@@ -1,0 +1,32 @@
+"""auron-tpu: a TPU-native query-execution engine.
+
+A ground-up re-design of the capability set of Apache Auron (the Spark/Flink
+native-execution accelerator, see /root/reference) for TPU hardware:
+
+- host engine physical plans arrive as a protobuf IR (``auron_tpu.ir``),
+- a physical planner lowers the IR to a tree of columnar operators
+  (``auron_tpu.ops``) whose hot loops are jax.jit / pallas kernels running on
+  Arrow-derived device batches (``auron_tpu.columnar``),
+- a memory manager tiers batches between TPU HBM and host DRAM with spilling
+  (``auron_tpu.memmgr``),
+- stage exchange (hash / round-robin / range / single partitioning and
+  broadcast) runs as ICI all-to-all over a ``jax.sharding.Mesh``
+  (``auron_tpu.parallel``).
+
+Unlike the reference (Rust + DataFusion on CPU, reference:
+native-engine/auron/src/rt.rs), the compute path here is XLA: batches are
+fixed-capacity, validity-masked device arrays so every kernel traces to a
+static-shape HLO module that XLA can tile onto the MXU/VPU.
+"""
+
+import jax
+
+# SQL semantics need real 64-bit integers (BIGINT sums, xxhash64, decimal64).
+# TPU emulates i64 with i32 pairs; kernels that are perf-critical choose
+# narrower dtypes explicitly.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from auron_tpu.columnar.batch import DeviceBatch, PrimitiveColumn, StringColumn  # noqa: E402,F401
+from auron_tpu.columnar.schema import DataType, Field, Schema  # noqa: E402,F401

@@ -1,0 +1,111 @@
+"""Logical schema, kept host-side.
+
+Device batches are bare pytrees of arrays; the logical types (the analogue of
+the Arrow schema that travels with every RecordBatch in the reference,
+reference: native-engine/datafusion-ext-commons/src/io/batch_serde.rs) live
+here and are threaded through the planner, never onto the device.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+class DataType(enum.Enum):
+    BOOL = "bool"
+    INT8 = "int8"
+    INT16 = "int16"
+    INT32 = "int32"
+    INT64 = "int64"
+    FLOAT32 = "float32"
+    FLOAT64 = "float64"
+    DATE32 = "date32"          # days since epoch, int32 payload
+    TIMESTAMP_US = "timestamp_us"  # microseconds since epoch, int64 payload
+    DECIMAL = "decimal"        # precision<=18 stored as scaled int64
+    STRING = "string"
+    NULL = "null"
+
+    # ---- classification helpers -------------------------------------------
+    @property
+    def is_numeric(self) -> bool:
+        return self in _NUMERIC
+
+    @property
+    def is_integer(self) -> bool:
+        return self in _INTEGER
+
+    @property
+    def is_floating(self) -> bool:
+        return self in (DataType.FLOAT32, DataType.FLOAT64)
+
+    def to_np(self) -> np.dtype:
+        return np.dtype(_NP[self])
+
+
+_NUMERIC = {
+    DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64,
+    DataType.FLOAT32, DataType.FLOAT64, DataType.DECIMAL,
+}
+_INTEGER = {DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64}
+
+# Physical numpy payload for each logical type (strings handled separately).
+_NP = {
+    DataType.BOOL: "bool",
+    DataType.INT8: "int8",
+    DataType.INT16: "int16",
+    DataType.INT32: "int32",
+    DataType.INT64: "int64",
+    DataType.FLOAT32: "float32",
+    DataType.FLOAT64: "float64",
+    DataType.DATE32: "int32",
+    DataType.TIMESTAMP_US: "int64",
+    DataType.DECIMAL: "int64",
+    DataType.NULL: "bool",
+}
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: DataType
+    nullable: bool = True
+    # decimal only
+    precision: int = 0
+    scale: int = 0
+
+    def with_name(self, name: str) -> "Field":
+        return Field(name, self.dtype, self.nullable, self.precision, self.scale)
+
+
+@dataclass(frozen=True)
+class Schema:
+    fields: tuple[Field, ...] = field(default_factory=tuple)
+
+    def __post_init__(self):
+        object.__setattr__(self, "fields", tuple(self.fields))
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __getitem__(self, i) -> Field:
+        return self.fields[i]
+
+    def index_of(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(f"no field named {name!r} in {self.names}")
+
+    @property
+    def names(self) -> list[str]:
+        return [f.name for f in self.fields]
+
+    @property
+    def dtypes(self) -> list[DataType]:
+        return [f.dtype for f in self.fields]

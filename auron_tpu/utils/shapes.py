@@ -1,0 +1,39 @@
+"""Static-shape bucketing.
+
+XLA traces/compiles one HLO module per distinct input shape. To bound
+recompilation we round every dynamic extent (row counts, string widths, hash
+table sizes) up to a small set of buckets; the true extent rides along as a
+device scalar and kernels mask the padding.
+
+This mirrors what the reference never had to do — its Rust engine handled
+dynamic batch sizes natively (reference: native-engine/datafusion-ext-commons/
+src/lib.rs batch_size()) — and is the central trick that makes a columnar SQL
+engine compile onto a static-shape compiler.
+"""
+
+from __future__ import annotations
+
+DEFAULT_BATCH_CAPACITY = 8192
+
+# Width buckets for fixed-width device string columns (bytes per slot).
+STRING_WIDTH_BUCKETS = (8, 16, 32, 64, 128, 256)
+
+
+def next_pow2(n: int) -> int:
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def bucket_rows(n: int, minimum: int = 16) -> int:
+    """Round a row count up to a power of two (>= minimum)."""
+    return max(minimum, next_pow2(n))
+
+
+def bucket_string_width(max_len: int) -> int:
+    """Round a max string byte-length up to a width bucket."""
+    for w in STRING_WIDTH_BUCKETS:
+        if max_len <= w:
+            return w
+    # Degenerate long strings: round to next multiple of 256.
+    return ((max_len + 255) // 256) * 256

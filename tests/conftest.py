@@ -1,0 +1,29 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the reference runs its native tests
+without a JVM the same way — the 'fake backend' pattern, reference:
+auron-memmgr/src/spill.rs:78-87): multi-chip sharding logic is exercised with
+xla_force_host_platform_device_count, and the real-TPU bench path is covered
+separately by bench.py.
+
+Env vars must be set before jax initializes, hence this happens at conftest
+import time, before any test module imports auron_tpu.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override the session's axon/TPU default
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# jax may already be imported by the interpreter's sitecustomize, in which
+# case the env vars above were read too late — force the config directly
+# (safe as long as no computation has run yet).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,134 @@
+"""Pure-Python reference implementations used only by tests.
+
+Independent scalar re-implementations of Spark's hash functions (semantics
+documented in the reference at native-engine/datafusion-ext-commons/src/hash/)
+to differentially test the vectorized JAX kernels.
+"""
+
+MASK32 = 0xFFFFFFFF
+MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _rotl32(x, r):
+    x &= MASK32
+    return ((x << r) | (x >> (32 - r))) & MASK32
+
+
+def _mix_k1(k1):
+    k1 = (k1 * 0xCC9E2D51) & MASK32
+    k1 = _rotl32(k1, 15)
+    return (k1 * 0x1B873593) & MASK32
+
+
+def _mix_h1(h1, k1):
+    h1 ^= k1
+    h1 = _rotl32(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & MASK32
+
+
+def _fmix(h1, length):
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & MASK32
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & MASK32
+    h1 ^= h1 >> 16
+    return h1
+
+
+def _to_signed32(x):
+    x &= MASK32
+    return x - (1 << 32) if x >= (1 << 31) else x
+
+
+def _to_signed64(x):
+    x &= MASK64
+    return x - (1 << 64) if x >= (1 << 63) else x
+
+
+def murmur3_bytes(data: bytes, seed: int) -> int:
+    """Spark murmur3: 4-byte LE blocks, then tail bytes one at a time
+    (sign-extended), fmix with total length."""
+    h1 = seed & MASK32
+    nblocks = len(data) // 4
+    for i in range(nblocks):
+        word = int.from_bytes(data[i * 4:(i + 1) * 4], "little")
+        h1 = _mix_h1(h1, _mix_k1(word))
+    for b in data[nblocks * 4:]:
+        signed = b - 256 if b >= 128 else b
+        h1 = _mix_h1(h1, _mix_k1(signed & MASK32))
+    return _to_signed32(_fmix(h1, len(data)))
+
+
+def murmur3_long(value: int, seed: int) -> int:
+    h1 = _mix_h1(seed & MASK32, _mix_k1(value & MASK32))
+    h1 = _mix_h1(h1, _mix_k1((value >> 32) & MASK32))
+    return _to_signed32(_fmix(h1, 8))
+
+
+P1 = 0x9E3779B185EBCA87
+P2 = 0xC2B2AE3D27D4EB4F
+P3 = 0x165667B19E3779F9
+P4 = 0x85EBCA77C2B2AE63
+P5 = 0x27D4EB2F165667C5
+
+
+def _rotl64(x, r):
+    x &= MASK64
+    return ((x << r) | (x >> (64 - r))) & MASK64
+
+
+def _xx_round(acc, inp):
+    acc = (acc + inp * P2) & MASK64
+    acc = _rotl64(acc, 31)
+    return (acc * P1) & MASK64
+
+
+def _xx_merge(h, acc):
+    h ^= _xx_round(0, acc)
+    return (h * P1 + P4) & MASK64
+
+
+def _xx_avalanche(h):
+    h ^= h >> 33
+    h = (h * P2) & MASK64
+    h ^= h >> 29
+    h = (h * P3) & MASK64
+    h ^= h >> 32
+    return h
+
+
+def xxhash64_bytes(data: bytes, seed: int) -> int:
+    seed &= MASK64
+    remaining = len(data)
+    off = 0
+    if remaining >= 32:
+        a1 = (seed + P1 + P2) & MASK64
+        a2 = (seed + P2) & MASK64
+        a3 = seed
+        a4 = (seed - P1) & MASK64
+        while remaining >= 32:
+            a1 = _xx_round(a1, int.from_bytes(data[off:off + 8], "little")); off += 8
+            a2 = _xx_round(a2, int.from_bytes(data[off:off + 8], "little")); off += 8
+            a3 = _xx_round(a3, int.from_bytes(data[off:off + 8], "little")); off += 8
+            a4 = _xx_round(a4, int.from_bytes(data[off:off + 8], "little")); off += 8
+            remaining -= 32
+        h = (_rotl64(a1, 1) + _rotl64(a2, 7) + _rotl64(a3, 12) + _rotl64(a4, 18)) & MASK64
+        for acc in (a1, a2, a3, a4):
+            h = _xx_merge(h, acc)
+    else:
+        h = (seed + P5) & MASK64
+    h = (h + len(data)) & MASK64
+    while remaining >= 8:
+        h ^= _xx_round(0, int.from_bytes(data[off:off + 8], "little"))
+        h = (_rotl64(h, 27) * P1 + P4) & MASK64
+        off += 8; remaining -= 8
+    if remaining >= 4:
+        h ^= (int.from_bytes(data[off:off + 4], "little") * P1) & MASK64
+        h = (_rotl64(h, 23) * P2 + P3) & MASK64
+        off += 4; remaining -= 4
+    while remaining:
+        h ^= (data[off] * P5) & MASK64
+        h = (_rotl64(h, 11) * P1) & MASK64
+        off += 1; remaining -= 1
+    return _to_signed64(_xx_avalanche(h))

@@ -1,0 +1,88 @@
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+
+import jax.numpy as jnp
+
+from auron_tpu.columnar import batch as B
+from auron_tpu.columnar.arrow_bridge import to_arrow, to_device
+
+
+def roundtrip(rb, **kw):
+    dev, schema = to_device(rb, **kw)
+    return to_arrow(dev, schema)
+
+
+def test_roundtrip_primitives():
+    rb = pa.record_batch({
+        "i32": pa.array([1, None, -3], pa.int32()),
+        "i64": pa.array([10, 20, None], pa.int64()),
+        "f64": pa.array([1.5, None, -2.5], pa.float64()),
+        "b": pa.array([True, False, None], pa.bool_()),
+    })
+    out = roundtrip(rb)
+    assert out.equals(rb)
+
+
+def test_roundtrip_strings():
+    rb = pa.record_batch({
+        "s": pa.array(["", "hello", None, "wörld", "a" * 30], pa.string()),
+    })
+    out = roundtrip(rb)
+    assert out.equals(rb)
+
+
+def test_roundtrip_date_timestamp_decimal():
+    rb = pa.record_batch({
+        "d": pa.array([0, 19000, None], pa.date32()),
+        "ts": pa.array([0, 1_700_000_000_000_000, None], pa.timestamp("us")),
+        "dec": pa.array([None, Decimal("123.45"), Decimal("-0.01")],
+                        pa.decimal128(10, 2)),
+    })
+    out = roundtrip(rb)
+    assert out.equals(rb)
+
+
+def test_capacity_padding_and_mask():
+    rb = pa.record_batch({"x": pa.array([1, 2, 3], pa.int64())})
+    dev, schema = to_device(rb, capacity=16)
+    assert dev.capacity == 16
+    assert int(dev.num_rows) == 3
+    np.testing.assert_array_equal(
+        np.asarray(dev.row_mask()), [True] * 3 + [False] * 13)
+    assert to_arrow(dev, schema).equals(rb)
+
+
+def test_compact():
+    rb = pa.record_batch({
+        "x": pa.array([1, 2, 3, 4, 5], pa.int64()),
+        "s": pa.array(["a", "bb", "ccc", None, "e"], pa.string()),
+    })
+    dev, schema = to_device(rb, capacity=8)
+    keep = jnp.asarray([True, False, True, True, False, True, True, True])
+    out = B.compact(dev, keep)
+    assert int(out.num_rows) == 3
+    got = to_arrow(out, schema)
+    assert got.column(0).to_pylist() == [1, 3, 4]
+    assert got.column(1).to_pylist() == ["a", "ccc", None]
+
+
+def test_concat_batches():
+    rb1 = pa.record_batch({"x": pa.array([1, 2], pa.int64())})
+    rb2 = pa.record_batch({"x": pa.array([3, 4, 5], pa.int64())})
+    d1, schema = to_device(rb1, capacity=4)
+    d2, _ = to_device(rb2, capacity=4)
+    out = B.concat_batches(d1, d2)
+    assert out.capacity == 8
+    assert int(out.num_rows) == 5
+    assert to_arrow(out, schema).column(0).to_pylist() == [1, 2, 3, 4, 5]
+
+
+def test_resize():
+    rb = pa.record_batch({"x": pa.array([1, 2, 3], pa.int64()),
+                          "s": pa.array(["a", "b", "c"], pa.string())})
+    dev, schema = to_device(rb, capacity=4)
+    grown = B.resize(dev, 16)
+    assert grown.capacity == 16
+    assert to_arrow(grown, schema).equals(to_arrow(dev, schema))
