@@ -1,0 +1,192 @@
+"""Device string kernels over fixed-width byte matrices.
+
+All operate on (chars uint8[n, w], lens int32[n]) — the padded layout from
+auron_tpu.columnar.batch.StringColumn. Zero padding makes plain byte-wise
+comparison coincide with lexicographic ordering (0 sorts below every byte, so
+a proper prefix sorts first), which turns string sort keys into integer
+columns the MXU-era sort networks can chew on.
+
+Covers the string surface of the reference's expression/function layer
+(reference: datafusion-ext-exprs/src/string_{starts_with,ends_with,
+contains}.rs, datafusion-ext-functions/src/spark_strings.rs).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from auron_tpu.columnar.batch import StringColumn
+
+
+def literal_to_device(s: bytes | str, width: int) -> tuple[np.ndarray, int]:
+    """Encode a literal to a zero-padded row of the given width."""
+    b = s.encode() if isinstance(s, str) else s
+    if len(b) > width:
+        # longer than any possible column value of this width
+        return np.zeros(width, np.uint8), len(b)
+    out = np.zeros(width, np.uint8)
+    out[: len(b)] = np.frombuffer(b, np.uint8)
+    return out, len(b)
+
+
+def _be_words(chars: jax.Array) -> jax.Array:
+    """Pack bytes into big-endian uint32 words [n, ceil(w/4)] so word-wise
+    integer comparison == lexicographic byte comparison."""
+    n, w = chars.shape
+    pad = (-w) % 4
+    if pad:
+        chars = jnp.pad(chars, ((0, 0), (0, pad)))
+    u = chars.astype(jnp.uint32).reshape(n, -1, 4)
+    return (u[:, :, 0] << 24) | (u[:, :, 1] << 16) | (u[:, :, 2] << 8) | u[:, :, 3]
+
+
+def compare(a_chars, a_lens, b_chars, b_lens):
+    """Three-way compare: returns (lt, eq) bool[n] per lexicographic byte
+    order. Zero padding means lens only matter for the eq tie-break when one
+    is a strict prefix — handled for free because padding is 0."""
+    wa = _be_words(a_chars)
+    wb = _be_words(b_chars)
+    k = max(wa.shape[1], wb.shape[1])
+    if wa.shape[1] < k:
+        wa = jnp.pad(wa, ((0, 0), (0, k - wa.shape[1])))
+    if wb.shape[1] < k:
+        wb = jnp.pad(wb, ((0, 0), (0, k - wb.shape[1])))
+    lt = jnp.zeros(wa.shape[0], bool)
+    eq = jnp.ones(wa.shape[0], bool)
+    for i in range(k):
+        lt = lt | (eq & (wa[:, i] < wb[:, i]))
+        eq = eq & (wa[:, i] == wb[:, i])
+    # equal padded bytes but different lengths cannot happen with 0-padding
+    # unless values contain NUL bytes; SQL strings here never do.
+    return lt, eq & (a_lens == b_lens)
+
+
+def sort_key_words(col: StringColumn, max_words: int | None = None) -> jax.Array:
+    """uint32[n, k] big-endian words usable as a compound sort key."""
+    w = _be_words(col.chars)
+    if max_words is not None and w.shape[1] > max_words:
+        w = w[:, :max_words]
+    return w
+
+
+def starts_with(chars, lens, prefix: bytes) -> jax.Array:
+    n, w = chars.shape
+    if len(prefix) == 0:
+        return jnp.ones(n, bool)
+    if len(prefix) > w:
+        return jnp.zeros(n, bool)
+    lit = jnp.asarray(np.frombuffer(prefix, np.uint8))
+    match = jnp.all(chars[:, : len(prefix)] == lit[None, :], axis=1)
+    return match & (lens >= len(prefix))
+
+
+def ends_with(chars, lens, suffix: bytes) -> jax.Array:
+    n, w = chars.shape
+    m = len(suffix)
+    if m == 0:
+        return jnp.ones(n, bool)
+    if m > w:
+        return jnp.zeros(n, bool)
+    lit = jnp.asarray(np.frombuffer(suffix, np.uint8))
+    # gather the last m bytes of each row
+    start = jnp.maximum(lens - m, 0)
+    idx = start[:, None] + jnp.arange(m)[None, :]
+    tail = jnp.take_along_axis(chars, jnp.clip(idx, 0, w - 1), axis=1)
+    return jnp.all(tail == lit[None, :], axis=1) & (lens >= m)
+
+
+def contains(chars, lens, infix: bytes) -> jax.Array:
+    n, w = chars.shape
+    m = len(infix)
+    if m == 0:
+        return jnp.ones(n, bool)
+    if m > w:
+        return jnp.zeros(n, bool)
+    lit = jnp.asarray(np.frombuffer(infix, np.uint8))
+    # windows: for each start s in [0, w-m], all(chars[:, s:s+m] == lit)
+    hits = jnp.zeros(n, bool)
+    for s in range(w - m + 1):
+        win_ok = jnp.all(chars[:, s: s + m] == lit[None, :], axis=1)
+        hits = hits | (win_ok & (s + m <= lens))
+    return hits
+
+
+def substring(col: StringColumn, start: jax.Array, length: jax.Array) -> StringColumn:
+    """1-based SQL substring with Spark semantics (negative start counts from
+    the end; reference: spark_strings.rs string_substring)."""
+    chars, lens = col.chars, col.lens
+    n, w = chars.shape
+    start = jnp.asarray(start, jnp.int32)
+    length = jnp.maximum(jnp.asarray(length, jnp.int32), 0)
+    # Spark: start>0 → start-1; start==0 → 0; start<0 → len+start (floor 0)
+    zero_based = jnp.where(start > 0, start - 1,
+                           jnp.where(start == 0, 0, jnp.maximum(lens + start, 0)))
+    zero_based = jnp.minimum(zero_based, lens)
+    out_len = jnp.minimum(length, lens - zero_based)
+    idx = zero_based[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    gathered = jnp.take_along_axis(chars, jnp.clip(idx, 0, w - 1), axis=1)
+    mask = jnp.arange(w, dtype=jnp.int32)[None, :] < out_len[:, None]
+    return StringColumn(jnp.where(mask, gathered, 0).astype(jnp.uint8),
+                        out_len, col.validity)
+
+
+def concat(cols: list[StringColumn], out_width: int) -> StringColumn:
+    """Concatenate string columns row-wise (null if any null — Spark concat)."""
+    n = cols[0].capacity
+    out = jnp.zeros((n, out_width), jnp.uint8)
+    pos = jnp.zeros(n, jnp.int32)
+    for c in cols:
+        w = c.width
+        # scatter c.chars rows at offset pos
+        tgt = pos[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+        valid = jnp.arange(w, dtype=jnp.int32)[None, :] < c.lens[:, None]
+        tgt = jnp.where(valid, tgt, out_width)  # out-of-range drops
+        rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, w))
+        out = out.at[rows.reshape(-1), jnp.clip(tgt, 0, out_width).reshape(-1)].max(
+            jnp.where(valid, c.chars, 0).reshape(-1), mode="drop")
+        pos = pos + c.lens
+    validity = cols[0].validity
+    for c in cols[1:]:
+        validity = validity & c.validity
+    return StringColumn(out, jnp.where(validity, pos, 0), validity)
+
+
+def upper(col: StringColumn) -> StringColumn:
+    c = col.chars
+    is_lower = (c >= ord("a")) & (c <= ord("z"))
+    return StringColumn(jnp.where(is_lower, c - 32, c).astype(jnp.uint8),
+                        col.lens, col.validity)
+
+
+def lower(col: StringColumn) -> StringColumn:
+    c = col.chars
+    is_upper = (c >= ord("A")) & (c <= ord("Z"))
+    return StringColumn(jnp.where(is_upper, c + 32, c).astype(jnp.uint8),
+                        col.lens, col.validity)
+
+
+def trim(col: StringColumn, left: bool = True, right: bool = True) -> StringColumn:
+    chars, lens = col.chars, col.lens
+    n, w = chars.shape
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    in_str = pos < lens[:, None]
+    is_space = (chars == ord(" ")) & in_str
+    if right:
+        nonspace_idx = jnp.where(~is_space & in_str, pos, -1)
+        last_nonspace = jnp.max(nonspace_idx, axis=1)  # -1 if all spaces
+        new_len = last_nonspace + 1
+    else:
+        new_len = lens
+    if left:
+        lead = jnp.where(~is_space & in_str, pos, w)
+        first_nonspace = jnp.min(lead, axis=1)
+        first_nonspace = jnp.minimum(first_nonspace, new_len)
+        idx = first_nonspace[:, None] + pos
+        chars = jnp.take_along_axis(chars, jnp.clip(idx, 0, w - 1), axis=1)
+        new_len = new_len - first_nonspace
+    mask = pos < new_len[:, None]
+    return StringColumn(jnp.where(mask, chars, 0).astype(jnp.uint8),
+                        jnp.maximum(new_len, 0), col.validity)

@@ -1,0 +1,209 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu.columnar.arrow_bridge import to_arrow, to_device
+from auron_tpu.columnar.schema import DataType, Field, Schema
+from auron_tpu.exprs import ir
+from auron_tpu.exprs.eval import EvalContext, evaluate
+
+
+def eval_to_list(expr, rb, **kw):
+    batch, schema = to_device(rb, **kw)
+    tv = evaluate(expr, batch, schema, EvalContext())
+    n = int(batch.num_rows)
+    data = np.asarray(tv.data) if not hasattr(tv.col, "chars") else None
+    validity = np.asarray(tv.validity)
+    if data is None:
+        out = []
+        chars = np.asarray(tv.col.chars)
+        lens = np.asarray(tv.col.lens)
+        for i in range(n):
+            out.append(bytes(chars[i, :lens[i]]).decode() if validity[i] else None)
+        return out
+    return [data[i].item() if validity[i] else None for i in range(n)]
+
+
+C = ir.ColumnRef
+L = ir.Literal
+
+
+def test_arithmetic_and_nulls():
+    rb = pa.record_batch({
+        "a": pa.array([1, 2, None, 4], pa.int64()),
+        "b": pa.array([10, None, 30, 0], pa.int64()),
+    })
+    assert eval_to_list(ir.BinaryExpr("+", C(0), C(1)), rb) == [11, None, None, 4]
+    assert eval_to_list(ir.BinaryExpr("*", C(0), C(1)), rb) == [10, None, None, 0]
+    # integer division by zero → null (a/b with b=0 in the last row)
+    assert eval_to_list(ir.BinaryExpr("/", C(0), C(1)), rb) == [0, None, None, None]
+
+
+def test_java_division_semantics():
+    rb = pa.record_batch({
+        "a": pa.array([7, -7, 7, -7], pa.int64()),
+        "b": pa.array([2, 2, -2, -2], pa.int64()),
+    })
+    # Java: truncation toward zero
+    assert eval_to_list(ir.BinaryExpr("/", C(0), C(1)), rb) == [3, -3, -3, 3]
+    # Java %: sign of dividend
+    assert eval_to_list(ir.BinaryExpr("%", C(0), C(1)), rb) == [1, -1, 1, -1]
+
+
+def test_three_valued_logic():
+    rb = pa.record_batch({
+        "x": pa.array([True, True, False, None, None], pa.bool_()),
+        "y": pa.array([None, False, None, True, None], pa.bool_()),
+    })
+    assert eval_to_list(ir.BinaryExpr("and", C(0), C(1)), rb) == \
+        [None, False, False, None, None]
+    assert eval_to_list(ir.BinaryExpr("or", C(0), C(1)), rb) == \
+        [True, True, None, True, None]
+
+
+def test_comparisons_and_null_checks():
+    rb = pa.record_batch({"a": pa.array([1.5, None, 3.0], pa.float64())})
+    assert eval_to_list(ir.BinaryExpr(">", C(0), L(2.0, DataType.FLOAT64)), rb) == \
+        [False, None, True]
+    assert eval_to_list(ir.IsNull(C(0)), rb) == [False, True, False]
+    assert eval_to_list(ir.IsNotNull(C(0)), rb) == [True, False, True]
+
+
+def test_string_compare_and_like():
+    rb = pa.record_batch({
+        "s": pa.array(["apple", "banana", None, "apricot", "b"], pa.string()),
+    })
+    assert eval_to_list(ir.BinaryExpr("<", C(0), L("b", DataType.STRING)), rb) == \
+        [True, False, None, True, False]
+    assert eval_to_list(ir.StringStartsWith(C(0), "ap"), rb) == \
+        [True, False, None, True, False]
+    assert eval_to_list(ir.StringEndsWith(C(0), "a"), rb) == \
+        [False, True, None, False, False]
+    assert eval_to_list(ir.StringContains(C(0), "an"), rb) == \
+        [False, True, None, False, False]
+    assert eval_to_list(ir.Like(C(0), "a%t"), rb) == \
+        [False, False, None, True, False]
+    assert eval_to_list(ir.Like(C(0), "_pple"), rb) == \
+        [True, False, None, False, False]
+
+
+def test_case_when():
+    rb = pa.record_batch({"x": pa.array([1, 2, 3, None], pa.int64())})
+    expr = ir.CaseWhen(
+        when_then=(
+            (ir.BinaryExpr("==", C(0), L(1, DataType.INT64)), L("one", DataType.STRING)),
+            (ir.BinaryExpr("==", C(0), L(2, DataType.INT64)), L("two", DataType.STRING)),
+        ),
+        otherwise=L("many", DataType.STRING))
+    assert eval_to_list(expr, rb) == ["one", "two", "many", "many"]
+    expr2 = ir.CaseWhen(
+        when_then=((ir.BinaryExpr("==", C(0), L(1, DataType.INT64)),
+                    L("one", DataType.STRING)),))
+    assert eval_to_list(expr2, rb) == ["one", None, None, None]
+
+
+def test_in_list():
+    rb = pa.record_batch({
+        "x": pa.array([1, 5, 9, None], pa.int64()),
+        "s": pa.array(["a", "b", "c", None], pa.string()),
+    })
+    assert eval_to_list(ir.InList(C(0), (1, 9)), rb) == [True, False, True, None]
+    assert eval_to_list(ir.InList(C(1), ("a", "c"), negated=True), rb) == \
+        [False, True, False, None]
+
+
+def test_cast_numeric():
+    rb = pa.record_batch({
+        "f": pa.array([1.9, -2.9, float("nan"), 3e10], pa.float64()),
+    })
+    # JVM float→int: truncate, NaN→0, saturate
+    assert eval_to_list(ir.Cast(C(0), DataType.INT32), rb) == \
+        [1, -2, 0, 2**31 - 1]
+    assert eval_to_list(ir.Cast(C(0), DataType.INT64), rb) == \
+        [1, -2, 0, 30000000000]
+
+
+def test_cast_string_to_int():
+    rb = pa.record_batch({"s": pa.array(["12", " 34 ", "x", None], pa.string())})
+    assert eval_to_list(ir.Cast(C(0), DataType.INT32), rb) == [12, 34, None, None]
+
+
+def test_cast_int_to_string():
+    rb = pa.record_batch({"x": pa.array([12, -7, None], pa.int64())})
+    assert eval_to_list(ir.Cast(C(0), DataType.STRING), rb) == ["12", "-7", None]
+
+
+def test_string_functions():
+    rb = pa.record_batch({"s": pa.array(["  Hello ", "WORLD", None], pa.string())})
+    F = ir.ScalarFunction
+    assert eval_to_list(F("trim", (C(0),)), rb) == ["Hello", "WORLD", None]
+    assert eval_to_list(F("upper", (C(0),)), rb) == ["  HELLO ", "WORLD", None]
+    assert eval_to_list(F("lower", (C(0),)), rb) == ["  hello ", "world", None]
+    assert eval_to_list(F("length", (C(0),)), rb) == [8, 5, None]
+
+
+def test_substring_spark_semantics():
+    rb = pa.record_batch({"s": pa.array(["hello"], pa.string())})
+    F = ir.ScalarFunction
+    L64 = lambda v: L(v, DataType.INT64)
+    assert eval_to_list(F("substring", (C(0), L64(2), L64(3))), rb) == ["ell"]
+    assert eval_to_list(F("substring", (C(0), L64(0), L64(2))), rb) == ["he"]
+    assert eval_to_list(F("substring", (C(0), L64(-3), L64(2))), rb) == ["ll"]
+    assert eval_to_list(F("substring", (C(0), L64(10), L64(2))), rb) == [""]
+
+
+def test_concat():
+    rb = pa.record_batch({
+        "a": pa.array(["foo", "x", None], pa.string()),
+        "b": pa.array(["bar", "yz", "w"], pa.string()),
+    })
+    assert eval_to_list(ir.ScalarFunction("concat", (C(0), C(1))), rb) == \
+        ["foobar", "xyz", None]
+
+
+def test_date_functions():
+    import datetime
+    dates = [datetime.date(2000, 2, 29), datetime.date(1969, 12, 31),
+             datetime.date(2023, 7, 4)]
+    days = [(d - datetime.date(1970, 1, 1)).days for d in dates]
+    rb = pa.record_batch({"d": pa.array(days, pa.int32()).cast(pa.date32())})
+    F = ir.ScalarFunction
+    assert eval_to_list(F("year", (C(0),)), rb) == [2000, 1969, 2023]
+    assert eval_to_list(F("month", (C(0),)), rb) == [2, 12, 7]
+    assert eval_to_list(F("day", (C(0),)), rb) == [29, 31, 4]
+    assert eval_to_list(F("quarter", (C(0),)), rb) == [1, 4, 3]
+    # 2023-07-04 is a Tuesday → Spark dayofweek=3
+    assert eval_to_list(F("dayofweek", (C(0),)), rb)[2] == 3
+
+
+def test_coalesce_and_if():
+    rb = pa.record_batch({
+        "a": pa.array([None, 2, None], pa.int64()),
+        "b": pa.array([10, 20, None], pa.int64()),
+    })
+    F = ir.ScalarFunction
+    assert eval_to_list(F("coalesce", (C(0), C(1))), rb) == [10, 2, None]
+    cond = ir.IsNull(C(0))
+    assert eval_to_list(F("if", (cond, C(1), C(0))), rb) == [10, 2, None]
+
+
+def test_round():
+    rb = pa.record_batch({"x": pa.array([2.5, 3.5, -2.5, 1.234], pa.float64())})
+    F = ir.ScalarFunction
+    # Spark round = HALF_UP
+    assert eval_to_list(F("round", (C(0),)), rb) == [3.0, 4.0, -3.0, 1.0]
+    # bround = HALF_EVEN
+    assert eval_to_list(F("bround", (C(0),)), rb) == [2.0, 4.0, -2.0, 1.0]
+
+
+def test_decimal_arith():
+    from decimal import Decimal
+    rb = pa.record_batch({
+        "a": pa.array([Decimal("1.50"), Decimal("2.25"), None], pa.decimal128(10, 2)),
+        "b": pa.array([Decimal("0.50"), Decimal("1.00"), Decimal("3.00")],
+                      pa.decimal128(10, 2)),
+    })
+    assert eval_to_list(ir.BinaryExpr("+", C(0), C(1)), rb) == [200, 325, None]  # unscaled s=2
+    assert eval_to_list(ir.BinaryExpr("<", C(0), C(1)), rb) == [False, False, None]
+    out = eval_to_list(ir.BinaryExpr("*", C(0), C(1)), rb)
+    assert out == [7500, 22500, None]  # unscaled s=4
