@@ -1,0 +1,215 @@
+"""Parquet scan.
+
+The reference reads parquet through a JVM FileSystem wrapper into DataFusion's
+parquet opener with row-group/page pruning (reference: datafusion-ext-plans/
+src/parquet_exec.rs:151-237, scan/internal_file_reader.rs). Here the host side
+is pyarrow (column pruning + row-group statistics pruning + dictionary-aware
+reads) feeding padded DeviceBatches to the TPU; the scan is the host→device
+on-ramp, deliberately kept off the device's critical path via double
+buffering: while the device crunches batch N, pyarrow decodes batch N+1.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Iterator, Optional
+
+import pyarrow as pa
+import pyarrow.dataset as pa_ds
+import pyarrow.parquet as pq
+
+from auron_tpu.columnar.arrow_bridge import schema_from_arrow, to_device
+from auron_tpu.columnar.batch import DeviceBatch
+from auron_tpu.columnar.schema import Schema
+from auron_tpu.exprs import ir
+from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
+from auron_tpu.utils.shapes import DEFAULT_BATCH_CAPACITY
+
+
+def _expr_to_arrow_filter(e: ir.Expr, names: list[str]):
+    """Best-effort translation of predicates to pyarrow dataset filters for
+    row-group pruning; anything untranslatable is skipped (the device filter
+    re-applies everything, so this is pruning-only — same contract as the
+    reference's rowgroup pruning, conf.rs:43-46)."""
+    import pyarrow.compute as pc
+    try:
+        if isinstance(e, ir.BinaryExpr) and e.op in ("==", "!=", "<", "<=", ">", ">="):
+            l, r = e.left, e.right
+            if isinstance(l, ir.ColumnRef) and isinstance(r, ir.Literal):
+                f = pc.field(names[l.index])
+                v = r.value
+                return {"==": f == v, "!=": f != v, "<": f < v,
+                        "<=": f <= v, ">": f > v, ">=": f >= v}[e.op]
+        if isinstance(e, ir.BinaryExpr) and e.op == "and":
+            a = _expr_to_arrow_filter(e.left, names)
+            b = _expr_to_arrow_filter(e.right, names)
+            if a is not None and b is not None:
+                return a & b
+            return a if a is not None else b
+        if isinstance(e, ir.InList) and isinstance(e.child, ir.ColumnRef) and not e.negated:
+            return pc.field(names[e.child.index]).isin(list(e.values))
+        if isinstance(e, ir.IsNotNull) and isinstance(e.child, ir.ColumnRef):
+            return ~pc.field(names[e.child.index]).is_null()
+    except Exception:
+        return None
+    return None
+
+
+class ParquetScanOp(PhysicalOp):
+    name = "parquet_scan"
+
+    def __init__(self, files: list[str], schema: Optional[Schema] = None,
+                 columns: Optional[list[str]] = None,
+                 predicates: Optional[list[ir.Expr]] = None,
+                 batch_rows: int = DEFAULT_BATCH_CAPACITY,
+                 string_widths: Optional[dict[str, int]] = None):
+        self.files = list(files)
+        self.columns = columns
+        self.predicates = predicates or []
+        self.batch_rows = batch_rows
+        ds = pa_ds.dataset(self.files, format="parquet")
+        arrow_schema = ds.schema
+        if columns:
+            arrow_schema = pa.schema([arrow_schema.field(c) for c in columns])
+        self._arrow_schema = arrow_schema
+        self._schema = schema or schema_from_arrow(arrow_schema)
+        self._dataset = ds
+        # Pre-size string widths from the data unless caller pinned them, so
+        # every batch of a file lands in the same compiled kernel bucket.
+        self.string_widths = dict(string_widths or {})
+
+    @property
+    def children(self):
+        return []
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _partition_files(self, partition: int, num_partitions: int) -> list[str]:
+        return [f for i, f in enumerate(self.files)
+                if i % num_partitions == partition]
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        metrics = ctx.metrics_for(self.name)
+        io_time = metrics.counter("io_time")
+        files = self._partition_files(partition, max(ctx.num_partitions, 1))
+
+        names = self._arrow_schema.names
+        arrow_filter = None
+        for p in self.predicates:
+            f = _expr_to_arrow_filter(p, self._schema.names)
+            if f is not None:
+                arrow_filter = f if arrow_filter is None else (arrow_filter & f)
+
+        def host_batches():
+            if not files:
+                return
+            ds = pa_ds.dataset(files, format="parquet")
+            scanner = ds.scanner(columns=self.columns, filter=arrow_filter,
+                                 batch_size=self.batch_rows)
+            for rb in scanner.to_batches():
+                if rb.num_rows == 0:
+                    continue
+                # split oversized batches (scanner batch_size is a hint)
+                for off in range(0, rb.num_rows, self.batch_rows):
+                    yield rb.slice(off, min(self.batch_rows, rb.num_rows - off))
+
+        def stream():
+            # Double buffering: decode/transfer next batch while caller
+            # computes on the current one.
+            with concurrent.futures.ThreadPoolExecutor(1) as pool:
+                it = host_batches()
+
+                def convert(rb):
+                    return to_device(rb, capacity=self.batch_rows,
+                                     string_widths=self._widths_for(rb))[0]
+
+                pending = None
+                for rb in it:
+                    nxt = pool.submit(convert, rb)
+                    if pending is not None:
+                        with timer(io_time):
+                            yield pending.result()
+                    pending = nxt
+                if pending is not None:
+                    with timer(io_time):
+                        yield pending.result()
+
+        return count_output(stream(), metrics)
+
+    def _widths_for(self, rb: pa.RecordBatch) -> dict[str, int]:
+        """Stable width buckets per string column, learned once per scan from
+        parquet statistics / first batch and then pinned."""
+        import pyarrow.compute as pc
+        from auron_tpu.utils.shapes import bucket_string_width
+        widths = self.string_widths
+        for i, f in enumerate(rb.schema):
+            if pa.types.is_string(f.type) or pa.types.is_large_string(f.type):
+                if f.name not in widths:
+                    col = rb.column(i)
+                    max_len = pc.max(pc.binary_length(col)).as_py() or 1
+                    widths[f.name] = bucket_string_width(max(max_len, 1))
+                else:
+                    col = rb.column(i)
+                    max_len = pc.max(pc.binary_length(col)).as_py() or 0
+                    if max_len > widths[f.name]:
+                        widths[f.name] = bucket_string_width(max_len)
+        return widths
+
+    def __repr__(self):
+        return f"ParquetScanOp[{len(self.files)} files]"
+
+
+class MemoryScanOp(PhysicalOp):
+    """In-memory source (tests and broadcast-side plumbing)."""
+
+    name = "memory_scan"
+
+    def __init__(self, partitions: list[list[pa.RecordBatch]], schema: Schema,
+                 capacity: int = DEFAULT_BATCH_CAPACITY,
+                 string_widths: Optional[dict[str, int]] = None):
+        self.partitions = partitions
+        self._schema = schema
+        self.capacity = capacity
+        self.string_widths = string_widths
+
+    @property
+    def children(self):
+        return []
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        metrics = ctx.metrics_for(self.name)
+
+        def stream():
+            for rb in self.partitions[partition]:
+                if rb.num_rows:
+                    yield to_device(rb, capacity=self.capacity,
+                                    string_widths=self.string_widths)[0]
+
+        return count_output(stream(), metrics)
+
+
+class DeviceBatchScanOp(PhysicalOp):
+    """Source over already-device-resident batches (shuffle-read side)."""
+
+    name = "device_scan"
+
+    def __init__(self, partitions, schema: Schema):
+        self.partitions = partitions  # list[list[DeviceBatch]] or callable
+        self._schema = schema
+
+    @property
+    def children(self):
+        return []
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        parts = self.partitions(partition) if callable(self.partitions) \
+            else self.partitions[partition]
+        metrics = ctx.metrics_for(self.name)
+        return count_output(iter(parts), metrics)

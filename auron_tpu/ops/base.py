@@ -1,0 +1,125 @@
+"""Operator framework.
+
+The analogue of the reference's ExecutionPlan/ExecutionContext pair
+(reference: datafusion-ext-plans/src/common/execution_context.rs:70-767),
+re-shaped for a host-driven TPU engine: operators are a tree of
+``PhysicalOp``s; ``execute(partition, ctx)`` returns a pull-based iterator of
+DeviceBatches. The host loop stays in Python (it only orchestrates); every
+per-batch computation inside an operator is a jit-compiled kernel cached per
+(operator config, shape bucket), so steady-state execution is a chain of XLA
+executions with no per-row host work — the tokio stream chain of the
+reference collapses into Python generators driving device kernels.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from auron_tpu.columnar.batch import DeviceBatch
+from auron_tpu.columnar.schema import Schema
+
+
+class Metric:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def add(self, v):
+        self.value += v
+
+
+class MetricsSet:
+    """Per-operator metrics, mirrored into the host tree on finalize —
+    canonical names follow the reference (NativeHelper.scala:170-238):
+    output_rows, output_batches, elapsed_compute, mem_spill_count, ..."""
+
+    def __init__(self):
+        self._metrics: dict[str, Metric] = {}
+
+    def counter(self, name: str) -> Metric:
+        if name not in self._metrics:
+            self._metrics[name] = Metric()
+        return self._metrics[name]
+
+    def snapshot(self) -> dict[str, int]:
+        return {k: m.value for k, m in self._metrics.items()}
+
+
+class timer:
+    """Context manager adding wall nanoseconds to a metric
+    (reference: common/timer_helper.rs)."""
+
+    __slots__ = ("metric", "t0")
+
+    def __init__(self, metric: Metric):
+        self.metric = metric
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self.metric.add(time.perf_counter_ns() - self.t0)
+        return False
+
+
+@dataclass
+class ExecContext:
+    """Per-task execution context (reference: TaskContext propagated through
+    rt.rs:113-139): identity, metrics registry, memory manager hook."""
+
+    stage_id: int = 0
+    partition_id: int = 0
+    task_id: int = 0
+    num_partitions: int = 1
+    metrics: dict[str, MetricsSet] = field(default_factory=dict)
+    mem_manager: Optional[object] = None
+    # cancellation flag checked by long-running operators
+    cancelled: bool = False
+
+    def metrics_for(self, op_name: str) -> MetricsSet:
+        if op_name not in self.metrics:
+            self.metrics[op_name] = MetricsSet()
+        return self.metrics[op_name]
+
+    def metrics_snapshot(self) -> dict[str, dict[str, int]]:
+        return {k: v.snapshot() for k, v in self.metrics.items()}
+
+
+class PhysicalOp:
+    """Base physical operator."""
+
+    #: operator display name (metric key prefix)
+    name: str = "op"
+
+    @property
+    def children(self) -> list["PhysicalOp"]:
+        return []
+
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        raise NotImplementedError
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + repr(self) + "\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+def count_output(stream, metrics: MetricsSet):
+    """Wrap a batch stream with output_rows/output_batches counting."""
+    rows = metrics.counter("output_rows")
+    batches = metrics.counter("output_batches")
+    for b in stream:
+        rows.add(int(b.num_rows))
+        batches.add(1)
+        yield b

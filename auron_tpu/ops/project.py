@@ -1,0 +1,185 @@
+"""Projection / filter operators (+ fused filter-project).
+
+reference: datafusion-ext-plans/src/project_exec.rs, filter_exec.rs; the
+fusion mirrors CachedExprsEvaluator's project+filter fusion (reference:
+datafusion-ext-plans/src/common/cached_exprs_evaluator.rs:50+) — here the
+fused path is a single jit kernel, so XLA CSEs shared subexpressions and
+fuses everything into one HLO computation.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+from auron_tpu.columnar.batch import DeviceBatch, compact
+from auron_tpu.columnar.schema import DataType, Field, Schema
+from auron_tpu.exprs import ir
+from auron_tpu.exprs.eval import EvalContext, evaluate, infer_dtype
+from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
+
+
+def project_schema(exprs: tuple, names: tuple[str, ...], in_schema: Schema) -> Schema:
+    fields = []
+    for e, n in zip(exprs, names):
+        dt, p, s = infer_dtype(e, in_schema)
+        fields.append(Field(n, dt, True, p, s))
+    return Schema(tuple(fields))
+
+
+@lru_cache(maxsize=512)
+def _project_kernel(exprs: tuple, in_schema: Schema, capacity: int):
+    """One compiled kernel per (expression tuple, schema, capacity)."""
+
+    @jax.jit
+    def kernel(batch: DeviceBatch, partition_id, row_num_offset):
+        ctx = EvalContext(partition_id=partition_id, row_num_offset=row_num_offset)
+        cols = tuple(evaluate(e, batch, in_schema, ctx).col for e in exprs)
+        return DeviceBatch(cols, batch.num_rows)
+
+    return kernel
+
+
+@lru_cache(maxsize=512)
+def _filter_kernel(predicates: tuple, in_schema: Schema, capacity: int):
+    @jax.jit
+    def kernel(batch: DeviceBatch, partition_id, row_num_offset):
+        ctx = EvalContext(partition_id=partition_id, row_num_offset=row_num_offset)
+        keep = batch.row_mask()
+        for p in predicates:
+            v = evaluate(p, batch, in_schema, ctx)
+            keep = keep & v.data.astype(bool) & v.validity
+        return compact(batch, keep)
+
+    return kernel
+
+
+@lru_cache(maxsize=512)
+def _filter_project_kernel(predicates: tuple, exprs: tuple, in_schema: Schema,
+                           capacity: int):
+    @jax.jit
+    def kernel(batch: DeviceBatch, partition_id, row_num_offset):
+        ctx = EvalContext(partition_id=partition_id, row_num_offset=row_num_offset)
+        keep = batch.row_mask()
+        for p in predicates:
+            v = evaluate(p, batch, in_schema, ctx)
+            keep = keep & v.data.astype(bool) & v.validity
+        filtered = compact(batch, keep)
+        cols = tuple(evaluate(e, filtered, in_schema, ctx).col for e in exprs)
+        return DeviceBatch(cols, filtered.num_rows)
+
+    return kernel
+
+
+class ProjectOp(PhysicalOp):
+    name = "project"
+
+    def __init__(self, child: PhysicalOp, exprs: list[ir.Expr], names: list[str]):
+        self.child = child
+        self.exprs = tuple(exprs)
+        self.names = tuple(names)
+        self._schema = project_schema(self.exprs, self.names, child.schema())
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        metrics = ctx.metrics_for(self.name)
+        elapsed = metrics.counter("elapsed_compute")
+        in_schema = self.child.schema()
+
+        def stream():
+            row_off = 0
+            for batch in self.child.execute(partition, ctx):
+                kern = _project_kernel(self.exprs, in_schema, batch.capacity)
+                with timer(elapsed):
+                    out = kern(batch, jnp.int32(partition), jnp.int64(row_off))
+                row_off += int(batch.num_rows)
+                yield out
+
+        return count_output(stream(), metrics)
+
+    def __repr__(self):
+        return f"ProjectOp[{', '.join(self.names)}]"
+
+
+class FilterOp(PhysicalOp):
+    name = "filter"
+
+    def __init__(self, child: PhysicalOp, predicates: list[ir.Expr]):
+        self.child = child
+        self.predicates = tuple(predicates)
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def schema(self) -> Schema:
+        return self.child.schema()
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        metrics = ctx.metrics_for(self.name)
+        elapsed = metrics.counter("elapsed_compute")
+        in_schema = self.child.schema()
+
+        def stream():
+            row_off = 0
+            for batch in self.child.execute(partition, ctx):
+                kern = _filter_kernel(self.predicates, in_schema, batch.capacity)
+                with timer(elapsed):
+                    out = kern(batch, jnp.int32(partition), jnp.int64(row_off))
+                row_off += int(batch.num_rows)
+                yield out
+
+        return count_output(stream(), metrics)
+
+    def __repr__(self):
+        return f"FilterOp[{len(self.predicates)} predicates]"
+
+
+class FilterProjectOp(PhysicalOp):
+    """Fused filter+project — one kernel launch, full XLA fusion."""
+
+    name = "filter_project"
+
+    def __init__(self, child: PhysicalOp, predicates: list[ir.Expr],
+                 exprs: list[ir.Expr], names: list[str]):
+        self.child = child
+        self.predicates = tuple(predicates)
+        self.exprs = tuple(exprs)
+        self.names = tuple(names)
+        self._schema = project_schema(self.exprs, self.names, child.schema())
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        metrics = ctx.metrics_for(self.name)
+        elapsed = metrics.counter("elapsed_compute")
+        in_schema = self.child.schema()
+
+        def stream():
+            row_off = 0
+            for batch in self.child.execute(partition, ctx):
+                kern = _filter_project_kernel(self.predicates, self.exprs,
+                                              in_schema, batch.capacity)
+                with timer(elapsed):
+                    out = kern(batch, jnp.int32(partition), jnp.int64(row_off))
+                row_off += int(batch.num_rows)
+                yield out
+
+        return count_output(stream(), metrics)
+
+    def __repr__(self):
+        return f"FilterProjectOp[{len(self.predicates)} predicates -> {', '.join(self.names)}]"

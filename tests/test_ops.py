@@ -1,0 +1,199 @@
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pytest
+
+from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.exprs import ir
+from auron_tpu.io.parquet import MemoryScanOp, ParquetScanOp
+from auron_tpu.ops.agg import AggOp
+from auron_tpu.ops.limit import CoalesceBatchesOp, LimitOp, RenameColumnsOp, UnionOp
+from auron_tpu.ops.project import FilterOp, FilterProjectOp, ProjectOp
+from auron_tpu.runtime.executor import collect
+
+C = ir.ColumnRef
+L = ir.Literal
+
+
+def mem_scan(rb, capacity=64):
+    return MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=capacity)
+
+
+def test_project_filter_pipeline():
+    rb = pa.record_batch({
+        "x": pa.array(range(100), pa.int64()),
+        "y": pa.array([float(i) * 0.5 for i in range(100)], pa.float64()),
+    })
+    scan = mem_scan(rb, capacity=128)
+    filt = FilterOp(scan, [ir.BinaryExpr(">", C(0), L(90, DataType.INT64))])
+    proj = ProjectOp(filt, [ir.BinaryExpr("+", C(0), C(0)), C(1)], ["x2", "y"])
+    out = collect(proj)
+    assert out.column("x2").to_pylist() == [2 * i for i in range(91, 100)]
+
+
+def test_fused_filter_project():
+    rb = pa.record_batch({"x": pa.array(range(50), pa.int64())})
+    scan = mem_scan(rb, capacity=64)
+    op = FilterProjectOp(
+        scan,
+        [ir.BinaryExpr("<", C(0), L(5, DataType.INT64))],
+        [ir.BinaryExpr("*", C(0), L(10, DataType.INT64))], ["x10"])
+    out = collect(op)
+    assert out.column("x10").to_pylist() == [0, 10, 20, 30, 40]
+
+
+def test_limit_across_batches():
+    rbs = [pa.record_batch({"x": pa.array([i * 3, i * 3 + 1, i * 3 + 2], pa.int64())})
+           for i in range(5)]
+    scan = MemoryScanOp([rbs], schema_from_arrow(rbs[0].schema), capacity=4)
+    out = collect(LimitOp(scan, 7))
+    assert out.column("x").to_pylist() == [0, 1, 2, 3, 4, 5, 6]
+
+
+def test_union_and_rename():
+    rb1 = pa.record_batch({"x": pa.array([1, 2], pa.int64())})
+    rb2 = pa.record_batch({"x": pa.array([3], pa.int64())})
+    u = UnionOp([mem_scan(rb1), mem_scan(rb2)])
+    r = RenameColumnsOp(u, ["renamed"])
+    out = collect(r)
+    assert out.column("renamed").to_pylist() == [1, 2, 3]
+
+
+def test_coalesce_batches():
+    rbs = [pa.record_batch({"x": pa.array([i], pa.int64())}) for i in range(10)]
+    scan = MemoryScanOp([rbs], schema_from_arrow(rbs[0].schema), capacity=4)
+    out = collect(CoalesceBatchesOp(scan, 8))
+    assert out.column("x").to_pylist() == list(range(10))
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+def test_global_agg():
+    rb = pa.record_batch({
+        "x": pa.array([1, 2, None, 4], pa.int64()),
+        "f": pa.array([1.0, None, 3.0, 4.0], pa.float64()),
+    })
+    agg = AggOp(mem_scan(rb), [], [
+        ir.AggFunction("sum", C(0)),
+        ir.AggFunction("count", C(0)),
+        ir.AggFunction("count_star"),
+        ir.AggFunction("avg", C(1)),
+        ir.AggFunction("min", C(0)),
+        ir.AggFunction("max", C(1)),
+    ], mode="complete", agg_names=["s", "c", "cs", "a", "mn", "mx"])
+    out = collect(agg)
+    assert out.num_rows == 1
+    row = {k: v[0] for k, v in out.to_pydict().items()}
+    assert row == {"s": 7, "c": 3, "cs": 4, "a": pytest.approx(8.0 / 3),
+                   "mn": 1, "mx": 4.0}
+
+
+def test_grouped_agg_matches_arrow():
+    rng = np.random.default_rng(7)
+    n = 5000
+    keys = rng.integers(0, 100, n)
+    vals = rng.normal(size=n)
+    # inject nulls
+    key_arr = pa.array([int(k) if i % 17 else None for i, k in enumerate(keys)],
+                       pa.int64())
+    val_arr = pa.array([float(v) if i % 11 else None for i, v in enumerate(vals)],
+                       pa.float64())
+    rb = pa.record_batch({"k": key_arr, "v": val_arr})
+
+    # split into several batches
+    rbs = [rb.slice(o, 1000) for o in range(0, n, 1000)]
+    scan = MemoryScanOp([rbs], schema_from_arrow(rb.schema), capacity=1024)
+    agg = AggOp(scan, [C(0)], [
+        ir.AggFunction("sum", C(1)),
+        ir.AggFunction("count", C(1)),
+        ir.AggFunction("min", C(1)),
+        ir.AggFunction("max", C(1)),
+    ], mode="complete", group_names=["k"], agg_names=["s", "c", "mn", "mx"],
+        initial_capacity=64)
+    got = collect(agg).to_pandas().sort_values("k", na_position="first")
+
+    expected = (pa.table({"k": key_arr, "v": val_arr}).group_by("k")
+                .aggregate([("v", "sum"), ("v", "count"), ("v", "min"), ("v", "max")])
+                .to_pandas().sort_values("k", na_position="first"))
+
+    np.testing.assert_array_equal(got["k"].to_numpy(), expected["k"].to_numpy())
+    np.testing.assert_allclose(got["s"].to_numpy(), expected["v_sum"].to_numpy(),
+                               rtol=1e-9)
+    np.testing.assert_array_equal(got["c"].to_numpy(), expected["v_count"].to_numpy())
+    np.testing.assert_allclose(got["mn"].to_numpy(), expected["v_min"].to_numpy())
+    np.testing.assert_allclose(got["mx"].to_numpy(), expected["v_max"].to_numpy())
+
+
+def test_grouped_agg_string_keys():
+    rb = pa.record_batch({
+        "s": pa.array(["a", "bb", "a", None, "bb", "a", None], pa.string()),
+        "v": pa.array([1, 2, 3, 4, 5, 6, 7], pa.int64()),
+    })
+    agg = AggOp(mem_scan(rb, capacity=8), [C(0)],
+                [ir.AggFunction("sum", C(1))],
+                mode="complete", group_names=["s"], agg_names=["sum_v"],
+                initial_capacity=16)
+    got = {r["s"]: r["sum_v"] for r in collect(agg).to_pylist()}
+    assert got == {"a": 10, "bb": 7, None: 11}
+
+
+def test_partial_final_agg_roundtrip():
+    """partial on 2 'map tasks' → final merge (the shuffle-less version of
+    the two-phase agg the reference runs across stages)."""
+    rb1 = pa.record_batch({"k": pa.array([1, 2, 1], pa.int64()),
+                           "v": pa.array([10.0, 20.0, 30.0], pa.float64())})
+    rb2 = pa.record_batch({"k": pa.array([2, 3], pa.int64()),
+                           "v": pa.array([5.0, 7.0], pa.float64())})
+
+    partial1 = AggOp(mem_scan(rb1), [C(0)],
+                     [ir.AggFunction("sum", C(1)), ir.AggFunction("avg", C(1))],
+                     mode="partial", group_names=["k"], agg_names=["s", "a"],
+                     initial_capacity=16)
+    partial2 = AggOp(mem_scan(rb2), [C(0)],
+                     [ir.AggFunction("sum", C(1)), ir.AggFunction("avg", C(1))],
+                     mode="partial", group_names=["k"], agg_names=["s", "a"],
+                     initial_capacity=16)
+    t1 = collect(partial1)
+    t2 = collect(partial2)
+
+    merged = pa.concat_tables([t1, t2]).combine_chunks().to_batches()[0]
+    final = AggOp(mem_scan(merged, capacity=16), [C(0)],
+                  [ir.AggFunction("sum", None), ir.AggFunction("avg", None)],
+                  mode="final", group_names=["k"], agg_names=["s", "a"],
+                  initial_capacity=16)
+    got = {r["k"]: (r["s"], r["a"]) for r in collect(final).to_pylist()}
+    assert got[1] == (40.0, 20.0)
+    assert got[2] == (25.0, 12.5)
+    assert got[3] == (7.0, 7.0)
+
+
+def test_agg_capacity_growth():
+    """More groups than initial capacity → re-bucketing."""
+    n = 2000
+    rb = pa.record_batch({"k": pa.array(list(range(n)), pa.int64()),
+                          "v": pa.array([1] * n, pa.int64())})
+    agg = AggOp(mem_scan(rb, capacity=2048), [C(0)],
+                [ir.AggFunction("count", C(1))], mode="complete",
+                group_names=["k"], agg_names=["c"], initial_capacity=32)
+    out = collect(agg)
+    assert out.num_rows == n
+    assert set(out.column("c").to_pylist()) == {1}
+
+
+def test_parquet_scan(tmp_path):
+    import pyarrow.parquet as pq
+    t = pa.table({
+        "id": pa.array(range(1000), pa.int64()),
+        "name": pa.array([f"row{i}" for i in range(1000)], pa.string()),
+        "price": pa.array([i * 0.01 for i in range(1000)], pa.float64()),
+    })
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(t, path)
+    scan = ParquetScanOp([path], batch_rows=256)
+    filt = FilterOp(scan, [ir.BinaryExpr("<", C(0), L(10, DataType.INT64))])
+    out = collect(filt)
+    assert out.num_rows == 10
+    assert out.column("name").to_pylist() == [f"row{i}" for i in range(10)]
