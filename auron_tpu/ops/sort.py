@@ -1,0 +1,190 @@
+"""Sort operator.
+
+The reference's external sort is row-format blocks + loser-tree k-way merge
+with key prefixes (reference: datafusion-ext-plans/src/sort_exec.rs). On TPU
+the economics invert: one big device sort beats incremental merging, so the
+design is: buffer the (bounded) partition, normalize every sort key into
+order-preserving uint64 words, and run a chain of stable argsorts
+(least-significant key first) that XLA lowers to its parallel sort. Nulls
+first/last and asc/desc are encoded into the key words themselves:
+
+  int64     → x XOR sign-bit        (order-preserving unsigned map)
+  float     → IEEE trick: flip all bits if negative else flip sign bit
+  string    → big-endian byte words (zero padding already sorts prefixes first)
+  desc      → bitwise NOT of the word
+  null rank → one leading word per key: 0/1 by nulls_first
+
+Spill for over-HBM partitions hooks in at the buffer stage via the memory
+manager (sorted-run spill + host merge), added with the memmgr subsystem.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+from auron_tpu.columnar.batch import (DeviceBatch, PrimitiveColumn, StringColumn,
+                                      concat_columns, gather_batch)
+from auron_tpu.columnar.schema import DataType, Schema
+from auron_tpu.exprs import ir
+from auron_tpu.exprs.eval import EvalContext, evaluate
+from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
+from auron_tpu.utils.shapes import bucket_rows
+
+
+def order_words(col, ascending: bool, nulls_first: bool) -> list[jax.Array]:
+    """Normalize one sort key column into order-preserving uint64 words,
+    most significant first (excluding the null-rank word, which the caller
+    gets separately)."""
+    words: list[jax.Array] = []
+    if isinstance(col, StringColumn):
+        chars = col.chars
+        n, w = chars.shape
+        pad = (-w) % 8
+        if pad:
+            chars = jnp.pad(chars, ((0, 0), (0, pad)))
+        u = chars.astype(jnp.uint64).reshape(n, -1, 8)
+        shifts = jnp.asarray([56, 48, 40, 32, 24, 16, 8, 0], jnp.uint64)
+        be = jnp.sum(u << shifts[None, None, :], axis=2)
+        words.extend(be[:, i] for i in range(be.shape[1]))
+    else:
+        d = col.data
+        if d.dtype == jnp.bool_:
+            u = d.astype(jnp.uint64)
+        elif jnp.issubdtype(d.dtype, jnp.signedinteger):
+            u = d.astype(jnp.int64).astype(jnp.uint64) ^ jnp.uint64(1 << 63)
+        elif d.dtype == jnp.dtype(jnp.float32):
+            b = d.view(jnp.int32).astype(jnp.int64).astype(jnp.uint64) \
+                & jnp.uint64(0xFFFFFFFF)
+            sign = (b >> 31) & 1
+            u = jnp.where(sign == 1, (~b) & jnp.uint64(0xFFFFFFFF),
+                          b | jnp.uint64(0x80000000))
+        elif d.dtype == jnp.dtype(jnp.float64):
+            from jax import lax
+            pair = lax.bitcast_convert_type(d, jnp.uint32)
+            b = pair[..., 0].astype(jnp.uint64) | (pair[..., 1].astype(jnp.uint64) << 32)
+            sign = (b >> 63) & 1
+            u = jnp.where(sign == 1, ~b, b | jnp.uint64(1 << 63))
+        else:
+            u = d.astype(jnp.uint64)
+        words.append(u)
+    if not ascending:
+        words = [~w for w in words]
+    return words
+
+
+def sort_permutation(batch: DeviceBatch, key_cols, orders) -> jax.Array:
+    """Stable multi-key sort permutation. orders: list[(ascending,
+    nulls_first)] aligned with key_cols. Padding rows sort to the end."""
+    cap = batch.capacity
+    live = batch.row_mask()
+    all_words: list[jax.Array] = []
+    for col, (asc, nf) in zip(key_cols, orders):
+        null_word = jnp.where(col.validity,
+                              jnp.uint64(1 if nf else 0),
+                              jnp.uint64(0 if nf else 1))
+        words = order_words(col, asc, nf)
+        # null rows: neutralize value words so they compare equal
+        words = [jnp.where(col.validity, w, 0) for w in words]
+        all_words.append(null_word)
+        all_words.extend(words)
+    # dead rows to the very end: leading liveness word
+    lead = jnp.where(live, jnp.uint64(0), jnp.uint64(1))
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    for w in reversed(all_words):
+        perm = perm[jnp.argsort(w[perm], stable=True)]
+    perm = perm[jnp.argsort(lead[perm], stable=True)]
+    return perm
+
+
+@lru_cache(maxsize=256)
+def _sort_kernel(sort_exprs: tuple, in_schema: Schema, capacity: int):
+    @jax.jit
+    def kernel(batch: DeviceBatch):
+        ctx = EvalContext()
+        key_cols = [evaluate(s.expr, batch, in_schema, ctx).col
+                    for s in sort_exprs]
+        orders = [(s.ascending, s.nulls_first) for s in sort_exprs]
+        perm = sort_permutation(batch, key_cols, orders)
+        return gather_batch(batch, perm, batch.num_rows)
+
+    return kernel
+
+
+def _concat_all(batches: list[DeviceBatch]) -> DeviceBatch:
+    """Concatenate buffered batches into one capacity-bucketed batch."""
+    total_cap = bucket_rows(sum(b.capacity for b in batches))
+    cols = []
+    ncols = batches[0].num_columns
+    for i in range(ncols):
+        col = batches[0].columns[i]
+        # unify string widths
+        if isinstance(col, StringColumn):
+            width = max(b.columns[i].width for b in batches)
+            parts = []
+            for b in batches:
+                c = b.columns[i]
+                if c.width < width:
+                    c = StringColumn(
+                        jnp.pad(c.chars, ((0, 0), (0, width - c.width))),
+                        c.lens, c.validity)
+                parts.append(c)
+            merged = parts[0]
+            for p in parts[1:]:
+                merged = concat_columns(merged, p)
+        else:
+            merged = col
+            for b in batches[1:]:
+                merged = concat_columns(merged, b.columns[i])
+        cols.append(merged)
+    stacked_cap = sum(b.capacity for b in batches)
+    from auron_tpu.columnar.batch import compact, resize
+    live = jnp.concatenate([b.row_mask() for b in batches])
+    num = sum(int(b.num_rows) for b in batches)
+    stacked = DeviceBatch(tuple(cols), jnp.asarray(stacked_cap, jnp.int32))
+    compacted = compact(stacked, live)
+    out = resize(compacted, total_cap) if total_cap >= stacked_cap else compacted
+    return DeviceBatch(out.columns, jnp.asarray(num, jnp.int32))
+
+
+class SortOp(PhysicalOp):
+    name = "sort"
+
+    def __init__(self, child: PhysicalOp, sort_exprs: list[ir.SortOrder],
+                 fetch: Optional[int] = None):
+        self.child = child
+        self.sort_exprs = tuple(sort_exprs)
+        self.fetch = fetch
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def schema(self) -> Schema:
+        return self.child.schema()
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        metrics = ctx.metrics_for(self.name)
+        elapsed = metrics.counter("elapsed_compute")
+        in_schema = self.child.schema()
+
+        def stream():
+            batches = list(self.child.execute(partition, ctx))
+            if not batches:
+                return
+            with timer(elapsed):
+                merged = _concat_all(batches) if len(batches) > 1 else batches[0]
+                kern = _sort_kernel(self.sort_exprs, in_schema, merged.capacity)
+                out = kern(merged)
+            if self.fetch is not None:
+                n = jnp.minimum(out.num_rows, self.fetch)
+                out = DeviceBatch(out.columns, jnp.asarray(n, jnp.int32))
+            yield out
+
+        return count_output(stream(), metrics)
+
+    def __repr__(self):
+        return f"SortOp[{len(self.sort_exprs)} keys, fetch={self.fetch}]"

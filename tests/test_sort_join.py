@@ -1,0 +1,179 @@
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.exprs import ir
+from auron_tpu.io.parquet import MemoryScanOp
+from auron_tpu.ops.joins import HashJoinOp, SortMergeJoinOp
+from auron_tpu.ops.sort import SortOp
+from auron_tpu.runtime.executor import collect
+
+C = ir.ColumnRef
+
+
+def mem_scan(rb, capacity=64):
+    return MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=capacity)
+
+
+def test_sort_multi_key_with_nulls():
+    rb = pa.record_batch({
+        "a": pa.array([3, 1, None, 1, 2, None], pa.int64()),
+        "b": pa.array([1.0, 5.0, 2.0, None, 3.0, 1.0], pa.float64()),
+    })
+    op = SortOp(mem_scan(rb, capacity=8), [
+        ir.SortOrder(C(0), ascending=True, nulls_first=True),
+        ir.SortOrder(C(1), ascending=False, nulls_first=False),
+    ])
+    out = collect(op)
+    assert out.column("a").to_pylist() == [None, None, 1, 1, 2, 3]
+    assert out.column("b").to_pylist() == [2.0, 1.0, 5.0, None, 3.0, 1.0]
+
+
+def test_sort_strings_desc():
+    rb = pa.record_batch({"s": pa.array(["b", "abc", None, "ab", "c"], pa.string())})
+    out = collect(SortOp(mem_scan(rb, capacity=8),
+                         [ir.SortOrder(C(0), ascending=False, nulls_first=False)]))
+    assert out.column("s").to_pylist() == ["c", "b", "abc", "ab", None]
+
+
+def test_sort_random_differential():
+    rng = np.random.default_rng(11)
+    n = 3000
+    rb = pa.record_batch({
+        "k": pa.array(rng.integers(0, 50, n), pa.int64()),
+        "v": pa.array(rng.normal(size=n), pa.float64()),
+    })
+    # multi-batch input
+    rbs = [rb.slice(o, 500) for o in range(0, n, 500)]
+    scan = MemoryScanOp([rbs], schema_from_arrow(rb.schema), capacity=512)
+    out = collect(SortOp(scan, [ir.SortOrder(C(0)), ir.SortOrder(C(1))]))
+    df = rb.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    got = out.to_pandas()
+    np.testing.assert_array_equal(got["k"], df["k"])
+    np.testing.assert_allclose(got["v"], df["v"])
+
+
+def test_sort_fetch():
+    rb = pa.record_batch({"x": pa.array([5, 3, 8, 1, 9], pa.int64())})
+    out = collect(SortOp(mem_scan(rb, capacity=8), [ir.SortOrder(C(0))], fetch=3))
+    assert out.column("x").to_pylist() == [1, 3, 5]
+
+
+# ---------------------------------------------------------------------------
+# joins
+# ---------------------------------------------------------------------------
+
+def _join_case(join_type, expected_rows):
+    left = pa.record_batch({
+        "lk": pa.array([1, 2, 3, None, 2], pa.int64()),
+        "lv": pa.array(["a", "b", "c", "d", "e"], pa.string()),
+    })
+    right = pa.record_batch({
+        "rk": pa.array([2, 2, 4, None], pa.int64()),
+        "rv": pa.array([20, 21, 40, 99], pa.int64()),
+    })
+    op = HashJoinOp(mem_scan(left, capacity=8), mem_scan(right, capacity=8),
+                    [C(0)], [C(0)], join_type=join_type)
+    out = collect(op)
+    rows = set()
+    for r in out.to_pylist():
+        rows.add(tuple(r.values()))
+    assert rows == expected_rows, f"{join_type}: {rows}"
+
+
+def test_inner_join():
+    _join_case("inner", {
+        (2, "b", 2, 20), (2, "b", 2, 21), (2, "e", 2, 20), (2, "e", 2, 21),
+    })
+
+
+def test_left_join():
+    _join_case("left", {
+        (1, "a", None, None), (2, "b", 2, 20), (2, "b", 2, 21),
+        (3, "c", None, None), (None, "d", None, None),
+        (2, "e", 2, 20), (2, "e", 2, 21),
+    })
+
+
+def test_right_join():
+    _join_case("right", {
+        (2, "b", 2, 20), (2, "b", 2, 21), (2, "e", 2, 20), (2, "e", 2, 21),
+        (None, None, 4, 40), (None, None, None, 99),
+    })
+
+
+def test_full_join():
+    _join_case("full", {
+        (1, "a", None, None), (2, "b", 2, 20), (2, "b", 2, 21),
+        (3, "c", None, None), (None, "d", None, None),
+        (2, "e", 2, 20), (2, "e", 2, 21),
+        (None, None, 4, 40), (None, None, None, 99),
+    })
+
+
+def test_semi_join():
+    _join_case("semi", {(2, "b"), (2, "e")})
+
+
+def test_anti_join():
+    _join_case("anti", {(1, "a"), (3, "c"), (None, "d")})
+
+
+def test_existence_join():
+    _join_case("existence", {
+        (1, "a", False), (2, "b", True), (3, "c", False),
+        (None, "d", False), (2, "e", True),
+    })
+
+
+def test_join_string_keys():
+    left = pa.record_batch({"k": pa.array(["x", "y", "z"], pa.string()),
+                            "v": pa.array([1, 2, 3], pa.int64())})
+    right = pa.record_batch({"rk": pa.array(["y", "z", "w"], pa.string()),
+                             "u": pa.array([20, 30, 40], pa.int64())})
+    op = HashJoinOp(mem_scan(left, capacity=4), mem_scan(right, capacity=4),
+                    [C(0)], [C(0)], join_type="inner")
+    out = collect(op)
+    rows = {tuple(r.values()) for r in out.to_pylist()}
+    assert rows == {("y", 2, "y", 20), ("z", 3, "z", 30)}
+
+
+def test_join_random_differential():
+    rng = np.random.default_rng(13)
+    nl, nr = 2000, 1500
+    left = pa.table({
+        "k": pa.array(rng.integers(0, 200, nl), pa.int64()),
+        "lv": pa.array(rng.integers(0, 10**6, nl), pa.int64()),
+    })
+    right = pa.table({
+        "k": pa.array(rng.integers(0, 200, nr), pa.int64()),
+        "rv": pa.array(rng.integers(0, 10**6, nr), pa.int64()),
+    })
+    lb = left.to_batches()[0]
+    rb = right.to_batches()[0]
+    op = HashJoinOp(mem_scan(lb, capacity=2048), mem_scan(rb, capacity=2048),
+                    [C(0)], [C(0)], join_type="inner")
+    got = collect(op).to_pandas().rename(columns={"k": "lk"})
+    got.columns = ["lk", "lv", "rk", "rv"]
+
+    expected = left.to_pandas().merge(right.to_pandas(), on="k", how="inner")
+    assert len(got) == len(expected)
+    gs = got.sort_values(["lk", "lv", "rv"]).reset_index(drop=True)
+    es = expected.sort_values(["k", "lv", "rv"]).reset_index(drop=True)
+    np.testing.assert_array_equal(gs["lk"], es["k"])
+    np.testing.assert_array_equal(gs["lv"], es["lv"])
+    np.testing.assert_array_equal(gs["rv"], es["rv"])
+
+
+def test_smj_same_results():
+    left = pa.record_batch({"k": pa.array([1, 2, 2, 3], pa.int64()),
+                            "lv": pa.array([1, 2, 3, 4], pa.int64())})
+    right = pa.record_batch({"rk": pa.array([2, 3, 3], pa.int64()),
+                             "rv": pa.array([10, 20, 30], pa.int64())})
+    op = SortMergeJoinOp(mem_scan(left, capacity=4), mem_scan(right, capacity=4),
+                         [C(0)], [C(0)], join_type="inner")
+    rows = {tuple(r.values()) for r in collect(op).to_pylist()}
+    assert rows == {(2, 2, 2, 10), (2, 3, 2, 10), (3, 4, 3, 20), (3, 4, 3, 30)}
