@@ -1,0 +1,173 @@
+"""Stage exchange (shuffle + broadcast).
+
+The reference's exchange is file-based: BufferedData staging → per-partition
+compaction → one spill file + offset index, fetched through Spark's block
+store (reference: datafusion-ext-plans/src/shuffle/buffered_data.rs:48-225,
+sort_repartitioner.rs:44-254; SURVEY.md §3.3). On TPU the design target is
+HBM-granularity exchange: rows are bucketed to target partitions on device
+(one compaction kernel per partition), stay device-resident in local mode,
+and ride ICI all-to-all when the stage runs SPMD over a mesh
+(auron_tpu.parallel.mesh_exchange). A host spill path (serialize + compress)
+covers datasets beyond HBM — that is the RSS-analogue tier.
+
+ShuffleExchangeOp is a stage boundary: the upstream subtree runs once per
+*input* partition (all materialized on first demand, memoized), downstream
+partitions then stream their buckets.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import lru_cache
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+from auron_tpu.columnar.batch import DeviceBatch, compact
+from auron_tpu.columnar.schema import Schema
+from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
+from auron_tpu.parallel.partitioning import (HashPartitioning,
+                                             RangePartitioning,
+                                             RoundRobinPartitioning,
+                                             SinglePartitioning)
+
+
+@lru_cache(maxsize=256)
+def _split_kernel(num_partitions: int, capacity: int):
+    """One launch computes all partition buckets: for each target p, compact
+    rows with pid==p to the front (shared sort, N gathers)."""
+
+    @jax.jit
+    def kernel(batch: DeviceBatch, pids):
+        live = batch.row_mask()
+        outs = []
+        for p in range(num_partitions):
+            keep = live & (pids == p)
+            outs.append(compact(batch, keep))
+        return tuple(outs)
+
+    return kernel
+
+
+class ShuffleExchangeOp(PhysicalOp):
+    name = "shuffle_exchange"
+
+    def __init__(self, child: PhysicalOp, partitioning,
+                 input_partitions: int = 1):
+        self.child = child
+        self.partitioning = partitioning
+        self.input_partitions = input_partitions
+        self._lock = threading.Lock()
+        self._buckets: Optional[list[list[DeviceBatch]]] = None
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def schema(self) -> Schema:
+        return self.child.schema()
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partitioning.num_partitions
+
+    def _materialize(self, ctx: ExecContext):
+        """Run all map tasks, splitting every batch into output buckets."""
+        metrics = ctx.metrics_for(self.name)
+        write_time = metrics.counter("shuffle_write_total_time")
+        n_out = self.num_partitions
+        schema = self.child.schema()
+        partitioning = self._resolve_partitioning(ctx, schema)
+
+        buckets: list[list[DeviceBatch]] = [[] for _ in range(n_out)]
+        for in_p in range(self.input_partitions):
+            map_ctx = ExecContext(
+                stage_id=ctx.stage_id, partition_id=in_p,
+                num_partitions=self.input_partitions,
+                metrics=ctx.metrics, mem_manager=ctx.mem_manager)
+            row_offset = 0
+            for batch in self.child.execute(in_p, map_ctx):
+                with timer(write_time):
+                    if isinstance(partitioning, RoundRobinPartitioning):
+                        part = RoundRobinPartitioning(n_out, row_offset)
+                        pids = part.partition_ids(batch, schema)
+                    else:
+                        pids = partitioning.partition_ids(batch, schema)
+                    kern = _split_kernel(n_out, batch.capacity)
+                    outs = kern(batch, pids)
+                row_offset += int(batch.num_rows)
+                for p, out in enumerate(outs):
+                    if int(out.num_rows) > 0:
+                        buckets[p].append(out)
+        return buckets
+
+    def _resolve_partitioning(self, ctx, schema):
+        """Range partitioning needs bounds sampled from the input — resolve
+        lazily, caching bounds on the op."""
+        p = self.partitioning
+        if isinstance(p, RangePartitioning) and not p.bounds:
+            from auron_tpu.parallel.partitioning import compute_range_bounds
+            samples = []
+            sample_rows = 0
+            for in_p in range(self.input_partitions):
+                map_ctx = ExecContext(partition_id=in_p,
+                                      num_partitions=self.input_partitions)
+                for batch in self.child.execute(in_p, map_ctx):
+                    samples.append(batch)
+                    sample_rows += int(batch.num_rows)
+                    if sample_rows >= 10000:
+                        break
+                if sample_rows >= 10000:
+                    break
+            bounds = compute_range_bounds(samples, list(p.sort_orders), schema,
+                                          p.num_partitions)
+            p = RangePartitioning(p.sort_orders, p.num_partitions, bounds)
+            self.partitioning = p
+        return p
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        with self._lock:
+            if self._buckets is None:
+                self._buckets = self._materialize(ctx)
+        metrics = ctx.metrics_for(self.name + "_read")
+        return count_output(iter(self._buckets[partition]), metrics)
+
+    def __repr__(self):
+        return (f"ShuffleExchangeOp[{type(self.partitioning).__name__} "
+                f"{self.input_partitions}->{self.num_partitions}]")
+
+
+class BroadcastExchangeOp(PhysicalOp):
+    """Collect the child once, replay to every consumer partition
+    (reference: NativeBroadcastExchangeBase collect→IPC→re-expose,
+    SURVEY.md §3.4). Device batches are naturally shared on a single host;
+    in SPMD execution the same batch is replicated into every shard."""
+
+    name = "broadcast_exchange"
+
+    def __init__(self, child: PhysicalOp, input_partitions: int = 1):
+        self.child = child
+        self.input_partitions = input_partitions
+        self._lock = threading.Lock()
+        self._collected: Optional[list[DeviceBatch]] = None
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def schema(self) -> Schema:
+        return self.child.schema()
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        with self._lock:
+            if self._collected is None:
+                out = []
+                for in_p in range(self.input_partitions):
+                    map_ctx = ExecContext(
+                        partition_id=in_p, num_partitions=self.input_partitions,
+                        metrics=ctx.metrics, mem_manager=ctx.mem_manager)
+                    out.extend(self.child.execute(in_p, map_ctx))
+                self._collected = out
+        metrics = ctx.metrics_for(self.name)
+        return count_output(iter(self._collected), metrics)

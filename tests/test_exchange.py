@@ -1,0 +1,193 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.exprs import ir
+from auron_tpu.io.parquet import MemoryScanOp
+from auron_tpu.ops.agg import AggOp
+from auron_tpu.ops.sort import SortOp
+from auron_tpu.parallel.exchange import BroadcastExchangeOp, ShuffleExchangeOp
+from auron_tpu.parallel.partitioning import (HashPartitioning,
+                                             RangePartitioning,
+                                             RoundRobinPartitioning,
+                                             SinglePartitioning)
+from auron_tpu.runtime.executor import collect
+from tests.reference_impls import murmur3_long
+
+C = ir.ColumnRef
+
+
+def test_hash_partition_ids_match_spark():
+    """pmod(murmur3(key, 42), n) — parity with the reference shuffle
+    (shuffle/mod.rs:163-188)."""
+    from auron_tpu.columnar.arrow_bridge import to_device
+    rb = pa.record_batch({"k": pa.array([1, 2, 3, 100, -5], pa.int64())})
+    batch, schema = to_device(rb, capacity=8)
+    p = HashPartitioning((C(0),), 4)
+    pids = np.asarray(p.partition_ids(batch, schema))[:5]
+    expected = [((murmur3_long(k, 42) % 4) + 4) % 4 for k in [1, 2, 3, 100, -5]]
+    assert pids.tolist() == expected
+
+
+def test_shuffle_exchange_hash_repartition():
+    n = 1000
+    rb = pa.record_batch({
+        "k": pa.array([i % 37 for i in range(n)], pa.int64()),
+        "v": pa.array(list(range(n)), pa.int64()),
+    })
+    rbs = [rb.slice(o, 250) for o in range(0, n, 250)]
+    # two map partitions, each with 2 batches
+    scan = MemoryScanOp([rbs[:2], rbs[2:]], schema_from_arrow(rb.schema),
+                        capacity=256)
+    ex = ShuffleExchangeOp(scan, HashPartitioning((C(0),), 4),
+                           input_partitions=2)
+    # union of all output partitions == input; same key → same partition
+    out = collect(ex, num_partitions=4)
+    assert out.num_rows == n
+    assert sorted(out.column("v").to_pylist()) == list(range(n))
+    # verify co-location: each key appears in exactly one partition
+    seen = {}
+    for p in range(4):
+        t = collect_partition(ex, p)
+        for k in set(t.column("k").to_pylist()):
+            assert seen.setdefault(k, p) == p
+
+
+def collect_partition(op, p):
+    from auron_tpu.runtime.executor import ExecutionRuntime, TaskDefinition
+    return ExecutionRuntime(op, TaskDefinition(partition_id=p,
+                                               num_partitions=op.num_partitions)).collect()
+
+
+def test_round_robin_balance():
+    n = 100
+    rb = pa.record_batch({"v": pa.array(list(range(n)), pa.int64())})
+    scan = MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=128)
+    ex = ShuffleExchangeOp(scan, RoundRobinPartitioning(4), input_partitions=1)
+    sizes = [collect_partition(ex, p).num_rows for p in range(4)]
+    assert sizes == [25, 25, 25, 25]
+
+
+def test_range_partition_global_sort():
+    """Range exchange + per-partition sort == global sort (the reference's
+    global-sort pattern, SURVEY.md §2.3)."""
+    rng = np.random.default_rng(3)
+    vals = rng.integers(-1000, 1000, 500)
+    rb = pa.record_batch({"x": pa.array(vals, pa.int64())})
+    scan = MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=512)
+    orders = (ir.SortOrder(C(0)),)
+    ex = ShuffleExchangeOp(scan, RangePartitioning(orders, 4, ()),
+                           input_partitions=1)
+    srt = SortOp(ex, list(orders))
+    pieces = [collect_partition_sorted(srt, ex, p) for p in range(4)]
+    flat = [x for piece in pieces for x in piece]
+    assert flat == sorted(vals.tolist())
+
+
+def collect_partition_sorted(srt, ex, p):
+    from auron_tpu.runtime.executor import ExecutionRuntime, TaskDefinition
+    t = ExecutionRuntime(srt, TaskDefinition(partition_id=p,
+                                             num_partitions=4)).collect()
+    return t.column("x").to_pylist()
+
+
+def test_two_phase_agg_over_exchange():
+    """partial agg → hash exchange on keys → final agg; the canonical
+    distributed agg plan (SURVEY.md §3.3)."""
+    n = 2000
+    rb = pa.record_batch({
+        "k": pa.array([i % 53 for i in range(n)], pa.int64()),
+        "v": pa.array([float(i) for i in range(n)], pa.float64()),
+    })
+    rbs = [rb.slice(o, 500) for o in range(0, n, 500)]
+    scan = MemoryScanOp([rbs[:2], rbs[2:]], schema_from_arrow(rb.schema),
+                        capacity=512)
+    partial = AggOp(scan, [C(0)], [ir.AggFunction("sum", C(1)),
+                                   ir.AggFunction("count", C(1))],
+                    mode="partial", group_names=["k"], agg_names=["s", "c"],
+                    initial_capacity=64)
+    ex = ShuffleExchangeOp(partial, HashPartitioning((C(0),), 4),
+                           input_partitions=2)
+    final = AggOp(ex, [C(0)], [ir.AggFunction("sum", None),
+                               ir.AggFunction("count", None)],
+                  mode="final", group_names=["k"], agg_names=["s", "c"],
+                  initial_capacity=64)
+    out = collect(final, num_partitions=4)
+    assert out.num_rows == 53
+    got = {r["k"]: (r["s"], r["c"]) for r in out.to_pylist()}
+    import pandas as pd
+    df = rb.to_pandas().groupby("k")["v"].agg(["sum", "count"])
+    for k, row in df.iterrows():
+        assert got[k][0] == pytest.approx(row["sum"])
+        assert got[k][1] == row["count"]
+
+
+def test_broadcast_exchange():
+    rb = pa.record_batch({"x": pa.array([1, 2, 3], pa.int64())})
+    scan = MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=4)
+    bc = BroadcastExchangeOp(scan, input_partitions=1)
+    # every consumer partition sees the full data
+    for p in range(3):
+        assert collect_partition_generic(bc, p, 3).column("x").to_pylist() == [1, 2, 3]
+
+
+def collect_partition_generic(op, p, n):
+    from auron_tpu.runtime.executor import ExecutionRuntime, TaskDefinition
+    return ExecutionRuntime(op, TaskDefinition(partition_id=p,
+                                               num_partitions=n)).collect()
+
+
+# ---------------------------------------------------------------------------
+# mesh all-to-all
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_mesh_all_to_all_exchange():
+    from auron_tpu.parallel.mesh_exchange import (exchange_device_batches,
+                                                  make_mesh)
+    mesh = make_mesh(8)
+    n_dev, cap = 8, 128
+    rng = np.random.default_rng(5)
+    vals = rng.integers(0, 10**6, n_dev * cap).astype(np.int64)
+    pids = (vals % n_dev).astype(np.int32)
+    num_rows = np.full(n_dev, cap, np.int32)  # all rows live
+
+    out_cols, out_nr, quota = exchange_device_batches(
+        mesh, (jnp.asarray(vals),), jnp.asarray(pids), jnp.asarray(num_rows))
+    out_vals = np.asarray(out_cols[0])
+    out_nr = np.asarray(out_nr)
+
+    # every row lands on the device matching its pid
+    local_cap = out_vals.shape[0] // n_dev
+    got_all = []
+    for d in range(n_dev):
+        local = out_vals[d * local_cap: d * local_cap + out_nr[d]]
+        assert np.all(local % n_dev == d)
+        got_all.extend(local.tolist())
+    assert sorted(got_all) == sorted(vals.tolist())
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_mesh_exchange_overflow_retry():
+    from auron_tpu.parallel.mesh_exchange import (exchange_device_batches,
+                                                  make_mesh)
+    mesh = make_mesh(8)
+    n_dev, cap = 8, 64
+    # fully skewed: every row targets partition 0 → guaranteed overflow at
+    # the initial quota, exercising the doubling path
+    vals = np.arange(n_dev * cap, dtype=np.int64)
+    pids = np.zeros(n_dev * cap, np.int32)
+    num_rows = np.full(n_dev, cap, np.int32)
+    out_cols, out_nr, quota = exchange_device_batches(
+        mesh, (jnp.asarray(vals),), jnp.asarray(pids), jnp.asarray(num_rows))
+    out_nr = np.asarray(out_nr)
+    assert out_nr[0] == n_dev * cap
+    assert out_nr[1:].sum() == 0
+    local_cap = np.asarray(out_cols[0]).shape[0] // n_dev
+    got = np.asarray(out_cols[0])[:out_nr[0]]
+    assert sorted(got.tolist()) == vals.tolist()
