@@ -101,7 +101,7 @@ def _exchange_fn(mesh: Mesh, n_cols: int, quota: int, axis: str):
     out_specs = (tuple(P(axis) for _ in range(n_cols)), P(axis), P(axis))
 
     return jax.jit(shard_map(local_fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_rep=False))
+                             out_specs=out_specs))
 
 
 def mesh_all_to_all(mesh: Mesh, cols: tuple, pids, num_rows, quota: int,
