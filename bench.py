@@ -1,0 +1,102 @@
+"""Headline bench: rows/sec/chip on the fused q01-class pipeline.
+
+Runs the flagship kernel (filter → hash-group → segment aggregate, see
+__graft_entry__._q01_kernel) on the available accelerator and compares
+against a single-threaded host (pyarrow) implementation of the same query —
+the "single-partition CPU reference" of BASELINE.md.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+import jax
+
+import __graft_entry__ as graft
+from auron_tpu.columnar.batch import DeviceBatch, PrimitiveColumn
+import jax.numpy as jnp
+
+CAPACITY = 1 << 20          # 1M rows per batch
+ITERS = 20
+WARMUP = 3
+
+
+def make_batch(seed: int) -> tuple[DeviceBatch, dict]:
+    rng = np.random.default_rng(seed)
+    n = CAPACITY
+    k = rng.integers(0, 65536, size=n).astype(np.int64)
+    v = rng.normal(size=n)
+    f = rng.integers(0, 40, size=n).astype(np.int32)
+    v_valid = rng.random(n) > 0.05
+    host = {"k": k, "v": v, "f": f, "v_valid": v_valid}
+    batch = DeviceBatch(
+        columns=(
+            PrimitiveColumn(jnp.asarray(k), jnp.ones(n, jnp.bool_)),
+            PrimitiveColumn(jnp.asarray(v), jnp.asarray(v_valid)),
+            PrimitiveColumn(jnp.asarray(f), jnp.ones(n, jnp.bool_)),
+        ),
+        num_rows=jnp.asarray(n, jnp.int32),
+    )
+    return batch, host
+
+
+def bench_device() -> float:
+    fn = jax.jit(graft._q01_kernel)
+    batch, _ = make_batch(0)
+    for _ in range(WARMUP):
+        jax.block_until_ready(fn(batch))
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(batch)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return CAPACITY * ITERS / dt
+
+
+def bench_cpu_reference() -> float:
+    """Same query via pyarrow (vectorized C++ single-thread class baseline)."""
+    _, host = make_batch(0)
+    tbl = pa.table({
+        "k": host["k"],
+        "v": pa.array(host["v"], mask=~host["v_valid"]),
+        "f": host["f"],
+    })
+    iters = max(1, ITERS // 4)
+
+    def run_once():
+        filt = tbl.filter(pc.and_(pc.greater(tbl["f"], 10),
+                                  pc.is_valid(tbl["v"])))
+        return filt.group_by("k").aggregate(
+            [("v", "sum"), ("v", "count"), ("v", "mean")])
+
+    run_once()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_once()
+    dt = time.perf_counter() - t0
+    return CAPACITY * iters / dt
+
+
+def main() -> None:
+    dev_rps = bench_device()
+    cpu_rps = bench_cpu_reference()
+    result = {
+        "metric": "q01_pipeline_rows_per_sec_per_chip",
+        "value": round(dev_rps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(dev_rps / cpu_rps, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
