@@ -1,0 +1,56 @@
+"""Host UDF / UDAF / UDTF registry.
+
+Serialized plans carry only a registry name (ir/auron.proto HostUDFE) — the
+callable is resolved host-side at plan-parse time. This mirrors the
+reference's design where the serialized Spark expression travels in the proto
+and the JVM materializes the evaluator on first use (reference:
+datafusion-ext-exprs/src/spark_udf_wrapper.rs:43-97), minus the code
+shipping: in a multi-host deployment every host registers the same UDFs at
+startup.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from auron_tpu.columnar.schema import DataType
+
+_UDFS: dict[str, tuple[Callable, DataType, int, int]] = {}
+_UDTFS: dict[str, Any] = {}
+_UDAFS: dict[str, Any] = {}
+
+
+def register_udf(name: str, fn: Callable, dtype: DataType,
+                 precision: int = 0, scale: int = 0) -> None:
+    """fn: list[pyarrow.Array] -> pyarrow.Array (vectorized over the batch)."""
+    _UDFS[name] = (fn, dtype, precision, scale)
+
+
+def lookup_udf(name: str) -> tuple[Callable, DataType, int, int]:
+    if name not in _UDFS:
+        raise KeyError(f"host UDF '{name}' is not registered on this host")
+    return _UDFS[name]
+
+
+def register_udtf(name: str, fn: Any) -> None:
+    """fn: row tuple -> iterable of output row tuples (generator fallback,
+    reference: generate/spark_udtf_wrapper.rs)."""
+    _UDTFS[name] = fn
+
+
+def lookup_udtf(name: str) -> Any:
+    if name not in _UDTFS:
+        raise KeyError(f"host UDTF '{name}' is not registered on this host")
+    return _UDTFS[name]
+
+
+def register_udaf(name: str, udaf: Any) -> None:
+    """udaf: object with zero()/update(buf, row)/merge(a, b)/eval(buf)
+    (reference: SparkUDAFWrapperContext.scala:100-235)."""
+    _UDAFS[name] = udaf
+
+
+def lookup_udaf(name: str) -> Any:
+    if name not in _UDAFS:
+        raise KeyError(f"host UDAF '{name}' is not registered on this host")
+    return _UDAFS[name]

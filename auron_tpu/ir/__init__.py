@@ -1,0 +1,14 @@
+"""Plan IR: the protobuf host↔engine contract + physical planner.
+
+auron.proto is the source of truth; auron_pb2.py is generated with
+``protoc --python_out=. auron.proto`` (protoc 3.21+) and checked in so the
+engine has no build-time protoc dependency.
+"""
+
+from auron_tpu.ir import auron_pb2 as pb  # noqa: F401
+from auron_tpu.ir.planner import (PhysicalPlanner, PlannerContext,  # noqa: F401
+                                  plan_from_bytes)
+from auron_tpu.ir.serde import (agg_to_proto, expr_to_proto,  # noqa: F401
+                                parse_agg, parse_expr, parse_schema,
+                                parse_sort_order, schema_to_proto,
+                                sort_order_to_proto)

@@ -1,0 +1,304 @@
+"""Physical planner: proto PlanNode → PhysicalOp tree.
+
+The engine-side half of the contract — the analogue of the reference's
+``PhysicalPlanner::create_plan`` (reference:
+native-engine/auron-planner/src/planner.rs:121-856), recursively
+materializing executable operators from the IR. Scans resolve named tables
+through a catalog; exchange/broadcast nodes resolve cross-stage data through
+a resource map (the analogue of JniBridge.putResource/getResource,
+reference: auron-core/src/main/java/org/apache/auron/jni/JniBridge.java).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import pyarrow as pa
+
+from auron_tpu.columnar.schema import Schema
+from auron_tpu.ir import auron_pb2 as pb
+from auron_tpu.ir import serde
+from auron_tpu.ops.base import PhysicalOp
+
+
+@dataclass
+class PlannerContext:
+    """Host-side environment plans resolve against.
+
+    catalog: table name → pyarrow.Table (or list of per-partition
+      RecordBatch lists) for MemoryScanNode.
+    resources: id → engine object (bucketed shuffle output, broadcast
+      batches, bloom filters...) for IpcReader/BroadcastJoin nodes.
+    """
+
+    catalog: dict[str, Any] = field(default_factory=dict)
+    resources: dict[str, Any] = field(default_factory=dict)
+    batch_capacity: int = 1 << 16
+
+    def put_resource(self, rid: str, value: Any) -> None:
+        self.resources[rid] = value
+
+    def get_resource(self, rid: str) -> Any:
+        if rid not in self.resources:
+            raise KeyError(f"unknown resource id {rid!r}")
+        return self.resources[rid]
+
+
+class PhysicalPlanner:
+    def __init__(self, ctx: Optional[PlannerContext] = None):
+        self.ctx = ctx or PlannerContext()
+
+    # -- entry points -------------------------------------------------------
+
+    def plan_task(self, task: pb.TaskDefinition) -> PhysicalOp:
+        return self.create_plan(task.plan)
+
+    def create_plan(self, node: pb.PlanNode) -> PhysicalOp:
+        kind = node.WhichOneof("node")
+        if kind is None:
+            raise ValueError("empty PlanNode")
+        handler = getattr(self, f"_plan_{kind}", None)
+        if handler is None:
+            raise NotImplementedError(f"plan node {kind!r} not supported yet")
+        return handler(getattr(node, kind))
+
+    # -- sources ------------------------------------------------------------
+
+    def _plan_parquet_scan(self, n: pb.ParquetScanNode) -> PhysicalOp:
+        from auron_tpu.io.parquet import ParquetScanOp
+        return ParquetScanOp(
+            files=list(n.files),
+            schema=serde.parse_schema(n.schema) if n.schema.fields else None,
+            columns=list(n.columns) or None,
+            predicates=[serde.parse_expr(p) for p in n.predicates],
+            batch_rows=n.batch_rows or self.ctx.batch_capacity,
+        )
+
+    def _plan_orc_scan(self, n: pb.OrcScanNode) -> PhysicalOp:
+        from auron_tpu.io.orc import OrcScanOp
+        return OrcScanOp(
+            files=list(n.files),
+            schema=serde.parse_schema(n.schema) if n.schema.fields else None,
+            columns=list(n.columns) or None,
+            batch_rows=n.batch_rows or self.ctx.batch_capacity,
+        )
+
+    def _plan_memory_scan(self, n: pb.MemoryScanNode) -> PhysicalOp:
+        from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+        from auron_tpu.io.parquet import MemoryScanOp
+        if n.table_name not in self.ctx.catalog:
+            raise KeyError(
+                f"table {n.table_name!r} not in planner catalog "
+                f"(known: {sorted(self.ctx.catalog)})")
+        table = self.ctx.catalog[n.table_name]
+        if isinstance(table, pa.Table):
+            partitions = [table.to_batches(
+                max_chunksize=n.batch_rows or self.ctx.batch_capacity)]
+            schema = schema_from_arrow(table.schema)
+        else:  # pre-partitioned: list[list[RecordBatch]]
+            partitions = table
+            schema = schema_from_arrow(partitions[0][0].schema)
+        return MemoryScanOp(partitions, schema,
+                            capacity=n.batch_rows or self.ctx.batch_capacity)
+
+    def _plan_ipc_reader(self, n: pb.IpcReaderNode) -> PhysicalOp:
+        from auron_tpu.io.parquet import DeviceBatchScanOp
+        partitions = self.ctx.get_resource(n.resource_id)
+        return DeviceBatchScanOp(partitions, serde.parse_schema(n.schema))
+
+    def _plan_empty_partitions(self, n: pb.EmptyPartitionsNode) -> PhysicalOp:
+        from auron_tpu.ops.limit import EmptyPartitionsOp
+        return EmptyPartitionsOp(serde.parse_schema(n.schema),
+                                 n.num_partitions)
+
+    def _plan_kafka_scan(self, n: pb.KafkaScanNode) -> PhysicalOp:
+        from auron_tpu.streaming.kafka import KafkaScanOp
+        return KafkaScanOp(topic=n.topic, bootstrap=n.bootstrap,
+                           schema=serde.parse_schema(n.schema),
+                           fmt=n.format or "json",
+                           max_batches=n.max_batches or None)
+
+    # -- row transforms -----------------------------------------------------
+
+    def _plan_filter(self, n: pb.FilterNode) -> PhysicalOp:
+        from auron_tpu.ops.project import FilterOp
+        return FilterOp(self.create_plan(n.child),
+                        [serde.parse_expr(p) for p in n.predicates])
+
+    def _plan_project(self, n: pb.ProjectNode) -> PhysicalOp:
+        from auron_tpu.ops.project import ProjectOp
+        return ProjectOp(self.create_plan(n.child),
+                         [serde.parse_expr(e) for e in n.exprs],
+                         list(n.names))
+
+    def _plan_agg(self, n: pb.AggNode) -> PhysicalOp:
+        from auron_tpu.ops.agg import AggOp
+        return AggOp(
+            self.create_plan(n.child),
+            [serde.parse_expr(e) for e in n.group_exprs],
+            [serde.parse_agg(a) for a in n.aggs],
+            mode=n.mode or "complete",
+            group_names=list(n.group_names) or None,
+            agg_names=list(n.agg_names) or None,
+        )
+
+    def _plan_sort(self, n: pb.SortNode) -> PhysicalOp:
+        from auron_tpu.ops.sort import SortOp
+        return SortOp(self.create_plan(n.child),
+                      [serde.parse_sort_order(o) for o in n.sort_orders],
+                      fetch=None if n.fetch < 0 else n.fetch)
+
+    def _plan_limit(self, n: pb.LimitNode) -> PhysicalOp:
+        from auron_tpu.ops.limit import LimitOp
+        return LimitOp(self.create_plan(n.child), n.limit)
+
+    def _plan_union(self, n: pb.UnionNode) -> PhysicalOp:
+        from auron_tpu.ops.limit import UnionOp
+        return UnionOp([self.create_plan(c) for c in n.children])
+
+    def _plan_coalesce_batches(self, n: pb.CoalesceBatchesNode) -> PhysicalOp:
+        from auron_tpu.ops.limit import CoalesceBatchesOp
+        return CoalesceBatchesOp(self.create_plan(n.child), n.target_rows)
+
+    def _plan_rename_columns(self, n: pb.RenameColumnsNode) -> PhysicalOp:
+        from auron_tpu.ops.limit import RenameColumnsOp
+        return RenameColumnsOp(self.create_plan(n.child), list(n.names))
+
+    def _plan_debug(self, n: pb.DebugNode) -> PhysicalOp:
+        from auron_tpu.ops.debug import DebugOp
+        return DebugOp(self.create_plan(n.child), n.label)
+
+    def _plan_window(self, n: pb.WindowNode) -> PhysicalOp:
+        from auron_tpu.ops.window import WindowFunctionSpec, WindowOp
+        fns = []
+        for f in n.functions:
+            default = None
+            if f.HasField("default_value"):
+                default = serde._parse_literal(f.default_value).value
+            fns.append(WindowFunctionSpec(
+                kind=f.kind, fn=f.fn,
+                arg=serde.parse_expr(f.arg) if f.HasField("arg") else None,
+                offset=f.offset, default=default))
+        return WindowOp(
+            self.create_plan(n.child),
+            partition_by=[serde.parse_expr(e) for e in n.partition_by],
+            order_by=[serde.parse_sort_order(o) for o in n.order_by],
+            functions=fns,
+            output_names=list(n.output_names) or None,
+            group_limit=None if n.group_limit < 0 else (n.group_limit or None),
+        )
+
+    def _plan_expand(self, n: pb.ExpandNode) -> PhysicalOp:
+        from auron_tpu.ops.expand import ExpandOp
+        return ExpandOp(
+            self.create_plan(n.child),
+            [[serde.parse_expr(e) for e in proj.exprs]
+             for proj in n.projections],
+            list(n.names) or None,
+        )
+
+    def _plan_generate(self, n: pb.GenerateNode) -> PhysicalOp:
+        from auron_tpu.ops.generate import GenerateOp
+        return GenerateOp(
+            self.create_plan(n.child),
+            kind=n.kind,
+            generator=serde.parse_expr(n.generator)
+            if n.HasField("generator") else None,
+            json_fields=list(n.json_fields),
+            udtf_name=n.udtf_registry_name or None,
+            required_child_output=list(n.required_child_output),
+            outer=n.outer,
+            output_names=list(n.output_names) or None,
+        )
+
+    # -- joins --------------------------------------------------------------
+
+    def _plan_hash_join(self, n: pb.HashJoinNode) -> PhysicalOp:
+        from auron_tpu.ops.joins import HashJoinOp
+        return HashJoinOp(
+            self.create_plan(n.probe), self.create_plan(n.build),
+            [serde.parse_expr(e) for e in n.probe_keys],
+            [serde.parse_expr(e) for e in n.build_keys],
+            join_type=n.join_type or "inner",
+        )
+
+    def _plan_sort_merge_join(self, n: pb.SortMergeJoinNode) -> PhysicalOp:
+        from auron_tpu.ops.joins import SortMergeJoinOp
+        return SortMergeJoinOp(
+            self.create_plan(n.probe), self.create_plan(n.build),
+            [serde.parse_expr(e) for e in n.probe_keys],
+            [serde.parse_expr(e) for e in n.build_keys],
+            join_type=n.join_type or "inner",
+        )
+
+    def _plan_broadcast_join(self, n: pb.BroadcastJoinNode) -> PhysicalOp:
+        from auron_tpu.io.parquet import DeviceBatchScanOp
+        from auron_tpu.ops.joins import HashJoinOp
+        build_partitions = self.ctx.get_resource(n.build_resource_id)
+        build = DeviceBatchScanOp(build_partitions,
+                                  serde.parse_schema(n.build_schema))
+        return HashJoinOp(
+            self.create_plan(n.probe), build,
+            [serde.parse_expr(e) for e in n.probe_keys],
+            [serde.parse_expr(e) for e in n.build_keys],
+            join_type=n.join_type or "inner",
+        )
+
+    # -- exchange -----------------------------------------------------------
+
+    def _parse_partitioning(self, p: pb.PartitioningP):
+        from auron_tpu.parallel.partitioning import (HashPartitioning,
+                                                     RoundRobinPartitioning,
+                                                     SinglePartitioning)
+        if p.kind == "hash":
+            return HashPartitioning(
+                tuple(serde.parse_expr(e) for e in p.hash_keys),
+                p.num_partitions)
+        if p.kind == "round_robin":
+            return RoundRobinPartitioning(p.num_partitions)
+        if p.kind == "single":
+            return SinglePartitioning()
+        if p.kind == "range":
+            # bounds are sampled at execution time by the exchange operator
+            from auron_tpu.parallel.partitioning import RangePartitioning
+            return RangePartitioning(
+                tuple(serde.parse_sort_order(o) for o in p.range_orders),
+                p.num_partitions, bounds=())
+        raise NotImplementedError(f"partitioning {p.kind!r}")
+
+    def _plan_shuffle_writer(self, n: pb.ShuffleWriterNode) -> PhysicalOp:
+        from auron_tpu.parallel.exchange import ShuffleExchangeOp
+        op = ShuffleExchangeOp(self.create_plan(n.child),
+                               self._parse_partitioning(n.partitioning))
+        if n.output_resource_id:
+            self.ctx.put_resource(n.output_resource_id, op)
+        return op
+
+    def _plan_broadcast_exchange(self, n: pb.BroadcastExchangeNode) -> PhysicalOp:
+        from auron_tpu.parallel.exchange import BroadcastExchangeOp
+        op = BroadcastExchangeOp(self.create_plan(n.child))
+        if n.output_resource_id:
+            self.ctx.put_resource(n.output_resource_id, op)
+        return op
+
+    # -- sinks --------------------------------------------------------------
+
+    def _plan_parquet_sink(self, n: pb.ParquetSinkNode) -> PhysicalOp:
+        from auron_tpu.io.sinks import ParquetSinkOp
+        return ParquetSinkOp(self.create_plan(n.child), n.path,
+                             partition_by=list(n.partition_by),
+                             compression=n.compression or "snappy")
+
+    def _plan_orc_sink(self, n: pb.OrcSinkNode) -> PhysicalOp:
+        from auron_tpu.io.sinks import OrcSinkOp
+        return OrcSinkOp(self.create_plan(n.child), n.path,
+                         compression=n.compression or "zstd")
+
+
+def plan_from_bytes(data: bytes,
+                    ctx: Optional[PlannerContext] = None) -> PhysicalOp:
+    """Decode a serialized TaskDefinition and materialize its plan — the
+    `callNative` entry analogue (reference: auron/src/exec.rs:42-118)."""
+    task = pb.TaskDefinition.FromString(data)
+    return PhysicalPlanner(ctx).plan_task(task)

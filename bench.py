@@ -12,7 +12,6 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
-import sys
 import time
 
 import numpy as np
@@ -63,7 +62,10 @@ def bench_device() -> float:
 
 
 def bench_cpu_reference() -> float:
-    """Same query via pyarrow (vectorized C++ single-thread class baseline)."""
+    """Same query via pyarrow (vectorized C++ single-thread class baseline).
+    Arrow's kernels are multi-threaded by default; pin the pool to one
+    thread so the baseline really is the single-partition CPU reference."""
+    pa.set_cpu_count(1)
     _, host = make_batch(0)
     tbl = pa.table({
         "k": host["k"],
@@ -75,7 +77,7 @@ def bench_cpu_reference() -> float:
     def run_once():
         filt = tbl.filter(pc.and_(pc.greater(tbl["f"], 10),
                                   pc.is_valid(tbl["v"])))
-        return filt.group_by("k").aggregate(
+        return filt.group_by("k", use_threads=False).aggregate(
             [("v", "sum"), ("v", "count"), ("v", "mean")])
 
     run_once()
