@@ -98,6 +98,17 @@ class DeviceBatch:
         return DeviceBatch(tuple(self.columns[i] for i in indices), self.num_rows)
 
 
+def batch_nbytes(batch: DeviceBatch) -> int:
+    """Device bytes held by the batch (at capacity, incl. padding)."""
+    total = 0
+    for c in batch.columns:
+        if isinstance(c, StringColumn):
+            total += c.chars.nbytes + c.lens.nbytes + c.validity.nbytes
+        else:
+            total += c.data.nbytes + c.validity.nbytes
+    return total
+
+
 def mask_validity(batch: DeviceBatch) -> DeviceBatch:
     """Force validity False on padding rows (defensive normalization)."""
     mask = batch.row_mask()

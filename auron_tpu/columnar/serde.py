@@ -1,0 +1,216 @@
+"""Batch ↔ bytes serde for spill and shuffle payloads.
+
+The analogue of the reference's length-prefixed columnar wire format +
+block-compressed IPC framing (reference:
+datafusion-ext-commons/src/io/batch_serde.rs:68-149,
+io/ipc_compression.rs:35-280). Layout per frame:
+
+    magic 'ATB1' | u8 codec | u32 body_len | body (maybe compressed)
+
+body:
+    u32 num_rows | u16 num_cols | u16 num_extras
+    per column:   u8 kind (0 prim / 1 string) | dtype tag | buffers
+    per extra:    name | uint64 word matrix   (sort-key words for merge)
+
+Buffers are raw little-endian numpy bytes, each u32-length-prefixed. Only
+live rows travel — capacity padding is re-applied on load. Compression is
+zstd level 1 (the codec baked into this image; the reference defaults to
+lz4 with zstd as option, conf.rs SPILL_COMPRESSION_CODEC).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from dataclasses import dataclass
+from typing import Optional, Union
+
+import numpy as np
+import zstandard
+
+from auron_tpu.columnar.batch import DeviceBatch, PrimitiveColumn, StringColumn
+
+MAGIC = b"ATB1"
+CODEC_NONE = 0
+CODEC_ZSTD = 1
+
+_compressor = zstandard.ZstdCompressor(level=1)
+_decompressor = zstandard.ZstdDecompressor()
+
+
+# ---------------------------------------------------------------------------
+# host-side batch representation
+# ---------------------------------------------------------------------------
+
+@dataclass
+class HostPrimitive:
+    data: np.ndarray       # [n]
+    validity: np.ndarray   # bool[n]
+
+
+@dataclass
+class HostString:
+    chars: np.ndarray      # uint8[n, width]
+    lens: np.ndarray       # int32[n]
+    validity: np.ndarray   # bool[n]
+
+
+HostColumn = Union[HostPrimitive, HostString]
+
+
+@dataclass
+class HostBatch:
+    columns: list
+    num_rows: int
+
+    @property
+    def nbytes(self) -> int:
+        total = 0
+        for c in self.columns:
+            if isinstance(c, HostString):
+                total += c.chars.nbytes + c.lens.nbytes + c.validity.nbytes
+            else:
+                total += c.data.nbytes + c.validity.nbytes
+        return total
+
+
+def slice_host_batch(host: HostBatch, lo: int, hi: int) -> HostBatch:
+    """Row-range view [lo, hi) over every column."""
+    cols: list[HostColumn] = []
+    for c in host.columns:
+        if isinstance(c, HostString):
+            cols.append(HostString(c.chars[lo:hi], c.lens[lo:hi],
+                                   c.validity[lo:hi]))
+        else:
+            cols.append(HostPrimitive(c.data[lo:hi], c.validity[lo:hi]))
+    return HostBatch(cols, hi - lo)
+
+
+def batch_to_host(batch: DeviceBatch,
+                  num_rows: Optional[int] = None) -> HostBatch:
+    """Device → host, keeping only live rows (one device→host transfer per
+    buffer; jax batches them)."""
+    n = int(batch.num_rows) if num_rows is None else num_rows
+    cols: list[HostColumn] = []
+    for c in batch.columns:
+        if isinstance(c, StringColumn):
+            cols.append(HostString(
+                np.asarray(c.chars[:n]), np.asarray(c.lens[:n]),
+                np.asarray(c.validity[:n])))
+        else:
+            cols.append(HostPrimitive(
+                np.asarray(c.data[:n]), np.asarray(c.validity[:n])))
+    return HostBatch(cols, n)
+
+
+def host_to_batch(host: HostBatch, capacity: Optional[int] = None) -> DeviceBatch:
+    """Host → device with padding back to ``capacity`` (>= num_rows)."""
+    import jax.numpy as jnp
+    n = host.num_rows
+    cap = capacity or n
+    assert cap >= n, (cap, n)
+    pad = cap - n
+    cols = []
+    for c in host.columns:
+        if isinstance(c, HostString):
+            chars = np.pad(c.chars, ((0, pad), (0, 0))) if pad else c.chars
+            lens = np.pad(c.lens, (0, pad)) if pad else c.lens
+            val = np.pad(c.validity, (0, pad)) if pad else c.validity
+            cols.append(StringColumn(jnp.asarray(chars), jnp.asarray(lens),
+                                     jnp.asarray(val)))
+        else:
+            data = np.pad(c.data, (0, pad)) if pad else c.data
+            val = np.pad(c.validity, (0, pad)) if pad else c.validity
+            cols.append(PrimitiveColumn(jnp.asarray(data), jnp.asarray(val)))
+    return DeviceBatch(tuple(cols), jnp.asarray(n, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+def _put_buf(out: io.BytesIO, arr: np.ndarray) -> None:
+    b = np.ascontiguousarray(arr).tobytes()
+    out.write(struct.pack("<I", len(b)))
+    out.write(b)
+
+
+def _get_buf(src: io.BytesIO, dtype, shape) -> np.ndarray:
+    (ln,) = struct.unpack("<I", src.read(4))
+    return np.frombuffer(src.read(ln), dtype=dtype).reshape(shape).copy()
+
+
+def serialize_host_batch(host: HostBatch,
+                         extras: Optional[dict[str, np.ndarray]] = None,
+                         codec: str = "zstd") -> bytes:
+    extras = extras or {}
+    body = io.BytesIO()
+    body.write(struct.pack("<IHH", host.num_rows, len(host.columns),
+                           len(extras)))
+    for c in host.columns:
+        if isinstance(c, HostString):
+            body.write(struct.pack("<BH", 1, c.chars.shape[1]))
+            _put_buf(body, c.chars)
+            _put_buf(body, c.lens.astype(np.int32))
+            _put_buf(body, c.validity.astype(np.bool_))
+        else:
+            tag = c.data.dtype.str.encode()
+            body.write(struct.pack("<BB", 0, len(tag)))
+            body.write(tag)
+            _put_buf(body, c.data)
+            _put_buf(body, c.validity.astype(np.bool_))
+    for name, arr in extras.items():
+        nb = name.encode()
+        assert arr.ndim == 2 and arr.dtype == np.uint64, name
+        body.write(struct.pack("<BH", len(nb), arr.shape[1]))
+        body.write(nb)
+        _put_buf(body, arr)
+
+    raw = body.getvalue()
+    if codec == "zstd":
+        payload = _compressor.compress(raw)
+        code = CODEC_ZSTD
+    else:
+        payload, code = raw, CODEC_NONE
+    return MAGIC + struct.pack("<BI", code, len(payload)) + payload
+
+
+def deserialize_host_batch(data: bytes) -> tuple[HostBatch, dict[str, np.ndarray]]:
+    if data[:4] != MAGIC:
+        raise ValueError("bad batch frame magic")
+    code, body_len = struct.unpack("<BI", data[4:9])
+    payload = data[9:9 + body_len]
+    raw = _decompressor.decompress(payload) if code == CODEC_ZSTD else payload
+    src = io.BytesIO(raw)
+    num_rows, num_cols, num_extras = struct.unpack("<IHH", src.read(8))
+    cols: list[HostColumn] = []
+    for _ in range(num_cols):
+        kind = struct.unpack("<B", src.read(1))[0]
+        if kind == 1:
+            (width,) = struct.unpack("<H", src.read(2))
+            chars = _get_buf(src, np.uint8, (num_rows, width))
+            lens = _get_buf(src, np.int32, (num_rows,))
+            val = _get_buf(src, np.bool_, (num_rows,))
+            cols.append(HostString(chars, lens, val))
+        else:
+            (tag_len,) = struct.unpack("<B", src.read(1))
+            dt = np.dtype(src.read(tag_len).decode())
+            data_arr = _get_buf(src, dt, (num_rows,))
+            val = _get_buf(src, np.bool_, (num_rows,))
+            cols.append(HostPrimitive(data_arr, val))
+    extras: dict[str, np.ndarray] = {}
+    for _ in range(num_extras):
+        name_len, words = struct.unpack("<BH", src.read(3))
+        name = src.read(name_len).decode()
+        extras[name] = _get_buf(src, np.uint64, (num_rows, words))
+    return HostBatch(cols, num_rows), extras
+
+
+def serialize_batch(batch: DeviceBatch, codec: str = "zstd") -> bytes:
+    return serialize_host_batch(batch_to_host(batch), codec=codec)
+
+
+def deserialize_batch(data: bytes,
+                      capacity: Optional[int] = None) -> DeviceBatch:
+    host, _ = deserialize_host_batch(data)
+    return host_to_batch(host, capacity)

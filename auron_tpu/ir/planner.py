@@ -145,9 +145,11 @@ class PhysicalPlanner:
 
     def _plan_sort(self, n: pb.SortNode) -> PhysicalOp:
         from auron_tpu.ops.sort import SortOp
+        # proto3 leaves unset fetch at 0; a 0-row top-k is meaningless, so
+        # any fetch <= 0 means "no limit"
         return SortOp(self.create_plan(n.child),
                       [serde.parse_sort_order(o) for o in n.sort_orders],
-                      fetch=None if n.fetch < 0 else n.fetch)
+                      fetch=None if n.fetch <= 0 else n.fetch)
 
     def _plan_limit(self, n: pb.LimitNode) -> PhysicalOp:
         from auron_tpu.ops.limit import LimitOp

@@ -1,0 +1,14 @@
+"""Memory manager: HBM budget arbitration + host-DRAM/disk spill tiering.
+
+The TPU re-design of the reference's memmgr (reference:
+native-engine/auron-memmgr/src/lib.rs:38-423, spill.rs:40-275): operators
+register as MemConsumers against one MemManager arbitrating a device (HBM)
+budget; when an update pushes usage past the consumer's fair share, the
+manager tells it to spill. Spills tier through host DRAM first (the
+HBM↔DRAM tiering of the north star — on TPU, host memory plays the role the
+JVM on-heap spill plays in the reference) and overflow to compressed disk
+files.
+"""
+
+from auron_tpu.memmgr.manager import MemConsumer, MemManager  # noqa: F401
+from auron_tpu.memmgr.spill import Spill, SpillManager  # noqa: F401

@@ -1,0 +1,137 @@
+"""HBM budget arbitration.
+
+Mirrors the reference's design (reference: auron-memmgr/src/lib.rs:303-423):
+one manager per process, consumers update their usage after each growth
+step, the manager answers Nothing or Spill based on the consumer's fair
+share ``total / num_spillable_consumers`` and a global watermark. The
+reference's Wait arm (condvar, 10 s) exists because many tasks share one
+pool concurrently; the host driver here executes partitions cooperatively,
+so over-budget resolves by spilling the requester (the biggest consumer is
+asked first when the requester is under fair share).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+logger = logging.getLogger("auron_tpu.memmgr")
+
+#: don't bother spilling consumers below this (reference: MIN_TRIGGER_SIZE
+#: 16MB, auron-memmgr/src/lib.rs:36)
+MIN_TRIGGER_SIZE = 16 << 20
+
+
+class MemConsumer:
+    """Spillable participant. Operators subclass / duck-type this."""
+
+    #: display name for the status dump
+    consumer_name: str = "consumer"
+
+    def mem_used(self) -> int:
+        raise NotImplementedError
+
+    def spill(self) -> int:
+        """Release device memory; returns bytes freed."""
+        raise NotImplementedError
+
+
+class MemManager:
+    def __init__(self, total_bytes: int,
+                 min_trigger: int = MIN_TRIGGER_SIZE,
+                 spill_manager: Optional["object"] = None):
+        self.total = total_bytes
+        self.min_trigger = min_trigger
+        self.spill_manager = spill_manager
+        self._lock = threading.Lock()
+        self._used: dict[MemConsumer, int] = {}
+        self.num_spills = 0
+        self.spilled_bytes = 0
+
+    # -- registration -------------------------------------------------------
+
+    def register_consumer(self, c: MemConsumer) -> None:
+        with self._lock:
+            self._used.setdefault(c, 0)
+
+    def unregister_consumer(self, c: MemConsumer) -> None:
+        with self._lock:
+            self._used.pop(c, None)
+
+    # -- accounting ---------------------------------------------------------
+
+    @property
+    def used_total(self) -> int:
+        with self._lock:
+            return sum(self._used.values())
+
+    def fair_share(self) -> int:
+        with self._lock:
+            n = max(len(self._used), 1)
+        return self.total // n
+
+    def update_mem_used(self, c: MemConsumer, used: int) -> str:
+        """Record ``c``'s usage; returns 'nothing' or 'spilled'. May invoke
+        c.spill() (or the largest consumer's) synchronously."""
+        with self._lock:
+            if c not in self._used:
+                self._used[c] = 0
+            self._used[c] = used
+            total_used = sum(self._used.values())
+            share = self.total // max(len(self._used), 1)
+
+        if total_used <= self.total:
+            return "nothing"
+
+        # Spill until under budget or out of candidates (the reference loops
+        # to its watermark the same way; one victim's spill may free less
+        # than the overshoot — e.g. a consumer refusing mid-merge).
+        spilled_any = False
+        tried: set = set()
+        while True:
+            with self._lock:
+                total_used = sum(self._used.values())
+                share = self.total // max(len(self._used), 1)
+                c_used = self._used.get(c, 0)
+            if total_used <= self.total:
+                break
+            if (c not in tried and c_used >= max(share, 1)
+                    and c_used >= self.min_trigger):
+                victim = c
+            else:
+                with self._lock:
+                    candidates = [(u, v) for v, u in self._used.items()
+                                  if u >= self.min_trigger and v not in tried]
+                if not candidates:
+                    break
+                _, victim = max(candidates, key=lambda t: t[0])
+            tried.add(victim)
+
+            freed = victim.spill()
+            with self._lock:
+                self._used[victim] = max(self._used.get(victim, 0) - freed, 0)
+                if freed:
+                    self.num_spills += 1
+                    self.spilled_bytes += freed
+            if freed:
+                spilled_any = True
+                logger.info("memmgr: spilled %s (%d bytes freed, %d/%d used)",
+                            victim.consumer_name, freed,
+                            max(total_used - freed, 0), self.total)
+        return "spilled" if spilled_any else "nothing"
+
+    # -- status (reference dumps the consumer table on exit,
+    #    auron-memmgr/src/lib.rs:143-163) ----------------------------------
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "total": self.total,
+                "used": sum(self._used.values()),
+                "num_consumers": len(self._used),
+                "num_spills": self.num_spills,
+                "spilled_bytes": self.spilled_bytes,
+                "consumers": {getattr(c, "consumer_name", "?"): u
+                              for c, u in self._used.items()},
+            }

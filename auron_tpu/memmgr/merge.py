@@ -1,0 +1,163 @@
+"""Host-side k-way merge of sorted spill runs.
+
+The reference merges runs with a loser tree over row cursors (reference:
+datafusion-ext-commons/src/algorithm/loser_tree.rs, sort_exec.rs k-way
+merge). A per-row tournament is a host-bound scalar loop — poison on this
+architecture — so the merge here is *blockwise and vectorized*: each run's
+frames carry the device-computed order words (uint64 [rows, W], produced by
+the same kernel that sorted the run, so host comparisons agree with device
+sort order bit-for-bit). Per round:
+
+  1. bound = min over runs of (last key words of the run's current block);
+  2. every row ≤ bound anywhere is safe to emit — later rows of run r are
+     ≥ r's block-last ≥ bound;
+  3. those rows are merged with one np.lexsort and emitted as one batch.
+
+The run whose block defines the bound always drains fully, so each round
+retires ≥ one block. Ties at the bound may interleave across runs: the
+merge is not stable across runs for equal keys (neither is the output
+contract — SQL sort is non-stable).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from auron_tpu.columnar.serde import (HostBatch, HostPrimitive, HostString,
+                                      deserialize_host_batch)
+
+ORDER_WORDS_EXTRA = "order_words"
+
+
+class _RunCursor:
+    """One sorted run: frame iterator + current decoded block."""
+
+    def __init__(self, frames: Iterator[bytes]):
+        self._frames = iter(frames)
+        self.batch: Optional[HostBatch] = None
+        self.words: Optional[np.ndarray] = None
+        self.pos = 0
+        self._advance()
+
+    def _advance(self) -> None:
+        for frame in self._frames:
+            batch, extras = deserialize_host_batch(frame)
+            if batch.num_rows == 0:
+                continue
+            self.batch = batch
+            self.words = extras[ORDER_WORDS_EXTRA]
+            self.pos = 0
+            return
+        self.batch = None
+        self.words = None
+
+    @property
+    def exhausted(self) -> bool:
+        return self.batch is None
+
+    def remaining_words(self) -> np.ndarray:
+        return self.words[self.pos:]
+
+    def last_words(self) -> np.ndarray:
+        return self.words[-1]
+
+    def take(self, n: int) -> tuple[HostBatch, np.ndarray]:
+        """Consume n rows from the front of the current block."""
+        from auron_tpu.columnar.serde import slice_host_batch
+        lo, hi = self.pos, self.pos + n
+        out = slice_host_batch(self.batch, lo, hi)
+        words = self.words[lo:hi]
+        self.pos = hi
+        if self.pos >= self.batch.num_rows:
+            self._advance()
+        return out, words
+
+
+def _lex_leq(words: np.ndarray, bound: np.ndarray) -> np.ndarray:
+    """rows ≤ bound, lexicographic over word columns (vectorized)."""
+    n, w = words.shape
+    le = np.zeros(n, bool)
+    eq = np.ones(n, bool)
+    for i in range(w):
+        le |= eq & (words[:, i] < bound[i])
+        eq &= words[:, i] == bound[i]
+    return le | eq
+
+
+def _lex_min(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    for x, y in zip(a, b):
+        if x < y:
+            return a
+        if x > y:
+            return b
+    return a
+
+
+def _concat_host(parts: list[HostBatch]) -> HostBatch:
+    ncols = len(parts[0].columns)
+    cols = []
+    for i in range(ncols):
+        cs = [p.columns[i] for p in parts]
+        if isinstance(cs[0], HostString):
+            width = max(c.chars.shape[1] for c in cs)
+            chars = np.concatenate([
+                np.pad(c.chars, ((0, 0), (0, width - c.chars.shape[1])))
+                for c in cs])
+            cols.append(HostString(chars,
+                                   np.concatenate([c.lens for c in cs]),
+                                   np.concatenate([c.validity for c in cs])))
+        else:
+            cols.append(HostPrimitive(
+                np.concatenate([c.data for c in cs]),
+                np.concatenate([c.validity for c in cs])))
+    return HostBatch(cols, sum(p.num_rows for p in parts))
+
+
+def _reorder_host(batch: HostBatch, perm: np.ndarray) -> HostBatch:
+    cols = []
+    for c in batch.columns:
+        if isinstance(c, HostString):
+            cols.append(HostString(c.chars[perm], c.lens[perm],
+                                   c.validity[perm]))
+        else:
+            cols.append(HostPrimitive(c.data[perm], c.validity[perm]))
+    return HostBatch(cols, len(perm))
+
+
+def merge_sorted_runs(run_frames: list[Iterator[bytes]]) -> Iterator[HostBatch]:
+    """Merge k sorted runs (frames with ORDER_WORDS_EXTRA) into a stream of
+    sorted HostBatches (one per merge round)."""
+    cursors = [_RunCursor(f) for f in run_frames]
+    cursors = [c for c in cursors if not c.exhausted]
+
+    while cursors:
+        if len(cursors) == 1:
+            c = cursors[0]
+            n = c.batch.num_rows - c.pos
+            batch, _ = c.take(n)
+            yield batch
+            cursors = [c for c in cursors if not c.exhausted]
+            continue
+
+        bound = cursors[0].last_words()
+        for c in cursors[1:]:
+            bound = _lex_min(bound, c.last_words())
+
+        parts: list[tuple[HostBatch, np.ndarray]] = []
+        for c in cursors:
+            rw = c.remaining_words()
+            le = _lex_leq(rw, bound)
+            # rows are sorted, so ≤-bound rows form a prefix
+            n = int(np.searchsorted(~le, True)) if le.size else 0
+            if n:
+                parts.append(c.take(n))
+
+        merged = _concat_host([p[0] for p in parts])
+        words = np.concatenate([p[1] for p in parts])
+        # np.lexsort: last key is primary → feed most-significant last
+        perm = np.lexsort(tuple(words[:, i]
+                                for i in range(words.shape[1] - 1, -1, -1)))
+        yield _reorder_host(merged, perm)
+        cursors = [c for c in cursors if not c.exhausted]

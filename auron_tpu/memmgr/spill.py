@@ -1,0 +1,134 @@
+"""Tiered spill storage: host DRAM → compressed disk files.
+
+The reference spills to JVM on-heap buffers or local files with a
+block-compressed codec (reference: auron-memmgr/src/spill.rs:89-275,
+OnHeapSpill via JNI / FileSpill via tempfile). Here tier 1 is host DRAM
+(already-serialized compressed frames held as bytes — on TPU the device→host
+hop is the expensive part, compression is cheap), tier 2 is an append-only
+temp file of length-prefixed frames. A Spill written while DRAM budget
+lasts can later overflow: frames are flushed to disk in order and the spill
+keeps a single frame sequence either way.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import tempfile
+import threading
+from typing import Iterator, Optional
+
+
+class Spill:
+    """One spill: an ordered sequence of opaque frames (serialized batches).
+
+    Write phase: ``write_frame`` × N then ``finish``. Read phase:
+    ``frames()`` re-yields in order (repeatable). ``release`` drops memory
+    and deletes the file (reference deletes on drop, spill.rs:163-175).
+    """
+
+    def __init__(self, manager: "SpillManager", spill_id: int):
+        self._mgr = manager
+        self.spill_id = spill_id
+        self._mem_frames: list[bytes] = []
+        self._file: Optional[object] = None
+        self._path: Optional[str] = None
+        self._finished = False
+        self.mem_bytes = 0
+        self.disk_bytes = 0
+
+    # -- write --------------------------------------------------------------
+
+    def write_frame(self, frame: bytes) -> None:
+        assert not self._finished
+        if self._file is None and not self._mgr.try_reserve_host(len(frame)):
+            self._spill_to_disk()
+        if self._file is not None:
+            self._file.write(struct.pack("<I", len(frame)))
+            self._file.write(frame)
+            self.disk_bytes += len(frame) + 4
+        else:
+            self._mem_frames.append(frame)
+            self.mem_bytes += len(frame)
+
+    def _spill_to_disk(self) -> None:
+        fd, self._path = tempfile.mkstemp(
+            prefix=f"auron-spill-{self.spill_id}-", suffix=".atb",
+            dir=self._mgr.spill_dir)
+        self._file = os.fdopen(fd, "wb")
+        for frame in self._mem_frames:
+            self._file.write(struct.pack("<I", len(frame)))
+            self._file.write(frame)
+            self.disk_bytes += len(frame) + 4
+        self._mem_frames.clear()
+        self._mgr.release_host(self.mem_bytes)
+        self.mem_bytes = 0
+
+    def finish(self) -> "Spill":
+        self._finished = True
+        if self._file is not None:
+            self._file.flush()
+            self._file.close()
+            self._file = None
+        return self
+
+    # -- read ---------------------------------------------------------------
+
+    def frames(self) -> Iterator[bytes]:
+        assert self._finished
+        if self._path is not None:
+            with open(self._path, "rb") as f:
+                while True:
+                    hdr = f.read(4)
+                    if not hdr:
+                        break
+                    (ln,) = struct.unpack("<I", hdr)
+                    yield f.read(ln)
+        else:
+            yield from self._mem_frames
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def release(self) -> None:
+        self._mgr.release_host(self.mem_bytes)
+        self._mem_frames.clear()
+        self.mem_bytes = 0
+        if self._path is not None and os.path.exists(self._path):
+            os.unlink(self._path)
+        self._path = None
+
+
+class SpillManager:
+    """Owns the host-DRAM spill budget and the spill directory."""
+
+    def __init__(self, host_budget_bytes: int = 1 << 30,
+                 spill_dir: Optional[str] = None):
+        self.host_budget = host_budget_bytes
+        self.spill_dir = spill_dir
+        self._lock = threading.Lock()
+        self._host_used = 0
+        self._next_id = 0
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+
+    @property
+    def host_used(self) -> int:
+        with self._lock:
+            return self._host_used
+
+    def try_reserve_host(self, nbytes: int) -> bool:
+        with self._lock:
+            if self._host_used + nbytes > self.host_budget:
+                return False
+            self._host_used += nbytes
+            return True
+
+    def release_host(self, nbytes: int) -> None:
+        with self._lock:
+            self._host_used = max(self._host_used - nbytes, 0)
+
+    def new_spill(self) -> Spill:
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        return Spill(self, sid)

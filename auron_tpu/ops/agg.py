@@ -205,6 +205,105 @@ def _merge_kernel(n_keys: int, acc_meta: tuple, out_cap: int):
 # the operator
 # ---------------------------------------------------------------------------
 
+def _state_nbytes(state) -> int:
+    """Device bytes of an accumulator state, from array metadata only."""
+    keys, accs, _num_groups, _cap = state
+    total = 0
+    for k in keys:
+        if isinstance(k, StringColumn):
+            total += k.chars.nbytes + k.lens.nbytes + k.validity.nbytes
+        else:
+            total += k.data.nbytes + k.validity.nbytes
+    for a in accs:
+        total += a.nbytes
+    return total
+
+
+class _AggSpillConsumer:
+    """MemConsumer for AggOp: owns the accumulator state between merges.
+
+    The operator checks the state out with ``take_state`` before each merge
+    and checks the merged result back in with ``observe``. While checked
+    out, an externally-triggered spill (another consumer's update picking
+    this one as victim) must refuse — serializing a state the operator is
+    about to fold new rows into would double-count every group on emit."""
+
+    FRAME_ROWS = 1 << 16
+
+    def __init__(self, op: "AggOp", mem_manager, metrics):
+        import threading
+        self.op = op
+        self.mem = mem_manager
+        self.metrics = metrics
+        self.consumer_name = f"agg-{id(op):x}"
+        self.state = None
+        self.spills = []
+        self._lock = threading.RLock()
+        self._merging = False
+        mem_manager.register_consumer(self)
+
+    def take_state(self):
+        with self._lock:
+            self._merging = True
+            state, self.state = self.state, None
+            return state
+
+    def observe(self, state):
+        """Check the merged state back in; may spill it synchronously (the
+        requester-side trigger). Returns the state the operator should
+        continue with (None right after a spill)."""
+        with self._lock:
+            self.state = state
+            self._merging = False
+        if state is not None:
+            self.mem.update_mem_used(self, _state_nbytes(state))
+        with self._lock:
+            return self.state
+
+    def mem_used(self) -> int:
+        with self._lock:
+            return 0 if self.state is None else _state_nbytes(self.state)
+
+    def spill(self) -> int:
+        from auron_tpu.columnar.serde import (batch_to_host,
+                                              serialize_host_batch,
+                                              slice_host_batch)
+        with self._lock:
+            if self.state is None or self._merging:
+                return 0
+            state, self.state = self.state, None
+        state_batch = self.op._state_batch(state)
+        freed = _state_nbytes(state)
+        n = int(state_batch.num_rows)
+        host = batch_to_host(state_batch, n)
+        spill = self.mem.spill_manager.new_spill()
+        for lo in range(0, max(n, 1), self.FRAME_ROWS):
+            hi = min(lo + self.FRAME_ROWS, n)
+            spill.write_frame(
+                serialize_host_batch(slice_host_batch(host, lo, hi)))
+        with self._lock:
+            self.spills.append(spill.finish())
+        self.metrics.counter("mem_spill_count").add(1)
+        self.metrics.counter("mem_spill_size").add(freed)
+        return freed
+
+    def read_spilled_states(self):
+        from auron_tpu.columnar.serde import (deserialize_host_batch,
+                                              host_to_batch)
+        from auron_tpu.utils.shapes import bucket_rows
+        for spill in self.spills:
+            for frame in spill.frames():
+                host, _ = deserialize_host_batch(frame)
+                if host.num_rows:
+                    yield host_to_batch(host, bucket_rows(host.num_rows))
+
+    def close(self) -> None:
+        self.mem.unregister_consumer(self)
+        for s in self.spills:
+            s.release()
+        self.spills = []
+
+
 class AggOp(PhysicalOp):
     """mode: 'partial' emits (keys..., state...); 'final' consumes state
     columns; 'complete' does full agg in one op (reference: AggMode,
@@ -393,23 +492,73 @@ class AggOp(PhysicalOp):
                 raise NotImplementedError(fn)
         return DeviceBatch(tuple(out_cols), num_groups)
 
+    # -- spill support ------------------------------------------------------
+    # The reference spills the in-mem hash table as sorted buckets and
+    # merges with a radix queue on output (agg/agg_table.rs:68-356). Here
+    # the spilled unit is the whole accumulator table as a partial-layout
+    # batch; on emit, spilled tables re-enter the same device merge kernel —
+    # associativity of the accumulators makes re-merging exact.
+
+    def _state_batch(self, state) -> DeviceBatch:
+        keys, accs, num_groups, cap = state
+        valid = jnp.arange(cap, dtype=jnp.int32) < num_groups
+        cols = list(keys) + [PrimitiveColumn(a, valid) for a in accs]
+        return DeviceBatch(tuple(cols), num_groups)
+
+    def _state_contributions(self, batch: DeviceBatch):
+        n_keys = len(self.group_exprs)
+        keys = tuple(batch.columns[:n_keys])
+        live = batch.row_mask()
+        accs = []
+        idx = n_keys
+        for spec in self.specs:
+            for (fname, _fdt, _kind) in spec.state_fields:
+                col = batch.columns[idx]
+                data = col.data
+                if fname == "has":
+                    data = data.astype(jnp.bool_) & col.validity
+                accs.append(data)
+                idx += 1
+        return keys, accs, live
+
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         metrics = ctx.metrics_for(self.name)
         elapsed = metrics.counter("elapsed_compute")
         in_schema = self.child.schema()
         ectx = EvalContext(partition_id=partition)
+        mem = ctx.mem_manager
+        spillable = mem is not None and getattr(mem, "spill_manager", None) is not None
 
         def stream():
+            consumer = _AggSpillConsumer(self, mem, metrics) if spillable else None
             state = None
-            for batch in self.child.execute(partition, ctx):
-                keys, accs, live = self._contributions(batch, in_schema, ectx)
-                state = self._merge(state, keys, accs, live, elapsed)
-            if state is None:
-                if not self.group_exprs and self.mode in ("final", "complete"):
-                    # global agg over empty input: one row of neutral results
-                    yield self._empty_global()
-                return
-            yield self._emit(state, in_schema)
+            try:
+                for batch in self.child.execute(partition, ctx):
+                    keys, accs, live = self._contributions(batch, in_schema, ectx)
+                    if consumer is not None:
+                        # state lives in the consumer between merges so an
+                        # external victim spill can take it atomically
+                        state = consumer.take_state()
+                    state = self._merge(state, keys, accs, live, elapsed)
+                    if consumer is not None:
+                        state = consumer.observe(state)
+                if consumer is not None:
+                    # re-take: locks out external spills for the final merge
+                    # (consumer.state is the source of truth, the local var
+                    # may have been spilled away since the last observe)
+                    state = consumer.take_state()
+                    for spilled in consumer.read_spilled_states():
+                        keys, accs, live = self._state_contributions(spilled)
+                        state = self._merge(state, keys, accs, live, elapsed)
+                if state is None:
+                    if not self.group_exprs and self.mode in ("final", "complete"):
+                        # global agg over empty input: one row of neutral results
+                        yield self._empty_global()
+                    return
+                yield self._emit(state, in_schema)
+            finally:
+                if consumer is not None:
+                    consumer.close()
 
         return count_output(stream(), metrics)
 

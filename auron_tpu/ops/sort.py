@@ -76,11 +76,9 @@ def order_words(col, ascending: bool, nulls_first: bool) -> list[jax.Array]:
     return words
 
 
-def sort_permutation(batch: DeviceBatch, key_cols, orders) -> jax.Array:
-    """Stable multi-key sort permutation. orders: list[(ascending,
-    nulls_first)] aligned with key_cols. Padding rows sort to the end."""
-    cap = batch.capacity
-    live = batch.row_mask()
+def sort_key_words(key_cols, orders) -> list[jax.Array]:
+    """All order words for a composite key, most-significant first: per key,
+    one null-rank word then the value words (nulls neutralized to 0)."""
     all_words: list[jax.Array] = []
     for col, (asc, nf) in zip(key_cols, orders):
         null_word = jnp.where(col.validity,
@@ -91,6 +89,15 @@ def sort_permutation(batch: DeviceBatch, key_cols, orders) -> jax.Array:
         words = [jnp.where(col.validity, w, 0) for w in words]
         all_words.append(null_word)
         all_words.extend(words)
+    return all_words
+
+
+def sort_permutation(batch: DeviceBatch, key_cols, orders) -> jax.Array:
+    """Stable multi-key sort permutation. orders: list[(ascending,
+    nulls_first)] aligned with key_cols. Padding rows sort to the end."""
+    cap = batch.capacity
+    live = batch.row_mask()
+    all_words = sort_key_words(key_cols, orders)
     # dead rows to the very end: leading liveness word
     lead = jnp.where(live, jnp.uint64(0), jnp.uint64(1))
     perm = jnp.arange(cap, dtype=jnp.int32)
@@ -110,6 +117,26 @@ def _sort_kernel(sort_exprs: tuple, in_schema: Schema, capacity: int):
         orders = [(s.ascending, s.nulls_first) for s in sort_exprs]
         perm = sort_permutation(batch, key_cols, orders)
         return gather_batch(batch, perm, batch.num_rows)
+
+    return kernel
+
+
+@lru_cache(maxsize=256)
+def _sort_with_words_kernel(sort_exprs: tuple, in_schema: Schema,
+                            capacity: int):
+    """Sorted batch + its order-word matrix [capacity, W] — the words ride
+    into the spill so the host k-way merge (memmgr.merge) compares exactly
+    what the device sorted."""
+
+    @jax.jit
+    def kernel(batch: DeviceBatch):
+        ctx = EvalContext()
+        key_cols = [evaluate(s.expr, batch, in_schema, ctx).col
+                    for s in sort_exprs]
+        orders = [(s.ascending, s.nulls_first) for s in sort_exprs]
+        perm = sort_permutation(batch, key_cols, orders)
+        words = jnp.stack(sort_key_words(key_cols, orders), axis=1)
+        return gather_batch(batch, perm, batch.num_rows), words[perm]
 
     return kernel
 
@@ -150,6 +177,79 @@ def _concat_all(batches: list[DeviceBatch]) -> DeviceBatch:
     return DeviceBatch(out.columns, jnp.asarray(num, jnp.int32))
 
 
+class _SortSpillConsumer:
+    """Per-execution buffering state registered with the memory manager
+    (the MemConsumer role SortExec plays in the reference,
+    sort_exec.rs:375). spill() sorts the buffer into a run and writes it to
+    tiered storage with its order words."""
+
+    def __init__(self, op: "SortOp", in_schema: Schema, mem_manager,
+                 metrics, frame_rows: int = 1 << 16):
+        import threading
+        self.op = op
+        self.in_schema = in_schema
+        self.mem = mem_manager
+        self.metrics = metrics
+        self.frame_rows = frame_rows
+        self.consumer_name = f"sort-{id(op):x}"
+        self.buffered: list[DeviceBatch] = []
+        self.bytes = 0
+        self.spills = []
+        self._lock = threading.RLock()
+        mem_manager.register_consumer(self)
+
+    def add(self, batch: DeviceBatch) -> None:
+        from auron_tpu.columnar.batch import batch_nbytes
+        with self._lock:
+            self.buffered.append(batch)
+            self.bytes += batch_nbytes(batch)
+            used = self.bytes
+        self.mem.update_mem_used(self, used)
+
+    def mem_used(self) -> int:
+        with self._lock:
+            return self.bytes
+
+    def _sorted_run(self, buffered):
+        merged = _concat_all(buffered) if len(buffered) > 1 else buffered[0]
+        kern = _sort_with_words_kernel(self.op.sort_exprs, self.in_schema,
+                                       merged.capacity)
+        return kern(merged)
+
+    def spill(self) -> int:
+        import numpy as np
+        from auron_tpu.columnar.serde import (batch_to_host,
+                                              serialize_host_batch,
+                                              slice_host_batch)
+        from auron_tpu.memmgr.merge import ORDER_WORDS_EXTRA
+        with self._lock:
+            if not self.buffered:
+                return 0
+            buffered, self.buffered = self.buffered, []
+            freed, self.bytes = self.bytes, 0
+        run, words = self._sorted_run(buffered)
+        n = int(run.num_rows)
+        host = batch_to_host(run, n)
+        host_words = np.asarray(words[:n])
+        spill = self.mem.spill_manager.new_spill()
+        for lo in range(0, max(n, 1), self.frame_rows):
+            hi = min(lo + self.frame_rows, n)
+            spill.write_frame(serialize_host_batch(
+                slice_host_batch(host, lo, hi),
+                extras={ORDER_WORDS_EXTRA: host_words[lo:hi]}))
+        with self._lock:
+            self.spills.append(spill.finish())
+        self.metrics.counter("mem_spill_count").add(1)
+        self.metrics.counter("mem_spill_size").add(freed)
+        return freed
+
+    def close(self) -> None:
+        self.mem.unregister_consumer(self)
+        for s in self.spills:
+            s.release()
+        self.spills = []
+
+
 class SortOp(PhysicalOp):
     name = "sort"
 
@@ -166,23 +266,63 @@ class SortOp(PhysicalOp):
     def schema(self) -> Schema:
         return self.child.schema()
 
+    def _limit(self, stream):
+        remaining = self.fetch
+        for out in stream:
+            if remaining is None:
+                yield out
+                continue
+            if remaining <= 0:
+                return
+            n = int(out.num_rows)
+            if n > remaining:
+                out = DeviceBatch(out.columns, jnp.asarray(remaining, jnp.int32))
+            remaining -= n
+            yield out
+            if remaining <= 0:
+                return
+
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         metrics = ctx.metrics_for(self.name)
         elapsed = metrics.counter("elapsed_compute")
         in_schema = self.child.schema()
+        mem = ctx.mem_manager
+        spillable = mem is not None and getattr(mem, "spill_manager", None) is not None
 
-        def stream():
-            batches = list(self.child.execute(partition, ctx))
+        def in_mem_stream(batches):
             if not batches:
                 return
             with timer(elapsed):
                 merged = _concat_all(batches) if len(batches) > 1 else batches[0]
                 kern = _sort_kernel(self.sort_exprs, in_schema, merged.capacity)
                 out = kern(merged)
-            if self.fetch is not None:
-                n = jnp.minimum(out.num_rows, self.fetch)
-                out = DeviceBatch(out.columns, jnp.asarray(n, jnp.int32))
             yield out
+
+        def external_stream(consumer):
+            """Runs on tiered storage + final host k-way merge."""
+            from auron_tpu.columnar.serde import host_to_batch
+            from auron_tpu.memmgr.merge import merge_sorted_runs
+            if consumer.buffered:
+                consumer.spill()   # final in-mem run joins the merge
+            for host in merge_sorted_runs(
+                    [s.frames() for s in consumer.spills]):
+                yield host_to_batch(host, bucket_rows(host.num_rows))
+
+        def stream():
+            if not spillable:
+                yield from self._limit(
+                    in_mem_stream(list(self.child.execute(partition, ctx))))
+                return
+            consumer = _SortSpillConsumer(self, in_schema, mem, metrics)
+            try:
+                for batch in self.child.execute(partition, ctx):
+                    consumer.add(batch)
+                if not consumer.spills:
+                    yield from self._limit(in_mem_stream(consumer.buffered))
+                else:
+                    yield from self._limit(external_stream(consumer))
+            finally:
+                consumer.close()
 
         return count_output(stream(), metrics)
 

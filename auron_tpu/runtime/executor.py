@@ -83,11 +83,13 @@ class ExecutionRuntime:
         return self.ctx.metrics_snapshot()
 
 
-def collect(plan: PhysicalOp, num_partitions: int = 1) -> pa.Table:
+def collect(plan: PhysicalOp, num_partitions: int = 1,
+            mem_manager=None) -> pa.Table:
     """Run every partition of a plan and concatenate (driver-side collect)."""
     tables = []
     for p in range(num_partitions):
         rt = ExecutionRuntime(
-            plan, TaskDefinition(partition_id=p, num_partitions=num_partitions))
+            plan, TaskDefinition(partition_id=p, num_partitions=num_partitions),
+            mem_manager=mem_manager)
         tables.append(rt.collect())
     return pa.concat_tables(tables)

@@ -171,6 +171,15 @@ class TestPlannerExecution:
         got = _run_collect(op, num_partitions=4)
         assert sorted(got.column(0).to_pylist()) == list(range(100))
 
+    def test_sort_fetch_unset_means_no_limit(self):
+        # proto3 default fetch=0 must not be read as top-0 (review regression)
+        t = pa.table({"a": pa.array([3, 1, 2], pa.int64())})
+        sort = pb.PlanNode(sort=pb.SortNode(
+            child=pb.PlanNode(memory_scan=pb.MemoryScanNode(table_name="t")),
+            sort_orders=[serde.sort_order_to_proto(ir.SortOrder(ir.ColumnRef(0)))]))
+        op = PhysicalPlanner(PlannerContext(catalog={"t": t})).create_plan(sort)
+        assert _run_collect(op).column(0).to_pylist() == [1, 2, 3]
+
     def test_unknown_resource_raises(self):
         n = pb.PlanNode(ipc_reader=pb.IpcReaderNode(resource_id="nope"))
         with pytest.raises(KeyError):

@@ -1,0 +1,291 @@
+"""Memory manager + spill tests: serde round-trips, tiering, budget
+arbitration, and spilling operators producing bit-identical results to the
+in-memory path (the reference exercises the same via MemConsumer tests and
+fuzz comparisons, SURVEY.md §4)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+from auron_tpu.columnar.serde import (HostBatch, HostPrimitive, HostString,
+                                      batch_to_host, deserialize_batch,
+                                      deserialize_host_batch, host_to_batch,
+                                      serialize_batch, serialize_host_batch)
+from auron_tpu.exprs import ir
+from auron_tpu.io.parquet import MemoryScanOp
+from auron_tpu.memmgr import MemConsumer, MemManager, SpillManager
+from auron_tpu.ops.agg import AggOp
+from auron_tpu.ops.sort import SortOp
+from auron_tpu.runtime.executor import collect
+
+C = ir.ColumnRef
+
+
+def mem_scan(rbs, capacity=512):
+    if not isinstance(rbs, list):
+        rbs = [rbs]
+    return MemoryScanOp([rbs], schema_from_arrow(rbs[0].schema),
+                        capacity=capacity)
+
+
+# ---------------------------------------------------------------------------
+# batch serde
+# ---------------------------------------------------------------------------
+
+class TestBatchSerde:
+    def _rb(self):
+        return pa.record_batch({
+            "i": pa.array([1, None, 3, 4], pa.int64()),
+            "f": pa.array([1.5, 2.5, None, 4.5], pa.float64()),
+            "s": pa.array(["ab", "c", None, "defg"], pa.string()),
+        })
+
+    def test_roundtrip_device(self):
+        from auron_tpu.columnar.arrow_bridge import to_arrow, to_device
+        rb = self._rb()
+        batch, schema = to_device(rb, capacity=8)
+        data = serialize_batch(batch)
+        back = deserialize_batch(data, capacity=8)
+        rb2 = to_arrow(back, schema)
+        assert rb2.to_pydict() == rb.to_pydict()
+
+    def test_roundtrip_uncompressed(self):
+        from auron_tpu.columnar.arrow_bridge import to_arrow, to_device
+        rb = self._rb()
+        batch, schema = to_device(rb, capacity=8)
+        data = serialize_batch(batch, codec="none")
+        rb2 = to_arrow(deserialize_batch(data, capacity=8), schema)
+        assert rb2.to_pydict() == rb.to_pydict()
+
+    def test_extras_roundtrip(self):
+        host = HostBatch([HostPrimitive(np.arange(5, dtype=np.int64),
+                                        np.ones(5, bool))], 5)
+        words = np.arange(10, dtype=np.uint64).reshape(5, 2)
+        data = serialize_host_batch(host, extras={"order_words": words})
+        back, extras = deserialize_host_batch(data)
+        np.testing.assert_array_equal(extras["order_words"], words)
+        np.testing.assert_array_equal(back.columns[0].data, np.arange(5))
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError):
+            deserialize_host_batch(b"NOPE" + b"\x00" * 16)
+
+    def test_compression_shrinks(self):
+        host = HostBatch([HostPrimitive(np.zeros(100_000, np.int64),
+                                        np.ones(100_000, bool))], 100_000)
+        z = serialize_host_batch(host, codec="zstd")
+        raw = serialize_host_batch(host, codec="none")
+        assert len(z) < len(raw) // 10
+
+
+# ---------------------------------------------------------------------------
+# spill tiering
+# ---------------------------------------------------------------------------
+
+class TestSpillTiering:
+    def test_mem_tier(self):
+        mgr = SpillManager(host_budget_bytes=1 << 20)
+        s = mgr.new_spill()
+        s.write_frame(b"abc")
+        s.write_frame(b"defg")
+        s.finish()
+        assert list(s.frames()) == [b"abc", b"defg"]
+        assert list(s.frames()) == [b"abc", b"defg"]  # repeatable
+        assert mgr.host_used == 7
+        s.release()
+        assert mgr.host_used == 0
+
+    def test_disk_overflow(self, tmp_path):
+        mgr = SpillManager(host_budget_bytes=10, spill_dir=str(tmp_path))
+        s = mgr.new_spill()
+        s.write_frame(b"12345678")       # fits (8 <= 10)
+        s.write_frame(b"abcdefgh")       # overflows → whole spill to disk
+        s.finish()
+        assert s._path is not None and os.path.exists(s._path)
+        assert list(s.frames()) == [b"12345678", b"abcdefgh"]
+        assert mgr.host_used == 0        # all moved to disk
+        path = s._path
+        s.release()
+        assert not os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# budget arbitration
+# ---------------------------------------------------------------------------
+
+class _FakeConsumer(MemConsumer):
+    def __init__(self, name):
+        self.consumer_name = name
+        self.used = 0
+        self.spill_calls = 0
+
+    def mem_used(self):
+        return self.used
+
+    def spill(self):
+        self.spill_calls += 1
+        freed = self.used
+        self.used = 0
+        return freed
+
+
+class TestMemManager:
+    def test_under_budget_nothing(self):
+        mm = MemManager(total_bytes=1000, min_trigger=0)
+        c = _FakeConsumer("a")
+        mm.register_consumer(c)
+        assert mm.update_mem_used(c, 500) == "nothing"
+        assert c.spill_calls == 0
+
+    def test_over_budget_spills_requester(self):
+        mm = MemManager(total_bytes=1000, min_trigger=0)
+        c = _FakeConsumer("a")
+        mm.register_consumer(c)
+        c.used = 1500
+        assert mm.update_mem_used(c, 1500) == "spilled"
+        assert c.spill_calls == 1
+        assert mm.used_total == 0
+
+    def test_over_budget_spills_biggest(self):
+        mm = MemManager(total_bytes=1000, min_trigger=0)
+        small, big = _FakeConsumer("small"), _FakeConsumer("big")
+        mm.register_consumer(small)
+        mm.register_consumer(big)
+        big.used = 900
+        mm.update_mem_used(big, 900)
+        small.used = 200
+        # small is under fair share (500) → the big one is the victim
+        assert mm.update_mem_used(small, 200) == "spilled"
+        assert big.spill_calls == 1 and small.spill_calls == 0
+
+    def test_status(self):
+        mm = MemManager(total_bytes=100)
+        c = _FakeConsumer("x")
+        mm.register_consumer(c)
+        mm.update_mem_used(c, 42)
+        st = mm.status()
+        assert st["used"] == 42 and st["consumers"] == {"x": 42}
+
+
+# ---------------------------------------------------------------------------
+# external sort (spill + k-way merge) — differential vs in-mem path
+# ---------------------------------------------------------------------------
+
+def _tiny_mem_manager(tmp_path, budget=1):
+    """A manager whose budget forces a spill on every buffered batch."""
+    return MemManager(total_bytes=budget, min_trigger=0,
+                      spill_manager=SpillManager(host_budget_bytes=1 << 20,
+                                                 spill_dir=str(tmp_path)))
+
+
+class TestExternalSort:
+    def _data(self, n=5000, seed=3):
+        rng = np.random.default_rng(seed)
+        rb = pa.record_batch({
+            "k": pa.array(rng.integers(0, 40, n), pa.int64()),
+            "v": pa.array(np.where(rng.random(n) < 0.1, None,
+                                   rng.normal(size=n))),
+            "s": pa.array([None if rng.random() < 0.05 else
+                           f"row{int(x)}" for x in rng.integers(0, 500, n)]),
+        })
+        return [rb.slice(o, 500) for o in range(0, n, 500)]
+
+    @pytest.mark.parametrize("orders", [
+        [("k", True, True), ("v", True, True)],
+        [("s", False, False), ("k", True, True)],
+    ])
+    def test_matches_in_memory(self, tmp_path, orders):
+        rbs = self._data()
+        sort_orders = [
+            ir.SortOrder(C([rb for rb in rbs][0].schema.get_field_index(n)),
+                         ascending=asc, nulls_first=nf)
+            for (n, asc, nf) in orders]
+
+        plain = collect(SortOp(mem_scan(rbs), sort_orders))
+        mm = _tiny_mem_manager(tmp_path)
+        spilled = collect(SortOp(mem_scan(rbs), sort_orders), mem_manager=mm)
+        assert mm.num_spills > 1  # external path actually ran
+        pd.testing.assert_frame_equal(plain.to_pandas(), spilled.to_pandas())
+
+    def test_fetch_with_spill(self, tmp_path):
+        rbs = self._data(2000)
+        so = [ir.SortOrder(C(0)), ir.SortOrder(C(1))]
+        plain = collect(SortOp(mem_scan(rbs), so, fetch=17))
+        mm = _tiny_mem_manager(tmp_path)
+        spilled = collect(SortOp(mem_scan(rbs), so, fetch=17), mem_manager=mm)
+        assert len(spilled) == 17
+        pd.testing.assert_frame_equal(plain.to_pandas(), spilled.to_pandas())
+
+
+# ---------------------------------------------------------------------------
+# agg spill — differential vs in-mem path
+# ---------------------------------------------------------------------------
+
+class TestAggSpill:
+    def test_external_victim_no_double_count(self, tmp_path):
+        """An agg victimized by *another* consumer's update must not
+        double-count: spills mid-merge are refused, spills between merges
+        take the state atomically (code-review regression)."""
+        rng = np.random.default_rng(1)
+        n = 2000
+        rb = pa.record_batch({
+            "k": pa.array(rng.integers(0, 50, n), pa.int64()),
+            "v": pa.array(rng.integers(0, 10, n), pa.int64()),
+        })
+        rbs = [rb.slice(o, 200) for o in range(0, n, 200)]
+        # big sort under the same manager keeps ramming the budget, making
+        # the agg the external victim repeatedly
+        from auron_tpu.ops.limit import UnionOp
+        agg = AggOp(mem_scan(rbs), [C(0)], [ir.AggFunction("sum", C(1))],
+                    group_names=["k"], agg_names=["s"])
+        mm = _tiny_mem_manager(tmp_path)
+        got = collect(agg, mem_manager=mm).to_pandas() \
+            .sort_values("k").reset_index(drop=True)
+        want = rb.to_pandas().groupby("k")["v"].sum().reset_index() \
+            .rename(columns={"v": "s"})
+        pd.testing.assert_frame_equal(got, want)
+
+    def test_spill_refused_mid_merge(self, tmp_path):
+        from auron_tpu.ops.agg import _AggSpillConsumer
+        from auron_tpu.ops.base import MetricsSet
+        mm = _tiny_mem_manager(tmp_path)
+        op = AggOp(mem_scan([pa.record_batch({"k": pa.array([1], pa.int64())})]),
+                   [C(0)], [ir.AggFunction("count_star")])
+        consumer = _AggSpillConsumer(op, mm, MetricsSet())
+        consumer.state = "sentinel-not-none"
+        consumer._merging = True
+        assert consumer.spill() == 0          # refused: state checked out
+        consumer._merging = False
+        consumer.state = None
+        assert consumer.spill() == 0          # nothing to spill
+        consumer.close()
+
+
+    def test_matches_in_memory(self, tmp_path):
+        rng = np.random.default_rng(7)
+        n = 4000
+        rb = pa.record_batch({
+            "k": pa.array(rng.integers(0, 300, n), pa.int64()),
+            "v": pa.array(np.where(rng.random(n) < 0.1, None,
+                                   rng.integers(-100, 100, n)).astype("float64")),
+        })
+        rbs = [rb.slice(o, 400) for o in range(0, n, 400)]
+        aggs = [ir.AggFunction("sum", C(1)), ir.AggFunction("count", C(1)),
+                ir.AggFunction("min", C(1)), ir.AggFunction("max", C(1)),
+                ir.AggFunction("avg", C(1))]
+
+        def build():
+            return AggOp(mem_scan(rbs), [C(0)], aggs,
+                         group_names=["k"],
+                         agg_names=["s", "c", "mn", "mx", "a"])
+
+        plain = collect(build()).to_pandas().sort_values("k").reset_index(drop=True)
+        mm = _tiny_mem_manager(tmp_path)
+        spilled = collect(build(), mem_manager=mm) \
+            .to_pandas().sort_values("k").reset_index(drop=True)
+        assert mm.num_spills > 1
+        pd.testing.assert_frame_equal(plain, spilled)
