@@ -98,15 +98,16 @@ class DeviceBatch:
         return DeviceBatch(tuple(self.columns[i] for i in indices), self.num_rows)
 
 
+def column_nbytes(col: Column) -> int:
+    """Device bytes held by one column (at capacity, incl. padding)."""
+    if isinstance(col, StringColumn):
+        return col.chars.nbytes + col.lens.nbytes + col.validity.nbytes
+    return col.data.nbytes + col.validity.nbytes
+
+
 def batch_nbytes(batch: DeviceBatch) -> int:
     """Device bytes held by the batch (at capacity, incl. padding)."""
-    total = 0
-    for c in batch.columns:
-        if isinstance(c, StringColumn):
-            total += c.chars.nbytes + c.lens.nbytes + c.validity.nbytes
-        else:
-            total += c.data.nbytes + c.validity.nbytes
-    return total
+    return sum(column_nbytes(c) for c in batch.columns)
 
 
 def mask_validity(batch: DeviceBatch) -> DeviceBatch:

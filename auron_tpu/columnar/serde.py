@@ -34,8 +34,23 @@ MAGIC = b"ATB1"
 CODEC_NONE = 0
 CODEC_ZSTD = 1
 
-_compressor = zstandard.ZstdCompressor(level=1)
-_decompressor = zstandard.ZstdDecompressor()
+# zstd contexts are not safe for concurrent use; spills may run from
+# multiple threads, so keep one per thread
+import threading
+
+_tls = threading.local()
+
+
+def _compressor() -> zstandard.ZstdCompressor:
+    if not hasattr(_tls, "c"):
+        _tls.c = zstandard.ZstdCompressor(level=1)
+    return _tls.c
+
+
+def _decompressor() -> zstandard.ZstdDecompressor:
+    if not hasattr(_tls, "d"):
+        _tls.d = zstandard.ZstdDecompressor()
+    return _tls.d
 
 
 # ---------------------------------------------------------------------------
@@ -162,13 +177,13 @@ def serialize_host_batch(host: HostBatch,
     for name, arr in extras.items():
         nb = name.encode()
         assert arr.ndim == 2 and arr.dtype == np.uint64, name
-        body.write(struct.pack("<BH", len(nb), arr.shape[1]))
+        body.write(struct.pack("<BIH", len(nb), arr.shape[0], arr.shape[1]))
         body.write(nb)
         _put_buf(body, arr)
 
     raw = body.getvalue()
     if codec == "zstd":
-        payload = _compressor.compress(raw)
+        payload = _compressor().compress(raw)
         code = CODEC_ZSTD
     else:
         payload, code = raw, CODEC_NONE
@@ -180,7 +195,7 @@ def deserialize_host_batch(data: bytes) -> tuple[HostBatch, dict[str, np.ndarray
         raise ValueError("bad batch frame magic")
     code, body_len = struct.unpack("<BI", data[4:9])
     payload = data[9:9 + body_len]
-    raw = _decompressor.decompress(payload) if code == CODEC_ZSTD else payload
+    raw = _decompressor().decompress(payload) if code == CODEC_ZSTD else payload
     src = io.BytesIO(raw)
     num_rows, num_cols, num_extras = struct.unpack("<IHH", src.read(8))
     cols: list[HostColumn] = []
@@ -200,9 +215,9 @@ def deserialize_host_batch(data: bytes) -> tuple[HostBatch, dict[str, np.ndarray
             cols.append(HostPrimitive(data_arr, val))
     extras: dict[str, np.ndarray] = {}
     for _ in range(num_extras):
-        name_len, words = struct.unpack("<BH", src.read(3))
+        name_len, rows, words = struct.unpack("<BIH", src.read(7))
         name = src.read(name_len).decode()
-        extras[name] = _get_buf(src, np.uint64, (num_rows, words))
+        extras[name] = _get_buf(src, np.uint64, (rows, words))
     return HostBatch(cols, num_rows), extras
 
 

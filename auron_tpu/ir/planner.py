@@ -181,7 +181,8 @@ class PhysicalPlanner:
             fns.append(WindowFunctionSpec(
                 kind=f.kind, fn=f.fn,
                 arg=serde.parse_expr(f.arg) if f.HasField("arg") else None,
-                offset=f.offset, default=default))
+                offset=f.offset if f.HasField("offset") else 1,
+                default=default))
         return WindowOp(
             self.create_plan(n.child),
             partition_by=[serde.parse_expr(e) for e in n.partition_by],

@@ -75,11 +75,8 @@ class MemManager:
         """Record ``c``'s usage; returns 'nothing' or 'spilled'. May invoke
         c.spill() (or the largest consumer's) synchronously."""
         with self._lock:
-            if c not in self._used:
-                self._used[c] = 0
             self._used[c] = used
             total_used = sum(self._used.values())
-            share = self.total // max(len(self._used), 1)
 
         if total_used <= self.total:
             return "nothing"

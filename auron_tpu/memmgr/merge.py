@@ -29,6 +29,26 @@ from auron_tpu.columnar.serde import (HostBatch, HostPrimitive, HostString,
                                       deserialize_host_batch)
 
 ORDER_WORDS_EXTRA = "order_words"
+#: per-key (word count, pad word) matrix — lets runs whose string keys
+#: landed in different width buckets merge correctly
+WORD_LAYOUT_EXTRA = "word_layout"
+
+
+def _expand_words(words: np.ndarray, layout: np.ndarray,
+                  target_counts: list[int]) -> np.ndarray:
+    """Align one run's word matrix to the merge-wide per-key word counts by
+    inserting each key's pad word for its missing trailing words (exactly
+    what the device kernel would have emitted at the wider bucket)."""
+    n = words.shape[0]
+    parts = []
+    pos = 0
+    for (cnt, pad), tgt in zip(layout.tolist(), target_counts):
+        cnt = int(cnt)
+        parts.append(words[:, pos:pos + cnt])
+        if tgt > cnt:
+            parts.append(np.full((n, tgt - cnt), np.uint64(pad), np.uint64))
+        pos += cnt
+    return np.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
 
 
 class _RunCursor:
@@ -38,6 +58,8 @@ class _RunCursor:
         self._frames = iter(frames)
         self.batch: Optional[HostBatch] = None
         self.words: Optional[np.ndarray] = None
+        self.layout: Optional[np.ndarray] = None
+        self.target_counts: Optional[list[int]] = None
         self.pos = 0
         self._advance()
 
@@ -47,11 +69,20 @@ class _RunCursor:
             if batch.num_rows == 0:
                 continue
             self.batch = batch
-            self.words = extras[ORDER_WORDS_EXTRA]
+            self.layout = extras[WORD_LAYOUT_EXTRA]
+            words = extras[ORDER_WORDS_EXTRA]
+            if self.target_counts is not None:
+                words = _expand_words(words, self.layout, self.target_counts)
+            self.words = words
             self.pos = 0
             return
         self.batch = None
         self.words = None
+
+    def align(self, target_counts: list[int]) -> None:
+        self.target_counts = target_counts
+        if self.words is not None:
+            self.words = _expand_words(self.words, self.layout, target_counts)
 
     @property
     def exhausted(self) -> bool:
@@ -131,6 +162,12 @@ def merge_sorted_runs(run_frames: list[Iterator[bytes]]) -> Iterator[HostBatch]:
     sorted HostBatches (one per merge round)."""
     cursors = [_RunCursor(f) for f in run_frames]
     cursors = [c for c in cursors if not c.exhausted]
+    if cursors:
+        n_keys = cursors[0].layout.shape[0]
+        target_counts = [max(int(c.layout[k, 0]) for c in cursors)
+                         for k in range(n_keys)]
+        for c in cursors:
+            c.align(target_counts)
 
     while cursors:
         if len(cursors) == 1:

@@ -207,16 +207,10 @@ def _merge_kernel(n_keys: int, acc_meta: tuple, out_cap: int):
 
 def _state_nbytes(state) -> int:
     """Device bytes of an accumulator state, from array metadata only."""
+    from auron_tpu.columnar.batch import column_nbytes
     keys, accs, _num_groups, _cap = state
-    total = 0
-    for k in keys:
-        if isinstance(k, StringColumn):
-            total += k.chars.nbytes + k.lens.nbytes + k.validity.nbytes
-        else:
-            total += k.data.nbytes + k.validity.nbytes
-    for a in accs:
-        total += a.nbytes
-    return total
+    return (sum(column_nbytes(k) for k in keys)
+            + sum(a.nbytes for a in accs))
 
 
 class _AggSpillConsumer:

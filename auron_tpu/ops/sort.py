@@ -121,6 +121,29 @@ def _sort_kernel(sort_exprs: tuple, in_schema: Schema, capacity: int):
     return kernel
 
 
+def key_word_layout(sort_exprs: tuple, in_schema: Schema,
+                    batch: DeviceBatch) -> list[tuple[int, int]]:
+    """Per sort key: (word count incl. null word, pad word). Word counts
+    depend on evaluated string widths, which are static per batch structure
+    — jax.eval_shape gets them without compute. The pad word is what the
+    kernel itself would emit for the missing trailing chars of a narrower
+    width bucket (0 for ascending, ~0 for descending), letting the spill
+    merge align runs whose strings landed in different buckets."""
+    ectx = EvalContext()
+    shapes = jax.eval_shape(
+        lambda b: tuple(evaluate(s.expr, b, in_schema, ectx).col
+                        for s in sort_exprs), batch)
+    layout = []
+    for s, col in zip(sort_exprs, shapes):
+        if isinstance(col, StringColumn):
+            n_value_words = (col.chars.shape[1] + 7) // 8
+        else:
+            n_value_words = 1
+        pad = 0 if s.ascending else (1 << 64) - 1
+        layout.append((1 + n_value_words, pad))
+    return layout
+
+
 @lru_cache(maxsize=256)
 def _sort_with_words_kernel(sort_exprs: tuple, in_schema: Schema,
                             capacity: int):
@@ -210,12 +233,6 @@ class _SortSpillConsumer:
         with self._lock:
             return self.bytes
 
-    def _sorted_run(self, buffered):
-        merged = _concat_all(buffered) if len(buffered) > 1 else buffered[0]
-        kern = _sort_with_words_kernel(self.op.sort_exprs, self.in_schema,
-                                       merged.capacity)
-        return kern(merged)
-
     def spill(self) -> int:
         import numpy as np
         from auron_tpu.columnar.serde import (batch_to_host,
@@ -227,7 +244,14 @@ class _SortSpillConsumer:
                 return 0
             buffered, self.buffered = self.buffered, []
             freed, self.bytes = self.bytes, 0
-        run, words = self._sorted_run(buffered)
+        from auron_tpu.memmgr.merge import WORD_LAYOUT_EXTRA
+        merged = _concat_all(buffered) if len(buffered) > 1 else buffered[0]
+        layout = np.asarray(
+            key_word_layout(self.op.sort_exprs, self.in_schema, merged),
+            dtype=np.uint64)
+        kern = _sort_with_words_kernel(self.op.sort_exprs, self.in_schema,
+                                       merged.capacity)
+        run, words = kern(merged)
         n = int(run.num_rows)
         host = batch_to_host(run, n)
         host_words = np.asarray(words[:n])
@@ -236,7 +260,8 @@ class _SortSpillConsumer:
             hi = min(lo + self.frame_rows, n)
             spill.write_frame(serialize_host_batch(
                 slice_host_batch(host, lo, hi),
-                extras={ORDER_WORDS_EXTRA: host_words[lo:hi]}))
+                extras={ORDER_WORDS_EXTRA: host_words[lo:hi],
+                        WORD_LAYOUT_EXTRA: layout}))
         with self._lock:
             self.spills.append(spill.finish())
         self.metrics.counter("mem_spill_count").add(1)

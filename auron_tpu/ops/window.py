@@ -1,0 +1,367 @@
+"""Window operator.
+
+Reference: datafusion-ext-plans/src/window_exec.rs + window/processors/*
+(rank, row_number, dense_rank, lead/lag, nth_value, percent_rank, cume_dist,
+agg-over-window) and the window-group-limit pushdown (auron.proto:590-593).
+
+TPU design: the reference streams rows through per-partition processor state
+(a sequential scan). Sequential row processing is hostile to a vector
+machine, so here the whole operator is one data-parallel kernel over the
+sorted partition:
+
+  sort by (partition keys, order keys)           — reuses the sort kernels
+  → segment-boundary flags via neighbor equality  — one vector compare
+  → every window function is a closed-form gather / segmented scan over
+    positions (row_number = pos - seg_start + 1, rank via cummax of
+    order-boundary positions, running aggs via segmented prefix scans with
+    jax.lax.associative_scan, lead/lag via shifted gathers)
+
+Aggregates use Spark's default frame semantics: with ORDER BY, RANGE
+UNBOUNDED PRECEDING..CURRENT ROW (peer rows share the value at their tie
+group's end); without ORDER BY, the whole partition.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+from auron_tpu.columnar.batch import (DeviceBatch, PrimitiveColumn,
+                                      StringColumn, gather_batch)
+from auron_tpu.columnar.schema import DataType, Field, Schema
+from auron_tpu.exprs import ir
+from auron_tpu.exprs.eval import EvalContext, evaluate, infer_dtype
+from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
+from auron_tpu.ops.sort import _concat_all, sort_permutation
+
+RANK_LIKE = ("row_number", "rank", "dense_rank", "percent_rank",
+             "cume_dist", "ntile")
+OFFSET_FNS = ("lead", "lag", "nth_value", "first_value", "last_value")
+AGG_FNS = ("sum", "count", "count_star", "avg", "min", "max")
+
+
+@dataclass(frozen=True)
+class WindowFunctionSpec:
+    kind: str                      # rank_like | offset | agg
+    fn: str
+    arg: Optional[ir.Expr] = None
+    offset: int = 1                # lead/lag distance, nth n, ntile buckets
+    default: object = None         # lead/lag default value
+
+    def __post_init__(self):
+        if self.kind == "rank_like":
+            assert self.fn in RANK_LIKE, self.fn
+        elif self.kind == "offset":
+            assert self.fn in OFFSET_FNS, self.fn
+        elif self.kind == "agg":
+            assert self.fn in AGG_FNS, self.fn
+        else:
+            raise ValueError(self.kind)
+
+
+# ---------------------------------------------------------------------------
+# segment machinery
+# ---------------------------------------------------------------------------
+
+def _col_neq_prev(col) -> jax.Array:
+    """bool[cap]: row i differs from row i-1 (null-aware; row 0 => True)."""
+    if isinstance(col, StringColumn):
+        same_chars = jnp.all(col.chars[1:] == col.chars[:-1], axis=1)
+        same = same_chars & (col.lens[1:] == col.lens[:-1])
+    else:
+        same = col.data[1:] == col.data[:-1]
+    both_null = (~col.validity[1:]) & (~col.validity[:-1])
+    both_valid = col.validity[1:] & col.validity[:-1]
+    eq = jnp.where(both_null, True, both_valid & same)
+    return jnp.concatenate([jnp.ones(1, bool), ~eq])
+
+
+def _segmented_cummax_pos(flags: jax.Array) -> jax.Array:
+    """For each row, the last position <= i where flags was True."""
+    cap = flags.shape[0]
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    return jax.lax.cummax(jnp.where(flags, pos, -1))
+
+
+def _segmented_scan(values, seg_new: jax.Array, combine):
+    """Inclusive segmented prefix scan: resets at seg_new."""
+    def op(a, b):
+        fa, va = a
+        fb, vb = b
+        return (fa | fb, jnp.where(fb, vb, combine(va, vb)))
+
+    _, out = jax.lax.associative_scan(op, (seg_new, values))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+def _result_field(spec: WindowFunctionSpec, name: str,
+                  in_schema: Schema) -> Field:
+    if spec.kind == "rank_like":
+        if spec.fn in ("percent_rank", "cume_dist"):
+            return Field(name, DataType.FLOAT64, False)
+        return Field(name, DataType.INT64, False)
+    if spec.kind == "offset":
+        dt, p, s = infer_dtype(spec.arg, in_schema)
+        return Field(name, dt, True, p, s)
+    # agg
+    if spec.fn in ("count", "count_star"):
+        return Field(name, DataType.INT64, False)
+    dt, p, s = infer_dtype(spec.arg, in_schema)
+    if spec.fn == "avg" and dt != DataType.FLOAT64 and dt != DataType.DECIMAL:
+        dt = DataType.FLOAT64
+    return Field(name, dt, True, p, s)
+
+
+@lru_cache(maxsize=128)
+def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
+                   in_schema: Schema, capacity: int, group_limit):
+    n_funcs = len(fn_specs)
+
+    @jax.jit
+    def kernel(batch: DeviceBatch):
+        ectx = EvalContext()
+        pcols = [evaluate(e, batch, in_schema, ectx).col
+                 for e in partition_exprs]
+        ocols = [evaluate(o.expr, batch, in_schema, ectx).col
+                 for o in order_by]
+        key_cols = pcols + ocols
+        orders = ([(True, True)] * len(pcols) +
+                  [(o.ascending, o.nulls_first) for o in order_by])
+        if key_cols:
+            perm = sort_permutation(batch, key_cols, orders)
+        else:
+            perm = jnp.arange(batch.capacity, dtype=jnp.int32)
+        sbatch = gather_batch(batch, perm, batch.num_rows)
+        cap = sbatch.capacity
+        live = sbatch.row_mask()
+        pos = jnp.arange(cap, dtype=jnp.int32)
+        n = sbatch.num_rows
+
+        def sorted_col(c):
+            if isinstance(c, StringColumn):
+                return StringColumn(c.chars[perm], c.lens[perm],
+                                    c.validity[perm])
+            return PrimitiveColumn(c.data[perm], c.validity[perm])
+
+        spcols = [sorted_col(c) for c in pcols]
+        socols = [sorted_col(c) for c in ocols]
+
+        # partition segment boundaries
+        if spcols:
+            seg_new = jnp.zeros(cap, bool)
+            for c in spcols:
+                seg_new = seg_new | _col_neq_prev(c)
+            seg_new = seg_new.at[0].set(True)
+        else:
+            seg_new = jnp.zeros(cap, bool).at[0].set(True)
+        # order-key (peer group) boundaries
+        tie_new = seg_new
+        for c in socols:
+            tie_new = tie_new | _col_neq_prev(c)
+
+        seg_start = _segmented_cummax_pos(seg_new)
+        # segment end: next seg_new position - 1 (live rows only)
+        next_new_rev = _segmented_cummax_pos(jnp.flip(seg_new))
+        # position (from the right) of the next boundary at or before i in
+        # flipped space → convert back: for row i, start of *next* segment
+        seg_id = jnp.cumsum(seg_new.astype(jnp.int32)) - 1
+        n_segs = seg_id[jnp.maximum(n - 1, 0)] + 1
+        # end of each row's segment: last live row with same seg_id.
+        # compute per-segment end via scatter-max of positions
+        seg_end = jax.ops.segment_max(
+            jnp.where(live, pos, -1), jnp.clip(seg_id, 0, cap - 1),
+            num_segments=cap)
+        seg_end_row = seg_end[jnp.clip(seg_id, 0, cap - 1)]
+        npart = (seg_end_row - seg_start + 1).astype(jnp.int64)
+
+        # peer (tie) group end: last row with same (segment, order keys)
+        tie_id = jnp.cumsum(tie_new.astype(jnp.int32)) - 1
+        tie_end = jax.ops.segment_max(
+            jnp.where(live, pos, -1), jnp.clip(tie_id, 0, cap - 1),
+            num_segments=cap)
+        tie_end_row = tie_end[jnp.clip(tie_id, 0, cap - 1)]
+
+        row_number = (pos - seg_start + 1).astype(jnp.int64)
+        rank = (_segmented_cummax_pos(tie_new) - seg_start + 1).astype(jnp.int64)
+        dense_rank = _segmented_scan(
+            tie_new.astype(jnp.int64), seg_new, jnp.add)
+
+        out_cols = []
+        for spec in fn_specs:
+            if spec.kind == "rank_like":
+                if spec.fn == "row_number":
+                    data = row_number
+                elif spec.fn == "rank":
+                    data = rank
+                elif spec.fn == "dense_rank":
+                    data = dense_rank
+                elif spec.fn == "percent_rank":
+                    data = jnp.where(npart > 1,
+                                     (rank - 1).astype(jnp.float64)
+                                     / jnp.maximum(npart - 1, 1), 0.0)
+                elif spec.fn == "cume_dist":
+                    data = (tie_end_row - seg_start + 1).astype(jnp.float64) \
+                        / jnp.maximum(npart, 1)
+                elif spec.fn == "ntile":
+                    k = spec.offset
+                    q, r = npart // k, npart % k
+                    rn0 = row_number - 1
+                    cutoff = (q + 1) * r
+                    in_big = rn0 < cutoff
+                    data = jnp.where(
+                        in_big, rn0 // jnp.maximum(q + 1, 1) + 1,
+                        r + (rn0 - cutoff) // jnp.maximum(q, 1) + 1)
+                out_cols.append(PrimitiveColumn(data, live))
+                continue
+
+            v = evaluate(spec.arg, sbatch, in_schema, ectx) \
+                if spec.arg is not None else None
+
+            if spec.kind == "offset":
+                col = v.col
+                if spec.fn in ("lead", "lag"):
+                    delta = spec.offset if spec.fn == "lead" else -spec.offset
+                    src = pos + delta
+                    in_seg = (src >= seg_start) & (src <= seg_end_row)
+                    src_c = jnp.clip(src, 0, cap - 1)
+                elif spec.fn == "first_value":
+                    src_c, in_seg = seg_start, live
+                elif spec.fn == "last_value":
+                    # default frame: up to current peer group end
+                    src_c = tie_end_row if order_by else seg_end_row
+                    in_seg = live
+                else:  # nth_value (frame-clipped like last_value)
+                    src = seg_start + (spec.offset - 1)
+                    bound = tie_end_row if order_by else seg_end_row
+                    in_seg = (src <= bound) & live
+                    src_c = jnp.clip(src, 0, cap - 1)
+                if isinstance(col, StringColumn):
+                    out = StringColumn(
+                        col.chars[src_c],
+                        jnp.where(in_seg, col.lens[src_c], 0),
+                        col.validity[src_c] & in_seg & live)
+                else:
+                    data = col.data[src_c]
+                    valid = col.validity[src_c] & in_seg & live
+                    if spec.default is not None and spec.fn in ("lead", "lag"):
+                        data = jnp.where(in_seg, data,
+                                         jnp.asarray(spec.default, data.dtype))
+                        valid = jnp.where(in_seg, valid, live)
+                    out = PrimitiveColumn(data, valid)
+                out_cols.append(out)
+                continue
+
+            # agg over window
+            if spec.fn == "count_star":
+                run = _segmented_scan(live.astype(jnp.int64), seg_new, jnp.add)
+                valid = live
+            elif spec.fn == "count":
+                run = _segmented_scan((v.validity & live).astype(jnp.int64),
+                                      seg_new, jnp.add)
+                valid = live
+            elif spec.fn in ("sum", "avg"):
+                vals = jnp.where(v.validity & live, v.col.data, 0)
+                if jnp.issubdtype(vals.dtype, jnp.integer):
+                    vals = vals.astype(jnp.int64)
+                run = _segmented_scan(vals, seg_new, jnp.add)
+                has = _segmented_scan((v.validity & live).astype(jnp.int64),
+                                      seg_new, jnp.add)
+                if spec.fn == "avg":
+                    run = run.astype(jnp.float64) / jnp.maximum(has, 1)
+                valid = has > 0
+            else:  # min / max
+                big = jnp.asarray(
+                    jnp.finfo(v.col.data.dtype).max
+                    if jnp.issubdtype(v.col.data.dtype, jnp.floating)
+                    else jnp.iinfo(v.col.data.dtype).max, v.col.data.dtype)
+                neutral = big if spec.fn == "min" else (
+                    -big if jnp.issubdtype(v.col.data.dtype, jnp.floating)
+                    else jnp.asarray(
+                        jnp.iinfo(v.col.data.dtype).min, v.col.data.dtype))
+                vals = jnp.where(v.validity & live, v.col.data, neutral)
+                run = _segmented_scan(
+                    vals, seg_new,
+                    jnp.minimum if spec.fn == "min" else jnp.maximum)
+                has = _segmented_scan((v.validity & live).astype(jnp.int64),
+                                      seg_new, jnp.add)
+                valid = has > 0
+            if order_by:
+                # peers share the value at their tie group's end
+                run = run[jnp.clip(tie_end_row, 0, cap - 1)]
+                valid = valid[jnp.clip(tie_end_row, 0, cap - 1)] & live
+            else:
+                run = run[jnp.clip(seg_end_row, 0, cap - 1)]
+                valid = valid[jnp.clip(seg_end_row, 0, cap - 1)] & live
+            out_cols.append(PrimitiveColumn(run, valid))
+
+        result = DeviceBatch(tuple(sbatch.columns) + tuple(out_cols), n)
+        if group_limit is not None:
+            from auron_tpu.columnar.batch import compact
+            keep = (rank <= group_limit) & live
+            result = compact(result, keep)
+        return result
+
+    return kernel
+
+
+# ---------------------------------------------------------------------------
+# operator
+# ---------------------------------------------------------------------------
+
+class WindowOp(PhysicalOp):
+    name = "window"
+
+    def __init__(self, child: PhysicalOp, partition_by: list[ir.Expr],
+                 order_by: list[ir.SortOrder],
+                 functions: list[WindowFunctionSpec],
+                 output_names: Optional[list[str]] = None,
+                 group_limit: Optional[int] = None):
+        self.child = child
+        self.partition_by = tuple(partition_by)
+        self.order_by = tuple(order_by)
+        self.functions = tuple(functions)
+        self.group_limit = group_limit
+        names = output_names or [f"w{i}" for i in range(len(functions))]
+        self.output_names = list(names)
+        in_schema = child.schema()
+        extra = [_result_field(spec, n, in_schema)
+                 for spec, n in zip(self.functions, names)]
+        self._schema = Schema(tuple(in_schema.fields) + tuple(extra))
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        metrics = ctx.metrics_for(self.name)
+        elapsed = metrics.counter("elapsed_compute")
+        in_schema = self.child.schema()
+
+        def stream():
+            batches = list(self.child.execute(partition, ctx))
+            if not batches:
+                return
+            with timer(elapsed):
+                merged = _concat_all(batches) if len(batches) > 1 else batches[0]
+                kern = _window_kernel(self.partition_by, self.order_by,
+                                      self.functions, in_schema,
+                                      merged.capacity, self.group_limit)
+                yield kern(merged)
+
+        return count_output(stream(), metrics)
+
+    def __repr__(self):
+        fns = ",".join(s.fn for s in self.functions)
+        return (f"WindowOp[{fns} partition_by={len(self.partition_by)} "
+                f"order_by={len(self.order_by)}]")

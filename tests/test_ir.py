@@ -171,6 +171,19 @@ class TestPlannerExecution:
         got = _run_collect(op, num_partitions=4)
         assert sorted(got.column(0).to_pylist()) == list(range(100))
 
+    def test_window_plan(self):
+        t = pa.table({"g": pa.array([1, 1, 2, 2, 2], pa.int64()),
+                      "o": pa.array([2, 1, 3, 1, 2], pa.int64())})
+        win = pb.PlanNode(window=pb.WindowNode(
+            child=pb.PlanNode(memory_scan=pb.MemoryScanNode(table_name="t")),
+            partition_by=[serde.expr_to_proto(ir.ColumnRef(0))],
+            order_by=[serde.sort_order_to_proto(ir.SortOrder(ir.ColumnRef(1)))],
+            functions=[pb.WindowFunctionP(kind="rank_like", fn="row_number")],
+            output_names=["rn"]))
+        op = PhysicalPlanner(PlannerContext(catalog={"t": t})).create_plan(win)
+        got = _run_collect(op)
+        assert got.column("rn").to_pylist() == [1, 2, 1, 2, 3]
+
     def test_sort_fetch_unset_means_no_limit(self):
         # proto3 default fetch=0 must not be read as top-0 (review regression)
         t = pa.table({"a": pa.array([3, 1, 2], pa.int64())})

@@ -211,6 +211,26 @@ class TestExternalSort:
         assert mm.num_spills > 1  # external path actually ran
         pd.testing.assert_frame_equal(plain.to_pandas(), spilled.to_pandas())
 
+    def test_cross_bucket_string_widths(self, tmp_path):
+        """Spill runs whose string keys land in different width buckets must
+        still merge (word matrices aligned via the layout extra) — one run
+        gets short strings, a later one long strings (code-review
+        regression)."""
+        short = pa.record_batch({"s": pa.array(
+            [f"a{i}" for i in range(300)], pa.string())})
+        long = pa.record_batch({"s": pa.array(
+            [f"b-very-long-string-{i:040d}" for i in range(300)],
+            pa.string())})
+        for orders in ([ir.SortOrder(C(0), ascending=True)],
+                       [ir.SortOrder(C(0), ascending=False)]):
+            plain = collect(SortOp(mem_scan([short, long]), orders))
+            mm = _tiny_mem_manager(tmp_path)
+            spilled = collect(SortOp(mem_scan([short, long]), orders),
+                              mem_manager=mm)
+            assert mm.num_spills > 1
+            pd.testing.assert_frame_equal(plain.to_pandas(),
+                                          spilled.to_pandas())
+
     def test_fetch_with_spill(self, tmp_path):
         rbs = self._data(2000)
         so = [ir.SortOrder(C(0)), ir.SortOrder(C(1))]
@@ -227,9 +247,9 @@ class TestExternalSort:
 
 class TestAggSpill:
     def test_external_victim_no_double_count(self, tmp_path):
-        """An agg victimized by *another* consumer's update must not
-        double-count: spills mid-merge are refused, spills between merges
-        take the state atomically (code-review regression)."""
+        """An agg spilled as the *victim of another consumer's* update (the
+        dangerous window between merges) must not double-count groups on
+        emit (code-review regression)."""
         rng = np.random.default_rng(1)
         n = 2000
         rb = pa.record_batch({
@@ -237,14 +257,36 @@ class TestAggSpill:
             "v": pa.array(rng.integers(0, 10, n), pa.int64()),
         })
         rbs = [rb.slice(o, 200) for o in range(0, n, 200)]
-        # big sort under the same manager keeps ramming the budget, making
-        # the agg the external victim repeatedly
-        from auron_tpu.ops.limit import UnionOp
-        agg = AggOp(mem_scan(rbs), [C(0)], [ir.AggFunction("sum", C(1))],
+        mm = MemManager(total_bytes=1 << 16, min_trigger=0,
+                        spill_manager=SpillManager(spill_dir=str(tmp_path)))
+
+        # an unspillable consumer that rams the budget between every batch
+        # the agg pulls, forcing the manager to pick the agg as victim
+        class _Rammer(MemConsumer):
+            consumer_name = "rammer"
+
+            def mem_used(self):
+                return 1 << 20
+
+            def spill(self):
+                return 0
+
+        rammer = _Rammer()
+        mm.register_consumer(rammer)
+        scan = mem_scan(rbs)
+        orig_execute = scan.execute
+
+        def ramming_execute(partition, ctx):
+            for b in orig_execute(partition, ctx):
+                yield b
+                mm.update_mem_used(rammer, 1 << 20)  # external pressure
+
+        scan.execute = ramming_execute
+        agg = AggOp(scan, [C(0)], [ir.AggFunction("sum", C(1))],
                     group_names=["k"], agg_names=["s"])
-        mm = _tiny_mem_manager(tmp_path)
         got = collect(agg, mem_manager=mm).to_pandas() \
             .sort_values("k").reset_index(drop=True)
+        assert mm.num_spills > 1  # the agg really was victimized repeatedly
         want = rb.to_pandas().groupby("k")["v"].sum().reset_index() \
             .rename(columns={"v": "s"})
         pd.testing.assert_frame_equal(got, want)

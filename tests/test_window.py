@@ -1,0 +1,235 @@
+"""Window operator tests — differential vs pandas (the reference cross-checks
+its processors against Spark's own window suites, SURVEY.md §4)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+from auron_tpu.exprs import ir
+from auron_tpu.io.parquet import MemoryScanOp
+from auron_tpu.ops.window import WindowFunctionSpec, WindowOp
+from auron_tpu.runtime.executor import collect
+
+C = ir.ColumnRef
+
+
+def mem_scan(rbs, capacity=512):
+    if not isinstance(rbs, list):
+        rbs = [rbs]
+    return MemoryScanOp([rbs], schema_from_arrow(rbs[0].schema),
+                        capacity=capacity)
+
+
+def _data(n=500, seed=0, groups=8, unique_order=False):
+    rng = np.random.default_rng(seed)
+    order = (rng.permutation(n).astype("int64") if unique_order
+             else rng.integers(0, 40, n))
+    return pa.record_batch({
+        "g": pa.array(rng.integers(0, groups, n), pa.int64()),
+        "o": pa.array(order, pa.int64()),
+        "v": pa.array([None if m else float(x) for m, x in
+                       zip(rng.random(n) < 0.1, rng.integers(-50, 50, n))],
+                      pa.float64()),
+    })
+
+
+def run_window(rb, functions, partition_by=("g",), order_by=("o",),
+               group_limit=None, capacity=512):
+    names = [f"w{i}" for i in range(len(functions))]
+    op = WindowOp(
+        mem_scan(rb, capacity=capacity),
+        partition_by=[C(rb.schema.get_field_index(c)) for c in partition_by],
+        order_by=[ir.SortOrder(C(rb.schema.get_field_index(c)))
+                  for c in order_by],
+        functions=functions, output_names=names, group_limit=group_limit)
+    return collect(op).to_pandas()
+
+
+class TestRankFamily:
+    def test_row_number_rank_dense_rank(self):
+        rb = _data()
+        got = run_window(rb, [
+            WindowFunctionSpec("rank_like", "row_number"),
+            WindowFunctionSpec("rank_like", "rank"),
+            WindowFunctionSpec("rank_like", "dense_rank"),
+        ])
+        df = got[["g", "o"]].copy()
+        want_rn = df.groupby("g").cumcount() + 1          # got is sorted
+        want_rank = df.groupby("g")["o"].rank(method="min").astype("int64")
+        want_dense = df.groupby("g")["o"].rank(method="dense").astype("int64")
+        np.testing.assert_array_equal(got["w0"], want_rn)
+        np.testing.assert_array_equal(got["w1"], want_rank)
+        np.testing.assert_array_equal(got["w2"], want_dense)
+
+    def test_percent_rank_cume_dist(self):
+        rb = _data(300, seed=1)
+        got = run_window(rb, [
+            WindowFunctionSpec("rank_like", "percent_rank"),
+            WindowFunctionSpec("rank_like", "cume_dist"),
+        ])
+        df = got[["g", "o"]]
+        grp = df.groupby("g")["o"]
+        want_pr = (grp.rank(method="min") - 1) / \
+            (grp.transform("count") - 1).clip(lower=1)
+        want_cd = grp.rank(method="max") / grp.transform("count")
+        np.testing.assert_allclose(got["w0"], want_pr)
+        np.testing.assert_allclose(got["w1"], want_cd)
+
+    def test_ntile(self):
+        rb = _data(100, seed=2, groups=3, unique_order=True)
+        got = run_window(rb, [WindowFunctionSpec("rank_like", "ntile",
+                                                 offset=4)])
+        for _, part in got.groupby("g"):
+            n = len(part)
+            q, r = divmod(n, 4)
+            sizes = [q + 1] * r + [q] * (4 - r)
+            counts = part["w0"].value_counts().sort_index()
+            want = {i + 1: s for i, s in enumerate(sizes) if s}
+            assert counts.to_dict() == want
+
+    def test_group_limit(self):
+        rb = _data(400, seed=3)
+        got = run_window(rb, [WindowFunctionSpec("rank_like", "rank")],
+                         group_limit=3)
+        assert (got["w0"] <= 3).all()
+        # every partition keeps all rank<=3 rows
+        full = run_window(rb, [WindowFunctionSpec("rank_like", "rank")])
+        want = full[full["w0"] <= 3]
+        assert len(got) == len(want)
+
+
+class TestOffsetFamily:
+    def test_lead_lag(self):
+        rb = _data(300, seed=4, unique_order=True)
+        got = run_window(rb, [
+            WindowFunctionSpec("offset", "lead", arg=C(2), offset=1),
+            WindowFunctionSpec("offset", "lag", arg=C(2), offset=2),
+        ])
+        g = got.groupby("g")["v"]
+        pd.testing.assert_series_equal(got["w0"], g.shift(-1),
+                                       check_names=False)
+        pd.testing.assert_series_equal(got["w1"], g.shift(2),
+                                       check_names=False)
+
+    def test_lead_default(self):
+        rb = _data(100, seed=5, unique_order=True)
+        got = run_window(rb, [
+            WindowFunctionSpec("offset", "lead", arg=C(1), offset=1,
+                               default=-999)])
+        g = got.groupby("g")["o"]
+        want = g.shift(-1).fillna(-999).astype("int64")
+        np.testing.assert_array_equal(got["w0"], want)
+
+    def test_first_last_nth(self):
+        rb = _data(200, seed=6, unique_order=True)
+        got = run_window(rb, [
+            WindowFunctionSpec("offset", "first_value", arg=C(1)),
+            WindowFunctionSpec("offset", "last_value", arg=C(1)),
+            WindowFunctionSpec("offset", "nth_value", arg=C(1), offset=2),
+        ])
+        g = got.groupby("g")["o"]
+        np.testing.assert_array_equal(got["w0"], g.transform("first"))
+        # default frame: last_value == current row's o (unique order keys)
+        np.testing.assert_array_equal(got["w1"], got["o"])
+        # nth=2: null on the first row of each partition, else 2nd value
+        second = g.transform(lambda s: s.iloc[1] if len(s) > 1 else np.nan)
+        rn = got.groupby("g").cumcount()
+        want = np.where(rn >= 1, second, np.nan)
+        np.testing.assert_array_equal(got["w2"].to_numpy(dtype="float64"),
+                                      want)
+
+
+class TestAggOverWindow:
+    def test_running_sum_count_avg(self):
+        rb = _data(400, seed=7, unique_order=True)
+        got = run_window(rb, [
+            WindowFunctionSpec("agg", "sum", arg=C(2)),
+            WindowFunctionSpec("agg", "count", arg=C(2)),
+            WindowFunctionSpec("agg", "avg", arg=C(2)),
+        ])
+        g = got.groupby("g")["v"]
+        # SQL frame semantics: at a null row the running sum is the sum of
+        # the non-null values so far (null only while count==0) — pandas
+        # cumsum instead emits NaN at the null positions
+        cnt = g.transform(lambda s: s.notna().cumsum())
+        want_sum = g.transform(lambda s: s.fillna(0).cumsum()).where(cnt > 0)
+        np.testing.assert_allclose(got["w0"], want_sum, equal_nan=True)
+        np.testing.assert_array_equal(got["w1"], cnt)
+        np.testing.assert_allclose(got["w2"], want_sum / cnt, equal_nan=True)
+
+    def test_running_min_max(self):
+        rb = _data(300, seed=8, unique_order=True)
+        got = run_window(rb, [
+            WindowFunctionSpec("agg", "min", arg=C(2)),
+            WindowFunctionSpec("agg", "max", arg=C(2)),
+        ])
+        g = got.groupby("g")["v"]
+        cnt = g.transform(lambda s: s.notna().cumsum())
+        want_min = g.transform(lambda s: s.fillna(np.inf).cummin()).where(cnt > 0)
+        want_max = g.transform(lambda s: s.fillna(-np.inf).cummax()).where(cnt > 0)
+        np.testing.assert_allclose(got["w0"], want_min, equal_nan=True)
+        np.testing.assert_allclose(got["w1"], want_max, equal_nan=True)
+
+    def test_whole_partition_agg_without_order(self):
+        rb = _data(200, seed=9)
+        got = run_window(rb, [WindowFunctionSpec("agg", "sum", arg=C(2))],
+                         order_by=())
+        g = got.groupby("g")["v"]
+        np.testing.assert_allclose(got["w0"], g.transform("sum"))
+
+    def test_range_frame_ties_share_value(self):
+        # RANGE frame: peer rows (equal order key) share the cumulative
+        # value at the end of their tie group
+        rb = pa.record_batch({
+            "g": pa.array([1, 1, 1, 1], pa.int64()),
+            "o": pa.array([10, 10, 20, 20], pa.int64()),
+            "v": pa.array([1.0, 2.0, 3.0, 4.0], pa.float64()),
+        })
+        got = run_window(rb, [WindowFunctionSpec("agg", "sum", arg=C(2))])
+        assert got["w0"].tolist() == [3.0, 3.0, 10.0, 10.0]
+
+    def test_count_star(self):
+        rb = _data(150, seed=10, unique_order=True)
+        got = run_window(rb, [WindowFunctionSpec("agg", "count_star")])
+        want = got.groupby("g").cumcount() + 1
+        np.testing.assert_array_equal(got["w0"], want)
+
+
+class TestEdges:
+    def test_empty_input(self):
+        rb = pa.record_batch({"g": pa.array([], pa.int64()),
+                              "o": pa.array([], pa.int64()),
+                              "v": pa.array([], pa.float64())})
+        got = run_window(rb, [WindowFunctionSpec("rank_like", "row_number")])
+        assert len(got) == 0
+
+    def test_single_partition_no_partition_by(self):
+        rb = _data(50, seed=11, unique_order=True)
+        got = run_window(rb, [WindowFunctionSpec("rank_like", "row_number")],
+                         partition_by=())
+        np.testing.assert_array_equal(got["w0"], np.arange(1, 51))
+
+    def test_multi_batch_input(self):
+        rb = _data(600, seed=12)
+        rbs = [rb.slice(o, 100) for o in range(0, 600, 100)]
+        got_multi = run_window(rbs[0], [WindowFunctionSpec("rank_like", "rank")])
+        op = WindowOp(mem_scan(rbs, capacity=128),
+                      [C(0)], [ir.SortOrder(C(1))],
+                      [WindowFunctionSpec("rank_like", "rank")],
+                      output_names=["w0"])
+        got = collect(op).to_pandas()
+        df = got[["g", "o"]]
+        want = df.groupby("g")["o"].rank(method="min").astype("int64")
+        np.testing.assert_array_equal(got["w0"], want)
+
+    def test_string_partition_keys(self):
+        rb = pa.record_batch({
+            "g": pa.array(["a", "b", "a", None, "b", None], pa.string()),
+            "o": pa.array([1, 2, 3, 4, 5, 6], pa.int64()),
+        })
+        got = run_window(rb, [WindowFunctionSpec("rank_like", "row_number")])
+        df = got.to_dict("list")
+        # null group sorts first (nulls_first), then 'a', then 'b'
+        assert df["w0"] == [1, 2, 1, 2, 1, 2]
