@@ -14,7 +14,8 @@ import pyarrow as pa
 
 import jax.numpy as jnp
 
-from auron_tpu.columnar.batch import DeviceBatch, PrimitiveColumn, StringColumn
+from auron_tpu.columnar.batch import (DeviceBatch, ListColumn,
+                                      PrimitiveColumn, StringColumn)
 from auron_tpu.columnar.schema import DataType, Field, Schema
 from auron_tpu.utils.shapes import bucket_rows, bucket_string_width
 
@@ -52,6 +53,11 @@ def schema_from_arrow(sch: pa.Schema) -> Schema:
             fields.append(Field(f.name, _PA_TO_DT[t], f.nullable))
         elif pa.types.is_timestamp(t):
             fields.append(Field(f.name, DataType.TIMESTAMP_US, f.nullable))
+        elif pa.types.is_list(t) or pa.types.is_large_list(t):
+            elem = _PA_TO_DT.get(t.value_type)
+            if elem is None or elem in (DataType.STRING, DataType.NULL):
+                raise NotImplementedError(f"list of {t.value_type}")
+            fields.append(Field(f.name, DataType.LIST, f.nullable, elem=elem))
         else:
             raise NotImplementedError(f"arrow type {t} not supported")
     return Schema(tuple(fields))
@@ -70,6 +76,8 @@ def schema_to_arrow(schema: Schema) -> pa.Schema:
             t = pa.timestamp("us")
         elif f.dtype == DataType.NULL:
             t = pa.null()
+        elif f.dtype == DataType.LIST:
+            t = pa.list_(pa.from_numpy_dtype(f.elem.to_np()))
         else:
             t = pa.from_numpy_dtype(f.dtype.to_np())
         out.append(pa.field(f.name, t, f.nullable))
@@ -112,6 +120,42 @@ def _string_arrays(arr: pa.Array, capacity: int, width: int | None):
     return chars, lens_full, validity
 
 
+def _list_arrays(arr: pa.Array, capacity: int, elem_np) -> tuple:
+    """Extract (values[cap, m], elem_valid[cap, m], lens[cap], validity[cap])
+    from a pyarrow list array via its offsets (no per-row Python)."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    arr = arr.cast(pa.list_(arr.type.value_type))
+    n = len(arr)
+    offsets = np.asarray(arr.offsets)[: n + 1]
+    child = arr.values
+    child_np = np.asarray(child.fill_null(0)).astype(elem_np)
+    child_valid = (~np.asarray(child.is_null()) if child.null_count
+                   else np.ones(len(child), bool))
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    validity = (~np.asarray(arr.is_null()) if arr.null_count
+                else np.ones(n, bool))
+    lens = np.where(validity, lens, 0)
+    m = max(int(lens.max()) if n else 0, 1)
+    values = np.zeros((capacity, m), elem_np)
+    elem_valid = np.zeros((capacity, m), bool)
+    if n:
+        col_idx = np.arange(m, dtype=np.int64)[None, :]
+        src = offsets[:-1, None].astype(np.int64) + col_idx
+        in_range = col_idx < lens[:, None]
+        src = np.clip(src, 0, max(len(child_np) - 1, 0))
+        if len(child_np) == 0:
+            child_np = np.zeros(1, elem_np)
+            child_valid = np.zeros(1, bool)
+        values[:n] = np.where(in_range, child_np[src], 0)
+        elem_valid[:n] = in_range & child_valid[src]
+    lens_full = np.zeros(capacity, np.int32)
+    lens_full[:n] = lens
+    validity_full = np.zeros(capacity, bool)
+    validity_full[:n] = validity
+    return values, elem_valid, lens_full, validity_full
+
+
 def to_device(rb: pa.RecordBatch, capacity: int | None = None,
               string_widths: dict[str, int] | None = None) -> tuple[DeviceBatch, Schema]:
     """Convert a pyarrow RecordBatch into a padded DeviceBatch."""
@@ -131,6 +175,12 @@ def to_device(rb: pa.RecordBatch, capacity: int | None = None,
             chars, lens, validity = _string_arrays(arr, cap, w)
             cols.append(StringColumn(jnp.asarray(chars), jnp.asarray(lens),
                                      jnp.asarray(validity)))
+            continue
+        if field.dtype == DataType.LIST:
+            values, ev, lens, validity = _list_arrays(arr, cap,
+                                                      field.elem.to_np())
+            cols.append(ListColumn(jnp.asarray(values), jnp.asarray(ev),
+                                   jnp.asarray(lens), jnp.asarray(validity)))
             continue
         np_dtype = field.dtype.to_np()
         validity = np.zeros(cap, bool)
@@ -183,6 +233,28 @@ def to_arrow(batch: DeviceBatch, schema: Schema) -> pa.RecordBatch:
                 n, pa.py_buffer(offsets.tobytes()), pa.py_buffer(flat.tobytes()),
                 pa.py_buffer(np.packbits(validity, bitorder="little").tobytes()),
                 int((~validity).sum())))
+            continue
+        if isinstance(col, ListColumn):
+            values = np.asarray(col.values[:n])
+            ev = np.asarray(col.elem_valid[:n])
+            lens = np.where(np.asarray(col.validity[:n]),
+                            np.asarray(col.lens[:n]), 0)
+            validity = np.asarray(col.validity[:n])
+            take = np.arange(col.max_elems)[None, :] < lens[:, None]
+            flat_vals = values[take]
+            flat_valid = ev[take]
+            child = pa.array(flat_vals,
+                             pa.from_numpy_dtype(field.elem.to_np()))
+            if not flat_valid.all():
+                child = _with_nulls(child, flat_valid)
+            offsets = np.zeros(n + 1, np.int32)
+            np.cumsum(lens, out=offsets[1:])
+            off_arr = pa.array(
+                [None if not v else int(o)
+                 for o, v in zip(offsets[:-1], validity)] + [int(offsets[-1])],
+                pa.int32()) if not validity.all() else \
+                pa.array(offsets, pa.int32())
+            arrays.append(pa.ListArray.from_arrays(off_arr, child))
             continue
         data = np.asarray(col.data[:n])
         validity = np.asarray(col.validity[:n])

@@ -63,7 +63,35 @@ class StringColumn:
         return replace(self, validity=validity)
 
 
-Column = Union[PrimitiveColumn, StringColumn]
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class ListColumn:
+    """Padded list-of-primitive column: values[capacity, max_elems] +
+    per-element validity + list lengths + row validity.
+
+    The device layout for Arrow list arrays (the reference's explode /
+    UserDefinedArray paths, reference: datafusion-ext-plans/src/generate/,
+    datafusion-ext-commons/src/uda.rs): offsets+child become a dense padded
+    matrix so explode is one mask+compact kernel."""
+
+    values: jax.Array      # [capacity, max_elems] primitive payload
+    elem_valid: jax.Array  # bool[capacity, max_elems]
+    lens: jax.Array        # int32[capacity]
+    validity: jax.Array    # bool[capacity]  (row null = whole list null)
+
+    @property
+    def capacity(self) -> int:
+        return self.values.shape[0]
+
+    @property
+    def max_elems(self) -> int:
+        return self.values.shape[1]
+
+    def with_validity(self, validity: jax.Array) -> "ListColumn":
+        return replace(self, validity=validity)
+
+
+Column = Union[PrimitiveColumn, StringColumn, ListColumn]
 
 
 @jax.tree_util.register_dataclass
@@ -102,6 +130,9 @@ def column_nbytes(col: Column) -> int:
     """Device bytes held by one column (at capacity, incl. padding)."""
     if isinstance(col, StringColumn):
         return col.chars.nbytes + col.lens.nbytes + col.validity.nbytes
+    if isinstance(col, ListColumn):
+        return (col.values.nbytes + col.elem_valid.nbytes
+                + col.lens.nbytes + col.validity.nbytes)
     return col.data.nbytes + col.validity.nbytes
 
 
@@ -129,6 +160,13 @@ def gather_column(col: Column, indices: jax.Array, valid: jax.Array) -> Column:
             lens=jnp.where(valid, col.lens[indices], 0),
             validity=col.validity[indices] & valid,
         )
+    if isinstance(col, ListColumn):
+        return ListColumn(
+            values=col.values[indices],
+            elem_valid=col.elem_valid[indices] & valid[:, None],
+            lens=jnp.where(valid, col.lens[indices], 0),
+            validity=col.validity[indices] & valid,
+        )
     return PrimitiveColumn(
         data=col.data[indices],
         validity=col.validity[indices] & valid,
@@ -147,12 +185,20 @@ def gather_batch(batch: DeviceBatch, indices: jax.Array, num_rows: jax.Array) ->
 
 
 def concat_columns(a: Column, b: Column) -> Column:
-    """Stack two columns (capacities add). String widths must match — callers
-    re-bucket beforehand."""
+    """Stack two columns (capacities add). String widths / list elem counts
+    must match — callers re-bucket beforehand."""
     if isinstance(a, StringColumn):
         assert isinstance(b, StringColumn) and a.width == b.width
         return StringColumn(
             chars=jnp.concatenate([a.chars, b.chars], axis=0),
+            lens=jnp.concatenate([a.lens, b.lens]),
+            validity=jnp.concatenate([a.validity, b.validity]),
+        )
+    if isinstance(a, ListColumn):
+        assert isinstance(b, ListColumn) and a.max_elems == b.max_elems
+        return ListColumn(
+            values=jnp.concatenate([a.values, b.values], axis=0),
+            elem_valid=jnp.concatenate([a.elem_valid, b.elem_valid], axis=0),
             lens=jnp.concatenate([a.lens, b.lens]),
             validity=jnp.concatenate([a.validity, b.validity]),
         )
@@ -197,6 +243,13 @@ def resize(batch: DeviceBatch, new_capacity: int) -> DeviceBatch:
                     lens=jnp.pad(c.lens, (0, pad)),
                     validity=jnp.pad(c.validity, (0, pad)),
                 )
+            if isinstance(c, ListColumn):
+                return ListColumn(
+                    values=jnp.pad(c.values, ((0, pad), (0, 0))),
+                    elem_valid=jnp.pad(c.elem_valid, ((0, pad), (0, 0))),
+                    lens=jnp.pad(c.lens, (0, pad)),
+                    validity=jnp.pad(c.validity, (0, pad)),
+                )
             return PrimitiveColumn(
                 data=jnp.pad(c.data, (0, pad)),
                 validity=jnp.pad(c.validity, (0, pad)),
@@ -204,6 +257,13 @@ def resize(batch: DeviceBatch, new_capacity: int) -> DeviceBatch:
         if isinstance(c, StringColumn):
             return StringColumn(
                 chars=c.chars[:new_capacity],
+                lens=c.lens[:new_capacity],
+                validity=c.validity[:new_capacity],
+            )
+        if isinstance(c, ListColumn):
+            return ListColumn(
+                values=c.values[:new_capacity],
+                elem_valid=c.elem_valid[:new_capacity],
                 lens=c.lens[:new_capacity],
                 validity=c.validity[:new_capacity],
             )

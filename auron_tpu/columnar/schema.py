@@ -26,6 +26,7 @@ class DataType(enum.Enum):
     TIMESTAMP_US = "timestamp_us"  # microseconds since epoch, int64 payload
     DECIMAL = "decimal"        # precision<=18 stored as scaled int64
     STRING = "string"
+    LIST = "list"              # list of primitives; element type in Field.elem
     NULL = "null"
 
     # ---- classification helpers -------------------------------------------
@@ -75,9 +76,12 @@ class Field:
     # decimal only
     precision: int = 0
     scale: int = 0
+    # list element type (dtype == LIST only)
+    elem: "DataType" = None
 
     def with_name(self, name: str) -> "Field":
-        return Field(name, self.dtype, self.nullable, self.precision, self.scale)
+        return Field(name, self.dtype, self.nullable, self.precision,
+                     self.scale, self.elem)
 
 
 @dataclass(frozen=True)

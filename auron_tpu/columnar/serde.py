@@ -28,7 +28,8 @@ from typing import Optional, Union
 import numpy as np
 import zstandard
 
-from auron_tpu.columnar.batch import DeviceBatch, PrimitiveColumn, StringColumn
+from auron_tpu.columnar.batch import (DeviceBatch, ListColumn,
+                                      PrimitiveColumn, StringColumn)
 
 MAGIC = b"ATB1"
 CODEC_NONE = 0
@@ -70,7 +71,15 @@ class HostString:
     validity: np.ndarray   # bool[n]
 
 
-HostColumn = Union[HostPrimitive, HostString]
+@dataclass
+class HostList:
+    values: np.ndarray     # [n, max_elems]
+    elem_valid: np.ndarray  # bool[n, max_elems]
+    lens: np.ndarray       # int32[n]
+    validity: np.ndarray   # bool[n]
+
+
+HostColumn = Union[HostPrimitive, HostString, HostList]
 
 
 @dataclass
@@ -84,6 +93,9 @@ class HostBatch:
         for c in self.columns:
             if isinstance(c, HostString):
                 total += c.chars.nbytes + c.lens.nbytes + c.validity.nbytes
+            elif isinstance(c, HostList):
+                total += (c.values.nbytes + c.elem_valid.nbytes
+                          + c.lens.nbytes + c.validity.nbytes)
             else:
                 total += c.data.nbytes + c.validity.nbytes
         return total
@@ -96,6 +108,9 @@ def slice_host_batch(host: HostBatch, lo: int, hi: int) -> HostBatch:
         if isinstance(c, HostString):
             cols.append(HostString(c.chars[lo:hi], c.lens[lo:hi],
                                    c.validity[lo:hi]))
+        elif isinstance(c, HostList):
+            cols.append(HostList(c.values[lo:hi], c.elem_valid[lo:hi],
+                                 c.lens[lo:hi], c.validity[lo:hi]))
         else:
             cols.append(HostPrimitive(c.data[lo:hi], c.validity[lo:hi]))
     return HostBatch(cols, hi - lo)
@@ -112,6 +127,10 @@ def batch_to_host(batch: DeviceBatch,
             cols.append(HostString(
                 np.asarray(c.chars[:n]), np.asarray(c.lens[:n]),
                 np.asarray(c.validity[:n])))
+        elif isinstance(c, ListColumn):
+            cols.append(HostList(
+                np.asarray(c.values[:n]), np.asarray(c.elem_valid[:n]),
+                np.asarray(c.lens[:n]), np.asarray(c.validity[:n])))
         else:
             cols.append(HostPrimitive(
                 np.asarray(c.data[:n]), np.asarray(c.validity[:n])))
@@ -133,6 +152,13 @@ def host_to_batch(host: HostBatch, capacity: Optional[int] = None) -> DeviceBatc
             val = np.pad(c.validity, (0, pad)) if pad else c.validity
             cols.append(StringColumn(jnp.asarray(chars), jnp.asarray(lens),
                                      jnp.asarray(val)))
+        elif isinstance(c, HostList):
+            values = np.pad(c.values, ((0, pad), (0, 0))) if pad else c.values
+            ev = np.pad(c.elem_valid, ((0, pad), (0, 0))) if pad else c.elem_valid
+            lens = np.pad(c.lens, (0, pad)) if pad else c.lens
+            val = np.pad(c.validity, (0, pad)) if pad else c.validity
+            cols.append(ListColumn(jnp.asarray(values), jnp.asarray(ev),
+                                   jnp.asarray(lens), jnp.asarray(val)))
         else:
             data = np.pad(c.data, (0, pad)) if pad else c.data
             val = np.pad(c.validity, (0, pad)) if pad else c.validity
@@ -166,6 +192,14 @@ def serialize_host_batch(host: HostBatch,
         if isinstance(c, HostString):
             body.write(struct.pack("<BH", 1, c.chars.shape[1]))
             _put_buf(body, c.chars)
+            _put_buf(body, c.lens.astype(np.int32))
+            _put_buf(body, c.validity.astype(np.bool_))
+        elif isinstance(c, HostList):
+            tag = c.values.dtype.str.encode()
+            body.write(struct.pack("<BHB", 2, c.values.shape[1], len(tag)))
+            body.write(tag)
+            _put_buf(body, c.values)
+            _put_buf(body, c.elem_valid.astype(np.bool_))
             _put_buf(body, c.lens.astype(np.int32))
             _put_buf(body, c.validity.astype(np.bool_))
         else:
@@ -207,6 +241,14 @@ def deserialize_host_batch(data: bytes) -> tuple[HostBatch, dict[str, np.ndarray
             lens = _get_buf(src, np.int32, (num_rows,))
             val = _get_buf(src, np.bool_, (num_rows,))
             cols.append(HostString(chars, lens, val))
+        elif kind == 2:
+            m, tag_len = struct.unpack("<HB", src.read(3))
+            dt = np.dtype(src.read(tag_len).decode())
+            values = _get_buf(src, dt, (num_rows, m))
+            ev = _get_buf(src, np.bool_, (num_rows, m))
+            lens = _get_buf(src, np.int32, (num_rows,))
+            val = _get_buf(src, np.bool_, (num_rows,))
+            cols.append(HostList(values, ev, lens, val))
         else:
             (tag_len,) = struct.unpack("<B", src.read(1))
             dt = np.dtype(src.read(tag_len).decode())

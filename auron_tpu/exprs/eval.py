@@ -186,6 +186,20 @@ def evaluate(expr: ir.Expr, batch: DeviceBatch, schema: Schema,
             ctx.row_num_offset, jnp.int64)
         return TypedValue(PrimitiveColumn(mid, jnp.ones(cap, bool)), DataType.INT64)
 
+    if isinstance(expr, ir.GetIndexedField):
+        from auron_tpu.columnar.batch import ListColumn
+        v = evaluate(expr.child, batch, schema, ctx)
+        assert isinstance(v.col, ListColumn), "GetIndexedField needs a list"
+        i = expr.ordinal
+        in_range = (i >= 0) & (i < v.col.lens)
+        idx = min(max(i, 0), v.col.max_elems - 1)
+        elem_dt, _, _ = infer_dtype(expr, schema)
+        return TypedValue(
+            PrimitiveColumn(v.col.values[:, idx],
+                            v.col.validity & in_range
+                            & v.col.elem_valid[:, idx]),
+            elem_dt)
+
     if isinstance(expr, ir.HostUDF):
         return _eval_host_udf(expr, batch, schema, ctx)
 
@@ -237,6 +251,13 @@ def infer_dtype(expr: ir.Expr, schema: Schema) -> tuple[DataType, int, int]:
         return DataType.INT32, 0, 0
     if isinstance(expr, ir.HostUDF):
         return expr.dtype, 0, 0
+    if isinstance(expr, ir.GetIndexedField):
+        child_dt = infer_dtype(expr.child, schema)
+        if child_dt[0] == DataType.LIST:
+            # element type rides in the field's elem slot
+            if isinstance(expr.child, ir.ColumnRef):
+                return schema[expr.child.index].elem, 0, 0
+        raise NotImplementedError("GetIndexedField on non-column list")
     raise NotImplementedError(f"infer_dtype for {type(expr).__name__}")
 
 

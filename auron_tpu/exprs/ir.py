@@ -178,6 +178,17 @@ class ScalarFunction(Expr):
 
 
 @dataclass(frozen=True)
+class GetIndexedField(Expr):
+    """list[ordinal] element access, 0-based (reference:
+    datafusion-ext-exprs/src/get_indexed_field.rs)."""
+    child: Expr
+    ordinal: int
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(frozen=True)
 class RowNum(Expr):
     """Monotonic row number within the partition stream (reference:
     datafusion-ext-exprs/src/row_num.rs)."""

@@ -33,7 +33,8 @@ def lookup_udf(name: str) -> tuple[Callable, DataType, int, int]:
 
 
 def register_udtf(name: str, fn: Any) -> None:
-    """fn: row tuple -> iterable of output row tuples (generator fallback,
+    """fn: callable(row tuple) -> iterable of output row tuples, with an
+    ``output_fields`` attribute: list[(name, DataType)] (generator fallback,
     reference: generate/spark_udtf_wrapper.rs)."""
     _UDTFS[name] = fn
 

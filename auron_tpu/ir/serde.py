@@ -33,6 +33,7 @@ _DT_TO_P = {
     DataType.TIMESTAMP_US: pb.DT_TIMESTAMP_US,
     DataType.DECIMAL: pb.DT_DECIMAL,
     DataType.STRING: pb.DT_STRING,
+    DataType.LIST: pb.DT_LIST,
 }
 _P_TO_DT = {v: k for k, v in _DT_TO_P.items()}
 
@@ -48,14 +49,16 @@ def parse_dtype(p: int) -> DataType:
 def schema_to_proto(schema: Schema) -> pb.SchemaP:
     return pb.SchemaP(fields=[
         pb.FieldP(name=f.name, dtype=_DT_TO_P[f.dtype], nullable=f.nullable,
-                  precision=f.precision, scale=f.scale)
+                  precision=f.precision, scale=f.scale,
+                  elem=_DT_TO_P[f.elem] if f.elem is not None else 0)
         for f in schema.fields
     ])
 
 
 def parse_schema(p: pb.SchemaP) -> Schema:
     return Schema(tuple(
-        Field(f.name, _P_TO_DT[f.dtype], f.nullable, f.precision, f.scale)
+        Field(f.name, _P_TO_DT[f.dtype], f.nullable, f.precision, f.scale,
+              elem=_P_TO_DT[f.elem] if f.dtype == pb.DT_LIST else None)
         for f in p.fields
     ))
 
@@ -157,6 +160,9 @@ def expr_to_proto(e: ir.Expr) -> pb.ExprNode:
         return pb.ExprNode(host_udf=pb.HostUDFE(
             registry_name=e.name, args=[expr_to_proto(a) for a in e.args],
             dtype=_DT_TO_P[e.dtype]))
+    if isinstance(e, ir.GetIndexedField):
+        return pb.ExprNode(get_indexed_field=pb.GetIndexedFieldE(
+            child=expr_to_proto(e.child), ordinal=e.ordinal))
     raise NotImplementedError(f"expr_to_proto: {type(e).__name__}")
 
 
@@ -227,6 +233,9 @@ def parse_expr(p: pb.ExprNode) -> ir.Expr:
         fn, dtype, prec, scale = udf_registry.lookup_udf(p.host_udf.registry_name)
         return ir.HostUDF(fn, tuple(parse_expr(a) for a in p.host_udf.args),
                           dtype, p.host_udf.registry_name)
+    if kind == "get_indexed_field":
+        return ir.GetIndexedField(parse_expr(p.get_indexed_field.child),
+                                  p.get_indexed_field.ordinal)
     raise NotImplementedError(f"parse_expr: {kind}")
 
 

@@ -25,8 +25,8 @@ from typing import Iterator, Optional
 
 import numpy as np
 
-from auron_tpu.columnar.serde import (HostBatch, HostPrimitive, HostString,
-                                      deserialize_host_batch)
+from auron_tpu.columnar.serde import (HostBatch, HostList, HostPrimitive,
+                                      HostString, deserialize_host_batch)
 
 ORDER_WORDS_EXTRA = "order_words"
 #: per-key (word count, pad word) matrix — lets runs whose string keys
@@ -139,6 +139,17 @@ def _concat_host(parts: list[HostBatch]) -> HostBatch:
             cols.append(HostString(chars,
                                    np.concatenate([c.lens for c in cs]),
                                    np.concatenate([c.validity for c in cs])))
+        elif isinstance(cs[0], HostList):
+            m = max(c.values.shape[1] for c in cs)
+            values = np.concatenate([
+                np.pad(c.values, ((0, 0), (0, m - c.values.shape[1])))
+                for c in cs])
+            ev = np.concatenate([
+                np.pad(c.elem_valid, ((0, 0), (0, m - c.elem_valid.shape[1])))
+                for c in cs])
+            cols.append(HostList(values, ev,
+                                 np.concatenate([c.lens for c in cs]),
+                                 np.concatenate([c.validity for c in cs])))
         else:
             cols.append(HostPrimitive(
                 np.concatenate([c.data for c in cs]),
@@ -152,6 +163,9 @@ def _reorder_host(batch: HostBatch, perm: np.ndarray) -> HostBatch:
         if isinstance(c, HostString):
             cols.append(HostString(c.chars[perm], c.lens[perm],
                                    c.validity[perm]))
+        elif isinstance(c, HostList):
+            cols.append(HostList(c.values[perm], c.elem_valid[perm],
+                                 c.lens[perm], c.validity[perm]))
         else:
             cols.append(HostPrimitive(c.data[perm], c.validity[perm]))
     return HostBatch(cols, len(perm))

@@ -117,6 +117,8 @@ def _result_field(spec: WindowFunctionSpec, name: str,
     dt, p, s = infer_dtype(spec.arg, in_schema)
     if spec.fn == "avg" and dt != DataType.FLOAT64 and dt != DataType.DECIMAL:
         dt = DataType.FLOAT64
+    if spec.fn == "sum" and dt.is_integer:
+        dt = DataType.INT64   # kernel accumulates int64 (Spark: sum → long)
     return Field(name, dt, True, p, s)
 
 
@@ -168,14 +170,9 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
             tie_new = tie_new | _col_neq_prev(c)
 
         seg_start = _segmented_cummax_pos(seg_new)
-        # segment end: next seg_new position - 1 (live rows only)
-        next_new_rev = _segmented_cummax_pos(jnp.flip(seg_new))
-        # position (from the right) of the next boundary at or before i in
-        # flipped space → convert back: for row i, start of *next* segment
         seg_id = jnp.cumsum(seg_new.astype(jnp.int32)) - 1
-        n_segs = seg_id[jnp.maximum(n - 1, 0)] + 1
-        # end of each row's segment: last live row with same seg_id.
-        # compute per-segment end via scatter-max of positions
+        # end of each row's segment: last live row with same seg_id, via
+        # scatter-max of positions
         seg_end = jax.ops.segment_max(
             jnp.where(live, pos, -1), jnp.clip(seg_id, 0, cap - 1),
             num_segments=cap)
@@ -244,10 +241,17 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
                     in_seg = (src <= bound) & live
                     src_c = jnp.clip(src, 0, cap - 1)
                 if isinstance(col, StringColumn):
-                    out = StringColumn(
-                        col.chars[src_c],
-                        jnp.where(in_seg, col.lens[src_c], 0),
-                        col.validity[src_c] & in_seg & live)
+                    chars = col.chars[src_c]
+                    lens = jnp.where(in_seg, col.lens[src_c], 0)
+                    valid = col.validity[src_c] & in_seg & live
+                    if spec.default is not None and spec.fn in ("lead", "lag"):
+                        db = str(spec.default).encode()[:col.width]
+                        drow = jnp.zeros(col.width, jnp.uint8).at[
+                            :len(db)].set(jnp.asarray(list(db), jnp.uint8))
+                        chars = jnp.where(in_seg[:, None], chars, drow[None, :])
+                        lens = jnp.where(in_seg, lens, len(db))
+                        valid = jnp.where(in_seg, valid, live)
+                    out = StringColumn(chars, lens, valid)
                 else:
                     data = col.data[src_c]
                     valid = col.validity[src_c] & in_seg & live

@@ -1,0 +1,178 @@
+"""Generate / expand / debug / list-column tests (reference test models:
+datafusion-ext-plans/src/generate/, expand_exec.rs)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from auron_tpu.columnar.arrow_bridge import (schema_from_arrow, to_arrow,
+                                             to_device)
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.exprs import ir
+from auron_tpu.exprs import udf as udf_registry
+from auron_tpu.io.parquet import MemoryScanOp
+from auron_tpu.ops.debug import DebugOp
+from auron_tpu.ops.expand import ExpandOp
+from auron_tpu.ops.generate import GenerateOp
+from auron_tpu.runtime.executor import collect
+
+C = ir.ColumnRef
+
+
+def mem_scan(rb, capacity=64):
+    return MemoryScanOp([[rb]], schema_from_arrow(rb.schema),
+                        capacity=capacity)
+
+
+class TestListColumn:
+    def test_arrow_roundtrip(self):
+        rb = pa.record_batch({
+            "l": pa.array([[1, 2], [], None, [3, None, 5]],
+                          pa.list_(pa.int64())),
+            "x": pa.array([10, 20, 30, 40], pa.int64()),
+        })
+        batch, schema = to_device(rb, capacity=8)
+        back = to_arrow(batch, schema)
+        assert back.to_pydict() == rb.to_pydict()
+
+    def test_get_indexed_field(self):
+        rb = pa.record_batch({
+            "l": pa.array([[1, 2], [7], None, [3, 4, 5]],
+                          pa.list_(pa.int64())),
+        })
+        from auron_tpu.ops.project import ProjectOp
+        op = ProjectOp(mem_scan(rb, capacity=8),
+                       [ir.GetIndexedField(C(0), 1)], ["e"])
+        out = collect(op)
+        assert out.column("e").to_pylist() == [2, None, None, 4]
+
+
+class TestExplode:
+    def _rb(self):
+        return pa.record_batch({
+            "id": pa.array([1, 2, 3, 4], pa.int64()),
+            "l": pa.array([[10, 20], [], None, [30, None]],
+                          pa.list_(pa.int64())),
+        })
+
+    def test_explode(self):
+        op = GenerateOp(mem_scan(self._rb(), capacity=8), "explode",
+                        generator=C(1), required_child_output=[0])
+        out = collect(op).to_pydict()
+        assert out == {"id": [1, 1, 4, 4], "col": [10, 20, 30, None]}
+
+    def test_explode_outer(self):
+        op = GenerateOp(mem_scan(self._rb(), capacity=8), "explode",
+                        generator=C(1), required_child_output=[0],
+                        outer=True)
+        out = collect(op).to_pydict()
+        assert out == {"id": [1, 1, 2, 3, 4, 4],
+                       "col": [10, 20, None, None, 30, None]}
+
+    def test_posexplode(self):
+        op = GenerateOp(mem_scan(self._rb(), capacity=8), "posexplode",
+                        generator=C(1), required_child_output=[0])
+        out = collect(op).to_pydict()
+        assert out == {"id": [1, 1, 4, 4], "pos": [0, 1, 0, 1],
+                       "col": [10, 20, 30, None]}
+
+    def test_posexplode_outer_null_pos(self):
+        # Spark posexplode_outer: padded rows get NULL pos (review regression)
+        rb = pa.record_batch({
+            "id": pa.array([1, 2], pa.int64()),
+            "l": pa.array([[10], []], pa.list_(pa.int64())),
+        })
+        op = GenerateOp(mem_scan(rb, capacity=8), "posexplode",
+                        generator=C(1), required_child_output=[0],
+                        outer=True)
+        out = collect(op).to_pydict()
+        assert out == {"id": [1, 2], "pos": [0, None], "col": [10, None]}
+
+    def test_explode_large_random(self):
+        rng = np.random.default_rng(0)
+        lists, want = [], []
+        for i in range(500):
+            ln = int(rng.integers(0, 6))
+            lst = rng.integers(0, 100, ln).tolist()
+            lists.append(lst)
+            want.extend((i, v) for v in lst)
+        rb = pa.record_batch({
+            "id": pa.array(range(500), pa.int64()),
+            "l": pa.array(lists, pa.list_(pa.int64())),
+        })
+        op = GenerateOp(mem_scan(rb, capacity=512), "explode",
+                        generator=C(1), required_child_output=[0])
+        out = collect(op)
+        got = list(zip(out.column("id").to_pylist(),
+                       out.column("col").to_pylist()))
+        assert got == want
+
+
+class TestJsonTuple:
+    def test_json_tuple(self):
+        rb = pa.record_batch({
+            "j": pa.array(['{"a": 1, "b": "x"}', '{"a": 2}',
+                           'not json', None], pa.string()),
+        })
+        op = GenerateOp(mem_scan(rb, capacity=8), "json_tuple",
+                        generator=C(0), json_fields=["a", "b"],
+                        required_child_output=[])
+        out = collect(op).to_pydict()
+        assert out == {"a": ["1", "2", None, None],
+                       "b": ["x", None, None, None]}
+
+
+class TestUdtf:
+    def test_host_udtf(self):
+        class RepeatUdtf:
+            output_fields = [("n", DataType.INT64)]
+
+            def __call__(self, row):
+                for i in range(int(row[1])):
+                    yield (row[0] * 10 + i,)
+
+        udf_registry.register_udtf("test_repeat", RepeatUdtf())
+        rb = pa.record_batch({
+            "x": pa.array([1, 2], pa.int64()),
+            "times": pa.array([2, 3], pa.int64()),
+        })
+        op = GenerateOp(mem_scan(rb, capacity=8), "udtf",
+                        udtf_name="test_repeat", required_child_output=[0])
+        out = collect(op).to_pydict()
+        assert out == {"x": [1, 1, 2, 2, 2], "n": [10, 11, 20, 21, 22]}
+
+
+class TestExpand:
+    def test_grouping_sets_style(self):
+        rb = pa.record_batch({
+            "a": pa.array([1, 2], pa.int64()),
+            "b": pa.array([10, 20], pa.int64()),
+        })
+        null_i64 = ir.Literal(None, DataType.INT64)
+        op = ExpandOp(mem_scan(rb, capacity=8), [
+            [C(0), C(1)],
+            [C(0), null_i64],
+            [null_i64, null_i64],
+        ], names=["a", "b"])
+        out = collect(op).to_pydict()
+        key = lambda t: (t[0] is None, t[0] or 0, t[1] is None, t[1] or 0)
+        got = sorted(zip(out["a"], out["b"]), key=key)
+        want = sorted([(1, 10), (2, 20), (1, None), (2, None),
+                       (None, None), (None, None)], key=key)
+        assert got == want
+
+    def test_arity_mismatch_rejected(self):
+        rb = pa.record_batch({"a": pa.array([1], pa.int64())})
+        with pytest.raises(AssertionError):
+            ExpandOp(mem_scan(rb), [[C(0)], [C(0), C(0)]])
+
+
+class TestDebug:
+    def test_passthrough(self, caplog):
+        rb = pa.record_batch({"a": pa.array([1, 2, 3], pa.int64())})
+        import logging
+        with caplog.at_level(logging.INFO, logger="auron_tpu.debug"):
+            out = collect(DebugOp(mem_scan(rb, capacity=8), label="t"))
+        assert out.column("a").to_pylist() == [1, 2, 3]
+        assert any("rows=3" in r.message for r in caplog.records)

@@ -231,6 +231,20 @@ class TestExternalSort:
             pd.testing.assert_frame_equal(plain.to_pandas(),
                                           spilled.to_pandas())
 
+    def test_list_column_passthrough_spill(self, tmp_path):
+        # list columns must survive the spill serde (review regression)
+        rb = pa.record_batch({
+            "k": pa.array([3, 1, 2, 1], pa.int64()),
+            "l": pa.array([[1, 2], [], None, [3]], pa.list_(pa.int64())),
+        })
+        so = [ir.SortOrder(C(0))]
+        plain = collect(SortOp(mem_scan([rb], capacity=8), so))
+        mm = _tiny_mem_manager(tmp_path)
+        spilled = collect(SortOp(mem_scan([rb], capacity=8), so),
+                          mem_manager=mm)
+        assert mm.num_spills >= 1
+        assert plain.to_pydict() == spilled.to_pydict()
+
     def test_fetch_with_spill(self, tmp_path):
         rbs = self._data(2000)
         so = [ir.SortOrder(C(0)), ir.SortOrder(C(1))]

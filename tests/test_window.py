@@ -122,6 +122,28 @@ class TestOffsetFamily:
         want = g.shift(-1).fillna(-999).astype("int64")
         np.testing.assert_array_equal(got["w0"], want)
 
+    def test_lead_default_string(self):
+        # string lead/lag must honor the default too (review regression)
+        rb = pa.record_batch({
+            "g": pa.array([1, 1], pa.int64()),
+            "o": pa.array([1, 2], pa.int64()),
+            "s": pa.array(["a", "b"], pa.string()),
+        })
+        got = run_window(rb, [
+            WindowFunctionSpec("offset", "lead", arg=C(2), offset=1,
+                               default="ZZ")])
+        assert got["w0"].tolist() == ["b", "ZZ"]
+
+    def test_sum_int32_widens(self):
+        # sum over narrow ints must widen to int64 (review regression)
+        rb = pa.record_batch({
+            "g": pa.array([1, 1, 1], pa.int64()),
+            "o": pa.array([1, 2, 3], pa.int64()),
+            "v": pa.array([2**30, 2**30, 2**30], pa.int32()),
+        })
+        got = run_window(rb, [WindowFunctionSpec("agg", "sum", arg=C(2))])
+        assert got["w0"].tolist() == [2**30, 2**31, 3 * 2**30]
+
     def test_first_last_nth(self):
         rb = _data(200, seed=6, unique_order=True)
         got = run_window(rb, [
