@@ -155,7 +155,8 @@ def evaluate(expr: ir.Expr, batch: DeviceBatch, schema: Schema,
 
     if isinstance(expr, ir.Cast):
         v = evaluate(expr.child, batch, schema, ctx)
-        return cast_value(v, expr.dtype, expr.precision, expr.scale)
+        return cast_value(v, expr.dtype, expr.precision, expr.scale,
+                          safe=expr.safe)
 
     if isinstance(expr, ir.CaseWhen):
         return _eval_case(expr, batch, schema, ctx)
@@ -542,16 +543,20 @@ _INT_BITS = {DataType.INT8: 8, DataType.INT16: 16, DataType.INT32: 32,
 
 
 def cast_value(v: TypedValue, dtype: DataType, precision: int = 0,
-               scale: int = 0) -> TypedValue:
-    """Spark (non-ANSI) cast semantics (checklist: reference
-    datafusion-ext-commons/src/arrow/cast.rs)."""
-    if v.dtype == dtype and (dtype != DataType.DECIMAL or v.scale == scale):
+               scale: int = 0, safe: bool = True) -> TypedValue:
+    """Spark cast semantics (checklist: reference
+    datafusion-ext-commons/src/arrow/cast.rs). safe=True is the default
+    null-on-failure mode (Spark non-ANSI / TryCast); safe=False raises on
+    unparseable strings (ANSI), checked at the host boundary."""
+    if v.dtype == dtype and (dtype != DataType.DECIMAL
+                             or (v.scale == scale
+                                 and v.precision <= precision)):
         return v
     validity = v.validity
     cap = validity.shape[0]
 
     if isinstance(v.col, StringColumn):
-        return _cast_from_string(v, dtype, precision, scale)
+        return _cast_from_string(v, dtype, precision, scale, safe)
 
     if dtype == DataType.STRING:
         return _cast_to_string(v)
@@ -559,6 +564,33 @@ def cast_value(v: TypedValue, dtype: DataType, precision: int = 0,
     d = v.data
 
     if v.dtype == DataType.DECIMAL:
+        if dtype == DataType.DECIMAL:
+            # rescale with integer math: round half-up like Spark
+            ds = scale - v.scale
+            limit = 10 ** min(precision, 18)
+            if ds >= 0:
+                # overflow-check BEFORE multiplying (int64 wrap would
+                # otherwise slip past the bound)
+                pre_limit = limit // (10 ** ds) if ds <= 18 else 0
+                ok = jnp.abs(d) < max(pre_limit, 1)
+                unscaled = jnp.where(ok, d, 0) * (10 ** min(ds, 18))
+            else:
+                div = 10 ** (-ds)
+                # round half away from zero (Spark HALF_UP)
+                q_abs = (jnp.abs(d) + div // 2) // div
+                unscaled = jnp.where(d >= 0, q_abs, -q_abs)
+                ok = jnp.abs(unscaled) < limit
+            return TypedValue(
+                PrimitiveColumn(jnp.where(ok, unscaled, 0).astype(jnp.int64),
+                                validity & ok),
+                DataType.DECIMAL, precision, scale)
+        if dtype.is_integer:
+            # truncate toward zero on the decimal value (Spark)
+            div = 10 ** v.scale
+            q = jnp.where(d >= 0, d // div, -((-d) // div))
+            target = _JNP[dtype]
+            return TypedValue(PrimitiveColumn(q.astype(target), validity),
+                              dtype)
         f = d.astype(jnp.float64) / (10.0 ** v.scale)
         return cast_value(TypedValue(PrimitiveColumn(f, validity),
                                      DataType.FLOAT64), dtype, precision, scale)
@@ -636,11 +668,44 @@ def _cast_to_string(v: TypedValue) -> TypedValue:
         fmt = lambda x: (datetime.date(1970, 1, 1)
                          + datetime.timedelta(days=int(x))).isoformat()
         width = 16
+    elif v.dtype == DataType.TIMESTAMP_US:
+        import datetime
+        def fmt(x):
+            ts = (datetime.datetime(1970, 1, 1)
+                  + datetime.timedelta(microseconds=int(x)))
+            s = ts.strftime("%Y-%m-%d %H:%M:%S")
+            if ts.microsecond:
+                s += f".{ts.microsecond:06d}".rstrip("0")
+            return s
+        width = 32
     else:
+        is_f32 = v.dtype == DataType.FLOAT32
         def fmt(x):
             f = float(x)
-            if f == int(f) and abs(f) < 1e16:
+            if f != f:
+                return "NaN"
+            if f == float("inf"):
+                return "Infinity"
+            if f == float("-inf"):
+                return "-Infinity"
+            a = abs(f)
+            if a != 0 and (a >= 1e7 or a < 1e-3):
+                # Java Float/Double.toString switches to scientific
+                # notation outside [1e-3, 1e7): '1.0E30'
+                s = np.format_float_scientific(
+                    np.float32(x) if is_f32 else f, unique=True,
+                    trim="0", exp_digits=1)
+                mant, exp = s.split("e")
+                if "." not in mant:
+                    mant += ".0"
+                return f"{mant}E{int(exp)}"
+            if f == int(f):
                 return f"{f:.1f}"
+            if is_f32:
+                # shortest round-trip at f32 precision: '0.1', not the
+                # widened double representation '0.10000000149...'
+                return np.format_float_positional(
+                    np.float32(x), unique=True, trim="0")
             return repr(f)
         width = 32
 
@@ -662,9 +727,10 @@ def _cast_to_string(v: TypedValue) -> TypedValue:
 
 
 def _cast_from_string(v: TypedValue, dtype: DataType, precision: int,
-                      scale: int) -> TypedValue:
-    """string→numeric parse on host; invalid → null (TryCast semantics,
-    reference: datafusion-ext-exprs/src/cast.rs)."""
+                      scale: int, safe: bool = True) -> TypedValue:
+    """string→numeric parse on host; invalid → null when safe (TryCast /
+    non-ANSI), raise when not (ANSI) (reference:
+    datafusion-ext-exprs/src/cast.rs)."""
     col: StringColumn = v.col
     cap = col.capacity
 
@@ -684,12 +750,16 @@ def _cast_from_string(v: TypedValue, dtype: DataType, precision: int,
                     return None
             np_t = np.int32
         else:
+            bits = _INT_BITS[dtype]
+            lo, hi = -(2 ** (bits - 1)), 2 ** (bits - 1) - 1
             def parse(s):
                 try:
                     f = float(s.strip())
-                    return int(f) if f == int(f) or "." in s else int(s.strip())
-                except ValueError:
+                    r = int(f) if f == int(f) or "." in s else int(s.strip())
+                except (ValueError, OverflowError):
                     return None
+                # out-of-range → null (Spark UTF8String.toInt failure)
+                return r if lo <= r <= hi else None
             np_t = _JNP[dtype]
     elif dtype == DataType.DECIMAL:
         from decimal import Decimal, InvalidOperation
@@ -703,10 +773,14 @@ def _cast_from_string(v: TypedValue, dtype: DataType, precision: int,
         import datetime
         def parse(s):
             try:
-                return int(datetime.datetime.fromisoformat(s.strip())
-                           .replace(tzinfo=datetime.timezone.utc).timestamp() * 1e6)
+                ts = datetime.datetime.fromisoformat(s.strip())
             except ValueError:
                 return None
+            if ts.tzinfo is None:
+                ts = ts.replace(tzinfo=datetime.timezone.utc)
+            else:
+                ts = ts.astimezone(datetime.timezone.utc)
+            return int(ts.timestamp() * 1e6)
         np_t = np.int64
     else:
         def parse(s):
@@ -716,22 +790,34 @@ def _cast_from_string(v: TypedValue, dtype: DataType, precision: int,
                 return None
         np_t = _JNP[dtype]
 
-    def host_parse(chars_np, lens_np):
+    def host_parse(chars_np, lens_np, valid_np):
         data = np.zeros(cap, np_t)
         ok = np.zeros(cap, bool)
         for i in range(cap):
             s = bytes(chars_np[i, : lens_np[i]]).decode("utf-8", "replace")
-            r = parse(s)
+            try:
+                r = parse(s)
+            except (ValueError, OverflowError):
+                r = None
             if r is not None:
-                data[i] = r
-                ok[i] = True
+                try:
+                    data[i] = r
+                    ok[i] = True
+                except (OverflowError, ValueError):
+                    # parsed but does not fit the target width → null
+                    data[i] = 0
+                    ok[i] = False
+            if not ok[i] and not safe and valid_np[i]:
+                raise ValueError(
+                    f"[CAST_INVALID_INPUT] cannot cast {s!r} to "
+                    f"{dtype.value} (ANSI mode)")
         return data, ok
 
     data, ok = jax.pure_callback(
         host_parse,
         (jax.ShapeDtypeStruct((cap,), np_t),
          jax.ShapeDtypeStruct((cap,), jnp.bool_)),
-        col.chars, col.lens, vmap_method="sequential")
+        col.chars, col.lens, v.validity, vmap_method="sequential")
     return TypedValue(PrimitiveColumn(data, v.validity & ok), dtype,
                       precision, scale)
 

@@ -115,7 +115,7 @@ def expr_to_proto(e: ir.Expr) -> pb.ExprNode:
     if isinstance(e, ir.Cast):
         return pb.ExprNode(cast=pb.CastE(
             child=expr_to_proto(e.child), dtype=_DT_TO_P[e.dtype],
-            precision=e.precision, scale=e.scale, try_cast=e.safe))
+            precision=e.precision, scale=e.scale, ansi=not e.safe))
     if isinstance(e, ir.CaseWhen):
         node = pb.CaseWhenE()
         for when, then in e.when_then:
@@ -199,8 +199,10 @@ def parse_expr(p: pb.ExprNode) -> ir.Expr:
             "is_not_null": ir.IsNotNull, "negative": ir.Negative,
         }[p.unary.op](child)
     if kind == "cast":
+        # TryCast is null-on-failure regardless of session ANSI mode
+        safe = p.cast.try_cast or not p.cast.ansi
         return ir.Cast(parse_expr(p.cast.child), _P_TO_DT[p.cast.dtype],
-                       p.cast.precision, p.cast.scale, safe=p.cast.try_cast)
+                       p.cast.precision, p.cast.scale, safe=safe)
     if kind == "case_when":
         branches = tuple((parse_expr(b.when), parse_expr(b.then))
                          for b in p.case_when.branches)

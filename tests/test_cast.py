@@ -1,0 +1,204 @@
+"""Spark cast-matrix tests (checklist model: reference
+datafusion-ext-commons/src/arrow/cast.rs, datafusion-ext-exprs/src/cast.rs).
+Expected values encode Spark non-ANSI semantics."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.exprs import ir
+from auron_tpu.io.parquet import MemoryScanOp
+from auron_tpu.ops.project import ProjectOp
+from auron_tpu.runtime.executor import collect
+
+C = ir.ColumnRef
+
+
+def run_cast(values, src_type, dtype, precision=0, scale=0, safe=True):
+    rb = pa.record_batch({"x": pa.array(values, src_type)})
+    op = ProjectOp(
+        MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=16),
+        [ir.Cast(C(0), dtype, precision, scale, safe=safe)], ["y"])
+    return collect(op).column("y").to_pylist()
+
+
+class TestNumericCasts:
+    def test_long_to_int_wraps(self):
+        # Java semantics: bit truncation
+        assert run_cast([2**31, -2**31 - 1, 5, None], pa.int64(),
+                        DataType.INT32) == [-2**31, 2**31 - 1, 5, None]
+
+    def test_int_to_short_byte_wraps(self):
+        assert run_cast([300, -300], pa.int32(), DataType.INT8) == [44, -44]
+        assert run_cast([70000], pa.int32(), DataType.INT16) == [4464]
+
+    def test_double_to_int_truncates_saturates(self):
+        got = run_cast([1.9, -1.9, float("nan"), 1e20, -1e20], pa.float64(),
+                       DataType.INT32)
+        assert got == [1, -1, 0, 2**31 - 1, -2**31]
+
+    def test_double_to_long(self):
+        got = run_cast([1.5, -2.7, float("inf")], pa.float64(),
+                       DataType.INT64)
+        assert got == [1, -2, 2**63 - 1]
+
+    def test_int_to_double(self):
+        assert run_cast([3, None], pa.int64(), DataType.FLOAT64) == [3.0, None]
+
+    def test_bool_casts(self):
+        assert run_cast([0, 1, 5, None], pa.int64(), DataType.BOOL) == \
+            [False, True, True, None]
+        assert run_cast([True, False], pa.bool_(), DataType.INT32) == [1, 0]
+
+
+class TestDecimalCasts:
+    def test_int_to_decimal(self):
+        got = run_cast([3, -7, None], pa.int64(), DataType.DECIMAL, 10, 2)
+        assert [str(x) if x is not None else None for x in got] == \
+            ["3.00", "-7.00", None]
+
+    def test_decimal_rescale_half_up(self):
+        src = pa.decimal128(10, 3)
+        vals = [None if v is None else __import__("decimal").Decimal(v)
+                for v in ("1.005", "1.004", "-1.005", None)]
+        rb = pa.record_batch({"x": pa.array(vals, src)})
+        op = ProjectOp(
+            MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=8),
+            [ir.Cast(C(0), DataType.DECIMAL, 10, 2)], ["y"])
+        got = collect(op).column("y").to_pylist()
+        assert [None if x is None else str(x) for x in got] == \
+            ["1.01", "1.00", "-1.01", None]
+
+    def test_decimal_to_int_truncates(self):
+        import decimal
+        rb = pa.record_batch({"x": pa.array(
+            [decimal.Decimal("5.99"), decimal.Decimal("-5.99")],
+            pa.decimal128(10, 2))})
+        op = ProjectOp(
+            MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=8),
+            [ir.Cast(C(0), DataType.INT64)], ["y"])
+        assert collect(op).column("y").to_pylist() == [5, -5]
+
+    def test_decimal_overflow_nulls(self):
+        got = run_cast([10**9], pa.int64(), DataType.DECIMAL, 9, 2)
+        assert got == [None]
+
+    def test_decimal_upscale_no_int64_wrap(self):
+        # review regression: overflow check must precede the multiply
+        import decimal
+        rb = pa.record_batch({"x": pa.array(
+            [decimal.Decimal(184467440737095516)], pa.decimal128(18, 0))})
+        op = ProjectOp(
+            MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=8),
+            [ir.Cast(C(0), DataType.DECIMAL, 18, 2)], ["y"])
+        assert collect(op).column("y").to_pylist() == [None]
+
+    def test_decimal_precision_narrowing_same_scale(self):
+        # review regression: equal scale must not skip the overflow check
+        import decimal
+        rb = pa.record_batch({"x": pa.array(
+            [decimal.Decimal("99999999.99"), decimal.Decimal("1.25")],
+            pa.decimal128(10, 2))})
+        op = ProjectOp(
+            MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=8),
+            [ir.Cast(C(0), DataType.DECIMAL, 5, 2)], ["y"])
+        got = collect(op).column("y").to_pylist()
+        assert [None if x is None else str(x) for x in got] == [None, "1.25"]
+
+
+class TestStringCasts:
+    def test_number_to_string(self):
+        assert run_cast([1, -42, None], pa.int64(), DataType.STRING) == \
+            ["1", "-42", None]
+        assert run_cast([1.0, 2.5], pa.float64(), DataType.STRING) == \
+            ["1.0", "2.5"]
+        assert run_cast([float("nan"), float("inf")], pa.float64(),
+                        DataType.STRING) == ["NaN", "Infinity"]
+
+    def test_float32_to_string_shortest(self):
+        assert run_cast([np.float32(0.1), np.float32(1.5)], pa.float32(),
+                        DataType.STRING) == ["0.1", "1.5"]
+
+    def test_float_to_string_scientific(self):
+        # Java toString switches to scientific outside [1e-3, 1e7)
+        assert run_cast([np.float32(1e30)], pa.float32(),
+                        DataType.STRING) == ["1.0E30"]
+        assert run_cast([1e30, 1.5e-5], pa.float64(),
+                        DataType.STRING) == ["1.0E30", "1.5E-5"]
+
+    def test_bool_to_string(self):
+        assert run_cast([True, False, None], pa.bool_(),
+                        DataType.STRING) == ["true", "false", None]
+
+    def test_string_to_int(self):
+        assert run_cast(["42", " 7 ", "1.9", "abc", "", None], pa.string(),
+                        DataType.INT32) == [42, 7, 1, None, None, None]
+
+    def test_string_to_double(self):
+        assert run_cast(["1.5", "-2e3", "x"], pa.string(),
+                        DataType.FLOAT64) == [1.5, -2000.0, None]
+
+    def test_string_to_bool(self):
+        assert run_cast(["true", "FALSE", "1", "0", "yes", "maybe"],
+                        pa.string(), DataType.BOOL) == \
+            [True, False, True, False, True, None]
+
+    def test_string_to_decimal(self):
+        got = run_cast(["1.239", "oops"], pa.string(), DataType.DECIMAL,
+                       10, 2)
+        assert [None if x is None else str(x) for x in got] == ["1.24", None]
+
+    def test_string_out_of_range_nulls(self):
+        # review regression: overflow must null, not kill the query
+        assert run_cast(["9999999999", "1e999", "-99999999999999999999"],
+                        pa.string(), DataType.INT32) == [None, None, None]
+
+    def test_ansi_cast_raises(self):
+        with pytest.raises(Exception, match="CAST_INVALID_INPUT"):
+            run_cast(["abc"], pa.string(), DataType.INT32, safe=False)
+
+    def test_ansi_cast_ok_when_parseable(self):
+        assert run_cast(["11"], pa.string(), DataType.INT32,
+                        safe=False) == [11]
+
+    def test_try_cast_nulls_not_raises(self):
+        assert run_cast(["abc", None], pa.string(), DataType.INT32,
+                        safe=True) == [None, None]
+
+
+class TestDateTimeCasts:
+    def test_string_to_date(self):
+        got = run_cast(["2024-02-29", "not a date", None], pa.string(),
+                       DataType.DATE32)
+        import datetime
+        assert got == [datetime.date(2024, 2, 29), None, None]
+
+    def test_date_to_string(self):
+        import datetime
+        assert run_cast([datetime.date(2023, 1, 5), None], pa.date32(),
+                        DataType.STRING) == ["2023-01-05", None]
+
+    def test_timestamp_to_string(self):
+        import datetime
+        ts = datetime.datetime(2023, 5, 6, 7, 8, 9, 123000)
+        got = run_cast([ts], pa.timestamp("us"), DataType.STRING)
+        assert got == ["2023-05-06 07:08:09.123"]
+
+    def test_string_to_timestamp_offset(self):
+        # review regression: explicit UTC offsets must be honored
+        import datetime
+        got = run_cast(["2023-05-06 07:08:09+05:00", "2023-05-06 07:08:09"],
+                       pa.string(), DataType.TIMESTAMP_US)
+        assert got[0] == datetime.datetime(2023, 5, 6, 2, 8, 9)
+        assert got[1] == datetime.datetime(2023, 5, 6, 7, 8, 9)
+
+    def test_timestamp_date_roundtrip(self):
+        import datetime
+        ts = datetime.datetime(2023, 5, 6, 23, 59, 0)
+        assert run_cast([ts], pa.timestamp("us"), DataType.DATE32) == \
+            [datetime.date(2023, 5, 6)]
+        assert run_cast([datetime.date(2023, 5, 6)], pa.date32(),
+                        DataType.TIMESTAMP_US) == \
+            [datetime.datetime(2023, 5, 6, 0, 0, 0)]
