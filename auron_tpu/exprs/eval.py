@@ -187,6 +187,13 @@ def evaluate(expr: ir.Expr, batch: DeviceBatch, schema: Schema,
             ctx.row_num_offset, jnp.int64)
         return TypedValue(PrimitiveColumn(mid, jnp.ones(cap, bool)), DataType.INT64)
 
+    if isinstance(expr, ir.BloomFilterMightContain):
+        from auron_tpu.exprs.bloom import might_contain_device
+        v = evaluate(expr.value, batch, schema, ctx)
+        vals = v.data.astype(jnp.int64)
+        hit = might_contain_device(expr.serialized, vals)
+        return TypedValue(PrimitiveColumn(hit, v.validity), DataType.BOOL)
+
     if isinstance(expr, ir.GetIndexedField):
         from auron_tpu.columnar.batch import ListColumn
         v = evaluate(expr.child, batch, schema, ctx)
@@ -233,7 +240,8 @@ def infer_dtype(expr: ir.Expr, schema: Schema) -> tuple[DataType, int, int]:
         return out, 0, 0
     if isinstance(expr, (ir.Not, ir.IsNull, ir.IsNotNull, ir.Like,
                          ir.StringStartsWith, ir.StringEndsWith,
-                         ir.StringContains, ir.InList)):
+                         ir.StringContains, ir.InList,
+                         ir.BloomFilterMightContain)):
         return DataType.BOOL, 0, 0
     if isinstance(expr, ir.Negative):
         return infer_dtype(expr.child, schema)

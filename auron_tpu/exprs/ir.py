@@ -178,6 +178,18 @@ class ScalarFunction(Expr):
 
 
 @dataclass(frozen=True)
+class BloomFilterMightContain(Expr):
+    """Membership probe against a serialized Spark bloom filter (reference:
+    datafusion-ext-exprs/src/bloom_filter_might_contain.rs). The filter
+    bytes travel in the expression, as in Spark's runtime filter pushdown."""
+    value: Expr
+    serialized: bytes
+
+    def children(self):
+        return (self.value,)
+
+
+@dataclass(frozen=True)
 class GetIndexedField(Expr):
     """list[ordinal] element access, 0-based (reference:
     datafusion-ext-exprs/src/get_indexed_field.rs)."""

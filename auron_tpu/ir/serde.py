@@ -163,6 +163,9 @@ def expr_to_proto(e: ir.Expr) -> pb.ExprNode:
     if isinstance(e, ir.GetIndexedField):
         return pb.ExprNode(get_indexed_field=pb.GetIndexedFieldE(
             child=expr_to_proto(e.child), ordinal=e.ordinal))
+    if isinstance(e, ir.BloomFilterMightContain):
+        return pb.ExprNode(bloom_might_contain=pb.BloomMightContainE(
+            value=expr_to_proto(e.value), serialized_filter=e.serialized))
     raise NotImplementedError(f"expr_to_proto: {type(e).__name__}")
 
 
@@ -238,6 +241,14 @@ def parse_expr(p: pb.ExprNode) -> ir.Expr:
     if kind == "get_indexed_field":
         return ir.GetIndexedField(parse_expr(p.get_indexed_field.child),
                                   p.get_indexed_field.ordinal)
+    if kind == "bloom_might_contain":
+        b = p.bloom_might_contain
+        if not b.serialized_filter:
+            raise NotImplementedError(
+                "bloom filter by resource id not supported; embed the "
+                "serialized filter bytes")
+        return ir.BloomFilterMightContain(parse_expr(b.value),
+                                          bytes(b.serialized_filter))
     raise NotImplementedError(f"parse_expr: {kind}")
 
 
