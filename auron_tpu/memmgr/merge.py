@@ -207,8 +207,11 @@ def merge_sorted_runs(run_frames: list[Iterator[bytes]]) -> Iterator[HostBatch]:
 
         merged = _concat_host([p[0] for p in parts])
         words = np.concatenate([p[1] for p in parts])
-        # np.lexsort: last key is primary → feed most-significant last
-        perm = np.lexsort(tuple(words[:, i]
-                                for i in range(words.shape[1] - 1, -1, -1)))
+        # each part is itself sorted → loser-tree merge of the sub-runs
+        # (native C++ when available; numpy lexsort fallback inside)
+        from auron_tpu import native
+        offsets = np.zeros(len(parts) + 1, np.int64)
+        np.cumsum([p[0].num_rows for p in parts], out=offsets[1:])
+        perm = native.merge_runs(words, offsets)
         yield _reorder_host(merged, perm)
         cursors = [c for c in cursors if not c.exhausted]
