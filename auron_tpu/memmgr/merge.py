@@ -158,13 +158,16 @@ def _concat_host(parts: list[HostBatch]) -> HostBatch:
 
 
 def _reorder_host(batch: HostBatch, perm: np.ndarray) -> HostBatch:
+    from auron_tpu.native import take_rows
     cols = []
     for c in batch.columns:
         if isinstance(c, HostString):
-            cols.append(HostString(c.chars[perm], c.lens[perm],
+            # chars matrices are the wide payload — native memcpy gather
+            cols.append(HostString(take_rows(c.chars, perm), c.lens[perm],
                                    c.validity[perm]))
         elif isinstance(c, HostList):
-            cols.append(HostList(c.values[perm], c.elem_valid[perm],
+            cols.append(HostList(take_rows(c.values, perm),
+                                 take_rows(c.elem_valid, perm),
                                  c.lens[perm], c.validity[perm]))
         else:
             cols.append(HostPrimitive(c.data[perm], c.validity[perm]))

@@ -99,10 +99,9 @@ def merge_runs(words: np.ndarray, run_offsets: np.ndarray) -> np.ndarray:
     k = len(run_offsets) - 1
     lib = _load()
     if lib is None or n == 0:
-        if n == 0:
-            return np.zeros(0, np.int32)
-        return np.lexsort(tuple(words[:, i]
-                                for i in range(w - 1, -1, -1))).astype(np.int32)
+        # a stable sort of the concatenation merges sorted runs with the
+        # same run-order tie-break as the loser tree
+        return lex_sort_words(words)
     words = np.ascontiguousarray(words, np.uint64)
     offsets = np.ascontiguousarray(run_offsets, np.int64)
     out = np.empty(n, np.int32)

@@ -52,11 +52,14 @@ def bench_device() -> float:
     fn = jax.jit(graft._q01_kernel)
     batch, _ = make_batch(0)
     for _ in range(WARMUP):
-        jax.block_until_ready(fn(batch))
+        np.asarray(fn(batch)[2])
     t0 = time.perf_counter()
     for _ in range(ITERS):
         out = fn(batch)
-    jax.block_until_ready(out)
+    # device->host readback is the reliable sync point (on the tunneled
+    # axon platform block_until_ready returns before execution finishes);
+    # stream ordering makes the last result's readback cover all iters
+    np.asarray(out[2])
     dt = time.perf_counter() - t0
     return CAPACITY * ITERS / dt
 
