@@ -1,0 +1,14 @@
+"""Host-engine front-end: Session + DataFrame building proto plans.
+
+The role the Spark extension plays for the reference (reference:
+spark-extension/src/main/scala/.../AuronConverters.scala — host plans are
+converted node by node into the protobuf IR, then executed natively). Here
+the host engine is this DataFrame DSL: every transformation appends a
+PlanNode, `collect()` serializes the tree and hands it to the engine's
+physical planner. Anything the engine cannot run natively goes through the
+host-fallback boundary (`map_batches` — the ConvertToNative/C2R analogue).
+"""
+
+from auron_tpu.frontend.dataframe import (DataFrame, col, lit,  # noqa: F401
+                                          functions)
+from auron_tpu.frontend.session import Session  # noqa: F401

@@ -1,0 +1,427 @@
+"""DataFrame DSL → proto plan builder.
+
+Unresolved column names resolve against the child's schema at build time —
+the same late binding the reference's converters do against the Spark
+plan's output attributes (NativeConverters.scala:95+)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from auron_tpu.columnar.schema import DataType, Field, Schema
+from auron_tpu.exprs import ir
+from auron_tpu.exprs.eval import infer_dtype
+from auron_tpu.ir import pb, serde
+
+# ---------------------------------------------------------------------------
+# column expressions (unresolved)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Col:
+    """Unresolved expression + optional alias."""
+
+    node: Any             # _Ref | ir-builder tree of Cols
+    name: Optional[str] = None
+
+    # -- operators ----------------------------------------------------------
+    def _bin(self, op, other) -> "Col":
+        return Col(("bin", op, self, _wrap(other)))
+
+    def __add__(self, o): return self._bin("+", o)
+    def __radd__(self, o): return _wrap(o)._bin("+", self)
+    def __sub__(self, o): return self._bin("-", o)
+    def __rsub__(self, o): return _wrap(o)._bin("-", self)
+    def __mul__(self, o): return self._bin("*", o)
+    def __rmul__(self, o): return _wrap(o)._bin("*", self)
+    def __truediv__(self, o): return self._bin("/", o)
+    def __mod__(self, o): return self._bin("%", o)
+    def __eq__(self, o): return self._bin("==", o)      # type: ignore
+    def __ne__(self, o): return self._bin("!=", o)      # type: ignore
+    def __lt__(self, o): return self._bin("<", o)
+    def __le__(self, o): return self._bin("<=", o)
+    def __gt__(self, o): return self._bin(">", o)
+    def __ge__(self, o): return self._bin(">=", o)
+    def __and__(self, o): return self._bin("and", o)
+    def __or__(self, o): return self._bin("or", o)
+    def __invert__(self): return Col(("not", self))
+
+    def __hash__(self):
+        return id(self)
+
+    # -- builders -----------------------------------------------------------
+    def alias(self, name: str) -> "Col":
+        return Col(self.node, name)
+
+    def cast(self, dtype: DataType, precision: int = 0,
+             scale: int = 0) -> "Col":
+        return Col(("cast", self, dtype, precision, scale), self.name)
+
+    def is_null(self) -> "Col":
+        return Col(("is_null", self))
+
+    def is_not_null(self) -> "Col":
+        return Col(("is_not_null", self))
+
+    def isin(self, *values) -> "Col":
+        vals = values[0] if len(values) == 1 and isinstance(
+            values[0], (list, tuple)) else values
+        return Col(("in", self, tuple(vals)))
+
+    def like(self, pattern: str) -> "Col":
+        return Col(("like", self, pattern))
+
+    def startswith(self, prefix: str) -> "Col":
+        return Col(("startswith", self, prefix))
+
+    def endswith(self, suffix: str) -> "Col":
+        return Col(("endswith", self, suffix))
+
+    def contains(self, infix: str) -> "Col":
+        return Col(("contains", self, infix))
+
+    def getitem(self, ordinal: int) -> "Col":
+        return Col(("index", self, ordinal))
+
+    def asc(self, nulls_first: bool = True) -> "SortCol":
+        return SortCol(self, True, nulls_first)
+
+    def desc(self, nulls_first: bool = False) -> "SortCol":
+        return SortCol(self, False, nulls_first)
+
+    def out_name(self, default: str = "col") -> str:
+        if self.name:
+            return self.name
+        if isinstance(self.node, str):
+            return self.node
+        return default
+
+
+@dataclass(frozen=True)
+class SortCol:
+    col: Col
+    ascending: bool = True
+    nulls_first: bool = True
+
+
+@dataclass(frozen=True)
+class AggCol:
+    fn: str
+    arg: Optional[Col]
+    name: Optional[str] = None
+    distinct: bool = False
+
+    def alias(self, name: str) -> "AggCol":
+        return AggCol(self.fn, self.arg, name, self.distinct)
+
+    def out_name(self, i: int) -> str:
+        if self.name:
+            return self.name
+        argname = self.arg.out_name() if self.arg is not None else ""
+        return f"{self.fn}({argname})" if argname else self.fn
+
+
+def col(name: str) -> Col:
+    return Col(name)
+
+
+def lit(value, dtype: Optional[DataType] = None) -> Col:
+    return Col(("lit", value, dtype))
+
+
+def _wrap(v) -> Col:
+    return v if isinstance(v, Col) else lit(v)
+
+
+def _py_dtype(v) -> DataType:
+    if isinstance(v, bool):
+        return DataType.BOOL
+    if isinstance(v, int):
+        return DataType.INT64
+    if isinstance(v, float):
+        return DataType.FLOAT64
+    if isinstance(v, str):
+        return DataType.STRING
+    raise TypeError(f"cannot infer literal type for {type(v).__name__}")
+
+
+def resolve(c: Col, schema: Schema) -> ir.Expr:
+    """Resolve a Col tree to a bound ir.Expr against ``schema``."""
+    n = c.node
+    if isinstance(n, str):
+        return ir.ColumnRef(schema.index_of(n), n)
+    if isinstance(n, ir.Expr):
+        return n
+    tag = n[0]
+    if tag == "lit":
+        _, value, dtype = n
+        if value is None:
+            return ir.Literal(None, dtype or DataType.NULL)
+        return ir.Literal(value, dtype or _py_dtype(value))
+    if tag == "bin":
+        _, op, l, r = n
+        return ir.BinaryExpr(op, resolve(l, schema), resolve(r, schema))
+    if tag == "not":
+        return ir.Not(resolve(n[1], schema))
+    if tag == "is_null":
+        return ir.IsNull(resolve(n[1], schema))
+    if tag == "is_not_null":
+        return ir.IsNotNull(resolve(n[1], schema))
+    if tag == "cast":
+        _, child, dtype, p, s = n
+        return ir.Cast(resolve(child, schema), dtype, p, s)
+    if tag == "in":
+        return ir.InList(resolve(n[1], schema), tuple(n[2]))
+    if tag == "like":
+        return ir.Like(resolve(n[1], schema), n[2])
+    if tag == "startswith":
+        return ir.StringStartsWith(resolve(n[1], schema), n[2])
+    if tag == "endswith":
+        return ir.StringEndsWith(resolve(n[1], schema), n[2])
+    if tag == "contains":
+        return ir.StringContains(resolve(n[1], schema), n[2])
+    if tag == "index":
+        return ir.GetIndexedField(resolve(n[1], schema), n[2])
+    if tag == "fn":
+        _, fname, args = n
+        return ir.ScalarFunction(
+            fname, tuple(resolve(a, schema) for a in args))
+    if tag == "udf":
+        _, registry_name, args, dtype = n
+        from auron_tpu.exprs import udf as udf_registry
+        fn, dt, p, s = udf_registry.lookup_udf(registry_name)
+        return ir.HostUDF(fn, tuple(resolve(a, schema) for a in args),
+                          dt, registry_name)
+    raise NotImplementedError(f"cannot resolve column node {tag!r}")
+
+
+class _Functions:
+    """`functions.upper(col) / functions.sum(col) / ...` — scalar function
+    and aggregate builders (reference: datafusion-ext-functions registry +
+    agg set)."""
+
+    _AGGS = {"sum", "count", "avg", "min", "max", "first",
+             "first_ignores_null", "collect_list", "collect_set"}
+
+    def __getattr__(self, name: str) -> Callable[..., Any]:
+        fname = name.rstrip("_")
+        if fname in self._AGGS:
+            def agg_builder(c: Optional[Col] = None, distinct=False):
+                return AggCol(fname, _wrap(c) if c is not None else None,
+                              distinct=distinct)
+            return agg_builder
+
+        def builder(*args):
+            return Col(("fn", fname, tuple(_wrap(a) for a in args)))
+        return builder
+
+    def count_star(self) -> AggCol:
+        return AggCol("count_star", None)
+
+    def udf(self, registry_name: str, *args) -> Col:
+        return Col(("udf", registry_name, tuple(_wrap(a) for a in args),
+                    None))
+
+
+functions = _Functions()
+
+
+# ---------------------------------------------------------------------------
+# DataFrame
+# ---------------------------------------------------------------------------
+
+class GroupedData:
+    def __init__(self, df: "DataFrame", keys: Sequence[Col]):
+        self.df = df
+        self.keys = [_wrap(k) if not isinstance(k, Col) else k
+                     for k in keys]
+
+    def agg(self, *aggs: AggCol) -> "DataFrame":
+        schema = self.df.schema
+        group_exprs = [resolve(k, schema) for k in self.keys]
+        group_names = [k.out_name(f"k{i}") for i, k in enumerate(self.keys)]
+        agg_fns = [ir.AggFunction(
+            a.fn, resolve(a.arg, schema) if a.arg is not None else None,
+            a.distinct) for a in aggs]
+        agg_names = [a.out_name(i) for i, a in enumerate(aggs)]
+        node = pb.PlanNode(agg=pb.AggNode(
+            child=self.df.plan,
+            group_exprs=[serde.expr_to_proto(e) for e in group_exprs],
+            aggs=[serde.agg_to_proto(a) for a in agg_fns],
+            mode="complete", group_names=group_names, agg_names=agg_names))
+        from auron_tpu.ops.agg import AggOp
+        # schema via a throwaway op build is overkill; compute directly
+        key_fields = []
+        for e, nm in zip(group_exprs, group_names):
+            dt, p, s = infer_dtype(e, schema)
+            key_fields.append(Field(nm, dt, True, p, s))
+        out_fields = list(key_fields)
+        from auron_tpu.ops.agg import make_acc_spec
+        for a, nm in zip(agg_fns, agg_names):
+            spec = make_acc_spec(a, schema, "complete")
+            out_fields.append(Field(nm, spec.result[0], True,
+                                    spec.result[1], spec.result[2]))
+        return DataFrame(self.df.session, node, Schema(tuple(out_fields)),
+                         self.df.num_partitions)
+
+
+class DataFrame:
+    def __init__(self, session, plan: pb.PlanNode, schema: Schema,
+                 num_partitions: int = 1):
+        self.session = session
+        self.plan = plan
+        self.schema = schema
+        self.num_partitions = num_partitions
+
+    # -- transforms ---------------------------------------------------------
+
+    def filter(self, cond: Col) -> "DataFrame":
+        e = resolve(cond, self.schema)
+        node = pb.PlanNode(filter=pb.FilterNode(
+            child=self.plan, predicates=[serde.expr_to_proto(e)]))
+        return DataFrame(self.session, node, self.schema,
+                         self.num_partitions)
+
+    where = filter
+
+    def select(self, *cols: Union[str, Col]) -> "DataFrame":
+        cs = [col(c) if isinstance(c, str) else c for c in cols]
+        exprs = [resolve(c, self.schema) for c in cs]
+        names = [c.out_name(f"c{i}") for i, c in enumerate(cs)]
+        node = pb.PlanNode(project=pb.ProjectNode(
+            child=self.plan, exprs=[serde.expr_to_proto(e) for e in exprs],
+            names=names))
+        fields = []
+        for e, nm in zip(exprs, names):
+            dt, p, s = infer_dtype(e, self.schema)
+            fields.append(Field(nm, dt, True, p, s))
+        return DataFrame(self.session, node, Schema(tuple(fields)),
+                         self.num_partitions)
+
+    def with_column(self, name: str, c: Col) -> "DataFrame":
+        existing = [col(f.name) for f in self.schema]
+        return self.select(*existing, c.alias(name))
+
+    def group_by(self, *keys: Union[str, Col]) -> GroupedData:
+        ks = [col(k) if isinstance(k, str) else k for k in keys]
+        return GroupedData(self, ks)
+
+    def sort(self, *orders: Union[str, Col, SortCol],
+             limit: Optional[int] = None) -> "DataFrame":
+        sos = []
+        for o in orders:
+            if isinstance(o, str):
+                o = col(o).asc()
+            elif isinstance(o, Col):
+                o = o.asc()
+            sos.append(ir.SortOrder(resolve(o.col, self.schema),
+                                    o.ascending, o.nulls_first))
+        node = pb.PlanNode(sort=pb.SortNode(
+            child=self.plan,
+            sort_orders=[serde.sort_order_to_proto(s) for s in sos],
+            fetch=-1 if limit is None else limit))
+        return DataFrame(self.session, node, self.schema,
+                         self.num_partitions)
+
+    order_by = sort
+
+    def limit(self, n: int) -> "DataFrame":
+        node = pb.PlanNode(limit=pb.LimitNode(child=self.plan, limit=n))
+        return DataFrame(self.session, node, self.schema,
+                         self.num_partitions)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        node = pb.PlanNode(union=pb.UnionNode(
+            children=[self.plan, other.plan]))
+        return DataFrame(self.session, node, self.schema,
+                         self.num_partitions)
+
+    def join(self, other: "DataFrame", on: Union[str, Sequence[str]],
+             how: str = "inner") -> "DataFrame":
+        keys = [on] if isinstance(on, str) else list(on)
+        pk = [serde.expr_to_proto(resolve(col(k), self.schema))
+              for k in keys]
+        bk = [serde.expr_to_proto(resolve(col(k), other.schema))
+              for k in keys]
+        node = pb.PlanNode(hash_join=pb.HashJoinNode(
+            probe=self.plan, build=other.plan, probe_keys=pk,
+            build_keys=bk, join_type=how))
+        if how in ("semi", "anti"):
+            return DataFrame(self.session, node, self.schema,
+                             self.num_partitions)
+        if how == "existence":
+            out = Schema(tuple(self.schema.fields)
+                         + (Field("exists", DataType.BOOL, False),))
+            return DataFrame(self.session, node, out, self.num_partitions)
+        # USING-style join: the build side's key columns are dropped
+        # (Spark/SQL `JOIN ... USING` semantics)
+        raw = Schema(tuple(self.schema.fields)
+                     + tuple(other.schema.fields))
+        p = len(self.schema)
+        keep = list(range(p)) + [
+            p + i for i, f in enumerate(other.schema)
+            if f.name not in keys]
+        joined = DataFrame(self.session, node, raw, self.num_partitions)
+        return joined.select(*[Col(ir.ColumnRef(i, raw[i].name),
+                                   raw[i].name) for i in keep])
+
+    def explode(self, c: Union[str, Col], outer: bool = False,
+                keep: Optional[Sequence[str]] = None) -> "DataFrame":
+        cc = col(c) if isinstance(c, str) else c
+        gen = resolve(cc, self.schema)
+        keep_idx = ([self.schema.index_of(k) for k in keep]
+                    if keep is not None else list(range(len(self.schema))))
+        node = pb.PlanNode(generate=pb.GenerateNode(
+            child=self.plan, kind="explode",
+            generator=serde.expr_to_proto(gen),
+            required_child_output=keep_idx, outer=outer))
+        elem = (self.schema[gen.index].elem
+                if isinstance(gen, ir.ColumnRef) else DataType.INT64)
+        fields = tuple(self.schema[i] for i in keep_idx) + (
+            Field("col", elem, True),)
+        return DataFrame(self.session, node, Schema(fields),
+                         self.num_partitions)
+
+    def repartition(self, n: int,
+                    *keys: Union[str, Col]) -> "DataFrame":
+        if keys:
+            ks = [col(k) if isinstance(k, str) else k for k in keys]
+            part = pb.PartitioningP(
+                kind="hash", num_partitions=n,
+                hash_keys=[serde.expr_to_proto(resolve(k, self.schema))
+                           for k in ks])
+        else:
+            part = pb.PartitioningP(kind="round_robin", num_partitions=n)
+        node = pb.PlanNode(shuffle_writer=pb.ShuffleWriterNode(
+            child=self.plan, partitioning=part))
+        return DataFrame(self.session, node, self.schema, n)
+
+    def map_batches(self, fn: Callable[[pa.RecordBatch], pa.RecordBatch],
+                    schema: Optional[Schema] = None) -> "DataFrame":
+        """Host-fallback boundary: run an arbitrary Arrow-batch function on
+        the host (the ConvertToNative / C2R transition of the reference)."""
+        rid = self.session._register_host_fn(fn, self)
+        node = pb.PlanNode(memory_scan=pb.MemoryScanNode(table_name=rid))
+        return DataFrame(self.session, node, schema or self.schema,
+                         self.num_partitions)
+
+    # -- actions ------------------------------------------------------------
+
+    def task_bytes(self, partition_id: int = 0) -> bytes:
+        return pb.TaskDefinition(
+            partition_id=partition_id, num_partitions=self.num_partitions,
+            plan=self.plan).SerializeToString()
+
+    def collect(self) -> pa.Table:
+        return self.session.execute(self)
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    def explain(self) -> str:
+        op = self.session.plan_physical(self)
+        return op.tree_string()

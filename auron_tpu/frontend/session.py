@@ -1,0 +1,121 @@
+"""Session: catalog, config, and plan execution for the DataFrame DSL.
+
+The driver-side runtime the Spark session plays for the reference
+(AuronSparkSessionExtension.scala): owns the table catalog and the planner
+context, serializes each DataFrame's plan to TaskDefinition bytes, and runs
+the engine's physical plan per partition — including materializing
+host-fallback boundaries before native planning (the ConvertToNative
+transition, SURVEY.md §3.1)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+from auron_tpu.columnar.schema import Schema
+from auron_tpu.frontend.dataframe import DataFrame
+from auron_tpu.ir import pb, plan_from_bytes
+from auron_tpu.ir.planner import PhysicalPlanner, PlannerContext
+from auron_tpu.runtime.executor import collect as _collect
+
+
+class Session:
+    def __init__(self, batch_capacity: int = 1 << 16, mem_manager=None):
+        self.ctx = PlannerContext(batch_capacity=batch_capacity)
+        self.mem_manager = mem_manager
+        self._ids = itertools.count()
+        #: host-fallback registrations: rid -> (child DataFrame, fn)
+        self._host_fns: dict[str, tuple[DataFrame, Callable]] = {}
+
+    # -- sources ------------------------------------------------------------
+
+    def register(self, name: str, table: pa.Table) -> None:
+        self.ctx.catalog[name] = table
+
+    def table(self, name: str) -> DataFrame:
+        table = self.ctx.catalog[name]
+        node = pb.PlanNode(memory_scan=pb.MemoryScanNode(table_name=name))
+        return DataFrame(self, node, schema_from_arrow(table.schema))
+
+    def from_arrow(self, table: pa.Table,
+                   name: Optional[str] = None) -> DataFrame:
+        name = name or f"__mem_{next(self._ids)}"
+        self.register(name, table)
+        return self.table(name)
+
+    def read_parquet(self, files, columns=None) -> DataFrame:
+        files = [files] if isinstance(files, str) else list(files)
+        node = pb.PlanNode(parquet_scan=pb.ParquetScanNode(
+            files=files, columns=columns or []))
+        schema = schema_from_arrow(pq.read_schema(files[0]))
+        if columns:
+            schema = Schema(tuple(f for f in schema if f.name in columns))
+        return DataFrame(self, node, schema)
+
+    def read_orc(self, files, columns=None) -> DataFrame:
+        from pyarrow import orc
+        files = [files] if isinstance(files, str) else list(files)
+        node = pb.PlanNode(orc_scan=pb.OrcScanNode(
+            files=files, columns=columns or []))
+        schema = schema_from_arrow(orc.ORCFile(files[0]).schema)
+        if columns:
+            schema = Schema(tuple(f for f in schema if f.name in columns))
+        return DataFrame(self, node, schema)
+
+    # -- host fallback ------------------------------------------------------
+
+    def _register_host_fn(self, fn: Callable, child_df: DataFrame) -> str:
+        rid = f"__hostfn_{next(self._ids)}"
+        self._host_fns[rid] = (child_df, fn)
+        return rid
+
+    def _materialize_host_fns(self, plan: pb.PlanNode) -> None:
+        """Execute host-fallback children referenced by this plan and put
+        their transformed output into the catalog."""
+        rids = []
+
+        def walk(node: pb.PlanNode):
+            kind = node.WhichOneof("node")
+            if kind is None:
+                return
+            if kind == "memory_scan" and \
+                    node.memory_scan.table_name.startswith("__hostfn_"):
+                rids.append(node.memory_scan.table_name)
+            inner = getattr(node, kind)
+            for _f, sub in inner.ListFields():
+                if isinstance(sub, pb.PlanNode):
+                    walk(sub)
+                elif hasattr(sub, "__iter__") and not isinstance(sub, (str, bytes)):
+                    for item in sub:
+                        if isinstance(item, pb.PlanNode):
+                            walk(item)
+
+        walk(plan)
+        for rid in rids:
+            if rid in self.ctx.catalog:
+                continue
+            child_df, fn = self._host_fns[rid]
+            child_table = self.execute(child_df)
+            out_batches = []
+            for rb in child_table.to_batches():
+                out = fn(rb)
+                if out.num_rows:
+                    out_batches.append(out)
+            self.ctx.catalog[rid] = (
+                pa.Table.from_batches(out_batches) if out_batches
+                else child_table.schema.empty_table())
+
+    # -- execution ----------------------------------------------------------
+
+    def plan_physical(self, df: DataFrame):
+        self._materialize_host_fns(df.plan)
+        return plan_from_bytes(df.task_bytes(), self.ctx)
+
+    def execute(self, df: DataFrame) -> pa.Table:
+        op = self.plan_physical(df)
+        return _collect(op, num_partitions=df.num_partitions,
+                        mem_manager=self.mem_manager)

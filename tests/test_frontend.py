@@ -1,0 +1,164 @@
+"""DataFrame front-end tests: the DSL builds proto plans, the engine
+executes them — differential vs pandas (the reference covers this layer
+with its Spark-suite re-runs, SURVEY.md §4)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.frontend import Session, col, functions as F, lit
+
+
+@pytest.fixture
+def session():
+    return Session(batch_capacity=1 << 12)
+
+
+@pytest.fixture
+def sales(session):
+    rng = np.random.default_rng(0)
+    n = 2000
+    t = pa.table({
+        "store": pa.array(rng.integers(0, 20, n), pa.int64()),
+        "amount": pa.array(rng.normal(100, 30, n), pa.float64()),
+        "qty": pa.array(rng.integers(1, 10, n), pa.int64()),
+        "city": pa.array([f"city{int(i)}" for i in rng.integers(0, 5, n)],
+                         pa.string()),
+    })
+    return session.from_arrow(t, "sales"), t.to_pandas()
+
+
+class TestBasics:
+    def test_filter_select(self, sales):
+        df, pdf = sales
+        got = (df.filter(col("amount") > 120)
+                 .select("store", (col("amount") * col("qty")).alias("total"))
+                 .collect().to_pandas())
+        want = pdf[pdf.amount > 120]
+        np.testing.assert_array_equal(got["store"], want.store)
+        np.testing.assert_allclose(got["total"], want.amount * want.qty)
+
+    def test_with_column_cast(self, sales):
+        df, pdf = sales
+        got = df.with_column("amt_int", col("amount").cast(DataType.INT64)) \
+            .collect().to_pandas()
+        np.testing.assert_array_equal(got["amt_int"],
+                                      pdf.amount.astype("int64"))
+
+    def test_group_agg(self, sales):
+        df, pdf = sales
+        got = (df.group_by("store")
+                 .agg(F.sum(col("amount")).alias("s"),
+                      F.count(col("amount")).alias("c"),
+                      F.avg(col("qty")).alias("aq"))
+                 .collect().to_pandas().sort_values("store")
+                 .reset_index(drop=True))
+        want = pdf.groupby("store").agg(
+            s=("amount", "sum"), c=("amount", "count"),
+            aq=("qty", "mean")).reset_index()
+        np.testing.assert_allclose(got["s"], want["s"])
+        np.testing.assert_array_equal(got["c"], want["c"])
+        np.testing.assert_allclose(got["aq"], want["aq"])
+
+    def test_sort_limit(self, sales):
+        df, pdf = sales
+        got = df.sort(col("amount").desc()).limit(10).collect().to_pandas()
+        want = pdf.sort_values("amount", ascending=False).head(10)
+        np.testing.assert_allclose(got["amount"], want.amount)
+
+    def test_union(self, sales):
+        df, pdf = sales
+        a = df.filter(col("store") == 1)
+        b = df.filter(col("store") == 2)
+        got = a.union(b).collect()
+        assert len(got) == ((pdf.store == 1) | (pdf.store == 2)).sum()
+
+    def test_string_predicates(self, sales):
+        df, pdf = sales
+        got = df.filter(col("city").startswith("city1")).collect()
+        assert len(got) == (pdf.city == "city1").sum()
+        got2 = df.filter(col("city").like("c%y2")).collect()
+        assert len(got2) == (pdf.city == "city2").sum()
+
+    def test_isin(self, sales):
+        df, pdf = sales
+        got = df.filter(col("store").isin(1, 3, 5)).collect()
+        assert len(got) == pdf.store.isin([1, 3, 5]).sum()
+
+    def test_scalar_functions(self, session):
+        t = pa.table({"s": pa.array(["ab", "CdE", None], pa.string())})
+        df = session.from_arrow(t)
+        got = df.select(F.upper(col("s")).alias("u"),
+                        F.length(col("s")).alias("l")).collect()
+        assert got.column("u").to_pylist() == ["AB", "CDE", None]
+        assert got.column("l").to_pylist() == [2, 3, None]
+
+
+class TestJoin:
+    def test_inner_join(self, session):
+        left = session.from_arrow(pa.table({
+            "id": pa.array([1, 2, 3], pa.int64()),
+            "x": pa.array([10.0, 20.0, 30.0], pa.float64())}))
+        right = session.from_arrow(pa.table({
+            "id": pa.array([2, 3, 4], pa.int64()),
+            "y": pa.array(["b", "c", "d"], pa.string())}))
+        got = left.join(right, on="id").collect().to_pandas() \
+            .sort_values("id").reset_index(drop=True)
+        assert got["id"].tolist() == [2, 3]
+        assert got["y"].tolist() == ["b", "c"]
+
+    def test_semi_join(self, session):
+        left = session.from_arrow(pa.table({"id": pa.array([1, 2, 3], pa.int64())}))
+        right = session.from_arrow(pa.table({"id": pa.array([2], pa.int64())}))
+        got = left.join(right, on="id", how="semi").collect()
+        assert got.column("id").to_pylist() == [2]
+
+
+class TestShuffleAndScale:
+    def test_repartition_hash(self, sales):
+        df, pdf = sales
+        got = (df.repartition(4, "store")
+                 .group_by("store").agg(F.sum(col("qty")).alias("s"))
+                 .collect().to_pandas().sort_values("store")
+                 .reset_index(drop=True))
+        want = pdf.groupby("store")["qty"].sum().reset_index(name="s")
+        np.testing.assert_array_equal(got["s"], want["s"])
+
+    def test_parquet_roundtrip(self, session, tmp_path):
+        import pyarrow.parquet as pq
+        t = pa.table({"a": pa.array(range(100), pa.int64()),
+                      "b": pa.array([i * 0.5 for i in range(100)])})
+        path = str(tmp_path / "t.parquet")
+        pq.write_table(t, path)
+        got = (session.read_parquet(path)
+               .filter(col("a") >= 90).collect())
+        assert got.column("a").to_pylist() == list(range(90, 100))
+
+
+class TestHostFallback:
+    def test_map_batches(self, session):
+        t = pa.table({"x": pa.array([1, 2, 3, 4], pa.int64())})
+        df = session.from_arrow(t)
+
+        def double(rb: pa.RecordBatch) -> pa.RecordBatch:
+            import pyarrow.compute as pc
+            return pa.record_batch({"x": pc.multiply(rb.column("x"), 2)})
+
+        got = df.filter(col("x") > 1).map_batches(double) \
+            .filter(col("x") > 5).collect()
+        assert got.column("x").to_pylist() == [6, 8]
+
+    def test_explain_shows_tree(self, sales):
+        df, _ = sales
+        s = df.filter(col("store") == 1).explain()
+        assert "FilterOp" in s and "MemoryScanOp" in s
+
+
+class TestExplode:
+    def test_explode(self, session):
+        t = pa.table({"id": pa.array([1, 2], pa.int64()),
+                      "l": pa.array([[1, 2], [3]], pa.list_(pa.int64()))})
+        got = session.from_arrow(t).explode("l", keep=["id"]).collect()
+        assert got.to_pydict() == {"id": [1, 1, 2], "col": [1, 2, 3]}
