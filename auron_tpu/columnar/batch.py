@@ -19,7 +19,7 @@ Batches are pytrees, so they pass straight through jit / shard_map / scan.
 from __future__ import annotations
 
 from dataclasses import dataclass, replace
-from typing import Union
+from typing import Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -182,6 +182,38 @@ def gather_batch(batch: DeviceBatch, indices: jax.Array, num_rows: jax.Array) ->
         tuple(gather_column(c, indices, valid) for c in batch.columns),
         jnp.asarray(num_rows, jnp.int32),
     )
+
+
+def pad_string_width(col: StringColumn, width: int) -> StringColumn:
+    """Zero-pad a string column's char matrix out to `width` bytes/slot."""
+    if col.width >= width:
+        return col
+    return StringColumn(
+        jnp.pad(col.chars, ((0, 0), (0, width - col.width))),
+        col.lens, col.validity)
+
+
+def pad_list_elems(col: ListColumn, max_elems: int) -> ListColumn:
+    """Pad a list column's element axis out to `max_elems` slots."""
+    if col.max_elems >= max_elems:
+        return col
+    pad = max_elems - col.max_elems
+    return ListColumn(
+        jnp.pad(col.values, ((0, 0), (0, pad))),
+        jnp.pad(col.elem_valid, ((0, 0), (0, pad))),
+        col.lens, col.validity)
+
+
+def unify_column_widths(cols: Sequence[Column]) -> list[Column]:
+    """Pad string widths / list element counts to the max across `cols` so
+    they can be concatenated (capacities may differ; widths must not)."""
+    if isinstance(cols[0], StringColumn):
+        w = max(c.width for c in cols)
+        return [pad_string_width(c, w) for c in cols]
+    if isinstance(cols[0], ListColumn):
+        m = max(c.max_elems for c in cols)
+        return [pad_list_elems(c, m) for c in cols]
+    return list(cols)
 
 
 def concat_columns(a: Column, b: Column) -> Column:

@@ -252,3 +252,6 @@ class AggFunction:
     fn: str
     arg: Optional[Expr] = None     # None for count(*)
     distinct: bool = False
+    # bloom_filter sizing; 0 = engine defaults
+    expected_items: int = 0
+    fpp: float = 0.0

@@ -53,7 +53,9 @@ class Session:
             files=files, columns=columns or []))
         schema = schema_from_arrow(pq.read_schema(files[0]))
         if columns:
-            schema = Schema(tuple(f for f in schema if f.name in columns))
+            # requested order, not file order: the scan op emits columns in
+            # the order they were asked for
+            schema = Schema(tuple(schema[schema.index_of(c)] for c in columns))
         return DataFrame(self, node, schema)
 
     def read_orc(self, files, columns=None) -> DataFrame:
@@ -63,7 +65,7 @@ class Session:
             files=files, columns=columns or []))
         schema = schema_from_arrow(orc.ORCFile(files[0]).schema)
         if columns:
-            schema = Schema(tuple(f for f in schema if f.name in columns))
+            schema = Schema(tuple(schema[schema.index_of(c)] for c in columns))
         return DataFrame(self, node, schema)
 
     # -- host fallback ------------------------------------------------------

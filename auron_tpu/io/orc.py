@@ -1,0 +1,32 @@
+"""ORC scan.
+
+The reference reads ORC with the orc-rust crate through the same JVM
+FileSystem wrapper as parquet (reference: datafusion-ext-plans/src/
+orc_exec.rs). Here the host side is pyarrow's ORC dataset reader feeding the
+same double-buffered host→device on-ramp as the parquet scan — the two scans
+share everything but the file format, so OrcScanOp is the generic FileScan
+with the format pinned.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from auron_tpu.columnar.schema import Schema
+from auron_tpu.io.parquet import ParquetScanOp
+from auron_tpu.utils.shapes import DEFAULT_BATCH_CAPACITY
+
+
+class OrcScanOp(ParquetScanOp):
+    name = "orc_scan"
+    _format = "orc"
+
+    def __init__(self, files: list[str], schema: Optional[Schema] = None,
+                 columns: Optional[list[str]] = None,
+                 batch_rows: int = DEFAULT_BATCH_CAPACITY,
+                 string_widths: Optional[dict[str, int]] = None):
+        # ORC proto node carries no pushed-down predicates (the device
+        # filter applies them); dataset-level pruning is parquet-only.
+        super().__init__(files, schema=schema, columns=columns,
+                         predicates=None, batch_rows=batch_rows,
+                         string_widths=string_widths)

@@ -57,6 +57,8 @@ def _expr_to_arrow_filter(e: ir.Expr, names: list[str]):
 
 class ParquetScanOp(PhysicalOp):
     name = "parquet_scan"
+    #: pyarrow.dataset format — OrcScanOp subclasses with "orc"
+    _format = "parquet"
 
     def __init__(self, files: list[str], schema: Optional[Schema] = None,
                  columns: Optional[list[str]] = None,
@@ -67,7 +69,7 @@ class ParquetScanOp(PhysicalOp):
         self.columns = columns
         self.predicates = predicates or []
         self.batch_rows = batch_rows
-        ds = pa_ds.dataset(self.files, format="parquet")
+        ds = pa_ds.dataset(self.files, format=self._format)
         arrow_schema = ds.schema
         if columns:
             arrow_schema = pa.schema([arrow_schema.field(c) for c in columns])
@@ -104,7 +106,7 @@ class ParquetScanOp(PhysicalOp):
         def host_batches():
             if not files:
                 return
-            ds = pa_ds.dataset(files, format="parquet")
+            ds = pa_ds.dataset(files, format=self._format)
             scanner = ds.scanner(columns=self.columns, filter=arrow_filter,
                                  batch_size=self.batch_rows)
             for rb in scanner.to_batches():
@@ -157,7 +159,7 @@ class ParquetScanOp(PhysicalOp):
         return widths
 
     def __repr__(self):
-        return f"ParquetScanOp[{len(self.files)} files]"
+        return f"{type(self).__name__}[{len(self.files)} files]"
 
 
 class MemoryScanOp(PhysicalOp):

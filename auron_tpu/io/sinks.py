@@ -1,0 +1,111 @@
+"""Parquet / ORC sinks.
+
+The reference writes parquet/ORC back through its JVM FileSystem wrapper,
+with Hive dynamic partitions handled JVM-side (reference: datafusion-ext-
+plans/src/parquet_sink_exec.rs, orc_sink_exec.rs, NativeParquetSinkUtils).
+Here the sink is the device→host off-ramp: child batches are materialized to
+Arrow and written with pyarrow; dynamic partitions use pyarrow's hive-style
+dataset writer. Each execute() partition writes its own file(s) — the same
+task-parallel layout as the reference's one-file-per-task sinks — and emits
+a single bookkeeping row (num_rows written), mirroring the reference sinks'
+metric-only output.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from auron_tpu.columnar.arrow_bridge import to_arrow, to_device
+from auron_tpu.columnar.batch import DeviceBatch
+from auron_tpu.columnar.schema import DataType, Field, Schema
+from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
+
+_RESULT_SCHEMA = Schema((Field("num_rows", DataType.INT64, False),))
+
+
+class _FileSinkOp(PhysicalOp):
+    def __init__(self, child: PhysicalOp, path: str, compression: str):
+        self.child = child
+        self.path = path
+        self.compression = compression
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def schema(self) -> Schema:
+        return _RESULT_SCHEMA
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        metrics = ctx.metrics_for(self.name)
+        io_time = metrics.counter("io_time")
+        child_schema = self.child.schema()
+
+        def stream():
+            tables = []
+            for batch in self.child.execute(partition, ctx):
+                rb = to_arrow(batch, child_schema)
+                if rb.num_rows:
+                    tables.append(pa.Table.from_batches([rb]))
+            n = 0
+            if tables:
+                table = pa.concat_tables(tables).combine_chunks()
+                n = table.num_rows
+                with timer(io_time):
+                    self._write(table, partition)
+            result = pa.record_batch({"num_rows": pa.array([n], pa.int64())})
+            yield to_device(result, capacity=16)[0]
+
+        return count_output(stream(), metrics)
+
+    def _write(self, table: pa.Table, partition: int) -> None:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}[{self.path}]"
+
+
+class ParquetSinkOp(_FileSinkOp):
+    name = "parquet_sink"
+
+    def __init__(self, child: PhysicalOp, path: str,
+                 partition_by: Optional[list[str]] = None,
+                 compression: str = "snappy"):
+        super().__init__(child, path, compression)
+        self.partition_by = list(partition_by or [])
+
+    def _write(self, table: pa.Table, partition: int) -> None:
+        comp = None if self.compression == "none" else self.compression
+        if self.partition_by:
+            # hive-style dynamic partitions: path/key=value/part-....parquet
+            pq.write_to_dataset(
+                table, root_path=self.path, partition_cols=self.partition_by,
+                compression=comp,
+                basename_template=f"part-{partition:05d}-{{i}}.parquet")
+        else:
+            os.makedirs(self.path, exist_ok=True)
+            pq.write_table(
+                table, os.path.join(self.path, f"part-{partition:05d}.parquet"),
+                compression=comp)
+
+
+class OrcSinkOp(_FileSinkOp):
+    name = "orc_sink"
+
+    _ORC_COMPRESSION = {"none": "uncompressed", "snappy": "snappy",
+                        "zstd": "zstd", "zlib": "zlib", "lz4": "lz4"}
+
+    def __init__(self, child: PhysicalOp, path: str, compression: str = "zstd"):
+        super().__init__(child, path, compression)
+
+    def _write(self, table: pa.Table, partition: int) -> None:
+        from pyarrow import orc
+        os.makedirs(self.path, exist_ok=True)
+        orc.write_table(
+            table, os.path.join(self.path, f"part-{partition:05d}.orc"),
+            compression=self._ORC_COMPRESSION.get(self.compression,
+                                                  self.compression))

@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 
 from auron_tpu.columnar.batch import (DeviceBatch, PrimitiveColumn, StringColumn,
-                                      concat_columns, gather_column)
+                                      concat_columns, gather_column,
+                                      unify_column_widths)
 from auron_tpu.columnar.schema import DataType, Field, Schema
 from auron_tpu.exprs import ir
 from auron_tpu.exprs.eval import EvalContext, TypedValue, evaluate, infer_dtype
@@ -68,11 +69,19 @@ class AccSpec:
 
     state_fields: (name, dtype, reduce_kind) per state column.
     reduce kinds: sum | min | max | or | first (first = value at the
-    first-ordered valid row of the group).
+    first-ordered valid row of the group) run on device inside the merge
+    kernel; collect_list/collect_set carry a padded list accumulator
+    (values[cap, E], lens[cap]) through the same kernel; bloom / udaf are
+    host-side states (kind marks the field, no device accumulator).
     """
     fn: str
     state_fields: tuple
     result: tuple  # (dtype, precision, scale)
+    elem: Optional[DataType] = None  # list element dtype (collect_*)
+
+
+#: reduce kinds whose state is accumulated host-side, not in the kernel
+HOST_KINDS = ("bloom", "udaf")
 
 
 def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
@@ -80,6 +89,18 @@ def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
     if fn in ("count", "count_star"):
         return AccSpec(fn, (("count", DataType.INT64, "sum"),),
                        (DataType.INT64, 0, 0))
+    if fn == "bloom_filter":
+        # host-built runtime filter (reference: agg/bloom_filter.rs);
+        # result/state travel as base64 of the Spark wire format
+        return AccSpec(fn, (("bloom", DataType.STRING, "bloom"),),
+                       (DataType.STRING, 0, 0))
+    if fn.startswith("udaf:"):
+        from auron_tpu.exprs.udf import lookup_udaf
+        udaf = lookup_udaf(fn[5:])
+        rdt = getattr(udaf, "dtype", DataType.FLOAT64)
+        rp = getattr(udaf, "precision", 0)
+        rs = getattr(udaf, "scale", 0)
+        return AccSpec(fn, (("udaf", DataType.STRING, "udaf"),), (rdt, rp, rs))
     dt, p, s = infer_dtype(agg.arg, in_schema)
     if fn == "sum":
         sdt = _SUM_DTYPE[dt]
@@ -97,7 +118,39 @@ def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
     if fn in ("first", "first_ignores_null"):
         return AccSpec(fn, (("val", dt, "first"), ("has", DataType.BOOL, "or")),
                        (dt, p, s))
+    if fn in ("collect_list", "collect_set"):
+        if dt in (DataType.STRING, DataType.LIST):
+            raise NotImplementedError(f"{fn} over {dt.value}")
+        return AccSpec(fn, (("list", dt, fn),), (DataType.LIST, 0, 0), elem=dt)
     raise NotImplementedError(f"aggregate function {fn}")
+
+
+def _device_fields(spec: AccSpec) -> tuple:
+    """State fields accumulated on device (everything but bloom/udaf)."""
+    return tuple(f for f in spec.state_fields if f[2] not in HOST_KINDS)
+
+
+def _list_column_from_acc(acc, validity):
+    """(values[cap, E], lens[cap]) list accumulator → ListColumn (all
+    elements below lens are valid: collect_* skip nulls on input)."""
+    from auron_tpu.columnar.batch import ListColumn
+    vals, lens = acc
+    ev = (jnp.arange(vals.shape[1], dtype=jnp.int32)[None, :]
+          < lens[:, None])
+    return ListColumn(vals, ev, lens, validity)
+
+
+def _cat_acc(a, b):
+    """Concatenate two accumulator entries along the row axis; list
+    accumulators (values, lens) additionally unify their element counts."""
+    if isinstance(a, tuple):
+        ea, eb = a[0].shape[1], b[0].shape[1]
+        e = max(ea, eb)
+        av = jnp.pad(a[0], ((0, 0), (0, e - ea))) if ea < e else a[0]
+        bv = jnp.pad(b[0], ((0, 0), (0, e - eb))) if eb < e else b[0]
+        return (jnp.concatenate([av, bv]),
+                jnp.concatenate([a[1], b[1]]))
+    return jnp.concatenate([a, b])
 
 
 # neutral elements per reduce kind
@@ -140,8 +193,10 @@ def _keys_equal_prev(sorted_keys, live):
 @lru_cache(maxsize=256)
 def _merge_kernel(n_keys: int, acc_meta: tuple, out_cap: int):
     """Builds the jitted merge: (concat'd keys, accs, live) → state of
-    capacity out_cap. acc_meta: tuple of (dtype_enum_value, kind) per state
-    column."""
+    capacity out_cap. acc_meta: tuple of (kind, out_elems) per state column
+    (out_elems only meaningful for collect kinds). Returns
+    (keys, accs, num_groups, needed_elems) where needed_elems carries the
+    true max list length per collect acc so the driver can grow E."""
 
     @jax.jit
     def kernel(keys, accs, live):
@@ -171,7 +226,56 @@ def _merge_kernel(n_keys: int, acc_meta: tuple, out_cap: int):
         new_keys = tuple(gather_column(c, rep, out_valid) for c in keys_s)
 
         new_accs = []
-        for (dt_val, kind), acc in zip(acc_meta, accs):
+        needed_elems = []
+        for (kind, out_elems), acc in zip(acc_meta, accs):
+            if kind in ("collect_list", "collect_set"):
+                vals, lens = acc          # [cap, in_E], [cap]
+                in_e = vals.shape[1]
+                vals_s = vals[perm]
+                lens_s = jnp.where(live_s, lens[perm], 0)
+                # within-group exclusive element offset: global exclusive
+                # cumsum minus the group's base (cumsum at its first row)
+                cum = jnp.cumsum(lens_s)
+                excl = cum - lens_s
+                base = excl[rep]          # [out_cap]
+                start = excl - base[gid]
+                j = jnp.arange(in_e, dtype=jnp.int32)[None, :]
+                flat = gid[:, None] * out_elems + start[:, None] + j
+                ok = (live_s[:, None] & (j < lens_s[:, None])
+                      & ((start[:, None] + j) < out_elems))
+                flat = jnp.where(ok, flat, out_cap * out_elems)
+                out_vals = jnp.zeros((out_cap * out_elems,), vals.dtype).at[
+                    flat.reshape(-1)].set(vals_s.reshape(-1), mode="drop")
+                out_vals = out_vals.reshape(out_cap, out_elems)
+                glens_raw = jax.ops.segment_sum(lens_s, gid,
+                                                num_segments=out_cap)
+                needed_elems.append(jnp.max(glens_raw))
+                glens = jnp.minimum(glens_raw, out_elems)
+                if kind == "collect_set":
+                    # per-group dedupe, sort-based so memory stays
+                    # O(cap * E): row-wise lexsort by (is_pad, value) pushes
+                    # padding last and groups equal values adjacently; keep
+                    # first-of-run, compact left. Set order is unspecified
+                    # (as in Spark), so reordering is free.
+                    jj = jnp.arange(out_elems, dtype=jnp.int32)
+                    pad = jj[None, :] >= glens[:, None]
+                    s_pad, s_vals = jax.lax.sort(
+                        (pad, out_vals), dimension=1, num_keys=2)
+                    neq = s_vals[:, 1:] != s_vals[:, :-1]
+                    keep = ~s_pad & jnp.concatenate(
+                        [jnp.ones((out_cap, 1), bool), neq], axis=1)
+                    pos = jnp.cumsum(keep, axis=1) - 1
+                    row = jnp.arange(out_cap, dtype=jnp.int32)[:, None]
+                    flat2 = jnp.where(keep, row * out_elems + pos,
+                                      out_cap * out_elems)
+                    out_vals = jnp.zeros((out_cap * out_elems,),
+                                         vals.dtype).at[
+                        flat2.reshape(-1)].set(s_vals.reshape(-1),
+                                               mode="drop")
+                    out_vals = out_vals.reshape(out_cap, out_elems)
+                    glens = jnp.sum(keep, axis=1).astype(jnp.int32)
+                new_accs.append((out_vals, glens))
+                continue
             acc_s = acc[perm]
             if kind == "first":
                 # value at first sorted valid row; pair-reduce via segment_min
@@ -196,7 +300,7 @@ def _merge_kernel(n_keys: int, acc_meta: tuple, out_cap: int):
             else:
                 raise ValueError(kind)
             new_accs.append(red)
-        return new_keys, tuple(new_accs), num_groups
+        return new_keys, tuple(new_accs), num_groups, tuple(needed_elems)
 
     return kernel
 
@@ -210,7 +314,189 @@ def _state_nbytes(state) -> int:
     from auron_tpu.columnar.batch import column_nbytes
     keys, accs, _num_groups, _cap = state
     return (sum(column_nbytes(k) for k in keys)
-            + sum(a.nbytes for a in accs))
+            + sum(a[0].nbytes + a[1].nbytes if isinstance(a, tuple)
+                  else a.nbytes for a in accs))
+
+
+def _column_pyvalues(col, n: int) -> list:
+    """First n rows of a column as python values (None where invalid)."""
+    if isinstance(col, StringColumn):
+        chars = np.asarray(col.chars[:n])
+        lens = np.asarray(col.lens[:n])
+        val = np.asarray(col.validity[:n])
+        return [bytes(chars[i, :lens[i]]).decode("utf-8", "surrogateescape")
+                if val[i] else None for i in range(n)]
+    data = np.asarray(col.data[:n])
+    val = np.asarray(col.validity[:n])
+    return [data[i].item() if val[i] else None for i in range(n)]
+
+
+def _key_tuples_host(key_cols, n: int) -> list[tuple]:
+    """Group-key tuples for the first n state rows (host python values) —
+    the rendezvous between device group state and host-side (udaf)
+    accumulators, which are keyed by value."""
+    if not key_cols:
+        return [() for _ in range(n)]
+    per_col = [_column_pyvalues(c, n) for c in key_cols]
+    return [tuple(c[i] for c in per_col) for i in range(n)]
+
+
+def _host_string_column(values: list, cap: int) -> StringColumn:
+    """Build a device StringColumn from python str/None values."""
+    from auron_tpu.utils.shapes import bucket_string_width
+    enc = [None if v is None else v.encode() for v in values]
+    width = bucket_string_width(max([len(b) for b in enc if b is not None],
+                                    default=1) or 1)
+    chars = np.zeros((cap, width), np.uint8)
+    lens = np.zeros(cap, np.int32)
+    val = np.zeros(cap, bool)
+    for i, b in enumerate(enc):
+        if b is None:
+            continue
+        chars[i, :len(b)] = np.frombuffer(b, np.uint8)
+        lens[i] = len(b)
+        val[i] = True
+    return StringColumn(jnp.asarray(chars), jnp.asarray(lens),
+                        jnp.asarray(val))
+
+
+class _HostAggState:
+    """Host-side accumulation for bloom_filter and host-UDAF aggregates.
+
+    The reference routes these through its JVM fallback (reference:
+    datafusion-ext-plans/src/agg/spark_udaf_wrapper.rs:52-380 — per-group
+    JVM buffer rows with update/merge/eval/spill entry points) and builds
+    runtime bloom filters natively (agg/bloom_filter.rs). Here both are
+    host-python escape hatches: udaf buffers live in a dict keyed by group
+    key values (the value-keyed analogue of the wrapper's index caches),
+    bloom filters accumulate via the vectorized SparkBloomFilter builder.
+    State travels between partial/final stages as base64 inside STRING
+    columns. Host states are small (filter bytes / pickled buffers) and are
+    not spill-managed.
+    """
+
+    def __init__(self, op: "AggOp", in_schema: Schema):
+        self.op = op
+        self.in_schema = in_schema
+        self.entries: dict[int, list] = {}
+        for si, (agg, spec) in enumerate(zip(op.aggs, op.specs)):
+            if spec.fn == "bloom_filter":
+                from auron_tpu.exprs.bloom import SparkBloomFilter
+                if op.group_exprs:
+                    raise NotImplementedError(
+                        "bloom_filter aggregate with group keys")
+                items = agg.expected_items or 100_000
+                self.entries[si] = ["bloom", SparkBloomFilter.create(
+                    items, agg.fpp or 0.03)]
+            elif spec.fn.startswith("udaf:"):
+                from auron_tpu.exprs.udf import lookup_udaf
+                self.entries[si] = ["udaf", lookup_udaf(spec.fn[5:]), {}]
+
+    def empty(self) -> bool:
+        return not self.entries
+
+    def has_bloom(self) -> bool:
+        return any(e[0] == "bloom" for e in self.entries.values())
+
+    # -- update (partial / complete input rows) -----------------------------
+
+    def update(self, batch: DeviceBatch, ectx: EvalContext) -> None:
+        if not self.entries:
+            return
+        n = int(batch.num_rows)
+        key_tuples = None
+        for si, ent in self.entries.items():
+            agg = self.op.aggs[si]
+            v = evaluate(agg.arg, batch, self.in_schema, ectx)
+            if ent[0] == "bloom":
+                data = np.asarray(v.col.data[:n])
+                valid = np.asarray((v.validity & batch.row_mask())[:n])
+                ent[1].put_longs(data[valid].astype(np.int64))
+            else:
+                _, udaf, bufs = ent
+                if key_tuples is None:
+                    key_cols = [evaluate(e, batch, self.in_schema, ectx).col
+                                for e in self.op.group_exprs]
+                    key_tuples = _key_tuples_host(key_cols, n)
+                vals = _column_pyvalues(v.col.with_validity(
+                    v.validity & batch.row_mask()), n)
+                for i in range(n):
+                    kt = key_tuples[i]
+                    buf = bufs.get(kt)
+                    if buf is None:
+                        buf = udaf.zero()
+                    bufs[kt] = udaf.update(buf, vals[i])
+
+    # -- merge (final-mode input rows carry serialized states) --------------
+
+    def merge_partial(self, batch: DeviceBatch) -> None:
+        if not self.entries:
+            return
+        import base64
+        import pickle
+        n = int(batch.num_rows)
+        n_keys = len(self.op.group_exprs)
+        key_tuples = _key_tuples_host(batch.columns[:n_keys], n)
+        # state column index per spec in the partial layout
+        idx = n_keys
+        col_of = {}
+        for si, spec in enumerate(self.op.specs):
+            col_of[si] = idx
+            idx += len(spec.state_fields)
+        for si, ent in self.entries.items():
+            col = batch.columns[col_of[si]]
+            states = _column_pyvalues(col, n)
+            if ent[0] == "bloom":
+                from auron_tpu.exprs.bloom import SparkBloomFilter
+                for s in states:
+                    if s:
+                        ent[1].merge(SparkBloomFilter.deserialize(
+                            base64.b64decode(s)))
+            else:
+                _, udaf, bufs = ent
+                for i, s in enumerate(states):
+                    if s is None:
+                        continue
+                    buf = pickle.loads(base64.b64decode(s))
+                    kt = key_tuples[i]
+                    old = bufs.get(kt)
+                    bufs[kt] = buf if old is None else udaf.merge(old, buf)
+
+    # -- emit ----------------------------------------------------------------
+
+    def result_column(self, si: int, key_tuples: list[tuple], ng: int,
+                      cap: int, partial: bool):
+        import base64
+        import pickle
+        ent = self.entries[si]
+        if ent[0] == "bloom":
+            blob = base64.b64encode(ent[1].serialize()).decode()
+            vals = [blob if i < ng else None for i in range(min(ng, 1))]
+            vals += [None] * (cap - len(vals))
+            return _host_string_column(vals[:cap], cap)
+        _, udaf, bufs = ent
+        out = []
+        for i in range(ng):
+            buf = bufs.get(key_tuples[i])
+            if partial:
+                out.append(None if buf is None
+                           else base64.b64encode(pickle.dumps(buf)).decode())
+            else:
+                # missing buffer = no input rows reached the UDAF (empty
+                # global input): Spark evaluates the initial buffer
+                out.append(udaf.eval(udaf.zero() if buf is None else buf))
+        out += [None] * (cap - ng)
+        if partial:
+            return _host_string_column(out, cap)
+        spec = self.op.specs[si]
+        jdt = _JNPT[spec.result[0]]
+        data = np.zeros(cap, np.dtype(jnp.dtype(jdt)))
+        valid = np.zeros(cap, bool)
+        for i, v in enumerate(out[:cap]):
+            if v is not None:
+                data[i] = v
+                valid[i] = True
+        return PrimitiveColumn(jnp.asarray(data), jnp.asarray(valid))
 
 
 class _AggSpillConsumer:
@@ -344,13 +630,19 @@ class AggOp(PhysicalOp):
         if mode == "partial":
             state_fields = []
             for spec, an in zip(self.specs, self.agg_names):
-                for (fname, fdt, _kind) in spec.state_fields:
+                for (fname, fdt, kind) in spec.state_fields:
+                    if kind in ("collect_list", "collect_set"):
+                        state_fields.append(Field(f"{an}#{fname}",
+                                                  DataType.LIST, True,
+                                                  elem=spec.elem))
+                        continue
                     prec, sc = (spec.result[1], spec.result[2]) \
                         if fdt == DataType.DECIMAL else (0, 0)
                     state_fields.append(Field(f"{an}#{fname}", fdt, True, prec, sc))
             self._schema = Schema(tuple(key_fields + state_fields))
         else:
-            out_fields = [Field(n, spec.result[0], True, spec.result[1], spec.result[2])
+            out_fields = [Field(n, spec.result[0], True, spec.result[1],
+                                spec.result[2], elem=spec.elem)
                           for spec, n in zip(self.specs, self.agg_names)]
             self._schema = Schema(tuple(key_fields + out_fields))
 
@@ -375,6 +667,14 @@ class AggOp(PhysicalOp):
             for spec in self.specs:
                 for k, (fname, fdt, kind) in enumerate(spec.state_fields):
                     col = batch.columns[idx]
+                    if kind in HOST_KINDS:
+                        idx += 1      # merged host-side (_HostAggState)
+                        continue
+                    if kind in ("collect_list", "collect_set"):
+                        accs.append((col.values,
+                                     jnp.where(col.validity, col.lens, 0)))
+                        idx += 1
+                        continue
                     data = col.data
                     if fname == "has":
                         data = data.astype(jnp.bool_) & col.validity
@@ -385,6 +685,17 @@ class AggOp(PhysicalOp):
             return keys, accs, live
 
         for agg, spec in zip(self.aggs, self.specs):
+            if spec.state_fields and spec.state_fields[0][2] in HOST_KINDS:
+                continue              # accumulated host-side
+            if agg.fn in ("collect_list", "collect_set"):
+                v = evaluate(agg.arg, batch, in_schema, ctx)
+                if not isinstance(v.col, PrimitiveColumn):
+                    raise NotImplementedError(f"{agg.fn} over non-primitives")
+                valid = v.validity & live
+                # one-element list per valid row (len 0 where null: Spark
+                # collect_* skip nulls)
+                accs.append((v.col.data[:, None], valid.astype(jnp.int32)))
+                continue
             if agg.fn in ("count", "count_star"):
                 if agg.arg is None:
                     c = live.astype(jnp.int64)
@@ -417,74 +728,138 @@ class AggOp(PhysicalOp):
     # -- merge driver -------------------------------------------------------
     def _merge(self, state, keys, accs, live, elapsed):
         """state: None | (keys, accs, num_groups, capacity). Returns updated
-        state, growing capacity buckets when groups overflow."""
-        acc_meta = tuple((0, kind) for spec in self.specs
-                         for (_n, _dt, kind) in spec.state_fields)
+        state, growing capacity buckets (and collect-list element buckets)
+        when groups/lists overflow."""
+        from auron_tpu.utils.shapes import next_pow2
+        kinds = [kind for spec in self.specs
+                 for (_n, _dt, kind) in _device_fields(spec)]
         if state is None:
             cat_keys, cat_accs, cat_live = keys, tuple(accs), live
         else:
             s_keys, s_accs, s_n, s_cap = state
             s_live = jnp.arange(s_cap, dtype=jnp.int32) < s_n
-            cat_keys = tuple(concat_columns(a, b) for a, b in zip(s_keys, keys))
-            cat_accs = tuple(jnp.concatenate([a, b])
+            # string/list key columns may land in different width buckets
+            # per batch (and per restored spill run) — unify before concat
+            cat_keys = tuple(concat_columns(*unify_column_widths([a, b]))
+                             for a, b in zip(s_keys, keys))
+            cat_accs = tuple(_cat_acc(a, b)
                              for a, b in zip(s_accs, accs))
             cat_live = jnp.concatenate([s_live, live])
 
         out_cap = self.initial_capacity if state is None else state[3]
+        out_elems = [max(4, next_pow2(a[0].shape[1])) if isinstance(a, tuple)
+                     else 0 for a in cat_accs]
         while True:
-            kern = _merge_kernel(len(cat_keys), acc_meta, out_cap)
+            meta = tuple(zip(kinds, out_elems))
+            kern = _merge_kernel(len(cat_keys), meta, out_cap)
             with timer(elapsed):
-                new_keys, new_accs, num_groups = kern(cat_keys, cat_accs, cat_live)
+                new_keys, new_accs, num_groups, needed = kern(
+                    cat_keys, cat_accs, cat_live)
             ng = int(num_groups)
-            if ng <= out_cap:
+            ok = ng <= out_cap
+            ni = 0
+            for i, k in enumerate(kinds):
+                if k in ("collect_list", "collect_set"):
+                    nd = int(needed[ni])
+                    ni += 1
+                    if nd > out_elems[i]:
+                        ok = False
+                        out_elems[i] = max(4, next_pow2(nd))
+            if ok:
                 return (new_keys, new_accs, num_groups, out_cap)
-            out_cap = bucket_rows(ng)
+            if ng > out_cap:
+                out_cap = bucket_rows(ng)
 
     # -- finalize → output batch -------------------------------------------
-    def _emit(self, state, in_schema: Schema) -> DeviceBatch:
+    def _emit(self, state, in_schema: Schema, host=None) -> DeviceBatch:
+        from auron_tpu.columnar.batch import ListColumn, resize
         keys, accs, num_groups, cap = state
-        out_cols = list(keys)
         valid = jnp.arange(cap, dtype=jnp.int32) < num_groups
+        ng = int(num_groups)
+
+        # A global bloom state serializes to ~100 KB+ per row; shrink the
+        # (single-group) output capacity before attaching it so the string
+        # column isn't materialized at state capacity.
+        shrink = host is not None and host.has_bloom()
+        out_cap = bucket_rows(max(ng, 1), minimum=16) if shrink else cap
+
+        def list_col(a):
+            return _list_column_from_acc(a, valid)
+
+        out_cols = list(keys)   # device columns; host cols spliced after
+        host_slots = []         # (position, spec_index)
 
         if self.mode == "partial":
             i = 0
-            for spec in self.specs:
+            for si, spec in enumerate(self.specs):
                 for (fname, fdt, kind) in spec.state_fields:
+                    if kind in HOST_KINDS:
+                        host_slots.append((len(out_cols), si))
+                        out_cols.append(None)
+                        continue
                     data = accs[i]
-                    if data.dtype == jnp.bool_ and fname != "has":
-                        data = data.astype(jnp.bool_)
-                    out_cols.append(PrimitiveColumn(
-                        data, valid))
                     i += 1
-            return DeviceBatch(tuple(out_cols), num_groups)
-
-        # final/complete: finalize each agg
-        i = 0
-        for spec in self.specs:
-            n_state = len(spec.state_fields)
-            state_vals = accs[i: i + n_state]
-            i += n_state
-            fn = spec.fn
-            if fn in ("count", "count_star"):
-                out_cols.append(PrimitiveColumn(state_vals[0], valid))
-            elif fn == "sum":
-                s, has = state_vals
-                out_cols.append(PrimitiveColumn(s, valid & has))
-            elif fn == "avg":
-                s, cnt = state_vals
-                res_dt = spec.result[0]
-                safe = jnp.maximum(cnt, 1)
-                if res_dt == DataType.FLOAT64:
-                    avg = s.astype(jnp.float64) / safe
+                    if isinstance(data, tuple):
+                        out_cols.append(list_col(data))
+                    else:
+                        out_cols.append(PrimitiveColumn(data, valid))
+        else:
+            # final/complete: finalize each agg
+            i = 0
+            for si, spec in enumerate(self.specs):
+                n_state = len(_device_fields(spec))
+                state_vals = accs[i: i + n_state]
+                i += n_state
+                fn = spec.fn
+                if fn in ("count", "count_star"):
+                    out_cols.append(PrimitiveColumn(state_vals[0], valid))
+                elif fn == "sum":
+                    s, has = state_vals
+                    out_cols.append(PrimitiveColumn(s, valid & has))
+                elif fn == "avg":
+                    s, cnt = state_vals
+                    res_dt = spec.result[0]
+                    safe = jnp.maximum(cnt, 1)
+                    if res_dt == DataType.FLOAT64:
+                        avg = s.astype(jnp.float64) / safe
+                    else:
+                        avg = s / safe
+                    out_cols.append(PrimitiveColumn(avg, valid & (cnt > 0)))
+                elif fn in ("min", "max", "first", "first_ignores_null"):
+                    v, has = state_vals
+                    out_cols.append(PrimitiveColumn(v, valid & has))
+                elif fn in ("collect_list", "collect_set"):
+                    # empty list (not null) for groups with only nulls —
+                    # Spark's collect_* semantics
+                    out_cols.append(list_col(state_vals[0]))
+                elif spec.state_fields and spec.state_fields[0][2] in HOST_KINDS:
+                    host_slots.append((len(out_cols), si))
+                    out_cols.append(None)
                 else:
-                    avg = s / safe
-                out_cols.append(PrimitiveColumn(avg, valid & (cnt > 0)))
-            elif fn in ("min", "max", "first", "first_ignores_null"):
-                v, has = state_vals
-                out_cols.append(PrimitiveColumn(v, valid & has))
+                    raise NotImplementedError(fn)
+
+        if not host_slots:
+            batch = DeviceBatch(tuple(out_cols), num_groups)
+            return resize(batch, out_cap) if out_cap != cap else batch
+
+        # splice host-aggregated columns (bloom / udaf) at output capacity
+        device_batch = DeviceBatch(
+            tuple(c for c in out_cols if c is not None), num_groups)
+        if out_cap != cap:
+            device_batch = resize(device_batch, out_cap)
+        key_tuples = _key_tuples_host(device_batch.columns[:len(keys)], ng)
+        final_cols = []
+        di = 0
+        slot_map = dict(host_slots)
+        for pos in range(len(out_cols)):
+            if pos in slot_map:
+                final_cols.append(host.result_column(
+                    slot_map[pos], key_tuples, ng, out_cap,
+                    partial=self.mode == "partial"))
             else:
-                raise NotImplementedError(fn)
-        return DeviceBatch(tuple(out_cols), num_groups)
+                final_cols.append(device_batch.columns[di])
+                di += 1
+        return DeviceBatch(tuple(final_cols), num_groups)
 
     # -- spill support ------------------------------------------------------
     # The reference spills the in-mem hash table as sorted buckets and
@@ -496,7 +871,12 @@ class AggOp(PhysicalOp):
     def _state_batch(self, state) -> DeviceBatch:
         keys, accs, num_groups, cap = state
         valid = jnp.arange(cap, dtype=jnp.int32) < num_groups
-        cols = list(keys) + [PrimitiveColumn(a, valid) for a in accs]
+        cols = list(keys)
+        for a in accs:
+            if isinstance(a, tuple):
+                cols.append(_list_column_from_acc(a, valid))
+            else:
+                cols.append(PrimitiveColumn(a, valid))
         return DeviceBatch(tuple(cols), num_groups)
 
     def _state_contributions(self, batch: DeviceBatch):
@@ -506,8 +886,13 @@ class AggOp(PhysicalOp):
         accs = []
         idx = n_keys
         for spec in self.specs:
-            for (fname, _fdt, _kind) in spec.state_fields:
+            for (fname, _fdt, kind) in _device_fields(spec):
                 col = batch.columns[idx]
+                if kind in ("collect_list", "collect_set"):
+                    accs.append((col.values,
+                                 jnp.where(col.validity, col.lens, 0)))
+                    idx += 1
+                    continue
                 data = col.data
                 if fname == "has":
                     data = data.astype(jnp.bool_) & col.validity
@@ -525,9 +910,14 @@ class AggOp(PhysicalOp):
 
         def stream():
             consumer = _AggSpillConsumer(self, mem, metrics) if spillable else None
+            host = _HostAggState(self, in_schema)
             state = None
             try:
                 for batch in self.child.execute(partition, ctx):
+                    if self.mode == "final":
+                        host.merge_partial(batch)
+                    else:
+                        host.update(batch, ectx)
                     keys, accs, live = self._contributions(batch, in_schema, ectx)
                     if consumer is not None:
                         # state lives in the consumer between merges so an
@@ -547,24 +937,34 @@ class AggOp(PhysicalOp):
                 if state is None:
                     if not self.group_exprs and self.mode in ("final", "complete"):
                         # global agg over empty input: one row of neutral results
-                        yield self._empty_global()
+                        yield self._empty_global(host)
                     return
-                yield self._emit(state, in_schema)
+                yield self._emit(state, in_schema, host)
             finally:
                 if consumer is not None:
                     consumer.close()
 
         return count_output(stream(), metrics)
 
-    def _empty_global(self) -> DeviceBatch:
+    def _empty_global(self, host=None) -> DeviceBatch:
+        from auron_tpu.columnar.batch import ListColumn
         cols = []
-        for spec in self.specs:
+        for si, spec in enumerate(self.specs):
             dt = spec.result[0]
-            jdt = _JNPT[dt]
             if spec.fn in ("count", "count_star"):
                 cols.append(PrimitiveColumn(jnp.zeros(1, jnp.int64),
                                             jnp.ones(1, bool)))
+            elif spec.fn in ("collect_list", "collect_set"):
+                cols.append(ListColumn(
+                    jnp.zeros((1, 1), _JNPT[spec.elem]),
+                    jnp.zeros((1, 1), bool), jnp.zeros(1, jnp.int32),
+                    jnp.ones(1, bool)))
+            elif host is not None and si in host.entries:
+                # empty-input bloom/udaf: serialized empty filter /
+                # eval(zero()) — both via the normal result path
+                cols.append(host.result_column(si, [()], 1, 1, partial=False))
             else:
+                jdt = _JNPT[dt]
                 cols.append(PrimitiveColumn(jnp.zeros(1, jdt),
                                             jnp.zeros(1, bool)))
         return DeviceBatch(tuple(cols), jnp.asarray(1, jnp.int32))
@@ -594,4 +994,17 @@ def make_acc_spec_from_partial(agg: ir.AggFunction, in_schema: Schema,
     if fn in ("first", "first_ignores_null"):
         return AccSpec(fn, (("val", f0.dtype, "first"), ("has", DataType.BOOL, "or")),
                        (f0.dtype, f0.precision, f0.scale))
+    if fn in ("collect_list", "collect_set"):
+        return AccSpec(fn, (("list", f0.elem, fn),), (DataType.LIST, 0, 0),
+                       elem=f0.elem)
+    if fn == "bloom_filter":
+        return AccSpec(fn, (("bloom", DataType.STRING, "bloom"),),
+                       (DataType.STRING, 0, 0))
+    if fn.startswith("udaf:"):
+        from auron_tpu.exprs.udf import lookup_udaf
+        udaf = lookup_udaf(fn[5:])
+        rdt = getattr(udaf, "dtype", DataType.FLOAT64)
+        return AccSpec(fn, (("udaf", DataType.STRING, "udaf"),),
+                       (rdt, getattr(udaf, "precision", 0),
+                        getattr(udaf, "scale", 0)))
     raise NotImplementedError(fn)

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from auron_tpu.columnar.batch import (DeviceBatch, ListColumn,
                                       PrimitiveColumn, StringColumn,
+                                      unify_column_widths,
                                       concat_columns, gather_batch)
 from auron_tpu.columnar.schema import DataType, Schema
 from auron_tpu.exprs import ir
@@ -171,40 +172,10 @@ def _concat_all(batches: list[DeviceBatch]) -> DeviceBatch:
     cols = []
     ncols = batches[0].num_columns
     for i in range(ncols):
-        col = batches[0].columns[i]
-        # unify string widths / list element counts across batches
-        if isinstance(col, StringColumn):
-            width = max(b.columns[i].width for b in batches)
-            parts = []
-            for b in batches:
-                c = b.columns[i]
-                if c.width < width:
-                    c = StringColumn(
-                        jnp.pad(c.chars, ((0, 0), (0, width - c.width))),
-                        c.lens, c.validity)
-                parts.append(c)
-            merged = parts[0]
-            for p in parts[1:]:
-                merged = concat_columns(merged, p)
-        elif isinstance(col, ListColumn):
-            m = max(b.columns[i].max_elems for b in batches)
-            parts = []
-            for b in batches:
-                c = b.columns[i]
-                if c.max_elems < m:
-                    pad = m - c.max_elems
-                    c = ListColumn(
-                        jnp.pad(c.values, ((0, 0), (0, pad))),
-                        jnp.pad(c.elem_valid, ((0, 0), (0, pad))),
-                        c.lens, c.validity)
-                parts.append(c)
-            merged = parts[0]
-            for p in parts[1:]:
-                merged = concat_columns(merged, p)
-        else:
-            merged = col
-            for b in batches[1:]:
-                merged = concat_columns(merged, b.columns[i])
+        parts = unify_column_widths([b.columns[i] for b in batches])
+        merged = parts[0]
+        for p in parts[1:]:
+            merged = concat_columns(merged, p)
         cols.append(merged)
     stacked_cap = sum(b.capacity for b in batches)
     from auron_tpu.columnar.batch import compact, resize

@@ -115,11 +115,25 @@ def _result_field(spec: WindowFunctionSpec, name: str,
     if spec.fn in ("count", "count_star"):
         return Field(name, DataType.INT64, False)
     dt, p, s = infer_dtype(spec.arg, in_schema)
-    if spec.fn == "avg" and dt != DataType.FLOAT64 and dt != DataType.DECIMAL:
-        dt = DataType.FLOAT64
+    if spec.fn == "avg":
+        if dt == DataType.DECIMAL:
+            p, s = _decimal_avg_type(p, s)
+        elif dt != DataType.FLOAT64:
+            dt = DataType.FLOAT64
     if spec.fn == "sum" and dt.is_integer:
         dt = DataType.INT64   # kernel accumulates int64 (Spark: sum → long)
     return Field(name, dt, True, p, s)
+
+
+def _decimal_avg_type(p: int, s: int) -> tuple[int, int]:
+    """Spark: avg(decimal(p,s)) -> decimal(p+4, s+4). This engine's
+    decimals are int64-scaled, so precision caps at 18 and the scale is
+    clamped to the capped precision (sums whose scaled value would exceed
+    int64 are an accepted engine limitation, as for sum)."""
+    p = p or 18
+    s = s or 0
+    np_ = min(p + 4, 18)
+    return np_, min(s + 4, np_)
 
 
 @lru_cache(maxsize=128)
@@ -279,7 +293,22 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
                 has = _segmented_scan((v.validity & live).astype(jnp.int64),
                                       seg_new, jnp.add)
                 if spec.fn == "avg":
-                    run = run.astype(jnp.float64) / jnp.maximum(has, 1)
+                    dt_in, _p, in_s = infer_dtype(spec.arg, in_schema)
+                    if dt_in == DataType.DECIMAL:
+                        # scaled-int divide at the (clamped) s+4 result
+                        # scale, HALF_UP away from zero, matching
+                        # Decimal.divide; quotient/remainder form keeps the
+                        # intermediate within one 10^delta shift of the sum
+                        _rp, rs = _decimal_avg_type(_p, in_s)
+                        num = run * (10 ** (rs - (in_s or 0)))
+                        has_safe = jnp.maximum(has, 1)
+                        a = jnp.abs(num)
+                        q0 = a // has_safe
+                        rem = a - q0 * has_safe
+                        q = q0 + (2 * rem >= has_safe)
+                        run = jnp.where(num < 0, -q, q)
+                    else:
+                        run = run.astype(jnp.float64) / jnp.maximum(has, 1)
                 valid = has > 0
             else:  # min / max
                 big = jnp.asarray(

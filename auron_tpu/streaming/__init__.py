@@ -1,0 +1,8 @@
+"""Streaming sources (the Flink-analogue slice of the framework).
+
+The reference's streaming support lives in datafusion-ext-plans/src/flink/:
+a native Kafka consumer (kafka_scan_exec.rs), an in-process mock broker for
+tests (kafka_mock_scan_exec.rs), and row deserializers (json_deserializer.rs,
+pb_deserializer.rs). Here the same roles are: MockBroker (broker.py),
+KafkaScanOp (kafka.py), and the json/proto-rows decoders (rows.py).
+"""

@@ -1,0 +1,74 @@
+"""In-process mock message broker.
+
+The test-time stand-in for a Kafka cluster, mirroring the reference's
+kafka_mock_scan_exec (reference: datafusion-ext-plans/src/flink/
+kafka_mock_scan_exec.rs): topics are named partitioned logs of byte
+messages; consumers poll by (topic, partition, offset). A real-broker
+backend would implement the same poll surface — the scan op only sees this
+interface.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class MockBroker:
+    """Thread-safe topic → partitioned log of bytes messages."""
+
+    _registry: dict[str, "MockBroker"] = {}
+    _registry_lock = threading.Lock()
+
+    @classmethod
+    def get(cls, bootstrap: str) -> "MockBroker":
+        """Resolve a broker by bootstrap string (creating it on first use),
+        so producers (tests / host engine) and the scan op rendezvous by
+        name the way Kafka clients do by bootstrap servers."""
+        with cls._registry_lock:
+            if bootstrap not in cls._registry:
+                cls._registry[bootstrap] = cls()
+            return cls._registry[bootstrap]
+
+    @classmethod
+    def reset(cls, bootstrap: Optional[str] = None) -> None:
+        with cls._registry_lock:
+            if bootstrap is None:
+                cls._registry.clear()
+            else:
+                cls._registry.pop(bootstrap, None)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._topics: dict[str, list[list[bytes]]] = {}
+
+    def create_topic(self, topic: str, num_partitions: int = 1) -> None:
+        with self._lock:
+            self._topics.setdefault(
+                topic, [[] for _ in range(num_partitions)])
+
+    def num_partitions(self, topic: str) -> int:
+        with self._lock:
+            return len(self._topics.get(topic, ()))
+
+    def produce(self, topic: str, message: bytes, partition: int = 0) -> None:
+        with self._lock:
+            if topic not in self._topics:
+                self._topics[topic] = [[]]
+            self._topics[topic][partition].append(message)
+
+    def poll(self, topic: str, partition: int, offset: int,
+             max_messages: int) -> list[bytes]:
+        """Fetch up to max_messages starting at offset (may be empty)."""
+        with self._lock:
+            log = self._topics.get(topic)
+            if log is None or partition >= len(log):
+                return []
+            return list(log[partition][offset:offset + max_messages])
+
+    def end_offset(self, topic: str, partition: int) -> int:
+        with self._lock:
+            log = self._topics.get(topic)
+            if log is None or partition >= len(log):
+                return 0
+            return len(log[partition])

@@ -1,0 +1,85 @@
+"""Kafka-style streaming scan operator.
+
+The reference's native Kafka consumer (reference: datafusion-ext-plans/src/
+flink/kafka_scan_exec.rs) polls rdkafka and deserializes rows into Arrow.
+Here the scan polls a broker by bootstrap name — in this build always the
+in-process MockBroker (the reference ships kafka_mock_scan_exec for exactly
+this role) — decodes message windows into RecordBatches, and yields
+DeviceBatches. ``max_batches`` bounds the stream (0/None = drain to the
+current end offset), which is how the bounded test/dryrun mode works.
+
+Each execute() partition consumes the matching broker partition, so the
+streaming source shards over tasks the way Kafka partitions shard over
+consumers in a group.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from auron_tpu.columnar.arrow_bridge import to_device
+from auron_tpu.columnar.batch import DeviceBatch
+from auron_tpu.columnar.schema import Schema
+from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output
+from auron_tpu.streaming.broker import MockBroker
+from auron_tpu.streaming.rows import DECODERS
+from auron_tpu.utils.shapes import DEFAULT_BATCH_CAPACITY
+
+
+class KafkaScanOp(PhysicalOp):
+    name = "kafka_scan"
+
+    def __init__(self, topic: str, bootstrap: str, schema: Schema,
+                 fmt: str = "json", max_batches: Optional[int] = None,
+                 batch_rows: int = DEFAULT_BATCH_CAPACITY):
+        if fmt not in DECODERS:
+            raise ValueError(f"unknown kafka row format {fmt!r} "
+                             f"(known: {sorted(DECODERS)})")
+        self.topic = topic
+        self.bootstrap = bootstrap
+        self._schema = schema
+        self.fmt = fmt
+        self.max_batches = max_batches
+        self.batch_rows = batch_rows
+
+    @property
+    def children(self):
+        return []
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        metrics = ctx.metrics_for(self.name)
+        decoder = DECODERS[self.fmt]
+        broker = MockBroker.get(self.bootstrap)
+
+        def stream():
+            offset = 0
+            emitted = 0
+            # bounded mode: drain to the end offset captured at start (a
+            # snapshot read); max_batches additionally caps emitted batches
+            end = broker.end_offset(self.topic, partition)
+            while offset < end:
+                if self.max_batches and emitted >= self.max_batches:
+                    return
+                msgs = broker.poll(self.topic, partition, offset,
+                                   self.batch_rows)
+                if not msgs:
+                    break
+                offset += len(msgs)
+                rb = decoder(msgs, self._schema)
+                if rb.num_rows == 0:
+                    continue
+                for off in range(0, rb.num_rows, self.batch_rows):
+                    yield to_device(
+                        rb.slice(off, min(self.batch_rows, rb.num_rows - off)),
+                        capacity=self.batch_rows)[0]
+                    emitted += 1
+                    if self.max_batches and emitted >= self.max_batches:
+                        return
+
+        return count_output(stream(), metrics)
+
+    def __repr__(self):
+        return f"KafkaScanOp[{self.topic}@{self.bootstrap}]"

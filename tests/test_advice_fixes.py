@@ -1,0 +1,99 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md).
+
+1. read_parquet/read_orc with a columns list must build the schema in the
+   requested order (scan ops emit columns in requested order).
+2. AggOp._merge must unify string key widths across batches before
+   concatenation (batches land in different width buckets).
+3. Window avg over DECIMAL emits a scaled-int decimal at Spark's (s+4)
+   result scale, not float data under a decimal field.
+"""
+
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+from auron_tpu.exprs import ir
+from auron_tpu.io.parquet import MemoryScanOp
+from auron_tpu.ops.agg import AggOp
+from auron_tpu.ops.window import WindowFunctionSpec, WindowOp
+from auron_tpu.runtime.executor import collect
+
+C = ir.ColumnRef
+
+
+def test_read_parquet_columns_requested_order(tmp_path):
+    from auron_tpu.frontend.session import Session
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({
+        "a": pa.array([1, 2, 3], pa.int64()),
+        "b": pa.array([10.0, 20.0, 30.0], pa.float64()),
+    }), path)
+    s = Session()
+    df = s.read_parquet(path, columns=["b", "a"])
+    assert df.schema.names == ["b", "a"]
+    out = df.collect().to_pydict()
+    assert out["a"] == [1, 2, 3]
+    assert out["b"] == [10.0, 20.0, 30.0]
+
+
+def test_read_orc_columns_requested_order(tmp_path):
+    from pyarrow import orc
+    from auron_tpu.frontend.session import Session
+    path = str(tmp_path / "t.orc")
+    orc.write_table(pa.table({
+        "a": pa.array([1, 2, 3], pa.int64()),
+        "b": pa.array([10.0, 20.0, 30.0], pa.float64()),
+    }), path)
+    s = Session()
+    df = s.read_orc(path, columns=["b", "a"])
+    assert df.schema.names == ["b", "a"]
+    out = df.collect().to_pydict()
+    assert out["a"] == [1, 2, 3]
+
+
+def test_agg_string_keys_mixed_width_buckets():
+    # batch 1: short keys (width bucket 8); batch 2: long keys (bucket 32).
+    # Before the fix _merge crashed with an AssertionError in concat_columns.
+    short = pa.record_batch({
+        "s": pa.array(["a", "bb", "a"], pa.string()),
+        "v": pa.array([1, 2, 3], pa.int64()),
+    })
+    long = pa.record_batch({
+        "s": pa.array(["a", "x" * 20, "bb"], pa.string()),
+        "v": pa.array([10, 20, 30], pa.int64()),
+    })
+    scan = MemoryScanOp([[short, long]], schema_from_arrow(short.schema),
+                        capacity=8)
+    agg = AggOp(scan, [C(0)], [ir.AggFunction("sum", C(1))],
+                mode="complete", group_names=["s"], agg_names=["sum_v"],
+                initial_capacity=16)
+    got = {r["s"]: r["sum_v"] for r in collect(agg).to_pylist()}
+    assert got == {"a": 14, "bb": 32, "x" * 20: 20}
+
+
+def test_window_avg_decimal_spark_scale():
+    # avg(decimal(10,2)) -> decimal(14,6), HALF_UP division
+    vals = [decimal.Decimal("1.00"), decimal.Decimal("2.01"),
+            decimal.Decimal("2.00"), None]
+    rb = pa.record_batch({
+        "g": pa.array([1, 1, 2, 2], pa.int64()),
+        "o": pa.array([0, 1, 0, 1], pa.int64()),
+        "d": pa.array(vals, pa.decimal128(10, 2)),
+    })
+    op = WindowOp(
+        MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=8),
+        partition_by=[C(0)], order_by=[ir.SortOrder(C(1))],
+        functions=[WindowFunctionSpec("agg", "avg", arg=C(2))],
+        output_names=["a"])
+    out_field = op.schema()[op.schema().index_of("a")]
+    assert out_field.scale == 6
+    got = collect(op)
+    assert got.schema.field("a").type == pa.decimal128(14, 6)
+    a = got.column("a").to_pylist()
+    # g=1 running avg: 1.00 then (1.00+2.01)/2 = 1.505 exactly
+    assert a[:2] == [decimal.Decimal("1.000000"), decimal.Decimal("1.505000")]
+    # g=2: 2.00 then still 2.00 (null ignored)
+    assert a[2:] == [decimal.Decimal("2.000000"), decimal.Decimal("2.000000")]
