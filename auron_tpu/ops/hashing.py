@@ -16,6 +16,8 @@ becomes lane-parallel int32/uint64 VPU ops; the per-string block loop is a
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -24,9 +26,13 @@ from auron_tpu.columnar.batch import Column, DeviceBatch, PrimitiveColumn, Strin
 
 SPARK_SHUFFLE_SEED = 42
 
-_M3_C1 = jnp.uint32(0xCC9E2D51)
-_M3_C2 = jnp.uint32(0x1B873593)
-_M3_MIX = jnp.uint32(0xE6546B64)
+# numpy scalars, not jnp: a module-level jnp constant forces jax backend
+# init at import time, which hangs any process whose ambient accelerator
+# client is wedged (round-2 driver gate, MULTICHIP_r02.json rc=124) before
+# the dryrun can re-exec itself with a safe platform.
+_M3_C1 = np.uint32(0xCC9E2D51)
+_M3_C2 = np.uint32(0x1B873593)
+_M3_MIX = np.uint32(0xE6546B64)
 
 
 def _rotl32(x, r):
@@ -133,11 +139,13 @@ def murmur3_string(chars: jax.Array, lens: jax.Array, seed) -> jax.Array:
 # xxhash64 (Spark XxHash64, seed-chained like murmur)
 # ---------------------------------------------------------------------------
 
-_P1 = jnp.uint64(0x9E3779B185EBCA87)
-_P2 = jnp.uint64(0xC2B2AE3D27D4EB4F)
-_P3 = jnp.uint64(0x165667B19E3779F9)
-_P4 = jnp.uint64(0x85EBCA77C2B2AE63)
-_P5 = jnp.uint64(0x27D4EB2F165667C5)
+# numpy scalars for the same import-time-laziness reason as the murmur
+# constants above
+_P1 = np.uint64(0x9E3779B185EBCA87)
+_P2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_P3 = np.uint64(0x165667B19E3779F9)
+_P4 = np.uint64(0x85EBCA77C2B2AE63)
+_P5 = np.uint64(0x27D4EB2F165667C5)
 
 
 def _rotl64(x, r):

@@ -36,9 +36,10 @@ from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
 from auron_tpu.ops.sort import _concat_all
 from auron_tpu.utils.shapes import bucket_rows
 
-# sentinel hashes guaranteeing null keys never match
-_NULL_PROBE = jnp.uint64(0xFFFFFFFFFFFFFFFF)
-_NULL_BUILD = jnp.uint64(0xFFFFFFFFFFFFFFFE)
+# sentinel hashes guaranteeing null keys never match (numpy scalars so the
+# import doesn't force jax backend init — see ops/hashing.py)
+_NULL_PROBE = np.uint64(0xFFFFFFFFFFFFFFFF)
+_NULL_BUILD = np.uint64(0xFFFFFFFFFFFFFFFE)
 
 
 def _key_hashes(cols, cap, live, null_sentinel) -> jax.Array:
