@@ -1,0 +1,89 @@
+"""Safe child-process environments for platform-sensitive re-execs.
+
+The driver environment may carry a sitecustomize on PYTHONPATH that
+re-registers an accelerator PJRT plugin at interpreter start and forces
+jax's platform selection back to the accelerator — overriding any
+``JAX_PLATFORMS`` env var a child was given (observed: round-2 multichip
+gate, MULTICHIP_r02.json rc=124, hung in ``make_c_api_client`` against a
+wedged TPU client). Subprocesses that must be immune to the ambient
+accelerator state build their env here.
+
+Reference analogue: the reference's native tests run "without a JVM" by
+branching on ``is_jni_bridge_inited()`` (reference:
+native-engine/auron-memmgr/src/spill.rs:78-87); here the equivalent of
+"without the JVM" is "without the accelerator plugin".
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def watchdogged_child_code(body: str, parent_timeout_s: int,
+                           margin_s: int = 30) -> tuple[str, int]:
+    """Wrap python ``-c`` code with a faulthandler watchdog.
+
+    The watchdog thread fires even when the main thread is stuck inside
+    native code (e.g. a wedged PJRT client init), printing every stack to
+    stderr and hard-exiting — so a hang becomes a fast diagnosable failure
+    instead of an opaque parent-side SIGKILL. Returns ``(code,
+    watchdog_s)`` where the watchdog fires ``margin_s`` BEFORE the
+    parent's ``parent_timeout_s`` so the stack dump always wins the race
+    against the parent's kill.
+    """
+    watchdog_s = max(parent_timeout_s - margin_s, 5)
+    code = (
+        "import faulthandler\n"
+        f"faulthandler.dump_traceback_later({watchdog_s}, exit=True)\n"
+        f"{body}\n"
+        "faulthandler.cancel_dump_traceback_later()\n"
+    )
+    return code, watchdog_s
+
+
+def strip_sitecustomize_entries(pythonpath: str, relative_base: str) -> list[str]:
+    """Drop PYTHONPATH entries that carry an interpreter-startup hook.
+
+    Any entry with a ``sitecustomize.py``/``usercustomize.py`` runs
+    arbitrary code before env pinning can matter, so such entries are
+    dropped wholesale. Relative entries are probed against
+    ``relative_base`` (the child's cwd), not the parent's cwd.
+    """
+    keep = []
+    for entry in pythonpath.split(os.pathsep):
+        if not entry:
+            continue
+        probe_base = entry if os.path.isabs(entry) else os.path.join(
+            relative_base, entry)
+        if any(os.path.exists(os.path.join(probe_base, hook))
+               for hook in ("sitecustomize.py", "usercustomize.py")):
+            continue
+        keep.append(entry)
+    return keep
+
+
+def cpu_child_env(child_cwd: str, n_devices: int | None = None) -> dict:
+    """A copy of os.environ pinned to the CPU platform with every route by
+    which an accelerator plugin could re-register stripped."""
+    env = dict(os.environ)
+
+    keep = strip_sitecustomize_entries(env.get("PYTHONPATH", ""), child_cwd)
+    if keep:
+        env["PYTHONPATH"] = os.pathsep.join(keep)
+    else:
+        env.pop("PYTHONPATH", None)
+
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    if n_devices is not None:
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    if flags:
+        env["XLA_FLAGS"] = " ".join(flags)
+    else:
+        env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # belt-and-braces: these only matter if a plugin still registers, but
+    # they must not steer initialization at an accelerator
+    for var in ("JAX_PLATFORM_NAME", "PJRT_DEVICE"):
+        env.pop(var, None)
+    return env

@@ -1,0 +1,82 @@
+"""Driver-contract tests for bench.py.
+
+Round 2's bench failed rc=1 with nothing parseable (BENCH_r02.json) when
+the TPU client was wedged at init. The contract now: bench.py ALWAYS
+prints exactly one JSON line — a measurement (with ``platform`` and, on
+accelerator failure, ``accel_error``) or an ``error`` record — no matter
+how hostile the ambient environment is.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SMALL = {"AURON_BENCH_CAPACITY": "16384", "AURON_BENCH_ITERS": "2"}
+
+
+def _run_bench(extra_env, timeout=560):
+    env = {"PATH": "/usr/bin:/bin", "HOME": "/root"}
+    env.update(_SMALL)
+    env.update(extra_env)
+    return subprocess.run([sys.executable, os.path.join(_REPO, "bench.py")],
+                          env=env, capture_output=True, text=True,
+                          timeout=timeout, cwd=_REPO)
+
+
+def _parse_single_json_line(stdout: str) -> dict:
+    lines = [l for l in stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE json line, got: {lines}"
+    return json.loads(lines[0])
+
+
+def test_bench_emits_measurement_on_cpu():
+    proc = _run_bench({"JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = _parse_single_json_line(proc.stdout)
+    assert rec["metric"] == "q01_pipeline_rows_per_sec_per_chip"
+    assert rec["value"] > 0
+    assert rec["unit"] == "rows/s"
+    assert rec["vs_baseline"] > 0
+    assert rec["platform"] == "cpu"
+
+
+def test_bench_survives_hostile_sitecustomize(tmp_path):
+    """A sitecustomize that forces a nonexistent accelerator platform (the
+    wedged-TPU class of failure, minus the hang): the probe fails, the
+    bench falls back to a sanitized CPU child, and the record says so."""
+    site = tmp_path / "site"
+    site.mkdir()
+    (site / "sitecustomize.py").write_text(
+        "import os\nos.environ['JAX_PLATFORMS'] = 'wedged_accel'\n")
+    proc = _run_bench({"PYTHONPATH": str(site),
+                       "JAX_PLATFORMS": "wedged_accel"})
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = _parse_single_json_line(proc.stdout)
+    assert rec["value"] > 0
+    assert rec["platform"] == "cpu"
+    assert rec.get("accel_error"), "environmental failure must be recorded"
+
+
+def test_bench_error_record_is_parseable(tmp_path):
+    """When even the CPU fallback cannot run (a dependency unimportable),
+    the output must still be one JSON line with an ``error`` key.
+
+    pyarrow is shadowed rather than auron_tpu because the repo dir sits
+    ahead of PYTHONPATH in sys.path; PYTHONPATH still precedes
+    site-packages, and the dir is sitecustomize-free so the sanitizer
+    keeps it on the child's path."""
+    broken = tmp_path / "broken"
+    broken.mkdir()
+    (broken / "pyarrow").mkdir()
+    (broken / "pyarrow" / "__init__.py").write_text(
+        "raise RuntimeError('deliberately broken for the error-record test')")
+    proc = _run_bench({"JAX_PLATFORMS": "cpu",
+                       "PYTHONPATH": str(broken)})
+    assert proc.returncode != 0
+    rec = _parse_single_json_line(proc.stdout)
+    assert rec["metric"] == "q01_pipeline_rows_per_sec_per_chip"
+    assert "deliberately broken" in rec["error"]
