@@ -83,6 +83,12 @@ class AccSpec:
 #: reduce kinds whose state is accumulated host-side, not in the kernel
 HOST_KINDS = ("bloom", "udaf")
 
+#: reduce kinds over string values; their accumulator is a 3-tuple
+#: (chars[cap, W] uint8, lens[cap] int32, valid[cap] bool) and reduction
+#: runs on order-preserving uint64 words (the sort operator's order-word
+#: normalization, ops/sort.py order_words) instead of segment min/max
+_STR_KINDS = ("smin", "smax", "sfirst", "sfirst_ign")
+
 
 def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
     fn = agg.fn
@@ -113,9 +119,17 @@ def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
         return AccSpec(fn, (("sum", sdt, "sum"), ("count", DataType.INT64, "sum")),
                        res)
     if fn in ("min", "max"):
+        if dt == DataType.STRING:
+            # single state field; validity rides inside the string acc
+            # tuple (chars, lens, valid) — see _merge_kernel's _STR_KINDS
+            return AccSpec(fn, (("val", DataType.STRING, f"s{fn}"),),
+                           (dt, p, s))
         return AccSpec(fn, (("val", dt, fn), ("has", DataType.BOOL, "or")),
                        (dt, p, s))
     if fn in ("first", "first_ignores_null"):
+        if dt == DataType.STRING:
+            kind = "sfirst_ign" if fn == "first_ignores_null" else "sfirst"
+            return AccSpec(fn, (("val", DataType.STRING, kind),), (dt, p, s))
         return AccSpec(fn, (("val", dt, "first"), ("has", DataType.BOOL, "or")),
                        (dt, p, s))
     if fn in ("collect_list", "collect_set"):
@@ -142,14 +156,17 @@ def _list_column_from_acc(acc, validity):
 
 def _cat_acc(a, b):
     """Concatenate two accumulator entries along the row axis; list
-    accumulators (values, lens) additionally unify their element counts."""
+    accumulators (values, lens) and string accumulators (chars, lens,
+    valid) additionally unify their element/width counts."""
     if isinstance(a, tuple):
         ea, eb = a[0].shape[1], b[0].shape[1]
         e = max(ea, eb)
         av = jnp.pad(a[0], ((0, 0), (0, e - ea))) if ea < e else a[0]
         bv = jnp.pad(b[0], ((0, 0), (0, e - eb))) if eb < e else b[0]
-        return (jnp.concatenate([av, bv]),
-                jnp.concatenate([a[1], b[1]]))
+        out = (jnp.concatenate([av, bv]), jnp.concatenate([a[1], b[1]]))
+        if len(a) == 3:   # string acc carries its validity
+            out = out + (jnp.concatenate([a[2], b[2]]),)
+        return out
     return jnp.concatenate([a, b])
 
 
@@ -276,6 +293,51 @@ def _merge_kernel(n_keys: int, acc_meta: tuple, out_cap: int):
                     glens = jnp.sum(keep, axis=1).astype(jnp.int32)
                 new_accs.append((out_vals, glens))
                 continue
+            if kind in _STR_KINDS:
+                chars, lens, v = acc
+                chars_s = chars[perm]
+                lens_s = lens[perm]
+                v_s = v[perm] & live_s
+                idx = jnp.arange(cap, dtype=jnp.int32)
+                if kind in ("sfirst", "sfirst_ign"):
+                    # representative row per group: first sorted live row
+                    # (sfirst) or first sorted VALID row (sfirst_ign)
+                    cand = jnp.where(
+                        v_s if kind == "sfirst_ign" else live_s, idx, cap)
+                    raw = jax.ops.segment_min(cand, gid,
+                                              num_segments=out_cap)
+                    fi = jnp.clip(raw, 0, cap - 1)
+                    # raw == cap means NO qualifying row (all-null group in
+                    # sfirst_ign): the clipped index then points at an
+                    # unrelated row whose validity must not leak through
+                    res_valid = v_s[fi] & (raw < cap) & out_valid
+                    new_accs.append((chars_s[fi], lens_s[fi], res_valid))
+                    continue
+                # smin/smax: string order reduces on the sort operator's
+                # order-preserving words — rank every row by value with one
+                # multi-word argsort, then segment_min of ranks picks each
+                # group's winner (reference handles all Arrow types in its
+                # AccColumn instead: datafusion-ext-plans/src/agg/acc.rs)
+                from auron_tpu.ops.sort import order_words
+                col_s = StringColumn(chars_s, lens_s, v_s)
+                words = order_words(col_s, ascending=(kind == "smin"),
+                                    nulls_first=False)
+                lw = lens_s.astype(jnp.uint64)  # tiebreak embedded NULs
+                words.append(lw if kind == "smin" else ~lw)
+                lead = jnp.where(v_s, jnp.uint64(0), jnp.uint64(1))
+                vperm = idx
+                for w in reversed([lead] + words):
+                    vperm = vperm[jnp.argsort(w[vperm], stable=True)]
+                rank = jnp.zeros(cap, jnp.int32).at[vperm].set(idx)
+                winner_rank = jax.ops.segment_min(
+                    jnp.where(v_s, rank, cap), gid, num_segments=out_cap)
+                win = vperm[jnp.clip(winner_rank, 0, cap - 1)]
+                has = jax.ops.segment_max(
+                    v_s.astype(jnp.int8), gid,
+                    num_segments=out_cap).astype(jnp.bool_)
+                new_accs.append((chars_s[win], lens_s[win],
+                                 has & out_valid))
+                continue
             acc_s = acc[perm]
             if kind == "first":
                 # value at first sorted valid row; pair-reduce via segment_min
@@ -314,7 +376,7 @@ def _state_nbytes(state) -> int:
     from auron_tpu.columnar.batch import column_nbytes
     keys, accs, _num_groups, _cap = state
     return (sum(column_nbytes(k) for k in keys)
-            + sum(a[0].nbytes + a[1].nbytes if isinstance(a, tuple)
+            + sum(sum(x.nbytes for x in a) if isinstance(a, tuple)
                   else a.nbytes for a in accs))
 
 
@@ -675,6 +737,10 @@ class AggOp(PhysicalOp):
                                      jnp.where(col.validity, col.lens, 0)))
                         idx += 1
                         continue
+                    if kind in _STR_KINDS:
+                        accs.append((col.chars, col.lens, col.validity))
+                        idx += 1
+                        continue
                     data = col.data
                     if fname == "has":
                         data = data.astype(jnp.bool_) & col.validity
@@ -707,6 +773,9 @@ class AggOp(PhysicalOp):
             v = evaluate(agg.arg, batch, in_schema, ctx)
             valid = v.validity & live
             if isinstance(v.col, StringColumn):
+                if spec.state_fields[0][2] in _STR_KINDS:
+                    accs.append((v.col.chars, v.col.lens, valid))
+                    continue
                 raise NotImplementedError(f"{agg.fn} over strings")
             for fname, fdt, kind in spec.state_fields:
                 if fname == "has":
@@ -747,7 +816,8 @@ class AggOp(PhysicalOp):
             cat_live = jnp.concatenate([s_live, live])
 
         out_cap = self.initial_capacity if state is None else state[3]
-        out_elems = [max(4, next_pow2(a[0].shape[1])) if isinstance(a, tuple)
+        out_elems = [max(4, next_pow2(a[0].shape[1]))
+                     if isinstance(a, tuple) and len(a) == 2
                      else 0 for a in cat_accs]
         while True:
             meta = tuple(zip(kinds, out_elems))
@@ -799,7 +869,10 @@ class AggOp(PhysicalOp):
                         continue
                     data = accs[i]
                     i += 1
-                    if isinstance(data, tuple):
+                    if isinstance(data, tuple) and len(data) == 3:
+                        out_cols.append(StringColumn(
+                            data[0], data[1], data[2] & valid))
+                    elif isinstance(data, tuple):
                         out_cols.append(list_col(data))
                     else:
                         out_cols.append(PrimitiveColumn(data, valid))
@@ -826,8 +899,13 @@ class AggOp(PhysicalOp):
                         avg = s / safe
                     out_cols.append(PrimitiveColumn(avg, valid & (cnt > 0)))
                 elif fn in ("min", "max", "first", "first_ignores_null"):
-                    v, has = state_vals
-                    out_cols.append(PrimitiveColumn(v, valid & has))
+                    if len(state_vals) == 1:   # string acc: validity inside
+                        chars, lens, sv = state_vals[0]
+                        out_cols.append(StringColumn(chars, lens,
+                                                     sv & valid))
+                    else:
+                        v, has = state_vals
+                        out_cols.append(PrimitiveColumn(v, valid & has))
                 elif fn in ("collect_list", "collect_set"):
                     # empty list (not null) for groups with only nulls —
                     # Spark's collect_* semantics
@@ -873,7 +951,9 @@ class AggOp(PhysicalOp):
         valid = jnp.arange(cap, dtype=jnp.int32) < num_groups
         cols = list(keys)
         for a in accs:
-            if isinstance(a, tuple):
+            if isinstance(a, tuple) and len(a) == 3:
+                cols.append(StringColumn(a[0], a[1], a[2] & valid))
+            elif isinstance(a, tuple):
                 cols.append(_list_column_from_acc(a, valid))
             else:
                 cols.append(PrimitiveColumn(a, valid))
@@ -891,6 +971,10 @@ class AggOp(PhysicalOp):
                 if kind in ("collect_list", "collect_set"):
                     accs.append((col.values,
                                  jnp.where(col.validity, col.lens, 0)))
+                    idx += 1
+                    continue
+                if kind in _STR_KINDS:
+                    accs.append((col.chars, col.lens, col.validity))
                     idx += 1
                     continue
                 data = col.data
@@ -963,6 +1047,10 @@ class AggOp(PhysicalOp):
                 # empty-input bloom/udaf: serialized empty filter /
                 # eval(zero()) — both via the normal result path
                 cols.append(host.result_column(si, [()], 1, 1, partial=False))
+            elif dt == DataType.STRING:
+                cols.append(StringColumn(jnp.zeros((1, 1), jnp.uint8),
+                                         jnp.zeros(1, jnp.int32),
+                                         jnp.zeros(1, bool)))
             else:
                 jdt = _JNPT[dt]
                 cols.append(PrimitiveColumn(jnp.zeros(1, jdt),
@@ -989,9 +1077,16 @@ def make_acc_spec_from_partial(agg: ir.AggFunction, in_schema: Schema,
         return AccSpec(fn, (("sum", f0.dtype, "sum"), ("count", DataType.INT64, "sum")),
                        (DataType.FLOAT64, 0, 0))
     if fn in ("min", "max"):
+        if f0.dtype == DataType.STRING:
+            return AccSpec(fn, (("val", DataType.STRING, f"s{fn}"),),
+                           (f0.dtype, f0.precision, f0.scale))
         return AccSpec(fn, (("val", f0.dtype, fn), ("has", DataType.BOOL, "or")),
                        (f0.dtype, f0.precision, f0.scale))
     if fn in ("first", "first_ignores_null"):
+        if f0.dtype == DataType.STRING:
+            kind = "sfirst_ign" if fn == "first_ignores_null" else "sfirst"
+            return AccSpec(fn, (("val", DataType.STRING, kind),),
+                           (f0.dtype, f0.precision, f0.scale))
         return AccSpec(fn, (("val", f0.dtype, "first"), ("has", DataType.BOOL, "or")),
                        (f0.dtype, f0.precision, f0.scale))
     if fn in ("collect_list", "collect_set"):

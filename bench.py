@@ -212,12 +212,17 @@ def main() -> None:
                       f"bench child rc={proc.returncode}")[-500:]
 
     proc = None
+    if accel_error:
+        # environmental: the accelerator never initialized
+        accel_error = f"probe: {accel_error}"
     if accel_ok:
         proc, failure = try_child(dict(os.environ))
         if proc is None:
-            # the accelerator FAILED MID-BENCH after a healthy probe —
-            # that must not be masked by a clean-looking CPU fallback
-            accel_error = failure
+            # the accelerator FAILED MID-BENCH after a HEALTHY probe —
+            # likely a product bug on the accelerator path, not an
+            # environmental failure; the stage prefix keeps the two
+            # distinguishable in the recorded line
+            accel_error = f"bench: {failure}"
     if proc is None:
         # CPU fallback env: sanitized so a hostile sitecustomize can't
         # drag the child back onto the broken accelerator
