@@ -42,9 +42,13 @@ import threading
 _tls = threading.local()
 
 
-def _compressor() -> zstandard.ZstdCompressor:
-    if not hasattr(_tls, "c"):
-        _tls.c = zstandard.ZstdCompressor(level=1)
+def _compressor(level: int = None) -> zstandard.ZstdCompressor:
+    if level is None:
+        from auron_tpu import config as cfg
+        level = cfg.get_config().get(cfg.SPILL_CODEC_LEVEL)
+    if getattr(_tls, "level", None) != level:
+        _tls.c = zstandard.ZstdCompressor(level=level)
+        _tls.level = level
     return _tls.c
 
 
@@ -183,7 +187,8 @@ def _get_buf(src: io.BytesIO, dtype, shape) -> np.ndarray:
 
 def serialize_host_batch(host: HostBatch,
                          extras: Optional[dict[str, np.ndarray]] = None,
-                         codec: str = "zstd") -> bytes:
+                         codec: str = "zstd",
+                         codec_level: Optional[int] = None) -> bytes:
     extras = extras or {}
     body = io.BytesIO()
     body.write(struct.pack("<IHH", host.num_rows, len(host.columns),
@@ -217,7 +222,7 @@ def serialize_host_batch(host: HostBatch,
 
     raw = body.getvalue()
     if codec == "zstd":
-        payload = _compressor().compress(raw)
+        payload = _compressor(codec_level).compress(raw)
         code = CODEC_ZSTD
     else:
         payload, code = raw, CODEC_NONE
@@ -263,8 +268,10 @@ def deserialize_host_batch(data: bytes) -> tuple[HostBatch, dict[str, np.ndarray
     return HostBatch(cols, num_rows), extras
 
 
-def serialize_batch(batch: DeviceBatch, codec: str = "zstd") -> bytes:
-    return serialize_host_batch(batch_to_host(batch), codec=codec)
+def serialize_batch(batch: DeviceBatch, codec: str = "zstd",
+                    codec_level: Optional[int] = None) -> bytes:
+    return serialize_host_batch(batch_to_host(batch), codec=codec,
+                                codec_level=codec_level)
 
 
 def deserialize_batch(data: bytes,

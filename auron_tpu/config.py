@@ -1,0 +1,221 @@
+"""Typed configuration registry.
+
+The reference runs a three-layer config system: typed ``ConfigOption``
+declarations with defaults and docs (reference:
+auron-core/src/main/java/org/apache/auron/configuration/ConfigOption.java),
+a Spark binding exposing ~70 ``spark.auron.*`` options (reference:
+spark-extension/src/main/java/org/apache/spark/sql/auron/
+SparkAuronConfiguration.java:42-526), and a native mirror that reads
+through JNI at use-site so the host config is the single source of truth
+(reference: native-engine/auron-jni-bridge/src/conf.rs:20-63).
+
+Here the same shape, TPU-side: declared options with defaults + docs,
+resolved at use-site through ``AuronConfig.get`` with precedence
+
+    session/programmatic override  >  env var  >  default
+
+Env binding: ``auron.agg.partial_skip.ratio`` ←
+``AURON_CONF_AGG_PARTIAL_SKIP_RATIO`` (prefix stripped, dots → ``_``,
+upper-cased). ``generate_docs()`` emits the markdown config reference
+(the reference generates docs the same way:
+SparkAuronConfigurationDocGenerator.java).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Optional
+
+
+@dataclass(frozen=True)
+class ConfigOption:
+    key: str
+    dtype: type           # int | float | bool | str
+    default: Any
+    doc: str
+
+    @property
+    def env_var(self) -> str:
+        return "AURON_CONF_" + self.key.replace("auron.", "", 1) \
+            .replace(".", "_").upper()
+
+    def parse(self, raw: str) -> Any:
+        if self.dtype is bool:
+            v = raw.strip().lower()
+            if v in ("1", "true", "yes", "on"):
+                return True
+            if v in ("0", "false", "no", "off"):
+                return False
+            raise ValueError(f"{self.key}: invalid bool {raw!r}")
+        return self.dtype(raw)
+
+
+_REGISTRY: dict[str, ConfigOption] = {}
+
+
+def _opt(key: str, dtype: type, default, doc: str) -> str:
+    assert key not in _REGISTRY, f"duplicate config option {key}"
+    _REGISTRY[key] = ConfigOption(key, dtype, default, doc)
+    return key
+
+
+# --------------------------------------------------------------------------
+# option declarations (grouped like the reference's config sections)
+# --------------------------------------------------------------------------
+
+# batching / shapes
+BATCH_CAPACITY = _opt(
+    "auron.batch.capacity", int, 1 << 16,
+    "Default rows per device batch (scan batch size and the planner's "
+    "capacity bucketing target). Larger batches amortize kernel launches; "
+    "smaller ones reduce padding waste on ragged inputs.")
+PARQUET_BATCH_ROWS = _opt(
+    "auron.io.parquet.batch_rows", int, 1 << 16,
+    "Row-group read granularity for the parquet/ORC scans when the plan "
+    "does not pin batch_rows explicitly.")
+
+# memory / spill
+MEMORY_FRACTION = _opt(
+    "auron.memory.fraction", float, 0.6,
+    "Fraction of device HBM the memory manager arbitrates across "
+    "consumers (the reference's spark.auron.memoryFraction).")
+HOST_SPILL_BUDGET = _opt(
+    "auron.memory.host_spill_budget", int, 1 << 30,
+    "Bytes of host DRAM the spill manager may hold before overflowing "
+    "frames to disk (tier 2 of the HBM->DRAM->disk spill path).")
+SPILL_DIR = _opt(
+    "auron.memory.spill_dir", str, "",
+    "Directory for disk spill files; empty = system temp dir.")
+SPILL_FRAME_ROWS = _opt(
+    "auron.spill.frame_rows", int, 1 << 16,
+    "Rows per serialized spill frame (the unit of spill I/O and of the "
+    "k-way merge restore).")
+SPILL_CODEC_LEVEL = _opt(
+    "auron.spill.codec_level", int, 1,
+    "zstd compression level for spill/shuffle frames (the reference "
+    "defaults its IPC compression to lz4/zstd level 1).")
+
+# aggregation
+AGG_INITIAL_CAPACITY = _opt(
+    "auron.agg.initial_capacity", int, 4096,
+    "Initial group-state capacity of the agg merge kernel; grows by "
+    "power-of-two re-bucketing when exceeded.")
+AGG_PARTIAL_SKIP_ENABLED = _opt(
+    "auron.agg.partial_skip.enabled", bool, True,
+    "Adaptive partial-agg skipping: when the observed group/input "
+    "cardinality ratio stays high, the partial stage stops merging and "
+    "passes rows through in state layout (the reference's "
+    "spark.auron.partialAggSkipping.*, agg_ctx.rs:63-196).")
+AGG_PARTIAL_SKIP_RATIO = _opt(
+    "auron.agg.partial_skip.ratio", float, 0.8,
+    "Cardinality ratio (distinct groups / input rows) at or above which "
+    "the partial agg switches to pass-through.")
+AGG_PARTIAL_SKIP_MIN_ROWS = _opt(
+    "auron.agg.partial_skip.min_rows", int, 1 << 16,
+    "Input rows to observe before the skip decision is made.")
+AGG_DENSE_KERNEL_MAX_DOMAIN = _opt(
+    "auron.agg.dense_kernel.max_domain", int, 1 << 16,
+    "Upper bound on the group-key domain for which the planner selects "
+    "the dense one-hot/MXU aggregation kernel instead of the general "
+    "sort-based path.")
+
+# joins
+SMJ_FALLBACK_ENABLED = _opt(
+    "auron.join.smj_fallback.enabled", bool, True,
+    "Allow falling back from sort-merge join to hash join when the "
+    "inputs are not already sorted (mirrors "
+    "spark.auron.forceSortMergeJoin handling, conf.rs:53-55).")
+
+# exchange / shuffle
+EXCHANGE_SPILL_ENABLED = _opt(
+    "auron.exchange.spill.enabled", bool, True,
+    "Register exchange partition buckets with the memory manager and "
+    "spill them to host storage under pressure.")
+
+# observability
+METRICS_DEVICE_SYNC = _opt(
+    "auron.metrics.device_sync", bool, False,
+    "Synchronize (device readback) around per-op timers so "
+    "elapsed_compute measures device time instead of async dispatch. "
+    "Adds per-batch latency; enable for profiling runs.")
+
+
+# --------------------------------------------------------------------------
+# resolution
+# --------------------------------------------------------------------------
+
+class AuronConfig:
+    """One resolved configuration: programmatic overrides > env > default."""
+
+    def __init__(self, overrides: Optional[dict] = None):
+        self._overrides: dict[str, Any] = {}
+        self._lock = threading.Lock()
+        for k, v in (overrides or {}).items():
+            self.set(k, v)
+
+    def set(self, key: str, value) -> "AuronConfig":
+        opt = _REGISTRY.get(key)
+        if opt is None:
+            raise KeyError(f"unknown config option {key!r}; "
+                           f"known: {sorted(_REGISTRY)}")
+        if isinstance(value, str) and opt.dtype is not str:
+            value = opt.parse(value)
+        if opt.dtype is float and isinstance(value, int):
+            value = float(value)
+        if not isinstance(value, opt.dtype):
+            raise TypeError(f"{key} expects {opt.dtype.__name__}, "
+                            f"got {type(value).__name__}")
+        with self._lock:
+            self._overrides[key] = value
+        return self
+
+    def unset(self, key: str) -> None:
+        with self._lock:
+            self._overrides.pop(key, None)
+
+    def get(self, key: str):
+        opt = _REGISTRY.get(key)
+        if opt is None:
+            raise KeyError(f"unknown config option {key!r}")
+        with self._lock:
+            if key in self._overrides:
+                return self._overrides[key]
+        raw = os.environ.get(opt.env_var)
+        if raw is not None:
+            return opt.parse(raw)
+        return opt.default
+
+
+#: process-wide default config; ExecContext carries a per-execution one
+#: that defaults to this (the "session" layer)
+_GLOBAL = AuronConfig()
+
+
+def get_config() -> AuronConfig:
+    return _GLOBAL
+
+
+def options() -> list[ConfigOption]:
+    return sorted(_REGISTRY.values(), key=lambda o: o.key)
+
+
+def generate_docs() -> str:
+    """Markdown config reference (the doc-generator analogue of the
+    reference's SparkAuronConfigurationDocGenerator.java)."""
+    lines = [
+        "# Configuration reference",
+        "",
+        "Resolution order: session override (`AuronConfig.set`) > env var "
+        "> default. Env binding: drop the `auron.` prefix, upper-case, "
+        "dots to underscores, prepend `AURON_CONF_`.",
+        "",
+        "| Option | Type | Default | Env var | Description |",
+        "|---|---|---|---|---|",
+    ]
+    for o in options():
+        default = repr(o.default) if o.dtype is str else str(o.default)
+        lines.append(f"| `{o.key}` | {o.dtype.__name__} | {default} "
+                     f"| `{o.env_var}` | {o.doc} |")
+    return "\n".join(lines) + "\n"

@@ -24,8 +24,12 @@ from auron_tpu.runtime.executor import collect as _collect
 
 
 class Session:
-    def __init__(self, batch_capacity: int = 1 << 16, mem_manager=None):
-        self.ctx = PlannerContext(batch_capacity=batch_capacity)
+    def __init__(self, batch_capacity: Optional[int] = None, mem_manager=None,
+                 config=None):
+        from auron_tpu.config import get_config
+        self.config = config or get_config()
+        self.ctx = PlannerContext(batch_capacity=batch_capacity,
+                                  config=self.config)
         self.mem_manager = mem_manager
         self._ids = itertools.count()
         #: host-fallback registrations: rid -> (child DataFrame, fn)
@@ -120,4 +124,4 @@ class Session:
     def execute(self, df: DataFrame) -> pa.Table:
         op = self.plan_physical(df)
         return _collect(op, num_partitions=df.num_partitions,
-                        mem_manager=self.mem_manager)
+                        mem_manager=self.mem_manager, config=self.config)

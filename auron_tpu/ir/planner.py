@@ -34,7 +34,16 @@ class PlannerContext:
 
     catalog: dict[str, Any] = field(default_factory=dict)
     resources: dict[str, Any] = field(default_factory=dict)
-    batch_capacity: int = 1 << 16
+    # None = resolve from the typed config (auron.batch.capacity)
+    batch_capacity: Optional[int] = None
+    config: Optional[Any] = None
+
+    def __post_init__(self):
+        from auron_tpu import config as cfg
+        if self.config is None:
+            self.config = cfg.get_config()
+        if self.batch_capacity is None:
+            self.batch_capacity = self.config.get(cfg.BATCH_CAPACITY)
 
     def put_resource(self, rid: str, value: Any) -> None:
         self.resources[rid] = value
@@ -133,6 +142,7 @@ class PhysicalPlanner:
                          list(n.names))
 
     def _plan_agg(self, n: pb.AggNode) -> PhysicalOp:
+        from auron_tpu import config as cfg
         from auron_tpu.ops.agg import AggOp
         return AggOp(
             self.create_plan(n.child),
@@ -141,6 +151,7 @@ class PhysicalPlanner:
             mode=n.mode or "complete",
             group_names=list(n.group_names) or None,
             agg_names=list(n.agg_names) or None,
+            initial_capacity=self.ctx.config.get(cfg.AGG_INITIAL_CAPACITY),
         )
 
     def _plan_sort(self, n: pb.SortNode) -> PhysicalOp:

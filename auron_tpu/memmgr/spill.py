@@ -101,8 +101,14 @@ class Spill:
 class SpillManager:
     """Owns the host-DRAM spill budget and the spill directory."""
 
-    def __init__(self, host_budget_bytes: int = 1 << 30,
+    def __init__(self, host_budget_bytes: Optional[int] = None,
                  spill_dir: Optional[str] = None):
+        from auron_tpu import config as cfg
+        conf = cfg.get_config()
+        if host_budget_bytes is None:
+            host_budget_bytes = conf.get(cfg.HOST_SPILL_BUDGET)
+        if spill_dir is None:
+            spill_dir = conf.get(cfg.SPILL_DIR) or None
         self.host_budget = host_budget_bytes
         self.spill_dir = spill_dir
         self._lock = threading.Lock()

@@ -572,11 +572,15 @@ class _AggSpillConsumer:
 
     FRAME_ROWS = 1 << 16
 
-    def __init__(self, op: "AggOp", mem_manager, metrics):
+    def __init__(self, op: "AggOp", mem_manager, metrics, conf=None):
         import threading
+        from auron_tpu import config as cfg
         self.op = op
         self.mem = mem_manager
         self.metrics = metrics
+        conf = conf or cfg.get_config()
+        self.frame_rows = conf.get(cfg.SPILL_FRAME_ROWS)
+        self.codec_level = conf.get(cfg.SPILL_CODEC_LEVEL)
         self.consumer_name = f"agg-{id(op):x}"
         self.state = None
         self.spills = []
@@ -619,25 +623,40 @@ class _AggSpillConsumer:
         n = int(state_batch.num_rows)
         host = batch_to_host(state_batch, n)
         spill = self.mem.spill_manager.new_spill()
-        for lo in range(0, max(n, 1), self.FRAME_ROWS):
-            hi = min(lo + self.FRAME_ROWS, n)
+        for lo in range(0, max(n, 1), self.frame_rows):
+            hi = min(lo + self.frame_rows, n)
             spill.write_frame(
-                serialize_host_batch(slice_host_batch(host, lo, hi)))
+                serialize_host_batch(slice_host_batch(host, lo, hi),
+                                     codec_level=self.codec_level))
         with self._lock:
             self.spills.append(spill.finish())
         self.metrics.counter("mem_spill_count").add(1)
         self.metrics.counter("mem_spill_size").add(freed)
         return freed
 
-    def read_spilled_states(self):
+    @staticmethod
+    def _restored_batches(spill):
         from auron_tpu.columnar.serde import (deserialize_host_batch,
                                               host_to_batch)
         from auron_tpu.utils.shapes import bucket_rows
+        for frame in spill.frames():
+            host, _ = deserialize_host_batch(frame)
+            if host.num_rows:
+                yield host_to_batch(host, bucket_rows(host.num_rows))
+
+    def read_spilled_states(self):
         for spill in self.spills:
-            for frame in spill.frames():
-                host, _ = deserialize_host_batch(frame)
-                if host.num_rows:
-                    yield host_to_batch(host, bucket_rows(host.num_rows))
+            yield from self._restored_batches(spill)
+
+    def drain_spilled_states(self):
+        """read_spilled_states, then release + clear — used when the
+        operator folds spilled runs back in mid-stream (partial-agg skip
+        switchover) rather than at close."""
+        with self._lock:
+            spills, self.spills = self.spills, []
+        for spill in spills:
+            yield from self._restored_batches(spill)
+            spill.release()
 
     def close(self) -> None:
         self.mem.unregister_consumer(self)
@@ -984,20 +1003,56 @@ class AggOp(PhysicalOp):
                 idx += 1
         return keys, accs, live
 
+    def _passthrough_batch(self, keys, accs, live, num_rows) -> DeviceBatch:
+        """One input batch re-expressed in partial-state layout without
+        merging — each row is its own group (adaptive partial-agg
+        skipping, reference: agg/agg_ctx.rs:63-196)."""
+        cols = list(keys)
+        for a in accs:
+            if isinstance(a, tuple) and len(a) == 3:
+                cols.append(StringColumn(a[0], a[1], a[2]))
+            elif isinstance(a, tuple):
+                cols.append(_list_column_from_acc(a, live))
+            else:
+                cols.append(PrimitiveColumn(a, live))
+        return DeviceBatch(tuple(cols), num_rows)
+
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from auron_tpu import config as cfg
         metrics = ctx.metrics_for(self.name)
         elapsed = metrics.counter("elapsed_compute")
         in_schema = self.child.schema()
         ectx = EvalContext(partition_id=partition)
         mem = ctx.mem_manager
         spillable = mem is not None and getattr(mem, "spill_manager", None) is not None
+        conf = ctx.conf
+        # adaptive partial-agg skipping: only meaningful for keyed partial
+        # stages with pure device accumulators (host-side bloom/udaf state
+        # cannot pass through row-wise)
+        skip_enabled = (self.mode == "partial" and bool(self.group_exprs)
+                        and conf.get(cfg.AGG_PARTIAL_SKIP_ENABLED))
+        skip_ratio = conf.get(cfg.AGG_PARTIAL_SKIP_RATIO)
+        skip_min_rows = conf.get(cfg.AGG_PARTIAL_SKIP_MIN_ROWS)
 
         def stream():
-            consumer = _AggSpillConsumer(self, mem, metrics) if spillable else None
+            consumer = _AggSpillConsumer(self, mem, metrics, conf) \
+                if spillable else None
             host = _HostAggState(self, in_schema)
             state = None
+            skipping = False
+            rows_seen = 0
+            # host-side bloom/udaf state cannot pass through row-wise
+            skip_pending = skip_enabled and host.empty()
+            skipped_rows = metrics.counter("partial_agg_skipped_rows")
             try:
                 for batch in self.child.execute(partition, ctx):
+                    if skipping:
+                        keys, accs, live = self._contributions(
+                            batch, in_schema, ectx)
+                        skipped_rows.add(int(batch.num_rows))
+                        yield self._passthrough_batch(keys, accs, live,
+                                                      batch.num_rows)
+                        continue
                     if self.mode == "final":
                         host.merge_partial(batch)
                     else:
@@ -1010,6 +1065,38 @@ class AggOp(PhysicalOp):
                     state = self._merge(state, keys, accs, live, elapsed)
                     if consumer is not None:
                         state = consumer.observe(state)
+                    if not skip_pending:
+                        continue
+                    # decide ONCE when min_rows is crossed, then latch
+                    # either way (the reference also decides at a fixed
+                    # observation point, agg_ctx.rs:63-196) — so the steady
+                    # state pays no per-batch device sync for bookkeeping
+                    rows_seen += int(batch.num_rows)
+                    if rows_seen < skip_min_rows:
+                        continue
+                    skip_pending = False  # decision point reached: latch
+                    if consumer is not None:
+                        state = consumer.take_state()
+                    ng = 0 if state is None else int(state[2])
+                    if state is not None and ng >= skip_ratio * rows_seen:
+                        # fold any spilled runs in, flush the merged
+                        # state, then pass the rest of the input through
+                        if consumer is not None:
+                            for spilled in consumer.drain_spilled_states():
+                                k2, a2, l2 = self._state_contributions(
+                                    spilled)
+                                state = self._merge(state, k2, a2, l2,
+                                                    elapsed)
+                        yield self._emit(state, in_schema, host)
+                        state = None
+                        skipping = True
+                        if consumer is not None:
+                            consumer.observe(None)
+                        continue
+                    if consumer is not None:
+                        state = consumer.observe(state)
+                if skipping:
+                    return
                 if consumer is not None:
                     # re-take: locks out external spills for the final merge
                     # (consumer.state is the source of truth, the local var

@@ -79,6 +79,15 @@ class ExecContext:
     mem_manager: Optional[object] = None
     # cancellation flag checked by long-running operators
     cancelled: bool = False
+    # typed config (auron_tpu.config); None = process-wide defaults
+    config: Optional[object] = None
+
+    @property
+    def conf(self):
+        if self.config is None:
+            from auron_tpu.config import get_config
+            self.config = get_config()
+        return self.config
 
     def metrics_for(self, op_name: str) -> MetricsSet:
         if op_name not in self.metrics:

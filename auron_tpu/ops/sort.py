@@ -194,13 +194,16 @@ class _SortSpillConsumer:
     tiered storage with its order words."""
 
     def __init__(self, op: "SortOp", in_schema: Schema, mem_manager,
-                 metrics, frame_rows: int = 1 << 16):
+                 metrics, frame_rows: Optional[int] = None, conf=None):
         import threading
+        from auron_tpu import config as cfg
+        conf = conf or cfg.get_config()
         self.op = op
         self.in_schema = in_schema
         self.mem = mem_manager
         self.metrics = metrics
-        self.frame_rows = frame_rows
+        self.frame_rows = frame_rows or conf.get(cfg.SPILL_FRAME_ROWS)
+        self.codec_level = conf.get(cfg.SPILL_CODEC_LEVEL)
         self.consumer_name = f"sort-{id(op):x}"
         self.buffered: list[DeviceBatch] = []
         self.bytes = 0
@@ -248,7 +251,8 @@ class _SortSpillConsumer:
             spill.write_frame(serialize_host_batch(
                 slice_host_batch(host, lo, hi),
                 extras={ORDER_WORDS_EXTRA: host_words[lo:hi],
-                        WORD_LAYOUT_EXTRA: layout}))
+                        WORD_LAYOUT_EXTRA: layout},
+                codec_level=self.codec_level))
         with self._lock:
             self.spills.append(spill.finish())
         self.metrics.counter("mem_spill_count").add(1)
@@ -325,7 +329,8 @@ class SortOp(PhysicalOp):
                 yield from self._limit(
                     in_mem_stream(list(self.child.execute(partition, ctx))))
                 return
-            consumer = _SortSpillConsumer(self, in_schema, mem, metrics)
+            consumer = _SortSpillConsumer(self, in_schema, mem, metrics,
+                                          conf=ctx.conf)
             try:
                 for batch in self.child.execute(partition, ctx):
                     consumer.add(batch)

@@ -38,7 +38,7 @@ class ExecutionRuntime:
     """Runs one (plan, partition) pair to completion."""
 
     def __init__(self, plan: PhysicalOp, task: TaskDefinition,
-                 mem_manager=None):
+                 mem_manager=None, config=None):
         self.plan = plan
         self.task = task
         self.ctx = ExecContext(
@@ -47,6 +47,7 @@ class ExecutionRuntime:
             task_id=task.task_id,
             num_partitions=task.num_partitions,
             mem_manager=mem_manager,
+            config=config,
         )
         self._started = time.time()
 
@@ -84,12 +85,12 @@ class ExecutionRuntime:
 
 
 def collect(plan: PhysicalOp, num_partitions: int = 1,
-            mem_manager=None) -> pa.Table:
+            mem_manager=None, config=None) -> pa.Table:
     """Run every partition of a plan and concatenate (driver-side collect)."""
     tables = []
     for p in range(num_partitions):
         rt = ExecutionRuntime(
             plan, TaskDefinition(partition_id=p, num_partitions=num_partitions),
-            mem_manager=mem_manager)
+            mem_manager=mem_manager, config=config)
         tables.append(rt.collect())
     return pa.concat_tables(tables)
