@@ -97,6 +97,11 @@ SPILL_CODEC_LEVEL = _opt(
     "zstd compression level for spill/shuffle frames (the reference "
     "defaults its IPC compression to lz4/zstd level 1).")
 
+# NOTE: options are declared only once a use-site exists — an option in
+# CONFIG.md that nothing reads is a lie to the user. SMJ-fallback,
+# exchange-spill, dense-kernel-selection, and device-sync-metrics knobs
+# land together with their features.
+
 # aggregation
 AGG_INITIAL_CAPACITY = _opt(
     "auron.agg.initial_capacity", int, 4096,
@@ -115,31 +120,6 @@ AGG_PARTIAL_SKIP_RATIO = _opt(
 AGG_PARTIAL_SKIP_MIN_ROWS = _opt(
     "auron.agg.partial_skip.min_rows", int, 1 << 16,
     "Input rows to observe before the skip decision is made.")
-AGG_DENSE_KERNEL_MAX_DOMAIN = _opt(
-    "auron.agg.dense_kernel.max_domain", int, 1 << 16,
-    "Upper bound on the group-key domain for which the planner selects "
-    "the dense one-hot/MXU aggregation kernel instead of the general "
-    "sort-based path.")
-
-# joins
-SMJ_FALLBACK_ENABLED = _opt(
-    "auron.join.smj_fallback.enabled", bool, True,
-    "Allow falling back from sort-merge join to hash join when the "
-    "inputs are not already sorted (mirrors "
-    "spark.auron.forceSortMergeJoin handling, conf.rs:53-55).")
-
-# exchange / shuffle
-EXCHANGE_SPILL_ENABLED = _opt(
-    "auron.exchange.spill.enabled", bool, True,
-    "Register exchange partition buckets with the memory manager and "
-    "spill them to host storage under pressure.")
-
-# observability
-METRICS_DEVICE_SYNC = _opt(
-    "auron.metrics.device_sync", bool, False,
-    "Synchronize (device readback) around per-op timers so "
-    "elapsed_compute measures device time instead of async dispatch. "
-    "Adds per-batch latency; enable for profiling runs.")
 
 
 # --------------------------------------------------------------------------

@@ -74,6 +74,10 @@ class PhysicalPlanner:
 
     # -- sources ------------------------------------------------------------
 
+    def _file_scan_batch_rows(self) -> int:
+        from auron_tpu import config as cfg
+        return self.ctx.config.get(cfg.PARQUET_BATCH_ROWS)
+
     def _plan_parquet_scan(self, n: pb.ParquetScanNode) -> PhysicalOp:
         from auron_tpu.io.parquet import ParquetScanOp
         return ParquetScanOp(
@@ -81,7 +85,7 @@ class PhysicalPlanner:
             schema=serde.parse_schema(n.schema) if n.schema.fields else None,
             columns=list(n.columns) or None,
             predicates=[serde.parse_expr(p) for p in n.predicates],
-            batch_rows=n.batch_rows or self.ctx.batch_capacity,
+            batch_rows=n.batch_rows or self._file_scan_batch_rows(),
         )
 
     def _plan_orc_scan(self, n: pb.OrcScanNode) -> PhysicalOp:
@@ -90,7 +94,7 @@ class PhysicalPlanner:
             files=list(n.files),
             schema=serde.parse_schema(n.schema) if n.schema.fields else None,
             columns=list(n.columns) or None,
-            batch_rows=n.batch_rows or self.ctx.batch_capacity,
+            batch_rows=n.batch_rows or self._file_scan_batch_rows(),
         )
 
     def _plan_memory_scan(self, n: pb.MemoryScanNode) -> PhysicalOp:

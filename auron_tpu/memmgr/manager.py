@@ -38,9 +38,11 @@ class MemConsumer:
 
 
 class MemManager:
-    def __init__(self, total_bytes: int,
+    def __init__(self, total_bytes: Optional[int] = None,
                  min_trigger: int = MIN_TRIGGER_SIZE,
                  spill_manager: Optional["object"] = None):
+        if total_bytes is None:
+            total_bytes = self.default_budget()
         self.total = total_bytes
         self.min_trigger = min_trigger
         self.spill_manager = spill_manager
@@ -48,6 +50,23 @@ class MemManager:
         self._used: dict[MemConsumer, int] = {}
         self.num_spills = 0
         self.spilled_bytes = 0
+
+    @staticmethod
+    def default_budget() -> int:
+        """auron.memory.fraction of the device's HBM (the reference's
+        spark.auron.memoryFraction × executor memory); falls back to a
+        conservative 8 GB figure when the backend doesn't report a limit
+        (e.g. the CPU test mesh)."""
+        from auron_tpu import config as cfg
+        fraction = cfg.get_config().get(cfg.MEMORY_FRACTION)
+        limit = 8 << 30
+        try:
+            import jax
+            stats = jax.devices()[0].memory_stats() or {}
+            limit = int(stats.get("bytes_limit", limit)) or limit
+        except Exception:
+            pass
+        return int(limit * fraction)
 
     # -- registration -------------------------------------------------------
 

@@ -34,8 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from auron_tpu.columnar.batch import (DeviceBatch, PrimitiveColumn, StringColumn,
-                                      concat_columns, gather_column,
-                                      unify_column_widths)
+                                      gather_column, unify_column_widths)
 from auron_tpu.columnar.schema import DataType, Field, Schema
 from auron_tpu.exprs import ir
 from auron_tpu.exprs.eval import EvalContext, TypedValue, evaluate, infer_dtype
@@ -121,7 +120,7 @@ def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
     if fn in ("min", "max"):
         if dt == DataType.STRING:
             # single state field; validity rides inside the string acc
-            # tuple (chars, lens, valid) — see _merge_kernel's _STR_KINDS
+            # tuple (chars, lens, valid) — see _reduce_sorted's _STR_KINDS
             return AccSpec(fn, (("val", DataType.STRING, f"s{fn}"),),
                            (dt, p, s))
         return AccSpec(fn, (("val", dt, fn), ("has", DataType.BOOL, "or")),
@@ -154,20 +153,21 @@ def _list_column_from_acc(acc, validity):
     return ListColumn(vals, ev, lens, validity)
 
 
-def _cat_acc(a, b):
-    """Concatenate two accumulator entries along the row axis; list
-    accumulators (values, lens) and string accumulators (chars, lens,
-    valid) additionally unify their element/width counts."""
-    if isinstance(a, tuple):
-        ea, eb = a[0].shape[1], b[0].shape[1]
-        e = max(ea, eb)
-        av = jnp.pad(a[0], ((0, 0), (0, e - ea))) if ea < e else a[0]
-        bv = jnp.pad(b[0], ((0, 0), (0, e - eb))) if eb < e else b[0]
-        out = (jnp.concatenate([av, bv]), jnp.concatenate([a[1], b[1]]))
-        if len(a) == 3:   # string acc carries its validity
-            out = out + (jnp.concatenate([a[2], b[2]]),)
-        return out
-    return jnp.concatenate([a, b])
+def _unify_acc_pair(accs_a: tuple, accs_b: tuple) -> tuple[tuple, tuple]:
+    """Pad the trailing (element-count / char-width) dimension of paired
+    tuple accumulators so state and batch sides can merge shape-to-shape."""
+    out_a, out_b = [], []
+    for a, b in zip(accs_a, accs_b):
+        if isinstance(a, tuple):
+            ea, eb = a[0].shape[1], b[0].shape[1]
+            e = max(ea, eb)
+            if ea < e:
+                a = (jnp.pad(a[0], ((0, 0), (0, e - ea))),) + a[1:]
+            if eb < e:
+                b = (jnp.pad(b[0], ((0, 0), (0, e - eb))),) + b[1:]
+        out_a.append(a)
+        out_b.append(b)
+    return tuple(out_a), tuple(out_b)
 
 
 # neutral elements per reduce kind
@@ -207,162 +207,248 @@ def _keys_equal_prev(sorted_keys, live):
     return eq
 
 
+#: dead rows / invalid state slots carry this hash so they sort last; the
+#: (astronomically unlikely) real hash equal to it is still correct — such
+#: rows group among themselves via the exact key compare
+_HASH_SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _gather_acc(acc, perm):
+    if isinstance(acc, tuple):
+        return tuple(x[perm] for x in acc)
+    return acc[perm]
+
+
+def _reduce_sorted(keys_s, accs_s, live_s, h_s, acc_meta, out_cap):
+    """Group + reduce rows that are ALREADY sorted by (dead-last, hash
+    asc). Shared by the batch-reduce and state-merge kernels. Returns
+    (new_keys, new_accs, h_out, num_groups, needed_elems); outputs stay
+    hash-sorted (reps are increasing), which is the state invariant the
+    merge-by-searchsorted path relies on."""
+    cap = live_s.shape[0]
+    same_hash = jnp.concatenate(
+        [jnp.zeros(1, bool), h_s[1:] == h_s[:-1]])
+    same_keys = _keys_equal_prev(keys_s, live_s)
+    boundary = live_s & ~(same_hash & same_keys)
+    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    gid = jnp.maximum(gid, 0)
+    num_groups = jnp.sum(boundary.astype(jnp.int32))
+
+    # first sorted row of each group → representative for keys
+    rep = jax.ops.segment_min(
+        jnp.where(live_s, jnp.arange(cap, dtype=jnp.int32), cap),
+        gid, num_segments=out_cap)
+    rep = jnp.clip(rep, 0, cap - 1)
+    out_valid = jnp.arange(out_cap, dtype=jnp.int32) < num_groups
+    new_keys = tuple(gather_column(c, rep, out_valid) for c in keys_s)
+    h_out = jnp.where(out_valid, h_s[rep], _HASH_SENTINEL)
+
+    new_accs = []
+    needed_elems = []
+    for (kind, out_elems), acc in zip(acc_meta, accs_s):
+        if kind in ("collect_list", "collect_set"):
+            vals_s, lens_in = acc     # [cap, in_E], [cap] (already sorted)
+            in_e = vals_s.shape[1]
+            lens_s = jnp.where(live_s, lens_in, 0)
+            # within-group exclusive element offset: global exclusive
+            # cumsum minus the group's base (cumsum at its first row)
+            cum = jnp.cumsum(lens_s)
+            excl = cum - lens_s
+            base = excl[rep]          # [out_cap]
+            start = excl - base[gid]
+            j = jnp.arange(in_e, dtype=jnp.int32)[None, :]
+            flat = gid[:, None] * out_elems + start[:, None] + j
+            ok = (live_s[:, None] & (j < lens_s[:, None])
+                  & ((start[:, None] + j) < out_elems))
+            flat = jnp.where(ok, flat, out_cap * out_elems)
+            out_vals = jnp.zeros((out_cap * out_elems,), vals_s.dtype).at[
+                flat.reshape(-1)].set(vals_s.reshape(-1), mode="drop")
+            out_vals = out_vals.reshape(out_cap, out_elems)
+            glens_raw = jax.ops.segment_sum(lens_s, gid,
+                                            num_segments=out_cap)
+            needed_elems.append(jnp.max(glens_raw))
+            glens = jnp.minimum(glens_raw, out_elems)
+            if kind == "collect_set":
+                # per-group dedupe, sort-based so memory stays
+                # O(cap * E): row-wise lexsort by (is_pad, value) pushes
+                # padding last and groups equal values adjacently; keep
+                # first-of-run, compact left. Set order is unspecified
+                # (as in Spark), so reordering is free.
+                jj = jnp.arange(out_elems, dtype=jnp.int32)
+                pad = jj[None, :] >= glens[:, None]
+                s_pad, s_vals = jax.lax.sort(
+                    (pad, out_vals), dimension=1, num_keys=2)
+                neq = s_vals[:, 1:] != s_vals[:, :-1]
+                keep = ~s_pad & jnp.concatenate(
+                    [jnp.ones((out_cap, 1), bool), neq], axis=1)
+                pos = jnp.cumsum(keep, axis=1) - 1
+                row = jnp.arange(out_cap, dtype=jnp.int32)[:, None]
+                flat2 = jnp.where(keep, row * out_elems + pos,
+                                  out_cap * out_elems)
+                out_vals = jnp.zeros((out_cap * out_elems,),
+                                     vals_s.dtype).at[
+                    flat2.reshape(-1)].set(s_vals.reshape(-1),
+                                           mode="drop")
+                out_vals = out_vals.reshape(out_cap, out_elems)
+                glens = jnp.sum(keep, axis=1).astype(jnp.int32)
+            new_accs.append((out_vals, glens))
+            continue
+        if kind in _STR_KINDS:
+            chars_s, lens_s, v = acc   # already sorted components
+            v_s = v & live_s
+            idx = jnp.arange(cap, dtype=jnp.int32)
+            if kind in ("sfirst", "sfirst_ign"):
+                # representative row per group: first sorted live row
+                # (sfirst) or first sorted VALID row (sfirst_ign)
+                cand = jnp.where(
+                    v_s if kind == "sfirst_ign" else live_s, idx, cap)
+                raw = jax.ops.segment_min(cand, gid,
+                                          num_segments=out_cap)
+                fi = jnp.clip(raw, 0, cap - 1)
+                # raw == cap means NO qualifying row (all-null group in
+                # sfirst_ign): the clipped index then points at an
+                # unrelated row whose validity must not leak through
+                res_valid = v_s[fi] & (raw < cap) & out_valid
+                new_accs.append((chars_s[fi], lens_s[fi], res_valid))
+                continue
+            # smin/smax: string order reduces on the sort operator's
+            # order-preserving words — rank every row by value with one
+            # multi-word argsort, then segment_min of ranks picks each
+            # group's winner (reference handles all Arrow types in its
+            # AccColumn instead: datafusion-ext-plans/src/agg/acc.rs)
+            from auron_tpu.ops.sort import order_words
+            col_s = StringColumn(chars_s, lens_s, v_s)
+            words = order_words(col_s, ascending=(kind == "smin"),
+                                nulls_first=False)
+            lw = lens_s.astype(jnp.uint64)  # tiebreak embedded NULs
+            words.append(lw if kind == "smin" else ~lw)
+            lead = jnp.where(v_s, jnp.uint64(0), jnp.uint64(1))
+            vperm = idx
+            for w in reversed([lead] + words):
+                vperm = vperm[jnp.argsort(w[vperm], stable=True)]
+            rank = jnp.zeros(cap, jnp.int32).at[vperm].set(idx)
+            winner_rank = jax.ops.segment_min(
+                jnp.where(v_s, rank, cap), gid, num_segments=out_cap)
+            win = vperm[jnp.clip(winner_rank, 0, cap - 1)]
+            has = jax.ops.segment_max(
+                v_s.astype(jnp.int8), gid,
+                num_segments=out_cap).astype(jnp.bool_)
+            new_accs.append((chars_s[win], lens_s[win],
+                             has & out_valid))
+            continue
+        acc_s = acc
+        if kind == "first":
+            # value at first sorted valid row; pair-reduce via segment_min
+            # over (order, value-index)
+            first_idx = jax.ops.segment_min(
+                jnp.where(live_s, jnp.arange(cap, dtype=jnp.int32), cap),
+                gid, num_segments=out_cap)
+            first_idx = jnp.clip(first_idx, 0, cap - 1)
+            new_accs.append(acc_s[first_idx])
+            continue
+        neutral = _neutral(kind, acc.dtype)
+        masked = jnp.where(live_s, acc_s, neutral)
+        if kind == "sum":
+            red = jax.ops.segment_sum(masked, gid, num_segments=out_cap)
+        elif kind == "min":
+            red = jax.ops.segment_min(masked, gid, num_segments=out_cap)
+        elif kind == "max":
+            red = jax.ops.segment_max(masked, gid, num_segments=out_cap)
+        elif kind == "or":
+            red = jax.ops.segment_max(masked.astype(jnp.int8), gid,
+                                      num_segments=out_cap).astype(jnp.bool_)
+        else:
+            raise ValueError(kind)
+        new_accs.append(red)
+    return new_keys, tuple(new_accs), h_out, num_groups, tuple(needed_elems)
+
+
 @lru_cache(maxsize=256)
-def _merge_kernel(n_keys: int, acc_meta: tuple, out_cap: int):
-    """Builds the jitted merge: (concat'd keys, accs, live) → state of
-    capacity out_cap. acc_meta: tuple of (kind, out_elems) per state column
-    (out_elems only meaningful for collect kinds). Returns
-    (keys, accs, num_groups, needed_elems) where needed_elems carries the
-    true max list length per collect acc so the driver can grow E."""
+def _batch_reduce_kernel(n_keys: int, acc_meta: tuple, cap: int):
+    """(keys, accs, live) of one batch → its own group table, hash-sorted.
+    One O(B log B) sort of the BATCH only — the state is never re-sorted
+    (it merges by binary search in _state_merge_kernel). acc_meta: tuple
+    of (kind, out_elems) per state column. Returns (keys, accs, hashes,
+    num_groups, needed_elems)."""
 
     @jax.jit
     def kernel(keys, accs, live):
-        cap = live.shape[0]
         h = hashing.xxhash64_columns(list(keys), cap).view(jnp.uint64)
-        # dead rows to the end
-        h = jnp.where(live, h, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+        h = jnp.where(live, h, _HASH_SENTINEL)  # dead rows to the end
         perm = jnp.argsort(h, stable=True)
         live_s = live[perm]
-        keys_s = tuple(gather_column(c, perm, jnp.ones(cap, bool)) for c in keys)
-        h_s = h[perm]
+        keys_s = tuple(gather_column(c, perm, jnp.ones(cap, bool))
+                       for c in keys)
+        accs_s = tuple(_gather_acc(a, perm) for a in accs)
+        return _reduce_sorted(keys_s, accs_s, live_s, h[perm], acc_meta, cap)
 
-        same_hash = jnp.concatenate(
-            [jnp.zeros(1, bool), h_s[1:] == h_s[:-1]])
-        same_keys = _keys_equal_prev(keys_s, live_s)
-        boundary = live_s & ~(same_hash & same_keys)
-        gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-        gid = jnp.maximum(gid, 0)
-        num_groups = jnp.sum(boundary.astype(jnp.int32))
+    return kernel
 
-        # first sorted row of each group → representative for keys
-        rep = jax.ops.segment_min(
-            jnp.where(live_s, jnp.arange(cap, dtype=jnp.int32), cap),
-            gid, num_segments=out_cap)
-        rep = jnp.clip(rep, 0, cap - 1)
-        out_valid = jnp.arange(out_cap, dtype=jnp.int32) < num_groups
-        new_keys = tuple(gather_column(c, rep, out_valid) for c in keys_s)
 
-        new_accs = []
-        needed_elems = []
-        for (kind, out_elems), acc in zip(acc_meta, accs):
-            if kind in ("collect_list", "collect_set"):
-                vals, lens = acc          # [cap, in_E], [cap]
-                in_e = vals.shape[1]
-                vals_s = vals[perm]
-                lens_s = jnp.where(live_s, lens[perm], 0)
-                # within-group exclusive element offset: global exclusive
-                # cumsum minus the group's base (cumsum at its first row)
-                cum = jnp.cumsum(lens_s)
-                excl = cum - lens_s
-                base = excl[rep]          # [out_cap]
-                start = excl - base[gid]
-                j = jnp.arange(in_e, dtype=jnp.int32)[None, :]
-                flat = gid[:, None] * out_elems + start[:, None] + j
-                ok = (live_s[:, None] & (j < lens_s[:, None])
-                      & ((start[:, None] + j) < out_elems))
-                flat = jnp.where(ok, flat, out_cap * out_elems)
-                out_vals = jnp.zeros((out_cap * out_elems,), vals.dtype).at[
-                    flat.reshape(-1)].set(vals_s.reshape(-1), mode="drop")
-                out_vals = out_vals.reshape(out_cap, out_elems)
-                glens_raw = jax.ops.segment_sum(lens_s, gid,
-                                                num_segments=out_cap)
-                needed_elems.append(jnp.max(glens_raw))
-                glens = jnp.minimum(glens_raw, out_elems)
-                if kind == "collect_set":
-                    # per-group dedupe, sort-based so memory stays
-                    # O(cap * E): row-wise lexsort by (is_pad, value) pushes
-                    # padding last and groups equal values adjacently; keep
-                    # first-of-run, compact left. Set order is unspecified
-                    # (as in Spark), so reordering is free.
-                    jj = jnp.arange(out_elems, dtype=jnp.int32)
-                    pad = jj[None, :] >= glens[:, None]
-                    s_pad, s_vals = jax.lax.sort(
-                        (pad, out_vals), dimension=1, num_keys=2)
-                    neq = s_vals[:, 1:] != s_vals[:, :-1]
-                    keep = ~s_pad & jnp.concatenate(
-                        [jnp.ones((out_cap, 1), bool), neq], axis=1)
-                    pos = jnp.cumsum(keep, axis=1) - 1
-                    row = jnp.arange(out_cap, dtype=jnp.int32)[:, None]
-                    flat2 = jnp.where(keep, row * out_elems + pos,
-                                      out_cap * out_elems)
-                    out_vals = jnp.zeros((out_cap * out_elems,),
-                                         vals.dtype).at[
-                        flat2.reshape(-1)].set(s_vals.reshape(-1),
-                                               mode="drop")
-                    out_vals = out_vals.reshape(out_cap, out_elems)
-                    glens = jnp.sum(keep, axis=1).astype(jnp.int32)
-                new_accs.append((out_vals, glens))
-                continue
-            if kind in _STR_KINDS:
-                chars, lens, v = acc
-                chars_s = chars[perm]
-                lens_s = lens[perm]
-                v_s = v[perm] & live_s
-                idx = jnp.arange(cap, dtype=jnp.int32)
-                if kind in ("sfirst", "sfirst_ign"):
-                    # representative row per group: first sorted live row
-                    # (sfirst) or first sorted VALID row (sfirst_ign)
-                    cand = jnp.where(
-                        v_s if kind == "sfirst_ign" else live_s, idx, cap)
-                    raw = jax.ops.segment_min(cand, gid,
-                                              num_segments=out_cap)
-                    fi = jnp.clip(raw, 0, cap - 1)
-                    # raw == cap means NO qualifying row (all-null group in
-                    # sfirst_ign): the clipped index then points at an
-                    # unrelated row whose validity must not leak through
-                    res_valid = v_s[fi] & (raw < cap) & out_valid
-                    new_accs.append((chars_s[fi], lens_s[fi], res_valid))
-                    continue
-                # smin/smax: string order reduces on the sort operator's
-                # order-preserving words — rank every row by value with one
-                # multi-word argsort, then segment_min of ranks picks each
-                # group's winner (reference handles all Arrow types in its
-                # AccColumn instead: datafusion-ext-plans/src/agg/acc.rs)
-                from auron_tpu.ops.sort import order_words
-                col_s = StringColumn(chars_s, lens_s, v_s)
-                words = order_words(col_s, ascending=(kind == "smin"),
-                                    nulls_first=False)
-                lw = lens_s.astype(jnp.uint64)  # tiebreak embedded NULs
-                words.append(lw if kind == "smin" else ~lw)
-                lead = jnp.where(v_s, jnp.uint64(0), jnp.uint64(1))
-                vperm = idx
-                for w in reversed([lead] + words):
-                    vperm = vperm[jnp.argsort(w[vperm], stable=True)]
-                rank = jnp.zeros(cap, jnp.int32).at[vperm].set(idx)
-                winner_rank = jax.ops.segment_min(
-                    jnp.where(v_s, rank, cap), gid, num_segments=out_cap)
-                win = vperm[jnp.clip(winner_rank, 0, cap - 1)]
-                has = jax.ops.segment_max(
-                    v_s.astype(jnp.int8), gid,
-                    num_segments=out_cap).astype(jnp.bool_)
-                new_accs.append((chars_s[win], lens_s[win],
-                                 has & out_valid))
-                continue
-            acc_s = acc[perm]
-            if kind == "first":
-                # value at first sorted valid row; pair-reduce via segment_min
-                # over (order, value-index)
-                first_idx = jax.ops.segment_min(
-                    jnp.where(live_s, jnp.arange(cap, dtype=jnp.int32), cap),
-                    gid, num_segments=out_cap)
-                first_idx = jnp.clip(first_idx, 0, cap - 1)
-                new_accs.append(acc_s[first_idx])
-                continue
-            neutral = _neutral(kind, acc.dtype)
-            masked = jnp.where(live_s, acc_s, neutral)
-            if kind == "sum":
-                red = jax.ops.segment_sum(masked, gid, num_segments=out_cap)
-            elif kind == "min":
-                red = jax.ops.segment_min(masked, gid, num_segments=out_cap)
-            elif kind == "max":
-                red = jax.ops.segment_max(masked, gid, num_segments=out_cap)
-            elif kind == "or":
-                red = jax.ops.segment_max(masked.astype(jnp.int8), gid,
-                                          num_segments=out_cap).astype(jnp.bool_)
-            else:
-                raise ValueError(kind)
-            new_accs.append(red)
-        return new_keys, tuple(new_accs), num_groups, tuple(needed_elems)
+def _scatter_acc(a_s, a_b, pos_s, pos_b, m: int):
+    """Merge two acc entries (state + batch groups) by scattering both to
+    their merged positions."""
+    if isinstance(a_s, tuple):
+        out = []
+        for xs, xb in zip(a_s, a_b):
+            buf = jnp.zeros((m,) + xs.shape[1:], xs.dtype)
+            buf = buf.at[pos_s].set(xs).at[pos_b].set(xb)
+            out.append(buf)
+        return tuple(out)
+    buf = jnp.zeros((m,) + a_s.shape[1:], a_s.dtype)
+    return buf.at[pos_s].set(a_s).at[pos_b].set(a_b)
+
+
+@lru_cache(maxsize=256)
+def _state_merge_kernel(n_keys: int, acc_meta: tuple, cap_s: int,
+                        cap_b: int, out_cap: int):
+    """Fold a hash-sorted batch group table into the hash-sorted state
+    WITHOUT re-sorting the state: merge positions come from two
+    searchsorted calls (O(B log S + S)), then one scatter interleaves both
+    sides and the shared reduce folds duplicate groups. This is the
+    incremental-update contract of the reference's AggTable (reference:
+    datafusion-ext-plans/src/agg/agg_table.rs:68-356) with the
+    open-addressing probe replaced by the sorted-merge primitive."""
+
+    @jax.jit
+    def kernel(keys_s, accs_s, h_s, n_s, keys_b, accs_b, h_b, n_b):
+        live_s = jnp.arange(cap_s, dtype=jnp.int32) < n_s
+        live_b = jnp.arange(cap_b, dtype=jnp.int32) < n_b
+        # dead slots on both sides hold _HASH_SENTINEL (state invariant +
+        # batch-reduce output), so they merge to the tail; side='left' for
+        # state vs 'right' for batch keeps state rows first on hash ties
+        # (so 'first' semantics prefer earlier batches) and makes the
+        # combined position map a permutation of [0, cap_s + cap_b)
+        pos_s = (jnp.arange(cap_s, dtype=jnp.int32)
+                 + jnp.searchsorted(h_b, h_s, side="left").astype(jnp.int32))
+        pos_b = (jnp.arange(cap_b, dtype=jnp.int32)
+                 + jnp.searchsorted(h_s, h_b, side="right").astype(jnp.int32))
+        m = cap_s + cap_b
+
+        def scatter2(xs, xb):
+            buf = jnp.zeros((m,) + xs.shape[1:], xs.dtype)
+            return buf.at[pos_s].set(xs).at[pos_b].set(xb)
+
+        def scatter_col(a, b):
+            if isinstance(a, StringColumn):
+                return StringColumn(scatter2(a.chars, b.chars),
+                                    scatter2(a.lens, b.lens),
+                                    scatter2(a.validity, b.validity))
+            from auron_tpu.columnar.batch import ListColumn
+            if isinstance(a, ListColumn):
+                return ListColumn(scatter2(a.values, b.values),
+                                  scatter2(a.elem_valid, b.elem_valid),
+                                  scatter2(a.lens, b.lens),
+                                  scatter2(a.validity, b.validity))
+            return PrimitiveColumn(scatter2(a.data, b.data),
+                                   scatter2(a.validity, b.validity))
+
+        keys_m = tuple(scatter_col(a, b) for a, b in zip(keys_s, keys_b))
+        accs_m = tuple(_scatter_acc(a, b, pos_s, pos_b, m)
+                       for a, b in zip(accs_s, accs_b))
+        h_m = scatter2(h_s, h_b)
+        live_m = scatter2(live_s, live_b)
+        return _reduce_sorted(keys_m, accs_m, live_m, h_m, acc_meta, out_cap)
 
     return kernel
 
@@ -371,13 +457,21 @@ def _merge_kernel(n_keys: int, acc_meta: tuple, out_cap: int):
 # the operator
 # ---------------------------------------------------------------------------
 
-def _state_nbytes(state) -> int:
-    """Device bytes of an accumulator state, from array metadata only."""
+def _table_nbytes(tbl) -> int:
     from auron_tpu.columnar.batch import column_nbytes
-    keys, accs, _num_groups, _cap = state
+    keys, accs, _num_groups, _cap, hashes = tbl
     return (sum(column_nbytes(k) for k in keys)
+            + hashes.nbytes
             + sum(sum(x.nbytes for x in a) if isinstance(a, tuple)
                   else a.nbytes for a in accs))
+
+
+def _state_nbytes(state) -> int:
+    """Device bytes of a (main, hot) accumulator state, from array
+    metadata only."""
+    if state is None:
+        return 0
+    return sum(_table_nbytes(lvl) for lvl in state if lvl is not None)
 
 
 def _column_pyvalues(col, n: int) -> list:
@@ -570,8 +664,6 @@ class _AggSpillConsumer:
     this one as victim) must refuse — serializing a state the operator is
     about to fold new rows into would double-count every group on emit."""
 
-    FRAME_ROWS = 1 << 16
-
     def __init__(self, op: "AggOp", mem_manager, metrics, conf=None):
         import threading
         from auron_tpu import config as cfg
@@ -584,6 +676,11 @@ class _AggSpillConsumer:
         self.consumer_name = f"agg-{id(op):x}"
         self.state = None
         self.spills = []
+        #: groups written to spill runs so far — feeds the partial-skip
+        #: cardinality estimate (spilled keys are otherwise invisible at
+        #: the decision point); an upper bound, since a key can appear in
+        #: several runs
+        self.spilled_groups = 0
         self._lock = threading.RLock()
         self._merging = False
         mem_manager.register_consumer(self)
@@ -597,12 +694,14 @@ class _AggSpillConsumer:
     def observe(self, state):
         """Check the merged state back in; may spill it synchronously (the
         requester-side trigger). Returns the state the operator should
-        continue with (None right after a spill)."""
+        continue with (None right after a spill). A None state still
+        reports (as zero) so dropping the state — e.g. the partial-skip
+        switchover — clears this consumer's accounted usage instead of
+        leaving stale pressure on the manager."""
         with self._lock:
             self.state = state
             self._merging = False
-        if state is not None:
-            self.mem.update_mem_used(self, _state_nbytes(state))
+        self.mem.update_mem_used(self, _state_nbytes(state))
         with self._lock:
             return self.state
 
@@ -618,16 +717,26 @@ class _AggSpillConsumer:
             if self.state is None or self._merging:
                 return 0
             state, self.state = self.state, None
-        state_batch = self.op._state_batch(state)
         freed = _state_nbytes(state)
-        n = int(state_batch.num_rows)
-        host = batch_to_host(state_batch, n)
+        # each level of the (main, hot) state spills as its own run; the
+        # restore path re-merges them, so level boundaries are free
         spill = self.mem.spill_manager.new_spill()
-        for lo in range(0, max(n, 1), self.frame_rows):
-            hi = min(lo + self.frame_rows, n)
-            spill.write_frame(
-                serialize_host_batch(slice_host_batch(host, lo, hi),
-                                     codec_level=self.codec_level))
+        for lvl in state:
+            if lvl is None:
+                continue
+            state_batch = self.op._state_batch(lvl)
+            n = int(state_batch.num_rows)
+            if n == 0:
+                continue
+            self.spilled_groups += n
+            host = batch_to_host(state_batch, n)
+            for lo in range(0, n, self.frame_rows):
+                hi = min(lo + self.frame_rows, n)
+                spill.write_frame(
+                    serialize_host_batch(slice_host_batch(host, lo, hi),
+                                         codec_level=self.codec_level))
+        # an all-empty state yields an empty (frameless) spill — restore
+        # simply yields nothing for it
         with self._lock:
             self.spills.append(spill.finish())
         self.metrics.counter("mem_spill_count").add(1)
@@ -814,55 +923,153 @@ class AggOp(PhysicalOp):
         return keys, accs, live
 
     # -- merge driver -------------------------------------------------------
-    def _merge(self, state, keys, accs, live, elapsed):
-        """state: None | (keys, accs, num_groups, capacity). Returns updated
-        state, growing capacity buckets (and collect-list element buckets)
-        when groups/lists overflow."""
+    #
+    # Two-kernel incremental update (the sorted analogue of the reference
+    # AggTable's probe-update, agg_table.rs:68-356):
+    #   1. _batch_reduce_kernel sorts and reduces ONLY the incoming batch
+    #      (O(B log B)) into a hash-sorted group table;
+    #   2. _state_merge_kernel folds that table into the hash-sorted state
+    #      by searchsorted + scatter (O(B log S + S)) — the state is never
+    #      re-sorted and its hashes are computed exactly once.
+
+    def _collect_elems(self, accs) -> list[int]:
         from auron_tpu.utils.shapes import next_pow2
+        return [max(4, next_pow2(a[0].shape[1]))
+                if isinstance(a, tuple) and len(a) == 2
+                else 0 for a in accs]
+
+    def _grow_check(self, kinds, out_elems, ng, out_cap, needed):
+        """Shared capacity/element-overflow check; mutates out_elems.
+        Returns (ok, new_out_cap)."""
+        from auron_tpu.utils.shapes import next_pow2
+        ok = ng <= out_cap
+        ni = 0
+        for i, k in enumerate(kinds):
+            if k in ("collect_list", "collect_set"):
+                nd = int(needed[ni])
+                ni += 1
+                if nd > out_elems[i]:
+                    ok = False
+                    out_elems[i] = max(4, next_pow2(nd))
+        return ok, (bucket_rows(ng) if ng > out_cap else out_cap)
+
+    def _shrink_table(self, tbl, ng: int):
+        """Slice a group table down to its occupancy bucket. Live groups
+        are a hash-sorted prefix, so shrinking is a plain slice; keeps
+        small-cardinality states from carrying batch-sized buffers through
+        every subsequent merge."""
+        keys, accs, n, cap, h = tbl
+        new_cap = max(bucket_rows(max(ng, 1)), self.initial_capacity)
+        if new_cap >= cap:
+            return tbl
+
+        def slice_col(c):
+            if isinstance(c, StringColumn):
+                return StringColumn(c.chars[:new_cap], c.lens[:new_cap],
+                                    c.validity[:new_cap])
+            from auron_tpu.columnar.batch import ListColumn
+            if isinstance(c, ListColumn):
+                return ListColumn(c.values[:new_cap], c.elem_valid[:new_cap],
+                                  c.lens[:new_cap], c.validity[:new_cap])
+            return PrimitiveColumn(c.data[:new_cap], c.validity[:new_cap])
+
+        keys2 = tuple(slice_col(c) for c in keys)
+        accs2 = tuple(tuple(x[:new_cap] for x in a) if isinstance(a, tuple)
+                      else a[:new_cap] for a in accs)
+        return (keys2, accs2, n, new_cap, h[:new_cap])
+
+    def _reduce_batch(self, keys, accs, live, elapsed):
+        """Step 1: one batch → its hash-sorted group table."""
         kinds = [kind for spec in self.specs
                  for (_n, _dt, kind) in _device_fields(spec)]
-        if state is None:
-            cat_keys, cat_accs, cat_live = keys, tuple(accs), live
-        else:
-            s_keys, s_accs, s_n, s_cap = state
-            s_live = jnp.arange(s_cap, dtype=jnp.int32) < s_n
-            # string/list key columns may land in different width buckets
-            # per batch (and per restored spill run) — unify before concat
-            cat_keys = tuple(concat_columns(*unify_column_widths([a, b]))
-                             for a, b in zip(s_keys, keys))
-            cat_accs = tuple(_cat_acc(a, b)
-                             for a, b in zip(s_accs, accs))
-            cat_live = jnp.concatenate([s_live, live])
-
-        out_cap = self.initial_capacity if state is None else state[3]
-        out_elems = [max(4, next_pow2(a[0].shape[1]))
-                     if isinstance(a, tuple) and len(a) == 2
-                     else 0 for a in cat_accs]
+        cap_b = live.shape[0]
+        out_elems = self._collect_elems(accs)
         while True:
             meta = tuple(zip(kinds, out_elems))
-            kern = _merge_kernel(len(cat_keys), meta, out_cap)
+            kern = _batch_reduce_kernel(len(keys), meta, cap_b)
             with timer(elapsed):
-                new_keys, new_accs, num_groups, needed = kern(
-                    cat_keys, cat_accs, cat_live)
-            ng = int(num_groups)
-            ok = ng <= out_cap
-            ni = 0
-            for i, k in enumerate(kinds):
-                if k in ("collect_list", "collect_set"):
-                    nd = int(needed[ni])
-                    ni += 1
-                    if nd > out_elems[i]:
-                        ok = False
-                        out_elems[i] = max(4, next_pow2(nd))
+                bk, ba, bh, bn, needed = kern(tuple(keys), tuple(accs), live)
+            ng = int(bn)
+            ok, _cap = self._grow_check(kinds, out_elems, ng, cap_b, needed)
             if ok:
-                return (new_keys, new_accs, num_groups, out_cap)
-            if ng > out_cap:
-                out_cap = bucket_rows(ng)
+                return self._shrink_table((bk, ba, bn, cap_b, bh), ng)
+
+    def _merge_tables(self, s, b, elapsed):
+        """Fold group table ``b`` into group table ``s`` (both hash-sorted
+        5-tuples) via the searchsorted merge kernel, growing capacity /
+        element buckets as needed."""
+        kinds = [kind for spec in self.specs
+                 for (_n, _dt, kind) in _device_fields(spec)]
+        s_keys, s_accs, s_n, s_cap, s_h = s
+        bk, ba, bn, cap_b, bh = b
+        # string/list columns may land in different width buckets per
+        # batch (and per restored spill run) — unify before the merge
+        unified = [unify_column_widths([a, c]) for a, c in zip(s_keys, bk)]
+        s_keys = tuple(p[0] for p in unified)
+        bk = tuple(p[1] for p in unified)
+        s_accs, ba = _unify_acc_pair(s_accs, ba)
+
+        out_cap = max(s_cap, self.initial_capacity)
+        out_elems = self._collect_elems(s_accs)
+        while True:
+            meta = tuple(zip(kinds, out_elems))
+            kern = _state_merge_kernel(len(s_keys), meta, s_cap, cap_b,
+                                       out_cap)
+            with timer(elapsed):
+                new_keys, new_accs, h_out, num_groups, needed = kern(
+                    s_keys, s_accs, s_h, s_n, bk, ba, bh, bn)
+            ng = int(num_groups)
+            ok, out_cap = self._grow_check(kinds, out_elems, ng, out_cap,
+                                           needed)
+            if ok:
+                return self._shrink_table(
+                    (new_keys, new_accs, num_groups, out_cap, h_out), ng)
+
+    #: hot table folds into main once it has grown this many times the
+    #: batch capacity — bounds the amortized main-merge cost to
+    #: O(S / _HOT_FACTOR) per batch (LSM-style two-level state)
+    _HOT_FACTOR = 8
+
+    def _merge(self, state, keys, accs, live, elapsed):
+        """state: None | (main, hot), each None | (keys, accs, num_groups,
+        capacity, hashes). Two-level update: every batch merges into the
+        small hot table (O(B log B + hot)); the hot table folds into main
+        only on overflow, so the O(S) main-table pass is paid once per
+        ~_HOT_FACTOR batches instead of per batch. The reference's
+        open-addressing AggTable gets the same amortization from its
+        in-memory table + sorted bucket spills (agg_table.rs:68-356)."""
+        batch_tbl = self._reduce_batch(keys, accs, live, elapsed)
+        cap_b = live.shape[0]
+        main, hot = state if state is not None else (None, None)
+        if hot is None:
+            hot = batch_tbl
+        else:
+            hot = self._merge_tables(hot, batch_tbl, elapsed)
+        # threshold must clear _shrink_table's initial_capacity floor, or
+        # a small batch capacity would fold hot->main on EVERY batch (two
+        # O(S) passes per batch — worse than the single-level design)
+        if hot[3] >= self._HOT_FACTOR * max(cap_b, self.initial_capacity):
+            main = hot if main is None else self._merge_tables(main, hot,
+                                                               elapsed)
+            hot = None
+        return (main, hot)
+
+    def _compact(self, state, elapsed):
+        """Collapse (main, hot) into one table for emit / spill / the skip
+        decision. Returns a 5-tuple or None."""
+        if state is None:
+            return None
+        main, hot = state
+        if main is None:
+            return hot
+        if hot is None:
+            return main
+        return self._merge_tables(main, hot, elapsed)
 
     # -- finalize → output batch -------------------------------------------
     def _emit(self, state, in_schema: Schema, host=None) -> DeviceBatch:
         from auron_tpu.columnar.batch import ListColumn, resize
-        keys, accs, num_groups, cap = state
+        keys, accs, num_groups, cap, _hashes = state
         valid = jnp.arange(cap, dtype=jnp.int32) < num_groups
         ng = int(num_groups)
 
@@ -966,7 +1173,7 @@ class AggOp(PhysicalOp):
     # associativity of the accumulators makes re-merging exact.
 
     def _state_batch(self, state) -> DeviceBatch:
-        keys, accs, num_groups, cap = state
+        keys, accs, num_groups, cap, _hashes = state
         valid = jnp.arange(cap, dtype=jnp.int32) < num_groups
         cols = list(keys)
         for a in accs:
@@ -1077,8 +1284,18 @@ class AggOp(PhysicalOp):
                     skip_pending = False  # decision point reached: latch
                     if consumer is not None:
                         state = consumer.take_state()
-                    ng = 0 if state is None else int(state[2])
-                    if state is not None and ng >= skip_ratio * rows_seen:
+                    # exact distinct count needs the levels folded: a key
+                    # present in both hot and main would count twice
+                    tbl = self._compact(state, elapsed)
+                    state = None if tbl is None else (tbl, None)
+                    ng = 0 if tbl is None else int(tbl[2])
+                    # groups living only in spill runs are invisible in the
+                    # in-memory table; without them a pre-decision spill
+                    # would suppress skipping in exactly the
+                    # memory-pressured high-cardinality case it targets
+                    if consumer is not None:
+                        ng += consumer.spilled_groups
+                    if tbl is not None and ng >= skip_ratio * rows_seen:
                         # fold any spilled runs in, flush the merged
                         # state, then pass the rest of the input through
                         if consumer is not None:
@@ -1087,7 +1304,8 @@ class AggOp(PhysicalOp):
                                     spilled)
                                 state = self._merge(state, k2, a2, l2,
                                                     elapsed)
-                        yield self._emit(state, in_schema, host)
+                        yield self._emit(self._compact(state, elapsed),
+                                         in_schema, host)
                         state = None
                         skipping = True
                         if consumer is not None:
@@ -1105,12 +1323,13 @@ class AggOp(PhysicalOp):
                     for spilled in consumer.read_spilled_states():
                         keys, accs, live = self._state_contributions(spilled)
                         state = self._merge(state, keys, accs, live, elapsed)
-                if state is None:
+                final_tbl = self._compact(state, elapsed)
+                if final_tbl is None:
                     if not self.group_exprs and self.mode in ("final", "complete"):
                         # global agg over empty input: one row of neutral results
                         yield self._empty_global(host)
                     return
-                yield self._emit(state, in_schema, host)
+                yield self._emit(final_tbl, in_schema, host)
             finally:
                 if consumer is not None:
                     consumer.close()

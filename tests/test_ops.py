@@ -197,3 +197,26 @@ def test_parquet_scan(tmp_path):
     out = collect(filt)
     assert out.num_rows == 10
     assert out.column("name").to_pylist() == [f"row{i}" for i in range(10)]
+
+
+def test_agg_two_level_state_folds():
+    """Enough batches to force several hot->main folds (LSM-style state,
+    ops/agg.py AggOp._HOT_FACTOR) with keys recurring across batches: sums
+    must fold exactly across the level boundary."""
+    import numpy as np
+    rng = np.random.default_rng(9)
+    n_batches, rows = 40, 64
+    rbs, exp = [], {}
+    for b in range(n_batches):
+        k = rng.integers(0, 512, rows)
+        v = rng.integers(0, 100, rows).astype(float)
+        for ki, vi in zip(k.tolist(), v.tolist()):
+            exp[ki] = exp.get(ki, 0.0) + vi
+        rbs.append(pa.record_batch({"k": pa.array(k, pa.int64()),
+                                    "v": pa.array(v, pa.float64())}))
+    scan = MemoryScanOp([rbs], schema_from_arrow(rbs[0].schema), capacity=64)
+    agg = AggOp(scan, [C(0)], [ir.AggFunction("sum", C(1))],
+                mode="complete", group_names=["k"], agg_names=["s"],
+                initial_capacity=16)
+    got = {r["k"]: r["s"] for r in collect(agg).to_pylist()}
+    assert got == exp
