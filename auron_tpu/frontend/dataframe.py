@@ -248,12 +248,54 @@ class GroupedData:
             a.fn, resolve(a.arg, schema) if a.arg is not None else None,
             a.distinct) for a in aggs]
         agg_names = [a.out_name(i) for i, a in enumerate(aggs)]
-        node = pb.PlanNode(agg=pb.AggNode(
-            child=self.df.plan,
-            group_exprs=[serde.expr_to_proto(e) for e in group_exprs],
-            aggs=[serde.agg_to_proto(a) for a in agg_fns],
-            mode="complete", group_names=group_names, agg_names=agg_names))
-        from auron_tpu.ops.agg import AggOp
+        n_keys = len(group_exprs)
+        n_part = self.df.num_partitions
+
+        out_partitions = n_part
+        out_prov = self.df.partitioning
+        if n_part > 1:
+            # Spark-shaped two-phase plan: partial agg on every map
+            # partition → exchange → final agg (the reference converts
+            # HashAggregateExec pairs the same way,
+            # AuronConverters.scala convertHashAggregateExec). Keyed aggs
+            # hash-exchange on the group keys; a GLOBAL agg (no keys)
+            # coalesces every partial row into one partition — without
+            # that, each partition would emit its own "global" row.
+            partial = pb.PlanNode(agg=pb.AggNode(
+                child=self.df.plan,
+                group_exprs=[serde.expr_to_proto(e) for e in group_exprs],
+                aggs=[serde.agg_to_proto(a) for a in agg_fns],
+                mode="partial", group_names=group_names,
+                agg_names=agg_names))
+            if n_keys > 0:
+                part = pb.PartitioningP(
+                    kind="hash", num_partitions=n_part,
+                    hash_keys=[serde.expr_to_proto(ir.ColumnRef(i))
+                               for i in range(n_keys)])
+                out_prov = ("hash", tuple(group_names), n_part)
+            else:
+                part = pb.PartitioningP(kind="single", num_partitions=1)
+                out_partitions = 1
+                out_prov = ("single",)
+            shuffle = pb.PlanNode(shuffle_writer=pb.ShuffleWriterNode(
+                child=partial, partitioning=part, input_partitions=n_part))
+            node = pb.PlanNode(agg=pb.AggNode(
+                child=shuffle,
+                group_exprs=[serde.expr_to_proto(ir.ColumnRef(i))
+                             for i in range(n_keys)],
+                aggs=[serde.agg_to_proto(
+                    ir.AggFunction(a.fn, None, a.distinct))
+                    for a in agg_fns],
+                mode="final", group_names=group_names,
+                agg_names=agg_names))
+        else:
+            node = pb.PlanNode(agg=pb.AggNode(
+                child=self.df.plan,
+                group_exprs=[serde.expr_to_proto(e) for e in group_exprs],
+                aggs=[serde.agg_to_proto(a) for a in agg_fns],
+                mode="complete", group_names=group_names,
+                agg_names=agg_names))
+
         # schema via a throwaway op build is overkill; compute directly
         key_fields = []
         for e, nm in zip(group_exprs, group_names):
@@ -264,18 +306,25 @@ class GroupedData:
         for a, nm in zip(agg_fns, agg_names):
             spec = make_acc_spec(a, schema, "complete")
             out_fields.append(Field(nm, spec.result[0], True,
-                                    spec.result[1], spec.result[2]))
+                                    spec.result[1], spec.result[2],
+                                    elem=spec.elem))
         return DataFrame(self.df.session, node, Schema(tuple(out_fields)),
-                         self.df.num_partitions)
+                         out_partitions, out_prov)
 
 
 class DataFrame:
     def __init__(self, session, plan: pb.PlanNode, schema: Schema,
-                 num_partitions: int = 1):
+                 num_partitions: int = 1, partitioning=None):
         self.session = session
         self.plan = plan
         self.schema = schema
         self.num_partitions = num_partitions
+        #: output-partitioning provenance, the EnsureRequirements signal:
+        #: ("hash", (key names...), n) after repartition-by-key, ("single",)
+        #: after a coalescing exchange, else None (unknown layout). Joins
+        #: use it to recognize co-partitioned inputs; anything else gets a
+        #: broadcast build side.
+        self.partitioning = partitioning
 
     # -- transforms ---------------------------------------------------------
 
@@ -283,8 +332,9 @@ class DataFrame:
         e = resolve(cond, self.schema)
         node = pb.PlanNode(filter=pb.FilterNode(
             child=self.plan, predicates=[serde.expr_to_proto(e)]))
+        # row-preserving: the partition layout survives a filter
         return DataFrame(self.session, node, self.schema,
-                         self.num_partitions)
+                         self.num_partitions, self.partitioning)
 
     where = filter
 
@@ -320,25 +370,76 @@ class DataFrame:
                 o = o.asc()
             sos.append(ir.SortOrder(resolve(o.col, self.schema),
                                     o.ascending, o.nulls_first))
+        so_protos = [serde.sort_order_to_proto(s) for s in sos]
+        child = self.plan
+        out_partitions = self.num_partitions
+        prov = None
+        if self.num_partitions > 1:
+            # a per-partition sort is not a global sort: top-k coalesces
+            # to one partition first; a full sort range-exchanges so the
+            # per-partition runs concatenate globally ordered (the Spark
+            # global-sort shape, reference: shuffle/mod.rs:204-279 range
+            # partitioning + NativeSortExec per partition)
+            if limit is not None:
+                part = pb.PartitioningP(kind="single", num_partitions=1)
+                out_partitions = 1
+                prov = ("single",)
+            else:
+                part = pb.PartitioningP(kind="range",
+                                        num_partitions=self.num_partitions,
+                                        range_orders=so_protos)
+            child = pb.PlanNode(shuffle_writer=pb.ShuffleWriterNode(
+                child=child, partitioning=part,
+                input_partitions=self.num_partitions))
         node = pb.PlanNode(sort=pb.SortNode(
-            child=self.plan,
-            sort_orders=[serde.sort_order_to_proto(s) for s in sos],
+            child=child, sort_orders=so_protos,
             fetch=-1 if limit is None else limit))
         return DataFrame(self.session, node, self.schema,
-                         self.num_partitions)
+                         out_partitions, prov)
 
     order_by = sort
 
     def limit(self, n: int) -> "DataFrame":
-        node = pb.PlanNode(limit=pb.LimitNode(child=self.plan, limit=n))
+        child = self.plan
+        out_partitions = self.num_partitions
+        prov = self.partitioning
+        if self.num_partitions > 1:
+            # LIMIT is global: coalesce to one partition first, else every
+            # partition would emit up to n rows
+            child = pb.PlanNode(shuffle_writer=pb.ShuffleWriterNode(
+                child=child,
+                partitioning=pb.PartitioningP(kind="single",
+                                              num_partitions=1),
+                input_partitions=self.num_partitions))
+            out_partitions = 1
+            prov = ("single",)
+        node = pb.PlanNode(limit=pb.LimitNode(child=child, limit=n))
         return DataFrame(self.session, node, self.schema,
-                         self.num_partitions)
+                         out_partitions, prov)
 
     def union(self, other: "DataFrame") -> "DataFrame":
+        if other.num_partitions != self.num_partitions:
+            raise ValueError(
+                "union requires equal partition counts "
+                f"({self.num_partitions} vs {other.num_partitions}); "
+                "repartition one side first")
         node = pb.PlanNode(union=pb.UnionNode(
             children=[self.plan, other.plan]))
         return DataFrame(self.session, node, self.schema,
                          self.num_partitions)
+
+    def _co_partitioned_with(self, other: "DataFrame", keys: list) -> bool:
+        """True when both sides are laid out so probe partition p only
+        needs build partition p: both single-partition, or both
+        hash-partitioned on exactly the join keys with equal counts."""
+        if self.num_partitions == 1 and other.num_partitions == 1:
+            return True
+        a, b = self.partitioning, other.partitioning
+        return (a is not None and b is not None
+                and a[0] == "hash" and b[0] == "hash"
+                and a[1] == b[1] == tuple(keys)
+                and a[2] == b[2] == self.num_partitions
+                == other.num_partitions)
 
     def join(self, other: "DataFrame", on: Union[str, Sequence[str]],
              how: str = "inner") -> "DataFrame":
@@ -347,16 +448,28 @@ class DataFrame:
               for k in keys]
         bk = [serde.expr_to_proto(resolve(col(k), other.schema))
               for k in keys]
+        build_plan = other.plan
+        if not self._co_partitioned_with(other, keys):
+            # sides are not provably co-partitioned: collect the build
+            # side once and replay it to every probe partition (broadcast
+            # join, reference: NativeBroadcastExchangeBase / SURVEY §3.4)
+            # — without this, probe partition p silently only sees build
+            # partition p
+            build_plan = pb.PlanNode(
+                broadcast_exchange=pb.BroadcastExchangeNode(
+                    child=other.plan,
+                    input_partitions=other.num_partitions))
         node = pb.PlanNode(hash_join=pb.HashJoinNode(
-            probe=self.plan, build=other.plan, probe_keys=pk,
+            probe=self.plan, build=build_plan, probe_keys=pk,
             build_keys=bk, join_type=how))
         if how in ("semi", "anti"):
             return DataFrame(self.session, node, self.schema,
-                             self.num_partitions)
+                             self.num_partitions, self.partitioning)
         if how == "existence":
             out = Schema(tuple(self.schema.fields)
                          + (Field("exists", DataType.BOOL, False),))
-            return DataFrame(self.session, node, out, self.num_partitions)
+            return DataFrame(self.session, node, out, self.num_partitions,
+                             self.partitioning)
         # USING-style join: the build side's key columns are dropped
         # (Spark/SQL `JOIN ... USING` semantics)
         raw = Schema(tuple(self.schema.fields)
@@ -365,7 +478,8 @@ class DataFrame:
         keep = list(range(p)) + [
             p + i for i, f in enumerate(other.schema)
             if f.name not in keys]
-        joined = DataFrame(self.session, node, raw, self.num_partitions)
+        joined = DataFrame(self.session, node, raw, self.num_partitions,
+                           self.partitioning)
         return joined.select(*[Col(ir.ColumnRef(i, raw[i].name),
                                    raw[i].name) for i in keep])
 
@@ -394,11 +508,14 @@ class DataFrame:
                 kind="hash", num_partitions=n,
                 hash_keys=[serde.expr_to_proto(resolve(k, self.schema))
                            for k in ks])
+            prov = ("hash", tuple(k.out_name() for k in ks), n)
         else:
             part = pb.PartitioningP(kind="round_robin", num_partitions=n)
+            prov = ("single",) if n == 1 else None
         node = pb.PlanNode(shuffle_writer=pb.ShuffleWriterNode(
-            child=self.plan, partitioning=part))
-        return DataFrame(self.session, node, self.schema, n)
+            child=self.plan, partitioning=part,
+            input_partitions=self.num_partitions))
+        return DataFrame(self.session, node, self.schema, n, prov)
 
     def map_batches(self, fn: Callable[[pa.RecordBatch], pa.RecordBatch],
                     schema: Optional[Schema] = None) -> "DataFrame":

@@ -51,7 +51,10 @@ class Session:
         self.register(name, table)
         return self.table(name)
 
-    def read_parquet(self, files, columns=None) -> DataFrame:
+    def read_parquet(self, files, columns=None,
+                     partitions: Optional[int] = None) -> DataFrame:
+        """``partitions`` sets the scan parallelism (files are split
+        round-robin across partitions, like Spark input splits); default 1."""
         files = [files] if isinstance(files, str) else list(files)
         node = pb.PlanNode(parquet_scan=pb.ParquetScanNode(
             files=files, columns=columns or []))
@@ -60,7 +63,8 @@ class Session:
             # requested order, not file order: the scan op emits columns in
             # the order they were asked for
             schema = Schema(tuple(schema[schema.index_of(c)] for c in columns))
-        return DataFrame(self, node, schema)
+        return DataFrame(self, node, schema,
+                         num_partitions=partitions or 1)
 
     def read_orc(self, files, columns=None) -> DataFrame:
         from pyarrow import orc

@@ -288,14 +288,16 @@ class PhysicalPlanner:
     def _plan_shuffle_writer(self, n: pb.ShuffleWriterNode) -> PhysicalOp:
         from auron_tpu.parallel.exchange import ShuffleExchangeOp
         op = ShuffleExchangeOp(self.create_plan(n.child),
-                               self._parse_partitioning(n.partitioning))
+                               self._parse_partitioning(n.partitioning),
+                               input_partitions=n.input_partitions or 1)
         if n.output_resource_id:
             self.ctx.put_resource(n.output_resource_id, op)
         return op
 
     def _plan_broadcast_exchange(self, n: pb.BroadcastExchangeNode) -> PhysicalOp:
         from auron_tpu.parallel.exchange import BroadcastExchangeOp
-        op = BroadcastExchangeOp(self.create_plan(n.child))
+        op = BroadcastExchangeOp(self.create_plan(n.child),
+                                 input_partitions=n.input_partitions or 1)
         if n.output_resource_id:
             self.ctx.put_resource(n.output_resource_id, op)
         return op

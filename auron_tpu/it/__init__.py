@@ -1,0 +1,14 @@
+"""Query-level E2E integration harness.
+
+The analogue of the reference's ``dev/auron-it`` (reference:
+dev/auron-it/src/main/scala/org/apache/auron/integration/Main.scala:60-128):
+generate a TPC-DS-class dataset, run multi-operator queries through the
+full proto → planner → exchange pipeline, and diff results against an
+independent pandas/pyarrow computation with double tolerance (reference:
+comparison/QueryResultComparator.scala:21-100).
+"""
+
+from auron_tpu.it.comparator import QueryResultComparator, ComparisonResult
+from auron_tpu.it.queries import QUERIES
+
+__all__ = ["QueryResultComparator", "ComparisonResult", "QUERIES"]

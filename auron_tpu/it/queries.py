@@ -1,0 +1,387 @@
+"""TPC-DS-class integration queries.
+
+Each query runs scan → filter/project → (two-phase, exchanged) agg →
+join → sort/limit combinations through the FULL pipeline: DataFrame DSL →
+protobuf TaskDefinition → physical planner → operators (incl.
+ShuffleExchangeOp stages) — the per-query differential methodology of the
+reference's auron-it (reference: dev/auron-it/.../Main.scala:60-128).
+The oracle for every query is an independent pandas computation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+import pyarrow as pa
+
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.frontend.dataframe import col, functions as F, lit
+
+
+@dataclass(frozen=True)
+class Query:
+    name: str
+    description: str
+    run: Callable        # (session, tables: {name: [files]}) -> pa.Table
+    oracle: Callable     # (pd_tables: {name: DataFrame}) -> pandas.DataFrame
+
+    def expected(self, pd_tables) -> pa.Table:
+        import pandas as pd
+        df = self.oracle(pd_tables)
+        return pa.Table.from_pandas(df.reset_index(drop=True),
+                                    preserve_index=False)
+
+
+def _sales(session, tables, partitions=4):
+    return session.read_parquet(tables["store_sales"], partitions=partitions)
+
+
+def _dim(session, tables, name):
+    return session.read_parquet(tables[name])
+
+
+# --------------------------------------------------------------------------
+# q01: scan → filter → two-phase agg → sort  (the flagship q01 shape)
+# --------------------------------------------------------------------------
+
+def _q01_run(s, t):
+    return (_sales(s, t)
+            .filter(col("ss_quantity") > 5)
+            .group_by("ss_store_sk")
+            .agg(F.sum(col("ss_sales_price")).alias("total"),
+                 F.count(col("ss_net_paid")).alias("paid_cnt"),
+                 F.avg(col("ss_net_profit")).alias("avg_profit"))
+            .collect())
+
+
+def _q01_oracle(p):
+    ss = p["store_sales"]
+    f = ss[ss.ss_quantity > 5]
+    g = f.groupby("ss_store_sk").agg(
+        total=("ss_sales_price", "sum"),
+        paid_cnt=("ss_net_paid", "count"),
+        avg_profit=("ss_net_profit", "mean")).reset_index()
+    return g
+
+
+# --------------------------------------------------------------------------
+# q02: top-k customers by revenue (agg → exchange → global sort+limit)
+# --------------------------------------------------------------------------
+
+def _q02_run(s, t):
+    return (_sales(s, t)
+            .group_by("ss_customer_sk")
+            .agg(F.sum(col("ss_net_paid")).alias("revenue"))
+            .sort(col("revenue").desc(), col("ss_customer_sk").asc(),
+                  limit=100)
+            .collect())
+
+
+def _q02_oracle(p):
+    g = p["store_sales"].groupby("ss_customer_sk").agg(
+        revenue=("ss_net_paid", "sum")).reset_index()
+    return g.sort_values(["revenue", "ss_customer_sk"],
+                         ascending=[False, True]).head(100)
+
+
+# --------------------------------------------------------------------------
+# q03: fact ⋈ dim join (co-partitioned) → agg by category → sort
+# --------------------------------------------------------------------------
+
+def _q03_run(s, t):
+    item = (_dim(s, t, "item")
+            .select(col("i_item_sk").alias("ss_item_sk"),
+                    col("i_category"), col("i_current_price"))
+            .repartition(4, "ss_item_sk"))
+    sales = _sales(s, t).repartition(4, "ss_item_sk")
+    return (sales.join(item, on="ss_item_sk")
+            .filter(col("i_category").isin("Books", "Music", "Shoes"))
+            .group_by("i_category")
+            .agg(F.sum(col("ss_sales_price")).alias("total"),
+                 F.count_star().alias("n"))
+            .collect())
+
+
+def _q03_oracle(p):
+    ss, it = p["store_sales"], p["item"]
+    j = ss.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j[j.i_category.isin(["Books", "Music", "Shoes"])]
+    return j.groupby("i_category").agg(
+        total=("ss_sales_price", "sum"),
+        n=("ss_item_sk", "size")).reset_index()
+
+
+# --------------------------------------------------------------------------
+# q04: join store dim → agg by state
+# --------------------------------------------------------------------------
+
+def _q04_run(s, t):
+    store = (_dim(s, t, "store")
+             .select(col("s_store_sk").alias("ss_store_sk"),
+                     col("s_state")))
+    return (_sales(s, t).repartition(4, "ss_store_sk")
+            .join(store.repartition(4, "ss_store_sk"), on="ss_store_sk")
+            .group_by("s_state")
+            .agg(F.count_star().alias("n"),
+                 F.sum(col("ss_net_profit")).alias("profit"))
+            .collect())
+
+
+def _q04_oracle(p):
+    j = p["store_sales"].merge(p["store"], left_on="ss_store_sk",
+                               right_on="s_store_sk")
+    return j.groupby("s_state").agg(
+        n=("ss_store_sk", "size"),
+        profit=("ss_net_profit", "sum")).reset_index()
+
+
+# --------------------------------------------------------------------------
+# q05: date-dim filter join → agg by month
+# --------------------------------------------------------------------------
+
+def _q05_run(s, t):
+    dd = (_dim(s, t, "date_dim")
+          .filter(col("d_year") == 2000)
+          .select(col("d_date_sk").alias("ss_sold_date_sk"), col("d_moy")))
+    return (_sales(s, t).repartition(4, "ss_sold_date_sk")
+            .join(dd.repartition(4, "ss_sold_date_sk"),
+                  on="ss_sold_date_sk")
+            .group_by("d_moy")
+            .agg(F.sum(col("ss_sales_price")).alias("total"))
+            .collect())
+
+
+def _q05_oracle(p):
+    dd = p["date_dim"]
+    dd = dd[dd.d_year == 2000]
+    j = p["store_sales"].merge(dd, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    return j.groupby("d_moy").agg(
+        total=("ss_sales_price", "sum")).reset_index()
+
+
+# --------------------------------------------------------------------------
+# q06: string min/max aggregates over a join (customer emails by state)
+# --------------------------------------------------------------------------
+
+def _q06_run(s, t):
+    cust = (_dim(s, t, "customer")
+            .select(col("c_customer_sk").alias("ss_customer_sk"),
+                    col("c_state"), col("c_email")))
+    return (_sales(s, t).repartition(4, "ss_customer_sk")
+            .join(cust.repartition(4, "ss_customer_sk"),
+                  on="ss_customer_sk")
+            .group_by("c_state")
+            .agg(F.min(col("c_email")).alias("first_email"),
+                 F.max(col("c_email")).alias("last_email"),
+                 F.count(col("c_email")).alias("n"))
+            .collect())
+
+
+def _q06_oracle(p):
+    j = p["store_sales"].merge(p["customer"], left_on="ss_customer_sk",
+                               right_on="c_customer_sk")
+    return j.groupby("c_state").agg(
+        first_email=("c_email", "min"),
+        last_email=("c_email", "max"),
+        n=("c_email", "count")).reset_index()
+
+
+# --------------------------------------------------------------------------
+# q07: three-table join → composite-key agg → sort+limit
+# --------------------------------------------------------------------------
+
+def _q07_run(s, t):
+    item = (_dim(s, t, "item")
+            .select(col("i_item_sk").alias("ss_item_sk"),
+                    col("i_category")))
+    store = (_dim(s, t, "store")
+             .select(col("s_store_sk").alias("ss_store_sk"),
+                     col("s_state")))
+    return (_sales(s, t).repartition(4, "ss_item_sk")
+            .join(item.repartition(4, "ss_item_sk"), on="ss_item_sk")
+            .repartition(4, "ss_store_sk")
+            .join(store.repartition(4, "ss_store_sk"), on="ss_store_sk")
+            .filter(col("ss_net_profit") > 0)
+            .group_by("i_category", "s_state")
+            .agg(F.sum(col("ss_net_paid")).alias("paid"))
+            .sort(col("paid").desc(), col("i_category").asc(),
+                  col("s_state").asc(), limit=50)
+            .collect())
+
+
+def _q07_oracle(p):
+    j = (p["store_sales"]
+         .merge(p["item"], left_on="ss_item_sk", right_on="i_item_sk")
+         .merge(p["store"], left_on="ss_store_sk", right_on="s_store_sk"))
+    j = j[j.ss_net_profit > 0]
+    g = j.groupby(["i_category", "s_state"]).agg(
+        paid=("ss_net_paid", "sum")).reset_index()
+    return g.sort_values(["paid", "i_category", "s_state"],
+                         ascending=[False, True, True]).head(50)
+
+
+# --------------------------------------------------------------------------
+# q08: semi join — states of customers who bought Electronics
+# --------------------------------------------------------------------------
+
+def _q08_run(s, t):
+    item = (_dim(s, t, "item")
+            .select(col("i_item_sk").alias("ss_item_sk"),
+                    col("i_category")))
+    buyers = (_sales(s, t)
+              .join(item, on="ss_item_sk")
+              .filter(col("i_category") == "Electronics")
+              .select(col("ss_customer_sk").alias("c_customer_sk")))
+    cust = _dim(s, t, "customer")
+    return (cust.join(buyers, on="c_customer_sk", how="semi")
+            .group_by("c_state")
+            .agg(F.count_star().alias("n"))
+            .collect())
+
+
+def _q08_oracle(p):
+    j = p["store_sales"].merge(p["item"], left_on="ss_item_sk",
+                               right_on="i_item_sk")
+    buyers = set(j[j.i_category == "Electronics"].ss_customer_sk)
+    c = p["customer"]
+    c = c[c.c_customer_sk.isin(buyers)]
+    return c.groupby("c_state").agg(
+        n=("c_customer_sk", "size")).reset_index()
+
+
+# --------------------------------------------------------------------------
+# q09: anti join — items never sold, counted by category
+# --------------------------------------------------------------------------
+
+def _q09_run(s, t):
+    # "never discounted": anti-join against sub-$1 sales — rare enough
+    # (~0.2% of rows) that the anti side stays populated at every scale
+    sold = (_sales(s, t)
+            .filter(col("ss_sales_price") < 1.0)
+            .select(col("ss_item_sk").alias("i_item_sk")))
+    item = _dim(s, t, "item")
+    return (item.join(sold, on="i_item_sk", how="anti")
+            .group_by("i_category")
+            .agg(F.count_star().alias("n"))
+            .collect())
+
+
+def _q09_oracle(p):
+    ss = p["store_sales"]
+    sold = set(ss[ss.ss_sales_price < 1.0].ss_item_sk)
+    it = p["item"]
+    unsold = it[~it.i_item_sk.isin(sold)]
+    g = unsold.groupby("i_category").agg(
+        n=("i_item_sk", "size")).reset_index()
+    return g
+
+
+# --------------------------------------------------------------------------
+# q10: agg → filter-on-aggregate (HAVING) → sort
+# --------------------------------------------------------------------------
+
+def _q10_run(s, t):
+    return (_sales(s, t)
+            .group_by("ss_quantity")
+            .agg(F.count_star().alias("n"),
+                 F.avg(col("ss_sales_price")).alias("avg_price"))
+            .filter(col("n") > 100)
+            .collect())
+
+
+def _q10_oracle(p):
+    g = p["store_sales"].groupby("ss_quantity").agg(
+        n=("ss_quantity", "size"),
+        avg_price=("ss_sales_price", "mean")).reset_index()
+    return g[g.n > 100]
+
+
+# --------------------------------------------------------------------------
+# q11: union of two filtered branches → agg
+# --------------------------------------------------------------------------
+
+def _q11_run(s, t):
+    lo = (_sales(s, t)
+          .filter(col("ss_sales_price") < 10.0)
+          .select(col("ss_store_sk"), col("ss_quantity")))
+    hi = (_sales(s, t)
+          .filter(col("ss_sales_price") > 250.0)
+          .select(col("ss_store_sk"), col("ss_quantity")))
+    return (lo.union(hi)
+            .group_by("ss_store_sk")
+            .agg(F.sum(col("ss_quantity")).alias("qty"),
+                 F.count_star().alias("n"))
+            .collect())
+
+
+def _q11_oracle(p):
+    ss = p["store_sales"]
+    u = ss[(ss.ss_sales_price < 10.0) | (ss.ss_sales_price > 250.0)]
+    return u.groupby("ss_store_sk").agg(
+        qty=("ss_quantity", "sum"),
+        n=("ss_quantity", "size")).reset_index()
+
+
+# --------------------------------------------------------------------------
+# q12: projection arithmetic → filter → global top-k by computed column
+# --------------------------------------------------------------------------
+
+def _q12_run(s, t):
+    return (_sales(s, t)
+            .select(col("ss_item_sk"),
+                    (col("ss_sales_price")
+                     * col("ss_quantity").cast(DataType.FLOAT64))
+                    .alias("revenue"),
+                    col("ss_net_profit"))
+            .filter(col("ss_net_profit") > 0)
+            .sort(col("revenue").desc(), col("ss_item_sk").asc(), limit=20)
+            .collect())
+
+
+def _q12_oracle(p):
+    ss = p["store_sales"].copy()
+    ss["revenue"] = ss.ss_sales_price * ss.ss_quantity
+    f = ss[ss.ss_net_profit > 0][["ss_item_sk", "revenue", "ss_net_profit"]]
+    return f.sort_values(["revenue", "ss_item_sk"],
+                         ascending=[False, True]).head(20)
+
+
+# --------------------------------------------------------------------------
+# q13: distinct count class — number of distinct buying customers per store
+# (two nested aggs through an exchange)
+# --------------------------------------------------------------------------
+
+def _q13_run(s, t):
+    per_cust = (_sales(s, t)
+                .group_by("ss_store_sk", "ss_customer_sk")
+                .agg(F.count_star().alias("_n")))
+    return (per_cust
+            .group_by("ss_store_sk")
+            .agg(F.count(col("ss_customer_sk")).alias("buyers"))
+            .collect())
+
+
+def _q13_oracle(p):
+    g = p["store_sales"].groupby("ss_store_sk").agg(
+        buyers=("ss_customer_sk", "nunique")).reset_index()
+    return g
+
+
+QUERIES = [
+    Query("q01_filter_agg", "scan→filter→two-phase agg", _q01_run, _q01_oracle),
+    Query("q02_topk_revenue", "agg→exchange→global sort+limit", _q02_run, _q02_oracle),
+    Query("q03_item_join_agg", "co-partitioned join→agg (IN filter)", _q03_run, _q03_oracle),
+    Query("q04_store_join_agg", "join→agg by dim attribute", _q04_run, _q04_oracle),
+    Query("q05_date_filter_join", "filtered dim join→agg", _q05_run, _q05_oracle),
+    Query("q06_string_minmax", "join→min/max(string) agg", _q06_run, _q06_oracle),
+    Query("q07_three_table", "3-table join→composite agg→top-k", _q07_run, _q07_oracle),
+    Query("q08_semi_join", "semi join→agg", _q08_run, _q08_oracle),
+    Query("q09_anti_join", "anti join→agg", _q09_run, _q09_oracle),
+    Query("q10_having", "agg→filter-on-aggregate", _q10_run, _q10_oracle),
+    Query("q11_union", "union of branches→agg", _q11_run, _q11_oracle),
+    Query("q12_computed_topk", "project arithmetic→top-k", _q12_run, _q12_oracle),
+    Query("q13_distinct_buyers", "nested aggs through exchange", _q13_run, _q13_oracle),
+]

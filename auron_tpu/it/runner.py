@@ -1,0 +1,81 @@
+"""Integration-harness runner.
+
+CLI analogue of the reference's auron-it Main (reference:
+dev/auron-it/.../Main.scala:60-128, flags --auron-only/--result-check):
+
+    python -m auron_tpu.it.runner [--scale 1.0] [--queries q01,q03] [--data DIR]
+
+Exit code 0 iff every query's result matches the pandas oracle.
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+import time
+
+from auron_tpu.it.comparator import ComparisonResult, QueryResultComparator
+from auron_tpu.it.queries import QUERIES
+from auron_tpu.it.tpcds_data import generate, load_pandas
+
+
+def _fresh_session():
+    from auron_tpu.frontend.session import Session
+    return Session()
+
+
+def run_query(query, tables, pd_tables,
+              comparator=None) -> ComparisonResult:
+    comparator = comparator or QueryResultComparator()
+    session = _fresh_session()
+    t0 = time.perf_counter()
+    try:
+        got = query.run(session, tables)
+    except Exception as e:  # a crash is a FAIL with the error recorded
+        import traceback
+        return ComparisonResult(query.name, False, 0,
+                                error=traceback.format_exc(limit=8))
+    elapsed = time.perf_counter() - t0
+    expected = query.expected(pd_tables)
+    res = comparator.compare(query.name, got, expected)
+    res.elapsed_s = round(elapsed, 3)
+    return res
+
+
+def run_all(data_dir=None, scale: float = 1.0, names=None,
+            verbose: bool = True) -> list[ComparisonResult]:
+    if data_dir is None:
+        data_dir = tempfile.mkdtemp(prefix="auron_it_")
+    tables = generate(data_dir, scale=scale)
+    pd_tables = load_pandas(tables)
+    results = []
+    for q in QUERIES:
+        if names and q.name not in names and q.name.split("_")[0] not in names:
+            continue
+        res = run_query(q, tables, pd_tables)
+        results.append(res)
+        if verbose:
+            took = getattr(res, "elapsed_s", None)
+            suffix = f" ({took}s)" if took is not None else ""
+            print(res.report() + suffix, flush=True)
+    return results
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--queries", default="",
+                    help="comma-separated names (q01 or full name)")
+    ap.add_argument("--data", default=None,
+                    help="reuse/create dataset in this directory")
+    args = ap.parse_args(argv)
+    names = [n.strip() for n in args.queries.split(",") if n.strip()] or None
+    results = run_all(data_dir=args.data, scale=args.scale, names=names)
+    failed = [r for r in results if not r.ok]
+    print(f"{len(results) - len(failed)}/{len(results)} queries passed")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,121 @@
+"""Synthetic TPC-DS-class dataset generator.
+
+Five tables with the TPC-DS store-sales star-schema shape (fact table +
+customer/item/store/date dims), written as multi-file parquet so scans
+have real input splits. Sizes are driven by ``scale`` (1.0 ≈ 120k fact
+rows — enough to exercise multi-batch execution, exchanges, and two-phase
+aggregation while keeping the pandas oracle fast). Deterministic per
+(seed, scale).
+
+Reference dataset: the 1 GB TPC-DS checkout the reference's CI runs
+(reference: .github/workflows/tpcds-reusable.yml:255-258).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+FACT_FILES = 4
+
+
+def generate(root: str, scale: float = 1.0, seed: int = 42) -> dict:
+    """Write the dataset under ``root``; returns {table: [files]}."""
+    rng = np.random.default_rng(seed)
+    n_sales = int(120_000 * scale)
+    n_customers = int(4_000 * scale) or 1
+    n_items = int(1_000 * scale) or 1
+    n_stores = max(int(12 * scale), 2)
+    n_dates = 730   # two years
+
+    os.makedirs(root, exist_ok=True)
+    out: dict[str, list[str]] = {}
+
+    # -- dims ---------------------------------------------------------------
+    states = np.array(["CA", "TX", "NY", "WA", "GA", "OH", "IL", "MI"])
+    customer = pa.table({
+        "c_customer_sk": pa.array(np.arange(n_customers), pa.int64()),
+        "c_birth_year": pa.array(
+            rng.integers(1930, 2005, n_customers), pa.int64()),
+        "c_state": pa.array(states[rng.integers(0, len(states),
+                                                n_customers)]),
+        # ~2% null emails exercise null join/agg semantics
+        "c_email": pa.array(
+            [None if rng.random() < 0.02 else f"c{i}@example.com"
+             for i in range(n_customers)], pa.string()),
+    })
+
+    cats = np.array(["Books", "Music", "Shoes", "Home", "Sports",
+                     "Electronics", "Jewelry"])
+    item = pa.table({
+        "i_item_sk": pa.array(np.arange(n_items), pa.int64()),
+        "i_category": pa.array(cats[rng.integers(0, len(cats), n_items)]),
+        "i_brand": pa.array([f"brand#{b:03d}" for b in
+                             rng.integers(0, 50, n_items)], pa.string()),
+        "i_current_price": pa.array(
+            np.round(rng.uniform(0.5, 300.0, n_items), 2), pa.float64()),
+    })
+
+    store = pa.table({
+        "s_store_sk": pa.array(np.arange(n_stores), pa.int64()),
+        "s_state": pa.array(states[rng.integers(0, len(states), n_stores)]),
+        "s_number_employees": pa.array(
+            rng.integers(50, 300, n_stores), pa.int64()),
+    })
+
+    date_dim = pa.table({
+        "d_date_sk": pa.array(np.arange(n_dates), pa.int64()),
+        "d_year": pa.array(2000 + (np.arange(n_dates) // 365), pa.int64()),
+        "d_moy": pa.array(1 + (np.arange(n_dates) % 365) // 31, pa.int64()),
+    })
+
+    # -- fact ---------------------------------------------------------------
+    qty = rng.integers(1, 20, n_sales)
+    price = np.round(rng.uniform(0.5, 300.0, n_sales), 2)
+    profit = np.round(rng.normal(5.0, 40.0, n_sales), 2)
+    # ~1.5% of net_paid is NULL (returns in flight)
+    paid_null = rng.random(n_sales) < 0.015
+    store_sales = pa.table({
+        "ss_sold_date_sk": pa.array(
+            rng.integers(0, n_dates, n_sales), pa.int64()),
+        "ss_customer_sk": pa.array(
+            rng.integers(0, n_customers, n_sales), pa.int64()),
+        "ss_item_sk": pa.array(rng.integers(0, n_items, n_sales), pa.int64()),
+        "ss_store_sk": pa.array(
+            rng.integers(0, n_stores, n_sales), pa.int64()),
+        "ss_quantity": pa.array(qty, pa.int64()),
+        "ss_sales_price": pa.array(price, pa.float64()),
+        "ss_net_profit": pa.array(profit, pa.float64()),
+        "ss_net_paid": pa.array(np.where(paid_null, np.nan, price * qty),
+                                pa.float64(), mask=paid_null),
+    })
+
+    def write(name: str, tbl: pa.Table, n_files: int = 1):
+        files = []
+        rows = tbl.num_rows
+        per = (rows + n_files - 1) // n_files
+        for i in range(n_files):
+            path = os.path.join(root, f"{name}_{i}.parquet")
+            pq.write_table(tbl.slice(i * per, per), path)
+            files.append(path)
+        out[name] = files
+
+    write("store_sales", store_sales, FACT_FILES)
+    write("customer", customer)
+    write("item", item)
+    write("store", store)
+    write("date_dim", date_dim)
+    return out
+
+
+def load_pandas(tables: dict) -> dict:
+    """The oracle's view: every table as a pandas DataFrame."""
+    import pandas as pd
+    out = {}
+    for name, files in tables.items():
+        out[name] = pa.concat_tables(
+            [pq.read_table(f) for f in files]).to_pandas()
+    return out

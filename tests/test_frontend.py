@@ -162,3 +162,71 @@ class TestExplode:
                       "l": pa.array([[1, 2], [3]], pa.list_(pa.int64()))})
         got = session.from_arrow(t).explode("l", keep=["id"]).collect()
         assert got.to_pydict() == {"id": [1, 1, 2], "col": [1, 2, 3]}
+
+
+# ---------------------------------------------------------------------------
+# multi-partition semantics (regressions for the partition-alignment fixes)
+# ---------------------------------------------------------------------------
+
+def _mp_session_and_files(tmp_path, n_files=3):
+    import numpy as np
+    import pyarrow.parquet as pq
+    from auron_tpu.frontend.session import Session
+    files = []
+    for i in range(n_files):
+        t = pa.table({"x": pa.array([i * 10 + j for j in range(10)],
+                                    pa.int64()),
+                      "v": pa.array([float(j) for j in range(10)])})
+        f = str(tmp_path / f"mp_{i}.parquet")
+        pq.write_table(t, f)
+        files.append(f)
+    return Session(), files
+
+
+def test_global_agg_multi_partition(tmp_path):
+    s, files = _mp_session_and_files(tmp_path)
+    df = s.read_parquet(files, partitions=3)
+    out = df.group_by().agg(F.count(col("x")).alias("n"),
+                            F.sum(col("v")).alias("sv")).collect()
+    assert out.num_rows == 1
+    assert out.column("n").to_pylist() == [30]
+    assert out.column("sv").to_pylist() == [3 * sum(range(10))]
+
+
+def test_join_uncopartitioned_broadcasts(tmp_path):
+    s, files = _mp_session_and_files(tmp_path)
+    probe = s.read_parquet(files, partitions=3)
+    build = s.from_arrow(pa.table({
+        "x": pa.array(list(range(0, 30, 2)), pa.int64()),
+        "tag": pa.array([f"t{i}" for i in range(15)], pa.string())}))
+    out = probe.join(build, on="x").collect()
+    # without broadcast alignment, probe partitions 1-2 would crash or
+    # silently drop their matches
+    assert out.num_rows == 15
+    got = dict(zip(out.column("x").to_pylist(),
+                   out.column("tag").to_pylist()))
+    assert got == {2 * i: f"t{i}" for i in range(15)}
+
+
+def test_limit_multi_partition_is_global(tmp_path):
+    s, files = _mp_session_and_files(tmp_path)
+    out = s.read_parquet(files, partitions=3).limit(5).collect()
+    assert out.num_rows == 5
+
+
+def test_sort_multi_partition_is_global(tmp_path):
+    s, files = _mp_session_and_files(tmp_path)
+    out = (s.read_parquet(files, partitions=3)
+           .sort(col("x").desc()).collect())
+    xs = out.column("x").to_pylist()
+    assert xs == sorted(xs, reverse=True)
+    assert len(xs) == 30
+
+
+def test_union_partition_mismatch_raises(tmp_path):
+    s, files = _mp_session_and_files(tmp_path)
+    a = s.read_parquet(files, partitions=3)
+    b = s.read_parquet(files, partitions=2)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="partition counts"):
+        a.union(b)
