@@ -338,6 +338,24 @@ class DataFrame:
 
     where = filter
 
+    def _project_provenance(self, exprs, names):
+        """Hash-partitioning provenance survives a projection when every
+        key column passes through untransformed — possibly renamed, in
+        which case the provenance carries the NEW name (the data layout
+        is unchanged; only the label moved)."""
+        prov = self.partitioning
+        if not prov or prov[0] != "hash":
+            return None
+        renames = {}
+        for e, nm in zip(exprs, names):
+            if isinstance(e, ir.ColumnRef):
+                renames.setdefault(self.schema[e.index].name, nm)
+        try:
+            new_keys = tuple(renames[k] for k in prov[1])
+        except KeyError:
+            return None
+        return ("hash", new_keys, prov[2])
+
     def select(self, *cols: Union[str, Col]) -> "DataFrame":
         cs = [col(c) if isinstance(c, str) else c for c in cols]
         exprs = [resolve(c, self.schema) for c in cs]
@@ -350,7 +368,8 @@ class DataFrame:
             dt, p, s = infer_dtype(e, self.schema)
             fields.append(Field(nm, dt, True, p, s))
         return DataFrame(self.session, node, Schema(tuple(fields)),
-                         self.num_partitions)
+                         self.num_partitions,
+                         self._project_provenance(exprs, names))
 
     def with_column(self, name: str, c: Col) -> "DataFrame":
         existing = [col(f.name) for f in self.schema]
@@ -375,12 +394,15 @@ class DataFrame:
         out_partitions = self.num_partitions
         prov = None
         if self.num_partitions > 1:
-            # a per-partition sort is not a global sort: top-k coalesces
-            # to one partition first; a full sort range-exchanges so the
-            # per-partition runs concatenate globally ordered (the Spark
-            # global-sort shape, reference: shuffle/mod.rs:204-279 range
-            # partitioning + NativeSortExec per partition)
+            # a per-partition sort is not a global sort: top-k runs a
+            # MAP-SIDE SortNode(fetch=k) per partition so only
+            # n_part * k rows cross the coalescing exchange, then the
+            # final top-k; a full sort range-exchanges so per-partition
+            # runs concatenate globally ordered (the Spark global-sort /
+            # TakeOrdered shape, reference: shuffle/mod.rs:204-279)
             if limit is not None:
+                child = pb.PlanNode(sort=pb.SortNode(
+                    child=child, sort_orders=so_protos, fetch=limit))
                 part = pb.PartitioningP(kind="single", num_partitions=1)
                 out_partitions = 1
                 prov = ("single",)
@@ -404,8 +426,11 @@ class DataFrame:
         out_partitions = self.num_partitions
         prov = self.partitioning
         if self.num_partitions > 1:
-            # LIMIT is global: coalesce to one partition first, else every
-            # partition would emit up to n rows
+            # LIMIT is global: a map-side LocalLimit caps each partition
+            # at n rows so at most n_part * n rows cross the coalescing
+            # exchange, then the global limit truncates (the Spark
+            # LocalLimit/GlobalLimit pair)
+            child = pb.PlanNode(limit=pb.LimitNode(child=child, limit=n))
             child = pb.PlanNode(shuffle_writer=pb.ShuffleWriterNode(
                 child=child,
                 partitioning=pb.PartitioningP(kind="single",

@@ -1,0 +1,18 @@
+"""Host-engine integration: converters that ingest an external engine's
+physical plan and lower its maximal convertible subtrees onto this
+engine's protobuf IR.
+
+The L1 layer of the reference (reference:
+spark-extension/src/main/scala/org/apache/spark/sql/auron/
+AuronConverters.scala:209-310, AuronConvertStrategy.scala:41-76): a
+convert strategy tags every node convertible / never-convert-with-reason,
+then per-class converters build the native plan, with explicit fallback
+boundaries where the host engine keeps executing.
+"""
+
+from auron_tpu.integration.spark_plan import SparkNode, parse_plan
+from auron_tpu.integration.spark_converter import (ConversionReport,
+                                                   SparkPlanConverter)
+
+__all__ = ["SparkNode", "parse_plan", "SparkPlanConverter",
+           "ConversionReport"]

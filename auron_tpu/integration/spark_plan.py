@@ -1,0 +1,79 @@
+"""Decoder for Spark's TreeNode JSON (``plan.toJSON`` /
+``df.queryExecution.executedPlan.toJSON``).
+
+Spark serializes a plan (or expression) tree as a JSON array of node
+objects in PRE-ORDER, each carrying ``class`` (the JVM class name) and
+``num-children``; the tree is reconstructed by consuming children
+recursively from the flattened sequence. TreeNode-valued FIELDS (e.g. a
+filter's ``condition``, a project's ``projectList`` entries) are encoded
+the same way: a JSON array is one flattened expression tree, a list of
+arrays is a sequence of trees.
+
+This module only rebuilds the tree; semantics live in spark_converter.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class SparkNode:
+    cls: str                  # fully-qualified JVM class name
+    fields: dict              # raw JSON fields of this node
+    children: list            # child SparkNodes (plan or expression)
+
+    @property
+    def simple_name(self) -> str:
+        return self.cls.rsplit(".", 1)[-1]
+
+    def field_tree(self, name: str) -> Optional["SparkNode"]:
+        """A field holding ONE flattened tree."""
+        v = self.fields.get(name)
+        if not v:
+            return None
+        return _decode_flat(v)
+
+    def field_trees(self, name: str) -> list:
+        """A field holding a SEQUENCE of flattened trees."""
+        v = self.fields.get(name)
+        if not v:
+            return []
+        if isinstance(v[0], dict):
+            # some writers inline a single tree without the outer list
+            return [_decode_flat(v)]
+        return [_decode_flat(t) for t in v]
+
+    def __repr__(self):
+        return f"{self.simple_name}({len(self.children)} children)"
+
+
+def _decode_pre_order(nodes: list, pos: int) -> tuple[SparkNode, int]:
+    raw = nodes[pos]
+    n = int(raw.get("num-children", 0))
+    children = []
+    nxt = pos + 1
+    for _ in range(n):
+        child, nxt = _decode_pre_order(nodes, nxt)
+        children.append(child)
+    return SparkNode(raw["class"], raw, children), nxt
+
+
+def _decode_flat(nodes: list) -> SparkNode:
+    root, end = _decode_pre_order(nodes, 0)
+    if end != len(nodes):
+        raise ValueError(
+            f"flattened tree has {len(nodes) - end} trailing nodes "
+            f"(root {root.cls})")
+    return root
+
+
+def parse_plan(data) -> SparkNode:
+    """data: the JSON array (or its json string) produced by plan.toJSON."""
+    if isinstance(data, (str, bytes)):
+        data = json.loads(data)
+    if not isinstance(data, list) or not data:
+        raise ValueError("expected a non-empty JSON array of plan nodes")
+    return _decode_flat(data)

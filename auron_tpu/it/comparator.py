@@ -38,23 +38,32 @@ class QueryResultComparator:
         self.abs = double_abs_tol
 
     @staticmethod
-    def _canon_rows(table) -> list[tuple]:
-        """Rows as sortable tuples; None sorts first, floats via repr for
-        the sort key only (comparison uses tolerance)."""
+    def _cell_key(v):
+        """Type-ranked, NUMERIC sort key: None < numbers (by value, NaN
+        last) < strings < other. Floats must sort by value, not repr —
+        lexicographic float keys ('100.0' < '99.9') could align the two
+        sides differently and misreport tolerance-level differences as
+        row mismatches."""
+        if v is None:
+            return (0, 0, "")
+        if isinstance(v, bool):
+            return (1, 2, float(v))
+        if isinstance(v, (int, float)):
+            f = float(v)
+            if math.isnan(f):
+                return (1, 2, math.inf)
+            return (1, 2, f)
+        if isinstance(v, str):
+            return (1, 3, v)
+        return (1, 4, str(v))
+
+    @classmethod
+    def _canon_rows(cls, table) -> list[tuple]:
+        """Rows as sortable tuples (engine output order is unspecified)."""
         rows = [tuple(r[c] for c in table.column_names)
                 for r in table.to_pylist()]
-
-        def key(row):
-            return tuple((v is not None,
-                          repr(v) if isinstance(v, float) else v if v is not None else "")
-                         for v in row)
-        # stringify mixed-type sort keys defensively
-        def skey(row):
-            return tuple((v is not None, str(v)) for v in row)
-        try:
-            return sorted(rows, key=key)
-        except TypeError:
-            return sorted(rows, key=skey)
+        return sorted(rows, key=lambda row: tuple(cls._cell_key(v)
+                                                  for v in row))
 
     def _cell_equal(self, a, b) -> bool:
         if a is None or b is None:

@@ -91,6 +91,24 @@ _STR_KINDS = ("smin", "smax", "sfirst", "sfirst_ign")
 
 def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
     fn = agg.fn
+    if agg.distinct:
+        # DISTINCT state rides the collect_set accumulator: the merge
+        # kernel already dedupes per group, so count/sum/avg finalize
+        # straight off the set (reference models distinct the same
+        # "expand to set then aggregate" way); min/max/first are
+        # distinct-invariant and keep their plain state
+        if fn in ("count", "sum", "avg"):
+            dt, p, s = infer_dtype(agg.arg, in_schema)
+            if dt in (DataType.STRING, DataType.LIST):
+                raise NotImplementedError(f"{fn} DISTINCT over {dt.value}")
+            res = {"count": (DataType.INT64, 0, 0),
+                   "sum": (_SUM_DTYPE[dt], 0, 0),
+                   "avg": (DataType.FLOAT64, 0, 0)}[fn]
+            return AccSpec(f"{fn}_distinct",
+                           (("set", dt, "collect_set"),), res, elem=dt)
+        if fn not in ("min", "max", "first", "first_ignores_null",
+                      "collect_set"):
+            raise NotImplementedError(f"{fn} DISTINCT")
     if fn in ("count", "count_star"):
         return AccSpec(fn, (("count", DataType.INT64, "sum"),),
                        (DataType.INT64, 0, 0))
@@ -881,13 +899,14 @@ class AggOp(PhysicalOp):
         for agg, spec in zip(self.aggs, self.specs):
             if spec.state_fields and spec.state_fields[0][2] in HOST_KINDS:
                 continue              # accumulated host-side
-            if agg.fn in ("collect_list", "collect_set"):
+            if spec.state_fields[0][2] in ("collect_list", "collect_set"):
+                # collect_* and the DISTINCT aggs share the padded-list
+                # accumulator (one-element list per valid row; len 0
+                # where null: Spark collect_*/distinct skip nulls)
                 v = evaluate(agg.arg, batch, in_schema, ctx)
                 if not isinstance(v.col, PrimitiveColumn):
                     raise NotImplementedError(f"{agg.fn} over non-primitives")
                 valid = v.validity & live
-                # one-element list per valid row (len 0 where null: Spark
-                # collect_* skip nulls)
                 accs.append((v.col.data[:, None], valid.astype(jnp.int32)))
                 continue
             if agg.fn in ("count", "count_star"):
@@ -1136,6 +1155,25 @@ class AggOp(PhysicalOp):
                     # empty list (not null) for groups with only nulls —
                     # Spark's collect_* semantics
                     out_cols.append(list_col(state_vals[0]))
+                elif fn in ("count_distinct", "sum_distinct",
+                            "avg_distinct"):
+                    vals, lens = state_vals[0]  # deduped set per group
+                    if fn == "count_distinct":
+                        out_cols.append(PrimitiveColumn(
+                            lens.astype(jnp.int64), valid))
+                    else:
+                        e = vals.shape[1]
+                        mask = (jnp.arange(e, dtype=jnp.int32)[None, :]
+                                < lens[:, None])
+                        jdt = _JNPT[spec.result[0]]
+                        s = jnp.sum(jnp.where(mask, vals, 0),
+                                    axis=1).astype(jdt)
+                        if fn == "avg_distinct":
+                            s = (s.astype(jnp.float64)
+                                 / jnp.maximum(lens, 1))
+                        # all-null group: no distinct values → NULL
+                        out_cols.append(PrimitiveColumn(
+                            s, valid & (lens > 0)))
                 elif spec.state_fields and spec.state_fields[0][2] in HOST_KINDS:
                     host_slots.append((len(out_cols), si))
                     out_cols.append(None)
@@ -1341,7 +1379,7 @@ class AggOp(PhysicalOp):
         cols = []
         for si, spec in enumerate(self.specs):
             dt = spec.result[0]
-            if spec.fn in ("count", "count_star"):
+            if spec.fn in ("count", "count_star", "count_distinct"):
                 cols.append(PrimitiveColumn(jnp.zeros(1, jnp.int64),
                                             jnp.ones(1, bool)))
             elif spec.fn in ("collect_list", "collect_set"):
@@ -1372,6 +1410,13 @@ def make_acc_spec_from_partial(agg: ir.AggFunction, in_schema: Schema,
                                start_idx: int) -> AccSpec:
     """Spec for the final side: state dtypes read from the partial schema."""
     fn = agg.fn
+    if agg.distinct and fn in ("count", "sum", "avg"):
+        elem = in_schema[start_idx].elem
+        res = {"count": (DataType.INT64, 0, 0),
+               "sum": (_SUM_DTYPE[elem], 0, 0),
+               "avg": (DataType.FLOAT64, 0, 0)}[fn]
+        return AccSpec(f"{fn}_distinct",
+                       (("set", elem, "collect_set"),), res, elem=elem)
     if fn in ("count", "count_star"):
         return AccSpec(fn, (("count", DataType.INT64, "sum"),),
                        (DataType.INT64, 0, 0))

@@ -1,0 +1,152 @@
+"""Regenerate the recorded Spark-plan fixtures under tests/fixtures/.
+
+Run: python tests/gen_spark_fixtures.py
+The fixtures are committed; this script documents exactly how they were
+authored (in Spark's plan.toJSON encoding, see spark_fixture_builder).
+"""
+
+import json
+import os
+
+from spark_fixture_builder import (agg_expr, alias, attr, bhj,
+                                   broadcast_exchange, file_scan, filter_,
+                                   hash_agg, hash_partitioning,
+                                   input_adapter, isin, lit, project,
+                                   python_eval, shuffle_exchange, smj,
+                                   sort_order, take_ordered, unop, wscg)
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+SS_FILES = [f"/data/tpcds/store_sales_{i}.parquet" for i in range(4)]
+ITEM_FILES = ["/data/tpcds/item_0.parquet"]
+STORE_FILES = ["/data/tpcds/store_0.parquet"]
+
+
+def q03_plan():
+    """TPC-DS q3-class: scan ⋈ broadcast(item) → two-phase agg → top-k.
+
+    SELECT i_category, sum(ss_sales_price) AS total_sales
+    FROM store_sales JOIN item ON ss_item_sk = i_item_sk
+    WHERE i_category IN ('Books','Music','Shoes')
+      AND ss_item_sk IS NOT NULL
+    GROUP BY i_category ORDER BY total_sales DESC, i_category LIMIT 10
+    """
+    ss_item = attr("ss_item_sk", 3, "long")
+    ss_price = attr("ss_sales_price", 5, "double")
+    i_item = attr("i_item_sk", 19, "long")
+    i_cat = attr("i_category", 20, "string")
+
+    scan_ss = file_scan([ss_item, ss_price], SS_FILES)
+    probe = wscg(filter_(unop("IsNotNull", ss_item), scan_ss), 1)
+
+    scan_it = file_scan([i_item, i_cat], ITEM_FILES)
+    build = broadcast_exchange(
+        wscg(filter_(isin(i_cat, lit("Books", "string"),
+                          lit("Music", "string"),
+                          lit("Shoes", "string")), scan_it), 2))
+
+    join = bhj([ss_item], [i_item], "Inner", probe, build)
+    proj = project([i_cat, ss_price], join)
+
+    partial = hash_agg([i_cat],
+                       [agg_expr("Sum", ss_price, "Partial", 29)],
+                       [], proj)
+    exchange = shuffle_exchange(hash_partitioning([i_cat], 4),
+                                input_adapter(partial))
+    buffer_ref = attr("sum", 29, "double")
+    final = hash_agg(
+        [i_cat],
+        [agg_expr("Sum", buffer_ref, "Final", 30)],
+        [i_cat, alias(attr("sum(ss_sales_price)", 30, "double"),
+                      "total_sales", 31)],
+        exchange)
+    top = take_ordered(
+        [sort_order(attr("total_sales", 31, "double"), ascending=False),
+         sort_order(attr("i_category", 20, "string"))],
+        10, [], wscg(final, 3))
+    return top.flatten()
+
+
+def q04_smj_plan():
+    """Sort-merge-join variant: sales ⋈ store co-partitioned by exchange,
+    aggregated by state (complete mode, single stage after exchange)."""
+    ss_store = attr("ss_store_sk", 7, "long")
+    ss_profit = attr("ss_net_profit", 8, "double")
+    s_store = attr("s_store_sk", 40, "long")
+    s_state = attr("s_state", 41, "string")
+
+    left = shuffle_exchange(
+        hash_partitioning([ss_store], 4),
+        wscg(file_scan([ss_store, ss_profit], SS_FILES), 1))
+    left_sorted = T_sort([sort_order(ss_store)], left)
+    right = shuffle_exchange(
+        hash_partitioning([s_store], 4),
+        wscg(file_scan([s_store, s_state], STORE_FILES), 2))
+    right_sorted = T_sort([sort_order(s_store)], right)
+
+    join = smj([ss_store], [s_store], "Inner", left_sorted, right_sorted)
+    proj = project([s_state, ss_profit], join)
+    partial = hash_agg([s_state],
+                       [agg_expr("Sum", ss_profit, "Partial", 50),
+                        agg_expr("Count", ss_profit, "Partial", 51,
+                                 dtype="long")],
+                       [], proj)
+    exchange = shuffle_exchange(hash_partitioning([s_state], 4),
+                                input_adapter(partial))
+    final = hash_agg(
+        [s_state],
+        [agg_expr("Sum", attr("sum", 50, "double"), "Final", 52),
+         agg_expr("Count", attr("count", 51, "long"), "Final", 53,
+                  dtype="long")],
+        [s_state,
+         alias(attr("sum(ss_net_profit)", 52, "double"), "profit", 54),
+         alias(attr("count(ss_net_profit)", 53, "long"), "n", 55)],
+        exchange)
+    return final.flatten()
+
+
+def T_sort(orders, child):
+    from spark_fixture_builder import SPARK_EXEC, T
+    return T(f"{SPARK_EXEC}.SortExec", [child],
+             sortOrder=[o.flatten() for o in orders],
+             **{"global": False, "testSpillFrequency": 0})
+
+
+def q_fallback_plan():
+    """A plan with an unconvertible BatchEvalPythonExec in the middle —
+    exercises never-convert tagging + the ConvertToNative boundary."""
+    ss_store = attr("ss_store_sk", 7, "long")
+    ss_qty = attr("ss_quantity", 9, "long")
+    udf_out = attr("py_bucket", 60, "long")
+
+    scan = file_scan([ss_store, ss_qty], SS_FILES)
+    py = python_eval([ss_store, ss_qty, udf_out],
+                     filter_(unop("IsNotNull", ss_store), scan))
+    partial = hash_agg([udf_out],
+                       [agg_expr("Sum", ss_qty, "Partial", 61,
+                                 dtype="long")],
+                       [], py)
+    exchange = shuffle_exchange(hash_partitioning([udf_out], 2),
+                                input_adapter(partial))
+    final = hash_agg(
+        [udf_out],
+        [agg_expr("Sum", attr("sum", 61, "long"), "Final", 62,
+                  dtype="long")],
+        [udf_out, alias(attr("sum(ss_quantity)", 62, "long"), "qty", 63)],
+        exchange)
+    return final.flatten()
+
+
+def main():
+    os.makedirs(FIXTURES, exist_ok=True)
+    for name, plan in [("spark_plan_q03.json", q03_plan()),
+                       ("spark_plan_q04_smj.json", q04_smj_plan()),
+                       ("spark_plan_fallback.json", q_fallback_plan())]:
+        with open(os.path.join(FIXTURES, name), "w") as f:
+            json.dump(plan, f, indent=1)
+        print("wrote", name)
+
+
+if __name__ == "__main__":
+    main()

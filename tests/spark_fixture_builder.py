@@ -1,0 +1,187 @@
+"""Builder emitting Spark's TreeNode JSON encoding (plan.toJSON): a
+pre-order array of {"class", "num-children", ...fields}; tree-valued
+fields are themselves flattened arrays. Used to author recorded-plan
+fixtures in tests/fixtures/ exactly the way a live
+``df.queryExecution.executedPlan.toJSON`` call renders them."""
+
+from __future__ import annotations
+
+SPARK_EXEC = "org.apache.spark.sql.execution"
+CATALYST = "org.apache.spark.sql.catalyst.expressions"
+
+
+class T:
+    """One tree node; flatten() renders the Spark encoding."""
+
+    def __init__(self, cls: str, children=(), **fields):
+        self.cls = cls
+        self.children = list(children)
+        self.fields = fields
+
+    def flatten(self) -> list:
+        out = [{"class": self.cls, "num-children": len(self.children),
+                **self.fields}]
+        for c in self.children:
+            out.extend(c.flatten())
+        return out
+
+
+# -- expressions ------------------------------------------------------------
+
+def attr(name: str, eid: int, dtype: str) -> T:
+    return T(f"{CATALYST}.AttributeReference", name=name, dataType=dtype,
+             nullable=True, metadata={},
+             exprId={"product-class": f"{CATALYST}.ExprId", "id": eid,
+                     "jvmId": "00000000-0000-0000-0000-000000000000"},
+             qualifier=[])
+
+
+def lit(value, dtype: str) -> T:
+    return T(f"{CATALYST}.Literal", value=None if value is None
+             else str(value), dataType=dtype)
+
+
+def alias(child: T, name: str, eid: int) -> T:
+    return T(f"{CATALYST}.Alias", [child], name=name,
+             exprId={"product-class": f"{CATALYST}.ExprId", "id": eid,
+                     "jvmId": "00000000-0000-0000-0000-000000000000"},
+             qualifier=[], explicitMetadata=None,
+             nonInheritableMetadataKeys=[])
+
+
+def binop(cls: str, left: T, right: T) -> T:
+    return T(f"{CATALYST}.{cls}", [left, right])
+
+
+def unop(cls: str, child: T) -> T:
+    return T(f"{CATALYST}.{cls}", [child])
+
+
+def isin(child: T, *lits: T) -> T:
+    return T(f"{CATALYST}.In", [child, *lits])
+
+
+def sort_order(child: T, ascending=True, nulls_first=None) -> T:
+    if nulls_first is None:
+        nulls_first = ascending
+    return T(f"{CATALYST}.SortOrder", [child],
+             direction={"object": f"{CATALYST}."
+                        + ("Ascending$" if ascending else "Descending$")},
+             nullOrdering={"object": f"{CATALYST}."
+                           + ("NullsFirst$" if nulls_first
+                              else "NullsLast$")},
+             sameOrderExpressions=[])
+
+
+def agg_expr(fn_cls: str, arg, mode: str, result_id: int,
+             dtype: str = "double", distinct=False) -> T:
+    fn = T(f"{CATALYST}.aggregate.{fn_cls}",
+           [arg] if arg is not None else [], dataType=dtype)
+    return T(f"{CATALYST}.aggregate.AggregateExpression", [fn],
+             mode={"object": f"{CATALYST}.aggregate.{mode}$"},
+             isDistinct=distinct,
+             resultId={"product-class": f"{CATALYST}.ExprId",
+                       "id": result_id,
+                       "jvmId": "00000000-0000-0000-0000-000000000000"})
+
+
+# -- plan nodes -------------------------------------------------------------
+
+def file_scan(output: list[T], files: list[str],
+              fmt: str = "Parquet") -> T:
+    loc = "InMemoryFileIndex[" + ", ".join(f"file:{f}" for f in files) + "]"
+    return T(f"{SPARK_EXEC}.FileSourceScanExec",
+             output=[a.flatten() for a in output],
+             metadata={"Location": loc, "Format": fmt,
+                       "ReadSchema": "", "Batched": "true",
+                       "PartitionFilters": "[]", "PushedFilters": "[]"},
+             relation=None, tableIdentifier=None, disableBucketedScan=False)
+
+
+def filter_(cond: T, child: T) -> T:
+    return T(f"{SPARK_EXEC}.FilterExec", [child],
+             condition=cond.flatten())
+
+
+def project(plist: list[T], child: T) -> T:
+    return T(f"{SPARK_EXEC}.ProjectExec", [child],
+             projectList=[p.flatten() for p in plist])
+
+
+def hash_agg(groups: list[T], aggs: list[T], results: list[T],
+             child: T) -> T:
+    return T(f"{SPARK_EXEC}.aggregate.HashAggregateExec", [child],
+             requiredChildDistributionExpressions=None,
+             groupingExpressions=[g.flatten() for g in groups],
+             aggregateExpressions=[a.flatten() for a in aggs],
+             aggregateAttributes=[],
+             initialInputBufferOffset=0,
+             resultExpressions=[r.flatten() for r in results])
+
+
+def shuffle_exchange(partitioning: T, child: T) -> T:
+    return T(f"{SPARK_EXEC}.exchange.ShuffleExchangeExec", [child],
+             outputPartitioning=partitioning.flatten(),
+             shuffleOrigin={"object": f"{SPARK_EXEC}.exchange."
+                            "ENSURE_REQUIREMENTS$"})
+
+
+def hash_partitioning(keys: list[T], n: int) -> T:
+    return T("org.apache.spark.sql.catalyst.plans.physical"
+             ".HashPartitioning", keys, numPartitions=n)
+
+
+def single_partition() -> T:
+    return T("org.apache.spark.sql.catalyst.plans.physical"
+             ".SinglePartition$", numPartitions=1)
+
+
+def broadcast_exchange(child: T) -> T:
+    return T(f"{SPARK_EXEC}.exchange.BroadcastExchangeExec", [child],
+             mode={"product-class": f"{SPARK_EXEC}.joins"
+                   ".HashedRelationBroadcastMode"})
+
+
+def bhj(left_keys: list[T], right_keys: list[T], join_type: str,
+        left: T, right: T, build_side: str = "BuildRight") -> T:
+    return T(f"{SPARK_EXEC}.joins.BroadcastHashJoinExec", [left, right],
+             leftKeys=[k.flatten() for k in left_keys],
+             rightKeys=[k.flatten() for k in right_keys],
+             joinType={"object": "org.apache.spark.sql.catalyst.plans."
+                       f"{join_type}$"},
+             buildSide={"object": "org.apache.spark.sql.catalyst."
+                        f"optimizer.{build_side}$"},
+             condition=None, isNullAwareAntiJoin=False)
+
+
+def smj(left_keys: list[T], right_keys: list[T], join_type: str,
+        left: T, right: T) -> T:
+    return T(f"{SPARK_EXEC}.joins.SortMergeJoinExec", [left, right],
+             leftKeys=[k.flatten() for k in left_keys],
+             rightKeys=[k.flatten() for k in right_keys],
+             joinType={"object": "org.apache.spark.sql.catalyst.plans."
+                       f"{join_type}$"},
+             condition=None, isSkewJoin=False)
+
+
+def take_ordered(orders: list[T], limit: int, plist: list[T],
+                 child: T) -> T:
+    return T(f"{SPARK_EXEC}.TakeOrderedAndProjectExec", [child],
+             limit=limit,
+             sortOrder=[o.flatten() for o in orders],
+             projectList=[p.flatten() for p in plist])
+
+
+def wscg(child: T, codegen_id: int = 1) -> T:
+    return T(f"{SPARK_EXEC}.WholeStageCodegenExec", [child],
+             codegenStageId=codegen_id)
+
+
+def input_adapter(child: T) -> T:
+    return T(f"{SPARK_EXEC}.InputAdapter", [child])
+
+
+def python_eval(output: list[T], child: T) -> T:
+    """An exec this engine does not support — exercises fallback tagging."""
+    return T(f"{SPARK_EXEC}.python.BatchEvalPythonExec", [child],
+             udfs=[], output=[a.flatten() for a in output])

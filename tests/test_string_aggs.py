@@ -192,3 +192,94 @@ def test_min_string_capacity_growth():
                 initial_capacity=8)
     got = {r["k"]: r["mn"] for r in collect(agg).to_pylist()}
     assert got == dict(zip(k, s))
+
+
+# ---------------------------------------------------------------------------
+# DISTINCT aggregates (set-based state through the same merge kernel)
+# ---------------------------------------------------------------------------
+
+def test_count_sum_avg_distinct_vs_reference():
+    rng = np.random.default_rng(21)
+    n = 600
+    k = rng.integers(0, 17, n)
+    v = rng.integers(0, 12, n).astype("int64")
+    nulls = rng.random(n) < 0.1
+    rb = pa.record_batch({"k": pa.array(k, pa.int64()),
+                          "v": pa.array(v, pa.int64(), mask=nulls)})
+    agg = AggOp(mem_scan(rb, capacity=1024), [C(0)],
+                [ir.AggFunction("count", C(1), distinct=True),
+                 ir.AggFunction("sum", C(1), distinct=True),
+                 ir.AggFunction("avg", C(1), distinct=True)],
+                mode="complete", group_names=["k"],
+                agg_names=["cd", "sd", "ad"], initial_capacity=16)
+    got = {r["k"]: (r["cd"], r["sd"], r["ad"])
+           for r in collect(agg).to_pylist()}
+    exp = {}
+    for key in set(k.tolist()):
+        vals = {int(v[i]) for i in range(n) if k[i] == key and not nulls[i]}
+        if vals:
+            exp[key] = (len(vals), sum(vals), sum(vals) / len(vals))
+        else:
+            exp[key] = (0, None, None)
+    assert set(got) == set(exp)
+    for key in exp:
+        assert got[key][0] == exp[key][0], key
+        assert got[key][1] == exp[key][1], key
+        if exp[key][2] is None:
+            assert got[key][2] is None
+        else:
+            assert abs(got[key][2] - exp[key][2]) < 1e-9
+
+
+def test_count_distinct_two_phase():
+    """DISTINCT state (a set) must merge exactly across partial/final."""
+    rb1 = pa.record_batch({"k": pa.array([1, 1, 2], pa.int64()),
+                           "v": pa.array([5, 5, 7], pa.int64())})
+    rb2 = pa.record_batch({"k": pa.array([1, 2, 2], pa.int64()),
+                           "v": pa.array([5, 7, 9], pa.int64())})
+    kw = dict(mode="partial", group_names=["k"], agg_names=["cd"],
+              initial_capacity=8)
+    aggs = [ir.AggFunction("count", C(1), distinct=True)]
+    t1 = collect(AggOp(mem_scan(rb1), [C(0)], aggs, **kw))
+    t2 = collect(AggOp(mem_scan(rb2), [C(0)], aggs, **kw))
+    merged = pa.concat_tables([t1, t2]).combine_chunks().to_batches()[0]
+    final = AggOp(mem_scan(merged, capacity=16), [C(0)],
+                  [ir.AggFunction("count", None, distinct=True)],
+                  mode="final", group_names=["k"], agg_names=["cd"],
+                  initial_capacity=8)
+    got = {r["k"]: r["cd"] for r in collect(final).to_pylist()}
+    assert got == {1: 1, 2: 2}
+
+
+def test_distinct_frontend_two_phase(tmp_path):
+    import pyarrow.parquet as pq
+    from auron_tpu.frontend import Session, col, functions as F
+    files = []
+    rng = np.random.default_rng(3)
+    for i in range(3):
+        t = pa.table({"k": pa.array(rng.integers(0, 5, 40), pa.int64()),
+                      "v": pa.array(rng.integers(0, 8, 40), pa.int64())})
+        f = str(tmp_path / f"d{i}.parquet")
+        pq.write_table(t, f)
+        files.append(f)
+    s = Session()
+    df = s.read_parquet(files, partitions=3)
+    got = {r["k"]: r["cd"] for r in
+           df.group_by("k").agg(F.count(col("v"), distinct=True)
+                                .alias("cd")).collect().to_pylist()}
+    import pandas as pd
+    full = pa.concat_tables([pq.read_table(f) for f in files]).to_pandas()
+    exp = full.groupby("k")["v"].nunique().to_dict()
+    assert got == exp
+
+
+def test_min_max_distinct_equals_plain():
+    rb = pa.record_batch({"k": pa.array([1, 1, 2], pa.int64()),
+                          "v": pa.array([3, 3, 9], pa.int64())})
+    agg = AggOp(mem_scan(rb), [C(0)],
+                [ir.AggFunction("min", C(1), distinct=True),
+                 ir.AggFunction("max", C(1), distinct=True)],
+                mode="complete", group_names=["k"], agg_names=["mn", "mx"],
+                initial_capacity=8)
+    got = {r["k"]: (r["mn"], r["mx"]) for r in collect(agg).to_pylist()}
+    assert got == {1: (3, 3), 2: (9, 9)}
