@@ -190,14 +190,23 @@ class ExprConverter:
                                                    precision=p, scale=s))
         dt, p, s = _dtype_to_proto(dt_s)
         lit = pb.LiteralE(dtype=dt, precision=p, scale=s)
-        if dt in (pb.DT_FLOAT32, pb.DT_FLOAT64):
-            lit.f64 = float(raw)
-        elif dt == pb.DT_STRING:
-            lit.str = str(raw)
-        elif dt == pb.DT_BOOL:
-            lit.i64 = 1 if str(raw).lower() == "true" else 0
-        else:
-            lit.i64 = int(raw)
+        try:
+            if dt in (pb.DT_FLOAT32, pb.DT_FLOAT64):
+                lit.f64 = float(raw)
+            elif dt == pb.DT_STRING:
+                lit.str = str(raw)
+            elif dt == pb.DT_BOOL:
+                lit.i64 = 1 if str(raw).lower() == "true" else 0
+            elif dt == pb.DT_DECIMAL:
+                # decimals travel as the scaled unscaled integer
+                from decimal import Decimal
+                lit.i64 = int(Decimal(str(raw)).scaleb(s))
+            else:
+                lit.i64 = int(raw)
+        except (ValueError, ArithmeticError) as e:
+            # surface as never-convert, not a crash of the whole plan
+            raise NotImplementedError(
+                f"unparseable {dt_s} literal {raw!r}: {e}") from e
         return pb.ExprNode(literal=lit)
 
     def sort_order(self, e: SparkNode) -> pb.SortOrderP:
@@ -386,9 +395,12 @@ class SparkPlanConverter:
         ec = ExprConverter(child.attrs)
         orders = [ec.sort_order(t) for t in node.field_trees("sortOrder")]
         limit = int(node.fields.get("limit", -1))
-        # global top-k: coalesce partitions first, as the frontend does
+        # global top-k: map-side SortNode(fetch=k) per partition so only
+        # n_part * k rows cross the coalescing exchange
         plan = child.node
         if child.partitions > 1:
+            plan = pb.PlanNode(sort=pb.SortNode(
+                child=plan, sort_orders=orders, fetch=limit))
             plan = pb.PlanNode(shuffle_writer=pb.ShuffleWriterNode(
                 child=plan,
                 partitioning=pb.PartitioningP(kind="single",
@@ -412,15 +424,18 @@ class SparkPlanConverter:
         child = self._convert(node.children[0])
         plan = child.node
         parts = child.partitions
+        limit = int(node.fields.get("limit", 0))
         if parts > 1:
+            # map-side LocalLimit caps each partition before the
+            # coalescing exchange (the LocalLimit/GlobalLimit pair)
+            plan = pb.PlanNode(limit=pb.LimitNode(child=plan, limit=limit))
             plan = pb.PlanNode(shuffle_writer=pb.ShuffleWriterNode(
                 child=plan,
                 partitioning=pb.PartitioningP(kind="single",
                                               num_partitions=1),
                 input_partitions=parts))
             parts = 1
-        n = pb.PlanNode(limit=pb.LimitNode(
-            child=plan, limit=int(node.fields.get("limit", 0))))
+        n = pb.PlanNode(limit=pb.LimitNode(child=plan, limit=limit))
         return _Converted(n, child.attrs, parts)
 
     def _c_UnionExec(self, node: SparkNode) -> _Converted:
@@ -535,7 +550,13 @@ class SparkPlanConverter:
                  for a in agg_exprs} or {"Complete"}
         if len(modes) > 1:
             raise NotImplementedError(f"mixed agg modes {modes}")
-        return groups, agg_exprs, modes.pop()
+        mode = modes.pop()
+        if mode not in ("Partial", "Final", "Complete"):
+            # e.g. PartialMerge (distinct rewrites / AQE re-optimizations):
+            # unsupported — must become a fallback boundary, not a plan
+            # that fails the engine's mode assertion later
+            raise NotImplementedError(f"aggregate mode {mode}")
+        return groups, agg_exprs, mode
 
     def _agg_fn(self, agg_expr: SparkNode) -> tuple[str, SparkNode, bool]:
         fn_tree = agg_expr.children[0]

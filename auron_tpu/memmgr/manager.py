@@ -47,7 +47,13 @@ class MemManager:
         self.min_trigger = min_trigger
         self.spill_manager = spill_manager
         self._lock = threading.Lock()
-        self._used: dict[MemConsumer, int] = {}
+        # weak keys: a consumer whose operator was dropped without an
+        # explicit unregister (e.g. a memoized exchange buffer released
+        # with its query) must not pin itself — or its accounted bytes —
+        # in the manager for the process lifetime
+        import weakref
+        self._used: "weakref.WeakKeyDictionary[MemConsumer, int]" = \
+            weakref.WeakKeyDictionary()
         self.num_spills = 0
         self.spilled_bytes = 0
 

@@ -87,6 +87,26 @@ class Spill:
         else:
             yield from self._mem_frames
 
+    def frame_at(self, index: int) -> bytes:
+        """Random access to one frame — on disk this seeks over the
+        length-prefixed frames, reading only headers plus the target (the
+        offset-indexed fetch of the reference's shuffle files,
+        sort_repartitioner.rs:151+)."""
+        assert self._finished
+        if self._path is None:
+            return self._mem_frames[index]
+        with open(self._path, "rb") as f:
+            i = 0
+            while True:
+                hdr = f.read(4)
+                if not hdr:
+                    raise IndexError(index)
+                (ln,) = struct.unpack("<I", hdr)
+                if i == index:
+                    return f.read(ln)
+                f.seek(ln, 1)
+                i += 1
+
     # -- lifecycle ----------------------------------------------------------
 
     def release(self) -> None:

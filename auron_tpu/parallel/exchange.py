@@ -1,18 +1,29 @@
 """Stage exchange (shuffle + broadcast).
 
-The reference's exchange is file-based: BufferedData staging → per-partition
-compaction → one spill file + offset index, fetched through Spark's block
-store (reference: datafusion-ext-plans/src/shuffle/buffered_data.rs:48-225,
-sort_repartitioner.rs:44-254; SURVEY.md §3.3). On TPU the design target is
-HBM-granularity exchange: rows are bucketed to target partitions on device
-(one compaction kernel per partition), stay device-resident in local mode,
-and ride ICI all-to-all when the stage runs SPMD over a mesh
-(auron_tpu.parallel.mesh_exchange). A host spill path (serialize + compress)
-covers datasets beyond HBM — that is the RSS-analogue tier.
+The reference's exchange is file-based: BufferedData staging → ONE
+per-partition-sorted compaction → spill file + offset index, fetched
+through Spark's block store (reference:
+datafusion-ext-plans/src/shuffle/buffered_data.rs:48-225,
+sort_repartitioner.rs:44-254; SURVEY.md §3.3). This engine keeps that
+exact shape at HBM granularity:
+
+- the split is ONE stable sort-by-partition-id per input batch (not P
+  compaction passes): rows land contiguous per target partition with a
+  host-side offset index — buffered_data.rs's sorted compaction verbatim;
+- sorted batches stay device-resident and are REGISTERED with the memory
+  manager; under pressure they spill to host storage via the columnar
+  serde, offsets riding along as a frame extra — the
+  SortShuffleRepartitioner spill contract;
+- a reducer partition reads its row range from each entry (device slice
+  or host-restored), never touching other partitions' rows;
+- range partitioning samples its bounds from the FIRST batches of the
+  same materialization pass (no second execution of the child).
 
 ShuffleExchangeOp is a stage boundary: the upstream subtree runs once per
-*input* partition (all materialized on first demand, memoized), downstream
-partitions then stream their buckets.
+*input* partition (all materialized on first demand, memoized),
+downstream partitions then stream their buckets. In SPMD execution the
+same sorted-compaction rides `lax.all_to_all`
+(auron_tpu.parallel.mesh_exchange).
 """
 
 from __future__ import annotations
@@ -21,33 +32,158 @@ import threading
 from functools import lru_cache
 from typing import Iterator, Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
-from auron_tpu.columnar.batch import DeviceBatch, compact
+from auron_tpu.columnar.batch import DeviceBatch, gather_batch
 from auron_tpu.columnar.schema import Schema
 from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
 from auron_tpu.parallel.partitioning import (HashPartitioning,
                                              RangePartitioning,
                                              RoundRobinPartitioning,
                                              SinglePartitioning)
+from auron_tpu.utils.shapes import bucket_rows
+
+#: rows sampled for range bounds (reference samples client-side too,
+#: NativeShuffleExchangeBase.scala:313+)
+_RANGE_SAMPLE_ROWS = 10_000
 
 
 @lru_cache(maxsize=256)
-def _split_kernel(num_partitions: int, capacity: int):
-    """One launch computes all partition buckets: for each target p, compact
-    rows with pid==p to the front (shared sort, N gathers)."""
+def _sort_by_pid_kernel(num_partitions: int, capacity: int):
+    """ONE compaction for all partitions: stable sort rows by target
+    partition id (dead rows to the end) + per-partition counts
+    (reference: shuffle/buffered_data.rs:88-160)."""
 
     @jax.jit
     def kernel(batch: DeviceBatch, pids):
         live = batch.row_mask()
-        outs = []
-        for p in range(num_partitions):
-            keep = live & (pids == p)
-            outs.append(compact(batch, keep))
-        return tuple(outs)
+        key = jnp.where(live, pids, num_partitions)
+        perm = jnp.argsort(key, stable=True)
+        sorted_batch = gather_batch(batch, perm, batch.num_rows)
+        counts = jax.ops.segment_sum(
+            live.astype(jnp.int32), jnp.clip(key, 0, num_partitions),
+            num_segments=num_partitions + 1)[:num_partitions]
+        return sorted_batch, counts
 
     return kernel
+
+
+class _ExchangeBuffer:
+    """MemConsumer owning the sorted shuffle entries of one exchange.
+
+    Each entry is one input batch sorted by partition id plus its host
+    offset index. Device entries spill (oldest first) to tiered host
+    storage via the columnar serde when the memory manager picks this
+    consumer as a victim."""
+
+    def __init__(self, op, mem_manager, metrics, conf=None):
+        from auron_tpu import config as cfg
+        conf = conf or cfg.get_config()
+        self.op = op
+        self.mem = mem_manager
+        self.metrics = metrics
+        self.codec_level = conf.get(cfg.SPILL_CODEC_LEVEL)
+        self.consumer_name = f"exchange-{id(op):x}"
+        #: entry = ["dev", DeviceBatch, offsets] | ["spill", SpillRef,
+        #: offsets, num_rows]
+        self.entries: list = []
+        self._lock = threading.RLock()
+        if mem_manager is not None:
+            mem_manager.register_consumer(self)
+
+    # -- write side ---------------------------------------------------------
+
+    def add(self, sorted_batch: DeviceBatch, offsets: np.ndarray) -> None:
+        with self._lock:
+            self.entries.append(["dev", sorted_batch, offsets])
+        if self.mem is not None:
+            self.mem.update_mem_used(self, self.mem_used())
+
+    def mem_used(self) -> int:
+        from auron_tpu.columnar.batch import batch_nbytes
+        with self._lock:
+            return sum(batch_nbytes(e[1]) for e in self.entries
+                       if e[0] == "dev")
+
+    def spill(self) -> int:
+        from auron_tpu.columnar.batch import batch_nbytes
+        from auron_tpu.columnar.serde import (batch_to_host,
+                                              serialize_host_batch,
+                                              slice_host_batch)
+        if self.mem is None or getattr(self.mem, "spill_manager", None) is None:
+            return 0
+        with self._lock:
+            victims = [(i, e) for i, e in enumerate(self.entries)
+                       if e[0] == "dev"]
+            if not victims:
+                return 0
+        n_out = len(victims[0][1][2]) - 1
+        freed = 0
+        for i, e in victims:
+            _tag, batch, offsets = e
+            n = int(batch.num_rows)
+            host = batch_to_host(batch, n)
+            # ONE FRAME PER PARTITION (the reference's data file + offset
+            # index, sort_repartitioner.rs:151+): a reducer later reads
+            # only its own frame via Spill.frame_at — never
+            # decompressing other partitions' rows
+            spill = self.mem.spill_manager.new_spill()
+            for p in range(n_out):
+                part = slice_host_batch(host, int(offsets[p]),
+                                        int(offsets[p + 1]))
+                spill.write_frame(serialize_host_batch(
+                    part, codec_level=self.codec_level))
+            freed += batch_nbytes(batch)
+            with self._lock:
+                self.entries[i] = ["spill", spill.finish(), offsets, n]
+        self.metrics.counter("mem_spill_count").add(len(victims))
+        self.metrics.counter("mem_spill_size").add(freed)
+        return freed
+
+    # -- read side ----------------------------------------------------------
+
+    def partition_batches(self, p: int) -> Iterator[DeviceBatch]:
+        from auron_tpu.columnar.serde import (deserialize_host_batch,
+                                              host_to_batch)
+        with self._lock:
+            entries = list(self.entries)
+        for e in entries:
+            offsets = e[2]
+            lo, hi = int(offsets[p]), int(offsets[p + 1])
+            n_p = hi - lo
+            if n_p <= 0:
+                continue
+            if e[0] == "dev":
+                batch = e[1]
+                cap = bucket_rows(n_p)
+                idx = jnp.minimum(lo + jnp.arange(cap, dtype=jnp.int32),
+                                  batch.capacity - 1)
+                yield gather_batch(batch, idx,
+                                   jnp.asarray(n_p, jnp.int32))
+            else:
+                host, _extras = deserialize_host_batch(e[1].frame_at(p))
+                yield host_to_batch(host, bucket_rows(n_p))
+
+    def close(self) -> None:
+        if self.mem is not None:
+            self.mem.unregister_consumer(self)
+        with self._lock:
+            entries, self.entries = self.entries, []
+        for e in entries:
+            if e[0] == "spill":
+                e[1].release()
+
+    def __del__(self):
+        # backstop: exchanges are memoized on the op for stage replay, so
+        # the buffer's spill files / registration are released when the
+        # query's op tree is dropped (the manager holds consumers weakly)
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class ShuffleExchangeOp(PhysicalOp):
@@ -59,7 +195,7 @@ class ShuffleExchangeOp(PhysicalOp):
         self.partitioning = partitioning
         self.input_partitions = input_partitions
         self._lock = threading.Lock()
-        self._buckets: Optional[list[list[DeviceBatch]]] = None
+        self._buffer: Optional[_ExchangeBuffer] = None
 
     @property
     def children(self):
@@ -72,66 +208,80 @@ class ShuffleExchangeOp(PhysicalOp):
     def num_partitions(self) -> int:
         return self.partitioning.num_partitions
 
-    def _materialize(self, ctx: ExecContext):
-        """Run all map tasks, splitting every batch into output buckets."""
-        metrics = ctx.metrics_for(self.name)
-        write_time = metrics.counter("shuffle_write_total_time")
-        n_out = self.num_partitions
-        schema = self.child.schema()
-        partitioning = self._resolve_partitioning(ctx, schema)
+    # -- map side -----------------------------------------------------------
 
-        buckets: list[list[DeviceBatch]] = [[] for _ in range(n_out)]
+    def _input_batches(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         for in_p in range(self.input_partitions):
             map_ctx = ExecContext(
                 stage_id=ctx.stage_id, partition_id=in_p,
                 num_partitions=self.input_partitions,
-                metrics=ctx.metrics, mem_manager=ctx.mem_manager)
-            row_offset = 0
-            for batch in self.child.execute(in_p, map_ctx):
-                with timer(write_time):
-                    if isinstance(partitioning, RoundRobinPartitioning):
-                        part = RoundRobinPartitioning(n_out, row_offset)
-                        pids = part.partition_ids(batch, schema)
-                    else:
-                        pids = partitioning.partition_ids(batch, schema)
-                    kern = _split_kernel(n_out, batch.capacity)
-                    outs = kern(batch, pids)
-                row_offset += int(batch.num_rows)
-                for p, out in enumerate(outs):
-                    if int(out.num_rows) > 0:
-                        buckets[p].append(out)
-        return buckets
+                metrics=ctx.metrics, mem_manager=ctx.mem_manager,
+                config=ctx.config)
+            yield from self.child.execute(in_p, map_ctx)
 
-    def _resolve_partitioning(self, ctx, schema):
-        """Range partitioning needs bounds sampled from the input — resolve
-        lazily, caching bounds on the op."""
-        p = self.partitioning
-        if isinstance(p, RangePartitioning) and not p.bounds:
+    def _materialize(self, ctx: ExecContext) -> _ExchangeBuffer:
+        """Run all map tasks; ONE sort-by-pid compaction per batch."""
+        metrics = ctx.metrics_for(self.name)
+        write_time = metrics.counter("shuffle_write_total_time")
+        n_out = self.num_partitions
+        schema = self.child.schema()
+        buffer = _ExchangeBuffer(self, ctx.mem_manager, metrics, ctx.conf)
+
+        batches = self._input_batches(ctx)
+        partitioning = self.partitioning
+        pending: list[DeviceBatch] = []
+        if isinstance(partitioning, RangePartitioning) \
+                and not partitioning.bounds:
+            # sample bounds from the LEADING batches of this same pass —
+            # the child is never executed twice
             from auron_tpu.parallel.partitioning import compute_range_bounds
-            samples = []
-            sample_rows = 0
-            for in_p in range(self.input_partitions):
-                map_ctx = ExecContext(partition_id=in_p,
-                                      num_partitions=self.input_partitions)
-                for batch in self.child.execute(in_p, map_ctx):
-                    samples.append(batch)
-                    sample_rows += int(batch.num_rows)
-                    if sample_rows >= 10000:
-                        break
-                if sample_rows >= 10000:
+            sampled = 0
+            for batch in batches:
+                pending.append(batch)
+                sampled += int(batch.num_rows)
+                if sampled >= _RANGE_SAMPLE_ROWS:
                     break
-            bounds = compute_range_bounds(samples, list(p.sort_orders), schema,
-                                          p.num_partitions)
-            p = RangePartitioning(p.sort_orders, p.num_partitions, bounds)
-            self.partitioning = p
-        return p
+            bounds = compute_range_bounds(
+                pending, list(partitioning.sort_orders), schema,
+                partitioning.num_partitions)
+            partitioning = RangePartitioning(
+                partitioning.sort_orders, partitioning.num_partitions,
+                bounds)
+            self.partitioning = partitioning
+
+        row_offset = 0
+        import itertools
+        for batch in itertools.chain(pending, batches):
+            with timer(write_time):
+                if isinstance(partitioning, RoundRobinPartitioning):
+                    part = RoundRobinPartitioning(n_out, row_offset)
+                    pids = part.partition_ids(batch, schema)
+                else:
+                    pids = partitioning.partition_ids(batch, schema)
+                kern = _sort_by_pid_kernel(n_out, batch.capacity)
+                sorted_batch, counts = kern(batch, pids)
+            row_offset += int(batch.num_rows)
+            counts_h = np.asarray(counts)
+            offsets = np.concatenate(
+                [np.zeros(1, np.int64), np.cumsum(counts_h)])
+            buffer.add(sorted_batch, offsets)
+        return buffer
+
+    # -- reduce side --------------------------------------------------------
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         with self._lock:
-            if self._buckets is None:
-                self._buckets = self._materialize(ctx)
+            if self._buffer is None:
+                self._buffer = self._materialize(ctx)
         metrics = ctx.metrics_for(self.name + "_read")
-        return count_output(iter(self._buckets[partition]), metrics)
+        read_time = metrics.counter("shuffle_read_total_time")
+
+        def stream():
+            for batch in self._buffer.partition_batches(partition):
+                with timer(read_time):
+                    yield batch
+
+        return count_output(stream(), metrics)
 
     def __repr__(self):
         return (f"ShuffleExchangeOp[{type(self.partitioning).__name__} "
@@ -166,7 +316,8 @@ class BroadcastExchangeOp(PhysicalOp):
                 for in_p in range(self.input_partitions):
                     map_ctx = ExecContext(
                         partition_id=in_p, num_partitions=self.input_partitions,
-                        metrics=ctx.metrics, mem_manager=ctx.mem_manager)
+                        metrics=ctx.metrics, mem_manager=ctx.mem_manager,
+                        config=ctx.config)
                     out.extend(self.child.execute(in_p, map_ctx))
                 self._collected = out
         metrics = ctx.metrics_for(self.name)

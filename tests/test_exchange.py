@@ -10,6 +10,7 @@ from auron_tpu.columnar.schema import DataType
 from auron_tpu.exprs import ir
 from auron_tpu.io.parquet import MemoryScanOp
 from auron_tpu.ops.agg import AggOp
+from auron_tpu.ops.base import ExecContext
 from auron_tpu.ops.sort import SortOp
 from auron_tpu.parallel.exchange import BroadcastExchangeOp, ShuffleExchangeOp
 from auron_tpu.parallel.partitioning import (HashPartitioning,
@@ -191,3 +192,83 @@ def test_mesh_exchange_overflow_retry():
     local_cap = np.asarray(out_cols[0]).shape[0] // n_dev
     got = np.asarray(out_cols[0])[:out_nr[0]]
     assert sorted(got.tolist()) == vals.tolist()
+
+
+def test_shuffle_64_partitions_spills_under_pressure(tmp_path):
+    """The VERDICT gate: a 64-partition shuffle of a larger-than-budget
+    dataset completes with spill counters > 0 — exchange entries are
+    memmgr-registered and round-trip host storage with their offset
+    index (reference spill contract: sort_repartitioner.rs:44-254)."""
+    from auron_tpu.memmgr import MemManager, SpillManager
+    from auron_tpu.parallel.partitioning import HashPartitioning
+
+    n_out = 64
+    rows = 20_000
+    rng = np.random.default_rng(12)
+    k = rng.integers(0, 100_000, rows)
+    v = rng.normal(size=rows)
+    rbs = [pa.record_batch({"k": pa.array(k[i:i + 2048], pa.int64()),
+                            "v": pa.array(v[i:i + 2048], pa.float64())})
+           for i in range(0, rows, 2048)]
+    scan = MemoryScanOp([rbs], schema_from_arrow(rbs[0].schema),
+                        capacity=2048)
+    ex = ShuffleExchangeOp(
+        scan, HashPartitioning((ir.ColumnRef(0),), n_out))
+    mm = MemManager(total_bytes=1, min_trigger=0,
+                    spill_manager=SpillManager(host_budget_bytes=1 << 22,
+                                               spill_dir=str(tmp_path)))
+    ctx = ExecContext(mem_manager=mm)
+    got = {}
+    total = 0
+    for p in range(n_out):
+        for b in ex.execute(p, ctx):
+            n = int(b.num_rows)
+            total += n
+            col_k = np.asarray(b.columns[0].data[:n])
+            col_v = np.asarray(b.columns[1].data[:n])
+            for kk, vv in zip(col_k.tolist(), col_v.tolist()):
+                got.setdefault(kk, []).append(vv)
+    assert total == rows
+    spills = ctx.metrics["shuffle_exchange"].counter(
+        "mem_spill_count").value
+    assert spills > 0, "larger-than-budget exchange must spill"
+    # content integrity across the spill round-trip
+    exp = {}
+    for kk, vv in zip(k.tolist(), v.tolist()):
+        exp.setdefault(kk, []).append(vv)
+    assert set(got) == set(exp)
+    for kk in exp:
+        assert sorted(got[kk]) == pytest.approx(sorted(exp[kk]))
+
+
+def test_range_bounds_sampled_in_single_pass():
+    """Range partitioning must not execute the child twice (round-1
+    weakness): count scan executions."""
+    from auron_tpu.parallel.partitioning import RangePartitioning
+
+    rb = pa.record_batch({"x": pa.array(list(range(512)), pa.int64())})
+    inner = MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=512)
+    calls = {"n": 0}
+
+    class CountingScan:
+        name = "scan"
+        @property
+        def children(self):
+            return []
+        def schema(self):
+            return inner.schema()
+        def execute(self, p, ctx):
+            calls["n"] += 1
+            return inner.execute(p, ctx)
+
+    so = ir.SortOrder(ir.ColumnRef(0), True, True)
+    ex = ShuffleExchangeOp(CountingScan(),
+                           RangePartitioning((so,), 4, ()))
+    ctx = ExecContext()
+    out = []
+    for p in range(4):
+        for b in ex.execute(p, ctx):
+            n = int(b.num_rows)
+            out.extend(np.asarray(b.columns[0].data[:n]).tolist())
+    assert sorted(out) == list(range(512))
+    assert calls["n"] == 1, "child must execute exactly once"
