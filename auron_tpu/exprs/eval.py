@@ -72,6 +72,16 @@ _JNP = {
     DataType.NULL: jnp.bool_,
 }
 
+def null_column_for_field(field, cap: int):
+    """All-null device column shaped for ``field`` (outer-join padding)."""
+    from auron_tpu.columnar.batch import PrimitiveColumn, StringColumn
+    if field.dtype == DataType.STRING:
+        return StringColumn(jnp.zeros((cap, 8), jnp.uint8),
+                            jnp.zeros(cap, jnp.int32), jnp.zeros(cap, bool))
+    return PrimitiveColumn(jnp.zeros(cap, _JNP[field.dtype]),
+                           jnp.zeros(cap, bool))
+
+
 _RANK = [DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64,
          DataType.FLOAT32, DataType.FLOAT64]
 

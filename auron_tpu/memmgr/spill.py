@@ -36,6 +36,8 @@ class Spill:
         self._finished = False
         self.mem_bytes = 0
         self.disk_bytes = 0
+        self._frame_sizes: list[int] = []
+        self._offsets: Optional[list[int]] = None  # built at finish()
 
     # -- write --------------------------------------------------------------
 
@@ -50,6 +52,7 @@ class Spill:
         else:
             self._mem_frames.append(frame)
             self.mem_bytes += len(frame)
+        self._frame_sizes.append(len(frame))
 
     def _spill_to_disk(self) -> None:
         fd, self._path = tempfile.mkstemp(
@@ -70,6 +73,13 @@ class Spill:
             self._file.flush()
             self._file.close()
             self._file = None
+        # byte-offset index for frame_at (the reference's partition-offset
+        # array alongside the data file, sort_repartitioner.rs:151+)
+        offs, o = [], 0
+        for s in self._frame_sizes:
+            offs.append(o)
+            o += 4 + s
+        self._offsets = offs
         return self
 
     # -- read ---------------------------------------------------------------
@@ -88,24 +98,19 @@ class Spill:
             yield from self._mem_frames
 
     def frame_at(self, index: int) -> bytes:
-        """Random access to one frame — on disk this seeks over the
-        length-prefixed frames, reading only headers plus the target (the
-        offset-indexed fetch of the reference's shuffle files,
-        sort_repartitioner.rs:151+)."""
+        """Random access to one frame: one seek via the offset index built
+        at finish() (the offset-indexed fetch of the reference's shuffle
+        files, sort_repartitioner.rs:151+)."""
         assert self._finished
         if self._path is None:
             return self._mem_frames[index]
+        if index >= len(self._offsets):
+            raise IndexError(index)
         with open(self._path, "rb") as f:
-            i = 0
-            while True:
-                hdr = f.read(4)
-                if not hdr:
-                    raise IndexError(index)
-                (ln,) = struct.unpack("<I", hdr)
-                if i == index:
-                    return f.read(ln)
-                f.seek(ln, 1)
-                i += 1
+            f.seek(self._offsets[index])
+            hdr = f.read(4)
+            (ln,) = struct.unpack("<I", hdr)
+            return f.read(ln)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -131,7 +136,9 @@ class SpillManager:
             spill_dir = conf.get(cfg.SPILL_DIR) or None
         self.host_budget = host_budget_bytes
         self.spill_dir = spill_dir
-        self._lock = threading.Lock()
+        # RLock: Spill.release can run from a GC finalizer that fires while
+        # the same thread is inside a budget-accounting critical section
+        self._lock = threading.RLock()
         self._host_used = 0
         self._next_id = 0
         if spill_dir:

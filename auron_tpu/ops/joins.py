@@ -169,29 +169,73 @@ class HashJoinOp(PhysicalOp):
         build_time = metrics.counter("build_hash_map_time")
         probe_schema = self.probe.schema()
         build_schema = self.build.schema()
+        mem = ctx.mem_manager
+        spillable = mem is not None and \
+            getattr(mem, "spill_manager", None) is not None
 
         def stream():
-            with timer(build_time):
-                build_batches = list(self.build.execute(partition, ctx))
-                if build_batches:
-                    merged = _concat_all(build_batches) if len(build_batches) > 1 \
-                        else build_batches[0]
-                else:
+            consumer = _JoinBuildConsumer(self, mem, metrics, ctx.conf) \
+                if spillable else None
+            try:
+                build_batches = []
+                with timer(build_time):
+                    for b in self.build.execute(partition, ctx):
+                        if consumer is not None:
+                            consumer.add(b)
+                        else:
+                            build_batches.append(b)
+                if consumer is not None and consumer.spills:
+                    # Build side exceeded its memory share: degrade to an
+                    # external sort-merge join over spilled runs (the
+                    # reference's smj-fallback knob, conf.rs:53-55, in the
+                    # memory-safe direction).
+                    metrics.counter("fallback_smj_count").add(1)
+                    yield from self._smj_fallback(consumer, partition, ctx)
+                    return
+                if consumer is not None:
+                    build_batches = consumer.take_buffered()
+                with timer(build_time):
                     merged = None
-            if merged is None:
-                # empty build side
-                yield from self._empty_build_stream(partition, ctx, probe_schema)
-                return
-            side = _BuildSide(merged, build_schema, self.build_keys, metrics)
+                    if build_batches:
+                        merged = _concat_all(build_batches) \
+                            if len(build_batches) > 1 else build_batches[0]
+                if merged is None:
+                    yield from self._empty_build_stream(partition, ctx,
+                                                        probe_schema)
+                    return
+                side = _BuildSide(merged, build_schema, self.build_keys,
+                                  metrics)
 
-            for probe in self.probe.execute(partition, ctx):
-                yield from self._probe_one(probe, side, probe_schema,
-                                           build_schema, elapsed)
+                for probe in self.probe.execute(partition, ctx):
+                    yield from self._probe_one(probe, side, probe_schema,
+                                               build_schema, elapsed)
 
-            if self.join_type in ("right", "full"):
-                yield self._unmatched_build(side, probe_schema, build_schema)
+                if self.join_type in ("right", "full"):
+                    yield self._unmatched_build(side, probe_schema,
+                                                build_schema)
+            finally:
+                if consumer is not None:
+                    consumer.close()
 
         return count_output(stream(), metrics)
+
+    def _smj_fallback(self, consumer: "_JoinBuildConsumer", partition: int,
+                      ctx: ExecContext) -> Iterator[DeviceBatch]:
+        """Oversized build side: sort both sides externally (SortOp handles
+        the spill-backed sorting) and stream an order-preserving merge join
+        with a bounded window."""
+        from auron_tpu.ops.smj import SortMergeJoinOp
+        from auron_tpu.ops.sort import SortOp
+        replay = _SpillReplayOp(self.build.schema(), consumer.spills,
+                                consumer.take_buffered())
+        probe_sorted = SortOp(self.probe,
+                              [ir.SortOrder(e) for e in self.probe_keys])
+        build_sorted = SortOp(replay,
+                              [ir.SortOrder(e) for e in self.build_keys])
+        smj = SortMergeJoinOp(probe_sorted, build_sorted,
+                              list(self.probe_keys), list(self.build_keys),
+                              self.join_type)
+        yield from smj.execute(partition, ctx)
 
     # -- helpers ------------------------------------------------------------
     def _probe_one(self, probe: DeviceBatch, side: _BuildSide, probe_schema,
@@ -303,18 +347,111 @@ def _null_column_like(col, cap):
 
 
 def _null_column_like_schema(field: Field, cap):
-    from auron_tpu.exprs.eval import _JNP
-    if field.dtype == DataType.STRING:
-        return StringColumn(jnp.zeros((cap, 8), jnp.uint8),
-                            jnp.zeros(cap, jnp.int32), jnp.zeros(cap, bool))
-    return PrimitiveColumn(jnp.zeros(cap, _JNP[field.dtype]),
-                           jnp.zeros(cap, bool))
+    from auron_tpu.exprs.eval import null_column_for_field
+    return null_column_for_field(field, cap)
 
 
-class SortMergeJoinOp(HashJoinOp):
-    """SMJ contract (children sorted on keys); executes via the same sorted
-    probe machinery. Output ordering is not currently preserved — acceptable
-    because every consumer in this engine re-sorts or re-hashes, but noted
-    as a deviation from the reference (sort_merge_join_exec.rs)."""
+class _JoinBuildConsumer:
+    """Build-side buffering registered with the memory manager (the
+    MemConsumer role the reference's broadcast-join build plays,
+    join_hash_map.rs:365-387). Under pressure, buffered batches spill as
+    unsorted runs to tiered storage; their presence switches the join to
+    the external sort-merge fallback."""
 
-    name = "sort_merge_join"
+    def __init__(self, op: "HashJoinOp", mem, metrics, conf):
+        import threading
+        from auron_tpu import config as cfg
+        self.mem = mem
+        self.metrics = metrics
+        self.consumer_name = f"join-build-{id(op):x}"
+        self.frame_rows = conf.get(cfg.SPILL_FRAME_ROWS)
+        self.codec_level = conf.get(cfg.SPILL_CODEC_LEVEL)
+        self.buffered: list[DeviceBatch] = []
+        self.bytes = 0
+        self.spills = []
+        self._lock = threading.RLock()
+        mem.register_consumer(self)
+
+    def add(self, batch: DeviceBatch) -> None:
+        from auron_tpu.columnar.batch import batch_nbytes
+        with self._lock:
+            self.buffered.append(batch)
+            self.bytes += batch_nbytes(batch)
+            used = self.bytes
+        self.mem.update_mem_used(self, used)
+
+    def take_buffered(self) -> list[DeviceBatch]:
+        with self._lock:
+            out, self.buffered = self.buffered, []
+            self.bytes = 0
+        return out
+
+    def mem_used(self) -> int:
+        with self._lock:
+            return self.bytes
+
+    def spill(self) -> int:
+        from auron_tpu.columnar.serde import (batch_to_host,
+                                              serialize_host_batch,
+                                              slice_host_batch)
+        with self._lock:
+            if not self.buffered:
+                return 0
+            buffered, self.buffered = self.buffered, []
+            freed, self.bytes = self.bytes, 0
+        spill = self.mem.spill_manager.new_spill()
+        for b in buffered:
+            n = int(b.num_rows)
+            host = batch_to_host(b, n)
+            for lo in range(0, max(n, 1), self.frame_rows):
+                hi = min(lo + self.frame_rows, n)
+                spill.write_frame(serialize_host_batch(
+                    slice_host_batch(host, lo, hi),
+                    codec_level=self.codec_level))
+        with self._lock:
+            self.spills.append(spill.finish())
+        self.metrics.counter("mem_spill_count").add(1)
+        self.metrics.counter("mem_spill_size").add(freed)
+        return freed
+
+    def close(self) -> None:
+        self.mem.unregister_consumer(self)
+        for s in self.spills:
+            s.release()
+        self.spills = []
+
+
+class _SpillReplayOp(PhysicalOp):
+    """Replays spilled build-side runs (plus any still-resident batches) as
+    a child stream for the sort-merge fallback."""
+
+    name = "spill_replay"
+
+    def __init__(self, schema: Schema, spills, batches: list[DeviceBatch]):
+        self._schema = schema
+        self.spills = spills
+        self.batches = batches
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from auron_tpu.columnar.serde import (deserialize_host_batch,
+                                              host_to_batch)
+        def stream():
+            for s in self.spills:
+                for frame in s.frames():
+                    host, _ = deserialize_host_batch(frame)
+                    if host.num_rows:
+                        yield host_to_batch(host, bucket_rows(host.num_rows))
+            for b in self.batches:
+                yield b
+        return stream()
+
+    def __repr__(self):
+        return f"_SpillReplayOp[{len(self.spills)} spills]"
+
+
+# canonical SMJ implementation (order-preserving streaming merge) lives in
+# ops/smj.py; re-exported here so plan builders import one joins module
+from auron_tpu.ops.smj import SortMergeJoinOp  # noqa: E402

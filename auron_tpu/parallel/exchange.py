@@ -87,9 +87,10 @@ class _ExchangeBuffer:
         self.metrics = metrics
         self.codec_level = conf.get(cfg.SPILL_CODEC_LEVEL)
         self.consumer_name = f"exchange-{id(op):x}"
-        #: entry = ["dev", DeviceBatch, offsets] | ["spill", SpillRef,
-        #: offsets, num_rows]
+        #: entry = ["dev", DeviceBatch, offsets] | ["dev-spilling", ...] |
+        #: ["spill", SpillRef, offsets, num_rows]
         self.entries: list = []
+        self._dev_bytes = 0   # running counter, guarded by _lock
         self._lock = threading.RLock()
         if mem_manager is not None:
             mem_manager.register_consumer(self)
@@ -97,16 +98,17 @@ class _ExchangeBuffer:
     # -- write side ---------------------------------------------------------
 
     def add(self, sorted_batch: DeviceBatch, offsets: np.ndarray) -> None:
-        with self._lock:
-            self.entries.append(["dev", sorted_batch, offsets])
-        if self.mem is not None:
-            self.mem.update_mem_used(self, self.mem_used())
-
-    def mem_used(self) -> int:
         from auron_tpu.columnar.batch import batch_nbytes
         with self._lock:
-            return sum(batch_nbytes(e[1]) for e in self.entries
-                       if e[0] == "dev")
+            self.entries.append(["dev", sorted_batch, offsets])
+            self._dev_bytes += batch_nbytes(sorted_batch)
+            used = self._dev_bytes
+        if self.mem is not None:
+            self.mem.update_mem_used(self, used)
+
+    def mem_used(self) -> int:
+        with self._lock:
+            return self._dev_bytes
 
     def spill(self) -> int:
         from auron_tpu.columnar.batch import batch_nbytes
@@ -115,9 +117,13 @@ class _ExchangeBuffer:
                                               slice_host_batch)
         if self.mem is None or getattr(self.mem, "spill_manager", None) is None:
             return 0
+        # claim victims under the lock (tag flip) so a concurrent spill()
+        # can't serialize the same entries twice
         with self._lock:
             victims = [(i, e) for i, e in enumerate(self.entries)
                        if e[0] == "dev"]
+            for _i, e in victims:
+                e[0] = "dev-spilling"
             if not victims:
                 return 0
         n_out = len(victims[0][1][2]) - 1
@@ -136,9 +142,15 @@ class _ExchangeBuffer:
                                         int(offsets[p + 1]))
                 spill.write_frame(serialize_host_batch(
                     part, codec_level=self.codec_level))
-            freed += batch_nbytes(batch)
+            done = spill.finish()
             with self._lock:
-                self.entries[i] = ["spill", spill.finish(), offsets, n]
+                if i < len(self.entries) and self.entries[i] is e:
+                    self.entries[i] = ["spill", done, offsets, n]
+                    self._dev_bytes -= batch_nbytes(batch)
+                    freed += batch_nbytes(batch)
+                else:
+                    # buffer was closed/cleared mid-spill
+                    done.release()
         self.metrics.counter("mem_spill_count").add(len(victims))
         self.metrics.counter("mem_spill_size").add(freed)
         return freed
@@ -156,7 +168,10 @@ class _ExchangeBuffer:
             n_p = hi - lo
             if n_p <= 0:
                 continue
-            if e[0] == "dev":
+            if e[0].startswith("dev"):
+                # "dev" or "dev-spilling": the device batch in this
+                # snapshot's entry list stays valid even if a concurrent
+                # spill swaps the entry afterwards
                 batch = e[1]
                 cap = bucket_rows(n_p)
                 idx = jnp.minimum(lo + jnp.arange(cap, dtype=jnp.int32),
@@ -172,16 +187,22 @@ class _ExchangeBuffer:
             self.mem.unregister_consumer(self)
         with self._lock:
             entries, self.entries = self.entries, []
+            self._dev_bytes = 0
         for e in entries:
             if e[0] == "spill":
                 e[1].release()
 
     def __del__(self):
-        # backstop: exchanges are memoized on the op for stage replay, so
-        # the buffer's spill files / registration are released when the
-        # query's op tree is dropped (the manager holds consumers weakly)
+        # backstop for spill files when the memoized buffer is dropped with
+        # the query's op tree. Deliberately does NOT call close(): cyclic GC
+        # can fire this finalizer on the same thread that currently holds
+        # the MemManager lock (op -> buffer -> op cycle), and
+        # unregister_consumer would deadlock on it. Registration needs no
+        # cleanup — the manager holds consumers weakly.
         try:
-            self.close()
+            for e in self.entries:
+                if e[0] == "spill":
+                    e[1].release()
         except Exception:
             pass
 

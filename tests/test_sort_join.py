@@ -177,3 +177,188 @@ def test_smj_same_results():
                          [C(0)], [C(0)], join_type="inner")
     rows = {tuple(r.values()) for r in collect(op).to_pylist()}
     assert rows == {(2, 2, 2, 10), (2, 3, 2, 10), (3, 4, 3, 20), (3, 4, 3, 30)}
+
+
+# ---------------------------------------------------------------------------
+# sort-merge join (real streaming merge)
+# ---------------------------------------------------------------------------
+
+def _smj_case(join_type, expected_rows):
+    # same data as _join_case but pre-sorted on the keys (nulls first), the
+    # SMJ contract
+    left = pa.record_batch({
+        "lk": pa.array([None, 1, 2, 2, 3], pa.int64()),
+        "lv": pa.array(["d", "a", "b", "e", "c"], pa.string()),
+    })
+    right = pa.record_batch({
+        "rk": pa.array([None, 2, 2, 4], pa.int64()),
+        "rv": pa.array([99, 20, 21, 40], pa.int64()),
+    })
+    op = SortMergeJoinOp(mem_scan(left, capacity=8), mem_scan(right, capacity=8),
+                         [C(0)], [C(0)], join_type=join_type)
+    out = collect(op)
+    rows = {tuple(r.values()) for r in out.to_pylist()}
+    assert rows == expected_rows, f"{join_type}: {rows}"
+
+
+def test_smj_inner():
+    _smj_case("inner", {
+        (2, "b", 2, 20), (2, "b", 2, 21), (2, "e", 2, 20), (2, "e", 2, 21),
+    })
+
+
+def test_smj_left():
+    _smj_case("left", {
+        (None, "d", None, None), (1, "a", None, None),
+        (2, "b", 2, 20), (2, "b", 2, 21), (2, "e", 2, 20), (2, "e", 2, 21),
+        (3, "c", None, None),
+    })
+
+
+def test_smj_right():
+    _smj_case("right", {
+        (2, "b", 2, 20), (2, "b", 2, 21), (2, "e", 2, 20), (2, "e", 2, 21),
+        (None, None, 4, 40), (None, None, None, 99),
+    })
+
+
+def test_smj_full():
+    _smj_case("full", {
+        (None, "d", None, None), (1, "a", None, None),
+        (2, "b", 2, 20), (2, "b", 2, 21), (2, "e", 2, 20), (2, "e", 2, 21),
+        (3, "c", None, None),
+        (None, None, 4, 40), (None, None, None, 99),
+    })
+
+
+def test_smj_semi_anti_existence():
+    _smj_case("semi", {(2, "b"), (2, "e")})
+    _smj_case("anti", {(None, "d"), (1, "a"), (3, "c")})
+    _smj_case("existence", {
+        (None, "d", False), (1, "a", False), (2, "b", True),
+        (2, "e", True), (3, "c", False),
+    })
+
+
+def test_smj_order_preserved_multibatch():
+    """The round-3 contract: SMJ output preserves the children's sort order,
+    streaming across many small batches on both sides."""
+    rng = np.random.default_rng(7)
+    nl, nr = 700, 900
+    lk = np.sort(rng.integers(0, 120, nl))
+    rk = np.sort(rng.integers(0, 120, nr))
+    left = pa.record_batch({"k": pa.array(lk, pa.int64()),
+                            "lv": pa.array(np.arange(nl), pa.int64())})
+    right = pa.record_batch({"rk": pa.array(rk, pa.int64()),
+                             "rv": pa.array(np.arange(nr), pa.int64())})
+    lbs = [left.slice(o, 64) for o in range(0, nl, 64)]
+    rbs = [right.slice(o, 96) for o in range(0, nr, 96)]
+    op = SortMergeJoinOp(
+        MemoryScanOp([lbs], schema_from_arrow(left.schema), capacity=64),
+        MemoryScanOp([rbs], schema_from_arrow(right.schema), capacity=96),
+        [C(0)], [C(0)], join_type="inner")
+    got = collect(op).to_pandas()
+    got.columns = ["lk", "lv", "rk", "rv"]
+
+    # exact order: ascending (left row position, right row position)
+    ldf = pd.DataFrame({"k": lk, "lv": np.arange(nl)})
+    rdf = pd.DataFrame({"k": rk, "rv": np.arange(nr)})
+    exp = ldf.merge(rdf, on="k", how="inner").sort_values(
+        ["lv", "rv"]).reset_index(drop=True)
+    assert len(got) == len(exp)
+    np.testing.assert_array_equal(got["lv"], exp["lv"])
+    np.testing.assert_array_equal(got["rv"], exp["rv"])
+    np.testing.assert_array_equal(got["lk"], exp["k"])
+    # left-outer variant: left order must hold globally over the output
+    opl = SortMergeJoinOp(
+        MemoryScanOp([lbs], schema_from_arrow(left.schema), capacity=64),
+        MemoryScanOp([rbs], schema_from_arrow(right.schema), capacity=96),
+        [C(0)], [C(0)], join_type="left")
+    gl = collect(opl).to_pandas()
+    gl.columns = ["lk", "lv", "rk", "rv"]
+    expl = ldf.merge(rdf, on="k", how="left").sort_values(
+        ["lv", "rv"], na_position="last").reset_index(drop=True)
+    assert len(gl) == len(expl)
+    np.testing.assert_array_equal(gl["lv"], expl["lv"])
+
+
+def test_smj_string_keys_mixed_widths():
+    left = pa.record_batch({
+        "k": pa.array(["aa", "bb", "bb", "a-very-long-key-string"], pa.string()),
+        "lv": pa.array([1, 2, 3, 4], pa.int64()),
+    })
+    right = pa.record_batch({
+        "rk": pa.array(["bb", "a-very-long-key-string", "zz"], pa.string()),
+        "rv": pa.array([10, 20, 30], pa.int64()),
+    })
+    # children sorted on key
+    ls = SortOp(mem_scan(left, capacity=8), [ir.SortOrder(C(0))])
+    rs = SortOp(mem_scan(right, capacity=8), [ir.SortOrder(C(0))])
+    op = SortMergeJoinOp(ls, rs, [C(0)], [C(0)], join_type="inner")
+    rows = {tuple(r.values()) for r in collect(op).to_pylist()}
+    assert rows == {("bb", 2, "bb", 10), ("bb", 3, "bb", 10),
+                    ("a-very-long-key-string", 4, "a-very-long-key-string", 20)}
+
+
+def test_smj_multi_key_differential():
+    rng = np.random.default_rng(23)
+    nl, nr = 800, 600
+    left = pa.table({
+        "a": pa.array(rng.integers(0, 12, nl), pa.int64()),
+        "b": pa.array(rng.integers(0, 6, nl), pa.int64()),
+        "lv": pa.array(np.arange(nl), pa.int64()),
+    }).to_batches()[0]
+    right = pa.table({
+        "a": pa.array(rng.integers(0, 12, nr), pa.int64()),
+        "b": pa.array(rng.integers(0, 6, nr), pa.int64()),
+        "rv": pa.array(np.arange(nr), pa.int64()),
+    }).to_batches()[0]
+    keys = [ir.SortOrder(C(0)), ir.SortOrder(C(1))]
+    op = SortMergeJoinOp(
+        SortOp(mem_scan(left, capacity=1024), keys),
+        SortOp(mem_scan(right, capacity=1024), keys),
+        [C(0), C(1)], [C(0), C(1)], join_type="inner")
+    got = collect(op).to_pandas()
+    got.columns = ["la", "lb", "lv", "ra", "rb", "rv"]
+    exp = left.to_pandas().merge(right.to_pandas(), on=["a", "b"],
+                                 how="inner")
+    assert len(got) == len(exp)
+    gs = got.sort_values(["la", "lb", "lv", "rv"]).reset_index(drop=True)
+    es = exp.sort_values(["a", "b", "lv", "rv"]).reset_index(drop=True)
+    np.testing.assert_array_equal(gs["lv"], es["lv"])
+    np.testing.assert_array_equal(gs["rv"], es["rv"])
+
+
+def test_hash_join_build_spill_falls_back_to_smj():
+    """Oversized build side must spill and degrade to the external merge
+    join instead of OOMing (round-3 join memory safety)."""
+    from auron_tpu.memmgr.manager import MemManager
+    from auron_tpu.memmgr.spill import SpillManager
+
+    rng = np.random.default_rng(31)
+    nl, nr = 1200, 4000
+    left = pa.record_batch({
+        "k": pa.array(rng.integers(0, 500, nl), pa.int64()),
+        "lv": pa.array(np.arange(nl), pa.int64()),
+    })
+    right = pa.record_batch({
+        "k": pa.array(rng.integers(0, 500, nr), pa.int64()),
+        "rv": pa.array(np.arange(nr), pa.int64()),
+    })
+    lbs = [left.slice(o, 256) for o in range(0, nl, 256)]
+    rbs = [right.slice(o, 256) for o in range(0, nr, 256)]
+    mm = MemManager(total_bytes=64 << 10, min_trigger=0,
+                    spill_manager=SpillManager(host_budget_bytes=1 << 24))
+    op = HashJoinOp(
+        MemoryScanOp([lbs], schema_from_arrow(left.schema), capacity=256),
+        MemoryScanOp([rbs], schema_from_arrow(right.schema), capacity=256),
+        [C(0)], [C(0)], join_type="inner")
+    got = collect(op, mem_manager=mm).to_pandas()
+    got.columns = ["lk", "lv", "rk", "rv"]
+    exp = left.to_pandas().merge(right.to_pandas(), on="k", how="inner")
+    assert mm.num_spills > 0, "build side must have spilled"
+    assert len(got) == len(exp)
+    gs = got.sort_values(["lk", "lv", "rv"]).reset_index(drop=True)
+    es = exp.sort_values(["k", "lv", "rv"]).reset_index(drop=True)
+    np.testing.assert_array_equal(gs["lv"], es["lv"])
+    np.testing.assert_array_equal(gs["rv"], es["rv"])
