@@ -206,6 +206,12 @@ def evaluate(expr: ir.Expr, batch: DeviceBatch, schema: Schema,
 
     if isinstance(expr, ir.GetIndexedField):
         from auron_tpu.columnar.batch import ListColumn
+        if isinstance(expr.child, ir.ScalarFunction) \
+                and expr.child.name == "split":
+            # split(...)[i] fused — string lists are never materialized
+            from auron_tpu.exprs.fn_strings import split_index
+            return split_index(expr.child.args, expr.ordinal, batch,
+                               schema, ctx)
         v = evaluate(expr.child, batch, schema, ctx)
         assert isinstance(v.col, ListColumn), "GetIndexedField needs a list"
         i = expr.ordinal
@@ -271,11 +277,16 @@ def infer_dtype(expr: ir.Expr, schema: Schema) -> tuple[DataType, int, int]:
     if isinstance(expr, ir.HostUDF):
         return expr.dtype, 0, 0
     if isinstance(expr, ir.GetIndexedField):
+        if isinstance(expr.child, ir.ScalarFunction) \
+                and expr.child.name == "split":
+            return DataType.STRING, 0, 0
         child_dt = infer_dtype(expr.child, schema)
         if child_dt[0] == DataType.LIST:
-            # element type rides in the field's elem slot
+            # element type rides in the field's elem slot / array expr
             if isinstance(expr.child, ir.ColumnRef):
                 return schema[expr.child.index].elem, 0, 0
+            from auron_tpu.exprs.fn_arrays import elem_dtype_of
+            return elem_dtype_of(expr.child, schema), 0, 0
         raise NotImplementedError("GetIndexedField on non-column list")
     raise NotImplementedError(f"infer_dtype for {type(expr).__name__}")
 

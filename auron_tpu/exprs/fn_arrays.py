@@ -1,0 +1,297 @@
+"""Array and map constructors/accessors.
+
+The reference's spark_map.rs (1,516 LoC) builds Arrow List/Map arrays row
+by row. Here arrays are the engine's padded ListColumn ([cap, max_elems]
+matrix + lens), so constructors are one stack and accessors are one
+gather. Maps have no columnar materialization yet (the batch layer has no
+MapColumn); a map built inside a projection lives as an eval-internal
+``MapValue`` (parallel key/value ListColumns) that the map accessors
+consume in the same expression tree — the common `map(...)[k]` /
+element_at pattern. Materializing a map into an output batch raises.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from auron_tpu.columnar.batch import ListColumn, PrimitiveColumn, StringColumn
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.exprs import ir
+from auron_tpu.exprs.eval import TypedValue, cast_value, infer_dtype
+from auron_tpu.exprs.functions import register
+from auron_tpu.ops import strings as S
+
+
+def _list_result(expr, schema):
+    return DataType.LIST, 0, 0
+
+
+def elem_dtype_of(a: ir.Expr, schema) -> DataType:
+    """Static element dtype of an array/map-valued expression."""
+    if isinstance(a, ir.ScalarFunction):
+        if a.name in ("array", "array_repeat") and a.args:
+            return infer_dtype(a.args[0], schema)[0]
+        if a.name == "sort_array":
+            return elem_dtype_of(a.args[0], schema)
+        if a.name == "map_keys":
+            m = a.args[0]
+            if isinstance(m, ir.ScalarFunction) and m.name == "map" and m.args:
+                return infer_dtype(m.args[0], schema)[0]
+            if isinstance(m, ir.ScalarFunction) and m.name == "map_from_arrays":
+                return elem_dtype_of(m.args[0], schema)
+        if a.name == "map_values":
+            m = a.args[0]
+            if isinstance(m, ir.ScalarFunction) and m.name == "map" and m.args:
+                return infer_dtype(m.args[1], schema)[0]
+            if isinstance(m, ir.ScalarFunction) and m.name == "map_from_arrays":
+                return elem_dtype_of(m.args[1], schema)
+    if isinstance(a, ir.ColumnRef):
+        return schema[a.index].elem
+    return DataType.INT64
+
+
+def _elem_dtype(expr, schema):
+    """Element dtype of the function's first (array) argument."""
+    return elem_dtype_of(expr.args[0], schema)
+
+
+def _elem_result(expr, schema):
+    return _elem_dtype(expr, schema), 0, 0
+
+
+def _element_at_result(expr, schema):
+    a = expr.args[0]
+    if isinstance(a, ir.ScalarFunction) and a.name == "map" and len(a.args) > 1:
+        return infer_dtype(a.args[1], schema)
+    if isinstance(a, ir.ScalarFunction) and a.name == "map_from_arrays":
+        return elem_dtype_of(a.args[1], schema), 0, 0
+    return _elem_dtype(expr, schema), 0, 0
+
+
+# ---------------------------------------------------------------------------
+# arrays
+# ---------------------------------------------------------------------------
+
+@register("array", _list_result)
+def _array(args, expr, batch, schema, ctx):
+    """array(e1, ..., ek): ListColumn with max_elems = k."""
+    if not args:
+        n = batch.capacity
+        return TypedValue(ListColumn(jnp.zeros((n, 1), jnp.int64),
+                                     jnp.zeros((n, 1), bool),
+                                     jnp.zeros(n, jnp.int32),
+                                     jnp.ones(n, bool)), DataType.LIST)
+    target = args[0].dtype
+    vals = [cast_value(a, target) if a.dtype != target else a for a in args]
+    values = jnp.stack([v.data for v in vals], axis=1)
+    elem_valid = jnp.stack([v.validity for v in vals], axis=1)
+    n = batch.capacity
+    k = len(args)
+    return TypedValue(ListColumn(values, elem_valid,
+                                 jnp.full(n, k, jnp.int32),
+                                 jnp.ones(n, bool)), DataType.LIST)
+
+
+@register("size", DataType.INT32)
+@register("cardinality", DataType.INT32)
+def _size(args, expr, batch, schema, ctx):
+    v = args[0]
+    if isinstance(v.col, MapValue):
+        lens = v.col.keys.lens
+        valid = v.col.validity
+    else:
+        assert isinstance(v.col, ListColumn), "size() needs an array/map"
+        lens, valid = v.col.lens, v.col.validity
+    # Spark legacy sizeOfNull: null input → -1
+    out = jnp.where(valid, lens, -1).astype(jnp.int32)
+    return TypedValue(PrimitiveColumn(out, jnp.ones_like(valid)),
+                      DataType.INT32)
+
+
+@register("array_contains", DataType.BOOL)
+def _array_contains(args, expr, batch, schema, ctx):
+    arr, needle = args
+    col: ListColumn = arr.col
+    hit = jnp.any((col.values == needle.data[:, None]) & col.elem_valid
+                  & (jnp.arange(col.max_elems)[None, :] < col.lens[:, None]),
+                  axis=1)
+    return TypedValue(PrimitiveColumn(hit, arr.validity & needle.validity),
+                      DataType.BOOL)
+
+
+@register("array_position", DataType.INT64)
+def _array_position(args, expr, batch, schema, ctx):
+    arr, needle = args
+    col: ListColumn = arr.col
+    in_list = jnp.arange(col.max_elems)[None, :] < col.lens[:, None]
+    eq = (col.values == needle.data[:, None]) & col.elem_valid & in_list
+    first = jnp.argmax(eq, axis=1)
+    any_hit = jnp.any(eq, axis=1)
+    pos = jnp.where(any_hit, first + 1, 0).astype(jnp.int64)
+    return TypedValue(PrimitiveColumn(pos, arr.validity & needle.validity),
+                      DataType.INT64)
+
+
+@register("element_at", _element_at_result)
+def _element_at(args, expr, batch, schema, ctx):
+    v = args[0]
+    if isinstance(v.col, MapValue):
+        return _map_get(v, args[1])
+    col: ListColumn = v.col
+    idx = cast_value(args[1], DataType.INT32).data
+    # 1-based; negative counts from the end; out of range → null
+    zero = jnp.where(idx > 0, idx - 1, col.lens + idx)
+    in_range = (zero >= 0) & (zero < col.lens)
+    zi = jnp.clip(zero, 0, col.max_elems - 1)
+    data = jnp.take_along_axis(col.values, zi[:, None], axis=1)[:, 0]
+    ev = jnp.take_along_axis(col.elem_valid, zi[:, None], axis=1)[:, 0]
+    dt = _elem_dtype(expr, schema)
+    return TypedValue(PrimitiveColumn(data, v.validity & in_range & ev), dt)
+
+
+def _array_minmax(args, expr, schema, largest: bool):
+    v = args[0]
+    col: ListColumn = v.col
+    in_list = (jnp.arange(col.max_elems)[None, :] < col.lens[:, None]) \
+        & col.elem_valid
+    if largest:
+        neutral = jnp.asarray(np.iinfo(np.int64).min, col.values.dtype) \
+            if jnp.issubdtype(col.values.dtype, jnp.integer) \
+            else jnp.asarray(-np.inf, col.values.dtype)
+        data = jnp.max(jnp.where(in_list, col.values, neutral), axis=1)
+    else:
+        neutral = jnp.asarray(np.iinfo(np.int64).max, col.values.dtype) \
+            if jnp.issubdtype(col.values.dtype, jnp.integer) \
+            else jnp.asarray(np.inf, col.values.dtype)
+        data = jnp.min(jnp.where(in_list, col.values, neutral), axis=1)
+    has = jnp.any(in_list, axis=1)
+    dt = _elem_dtype(expr, schema)
+    return TypedValue(PrimitiveColumn(data, v.validity & has), dt)
+
+
+@register("array_max", _elem_result)
+def _array_max(args, expr, batch, schema, ctx):
+    return _array_minmax(args, expr, schema, largest=True)
+
+
+@register("array_min", _elem_result)
+def _array_min(args, expr, batch, schema, ctx):
+    return _array_minmax(args, expr, schema, largest=False)
+
+
+@register("sort_array", _list_result)
+def _sort_array(args, expr, batch, schema, ctx):
+    v = args[0]
+    asc = True
+    if len(expr.args) > 1 and isinstance(expr.args[1], ir.Literal):
+        asc = bool(expr.args[1].value)
+    col: ListColumn = v.col
+    in_list = (jnp.arange(col.max_elems)[None, :] < col.lens[:, None]) \
+        & col.elem_valid
+    # nulls first (asc) / last (desc), then value — Spark sort_array
+    if jnp.issubdtype(col.values.dtype, jnp.integer):
+        hi = jnp.asarray(np.iinfo(np.int64).max, col.values.dtype)
+    else:
+        hi = jnp.asarray(np.inf, col.values.dtype)
+    key = jnp.where(in_list, col.values, hi)            # padding last
+    key = jnp.where(in_list & ~col.elem_valid, -hi, key)  # nulls smallest
+    order = jnp.argsort(jnp.where(jnp.asarray(asc), key, -key), axis=1,
+                        stable=True)
+    values = jnp.take_along_axis(col.values, order, axis=1)
+    ev = jnp.take_along_axis(col.elem_valid, order, axis=1)
+    return TypedValue(ListColumn(values, ev, col.lens, col.validity),
+                      DataType.LIST)
+
+
+@register("array_repeat", _list_result)
+def _array_repeat(args, expr, batch, schema, ctx):
+    v = args[0]
+    times = int(expr.args[1].value) if isinstance(expr.args[1], ir.Literal) \
+        else 1
+    times = max(times, 0)
+    n = batch.capacity
+    k = max(times, 1)
+    values = jnp.broadcast_to(v.data[:, None], (n, k))
+    ev = jnp.broadcast_to(v.validity[:, None], (n, k))
+    return TypedValue(ListColumn(values, ev, jnp.full(n, times, jnp.int32),
+                                 jnp.ones(n, bool)), DataType.LIST)
+
+
+# ---------------------------------------------------------------------------
+# maps (eval-internal composite)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class MapValue:
+    """Parallel key/value lists; exists only inside expression evaluation
+    (consumed by element_at / map_keys / map_values / size before any
+    batch materialization)."""
+    keys: ListColumn
+    values: ListColumn
+    validity: object
+
+    @property
+    def capacity(self):
+        return self.keys.capacity
+
+
+def _map_result(expr, schema):
+    return DataType.LIST, 0, 0   # only observable through accessors
+
+
+@register("map", _map_result)
+@register("map_from_arrays", _map_result)
+def _map(args, expr, batch, schema, ctx):
+    if expr.name == "map_from_arrays":
+        karr, varr = args
+        return TypedValue(MapValue(karr.col, varr.col,
+                                   karr.validity & varr.validity),
+                          DataType.LIST)
+    assert len(args) % 2 == 0, "map() needs key/value pairs"
+    keys = args[0::2]
+    vals = args[1::2]
+    n = batch.capacity
+    k = len(keys)
+
+    def mklist(items):
+        values = jnp.stack([x.data for x in items], axis=1)
+        ev = jnp.stack([x.validity for x in items], axis=1)
+        return ListColumn(values, ev, jnp.full(n, k, jnp.int32),
+                          jnp.ones(n, bool))
+
+    return TypedValue(MapValue(mklist(keys), mklist(vals),
+                               jnp.ones(n, bool)), DataType.LIST)
+
+
+@register("map_keys", _list_result)
+def _map_keys(args, expr, batch, schema, ctx):
+    m: MapValue = args[0].col
+    return TypedValue(m.keys.with_validity(args[0].validity), DataType.LIST)
+
+
+@register("map_values", _list_result)
+def _map_values(args, expr, batch, schema, ctx):
+    m: MapValue = args[0].col
+    return TypedValue(m.values.with_validity(args[0].validity), DataType.LIST)
+
+
+def _map_get(v: TypedValue, key: TypedValue) -> TypedValue:
+    """map[key]: last matching key wins (Spark map semantics)."""
+    m: MapValue = v.col
+    kcol, vcol = m.keys, m.values
+    in_map = jnp.arange(kcol.max_elems)[None, :] < kcol.lens[:, None]
+    eq = (kcol.values == key.data[:, None]) & kcol.elem_valid & in_map
+    # last match: flip, argmax, flip back
+    rev = eq[:, ::-1]
+    last = kcol.max_elems - 1 - jnp.argmax(rev, axis=1)
+    hit = jnp.any(eq, axis=1)
+    li = jnp.clip(last, 0, vcol.max_elems - 1)
+    data = jnp.take_along_axis(vcol.values, li[:, None], axis=1)[:, 0]
+    ev = jnp.take_along_axis(vcol.elem_valid, li[:, None], axis=1)[:, 0]
+    return TypedValue(PrimitiveColumn(data, v.validity & hit & ev),
+                      DataType.INT64 if jnp.issubdtype(
+                          vcol.values.dtype, jnp.integer) else DataType.FLOAT64)

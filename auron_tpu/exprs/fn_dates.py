@@ -1,0 +1,418 @@
+"""Spark date/time functions as device kernels.
+
+The reference's spark_dates.rs (1,177 LoC) does per-row chrono math; here
+every function is Hinnant civil-calendar integer arithmetic over whole
+columns (the same _civil_from_days/_days_from_civil pair the core date
+extractors use, exprs/functions.py), so they trace into the enclosing jit.
+String parsing (unix_timestamp(str, fmt), to_date(str, fmt)) is host-side
+— data-dependent scalar parsing has no MXU mapping; the host callback
+mirrors the reference's JVM-fallback escape hatch.
+
+Formats use Java SimpleDateFormat tokens (yyyy, MM, dd, HH, mm, ss) as
+Spark does; date_format builds fixed-width segments entirely on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from auron_tpu.columnar.batch import PrimitiveColumn, StringColumn
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.exprs import ir
+from auron_tpu.exprs.eval import TypedValue, cast_value
+from auron_tpu.exprs.functions import (_civil_from_days, _days_arg,
+                                       _days_from_civil, register)
+
+US_PER_DAY = 86_400_000_000
+US_PER_HOUR = 3_600_000_000
+US_PER_MIN = 60_000_000
+US_PER_SEC = 1_000_000
+
+
+def _string_result(expr, schema):
+    return DataType.STRING, 0, 0
+
+
+def _lit(expr, k, default=None):
+    if k >= len(expr.args):
+        return default
+    a = expr.args[k]
+    if not isinstance(a, ir.Literal):
+        raise NotImplementedError(f"{expr.name}: arg {k} must be a literal")
+    return a.value
+
+
+def _ts_us(v: TypedValue):
+    """Any date/timestamp input → microseconds since epoch (int64)."""
+    if v.dtype == DataType.TIMESTAMP_US:
+        return v.data.astype(jnp.int64)
+    return v.data.astype(jnp.int64) * US_PER_DAY
+
+
+def _time_of_day_us(ts):
+    return jnp.mod(ts, US_PER_DAY)  # floor-mod: correct for pre-epoch
+
+
+@register("hour", DataType.INT32)
+def _hour(args, expr, batch, schema, ctx):
+    t = _time_of_day_us(_ts_us(args[0]))
+    return TypedValue(PrimitiveColumn(
+        (t // US_PER_HOUR).astype(jnp.int32), args[0].validity),
+        DataType.INT32)
+
+
+@register("minute", DataType.INT32)
+def _minute(args, expr, batch, schema, ctx):
+    t = _time_of_day_us(_ts_us(args[0]))
+    return TypedValue(PrimitiveColumn(
+        (t // US_PER_MIN % 60).astype(jnp.int32), args[0].validity),
+        DataType.INT32)
+
+
+@register("second", DataType.INT32)
+def _second(args, expr, batch, schema, ctx):
+    t = _time_of_day_us(_ts_us(args[0]))
+    return TypedValue(PrimitiveColumn(
+        (t // US_PER_SEC % 60).astype(jnp.int32), args[0].validity),
+        DataType.INT32)
+
+
+# ---------------------------------------------------------------------------
+# date_format / from_unixtime / unix_timestamp / to_date
+# ---------------------------------------------------------------------------
+
+#: token → (digit count, extractor index) — extractors computed per batch
+_TOKENS = ["yyyy", "yy", "MM", "dd", "HH", "hh", "mm", "ss", "SSS"]
+
+
+def _tokenize(fmt: str):
+    """Format string → list of ('tok', name) | ('lit', bytes)."""
+    out, i = [], 0
+    while i < len(fmt):
+        for t in _TOKENS:
+            if fmt.startswith(t, i):
+                out.append(("tok", t))
+                i += len(t)
+                break
+        else:
+            if fmt[i] == "'":
+                j = fmt.find("'", i + 1)
+                j = len(fmt) if j < 0 else j
+                out.append(("lit", fmt[i + 1:j].encode() or b"'"))
+                i = j + 1
+            else:
+                out.append(("lit", fmt[i].encode()))
+                i += 1
+    return out
+
+
+def _digits(x, ndig: int):
+    """int array → uint8[?, ndig] ASCII digits, zero-padded."""
+    cols = []
+    for k in range(ndig - 1, -1, -1):
+        cols.append((x // (10 ** k) % 10 + ord("0")).astype(jnp.uint8))
+    return jnp.stack(cols, axis=1)
+
+
+def format_timestamp(ts, fmt: str):
+    """Device timestamp formatting → (chars, lens). Raises on tokens
+    outside the supported set (callers fall back to host)."""
+    days = jnp.floor_divide(ts, US_PER_DAY)
+    tod = jnp.mod(ts, US_PER_DAY)
+    y, mo, d = _civil_from_days(days)
+    vals = {
+        "yyyy": (y, 4), "yy": (jnp.mod(y, 100), 2),
+        "MM": (mo, 2), "dd": (d, 2),
+        "HH": ((tod // US_PER_HOUR).astype(jnp.int32), 2),
+        "hh": ((jnp.mod(tod // US_PER_HOUR + 11, 12) + 1).astype(jnp.int32), 2),
+        "mm": ((tod // US_PER_MIN % 60).astype(jnp.int32), 2),
+        "ss": ((tod // US_PER_SEC % 60).astype(jnp.int32), 2),
+        "SSS": ((tod // 1000 % 1000).astype(jnp.int32), 3),
+    }
+    segs = []
+    n = ts.shape[0]
+    for kind, tok in _tokenize(fmt):
+        if kind == "lit":
+            lit = np.frombuffer(tok, np.uint8)
+            segs.append(jnp.broadcast_to(jnp.asarray(lit)[None, :],
+                                         (n, len(lit))))
+        else:
+            x, nd = vals[tok]
+            segs.append(_digits(x, nd))
+    chars = jnp.concatenate(segs, axis=1) if segs else \
+        jnp.zeros((n, 1), jnp.uint8)
+    total = chars.shape[1]
+    return chars, jnp.full(n, total, jnp.int32)
+
+
+@register("date_format", _string_result)
+def _date_format(args, expr, batch, schema, ctx):
+    fmt = str(_lit(expr, 1, "yyyy-MM-dd HH:mm:ss"))
+    ts = _ts_us(args[0])
+    chars, lens = format_timestamp(ts, fmt)
+    return TypedValue(StringColumn(chars, lens, args[0].validity),
+                      DataType.STRING)
+
+
+@register("from_unixtime", _string_result)
+def _from_unixtime(args, expr, batch, schema, ctx):
+    fmt = str(_lit(expr, 1, "yyyy-MM-dd HH:mm:ss"))
+    secs = cast_value(args[0], DataType.INT64).data
+    chars, lens = format_timestamp(secs * US_PER_SEC, fmt)
+    return TypedValue(StringColumn(chars, lens, args[0].validity),
+                      DataType.STRING)
+
+
+def _java_to_strptime(fmt: str) -> str:
+    for a, b in [("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"),
+                 ("HH", "%H"), ("mm", "%M"), ("ss", "%S")]:
+        fmt = fmt.replace(a, b)
+    return fmt
+
+
+def _host_parse_ts(col: StringColumn, validity, fmt: str):
+    """string → epoch micros on host (strptime); invalid → null."""
+    import datetime
+    cap = col.capacity
+    py_fmt = _java_to_strptime(fmt)
+
+    def host(chars_np, lens_np, valid_np):
+        out = np.zeros(cap, np.int64)
+        ok = np.zeros(cap, bool)
+        for i in range(cap):
+            if not valid_np[i]:
+                continue
+            s = bytes(chars_np[i, : lens_np[i]]).decode("utf-8", "replace")
+            try:
+                dt = datetime.datetime.strptime(s.strip(), py_fmt)
+            except ValueError:
+                continue
+            dt = dt.replace(tzinfo=datetime.timezone.utc)
+            out[i] = int(dt.timestamp() * 1e6)
+            ok[i] = True
+        return out, ok
+
+    return jax.pure_callback(
+        host,
+        (jax.ShapeDtypeStruct((cap,), jnp.int64),
+         jax.ShapeDtypeStruct((cap,), jnp.bool_)),
+        col.chars, col.lens, validity, vmap_method="sequential")
+
+
+@register("unix_timestamp", DataType.INT64)
+@register("to_unix_timestamp", DataType.INT64)
+def _unix_timestamp(args, expr, batch, schema, ctx):
+    v = args[0]
+    if v.dtype == DataType.STRING:
+        fmt = str(_lit(expr, 1, "yyyy-MM-dd HH:mm:ss"))
+        us, ok = _host_parse_ts(v.col, v.validity, fmt)
+        return TypedValue(PrimitiveColumn(us // US_PER_SEC, v.validity & ok),
+                          DataType.INT64)
+    secs = _ts_us(v) // US_PER_SEC
+    return TypedValue(PrimitiveColumn(secs, v.validity), DataType.INT64)
+
+
+@register("to_date", DataType.DATE32)
+def _to_date(args, expr, batch, schema, ctx):
+    v = args[0]
+    if v.dtype != DataType.STRING:
+        days = (_ts_us(v) // US_PER_DAY).astype(jnp.int32)
+        return TypedValue(PrimitiveColumn(days, v.validity), DataType.DATE32)
+    if len(expr.args) > 1:
+        fmt = str(_lit(expr, 1))
+        us, ok = _host_parse_ts(v.col, v.validity, fmt)
+        return TypedValue(PrimitiveColumn(
+            (us // US_PER_DAY).astype(jnp.int32), v.validity & ok),
+            DataType.DATE32)
+    return cast_value(v, DataType.DATE32)
+
+
+# ---------------------------------------------------------------------------
+# trunc / date_trunc / month math
+# ---------------------------------------------------------------------------
+
+@register("trunc", DataType.DATE32)
+def _trunc(args, expr, batch, schema, ctx):
+    """trunc(date, fmt): year/yyyy/yy → Jan 1; month/mon/mm → 1st; week →
+    Monday; quarter → quarter start (Spark trunc)."""
+    v = args[0]
+    fmt = str(_lit(expr, 1, "")).lower()
+    days = _days_arg(v)
+    y, m, _d = _civil_from_days(days)
+    one = jnp.ones_like(y)
+    if fmt in ("year", "yyyy", "yy"):
+        out = _days_from_civil(y, one, one)
+    elif fmt in ("month", "mon", "mm"):
+        out = _days_from_civil(y, m, one)
+    elif fmt == "quarter":
+        qm = ((m - 1) // 3) * 3 + 1
+        out = _days_from_civil(y, qm, one)
+    elif fmt == "week":
+        # Monday of the week; 1970-01-01 was Thursday (dow0=Thu)
+        dow_mon = jnp.mod(days + 3, 7)   # 0 = Monday
+        out = days - dow_mon
+    else:
+        # unknown format → null (Spark returns null)
+        return TypedValue(PrimitiveColumn(jnp.zeros_like(days),
+                                          jnp.zeros_like(v.validity)),
+                          DataType.DATE32)
+    return TypedValue(PrimitiveColumn(out.astype(jnp.int32), v.validity),
+                      DataType.DATE32)
+
+
+@register("date_trunc", DataType.TIMESTAMP_US)
+def _date_trunc(args, expr, batch, schema, ctx):
+    """date_trunc(fmt, ts) → timestamp truncated to the unit."""
+    fmt = str(_lit(expr, 0, "")).lower()
+    v = args[1]
+    ts = _ts_us(v)
+    days = jnp.floor_divide(ts, US_PER_DAY)
+    if fmt in ("year", "yyyy", "yy", "month", "mon", "mm", "quarter", "week"):
+        y, m, _d = _civil_from_days(days)
+        one = jnp.ones_like(y)
+        if fmt in ("year", "yyyy", "yy"):
+            d2 = _days_from_civil(y, one, one)
+        elif fmt == "quarter":
+            d2 = _days_from_civil(y, ((m - 1) // 3) * 3 + 1, one)
+        elif fmt == "week":
+            d2 = days - jnp.mod(days + 3, 7)
+        else:
+            d2 = _days_from_civil(y, m, one)
+        out = d2.astype(jnp.int64) * US_PER_DAY
+    elif fmt in ("day", "dd"):
+        out = days * US_PER_DAY
+    elif fmt == "hour":
+        out = ts - jnp.mod(ts, US_PER_HOUR)
+    elif fmt == "minute":
+        out = ts - jnp.mod(ts, US_PER_MIN)
+    elif fmt == "second":
+        out = ts - jnp.mod(ts, US_PER_SEC)
+    else:
+        return TypedValue(PrimitiveColumn(jnp.zeros_like(ts),
+                                          jnp.zeros_like(v.validity)),
+                          DataType.TIMESTAMP_US)
+    return TypedValue(PrimitiveColumn(out, v.validity), DataType.TIMESTAMP_US)
+
+
+def _month_add(days, n):
+    y, m, d = _civil_from_days(days)
+    m0 = y * 12 + (m - 1) + n
+    y2 = jnp.floor_divide(m0, 12)
+    m2 = jnp.mod(m0, 12) + 1
+    one = jnp.ones_like(y2)
+    first = _days_from_civil(y2, m2, one)
+    next_first = _days_from_civil(
+        y2 + (m2 == 12), jnp.where(m2 == 12, 1, m2 + 1), one)
+    dim = next_first - first               # days in target month
+    d2 = jnp.minimum(d, dim)               # Spark clamps to last day
+    return first + d2 - 1
+
+
+@register("add_months", DataType.DATE32)
+def _add_months(args, expr, batch, schema, ctx):
+    days = _days_arg(args[0])
+    n = cast_value(args[1], DataType.INT32).data
+    out = _month_add(days, n)
+    return TypedValue(PrimitiveColumn(out.astype(jnp.int32),
+                                      args[0].validity & args[1].validity),
+                      DataType.DATE32)
+
+
+@register("last_day", DataType.DATE32)
+def _last_day(args, expr, batch, schema, ctx):
+    days = _days_arg(args[0])
+    y, m, _d = _civil_from_days(days)
+    one = jnp.ones_like(y)
+    next_first = _days_from_civil(
+        y + (m == 12), jnp.where(m == 12, 1, m + 1), one)
+    return TypedValue(PrimitiveColumn((next_first - 1).astype(jnp.int32),
+                                      args[0].validity), DataType.DATE32)
+
+
+@register("months_between", DataType.FLOAT64)
+def _months_between(args, expr, batch, schema, ctx):
+    """Spark months_between: whole-month diff when both are the same day of
+    month or both last days; otherwise 31-day-month fraction incl. time."""
+    ts1, ts2 = _ts_us(args[0]), _ts_us(args[1])
+    d1 = jnp.floor_divide(ts1, US_PER_DAY)
+    d2 = jnp.floor_divide(ts2, US_PER_DAY)
+    y1, m1, dd1 = _civil_from_days(d1)
+    y2, m2, dd2 = _civil_from_days(d2)
+
+    def last_dom(y, m, d):
+        one = jnp.ones_like(y)
+        nf = _days_from_civil(y + (m == 12), jnp.where(m == 12, 1, m + 1), one)
+        f = _days_from_civil(y, m, one)
+        return d == (nf - f)
+
+    months = (y1 - y2) * 12 + (m1 - m2)
+    both_last = last_dom(y1, m1, dd1) & last_dom(y2, m2, dd2)
+    same_day = dd1 == dd2
+    t1 = jnp.mod(ts1, US_PER_DAY).astype(jnp.float64)
+    t2 = jnp.mod(ts2, US_PER_DAY).astype(jnp.float64)
+    day_frac = ((dd1 - dd2).astype(jnp.float64) * US_PER_DAY + (t1 - t2)) \
+        / (31.0 * US_PER_DAY)
+    frac = jnp.where(both_last | (same_day & (t1 == t2)), 0.0, day_frac)
+    out = months.astype(jnp.float64) + frac
+    roundoff = _lit(expr, 2, True) if len(expr.args) > 2 else True
+    if roundoff:
+        out = jnp.round(out * 1e8) / 1e8
+    return TypedValue(PrimitiveColumn(out, args[0].validity & args[1].validity),
+                      DataType.FLOAT64)
+
+
+@register("weekofyear", DataType.INT32)
+def _weekofyear(args, expr, batch, schema, ctx):
+    """ISO-8601 week number, fully vectorized."""
+    days = _days_arg(args[0])
+    y, _m, _d = _civil_from_days(days)
+
+    def iso_week(days, y):
+        jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+        ordinal = days - jan1 + 1                       # 1-based day of year
+        wd = jnp.mod(days + 3, 7) + 1                   # ISO weekday 1=Mon
+        return jnp.floor_divide(ordinal - wd + 10, 7)
+
+    w0 = iso_week(days, y)
+    # w0 == 0 → last week of previous year; own-year w0 == 53 rolls to
+    # week 1 when the year has no week 53
+    w_prev = iso_week(days, y - 1)
+    dec31 = _days_from_civil(y, jnp.full_like(y, 12), jnp.full_like(y, 31))
+    w_dec31 = iso_week(dec31, y)
+    roll = (w0 >= 53) & (w_dec31 < 53)
+    w = jnp.where(w0 < 1, w_prev, jnp.where(roll, 1, w0))
+    return TypedValue(PrimitiveColumn(w.astype(jnp.int32), args[0].validity),
+                      DataType.INT32)
+
+
+_DOW = {"mo": 0, "tu": 1, "we": 2, "th": 3, "fr": 4, "sa": 5, "su": 6}
+
+
+@register("next_day", DataType.DATE32)
+def _next_day(args, expr, batch, schema, ctx):
+    days = _days_arg(args[0])
+    dow_s = str(_lit(expr, 1, "")).strip().lower()[:2]
+    if dow_s not in _DOW:
+        return TypedValue(PrimitiveColumn(jnp.zeros_like(days),
+                                          jnp.zeros_like(args[0].validity)),
+                          DataType.DATE32)
+    target = _DOW[dow_s]
+    cur = jnp.mod(days + 3, 7)                # 0 = Monday
+    delta = jnp.mod(target - cur + 6, 7) + 1  # strictly after
+    return TypedValue(PrimitiveColumn((days + delta).astype(jnp.int32),
+                                      args[0].validity), DataType.DATE32)
+
+
+@register("make_date", DataType.DATE32)
+def _make_date(args, expr, batch, schema, ctx):
+    y = cast_value(args[0], DataType.INT32).data
+    m = cast_value(args[1], DataType.INT32).data
+    d = cast_value(args[2], DataType.INT32).data
+    ok = (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)
+    out = _days_from_civil(y, jnp.clip(m, 1, 12), jnp.clip(d, 1, 31))
+    valid = args[0].validity & args[1].validity & args[2].validity & ok
+    return TypedValue(PrimitiveColumn(out.astype(jnp.int32), valid),
+                      DataType.DATE32)

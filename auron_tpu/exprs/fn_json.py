@@ -1,0 +1,243 @@
+"""JSON path extraction and regular expressions.
+
+Data-dependent parsing (JSON trees, regex NFAs) has no mapping onto the
+MXU/VPU — the reference runs these as native Rust row loops
+(datafusion-ext-functions/src/spark_get_json_object.rs, 867 LoC). Here
+they run as host callbacks over the (chars, lens) wire — the same escape
+hatch the engine uses for Spark UDFs (SURVEY.md §3.5) — with patterns
+compiled once per plan, not per batch.
+
+Spark semantics notes:
+- get_json_object returns NULL for missing paths, the raw string for JSON
+  strings (no quotes), and compact JSON for objects/arrays.
+- regexp_extract returns "" (not NULL) when the pattern misses.
+- regexp_replace uses Java's $1 group references.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from auron_tpu.columnar.batch import PrimitiveColumn, StringColumn
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.exprs import ir
+from auron_tpu.exprs.eval import TypedValue
+from auron_tpu.exprs.functions import register
+from auron_tpu.utils.shapes import bucket_string_width
+
+
+def _string_result(expr, schema):
+    return DataType.STRING, 0, 0
+
+
+def _lit(expr, k, default=None):
+    if k >= len(expr.args):
+        return default
+    a = expr.args[k]
+    if not isinstance(a, ir.Literal):
+        raise NotImplementedError(f"{expr.name}: arg {k} must be a literal")
+    return a.value
+
+
+def host_string_fn(v: TypedValue, out_w: int, rowfn) -> TypedValue:
+    """Run ``rowfn(str) -> Optional[str]`` over a string column on host;
+    None → null."""
+    col: StringColumn = v.col
+    cap = col.capacity
+
+    def host(chars_np, lens_np, valid_np):
+        chars = np.zeros((cap, out_w), np.uint8)
+        lens = np.zeros(cap, np.int32)
+        ok = np.zeros(cap, bool)
+        for i in range(cap):
+            if not valid_np[i]:
+                continue
+            s = bytes(chars_np[i, : lens_np[i]]).decode("utf-8", "replace")
+            r = rowfn(s)
+            if r is None:
+                continue
+            b = r.encode()[:out_w]
+            chars[i, : len(b)] = np.frombuffer(b, np.uint8)
+            lens[i] = len(b)
+            ok[i] = True
+        return chars, lens, ok
+
+    chars, lens, ok = jax.pure_callback(
+        host,
+        (jax.ShapeDtypeStruct((cap, out_w), jnp.uint8),
+         jax.ShapeDtypeStruct((cap,), jnp.int32),
+         jax.ShapeDtypeStruct((cap,), jnp.bool_)),
+        col.chars, col.lens, v.validity, vmap_method="sequential")
+    return TypedValue(StringColumn(chars, lens, ok), DataType.STRING)
+
+
+# ---------------------------------------------------------------------------
+# get_json_object
+# ---------------------------------------------------------------------------
+
+_PATH_STEP = re.compile(r"\.([^.\[]+)|\[(\d+)\]|\['([^']+)'\]")
+
+
+def _compile_path(path: str):
+    """'$.a.b[2]' → list of dict-key / list-index steps; None if invalid."""
+    if not path.startswith("$"):
+        return None
+    steps, pos = [], 1
+    while pos < len(path):
+        m = _PATH_STEP.match(path, pos)
+        if not m:
+            return None
+        if m.group(1) is not None:
+            steps.append(m.group(1))
+        elif m.group(2) is not None:
+            steps.append(int(m.group(2)))
+        else:
+            steps.append(m.group(3))
+        pos = m.end()
+    return steps
+
+
+def _json_to_spark_string(v):
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (dict, list)):
+        return json.dumps(v, separators=(",", ":"))
+    if isinstance(v, float) and v.is_integer():
+        return json.dumps(v)
+    return str(v)
+
+
+@register("get_json_object", _string_result)
+def _get_json_object(args, expr, batch, schema, ctx):
+    path = _compile_path(str(_lit(expr, 1, "")))
+    v = args[0]
+    out_w = v.col.width  # the value is a substring of the document
+
+    def rowfn(s):
+        if path is None:
+            return None
+        try:
+            node = json.loads(s)
+        except (ValueError, RecursionError):
+            return None
+        for step in path:
+            if isinstance(step, int):
+                if not isinstance(node, list) or step >= len(node):
+                    return None
+                node = node[step]
+            else:
+                if not isinstance(node, dict) or step not in node:
+                    return None
+                node = node[step]
+        return _json_to_spark_string(node)
+
+    return host_string_fn(v, out_w, rowfn)
+
+
+@register("json_array_length", DataType.INT32)
+def _json_array_length(args, expr, batch, schema, ctx):
+    v = args[0]
+    col: StringColumn = v.col
+    cap = col.capacity
+
+    def host(chars_np, lens_np, valid_np):
+        out = np.zeros(cap, np.int32)
+        ok = np.zeros(cap, bool)
+        for i in range(cap):
+            if not valid_np[i]:
+                continue
+            try:
+                node = json.loads(
+                    bytes(chars_np[i, : lens_np[i]]).decode("utf-8", "replace"))
+            except ValueError:
+                continue
+            if isinstance(node, list):
+                out[i] = len(node)
+                ok[i] = True
+        return out, ok
+
+    data, ok = jax.pure_callback(
+        host, (jax.ShapeDtypeStruct((cap,), jnp.int32),
+               jax.ShapeDtypeStruct((cap,), jnp.bool_)),
+        col.chars, col.lens, v.validity, vmap_method="sequential")
+    return TypedValue(PrimitiveColumn(data, ok), DataType.INT32)
+
+
+# ---------------------------------------------------------------------------
+# regex family
+# ---------------------------------------------------------------------------
+
+def _java_replacement_to_python(rep: str) -> str:
+    # Java "$1" group refs → Python "\1"; escaped "\$" stays literal
+    out, i = [], 0
+    while i < len(rep):
+        c = rep[i]
+        if c == "\\" and i + 1 < len(rep):
+            out.append(rep[i + 1] if rep[i + 1] in "$\\" else rep[i:i + 2])
+            i += 2
+        elif c == "$" and i + 1 < len(rep) and rep[i + 1].isdigit():
+            out.append("\\" + rep[i + 1])
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+@register("regexp_extract", _string_result)
+def _regexp_extract(args, expr, batch, schema, ctx):
+    rx = re.compile(str(_lit(expr, 1, "")))
+    idx = int(_lit(expr, 2, 1) or 0) if len(expr.args) > 2 else 1
+    v = args[0]
+
+    def rowfn(s):
+        m = rx.search(s)
+        if m is None:
+            return ""          # Spark: empty string on no match
+        if idx > (m.re.groups or 0) and idx != 0:
+            return None
+        g = m.group(idx)
+        return g if g is not None else ""
+
+    return host_string_fn(v, v.col.width, rowfn)
+
+
+@register("regexp_replace", _string_result)
+def _regexp_replace(args, expr, batch, schema, ctx):
+    rx = re.compile(str(_lit(expr, 1, "")))
+    rep = _java_replacement_to_python(str(_lit(expr, 2, "")))
+    v = args[0]
+    out_w = bucket_string_width(max(v.col.width * 2, 64))
+    return host_string_fn(v, out_w, lambda s: rx.sub(rep, s))
+
+
+@register("rlike", DataType.BOOL)
+@register("regexp_like", DataType.BOOL)
+@register("regexp", DataType.BOOL)
+def _rlike(args, expr, batch, schema, ctx):
+    rx = re.compile(str(_lit(expr, 1, "")))
+    v = args[0]
+    col: StringColumn = v.col
+    cap = col.capacity
+
+    def host(chars_np, lens_np):
+        out = np.zeros(cap, bool)
+        for i in range(cap):
+            s = bytes(chars_np[i, : lens_np[i]]).decode("utf-8", "replace")
+            out[i] = rx.search(s) is not None
+        return out
+
+    hit = jax.pure_callback(
+        host, jax.ShapeDtypeStruct((cap,), jnp.bool_),
+        col.chars, col.lens, vmap_method="sequential")
+    return TypedValue(PrimitiveColumn(hit, v.validity), DataType.BOOL)

@@ -1,0 +1,411 @@
+"""Spark string functions as device kernels.
+
+The reference implements these as Rust row loops over Arrow string arrays
+(reference: datafusion-ext-functions/src/spark_strings.rs). On TPU the
+fixed-width (chars[n, w], lens[n]) layout turns every one of them into
+masked gathers/scatters over the char matrix — no per-row host work. Ops
+whose output length is data-dependent (translate with deletions,
+substring_index) compute a per-row keep mask and compact it with one
+argsort, the same trick the filter operator uses for rows.
+
+Registered into the shared scalar-function registry (exprs/functions.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from auron_tpu.columnar.batch import PrimitiveColumn, StringColumn
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.exprs import ir
+from auron_tpu.exprs.eval import TypedValue, cast_value
+from auron_tpu.exprs.functions import register
+from auron_tpu.ops import strings as S
+from auron_tpu.utils.shapes import bucket_string_width
+
+
+def _string_result(expr, schema):
+    return DataType.STRING, 0, 0
+
+
+def _lit(expr: ir.ScalarFunction, k: int, default=None):
+    """Literal argument value at position k, or default when absent."""
+    if k >= len(expr.args):
+        return default
+    a = expr.args[k]
+    if not isinstance(a, ir.Literal):
+        raise NotImplementedError(
+            f"{expr.name}: argument {k} must be a literal")
+    return a.value
+
+
+def _pos(w: int):
+    return jnp.arange(w, dtype=jnp.int32)[None, :]
+
+
+# ---------------------------------------------------------------------------
+# concat_ws / initcap / repeat / reverse / pads
+# ---------------------------------------------------------------------------
+
+@register("concat_ws", _string_result)
+def _concat_ws(args, expr, batch, schema, ctx):
+    """concat_ws(sep, s1, s2, ...): null args are SKIPPED (unlike concat);
+    result is null only when sep is null (Spark semantics)."""
+    sep, parts = args[0], args[1:]
+    if not parts:
+        n = batch.capacity
+        return TypedValue(StringColumn(jnp.zeros((n, 8), jnp.uint8),
+                                       jnp.zeros(n, jnp.int32),
+                                       sep.validity), DataType.STRING)
+    n = parts[0].col.capacity
+    sep_c: StringColumn = sep.col
+    total_w = sum(p.col.width for p in parts) + \
+        sep_c.width * max(len(parts) - 1, 0)
+    out_w = bucket_string_width(max(total_w, 1))
+    out = jnp.zeros((n, out_w), jnp.uint8)
+    pos = jnp.zeros(n, jnp.int32)
+    written_any = jnp.zeros(n, bool)
+    rows = jnp.arange(n)
+
+    def scatter(out, pos, chars, lens, include):
+        w = chars.shape[1]
+        tgt = pos[:, None] + _pos(w)
+        valid = (_pos(w) < lens[:, None]) & include[:, None]
+        tgt = jnp.where(valid, tgt, out_w)
+        r = jnp.broadcast_to(rows[:, None], (n, w))
+        out = out.at[r.reshape(-1),
+                     jnp.clip(tgt, 0, out_w).reshape(-1)].max(
+            jnp.where(valid, chars, 0).reshape(-1), mode="drop")
+        return out, pos + jnp.where(include, lens, 0)
+
+    for p in parts:
+        inc = p.validity
+        # separator before this part if something was already written
+        sep_inc = inc & written_any
+        out, pos = scatter(out, pos, sep_c.chars, sep_c.lens, sep_inc)
+        out, pos = scatter(out, pos, p.col.chars, p.col.lens, inc)
+        written_any = written_any | inc
+    return TypedValue(StringColumn(out, jnp.where(sep.validity, pos, 0),
+                                   sep.validity), DataType.STRING)
+
+
+@register("initcap", _string_result)
+def _initcap(args, expr, batch, schema, ctx):
+    """Uppercase the first letter of each space-separated word, lowercase
+    the rest (Spark initcap, ASCII)."""
+    c = args[0].col.chars
+    lo = jnp.where((c >= ord("A")) & (c <= ord("Z")), c + 32, c)
+    # word start: position 0, or previous char is a space
+    prev = jnp.concatenate(
+        [jnp.full((c.shape[0], 1), ord(" "), jnp.uint8), lo[:, :-1]], axis=1)
+    start = prev == ord(" ")
+    up = jnp.where(start & (lo >= ord("a")) & (lo <= ord("z")), lo - 32, lo)
+    return TypedValue(StringColumn(up.astype(jnp.uint8), args[0].col.lens,
+                                   args[0].validity), DataType.STRING)
+
+
+@register("repeat", _string_result)
+def _repeat(args, expr, batch, schema, ctx):
+    v = args[0]
+    times = int(_lit(expr, 1, 1) or 0)
+    w = v.col.width
+    if times <= 0:
+        n = v.col.capacity
+        return TypedValue(StringColumn(jnp.zeros((n, 8), jnp.uint8),
+                                       jnp.zeros(n, jnp.int32), v.validity),
+                          DataType.STRING)
+    out_w = bucket_string_width(w * times)
+    n = v.col.capacity
+    # tile positions: out[j] = chars[j mod len] for j < len*times
+    out_pos = jnp.arange(out_w, dtype=jnp.int32)[None, :]
+    lens = jnp.maximum(v.col.lens, 1)[:, None]  # avoid mod 0
+    src = jnp.mod(out_pos, lens)
+    gathered = jnp.take_along_axis(
+        jnp.pad(v.col.chars, ((0, 0), (0, max(out_w - w, 0)))),
+        jnp.clip(src, 0, max(out_w - 1, 0)), axis=1)
+    out_len = v.col.lens * times
+    mask = out_pos < out_len[:, None]
+    return TypedValue(StringColumn(
+        jnp.where(mask, gathered, 0).astype(jnp.uint8),
+        out_len, v.validity), DataType.STRING)
+
+
+@register("reverse", _string_result)
+def _reverse(args, expr, batch, schema, ctx):
+    v = args[0]
+    chars, lens = v.col.chars, v.col.lens
+    n, w = chars.shape
+    idx = lens[:, None] - 1 - _pos(w)
+    out = jnp.take_along_axis(chars, jnp.clip(idx, 0, w - 1), axis=1)
+    mask = _pos(w) < lens[:, None]
+    return TypedValue(StringColumn(jnp.where(mask, out, 0).astype(jnp.uint8),
+                                   lens, v.validity), DataType.STRING)
+
+
+def _pad(args, expr, batch, schema, ctx, left: bool):
+    v = args[0]
+    target = int(_lit(expr, 1, 0) or 0)
+    pad_s = _lit(expr, 2, " ")
+    pad_b = (pad_s if isinstance(pad_s, bytes) else str(pad_s).encode()) or b" "
+    n, w = v.col.chars.shape
+    out_w = bucket_string_width(max(target, 1))
+    lens = v.col.lens
+    out_len = jnp.minimum(jnp.maximum(lens, target), target)
+    pad_arr = jnp.asarray(np.frombuffer(pad_b, np.uint8))
+    plen = len(pad_b)
+    pos = jnp.arange(out_w, dtype=jnp.int32)[None, :]
+    src = jnp.pad(v.col.chars, ((0, 0), (0, max(out_w - w, 0))))[:, :out_w]
+    if left:
+        npad = jnp.maximum(target - lens, 0)[:, None]
+        from_pad = pos < npad
+        pad_chars = pad_arr[jnp.mod(pos, plen)]
+        str_idx = pos - npad
+        str_chars = jnp.take_along_axis(
+            src, jnp.clip(str_idx, 0, out_w - 1), axis=1)
+        out = jnp.where(from_pad, pad_chars, str_chars)
+    else:
+        in_str = pos < lens[:, None]
+        pad_chars = pad_arr[jnp.mod(pos - lens[:, None], plen)]
+        out = jnp.where(in_str, src, pad_chars)
+    mask = pos < out_len[:, None]
+    return TypedValue(StringColumn(jnp.where(mask, out, 0).astype(jnp.uint8),
+                                   out_len, v.validity), DataType.STRING)
+
+
+@register("lpad", _string_result)
+def _lpad(args, expr, batch, schema, ctx):
+    return _pad(args, expr, batch, schema, ctx, left=True)
+
+
+@register("rpad", _string_result)
+def _rpad(args, expr, batch, schema, ctx):
+    return _pad(args, expr, batch, schema, ctx, left=False)
+
+
+@register("left", _string_result)
+def _left(args, expr, batch, schema, ctx):
+    v = args[0]
+    ln = cast_value(args[1], DataType.INT32).data
+    return TypedValue(S.substring(v.col, jnp.ones_like(ln),
+                                  jnp.maximum(ln, 0)), DataType.STRING)
+
+
+@register("right", _string_result)
+def _right(args, expr, batch, schema, ctx):
+    v = args[0]
+    ln = jnp.maximum(cast_value(args[1], DataType.INT32).data, 0)
+    start = jnp.where(ln == 0, v.col.lens + 1, -ln)
+    return TypedValue(S.substring(v.col, start, jnp.full_like(ln, 2 ** 30)),
+                      DataType.STRING)
+
+
+@register("space", _string_result)
+def _space(args, expr, batch, schema, ctx):
+    nsp = jnp.maximum(cast_value(args[0], DataType.INT32).data, 0)
+    cap_n = int(_lit(expr, 0, 0) or 0) if isinstance(expr.args[0], ir.Literal) \
+        else 64
+    out_w = bucket_string_width(max(cap_n, 1))
+    n = args[0].col.capacity
+    nsp = jnp.minimum(nsp, out_w)
+    mask = _pos(out_w) < nsp[:, None]
+    chars = jnp.where(mask, ord(" "), 0).astype(jnp.uint8)
+    return TypedValue(StringColumn(
+        jnp.broadcast_to(chars, (n, out_w)), nsp, args[0].validity),
+        DataType.STRING)
+
+
+@register("ascii", DataType.INT32)
+def _ascii(args, expr, batch, schema, ctx):
+    v = args[0]
+    first = jnp.where(v.col.lens > 0, v.col.chars[:, 0].astype(jnp.int32), 0)
+    return TypedValue(PrimitiveColumn(first, v.validity), DataType.INT32)
+
+
+@register("chr", _string_result)
+@register("char", _string_result)
+def _chr(args, expr, batch, schema, ctx):
+    code = jnp.mod(cast_value(args[0], DataType.INT64).data, 256)
+    n = args[0].col.capacity
+    chars = jnp.zeros((n, 8), jnp.uint8).at[:, 0].set(
+        code.astype(jnp.uint8))
+    lens = jnp.where(code > 0, 1, 0).astype(jnp.int32)
+    return TypedValue(StringColumn(chars, lens, args[0].validity),
+                      DataType.STRING)
+
+
+# ---------------------------------------------------------------------------
+# search: instr / locate / substring_index / translate
+# ---------------------------------------------------------------------------
+
+def _first_occurrence(chars, lens, needle: bytes, from_pos):
+    """1-based position of the first occurrence of ``needle`` at or after
+    0-based ``from_pos``; 0 when absent. Vectorized window scan."""
+    n, w = chars.shape
+    m = len(needle)
+    if m == 0:
+        return jnp.minimum(from_pos + 1, lens + 1)
+    if m > w:
+        return jnp.zeros(n, jnp.int32)
+    lit = jnp.asarray(np.frombuffer(needle, np.uint8))
+    best = jnp.full(n, w + 1, jnp.int32)
+    for s in range(w - m + 1):
+        ok = jnp.all(chars[:, s:s + m] == lit[None, :], axis=1) \
+            & (s + m <= lens) & (s >= from_pos)
+        best = jnp.where(ok & (s < best), s, best)
+    return jnp.where(best <= w, best + 1, 0).astype(jnp.int32)
+
+
+@register("instr", DataType.INT32)
+def _instr(args, expr, batch, schema, ctx):
+    v = args[0]
+    needle = _lit(expr, 1, "")
+    needle_b = needle.encode() if isinstance(needle, str) else (needle or b"")
+    p = _first_occurrence(v.col.chars, v.col.lens, needle_b,
+                          jnp.zeros(v.col.capacity, jnp.int32))
+    return TypedValue(PrimitiveColumn(p, v.validity & args[1].validity),
+                      DataType.INT32)
+
+
+@register("locate", DataType.INT32)
+@register("position", DataType.INT32)
+def _locate(args, expr, batch, schema, ctx):
+    # locate(substr, str[, pos])
+    needle = _lit(expr, 0, "")
+    needle_b = needle.encode() if isinstance(needle, str) else (needle or b"")
+    v = args[1]
+    start = (cast_value(args[2], DataType.INT32).data - 1
+             if len(args) > 2 else jnp.zeros(v.col.capacity, jnp.int32))
+    p = _first_occurrence(v.col.chars, v.col.lens, needle_b,
+                          jnp.maximum(start, 0))
+    return TypedValue(PrimitiveColumn(p, v.validity), DataType.INT32)
+
+
+@register("substring_index", _string_result)
+def _substring_index(args, expr, batch, schema, ctx):
+    """substring_index(str, delim, count): everything before the count-th
+    delimiter (count > 0, from the left) or after it (count < 0, from the
+    right) — Spark semantics incl. whole-string when too few delimiters."""
+    v = args[0]
+    delim = _lit(expr, 1, "")
+    delim_b = delim.encode() if isinstance(delim, str) else (delim or b"")
+    count = int(_lit(expr, 2, 0) or 0)
+    chars, lens = v.col.chars, v.col.lens
+    n, w = chars.shape
+    m = len(delim_b)
+    if m == 0 or count == 0:
+        return TypedValue(StringColumn(jnp.zeros_like(chars),
+                                       jnp.zeros(n, jnp.int32), v.validity),
+                          DataType.STRING)
+    lit = jnp.asarray(np.frombuffer(delim_b, np.uint8))
+    # occurrence matrix (non-overlapping, left to right, like Java indexOf
+    # stepping by the delimiter length)
+    occ = jnp.zeros((n, w), bool)
+    blocked_until = jnp.zeros(n, jnp.int32)
+    for s in range(w - m + 1):
+        hit = jnp.all(chars[:, s:s + m] == lit[None, :], axis=1) \
+            & (s + m <= lens) & (s >= blocked_until)
+        occ = occ.at[:, s].set(hit)
+        blocked_until = jnp.where(hit, s + m, blocked_until)
+    cum = jnp.cumsum(occ.astype(jnp.int32), axis=1)
+    total = cum[:, -1] if w else jnp.zeros(n, jnp.int32)
+    if count > 0:
+        # cut before the count-th occurrence
+        kth = jnp.where(occ & (cum == count), _pos(w), w)
+        cut = jnp.min(kth, axis=1)
+        new_len = jnp.where(total >= count, jnp.minimum(cut, lens), lens)
+        mask = _pos(w) < new_len[:, None]
+        return TypedValue(StringColumn(
+            jnp.where(mask, chars, 0).astype(jnp.uint8),
+            new_len.astype(jnp.int32), v.validity), DataType.STRING)
+    k = -count
+    # start after the (total-k+1)-th occurrence from the left
+    target = total - k + 1
+    kth = jnp.where(occ & (cum == target[:, None]), _pos(w), -1)
+    start_at = jnp.max(kth, axis=1) + m
+    start = jnp.where(total >= k, start_at, 0)
+    new_len = lens - start
+    idx = start[:, None] + _pos(w)
+    out = jnp.take_along_axis(chars, jnp.clip(idx, 0, w - 1), axis=1)
+    mask = _pos(w) < new_len[:, None]
+    return TypedValue(StringColumn(
+        jnp.where(mask, out, 0).astype(jnp.uint8),
+        jnp.maximum(new_len, 0).astype(jnp.int32), v.validity),
+        DataType.STRING)
+
+
+@register("translate", _string_result)
+def _translate(args, expr, batch, schema, ctx):
+    """translate(str, from, to): per-char mapping via a 256-entry LUT;
+    chars beyond len(to) are DELETED (per-row compaction by one argsort)."""
+    v = args[0]
+    from_s = str(_lit(expr, 1, ""))
+    to_s = str(_lit(expr, 2, ""))
+    lut = np.arange(256, dtype=np.int32)        # identity
+    delete = np.zeros(256, bool)
+    for i, ch in enumerate(from_s.encode()):
+        if lut[ch] != ch or delete[ch]:
+            continue  # first occurrence wins (Java semantics)
+        if i < len(to_s.encode()):
+            lut[ch] = to_s.encode()[i]
+        else:
+            delete[ch] = True
+    chars, lens = v.col.chars, v.col.lens
+    n, w = chars.shape
+    mapped = jnp.asarray(lut)[chars.astype(jnp.int32)].astype(jnp.uint8)
+    drop = jnp.asarray(delete)[chars.astype(jnp.int32)] \
+        | (_pos(w) >= lens[:, None])
+    # stable compact per row: sort by (dropped, position)
+    key = jnp.where(drop, w + _pos(w), _pos(w))
+    order = jnp.argsort(key, axis=1)
+    out = jnp.take_along_axis(mapped, order, axis=1)
+    new_len = jnp.sum(~drop, axis=1).astype(jnp.int32)
+    mask = _pos(w) < new_len[:, None]
+    return TypedValue(StringColumn(jnp.where(mask, out, 0).astype(jnp.uint8),
+                                   new_len, v.validity), DataType.STRING)
+
+
+# ---------------------------------------------------------------------------
+# split (host) + fused element access
+# ---------------------------------------------------------------------------
+
+def split_index(child_args, ordinal: int, batch, schema, ctx):
+    """GetIndexedField(split(str, regex), i) fused into one host kernel —
+    the dominant use of split in query plans. Returns the i-th piece or
+    null when out of range (reference: spark_strings.rs string_split +
+    list extract)."""
+    import re
+    import jax
+    from auron_tpu.exprs.eval import evaluate
+    v = evaluate(child_args[0], batch, schema, ctx)
+    pat = child_args[1]
+    assert isinstance(pat, ir.Literal), "split pattern must be literal"
+    rx = re.compile(str(pat.value))
+    col: StringColumn = v.col
+    cap, w = col.chars.shape
+    out_w = col.chars.shape[1]
+
+    def host(chars_np, lens_np, valid_np):
+        chars = np.zeros((cap, out_w), np.uint8)
+        lens = np.zeros(cap, np.int32)
+        ok = np.zeros(cap, bool)
+        for i in range(cap):
+            if not valid_np[i]:
+                continue
+            s = bytes(chars_np[i, : lens_np[i]]).decode("utf-8", "replace")
+            parts = rx.split(s)
+            if 0 <= ordinal < len(parts):
+                b = parts[ordinal].encode()[:out_w]
+                chars[i, : len(b)] = np.frombuffer(b, np.uint8)
+                lens[i] = len(b)
+                ok[i] = True
+        return chars, lens, ok
+
+    chars, lens, ok = jax.pure_callback(
+        host,
+        (jax.ShapeDtypeStruct((cap, out_w), jnp.uint8),
+         jax.ShapeDtypeStruct((cap,), jnp.int32),
+         jax.ShapeDtypeStruct((cap,), jnp.bool_)),
+        col.chars, col.lens, v.validity, vmap_method="sequential")
+    return TypedValue(StringColumn(chars, lens, ok), DataType.STRING)

@@ -453,3 +453,16 @@ def _xxhash64(args, expr, batch, schema, ctx):
     h = hashing.xxhash64_columns([a.col for a in args], batch.capacity, 42)
     return TypedValue(PrimitiveColumn(h, jnp.ones(batch.capacity, bool)),
                       DataType.INT64)
+
+
+# ---------------------------------------------------------------------------
+# extended surface — importing these modules populates the registry
+# (strings/dates on device; json/regex as host callbacks; md5/sha256 as
+# vectorized device kernels)
+# ---------------------------------------------------------------------------
+
+from auron_tpu.exprs import fn_arrays   # noqa: E402,F401
+from auron_tpu.exprs import fn_crypto   # noqa: E402,F401
+from auron_tpu.exprs import fn_dates    # noqa: E402,F401
+from auron_tpu.exprs import fn_json     # noqa: E402,F401
+from auron_tpu.exprs import fn_strings  # noqa: E402,F401

@@ -111,7 +111,47 @@ _STRING_PRED = {"StartsWith": "starts_with", "EndsWith": "ends_with",
 
 _SCALAR_FN = {"Upper": "upper", "Lower": "lower", "Length": "length",
               "Substring": "substring", "Concat": "concat",
-              "Coalesce": "coalesce", "Abs": "abs"}
+              "Coalesce": "coalesce", "Abs": "abs",
+              # round-3 surface expansion (exprs/fn_*.py)
+              "ConcatWs": "concat_ws", "InitCap": "initcap",
+              "StringRepeat": "repeat", "Reverse": "reverse",
+              "StringLPad": "lpad", "StringRPad": "rpad",
+              "StringInstr": "instr", "StringLocate": "locate",
+              "SubstringIndex": "substring_index",
+              "StringTranslate": "translate", "Ascii": "ascii", "Chr": "chr",
+              "Year": "year", "Month": "month", "DayOfMonth": "day",
+              "Quarter": "quarter", "DayOfWeek": "dayofweek",
+              "DayOfYear": "dayofyear", "WeekOfYear": "weekofyear",
+              "Hour": "hour", "Minute": "minute", "Second": "second",
+              "DateAdd": "date_add", "DateSub": "date_sub",
+              "DateDiff": "datediff", "DateFormatClass": "date_format",
+              "FromUnixTime": "from_unixtime",
+              "UnixTimestamp": "unix_timestamp",
+              "ToUnixTimestamp": "to_unix_timestamp",
+              "TruncDate": "trunc", "TruncTimestamp": "date_trunc",
+              "AddMonths": "add_months", "LastDay": "last_day",
+              "MonthsBetween": "months_between", "NextDay": "next_day",
+              "MakeDate": "make_date",
+              "Md5": "md5", "Sha1": "sha1", "Sha2": "sha2", "Crc32": "crc32",
+              "Base64": "base64", "UnBase64": "unbase64",
+              "Hex": "hex", "Unhex": "unhex",
+              "GetJsonObject": "get_json_object",
+              "RegExpExtract": "regexp_extract",
+              "RegExpReplace": "regexp_replace", "RLike": "rlike",
+              "CreateArray": "array", "ArrayContains": "array_contains",
+              "ArrayPosition": "array_position", "ElementAt": "element_at",
+              "Size": "size", "SortArray": "sort_array",
+              "ArrayMax": "array_max", "ArrayMin": "array_min",
+              "CreateMap": "map", "MapFromArrays": "map_from_arrays",
+              "MapKeys": "map_keys", "MapValues": "map_values",
+              "Round": "round", "BRound": "bround", "Pow": "pow",
+              "Sqrt": "sqrt", "Exp": "exp", "Log": "log",
+              "Floor": "floor", "Ceil": "ceil", "Greatest": "greatest",
+              "Least": "least", "IsNaN": "isnan", "NaNvl": "nanvl",
+              "NullIf": "nullif", "If": "if",
+              "StringTrim": "trim", "StringTrimLeft": "ltrim",
+              "StringTrimRight": "rtrim", "Murmur3Hash": "hash",
+              "XxHash64": "xxhash64"}
 
 _AGG_FN = {"Sum": "sum", "Min": "min", "Max": "max", "Average": "avg",
            "Count": "count", "First": "first",

@@ -1,0 +1,91 @@
+"""Shared buffering MemConsumer skeleton.
+
+Several operators buffer device batches and spill them to tiered storage
+under memory pressure (sort runs, join build sides — the reference's
+MemConsumer impls in sort_exec.rs:375 and the join build registration).
+The lock/accounting/metrics protocol is identical everywhere; only how a
+spill run is serialized differs, so that is the one override point
+(``_write_run``)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from auron_tpu.columnar.batch import DeviceBatch, batch_nbytes
+
+
+class BufferedSpillConsumer:
+    """Buffers child batches; under pressure writes them as one spill run.
+
+    Subclasses override ``_write_run`` to control the run format (e.g. the
+    sort consumer sorts the buffer and attaches order words)."""
+
+    def __init__(self, name: str, mem, metrics, conf,
+                 frame_rows: Optional[int] = None):
+        from auron_tpu import config as cfg
+        self.mem = mem
+        self.metrics = metrics
+        self.consumer_name = name
+        self.frame_rows = frame_rows or conf.get(cfg.SPILL_FRAME_ROWS)
+        self.codec_level = conf.get(cfg.SPILL_CODEC_LEVEL)
+        self.buffered: list[DeviceBatch] = []
+        self.bytes = 0
+        self.spills = []
+        self._lock = threading.RLock()
+        mem.register_consumer(self)
+
+    # -- write side ---------------------------------------------------------
+
+    def add(self, batch: DeviceBatch) -> None:
+        with self._lock:
+            self.buffered.append(batch)
+            self.bytes += batch_nbytes(batch)
+            used = self.bytes
+        self.mem.update_mem_used(self, used)
+
+    def take_buffered(self) -> list[DeviceBatch]:
+        with self._lock:
+            out, self.buffered = self.buffered, []
+            self.bytes = 0
+        return out
+
+    def mem_used(self) -> int:
+        with self._lock:
+            return self.bytes
+
+    # -- MemConsumer --------------------------------------------------------
+
+    def spill(self) -> int:
+        with self._lock:
+            if not self.buffered:
+                return 0
+            buffered, self.buffered = self.buffered, []
+            freed, self.bytes = self.bytes, 0
+        spill = self.mem.spill_manager.new_spill()
+        self._write_run(spill, buffered)
+        with self._lock:
+            self.spills.append(spill.finish())
+        self.metrics.counter("mem_spill_count").add(1)
+        self.metrics.counter("mem_spill_size").add(freed)
+        return freed
+
+    def _write_run(self, spill, batches: list[DeviceBatch]) -> None:
+        """Default run format: each batch's live rows as unsorted frames."""
+        from auron_tpu.columnar.serde import (batch_to_host,
+                                              serialize_host_batch,
+                                              slice_host_batch)
+        for b in batches:
+            n = int(b.num_rows)
+            host = batch_to_host(b, n)
+            for lo in range(0, max(n, 1), self.frame_rows):
+                hi = min(lo + self.frame_rows, n)
+                spill.write_frame(serialize_host_batch(
+                    slice_host_batch(host, lo, hi),
+                    codec_level=self.codec_level))
+
+    def close(self) -> None:
+        self.mem.unregister_consumer(self)
+        for s in self.spills:
+            s.release()
+        self.spills = []

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from auron_tpu.columnar.batch import (DeviceBatch, PrimitiveColumn, StringColumn,
                                       compact, gather_column)
+from auron_tpu.memmgr.consumer import BufferedSpillConsumer
 from auron_tpu.columnar.schema import DataType, Field, Schema
 from auron_tpu.exprs import ir
 from auron_tpu.exprs.eval import EvalContext, evaluate
@@ -351,7 +352,7 @@ def _null_column_like_schema(field: Field, cap):
     return null_column_for_field(field, cap)
 
 
-class _JoinBuildConsumer:
+class _JoinBuildConsumer(BufferedSpillConsumer):
     """Build-side buffering registered with the memory manager (the
     MemConsumer role the reference's broadcast-join build plays,
     join_hash_map.rs:365-387). Under pressure, buffered batches spill as
@@ -359,66 +360,7 @@ class _JoinBuildConsumer:
     the external sort-merge fallback."""
 
     def __init__(self, op: "HashJoinOp", mem, metrics, conf):
-        import threading
-        from auron_tpu import config as cfg
-        self.mem = mem
-        self.metrics = metrics
-        self.consumer_name = f"join-build-{id(op):x}"
-        self.frame_rows = conf.get(cfg.SPILL_FRAME_ROWS)
-        self.codec_level = conf.get(cfg.SPILL_CODEC_LEVEL)
-        self.buffered: list[DeviceBatch] = []
-        self.bytes = 0
-        self.spills = []
-        self._lock = threading.RLock()
-        mem.register_consumer(self)
-
-    def add(self, batch: DeviceBatch) -> None:
-        from auron_tpu.columnar.batch import batch_nbytes
-        with self._lock:
-            self.buffered.append(batch)
-            self.bytes += batch_nbytes(batch)
-            used = self.bytes
-        self.mem.update_mem_used(self, used)
-
-    def take_buffered(self) -> list[DeviceBatch]:
-        with self._lock:
-            out, self.buffered = self.buffered, []
-            self.bytes = 0
-        return out
-
-    def mem_used(self) -> int:
-        with self._lock:
-            return self.bytes
-
-    def spill(self) -> int:
-        from auron_tpu.columnar.serde import (batch_to_host,
-                                              serialize_host_batch,
-                                              slice_host_batch)
-        with self._lock:
-            if not self.buffered:
-                return 0
-            buffered, self.buffered = self.buffered, []
-            freed, self.bytes = self.bytes, 0
-        spill = self.mem.spill_manager.new_spill()
-        for b in buffered:
-            n = int(b.num_rows)
-            host = batch_to_host(b, n)
-            for lo in range(0, max(n, 1), self.frame_rows):
-                hi = min(lo + self.frame_rows, n)
-                spill.write_frame(serialize_host_batch(
-                    slice_host_batch(host, lo, hi),
-                    codec_level=self.codec_level))
-        with self._lock:
-            self.spills.append(spill.finish())
-        self.metrics.counter("mem_spill_count").add(1)
-        self.metrics.counter("mem_spill_size").add(freed)
-        return freed
-
-    def close(self) -> None:
-        self.mem.unregister_consumer(self)
-        for s in self.spills:
-            s.release()
-        self.spills = []
+        super().__init__(f"join-build-{id(op):x}", mem, metrics, conf)
 
 
 class _SpillReplayOp(PhysicalOp):

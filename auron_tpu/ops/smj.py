@@ -326,17 +326,22 @@ class _MergeWindow:
     def words(self, widths: tuple[int, ...]) -> jax.Array:
         return _pad_and_join(self.per_key, widths)
 
-    def evict_below(self, k: int) -> Optional[DeviceBatch]:
-        """Drop the first ``k`` window rows; returns the compacted unmatched
-        prefix (caller null-extends it for right/full joins) or None."""
+    def evict_below(self, k: int,
+                    want_unmatched: bool = True) -> Optional[DeviceBatch]:
+        """Drop the first ``k`` window rows; when ``want_unmatched`` (the
+        right/full tracking path) also returns the compacted unmatched
+        prefix for null-extension — skipped for join types that discard
+        it (one device compact saved per left batch)."""
         if k <= 0 or self.batch is None:
             return None
         k = min(k, self.n)
         cap = self.batch.capacity
         idxs = jnp.arange(cap, dtype=jnp.int32)
-        keep_mask = (idxs < k) & (idxs < self.n) & \
-            ~jnp.asarray(self.matched[:cap])
-        unmatched = compact(self.batch, keep_mask)
+        unmatched = None
+        if want_unmatched:
+            keep_mask = (idxs < k) & (idxs < self.n) & \
+                ~jnp.asarray(self.matched[:cap])
+            unmatched = compact(self.batch, keep_mask)
         shift = jnp.clip(idxs + k, 0, cap - 1)
         self.batch = gather_batch(self.batch, shift,
                                   jnp.asarray(self.n - k, jnp.int32))
@@ -345,7 +350,9 @@ class _MergeWindow:
             [self.matched[k:], np.zeros(k, bool)])
         self.n -= k
         self._account()
-        return unmatched if int(unmatched.num_rows) > 0 else None
+        if unmatched is not None and int(unmatched.num_rows) == 0:
+            unmatched = None
+        return unmatched
 
     def unmatched_rest(self) -> Optional[DeviceBatch]:
         if self.batch is None or self.n == 0:
@@ -364,13 +371,20 @@ class _MergeWindow:
 
 
 @lru_cache(maxsize=256)
-def _mark_kernel(out_cap: int, cap: int, win_cap: int):
+def _mark_kernel(win_cap: int):
+    """Matched window rows = union of the per-left-row match intervals
+    [lo, lo+count): one +1/-1 scatter and a prefix sum — O(win_cap), no
+    pair expansion."""
+
     @jax.jit
-    def kernel(lo, counts, emit):
-        left_idx, win_idx, real, _ = _expand_kernel(out_cap, cap)(lo, counts,
-                                                                  emit)
-        m = jnp.zeros(win_cap, bool)
-        return m.at[jnp.where(real, win_idx, win_cap)].set(True, mode="drop")
+    def kernel(lo, counts):
+        has = counts > 0
+        starts = jnp.where(has, lo, win_cap)
+        ends = jnp.where(has, lo + counts, win_cap)
+        delta = jnp.zeros(win_cap + 1, jnp.int32)
+        delta = delta.at[starts].add(1, mode="drop")
+        delta = delta.at[ends].add(-1, mode="drop")
+        return jnp.cumsum(delta[:win_cap]) > 0
 
     return kernel
 
@@ -536,15 +550,15 @@ class SortMergeJoinOp(PhysicalOp):
                 out = _gather_pairs(left, win.batch, left_idx, win_idx,
                                     real, tot)
             if track:
-                mark = _mark_kernel(out_cap, cap, win_cap)
+                mark = _mark_kernel(win_cap)
                 with timer(elapsed):
-                    win.mark_matched(mark(lo, counts, emit))
+                    win.mark_matched(mark(lo, counts))
             yield out
 
         # advance: window rows strictly below this batch's max key can
         # never match future (ascending) left rows
         k = int(lo[nL - 1])
-        evicted = win.evict_below(k)
+        evicted = win.evict_below(k, want_unmatched=track)
         if track and evicted is not None:
             yield null_extended_right(evicted)
 

@@ -33,6 +33,7 @@ from auron_tpu.columnar.batch import (DeviceBatch, ListColumn,
 from auron_tpu.columnar.schema import DataType, Schema
 from auron_tpu.exprs import ir
 from auron_tpu.exprs.eval import EvalContext, evaluate
+from auron_tpu.memmgr.consumer import BufferedSpillConsumer
 from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
 from auron_tpu.utils.shapes import bucket_rows
 
@@ -187,55 +188,30 @@ def _concat_all(batches: list[DeviceBatch]) -> DeviceBatch:
     return DeviceBatch(out.columns, jnp.asarray(num, jnp.int32))
 
 
-class _SortSpillConsumer:
+class _SortSpillConsumer(BufferedSpillConsumer):
     """Per-execution buffering state registered with the memory manager
     (the MemConsumer role SortExec plays in the reference,
-    sort_exec.rs:375). spill() sorts the buffer into a run and writes it to
-    tiered storage with its order words."""
+    sort_exec.rs:375). A spill sorts the buffer into one run and writes it
+    with its order words so the host k-way merge compares exactly what the
+    device sorted."""
 
     def __init__(self, op: "SortOp", in_schema: Schema, mem_manager,
                  metrics, frame_rows: Optional[int] = None, conf=None):
-        import threading
         from auron_tpu import config as cfg
         conf = conf or cfg.get_config()
         self.op = op
         self.in_schema = in_schema
-        self.mem = mem_manager
-        self.metrics = metrics
-        self.frame_rows = frame_rows or conf.get(cfg.SPILL_FRAME_ROWS)
-        self.codec_level = conf.get(cfg.SPILL_CODEC_LEVEL)
-        self.consumer_name = f"sort-{id(op):x}"
-        self.buffered: list[DeviceBatch] = []
-        self.bytes = 0
-        self.spills = []
-        self._lock = threading.RLock()
-        mem_manager.register_consumer(self)
+        super().__init__(f"sort-{id(op):x}", mem_manager, metrics, conf,
+                         frame_rows=frame_rows)
 
-    def add(self, batch: DeviceBatch) -> None:
-        from auron_tpu.columnar.batch import batch_nbytes
-        with self._lock:
-            self.buffered.append(batch)
-            self.bytes += batch_nbytes(batch)
-            used = self.bytes
-        self.mem.update_mem_used(self, used)
-
-    def mem_used(self) -> int:
-        with self._lock:
-            return self.bytes
-
-    def spill(self) -> int:
+    def _write_run(self, spill, batches: list[DeviceBatch]) -> None:
         import numpy as np
         from auron_tpu.columnar.serde import (batch_to_host,
                                               serialize_host_batch,
                                               slice_host_batch)
-        from auron_tpu.memmgr.merge import ORDER_WORDS_EXTRA
-        with self._lock:
-            if not self.buffered:
-                return 0
-            buffered, self.buffered = self.buffered, []
-            freed, self.bytes = self.bytes, 0
-        from auron_tpu.memmgr.merge import WORD_LAYOUT_EXTRA
-        merged = _concat_all(buffered) if len(buffered) > 1 else buffered[0]
+        from auron_tpu.memmgr.merge import (ORDER_WORDS_EXTRA,
+                                            WORD_LAYOUT_EXTRA)
+        merged = _concat_all(batches) if len(batches) > 1 else batches[0]
         layout = np.asarray(
             key_word_layout(self.op.sort_exprs, self.in_schema, merged),
             dtype=np.uint64)
@@ -245,7 +221,6 @@ class _SortSpillConsumer:
         n = int(run.num_rows)
         host = batch_to_host(run, n)
         host_words = np.asarray(words[:n])
-        spill = self.mem.spill_manager.new_spill()
         for lo in range(0, max(n, 1), self.frame_rows):
             hi = min(lo + self.frame_rows, n)
             spill.write_frame(serialize_host_batch(
@@ -253,17 +228,6 @@ class _SortSpillConsumer:
                 extras={ORDER_WORDS_EXTRA: host_words[lo:hi],
                         WORD_LAYOUT_EXTRA: layout},
                 codec_level=self.codec_level))
-        with self._lock:
-            self.spills.append(spill.finish())
-        self.metrics.counter("mem_spill_count").add(1)
-        self.metrics.counter("mem_spill_size").add(freed)
-        return freed
-
-    def close(self) -> None:
-        self.mem.unregister_consumer(self)
-        for s in self.spills:
-            s.release()
-        self.spills = []
 
 
 class SortOp(PhysicalOp):
