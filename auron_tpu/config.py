@@ -99,8 +99,20 @@ SPILL_CODEC_LEVEL = _opt(
 
 # NOTE: options are declared only once a use-site exists — an option in
 # CONFIG.md that nothing reads is a lie to the user. SMJ-fallback,
-# exchange-spill, dense-kernel-selection, and device-sync-metrics knobs
-# land together with their features.
+# exchange-spill, and dense-kernel-selection knobs land together with
+# their features.
+
+# metrics / sinks
+METRICS_DEVICE_SYNC = _opt(
+    "auron.metrics.device_sync", bool, True,
+    "Block on kernel outputs inside per-operator timers so "
+    "elapsed_compute measures device compute, not async dispatch. "
+    "Costs pipelining overlap; disable for maximum throughput runs.")
+SINK_BUFFER_ROWS = _opt(
+    "auron.sink.buffer_rows", int, 1 << 17,
+    "Rows a file sink buffers before flushing a row group / dataset "
+    "fragment — bounds sink host memory for arbitrarily large "
+    "partitions.")
 
 # aggregation
 AGG_INITIAL_CAPACITY = _opt(

@@ -85,6 +85,10 @@ def _array(args, expr, batch, schema, ctx):
                                      jnp.zeros((n, 1), bool),
                                      jnp.zeros(n, jnp.int32),
                                      jnp.ones(n, bool)), DataType.LIST)
+    if any(isinstance(a.col, StringColumn) for a in args):
+        raise NotImplementedError(
+            "array() over STRING elements: string lists have no columnar "
+            "materialization yet")
     target = args[0].dtype
     vals = [cast_value(a, target) if a.dtype != target else a for a in args]
     values = jnp.stack([v.data for v in vals], axis=1)
@@ -115,6 +119,8 @@ def _size(args, expr, batch, schema, ctx):
 @register("array_contains", DataType.BOOL)
 def _array_contains(args, expr, batch, schema, ctx):
     arr, needle = args
+    if isinstance(needle.col, StringColumn):
+        raise NotImplementedError("array_contains with STRING needle")
     col: ListColumn = arr.col
     hit = jnp.any((col.values == needle.data[:, None]) & col.elem_valid
                   & (jnp.arange(col.max_elems)[None, :] < col.lens[:, None]),
@@ -190,17 +196,21 @@ def _sort_array(args, expr, batch, schema, ctx):
     if len(expr.args) > 1 and isinstance(expr.args[1], ir.Literal):
         asc = bool(expr.args[1].value)
     col: ListColumn = v.col
-    in_list = (jnp.arange(col.max_elems)[None, :] < col.lens[:, None]) \
-        & col.elem_valid
-    # nulls first (asc) / last (desc), then value — Spark sort_array
-    if jnp.issubdtype(col.values.dtype, jnp.integer):
-        hi = jnp.asarray(np.iinfo(np.int64).max, col.values.dtype)
-    else:
-        hi = jnp.asarray(np.inf, col.values.dtype)
-    key = jnp.where(in_list, col.values, hi)            # padding last
-    key = jnp.where(in_list & ~col.elem_valid, -hi, key)  # nulls smallest
-    order = jnp.argsort(jnp.where(jnp.asarray(asc), key, -key), axis=1,
-                        stable=True)
+    pos = jnp.arange(col.max_elems)[None, :]
+    in_list = pos < col.lens[:, None]
+    valid = in_list & col.elem_valid
+    # two stable argsorts: value order first, then the class key
+    # (asc: nulls < values < padding; desc: values < nulls < padding —
+    # Spark sort_array null placement), so padding never leaks into the
+    # live prefix regardless of direction
+    valkey = col.values if asc else -col.values
+    order = jnp.argsort(valkey, axis=1, stable=True)
+    cls = jnp.where(in_list & ~col.elem_valid, 0 if asc else 1,
+                    jnp.where(valid, 1 if asc else 0, 2))
+    cls_sorted = jnp.take_along_axis(cls, order, axis=1)
+    order = jnp.take_along_axis(order,
+                                jnp.argsort(cls_sorted, axis=1, stable=True),
+                                axis=1)
     values = jnp.take_along_axis(col.values, order, axis=1)
     ev = jnp.take_along_axis(col.elem_valid, order, axis=1)
     return TypedValue(ListColumn(values, ev, col.lens, col.validity),
@@ -252,6 +262,10 @@ def _map(args, expr, batch, schema, ctx):
                                    karr.validity & varr.validity),
                           DataType.LIST)
     assert len(args) % 2 == 0, "map() needs key/value pairs"
+    if any(isinstance(a.col, StringColumn) for a in args):
+        raise NotImplementedError(
+            "map() over STRING keys/values: string lists have no columnar "
+            "materialization yet")
     keys = args[0::2]
     vals = args[1::2]
     n = batch.capacity
@@ -281,6 +295,8 @@ def _map_values(args, expr, batch, schema, ctx):
 
 def _map_get(v: TypedValue, key: TypedValue) -> TypedValue:
     """map[key]: last matching key wins (Spark map semantics)."""
+    if isinstance(key.col, StringColumn):
+        raise NotImplementedError("map lookup with STRING key")
     m: MapValue = v.col
     kcol, vcol = m.keys, m.values
     in_map = jnp.arange(kcol.max_elems)[None, :] < kcol.lens[:, None]

@@ -355,7 +355,9 @@ def _months_between(args, expr, batch, schema, ctx):
     t2 = jnp.mod(ts2, US_PER_DAY).astype(jnp.float64)
     day_frac = ((dd1 - dd2).astype(jnp.float64) * US_PER_DAY + (t1 - t2)) \
         / (31.0 * US_PER_DAY)
-    frac = jnp.where(both_last | (same_day & (t1 == t2)), 0.0, day_frac)
+    # Spark short-circuits to the whole-month diff whenever the days of
+    # month match (time of day ignored) or both are month-ends
+    frac = jnp.where(both_last | same_day, 0.0, day_frac)
     out = months.astype(jnp.float64) + frac
     roundoff = _lit(expr, 2, True) if len(expr.args) > 2 else True
     if roundoff:
@@ -378,11 +380,13 @@ def _weekofyear(args, expr, batch, schema, ctx):
 
     w0 = iso_week(days, y)
     # w0 == 0 → last week of previous year; own-year w0 == 53 rolls to
-    # week 1 when the year has no week 53
+    # week 1 when the year has no week 53. Dec 28 is ALWAYS in the year's
+    # last ISO week, so its week number IS the year's week count (Dec 31
+    # itself overcounts in exactly the years that roll).
     w_prev = iso_week(days, y - 1)
-    dec31 = _days_from_civil(y, jnp.full_like(y, 12), jnp.full_like(y, 31))
-    w_dec31 = iso_week(dec31, y)
-    roll = (w0 >= 53) & (w_dec31 < 53)
+    dec28 = _days_from_civil(y, jnp.full_like(y, 12), jnp.full_like(y, 28))
+    weeks_in_year = iso_week(dec28, y)
+    roll = w0 > weeks_in_year
     w = jnp.where(w0 < 1, w_prev, jnp.where(roll, 1, w0))
     return TypedValue(PrimitiveColumn(w.astype(jnp.int32), args[0].validity),
                       DataType.INT32)

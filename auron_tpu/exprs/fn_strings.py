@@ -203,9 +203,10 @@ def _right(args, expr, batch, schema, ctx):
 
 @register("space", _string_result)
 def _space(args, expr, batch, schema, ctx):
+    # literal-only: the output width must be static (a silent cap on a
+    # column argument would truncate data)
     nsp = jnp.maximum(cast_value(args[0], DataType.INT32).data, 0)
-    cap_n = int(_lit(expr, 0, 0) or 0) if isinstance(expr.args[0], ir.Literal) \
-        else 64
+    cap_n = int(_lit(expr, 0, 0) or 0)
     out_w = bucket_string_width(max(cap_n, 1))
     n = args[0].col.capacity
     nsp = jnp.minimum(nsp, out_w)

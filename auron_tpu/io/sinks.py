@@ -28,6 +28,11 @@ _RESULT_SCHEMA = Schema((Field("num_rows", DataType.INT64, False),))
 
 
 class _FileSinkOp(PhysicalOp):
+    """Streaming sink: child batches flush to the writer whenever the
+    buffer reaches auron.sink.buffer_rows — sink host memory is bounded
+    regardless of partition size, the same streaming row-group contract as
+    the reference sinks (parquet_sink_exec.rs)."""
+
     def __init__(self, child: PhysicalOp, path: str, compression: str):
         self.child = child
         self.path = path
@@ -41,28 +46,47 @@ class _FileSinkOp(PhysicalOp):
         return _RESULT_SCHEMA
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        from auron_tpu import config as cfg
         metrics = ctx.metrics_for(self.name)
         io_time = metrics.counter("io_time")
         child_schema = self.child.schema()
+        buffer_rows = ctx.conf.get(cfg.SINK_BUFFER_ROWS)
 
         def stream():
-            tables = []
-            for batch in self.child.execute(partition, ctx):
-                rb = to_arrow(batch, child_schema)
-                if rb.num_rows:
-                    tables.append(pa.Table.from_batches([rb]))
+            pending: list[pa.Table] = []
+            pending_rows = 0
             n = 0
-            if tables:
-                table = pa.concat_tables(tables).combine_chunks()
-                n = table.num_rows
-                with timer(io_time):
-                    self._write(table, partition)
+            writer = None
+            try:
+                for batch in self.child.execute(partition, ctx):
+                    rb = to_arrow(batch, child_schema)
+                    if not rb.num_rows:
+                        continue
+                    pending.append(pa.Table.from_batches([rb]))
+                    pending_rows += rb.num_rows
+                    n += rb.num_rows
+                    if pending_rows >= buffer_rows:
+                        chunk = pa.concat_tables(pending).combine_chunks()
+                        pending, pending_rows = [], 0
+                        with timer(io_time):
+                            writer = self._write_chunk(writer, chunk,
+                                                       partition)
+                if pending:
+                    chunk = pa.concat_tables(pending).combine_chunks()
+                    with timer(io_time):
+                        writer = self._write_chunk(writer, chunk, partition)
+            finally:
+                if writer is not None:
+                    with timer(io_time):
+                        writer.close()
             result = pa.record_batch({"num_rows": pa.array([n], pa.int64())})
             yield to_device(result, capacity=16)[0]
 
         return count_output(stream(), metrics)
 
-    def _write(self, table: pa.Table, partition: int) -> None:
+    def _write_chunk(self, writer, chunk: pa.Table, partition: int):
+        """Write one flushed chunk; returns the (possibly newly opened)
+        long-lived writer, or None for writers that are per-chunk."""
         raise NotImplementedError
 
     def __repr__(self):
@@ -77,20 +101,28 @@ class ParquetSinkOp(_FileSinkOp):
                  compression: str = "snappy"):
         super().__init__(child, path, compression)
         self.partition_by = list(partition_by or [])
+        self._flush_seq = 0
 
-    def _write(self, table: pa.Table, partition: int) -> None:
-        comp = None if self.compression == "none" else self.compression
+    def _write_chunk(self, writer, chunk: pa.Table, partition: int):
+        comp = self.compression if self.compression != "none" else None
         if self.partition_by:
-            # hive-style dynamic partitions: path/key=value/part-....parquet
+            # hive-style dynamic partitions: every flush appends dataset
+            # fragments under path/key=value/
+            seq = self._flush_seq
+            self._flush_seq += 1
             pq.write_to_dataset(
-                table, root_path=self.path, partition_cols=self.partition_by,
+                chunk, root_path=self.path, partition_cols=self.partition_by,
                 compression=comp,
-                basename_template=f"part-{partition:05d}-{{i}}.parquet")
-        else:
+                basename_template=f"part-{partition:05d}-{seq:04d}-{{i}}"
+                                  ".parquet")
+            return None
+        if writer is None:
             os.makedirs(self.path, exist_ok=True)
-            pq.write_table(
-                table, os.path.join(self.path, f"part-{partition:05d}.parquet"),
-                compression=comp)
+            writer = pq.ParquetWriter(
+                os.path.join(self.path, f"part-{partition:05d}.parquet"),
+                chunk.schema, compression=comp or "none")
+        writer.write_table(chunk)
+        return writer
 
 
 class OrcSinkOp(_FileSinkOp):
@@ -102,10 +134,13 @@ class OrcSinkOp(_FileSinkOp):
     def __init__(self, child: PhysicalOp, path: str, compression: str = "zstd"):
         super().__init__(child, path, compression)
 
-    def _write(self, table: pa.Table, partition: int) -> None:
+    def _write_chunk(self, writer, chunk: pa.Table, partition: int):
         from pyarrow import orc
-        os.makedirs(self.path, exist_ok=True)
-        orc.write_table(
-            table, os.path.join(self.path, f"part-{partition:05d}.orc"),
-            compression=self._ORC_COMPRESSION.get(self.compression,
-                                                  self.compression))
+        if writer is None:
+            os.makedirs(self.path, exist_ok=True)
+            writer = orc.ORCWriter(
+                os.path.join(self.path, f"part-{partition:05d}.orc"),
+                compression=self._ORC_COMPRESSION.get(self.compression,
+                                                      self.compression))
+        writer.write(chunk)
+        return writer

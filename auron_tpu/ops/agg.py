@@ -997,7 +997,7 @@ class AggOp(PhysicalOp):
                       else a[:new_cap] for a in accs)
         return (keys2, accs2, n, new_cap, h[:new_cap])
 
-    def _reduce_batch(self, keys, accs, live, elapsed):
+    def _reduce_batch(self, keys, accs, live, elapsed, _sync=True):
         """Step 1: one batch → its hash-sorted group table."""
         kinds = [kind for spec in self.specs
                  for (_n, _dt, kind) in _device_fields(spec)]
@@ -1006,14 +1006,15 @@ class AggOp(PhysicalOp):
         while True:
             meta = tuple(zip(kinds, out_elems))
             kern = _batch_reduce_kernel(len(keys), meta, cap_b)
-            with timer(elapsed):
-                bk, ba, bh, bn, needed = kern(tuple(keys), tuple(accs), live)
+            with timer(elapsed, sync=_sync) as t:
+                bk, ba, bh, bn, needed = t.track(
+                    kern(tuple(keys), tuple(accs), live))
             ng = int(bn)
             ok, _cap = self._grow_check(kinds, out_elems, ng, cap_b, needed)
             if ok:
                 return self._shrink_table((bk, ba, bn, cap_b, bh), ng)
 
-    def _merge_tables(self, s, b, elapsed):
+    def _merge_tables(self, s, b, elapsed, _sync=True):
         """Fold group table ``b`` into group table ``s`` (both hash-sorted
         5-tuples) via the searchsorted merge kernel, growing capacity /
         element buckets as needed."""
@@ -1034,9 +1035,9 @@ class AggOp(PhysicalOp):
             meta = tuple(zip(kinds, out_elems))
             kern = _state_merge_kernel(len(s_keys), meta, s_cap, cap_b,
                                        out_cap)
-            with timer(elapsed):
-                new_keys, new_accs, h_out, num_groups, needed = kern(
-                    s_keys, s_accs, s_h, s_n, bk, ba, bh, bn)
+            with timer(elapsed, sync=_sync) as t:
+                new_keys, new_accs, h_out, num_groups, needed = t.track(kern(
+                    s_keys, s_accs, s_h, s_n, bk, ba, bh, bn))
             ng = int(num_groups)
             ok, out_cap = self._grow_check(kinds, out_elems, ng, out_cap,
                                            needed)
@@ -1049,7 +1050,7 @@ class AggOp(PhysicalOp):
     #: O(S / _HOT_FACTOR) per batch (LSM-style two-level state)
     _HOT_FACTOR = 8
 
-    def _merge(self, state, keys, accs, live, elapsed):
+    def _merge(self, state, keys, accs, live, elapsed, _sync=True):
         """state: None | (main, hot), each None | (keys, accs, num_groups,
         capacity, hashes). Two-level update: every batch merges into the
         small hot table (O(B log B + hot)); the hot table folds into main
@@ -1057,23 +1058,23 @@ class AggOp(PhysicalOp):
         ~_HOT_FACTOR batches instead of per batch. The reference's
         open-addressing AggTable gets the same amortization from its
         in-memory table + sorted bucket spills (agg_table.rs:68-356)."""
-        batch_tbl = self._reduce_batch(keys, accs, live, elapsed)
+        batch_tbl = self._reduce_batch(keys, accs, live, elapsed, _sync)
         cap_b = live.shape[0]
         main, hot = state if state is not None else (None, None)
         if hot is None:
             hot = batch_tbl
         else:
-            hot = self._merge_tables(hot, batch_tbl, elapsed)
+            hot = self._merge_tables(hot, batch_tbl, elapsed, _sync)
         # threshold must clear _shrink_table's initial_capacity floor, or
         # a small batch capacity would fold hot->main on EVERY batch (two
         # O(S) passes per batch — worse than the single-level design)
         if hot[3] >= self._HOT_FACTOR * max(cap_b, self.initial_capacity):
             main = hot if main is None else self._merge_tables(main, hot,
-                                                               elapsed)
+                                                               elapsed, _sync)
             hot = None
         return (main, hot)
 
-    def _compact(self, state, elapsed):
+    def _compact(self, state, elapsed, _sync=True):
         """Collapse (main, hot) into one table for emit / spill / the skip
         decision. Returns a 5-tuple or None."""
         if state is None:
@@ -1083,7 +1084,7 @@ class AggOp(PhysicalOp):
             return hot
         if hot is None:
             return main
-        return self._merge_tables(main, hot, elapsed)
+        return self._merge_tables(main, hot, elapsed, _sync)
 
     # -- finalize → output batch -------------------------------------------
     def _emit(self, state, in_schema: Schema, host=None) -> DeviceBatch:
@@ -1268,6 +1269,7 @@ class AggOp(PhysicalOp):
         elapsed = metrics.counter("elapsed_compute")
         in_schema = self.child.schema()
         ectx = EvalContext(partition_id=partition)
+        _sync = ctx.device_sync
         mem = ctx.mem_manager
         spillable = mem is not None and getattr(mem, "spill_manager", None) is not None
         conf = ctx.conf
@@ -1307,7 +1309,8 @@ class AggOp(PhysicalOp):
                         # state lives in the consumer between merges so an
                         # external victim spill can take it atomically
                         state = consumer.take_state()
-                    state = self._merge(state, keys, accs, live, elapsed)
+                    state = self._merge(state, keys, accs, live, elapsed,
+                                        _sync)
                     if consumer is not None:
                         state = consumer.observe(state)
                     if not skip_pending:
@@ -1324,7 +1327,7 @@ class AggOp(PhysicalOp):
                         state = consumer.take_state()
                     # exact distinct count needs the levels folded: a key
                     # present in both hot and main would count twice
-                    tbl = self._compact(state, elapsed)
+                    tbl = self._compact(state, elapsed, _sync)
                     state = None if tbl is None else (tbl, None)
                     ng = 0 if tbl is None else int(tbl[2])
                     # groups living only in spill runs are invisible in the
@@ -1341,8 +1344,9 @@ class AggOp(PhysicalOp):
                                 k2, a2, l2 = self._state_contributions(
                                     spilled)
                                 state = self._merge(state, k2, a2, l2,
-                                                    elapsed)
-                        yield self._emit(self._compact(state, elapsed),
+                                                    elapsed, _sync)
+                        yield self._emit(self._compact(state, elapsed,
+                                                       _sync),
                                          in_schema, host)
                         state = None
                         skipping = True
@@ -1360,8 +1364,9 @@ class AggOp(PhysicalOp):
                     state = consumer.take_state()
                     for spilled in consumer.read_spilled_states():
                         keys, accs, live = self._state_contributions(spilled)
-                        state = self._merge(state, keys, accs, live, elapsed)
-                final_tbl = self._compact(state, elapsed)
+                        state = self._merge(state, keys, accs, live,
+                                            elapsed, _sync)
+                final_tbl = self._compact(state, elapsed, _sync)
                 if final_tbl is None:
                     if not self.group_exprs and self.mode in ("final", "complete"):
                         # global agg over empty input: one row of neutral results

@@ -48,20 +48,53 @@ class MetricsSet:
         return {k: m.value for k, m in self._metrics.items()}
 
 
+def _device_sync(value) -> None:
+    """Block until a kernel result is materialized on device.
+    block_until_ready is unreliable on some PJRT plugins (bench.py syncs
+    via readback for the same reason), so fall back to a 1-element
+    readback of the first leaf when it raises."""
+    import jax
+    leaves = [l for l in jax.tree_util.tree_leaves(value)
+              if hasattr(l, "block_until_ready")]
+    for leaf in leaves:
+        try:
+            leaf.block_until_ready()
+        except Exception:
+            import numpy as _np
+            try:
+                _np.asarray(jax.device_get(leaf.ravel()[:1]))
+            except Exception:
+                pass
+
+
 class timer:
     """Context manager adding wall nanoseconds to a metric
-    (reference: common/timer_helper.rs)."""
+    (reference: common/timer_helper.rs). ``track(x)`` registers kernel
+    outputs to block on before the clock stops, so elapsed_compute means
+    device compute rather than async dispatch (round-3 honest metrics;
+    gate: auron.metrics.device_sync, resolved once per ExecContext and
+    passed as ``sync``)."""
 
-    __slots__ = ("metric", "t0")
+    __slots__ = ("metric", "t0", "_tracked", "sync")
 
-    def __init__(self, metric: Metric):
+    def __init__(self, metric: Metric, sync: bool = True):
         self.metric = metric
+        self.sync = sync
+        self._tracked = None
+
+    def track(self, value):
+        """Register a kernel result to sync on at exit; returns it."""
+        self._tracked = value
+        return value
 
     def __enter__(self):
         self.t0 = time.perf_counter_ns()
         return self
 
     def __exit__(self, *exc):
+        if self._tracked is not None and exc[0] is None and self.sync:
+            _device_sync(self._tracked)
+            self._tracked = None
         self.metric.add(time.perf_counter_ns() - self.t0)
         return False
 
@@ -88,6 +121,17 @@ class ExecContext:
             from auron_tpu.config import get_config
             self.config = get_config()
         return self.config
+
+    @property
+    def device_sync(self) -> bool:
+        """auron.metrics.device_sync resolved once per context (timers are
+        on the hot path; see timer.track)."""
+        cached = getattr(self, "_device_sync", None)
+        if cached is None:
+            from auron_tpu import config as cfg
+            cached = self.conf.get(cfg.METRICS_DEVICE_SYNC)
+            self._device_sync = cached
+        return cached
 
     def metrics_for(self, op_name: str) -> MetricsSet:
         if op_name not in self.metrics:

@@ -209,7 +209,8 @@ class HashJoinOp(PhysicalOp):
 
                 for probe in self.probe.execute(partition, ctx):
                     yield from self._probe_one(probe, side, probe_schema,
-                                               build_schema, elapsed)
+                                               build_schema, elapsed,
+                                               ctx.device_sync)
 
                 if self.join_type in ("right", "full"):
                     yield self._unmatched_build(side, probe_schema,
@@ -240,12 +241,12 @@ class HashJoinOp(PhysicalOp):
 
     # -- helpers ------------------------------------------------------------
     def _probe_one(self, probe: DeviceBatch, side: _BuildSide, probe_schema,
-                   build_schema, elapsed):
+                   build_schema, elapsed, _sync: bool = True):
         cap = probe.capacity
         kern = _probe_count_kernel(self.probe_keys, probe_schema, cap,
                                    side.capacity)
-        with timer(elapsed):
-            h, lo, counts, total = kern(probe, side.hashes)
+        with timer(elapsed, sync=_sync) as t:
+            h, lo, counts, total = t.track(kern(probe, side.hashes))
         total_i = int(total)
 
         ctx = EvalContext()
@@ -256,10 +257,10 @@ class HashJoinOp(PhysicalOp):
                 or total_i > 0:
             out_cap = bucket_rows(max(total_i, 1))
             expand = _expand_kernel(out_cap, cap)
-            with timer(elapsed):
+            with timer(elapsed, sync=_sync) as t:
                 probe_idx, build_idx, in_range = expand(lo, counts)
-                ok = _keys_match(probe_key_cols, probe_idx, side.keys,
-                                 build_idx) & in_range
+                ok = t.track(_keys_match(probe_key_cols, probe_idx, side.keys,
+                                         build_idx) & in_range)
         else:
             probe_idx = build_idx = ok = None
 

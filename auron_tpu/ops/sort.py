@@ -266,16 +266,17 @@ class SortOp(PhysicalOp):
         metrics = ctx.metrics_for(self.name)
         elapsed = metrics.counter("elapsed_compute")
         in_schema = self.child.schema()
+        _sync = ctx.device_sync
         mem = ctx.mem_manager
         spillable = mem is not None and getattr(mem, "spill_manager", None) is not None
 
         def in_mem_stream(batches):
             if not batches:
                 return
-            with timer(elapsed):
+            with timer(elapsed, sync=_sync) as t:
                 merged = _concat_all(batches) if len(batches) > 1 else batches[0]
                 kern = _sort_kernel(self.sort_exprs, in_schema, merged.capacity)
-                out = kern(merged)
+                out = t.track(kern(merged))
             yield out
 
         def external_stream(consumer):

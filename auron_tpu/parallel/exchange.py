@@ -246,6 +246,7 @@ class ShuffleExchangeOp(PhysicalOp):
         write_time = metrics.counter("shuffle_write_total_time")
         n_out = self.num_partitions
         schema = self.child.schema()
+        _sync = ctx.device_sync
         buffer = _ExchangeBuffer(self, ctx.mem_manager, metrics, ctx.conf)
 
         batches = self._input_batches(ctx)
@@ -273,14 +274,14 @@ class ShuffleExchangeOp(PhysicalOp):
         row_offset = 0
         import itertools
         for batch in itertools.chain(pending, batches):
-            with timer(write_time):
+            with timer(write_time, sync=_sync) as t:
                 if isinstance(partitioning, RoundRobinPartitioning):
                     part = RoundRobinPartitioning(n_out, row_offset)
                     pids = part.partition_ids(batch, schema)
                 else:
                     pids = partitioning.partition_ids(batch, schema)
                 kern = _sort_by_pid_kernel(n_out, batch.capacity)
-                sorted_batch, counts = kern(batch, pids)
+                sorted_batch, counts = t.track(kern(batch, pids))
             row_offset += int(batch.num_rows)
             counts_h = np.asarray(counts)
             offsets = np.concatenate(

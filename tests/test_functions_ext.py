@@ -193,13 +193,26 @@ def test_month_math():
     })
     got = run_fn("months_between", rb2, [C(0), C(1)])
     assert got == [1.0, 1.0]   # both-last-day & same-day rules
+    # same day-of-month short-circuits regardless of time of day (Spark)
+    rb3 = pa.record_batch({
+        "a": pa.array([_ts("2023-03-15T12:00:00")], pa.timestamp("us")),
+        "b": pa.array([_ts("2023-02-15T00:00:00")], pa.timestamp("us")),
+    })
+    assert run_fn("months_between", rb3, [C(0), C(1)]) == [1.0]
 
 
 def test_weekofyear_next_day():
-    # known ISO weeks: 2021-01-01 is week 53 (of 2020); 2021-01-04 week 1
+    # known ISO weeks: 2021-01-01 is week 53 (of 2020); 2021-01-04 week 1;
+    # 2019-12-30 rolls forward into week 1 of 2020 (the Dec-28 rule)
     rb = pa.record_batch({"d": pa.array(
         [_d("2021-01-01"), _d("2021-01-04"), _d("2023-07-14")], pa.date32())})
     assert run_fn("weekofyear", rb, [C(0)]) == [53, 1, 28]
+    dates = ["2019-12-30", "2019-12-31", "2024-12-30", "2015-12-28",
+             "2020-12-31", "2016-01-01"]
+    rb2 = pa.record_batch({"d": pa.array([_d(s) for s in dates],
+                                         pa.date32())})
+    exp = [datetime.date.fromisoformat(s).isocalendar()[1] for s in dates]
+    assert run_fn("weekofyear", rb2, [C(0)]) == exp
     got = run_fn("next_day", rb, [C(0), lit("Monday")])
     assert got == [datetime.date(2021, 1, 4), datetime.date(2021, 1, 11),
                    datetime.date(2023, 7, 17)]
@@ -326,6 +339,31 @@ def test_array_functions():
     assert run_fn("array_min", rb, [arr]) == [1, 5, 3]
     assert run_fn("element_at", rb,
                   [arr, lit(-1)]) == [2, None, 4]
+
+
+def test_sort_array_desc_with_padding():
+    """Descending sort over a list with padding slots (max_elems > lens)
+    must keep real elements in the live prefix — regression for the
+    padding-leak found in review."""
+    from auron_tpu.columnar.batch import ListColumn
+    from auron_tpu.columnar.schema import DataType
+    from auron_tpu.exprs.fn_arrays import _sort_array
+    from auron_tpu.exprs.eval import TypedValue
+    import jax.numpy as jnp
+
+    col = ListColumn(
+        values=jnp.asarray([[5, 1, 3, 99], [2, 7, 0, 0]], jnp.int64),
+        elem_valid=jnp.asarray([[True, True, True, False],
+                                [True, True, False, False]]),
+        lens=jnp.asarray([3, 2], jnp.int32),
+        validity=jnp.asarray([True, True]))
+    arg = TypedValue(col, DataType.LIST)
+    expr = ir.ScalarFunction("sort_array",
+                             (C(0), ir.Literal(False, None)))
+    out = _sort_array([arg, None], expr, None, None, None)
+    vals = np.asarray(out.col.values)
+    assert vals[0, :3].tolist() == [5, 3, 1]
+    assert vals[1, :2].tolist() == [7, 2]
 
 
 def test_sort_array_and_getitem():
