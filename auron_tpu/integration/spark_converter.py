@@ -157,6 +157,18 @@ _AGG_FN = {"Sum": "sum", "Min": "min", "Max": "max", "Average": "avg",
            "Count": "count", "First": "first",
            "CollectList": "collect_list", "CollectSet": "collect_set"}
 
+#: per-function argument positions whose kernels require a static literal
+#: (checked at conversion time so non-literal uses fall back cleanly)
+_LITERAL_ARGS = {
+    "repeat": (1,), "lpad": (1, 2), "rpad": (1, 2), "instr": (1,),
+    "locate": (0,), "substring_index": (1, 2), "translate": (1, 2),
+    "space": (0,), "sha2": (1,), "regexp_extract": (1, 2),
+    "regexp_replace": (1, 2), "rlike": (1,), "get_json_object": (1,),
+    "date_format": (1,), "from_unixtime": (1,), "unix_timestamp": (1,),
+    "to_unix_timestamp": (1,), "trunc": (1,), "date_trunc": (0,),
+    "next_day": (1,), "sort_array": (1,), "array_repeat": (1,),
+}
+
 
 class ExprConverter:
     def __init__(self, attrs: list[Attr]):
@@ -215,8 +227,18 @@ class ExprConverter:
                 child=self.convert(e.children[0]),
                 pattern=str(e.children[1].fields.get("value", ""))))
         if cls in _SCALAR_FN:
+            fn = _SCALAR_FN[cls]
+            # functions whose kernels need a static (literal) argument must
+            # reject non-literal args HERE, at conversion time, so the
+            # subtree falls back to the host engine instead of failing the
+            # task at kernel-build time
+            for idx in _LITERAL_ARGS.get(fn, ()):
+                if idx < len(e.children) \
+                        and e.children[idx].simple_name != "Literal":
+                    raise NotImplementedError(
+                        f"{fn}: argument {idx} must be a literal")
             return pb.ExprNode(scalar_function=pb.ScalarFunctionE(
-                name=_SCALAR_FN[cls],
+                name=fn,
                 args=[self.convert(c) for c in e.children]))
         raise NotImplementedError(f"unsupported Spark expression {cls}")
 

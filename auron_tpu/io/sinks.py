@@ -57,6 +57,13 @@ class _FileSinkOp(PhysicalOp):
             pending_rows = 0
             n = 0
             writer = None
+            # per-execute write state: flush sequence restarts at 0 so a
+            # task retry overwrites the previous attempt's fragments, and
+            # every path written this attempt is tracked so a mid-stream
+            # failure leaves NO output (the all-or-nothing contract a
+            # one-shot write had)
+            wstate = {"seq": 0, "paths": []}
+            ok = False
             try:
                 for batch in self.child.execute(partition, ctx):
                     rb = to_arrow(batch, child_schema)
@@ -70,23 +77,34 @@ class _FileSinkOp(PhysicalOp):
                         pending, pending_rows = [], 0
                         with timer(io_time):
                             writer = self._write_chunk(writer, chunk,
-                                                       partition)
+                                                       partition, wstate)
                 if pending:
                     chunk = pa.concat_tables(pending).combine_chunks()
                     with timer(io_time):
-                        writer = self._write_chunk(writer, chunk, partition)
+                        writer = self._write_chunk(writer, chunk, partition,
+                                                   wstate)
+                ok = True
             finally:
                 if writer is not None:
                     with timer(io_time):
                         writer.close()
+                if not ok:
+                    for p in wstate["paths"]:
+                        try:
+                            if os.path.exists(p):
+                                os.unlink(p)
+                        except OSError:
+                            pass
             result = pa.record_batch({"num_rows": pa.array([n], pa.int64())})
             yield to_device(result, capacity=16)[0]
 
         return count_output(stream(), metrics)
 
-    def _write_chunk(self, writer, chunk: pa.Table, partition: int):
+    def _write_chunk(self, writer, chunk: pa.Table, partition: int,
+                     wstate: dict):
         """Write one flushed chunk; returns the (possibly newly opened)
-        long-lived writer, or None for writers that are per-chunk."""
+        long-lived writer, or None for writers that are per-chunk. Must
+        append every file it creates to ``wstate['paths']``."""
         raise NotImplementedError
 
     def __repr__(self):
@@ -101,26 +119,34 @@ class ParquetSinkOp(_FileSinkOp):
                  compression: str = "snappy"):
         super().__init__(child, path, compression)
         self.partition_by = list(partition_by or [])
-        self._flush_seq = 0
 
-    def _write_chunk(self, writer, chunk: pa.Table, partition: int):
+    def _write_chunk(self, writer, chunk: pa.Table, partition: int,
+                     wstate: dict):
         comp = self.compression if self.compression != "none" else None
         if self.partition_by:
             # hive-style dynamic partitions: every flush appends dataset
-            # fragments under path/key=value/
-            seq = self._flush_seq
-            self._flush_seq += 1
+            # fragments under path/key=value/. The sequence is per-execute
+            # so a retry overwrites the previous attempt's fragment names.
+            seq = wstate["seq"]
+            wstate["seq"] += 1
+            collector: list = []
             pq.write_to_dataset(
                 chunk, root_path=self.path, partition_cols=self.partition_by,
                 compression=comp,
                 basename_template=f"part-{partition:05d}-{seq:04d}-{{i}}"
-                                  ".parquet")
+                                  ".parquet",
+                metadata_collector=collector)
+            for md in collector:
+                wstate["paths"].append(os.path.join(self.path,
+                                                    md.row_group(0)
+                                                    .column(0).file_path))
             return None
         if writer is None:
             os.makedirs(self.path, exist_ok=True)
-            writer = pq.ParquetWriter(
-                os.path.join(self.path, f"part-{partition:05d}.parquet"),
-                chunk.schema, compression=comp or "none")
+            target = os.path.join(self.path, f"part-{partition:05d}.parquet")
+            writer = pq.ParquetWriter(target, chunk.schema,
+                                      compression=comp or "none")
+            wstate["paths"].append(target)
         writer.write_table(chunk)
         return writer
 
@@ -134,13 +160,16 @@ class OrcSinkOp(_FileSinkOp):
     def __init__(self, child: PhysicalOp, path: str, compression: str = "zstd"):
         super().__init__(child, path, compression)
 
-    def _write_chunk(self, writer, chunk: pa.Table, partition: int):
+    def _write_chunk(self, writer, chunk: pa.Table, partition: int,
+                     wstate: dict):
         from pyarrow import orc
         if writer is None:
             os.makedirs(self.path, exist_ok=True)
+            target = os.path.join(self.path, f"part-{partition:05d}.orc")
             writer = orc.ORCWriter(
-                os.path.join(self.path, f"part-{partition:05d}.orc"),
+                target,
                 compression=self._ORC_COMPRESSION.get(self.compression,
                                                       self.compression))
+            wstate["paths"].append(target)
         writer.write(chunk)
         return writer

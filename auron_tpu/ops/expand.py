@@ -56,9 +56,10 @@ class ExpandOp(PhysicalOp):
             for batch in self.child.execute(partition, ctx):
                 for proj in self.projections:
                     kern = _project_kernel(proj, in_schema, batch.capacity)
-                    with timer(elapsed):
-                        yield kern(batch, jnp.int32(partition),
-                                   jnp.int64(row_off))
+                    with timer(elapsed, sync=ctx.device_sync) as t:
+                        out = t.track(kern(batch, jnp.int32(partition),
+                                           jnp.int64(row_off)))
+                    yield out
                 row_off += int(batch.num_rows)
 
         return count_output(stream(), metrics)

@@ -188,8 +188,9 @@ class GenerateOp(PhysicalOp):
                         self.generator, tuple(self.required_child_output),
                         self.kind == "posexplode", self.outer,
                         in_schema, batch.capacity)
-                    with timer(elapsed):
-                        yield kern(batch)
+                    with timer(elapsed, sync=ctx.device_sync) as t:
+                        out = t.track(kern(batch))
+                    yield out
                 else:
                     rb = to_arrow(batch, in_schema)
                     out = (self._json_tuple_host(rb, in_schema)

@@ -535,7 +535,7 @@ class SortMergeJoinOp(PhysicalOp):
 
         if jt in ("semi", "anti", "existence"):
             has = counts > 0
-            with timer(elapsed):
+            with timer(elapsed, sync=_sync) as t:
                 if jt == "semi":
                     out = compact(left, has)
                 elif jt == "anti":
@@ -543,6 +543,7 @@ class SortMergeJoinOp(PhysicalOp):
                 else:
                     col = PrimitiveColumn(has, jnp.ones(cap, bool))
                     out = DeviceBatch(left.columns + (col,), left.num_rows)
+                t.track(out)
             if int(out.num_rows) > 0 or jt == "existence":
                 yield out
         elif total_i > 0:

@@ -98,6 +98,38 @@ class TestStreamingSinks:
         assert (tmp_path / "ds" / "k=0").is_dir()
         assert (tmp_path / "ds" / "k=1").is_dir()
 
+    def test_sink_failure_leaves_no_output(self, tmp_path):
+        """Mid-stream child failure must not leave a truncated-but-valid
+        output file behind (all-or-nothing per attempt)."""
+        from auron_tpu.ops.base import PhysicalOp
+
+        class _FailingOp(PhysicalOp):
+            name = "failing"
+
+            def __init__(self, inner, after):
+                self.inner, self.after = inner, after
+
+            def schema(self):
+                return self.inner.schema()
+
+            def execute(self, partition, ctx):
+                def stream():
+                    for i, b in enumerate(self.inner.execute(partition, ctx)):
+                        if i >= self.after:
+                            raise RuntimeError("child blew up")
+                        yield b
+                return stream()
+
+        rb = pa.record_batch({"a": pa.array(np.arange(1000), pa.int64())})
+        conf = cfg.AuronConfig({cfg.SINK_BUFFER_ROWS: 500})
+        sink = ParquetSinkOp(
+            _FailingOp(_scan(rb, capacity=1024, nbatches=6), after=3),
+            str(tmp_path / "boom"))
+        with pytest.raises(RuntimeError):
+            collect(sink, config=conf)
+        # the partial part file (2+ flushed chunks) must be gone
+        assert not (tmp_path / "boom" / "part-00000.parquet").exists()
+
     def test_orc_sink_streams(self, tmp_path):
         rb = pa.record_batch({"a": pa.array(np.arange(500), pa.int64())})
         conf = cfg.AuronConfig({cfg.SINK_BUFFER_ROWS: 400})
