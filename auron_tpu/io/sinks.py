@@ -86,15 +86,17 @@ class _FileSinkOp(PhysicalOp):
                 ok = True
             finally:
                 if writer is not None:
-                    with timer(io_time):
-                        writer.close()
+                    try:
+                        with timer(io_time):
+                            writer.close()
+                    except Exception:
+                        # on the failure path a close() error (e.g. the
+                        # same full disk) must not mask the original
+                        # exception or skip cleanup
+                        if ok:
+                            raise
                 if not ok:
-                    for p in wstate["paths"]:
-                        try:
-                            if os.path.exists(p):
-                                os.unlink(p)
-                        except OSError:
-                            pass
+                    self._cleanup_failed(partition, wstate)
             result = pa.record_batch({"num_rows": pa.array([n], pa.int64())})
             yield to_device(result, capacity=16)[0]
 
@@ -106,6 +108,17 @@ class _FileSinkOp(PhysicalOp):
         long-lived writer, or None for writers that are per-chunk. Must
         append every file it creates to ``wstate['paths']``."""
         raise NotImplementedError
+
+    def _cleanup_failed(self, partition: int, wstate: dict) -> None:
+        """All-or-nothing per attempt: remove everything this attempt
+        wrote. Tracked paths first; subclasses extend for files a failed
+        write call may have created before raising."""
+        for p in wstate["paths"]:
+            try:
+                if os.path.exists(p):
+                    os.unlink(p)
+            except OSError:
+                pass
 
     def __repr__(self):
         return f"{type(self).__name__}[{self.path}]"
@@ -149,6 +162,28 @@ class ParquetSinkOp(_FileSinkOp):
             wstate["paths"].append(target)
         writer.write_table(chunk)
         return writer
+
+    def _cleanup_failed(self, partition: int, wstate: dict) -> None:
+        super()._cleanup_failed(partition, wstate)
+        if not self.partition_by or not os.path.isdir(self.path):
+            return
+        # a write_to_dataset call that raised mid-write may have created
+        # fragments never reported to the collector; this attempt's (and
+        # any previous attempt's) fragments all carry this partition's
+        # basename prefix, so a prefix sweep restores all-or-nothing
+        prefix = f"part-{partition:05d}-"
+        for dirpath, _dirs, files in os.walk(self.path, topdown=False):
+            for f in files:
+                if f.startswith(prefix):
+                    try:
+                        os.unlink(os.path.join(dirpath, f))
+                    except OSError:
+                        pass
+            try:
+                if dirpath != self.path and not os.listdir(dirpath):
+                    os.rmdir(dirpath)
+            except OSError:
+                pass
 
 
 class OrcSinkOp(_FileSinkOp):

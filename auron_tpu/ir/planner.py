@@ -286,13 +286,31 @@ class PhysicalPlanner:
         raise NotImplementedError(f"partitioning {p.kind!r}")
 
     def _plan_shuffle_writer(self, n: pb.ShuffleWriterNode) -> PhysicalOp:
-        from auron_tpu.parallel.exchange import ShuffleExchangeOp
-        op = ShuffleExchangeOp(self.create_plan(n.child),
-                               self._parse_partitioning(n.partitioning),
-                               input_partitions=n.input_partitions or 1)
+        if n.rss_root:
+            # RSS tier: push partition frames to the host shuffle service
+            # so other hosts can read them (exchange.RssShuffleExchangeOp)
+            from auron_tpu.parallel.exchange import RssShuffleExchangeOp
+            from auron_tpu.parallel.shuffle_service import FileShuffleService
+            op = RssShuffleExchangeOp(
+                self.create_plan(n.child),
+                self._parse_partitioning(n.partitioning),
+                FileShuffleService(n.rss_root), n.shuffle_id,
+                input_partitions=n.input_partitions or 1)
+        else:
+            from auron_tpu.parallel.exchange import ShuffleExchangeOp
+            op = ShuffleExchangeOp(self.create_plan(n.child),
+                                   self._parse_partitioning(n.partitioning),
+                                   input_partitions=n.input_partitions or 1)
         if n.output_resource_id:
             self.ctx.put_resource(n.output_resource_id, op)
         return op
+
+    def _plan_rss_shuffle_read(self, n: pb.RssShuffleReadNode) -> PhysicalOp:
+        from auron_tpu.parallel.exchange import RssShuffleReadOp
+        from auron_tpu.parallel.shuffle_service import FileShuffleService
+        return RssShuffleReadOp(FileShuffleService(n.rss_root), n.shuffle_id,
+                                serde.parse_schema(n.schema),
+                                n.num_partitions or 1)
 
     def _plan_broadcast_exchange(self, n: pb.BroadcastExchangeNode) -> PhysicalOp:
         from auron_tpu.parallel.exchange import BroadcastExchangeOp

@@ -310,6 +310,179 @@ class ShuffleExchangeOp(PhysicalOp):
                 f"{self.input_partitions}->{self.num_partitions}]")
 
 
+class RssShuffleExchangeOp(PhysicalOp):
+    """Shuffle through the host shuffle service (the RSS tier, reference:
+    shuffle/rss.rs + rss_shuffle_writer_exec.rs): the map side pushes
+    per-partition serialized frames to shared storage instead of keeping
+    buckets device-resident, so shuffle size is bounded by storage, not
+    HBM, and reducers on OTHER HOSTS read the same shuffle through their
+    own service instance (see RssShuffleReadOp)."""
+
+    name = "rss_shuffle_exchange"
+
+    def __init__(self, child: PhysicalOp, partitioning, service,
+                 shuffle_id: int, input_partitions: int = 1):
+        self.child = child
+        self.partitioning = partitioning
+        self.service = service
+        self.shuffle_id = shuffle_id
+        self.input_partitions = input_partitions
+        self._lock = threading.Lock()
+        self._written = False
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def schema(self) -> Schema:
+        return self.child.schema()
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partitioning.num_partitions
+
+    def _materialize(self, ctx: ExecContext) -> None:
+        from auron_tpu import config as cfg
+        from auron_tpu.columnar.serde import (batch_to_host,
+                                              serialize_host_batch,
+                                              slice_host_batch)
+        metrics = ctx.metrics_for(self.name)
+        write_time = metrics.counter("shuffle_write_total_time")
+        _sync = ctx.device_sync
+        n_out = self.num_partitions
+        schema = self.child.schema()
+        codec_level = ctx.conf.get(cfg.SPILL_CODEC_LEVEL)
+        partitioning = self.partitioning
+        # invalidate any previous attempt's manifest so readers can't mix
+        # stale map outputs into this attempt
+        self.service.begin_shuffle(self.shuffle_id)
+
+        for in_p in range(self.input_partitions):
+            map_ctx = ExecContext(
+                stage_id=ctx.stage_id, partition_id=in_p,
+                num_partitions=self.input_partitions,
+                metrics=ctx.metrics, mem_manager=ctx.mem_manager,
+                config=ctx.config)
+            batches = self.child.execute(in_p, map_ctx)
+            pending: list[DeviceBatch] = []
+            if in_p == 0 and isinstance(partitioning, RangePartitioning) \
+                    and not partitioning.bounds:
+                # sample bounds from map 0's leading batches; all maps of
+                # this shuffle then share the same bounds (the reference
+                # samples once, driver-side)
+                from auron_tpu.parallel.partitioning import \
+                    compute_range_bounds
+                sampled = 0
+                for batch in batches:
+                    pending.append(batch)
+                    sampled += int(batch.num_rows)
+                    if sampled >= _RANGE_SAMPLE_ROWS:
+                        break
+                bounds = compute_range_bounds(
+                    pending, list(partitioning.sort_orders), schema,
+                    partitioning.num_partitions)
+                partitioning = RangePartitioning(
+                    partitioning.sort_orders, partitioning.num_partitions,
+                    bounds)
+                self.partitioning = partitioning
+
+            writer = self.service.partition_writer(self.shuffle_id, in_p,
+                                                   n_out)
+            row_offset = 0
+            import itertools
+            try:
+                for batch in itertools.chain(pending, batches):
+                    with timer(write_time, sync=_sync) as t:
+                        if isinstance(partitioning, RoundRobinPartitioning):
+                            part = RoundRobinPartitioning(n_out, row_offset)
+                            pids = part.partition_ids(batch, schema)
+                        else:
+                            pids = partitioning.partition_ids(batch, schema)
+                        kern = _sort_by_pid_kernel(n_out, batch.capacity)
+                        sorted_batch, counts = t.track(kern(batch, pids))
+                    row_offset += int(batch.num_rows)
+                    counts_h = np.asarray(counts)
+                    offsets = np.concatenate(
+                        [np.zeros(1, np.int64), np.cumsum(counts_h)])
+                    n = int(sorted_batch.num_rows)
+                    with timer(write_time):
+                        host = batch_to_host(sorted_batch, n)
+                        for p in range(n_out):
+                            lo, hi = int(offsets[p]), int(offsets[p + 1])
+                            if hi > lo:
+                                writer.write(p, serialize_host_batch(
+                                    slice_host_batch(host, lo, hi),
+                                    codec_level=codec_level))
+                writer.commit()
+            except BaseException:
+                writer.abort()
+                raise
+        self.service.commit_shuffle(self.shuffle_id, self.input_partitions)
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        with self._lock:
+            if not self._written:
+                self._materialize(ctx)
+                self._written = True
+        metrics = ctx.metrics_for(self.name + "_read")
+        read_time = metrics.counter("shuffle_read_total_time")
+
+        def stream():
+            from auron_tpu.columnar.serde import (deserialize_host_batch,
+                                                  host_to_batch)
+            for frame in self.service.partition_frames(self.shuffle_id,
+                                                       partition):
+                with timer(read_time):
+                    host, _ = deserialize_host_batch(frame)
+                    if host.num_rows:
+                        yield host_to_batch(host, bucket_rows(host.num_rows))
+
+        return count_output(stream(), metrics)
+
+    def __repr__(self):
+        return (f"RssShuffleExchangeOp[{type(self.partitioning).__name__} "
+                f"{self.input_partitions}->{self.num_partitions} "
+                f"shuffle={self.shuffle_id}]")
+
+
+class RssShuffleReadOp(PhysicalOp):
+    """Reducer-side read of a committed RSS shuffle — the entry point for
+    a DIFFERENT host than the one that wrote (reference:
+    AuronCelebornShuffleReader): needs only the shared service root, the
+    shuffle id, and the schema."""
+
+    name = "rss_shuffle_read"
+
+    def __init__(self, service, shuffle_id: int, schema: Schema,
+                 num_partitions: int):
+        self.service = service
+        self.shuffle_id = shuffle_id
+        self._schema = schema
+        self.num_partitions = num_partitions
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        metrics = ctx.metrics_for(self.name)
+        read_time = metrics.counter("shuffle_read_total_time")
+
+        def stream():
+            from auron_tpu.columnar.serde import (deserialize_host_batch,
+                                                  host_to_batch)
+            for frame in self.service.partition_frames(self.shuffle_id,
+                                                       partition):
+                with timer(read_time):
+                    host, _ = deserialize_host_batch(frame)
+                    if host.num_rows:
+                        yield host_to_batch(host, bucket_rows(host.num_rows))
+
+        return count_output(stream(), metrics)
+
+    def __repr__(self):
+        return f"RssShuffleReadOp[shuffle={self.shuffle_id}]"
+
+
 class BroadcastExchangeOp(PhysicalOp):
     """Collect the child once, replay to every consumer partition
     (reference: NativeBroadcastExchangeBase collect→IPC→re-expose,

@@ -1,0 +1,214 @@
+"""Host shuffle service: the RSS (remote-shuffle-service) tier.
+
+The reference pushes shuffle data to Celeborn/Uniffle through
+`RssPartitionWriterBase` — map tasks stream per-partition byte chunks to a
+service, reducers fetch one merged stream per partition (reference:
+datafusion-ext-plans/src/shuffle/rss.rs,
+thirdparty/auron-celeborn-0.6/.../CelebornPartitionWriter.scala). On a TPU
+pod the intra-slice exchange rides ICI all-to-all
+(parallel/mesh_exchange.py); this tier is the complement for data that
+exceeds slice HBM or must cross hosts without ICI: partition frames are
+pushed to a service root on shared storage (NFS/FUSE-mounted object
+store — the deployment substrate TPU pods already have for checkpoints),
+and any host can read any partition back.
+
+Layout (one directory per shuffle):
+    root/shuffle_{id}/map_{m}.part        in-progress map output
+    root/shuffle_{id}/map_{m}.data        committed map output
+    root/shuffle_{id}/manifest           shuffle-level commit marker
+
+A map output file is a sequence of length-prefixed frames grouped by
+partition, followed by a trailer [per partition: run count + (offset,
+length) runs] — the reference's one-data-file + partition-offset index
+(sort_repartitioner.rs:151+). Commits are atomic renames at two levels:
+per map output, and the shuffle-level ``manifest`` naming the exact map
+count, so readers never observe partial attempts OR stale map outputs
+from a previous attempt with different parallelism. Map retries overwrite
+by map id (idempotent, the engine's partition-granular recovery contract,
+SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator
+
+_TRAILER_MAGIC = b"AURS"
+
+
+class RssPartitionWriter:
+    """Push-based writer for ONE map task's output across all partitions.
+
+    Frames are buffered per partition and flushed to the map file grouped
+    by partition id; `commit()` writes the offset trailer and atomically
+    renames. The buffer bound makes host memory independent of map-output
+    size (the push-based contract of the reference's RSS writers)."""
+
+    def __init__(self, service: "FileShuffleService", shuffle_id: int,
+                 map_id: int, num_partitions: int,
+                 buffer_bytes: int = 8 << 20):
+        self.service = service
+        self.num_partitions = num_partitions
+        self.buffer_bytes = buffer_bytes
+        self._dir = service._shuffle_dir(shuffle_id)
+        os.makedirs(self._dir, exist_ok=True)
+        self._tmp = os.path.join(self._dir, f"map_{map_id}.part")
+        self._final = os.path.join(self._dir, f"map_{map_id}.data")
+        self._file = open(self._tmp, "wb")
+        #: per-partition buffered frames awaiting a flush
+        self._buffers: dict[int, list[bytes]] = {}
+        self._buffered = 0
+        #: per-partition list of (offset, length) runs already on disk
+        self._runs: dict[int, list[tuple[int, int]]] = {}
+        self._pos = 0
+        self._committed = False
+
+    def write(self, partition: int, frame: bytes) -> None:
+        assert not self._committed
+        self._buffers.setdefault(partition, []).append(frame)
+        self._buffered += len(frame)
+        if self._buffered >= self.buffer_bytes:
+            self._flush()
+
+    def _flush(self) -> None:
+        for p in sorted(self._buffers):
+            frames = self._buffers[p]
+            start = self._pos
+            for fr in frames:
+                self._file.write(struct.pack("<I", len(fr)))
+                self._file.write(fr)
+                self._pos += 4 + len(fr)
+            self._runs.setdefault(p, []).append((start, self._pos - start))
+        self._buffers = {}
+        self._buffered = 0
+
+    def commit(self) -> None:
+        """Flush, append the partition-run trailer, atomically publish."""
+        self._flush()
+        trailer_start = self._pos
+        # trailer: per partition, run count then (offset, length) pairs
+        for p in range(self.num_partitions):
+            runs = self._runs.get(p, [])
+            self._file.write(struct.pack("<I", len(runs)))
+            for off, ln in runs:
+                self._file.write(struct.pack("<QQ", off, ln))
+        self._file.write(struct.pack("<QI", trailer_start,
+                                     self.num_partitions))
+        self._file.write(_TRAILER_MAGIC)
+        self._file.close()
+        os.replace(self._tmp, self._final)   # atomic commit
+        self._committed = True
+
+    def abort(self) -> None:
+        if not self._committed:
+            try:
+                self._file.close()
+                os.unlink(self._tmp)
+            except OSError:
+                pass
+
+
+class FileShuffleService:
+    """Shared-storage shuffle service. Each host creates its own instance
+    over the same root; no coordination beyond the filesystem's atomic
+    renames is needed."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _shuffle_dir(self, shuffle_id: int) -> str:
+        return os.path.join(self.root, f"shuffle_{shuffle_id}")
+
+    def partition_writer(self, shuffle_id: int, map_id: int,
+                         num_partitions: int,
+                         buffer_bytes: int = 8 << 20) -> RssPartitionWriter:
+        return RssPartitionWriter(self, shuffle_id, map_id, num_partitions,
+                                  buffer_bytes)
+
+    # -- shuffle-level commit ------------------------------------------------
+
+    def begin_shuffle(self, shuffle_id: int) -> None:
+        """Invalidate any previous attempt: a re-planned stage (different
+        map parallelism, AQE) must not leave stale map outputs visible."""
+        d = self._shuffle_dir(shuffle_id)
+        try:
+            os.unlink(os.path.join(d, "manifest"))
+        except OSError:
+            pass
+
+    def commit_shuffle(self, shuffle_id: int, num_maps: int) -> None:
+        d = self._shuffle_dir(shuffle_id)
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, "manifest.part")
+        with open(tmp, "w") as f:
+            f.write(str(num_maps))
+        os.replace(tmp, os.path.join(d, "manifest"))
+
+    def map_outputs(self, shuffle_id: int) -> list[str]:
+        """Committed map output files present on storage (diagnostics;
+        readers use :meth:`committed_maps`, which honors the manifest)."""
+        d = self._shuffle_dir(shuffle_id)
+        if not os.path.isdir(d):
+            return []
+        return sorted(os.path.join(d, f) for f in os.listdir(d)
+                      if f.endswith(".data"))
+
+    def committed_maps(self, shuffle_id: int) -> list[str]:
+        """Paths of EXACTLY the map outputs the manifest names; [] when the
+        shuffle is not (yet) committed."""
+        d = self._shuffle_dir(shuffle_id)
+        try:
+            with open(os.path.join(d, "manifest")) as f:
+                num_maps = int(f.read().strip())
+        except (OSError, ValueError):
+            return []
+        return [os.path.join(d, f"map_{m}.data") for m in range(num_maps)]
+
+    # -- read side ------------------------------------------------------------
+
+    def partition_frames(self, shuffle_id: int,
+                         partition: int) -> Iterator[bytes]:
+        """All committed map outputs' frames for one partition, reading
+        only that partition's byte runs (offset-indexed fetch). One read
+        for the whole trailer + one per run — no per-entry round trips
+        (matters on NFS/FUSE substrates)."""
+        for path in self.committed_maps(shuffle_id):
+            with open(path, "rb") as f:
+                # fixed footer: <QI trailer_start num_partitions> + magic
+                foot = 12 + len(_TRAILER_MAGIC)
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(size - foot)
+                tail = f.read(foot)
+                assert tail[-4:] == _TRAILER_MAGIC, f"corrupt map output {path}"
+                trailer_start, num_parts = struct.unpack("<QI", tail[:12])
+                if partition >= num_parts:
+                    continue
+                f.seek(trailer_start)
+                trailer = f.read(size - foot - trailer_start)
+                pos = 0
+                runs = []
+                for p in range(num_parts):
+                    (nruns,) = struct.unpack_from("<I", trailer, pos)
+                    pos += 4
+                    if p == partition:
+                        runs = [struct.unpack_from("<QQ", trailer,
+                                                   pos + 16 * r)
+                                for r in range(nruns)]
+                        break
+                    pos += 16 * nruns
+                for off, ln in runs:
+                    f.seek(off)
+                    blob = f.read(ln)
+                    bpos = 0
+                    while bpos < ln:
+                        (flen,) = struct.unpack_from("<I", blob, bpos)
+                        bpos += 4
+                        yield blob[bpos:bpos + flen]
+                        bpos += flen
+
+    def delete_shuffle(self, shuffle_id: int) -> None:
+        import shutil
+        shutil.rmtree(self._shuffle_dir(shuffle_id), ignore_errors=True)
