@@ -130,7 +130,19 @@ class PhysicalPlanner:
         return KafkaScanOp(topic=n.topic, bootstrap=n.bootstrap,
                            schema=serde.parse_schema(n.schema),
                            fmt=n.format or "json",
-                           max_batches=n.max_batches or None)
+                           max_batches=n.max_batches or None,
+                           group_id=n.group_id or None)
+
+    def _plan_streaming_window_agg(
+            self, n: pb.StreamingWindowAggNode) -> PhysicalOp:
+        from auron_tpu.streaming.window import StreamingWindowAggOp
+        return StreamingWindowAggOp(
+            self.create_plan(n.child), n.time_col, n.window_us,
+            [serde.parse_expr(e) for e in n.group_exprs],
+            [serde.parse_agg(a) for a in n.aggs],
+            ooo_bound_us=n.ooo_bound_us,
+            group_names=list(n.group_names) or None,
+            agg_names=list(n.agg_names) or None)
 
     # -- row transforms -----------------------------------------------------
 

@@ -41,6 +41,8 @@ class MockBroker:
     def __init__(self):
         self._lock = threading.Lock()
         self._topics: dict[str, list[list[bytes]]] = {}
+        #: consumer-group committed offsets: (group, topic, partition) → off
+        self._commits: dict[tuple[str, str, int], int] = {}
 
     def create_topic(self, topic: str, num_partitions: int = 1) -> None:
         with self._lock:
@@ -65,6 +67,17 @@ class MockBroker:
             if log is None or partition >= len(log):
                 return []
             return list(log[partition][offset:offset + max_messages])
+
+    def commit(self, group: str, topic: str, partition: int,
+               offset: int) -> None:
+        """Record a consumer group's next-read offset (Kafka offset-commit
+        semantics: the committed offset is the NEXT message to consume)."""
+        with self._lock:
+            self._commits[(group, topic, partition)] = offset
+
+    def committed(self, group: str, topic: str, partition: int) -> int:
+        with self._lock:
+            return self._commits.get((group, topic, partition), 0)
 
     def end_offset(self, topic: str, partition: int) -> int:
         with self._lock:

@@ -31,7 +31,8 @@ class KafkaScanOp(PhysicalOp):
 
     def __init__(self, topic: str, bootstrap: str, schema: Schema,
                  fmt: str = "json", max_batches: Optional[int] = None,
-                 batch_rows: int = DEFAULT_BATCH_CAPACITY):
+                 batch_rows: int = DEFAULT_BATCH_CAPACITY,
+                 group_id: Optional[str] = None):
         if fmt not in DECODERS:
             raise ValueError(f"unknown kafka row format {fmt!r} "
                              f"(known: {sorted(DECODERS)})")
@@ -41,6 +42,10 @@ class KafkaScanOp(PhysicalOp):
         self.fmt = fmt
         self.max_batches = max_batches
         self.batch_rows = batch_rows
+        #: non-None: resume from the group's committed offset and commit
+        #: after each consumed poll window (at-least-once on restart —
+        #: Kafka consumer-group semantics)
+        self.group_id = group_id
 
     @property
     def children(self):
@@ -55,7 +60,8 @@ class KafkaScanOp(PhysicalOp):
         broker = MockBroker.get(self.bootstrap)
 
         def stream():
-            offset = 0
+            offset = broker.committed(self.group_id, self.topic, partition) \
+                if self.group_id else 0
             emitted = 0
             # bounded mode: drain to the end offset captured at start (a
             # snapshot read); max_batches additionally caps emitted batches
@@ -69,15 +75,24 @@ class KafkaScanOp(PhysicalOp):
                     break
                 offset += len(msgs)
                 rb = decoder(msgs, self._schema)
-                if rb.num_rows == 0:
-                    continue
-                for off in range(0, rb.num_rows, self.batch_rows):
-                    yield to_device(
-                        rb.slice(off, min(self.batch_rows, rb.num_rows - off)),
-                        capacity=self.batch_rows)[0]
-                    emitted += 1
-                    if self.max_batches and emitted >= self.max_batches:
-                        return
+                if rb.num_rows:
+                    for off in range(0, rb.num_rows, self.batch_rows):
+                        yield to_device(
+                            rb.slice(off,
+                                     min(self.batch_rows,
+                                         rb.num_rows - off)),
+                            capacity=self.batch_rows)[0]
+                        emitted += 1
+                        if self.max_batches and emitted >= self.max_batches:
+                            # window partially delivered: do NOT commit it
+                            return
+                # commit AFTER the poll window has been delivered
+                # downstream (the generator resumed past every yield):
+                # a crash before this point replays the window on restart
+                # — at-least-once, the Kafka consumer-group contract
+                if self.group_id:
+                    broker.commit(self.group_id, self.topic, partition,
+                                  offset)
 
         return count_output(stream(), metrics)
 

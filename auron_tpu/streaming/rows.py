@@ -59,4 +59,7 @@ def decode_proto_rows(messages: Iterable[bytes], schema: Schema) -> pa.RecordBat
     return pa.record_batch(cols, schema=arrow_schema)
 
 
-DECODERS = {"json": decode_json_rows, "proto_rows": decode_proto_rows}
+from auron_tpu.streaming.pbrows import decode_pb_rows  # noqa: E402
+
+DECODERS = {"json": decode_json_rows, "proto_rows": decode_proto_rows,
+            "pb": decode_pb_rows}

@@ -1,0 +1,259 @@
+"""Round-3 streaming: protobuf wire rows, offset commit/resume, and
+event-time tumbling windows with watermarks (BASELINE.md's "Flink-style
+streaming windowed aggregate"; reference contracts:
+flink/pb_deserializer.rs, kafka_scan_exec.rs offset handling)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu.columnar.arrow_bridge import schema_from_arrow, to_arrow
+from auron_tpu.columnar.schema import DataType, Field, Schema
+from auron_tpu.exprs import ir
+from auron_tpu.ops.base import ExecContext
+from auron_tpu.streaming.broker import MockBroker
+from auron_tpu.streaming.kafka import KafkaScanOp
+from auron_tpu.streaming.pbrows import (decode_pb_rows, encode_pb_row,
+                                        decode_pb_row)
+from auron_tpu.streaming.window import StreamingWindowAggOp
+
+C = ir.ColumnRef
+
+SCHEMA = Schema((
+    Field("ts", DataType.TIMESTAMP_US, True),
+    Field("k", DataType.INT64, True),
+    Field("v", DataType.FLOAT64, True),
+    Field("tag", DataType.STRING, True),
+))
+
+
+class TestPbRows:
+    def test_roundtrip_all_types(self):
+        rows = [
+            {"ts": 1_000_000, "k": -42, "v": 3.5, "tag": "alpha"},
+            {"ts": 2_000_000, "k": 7, "v": -0.25, "tag": ""},
+            {"ts": None, "k": None, "v": None, "tag": None},   # all missing
+            {"ts": 0, "k": 2 ** 62, "v": 1e300, "tag": "日本語"},
+        ]
+        msgs = [encode_pb_row(r, SCHEMA) for r in rows]
+        rb = decode_pb_rows(msgs, SCHEMA)
+        assert rb.column("k").to_pylist() == [-42, 7, None, 2 ** 62]
+        assert rb.column("v").to_pylist() == [3.5, -0.25, None, 1e300]
+        assert rb.column("tag").to_pylist() == ["alpha", "", None, "日本語"]
+        assert rb.column("ts").to_pylist()[0].timestamp() == 1.0
+
+    def test_unknown_fields_skipped(self):
+        import struct
+        # field 9 (unknown): varint; field 10: length-delimited
+        extra = bytearray(encode_pb_row({"k": 5}, SCHEMA))
+        extra += bytes([(9 << 3) | 0]); extra += bytes([0x96, 0x01])
+        extra += bytes([(10 << 3) | 2]); extra += bytes([3]) + b"xyz"
+        vals = decode_pb_row(bytes(extra), SCHEMA, 4)
+        assert vals[1] == 5 and vals[0] is None and vals[3] is None
+
+    def test_wire_type_mismatch_ignored(self):
+        # field 2 (k, expects varint) sent as length-delimited → null
+        msg = bytes([(2 << 3) | 2, 2]) + b"ab"
+        vals = decode_pb_row(msg, SCHEMA, 4)
+        assert vals[1] is None
+
+
+class TestOffsetCommit:
+    def test_resume_from_committed(self):
+        MockBroker.reset("b1")
+        broker = MockBroker.get("b1")
+        broker.create_topic("t", 1)
+        import json
+        for i in range(10):
+            broker.produce("t", json.dumps({"ts": i, "k": i, "v": 1.0,
+                                            "tag": "x"}).encode())
+        op = KafkaScanOp("t", "b1", SCHEMA, fmt="json", group_id="g1",
+                         batch_rows=4)
+        ks = []
+        for b in op.execute(0, ExecContext()):
+            ks.extend(to_arrow(b, SCHEMA).column("k").to_pylist())
+        assert ks == list(range(10))
+        # produce more; a new scan with the same group resumes past 10
+        for i in range(10, 14):
+            broker.produce("t", json.dumps({"ts": i, "k": i, "v": 1.0,
+                                            "tag": "x"}).encode())
+        op2 = KafkaScanOp("t", "b1", SCHEMA, fmt="json", group_id="g1",
+                          batch_rows=4)
+        ks2 = []
+        for b in op2.execute(0, ExecContext()):
+            ks2.extend(to_arrow(b, SCHEMA).column("k").to_pylist())
+        assert ks2 == [10, 11, 12, 13]
+
+
+class TestSemanticsFixes:
+    def test_decimal_as_string_roundtrip(self):
+        from decimal import Decimal
+        sch = Schema((Field("d", DataType.DECIMAL, True, 10, 2),))
+        msgs = [encode_pb_row({"d": Decimal("3.50")}, sch),
+                encode_pb_row({"d": "12.25"}, sch),
+                encode_pb_row({}, sch)]
+        rb = decode_pb_rows(msgs, sch)
+        assert rb.column("d").to_pylist() == [Decimal("3.50"),
+                                              Decimal("12.25"), None]
+
+    def test_commit_is_after_consumption(self):
+        """At-least-once: a poll window's offset commits only after the
+        consumer has drained its batches — stopping mid-stream must leave
+        the undrained window uncommitted."""
+        import json
+        MockBroker.reset("alo")
+        broker = MockBroker.get("alo")
+        broker.create_topic("t", 1)
+        for i in range(8):
+            broker.produce("t", json.dumps({"ts": i, "k": i, "v": 1.0,
+                                            "tag": "x"}).encode())
+        op = KafkaScanOp("t", "alo", SCHEMA, fmt="json", group_id="g",
+                         batch_rows=4)
+        it = op.execute(0, ExecContext())
+        next(it)        # first poll window delivered
+        it.close()      # consumer dies before requesting more
+        # window 1's commit only happens when the generator resumes past
+        # its yield — which it never did
+        assert broker.committed("g", "t", 0) == 0
+        # full drain commits everything
+        op2 = KafkaScanOp("t", "alo", SCHEMA, fmt="json", group_id="g",
+                          batch_rows=4)
+        list(op2.execute(0, ExecContext()))
+        assert broker.committed("g", "t", 0) == 8
+
+    def test_late_row_into_never_seen_window_dropped(self):
+        """A late row for a window that never held on-time rows must be
+        dropped, not resurrected as a fresh window (Flink lateness is
+        against the watermark, not fired-window membership)."""
+        MockBroker.reset("w5")
+        broker = MockBroker.get("w5")
+        broker.create_topic("t", 1)
+        SEC = 1_000_000
+        rows = [{"ts": 10 * SEC, "k": 0, "v": 1.0, "tag": "x"},
+                {"ts": 11 * SEC, "k": 0, "v": 2.0, "tag": "x"},
+                # late, and window [0,5) never had any on-time row
+                {"ts": 1 * SEC, "k": 0, "v": 99.0, "tag": "late"}]
+        _produce_pb(broker, "t", rows[:2])
+        _produce_pb(broker, "t", rows[2:])
+        scan = KafkaScanOp("t", "w5", SCHEMA, fmt="pb", batch_rows=2)
+        op = StreamingWindowAggOp(
+            scan, time_col=0, window_us=5 * SEC,
+            group_exprs=[], aggs=[ir.AggFunction("sum", C(2))],
+            agg_names=["sv"])
+        ctx = ExecContext()
+        out = []
+        for b in op.execute(0, ctx):
+            out.extend(to_arrow(b, op.schema()).to_pylist())
+        starts = {r["window_start"].timestamp() for r in out}
+        assert 0.0 not in starts, out
+        assert ctx.metrics_snapshot()["streaming_window_agg"]["late_rows"] == 1
+
+
+def _produce_pb(broker, topic, rows, partition=0):
+    for r in rows:
+        broker.produce(topic, encode_pb_row(r, SCHEMA), partition)
+
+
+class TestStreamingWindow:
+    def _out_rows(self, op):
+        rows = []
+        for b in op.execute(0, ExecContext()):
+            rows.extend(to_arrow(b, op.schema()).to_pylist())
+        return rows
+
+    def test_tumbling_window_sums(self):
+        MockBroker.reset("w1")
+        broker = MockBroker.get("w1")
+        broker.create_topic("t", 1)
+        SEC = 1_000_000
+        rows = [{"ts": t * SEC, "k": t % 2, "v": float(t), "tag": "x"}
+                for t in range(10)]          # windows [0,5), [5,10)
+        _produce_pb(broker, "t", rows)
+        scan = KafkaScanOp("t", "w1", SCHEMA, fmt="pb", batch_rows=3)
+        op = StreamingWindowAggOp(
+            scan, time_col=0, window_us=5 * SEC,
+            group_exprs=[C(1)], aggs=[ir.AggFunction("sum", C(2))],
+            group_names=["k"], agg_names=["sv"])
+        got = self._out_rows(op)
+        by = {(r["window_start"].timestamp(), r["k"]): r["sv"] for r in got}
+        assert by[(0.0, 0)] == 0 + 2 + 4
+        assert by[(0.0, 1)] == 1 + 3
+        assert by[(5.0, 0)] == 6 + 8
+        assert by[(5.0, 1)] == 5 + 7 + 9
+
+    def test_watermark_fires_and_drops_late(self):
+        MockBroker.reset("w2")
+        broker = MockBroker.get("w2")
+        broker.create_topic("t", 1)
+        SEC = 1_000_000
+        # in-order rows push the watermark past window [0,5)'s end; then a
+        # late row for window 0 arrives and must be dropped
+        rows = ([{"ts": t * SEC, "k": 0, "v": 1.0, "tag": "x"}
+                 for t in range(0, 8)] +
+                [{"ts": 1 * SEC, "k": 0, "v": 100.0, "tag": "late"}])
+        _produce_pb(broker, "t", rows)
+        scan = KafkaScanOp("t", "w2", SCHEMA, fmt="pb", batch_rows=8)
+        op = StreamingWindowAggOp(
+            scan, time_col=0, window_us=5 * SEC,
+            group_exprs=[], aggs=[ir.AggFunction("sum", C(2))],
+            agg_names=["sv"])
+        ctx = ExecContext()
+        rows_out = []
+        for b in op.execute(0, ctx):
+            rows_out.extend(to_arrow(b, op.schema()).to_pylist())
+        sums = {r["window_start"].timestamp(): r["sv"] for r in rows_out}
+        assert sums[0.0] == 5.0          # late row NOT included
+        assert sums[5.0] == 3.0
+        snap = ctx.metrics_snapshot()["streaming_window_agg"]
+        assert snap["late_rows"] == 1
+        assert snap["fired_windows"] == 2
+
+    def test_out_of_order_within_bound_included(self):
+        MockBroker.reset("w3")
+        broker = MockBroker.get("w3")
+        broker.create_topic("t", 1)
+        SEC = 1_000_000
+        # ooo bound 3s: ts=6 then a disorderly ts=4 row — watermark at
+        # 6-3=3 < 5, so window [0,5) has NOT fired and the row counts
+        rows = [{"ts": 6 * SEC, "k": 0, "v": 1.0, "tag": "x"},
+                {"ts": 4 * SEC, "k": 0, "v": 10.0, "tag": "x"},
+                {"ts": 12 * SEC, "k": 0, "v": 2.0, "tag": "x"}]
+        _produce_pb(broker, "t", rows)
+        scan = KafkaScanOp("t", "w3", SCHEMA, fmt="pb", batch_rows=1)
+        op = StreamingWindowAggOp(
+            scan, time_col=0, window_us=5 * SEC,
+            group_exprs=[], aggs=[ir.AggFunction("sum", C(2))],
+            agg_names=["sv"], ooo_bound_us=3 * SEC)
+        got = self._out_rows(op)
+        sums = {r["window_start"].timestamp(): r["sv"] for r in got}
+        assert sums[0.0] == 10.0
+        assert sums[5.0] == 1.0
+        assert sums[10.0] == 2.0
+
+    def test_proto_plan_streaming_window(self):
+        from auron_tpu.ir import pb
+        from auron_tpu.ir.planner import PlannerContext, plan_from_bytes
+        from auron_tpu.ir.serde import (agg_to_proto, expr_to_proto,
+                                        schema_to_proto)
+        MockBroker.reset("w4")
+        broker = MockBroker.get("w4")
+        broker.create_topic("t", 1)
+        SEC = 1_000_000
+        _produce_pb(broker, "t",
+                    [{"ts": t * SEC, "k": 0, "v": 1.0, "tag": "x"}
+                     for t in range(6)])
+        node = pb.PlanNode(streaming_window_agg=pb.StreamingWindowAggNode(
+            child=pb.PlanNode(kafka_scan=pb.KafkaScanNode(
+                topic="t", bootstrap="w4",
+                schema=schema_to_proto(SCHEMA), format="pb")),
+            time_col=0, window_us=5 * SEC,
+            aggs=[agg_to_proto(ir.AggFunction("count", C(1)))],
+            agg_names=["n"]))
+        task = pb.TaskDefinition(stage_id=0, partition_id=0, task_id=1,
+                                 plan=node)
+        op = plan_from_bytes(task.SerializeToString(), PlannerContext())
+        rows = []
+        for b in op.execute(0, ExecContext()):
+            rows.extend(to_arrow(b, op.schema()).to_pylist())
+        counts = {r["window_start"].timestamp(): r["n"] for r in rows}
+        assert counts == {0.0: 5, 5.0: 1}
