@@ -545,14 +545,31 @@ class _HostAggState:
     key values (the value-keyed analogue of the wrapper's index caches),
     bloom filters accumulate via the vectorized SparkBloomFilter builder.
     State travels between partial/final stages as base64 inside STRING
-    columns. Host states are small (filter bytes / pickled buffers) and are
-    not spill-managed.
+    columns.
+
+    Round 3: the buffer dict is spill-managed — it registers with the
+    memory manager (size estimated from a sampled pickled buffer), and
+    under pressure the whole dict serializes to tiered storage (the
+    wrapper's spill/unspill entry points, spark_udaf_wrapper.rs:52-380);
+    spilled states fold back in via udaf.merge before emit. Per-batch
+    updates are bucketed per group so a UDAF exposing a vectorized
+    ``update_batch(buf, values)`` hook is called once per group, not once
+    per row.
     """
 
-    def __init__(self, op: "AggOp", in_schema: Schema):
+    consumer_name = "host-agg"
+
+    def __init__(self, op: "AggOp", in_schema: Schema, mem=None,
+                 metrics=None):
         self.op = op
         self.in_schema = in_schema
+        self.mem = mem
+        self.metrics = metrics
         self.entries: dict[int, list] = {}
+        self.spills = []
+        self._buf_size_sample = 64
+        self._sampled_at = 0     # group count at last buffer-size sample
+        self._emitting = False   # spill() refuses once emit has begun
         for si, (agg, spec) in enumerate(zip(op.aggs, op.specs)):
             if spec.fn == "bloom_filter":
                 from auron_tpu.exprs.bloom import SparkBloomFilter
@@ -565,12 +582,85 @@ class _HostAggState:
             elif spec.fn.startswith("udaf:"):
                 from auron_tpu.exprs.udf import lookup_udaf
                 self.entries[si] = ["udaf", lookup_udaf(spec.fn[5:]), {}]
+        self._spillable = (
+            mem is not None
+            and getattr(mem, "spill_manager", None) is not None
+            and any(e[0] == "udaf" for e in self.entries.values()))
+        if self._spillable:
+            self.consumer_name = f"host-agg-{id(op):x}"
+            mem.register_consumer(self)
 
     def empty(self) -> bool:
         return not self.entries
 
     def has_bloom(self) -> bool:
         return any(e[0] == "bloom" for e in self.entries.values())
+
+    # -- MemConsumer ---------------------------------------------------------
+
+    def _n_buffers(self) -> int:
+        return sum(len(e[2]) for e in self.entries.values()
+                   if e[0] == "udaf")
+
+    def mem_used(self) -> int:
+        # per-buffer estimate from a sampled pickle + dict/key overhead
+        return self._n_buffers() * (self._buf_size_sample + 96)
+
+    def _account(self) -> None:
+        if self._spillable:
+            self.mem.update_mem_used(self, self.mem_used())
+
+    def spill(self) -> int:
+        """Serialize every UDAF buffer dict to tiered storage and clear.
+        Refuses during emit — the restored dict is being read (the same
+        refuse-while-merging protocol the device consumer uses)."""
+        import pickle
+        if not self._spillable or self._n_buffers() == 0 or self._emitting:
+            return 0
+        freed = self.mem_used()
+        payload = {si: list(e[2].items())
+                   for si, e in self.entries.items() if e[0] == "udaf"}
+        spill = self.mem.spill_manager.new_spill()
+        spill.write_frame(pickle.dumps(payload))
+        self.spills.append(spill.finish())
+        for e in self.entries.values():
+            if e[0] == "udaf":
+                e[2].clear()
+        if self.metrics is not None:
+            self.metrics.counter("mem_spill_count").add(1)
+            self.metrics.counter("mem_spill_size").add(freed)
+        self.mem.update_mem_used(self, 0)
+        return freed
+
+    def restore_spills(self) -> None:
+        """Fold spilled buffer dicts back in (udaf.merge) before emit;
+        latches the emit phase, which blocks further spills of this
+        state."""
+        import pickle
+        self._emitting = True
+        if not self.spills:
+            return
+        spills, self.spills = self.spills, []
+        for sp in spills:
+            for frame in sp.frames():
+                payload = pickle.loads(frame)
+                for si, items in payload.items():
+                    ent = self.entries.get(si)
+                    if ent is None or ent[0] != "udaf":
+                        continue
+                    _, udaf, bufs = ent
+                    for kt, buf in items:
+                        old = bufs.get(kt)
+                        bufs[kt] = buf if old is None \
+                            else udaf.merge(old, buf)
+            sp.release()
+
+    def close(self) -> None:
+        if self._spillable:
+            self.mem.unregister_consumer(self)
+        for sp in self.spills:
+            sp.release()
+        self.spills = []
 
     # -- update (partial / complete input rows) -----------------------------
 
@@ -594,12 +684,42 @@ class _HostAggState:
                     key_tuples = _key_tuples_host(key_cols, n)
                 vals = _column_pyvalues(v.col.with_validity(
                     v.validity & batch.row_mask()), n)
+                # bucket rows by group: one update(_batch) call per group
+                from collections import defaultdict
+                per_group: dict = defaultdict(list)
                 for i in range(n):
-                    kt = key_tuples[i]
+                    per_group[key_tuples[i]].append(vals[i])
+                update_batch = getattr(udaf, "update_batch", None)
+                for kt, group_vals in per_group.items():
                     buf = bufs.get(kt)
                     if buf is None:
                         buf = udaf.zero()
-                    bufs[kt] = udaf.update(buf, vals[i])
+                    if update_batch is not None:
+                        bufs[kt] = update_batch(buf, group_vals)
+                    else:
+                        for gv in group_vals:
+                            buf = udaf.update(buf, gv)
+                        bufs[kt] = buf
+        self._sample_buf_size()
+        self._account()
+
+    def _sample_buf_size(self) -> None:
+        # re-sample only when the group count doubles: pickling a large
+        # accumulator every batch would make the hot path O(buffer bytes)
+        import pickle
+        n = self._n_buffers()
+        if n < max(self._sampled_at * 2, 1):
+            return
+        self._sampled_at = n
+        for e in self.entries.values():
+            if e[0] == "udaf" and e[2]:
+                buf = next(iter(e[2].values()))
+                try:
+                    self._buf_size_sample = max(
+                        self._buf_size_sample, len(pickle.dumps(buf)))
+                except Exception:
+                    pass
+                break
 
     # -- merge (final-mode input rows carry serialized states) --------------
 
@@ -635,6 +755,8 @@ class _HostAggState:
                     kt = key_tuples[i]
                     old = bufs.get(kt)
                     bufs[kt] = buf if old is None else udaf.merge(old, buf)
+        self._sample_buf_size()
+        self._account()
 
     # -- emit ----------------------------------------------------------------
 
@@ -642,6 +764,7 @@ class _HostAggState:
                       cap: int, partial: bool):
         import base64
         import pickle
+        self.restore_spills()
         ent = self.entries[si]
         if ent[0] == "bloom":
             blob = base64.b64encode(ent[1].serialize()).decode()
@@ -1284,7 +1407,7 @@ class AggOp(PhysicalOp):
         def stream():
             consumer = _AggSpillConsumer(self, mem, metrics, conf) \
                 if spillable else None
-            host = _HostAggState(self, in_schema)
+            host = _HostAggState(self, in_schema, mem=mem, metrics=metrics)
             state = None
             skipping = False
             rows_seen = 0
@@ -1374,6 +1497,7 @@ class AggOp(PhysicalOp):
                     return
                 yield self._emit(final_tbl, in_schema, host)
             finally:
+                host.close()
                 if consumer is not None:
                     consumer.close()
 

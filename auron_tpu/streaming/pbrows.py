@@ -29,6 +29,8 @@ from auron_tpu.columnar.schema import DataType, Schema
 _VARINT = 0
 _FIXED64 = 1
 _LEN = 2
+_SGROUP = 3   # deprecated proto2 start-group (skipped)
+_EGROUP = 4   # deprecated proto2 end-group
 _FIXED32 = 5
 
 #: engine dtype → expected wire type
@@ -127,9 +129,36 @@ def decode_pb_row(msg: bytes, schema: Schema,
                 vals[idx] = bytes(buf[pos:pos + ln]).decode("utf-8",
                                                             "replace")
             pos += ln
+        elif wt == _SGROUP:
+            pos = _skip_group(buf, pos)   # deprecated proto2 groups
+        elif wt == _EGROUP:
+            raise ValueError("unbalanced group end")
         else:
             raise ValueError(f"unsupported wire type {wt}")
     return vals
+
+
+def _skip_group(buf: memoryview, pos: int) -> int:
+    """Consume a (deprecated) proto2 group: everything up to and
+    including the matching end-group tag, nested groups handled."""
+    while True:
+        tag, pos = _read_varint(buf, pos)
+        wt = tag & 7
+        if wt == _EGROUP:
+            return pos
+        if wt == _VARINT:
+            _, pos = _read_varint(buf, pos)
+        elif wt == _FIXED64:
+            pos += 8
+        elif wt == _FIXED32:
+            pos += 4
+        elif wt == _LEN:
+            ln, pos = _read_varint(buf, pos)
+            pos += ln
+        elif wt == _SGROUP:
+            pos = _skip_group(buf, pos)
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
 
 
 def decode_pb_rows(messages: Iterable[bytes],

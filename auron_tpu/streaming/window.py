@@ -102,9 +102,12 @@ class StreamingWindowAggOp(PhysicalOp):
             #                      approximation of Flink's per-element wm)
 
             def fire_window(w: int):
-                batches = [to_device(x)[0]
-                           for x in pending.pop(w) if x.num_rows]
+                rbs = pending.pop(w)
                 fired_windows.add(1)
+                # lazy per-batch upload: the window's rows reach the device
+                # one batch at a time as the aggregation consumes them, not
+                # all at once outside memmgr control
+                batches = (to_device(x)[0] for x in rbs if x.num_rows)
                 yield from self._fire(w, batches, ctx)
 
             for batch in self.child.execute(partition, ctx):

@@ -51,6 +51,17 @@ class TestPbRows:
         vals = decode_pb_row(bytes(extra), SCHEMA, 4)
         assert vals[1] == 5 and vals[0] is None and vals[3] is None
 
+    def test_proto2_groups_skipped(self):
+        # deprecated group field (wt 3...4) with nested content must be
+        # skipped, not poison the stream
+        body = bytes([(2 << 3) | 0, 5])                 # k = 5
+        grp = bytes([(9 << 3) | 3])                     # start group 9
+        grp += bytes([(1 << 3) | 0, 7])                 # varint inside
+        grp += bytes([(2 << 3) | 2, 2]) + b"ab"         # len-delim inside
+        grp += bytes([(9 << 3) | 4])                    # end group 9
+        vals = decode_pb_row(body + grp, SCHEMA, 4)
+        assert vals[1] == 5
+
     def test_wire_type_mismatch_ignored(self):
         # field 2 (k, expects varint) sent as length-delimited → null
         msg = bytes([(2 << 3) | 2, 2]) + b"ab"
