@@ -216,14 +216,17 @@ def to_device(rb: pa.RecordBatch, capacity: int | None = None,
 
 
 def to_arrow(batch: DeviceBatch, schema: Schema) -> pa.RecordBatch:
-    """Materialize a DeviceBatch back to a pyarrow RecordBatch (host side)."""
-    n = int(batch.num_rows)
+    """Materialize a DeviceBatch back to a pyarrow RecordBatch — ONE packed
+    device→host transfer for the whole batch (columnar.serde.fetch_batch_numpy;
+    per-array fetches pay ~70 ms tunnel latency EACH on remote accelerators)."""
+    from auron_tpu.columnar.serde import fetch_batch_numpy
+    fetched, n = fetch_batch_numpy(batch)
     arrays = []
-    for field, col in zip(schema, batch.columns):
+    for field, col, col_arrs in zip(schema, batch.columns, fetched):
         if isinstance(col, StringColumn):
-            chars = np.asarray(col.chars[:n])
-            lens = np.asarray(col.lens[:n]).astype(np.int64)
-            validity = np.asarray(col.validity[:n])
+            chars = col_arrs[0][:n]
+            lens = col_arrs[1][:n].astype(np.int64)
+            validity = col_arrs[2][:n]
             lens = np.where(validity, lens, 0)
             offsets = np.zeros(n + 1, np.int32)
             np.cumsum(lens, out=offsets[1:])
@@ -235,11 +238,10 @@ def to_arrow(batch: DeviceBatch, schema: Schema) -> pa.RecordBatch:
                 int((~validity).sum())))
             continue
         if isinstance(col, ListColumn):
-            values = np.asarray(col.values[:n])
-            ev = np.asarray(col.elem_valid[:n])
-            lens = np.where(np.asarray(col.validity[:n]),
-                            np.asarray(col.lens[:n]), 0)
-            validity = np.asarray(col.validity[:n])
+            values = col_arrs[0][:n]
+            ev = col_arrs[1][:n]
+            validity = col_arrs[3][:n]
+            lens = np.where(validity, col_arrs[2][:n], 0)
             take = np.arange(col.max_elems)[None, :] < lens[:, None]
             flat_vals = values[take]
             flat_valid = ev[take]
@@ -256,8 +258,8 @@ def to_arrow(batch: DeviceBatch, schema: Schema) -> pa.RecordBatch:
                 pa.array(offsets, pa.int32())
             arrays.append(pa.ListArray.from_arrays(off_arr, child))
             continue
-        data = np.asarray(col.data[:n])
-        validity = np.asarray(col.validity[:n])
+        data = col_arrs[0][:n]
+        validity = col_arrs[1][:n]
         if field.dtype == DataType.DECIMAL:
             vals = [None if not v else _int_to_decimal(int(x), field.scale)
                     for x, v in zip(data, validity)]

@@ -120,24 +120,84 @@ def slice_host_batch(host: HostBatch, lo: int, hi: int) -> HostBatch:
     return HostBatch(cols, hi - lo)
 
 
-def batch_to_host(batch: DeviceBatch,
-                  num_rows: Optional[int] = None) -> HostBatch:
-    """Device → host, keeping only live rows (one device→host transfer per
-    buffer; jax batches them)."""
-    n = int(batch.num_rows) if num_rows is None else num_rows
-    cols: list[HostColumn] = []
+def fetch_leaves(leaves: list) -> list[np.ndarray]:
+    """Fetch many device arrays in ONE batched round trip.
+
+    On tunneled accelerators a blocking per-array fetch costs ~70 ms of
+    fixed latency regardless of size, so `np.asarray` per buffer (10+ per
+    batch) dominates everything; `jax.device_get` on the whole list issues
+    the transfers together and awaits them once (measured 7x faster for a
+    10-array batch on v5e-over-tunnel)."""
+    import jax
+    return list(jax.device_get(list(leaves)))
+
+
+def fetch_batch_numpy(batch: DeviceBatch) -> tuple[list[list[np.ndarray]], int]:
+    """All column arrays of a batch (full capacity) + the row count, in a
+    single device→host transfer. Returns (per-column array lists, n)."""
+    leaves: list = []
+    counts: list[int] = []
     for c in batch.columns:
         if isinstance(c, StringColumn):
-            cols.append(HostString(
-                np.asarray(c.chars[:n]), np.asarray(c.lens[:n]),
-                np.asarray(c.validity[:n])))
+            arrs = [c.chars, c.lens, c.validity]
         elif isinstance(c, ListColumn):
-            cols.append(HostList(
-                np.asarray(c.values[:n]), np.asarray(c.elem_valid[:n]),
-                np.asarray(c.lens[:n]), np.asarray(c.validity[:n])))
+            arrs = [c.values, c.elem_valid, c.lens, c.validity]
         else:
-            cols.append(HostPrimitive(
-                np.asarray(c.data[:n]), np.asarray(c.validity[:n])))
+            arrs = [c.data, c.validity]
+        counts.append(len(arrs))
+        leaves.extend(arrs)
+    import jax.numpy as jnp
+    leaves.append(jnp.asarray(batch.num_rows, jnp.int32).reshape(1))
+    fetched = fetch_leaves(leaves)
+    n = int(fetched[-1][0])
+    cols = []
+    pos = 0
+    for k in counts:
+        cols.append(fetched[pos:pos + k])
+        pos += k
+    return cols, n
+
+
+def batch_to_host(batch: DeviceBatch,
+                  num_rows: Optional[int] = None) -> HostBatch:
+    """Device → host, keeping only live rows — ONE batched transfer for
+    the whole batch (fetch_leaves). When the caller knows ``num_rows``
+    (every spill path does), only the live row prefix is transferred —
+    spills run exactly when memory is tight, so shipping capacity padding
+    there would be self-defeating."""
+    if num_rows is not None:
+        n = num_rows
+        leaves: list = []
+        counts: list[int] = []
+        for c in batch.columns:
+            if isinstance(c, StringColumn):
+                arrs = [c.chars[:n], c.lens[:n], c.validity[:n]]
+            elif isinstance(c, ListColumn):
+                arrs = [c.values[:n], c.elem_valid[:n], c.lens[:n],
+                        c.validity[:n]]
+            else:
+                arrs = [c.data[:n], c.validity[:n]]
+            counts.append(len(arrs))
+            leaves.extend(arrs)
+        flat = fetch_leaves(leaves)
+        fetched = []
+        pos = 0
+        for k in counts:
+            fetched.append(flat[pos:pos + k])
+            pos += k
+    else:
+        fetched, n = fetch_batch_numpy(batch)
+        fetched = [[a[:n] for a in arrs] for arrs in fetched]
+    cols: list[HostColumn] = []
+    for c, arrs in zip(batch.columns, fetched):
+        if isinstance(c, StringColumn):
+            cols.append(HostString(*[np.ascontiguousarray(a)
+                                     for a in arrs]))
+        elif isinstance(c, ListColumn):
+            cols.append(HostList(*[np.ascontiguousarray(a) for a in arrs]))
+        else:
+            cols.append(HostPrimitive(*[np.ascontiguousarray(a)
+                                        for a in arrs]))
     return HostBatch(cols, n)
 
 

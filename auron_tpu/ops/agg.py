@@ -567,9 +567,14 @@ class _HostAggState:
         self.metrics = metrics
         self.entries: dict[int, list] = {}
         self.spills = []
+        import threading
         self._buf_size_sample = 64
         self._sampled_at = 0     # group count at last buffer-size sample
         self._emitting = False   # spill() refuses once emit has begun
+        #: guards the buffer dicts against an externally-triggered victim
+        #: spill landing mid-update (same role as the device consumer's
+        #: refuse-while-merging protocol)
+        self._lock = threading.RLock()
         for si, (agg, spec) in enumerate(zip(op.aggs, op.specs)):
             if spec.fn == "bloom_filter":
                 from auron_tpu.exprs.bloom import SparkBloomFilter
@@ -612,20 +617,24 @@ class _HostAggState:
 
     def spill(self) -> int:
         """Serialize every UDAF buffer dict to tiered storage and clear.
-        Refuses during emit — the restored dict is being read (the same
-        refuse-while-merging protocol the device consumer uses)."""
+        Refuses during emit — the restored dict is being read — and takes
+        the state lock so a victim spill can't snapshot-and-clear a dict
+        another thread's update() is mutating."""
         import pickle
-        if not self._spillable or self._n_buffers() == 0 or self._emitting:
-            return 0
-        freed = self.mem_used()
-        payload = {si: list(e[2].items())
-                   for si, e in self.entries.items() if e[0] == "udaf"}
+        with self._lock:
+            if not self._spillable or self._n_buffers() == 0 \
+                    or self._emitting:
+                return 0
+            freed = self.mem_used()
+            payload = {si: list(e[2].items())
+                       for si, e in self.entries.items()
+                       if e[0] == "udaf"}
+            for e in self.entries.values():
+                if e[0] == "udaf":
+                    e[2].clear()
         spill = self.mem.spill_manager.new_spill()
         spill.write_frame(pickle.dumps(payload))
         self.spills.append(spill.finish())
-        for e in self.entries.values():
-            if e[0] == "udaf":
-                e[2].clear()
         if self.metrics is not None:
             self.metrics.counter("mem_spill_count").add(1)
             self.metrics.counter("mem_spill_size").add(freed)
@@ -637,7 +646,8 @@ class _HostAggState:
         latches the emit phase, which blocks further spills of this
         state."""
         import pickle
-        self._emitting = True
+        with self._lock:
+            self._emitting = True
         if not self.spills:
             return
         spills, self.spills = self.spills, []
@@ -667,6 +677,10 @@ class _HostAggState:
     def update(self, batch: DeviceBatch, ectx: EvalContext) -> None:
         if not self.entries:
             return
+        with self._lock:
+            self._update_locked(batch, ectx)
+
+    def _update_locked(self, batch: DeviceBatch, ectx: EvalContext) -> None:
         n = int(batch.num_rows)
         key_tuples = None
         for si, ent in self.entries.items():
@@ -726,6 +740,10 @@ class _HostAggState:
     def merge_partial(self, batch: DeviceBatch) -> None:
         if not self.entries:
             return
+        with self._lock:
+            self._merge_partial_locked(batch)
+
+    def _merge_partial_locked(self, batch: DeviceBatch) -> None:
         import base64
         import pickle
         n = int(batch.num_rows)
@@ -1120,7 +1138,7 @@ class AggOp(PhysicalOp):
                       else a[:new_cap] for a in accs)
         return (keys2, accs2, n, new_cap, h[:new_cap])
 
-    def _reduce_batch(self, keys, accs, live, elapsed, _sync=True):
+    def _reduce_batch(self, keys, accs, live, elapsed):
         """Step 1: one batch → its hash-sorted group table."""
         kinds = [kind for spec in self.specs
                  for (_n, _dt, kind) in _device_fields(spec)]
@@ -1129,15 +1147,21 @@ class AggOp(PhysicalOp):
         while True:
             meta = tuple(zip(kinds, out_elems))
             kern = _batch_reduce_kernel(len(keys), meta, cap_b)
-            with timer(elapsed, sync=_sync) as t:
-                bk, ba, bh, bn, needed = t.track(
-                    kern(tuple(keys), tuple(accs), live))
-            ng = int(bn)
-            ok, _cap = self._grow_check(kinds, out_elems, ng, cap_b, needed)
+            with timer(elapsed) as t:
+                bk, ba, bh, bn, needed = kern(tuple(keys), tuple(accs),
+                                              live)
+                # one batched round trip for every control scalar — on
+                # tunneled accelerators each separate int() readback costs
+                # a full RTT, and the readback doubles as the device sync
+                import jax
+                ng, needed_h = jax.device_get([bn, needed])
+                ng = int(ng)
+            ok, _cap = self._grow_check(kinds, out_elems, ng, cap_b,
+                                        needed_h)
             if ok:
                 return self._shrink_table((bk, ba, bn, cap_b, bh), ng)
 
-    def _merge_tables(self, s, b, elapsed, _sync=True):
+    def _merge_tables(self, s, b, elapsed):
         """Fold group table ``b`` into group table ``s`` (both hash-sorted
         5-tuples) via the searchsorted merge kernel, growing capacity /
         element buckets as needed."""
@@ -1158,12 +1182,14 @@ class AggOp(PhysicalOp):
             meta = tuple(zip(kinds, out_elems))
             kern = _state_merge_kernel(len(s_keys), meta, s_cap, cap_b,
                                        out_cap)
-            with timer(elapsed, sync=_sync) as t:
-                new_keys, new_accs, h_out, num_groups, needed = t.track(kern(
-                    s_keys, s_accs, s_h, s_n, bk, ba, bh, bn))
-            ng = int(num_groups)
+            with timer(elapsed) as t:
+                new_keys, new_accs, h_out, num_groups, needed = kern(
+                    s_keys, s_accs, s_h, s_n, bk, ba, bh, bn)
+                import jax
+                ng, needed_h = jax.device_get([num_groups, needed])
+                ng = int(ng)
             ok, out_cap = self._grow_check(kinds, out_elems, ng, out_cap,
-                                           needed)
+                                           needed_h)
             if ok:
                 return self._shrink_table(
                     (new_keys, new_accs, num_groups, out_cap, h_out), ng)
@@ -1173,7 +1199,7 @@ class AggOp(PhysicalOp):
     #: O(S / _HOT_FACTOR) per batch (LSM-style two-level state)
     _HOT_FACTOR = 8
 
-    def _merge(self, state, keys, accs, live, elapsed, _sync=True):
+    def _merge(self, state, keys, accs, live, elapsed):
         """state: None | (main, hot), each None | (keys, accs, num_groups,
         capacity, hashes). Two-level update: every batch merges into the
         small hot table (O(B log B + hot)); the hot table folds into main
@@ -1181,23 +1207,23 @@ class AggOp(PhysicalOp):
         ~_HOT_FACTOR batches instead of per batch. The reference's
         open-addressing AggTable gets the same amortization from its
         in-memory table + sorted bucket spills (agg_table.rs:68-356)."""
-        batch_tbl = self._reduce_batch(keys, accs, live, elapsed, _sync)
+        batch_tbl = self._reduce_batch(keys, accs, live, elapsed)
         cap_b = live.shape[0]
         main, hot = state if state is not None else (None, None)
         if hot is None:
             hot = batch_tbl
         else:
-            hot = self._merge_tables(hot, batch_tbl, elapsed, _sync)
+            hot = self._merge_tables(hot, batch_tbl, elapsed)
         # threshold must clear _shrink_table's initial_capacity floor, or
         # a small batch capacity would fold hot->main on EVERY batch (two
         # O(S) passes per batch — worse than the single-level design)
         if hot[3] >= self._HOT_FACTOR * max(cap_b, self.initial_capacity):
             main = hot if main is None else self._merge_tables(main, hot,
-                                                               elapsed, _sync)
+                                                               elapsed)
             hot = None
         return (main, hot)
 
-    def _compact(self, state, elapsed, _sync=True):
+    def _compact(self, state, elapsed):
         """Collapse (main, hot) into one table for emit / spill / the skip
         decision. Returns a 5-tuple or None."""
         if state is None:
@@ -1207,7 +1233,7 @@ class AggOp(PhysicalOp):
             return hot
         if hot is None:
             return main
-        return self._merge_tables(main, hot, elapsed, _sync)
+        return self._merge_tables(main, hot, elapsed)
 
     # -- finalize → output batch -------------------------------------------
     def _emit(self, state, in_schema: Schema, host=None) -> DeviceBatch:
@@ -1392,7 +1418,6 @@ class AggOp(PhysicalOp):
         elapsed = metrics.counter("elapsed_compute")
         in_schema = self.child.schema()
         ectx = EvalContext(partition_id=partition)
-        _sync = ctx.device_sync
         mem = ctx.mem_manager
         spillable = mem is not None and getattr(mem, "spill_manager", None) is not None
         conf = ctx.conf
@@ -1432,8 +1457,7 @@ class AggOp(PhysicalOp):
                         # state lives in the consumer between merges so an
                         # external victim spill can take it atomically
                         state = consumer.take_state()
-                    state = self._merge(state, keys, accs, live, elapsed,
-                                        _sync)
+                    state = self._merge(state, keys, accs, live, elapsed)
                     if consumer is not None:
                         state = consumer.observe(state)
                     if not skip_pending:
@@ -1450,7 +1474,7 @@ class AggOp(PhysicalOp):
                         state = consumer.take_state()
                     # exact distinct count needs the levels folded: a key
                     # present in both hot and main would count twice
-                    tbl = self._compact(state, elapsed, _sync)
+                    tbl = self._compact(state, elapsed)
                     state = None if tbl is None else (tbl, None)
                     ng = 0 if tbl is None else int(tbl[2])
                     # groups living only in spill runs are invisible in the
@@ -1467,9 +1491,8 @@ class AggOp(PhysicalOp):
                                 k2, a2, l2 = self._state_contributions(
                                     spilled)
                                 state = self._merge(state, k2, a2, l2,
-                                                    elapsed, _sync)
-                        yield self._emit(self._compact(state, elapsed,
-                                                       _sync),
+                                                    elapsed)
+                        yield self._emit(self._compact(state, elapsed),
                                          in_schema, host)
                         state = None
                         skipping = True
@@ -1488,8 +1511,8 @@ class AggOp(PhysicalOp):
                     for spilled in consumer.read_spilled_states():
                         keys, accs, live = self._state_contributions(spilled)
                         state = self._merge(state, keys, accs, live,
-                                            elapsed, _sync)
-                final_tbl = self._compact(state, elapsed, _sync)
+                                            elapsed)
+                final_tbl = self._compact(state, elapsed)
                 if final_tbl is None:
                     if not self.group_exprs and self.mode in ("final", "complete"):
                         # global agg over empty input: one row of neutral results

@@ -49,20 +49,31 @@ class MetricsSet:
 
 
 def _device_sync(value) -> None:
-    """Block until a kernel result is materialized on device.
-    block_until_ready is unreliable on some PJRT plugins (bench.py syncs
-    via readback for the same reason), so fall back to a 1-element
-    readback of the first leaf when it raises."""
+    """Block until a kernel result is materialized on device. ONE leaf is
+    enough: all outputs of an executable complete together, and each
+    block/readback costs a full round trip (~70 ms on tunneled
+    accelerators) — syncing every leaf multiplied that cost by the output
+    arity. block_until_ready is unreliable on some PJRT plugins (bench.py
+    syncs via readback for the same reason), so fall back to a 1-element
+    readback when it raises."""
     import jax
     leaves = [l for l in jax.tree_util.tree_leaves(value)
               if hasattr(l, "block_until_ready")]
-    for leaf in leaves:
-        try:
-            leaf.block_until_ready()
-        except Exception:
-            import numpy as _np
+    if not leaves:
+        return
+    # representative sync: the LAST two leaves, fetched in one round trip.
+    # A tracked value may mix pass-through inputs with fresh outputs
+    # (e.g. a batch whose first columns are inputs and last column is the
+    # computed one); the tail leaves are the freshly computed ones in
+    # every tracked shape this engine produces.
+    try:
+        import numpy as _np
+        picks = leaves[-2:]
+        _np.asarray(jax.device_get([p.ravel()[:1] for p in picks]))
+    except Exception:
+        for leaf in leaves[-2:]:
             try:
-                _np.asarray(jax.device_get(leaf.ravel()[:1]))
+                leaf.block_until_ready()
             except Exception:
                 pass
 

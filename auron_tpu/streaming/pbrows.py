@@ -140,13 +140,18 @@ def decode_pb_row(msg: bytes, schema: Schema,
 
 def _skip_group(buf: memoryview, pos: int) -> int:
     """Consume a (deprecated) proto2 group: everything up to and
-    including the matching end-group tag, nested groups handled."""
-    while True:
+    including the matching end-group tag. Iterative depth counter — a
+    hostile message of thousands of nested start-groups must produce
+    ValueError at worst, never RecursionError."""
+    depth = 1
+    while depth:
         tag, pos = _read_varint(buf, pos)
         wt = tag & 7
         if wt == _EGROUP:
-            return pos
-        if wt == _VARINT:
+            depth -= 1
+        elif wt == _SGROUP:
+            depth += 1
+        elif wt == _VARINT:
             _, pos = _read_varint(buf, pos)
         elif wt == _FIXED64:
             pos += 8
@@ -155,10 +160,9 @@ def _skip_group(buf: memoryview, pos: int) -> int:
         elif wt == _LEN:
             ln, pos = _read_varint(buf, pos)
             pos += ln
-        elif wt == _SGROUP:
-            pos = _skip_group(buf, pos)
         else:
             raise ValueError(f"unsupported wire type {wt}")
+    return pos
 
 
 def decode_pb_rows(messages: Iterable[bytes],
