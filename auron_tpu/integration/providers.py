@@ -1,0 +1,155 @@
+"""Lakehouse scan convert-providers.
+
+The reference ships ConvertProvider plugins that rewrite Iceberg / Paimon
+/ Hudi table scans into its native parquet/orc scan (reference:
+thirdparty/auron-iceberg/.../NativeIcebergTableScanExec.scala,
+auron-paimon, auron-hudi). The contract is the same here: a provider
+recognizes a host-engine scan node this converter has no built-in handler
+for, resolves the table's CURRENT DATA FILES, and emits the engine's
+ParquetScanNode — falling back (NotImplementedError → ConvertToNative
+boundary) for table states it cannot serve natively.
+
+File resolution is directory-layout based (the layout all three formats
+share: parquet data files under the table root, metadata under
+``metadata/`` / ``.hoodie/``); tables with row-level deletes or
+positional delete files are declined so the host engine's reader keeps
+correctness. Catalog-API integration (REST/Glue/HMS) plugs in by
+registering a provider whose ``resolve_files`` asks the catalog instead
+of the filesystem.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from auron_tpu.ir import pb
+
+
+class ScanProvider:
+    """One lakehouse format: recognize the scan node, resolve data files."""
+
+    #: short format name used in reports
+    name = "base"
+
+    def matches(self, node) -> bool:
+        raise NotImplementedError
+
+    def table_root(self, node) -> Optional[str]:
+        """Table location from the scan node's metadata (shared logic)."""
+        meta = node.fields.get("metadata") or {}
+        for key in ("Location", "location", "path", "table"):
+            loc = meta.get(key, "")
+            if isinstance(loc, str) and loc:
+                # "InMemoryFileIndex[/path]" or a plain path
+                if "[" in loc:
+                    loc = loc[loc.index("[") + 1:loc.rindex("]")]
+                    loc = loc.split(",")[0].strip()
+                return loc.replace("file:", "")
+        return None
+
+    def resolve_files(self, root: str) -> list[str]:
+        raise NotImplementedError
+
+    # -- shared helpers ------------------------------------------------------
+
+    @staticmethod
+    def _data_parquet_files(root: str, exclude_dirs: tuple[str, ...],
+                            require_marker: Optional[str] = None) -> list[str]:
+        if require_marker and not os.path.exists(
+                os.path.join(root, require_marker)):
+            raise NotImplementedError(
+                f"not a recognized table root (missing {require_marker}): "
+                f"{root}")
+        out = []
+        for dirpath, dirs, files in os.walk(root):
+            dirs[:] = [d for d in dirs if d not in exclude_dirs]
+            for f in sorted(files):
+                if f.endswith(".parquet"):
+                    out.append(os.path.join(dirpath, f))
+                elif f.endswith((".delete", ".equality-deletes",
+                                 ".position-deletes")):
+                    raise NotImplementedError(
+                        "table has row-level delete files — native scan "
+                        "would return deleted rows; falling back")
+        if not out:
+            raise NotImplementedError(f"no parquet data files under {root}")
+        return out
+
+
+class IcebergScanProvider(ScanProvider):
+    """Iceberg table layout: data under the root, metadata/ dir with
+    version-hint/metadata JSON (reference: auron-iceberg's
+    NativeIcebergTableScanExec rewrites the current snapshot's data files
+    into the native parquet scan)."""
+
+    name = "iceberg"
+
+    def matches(self, node) -> bool:
+        blob = str(node.fields.get("scan", "")) + str(
+            node.fields.get("metadata", "")) + node.cls
+        return "iceberg" in blob.lower()
+
+    def resolve_files(self, root: str) -> list[str]:
+        return self._data_parquet_files(
+            root, exclude_dirs=("metadata",), require_marker="metadata")
+
+
+class PaimonScanProvider(ScanProvider):
+    name = "paimon"
+
+    def matches(self, node) -> bool:
+        blob = str(node.fields.get("scan", "")) + str(
+            node.fields.get("metadata", "")) + node.cls
+        return "paimon" in blob.lower()
+
+    def resolve_files(self, root: str) -> list[str]:
+        return self._data_parquet_files(
+            root, exclude_dirs=("snapshot", "manifest", "schema", "index"),
+            require_marker="snapshot")
+
+
+class HudiScanProvider(ScanProvider):
+    name = "hudi"
+
+    def matches(self, node) -> bool:
+        blob = str(node.fields.get("scan", "")) + str(
+            node.fields.get("metadata", "")) + node.cls
+        return "hudi" in blob.lower() or "hoodie" in blob.lower()
+
+    def resolve_files(self, root: str) -> list[str]:
+        return self._data_parquet_files(
+            root, exclude_dirs=(".hoodie",), require_marker=".hoodie")
+
+
+#: default provider chain (reference: ConvertProvider ServiceLoader)
+PROVIDERS: list[ScanProvider] = [IcebergScanProvider(), PaimonScanProvider(),
+                                 HudiScanProvider()]
+
+
+def register_provider(p: ScanProvider) -> None:
+    PROVIDERS.insert(0, p)
+
+
+def try_convert_scan(node, attrs, dtype_to_proto,
+                     path_rewrite: Callable[[str], str]):
+    """Provider hook called by the Spark plan converter for scan-like nodes
+    without a built-in handler. Returns a ParquetScanNode plan or None."""
+    for p in PROVIDERS:
+        if not p.matches(node):
+            continue
+        root = p.table_root(node)
+        if not root:
+            raise NotImplementedError(
+                f"{p.name} scan without a table location")
+        files = [path_rewrite(f) for f in p.resolve_files(root)]
+        fields = []
+        for a in attrs:
+            dt, prec, sc = dtype_to_proto(a.dtype)
+            fields.append(pb.FieldP(name=a.name, dtype=dt, nullable=True,
+                                    precision=prec, scale=sc))
+        n = pb.PlanNode(parquet_scan=pb.ParquetScanNode(
+            files=files, schema=pb.SchemaP(fields=fields),
+            columns=[a.name for a in attrs]))
+        return n, max(len(files), 1), p.name
+    return None

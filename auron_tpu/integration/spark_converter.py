@@ -38,15 +38,15 @@ from auron_tpu.ir import pb
 
 _DT = {
     "boolean": pb.DT_BOOL,
-    "byte": pb.DT_INT8,
-    "short": pb.DT_INT16,
-    "integer": pb.DT_INT32,
-    "long": pb.DT_INT64,
-    "float": pb.DT_FLOAT32,
+    "byte": pb.DT_INT8, "tinyint": pb.DT_INT8,
+    "short": pb.DT_INT16, "smallint": pb.DT_INT16,
+    "integer": pb.DT_INT32, "int": pb.DT_INT32,
+    "long": pb.DT_INT64, "bigint": pb.DT_INT64,
+    "float": pb.DT_FLOAT32, "real": pb.DT_FLOAT32,
     "double": pb.DT_FLOAT64,
-    "string": pb.DT_STRING,
+    "string": pb.DT_STRING, "varchar": pb.DT_STRING,
     "date": pb.DT_DATE32,
-    "timestamp": pb.DT_TIMESTAMP_US,
+    "timestamp": pb.DT_TIMESTAMP_US, "timestamp_ntz": pb.DT_TIMESTAMP_US,
 }
 
 _DECIMAL_RE = re.compile(r"decimal\((\d+),\s*(\d+)\)")
@@ -412,6 +412,22 @@ class SparkPlanConverter:
         else:
             raise NotImplementedError(f"scan format {fmt}")
         return _Converted(n, attrs, partitions=max(len(files), 1))
+
+    def _c_BatchScanExec(self, node: SparkNode) -> _Converted:
+        """DSv2 scans (Iceberg / Paimon / Hudi ride this node): delegate to
+        the lakehouse convert-providers (integration/providers.py — the
+        reference's ConvertProvider plugin seam, thirdparty/auron-iceberg
+        etc.); unmatched scans fall back."""
+        from auron_tpu.integration.providers import try_convert_scan
+        attrs = _parse_output(node)
+        got = try_convert_scan(node, attrs, _dtype_to_proto,
+                               self.path_rewrite)
+        if got is None:
+            raise NotImplementedError(
+                "BatchScanExec with no matching scan provider")
+        n, partitions, provider = got
+        self.report.tag(node, True, f"provider:{provider}")
+        return _Converted(n, attrs, partitions=partitions)
 
     # -- unary row transforms ----------------------------------------------
 

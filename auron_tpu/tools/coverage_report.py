@@ -1,0 +1,82 @@
+"""Native-coverage reporting — the Spark UI tab analogue.
+
+The reference ships a Spark UI plugin visualizing, per query, which plan
+nodes ran natively and which fell back to the host engine (reference:
+auron-spark-ui/.../AuronSQLAppStatusListener.scala + the React/ECharts
+front-end). This engine is host-UI-less, so the same information renders
+as a markdown/JSON report from the converter's ConversionReport tags —
+suitable for CI artifacts and terminal review.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+
+@dataclass
+class QueryCoverage:
+    name: str
+    tags: list                      # (node class, ok, reason)
+
+    @property
+    def native(self) -> int:
+        return sum(1 for _c, ok, _r in self.tags if ok)
+
+    @property
+    def fallback(self) -> int:
+        return sum(1 for _c, ok, _r in self.tags if not ok)
+
+    @property
+    def pct(self) -> float:
+        total = len(self.tags)
+        return 100.0 * self.native / total if total else 100.0
+
+
+@dataclass
+class CoverageReport:
+    queries: list = field(default_factory=list)
+
+    def add(self, name: str, conversion_report) -> QueryCoverage:
+        qc = QueryCoverage(name, list(conversion_report.tags))
+        self.queries.append(qc)
+        return qc
+
+    # -- renderers -----------------------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "queries": [{
+                "name": q.name,
+                "native_nodes": q.native,
+                "fallback_nodes": q.fallback,
+                "native_pct": round(q.pct, 1),
+                "fallbacks": [
+                    {"node": c, "reason": r}
+                    for c, ok, r in q.tags if not ok],
+            } for q in self.queries],
+            "overall_native_pct": round(self.overall_pct, 1),
+        }, indent=2)
+
+    @property
+    def overall_pct(self) -> float:
+        total = sum(len(q.tags) for q in self.queries)
+        native = sum(q.native for q in self.queries)
+        return 100.0 * native / total if total else 100.0
+
+    def to_markdown(self) -> str:
+        lines = ["# Native coverage", "",
+                 f"Overall: {self.overall_pct:.1f}% of plan nodes native",
+                 "",
+                 "| Query | Native | Fallback | Coverage |",
+                 "|---|---|---|---|"]
+        for q in self.queries:
+            lines.append(f"| {q.name} | {q.native} | {q.fallback} "
+                         f"| {q.pct:.1f}% |")
+        fb = [(q.name, c, r) for q in self.queries
+              for c, ok, r in q.tags if not ok]
+        if fb:
+            lines += ["", "## Fallback reasons", ""]
+            for name, c, r in fb:
+                lines.append(f"- **{name}** `{c}`: {r or 'unconvertible'}")
+        return "\n".join(lines)
