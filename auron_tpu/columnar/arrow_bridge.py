@@ -40,9 +40,9 @@ def schema_from_arrow(sch: pa.Schema) -> Schema:
     for f in sch:
         t = f.type
         if pa.types.is_decimal(t):
-            if t.precision > 18:
+            if t.precision > 38:
                 raise NotImplementedError(
-                    f"decimal precision {t.precision} > 18 not supported yet")
+                    f"decimal precision {t.precision} > 38 not supported")
             fields.append(Field(f.name, DataType.DECIMAL, f.nullable, t.precision, t.scale))
         elif pa.types.is_dictionary(t):
             inner = _PA_TO_DT.get(t.value_type)
@@ -189,10 +189,30 @@ def to_device(rb: pa.RecordBatch, capacity: int | None = None,
             cols.append(PrimitiveColumn(jnp.asarray(data), jnp.asarray(validity)))
             continue
         if field.dtype == DataType.DECIMAL:
-            # Unscaled int64 payload (reference stores Decimal128; <=18 digits
-            # fits 64 bits, reference: datafusion-ext-functions/src/spark_make_decimal.rs).
-            unscaled = np.zeros(n, np.int64)
             pyvals = arr.to_pylist()
+            if field.precision > 18:
+                # precision 19..38: two-limb device representation
+                # (columnar/decimal128.py; reference stores Decimal128 and
+                # computes in i128, arrow/cast.rs decimal paths)
+                from auron_tpu.columnar.decimal128 import (Decimal128Column,
+                                                           limbs_from_ints)
+                import decimal as _dec
+                with _dec.localcontext() as _ctx:
+                    # default context (prec=28) would silently round
+                    # 29-38 digit values during scaleb
+                    _ctx.prec = 60
+                    ints = [None if v is None
+                            else int(v.scaleb(field.scale)
+                                     .to_integral_value())
+                            for v in pyvals]
+                hi, lo, valid128 = limbs_from_ints(ints, cap)
+                cols.append(Decimal128Column(jnp.asarray(hi),
+                                             jnp.asarray(lo),
+                                             jnp.asarray(valid128)))
+                continue
+            # <=18 digits: unscaled int64 payload (reference:
+            # datafusion-ext-functions/src/spark_make_decimal.rs)
+            unscaled = np.zeros(n, np.int64)
             for i, v in enumerate(pyvals):
                 if v is not None:
                     unscaled[i] = int(v.scaleb(field.scale).to_integral_value())
@@ -258,6 +278,16 @@ def to_arrow(batch: DeviceBatch, schema: Schema) -> pa.RecordBatch:
                 pa.array(offsets, pa.int32())
             arrays.append(pa.ListArray.from_arrays(off_arr, child))
             continue
+        from auron_tpu.columnar.decimal128 import Decimal128Column
+        if isinstance(col, Decimal128Column):
+            from auron_tpu.columnar.decimal128 import ints_from_limbs
+            ints = ints_from_limbs(col_arrs[0][:n], col_arrs[1][:n],
+                                   col_arrs[2][:n])
+            vals = [None if x is None else _int_to_decimal(x, field.scale)
+                    for x in ints]
+            arrays.append(pa.array(
+                vals, type=pa.decimal128(field.precision, field.scale)))
+            continue
         data = col_arrs[0][:n]
         validity = col_arrs[1][:n]
         if field.dtype == DataType.DECIMAL:
@@ -288,4 +318,6 @@ def _with_nulls(arr: pa.Array, validity: np.ndarray) -> pa.Array:
 
 def _int_to_decimal(unscaled: int, scale: int):
     import decimal
-    return decimal.Decimal(unscaled).scaleb(-scale)
+    with decimal.localcontext() as ctx:
+        ctx.prec = 60   # default prec=28 rounds away 29-38 digit values
+        return decimal.Decimal(unscaled).scaleb(-scale)

@@ -19,6 +19,8 @@ Batches are pytrees, so they pass straight through jit / shard_map / scan.
 from __future__ import annotations
 
 from dataclasses import dataclass, replace
+
+from auron_tpu.columnar.decimal128 import Decimal128Column
 from typing import Sequence, Union
 
 import jax
@@ -91,7 +93,8 @@ class ListColumn:
         return replace(self, validity=validity)
 
 
-Column = Union[PrimitiveColumn, StringColumn, ListColumn]
+Column = Union[PrimitiveColumn, StringColumn, ListColumn,
+               Decimal128Column]
 
 
 @jax.tree_util.register_dataclass
@@ -133,6 +136,8 @@ def column_nbytes(col: Column) -> int:
     if isinstance(col, ListColumn):
         return (col.values.nbytes + col.elem_valid.nbytes
                 + col.lens.nbytes + col.validity.nbytes)
+    if isinstance(col, Decimal128Column):
+        return col.hi.nbytes + col.lo.nbytes + col.validity.nbytes
     return col.data.nbytes + col.validity.nbytes
 
 
@@ -165,6 +170,11 @@ def gather_column(col: Column, indices: jax.Array, valid: jax.Array) -> Column:
             values=col.values[indices],
             elem_valid=col.elem_valid[indices] & valid[:, None],
             lens=jnp.where(valid, col.lens[indices], 0),
+            validity=col.validity[indices] & valid,
+        )
+    if isinstance(col, Decimal128Column):
+        return Decimal128Column(
+            hi=col.hi[indices], lo=col.lo[indices],
             validity=col.validity[indices] & valid,
         )
     return PrimitiveColumn(
@@ -234,6 +244,13 @@ def concat_columns(a: Column, b: Column) -> Column:
             lens=jnp.concatenate([a.lens, b.lens]),
             validity=jnp.concatenate([a.validity, b.validity]),
         )
+    if isinstance(a, Decimal128Column):
+        assert isinstance(b, Decimal128Column)
+        return Decimal128Column(
+            hi=jnp.concatenate([a.hi, b.hi]),
+            lo=jnp.concatenate([a.lo, b.lo]),
+            validity=jnp.concatenate([a.validity, b.validity]),
+        )
     assert isinstance(b, PrimitiveColumn)
     return PrimitiveColumn(
         data=jnp.concatenate([a.data, b.data]),
@@ -282,6 +299,12 @@ def resize(batch: DeviceBatch, new_capacity: int) -> DeviceBatch:
                     lens=jnp.pad(c.lens, (0, pad)),
                     validity=jnp.pad(c.validity, (0, pad)),
                 )
+            if isinstance(c, Decimal128Column):
+                return Decimal128Column(
+                    hi=jnp.pad(c.hi, (0, pad)),
+                    lo=jnp.pad(c.lo, (0, pad)),
+                    validity=jnp.pad(c.validity, (0, pad)),
+                )
             return PrimitiveColumn(
                 data=jnp.pad(c.data, (0, pad)),
                 validity=jnp.pad(c.validity, (0, pad)),
@@ -299,6 +322,10 @@ def resize(batch: DeviceBatch, new_capacity: int) -> DeviceBatch:
                 lens=c.lens[:new_capacity],
                 validity=c.validity[:new_capacity],
             )
+        if isinstance(c, Decimal128Column):
+            return Decimal128Column(hi=c.hi[:new_capacity],
+                                    lo=c.lo[:new_capacity],
+                                    validity=c.validity[:new_capacity])
         return PrimitiveColumn(data=c.data[:new_capacity], validity=c.validity[:new_capacity])
 
     return DeviceBatch(tuple(resize_col(c) for c in batch.columns), batch.num_rows)

@@ -83,7 +83,14 @@ class HostList:
     validity: np.ndarray   # bool[n]
 
 
-HostColumn = Union[HostPrimitive, HostString, HostList]
+@dataclass
+class HostDecimal128:
+    hi: np.ndarray         # int64[n]
+    lo: np.ndarray         # int64[n] (unsigned bit pattern)
+    validity: np.ndarray   # bool[n]
+
+
+HostColumn = Union[HostPrimitive, HostString, HostList, HostDecimal128]
 
 
 @dataclass
@@ -100,6 +107,8 @@ class HostBatch:
             elif isinstance(c, HostList):
                 total += (c.values.nbytes + c.elem_valid.nbytes
                           + c.lens.nbytes + c.validity.nbytes)
+            elif isinstance(c, HostDecimal128):
+                total += c.hi.nbytes + c.lo.nbytes + c.validity.nbytes
             else:
                 total += c.data.nbytes + c.validity.nbytes
         return total
@@ -115,6 +124,9 @@ def slice_host_batch(host: HostBatch, lo: int, hi: int) -> HostBatch:
         elif isinstance(c, HostList):
             cols.append(HostList(c.values[lo:hi], c.elem_valid[lo:hi],
                                  c.lens[lo:hi], c.validity[lo:hi]))
+        elif isinstance(c, HostDecimal128):
+            cols.append(HostDecimal128(c.hi[lo:hi], c.lo[lo:hi],
+                                       c.validity[lo:hi]))
         else:
             cols.append(HostPrimitive(c.data[lo:hi], c.validity[lo:hi]))
     return HostBatch(cols, hi - lo)
@@ -137,11 +149,14 @@ def fetch_batch_numpy(batch: DeviceBatch) -> tuple[list[list[np.ndarray]], int]:
     single device→host transfer. Returns (per-column array lists, n)."""
     leaves: list = []
     counts: list[int] = []
+    from auron_tpu.columnar.decimal128 import Decimal128Column
     for c in batch.columns:
         if isinstance(c, StringColumn):
             arrs = [c.chars, c.lens, c.validity]
         elif isinstance(c, ListColumn):
             arrs = [c.values, c.elem_valid, c.lens, c.validity]
+        elif isinstance(c, Decimal128Column):
+            arrs = [c.hi, c.lo, c.validity]
         else:
             arrs = [c.data, c.validity]
         counts.append(len(arrs))
@@ -169,12 +184,15 @@ def batch_to_host(batch: DeviceBatch,
         n = num_rows
         leaves: list = []
         counts: list[int] = []
+        from auron_tpu.columnar.decimal128 import Decimal128Column
         for c in batch.columns:
             if isinstance(c, StringColumn):
                 arrs = [c.chars[:n], c.lens[:n], c.validity[:n]]
             elif isinstance(c, ListColumn):
                 arrs = [c.values[:n], c.elem_valid[:n], c.lens[:n],
                         c.validity[:n]]
+            elif isinstance(c, Decimal128Column):
+                arrs = [c.hi[:n], c.lo[:n], c.validity[:n]]
             else:
                 arrs = [c.data[:n], c.validity[:n]]
             counts.append(len(arrs))
@@ -188,6 +206,7 @@ def batch_to_host(batch: DeviceBatch,
     else:
         fetched, n = fetch_batch_numpy(batch)
         fetched = [[a[:n] for a in arrs] for arrs in fetched]
+    from auron_tpu.columnar.decimal128 import Decimal128Column
     cols: list[HostColumn] = []
     for c, arrs in zip(batch.columns, fetched):
         if isinstance(c, StringColumn):
@@ -195,6 +214,9 @@ def batch_to_host(batch: DeviceBatch,
                                      for a in arrs]))
         elif isinstance(c, ListColumn):
             cols.append(HostList(*[np.ascontiguousarray(a) for a in arrs]))
+        elif isinstance(c, Decimal128Column):
+            cols.append(HostDecimal128(*[np.ascontiguousarray(a)
+                                         for a in arrs]))
         else:
             cols.append(HostPrimitive(*[np.ascontiguousarray(a)
                                         for a in arrs]))
@@ -223,6 +245,13 @@ def host_to_batch(host: HostBatch, capacity: Optional[int] = None) -> DeviceBatc
             val = np.pad(c.validity, (0, pad)) if pad else c.validity
             cols.append(ListColumn(jnp.asarray(values), jnp.asarray(ev),
                                    jnp.asarray(lens), jnp.asarray(val)))
+        elif isinstance(c, HostDecimal128):
+            from auron_tpu.columnar.decimal128 import Decimal128Column
+            hi = np.pad(c.hi, (0, pad)) if pad else c.hi
+            lo = np.pad(c.lo, (0, pad)) if pad else c.lo
+            val = np.pad(c.validity, (0, pad)) if pad else c.validity
+            cols.append(Decimal128Column(jnp.asarray(hi), jnp.asarray(lo),
+                                         jnp.asarray(val)))
         else:
             data = np.pad(c.data, (0, pad)) if pad else c.data
             val = np.pad(c.validity, (0, pad)) if pad else c.validity
@@ -266,6 +295,11 @@ def serialize_host_batch(host: HostBatch,
             _put_buf(body, c.values)
             _put_buf(body, c.elem_valid.astype(np.bool_))
             _put_buf(body, c.lens.astype(np.int32))
+            _put_buf(body, c.validity.astype(np.bool_))
+        elif isinstance(c, HostDecimal128):
+            body.write(struct.pack("<B", 3))
+            _put_buf(body, c.hi.astype(np.int64))
+            _put_buf(body, c.lo.astype(np.int64))
             _put_buf(body, c.validity.astype(np.bool_))
         else:
             tag = c.data.dtype.str.encode()
@@ -314,6 +348,11 @@ def deserialize_host_batch(data: bytes) -> tuple[HostBatch, dict[str, np.ndarray
             lens = _get_buf(src, np.int32, (num_rows,))
             val = _get_buf(src, np.bool_, (num_rows,))
             cols.append(HostList(values, ev, lens, val))
+        elif kind == 3:
+            hi = _get_buf(src, np.int64, (num_rows,))
+            lo = _get_buf(src, np.int64, (num_rows,))
+            val = _get_buf(src, np.bool_, (num_rows,))
+            cols.append(HostDecimal128(hi, lo, val))
         else:
             (tag_len,) = struct.unpack("<B", src.read(1))
             dt = np.dtype(src.read(tag_len).decode())

@@ -86,6 +86,29 @@ _RANK = [DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64,
          DataType.FLOAT32, DataType.FLOAT64]
 
 
+def decimal_result_type(op: str, lp: int, ls: int, rp: int,
+                        rs: int) -> tuple[int, int, int]:
+    """Spark decimal binary result type with allowPrecisionLoss scale
+    adjustment (Spark's DecimalPrecision.adjustPrecisionScale): returns
+    (precision, scale, full_scale) where full_scale is the scale the raw
+    limb computation produces before any precision-loss rescale. ONE
+    definition shared by infer_dtype and evaluation so declared schemas
+    and evaluated columns can't drift."""
+    if op == "*":
+        p, s = lp + rp + 1, ls + rs
+        full_s = ls + rs
+    else:   # + - and comparisons share add/sub typing
+        s = max(ls, rs)
+        p = max(lp - ls, rp - rs) + s + 1
+        full_s = s
+    if p <= 38:
+        return p, s, full_s
+    digits_int = p - s
+    min_scale = min(s, 6)
+    adj_s = max(38 - digits_int, min_scale)
+    return 38, adj_s, full_s
+
+
 def common_type(a: DataType, b: DataType) -> DataType:
     if a == b:
         return a
@@ -137,6 +160,15 @@ def evaluate(expr: ir.Expr, batch: DeviceBatch, schema: Schema,
         return TypedValue(batch.columns[expr.index], f.dtype, f.precision, f.scale)
 
     if isinstance(expr, ir.Literal):
+        if expr.dtype == DataType.DECIMAL and expr.precision > 18:
+            from auron_tpu.columnar.decimal128 import (Decimal128Column,
+                                                       limbs_from_ints)
+            vals = [None if expr.value is None else int(expr.value)] * cap
+            hi, lo, valid = limbs_from_ints(vals, cap)
+            return TypedValue(
+                Decimal128Column(jnp.asarray(hi), jnp.asarray(lo),
+                                 jnp.asarray(valid)),
+                DataType.DECIMAL, expr.precision, expr.scale)
         return TypedValue(_const_column(expr.value, expr.dtype, cap),
                           expr.dtype, expr.precision, expr.scale)
 
@@ -243,11 +275,12 @@ def infer_dtype(expr: ir.Expr, schema: Schema) -> tuple[DataType, int, int]:
         lt, lp, ls = infer_dtype(expr.left, schema)
         rt, rp, rs = infer_dtype(expr.right, schema)
         if lt == DataType.DECIMAL and rt == DataType.DECIMAL:
-            if expr.op == "*":
-                return DataType.DECIMAL, min(lp + rp, 18), ls + rs
+            # Spark decimal result types (precision 19..38 runs on the
+            # two-limb kernels, columnar/decimal128.py)
             if expr.op == "/":
                 return DataType.FLOAT64, 0, 0
-            return DataType.DECIMAL, min(max(lp, rp) + 1, 18), max(ls, rs)
+            p, s, _fs = decimal_result_type(expr.op, lp, ls, rp, rs)
+            return DataType.DECIMAL, p, s
         out = common_type(lt, rt)
         if expr.op == "/" and out in _RANK and not out.is_floating:
             # integer '/' keeps integer semantics here; Spark's true divide
@@ -393,7 +426,17 @@ def _eval_decimal_binary(op, l: TypedValue, r: TypedValue, cap: int) -> TypedVal
         lf = _decimal_to_f64(l)
         rf = _decimal_to_f64(r)
         return _eval_binary_simple(op, lf, rf)
+    from auron_tpu.columnar.decimal128 import Decimal128Column
     s = max(l.scale, r.scale)
+    # route to the two-limb path when either side is wide or the Spark
+    # result type exceeds 18 digits (the int64 payload would wrap)
+    rp, rs, full_s = decimal_result_type(op, l.precision, l.scale,
+                                         r.precision, r.scale)
+    wide = (isinstance(l.col, Decimal128Column)
+            or isinstance(r.col, Decimal128Column) or rp > 18
+            or full_s > rs)
+    if wide:
+        return _eval_decimal128_binary(op, l, r, rp, rs, full_s)
     ld = l.data * (10 ** (s - l.scale))
     rd = r.data * (10 ** (s - r.scale))
     validity = l.validity & r.validity
@@ -403,17 +446,107 @@ def _eval_decimal_binary(op, l: TypedValue, r: TypedValue, cap: int) -> TypedVal
         return TypedValue(PrimitiveColumn(fn(ld, rd), validity), DataType.BOOL)
     if op == "+":
         return TypedValue(PrimitiveColumn(ld + rd, validity), DataType.DECIMAL,
-                          18, s)
+                          rp, s)
     if op == "-":
         return TypedValue(PrimitiveColumn(ld - rd, validity), DataType.DECIMAL,
-                          18, s)
+                          rp, s)
     if op == "*":
         return TypedValue(PrimitiveColumn(l.data * r.data, validity),
-                          DataType.DECIMAL, 18, l.scale + r.scale)
+                          DataType.DECIMAL, rp, l.scale + r.scale)
     raise NotImplementedError(f"decimal op {op}")
 
 
+def _limbs_of(v: TypedValue):
+    """(hi, lo) limbs of a decimal TypedValue of either representation."""
+    from auron_tpu.columnar import decimal128 as D
+    if isinstance(v.col, D.Decimal128Column):
+        return v.col.hi, v.col.lo
+    return D.from_int64(v.data.astype(jnp.int64))
+
+
+def _mk_decimal(hi, lo, validity, precision: int, scale: int) -> TypedValue:
+    """Wrap limb results in the narrowest faithful column class."""
+    from auron_tpu.columnar import decimal128 as D
+    if precision <= 18:
+        v64, _fits = D.to_int64(hi, lo)   # |x| < 10^18 always fits
+        return TypedValue(PrimitiveColumn(v64, validity), DataType.DECIMAL,
+                          precision, scale)
+    return TypedValue(D.Decimal128Column(hi, lo, validity),
+                      DataType.DECIMAL, precision, scale)
+
+
+def _eval_decimal128_binary(op, l: TypedValue, r: TypedValue, rp: int,
+                            rs: int, full_s: int) -> TypedValue:
+    """Two-limb decimal arithmetic/comparison for precision 19..38
+    (reference computes these in Rust i128; columnar/decimal128.py is the
+    limb kernel library). Declared-precision overflow nulls the row
+    (Spark non-ANSI check_overflow semantics); when adjustPrecisionScale
+    reduced the scale (full_s > rs), the raw result rescales HALF_UP.
+
+    Rescaling by 10^ds can push a 38-digit value past 2^127 and wrap, so
+    every rescale is guarded by a fits_precision(38 - ds) pre-check: for
+    arithmetic an unsafe rescale implies result overflow (null); for
+    comparisons those rows fall back to float64 ordering."""
+    from auron_tpu.columnar import decimal128 as D
+    s = max(l.scale, r.scale)
+    lh, ll_ = _limbs_of(l)
+    rh, rl = _limbs_of(r)
+    validity = l.validity & r.validity
+
+    def rescale_safe(h, lo, ds):
+        if ds == 0:
+            return h, lo, jnp.ones_like(validity)
+        ok = D.fits_precision(h, lo, 38 - ds)
+        h2, l2 = D.mul_pow10(h, lo, ds)
+        return h2, l2, ok
+
+    if op in ("==", "!=", "<", "<=", ">", ">="):
+        ah, al, oka = rescale_safe(lh, ll_, s - l.scale)
+        bh, bl, okb = rescale_safe(rh, rl, s - r.scale)
+        lt, eq = D.cmp128(ah, al, bh, bl)
+        # unsafe rescale rows: exact limb compare is wrapped garbage —
+        # float64 ordering is correct there (magnitudes >= 1e19 apart
+        # from any representable tie)
+        fa = D.to_float64(lh, ll_) / (10.0 ** l.scale)
+        fb = D.to_float64(rh, rl) / (10.0 ** r.scale)
+        unsafe = ~(oka & okb)
+        lt = jnp.where(unsafe, fa < fb, lt)
+        eq = jnp.where(unsafe, fa == fb, eq)
+        out = {"==": eq, "!=": ~eq, "<": lt, "<=": lt | eq,
+               ">": ~(lt | eq), ">=": ~lt}[op]
+        return TypedValue(PrimitiveColumn(out, validity), DataType.BOOL)
+    if op in ("+", "-"):
+        ah, al, oka = rescale_safe(lh, ll_, s - l.scale)
+        bh, bl, okb = rescale_safe(rh, rl, s - r.scale)
+        if op == "+":
+            oh, ol = D.add128(ah, al, bh, bl)
+        else:
+            oh, ol = D.sub128(ah, al, bh, bl)
+        ok = oka & okb
+    elif op == "*":
+        oh, ol = D.mul128(lh, ll_, rh, rl)
+        # a RAW product beyond 2^127 wraps silently in the low-128
+        # multiply; guard with a float magnitude check at the
+        # representability bound (2^127 ~ 1.70e38, margin for float
+        # error). Known limitation vs Spark's unbounded BigDecimal
+        # intermediates: a product whose raw (pre-precision-loss-rescale)
+        # value exceeds 2^127 nulls even if the rescaled result would fit.
+        mag = jnp.abs(D.to_float64(lh, ll_) * D.to_float64(rh, rl))
+        ok = mag < 1.6e38
+    else:
+        raise NotImplementedError(f"decimal128 op {op}")
+    if full_s > rs:
+        # precision-loss rescale (Spark adjustPrecisionScale, HALF_UP)
+        oh, ol = D.div_pow10_half_up(oh, ol, full_s - rs)
+    ok = ok & D.fits_precision(oh, ol, rp)
+    return _mk_decimal(oh, ol, validity & ok, rp, rs)
+
+
 def _decimal_to_f64(v: TypedValue) -> TypedValue:
+    from auron_tpu.columnar import decimal128 as D
+    if isinstance(v.col, D.Decimal128Column):
+        f = D.to_float64(v.col.hi, v.col.lo) / (10.0 ** v.scale)
+        return TypedValue(PrimitiveColumn(f, v.validity), DataType.FLOAT64)
     if v.dtype == DataType.DECIMAL:
         return TypedValue(
             PrimitiveColumn(v.data.astype(jnp.float64) / (10.0 ** v.scale),
@@ -587,6 +720,12 @@ def cast_value(v: TypedValue, dtype: DataType, precision: int = 0,
     if isinstance(v.col, StringColumn):
         return _cast_from_string(v, dtype, precision, scale, safe)
 
+    from auron_tpu.columnar import decimal128 as _D128
+    if isinstance(v.col, _D128.Decimal128Column) or (
+            v.dtype == DataType.DECIMAL and dtype == DataType.DECIMAL
+            and precision > 18):
+        return _cast_decimal128(v, dtype, precision, scale)
+
     if dtype == DataType.STRING:
         return _cast_to_string(v)
 
@@ -625,6 +764,34 @@ def cast_value(v: TypedValue, dtype: DataType, precision: int = 0,
                                      DataType.FLOAT64), dtype, precision, scale)
 
     if dtype == DataType.DECIMAL:
+        if precision > 18 and v.dtype.is_floating:
+            # double → wide decimal: build limbs from the float magnitude
+            # (doubles carry 53 bits — digits beyond ~17 are already
+            # approximation in Spark too, which rounds BigDecimal(double))
+            from auron_tpu.columnar import decimal128 as D
+            mag = jnp.abs(jnp.round(d.astype(jnp.float64) * (10.0 ** scale)))
+            ok = mag < float(10 ** precision)
+            magc = jnp.where(ok, mag, 0.0)
+            hi_f = jnp.floor(magc / (2.0 ** 64))
+            lo_f = magc - hi_f * (2.0 ** 64)
+            hi = hi_f.astype(jnp.int64)
+            lo = jnp.where(lo_f >= 2.0 ** 63,
+                           (lo_f - 2.0 ** 64).astype(jnp.int64),
+                           lo_f.astype(jnp.int64))
+            neg = d < 0
+            nh, nl = D.neg128(hi, lo)
+            hi = jnp.where(neg, nh, hi)
+            lo = jnp.where(neg, nl, lo)
+            return TypedValue(D.Decimal128Column(hi, lo, validity & ok),
+                              DataType.DECIMAL, precision, scale)
+        if precision > 18 and not v.dtype.is_floating:
+            # int → wide decimal: exact limb promotion + scale-up
+            from auron_tpu.columnar import decimal128 as D
+            hi, lo = D.from_int64(d.astype(jnp.int64))
+            hi, lo = D.mul_pow10(hi, lo, scale)
+            ok = D.fits_precision(hi, lo, precision)
+            return TypedValue(D.Decimal128Column(hi, lo, validity & ok),
+                              DataType.DECIMAL, precision, scale)
         if v.dtype.is_floating:
             unscaled = jnp.round(d.astype(jnp.float64) * (10.0 ** scale))
             ok = jnp.abs(unscaled) < float(10 ** min(precision, 18))
@@ -674,6 +841,66 @@ def cast_value(v: TypedValue, dtype: DataType, precision: int = 0,
                           DataType.TIMESTAMP_US)
 
     raise NotImplementedError(f"cast {v.dtype} -> {dtype}")
+
+
+def _cast_decimal128(v: TypedValue, dtype: DataType, precision: int,
+                     scale: int) -> TypedValue:
+    """Casts touching the two-limb representation: rescale between wide
+    and narrow decimals (HALF_UP, overflow→null), to float, to ints, and
+    to string via the host (reference: arrow/cast.rs decimal arms)."""
+    from auron_tpu.columnar import decimal128 as D
+    validity = v.validity
+    hi, lo = _limbs_of(v)
+    if dtype == DataType.DECIMAL:
+        ds = scale - v.scale
+        if ds >= 0:
+            hi2, lo2 = D.mul_pow10(hi, lo, ds)
+        else:
+            hi2, lo2 = D.div_pow10_half_up(hi, lo, -ds)
+        ok = D.fits_precision(hi2, lo2, precision)
+        return _mk_decimal(hi2, lo2, validity & ok, precision, scale)
+    if dtype.is_floating or dtype == DataType.FLOAT64:
+        f = D.to_float64(hi, lo) / (10.0 ** v.scale)
+        return cast_value(TypedValue(PrimitiveColumn(f, validity),
+                                     DataType.FLOAT64), dtype)
+    if dtype.is_integer:
+        # truncate toward zero, then int64 range check (Spark)
+        qh, ql = D.div_pow10_trunc(hi, lo, v.scale)
+        v64, fits = D.to_int64(qh, ql)
+        target = _JNP[dtype]
+        return TypedValue(PrimitiveColumn(v64.astype(target),
+                                          validity & fits), dtype)
+    if dtype == DataType.STRING:
+        import jax
+        import numpy as np
+        cap = validity.shape[0]
+        width = 48  # 38 digits + sign + point + margin
+
+        def host(hi_np, lo_np, valid_np):
+            import decimal
+            ints = D.ints_from_limbs(hi_np, lo_np, valid_np)
+            chars = np.zeros((cap, width), np.uint8)
+            lens = np.zeros(cap, np.int32)
+            with decimal.localcontext() as dctx:
+                dctx.prec = 60
+                for i, x in enumerate(ints):
+                    if x is None:
+                        continue
+                    d = decimal.Decimal(x).scaleb(-v.scale)
+                    # plain notation, never scientific (Spark CAST output)
+                    b = format(d, "f").encode()[:width]
+                    chars[i, :len(b)] = np.frombuffer(b, np.uint8)
+                    lens[i] = len(b)
+            return chars, lens
+
+        chars, lens = jax.pure_callback(
+            host,
+            (jax.ShapeDtypeStruct((cap, width), jnp.uint8),
+             jax.ShapeDtypeStruct((cap,), jnp.int32)),
+            hi, lo, validity, vmap_method="sequential")
+        return TypedValue(StringColumn(chars, lens, validity),
+                          DataType.STRING)
+    raise NotImplementedError(f"decimal128 cast to {dtype}")
 
 
 def _cast_to_string(v: TypedValue) -> TypedValue:

@@ -25,8 +25,9 @@ from typing import Iterator, Optional
 
 import numpy as np
 
-from auron_tpu.columnar.serde import (HostBatch, HostList, HostPrimitive,
-                                      HostString, deserialize_host_batch)
+from auron_tpu.columnar.serde import (HostBatch, HostDecimal128, HostList,
+                                      HostPrimitive, HostString,
+                                      deserialize_host_batch)
 
 ORDER_WORDS_EXTRA = "order_words"
 #: per-key (word count, pad word) matrix — lets runs whose string keys
@@ -150,6 +151,11 @@ def _concat_host(parts: list[HostBatch]) -> HostBatch:
             cols.append(HostList(values, ev,
                                  np.concatenate([c.lens for c in cs]),
                                  np.concatenate([c.validity for c in cs])))
+        elif isinstance(cs[0], HostDecimal128):
+            cols.append(HostDecimal128(
+                np.concatenate([c.hi for c in cs]),
+                np.concatenate([c.lo for c in cs]),
+                np.concatenate([c.validity for c in cs])))
         else:
             cols.append(HostPrimitive(
                 np.concatenate([c.data for c in cs]),
@@ -169,6 +175,9 @@ def _reorder_host(batch: HostBatch, perm: np.ndarray) -> HostBatch:
             cols.append(HostList(take_rows(c.values, perm),
                                  take_rows(c.elem_valid, perm),
                                  c.lens[perm], c.validity[perm]))
+        elif isinstance(c, HostDecimal128):
+            cols.append(HostDecimal128(c.hi[perm], c.lo[perm],
+                                       c.validity[perm]))
         else:
             cols.append(HostPrimitive(c.data[perm], c.validity[perm]))
     return HostBatch(cols, len(perm))

@@ -336,13 +336,24 @@ def murmur3_columns(cols: list[Column], capacity: int,
     """Spark create_hashes: running int32 hash chained across columns."""
     hashes = jnp.full((capacity,), seed, jnp.int32)
     for col in cols:
+        _reject_decimal128(col)
         hashes = _hash_column_murmur(col, hashes)
     return hashes
+
+
+def _reject_decimal128(col) -> None:
+    from auron_tpu.columnar.decimal128 import Decimal128Column
+    if isinstance(col, Decimal128Column):
+        raise NotImplementedError(
+            "hash partitioning / hash join / hash agg on decimal(>18) keys "
+            "is not supported yet — use sort-based operators (SMJ, range "
+            "partitioning) or cast the key")
 
 
 def xxhash64_columns(cols: list[Column], capacity: int, seed: int = 42) -> jax.Array:
     hashes = jnp.full((capacity,), seed, jnp.int64)
     for col in cols:
+        _reject_decimal128(col)
         hashes = _hash_column_xxhash(col, hashes)
     return hashes
 

@@ -42,7 +42,16 @@ def order_words(col, ascending: bool, nulls_first: bool) -> list[jax.Array]:
     """Normalize one sort key column into order-preserving uint64 words,
     most significant first (excluding the null-rank word, which the caller
     gets separately)."""
+    from auron_tpu.columnar.decimal128 import Decimal128Column
     words: list[jax.Array] = []
+    if isinstance(col, Decimal128Column):
+        # signed 128-bit order: sign-flipped hi limb, then unsigned lo
+        hi_w = col.hi.astype(jnp.uint64) ^ jnp.uint64(1 << 63)
+        lo_w = col.lo.astype(jnp.uint64)
+        words = [hi_w, lo_w]
+        if not ascending:
+            words = [~w for w in words]
+        return words
     if isinstance(col, StringColumn):
         chars = col.chars
         n, w = chars.shape

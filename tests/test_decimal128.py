@@ -1,0 +1,253 @@
+"""Decimal precision 19..38 — the two-limb device representation
+(columnar/decimal128.py; reference computes these in Rust i128:
+arrow/cast.rs decimal paths, spark_check_overflow.rs). Differential
+against python Decimal with exact contexts."""
+
+import decimal
+import random
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax.numpy as jnp
+
+from auron_tpu.columnar import decimal128 as D
+from auron_tpu.columnar.arrow_bridge import schema_from_arrow, to_arrow, to_device
+from auron_tpu.exprs import ir
+from auron_tpu.io.parquet import MemoryScanOp
+from auron_tpu.ops.project import ProjectOp
+from auron_tpu.ops.sort import SortOp
+from auron_tpu.runtime.executor import collect
+
+C = ir.ColumnRef
+decimal.getcontext().prec = 80
+
+
+def _dec_batch(vals, precision, scale, name="d"):
+    return pa.record_batch({name: pa.array(
+        [None if v is None else decimal.Decimal(v) for v in vals],
+        pa.decimal128(precision, scale))})
+
+
+def mem_scan(rb, capacity=16):
+    return MemoryScanOp([[rb]], schema_from_arrow(rb.schema),
+                        capacity=capacity)
+
+
+class TestLimbMath:
+    def test_random_roundtrip_and_ops(self):
+        random.seed(11)
+        N = 300
+        a = [random.randint(-10 ** 38 + 1, 10 ** 38 - 1) for _ in range(N)]
+        b = [random.randint(-10 ** 18, 10 ** 18) for _ in range(N)]
+        ah, al, _ = D.limbs_from_ints(a, N)
+        bh, bl, _ = D.limbs_from_ints(b, N)
+        ah, al, bh, bl = map(jnp.asarray, (ah, al, bh, bl))
+        wrap = lambda x: ((x + 2 ** 127) % 2 ** 128) - 2 ** 127
+
+        def to_py(h, l):
+            return D.ints_from_limbs(np.asarray(h), np.asarray(l),
+                                     np.ones(N, bool))
+
+        rh, rl = D.add128(ah, al, bh, bl)
+        assert to_py(rh, rl) == [wrap(x + y) for x, y in zip(a, b)]
+        rh, rl = D.mul128(ah, al, bh, bl)
+        assert to_py(rh, rl) == [wrap(x * y) for x, y in zip(a, b)]
+        for k in (3, 11, 20):
+            rh, rl = D.div_pow10_half_up(ah, al, k)
+            exp = [int(decimal.Decimal(x).scaleb(-k).to_integral_value(
+                rounding=decimal.ROUND_HALF_UP)) for x in a]
+            assert to_py(rh, rl) == exp, k
+            rh, rl = D.div_pow10_trunc(ah, al, k)
+            exp = [int(decimal.Decimal(x).scaleb(-k).to_integral_value(
+                rounding=decimal.ROUND_DOWN)) for x in a]
+            assert to_py(rh, rl) == exp, k
+
+
+class TestArrowRoundtrip:
+    def test_scan_project_collect(self):
+        vals = ["12345678901234567890123456.789", "-0.001", None,
+                "99999999999999999999999999999999.99"]
+        rb = _dec_batch(vals, 38, 3)
+        out = collect(ProjectOp(mem_scan(rb), [C(0)], ["d"]))
+        got = out.column("d").to_pylist()
+        exp = [None if v is None else decimal.Decimal(v).quantize(
+            decimal.Decimal(1).scaleb(-3)) for v in vals]
+        assert got == exp
+
+    def test_wide_arithmetic(self):
+        # products stay within precision 38 (overflow semantics tested
+        # separately): dec(22,2) operands with modest magnitudes
+        a = ["12345678901234567890.12", "-99999999999999999999.99", "0.01"]
+        b = ["87654321.01", "0.01", "-0.01"]
+        rb = pa.record_batch({
+            "a": pa.array([decimal.Decimal(x) for x in a],
+                          pa.decimal128(22, 2)),
+            "b": pa.array([decimal.Decimal(x) for x in b],
+                          pa.decimal128(22, 2)),
+        })
+        add = ir.BinaryExpr("+", C(0), C(1))
+        mul = ir.BinaryExpr("*", C(0), C(1))
+        lt = ir.BinaryExpr("<", C(0), C(1))
+        out = collect(ProjectOp(mem_scan(rb), [add, mul, lt],
+                                ["s", "m", "lt"]))
+        exp_s = [decimal.Decimal(x) + decimal.Decimal(y)
+                 for x, y in zip(a, b)]
+        assert out.column("s").to_pylist() == exp_s
+        exp_m = [decimal.Decimal(x) * decimal.Decimal(y)
+                 for x, y in zip(a, b)]
+        assert out.column("m").to_pylist() == exp_m
+        assert out.column("lt").to_pylist() == [
+            decimal.Decimal(x) < decimal.Decimal(y) for x, y in zip(a, b)]
+
+    def test_narrow_times_narrow_promotes_wide(self):
+        """dec(15,2) * dec(15,2) → dec(31,4): int64 payloads would wrap."""
+        a, b = "9999999999999.99", "9999999999999.99"
+        rb = pa.record_batch({
+            "a": pa.array([decimal.Decimal(a)], pa.decimal128(15, 2)),
+            "b": pa.array([decimal.Decimal(b)], pa.decimal128(15, 2)),
+        })
+        out = collect(ProjectOp(mem_scan(rb),
+                                [ir.BinaryExpr("*", C(0), C(1))], ["m"]))
+        assert out.column("m").to_pylist() == [
+            decimal.Decimal(a) * decimal.Decimal(b)]
+
+    def test_overflow_nulls(self):
+        big = decimal.Decimal(10) ** 37
+        rb = pa.record_batch({
+            "a": pa.array([big, decimal.Decimal(2)], pa.decimal128(38, 0)),
+            "b": pa.array([big, decimal.Decimal(3)], pa.decimal128(38, 0)),
+        })
+        out = collect(ProjectOp(mem_scan(rb),
+                                [ir.BinaryExpr("*", C(0), C(1))], ["m"]))
+        got = out.column("m").to_pylist()
+        assert got[0] is None            # 10^74 overflows precision 38
+        assert got[1] == decimal.Decimal(6)
+
+    def test_casts(self):
+        vals = ["123456789012345678901.5678", "-42.4444", "0.0001"]
+        rb = _dec_batch(vals, 38, 4)
+        from auron_tpu.columnar.schema import DataType
+        exprs = [
+            ir.Cast(C(0), DataType.DECIMAL, precision=38, scale=2),
+            ir.Cast(C(0), DataType.FLOAT64),
+            ir.Cast(C(0), DataType.INT64),
+            ir.Cast(C(0), DataType.STRING),
+        ]
+        out = collect(ProjectOp(mem_scan(rb), exprs,
+                                ["rescale", "f", "i", "s"]))
+        exp_rescale = [decimal.Decimal(v).quantize(
+            decimal.Decimal("0.01"),
+            rounding=decimal.ROUND_HALF_UP) for v in vals]
+        assert out.column("rescale").to_pylist() == exp_rescale
+        np.testing.assert_allclose(
+            out.column("f").to_pylist(),
+            [float(decimal.Decimal(v)) for v in vals], rtol=1e-12)
+        # index 0 exceeds int64 → null (Spark non-ANSI overflow-to-null)
+        assert out.column("i").to_pylist() == [None, -42, 0]
+        assert out.column("s").to_pylist() == vals
+
+    def test_int_to_wide_decimal(self):
+        from auron_tpu.columnar.schema import DataType
+        rb = pa.record_batch({"x": pa.array([123456789, -42], pa.int64())})
+        out = collect(ProjectOp(
+            mem_scan(rb),
+            [ir.Cast(C(0), DataType.DECIMAL, precision=30, scale=10)],
+            ["d"]))
+        assert out.column("d").to_pylist() == [
+            decimal.Decimal(123456789).quantize(
+                decimal.Decimal(1).scaleb(-10)),
+            decimal.Decimal(-42).quantize(decimal.Decimal(1).scaleb(-10))]
+
+    def test_sort_on_wide_decimal(self):
+        vals = ["5.00", "-12345678901234567890123.45", None,
+                "99999999999999999999999.99", "0.01"]
+        rb = _dec_batch(vals, 38, 2)
+        out = collect(SortOp(mem_scan(rb), [ir.SortOrder(C(0))]))
+        got = out.column("d").to_pylist()
+        nonnull = sorted(decimal.Decimal(v) for v in vals if v is not None)
+        assert got[0] is None and [g for g in got if g is not None] == [
+            v.quantize(decimal.Decimal("0.01")) for v in nonnull]
+
+
+class TestReviewFixes:
+    def test_ingest_exact_under_default_context(self):
+        """29-38 digit values must survive ingest/egress even when the
+        ambient decimal context is the 28-digit default."""
+        with decimal.localcontext() as ctx:
+            ctx.prec = 28   # the hostile default
+            v = "12345678901234567890123456789012.345678"
+            rb = _dec_batch([v], 38, 6)
+            out = collect(ProjectOp(mem_scan(rb), [C(0)], ["d"]))
+            with decimal.localcontext() as wide:
+                wide.prec = 60
+                assert out.column("d").to_pylist() == [decimal.Decimal(v)]
+
+    def test_string_cast_plain_notation(self):
+        from auron_tpu.columnar.schema import DataType
+        with decimal.localcontext() as ctx:
+            ctx.prec = 28
+            v = "1234567890123456789012345678901234.5678"
+            rb = _dec_batch([v], 38, 4)
+            out = collect(ProjectOp(mem_scan(rb),
+                                    [ir.Cast(C(0), DataType.STRING)], ["s"]))
+            assert out.column("s").to_pylist() == [v]
+
+    def test_precision_loss_scale_adjustment(self):
+        """dec(38,10) + dec(38,10) → dec(38,9) (Spark adjustPrecisionScale),
+        value rescaled HALF_UP."""
+        a = decimal.Decimal("1.0000000005")
+        b = decimal.Decimal("2.0000000000")
+        rb = pa.record_batch({
+            "a": pa.array([a], pa.decimal128(38, 10)),
+            "b": pa.array([b], pa.decimal128(38, 10)),
+        })
+        out = collect(ProjectOp(mem_scan(rb),
+                                [ir.BinaryExpr("+", C(0), C(1))], ["s"]))
+        f = out.schema.field("s")
+        assert (f.type.precision, f.type.scale) == (38, 9)
+        assert out.column("s").to_pylist() == [decimal.Decimal("3.000000001")]
+
+    def test_float_to_wide_decimal(self):
+        from auron_tpu.columnar.schema import DataType
+        rb = pa.record_batch({"x": pa.array([1e20, -2.5], pa.float64())})
+        out = collect(ProjectOp(
+            mem_scan(rb),
+            [ir.Cast(C(0), DataType.DECIMAL, precision=38, scale=1)], ["d"]))
+        got = out.column("d").to_pylist()
+        assert got[0] == decimal.Decimal(10) ** 20
+        assert got[1] == decimal.Decimal("-2.5")
+
+    def test_wide_decimal_spills_through_sort(self):
+        """External sort of wide decimals: spill serde round-trips limbs."""
+        from auron_tpu.memmgr.manager import MemManager
+        from auron_tpu.memmgr.spill import SpillManager
+        rng = random.Random(3)
+        vals = [decimal.Decimal(rng.randint(-10 ** 30, 10 ** 30))
+                .scaleb(-2) for _ in range(2000)]
+        rb = pa.record_batch({"d": pa.array(vals, pa.decimal128(38, 2))})
+        rbs = [rb.slice(o, 256) for o in range(0, 2000, 256)]
+        mm = MemManager(total_bytes=24 << 10, min_trigger=0,
+                        spill_manager=SpillManager(host_budget_bytes=1 << 24))
+        scan = MemoryScanOp([rbs], schema_from_arrow(rb.schema),
+                            capacity=256)
+        out = collect(SortOp(scan, [ir.SortOrder(C(0))]), mem_manager=mm)
+        assert mm.num_spills > 0
+        got = out.column("d").to_pylist()
+        assert got == sorted(vals)
+
+    def test_rescale_wrap_guard_on_compare(self):
+        """Comparing wildly different scales must not wrap: 10^21 at
+        scale 0 vs tiny at scale 18."""
+        rb = pa.record_batch({
+            "a": pa.array([decimal.Decimal(10) ** 21], pa.decimal128(38, 0)),
+            "b": pa.array([decimal.Decimal("0.000000000000000001")],
+                          pa.decimal128(38, 18)),
+        })
+        out = collect(ProjectOp(mem_scan(rb),
+                                [ir.BinaryExpr("<", C(0), C(1)),
+                                 ir.BinaryExpr(">", C(0), C(1))],
+                                ["lt", "gt"]))
+        assert out.column("lt").to_pylist() == [False]
+        assert out.column("gt").to_pylist() == [True]

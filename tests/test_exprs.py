@@ -205,5 +205,18 @@ def test_decimal_arith():
     })
     assert eval_to_list(ir.BinaryExpr("+", C(0), C(1)), rb) == [200, 325, None]  # unscaled s=2
     assert eval_to_list(ir.BinaryExpr("<", C(0), C(1)), rb) == [False, False, None]
-    out = eval_to_list(ir.BinaryExpr("*", C(0), C(1)), rb)
-    assert out == [7500, 22500, None]  # unscaled s=4
+    # dec(10,2) * dec(10,2) -> dec(21,4): promoted to the two-limb
+    # representation (round-3 decimal-38 support)
+    from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+    from auron_tpu.columnar.arrow_bridge import to_device
+    from auron_tpu.columnar.decimal128 import (Decimal128Column,
+                                               ints_from_limbs)
+    from auron_tpu.exprs.eval import evaluate
+    batch, schema = to_device(rb, capacity=16)
+    tv = evaluate(ir.BinaryExpr("*", C(0), C(1)), batch, schema)
+    assert isinstance(tv.col, Decimal128Column)
+    assert (tv.precision, tv.scale) == (21, 4)
+    got = ints_from_limbs(np.asarray(tv.col.hi[:3]),
+                          np.asarray(tv.col.lo[:3]),
+                          np.asarray(tv.validity[:3]))
+    assert got == [7500, 22500, None]  # unscaled s=4
