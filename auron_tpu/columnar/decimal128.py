@@ -194,6 +194,9 @@ def div_pow10_half_up(h, l, k: int):
     """value / 10^k with HALF_UP rounding (Spark decimal rescale-down)."""
     if k == 0:
         return h, l
+    if k >= 39:
+        # |value| < 10^38 < 0.5 * 10^k: always rounds to zero
+        return jnp.zeros_like(h), jnp.zeros_like(l)
     neg = is_negative(h, l)
     ah, al = abs128(h, l)
     # q, r = divmod(value, 10^k) in <=9-digit chunks. Dividing by d1 then
@@ -213,10 +216,15 @@ def div_pow10_half_up(h, l, k: int):
         rem_h, rem_l = add128(rem_h, rem_l, rh_, rl_)
         rem_exp += step
         kk -= step
-    # HALF_UP: round away from zero when remainder*2 >= 10^k
-    r2h, r2l = add128(rem_h, rem_l, rem_h, rem_l)
-    th, tl = _pow10_limbs(k)
-    lt, _eq = cmp128(r2h, r2l, jnp.int64(th), jnp.int64(tl))
+    # HALF_UP: round away from zero when remainder >= 5 * 10^(k-1).
+    # (Comparing 2*remainder against 10^k would signed-wrap for k=38
+    # remainders >= 2^126.)
+    half = 5 * 10 ** (k - 1)
+    mask = (1 << 64) - 1
+    t_lo = half & mask
+    t_hi = (half >> 64) & mask
+    t_lo = t_lo - (1 << 64) if t_lo >= 1 << 63 else t_lo
+    lt, _eq = cmp128(rem_h, rem_l, jnp.int64(t_hi), jnp.int64(t_lo))
     bump = (~lt).astype(I64)
     ah, al = add128(ah, al, jnp.zeros_like(h), bump)
     nh, nl = neg128(ah, al)

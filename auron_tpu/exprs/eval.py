@@ -78,6 +78,11 @@ def null_column_for_field(field, cap: int):
     if field.dtype == DataType.STRING:
         return StringColumn(jnp.zeros((cap, 8), jnp.uint8),
                             jnp.zeros(cap, jnp.int32), jnp.zeros(cap, bool))
+    if field.dtype == DataType.DECIMAL and field.precision > 18:
+        from auron_tpu.columnar.decimal128 import Decimal128Column
+        return Decimal128Column(jnp.zeros(cap, jnp.int64),
+                                jnp.zeros(cap, jnp.int64),
+                                jnp.zeros(cap, bool))
     return PrimitiveColumn(jnp.zeros(cap, _JNP[field.dtype]),
                            jnp.zeros(cap, bool))
 
@@ -504,14 +509,17 @@ def _eval_decimal128_binary(op, l: TypedValue, r: TypedValue, rp: int,
         ah, al, oka = rescale_safe(lh, ll_, s - l.scale)
         bh, bl, okb = rescale_safe(rh, rl, s - r.scale)
         lt, eq = D.cmp128(ah, al, bh, bl)
-        # unsafe rescale rows: exact limb compare is wrapped garbage —
-        # float64 ordering is correct there (magnitudes >= 1e19 apart
-        # from any representable tie)
-        fa = D.to_float64(lh, ll_) / (10.0 ** l.scale)
-        fb = D.to_float64(rh, rl) / (10.0 ** r.scale)
-        unsafe = ~(oka & okb)
-        lt = jnp.where(unsafe, fa < fb, lt)
-        eq = jnp.where(unsafe, fa == fb, eq)
+        # At most ONE side can be rescale-unsafe (only the smaller-scale
+        # side has ds > 0), and an unsafe side's magnitude at the common
+        # scale is >= 10^38 while a safe side's is < 10^38 — so the
+        # unsafe side strictly dominates and its SIGN decides the order.
+        a_unsafe = ~oka
+        b_unsafe = ~okb
+        a_neg = D.is_negative(lh, ll_)
+        b_neg = D.is_negative(rh, rl)
+        lt = jnp.where(a_unsafe, a_neg,
+                       jnp.where(b_unsafe, ~b_neg, lt))
+        eq = jnp.where(a_unsafe | b_unsafe, False, eq)
         out = {"==": eq, "!=": ~eq, "<": lt, "<=": lt | eq,
                ">": ~(lt | eq), ">=": ~lt}[op]
         return TypedValue(PrimitiveColumn(out, validity), DataType.BOOL)
@@ -520,9 +528,17 @@ def _eval_decimal128_binary(op, l: TypedValue, r: TypedValue, rp: int,
         bh, bl, okb = rescale_safe(rh, rl, s - r.scale)
         if op == "+":
             oh, ol = D.add128(ah, al, bh, bl)
+            bsign = D.is_negative(bh, bl)
         else:
             oh, ol = D.sub128(ah, al, bh, bl)
-        ok = oka & okb
+            bsign = ~D.is_negative(bh, bl) & ~((bh == 0) & (bl == 0))
+        # 128-bit wrap detection: same-sign operands whose result flips
+        # sign overflowed 2^127 (would otherwise slip past the
+        # post-rescale precision check as a plausible wrong value)
+        asign = D.is_negative(ah, al)
+        osign = D.is_negative(oh, ol)
+        no_wrap = ~((asign == bsign) & (osign != asign))
+        ok = oka & okb & no_wrap
     elif op == "*":
         oh, ol = D.mul128(lh, ll_, rh, rl)
         # a RAW product beyond 2^127 wraps silently in the low-128

@@ -370,6 +370,69 @@ def _q13_oracle(p):
     return g
 
 
+# --------------------------------------------------------------------------
+# q14: round-3 scalar-function pipeline — string kernels + fused split
+# over a dim table, grouped aggregation on a derived key
+# --------------------------------------------------------------------------
+
+def _q14_run(s, t):
+    return (_dim(s, t, "item")
+            .select(col("i_item_sk"),
+                    F.initcap(col("i_category")).alias("cat_title"),
+                    F.substring_index(col("i_brand"), lit("#"), lit(1))
+                    .alias("brand_name"),
+                    F.concat_ws(lit("/"), col("i_category"),
+                                col("i_brand")).alias("path"))
+            .filter(col("i_item_sk") >= 0)
+            .group_by("cat_title")
+            .agg(F.count_star().alias("n"),
+                 F.min(col("path")).alias("first_path"))
+            .sort(col("cat_title").asc())
+            .collect())
+
+
+def _q14_oracle(p):
+    it = p["item"].copy()
+    it["cat_title"] = it.i_category.str.title()
+    it["path"] = it.i_category + "/" + it.i_brand
+    g = (it.groupby("cat_title")
+           .agg(n=("i_item_sk", "size"), first_path=("path", "min"))
+           .reset_index())
+    return g.sort_values("cat_title")[["cat_title", "n", "first_path"]]
+
+
+# --------------------------------------------------------------------------
+# q15: wide decimals — cast to decimal(25,2), multiply (promotes past 18
+# digits onto the two-limb kernels), sort on the wide result
+# --------------------------------------------------------------------------
+
+def _q15_run(s, t):
+    return (_sales(s, t)
+            .select(col("ss_item_sk"),
+                    (col("ss_sales_price").cast(DataType.DECIMAL, 25, 2)
+                     * col("ss_quantity").cast(DataType.DECIMAL, 20, 0))
+                    .alias("rev_dec"))
+            .filter(col("ss_item_sk") < 50)
+            .sort(col("rev_dec").desc(), col("ss_item_sk").asc(), limit=25)
+            .collect())
+
+
+def _q15_oracle(p):
+    import decimal
+    with decimal.localcontext() as ctx:
+        ctx.prec = 60
+        ss = p["store_sales"]
+        f = ss[ss.ss_item_sk < 50].copy()
+        q = decimal.Decimal("0.01")
+        f["rev_dec"] = [
+            (decimal.Decimal(str(round(px, 2))).quantize(q)
+             * decimal.Decimal(int(n)))
+            for px, n in zip(f.ss_sales_price, f.ss_quantity)]
+        out = f.sort_values(["rev_dec", "ss_item_sk"],
+                            ascending=[False, True]).head(25)
+        return out[["ss_item_sk", "rev_dec"]]
+
+
 QUERIES = [
     Query("q01_filter_agg", "scan→filter→two-phase agg", _q01_run, _q01_oracle),
     Query("q02_topk_revenue", "agg→exchange→global sort+limit", _q02_run, _q02_oracle),
@@ -383,5 +446,7 @@ QUERIES = [
     Query("q10_having", "agg→filter-on-aggregate", _q10_run, _q10_oracle),
     Query("q11_union", "union of branches→agg", _q11_run, _q11_oracle),
     Query("q12_computed_topk", "project arithmetic→top-k", _q12_run, _q12_oracle),
+    Query("q14_string_functions", "round-3 string fns→agg", _q14_run, _q14_oracle),
+    Query("q15_wide_decimal", "decimal(>18) arith→sort", _q15_run, _q15_oracle),
     Query("q13_distinct_buyers", "nested aggs through exchange", _q13_run, _q13_oracle),
 ]

@@ -91,6 +91,15 @@ _STR_KINDS = ("smin", "smax", "sfirst", "sfirst_ign")
 
 def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
     fn = agg.fn
+    if agg.arg is not None:
+        _dt, _p, _s = infer_dtype(agg.arg, in_schema)
+        if _dt == DataType.DECIMAL and _p > 18:
+            # wide decimals live in two-limb columns the accumulator
+            # kernels don't speak yet; fail fast with guidance instead of
+            # an AttributeError deep in the merge kernel
+            raise NotImplementedError(
+                f"{fn} over decimal(p={_p}>18): aggregate wide decimals "
+                "after casting to decimal(<=18) or double")
     if agg.distinct:
         # DISTINCT state rides the collect_set accumulator: the merge
         # kernel already dedupes per group, so count/sum/avg finalize

@@ -342,9 +342,14 @@ class HashJoinOp(PhysicalOp):
 
 
 def _null_column_like(col, cap):
+    from auron_tpu.columnar.decimal128 import Decimal128Column
     if isinstance(col, StringColumn):
         return StringColumn(jnp.zeros((cap, col.width), jnp.uint8),
                             jnp.zeros(cap, jnp.int32), jnp.zeros(cap, bool))
+    if isinstance(col, Decimal128Column):
+        return Decimal128Column(jnp.zeros(cap, jnp.int64),
+                                jnp.zeros(cap, jnp.int64),
+                                jnp.zeros(cap, bool))
     return PrimitiveColumn(jnp.zeros(cap, col.data.dtype), jnp.zeros(cap, bool))
 
 

@@ -251,3 +251,64 @@ class TestReviewFixes:
                                 ["lt", "gt"]))
         assert out.column("lt").to_pylist() == [False]
         assert out.column("gt").to_pylist() == [True]
+
+
+class TestWrapGuards:
+    def test_add_wrap_nulls_not_wrong_value(self):
+        """Raw sum past 2^127 must null, not return a wrapped value."""
+        v = decimal.Decimal(9) * 10 ** 27   # unscaled 9e37 at scale 10
+        rb = pa.record_batch({
+            "a": pa.array([v], pa.decimal128(38, 10)),
+            "b": pa.array([v], pa.decimal128(38, 10)),
+        })
+        out = collect(ProjectOp(mem_scan(rb),
+                                [ir.BinaryExpr("+", C(0), C(1))], ["s"]))
+        assert out.column("s").to_pylist() == [None]
+
+    def test_halfup_boundary_k38(self):
+        """k=38 rescale with remainder >= 2^126: the bump test must not
+        signed-wrap (0.9 at scale 38 → 1 at scale 0)."""
+        from auron_tpu.columnar.schema import DataType
+        rb = _dec_batch(["0.9" + "0" * 36], 38, 38)
+        out = collect(ProjectOp(
+            mem_scan(rb),
+            [ir.Cast(C(0), DataType.DECIMAL, precision=38, scale=0)], ["r"]))
+        assert out.column("r").to_pylist() == [decimal.Decimal(1)]
+
+    def test_high_scale_mul_rescale_past_38(self):
+        """full_s - adjusted_s > 38 must not crash (rounds to the adjusted
+        scale; tiny values become zero)."""
+        rb = pa.record_batch({
+            "a": pa.array([decimal.Decimal("0." + "0" * 35 + "5")],
+                          pa.decimal128(38, 36)),
+            "b": pa.array([decimal.Decimal("0." + "0" * 35 + "4")],
+                          pa.decimal128(38, 36)),
+        })
+        out = collect(ProjectOp(mem_scan(rb),
+                                [ir.BinaryExpr("*", C(0), C(1))], ["m"]))
+        got = out.column("m").to_pylist()
+        assert got == [decimal.Decimal(0).scaleb(-6).quantize(
+            decimal.Decimal(1).scaleb(-6))]
+
+    def test_unsafe_compare_boundary_not_equal(self):
+        """Values float64 cannot distinguish must still order correctly
+        via sign dominance (the float fallback reported equality here)."""
+        rb = pa.record_batch({
+            "a": pa.array([decimal.Decimal(10) ** 20], pa.decimal128(38, 0)),
+            "b": pa.array([decimal.Decimal("99999999999999999999."
+                                           "999999999999999999")],
+                          pa.decimal128(38, 18)),
+        })
+        out = collect(ProjectOp(mem_scan(rb),
+                                [ir.BinaryExpr(">", C(0), C(1)),
+                                 ir.BinaryExpr("==", C(0), C(1))],
+                                ["gt", "eq"]))
+        assert out.column("gt").to_pylist() == [True]
+        assert out.column("eq").to_pylist() == [False]
+
+    def test_wide_agg_rejects_clearly(self):
+        from auron_tpu.ops.agg import AggOp
+        rb = _dec_batch(["1.00"], 25, 2)
+        with pytest.raises(NotImplementedError, match="decimal"):
+            AggOp(mem_scan(rb), [], [ir.AggFunction("sum", C(0))],
+                  mode="complete")
