@@ -1,0 +1,89 @@
+"""Spark version shims for plan ingestion.
+
+The reference compiles one Scala source tree per Spark version with the
+``@sparkver`` whitebox macro enabling/disabling defs per version, plus a
+60-method ``Shims`` seam (reference: spark-version-annotation-macros/
+sparkver.scala:24-94, spark-extension/.../Shims.scala:64-293). This
+engine ingests serialized plan JSON instead of linking against Spark, so
+the seam collapses to data: per-version tables of (a) plan wrappers that
+are transparent, (b) expression wrappers that are semantically identity
+or reduce to casts, and (c) class renames across versions. One converter
+source serves Spark 3.0..4.x by consulting the shims for the session's
+version.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import total_ordering
+
+
+@total_ordering
+@dataclass(frozen=True)
+class SemanticVersion:
+    """'3.5.1' style version with comparison (reference: common/
+    SemanticVersion.scala)."""
+
+    major: int
+    minor: int
+    patch: int = 0
+
+    @classmethod
+    def parse(cls, s: str) -> "SemanticVersion":
+        parts = (s.split("-")[0].split(".") + ["0", "0"])[:3]
+        return cls(int(parts[0]), int(parts[1] or 0), int(parts[2] or 0))
+
+    def _key(self):
+        return (self.major, self.minor, self.patch)
+
+    def __lt__(self, other):
+        return self._key() < other._key()
+
+    def __str__(self):
+        return f"{self.major}.{self.minor}.{self.patch}"
+
+
+class SparkShims:
+    """Version-conditioned ingestion tables."""
+
+    def __init__(self, version: str = "3.5.0"):
+        #: retained as the gating hook: the ingestion tables below are
+        #: deliberately version-TOLERANT supersets (a plan recorded on
+        #: Spark 3.0 must ingest under a 3.5 session and vice versa), so
+        #: nothing currently branches on it; a genuinely incompatible
+        #: future difference gates here with `self.version >= V(x, y)`.
+        self.version = SemanticVersion.parse(version)
+
+        #: plan nodes that wrap a single child transparently — both AQE
+        #: reader spellings accepted (renamed in 3.2:
+        #: CustomShuffleReaderExec → AQEShuffleReadExec)
+        self.transparent_plan = {
+            "WholeStageCodegenExec", "InputAdapter",
+            "AdaptiveSparkPlanExec", "QueryStageExec",
+            "ShuffleQueryStageExec", "BroadcastQueryStageExec",
+            "ReusedExchangeExec",
+            "AQEShuffleReadExec", "CustomShuffleReaderExec",
+        }
+
+        #: expression wrappers that evaluate to their child.
+        #: PromotePrecision existed through 3.3 (removed in 3.4 —
+        #: SPARK-39316); the normalization wrappers are identity for
+        #: engine semantics (this engine already canonicalizes NaN/-0.0
+        #: in its hash/sort kernels).
+        self.identity_exprs = {"KnownFloatingPointNormalized",
+                               "KnownNotNull", "PromotePrecision"}
+
+        #: CheckOverflow(child, dtype, nullOnOverflow) reduces to a
+        #: decimal cast in this engine when nullOnOverflow is true (the
+        #: cast path implements the overflow-to-null contract); present
+        #: in all 3.x
+        self.overflow_wrappers = {"CheckOverflow", "CheckOverflowInSum"}
+
+    def is_transparent_plan(self, cls: str) -> bool:
+        return cls in self.transparent_plan
+
+    def is_identity_expr(self, cls: str) -> bool:
+        return cls in self.identity_exprs
+
+    def is_overflow_wrapper(self, cls: str) -> bool:
+        return cls in self.overflow_wrappers

@@ -171,12 +171,29 @@ _LITERAL_ARGS = {
 
 
 class ExprConverter:
-    def __init__(self, attrs: list[Attr]):
+    def __init__(self, attrs: list[Attr], shims=None):
+        from auron_tpu.integration.shims import SparkShims
         self.index_of = {a.expr_id: i for i, a in enumerate(attrs)}
         self.attrs = attrs
+        self.shims = shims or SparkShims()
 
     def convert(self, e: SparkNode) -> pb.ExprNode:
         cls = e.simple_name
+        # version shims: identity wrappers unwrap; overflow wrappers
+        # (CheckOverflow around decimal arith) reduce to a decimal cast
+        # whose non-ANSI path IS the null-on-overflow contract
+        if self.shims.is_identity_expr(cls):
+            return self.convert(e.children[0])
+        if self.shims.is_overflow_wrapper(cls):
+            if e.fields.get("nullOnOverflow") is False:
+                # ANSI mode: Spark RAISES on overflow; the engine's cast
+                # nulls — fall back rather than silently diverge
+                raise NotImplementedError(
+                    "CheckOverflow with nullOnOverflow=false (ANSI)")
+            dt, p, s = _dtype_to_proto(str(e.fields.get("dataType", "")))
+            return pb.ExprNode(cast=pb.CastE(
+                child=self.convert(e.children[0]), dtype=dt, precision=p,
+                scale=s))
         if cls == "AttributeReference":
             eid = _expr_id(e.fields)
             if eid not in self.index_of:
@@ -325,11 +342,14 @@ _TRANSPARENT = ("WholeStageCodegenExec", "InputAdapter",
 class SparkPlanConverter:
     """One-shot converter for a recorded plan. ``path_rewrite`` maps the
     recorded file paths into the local filesystem (fixtures record the
-    original cluster paths)."""
+    original cluster paths). ``spark_version`` selects the version shims
+    (integration/shims.py — the @sparkver seam analogue)."""
 
-    def __init__(self, path_rewrite=None):
+    def __init__(self, path_rewrite=None, spark_version: str = "3.5.0"):
+        from auron_tpu.integration.shims import SparkShims
         self.path_rewrite = path_rewrite or (lambda p: p)
         self.report = ConversionReport()
+        self.shims = SparkShims(spark_version)
         self._fallback_ids = 0
 
     # -- public entry -------------------------------------------------------
@@ -348,7 +368,7 @@ class SparkPlanConverter:
 
     def _convert(self, node: SparkNode) -> _Converted:
         cls = node.simple_name
-        if cls in _TRANSPARENT:
+        if cls in _TRANSPARENT or self.shims.is_transparent_plan(cls):
             return self._convert(node.children[0])
         handler = getattr(self, f"_c_{cls}", None)
         try:
@@ -433,7 +453,7 @@ class SparkPlanConverter:
 
     def _c_FilterExec(self, node: SparkNode) -> _Converted:
         child = self._convert(node.children[0])
-        ec = ExprConverter(child.attrs)
+        ec = ExprConverter(child.attrs, self.shims)
         cond = node.field_tree("condition")
         n = pb.PlanNode(filter=pb.FilterNode(
             child=child.node, predicates=[ec.convert(cond)]))
@@ -441,7 +461,7 @@ class SparkPlanConverter:
 
     def _project(self, child: _Converted,
                  project_list: list) -> _Converted:
-        ec = ExprConverter(child.attrs)
+        ec = ExprConverter(child.attrs, self.shims)
         exprs, names, attrs = [], [], []
         for t in project_list:
             exprs.append(ec.convert(t))
@@ -462,7 +482,7 @@ class SparkPlanConverter:
 
     def _c_SortExec(self, node: SparkNode) -> _Converted:
         child = self._convert(node.children[0])
-        ec = ExprConverter(child.attrs)
+        ec = ExprConverter(child.attrs, self.shims)
         orders = [ec.sort_order(t) for t in node.field_trees("sortOrder")]
         n = pb.PlanNode(sort=pb.SortNode(child=child.node,
                                          sort_orders=orders, fetch=-1))
@@ -470,7 +490,7 @@ class SparkPlanConverter:
 
     def _c_TakeOrderedAndProjectExec(self, node: SparkNode) -> _Converted:
         child = self._convert(node.children[0])
-        ec = ExprConverter(child.attrs)
+        ec = ExprConverter(child.attrs, self.shims)
         orders = [ec.sort_order(t) for t in node.field_trees("sortOrder")]
         limit = int(node.fields.get("limit", -1))
         # global top-k: map-side SortNode(fetch=k) per partition so only
@@ -546,7 +566,7 @@ class SparkPlanConverter:
 
     def _c_ShuffleExchangeExec(self, node: SparkNode) -> _Converted:
         child = self._convert(node.children[0])
-        ec = ExprConverter(child.attrs)
+        ec = ExprConverter(child.attrs, self.shims)
         ptree = node.field_tree("outputPartitioning")
         part, n_out = self._partitioning(ptree, ec)
         n = pb.PlanNode(shuffle_writer=pb.ShuffleWriterNode(
@@ -584,7 +604,7 @@ class SparkPlanConverter:
             raise NotImplementedError("BuildLeft broadcast join")
         left = self._convert(node.children[0])
         right = self._convert(node.children[1])
-        lec, rec = ExprConverter(left.attrs), ExprConverter(right.attrs)
+        lec, rec = ExprConverter(left.attrs, self.shims), ExprConverter(right.attrs, self.shims)
         lk = [lec.convert(t) for t in node.field_trees("leftKeys")]
         rk = [rec.convert(t) for t in node.field_trees("rightKeys")]
         n = pb.PlanNode(hash_join=pb.HashJoinNode(
@@ -599,7 +619,7 @@ class SparkPlanConverter:
         jt = self._join_common(node)
         left = self._convert(node.children[0])
         right = self._convert(node.children[1])
-        lec, rec = ExprConverter(left.attrs), ExprConverter(right.attrs)
+        lec, rec = ExprConverter(left.attrs, self.shims), ExprConverter(right.attrs, self.shims)
         lk = [lec.convert(t) for t in node.field_trees("leftKeys")]
         rk = [rec.convert(t) for t in node.field_trees("rightKeys")]
         n = pb.PlanNode(sort_merge_join=pb.SortMergeJoinNode(
@@ -651,7 +671,7 @@ class SparkPlanConverter:
     def _c_HashAggregateExec(self, node: SparkNode) -> _Converted:
         child = self._convert(node.children[0])
         groups, agg_exprs, mode = self._agg_parts(node)
-        ec = ExprConverter(child.attrs)
+        ec = ExprConverter(child.attrs, self.shims)
         group_names = [g.fields.get("name", f"k{i}")
                        for i, g in enumerate(groups)]
 

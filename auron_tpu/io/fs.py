@@ -1,0 +1,69 @@
+"""Filesystem abstraction for scans, sinks and the shuffle service.
+
+The reference reaches every byte of storage through a JVM Hadoop
+FileSystem wrapper (reference: datafusion-ext-commons/src/hadoop_fs.rs,
+scan/internal_file_reader.rs, the hadoop-shim module), so one seam serves
+local disk, HDFS and object stores. The TPU engine's seam is pyarrow's
+FileSystem layer: ``resolve`` maps a URI to (filesystem, fs-local path),
+with built-in schemes (file, s3, gs, hdfs) and a registry for custom
+providers — the extension point a deployment uses to mount its own store
+(the FsProvider role)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+from urllib.parse import urlparse
+
+import pyarrow.fs as pafs
+
+#: scheme → factory(netloc) -> (FileSystem, path_prefix)
+_PROVIDERS: dict[str, Callable] = {}
+
+
+def register_filesystem(scheme: str, factory: Callable) -> None:
+    """factory(netloc: str) -> (pyarrow.fs.FileSystem, path_prefix: str);
+    the fs-local path is path_prefix + uri.path."""
+    _PROVIDERS[scheme] = factory
+
+
+def resolve(path: str) -> tuple[pafs.FileSystem, str]:
+    """URI or plain path → (filesystem, fs-local path)."""
+    parsed = urlparse(path)
+    scheme = parsed.scheme
+    if not scheme or len(scheme) == 1:       # plain / windows-drive path
+        return pafs.LocalFileSystem(), path
+    if scheme in _PROVIDERS:
+        fs, prefix = _PROVIDERS[scheme](parsed.netloc)
+        return fs, prefix + parsed.path
+    if scheme == "file":
+        return pafs.LocalFileSystem(), parsed.path
+    if scheme == "s3":
+        return pafs.S3FileSystem(), parsed.netloc + parsed.path
+    if scheme in ("gs", "gcs"):
+        return pafs.GcsFileSystem(), parsed.netloc + parsed.path
+    if scheme in ("hdfs", "viewfs"):
+        host, _, port = parsed.netloc.partition(":")
+        return (pafs.HadoopFileSystem(host or "default",
+                                      int(port) if port else 8020),
+                parsed.path)
+    raise NotImplementedError(
+        f"no filesystem provider for scheme {scheme!r} "
+        f"(register one with auron_tpu.io.fs.register_filesystem)")
+
+
+def resolve_many(paths: list[str]) -> tuple[Optional[pafs.FileSystem],
+                                            list[str]]:
+    """One filesystem for a file list (scans require a uniform scheme).
+    Returns (None, paths) for plain local paths — pyarrow's default."""
+    if not paths:
+        return None, paths
+    origins = {(urlparse(p).scheme, urlparse(p).netloc) for p in paths}
+    if len(origins) > 1:
+        raise ValueError(
+            f"mixed filesystem origins in one scan: {sorted(origins)} — "
+            "one (scheme, host) per scan")
+    scheme, _host = origins.pop()
+    if not scheme or len(scheme) == 1:
+        return None, list(paths)
+    resolved = [resolve(p) for p in paths]
+    return resolved[0][0], [r[1] for r in resolved]

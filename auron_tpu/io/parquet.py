@@ -69,7 +69,13 @@ class ParquetScanOp(PhysicalOp):
         self.columns = columns
         self.predicates = predicates or []
         self.batch_rows = batch_rows
-        ds = pa_ds.dataset(self.files, format=self._format)
+        # remote-FS seam (the reference reads through its JVM Hadoop
+        # FileSystem wrapper; here io/fs.py resolves URIs to pyarrow
+        # filesystems — hdfs://, s3://, gs://, registered providers)
+        from auron_tpu.io.fs import resolve_many
+        self._fs, self.files = resolve_many(self.files)
+        ds = pa_ds.dataset(self.files, format=self._format,
+                           filesystem=self._fs)
         arrow_schema = ds.schema
         if columns:
             arrow_schema = pa.schema([arrow_schema.field(c) for c in columns])
@@ -106,7 +112,8 @@ class ParquetScanOp(PhysicalOp):
         def host_batches():
             if not files:
                 return
-            ds = pa_ds.dataset(files, format=self._format)
+            ds = pa_ds.dataset(files, format=self._format,
+                               filesystem=self._fs)
             scanner = ds.scanner(columns=self.columns, filter=arrow_filter,
                                  batch_size=self.batch_rows)
             for rb in scanner.to_batches():

@@ -34,9 +34,20 @@ class _FileSinkOp(PhysicalOp):
     the reference sinks (parquet_sink_exec.rs)."""
 
     def __init__(self, child: PhysicalOp, path: str, compression: str):
+        from auron_tpu.io.fs import resolve
         self.child = child
-        self.path = path
+        #: remote-FS seam (io/fs.py): URI → (filesystem, fs-local path)
+        self.fs, self.path = resolve(path)
         self.compression = compression
+
+    def _makedirs(self) -> None:
+        self.fs.create_dir(self.path, recursive=True)
+
+    def _unlink(self, p: str) -> None:
+        try:
+            self.fs.delete_file(p)
+        except (OSError, FileNotFoundError):
+            pass
 
     @property
     def children(self):
@@ -89,6 +100,9 @@ class _FileSinkOp(PhysicalOp):
                     try:
                         with timer(io_time):
                             writer.close()
+                            for st in wstate.get("streams", ()):
+                                if not st.closed:
+                                    st.close()
                     except Exception:
                         # on the failure path a close() error (e.g. the
                         # same full disk) must not mask the original
@@ -114,11 +128,7 @@ class _FileSinkOp(PhysicalOp):
         wrote. Tracked paths first; subclasses extend for files a failed
         write call may have created before raising."""
         for p in wstate["paths"]:
-            try:
-                if os.path.exists(p):
-                    os.unlink(p)
-            except OSError:
-                pass
+            self._unlink(p)
 
     def __repr__(self):
         return f"{type(self).__name__}[{self.path}]"
@@ -145,7 +155,7 @@ class ParquetSinkOp(_FileSinkOp):
             collector: list = []
             pq.write_to_dataset(
                 chunk, root_path=self.path, partition_cols=self.partition_by,
-                compression=comp,
+                compression=comp, filesystem=self.fs,
                 basename_template=f"part-{partition:05d}-{seq:04d}-{{i}}"
                                   ".parquet",
                 metadata_collector=collector)
@@ -155,34 +165,50 @@ class ParquetSinkOp(_FileSinkOp):
                                                     .column(0).file_path))
             return None
         if writer is None:
-            os.makedirs(self.path, exist_ok=True)
-            target = os.path.join(self.path, f"part-{partition:05d}.parquet")
+            self._makedirs()
+            target = f"{self.path}/part-{partition:05d}.parquet"
             writer = pq.ParquetWriter(target, chunk.schema,
-                                      compression=comp or "none")
+                                      compression=comp or "none",
+                                      filesystem=self.fs)
             wstate["paths"].append(target)
         writer.write_table(chunk)
         return writer
 
     def _cleanup_failed(self, partition: int, wstate: dict) -> None:
         super()._cleanup_failed(partition, wstate)
-        if not self.partition_by or not os.path.isdir(self.path):
+        if not self.partition_by:
+            return
+        import pyarrow.fs as pafs
+        try:
+            infos = self.fs.get_file_info(
+                pafs.FileSelector(self.path, recursive=True,
+                                  allow_not_found=True))
+        except (OSError, FileNotFoundError):
             return
         # a write_to_dataset call that raised mid-write may have created
         # fragments never reported to the collector; this attempt's (and
         # any previous attempt's) fragments all carry this partition's
         # basename prefix, so a prefix sweep restores all-or-nothing
         prefix = f"part-{partition:05d}-"
-        for dirpath, _dirs, files in os.walk(self.path, topdown=False):
-            for f in files:
-                if f.startswith(prefix):
-                    try:
-                        os.unlink(os.path.join(dirpath, f))
-                    except OSError:
-                        pass
+        for info in infos:
+            if info.type == pafs.FileType.File and \
+                    info.base_name.startswith(prefix):
+                self._unlink(info.path)
+        # sweep now-empty hive key=value directories (deepest first)
+        try:
+            infos = self.fs.get_file_info(
+                pafs.FileSelector(self.path, recursive=True,
+                                  allow_not_found=True))
+        except (OSError, FileNotFoundError):
+            return
+        dirs = sorted((i.path for i in infos
+                       if i.type == pafs.FileType.Directory),
+                      key=len, reverse=True)
+        for d in dirs:
             try:
-                if dirpath != self.path and not os.listdir(dirpath):
-                    os.rmdir(dirpath)
-            except OSError:
+                if not self.fs.get_file_info(pafs.FileSelector(d)):
+                    self.fs.delete_dir(d)
+            except (OSError, FileNotFoundError):
                 pass
 
 
@@ -199,12 +225,16 @@ class OrcSinkOp(_FileSinkOp):
                      wstate: dict):
         from pyarrow import orc
         if writer is None:
-            os.makedirs(self.path, exist_ok=True)
-            target = os.path.join(self.path, f"part-{partition:05d}.orc")
+            self._makedirs()
+            target = f"{self.path}/part-{partition:05d}.orc"
+            sink_stream = self.fs.open_output_stream(target)
             writer = orc.ORCWriter(
-                target,
+                sink_stream,
                 compression=self._ORC_COMPRESSION.get(self.compression,
                                                       self.compression))
+            # ORCWriter.close() does NOT close the underlying stream; an
+            # unclosed object-store stream never finalizes its upload
+            wstate["streams"] = wstate.get("streams", []) + [sink_stream]
             wstate["paths"].append(target)
         writer.write(chunk)
         return writer

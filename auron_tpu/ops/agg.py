@@ -91,7 +91,8 @@ _STR_KINDS = ("smin", "smax", "sfirst", "sfirst_ign")
 
 def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
     fn = agg.fn
-    if agg.arg is not None:
+    if agg.arg is not None and fn not in ("count",):
+        # count only reads validity — wide decimals are fine there
         _dt, _p, _s = infer_dtype(agg.arg, in_schema)
         if _dt == DataType.DECIMAL and _p > 18:
             # wide decimals live in two-limb columns the accumulator

@@ -166,3 +166,49 @@ def test_parse_plan_roundtrip_structure():
         return 1 + sum(count(c) for c in n.children)
     # expression fields are separate flattened arrays, not plan children
     assert count(root) < len(plan) or count(root) == len(plan)
+
+
+class TestVersionShims:
+    """integration/shims.py — the @sparkver / Shims seam analogue."""
+
+    def test_semantic_version(self):
+        from auron_tpu.integration.shims import SemanticVersion as V
+        assert V.parse("3.5.1") > V.parse("3.5")
+        assert V.parse("3.2") >= V.parse("3.2.0")
+        assert V.parse("4.0.0-preview") > V.parse("3.5.4")
+        assert str(V.parse("3.3")) == "3.3.0"
+
+    def test_promote_precision_and_check_overflow_unwrap(self):
+        """Real Spark <=3.3 plans wrap decimal arithmetic in
+        PromotePrecision/CheckOverflow; both must convert (identity /
+        decimal cast) instead of falling back."""
+        from auron_tpu.integration.spark_converter import (ExprConverter,
+                                                           Attr)
+        from auron_tpu.integration.shims import SparkShims
+        from auron_tpu.integration.spark_plan import SparkNode
+
+        attr_node = SparkNode(
+            cls="org.apache.spark.sql.catalyst.expressions"
+                ".AttributeReference",
+            fields={"name": "d", "dataType": "decimal(12,2)",
+                    "exprId": {"id": 7}}, children=[])
+        wrapped = SparkNode(
+            cls="org.apache.spark.sql.catalyst.expressions.CheckOverflow",
+            fields={"dataType": "decimal(14,2)", "nullOnOverflow": True},
+            children=[SparkNode(
+                cls="org.apache.spark.sql.catalyst.expressions"
+                    ".PromotePrecision",
+                fields={}, children=[attr_node])])
+        ec = ExprConverter([Attr("d", 7, "decimal(12,2)")],
+                           SparkShims("3.3.0"))
+        out = ec.convert(wrapped)
+        assert out.WhichOneof("expr") == "cast"
+        assert out.cast.precision == 14 and out.cast.scale == 2
+        assert out.cast.child.WhichOneof("expr") == "column"
+
+    def test_aqe_reader_both_spellings_transparent(self):
+        from auron_tpu.integration.shims import SparkShims
+        for v in ("3.0.3", "3.5.1"):
+            sh = SparkShims(v)
+            assert sh.is_transparent_plan("CustomShuffleReaderExec")
+            assert sh.is_transparent_plan("AQEShuffleReadExec")
