@@ -108,6 +108,12 @@ def _result_field(spec: WindowFunctionSpec, name: str,
         if spec.fn in ("percent_rank", "cume_dist"):
             return Field(name, DataType.FLOAT64, False)
         return Field(name, DataType.INT64, False)
+    if spec.arg is not None and spec.fn not in ("count", "count_star"):
+        _dt, _p, _s = infer_dtype(spec.arg, in_schema)
+        if _dt == DataType.DECIMAL and _p > 18:
+            raise NotImplementedError(
+                f"window {spec.fn} over decimal(p={_p}>18): cast to "
+                "decimal(<=18) or double first")
     if spec.kind == "offset":
         dt, p, s = infer_dtype(spec.arg, in_schema)
         return Field(name, dt, True, p, s)
