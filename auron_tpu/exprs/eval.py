@@ -1103,29 +1103,79 @@ def _eval_host_udf(expr: ir.HostUDF, batch, schema, ctx) -> TypedValue:
     args = [evaluate(a, batch, schema, ctx) for a in expr.args]
     cap = batch.capacity
 
-    # Only primitive args/results for now; strings can be added via the
-    # (chars, lens) protocol when needed.
+    # argument wire: primitives as (data, validity); strings via the
+    # (chars, lens, validity) protocol (the Arrow-FFI round trip of the
+    # reference's SparkUDFWrapperContext, spark_udf_wrapper.rs:43-230)
+    leaves: list = []
+    layout: list[str] = []
     for a in args:
         if isinstance(a.col, StringColumn):
-            raise NotImplementedError("string args to HostUDF")
+            layout.append("s")
+            leaves += [a.col.chars, a.col.lens, a.validity]
+        else:
+            layout.append("p")
+            leaves += [a.data, a.validity]
 
-    out_np = _JNP[expr.dtype]
+    string_result = expr.dtype == DataType.STRING
+    out_np = None if string_result else _JNP[expr.dtype]
+    # result width bound: adaptive to the string inputs (a concat-style
+    # UDF fits), floored at 256; truncation happens on UTF-8 codepoint
+    # boundaries so an overflow can never corrupt the column
+    out_w = 0
+    if string_result:
+        in_w = sum(a.col.width for a in args
+                   if isinstance(a.col, StringColumn))
+        out_w = bucket_string_width(max(2 * in_w + 64, 256))
 
     def host(*cols):
-        n = len(cols) // 2
-        datas, oks = cols[:n], cols[n:]
-        arrays = [pa.array(np.where(ok, d, None).tolist() if not ok.all()
-                           else d) for d, ok in zip(datas, oks)]
+        arrays = []
+        pos = 0
+        for kind in layout:
+            if kind == "s":
+                chars, lens, ok = cols[pos:pos + 3]
+                pos += 3
+                vals = [bytes(chars[i, :lens[i]]).decode("utf-8", "replace")
+                        if ok[i] else None for i in range(cap)]
+                arrays.append(pa.array(vals, pa.string()))
+            else:
+                d, ok = cols[pos:pos + 2]
+                pos += 2
+                arrays.append(pa.array(
+                    np.where(ok, d, None).tolist() if not ok.all() else d))
         result = expr.fn(arrays)
-        res_np = np.asarray(result.fill_null(0).to_numpy(zero_copy_only=False),
-                            dtype=out_np)
-        ok = ~np.asarray(result.is_null())
+        ok = ~np.asarray(result.is_null()) if result.null_count \
+            else np.ones(cap, bool)
+        if string_result:
+            chars = np.zeros((cap, out_w), np.uint8)
+            lens = np.zeros(cap, np.int32)
+            for i, v in enumerate(result.to_pylist()):
+                if v is None:
+                    continue
+                b = v.encode()
+                if len(b) > out_w:
+                    b = b[:out_w]
+                    # back off to a codepoint boundary (0b10xxxxxx bytes
+                    # are continuations)
+                    while b and (b[-1] & 0xC0) == 0x80:
+                        b = b[:-1]
+                chars[i, :len(b)] = np.frombuffer(b, np.uint8)
+                lens[i] = len(b)
+            return chars, lens, ok
+        res_np = np.asarray(result.fill_null(0).to_numpy(
+            zero_copy_only=False), dtype=out_np)
         return res_np.astype(out_np), ok
 
+    if string_result:
+        chars, lens, ok = jax.pure_callback(
+            host,
+            (jax.ShapeDtypeStruct((cap, out_w), jnp.uint8),
+             jax.ShapeDtypeStruct((cap,), jnp.int32),
+             jax.ShapeDtypeStruct((cap,), jnp.bool_)),
+            *leaves, vmap_method="sequential")
+        return TypedValue(StringColumn(chars, lens, ok), DataType.STRING)
     data, ok = jax.pure_callback(
         host,
         (jax.ShapeDtypeStruct((cap,), out_np),
          jax.ShapeDtypeStruct((cap,), jnp.bool_)),
-        *[a.data for a in args], *[a.validity for a in args],
-        vmap_method="sequential")
+        *leaves, vmap_method="sequential")
     return TypedValue(PrimitiveColumn(data, ok), expr.dtype)

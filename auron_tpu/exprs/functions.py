@@ -314,6 +314,110 @@ def _least(args, expr, batch, schema, ctx):
     return out
 
 
+_UNARY_F64 = {
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "log10": jnp.log10, "log2": jnp.log2, "log1p": jnp.log1p,
+    "expm1": jnp.expm1, "cbrt": jnp.cbrt,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "rint": jnp.round,
+}
+
+
+def _register_unary_f64():
+    for fname, jfn in _UNARY_F64.items():
+        def make(jf):
+            def impl(args, expr, batch, schema, ctx):
+                v = cast_value(args[0], DataType.FLOAT64)
+                return TypedValue(PrimitiveColumn(jf(v.data), v.validity),
+                                  DataType.FLOAT64)
+            return impl
+        register(fname, DataType.FLOAT64)(make(jfn))
+
+
+_register_unary_f64()
+
+
+@register("signum", DataType.FLOAT64)
+@register("sign", DataType.FLOAT64)
+def _signum(args, expr, batch, schema, ctx):
+    v = cast_value(args[0], DataType.FLOAT64)
+    return TypedValue(PrimitiveColumn(jnp.sign(v.data), v.validity),
+                      DataType.FLOAT64)
+
+
+@register("atan2", DataType.FLOAT64)
+def _atan2(args, expr, batch, schema, ctx):
+    a = cast_value(args[0], DataType.FLOAT64)
+    b = cast_value(args[1], DataType.FLOAT64)
+    return TypedValue(PrimitiveColumn(jnp.arctan2(a.data, b.data),
+                                      a.validity & b.validity),
+                      DataType.FLOAT64)
+
+
+@register("hypot", DataType.FLOAT64)
+def _hypot(args, expr, batch, schema, ctx):
+    a = cast_value(args[0], DataType.FLOAT64)
+    b = cast_value(args[1], DataType.FLOAT64)
+    return TypedValue(PrimitiveColumn(jnp.hypot(a.data, b.data),
+                                      a.validity & b.validity),
+                      DataType.FLOAT64)
+
+
+@register("pi", DataType.FLOAT64)
+def _pi(args, expr, batch, schema, ctx):
+    import math
+    return TypedValue(PrimitiveColumn(
+        jnp.full(batch.capacity, math.pi, jnp.float64),
+        jnp.ones(batch.capacity, bool)), DataType.FLOAT64)
+
+
+@register("e", DataType.FLOAT64)
+def _e(args, expr, batch, schema, ctx):
+    import math
+    return TypedValue(PrimitiveColumn(
+        jnp.full(batch.capacity, math.e, jnp.float64),
+        jnp.ones(batch.capacity, bool)), DataType.FLOAT64)
+
+
+def _pmod_result(expr, schema):
+    lt, _, _ = infer_dtype(expr.args[0], schema)
+    rt, _, _ = infer_dtype(expr.args[1], schema)
+    if lt.is_floating or rt.is_floating:
+        return DataType.FLOAT64, 0, 0
+    return DataType.INT64, 0, 0
+
+
+@register("pmod", _pmod_result)
+def _pmod(args, expr, batch, schema, ctx):
+    """Spark pmod(a, n) = ((a % n) + n) % n with Java remainder — which
+    is exactly floor-mod for every sign combination (verified: (-7,3)->2,
+    (7,-3)->-2, (-7,-3)->-1). Null on zero divisor."""
+    a, b = args
+    target = DataType.FLOAT64 if (a.dtype.is_floating
+                                  or b.dtype.is_floating) else DataType.INT64
+    av = cast_value(a, target)
+    bv = cast_value(b, target)
+    nz = bv.data != 0
+    safe_b = jnp.where(nz, bv.data, 1)
+    r = jnp.mod(av.data, safe_b)            # jnp.mod is floor-mod
+    return TypedValue(PrimitiveColumn(r, av.validity & bv.validity & nz),
+                      target)
+
+
+@register("factorial", DataType.INT64)
+def _factorial(args, expr, batch, schema, ctx):
+    """Spark factorial: defined for 0..20, null outside."""
+    import math
+    table = jnp.asarray([math.factorial(i) for i in range(21)], jnp.int64)
+    v = cast_value(args[0], DataType.INT64)
+    ok = (v.data >= 0) & (v.data <= 20)
+    idx = jnp.clip(v.data, 0, 20)
+    return TypedValue(PrimitiveColumn(table[idx], v.validity & ok),
+                      DataType.INT64)
+
+
 # ---------------------------------------------------------------------------
 # conditional / null
 # ---------------------------------------------------------------------------

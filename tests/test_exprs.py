@@ -220,3 +220,36 @@ def test_decimal_arith():
                           np.asarray(tv.col.lo[:3]),
                           np.asarray(tv.validity[:3]))
     assert got == [7500, 22500, None]  # unscaled s=4
+
+
+def test_host_udf_string_args_and_result():
+    """Round-3: host UDFs accept string args via the (chars, lens)
+    protocol and can return strings (reference:
+    spark_udf_wrapper.rs Arrow FFI round trip)."""
+    import pyarrow as pa_
+    import pyarrow.compute as pc
+    from auron_tpu.exprs.udf import register_udf
+    from auron_tpu.columnar.schema import DataType
+
+    def shout(arrays):
+        return pc.binary_join_element_wise(
+            pc.utf8_upper(arrays[0]), pa_.array(
+                [str(x.as_py()) if x.is_valid else None
+                 for x in arrays[1]], pa_.string()), "!")
+
+    register_udf("shout_t", shout, DataType.STRING)
+    rb = pa.record_batch({
+        "s": pa.array(["hey", None, "ok"], pa.string()),
+        "n": pa.array([1, 2, 3], pa.int64()),
+    })
+    expr = ir.HostUDF(shout, (C(0), C(1)), DataType.STRING)
+    got = eval_to_list(expr, rb)
+    assert got == ["HEY!1", None, "OK!3"]
+
+
+def test_pmod_sign_matrix():
+    rb = pa.record_batch({"a": pa.array([-7, 7, -7, 7], pa.int64()),
+                          "b": pa.array([3, -3, -3, 3], pa.int64())})
+    got = eval_to_list(ir.ScalarFunction("pmod", (C(0), C(1))), rb)
+    # Spark: ((a % n) + n) % n with Java remainder == floor-mod
+    assert got == [2, -2, -1, 1]

@@ -394,3 +394,30 @@ def test_map_functions():
     assert run_fn("size", rb, [m]) == [2, 2]
     keys = ir.ScalarFunction("map_keys", (m,))
     assert run_fn("element_at", rb, [keys, lit(1)]) == [1, 1]
+
+
+def test_math_family():
+    import math
+    vals = [0.5, -1.2, 2.0]
+    rb = pa.record_batch({"x": pa.array(vals, pa.float64()),
+                          "y": pa.array([2.0, 3.0, -4.0], pa.float64())})
+    for name, ref in [("sin", math.sin), ("cos", math.cos),
+                      ("tan", math.tan), ("atan", math.atan),
+                      ("tanh", math.tanh), ("cbrt", lambda v: math.copysign(
+                          abs(v) ** (1 / 3), v)),
+                      ("degrees", math.degrees), ("radians", math.radians),
+                      ("expm1", math.expm1)]:
+        got = run_fn(name, rb, [C(0)])
+        assert got == pytest.approx([ref(v) for v in vals], rel=1e-12), name
+    assert run_fn("signum", rb, [C(0)]) == [1.0, -1.0, 1.0]
+    got = run_fn("atan2", rb, [C(0), C(1)])
+    assert got == pytest.approx(
+        [math.atan2(a, b) for a, b in
+         zip(vals, [2.0, 3.0, -4.0])], rel=1e-12)
+    rb2 = pa.record_batch({"a": pa.array([7, -7, 5], pa.int64()),
+                           "b": pa.array([3, 3, 0], pa.int64())})
+    assert run_fn("pmod", rb2, [C(0), C(1)]) == [1, 2, None]
+    rb3 = pa.record_batch({"n": pa.array([5, 20, 21, -1], pa.int64())})
+    import math as m
+    assert run_fn("factorial", rb3, [C(0)]) == [120, m.factorial(20),
+                                                None, None]
