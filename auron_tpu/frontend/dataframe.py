@@ -125,6 +125,25 @@ class AggCol:
         return f"{self.fn}({argname})" if argname else self.fn
 
 
+@dataclass
+class WinFn:
+    """One window function spec for DataFrame.window() (the DSL face of
+    WindowFunctionP / ops.window.WindowFunctionSpec)."""
+    kind: str                   # rank_like | offset | agg
+    fn: str
+    arg: Optional["Col"] = None
+    offset: int = 1
+    default: Any = None
+    name: Optional[str] = None
+
+    def alias(self, name: str) -> "WinFn":
+        from dataclasses import replace as _replace
+        return _replace(self, name=name)
+
+    def out_name(self, i: int) -> str:
+        return self.name or f"{self.fn}_{i}"
+
+
 def col(name: str) -> Col:
     return Col(name)
 
@@ -221,6 +240,47 @@ class _Functions:
 
     def count_star(self) -> AggCol:
         return AggCol("count_star", None)
+
+    # -- window function builders (DataFrame.window) ------------------------
+
+    def row_number(self) -> WinFn:
+        return WinFn("rank_like", "row_number")
+
+    def rank(self) -> WinFn:
+        return WinFn("rank_like", "rank")
+
+    def dense_rank(self) -> WinFn:
+        return WinFn("rank_like", "dense_rank")
+
+    def percent_rank(self) -> WinFn:
+        return WinFn("rank_like", "percent_rank")
+
+    def cume_dist(self) -> WinFn:
+        return WinFn("rank_like", "cume_dist")
+
+    def ntile(self, n: int) -> WinFn:
+        return WinFn("rank_like", "ntile", offset=n)
+
+    def lead(self, c, offset: int = 1, default=None) -> WinFn:
+        return WinFn("offset", "lead", _wrap(c), offset, default)
+
+    def lag(self, c, offset: int = 1, default=None) -> WinFn:
+        return WinFn("offset", "lag", _wrap(c), offset, default)
+
+    def nth_value(self, c, n: int) -> WinFn:
+        return WinFn("offset", "nth_value", _wrap(c), n)
+
+    def first_value(self, c) -> WinFn:
+        return WinFn("offset", "first_value", _wrap(c))
+
+    def last_value(self, c) -> WinFn:
+        return WinFn("offset", "last_value", _wrap(c))
+
+    def win_agg(self, fn: str, c=None) -> WinFn:
+        """Running aggregate over the window frame (Spark default frame:
+        UNBOUNDED PRECEDING..CURRENT ROW with ORDER BY, else whole
+        partition): win_agg("sum", col) / win_agg("count_star")."""
+        return WinFn("agg", fn, _wrap(c) if c is not None else None)
 
     def udf(self, registry_name: str, *args) -> Col:
         return Col(("udf", registry_name, tuple(_wrap(a) for a in args),
@@ -379,8 +439,9 @@ class DataFrame:
         ks = [col(k) if isinstance(k, str) else k for k in keys]
         return GroupedData(self, ks)
 
-    def sort(self, *orders: Union[str, Col, SortCol],
-             limit: Optional[int] = None) -> "DataFrame":
+    def _to_sort_orders(self, orders) -> list[ir.SortOrder]:
+        """str/Col/SortCol → resolved ir.SortOrder (shared by sort and
+        window)."""
         sos = []
         for o in orders:
             if isinstance(o, str):
@@ -389,6 +450,81 @@ class DataFrame:
                 o = o.asc()
             sos.append(ir.SortOrder(resolve(o.col, self.schema),
                                     o.ascending, o.nulls_first))
+        return sos
+
+    def window(self, funcs: list, partition_by=(), order_by=(),
+               group_limit: Optional[int] = None) -> "DataFrame":
+        """Append window-function columns (WindowNode → ops/window.py).
+        Multi-partition frames hash-exchange on the partition keys first
+        (Spark's required child distribution for window execs); an empty
+        partition_by coalesces to a single partition."""
+        if group_limit is not None and group_limit < 1:
+            raise ValueError(f"group_limit must be >= 1, got {group_limit}")
+        pbs = [col(k) if isinstance(k, str) else k for k in partition_by]
+        sos = self._to_sort_orders(order_by)
+        pb_exprs = [resolve(c, self.schema) for c in pbs]
+        child = self.plan
+        out_partitions = self.num_partitions
+        prov = None
+        if self.num_partitions > 1:
+            if pb_exprs:
+                part = pb.PartitioningP(
+                    kind="hash", num_partitions=self.num_partitions,
+                    hash_keys=[serde.expr_to_proto(e) for e in pb_exprs])
+                prov = ("hash", tuple(c.out_name() for c in pbs),
+                        self.num_partitions)
+            else:
+                part = pb.PartitioningP(kind="single", num_partitions=1)
+                out_partitions = 1
+                prov = ("single",)
+            child = pb.PlanNode(shuffle_writer=pb.ShuffleWriterNode(
+                child=child, partitioning=part,
+                input_partitions=self.num_partitions))
+        # ONE spec build; protos and schema both derive from it (keeps the
+        # spec's own validation ahead of wire construction)
+        from auron_tpu.ops.window import WindowFunctionSpec, _result_field
+        names = [f.out_name(i) for i, f in enumerate(funcs)]
+        specs = []
+        for f in funcs:
+            default = None
+            if f.default is not None:
+                lit_ir = resolve(_wrap(f.default), self.schema)
+                if not isinstance(lit_ir, ir.Literal):
+                    raise TypeError(
+                        f"{f.fn} default must be a literal, got "
+                        f"{type(lit_ir).__name__}")
+                default = lit_ir
+            specs.append((WindowFunctionSpec(
+                kind=f.kind, fn=f.fn,
+                arg=resolve(f.arg, self.schema) if f.arg is not None
+                else None, offset=f.offset,
+                default=None if default is None else default.value),
+                default))
+        fprotos = []
+        for (spec, default) in specs:
+            wp = pb.WindowFunctionP(kind=spec.kind, fn=spec.fn)
+            if spec.arg is not None:
+                wp.arg.CopyFrom(serde.expr_to_proto(spec.arg))
+            wp.offset = spec.offset
+            if default is not None:
+                wp.default_value.CopyFrom(
+                    serde.expr_to_proto(default).literal)
+            fprotos.append(wp)
+        node = pb.PlanNode(window=pb.WindowNode(
+            child=child,
+            partition_by=[serde.expr_to_proto(e) for e in pb_exprs],
+            order_by=[serde.sort_order_to_proto(s) for s in sos],
+            functions=fprotos, output_names=names,
+            group_limit=-1 if group_limit is None else group_limit))
+        extra = [_result_field(spec, nm, self.schema)
+                 for (spec, _d), nm in zip(specs, names)]
+        out_schema = Schema(tuple(self.schema.fields) + tuple(extra))
+        return DataFrame(self.session, node, out_schema, out_partitions,
+                         prov)
+
+    def sort(self, *orders: Union[str, Col, SortCol],
+             limit: Optional[int] = None) -> "DataFrame":
+        sos = self._to_sort_orders(orders)
         so_protos = [serde.sort_order_to_proto(s) for s in sos]
         child = self.plan
         out_partitions = self.num_partitions

@@ -230,3 +230,80 @@ def test_union_partition_mismatch_raises(tmp_path):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="partition counts"):
         a.union(b)
+
+
+class TestWindowDSL:
+    """DataFrame.window() — the DSL face of WindowNode (round 3)."""
+
+    def _frame(self, n=600, parts=3):
+        rng = np.random.default_rng(21)
+        rb = pa.record_batch({
+            "k": pa.array(rng.integers(0, 8, n), pa.int64()),
+            # unique order keys: Spark's default RANGE frame makes tied
+            # peers share running-agg values, which pandas cumsum doesn't
+            "v": pa.array(rng.permutation(n).astype(np.float64) / 7.0,
+                          pa.float64()),
+        })
+        import tempfile, os
+        import pyarrow.parquet as pq
+        d = tempfile.mkdtemp()
+        files = []
+        per = n // parts
+        for i in range(parts):
+            p = os.path.join(d, f"f{i}.parquet")
+            pq.write_table(pa.Table.from_batches([rb.slice(i * per, per)]),
+                           p)
+            files.append(p)
+        return rb, files
+
+    def test_rank_and_running_sum_multi_partition(self):
+        from auron_tpu.frontend.session import Session
+        from auron_tpu.frontend.dataframe import col, functions as F
+        rb, files = self._frame()
+        s = Session()
+        df = s.read_parquet(files, partitions=3)
+        out = (df.window(
+            [F.row_number().alias("rn"),
+             F.win_agg("sum", col("v")).alias("rsum")],
+            partition_by=[col("k")], order_by=[col("v").asc()])
+            .collect())
+        pd_df = pa.Table.from_batches([rb]).to_pandas()
+        got = out.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+        exp = pd_df.sort_values(["k", "v"]).reset_index(drop=True)
+        exp["rn"] = exp.groupby("k").cumcount() + 1
+        exp["rsum"] = exp.groupby("k")["v"].cumsum()
+        assert len(got) == len(exp)
+        np.testing.assert_array_equal(got["rn"], exp["rn"])
+        np.testing.assert_allclose(got["rsum"], exp["rsum"], rtol=1e-9)
+
+    def test_lag_with_default(self):
+        from auron_tpu.frontend.session import Session
+        from auron_tpu.frontend.dataframe import col, functions as F
+        rb, files = self._frame(n=120, parts=1)
+        s = Session()
+        df = s.read_parquet(files, partitions=1)
+        out = (df.window([F.lag(col("v"), 1, -1.0).alias("prev")],
+                         partition_by=[col("k")],
+                         order_by=[col("v").asc()])
+               .collect())
+        g = out.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+        exp = pa.Table.from_batches([rb]).to_pandas() \
+            .sort_values(["k", "v"]).reset_index(drop=True)
+        exp["prev"] = exp.groupby("k")["v"].shift(1).fillna(-1.0)
+        np.testing.assert_allclose(g["prev"], exp["prev"], rtol=1e-9)
+
+    def test_window_validation_and_provenance(self):
+        from auron_tpu.frontend.session import Session
+        from auron_tpu.frontend.dataframe import col, functions as F
+        rb, files = self._frame(n=60, parts=2)
+        s = Session()
+        df = s.read_parquet(files, partitions=2)
+        with pytest.raises(ValueError, match="group_limit"):
+            df.window([F.rank()], partition_by=[col("k")],
+                      order_by=[col("v")], group_limit=0)
+        with pytest.raises(TypeError, match="literal"):
+            df.window([F.lag(col("v"), 1, col("k"))],
+                      partition_by=[col("k")], order_by=[col("v")])
+        out = df.window([F.rank().alias("r")], partition_by=[col("k")],
+                        order_by=[col("v")])
+        assert out.partitioning == ("hash", ("k",), 2)
