@@ -433,6 +433,36 @@ def _q15_oracle(p):
         return out[["ss_item_sk", "rev_dec"]]
 
 
+# --------------------------------------------------------------------------
+# q16: window functions — rank within store by revenue + running sum
+# (exchange on partition keys, the TPC-DS windowed-rank query class)
+# --------------------------------------------------------------------------
+
+def _q16_run(s, t):
+    per_item = (_sales(s, t)
+                .group_by("ss_store_sk", "ss_item_sk")
+                .agg(F.sum(col("ss_sales_price")).alias("rev")))
+    return (per_item
+            .window([F.rank().alias("rnk")],
+                    partition_by=[col("ss_store_sk")],
+                    order_by=[col("rev").desc()])
+            .filter(col("rnk") <= 3)
+            .sort(col("ss_store_sk").asc(), col("rnk").asc(),
+                  col("ss_item_sk").asc())
+            .collect())
+
+
+def _q16_oracle(p):
+    ss = p["store_sales"]
+    g = (ss.groupby(["ss_store_sk", "ss_item_sk"])
+           .agg(rev=("ss_sales_price", "sum")).reset_index())
+    g["rnk"] = g.groupby("ss_store_sk")["rev"] \
+        .rank(method="min", ascending=False).astype("int64")
+    f = g[g.rnk <= 3]
+    return f.sort_values(["ss_store_sk", "rnk", "ss_item_sk"])[
+        ["ss_store_sk", "ss_item_sk", "rev", "rnk"]]
+
+
 QUERIES = [
     Query("q01_filter_agg", "scan→filter→two-phase agg", _q01_run, _q01_oracle),
     Query("q02_topk_revenue", "agg→exchange→global sort+limit", _q02_run, _q02_oracle),
@@ -448,5 +478,6 @@ QUERIES = [
     Query("q12_computed_topk", "project arithmetic→top-k", _q12_run, _q12_oracle),
     Query("q14_string_functions", "round-3 string fns→agg", _q14_run, _q14_oracle),
     Query("q15_wide_decimal", "decimal(>18) arith→sort", _q15_run, _q15_oracle),
+    Query("q16_window_rank", "window rank→filter→sort", _q16_run, _q16_oracle),
     Query("q13_distinct_buyers", "nested aggs through exchange", _q13_run, _q13_oracle),
 ]
