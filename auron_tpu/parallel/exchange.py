@@ -483,11 +483,113 @@ class RssShuffleReadOp(PhysicalOp):
         return f"RssShuffleReadOp[shuffle={self.shuffle_id}]"
 
 
+class _BroadcastBuffer:
+    """MemConsumer owning a broadcast's collected batches.
+
+    The reference registers broadcast hash maps with its memory manager
+    (join_hash_map.rs:365-387) so an oversized build side spills instead of
+    OOMing; this is the same contract for the collected device batches. Each
+    entry is ["dev", DeviceBatch] or ["spill", SpillRef, num_rows]; replay
+    rehydrates spilled entries per consumer without pinning them back into
+    the buffer (consumers stream them, HBM stays at one batch at a time)."""
+
+    def __init__(self, op, mem_manager, metrics, conf=None):
+        from auron_tpu import config as cfg
+        conf = conf or cfg.get_config()
+        self.mem = mem_manager
+        self.metrics = metrics
+        self.codec_level = conf.get(cfg.SPILL_CODEC_LEVEL)
+        self.consumer_name = f"broadcast-{id(op):x}"
+        self.entries: list = []
+        self._dev_bytes = 0
+        self._lock = threading.RLock()
+        if mem_manager is not None:
+            mem_manager.register_consumer(self)
+
+    def add(self, batch: DeviceBatch) -> None:
+        from auron_tpu.columnar.batch import batch_nbytes
+        with self._lock:
+            self.entries.append(["dev", batch])
+            self._dev_bytes += batch_nbytes(batch)
+            used = self._dev_bytes
+        if self.mem is not None:
+            self.mem.update_mem_used(self, used)
+
+    def mem_used(self) -> int:
+        with self._lock:
+            return self._dev_bytes
+
+    def spill(self) -> int:
+        from auron_tpu.columnar.batch import batch_nbytes
+        from auron_tpu.columnar.serde import (batch_to_host,
+                                              serialize_host_batch)
+        if self.mem is None or getattr(self.mem, "spill_manager", None) is None:
+            return 0
+        with self._lock:  # tag flip, same protocol as _ExchangeBuffer
+            victims = [(i, e) for i, e in enumerate(self.entries)
+                       if e[0] == "dev"]
+            for _i, e in victims:
+                e[0] = "dev-spilling"
+            if not victims:
+                return 0
+        freed = 0
+        for i, e in victims:
+            batch = e[1]
+            n = int(batch.num_rows)
+            spill = self.mem.spill_manager.new_spill()
+            spill.write_frame(serialize_host_batch(
+                batch_to_host(batch, n), codec_level=self.codec_level))
+            done = spill.finish()
+            with self._lock:
+                if i < len(self.entries) and self.entries[i] is e:
+                    self.entries[i] = ["spill", done, n]
+                    self._dev_bytes -= batch_nbytes(batch)
+                    freed += batch_nbytes(batch)
+                else:
+                    done.release()
+        self.metrics.counter("mem_spill_count").add(len(victims))
+        self.metrics.counter("mem_spill_size").add(freed)
+        return freed
+
+    def replay(self) -> Iterator[DeviceBatch]:
+        from auron_tpu.columnar.serde import (deserialize_host_batch,
+                                              host_to_batch)
+        with self._lock:
+            entries = list(self.entries)
+        for e in entries:
+            if e[0].startswith("dev"):
+                yield e[1]
+            else:
+                host, _extras = deserialize_host_batch(e[1].frame_at(0))
+                yield host_to_batch(host, bucket_rows(e[2]))
+
+    def close(self) -> None:
+        if self.mem is not None:
+            self.mem.unregister_consumer(self)
+        with self._lock:
+            entries, self.entries = self.entries, []
+            self._dev_bytes = 0
+        for e in entries:
+            if e[0] == "spill":
+                e[1].release()
+
+    def __del__(self):
+        # see _ExchangeBuffer.__del__ for why this must not call close()
+        try:
+            for e in self.entries:
+                if e[0] == "spill":
+                    e[1].release()
+        except Exception:
+            pass
+
+
 class BroadcastExchangeOp(PhysicalOp):
     """Collect the child once, replay to every consumer partition
     (reference: NativeBroadcastExchangeBase collect→IPC→re-expose,
     SURVEY.md §3.4). Device batches are naturally shared on a single host;
-    in SPMD execution the same batch is replicated into every shard."""
+    in SPMD execution the same batch is replicated into every shard. The
+    collected set is a memmgr consumer (_BroadcastBuffer): a build side
+    larger than the budget spills to host tiers and replays from there."""
 
     name = "broadcast_exchange"
 
@@ -495,7 +597,7 @@ class BroadcastExchangeOp(PhysicalOp):
         self.child = child
         self.input_partitions = input_partitions
         self._lock = threading.Lock()
-        self._collected: Optional[list[DeviceBatch]] = None
+        self._buffer: Optional[_BroadcastBuffer] = None
 
     @property
     def children(self):
@@ -505,15 +607,17 @@ class BroadcastExchangeOp(PhysicalOp):
         return self.child.schema()
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        metrics = ctx.metrics_for(self.name)
         with self._lock:
-            if self._collected is None:
-                out = []
+            if self._buffer is None:
+                buf = _BroadcastBuffer(self, ctx.mem_manager, metrics,
+                                       conf=ctx.config)
                 for in_p in range(self.input_partitions):
                     map_ctx = ExecContext(
                         partition_id=in_p, num_partitions=self.input_partitions,
                         metrics=ctx.metrics, mem_manager=ctx.mem_manager,
                         config=ctx.config)
-                    out.extend(self.child.execute(in_p, map_ctx))
-                self._collected = out
-        metrics = ctx.metrics_for(self.name)
-        return count_output(iter(self._collected), metrics)
+                    for b in self.child.execute(in_p, map_ctx):
+                        buf.add(b)
+                self._buffer = buf
+        return count_output(self._buffer.replay(), metrics)

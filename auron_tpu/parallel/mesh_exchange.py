@@ -6,8 +6,11 @@ store, each mesh device buckets its rows by target partition *on device* and
 one `lax.all_to_all` moves every bucket to its owner across ICI links in a
 single collective. Static shapes are preserved by a per-(src,dst) row quota:
 send buffers are [n_dev, quota, ...]; overflow (a bucket exceeding quota) is
-reported per-device so the host can rerun the exchange at a doubled quota —
-same contract as the engine's other capacity re-bucketing.
+reported per-device as the observed max bucket size so the host can rerun
+the exchange ONCE at exactly the needed quota (rounded up to a power of two
+so escalations land on a small reusable set of compiled programs) — same
+contract as the engine's other capacity re-bucketing, without the
+compile-per-doubling churn of a blind retry loop.
 
 Works identically on a virtual CPU mesh (tests / driver dry-run) and a real
 TPU slice; on multi-host deployments the same code spans hosts because jax
@@ -46,7 +49,12 @@ def _exchange_fn(mesh: Mesh, n_cols: int, quota: int, axis: str):
     Outputs:
       out_cols:     tuple of arrays [n_dev * (n_dev*quota), ...]
       out_num_rows: int32[n_dev]
-      overflow:     bool[n_dev]  True if any bucket exceeded quota
+      max_count:    int32[n_dev]  largest bucket observed on this shard —
+                    rows were dropped iff max_count > quota, and the value
+                    tells the host the exact quota a single retry needs
+
+    Program builds are countable via ``_exchange_fn.cache_info().misses``;
+    tests assert skew escalation stays within a 2-compile budget.
     """
     n_dev = mesh.shape[axis]
 
@@ -61,7 +69,7 @@ def _exchange_fn(mesh: Mesh, n_cols: int, quota: int, axis: str):
         ones = live.astype(jnp.int32)
         counts = jax.ops.segment_sum(ones, pid_key, num_segments=n_dev + 1)[:n_dev]
         offsets = jnp.cumsum(counts) - counts  # exclusive
-        overflow = jnp.any(counts > quota)
+        max_count = jnp.max(counts).astype(jnp.int32)
 
         pos = jnp.arange(cap, dtype=jnp.int32)
         tgt = jnp.clip(sorted_pid, 0, n_dev - 1)
@@ -95,7 +103,7 @@ def _exchange_fn(mesh: Mesh, n_cols: int, quota: int, axis: str):
                             stable=True)
         out_cols = [c[order] for c in out_cols]
         out_nr = jnp.sum(recv_counts).astype(jnp.int32)
-        return (tuple(out_cols), out_nr[None], overflow[None])
+        return (tuple(out_cols), out_nr[None], max_count[None])
 
     in_specs = (tuple(P(axis) for _ in range(n_cols)), P(axis), P(axis))
     out_specs = (tuple(P(axis) for _ in range(n_cols)), P(axis), P(axis))
@@ -106,25 +114,33 @@ def _exchange_fn(mesh: Mesh, n_cols: int, quota: int, axis: str):
 
 def mesh_all_to_all(mesh: Mesh, cols: tuple, pids, num_rows, quota: int,
                     axis: str = "data"):
-    """Run the SPMD exchange; returns (cols, num_rows_per_shard, overflow).
-    Caller reruns with a larger quota when overflow is set."""
+    """Run the SPMD exchange; returns (cols, num_rows_per_shard, max_count).
+    Rows were dropped iff max(max_count) > quota; rerun at that quota."""
     fn = _exchange_fn(mesh, len(cols), quota, axis)
     return fn(tuple(cols), pids, num_rows)
 
 
 def exchange_device_batches(mesh: Mesh, cols: tuple, pids, num_rows,
                             axis: str = "data", initial_quota: int | None = None):
-    """Overflow-safe wrapper: doubles quota until everything fits."""
+    """Overflow-safe wrapper, at most TWO compiled programs per shape class.
+
+    Quotas are always powers of two: the first attempt uses a pow2 estimate,
+    and if any bucket overflows, the returned max bucket size tells us the
+    exact quota needed, so a single retry (at the next pow2 ≥ that size)
+    always fits. Blind doubling would compile a fresh SPMD program per step
+    (~seconds each on a real TPU slice); this escalates once, to a quota
+    value drawn from a log-sized bucket set that future calls reuse.
+    """
+    from auron_tpu.utils.shapes import bucket_rows
     n_dev = mesh.shape[axis]
     cap = pids.shape[0] // n_dev
-    quota = initial_quota or max(16, (2 * cap) // n_dev)
-    while True:
-        out_cols, out_nr, overflow = mesh_all_to_all(
-            mesh, cols, pids, num_rows, quota, axis)
-        if not bool(np.any(np.asarray(overflow))):
-            return out_cols, out_nr, quota
-        quota = min(quota * 2, cap)
-        if quota == cap:
-            out_cols, out_nr, overflow = mesh_all_to_all(
-                mesh, cols, pids, num_rows, quota, axis)
-            return out_cols, out_nr, quota
+    quota = bucket_rows(initial_quota or (2 * cap) // n_dev)
+    out_cols, out_nr, max_count = mesh_all_to_all(
+        mesh, cols, pids, num_rows, quota, axis)
+    needed = int(np.max(np.asarray(max_count)))
+    if needed <= quota:
+        return out_cols, out_nr, quota
+    quota = bucket_rows(needed)
+    out_cols, out_nr, _ = mesh_all_to_all(
+        mesh, cols, pids, num_rows, quota, axis)
+    return out_cols, out_nr, quota

@@ -177,15 +177,21 @@ def test_mesh_all_to_all_exchange():
 def test_mesh_exchange_overflow_retry():
     from auron_tpu.parallel.mesh_exchange import (exchange_device_batches,
                                                   make_mesh)
+    from auron_tpu.parallel import mesh_exchange
     mesh = make_mesh(8)
     n_dev, cap = 8, 64
     # fully skewed: every row targets partition 0 → guaranteed overflow at
-    # the initial quota, exercising the doubling path
+    # the initial quota, exercising the single-retry escalation path
     vals = np.arange(n_dev * cap, dtype=np.int64)
     pids = np.zeros(n_dev * cap, np.int32)
     num_rows = np.full(n_dev, cap, np.int32)
+    mesh_exchange._exchange_fn.cache_clear()
     out_cols, out_nr, quota = exchange_device_batches(
         mesh, (jnp.asarray(vals),), jnp.asarray(pids), jnp.asarray(num_rows))
+    # max-count feedback jumps straight to the needed pow2 quota: at most
+    # two compiled programs even under extreme skew
+    assert mesh_exchange._exchange_fn.cache_info().misses <= 2
+    assert quota & (quota - 1) == 0  # pow2 → reusable bucket set
     out_nr = np.asarray(out_nr)
     assert out_nr[0] == n_dev * cap
     assert out_nr[1:].sum() == 0
@@ -239,6 +245,41 @@ def test_shuffle_64_partitions_spills_under_pressure(tmp_path):
     assert set(got) == set(exp)
     for kk in exp:
         assert sorted(got[kk]) == pytest.approx(sorted(exp[kk]))
+
+
+def test_broadcast_larger_than_budget_spills(tmp_path):
+    """VERDICT r3 directive 6: a broadcast whose collected build side
+    exceeds the memory budget must spill via the memmgr (reference
+    registers broadcast maps: join_hash_map.rs:365-387) and every consumer
+    partition still replays the full content from host tiers."""
+    from auron_tpu.memmgr import MemManager, SpillManager
+    from auron_tpu.parallel.exchange import BroadcastExchangeOp
+
+    rows = 8_000
+    rng = np.random.default_rng(7)
+    k = rng.integers(0, 1_000, rows)
+    v = rng.normal(size=rows)
+    rbs = [pa.record_batch({"k": pa.array(k[i:i + 1024], pa.int64()),
+                            "v": pa.array(v[i:i + 1024], pa.float64())})
+           for i in range(0, rows, 1024)]
+    scan = MemoryScanOp([rbs], schema_from_arrow(rbs[0].schema),
+                        capacity=1024)
+    bc = BroadcastExchangeOp(scan, input_partitions=1)
+    mm = MemManager(total_bytes=1, min_trigger=0,
+                    spill_manager=SpillManager(host_budget_bytes=1 << 22,
+                                               spill_dir=str(tmp_path)))
+    ctx = ExecContext(mem_manager=mm)
+    for p in range(3):  # three consumers replay the same broadcast
+        got_k, got_v = [], []
+        for b in bc.execute(p, ctx):
+            n = int(b.num_rows)
+            got_k.extend(np.asarray(b.columns[0].data[:n]).tolist())
+            got_v.extend(np.asarray(b.columns[1].data[:n]).tolist())
+        assert sorted(got_k) == sorted(k.tolist())
+        assert sorted(got_v) == pytest.approx(sorted(v.tolist()))
+    spills = ctx.metrics["broadcast_exchange"].counter(
+        "mem_spill_count").value
+    assert spills > 0, "larger-than-budget broadcast must spill"
 
 
 def test_range_bounds_sampled_in_single_pass():
