@@ -190,6 +190,48 @@ def _divmod_small(h, l, d: int):
     return qh, ql, r
 
 
+def _divmod_u64_runtime(ah, al, d):
+    """Unsigned (ah,al) // d and remainder for a RUNTIME int64 divisor
+    1 <= d < 2^31 (base-2^32 long division keeps every partial value
+    r*2^32 + limb < d*2^32 < 2^63). The pow10 dividers above only take
+    compile-time divisor constants."""
+    limbs = [(ah >> 32) & jnp.int64(0xFFFFFFFF), ah & jnp.int64(0xFFFFFFFF),
+             (al >> 32) & jnp.int64(0xFFFFFFFF), al & jnp.int64(0xFFFFFFFF)]
+    q = []
+    r = jnp.zeros_like(ah)
+    for limb in limbs:
+        cur = (r << 32) | limb
+        q.append(cur // d)
+        r = cur % d
+    return (q[0] << 32) | q[1], (q[2] << 32) | q[3], r
+
+
+def avg_pow10_div_half_up(h, l, count, k: int):
+    """(value * 10^k) / count with HALF_UP, for avg finalizers: the sum
+    accumulates UNSHIFTED (so only genuinely-overflowing totals wrap
+    2^127) and the result-scale shift composes with the division here as
+    q*10^k + round((r*10^k)/count), which never widens past the result.
+    Returns (hi, lo, fits) — fits=False when |q| >= 10^(38-k), i.e. the
+    scaled average cannot fit decimal(38) and Spark nulls it."""
+    assert 0 <= k <= 9   # frac term: 2*r*10^k < 2^32 * 10^9 < 2^63
+    neg = is_negative(h, l)
+    ah, al = abs128(h, l)
+    qh, ql, r = _divmod_u64_runtime(ah, al, count)
+    fits = fits_precision(qh, ql, 38 - k)
+    # the long-division invariant needs count < 2^31; a group larger than
+    # that nulls rather than silently mis-dividing (Spark would compute it
+    # — an accepted engine bound, >2.1e9 rows in ONE group)
+    fits = fits & (count < (1 << 31))
+    qh, ql = mul_pow10(qh, ql, k)
+    # r < count < 2^31 and 10^k <= 10^38's low digits… keep k small enough
+    # for int64: the avg shift is at most 4 digits (s+4 result scale), so
+    # 2*r*10^k < 2^32 * 2e4 < 2^63
+    frac = (2 * r * (10 ** k) + count) // (2 * count)
+    qh, ql = add128(qh, ql, jnp.zeros_like(h), frac)
+    nh, nl = neg128(qh, ql)
+    return jnp.where(neg, nh, qh), jnp.where(neg, nl, ql), fits
+
+
 def div_pow10_half_up(h, l, k: int):
     """value / 10^k with HALF_UP rounding (Spark decimal rescale-down)."""
     if k == 0:

@@ -77,6 +77,11 @@ class AccSpec:
     state_fields: tuple
     result: tuple  # (dtype, precision, scale)
     elem: Optional[DataType] = None  # list element dtype (collect_*)
+    #: per-state-field (precision, scale) for DECIMAL state columns whose
+    #: type differs from the result type (avg's sum accumulates at the
+    #: INPUT scale; the result-scale shift happens inside the finalizing
+    #: division); None = use the result's (p, s)
+    state_ps: Optional[tuple] = None
 
 
 #: reduce kinds whose state is accumulated host-side, not in the kernel
@@ -89,18 +94,35 @@ HOST_KINDS = ("bloom", "udaf")
 _STR_KINDS = ("smin", "smax", "sfirst", "sfirst_ign")
 
 
+#: reduce kinds over two-limb decimal(p>18) values; their accumulator is a
+#: pair (hi[cap], lo[cap]) of int64 limb arrays reduced with carry-exact
+#: 128-bit arithmetic inside the merge kernel (reference handles these as
+#: Arrow Decimal128 in its AccColumn: datafusion-ext-plans/src/agg/acc.rs +
+#: sum.rs; here the i128 is two int64 limbs, columnar/decimal128.py)
+_DEC_KINDS = ("dsum", "dmin", "dmax", "dfirst")
+
+#: limb-pair neutral elements as plain python ints (module-level jnp
+#: constants would force backend init at import time — see ops/hashing.py).
+#: dmin's neutral is +2^127-1 (hi=INT64_MAX, lo=all-ones), dmax's is
+#: -2^127; real decimals are bounded by 10^38 < 2^127 so neither collides
+_DEC_NEUTRAL = {"dmin": (0x7FFFFFFFFFFFFFFF, -1),
+                "dmax": (-0x8000000000000000, 0)}
+
+
+def decimal_avg_result(p: int, s: int) -> tuple[int, int]:
+    """Spark avg(decimal(p,s)) → decimal(p+4, s+4), capped at precision 38
+    with the same allowPrecisionLoss scale adjustment as binary arithmetic
+    (DecimalPrecision.adjustPrecisionScale)."""
+    rp, rs = p + 4, s + 4
+    if rp <= 38:
+        return rp, rs
+    digits_int = rp - rs
+    adj_s = max(38 - digits_int, min(rs, 6))
+    return 38, adj_s
+
+
 def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
     fn = agg.fn
-    if agg.arg is not None and fn not in ("count",):
-        # count only reads validity — wide decimals are fine there
-        _dt, _p, _s = infer_dtype(agg.arg, in_schema)
-        if _dt == DataType.DECIMAL and _p > 18:
-            # wide decimals live in two-limb columns the accumulator
-            # kernels don't speak yet; fail fast with guidance instead of
-            # an AttributeError deep in the merge kernel
-            raise NotImplementedError(
-                f"{fn} over decimal(p={_p}>18): aggregate wide decimals "
-                "after casting to decimal(<=18) or double")
     if agg.distinct:
         # DISTINCT state rides the collect_set accumulator: the merge
         # kernel already dedupes per group, so count/sum/avg finalize
@@ -111,6 +133,10 @@ def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
             dt, p, s = infer_dtype(agg.arg, in_schema)
             if dt in (DataType.STRING, DataType.LIST):
                 raise NotImplementedError(f"{fn} DISTINCT over {dt.value}")
+            if dt == DataType.DECIMAL and p > 18:
+                raise NotImplementedError(
+                    f"{fn} DISTINCT over decimal(p={p}>18): the set "
+                    "accumulator is single-word; cast the arg first")
             res = {"count": (DataType.INT64, 0, 0),
                    "sum": (_SUM_DTYPE[dt], 0, 0),
                    "avg": (DataType.FLOAT64, 0, 0)}[fn]
@@ -122,6 +148,15 @@ def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
     if fn in ("count", "count_star"):
         return AccSpec(fn, (("count", DataType.INT64, "sum"),),
                        (DataType.INT64, 0, 0))
+    if fn in ("bloom_filter",) or fn.startswith("udaf:"):
+        # host-side accumulators read single-word device columns; keep the
+        # plan-time fail-fast for two-limb args (the old all-fn guard)
+        if agg.arg is not None:
+            _dt, _p, _s = infer_dtype(agg.arg, in_schema)
+            if _dt == DataType.DECIMAL and _p > 18:
+                raise NotImplementedError(
+                    f"{fn} over decimal(p={_p}>18): cast the arg to "
+                    "decimal(<=18) or double first")
     if fn == "bloom_filter":
         # host-built runtime filter (reference: agg/bloom_filter.rs);
         # result/state travel as base64 of the Spark wire format
@@ -135,12 +170,42 @@ def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
         rs = getattr(udaf, "scale", 0)
         return AccSpec(fn, (("udaf", DataType.STRING, "udaf"),), (rdt, rp, rs))
     dt, p, s = infer_dtype(agg.arg, in_schema)
+    wide = dt == DataType.DECIMAL and p > 18
     if fn == "sum":
+        if wide:
+            # Spark: sum(decimal(p,s)) → decimal(min(p+10,38), s); sums
+            # past 2^127 wrap before the 10^38 fits-check can see them —
+            # same accepted limitation as the narrow path's int64 sums
+            sp = min(p + 10, 38)
+            return AccSpec(fn, (("sum", DataType.DECIMAL, "dsum"),
+                                ("has", DataType.BOOL, "or")),
+                           (DataType.DECIMAL, sp, s))
         sdt = _SUM_DTYPE[dt]
         sp, ss = (min(p + 10, 18), s) if sdt == DataType.DECIMAL else (0, 0)
         return AccSpec(fn, (("sum", sdt, "sum"), ("has", DataType.BOOL, "or")),
                        (sdt, sp, ss))
     if fn == "avg":
+        if dt == DataType.DECIMAL:
+            # Spark: avg(decimal(p,s)) → decimal(p+4, s+4) (precision cap
+            # 38 wide / 18 narrow). The sum accumulates at the INPUT
+            # scale; the finalizer shifts to the result scale inside the
+            # division (q*10^k + round(r*10^k/count)) so only genuinely
+            # overflowing totals wrap the representation
+            if wide:
+                rp, rs = decimal_avg_result(p, s)
+                sp, kind = min(p + 10, 38), "dsum"
+            else:
+                rp = min(p + 4, 18)
+                rs = min(s + 4, rp)
+                sp, kind = min(p + 10, 18), "sum"
+            # the count field's (otherwise unused) precision/scale slots
+            # carry the RESULT (p, s) so a final-mode op rebuilt from the
+            # partial schema recovers the exact Spark avg type — the
+            # capped sum-state type alone is not invertible
+            return AccSpec(fn, (("sum", DataType.DECIMAL, kind),
+                                ("count", DataType.INT64, "sum")),
+                           (DataType.DECIMAL, rp, rs),
+                           state_ps=((sp, s), (rp, rs)))
         sdt = _SUM_DTYPE[dt]
         res = (DataType.FLOAT64, 0, 0)
         return AccSpec(fn, (("sum", sdt, "sum"), ("count", DataType.INT64, "sum")),
@@ -151,17 +216,25 @@ def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
             # tuple (chars, lens, valid) — see _reduce_sorted's _STR_KINDS
             return AccSpec(fn, (("val", DataType.STRING, f"s{fn}"),),
                            (dt, p, s))
+        if wide:
+            return AccSpec(fn, (("val", DataType.DECIMAL, f"d{fn}"),
+                                ("has", DataType.BOOL, "or")), (dt, p, s))
         return AccSpec(fn, (("val", dt, fn), ("has", DataType.BOOL, "or")),
                        (dt, p, s))
     if fn in ("first", "first_ignores_null"):
         if dt == DataType.STRING:
             kind = "sfirst_ign" if fn == "first_ignores_null" else "sfirst"
             return AccSpec(fn, (("val", DataType.STRING, kind),), (dt, p, s))
-        return AccSpec(fn, (("val", dt, "first"), ("has", DataType.BOOL, "or")),
+        kind = "dfirst" if wide else "first"
+        return AccSpec(fn, (("val", dt, kind), ("has", DataType.BOOL, "or")),
                        (dt, p, s))
     if fn in ("collect_list", "collect_set"):
         if dt in (DataType.STRING, DataType.LIST):
             raise NotImplementedError(f"{fn} over {dt.value}")
+        if wide:
+            raise NotImplementedError(
+                f"{fn} over decimal(p={p}>18): the list accumulator is "
+                "single-word; cast the arg first")
         return AccSpec(fn, (("list", dt, fn),), (DataType.LIST, 0, 0), elem=dt)
     raise NotImplementedError(f"aggregate function {fn}")
 
@@ -186,7 +259,8 @@ def _unify_acc_pair(accs_a: tuple, accs_b: tuple) -> tuple[tuple, tuple]:
     tuple accumulators so state and batch sides can merge shape-to-shape."""
     out_a, out_b = [], []
     for a, b in zip(accs_a, accs_b):
-        if isinstance(a, tuple):
+        if isinstance(a, tuple) and a[0].ndim == 2:   # list/string accs;
+            # decimal limb pairs are 1-D and width-free
             ea, eb = a[0].shape[1], b[0].shape[1]
             e = max(ea, eb)
             if ea < e:
@@ -221,11 +295,15 @@ def _neutral(kind: str, dtype):
 
 def _keys_equal_prev(sorted_keys, live):
     """eq[i] = keys[i] == keys[i-1] (null == null true; eq[0] = False)."""
+    from auron_tpu.columnar.decimal128 import Decimal128Column
     eq = jnp.ones_like(live)
     for col in sorted_keys:
         if isinstance(col, StringColumn):
             same_chars = jnp.all(col.chars[1:] == col.chars[:-1], axis=1)
             same = same_chars & (col.lens[1:] == col.lens[:-1])
+        elif isinstance(col, Decimal128Column):
+            same = ((col.hi[1:] == col.hi[:-1])
+                    & (col.lo[1:] == col.lo[:-1]))
         else:
             same = col.data[1:] == col.data[:-1]
         both_valid = col.validity[1:] & col.validity[:-1]
@@ -364,6 +442,48 @@ def _reduce_sorted(keys_s, accs_s, live_s, h_s, acc_meta, out_cap):
             new_accs.append((chars_s[win], lens_s[win],
                              has & out_valid))
             continue
+        if kind in _DEC_KINDS:
+            h_acc, l_acc = acc     # int64 limb pair, already sorted
+            if kind == "dsum":
+                # carry-exact segmented 128-bit sum: split the unsigned low
+                # limb into 32-bit halves, segment-sum each as int64 (a
+                # half-sum of cap<=2^31 rows stays < 2^63), recombine with
+                # explicit carries. Two's-complement makes the signed total
+                # exact mod 2^128 (columnar/decimal128.py add128 contract)
+                m32 = 0xFFFFFFFF
+                lo_lo = jnp.where(live_s, l_acc & m32, 0)
+                lo_hi = jnp.where(live_s, (l_acc >> 32) & m32, 0)
+                hi_m = jnp.where(live_s, h_acc, 0)
+                s_ll = jax.ops.segment_sum(lo_lo, gid, num_segments=out_cap)
+                s_lh = jax.ops.segment_sum(lo_hi, gid, num_segments=out_cap)
+                s_h = jax.ops.segment_sum(hi_m, gid, num_segments=out_cap)
+                mid = (s_ll >> 32) + s_lh          # both non-negative
+                out_lo = (s_ll & m32) | (mid << 32)
+                out_hi = s_h + (mid >> 32)
+                new_accs.append((out_hi, out_lo))
+            elif kind in ("dmin", "dmax"):
+                # lexicographic two-pass: signed compare on the high limb,
+                # then unsigned compare (sign-flip trick) on the low limb
+                # among rows tied at the group's winning high limb
+                nh, nl = _DEC_NEUTRAL[kind]
+                seg = jax.ops.segment_min if kind == "dmin" \
+                    else jax.ops.segment_max
+                mh = seg(jnp.where(live_s, h_acc, nh), gid,
+                         num_segments=out_cap)
+                tied = live_s & (h_acc == mh[gid])
+                sign = -0x8000000000000000
+                lx = jnp.where(tied, l_acc ^ sign,
+                               0x7FFFFFFFFFFFFFFF if kind == "dmin"
+                               else sign)
+                ml = seg(lx, gid, num_segments=out_cap) ^ sign
+                new_accs.append((mh, ml))
+            else:   # dfirst: limb pair at the first sorted live row
+                fi = jax.ops.segment_min(
+                    jnp.where(live_s, jnp.arange(cap, dtype=jnp.int32),
+                              cap), gid, num_segments=out_cap)
+                fi = jnp.clip(fi, 0, cap - 1)
+                new_accs.append((h_acc[fi], l_acc[fi]))
+            continue
         acc_s = acc
         if kind == "first":
             # value at first sorted valid row; pair-reduce via segment_min
@@ -462,6 +582,11 @@ def _state_merge_kernel(n_keys: int, acc_meta: tuple, cap_s: int,
                 return StringColumn(scatter2(a.chars, b.chars),
                                     scatter2(a.lens, b.lens),
                                     scatter2(a.validity, b.validity))
+            from auron_tpu.columnar.decimal128 import Decimal128Column
+            if isinstance(a, Decimal128Column):
+                return Decimal128Column(scatter2(a.hi, b.hi),
+                                        scatter2(a.lo, b.lo),
+                                        scatter2(a.validity, b.validity))
             from auron_tpu.columnar.batch import ListColumn
             if isinstance(a, ListColumn):
                 return ListColumn(scatter2(a.values, b.values),
@@ -989,14 +1114,18 @@ class AggOp(PhysicalOp):
         if mode == "partial":
             state_fields = []
             for spec, an in zip(self.specs, self.agg_names):
-                for (fname, fdt, kind) in spec.state_fields:
+                for fi, (fname, fdt, kind) in enumerate(spec.state_fields):
                     if kind in ("collect_list", "collect_set"):
                         state_fields.append(Field(f"{an}#{fname}",
                                                   DataType.LIST, True,
                                                   elem=spec.elem))
                         continue
-                    prec, sc = (spec.result[1], spec.result[2]) \
-                        if fdt == DataType.DECIMAL else (0, 0)
+                    if spec.state_ps is not None:
+                        prec, sc = spec.state_ps[fi]
+                    elif fdt == DataType.DECIMAL:
+                        prec, sc = spec.result[1], spec.result[2]
+                    else:
+                        prec, sc = 0, 0
                     state_fields.append(Field(f"{an}#{fname}", fdt, True, prec, sc))
             self._schema = Schema(tuple(key_fields + state_fields))
         else:
@@ -1038,6 +1167,13 @@ class AggOp(PhysicalOp):
                         accs.append((col.chars, col.lens, col.validity))
                         idx += 1
                         continue
+                    if kind in _DEC_KINDS:
+                        # limb pair; invalid state rows already hold the
+                        # reduce-neutral (partial emit / passthrough
+                        # neutralized them), so no re-masking needed
+                        accs.append((col.hi, col.lo))
+                        idx += 1
+                        continue
                     data = col.data
                     if fname == "has":
                         data = data.astype(jnp.bool_) & col.validity
@@ -1075,6 +1211,26 @@ class AggOp(PhysicalOp):
                     accs.append((v.col.chars, v.col.lens, valid))
                     continue
                 raise NotImplementedError(f"{agg.fn} over strings")
+            from auron_tpu.columnar.decimal128 import Decimal128Column
+            if isinstance(v.col, Decimal128Column):
+                hi, lo = v.col.hi, v.col.lo
+                for fname, fdt, kind in spec.state_fields:
+                    if fname == "has":
+                        accs.append(valid)
+                    elif fname == "count":
+                        accs.append(valid.astype(jnp.int64))
+                    elif kind == "dsum":
+                        accs.append((jnp.where(valid, hi, 0),
+                                     jnp.where(valid, lo, 0)))
+                    elif kind in ("dmin", "dmax"):
+                        nh, nl = _DEC_NEUTRAL[kind]
+                        accs.append((jnp.where(valid, hi, nh),
+                                     jnp.where(valid, lo, nl)))
+                    elif kind == "dfirst":
+                        accs.append((hi, lo))
+                    else:
+                        raise ValueError(kind)
+                continue
             for fname, fdt, kind in spec.state_fields:
                 if fname == "has":
                     accs.append(valid)
@@ -1104,8 +1260,10 @@ class AggOp(PhysicalOp):
 
     def _collect_elems(self, accs) -> list[int]:
         from auron_tpu.utils.shapes import next_pow2
+        # list accumulators are (values[cap, E], lens[cap]); decimal limb
+        # pairs are also 2-tuples but 1-D and carry no element width
         return [max(4, next_pow2(a[0].shape[1]))
-                if isinstance(a, tuple) and len(a) == 2
+                if isinstance(a, tuple) and len(a) == 2 and a[0].ndim == 2
                 else 0 for a in accs]
 
     def _grow_check(self, kinds, out_elems, ng, out_cap, needed):
@@ -1277,6 +1435,11 @@ class AggOp(PhysicalOp):
                     if isinstance(data, tuple) and len(data) == 3:
                         out_cols.append(StringColumn(
                             data[0], data[1], data[2] & valid))
+                    elif isinstance(data, tuple) and data[0].ndim == 1:
+                        from auron_tpu.columnar.decimal128 import \
+                            Decimal128Column
+                        out_cols.append(Decimal128Column(
+                            data[0], data[1], valid))
                     elif isinstance(data, tuple):
                         out_cols.append(list_col(data))
                     else:
@@ -1293,21 +1456,63 @@ class AggOp(PhysicalOp):
                     out_cols.append(PrimitiveColumn(state_vals[0], valid))
                 elif fn == "sum":
                     s, has = state_vals
-                    out_cols.append(PrimitiveColumn(s, valid & has))
+                    if isinstance(s, tuple):
+                        from auron_tpu.columnar import decimal128 as d128
+                        from auron_tpu.columnar.decimal128 import \
+                            Decimal128Column
+                        h, l = s
+                        # Spark non-ANSI: overflow beyond the declared
+                        # precision nulls the group
+                        fits = d128.fits_precision(h, l, spec.result[1])
+                        out_cols.append(Decimal128Column(
+                            h, l, valid & has & fits))
+                    else:
+                        out_cols.append(PrimitiveColumn(s, valid & has))
                 elif fn == "avg":
                     s, cnt = state_vals
                     res_dt = spec.result[0]
                     safe = jnp.maximum(cnt, 1)
-                    if res_dt == DataType.FLOAT64:
-                        avg = s.astype(jnp.float64) / safe
+                    if isinstance(s, tuple):
+                        # two-limb sum at the input scale: shift to the
+                        # result scale inside the HALF_UP division; Spark
+                        # nulls averages that overflow decimal(38)
+                        from auron_tpu.columnar import decimal128 as d128
+                        from auron_tpu.columnar.decimal128 import \
+                            Decimal128Column
+                        k = spec.result[2] - spec.state_ps[0][1]
+                        qh, ql, fits = d128.avg_pow10_div_half_up(
+                            s[0], s[1], safe, k)
+                        out_cols.append(Decimal128Column(
+                            qh, ql, valid & (cnt > 0) & fits))
+                    elif res_dt == DataType.DECIMAL:
+                        # scaled-int64 sum at the input scale; same
+                        # q*10^k + round(r*10^k/count) composition in
+                        # int64, overflow past the 18-digit result → null
+                        k = spec.result[2] - spec.state_ps[0][1]
+                        shift = 10 ** k
+                        a = jnp.abs(s)
+                        q0 = a // safe
+                        rem = a - q0 * safe
+                        fits = q0 < 10 ** (18 - k)
+                        frac = (2 * rem * shift + safe) // (2 * safe)
+                        q = q0 * shift + frac
+                        avg = jnp.where(s < 0, -q, q)
+                        out_cols.append(PrimitiveColumn(
+                            avg, valid & (cnt > 0) & fits))
                     else:
-                        avg = s / safe
-                    out_cols.append(PrimitiveColumn(avg, valid & (cnt > 0)))
+                        avg = s.astype(jnp.float64) / safe
+                        out_cols.append(PrimitiveColumn(
+                            avg, valid & (cnt > 0)))
                 elif fn in ("min", "max", "first", "first_ignores_null"):
                     if len(state_vals) == 1:   # string acc: validity inside
                         chars, lens, sv = state_vals[0]
                         out_cols.append(StringColumn(chars, lens,
                                                      sv & valid))
+                    elif isinstance(state_vals[0], tuple):
+                        from auron_tpu.columnar.decimal128 import \
+                            Decimal128Column
+                        (h, l), has = state_vals
+                        out_cols.append(Decimal128Column(h, l, valid & has))
                     else:
                         v, has = state_vals
                         out_cols.append(PrimitiveColumn(v, valid & has))
@@ -1377,6 +1582,9 @@ class AggOp(PhysicalOp):
         for a in accs:
             if isinstance(a, tuple) and len(a) == 3:
                 cols.append(StringColumn(a[0], a[1], a[2] & valid))
+            elif isinstance(a, tuple) and a[0].ndim == 1:
+                from auron_tpu.columnar.decimal128 import Decimal128Column
+                cols.append(Decimal128Column(a[0], a[1], valid))
             elif isinstance(a, tuple):
                 cols.append(_list_column_from_acc(a, valid))
             else:
@@ -1401,6 +1609,10 @@ class AggOp(PhysicalOp):
                     accs.append((col.chars, col.lens, col.validity))
                     idx += 1
                     continue
+                if kind in _DEC_KINDS:
+                    accs.append((col.hi, col.lo))
+                    idx += 1
+                    continue
                 data = col.data
                 if fname == "has":
                     data = data.astype(jnp.bool_) & col.validity
@@ -1416,6 +1628,9 @@ class AggOp(PhysicalOp):
         for a in accs:
             if isinstance(a, tuple) and len(a) == 3:
                 cols.append(StringColumn(a[0], a[1], a[2]))
+            elif isinstance(a, tuple) and a[0].ndim == 1:
+                from auron_tpu.columnar.decimal128 import Decimal128Column
+                cols.append(Decimal128Column(a[0], a[1], live))
             elif isinstance(a, tuple):
                 cols.append(_list_column_from_acc(a, live))
             else:
@@ -1583,24 +1798,42 @@ def make_acc_spec_from_partial(agg: ir.AggFunction, in_schema: Schema,
         return AccSpec(fn, (("count", DataType.INT64, "sum"),),
                        (DataType.INT64, 0, 0))
     f0 = in_schema[start_idx]
+    wide = f0.dtype == DataType.DECIMAL and f0.precision > 18
     if fn == "sum":
-        return AccSpec(fn, (("sum", f0.dtype, "sum"), ("has", DataType.BOOL, "or")),
+        return AccSpec(fn, (("sum", f0.dtype, "dsum" if wide else "sum"),
+                            ("has", DataType.BOOL, "or")),
                        (f0.dtype, f0.precision, f0.scale))
     if fn == "avg":
+        if f0.dtype == DataType.DECIMAL:
+            # the partial side accumulated the sum at the input scale and
+            # stashed the result (p, s) in the count field's metadata
+            # slots (see make_acc_spec); fall back to an estimate for
+            # partial layouts that predate the channel
+            f1 = in_schema[start_idx + 1]
+            cap = 38 if wide else 18
+            rp = f1.precision or (cap if f0.precision >= cap
+                                  else max(f0.precision - 10, 1))
+            rs = f1.scale or min(f0.scale + 4, rp)
+            return AccSpec(fn, (("sum", f0.dtype, "dsum" if wide else "sum"),
+                                ("count", DataType.INT64, "sum")),
+                           (DataType.DECIMAL, rp, rs),
+                           state_ps=((f0.precision, f0.scale), (rp, rs)))
         return AccSpec(fn, (("sum", f0.dtype, "sum"), ("count", DataType.INT64, "sum")),
                        (DataType.FLOAT64, 0, 0))
     if fn in ("min", "max"):
         if f0.dtype == DataType.STRING:
             return AccSpec(fn, (("val", DataType.STRING, f"s{fn}"),),
                            (f0.dtype, f0.precision, f0.scale))
-        return AccSpec(fn, (("val", f0.dtype, fn), ("has", DataType.BOOL, "or")),
+        return AccSpec(fn, (("val", f0.dtype, f"d{fn}" if wide else fn),
+                            ("has", DataType.BOOL, "or")),
                        (f0.dtype, f0.precision, f0.scale))
     if fn in ("first", "first_ignores_null"):
         if f0.dtype == DataType.STRING:
             kind = "sfirst_ign" if fn == "first_ignores_null" else "sfirst"
             return AccSpec(fn, (("val", DataType.STRING, kind),),
                            (f0.dtype, f0.precision, f0.scale))
-        return AccSpec(fn, (("val", f0.dtype, "first"), ("has", DataType.BOOL, "or")),
+        return AccSpec(fn, (("val", f0.dtype, "dfirst" if wide else "first"),
+                            ("has", DataType.BOOL, "or")),
                        (f0.dtype, f0.precision, f0.scale))
     if fn in ("collect_list", "collect_set"):
         return AccSpec(fn, (("list", f0.elem, fn),), (DataType.LIST, 0, 0),

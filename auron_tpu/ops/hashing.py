@@ -286,6 +286,17 @@ def xxhash64_string(chars: jax.Array, lens: jax.Array, seed) -> jax.Array:
 
 def _hash_column_murmur(col: Column, hashes: jax.Array) -> jax.Array:
     """One column's contribution to the running murmur3 hash (int32[n])."""
+    from auron_tpu.columnar.decimal128 import Decimal128Column
+    if isinstance(col, Decimal128Column):
+        # limb-pair hashing: chain the low then high limb as two int64
+        # words. DELIBERATE DEVIATION from Spark, which hashes wide
+        # decimals as minimal big-endian two's-complement byte arrays
+        # (variable length — hostile to static shapes); engine-internal
+        # consistency is what hash partitioning / hash agg need, and both
+        # sides of any exchange run this same kernel.
+        new = murmur3_int64(col.lo, hashes.view(jnp.uint32))
+        new = murmur3_int64(col.hi, new.view(jnp.uint32))
+        return jnp.where(col.validity, new, hashes)
     if isinstance(col, StringColumn):
         new = murmur3_string(col.chars, col.lens, hashes.view(jnp.uint32))
     else:
@@ -309,6 +320,12 @@ def _hash_column_murmur(col: Column, hashes: jax.Array) -> jax.Array:
 
 
 def _hash_column_xxhash(col: Column, hashes: jax.Array) -> jax.Array:
+    from auron_tpu.columnar.decimal128 import Decimal128Column
+    if isinstance(col, Decimal128Column):
+        # limb-pair hashing; see _hash_column_murmur for the Spark deviation
+        new = xxhash64_int64(col.lo, hashes.view(jnp.uint64))
+        new = xxhash64_int64(col.hi, new.view(jnp.uint64))
+        return jnp.where(col.validity, new, hashes)
     if isinstance(col, StringColumn):
         new = xxhash64_string(col.chars, col.lens, hashes.view(jnp.uint64))
     else:
@@ -336,24 +353,13 @@ def murmur3_columns(cols: list[Column], capacity: int,
     """Spark create_hashes: running int32 hash chained across columns."""
     hashes = jnp.full((capacity,), seed, jnp.int32)
     for col in cols:
-        _reject_decimal128(col)
         hashes = _hash_column_murmur(col, hashes)
     return hashes
-
-
-def _reject_decimal128(col) -> None:
-    from auron_tpu.columnar.decimal128 import Decimal128Column
-    if isinstance(col, Decimal128Column):
-        raise NotImplementedError(
-            "hash partitioning / hash join / hash agg on decimal(>18) keys "
-            "is not supported yet — use sort-based operators (SMJ, range "
-            "partitioning) or cast the key")
 
 
 def xxhash64_columns(cols: list[Column], capacity: int, seed: int = 42) -> jax.Array:
     hashes = jnp.full((capacity,), seed, jnp.int64)
     for col in cols:
-        _reject_decimal128(col)
         hashes = _hash_column_xxhash(col, hashes)
     return hashes
 

@@ -123,6 +123,9 @@ def _keys_match(probe_keys, probe_idx, build_keys, build_idx) -> jax.Array:
         if isinstance(pc, StringColumn):
             same = jnp.all(pc.chars[probe_idx] == bc.chars[build_idx], axis=1) \
                 & (pc.lens[probe_idx] == bc.lens[build_idx])
+        elif hasattr(pc, "hi"):   # Decimal128Column: limb-pair equality
+            same = (pc.hi[probe_idx] == bc.hi[build_idx]) \
+                & (pc.lo[probe_idx] == bc.lo[build_idx])
         else:
             same = pc.data[probe_idx] == bc.data[build_idx]
         ok = ok & pv & bv & same

@@ -69,9 +69,12 @@ class WindowFunctionSpec:
 
 def _col_neq_prev(col) -> jax.Array:
     """bool[cap]: row i differs from row i-1 (null-aware; row 0 => True)."""
+    from auron_tpu.columnar.decimal128 import Decimal128Column
     if isinstance(col, StringColumn):
         same_chars = jnp.all(col.chars[1:] == col.chars[:-1], axis=1)
         same = same_chars & (col.lens[1:] == col.lens[:-1])
+    elif isinstance(col, Decimal128Column):
+        same = (col.hi[1:] == col.hi[:-1]) & (col.lo[1:] == col.lo[:-1])
     else:
         same = col.data[1:] == col.data[:-1]
     both_null = (~col.validity[1:]) & (~col.validity[:-1])
@@ -98,6 +101,21 @@ def _segmented_scan(values, seg_new: jax.Array, combine):
     return out
 
 
+def _segmented_scan128(h, l, seg_new: jax.Array, combine128):
+    """Segmented inclusive scan over two-limb (hi, lo) values; combine128
+    takes (ah, al, bh, bl) -> (h, l) and must be associative (add128 and
+    the cmp128-select min/max are)."""
+    def op(a, b):
+        fa, ha, la = a
+        fb, hb, lb = b
+        ch, cl = combine128(ha, la, hb, lb)
+        return (fa | fb,
+                jnp.where(fb, hb, ch), jnp.where(fb, lb, cl))
+
+    _, oh, ol = jax.lax.associative_scan(op, (seg_new, h, l))
+    return oh, ol
+
+
 # ---------------------------------------------------------------------------
 # kernel
 # ---------------------------------------------------------------------------
@@ -108,12 +126,6 @@ def _result_field(spec: WindowFunctionSpec, name: str,
         if spec.fn in ("percent_rank", "cume_dist"):
             return Field(name, DataType.FLOAT64, False)
         return Field(name, DataType.INT64, False)
-    if spec.arg is not None and spec.fn not in ("count", "count_star"):
-        _dt, _p, _s = infer_dtype(spec.arg, in_schema)
-        if _dt == DataType.DECIMAL and _p > 18:
-            raise NotImplementedError(
-                f"window {spec.fn} over decimal(p={_p}>18): cast to "
-                "decimal(<=18) or double first")
     if spec.kind == "offset":
         dt, p, s = infer_dtype(spec.arg, in_schema)
         return Field(name, dt, True, p, s)
@@ -123,9 +135,15 @@ def _result_field(spec: WindowFunctionSpec, name: str,
     dt, p, s = infer_dtype(spec.arg, in_schema)
     if spec.fn == "avg":
         if dt == DataType.DECIMAL:
-            p, s = _decimal_avg_type(p, s)
+            if p > 18:
+                from auron_tpu.ops.agg import decimal_avg_result
+                p, s = decimal_avg_result(p, s)
+            else:
+                p, s = _decimal_avg_type(p, s)
         elif dt != DataType.FLOAT64:
             dt = DataType.FLOAT64
+    if spec.fn == "sum" and dt == DataType.DECIMAL and p > 18:
+        p = min(p + 10, 38)   # Spark sum headroom, 128-bit cap
     if spec.fn == "sum" and dt.is_integer:
         dt = DataType.INT64   # kernel accumulates int64 (Spark: sum → long)
     return Field(name, dt, True, p, s)
@@ -168,9 +186,13 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
         n = sbatch.num_rows
 
         def sorted_col(c):
+            from auron_tpu.columnar.decimal128 import Decimal128Column
             if isinstance(c, StringColumn):
                 return StringColumn(c.chars[perm], c.lens[perm],
                                     c.validity[perm])
+            if isinstance(c, Decimal128Column):
+                return Decimal128Column(c.hi[perm], c.lo[perm],
+                                        c.validity[perm])
             return PrimitiveColumn(c.data[perm], c.validity[perm])
 
         spcols = [sorted_col(c) for c in pcols]
@@ -260,6 +282,15 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
                     bound = tie_end_row if order_by else seg_end_row
                     in_seg = (src <= bound) & live
                     src_c = jnp.clip(src, 0, cap - 1)
+                from auron_tpu.columnar.decimal128 import Decimal128Column
+                if isinstance(col, Decimal128Column):
+                    if spec.default is not None:
+                        raise NotImplementedError(
+                            "lead/lag default over decimal(p>18)")
+                    out_cols.append(Decimal128Column(
+                        col.hi[src_c], col.lo[src_c],
+                        col.validity[src_c] & in_seg & live))
+                    continue
                 if isinstance(col, StringColumn):
                     chars = col.chars[src_c]
                     lens = jnp.where(in_seg, col.lens[src_c], 0)
@@ -283,7 +314,49 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
                 out_cols.append(out)
                 continue
 
-            # agg over window
+            # agg over window — two-limb decimal(p>18) values run the
+            # same segmented scans in 128-bit limb arithmetic
+            from auron_tpu.columnar.decimal128 import Decimal128Column
+            if v is not None and isinstance(v.col, Decimal128Column) \
+                    and spec.fn != "count":
+                from auron_tpu.columnar import decimal128 as d128
+                from auron_tpu.ops.agg import _DEC_NEUTRAL
+                vv = v.validity & live
+                hi, lo = v.col.hi, v.col.lo
+                has = _segmented_scan(vv.astype(jnp.int64), seg_new,
+                                      jnp.add)
+                if spec.fn in ("sum", "avg"):
+                    rh, rl = _segmented_scan128(
+                        jnp.where(vv, hi, 0), jnp.where(vv, lo, 0),
+                        seg_new, d128.add128)
+                else:   # min / max
+                    nh, nl = _DEC_NEUTRAL[f"d{spec.fn}"]
+                    def pick(ah, al, bh, bl, _mx=(spec.fn == "max")):
+                        lt, _ = d128.cmp128(ah, al, bh, bl)
+                        take_a = (~lt) if _mx else lt
+                        return (jnp.where(take_a, ah, bh),
+                                jnp.where(take_a, al, bl))
+                    rh, rl = _segmented_scan128(
+                        jnp.where(vv, hi, nh), jnp.where(vv, lo, nl),
+                        seg_new, pick)
+                end = tie_end_row if order_by else seg_end_row
+                end_c = jnp.clip(end, 0, cap - 1)
+                rh, rl, has_e = rh[end_c], rl[end_c], has[end_c]
+                ok = has_e > 0
+                if spec.fn == "sum":
+                    # running sums past the declared precision null, like
+                    # AggOp's wide sum (Spark non-ANSI overflow)
+                    _dt, _p, _s = infer_dtype(spec.arg, in_schema)
+                    ok = ok & d128.fits_precision(rh, rl, min(_p + 10, 38))
+                if spec.fn == "avg":
+                    _dt, _p, in_s = infer_dtype(spec.arg, in_schema)
+                    from auron_tpu.ops.agg import decimal_avg_result
+                    _rp, rs = decimal_avg_result(_p, in_s)
+                    rh, rl, fits = d128.avg_pow10_div_half_up(
+                        rh, rl, jnp.maximum(has_e, 1), rs - in_s)
+                    ok = ok & fits
+                out_cols.append(Decimal128Column(rh, rl, ok & live))
+                continue
             if spec.fn == "count_star":
                 run = _segmented_scan(live.astype(jnp.int64), seg_new, jnp.add)
                 valid = live
