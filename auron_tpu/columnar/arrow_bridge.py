@@ -58,6 +58,29 @@ def schema_from_arrow(sch: pa.Schema) -> Schema:
             if elem is None or elem in (DataType.STRING, DataType.NULL):
                 raise NotImplementedError(f"list of {t.value_type}")
             fields.append(Field(f.name, DataType.LIST, f.nullable, elem=elem))
+        elif pa.types.is_map(t):
+            key = _PA_TO_DT.get(t.key_type)
+            val = _PA_TO_DT.get(t.item_type)
+            if key in (None, DataType.STRING, DataType.NULL) \
+                    or val in (None, DataType.STRING, DataType.NULL):
+                raise NotImplementedError(
+                    f"map<{t.key_type}, {t.item_type}>: only primitive "
+                    "keys/values have a columnar materialization")
+            fields.append(Field(f.name, DataType.MAP, f.nullable,
+                                elem=val, key=key))
+        elif pa.types.is_struct(t):
+            kids = []
+            for i in range(t.num_fields):
+                cf = t.field(i)
+                sub = schema_from_arrow(pa.schema([cf]))
+                if sub[0].dtype in (DataType.MAP, DataType.STRUCT,
+                                    DataType.LIST):
+                    raise NotImplementedError(
+                        f"struct child {cf.name}: nested map/struct/list "
+                        "children are not materialized yet")
+                kids.append(sub[0])
+            fields.append(Field(f.name, DataType.STRUCT, f.nullable,
+                                children=tuple(kids)))
         else:
             raise NotImplementedError(f"arrow type {t} not supported")
     return Schema(tuple(fields))
@@ -78,6 +101,12 @@ def schema_to_arrow(schema: Schema) -> pa.Schema:
             t = pa.null()
         elif f.dtype == DataType.LIST:
             t = pa.list_(pa.from_numpy_dtype(f.elem.to_np()))
+        elif f.dtype == DataType.MAP:
+            t = pa.map_(pa.from_numpy_dtype(f.key.to_np()),
+                        pa.from_numpy_dtype(f.elem.to_np()))
+        elif f.dtype == DataType.STRUCT:
+            t = pa.struct([schema_to_arrow(Schema((cf,)))[0]
+                           for cf in f.children])
         else:
             t = pa.from_numpy_dtype(f.dtype.to_np())
         out.append(pa.field(f.name, t, f.nullable))
@@ -156,6 +185,47 @@ def _list_arrays(arr: pa.Array, capacity: int, elem_np) -> tuple:
     return values, elem_valid, lens_full, validity_full
 
 
+def _map_to_device(field: Field, arr: pa.Array, cap: int):
+    """MapArray → MapColumn via two list-view extractions over the shared
+    offsets (keys carry no element validity — Spark map keys are
+    non-null)."""
+    from auron_tpu.columnar.batch import MapColumn
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    n = len(arr)
+    offsets = np.asarray(arr.offsets)[: n + 1]
+    off = pa.array(offsets.astype(np.int32), pa.int32())
+    keys_list = pa.ListArray.from_arrays(off, arr.keys)
+    items_list = pa.ListArray.from_arrays(off, arr.items)
+    kv, _kev, lens, _ = _list_arrays(keys_list, cap, field.key.to_np())
+    vv, vev, _vlens, _ = _list_arrays(items_list, cap, field.elem.to_np())
+    validity = np.zeros(cap, bool)
+    validity[:n] = (~np.asarray(arr.is_null()) if arr.null_count
+                    else np.ones(n, bool))
+    lens = np.where(validity, lens, 0).astype(np.int32)
+    # unify element buckets (keys/values extracted independently)
+    m = max(kv.shape[1], vv.shape[1])
+    kv = np.pad(kv, ((0, 0), (0, m - kv.shape[1])))
+    vv = np.pad(vv, ((0, 0), (0, m - vv.shape[1])))
+    vev = np.pad(vev, ((0, 0), (0, m - vev.shape[1])))
+    return MapColumn(jnp.asarray(kv), jnp.asarray(vv), jnp.asarray(vev),
+                     jnp.asarray(lens), jnp.asarray(validity))
+
+
+def _struct_to_device(field: Field, arr: pa.Array, cap: int):
+    from auron_tpu.columnar.batch import StructColumn
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    n = len(arr)
+    kids = tuple(
+        _column_to_device(cf, arr.field(i), cap, None)
+        for i, cf in enumerate(field.children))
+    validity = np.zeros(cap, bool)
+    validity[:n] = (~np.asarray(arr.is_null()) if arr.null_count
+                    else np.ones(n, bool))
+    return StructColumn(kids, jnp.asarray(validity))
+
+
 def to_device(rb: pa.RecordBatch, capacity: int | None = None,
               string_widths: dict[str, int] | None = None) -> tuple[DeviceBatch, Schema]:
     """Convert a pyarrow RecordBatch into a padded DeviceBatch."""
@@ -164,150 +234,185 @@ def to_device(rb: pa.RecordBatch, capacity: int | None = None,
     cap = capacity if capacity is not None else bucket_rows(n)
     if n > cap:
         raise ValueError(f"batch of {n} rows exceeds capacity {cap}")
-    cols: list = []
-    for field, arr in zip(schema, rb.columns):
-        if isinstance(arr, pa.ChunkedArray):
-            arr = arr.combine_chunks()
-        if pa.types.is_dictionary(arr.type):
-            arr = arr.dictionary_decode()
-        if field.dtype == DataType.STRING:
-            w = (string_widths or {}).get(field.name)
-            chars, lens, validity = _string_arrays(arr, cap, w)
-            cols.append(StringColumn(jnp.asarray(chars), jnp.asarray(lens),
-                                     jnp.asarray(validity)))
-            continue
-        if field.dtype == DataType.LIST:
-            values, ev, lens, validity = _list_arrays(arr, cap,
-                                                      field.elem.to_np())
-            cols.append(ListColumn(jnp.asarray(values), jnp.asarray(ev),
-                                   jnp.asarray(lens), jnp.asarray(validity)))
-            continue
-        np_dtype = field.dtype.to_np()
-        validity = np.zeros(cap, bool)
-        data = np.zeros(cap, np_dtype)
-        if field.dtype == DataType.NULL:
-            cols.append(PrimitiveColumn(jnp.asarray(data), jnp.asarray(validity)))
-            continue
-        if field.dtype == DataType.DECIMAL:
-            pyvals = arr.to_pylist()
-            if field.precision > 18:
-                # precision 19..38: two-limb device representation
-                # (columnar/decimal128.py; reference stores Decimal128 and
-                # computes in i128, arrow/cast.rs decimal paths)
-                from auron_tpu.columnar.decimal128 import (Decimal128Column,
-                                                           limbs_from_ints)
-                import decimal as _dec
-                with _dec.localcontext() as _ctx:
-                    # default context (prec=28) would silently round
-                    # 29-38 digit values during scaleb
-                    _ctx.prec = 60
-                    ints = [None if v is None
-                            else int(v.scaleb(field.scale)
-                                     .to_integral_value())
-                            for v in pyvals]
-                hi, lo, valid128 = limbs_from_ints(ints, cap)
-                cols.append(Decimal128Column(jnp.asarray(hi),
-                                             jnp.asarray(lo),
-                                             jnp.asarray(valid128)))
-                continue
-            # <=18 digits: unscaled int64 payload (reference:
-            # datafusion-ext-functions/src/spark_make_decimal.rs)
-            unscaled = np.zeros(n, np.int64)
-            for i, v in enumerate(pyvals):
-                if v is not None:
-                    unscaled[i] = int(v.scaleb(field.scale).to_integral_value())
-            data[:n] = unscaled
-            validity[:n] = [v is not None for v in pyvals]
-        elif field.dtype == DataType.TIMESTAMP_US:
-            arr_us = arr.cast(pa.timestamp("us"))
-            vals = arr_us.cast(pa.int64())
-            data[:n] = np.asarray(vals.fill_null(0))
-            validity[:n] = ~np.asarray(arr.is_null()) if arr.null_count else True
-        elif field.dtype == DataType.DATE32:
-            vals = arr.cast(pa.int32())
-            data[:n] = np.asarray(vals.fill_null(0))
-            validity[:n] = ~np.asarray(arr.is_null()) if arr.null_count else True
-        else:
-            vals = arr.fill_null(False) if field.dtype == DataType.BOOL else arr.fill_null(0)
-            data[:n] = np.asarray(vals)
-            validity[:n] = ~np.asarray(arr.is_null()) if arr.null_count else True
-        cols.append(PrimitiveColumn(jnp.asarray(data), jnp.asarray(validity)))
+    cols = [_column_to_device(field, arr, cap, string_widths)
+            for field, arr in zip(schema, rb.columns)]
     return DeviceBatch(tuple(cols), jnp.asarray(n, jnp.int32)), schema
+
+
+def _column_to_device(field: Field, arr, cap: int,
+                      string_widths: dict[str, int] | None):
+    n = len(arr)
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if pa.types.is_dictionary(arr.type):
+        arr = arr.dictionary_decode()
+    if field.dtype == DataType.STRING:
+        w = (string_widths or {}).get(field.name)
+        chars, lens, validity = _string_arrays(arr, cap, w)
+        return StringColumn(jnp.asarray(chars), jnp.asarray(lens),
+                            jnp.asarray(validity))
+    if field.dtype == DataType.LIST:
+        values, ev, lens, validity = _list_arrays(arr, cap,
+                                                  field.elem.to_np())
+        return ListColumn(jnp.asarray(values), jnp.asarray(ev),
+                          jnp.asarray(lens), jnp.asarray(validity))
+    if field.dtype == DataType.MAP:
+        return _map_to_device(field, arr, cap)
+    if field.dtype == DataType.STRUCT:
+        return _struct_to_device(field, arr, cap)
+    np_dtype = field.dtype.to_np()
+    validity = np.zeros(cap, bool)
+    data = np.zeros(cap, np_dtype)
+    if field.dtype == DataType.NULL:
+        return PrimitiveColumn(jnp.asarray(data), jnp.asarray(validity))
+    if field.dtype == DataType.DECIMAL:
+        pyvals = arr.to_pylist()
+        if field.precision > 18:
+            # precision 19..38: two-limb device representation
+            # (columnar/decimal128.py; reference stores Decimal128 and
+            # computes in i128, arrow/cast.rs decimal paths)
+            from auron_tpu.columnar.decimal128 import (Decimal128Column,
+                                                       limbs_from_ints)
+            import decimal as _dec
+            with _dec.localcontext() as _ctx:
+                # default context (prec=28) would silently round
+                # 29-38 digit values during scaleb
+                _ctx.prec = 60
+                ints = [None if v is None
+                        else int(v.scaleb(field.scale)
+                                 .to_integral_value())
+                        for v in pyvals]
+            hi, lo, valid128 = limbs_from_ints(ints, cap)
+            return Decimal128Column(jnp.asarray(hi), jnp.asarray(lo),
+                                    jnp.asarray(valid128))
+        # <=18 digits: unscaled int64 payload (reference:
+        # datafusion-ext-functions/src/spark_make_decimal.rs)
+        unscaled = np.zeros(n, np.int64)
+        for i, v in enumerate(pyvals):
+            if v is not None:
+                unscaled[i] = int(v.scaleb(field.scale).to_integral_value())
+        data[:n] = unscaled
+        validity[:n] = [v is not None for v in pyvals]
+    elif field.dtype == DataType.TIMESTAMP_US:
+        arr_us = arr.cast(pa.timestamp("us"))
+        vals = arr_us.cast(pa.int64())
+        data[:n] = np.asarray(vals.fill_null(0))
+        validity[:n] = ~np.asarray(arr.is_null()) if arr.null_count else True
+    elif field.dtype == DataType.DATE32:
+        vals = arr.cast(pa.int32())
+        data[:n] = np.asarray(vals.fill_null(0))
+        validity[:n] = ~np.asarray(arr.is_null()) if arr.null_count else True
+    else:
+        vals = arr.fill_null(False) if field.dtype == DataType.BOOL else arr.fill_null(0)
+        data[:n] = np.asarray(vals)
+        validity[:n] = ~np.asarray(arr.is_null()) if arr.null_count else True
+    return PrimitiveColumn(jnp.asarray(data), jnp.asarray(validity))
 
 
 def to_arrow(batch: DeviceBatch, schema: Schema) -> pa.RecordBatch:
     """Materialize a DeviceBatch back to a pyarrow RecordBatch — ONE packed
     device→host transfer for the whole batch (columnar.serde.fetch_batch_numpy;
-    per-array fetches pay ~70 ms tunnel latency EACH on remote accelerators)."""
-    from auron_tpu.columnar.serde import fetch_batch_numpy
+    per-array fetches pay ~70 ms tunnel latency EACH on remote accelerators).
+    Every column routes through the one host→arrow converter
+    (_host_col_to_arrow) so top-level and struct-child renderings of the
+    same logical type cannot drift."""
+    from auron_tpu.columnar.serde import (_slice_host_col, fetch_batch_numpy,
+                                          host_col_from_device)
     fetched, n = fetch_batch_numpy(batch)
     arrays = []
     for field, col, col_arrs in zip(schema, batch.columns, fetched):
-        if isinstance(col, StringColumn):
-            chars = col_arrs[0][:n]
-            lens = col_arrs[1][:n].astype(np.int64)
-            validity = col_arrs[2][:n]
-            lens = np.where(validity, lens, 0)
-            offsets = np.zeros(n + 1, np.int32)
-            np.cumsum(lens, out=offsets[1:])
-            take_mask = np.arange(chars.shape[1])[None, :] < lens[:, None]
-            flat = chars[take_mask].astype(np.uint8)
-            arrays.append(pa.StringArray.from_buffers(
-                n, pa.py_buffer(offsets.tobytes()), pa.py_buffer(flat.tobytes()),
-                pa.py_buffer(np.packbits(validity, bitorder="little").tobytes()),
-                int((~validity).sum())))
-            continue
-        if isinstance(col, ListColumn):
-            values = col_arrs[0][:n]
-            ev = col_arrs[1][:n]
-            validity = col_arrs[3][:n]
-            lens = np.where(validity, col_arrs[2][:n], 0)
-            take = np.arange(col.max_elems)[None, :] < lens[:, None]
-            flat_vals = values[take]
-            flat_valid = ev[take]
-            child = pa.array(flat_vals,
-                             pa.from_numpy_dtype(field.elem.to_np()))
-            if not flat_valid.all():
-                child = _with_nulls(child, flat_valid)
-            offsets = np.zeros(n + 1, np.int32)
-            np.cumsum(lens, out=offsets[1:])
-            off_arr = pa.array(
-                [None if not v else int(o)
-                 for o, v in zip(offsets[:-1], validity)] + [int(offsets[-1])],
-                pa.int32()) if not validity.all() else \
-                pa.array(offsets, pa.int32())
-            arrays.append(pa.ListArray.from_arrays(off_arr, child))
-            continue
-        from auron_tpu.columnar.decimal128 import Decimal128Column
-        if isinstance(col, Decimal128Column):
-            from auron_tpu.columnar.decimal128 import ints_from_limbs
-            ints = ints_from_limbs(col_arrs[0][:n], col_arrs[1][:n],
-                                   col_arrs[2][:n])
-            vals = [None if x is None else _int_to_decimal(x, field.scale)
-                    for x in ints]
-            arrays.append(pa.array(
-                vals, type=pa.decimal128(field.precision, field.scale)))
-            continue
-        data = col_arrs[0][:n]
-        validity = col_arrs[1][:n]
-        if field.dtype == DataType.DECIMAL:
-            vals = [None if not v else _int_to_decimal(int(x), field.scale)
-                    for x, v in zip(data, validity)]
-            arrays.append(pa.array(vals, type=pa.decimal128(field.precision, field.scale)))
-        elif field.dtype == DataType.DATE32:
-            arrays.append(pa.array(np.where(validity, data, 0), pa.int32())
-                          .cast(pa.date32()))
-            if not validity.all():
-                arrays[-1] = _with_nulls(arrays[-1], validity)
-        elif field.dtype == DataType.TIMESTAMP_US:
-            a = pa.array(np.where(validity, data, 0), pa.int64()).cast(pa.timestamp("us"))
-            arrays.append(a if validity.all() else _with_nulls(a, validity))
-        elif field.dtype == DataType.NULL:
-            arrays.append(pa.nulls(n))
-        else:
-            a = pa.array(data)
-            arrays.append(a if validity.all() else _with_nulls(a, validity))
+        hc = _slice_host_col(host_col_from_device(col, iter(col_arrs)), 0, n)
+        arrays.append(_host_col_to_arrow(field, hc, n))
     return pa.RecordBatch.from_arrays(arrays, schema=schema_to_arrow(schema))
+
+
+def _host_col_to_arrow(field: Field, hc, n: int) -> pa.Array:
+    """ONE host column → pyarrow array; the single conversion point for
+    every logical type (top-level columns and struct children alike)."""
+    from auron_tpu.columnar.serde import (HostDecimal128, HostList, HostMap,
+                                          HostString, HostStruct)
+    if isinstance(hc, HostList):
+        validity = hc.validity
+        lens = np.where(validity, hc.lens.astype(np.int64), 0)
+        take = np.arange(hc.values.shape[1])[None, :] < lens[:, None]
+        flat_vals = hc.values[take]
+        flat_valid = hc.elem_valid[take]
+        child = pa.array(flat_vals, pa.from_numpy_dtype(field.elem.to_np()))
+        if not flat_valid.all():
+            child = _with_nulls(child, flat_valid)
+        offsets = np.zeros(n + 1, np.int32)
+        np.cumsum(lens, out=offsets[1:])
+        off_arr = pa.array(
+            [None if not v else int(o)
+             for o, v in zip(offsets[:-1], validity)] + [int(offsets[-1])],
+            pa.int32()) if not validity.all() else \
+            pa.array(offsets, pa.int32())
+        return pa.ListArray.from_arrays(off_arr, child)
+    if isinstance(hc, HostMap):
+        validity = hc.validity
+        lens = np.where(validity, hc.lens, 0).astype(np.int64)
+        take = np.arange(hc.keys.shape[1])[None, :] < lens[:, None]
+        karr = pa.array(hc.keys[take],
+                        pa.from_numpy_dtype(field.key.to_np()))
+        varr = pa.array(hc.values[take],
+                        pa.from_numpy_dtype(field.elem.to_np()))
+        flat_vv = hc.val_valid[take]
+        if not flat_vv.all():
+            varr = _with_nulls(varr, flat_vv)
+        offsets = np.zeros(n + 1, np.int32)
+        np.cumsum(lens, out=offsets[1:])
+        off_arr = pa.array(
+            [None if not v else int(o)
+             for o, v in zip(offsets[:-1], validity)] + [int(offsets[-1])],
+            pa.int32()) if not validity.all() else \
+            pa.array(offsets, pa.int32())
+        return pa.MapArray.from_arrays(off_arr, karr, varr)
+    if isinstance(hc, HostStruct):
+        kids = [_host_col_to_arrow(cf, ch, n)
+                for cf, ch in zip(field.children, hc.children)]
+        mask = None if hc.validity.all() \
+            else pa.array(~hc.validity, pa.bool_())
+        arr = pa.StructArray.from_arrays(
+            kids, names=[cf.name for cf in field.children], mask=mask)
+        return arr.cast(schema_to_arrow(Schema((field,)))[0].type)
+    if isinstance(hc, HostString):
+        validity = hc.validity
+        lens = np.where(validity, hc.lens.astype(np.int64), 0)
+        offsets = np.zeros(n + 1, np.int32)
+        np.cumsum(lens, out=offsets[1:])
+        take = np.arange(hc.chars.shape[1])[None, :] < lens[:, None]
+        flat = hc.chars[take].astype(np.uint8)
+        return pa.StringArray.from_buffers(
+            n, pa.py_buffer(offsets.tobytes()),
+            pa.py_buffer(flat.tobytes()),
+            pa.py_buffer(np.packbits(validity,
+                                     bitorder="little").tobytes()),
+            int((~validity).sum()))
+    if isinstance(hc, HostDecimal128):
+        from auron_tpu.columnar.decimal128 import ints_from_limbs
+        ints = ints_from_limbs(hc.hi, hc.lo, hc.validity)
+        vals = [None if x is None else _int_to_decimal(x, field.scale)
+                for x in ints]
+        return pa.array(vals,
+                        type=pa.decimal128(field.precision, field.scale))
+    # primitives
+    data, validity = hc.data, hc.validity
+    if field.dtype == DataType.NULL:
+        return pa.nulls(n)
+    if field.dtype == DataType.DECIMAL:
+        vals = [None if not v else _int_to_decimal(int(x), field.scale)
+                for x, v in zip(data, validity)]
+        return pa.array(vals,
+                        type=pa.decimal128(field.precision, field.scale))
+    if field.dtype == DataType.DATE32:
+        a = pa.array(np.where(validity, data, 0), pa.int32()).cast(pa.date32())
+        return a if validity.all() else _with_nulls(a, validity)
+    if field.dtype == DataType.TIMESTAMP_US:
+        a = pa.array(np.where(validity, data, 0),
+                     pa.int64()).cast(pa.timestamp("us"))
+        return a if validity.all() else _with_nulls(a, validity)
+    a = pa.array(data)
+    return a if validity.all() else _with_nulls(a, validity)
 
 
 def _with_nulls(arr: pa.Array, validity: np.ndarray) -> pa.Array:

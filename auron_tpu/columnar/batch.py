@@ -93,8 +93,55 @@ class ListColumn:
         return replace(self, validity=validity)
 
 
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class MapColumn:
+    """Padded map column: parallel key/value matrices sharing one length
+    column (reference stores these as Arrow MapArray — offsets over a
+    struct<key,value> child, datafusion-ext-functions/src/spark_map.rs;
+    here the offsets+child become dense padded matrices like ListColumn).
+    Keys and values are primitive payloads; Spark map keys cannot be null
+    so keys carry no element validity."""
+
+    keys: jax.Array        # [capacity, max_elems] primitive key payload
+    values: jax.Array      # [capacity, max_elems] primitive value payload
+    val_valid: jax.Array   # bool[capacity, max_elems]
+    lens: jax.Array        # int32[capacity]  entry count per row
+    validity: jax.Array    # bool[capacity]   (row null = whole map null)
+
+    @property
+    def capacity(self) -> int:
+        return self.keys.shape[0]
+
+    @property
+    def max_elems(self) -> int:
+        return self.keys.shape[1]
+
+    def with_validity(self, validity: jax.Array) -> "MapColumn":
+        return replace(self, validity=validity)
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class StructColumn:
+    """Struct column: per-field child columns + row validity (Arrow
+    StructArray, reference: datafusion-ext-exprs/src/named_struct.rs /
+    get_indexed_field.rs). Field names/types live in the schema's Field
+    children, never on the device."""
+
+    children: tuple        # tuple[Column, ...] (no nested struct/map yet)
+    validity: jax.Array    # bool[capacity]
+
+    @property
+    def capacity(self) -> int:
+        return self.validity.shape[0]
+
+    def with_validity(self, validity: jax.Array) -> "StructColumn":
+        return replace(self, validity=validity)
+
+
 Column = Union[PrimitiveColumn, StringColumn, ListColumn,
-               Decimal128Column]
+               Decimal128Column, MapColumn, StructColumn]
 
 
 @jax.tree_util.register_dataclass
@@ -138,6 +185,12 @@ def column_nbytes(col: Column) -> int:
                 + col.lens.nbytes + col.validity.nbytes)
     if isinstance(col, Decimal128Column):
         return col.hi.nbytes + col.lo.nbytes + col.validity.nbytes
+    if isinstance(col, MapColumn):
+        return (col.keys.nbytes + col.values.nbytes + col.val_valid.nbytes
+                + col.lens.nbytes + col.validity.nbytes)
+    if isinstance(col, StructColumn):
+        return (sum(column_nbytes(c) for c in col.children)
+                + col.validity.nbytes)
     return col.data.nbytes + col.validity.nbytes
 
 
@@ -177,6 +230,20 @@ def gather_column(col: Column, indices: jax.Array, valid: jax.Array) -> Column:
             hi=col.hi[indices], lo=col.lo[indices],
             validity=col.validity[indices] & valid,
         )
+    if isinstance(col, MapColumn):
+        return MapColumn(
+            keys=col.keys[indices],
+            values=col.values[indices],
+            val_valid=col.val_valid[indices] & valid[:, None],
+            lens=jnp.where(valid, col.lens[indices], 0),
+            validity=col.validity[indices] & valid,
+        )
+    if isinstance(col, StructColumn):
+        return StructColumn(
+            children=tuple(gather_column(c, indices, valid)
+                           for c in col.children),
+            validity=col.validity[indices] & valid,
+        )
     return PrimitiveColumn(
         data=col.data[indices],
         validity=col.validity[indices] & valid,
@@ -214,6 +281,18 @@ def pad_list_elems(col: ListColumn, max_elems: int) -> ListColumn:
         col.lens, col.validity)
 
 
+def pad_map_elems(col: "MapColumn", max_elems: int) -> "MapColumn":
+    """Pad a map column's entry axis out to `max_elems` slots."""
+    if col.max_elems >= max_elems:
+        return col
+    pad = max_elems - col.max_elems
+    return MapColumn(
+        jnp.pad(col.keys, ((0, 0), (0, pad))),
+        jnp.pad(col.values, ((0, 0), (0, pad))),
+        jnp.pad(col.val_valid, ((0, 0), (0, pad))),
+        col.lens, col.validity)
+
+
 def unify_column_widths(cols: Sequence[Column]) -> list[Column]:
     """Pad string widths / list element counts to the max across `cols` so
     they can be concatenated (capacities may differ; widths must not)."""
@@ -223,6 +302,16 @@ def unify_column_widths(cols: Sequence[Column]) -> list[Column]:
     if isinstance(cols[0], ListColumn):
         m = max(c.max_elems for c in cols)
         return [pad_list_elems(c, m) for c in cols]
+    if isinstance(cols[0], MapColumn):
+        m = max(c.max_elems for c in cols)
+        return [pad_map_elems(c, m) for c in cols]
+    if isinstance(cols[0], StructColumn):
+        per_child = [unify_column_widths([c.children[i] for c in cols])
+                     for i in range(len(cols[0].children))]
+        return [StructColumn(tuple(per_child[i][j]
+                                   for i in range(len(per_child))),
+                             c.validity)
+                for j, c in enumerate(cols)]
     return list(cols)
 
 
@@ -249,6 +338,22 @@ def concat_columns(a: Column, b: Column) -> Column:
         return Decimal128Column(
             hi=jnp.concatenate([a.hi, b.hi]),
             lo=jnp.concatenate([a.lo, b.lo]),
+            validity=jnp.concatenate([a.validity, b.validity]),
+        )
+    if isinstance(a, MapColumn):
+        assert isinstance(b, MapColumn) and a.max_elems == b.max_elems
+        return MapColumn(
+            keys=jnp.concatenate([a.keys, b.keys], axis=0),
+            values=jnp.concatenate([a.values, b.values], axis=0),
+            val_valid=jnp.concatenate([a.val_valid, b.val_valid], axis=0),
+            lens=jnp.concatenate([a.lens, b.lens]),
+            validity=jnp.concatenate([a.validity, b.validity]),
+        )
+    if isinstance(a, StructColumn):
+        assert isinstance(b, StructColumn)
+        return StructColumn(
+            children=tuple(concat_columns(ca, cb)
+                           for ca, cb in zip(a.children, b.children)),
             validity=jnp.concatenate([a.validity, b.validity]),
         )
     assert isinstance(b, PrimitiveColumn)
@@ -284,6 +389,26 @@ def resize(batch: DeviceBatch, new_capacity: int) -> DeviceBatch:
         return batch
 
     def resize_col(c: Column) -> Column:
+        if isinstance(c, StructColumn):
+            return StructColumn(
+                children=tuple(resize_col(ch) for ch in c.children),
+                validity=(jnp.pad(c.validity, (0, new_capacity - cap))
+                          if new_capacity > cap
+                          else c.validity[:new_capacity]))
+        if isinstance(c, MapColumn):
+            if new_capacity > cap:
+                pad = new_capacity - cap
+                return MapColumn(
+                    keys=jnp.pad(c.keys, ((0, pad), (0, 0))),
+                    values=jnp.pad(c.values, ((0, pad), (0, 0))),
+                    val_valid=jnp.pad(c.val_valid, ((0, pad), (0, 0))),
+                    lens=jnp.pad(c.lens, (0, pad)),
+                    validity=jnp.pad(c.validity, (0, pad)))
+            return MapColumn(
+                keys=c.keys[:new_capacity], values=c.values[:new_capacity],
+                val_valid=c.val_valid[:new_capacity],
+                lens=c.lens[:new_capacity],
+                validity=c.validity[:new_capacity])
         if new_capacity > cap:
             pad = new_capacity - cap
             if isinstance(c, StringColumn):

@@ -27,6 +27,8 @@ class DataType(enum.Enum):
     DECIMAL = "decimal"        # precision<=18 stored as scaled int64
     STRING = "string"
     LIST = "list"              # list of primitives; element type in Field.elem
+    MAP = "map"                # primitive keys/values; types in Field.key/elem
+    STRUCT = "struct"          # child fields in Field.children
     NULL = "null"
 
     # ---- classification helpers -------------------------------------------
@@ -76,12 +78,16 @@ class Field:
     # decimal only
     precision: int = 0
     scale: int = 0
-    # list element type (dtype == LIST only)
+    # list element / map VALUE type (dtype in (LIST, MAP))
     elem: "DataType" = None
+    # map KEY type (dtype == MAP; Spark map keys are non-null primitives)
+    key: "DataType" = None
+    # struct child fields (dtype == STRUCT)
+    children: tuple = ()
 
     def with_name(self, name: str) -> "Field":
         return Field(name, self.dtype, self.nullable, self.precision,
-                     self.scale, self.elem)
+                     self.scale, self.elem, self.key, self.children)
 
 
 @dataclass(frozen=True)

@@ -90,7 +90,40 @@ class HostDecimal128:
     validity: np.ndarray   # bool[n]
 
 
-HostColumn = Union[HostPrimitive, HostString, HostList, HostDecimal128]
+@dataclass
+class HostMap:
+    keys: np.ndarray       # [n, max_elems]
+    values: np.ndarray     # [n, max_elems]
+    val_valid: np.ndarray  # bool[n, max_elems]
+    lens: np.ndarray       # int32[n]
+    validity: np.ndarray   # bool[n]
+
+
+@dataclass
+class HostStruct:
+    children: list         # list[HostColumn]
+    validity: np.ndarray   # bool[n]
+
+
+HostColumn = Union[HostPrimitive, HostString, HostList, HostDecimal128,
+                   HostMap, HostStruct]
+
+
+def _host_col_nbytes(c: HostColumn) -> int:
+    if isinstance(c, HostString):
+        return c.chars.nbytes + c.lens.nbytes + c.validity.nbytes
+    if isinstance(c, HostList):
+        return (c.values.nbytes + c.elem_valid.nbytes
+                + c.lens.nbytes + c.validity.nbytes)
+    if isinstance(c, HostDecimal128):
+        return c.hi.nbytes + c.lo.nbytes + c.validity.nbytes
+    if isinstance(c, HostMap):
+        return (c.keys.nbytes + c.values.nbytes + c.val_valid.nbytes
+                + c.lens.nbytes + c.validity.nbytes)
+    if isinstance(c, HostStruct):
+        return sum(_host_col_nbytes(ch) for ch in c.children) \
+            + c.validity.nbytes
+    return c.data.nbytes + c.validity.nbytes
 
 
 @dataclass
@@ -100,36 +133,30 @@ class HostBatch:
 
     @property
     def nbytes(self) -> int:
-        total = 0
-        for c in self.columns:
-            if isinstance(c, HostString):
-                total += c.chars.nbytes + c.lens.nbytes + c.validity.nbytes
-            elif isinstance(c, HostList):
-                total += (c.values.nbytes + c.elem_valid.nbytes
-                          + c.lens.nbytes + c.validity.nbytes)
-            elif isinstance(c, HostDecimal128):
-                total += c.hi.nbytes + c.lo.nbytes + c.validity.nbytes
-            else:
-                total += c.data.nbytes + c.validity.nbytes
-        return total
+        return sum(_host_col_nbytes(c) for c in self.columns)
+
+
+def _slice_host_col(c: HostColumn, lo: int, hi: int) -> HostColumn:
+    if isinstance(c, HostString):
+        return HostString(c.chars[lo:hi], c.lens[lo:hi], c.validity[lo:hi])
+    if isinstance(c, HostList):
+        return HostList(c.values[lo:hi], c.elem_valid[lo:hi],
+                        c.lens[lo:hi], c.validity[lo:hi])
+    if isinstance(c, HostDecimal128):
+        return HostDecimal128(c.hi[lo:hi], c.lo[lo:hi], c.validity[lo:hi])
+    if isinstance(c, HostMap):
+        return HostMap(c.keys[lo:hi], c.values[lo:hi], c.val_valid[lo:hi],
+                       c.lens[lo:hi], c.validity[lo:hi])
+    if isinstance(c, HostStruct):
+        return HostStruct([_slice_host_col(ch, lo, hi) for ch in c.children],
+                          c.validity[lo:hi])
+    return HostPrimitive(c.data[lo:hi], c.validity[lo:hi])
 
 
 def slice_host_batch(host: HostBatch, lo: int, hi: int) -> HostBatch:
     """Row-range view [lo, hi) over every column."""
-    cols: list[HostColumn] = []
-    for c in host.columns:
-        if isinstance(c, HostString):
-            cols.append(HostString(c.chars[lo:hi], c.lens[lo:hi],
-                                   c.validity[lo:hi]))
-        elif isinstance(c, HostList):
-            cols.append(HostList(c.values[lo:hi], c.elem_valid[lo:hi],
-                                 c.lens[lo:hi], c.validity[lo:hi]))
-        elif isinstance(c, HostDecimal128):
-            cols.append(HostDecimal128(c.hi[lo:hi], c.lo[lo:hi],
-                                       c.validity[lo:hi]))
-        else:
-            cols.append(HostPrimitive(c.data[lo:hi], c.validity[lo:hi]))
-    return HostBatch(cols, hi - lo)
+    return HostBatch([_slice_host_col(c, lo, hi) for c in host.columns],
+                     hi - lo)
 
 
 def fetch_leaves(leaves: list) -> list[np.ndarray]:
@@ -144,32 +171,43 @@ def fetch_leaves(leaves: list) -> list[np.ndarray]:
     return list(jax.device_get(list(leaves)))
 
 
+def host_col_from_device(c, it) -> HostColumn:
+    """Rebuild one host column from a device column TEMPLATE plus an
+    iterator over its fetched numpy leaves (depth-first dataclass field
+    order — the jax pytree flattening order of the registered column
+    dataclasses)."""
+    from auron_tpu.columnar.batch import MapColumn, StructColumn
+    from auron_tpu.columnar.decimal128 import Decimal128Column
+    if isinstance(c, StringColumn):
+        return HostString(next(it), next(it), next(it))
+    if isinstance(c, ListColumn):
+        return HostList(next(it), next(it), next(it), next(it))
+    if isinstance(c, Decimal128Column):
+        return HostDecimal128(next(it), next(it), next(it))
+    if isinstance(c, MapColumn):
+        return HostMap(next(it), next(it), next(it), next(it), next(it))
+    if isinstance(c, StructColumn):
+        kids = [host_col_from_device(ch, it) for ch in c.children]
+        return HostStruct(kids, next(it))
+    return HostPrimitive(next(it), next(it))
+
+
 def fetch_batch_numpy(batch: DeviceBatch) -> tuple[list[list[np.ndarray]], int]:
     """All column arrays of a batch (full capacity) + the row count, in a
-    single device→host transfer. Returns (per-column array lists, n)."""
-    leaves: list = []
-    counts: list[int] = []
-    from auron_tpu.columnar.decimal128 import Decimal128Column
-    for c in batch.columns:
-        if isinstance(c, StringColumn):
-            arrs = [c.chars, c.lens, c.validity]
-        elif isinstance(c, ListColumn):
-            arrs = [c.values, c.elem_valid, c.lens, c.validity]
-        elif isinstance(c, Decimal128Column):
-            arrs = [c.hi, c.lo, c.validity]
-        else:
-            arrs = [c.data, c.validity]
-        counts.append(len(arrs))
-        leaves.extend(arrs)
+    single device→host transfer. Returns (per-column leaf lists in pytree
+    order — see host_col_from_device — and n)."""
+    import jax
+    per_col = [jax.tree_util.tree_leaves(c) for c in batch.columns]
+    leaves = [a for arrs in per_col for a in arrs]
     import jax.numpy as jnp
     leaves.append(jnp.asarray(batch.num_rows, jnp.int32).reshape(1))
     fetched = fetch_leaves(leaves)
     n = int(fetched[-1][0])
     cols = []
     pos = 0
-    for k in counts:
-        cols.append(fetched[pos:pos + k])
-        pos += k
+    for arrs in per_col:
+        cols.append(fetched[pos:pos + len(arrs)])
+        pos += len(arrs)
     return cols, n
 
 
@@ -179,48 +217,60 @@ def batch_to_host(batch: DeviceBatch,
     the whole batch (fetch_leaves). When the caller knows ``num_rows``
     (every spill path does), only the live row prefix is transferred —
     spills run exactly when memory is tight, so shipping capacity padding
-    there would be self-defeating."""
+    there would be self-defeating. Every column leaf is row-major with
+    capacity on axis 0, so the prefix slice is uniform."""
+    import jax
     if num_rows is not None:
         n = num_rows
-        leaves: list = []
-        counts: list[int] = []
-        from auron_tpu.columnar.decimal128 import Decimal128Column
-        for c in batch.columns:
-            if isinstance(c, StringColumn):
-                arrs = [c.chars[:n], c.lens[:n], c.validity[:n]]
-            elif isinstance(c, ListColumn):
-                arrs = [c.values[:n], c.elem_valid[:n], c.lens[:n],
-                        c.validity[:n]]
-            elif isinstance(c, Decimal128Column):
-                arrs = [c.hi[:n], c.lo[:n], c.validity[:n]]
-            else:
-                arrs = [c.data[:n], c.validity[:n]]
-            counts.append(len(arrs))
-            leaves.extend(arrs)
-        flat = fetch_leaves(leaves)
+        per_col = [[a[:n] for a in jax.tree_util.tree_leaves(c)]
+                   for c in batch.columns]
+        flat = fetch_leaves([a for arrs in per_col for a in arrs])
         fetched = []
         pos = 0
-        for k in counts:
-            fetched.append(flat[pos:pos + k])
-            pos += k
+        for arrs in per_col:
+            fetched.append(flat[pos:pos + len(arrs)])
+            pos += len(arrs)
     else:
         fetched, n = fetch_batch_numpy(batch)
         fetched = [[a[:n] for a in arrs] for arrs in fetched]
-    from auron_tpu.columnar.decimal128 import Decimal128Column
     cols: list[HostColumn] = []
     for c, arrs in zip(batch.columns, fetched):
-        if isinstance(c, StringColumn):
-            cols.append(HostString(*[np.ascontiguousarray(a)
-                                     for a in arrs]))
-        elif isinstance(c, ListColumn):
-            cols.append(HostList(*[np.ascontiguousarray(a) for a in arrs]))
-        elif isinstance(c, Decimal128Column):
-            cols.append(HostDecimal128(*[np.ascontiguousarray(a)
-                                         for a in arrs]))
-        else:
-            cols.append(HostPrimitive(*[np.ascontiguousarray(a)
-                                        for a in arrs]))
+        cols.append(host_col_from_device(
+            c, iter([np.ascontiguousarray(a) for a in arrs])))
     return HostBatch(cols, n)
+
+
+def _host_col_to_device(c: HostColumn, pad: int):
+    import jax.numpy as jnp
+    from auron_tpu.columnar.batch import MapColumn, StructColumn
+
+    def p1(a):
+        return np.pad(a, (0, pad)) if pad else a
+
+    def p2(a):
+        return np.pad(a, ((0, pad), (0, 0))) if pad else a
+
+    if isinstance(c, HostMap):
+        return MapColumn(jnp.asarray(p2(c.keys)), jnp.asarray(p2(c.values)),
+                         jnp.asarray(p2(c.val_valid)),
+                         jnp.asarray(p1(c.lens)), jnp.asarray(p1(c.validity)))
+    if isinstance(c, HostStruct):
+        return StructColumn(tuple(_host_col_to_device(ch, pad)
+                                  for ch in c.children),
+                            jnp.asarray(p1(c.validity)))
+    if isinstance(c, HostString):
+        return StringColumn(jnp.asarray(p2(c.chars)), jnp.asarray(p1(c.lens)),
+                            jnp.asarray(p1(c.validity)))
+    if isinstance(c, HostList):
+        return ListColumn(jnp.asarray(p2(c.values)),
+                          jnp.asarray(p2(c.elem_valid)),
+                          jnp.asarray(p1(c.lens)), jnp.asarray(p1(c.validity)))
+    if isinstance(c, HostDecimal128):
+        from auron_tpu.columnar.decimal128 import Decimal128Column
+        return Decimal128Column(jnp.asarray(p1(c.hi)), jnp.asarray(p1(c.lo)),
+                                jnp.asarray(p1(c.validity)))
+    return PrimitiveColumn(jnp.asarray(p1(c.data)),
+                           jnp.asarray(p1(c.validity)))
 
 
 def host_to_batch(host: HostBatch, capacity: Optional[int] = None) -> DeviceBatch:
@@ -232,7 +282,9 @@ def host_to_batch(host: HostBatch, capacity: Optional[int] = None) -> DeviceBatc
     pad = cap - n
     cols = []
     for c in host.columns:
-        if isinstance(c, HostString):
+        if isinstance(c, (HostMap, HostStruct)):
+            cols.append(_host_col_to_device(c, pad))
+        elif isinstance(c, HostString):
             chars = np.pad(c.chars, ((0, pad), (0, 0))) if pad else c.chars
             lens = np.pad(c.lens, (0, pad)) if pad else c.lens
             val = np.pad(c.validity, (0, pad)) if pad else c.validity
@@ -274,6 +326,50 @@ def _get_buf(src: io.BytesIO, dtype, shape) -> np.ndarray:
     return np.frombuffer(src.read(ln), dtype=dtype).reshape(shape).copy()
 
 
+def _write_host_col(body: io.BytesIO, c: HostColumn) -> None:
+    if isinstance(c, HostString):
+        body.write(struct.pack("<BH", 1, c.chars.shape[1]))
+        _put_buf(body, c.chars)
+        _put_buf(body, c.lens.astype(np.int32))
+        _put_buf(body, c.validity.astype(np.bool_))
+    elif isinstance(c, HostList):
+        tag = c.values.dtype.str.encode()
+        body.write(struct.pack("<BHB", 2, c.values.shape[1], len(tag)))
+        body.write(tag)
+        _put_buf(body, c.values)
+        _put_buf(body, c.elem_valid.astype(np.bool_))
+        _put_buf(body, c.lens.astype(np.int32))
+        _put_buf(body, c.validity.astype(np.bool_))
+    elif isinstance(c, HostDecimal128):
+        body.write(struct.pack("<B", 3))
+        _put_buf(body, c.hi.astype(np.int64))
+        _put_buf(body, c.lo.astype(np.int64))
+        _put_buf(body, c.validity.astype(np.bool_))
+    elif isinstance(c, HostMap):
+        ktag = c.keys.dtype.str.encode()
+        vtag = c.values.dtype.str.encode()
+        body.write(struct.pack("<BHBB", 4, c.keys.shape[1],
+                               len(ktag), len(vtag)))
+        body.write(ktag)
+        body.write(vtag)
+        _put_buf(body, c.keys)
+        _put_buf(body, c.values)
+        _put_buf(body, c.val_valid.astype(np.bool_))
+        _put_buf(body, c.lens.astype(np.int32))
+        _put_buf(body, c.validity.astype(np.bool_))
+    elif isinstance(c, HostStruct):
+        body.write(struct.pack("<BB", 5, len(c.children)))
+        for ch in c.children:
+            _write_host_col(body, ch)
+        _put_buf(body, c.validity.astype(np.bool_))
+    else:
+        tag = c.data.dtype.str.encode()
+        body.write(struct.pack("<BB", 0, len(tag)))
+        body.write(tag)
+        _put_buf(body, c.data)
+        _put_buf(body, c.validity.astype(np.bool_))
+
+
 def serialize_host_batch(host: HostBatch,
                          extras: Optional[dict[str, np.ndarray]] = None,
                          codec: str = "zstd",
@@ -283,30 +379,7 @@ def serialize_host_batch(host: HostBatch,
     body.write(struct.pack("<IHH", host.num_rows, len(host.columns),
                            len(extras)))
     for c in host.columns:
-        if isinstance(c, HostString):
-            body.write(struct.pack("<BH", 1, c.chars.shape[1]))
-            _put_buf(body, c.chars)
-            _put_buf(body, c.lens.astype(np.int32))
-            _put_buf(body, c.validity.astype(np.bool_))
-        elif isinstance(c, HostList):
-            tag = c.values.dtype.str.encode()
-            body.write(struct.pack("<BHB", 2, c.values.shape[1], len(tag)))
-            body.write(tag)
-            _put_buf(body, c.values)
-            _put_buf(body, c.elem_valid.astype(np.bool_))
-            _put_buf(body, c.lens.astype(np.int32))
-            _put_buf(body, c.validity.astype(np.bool_))
-        elif isinstance(c, HostDecimal128):
-            body.write(struct.pack("<B", 3))
-            _put_buf(body, c.hi.astype(np.int64))
-            _put_buf(body, c.lo.astype(np.int64))
-            _put_buf(body, c.validity.astype(np.bool_))
-        else:
-            tag = c.data.dtype.str.encode()
-            body.write(struct.pack("<BB", 0, len(tag)))
-            body.write(tag)
-            _put_buf(body, c.data)
-            _put_buf(body, c.validity.astype(np.bool_))
+        _write_host_col(body, c)
     for name, arr in extras.items():
         nb = name.encode()
         assert arr.ndim == 2 and arr.dtype == np.uint64, name
@@ -323,6 +396,49 @@ def serialize_host_batch(host: HostBatch,
     return MAGIC + struct.pack("<BI", code, len(payload)) + payload
 
 
+def _read_host_col(src: io.BytesIO, num_rows: int) -> HostColumn:
+    kind = struct.unpack("<B", src.read(1))[0]
+    if kind == 1:
+        (width,) = struct.unpack("<H", src.read(2))
+        chars = _get_buf(src, np.uint8, (num_rows, width))
+        lens = _get_buf(src, np.int32, (num_rows,))
+        val = _get_buf(src, np.bool_, (num_rows,))
+        return HostString(chars, lens, val)
+    if kind == 2:
+        m, tag_len = struct.unpack("<HB", src.read(3))
+        dt = np.dtype(src.read(tag_len).decode())
+        values = _get_buf(src, dt, (num_rows, m))
+        ev = _get_buf(src, np.bool_, (num_rows, m))
+        lens = _get_buf(src, np.int32, (num_rows,))
+        val = _get_buf(src, np.bool_, (num_rows,))
+        return HostList(values, ev, lens, val)
+    if kind == 3:
+        hi = _get_buf(src, np.int64, (num_rows,))
+        lo = _get_buf(src, np.int64, (num_rows,))
+        val = _get_buf(src, np.bool_, (num_rows,))
+        return HostDecimal128(hi, lo, val)
+    if kind == 4:
+        m, ktag_len, vtag_len = struct.unpack("<HBB", src.read(4))
+        kdt = np.dtype(src.read(ktag_len).decode())
+        vdt = np.dtype(src.read(vtag_len).decode())
+        keys = _get_buf(src, kdt, (num_rows, m))
+        values = _get_buf(src, vdt, (num_rows, m))
+        vv = _get_buf(src, np.bool_, (num_rows, m))
+        lens = _get_buf(src, np.int32, (num_rows,))
+        val = _get_buf(src, np.bool_, (num_rows,))
+        return HostMap(keys, values, vv, lens, val)
+    if kind == 5:
+        (n_children,) = struct.unpack("<B", src.read(1))
+        kids = [_read_host_col(src, num_rows) for _ in range(n_children)]
+        val = _get_buf(src, np.bool_, (num_rows,))
+        return HostStruct(kids, val)
+    (tag_len,) = struct.unpack("<B", src.read(1))
+    dt = np.dtype(src.read(tag_len).decode())
+    data_arr = _get_buf(src, dt, (num_rows,))
+    val = _get_buf(src, np.bool_, (num_rows,))
+    return HostPrimitive(data_arr, val)
+
+
 def deserialize_host_batch(data: bytes) -> tuple[HostBatch, dict[str, np.ndarray]]:
     if data[:4] != MAGIC:
         raise ValueError("bad batch frame magic")
@@ -331,34 +447,7 @@ def deserialize_host_batch(data: bytes) -> tuple[HostBatch, dict[str, np.ndarray
     raw = _decompressor().decompress(payload) if code == CODEC_ZSTD else payload
     src = io.BytesIO(raw)
     num_rows, num_cols, num_extras = struct.unpack("<IHH", src.read(8))
-    cols: list[HostColumn] = []
-    for _ in range(num_cols):
-        kind = struct.unpack("<B", src.read(1))[0]
-        if kind == 1:
-            (width,) = struct.unpack("<H", src.read(2))
-            chars = _get_buf(src, np.uint8, (num_rows, width))
-            lens = _get_buf(src, np.int32, (num_rows,))
-            val = _get_buf(src, np.bool_, (num_rows,))
-            cols.append(HostString(chars, lens, val))
-        elif kind == 2:
-            m, tag_len = struct.unpack("<HB", src.read(3))
-            dt = np.dtype(src.read(tag_len).decode())
-            values = _get_buf(src, dt, (num_rows, m))
-            ev = _get_buf(src, np.bool_, (num_rows, m))
-            lens = _get_buf(src, np.int32, (num_rows,))
-            val = _get_buf(src, np.bool_, (num_rows,))
-            cols.append(HostList(values, ev, lens, val))
-        elif kind == 3:
-            hi = _get_buf(src, np.int64, (num_rows,))
-            lo = _get_buf(src, np.int64, (num_rows,))
-            val = _get_buf(src, np.bool_, (num_rows,))
-            cols.append(HostDecimal128(hi, lo, val))
-        else:
-            (tag_len,) = struct.unpack("<B", src.read(1))
-            dt = np.dtype(src.read(tag_len).decode())
-            data_arr = _get_buf(src, dt, (num_rows,))
-            val = _get_buf(src, np.bool_, (num_rows,))
-            cols.append(HostPrimitive(data_arr, val))
+    cols = [_read_host_col(src, num_rows) for _ in range(num_cols)]
     extras: dict[str, np.ndarray] = {}
     for _ in range(num_extras):
         name_len, rows, words = struct.unpack("<BIH", src.read(7))

@@ -83,6 +83,22 @@ def null_column_for_field(field, cap: int):
         return Decimal128Column(jnp.zeros(cap, jnp.int64),
                                 jnp.zeros(cap, jnp.int64),
                                 jnp.zeros(cap, bool))
+    if field.dtype == DataType.LIST:
+        from auron_tpu.columnar.batch import ListColumn
+        return ListColumn(jnp.zeros((cap, 1), _JNP[field.elem]),
+                          jnp.zeros((cap, 1), bool),
+                          jnp.zeros(cap, jnp.int32), jnp.zeros(cap, bool))
+    if field.dtype == DataType.MAP:
+        from auron_tpu.columnar.batch import MapColumn
+        return MapColumn(jnp.zeros((cap, 1), _JNP[field.key]),
+                         jnp.zeros((cap, 1), _JNP[field.elem]),
+                         jnp.zeros((cap, 1), bool),
+                         jnp.zeros(cap, jnp.int32), jnp.zeros(cap, bool))
+    if field.dtype == DataType.STRUCT:
+        from auron_tpu.columnar.batch import StructColumn
+        return StructColumn(
+            tuple(null_column_for_field(cf, cap) for cf in field.children),
+            jnp.zeros(cap, bool))
     return PrimitiveColumn(jnp.zeros(cap, _JNP[field.dtype]),
                            jnp.zeros(cap, bool))
 
@@ -261,6 +277,16 @@ def evaluate(expr: ir.Expr, batch: DeviceBatch, schema: Schema,
                             & v.col.elem_valid[:, idx]),
             elem_dt)
 
+    if isinstance(expr, ir.GetStructField):
+        from auron_tpu.columnar.batch import StructColumn
+        v = evaluate(expr.child, batch, schema, ctx)
+        assert isinstance(v.col, StructColumn), "GetStructField needs struct"
+        child = v.col.children[expr.ordinal]
+        cf = infer_field(expr.child, schema).children[expr.ordinal]
+        return TypedValue(
+            child.with_validity(child.validity & v.validity),
+            cf.dtype, cf.precision, cf.scale)
+
     if isinstance(expr, ir.HostUDF):
         return _eval_host_udf(expr, batch, schema, ctx)
 
@@ -326,7 +352,37 @@ def infer_dtype(expr: ir.Expr, schema: Schema) -> tuple[DataType, int, int]:
             from auron_tpu.exprs.fn_arrays import elem_dtype_of
             return elem_dtype_of(expr.child, schema), 0, 0
         raise NotImplementedError("GetIndexedField on non-column list")
+    if isinstance(expr, ir.GetStructField):
+        cf = infer_field(expr.child, schema).children[expr.ordinal]
+        return cf.dtype, cf.precision, cf.scale
     raise NotImplementedError(f"infer_dtype for {type(expr).__name__}")
+
+
+def infer_field(expr: ir.Expr, schema: Schema, name: str = "c") -> Field:
+    """Nested-aware result field of an expression — like infer_dtype but
+    keeping list/map element types and struct children (the 3-tuple
+    (dtype, p, s) cannot describe nested results)."""
+    if isinstance(expr, ir.ColumnRef):
+        return schema[expr.index].with_name(name)
+    if isinstance(expr, ir.ScalarFunction):
+        from auron_tpu.exprs.functions import function_result_field
+        f = function_result_field(expr, schema)
+        if f is not None:
+            return f.with_name(name)
+    if isinstance(expr, ir.GetStructField):
+        return infer_field(expr.child, schema).children[expr.ordinal] \
+            .with_name(name)
+    if isinstance(expr, ir.CaseWhen) and expr.otherwise is not None:
+        f = infer_field(expr.otherwise, schema)
+        if f.dtype in (DataType.MAP, DataType.STRUCT, DataType.LIST):
+            return f.with_name(name)
+    dt, p, s = infer_dtype(expr, schema)
+    elem = None
+    if dt == DataType.LIST:
+        if isinstance(expr, ir.ScalarFunction):
+            from auron_tpu.exprs.fn_arrays import elem_dtype_of
+            elem = elem_dtype_of(expr, schema)
+    return Field(name, dt, True, p, s, elem=elem)
 
 
 # ---------------------------------------------------------------------------

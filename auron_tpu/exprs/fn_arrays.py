@@ -3,11 +3,11 @@
 The reference's spark_map.rs (1,516 LoC) builds Arrow List/Map arrays row
 by row. Here arrays are the engine's padded ListColumn ([cap, max_elems]
 matrix + lens), so constructors are one stack and accessors are one
-gather. Maps have no columnar materialization yet (the batch layer has no
-MapColumn); a map built inside a projection lives as an eval-internal
-``MapValue`` (parallel key/value ListColumns) that the map accessors
-consume in the same expression tree — the common `map(...)[k]` /
-element_at pattern. Materializing a map into an output batch raises.
+gather; maps are the batch layer's MapColumn (parallel key/value matrices
+sharing a length column), fully batch-materializable — they flow through
+scans, projections, shuffles, spill serde and the Arrow bridge like any
+other column. See the maps section below for the Spark-semantics notes
+(null keys, LAST_WINS dedup).
 """
 
 from __future__ import annotations
@@ -65,10 +65,13 @@ def _elem_result(expr, schema):
 
 def _element_at_result(expr, schema):
     a = expr.args[0]
-    if isinstance(a, ir.ScalarFunction) and a.name == "map" and len(a.args) > 1:
-        return infer_dtype(a.args[1], schema)
-    if isinstance(a, ir.ScalarFunction) and a.name == "map_from_arrays":
-        return elem_dtype_of(a.args[1], schema), 0, 0
+    dt, _p, _s = infer_dtype(a, schema)
+    if dt == DataType.MAP:
+        # _map_field resolves the value dtype for ANY map-valued
+        # expression (column ref, constructor, map_concat, ...)
+        mf = _map_field(a, schema)
+        if mf.elem is not None:
+            return mf.elem, 0, 0
     return _elem_dtype(expr, schema), 0, 0
 
 
@@ -103,10 +106,10 @@ def _array(args, expr, batch, schema, ctx):
 @register("size", DataType.INT32)
 @register("cardinality", DataType.INT32)
 def _size(args, expr, batch, schema, ctx):
+    from auron_tpu.columnar.batch import MapColumn
     v = args[0]
-    if isinstance(v.col, MapValue):
-        lens = v.col.keys.lens
-        valid = v.col.validity
+    if isinstance(v.col, MapColumn):
+        lens, valid = v.col.lens, v.validity
     else:
         assert isinstance(v.col, ListColumn), "size() needs an array/map"
         lens, valid = v.col.lens, v.col.validity
@@ -143,10 +146,12 @@ def _array_position(args, expr, batch, schema, ctx):
 
 
 @register("element_at", _element_at_result)
+@register("get_map_value", _element_at_result)
 def _element_at(args, expr, batch, schema, ctx):
+    from auron_tpu.columnar.batch import MapColumn
     v = args[0]
-    if isinstance(v.col, MapValue):
-        return _map_get(v, args[1])
+    if isinstance(v.col, MapColumn):
+        return _map_get(v, args[1], expr, schema)
     col: ListColumn = v.col
     idx = cast_value(args[1], DataType.INT32).data
     # 1-based; negative counts from the end; out of range → null
@@ -232,82 +237,218 @@ def _array_repeat(args, expr, batch, schema, ctx):
 
 
 # ---------------------------------------------------------------------------
-# maps (eval-internal composite)
+# maps — columnar MapColumn (batch-materializable)
 # ---------------------------------------------------------------------------
+#
+# reference: datafusion-ext-functions/src/spark_map.rs (map constructors /
+# accessors over Arrow MapArray) + get_map_value.rs. Here a map is the
+# engine's MapColumn: parallel [cap, max_elems] key/value matrices sharing
+# one length column (columnar/batch.py). Spark semantics notes:
+#   - map keys cannot be null: a row constructing one nulls instead of
+#     raising (jit kernels cannot throw data-dependent errors);
+#   - duplicate keys resolve LAST_WINS (Spark's legacy/LAST_WIN dedup
+#     policy; the default EXCEPTION policy cannot raise from a kernel).
 
-@dataclass(frozen=True)
-class MapValue:
-    """Parallel key/value lists; exists only inside expression evaluation
-    (consumed by element_at / map_keys / map_values / size before any
-    batch materialization)."""
-    keys: ListColumn
-    values: ListColumn
-    validity: object
+from auron_tpu.columnar.batch import MapColumn
+from auron_tpu.columnar.schema import Field
 
-    @property
-    def capacity(self):
-        return self.keys.capacity
+
+def _in_len(col):
+    return jnp.arange(col.max_elems)[None, :] < col.lens[:, None]
+
+
+def _map_field(expr, schema):
+    """Result Field of a map-valued expression (key/value dtypes)."""
+    from auron_tpu.exprs.eval import infer_field
+    if isinstance(expr, ir.ColumnRef):
+        return schema[expr.index]
+    assert isinstance(expr, ir.ScalarFunction), expr
+    if expr.name in ("map", "create_map"):
+        from functools import reduce
+        from auron_tpu.exprs.eval import common_type
+        k = reduce(common_type, [infer_dtype(e, schema)[0]
+                                 for e in expr.args[0::2]])
+        v = reduce(common_type, [infer_dtype(e, schema)[0]
+                                 for e in expr.args[1::2]])
+        return Field("m", DataType.MAP, True, key=k, elem=v)
+    if expr.name == "map_from_arrays":
+        return Field("m", DataType.MAP, True,
+                     key=elem_dtype_of(expr.args[0], schema),
+                     elem=elem_dtype_of(expr.args[1], schema))
+    if expr.name == "map_concat":
+        return _map_field(expr.args[0], schema)
+    return infer_field(expr, schema)
+
+
+def _map_result_field(expr, schema):
+    return _map_field(expr, schema)
 
 
 def _map_result(expr, schema):
-    return DataType.LIST, 0, 0   # only observable through accessors
+    return DataType.MAP, 0, 0
 
 
-@register("map", _map_result)
-@register("map_from_arrays", _map_result)
+def _dedupe_last_wins(keys, values, vev, lens):
+    """Drop entry i when a later in-range entry has the same key and
+    compact survivors left — Spark's LAST_WIN map-key dedup policy.
+    Maps are small, so the per-row M^2 compare stays tiny."""
+    M = keys.shape[1]
+    jj = jnp.arange(M)
+    in_rng = jj[None, :] < lens[:, None]
+    same = keys[:, :, None] == keys[:, None, :]
+    later = jj[None, None, :] > jj[None, :, None]
+    dup = jnp.any(same & later & in_rng[:, None, :], axis=2)
+    keep = in_rng & ~dup
+    order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
+    keys = jnp.take_along_axis(keys, order, axis=1)
+    values = jnp.take_along_axis(values, order, axis=1)
+    vev = jnp.take_along_axis(vev & keep, order, axis=1)
+    return keys, values, vev, jnp.sum(keep, axis=1).astype(jnp.int32)
+
+
+def _reject_unsupported_map_args(name, args, expr, schema):
+    if any(isinstance(a.col, StringColumn) for a in args):
+        raise NotImplementedError(
+            f"{name}() over STRING keys/values: no string map "
+            "materialization")
+    for a in expr.args:
+        dt, _p, _s = infer_dtype(a, schema)
+        if dt == DataType.DECIMAL:
+            # Field.key/elem are bare DataTypes: a decimal's (p, s) would
+            # be lost and the scaled int64 payload would leak out raw
+            raise NotImplementedError(
+                f"{name}() over DECIMAL keys/values: map element types "
+                "carry no precision/scale; cast to double first")
+
+
+
+@register("map", _map_result, result_field=_map_result_field)
+@register("create_map", _map_result, result_field=_map_result_field)
+@register("map_from_arrays", _map_result, result_field=_map_result_field)
 def _map(args, expr, batch, schema, ctx):
     if expr.name == "map_from_arrays":
         karr, varr = args
-        return TypedValue(MapValue(karr.col, varr.col,
-                                   karr.validity & varr.validity),
-                          DataType.LIST)
-    assert len(args) % 2 == 0, "map() needs key/value pairs"
-    if any(isinstance(a.col, StringColumn) for a in args):
-        raise NotImplementedError(
-            "map() over STRING keys/values: string lists have no columnar "
-            "materialization yet")
+        for a in expr.args:
+            if elem_dtype_of(a, schema) == DataType.DECIMAL:
+                raise NotImplementedError(
+                    "map_from_arrays over DECIMAL elements: map element "
+                    "types carry no precision/scale; cast to double first")
+        kcol, vcol = karr.col, varr.col
+        m = max(kcol.max_elems, vcol.max_elems)
+        from auron_tpu.columnar.batch import pad_list_elems
+        kcol = pad_list_elems(kcol, m)
+        vcol = pad_list_elems(vcol, m)
+        # Spark: null map keys are illegal and lengths must match; a jit
+        # kernel cannot raise, so offending rows null out
+        k_in = jnp.arange(m)[None, :] < kcol.lens[:, None]
+        ok = (karr.validity & varr.validity
+              & (kcol.lens == vcol.lens)
+              & ~jnp.any(k_in & ~kcol.elem_valid, axis=1))
+        kv, vv, vev, lens = _dedupe_last_wins(
+            kcol.values, vcol.values, vcol.elem_valid,
+            jnp.where(ok, kcol.lens, 0))
+        return TypedValue(MapColumn(kv, vv, vev, lens, ok), DataType.MAP)
+    assert len(args) % 2 == 0 and args, "map() needs key/value pairs"
+    _reject_unsupported_map_args("map", args, expr, schema)
+    from functools import reduce
+    from auron_tpu.exprs.eval import common_type
     keys = args[0::2]
     vals = args[1::2]
-    n = batch.capacity
+    # coerce to the declared common key/value types (like array())
+    kt = reduce(common_type, [a.dtype for a in keys])
+    vt = reduce(common_type, [a.dtype for a in vals])
+    keys = [cast_value(a, kt) if a.dtype != kt else a for a in keys]
+    vals = [cast_value(a, vt) if a.dtype != vt else a for a in vals]
     k = len(keys)
-
-    def mklist(items):
-        values = jnp.stack([x.data for x in items], axis=1)
-        ev = jnp.stack([x.validity for x in items], axis=1)
-        return ListColumn(values, ev, jnp.full(n, k, jnp.int32),
-                          jnp.ones(n, bool))
-
-    return TypedValue(MapValue(mklist(keys), mklist(vals),
-                               jnp.ones(n, bool)), DataType.LIST)
+    kv = jnp.stack([x.data for x in keys], axis=1)
+    vv = jnp.stack([x.data for x in vals], axis=1)
+    vev = jnp.stack([x.validity for x in vals], axis=1)
+    ok = ~jnp.any(jnp.stack([~x.validity for x in keys], axis=1), axis=1)
+    kv, vv, vev, lens = _dedupe_last_wins(
+        kv, vv, vev, jnp.where(ok, k, 0).astype(jnp.int32))
+    return TypedValue(MapColumn(kv, vv, vev, lens, ok), DataType.MAP)
 
 
-@register("map_keys", _list_result)
+def _map_keys_field(expr, schema):
+    mf = _map_field(expr.args[0], schema)
+    return Field("c", DataType.LIST, True, elem=mf.key)
+
+
+def _map_values_field(expr, schema):
+    mf = _map_field(expr.args[0], schema)
+    return Field("c", DataType.LIST, True, elem=mf.elem)
+
+
+@register("map_keys", _list_result, result_field=_map_keys_field)
 def _map_keys(args, expr, batch, schema, ctx):
-    m: MapValue = args[0].col
-    return TypedValue(m.keys.with_validity(args[0].validity), DataType.LIST)
+    m: MapColumn = args[0].col
+    return TypedValue(ListColumn(m.keys, _in_len(m), m.lens,
+                                 args[0].validity), DataType.LIST)
 
 
-@register("map_values", _list_result)
+@register("map_values", _list_result, result_field=_map_values_field)
 def _map_values(args, expr, batch, schema, ctx):
-    m: MapValue = args[0].col
-    return TypedValue(m.values.with_validity(args[0].validity), DataType.LIST)
+    m: MapColumn = args[0].col
+    return TypedValue(ListColumn(m.values, m.val_valid & _in_len(m),
+                                 m.lens, args[0].validity), DataType.LIST)
 
 
-def _map_get(v: TypedValue, key: TypedValue) -> TypedValue:
+@register("map_contains_key", DataType.BOOL)
+def _map_contains_key(args, expr, batch, schema, ctx):
+    v, key = args
+    m: MapColumn = v.col
+    hit = jnp.any((m.keys == key.data[:, None]) & _in_len(m), axis=1)
+    return TypedValue(PrimitiveColumn(hit, v.validity & key.validity),
+                      DataType.BOOL)
+
+
+@register("map_concat", _map_result, result_field=_map_result_field)
+def _map_concat(args, expr, batch, schema, ctx):
+    """Entry-concatenate maps, duplicate keys LAST_WINS (later argument,
+    later entry)."""
+    out = args[0]
+    for nxt in args[1:]:
+        a: MapColumn = out.col
+        b: MapColumn = nxt.col
+        cap = a.capacity
+        M = a.max_elems + b.max_elems
+        rows = jnp.arange(cap)[:, None]
+
+        def splice(xa, xb, fill=0):
+            buf = jnp.full((cap, M), fill, xa.dtype)
+            buf = buf.at[rows, jnp.arange(a.max_elems)[None, :]].set(
+                jnp.where(_in_len(a), xa, fill))
+            jb = jnp.arange(b.max_elems)[None, :]
+            tgt = jnp.clip(a.lens[:, None] + jb, 0, M - 1)
+            return buf.at[rows, tgt].set(
+                jnp.where(_in_len(b), xb, buf[rows, tgt]))
+
+        keys = splice(a.keys, b.keys)
+        values = splice(a.values, b.values)
+        vev = splice(a.val_valid, b.val_valid, fill=False)
+        ok = out.validity & nxt.validity
+        keys, values, vev, lens = _dedupe_last_wins(
+            keys, values, vev, jnp.where(ok, a.lens + b.lens, 0))
+        out = TypedValue(MapColumn(keys, values, vev, lens, ok),
+                         DataType.MAP)
+    return out
+
+
+def _map_get(v: TypedValue, key: TypedValue, expr, schema) -> TypedValue:
     """map[key]: last matching key wins (Spark map semantics)."""
     if isinstance(key.col, StringColumn):
         raise NotImplementedError("map lookup with STRING key")
-    m: MapValue = v.col
-    kcol, vcol = m.keys, m.values
-    in_map = jnp.arange(kcol.max_elems)[None, :] < kcol.lens[:, None]
-    eq = (kcol.values == key.data[:, None]) & kcol.elem_valid & in_map
-    # last match: flip, argmax, flip back
+    m: MapColumn = v.col
+    eq = (m.keys == key.data[:, None]) & _in_len(m)
     rev = eq[:, ::-1]
-    last = kcol.max_elems - 1 - jnp.argmax(rev, axis=1)
+    last = m.max_elems - 1 - jnp.argmax(rev, axis=1)
     hit = jnp.any(eq, axis=1)
-    li = jnp.clip(last, 0, vcol.max_elems - 1)
-    data = jnp.take_along_axis(vcol.values, li[:, None], axis=1)[:, 0]
-    ev = jnp.take_along_axis(vcol.elem_valid, li[:, None], axis=1)[:, 0]
-    return TypedValue(PrimitiveColumn(data, v.validity & hit & ev),
-                      DataType.INT64 if jnp.issubdtype(
-                          vcol.values.dtype, jnp.integer) else DataType.FLOAT64)
+    li = jnp.clip(last, 0, m.max_elems - 1)
+    data = jnp.take_along_axis(m.values, li[:, None], axis=1)[:, 0]
+    ev = jnp.take_along_axis(m.val_valid, li[:, None], axis=1)[:, 0]
+    mf = _map_field(expr.args[0], schema) if expr is not None else None
+    dt = mf.elem if mf is not None and mf.elem is not None else (
+        DataType.INT64 if jnp.issubdtype(m.values.dtype, jnp.integer)
+        else DataType.FLOAT64)
+    return TypedValue(PrimitiveColumn(
+        data, v.validity & key.validity & hit & ev), dt)

@@ -1,0 +1,106 @@
+"""Struct constructors/accessors.
+
+reference: datafusion-ext-exprs/src/named_struct.rs (NamedStructExpr
+builds a StructArray from child expressions) and get_indexed_field.rs
+(struct field access). Here a struct is the engine's StructColumn — the
+child columns themselves plus a row validity — so construction is free
+(tuple packing) and field access is a tuple index.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from auron_tpu.columnar.batch import StructColumn
+from auron_tpu.columnar.schema import DataType, Field
+from auron_tpu.exprs import ir
+from auron_tpu.exprs.eval import TypedValue, infer_field
+from auron_tpu.exprs.functions import register
+
+
+def _literal_name(e: ir.Expr, default: str) -> str:
+    if isinstance(e, ir.Literal) and isinstance(e.value, str):
+        return e.value
+    return default
+
+
+def _named_struct_field(expr, schema):
+    kids = []
+    for i in range(0, len(expr.args), 2):
+        nm = _literal_name(expr.args[i], f"col{i // 2}")
+        kids.append(infer_field(expr.args[i + 1], schema, name=nm))
+    return Field("c", DataType.STRUCT, True, children=tuple(kids))
+
+
+def _struct_field(expr, schema):
+    kids = []
+    for i, a in enumerate(expr.args):
+        # Spark naming: source column name for plain refs, else colN
+        name = schema[a.index].name if isinstance(a, ir.ColumnRef) \
+            else f"col{i + 1}"
+        kids.append(infer_field(a, schema, name=name))
+    return Field("c", DataType.STRUCT, True, children=tuple(kids))
+
+
+def _struct_result(expr, schema):
+    return DataType.STRUCT, 0, 0
+
+
+@register("named_struct", _struct_result, result_field=_named_struct_field)
+def _named_struct(args, expr, batch, schema, ctx):
+    """named_struct(name1, val1, name2, val2, ...) — names are string
+    literals consumed at plan time; only the value args contribute
+    columns (reference: named_struct.rs:eval)."""
+    assert len(args) % 2 == 0 and args, "named_struct needs name/value pairs"
+    kids = tuple(args[i].col for i in range(1, len(args), 2))
+    cap = batch.capacity
+    return TypedValue(StructColumn(kids, jnp.ones(cap, bool)),
+                      DataType.STRUCT)
+
+
+@register("struct", _struct_result, result_field=_struct_field)
+def _struct(args, expr, batch, schema, ctx):
+    kids = tuple(a.col for a in args)
+    cap = batch.capacity
+    return TypedValue(StructColumn(kids, jnp.ones(cap, bool)),
+                      DataType.STRUCT)
+
+
+def _get_struct_field_result(expr, schema):
+    f = _resolve_child(expr, schema)
+    return f.dtype, f.precision, f.scale
+
+
+def _get_struct_field_field(expr, schema):
+    return _resolve_child(expr, schema)
+
+
+def _resolve_child(expr, schema) -> Field:
+    sf = infer_field(expr.args[0], schema)
+    sel = expr.args[1]
+    if isinstance(sel, ir.Literal) and isinstance(sel.value, str):
+        for cf in sf.children:
+            if cf.name == sel.value:
+                return cf
+        raise KeyError(f"struct has no field {sel.value!r}")
+    idx = int(sel.value)
+    return sf.children[idx]
+
+
+@register("get_struct_field", _get_struct_field_result,
+          result_field=_get_struct_field_field)
+def _get_struct_field(args, expr, batch, schema, ctx):
+    """get_struct_field(struct, name_or_ordinal) — the functional form of
+    the GetStructField expression node."""
+    v = args[0]
+    assert isinstance(v.col, StructColumn), "get_struct_field needs struct"
+    sf = infer_field(expr.args[0], schema)
+    sel = expr.args[1]
+    if isinstance(sel, ir.Literal) and isinstance(sel.value, str):
+        idx = [cf.name for cf in sf.children].index(sel.value)
+    else:
+        idx = int(sel.value)
+    cf = sf.children[idx]
+    child = v.col.children[idx]
+    return TypedValue(child.with_validity(child.validity & v.validity),
+                      cf.dtype, cf.precision, cf.scale)

@@ -22,15 +22,27 @@ from auron_tpu.utils.shapes import bucket_string_width
 
 _REGISTRY = {}
 _RESULT_TYPE = {}
+#: name → callable(expr, schema) -> Field, for functions whose result is
+#: nested (map/struct/list) and cannot be described by a (dtype, p, s)
+_RESULT_FIELD = {}
 
 
-def register(name, result_type=None):
+def register(name, result_type=None, result_field=None):
     def deco(fn):
         _REGISTRY[name] = fn
         if result_type is not None:
             _RESULT_TYPE[name] = result_type
+        if result_field is not None:
+            _RESULT_FIELD[name] = result_field
         return fn
     return deco
+
+
+def function_result_field(expr: ir.ScalarFunction, schema: Schema):
+    """Full result Field for nested-returning functions; None when the
+    (dtype, p, s) 3-tuple from function_result_type is the whole story."""
+    rf = _RESULT_FIELD.get(expr.name)
+    return rf(expr, schema) if rf is not None else None
 
 
 def dispatch_function(expr: ir.ScalarFunction, batch, schema, ctx) -> TypedValue:
@@ -566,6 +578,7 @@ def _xxhash64(args, expr, batch, schema, ctx):
 # ---------------------------------------------------------------------------
 
 from auron_tpu.exprs import fn_arrays   # noqa: E402,F401
+from auron_tpu.exprs import fn_structs  # noqa: E402,F401
 from auron_tpu.exprs import fn_crypto   # noqa: E402,F401
 from auron_tpu.exprs import fn_dates    # noqa: E402,F401
 from auron_tpu.exprs import fn_json     # noqa: E402,F401

@@ -201,6 +201,18 @@ class GetIndexedField(Expr):
 
 
 @dataclass(frozen=True)
+class GetStructField(Expr):
+    """struct.field access by child ordinal (reference:
+    datafusion-ext-exprs/src/get_indexed_field.rs struct arm +
+    Spark GetStructField)."""
+    child: Expr
+    ordinal: int
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclass(frozen=True)
 class RowNum(Expr):
     """Monotonic row number within the partition stream (reference:
     datafusion-ext-exprs/src/row_num.rs)."""

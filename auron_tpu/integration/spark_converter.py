@@ -144,6 +144,9 @@ _SCALAR_FN = {"Upper": "upper", "Lower": "lower", "Length": "length",
               "ArrayMax": "array_max", "ArrayMin": "array_min",
               "CreateMap": "map", "MapFromArrays": "map_from_arrays",
               "MapKeys": "map_keys", "MapValues": "map_values",
+              "MapContainsKey": "map_contains_key",
+              "MapConcat": "map_concat", "GetMapValue": "get_map_value",
+              "CreateNamedStruct": "named_struct",
               "Round": "round", "BRound": "bround", "Pow": "pow",
               "Sqrt": "sqrt", "Exp": "exp", "Log": "log",
               "Floor": "floor", "Ceil": "ceil", "Greatest": "greatest",
@@ -257,6 +260,11 @@ class ExprConverter:
             return pb.ExprNode(scalar_function=pb.ScalarFunctionE(
                 name=fn,
                 args=[self.convert(c) for c in e.children]))
+        if cls == "GetStructField":
+            # Spark carries the child ordinal as a field, not an argument
+            return pb.ExprNode(get_struct_field=pb.GetStructFieldE(
+                child=self.convert(e.children[0]),
+                ordinal=int(e.fields.get("ordinal", 0))))
         raise NotImplementedError(f"unsupported Spark expression {cls}")
 
     def _literal(self, e: SparkNode) -> pb.ExprNode:

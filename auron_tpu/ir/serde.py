@@ -34,6 +34,8 @@ _DT_TO_P = {
     DataType.DECIMAL: pb.DT_DECIMAL,
     DataType.STRING: pb.DT_STRING,
     DataType.LIST: pb.DT_LIST,
+    DataType.MAP: pb.DT_MAP,
+    DataType.STRUCT: pb.DT_STRUCT,
 }
 _P_TO_DT = {v: k for k, v in _DT_TO_P.items()}
 
@@ -46,21 +48,32 @@ def parse_dtype(p: int) -> DataType:
     return _P_TO_DT[p]
 
 
+def field_to_proto(f: Field) -> pb.FieldP:
+    return pb.FieldP(
+        name=f.name, dtype=_DT_TO_P[f.dtype], nullable=f.nullable,
+        precision=f.precision, scale=f.scale,
+        elem=_DT_TO_P[f.elem] if f.elem is not None else 0,
+        key=_DT_TO_P[f.key] if f.key is not None else 0,
+        children=[field_to_proto(cf) for cf in f.children])
+
+
+def parse_field(f: pb.FieldP) -> Field:
+    dt = _P_TO_DT[f.dtype]
+    return Field(
+        f.name, dt, f.nullable, f.precision, f.scale,
+        elem=_P_TO_DT[f.elem] if dt in (DataType.LIST, DataType.MAP)
+        else None,
+        key=_P_TO_DT[f.key] if dt == DataType.MAP else None,
+        children=tuple(parse_field(cf) for cf in f.children)
+        if dt == DataType.STRUCT else ())
+
+
 def schema_to_proto(schema: Schema) -> pb.SchemaP:
-    return pb.SchemaP(fields=[
-        pb.FieldP(name=f.name, dtype=_DT_TO_P[f.dtype], nullable=f.nullable,
-                  precision=f.precision, scale=f.scale,
-                  elem=_DT_TO_P[f.elem] if f.elem is not None else 0)
-        for f in schema.fields
-    ])
+    return pb.SchemaP(fields=[field_to_proto(f) for f in schema.fields])
 
 
 def parse_schema(p: pb.SchemaP) -> Schema:
-    return Schema(tuple(
-        Field(f.name, _P_TO_DT[f.dtype], f.nullable, f.precision, f.scale,
-              elem=_P_TO_DT[f.elem] if f.dtype == pb.DT_LIST else None)
-        for f in p.fields
-    ))
+    return Schema(tuple(parse_field(f) for f in p.fields))
 
 
 # ---------------------------------------------------------------------------
@@ -163,6 +176,9 @@ def expr_to_proto(e: ir.Expr) -> pb.ExprNode:
     if isinstance(e, ir.GetIndexedField):
         return pb.ExprNode(get_indexed_field=pb.GetIndexedFieldE(
             child=expr_to_proto(e.child), ordinal=e.ordinal))
+    if isinstance(e, ir.GetStructField):
+        return pb.ExprNode(get_struct_field=pb.GetStructFieldE(
+            child=expr_to_proto(e.child), ordinal=e.ordinal))
     if isinstance(e, ir.BloomFilterMightContain):
         return pb.ExprNode(bloom_might_contain=pb.BloomMightContainE(
             value=expr_to_proto(e.value), serialized_filter=e.serialized))
@@ -241,6 +257,9 @@ def parse_expr(p: pb.ExprNode) -> ir.Expr:
     if kind == "get_indexed_field":
         return ir.GetIndexedField(parse_expr(p.get_indexed_field.child),
                                   p.get_indexed_field.ordinal)
+    if kind == "get_struct_field":
+        return ir.GetStructField(parse_expr(p.get_struct_field.child),
+                                 p.get_struct_field.ordinal)
     if kind == "bloom_might_contain":
         b = p.bloom_might_contain
         if not b.serialized_filter:

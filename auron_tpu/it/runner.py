@@ -61,17 +61,78 @@ def run_all(data_dir=None, scale: float = 1.0, names=None,
     return results
 
 
+def _defloat_decimals(tbl):
+    """Cast decimal columns to float64 so engine decimals (exact,
+    Spark-typed) and the Acero oracle's mixed decimal/float outputs
+    compare under the double tolerance. Money sums at TPC-DS scale stay
+    within float64's 2^53 exact-integer range."""
+    import pyarrow as pa
+    cols = []
+    for i, f in enumerate(tbl.schema):
+        c = tbl.column(i)
+        if pa.types.is_decimal(f.type):
+            c = c.cast(pa.float64())
+        cols.append(c)
+    return pa.table({f.name: c for f, c in zip(tbl.schema, cols)})
+
+
+def run_tpcds(data_dir=None, scale: float = 1.0, names=None,
+              verbose: bool = True) -> list[ComparisonResult]:
+    """The real-schema TPC-DS gate: 26 genuine TPC-DS query shapes over a
+    scale-1.0 = 1M-fact-row dataset, diffed against the pyarrow/Acero
+    oracle (reference gate: .github/workflows/tpcds-reusable.yml:70-83)."""
+    from auron_tpu.it.tpcds import generate, load_arrow
+    from auron_tpu.it.tpcds_queries import QUERIES as TQ
+    if data_dir is None:
+        data_dir = tempfile.mkdtemp(prefix="auron_tpcds_")
+    tables = generate(data_dir, scale=scale)
+    arrow = load_arrow(tables)
+    comparator = QueryResultComparator(double_rel_tol=1e-7,
+                                       double_abs_tol=1e-6)
+    results = []
+    for q in TQ:
+        if names and q.name not in names:
+            continue
+        session = _fresh_session()
+        t0 = time.perf_counter()
+        try:
+            got = q.run(session, tables)
+        except Exception:
+            import traceback
+            results.append(ComparisonResult(
+                q.name, False, 0, error=traceback.format_exc(limit=8)))
+            if verbose:
+                print(results[-1].report(), flush=True)
+            continue
+        elapsed = time.perf_counter() - t0
+        expected = q.oracle(arrow)
+        res = comparator.compare(q.name, _defloat_decimals(got),
+                                 _defloat_decimals(expected))
+        res.elapsed_s = round(elapsed, 3)
+        results.append(res)
+        if verbose:
+            print(res.report() + f" ({res.elapsed_s}s)", flush=True)
+    return results
+
+
 def main(argv=None) -> int:
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--suite", default="synth", choices=["synth", "tpcds"],
+                    help="synth: the 18 synthetic-star queries; tpcds: the "
+                         "26 real-schema TPC-DS queries vs the Acero oracle")
     ap.add_argument("--queries", default="",
                     help="comma-separated names (q01 or full name)")
     ap.add_argument("--data", default=None,
                     help="reuse/create dataset in this directory")
     args = ap.parse_args(argv)
     names = [n.strip() for n in args.queries.split(",") if n.strip()] or None
-    results = run_all(data_dir=args.data, scale=args.scale, names=names)
+    if args.suite == "tpcds":
+        results = run_tpcds(data_dir=args.data, scale=args.scale,
+                            names=names)
+    else:
+        results = run_all(data_dir=args.data, scale=args.scale, names=names)
     failed = [r for r in results if not r.ok]
     print(f"{len(results) - len(failed)}/{len(results)} queries passed")
     return 1 if failed else 0

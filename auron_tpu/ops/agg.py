@@ -295,9 +295,14 @@ def _neutral(kind: str, dtype):
 
 def _keys_equal_prev(sorted_keys, live):
     """eq[i] = keys[i] == keys[i-1] (null == null true; eq[0] = False)."""
+    from auron_tpu.columnar.batch import ListColumn, MapColumn, StructColumn
     from auron_tpu.columnar.decimal128 import Decimal128Column
     eq = jnp.ones_like(live)
     for col in sorted_keys:
+        if isinstance(col, (MapColumn, StructColumn, ListColumn)):
+            raise NotImplementedError(
+                f"GROUP BY on {type(col).__name__} keys is not supported "
+                "— group on the individual fields/elements instead")
         if isinstance(col, StringColumn):
             same_chars = jnp.all(col.chars[1:] == col.chars[:-1], axis=1)
             same = same_chars & (col.lens[1:] == col.lens[:-1])

@@ -284,8 +284,19 @@ def xxhash64_string(chars: jax.Array, lens: jax.Array, seed) -> jax.Array:
 # Column / batch level hashing (seed chaining + null skipping)
 # ---------------------------------------------------------------------------
 
+def _reject_nested(col) -> None:
+    from auron_tpu.columnar.batch import ListColumn, MapColumn, StructColumn
+    if isinstance(col, (MapColumn, StructColumn, ListColumn)):
+        raise NotImplementedError(
+            f"hash partitioning / hash join / hash agg on "
+            f"{type(col).__name__} keys is not supported — Spark itself "
+            "disallows map-typed keys; for struct/array keys, hash the "
+            "individual fields/elements instead")
+
+
 def _hash_column_murmur(col: Column, hashes: jax.Array) -> jax.Array:
     """One column's contribution to the running murmur3 hash (int32[n])."""
+    _reject_nested(col)
     from auron_tpu.columnar.decimal128 import Decimal128Column
     if isinstance(col, Decimal128Column):
         # limb-pair hashing: chain the low then high limb as two int64
@@ -320,6 +331,7 @@ def _hash_column_murmur(col: Column, hashes: jax.Array) -> jax.Array:
 
 
 def _hash_column_xxhash(col: Column, hashes: jax.Array) -> jax.Array:
+    _reject_nested(col)
     from auron_tpu.columnar.decimal128 import Decimal128Column
     if isinstance(col, Decimal128Column):
         # limb-pair hashing; see _hash_column_murmur for the Spark deviation

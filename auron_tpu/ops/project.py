@@ -18,16 +18,16 @@ import jax.numpy as jnp
 from auron_tpu.columnar.batch import DeviceBatch, compact
 from auron_tpu.columnar.schema import DataType, Field, Schema
 from auron_tpu.exprs import ir
-from auron_tpu.exprs.eval import EvalContext, evaluate, infer_dtype
+from auron_tpu.exprs.eval import (EvalContext, evaluate, infer_dtype,
+                                  infer_field)
 from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
 
 
 def project_schema(exprs: tuple, names: tuple[str, ...], in_schema: Schema) -> Schema:
-    fields = []
-    for e, n in zip(exprs, names):
-        dt, p, s = infer_dtype(e, in_schema)
-        fields.append(Field(n, dt, True, p, s))
-    return Schema(tuple(fields))
+    # infer_field keeps nested metadata (list elem / map key+value /
+    # struct children) that the (dtype, p, s) 3-tuple cannot carry
+    return Schema(tuple(infer_field(e, in_schema, name=n)
+                        for e, n in zip(exprs, names)))
 
 
 @lru_cache(maxsize=512)

@@ -69,7 +69,12 @@ class WindowFunctionSpec:
 
 def _col_neq_prev(col) -> jax.Array:
     """bool[cap]: row i differs from row i-1 (null-aware; row 0 => True)."""
+    from auron_tpu.columnar.batch import ListColumn, MapColumn, StructColumn
     from auron_tpu.columnar.decimal128 import Decimal128Column
+    if isinstance(col, (MapColumn, StructColumn, ListColumn)):
+        raise NotImplementedError(
+            f"window partition/order keys of {type(col).__name__} type "
+            "are not supported — key on the individual fields instead")
     if isinstance(col, StringColumn):
         same_chars = jnp.all(col.chars[1:] == col.chars[:-1], axis=1)
         same = same_chars & (col.lens[1:] == col.lens[:-1])

@@ -389,9 +389,11 @@ def test_map_functions():
         "q": pa.array([2, 1], pa.int64()),
     })
     m = ir.ScalarFunction("map", (C(0), C(1), C(2), C(3)))
-    # element_at: last matching key wins (row 2 has duplicate key 1)
+    # duplicate keys dedupe LAST_WINS (row 2 has key 1 twice): the later
+    # value survives and the cardinality drops to 1, matching Spark's
+    # LAST_WIN mapKeyDedupPolicy
     assert run_fn("element_at", rb, [m, C(4)]) == [20, 21]
-    assert run_fn("size", rb, [m]) == [2, 2]
+    assert run_fn("size", rb, [m]) == [2, 1]
     keys = ir.ScalarFunction("map_keys", (m,))
     assert run_fn("element_at", rb, [keys, lit(1)]) == [1, 1]
 

@@ -206,6 +206,50 @@ class TestVersionShims:
         assert out.cast.precision == 14 and out.cast.scale == 2
         assert out.cast.child.WhichOneof("expr") == "column"
 
+    def test_map_struct_expressions_convert(self):
+        """GetStructField (ordinal in fields, not args), CreateNamedStruct
+        and GetMapValue must convert to the engine's struct/map surface
+        (reference: named_struct.rs, get_map_value.rs)."""
+        from auron_tpu.integration.spark_converter import (Attr,
+                                                           ExprConverter)
+        from auron_tpu.integration.spark_plan import SparkNode
+        CAT = "org.apache.spark.sql.catalyst.expressions."
+        attr_node = SparkNode(
+            cls=CAT + "AttributeReference",
+            fields={"name": "st", "dataType": "struct<a:bigint,b:string>",
+                    "exprId": {"id": 3}}, children=[])
+        gsf = SparkNode(cls=CAT + "GetStructField",
+                        fields={"ordinal": 1, "name": "b"},
+                        children=[attr_node])
+        ec = ExprConverter([Attr("st", 3, "struct<a:bigint,b:string>"),
+                            Attr("m", 4, "map<bigint,bigint>"),
+                            Attr("k", 5, "bigint")])
+        out = ec.convert(gsf)
+        assert out.WhichOneof("expr") == "get_struct_field"
+        assert out.get_struct_field.ordinal == 1
+
+        m_attr = SparkNode(cls=CAT + "AttributeReference",
+                           fields={"name": "m",
+                                   "dataType": "map<bigint,bigint>",
+                                   "exprId": {"id": 4}}, children=[])
+        k_attr = SparkNode(cls=CAT + "AttributeReference",
+                           fields={"name": "k", "dataType": "bigint",
+                                   "exprId": {"id": 5}}, children=[])
+        gmv = SparkNode(cls=CAT + "GetMapValue", fields={},
+                        children=[m_attr, k_attr])
+        out = ec.convert(gmv)
+        assert out.WhichOneof("expr") == "scalar_function"
+        assert out.scalar_function.name == "get_map_value"
+
+        cns = SparkNode(
+            cls=CAT + "CreateNamedStruct", fields={},
+            children=[SparkNode(cls=CAT + "Literal",
+                                fields={"value": "a", "dataType": "string"},
+                                children=[]),
+                      k_attr])
+        out = ec.convert(cns)
+        assert out.scalar_function.name == "named_struct"
+
     def test_aqe_reader_both_spellings_transparent(self):
         from auron_tpu.integration.shims import SparkShims
         for v in ("3.0.3", "3.5.1"):
