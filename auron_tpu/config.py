@@ -102,6 +102,19 @@ SPILL_CODEC_LEVEL = _opt(
 # exchange-spill, and dense-kernel-selection knobs land together with
 # their features.
 
+# profiling
+PROFILE = _opt(
+    "auron.profile", bool, False,
+    "Wrap task execution in a jax.profiler trace and attach per-operator "
+    "device-time attribution to the finalize metrics (the role of the "
+    "reference's pprof flamegraph/heap HTTP endpoints, "
+    "auron/src/http/mod.rs:25-108).")
+PROFILE_DIR = _opt(
+    "auron.profile.dir", str, "",
+    "Directory for profiler trace output; empty = a per-task directory "
+    "under the system temp dir. The trace is viewable with "
+    "tensorboard/xprof.")
+
 # metrics / sinks
 METRICS_DEVICE_SYNC = _opt(
     "auron.metrics.device_sync", bool, True,

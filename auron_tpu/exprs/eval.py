@@ -1070,32 +1070,52 @@ def _cast_from_string(v: TypedValue, dtype: DataType, precision: int,
     elif dtype.is_integer or dtype == DataType.DATE32:
         if dtype == DataType.DATE32:
             import datetime
+            import re
+            # Spark accepts non-zero-padded fields: yyyy-[m]m-[d]d
+            # (DateTimeUtils.stringToDate); fromisoformat would reject
+            # "2020-1-2"
+            date_re = re.compile(r"^(\d{1,4})-(\d{1,2})-(\d{1,2})$")
             def parse(s):
+                m = date_re.match(s.strip())
+                if not m:
+                    return None
                 try:
-                    return (datetime.date.fromisoformat(s.strip())
-                            - datetime.date(1970, 1, 1)).days
+                    d = datetime.date(int(m.group(1)), int(m.group(2)),
+                                      int(m.group(3)))
                 except ValueError:
                     return None
+                return (d - datetime.date(1970, 1, 1)).days
             np_t = np.int32
         else:
             bits = _INT_BITS[dtype]
             lo, hi = -(2 ** (bits - 1)), 2 ** (bits - 1) - 1
             def parse(s):
-                try:
-                    f = float(s.strip())
-                    r = int(f) if f == int(f) or "." in s else int(s.strip())
-                except (ValueError, OverflowError):
+                # Spark UTF8String.toInt/toLong: trimmed, optional sign,
+                # ASCII DIGITS ONLY — '4.5', '1e2' are NULL (casting via
+                # float first is the documented workaround); exact int
+                # parsing keeps Long.MaxValue-class strings lossless
+                s = s.strip()
+                if not s:
                     return None
-                # out-of-range → null (Spark UTF8String.toInt failure)
+                body = s[1:] if s[0] in "+-" else s
+                if not (body.isascii() and body.isdigit()):
+                    return None
+                r = int(s)
                 return r if lo <= r <= hi else None
             np_t = _JNP[dtype]
     elif dtype == DataType.DECIMAL:
         from decimal import Decimal, InvalidOperation
         def parse(s):
             try:
-                return int(Decimal(s.strip()).scaleb(scale).to_integral_value())
+                r = int(Decimal(s.strip()).scaleb(scale)
+                        .to_integral_value())
             except (InvalidOperation, ValueError):
                 return None
+            # beyond the declared precision → null (Spark
+            # Decimal.changePrecision failure)
+            if precision and abs(r) >= 10 ** precision:
+                return None
+            return r
         np_t = np.int64
     elif dtype == DataType.TIMESTAMP_US:
         import datetime

@@ -473,7 +473,12 @@ def _nullif(args, expr, batch, schema, ctx):
                       a.dtype, a.precision, a.scale)
 
 
-@register("if")
+def _if_result(expr, schema):
+    # the result type is the THEN branch's (args[1]), not the condition's
+    return infer_dtype(expr.args[1], schema)
+
+
+@register("if", _if_result)
 def _if(args, expr, batch, schema, ctx):
     c, t, f = args
     take = c.data.astype(bool) & c.validity

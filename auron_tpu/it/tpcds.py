@@ -306,7 +306,19 @@ def generate(root: str, scale: float = 1.0, seed: int = 7) -> dict:
     # seasonal date skew: Nov/Dec holidays sell more (like dsdgen)
     date_w = 1.0 + 0.8 * np.isin(months, (11, 12))
     date_p = date_w / date_w.sum()
-    sold_date = rng.choice(N_DATES, n_ss, p=date_p).astype(np.int64)
+    # a TICKET is one basket: every line of a ticket shares customer,
+    # store, household, address, date and time (dsdgen's coherence —
+    # without it the per-ticket queries q68/q73/q79 group nothing)
+    n_tickets = max(n_ss // 6, 2)
+    tk_date = rng.choice(N_DATES, n_tickets, p=date_p).astype(np.int64)
+    tk_time = rng.integers(0, 1440, n_tickets)
+    tk_cust = rng.integers(1, n_cust + 1, n_tickets)
+    tk_cust_null = rng.random(n_tickets) < 0.02
+    tk_hd = rng.integers(1, n_hd + 1, n_tickets)
+    tk_addr = rng.integers(1, n_addr + 1, n_tickets)
+    tk_store = rng.integers(1, n_store + 1, n_tickets)
+    tickets = rng.integers(0, n_tickets, n_ss).astype(np.int64)
+    sold_date = tk_date[tickets]
     qty = rng.integers(1, 101, n_ss)
     wholesale_c = rng.integers(100, 10_000, n_ss)         # cents
     markup = 1.0 + rng.random(n_ss) * 1.5
@@ -315,19 +327,20 @@ def generate(root: str, scale: float = 1.0, seed: int = 7) -> dict:
     sales_c = (list_c * discount).astype(np.int64)
     coupon_c = np.where(rng.random(n_ss) < 0.1,
                         (sales_c * 0.2).astype(np.int64), 0)
-    tickets = rng.integers(1, max(n_ss // 8, 2), n_ss).astype(np.int64)
-    ss_cust = _fk_array(rng, n_ss, n_cust, 0.02, skew=True)
+    ss_cust = pa.array(
+        [None if tk_cust_null[t] else int(tk_cust[t]) for t in tickets],
+        pa.int64())
     store_sales = pa.table({
         "ss_sold_date_sk": pa.array(DATE_SK0 + sold_date, pa.int64()),
-        "ss_sold_time_sk": pa.array(rng.integers(0, 1440, n_ss), pa.int64()),
+        "ss_sold_time_sk": pa.array(tk_time[tickets], pa.int64()),
         "ss_item_sk": _fk_array(rng, n_ss, n_item, skew=True),
         "ss_customer_sk": ss_cust,
         "ss_cdemo_sk": _fk_array(rng, n_ss, n_cd, 0.02),
-        "ss_hdemo_sk": _fk_array(rng, n_ss, n_hd, 0.02),
-        "ss_addr_sk": _fk_array(rng, n_ss, n_addr, 0.02),
-        "ss_store_sk": _fk_array(rng, n_ss, n_store, 0.01),
+        "ss_hdemo_sk": pa.array(tk_hd[tickets], pa.int64()),
+        "ss_addr_sk": pa.array(tk_addr[tickets], pa.int64()),
+        "ss_store_sk": pa.array(tk_store[tickets], pa.int64()),
         "ss_promo_sk": _fk_array(rng, n_ss, n_promo, 0.05),
-        "ss_ticket_number": pa.array(tickets, pa.int64()),
+        "ss_ticket_number": pa.array(tickets + 1, pa.int64()),
         "ss_quantity": pa.array(qty.astype(np.int64)),
         "ss_wholesale_cost": _money_from_cents(wholesale_c),
         "ss_list_price": _money_from_cents(list_c),
@@ -357,7 +370,7 @@ def generate(root: str, scale: float = 1.0, seed: int = 7) -> dict:
         "sr_item_sk": store_sales.column("ss_item_sk").take(
             pa.array(ret_idx, pa.int64())),
         "sr_customer_sk": sr_cust,
-        "sr_ticket_number": pa.array(tickets[ret_idx], pa.int64()),
+        "sr_ticket_number": pa.array(tickets[ret_idx] + 1, pa.int64()),
         "sr_store_sk": store_sales.column("ss_store_sk").take(
             pa.array(ret_idx, pa.int64())),
         "sr_return_quantity": pa.array(

@@ -52,7 +52,27 @@ class ExecutionRuntime:
         self._started = time.time()
 
     def batches(self) -> Iterator[DeviceBatch]:
-        """Device-batch stream (stays on device; used for stage chaining)."""
+        """Device-batch stream (stays on device; used for stage chaining).
+
+        Under ``auron.profile`` the whole task executes inside a
+        jax.profiler trace (xprof/tensorboard-viewable) — the reference
+        exposes the same capability as pprof flamegraph HTTP endpoints
+        (auron/src/http/mod.rs:25-108); here the profiler is the XLA
+        one, which attributes time to compiled kernels directly."""
+        from auron_tpu import config as cfg
+        conf = self.ctx.conf
+        if conf.get(cfg.PROFILE):
+            import tempfile
+            import jax
+            trace_dir = conf.get(cfg.PROFILE_DIR) or tempfile.mkdtemp(
+                prefix=f"auron_profile_t{self.task.task_id}_")
+            self.profile_dir = trace_dir
+            with jax.profiler.trace(trace_dir):
+                yield from self._batches_inner()
+            return
+        yield from self._batches_inner()
+
+    def _batches_inner(self) -> Iterator[DeviceBatch]:
         try:
             yield from self.plan.execute(self.task.partition_id, self.ctx)
         except Exception:
@@ -80,8 +100,23 @@ class ExecutionRuntime:
         return pa.Table.from_batches(batches)
 
     def finalize(self) -> dict:
-        """Metric mirror-back (reference: update_metric_node, rt.rs:302-308)."""
-        return self.ctx.metrics_snapshot()
+        """Metric mirror-back (reference: update_metric_node, rt.rs:302-308).
+        With profiling on, attaches the trace directory and the per-op
+        device-time attribution (the flamegraph's data, queryable)."""
+        snap = self.ctx.metrics_snapshot()
+        if getattr(self, "profile_dir", None):
+            op_times = {
+                op: vals["elapsed_compute"] * 1e-9   # counters are ns
+                for op, vals in snap.items()
+                if isinstance(vals, dict) and "elapsed_compute" in vals
+            }
+            snap["profile"] = {
+                "trace_dir": self.profile_dir,
+                "op_device_time_s": op_times,
+                "device_time_total_s": round(sum(op_times.values()), 6),
+                "wall_time_s": round(time.time() - self._started, 6),
+            }
+        return snap
 
 
 def collect(plan: PhysicalOp, num_partitions: int = 1,

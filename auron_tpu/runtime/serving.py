@@ -1,0 +1,213 @@
+"""Cross-process serving boundary: the live host-attach protocol.
+
+The reference's host engine drives the native runtime per task through
+three JNI entry points — callNative (submit a TaskDefinition), nextBatch
+(pull one Arrow batch through the FFI), finalizeNative (metrics +
+teardown) — JniBridge.java:49-55 driven by
+AuronCallNativeWrapper.java:78-190 over rt.rs:76-300. This module is the
+same lifecycle WITHOUT a JVM: a length-prefixed framed protocol over a
+TCP (or Unix) socket that any process — a Spark executor plugin, a test
+client, another language — can speak.
+
+Wire format (all integers little-endian):
+
+    frame  := u8 kind | u32 len | payload[len]
+    kinds  : 1 SUBMIT   client→server  TaskDefinition protobuf bytes
+             2 BATCH    server→client  one Arrow IPC stream holding one
+                                       RecordBatch (self-describing)
+             3 DONE     server→client  metrics JSON (finalize)
+             4 ERROR    server→client  utf-8 traceback; terminates task
+             5 SHUTDOWN client→server  stop serving (tests/admin)
+
+One SUBMIT per connection mirrors the per-task lifecycle of the
+reference (each Spark task owns one native execution runtime).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+import socketserver
+import struct
+import threading
+import traceback
+
+import pyarrow as pa
+
+KIND_SUBMIT = 1
+KIND_BATCH = 2
+KIND_DONE = 3
+KIND_ERROR = 4
+KIND_SHUTDOWN = 5
+
+_HDR = struct.Struct("<BI")
+
+
+def write_frame(sock, kind: int, payload: bytes) -> None:
+    sock.sendall(_HDR.pack(kind, len(payload)) + payload)
+
+
+def read_frame(sock) -> tuple[int, bytes]:
+    hdr = _read_exact(sock, _HDR.size)
+    kind, ln = _HDR.unpack(hdr)
+    return kind, _read_exact(sock, ln)
+
+
+def _read_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _ipc_bytes(rb: pa.RecordBatch) -> bytes:
+    out = io.BytesIO()
+    with pa.ipc.new_stream(out, rb.schema) as w:
+        w.write_batch(rb)
+    return out.getvalue()
+
+
+def _ipc_batch(data: bytes) -> pa.RecordBatch:
+    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+        return next(iter(r))
+
+
+class _TaskHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            kind, payload = read_frame(self.request)
+        except ConnectionError:
+            return
+        if kind == KIND_SHUTDOWN:
+            self.server._shutdown_requested = True
+            threading.Thread(target=self.server.shutdown,
+                             daemon=True).start()
+            return
+        if kind != KIND_SUBMIT:
+            write_frame(self.request, KIND_ERROR,
+                        f"expected SUBMIT, got kind={kind}".encode())
+            return
+        try:
+            self._run_task(payload)
+        except Exception:
+            try:
+                write_frame(self.request, KIND_ERROR,
+                            traceback.format_exc(limit=12).encode())
+            except OSError:
+                pass
+
+    def _run_task(self, task_bytes: bytes) -> None:
+        # imported lazily so the server process controls jax platform
+        # selection before anything initializes a backend
+        from auron_tpu.columnar.arrow_bridge import to_arrow
+        from auron_tpu.ir import pb
+        from auron_tpu.ir.planner import PlannerContext, plan_from_bytes
+        from auron_tpu.runtime.executor import (ExecutionRuntime,
+                                                TaskDefinition)
+        task = pb.TaskDefinition()
+        task.ParseFromString(task_bytes)
+        op = plan_from_bytes(task_bytes, PlannerContext())
+        rt = ExecutionRuntime(
+            op, TaskDefinition(partition_id=task.partition_id,
+                               num_partitions=task.num_partitions or 1,
+                               stage_id=task.stage_id,
+                               task_id=task.task_id))
+        for batch in rt.batches():
+            rb = to_arrow(batch, op.schema())
+            if rb.num_rows:
+                write_frame(self.request, KIND_BATCH, _ipc_bytes(rb))
+        metrics = rt.finalize()
+        write_frame(self.request, KIND_DONE,
+                    json.dumps(metrics, default=str).encode())
+
+
+class AuronServer(socketserver.ThreadingTCPServer):
+    """Task-serving endpoint; one engine process serves many host tasks
+    concurrently (threaded — batch compute holds the GIL only outside
+    XLA execution)."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        super().__init__((host, port), _TaskHandler)
+        self._shutdown_requested = False
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.server_address
+
+    def serve_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+
+class AuronClient:
+    """The host-engine side of the protocol: callNative is ``execute``'s
+    SUBMIT, nextBatch is the BATCH stream, finalizeNative is DONE."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 300.0):
+        self.addr = (host, port)
+        self.timeout_s = timeout_s
+
+    def execute(self, task_bytes: bytes):
+        """Submit one TaskDefinition; returns (pa.Table, metrics dict).
+        Raises RuntimeError with the remote traceback on engine errors."""
+        batches, metrics = [], None
+        for kind, payload in self.stream(task_bytes):
+            if kind == KIND_BATCH:
+                batches.append(_ipc_batch(payload))
+            else:
+                metrics = json.loads(payload.decode())
+        if batches:
+            tbl = pa.Table.from_batches(batches)
+        else:
+            tbl = None
+        return tbl, metrics
+
+    def stream(self, task_bytes: bytes):
+        """Yield (kind, payload) frames for one task submission."""
+        with socket.create_connection(self.addr,
+                                      timeout=self.timeout_s) as s:
+            write_frame(s, KIND_SUBMIT, task_bytes)
+            while True:
+                kind, payload = read_frame(s)
+                if kind == KIND_ERROR:
+                    raise RuntimeError("engine error:\n"
+                                       + payload.decode())
+                yield kind, payload
+                if kind == KIND_DONE:
+                    return
+
+    def shutdown(self) -> None:
+        with socket.create_connection(self.addr, timeout=10) as s:
+            write_frame(s, KIND_SHUTDOWN, b"")
+
+
+def serve_main(argv=None) -> int:
+    """``python -m auron_tpu.runtime.serving --port N`` — run a serving
+    engine process (prints the bound port for the parent to scrape)."""
+    import argparse
+    import os
+    import sys
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+    srv = AuronServer(args.host, args.port)
+    print(f"AURON_SERVING {srv.address[0]}:{srv.address[1]}", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(serve_main())

