@@ -377,6 +377,15 @@ def infer_field(expr: ir.Expr, schema: Schema, name: str = "c") -> Field:
         if f.dtype in (DataType.MAP, DataType.STRUCT, DataType.LIST):
             return f.with_name(name)
     dt, p, s = infer_dtype(expr, schema)
+    if dt in (DataType.MAP, DataType.STRUCT):
+        # no nested-aware arm matched above: a Field without key/children
+        # metadata would crash schema_to_arrow/serde far downstream — fail
+        # at plan time instead (e.g. CaseWhen over maps with no otherwise)
+        raise NotImplementedError(
+            f"cannot infer nested ({dt.value}) result metadata for "
+            f"{type(expr).__name__}; add an explicit typed branch "
+            "(e.g. an 'otherwise' arm) or project the nested column "
+            "directly")
     elem = None
     if dt == DataType.LIST:
         if isinstance(expr, ir.ScalarFunction):
@@ -884,13 +893,22 @@ def cast_value(v: TypedValue, dtype: DataType, precision: int = 0,
     if dtype in _INT_BITS:
         target = _JNP[dtype]
         if v.dtype.is_floating:
-            # JVM d2i/d2l: NaN→0, saturate at min/max
-            info_min = -(2 ** (_INT_BITS[dtype] - 1))
-            info_max = 2 ** (_INT_BITS[dtype] - 1) - 1
-            clamped = jnp.clip(jnp.nan_to_num(jnp.trunc(d), nan=0.0),
-                               info_min, info_max)
-            return TypedValue(PrimitiveColumn(clamped.astype(target), validity),
-                              dtype)
+            # Spark non-ANSI Cast: truncate toward zero; NaN, ±inf and
+            # values outside the target range become NULL (not the JVM
+            # d2i saturate — cast(2.5e9 as int) is NULL, not MaxValue).
+            # The range check mirrors Spark's, where Long.MaxValue
+            # promotes to double 2^63: the input exactly 2^63 is ADMITTED
+            # and d2l-saturates to MaxValue, while anything above nulls.
+            bits = _INT_BITS[dtype]
+            t = jnp.trunc(d.astype(jnp.float64))
+            lo_f = -(2.0 ** (bits - 1))
+            hi_adm = float(2 ** (bits - 1) - 1)   # int64: rounds to 2^63
+            ok = (t >= lo_f) & (t <= hi_adm)      # False for NaN/±inf too
+            at_top = t >= 2.0 ** (bits - 1)       # the admitted boundary
+            out = jnp.where(ok & ~at_top, t, 0.0).astype(target)
+            out = jnp.where(at_top, jnp.asarray(2 ** (bits - 1) - 1, target),
+                            out)
+            return TypedValue(PrimitiveColumn(out, validity & ok), dtype)
         # int→int narrowing wraps (Java semantics)
         return TypedValue(PrimitiveColumn(d.astype(target), validity), dtype)
 
@@ -1091,25 +1109,40 @@ def _cast_from_string(v: TypedValue, dtype: DataType, precision: int,
             lo, hi = -(2 ** (bits - 1)), 2 ** (bits - 1) - 1
             def parse(s):
                 # Spark UTF8String.toInt/toLong: trimmed, optional sign,
-                # ASCII DIGITS ONLY — '4.5', '1e2' are NULL (casting via
-                # float first is the documented workaround); exact int
-                # parsing keeps Long.MaxValue-class strings lossless
+                # digits with an optional '.' + digit fraction that
+                # TRUNCATES toward zero ('4.5'→4, '.5'→0); scientific
+                # notation ('1e2') stays NULL. Exact int parsing keeps
+                # Long.MaxValue-class strings lossless.
                 s = s.strip()
                 if not s:
                     return None
+                sign = -1 if s[0] == "-" else 1
                 body = s[1:] if s[0] in "+-" else s
                 if not (body.isascii() and body.isdigit()):
-                    return None
-                r = int(s)
+                    intpart, dot, frac = body.partition(".")
+                    if not dot or not (frac == "" or (frac.isascii()
+                                                     and frac.isdigit())):
+                        return None
+                    if intpart and not (intpart.isascii()
+                                        and intpart.isdigit()):
+                        return None
+                    if not intpart and not frac:
+                        return None      # bare '.' / '+.'
+                    body = intpart or "0"
+                r = sign * int(body)
                 return r if lo <= r <= hi else None
             np_t = _JNP[dtype]
     elif dtype == DataType.DECIMAL:
-        from decimal import Decimal, InvalidOperation
+        from decimal import ROUND_HALF_UP, Decimal, InvalidOperation
         def parse(s):
             try:
+                # Spark Decimal.changePrecision rescales HALF_UP:
+                # cast('1.005' as decimal(10,2)) → 1.01, not banker's 1.00.
+                # OverflowError: 'Infinity' parses as a Decimal but cannot
+                # convert to int — NULL, not a crash
                 r = int(Decimal(s.strip()).scaleb(scale)
-                        .to_integral_value())
-            except (InvalidOperation, ValueError):
+                        .to_integral_value(rounding=ROUND_HALF_UP))
+            except (InvalidOperation, ValueError, OverflowError):
                 return None
             # beyond the declared precision → null (Spark
             # Decimal.changePrecision failure)

@@ -110,15 +110,10 @@ _DEC_NEUTRAL = {"dmin": (0x7FFFFFFFFFFFFFFF, -1),
 
 
 def decimal_avg_result(p: int, s: int) -> tuple[int, int]:
-    """Spark avg(decimal(p,s)) → decimal(p+4, s+4), capped at precision 38
-    with the same allowPrecisionLoss scale adjustment as binary arithmetic
-    (DecimalPrecision.adjustPrecisionScale)."""
-    rp, rs = p + 4, s + 4
-    if rp <= 38:
-        return rp, rs
-    digits_int = rp - rs
-    adj_s = max(38 - digits_int, min(rs, 6))
-    return 38, adj_s
+    """Spark avg(decimal(p,s)) → DecimalType.bounded(p+4, s+4): each bound
+    clamps at 38 independently (avg(decimal(38,18)) is decimal(38,22)) —
+    NOT the adjustPrecisionScale scale-reduction binary arithmetic uses."""
+    return min(p + 4, 38), min(s + 4, 38)
 
 
 def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
@@ -191,11 +186,14 @@ def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
             # scale; the finalizer shifts to the result scale inside the
             # division (q*10^k + round(r*10^k/count)) so only genuinely
             # overflowing totals wrap the representation
-            if wide:
+            if wide or p + 4 > 18:
+                # Spark promotes past 18 digits: avg(decimal(16,2)) is
+                # decimal(20,6) — narrow inputs with p in 15..18 route
+                # through the two-limb representation for the result
                 rp, rs = decimal_avg_result(p, s)
                 sp, kind = min(p + 10, 38), "dsum"
             else:
-                rp = min(p + 4, 18)
+                rp = p + 4
                 rs = min(s + 4, rp)
                 sp, kind = min(p + 10, 18), "sum"
             # the count field's (otherwise unused) precision/scale slots
@@ -310,7 +308,9 @@ def _keys_equal_prev(sorted_keys, live):
             same = ((col.hi[1:] == col.hi[:-1])
                     & (col.lo[1:] == col.lo[:-1]))
         else:
-            same = col.data[1:] == col.data[:-1]
+            # Spark groups all NaNs together (NormalizeNaNAndZero)
+            from auron_tpu.ops.hashing import nan_aware_eq
+            same = nan_aware_eq(col.data[1:], col.data[:-1])
         both_valid = col.validity[1:] & col.validity[:-1]
         both_null = ~col.validity[1:] & ~col.validity[:-1]
         same = (both_valid & same) | both_null
@@ -632,6 +632,10 @@ def _state_nbytes(state) -> int:
     return sum(_table_nbytes(lvl) for lvl in state if lvl is not None)
 
 
+#: single shared NaN object so NaN group keys rendezvous in host dicts
+_CANONICAL_NAN = float("nan")
+
+
 def _column_pyvalues(col, n: int) -> list:
     """First n rows of a column as python values (None where invalid)."""
     if isinstance(col, StringColumn):
@@ -652,7 +656,19 @@ def _key_tuples_host(key_cols, n: int) -> list[tuple]:
     if not key_cols:
         return [() for _ in range(n)]
     per_col = [_column_pyvalues(c, n) for c in key_cols]
-    return [tuple(c[i] for c in per_col) for i in range(n)]
+
+    def canon(x):
+        # keys only (NOT aggregate inputs — Spark's NormalizeNaNAndZero
+        # applies to group/join/window keys alone): one shared NaN object
+        # so NaN keys rendezvous in host dicts via identity; -0.0 → 0.0
+        if isinstance(x, float):
+            if x != x:
+                return _CANONICAL_NAN
+            if x == 0.0:
+                return 0.0
+        return x
+
+    return [tuple(canon(c[i]) for c in per_col) for i in range(n)]
 
 
 def _host_string_column(values: list, cap: int) -> StringColumn:
@@ -1217,8 +1233,17 @@ class AggOp(PhysicalOp):
                     continue
                 raise NotImplementedError(f"{agg.fn} over strings")
             from auron_tpu.columnar.decimal128 import Decimal128Column
-            if isinstance(v.col, Decimal128Column):
-                hi, lo = v.col.hi, v.col.lo
+            needs_limbs = any(k in _DEC_KINDS
+                              for _f, _d, k in spec.state_fields)
+            if isinstance(v.col, Decimal128Column) or needs_limbs:
+                if isinstance(v.col, Decimal128Column):
+                    hi, lo = v.col.hi, v.col.lo
+                else:
+                    # narrow decimal input promoted to two limbs: avg
+                    # with p+4>18 accumulates/returns wide (Spark
+                    # DecimalType.bounded promotion past 18 digits)
+                    from auron_tpu.columnar import decimal128 as d128
+                    hi, lo = d128.from_int64(v.col.data.astype(jnp.int64))
                 for fname, fdt, kind in spec.state_fields:
                     if fname == "has":
                         accs.append(valid)

@@ -85,11 +85,32 @@ def murmur3_int64(values: jax.Array, seed: jax.Array) -> jax.Array:
     return murmur3_u32_pair(low, high, seed)
 
 
+def canonicalize_float(d: jax.Array) -> jax.Array:
+    """Spark NormalizeNaNAndZero / Java doubleToLongBits canonicalization:
+    -0.0 → 0.0 and every NaN payload → the canonical quiet NaN. Applied to
+    float KEY values before hashing, order-word encoding, or equality so
+    equal-under-Spark keys agree bit-for-bit; non-float arrays pass
+    through."""
+    if not jnp.issubdtype(d.dtype, jnp.floating):
+        return d
+    v = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
+    return jnp.where(d != d, jnp.full((), jnp.nan, d.dtype), v)
+
+
+def nan_aware_eq(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Elementwise key equality with Spark semantics: NaN == NaN (floats
+    only; plain == elsewhere). -0.0 == 0.0 already holds under IEEE ==."""
+    same = a == b
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        same = same | ((a != a) & (b != b))
+    return same
+
+
 def _f64_bits(d: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Raw bits of f64 as (low, high) uint32 words, with Spark's -0.0 → 0.0
-    normalization. Avoids f64<->s64 bitcast, which TPU's 64-bit-rewriting
-    pass does not implement; f64→2×u32 bitcast is supported."""
-    v = jnp.where(d == 0.0, jnp.float64(0.0), d)
+    """Canonicalized bits of f64 as (low, high) uint32 words. Avoids
+    f64<->s64 bitcast, which TPU's 64-bit-rewriting pass does not
+    implement; f64→2×u32 bitcast is supported."""
+    v = canonicalize_float(d)
     pair = lax.bitcast_convert_type(v, jnp.uint32)  # [..., 2]
     # trailing dim order: index 0 = least-significant word on LE targets
     return pair[..., 0], pair[..., 1]
@@ -319,9 +340,9 @@ def _hash_column_murmur(col: Column, hashes: jax.Array) -> jax.Array:
         elif d.dtype == jnp.dtype(jnp.int64):
             new = murmur3_int64(d, hashes.view(jnp.uint32))
         elif d.dtype == jnp.dtype(jnp.float32):
-            # Spark: -0.0 normalized to 0.0, then int bits.
-            v = jnp.where(d == 0.0, jnp.float32(0.0), d).view(jnp.int32)
-            new = murmur3_int32(v, hashes.view(jnp.uint32))
+            # Java floatToIntBits: -0.0 → 0.0, NaN payloads canonicalized.
+            new = murmur3_int32(canonicalize_float(d).view(jnp.int32),
+                                hashes.view(jnp.uint32))
         elif d.dtype == jnp.dtype(jnp.float64):
             lo, hi = _f64_bits(d)
             new = murmur3_u32_pair(lo, hi, hashes.view(jnp.uint32))
@@ -349,8 +370,8 @@ def _hash_column_xxhash(col: Column, hashes: jax.Array) -> jax.Array:
         elif d.dtype == jnp.dtype(jnp.int64):
             new = xxhash64_int64(d, hashes.view(jnp.uint64))
         elif d.dtype == jnp.dtype(jnp.float32):
-            v = jnp.where(d == 0.0, jnp.float32(0.0), d).view(jnp.int32)
-            new = xxhash64_int32(v, hashes.view(jnp.uint64))
+            new = xxhash64_int32(canonicalize_float(d).view(jnp.int32),
+                                 hashes.view(jnp.uint64))
         elif d.dtype == jnp.dtype(jnp.float64):
             lo, hi = _f64_bits(d)
             u64 = lo.astype(jnp.uint64) | (hi.astype(jnp.uint64) << 32)

@@ -127,7 +127,9 @@ def _keys_match(probe_keys, probe_idx, build_keys, build_idx) -> jax.Array:
             same = (pc.hi[probe_idx] == bc.hi[build_idx]) \
                 & (pc.lo[probe_idx] == bc.lo[build_idx])
         else:
-            same = pc.data[probe_idx] == bc.data[build_idx]
+            # Spark join keys: NaN matches NaN (NormalizeNaNAndZero)
+            from auron_tpu.ops.hashing import nan_aware_eq
+            same = nan_aware_eq(pc.data[probe_idx], bc.data[build_idx])
         ok = ok & pv & bv & same
     return ok
 

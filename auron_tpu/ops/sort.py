@@ -69,6 +69,12 @@ def order_words(col, ascending: bool, nulls_first: bool) -> list[jax.Array]:
         elif jnp.issubdtype(d.dtype, jnp.signedinteger):
             u = d.astype(jnp.int64).astype(jnp.uint64) ^ jnp.uint64(1 << 63)
         elif d.dtype == jnp.dtype(jnp.float32):
+            # Spark ordering: -0.0 == 0.0 and every NaN is the same
+            # (greatest) value — canonicalize before bit-twiddling so
+            # equal-under-Spark keys produce identical order words (SMJ
+            # and window group detection compare words for equality)
+            from auron_tpu.ops.hashing import canonicalize_float
+            d = canonicalize_float(d)
             b = d.view(jnp.int32).astype(jnp.int64).astype(jnp.uint64) \
                 & jnp.uint64(0xFFFFFFFF)
             sign = (b >> 31) & 1
@@ -76,6 +82,8 @@ def order_words(col, ascending: bool, nulls_first: bool) -> list[jax.Array]:
                           b | jnp.uint64(0x80000000))
         elif d.dtype == jnp.dtype(jnp.float64):
             from jax import lax
+            from auron_tpu.ops.hashing import canonicalize_float
+            d = canonicalize_float(d)
             pair = lax.bitcast_convert_type(d, jnp.uint32)
             b = pair[..., 0].astype(jnp.uint64) | (pair[..., 1].astype(jnp.uint64) << 32)
             sign = (b >> 63) & 1

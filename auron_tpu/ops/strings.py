@@ -121,11 +121,18 @@ def substring(col: StringColumn, start: jax.Array, length: jax.Array) -> StringC
     n, w = chars.shape
     start = jnp.asarray(start, jnp.int32)
     length = jnp.maximum(jnp.asarray(length, jnp.int32), 0)
-    # Spark: start>0 → start-1; start==0 → 0; start<0 → len+start (floor 0)
-    zero_based = jnp.where(start > 0, start - 1,
-                           jnp.where(start == 0, 0, jnp.maximum(lens + start, 0)))
-    zero_based = jnp.minimum(zero_based, lens)
-    out_len = jnp.minimum(length, lens - zero_based)
+    # Spark UTF8String.substringSQL: start>0 → start-1; start==0 → 0;
+    # start<0 → len+start UNCLAMPED — the window end is start+length
+    # *before* clamping, so substring('hello', -10, 2) is '' (the window
+    # [-5,-3) misses the string entirely), not 'he'
+    raw = jnp.where(start > 0, start - 1,
+                    jnp.where(start == 0, 0, lens + start))
+    # end in int64: Spark's 2-arg substring passes length=Int.MaxValue,
+    # which would wrap int32 raw+length and empty the result
+    end = jnp.clip(raw.astype(jnp.int64) + length.astype(jnp.int64),
+                   0, lens.astype(jnp.int64)).astype(jnp.int32)
+    zero_based = jnp.clip(raw, 0, lens)
+    out_len = jnp.maximum(end - zero_based, 0)
     idx = zero_based[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
     gathered = jnp.take_along_axis(chars, jnp.clip(idx, 0, w - 1), axis=1)
     mask = jnp.arange(w, dtype=jnp.int32)[None, :] < out_len[:, None]

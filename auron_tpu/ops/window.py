@@ -34,7 +34,8 @@ from auron_tpu.columnar.batch import (DeviceBatch, PrimitiveColumn,
                                       StringColumn, gather_batch)
 from auron_tpu.columnar.schema import DataType, Field, Schema
 from auron_tpu.exprs import ir
-from auron_tpu.exprs.eval import EvalContext, evaluate, infer_dtype
+from auron_tpu.exprs.eval import (EvalContext, TypedValue, evaluate,
+                                  infer_dtype)
 from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
 from auron_tpu.ops.sort import _concat_all, sort_permutation
 
@@ -81,7 +82,9 @@ def _col_neq_prev(col) -> jax.Array:
     elif isinstance(col, Decimal128Column):
         same = (col.hi[1:] == col.hi[:-1]) & (col.lo[1:] == col.lo[:-1])
     else:
-        same = col.data[1:] == col.data[:-1]
+        # Spark partitions all NaNs together (NormalizeNaNAndZero)
+        from auron_tpu.ops.hashing import nan_aware_eq
+        same = nan_aware_eq(col.data[1:], col.data[:-1])
     both_null = (~col.validity[1:]) & (~col.validity[:-1])
     both_valid = col.validity[1:] & col.validity[:-1]
     eq = jnp.where(both_null, True, both_valid & same)
@@ -140,7 +143,9 @@ def _result_field(spec: WindowFunctionSpec, name: str,
     dt, p, s = infer_dtype(spec.arg, in_schema)
     if spec.fn == "avg":
         if dt == DataType.DECIMAL:
-            if p > 18:
+            if p + 4 > 18:
+                # matches AggOp: avg past 18 digits promotes to the wide
+                # representation with Spark's bounded(p+4, s+4) type
                 from auron_tpu.ops.agg import decimal_avg_result
                 p, s = decimal_avg_result(p, s)
             else:
@@ -322,6 +327,16 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
             # agg over window — two-limb decimal(p>18) values run the
             # same segmented scans in 128-bit limb arithmetic
             from auron_tpu.columnar.decimal128 import Decimal128Column
+            if (v is not None and spec.fn == "avg"
+                    and not isinstance(v.col, Decimal128Column)):
+                _dt, _p, _s = infer_dtype(spec.arg, in_schema)
+                if _dt == DataType.DECIMAL and _p + 4 > 18:
+                    # same p+4>18 wide promotion as AggOp: window avg of
+                    # decimal(15..18,s) returns Spark's decimal(p+4,s+4)
+                    from auron_tpu.columnar import decimal128 as d128
+                    _h, _l = d128.from_int64(v.col.data.astype(jnp.int64))
+                    v = TypedValue(Decimal128Column(_h, _l, v.validity),
+                                   DataType.DECIMAL, _p, _s)
             if v is not None and isinstance(v.col, Decimal128Column) \
                     and spec.fn != "count":
                 from auron_tpu.columnar import decimal128 as d128

@@ -97,3 +97,69 @@ def test_window_avg_decimal_spark_scale():
     assert a[:2] == [decimal.Decimal("1.000000"), decimal.Decimal("1.505000")]
     # g=2: 2.00 then still 2.00 (null ignored)
     assert a[2:] == [decimal.Decimal("2.000000"), decimal.Decimal("2.000000")]
+
+
+def test_window_avg_decimal_wide_promotion_matches_aggop():
+    # round-5: avg(decimal(16,2)) promotes past 18 digits to Spark's
+    # bounded(p+4, s+4) = decimal(20,6) in BOTH AggOp and WindowOp
+    vals = [decimal.Decimal("99999999999999.99"),
+            decimal.Decimal("99999999999999.97"),
+            decimal.Decimal("3.00"), decimal.Decimal("1.00")]
+    rb = pa.record_batch({
+        "g": pa.array([1, 1, 2, 2], pa.int64()),
+        "o": pa.array([0, 1, 0, 1], pa.int64()),
+        "d": pa.array(vals, pa.decimal128(16, 2)),
+    })
+    from auron_tpu.columnar.schema import DataType
+    sch = schema_from_arrow(rb.schema)
+    agg = AggOp(MemoryScanOp([[rb]], sch, capacity=8), [C(0)],
+                [ir.AggFunction("avg", C(2))], mode="complete",
+                group_names=["g"], agg_names=["a"], initial_capacity=16)
+    f = agg.schema()[agg.schema().index_of("a")]
+    assert (f.dtype, f.precision, f.scale) == (DataType.DECIMAL, 20, 6)
+    got = {r["g"]: r["a"] for r in collect(agg).to_pylist()}
+    assert got[1] == decimal.Decimal("99999999999999.980000")
+    assert got[2] == decimal.Decimal("2.000000")
+
+    win = WindowOp(
+        MemoryScanOp([[rb]], sch, capacity=8),
+        partition_by=[C(0)], order_by=[ir.SortOrder(C(1))],
+        functions=[WindowFunctionSpec("agg", "avg", arg=C(2))],
+        output_names=["a"])
+    wf = win.schema()[win.schema().index_of("a")]
+    assert (wf.dtype, wf.precision, wf.scale) == (DataType.DECIMAL, 20, 6)
+    wgot = collect(win)
+    assert wgot.schema.field("a").type == pa.decimal128(20, 6)
+    a = wgot.column("a").to_pylist()
+    assert a[0] == decimal.Decimal("99999999999999.990000")
+    assert a[1] == decimal.Decimal("99999999999999.980000")
+    assert a[2:] == [decimal.Decimal("3.000000"),
+                     decimal.Decimal("2.000000")]
+
+
+def test_cast_double_to_long_2pow63_boundary_saturates():
+    # Spark's own range check promotes Long.MaxValue to double 2^63, so
+    # the input exactly 2^63 is admitted and saturates; above it -> NULL
+    from auron_tpu.columnar.schema import DataType
+    from auron_tpu.ops.project import ProjectOp
+    rb = pa.record_batch({"d": pa.array(
+        [float(2**63), 9.3e18, -float(2**63), 9223372036854774784.0],
+        pa.float64())})
+    op = ProjectOp(MemoryScanOp([[rb]], schema_from_arrow(rb.schema),
+                                capacity=8),
+                   [ir.Cast(C(0), DataType.INT64, safe=True)], ["x"])
+    got = collect(op).column("x").to_pylist()
+    assert got == [2**63 - 1, None, -(2**63), 9223372036854774784]
+
+
+def test_cast_infinity_string_to_decimal_is_null():
+    from auron_tpu.columnar.schema import DataType
+    from auron_tpu.ops.project import ProjectOp
+    rb = pa.record_batch({"s": pa.array(
+        ["Infinity", "-Infinity", "NaN", "1.25"], pa.string())})
+    op = ProjectOp(MemoryScanOp([[rb]], schema_from_arrow(rb.schema),
+                                capacity=8),
+                   [ir.Cast(C(0), DataType.DECIMAL, 10, 2, safe=True)],
+                   ["x"])
+    got = collect(op).column("x").to_pylist()
+    assert got == [None, None, None, decimal.Decimal("1.25")]

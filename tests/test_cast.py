@@ -34,15 +34,16 @@ class TestNumericCasts:
         assert run_cast([300, -300], pa.int32(), DataType.INT8) == [44, -44]
         assert run_cast([70000], pa.int32(), DataType.INT16) == [4464]
 
-    def test_double_to_int_truncates_saturates(self):
+    def test_double_to_int_truncates_nulls_overflow(self):
+        # Spark non-ANSI: truncate toward zero; NaN/±inf/out-of-range → NULL
         got = run_cast([1.9, -1.9, float("nan"), 1e20, -1e20], pa.float64(),
                        DataType.INT32)
-        assert got == [1, -1, 0, 2**31 - 1, -2**31]
+        assert got == [1, -1, None, None, None]
 
     def test_double_to_long(self):
         got = run_cast([1.5, -2.7, float("inf")], pa.float64(),
                        DataType.INT64)
-        assert got == [1, -2, 2**63 - 1]
+        assert got == [1, -2, None]
 
     def test_int_to_double(self):
         assert run_cast([3, None], pa.int64(), DataType.FLOAT64) == [3.0, None]

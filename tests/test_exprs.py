@@ -116,11 +116,11 @@ def test_cast_numeric():
     rb = pa.record_batch({
         "f": pa.array([1.9, -2.9, float("nan"), 3e10], pa.float64()),
     })
-    # JVM float→int: truncate, NaN→0, saturate
+    # Spark non-ANSI float→int: truncate toward zero, NaN/overflow → NULL
     assert eval_to_list(ir.Cast(C(0), DataType.INT32), rb) == \
-        [1, -2, 0, 2**31 - 1]
+        [1, -2, None, None]
     assert eval_to_list(ir.Cast(C(0), DataType.INT64), rb) == \
-        [1, -2, 0, 30000000000]
+        [1, -2, None, 30000000000]
 
 
 def test_cast_string_to_int():
