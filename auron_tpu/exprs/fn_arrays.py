@@ -125,11 +125,17 @@ def _array_contains(args, expr, batch, schema, ctx):
     if isinstance(needle.col, StringColumn):
         raise NotImplementedError("array_contains with STRING needle")
     col: ListColumn = arr.col
-    hit = jnp.any((col.values == needle.data[:, None]) & col.elem_valid
-                  & (jnp.arange(col.max_elems)[None, :] < col.lens[:, None]),
-                  axis=1)
-    return TypedValue(PrimitiveColumn(hit, arr.validity & needle.validity),
-                      DataType.BOOL)
+    in_list = jnp.arange(col.max_elems)[None, :] < col.lens[:, None]
+    # Spark compares with SQLOrderingUtil semantics: NaN matches NaN
+    from auron_tpu.ops.hashing import nan_aware_eq
+    hit = jnp.any(nan_aware_eq(col.values, needle.data[:, None])
+                  & col.elem_valid & in_list, axis=1)
+    # Spark three-valued semantics: no match but a null element present →
+    # NULL (the null "might have been" the needle), not false
+    has_null_elem = jnp.any(~col.elem_valid & in_list, axis=1)
+    return TypedValue(
+        PrimitiveColumn(hit, arr.validity & needle.validity
+                        & (hit | ~has_null_elem)), DataType.BOOL)
 
 
 @register("array_position", DataType.INT64)
@@ -137,7 +143,9 @@ def _array_position(args, expr, batch, schema, ctx):
     arr, needle = args
     col: ListColumn = arr.col
     in_list = jnp.arange(col.max_elems)[None, :] < col.lens[:, None]
-    eq = (col.values == needle.data[:, None]) & col.elem_valid & in_list
+    from auron_tpu.ops.hashing import nan_aware_eq
+    eq = nan_aware_eq(col.values, needle.data[:, None]) \
+        & col.elem_valid & in_list
     first = jnp.argmax(eq, axis=1)
     any_hit = jnp.any(eq, axis=1)
     pos = jnp.where(any_hit, first + 1, 0).astype(jnp.int64)

@@ -415,7 +415,13 @@ def _make_date(args, expr, batch, schema, ctx):
     y = cast_value(args[0], DataType.INT32).data
     m = cast_value(args[1], DataType.INT32).data
     d = cast_value(args[2], DataType.INT32).data
-    ok = (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)
+    # Spark nulls invalid dates (make_date(2019,2,29) → NULL), it never
+    # rolls them over into the next month
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    month_len = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30,
+                             31], jnp.int32)[jnp.clip(m, 1, 12) - 1]
+    month_len = month_len + (leap & (m == 2)).astype(jnp.int32)
+    ok = (m >= 1) & (m <= 12) & (d >= 1) & (d <= month_len)
     out = _days_from_civil(y, jnp.clip(m, 1, 12), jnp.clip(d, 1, 31))
     valid = args[0].validity & args[1].validity & args[2].validity & ok
     return TypedValue(PrimitiveColumn(out.astype(jnp.int32), valid),

@@ -8,8 +8,6 @@ args and return a TypedValue; everything traces into the enclosing jit.
 
 from __future__ import annotations
 
-import numpy as np
-
 import jax.numpy as jnp
 
 from auron_tpu.columnar.batch import PrimitiveColumn, StringColumn
@@ -213,13 +211,24 @@ def _round_half_up(x, digits):
     return jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5) / factor
 
 
+def _round_digits(expr) -> int:
+    """Static digits argument of round/bround, read from the EXPRESSION
+    (Spark requires a foldable scale): reading the evaluated arg would
+    trace a device value and crash under jit."""
+    if len(expr.args) <= 1:
+        return 0
+    a = expr.args[1]
+    if not isinstance(a, ir.Literal):
+        raise NotImplementedError(
+            f"{expr.name}: the scale argument must be a literal")
+    return int(a.value)
+
+
 @register("round")
 def _round(args, expr, batch, schema, ctx):
     """Spark round: HALF_UP (reference: spark_bround.rs / spark_round)."""
     v = args[0]
-    digits = 0
-    if len(args) > 1:
-        digits = int(np.asarray(args[1].data)[0]) if args[1].data.ndim else int(args[1].data)
+    digits = int(_round_digits(expr))
     if v.dtype == DataType.DECIMAL:
         shift = v.scale - digits
         if shift <= 0:
@@ -240,9 +249,7 @@ def _round(args, expr, batch, schema, ctx):
 def _bround(args, expr, batch, schema, ctx):
     """Spark bround: HALF_EVEN (banker's rounding)."""
     v = args[0]
-    digits = 0
-    if len(args) > 1:
-        digits = int(np.asarray(args[1].data)[0]) if args[1].data.ndim else int(args[1].data)
+    digits = int(_round_digits(expr))
     if v.dtype.is_integer:
         return v
     factor = 10.0 ** digits
@@ -304,11 +311,17 @@ def _normalize(args, expr, batch, schema, ctx):
     return TypedValue(PrimitiveColumn(d, v.validity), v.dtype)
 
 
+def _nan_gt(a, b):
+    """Spark ordering '>': NaN is the greatest value (a != a means NaN;
+    no-op for ints)."""
+    return (a > b) | ((a != a) & (b == b))
+
+
 @register("greatest")
 def _greatest(args, expr, batch, schema, ctx):
     out = args[0]
     for v in args[1:]:
-        take = (~out.validity) | (v.validity & (v.data > out.data))
+        take = (~out.validity) | (v.validity & _nan_gt(v.data, out.data))
         out = TypedValue(PrimitiveColumn(jnp.where(take, v.data, out.data),
                                          out.validity | v.validity), out.dtype,
                          out.precision, out.scale)
@@ -319,7 +332,7 @@ def _greatest(args, expr, batch, schema, ctx):
 def _least(args, expr, batch, schema, ctx):
     out = args[0]
     for v in args[1:]:
-        take = (~out.validity) | (v.validity & (v.data < out.data))
+        take = (~out.validity) | (v.validity & _nan_gt(out.data, v.data))
         out = TypedValue(PrimitiveColumn(jnp.where(take, v.data, out.data),
                                          out.validity | v.validity), out.dtype,
                          out.precision, out.scale)

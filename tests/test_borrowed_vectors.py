@@ -465,9 +465,441 @@ class TestNanNullSemantics:
         ASSERTIONS["n"] += 3
 
 
+# ---------------------------------------------------------------------------
+# math / arithmetic
+# ---------------------------------------------------------------------------
+
+class TestMathVectors:
+    def test_round_bround(self):
+        # Spark round = HALF_UP (away from zero at .5); bround = HALF_EVEN
+        _check_vector(fn("round", C(0)),
+                      {"c": pa.array([2.5, -2.5, 2.4, 3.5, -3.5, 0.5, None],
+                                     pa.float64())},
+                      [3.0, -3.0, 2.0, 4.0, -4.0, 1.0, None], "round")
+        _check_vector(fn("round", C(0), lit(2, DataType.INT32)),
+                      {"c": pa.array([2.675, 1.234, -2.675, None],
+                                     pa.float64())},
+                      [2.68, 1.23, -2.68, None], "round2")
+        _check_vector(fn("bround", C(0)),
+                      {"c": pa.array([2.5, 3.5, -2.5, 0.5, None],
+                                     pa.float64())},
+                      [2.0, 4.0, -2.0, 0.0, None], "bround")
+
+    def test_ceil_floor(self):
+        vec = [(1.1, 2, 1), (-1.1, -1, -2), (0.0, 0, 0), (-0.5, 0, -1),
+               (5.0, 5, 5), (None, None, None)]
+        _check_vector(fn("ceil", C(0)),
+                      {"c": pa.array([v for v, _, _ in vec], pa.float64())},
+                      [e for _, e, _ in vec], "ceil")
+        _check_vector(fn("floor", C(0)),
+                      {"c": pa.array([v for v, _, _ in vec], pa.float64())},
+                      [e for _, _, e in vec], "floor")
+
+    def test_abs_sign(self):
+        _check_vector(fn("abs", C(0)),
+                      {"c": pa.array([-5, 5, 0, None], pa.int64())},
+                      [5, 5, 0, None], "abs")
+        _check_vector(fn("sign", C(0)),
+                      {"c": pa.array([-3.5, 0.0, 7.0, None], pa.float64())},
+                      [-1.0, 0.0, 1.0, None], "sign")
+
+    def test_pmod(self):
+        # Spark pmod: ((a % n) + n) % n
+        _check_vector(fn("pmod", C(0), lit(3, DataType.INT32)),
+                      {"c": pa.array([10, -7, 0, None], pa.int32())},
+                      [1, 2, 0, None], "pmod+")
+        _check_vector(fn("pmod", C(0), lit(-3, DataType.INT32)),
+                      {"c": pa.array([7, -7], pa.int32())},
+                      [-2, -1], "pmod-")
+
+    def test_pow_sqrt_exp_log(self):
+        _check_vector(fn("pow", C(0), lit(10.0, DataType.FLOAT64)),
+                      {"c": pa.array([2.0, 0.0, None], pa.float64())},
+                      [1024.0, 0.0, None], "pow")
+        _check_vector(fn("sqrt", C(0)),
+                      {"c": pa.array([4.0, 0.0, -1.0, None], pa.float64())},
+                      [2.0, 0.0, math.nan, None], "sqrt")
+        _check_vector(fn("exp", C(0)),
+                      {"c": pa.array([0.0, 1.0, None], pa.float64())},
+                      [1.0, math.e, None], "exp")
+        # Spark ln/log of non-positive → NULL (not -inf/NaN)
+        _check_vector(fn("ln", C(0)),
+                      {"c": pa.array([math.e, 1.0, 0.0, -1.0, None],
+                                     pa.float64())},
+                      [1.0, 0.0, None, None, None], "ln")
+        _check_vector(fn("hypot", C(0), C(1)),
+                      {"a": pa.array([3.0, 0.0], pa.float64()),
+                       "b": pa.array([4.0, 0.0], pa.float64())},
+                      [5.0, 0.0], "hypot")
+
+    def test_factorial(self):
+        # Spark factorial: 0..20 only, else NULL
+        _check_vector(fn("factorial", C(0)),
+                      {"c": pa.array([0, 5, 20, 21, -1, None], pa.int32())},
+                      [1, 120, 2432902008176640000, None, None, None],
+                      "factorial")
+
+    def test_greatest_least_skip_nulls(self):
+        # Spark greatest/least SKIP nulls (unlike binary comparison);
+        # NaN is greatest
+        a = pa.array([1.0, None, float("nan"), None], pa.float64())
+        b = pa.array([2.0, 3.0, 1.0, None], pa.float64())
+        _check_vector(fn("greatest", C(0), C(1)), {"a": a, "b": b},
+                      [2.0, 3.0, math.nan, None], "greatest")
+        _check_vector(fn("least", C(0), C(1)), {"a": a, "b": b},
+                      [1.0, 3.0, 1.0, None], "least")
+
+    def test_isnan_nanvl(self):
+        # Spark IsNaN(NULL) is false, not null
+        _check_vector(fn("isnan", C(0)),
+                      {"c": pa.array([float("nan"), 1.0, None],
+                                     pa.float64())},
+                      [True, False, False], "isnan")
+        _check_vector(fn("nanvl", C(0), C(1)),
+                      {"a": pa.array([float("nan"), 1.0, None],
+                                     pa.float64()),
+                       "b": pa.array([5.0, 9.0, 2.0], pa.float64())},
+                      [5.0, 1.0, None], "nanvl")
+
+
+# ---------------------------------------------------------------------------
+# more strings
+# ---------------------------------------------------------------------------
+
+class TestMoreStringVectors:
+    def test_locate_position(self):
+        # locate(substr, str): 1-based, 0 when absent
+        _check_vector(fn("locate", lit("l", DataType.STRING), C(0)),
+                      {"c": pa.array(["hello", "world", "xyz", "", None])},
+                      [3, 4, 0, 0, None], "locate")
+        _check_vector(fn("position", lit("o", DataType.STRING), C(0)),
+                      {"c": pa.array(["hello world", "xyz"])},
+                      [5, 0], "position")
+
+    def test_repeat_initcap(self):
+        _check_vector(fn("repeat", C(0), lit(3, DataType.INT32)),
+                      {"c": pa.array(["ab", "", None])},
+                      ["ababab", "", None], "repeat")
+        _check_vector(fn("repeat", C(0), lit(0, DataType.INT32)),
+                      {"c": pa.array(["ab"])}, [""], "repeat0")
+        _check_vector(fn("initcap", C(0)),
+                      {"c": pa.array(["hello world", "hELLO", "a b", "",
+                                      None])},
+                      ["Hello World", "Hello", "A B", "", None], "initcap")
+
+    def test_concat_ws_skips_nulls(self):
+        # concat_ws skips null args (unlike concat which nulls out)
+        _check_vector(fn("concat_ws", lit("-", DataType.STRING), C(0), C(1)),
+                      {"a": pa.array(["a", None, "x", None]),
+                       "b": pa.array(["b", "c", None, None])},
+                      ["a-b", "c", "x", ""], "concat_ws")
+
+    def test_chr_ascii_char(self):
+        _check_vector(fn("chr", C(0)),
+                      {"c": pa.array([65, 97, 48, None], pa.int64())},
+                      ["A", "a", "0", None], "chr")
+        _check_vector(fn("char", C(0)),
+                      {"c": pa.array([66], pa.int64())}, ["B"], "char")
+
+    def test_base64_hex(self):
+        _check_vector(fn("base64", C(0)),
+                      {"c": pa.array(["abc", "", None])},
+                      ["YWJj", "", None], "base64")
+        _check_vector(fn("hex", C(0)),
+                      {"c": pa.array([255, 0, 16, None], pa.int64())},
+                      ["FF", "0", "10", None], "hex")
+
+    def test_crypto_known_answers(self):
+        # textbook digests of 'abc'
+        _check_vector(fn("md5", C(0)), {"c": pa.array(["abc", None])},
+                      ["900150983cd24fb0d6963f7d28e17f72", None], "md5")
+        _check_vector(fn("sha1", C(0)), {"c": pa.array(["abc"])},
+                      ["a9993e364706816aba3e25717850c26c9cd0d89d"], "sha1")
+        _check_vector(fn("sha2", C(0), lit(256, DataType.INT32)),
+                      {"c": pa.array(["abc"])},
+                      ["ba7816bf8f01cfea414140de5dae2223b00361a396177a"
+                       "9cb410ff61f20015ad"], "sha256")
+        _check_vector(fn("crc32", C(0)), {"c": pa.array(["abc", ""])},
+                      [891568578, 0], "crc32")
+
+    def test_substring_clamp_subtleties(self):
+        # start clamps to 0 only AFTER the end is computed: -10 over a
+        # 9-char string keeps one char, over a 5-char string keeps none
+        cases = [("spark sql", -10, 2, "s"), ("hello", -5, 2, "he"),
+                 ("hello", -4, 10, "ello"), ("hello", 1, 0, "")]
+        for s, p, ln, e in cases:
+            got = _run_expr(
+                fn("substring", C(0), lit(p, DataType.INT32),
+                   lit(ln, DataType.INT32)),
+                {"c": pa.array([s], pa.string())})
+            assert got[0] == e, (s, p, ln, got[0], e)
+            ASSERTIONS["n"] += 1
+
+    def test_char_length(self):
+        _check_vector(fn("char_length", C(0)),
+                      {"c": pa.array(["abc", "", None])},
+                      [3, 0, None], "char_length")
+
+
+# ---------------------------------------------------------------------------
+# more dates / timestamps
+# ---------------------------------------------------------------------------
+
+class TestMoreDateVectors:
+    def test_add_months(self):
+        # Spark clamps the day to the target month's end but does NOT
+        # preserve "last day" (unlike Hive): 2020-02-29 +1 → 2020-03-29
+        base = {"c": pa.array([datetime.date(2020, 1, 31),
+                               datetime.date(2020, 2, 29),
+                               datetime.date(2020, 11, 30), None],
+                              pa.date32())}
+        _check_vector(fn("add_months", C(0), lit(1, DataType.INT32)), base,
+                      [datetime.date(2020, 2, 29),
+                       datetime.date(2020, 3, 29),
+                       datetime.date(2020, 12, 30), None], "add_months")
+        _check_vector(fn("add_months", C(0), lit(-12, DataType.INT32)),
+                      base,
+                      [datetime.date(2019, 1, 31),
+                       datetime.date(2019, 2, 28),
+                       datetime.date(2019, 11, 30), None], "add_months-12")
+
+    def test_months_between(self):
+        # both-last-day and same-day cases are integral
+        _check_vector(
+            fn("months_between", C(0), C(1)),
+            {"a": pa.array([datetime.date(2020, 3, 15),
+                            datetime.date(2020, 2, 29), None],
+                           pa.date32()),
+             "b": pa.array([datetime.date(2020, 1, 15),
+                            datetime.date(2020, 1, 31),
+                            datetime.date(2020, 1, 1)], pa.date32())},
+            [2.0, 1.0, None], "months_between")
+
+    def test_next_day_weekofyear(self):
+        _check_vector(fn("next_day", C(0), lit("Sunday", DataType.STRING)),
+                      {"c": pa.array([datetime.date(2020, 1, 1),
+                                      datetime.date(2020, 1, 5), None],
+                                     pa.date32())},
+                      [datetime.date(2020, 1, 5),
+                       datetime.date(2020, 1, 12), None], "next_day")
+        # ISO weeks: 2016-01-01 is week 53 of 2015
+        _check_vector(fn("weekofyear", C(0)),
+                      {"c": pa.array([datetime.date(2020, 1, 1),
+                                      datetime.date(2016, 1, 1),
+                                      datetime.date(2020, 12, 31), None],
+                                     pa.date32())},
+                      [1, 53, 53, None], "weekofyear")
+
+    def test_dayofweek_quarter(self):
+        # dayofweek: 1 = Sunday
+        _check_vector(fn("dayofweek", C(0)),
+                      {"c": pa.array([datetime.date(2020, 1, 1),
+                                      datetime.date(2020, 1, 5),
+                                      datetime.date(2020, 1, 6), None],
+                                     pa.date32())},
+                      [4, 1, 2, None], "dayofweek")
+        _check_vector(fn("quarter", C(0)),
+                      {"c": pa.array([datetime.date(2020, 1, 1),
+                                      datetime.date(2020, 5, 1),
+                                      datetime.date(2020, 12, 31), None],
+                                     pa.date32())},
+                      [1, 2, 4, None], "quarter")
+
+    def test_make_date_to_date(self):
+        _check_vector(
+            fn("make_date", C(0), C(1), C(2)),
+            {"y": pa.array([2020, 2020, 2019, None], pa.int32()),
+             "m": pa.array([2, 13, 2, 1], pa.int32()),
+             "d": pa.array([29, 1, 29, 1], pa.int32())},
+            [datetime.date(2020, 2, 29), None, None, None], "make_date")
+        _check_vector(fn("to_date", C(0)),
+                      {"c": pa.array(["2020-01-01", "bad", "", None])},
+                      [datetime.date(2020, 1, 1), None, None, None],
+                      "to_date")
+
+    def test_date_format_from_unixtime(self):
+        _check_vector(
+            fn("date_format", C(0), lit("yyyy-MM-dd", DataType.STRING)),
+            {"c": pa.array([datetime.date(2020, 1, 5), None],
+                           pa.date32())},
+            ["2020-01-05", None], "date_format")
+        _check_vector(fn("from_unixtime", C(0)),
+                      {"c": pa.array([0, 86400, 86399, None], pa.int64())},
+                      ["1970-01-01 00:00:00", "1970-01-02 00:00:00",
+                       "1970-01-01 23:59:59", None], "from_unixtime")
+        _check_vector(fn("unix_timestamp", C(0)),
+                      {"c": pa.array(["1970-01-01 00:00:01"])},
+                      [1], "unix_timestamp")
+
+    def test_timestamp_fields(self):
+        ts = {"c": pa.array([datetime.datetime(2020, 1, 2, 13, 45, 59),
+                             datetime.datetime(1970, 1, 1, 0, 0, 0), None],
+                            pa.timestamp("us"))}
+        _check_vector(fn("hour", C(0)), ts, [13, 0, None], "hour")
+        _check_vector(fn("minute", C(0)), ts, [45, 0, None], "minute")
+        _check_vector(fn("second", C(0)), ts, [59, 0, None], "second")
+
+    def test_trunc_quarter_week(self):
+        base = {"c": pa.array([datetime.date(2020, 5, 20), None],
+                              pa.date32())}
+        _check_vector(fn("trunc", C(0), lit("QUARTER", DataType.STRING)),
+                      base, [datetime.date(2020, 4, 1), None], "truncQ")
+
+
+# ---------------------------------------------------------------------------
+# regexp + json
+# ---------------------------------------------------------------------------
+
+class TestRegexpJsonVectors:
+    def test_regexp_extract(self):
+        # no match → empty string (not null); null in → null out
+        _check_vector(
+            fn("regexp_extract", C(0), lit(r"(\d+)-(\d+)", DataType.STRING),
+               lit(1, DataType.INT32)),
+            {"c": pa.array(["100-200", "abc", "7-8", "", None])},
+            ["100", "", "7", "", None], "regexp_extract g1")
+        _check_vector(
+            fn("regexp_extract", C(0), lit(r"(\d+)-(\d+)", DataType.STRING),
+               lit(2, DataType.INT32)),
+            {"c": pa.array(["100-200"])}, ["200"], "regexp_extract g2")
+
+    def test_regexp_replace_rlike(self):
+        _check_vector(
+            fn("regexp_replace", C(0), lit(r"\d+", DataType.STRING),
+               lit("#", DataType.STRING)),
+            {"c": pa.array(["abc123x45", "none", "", None])},
+            ["abc#x#", "none", "", None], "regexp_replace")
+        _check_vector(fn("rlike", C(0), lit("^a.*c$", DataType.STRING)),
+                      {"c": pa.array(["abc", "ac", "bc", "abcd", None])},
+                      [True, True, False, False, None], "rlike")
+
+    def test_get_json_object(self):
+        col = {"c": pa.array(['{"a":1}', '{"a":"b"}', '{"x":2}',
+                              '{"a":{"b":7}}', "not json", None])}
+        _check_vector(fn("get_json_object", C(0),
+                         lit("$.a", DataType.STRING)), col,
+                      ["1", "b", None, '{"b":7}', None, None], "json $.a")
+        _check_vector(fn("get_json_object", C(0),
+                         lit("$.a.b", DataType.STRING)), col,
+                      [None, None, None, "7", None, None], "json $.a.b")
+
+    def test_json_array_length(self):
+        _check_vector(fn("json_array_length", C(0)),
+                      {"c": pa.array(["[1,2,3]", "[]", "nope", None])},
+                      [3, 0, None, None], "json_array_length")
+
+
+# ---------------------------------------------------------------------------
+# conditionals
+# ---------------------------------------------------------------------------
+
+class TestConditionalVectors:
+    def test_coalesce(self):
+        _check_vector(fn("coalesce", C(0), C(1)),
+                      {"a": pa.array([None, 5, None], pa.int64()),
+                       "b": pa.array([2, 9, None], pa.int64())},
+                      [2, 5, None], "coalesce")
+
+    def test_nullif(self):
+        _check_vector(fn("nullif", C(0), lit(1, DataType.INT64)),
+                      {"c": pa.array([1, 2, None], pa.int64())},
+                      [None, 2, None], "nullif")
+
+    def test_if(self):
+        _check_vector(
+            fn("if", ir.BinaryExpr(">", C(0), lit(0, DataType.INT64)),
+               lit("pos", DataType.STRING), lit("neg", DataType.STRING)),
+            {"c": pa.array([5, -5, 0], pa.int64())},
+            ["pos", "neg", "neg"], "if")
+
+    def test_case_when_null_condition_falls_through(self):
+        # CASE WHEN null-cond THEN ... falls through to ELSE
+        expr = ir.CaseWhen(
+            ((ir.BinaryExpr(">", C(0), lit(0, DataType.INT64)),
+              lit("pos", DataType.STRING)),),
+            otherwise=lit("other", DataType.STRING))
+        _check_vector(expr,
+                      {"c": pa.array([3, -3, None], pa.int64())},
+                      ["pos", "other", "other"], "case_when")
+
+
+# ---------------------------------------------------------------------------
+# arrays + maps
+# ---------------------------------------------------------------------------
+
+class TestArrayMapVectors:
+    LCOL = None
+
+    def _l(self):
+        return {"c": pa.array([[3, 1, 2], [], None, [5, None]],
+                              pa.list_(pa.int64()))}
+
+    def test_size_cardinality(self):
+        # default (legacy sizeOfNull): size(NULL) = -1
+        _check_vector(fn("size", C(0)), self._l(),
+                      [3, 0, -1, 2], "size")
+        _check_vector(fn("cardinality", C(0)), self._l(),
+                      [3, 0, -1, 2], "cardinality")
+
+    def test_array_contains_three_valued(self):
+        # no match + null element present → NULL, not false
+        _check_vector(fn("array_contains", C(0),
+                         lit(1, DataType.INT64)), self._l(),
+                      [True, False, None, None], "array_contains 1")
+        _check_vector(fn("array_contains", C(0),
+                         lit(5, DataType.INT64)), self._l(),
+                      [False, False, None, True], "array_contains 5")
+
+    def test_array_contains_nan_needle(self):
+        # Spark's ArrayContains compares with NaN == NaN semantics
+        _check_vector(
+            fn("array_contains", C(0), lit(math.nan, DataType.FLOAT64)),
+            {"c": pa.array([[math.nan, 1.0], [1.0, 2.0]],
+                           pa.list_(pa.float64()))},
+            [True, False], "array_contains NaN")
+
+    def test_element_at_array(self):
+        # 1-based; negative counts from the end; out of range → NULL
+        _check_vector(fn("element_at", C(0), lit(1, DataType.INT32)),
+                      self._l(), [3, None, None, 5], "element_at 1")
+        _check_vector(fn("element_at", C(0), lit(-1, DataType.INT32)),
+                      self._l(), [2, None, None, None], "element_at -1")
+        _check_vector(fn("element_at", C(0), lit(9, DataType.INT32)),
+                      self._l(), [None, None, None, None], "element_at 9")
+
+    def test_array_min_max_position(self):
+        _check_vector(fn("array_min", C(0)), self._l(),
+                      [1, None, None, 5], "array_min")
+        _check_vector(fn("array_max", C(0)), self._l(),
+                      [3, None, None, 5], "array_max")
+        _check_vector(fn("array_position", C(0), lit(2, DataType.INT64)),
+                      self._l(), [3, 0, None, 0], "array_position")
+
+    def test_sort_array_repeat(self):
+        _check_vector(fn("sort_array", C(0)), self._l(),
+                      [[1, 2, 3], [], None, [None, 5]], "sort_array")
+        _check_vector(fn("array_repeat", C(0), lit(3, DataType.INT32)),
+                      {"c": pa.array([7, None], pa.int64())},
+                      [[7, 7, 7], [None, None, None]], "array_repeat")
+
+    def test_map_family(self):
+        m = {"c": pa.array([[(1, 10), (2, 20)], []],
+                           pa.map_(pa.int64(), pa.int64()))}
+        _check_vector(fn("map_keys", C(0)), m, [[1, 2], []], "map_keys")
+        _check_vector(fn("map_values", C(0)), m, [[10, 20], []],
+                      "map_values")
+        _check_vector(fn("map_contains_key", C(0), lit(1, DataType.INT64)),
+                      m, [True, False], "map_contains_key")
+        _check_vector(fn("element_at", C(0), lit(2, DataType.INT64)),
+                      m, [20, None], "element_at map")
+        _check_vector(fn("size", C(0)), m, [2, 0], "map size")
+
+
 def test_assertion_floor():
     """The battery above must keep covering 500+ borrowed assertions —
     run last (alphabetical classes first, functions after)."""
     # Each _check_vector row and explicit assert bumps the counter; the
     # floor guards against silently shrinking coverage.
-    assert ASSERTIONS["n"] >= 260, ASSERTIONS["n"]
+    if ASSERTIONS["n"] == 0:
+        pytest.skip("battery deselected (-k): nothing to measure")
+    assert ASSERTIONS["n"] >= 500, ASSERTIONS["n"]
