@@ -211,24 +211,29 @@ def _round_half_up(x, digits):
     return jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5) / factor
 
 
-def _round_digits(expr) -> int:
+def _round_digits(expr):
     """Static digits argument of round/bround, read from the EXPRESSION
     (Spark requires a foldable scale): reading the evaluated arg would
-    trace a device value and crash under jit."""
+    trace a device value and crash under jit. A typed-NULL scale returns
+    None (round(x, NULL) is NULL in Spark, not an error)."""
     if len(expr.args) <= 1:
         return 0
     a = expr.args[1]
     if not isinstance(a, ir.Literal):
         raise NotImplementedError(
             f"{expr.name}: the scale argument must be a literal")
-    return int(a.value)
+    return None if a.value is None else int(a.value)
 
 
 @register("round")
 def _round(args, expr, batch, schema, ctx):
     """Spark round: HALF_UP (reference: spark_bround.rs / spark_round)."""
     v = args[0]
-    digits = int(_round_digits(expr))
+    digits = _round_digits(expr)
+    if digits is None:
+        return TypedValue(PrimitiveColumn(v.data,
+                                          jnp.zeros_like(v.validity)),
+                          v.dtype, v.precision, v.scale)
     if v.dtype == DataType.DECIMAL:
         shift = v.scale - digits
         if shift <= 0:
@@ -249,7 +254,11 @@ def _round(args, expr, batch, schema, ctx):
 def _bround(args, expr, batch, schema, ctx):
     """Spark bround: HALF_EVEN (banker's rounding)."""
     v = args[0]
-    digits = int(_round_digits(expr))
+    digits = _round_digits(expr)
+    if digits is None:
+        return TypedValue(PrimitiveColumn(v.data,
+                                          jnp.zeros_like(v.validity)),
+                          v.dtype, v.precision, v.scale)
     if v.dtype.is_integer:
         return v
     factor = 10.0 ** digits

@@ -12,21 +12,49 @@ client, another language — can speak.
 Wire format (all integers little-endian):
 
     frame  := u8 kind | u32 len | payload[len]
-    kinds  : 1 SUBMIT   client→server  TaskDefinition protobuf bytes
-             2 BATCH    server→client  one Arrow IPC stream holding one
-                                       RecordBatch (self-describing)
-             3 DONE     server→client  metrics JSON (finalize)
-             4 ERROR    server→client  utf-8 traceback; terminates task
-             5 SHUTDOWN client→server  stop serving (tests/admin)
+    kinds  : 1 SUBMIT      client→server  TaskDefinition protobuf bytes
+             2 BATCH       server→client  one Arrow IPC stream holding one
+                                          RecordBatch (self-describing)
+             3 DONE        server→client  JSON {metrics, schema_ipc b64,
+                                          report?} — schema always present
+                                          so empty results stay typed
+             4 ERROR       server→client  utf-8 traceback; terminates task
+             5 SHUTDOWN    client→server  stop serving (tests/admin)
+             6 SUBMIT_PLAN client→server  JSON {plan: Spark plan.toJSON
+                                          tree, path_rewrites?, partition_id?,
+                                          num_partitions?, spark_version?} —
+                                          the engine converts AND executes,
+                                          the live-attach composition the
+                                          reference does in
+                                          AuronConverters.scala:209-310 +
+                                          JniBridge.callNative
+             7 ACK         client→server  consumed one BATCH (flow control)
+             8 CANCEL      client→server  tear down the running task
+             9 NEED_TABLES server→client  JSON [{table, exec, columns}] —
+                                          unconvertible subtrees the host
+                                          must execute (ConvertToNative
+                                          boundary, AuronConvertStrategy)
+            10 TABLE       client→server  u32 name_len | name | Arrow IPC
+                                          stream with the subtree's rows
 
-One SUBMIT per connection mirrors the per-task lifecycle of the
-reference (each Spark task owns one native execution runtime).
+Flow control mirrors rt.rs's bound-1 sync channel, generalized to a
+window: the server keeps at most ``window`` un-ACKed BATCH frames in
+flight, so a slow host applies backpressure instead of unbounded socket
+buffering. A CANCEL frame — or the client closing the socket — stops the
+producer within one batch (reference: is_task_running checks,
+rt.rs:208-238).
+
+One SUBMIT/SUBMIT_PLAN per connection mirrors the per-task lifecycle of
+the reference (each Spark task owns one native execution runtime).
 """
 
 from __future__ import annotations
 
+import base64
 import io
 import json
+import os
+import queue
 import socket
 import socketserver
 import struct
@@ -40,6 +68,16 @@ KIND_BATCH = 2
 KIND_DONE = 3
 KIND_ERROR = 4
 KIND_SHUTDOWN = 5
+KIND_SUBMIT_PLAN = 6
+KIND_ACK = 7
+KIND_CANCEL = 8
+KIND_NEED_TABLES = 9
+KIND_TABLE = 10
+
+#: max un-ACKed BATCH frames in flight (rt.rs uses a bound-1 channel; a
+#: small window amortizes the network round trip without losing the
+#: backpressure property)
+DEFAULT_WINDOW = 4
 
 _HDR = struct.Struct("<BI")
 
@@ -71,12 +109,32 @@ def _ipc_bytes(rb: pa.RecordBatch) -> bytes:
     return out.getvalue()
 
 
+def _ipc_table(data: bytes) -> pa.Table:
+    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+        return r.read_all()
+
+
 def _ipc_batch(data: bytes) -> pa.RecordBatch:
     with pa.ipc.open_stream(io.BytesIO(data)) as r:
         return next(iter(r))
 
 
+def _schema_ipc_b64(schema: pa.Schema) -> str:
+    return base64.b64encode(schema.serialize().to_pybytes()).decode()
+
+
+def _schema_from_b64(b64: str) -> pa.Schema:
+    return pa.ipc.read_schema(pa.py_buffer(base64.b64decode(b64)))
+
+
 class _TaskHandler(socketserver.BaseRequestHandler):
+    def setup(self):
+        self._cancel = threading.Event()
+        self._window = threading.Semaphore(
+            getattr(self.server, "window", DEFAULT_WINDOW))
+        self._tables: queue.Queue = queue.Queue()
+        self._reader = None
+
     def handle(self):
         try:
             kind, payload = read_frame(self.request)
@@ -87,42 +145,160 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             threading.Thread(target=self.server.shutdown,
                              daemon=True).start()
             return
-        if kind != KIND_SUBMIT:
+        if kind not in (KIND_SUBMIT, KIND_SUBMIT_PLAN):
             write_frame(self.request, KIND_ERROR,
                         f"expected SUBMIT, got kind={kind}".encode())
             return
+        # from here on, all socket READS belong to the control-reader
+        # thread (ACK / CANCEL / TABLE / disconnect); the handler only
+        # writes
+        self._reader = threading.Thread(target=self._control_reader,
+                                        daemon=True)
+        self._reader.start()
         try:
-            self._run_task(payload)
+            if kind == KIND_SUBMIT:
+                self._run_task(payload)
+            else:
+                self._run_plan_task(payload)
+        except _Cancelled:
+            self.server.stats["cancelled"] += 1
         except Exception:
             try:
                 write_frame(self.request, KIND_ERROR,
                             traceback.format_exc(limit=12).encode())
             except OSError:
                 pass
+        finally:
+            self._cancel.set()   # unblocks the reader on close
+
+    # -- control plane -----------------------------------------------------
+
+    def _control_reader(self):
+        """Reads client frames while the task runs: ACK releases window
+        slots, CANCEL / disconnect stop the producer, TABLE feeds
+        fallback-boundary rows."""
+        try:
+            while not self._cancel.is_set():
+                kind, payload = read_frame(self.request)
+                if kind == KIND_ACK:
+                    self._window.release()
+                elif kind == KIND_CANCEL:
+                    return
+                elif kind == KIND_TABLE:
+                    (nlen,) = struct.unpack("<I", payload[:4])
+                    name = payload[4:4 + nlen].decode()
+                    self._tables.put((name, _ipc_table(payload[4 + nlen:])))
+                else:
+                    return   # protocol violation: treat as disconnect
+        except Exception:
+            pass   # malformed frame / peer went away: stop computing
+        finally:
+            # EVERY reader exit must cancel: a live handler with a dead
+            # reader would otherwise spin on the window semaphore forever
+            self._cancel.set()
+
+    def _send_batch(self, rb: pa.RecordBatch) -> None:
+        """Backpressured BATCH send; raises _Cancelled when the client
+        cancelled or disconnected instead of writing into the void."""
+        while not self._window.acquire(timeout=0.1):
+            if self._cancel.is_set():
+                raise _Cancelled()
+        if self._cancel.is_set():
+            raise _Cancelled()
+        try:
+            write_frame(self.request, KIND_BATCH, _ipc_bytes(rb))
+            self.server.stats["batches_sent"] += 1
+        except OSError:
+            raise _Cancelled()
+
+    # -- task execution ----------------------------------------------------
 
     def _run_task(self, task_bytes: bytes) -> None:
+        from auron_tpu.ir.planner import PlannerContext
+        self._execute(task_bytes, PlannerContext(), report=None)
+
+    def _run_plan_task(self, payload: bytes) -> None:
+        """SUBMIT_PLAN: convert a raw Spark plan.toJSON tree server-side,
+        source any ConvertToNative boundaries from the client, execute."""
+        from auron_tpu.integration.spark_converter import SparkPlanConverter
+        from auron_tpu.ir import pb
+        from auron_tpu.ir.planner import PlannerContext
+        req = json.loads(payload.decode())
+        rewrites = req.get("path_rewrites") or {}
+
+        def rewrite(p):
+            return rewrites.get(p) or rewrites.get(os.path.basename(p), p)
+
+        conv = SparkPlanConverter(
+            path_rewrite=rewrite,
+            spark_version=req.get("spark_version", "3.5.0"))
+        node, report = conv.convert(req["plan"])
+
+        catalog = {}
+        if report.boundaries:
+            need = [{"table": t, "exec": cls,
+                     "columns": [a.name for a in attrs]}
+                    for t, cls, attrs in report.boundaries]
+            write_frame(self.request, KIND_NEED_TABLES,
+                        json.dumps(need).encode())
+            for _ in need:
+                while True:
+                    try:
+                        name, tbl = self._tables.get(timeout=0.1)
+                        break
+                    except queue.Empty:
+                        if self._cancel.is_set():
+                            raise _Cancelled()
+                catalog[name] = tbl
+
+        task_bytes = pb.TaskDefinition(
+            plan=node,
+            partition_id=int(req.get("partition_id", 0)),
+            num_partitions=int(req.get("num_partitions", 1)),
+        ).SerializeToString()
+        self._execute(task_bytes, PlannerContext(catalog=catalog),
+                      report={"converted": len(report.tags)
+                              - len(report.never_converted),
+                              "fallbacks": [
+                                  {"exec": cls, "reason": reason}
+                                  for cls, reason in
+                                  report.never_converted],
+                              "summary": report.summary()})
+
+    def _execute(self, task_bytes: bytes, planner_ctx, report) -> None:
         # imported lazily so the server process controls jax platform
         # selection before anything initializes a backend
-        from auron_tpu.columnar.arrow_bridge import to_arrow
+        from auron_tpu.columnar.arrow_bridge import (schema_to_arrow,
+                                                     to_arrow)
         from auron_tpu.ir import pb
-        from auron_tpu.ir.planner import PlannerContext, plan_from_bytes
+        from auron_tpu.ir.planner import plan_from_bytes
         from auron_tpu.runtime.executor import (ExecutionRuntime,
                                                 TaskDefinition)
         task = pb.TaskDefinition()
         task.ParseFromString(task_bytes)
-        op = plan_from_bytes(task_bytes, PlannerContext())
+        op = plan_from_bytes(task_bytes, planner_ctx)
         rt = ExecutionRuntime(
             op, TaskDefinition(partition_id=task.partition_id,
                                num_partitions=task.num_partitions or 1,
                                stage_id=task.stage_id,
                                task_id=task.task_id))
         for batch in rt.batches():
+            if self._cancel.is_set():
+                raise _Cancelled()
             rb = to_arrow(batch, op.schema())
             if rb.num_rows:
-                write_frame(self.request, KIND_BATCH, _ipc_bytes(rb))
+                self._send_batch(rb)
         metrics = rt.finalize()
+        done = {"metrics": metrics,
+                "schema_ipc": _schema_ipc_b64(schema_to_arrow(op.schema()))}
+        if report is not None:
+            done["report"] = report
         write_frame(self.request, KIND_DONE,
-                    json.dumps(metrics, default=str).encode())
+                    json.dumps(done, default=str).encode())
+
+
+class _Cancelled(Exception):
+    pass
 
 
 class AuronServer(socketserver.ThreadingTCPServer):
@@ -133,9 +309,12 @@ class AuronServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 window: int = DEFAULT_WINDOW):
         super().__init__((host, port), _TaskHandler)
         self._shutdown_requested = False
+        self.window = window
+        self.stats = {"batches_sent": 0, "cancelled": 0}
 
     @property
     def address(self) -> tuple[str, int]:
@@ -157,21 +336,75 @@ class AuronClient:
 
     def execute(self, task_bytes: bytes):
         """Submit one TaskDefinition; returns (pa.Table, metrics dict).
+        Empty results return a typed empty table (schema rides DONE).
         Raises RuntimeError with the remote traceback on engine errors."""
-        batches, metrics = [], None
-        for kind, payload in self.stream(task_bytes):
-            if kind == KIND_BATCH:
-                batches.append(_ipc_batch(payload))
-            else:
-                metrics = json.loads(payload.decode())
+        tbl, done = self._drive(KIND_SUBMIT, task_bytes, None)
+        return tbl, done.get("metrics", done)
+
+    def execute_plan(self, plan, path_rewrites=None, partition_id: int = 0,
+                     num_partitions: int = 1, spark_version: str = "3.5.0",
+                     fallback_provider=None):
+        """Live attach: submit a raw Spark ``plan.toJSON`` tree (parsed
+        JSON list/dict). The engine converts it server-side; when the
+        conversion hits unconvertible subtrees it asks back for their
+        rows, sourced from ``fallback_provider(table, exec_class,
+        columns) -> pa.Table`` (the role NativeHelper/ConvertToNativeExec
+        plays host-side in the reference).
+
+        Returns (pa.Table, done dict) where done carries metrics plus the
+        conversion report (fallbacks + summary)."""
+        req = {"plan": plan, "partition_id": partition_id,
+               "num_partitions": num_partitions,
+               "spark_version": spark_version}
+        if path_rewrites:
+            req["path_rewrites"] = dict(path_rewrites)
+        return self._drive(KIND_SUBMIT_PLAN, json.dumps(req).encode(),
+                           fallback_provider)
+
+    def _drive(self, kind: int, payload: bytes, fallback_provider):
+        batches, done = [], None
+        with socket.create_connection(self.addr,
+                                      timeout=self.timeout_s) as s:
+            write_frame(s, kind, payload)
+            while True:
+                fkind, fpayload = read_frame(s)
+                if fkind == KIND_ERROR:
+                    raise RuntimeError("engine error:\n"
+                                       + fpayload.decode())
+                if fkind == KIND_BATCH:
+                    batches.append(_ipc_batch(fpayload))
+                    write_frame(s, KIND_ACK, b"")
+                elif fkind == KIND_NEED_TABLES:
+                    need = json.loads(fpayload.decode())
+                    if fallback_provider is None:
+                        raise RuntimeError(
+                            "engine requested fallback tables "
+                            f"{[n['table'] for n in need]} but no "
+                            "fallback_provider was given")
+                    for ent in need:
+                        tbl = fallback_provider(ent["table"], ent["exec"],
+                                                ent["columns"])
+                        name = ent["table"].encode()
+                        sink = io.BytesIO()
+                        with pa.ipc.new_stream(sink, tbl.schema) as w:
+                            w.write_table(tbl)
+                        write_frame(s, KIND_TABLE,
+                                    struct.pack("<I", len(name)) + name
+                                    + sink.getvalue())
+                elif fkind == KIND_DONE:
+                    done = json.loads(fpayload.decode())
+                    break
         if batches:
             tbl = pa.Table.from_batches(batches)
+        elif done and done.get("schema_ipc"):
+            tbl = _schema_from_b64(done["schema_ipc"]).empty_table()
         else:
             tbl = None
-        return tbl, metrics
+        return tbl, done
 
     def stream(self, task_bytes: bytes):
-        """Yield (kind, payload) frames for one task submission."""
+        """Yield (kind, payload) frames for one task submission, ACKing
+        each BATCH (legacy-shaped helper used by tests)."""
         with socket.create_connection(self.addr,
                                       timeout=self.timeout_s) as s:
             write_frame(s, KIND_SUBMIT, task_bytes)
@@ -180,6 +413,8 @@ class AuronClient:
                 if kind == KIND_ERROR:
                     raise RuntimeError("engine error:\n"
                                        + payload.decode())
+                if kind == KIND_BATCH:
+                    write_frame(s, KIND_ACK, b"")
                 yield kind, payload
                 if kind == KIND_DONE:
                     return
@@ -193,13 +428,12 @@ def serve_main(argv=None) -> int:
     """``python -m auron_tpu.runtime.serving --port N`` — run a serving
     engine process (prints the bound port for the parent to scrape)."""
     import argparse
-    import os
-    import sys
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW)
     args = ap.parse_args(argv)
-    srv = AuronServer(args.host, args.port)
+    srv = AuronServer(args.host, args.port, window=args.window)
     print(f"AURON_SERVING {srv.address[0]}:{srv.address[1]}", flush=True)
     try:
         srv.serve_forever()
