@@ -180,6 +180,12 @@ def evaluate(expr: ir.Expr, batch: DeviceBatch, schema: Schema,
         f = schema[expr.index]
         return TypedValue(batch.columns[expr.index], f.dtype, f.precision, f.scale)
 
+    if isinstance(expr, ir.ScalarSubquery):
+        raise RuntimeError(
+            "unresolved scalar subquery reached evaluation — plans with "
+            "subqueries must go through plan_from_bytes / the DataFrame "
+            "API (ScalarSubqueryBinderOp substitutes the value)")
+
     if isinstance(expr, ir.Literal):
         if expr.dtype == DataType.DECIMAL and expr.precision > 18:
             from auron_tpu.columnar.decimal128 import (Decimal128Column,
@@ -326,6 +332,8 @@ def infer_dtype(expr: ir.Expr, schema: Schema) -> tuple[DataType, int, int]:
     if isinstance(expr, ir.Negative):
         return infer_dtype(expr.child, schema)
     if isinstance(expr, ir.Cast):
+        return expr.dtype, expr.precision, expr.scale
+    if isinstance(expr, ir.ScalarSubquery):
         return expr.dtype, expr.precision, expr.scale
     if isinstance(expr, ir.CaseWhen):
         if expr.when_then:

@@ -231,8 +231,9 @@ def _round(args, expr, batch, schema, ctx):
     v = args[0]
     digits = _round_digits(expr)
     if digits is None:
-        return TypedValue(PrimitiveColumn(v.data,
-                                          jnp.zeros_like(v.validity)),
+        # all-null result of the input's own column type (wide decimals
+        # are limb pairs, not .data columns)
+        return TypedValue(v.col.with_validity(jnp.zeros_like(v.validity)),
                           v.dtype, v.precision, v.scale)
     if v.dtype == DataType.DECIMAL:
         shift = v.scale - digits
@@ -256,8 +257,7 @@ def _bround(args, expr, batch, schema, ctx):
     v = args[0]
     digits = _round_digits(expr)
     if digits is None:
-        return TypedValue(PrimitiveColumn(v.data,
-                                          jnp.zeros_like(v.validity)),
+        return TypedValue(v.col.with_validity(jnp.zeros_like(v.validity)),
                           v.dtype, v.precision, v.scale)
     if v.dtype.is_integer:
         return v

@@ -213,6 +213,22 @@ class GetStructField(Expr):
 
 
 @dataclass(frozen=True)
+class ScalarSubquery(Expr):
+    """Uncorrelated scalar subquery: ``plan_bytes`` is a serialized
+    PlanNode executed ONCE at task start; its single value is substituted
+    as a Literal before any kernel builds (reference:
+    datafusion-ext-exprs/src/spark_scalar_subquery_wrapper.rs — there the
+    value comes back from the JVM, here the engine runs the child plan).
+    Held as bytes so the expr tree stays hashable for kernel caching; it
+    has NO expr children (the plan is opaque at this level)."""
+    plan_bytes: bytes
+    dtype: DataType
+    precision: int = 0
+    scale: int = 0
+    sid: int = 0
+
+
+@dataclass(frozen=True)
 class RowNum(Expr):
     """Monotonic row number within the partition stream (reference:
     datafusion-ext-exprs/src/row_num.rs)."""

@@ -10,5 +10,5 @@ host-fallback boundary (`map_batches` — the ConvertToNative/C2R analogue).
 """
 
 from auron_tpu.frontend.dataframe import (DataFrame, col, lit,  # noqa: F401
-                                          functions)
+                                          functions, scalar_subquery)
 from auron_tpu.frontend.session import Session  # noqa: F401

@@ -215,7 +215,28 @@ def resolve(c: Col, schema: Schema) -> ir.Expr:
         fn, dt, p, s = udf_registry.lookup_udf(registry_name)
         return ir.HostUDF(fn, tuple(resolve(a, schema) for a in args),
                           dt, registry_name)
+    if tag == "subquery":
+        _, plan_bytes, dtype, p, s, sid = n
+        return ir.ScalarSubquery(plan_bytes, dtype, p, s, sid)
     raise NotImplementedError(f"cannot resolve column node {tag!r}")
+
+
+_SUBQUERY_IDS = iter(range(1, 1 << 30))
+
+
+def scalar_subquery(df) -> Col:
+    """An uncorrelated scalar subquery over a single-column DataFrame:
+    the plan executes once per task and its one value becomes a literal
+    (Spark's ScalarSubquery; 0 rows → NULL, >1 rows → runtime error).
+    Correlated subqueries must still be rewritten as joins — exactly as
+    Spark's own optimizer does before the physical plan exists."""
+    if len(df.schema) != 1:
+        raise ValueError(
+            f"scalar subquery must produce exactly one column, got "
+            f"{[f.name for f in df.schema]}")
+    f = df.schema[0]
+    return Col(("subquery", df.plan.SerializeToString(), f.dtype,
+                f.precision, f.scale, next(_SUBQUERY_IDS)))
 
 
 class _Functions:

@@ -53,6 +53,7 @@ class SparkShims:
         #: nothing currently branches on it; a genuinely incompatible
         #: future difference gates here with `self.version >= V(x, y)`.
         self.version = SemanticVersion.parse(version)
+        self.version_str = version
 
         #: plan nodes that wrap a single child transparently — both AQE
         #: reader spellings accepted (renamed in 3.2:

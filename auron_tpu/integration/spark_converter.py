@@ -174,11 +174,14 @@ _LITERAL_ARGS = {
 
 
 class ExprConverter:
-    def __init__(self, attrs: list[Attr], shims=None):
+    def __init__(self, attrs: list[Attr], shims=None, plan_converter=None):
         from auron_tpu.integration.shims import SparkShims
         self.index_of = {a.expr_id: i for i, a in enumerate(attrs)}
         self.attrs = attrs
         self.shims = shims or SparkShims()
+        # callback converting an embedded Spark plan (toJSON tree) into a
+        # pb.PlanNode — used by ScalarSubquery expressions
+        self.plan_converter = plan_converter
 
     def convert(self, e: SparkNode) -> pb.ExprNode:
         cls = e.simple_name
@@ -265,6 +268,22 @@ class ExprConverter:
             return pb.ExprNode(get_struct_field=pb.GetStructFieldE(
                 child=self.convert(e.children[0]),
                 ordinal=int(e.fields.get("ordinal", 0))))
+        if cls == "ScalarSubquery":
+            # uncorrelated scalar subquery: Spark embeds the subquery's
+            # physical plan; it executes once and acts as a constant
+            # (reference: spark_scalar_subquery_wrapper.rs)
+            sub = e.fields.get("plan")
+            if sub is None or self.plan_converter is None:
+                raise NotImplementedError(
+                    "ScalarSubquery without an embedded plan")
+            node = self.plan_converter(sub)
+            dt, p, s = _dtype_to_proto(str(e.fields.get("dataType", "")))
+            sid = 0
+            eid = e.fields.get("exprId")
+            if isinstance(eid, dict):
+                sid = int(eid.get("id", 0))
+            return pb.ExprNode(scalar_subquery=pb.ScalarSubqueryE(
+                plan=node, dtype=dt, precision=p, scale=s, sid=sid))
         raise NotImplementedError(f"unsupported Spark expression {cls}")
 
     def _literal(self, e: SparkNode) -> pb.ExprNode:
@@ -372,6 +391,21 @@ class SparkPlanConverter:
         return pb.TaskDefinition(plan=node,
                                  partition_id=partition_id).SerializeToString()
 
+    def _convert_subplan(self, plan) -> pb.PlanNode:
+        """Convert a plan embedded inside an expression (ScalarSubquery).
+        Runs a FRESH converter sharing rewrite/shims: the subquery's
+        tags/fallbacks must not pollute this plan's report, and an
+        unconvertible subquery falls back as a whole via the raised
+        NotImplementedError."""
+        sub = SparkPlanConverter(path_rewrite=self.path_rewrite,
+                                 spark_version=self.shims.version_str)
+        node, report = sub.convert(plan)
+        if report.never_converted:
+            raise NotImplementedError(
+                "unconvertible subquery plan: "
+                + "; ".join(r for _c, r in report.never_converted))
+        return node
+
     # -- dispatch with tagging ---------------------------------------------
 
     def _convert(self, node: SparkNode) -> _Converted:
@@ -461,7 +495,7 @@ class SparkPlanConverter:
 
     def _c_FilterExec(self, node: SparkNode) -> _Converted:
         child = self._convert(node.children[0])
-        ec = ExprConverter(child.attrs, self.shims)
+        ec = ExprConverter(child.attrs, self.shims, self._convert_subplan)
         cond = node.field_tree("condition")
         n = pb.PlanNode(filter=pb.FilterNode(
             child=child.node, predicates=[ec.convert(cond)]))
@@ -469,7 +503,7 @@ class SparkPlanConverter:
 
     def _project(self, child: _Converted,
                  project_list: list) -> _Converted:
-        ec = ExprConverter(child.attrs, self.shims)
+        ec = ExprConverter(child.attrs, self.shims, self._convert_subplan)
         exprs, names, attrs = [], [], []
         for t in project_list:
             exprs.append(ec.convert(t))
@@ -490,7 +524,7 @@ class SparkPlanConverter:
 
     def _c_SortExec(self, node: SparkNode) -> _Converted:
         child = self._convert(node.children[0])
-        ec = ExprConverter(child.attrs, self.shims)
+        ec = ExprConverter(child.attrs, self.shims, self._convert_subplan)
         orders = [ec.sort_order(t) for t in node.field_trees("sortOrder")]
         n = pb.PlanNode(sort=pb.SortNode(child=child.node,
                                          sort_orders=orders, fetch=-1))
@@ -498,7 +532,7 @@ class SparkPlanConverter:
 
     def _c_TakeOrderedAndProjectExec(self, node: SparkNode) -> _Converted:
         child = self._convert(node.children[0])
-        ec = ExprConverter(child.attrs, self.shims)
+        ec = ExprConverter(child.attrs, self.shims, self._convert_subplan)
         orders = [ec.sort_order(t) for t in node.field_trees("sortOrder")]
         limit = int(node.fields.get("limit", -1))
         # global top-k: map-side SortNode(fetch=k) per partition so only
@@ -574,7 +608,7 @@ class SparkPlanConverter:
 
     def _c_ShuffleExchangeExec(self, node: SparkNode) -> _Converted:
         child = self._convert(node.children[0])
-        ec = ExprConverter(child.attrs, self.shims)
+        ec = ExprConverter(child.attrs, self.shims, self._convert_subplan)
         ptree = node.field_tree("outputPartitioning")
         part, n_out = self._partitioning(ptree, ec)
         n = pb.PlanNode(shuffle_writer=pb.ShuffleWriterNode(
@@ -612,7 +646,8 @@ class SparkPlanConverter:
             raise NotImplementedError("BuildLeft broadcast join")
         left = self._convert(node.children[0])
         right = self._convert(node.children[1])
-        lec, rec = ExprConverter(left.attrs, self.shims), ExprConverter(right.attrs, self.shims)
+        lec, rec = (ExprConverter(left.attrs, self.shims, self._convert_subplan),
+                    ExprConverter(right.attrs, self.shims, self._convert_subplan))
         lk = [lec.convert(t) for t in node.field_trees("leftKeys")]
         rk = [rec.convert(t) for t in node.field_trees("rightKeys")]
         n = pb.PlanNode(hash_join=pb.HashJoinNode(
@@ -627,7 +662,8 @@ class SparkPlanConverter:
         jt = self._join_common(node)
         left = self._convert(node.children[0])
         right = self._convert(node.children[1])
-        lec, rec = ExprConverter(left.attrs, self.shims), ExprConverter(right.attrs, self.shims)
+        lec, rec = (ExprConverter(left.attrs, self.shims, self._convert_subplan),
+                    ExprConverter(right.attrs, self.shims, self._convert_subplan))
         lk = [lec.convert(t) for t in node.field_trees("leftKeys")]
         rk = [rec.convert(t) for t in node.field_trees("rightKeys")]
         n = pb.PlanNode(sort_merge_join=pb.SortMergeJoinNode(
@@ -679,7 +715,7 @@ class SparkPlanConverter:
     def _c_HashAggregateExec(self, node: SparkNode) -> _Converted:
         child = self._convert(node.children[0])
         groups, agg_exprs, mode = self._agg_parts(node)
-        ec = ExprConverter(child.attrs, self.shims)
+        ec = ExprConverter(child.attrs, self.shims, self._convert_subplan)
         group_names = [g.fields.get("name", f"k{i}")
                        for i, g in enumerate(groups)]
 

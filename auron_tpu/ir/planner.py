@@ -61,6 +61,12 @@ class PhysicalPlanner:
     # -- entry points -------------------------------------------------------
 
     def plan_task(self, task: pb.TaskDefinition) -> PhysicalOp:
+        if _collect_subqueries(task.plan):
+            # resolve every uncorrelated scalar subquery in the tree ONCE
+            # at task start, then re-plan with literals substituted
+            # (reference: spark_scalar_subquery_wrapper.rs role)
+            from auron_tpu.ops.subquery import ScalarSubqueryBinderOp
+            return ScalarSubqueryBinderOp(task.plan, self.ctx)
         return self.create_plan(task.plan)
 
     def create_plan(self, node: pb.PlanNode) -> PhysicalOp:
@@ -352,3 +358,49 @@ def plan_from_bytes(data: bytes,
     `callNative` entry analogue (reference: auron/src/exec.rs:42-118)."""
     task = pb.TaskDefinition.FromString(data)
     return PhysicalPlanner(ctx).plan_task(task)
+
+
+def _collect_subqueries(msg) -> list:
+    """All ScalarSubqueryE messages reachable from ``msg`` (any proto
+    node), outermost occurrences only — a subquery's own plan is scanned
+    again when IT is planned."""
+    found = []
+    for fd, val in msg.ListFields():
+        if fd.type != fd.TYPE_MESSAGE:
+            continue
+        vals = val if fd.is_repeated else [val]
+        for v in vals:
+            if isinstance(v, pb.ExprNode) \
+                    and v.WhichOneof("expr") == "scalar_subquery":
+                found.append(v.scalar_subquery)
+            elif isinstance(v, pb.ScalarSubqueryE):
+                continue   # do not descend into the subquery's own plan
+            else:
+                found.extend(_collect_subqueries(v))
+    return found
+
+
+def substitute_subqueries(node: pb.PlanNode,
+                          values: dict[bytes, "pb.ExprNode"]) -> pb.PlanNode:
+    """Copy of ``node`` with every scalar_subquery ExprNode replaced by
+    the resolved literal ExprNode from ``values`` (keyed by the
+    ScalarSubqueryE's serialized bytes — identical subqueries share one
+    resolution; sid alone is not unique)."""
+    out = pb.PlanNode()
+    out.CopyFrom(node)
+
+    def walk(msg):
+        for fd, val in msg.ListFields():
+            if fd.type != fd.TYPE_MESSAGE:
+                continue
+            vals = val if fd.is_repeated else [val]
+            for v in vals:
+                if isinstance(v, pb.ExprNode) \
+                        and v.WhichOneof("expr") == "scalar_subquery":
+                    v.CopyFrom(values[v.scalar_subquery
+                                      .SerializeToString()])
+                else:
+                    walk(v)
+
+    walk(out)
+    return out

@@ -182,6 +182,12 @@ def expr_to_proto(e: ir.Expr) -> pb.ExprNode:
     if isinstance(e, ir.BloomFilterMightContain):
         return pb.ExprNode(bloom_might_contain=pb.BloomMightContainE(
             value=expr_to_proto(e.value), serialized_filter=e.serialized))
+    if isinstance(e, ir.ScalarSubquery):
+        sub = pb.PlanNode()
+        sub.ParseFromString(e.plan_bytes)
+        return pb.ExprNode(scalar_subquery=pb.ScalarSubqueryE(
+            plan=sub, dtype=_DT_TO_P[e.dtype], precision=e.precision,
+            scale=e.scale, sid=e.sid))
     raise NotImplementedError(f"expr_to_proto: {type(e).__name__}")
 
 
@@ -268,6 +274,11 @@ def parse_expr(p: pb.ExprNode) -> ir.Expr:
                 "serialized filter bytes")
         return ir.BloomFilterMightContain(parse_expr(b.value),
                                           bytes(b.serialized_filter))
+    if kind == "scalar_subquery":
+        q = p.scalar_subquery
+        return ir.ScalarSubquery(q.plan.SerializeToString(),
+                                 _P_TO_DT[q.dtype], q.precision, q.scale,
+                                 q.sid)
     raise NotImplementedError(f"parse_expr: {kind}")
 
 

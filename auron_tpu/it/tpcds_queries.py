@@ -24,7 +24,8 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from auron_tpu.columnar.schema import DataType
-from auron_tpu.frontend.dataframe import col, functions as F, lit
+from auron_tpu.frontend.dataframe import (col, functions as F, lit,
+                                          scalar_subquery)
 
 DATE_SK0 = 2450815
 
@@ -315,8 +316,15 @@ def _q6_run(s, t):
                      > col("cat_avg") * lit(1.2))
     ss = _rd(s, t, "store_sales").select("ss_sold_date_sk", "ss_item_sk",
                                          "ss_customer_sk")
-    dd = _rd(s, t, "date_dim").filter(
-        (col("d_year") == 2001) & (col("d_moy") == 1)) \
+    # true q6 shape: d_month_seq = (select distinct d_month_seq from
+    # date_dim where d_year = 2001 and d_moy = 1) — an uncorrelated
+    # SCALAR SUBQUERY executed once per task, no join rewrite
+    mseq = scalar_subquery(
+        _rd(s, t, "date_dim")
+        .filter((col("d_year") == 2001) & (col("d_moy") == 1))
+        .group_by("d_month_seq").agg(F.count_star().alias("_c"))
+        .select("d_month_seq"))
+    dd = _rd(s, t, "date_dim").filter(col("d_month_seq") == mseq) \
         .select("d_date_sk")
     cu = _rd(s, t, "customer").select("c_customer_sk",
                                       "c_current_addr_sk")
@@ -358,7 +366,8 @@ def _q6_oracle(a):
     return _topn(g, [("cnt", "ascending"), ("ca_state", "ascending")])
 
 
-_q("q6", "states buying premium-priced items (subquery-as-join)")(
+_q("q6", "states buying premium-priced items (scalar subquery + "
+         "correlated-subquery-as-join)")(
     (_q6_run, _q6_oracle))
 
 

@@ -292,29 +292,12 @@ def _neutral(kind: str, dtype):
 # ---------------------------------------------------------------------------
 
 def _keys_equal_prev(sorted_keys, live):
-    """eq[i] = keys[i] == keys[i-1] (null == null true; eq[0] = False)."""
-    from auron_tpu.columnar.batch import ListColumn, MapColumn, StructColumn
-    from auron_tpu.columnar.decimal128 import Decimal128Column
+    """eq[i] = keys[i] == keys[i-1] (null == null true, NaN == NaN,
+    struct fieldwise; eq[0] = False)."""
+    from auron_tpu.ops.hashing import adjacent_eq
     eq = jnp.ones_like(live)
     for col in sorted_keys:
-        if isinstance(col, (MapColumn, StructColumn, ListColumn)):
-            raise NotImplementedError(
-                f"GROUP BY on {type(col).__name__} keys is not supported "
-                "— group on the individual fields/elements instead")
-        if isinstance(col, StringColumn):
-            same_chars = jnp.all(col.chars[1:] == col.chars[:-1], axis=1)
-            same = same_chars & (col.lens[1:] == col.lens[:-1])
-        elif isinstance(col, Decimal128Column):
-            same = ((col.hi[1:] == col.hi[:-1])
-                    & (col.lo[1:] == col.lo[:-1]))
-        else:
-            # Spark groups all NaNs together (NormalizeNaNAndZero)
-            from auron_tpu.ops.hashing import nan_aware_eq
-            same = nan_aware_eq(col.data[1:], col.data[:-1])
-        both_valid = col.validity[1:] & col.validity[:-1]
-        both_null = ~col.validity[1:] & ~col.validity[:-1]
-        same = (both_valid & same) | both_null
-        eq = eq & jnp.concatenate([jnp.zeros(1, bool), same])
+        eq = eq & jnp.concatenate([jnp.zeros(1, bool), adjacent_eq(col)])
     return eq
 
 
@@ -592,12 +575,17 @@ def _state_merge_kernel(n_keys: int, acc_meta: tuple, cap_s: int,
                 return Decimal128Column(scatter2(a.hi, b.hi),
                                         scatter2(a.lo, b.lo),
                                         scatter2(a.validity, b.validity))
-            from auron_tpu.columnar.batch import ListColumn
+            from auron_tpu.columnar.batch import ListColumn, StructColumn
             if isinstance(a, ListColumn):
                 return ListColumn(scatter2(a.values, b.values),
                                   scatter2(a.elem_valid, b.elem_valid),
                                   scatter2(a.lens, b.lens),
                                   scatter2(a.validity, b.validity))
+            if isinstance(a, StructColumn):
+                return StructColumn(
+                    tuple(scatter_col(ca, cb)
+                          for ca, cb in zip(a.children, b.children)),
+                    scatter2(a.validity, b.validity))
             return PrimitiveColumn(scatter2(a.data, b.data),
                                    scatter2(a.validity, b.validity))
 
@@ -637,7 +625,15 @@ _CANONICAL_NAN = float("nan")
 
 
 def _column_pyvalues(col, n: int) -> list:
-    """First n rows of a column as python values (None where invalid)."""
+    """First n rows of a column as python values (None where invalid);
+    struct rows become tuples of child values (hashable → usable as
+    host-dict keys)."""
+    from auron_tpu.columnar.batch import StructColumn
+    if isinstance(col, StructColumn):
+        kids = [_column_pyvalues(ch, n) for ch in col.children]
+        val = np.asarray(col.validity[:n])
+        return [tuple(k[i] for k in kids) if val[i] else None
+                for i in range(n)]
     if isinstance(col, StringColumn):
         chars = np.asarray(col.chars[:n])
         lens = np.asarray(col.lens[:n])
@@ -1129,8 +1125,10 @@ class AggOp(PhysicalOp):
 
         key_fields = []
         for e, n in zip(self.group_exprs, self.group_names):
-            dt, p, s = infer_dtype(e, in_schema)
-            key_fields.append(Field(n, dt, True, p, s))
+            # nested-aware: struct group keys keep their children metadata
+            # through the output/partial schema (serde needs it)
+            from auron_tpu.exprs.eval import infer_field
+            key_fields.append(infer_field(e, in_schema, n))
 
         if mode == "partial":
             state_fields = []
@@ -1325,10 +1323,17 @@ class AggOp(PhysicalOp):
             if isinstance(c, StringColumn):
                 return StringColumn(c.chars[:new_cap], c.lens[:new_cap],
                                     c.validity[:new_cap])
-            from auron_tpu.columnar.batch import ListColumn
+            from auron_tpu.columnar.batch import ListColumn, StructColumn
+            from auron_tpu.columnar.decimal128 import Decimal128Column
             if isinstance(c, ListColumn):
                 return ListColumn(c.values[:new_cap], c.elem_valid[:new_cap],
                                   c.lens[:new_cap], c.validity[:new_cap])
+            if isinstance(c, Decimal128Column):
+                return Decimal128Column(c.hi[:new_cap], c.lo[:new_cap],
+                                        c.validity[:new_cap])
+            if isinstance(c, StructColumn):
+                return StructColumn(tuple(slice_col(ch) for ch in c.children),
+                                    c.validity[:new_cap])
             return PrimitiveColumn(c.data[:new_cap], c.validity[:new_cap])
 
         keys2 = tuple(slice_col(c) for c in keys)

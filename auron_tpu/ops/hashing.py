@@ -106,6 +106,64 @@ def nan_aware_eq(a: jax.Array, b: jax.Array) -> jax.Array:
     return same
 
 
+def adjacent_eq(col) -> jax.Array:
+    """bool[cap-1]: row i structurally equals row i-1 under Spark key
+    semantics — null == null, NaN == NaN, struct fieldwise. Shared by
+    group-boundary and window-partition detection."""
+    from auron_tpu.columnar.batch import (ListColumn, MapColumn,
+                                          StringColumn, StructColumn)
+    from auron_tpu.columnar.decimal128 import Decimal128Column
+    if isinstance(col, (MapColumn, ListColumn)):
+        raise NotImplementedError(
+            f"grouping / partitioning on {type(col).__name__} keys is not "
+            "supported — Spark itself disallows map-typed keys; key on "
+            "the individual elements instead")
+    both_valid = col.validity[1:] & col.validity[:-1]
+    both_null = ~col.validity[1:] & ~col.validity[:-1]
+    if isinstance(col, StructColumn):
+        same = jnp.ones_like(both_valid)
+        for ch in col.children:
+            same = same & adjacent_eq(
+                ch.with_validity(ch.validity & col.validity))
+    elif isinstance(col, StringColumn):
+        same = jnp.all(col.chars[1:] == col.chars[:-1], axis=1) \
+            & (col.lens[1:] == col.lens[:-1])
+    elif isinstance(col, Decimal128Column):
+        same = (col.hi[1:] == col.hi[:-1]) & (col.lo[1:] == col.lo[:-1])
+    else:
+        same = nan_aware_eq(col.data[1:], col.data[:-1])
+    return (both_valid & same) | both_null
+
+
+def pairwise_eq(pc, probe_idx, bc, build_idx) -> jax.Array:
+    """Structural value equality of pc[probe_idx] vs bc[build_idx] under
+    Spark key semantics (NaN == NaN; struct fieldwise with null-field ==
+    null-field). Does NOT include the top-level validity conjunction —
+    equi-join null keys never match, so the caller applies its own
+    null rule."""
+    from auron_tpu.columnar.batch import (ListColumn, MapColumn,
+                                          StringColumn, StructColumn)
+    from auron_tpu.columnar.decimal128 import Decimal128Column
+    if isinstance(pc, (MapColumn, ListColumn)):
+        raise NotImplementedError(
+            f"join keys of {type(pc).__name__} type are not supported")
+    if isinstance(pc, StructColumn):
+        same = jnp.ones(probe_idx.shape[0], bool)
+        for cp, cb in zip(pc.children, bc.children):
+            pv = cp.validity[probe_idx] & pc.validity[probe_idx]
+            bv = cb.validity[build_idx] & bc.validity[build_idx]
+            child_same = pairwise_eq(cp, probe_idx, cb, build_idx)
+            same = same & ((pv & bv & child_same) | (~pv & ~bv))
+        return same
+    if isinstance(pc, StringColumn):
+        return jnp.all(pc.chars[probe_idx] == bc.chars[build_idx], axis=1) \
+            & (pc.lens[probe_idx] == bc.lens[build_idx])
+    if isinstance(pc, Decimal128Column):
+        return (pc.hi[probe_idx] == bc.hi[build_idx]) \
+            & (pc.lo[probe_idx] == bc.lo[build_idx])
+    return nan_aware_eq(pc.data[probe_idx], bc.data[build_idx])
+
+
 def _f64_bits(d: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Canonicalized bits of f64 as (low, high) uint32 words. Avoids
     f64<->s64 bitcast, which TPU's 64-bit-rewriting pass does not
@@ -306,19 +364,29 @@ def xxhash64_string(chars: jax.Array, lens: jax.Array, seed) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 def _reject_nested(col) -> None:
-    from auron_tpu.columnar.batch import ListColumn, MapColumn, StructColumn
-    if isinstance(col, (MapColumn, StructColumn, ListColumn)):
+    from auron_tpu.columnar.batch import ListColumn, MapColumn
+    if isinstance(col, (MapColumn, ListColumn)):
         raise NotImplementedError(
             f"hash partitioning / hash join / hash agg on "
             f"{type(col).__name__} keys is not supported — Spark itself "
-            "disallows map-typed keys; for struct/array keys, hash the "
-            "individual fields/elements instead")
+            "disallows map-typed keys; for array keys, hash the "
+            "individual elements instead")
 
 
 def _hash_column_murmur(col: Column, hashes: jax.Array) -> jax.Array:
     """One column's contribution to the running murmur3 hash (int32[n])."""
     _reject_nested(col)
+    from auron_tpu.columnar.batch import StructColumn
     from auron_tpu.columnar.decimal128 import Decimal128Column
+    if isinstance(col, StructColumn):
+        # Spark create_hashes recurses into struct fields, chaining the
+        # running hash through each (spark_hash.rs); a NULL struct row
+        # leaves the running hash untouched, like any null column
+        new = hashes
+        for ch in col.children:
+            new = _hash_column_murmur(
+                ch.with_validity(ch.validity & col.validity), new)
+        return jnp.where(col.validity, new, hashes)
     if isinstance(col, Decimal128Column):
         # limb-pair hashing: chain the low then high limb as two int64
         # words. DELIBERATE DEVIATION from Spark, which hashes wide
@@ -353,7 +421,14 @@ def _hash_column_murmur(col: Column, hashes: jax.Array) -> jax.Array:
 
 def _hash_column_xxhash(col: Column, hashes: jax.Array) -> jax.Array:
     _reject_nested(col)
+    from auron_tpu.columnar.batch import StructColumn
     from auron_tpu.columnar.decimal128 import Decimal128Column
+    if isinstance(col, StructColumn):
+        new = hashes
+        for ch in col.children:
+            new = _hash_column_xxhash(
+                ch.with_validity(ch.validity & col.validity), new)
+        return jnp.where(col.validity, new, hashes)
     if isinstance(col, Decimal128Column):
         # limb-pair hashing; see _hash_column_murmur for the Spark deviation
         new = xxhash64_int64(col.lo, hashes.view(jnp.uint64))

@@ -115,22 +115,15 @@ class _BuildSide:
 
 
 def _keys_match(probe_keys, probe_idx, build_keys, build_idx) -> jax.Array:
-    """Exact equality verification per candidate pair."""
+    """Exact equality verification per candidate pair: structural value
+    equality (NaN == NaN, struct fieldwise) but top-level NULL keys never
+    match (SQL equi-join)."""
+    from auron_tpu.ops.hashing import pairwise_eq
     ok = jnp.ones(probe_idx.shape[0], bool)
     for pc, bc in zip(probe_keys, build_keys):
         pv = pc.validity[probe_idx]
         bv = bc.validity[build_idx]
-        if isinstance(pc, StringColumn):
-            same = jnp.all(pc.chars[probe_idx] == bc.chars[build_idx], axis=1) \
-                & (pc.lens[probe_idx] == bc.lens[build_idx])
-        elif hasattr(pc, "hi"):   # Decimal128Column: limb-pair equality
-            same = (pc.hi[probe_idx] == bc.hi[build_idx]) \
-                & (pc.lo[probe_idx] == bc.lo[build_idx])
-        else:
-            # Spark join keys: NaN matches NaN (NormalizeNaNAndZero)
-            from auron_tpu.ops.hashing import nan_aware_eq
-            same = nan_aware_eq(pc.data[probe_idx], bc.data[build_idx])
-        ok = ok & pv & bv & same
+        ok = ok & pv & bv & pairwise_eq(pc, probe_idx, bc, build_idx)
     return ok
 
 

@@ -42,8 +42,21 @@ def order_words(col, ascending: bool, nulls_first: bool) -> list[jax.Array]:
     """Normalize one sort key column into order-preserving uint64 words,
     most significant first (excluding the null-rank word, which the caller
     gets separately)."""
+    from auron_tpu.columnar.batch import StructColumn
     from auron_tpu.columnar.decimal128 import Decimal128Column
     words: list[jax.Array] = []
+    if isinstance(col, StructColumn):
+        # struct ordering is fieldwise; each field contributes its own
+        # null-rank word (null fields sort first ascending, like Spark's
+        # InterpretedOrdering) then its value words, nulls neutralized
+        for ch in col.children:
+            cv = ch.validity & col.validity
+            words.append(jnp.where(cv, jnp.uint64(1), jnp.uint64(0)))
+            words.extend(jnp.where(cv, w, jnp.uint64(0))
+                         for w in order_words(ch, True, True))
+        if not ascending:
+            words = [~w for w in words]
+        return words
     if isinstance(col, Decimal128Column):
         # signed 128-bit order: sign-flipped hi limb, then unsigned lo
         hi_w = col.hi.astype(jnp.uint64) ^ jnp.uint64(1 << 63)

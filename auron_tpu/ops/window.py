@@ -69,26 +69,10 @@ class WindowFunctionSpec:
 # ---------------------------------------------------------------------------
 
 def _col_neq_prev(col) -> jax.Array:
-    """bool[cap]: row i differs from row i-1 (null-aware; row 0 => True)."""
-    from auron_tpu.columnar.batch import ListColumn, MapColumn, StructColumn
-    from auron_tpu.columnar.decimal128 import Decimal128Column
-    if isinstance(col, (MapColumn, StructColumn, ListColumn)):
-        raise NotImplementedError(
-            f"window partition/order keys of {type(col).__name__} type "
-            "are not supported — key on the individual fields instead")
-    if isinstance(col, StringColumn):
-        same_chars = jnp.all(col.chars[1:] == col.chars[:-1], axis=1)
-        same = same_chars & (col.lens[1:] == col.lens[:-1])
-    elif isinstance(col, Decimal128Column):
-        same = (col.hi[1:] == col.hi[:-1]) & (col.lo[1:] == col.lo[:-1])
-    else:
-        # Spark partitions all NaNs together (NormalizeNaNAndZero)
-        from auron_tpu.ops.hashing import nan_aware_eq
-        same = nan_aware_eq(col.data[1:], col.data[:-1])
-    both_null = (~col.validity[1:]) & (~col.validity[:-1])
-    both_valid = col.validity[1:] & col.validity[:-1]
-    eq = jnp.where(both_null, True, both_valid & same)
-    return jnp.concatenate([jnp.ones(1, bool), ~eq])
+    """bool[cap]: row i differs from row i-1 (null-aware, NaN == NaN,
+    struct fieldwise; row 0 => True)."""
+    from auron_tpu.ops.hashing import adjacent_eq
+    return jnp.concatenate([jnp.ones(1, bool), ~adjacent_eq(col)])
 
 
 def _segmented_cummax_pos(flags: jax.Array) -> jax.Array:
@@ -196,6 +180,7 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
         n = sbatch.num_rows
 
         def sorted_col(c):
+            from auron_tpu.columnar.batch import StructColumn
             from auron_tpu.columnar.decimal128 import Decimal128Column
             if isinstance(c, StringColumn):
                 return StringColumn(c.chars[perm], c.lens[perm],
@@ -203,6 +188,10 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
             if isinstance(c, Decimal128Column):
                 return Decimal128Column(c.hi[perm], c.lo[perm],
                                         c.validity[perm])
+            if isinstance(c, StructColumn):
+                return StructColumn(tuple(sorted_col(ch)
+                                          for ch in c.children),
+                                    c.validity[perm])
             return PrimitiveColumn(c.data[perm], c.validity[perm])
 
         spcols = [sorted_col(c) for c in pcols]

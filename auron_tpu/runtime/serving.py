@@ -241,6 +241,7 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                     for t, cls, attrs in report.boundaries]
             write_frame(self.request, KIND_NEED_TABLES,
                         json.dumps(need).encode())
+            expected = {n["table"] for n in need}
             for _ in need:
                 while True:
                     try:
@@ -249,6 +250,15 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                     except queue.Empty:
                         if self._cancel.is_set():
                             raise _Cancelled()
+                # validate at receive time: a misnamed/duplicate TABLE
+                # frame fails loudly here, not as an opaque missing-table
+                # error mid-execution
+                if name not in expected:
+                    raise ValueError(
+                        f"TABLE frame {name!r} does not match any "
+                        f"requested boundary (outstanding: "
+                        f"{sorted(expected)})")
+                expected.discard(name)
                 catalog[name] = tbl
 
         task_bytes = pb.TaskDefinition(

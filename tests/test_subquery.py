@@ -1,0 +1,113 @@
+"""Uncorrelated scalar subquery: expr + binder (round-5 directive 5;
+reference: datafusion-ext-exprs/src/spark_scalar_subquery_wrapper.rs)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.exprs import ir
+from auron_tpu.frontend import Session, col, functions as F, lit, \
+    scalar_subquery
+from auron_tpu.ir import pb, serde
+
+
+def _session():
+    s = Session()
+    rng = np.random.default_rng(11)
+    s.register("t", pa.table({
+        "k": pa.array(rng.integers(0, 5, 200), pa.int64()),
+        "v": pa.array(rng.normal(10.0, 3.0, 200), pa.float64()),
+    }))
+    s.register("thresh", pa.table({
+        "cut": pa.array([12.0], pa.float64()),
+    }))
+    s.register("empty", pa.table({
+        "cut": pa.array([], pa.float64()),
+    }))
+    s.register("multi", pa.table({
+        "cut": pa.array([1.0, 2.0], pa.float64()),
+    }))
+    return s
+
+
+def test_proto_roundtrip():
+    sub = pb.PlanNode(memory_scan=pb.MemoryScanNode(table_name="thresh"))
+    e = ir.ScalarSubquery(sub.SerializeToString(), DataType.FLOAT64,
+                          sid=7)
+    assert serde.parse_expr(serde.expr_to_proto(e)) == e
+
+
+def test_filter_by_scalar_subquery_vs_oracle():
+    s = _session()
+    t = s.table("t")
+    cut = scalar_subquery(s.table("thresh").select("cut"))
+    got = t.filter(col("v") > cut).collect().to_pandas()
+    tbl = s.table("t").collect().to_pandas()
+    exp = tbl[tbl.v > 12.0]
+    assert len(got) == len(exp) > 0
+    assert set(np.round(got.v, 9)) == set(np.round(exp.v, 9))
+
+
+def test_aggregated_subquery_value():
+    # v > (select avg(v) from t) — the q6-class shape
+    s = _session()
+    t = s.table("t")
+    avg_v = scalar_subquery(
+        s.table("t").group_by().agg(F.avg(col("v")).alias("a")))
+    got = t.filter(col("v") > avg_v).collect().to_pandas()
+    tbl = s.table("t").collect().to_pandas()
+    exp = tbl[tbl.v > tbl.v.mean()]
+    assert len(got) == len(exp) > 0
+
+
+def test_empty_subquery_is_null():
+    # 0 rows → NULL → comparison never true (Spark semantics)
+    s = _session()
+    t = s.table("t")
+    cut = scalar_subquery(s.table("empty").select("cut"))
+    got = t.filter(col("v") > cut).collect()
+    assert got.num_rows == 0
+
+
+def test_multi_row_subquery_errors():
+    s = _session()
+    t = s.table("t")
+    cut = scalar_subquery(s.table("multi").select("cut"))
+    with pytest.raises(RuntimeError, match="more than one row"):
+        t.filter(col("v") > cut).collect()
+
+
+def test_projected_subquery_and_sharing():
+    # same subquery twice resolves once and projects as a constant
+    s = _session()
+    t = s.table("t")
+    cut = scalar_subquery(s.table("thresh").select("cut"))
+    got = t.select(col("k"), (col("v") - cut).alias("d"),
+                   (col("v") + cut).alias("u")).collect()
+    assert got.num_rows == 200
+    vals = s.table("t").collect().to_pandas()
+    assert np.allclose(np.sort(got.column("d").to_numpy()),
+                       np.sort(vals.v.values - 12.0))
+
+
+def test_multi_column_subquery_rejected():
+    s = _session()
+    with pytest.raises(ValueError, match="exactly one column"):
+        scalar_subquery(s.table("t"))
+
+
+def test_nested_scalar_subquery():
+    # v > (select avg(v) from t where k > (select min(k) from t)) —
+    # the inner subquery resolves inside the outer's plan
+    s = _session()
+    t = s.table("t")
+    min_k = scalar_subquery(
+        s.table("t").group_by().agg(F.min(col("k")).alias("m")))
+    inner = (s.table("t").filter(col("k") > min_k)
+             .group_by().agg(F.avg(col("v")).alias("a")))
+    got = t.filter(col("v") > scalar_subquery(inner)).collect().to_pandas()
+    tbl = s.table("t").collect().to_pandas()
+    cut = tbl[tbl.k > tbl.k.min()].v.mean()
+    exp = tbl[tbl.v > cut]
+    assert len(got) == len(exp) > 0
