@@ -35,7 +35,8 @@ def elem_dtype_of(a: ir.Expr, schema) -> DataType:
     if isinstance(a, ir.ScalarFunction):
         if a.name in ("array", "array_repeat") and a.args:
             return infer_dtype(a.args[0], schema)[0]
-        if a.name == "sort_array":
+        if a.name in ("sort_array", "array_distinct", "array_union",
+                      "array_intersect", "array_except"):
             return elem_dtype_of(a.args[0], schema)
         if a.name == "map_keys":
             m = a.args[0]
@@ -460,3 +461,129 @@ def _map_get(v: TypedValue, key: TypedValue, expr, schema) -> TypedValue:
         else DataType.FLOAT64)
     return TypedValue(PrimitiveColumn(
         data, v.validity & key.validity & hit & ev), dt)
+
+
+# ---------------------------------------------------------------------------
+# array set operations (reference: datafusion-ext-functions/src/brickhouse/
+# array_union.rs + Spark's ArrayDistinct/ArrayUnion/ArrayIntersect/
+# ArrayExcept/ArraysOverlap)
+# ---------------------------------------------------------------------------
+
+def _elem_eq_cross(av, ae, bv, be):
+    """[cap, Ea, Eb] structural element equality: both valid & NaN-aware
+    equal, or both null."""
+    from auron_tpu.ops.hashing import nan_aware_eq
+    eq = nan_aware_eq(av[:, :, None], bv[:, None, :])
+    both_valid = ae[:, :, None] & be[:, None, :]
+    both_null = ~ae[:, :, None] & ~be[:, None, :]
+    return (both_valid & eq) | both_null
+
+
+def _first_occurrence(values, ev, in_list):
+    """bool[cap, E]: element is in-list AND no equal element precedes it."""
+    e = values.shape[1]
+    eq = _elem_eq_cross(values, ev, values, ev)
+    lower = jnp.tril(jnp.ones((e, e), bool), k=-1)   # j < i
+    dup = jnp.any(eq & in_list[:, None, :] & lower[None, :, :], axis=2)
+    return in_list & ~dup
+
+
+def _member_of(av, ae, a_in, bv, be, b_in):
+    """bool[cap, Ea]: a's element occurs among b's in-list elements."""
+    eq = _elem_eq_cross(av, ae, bv, be)
+    return jnp.any(eq & b_in[:, None, :], axis=2)
+
+
+def _compact(values, ev, keep):
+    """Left-compact kept elements preserving order."""
+    cap, e = values.shape
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    tgt = jnp.where(keep, pos, e)          # e = out of range → dropped
+    rows = jnp.broadcast_to(jnp.arange(cap)[:, None], (cap, e))
+    out_v = jnp.zeros_like(values).at[rows, tgt].set(values, mode="drop")
+    out_e = jnp.zeros_like(ev).at[rows, tgt].set(ev & keep, mode="drop")
+    return out_v, out_e, keep.sum(axis=1).astype(jnp.int32)
+
+
+def _in_list_mask(col: ListColumn):
+    return jnp.arange(col.max_elems)[None, :] < col.lens[:, None]
+
+
+@register("array_distinct", _list_result)
+def _array_distinct(args, expr, batch, schema, ctx):
+    col: ListColumn = args[0].col
+    keep = _first_occurrence(col.values, col.elem_valid,
+                             _in_list_mask(col))
+    v, ev, lens = _compact(col.values, col.elem_valid, keep)
+    return TypedValue(ListColumn(v, ev, lens, col.validity),
+                      DataType.LIST)
+
+
+def _concat_lists(a: ListColumn, b: ListColumn):
+    values = jnp.concatenate([a.values, b.values], axis=1)
+    ev = jnp.concatenate([a.elem_valid, b.elem_valid], axis=1)
+    in_list = jnp.concatenate(
+        [_in_list_mask(a),
+         _in_list_mask(b)], axis=1)
+    # order: all of a's elements first, then b's — matches Spark's
+    # first-occurrence union order
+    return values, ev, in_list
+
+
+@register("array_union", _list_result)
+def _array_union(args, expr, batch, schema, ctx):
+    a: ListColumn = args[0].col
+    b: ListColumn = args[1].col
+    values, ev, in_list = _concat_lists(a, b)
+    keep = _first_occurrence(values, ev, in_list)
+    v, e2, lens = _compact(values, ev, keep)
+    return TypedValue(ListColumn(v, e2, lens,
+                                 a.validity & b.validity), DataType.LIST)
+
+
+@register("array_intersect", _list_result)
+def _array_intersect(args, expr, batch, schema, ctx):
+    a: ListColumn = args[0].col
+    b: ListColumn = args[1].col
+    a_in = _in_list_mask(a)
+    keep = _first_occurrence(a.values, a.elem_valid, a_in) \
+        & _member_of(a.values, a.elem_valid, a_in,
+                     b.values, b.elem_valid, _in_list_mask(b))
+    v, ev, lens = _compact(a.values, a.elem_valid, keep)
+    return TypedValue(ListColumn(v, ev, lens,
+                                 a.validity & b.validity), DataType.LIST)
+
+
+@register("array_except", _list_result)
+def _array_except(args, expr, batch, schema, ctx):
+    a: ListColumn = args[0].col
+    b: ListColumn = args[1].col
+    a_in = _in_list_mask(a)
+    keep = _first_occurrence(a.values, a.elem_valid, a_in) \
+        & ~_member_of(a.values, a.elem_valid, a_in,
+                      b.values, b.elem_valid, _in_list_mask(b))
+    v, ev, lens = _compact(a.values, a.elem_valid, keep)
+    return TypedValue(ListColumn(v, ev, lens,
+                                 a.validity & b.validity), DataType.LIST)
+
+
+@register("arrays_overlap", DataType.BOOL)
+def _arrays_overlap(args, expr, batch, schema, ctx):
+    # Spark three-valued: any common NON-NULL element → true; otherwise
+    # if both non-empty and either side holds a null element → NULL;
+    # else false
+    a: ListColumn = args[0].col
+    b: ListColumn = args[1].col
+    a_in, b_in = _in_list_mask(a), _in_list_mask(b)
+    from auron_tpu.ops.hashing import nan_aware_eq
+    eq = nan_aware_eq(a.values[:, :, None], b.values[:, None, :]) \
+        & a.elem_valid[:, :, None] & b.elem_valid[:, None, :] \
+        & a_in[:, :, None] & b_in[:, None, :]
+    hit = jnp.any(eq, axis=(1, 2))
+    has_null = jnp.any(~a.elem_valid & a_in, axis=1) \
+        | jnp.any(~b.elem_valid & b_in, axis=1)
+    both_nonempty = (a.lens > 0) & (b.lens > 0)
+    unknown = ~hit & both_nonempty & has_null
+    return TypedValue(
+        PrimitiveColumn(hit, args[0].validity & args[1].validity
+                        & ~unknown), DataType.BOOL)

@@ -380,12 +380,21 @@ def _collect_subqueries(msg) -> list:
     return found
 
 
+def subquery_key(q) -> bytes:
+    """Dedup key for a ScalarSubqueryE: the plan + result type WITHOUT the
+    sid — two structurally equal subqueries (built separately, so stamped
+    with different sids) must share one resolution."""
+    k = pb.ScalarSubqueryE()
+    k.CopyFrom(q)
+    k.sid = 0
+    return k.SerializeToString()
+
+
 def substitute_subqueries(node: pb.PlanNode,
                           values: dict[bytes, "pb.ExprNode"]) -> pb.PlanNode:
     """Copy of ``node`` with every scalar_subquery ExprNode replaced by
-    the resolved literal ExprNode from ``values`` (keyed by the
-    ScalarSubqueryE's serialized bytes — identical subqueries share one
-    resolution; sid alone is not unique)."""
+    the resolved literal ExprNode from ``values`` (keyed by
+    ``subquery_key`` — identical subqueries share one resolution)."""
     out = pb.PlanNode()
     out.CopyFrom(node)
 
@@ -397,8 +406,7 @@ def substitute_subqueries(node: pb.PlanNode,
             for v in vals:
                 if isinstance(v, pb.ExprNode) \
                         and v.WhichOneof("expr") == "scalar_subquery":
-                    v.CopyFrom(values[v.scalar_subquery
-                                      .SerializeToString()])
+                    v.CopyFrom(values[subquery_key(v.scalar_subquery)])
                 else:
                     walk(v)
 

@@ -40,12 +40,13 @@ class ScalarSubqueryBinderOp(PhysicalOp):
                                           _collect_subqueries,
                                           substitute_subqueries)
         from auron_tpu.ir.serde import expr_to_proto
+        from auron_tpu.ir.planner import subquery_key
         subs = _collect_subqueries(self._node)
         values = {}
         for q in subs:
             from auron_tpu.ir.serde import _P_TO_DT
             lit = ir.Literal(None, _P_TO_DT[q.dtype], q.precision, q.scale)
-            values[q.SerializeToString()] = expr_to_proto(lit)
+            values[subquery_key(q)] = expr_to_proto(lit)
         node = substitute_subqueries(self._node, values)
         return PhysicalPlanner(self._planner_ctx).create_plan(node)
 
@@ -126,11 +127,12 @@ class ScalarSubqueryBinderOp(PhysicalOp):
                 return self._inner
             from auron_tpu.ir.planner import (PhysicalPlanner,
                                               _collect_subqueries,
-                                              substitute_subqueries)
+                                              substitute_subqueries,
+                                              subquery_key)
             from auron_tpu.ir.serde import _P_TO_DT, expr_to_proto
             values = {}
             for q in _collect_subqueries(self._node):
-                key = q.SerializeToString()
+                key = subquery_key(q)
                 if key in values:
                     continue
                 v = self._resolve_one(q, ctx)

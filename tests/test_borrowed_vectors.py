@@ -882,6 +882,32 @@ class TestArrayMapVectors:
                       {"c": pa.array([7, None], pa.int64())},
                       [[7, 7, 7], [None, None, None]], "array_repeat")
 
+    def test_array_set_ops(self):
+        # Spark ArrayDistinct/Union/Intersect/Except: first-occurrence
+        # order, nulls dedupe to one, NaN == NaN
+        two = {"a": pa.array([[1, 2, 2, None, None, 1], [], None, [3]],
+                             pa.list_(pa.int64())),
+               "b": pa.array([[2, 4, None], [1], [1], None],
+                             pa.list_(pa.int64()))}
+        _check_vector(fn("array_distinct", C(0)), two,
+                      [[1, 2, None], [], None, [3]], "array_distinct")
+        _check_vector(fn("array_union", C(0), C(1)), two,
+                      [[1, 2, None, 4], [1], None, None], "array_union")
+        _check_vector(fn("array_intersect", C(0), C(1)), two,
+                      [[2, None], [], None, None], "array_intersect")
+        _check_vector(fn("array_except", C(0), C(1)), two,
+                      [[1], [], None, None], "array_except")
+
+    def test_arrays_overlap_three_valued(self):
+        two = {"a": pa.array([[1, 2], [1, None], [1], [], [None]],
+                             pa.list_(pa.int64())),
+               "b": pa.array([[2, 3], [3], [2], [1], [1]],
+                             pa.list_(pa.int64()))}
+        # common non-null → true; none but a null present (both
+        # non-empty) → NULL; empty side → false
+        _check_vector(fn("arrays_overlap", C(0), C(1)), two,
+                      [True, None, False, False, None], "arrays_overlap")
+
     def test_map_family(self):
         m = {"c": pa.array([[(1, 10), (2, 20)], []],
                            pa.map_(pa.int64(), pa.int64()))}
