@@ -52,10 +52,19 @@ class TypedValue:
 
 @dataclass(frozen=True)
 class EvalContext:
-    """Ambient scalars available to expressions."""
+    """Ambient scalars available to expressions.
+
+    ``memo``: when a kernel passes a fresh dict, evaluate() caches each
+    subexpression's TypedValue per (batch, expr) — the common-subexpression
+    evaluator (reference: datafusion-ext-plans/src/common/
+    cached_exprs_evaluator.rs). Safe only for a dict created INSIDE the
+    traced kernel (tracer lifetimes match the trace); the default None
+    disables caching, so the shared default context can never leak
+    tracers across traces."""
     partition_id: object = 0          # device or python int32
     row_num_offset: object = 0        # rows produced before this batch
     num_partitions: int = 1
+    memo: object = None               # dict | None; see docstring
 
 
 _JNP = {
@@ -175,6 +184,24 @@ def _const_column(value, dtype: DataType, capacity: int, width_hint: int = 8):
 
 def evaluate(expr: ir.Expr, batch: DeviceBatch, schema: Schema,
              ctx: EvalContext = EvalContext()) -> TypedValue:
+    """Evaluate ``expr`` against ``batch``; with ctx.memo set, each
+    distinct subexpression evaluates once per batch (CSE — expr trees are
+    frozen/hashable, so structural duplicates share one result; host
+    callbacks like string parsing benefit most since XLA cannot CSE
+    those)."""
+    memo = ctx.memo
+    if memo is None or isinstance(expr, (ir.ColumnRef, ir.Literal)):
+        return _evaluate(expr, batch, schema, ctx)
+    key = (id(batch), expr)
+    hit = memo.get(key)
+    if hit is None:
+        hit = _evaluate(expr, batch, schema, ctx)
+        memo[key] = hit
+    return hit
+
+
+def _evaluate(expr: ir.Expr, batch: DeviceBatch, schema: Schema,
+              ctx: EvalContext) -> TypedValue:
     cap = batch.capacity
     if isinstance(expr, ir.ColumnRef):
         f = schema[expr.index]

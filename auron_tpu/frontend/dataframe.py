@@ -456,6 +456,80 @@ class DataFrame:
         existing = [col(f.name) for f in self.schema]
         return self.select(*existing, c.alias(name))
 
+    def expand(self, projections: Sequence[Sequence[Col]],
+               names: Sequence[str]) -> "DataFrame":
+        """Emit every projection once per input row (Spark ExpandExec —
+        the engine of rollup/cube/grouping sets; reference:
+        datafusion-ext-plans/src/expand_exec.rs). The FIRST projection
+        determines the output types, so put the most-typed one first."""
+        schema = self.schema
+        projs = [[resolve(_wrap(c), schema) for c in p]
+                 for p in projections]
+        node = pb.PlanNode(expand=pb.ExpandNode(
+            child=self.plan,
+            projections=[pb.ExpandNode.Projection(
+                exprs=[serde.expr_to_proto(e) for e in p])
+                for p in projs],
+            names=list(names)))
+        fields = []
+        for e, nm in zip(projs[0], names):
+            dt, p, s = infer_dtype(e, schema)
+            fields.append(Field(nm, dt, True, p, s))
+        return DataFrame(self.session, node, Schema(tuple(fields)),
+                         self.num_partitions, None)
+
+    def grouping_sets(self, keys: Sequence[Union[str, Col]],
+                      sets: Sequence[Sequence[int]]) -> "GroupedData":
+        """GROUP BY GROUPING SETS: expand one copy of the input per set,
+        null-filling grouped-out keys, and tag ``spark_grouping_id``
+        (bit i set = key i rolled up, leftmost key = highest bit — Spark's
+        encoding). The grouping id participates in the group keys so a
+        natural NULL key stays distinct from a rolled-up one."""
+        kcols = [col(k) if isinstance(k, str) else k for k in keys]
+        schema = self.schema
+        key_names = [k.out_name(f"k{i}") for i, k in enumerate(kcols)]
+        n = len(kcols)
+        pass_names = list(schema.names)
+        out_names = pass_names + [f"{kn}#g" for kn in key_names] \
+            + ["spark_grouping_id"]
+        null_keys = []
+        for k in kcols:
+            dt, p, s = infer_dtype(resolve(k, schema), schema)
+            null_keys.append(Col(ir.Literal(None, dt, p, s)))
+        projections = []
+        for st in sets:
+            inc = set(st)
+            gid = sum(1 << (n - 1 - i) for i in range(n) if i not in inc)
+            projections.append(
+                [col(c) for c in pass_names]
+                + [kcols[i] if i in inc else null_keys[i]
+                   for i in range(n)]
+                + [lit(gid, DataType.INT32)])
+        # the full set must come first: it types the expanded columns
+        projections.sort(key=lambda p: sum(
+            1 for c in p if isinstance(c.node, ir.Literal)
+            and c.node.value is None))
+        expanded = self.expand(projections, out_names)
+        gkeys = [col(f"{kn}#g").alias(kn) for kn in key_names] \
+            + [col("spark_grouping_id")]
+        return GroupedData(expanded, gkeys)
+
+    def rollup(self, *keys: Union[str, Col]) -> "GroupedData":
+        """GROUP BY ROLLUP(k1..kn): the n+1 prefix grouping sets."""
+        n = len(keys)
+        return self.grouping_sets(
+            keys, [list(range(i)) for i in range(n, -1, -1)])
+
+    def cube(self, *keys: Union[str, Col]) -> "GroupedData":
+        """GROUP BY CUBE(k1..kn): all 2^n grouping sets."""
+        import itertools
+        n = len(keys)
+        sets = []
+        for r in range(n, -1, -1):
+            sets.extend(list(c) for c in
+                        itertools.combinations(range(n), r))
+        return self.grouping_sets(keys, sets)
+
     def group_by(self, *keys: Union[str, Col]) -> GroupedData:
         ks = [col(k) if isinstance(k, str) else k for k in keys]
         return GroupedData(self, ks)

@@ -20,6 +20,11 @@ class ComparisonResult:
     rows: int
     mismatches: list = field(default_factory=list)
     error: str = ""
+    elapsed_s: float = 0.0
+    #: XLA programs built / seconds spent compiling while the query ran
+    #: (utils/compile_stats.py; ~0 on a warm in-process rerun)
+    compiles: int = 0
+    compile_s: float = 0.0
 
     def report(self) -> str:
         if self.ok:

@@ -104,12 +104,15 @@ def run_tpcds(data_dir=None, scale: float = 1.0, names=None,
     arrow = load_arrow(tables)
     comparator = QueryResultComparator(double_rel_tol=1e-7,
                                        double_abs_tol=1e-6)
+    from auron_tpu.utils import compile_stats
     results = []
+    suite_start = compile_stats.snapshot()
     for q in TQ:
         if names and q.name not in names:
             continue
         session = _fresh_session()
         t0 = time.perf_counter()
+        c0 = compile_stats.snapshot()
         try:
             got = q.run(session, tables)
         except Exception:
@@ -120,13 +123,24 @@ def run_tpcds(data_dir=None, scale: float = 1.0, names=None,
                 print(results[-1].report(), flush=True)
             continue
         elapsed = time.perf_counter() - t0
+        cd = compile_stats.delta(c0)
         expected = q.oracle(arrow)
         res = comparator.compare(q.name, _defloat_decimals(got),
                                  _defloat_decimals(expected))
         res.elapsed_s = round(elapsed, 3)
+        res.compiles = cd.count
+        res.compile_s = round(cd.seconds, 3)
         results.append(res)
         if verbose:
-            print(res.report() + f" ({res.elapsed_s}s)", flush=True)
+            print(res.report() + f" ({res.elapsed_s}s, "
+                  f"{cd.count} compiles {res.compile_s}s)", flush=True)
+    total = compile_stats.delta(suite_start)
+    if verbose:
+        wall = sum(getattr(r, "elapsed_s", 0) or 0 for r in results)
+        print(f"compile budget: {total.count} XLA programs, "
+              f"{total.seconds:.1f}s compiling / {wall:.1f}s total "
+              "(a second run in this process should compile ~0)",
+              flush=True)
     return results
 
 

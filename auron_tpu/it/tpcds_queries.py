@@ -1,13 +1,15 @@
 """Real TPC-DS queries over the real-schema dataset (tpcds.py).
 
-22 genuine TPC-DS query shapes — star joins, multi-dimension filters,
-two-phase aggregation, CASE buckets, subquery-as-join, window ratios —
-expressed in the frontend DataFrame DSL (which lowers to protobuf plans
-and runs the full engine pipeline) and diffed against an INDEPENDENT
-pyarrow/Acero oracle (multithreaded Arrow C++: group_by/join/filter —
-the non-pandas oracle VERDICT r3 asked for; DuckDB is not in this
-image). Query parameters are substituted to match the generated data's
-value domains, exactly as dsdgen's templates substitute parameters.
+40 genuine TPC-DS query shapes — star joins, multi-dimension filters,
+two-phase aggregation, CASE buckets, scalar subqueries, EXISTS/IN as
+semi/anti joins, ROLLUP/grouping-sets with grouping_id arithmetic,
+three-channel UNIONs, and window ratios — expressed in the frontend
+DataFrame DSL (which lowers to protobuf plans and runs the full engine
+pipeline) and diffed against an INDEPENDENT pyarrow/Acero (or pandas)
+oracle (DuckDB is not in this image). Query parameters are substituted
+to match the generated data's value domains, exactly as dsdgen's
+templates substitute parameters — and auto-tuned so every query returns
+rows at CI scale (an empty result proves nothing about a query).
 
 Reference gate being mirrored: all-99-query TPC-DS diff vs vanilla Spark
 (reference: .github/workflows/tpcds-reusable.yml:70-83,
@@ -887,8 +889,12 @@ def _q1_run(s, t):
                .agg(F.avg(col("ctr_total_return")).alias("avg_return")))
     j = _join_dim(ctr, avg_ctr, "sr_store_sk", "st2")
     j = j.filter(col("ctr_total_return") > col("avg_return") * lit(1.2))
-    st = _rd(s, t, "store").filter(col("s_state") == "TN") \
-        .select("s_store_sk")
+    # parameter auto-tune: at CI scales the store table is 6 rows drawn
+    # from 12 states, so the single-state 'TN' template parameter often
+    # selects zero stores; a 4-state IN keeps the filter real AND the
+    # result nonempty at every scale
+    st = _rd(s, t, "store").filter(
+        col("s_state").isin("TN", "CA", "TX", "NY")).select("s_store_sk")
     j = _join_dim(j, st, "sr_store_sk", "s_store_sk")
     cu = _rd(s, t, "customer").select("c_customer_sk", "c_customer_id")
     j = _join_dim(j, cu, "sr_customer_sk", "c_customer_sk")
@@ -914,7 +920,9 @@ def _q1_oracle(a):
     j = _oj(ctr, avg_ctr, ["sr_store_sk"], ["st2"])
     j = j.filter(pc.greater(j["ctr_total_return"],
                             pc.multiply(j["avg_return"], 1.2)))
-    st = a["store"].filter(pc.equal(a["store"]["s_state"], "TN")) \
+    st = a["store"].filter(pc.is_in(
+        a["store"]["s_state"],
+        value_set=pa.array(["TN", "CA", "TX", "NY"]))) \
         .select(["s_store_sk"])
     j = _oj(j, st, ["sr_store_sk"], ["s_store_sk"])
     cu = a["customer"].select(["c_customer_sk", "c_customer_id"])
@@ -1360,3 +1368,817 @@ def _q88_oracle(a):
 
 _q("q88", "morning half-hour purchase count buckets")(
     (_q88_run, _q88_oracle))
+
+
+# ===========================================================================
+# rollup / grouping-sets family (round-5 directive 6). The engine side uses
+# DataFrame.rollup (Expand + grouping_id, Spark's own lowering); the oracle
+# computes each grouping-set level independently in pyarrow and concats.
+# ===========================================================================
+
+def _oracle_rollup(t, keys, aggs, agg_names):
+    """Per-prefix-level group_by, null-filled rolled-up keys + Spark
+    grouping_id, concatenated (the independent rollup oracle)."""
+    import pyarrow as _pa
+    n = len(keys)
+    outs = []
+    for level in range(n, -1, -1):
+        inc = keys[:level]
+        gid = sum(1 << (n - 1 - i) for i in range(level, n))
+        if inc:
+            g = t.group_by(inc, use_threads=False).aggregate(aggs)
+            g = g.rename_columns(list(inc) + agg_names)
+        else:
+            g = t.group_by([], use_threads=False).aggregate(aggs)
+            g = g.rename_columns(agg_names)
+        cols, names = [], []
+        for i, k in enumerate(keys):
+            if i < level:
+                cols.append(g.column(k))
+            else:
+                cols.append(_pa.nulls(g.num_rows, t.schema.field(k).type))
+            names.append(k)
+        cols.append(_pa.array([gid] * g.num_rows, _pa.int32()))
+        names.append("spark_grouping_id")
+        for an in agg_names:
+            cols.append(g.column(an))
+            names.append(an)
+        outs.append(_pa.table(dict(zip(names, cols))))
+    return _pa.concat_tables(outs)
+
+
+def _q18_run(s, t):
+    # q18-class: catalog averages by demographic slice, ROLLUP over the
+    # item hierarchy (the template rolls up buyer geography, which this
+    # schema subset does not carry on catalog_sales)
+    cs = _rd(s, t, "catalog_sales").select(
+        "cs_sold_date_sk", "cs_item_sk", "cs_bill_cdemo_sk",
+        "cs_quantity", "cs_list_price", "cs_coupon_amt")
+    dd = _rd(s, t, "date_dim").filter(col("d_year") == 2000) \
+        .select("d_date_sk")
+    cd = _rd(s, t, "customer_demographics").filter(
+        (col("cd_gender") == "F")
+        & (col("cd_education_status") == "College")) \
+        .select("cd_demo_sk")
+    it = _rd(s, t, "item").select("i_item_sk", "i_category", "i_class")
+    j = _join_dim(cs, dd, "cs_sold_date_sk", "d_date_sk")
+    j = _join_dim(j, cd, "cs_bill_cdemo_sk", "cd_demo_sk")
+    j = _join_dim(j, it, "cs_item_sk", "i_item_sk")
+    g = (j.rollup("i_category", "i_class")
+         .agg(F.avg(col("cs_quantity").cast(DataType.FLOAT64))
+              .alias("agg1"),
+              F.avg(col("cs_list_price").cast(DataType.FLOAT64))
+              .alias("agg2"),
+              F.avg(col("cs_coupon_amt").cast(DataType.FLOAT64))
+              .alias("agg3")))
+    return (g.sort(col("spark_grouping_id").asc(),
+                   col("i_category").asc(), col("i_class").asc())
+            .limit(200).collect())
+
+
+def _q18_oracle(a):
+    dd = a["date_dim"].filter(
+        pc.equal(a["date_dim"]["d_year"], 2000)).select(["d_date_sk"])
+    cd = a["customer_demographics"].filter(pc.and_(
+        pc.equal(a["customer_demographics"]["cd_gender"], "F"),
+        pc.equal(a["customer_demographics"]["cd_education_status"],
+                 "College"))).select(["cd_demo_sk"])
+    it = a["item"].select(["i_item_sk", "i_category", "i_class"])
+    j = _oj(a["catalog_sales"], dd, ["cs_sold_date_sk"], ["d_date_sk"])
+    j = _oj(j, cd, ["cs_bill_cdemo_sk"], ["cd_demo_sk"])
+    j = _oj(j, it, ["cs_item_sk"], ["i_item_sk"])
+    for c in ("cs_quantity", "cs_list_price", "cs_coupon_amt"):
+        j = j.set_column(j.column_names.index(c), c,
+                         j[c].cast(pa.float64()))
+    g = _oracle_rollup(j, ["i_category", "i_class"],
+                       [("cs_quantity", "mean"), ("cs_list_price", "mean"),
+                        ("cs_coupon_amt", "mean")],
+                       ["agg1", "agg2", "agg3"])
+    return _topn(g, [("spark_grouping_id", "ascending"),
+                     ("i_category", "ascending"),
+                     ("i_class", "ascending")], 200)
+
+
+_q("q18", "catalog demographic averages, ROLLUP(i_category, i_class)")(
+    (_q18_run, _q18_oracle))
+
+
+def _q22_run(s, t):
+    inv = _rd(s, t, "inventory").select("inv_date_sk", "inv_item_sk",
+                                        "inv_quantity_on_hand")
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_month_seq") >= 24) & (col("d_month_seq") <= 35)) \
+        .select("d_date_sk")
+    it = _rd(s, t, "item").select("i_item_sk", "i_category", "i_brand")
+    j = _join_dim(inv, dd, "inv_date_sk", "d_date_sk")
+    j = _join_dim(j, it, "inv_item_sk", "i_item_sk")
+    g = (j.rollup("i_category", "i_brand")
+         .agg(F.avg(col("inv_quantity_on_hand").cast(DataType.FLOAT64))
+              .alias("qoh")))
+    return (g.sort(col("qoh").asc(), col("i_category").asc(),
+                   col("i_brand").asc()).limit(100).collect())
+
+
+def _q22_oracle(a):
+    dd = a["date_dim"].filter(pc.and_(
+        pc.greater_equal(a["date_dim"]["d_month_seq"], 24),
+        pc.less_equal(a["date_dim"]["d_month_seq"], 35))) \
+        .select(["d_date_sk"])
+    it = a["item"].select(["i_item_sk", "i_category", "i_brand"])
+    j = _oj(a["inventory"], dd, ["inv_date_sk"], ["d_date_sk"])
+    j = _oj(j, it, ["inv_item_sk"], ["i_item_sk"])
+    j = j.set_column(j.column_names.index("inv_quantity_on_hand"),
+                     "inv_quantity_on_hand",
+                     j["inv_quantity_on_hand"].cast(pa.float64()))
+    g = _oracle_rollup(j, ["i_category", "i_brand"],
+                       [("inv_quantity_on_hand", "mean")], ["qoh"])
+    return _topn(g, [("qoh", "ascending"), ("i_category", "ascending"),
+                     ("i_brand", "ascending")])
+
+
+_q("q22", "average inventory on hand, ROLLUP(i_category, i_brand)")(
+    (_q22_run, _q22_oracle))
+
+
+def _q36_run(s, t):
+    ss = _rd(s, t, "store_sales").select(
+        "ss_sold_date_sk", "ss_item_sk", "ss_store_sk",
+        "ss_ext_sales_price", "ss_net_profit")
+    dd = _rd(s, t, "date_dim").filter(col("d_year") == 2001) \
+        .select("d_date_sk")
+    it = _rd(s, t, "item").select("i_item_sk", "i_category", "i_class")
+    j = _join_dim(ss, dd, "ss_sold_date_sk", "d_date_sk")
+    j = _join_dim(j, it, "ss_item_sk", "i_item_sk")
+    g = (j.rollup("i_category", "i_class")
+         .agg(F.sum(col("ss_net_profit").cast(DataType.FLOAT64))
+              .alias("profit"),
+              F.sum(col("ss_ext_sales_price").cast(DataType.FLOAT64))
+              .alias("sales")))
+    # gross margin + lochierarchy = grouping(category)+grouping(class),
+    # computed from the Spark grouping id bits
+    g = g.with_column("gross_margin", col("profit") / col("sales"))
+    g = g.with_column(
+        "lochierarchy",
+        (col("spark_grouping_id") % lit(2, DataType.INT32))
+        + (col("spark_grouping_id") / lit(2, DataType.INT32)))
+    g = g.select("i_category", "i_class", "gross_margin", "lochierarchy")
+    return (g.sort(col("lochierarchy").desc(), col("i_category").asc(),
+                   col("i_class").asc()).limit(100).collect())
+
+
+def _q36_oracle(a):
+    dd = a["date_dim"].filter(
+        pc.equal(a["date_dim"]["d_year"], 2001)).select(["d_date_sk"])
+    it = a["item"].select(["i_item_sk", "i_category", "i_class"])
+    j = _oj(a["store_sales"], dd, ["ss_sold_date_sk"], ["d_date_sk"])
+    j = _oj(j, it, ["ss_item_sk"], ["i_item_sk"])
+    for c in ("ss_net_profit", "ss_ext_sales_price"):
+        j = j.set_column(j.column_names.index(c), c,
+                         j[c].cast(pa.float64()))
+    g = _oracle_rollup(j, ["i_category", "i_class"],
+                       [("ss_net_profit", "sum"),
+                        ("ss_ext_sales_price", "sum")],
+                       ["profit", "sales"])
+    gm = pc.divide(g["profit"], g["sales"])
+    gid = g["spark_grouping_id"]
+    loch = pc.add(pc.bit_wise_and(gid, 1),
+                  pc.shift_right(gid, 1))
+    g = pa.table({"i_category": g["i_category"], "i_class": g["i_class"],
+                  "gross_margin": gm,
+                  "lochierarchy": loch.cast(pa.int32())})
+    return _topn(g, [("lochierarchy", "descending"),
+                     ("i_category", "ascending"),
+                     ("i_class", "ascending")])
+
+
+_q("q36", "gross margin ROLLUP with grouping()-derived hierarchy level")(
+    (_q36_run, _q36_oracle))
+
+
+def _q67_run(s, t):
+    ss = _rd(s, t, "store_sales").select(
+        "ss_sold_date_sk", "ss_item_sk", "ss_quantity", "ss_sales_price")
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_month_seq") >= 24) & (col("d_month_seq") <= 35)) \
+        .select("d_date_sk")
+    it = _rd(s, t, "item").select("i_item_sk", "i_category", "i_class",
+                                  "i_brand")
+    j = _join_dim(ss, dd, "ss_sold_date_sk", "d_date_sk")
+    j = _join_dim(j, it, "ss_item_sk", "i_item_sk")
+    j = j.with_column(
+        "amt", col("ss_sales_price").cast(DataType.FLOAT64)
+        * col("ss_quantity").cast(DataType.FLOAT64))
+    g = (j.rollup("i_category", "i_class", "i_brand")
+         .agg(F.sum(col("amt")).alias("sumsales")))
+    # rank the hierarchy rows within each category by sales
+    g = g.window([F.rank().alias("rk")],
+                 partition_by=[col("i_category")],
+                 order_by=[col("sumsales").desc()])
+    g = g.filter(col("rk") <= 5) \
+        .select("i_category", "i_class", "i_brand", "sumsales", "rk")
+    return (g.sort(col("i_category").asc(), col("rk").asc(),
+                   col("i_class").asc(), col("i_brand").asc())
+            .limit(200).collect())
+
+
+def _q67_oracle(a):
+    import pandas as pd
+    dd = a["date_dim"].filter(pc.and_(
+        pc.greater_equal(a["date_dim"]["d_month_seq"], 24),
+        pc.less_equal(a["date_dim"]["d_month_seq"], 35))) \
+        .select(["d_date_sk"])
+    it = a["item"].select(["i_item_sk", "i_category", "i_class",
+                           "i_brand"])
+    j = _oj(a["store_sales"], dd, ["ss_sold_date_sk"], ["d_date_sk"])
+    j = _oj(j, it, ["ss_item_sk"], ["i_item_sk"])
+    amt = pc.multiply(j["ss_sales_price"].cast(pa.float64()),
+                      j["ss_quantity"].cast(pa.float64()))
+    j = j.append_column("amt", amt)
+    g = _oracle_rollup(j, ["i_category", "i_class", "i_brand"],
+                       [("amt", "sum")], ["sumsales"])
+    df = g.to_pandas()
+    # rank(method='min') over sumsales desc per category (NaN category =
+    # the all-up row partitions together, like the engine's NULL keys)
+    df["rk"] = df.groupby("i_category", dropna=False)["sumsales"] \
+        .rank(method="min", ascending=False).astype("int64")
+    df = df[df.rk <= 5][["i_category", "i_class", "i_brand",
+                         "sumsales", "rk"]]
+    out = pa.Table.from_pandas(df.reset_index(drop=True),
+                               preserve_index=False)
+    return _topn(out, [("i_category", "ascending"), ("rk", "ascending"),
+                       ("i_class", "ascending"), ("i_brand", "ascending")],
+                 200)
+
+
+_q("q67", "top sales rows per category over ROLLUP(cat, class, brand)")(
+    (_q67_run, _q67_oracle))
+
+
+def _q86_run(s, t):
+    ws = _rd(s, t, "web_sales").select("ws_sold_date_sk", "ws_item_sk",
+                                       "ws_ext_sales_price")
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_month_seq") >= 12) & (col("d_month_seq") <= 23)) \
+        .select("d_date_sk")
+    it = _rd(s, t, "item").select("i_item_sk", "i_category", "i_class")
+    j = _join_dim(ws, dd, "ws_sold_date_sk", "d_date_sk")
+    j = _join_dim(j, it, "ws_item_sk", "i_item_sk")
+    g = (j.rollup("i_category", "i_class")
+         .agg(F.sum(col("ws_ext_sales_price").cast(DataType.FLOAT64))
+              .alias("total_sum")))
+    g = g.with_column(
+        "lochierarchy",
+        (col("spark_grouping_id") % lit(2, DataType.INT32))
+        + (col("spark_grouping_id") / lit(2, DataType.INT32)))
+    g = g.select("total_sum", "i_category", "i_class", "lochierarchy")
+    return (g.sort(col("lochierarchy").desc(), col("total_sum").desc(),
+                   col("i_category").asc(), col("i_class").asc())
+            .limit(100).collect())
+
+
+def _q86_oracle(a):
+    dd = a["date_dim"].filter(pc.and_(
+        pc.greater_equal(a["date_dim"]["d_month_seq"], 12),
+        pc.less_equal(a["date_dim"]["d_month_seq"], 23))) \
+        .select(["d_date_sk"])
+    it = a["item"].select(["i_item_sk", "i_category", "i_class"])
+    j = _oj(a["web_sales"], dd, ["ws_sold_date_sk"], ["d_date_sk"])
+    j = _oj(j, it, ["ws_item_sk"], ["i_item_sk"])
+    j = j.set_column(j.column_names.index("ws_ext_sales_price"),
+                     "ws_ext_sales_price",
+                     j["ws_ext_sales_price"].cast(pa.float64()))
+    g = _oracle_rollup(j, ["i_category", "i_class"],
+                       [("ws_ext_sales_price", "sum")], ["total_sum"])
+    gid = g["spark_grouping_id"]
+    loch = pc.add(pc.bit_wise_and(gid, 1), pc.shift_right(gid, 1))
+    g = pa.table({"total_sum": g["total_sum"],
+                  "i_category": g["i_category"],
+                  "i_class": g["i_class"],
+                  "lochierarchy": loch.cast(pa.int32())})
+    return _topn(g, [("lochierarchy", "descending"),
+                     ("total_sum", "descending"),
+                     ("i_category", "ascending"),
+                     ("i_class", "ascending")])
+
+
+_q("q86", "web revenue ROLLUP(i_category, i_class) with hierarchy level")(
+    (_q86_run, _q86_oracle))
+
+
+# ===========================================================================
+# EXISTS / IN-correlated family: Spark lowers these to semi/anti joins
+# before the physical plan (RewritePredicateSubquery), which is exactly
+# what the engine's semi/anti hash joins execute.
+# ===========================================================================
+
+def _q10_run(s, t):
+    # q10-class: demographics of customers in selected counties WITH a
+    # store purchase in the period (EXISTS → semi join). The template's
+    # web/catalog EXISTS legs need customer keys those facts don't carry
+    # in this schema subset.
+    c = _rd(s, t, "customer").select("c_customer_sk", "c_current_cdemo_sk",
+                                     "c_current_addr_sk")
+    ca = _rd(s, t, "customer_address").filter(
+        col("ca_county").isin("Ziebach County", "Walker County",
+                              "Daviess County")) \
+        .select("ca_address_sk")
+    c = _join_dim(c, ca, "c_current_addr_sk", "ca_address_sk")
+    ss = _rd(s, t, "store_sales").select("ss_customer_sk",
+                                         "ss_sold_date_sk")
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_year") == 2000) & (col("d_moy") >= 1) & (col("d_moy") <= 4)) \
+        .select("d_date_sk")
+    buyers = _join_dim(ss, dd, "ss_sold_date_sk", "d_date_sk") \
+        .select(col("ss_customer_sk").alias("c_customer_sk"))
+    c = c.join(buyers, on="c_customer_sk", how="semi")
+    cd = _rd(s, t, "customer_demographics").select(
+        "cd_demo_sk", "cd_gender", "cd_marital_status",
+        "cd_education_status")
+    j = _join_dim(c, cd, "c_current_cdemo_sk", "cd_demo_sk")
+    g = (j.group_by("cd_gender", "cd_marital_status",
+                    "cd_education_status")
+         .agg(F.count_star().alias("cnt")))
+    return (g.sort(col("cd_gender").asc(), col("cd_marital_status").asc(),
+                   col("cd_education_status").asc()).limit(100).collect())
+
+
+def _q10_oracle(a):
+    ca = a["customer_address"].filter(pc.is_in(
+        a["customer_address"]["ca_county"],
+        value_set=pa.array(["Ziebach County", "Walker County",
+                            "Daviess County"]))).select(["ca_address_sk"])
+    c = _oj(a["customer"], ca, ["c_current_addr_sk"], ["ca_address_sk"])
+    dd = a["date_dim"].filter(pc.and_(
+        pc.equal(a["date_dim"]["d_year"], 2000),
+        pc.and_(pc.greater_equal(a["date_dim"]["d_moy"], 1),
+                pc.less_equal(a["date_dim"]["d_moy"], 4)))) \
+        .select(["d_date_sk"])
+    ss = _oj(a["store_sales"], dd, ["ss_sold_date_sk"], ["d_date_sk"])
+    buyers = ss.select(["ss_customer_sk"]).rename_columns(
+        ["c_customer_sk"])
+    c = _oj(c, buyers, ["c_customer_sk"], how="left semi")
+    cd = a["customer_demographics"].select(
+        ["cd_demo_sk", "cd_gender", "cd_marital_status",
+         "cd_education_status"])
+    j = _oj(c, cd, ["c_current_cdemo_sk"], ["cd_demo_sk"])
+    g = j.group_by(["cd_gender", "cd_marital_status",
+                    "cd_education_status"]).aggregate([([], "count_all")]) \
+        .rename_columns(["cd_gender", "cd_marital_status",
+                         "cd_education_status", "cnt"])
+    return _topn(g, [("cd_gender", "ascending"),
+                     ("cd_marital_status", "ascending"),
+                     ("cd_education_status", "ascending")])
+
+
+_q("q10", "demographics of county customers with store purchases "
+          "(EXISTS as semi join)")((_q10_run, _q10_oracle))
+
+
+def _q35_run(s, t):
+    # q35-class: purchase-active customers' demographic aggregate battery
+    c = _rd(s, t, "customer").select("c_customer_sk", "c_current_cdemo_sk",
+                                     "c_birth_month")
+    ss = _rd(s, t, "store_sales").select("ss_customer_sk",
+                                         "ss_sold_date_sk")
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_year") == 2001) & (col("d_qoy") < 4)).select("d_date_sk")
+    buyers = _join_dim(ss, dd, "ss_sold_date_sk", "d_date_sk") \
+        .select(col("ss_customer_sk").alias("c_customer_sk"))
+    c = c.join(buyers, on="c_customer_sk", how="semi")
+    cd = _rd(s, t, "customer_demographics").select(
+        "cd_demo_sk", "cd_gender", "cd_marital_status", "cd_dep_count")
+    j = _join_dim(c, cd, "c_current_cdemo_sk", "cd_demo_sk")
+    g = (j.group_by("cd_gender", "cd_marital_status")
+         .agg(F.count_star().alias("cnt"),
+              F.avg(col("cd_dep_count").cast(DataType.FLOAT64))
+              .alias("avg_dep"),
+              F.max(col("cd_dep_count")).alias("max_dep"),
+              F.sum(col("cd_dep_count")).alias("sum_dep")))
+    return (g.sort(col("cd_gender").asc(),
+                   col("cd_marital_status").asc()).limit(100).collect())
+
+
+def _q35_oracle(a):
+    dd = a["date_dim"].filter(pc.and_(
+        pc.equal(a["date_dim"]["d_year"], 2001),
+        pc.less(a["date_dim"]["d_qoy"], 4))).select(["d_date_sk"])
+    ss = _oj(a["store_sales"], dd, ["ss_sold_date_sk"], ["d_date_sk"])
+    buyers = ss.select(["ss_customer_sk"]).rename_columns(
+        ["c_customer_sk"])
+    c = _oj(a["customer"], buyers, ["c_customer_sk"], how="left semi")
+    cd = a["customer_demographics"].select(
+        ["cd_demo_sk", "cd_gender", "cd_marital_status", "cd_dep_count"])
+    j = _oj(c, cd, ["c_current_cdemo_sk"], ["cd_demo_sk"])
+    j = j.append_column("dep_f", j["cd_dep_count"].cast(pa.float64()))
+    g = j.group_by(["cd_gender", "cd_marital_status"]).aggregate(
+        [([], "count_all"), ("dep_f", "mean"), ("cd_dep_count", "max"),
+         ("cd_dep_count", "sum")]) \
+        .rename_columns(["cd_gender", "cd_marital_status", "cnt",
+                         "avg_dep", "max_dep", "sum_dep"])
+    return _topn(g, [("cd_gender", "ascending"),
+                     ("cd_marital_status", "ascending")])
+
+
+_q("q35", "demographic aggregate battery over purchase-active customers "
+          "(IN as semi join)")((_q35_run, _q35_oracle))
+
+
+def _q69_run(s, t):
+    # q69-class: customers WITH a purchase in the period but WITHOUT any
+    # return (EXISTS + NOT EXISTS → semi + anti). The template excludes
+    # web/catalog activity, which this subset's facts cannot key by
+    # customer; store returns carry the NOT-EXISTS role.
+    c = _rd(s, t, "customer").select("c_customer_sk",
+                                     "c_current_cdemo_sk")
+    ss = _rd(s, t, "store_sales").select("ss_customer_sk",
+                                         "ss_sold_date_sk")
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_year") == 2000) & (col("d_qoy") <= 2)).select("d_date_sk")
+    buyers = _join_dim(ss, dd, "ss_sold_date_sk", "d_date_sk") \
+        .select(col("ss_customer_sk").alias("c_customer_sk"))
+    returners = _rd(s, t, "store_returns") \
+        .select(col("sr_customer_sk").alias("c_customer_sk"))
+    c = c.join(buyers, on="c_customer_sk", how="semi")
+    c = c.join(returners, on="c_customer_sk", how="anti")
+    cd = _rd(s, t, "customer_demographics").select(
+        "cd_demo_sk", "cd_gender", "cd_marital_status",
+        "cd_education_status")
+    j = _join_dim(c, cd, "c_current_cdemo_sk", "cd_demo_sk")
+    g = (j.group_by("cd_gender", "cd_marital_status",
+                    "cd_education_status")
+         .agg(F.count_star().alias("cnt")))
+    return (g.sort(col("cd_gender").asc(), col("cd_marital_status").asc(),
+                   col("cd_education_status").asc()).limit(100).collect())
+
+
+def _q69_oracle(a):
+    dd = a["date_dim"].filter(pc.and_(
+        pc.equal(a["date_dim"]["d_year"], 2000),
+        pc.less_equal(a["date_dim"]["d_qoy"], 2))).select(["d_date_sk"])
+    ss = _oj(a["store_sales"], dd, ["ss_sold_date_sk"], ["d_date_sk"])
+    buyers = ss.select(["ss_customer_sk"]).rename_columns(
+        ["c_customer_sk"])
+    returners = a["store_returns"].select(["sr_customer_sk"]) \
+        .rename_columns(["c_customer_sk"])
+    c = _oj(a["customer"], buyers, ["c_customer_sk"], how="left semi")
+    c = _oj(c, returners, ["c_customer_sk"], how="left anti")
+    cd = a["customer_demographics"].select(
+        ["cd_demo_sk", "cd_gender", "cd_marital_status",
+         "cd_education_status"])
+    j = _oj(c, cd, ["c_current_cdemo_sk"], ["cd_demo_sk"])
+    g = j.group_by(["cd_gender", "cd_marital_status",
+                    "cd_education_status"]).aggregate([([], "count_all")]) \
+        .rename_columns(["cd_gender", "cd_marital_status",
+                         "cd_education_status", "cnt"])
+    return _topn(g, [("cd_gender", "ascending"),
+                     ("cd_marital_status", "ascending"),
+                     ("cd_education_status", "ascending")])
+
+
+_q("q69", "buyers with no returns by demographics (semi + anti join)")(
+    (_q69_run, _q69_oracle))
+
+
+def _q93_run(s, t):
+    # q93: actual sales after returns — ss LEFT JOIN sr on
+    # (ticket, item); returned quantity reduces the paid amount
+    ss = _rd(s, t, "store_sales").select(
+        "ss_ticket_number", "ss_item_sk", "ss_customer_sk",
+        "ss_quantity", "ss_sales_price")
+    sr = _rd(s, t, "store_returns").select(
+        col("sr_ticket_number").alias("ss_ticket_number"),
+        col("sr_item_sk").alias("ss_item_sk"),
+        col("sr_return_quantity"))
+    j = ss.join(sr, on=["ss_ticket_number", "ss_item_sk"], how="left")
+    qty = col("ss_quantity").cast(DataType.FLOAT64)
+    ret = col("sr_return_quantity").cast(DataType.FLOAT64)
+    price = col("ss_sales_price").cast(DataType.FLOAT64)
+    act = F.if_(col("sr_return_quantity").is_not_null(),
+                (qty - ret) * price, qty * price)
+    j = j.with_column("act_sales", act)
+    g = (j.group_by("ss_customer_sk")
+         .agg(F.sum(col("act_sales")).alias("sumsales")))
+    return (g.sort(col("sumsales").asc(), col("ss_customer_sk").asc())
+            .limit(100).collect())
+
+
+def _q93_oracle(a):
+    import pandas as pd
+    ss = a["store_sales"].select(
+        ["ss_ticket_number", "ss_item_sk", "ss_customer_sk",
+         "ss_quantity", "ss_sales_price"]).to_pandas()
+    sr = a["store_returns"].select(
+        ["sr_ticket_number", "sr_item_sk", "sr_return_quantity"]) \
+        .to_pandas()
+    j = ss.merge(sr, how="left",
+                 left_on=["ss_ticket_number", "ss_item_sk"],
+                 right_on=["sr_ticket_number", "sr_item_sk"])
+    price = j.ss_sales_price.astype(float)
+    qty = j.ss_quantity.astype(float)
+    act = np.where(j.sr_return_quantity.notna(),
+                   (qty - j.sr_return_quantity.fillna(0)) * price,
+                   qty * price)
+    j["act_sales"] = act
+    g = j.groupby("ss_customer_sk", dropna=False)["act_sales"] \
+        .sum().reset_index().rename(columns={"act_sales": "sumsales"})
+    out = pa.Table.from_pandas(g, preserve_index=False)
+    return _topn(out, [("sumsales", "ascending"),
+                       ("ss_customer_sk", "ascending")])
+
+
+_q("q93", "actual sales after returns per customer (ss left-join sr)")(
+    (_q93_run, _q93_oracle))
+
+
+# ===========================================================================
+# multi-channel UNION family
+# ===========================================================================
+
+def _channel_legs(s, t, year, moy_lo, moy_hi):
+    """(ss, cs, ws) legs normalized to (item_sk, ext_price) within the
+    date window — the common scaffold of q60/q71."""
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_year") == year) & (col("d_moy") >= moy_lo)
+        & (col("d_moy") <= moy_hi)).select("d_date_sk")
+    legs = []
+    for fact, dk, ik, pk in (
+            ("store_sales", "ss_sold_date_sk", "ss_item_sk",
+             "ss_ext_sales_price"),
+            ("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+             "cs_ext_sales_price"),
+            ("web_sales", "ws_sold_date_sk", "ws_item_sk",
+             "ws_ext_sales_price")):
+        f = _rd(s, t, fact).select(dk, ik, pk)
+        f = _join_dim(f, dd, dk, "d_date_sk")
+        legs.append(f.select(
+            col(ik).alias("item_sk"),
+            col(pk).cast(DataType.FLOAT64).alias("ext_price")))
+    return legs
+
+
+def _oracle_channel_legs(a, year, moy_lo, moy_hi):
+    dd = a["date_dim"].filter(pc.and_(
+        pc.equal(a["date_dim"]["d_year"], year),
+        pc.and_(pc.greater_equal(a["date_dim"]["d_moy"], moy_lo),
+                pc.less_equal(a["date_dim"]["d_moy"], moy_hi)))) \
+        .select(["d_date_sk"])
+    legs = []
+    for fact, dk, ik, pk in (
+            ("store_sales", "ss_sold_date_sk", "ss_item_sk",
+             "ss_ext_sales_price"),
+            ("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+             "cs_ext_sales_price"),
+            ("web_sales", "ws_sold_date_sk", "ws_item_sk",
+             "ws_ext_sales_price")):
+        f = _oj(a[fact].select([dk, ik, pk]), dd, [dk], ["d_date_sk"])
+        legs.append(pa.table({
+            "item_sk": f[ik],
+            "ext_price": f[pk].cast(pa.float64())}))
+    return legs
+
+
+def _q60_run(s, t):
+    # q60: total cross-channel revenue per item id in one category/month
+    legs = _channel_legs(s, t, 1999, 8, 9)
+    u = legs[0].union(legs[1]).union(legs[2])
+    it = _rd(s, t, "item").filter(col("i_category") == "Music") \
+        .select(col("i_item_sk").alias("item_sk"), col("i_item_id"))
+    j = u.join(it, on="item_sk", how="inner")
+    g = (j.group_by("i_item_id")
+         .agg(F.sum(col("ext_price")).alias("total_sales")))
+    return (g.sort(col("i_item_id").asc(), col("total_sales").asc())
+            .limit(100).collect())
+
+
+def _q60_oracle(a):
+    legs = _oracle_channel_legs(a, 1999, 8, 9)
+    u = pa.concat_tables(legs)
+    it = a["item"].filter(pc.equal(a["item"]["i_category"], "Music")) \
+        .select(["i_item_sk", "i_item_id"]) \
+        .rename_columns(["item_sk", "i_item_id"])
+    j = _oj(u, it, ["item_sk"])
+    g = j.group_by(["i_item_id"]).aggregate([("ext_price", "sum")]) \
+        .rename_columns(["i_item_id", "total_sales"])
+    return _topn(g, [("i_item_id", "ascending"),
+                     ("total_sales", "ascending")])
+
+
+_q("q60", "cross-channel item revenue in one category (3-way UNION)")(
+    (_q60_run, _q60_oracle))
+
+
+def _q71_run(s, t):
+    # q71-class: brand revenue across all three channels for one month
+    # under one manager (the template also splits by time-of-day; only
+    # the store fact carries a time key in this subset)
+    legs = _channel_legs(s, t, 2000, 12, 12)
+    u = legs[0].union(legs[1]).union(legs[2])
+    it = _rd(s, t, "item").filter(col("i_manager_id") == 1) \
+        .select(col("i_item_sk").alias("item_sk"), col("i_brand_id"),
+                col("i_brand"))
+    j = u.join(it, on="item_sk", how="inner")
+    g = (j.group_by("i_brand_id", "i_brand")
+         .agg(F.sum(col("ext_price")).alias("ext_price_sum")))
+    return (g.sort(col("ext_price_sum").desc(), col("i_brand_id").asc())
+            .limit(100).collect())
+
+
+def _q71_oracle(a):
+    legs = _oracle_channel_legs(a, 2000, 12, 12)
+    u = pa.concat_tables(legs)
+    it = a["item"].filter(pc.equal(a["item"]["i_manager_id"], 1)) \
+        .select(["i_item_sk", "i_brand_id", "i_brand"]) \
+        .rename_columns(["item_sk", "i_brand_id", "i_brand"])
+    j = _oj(u, it, ["item_sk"])
+    g = j.group_by(["i_brand_id", "i_brand"]).aggregate(
+        [("ext_price", "sum")]) \
+        .rename_columns(["i_brand_id", "i_brand", "ext_price_sum"])
+    return _topn(g, [("ext_price_sum", "descending"),
+                     ("i_brand_id", "ascending")])
+
+
+_q("q71", "brand revenue across three channels for one manager/month")(
+    (_q71_run, _q71_oracle))
+
+
+def _q76_run(s, t):
+    # q76: per-channel sales rows whose surrogate key is NULL, unioned
+    # and counted by (channel, null-column tag, year, quarter, category)
+    it = _rd(s, t, "item").select("i_item_sk", "i_category")
+    dd = _rd(s, t, "date_dim").select("d_date_sk", "d_year", "d_qoy")
+    legs = []
+    for fact, dk, ik, pk, nullk, chan in (
+            ("store_sales", "ss_sold_date_sk", "ss_item_sk",
+             "ss_ext_sales_price", "ss_promo_sk", "store"),
+            ("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+             "cs_ext_sales_price", "cs_warehouse_sk", "catalog"),
+            ("web_sales", "ws_sold_date_sk", "ws_item_sk",
+             "ws_ext_sales_price", "ws_ship_mode_sk", "web")):
+        f = _rd(s, t, fact).select(dk, ik, pk, nullk)
+        f = f.filter(col(nullk).is_null())
+        f = _join_dim(f, it, ik, "i_item_sk")
+        f = _join_dim(f, dd, dk, "d_date_sk")
+        legs.append(f.select(
+            lit(chan, DataType.STRING).alias("channel"),
+            lit(nullk, DataType.STRING).alias("col_name"),
+            col("d_year"), col("d_qoy"), col("i_category"),
+            col(pk).cast(DataType.FLOAT64).alias("ext_price")))
+    u = legs[0].union(legs[1]).union(legs[2])
+    g = (u.group_by("channel", "col_name", "d_year", "d_qoy",
+                    "i_category")
+         .agg(F.count_star().alias("sales_cnt"),
+              F.sum(col("ext_price")).alias("sales_amt")))
+    return (g.sort(col("channel").asc(), col("col_name").asc(),
+                   col("d_year").asc(), col("d_qoy").asc(),
+                   col("i_category").asc()).limit(200).collect())
+
+
+def _q76_oracle(a):
+    it = a["item"].select(["i_item_sk", "i_category"])
+    dd = a["date_dim"].select(["d_date_sk", "d_year", "d_qoy"])
+    legs = []
+    for fact, dk, ik, pk, nullk, chan in (
+            ("store_sales", "ss_sold_date_sk", "ss_item_sk",
+             "ss_ext_sales_price", "ss_promo_sk", "store"),
+            ("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+             "cs_ext_sales_price", "cs_warehouse_sk", "catalog"),
+            ("web_sales", "ws_sold_date_sk", "ws_item_sk",
+             "ws_ext_sales_price", "ws_ship_mode_sk", "web")):
+        f = a[fact].select([dk, ik, pk, nullk])
+        f = f.filter(pc.is_null(f[nullk]))
+        f = _oj(f, it, [ik], ["i_item_sk"])
+        f = _oj(f, dd, [dk], ["d_date_sk"])
+        legs.append(pa.table({
+            # explicit string type: an EMPTY leg would otherwise infer
+            # null-typed columns and break concat_tables
+            "channel": pa.array([chan] * f.num_rows, pa.string()),
+            "col_name": pa.array([nullk] * f.num_rows, pa.string()),
+            "d_year": f["d_year"], "d_qoy": f["d_qoy"],
+            "i_category": f["i_category"],
+            "ext_price": f[pk].cast(pa.float64())}))
+    u = pa.concat_tables(legs)
+    g = u.group_by(["channel", "col_name", "d_year", "d_qoy",
+                    "i_category"]).aggregate(
+        [([], "count_all"), ("ext_price", "sum")]) \
+        .rename_columns(["channel", "col_name", "d_year", "d_qoy",
+                         "i_category", "sales_cnt", "sales_amt"])
+    return _topn(g, [("channel", "ascending"), ("col_name", "ascending"),
+                     ("d_year", "ascending"), ("d_qoy", "ascending"),
+                     ("i_category", "ascending")], 200)
+
+
+_q("q76", "null-key sales rows by channel (3-way UNION, wide group)")(
+    (_q76_run, _q76_oracle))
+
+
+# ===========================================================================
+# q9: CASE buckets chosen by scalar subqueries (one-row projection)
+# ===========================================================================
+
+def _q9_run(s, t):
+    ss = _rd(s, t, "store_sales")
+    buckets = []
+    for lo, hi in ((1, 20), (21, 40), (41, 60)):
+        b = ss.filter((col("ss_quantity") >= lo)
+                      & (col("ss_quantity") <= hi))
+        cnt = scalar_subquery(
+            b.group_by().agg(F.count_star().alias("c")))
+        avg_paid = scalar_subquery(
+            b.group_by().agg(
+                F.avg(col("ss_net_paid").cast(DataType.FLOAT64))
+                .alias("a")))
+        avg_list = scalar_subquery(
+            b.group_by().agg(
+                F.avg(col("ss_ext_list_price").cast(DataType.FLOAT64))
+                .alias("a")))
+        buckets.append(F.if_(cnt > lit(1000, DataType.INT64),
+                             avg_paid, avg_list))
+    one = _rd(s, t, "date_dim").limit(1)
+    return one.select(buckets[0].alias("bucket1"),
+                      buckets[1].alias("bucket2"),
+                      buckets[2].alias("bucket3")).collect()
+
+
+def _q9_oracle(a):
+    ss = a["store_sales"]
+    out = {}
+    for i, (lo, hi) in enumerate(((1, 20), (21, 40), (41, 60)), 1):
+        m = pc.and_(pc.greater_equal(ss["ss_quantity"], lo),
+                    pc.less_equal(ss["ss_quantity"], hi))
+        b = ss.filter(m)
+        if b.num_rows > 1000:
+            v = pc.mean(b["ss_net_paid"].cast(pa.float64())).as_py()
+        else:
+            v = pc.mean(b["ss_ext_list_price"].cast(pa.float64())).as_py()
+        out[f"bucket{i}"] = [v]
+    return pa.table(out)
+
+
+_q("q9", "quantity-bucket averages selected by scalar subqueries")(
+    (_q9_run, _q9_oracle))
+
+
+# ===========================================================================
+# q40: catalog sales around a pivot date by warehouse (CASE split)
+# ===========================================================================
+
+def _q40_run(s, t):
+    pivot = DATE_SK0 + 730
+    cs = _rd(s, t, "catalog_sales").select(
+        "cs_sold_date_sk", "cs_item_sk", "cs_warehouse_sk",
+        "cs_sales_price")
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_date_sk") >= pivot - 30) & (col("d_date_sk") <= pivot + 30)) \
+        .select("d_date_sk")
+    w = _rd(s, t, "warehouse").select("w_warehouse_sk", "w_warehouse_name")
+    it = _rd(s, t, "item").filter(
+        (col("i_current_price") >= lit(0.99))
+        & (col("i_current_price") <= lit(150.00))) \
+        .select("i_item_sk", "i_item_id")
+    j = _join_dim(cs, dd, "cs_sold_date_sk", "d_date_sk")
+    j = _join_dim(j, w, "cs_warehouse_sk", "w_warehouse_sk")
+    j = _join_dim(j, it, "cs_item_sk", "i_item_sk")
+    price = col("cs_sales_price").cast(DataType.FLOAT64)
+    before = F.if_(col("cs_sold_date_sk") < lit(pivot, DataType.INT64),
+                   price, lit(0.0))
+    after = F.if_(col("cs_sold_date_sk") >= lit(pivot, DataType.INT64),
+                  price, lit(0.0))
+    j = j.with_column("before_amt", before).with_column("after_amt", after)
+    g = (j.group_by("w_warehouse_name", "i_item_id")
+         .agg(F.sum(col("before_amt")).alias("sales_before"),
+              F.sum(col("after_amt")).alias("sales_after")))
+    return (g.sort(col("w_warehouse_name").asc(), col("i_item_id").asc())
+            .limit(100).collect())
+
+
+def _q40_oracle(a):
+    pivot = DATE_SK0 + 730
+    dd = a["date_dim"].filter(pc.and_(
+        pc.greater_equal(a["date_dim"]["d_date_sk"], pivot - 30),
+        pc.less_equal(a["date_dim"]["d_date_sk"], pivot + 30))) \
+        .select(["d_date_sk"])
+    w = a["warehouse"].select(["w_warehouse_sk", "w_warehouse_name"])
+    it = a["item"].filter(pc.and_(
+        pc.greater_equal(a["item"]["i_current_price"].cast(pa.float64()),
+                         0.99),
+        pc.less_equal(a["item"]["i_current_price"].cast(pa.float64()),
+                      150.0))).select(["i_item_sk", "i_item_id"])
+    j = _oj(a["catalog_sales"], dd, ["cs_sold_date_sk"], ["d_date_sk"])
+    j = _oj(j, w, ["cs_warehouse_sk"], ["w_warehouse_sk"])
+    j = _oj(j, it, ["cs_item_sk"], ["i_item_sk"])
+    price = j["cs_sales_price"].cast(pa.float64())
+    isb = pc.less(j["cs_sold_date_sk"], pivot)
+    j = j.append_column("before_amt",
+                        pc.if_else(isb, price, pa.scalar(0.0)))
+    j = j.append_column("after_amt",
+                        pc.if_else(pc.invert(isb), price, pa.scalar(0.0)))
+    g = j.group_by(["w_warehouse_name", "i_item_id"]).aggregate(
+        [("before_amt", "sum"), ("after_amt", "sum")]) \
+        .rename_columns(["w_warehouse_name", "i_item_id",
+                         "sales_before", "sales_after"])
+    return _topn(g, [("w_warehouse_name", "ascending"),
+                     ("i_item_id", "ascending")])
+
+
+_q("q40", "catalog sales before/after a pivot date by warehouse (CASE)")(
+    (_q40_run, _q40_oracle))

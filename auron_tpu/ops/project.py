@@ -36,7 +36,8 @@ def _project_kernel(exprs: tuple, in_schema: Schema, capacity: int):
 
     @jax.jit
     def kernel(batch: DeviceBatch, partition_id, row_num_offset):
-        ctx = EvalContext(partition_id=partition_id, row_num_offset=row_num_offset)
+        ctx = EvalContext(partition_id=partition_id,
+                          row_num_offset=row_num_offset, memo={})
         cols = tuple(evaluate(e, batch, in_schema, ctx).col for e in exprs)
         return DeviceBatch(cols, batch.num_rows)
 
@@ -47,7 +48,8 @@ def _project_kernel(exprs: tuple, in_schema: Schema, capacity: int):
 def _filter_kernel(predicates: tuple, in_schema: Schema, capacity: int):
     @jax.jit
     def kernel(batch: DeviceBatch, partition_id, row_num_offset):
-        ctx = EvalContext(partition_id=partition_id, row_num_offset=row_num_offset)
+        ctx = EvalContext(partition_id=partition_id,
+                          row_num_offset=row_num_offset, memo={})
         keep = batch.row_mask()
         for p in predicates:
             v = evaluate(p, batch, in_schema, ctx)
@@ -62,7 +64,8 @@ def _filter_project_kernel(predicates: tuple, exprs: tuple, in_schema: Schema,
                            capacity: int):
     @jax.jit
     def kernel(batch: DeviceBatch, partition_id, row_num_offset):
-        ctx = EvalContext(partition_id=partition_id, row_num_offset=row_num_offset)
+        ctx = EvalContext(partition_id=partition_id,
+                          row_num_offset=row_num_offset, memo={})
         keep = batch.row_mask()
         for p in predicates:
             v = evaluate(p, batch, in_schema, ctx)

@@ -161,7 +161,7 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
 
     @jax.jit
     def kernel(batch: DeviceBatch):
-        ectx = EvalContext()
+        ectx = EvalContext(memo={})
         pcols = [evaluate(e, batch, in_schema, ectx).col
                  for e in partition_exprs]
         ocols = [evaluate(o.expr, batch, in_schema, ectx).col

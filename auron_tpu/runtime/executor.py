@@ -50,6 +50,13 @@ class ExecutionRuntime:
             config=config,
         )
         self._started = time.time()
+        # per-task XLA compile attribution (round-5 directive 7): NEW
+        # program builds during this task surface in the finalize metrics
+        try:
+            from auron_tpu.utils import compile_stats
+            self._compile_start = compile_stats.snapshot()
+        except Exception:
+            self._compile_start = None
 
     def batches(self) -> Iterator[DeviceBatch]:
         """Device-batch stream (stays on device; used for stage chaining).
@@ -104,6 +111,11 @@ class ExecutionRuntime:
         With profiling on, attaches the trace directory and the per-op
         device-time attribution (the flamegraph's data, queryable)."""
         snap = self.ctx.metrics_snapshot()
+        if self._compile_start is not None:
+            from auron_tpu.utils import compile_stats
+            d = compile_stats.delta(self._compile_start)
+            snap["xla_compiles"] = d.count
+            snap["xla_compile_seconds"] = round(d.seconds, 4)
         if getattr(self, "profile_dir", None):
             op_times = {
                 op: vals["elapsed_compute"] * 1e-9   # counters are ns

@@ -1,0 +1,63 @@
+"""Process-wide XLA compile accounting (round-5 directive 7).
+
+The reference pays plan-build per task but never kernel-compile per query
+(DataFusion's physical operators are interpreted, planner.rs:121-856); on
+this engine every jitted kernel is an XLA program, so compile latency is
+a first-class perf axis — on a real TPU a single program build costs
+seconds over the tunnel. This module hooks ``jax.monitoring``'s
+``backend_compile_duration`` event (fired on every real backend compile,
+including shape-driven recompiles that python-level kernel caches cannot
+see) and exposes cheap snapshots so the executor and the TPC-DS runner
+can attribute compiles and compile-seconds per task / per query.
+
+A healthy steady state compiles ~0 new programs: kernels are cached by
+(exprs, schema, bucketed capacity), so re-running a query suite in one
+process should be all cache hits — ``delta()`` makes that measurable.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import NamedTuple
+
+_LOCK = threading.Lock()
+_N = {"count": 0}
+_S = {"seconds": 0.0}
+_INSTALLED = False
+
+_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class CompileSnapshot(NamedTuple):
+    count: int
+    seconds: float
+
+
+def install() -> None:
+    """Register the monitoring listener once per process (idempotent)."""
+    global _INSTALLED
+    with _LOCK:
+        if _INSTALLED:
+            return
+        import jax.monitoring as mon
+
+        def _listen(name: str, dur: float, **_kw) -> None:
+            if name == _EVENT:
+                with _LOCK:
+                    _N["count"] += 1
+                    _S["seconds"] += dur
+
+        mon.register_event_duration_secs_listener(_listen)
+        _INSTALLED = True
+
+
+def snapshot() -> CompileSnapshot:
+    install()
+    with _LOCK:
+        return CompileSnapshot(_N["count"], _S["seconds"])
+
+
+def delta(since: CompileSnapshot) -> CompileSnapshot:
+    now = snapshot()
+    return CompileSnapshot(now.count - since.count,
+                           now.seconds - since.seconds)

@@ -174,6 +174,13 @@ def _child_main() -> None:
         "unit": "rows/s",
         "vs_baseline": round(dev_rps / cpu_rps, 3),
         "vs_baseline_mc": round(dev_rps / mc_rps, 3),
+        # hosts with <8 cores cannot measure the >=8-core denominator;
+        # BASELINE.md pins linear scaling to 8 threads as the documented
+        # stand-in, so scale the measured mc figure by 8/threads
+        # (replace with a measured figure on the first >=8-core host)
+        "vs_baseline_mc_pinned8": round(
+            dev_rps / (mc_rps * max(1.0, 8.0 / (os.cpu_count() or 1))),
+            4),
         "baseline_mc_rows_per_sec": round(mc_rps, 1),
         "baseline_mc_threads": os.cpu_count() or 1,
         "platform": platform,

@@ -1,0 +1,111 @@
+"""XLA compile accounting (round-5 directive 7): compiles and compile
+seconds are attributed per task / per query, and a warm in-process rerun
+compiles ~0 new programs (kernel caches key on exprs + schema + bucketed
+capacity, so identical queries reuse every program)."""
+
+import numpy as np
+import pyarrow as pa
+
+from auron_tpu.frontend import Session, col, functions as F
+from auron_tpu.utils import compile_stats
+
+
+def _run_query(s):
+    t = s.table("t")
+    return (t.filter(col("v") > 0.0)
+            .group_by("k").agg(F.sum(col("v")).alias("s"),
+                               F.count_star().alias("n"))
+            .sort(col("k").asc())
+            .collect())
+
+
+def _fresh_session():
+    s = Session()
+    rng = np.random.default_rng(3)
+    s.register("t", pa.table({
+        "k": pa.array(rng.integers(0, 10, 500), pa.int64()),
+        "v": pa.array(rng.normal(size=500), pa.float64()),
+    }))
+    return s
+
+
+def test_warm_rerun_compiles_nothing():
+    first = compile_stats.snapshot()
+    r1 = _run_query(_fresh_session())
+    d1 = compile_stats.delta(first)
+    # cold run builds at least one program (unless an earlier test in
+    # this process already warmed the exact kernels)
+    warm = compile_stats.snapshot()
+    r2 = _run_query(_fresh_session())
+    d2 = compile_stats.delta(warm)
+    assert r1.equals(r2)
+    assert d2.count == 0, (
+        f"warm rerun built {d2.count} new XLA programs "
+        f"(cold run built {d1.count}) — kernel cache keying regressed")
+
+
+def test_task_metrics_carry_compile_attribution():
+    from auron_tpu.ir.planner import PlannerContext, plan_from_bytes
+    from auron_tpu.runtime.executor import ExecutionRuntime, TaskDefinition
+    from auron_tpu.ir import pb
+    rng = np.random.default_rng(4)
+    tbl = pa.table({"k": pa.array(rng.integers(0, 4, 100), pa.int64())})
+    scan = pb.PlanNode(memory_scan=pb.MemoryScanNode(table_name="t"))
+    task = pb.TaskDefinition(plan=scan, task_id=1).SerializeToString()
+    op = plan_from_bytes(task, PlannerContext(catalog={"t": tbl}))
+    rt = ExecutionRuntime(op, TaskDefinition())
+    for _ in rt.batches():
+        pass
+    m = rt.finalize()
+    assert "xla_compiles" in m and "xla_compile_seconds" in m
+    assert m["xla_compiles"] >= 0 and m["xla_compile_seconds"] >= 0.0
+
+
+def test_runner_reports_compile_budget(capsys):
+    from auron_tpu.it.runner import run_tpcds
+    rs = run_tpcds(scale=0.02, names=["q3"], verbose=True)
+    assert len(rs) == 1
+    out = capsys.readouterr().out
+    assert "compile budget:" in out
+    assert rs[0].compiles >= 0 and rs[0].compile_s >= 0.0
+
+
+def test_common_subexpression_evaluates_once():
+    """CSE (reference: cached_exprs_evaluator.rs): the same host-UDF
+    subexpression used in several projection outputs runs its callback
+    once per batch, not once per use."""
+    import pyarrow as pa
+
+    from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+    from auron_tpu.columnar.schema import DataType
+    from auron_tpu.exprs import ir, udf
+    from auron_tpu.io.parquet import MemoryScanOp
+    from auron_tpu.ops.project import ProjectOp
+    from auron_tpu.runtime.executor import collect
+
+    calls = {"n": 0}
+
+    def slow_fn(arrays):
+        import pyarrow.compute as pc
+        calls["n"] += 1
+        return pc.multiply(arrays[0], 2.0)
+
+    udf.register_udf("cse_probe", slow_fn, DataType.FLOAT64)
+    rb = pa.record_batch({"v": pa.array([1.0, 2.0, 3.0], pa.float64())})
+    shared = ir.ScalarFunction(
+        "coalesce",
+        (ir.HostUDF(slow_fn, (ir.ColumnRef(0),), DataType.FLOAT64,
+                    "cse_probe"),
+         ir.Literal(0.0, DataType.FLOAT64)))
+    op = ProjectOp(
+        MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=8),
+        [ir.BinaryExpr("+", shared, ir.Literal(1.0, DataType.FLOAT64)),
+         ir.BinaryExpr("*", shared, ir.Literal(3.0, DataType.FLOAT64)),
+         shared],
+        ["a", "b", "c"])
+    got = collect(op)
+    assert got.column("a").to_pylist() == [3.0, 5.0, 7.0]
+    assert got.column("b").to_pylist() == [6.0, 12.0, 18.0]
+    assert got.column("c").to_pylist() == [2.0, 4.0, 6.0]
+    assert calls["n"] == 1, \
+        f"shared subexpression ran {calls['n']} times (expected 1)"

@@ -1,6 +1,6 @@
 """The real-schema TPC-DS gate at CI scale (VERDICT r3 directive 2).
 
-26 genuine TPC-DS query shapes run through the full engine pipeline
+40 genuine TPC-DS query shapes run through the full engine pipeline
 (DataFrame DSL → protobuf plans → operators with exchanges) and diff
 against the pyarrow/Acero oracle. CI runs scale 0.05 (50k fact rows —
 every operator still multi-batch); `python -m auron_tpu.it.runner
@@ -26,7 +26,7 @@ def results():
 
 
 def test_all_queries_present(results):
-    assert len(results) == len(QUERIES) == 26
+    assert len(results) == len(QUERIES) == 40
 
 
 @pytest.mark.parametrize("qname", [q.name for q in QUERIES])
@@ -35,9 +35,10 @@ def test_query_matches_oracle(results, qname):
     assert r.ok, r.report()
 
 
-def test_enough_queries_return_rows(results):
-    """Guard against a silently over-selective dataset: a passing suite
-    where most queries return nothing would prove little."""
-    nonempty = sum(1 for r in results.values() if r.rows > 0)
-    assert nonempty >= len(results) * 2 // 3, \
-        {n: r.rows for n, r in results.items()}
+@pytest.mark.parametrize("qname", [q.name for q in QUERIES])
+def test_query_returns_rows(results, qname):
+    """EVERY query must return rows at CI scale (round-5 directive 6):
+    parameters are auto-tuned against the generated data, so an empty
+    result means the query proved nothing and its parameters regressed."""
+    assert results[qname].rows > 0, \
+        f"{qname} returned 0 rows at scale {_SCALE}"
