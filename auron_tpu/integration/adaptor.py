@@ -1,0 +1,122 @@
+"""Host-engine adaptor SPI — the engine-agnostic core seam.
+
+The reference keeps its core engine-agnostic behind the ``AuronAdaptor``
+service-provider interface: Spark and Flink each ship an adaptor
+discovered via ServiceLoader, and everything below the adaptor (JNI
+bridge, runtime, operators) never mentions a host engine (reference:
+auron-core/src/main/java/org/apache/auron/AuronAdaptor.java + the
+MockAuronAdaptor test double exercising the wrapper lifecycle without
+Spark). This module is that seam for this engine: an adaptor converts a
+host plan into the protobuf IR, supplies fallback-subtree rows, and
+declares config overrides; the registry is the ServiceLoader analogue.
+
+Shipped adaptors:
+  * ``SparkAdaptor`` — wraps integration/spark_converter (plan.toJSON in)
+  * ``StreamingCalcAdaptor`` — the Flink-shaped streaming host: its
+    "plan" is a calc spec the streaming CalcOperator drives per batch
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from auron_tpu.ir import pb
+
+_REGISTRY: dict[str, "HostEngineAdaptor"] = {}
+
+
+def register_adaptor(adaptor: "HostEngineAdaptor") -> None:
+    _REGISTRY[adaptor.name] = adaptor
+
+
+def get_adaptor(name: str) -> "HostEngineAdaptor":
+    if name not in _REGISTRY:
+        raise KeyError(f"no host adaptor {name!r} registered "
+                       f"(known: {sorted(_REGISTRY)})")
+    return _REGISTRY[name]
+
+
+def registered_adaptors() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+class HostEngineAdaptor:
+    """SPI: what a host engine must provide to attach to this engine."""
+
+    #: registry key (ServiceLoader analogue)
+    name: str = "abstract"
+
+    def convert_plan(self, raw_plan, path_rewrite=None):
+        """Host plan (engine-native encoding) → (pb.PlanNode, report).
+        ``report`` must expose ``never_converted`` and ``boundaries``
+        like integration.spark_converter.ConversionReport."""
+        raise NotImplementedError
+
+    def fallback_provider(self) -> Optional[Callable]:
+        """Callable(table, exec_class, columns) -> pa.Table executing an
+        unconvertible subtree host-side, or None when the host engine
+        has no interpreter of its own."""
+        return None
+
+    def config_overrides(self) -> dict:
+        """Engine-specific typed-config overrides (reference: each
+        adaptor binds its host's conf system, SparkAuronConfiguration /
+        FlinkAuronConfiguration)."""
+        return {}
+
+
+class SparkAdaptor(HostEngineAdaptor):
+    name = "spark"
+
+    def __init__(self, spark_version: str = "3.5.0"):
+        self.spark_version = spark_version
+
+    def convert_plan(self, raw_plan, path_rewrite=None):
+        from auron_tpu.integration.spark_converter import SparkPlanConverter
+        conv = SparkPlanConverter(path_rewrite=path_rewrite,
+                                  spark_version=self.spark_version)
+        return conv.convert(raw_plan)
+
+
+class StreamingCalcAdaptor(HostEngineAdaptor):
+    """The Flink-shaped host: a raw plan here is a calc spec
+    ``{"exprs": [ExprNode json...], "names": [...]}`` applied over the
+    CalcOperator's buffered input (reference: FlinkNodeConverter
+    translating Calc nodes into the same protobuf IR the Spark side
+    uses — one IR, many hosts)."""
+
+    name = "streaming_calc"
+
+    def convert_plan(self, raw_plan, path_rewrite=None):
+        import json as _json
+
+        from google.protobuf import json_format
+
+        from auron_tpu.integration.spark_converter import ConversionReport
+        from auron_tpu.streaming.calc_operator import INPUT_TABLE
+        spec = raw_plan if isinstance(raw_plan, dict) \
+            else _json.loads(raw_plan)
+        scan = pb.PlanNode(memory_scan=pb.MemoryScanNode(
+            table_name=INPUT_TABLE))
+        exprs = [json_format.ParseDict(e, pb.ExprNode())
+                 for e in spec["exprs"]]
+        node = pb.PlanNode(project=pb.ProjectNode(
+            child=scan, exprs=exprs, names=list(spec["names"])))
+        if spec.get("predicates"):
+            preds = [json_format.ParseDict(e, pb.ExprNode())
+                     for e in spec["predicates"]]
+            node = pb.PlanNode(project=pb.ProjectNode(
+                child=pb.PlanNode(filter=pb.FilterNode(
+                    child=scan, predicates=preds)),
+                exprs=exprs, names=list(spec["names"])))
+        report = ConversionReport()
+
+        class _N:
+            simple_name = "StreamCalc"
+        report.tag(_N(), True)
+        return node, report
+
+
+# default registrations (the "service files" of this engine)
+register_adaptor(SparkAdaptor())
+register_adaptor(StreamingCalcAdaptor())

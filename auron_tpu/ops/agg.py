@@ -1701,6 +1701,7 @@ class AggOp(PhysicalOp):
             skipped_rows = metrics.counter("partial_agg_skipped_rows")
             try:
                 for batch in self.child.execute(partition, ctx):
+                    ctx.check_cancelled()
                     if skipping:
                         keys, accs, live = self._contributions(
                             batch, in_schema, ectx)

@@ -121,10 +121,48 @@ class ExecContext:
     num_partitions: int = 1
     metrics: dict[str, MetricsSet] = field(default_factory=dict)
     mem_manager: Optional[object] = None
-    # cancellation flag checked by long-running operators
-    cancelled: bool = False
+    #: shared cancellation flag (reference: cancel_all_tasks registry,
+    #: execution_context.rs:452 + is_task_running checks, rt.rs:208-238).
+    #: A threading.Event created EAGERLY so derived contexts (ctx.child)
+    #: always share the same registry object — a lazily-created event
+    #: would not reach children built before the first cancel; the host
+    #: (serving handler, task-kill) flips it from another thread and
+    #: operators poll between batches.
+    cancel_event: object = field(default_factory=lambda: _new_event())
     # typed config (auron_tpu.config); None = process-wide defaults
     config: Optional[object] = None
+
+    def child(self, **overrides) -> "ExecContext":
+        """Derived context for a sub-execution (the map side of an
+        exchange, a subquery, a broadcast build): inherits the memory
+        manager, config AND the cancellation registry — a cancel on the
+        parent must reach every nested execution — while identity fields
+        (stage/partition/task) and metrics may be overridden."""
+        base = dict(
+            stage_id=self.stage_id, partition_id=self.partition_id,
+            task_id=self.task_id, num_partitions=self.num_partitions,
+            metrics=self.metrics, mem_manager=self.mem_manager,
+            cancel_event=self.cancel_event, config=self.config)
+        base.update(overrides)
+        return ExecContext(**base)
+
+    def cancel(self) -> None:
+        """Flip the task's cancellation flag (thread-safe)."""
+        self.cancel_event.set()
+
+    @property
+    def cancelled(self) -> bool:
+        ev = self.cancel_event
+        return ev is not None and ev.is_set()
+
+    def check_cancelled(self) -> None:
+        """Raise TaskCancelled if the host tore this task down — called
+        by operators between child batches so a cancel lands within one
+        batch of compute."""
+        if self.cancelled:
+            raise TaskCancelled(
+                f"task {self.task_id} (stage {self.stage_id}, partition "
+                f"{self.partition_id}) was cancelled")
 
     @property
     def conf(self):
@@ -151,6 +189,17 @@ class ExecContext:
 
     def metrics_snapshot(self) -> dict[str, dict[str, int]]:
         return {k: v.snapshot() for k, v in self.metrics.items()}
+
+
+def _new_event():
+    import threading
+    return threading.Event()
+
+
+class TaskCancelled(Exception):
+    """The host cancelled this task mid-stream (reference: task-kill
+    detection via is_task_running, rt.rs:208-238); operators unwind and
+    the runtime tears down without reporting a failure."""
 
 
 class PhysicalOp:

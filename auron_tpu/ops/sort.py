@@ -321,13 +321,17 @@ class SortOp(PhysicalOp):
 
         def stream():
             if not spillable:
-                yield from self._limit(
-                    in_mem_stream(list(self.child.execute(partition, ctx))))
+                collected = []
+                for b in self.child.execute(partition, ctx):
+                    ctx.check_cancelled()   # cancel lands mid-collect too
+                    collected.append(b)
+                yield from self._limit(in_mem_stream(collected))
                 return
             consumer = _SortSpillConsumer(self, in_schema, mem, metrics,
                                           conf=ctx.conf)
             try:
                 for batch in self.child.execute(partition, ctx):
+                    ctx.check_cancelled()
                     consumer.add(batch)
                 if not consumer.spills:
                     yield from self._limit(in_mem_stream(consumer.buffered))

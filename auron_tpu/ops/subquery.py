@@ -73,10 +73,9 @@ class ScalarSubqueryBinderOp(PhysicalOp):
         # further scalar subqueries (nested binder resolves them)
         op = PhysicalPlanner(self._planner_ctx).plan_task(
             pb.TaskDefinition(plan=q.plan))
-        sub_ctx = ExecContext(stage_id=ctx.stage_id,
-                              partition_id=0, num_partitions=1,
-                              mem_manager=ctx.mem_manager,
-                              config=ctx.config)
+        # ctx.child keeps the cancellation registry: cancelling the task
+        # also stops an in-flight subquery resolution
+        sub_ctx = ctx.child(partition_id=0, num_partitions=1, metrics={})
         rows = 0
         value = None
         from auron_tpu.columnar.arrow_bridge import to_arrow

@@ -233,12 +233,11 @@ class ShuffleExchangeOp(PhysicalOp):
 
     def _input_batches(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         for in_p in range(self.input_partitions):
-            map_ctx = ExecContext(
-                stage_id=ctx.stage_id, partition_id=in_p,
-                num_partitions=self.input_partitions,
-                metrics=ctx.metrics, mem_manager=ctx.mem_manager,
-                config=ctx.config)
-            yield from self.child.execute(in_p, map_ctx)
+            map_ctx = ctx.child(partition_id=in_p,
+                                num_partitions=self.input_partitions)
+            for b in self.child.execute(in_p, map_ctx):
+                map_ctx.check_cancelled()
+                yield b
 
     def _materialize(self, ctx: ExecContext) -> _ExchangeBuffer:
         """Run all map tasks; ONE sort-by-pid compaction per batch."""
@@ -358,11 +357,8 @@ class RssShuffleExchangeOp(PhysicalOp):
         self.service.begin_shuffle(self.shuffle_id)
 
         for in_p in range(self.input_partitions):
-            map_ctx = ExecContext(
-                stage_id=ctx.stage_id, partition_id=in_p,
-                num_partitions=self.input_partitions,
-                metrics=ctx.metrics, mem_manager=ctx.mem_manager,
-                config=ctx.config)
+            map_ctx = ctx.child(partition_id=in_p,
+                                num_partitions=self.input_partitions)
             batches = self.child.execute(in_p, map_ctx)
             pending: list[DeviceBatch] = []
             if in_p == 0 and isinstance(partitioning, RangePartitioning) \
@@ -613,11 +609,11 @@ class BroadcastExchangeOp(PhysicalOp):
                 buf = _BroadcastBuffer(self, ctx.mem_manager, metrics,
                                        conf=ctx.config)
                 for in_p in range(self.input_partitions):
-                    map_ctx = ExecContext(
-                        partition_id=in_p, num_partitions=self.input_partitions,
-                        metrics=ctx.metrics, mem_manager=ctx.mem_manager,
-                        config=ctx.config)
+                    map_ctx = ctx.child(
+                        partition_id=in_p,
+                        num_partitions=self.input_partitions)
                     for b in self.child.execute(in_p, map_ctx):
+                        map_ctx.check_cancelled()
                         buf.add(b)
                 self._buffer = buf
         return count_output(self._buffer.replay(), metrics)

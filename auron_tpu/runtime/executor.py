@@ -79,12 +79,29 @@ class ExecutionRuntime:
             return
         yield from self._batches_inner()
 
+    def cancel(self) -> None:
+        """Tear the running task down: operators polling the context's
+        cancellation registry unwind within one batch (reference:
+        cancel_all_tasks, rt.rs:296)."""
+        self.ctx.cancel()
+
     def _batches_inner(self) -> Iterator[DeviceBatch]:
+        from auron_tpu.ops.base import TaskCancelled
         try:
-            yield from self.plan.execute(self.task.partition_id, self.ctx)
+            for batch in self.plan.execute(self.task.partition_id,
+                                           self.ctx):
+                self.ctx.check_cancelled()
+                yield batch
+        except TaskCancelled:
+            # reference behavior: task-kill is teardown, not failure
+            # (is_task_running checks, rt.rs:208-238)
+            logger.info(
+                "task cancelled: stage=%d partition=%d task=%d",
+                self.task.stage_id, self.task.partition_id,
+                self.task.task_id)
+            raise
         except Exception:
-            # reference behavior: distinguish task-kill from real failure and
-            # always surface with task identity attached (rt.rs:208-238)
+            # real failures surface with task identity attached
             logger.exception(
                 "task failed: stage=%d partition=%d task=%d",
                 self.task.stage_id, self.task.partition_id, self.task.task_id)

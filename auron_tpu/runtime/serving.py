@@ -218,9 +218,10 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         self._execute(task_bytes, PlannerContext(), report=None)
 
     def _run_plan_task(self, payload: bytes) -> None:
-        """SUBMIT_PLAN: convert a raw Spark plan.toJSON tree server-side,
-        source any ConvertToNative boundaries from the client, execute."""
-        from auron_tpu.integration.spark_converter import SparkPlanConverter
+        """SUBMIT_PLAN: convert a raw host plan server-side through the
+        adaptor SPI (default: Spark plan.toJSON via SparkAdaptor), source
+        any ConvertToNative boundaries from the client, execute."""
+        from auron_tpu.integration.adaptor import SparkAdaptor, get_adaptor
         from auron_tpu.ir import pb
         from auron_tpu.ir.planner import PlannerContext
         req = json.loads(payload.decode())
@@ -229,10 +230,13 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         def rewrite(p):
             return rewrites.get(p) or rewrites.get(os.path.basename(p), p)
 
-        conv = SparkPlanConverter(
-            path_rewrite=rewrite,
-            spark_version=req.get("spark_version", "3.5.0"))
-        node, report = conv.convert(req["plan"])
+        name = req.get("adaptor", "spark")
+        if name == "spark":
+            adaptor = SparkAdaptor(req.get("spark_version", "3.5.0"))
+        else:
+            adaptor = get_adaptor(name)
+        node, report = adaptor.convert_plan(req["plan"],
+                                            path_rewrite=rewrite)
 
         catalog = {}
         if report.boundaries:
@@ -292,12 +296,18 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                                num_partitions=task.num_partitions or 1,
                                stage_id=task.stage_id,
                                task_id=task.task_id))
-        for batch in rt.batches():
-            if self._cancel.is_set():
-                raise _Cancelled()
-            rb = to_arrow(batch, op.schema())
-            if rb.num_rows:
-                self._send_batch(rb)
+        # share the handler's cancel event as the task's cancellation
+        # registry: operators polling between child batches unwind even
+        # MID-operator, not just between output batches
+        rt.ctx.cancel_event = self._cancel
+        from auron_tpu.ops.base import TaskCancelled
+        try:
+            for batch in rt.batches():
+                rb = to_arrow(batch, op.schema())
+                if rb.num_rows:
+                    self._send_batch(rb)
+        except TaskCancelled:
+            raise _Cancelled()
         metrics = rt.finalize()
         done = {"metrics": metrics,
                 "schema_ipc": _schema_ipc_b64(schema_to_arrow(op.schema()))}

@@ -1,0 +1,130 @@
+"""Streaming calc operator: the host-engine streaming lifecycle.
+
+The reference's Flink integration wraps the native engine in a streaming
+operator: ``FlinkAuronCalcOperator`` buffers incoming rows into an Arrow
+writer, flushes a batch through the native runtime when the buffer fills
+OR a checkpoint barrier arrives, and drains results back as host rows
+(reference: auron-flink-extension/.../operator/
+FlinkAuronCalcOperator.java:87-267 — open() resolves the converted plan,
+processElement() buffers, snapshotState() flushes so no buffered row is
+lost across a checkpoint/restore).
+
+``CalcOperator`` is that lifecycle for ANY host streaming engine:
+
+    op = CalcOperator(plan_node, input_schema, adaptor=...)
+    op.open()
+    for row in source: out.extend(op.process(row))
+    state = op.snapshot()          # checkpoint barrier: flush + state
+    ...crash...
+    op2 = CalcOperator(...); op2.restore(state)
+
+The plan executes per flushed batch through the engine's in-process
+runtime with the batch exposed as a memory-scan table — the structural
+role of FFIReaderExec feeding the converted Calc program.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Iterator, Optional
+
+import pyarrow as pa
+
+from auron_tpu.columnar.schema import Schema
+from auron_tpu.ir import pb
+
+#: rows buffered before an automatic flush (the reference flushes on the
+#: Arrow writer's batch boundary)
+DEFAULT_BUFFER_ROWS = 4096
+
+#: the well-known catalog name the calc plan reads its buffered rows from
+INPUT_TABLE = "__calc_input__"
+
+
+class CalcOperator:
+    """Buffer → flush-through-engine → emit, with checkpoint flush."""
+
+    def __init__(self, plan: pb.PlanNode, input_schema: Schema,
+                 buffer_rows: int = DEFAULT_BUFFER_ROWS,
+                 on_emit: Optional[Callable] = None):
+        self._plan = plan
+        self._input_schema = input_schema
+        self._buffer_rows = buffer_rows
+        self._rows: list[dict] = []
+        self._opened = False
+        self._emitted_batches = 0
+        self._processed_rows = 0
+        self.on_emit = on_emit
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self) -> None:
+        """Resolve the plan once (the reference resolves the converted
+        proto in open()); cheap here — planning happens per flush against
+        the buffered table, kernels are cached across flushes."""
+        self._opened = True
+
+    def process(self, row: dict) -> list[dict]:
+        """Buffer one host row; returns emitted result rows (empty until
+        a flush boundary)."""
+        assert self._opened, "open() first"
+        self._rows.append(row)
+        self._processed_rows += 1
+        if len(self._rows) >= self._buffer_rows:
+            return list(self._flush())
+        return []
+
+    def snapshot(self) -> bytes:
+        """Checkpoint barrier: FLUSH buffered rows (the reference flushes
+        in snapshotState so no element is lost on restore), then return
+        the durable operator state. Returns state bytes; emitted rows go
+        through ``on_emit`` (set it to capture flush-at-checkpoint
+        output)."""
+        flushed = list(self._flush())
+        if flushed and self.on_emit is None:
+            raise RuntimeError(
+                "checkpoint flushed rows but no on_emit sink is attached "
+                "— results would be lost")
+        state = {"processed_rows": self._processed_rows,
+                 "emitted_batches": self._emitted_batches}
+        return json.dumps(state).encode()
+
+    def restore(self, state: bytes) -> None:
+        s = json.loads(state.decode())
+        self._processed_rows = int(s["processed_rows"])
+        self._emitted_batches = int(s["emitted_batches"])
+        self._opened = True
+
+    def close(self) -> list[dict]:
+        """End of stream: final flush."""
+        return list(self._flush())
+
+    # -- the engine boundary -------------------------------------------------
+
+    def _flush(self) -> Iterator[dict]:
+        if not self._rows:
+            return
+        from auron_tpu.columnar.arrow_bridge import (schema_to_arrow,
+                                                     to_arrow)
+        from auron_tpu.ir.planner import PlannerContext, plan_from_bytes
+        from auron_tpu.runtime.executor import (ExecutionRuntime,
+                                                TaskDefinition)
+        arrow_schema = schema_to_arrow(self._input_schema)
+        tbl = pa.Table.from_pylist(self._rows, schema=arrow_schema)
+        self._rows = []
+        ctx = PlannerContext(catalog={INPUT_TABLE: tbl})
+        op = plan_from_bytes(
+            pb.TaskDefinition(
+                plan=self._plan,
+                task_id=self._emitted_batches).SerializeToString(), ctx)
+        rt = ExecutionRuntime(op, TaskDefinition(
+            task_id=self._emitted_batches))
+        out_schema = op.schema()
+        self._emitted_batches += 1
+        for batch in rt.batches():
+            rb = to_arrow(batch, out_schema)
+            for row in rb.to_pylist():
+                if self.on_emit is not None:
+                    self.on_emit(row)
+                yield row
+        rt.finalize()

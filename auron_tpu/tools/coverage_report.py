@@ -80,3 +80,58 @@ class CoverageReport:
             for name, c, r in fb:
                 lines.append(f"- **{name}** `{c}`: {r or 'unconvertible'}")
         return "\n".join(lines)
+
+    def to_html(self) -> str:
+        """Self-contained static page — the Spark-UI Auron tab analogue
+        (reference: auron-spark-ui/src/ui React/ECharts front-end showing
+        native vs fallback plan coverage). No external assets: inline CSS
+        + SVG bars, so the file works as a CI artifact or `file://`
+        open."""
+        import html as _html
+
+        def bar(pct: float) -> str:
+            w = max(0.0, min(100.0, pct))
+            color = "#2da44e" if w >= 99.5 else (
+                "#bf8700" if w >= 80 else "#cf222e")
+            return (f'<svg width="160" height="12" role="img">'
+                    f'<rect width="160" height="12" fill="#eee" rx="2"/>'
+                    f'<rect width="{w * 1.6:.1f}" height="12" '
+                    f'fill="{color}" rx="2"/></svg> {w:.1f}%')
+
+        rows = []
+        for q in self.queries:
+            fb = "".join(
+                f"<li><code>{_html.escape(c)}</code> "
+                f"{_html.escape(r or 'unconvertible')}</li>"
+                for c, ok, r in q.tags if not ok)
+            rows.append(
+                f"<tr><td>{_html.escape(q.name)}</td>"
+                f"<td>{q.native}</td><td>{q.fallback}</td>"
+                f"<td>{bar(q.pct)}</td>"
+                f"<td>{('<ul>' + fb + '</ul>') if fb else '—'}</td></tr>")
+        return f"""<!doctype html>
+<html><head><meta charset="utf-8"><title>Auron native coverage</title>
+<style>
+ body {{ font: 14px/1.5 system-ui, sans-serif; margin: 2rem; }}
+ table {{ border-collapse: collapse; width: 100%; }}
+ th, td {{ border: 1px solid #ddd; padding: 6px 10px;
+           text-align: left; vertical-align: top; }}
+ th {{ background: #f6f8fa; }}
+ .overall {{ font-size: 1.2rem; margin-bottom: 1rem; }}
+ ul {{ margin: 0; padding-left: 1.2rem; }}
+</style></head><body>
+<h1>Native plan coverage</h1>
+<p class="overall">Overall: {bar(self.overall_pct)} of plan nodes
+executed natively ({len(self.queries)} queries)</p>
+<table><tr><th>Query</th><th>Native</th><th>Fallback</th>
+<th>Coverage</th><th>Fallback reasons</th></tr>
+{''.join(rows)}
+</table></body></html>
+"""
+
+    def write_html(self, path: str) -> str:
+        # explicit utf-8: CI runners with C/POSIX locales would otherwise
+        # raise on non-ASCII node names despite the page's charset
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(self.to_html())
+        return path

@@ -1,0 +1,81 @@
+"""Cancellation registry (reference: cancel_all_tasks,
+execution_context.rs:452 + is_task_running checks, rt.rs:208-238):
+a cancel reaches operators mid-stream, including nested executions
+under exchanges, within one batch."""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu.columnar.arrow_bridge import schema_from_arrow, to_device
+from auron_tpu.columnar.schema import DataType, Field, Schema
+from auron_tpu.exprs import ir
+from auron_tpu.ops.base import ExecContext, PhysicalOp, TaskCancelled
+from auron_tpu.ops.sort import SortOp
+
+
+class _SlowSource(PhysicalOp):
+    """Yields small batches forever (until cancelled)."""
+
+    def __init__(self):
+        rb = pa.record_batch({"x": pa.array(np.arange(8), pa.int64())})
+        self._batch, self._schema = to_device(rb, capacity=8)
+        self.yielded = 0
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def execute(self, partition, ctx):
+        while True:
+            self.yielded += 1
+            time.sleep(0.01)
+            yield self._batch
+
+
+def test_cancel_stops_sort_collect_within_batches():
+    src = _SlowSource()
+    op = SortOp(src, [ir.SortOrder(ir.ColumnRef(0), True, True)])
+    ctx = ExecContext()
+
+    def cancel_soon():
+        time.sleep(0.15)
+        ctx.cancel()
+
+    threading.Thread(target=cancel_soon, daemon=True).start()
+    with pytest.raises(TaskCancelled):
+        for _ in op.execute(0, ctx):
+            pass
+    yielded_at_cancel = src.yielded
+    time.sleep(0.1)
+    assert src.yielded == yielded_at_cancel   # nothing consumed after
+
+
+def test_child_context_shares_cancel_registry():
+    ctx = ExecContext(task_id=9)
+    kid = ctx.child(partition_id=2, metrics={})
+    grandkid = kid.child(partition_id=3)
+    assert not kid.cancelled
+    ctx.cancel()
+    assert kid.cancelled and grandkid.cancelled
+    with pytest.raises(TaskCancelled, match="task 9"):
+        grandkid.check_cancelled()
+
+
+def test_runtime_cancel_surfaces_as_task_cancelled():
+    from auron_tpu.ir import pb
+    from auron_tpu.ir.planner import PlannerContext, plan_from_bytes
+    from auron_tpu.runtime.executor import ExecutionRuntime, TaskDefinition
+    rng = np.random.default_rng(0)
+    tbl = pa.table({"k": pa.array(rng.integers(0, 4, 64), pa.int64())})
+    scan = pb.PlanNode(memory_scan=pb.MemoryScanNode(table_name="t"))
+    op = plan_from_bytes(
+        pb.TaskDefinition(plan=scan, task_id=4).SerializeToString(),
+        PlannerContext(catalog={"t": tbl}))
+    rt = ExecutionRuntime(op, TaskDefinition(task_id=4))
+    rt.cancel()      # cancelled before the first batch
+    with pytest.raises(TaskCancelled):
+        for _ in rt.batches():
+            pass

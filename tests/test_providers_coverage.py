@@ -121,3 +121,25 @@ class TestCoverageReport:
         assert j["queries"][0]["fallbacks"][0]["node"] == "WeirdExec"
         md = rep.to_markdown()
         assert "q01" in md and "WeirdExec" in md and "66.7%" in md
+
+
+def test_coverage_html_report(tmp_path):
+    """The static-HTML coverage page (Spark-UI tab analogue) renders
+    bars, fallback reasons, and escapes node names."""
+    from auron_tpu.integration.spark_converter import ConversionReport
+
+    class _N:
+        def __init__(self, name):
+            self.simple_name = name
+
+    rep = ConversionReport()
+    rep.tag(_N("FileSourceScanExec"), True)
+    rep.tag(_N("HashAggregateExec"), True)
+    rep.tag(_N("BatchEvalPythonExec<x>"), False, "no converter")
+    cov = CoverageReport()
+    cov.add("q_demo", rep)
+    path = cov.write_html(str(tmp_path / "coverage.html"))
+    html = open(path).read()
+    assert "<svg" in html and "66.7%" in html
+    assert "BatchEvalPythonExec&lt;x&gt;" in html   # escaped
+    assert "no converter" in html
