@@ -54,10 +54,16 @@ def schema_from_arrow(sch: pa.Schema) -> Schema:
         elif pa.types.is_timestamp(t):
             fields.append(Field(f.name, DataType.TIMESTAMP_US, f.nullable))
         elif pa.types.is_list(t) or pa.types.is_large_list(t):
-            elem = _PA_TO_DT.get(t.value_type)
-            if elem is None or elem in (DataType.STRING, DataType.NULL):
-                raise NotImplementedError(f"list of {t.value_type}")
-            fields.append(Field(f.name, DataType.LIST, f.nullable, elem=elem))
+            if pa.types.is_string(t.value_type) \
+                    or pa.types.is_large_string(t.value_type):
+                fields.append(Field(f.name, DataType.LIST, f.nullable,
+                                    elem=DataType.STRING))
+            else:
+                elem = _PA_TO_DT.get(t.value_type)
+                if elem is None or elem == DataType.NULL:
+                    raise NotImplementedError(f"list of {t.value_type}")
+                fields.append(Field(f.name, DataType.LIST, f.nullable,
+                                    elem=elem))
         elif pa.types.is_map(t):
             key = _PA_TO_DT.get(t.key_type)
             val = _PA_TO_DT.get(t.item_type)
@@ -100,7 +106,8 @@ def schema_to_arrow(schema: Schema) -> pa.Schema:
         elif f.dtype == DataType.NULL:
             t = pa.null()
         elif f.dtype == DataType.LIST:
-            t = pa.list_(pa.from_numpy_dtype(f.elem.to_np()))
+            t = pa.list_(pa.string() if f.elem == DataType.STRING
+                         else pa.from_numpy_dtype(f.elem.to_np()))
         elif f.dtype == DataType.MAP:
             t = pa.map_(pa.from_numpy_dtype(f.key.to_np()),
                         pa.from_numpy_dtype(f.elem.to_np()))
@@ -239,6 +246,45 @@ def to_device(rb: pa.RecordBatch, capacity: int | None = None,
     return DeviceBatch(tuple(cols), jnp.asarray(n, jnp.int32)), schema
 
 
+def _string_list_to_device(arr: pa.Array, cap: int):
+    """pyarrow list<string> → StringListColumn (padded char tensor)."""
+    from auron_tpu.columnar.batch import StringListColumn
+    from auron_tpu.utils.shapes import bucket_string_width
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    arr = arr.cast(pa.list_(pa.string()))
+    n = len(arr)
+    pyrows = arr.to_pylist()
+    max_e, max_w = 1, 1
+    for row in pyrows:
+        if row:
+            max_e = max(max_e, len(row))
+            for s in row:
+                if s is not None:
+                    max_w = max(max_w, len(s.encode()))
+    width = bucket_string_width(max_w)
+    chars = np.zeros((cap, max_e, width), np.uint8)
+    slens = np.zeros((cap, max_e), np.int32)
+    ev = np.zeros((cap, max_e), bool)
+    lens = np.zeros(cap, np.int32)
+    validity = np.zeros(cap, bool)
+    for i, row in enumerate(pyrows):
+        if row is None:
+            continue
+        validity[i] = True
+        lens[i] = len(row)
+        for j, s in enumerate(row):
+            if s is None:
+                continue
+            b = s.encode()
+            chars[i, j, :len(b)] = np.frombuffer(b, np.uint8)
+            slens[i, j] = len(b)
+            ev[i, j] = True
+    return StringListColumn(jnp.asarray(chars), jnp.asarray(slens),
+                            jnp.asarray(ev), jnp.asarray(lens),
+                            jnp.asarray(validity))
+
+
 def _column_to_device(field: Field, arr, cap: int,
                       string_widths: dict[str, int] | None):
     n = len(arr)
@@ -252,6 +298,8 @@ def _column_to_device(field: Field, arr, cap: int,
         return StringColumn(jnp.asarray(chars), jnp.asarray(lens),
                             jnp.asarray(validity))
     if field.dtype == DataType.LIST:
+        if field.elem == DataType.STRING:
+            return _string_list_to_device(arr, cap)
         values, ev, lens, validity = _list_arrays(arr, cap,
                                                   field.elem.to_np())
         return ListColumn(jnp.asarray(values), jnp.asarray(ev),
@@ -326,11 +374,40 @@ def to_arrow(batch: DeviceBatch, schema: Schema) -> pa.RecordBatch:
     return pa.RecordBatch.from_arrays(arrays, schema=schema_to_arrow(schema))
 
 
+def _list_offsets(lens: np.ndarray, validity: np.ndarray, n: int):
+    """int32 Arrow offsets (+ None at null rows) from per-row lengths —
+    shared by every list-shaped to-arrow arm (list / string list / map)."""
+    offsets = np.zeros(n + 1, np.int32)
+    np.cumsum(lens, out=offsets[1:])
+    if validity.all():
+        return pa.array(offsets, pa.int32())
+    return pa.array(
+        [None if not v else int(o)
+         for o, v in zip(offsets[:-1], validity)] + [int(offsets[-1])],
+        pa.int32())
+
+
 def _host_col_to_arrow(field: Field, hc, n: int) -> pa.Array:
     """ONE host column → pyarrow array; the single conversion point for
     every logical type (top-level columns and struct children alike)."""
     from auron_tpu.columnar.serde import (HostDecimal128, HostList, HostMap,
-                                          HostString, HostStruct)
+                                          HostString, HostStringList,
+                                          HostStruct)
+    if isinstance(hc, HostStringList):
+        validity = hc.validity
+        lens = np.where(validity, hc.lens.astype(np.int64), 0)
+        vals = []
+        for i in range(n):
+            for j in range(int(lens[i])):
+                if hc.elem_valid[i, j]:
+                    vals.append(bytes(
+                        hc.chars[i, j, :hc.slens[i, j]]).decode(
+                            "utf-8", "replace"))
+                else:
+                    vals.append(None)
+        child = pa.array(vals, pa.string())
+        off_arr = _list_offsets(lens, validity, n)
+        return pa.ListArray.from_arrays(off_arr, child)
     if isinstance(hc, HostList):
         validity = hc.validity
         lens = np.where(validity, hc.lens.astype(np.int64), 0)
@@ -340,13 +417,7 @@ def _host_col_to_arrow(field: Field, hc, n: int) -> pa.Array:
         child = pa.array(flat_vals, pa.from_numpy_dtype(field.elem.to_np()))
         if not flat_valid.all():
             child = _with_nulls(child, flat_valid)
-        offsets = np.zeros(n + 1, np.int32)
-        np.cumsum(lens, out=offsets[1:])
-        off_arr = pa.array(
-            [None if not v else int(o)
-             for o, v in zip(offsets[:-1], validity)] + [int(offsets[-1])],
-            pa.int32()) if not validity.all() else \
-            pa.array(offsets, pa.int32())
+        off_arr = _list_offsets(lens, validity, n)
         return pa.ListArray.from_arrays(off_arr, child)
     if isinstance(hc, HostMap):
         validity = hc.validity
@@ -359,13 +430,7 @@ def _host_col_to_arrow(field: Field, hc, n: int) -> pa.Array:
         flat_vv = hc.val_valid[take]
         if not flat_vv.all():
             varr = _with_nulls(varr, flat_vv)
-        offsets = np.zeros(n + 1, np.int32)
-        np.cumsum(lens, out=offsets[1:])
-        off_arr = pa.array(
-            [None if not v else int(o)
-             for o, v in zip(offsets[:-1], validity)] + [int(offsets[-1])],
-            pa.int32()) if not validity.all() else \
-            pa.array(offsets, pa.int32())
+        off_arr = _list_offsets(lens, validity, n)
         return pa.MapArray.from_arrays(off_arr, karr, varr)
     if isinstance(hc, HostStruct):
         kids = [_host_col_to_arrow(cf, ch, n)

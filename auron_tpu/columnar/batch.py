@@ -95,6 +95,37 @@ class ListColumn:
 
 @jax.tree_util.register_dataclass
 @dataclass(frozen=True)
+class StringListColumn:
+    """Padded list-of-STRING column: a [capacity, max_elems, width] char
+    tensor + per-element byte lengths. The string analogue of ListColumn
+    (reference: Arrow ListArray over a StringArray child — offsets over
+    offsets; here both levels become dense padded matrices so explode /
+    element_at are one gather)."""
+
+    chars: jax.Array       # uint8[capacity, max_elems, width]
+    slens: jax.Array       # int32[capacity, max_elems] per-element bytes
+    elem_valid: jax.Array  # bool[capacity, max_elems]
+    lens: jax.Array        # int32[capacity]
+    validity: jax.Array    # bool[capacity]  (row null = whole list null)
+
+    @property
+    def capacity(self) -> int:
+        return self.chars.shape[0]
+
+    @property
+    def max_elems(self) -> int:
+        return self.chars.shape[1]
+
+    @property
+    def width(self) -> int:
+        return self.chars.shape[2]
+
+    def with_validity(self, validity: jax.Array) -> "StringListColumn":
+        return replace(self, validity=validity)
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
 class MapColumn:
     """Padded map column: parallel key/value matrices sharing one length
     column (reference stores these as Arrow MapArray — offsets over a
@@ -141,7 +172,7 @@ class StructColumn:
 
 
 Column = Union[PrimitiveColumn, StringColumn, ListColumn,
-               Decimal128Column, MapColumn, StructColumn]
+               StringListColumn, Decimal128Column, MapColumn, StructColumn]
 
 
 @jax.tree_util.register_dataclass
@@ -183,6 +214,10 @@ def column_nbytes(col: Column) -> int:
     if isinstance(col, ListColumn):
         return (col.values.nbytes + col.elem_valid.nbytes
                 + col.lens.nbytes + col.validity.nbytes)
+    if isinstance(col, StringListColumn):
+        return (col.chars.nbytes + col.slens.nbytes
+                + col.elem_valid.nbytes + col.lens.nbytes
+                + col.validity.nbytes)
     if isinstance(col, Decimal128Column):
         return col.hi.nbytes + col.lo.nbytes + col.validity.nbytes
     if isinstance(col, MapColumn):
@@ -221,6 +256,14 @@ def gather_column(col: Column, indices: jax.Array, valid: jax.Array) -> Column:
     if isinstance(col, ListColumn):
         return ListColumn(
             values=col.values[indices],
+            elem_valid=col.elem_valid[indices] & valid[:, None],
+            lens=jnp.where(valid, col.lens[indices], 0),
+            validity=col.validity[indices] & valid,
+        )
+    if isinstance(col, StringListColumn):
+        return StringListColumn(
+            chars=col.chars[indices],
+            slens=col.slens[indices],
             elem_valid=col.elem_valid[indices] & valid[:, None],
             lens=jnp.where(valid, col.lens[indices], 0),
             validity=col.validity[indices] & valid,
@@ -302,6 +345,18 @@ def unify_column_widths(cols: Sequence[Column]) -> list[Column]:
     if isinstance(cols[0], ListColumn):
         m = max(c.max_elems for c in cols)
         return [pad_list_elems(c, m) for c in cols]
+    if isinstance(cols[0], StringListColumn):
+        m = max(c.max_elems for c in cols)
+        w = max(c.width for c in cols)
+        out = []
+        for c in cols:
+            pe, pw = m - c.max_elems, w - c.width
+            out.append(StringListColumn(
+                jnp.pad(c.chars, ((0, 0), (0, pe), (0, pw))),
+                jnp.pad(c.slens, ((0, 0), (0, pe))),
+                jnp.pad(c.elem_valid, ((0, 0), (0, pe))),
+                c.lens, c.validity))
+        return out
     if isinstance(cols[0], MapColumn):
         m = max(c.max_elems for c in cols)
         return [pad_map_elems(c, m) for c in cols]
@@ -329,6 +384,16 @@ def concat_columns(a: Column, b: Column) -> Column:
         assert isinstance(b, ListColumn) and a.max_elems == b.max_elems
         return ListColumn(
             values=jnp.concatenate([a.values, b.values], axis=0),
+            elem_valid=jnp.concatenate([a.elem_valid, b.elem_valid], axis=0),
+            lens=jnp.concatenate([a.lens, b.lens]),
+            validity=jnp.concatenate([a.validity, b.validity]),
+        )
+    if isinstance(a, StringListColumn):
+        assert isinstance(b, StringListColumn) \
+            and a.max_elems == b.max_elems and a.width == b.width
+        return StringListColumn(
+            chars=jnp.concatenate([a.chars, b.chars], axis=0),
+            slens=jnp.concatenate([a.slens, b.slens], axis=0),
             elem_valid=jnp.concatenate([a.elem_valid, b.elem_valid], axis=0),
             lens=jnp.concatenate([a.lens, b.lens]),
             validity=jnp.concatenate([a.validity, b.validity]),
@@ -424,6 +489,14 @@ def resize(batch: DeviceBatch, new_capacity: int) -> DeviceBatch:
                     lens=jnp.pad(c.lens, (0, pad)),
                     validity=jnp.pad(c.validity, (0, pad)),
                 )
+            if isinstance(c, StringListColumn):
+                return StringListColumn(
+                    chars=jnp.pad(c.chars, ((0, pad), (0, 0), (0, 0))),
+                    slens=jnp.pad(c.slens, ((0, pad), (0, 0))),
+                    elem_valid=jnp.pad(c.elem_valid, ((0, pad), (0, 0))),
+                    lens=jnp.pad(c.lens, (0, pad)),
+                    validity=jnp.pad(c.validity, (0, pad)),
+                )
             if isinstance(c, Decimal128Column):
                 return Decimal128Column(
                     hi=jnp.pad(c.hi, (0, pad)),
@@ -447,6 +520,12 @@ def resize(batch: DeviceBatch, new_capacity: int) -> DeviceBatch:
                 lens=c.lens[:new_capacity],
                 validity=c.validity[:new_capacity],
             )
+        if isinstance(c, StringListColumn):
+            return StringListColumn(
+                chars=c.chars[:new_capacity], slens=c.slens[:new_capacity],
+                elem_valid=c.elem_valid[:new_capacity],
+                lens=c.lens[:new_capacity],
+                validity=c.validity[:new_capacity])
         if isinstance(c, Decimal128Column):
             return Decimal128Column(hi=c.hi[:new_capacity],
                                     lo=c.lo[:new_capacity],

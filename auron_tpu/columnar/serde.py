@@ -84,6 +84,15 @@ class HostList:
 
 
 @dataclass
+class HostStringList:
+    chars: np.ndarray       # uint8[n, max_elems, width]
+    slens: np.ndarray       # int32[n, max_elems]
+    elem_valid: np.ndarray  # bool[n, max_elems]
+    lens: np.ndarray        # int32[n]
+    validity: np.ndarray    # bool[n]
+
+
+@dataclass
 class HostDecimal128:
     hi: np.ndarray         # int64[n]
     lo: np.ndarray         # int64[n] (unsigned bit pattern)
@@ -105,8 +114,8 @@ class HostStruct:
     validity: np.ndarray   # bool[n]
 
 
-HostColumn = Union[HostPrimitive, HostString, HostList, HostDecimal128,
-                   HostMap, HostStruct]
+HostColumn = Union[HostPrimitive, HostString, HostList, HostStringList,
+                   HostDecimal128, HostMap, HostStruct]
 
 
 def _host_col_nbytes(c: HostColumn) -> int:
@@ -114,6 +123,9 @@ def _host_col_nbytes(c: HostColumn) -> int:
         return c.chars.nbytes + c.lens.nbytes + c.validity.nbytes
     if isinstance(c, HostList):
         return (c.values.nbytes + c.elem_valid.nbytes
+                + c.lens.nbytes + c.validity.nbytes)
+    if isinstance(c, HostStringList):
+        return (c.chars.nbytes + c.slens.nbytes + c.elem_valid.nbytes
                 + c.lens.nbytes + c.validity.nbytes)
     if isinstance(c, HostDecimal128):
         return c.hi.nbytes + c.lo.nbytes + c.validity.nbytes
@@ -142,6 +154,10 @@ def _slice_host_col(c: HostColumn, lo: int, hi: int) -> HostColumn:
     if isinstance(c, HostList):
         return HostList(c.values[lo:hi], c.elem_valid[lo:hi],
                         c.lens[lo:hi], c.validity[lo:hi])
+    if isinstance(c, HostStringList):
+        return HostStringList(c.chars[lo:hi], c.slens[lo:hi],
+                              c.elem_valid[lo:hi], c.lens[lo:hi],
+                              c.validity[lo:hi])
     if isinstance(c, HostDecimal128):
         return HostDecimal128(c.hi[lo:hi], c.lo[lo:hi], c.validity[lo:hi])
     if isinstance(c, HostMap):
@@ -182,6 +198,10 @@ def host_col_from_device(c, it) -> HostColumn:
         return HostString(next(it), next(it), next(it))
     if isinstance(c, ListColumn):
         return HostList(next(it), next(it), next(it), next(it))
+    from auron_tpu.columnar.batch import StringListColumn
+    if isinstance(c, StringListColumn):
+        return HostStringList(next(it), next(it), next(it), next(it),
+                              next(it))
     if isinstance(c, Decimal128Column):
         return HostDecimal128(next(it), next(it), next(it))
     if isinstance(c, MapColumn):
@@ -265,6 +285,17 @@ def _host_col_to_device(c: HostColumn, pad: int):
         return ListColumn(jnp.asarray(p2(c.values)),
                           jnp.asarray(p2(c.elem_valid)),
                           jnp.asarray(p1(c.lens)), jnp.asarray(p1(c.validity)))
+    if isinstance(c, HostStringList):
+        from auron_tpu.columnar.batch import StringListColumn
+
+        def p3(a):
+            return np.pad(a, ((0, pad), (0, 0), (0, 0))) if pad else a
+
+        return StringListColumn(jnp.asarray(p3(c.chars)),
+                                jnp.asarray(p2(c.slens)),
+                                jnp.asarray(p2(c.elem_valid)),
+                                jnp.asarray(p1(c.lens)),
+                                jnp.asarray(p1(c.validity)))
     if isinstance(c, HostDecimal128):
         from auron_tpu.columnar.decimal128 import Decimal128Column
         return Decimal128Column(jnp.asarray(p1(c.hi)), jnp.asarray(p1(c.lo)),
@@ -297,6 +328,18 @@ def host_to_batch(host: HostBatch, capacity: Optional[int] = None) -> DeviceBatc
             val = np.pad(c.validity, (0, pad)) if pad else c.validity
             cols.append(ListColumn(jnp.asarray(values), jnp.asarray(ev),
                                    jnp.asarray(lens), jnp.asarray(val)))
+        elif isinstance(c, HostStringList):
+            from auron_tpu.columnar.batch import StringListColumn
+            chars = np.pad(c.chars, ((0, pad), (0, 0), (0, 0))) \
+                if pad else c.chars
+            slens = np.pad(c.slens, ((0, pad), (0, 0))) if pad else c.slens
+            ev = np.pad(c.elem_valid, ((0, pad), (0, 0))) \
+                if pad else c.elem_valid
+            lens = np.pad(c.lens, (0, pad)) if pad else c.lens
+            val = np.pad(c.validity, (0, pad)) if pad else c.validity
+            cols.append(StringListColumn(
+                jnp.asarray(chars), jnp.asarray(slens), jnp.asarray(ev),
+                jnp.asarray(lens), jnp.asarray(val)))
         elif isinstance(c, HostDecimal128):
             from auron_tpu.columnar.decimal128 import Decimal128Column
             hi = np.pad(c.hi, (0, pad)) if pad else c.hi
@@ -337,6 +380,14 @@ def _write_host_col(body: io.BytesIO, c: HostColumn) -> None:
         body.write(struct.pack("<BHB", 2, c.values.shape[1], len(tag)))
         body.write(tag)
         _put_buf(body, c.values)
+        _put_buf(body, c.elem_valid.astype(np.bool_))
+        _put_buf(body, c.lens.astype(np.int32))
+        _put_buf(body, c.validity.astype(np.bool_))
+    elif isinstance(c, HostStringList):
+        body.write(struct.pack("<BHH", 6, c.chars.shape[1],
+                               c.chars.shape[2]))
+        _put_buf(body, c.chars)
+        _put_buf(body, c.slens.astype(np.int32))
         _put_buf(body, c.elem_valid.astype(np.bool_))
         _put_buf(body, c.lens.astype(np.int32))
         _put_buf(body, c.validity.astype(np.bool_))
@@ -427,6 +478,14 @@ def _read_host_col(src: io.BytesIO, num_rows: int) -> HostColumn:
         lens = _get_buf(src, np.int32, (num_rows,))
         val = _get_buf(src, np.bool_, (num_rows,))
         return HostMap(keys, values, vv, lens, val)
+    if kind == 6:
+        m, width = struct.unpack("<HH", src.read(4))
+        chars = _get_buf(src, np.uint8, (num_rows, m, width))
+        slens = _get_buf(src, np.int32, (num_rows, m))
+        ev = _get_buf(src, np.bool_, (num_rows, m))
+        lens = _get_buf(src, np.int32, (num_rows,))
+        val = _get_buf(src, np.bool_, (num_rows,))
+        return HostStringList(chars, slens, ev, lens, val)
     if kind == 5:
         (n_children,) = struct.unpack("<B", src.read(1))
         kids = [_read_host_col(src, num_rows) for _ in range(n_children)]

@@ -92,6 +92,13 @@ def null_column_for_field(field, cap: int):
         return Decimal128Column(jnp.zeros(cap, jnp.int64),
                                 jnp.zeros(cap, jnp.int64),
                                 jnp.zeros(cap, bool))
+    if field.dtype == DataType.LIST and field.elem == DataType.STRING:
+        from auron_tpu.columnar.batch import StringListColumn
+        return StringListColumn(jnp.zeros((cap, 1, 8), jnp.uint8),
+                                jnp.zeros((cap, 1), jnp.int32),
+                                jnp.zeros((cap, 1), bool),
+                                jnp.zeros(cap, jnp.int32),
+                                jnp.zeros(cap, bool))
     if field.dtype == DataType.LIST:
         from auron_tpu.columnar.batch import ListColumn
         return ListColumn(jnp.zeros((cap, 1), _JNP[field.elem]),
@@ -299,10 +306,18 @@ def _evaluate(expr: ir.Expr, batch: DeviceBatch, schema: Schema,
             return split_index(expr.child.args, expr.ordinal, batch,
                                schema, ctx)
         v = evaluate(expr.child, batch, schema, ctx)
-        assert isinstance(v.col, ListColumn), "GetIndexedField needs a list"
+        from auron_tpu.columnar.batch import StringListColumn
+        assert isinstance(v.col, (ListColumn, StringListColumn)), \
+            "GetIndexedField needs a list"
         i = expr.ordinal
         in_range = (i >= 0) & (i < v.col.lens)
         idx = min(max(i, 0), v.col.max_elems - 1)
+        if isinstance(v.col, StringListColumn):
+            valid = v.col.validity & in_range & v.col.elem_valid[:, idx]
+            return TypedValue(
+                StringColumn(v.col.chars[:, idx],
+                             jnp.where(valid, v.col.slens[:, idx], 0),
+                             valid), DataType.STRING)
         elem_dt, _, _ = infer_dtype(expr, schema)
         return TypedValue(
             PrimitiveColumn(v.col.values[:, idx],

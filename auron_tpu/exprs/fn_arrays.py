@@ -38,6 +38,8 @@ def elem_dtype_of(a: ir.Expr, schema) -> DataType:
         if a.name in ("sort_array", "array_distinct", "array_union",
                       "array_intersect", "array_except"):
             return elem_dtype_of(a.args[0], schema)
+        if a.name == "split":
+            return DataType.STRING
         if a.name == "map_keys":
             m = a.args[0]
             if isinstance(m, ir.ScalarFunction) and m.name == "map" and m.args:
@@ -90,9 +92,25 @@ def _array(args, expr, batch, schema, ctx):
                                      jnp.zeros(n, jnp.int32),
                                      jnp.ones(n, bool)), DataType.LIST)
     if any(isinstance(a.col, StringColumn) for a in args):
-        raise NotImplementedError(
-            "array() over STRING elements: string lists have no columnar "
-            "materialization yet")
+        from auron_tpu.columnar.batch import StringListColumn
+        if not all(isinstance(a.col, StringColumn) for a in args):
+            raise NotImplementedError("array() mixing STRING and non-"
+                                      "STRING elements")
+        scols = [a.col for a in args]
+        w = max(c.width for c in scols)
+        n = batch.capacity
+
+        def widen(c):
+            if c.width == w:
+                return c.chars
+            return jnp.pad(c.chars, ((0, 0), (0, w - c.width)))
+
+        chars = jnp.stack([widen(c) for c in scols], axis=1)
+        slens = jnp.stack([c.lens for c in scols], axis=1)
+        ev = jnp.stack([a.validity for a in args], axis=1)
+        return TypedValue(StringListColumn(
+            chars, slens, ev, jnp.full(n, len(args), jnp.int32),
+            jnp.ones(n, bool)), DataType.LIST)
     target = args[0].dtype
     vals = [cast_value(a, target) if a.dtype != target else a for a in args]
     values = jnp.stack([v.data for v in vals], axis=1)
@@ -107,12 +125,13 @@ def _array(args, expr, batch, schema, ctx):
 @register("size", DataType.INT32)
 @register("cardinality", DataType.INT32)
 def _size(args, expr, batch, schema, ctx):
-    from auron_tpu.columnar.batch import MapColumn
+    from auron_tpu.columnar.batch import MapColumn, StringListColumn
     v = args[0]
     if isinstance(v.col, MapColumn):
         lens, valid = v.col.lens, v.validity
     else:
-        assert isinstance(v.col, ListColumn), "size() needs an array/map"
+        assert isinstance(v.col, (ListColumn, StringListColumn)), \
+            "size() needs an array/map"
         lens, valid = v.col.lens, v.col.validity
     # Spark legacy sizeOfNull: null input → -1
     out = jnp.where(valid, lens, -1).astype(jnp.int32)
@@ -122,7 +141,26 @@ def _size(args, expr, batch, schema, ctx):
 
 @register("array_contains", DataType.BOOL)
 def _array_contains(args, expr, batch, schema, ctx):
+    from auron_tpu.columnar.batch import StringListColumn
     arr, needle = args
+    if isinstance(arr.col, StringListColumn):
+        if not isinstance(needle.col, StringColumn):
+            raise NotImplementedError(
+                "array_contains over array<string> needs a STRING needle")
+        col = arr.col
+        nc = needle.col
+        w = max(col.width, nc.width)
+        ch = jnp.pad(col.chars,
+                     ((0, 0), (0, 0), (0, w - col.width)))
+        nh = jnp.pad(nc.chars, ((0, 0), (0, w - nc.width)))
+        same = jnp.all(ch == nh[:, None, :], axis=2) \
+            & (col.slens == nc.lens[:, None])
+        in_list = jnp.arange(col.max_elems)[None, :] < col.lens[:, None]
+        hit = jnp.any(same & col.elem_valid & in_list, axis=1)
+        has_null_elem = jnp.any(~col.elem_valid & in_list, axis=1)
+        return TypedValue(
+            PrimitiveColumn(hit, arr.validity & needle.validity
+                            & (hit | ~has_null_elem)), DataType.BOOL)
     if isinstance(needle.col, StringColumn):
         raise NotImplementedError("array_contains with STRING needle")
     col: ListColumn = arr.col
@@ -157,10 +195,24 @@ def _array_position(args, expr, batch, schema, ctx):
 @register("element_at", _element_at_result)
 @register("get_map_value", _element_at_result)
 def _element_at(args, expr, batch, schema, ctx):
-    from auron_tpu.columnar.batch import MapColumn
+    from auron_tpu.columnar.batch import MapColumn, StringListColumn
     v = args[0]
     if isinstance(v.col, MapColumn):
         return _map_get(v, args[1], expr, schema)
+    if isinstance(v.col, StringListColumn):
+        col = v.col
+        idx = cast_value(args[1], DataType.INT32).data
+        zero = jnp.where(idx > 0, idx - 1, col.lens + idx)
+        in_range = (zero >= 0) & (zero < col.lens)
+        zi = jnp.clip(zero, 0, col.max_elems - 1)
+        chars = jnp.take_along_axis(
+            col.chars, zi[:, None, None], axis=1)[:, 0]
+        slens = jnp.take_along_axis(col.slens, zi[:, None], axis=1)[:, 0]
+        ev = jnp.take_along_axis(col.elem_valid, zi[:, None],
+                                 axis=1)[:, 0]
+        valid = v.validity & in_range & ev
+        return TypedValue(StringColumn(chars, jnp.where(valid, slens, 0),
+                                       valid), DataType.STRING)
     col: ListColumn = v.col
     idx = cast_value(args[1], DataType.INT32).data
     # 1-based; negative counts from the end; out of range → null
@@ -587,3 +639,149 @@ def _arrays_overlap(args, expr, batch, schema, ctx):
     return TypedValue(
         PrimitiveColumn(hit, args[0].validity & args[1].validity
                         & ~unknown), DataType.BOOL)
+
+
+# ---------------------------------------------------------------------------
+# string lists: split / array_join + accessor arms (reference:
+# spark_strings.rs string_split + Spark's ArrayJoin; the padded
+# StringListColumn is columnar/batch.py's list-of-string layout)
+# ---------------------------------------------------------------------------
+
+def _split_limit(expr) -> int:
+    if len(expr.args) > 2 and isinstance(expr.args[2], ir.Literal) \
+            and expr.args[2].value is not None:
+        return int(expr.args[2].value)
+    return -1
+
+
+@register("split", _list_result)
+def _split(args, expr, batch, schema, ctx):
+    """split(str, regex[, limit]) → array<string> (Spark semantics:
+    java-regex split; limit<=0 keeps trailing empties EXCEPT the
+    java default of dropping them when limit==0... Spark uses limit=-1
+    as 'no limit', which KEEPS every part)."""
+    import re as _re
+
+    import jax
+
+    from auron_tpu.columnar.batch import StringListColumn
+    from auron_tpu.utils.shapes import bucket_string_width
+    v = args[0]
+    col = v.col
+    if not isinstance(col, StringColumn):
+        raise NotImplementedError("split() needs a STRING input")
+    pat = expr.args[1]
+    if not isinstance(pat, ir.Literal) or pat.value is None:
+        raise NotImplementedError("split(): the regex must be a literal")
+    pattern = _re.compile(str(pat.value))
+    zero_width = pattern.match("") is not None
+    limit = _split_limit(expr)
+    cap, w = col.chars.shape
+    # static bound: a W-byte string splits into at most W+1 parts; cap
+    # the element budget so wide strings don't explode the tensor, and
+    # fail loudly (not truncate) if a row exceeds it
+    max_e = min(w + 1, 64) if limit <= 0 else min(limit, w + 1)
+    out_w = bucket_string_width(max(w, 1))
+
+    def host(chars_np, lens_np, valid_np):
+        chars = np.zeros((cap, max_e, out_w), np.uint8)
+        slens = np.zeros((cap, max_e), np.int32)
+        ev = np.zeros((cap, max_e), bool)
+        lens = np.zeros(cap, np.int32)
+        for i in range(cap):
+            if not valid_np[i]:
+                continue
+            s = bytes(chars_np[i, :lens_np[i]]).decode("utf-8", "replace")
+            parts = pattern.split(s) if limit <= 0 \
+                else pattern.split(s, maxsplit=limit - 1)
+            if zero_width and parts and parts[0] == "":
+                # Java/Spark: a zero-width match at position 0 never
+                # produces an empty leading substring (re.split does)
+                parts = parts[1:]
+            if len(parts) > max_e:
+                raise ValueError(
+                    f"split() produced {len(parts)} parts; the static "
+                    f"element budget is {max_e} — pass an explicit limit")
+            lens[i] = len(parts)
+            for j, p in enumerate(parts):
+                b = p.encode()[:out_w]
+                chars[i, j, :len(b)] = np.frombuffer(b, np.uint8)
+                slens[i, j] = len(b)
+                ev[i, j] = True
+        return chars, slens, ev, lens
+
+    chars, slens, ev, lens = jax.pure_callback(
+        host,
+        (jax.ShapeDtypeStruct((cap, max_e, out_w), jnp.uint8),
+         jax.ShapeDtypeStruct((cap, max_e), jnp.int32),
+         jax.ShapeDtypeStruct((cap, max_e), jnp.bool_),
+         jax.ShapeDtypeStruct((cap,), jnp.int32)),
+        col.chars, col.lens, v.validity, vmap_method="sequential")
+    return TypedValue(StringListColumn(chars, slens, ev, lens,
+                                       v.validity), DataType.LIST)
+
+
+@register("array_join", DataType.STRING)
+def _array_join(args, expr, batch, schema, ctx):
+    """array_join(arr, sep[, null_replacement]): concatenate string
+    elements; null elements are skipped unless a replacement is given
+    (Spark ArrayJoin)."""
+    import jax
+
+    from auron_tpu.columnar.batch import StringListColumn
+    from auron_tpu.utils.shapes import bucket_string_width
+    v = args[0]
+    col = v.col
+    if not isinstance(col, StringListColumn):
+        raise NotImplementedError("array_join() needs an array<string>")
+    sep = expr.args[1]
+    if not isinstance(sep, ir.Literal):
+        raise NotImplementedError("array_join(): separator must be literal")
+    if sep.value is None:
+        # Spark: NULL separator → NULL result
+        cap = col.capacity
+        return TypedValue(
+            StringColumn(jnp.zeros((cap, 8), jnp.uint8),
+                         jnp.zeros(cap, jnp.int32),
+                         jnp.zeros(cap, bool)), DataType.STRING)
+    sep_s = str(sep.value)
+    repl = None
+    if len(expr.args) > 2 and isinstance(expr.args[2], ir.Literal) \
+            and expr.args[2].value is not None:
+        repl = str(expr.args[2].value)
+    cap, m, w = col.chars.shape
+    out_w = bucket_string_width(
+        min(m * (w + len(sep_s.encode())) + 8, 4096))
+
+    def host(chars_np, slens_np, ev_np, lens_np, valid_np):
+        chars = np.zeros((cap, out_w), np.uint8)
+        lens = np.zeros(cap, np.int32)
+        for i in range(cap):
+            if not valid_np[i]:
+                continue
+            parts = []
+            for j in range(lens_np[i]):
+                if ev_np[i, j]:
+                    parts.append(bytes(chars_np[i, j, :slens_np[i, j]])
+                                 .decode("utf-8", "replace"))
+                elif repl is not None:
+                    parts.append(repl)
+            b = sep_s.join(parts).encode()
+            if len(b) > out_w:
+                # fail loudly like split(): silent truncation would be a
+                # wrong query result
+                raise ValueError(
+                    f"array_join() produced {len(b)} bytes; the static "
+                    f"width budget is {out_w}")
+            chars[i, :len(b)] = np.frombuffer(b, np.uint8)
+            lens[i] = len(b)
+        return chars, lens
+
+    chars, lens = jax.pure_callback(
+        host,
+        (jax.ShapeDtypeStruct((cap, out_w), jnp.uint8),
+         jax.ShapeDtypeStruct((cap,), jnp.int32)),
+        col.chars, col.slens, col.elem_valid, col.lens, v.validity,
+        vmap_method="sequential")
+    return TypedValue(StringColumn(chars, lens, v.validity),
+                      DataType.STRING)

@@ -38,9 +38,11 @@ def _explode_kernel(generator: ir.Expr, pass_through: tuple, with_pos: bool,
     @jax.jit
     def kernel(batch: DeviceBatch):
         ectx = EvalContext()
+        from auron_tpu.columnar.batch import StringColumn, StringListColumn
         v = evaluate(generator, batch, in_schema, ectx)
         col = v.col
-        assert isinstance(col, ListColumn), "explode needs a list column"
+        assert isinstance(col, (ListColumn, StringListColumn)), \
+            "explode needs a list column"
         cap, m = col.capacity, col.max_elems
         flat_n = cap * m
         live = batch.row_mask()
@@ -49,7 +51,12 @@ def _explode_kernel(generator: ir.Expr, pass_through: tuple, with_pos: bool,
         row_idx = jnp.repeat(jnp.arange(cap, dtype=jnp.int32), m)
         in_list = elem_idx < col.lens[row_idx]
         keep = in_list & live[row_idx]
-        values = col.values.reshape(flat_n)
+        if isinstance(col, StringListColumn):
+            values = None   # string payloads flatten to (chars, lens)
+            flat_chars = col.chars.reshape(flat_n, col.width)
+            flat_slens = col.slens.reshape(flat_n)
+        else:
+            values = col.values.reshape(flat_n)
         elem_valid = col.elem_valid.reshape(flat_n)
 
         outer_slot = jnp.zeros(flat_n, bool)
@@ -67,7 +74,11 @@ def _explode_kernel(generator: ir.Expr, pass_through: tuple, with_pos: bool,
         if with_pos:
             cols.append(PrimitiveColumn(
                 elem_idx.astype(jnp.int64), keep & ~outer_slot))
-        cols.append(PrimitiveColumn(values, elem_valid & keep))
+        if values is None:
+            cols.append(StringColumn(flat_chars, flat_slens,
+                                     elem_valid & keep))
+        else:
+            cols.append(PrimitiveColumn(values, elem_valid & keep))
 
         flat = DeviceBatch(tuple(cols), jnp.asarray(flat_n, jnp.int32))
         return compact(flat, keep)
@@ -105,8 +116,8 @@ class GenerateOp(PhysicalOp):
                 gen_fields.append(Field("pos", DataType.INT64, False))
             dt, _, _ = infer_dtype(generator, in_schema)
             assert dt == DataType.LIST, "explode generator must be a list"
-            elem = (in_schema[generator.index].elem
-                    if isinstance(generator, ir.ColumnRef) else None)
+            from auron_tpu.exprs.fn_arrays import elem_dtype_of
+            elem = elem_dtype_of(generator, in_schema)
             gen_fields.append(Field("col", elem or DataType.INT64, True))
         elif kind == "json_tuple":
             gen_fields = [Field(n, DataType.STRING, True)

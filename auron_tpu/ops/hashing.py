@@ -111,9 +111,10 @@ def adjacent_eq(col) -> jax.Array:
     semantics — null == null, NaN == NaN, struct fieldwise. Shared by
     group-boundary and window-partition detection."""
     from auron_tpu.columnar.batch import (ListColumn, MapColumn,
-                                          StringColumn, StructColumn)
+                                          StringColumn, StringListColumn,
+                                          StructColumn)
     from auron_tpu.columnar.decimal128 import Decimal128Column
-    if isinstance(col, (MapColumn, ListColumn)):
+    if isinstance(col, (MapColumn, ListColumn, StringListColumn)):
         raise NotImplementedError(
             f"grouping / partitioning on {type(col).__name__} keys is not "
             "supported — Spark itself disallows map-typed keys; key on "
@@ -142,9 +143,10 @@ def pairwise_eq(pc, probe_idx, bc, build_idx) -> jax.Array:
     equi-join null keys never match, so the caller applies its own
     null rule."""
     from auron_tpu.columnar.batch import (ListColumn, MapColumn,
-                                          StringColumn, StructColumn)
+                                          StringColumn, StringListColumn,
+                                          StructColumn)
     from auron_tpu.columnar.decimal128 import Decimal128Column
-    if isinstance(pc, (MapColumn, ListColumn)):
+    if isinstance(pc, (MapColumn, ListColumn, StringListColumn)):
         raise NotImplementedError(
             f"join keys of {type(pc).__name__} type are not supported")
     if isinstance(pc, StructColumn):
@@ -364,8 +366,9 @@ def xxhash64_string(chars: jax.Array, lens: jax.Array, seed) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 def _reject_nested(col) -> None:
-    from auron_tpu.columnar.batch import ListColumn, MapColumn
-    if isinstance(col, (MapColumn, ListColumn)):
+    from auron_tpu.columnar.batch import (ListColumn, MapColumn,
+                                          StringListColumn)
+    if isinstance(col, (MapColumn, ListColumn, StringListColumn)):
         raise NotImplementedError(
             f"hash partitioning / hash join / hash agg on "
             f"{type(col).__name__} keys is not supported — Spark itself "

@@ -1,0 +1,163 @@
+"""List-of-string columns end to end (round-5: unblocks split /
+array_join / explode-over-strings / string-list scan+serde; reference:
+spark_strings.rs string_split + Arrow list<utf8> handling)."""
+
+import pyarrow as pa
+import pytest
+
+from auron_tpu.columnar.arrow_bridge import (schema_from_arrow, to_arrow,
+                                             to_device)
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.columnar.serde import deserialize_batch, serialize_batch
+from auron_tpu.exprs import ir
+from auron_tpu.io.parquet import MemoryScanOp
+from auron_tpu.ops.generate import GenerateOp
+from auron_tpu.ops.project import ProjectOp
+from auron_tpu.runtime.executor import collect
+
+C = ir.ColumnRef
+L = ir.Literal
+
+ROWS = [["a", "bb", None], [], None, ["xyz"], ["q", "q"]]
+
+
+def _rb():
+    return pa.record_batch({
+        "s": pa.array(ROWS, pa.list_(pa.string())),
+        "t": pa.array(["a,b,c", "", None, "x", "a,,b"], pa.string()),
+        "k": pa.array([1, 2, 3, 4, 5], pa.int64()),
+    })
+
+
+def _scan(rb=None):
+    rb = rb if rb is not None else _rb()
+    return MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=8)
+
+
+def fn(name, *args):
+    return ir.ScalarFunction(name, tuple(args))
+
+
+def test_roundtrip_scan_and_wire():
+    got = collect(ProjectOp(_scan(), [C(0), C(2)], ["s", "k"]))
+    assert got.column("s").to_pylist() == ROWS
+    batch, sch = to_device(_rb(), capacity=8)
+    back = to_arrow(deserialize_batch(serialize_batch(batch), 8), sch)
+    assert back.column("s").to_pylist() == ROWS
+
+
+def test_split():
+    got = collect(ProjectOp(_scan(), [fn(
+        "split", C(1), L(",", DataType.STRING))], ["p"]))
+    assert got.schema.field("p").type == pa.list_(pa.string())
+    assert got.column("p").to_pylist() == \
+        [["a", "b", "c"], [""], None, ["x"], ["a", "", "b"]]
+
+
+def test_split_regex_and_limit():
+    rb = pa.record_batch({"t": pa.array(["a1b22c333d"])})
+    got = collect(ProjectOp(_scan(rb), [fn(
+        "split", C(0), L(r"\d+", DataType.STRING))], ["p"]))
+    assert got.column("p").to_pylist() == [["a", "b", "c", "d"]]
+    got = collect(ProjectOp(_scan(rb), [fn(
+        "split", C(0), L(r"\d+", DataType.STRING),
+        L(2, DataType.INT32))], ["p"]))
+    assert got.column("p").to_pylist() == [["a", "b22c333d"]]
+
+
+def test_array_join():
+    got = collect(ProjectOp(_scan(), [fn(
+        "array_join", C(0), L("-", DataType.STRING))], ["j"]))
+    # null elements are skipped without a replacement
+    assert got.column("j").to_pylist() == ["a-bb", "", None, "xyz", "q-q"]
+    got = collect(ProjectOp(_scan(), [fn(
+        "array_join", C(0), L("-", DataType.STRING),
+        L("NA", DataType.STRING))], ["j"]))
+    assert got.column("j").to_pylist() == \
+        ["a-bb-NA", "", None, "xyz", "q-q"]
+
+
+def test_split_then_join_composition():
+    got = collect(ProjectOp(_scan(), [fn(
+        "array_join", fn("split", C(1), L(",", DataType.STRING)),
+        L("|", DataType.STRING))], ["j"]))
+    assert got.column("j").to_pylist() == ["a|b|c", "", None, "x", "a||b"]
+
+
+def test_element_at_and_size():
+    got = collect(ProjectOp(_scan(), [
+        fn("element_at", C(0), L(1, DataType.INT32)),
+        fn("element_at", C(0), L(-1, DataType.INT32)),
+        fn("size", C(0))], ["e1", "em1", "n"]))
+    assert got.column("e1").to_pylist() == ["a", None, None, "xyz", "q"]
+    # element_at(-1): last element; row 0's last is NULL
+    assert got.column("em1").to_pylist() == [None, None, None, "xyz", "q"]
+    assert got.column("n").to_pylist() == [3, 0, -1, 1, 2]
+
+
+def test_array_contains_string():
+    got = collect(ProjectOp(_scan(), [fn(
+        "array_contains", C(0), L("bb", DataType.STRING))], ["c"]))
+    # row 0 contains 'bb'; row 4 has no 'bb' and no nulls -> False;
+    # rows with null elements and no hit -> NULL
+    assert got.column("c").to_pylist() == [True, False, None, False, False]
+
+
+def test_array_constructor_over_strings():
+    rb = pa.record_batch({"a": pa.array(["x", "yy"]),
+                          "b": pa.array(["zzz", None])})
+    got = collect(ProjectOp(_scan(rb), [fn("array", C(0), C(1))], ["arr"]))
+    assert got.column("arr").to_pylist() == [["x", "zzz"], ["yy", None]]
+
+
+def test_explode_string_list():
+    op = GenerateOp(_scan(), "explode", generator=C(0),
+                    required_child_output=[2], output_names=["w"])
+    got = collect(op)
+    assert got.column("k").to_pylist() == [1, 1, 1, 4, 5, 5]
+    assert got.column("w").to_pylist() == ["a", "bb", None, "xyz", "q", "q"]
+
+
+def test_explode_split_composition():
+    op = GenerateOp(_scan(), "explode",
+                    generator=fn("split", C(1), L(",", DataType.STRING)),
+                    required_child_output=[2], output_names=["w"])
+    got = collect(op)
+    by_k = {}
+    for r in got.to_pylist():
+        by_k.setdefault(r["k"], []).append(r["w"])
+    assert by_k == {1: ["a", "b", "c"], 2: [""], 4: ["x"],
+                    5: ["a", "", "b"]}
+
+
+def test_sort_limit_over_string_list_projection():
+    """Generic batch plumbing (resize/concat/order) carries string-list
+    columns: ORDER BY + LIMIT over a split() projection."""
+    from auron_tpu.ops.limit import LimitOp
+    from auron_tpu.ops.sort import SortOp
+    op = LimitOp(SortOp(
+        ProjectOp(_scan(), [C(2), fn("split", C(1),
+                                     L(",", DataType.STRING))],
+                  ["k", "p"]),
+        [ir.SortOrder(C(0), False, False)]), 3)
+    got = collect(op)
+    assert got.column("k").to_pylist() == [5, 4, 3]
+    assert got.column("p").to_pylist() == [["a", "", "b"], ["x"], None]
+
+
+def test_split_zero_width_regex_java_semantics():
+    # Java/Spark: split('abc', '') has no empty leading part
+    rb = pa.record_batch({"t": pa.array(["abc", ""])})
+    got = collect(ProjectOp(_scan(rb), [fn(
+        "split", C(0), L("", DataType.STRING))], ["p"]))
+    assert got.column("p").to_pylist()[0] == ["a", "b", "c", ""]
+
+
+def test_group_by_string_list_rejects_cleanly():
+    import pytest
+
+    from auron_tpu.ops.agg import AggOp
+    op = AggOp(_scan(), [C(0)], [ir.AggFunction("count", None)],
+               mode="complete")
+    with pytest.raises(NotImplementedError, match="StringList"):
+        collect(op)
