@@ -67,13 +67,18 @@ def schema_from_arrow(sch: pa.Schema) -> Schema:
         elif pa.types.is_map(t):
             key = _PA_TO_DT.get(t.key_type)
             val = _PA_TO_DT.get(t.item_type)
-            if key in (None, DataType.STRING, DataType.NULL) \
+            if key == DataType.STRING and val == DataType.STRING:
+                fields.append(Field(f.name, DataType.MAP, f.nullable,
+                                    elem=DataType.STRING,
+                                    key=DataType.STRING))
+            elif key in (None, DataType.STRING, DataType.NULL) \
                     or val in (None, DataType.STRING, DataType.NULL):
                 raise NotImplementedError(
-                    f"map<{t.key_type}, {t.item_type}>: only primitive "
-                    "keys/values have a columnar materialization")
-            fields.append(Field(f.name, DataType.MAP, f.nullable,
-                                elem=val, key=key))
+                    f"map<{t.key_type}, {t.item_type}>: primitive "
+                    "keys/values or map<string,string> only")
+            else:
+                fields.append(Field(f.name, DataType.MAP, f.nullable,
+                                    elem=val, key=key))
         elif pa.types.is_struct(t):
             kids = []
             for i in range(t.num_fields):
@@ -109,8 +114,10 @@ def schema_to_arrow(schema: Schema) -> pa.Schema:
             t = pa.list_(pa.string() if f.elem == DataType.STRING
                          else pa.from_numpy_dtype(f.elem.to_np()))
         elif f.dtype == DataType.MAP:
-            t = pa.map_(pa.from_numpy_dtype(f.key.to_np()),
-                        pa.from_numpy_dtype(f.elem.to_np()))
+            t = pa.map_(pa.string() if f.key == DataType.STRING
+                        else pa.from_numpy_dtype(f.key.to_np()),
+                        pa.string() if f.elem == DataType.STRING
+                        else pa.from_numpy_dtype(f.elem.to_np()))
         elif f.dtype == DataType.STRUCT:
             t = pa.struct([schema_to_arrow(Schema((cf,)))[0]
                            for cf in f.children])
@@ -285,6 +292,49 @@ def _string_list_to_device(arr: pa.Array, cap: int):
                             jnp.asarray(validity))
 
 
+def _string_map_to_device(arr: pa.Array, cap: int):
+    """pyarrow map<string,string> → StringMapColumn."""
+    from auron_tpu.columnar.batch import StringMapColumn
+    from auron_tpu.utils.shapes import bucket_string_width
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    pyrows = arr.to_pylist()
+    max_e, kw, vw = 1, 1, 1
+    for row in pyrows:
+        if row:
+            max_e = max(max_e, len(row))
+            for k, v in row:
+                kw = max(kw, len(k.encode()))
+                if v is not None:
+                    vw = max(vw, len(v.encode()))
+    kw, vw = bucket_string_width(kw), bucket_string_width(vw)
+    kchars = np.zeros((cap, max_e, kw), np.uint8)
+    kslens = np.zeros((cap, max_e), np.int32)
+    vchars = np.zeros((cap, max_e, vw), np.uint8)
+    vslens = np.zeros((cap, max_e), np.int32)
+    vv = np.zeros((cap, max_e), bool)
+    lens = np.zeros(cap, np.int32)
+    validity = np.zeros(cap, bool)
+    for i, row in enumerate(pyrows):
+        if row is None:
+            continue
+        validity[i] = True
+        lens[i] = len(row)
+        for j, (k, v) in enumerate(row):
+            kb = k.encode()
+            kchars[i, j, :len(kb)] = np.frombuffer(kb, np.uint8)
+            kslens[i, j] = len(kb)
+            if v is not None:
+                vb = v.encode()
+                vchars[i, j, :len(vb)] = np.frombuffer(vb, np.uint8)
+                vslens[i, j] = len(vb)
+                vv[i, j] = True
+    return StringMapColumn(jnp.asarray(kchars), jnp.asarray(kslens),
+                           jnp.asarray(vchars), jnp.asarray(vslens),
+                           jnp.asarray(vv), jnp.asarray(lens),
+                           jnp.asarray(validity))
+
+
 def _column_to_device(field: Field, arr, cap: int,
                       string_widths: dict[str, int] | None):
     n = len(arr)
@@ -305,6 +355,8 @@ def _column_to_device(field: Field, arr, cap: int,
         return ListColumn(jnp.asarray(values), jnp.asarray(ev),
                           jnp.asarray(lens), jnp.asarray(validity))
     if field.dtype == DataType.MAP:
+        if field.key == DataType.STRING:
+            return _string_map_to_device(arr, cap)
         return _map_to_device(field, arr, cap)
     if field.dtype == DataType.STRUCT:
         return _struct_to_device(field, arr, cap)
@@ -392,7 +444,23 @@ def _host_col_to_arrow(field: Field, hc, n: int) -> pa.Array:
     every logical type (top-level columns and struct children alike)."""
     from auron_tpu.columnar.serde import (HostDecimal128, HostList, HostMap,
                                           HostString, HostStringList,
-                                          HostStruct)
+                                          HostStringMap, HostStruct)
+    if isinstance(hc, HostStringMap):
+        validity = hc.validity
+        lens = np.where(validity, hc.lens.astype(np.int64), 0)
+        keys, vals = [], []
+        for i in range(n):
+            for j in range(int(lens[i])):
+                keys.append(bytes(hc.kchars[i, j, :hc.kslens[i, j]])
+                            .decode("utf-8", "replace"))
+                vals.append(
+                    bytes(hc.vchars[i, j, :hc.vslens[i, j]])
+                    .decode("utf-8", "replace")
+                    if hc.val_valid[i, j] else None)
+        off_arr = _list_offsets(lens, validity, n)
+        return pa.MapArray.from_arrays(off_arr,
+                                       pa.array(keys, pa.string()),
+                                       pa.array(vals, pa.string()))
     if isinstance(hc, HostStringList):
         validity = hc.validity
         lens = np.where(validity, hc.lens.astype(np.int64), 0)

@@ -126,6 +126,34 @@ class StringListColumn:
 
 @jax.tree_util.register_dataclass
 @dataclass(frozen=True)
+class StringMapColumn:
+    """Padded map<string,string> column: parallel key/value CHAR tensors
+    sharing one length column (reference: spark_map.rs str_to_map builds
+    Arrow MapArray over utf8 children). Spark map keys cannot be null,
+    so keys carry no element validity; values can be null per entry."""
+
+    kchars: jax.Array      # uint8[capacity, max_elems, kwidth]
+    kslens: jax.Array      # int32[capacity, max_elems]
+    vchars: jax.Array      # uint8[capacity, max_elems, vwidth]
+    vslens: jax.Array      # int32[capacity, max_elems]
+    val_valid: jax.Array   # bool[capacity, max_elems]
+    lens: jax.Array        # int32[capacity]
+    validity: jax.Array    # bool[capacity]
+
+    @property
+    def capacity(self) -> int:
+        return self.kchars.shape[0]
+
+    @property
+    def max_elems(self) -> int:
+        return self.kchars.shape[1]
+
+    def with_validity(self, validity: jax.Array) -> "StringMapColumn":
+        return replace(self, validity=validity)
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
 class MapColumn:
     """Padded map column: parallel key/value matrices sharing one length
     column (reference stores these as Arrow MapArray — offsets over a
@@ -172,7 +200,8 @@ class StructColumn:
 
 
 Column = Union[PrimitiveColumn, StringColumn, ListColumn,
-               StringListColumn, Decimal128Column, MapColumn, StructColumn]
+               StringListColumn, Decimal128Column, MapColumn,
+               StringMapColumn, StructColumn]
 
 
 @jax.tree_util.register_dataclass
@@ -222,6 +251,10 @@ def column_nbytes(col: Column) -> int:
         return col.hi.nbytes + col.lo.nbytes + col.validity.nbytes
     if isinstance(col, MapColumn):
         return (col.keys.nbytes + col.values.nbytes + col.val_valid.nbytes
+                + col.lens.nbytes + col.validity.nbytes)
+    if isinstance(col, StringMapColumn):
+        return (col.kchars.nbytes + col.kslens.nbytes + col.vchars.nbytes
+                + col.vslens.nbytes + col.val_valid.nbytes
                 + col.lens.nbytes + col.validity.nbytes)
     if isinstance(col, StructColumn):
         return (sum(column_nbytes(c) for c in col.children)
@@ -277,6 +310,14 @@ def gather_column(col: Column, indices: jax.Array, valid: jax.Array) -> Column:
         return MapColumn(
             keys=col.keys[indices],
             values=col.values[indices],
+            val_valid=col.val_valid[indices] & valid[:, None],
+            lens=jnp.where(valid, col.lens[indices], 0),
+            validity=col.validity[indices] & valid,
+        )
+    if isinstance(col, StringMapColumn):
+        return StringMapColumn(
+            kchars=col.kchars[indices], kslens=col.kslens[indices],
+            vchars=col.vchars[indices], vslens=col.vslens[indices],
             val_valid=col.val_valid[indices] & valid[:, None],
             lens=jnp.where(valid, col.lens[indices], 0),
             validity=col.validity[indices] & valid,
@@ -360,6 +401,23 @@ def unify_column_widths(cols: Sequence[Column]) -> list[Column]:
     if isinstance(cols[0], MapColumn):
         m = max(c.max_elems for c in cols)
         return [pad_map_elems(c, m) for c in cols]
+    if isinstance(cols[0], StringMapColumn):
+        m = max(c.max_elems for c in cols)
+        kw = max(c.kchars.shape[2] for c in cols)
+        vw = max(c.vchars.shape[2] for c in cols)
+        out = []
+        for c in cols:
+            pe = m - c.max_elems
+            out.append(StringMapColumn(
+                jnp.pad(c.kchars, ((0, 0), (0, pe),
+                                   (0, kw - c.kchars.shape[2]))),
+                jnp.pad(c.kslens, ((0, 0), (0, pe))),
+                jnp.pad(c.vchars, ((0, 0), (0, pe),
+                                   (0, vw - c.vchars.shape[2]))),
+                jnp.pad(c.vslens, ((0, 0), (0, pe))),
+                jnp.pad(c.val_valid, ((0, 0), (0, pe))),
+                c.lens, c.validity))
+        return out
     if isinstance(cols[0], StructColumn):
         per_child = [unify_column_widths([c.children[i] for c in cols])
                      for i in range(len(cols[0].children))]
@@ -410,6 +468,20 @@ def concat_columns(a: Column, b: Column) -> Column:
         return MapColumn(
             keys=jnp.concatenate([a.keys, b.keys], axis=0),
             values=jnp.concatenate([a.values, b.values], axis=0),
+            val_valid=jnp.concatenate([a.val_valid, b.val_valid], axis=0),
+            lens=jnp.concatenate([a.lens, b.lens]),
+            validity=jnp.concatenate([a.validity, b.validity]),
+        )
+    if isinstance(a, StringMapColumn):
+        assert isinstance(b, StringMapColumn) \
+            and a.max_elems == b.max_elems \
+            and a.kchars.shape[2] == b.kchars.shape[2] \
+            and a.vchars.shape[2] == b.vchars.shape[2]
+        return StringMapColumn(
+            kchars=jnp.concatenate([a.kchars, b.kchars], axis=0),
+            kslens=jnp.concatenate([a.kslens, b.kslens], axis=0),
+            vchars=jnp.concatenate([a.vchars, b.vchars], axis=0),
+            vslens=jnp.concatenate([a.vslens, b.vslens], axis=0),
             val_valid=jnp.concatenate([a.val_valid, b.val_valid], axis=0),
             lens=jnp.concatenate([a.lens, b.lens]),
             validity=jnp.concatenate([a.validity, b.validity]),
@@ -497,6 +569,16 @@ def resize(batch: DeviceBatch, new_capacity: int) -> DeviceBatch:
                     lens=jnp.pad(c.lens, (0, pad)),
                     validity=jnp.pad(c.validity, (0, pad)),
                 )
+            if isinstance(c, StringMapColumn):
+                return StringMapColumn(
+                    kchars=jnp.pad(c.kchars, ((0, pad), (0, 0), (0, 0))),
+                    kslens=jnp.pad(c.kslens, ((0, pad), (0, 0))),
+                    vchars=jnp.pad(c.vchars, ((0, pad), (0, 0), (0, 0))),
+                    vslens=jnp.pad(c.vslens, ((0, pad), (0, 0))),
+                    val_valid=jnp.pad(c.val_valid, ((0, pad), (0, 0))),
+                    lens=jnp.pad(c.lens, (0, pad)),
+                    validity=jnp.pad(c.validity, (0, pad)),
+                )
             if isinstance(c, Decimal128Column):
                 return Decimal128Column(
                     hi=jnp.pad(c.hi, (0, pad)),
@@ -524,6 +606,15 @@ def resize(batch: DeviceBatch, new_capacity: int) -> DeviceBatch:
             return StringListColumn(
                 chars=c.chars[:new_capacity], slens=c.slens[:new_capacity],
                 elem_valid=c.elem_valid[:new_capacity],
+                lens=c.lens[:new_capacity],
+                validity=c.validity[:new_capacity])
+        if isinstance(c, StringMapColumn):
+            return StringMapColumn(
+                kchars=c.kchars[:new_capacity],
+                kslens=c.kslens[:new_capacity],
+                vchars=c.vchars[:new_capacity],
+                vslens=c.vslens[:new_capacity],
+                val_valid=c.val_valid[:new_capacity],
                 lens=c.lens[:new_capacity],
                 validity=c.validity[:new_capacity])
         if isinstance(c, Decimal128Column):

@@ -109,13 +109,24 @@ class HostMap:
 
 
 @dataclass
+class HostStringMap:
+    kchars: np.ndarray      # uint8[n, max_elems, kw]
+    kslens: np.ndarray      # int32[n, max_elems]
+    vchars: np.ndarray      # uint8[n, max_elems, vw]
+    vslens: np.ndarray      # int32[n, max_elems]
+    val_valid: np.ndarray   # bool[n, max_elems]
+    lens: np.ndarray        # int32[n]
+    validity: np.ndarray    # bool[n]
+
+
+@dataclass
 class HostStruct:
     children: list         # list[HostColumn]
     validity: np.ndarray   # bool[n]
 
 
 HostColumn = Union[HostPrimitive, HostString, HostList, HostStringList,
-                   HostDecimal128, HostMap, HostStruct]
+                   HostDecimal128, HostMap, HostStringMap, HostStruct]
 
 
 def _host_col_nbytes(c: HostColumn) -> int:
@@ -132,6 +143,10 @@ def _host_col_nbytes(c: HostColumn) -> int:
     if isinstance(c, HostMap):
         return (c.keys.nbytes + c.values.nbytes + c.val_valid.nbytes
                 + c.lens.nbytes + c.validity.nbytes)
+    if isinstance(c, HostStringMap):
+        return (c.kchars.nbytes + c.kslens.nbytes + c.vchars.nbytes
+                + c.vslens.nbytes + c.val_valid.nbytes + c.lens.nbytes
+                + c.validity.nbytes)
     if isinstance(c, HostStruct):
         return sum(_host_col_nbytes(ch) for ch in c.children) \
             + c.validity.nbytes
@@ -163,6 +178,11 @@ def _slice_host_col(c: HostColumn, lo: int, hi: int) -> HostColumn:
     if isinstance(c, HostMap):
         return HostMap(c.keys[lo:hi], c.values[lo:hi], c.val_valid[lo:hi],
                        c.lens[lo:hi], c.validity[lo:hi])
+    if isinstance(c, HostStringMap):
+        return HostStringMap(c.kchars[lo:hi], c.kslens[lo:hi],
+                             c.vchars[lo:hi], c.vslens[lo:hi],
+                             c.val_valid[lo:hi], c.lens[lo:hi],
+                             c.validity[lo:hi])
     if isinstance(c, HostStruct):
         return HostStruct([_slice_host_col(ch, lo, hi) for ch in c.children],
                           c.validity[lo:hi])
@@ -206,6 +226,10 @@ def host_col_from_device(c, it) -> HostColumn:
         return HostDecimal128(next(it), next(it), next(it))
     if isinstance(c, MapColumn):
         return HostMap(next(it), next(it), next(it), next(it), next(it))
+    from auron_tpu.columnar.batch import StringMapColumn
+    if isinstance(c, StringMapColumn):
+        return HostStringMap(next(it), next(it), next(it), next(it),
+                             next(it), next(it), next(it))
     if isinstance(c, StructColumn):
         kids = [host_col_from_device(ch, it) for ch in c.children]
         return HostStruct(kids, next(it))
@@ -274,6 +298,17 @@ def _host_col_to_device(c: HostColumn, pad: int):
         return MapColumn(jnp.asarray(p2(c.keys)), jnp.asarray(p2(c.values)),
                          jnp.asarray(p2(c.val_valid)),
                          jnp.asarray(p1(c.lens)), jnp.asarray(p1(c.validity)))
+    if isinstance(c, HostStringMap):
+        from auron_tpu.columnar.batch import StringMapColumn
+
+        def p3m(a):
+            return np.pad(a, ((0, pad), (0, 0), (0, 0))) if pad else a
+
+        return StringMapColumn(
+            jnp.asarray(p3m(c.kchars)), jnp.asarray(p2(c.kslens)),
+            jnp.asarray(p3m(c.vchars)), jnp.asarray(p2(c.vslens)),
+            jnp.asarray(p2(c.val_valid)), jnp.asarray(p1(c.lens)),
+            jnp.asarray(p1(c.validity)))
     if isinstance(c, HostStruct):
         return StructColumn(tuple(_host_col_to_device(ch, pad)
                                   for ch in c.children),
@@ -313,7 +348,8 @@ def host_to_batch(host: HostBatch, capacity: Optional[int] = None) -> DeviceBatc
     pad = cap - n
     cols = []
     for c in host.columns:
-        if isinstance(c, (HostMap, HostStruct)):
+        if isinstance(c, (HostMap, HostStruct, HostStringMap,
+                          HostStringList)):
             cols.append(_host_col_to_device(c, pad))
         elif isinstance(c, HostString):
             chars = np.pad(c.chars, ((0, pad), (0, 0))) if pad else c.chars
@@ -328,18 +364,6 @@ def host_to_batch(host: HostBatch, capacity: Optional[int] = None) -> DeviceBatc
             val = np.pad(c.validity, (0, pad)) if pad else c.validity
             cols.append(ListColumn(jnp.asarray(values), jnp.asarray(ev),
                                    jnp.asarray(lens), jnp.asarray(val)))
-        elif isinstance(c, HostStringList):
-            from auron_tpu.columnar.batch import StringListColumn
-            chars = np.pad(c.chars, ((0, pad), (0, 0), (0, 0))) \
-                if pad else c.chars
-            slens = np.pad(c.slens, ((0, pad), (0, 0))) if pad else c.slens
-            ev = np.pad(c.elem_valid, ((0, pad), (0, 0))) \
-                if pad else c.elem_valid
-            lens = np.pad(c.lens, (0, pad)) if pad else c.lens
-            val = np.pad(c.validity, (0, pad)) if pad else c.validity
-            cols.append(StringListColumn(
-                jnp.asarray(chars), jnp.asarray(slens), jnp.asarray(ev),
-                jnp.asarray(lens), jnp.asarray(val)))
         elif isinstance(c, HostDecimal128):
             from auron_tpu.columnar.decimal128 import Decimal128Column
             hi = np.pad(c.hi, (0, pad)) if pad else c.hi
@@ -405,6 +429,16 @@ def _write_host_col(body: io.BytesIO, c: HostColumn) -> None:
         body.write(vtag)
         _put_buf(body, c.keys)
         _put_buf(body, c.values)
+        _put_buf(body, c.val_valid.astype(np.bool_))
+        _put_buf(body, c.lens.astype(np.int32))
+        _put_buf(body, c.validity.astype(np.bool_))
+    elif isinstance(c, HostStringMap):
+        body.write(struct.pack("<BHHH", 7, c.kchars.shape[1],
+                               c.kchars.shape[2], c.vchars.shape[2]))
+        _put_buf(body, c.kchars)
+        _put_buf(body, c.kslens.astype(np.int32))
+        _put_buf(body, c.vchars)
+        _put_buf(body, c.vslens.astype(np.int32))
         _put_buf(body, c.val_valid.astype(np.bool_))
         _put_buf(body, c.lens.astype(np.int32))
         _put_buf(body, c.validity.astype(np.bool_))
@@ -478,6 +512,16 @@ def _read_host_col(src: io.BytesIO, num_rows: int) -> HostColumn:
         lens = _get_buf(src, np.int32, (num_rows,))
         val = _get_buf(src, np.bool_, (num_rows,))
         return HostMap(keys, values, vv, lens, val)
+    if kind == 7:
+        m, kw, vw = struct.unpack("<HHH", src.read(6))
+        kchars = _get_buf(src, np.uint8, (num_rows, m, kw))
+        kslens = _get_buf(src, np.int32, (num_rows, m))
+        vchars = _get_buf(src, np.uint8, (num_rows, m, vw))
+        vslens = _get_buf(src, np.int32, (num_rows, m))
+        vv = _get_buf(src, np.bool_, (num_rows, m))
+        lens = _get_buf(src, np.int32, (num_rows,))
+        val = _get_buf(src, np.bool_, (num_rows,))
+        return HostStringMap(kchars, kslens, vchars, vslens, vv, lens, val)
     if kind == 6:
         m, width = struct.unpack("<HH", src.read(4))
         chars = _get_buf(src, np.uint8, (num_rows, m, width))

@@ -92,6 +92,15 @@ def null_column_for_field(field, cap: int):
         return Decimal128Column(jnp.zeros(cap, jnp.int64),
                                 jnp.zeros(cap, jnp.int64),
                                 jnp.zeros(cap, bool))
+    if field.dtype == DataType.MAP and field.key == DataType.STRING:
+        from auron_tpu.columnar.batch import StringMapColumn
+        return StringMapColumn(jnp.zeros((cap, 1, 8), jnp.uint8),
+                               jnp.zeros((cap, 1), jnp.int32),
+                               jnp.zeros((cap, 1, 8), jnp.uint8),
+                               jnp.zeros((cap, 1), jnp.int32),
+                               jnp.zeros((cap, 1), bool),
+                               jnp.zeros(cap, jnp.int32),
+                               jnp.zeros(cap, bool))
     if field.dtype == DataType.LIST and field.elem == DataType.STRING:
         from auron_tpu.columnar.batch import StringListColumn
         return StringListColumn(jnp.zeros((cap, 1, 8), jnp.uint8),

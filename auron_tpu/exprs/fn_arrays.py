@@ -125,9 +125,10 @@ def _array(args, expr, batch, schema, ctx):
 @register("size", DataType.INT32)
 @register("cardinality", DataType.INT32)
 def _size(args, expr, batch, schema, ctx):
-    from auron_tpu.columnar.batch import MapColumn, StringListColumn
+    from auron_tpu.columnar.batch import (MapColumn, StringListColumn,
+                                          StringMapColumn)
     v = args[0]
-    if isinstance(v.col, MapColumn):
+    if isinstance(v.col, (MapColumn, StringMapColumn)):
         lens, valid = v.col.lens, v.validity
     else:
         assert isinstance(v.col, (ListColumn, StringListColumn)), \
@@ -195,9 +196,10 @@ def _array_position(args, expr, batch, schema, ctx):
 @register("element_at", _element_at_result)
 @register("get_map_value", _element_at_result)
 def _element_at(args, expr, batch, schema, ctx):
-    from auron_tpu.columnar.batch import MapColumn, StringListColumn
+    from auron_tpu.columnar.batch import (MapColumn, StringListColumn,
+                                          StringMapColumn)
     v = args[0]
-    if isinstance(v.col, MapColumn):
+    if isinstance(v.col, (MapColumn, StringMapColumn)):
         return _map_get(v, args[1], expr, schema)
     if isinstance(v.col, StringListColumn):
         col = v.col
@@ -442,22 +444,47 @@ def _map_values_field(expr, schema):
 
 @register("map_keys", _list_result, result_field=_map_keys_field)
 def _map_keys(args, expr, batch, schema, ctx):
-    m: MapColumn = args[0].col
+    from auron_tpu.columnar.batch import StringListColumn, StringMapColumn
+    m = args[0].col
+    if isinstance(m, StringMapColumn):
+        return TypedValue(StringListColumn(
+            m.kchars, m.kslens, _in_len(m), m.lens, args[0].validity),
+            DataType.LIST)
     return TypedValue(ListColumn(m.keys, _in_len(m), m.lens,
                                  args[0].validity), DataType.LIST)
 
 
 @register("map_values", _list_result, result_field=_map_values_field)
 def _map_values(args, expr, batch, schema, ctx):
-    m: MapColumn = args[0].col
+    from auron_tpu.columnar.batch import StringListColumn, StringMapColumn
+    m = args[0].col
+    if isinstance(m, StringMapColumn):
+        return TypedValue(StringListColumn(
+            m.vchars, m.vslens, m.val_valid & _in_len(m), m.lens,
+            args[0].validity), DataType.LIST)
     return TypedValue(ListColumn(m.values, m.val_valid & _in_len(m),
                                  m.lens, args[0].validity), DataType.LIST)
 
 
 @register("map_contains_key", DataType.BOOL)
 def _map_contains_key(args, expr, batch, schema, ctx):
+    from auron_tpu.columnar.batch import StringMapColumn
     v, key = args
-    m: MapColumn = v.col
+    m = v.col
+    if isinstance(m, StringMapColumn):
+        kc = key.col
+        if not isinstance(kc, StringColumn):
+            raise NotImplementedError(
+                "map_contains_key over map<string,..> needs a STRING key")
+        kw = max(m.kchars.shape[2], kc.width)
+        mk = jnp.pad(m.kchars, ((0, 0), (0, 0),
+                                (0, kw - m.kchars.shape[2])))
+        nk = jnp.pad(kc.chars, ((0, 0), (0, kw - kc.width)))
+        same = jnp.all(mk == nk[:, None, :], axis=2) \
+            & (m.kslens == kc.lens[:, None])
+        hit = jnp.any(same & _in_len(m), axis=1)
+        return TypedValue(PrimitiveColumn(hit, v.validity & key.validity),
+                          DataType.BOOL)
     hit = jnp.any((m.keys == key.data[:, None]) & _in_len(m), axis=1)
     return TypedValue(PrimitiveColumn(hit, v.validity & key.validity),
                       DataType.BOOL)
@@ -497,6 +524,9 @@ def _map_concat(args, expr, batch, schema, ctx):
 
 def _map_get(v: TypedValue, key: TypedValue, expr, schema) -> TypedValue:
     """map[key]: last matching key wins (Spark map semantics)."""
+    from auron_tpu.columnar.batch import StringMapColumn
+    if isinstance(v.col, StringMapColumn):
+        return _string_map_get(v, key)
     if isinstance(key.col, StringColumn):
         raise NotImplementedError("map lookup with STRING key")
     m: MapColumn = v.col
@@ -698,6 +728,10 @@ def _split(args, expr, batch, schema, ctx):
                 # Java/Spark: a zero-width match at position 0 never
                 # produces an empty leading substring (re.split does)
                 parts = parts[1:]
+            if zero_width and parts and parts[-1] == "":
+                # Spark 3.4+ (SPARK-40194): an empty regex also drops
+                # the trailing empty string
+                parts = parts[:-1]
             if len(parts) > max_e:
                 raise ValueError(
                     f"split() produced {len(parts)} parts; the static "
@@ -750,8 +784,9 @@ def _array_join(args, expr, batch, schema, ctx):
             and expr.args[2].value is not None:
         repl = str(expr.args[2].value)
     cap, m, w = col.chars.shape
+    repl_w = len(repl.encode()) if repl is not None else 0
     out_w = bucket_string_width(
-        min(m * (w + len(sep_s.encode())) + 8, 4096))
+        min(m * (max(w, repl_w) + len(sep_s.encode())) + 8, 4096))
 
     def host(chars_np, slens_np, ev_np, lens_np, valid_np):
         chars = np.zeros((cap, out_w), np.uint8)
@@ -785,3 +820,118 @@ def _array_join(args, expr, batch, schema, ctx):
         vmap_method="sequential")
     return TypedValue(StringColumn(chars, lens, v.validity),
                       DataType.STRING)
+
+
+# ---------------------------------------------------------------------------
+# string-keyed maps: str_to_map + accessor arms over StringMapColumn
+# (reference: spark_map.rs:417 str_to_map)
+# ---------------------------------------------------------------------------
+
+def _str_to_map_field(expr, schema):
+    return Field("m", DataType.MAP, True, key=DataType.STRING,
+                 elem=DataType.STRING)
+
+
+@register("str_to_map", DataType.MAP, result_field=_str_to_map_field)
+def _str_to_map(args, expr, batch, schema, ctx):
+    """str_to_map(text[, pairDelim[, keyValueDelim]]): split text into
+    pairs then key/value (regex delimiters, Spark defaults ',' and ':');
+    duplicate keys resolve LAST_WINS like the map constructors; a pair
+    without the kv delimiter maps the whole pair to NULL."""
+    import re as _re
+
+    import jax
+
+    from auron_tpu.columnar.batch import StringMapColumn
+    from auron_tpu.utils.shapes import bucket_string_width
+    v = args[0]
+    col = v.col
+    if not isinstance(col, StringColumn):
+        raise NotImplementedError("str_to_map() needs a STRING input")
+
+    def _delim(k, default):
+        if len(expr.args) > k:
+            a = expr.args[k]
+            if not isinstance(a, ir.Literal) or a.value is None:
+                raise NotImplementedError(
+                    "str_to_map(): delimiters must be literals")
+            return str(a.value)
+        return default
+
+    pair_re = _re.compile(_delim(1, ","))
+    kv_re = _re.compile(_delim(2, ":"))
+    cap, w = col.chars.shape
+    max_e = min(w + 1, 64)
+    out_w = bucket_string_width(max(w, 1))
+
+    def host(chars_np, lens_np, valid_np):
+        kchars = np.zeros((cap, max_e, out_w), np.uint8)
+        kslens = np.zeros((cap, max_e), np.int32)
+        vchars = np.zeros((cap, max_e, out_w), np.uint8)
+        vslens = np.zeros((cap, max_e), np.int32)
+        vv = np.zeros((cap, max_e), bool)
+        lens = np.zeros(cap, np.int32)
+        for i in range(cap):
+            if not valid_np[i]:
+                continue
+            s = bytes(chars_np[i, :lens_np[i]]).decode("utf-8", "replace")
+            entries = {}
+            for pair in pair_re.split(s):
+                kv = kv_re.split(pair, maxsplit=1)
+                entries[kv[0]] = kv[1] if len(kv) > 1 else None
+            if len(entries) > max_e:
+                raise ValueError(
+                    f"str_to_map() produced {len(entries)} entries; the "
+                    f"static budget is {max_e}")
+            lens[i] = len(entries)
+            for j, (k, val) in enumerate(entries.items()):
+                kb = k.encode()[:out_w]
+                kchars[i, j, :len(kb)] = np.frombuffer(kb, np.uint8)
+                kslens[i, j] = len(kb)
+                if val is not None:
+                    vb = val.encode()[:out_w]
+                    vchars[i, j, :len(vb)] = np.frombuffer(vb, np.uint8)
+                    vslens[i, j] = len(vb)
+                    vv[i, j] = True
+        return kchars, kslens, vchars, vslens, vv, lens
+
+    kchars, kslens, vchars, vslens, vv, lens = jax.pure_callback(
+        host,
+        (jax.ShapeDtypeStruct((cap, max_e, out_w), jnp.uint8),
+         jax.ShapeDtypeStruct((cap, max_e), jnp.int32),
+         jax.ShapeDtypeStruct((cap, max_e, out_w), jnp.uint8),
+         jax.ShapeDtypeStruct((cap, max_e), jnp.int32),
+         jax.ShapeDtypeStruct((cap, max_e), jnp.bool_),
+         jax.ShapeDtypeStruct((cap,), jnp.int32)),
+        col.chars, col.lens, v.validity, vmap_method="sequential")
+    return TypedValue(StringMapColumn(kchars, kslens, vchars, vslens, vv,
+                                      lens, v.validity), DataType.MAP)
+
+
+def _string_map_get(v: TypedValue, key: TypedValue) -> TypedValue:
+    """map<string,string> lookup by string key → StringColumn."""
+    from auron_tpu.columnar.batch import StringMapColumn
+    col: StringMapColumn = v.col
+    kc = key.col
+    if not isinstance(kc, StringColumn):
+        raise NotImplementedError("string-map lookup needs a STRING key")
+    kw = max(col.kchars.shape[2], kc.width)
+    mk = jnp.pad(col.kchars,
+                 ((0, 0), (0, 0), (0, kw - col.kchars.shape[2])))
+    nk = jnp.pad(kc.chars, ((0, 0), (0, kw - kc.width)))
+    same = jnp.all(mk == nk[:, None, :], axis=2) \
+        & (col.kslens == kc.lens[:, None])
+    in_map = jnp.arange(col.max_elems)[None, :] < col.lens[:, None]
+    hit = same & in_map
+    any_hit = jnp.any(hit, axis=1)
+    # LAST matching key wins, like the numeric _map_get and Spark
+    last = col.max_elems - 1 - jnp.argmax(hit[:, ::-1], axis=1)
+    li = jnp.clip(last, 0, col.max_elems - 1)
+    chars = jnp.take_along_axis(col.vchars, li[:, None, None],
+                                axis=1)[:, 0]
+    slens = jnp.take_along_axis(col.vslens, li[:, None], axis=1)[:, 0]
+    vvalid = jnp.take_along_axis(col.val_valid, li[:, None],
+                                 axis=1)[:, 0]
+    valid = v.validity & key.validity & any_hit & vvalid
+    return TypedValue(StringColumn(chars, jnp.where(valid, slens, 0),
+                                   valid), DataType.STRING)

@@ -112,9 +112,10 @@ def adjacent_eq(col) -> jax.Array:
     group-boundary and window-partition detection."""
     from auron_tpu.columnar.batch import (ListColumn, MapColumn,
                                           StringColumn, StringListColumn,
-                                          StructColumn)
+                                          StringMapColumn, StructColumn)
     from auron_tpu.columnar.decimal128 import Decimal128Column
-    if isinstance(col, (MapColumn, ListColumn, StringListColumn)):
+    if isinstance(col, (MapColumn, ListColumn, StringListColumn,
+                        StringMapColumn)):
         raise NotImplementedError(
             f"grouping / partitioning on {type(col).__name__} keys is not "
             "supported — Spark itself disallows map-typed keys; key on "
@@ -144,9 +145,10 @@ def pairwise_eq(pc, probe_idx, bc, build_idx) -> jax.Array:
     null rule."""
     from auron_tpu.columnar.batch import (ListColumn, MapColumn,
                                           StringColumn, StringListColumn,
-                                          StructColumn)
+                                          StringMapColumn, StructColumn)
     from auron_tpu.columnar.decimal128 import Decimal128Column
-    if isinstance(pc, (MapColumn, ListColumn, StringListColumn)):
+    if isinstance(pc, (MapColumn, ListColumn, StringListColumn,
+                       StringMapColumn)):
         raise NotImplementedError(
             f"join keys of {type(pc).__name__} type are not supported")
     if isinstance(pc, StructColumn):
@@ -367,8 +369,9 @@ def xxhash64_string(chars: jax.Array, lens: jax.Array, seed) -> jax.Array:
 
 def _reject_nested(col) -> None:
     from auron_tpu.columnar.batch import (ListColumn, MapColumn,
-                                          StringListColumn)
-    if isinstance(col, (MapColumn, ListColumn, StringListColumn)):
+                                          StringListColumn, StringMapColumn)
+    if isinstance(col, (MapColumn, ListColumn, StringListColumn,
+                        StringMapColumn)):
         raise NotImplementedError(
             f"hash partitioning / hash join / hash agg on "
             f"{type(col).__name__} keys is not supported — Spark itself "

@@ -146,11 +146,12 @@ def test_sort_limit_over_string_list_projection():
 
 
 def test_split_zero_width_regex_java_semantics():
-    # Java/Spark: split('abc', '') has no empty leading part
+    # Spark 3.4+ (SPARK-40194): split('abc', '') = ['a','b','c'] — no
+    # leading OR trailing empty part for a zero-width regex
     rb = pa.record_batch({"t": pa.array(["abc", ""])})
     got = collect(ProjectOp(_scan(rb), [fn(
         "split", C(0), L("", DataType.STRING))], ["p"]))
-    assert got.column("p").to_pylist()[0] == ["a", "b", "c", ""]
+    assert got.column("p").to_pylist()[0] == ["a", "b", "c"]
 
 
 def test_group_by_string_list_rejects_cleanly():
@@ -161,3 +162,59 @@ def test_group_by_string_list_rejects_cleanly():
                mode="complete")
     with pytest.raises(NotImplementedError, match="StringList"):
         collect(op)
+
+
+class TestStringMaps:
+    """map<string,string>: str_to_map + accessors (reference:
+    spark_map.rs:417 str_to_map)."""
+
+    def test_str_to_map_defaults(self):
+        rb = pa.record_batch({"t": pa.array(
+            ["a:1,b:2", "x:9", None, "k", ""], pa.string())})
+        got = collect(ProjectOp(_scan(rb), [fn("str_to_map", C(0))], ["m"]))
+        assert got.schema.field("m").type == pa.map_(pa.string(),
+                                                     pa.string())
+        assert got.column("m").to_pylist() == [
+            [("a", "1"), ("b", "2")], [("x", "9")], None,
+            [("k", None)], [("", None)]]
+
+    def test_str_to_map_custom_delims_and_last_wins(self):
+        rb = pa.record_batch({"t": pa.array(["a=1;b=2;a=3"], pa.string())})
+        got = collect(ProjectOp(_scan(rb), [fn(
+            "str_to_map", C(0), L(";", DataType.STRING),
+            L("=", DataType.STRING))], ["m"]))
+        assert got.column("m").to_pylist() == [[("a", "3"), ("b", "2")]]
+
+    def test_lookup_duplicate_keys_last_wins(self):
+        # ingested maps may hold duplicate keys: lookup takes the LAST
+        rows = [[("a", "1"), ("a", "2")]]
+        rb = pa.record_batch({
+            "m": pa.array(rows, pa.map_(pa.string(), pa.string()))})
+        got = collect(ProjectOp(_scan(rb), [fn(
+            "element_at", C(0), L("a", DataType.STRING))], ["v"]))
+        assert got.column("v").to_pylist() == ["2"]
+
+    def test_lookup_contains_keys_values_size(self):
+        rows = [[("a", "1"), ("b", None)], [], None, [("k", "vvv")]]
+        rb = pa.record_batch({
+            "m": pa.array(rows, pa.map_(pa.string(), pa.string()))})
+        got = collect(ProjectOp(_scan(rb), [
+            fn("element_at", C(0), L("a", DataType.STRING)),
+            fn("map_contains_key", C(0), L("b", DataType.STRING)),
+            fn("map_keys", C(0)),
+            fn("map_values", C(0)),
+            fn("size", C(0))], ["va", "hb", "mk", "mv", "n"]))
+        assert got.column("va").to_pylist() == ["1", None, None, None]
+        assert got.column("hb").to_pylist() == [True, False, None, False]
+        assert got.column("mk").to_pylist() == [["a", "b"], [], None, ["k"]]
+        assert got.column("mv").to_pylist() == [["1", None], [], None,
+                                                ["vvv"]]
+        assert got.column("n").to_pylist() == [2, 0, -1, 1]
+
+    def test_str_to_map_then_lookup(self):
+        rb = pa.record_batch({"t": pa.array(["env:prod,region:us"],
+                                            pa.string())})
+        got = collect(ProjectOp(_scan(rb), [fn(
+            "element_at", fn("str_to_map", C(0)),
+            L("region", DataType.STRING))], ["r"]))
+        assert got.column("r").to_pylist() == ["us"]
