@@ -22,6 +22,10 @@ import time
 # backend init ignores JAX_PLATFORMS entirely, so main() additionally
 # re-execs under a sanitized env when such a hook is on PYTHONPATH
 # (see _maybe_reexec_cpu; same contract as bench.py's CPU fallback).
+#: the ambient platform BEFORE this module pins cpu — if jax was already
+#: imported (package __init__ chains can do it) the ambient value is
+#: latched into jax.config and only a re-exec can undo it
+_AMBIENT_JAX_PLATFORMS = os.environ.get("JAX_PLATFORMS", "")
 if os.environ.get("AURON_IT_PLATFORM", "cpu") == "cpu":
     os.environ["JAX_PLATFORMS"] = "cpu"
     _xf = os.environ.get("XLA_FLAGS", "")
@@ -91,23 +95,14 @@ def _defloat_decimals(tbl):
     return pa.table({f.name: c for f, c in zip(tbl.schema, cols)})
 
 
-def run_tpcds(data_dir=None, scale: float = 1.0, names=None,
-              verbose: bool = True) -> list[ComparisonResult]:
-    """The real-schema TPC-DS gate: 26 genuine TPC-DS query shapes over a
-    scale-1.0 = 1M-fact-row dataset, diffed against the pyarrow/Acero
-    oracle (reference gate: .github/workflows/tpcds-reusable.yml:70-83)."""
-    from auron_tpu.it.tpcds import generate, load_arrow
-    from auron_tpu.it.tpcds_queries import QUERIES as TQ
-    if data_dir is None:
-        data_dir = tempfile.mkdtemp(prefix="auron_tpcds_")
-    tables = generate(data_dir, scale=scale)
-    arrow = load_arrow(tables)
-    comparator = QueryResultComparator(double_rel_tol=1e-7,
-                                       double_abs_tol=1e-6)
+def _run_suite(queries, tables, arrow, comparator, names=None,
+               verbose: bool = True, budget_note: bool = True):
+    """Shared per-query loop: fresh session, compile attribution, oracle
+    diff, verbose report, suite compile-budget summary."""
     from auron_tpu.utils import compile_stats
     results = []
     suite_start = compile_stats.snapshot()
-    for q in TQ:
+    for q in queries:
         if names and q.name not in names:
             continue
         session = _fresh_session()
@@ -135,13 +130,46 @@ def run_tpcds(data_dir=None, scale: float = 1.0, names=None,
             print(res.report() + f" ({res.elapsed_s}s, "
                   f"{cd.count} compiles {res.compile_s}s)", flush=True)
     total = compile_stats.delta(suite_start)
-    if verbose:
+    if verbose and budget_note:
         wall = sum(getattr(r, "elapsed_s", 0) or 0 for r in results)
         print(f"compile budget: {total.count} XLA programs, "
               f"{total.seconds:.1f}s compiling / {wall:.1f}s total "
               "(a second run in this process should compile ~0)",
               flush=True)
     return results
+
+
+def run_tpcds(data_dir=None, scale: float = 1.0, names=None,
+              verbose: bool = True) -> list[ComparisonResult]:
+    """The real-schema TPC-DS gate: 40 genuine TPC-DS query shapes over a
+    scale-1.0 = 1M-fact-row dataset, diffed against the pyarrow/Acero
+    oracle (reference gate: .github/workflows/tpcds-reusable.yml:70-83)."""
+    from auron_tpu.it.tpcds import generate, load_arrow
+    from auron_tpu.it.tpcds_queries import QUERIES as TQ
+    if data_dir is None:
+        data_dir = tempfile.mkdtemp(prefix="auron_tpcds_")
+    tables = generate(data_dir, scale=scale)
+    arrow = load_arrow(tables)
+    return _run_suite(TQ, tables, arrow,
+                      QueryResultComparator(double_rel_tol=1e-7,
+                                            double_abs_tol=1e-6),
+                      names=names, verbose=verbose)
+
+
+def run_tpch(data_dir=None, scale: float = 1.0, names=None,
+             verbose: bool = True) -> list[ComparisonResult]:
+    """TPC-H q5/q9/q18 (BASELINE.md join-heavy targets) vs pandas
+    oracles."""
+    from auron_tpu.it.tpch import generate, load_arrow
+    from auron_tpu.it.tpch_queries import QUERIES as HQ
+    if data_dir is None:
+        data_dir = tempfile.mkdtemp(prefix="auron_tpch_")
+    tables = generate(data_dir, scale=scale)
+    arrow = load_arrow(tables)
+    return _run_suite(HQ, tables, arrow,
+                      QueryResultComparator(double_rel_tol=1e-7,
+                                            double_abs_tol=1e-5),
+                      names=names, verbose=verbose)
 
 
 def _maybe_reexec_cpu(argv) -> int | None:
@@ -156,8 +184,13 @@ def _maybe_reexec_cpu(argv) -> int | None:
             or os.environ.get("_AURON_IT_SANITIZED") == "1":
         return None
     env = cpu_child_env(os.getcwd(), n_devices=8)
-    if env.get("PYTHONPATH") == os.environ.get("PYTHONPATH"):
+    ambient_noncpu = _AMBIENT_JAX_PLATFORMS not in ("", "cpu")
+    if env.get("PYTHONPATH") == os.environ.get("PYTHONPATH") \
+            and not ambient_noncpu:
         return None   # nothing stripped: the in-process pinning suffices
+    # ambient JAX_PLATFORMS pointed at an accelerator: if anything
+    # imported jax before this module pinned cpu, the value is latched
+    # into jax.config — only a fresh process can unlatch it
     env["_AURON_IT_SANITIZED"] = "1"
     args = list(argv) if argv is not None else sys.argv[1:]
     proc = subprocess.run(
@@ -172,9 +205,11 @@ def main(argv=None) -> int:
         return rc
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--scale", type=float, default=1.0)
-    ap.add_argument("--suite", default="synth", choices=["synth", "tpcds"],
-                    help="synth: the 18 synthetic-star queries; tpcds: the "
-                         "26 real-schema TPC-DS queries vs the Acero oracle")
+    ap.add_argument("--suite", default="synth",
+                    choices=["synth", "tpcds", "tpch"],
+                    help="synth: the synthetic-star queries; tpcds: the 40 "
+                         "real-schema TPC-DS queries vs the Acero oracle; "
+                         "tpch: the join-heavy q5/q9/q18 BASELINE targets")
     ap.add_argument("--queries", default="",
                     help="comma-separated names (q01 or full name)")
     ap.add_argument("--data", default=None,
@@ -184,8 +219,15 @@ def main(argv=None) -> int:
     if args.suite == "tpcds":
         results = run_tpcds(data_dir=args.data, scale=args.scale,
                             names=names)
+    elif args.suite == "tpch":
+        results = run_tpch(data_dir=args.data, scale=args.scale,
+                           names=names)
     else:
         results = run_all(data_dir=args.data, scale=args.scale, names=names)
+    if not results:
+        print(f"no queries matched --queries {args.queries!r} in suite "
+              f"{args.suite!r} — nothing ran", file=sys.stderr)
+        return 2
     failed = [r for r in results if not r.ok]
     print(f"{len(results) - len(failed)}/{len(results)} queries passed")
     return 1 if failed else 0

@@ -1,0 +1,31 @@
+"""TPC-H q5/q9/q18 gate at CI scale (BASELINE.md join-heavy targets;
+`python -m auron_tpu.it.runner --suite tpch --scale 1.0` is the full
+gate)."""
+
+import os
+import tempfile
+
+import pytest
+
+from auron_tpu.it.runner import run_tpch
+from auron_tpu.it.tpch_queries import QUERIES
+
+_SCALE = float(os.environ.get("AURON_TPCH_SCALE", "0.3"))
+
+
+@pytest.fixture(scope="module")
+def results():
+    with tempfile.TemporaryDirectory(prefix="tpch_ci_") as d:
+        yield {r.name: r for r in run_tpch(data_dir=d, scale=_SCALE,
+                                           verbose=False)}
+
+
+def test_all_queries_present(results):
+    assert len(results) == len(QUERIES) == 3
+
+
+@pytest.mark.parametrize("qname", [q.name for q in QUERIES])
+def test_query_matches_oracle(results, qname):
+    r = results[qname]
+    assert r.ok, r.report()
+    assert r.rows > 0, f"{qname} returned 0 rows at scale {_SCALE}"
