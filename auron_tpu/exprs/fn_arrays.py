@@ -263,6 +263,9 @@ def _sort_array(args, expr, batch, schema, ctx):
     asc = True
     if len(expr.args) > 1 and isinstance(expr.args[1], ir.Literal):
         asc = bool(expr.args[1].value)
+    from auron_tpu.columnar.batch import StringListColumn
+    if isinstance(v.col, StringListColumn):
+        return _sort_string_array(v, asc)
     col: ListColumn = v.col
     pos = jnp.arange(col.max_elems)[None, :]
     in_list = pos < col.lens[:, None]
@@ -283,6 +286,39 @@ def _sort_array(args, expr, batch, schema, ctx):
     ev = jnp.take_along_axis(col.elem_valid, order, axis=1)
     return TypedValue(ListColumn(values, ev, col.lens, col.validity),
                       DataType.LIST)
+
+
+def _sort_string_array(v: TypedValue, asc: bool) -> TypedValue:
+    """Row-wise lexicographic sort of string-list elements: pack each
+    element's bytes into big-endian uint64 words, then a stable argsort
+    chain along the element axis (least-significant word first), like
+    ops/sort.py order_words but per row."""
+    from auron_tpu.columnar.batch import StringListColumn
+    from auron_tpu.ops.sort import string_be_words
+    col: StringListColumn = v.col
+    cap, e, w = col.chars.shape
+    words = string_be_words(
+        col.chars.reshape(cap * e, w)).reshape(cap, e, -1)  # [cap,e,k]
+    if not asc:
+        words = ~words
+    in_list = jnp.arange(e)[None, :] < col.lens[:, None]
+    # class: asc nulls < values < padding; desc values < nulls < padding
+    cls = jnp.where(in_list & ~col.elem_valid, 0 if asc else 1,
+                    jnp.where(in_list & col.elem_valid, 1 if asc else 0,
+                              2)).astype(jnp.uint64)
+    order = jnp.arange(e, dtype=jnp.int32)[None, :].repeat(cap, axis=0)
+    for k in range(words.shape[2] - 1, -1, -1):
+        kk = jnp.take_along_axis(words[:, :, k], order, axis=1)
+        order = jnp.take_along_axis(order, jnp.argsort(kk, axis=1,
+                                                       stable=True), axis=1)
+    ck = jnp.take_along_axis(cls, order, axis=1)
+    order = jnp.take_along_axis(order, jnp.argsort(ck, axis=1,
+                                                   stable=True), axis=1)
+    return TypedValue(StringListColumn(
+        jnp.take_along_axis(col.chars, order[:, :, None], axis=1),
+        jnp.take_along_axis(col.slens, order, axis=1),
+        jnp.take_along_axis(col.elem_valid, order, axis=1),
+        col.lens, col.validity), DataType.LIST)
 
 
 @register("array_repeat", _list_result)

@@ -38,6 +38,21 @@ from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
 from auron_tpu.utils.shapes import bucket_rows
 
 
+def string_be_words(chars: "jax.Array") -> "jax.Array":
+    """[n, w] uint8 → [n, ceil(w/8)] big-endian uint64 words whose
+    unsigned order equals byte-lexicographic order (zero padding sorts
+    prefixes first; SQL strings never contain NUL). THE one definition of
+    the order-preserving string encoding — order_words and the
+    string-list sort share it."""
+    n, w = chars.shape
+    pad = (-w) % 8
+    if pad:
+        chars = jnp.pad(chars, ((0, 0), (0, pad)))
+    u = chars.astype(jnp.uint64).reshape(n, -1, 8)
+    shifts = jnp.asarray([56, 48, 40, 32, 24, 16, 8, 0], jnp.uint64)
+    return jnp.sum(u << shifts[None, None, :], axis=2)
+
+
 def order_words(col, ascending: bool, nulls_first: bool) -> list[jax.Array]:
     """Normalize one sort key column into order-preserving uint64 words,
     most significant first (excluding the null-rank word, which the caller
@@ -66,14 +81,7 @@ def order_words(col, ascending: bool, nulls_first: bool) -> list[jax.Array]:
             words = [~w for w in words]
         return words
     if isinstance(col, StringColumn):
-        chars = col.chars
-        n, w = chars.shape
-        pad = (-w) % 8
-        if pad:
-            chars = jnp.pad(chars, ((0, 0), (0, pad)))
-        u = chars.astype(jnp.uint64).reshape(n, -1, 8)
-        shifts = jnp.asarray([56, 48, 40, 32, 24, 16, 8, 0], jnp.uint64)
-        be = jnp.sum(u << shifts[None, None, :], axis=2)
+        be = string_be_words(col.chars)
         words.extend(be[:, i] for i in range(be.shape[1]))
     else:
         d = col.data

@@ -218,3 +218,16 @@ class TestStringMaps:
             "element_at", fn("str_to_map", C(0)),
             L("region", DataType.STRING))], ["r"]))
         assert got.column("r").to_pylist() == ["us"]
+
+
+def test_sort_array_strings():
+    rows = [["pear", "apple", None, "fig"], [], None, ["b", "a", "b"]]
+    rb = pa.record_batch({"s": pa.array(rows, pa.list_(pa.string()))})
+    got = collect(ProjectOp(_scan(rb), [fn("sort_array", C(0))], ["x"]))
+    # Spark sort_array asc: nulls first, then lexicographic
+    assert got.column("x").to_pylist() == \
+        [[None, "apple", "fig", "pear"], [], None, ["a", "b", "b"]]
+    got = collect(ProjectOp(_scan(rb), [fn(
+        "sort_array", C(0), L(False, DataType.BOOL))], ["x"]))
+    assert got.column("x").to_pylist() == \
+        [["pear", "fig", "apple", None], [], None, ["b", "b", "a"]]
