@@ -105,6 +105,7 @@ def _run_suite(queries, tables, arrow, comparator, names=None,
     for q in queries:
         if names and q.name not in names:
             continue
+        compile_stats.maybe_clear()   # bound live programs per process
         session = _fresh_session()
         t0 = time.perf_counter()
         c0 = compile_stats.snapshot()

@@ -152,6 +152,7 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         # from here on, all socket READS belong to the control-reader
         # thread (ACK / CANCEL / TABLE / disconnect); the handler only
         # writes
+        self.server.task_started()
         self._reader = threading.Thread(target=self._control_reader,
                                         daemon=True)
         self._reader.start()
@@ -170,6 +171,14 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                 pass
         finally:
             self._cancel.set()   # unblocks the reader on close
+            try:
+                # long-lived engine process: bound accumulated XLA
+                # programs — but ONLY while no other handler thread is
+                # mid-task (clear_caches during a concurrent trace would
+                # race the very caches it prunes)
+                self.server.task_done_maybe_trim()
+            except Exception:
+                pass
 
     # -- control plane -----------------------------------------------------
 
@@ -335,6 +344,24 @@ class AuronServer(socketserver.ThreadingTCPServer):
         self._shutdown_requested = False
         self.window = window
         self.stats = {"batches_sent": 0, "cancelled": 0}
+        self._active_lock = threading.Lock()
+        self._active_tasks = 0
+
+    def task_started(self) -> None:
+        with self._active_lock:
+            self._active_tasks += 1
+
+    def task_done_maybe_trim(self) -> None:
+        """Decrement the active-task count; when it reaches zero, bound
+        accumulated XLA programs (utils/compile_stats.maybe_clear). The
+        quiescence check prevents clear_caches from racing another
+        handler thread's in-flight trace/compile."""
+        with self._active_lock:
+            self._active_tasks -= 1
+            quiescent = self._active_tasks == 0
+        if quiescent:
+            from auron_tpu.utils import compile_stats
+            compile_stats.maybe_clear()
 
     @property
     def address(self) -> tuple[str, int]:

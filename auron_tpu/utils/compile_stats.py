@@ -46,6 +46,7 @@ def install() -> None:
                 with _LOCK:
                     _N["count"] += 1
                     _S["seconds"] += dur
+                    _SINCE_CLEAR["count"] += 1
 
         mon.register_event_duration_secs_listener(_listen)
         _INSTALLED = True
@@ -61,3 +62,42 @@ def delta(since: CompileSnapshot) -> CompileSnapshot:
     now = snapshot()
     return CompileSnapshot(now.count - since.count,
                            now.seconds - since.seconds)
+
+
+#: programs compiled since the last cache clear (distinct from the
+#: monotonic totals above)
+_SINCE_CLEAR = {"count": 0}
+
+#: default ceiling on live compiled programs per process. The XLA CPU
+#: backend's JIT has been observed to SEGFAULT inside backend_compile
+#: after ~500-700 programs accumulate in one long-lived process (1-CPU
+#: container, jax 0.8 era) — long before any visible memory pressure.
+#: Clearing jax's compilation caches trades bounded recompiles for
+#: survival; kernels rebuild lazily from the engine's own builder caches.
+DEFAULT_MAX_LIVE_PROGRAMS = 400
+
+
+def maybe_clear(limit: int | None = None) -> bool:
+    """Clear jax's compilation caches when more than ``limit`` programs
+    were built since the last clear. Returns True when a clear happened.
+    Call between tasks / test modules — never mid-kernel."""
+    import os
+    install()   # counting must be live for the ceiling to mean anything
+    if limit is None:
+        try:
+            limit = int(os.environ.get(
+                "AURON_MAX_LIVE_PROGRAMS",
+                DEFAULT_MAX_LIVE_PROGRAMS))
+        except ValueError:
+            limit = DEFAULT_MAX_LIVE_PROGRAMS
+    if limit <= 0:
+        return False
+    with _LOCK:
+        due = _SINCE_CLEAR["count"] >= limit
+        if due:
+            _SINCE_CLEAR["count"] = 0
+    if not due:
+        return False
+    import jax
+    jax.clear_caches()
+    return True

@@ -27,3 +27,18 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_live_programs():
+    """Bound accumulated XLA programs across the suite: the CPU backend's
+    JIT segfaults after several hundred programs pile up in one process
+    (see utils/compile_stats.DEFAULT_MAX_LIVE_PROGRAMS). Clearing between
+    modules keeps single-process full-suite runs alive; CI's sharded
+    workers never get close."""
+    yield
+    from auron_tpu.utils import compile_stats
+    compile_stats.maybe_clear()
