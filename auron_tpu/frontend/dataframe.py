@@ -135,6 +135,8 @@ class WinFn:
     offset: int = 1
     default: Any = None
     name: Optional[str] = None
+    #: ROWS BETWEEN (lo, hi) for agg functions; None = default frame
+    frame: Optional[tuple] = None
 
     def alias(self, name: str) -> "WinFn":
         from dataclasses import replace as _replace
@@ -297,11 +299,15 @@ class _Functions:
     def last_value(self, c) -> WinFn:
         return WinFn("offset", "last_value", _wrap(c))
 
-    def win_agg(self, fn: str, c=None) -> WinFn:
+    def win_agg(self, fn: str, c=None, frame=None) -> WinFn:
         """Running aggregate over the window frame (Spark default frame:
         UNBOUNDED PRECEDING..CURRENT ROW with ORDER BY, else whole
-        partition): win_agg("sum", col) / win_agg("count_star")."""
-        return WinFn("agg", fn, _wrap(c) if c is not None else None)
+        partition): win_agg("sum", col) / win_agg("count_star").
+        ``frame=(lo, hi)`` selects ROWS BETWEEN lo..hi (relative row
+        offsets, negative = preceding), e.g. frame=(-1, 1) is the q47/
+        q57-class centered moving window."""
+        return WinFn("agg", fn, _wrap(c) if c is not None else None,
+                     frame=tuple(frame) if frame is not None else None)
 
     def udf(self, registry_name: str, *args) -> Col:
         return Col(("udf", registry_name, tuple(_wrap(a) for a in args),
@@ -593,7 +599,8 @@ class DataFrame:
                 kind=f.kind, fn=f.fn,
                 arg=resolve(f.arg, self.schema) if f.arg is not None
                 else None, offset=f.offset,
-                default=None if default is None else default.value),
+                default=None if default is None else default.value,
+                frame=getattr(f, "frame", None)),
                 default))
         fprotos = []
         for (spec, default) in specs:
@@ -604,6 +611,8 @@ class DataFrame:
             if default is not None:
                 wp.default_value.CopyFrom(
                     serde.expr_to_proto(default).literal)
+            if spec.frame is not None:
+                wp.frame_lo, wp.frame_hi = spec.frame
             fprotos.append(wp)
         node = pb.PlanNode(window=pb.WindowNode(
             child=child,

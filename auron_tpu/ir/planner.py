@@ -215,7 +215,10 @@ class PhysicalPlanner:
                 kind=f.kind, fn=f.fn,
                 arg=serde.parse_expr(f.arg) if f.HasField("arg") else None,
                 offset=f.offset if f.HasField("offset") else 1,
-                default=default))
+                default=default,
+                frame=((f.frame_lo, f.frame_hi)
+                       if (f.HasField("frame_lo")
+                           or f.HasField("frame_hi")) else None)))
         return WindowOp(
             self.create_plan(n.child),
             partition_by=[serde.parse_expr(e) for e in n.partition_by],

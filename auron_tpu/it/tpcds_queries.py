@@ -1,6 +1,6 @@
 """Real TPC-DS queries over the real-schema dataset (tpcds.py).
 
-40 genuine TPC-DS query shapes — star joins, multi-dimension filters,
+41 genuine TPC-DS query shapes — star joins, multi-dimension filters,
 two-phase aggregation, CASE buckets, scalar subqueries, EXISTS/IN as
 semi/anti joins, ROLLUP/grouping-sets with grouping_id arithmetic,
 three-channel UNIONs, and window ratios — expressed in the frontend
@@ -2182,3 +2182,70 @@ def _q40_oracle(a):
 
 _q("q40", "catalog sales before/after a pivot date by warehouse (CASE)")(
     (_q40_run, _q40_oracle))
+
+
+# ===========================================================================
+# q47: monthly brand sales vs centered moving average (ROWS frame window)
+# ===========================================================================
+
+def _q47_run(s, t):
+    ss = _rd(s, t, "store_sales").select("ss_sold_date_sk", "ss_item_sk",
+                                         "ss_sales_price", "ss_quantity")
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_year") >= 1999) & (col("d_year") <= 2001)) \
+        .select("d_date_sk", "d_year", "d_moy")
+    it = _rd(s, t, "item").select("i_item_sk", "i_category", "i_brand")
+    j = _join_dim(ss, dd, "ss_sold_date_sk", "d_date_sk")
+    j = _join_dim(j, it, "ss_item_sk", "i_item_sk")
+    j = j.with_column(
+        "amt", col("ss_sales_price").cast(DataType.FLOAT64)
+        * col("ss_quantity").cast(DataType.FLOAT64))
+    g = (j.group_by("i_category", "i_brand", "d_year", "d_moy")
+         .agg(F.sum(col("amt")).alias("sum_sales")))
+    # centered 3-month moving average within each brand's month series
+    g = g.window(
+        [F.win_agg("avg", col("sum_sales"), frame=(-1, 1)).alias("avg3")],
+        partition_by=[col("i_category"), col("i_brand")],
+        order_by=[col("d_year").asc(), col("d_moy").asc()])
+    # q47 reports months deviating from their local average
+    g = g.with_column("dev", col("sum_sales") - col("avg3"))
+    g = g.filter((col("d_year") == 2000)
+                 & ((col("dev") > lit(0.0)) | (col("dev") < lit(0.0))))
+    return (g.select("i_category", "i_brand", "d_year", "d_moy",
+                     "sum_sales", "avg3")
+            .sort(col("i_category").asc(), col("i_brand").asc(),
+                  col("d_year").asc(), col("d_moy").asc())
+            .limit(100).collect())
+
+
+def _q47_oracle(a):
+    import pandas as pd
+    dd = a["date_dim"].filter(pc.and_(
+        pc.greater_equal(a["date_dim"]["d_year"], 1999),
+        pc.less_equal(a["date_dim"]["d_year"], 2001))) \
+        .select(["d_date_sk", "d_year", "d_moy"])
+    it = a["item"].select(["i_item_sk", "i_category", "i_brand"])
+    j = _oj(a["store_sales"], dd, ["ss_sold_date_sk"], ["d_date_sk"])
+    j = _oj(j, it, ["ss_item_sk"], ["i_item_sk"])
+    df = j.to_pandas()
+    df["amt"] = df.ss_sales_price.astype(float) \
+        * df.ss_quantity.astype(float)
+    g = df.groupby(["i_category", "i_brand", "d_year", "d_moy"],
+                   dropna=False)["amt"].sum().reset_index() \
+        .rename(columns={"amt": "sum_sales"})
+    g = g.sort_values(["i_category", "i_brand", "d_year", "d_moy"])
+    g["avg3"] = g.groupby(["i_category", "i_brand"])["sum_sales"] \
+        .transform(lambda x: x.rolling(3, center=True,
+                                       min_periods=1).mean())
+    g["dev"] = g.sum_sales - g.avg3
+    g = g[(g.d_year == 2000) & (g.dev != 0.0)]
+    g = g[["i_category", "i_brand", "d_year", "d_moy", "sum_sales",
+           "avg3"]]
+    g = g.sort_values(["i_category", "i_brand", "d_year", "d_moy"]) \
+        .head(100)
+    return pa.Table.from_pandas(g.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q47", "monthly brand sales vs centered moving average (ROWS frame)")(
+    (_q47_run, _q47_oracle))

@@ -52,6 +52,11 @@ class WindowFunctionSpec:
     arg: Optional[ir.Expr] = None
     offset: int = 1                # lead/lag distance, nth n, ntile buckets
     default: object = None         # lead/lag default value
+    #: ROWS BETWEEN (lo, hi) relative offsets for 'agg' functions
+    #: (lo=-1, hi=1 is 1 PRECEDING..1 FOLLOWING); None = Spark's default
+    #: frame. Supported for sum/count/count_star/avg (prefix-sum
+    #: invertible); min/max over sliding frames fail fast.
+    frame: Optional[tuple] = None
 
     def __post_init__(self):
         if self.kind == "rank_like":
@@ -62,6 +67,14 @@ class WindowFunctionSpec:
             assert self.fn in AGG_FNS, self.fn
         else:
             raise ValueError(self.kind)
+        if self.frame is not None:
+            if self.kind != "agg" or self.fn in ("min", "max"):
+                raise NotImplementedError(
+                    "ROWS frames are supported for sum/count/avg window "
+                    "aggregates only (min/max need non-invertible sliding "
+                    "state)")
+            lo, hi = self.frame
+            assert lo <= hi, self.frame
 
 
 # ---------------------------------------------------------------------------
@@ -141,6 +154,20 @@ def _result_field(spec: WindowFunctionSpec, name: str,
     if spec.fn == "sum" and dt.is_integer:
         dt = DataType.INT64   # kernel accumulates int64 (Spark: sum → long)
     return Field(name, dt, True, p, s)
+
+
+def _decimal_half_up_div(total, count, shift: int):
+    """Scaled-int decimal average: (total * shift) / count rounded
+    HALF_UP away from zero (Spark Decimal.divide); quotient/remainder
+    form keeps the intermediate within one 10^delta shift of the sum.
+    Shared by the default-frame and ROWS-frame window avg paths."""
+    num = total * shift
+    safe = jnp.maximum(count, 1)
+    a = jnp.abs(num)
+    q0 = a // safe
+    rem = a - q0 * safe
+    q = q0 + (2 * rem >= safe)
+    return jnp.where(num < 0, -q, q)
 
 
 def _decimal_avg_type(p: int, s: int) -> tuple[int, int]:
@@ -313,6 +340,68 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
                 out_cols.append(out)
                 continue
 
+            if spec.frame is not None:
+                # ROWS BETWEEN lo..hi: windowed segmented sums via prefix
+                # differences — sum[i] = P[b] - P[a-1] with a/b clamped
+                # into the row's segment (reference: the frame-bounded agg
+                # processors in window/processors/agg.rs). Runs BEFORE the
+                # decimal-128 section so wide (or promoted) inputs fail
+                # fast instead of silently computing the default frame.
+                from auron_tpu.columnar.decimal128 import Decimal128Column
+                if v is not None and isinstance(v.col, Decimal128Column):
+                    raise NotImplementedError(
+                        "ROWS frames over decimal(p>18) window aggregates")
+                if v is not None and spec.fn == "avg":
+                    _dt0, _p0, _s0 = infer_dtype(spec.arg, in_schema)
+                    if _dt0 == DataType.DECIMAL and _p0 + 4 > 18:
+                        raise NotImplementedError(
+                            "ROWS frames over avg(decimal(p>14)): the "
+                            "framed sum would overflow the int64 path")
+                lo_off, hi_off = spec.frame
+
+                def frame_window(prefix):
+                    a = pos + lo_off
+                    b = pos + hi_off
+                    empty = (a > seg_end_row) | (b < seg_start)
+                    a_c = jnp.clip(a, seg_start, seg_end_row)
+                    b_c = jnp.clip(b, seg_start, seg_end_row)
+                    hi_v = prefix[jnp.clip(b_c, 0, cap - 1)]
+                    lo_v = jnp.where(
+                        a_c > seg_start,
+                        prefix[jnp.clip(a_c - 1, 0, cap - 1)], 0)
+                    return jnp.where(empty, 0, hi_v - lo_v)
+
+                if spec.fn == "count_star":
+                    # one scan: the count prefix IS the value prefix here
+                    p_cnt = _segmented_scan(live.astype(jnp.int64),
+                                            seg_new, jnp.add)
+                    out_cols.append(
+                        PrimitiveColumn(frame_window(p_cnt), live))
+                    continue
+                vv = v.validity & live
+                p_cnt = _segmented_scan(vv.astype(jnp.int64), seg_new,
+                                        jnp.add)
+                wcnt = frame_window(p_cnt)
+                if spec.fn == "count":
+                    out_cols.append(PrimitiveColumn(wcnt, live))
+                    continue
+                vals = jnp.where(vv, v.col.data, 0)
+                if jnp.issubdtype(vals.dtype, jnp.integer):
+                    vals = vals.astype(jnp.int64)
+                p_sum = _segmented_scan(vals, seg_new, jnp.add)
+                wsum = frame_window(p_sum)
+                if spec.fn == "avg":
+                    dt_in, _p, in_s = infer_dtype(spec.arg, in_schema)
+                    if dt_in == DataType.DECIMAL:
+                        _rp, rs = _decimal_avg_type(_p, in_s)
+                        wsum = _decimal_half_up_div(
+                            wsum, wcnt, 10 ** (rs - (in_s or 0)))
+                    else:
+                        wsum = wsum.astype(jnp.float64) \
+                            / jnp.maximum(wcnt, 1)
+                out_cols.append(PrimitiveColumn(wsum, (wcnt > 0) & live))
+                continue
+
             # agg over window — two-limb decimal(p>18) values run the
             # same segmented scans in 128-bit limb arithmetic
             from auron_tpu.columnar.decimal128 import Decimal128Column
@@ -384,17 +473,10 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
                     dt_in, _p, in_s = infer_dtype(spec.arg, in_schema)
                     if dt_in == DataType.DECIMAL:
                         # scaled-int divide at the (clamped) s+4 result
-                        # scale, HALF_UP away from zero, matching
-                        # Decimal.divide; quotient/remainder form keeps the
-                        # intermediate within one 10^delta shift of the sum
+                        # scale (shared HALF_UP helper)
                         _rp, rs = _decimal_avg_type(_p, in_s)
-                        num = run * (10 ** (rs - (in_s or 0)))
-                        has_safe = jnp.maximum(has, 1)
-                        a = jnp.abs(num)
-                        q0 = a // has_safe
-                        rem = a - q0 * has_safe
-                        q = q0 + (2 * rem >= has_safe)
-                        run = jnp.where(num < 0, -q, q)
+                        run = _decimal_half_up_div(
+                            run, has, 10 ** (rs - (in_s or 0)))
                     else:
                         run = run.astype(jnp.float64) / jnp.maximum(has, 1)
                 valid = has > 0
