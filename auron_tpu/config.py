@@ -102,6 +102,18 @@ SPILL_CODEC_LEVEL = _opt(
 # exchange-spill, and dense-kernel-selection knobs land together with
 # their features.
 
+# compile-cache ceiling (default lives in utils/compile_stats so the
+# mechanism and its documented value cannot drift)
+from auron_tpu.utils.compile_stats import DEFAULT_MAX_LIVE_PROGRAMS
+
+MAX_LIVE_PROGRAMS = _opt(
+    "auron.max_live_programs", int, DEFAULT_MAX_LIVE_PROGRAMS,
+    "Clear jax's compilation caches after this many XLA programs build "
+    "since the last clear (utils/compile_stats.maybe_clear — the CPU "
+    "backend's JIT can segfault once several hundred programs accumulate "
+    "in one long-lived process). Checked only at quiescent boundaries "
+    "(between serving tasks / runner queries); <= 0 disables.")
+
 # profiling
 PROFILE = _opt(
     "auron.profile", bool, False,

@@ -81,15 +81,13 @@ def maybe_clear(limit: int | None = None) -> bool:
     """Clear jax's compilation caches when more than ``limit`` programs
     were built since the last clear. Returns True when a clear happened.
     Call between tasks / test modules — never mid-kernel."""
-    import os
     install()   # counting must be live for the ceiling to mean anything
     if limit is None:
-        try:
-            limit = int(os.environ.get(
-                "AURON_MAX_LIVE_PROGRAMS",
-                DEFAULT_MAX_LIVE_PROGRAMS))
-        except ValueError:
-            limit = DEFAULT_MAX_LIVE_PROGRAMS
+        # single binding through the typed config layer (session override
+        # > AURON_CONF_MAX_LIVE_PROGRAMS env > default — the documented
+        # precedence); a malformed value raises there, loudly
+        from auron_tpu import config as cfg
+        limit = cfg.get_config().get(cfg.MAX_LIVE_PROGRAMS)
     if limit <= 0:
         return False
     with _LOCK:
