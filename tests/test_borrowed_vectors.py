@@ -908,6 +908,42 @@ class TestArrayMapVectors:
         _check_vector(fn("arrays_overlap", C(0), C(1)), two,
                       [True, None, False, False, None], "arrays_overlap")
 
+    def test_split_array_join(self):
+        # Spark split keeps empty parts with the default -1 limit;
+        # array_join skips nulls without a replacement
+        _check_vector(fn("split", C(0), lit(",", DataType.STRING)),
+                      {"c": pa.array(["a,b,c", "", None, "a,,b", "x"])},
+                      [["a", "b", "c"], [""], None, ["a", "", "b"],
+                       ["x"]], "split")
+        _check_vector(
+            fn("array_join", C(0), lit("-", DataType.STRING)),
+            {"c": pa.array([["a", "bb", None], [], None, ["q"]],
+                           pa.list_(pa.string()))},
+            ["a-bb", "", None, "q"], "array_join")
+        _check_vector(
+            fn("array_join", C(0), lit("-", DataType.STRING),
+               lit("NA", DataType.STRING)),
+            {"c": pa.array([["a", None, "b"]], pa.list_(pa.string()))},
+            ["a-NA-b"], "array_join repl")
+
+    def test_str_to_map_vectors(self):
+        _check_vector(fn("str_to_map", C(0)),
+                      {"c": pa.array(["a:1,b:2", "k", "", None])},
+                      [[("a", "1"), ("b", "2")], [("k", None)],
+                       [("", None)], None], "str_to_map")
+        _check_vector(
+            fn("element_at", fn("str_to_map", C(0)),
+               lit("b", DataType.STRING)),
+            {"c": pa.array(["a:1,b:2", "b:9,b:7", "x:0"])},
+            ["2", "7", None], "str_to_map lookup LAST_WINS")
+
+    def test_sort_array_strings_vector(self):
+        _check_vector(fn("sort_array", C(0)),
+                      {"c": pa.array([["pear", "apple", None], [], None],
+                                     pa.list_(pa.string()))},
+                      [[None, "apple", "pear"], [], None],
+                      "sort_array strings")
+
     def test_map_family(self):
         m = {"c": pa.array([[(1, 10), (2, 20)], []],
                            pa.map_(pa.int64(), pa.int64()))}
