@@ -102,6 +102,7 @@ def _run_suite(queries, tables, arrow, comparator, names=None,
     from auron_tpu.utils import compile_stats
     results = []
     suite_start = compile_stats.snapshot()
+    clears_start = compile_stats.clears()
     for q in queries:
         if names and q.name not in names:
             continue
@@ -133,10 +134,14 @@ def _run_suite(queries, tables, arrow, comparator, names=None,
     total = compile_stats.delta(suite_start)
     if verbose and budget_note:
         wall = sum(getattr(r, "elapsed_s", 0) or 0 for r in results)
+        n_clears = compile_stats.clears() - clears_start
+        note = ("a second run in this process should compile ~0"
+                if n_clears == 0 else
+                f"{n_clears} cache clears hit the auron.max_live_programs "
+                "ceiling, so warm reruns recompile cleared kernels")
         print(f"compile budget: {total.count} XLA programs, "
               f"{total.seconds:.1f}s compiling / {wall:.1f}s total "
-              "(a second run in this process should compile ~0)",
-              flush=True)
+              f"({note})", flush=True)
     return results
 
 

@@ -77,6 +77,16 @@ _SINCE_CLEAR = {"count": 0}
 DEFAULT_MAX_LIVE_PROGRAMS = 400
 
 
+#: process-lifetime count of cache clears (observability for the
+#: suite runners' compile-budget note)
+_CLEARS = {"count": 0}
+
+
+def clears() -> int:
+    with _LOCK:
+        return _CLEARS["count"]
+
+
 def maybe_clear(limit: int | None = None) -> bool:
     """Clear jax's compilation caches when more than ``limit`` programs
     were built since the last clear. Returns True when a clear happened.
@@ -98,4 +108,6 @@ def maybe_clear(limit: int | None = None) -> bool:
         return False
     import jax
     jax.clear_caches()
+    with _LOCK:
+        _CLEARS["count"] += 1
     return True
