@@ -327,7 +327,67 @@ class GroupedData:
         self.keys = [_wrap(k) if not isinstance(k, Col) else k
                      for k in keys]
 
+    def _rewrite_wide_distinct(self, aggs) -> Optional["DataFrame"]:
+        """count/sum/avg DISTINCT over DECIMAL: plan distinct the way a
+        vector engine wants it anyway — an inner regroup on (keys..., arg)
+        dedupes the pairs (wide-decimal group keys are first-class since
+        the limb-grouping work), then the plain decimal aggregate runs
+        over the deduped rows with exact Spark result types
+        (sum → decimal(p+10,s), avg → decimal(p+4,s+4) HALF_UP). The
+        set-accumulator path cannot do either: its single int64 word
+        cannot hold two-limb p>18 values, and its finalizers lose the
+        decimal type (float avg). Distributed plans fall out for free:
+        the inner agg exchanges on (keys, arg), the outer agg
+        re-exchanges on keys. Reference models distinct as expand-to-set
+        (agg/acc.rs); Spark similarly regroups distinct aggregates."""
+        schema = self.df.schema
+
+        def dec_info(a: AggCol):
+            """(rewritable, needs): decimal distinct count/sum/avg can
+            join the regroup; it is REQUIRED when the set path cannot
+            serve the aggregate — two-limb p>18 values, or sum/avg whose
+            set finalizers lose the Spark decimal result type. Narrow
+            count-distinct alone stays on the set path (exact there), so
+            mixed queries like (count(distinct d), count_star()) keep
+            working."""
+            if not (a.distinct and a.fn in ("count", "sum", "avg")
+                    and a.arg is not None):
+                return False, False
+            dt, p, _s = infer_dtype(resolve(a.arg, schema), schema)
+            if dt != DataType.DECIMAL:
+                return False, False
+            return True, (p > 18 or a.fn in ("sum", "avg"))
+
+        infos = [dec_info(a) for a in aggs]
+        if not any(needs for _r, needs in infos):
+            return None
+        dec = [a for a, (r, _n) in zip(aggs, infos) if r]
+        if len(dec) != len(aggs):
+            raise NotImplementedError(
+                "DISTINCT over decimal cannot be mixed with other "
+                "aggregates in one agg() call: the distinct regroup "
+                "rewrite would dedupe the other aggregates' input rows. "
+                "Split the decimal-distinct aggregates into their own "
+                "agg().")
+        arg_reprs = {repr(resolve(a.arg, schema)) for a in dec}
+        if len(arg_reprs) > 1:
+            raise NotImplementedError(
+                "decimal DISTINCT aggregates in one agg() call must "
+                "share one argument expression (one regroup dedupes one "
+                "column); split differing arguments into separate agg()s.")
+
+        dcol = dec[0].arg.alias("__wd_arg__")
+        inner = GroupedData(self.df, list(self.keys) + [dcol]).agg()
+        key_names = [k.out_name(f"k{i}") for i, k in enumerate(self.keys)]
+        outer_aggs = [AggCol(a.fn, col("__wd_arg__"), name=a.out_name(i))
+                      for i, a in enumerate(dec)]
+        return GroupedData(inner, [col(n) for n in key_names]).agg(
+            *outer_aggs)
+
     def agg(self, *aggs: AggCol) -> "DataFrame":
+        rewritten = self._rewrite_wide_distinct(aggs)
+        if rewritten is not None:
+            return rewritten
         schema = self.df.schema
         group_exprs = [resolve(k, schema) for k in self.keys]
         group_names = [k.out_name(f"k{i}") for i, k in enumerate(self.keys)]

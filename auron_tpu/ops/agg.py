@@ -167,14 +167,16 @@ def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
     dt, p, s = infer_dtype(agg.arg, in_schema)
     wide = dt == DataType.DECIMAL and p > 18
     if fn == "sum":
-        if wide:
-            # Spark: sum(decimal(p,s)) → decimal(min(p+10,38), s); sums
-            # past 2^127 wrap before the 10^38 fits-check can see them —
-            # same accepted limitation as the narrow path's int64 sums
-            sp = min(p + 10, 38)
+        if dt == DataType.DECIMAL and p + 10 > 18:
+            # Spark: sum(decimal(p,s)) → decimal(min(p+10,38), s). Narrow
+            # inputs with p in 9..18 promote to the two-limb
+            # representation with the Spark type (DecimalType.bounded, as
+            # the avg branch); wide sums past 2^127 wrap before the 10^38
+            # fits-check can see them — same accepted limitation as the
+            # narrow path's int64 sums
             return AccSpec(fn, (("sum", DataType.DECIMAL, "dsum"),
                                 ("has", DataType.BOOL, "or")),
-                           (DataType.DECIMAL, sp, s))
+                           (DataType.DECIMAL, min(p + 10, 38), s))
         sdt = _SUM_DTYPE[dt]
         sp, ss = (min(p + 10, 18), s) if sdt == DataType.DECIMAL else (0, 0)
         return AccSpec(fn, (("sum", sdt, "sum"), ("has", DataType.BOOL, "or")),

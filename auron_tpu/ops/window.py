@@ -149,8 +149,11 @@ def _result_field(spec: WindowFunctionSpec, name: str,
                 p, s = _decimal_avg_type(p, s)
         elif dt != DataType.FLOAT64:
             dt = DataType.FLOAT64
-    if spec.fn == "sum" and dt == DataType.DECIMAL and p > 18:
-        p = min(p + 10, 38)   # Spark sum headroom, 128-bit cap
+    if spec.fn == "sum" and dt == DataType.DECIMAL:
+        # Spark sum headroom for narrow AND wide inputs: sum(decimal(p,s))
+        # is decimal(p+10, s) capped at the 128-bit 38; narrow inputs with
+        # p+10 > 18 promote to the two-limb representation (AggOp parity)
+        p = min(p + 10, 38)
     if spec.fn == "sum" and dt.is_integer:
         dt = DataType.INT64   # kernel accumulates int64 (Spark: sum → long)
     return Field(name, dt, True, p, s)
@@ -344,32 +347,36 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
                 # ROWS BETWEEN lo..hi: windowed segmented sums via prefix
                 # differences — sum[i] = P[b] - P[a-1] with a/b clamped
                 # into the row's segment (reference: the frame-bounded agg
-                # processors in window/processors/agg.rs). Runs BEFORE the
-                # decimal-128 section so wide (or promoted) inputs fail
-                # fast instead of silently computing the default frame.
+                # processors in window/processors/agg.rs). Sums whose
+                # declared type exceeds 18 digits (wide input, or narrow
+                # promoted by the p+10 headroom) run the scan in 128-bit
+                # limbs; framed avg over those still fails fast.
                 from auron_tpu.columnar.decimal128 import Decimal128Column
-                if v is not None and isinstance(v.col, Decimal128Column):
-                    raise NotImplementedError(
-                        "ROWS frames over decimal(p>18) window aggregates")
                 if v is not None and spec.fn == "avg":
                     _dt0, _p0, _s0 = infer_dtype(spec.arg, in_schema)
-                    if _dt0 == DataType.DECIMAL and _p0 + 4 > 18:
+                    if isinstance(v.col, Decimal128Column) or (
+                            _dt0 == DataType.DECIMAL and _p0 + 4 > 18):
                         raise NotImplementedError(
                             "ROWS frames over avg(decimal(p>14)): the "
-                            "framed sum would overflow the int64 path")
+                            "framed HALF_UP division runs on the int64 "
+                            "path only")
                 lo_off, hi_off = spec.frame
 
+                # shared frame index math: prefix rows at the window's
+                # inclusive end (bi) and exclusive start (ai, valid only
+                # when has_lo), empty = window outside the segment
+                a = pos + lo_off
+                b = pos + hi_off
+                f_empty = (a > seg_end_row) | (b < seg_start)
+                a_c = jnp.clip(a, seg_start, seg_end_row)
+                b_c = jnp.clip(b, seg_start, seg_end_row)
+                f_bi = jnp.clip(b_c, 0, cap - 1)
+                f_ai = jnp.clip(a_c - 1, 0, cap - 1)
+                f_has_lo = a_c > seg_start
+
                 def frame_window(prefix):
-                    a = pos + lo_off
-                    b = pos + hi_off
-                    empty = (a > seg_end_row) | (b < seg_start)
-                    a_c = jnp.clip(a, seg_start, seg_end_row)
-                    b_c = jnp.clip(b, seg_start, seg_end_row)
-                    hi_v = prefix[jnp.clip(b_c, 0, cap - 1)]
-                    lo_v = jnp.where(
-                        a_c > seg_start,
-                        prefix[jnp.clip(a_c - 1, 0, cap - 1)], 0)
-                    return jnp.where(empty, 0, hi_v - lo_v)
+                    lo_v = jnp.where(f_has_lo, prefix[f_ai], 0)
+                    return jnp.where(f_empty, 0, prefix[f_bi] - lo_v)
 
                 if spec.fn == "count_star":
                     # one scan: the count prefix IS the value prefix here
@@ -385,13 +392,36 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
                 if spec.fn == "count":
                     out_cols.append(PrimitiveColumn(wcnt, live))
                     continue
+                dt_in, _p, in_s = infer_dtype(spec.arg, in_schema)
+                if spec.fn == "sum" and dt_in == DataType.DECIMAL \
+                        and (_p + 10 > 18
+                             or isinstance(v.col, Decimal128Column)):
+                    # wide-typed frame sum: exact 128-bit prefix scan +
+                    # limb-pair prefix differences, overflow-nulled at the
+                    # declared precision like the running-window path (an
+                    # int64 scan here can silently wrap inside a frame)
+                    from auron_tpu.columnar import decimal128 as d128
+                    if isinstance(v.col, Decimal128Column):
+                        s_hi, s_lo = v.col.hi, v.col.lo
+                    else:
+                        s_hi, s_lo = d128.from_int64(
+                            v.col.data.astype(jnp.int64))
+                    ph, pl = _segmented_scan128(
+                        jnp.where(vv, s_hi, 0), jnp.where(vv, s_lo, 0),
+                        seg_new, d128.add128)
+                    lh = jnp.where(f_has_lo, ph[f_ai], 0)
+                    ll = jnp.where(f_has_lo, pl[f_ai], 0)
+                    rh, rl = d128.sub128(ph[f_bi], pl[f_bi], lh, ll)
+                    ok = ((wcnt > 0) & live & ~f_empty
+                          & d128.fits_precision(rh, rl, min(_p + 10, 38)))
+                    out_cols.append(Decimal128Column(rh, rl, ok))
+                    continue
                 vals = jnp.where(vv, v.col.data, 0)
                 if jnp.issubdtype(vals.dtype, jnp.integer):
                     vals = vals.astype(jnp.int64)
                 p_sum = _segmented_scan(vals, seg_new, jnp.add)
                 wsum = frame_window(p_sum)
                 if spec.fn == "avg":
-                    dt_in, _p, in_s = infer_dtype(spec.arg, in_schema)
                     if dt_in == DataType.DECIMAL:
                         _rp, rs = _decimal_avg_type(_p, in_s)
                         wsum = _decimal_half_up_div(
@@ -405,12 +435,14 @@ def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
             # agg over window — two-limb decimal(p>18) values run the
             # same segmented scans in 128-bit limb arithmetic
             from auron_tpu.columnar.decimal128 import Decimal128Column
-            if (v is not None and spec.fn == "avg"
+            if (v is not None and spec.fn in ("avg", "sum")
                     and not isinstance(v.col, Decimal128Column)):
                 _dt, _p, _s = infer_dtype(spec.arg, in_schema)
-                if _dt == DataType.DECIMAL and _p + 4 > 18:
-                    # same p+4>18 wide promotion as AggOp: window avg of
-                    # decimal(15..18,s) returns Spark's decimal(p+4,s+4)
+                headroom = 4 if spec.fn == "avg" else 10
+                if _dt == DataType.DECIMAL and _p + headroom > 18:
+                    # same wide promotion as AggOp: window avg of
+                    # decimal(15..18,s) returns Spark's decimal(p+4,s+4),
+                    # window sum of decimal(9..18,s) decimal(p+10,s)
                     from auron_tpu.columnar import decimal128 as d128
                     _h, _l = d128.from_int64(v.col.data.astype(jnp.int64))
                     v = TypedValue(Decimal128Column(_h, _l, v.validity),

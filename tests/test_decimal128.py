@@ -502,6 +502,120 @@ class TestWideDecimalAgg:
             assert row["lg"] == prev.get(g)
             prev[g] = v
 
+
+    def test_window_sum_narrow_promotes_like_agg(self):
+        """AggOp/WindowOp parity: sum over decimal(12,2) declares Spark's
+        decimal(22,2) and rides the two-limb representation (running AND
+        ROWS-frame paths); totals stay exact past int64-scaled range."""
+        import pyarrow as pa
+        from auron_tpu.ops.window import WindowOp, WindowFunctionSpec
+        rng = random.Random(6)
+        n = 40
+        groups = [rng.randrange(3) for i in range(n)]
+        vals = [None if i % 9 == 0 else
+                decimal.Decimal(rng.randint(-10 ** 10, 10 ** 10)).scaleb(-2)
+                for i in range(n)]
+        rb = pa.record_batch({
+            "g": pa.array(groups, pa.int64()),
+            "o": pa.array(list(range(n)), pa.int64()),
+            "d": pa.array(vals, pa.decimal128(12, 2))})
+        op = WindowOp(mem_scan(rb, capacity=64), [C(0)],
+                      [ir.SortOrder(C(1), True, True)],
+                      [WindowFunctionSpec("agg", "sum", arg=C(2)),
+                       WindowFunctionSpec("agg", "sum", arg=C(2),
+                                          frame=(-2, 0))],
+                      output_names=["s", "fs"])
+        sf = [f for f in op.schema() if f.name == "s"][0]
+        assert (sf.precision, sf.scale) == (22, 2)
+        ff = [f for f in op.schema() if f.name == "fs"][0]
+        assert (ff.precision, ff.scale) == (22, 2)
+        got = collect(op).to_pandas().sort_values("o").reset_index(drop=True)
+        state: dict = {}
+        hist: dict = {}
+        for i in range(n):
+            g, v = groups[i], vals[i]
+            row = got.iloc[i]
+            seen = state.setdefault(g, [])
+            h = hist.setdefault(g, [])
+            h.append(v)
+            if v is not None:
+                seen.append(v)
+            if seen:
+                assert row["s"] == sum(seen), i
+            else:
+                assert row["s"] is None
+            win = [x for x in h[-3:] if x is not None]
+            if win:
+                assert row["fs"] == sum(win), i
+            else:
+                assert row["fs"] is None, i
+
+
+    def test_rows_frame_sum_128bit_no_wrap(self):
+        """Review finding: framed sums that exceed int64 in the scaled
+        representation must stay exact (128-bit scan), not wrap. Eleven
+        9.2e15.00 values in one 11-row frame total 1.012e17 — past
+        int64's 9.22e18 in cents? No: past it via the PREFIX (running
+        prefix of 40 such rows is 3.7e19 cents > 2^63), which is where
+        the int64 scan wrapped."""
+        import pyarrow as pa
+        from auron_tpu.ops.window import WindowOp, WindowFunctionSpec
+        n = 40
+        big = decimal.Decimal("9200000000000000.00")   # 9.2e17 cents
+        vals = [big] * n
+        rb = pa.record_batch({
+            "g": pa.array([1] * n, pa.int64()),
+            "o": pa.array(list(range(n)), pa.int64()),
+            "d": pa.array(vals, pa.decimal128(18, 2))})
+        op = WindowOp(mem_scan(rb, capacity=64), [C(0)],
+                      [ir.SortOrder(C(1), True, True)],
+                      [WindowFunctionSpec("agg", "sum", arg=C(2),
+                                          frame=(-10, 0))],
+                      output_names=["fs"])
+        got = collect(op).to_pandas().sort_values("o").reset_index(drop=True)
+        for i in range(n):
+            w = min(i + 1, 11)
+            assert got.loc[i, "fs"] == big * w, i
+
+    def test_rows_frame_sum_wide_input(self):
+        """ROWS frames over genuinely wide decimal(38,2) input (was a
+        fail-fast) now run the limb scan; overflow past decimal(38)
+        nulls like the running path."""
+        import pyarrow as pa
+        from auron_tpu.ops.window import WindowOp, WindowFunctionSpec
+        rng = random.Random(12)
+        n = 30
+        vals = [None if i % 6 == 5 else
+                decimal.Decimal(rng.randint(-10 ** 30, 10 ** 30)).scaleb(-2)
+                for i in range(n)]
+        rb = pa.record_batch({
+            "g": pa.array([i % 2 for i in range(n)], pa.int64()),
+            "o": pa.array(list(range(n)), pa.int64()),
+            "d": pa.array(vals, pa.decimal128(38, 2))})
+        op = WindowOp(mem_scan(rb, capacity=32), [C(0)],
+                      [ir.SortOrder(C(1), True, True)],
+                      [WindowFunctionSpec("agg", "sum", arg=C(2),
+                                          frame=(-2, 1))],
+                      output_names=["fs"])
+        got = collect(op).to_pandas().sort_values("o").reset_index(drop=True)
+        hist: dict = {}
+        rows_by_g: dict = {}
+        for i in range(n):
+            rows_by_g.setdefault(i % 2, []).append(i)
+        pos_in_g = {}
+        for g, idxs in rows_by_g.items():
+            for j, i in enumerate(idxs):
+                pos_in_g[i] = (g, j, idxs)
+        for i in range(n):
+            g, j, idxs = pos_in_g[i]
+            win = [vals[idxs[t]] for t in range(max(0, j - 2),
+                                               min(len(idxs), j + 2))]
+            nn = [v for v in win if v is not None]
+            if nn:
+                assert got.loc[i, "fs"] == sum(nn), i
+            else:
+                assert got.loc[i, "fs"] is None, i
+
     def test_hash_join_on_wide_key(self):
         # review finding: hash join needs limb equality in _keys_match
         import pyarrow as pa
@@ -554,3 +668,130 @@ class TestWideDecimalAgg:
         parts = hh % 16
         assert np.array_equal(parts[:64], parts[64:])  # deterministic
         assert len(set(parts.tolist())) > 4            # spread
+
+
+class TestWideDistinctRewrite:
+    """count/sum/avg DISTINCT over decimal(p>18) via the frontend's regroup
+    rewrite (GroupedData._rewrite_wide_distinct): inner agg on
+    (keys, arg) dedupes the two-limb values with the wide group-key
+    machinery, then the plain wide aggregate runs over the deduped rows.
+    Reference semantics: Spark plans distinct aggregates as a regroup the
+    same way; the AggOp-level fail-fast (test above) still guards the
+    direct-proto path."""
+
+    def _frame(self, seed=7, n=200, n_groups=4):
+        import pyarrow as pa
+        rng = random.Random(seed)
+        pool = [decimal.Decimal(x).scaleb(-2) for x in
+                (10 ** 25 + 1, -(10 ** 30 + 7), 42, 10 ** 19, 0, -5)]
+        groups = [rng.randrange(n_groups) for _ in range(n)]
+        vals = [None if i % 11 == 0 else rng.choice(pool)
+                for i in range(n)]
+        tbl = pa.table({"g": pa.array(groups, pa.int64()),
+                        "d": pa.array(vals, pa.decimal128(31, 2))})
+        per: dict = {}
+        for g, v in zip(groups, vals):
+            per.setdefault(g, set())
+            if v is not None:
+                per[g].add(v)
+        return tbl, per
+
+    @pytest.mark.parametrize("nparts", [1, 3])
+    def test_count_sum_avg_distinct(self, nparts):
+        from auron_tpu.frontend.session import Session
+        from auron_tpu.frontend.dataframe import functions as F, col
+        tbl, per = self._frame()
+        s = Session(batch_capacity=64)
+        df = s.from_arrow(tbl)
+        if nparts > 1:
+            df = df.repartition(nparts)
+        out = s.execute(df.group_by("g").agg(
+            F.count(col("d"), distinct=True).alias("c"),
+            F.sum(col("d"), distinct=True).alias("s"),
+            F.avg(col("d"), distinct=True).alias("a")))
+        rows = {r["g"]: r for r in out.to_pylist()}
+        assert set(rows) == set(per)
+        for g, dset in per.items():
+            assert rows[g]["c"] == len(dset)
+            assert rows[g]["s"] == sum(dset)
+            exp_avg = (sum(dset) / len(dset)).quantize(
+                decimal.Decimal(1).scaleb(-6),
+                rounding=decimal.ROUND_HALF_UP)
+            assert rows[g]["a"] == exp_avg, g
+
+    def test_global_distinct_no_keys(self):
+        from auron_tpu.frontend.session import Session
+        from auron_tpu.frontend.dataframe import functions as F, col
+        tbl, per = self._frame(seed=9, n_groups=1)
+        allv = set().union(*per.values())
+        s = Session(batch_capacity=64)
+        df = s.from_arrow(tbl).repartition(2)
+        out = s.execute(df.group_by().agg(
+            F.count(col("d"), distinct=True).alias("c"),
+            F.sum(col("d"), distinct=True).alias("s")))
+        [row] = out.to_pylist()
+        assert row["c"] == len(allv)
+        assert row["s"] == sum(allv)
+
+
+    def test_narrow_decimal_distinct_spark_types(self):
+        """The regroup rewrite covers narrow decimals too: the set path
+        would return float avg / typeless sum, but Spark types
+        sum(DISTINCT decimal(10,2)) as decimal(20,2) and avg as
+        decimal(14,6) HALF_UP."""
+        import pyarrow as pa
+        from auron_tpu.frontend.session import Session
+        from auron_tpu.frontend.dataframe import functions as F, col
+        vals = [decimal.Decimal(v).scaleb(-2)
+                for v in (125, 125, -300, 42, 42, 7)] + [None]
+        tbl = pa.table({"g": pa.array([0] * 7, pa.int64()),
+                        "d": pa.array(vals, pa.decimal128(10, 2))})
+        s = Session(batch_capacity=16)
+        out = s.execute(s.from_arrow(tbl).group_by("g").agg(
+            F.sum(col("d"), distinct=True).alias("s"),
+            F.avg(col("d"), distinct=True).alias("a")))
+        fs = {f.name: f.type for f in out.schema}
+        assert str(fs["s"]) == "decimal128(20, 2)", fs
+        assert str(fs["a"]) == "decimal128(14, 6)", fs
+        [row] = out.to_pylist()
+        dset = {v for v in vals if v is not None}
+        assert row["s"] == sum(dset)
+        assert row["a"] == (sum(dset) / len(dset)).quantize(
+            decimal.Decimal(1).scaleb(-6), rounding=decimal.ROUND_HALF_UP)
+
+    def test_mixed_and_differing_args_fail_fast(self):
+        import pyarrow as pa
+        from auron_tpu.frontend.session import Session
+        from auron_tpu.frontend.dataframe import functions as F, col
+        tbl = pa.table({"g": pa.array([0], pa.int64()),
+                        "d": pa.array([decimal.Decimal("1.00")],
+                                      pa.decimal128(25, 2)),
+                        "e": pa.array([decimal.Decimal("2.00")],
+                                      pa.decimal128(25, 2))})
+        s = Session(batch_capacity=16)
+        df = s.from_arrow(tbl)
+        with pytest.raises(NotImplementedError, match="mixed"):
+            df.group_by("g").agg(F.sum(col("d"), distinct=True),
+                                 F.count(col("d")))
+        with pytest.raises(NotImplementedError, match="one argument"):
+            df.group_by("g").agg(F.sum(col("d"), distinct=True),
+                                 F.count(col("e"), distinct=True))
+
+    def test_narrow_count_distinct_mixed_stays_on_set_path(self):
+        """Review finding: count-distinct over NARROW decimal mixed with
+        other aggregates must keep working via the set accumulator (the
+        regroup is only forced when the set path cannot serve)."""
+        import pyarrow as pa
+        from auron_tpu.frontend.session import Session
+        from auron_tpu.frontend.dataframe import functions as F, col
+        vals = [decimal.Decimal(v).scaleb(-2)
+                for v in (100, 100, 250, 250, 250, -7)]
+        tbl = pa.table({"g": pa.array([0, 0, 0, 1, 1, 1], pa.int64()),
+                        "d": pa.array(vals, pa.decimal128(10, 2))})
+        s = Session(batch_capacity=16)
+        out = s.execute(s.from_arrow(tbl).group_by("g").agg(
+            F.count(col("d"), distinct=True).alias("cd"),
+            F.count_star().alias("n")))
+        rows = {r["g"]: r for r in out.to_pylist()}
+        assert rows[0]["cd"] == 2 and rows[0]["n"] == 3
+        assert rows[1]["cd"] == 2 and rows[1]["n"] == 3
