@@ -58,6 +58,31 @@ def schema_from_arrow(sch: pa.Schema) -> Schema:
                     or pa.types.is_large_string(t.value_type):
                 fields.append(Field(f.name, DataType.LIST, f.nullable,
                                     elem=DataType.STRING))
+            elif pa.types.is_struct(t.value_type):
+                # entry list — list<struct<K, V>> with two primitive
+                # children (the map_entries / map_from_entries shape,
+                # reference: spark_map.rs:553 MapFromEntries). Carried on
+                # device by the MapColumn layout; Field.children hold the
+                # entry struct's fields.
+                st = t.value_type
+                if st.num_fields != 2:
+                    raise NotImplementedError(
+                        f"list of {st}: only 2-field entry structs "
+                        "(key/value) are materialized")
+                kids = []
+                for i in range(st.num_fields):
+                    cf = st.field(i)
+                    cdt = _PA_TO_DT.get(cf.type)
+                    if cdt in (None, DataType.NULL, DataType.STRING):
+                        # the MapColumn carrier holds numeric matrices
+                        # only — no char-tensor slot for string children
+                        raise NotImplementedError(
+                            f"entry-struct child {cf.name}: {cf.type} "
+                            "(numeric primitive children only)")
+                    kids.append(Field(cf.name, cdt, cf.nullable))
+                fields.append(Field(f.name, DataType.LIST, f.nullable,
+                                    elem=DataType.STRUCT,
+                                    children=tuple(kids)))
             else:
                 elem = _PA_TO_DT.get(t.value_type)
                 if elem is None or elem == DataType.NULL:
@@ -111,8 +136,13 @@ def schema_to_arrow(schema: Schema) -> pa.Schema:
         elif f.dtype == DataType.NULL:
             t = pa.null()
         elif f.dtype == DataType.LIST:
-            t = pa.list_(pa.string() if f.elem == DataType.STRING
-                         else pa.from_numpy_dtype(f.elem.to_np()))
+            if f.elem == DataType.STRUCT:
+                t = pa.list_(pa.struct(
+                    [pa.field(cf.name, pa.from_numpy_dtype(cf.dtype.to_np()),
+                              cf.nullable) for cf in f.children]))
+            else:
+                t = pa.list_(pa.string() if f.elem == DataType.STRING
+                             else pa.from_numpy_dtype(f.elem.to_np()))
         elif f.dtype == DataType.MAP:
             t = pa.map_(pa.string() if f.key == DataType.STRING
                         else pa.from_numpy_dtype(f.key.to_np()),
@@ -203,27 +233,61 @@ def _map_to_device(field: Field, arr: pa.Array, cap: int):
     """MapArray → MapColumn via two list-view extractions over the shared
     offsets (keys carry no element validity — Spark map keys are
     non-null)."""
-    from auron_tpu.columnar.batch import MapColumn
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
+    return _kv_lists_to_map_column(arr, arr.keys, arr.items,
+                                   field.key.to_np(), field.elem.to_np(),
+                                   cap)
+
+
+def _kv_lists_to_map_column(arr: pa.Array, karr: pa.Array, varr: pa.Array,
+                            key_np, val_np, cap: int):
+    """Shared MapColumn-carrier assembly for every offsets-over-(K,V)
+    arrow shape (MapArray, entry-list ListArray): two list-view
+    extractions over the shared offsets, null-row len zeroing, and
+    element-bucket unification."""
+    from auron_tpu.columnar.batch import MapColumn
     n = len(arr)
     offsets = np.asarray(arr.offsets)[: n + 1]
     off = pa.array(offsets.astype(np.int32), pa.int32())
-    keys_list = pa.ListArray.from_arrays(off, arr.keys)
-    items_list = pa.ListArray.from_arrays(off, arr.items)
-    kv, _kev, lens, _ = _list_arrays(keys_list, cap, field.key.to_np())
-    vv, vev, _vlens, _ = _list_arrays(items_list, cap, field.elem.to_np())
+    keys_list = pa.ListArray.from_arrays(off, karr)
+    items_list = pa.ListArray.from_arrays(off, varr)
+    kv, _kev, lens, _ = _list_arrays(keys_list, cap, key_np)
+    vv, vev, _vlens, _ = _list_arrays(items_list, cap, val_np)
     validity = np.zeros(cap, bool)
     validity[:n] = (~np.asarray(arr.is_null()) if arr.null_count
                     else np.ones(n, bool))
     lens = np.where(validity, lens, 0).astype(np.int32)
-    # unify element buckets (keys/values extracted independently)
     m = max(kv.shape[1], vv.shape[1])
     kv = np.pad(kv, ((0, 0), (0, m - kv.shape[1])))
     vv = np.pad(vv, ((0, 0), (0, m - vv.shape[1])))
     vev = np.pad(vev, ((0, 0), (0, m - vev.shape[1])))
     return MapColumn(jnp.asarray(kv), jnp.asarray(vv), jnp.asarray(vev),
                      jnp.asarray(lens), jnp.asarray(validity))
+
+
+def _entry_list_to_device(field: Field, arr: pa.Array, cap: int):
+    """list<struct<K,V>> (entry list) → MapColumn carrier: the parallel
+    key/value matrices + shared lens ARE the list-of-entry-structs layout
+    (reference renders MapArray the same offsets-over-struct way). Null
+    entry structs and null first-child ("key") values have no slot in the
+    carrier and fail fast host-side; Spark's MapFromEntries raises on
+    both anyway."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    struct_child = arr.values
+    if struct_child.null_count:
+        raise NotImplementedError(
+            "entry list with NULL entry structs: entries have no carrier "
+            "slot (Spark map_from_entries raises on null entries)")
+    kf, vf = field.children
+    karr = struct_child.field(0)
+    if karr.null_count:
+        raise NotImplementedError(
+            "entry list with NULL key children (Spark map keys are "
+            "non-null)")
+    return _kv_lists_to_map_column(arr, karr, struct_child.field(1),
+                                   kf.dtype.to_np(), vf.dtype.to_np(), cap)
 
 
 def _struct_to_device(field: Field, arr: pa.Array, cap: int):
@@ -350,6 +414,8 @@ def _column_to_device(field: Field, arr, cap: int,
     if field.dtype == DataType.LIST:
         if field.elem == DataType.STRING:
             return _string_list_to_device(arr, cap)
+        if field.elem == DataType.STRUCT:
+            return _entry_list_to_device(field, arr, cap)
         values, ev, lens, validity = _list_arrays(arr, cap,
                                                   field.elem.to_np())
         return ListColumn(jnp.asarray(values), jnp.asarray(ev),
@@ -491,6 +557,20 @@ def _host_col_to_arrow(field: Field, hc, n: int) -> pa.Array:
         validity = hc.validity
         lens = np.where(validity, hc.lens, 0).astype(np.int64)
         take = np.arange(hc.keys.shape[1])[None, :] < lens[:, None]
+        if field.dtype == DataType.LIST:
+            # entry list: same carrier, rendered as list<struct<K,V>>
+            kf, vf = field.children
+            karr = pa.array(hc.keys[take],
+                            pa.from_numpy_dtype(kf.dtype.to_np()))
+            varr = pa.array(hc.values[take],
+                            pa.from_numpy_dtype(vf.dtype.to_np()))
+            flat_vv = hc.val_valid[take]
+            if not flat_vv.all():
+                varr = _with_nulls(varr, flat_vv)
+            entries = pa.StructArray.from_arrays(
+                [karr, varr], names=[kf.name, vf.name])
+            off_arr = _list_offsets(lens, validity, n)
+            return pa.ListArray.from_arrays(off_arr, entries)
         karr = pa.array(hc.keys[take],
                         pa.from_numpy_dtype(field.key.to_np()))
         varr = pa.array(hc.values[take],

@@ -376,6 +376,8 @@ def _map_field(expr, schema):
                      elem=elem_dtype_of(expr.args[1], schema))
     if expr.name == "map_concat":
         return _map_field(expr.args[0], schema)
+    if expr.name == "map_from_entries":
+        return _map_from_entries_field(expr, schema)
     return infer_field(expr, schema)
 
 
@@ -500,6 +502,71 @@ def _map_values(args, expr, batch, schema, ctx):
             args[0].validity), DataType.LIST)
     return TypedValue(ListColumn(m.values, m.val_valid & _in_len(m),
                                  m.lens, args[0].validity), DataType.LIST)
+
+
+def _map_entries_field(expr, schema):
+    mf = _map_field(expr.args[0], schema)
+    if DataType.STRING in (mf.key, mf.elem):
+        # fail at plan time: the entry-list carrier (MapColumn) has no
+        # char-tensor slot, so a string entry schema could never egress
+        raise NotImplementedError(
+            "map_entries over map<string,...>: no string entry-struct "
+            "materialization")
+    return Field("c", DataType.LIST, True, elem=DataType.STRUCT,
+                 children=(Field("key", mf.key, False),
+                           Field("value", mf.elem, True)))
+
+
+@register("map_entries", _list_result, result_field=_map_entries_field)
+def _map_entries(args, expr, batch, schema, ctx):
+    """map → array<struct<key,value>> in entry order (reference:
+    spark_map.rs map_entries). The MapColumn layout — parallel key/value
+    matrices over shared lens — IS the list-of-entry-structs layout, so
+    the kernel is an identity re-type of the carrier."""
+    from auron_tpu.columnar.batch import StringMapColumn
+    m = args[0].col
+    if isinstance(m, StringMapColumn):
+        raise NotImplementedError(
+            "map_entries over map<string,string>: no string entry-struct "
+            "materialization")
+    return TypedValue(MapColumn(m.keys, m.values, m.val_valid, m.lens,
+                                args[0].validity), DataType.LIST)
+
+
+def _map_from_entries_field(expr, schema):
+    from auron_tpu.exprs.eval import infer_field
+    ef = infer_field(expr.args[0], schema)
+    if ef.dtype != DataType.LIST or ef.elem != DataType.STRUCT \
+            or len(ef.children) != 2:
+        raise NotImplementedError(
+            f"map_from_entries over {ef.dtype.value}: needs "
+            "array<struct<key,value>>")
+    kf, vf = ef.children
+    if DataType.DECIMAL in (kf.dtype, vf.dtype):
+        raise NotImplementedError(
+            "map_from_entries over DECIMAL entry children: map element "
+            "types carry no precision/scale; cast to double first")
+    return Field("m", DataType.MAP, True, key=kf.dtype, elem=vf.dtype)
+
+
+@register("map_from_entries", _map_result,
+          result_field=_map_from_entries_field)
+def _map_from_entries(args, expr, batch, schema, ctx):
+    """array<struct<key,value>> → map with LAST_WINS key dedup, matching
+    the map()/map_from_arrays family (reference: spark_map.rs:553
+    MapFromEntries; null entries/keys are rejected at the ingest
+    boundary — the entry-list carrier cannot hold them)."""
+    _map_from_entries_field(expr, schema)   # re-raise the typed guards
+    m = args[0].col
+    if not isinstance(m, MapColumn):
+        raise NotImplementedError(
+            "map_from_entries needs an array<struct<key,value>> entry "
+            "list")
+    kv, vv, vev, lens = _dedupe_last_wins(
+        m.keys, m.values, m.val_valid,
+        jnp.where(args[0].validity, m.lens, 0))
+    return TypedValue(MapColumn(kv, vv, vev, lens, args[0].validity),
+                      DataType.MAP)
 
 
 @register("map_contains_key", DataType.BOOL)

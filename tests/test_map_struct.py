@@ -394,3 +394,105 @@ class TestStructKeys:
         for i, srow in enumerate(rb.column("s").to_pylist()):
             k = self._key(srow)
             assert by_key.setdefault(k, ids[i]) == ids[i], (k, ids)
+
+
+class TestEntryLists:
+    """array<struct<key,value>> — the entry-list shape of
+    map_entries / map_from_entries (reference: spark_map.rs map_entries,
+    :553 MapFromEntries). Carried on device by the MapColumn layout;
+    list<struct> materializes in arrow on both directions."""
+
+    _ENTRY_T = pa.list_(pa.struct([pa.field("key", pa.int64(), False),
+                                   pa.field("value", pa.int64())]))
+
+    def test_arrow_roundtrip(self):
+        rows = [[{"key": 1, "value": 10}, {"key": 2, "value": None}],
+                None, [], [{"key": 5, "value": -1}]]
+        rb = pa.record_batch({"e": pa.array(rows, self._ENTRY_T)})
+        batch, schema = to_device(rb, capacity=8)
+        f = schema[0]
+        assert (f.dtype, f.elem) == (DataType.LIST, DataType.STRUCT)
+        assert [c.dtype for c in f.children] == [DataType.INT64] * 2
+        back = to_arrow(batch, schema)
+        assert back.column("e").to_pylist() == rows
+
+    def test_wire_serde_roundtrip(self):
+        rows = [[{"key": 3, "value": 7}], None,
+                [{"key": 1, "value": None}, {"key": 2, "value": 4}]]
+        rb = pa.record_batch({"e": pa.array(rows, self._ENTRY_T)})
+        batch, schema = to_device(rb, capacity=4)
+        back = deserialize_batch(serialize_batch(batch))
+        rb2 = to_arrow(back, schema)
+        assert rb2.column("e").to_pylist() == rows
+
+    def test_map_entries_identity_order(self):
+        rb = pa.record_batch({
+            "m": pa.array([[(10, 1), (20, None), (30, 3)], None, []],
+                          pa.map_(pa.int64(), pa.int64()))})
+        out = _project([fn("map_entries", ir.ColumnRef(0))], ["e"], rb)
+        assert out.column("e").to_pylist() == [
+            [{"key": 10, "value": 1}, {"key": 20, "value": None},
+             {"key": 30, "value": 3}], None, []]
+
+    def test_map_from_entries_dedup_last_wins(self):
+        rows = [[{"key": 1, "value": 10}, {"key": 2, "value": 20},
+                 {"key": 1, "value": 99}],
+                None, [{"key": 7, "value": None}], []]
+        rb = pa.record_batch({"e": pa.array(rows, self._ENTRY_T)})
+        out = _project([fn("map_from_entries", ir.ColumnRef(0))],
+                       ["m"], rb)
+        got = out.column("m").to_pylist()
+        assert got[1] is None
+        assert dict(got[0]) == {1: 99, 2: 20} and len(got[0]) == 2
+        assert got[2] == [(7, None)]
+        assert got[3] == []
+
+    def test_roundtrip_composition(self):
+        # map_from_entries . map_entries == identity on maps (already
+        # deduped by construction)
+        rb = pa.record_batch({
+            "m": pa.array([[(1, 5), (2, None)], [(9, 9)]],
+                          pa.map_(pa.int64(), pa.int64()))})
+        out = _project(
+            [fn("map_from_entries", fn("map_entries", ir.ColumnRef(0)))],
+            ["m"], rb)
+        assert out.column("m").to_pylist() == [[(1, 5), (2, None)],
+                                               [(9, 9)]]
+
+    def test_null_entries_and_null_keys_fail_fast(self):
+        t = self._ENTRY_T
+        with pytest.raises(NotImplementedError, match="NULL entry"):
+            to_device(pa.record_batch(
+                {"e": pa.array([[{"key": 1, "value": 1}, None]], t)}),
+                capacity=4)
+        t2 = pa.list_(pa.struct([pa.field("key", pa.int64()),
+                                 pa.field("value", pa.int64())]))
+        with pytest.raises(NotImplementedError, match="NULL key"):
+            to_device(pa.record_batch(
+                {"e": pa.array([[{"key": None, "value": 1}]], t2)}),
+                capacity=4)
+
+    def test_three_field_struct_rejected(self):
+        t = pa.list_(pa.struct([pa.field("a", pa.int64()),
+                                pa.field("b", pa.int64()),
+                                pa.field("c", pa.int64())]))
+        with pytest.raises(NotImplementedError, match="2-field"):
+            to_device(pa.record_batch(
+                {"e": pa.array([[{"a": 1, "b": 2, "c": 3}]], t)}),
+                capacity=4)
+
+
+    def test_string_entry_children_rejected_at_ingest(self):
+        t = pa.list_(pa.struct([pa.field("key", pa.string(), False),
+                                pa.field("value", pa.int64())]))
+        with pytest.raises(NotImplementedError, match="numeric"):
+            to_device(pa.record_batch(
+                {"e": pa.array([[{"key": "a", "value": 1}]], t)}),
+                capacity=4)
+
+    def test_string_map_entries_fail_fast(self):
+        rb = pa.record_batch({
+            "m": pa.array([[("a", "b")]],
+                          pa.map_(pa.string(), pa.string()))})
+        with pytest.raises(NotImplementedError, match="string"):
+            _project([fn("map_entries", ir.ColumnRef(0))], ["e"], rb)
