@@ -49,9 +49,11 @@ def _exchange_fn(mesh: Mesh, n_cols: int, quota: int, axis: str):
     Outputs:
       out_cols:     tuple of arrays [n_dev * (n_dev*quota), ...]
       out_num_rows: int32[n_dev]
-      max_count:    int32[n_dev]  largest bucket observed on this shard —
-                    rows were dropped iff max_count > quota, and the value
-                    tells the host the exact quota a single retry needs
+      max_count:    replicated int32 scalar — the GLOBAL largest bucket
+                    (pmax over the axis), readable on every controller of
+                    a multi-host run; rows were dropped iff it exceeds
+                    quota, and the value tells the host the exact quota a
+                    single retry needs
 
     Program builds are countable via ``_exchange_fn.cache_info().misses``;
     tests assert skew escalation stays within a 2-compile budget.
@@ -103,10 +105,15 @@ def _exchange_fn(mesh: Mesh, n_cols: int, quota: int, axis: str):
                             stable=True)
         out_cols = [c[order] for c in out_cols]
         out_nr = jnp.sum(recv_counts).astype(jnp.int32)
-        return (tuple(out_cols), out_nr[None], max_count[None])
+        # global (replicated) max bucket: the host-side quota check must
+        # read this value on EVERY controller in a multi-host run, and a
+        # P(axis)-sharded output is not fully addressable there — a pmax
+        # into a replicated output is, and costs one tiny collective
+        gmax = lax.pmax(max_count, axis)
+        return (tuple(out_cols), out_nr[None], gmax)
 
     in_specs = (tuple(P(axis) for _ in range(n_cols)), P(axis), P(axis))
-    out_specs = (tuple(P(axis) for _ in range(n_cols)), P(axis), P(axis))
+    out_specs = (tuple(P(axis) for _ in range(n_cols)), P(axis), P())
 
     return jax.jit(shard_map(local_fn, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs))
@@ -114,8 +121,9 @@ def _exchange_fn(mesh: Mesh, n_cols: int, quota: int, axis: str):
 
 def mesh_all_to_all(mesh: Mesh, cols: tuple, pids, num_rows, quota: int,
                     axis: str = "data"):
-    """Run the SPMD exchange; returns (cols, num_rows_per_shard, max_count).
-    Rows were dropped iff max(max_count) > quota; rerun at that quota."""
+    """Run the SPMD exchange; returns (cols, num_rows_per_shard, max_count)
+    with max_count the replicated global max bucket size. Rows were
+    dropped iff max_count > quota; rerun at that quota."""
     fn = _exchange_fn(mesh, len(cols), quota, axis)
     return fn(tuple(cols), pids, num_rows)
 
