@@ -147,7 +147,7 @@ def _run_suite(queries, tables, arrow, comparator, names=None,
 
 def run_tpcds(data_dir=None, scale: float = 1.0, names=None,
               verbose: bool = True) -> list[ComparisonResult]:
-    """The real-schema TPC-DS gate: 41 genuine TPC-DS query shapes over a
+    """The real-schema TPC-DS gate: 74 genuine TPC-DS query shapes over a
     scale-1.0 = 1M-fact-row dataset, diffed against the pyarrow/Acero
     oracle (reference gate: .github/workflows/tpcds-reusable.yml:70-83)."""
     from auron_tpu.it.tpcds import generate, load_arrow
@@ -213,8 +213,9 @@ def main(argv=None) -> int:
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--suite", default="synth",
                     choices=["synth", "tpcds", "tpch"],
-                    help="synth: the synthetic-star queries; tpcds: the 41 "
-                         "real-schema TPC-DS queries vs the Acero oracle; "
+                    help="synth: the synthetic-star queries; tpcds: the "
+                         "real-schema TPC-DS battery (see tpcds_queries) "
+                         "vs the Acero oracle; "
                          "tpch: the join-heavy q5/q9/q18 BASELINE targets")
     ap.add_argument("--queries", default="",
                     help="comma-separated names (q01 or full name)")

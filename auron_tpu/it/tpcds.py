@@ -127,6 +127,8 @@ def generate(root: str, scale: float = 1.0, seed: int = 7) -> dict:
         "d_day_name": pa.array(day_names[dow]),
         "d_month_seq": pa.array(((years - 1998) * 12 + months - 1)
                                 .astype(np.int64)),
+        # week boundary on Monday; base offset keeps values dsdgen-like
+        "d_week_seq": pa.array(((doff + 3) // 7 + 5270).astype(np.int64)),
     })
     out["date_dim"] = _write(root, "date_dim", date_dim)
 
@@ -327,9 +329,8 @@ def generate(root: str, scale: float = 1.0, seed: int = 7) -> dict:
     sales_c = (list_c * discount).astype(np.int64)
     coupon_c = np.where(rng.random(n_ss) < 0.1,
                         (sales_c * 0.2).astype(np.int64), 0)
-    ss_cust = pa.array(
-        [None if tk_cust_null[t] else int(tk_cust[t]) for t in tickets],
-        pa.int64())
+    ss_cust = pa.array(tk_cust[tickets], pa.int64(),
+                       mask=tk_cust_null[tickets])
     store_sales = pa.table({
         "ss_sold_date_sk": pa.array(DATE_SK0 + sold_date, pa.int64()),
         "ss_sold_time_sk": pa.array(tk_time[tickets], pa.int64()),
@@ -382,46 +383,172 @@ def generate(root: str, scale: float = 1.0, seed: int = 7) -> dict:
     out["store_returns"] = _write(root, "store_returns", store_returns, 2)
 
     # -- catalog_sales ------------------------------------------------------
-    cs_date = rng.choice(N_DATES, n_cs, p=date_p).astype(np.int64)
+    # ORDER coherence (like ss tickets): lines of one order share the
+    # customer, addresses, call center and date — q16/q94-class queries
+    # group and EXISTS-probe on order_number
+    n_orders = max(n_cs // 4, 2)
+    ord_date = rng.choice(N_DATES, n_orders, p=date_p).astype(np.int64)
+    ord_cust = rng.integers(1, n_cust + 1, n_orders)
+    ord_cust_null = rng.random(n_orders) < 0.02
+    ord_addr = rng.integers(1, n_addr + 1, n_orders)
+    ord_ship_addr = rng.integers(1, n_addr + 1, n_orders)
+    ord_cc = rng.integers(1, n_cc + 1, n_orders)
+    cs_ord = rng.integers(0, n_orders, n_cs).astype(np.int64)
+    cs_date = ord_date[cs_ord]
     cs_qty = rng.integers(1, 101, n_cs)
+    cs_whole = rng.integers(100, 10_000, n_cs)
     cs_list = rng.integers(100, 30_000, n_cs)
     cs_sales = (cs_list * rng.choice([1.0, 0.9, 0.7], n_cs)).astype(np.int64)
     cs_coupon = np.where(rng.random(n_cs) < 0.08,
                          (cs_sales * 0.15).astype(np.int64), 0)
+    cs_disc = np.maximum(cs_list - cs_sales, 0) * cs_qty
+    cs_cust = pa.array(ord_cust[cs_ord], pa.int64(),
+                       mask=ord_cust_null[cs_ord])
     catalog_sales = pa.table({
         "cs_sold_date_sk": pa.array(DATE_SK0 + cs_date, pa.int64()),
         "cs_ship_date_sk": pa.array(
             DATE_SK0 + cs_date + rng.integers(1, 150, n_cs), pa.int64()),
         "cs_item_sk": _fk_array(rng, n_cs, n_item, skew=True),
+        "cs_bill_customer_sk": cs_cust,
         "cs_bill_cdemo_sk": _fk_array(rng, n_cs, n_cd, 0.02),
+        "cs_bill_addr_sk": pa.array(ord_addr[cs_ord], pa.int64()),
+        "cs_ship_addr_sk": pa.array(ord_ship_addr[cs_ord], pa.int64()),
         "cs_warehouse_sk": _fk_array(rng, n_cs, n_wh, 0.01),
         "cs_ship_mode_sk": _fk_array(rng, n_cs, 20, 0.01),
-        "cs_call_center_sk": _fk_array(rng, n_cs, n_cc, 0.01),
+        "cs_call_center_sk": pa.array(ord_cc[cs_ord], pa.int64()),
         "cs_promo_sk": _fk_array(rng, n_cs, n_promo, 0.05),
+        "cs_order_number": pa.array(cs_ord + 1, pa.int64()),
         "cs_quantity": pa.array(cs_qty.astype(np.int64)),
+        "cs_wholesale_cost": _money_from_cents(cs_whole),
         "cs_list_price": _money_from_cents(cs_list),
         "cs_sales_price": _money_from_cents(cs_sales),
         "cs_coupon_amt": _money_from_cents(cs_coupon),
+        "cs_ext_discount_amt": _money_from_cents(cs_disc),
+        "cs_ext_ship_cost": _money(rng, n_cs, 0.5, 200.0),
         "cs_ext_sales_price": _money_from_cents(cs_sales * cs_qty),
+        "cs_net_profit": _money_from_cents(
+            (cs_sales - cs_whole) * cs_qty - cs_coupon),
     })
     out["catalog_sales"] = _write(root, "catalog_sales", catalog_sales, 4)
 
+    # -- catalog_returns (reference real cs order lines) --------------------
+    n_cr = n_cs // 10
+    cr_idx = rng.choice(n_cs, n_cr, replace=False)
+    cr_lag = rng.integers(1, 90, n_cr)
+    cr_amt = (cs_sales[cr_idx]
+              * rng.integers(1, cs_qty[cr_idx] + 1)
+              * rng.choice([1.0, 0.5], n_cr)).astype(np.int64)
+    catalog_returns = pa.table({
+        "cr_returned_date_sk": pa.array(
+            np.minimum(DATE_SK0 + cs_date[cr_idx] + cr_lag,
+                       DATE_SK0 + N_DATES - 1), pa.int64()),
+        "cr_item_sk": catalog_sales.column("cs_item_sk").take(
+            pa.array(cr_idx, pa.int64())),
+        "cr_order_number": pa.array(cs_ord[cr_idx] + 1, pa.int64()),
+        "cr_returning_customer_sk": cs_cust.take(
+            pa.array(cr_idx, pa.int64())),
+        "cr_returning_addr_sk": pa.array(ord_addr[cs_ord[cr_idx]],
+                                         pa.int64()),
+        "cr_call_center_sk": pa.array(ord_cc[cs_ord[cr_idx]], pa.int64()),
+        "cr_return_quantity": pa.array(
+            rng.integers(1, 50, n_cr).astype(np.int64)),
+        "cr_return_amount": _money_from_cents(cr_amt),
+        "cr_net_loss": _money(rng, n_cr, 0.5, 300.0),
+    })
+    out["catalog_returns"] = _write(root, "catalog_returns",
+                                    catalog_returns, 2)
+
     # -- web_sales ----------------------------------------------------------
-    ws_date = rng.choice(N_DATES, n_ws, p=date_p).astype(np.int64)
+    n_worders = max(n_ws // 3, 2)
+    wo_date = rng.choice(N_DATES, n_worders, p=date_p).astype(np.int64)
+    wo_time = rng.integers(0, 1440, n_worders)
+    wo_cust = rng.integers(1, n_cust + 1, n_worders)
+    wo_cust_null = rng.random(n_worders) < 0.02
+    wo_addr = rng.integers(1, n_addr + 1, n_worders)
+    wo_ship_addr = rng.integers(1, n_addr + 1, n_worders)
+    n_wp = 60
+    ws_ord = rng.integers(0, n_worders, n_ws).astype(np.int64)
+    ws_date = wo_date[ws_ord]
     ws_qty = rng.integers(1, 101, n_ws)
+    ws_whole = rng.integers(100, 10_000, n_ws)
     ws_sales = rng.integers(100, 30_000, n_ws)
+    ws_cust = pa.array(wo_cust[ws_ord], pa.int64(),
+                       mask=wo_cust_null[ws_ord])
     web_sales = pa.table({
         "ws_sold_date_sk": pa.array(DATE_SK0 + ws_date, pa.int64()),
+        "ws_sold_time_sk": pa.array(wo_time[ws_ord], pa.int64()),
         "ws_ship_date_sk": pa.array(
             DATE_SK0 + ws_date + rng.integers(1, 150, n_ws), pa.int64()),
         "ws_item_sk": _fk_array(rng, n_ws, n_item, skew=True),
+        "ws_bill_customer_sk": ws_cust,
+        "ws_bill_addr_sk": pa.array(wo_addr[ws_ord], pa.int64()),
+        "ws_ship_addr_sk": pa.array(wo_ship_addr[ws_ord], pa.int64()),
         "ws_web_site_sk": _fk_array(rng, n_ws, n_web, 0.01),
+        "ws_web_page_sk": _fk_array(rng, n_ws, n_wp, 0.01),
+        "ws_ship_hdemo_sk": _fk_array(rng, n_ws, n_hd, 0.01),
         "ws_warehouse_sk": _fk_array(rng, n_ws, n_wh, 0.01),
         "ws_ship_mode_sk": _fk_array(rng, n_ws, 20, 0.01),
+        "ws_order_number": pa.array(ws_ord + 1, pa.int64()),
         "ws_quantity": pa.array(ws_qty.astype(np.int64)),
+        "ws_sales_price": _money_from_cents(ws_sales),
         "ws_ext_sales_price": _money_from_cents(ws_sales * ws_qty),
+        "ws_ext_ship_cost": _money(rng, n_ws, 0.5, 200.0),
+        "ws_net_paid": _money_from_cents(ws_sales * ws_qty),
+        "ws_net_profit": _money_from_cents((ws_sales - ws_whole) * ws_qty),
     })
     out["web_sales"] = _write(root, "web_sales", web_sales, 2)
+
+    # -- web_returns (reference real ws order lines) ------------------------
+    n_wr = n_ws // 10
+    wr_idx = rng.choice(n_ws, n_wr, replace=False)
+    wr_lag = rng.integers(1, 90, n_wr)
+    wr_amt = (ws_sales[wr_idx] * rng.integers(1, ws_qty[wr_idx] + 1)
+              * rng.choice([1.0, 0.5], n_wr)).astype(np.int64)
+    web_returns = pa.table({
+        "wr_returned_date_sk": pa.array(
+            np.minimum(DATE_SK0 + ws_date[wr_idx] + wr_lag,
+                       DATE_SK0 + N_DATES - 1), pa.int64()),
+        "wr_item_sk": web_sales.column("ws_item_sk").take(
+            pa.array(wr_idx, pa.int64())),
+        "wr_order_number": pa.array(ws_ord[wr_idx] + 1, pa.int64()),
+        "wr_returning_customer_sk": ws_cust.take(
+            pa.array(wr_idx, pa.int64())),
+        "wr_refunded_cdemo_sk": _fk_array(rng, n_wr, n_cd, 0.02),
+        "wr_returning_cdemo_sk": _fk_array(rng, n_wr, n_cd, 0.02),
+        "wr_refunded_addr_sk": pa.array(wo_addr[ws_ord[wr_idx]],
+                                        pa.int64()),
+        "wr_reason_sk": _fk_array(rng, n_wr, 35, 0.01),
+        "wr_return_quantity": pa.array(
+            rng.integers(1, 50, n_wr).astype(np.int64)),
+        "wr_return_amt": _money_from_cents(wr_amt),
+        "wr_fee": _money(rng, n_wr, 0.5, 100.0),
+        "wr_net_loss": _money(rng, n_wr, 0.5, 300.0),
+    })
+    out["web_returns"] = _write(root, "web_returns", web_returns, 2)
+
+    # -- small dims: web_page / income_band / reason ------------------------
+    wpk = np.arange(1, n_wp + 1)
+    web_page = pa.table({
+        "wp_web_page_sk": pa.array(wpk, pa.int64()),
+        "wp_char_count": pa.array(rng.integers(100, 8_000, n_wp),
+                                  pa.int64()),
+    })
+    out["web_page"] = _write(root, "web_page", web_page)
+
+    ibk = np.arange(1, 21)
+    income_band = pa.table({
+        "ib_income_band_sk": pa.array(ibk, pa.int64()),
+        "ib_lower_bound": pa.array((ibk - 1) * 10_000, pa.int64()),
+        "ib_upper_bound": pa.array(ibk * 10_000 - 1, pa.int64()),
+    })
+    out["income_band"] = _write(root, "income_band", income_band)
+
+    rk = np.arange(1, 36)
+    reasons = pa.table({
+        "r_reason_sk": pa.array(rk, pa.int64()),
+        "r_reason_desc": pa.array([f"reason {k}" for k in rk]),
+    })
+    out["reason"] = _write(root, "reason", reasons)
 
     # -- inventory ----------------------------------------------------------
     inventory = pa.table({
