@@ -83,6 +83,14 @@ def schema_from_arrow(sch: pa.Schema) -> Schema:
                 fields.append(Field(f.name, DataType.LIST, f.nullable,
                                     elem=DataType.STRUCT,
                                     children=tuple(kids)))
+            elif pa.types.is_decimal(t.value_type):
+                if t.value_type.precision > 38:
+                    raise NotImplementedError(
+                        f"list of {t.value_type}: precision > 38")
+                fields.append(Field(f.name, DataType.LIST, f.nullable,
+                                    t.value_type.precision,
+                                    t.value_type.scale,
+                                    elem=DataType.DECIMAL))
             else:
                 elem = _PA_TO_DT.get(t.value_type)
                 if elem is None or elem == DataType.NULL:
@@ -140,6 +148,10 @@ def schema_to_arrow(schema: Schema) -> pa.Schema:
                 t = pa.list_(pa.struct(
                     [pa.field(cf.name, pa.from_numpy_dtype(cf.dtype.to_np()),
                               cf.nullable) for cf in f.children]))
+            elif f.elem == DataType.DECIMAL:
+                # element (p, s) rides the LIST field's precision/scale
+                # slots (wide collect_* results; ops/agg.py make_acc_spec)
+                t = pa.list_(pa.decimal128(f.precision or 38, f.scale))
             else:
                 t = pa.list_(pa.string() if f.elem == DataType.STRING
                              else pa.from_numpy_dtype(f.elem.to_np()))
@@ -264,6 +276,44 @@ def _kv_lists_to_map_column(arr: pa.Array, karr: pa.Array, varr: pa.Array,
     vev = np.pad(vev, ((0, 0), (0, m - vev.shape[1])))
     return MapColumn(jnp.asarray(kv), jnp.asarray(vv), jnp.asarray(vev),
                      jnp.asarray(lens), jnp.asarray(validity))
+
+
+def _decimal_list_to_device(field: Field, arr: pa.Array, cap: int):
+    """list<decimal128(p,s)> → ListColumn with scaled-int64 payload
+    (p<=18) or the MapColumn limb carrier (p>18). The child decimal
+    buffer IS two little-endian int64 limbs per value, so the limbs are
+    a zero-copy view re-wrapped as int64 list arrays over the shared
+    offsets."""
+    from auron_tpu.columnar.batch import ListColumn, MapColumn
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    n = len(arr)
+    child = arr.values
+    limbs = np.frombuffer(child.buffers()[1], dtype=np.int64,
+                          count=2 * len(child) if len(child) else 0,
+                          offset=child.offset * 16).reshape(-1, 2)
+    mask = (np.asarray(child.is_null()) if child.null_count
+            else np.zeros(len(child), bool))
+    offsets = np.asarray(arr.offsets)[: n + 1]
+    off = pa.array(offsets.astype(np.int32), pa.int32())
+    lo_list = pa.ListArray.from_arrays(
+        off, pa.array(np.ascontiguousarray(limbs[:, 0]), pa.int64(),
+                      mask=mask))
+    lo_m, ev, lens, _ = _list_arrays(lo_list, cap, np.int64)
+    validity = np.zeros(cap, bool)
+    validity[:n] = (~np.asarray(arr.is_null()) if arr.null_count
+                    else np.ones(n, bool))
+    lens = np.where(validity, lens, 0).astype(np.int32)
+    if field.precision <= 18:
+        return ListColumn(jnp.asarray(lo_m), jnp.asarray(ev),
+                          jnp.asarray(lens), jnp.asarray(validity))
+    hi_list = pa.ListArray.from_arrays(
+        off, pa.array(np.ascontiguousarray(limbs[:, 1]), pa.int64(),
+                      mask=mask))
+    hi_m, _hev, _l, _ = _list_arrays(hi_list, cap, np.int64)
+    return MapColumn(jnp.asarray(hi_m), jnp.asarray(lo_m),
+                     jnp.asarray(ev), jnp.asarray(lens),
+                     jnp.asarray(validity))
 
 
 def _entry_list_to_device(field: Field, arr: pa.Array, cap: int):
@@ -416,6 +466,8 @@ def _column_to_device(field: Field, arr, cap: int,
             return _string_list_to_device(arr, cap)
         if field.elem == DataType.STRUCT:
             return _entry_list_to_device(field, arr, cap)
+        if field.elem == DataType.DECIMAL:
+            return _decimal_list_to_device(field, arr, cap)
         values, ev, lens, validity = _list_arrays(arr, cap,
                                                   field.elem.to_np())
         return ListColumn(jnp.asarray(values), jnp.asarray(ev),
@@ -548,7 +600,15 @@ def _host_col_to_arrow(field: Field, hc, n: int) -> pa.Array:
         take = np.arange(hc.values.shape[1])[None, :] < lens[:, None]
         flat_vals = hc.values[take]
         flat_valid = hc.elem_valid[take]
-        child = pa.array(flat_vals, pa.from_numpy_dtype(field.elem.to_np()))
+        if field.elem == DataType.DECIMAL:
+            # scaled-int64 payload → decimal(p,s) child (narrow lists;
+            # wide ones ride the HostMap limb carrier)
+            child = pa.array(
+                [_int_to_decimal(int(x), field.scale) for x in flat_vals],
+                pa.decimal128(field.precision or 18, field.scale))
+        else:
+            child = pa.array(flat_vals,
+                             pa.from_numpy_dtype(field.elem.to_np()))
         if not flat_valid.all():
             child = _with_nulls(child, flat_valid)
         off_arr = _list_offsets(lens, validity, n)
@@ -557,6 +617,20 @@ def _host_col_to_arrow(field: Field, hc, n: int) -> pa.Array:
         validity = hc.validity
         lens = np.where(validity, hc.lens, 0).astype(np.int64)
         take = np.arange(hc.keys.shape[1])[None, :] < lens[:, None]
+        if field.dtype == DataType.LIST and field.elem == DataType.DECIMAL:
+            # list<decimal128>: the carrier's keys/values matrices are the
+            # hi/lo limbs of each element; element nulls ride val_valid
+            from auron_tpu.columnar.decimal128 import ints_from_limbs
+            flat_hi = hc.keys[take]
+            flat_lo = hc.values[take]
+            flat_vv = hc.val_valid[take]
+            ints = ints_from_limbs(flat_hi, flat_lo, flat_vv)
+            vals = [None if x is None else _int_to_decimal(x, field.scale)
+                    for x in ints]
+            child = pa.array(vals, pa.decimal128(field.precision or 38,
+                                                 field.scale))
+            off_arr = _list_offsets(lens, validity, n)
+            return pa.ListArray.from_arrays(off_arr, child)
         if field.dtype == DataType.LIST:
             # entry list: same carrier, rendered as list<struct<K,V>>
             kf, vf = field.children

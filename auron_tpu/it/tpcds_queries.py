@@ -1,6 +1,6 @@
 """Real TPC-DS queries over the real-schema dataset (tpcds.py).
 
-74 genuine TPC-DS query shapes — star joins, multi-dimension filters,
+78 genuine TPC-DS query shapes — star joins, multi-dimension filters,
 two-phase aggregation, CASE buckets, scalar subqueries, EXISTS/IN as
 semi/anti joins, ROLLUP/grouping-sets with grouping_id arithmetic,
 three-channel UNIONs, and window ratios — expressed in the frontend
@@ -4388,3 +4388,262 @@ def _q80_oracle(a):
 
 _q("q80", "store sales with LEFT-joined returns by store, one period")(
     (_q80_run, _q80_oracle))
+
+
+# ===========================================================================
+# q28: six price-band value profiles of store sales (scalar subqueries)
+# ===========================================================================
+
+def _q28_run(s, t):
+    from auron_tpu.frontend.dataframe import scalar_subquery
+    ss = _rd(s, t, "store_sales").select("ss_quantity", "ss_list_price")
+
+    def band(lo_q, hi_q, name):
+        b = ss.filter((col("ss_quantity") >= lo_q)
+                      & (col("ss_quantity") <= hi_q))
+        return (b.group_by()
+                .agg(F.avg(col("ss_list_price").cast(DataType.FLOAT64))
+                     .alias(f"avg{name}"),
+                     F.count(col("ss_list_price"), distinct=True)
+                     .alias(f"cnt{name}")))
+
+    b1 = band(0, 5, "1")
+    b2 = band(6, 10, "2")
+    b3 = band(11, 15, "3")
+    out = b1.select(
+        col("avg1"), col("cnt1"),
+        scalar_subquery(b2.select("avg2")).alias("avg2"),
+        scalar_subquery(b2.select(col("cnt2").alias("c"))).alias("cnt2"),
+        scalar_subquery(b3.select("avg3")).alias("avg3"),
+        scalar_subquery(b3.select(col("cnt3").alias("c"))).alias("cnt3"))
+    return out.collect()
+
+
+def _q28_oracle(a):
+    import pandas as pd
+    ss = a["store_sales"].to_pandas()
+    ss["lp"] = ss.ss_list_price.astype(float)
+
+    def band(lo_q, hi_q):
+        b = ss[(ss.ss_quantity >= lo_q) & (ss.ss_quantity <= hi_q)]
+        return float(b.lp.mean()), int(b.ss_list_price.nunique())
+
+    a1, c1 = band(0, 5)
+    a2, c2 = band(6, 10)
+    a3, c3 = band(11, 15)
+    return pa.Table.from_pydict({
+        "avg1": [a1], "cnt1": [c1], "avg2": [a2], "cnt2": [c2],
+        "avg3": [a3], "cnt3": [c3]})
+
+
+_q("q28", "price-band value profiles via scalar subqueries")(
+    (_q28_run, _q28_oracle))
+
+
+# ===========================================================================
+# q51: cumulative channel maxima — ss vs ws running totals by item/day
+# ===========================================================================
+
+def _q51_run(s, t):
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_month_seq") >= 24) & (col("d_month_seq") <= 27)) \
+        .select("d_date_sk")
+    it_keep = _rd(s, t, "item").filter(col("i_item_sk") <= 40) \
+        .select("i_item_sk")
+
+    def daily(fact, date_k, item_k, price, alias):
+        f = _rd(s, t, fact).select(date_k, item_k, price)
+        j = _join_dim(f, dd, date_k, "d_date_sk")
+        j = _join_dim(j, it_keep, item_k, "i_item_sk")
+        return (j.group_by(item_k, date_k)
+                .agg(F.sum(col(price)).alias(alias))
+                .select(col(item_k).alias("item_sk"),
+                        col(date_k).alias("date_sk"), col(alias)))
+
+    web = daily("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                "ws_ext_sales_price", "web_sales")
+    store = daily("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                  "ss_ext_sales_price", "store_sales_")
+    j = web.join(store, on=["item_sk", "date_sk"], how="inner")
+    w = j.window(
+        [F.win_agg("sum", col("web_sales").cast(DataType.FLOAT64))
+         .alias("cume_web"),
+         F.win_agg("sum", col("store_sales_").cast(DataType.FLOAT64))
+         .alias("cume_store")],
+        partition_by=[col("item_sk")], order_by=[col("date_sk")])
+    w = w.filter(col("cume_web") > col("cume_store"))
+    return (w.select("item_sk", "date_sk", "cume_web", "cume_store")
+            .sort(col("item_sk").asc(), col("date_sk").asc())
+            .limit(100).collect())
+
+
+def _q51_oracle(a):
+    import pandas as pd
+    dd = a["date_dim"].to_pandas()
+    days = set(dd[(dd.d_month_seq >= 24) & (dd.d_month_seq <= 27)]
+               .d_date_sk)
+
+    def daily(name, date_k, item_k, price, alias):
+        f = a[name].to_pandas()
+        f = f[f[date_k].isin(days) & (f[item_k] <= 40)].copy()
+        f["p"] = f[price].astype(float)
+        return f.groupby([item_k, date_k])["p"].sum() \
+            .reset_index(name=alias) \
+            .rename(columns={item_k: "item_sk", date_k: "date_sk"})
+
+    web = daily("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                "ws_ext_sales_price", "web_sales")
+    store = daily("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                  "ss_ext_sales_price", "store_sales_")
+    j = web.merge(store, on=["item_sk", "date_sk"])
+    j = j.sort_values(["item_sk", "date_sk"])
+    j["cume_web"] = j.groupby("item_sk")["web_sales"].cumsum()
+    j["cume_store"] = j.groupby("item_sk")["store_sales_"].cumsum()
+    j = j[j.cume_web > j.cume_store]
+    out = j[["item_sk", "date_sk", "cume_web", "cume_store"]] \
+        .sort_values(["item_sk", "date_sk"]).head(100)
+    return pa.Table.from_pandas(out.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q51", "items where web running total overtakes store (windows)")(
+    (_q51_run, _q51_oracle))
+
+
+# ===========================================================================
+# q85: web returns by reason for demographic/address refund slices
+# ===========================================================================
+
+def _q85_run(s, t):
+    wr = _rd(s, t, "web_returns").select(
+        "wr_returned_date_sk", "wr_item_sk", "wr_order_number",
+        "wr_refunded_cdemo_sk", "wr_refunded_addr_sk", "wr_reason_sk",
+        "wr_return_amt", "wr_fee")
+    ws = _rd(s, t, "web_sales").select(
+        col("ws_item_sk").alias("wr_item_sk"),
+        col("ws_order_number").alias("wr_order_number"),
+        col("ws_quantity"), col("ws_sales_price"))
+    j = wr.join(ws, on=["wr_item_sk", "wr_order_number"], how="inner")
+    dd = _rd(s, t, "date_dim").filter(col("d_year") == 2000) \
+        .select("d_date_sk")
+    j = _join_dim(j, dd, "wr_returned_date_sk", "d_date_sk")
+    cd = _rd(s, t, "customer_demographics").filter(
+        col("cd_education_status").isin("College", "Primary")
+        & col("cd_marital_status").isin("M", "S")) \
+        .select("cd_demo_sk")
+    j = _join_dim(j, cd, "wr_refunded_cdemo_sk", "cd_demo_sk")
+    ca = _rd(s, t, "customer_address").filter(
+        col("ca_state").isin("CA", "TX", "NY", "OH", "GA", "WA")) \
+        .select("ca_address_sk")
+    j = _join_dim(j, ca, "wr_refunded_addr_sk", "ca_address_sk")
+    r = _rd(s, t, "reason").select("r_reason_sk", "r_reason_desc")
+    j = _join_dim(j, r, "wr_reason_sk", "r_reason_sk")
+    return (j.group_by("r_reason_desc")
+            .agg(F.avg(col("ws_quantity").cast(DataType.FLOAT64))
+                 .alias("avg_qty"),
+                 F.avg(col("wr_return_amt").cast(DataType.FLOAT64))
+                 .alias("avg_amt"),
+                 F.avg(col("wr_fee").cast(DataType.FLOAT64))
+                 .alias("avg_fee"))
+            .sort(col("r_reason_desc").asc()).limit(100).collect())
+
+
+def _q85_oracle(a):
+    import pandas as pd
+    wr = a["web_returns"].to_pandas()
+    ws = a["web_sales"].to_pandas()[
+        ["ws_item_sk", "ws_order_number", "ws_quantity",
+         "ws_sales_price"]]
+    j = wr.merge(ws, left_on=["wr_item_sk", "wr_order_number"],
+                 right_on=["ws_item_sk", "ws_order_number"])
+    dd = a["date_dim"].to_pandas()
+    days = set(dd[dd.d_year == 2000].d_date_sk)
+    j = j[j.wr_returned_date_sk.isin(days)]
+    cd = a["customer_demographics"].to_pandas()
+    cds = set(cd[cd.cd_education_status.isin(["College", "Primary"])
+                 & cd.cd_marital_status.isin(["M", "S"])].cd_demo_sk)
+    j = j[j.wr_refunded_cdemo_sk.isin(cds)]
+    ca = a["customer_address"].to_pandas()
+    cas = set(ca[ca.ca_state.isin(["CA", "TX", "NY", "OH", "GA",
+                                   "WA"])].ca_address_sk)
+    j = j[j.wr_refunded_addr_sk.isin(cas)]
+    r = a["reason"].to_pandas()
+    j = j.merge(r, left_on="wr_reason_sk", right_on="r_reason_sk")
+    j["q"] = j.ws_quantity.astype(float)
+    j["amt"] = j.wr_return_amt.astype(float)
+    j["fee"] = j.wr_fee.astype(float)
+    g = j.groupby("r_reason_desc").agg(
+        avg_qty=("q", "mean"), avg_amt=("amt", "mean"),
+        avg_fee=("fee", "mean")).reset_index()
+    g = g.sort_values("r_reason_desc").head(100)
+    return pa.Table.from_pandas(g.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q85", "web return profiles by reason for refund slices")(
+    (_q85_run, _q85_oracle))
+
+
+# ===========================================================================
+# q83: 3-channel return totals for one set of weeks (week_seq subquery)
+# ===========================================================================
+
+def _q83_run(s, t):
+    weeks = _rd(s, t, "date_dim").filter(
+        col("d_moy").isin(2, 5, 8) & (col("d_year") == 2000)
+        & (col("d_dom") == 15)).select("d_week_seq")
+    dd = _rd(s, t, "date_dim").select("d_date_sk", "d_week_seq")
+    sel_days = dd.join(weeks, on="d_week_seq", how="semi") \
+        .select("d_date_sk")
+    it = _rd(s, t, "item").select("i_item_sk", "i_item_id")
+
+    def chan(fact, date_k, item_k, qty, alias):
+        f = _rd(s, t, fact).select(date_k, item_k, qty)
+        j = f.join(_rename(sel_days, d_date_sk=date_k), on=date_k,
+                   how="semi")
+        j = _join_dim(j, it, item_k, "i_item_sk")
+        return (j.group_by("i_item_id")
+                .agg(F.sum(col(qty)).alias(alias)))
+
+    sr = chan("store_returns", "sr_returned_date_sk", "sr_item_sk",
+              "sr_return_quantity", "sr_qty")
+    cr = chan("catalog_returns", "cr_returned_date_sk", "cr_item_sk",
+              "cr_return_quantity", "cr_qty")
+    wr = chan("web_returns", "wr_returned_date_sk", "wr_item_sk",
+              "wr_return_quantity", "wr_qty")
+    j = sr.join(cr, on="i_item_id", how="inner")
+    j = j.join(wr, on="i_item_id", how="inner")
+    return (j.select("i_item_id", "sr_qty", "cr_qty", "wr_qty")
+            .sort(col("i_item_id").asc()).limit(100).collect())
+
+
+def _q83_oracle(a):
+    import pandas as pd
+    dd = a["date_dim"].to_pandas()
+    weeks = set(dd[dd.d_moy.isin([2, 5, 8]) & (dd.d_year == 2000)
+                   & (dd.d_dom == 15)].d_week_seq)
+    days = set(dd[dd.d_week_seq.isin(weeks)].d_date_sk)
+    it = a["item"].to_pandas()[["i_item_sk", "i_item_id"]]
+
+    def chan(name, date_k, item_k, qty, alias):
+        f = a[name].to_pandas()
+        f = f[f[date_k].isin(days)]
+        j = f.merge(it, left_on=item_k, right_on="i_item_sk")
+        return j.groupby("i_item_id")[qty].sum().rename(alias)
+
+    sr = chan("store_returns", "sr_returned_date_sk", "sr_item_sk",
+              "sr_return_quantity", "sr_qty")
+    cr = chan("catalog_returns", "cr_returned_date_sk", "cr_item_sk",
+              "cr_return_quantity", "cr_qty")
+    wr = chan("web_returns", "wr_returned_date_sk", "wr_item_sk",
+              "wr_return_quantity", "wr_qty")
+    j = pd.concat([sr, cr, wr], axis=1).dropna().reset_index()
+    j = j.sort_values("i_item_id").head(100)
+    j[["sr_qty", "cr_qty", "wr_qty"]] = \
+        j[["sr_qty", "cr_qty", "wr_qty"]].astype("int64")
+    return pa.Table.from_pandas(j.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q83", "items returned in all 3 channels in chosen weeks")(
+    (_q83_run, _q83_oracle))

@@ -101,6 +101,12 @@ _STR_KINDS = ("smin", "smax", "sfirst", "sfirst_ign")
 #: sum.rs; here the i128 is two int64 limbs, columnar/decimal128.py)
 _DEC_KINDS = ("dsum", "dmin", "dmax", "dfirst")
 
+#: collect kinds over two-limb decimal(p>18) values; their accumulator is
+#: (hi[cap, E], lo[cap, E], lens[cap]) — the padded-list accumulator with
+#: limb-pair payloads. State/wire columns ride the MapColumn carrier
+#: (hi→keys, lo→values), the same offsets-over-pairs reuse as entry lists
+_DCOLLECT = ("dcollect_list", "dcollect_set")
+
 #: limb-pair neutral elements as plain python ints (module-level jnp
 #: constants would force backend init at import time — see ops/hashing.py).
 #: dmin's neutral is +2^127-1 (hi=INT64_MAX, lo=all-ones), dmax's is
@@ -232,10 +238,15 @@ def make_acc_spec(agg: ir.AggFunction, in_schema: Schema, mode: str) -> AccSpec:
         if dt in (DataType.STRING, DataType.LIST):
             raise NotImplementedError(f"{fn} over {dt.value}")
         if wide:
-            raise NotImplementedError(
-                f"{fn} over decimal(p={p}>18): the list accumulator is "
-                "single-word; cast the arg first")
-        return AccSpec(fn, (("list", dt, fn),), (DataType.LIST, 0, 0), elem=dt)
+            # two-limb elements: the (p, s) of the ELEMENT type rides the
+            # result's precision/scale slots (a LIST result has no other
+            # use for them) so serde/arrow can rebuild decimal128 values
+            return AccSpec(fn, (("list", dt, f"d{fn}"),),
+                           (DataType.LIST, p, s), elem=dt)
+        # narrow decimal elements carry their (p, s) the same way so the
+        # arrow boundary renders list<decimal(p,s)>, not raw scaled ints
+        return AccSpec(fn, (("list", dt, fn),), (DataType.LIST, p, s),
+                       elem=dt)
     raise NotImplementedError(f"aggregate function {fn}")
 
 
@@ -254,19 +265,34 @@ def _list_column_from_acc(acc, validity):
     return ListColumn(vals, ev, lens, validity)
 
 
+def _map_carrier_from_dacc(acc, validity):
+    """(hi[cap, E], lo[cap, E], lens[cap]) dcollect accumulator → the
+    MapColumn carrier used for list<decimal128> state/output columns
+    (hi→keys, lo→values; all in-range elements valid — collect skips
+    nulls on input)."""
+    from auron_tpu.columnar.batch import MapColumn
+    hi, lo, lens = acc
+    ev = (jnp.arange(hi.shape[1], dtype=jnp.int32)[None, :]
+          < lens[:, None])
+    return MapColumn(hi, lo, ev, lens, validity)
+
+
 def _unify_acc_pair(accs_a: tuple, accs_b: tuple) -> tuple[tuple, tuple]:
     """Pad the trailing (element-count / char-width) dimension of paired
     tuple accumulators so state and batch sides can merge shape-to-shape."""
+    def _pad2d(t, e):
+        # every 2-D member widens (limb-pair lists carry TWO matrices;
+        # strings carry one char matrix); 1-D lens/validity stay as-is
+        return tuple(jnp.pad(x, ((0, 0), (0, e - x.shape[1])))
+                     if x.ndim == 2 and x.shape[1] < e else x for x in t)
+
     out_a, out_b = [], []
     for a, b in zip(accs_a, accs_b):
         if isinstance(a, tuple) and a[0].ndim == 2:   # list/string accs;
             # decimal limb pairs are 1-D and width-free
-            ea, eb = a[0].shape[1], b[0].shape[1]
-            e = max(ea, eb)
-            if ea < e:
-                a = (jnp.pad(a[0], ((0, 0), (0, e - ea))),) + a[1:]
-            if eb < e:
-                b = (jnp.pad(b[0], ((0, 0), (0, e - eb))),) + b[1:]
+            e = max(a[0].shape[1], b[0].shape[1])
+            a = _pad2d(a, e)
+            b = _pad2d(b, e)
         out_a.append(a)
         out_b.append(b)
     return tuple(out_a), tuple(out_b)
@@ -342,9 +368,12 @@ def _reduce_sorted(keys_s, accs_s, live_s, h_s, acc_meta, out_cap):
     new_accs = []
     needed_elems = []
     for (kind, out_elems), acc in zip(acc_meta, accs_s):
-        if kind in ("collect_list", "collect_set"):
-            vals_s, lens_in = acc     # [cap, in_E], [cap] (already sorted)
-            in_e = vals_s.shape[1]
+        if kind in ("collect_list", "collect_set") or kind in _DCOLLECT:
+            # acc = (vals[cap, in_E], lens) — or limb pairs
+            # (hi[cap, in_E], lo[cap, in_E], lens) for the dcollect kinds;
+            # the offsets/scatter logic is per-matrix and shared
+            *mats, lens_in = acc
+            in_e = mats[0].shape[1]
             lens_s = jnp.where(live_s, lens_in, 0)
             # within-group exclusive element offset: global exclusive
             # cumsum minus the group's base (cumsum at its first row)
@@ -357,37 +386,42 @@ def _reduce_sorted(keys_s, accs_s, live_s, h_s, acc_meta, out_cap):
             ok = (live_s[:, None] & (j < lens_s[:, None])
                   & ((start[:, None] + j) < out_elems))
             flat = jnp.where(ok, flat, out_cap * out_elems)
-            out_vals = jnp.zeros((out_cap * out_elems,), vals_s.dtype).at[
-                flat.reshape(-1)].set(vals_s.reshape(-1), mode="drop")
-            out_vals = out_vals.reshape(out_cap, out_elems)
+
+            def scatter(m_s, _flat=flat):
+                buf = jnp.zeros((out_cap * out_elems,), m_s.dtype).at[
+                    _flat.reshape(-1)].set(m_s.reshape(-1), mode="drop")
+                return buf.reshape(out_cap, out_elems)
+
+            out_mats = [scatter(m) for m in mats]
             glens_raw = jax.ops.segment_sum(lens_s, gid,
                                             num_segments=out_cap)
             needed_elems.append(jnp.max(glens_raw))
             glens = jnp.minimum(glens_raw, out_elems)
-            if kind == "collect_set":
+            if kind in ("collect_set", "dcollect_set"):
                 # per-group dedupe, sort-based so memory stays
-                # O(cap * E): row-wise lexsort by (is_pad, value) pushes
+                # O(cap * E): row-wise lexsort by (is_pad, value...) pushes
                 # padding last and groups equal values adjacently; keep
                 # first-of-run, compact left. Set order is unspecified
-                # (as in Spark), so reordering is free.
+                # (as in Spark), so reordering is free. Limb pairs sort
+                # and compare on (hi, lo) jointly.
                 jj = jnp.arange(out_elems, dtype=jnp.int32)
                 pad = jj[None, :] >= glens[:, None]
-                s_pad, s_vals = jax.lax.sort(
-                    (pad, out_vals), dimension=1, num_keys=2)
-                neq = s_vals[:, 1:] != s_vals[:, :-1]
+                sorted_ops = jax.lax.sort(
+                    (pad, *out_mats), dimension=1,
+                    num_keys=1 + len(out_mats))
+                s_pad, *s_mats = sorted_ops
+                neq = s_mats[0][:, 1:] != s_mats[0][:, :-1]
+                for m in s_mats[1:]:
+                    neq = neq | (m[:, 1:] != m[:, :-1])
                 keep = ~s_pad & jnp.concatenate(
                     [jnp.ones((out_cap, 1), bool), neq], axis=1)
                 pos = jnp.cumsum(keep, axis=1) - 1
                 row = jnp.arange(out_cap, dtype=jnp.int32)[:, None]
                 flat2 = jnp.where(keep, row * out_elems + pos,
                                   out_cap * out_elems)
-                out_vals = jnp.zeros((out_cap * out_elems,),
-                                     vals_s.dtype).at[
-                    flat2.reshape(-1)].set(s_vals.reshape(-1),
-                                           mode="drop")
-                out_vals = out_vals.reshape(out_cap, out_elems)
+                out_mats = [scatter(m, flat2) for m in s_mats]
                 glens = jnp.sum(keep, axis=1).astype(jnp.int32)
-            new_accs.append((out_vals, glens))
+            new_accs.append((*out_mats, glens))
             continue
         if kind in _STR_KINDS:
             chars_s, lens_s, v = acc   # already sorted components
@@ -1137,9 +1171,18 @@ class AggOp(PhysicalOp):
             for spec, an in zip(self.specs, self.agg_names):
                 for fi, (fname, fdt, kind) in enumerate(spec.state_fields):
                     if kind in ("collect_list", "collect_set"):
-                        state_fields.append(Field(f"{an}#{fname}",
-                                                  DataType.LIST, True,
-                                                  elem=spec.elem))
+                        # element (p, s) riding the LIST slots covers
+                        # decimal elements (0/0 for everything else)
+                        state_fields.append(Field(
+                            f"{an}#{fname}", DataType.LIST, True,
+                            spec.result[1], spec.result[2],
+                            elem=spec.elem))
+                        continue
+                    if kind in _DCOLLECT:
+                        state_fields.append(Field(
+                            f"{an}#{fname}", DataType.LIST, True,
+                            spec.result[1], spec.result[2],
+                            elem=spec.elem))
                         continue
                     if spec.state_ps is not None:
                         prec, sc = spec.state_ps[fi]
@@ -1184,6 +1227,11 @@ class AggOp(PhysicalOp):
                                      jnp.where(col.validity, col.lens, 0)))
                         idx += 1
                         continue
+                    if kind in _DCOLLECT:
+                        accs.append((col.keys, col.values,
+                                     jnp.where(col.validity, col.lens, 0)))
+                        idx += 1
+                        continue
                     if kind in _STR_KINDS:
                         accs.append((col.chars, col.lens, col.validity))
                         idx += 1
@@ -1216,6 +1264,16 @@ class AggOp(PhysicalOp):
                     raise NotImplementedError(f"{agg.fn} over non-primitives")
                 valid = v.validity & live
                 accs.append((v.col.data[:, None], valid.astype(jnp.int32)))
+                continue
+            if spec.state_fields[0][2] in _DCOLLECT:
+                from auron_tpu.columnar.decimal128 import Decimal128Column
+                v = evaluate(agg.arg, batch, in_schema, ctx)
+                if not isinstance(v.col, Decimal128Column):
+                    raise NotImplementedError(
+                        f"{agg.fn}: expected two-limb decimal input")
+                valid = v.validity & live
+                accs.append((v.col.hi[:, None], v.col.lo[:, None],
+                             valid.astype(jnp.int32)))
                 continue
             if agg.fn in ("count", "count_star"):
                 if agg.arg is None:
@@ -1290,11 +1348,17 @@ class AggOp(PhysicalOp):
 
     def _collect_elems(self, accs) -> list[int]:
         from auron_tpu.utils.shapes import next_pow2
-        # list accumulators are (values[cap, E], lens[cap]); decimal limb
-        # pairs are also 2-tuples but 1-D and carry no element width
-        return [max(4, next_pow2(a[0].shape[1]))
-                if isinstance(a, tuple) and len(a) == 2 and a[0].ndim == 2
-                else 0 for a in accs]
+        # list accumulators are (values[cap, E], lens[cap]) — or limb-pair
+        # (hi[cap, E], lo[cap, E], lens[cap]) for dcollect; string accs
+        # are also 3-tuples but their [1] (lens) is 1-D, and decimal limb
+        # pairs are 2-tuples of 1-D arrays with no element width
+        def elems(a):
+            if not isinstance(a, tuple) or a[0].ndim != 2:
+                return 0
+            if len(a) == 2 or (len(a) == 3 and a[1].ndim == 2):
+                return max(4, next_pow2(a[0].shape[1]))
+            return 0
+        return [elems(a) for a in accs]
 
     def _grow_check(self, kinds, out_elems, ng, out_cap, needed):
         """Shared capacity/element-overflow check; mutates out_elems.
@@ -1303,7 +1367,7 @@ class AggOp(PhysicalOp):
         ok = ng <= out_cap
         ni = 0
         for i, k in enumerate(kinds):
-            if k in ("collect_list", "collect_set"):
+            if k in ("collect_list", "collect_set") or k in _DCOLLECT:
                 nd = int(needed[ni])
                 ni += 1
                 if nd > out_elems[i]:
@@ -1469,7 +1533,10 @@ class AggOp(PhysicalOp):
                         continue
                     data = accs[i]
                     i += 1
-                    if isinstance(data, tuple) and len(data) == 3:
+                    if kind in _DCOLLECT:
+                        out_cols.append(
+                            _map_carrier_from_dacc(data, valid))
+                    elif isinstance(data, tuple) and len(data) == 3:
                         out_cols.append(StringColumn(
                             data[0], data[1], data[2] & valid))
                     elif isinstance(data, tuple) and data[0].ndim == 1:
@@ -1556,7 +1623,11 @@ class AggOp(PhysicalOp):
                 elif fn in ("collect_list", "collect_set"):
                     # empty list (not null) for groups with only nulls —
                     # Spark's collect_* semantics
-                    out_cols.append(list_col(state_vals[0]))
+                    if spec.state_fields[0][2] in _DCOLLECT:
+                        out_cols.append(_map_carrier_from_dacc(
+                            state_vals[0], valid))
+                    else:
+                        out_cols.append(list_col(state_vals[0]))
                 elif fn in ("count_distinct", "sum_distinct",
                             "avg_distinct"):
                     vals, lens = state_vals[0]  # deduped set per group
@@ -1612,12 +1683,18 @@ class AggOp(PhysicalOp):
     # batch; on emit, spilled tables re-enter the same device merge kernel —
     # associativity of the accumulators makes re-merging exact.
 
+    def _device_kinds(self) -> list[str]:
+        return [kind for spec in self.specs
+                for (_f, _d, kind) in _device_fields(spec)]
+
     def _state_batch(self, state) -> DeviceBatch:
         keys, accs, num_groups, cap, _hashes = state
         valid = jnp.arange(cap, dtype=jnp.int32) < num_groups
         cols = list(keys)
-        for a in accs:
-            if isinstance(a, tuple) and len(a) == 3:
+        for kind, a in zip(self._device_kinds(), accs):
+            if kind in _DCOLLECT:
+                cols.append(_map_carrier_from_dacc(a, valid))
+            elif isinstance(a, tuple) and len(a) == 3:
                 cols.append(StringColumn(a[0], a[1], a[2] & valid))
             elif isinstance(a, tuple) and a[0].ndim == 1:
                 from auron_tpu.columnar.decimal128 import Decimal128Column
@@ -1639,6 +1716,11 @@ class AggOp(PhysicalOp):
                 col = batch.columns[idx]
                 if kind in ("collect_list", "collect_set"):
                     accs.append((col.values,
+                                 jnp.where(col.validity, col.lens, 0)))
+                    idx += 1
+                    continue
+                if kind in _DCOLLECT:
+                    accs.append((col.keys, col.values,
                                  jnp.where(col.validity, col.lens, 0)))
                     idx += 1
                     continue
@@ -1798,10 +1880,18 @@ class AggOp(PhysicalOp):
                 cols.append(PrimitiveColumn(jnp.zeros(1, jnp.int64),
                                             jnp.ones(1, bool)))
             elif spec.fn in ("collect_list", "collect_set"):
-                cols.append(ListColumn(
-                    jnp.zeros((1, 1), _JNPT[spec.elem]),
-                    jnp.zeros((1, 1), bool), jnp.zeros(1, jnp.int32),
-                    jnp.ones(1, bool)))
+                if spec.state_fields[0][2] in _DCOLLECT:
+                    from auron_tpu.columnar.batch import MapColumn
+                    cols.append(MapColumn(
+                        jnp.zeros((1, 1), jnp.int64),
+                        jnp.zeros((1, 1), jnp.int64),
+                        jnp.zeros((1, 1), bool), jnp.zeros(1, jnp.int32),
+                        jnp.ones(1, bool)))
+                else:
+                    cols.append(ListColumn(
+                        jnp.zeros((1, 1), _JNPT[spec.elem]),
+                        jnp.zeros((1, 1), bool), jnp.zeros(1, jnp.int32),
+                        jnp.ones(1, bool)))
             elif host is not None and si in host.entries:
                 # empty-input bloom/udaf: serialized empty filter /
                 # eval(zero()) — both via the normal result path
@@ -1874,7 +1964,17 @@ def make_acc_spec_from_partial(agg: ir.AggFunction, in_schema: Schema,
                             ("has", DataType.BOOL, "or")),
                        (f0.dtype, f0.precision, f0.scale))
     if fn in ("collect_list", "collect_set"):
-        return AccSpec(fn, (("list", f0.elem, fn),), (DataType.LIST, 0, 0),
+        if f0.elem == DataType.DECIMAL and f0.precision > 18:
+            # the dcollect state field: element (p, s) rides the LIST
+            # field's precision/scale slots (see make_acc_spec)
+            return AccSpec(fn, (("list", f0.elem, f"d{fn}"),),
+                           (DataType.LIST, f0.precision, f0.scale),
+                           elem=f0.elem)
+        # narrow elements keep their (p, s) the same way — dropping them
+        # here made distributed collect over decimal(p<=18) emit raw
+        # scaled ints (review finding)
+        return AccSpec(fn, (("list", f0.elem, fn),),
+                       (DataType.LIST, f0.precision, f0.scale),
                        elem=f0.elem)
     if fn == "bloom_filter":
         return AccSpec(fn, (("bloom", DataType.STRING, "bloom"),),

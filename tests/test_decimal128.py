@@ -795,3 +795,119 @@ class TestWideDistinctRewrite:
         rows = {r["g"]: r for r in out.to_pylist()}
         assert rows[0]["cd"] == 2 and rows[0]["n"] == 3
         assert rows[1]["cd"] == 2 and rows[1]["n"] == 3
+
+
+class TestWideCollect:
+    """collect_list / collect_set over decimal(p>18): the dcollect
+    accumulator carries limb-pair element matrices and the output rides
+    the MapColumn carrier rendered as list<decimal128(p,s)> (reference
+    keeps these as native Decimal128 arrays in its AccColumn,
+    agg/acc.rs). Narrow decimal collect now renders list<decimal(p,s)>
+    too instead of raw scaled ints."""
+
+    def _data(self, seed=5, n=120, n_groups=4):
+        import pyarrow as pa
+        rng = random.Random(seed)
+        pool = [decimal.Decimal(x).scaleb(-2)
+                for x in (10 ** 25 + 1, -(10 ** 30 + 7), 42, 0, 10 ** 19)]
+        groups = [rng.randrange(n_groups) for _ in range(n)]
+        vals = [None if i % 9 == 0 else rng.choice(pool)
+                for i in range(n)]
+        rb = pa.record_batch({"g": pa.array(groups, pa.int64()),
+                              "d": pa.array(vals, pa.decimal128(31, 2))})
+        exp: dict = {}
+        for g, v in zip(groups, vals):
+            exp.setdefault(g, [])
+            if v is not None:
+                exp[g].append(v)
+        return rb, exp
+
+    def test_complete_list_and_set(self):
+        from auron_tpu.ops.agg import AggOp
+        rb, exp = self._data()
+        op = AggOp(mem_scan(rb, capacity=128), [C(0)],
+                   [ir.AggFunction("collect_list", C(1)),
+                    ir.AggFunction("collect_set", C(1))],
+                   mode="complete", group_names=["g"],
+                   agg_names=["cl", "cs"], initial_capacity=8)
+        out = collect(op)
+        assert str(out.schema.field("cl").type) == \
+            "list<item: decimal128(31, 2)>"
+        rows = {r["g"]: r for r in out.to_pylist()}
+        for g in exp:
+            assert sorted(rows[g]["cl"]) == sorted(exp[g]), g
+            assert sorted(rows[g]["cs"]) == sorted(set(exp[g])), g
+
+    def test_partial_final_arrow_roundtrip(self):
+        import pyarrow as pa
+        from auron_tpu.ops.agg import AggOp
+        rb, exp = self._data(seed=9)
+        kw = dict(group_names=["g"], agg_names=["cl"], initial_capacity=8)
+        p1 = collect(AggOp(mem_scan(rb, capacity=128), [C(0)],
+                           [ir.AggFunction("collect_list", C(1))],
+                           mode="partial", **kw))
+        merged = p1.combine_chunks().to_batches()[0]
+        fin = AggOp(mem_scan(merged, capacity=64), [C(0)],
+                    [ir.AggFunction("collect_list", None)],
+                    mode="final", **kw)
+        rows = {r["g"]: sorted(r["cl"])
+                for r in collect(fin).to_pylist()}
+        for g in exp:
+            assert rows[g] == sorted(exp[g]), g
+
+    def test_frontend_distributed_collect_set(self):
+        import pyarrow as pa
+        from auron_tpu.frontend.session import Session
+        from auron_tpu.frontend.dataframe import functions as F, col
+        rb, exp = self._data(seed=11)
+        tbl = pa.Table.from_batches([rb])
+        s = Session(batch_capacity=32)
+        df = s.from_arrow(tbl).repartition(3)
+        out = s.execute(df.group_by("g").agg(
+            F.collect_set(col("d")).alias("cs")))
+        rows = {r["g"]: r["cs"] for r in out.to_pylist()}
+        for g in exp:
+            assert sorted(rows[g]) == sorted(set(exp[g])), g
+
+
+    def test_narrow_distributed_collect_keeps_scale(self):
+        """Review finding: partial/final collect over decimal(p<=18) must
+        carry the element (p, s) through the wire state — dropping it
+        made distributed results raw scaled ints (1.25 -> 125)."""
+        import pyarrow as pa
+        from auron_tpu.frontend.session import Session
+        from auron_tpu.frontend.dataframe import functions as F, col
+        vals = [decimal.Decimal(v).scaleb(-2)
+                for v in (125, -350, 777, 125)]
+        tbl = pa.table({"g": pa.array([0, 0, 1, 1], pa.int64()),
+                        "d": pa.array(vals, pa.decimal128(10, 2))})
+        s = Session(batch_capacity=8)
+        df = s.from_arrow(tbl).repartition(2)
+        out = s.execute(df.group_by("g").agg(
+            F.collect_list(col("d")).alias("cl")))
+        assert str(out.schema.field("cl").type) == \
+            "list<item: decimal128(10, 2)>"
+        rows = {r["g"]: sorted(r["cl"]) for r in out.to_pylist()}
+        assert rows[0] == [decimal.Decimal("-3.50"),
+                           decimal.Decimal("1.25")]
+        assert rows[1] == [decimal.Decimal("1.25"),
+                           decimal.Decimal("7.77")]
+
+    def test_narrow_decimal_collect_renders_decimal(self):
+        import pyarrow as pa
+        from auron_tpu.ops.agg import AggOp
+        rb = pa.record_batch({
+            "g": pa.array([0, 0, 1], pa.int64()),
+            "d": pa.array([decimal.Decimal("1.25"),
+                           decimal.Decimal("-3.50"), None],
+                          pa.decimal128(10, 2))})
+        out = collect(AggOp(mem_scan(rb, capacity=8), [C(0)],
+                            [ir.AggFunction("collect_list", C(1))],
+                            mode="complete", group_names=["g"],
+                            agg_names=["cl"], initial_capacity=4))
+        assert str(out.schema.field("cl").type) == \
+            "list<item: decimal128(10, 2)>"
+        rows = {r["g"]: r["cl"] for r in out.to_pylist()}
+        assert sorted(rows[0]) == [decimal.Decimal("-3.50"),
+                                   decimal.Decimal("1.25")]
+        assert rows[1] == []
