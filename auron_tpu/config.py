@@ -114,6 +114,20 @@ MAX_LIVE_PROGRAMS = _opt(
     "in one long-lived process). Checked only at quiescent boundaries "
     "(between serving tasks / runner queries); <= 0 disables.")
 
+# failure recovery
+TASK_MAX_RETRIES = _opt(
+    "auron.task.max_retries", int, 2,
+    "Transient-failure retries per (plan, partition) task in the driver "
+    "collect path. The engine is functional, so a retry is an exact "
+    "partition-granularity recompute (the recovery unit the reference "
+    "delegates to Spark's task scheduler, SURVEY §5.3); cancellation is "
+    "never retried. 0 disables.")
+TASK_RETRY_BACKOFF_S = _opt(
+    "auron.task.retry_backoff_s", float, 0.0,
+    "Sleep before each task retry attempt (scaled by the attempt "
+    "number). Keep 0 for in-process transients; set >0 when retries "
+    "wait out external systems (remote FS, RSS service).")
+
 # profiling
 PROFILE = _opt(
     "auron.profile", bool, False,

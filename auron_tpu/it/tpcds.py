@@ -492,6 +492,9 @@ def generate(root: str, scale: float = 1.0, seed: int = 7) -> dict:
         "ws_quantity": pa.array(ws_qty.astype(np.int64)),
         "ws_sales_price": _money_from_cents(ws_sales),
         "ws_ext_sales_price": _money_from_cents(ws_sales * ws_qty),
+        "ws_ext_discount_amt": _money_from_cents(
+            np.maximum((ws_sales * 0.3).astype(np.int64)
+                       - rng.integers(0, 5000, n_ws), 0) * ws_qty),
         "ws_ext_ship_cost": _money(rng, n_ws, 0.5, 200.0),
         "ws_net_paid": _money_from_cents(ws_sales * ws_qty),
         "ws_net_profit": _money_from_cents((ws_sales - ws_whole) * ws_qty),

@@ -1,6 +1,6 @@
 """Real TPC-DS queries over the real-schema dataset (tpcds.py).
 
-78 genuine TPC-DS query shapes — star joins, multi-dimension filters,
+94 genuine TPC-DS query shapes — star joins, multi-dimension filters,
 two-phase aggregation, CASE buckets, scalar subqueries, EXISTS/IN as
 semi/anti joins, ROLLUP/grouping-sets with grouping_id arithmetic,
 three-channel UNIONs, and window ratios — expressed in the frontend
@@ -4647,3 +4647,1153 @@ def _q83_oracle(a):
 
 _q("q83", "items returned in all 3 channels in chosen weeks")(
     (_q83_run, _q83_oracle))
+
+
+# ===========================================================================
+# q2: web+catalog weekly sales, year-over-year day-of-week ratios
+# ===========================================================================
+
+def _q2_run(s, t):
+    dd = _rd(s, t, "date_dim").select("d_date_sk", "d_week_seq",
+                                      "d_day_name")
+
+    def chan(fact, date_k, price):
+        f = _rd(s, t, fact).select(col(date_k).alias("d_date_sk"),
+                                   col(price).alias("p"))
+        return f
+
+    u = chan("web_sales", "ws_sold_date_sk", "ws_ext_sales_price") \
+        .union(chan("catalog_sales", "cs_sold_date_sk",
+                    "cs_ext_sales_price"))
+    j = u.join(dd, on="d_date_sk", how="inner")
+    price = col("p").cast(DataType.FLOAT64)
+    for day, nm in (("Sunday", "sun"), ("Monday", "mon"),
+                    ("Thursday", "thu"), ("Saturday", "sat")):
+        j = j.with_column(nm, F.if_(col("d_day_name") == day, price,
+                                    lit(0.0)))
+    wk = (j.group_by("d_week_seq")
+          .agg(F.sum(col("sun")).alias("sun_s"),
+               F.sum(col("mon")).alias("mon_s"),
+               F.sum(col("thu")).alias("thu_s"),
+               F.sum(col("sat")).alias("sat_s")))
+    y1 = wk.filter((col("d_week_seq") >= 5270 + 52)
+                   & (col("d_week_seq") < 5270 + 104)) \
+        .select(col("d_week_seq").alias("wk"), col("sun_s").alias("s1"),
+                col("mon_s").alias("m1"), col("thu_s").alias("t1"),
+                col("sat_s").alias("a1"))
+    y2 = wk.filter((col("d_week_seq") >= 5270 + 104)
+                   & (col("d_week_seq") < 5270 + 156)) \
+        .select((col("d_week_seq") - lit(52, DataType.INT64)).alias("wk"),
+                col("sun_s").alias("s2"), col("mon_s").alias("m2"),
+                col("thu_s").alias("t2"), col("sat_s").alias("a2"))
+    j2 = y1.join(y2, on="wk", how="inner")
+    safe = lambda a, b: F.if_(col(b) > lit(0.0), col(a) / col(b),
+                              lit(None, DataType.FLOAT64))
+    out = j2.select(col("wk"), safe("s1", "s2").alias("sun_r"),
+                    safe("m1", "m2").alias("mon_r"),
+                    safe("t1", "t2").alias("thu_r"),
+                    safe("a1", "a2").alias("sat_r"))
+    return out.sort(col("wk").asc()).limit(100).collect()
+
+
+def _q2_oracle(a):
+    import numpy as _np
+    import pandas as pd
+    dd = a["date_dim"].to_pandas()[["d_date_sk", "d_week_seq",
+                                    "d_day_name"]]
+    frames = []
+    for name, date_k, price in (
+            ("web_sales", "ws_sold_date_sk", "ws_ext_sales_price"),
+            ("catalog_sales", "cs_sold_date_sk", "cs_ext_sales_price")):
+        f = a[name].to_pandas()[[date_k, price]]
+        f.columns = ["d_date_sk", "p"]
+        frames.append(f)
+    u = pd.concat(frames).merge(dd, on="d_date_sk")
+    u["pf"] = u.p.astype(float)
+    for day, nm in (("Sunday", "sun"), ("Monday", "mon"),
+                    ("Thursday", "thu"), ("Saturday", "sat")):
+        u[nm] = u.pf.where(u.d_day_name == day, 0.0)
+    wk = u.groupby("d_week_seq")[["sun", "mon", "thu", "sat"]].sum()
+    y1 = wk[(wk.index >= 5270 + 52) & (wk.index < 5270 + 104)].copy()
+    y2 = wk[(wk.index >= 5270 + 104) & (wk.index < 5270 + 156)].copy()
+    y2.index = y2.index - 52
+    j = y1.join(y2, lsuffix="1", rsuffix="2", how="inner")
+    out = pd.DataFrame(index=j.index)
+    for nm, r in (("sun", "sun_r"), ("mon", "mon_r"), ("thu", "thu_r"),
+                  ("sat", "sat_r")):
+        out[r] = _np.where(j[nm + "2"] > 0, j[nm + "1"] / j[nm + "2"],
+                           _np.nan)
+    out = out.reset_index().rename(columns={"d_week_seq": "wk"})
+    out = out.sort_values("wk").head(100)
+    return pa.Table.from_pandas(out.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q2", "web+catalog weekly sales YoY day-of-week ratios")(
+    (_q2_run, _q2_oracle))
+
+
+# ===========================================================================
+# q8: store sales for stores whose zip prefix matches active-buyer zips
+# ===========================================================================
+
+def _q8_run(s, t):
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_qoy") == 2) & (col("d_year") == 1998)).select("d_date_sk")
+    ss = _rd(s, t, "store_sales").select(
+        "ss_sold_date_sk", "ss_store_sk", "ss_net_profit")
+    # zips of customers who buy (preference slice), as 2-char prefixes
+    c = _rd(s, t, "customer").select("c_current_addr_sk")
+    ca = _rd(s, t, "customer_address").select("ca_address_sk", "ca_zip")
+    buyers = _join_dim(c, ca, "c_current_addr_sk", "ca_address_sk") \
+        .select(F.substring(col("ca_zip"), lit(1), lit(2)).alias("zp")) \
+        .group_by("zp").agg()
+    st = _rd(s, t, "store").select("s_store_sk", "s_store_name", "s_zip")
+    st = st.with_column("zp", F.substring(col("s_zip"), lit(1), lit(2)))
+    st = st.join(buyers, on="zp", how="semi")
+    j = _join_dim(ss, dd, "ss_sold_date_sk", "d_date_sk")
+    j = _join_dim(j, st, "ss_store_sk", "s_store_sk")
+    return (j.group_by("s_store_name")
+            .agg(F.sum(col("ss_net_profit")).alias("profit"))
+            .sort(col("s_store_name").asc()).limit(100).collect())
+
+
+def _q8_oracle(a):
+    import pandas as pd
+    dd = a["date_dim"].to_pandas()
+    days = set(dd[(dd.d_qoy == 2) & (dd.d_year == 1998)].d_date_sk)
+    c = a["customer"].to_pandas()
+    ca = a["customer_address"].to_pandas()[["ca_address_sk", "ca_zip"]]
+    j = c.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+    zps = set(j.ca_zip.str[:2])
+    st = a["store"].to_pandas()
+    st = st[st.s_zip.str[:2].isin(zps)]
+    ss = a["store_sales"].to_pandas()
+    ss = ss[ss.ss_sold_date_sk.isin(days)
+            & ss.ss_store_sk.isin(set(st.s_store_sk))]
+    j2 = ss.merge(st[["s_store_sk", "s_store_name"]], left_on="ss_store_sk",
+                  right_on="s_store_sk")
+    g = j2.groupby("s_store_name")["ss_net_profit"].sum() \
+        .reset_index(name="profit")
+    g = g.sort_values("s_store_name").head(100)
+    return pa.Table.from_pandas(g.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q8", "store profits where store zip matches buyer zip prefixes")(
+    (_q8_run, _q8_oracle))
+
+
+# ===========================================================================
+# q11: customers whose web yearly growth beat store growth (q74 on ids)
+# ===========================================================================
+
+def _q11_run(s, t):
+    c = _rd(s, t, "customer").select("c_customer_sk", "c_customer_id")
+
+    def totals(fact, cust_k, date_k, paid_k, years, alias):
+        f = _rd(s, t, fact).select(cust_k, date_k, paid_k)
+        dd = _rd(s, t, "date_dim").filter(col("d_year").isin(*years)) \
+            .select("d_date_sk")
+        j = _join_dim(f, dd, date_k, "d_date_sk")
+        return (j.group_by(cust_k)
+                .agg(F.sum(col(paid_k)).alias(alias))
+                .select(col(cust_k).alias("c_customer_sk"), col(alias)))
+
+    ss1 = totals("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                 "ss_ext_list_price", (1998, 1999, 2000), "ss1")
+    ss2 = totals("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                 "ss_ext_list_price", (2001, 2002), "ss2")
+    ws1 = totals("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+                 "ws_ext_sales_price", (1998, 1999, 2000), "ws1")
+    ws2 = totals("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+                 "ws_ext_sales_price", (2001, 2002), "ws2")
+    j = c.join(ss1, on="c_customer_sk", how="inner")
+    j = j.join(ss2, on="c_customer_sk", how="inner")
+    j = j.join(ws1, on="c_customer_sk", how="inner")
+    j = j.join(ws2, on="c_customer_sk", how="inner")
+    f = lambda nm: col(nm).cast(DataType.FLOAT64)
+    j = j.filter((f("ss1") > lit(0.0)) & (f("ws1") > lit(0.0))
+                 & (f("ws2") / f("ws1") > f("ss2") / f("ss1")))
+    return (j.select("c_customer_id")
+            .sort(col("c_customer_id").asc()).limit(100).collect())
+
+
+def _q11_oracle(a):
+    import pandas as pd
+    dd = a["date_dim"].to_pandas()
+    y1 = set(dd[dd.d_year.isin([1998, 1999, 2000])].d_date_sk)
+    y2 = set(dd[dd.d_year.isin([2001, 2002])].d_date_sk)
+
+    def totals(name, cust_k, date_k, paid_k, days):
+        f = a[name].to_pandas()
+        f = f[f[date_k].isin(days) & f[cust_k].notna()].copy()
+        f["v"] = f[paid_k].astype(float)
+        return f.groupby(cust_k)["v"].sum()
+
+    ss1 = totals("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                 "ss_ext_list_price", y1)
+    ss2 = totals("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                 "ss_ext_list_price", y2)
+    ws1 = totals("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+                 "ws_ext_sales_price", y1)
+    ws2 = totals("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+                 "ws_ext_sales_price", y2)
+    df = pd.concat([ss1.rename("ss1"), ss2.rename("ss2"),
+                    ws1.rename("ws1"), ws2.rename("ws2")], axis=1).dropna()
+    df = df[(df.ss1 > 0) & (df.ws1 > 0)
+            & (df.ws2 / df.ws1 > df.ss2 / df.ss1)]
+    c = a["customer"].to_pandas().set_index("c_customer_sk")
+    out = c.loc[c.index.intersection(df.index)][["c_customer_id"]] \
+        .sort_values("c_customer_id").head(100)
+    return pa.Table.from_pandas(out.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q11", "customers whose web growth beat store growth (list-price)")(
+    (_q11_run, _q11_oracle))
+
+
+# ===========================================================================
+# q27: demographic item averages with state ROLLUP
+# ===========================================================================
+
+def _q27_run(s, t):
+    ss = _rd(s, t, "store_sales").select(
+        "ss_sold_date_sk", "ss_item_sk", "ss_store_sk", "ss_cdemo_sk",
+        "ss_quantity", "ss_list_price", "ss_coupon_amt",
+        "ss_sales_price")
+    cd = _rd(s, t, "customer_demographics").filter(
+        (col("cd_gender") == "F") & (col("cd_marital_status") == "D")
+        & (col("cd_education_status") == "College")) \
+        .select("cd_demo_sk")
+    dd = _rd(s, t, "date_dim").filter(col("d_year") == 2000) \
+        .select("d_date_sk")
+    st = _rd(s, t, "store").filter(
+        col("s_state").isin("CA", "TX", "NY", "OH")) \
+        .select("s_store_sk", "s_state")
+    it = _rd(s, t, "item").select("i_item_sk", "i_item_id")
+    j = _join_dim(ss, cd, "ss_cdemo_sk", "cd_demo_sk")
+    j = _join_dim(j, dd, "ss_sold_date_sk", "d_date_sk")
+    j = _join_dim(j, st, "ss_store_sk", "s_store_sk")
+    j = _join_dim(j, it, "ss_item_sk", "i_item_sk")
+    g = (j.rollup(col("i_item_id"), col("s_state"))
+         .agg(F.avg(col("ss_quantity").cast(DataType.FLOAT64))
+              .alias("agg1"),
+              F.avg(col("ss_list_price").cast(DataType.FLOAT64))
+              .alias("agg2"),
+              F.avg(col("ss_coupon_amt").cast(DataType.FLOAT64))
+              .alias("agg3"),
+              F.avg(col("ss_sales_price").cast(DataType.FLOAT64))
+              .alias("agg4")))
+    return (g.select("i_item_id", "s_state", "agg1", "agg2", "agg3",
+                     "agg4")
+            .sort(col("i_item_id").asc(), col("s_state").asc())
+            .limit(100).collect())
+
+
+def _q27_oracle(a):
+    import pandas as pd
+    cd = a["customer_demographics"].to_pandas()
+    cds = set(cd[(cd.cd_gender == "F") & (cd.cd_marital_status == "D")
+                 & (cd.cd_education_status == "College")].cd_demo_sk)
+    dd = a["date_dim"].to_pandas()
+    days = set(dd[dd.d_year == 2000].d_date_sk)
+    st = a["store"].to_pandas()
+    st = st[st.s_state.isin(["CA", "TX", "NY", "OH"])][
+        ["s_store_sk", "s_state"]]
+    it = a["item"].to_pandas()[["i_item_sk", "i_item_id"]]
+    ss = a["store_sales"].to_pandas()
+    j = ss[ss.ss_cdemo_sk.isin(cds) & ss.ss_sold_date_sk.isin(days)]
+    j = j.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    for src_c, nm in (("ss_quantity", "q"), ("ss_list_price", "lp"),
+                      ("ss_coupon_amt", "cp"), ("ss_sales_price", "sp")):
+        j[nm] = j[src_c].astype(float)
+    lv2 = j.groupby(["i_item_id", "s_state"])[
+        ["q", "lp", "cp", "sp"]].mean().reset_index()
+    lv1 = j.groupby(["i_item_id"])[["q", "lp", "cp", "sp"]] \
+        .mean().reset_index()
+    lv1["s_state"] = None
+    lv0 = pd.DataFrame([{"i_item_id": None, "s_state": None,
+                         "q": j.q.mean(), "lp": j.lp.mean(),
+                         "cp": j.cp.mean(), "sp": j.sp.mean()}])
+    g = pd.concat([lv2, lv1, lv0], ignore_index=True).rename(
+        columns={"q": "agg1", "lp": "agg2", "cp": "agg3", "sp": "agg4"})
+    g = g[["i_item_id", "s_state", "agg1", "agg2", "agg3", "agg4"]]
+    g = g.sort_values(["i_item_id", "s_state"],
+                      na_position="first").head(100)
+    return pa.Table.from_pandas(g.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q27", "demographic item averages with state ROLLUP")(
+    (_q27_run, _q27_oracle))
+
+
+# ===========================================================================
+# q29: store buy -> return -> store re-buy quantities (q25's qty twin)
+# ===========================================================================
+
+def _q29_run(s, t):
+    ss = _rd(s, t, "store_sales").select(
+        "ss_sold_date_sk", "ss_item_sk", "ss_store_sk", "ss_customer_sk",
+        "ss_ticket_number", "ss_quantity")
+    sr = _rd(s, t, "store_returns").select(
+        "sr_returned_date_sk", "sr_item_sk", "sr_customer_sk",
+        "sr_ticket_number", "sr_return_quantity")
+    cs = _rd(s, t, "catalog_sales").select(
+        "cs_sold_date_sk", "cs_item_sk", "cs_bill_customer_sk",
+        "cs_quantity")
+    d1 = _rd(s, t, "date_dim").filter(
+        (col("d_moy") >= 1) & (col("d_moy") <= 6)
+        & (col("d_year") == 2000)).select(
+        col("d_date_sk").alias("ss_sold_date_sk"))
+    d2 = _rd(s, t, "date_dim").filter(col("d_year") == 2000).select(
+        col("d_date_sk").alias("sr_returned_date_sk"))
+    d3 = _rd(s, t, "date_dim").filter(
+        col("d_year").isin(2000, 2001, 2002)).select(
+        col("d_date_sk").alias("cs_sold_date_sk"))
+    st = _rd(s, t, "store").select("s_store_sk", "s_store_id",
+                                   "s_store_name")
+    it = _rd(s, t, "item").select("i_item_sk", "i_item_id", "i_item_desc")
+    j = ss.join(d1, on="ss_sold_date_sk", how="inner")
+    j = j.join(_rename(sr, sr_item_sk="ss_item_sk",
+                       sr_customer_sk="ss_customer_sk",
+                       sr_ticket_number="ss_ticket_number"),
+               on=["ss_item_sk", "ss_customer_sk", "ss_ticket_number"],
+               how="inner")
+    j = j.join(d2, on="sr_returned_date_sk", how="inner")
+    j = j.join(_rename(cs, cs_item_sk="ss_item_sk",
+                       cs_bill_customer_sk="ss_customer_sk"),
+               on=["ss_item_sk", "ss_customer_sk"], how="inner")
+    j = j.join(d3, on="cs_sold_date_sk", how="inner")
+    j = _join_dim(j, st, "ss_store_sk", "s_store_sk")
+    j = _join_dim(j, it, "ss_item_sk", "i_item_sk")
+    return (j.group_by("i_item_id", "i_item_desc", "s_store_id",
+                       "s_store_name")
+            .agg(F.sum(col("ss_quantity")).alias("store_qty"),
+                 F.sum(col("sr_return_quantity")).alias("return_qty"),
+                 F.sum(col("cs_quantity")).alias("catalog_qty"))
+            .sort(col("i_item_id").asc(), col("i_item_desc").asc(),
+                  col("s_store_id").asc())
+            .limit(100).collect())
+
+
+def _q29_oracle(a):
+    import pandas as pd
+    dd = a["date_dim"].to_pandas()
+    d1 = set(dd[(dd.d_moy >= 1) & (dd.d_moy <= 6)
+                & (dd.d_year == 2000)].d_date_sk)
+    d2 = set(dd[dd.d_year == 2000].d_date_sk)
+    d3 = set(dd[dd.d_year.isin([2000, 2001, 2002])].d_date_sk)
+    ss = a["store_sales"].to_pandas()
+    ss = ss[ss.ss_sold_date_sk.isin(d1) & ss.ss_customer_sk.notna()]
+    sr = a["store_returns"].to_pandas()
+    sr = sr[sr.sr_returned_date_sk.isin(d2) & sr.sr_customer_sk.notna()]
+    cs = a["catalog_sales"].to_pandas()
+    cs = cs[cs.cs_sold_date_sk.isin(d3) & cs.cs_bill_customer_sk.notna()]
+    j = ss.merge(sr, left_on=["ss_item_sk", "ss_customer_sk",
+                              "ss_ticket_number"],
+                 right_on=["sr_item_sk", "sr_customer_sk",
+                           "sr_ticket_number"])
+    j = j.merge(cs, left_on=["ss_item_sk", "ss_customer_sk"],
+                right_on=["cs_item_sk", "cs_bill_customer_sk"])
+    j = j.merge(a["store"].to_pandas(), left_on="ss_store_sk",
+                right_on="s_store_sk")
+    j = j.merge(a["item"].to_pandas(), left_on="ss_item_sk",
+                right_on="i_item_sk")
+    g = j.groupby(["i_item_id", "i_item_desc", "s_store_id",
+                   "s_store_name"])[
+        ["ss_quantity", "sr_return_quantity", "cs_quantity"]] \
+        .sum().reset_index() \
+        .rename(columns={"ss_quantity": "store_qty",
+                         "sr_return_quantity": "return_qty",
+                         "cs_quantity": "catalog_qty"})
+    g = g.sort_values(["i_item_id", "i_item_desc", "s_store_id"]) \
+        .head(100)
+    return pa.Table.from_pandas(g.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q29", "store buy -> return -> catalog re-buy quantities")(
+    (_q29_run, _q29_oracle))
+
+
+# ===========================================================================
+# q57: monthly call-center sales vs centered moving average (q47 twin)
+# ===========================================================================
+
+def _q57_run(s, t):
+    cs = _rd(s, t, "catalog_sales").select(
+        "cs_sold_date_sk", "cs_item_sk", "cs_call_center_sk",
+        "cs_sales_price", "cs_quantity")
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_year") >= 1999) & (col("d_year") <= 2001)) \
+        .select("d_date_sk", "d_year", "d_moy")
+    it = _rd(s, t, "item").select("i_item_sk", "i_category", "i_brand")
+    cc = _rd(s, t, "call_center").select("cc_call_center_sk", "cc_name")
+    j = _join_dim(cs, dd, "cs_sold_date_sk", "d_date_sk")
+    j = _join_dim(j, it, "cs_item_sk", "i_item_sk")
+    j = _join_dim(j, cc, "cs_call_center_sk", "cc_call_center_sk")
+    amt = (col("cs_sales_price").cast(DataType.FLOAT64)
+           * col("cs_quantity").cast(DataType.FLOAT64))
+    g = (j.with_column("amt", amt)
+         .group_by("i_category", "i_brand", "cc_name", "d_year", "d_moy")
+         .agg(F.sum(col("amt")).alias("sum_sales")))
+    w = g.window([F.win_agg("avg", col("sum_sales"), frame=(-1, 1))
+                  .alias("avg3")],
+                 partition_by=[col("i_category"), col("i_brand"),
+                               col("cc_name")],
+                 order_by=[col("d_year"), col("d_moy")])
+    out = w.filter((col("d_year") == 2000)
+                   & (col("sum_sales") - col("avg3") != lit(0.0)))
+    return (out.select("i_category", "i_brand", "cc_name", "d_year",
+                       "d_moy", "sum_sales", "avg3")
+            .sort(col("i_category").asc(), col("i_brand").asc(),
+                  col("cc_name").asc(), col("d_year").asc(),
+                  col("d_moy").asc())
+            .limit(100).collect())
+
+
+def _q57_oracle(a):
+    import pandas as pd
+    dd = a["date_dim"].to_pandas()
+    dd = dd[(dd.d_year >= 1999) & (dd.d_year <= 2001)][
+        ["d_date_sk", "d_year", "d_moy"]]
+    it = a["item"].to_pandas()[["i_item_sk", "i_category", "i_brand"]]
+    cc = a["call_center"].to_pandas()[["cc_call_center_sk", "cc_name"]]
+    cs = a["catalog_sales"].to_pandas()
+    j = cs.merge(dd, left_on="cs_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(it, left_on="cs_item_sk", right_on="i_item_sk")
+    j = j.merge(cc, left_on="cs_call_center_sk",
+                right_on="cc_call_center_sk")
+    j["amt"] = j.cs_sales_price.astype(float) * j.cs_quantity
+    g = j.groupby(["i_category", "i_brand", "cc_name", "d_year",
+                   "d_moy"])["amt"].sum().reset_index(name="sum_sales")
+    g = g.sort_values(["i_category", "i_brand", "cc_name", "d_year",
+                       "d_moy"])
+    g["avg3"] = g.groupby(["i_category", "i_brand", "cc_name"])[
+        "sum_sales"].transform(
+        lambda x: x.rolling(3, center=True, min_periods=1).mean())
+    g = g[(g.d_year == 2000) & (g.sum_sales - g.avg3 != 0.0)]
+    g = g[["i_category", "i_brand", "cc_name", "d_year", "d_moy",
+           "sum_sales", "avg3"]]
+    g = g.sort_values(["i_category", "i_brand", "cc_name", "d_year",
+                       "d_moy"]).head(100)
+    return pa.Table.from_pandas(g.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q57", "monthly call-center sales vs centered moving average")(
+    (_q57_run, _q57_oracle))
+
+
+# ===========================================================================
+# q92: web discounts exceeding 1.3x the item-period average (q32 twin)
+# ===========================================================================
+
+def _q92_run(s, t):
+    d0 = DATE_SK0 + 2 * 365 + 26
+    ws = _rd(s, t, "web_sales").select(
+        "ws_sold_date_sk", "ws_item_sk", "ws_ext_discount_amt")
+    ws = ws.filter((col("ws_sold_date_sk") >= lit(d0, DataType.INT64))
+                   & (col("ws_sold_date_sk") <= lit(d0 + 90,
+                                                    DataType.INT64)))
+    it = _rd(s, t, "item").filter(col("i_manufact_id") <= 200) \
+        .select("i_item_sk")
+    j = _join_dim(ws, it, "ws_item_sk", "i_item_sk")
+    per_item = (j.group_by("ws_item_sk")
+                .agg(F.avg(col("ws_ext_discount_amt")
+                           .cast(DataType.FLOAT64)).alias("avg_disc")))
+    j2 = j.join(per_item, on="ws_item_sk", how="inner")
+    j2 = j2.filter(col("ws_ext_discount_amt").cast(DataType.FLOAT64)
+                   > lit(1.3) * col("avg_disc"))
+    return (j2.group_by()
+            .agg(F.sum(col("ws_ext_discount_amt"))
+                 .alias("excess_discount"))
+            .collect())
+
+
+def _q92_oracle(a):
+    import pandas as pd
+    d0 = DATE_SK0 + 2 * 365 + 26
+    it = a["item"].to_pandas()
+    ok_items = set(it[it.i_manufact_id <= 200].i_item_sk)
+    ws = a["web_sales"].to_pandas()
+    ws = ws[(ws.ws_sold_date_sk >= d0) & (ws.ws_sold_date_sk <= d0 + 90)
+            & ws.ws_item_sk.isin(ok_items)].copy()
+    ws["disc"] = ws.ws_ext_discount_amt.astype(float)
+    avg = ws.groupby("ws_item_sk")["disc"].transform("mean")
+    sel = ws[ws.disc > 1.3 * avg]
+    return pa.Table.from_pydict(
+        {"excess_discount": [sel.ws_ext_discount_amt.sum()]})
+
+
+_q("q92", "web discounts exceeding 1.3x item-period average")(
+    (_q92_run, _q92_oracle))
+
+
+# ===========================================================================
+# q17: cross-channel quantity statistics incl. stdev (sum-of-squares)
+# ===========================================================================
+
+def _q17_run(s, t):
+    ss = _rd(s, t, "store_sales").select(
+        "ss_sold_date_sk", "ss_item_sk", "ss_store_sk", "ss_customer_sk",
+        "ss_ticket_number", "ss_quantity")
+    sr = _rd(s, t, "store_returns").select(
+        "sr_returned_date_sk", "sr_item_sk", "sr_customer_sk",
+        "sr_ticket_number", "sr_return_quantity")
+    d1 = _rd(s, t, "date_dim").filter(
+        (col("d_qoy") == 1) & (col("d_year") == 2000)).select(
+        col("d_date_sk").alias("ss_sold_date_sk"))
+    d2 = _rd(s, t, "date_dim").filter(
+        col("d_year").isin(2000, 2001)).select(
+        col("d_date_sk").alias("sr_returned_date_sk"))
+    st = _rd(s, t, "store").select("s_store_sk", "s_state")
+    it = _rd(s, t, "item").select("i_item_sk", "i_item_id", "i_item_desc")
+    j = ss.join(d1, on="ss_sold_date_sk", how="inner")
+    j = j.join(_rename(sr, sr_item_sk="ss_item_sk",
+                       sr_customer_sk="ss_customer_sk",
+                       sr_ticket_number="ss_ticket_number"),
+               on=["ss_item_sk", "ss_customer_sk", "ss_ticket_number"],
+               how="inner")
+    j = j.join(d2, on="sr_returned_date_sk", how="inner")
+    j = _join_dim(j, st, "ss_store_sk", "s_store_sk")
+    j = _join_dim(j, it, "ss_item_sk", "i_item_sk")
+    q = col("ss_quantity").cast(DataType.FLOAT64)
+    j = j.with_column("q", q).with_column("q2", q * q)
+    g = (j.group_by("i_item_id", "i_item_desc", "s_state")
+         .agg(F.count(col("q")).alias("cnt"),
+              F.avg(col("q")).alias("mean_q"),
+              F.sum(col("q")).alias("sum_q"),
+              F.sum(col("q2")).alias("sumsq_q")))
+    # sample stdev via the sum-of-squares identity (the engine's agg set
+    # composes it; genuine q17 calls stdev directly)
+    n = col("cnt").cast(DataType.FLOAT64)
+    var = ((col("sumsq_q") - col("sum_q") * col("sum_q") / n)
+           / (n - lit(1.0)))
+    g = g.filter(col("cnt") > 1).with_column("stdev_q", F.sqrt(var))
+    return (g.select("i_item_id", "i_item_desc", "s_state", "cnt",
+                     "mean_q", "stdev_q")
+            .sort(col("i_item_id").asc(), col("s_state").asc())
+            .limit(100).collect())
+
+
+def _q17_oracle(a):
+    import numpy as _np
+    import pandas as pd
+    dd = a["date_dim"].to_pandas()
+    d1 = set(dd[(dd.d_qoy == 1) & (dd.d_year == 2000)].d_date_sk)
+    d2 = set(dd[dd.d_year.isin([2000, 2001])].d_date_sk)
+    ss = a["store_sales"].to_pandas()
+    ss = ss[ss.ss_sold_date_sk.isin(d1) & ss.ss_customer_sk.notna()]
+    sr = a["store_returns"].to_pandas()
+    sr = sr[sr.sr_returned_date_sk.isin(d2) & sr.sr_customer_sk.notna()]
+    j = ss.merge(sr, left_on=["ss_item_sk", "ss_customer_sk",
+                              "ss_ticket_number"],
+                 right_on=["sr_item_sk", "sr_customer_sk",
+                           "sr_ticket_number"])
+    j = j.merge(a["store"].to_pandas()[["s_store_sk", "s_state"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(a["item"].to_pandas()[
+        ["i_item_sk", "i_item_id", "i_item_desc"]],
+        left_on="ss_item_sk", right_on="i_item_sk")
+    j["q"] = j.ss_quantity.astype(float)
+    g = j.groupby(["i_item_id", "i_item_desc", "s_state"])["q"].agg(
+        ["count", "mean", "std"]).reset_index() \
+        .rename(columns={"count": "cnt", "mean": "mean_q",
+                         "std": "stdev_q"})
+    g = g[g.cnt > 1]
+    g = g.sort_values(["i_item_id", "s_state"]).head(100)
+    return pa.Table.from_pandas(g.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q17", "returned-item quantity statistics incl. stdev")(
+    (_q17_run, _q17_oracle))
+
+
+# ===========================================================================
+# q4: customers whose catalog growth beat store growth (3-channel totals)
+# ===========================================================================
+
+def _q4_run(s, t):
+    c = _rd(s, t, "customer").select("c_customer_sk", "c_customer_id")
+
+    def totals(fact, cust_k, date_k, price_k, years, alias):
+        f = _rd(s, t, fact).select(cust_k, date_k, price_k)
+        dd = _rd(s, t, "date_dim").filter(col("d_year").isin(*years)) \
+            .select("d_date_sk")
+        j = _join_dim(f, dd, date_k, "d_date_sk")
+        return (j.group_by(cust_k)
+                .agg(F.sum(col(price_k)).alias(alias))
+                .select(col(cust_k).alias("c_customer_sk"), col(alias)))
+
+    y1, y2 = (1998, 1999, 2000), (2001, 2002)
+    ss1 = totals("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                 "ss_net_paid", y1, "ss1")
+    ss2 = totals("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                 "ss_net_paid", y2, "ss2")
+    cs1 = totals("catalog_sales", "cs_bill_customer_sk",
+                 "cs_sold_date_sk", "cs_ext_sales_price", y1, "cs1")
+    cs2 = totals("catalog_sales", "cs_bill_customer_sk",
+                 "cs_sold_date_sk", "cs_ext_sales_price", y2, "cs2")
+    ws1 = totals("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+                 "ws_net_paid", y1, "ws1")
+    ws2 = totals("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+                 "ws_net_paid", y2, "ws2")
+    j = c
+    for tbl in (ss1, ss2, cs1, cs2, ws1, ws2):
+        j = j.join(tbl, on="c_customer_sk", how="inner")
+    f = lambda nm: col(nm).cast(DataType.FLOAT64)
+    j = j.filter((f("ss1") > lit(0.0)) & (f("cs1") > lit(0.0))
+                 & (f("ws1") > lit(0.0))
+                 & (f("cs2") / f("cs1") > f("ss2") / f("ss1"))
+                 & (f("cs2") / f("cs1") > f("ws2") / f("ws1")))
+    return (j.select("c_customer_id")
+            .sort(col("c_customer_id").asc()).limit(100).collect())
+
+
+def _q4_oracle(a):
+    import pandas as pd
+    dd = a["date_dim"].to_pandas()
+    y1 = set(dd[dd.d_year.isin([1998, 1999, 2000])].d_date_sk)
+    y2 = set(dd[dd.d_year.isin([2001, 2002])].d_date_sk)
+
+    def totals(name, cust_k, date_k, price_k, days):
+        f = a[name].to_pandas()
+        f = f[f[date_k].isin(days) & f[cust_k].notna()].copy()
+        f["v"] = f[price_k].astype(float)
+        return f.groupby(cust_k)["v"].sum()
+
+    ss1 = totals("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                 "ss_net_paid", y1)
+    ss2 = totals("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                 "ss_net_paid", y2)
+    cs1 = totals("catalog_sales", "cs_bill_customer_sk",
+                 "cs_sold_date_sk", "cs_ext_sales_price", y1)
+    cs2 = totals("catalog_sales", "cs_bill_customer_sk",
+                 "cs_sold_date_sk", "cs_ext_sales_price", y2)
+    ws1 = totals("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+                 "ws_net_paid", y1)
+    ws2 = totals("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+                 "ws_net_paid", y2)
+    df = pd.concat([ss1.rename("ss1"), ss2.rename("ss2"),
+                    cs1.rename("cs1"), cs2.rename("cs2"),
+                    ws1.rename("ws1"), ws2.rename("ws2")], axis=1) \
+        .dropna()
+    df = df[(df.ss1 > 0) & (df.cs1 > 0) & (df.ws1 > 0)
+            & (df.cs2 / df.cs1 > df.ss2 / df.ss1)
+            & (df.cs2 / df.cs1 > df.ws2 / df.ws1)]
+    c = a["customer"].to_pandas().set_index("c_customer_sk")
+    out = c.loc[c.index.intersection(df.index)][["c_customer_id"]] \
+        .sort_values("c_customer_id").head(100)
+    return pa.Table.from_pandas(out.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q4", "customers whose catalog growth beat store AND web growth")(
+    (_q4_run, _q4_oracle))
+
+
+# ===========================================================================
+# q5: per-store sales vs returned-amount summary for one fortnight
+# ===========================================================================
+
+def _q5_run(s, t):
+    d0 = DATE_SK0 + 2 * 365 + 220
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_date_sk") >= lit(d0, DataType.INT64))
+        & (col("d_date_sk") <= lit(d0 + 14, DataType.INT64))) \
+        .select("d_date_sk")
+    ss = _rd(s, t, "store_sales").select(
+        col("ss_sold_date_sk").alias("d_date_sk"),
+        col("ss_store_sk").alias("store_sk"),
+        col("ss_ext_sales_price").alias("sales_price"))
+    sr = _rd(s, t, "store_returns").select(
+        col("sr_returned_date_sk").alias("d_date_sk"),
+        col("sr_store_sk").alias("store_sk"),
+        col("sr_return_amt").alias("return_amt"))
+    sales = ss.join(dd, on="d_date_sk", how="semi") \
+        .group_by("store_sk") \
+        .agg(F.sum(col("sales_price")).alias("sales"))
+    rets = sr.join(dd, on="d_date_sk", how="semi") \
+        .group_by("store_sk") \
+        .agg(F.sum(col("return_amt")).alias("returns_"))
+    j = sales.join(rets, on="store_sk", how="left")
+    st = _rd(s, t, "store").select(col("s_store_sk").alias("store_sk"),
+                                   col("s_store_id"))
+    j = j.join(st, on="store_sk", how="inner")
+    out = j.select(
+        col("s_store_id"),
+        col("sales").cast(DataType.FLOAT64).alias("sales"),
+        F.coalesce(col("returns_").cast(DataType.FLOAT64), lit(0.0))
+        .alias("returns_"),
+        (col("sales").cast(DataType.FLOAT64)
+         - F.coalesce(col("returns_").cast(DataType.FLOAT64), lit(0.0)))
+        .alias("net"))
+    return out.sort(col("s_store_id").asc()).limit(100).collect()
+
+
+def _q5_oracle(a):
+    import pandas as pd
+    d0 = DATE_SK0 + 2 * 365 + 220
+    ss = a["store_sales"].to_pandas()
+    ss = ss[(ss.ss_sold_date_sk >= d0) & (ss.ss_sold_date_sk <= d0 + 14)]
+    sales = ss.groupby("ss_store_sk")["ss_ext_sales_price"].apply(
+        lambda x: x.astype(float).sum()).rename("sales")
+    sr = a["store_returns"].to_pandas()
+    sr = sr[(sr.sr_returned_date_sk >= d0)
+            & (sr.sr_returned_date_sk <= d0 + 14)]
+    rets = sr.groupby("sr_store_sk")["sr_return_amt"].apply(
+        lambda x: x.astype(float).sum()).rename("returns_")
+    df = pd.concat([sales, rets], axis=1)
+    df = df[df.sales.notna()]
+    df["returns_"] = df.returns_.fillna(0.0)
+    df["net"] = df.sales - df.returns_
+    st = a["store"].to_pandas()[["s_store_sk", "s_store_id"]]
+    out = df.reset_index().rename(columns={"index": "sk"})
+    key = out.columns[0]
+    out = out.merge(st, left_on=key, right_on="s_store_sk")
+    out = out[["s_store_id", "sales", "returns_", "net"]] \
+        .sort_values("s_store_id").head(100)
+    return pa.Table.from_pandas(out.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q5", "per-store sales vs returns summary for one fortnight")(
+    (_q5_run, _q5_oracle))
+
+
+# ===========================================================================
+# q39: warehouse/item inventory variance screen (stdev/mean > 1)
+# ===========================================================================
+
+def _q39_run(s, t):
+    inv = _rd(s, t, "inventory").select(
+        "inv_date_sk", "inv_item_sk", "inv_warehouse_sk",
+        "inv_quantity_on_hand")
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_year") == 2000) & (col("d_moy").isin(1, 2))) \
+        .select("d_date_sk", "d_moy")
+    j = _join_dim(inv, dd, "inv_date_sk", "d_date_sk")
+    q = col("inv_quantity_on_hand").cast(DataType.FLOAT64)
+    j = j.with_column("q", q).with_column("q2", q * q)
+    g = (j.group_by("inv_warehouse_sk", "inv_item_sk", "d_moy")
+         .agg(F.count(col("q")).alias("cnt"),
+              F.avg(col("q")).alias("mean_q"),
+              F.sum(col("q")).alias("sum_q"),
+              F.sum(col("q2")).alias("sumsq_q")))
+    n = col("cnt").cast(DataType.FLOAT64)
+    var = ((col("sumsq_q") - col("sum_q") * col("sum_q") / n)
+           / (n - lit(1.0)))
+    g = g.filter((col("cnt") > 1) & (col("mean_q") > lit(0.0)))
+    g = g.with_column("cov", F.sqrt(var) / col("mean_q"))
+    g = g.filter(col("cov") > lit(0.3))
+    return (g.select("inv_warehouse_sk", "inv_item_sk", "d_moy",
+                     "mean_q", "cov")
+            .sort(col("inv_warehouse_sk").asc(), col("inv_item_sk").asc(),
+                  col("d_moy").asc())
+            .limit(100).collect())
+
+
+def _q39_oracle(a):
+    import pandas as pd
+    dd = a["date_dim"].to_pandas()
+    dd = dd[(dd.d_year == 2000) & dd.d_moy.isin([1, 2])][
+        ["d_date_sk", "d_moy"]]
+    inv = a["inventory"].to_pandas()
+    j = inv.merge(dd, left_on="inv_date_sk", right_on="d_date_sk")
+    j["q"] = j.inv_quantity_on_hand.astype(float)
+    g = j.groupby(["inv_warehouse_sk", "inv_item_sk", "d_moy"])["q"] \
+        .agg(["count", "mean", "std"]).reset_index() \
+        .rename(columns={"count": "cnt", "mean": "mean_q"})
+    g = g[(g.cnt > 1) & (g.mean_q > 0)].copy()
+    g["cov"] = g["std"] / g.mean_q       # NB: g.cov is DataFrame.cov()
+    g = g[g["cov"] > 0.3]
+    g = g[["inv_warehouse_sk", "inv_item_sk", "d_moy", "mean_q", "cov"]]
+    g = g.sort_values(["inv_warehouse_sk", "inv_item_sk", "d_moy"]) \
+        .head(100)
+    return pa.Table.from_pandas(g.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q39", "warehouse/item inventory variance screen (cov > k)")(
+    (_q39_run, _q39_oracle))
+
+
+# ===========================================================================
+# q49: worst return ratios per channel with dual ranks
+# ===========================================================================
+
+def _q49_run(s, t):
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_year") == 2000) & (col("d_moy") == 12)) \
+        .select("d_date_sk")
+    ws = _rd(s, t, "web_sales").select(
+        "ws_sold_date_sk", "ws_item_sk", "ws_order_number",
+        "ws_quantity", "ws_net_paid")
+    wr = _rd(s, t, "web_returns").select(
+        col("wr_item_sk").alias("ws_item_sk"),
+        col("wr_order_number").alias("ws_order_number"),
+        col("wr_return_quantity"), col("wr_return_amt"))
+    j = _join_dim(ws, dd, "ws_sold_date_sk", "d_date_sk")
+    j = j.join(wr, on=["ws_item_sk", "ws_order_number"], how="left")
+    j = j.with_column(
+        "ret_q", F.coalesce(col("wr_return_quantity"),
+                            lit(0, DataType.INT64)))
+    j = j.with_column(
+        "ret_a", F.coalesce(col("wr_return_amt").cast(DataType.FLOAT64),
+                            lit(0.0)))
+    g = (j.group_by("ws_item_sk")
+         .agg(F.sum(col("ret_q")).alias("rq"),
+              F.sum(col("ws_quantity")).alias("sq"),
+              F.sum(col("ret_a")).alias("ra"),
+              F.sum(col("ws_net_paid")).alias("sa")))
+    g = g.filter(col("sq") > 0)
+    g = g.with_column("qty_ratio",
+                      col("rq").cast(DataType.FLOAT64)
+                      / col("sq").cast(DataType.FLOAT64))
+    w = g.window([F.rank().alias("rnk")],
+                 order_by=[col("qty_ratio").desc(),
+                           col("ws_item_sk").asc()])
+    out = w.filter(col("rnk") <= 10)
+    return (out.select("ws_item_sk", "qty_ratio", "rnk")
+            .sort(col("rnk").asc(), col("ws_item_sk").asc())
+            .collect())
+
+
+def _q49_oracle(a):
+    import pandas as pd
+    dd = a["date_dim"].to_pandas()
+    days = set(dd[(dd.d_year == 2000) & (dd.d_moy == 12)].d_date_sk)
+    ws = a["web_sales"].to_pandas()
+    ws = ws[ws.ws_sold_date_sk.isin(days)]
+    wr = a["web_returns"].to_pandas()[
+        ["wr_item_sk", "wr_order_number", "wr_return_quantity",
+         "wr_return_amt"]]
+    j = ws.merge(wr, left_on=["ws_item_sk", "ws_order_number"],
+                 right_on=["wr_item_sk", "wr_order_number"], how="left")
+    j["ret_q"] = j.wr_return_quantity.fillna(0)
+    g = j.groupby("ws_item_sk").agg(
+        rq=("ret_q", "sum"), sq=("ws_quantity", "sum")).reset_index()
+    g = g[g.sq > 0].copy()
+    g["qty_ratio"] = g.rq / g.sq
+    g = g.sort_values(["qty_ratio", "ws_item_sk"],
+                      ascending=[False, True]).reset_index(drop=True)
+    # engine rank() orders by (ratio desc, item asc): the unique item
+    # tiebreaker makes ranks strictly positional, so mirror that
+    g["rnk"] = g.index + 1
+    g = g[g.rnk <= 10]
+    out = g[["ws_item_sk", "qty_ratio", "rnk"]] \
+        .sort_values(["rnk", "ws_item_sk"])
+    return pa.Table.from_pandas(out.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q49", "worst web return quantity ratios with ranks")(
+    (_q49_run, _q49_oracle))
+
+
+# ===========================================================================
+# q58: items with near-equal revenue share across all three channels
+# ===========================================================================
+
+def _q58_run(s, t):
+    dd = _rd(s, t, "date_dim").filter(
+        (col("d_year") == 2000) & (col("d_moy") == 11)) \
+        .select("d_date_sk")
+    it = _rd(s, t, "item").select("i_item_sk", "i_item_id")
+
+    def chan(fact, date_k, item_k, price, alias):
+        f = _rd(s, t, fact).select(date_k, item_k, price)
+        j = _join_dim(f, dd, date_k, "d_date_sk")
+        j = _join_dim(j, it, item_k, "i_item_sk")
+        return (j.group_by("i_item_id")
+                .agg(F.sum(col(price)).alias(alias)))
+
+    ssr = chan("store_sales", "ss_sold_date_sk", "ss_item_sk",
+               "ss_ext_sales_price", "ss_rev")
+    csr = chan("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+               "cs_ext_sales_price", "cs_rev")
+    wsr = chan("web_sales", "ws_sold_date_sk", "ws_item_sk",
+               "ws_ext_sales_price", "ws_rev")
+    j = ssr.join(csr, on="i_item_id", how="inner")
+    j = j.join(wsr, on="i_item_id", how="inner")
+    f = lambda nm: col(nm).cast(DataType.FLOAT64)
+    avg_rev = (f("ss_rev") + f("cs_rev") + f("ws_rev")) / lit(3.0)
+    j = j.with_column("avg_rev", avg_rev)
+    band = lambda nm: ((f(nm) >= lit(0.5) * col("avg_rev"))
+                       & (f(nm) <= lit(1.5) * col("avg_rev")))
+    j = j.filter(band("ss_rev") & band("cs_rev") & band("ws_rev"))
+    return (j.select("i_item_id", "ss_rev", "cs_rev", "ws_rev",
+                     "avg_rev")
+            .sort(col("i_item_id").asc()).limit(100).collect())
+
+
+def _q58_oracle(a):
+    import pandas as pd
+    dd = a["date_dim"].to_pandas()
+    days = set(dd[(dd.d_year == 2000) & (dd.d_moy == 11)].d_date_sk)
+    it = a["item"].to_pandas()[["i_item_sk", "i_item_id"]]
+
+    def chan(name, date_k, item_k, price, alias):
+        f = a[name].to_pandas()
+        f = f[f[date_k].isin(days)]
+        j = f.merge(it, left_on=item_k, right_on="i_item_sk")
+        return j.groupby("i_item_id")[price].apply(
+            lambda x: x.sum()).rename(alias)
+
+    df = pd.concat([
+        chan("store_sales", "ss_sold_date_sk", "ss_item_sk",
+             "ss_ext_sales_price", "ss_rev"),
+        chan("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+             "cs_ext_sales_price", "cs_rev"),
+        chan("web_sales", "ws_sold_date_sk", "ws_item_sk",
+             "ws_ext_sales_price", "ws_rev")], axis=1).dropna()
+    f = df.astype(float)
+    f["avg_rev"] = (f.ss_rev + f.cs_rev + f.ws_rev) / 3.0
+    keep = ((f.ss_rev >= 0.5 * f.avg_rev) & (f.ss_rev <= 1.5 * f.avg_rev)
+            & (f.cs_rev >= 0.5 * f.avg_rev)
+            & (f.cs_rev <= 1.5 * f.avg_rev)
+            & (f.ws_rev >= 0.5 * f.avg_rev)
+            & (f.ws_rev <= 1.5 * f.avg_rev))
+    out = f[keep].reset_index().sort_values("i_item_id").head(100)
+    return pa.Table.from_pandas(out.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q58", "items with near-equal revenue across all three channels")(
+    (_q58_run, _q58_oracle))
+
+
+# ===========================================================================
+# q72: catalog orders promising inventory coverage in the ship week
+# ===========================================================================
+
+def _q72_run(s, t):
+    cs = _rd(s, t, "catalog_sales").select(
+        "cs_sold_date_sk", "cs_ship_date_sk", "cs_item_sk",
+        "cs_bill_cdemo_sk", "cs_quantity")
+    cd = _rd(s, t, "customer_demographics").filter(
+        col("cd_marital_status") == "D").select("cd_demo_sk")
+    d1 = _rd(s, t, "date_dim").filter(col("d_year") == 2000) \
+        .select("d_date_sk", col("d_week_seq").alias("sold_week"))
+    inv = _rd(s, t, "inventory").select(
+        col("inv_item_sk").alias("cs_item_sk"),
+        col("inv_date_sk"), col("inv_quantity_on_hand"))
+    dd_inv = _rd(s, t, "date_dim").select(
+        col("d_date_sk").alias("inv_date_sk"),
+        col("d_week_seq").alias("sold_week"))
+    inv = inv.join(dd_inv, on="inv_date_sk", how="inner")
+    j = _join_dim(cs, cd, "cs_bill_cdemo_sk", "cd_demo_sk")
+    j = j.join(_rename(d1, d_date_sk="cs_sold_date_sk"),
+               on="cs_sold_date_sk", how="inner")
+    # inventory row for the same item in the SOLD week with qoh below
+    # the ordered quantity (the q72 shortage probe)
+    j = j.join(inv, on=["cs_item_sk", "sold_week"], how="inner")
+    j = j.filter(col("inv_quantity_on_hand") < col("cs_quantity"))
+    it = _rd(s, t, "item").select("i_item_sk", "i_item_desc")
+    j = _join_dim(j, it, "cs_item_sk", "i_item_sk")
+    g = (j.group_by("i_item_desc", "sold_week")
+         .agg(F.count_star().alias("n_short")))
+    return (g.sort(col("n_short").desc(), col("i_item_desc").asc(),
+                   col("sold_week").asc())
+            .limit(100).collect())
+
+
+def _q72_oracle(a):
+    import pandas as pd
+    cd = a["customer_demographics"].to_pandas()
+    cds = set(cd[cd.cd_marital_status == "D"].cd_demo_sk)
+    dd = a["date_dim"].to_pandas()[["d_date_sk", "d_week_seq", "d_year"]]
+    cs = a["catalog_sales"].to_pandas()
+    cs = cs[cs.cs_bill_cdemo_sk.isin(cds)]
+    j = cs.merge(dd[dd.d_year == 2000], left_on="cs_sold_date_sk",
+                 right_on="d_date_sk")
+    j = j.rename(columns={"d_week_seq": "sold_week"})
+    inv = a["inventory"].to_pandas()
+    inv = inv.merge(dd[["d_date_sk", "d_week_seq"]],
+                    left_on="inv_date_sk", right_on="d_date_sk")
+    inv = inv.rename(columns={"d_week_seq": "sold_week"})
+    j = j.merge(inv, left_on=["cs_item_sk", "sold_week"],
+                right_on=["inv_item_sk", "sold_week"])
+    j = j[j.inv_quantity_on_hand < j.cs_quantity]
+    it = a["item"].to_pandas()[["i_item_sk", "i_item_desc"]]
+    j = j.merge(it, left_on="cs_item_sk", right_on="i_item_sk")
+    g = j.groupby(["i_item_desc", "sold_week"]).size() \
+        .reset_index(name="n_short")
+    g = g.sort_values(["n_short", "i_item_desc", "sold_week"],
+                      ascending=[False, True, True]).head(100)
+    return pa.Table.from_pandas(g.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q72", "catalog orders exceeding same-week inventory on hand")(
+    (_q72_run, _q72_oracle))
+
+
+# ===========================================================================
+# q75: catalog yearly item-attribute sales vs prior year (net of returns)
+# ===========================================================================
+
+def _q75_run(s, t):
+    it = _rd(s, t, "item").filter(col("i_category") == "Home") \
+        .select("i_item_sk", "i_brand_id", "i_class_id", "i_category_id")
+    cs = _rd(s, t, "catalog_sales").select(
+        "cs_sold_date_sk", "cs_item_sk", "cs_order_number",
+        "cs_quantity", "cs_ext_sales_price")
+    cr = _rd(s, t, "catalog_returns").select(
+        col("cr_item_sk").alias("cs_item_sk"),
+        col("cr_order_number").alias("cs_order_number"),
+        col("cr_return_quantity"), col("cr_return_amount"))
+    j = cs.join(cr, on=["cs_item_sk", "cs_order_number"], how="left")
+    dd = _rd(s, t, "date_dim").select("d_date_sk", "d_year")
+    j = j.join(_rename(dd, d_date_sk="cs_sold_date_sk"),
+               on="cs_sold_date_sk", how="inner")
+    j = _join_dim(j, it, "cs_item_sk", "i_item_sk")
+    qty = (col("cs_quantity")
+           - F.coalesce(col("cr_return_quantity"), lit(0, DataType.INT64)))
+    amt = (col("cs_ext_sales_price").cast(DataType.FLOAT64)
+           - F.coalesce(col("cr_return_amount").cast(DataType.FLOAT64),
+                        lit(0.0)))
+    j = j.with_column("net_qty", qty).with_column("net_amt", amt)
+    g = (j.group_by("d_year", "i_brand_id", "i_class_id", "i_category_id")
+         .agg(F.sum(col("net_qty")).alias("qty"),
+              F.sum(col("net_amt")).alias("amt")))
+    y1 = g.filter(col("d_year") == 2000).select(
+        col("i_brand_id"), col("i_class_id"), col("i_category_id"),
+        col("qty").alias("qty1"), col("amt").alias("amt1"))
+    y2 = g.filter(col("d_year") == 2001).select(
+        col("i_brand_id"), col("i_class_id"), col("i_category_id"),
+        col("qty").alias("qty2"), col("amt").alias("amt2"))
+    j2 = y1.join(y2, on=["i_brand_id", "i_class_id", "i_category_id"],
+                 how="inner")
+    j2 = j2.filter(col("qty2").cast(DataType.FLOAT64)
+                   < lit(0.9) * col("qty1").cast(DataType.FLOAT64))
+    return (j2.select("i_brand_id", "i_class_id", "i_category_id",
+                      "qty1", "qty2", "amt1", "amt2")
+            .sort(col("i_brand_id").asc(), col("i_class_id").asc())
+            .limit(100).collect())
+
+
+def _q75_oracle(a):
+    import pandas as pd
+    it = a["item"].to_pandas()
+    it = it[it.i_category == "Home"][
+        ["i_item_sk", "i_brand_id", "i_class_id", "i_category_id"]]
+    cs = a["catalog_sales"].to_pandas()
+    cr = a["catalog_returns"].to_pandas()[
+        ["cr_item_sk", "cr_order_number", "cr_return_quantity",
+         "cr_return_amount"]]
+    j = cs.merge(cr, left_on=["cs_item_sk", "cs_order_number"],
+                 right_on=["cr_item_sk", "cr_order_number"], how="left")
+    dd = a["date_dim"].to_pandas()[["d_date_sk", "d_year"]]
+    j = j.merge(dd, left_on="cs_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(it, left_on="cs_item_sk", right_on="i_item_sk")
+    j["net_qty"] = j.cs_quantity - j.cr_return_quantity.fillna(0)
+    j["net_amt"] = (j.cs_ext_sales_price.astype(float)
+                    - j.cr_return_amount.astype(float).fillna(0.0))
+    g = j.groupby(["d_year", "i_brand_id", "i_class_id",
+                   "i_category_id"]).agg(
+        qty=("net_qty", "sum"), amt=("net_amt", "sum")).reset_index()
+    y1 = g[g.d_year == 2000].drop(columns="d_year") \
+        .rename(columns={"qty": "qty1", "amt": "amt1"})
+    y2 = g[g.d_year == 2001].drop(columns="d_year") \
+        .rename(columns={"qty": "qty2", "amt": "amt2"})
+    j2 = y1.merge(y2, on=["i_brand_id", "i_class_id", "i_category_id"])
+    j2 = j2[j2.qty2 < 0.9 * j2.qty1]
+    out = j2[["i_brand_id", "i_class_id", "i_category_id", "qty1",
+              "qty2", "amt1", "amt2"]] \
+        .sort_values(["i_brand_id", "i_class_id"]).head(100)
+    out[["qty1", "qty2"]] = out[["qty1", "qty2"]].astype("int64")
+    return pa.Table.from_pandas(out.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q75", "catalog item-attribute sales net of returns, YoY decline")(
+    (_q75_run, _q75_oracle))
+
+
+# ===========================================================================
+# q78: customer/item store-vs-web loyalty ratios, no returned store lines
+# ===========================================================================
+
+def _q78_run(s, t):
+    dd = _rd(s, t, "date_dim").filter(col("d_year") == 2000) \
+        .select("d_date_sk")
+    ss = _rd(s, t, "store_sales").select(
+        "ss_sold_date_sk", "ss_item_sk", "ss_customer_sk",
+        "ss_ticket_number", "ss_quantity")
+    sr = _rd(s, t, "store_returns").select(
+        col("sr_item_sk").alias("ss_item_sk"),
+        col("sr_ticket_number").alias("ss_ticket_number"))
+    ss = ss.join(sr, on=["ss_item_sk", "ss_ticket_number"], how="anti")
+    ss = ss.join(_rename(dd, d_date_sk="ss_sold_date_sk"),
+                 on="ss_sold_date_sk", how="semi")
+    ssg = (ss.filter(col("ss_customer_sk").is_not_null())
+           .group_by("ss_customer_sk", "ss_item_sk")
+           .agg(F.sum(col("ss_quantity")).alias("ss_qty"))
+           .select(col("ss_customer_sk").alias("cust"),
+                   col("ss_item_sk").alias("item"), col("ss_qty")))
+    ws = _rd(s, t, "web_sales").select(
+        "ws_sold_date_sk", "ws_item_sk", "ws_bill_customer_sk",
+        "ws_order_number", "ws_quantity")
+    wr = _rd(s, t, "web_returns").select(
+        col("wr_item_sk").alias("ws_item_sk"),
+        col("wr_order_number").alias("ws_order_number"))
+    ws = ws.join(wr, on=["ws_item_sk", "ws_order_number"], how="anti")
+    ws = ws.join(_rename(dd, d_date_sk="ws_sold_date_sk"),
+                 on="ws_sold_date_sk", how="semi")
+    wsg = (ws.filter(col("ws_bill_customer_sk").is_not_null())
+           .group_by("ws_bill_customer_sk", "ws_item_sk")
+           .agg(F.sum(col("ws_quantity")).alias("ws_qty"))
+           .select(col("ws_bill_customer_sk").alias("cust"),
+                   col("ws_item_sk").alias("item"), col("ws_qty")))
+    j = ssg.join(wsg, on=["cust", "item"], how="inner")
+    ratio = (col("ss_qty").cast(DataType.FLOAT64)
+             / col("ws_qty").cast(DataType.FLOAT64))
+    j = j.with_column("ratio", ratio)
+    return (j.select("cust", "item", "ss_qty", "ws_qty", "ratio")
+            .sort(col("ratio").desc(), col("cust").asc(),
+                  col("item").asc())
+            .limit(100).collect())
+
+
+def _q78_oracle(a):
+    import pandas as pd
+    dd = a["date_dim"].to_pandas()
+    days = set(dd[dd.d_year == 2000].d_date_sk)
+    ss = a["store_sales"].to_pandas()
+    sr = a["store_returns"].to_pandas()
+    sr_keys = set(zip(sr.sr_item_sk, sr.sr_ticket_number))
+    ss = ss[~pd.Series(list(zip(ss.ss_item_sk, ss.ss_ticket_number)),
+                       index=ss.index).isin(sr_keys)]
+    ss = ss[ss.ss_sold_date_sk.isin(days) & ss.ss_customer_sk.notna()]
+    ssg = ss.groupby(["ss_customer_sk", "ss_item_sk"])["ss_quantity"] \
+        .sum().reset_index(name="ss_qty") \
+        .rename(columns={"ss_customer_sk": "cust", "ss_item_sk": "item"})
+    ws = a["web_sales"].to_pandas()
+    wr = a["web_returns"].to_pandas()
+    wr_keys = set(zip(wr.wr_item_sk, wr.wr_order_number))
+    ws = ws[~pd.Series(list(zip(ws.ws_item_sk, ws.ws_order_number)),
+                       index=ws.index).isin(wr_keys)]
+    ws = ws[ws.ws_sold_date_sk.isin(days)
+            & ws.ws_bill_customer_sk.notna()]
+    wsg = ws.groupby(["ws_bill_customer_sk", "ws_item_sk"])[
+        "ws_quantity"].sum().reset_index(name="ws_qty") \
+        .rename(columns={"ws_bill_customer_sk": "cust",
+                         "ws_item_sk": "item"})
+    j = ssg.merge(wsg, on=["cust", "item"])
+    j["ratio"] = j.ss_qty / j.ws_qty
+    j["cust"] = j.cust.astype("int64")
+    out = j.sort_values(["ratio", "cust", "item"],
+                        ascending=[False, True, True]).head(100)
+    return pa.Table.from_pandas(out.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q78", "customer/item store-vs-web ratios on unreturned lines")(
+    (_q78_run, _q78_oracle))

@@ -1170,15 +1170,10 @@ class AggOp(PhysicalOp):
             state_fields = []
             for spec, an in zip(self.specs, self.agg_names):
                 for fi, (fname, fdt, kind) in enumerate(spec.state_fields):
-                    if kind in ("collect_list", "collect_set"):
+                    if kind in ("collect_list", "collect_set") \
+                            or kind in _DCOLLECT:
                         # element (p, s) riding the LIST slots covers
                         # decimal elements (0/0 for everything else)
-                        state_fields.append(Field(
-                            f"{an}#{fname}", DataType.LIST, True,
-                            spec.result[1], spec.result[2],
-                            elem=spec.elem))
-                        continue
-                    if kind in _DCOLLECT:
                         state_fields.append(Field(
                             f"{an}#{fname}", DataType.LIST, True,
                             spec.result[1], spec.result[2],

@@ -148,13 +148,64 @@ class ExecutionRuntime:
         return snap
 
 
+def run_task_with_retries(plan: PhysicalOp, partition: int,
+                          num_partitions: int, mem_manager=None,
+                          config=None) -> pa.Table:
+    """Run one (plan, partition) task, retrying transient failures at
+    partition granularity — the retry driver the reference delegates to
+    Spark's task scheduler (SURVEY §5.3; rt.rs's is_task_running checks
+    distinguish kill from failure the same way). The engine is
+    functional, so an attempt is an exact recompute: sinks are
+    retry-idempotent and RSS attempts invalidate, making re-execution
+    safe end to end. Each attempt gets a fresh ExecutionRuntime and a
+    distinct task_id (attempt number in the low bits, like Spark TIDs).
+    Cancellation is surfaced immediately, never retried."""
+    import time as _time
+
+    from auron_tpu import config as cfg
+    from auron_tpu.ops.base import TaskCancelled
+
+    conf = config if config is not None else cfg.get_config()
+    retries = max(0, int(conf.get(cfg.TASK_MAX_RETRIES)))
+    backoff = float(conf.get(cfg.TASK_RETRY_BACKOFF_S))
+    last_err = None
+    for attempt in range(retries + 1):
+        rt = ExecutionRuntime(
+            plan,
+            TaskDefinition(partition_id=partition,
+                           num_partitions=num_partitions,
+                           task_id=partition * 1000 + attempt),
+            mem_manager=mem_manager, config=config)
+        try:
+            return rt.collect()
+        except TaskCancelled:
+            raise
+        except (NotImplementedError, TypeError, AssertionError,
+                KeyError, IndexError, AttributeError):
+            # deterministic plan/schema/engine defects: recomputing the
+            # partition cannot succeed — surface immediately instead of
+            # paying retries+1 full computes and misleading "retrying"
+            # logs (transient classes — IO, runtime, resource — retry)
+            raise
+        except Exception as e:         # noqa: BLE001 — retry boundary
+            last_err = e
+            if attempt >= retries:
+                break
+            logger.warning(
+                "task attempt %d/%d failed for partition %d (%s); "
+                "retrying", attempt + 1, retries + 1, partition, e)
+            if backoff > 0:
+                _time.sleep(backoff * (attempt + 1))
+    raise last_err
+
+
 def collect(plan: PhysicalOp, num_partitions: int = 1,
             mem_manager=None, config=None) -> pa.Table:
-    """Run every partition of a plan and concatenate (driver-side collect)."""
+    """Run every partition of a plan and concatenate (driver-side
+    collect), with per-partition transient-failure retries."""
     tables = []
     for p in range(num_partitions):
-        rt = ExecutionRuntime(
-            plan, TaskDefinition(partition_id=p, num_partitions=num_partitions),
-            mem_manager=mem_manager, config=config)
-        tables.append(rt.collect())
+        tables.append(run_task_with_retries(
+            plan, p, num_partitions, mem_manager=mem_manager,
+            config=config))
     return pa.concat_tables(tables)
