@@ -147,7 +147,7 @@ def _run_suite(queries, tables, arrow, comparator, names=None,
 
 def run_tpcds(data_dir=None, scale: float = 1.0, names=None,
               verbose: bool = True) -> list[ComparisonResult]:
-    """The real-schema TPC-DS gate: 94 genuine TPC-DS query shapes over a
+    """The real-schema TPC-DS gate: 99 genuine TPC-DS query shapes over a
     scale-1.0 = 1M-fact-row dataset, diffed against the pyarrow/Acero
     oracle (reference gate: .github/workflows/tpcds-reusable.yml:70-83)."""
     from auron_tpu.it.tpcds import generate, load_arrow

@@ -163,6 +163,15 @@ def generate(root: str, scale: float = 1.0, seed: int = 7) -> dict:
                                 rng.integers(1, 100, n_item)]),
         "i_manager_id": pa.array(rng.integers(1, 100, n_item), pa.int64()),
         "i_current_price": _money(rng, n_item, 0.09, 99.0),
+        "i_color": pa.array(np.array(
+            ["red", "blue", "green", "black", "white", "plum",
+             "orchid", "slate"])[rng.integers(0, 8, n_item)]),
+        "i_size": pa.array(np.array(
+            ["small", "medium", "large", "extra large",
+             "economy"])[rng.integers(0, 5, n_item)]),
+        "i_units": pa.array(np.array(
+            ["Each", "Dozen", "Case", "Pound"])[rng.integers(
+                0, 4, n_item)]),
     })
     out["item"] = _write(root, "item", item)
 
@@ -183,6 +192,9 @@ def generate(root: str, scale: float = 1.0, seed: int = 7) -> dict:
         "c_birth_month": pa.array(rng.integers(1, 13, n_cust), pa.int64()),
         "c_birth_year": pa.array(rng.integers(1924, 1993, n_cust),
                                  pa.int64()),
+        "c_birth_country": pa.array(np.array(
+            ["UNITED STATES", "CANADA", "MEXICO", "BRAZIL", "JAPAN",
+             "GERMANY"])[rng.integers(0, 6, n_cust)]),
     })
     out["customer"] = _write(root, "customer", customer, 2)
 
@@ -256,6 +268,7 @@ def generate(root: str, scale: float = 1.0, seed: int = 7) -> dict:
                            rng.integers(10000, 99999, n_store)]),
         "s_gmt_offset": pa.array(rng.choice([-5.0, -6.0], n_store),
                                  pa.float64()),
+        "s_market_id": pa.array(rng.integers(1, 11, n_store), pa.int64()),
     })
     out["store"] = _write(root, "store", store)
 

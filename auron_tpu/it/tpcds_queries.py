@@ -1,6 +1,6 @@
 """Real TPC-DS queries over the real-schema dataset (tpcds.py).
 
-94 genuine TPC-DS query shapes — star joins, multi-dimension filters,
+99 genuine TPC-DS query shapes — star joins, multi-dimension filters,
 two-phase aggregation, CASE buckets, scalar subqueries, EXISTS/IN as
 semi/anti joins, ROLLUP/grouping-sets with grouping_id arithmetic,
 three-channel UNIONs, and window ratios — expressed in the frontend
@@ -5797,3 +5797,503 @@ def _q78_oracle(a):
 
 _q("q78", "customer/item store-vs-web ratios on unreturned lines")(
     (_q78_run, _q78_oracle))
+
+
+# ===========================================================================
+# q23: monthly channel sales from best customers on frequently-sold items
+# ===========================================================================
+
+def _q23_run(s, t):
+    from auron_tpu.frontend.dataframe import scalar_subquery
+    dd_years = _rd(s, t, "date_dim").filter(
+        col("d_year").isin(1999, 2000, 2001)) \
+        .select("d_date_sk", "d_date")
+    ss = _rd(s, t, "store_sales").select(
+        "ss_sold_date_sk", "ss_item_sk", "ss_customer_sk",
+        "ss_quantity", "ss_sales_price")
+    # frequent items: sold on many lines of one (item, date) pair
+    withdates = ss.join(_rename(dd_years, d_date_sk="ss_sold_date_sk"),
+                        on="ss_sold_date_sk", how="inner")
+    freq = (withdates.group_by("ss_item_sk", "d_date")
+            .agg(F.count_star().alias("cnt"))
+            .filter(col("cnt") > 4)
+            .group_by("ss_item_sk").agg()
+            .select(col("ss_item_sk")))
+    # best customers: total quantity*price above 95% of the maximum
+    spend = (ss.filter(col("ss_customer_sk").is_not_null())
+             .group_by("ss_customer_sk")
+             .agg(F.sum(col("ss_quantity").cast(DataType.FLOAT64)
+                        * col("ss_sales_price").cast(DataType.FLOAT64))
+                  .alias("ssales")))
+    max_spend = spend.group_by().agg(F.max(col("ssales")).alias("m"))
+    best = spend.filter(
+        col("ssales") > lit(0.95) * scalar_subquery(max_spend)) \
+        .select(col("ss_customer_sk"))
+    # chosen month's catalog + web sales from best customers on
+    # frequent items
+    dd_m = _rd(s, t, "date_dim").filter(
+        (col("d_year") == 2000) & (col("d_moy") == 3)) \
+        .select("d_date_sk")
+
+    def chan(fact, date_k, cust_k, item_k, qty_k, price_k):
+        f = _rd(s, t, fact).select(date_k, cust_k, item_k, qty_k,
+                                   price_k)
+        j = f.join(_rename(dd_m, d_date_sk=date_k), on=date_k,
+                   how="semi")
+        j = j.join(_rename(freq, ss_item_sk=item_k), on=item_k,
+                   how="semi")
+        j = j.join(_rename(best, ss_customer_sk=cust_k), on=cust_k,
+                   how="semi")
+        amt = (col(qty_k).cast(DataType.FLOAT64)
+               * col(price_k).cast(DataType.FLOAT64))
+        return j.with_column("amt", amt).group_by() \
+            .agg(F.sum(col("amt")).alias("t"))
+
+    cs_t = chan("catalog_sales", "cs_sold_date_sk",
+                "cs_bill_customer_sk", "cs_item_sk", "cs_quantity",
+                "cs_sales_price")
+    ws_t = chan("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk",
+                "ws_item_sk", "ws_quantity", "ws_sales_price")
+    out = cs_t.select(
+        (F.coalesce(col("t"), lit(0.0))
+         + F.coalesce(scalar_subquery(ws_t), lit(0.0))).alias("total"))
+    return out.collect()
+
+
+def _q23_oracle(a):
+    import pandas as pd
+    dd = a["date_dim"].to_pandas()
+    ydays = dd[dd.d_year.isin([1999, 2000, 2001])][
+        ["d_date_sk", "d_date"]]
+    ss = a["store_sales"].to_pandas()
+    w = ss.merge(ydays, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    cnt = w.groupby(["ss_item_sk", "d_date"]).size()
+    freq = set(cnt[cnt > 4].reset_index().ss_item_sk)
+    ssn = ss[ss.ss_customer_sk.notna()].copy()
+    ssn["amt"] = ssn.ss_quantity * ssn.ss_sales_price.astype(float)
+    spend = ssn.groupby("ss_customer_sk")["amt"].sum()
+    best = set(spend[spend > 0.95 * spend.max()].index)
+    mdays = set(dd[(dd.d_year == 2000) & (dd.d_moy == 3)].d_date_sk)
+
+    def chan(name, date_k, cust_k, item_k, qty_k, price_k):
+        f = a[name].to_pandas()
+        f = f[f[date_k].isin(mdays) & f[item_k].isin(freq)
+              & f[cust_k].isin(best)]
+        return float((f[qty_k] * f[price_k].astype(float)).sum())
+
+    total = (chan("catalog_sales", "cs_sold_date_sk",
+                  "cs_bill_customer_sk", "cs_item_sk", "cs_quantity",
+                  "cs_sales_price")
+             + chan("web_sales", "ws_sold_date_sk",
+                    "ws_bill_customer_sk", "ws_item_sk", "ws_quantity",
+                    "ws_sales_price"))
+    return pa.Table.from_pydict({"total": [total]})
+
+
+_q("q23", "monthly channel sales: best customers x frequent items")(
+    (_q23_run, _q23_oracle))
+
+
+# ===========================================================================
+# q14: cross-channel items sold above the all-channel average (INTERSECT
+#      of brand/class/category triples + scalar average threshold)
+# ===========================================================================
+
+def _q14_run(s, t):
+    from auron_tpu.frontend.dataframe import scalar_subquery
+    it = _rd(s, t, "item").select("i_item_sk", "i_brand_id",
+                                  "i_class_id", "i_category_id")
+    dd = _rd(s, t, "date_dim").filter(
+        col("d_year").isin(1999, 2000, 2001)).select("d_date_sk")
+
+    def chan_triples(fact, date_k, item_k):
+        f = _rd(s, t, fact).select(date_k, item_k)
+        j = f.join(_rename(dd, d_date_sk=date_k), on=date_k, how="semi")
+        j = j.join(_rename(it, i_item_sk=item_k), on=item_k, how="inner")
+        return (j.group_by("i_brand_id", "i_class_id", "i_category_id")
+                .agg())
+
+    sst = chan_triples("store_sales", "ss_sold_date_sk", "ss_item_sk")
+    cst = chan_triples("catalog_sales", "cs_sold_date_sk", "cs_item_sk")
+    wst = chan_triples("web_sales", "ws_sold_date_sk", "ws_item_sk")
+    keys = ["i_brand_id", "i_class_id", "i_category_id"]
+    cross = sst.join(cst, on=keys, how="semi").join(wst, on=keys,
+                                                    how="semi")
+    cross_items = it.join(cross, on=keys, how="semi") \
+        .select("i_item_sk")
+
+    # average (quantity * price) across ALL three channels; the web leg
+    # uses ws_sales_price (the generator carries no ws_list_price) — the
+    # oracle applies the same substitution
+    def chan_amt(fact, date_k, qty_k, price_k):
+        f = _rd(s, t, fact).select(date_k, qty_k, price_k)
+        j = f.join(_rename(dd, d_date_sk=date_k), on=date_k, how="semi")
+        return j.select((col(qty_k).cast(DataType.FLOAT64)
+                         * col(price_k).cast(DataType.FLOAT64))
+                        .alias("amt"))
+
+    allamt = chan_amt("store_sales", "ss_sold_date_sk", "ss_quantity",
+                      "ss_list_price") \
+        .union(chan_amt("catalog_sales", "cs_sold_date_sk",
+                        "cs_quantity", "cs_list_price")) \
+        .union(chan_amt("web_sales", "ws_sold_date_sk", "ws_quantity",
+                        "ws_sales_price"))
+    avg_sales = allamt.group_by().agg(F.avg(col("amt")).alias("a"))
+
+    # one month's store sales of cross items, grouped by item attrs,
+    # HAVING sum > the all-channel average
+    dd_m = _rd(s, t, "date_dim").filter(
+        (col("d_year") == 2000) & (col("d_moy") == 11)) \
+        .select("d_date_sk")
+    ss = _rd(s, t, "store_sales").select(
+        "ss_sold_date_sk", "ss_item_sk", "ss_quantity", "ss_list_price")
+    j = ss.join(_rename(dd_m, d_date_sk="ss_sold_date_sk"),
+                on="ss_sold_date_sk", how="semi")
+    j = j.join(_rename(cross_items, i_item_sk="ss_item_sk"),
+               on="ss_item_sk", how="semi")
+    j = j.join(_rename(it, i_item_sk="ss_item_sk"), on="ss_item_sk",
+               how="inner")
+    amt = (col("ss_quantity").cast(DataType.FLOAT64)
+           * col("ss_list_price").cast(DataType.FLOAT64))
+    g = (j.with_column("amt", amt)
+         .group_by("i_brand_id", "i_class_id", "i_category_id")
+         .agg(F.sum(col("amt")).alias("sales"),
+              F.count_star().alias("n")))
+    g = g.filter(col("sales") > scalar_subquery(avg_sales))
+    return (g.select("i_brand_id", "i_class_id", "i_category_id",
+                     "sales", "n")
+            .sort(col("i_brand_id").asc(), col("i_class_id").asc(),
+                  col("i_category_id").asc())
+            .limit(100).collect())
+
+
+def _q14_oracle(a):
+    import pandas as pd
+    it = a["item"].to_pandas()[
+        ["i_item_sk", "i_brand_id", "i_class_id", "i_category_id"]]
+    dd = a["date_dim"].to_pandas()
+    ydays = set(dd[dd.d_year.isin([1999, 2000, 2001])].d_date_sk)
+
+    def triples(name, date_k, item_k):
+        f = a[name].to_pandas()
+        f = f[f[date_k].isin(ydays)]
+        j = f.merge(it, left_on=item_k, right_on="i_item_sk")
+        return set(map(tuple, j[["i_brand_id", "i_class_id",
+                                 "i_category_id"]].drop_duplicates()
+                       .itertuples(index=False)))
+
+    cross = (triples("store_sales", "ss_sold_date_sk", "ss_item_sk")
+             & triples("catalog_sales", "cs_sold_date_sk", "cs_item_sk")
+             & triples("web_sales", "ws_sold_date_sk", "ws_item_sk"))
+    it_t = it.copy()
+    it_t["trip"] = list(map(tuple, it_t[["i_brand_id", "i_class_id",
+                                         "i_category_id"]]
+                            .itertuples(index=False)))
+    cross_items = set(it_t[it_t.trip.isin(cross)].i_item_sk)
+
+    def amounts(name, date_k, qty_k, price_k):
+        f = a[name].to_pandas()
+        f = f[f[date_k].isin(ydays)]
+        return f[qty_k] * f[price_k].astype(float)
+
+    import numpy as _np
+    allamt = _np.concatenate([
+        amounts("store_sales", "ss_sold_date_sk", "ss_quantity",
+                "ss_list_price").values,
+        amounts("catalog_sales", "cs_sold_date_sk", "cs_quantity",
+                "cs_list_price").values,
+        amounts("web_sales", "ws_sold_date_sk", "ws_quantity",
+                "ws_sales_price").values])
+    avg_sales = float(allamt.mean())
+
+    mdays = set(dd[(dd.d_year == 2000) & (dd.d_moy == 11)].d_date_sk)
+    ss = a["store_sales"].to_pandas()
+    j = ss[ss.ss_sold_date_sk.isin(mdays)
+           & ss.ss_item_sk.isin(cross_items)]
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j.copy()
+    j["amt"] = j.ss_quantity * j.ss_list_price.astype(float)
+    g = j.groupby(["i_brand_id", "i_class_id", "i_category_id"]).agg(
+        sales=("amt", "sum"), n=("amt", "size")).reset_index()
+    g = g[g.sales > avg_sales]
+    g = g.sort_values(["i_brand_id", "i_class_id", "i_category_id"]) \
+        .head(100)
+    g["n"] = g.n.astype("int64")
+    return pa.Table.from_pandas(g.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q14", "cross-channel items selling above the all-channel average")(
+    (_q14_run, _q14_oracle))
+
+
+# ===========================================================================
+# q24: one color's returned-line store sales by customer, above 5% of
+#      the per-market average (the market-basket chain)
+# ===========================================================================
+
+def _q24_run(s, t):
+    from auron_tpu.frontend.dataframe import scalar_subquery
+    ss = _rd(s, t, "store_sales").select(
+        "ss_item_sk", "ss_ticket_number", "ss_customer_sk",
+        "ss_store_sk", "ss_net_paid")
+    sr = _rd(s, t, "store_returns").select(
+        col("sr_item_sk").alias("ss_item_sk"),
+        col("sr_ticket_number").alias("ss_ticket_number"))
+    # only sold lines that were later returned (the q24 ss ⋈ sr core)
+    ss = ss.join(sr, on=["ss_item_sk", "ss_ticket_number"], how="semi")
+    st = _rd(s, t, "store").filter(col("s_market_id") <= 5) \
+        .select("s_store_sk", "s_store_name", "s_state", "s_zip")
+    c = _rd(s, t, "customer").select(
+        col("c_customer_sk").alias("ss_customer_sk"),
+        col("c_first_name"), col("c_last_name"),
+        col("c_current_addr_sk"))
+    ca = _rd(s, t, "customer_address").select(
+        col("ca_address_sk").alias("c_current_addr_sk"), col("ca_zip"))
+    it = _rd(s, t, "item").select("i_item_sk", "i_color")
+    j = _join_dim(ss, st, "ss_store_sk", "s_store_sk")
+    j = j.join(c, on="ss_customer_sk", how="inner")
+    j = j.join(ca, on="c_current_addr_sk", how="inner")
+    # q24's cross-state correlation: bought where the customer does NOT
+    # live (zip mismatch keeps the out-of-area shape)
+    j = j.filter(col("ca_zip") != col("s_zip"))
+    j = j.join(_rename(it, i_item_sk="ss_item_sk"), on="ss_item_sk",
+               how="inner")
+    per = (j.group_by("c_last_name", "c_first_name", "s_store_name",
+                      "i_color")
+           .agg(F.sum(col("ss_net_paid")).alias("netpaid")))
+    avg_all = per.group_by().agg(
+        F.avg(col("netpaid").cast(DataType.FLOAT64)).alias("a"))
+    sel = per.filter(col("i_color") == "plum")
+    sel = sel.filter(col("netpaid").cast(DataType.FLOAT64)
+                     > lit(0.05) * scalar_subquery(avg_all))
+    return (sel.select("c_last_name", "c_first_name", "s_store_name",
+                       "netpaid")
+            .sort(col("c_last_name").asc(), col("c_first_name").asc(),
+                  col("s_store_name").asc())
+            .limit(100).collect())
+
+
+def _q24_oracle(a):
+    import pandas as pd
+    ss = a["store_sales"].to_pandas()
+    sr = a["store_returns"].to_pandas()
+    keys = set(zip(sr.sr_item_sk, sr.sr_ticket_number))
+    ss = ss[pd.Series(list(zip(ss.ss_item_sk, ss.ss_ticket_number)),
+                      index=ss.index).isin(keys)
+            & ss.ss_customer_sk.notna()]
+    st = a["store"].to_pandas()
+    st = st[st.s_market_id <= 5][
+        ["s_store_sk", "s_store_name", "s_zip"]]
+    c = a["customer"].to_pandas()[
+        ["c_customer_sk", "c_first_name", "c_last_name",
+         "c_current_addr_sk"]]
+    ca = a["customer_address"].to_pandas()[["ca_address_sk", "ca_zip"]]
+    it = a["item"].to_pandas()[["i_item_sk", "i_color"]]
+    j = ss.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(c, left_on="ss_customer_sk", right_on="c_customer_sk")
+    j = j.merge(ca, left_on="c_current_addr_sk",
+                right_on="ca_address_sk")
+    j = j[j.ca_zip != j.s_zip]
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    j["np"] = j.ss_net_paid.astype(float)
+    per = j.groupby(["c_last_name", "c_first_name", "s_store_name",
+                     "i_color"])["np"].sum().reset_index(name="netpaid")
+    thresh = 0.05 * per.netpaid.mean()
+    sel = per[(per.i_color == "plum") & (per.netpaid > thresh)]
+    out = sel[["c_last_name", "c_first_name", "s_store_name",
+               "netpaid"]].sort_values(
+        ["c_last_name", "c_first_name", "s_store_name"]).head(100)
+    return pa.Table.from_pandas(out.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q24", "returned plum-color sales by out-of-area customers > 5% avg")(
+    (_q24_run, _q24_oracle))
+
+
+# ===========================================================================
+# q54: revenue-segment histogram of one month's cross-channel category
+#      buyers over their following-quarter store spend
+# ===========================================================================
+
+def _q54_run(s, t):
+    it = _rd(s, t, "item").filter(col("i_category") == "Sports") \
+        .select("i_item_sk")
+    dd_m = _rd(s, t, "date_dim").filter(
+        (col("d_year") == 2000) & (col("d_moy") >= 2)
+        & (col("d_moy") <= 4)).select("d_date_sk")
+
+    def buyers(fact, date_k, cust_k, item_k):
+        f = _rd(s, t, fact).select(date_k, cust_k, item_k)
+        j = f.join(_rename(dd_m, d_date_sk=date_k), on=date_k,
+                   how="semi")
+        j = j.join(_rename(it, i_item_sk=item_k), on=item_k, how="semi")
+        return (j.filter(col(cust_k).is_not_null())
+                .group_by(cust_k).agg()
+                .select(col(cust_k).alias("c_customer_sk")))
+
+    my_customers = buyers("catalog_sales", "cs_sold_date_sk",
+                          "cs_bill_customer_sk", "cs_item_sk") \
+        .union(buyers("web_sales", "ws_sold_date_sk",
+                      "ws_bill_customer_sk", "ws_item_sk")) \
+        .group_by("c_customer_sk").agg() \
+        .select(col("c_customer_sk"))
+    # the following six months' store revenue of those customers (the
+    # genuine template uses month+1..+3; the window is a tuned parameter
+    # so CI-scale data keeps the histogram nonempty)
+    dd_q = _rd(s, t, "date_dim").filter(
+        (col("d_year") == 2000) & (col("d_moy") >= 5)
+        & (col("d_moy") <= 10)).select("d_date_sk")
+    ss = _rd(s, t, "store_sales").select(
+        "ss_sold_date_sk", "ss_customer_sk", "ss_ext_sales_price")
+    j = ss.join(_rename(dd_q, d_date_sk="ss_sold_date_sk"),
+                on="ss_sold_date_sk", how="semi")
+    j = j.join(_rename(my_customers, c_customer_sk="ss_customer_sk"),
+               on="ss_customer_sk", how="semi")
+    rev = (j.group_by("ss_customer_sk")
+           .agg(F.sum(col("ss_ext_sales_price")).alias("revenue")))
+    seg = (col("revenue").cast(DataType.FLOAT64) / lit(50.0)) \
+        .cast(DataType.INT64)
+    g = (rev.with_column("segment", seg)
+         .group_by("segment").agg(F.count_star().alias("num_customers")))
+    return (g.select("segment", "num_customers",
+                     (col("segment") * lit(50, DataType.INT64))
+                     .alias("segment_base"))
+            .sort(col("segment").asc()).limit(100).collect())
+
+
+def _q54_oracle(a):
+    import pandas as pd
+    it = a["item"].to_pandas()
+    items = set(it[it.i_category == "Sports"].i_item_sk)
+    dd = a["date_dim"].to_pandas()
+    mdays = set(dd[(dd.d_year == 2000) & (dd.d_moy >= 2)
+                   & (dd.d_moy <= 4)].d_date_sk)
+    qdays = set(dd[(dd.d_year == 2000) & (dd.d_moy >= 5)
+                   & (dd.d_moy <= 10)].d_date_sk)
+
+    def buyers(name, date_k, cust_k, item_k):
+        f = a[name].to_pandas()
+        f = f[f[date_k].isin(mdays) & f[item_k].isin(items)
+              & f[cust_k].notna()]
+        return set(f[cust_k].astype(int))
+
+    custs = (buyers("catalog_sales", "cs_sold_date_sk",
+                    "cs_bill_customer_sk", "cs_item_sk")
+             | buyers("web_sales", "ws_sold_date_sk",
+                      "ws_bill_customer_sk", "ws_item_sk"))
+    ss = a["store_sales"].to_pandas()
+    ss = ss[ss.ss_sold_date_sk.isin(qdays)
+            & ss.ss_customer_sk.isin(custs)].copy()
+    ss["p"] = ss.ss_ext_sales_price.astype(float)
+    rev = ss.groupby("ss_customer_sk")["p"].sum()
+    seg = (rev / 50.0).astype(int)
+    g = seg.value_counts().sort_index().reset_index()
+    g.columns = ["segment", "num_customers"]
+    g["segment_base"] = g.segment * 50
+    g = g.sort_values("segment").head(100)
+    g["segment"] = g.segment.astype("int64")
+    g["num_customers"] = g.num_customers.astype("int64")
+    g["segment_base"] = g.segment_base.astype("int64")
+    return pa.Table.from_pandas(g.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q54", "revenue-segment histogram of cross-channel category buyers")(
+    (_q54_run, _q54_oracle))
+
+
+# ===========================================================================
+# q64: returned-item store purchase chains, self-joined across two years
+# ===========================================================================
+
+def _q64_cross_sales(s, t, year):
+    """One pass of the q64 CTE: per (item, store) sales stats for lines
+    that were RETURNED (ss ⋈ sr), in one year, for a color slice."""
+    ss = _rd(s, t, "store_sales").select(
+        "ss_sold_date_sk", "ss_item_sk", "ss_ticket_number",
+        "ss_store_sk", "ss_wholesale_cost", "ss_list_price",
+        "ss_coupon_amt")
+    sr = _rd(s, t, "store_returns").select(
+        col("sr_item_sk").alias("ss_item_sk"),
+        col("sr_ticket_number").alias("ss_ticket_number"))
+    ss = ss.join(sr, on=["ss_item_sk", "ss_ticket_number"], how="semi")
+    dd = _rd(s, t, "date_dim").filter(col("d_year") == year) \
+        .select("d_date_sk")
+    ss = ss.join(_rename(dd, d_date_sk="ss_sold_date_sk"),
+                 on="ss_sold_date_sk", how="semi")
+    it = _rd(s, t, "item").filter(
+        col("i_color").isin("plum", "orchid", "slate")) \
+        .select("i_item_sk", "i_item_id")
+    ss = ss.join(_rename(it, i_item_sk="ss_item_sk"), on="ss_item_sk",
+                 how="inner")
+    st = _rd(s, t, "store").select("s_store_sk", "s_store_name")
+    ss = _join_dim(ss, st, "ss_store_sk", "s_store_sk")
+    return (ss.group_by("i_item_id", "s_store_name")
+            .agg(F.count_star().alias("cnt"),
+                 F.sum(col("ss_wholesale_cost")).alias("s1"),
+                 F.sum(col("ss_list_price")).alias("s2"),
+                 F.sum(col("ss_coupon_amt")).alias("s3")))
+
+
+def _q64_run(s, t):
+    cs1 = _q64_cross_sales(s, t, 1999).select(
+        col("i_item_id"), col("s_store_name"), col("cnt").alias("cnt1"),
+        col("s1").alias("s1_1"), col("s2").alias("s2_1"),
+        col("s3").alias("s3_1"))
+    cs2 = _q64_cross_sales(s, t, 2000).select(
+        col("i_item_id"), col("s_store_name"), col("cnt").alias("cnt2"),
+        col("s1").alias("s1_2"), col("s2").alias("s2_2"),
+        col("s3").alias("s3_2"))
+    j = cs1.join(cs2, on=["i_item_id", "s_store_name"], how="inner")
+    j = j.filter(col("cnt2") >= col("cnt1"))
+    return (j.select("i_item_id", "s_store_name", "cnt1", "s1_1", "s2_1",
+                     "s3_1", "cnt2", "s1_2", "s2_2", "s3_2")
+            .sort(col("i_item_id").asc(), col("s_store_name").asc())
+            .limit(100).collect())
+
+
+def _q64_oracle(a):
+    import pandas as pd
+
+    def cross_sales(year):
+        ss = a["store_sales"].to_pandas()
+        sr = a["store_returns"].to_pandas()
+        keys = set(zip(sr.sr_item_sk, sr.sr_ticket_number))
+        ss = ss[pd.Series(list(zip(ss.ss_item_sk, ss.ss_ticket_number)),
+                          index=ss.index).isin(keys)]
+        dd = a["date_dim"].to_pandas()
+        days = set(dd[dd.d_year == year].d_date_sk)
+        ss = ss[ss.ss_sold_date_sk.isin(days)]
+        it = a["item"].to_pandas()
+        it = it[it.i_color.isin(["plum", "orchid", "slate"])][
+            ["i_item_sk", "i_item_id"]]
+        j = ss.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        st = a["store"].to_pandas()[["s_store_sk", "s_store_name"]]
+        j = j.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+        for c_, nm in (("ss_wholesale_cost", "s1"),
+                       ("ss_list_price", "s2"), ("ss_coupon_amt", "s3")):
+            j[nm] = j[c_]
+        g = j.groupby(["i_item_id", "s_store_name"]).agg(
+            cnt=("s1", "size"), s1=("s1", "sum"), s2=("s2", "sum"),
+            s3=("s3", "sum")).reset_index()
+        return g
+
+    c1 = cross_sales(1999).rename(columns={
+        "cnt": "cnt1", "s1": "s1_1", "s2": "s2_1", "s3": "s3_1"})
+    c2 = cross_sales(2000).rename(columns={
+        "cnt": "cnt2", "s1": "s1_2", "s2": "s2_2", "s3": "s3_2"})
+    j = c1.merge(c2, on=["i_item_id", "s_store_name"])
+    j = j[j.cnt2 >= j.cnt1]
+    out = j[["i_item_id", "s_store_name", "cnt1", "s1_1", "s2_1",
+             "s3_1", "cnt2", "s1_2", "s2_2", "s3_2"]]
+    out = out.sort_values(["i_item_id", "s_store_name"]).head(100)
+    for c_ in ("cnt1", "cnt2"):
+        out[c_] = out[c_].astype("int64")
+    return pa.Table.from_pandas(out.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q64", "returned-item purchase chains self-joined across two years")(
+    (_q64_run, _q64_oracle))
