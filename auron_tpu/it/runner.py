@@ -164,7 +164,8 @@ def run_tpcds(data_dir=None, scale: float = 1.0, names=None,
 
 def run_tpch(data_dir=None, scale: float = 1.0, names=None,
              verbose: bool = True) -> list[ComparisonResult]:
-    """TPC-H q5/q9/q18 (BASELINE.md join-heavy targets) vs pandas
+    """TPC-H q1/q3/q5/q6/q9/q18 (incl. the BASELINE.md join-heavy
+    targets) vs pandas
     oracles."""
     from auron_tpu.it.tpch import generate, load_arrow
     from auron_tpu.it.tpch_queries import QUERIES as HQ
@@ -216,7 +217,7 @@ def main(argv=None) -> int:
                     help="synth: the synthetic-star queries; tpcds: the "
                          "real-schema TPC-DS battery (see tpcds_queries) "
                          "vs the Acero oracle; "
-                         "tpch: the join-heavy q5/q9/q18 BASELINE targets")
+                         "tpch: q1/q3/q5/q6/q9/q18 incl. the BASELINE targets")
     ap.add_argument("--queries", default="",
                     help="comma-separated names (q01 or full name)")
     ap.add_argument("--data", default=None,

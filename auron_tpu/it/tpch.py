@@ -110,8 +110,12 @@ def generate(root: str, scale: float = 1.0, seed: int = 11) -> dict:
     out["partsupp"] = _write(root, "partsupp", partsupp)
 
     n_cust = max(int(1500 * scale), 150)
+    segments = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE",
+                         "HOUSEHOLD", "MACHINERY"])
     customer = pa.table({
         "c_custkey": pa.array(np.arange(1, n_cust + 1, dtype=np.int64)),
+        "c_mktsegment": pa.array(
+            segments[rng.integers(0, 5, n_cust)]),
         "c_name": pa.array([f"Customer#{i:09d}"
                             for i in range(1, n_cust + 1)]),
         "c_nationkey": pa.array(
@@ -126,6 +130,7 @@ def generate(root: str, scale: float = 1.0, seed: int = 11) -> dict:
         "o_custkey": pa.array(
             rng.integers(1, n_cust + 1, n_ord).astype(np.int64)),
         "o_orderdate": pa.array(o_date.astype("datetime64[D]")),
+        "o_shippriority": pa.array(np.zeros(n_ord, np.int64)),
         "o_totalprice": _money(rng, n_ord, 100_000, 40_000_000),
     })
     out["orders"] = _write(root, "orders", orders, 2)
@@ -153,6 +158,13 @@ def generate(root: str, scale: float = 1.0, seed: int = 11) -> dict:
         "l_shipdate": pa.array(
             (o_date[l_ord - 1]
              + rng.integers(1, 122, n_li)).astype("datetime64[D]")),
+        "l_tax": pa.array(
+            [decimal.Decimal(int(x)).scaleb(-2)
+             for x in rng.integers(0, 9, n_li)], pa.decimal128(12, 2)),
+        "l_returnflag": pa.array(
+            np.array(["A", "N", "R"])[rng.integers(0, 3, n_li)]),
+        "l_linestatus": pa.array(
+            np.array(["F", "O"])[rng.integers(0, 2, n_li)]),
     })
     out["lineitem"] = _write(root, "lineitem", lineitem, 4)
     return out

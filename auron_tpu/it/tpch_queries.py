@@ -233,3 +233,142 @@ def _q18_oracle(a):
 
 _q("q18", "large-volume customers (agg-filtered semi join)")(
     (_q18_run, _q18_oracle))
+
+
+# --- q1: pricing summary report -------------------------------------------
+
+def _q1_run(s, t):
+    li = _rd(s, t, "lineitem").select(
+        "l_quantity", "l_extendedprice", "l_discount", "l_tax",
+        "l_returnflag", "l_linestatus", "l_shipdate")
+    cutoff = int(np.datetime64("1998-06-02").astype("datetime64[D]")
+                 .astype(int))
+    li = li.filter(col("l_shipdate") <= lit(cutoff, DataType.DATE32))
+    price = col("l_extendedprice").cast(DataType.FLOAT64)
+    disc = col("l_discount").cast(DataType.FLOAT64)
+    tax = col("l_tax").cast(DataType.FLOAT64)
+    li = li.with_column("disc_price", price * (lit(1.0) - disc))
+    li = li.with_column("charge",
+                        col("disc_price") * (lit(1.0) + tax))
+    g = (li.group_by("l_returnflag", "l_linestatus")
+         .agg(F.sum(col("l_quantity")).alias("sum_qty"),
+              F.sum(price).alias("sum_base_price"),
+              F.sum(col("disc_price")).alias("sum_disc_price"),
+              F.sum(col("charge")).alias("sum_charge"),
+              F.avg(col("l_quantity").cast(DataType.FLOAT64))
+              .alias("avg_qty"),
+              F.avg(price).alias("avg_price"),
+              F.avg(disc).alias("avg_disc"),
+              F.count_star().alias("count_order")))
+    return (g.sort(col("l_returnflag").asc(), col("l_linestatus").asc())
+            .collect())
+
+
+def _q1_oracle(a):
+    p = _pd(a)
+    li = p["lineitem"]
+    li = li[li.l_shipdate <= np.datetime64("1998-06-02")].copy()
+    li["price"] = li.l_extendedprice.astype(float)
+    li["disc"] = li.l_discount.astype(float)
+    li["tax"] = li.l_tax.astype(float)
+    li["disc_price"] = li.price * (1.0 - li.disc)
+    li["charge"] = li.disc_price * (1.0 + li.tax)
+    g = li.groupby(["l_returnflag", "l_linestatus"]).agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base_price=("price", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        avg_price=("price", "mean"),
+        avg_disc=("disc", "mean"),
+        count_order=("price", "size")).reset_index()
+    g = g.sort_values(["l_returnflag", "l_linestatus"])
+    g["count_order"] = g.count_order.astype("int64")
+    return pa.Table.from_pandas(g.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q1", "pricing summary report (8-agg scan)")((_q1_run, _q1_oracle))
+
+
+# --- q3: shipping-priority revenue ----------------------------------------
+
+def _q3_run(s, t):
+    cutoff = int(np.datetime64("1995-03-15").astype("datetime64[D]")
+                 .astype(int))
+    c = _rd(s, t, "customer").filter(
+        col("c_mktsegment") == "BUILDING").select("c_custkey")
+    o = _rd(s, t, "orders").select("o_orderkey", "o_custkey",
+                                   "o_orderdate", "o_shippriority")
+    o = o.filter(col("o_orderdate") < lit(cutoff, DataType.DATE32))
+    li = _rd(s, t, "lineitem").select(
+        "l_orderkey", "l_extendedprice", "l_discount", "l_shipdate")
+    li = li.filter(col("l_shipdate") > lit(cutoff, DataType.DATE32))
+    j = _join(o, c, "o_custkey", "c_custkey")
+    j = j.join(_rename(li, l_orderkey="o_orderkey"), on="o_orderkey",
+               how="inner")
+    rev = (col("l_extendedprice").cast(DataType.FLOAT64)
+           * (lit(1.0) - col("l_discount").cast(DataType.FLOAT64)))
+    g = (j.with_column("rev", rev)
+         .group_by("o_orderkey", "o_orderdate", "o_shippriority")
+         .agg(F.sum(col("rev")).alias("revenue")))
+    return (g.sort(col("revenue").desc(), col("o_orderdate").asc(),
+                   col("o_orderkey").asc())
+            .limit(10).collect())
+
+
+def _q3_oracle(a):
+    p = _pd(a)
+    cutoff = np.datetime64("1995-03-15")
+    c = p["customer"]
+    c = c[c.c_mktsegment == "BUILDING"][["c_custkey"]]
+    o = p["orders"]
+    o = o[o.o_orderdate < cutoff]
+    li = p["lineitem"]
+    li = li[li.l_shipdate > cutoff]
+    j = o.merge(c, left_on="o_custkey", right_on="c_custkey")
+    j = j.merge(li, left_on="o_orderkey", right_on="l_orderkey")
+    j["rev"] = j.l_extendedprice.astype(float) \
+        * (1.0 - j.l_discount.astype(float))
+    g = j.groupby(["o_orderkey", "o_orderdate", "o_shippriority"])[
+        "rev"].sum().reset_index(name="revenue")
+    g = g.sort_values(["revenue", "o_orderdate", "o_orderkey"],
+                      ascending=[False, True, True]).head(10)
+    return pa.Table.from_pandas(g.reset_index(drop=True),
+                                preserve_index=False)
+
+
+_q("q3", "shipping-priority revenue (BUILDING segment top 10)")(
+    (_q3_run, _q3_oracle))
+
+
+# --- q6: forecast revenue change ------------------------------------------
+
+def _q6_run(s, t):
+    li = _rd(s, t, "lineitem").select(
+        "l_shipdate", "l_quantity", "l_extendedprice", "l_discount")
+    lo, hi = int(_D1994), int(_D1995)
+    disc = col("l_discount").cast(DataType.FLOAT64)
+    j = li.filter((col("l_shipdate") >= lit(lo, DataType.DATE32))
+                  & (col("l_shipdate") < lit(hi, DataType.DATE32))
+                  & (disc >= lit(0.03)) & (disc <= lit(0.07))
+                  & (col("l_quantity") < 24))
+    rev = col("l_extendedprice").cast(DataType.FLOAT64) * disc
+    return (j.with_column("rev", rev).group_by()
+            .agg(F.sum(col("rev")).alias("revenue")).collect())
+
+
+def _q6_oracle(a):
+    p = _pd(a)
+    li = p["lineitem"]
+    d = li.l_discount.astype(float)
+    sel = li[(li.l_shipdate >= np.datetime64("1994-01-01"))
+             & (li.l_shipdate < np.datetime64("1995-01-01"))
+             & (d >= 0.03) & (d <= 0.07) & (li.l_quantity < 24)]
+    rev = (sel.l_extendedprice.astype(float)
+           * sel.l_discount.astype(float)).sum()
+    return pa.Table.from_pydict({"revenue": [float(rev)]})
+
+
+_q("q6", "forecast revenue change (selective filter-agg)")(
+    (_q6_run, _q6_oracle))

@@ -1,4 +1,4 @@
-"""TPC-H q5/q9/q18 gate at CI scale (BASELINE.md join-heavy targets;
+"""TPC-H q1/q3/q5/q6/q9/q18 gate at CI scale (BASELINE.md join-heavy targets;
 `python -m auron_tpu.it.runner --suite tpch --scale 1.0` is the full
 gate)."""
 
@@ -21,7 +21,7 @@ def results():
 
 
 def test_all_queries_present(results):
-    assert len(results) == len(QUERIES) == 3
+    assert len(results) == len(QUERIES) == 6
 
 
 @pytest.mark.parametrize("qname", [q.name for q in QUERIES])
