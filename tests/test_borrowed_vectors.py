@@ -957,6 +957,94 @@ class TestArrayMapVectors:
         _check_vector(fn("size", C(0)), m, [2, 0], "map size")
 
 
+class TestEntryListVectors:
+    """Spark golden vectors for the round-5 map_entries /
+    map_from_entries family (Spark `SELECT map_entries(map(1,'a'))` class
+    results) and wide-decimal collect semantics."""
+
+    def test_map_entries_vector(self):
+        m = {"c": pa.array([[(1, 10), (2, None)], [], None],
+                           pa.map_(pa.int64(), pa.int64()))}
+        _check_vector(fn("map_entries", C(0)), m,
+                      [[{"key": 1, "value": 10}, {"key": 2, "value": None}],
+                       [], None], "map_entries")
+
+    def test_map_from_entries_vector(self):
+        t = pa.list_(pa.struct([pa.field("key", pa.int64(), False),
+                                pa.field("value", pa.int64())]))
+        ents = {"c": pa.array(
+            [[{"key": 1, "value": 10}, {"key": 1, "value": 99}],
+             [{"key": 7, "value": None}], None, []], t)}
+        # LAST_WINS dedup like map()/map_from_arrays; null map rows pass
+        got = _run_expr(fn("map_from_entries", C(0)), ents)
+        assert got[0] == [(1, 99)]     # truly deduped, not dict-collapsed
+        assert got[1] == [(7, None)]
+        assert got[2] is None
+        assert got[3] == []
+        ASSERTIONS["n"] += 4
+
+    def test_entries_roundtrip_vector(self):
+        m = {"c": pa.array([[(5, 50)], [(3, 30), (4, 40)]],
+                           pa.map_(pa.int64(), pa.int64()))}
+        _check_vector(fn("map_from_entries", fn("map_entries", C(0))), m,
+                      [[(5, 50)], [(3, 30), (4, 40)]],
+                      "map_from_entries . map_entries == id")
+
+
+class TestWideDecimalAggVectors:
+    """Spark golden semantics for wide-decimal aggregates added in
+    round 5: sum/avg result types past 18 digits, collect over two-limb
+    values (SparkTestsBase AuronPercentileSuite-class coverage)."""
+
+    def _agg(self, vals, precision, scale, aggfn, distinct=False):
+        rb = pa.record_batch({
+            "g": pa.array([0] * len(vals), pa.int64()),
+            "d": pa.array([None if v is None else decimal.Decimal(v)
+                           for v in vals],
+                          pa.decimal128(precision, scale))})
+        scan = MemoryScanOp([[rb]], schema_from_arrow(rb.schema),
+                            capacity=16)
+        from auron_tpu.ops.agg import AggOp
+        op = AggOp(scan, [C(0)],
+                   [ir.AggFunction(aggfn, C(1), distinct=distinct)],
+                   mode="complete", group_names=["g"], agg_names=["a"],
+                   initial_capacity=4)
+        tbl = collect(op)
+        return tbl.schema.field("a").type, tbl.column("a").to_pylist()[0]
+
+    def test_wide_sum_type_and_value(self):
+        t, v = self._agg(["99999999999999999999.01", "0.99", None],
+                         25, 2, "sum")
+        assert str(t) == "decimal128(35, 2)"     # min(p+10, 38)
+        assert v == decimal.Decimal("100000000000000000000.00")
+        ASSERTIONS["n"] += 2
+
+    def test_narrow_sum_promotes_past_18(self):
+        t, v = self._agg(["9999999999.25", "0.75"], 12, 2, "sum")
+        assert str(t) == "decimal128(22, 2)"     # Spark p+10, two-limb
+        assert v == decimal.Decimal("10000000000.00")
+        ASSERTIONS["n"] += 2
+
+    def test_wide_avg_halfup(self):
+        # sum = 10.000000000000000002, /3 = 3.333...334 at scale 22 after
+        # HALF_UP on the repeating tail (truncation/HALF_EVEN differ)
+        t, v = self._agg(["10.000000000000000001", "0.000000000000000001",
+                          "0.000000000000000000"], 38, 18, "avg")
+        assert str(t) == "decimal128(38, 22)"    # bounded(p+4, s+4)
+        assert v == decimal.Decimal("3.3333333333333333340000") \
+            .quantize(decimal.Decimal(1).scaleb(-22)), v
+        ASSERTIONS["n"] += 2
+
+    def test_wide_collect_set_dedup(self):
+        t, v = self._agg(["123456789012345678901234.50",
+                          "123456789012345678901234.50", "1.00", None],
+                         30, 2, "collect_set")
+        assert str(t) == "list<item: decimal128(30, 2)>"
+        assert sorted(v) == [decimal.Decimal("1.00"),
+                             decimal.Decimal("123456789012345678901234.50")]
+        ASSERTIONS["n"] += 2
+
+
 def test_assertion_floor():
     """The battery above must keep covering 500+ borrowed assertions —
     run last (alphabetical classes first, functions after)."""
