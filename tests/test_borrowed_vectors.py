@@ -1052,4 +1052,5 @@ def test_assertion_floor():
     # floor guards against silently shrinking coverage.
     if ASSERTIONS["n"] == 0:
         pytest.skip("battery deselected (-k): nothing to measure")
+    print(f"\nborrowed-vector assertions counted: {ASSERTIONS['n']}")
     assert ASSERTIONS["n"] >= 500, ASSERTIONS["n"]
