@@ -19,6 +19,13 @@ from auron_tpu.columnar.batch import (DeviceBatch, ListColumn,
 from auron_tpu.columnar.schema import DataType, Field, Schema
 from auron_tpu.utils.shapes import bucket_rows, bucket_string_width
 
+#: fallback precision for a LIST-of-decimal field whose precision slot is
+#: 0 (pre-fix partial layouts): ONE constant shared by schema_to_arrow
+#: and every child-array render site — diverging fallbacks (38 in the
+#: schema vs 18 in the HostList child) made the child array type
+#: mismatch the declared schema at table assembly (ADVICE round 5)
+_LIST_DECIMAL_FALLBACK_PRECISION = 38
+
 _PA_TO_DT = {
     pa.bool_(): DataType.BOOL,
     pa.int8(): DataType.INT8,
@@ -151,7 +158,9 @@ def schema_to_arrow(schema: Schema) -> pa.Schema:
             elif f.elem == DataType.DECIMAL:
                 # element (p, s) rides the LIST field's precision/scale
                 # slots (wide collect_* results; ops/agg.py make_acc_spec)
-                t = pa.list_(pa.decimal128(f.precision or 38, f.scale))
+                t = pa.list_(pa.decimal128(
+                    f.precision or _LIST_DECIMAL_FALLBACK_PRECISION,
+                    f.scale))
             else:
                 t = pa.list_(pa.string() if f.elem == DataType.STRING
                              else pa.from_numpy_dtype(f.elem.to_np()))
@@ -319,23 +328,51 @@ def _decimal_list_to_device(field: Field, arr: pa.Array, cap: int):
 def _entry_list_to_device(field: Field, arr: pa.Array, cap: int):
     """list<struct<K,V>> (entry list) → MapColumn carrier: the parallel
     key/value matrices + shared lens ARE the list-of-entry-structs layout
-    (reference renders MapArray the same offsets-over-struct way). Null
-    entry structs and null first-child ("key") values have no slot in the
-    carrier and fail fast host-side; Spark's MapFromEntries raises on
-    both anyway."""
+    (reference renders MapArray the same offsets-over-struct way).
+
+    A row containing a NULL entry struct renders as a NULL row — the
+    reference's map_from_entries semantics ('null array entry => null',
+    spark_map.rs) — by folding those rows into the carrier's row
+    validity, so the dead entries never need a slot. NULL first-child
+    ("key") values in surviving rows still fail fast: Spark map keys are
+    non-null."""
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
     struct_child = arr.values
+    n = len(arr)
     if struct_child.null_count:
-        raise NotImplementedError(
-            "entry list with NULL entry structs: entries have no carrier "
-            "slot (Spark map_from_entries raises on null entries)")
+        entry_null = np.asarray(struct_child.is_null())
+        offsets = np.asarray(arr.offsets)[: n + 1].astype(np.int64)
+        cum = np.concatenate([[0], np.cumsum(entry_null)])
+        row_has_null = (cum[offsets[1:]] - cum[offsets[:-1]]) > 0
+        validity = (~np.asarray(arr.is_null()) if arr.null_count
+                    else np.ones(n, bool)) & ~row_has_null
+        arr = pa.ListArray.from_arrays(
+            pa.array(offsets.astype(np.int32), pa.int32()), struct_child,
+            mask=pa.array(~validity))
+    else:
+        entry_null = None
     kf, vf = field.children
     karr = struct_child.field(0)
     if karr.null_count:
-        raise NotImplementedError(
-            "entry list with NULL key children (Spark map keys are "
-            "non-null)")
+        # keys inside dead entries (null structs, entries of NULL rows)
+        # have no semantics and no carrier slot; only a null key of a
+        # LIVE entry in a surviving row raises
+        key_null = np.asarray(karr.is_null())
+        offsets = np.asarray(arr.offsets)[: n + 1].astype(np.int64)
+        live_row = (~np.asarray(arr.is_null()) if arr.null_count
+                    else np.ones(n, bool))
+        ne = len(key_null)
+        mark = np.zeros(ne + 1, np.int32)
+        np.add.at(mark, np.clip(offsets[:-1][live_row], 0, ne), 1)
+        np.add.at(mark, np.clip(offsets[1:][live_row], 0, ne), -1)
+        key_null = key_null & (np.cumsum(mark[:ne]) > 0)
+        if entry_null is not None:
+            key_null = key_null & ~entry_null
+        if key_null.any():
+            raise NotImplementedError(
+                "entry list with NULL key children (Spark map keys are "
+                "non-null)")
     return _kv_lists_to_map_column(arr, karr, struct_child.field(1),
                                    kf.dtype.to_np(), vf.dtype.to_np(), cap)
 
@@ -605,7 +642,9 @@ def _host_col_to_arrow(field: Field, hc, n: int) -> pa.Array:
             # wide ones ride the HostMap limb carrier)
             child = pa.array(
                 [_int_to_decimal(int(x), field.scale) for x in flat_vals],
-                pa.decimal128(field.precision or 18, field.scale))
+                pa.decimal128(
+                    field.precision or _LIST_DECIMAL_FALLBACK_PRECISION,
+                    field.scale))
         else:
             child = pa.array(flat_vals,
                              pa.from_numpy_dtype(field.elem.to_np()))
@@ -627,8 +666,9 @@ def _host_col_to_arrow(field: Field, hc, n: int) -> pa.Array:
             ints = ints_from_limbs(flat_hi, flat_lo, flat_vv)
             vals = [None if x is None else _int_to_decimal(x, field.scale)
                     for x in ints]
-            child = pa.array(vals, pa.decimal128(field.precision or 38,
-                                                 field.scale))
+            child = pa.array(vals, pa.decimal128(
+                field.precision or _LIST_DECIMAL_FALLBACK_PRECISION,
+                field.scale))
             off_arr = _list_offsets(lens, validity, n)
             return pa.ListArray.from_arrays(off_arr, child)
         if field.dtype == DataType.LIST:

@@ -26,7 +26,11 @@ from dataclasses import dataclass
 from typing import Optional, Union
 
 import numpy as np
-import zstandard
+
+try:
+    import zstandard
+except ImportError:    # gated dependency: fall back to CODEC_NONE frames
+    zstandard = None
 
 from auron_tpu.columnar.batch import (DeviceBatch, ListColumn,
                                       PrimitiveColumn, StringColumn)
@@ -42,7 +46,7 @@ import threading
 _tls = threading.local()
 
 
-def _compressor(level: int = None) -> zstandard.ZstdCompressor:
+def _compressor(level: int = None):
     if level is None:
         from auron_tpu import config as cfg
         level = cfg.get_config().get(cfg.SPILL_CODEC_LEVEL)
@@ -52,7 +56,11 @@ def _compressor(level: int = None) -> zstandard.ZstdCompressor:
     return _tls.c
 
 
-def _decompressor() -> zstandard.ZstdDecompressor:
+def _decompressor():
+    if zstandard is None:
+        raise RuntimeError(
+            "frame was written with the zstd codec but the zstandard "
+            "module is not installed in this environment")
     if not hasattr(_tls, "d"):
         _tls.d = zstandard.ZstdDecompressor()
     return _tls.d
@@ -473,10 +481,12 @@ def serialize_host_batch(host: HostBatch,
         _put_buf(body, arr)
 
     raw = body.getvalue()
-    if codec == "zstd":
+    if codec == "zstd" and zstandard is not None:
         payload = _compressor(codec_level).compress(raw)
         code = CODEC_ZSTD
     else:
+        # zstandard absent: uncompressed frames keep serde functional
+        # (the codec byte makes readers self-describing either way)
         payload, code = raw, CODEC_NONE
     return MAGIC + struct.pack("<BI", code, len(payload)) + payload
 

@@ -114,6 +114,15 @@ MAX_LIVE_PROGRAMS = _opt(
     "in one long-lived process). Checked only at quiescent boundaries "
     "(between serving tasks / runner queries); <= 0 disables.")
 
+# compile-budget diet: persistent XLA compilation cache
+XLA_CACHE_DIR = _opt(
+    "auron.xla_cache_dir", str, "",
+    "Directory for jax's persistent compilation cache "
+    "(jax_compilation_cache_dir), bound at Session init. On the "
+    "tunneled accelerator each program build costs seconds, so a warm "
+    "cache across processes is the first step of the compile-budget "
+    "diet; empty (the default) leaves the cache off.")
+
 # failure recovery
 TASK_MAX_RETRIES = _opt(
     "auron.task.max_retries", int, 2,
@@ -171,6 +180,28 @@ AGG_PARTIAL_SKIP_RATIO = _opt(
 AGG_PARTIAL_SKIP_MIN_ROWS = _opt(
     "auron.agg.partial_skip.min_rows", int, 1 << 16,
     "Input rows to observe before the skip decision is made.")
+
+# hand-written kernels (auron_tpu/kernels)
+KERNELS_ENABLED = _opt(
+    "auron.kernels.enabled", bool, True,
+    "Allow the dense grouped-aggregation kernels (Pallas VMEM / one-hot "
+    "matmul) when the planner bounds the group-key domain; off forces "
+    "every aggregation through the general sort-based path "
+    "(kernels/dispatch.py).")
+KERNELS_MAX_KEY_DOMAIN = _opt(
+    "auron.kernels.max_key_domain", int, 1 << 16,
+    "Largest bounded key domain eligible for the dense grouped-agg "
+    "kernels; plans with a larger (or unknown) bound fall back to the "
+    "sort path. Hard-capped at 2^16 by the kernels' (hi, lo) byte grid "
+    "decomposition.")
+KERNELS_BACKEND = _opt(
+    "auron.kernels.backend", str, "auto",
+    "Dense grouped-agg backend: 'auto' compiles the Pallas VMEM kernel "
+    "natively on a real TPU and uses the one-hot matmul formulation "
+    "elsewhere; 'pallas' forces the Pallas kernel (interpreter on "
+    "non-TPU platforms — how the differential battery verifies it on "
+    "CPU); 'dense' forces the matmul path; 'sort' disables the dense "
+    "path entirely.")
 
 
 # --------------------------------------------------------------------------

@@ -37,6 +37,13 @@ class PlannerContext:
     # None = resolve from the typed config (auron.batch.capacity)
     batch_capacity: Optional[int] = None
     config: Optional[Any] = None
+    #: (table name, column index) -> (table ref, (min, max)) — memoizes
+    #: the O(n) key-column stats scan the dense-kernel derivation needs,
+    #: so repeated planning over a registered table pays it once. The
+    #: entry holds a STRONG reference to the scanned table and hits only
+    #: on identity (`is`): a re-registered table can never alias a
+    #: recycled id and serve stale stats for different data
+    _col_stats: dict = field(default_factory=dict)
 
     def __post_init__(self):
         from auron_tpu import config as cfg
@@ -166,15 +173,104 @@ class PhysicalPlanner:
     def _plan_agg(self, n: pb.AggNode) -> PhysicalOp:
         from auron_tpu import config as cfg
         from auron_tpu.ops.agg import AggOp
+        child = self.create_plan(n.child)
+        group_exprs = [serde.parse_expr(e) for e in n.group_exprs]
+        aggs = [serde.parse_agg(a) for a in n.aggs]
         return AggOp(
-            self.create_plan(n.child),
-            [serde.parse_expr(e) for e in n.group_exprs],
-            [serde.parse_agg(a) for a in n.aggs],
+            child, group_exprs, aggs,
             mode=n.mode or "complete",
             group_names=list(n.group_names) or None,
             agg_names=list(n.agg_names) or None,
             initial_capacity=self.ctx.config.get(cfg.AGG_INITIAL_CAPACITY),
+            key_domain=self._agg_key_domain(n, child, group_exprs, aggs),
         )
+
+    def _agg_key_domain(self, n: pb.AggNode, child: PhysicalOp,
+                        group_exprs, aggs) -> Optional[int]:
+        """Table-stats key-domain bound for the dense grouped-agg kernels
+        (auron_tpu/kernels): proven, not guessed. A bound is derived only
+        when the single group key is a direct ColumnRef reaching a
+        catalog memory table through schema-preserving nodes, the key
+        column is integer, null-free and non-negative, and every
+        aggregate is exact under the dense formulation (count/min/max,
+        and sum/avg over integers — float sums re-associate on the MXU
+        grids, so auto-selection skips them; an explicit AggOp
+        key_domain hint still enables the float path). The bound is
+        re-verified at runtime by the operator (ops/agg.py)."""
+        from auron_tpu import config as cfg
+        from auron_tpu.columnar.schema import DataType
+        from auron_tpu.exprs import ir
+        from auron_tpu.exprs.eval import infer_dtype
+        conf = self.ctx.config
+        try:
+            if not conf.get(cfg.KERNELS_ENABLED):
+                return None
+            if (n.mode or "complete") not in ("partial", "complete"):
+                return None
+            if len(group_exprs) != 1 or not isinstance(group_exprs[0],
+                                                       ir.ColumnRef):
+                return None
+            from auron_tpu.kernels.dispatch import DENSE_VALUE_DTYPES
+            schema = child.schema()
+            ints = (DataType.INT8, DataType.INT16, DataType.INT32,
+                    DataType.INT64)
+            for a in aggs:
+                if a.distinct and a.fn not in ("min", "max"):
+                    return None
+                # mirror the runtime dispatch's value-dtype filter so
+                # the stats scan below is never paid for a plan that
+                # falls back at execute time anyway
+                if a.arg is not None and \
+                        infer_dtype(a.arg, schema)[0] not in \
+                        DENSE_VALUE_DTYPES:
+                    return None
+                if a.fn in ("count", "count_star", "min", "max"):
+                    continue
+                if a.fn in ("sum", "avg") and a.arg is not None \
+                        and infer_dtype(a.arg, schema)[0] in ints:
+                    continue
+                return None
+            # walk to a memory scan through schema-preserving nodes
+            node = n.child
+            while True:
+                kind = node.WhichOneof("node")
+                if kind == "filter":
+                    node = node.filter.child
+                elif kind == "coalesce_batches":
+                    node = node.coalesce_batches.child
+                elif kind == "memory_scan":
+                    break
+                else:
+                    return None
+            table = self.ctx.catalog.get(node.memory_scan.table_name)
+            if not isinstance(table, pa.Table):
+                return None
+            idx = group_exprs[0].index
+            if not 0 <= idx < table.num_columns:
+                return None
+            col = table.column(idx)
+            if not pa.types.is_integer(col.type) or col.null_count \
+                    or table.num_rows == 0:
+                return None
+            ckey = (node.memory_scan.table_name, idx)
+            cached = self.ctx._col_stats.get(ckey)
+            if cached is not None and cached[0] is table:
+                stats = cached[1]
+            else:
+                import pyarrow.compute as pc
+                mm = pc.min_max(col)
+                stats = (mm["min"].as_py(), mm["max"].as_py())
+                self.ctx._col_stats[ckey] = (table, stats)
+            lo, hi = stats
+            if lo is None or lo < 0:
+                return None
+            if hi + 1 > conf.get(cfg.KERNELS_MAX_KEY_DOMAIN):
+                return None
+            return int(hi) + 1
+        except Exception:
+            # stats derivation is advisory; a failure here must never
+            # fail planning — the sort path is always correct
+            return None
 
     def _plan_sort(self, n: pb.SortNode) -> PhysicalOp:
         from auron_tpu.ops.sort import SortOp

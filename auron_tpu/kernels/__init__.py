@@ -1,0 +1,15 @@
+"""Hand-written accelerator kernels + the planner's dispatch policy.
+
+Layout:
+- ``grouped_agg``  — dense grouped-aggregation kernels over a bounded
+  key domain: the Pallas VMEM-accumulate sum/count kernel (with an
+  interpret-mode path for CPU verification), the one-hot matmul
+  formulation, and exact scatter reductions.
+- ``dispatch``     — per-plan kernel selection (Pallas vs dense matmul
+  vs the general sort path), keyed on key-domain bound, dtype set, and
+  platform.
+- ``registry``     — kernel capability registry + selection/fallback/
+  interpret/bytes-moved counters.
+"""
+
+from auron_tpu.kernels import dispatch, grouped_agg, registry  # noqa: F401

@@ -1,0 +1,179 @@
+"""Kernel-selection policy for grouped aggregation.
+
+The planner-facing decision point: given what the plan knows about an
+aggregation (key-domain bound, key/value dtypes, aggregate set) and
+what the environment provides (platform, config), pick one of
+
+- ``pallas_vmem``   — the VMEM-accumulate Pallas kernel
+                      (kernels/grouped_agg.pallas_sum_count); native
+                      Mosaic compilation only on a real TPU — real-chip
+                      compiles stay behind bench.py's healthy-window
+                      probe (the TPU-tunnel pitfall: a Mosaic compile
+                      against a wedged client can re-wedge it) — and
+                      the interpreter elsewhere;
+- ``dense_matmul``  — the one-hot einsum formulation (compiles on any
+                      XLA backend);
+- ``sort``          — the general sort-based AggOp path (unbounded
+                      domains, every dtype): the fallback.
+
+Every decision is counted (kernels/registry.py + the per-task
+MetricsSet under the ``kernels`` key) so "which kernel ran and why"
+is answerable from the existing metrics snapshot.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.kernels import grouped_agg, registry
+
+#: aggregate functions the dense-domain path finalizes (ops/agg.py
+#: _DenseDomainState); first/collect/distinct/bloom/udaf stay sort-based
+DENSE_AGG_FNS = frozenset(
+    {"count", "count_star", "sum", "avg", "min", "max"})
+
+#: integer-class key dtypes the (hi, lo) byte decomposition accepts
+DENSE_KEY_DTYPES = frozenset(
+    {DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64})
+
+#: value dtypes with a dense accumulator formulation (floats via the
+#: MXU grids, integers/dates via exact scatter)
+DENSE_VALUE_DTYPES = frozenset(
+    {DataType.INT8, DataType.INT16, DataType.INT32, DataType.INT64,
+     DataType.FLOAT32, DataType.FLOAT64, DataType.DATE32})
+
+#: rough HBM-traffic estimates, bytes per input row (the VMEM kernel
+#: reads k/v/c once: 12 B/row; the matmul path materializes one-hot +
+#: lhs operands in HBM: ~(5*gh + gl)*4 at the full 256x256 grid; the
+#: sort path re-reads rows across hash/sort/segment passes)
+BYTES_PER_ROW = {"pallas_vmem": 12, "dense_matmul": 6144, "sort": 48}
+
+
+@dataclass(frozen=True)
+class KernelDecision:
+    kernel: str              # pallas_vmem | dense_matmul | sort
+    interpret: bool          # pallas interpreter (non-TPU platforms)
+    reason: str              # why this kernel (or why the fallback)
+    bytes_per_row: int       # HBM-traffic estimate for metrics
+
+    @property
+    def is_dense(self) -> bool:
+        return self.kernel != "sort"
+
+
+def _platform(platform: Optional[str]) -> str:
+    if platform is not None:
+        return platform
+    import jax
+    return jax.default_backend()
+
+
+def backend_for_platform(conf=None, platform: Optional[str] = None
+                         ) -> tuple[str, bool]:
+    """(backend, interpret) honoring ``auron.kernels.backend``.
+
+    ``auto`` picks the Pallas kernel natively on a real TPU and the
+    one-hot matmul formulation elsewhere; ``pallas`` forces the Pallas
+    kernel, through the interpreter on non-TPU platforms (how the
+    differential battery runs it under JAX_PLATFORMS=cpu)."""
+    from auron_tpu import config as cfg
+    conf = conf or cfg.get_config()
+    choice = conf.get(cfg.KERNELS_BACKEND)
+    plat = _platform(platform)
+    if choice == "pallas":
+        if not grouped_agg.PALLAS_AVAILABLE:
+            # jax without the experimental pallas package: honor the
+            # intent as closely as possible instead of dispatching to a
+            # kernel whose module handle is None
+            return "dense_matmul", False
+        return "pallas_vmem", plat != "tpu"
+    if choice == "dense":
+        return "dense_matmul", False
+    if choice == "sort":
+        return "sort", False
+    if choice != "auto":
+        raise ValueError(
+            f"auron.kernels.backend: unknown backend {choice!r} "
+            "(auto|pallas|dense|sort)")
+    if plat == "tpu" and grouped_agg.PALLAS_AVAILABLE:
+        return "pallas_vmem", False
+    return "dense_matmul", False
+
+
+def _count(metrics, name: str, v: int = 1) -> None:
+    if metrics is not None:
+        metrics.counter(name).add(v)
+
+
+def record_rows(decision: KernelDecision, rows: int, metrics=None) -> None:
+    """Accumulate the bytes-moved estimate for ``rows`` input rows
+    against the decision's kernel (registry + per-task metrics)."""
+    est = rows * decision.bytes_per_row
+    registry.stats(decision.kernel).add("bytes_moved_est", est)
+    _count(metrics, "bytes_moved_est", est)
+
+
+def select_grouped_agg(*, key_domain: Optional[int], key_dtypes,
+                       agg_fns, value_dtypes, conf=None, metrics=None,
+                       platform: Optional[str] = None,
+                       record: bool = True) -> KernelDecision:
+    """The grouped-agg kernel decision.
+
+    key_domain: exclusive upper bound on the (non-negative) group keys,
+    or None when unbounded. key_dtypes/value_dtypes: DataType per group
+    key / aggregate argument. agg_fns: AccSpec.fn per aggregate.
+    ``metrics``: a MetricsSet (usually ctx.metrics_for("kernels")) that
+    receives selected/fallback/interpret counters alongside the
+    process-global registry stats. ``record=False`` returns the pure
+    policy decision without touching any counter — for callers that
+    override the fallback and account for the kernel they actually run
+    themselves (the flagship lowering)."""
+    from auron_tpu import config as cfg
+    conf = conf or cfg.get_config()
+
+    def fallback(reason: str) -> KernelDecision:
+        if record:
+            registry.stats("sort").add("selected")
+            registry.stats("sort").add("fallback")
+            _count(metrics, "sort_selected")
+            _count(metrics, "fallback")
+        return KernelDecision("sort", False, reason,
+                              BYTES_PER_ROW["sort"])
+
+    if not conf.get(cfg.KERNELS_ENABLED):
+        return fallback("disabled")
+    if key_domain is None:
+        return fallback("unbounded_key_domain")
+    if key_domain <= 0:
+        return fallback("empty_key_domain")
+    if key_domain > min(conf.get(cfg.KERNELS_MAX_KEY_DOMAIN),
+                        grouped_agg.MAX_KEY_DOMAIN):
+        return fallback("key_domain_too_large")
+    kds = tuple(key_dtypes)
+    if len(kds) != 1:
+        # the dense grids decompose ONE integer key as (hi, lo) bytes;
+        # composite keys stay on the sort path
+        return fallback("multi_key" if kds else "no_key")
+    bad = [d for d in kds if d not in DENSE_KEY_DTYPES]
+    if bad:
+        return fallback(f"key_dtype:{bad[0].value}")
+    for fn in agg_fns:
+        if fn not in DENSE_AGG_FNS:
+            return fallback(f"agg_fn:{fn}")
+    for d in value_dtypes:
+        if d not in DENSE_VALUE_DTYPES:
+            return fallback(f"value_dtype:{d.value}")
+
+    backend, interpret = backend_for_platform(conf, platform)
+    if backend == "sort":
+        return fallback("backend_config")
+    if record:
+        registry.stats(backend).add("selected")
+        _count(metrics, f"{backend}_selected")
+        if interpret:
+            registry.stats(backend).add("interpret")
+            _count(metrics, "interpret")
+    return KernelDecision(backend, interpret, "eligible",
+                          BYTES_PER_ROW[backend])

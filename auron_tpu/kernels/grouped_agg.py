@@ -1,0 +1,261 @@
+"""Grouped aggregation over a bounded key domain — the dense kernels.
+
+The engine's general aggregation is sort-based (ops/agg.py) and pays
+O(n log n) VPU work per batch. When the planner can bound the group-key
+domain (table stats, dictionary domains), the aggregation becomes a
+dense accumulation problem with two much cheaper formulations:
+
+``pallas_sum_count``
+    The Pallas VMEM-accumulate kernel (promoted from
+    tools/microbench_pallas.py): per 2048-row block the (hi, lo) one-hot
+    tiles are built IN VMEM, the [hi, lo] sum/count grids accumulate IN
+    VMEM across the whole grid, and HBM traffic collapses to the
+    ~12 B/row inputs. The XLA one-hot formulation materializes
+    [n, 256..1024] one-hot operands in HBM (~4 GB per 1M rows); this
+    kernel is the route from that memory-bound 0.82x to the >=3x bar.
+    ``interpret=True`` runs the same kernel through the Pallas
+    interpreter, so it executes (and is differentially verified) under
+    ``JAX_PLATFORMS=cpu``; real Mosaic compiles happen only when the
+    dispatch policy sees a TPU platform (kernels/dispatch.py).
+
+``dense_matmul_sum_count``
+    The one-hot einsum formulation (the flagship ``_q01_kernel`` math),
+    lax.map-tiled; compiles everywhere XLA runs.
+
+``scatter_reduce``
+    Exact dense-domain scatter (.at[k].add/min/max): the formulation for
+    reductions the MXU can't express (min/max) and for integer sums,
+    where bit-exactness vs the general path is part of the contract.
+
+Accuracy contract (shared with __graft_entry__._q01_kernel): the f32
+value operand is split into 3 additive bf16-exact terms via bit masking,
+so a single DEFAULT-precision bf16 MXU pass reproduces f32-HIGHEST
+quality (~1e-7 rel); counts are 0/1-exact. Sums accumulate in f32 —
+exact whenever inputs are integer-valued and per-key totals stay below
+2^24 (the differential battery exploits this for bit-exact checks);
+callers wanting exact float-independent sums use ``scatter_reduce``.
+
+Key contract: keys must already lie in [0, key_domain) — callers clip
+(the engine additionally tracks the observed key range and fails the
+task with a deterministic ValueError when the planner's bound was
+wrong, ops/agg.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: lane width of the dense grids: keys decompose as (k >> 8, k & 255) so
+#: the minor grid dimension matches the TPU's 256-wide key byte
+_LANES = 256
+
+#: second-minor tile granularity for f32 on TPU — the hi grid dimension
+#: rounds up to this so Mosaic gets well-shaped blocks
+_SUBLANES = 8
+
+#: the hi/lo byte decomposition caps the supported domain
+MAX_KEY_DOMAIN = _LANES * _LANES
+
+
+def grid_dims(key_domain: int) -> tuple[int, int]:
+    """(gh, gl) grid shape covering ``key_domain`` keys: gl is the
+    256-wide lo byte, gh covers the hi byte rounded up to the f32
+    sublane granularity."""
+    if not 0 < key_domain <= MAX_KEY_DOMAIN:
+        raise ValueError(
+            f"key_domain {key_domain} outside (0, {MAX_KEY_DOMAIN}]")
+    gh = -(-key_domain // _LANES)
+    gh = -(-gh // _SUBLANES) * _SUBLANES
+    return gh, _LANES
+
+
+def _mask16(x):
+    """Top-16-bit truncation of f32 via bit masking: exactly
+    bf16-representable, and opaque to XLA's bf16-propagation pass (which
+    folds convert-based f32->bf16->f32 pairs and would collapse a
+    convert-based residual split)."""
+    bits = lax.bitcast_convert_type(x, jnp.uint32)
+    return lax.bitcast_convert_type(bits & jnp.uint32(0xFFFF0000),
+                                    jnp.float32)
+
+
+def _split3(v):
+    """f32 -> 3 additive bf16-exact terms (v == v1 + v2 + v3)."""
+    v1 = _mask16(v)
+    r = v - v1
+    v2 = _mask16(r)
+    return v1, v2, r - v2
+
+
+# ---------------------------------------------------------------------------
+# Pallas VMEM-accumulate kernel
+# ---------------------------------------------------------------------------
+
+def _vmem_agg_kernel(gh, k_ref, v_ref, c_ref, sums_ref, cnts_ref):
+    """One grid step: fold a [1, blk] row block into the VMEM-resident
+    [gh, 256] sum/count grids. The one-hot tiles never leave VMEM."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        cnts_ref[:] = jnp.zeros_like(cnts_ref)
+
+    k = k_ref[:]          # [1, blk] int32 in [0, gh * 256)
+    v = v_ref[:]          # [1, blk] f32, nulls already zeroed
+    c = c_ref[:]          # [1, blk] f32 0/1 valid mask
+    blk = k.shape[1]
+
+    v1, v2, v3 = _split3(v)
+
+    iota_h = lax.broadcasted_iota(jnp.int32, (blk, gh), 1)
+    iota_l = lax.broadcasted_iota(jnp.int32, (blk, _LANES), 1)
+    hi = (k.reshape(blk, 1) >> 8) == iota_h
+    lo = ((k.reshape(blk, 1) & 255) == iota_l).astype(jnp.bfloat16)
+
+    def masked(vals):
+        return jnp.where(hi, vals.reshape(blk, 1), 0.0).astype(jnp.bfloat16)
+
+    lhs = jnp.concatenate(
+        [masked(v1), masked(v2), masked(v3), masked(c)], axis=1)
+    out = lax.dot_general(lhs, lo, (((0,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    sums_ref[:] += out[:gh] + out[gh:2 * gh] + out[2 * gh:3 * gh]
+    cnts_ref[:] += out[3 * gh:]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("key_domain", "blk", "interpret"))
+def pallas_sum_count(k, v, c, key_domain: int, blk: int = 2048,
+                     interpret: bool = False):
+    """Dense grouped (sum, count) over ``key_domain`` keys.
+
+    k: int32[n] in [0, key_domain); v: f32[n] with nulls zeroed;
+    c: f32[n] 0/1 valid mask. n must be a multiple of ``blk`` (batch
+    capacities are power-of-two bucketed, so pass blk=min(blk, n)).
+    Returns (sums f32[key_domain], counts f32[key_domain]).
+    """
+    n = k.shape[0]
+    blk = min(blk, n)
+    if n % blk:
+        raise ValueError(f"rows {n} not a multiple of block {blk}")
+    gh, gl = grid_dims(key_domain)
+    grid = n // blk
+    sums, cnts = pl.pallas_call(
+        functools.partial(_vmem_agg_kernel, gh),
+        out_shape=(jax.ShapeDtypeStruct((gh, gl), jnp.float32),
+                   jax.ShapeDtypeStruct((gh, gl), jnp.float32)),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((1, blk), lambda i: (0, i)),
+                  pl.BlockSpec((1, blk), lambda i: (0, i)),
+                  pl.BlockSpec((1, blk), lambda i: (0, i))],
+        out_specs=(pl.BlockSpec((gh, gl), lambda i: (0, 0)),
+                   pl.BlockSpec((gh, gl), lambda i: (0, 0))),
+        interpret=interpret,
+    )(k.reshape(1, n), v.reshape(1, n), c.reshape(1, n))
+    return sums.reshape(-1)[:key_domain], cnts.reshape(-1)[:key_domain]
+
+
+# ---------------------------------------------------------------------------
+# one-hot matmul formulation (XLA; compiles everywhere)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("key_domain", "block"))
+def dense_matmul_sum_count(k, v, c, key_domain: int, block: int = 1 << 16):
+    """Same contract as ``pallas_sum_count`` via the one-hot einsum
+    formulation: lax.map tiles the one-hots so the HBM working set stays
+    in tens of MB. This is the flagship ``_q01_kernel`` math, shared so
+    the entry point and the engine dispatch one implementation."""
+    n = k.shape[0]
+    block = min(block, n)
+    if n % block:
+        raise ValueError(f"rows {n} not a multiple of block {block}")
+    gh, gl = grid_dims(key_domain)
+    nb = n // block
+    kb = k.reshape(nb, block)
+    vb = v.reshape(nb, block)
+    cb = c.reshape(nb, block)
+
+    def block_grids(inp):
+        kk, vals, cnts = inp
+        hi = jax.nn.one_hot(kk >> 8, gh, dtype=jnp.float32)
+        lo = jax.nn.one_hot(kk & 255, gl, dtype=jnp.float32)
+        v1, v2, v3 = _split3(vals)
+        lhs = jnp.concatenate(
+            [hi * v1[:, None], hi * v2[:, None], hi * v3[:, None],
+             hi * cnts[:, None]], axis=1)
+        out = jnp.einsum("nh,nl->hl", lhs, lo,
+                         precision=lax.Precision.DEFAULT,
+                         preferred_element_type=jnp.float32)
+        sums = out[:gh] + out[gh:2 * gh] + out[2 * gh:3 * gh]
+        return sums, out[3 * gh:]
+
+    sum_blocks, cnt_blocks = lax.map(block_grids, (kb, vb, cb))
+    sums = jnp.sum(sum_blocks, axis=0).reshape(-1)[:key_domain]
+    cnts = jnp.sum(cnt_blocks, axis=0).reshape(-1)[:key_domain]
+    return sums, cnts
+
+
+def sum_count(k, v, c, key_domain: int, backend: str = "dense_matmul",
+              interpret: bool = False, blk: int = 2048):
+    """Backend-dispatched dense grouped (sum, count) — the single entry
+    the engine and the flagship lowering call with a
+    ``kernels.dispatch`` decision."""
+    if backend == "pallas_vmem":
+        return pallas_sum_count(k, v, c, key_domain, blk=blk,
+                                interpret=interpret)
+    if backend == "dense_matmul":
+        return dense_matmul_sum_count(k, v, c, key_domain)
+    raise ValueError(f"unknown dense grouped-agg backend {backend!r}")
+
+
+# ---------------------------------------------------------------------------
+# exact dense-domain scatter reductions
+# ---------------------------------------------------------------------------
+
+def scatter_reduce(kind: str, k, v, valid, key_domain: int, dtype):
+    """Exact dense reduction via XLA scatter — the formulation for
+    reduce kinds the MXU grids can't express (min/max) and for integer
+    sums where bit-exactness is contractual. Traffic is the same
+    ~12 B/row class as the VMEM kernel (inputs + a [domain] accumulator,
+    no one-hot materialization).
+
+    Invalid rows contribute the reduction's neutral; the caller masks
+    group existence separately (a key whose rows are all invalid still
+    returns the neutral here).
+    """
+    if kind == "sum":
+        vals = jnp.where(valid, v.astype(dtype), jnp.asarray(0, dtype))
+        return jnp.zeros(key_domain, dtype).at[k].add(vals, mode="drop")
+    if kind == "count":
+        ones = valid.astype(jnp.int64)
+        return jnp.zeros(key_domain, jnp.int64).at[k].add(ones, mode="drop")
+    if kind in ("min", "max"):
+        if jnp.issubdtype(dtype, jnp.floating):
+            neutral = jnp.asarray(jnp.inf if kind == "min" else -jnp.inf,
+                                  dtype)
+        else:
+            info = jnp.iinfo(dtype)
+            neutral = jnp.asarray(info.max if kind == "min" else info.min,
+                                  dtype)
+        vals = jnp.where(valid, v.astype(dtype), neutral)
+        acc = jnp.full(key_domain, neutral, dtype)
+        if kind == "min":
+            return acc.at[k].min(vals, mode="drop")
+        return acc.at[k].max(vals, mode="drop")
+    raise ValueError(f"unknown scatter reduction {kind!r}")
+
+
+# Pallas imports last so the module loads (and scatter/dense paths work)
+# even if the installed jax lacks the experimental pallas package — the
+# dispatch policy gates pallas selection on PALLAS_AVAILABLE.
+try:  # pragma: no cover - environment probe
+    from jax.experimental import pallas as pl  # noqa: E402
+    PALLAS_AVAILABLE = True
+except Exception:  # pragma: no cover
+    pl = None
+    PALLAS_AVAILABLE = False

@@ -1,0 +1,144 @@
+"""Kernel registry + per-kernel counters.
+
+The engine's hand-written accelerator kernels (the Pallas VMEM
+grouped-agg, the one-hot matmul grids) register here with their
+capability envelope so the dispatch policy (kernels/dispatch.py) can
+reason over data instead of hard-coded if-chains, and so operational
+introspection has one place to ask "which kernels exist, what can they
+do, and how often did each get picked".
+
+Two counter surfaces:
+
+- a process-global ``KernelStats`` per kernel (selected / fallback /
+  interpret counts, bytes-moved estimate) readable via ``snapshot()`` —
+  the long-lived serving view;
+- the per-task ``MetricsSet`` the dispatch call-site passes in, which
+  rides the existing metrics snapshot (ExecutionRuntime.finalize) under
+  the ``kernels`` operator key — the per-query view.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class KernelInfo:
+    """One registered kernel implementation.
+
+    ``name`` is the dispatch identifier; ``reductions`` the reduce kinds
+    it accelerates; ``max_key_domain`` the dense key-domain ceiling its
+    grid decomposition supports (the hi/lo byte split caps at 2^16);
+    ``platforms`` where it compiles natively ('*' = anywhere XLA runs —
+    interpretable kernels additionally run anywhere via interpret mode).
+    """
+
+    name: str
+    description: str
+    reductions: tuple
+    max_key_domain: int
+    platforms: tuple
+    interpretable: bool = False
+
+
+class KernelStats:
+    """Monotonic per-kernel counters (thread-safe adds)."""
+
+    __slots__ = ("selected", "fallback", "interpret", "bytes_moved_est",
+                 "_lock")
+
+    def __init__(self):
+        self.selected = 0
+        self.fallback = 0
+        self.interpret = 0
+        self.bytes_moved_est = 0
+        self._lock = threading.Lock()
+
+    def add(self, name: str, v: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + v)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"selected": self.selected, "fallback": self.fallback,
+                    "interpret": self.interpret,
+                    "bytes_moved_est": self.bytes_moved_est}
+
+
+_REGISTRY: dict[str, KernelInfo] = {}
+_STATS: dict[str, KernelStats] = {}
+_LOCK = threading.Lock()
+
+
+def register(info: KernelInfo) -> KernelInfo:
+    with _LOCK:
+        assert info.name not in _REGISTRY, f"duplicate kernel {info.name}"
+        _REGISTRY[info.name] = info
+        _STATS[info.name] = KernelStats()
+    return info
+
+
+def lookup(name: str) -> Optional[KernelInfo]:
+    return _REGISTRY.get(name)
+
+
+def kernels() -> list[KernelInfo]:
+    return sorted(_REGISTRY.values(), key=lambda k: k.name)
+
+
+def stats(name: str) -> KernelStats:
+    with _LOCK:
+        if name not in _STATS:
+            # fallback pseudo-kernels (e.g. "sort") get counters without
+            # requiring a capability registration
+            _STATS[name] = KernelStats()
+        return _STATS[name]
+
+
+def snapshot() -> dict:
+    """{kernel name: counter dict} — the process-global view."""
+    with _LOCK:
+        items = list(_STATS.items())
+    return {k: s.snapshot() for k, s in items}
+
+
+# ---------------------------------------------------------------------------
+# built-in kernels
+# ---------------------------------------------------------------------------
+
+PALLAS_VMEM = register(KernelInfo(
+    name="pallas_vmem",
+    description=(
+        "Pallas VMEM-accumulate grouped sum/count: one-hot tiles built in "
+        "VMEM per row block, [hi, lo] grids accumulated in VMEM across the "
+        "whole grid — HBM traffic collapses to the ~12 B/row inputs "
+        "(vs ~4 GB/1M rows of one-hot operands in the XLA formulation)."),
+    reductions=("sum", "count"),
+    max_key_domain=1 << 16,
+    platforms=("tpu",),
+    interpretable=True,
+))
+
+DENSE_MATMUL = register(KernelInfo(
+    name="dense_matmul",
+    description=(
+        "One-hot matmul grouped sum/count (einsum('nh,nl->hl') on the "
+        "MXU), lax.map-tiled so the one-hot working set stays in tens of "
+        "MB; the XLA formulation the flagship q01 kernel shipped with."),
+    reductions=("sum", "count"),
+    max_key_domain=1 << 16,
+    platforms=("*",),
+))
+
+SORT_GENERAL = register(KernelInfo(
+    name="sort",
+    description=(
+        "General sort-based grouping (xxhash64 -> stable sort -> segment "
+        "reduce): unbounded key domains, every dtype — the AggOp merge "
+        "kernel (ops/agg.py). The dispatch fallback."),
+    reductions=("sum", "count", "min", "max", "or", "first"),
+    max_key_domain=0,            # unbounded
+    platforms=("*",),
+))

@@ -29,7 +29,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:          # older jax exposes it under experimental
+    from jax.experimental.shard_map import shard_map
 
 
 def make_mesh(num_devices: int | None = None, axis: str = "data") -> Mesh:

@@ -148,6 +148,33 @@ class ExecutionRuntime:
         return snap
 
 
+#: exception classes that are deterministic plan/schema/engine defects:
+#: recomputing the partition cannot succeed, so they surface immediately
+#: (ValueError joined the tuple in round 6 — shape mismatches, invalid
+#: kernel bounds and parse failures are ValueErrors, and retrying them
+#: paid retries+1 full computes with misleading "retrying" logs)
+_NO_RETRY_TYPES = (NotImplementedError, TypeError, AssertionError,
+                   KeyError, IndexError, AttributeError, ValueError)
+
+#: RuntimeError is ambiguous — XLA wraps both transient resource
+#: failures and deterministic lowering/shape defects in it. Message
+#: patterns that identify the deterministic classes (case-insensitive):
+_NO_RETRY_RUNTIME_PATTERNS = (
+    "lowering", "invalid argument", "invalid_argument", "mosaic",
+    "incompatible shapes", "rank mismatch", "unimplemented",
+)
+
+
+def _is_deterministic_failure(e: BaseException) -> bool:
+    """True when re-running the partition is guaranteed to fail again."""
+    if isinstance(e, _NO_RETRY_TYPES):
+        return True
+    if isinstance(e, RuntimeError):
+        msg = str(e).lower()
+        return any(p in msg for p in _NO_RETRY_RUNTIME_PATTERNS)
+    return False
+
+
 def run_task_with_retries(plan: PhysicalOp, partition: int,
                           num_partitions: int, mem_manager=None,
                           config=None) -> pa.Table:
@@ -180,14 +207,14 @@ def run_task_with_retries(plan: PhysicalOp, partition: int,
             return rt.collect()
         except TaskCancelled:
             raise
-        except (NotImplementedError, TypeError, AssertionError,
-                KeyError, IndexError, AttributeError):
-            # deterministic plan/schema/engine defects: recomputing the
-            # partition cannot succeed — surface immediately instead of
-            # paying retries+1 full computes and misleading "retrying"
-            # logs (transient classes — IO, runtime, resource — retry)
-            raise
         except Exception as e:         # noqa: BLE001 — retry boundary
+            # deterministic plan/schema/engine defects (including
+            # shape/lowering RuntimeErrors) surface immediately instead
+            # of paying retries+1 full computes and misleading
+            # "retrying" logs; transient classes — IO, resource,
+            # external-service RuntimeErrors — retry
+            if _is_deterministic_failure(e):
+                raise
             last_err = e
             if attempt >= retries:
                 break

@@ -163,3 +163,25 @@ def test_cast_infinity_string_to_decimal_is_null():
                    ["x"])
     got = collect(op).column("x").to_pylist()
     assert got == [None, None, None, decimal.Decimal("1.25")]
+
+
+def test_precision0_list_decimal_fallback_is_unified():
+    """ADVICE round 5: schema_to_arrow and the HostList child render must
+    share ONE fallback precision for precision-0 list<decimal> fields, or
+    the child array type mismatches the declared schema at assembly."""
+    from auron_tpu.columnar import arrow_bridge as ab
+    from auron_tpu.columnar.schema import DataType, Field, Schema
+    from auron_tpu.columnar.serde import HostList
+
+    f = Field("xs", DataType.LIST, True, 0, 2, elem=DataType.DECIMAL)
+    declared = ab.schema_to_arrow(Schema((f,)))[0].type
+    hc = HostList(np.array([[125, 250]], np.int64),
+                  np.ones((1, 2), bool), np.array([2], np.int32),
+                  np.ones(1, bool))
+    child = ab._host_col_to_arrow(f, hc, 1)
+    assert child.type == declared
+    # and the pair assembles into a table without a type error
+    t = pa.Table.from_arrays([child], schema=pa.schema([
+        pa.field("xs", declared)]))
+    assert t.column("xs").to_pylist() == [[decimal.Decimal("1.25"),
+                                           decimal.Decimal("2.50")]]

@@ -1,0 +1,445 @@
+"""Differential battery for the kernel subsystem (auron_tpu/kernels).
+
+The Pallas VMEM-accumulate grouped-agg kernel runs INTERPRETED here
+(JAX_PLATFORMS=cpu — conftest) and must match the general sort-based
+formulation (__graft_entry__._q01_kernel_sort) and the one-hot matmul
+path bit-exactly on exactly-representable inputs: integer-valued
+measures with per-group totals below 2^24 make every formulation's f32
+accumulation exact, so == comparisons are honest, not tolerance-washed.
+
+Also covered: the dispatch policy's fallback matrix, the dispatch-
+metrics surface in the operator (the planner-chose-the-kernel proof),
+the planner's table-stats key-domain derivation, and the runtime
+verification of the planner's bound.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import __graft_entry__ as graft
+from auron_tpu import config as cfg
+from auron_tpu.columnar.arrow_bridge import schema_from_arrow, to_arrow
+from auron_tpu.columnar.batch import DeviceBatch, PrimitiveColumn
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.exprs import ir
+from auron_tpu.io.parquet import MemoryScanOp
+from auron_tpu.kernels import dispatch, grouped_agg, registry
+from auron_tpu.ops.agg import AggOp
+from auron_tpu.ops.base import ExecContext
+
+C = ir.ColumnRef
+
+
+def _q01_batch(capacity: int, keys, values, valid) -> DeviceBatch:
+    """A flagship-schema batch (k int64, v f64, f int32) with f pinned
+    above the predicate threshold so every live row passes the filter."""
+    f = np.full(capacity, 20, np.int32)
+    return DeviceBatch(
+        columns=(
+            PrimitiveColumn(jnp.asarray(keys.astype(np.int64)),
+                            jnp.ones(capacity, jnp.bool_)),
+            PrimitiveColumn(jnp.asarray(values.astype(np.float64)),
+                            jnp.asarray(valid)),
+            PrimitiveColumn(jnp.asarray(f), jnp.ones(capacity, jnp.bool_)),
+        ),
+        num_rows=jnp.asarray(capacity, jnp.int32),
+    )
+
+
+def _sort_groups(batch) -> dict:
+    gk, gv, gs, gc, ga = jax.jit(graft._q01_kernel_sort)(batch)
+    gk, gv, gs, gc, ga = jax.device_get([gk, gv, gs, gc, ga])
+    return {int(k): (float(s), int(c), float(a))
+            for k, v, s, c, a in zip(gk, gv, gs, gc, ga) if v}
+
+
+def _dense_groups(batch, backend: str) -> dict:
+    conf = cfg.get_config()
+    conf.set(cfg.KERNELS_BACKEND, backend)
+    try:
+        # flagship_kernel() resolves the backend eagerly into a
+        # per-backend function object; jitting _q01_kernel itself would
+        # let the shared trace cache serve the previous backend
+        kern = graft.flagship_kernel()
+        assert (backend == "pallas") == kern.__name__.startswith(
+            "_q01_kernel_pallas")
+        gk, gv, gs, gc, ga = jax.jit(kern)(batch)
+        gk, gv, gs, gc, ga = jax.device_get([gk, gv, gs, gc, ga])
+    finally:
+        conf.unset(cfg.KERNELS_BACKEND)
+    return {int(k): (float(s), int(c), float(a))
+            for k, v, s, c, a in zip(gk, gv, gs, gc, ga) if v}
+
+
+class TestFlagshipDifferential:
+    """Pallas (interpreted) == one-hot matmul == sort formulation,
+    bit-exact, through the actual flagship kernel + dispatch wiring."""
+
+    def _case(self, capacity, keys, values, valid):
+        batch = _q01_batch(capacity, keys, values, valid)
+        want = _sort_groups(batch)
+        got_pallas = _dense_groups(batch, "pallas")
+        got_dense = _dense_groups(batch, "dense")
+        assert got_pallas == want
+        assert got_dense == want
+        return want
+
+    def test_random_keys_with_nulls(self):
+        rng = np.random.default_rng(7)
+        cap = 4096
+        keys = rng.integers(0, 3000, cap)
+        values = rng.integers(-100, 100, cap).astype(np.float64)
+        valid = rng.random(cap) > 0.15
+        want = self._case(cap, keys, values, valid)
+        assert len(want) > 100
+
+    def test_empty_partition(self):
+        cap = 2048
+        batch = DeviceBatch(
+            columns=(
+                PrimitiveColumn(jnp.zeros(cap, jnp.int64),
+                                jnp.ones(cap, jnp.bool_)),
+                PrimitiveColumn(jnp.zeros(cap, jnp.float64),
+                                jnp.ones(cap, jnp.bool_)),
+                PrimitiveColumn(jnp.zeros(cap, jnp.int32),
+                                jnp.ones(cap, jnp.bool_)),
+            ),
+            num_rows=jnp.asarray(0, jnp.int32),
+        )
+        assert _sort_groups(batch) == {}
+        assert _dense_groups(batch, "pallas") == {}
+        assert _dense_groups(batch, "dense") == {}
+
+    def test_single_group(self):
+        cap = 2048
+        keys = np.full(cap, 37)
+        values = np.arange(cap, dtype=np.float64)
+        want = self._case(cap, keys, values, np.ones(cap, bool))
+        assert list(want) == [37]
+        assert want[37][1] == cap
+
+    def test_full_domain(self):
+        # every key of the 2^16 domain appears exactly once
+        cap = grouped_agg.MAX_KEY_DOMAIN
+        keys = np.arange(cap)
+        values = (keys % 97).astype(np.float64)
+        want = self._case(cap, keys, values, np.ones(cap, bool))
+        assert len(want) == cap
+
+
+class TestGroupedAggPrimitives:
+    def test_pallas_matches_numpy_float(self):
+        """Non-integer values: the masked 3-term split holds ~1e-7 rel
+        vs an f64 numpy oracle (the microbench accuracy contract)."""
+        rng = np.random.default_rng(0)
+        n, dom = 8192, 1 << 12
+        k = jnp.asarray(rng.integers(0, dom, n).astype(np.int32))
+        c = jnp.asarray((rng.random(n) > 0.05).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=n).astype(np.float32)) * c
+        s, cn = grouped_agg.pallas_sum_count(k, v, c, dom, interpret=True)
+        rs = np.zeros(dom)
+        np.add.at(rs, np.asarray(k), np.asarray(v, np.float64))
+        rc = np.zeros(dom)
+        np.add.at(rc, np.asarray(k), np.asarray(c, np.float64))
+        rel = (np.max(np.abs(np.asarray(s, np.float64) - rs))
+               / np.max(np.abs(rs)))
+        assert rel < 1e-6
+        np.testing.assert_array_equal(np.asarray(cn, np.float64), rc)
+
+    def test_scatter_reduce_kinds(self):
+        k = jnp.asarray(np.array([0, 1, 1, 2, 2, 2], np.int32))
+        v = jnp.asarray(np.array([5, -3, 7, 1, 2, 9], np.int64))
+        valid = jnp.asarray(np.array([1, 1, 1, 1, 0, 1], bool))
+        dom = 4
+        s = grouped_agg.scatter_reduce("sum", k, v, valid, dom, jnp.int64)
+        assert list(np.asarray(s)) == [5, 4, 10, 0]
+        mn = grouped_agg.scatter_reduce("min", k, v, valid, dom, jnp.int64)
+        assert list(np.asarray(mn))[:3] == [5, -3, 1]
+        mx = grouped_agg.scatter_reduce("max", k, v, valid, dom, jnp.int64)
+        assert list(np.asarray(mx))[:3] == [5, 7, 9]
+        c = grouped_agg.scatter_reduce("count", k, None, valid, dom,
+                                       jnp.int64)
+        assert list(np.asarray(c)) == [1, 2, 2, 0]
+
+    def test_grid_dims(self):
+        assert grouped_agg.grid_dims(1 << 16) == (256, 256)
+        assert grouped_agg.grid_dims(1000) == (8, 256)
+        assert grouped_agg.grid_dims(1) == (8, 256)
+        with pytest.raises(ValueError):
+            grouped_agg.grid_dims((1 << 16) + 1)
+
+
+class TestDispatchPolicy:
+    INT = (DataType.INT64,)
+    F64 = (DataType.FLOAT64,)
+
+    def _select(self, conf=None, **kw):
+        args = dict(key_domain=1 << 12, key_dtypes=self.INT,
+                    agg_fns=("sum", "count"), value_dtypes=self.F64,
+                    conf=conf or cfg.AuronConfig(), platform="cpu")
+        args.update(kw)
+        return dispatch.select_grouped_agg(**args)
+
+    def test_eligible_on_cpu_is_dense_matmul(self):
+        d = self._select()
+        assert (d.kernel, d.interpret) == ("dense_matmul", False)
+        assert d.is_dense
+
+    def test_unbounded_keys_fall_back(self):
+        d = self._select(key_domain=None)
+        assert (d.kernel, d.reason) == ("sort", "unbounded_key_domain")
+
+    def test_disabled_flag_falls_back(self):
+        conf = cfg.AuronConfig({cfg.KERNELS_ENABLED: False})
+        assert self._select(conf=conf).reason == "disabled"
+
+    def test_string_values_fall_back(self):
+        d = self._select(agg_fns=("min",),
+                         value_dtypes=(DataType.STRING,))
+        assert d.reason == "value_dtype:string"
+
+    def test_string_key_falls_back(self):
+        d = self._select(key_dtypes=(DataType.STRING,))
+        assert d.reason == "key_dtype:string"
+
+    def test_domain_above_cap_falls_back(self):
+        conf = cfg.AuronConfig({cfg.KERNELS_MAX_KEY_DOMAIN: 1 << 10})
+        d = self._select(conf=conf, key_domain=1 << 12)
+        assert d.reason == "key_domain_too_large"
+        # the hi/lo byte grid hard-caps at 2^16 regardless of config
+        d = self._select(key_domain=(1 << 16) + 1)
+        assert d.reason == "key_domain_too_large"
+
+    def test_multi_key_falls_back(self):
+        d = self._select(key_dtypes=(DataType.INT64, DataType.INT32))
+        assert d.reason == "multi_key"
+
+    def test_unsupported_agg_falls_back(self):
+        d = self._select(agg_fns=("collect_list",))
+        assert d.reason == "agg_fn:collect_list"
+
+    def test_pallas_backend_interprets_off_tpu(self):
+        conf = cfg.AuronConfig({cfg.KERNELS_BACKEND: "pallas"})
+        d = self._select(conf=conf)
+        assert (d.kernel, d.interpret) == ("pallas_vmem", True)
+
+    def test_auto_prefers_pallas_on_tpu(self):
+        d = self._select(platform="tpu")
+        assert (d.kernel, d.interpret) == ("pallas_vmem", False)
+
+
+def _mem_scan(rbs, capacity=64):
+    if not isinstance(rbs, list):
+        rbs = [rbs]
+    return MemoryScanOp([rbs], schema_from_arrow(rbs[0].schema),
+                        capacity=capacity)
+
+
+def _agg_table(op, ctx=None) -> pa.Table:
+    ctx = ctx or ExecContext()
+    batches = [to_arrow(b, op.schema()) for b in op.execute(0, ctx)
+               if int(b.num_rows)]
+    if not batches:
+        from auron_tpu.columnar.arrow_bridge import schema_to_arrow
+        return schema_to_arrow(op.schema()).empty_table()
+    return pa.concat_tables(
+        pa.Table.from_batches([b]) for b in batches).combine_chunks()
+
+
+def _rows_by_key(t: pa.Table) -> dict:
+    names = t.column_names
+    return {r[names[0]]: tuple(r[n] for n in names[1:])
+            for r in t.to_pylist()}
+
+
+class TestAggOpDenseDomain:
+    """AggOp with a key_domain hint == the sort path, across dtypes and
+    backends, with the dispatch-metrics assertion of the acceptance
+    criteria."""
+
+    AGGS = [ir.AggFunction("sum", C(1)), ir.AggFunction("count", C(1)),
+            ir.AggFunction("avg", C(1)), ir.AggFunction("min", C(1)),
+            ir.AggFunction("max", C(1)),
+            ir.AggFunction("count_star", None)]
+    NAMES = ["s", "c", "a", "mn", "mx", "cs"]
+
+    def _rbs(self, value_type, n=200, km=41, seed=3):
+        rng = np.random.default_rng(seed)
+        k = rng.integers(0, km, n)
+        v = rng.integers(-50, 50, n)
+        vm = rng.random(n) > 0.2
+        out = []
+        for i in range(0, n, 64):
+            out.append(pa.record_batch({
+                "k": pa.array(k[i:i + 64], pa.int64()),
+                "v": pa.array(v[i:i + 64], value_type,
+                              mask=~vm[i:i + 64])}))
+        return out
+
+    @pytest.mark.parametrize("vt", [pa.int32(), pa.int64(), pa.float32(),
+                                    pa.float64()])
+    @pytest.mark.parametrize("backend", ["dense", "pallas"])
+    def test_matches_sort_path_across_dtypes(self, vt, backend):
+        rbs = self._rbs(vt)
+        conf = cfg.AuronConfig({cfg.KERNELS_BACKEND: backend})
+        dense = AggOp(_mem_scan(rbs), [C(0)], self.AGGS, mode="complete",
+                      group_names=["k"], agg_names=self.NAMES,
+                      key_domain=64)
+        got = _rows_by_key(_agg_table(dense, ExecContext(config=conf)))
+        general = AggOp(_mem_scan(rbs), [C(0)], self.AGGS,
+                        mode="complete", group_names=["k"],
+                        agg_names=self.NAMES)
+        want = _rows_by_key(_agg_table(general))
+        assert got == want
+
+    def test_dispatch_metrics_recorded(self):
+        """The acceptance-criteria assertion: eligible dense aggregations
+        route through kernels.dispatch, visible in the metrics
+        snapshot."""
+        rbs = self._rbs(pa.int64())
+        op = AggOp(_mem_scan(rbs), [C(0)], self.AGGS, mode="complete",
+                   group_names=["k"], agg_names=self.NAMES,
+                   key_domain=64)
+        ctx = ExecContext()
+        list(op.execute(0, ctx))
+        snap = ctx.metrics["kernels"].snapshot()
+        assert (snap.get("dense_matmul_selected", 0)
+                + snap.get("pallas_vmem_selected", 0)) == 1
+        assert snap.get("bytes_moved_est", 0) > 0
+        # and the process-global registry saw it too
+        total = registry.snapshot()
+        assert (total["dense_matmul"]["selected"]
+                + total["pallas_vmem"]["selected"]) >= 1
+
+    def test_disabled_flag_uses_sort_path(self):
+        rbs = self._rbs(pa.int64())
+        conf = cfg.AuronConfig({cfg.KERNELS_ENABLED: False})
+        op = AggOp(_mem_scan(rbs), [C(0)], self.AGGS, mode="complete",
+                   group_names=["k"], agg_names=self.NAMES,
+                   key_domain=64)
+        ctx = ExecContext(config=conf)
+        got = _rows_by_key(_agg_table(op, ctx))
+        snap = ctx.metrics["kernels"].snapshot()
+        assert snap.get("fallback", 0) == 1
+        general = AggOp(_mem_scan(rbs), [C(0)], self.AGGS,
+                        mode="complete", group_names=["k"],
+                        agg_names=self.NAMES)
+        assert got == _rows_by_key(_agg_table(general))
+
+    def test_partial_then_final_matches(self):
+        rbs = self._rbs(pa.int64())
+        part = AggOp(_mem_scan(rbs), [C(0)], self.AGGS, mode="partial",
+                     group_names=["k"], agg_names=self.NAMES,
+                     key_domain=64)
+        t = _agg_table(part)
+        rb = t.to_batches()[0]
+        fin = AggOp(_mem_scan(rb, capacity=128), [C(0)],
+                    [ir.AggFunction(a.fn, None) for a in self.AGGS],
+                    mode="final", group_names=["k"],
+                    agg_names=self.NAMES)
+        got = _rows_by_key(_agg_table(fin))
+        general = AggOp(_mem_scan(rbs), [C(0)], self.AGGS,
+                        mode="complete", group_names=["k"],
+                        agg_names=self.NAMES)
+        assert got == _rows_by_key(_agg_table(general))
+
+    def test_empty_input_yields_no_groups(self):
+        rb = pa.record_batch({"k": pa.array([], pa.int64()),
+                              "v": pa.array([], pa.int64())})
+        op = AggOp(_mem_scan(rb), [C(0)], self.AGGS, mode="complete",
+                   group_names=["k"], agg_names=self.NAMES,
+                   key_domain=64)
+        assert _agg_table(op).num_rows == 0
+
+    def test_single_group_full_column(self):
+        rb = pa.record_batch({"k": pa.array([5] * 64, pa.int64()),
+                              "v": pa.array(list(range(64)), pa.int64())})
+        op = AggOp(_mem_scan(rb), [C(0)], self.AGGS, mode="complete",
+                   group_names=["k"], agg_names=self.NAMES,
+                   key_domain=8)
+        got = _rows_by_key(_agg_table(op))
+        assert got == {5: (2016, 64, 31.5, 0, 63, 64)}
+
+    def test_violated_bound_is_deterministic_valueerror(self):
+        """The planner's bound is a promise; a violation must fail the
+        task (ValueError — the executor's no-retry class), not silently
+        mis-aggregate via the clip guard."""
+        rb = pa.record_batch({"k": pa.array([1, 2, 99], pa.int64()),
+                              "v": pa.array([1, 2, 3], pa.int64())})
+        op = AggOp(_mem_scan(rb, capacity=16), [C(0)],
+                   [ir.AggFunction("sum", C(1))], mode="complete",
+                   group_names=["k"], agg_names=["s"], key_domain=8)
+        with pytest.raises(ValueError, match="key_domain"):
+            list(op.execute(0, ExecContext()))
+
+    def test_null_keys_violate_bound(self):
+        rb = pa.record_batch({"k": pa.array([1, None, 2], pa.int64()),
+                              "v": pa.array([1, 2, 3], pa.int64())})
+        op = AggOp(_mem_scan(rb, capacity=16), [C(0)],
+                   [ir.AggFunction("sum", C(1))], mode="complete",
+                   group_names=["k"], agg_names=["s"], key_domain=8)
+        with pytest.raises(ValueError, match="NULL group keys"):
+            list(op.execute(0, ExecContext()))
+
+
+class TestPlannerKeyDomain:
+    """The planner derives the key-domain bound from memory-table stats
+    (exact-only aggregate sets) — the 'planner, not a tool script,
+    chooses the kernel' wiring."""
+
+    def _run(self, table, agg_cols, expect_dense: bool):
+        from auron_tpu.frontend import Session, col, functions as F
+        s = Session(batch_capacity=64)
+        df = s.from_arrow(table, "t")
+        before = registry.snapshot()
+        aggs = [getattr(F, fn)(col(c)).alias(f"{fn}_{c}")
+                for fn, c in agg_cols]
+        out = df.group_by("k").agg(*aggs).collect()
+        after = registry.snapshot()
+        dense_delta = sum(
+            after[n]["selected"] - before.get(n, {}).get("selected", 0)
+            for n in ("dense_matmul", "pallas_vmem"))
+        assert (dense_delta >= 1) == expect_dense, (dense_delta, after)
+        return out
+
+    def test_int_aggs_over_memory_table_go_dense(self):
+        rng = np.random.default_rng(5)
+        n = 300
+        t = pa.table({
+            "k": pa.array(rng.integers(0, 50, n), pa.int64()),
+            "v": pa.array(rng.integers(0, 100, n), pa.int64())})
+        out = self._run(t, [("sum", "v"), ("count", "v"), ("min", "v")],
+                        expect_dense=True)
+        exp = {}
+        for k, v in zip(t["k"].to_pylist(), t["v"].to_pylist()):
+            e = exp.setdefault(k, [0, 0, None])
+            e[0] += v
+            e[1] += 1
+            e[2] = v if e[2] is None else min(e[2], v)
+        got = {r["k"]: (r["sum_v"], r["count_v"], r["min_v"])
+               for r in out.to_pylist()}
+        assert got == {k: tuple(v) for k, v in exp.items()}
+
+    def test_float_sum_stays_exact_sort_path(self):
+        # float sums re-associate on the MXU grids; planner-auto
+        # selection skips them so planner-chosen plans stay bit-identical
+        t = pa.table({"k": pa.array([1, 2, 1], pa.int64()),
+                      "v": pa.array([0.5, 1.5, 2.5], pa.float64())})
+        self._run(t, [("sum", "v")], expect_dense=False)
+
+    def test_nullable_or_negative_keys_stay_sort_path(self):
+        t = pa.table({"k": pa.array([1, None, 2], pa.int64()),
+                      "v": pa.array([1, 2, 3], pa.int64())})
+        self._run(t, [("sum", "v")], expect_dense=False)
+        t2 = pa.table({"k": pa.array([-1, 0, 2], pa.int64()),
+                       "v": pa.array([1, 2, 3], pa.int64())})
+        self._run(t2, [("sum", "v")], expect_dense=False)
+
+    def test_domain_above_config_cap_stays_sort_path(self):
+        t = pa.table({"k": pa.array([0, 1 << 20], pa.int64()),
+                      "v": pa.array([1, 2], pa.int64())})
+        self._run(t, [("sum", "v")], expect_dense=False)

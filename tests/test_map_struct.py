@@ -459,18 +459,37 @@ class TestEntryLists:
         assert out.column("m").to_pylist() == [[(1, 5), (2, None)],
                                                [(9, 9)]]
 
-    def test_null_entries_and_null_keys_fail_fast(self):
-        t = self._ENTRY_T
-        with pytest.raises(NotImplementedError, match="NULL entry"):
-            to_device(pa.record_batch(
-                {"e": pa.array([[{"key": 1, "value": 1}, None]], t)}),
-                capacity=4)
+    def test_null_entries_render_as_null_rows(self):
+        """Golden vector: a row containing a NULL entry struct renders
+        as a NULL row — the reference's map_from_entries semantics
+        ('null array entry => null', spark_map.rs) — instead of being
+        rejected (ADVICE round 5)."""
+        t2 = pa.list_(pa.struct([pa.field("key", pa.int64()),
+                                 pa.field("value", pa.int64())]))
+        rows = [[{"key": 1, "value": 10}, None],       # null entry
+                [{"key": 2, "value": 20}],             # clean row
+                None,                                  # already-null row
+                [],                                    # empty row
+                [None, None]]                          # all-null entries
+        rb = pa.record_batch({"e": pa.array(rows, t2)})
+        batch, schema = to_device(rb, capacity=8)
+        got = to_arrow(batch, schema).column("e").to_pylist()
+        assert got == [None, [{"key": 2, "value": 20}], None, [], None]
+
+    def test_null_key_in_live_entry_fails_fast(self):
         t2 = pa.list_(pa.struct([pa.field("key", pa.int64()),
                                  pa.field("value", pa.int64())]))
         with pytest.raises(NotImplementedError, match="NULL key"):
             to_device(pa.record_batch(
                 {"e": pa.array([[{"key": None, "value": 1}]], t2)}),
                 capacity=4)
+        # ...but a null key inside a DEAD entry (null struct) is fine:
+        # the whole row renders as NULL and the key has no slot
+        rb = pa.record_batch({"e": pa.array(
+            [[None], [{"key": 3, "value": 4}]], t2)})
+        batch, schema = to_device(rb, capacity=4)
+        got = to_arrow(batch, schema).column("e").to_pylist()
+        assert got == [None, [{"key": 3, "value": 4}]]
 
     def test_three_field_struct_rejected(self):
         t = pa.list_(pa.struct([pa.field("a", pa.int64()),

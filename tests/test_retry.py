@@ -70,6 +70,41 @@ def test_zero_retries_fail_fast():
     assert op.attempts == 1
 
 
+def test_deterministic_valueerror_not_retried():
+    """A ValueError is a deterministic engine/plan defect (shape
+    mismatch, violated kernel bound): recomputing cannot succeed, so it
+    surfaces on the first attempt (ADVICE round 5)."""
+    op = FlakyOp(_scan(), failures=10, exc=ValueError)
+    conf = cfg.AuronConfig().set(cfg.TASK_MAX_RETRIES, 3)
+    with pytest.raises(ValueError):
+        run_task_with_retries(op, 0, 1, config=conf)
+    assert op.attempts == 1
+
+
+def test_deterministic_runtimeerror_patterns_not_retried():
+    """RuntimeErrors carrying shape/lowering signatures are XLA's
+    deterministic-defect class and must not retry."""
+    def exc(msg):
+        return RuntimeError("Mosaic lowering failed: unsupported op")
+    op = FlakyOp(_scan(), failures=10, exc=exc)
+    conf = cfg.AuronConfig().set(cfg.TASK_MAX_RETRIES, 3)
+    with pytest.raises(RuntimeError, match="lowering"):
+        run_task_with_retries(op, 0, 1, config=conf)
+    assert op.attempts == 1
+
+
+def test_transient_runtimeerror_still_retried():
+    """Plain RuntimeErrors (external services, resource blips) keep
+    retrying — only the deterministic message patterns are excluded."""
+    def exc(msg):
+        return RuntimeError("connection reset by peer")
+    op = FlakyOp(_scan(), failures=1, exc=exc)
+    conf = cfg.AuronConfig().set(cfg.TASK_MAX_RETRIES, 2)
+    out = run_task_with_retries(op, 0, 1, config=conf)
+    assert out.column("x").to_pylist() == [1, 2, 3, 4]
+    assert op.attempts == 2
+
+
 def test_cancellation_not_retried():
     op = FlakyOp(_scan(), failures=10, exc=lambda msg: TaskCancelled())
     conf = cfg.AuronConfig().set(cfg.TASK_MAX_RETRIES, 3)

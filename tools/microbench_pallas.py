@@ -1,108 +1,64 @@
 """Pallas grouped-aggregate kernel microbench.
 
-The XLA formulations of the dense 2^16-domain group-aggregate are bound by
-materializing [n, 512..1024] one-hot operands in HBM (~4 GB per 1M rows).
-This kernel builds the one-hot tiles in VMEM per 2048-row block and
-accumulates the [hi, lo] grids in VMEM across the whole grid — HBM traffic
-collapses to the 12 B/row inputs.
+The kernel itself now lives in the engine — auron_tpu/kernels/
+grouped_agg.py ``pallas_sum_count`` (promoted from this script's round-5
+prototype), selected per-plan by kernels/dispatch.py. This script keeps
+the standalone measurement harness: block-size sweep, chained-dependency
+timing (honest on the tunneled platform, where block_until_ready returns
+early), and an f64 numpy accuracy cross-check.
 
-Accuracy: the value operand is split into 3 additive bf16-exact terms via
-bit-masking (f32 = 3 bf16 mantissa windows), so the single DEFAULT-precision
-bf16 MXU pass reproduces f32-HIGHEST quality (~1e-7 rel).
+The XLA formulations of the dense 2^16-domain group-aggregate are bound
+by materializing [n, 512..1024] one-hot operands in HBM (~4 GB per 1M
+rows). The VMEM kernel builds the one-hot tiles in VMEM per row block
+and accumulates the [hi, lo] grids in VMEM across the whole grid — HBM
+traffic collapses to the 12 B/row inputs.
 """
 
 from __future__ import annotations
 
-import functools
+import os
+import sys
 import time
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-_GRID = 256
-_DOMAIN = _GRID * _GRID
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
+from auron_tpu.kernels.grouped_agg import (MAX_KEY_DOMAIN,  # noqa: E402
+                                           pallas_sum_count)
 
-def _mask16(x):
-    bits = lax.bitcast_convert_type(x, jnp.uint32)
-    return lax.bitcast_convert_type(bits & jnp.uint32(0xFFFF0000),
-                                    jnp.float32)
-
-
-def _agg_kernel(k_ref, v_ref, c_ref, sums_ref, cnts_ref):
-    step = pl.program_id(0)
-
-    @pl.when(step == 0)
-    def _init():
-        sums_ref[:] = jnp.zeros_like(sums_ref)
-        cnts_ref[:] = jnp.zeros_like(cnts_ref)
-
-    k = k_ref[:]          # [1, BLK] int32 in [0, 2^16)
-    v = v_ref[:]          # [1, BLK] f32, nulls already zeroed
-    c = c_ref[:]          # [1, BLK] f32 0/1 count mask
-    blk = k.shape[1]
-
-    v1 = _mask16(v)
-    r = v - v1
-    v2 = _mask16(r)
-    v3 = r - v2
-
-    iota = lax.broadcasted_iota(jnp.int32, (blk, _GRID), 1)
-    hi = (k.reshape(blk, 1) >> 8) == iota
-    lo = ((k.reshape(blk, 1) & 255) == iota).astype(jnp.bfloat16)
-
-    def masked(vals):
-        return jnp.where(hi, vals.reshape(blk, 1), 0.0).astype(jnp.bfloat16)
-
-    lhs = jnp.concatenate(
-        [masked(v1), masked(v2), masked(v3), masked(c)], axis=1)
-    out = lax.dot_general(lhs, lo, (((0,), (0,)), ((), ())),
-                          preferred_element_type=jnp.float32)
-    sums_ref[:] += out[:_GRID] + out[_GRID:2 * _GRID] + out[2 * _GRID:3 * _GRID]
-    cnts_ref[:] += out[3 * _GRID:]
-
-
-@functools.partial(jax.jit, static_argnames=("blk",))
-def pallas_agg(k, v, c, blk=2048):
-    n = k.shape[0]
-    grid = n // blk
-    return pl.pallas_call(
-        _agg_kernel,
-        out_shape=(jax.ShapeDtypeStruct((_GRID, _GRID), jnp.float32),
-                   jax.ShapeDtypeStruct((_GRID, _GRID), jnp.float32)),
-        grid=(grid,),
-        in_specs=[pl.BlockSpec((1, blk), lambda i: (0, i)),
-                  pl.BlockSpec((1, blk), lambda i: (0, i)),
-                  pl.BlockSpec((1, blk), lambda i: (0, i))],
-        out_specs=(pl.BlockSpec((_GRID, _GRID), lambda i: (0, 0)),
-                   pl.BlockSpec((_GRID, _GRID), lambda i: (0, 0))),
-    )(k.reshape(1, n), v.reshape(1, n), c.reshape(1, n))
+_DOMAIN = MAX_KEY_DOMAIN
 
 
 def main():
     print("devices:", jax.devices())
+    interpret = jax.default_backend() != "tpu"
+    if interpret:
+        print("non-TPU backend: running the kernel INTERPRETED "
+              "(correctness sweep only, timings are meaningless)")
     rng = np.random.default_rng(0)
-    n = 1 << 20
-    iters = 20
+    n = 1 << (14 if interpret else 20)
+    iters = 2 if interpret else 20
     k0 = jnp.asarray(rng.integers(0, _DOMAIN, size=n).astype(np.int32))
     c0 = jnp.asarray((rng.random(n) > 0.05).astype(np.float32))
     # v arrives pre-masked (nulls zeroed), as in the engine kernel
     v0 = jnp.asarray(rng.normal(size=n).astype(np.float32)) * c0
 
     for blk in (1024, 2048, 4096, 8192):
-        f = lambda k, v, c: pallas_agg(k, v, c, blk=blk)
+        def f(k, v, c, _blk=blk):
+            return pallas_sum_count(k, v, c, _DOMAIN, blk=_blk,
+                                    interpret=interpret)
         s, cn = f(k0, v0, c0)
         s.block_until_ready()
         # chained timing: output scalar feeds next input, defeating any
         # async/dedup effects; final host readback is the sync point
         def step(v):
             s, cn = f(k0, v, c0)
-            return v + s[0, 0] * 1e-30
+            return v + s[0] * 1e-30
         st = jax.jit(step)
         v = st(v0)
         _ = float(jnp.sum(v))
@@ -119,9 +75,9 @@ def main():
         np.add.at(rs, kk, vv)
         rc = np.zeros(_DOMAIN)
         np.add.at(rc, kk, np.asarray(c0, np.float64))
-        serr = (np.max(np.abs(np.asarray(s, np.float64).reshape(-1) - rs))
+        serr = (np.max(np.abs(np.asarray(s, np.float64) - rs))
                 / np.max(np.abs(rs)))
-        cerr = np.max(np.abs(np.asarray(cn, np.float64).reshape(-1) - rc))
+        cerr = np.max(np.abs(np.asarray(cn, np.float64) - rc))
         print(f"pallas blk={blk:5d} {dt*1e3:8.3f} ms "
               f"{n/dt/1e6:9.1f} M rows/s rel={serr:.2e} cnt={cerr:.1f}")
 
