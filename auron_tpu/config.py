@@ -108,11 +108,15 @@ from auron_tpu.utils.compile_stats import DEFAULT_MAX_LIVE_PROGRAMS
 
 MAX_LIVE_PROGRAMS = _opt(
     "auron.max_live_programs", int, DEFAULT_MAX_LIVE_PROGRAMS,
-    "Clear jax's compilation caches after this many XLA programs build "
-    "since the last clear (utils/compile_stats.maybe_clear — the CPU "
-    "backend's JIT can segfault once several hundred programs accumulate "
-    "in one long-lived process). Checked only at quiescent boundaries "
-    "(between serving tasks / runner queries); <= 0 disables.")
+    "Ceiling on live compiled programs per process, enforced through the "
+    "central program-cache registry (runtime/programs.py): every kernel "
+    "builder registers its cache there, and when either the registry's "
+    "live-program count or the raw backend compiles since the last clear "
+    "reach this value, utils/compile_stats.maybe_clear drops BOTH jax's "
+    "compiled caches and the builder memos (the CPU backend's JIT can "
+    "segfault once several hundred programs accumulate in one long-lived "
+    "process). Checked only at quiescent boundaries (between serving "
+    "tasks / runner queries); <= 0 disables.")
 
 # compile-budget diet: persistent XLA compilation cache
 XLA_CACHE_DIR = _opt(
@@ -180,6 +184,29 @@ AGG_PARTIAL_SKIP_RATIO = _opt(
 AGG_PARTIAL_SKIP_MIN_ROWS = _opt(
     "auron.agg.partial_skip.min_rows", int, 1 << 16,
     "Input rows to observe before the skip decision is made.")
+
+# whole-stage fusion (ir/planner.fuse_stages + ops/fused.py)
+FUSION_ENABLED = _opt(
+    "auron.fusion.enabled", bool, True,
+    "Whole-stage XLA fusion: the planner chains maximal runs of "
+    "row-local operators (filter, project, expand, limit-within-batch, "
+    "rename — plus the shuffle-split and hash-join-probe prologues) "
+    "into one jit-compiled program per stage, so intermediates never "
+    "materialize in HBM and the compile budget pays one program per "
+    "chain instead of one per operator. Off executes every operator as "
+    "its own program. The plan NORMALIZATION half of the pass (pre-agg "
+    "key/value projection, pure-projection elision under aggs) applies "
+    "under BOTH settings — that is what keeps on/off results "
+    "bit-identical (eager vs jitted float arithmetic differs in the "
+    "last ulp), so 'off' restores the per-operator program layout, not "
+    "the exact pre-fusion plan shape.")
+FUSION_MAX_STAGE_OPS = _opt(
+    "auron.fusion.max_stage_ops", int, 8,
+    "Longest operator chain a single fused stage may contain. Longer "
+    "chains split into multiple stages — a bound on per-program trace "
+    "size and compile time (an over-long chain compiles one huge XLA "
+    "program whose build cost defeats the purpose on the tunneled "
+    "chip).")
 
 # hand-written kernels (auron_tpu/kernels)
 KERNELS_ENABLED = _opt(

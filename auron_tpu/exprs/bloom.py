@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from auron_tpu.ops import hashing
+from auron_tpu.runtime.programs import program_cache
 
 _M1 = np.uint32(0xCC9E2D51)
 _M2 = np.uint32(0x1B873593)
@@ -149,7 +150,7 @@ class SparkBloomFilter:
 # device probe kernel
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=64)
+@program_cache("exprs.bloom.probe", maxsize=64)
 def _probe_kernel(num_hash_functions: int, bit_size: int):
     k = num_hash_functions
 

@@ -205,6 +205,9 @@ class DeviceBatchScanOp(PhysicalOp):
     """Source over already-device-resident batches (shuffle-read side)."""
 
     name = "device_scan"
+    #: replays stored batches (broadcast builds, resource maps) that
+    #: later readers share — consumers must never donate them
+    owns_output = False
 
     def __init__(self, partitions, schema: Schema):
         self.partitions = partitions  # list[list[DeviceBatch]] or callable

@@ -71,10 +71,16 @@ class PhysicalPlanner:
         if _collect_subqueries(task.plan):
             # resolve every uncorrelated scalar subquery in the tree ONCE
             # at task start, then re-plan with literals substituted
-            # (reference: spark_scalar_subquery_wrapper.rs role)
+            # (reference: spark_scalar_subquery_wrapper.rs role); the
+            # binder applies the stage-fusion pass after substitution
             from auron_tpu.ops.subquery import ScalarSubqueryBinderOp
             return ScalarSubqueryBinderOp(task.plan, self.ctx)
-        return self.create_plan(task.plan)
+        return self.finalize_plan(self.create_plan(task.plan))
+
+    def finalize_plan(self, op: PhysicalOp) -> PhysicalOp:
+        """Post-planning passes over the materialized operator tree —
+        currently whole-stage fusion (fuse_stages)."""
+        return fuse_stages(op, self.ctx.config)
 
     def create_plan(self, node: pb.PlanNode) -> PhysicalOp:
         kind = node.WhichOneof("node")
@@ -457,6 +463,213 @@ def plan_from_bytes(data: bytes,
     `callNative` entry analogue (reference: auron/src/exec.rs:42-118)."""
     task = pb.TaskDefinition.FromString(data)
     return PhysicalPlanner(ctx).plan_task(task)
+
+
+# ---------------------------------------------------------------------------
+# whole-stage fusion pass
+# ---------------------------------------------------------------------------
+
+#: bound on the fan-out product (expand projections multiply the batch
+#: count inside one program) a fused stage may unroll
+_MAX_STAGE_FANOUT = 16
+
+
+def fuse_stages(op: PhysicalOp, config=None) -> PhysicalOp:
+    """Whole-stage fusion (ops/fused.py): greedily group maximal chains
+    of fusable row-local operators into FusedStageOp nodes, and push the
+    key/value projection of partial/complete aggregations below the agg
+    so its expression evaluation joins the fused chain. Stage breakers —
+    agg cores, joins, sorts, exchanges, window, generate, scans — never
+    implement the fragment protocol, so a chain cannot cross them by
+    construction. Gated on ``auron.fusion.enabled``; chain length is
+    bounded by ``auron.fusion.max_stage_ops``."""
+    from auron_tpu import config as cfg
+    conf = config if config is not None else cfg.get_config()
+    # the pre-agg projection normalization runs regardless of the fusion
+    # switch: it moves key/value expression evaluation from the agg's
+    # eager per-batch loop into a jitted project kernel, and eager vs
+    # jitted float arithmetic differ in the last ulp (XLA contracts
+    # elementwise chains) — applying it on BOTH settings keeps
+    # fusion.enabled on/off bit-identical, the differential battery's
+    # contract
+    op = _normalize(op)
+    if not conf.get(cfg.FUSION_ENABLED):
+        return op
+    max_ops = max(2, conf.get(cfg.FUSION_MAX_STAGE_OPS))
+    return _fuse(op, max_ops)
+
+
+def _normalize(op: PhysicalOp) -> PhysicalOp:
+    op = _elide_agg_child_projection(op)
+    op = _push_agg_projection(op)
+    _replace_children(op, _normalize)
+    return op
+
+
+def _wrap_single(child: PhysicalOp) -> PhysicalOp:
+    """Wrap a lone computing fusable op so a fold-capable parent (the
+    exchange's split, the hash join's probe) can absorb its fragment
+    into ONE program. Pass-through ops (limit/rename) stay bare — their
+    host-side bookkeeping is free, a program for it would not be."""
+    from auron_tpu.ops.fused import FusedStageOp
+    if getattr(child, "fusable", False) and child.fragment_computes \
+            and not isinstance(child, FusedStageOp):
+        return FusedStageOp([child])
+    return child
+
+
+def _fuse(op: PhysicalOp, max_ops: int) -> PhysicalOp:
+    from auron_tpu.ops.fused import FusedStageOp
+    from auron_tpu.ops.joins import HashJoinOp
+    from auron_tpu.parallel.exchange import ShuffleExchangeOp
+    if isinstance(op, (ShuffleExchangeOp, HashJoinOp)):
+        _replace_children(op, lambda c: _fuse(c, max_ops))
+        # wrap a lone computing child: the exchange folds the stage's
+        # fragments into its split program, the join into its probe
+        # program (chain + pids/keys + sort/search = ONE XLA launch)
+        if isinstance(op, ShuffleExchangeOp):
+            op.child = _wrap_single(op.child)
+        else:
+            op.probe = _wrap_single(op.probe)
+        return op
+    if getattr(op, "fusable", False):
+        # collect the maximal chain op → … → deepest fusable descendant
+        chain = [op]
+        fanout = op.fusion_fanout
+        while True:
+            child = chain[-1].children[0]
+            if not getattr(child, "fusable", False):
+                break
+            if len(chain) >= max_ops:
+                break
+            if fanout * child.fusion_fanout > _MAX_STAGE_FANOUT:
+                break
+            chain.append(child)
+            fanout *= child.fusion_fanout
+        # recurse below the stage input, keeping the member links intact
+        tail = chain[-1]
+        _replace_children(tail, lambda c: _fuse(c, max_ops))
+        if len(chain) >= 2 and any(m.fragment_computes for m in chain):
+            # a chain of pure pass-throughs (limit→rename) would compile
+            # a program for work the host loop does for free — skip
+            return FusedStageOp(list(reversed(chain)))
+        return op
+    _replace_children(op, lambda c: _fuse(c, max_ops))
+    return op
+
+
+def _replace_children(op: PhysicalOp, fn) -> None:
+    """Apply ``fn`` to every direct child and swap the rewritten ops back
+    into the parent's attributes (operators hold children as plain
+    attributes — ``child``, ``probe``/``build``, ``inputs`` lists)."""
+    for name, val in list(vars(op).items()):
+        if isinstance(val, PhysicalOp):
+            setattr(op, name, fn(val))
+        elif isinstance(val, list) and val \
+                and all(isinstance(v, PhysicalOp) for v in val):
+            setattr(op, name, [fn(v) for v in val])
+
+
+def _elide_agg_child_projection(op: PhysicalOp) -> PhysicalOp:
+    """Drop a pure column-pick ProjectOp feeding an aggregation: when the
+    agg's group/arg expressions are plain ColumnRefs into a projection
+    whose referenced outputs are themselves plain ColumnRefs, the
+    projection does no device compute the agg needs — the agg's
+    per-batch contribution step picks columns by index anyway, so the
+    refs are remapped to the projection's input and one whole program
+    per (exprs, schema, capacity) disappears from the plan. Values are
+    untouched (identical column arrays), so results are bit-identical
+    under both fusion settings."""
+    from auron_tpu.exprs import ir as eir
+    from auron_tpu.ops.agg import AggOp
+    from auron_tpu.ops.project import ProjectOp
+    if not isinstance(op, AggOp) or op.mode not in ("partial", "complete"):
+        return op
+    child = op.children[0]
+    if not isinstance(child, ProjectOp):
+        return op
+    for a in op.aggs:
+        if a.fn == "bloom_filter" or a.fn.startswith("udaf:"):
+            return op
+    used = list(op.group_exprs) + [a.arg for a in op.aggs
+                                   if a.arg is not None]
+    if not used or not all(isinstance(e, eir.ColumnRef) for e in used):
+        return op
+    refs = {e.index for e in used}
+    if not all(0 <= i < len(child.exprs)
+               and isinstance(child.exprs[i], eir.ColumnRef)
+               for i in refs):
+        return op
+    remap = {i: child.exprs[i].index for i in refs}
+    from dataclasses import replace as _dc_replace
+    new_groups = [eir.ColumnRef(remap[e.index]) for e in op.group_exprs]
+    new_aggs = [a if a.arg is None
+                else _dc_replace(a, arg=eir.ColumnRef(remap[a.arg.index]))
+                for a in op.aggs]
+    rewritten = AggOp(child.children[0], new_groups, new_aggs, mode=op.mode,
+                      group_names=op.group_names, agg_names=op.agg_names,
+                      initial_capacity=op.initial_capacity,
+                      key_domain=op.key_domain)
+    if rewritten.schema() != op.schema():
+        return op
+    # the child's child may itself be a pure projection: elide again
+    return _elide_agg_child_projection(rewritten)
+
+
+def _push_agg_projection(op: PhysicalOp) -> PhysicalOp:
+    """Pre-agg key/value projection: rewrite AggOp(group_exprs, aggs)
+    over arbitrary expressions into AggOp(ColumnRefs) over a ProjectOp
+    evaluating those expressions — the projection then fuses with the
+    chain below the agg, so key/value evaluation runs inside the fused
+    stage program instead of eagerly per batch in the agg's host loop.
+    Only for partial/complete device-side aggregations; the rewrite is
+    expression-for-expression, so results are bit-identical."""
+    from auron_tpu.exprs import ir as eir
+    from auron_tpu.ops.agg import AggOp
+    from auron_tpu.ops.project import ProjectOp
+    if not isinstance(op, AggOp) or op.mode not in ("partial", "complete"):
+        return op
+    for a in op.aggs:
+        # host-side accumulator states (bloom/udaf) evaluate their own
+        # inputs against the child schema — leave those plans untouched
+        if a.fn == "bloom_filter" or a.fn.startswith("udaf:"):
+            return op
+    if not getattr(op.children[0], "fusable", False):
+        # nothing below to fuse the projection into (agg over a join /
+        # exchange / scan): a standalone projection would ADD a program
+        # without saving one — leave key/value evaluation to the agg's
+        # per-batch loop, identically under both fusion settings
+        return op
+    used = list(op.group_exprs) + [a.arg for a in op.aggs
+                                   if a.arg is not None]
+    if not used or all(isinstance(e, eir.ColumnRef) for e in used):
+        return op   # nothing to push down
+
+    proj_exprs: list = []
+    index_of: dict = {}
+
+    def col(e):
+        if e not in index_of:
+            index_of[e] = len(proj_exprs)
+            proj_exprs.append(e)
+        return eir.ColumnRef(index_of[e])
+
+    new_groups = [col(e) for e in op.group_exprs]
+    from dataclasses import replace as _dc_replace
+    new_aggs = [a if a.arg is None else _dc_replace(a, arg=col(a.arg))
+                for a in op.aggs]
+    proj = ProjectOp(op.children[0], proj_exprs,
+                     [f"_pre{i}" for i in range(len(proj_exprs))])
+    rewritten = AggOp(proj, new_groups, new_aggs, mode=op.mode,
+                      group_names=op.group_names, agg_names=op.agg_names,
+                      initial_capacity=op.initial_capacity,
+                      key_domain=op.key_domain)
+    if rewritten.schema() != op.schema():
+        # defensive: a projection that would change the agg's output
+        # contract (shouldn't happen — infer_field is deterministic)
+        # must never reach execution
+        return op
+    return rewritten
 
 
 def _collect_subqueries(msg) -> list:

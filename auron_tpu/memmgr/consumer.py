@@ -33,6 +33,11 @@ class BufferedSpillConsumer:
         self.bytes = 0
         self.spills = []
         self._lock = threading.RLock()
+        #: victim spills claim the buffer under the lock but serialize it
+        #: outside; this counts claimed-but-unpublished runs so readers
+        #: can wait for a consistent (buffered, spills) view
+        self._inflight_spills = 0
+        self._quiesced = threading.Condition(self._lock)
         mem.register_consumer(self)
 
     # -- write side ---------------------------------------------------------
@@ -50,6 +55,16 @@ class BufferedSpillConsumer:
             self.bytes = 0
         return out
 
+    def wait_spills_published(self) -> None:
+        """Block until no victim spill holds claimed-but-unpublished
+        batches, so a subsequent (take_buffered, spills) read is a
+        consistent snapshot — without this, a reader could see an empty
+        buffer AND an empty spill list while a whole run is mid-write
+        and silently lose it."""
+        with self._quiesced:
+            while self._inflight_spills:
+                self._quiesced.wait()
+
     def mem_used(self) -> int:
         with self._lock:
             return self.bytes
@@ -62,10 +77,16 @@ class BufferedSpillConsumer:
                 return 0
             buffered, self.buffered = self.buffered, []
             freed, self.bytes = self.bytes, 0
-        spill = self.mem.spill_manager.new_spill()
-        self._write_run(spill, buffered)
-        with self._lock:
-            self.spills.append(spill.finish())
+            self._inflight_spills += 1
+        try:
+            spill = self.mem.spill_manager.new_spill()
+            self._write_run(spill, buffered)
+            with self._lock:
+                self.spills.append(spill.finish())
+        finally:
+            with self._quiesced:
+                self._inflight_spills -= 1
+                self._quiesced.notify_all()
         self.metrics.counter("mem_spill_count").add(1)
         self.metrics.counter("mem_spill_size").add(freed)
         return freed

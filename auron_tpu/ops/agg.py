@@ -25,7 +25,7 @@ the AccColumn idea (reference: agg/acc.rs) without the row-format detour.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import lru_cache, partial
+from functools import partial
 from typing import Iterator, Optional
 
 import numpy as np
@@ -41,6 +41,7 @@ from auron_tpu.exprs.eval import EvalContext, TypedValue, evaluate, infer_dtype
 from auron_tpu.ops import hashing
 from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
 from auron_tpu.utils.shapes import bucket_rows
+from auron_tpu.runtime.programs import program_cache
 
 # ---------------------------------------------------------------------------
 # accumulator specs
@@ -535,7 +536,7 @@ def _reduce_sorted(keys_s, accs_s, live_s, h_s, acc_meta, out_cap):
     return new_keys, tuple(new_accs), h_out, num_groups, tuple(needed_elems)
 
 
-@lru_cache(maxsize=256)
+@program_cache("ops.agg.batch_reduce", maxsize=256)
 def _batch_reduce_kernel(n_keys: int, acc_meta: tuple, cap: int):
     """(keys, accs, live) of one batch → its own group table, hash-sorted.
     One O(B log B) sort of the BATCH only — the state is never re-sorted
@@ -571,7 +572,7 @@ def _scatter_acc(a_s, a_b, pos_s, pos_b, m: int):
     return buf.at[pos_s].set(a_s).at[pos_b].set(a_b)
 
 
-@lru_cache(maxsize=256)
+@program_cache("ops.agg.state_merge", maxsize=256)
 def _state_merge_kernel(n_keys: int, acc_meta: tuple, cap_s: int,
                         cap_b: int, out_cap: int):
     """Fold a hash-sorted batch group table into the hash-sorted state

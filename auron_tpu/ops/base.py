@@ -208,6 +208,40 @@ class PhysicalOp:
     #: operator display name (metric key prefix)
     name: str = "op"
 
+    #: whole-stage fusion protocol (ops/fused.py): True on operators whose
+    #: per-batch work is a pure row-local device computation expressible as
+    #: a KernelFragment — the planner's stage-fusion pass
+    #: (ir/planner.fuse_stages) chains them into one jit-compiled program.
+    #: Stage breakers (agg cores, joins, sorts, exchanges, scans) stay
+    #: False and terminate fusion chains.
+    fusable: bool = False
+
+    #: kernel fan-out of this op's fragment (ExpandOp emits one batch per
+    #: projection); the fusion pass bounds the product along a chain.
+    fusion_fanout: int = 1
+
+    #: does this op's fragment do real device compute? Pass-through
+    #: fragments (limit's num_rows rewrite, rename's identity) are False:
+    #: a stage made ONLY of those would compile a program for work the
+    #: unfused operators do host-side for free, so the fusion pass only
+    #: creates stages containing at least one computing member.
+    fragment_computes: bool = False
+
+    #: may a consumer destroy (donate to XLA) the batches execute() yields?
+    #: True for ops that construct fresh device arrays per output batch;
+    #: "inherit" for pass-through ops (limit/union/rename/coalesce) whose
+    #: outputs alias their children's; False for sources that replay
+    #: shared, long-lived batches (device scans, broadcast buffers).
+    #: Resolve through ``yields_owned_batches``, never read directly.
+    owns_output = True
+
+    def build_kernel_fragment(self) -> Optional["object"]:
+        """Return this op's KernelFragment (ops/fused.py) — the traceable
+        per-batch function the stage-fusion pass composes into one XLA
+        program — or None when the op cannot fuse. Implemented iff
+        ``fusable`` is True."""
+        return None
+
     @property
     def children(self) -> list["PhysicalOp"]:
         return []
@@ -226,6 +260,18 @@ class PhysicalOp:
 
     def __repr__(self):
         return type(self).__name__
+
+
+def yields_owned_batches(op: PhysicalOp) -> bool:
+    """True when every batch ``op.execute`` yields is freshly constructed
+    and dead to the producer once consumed — the precondition for a
+    consumer kernel to donate it to XLA (buffer donation halves peak HBM
+    on single-consumer steps; donating a shared batch would corrupt later
+    readers). Pass-through ops inherit from their children."""
+    owned = getattr(op, "owns_output", True)
+    if owned == "inherit":
+        return all(yields_owned_batches(c) for c in op.children)
+    return bool(owned)
 
 
 def count_output(stream, metrics: MetricsSet):

@@ -19,6 +19,8 @@ from auron_tpu.ops.project import _project_kernel
 
 class ExpandOp(PhysicalOp):
     name = "expand"
+    fusable = True
+    fragment_computes = True
 
     def __init__(self, child: PhysicalOp, projections: list[list[ir.Expr]],
                  names: Optional[list[str]] = None):
@@ -27,6 +29,7 @@ class ExpandOp(PhysicalOp):
             "expand projections must agree on arity"
         self.child = child
         self.projections = tuple(tuple(p) for p in projections)
+        self.fusion_fanout = len(self.projections)
         in_schema = child.schema()
         n_out = len(self.projections[0])
         self.names = list(names or [f"c{i}" for i in range(n_out)])
@@ -44,6 +47,30 @@ class ExpandOp(PhysicalOp):
 
     def schema(self) -> Schema:
         return self._schema
+
+    def build_kernel_fragment(self):
+        import jax.numpy as jnp
+
+        from auron_tpu.columnar.batch import DeviceBatch
+        from auron_tpu.exprs.eval import EvalContext, evaluate
+        from auron_tpu.ops.fused import KernelFragment
+        projections, in_schema = self.projections, self.child.schema()
+
+        def apply(batch, partition_id, carry):
+            outs = []
+            for proj in projections:
+                # every projection of one input batch sees the same row
+                # offset, exactly like the unfused per-projection kernels
+                ctx = EvalContext(partition_id=partition_id,
+                                  row_num_offset=carry, memo={})
+                cols = tuple(evaluate(e, batch, in_schema, ctx).col
+                             for e in proj)
+                outs.append(DeviceBatch(cols, batch.num_rows))
+            return tuple(outs), \
+                carry + jnp.asarray(batch.num_rows, jnp.int64)
+
+        return KernelFragment(key=("expand", projections, in_schema),
+                              apply=apply, fanout=len(projections))
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator:
         metrics = ctx.metrics_for(self.name)

@@ -12,7 +12,6 @@ operating on Arrow batches at the host boundary.
 from __future__ import annotations
 
 import json
-from functools import lru_cache
 from typing import Iterator, Optional
 
 import jax
@@ -28,9 +27,10 @@ from auron_tpu.exprs import udf as udf_registry
 from auron_tpu.exprs.eval import EvalContext, evaluate, infer_dtype
 from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
 from auron_tpu.utils.shapes import bucket_rows
+from auron_tpu.runtime.programs import program_cache
 
 
-@lru_cache(maxsize=128)
+@program_cache("ops.generate.explode", maxsize=128)
 def _explode_kernel(generator: ir.Expr, pass_through: tuple, with_pos: bool,
                     outer: bool, in_schema: Schema, capacity: int):
     """One launch: rows × list elements → flattened live rows."""

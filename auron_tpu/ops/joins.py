@@ -18,7 +18,6 @@ Join types: inner / left / right / full / semi / anti / existence
 
 from __future__ import annotations
 
-from functools import lru_cache
 from typing import Iterator, Optional
 
 import numpy as np
@@ -35,6 +34,8 @@ from auron_tpu.exprs.eval import EvalContext, evaluate
 from auron_tpu.ops import hashing
 from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
 from auron_tpu.ops.sort import _concat_all
+from auron_tpu.runtime import programs
+from auron_tpu.runtime.programs import program_cache
 from auron_tpu.utils.shapes import bucket_rows
 
 # sentinel hashes guaranteeing null keys never match (numpy scalars so the
@@ -56,24 +57,67 @@ def _take_cols(cols, idx, valid):
     return tuple(gather_column(c, idx, valid) for c in cols)
 
 
-@lru_cache(maxsize=256)
+def _probe_count_body(probe: DeviceBatch, build_hashes, key_exprs: tuple,
+                      in_schema: Schema):
+    """Traced probe-side candidate search: key hashes binary-searched
+    into the sorted build table."""
+    ctx = EvalContext()
+    keys = tuple(evaluate(e, probe, in_schema, ctx).col for e in key_exprs)
+    h = _key_hashes(keys, probe.capacity, probe.row_mask(), _NULL_PROBE)
+    lo = jnp.searchsorted(build_hashes, h, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(build_hashes, h, side="right").astype(jnp.int32)
+    counts = hi - lo
+    total = jnp.sum(counts)
+    return h, lo, counts, total
+
+
+@program_cache("ops.joins.probe_count", maxsize=256)
 def _probe_count_kernel(key_exprs: tuple, in_schema: Schema, capacity: int,
                         build_cap: int):
     @jax.jit
     def kernel(probe: DeviceBatch, build_hashes):
-        ctx = EvalContext()
-        keys = tuple(evaluate(e, probe, in_schema, ctx).col for e in key_exprs)
-        h = _key_hashes(keys, probe.capacity, probe.row_mask(), _NULL_PROBE)
-        lo = jnp.searchsorted(build_hashes, h, side="left").astype(jnp.int32)
-        hi = jnp.searchsorted(build_hashes, h, side="right").astype(jnp.int32)
-        counts = hi - lo
-        total = jnp.sum(counts)
-        return h, lo, counts, total
+        return _probe_count_body(probe, build_hashes, key_exprs, in_schema)
 
     return kernel
 
 
-@lru_cache(maxsize=256)
+#: probe-prologue programs: the probe-side fused-stage chain + key hashing
+#: + candidate search in ONE XLA program (the join-side analogue of the
+#: exchange's fused split) — the probe chain's intermediate batch goes
+#: straight into the hash probe without an extra program boundary
+_PROBE_PROGRAMS = programs.register(
+    programs.ProgramCache("ops.joins.fused_probe", maxsize=256))
+
+
+def _fused_probe_program(frag_keys: tuple, key_exprs: tuple,
+                         in_schema: Schema, out_schema: Schema,
+                         capacity: int, build_cap: int, fragments):
+    """One program per (probe chain, join keys, schema, capacities):
+    member fragments thread the batch, then the probe-count body runs on
+    the chain output. Returns the transformed batch too — the join's
+    match/gather phase consumes it, and the downstream eager key
+    evaluation (_keys_match) sees exactly the batch the standalone chain
+    would have produced, keeping fused results bit-identical."""
+
+    def build():
+        from auron_tpu.ops.fused import thread_fragments
+
+        @jax.jit
+        def kernel(batch: DeviceBatch, partition_id, carries, build_hashes):
+            outs, new_carries = thread_fragments(fragments, batch,
+                                                 partition_id, carries)
+            (b,) = outs   # fan-out chains never take this path
+            h, lo, counts, total = _probe_count_body(
+                b, build_hashes, key_exprs, out_schema)
+            return b, lo, counts, total, jnp.stack(new_carries)
+
+        return kernel
+
+    return _PROBE_PROGRAMS.get_or_build(
+        (frag_keys, key_exprs, in_schema, capacity, build_cap), build)
+
+
+@program_cache("ops.joins.expand", maxsize=256)
 def _expand_kernel(out_cap: int, capacity: int):
     """Expand candidate ranges to (probe_idx, build_idx) pairs."""
 
@@ -205,10 +249,16 @@ class HashJoinOp(PhysicalOp):
                 side = _BuildSide(merged, build_schema, self.build_keys,
                                   metrics)
 
-                for probe in self.probe.execute(partition, ctx):
-                    yield from self._probe_one(probe, side, probe_schema,
-                                               build_schema, elapsed,
-                                               ctx.device_sync)
+                fold = self._probe_fold(ctx)
+                if fold is not None:
+                    yield from self._probe_fused(fold, side, partition, ctx,
+                                                 probe_schema, build_schema,
+                                                 elapsed)
+                else:
+                    for probe in self.probe.execute(partition, ctx):
+                        yield from self._probe_one(probe, side, probe_schema,
+                                                   build_schema, elapsed,
+                                                   ctx.device_sync)
 
                 if self.join_type in ("right", "full"):
                     yield self._unmatched_build(side, probe_schema,
@@ -238,13 +288,57 @@ class HashJoinOp(PhysicalOp):
         yield from smj.execute(partition, ctx)
 
     # -- helpers ------------------------------------------------------------
+    def _probe_fold(self, ctx: ExecContext):
+        """(fragments, frag_keys, input_op) when the probe side is a
+        fused chain whose fragments can fold into the probe-count
+        program, else None."""
+        from auron_tpu import config as cfg
+        from auron_tpu.ops.fused import FusedStageOp
+        if not ctx.conf.get(cfg.FUSION_ENABLED):
+            return None
+        if not isinstance(self.probe, FusedStageOp) \
+                or self.probe.has_limit():
+            return None
+        fragments, frag_keys = self.probe.fragment_pipeline()
+        if not fragments or any(f.fanout != 1 for f in fragments):
+            return None
+        return fragments, frag_keys, self.probe.input
+
+    def _probe_fused(self, fold, side: _BuildSide, partition: int,
+                     ctx: ExecContext, probe_schema, build_schema, elapsed):
+        """Probe loop with the chain folded into the probe program: one
+        XLA launch runs the member fragments AND the candidate search;
+        the transformed batch comes back for the match/gather phase."""
+        fragments, frag_keys, input_op = fold
+        kmetrics = ctx.metrics_for("kernels")
+        built_c = kmetrics.counter("fused_probe_programs_built")
+        hit_c = kmetrics.counter("fused_probe_program_hits")
+        in_schema = input_op.schema()
+        _sync = ctx.device_sync
+        carries = jnp.asarray([f.init_carry for f in fragments], jnp.int64)
+        for raw in input_op.execute(partition, ctx):
+            ctx.check_cancelled()
+            kern, built = _fused_probe_program(
+                frag_keys, self.probe_keys, in_schema, probe_schema,
+                raw.capacity, side.capacity, fragments)
+            (built_c if built else hit_c).add(1)
+            with timer(elapsed, sync=_sync) as t:
+                probe, lo, counts, total, carries = t.track(
+                    kern(raw, jnp.int32(partition), carries, side.hashes))
+            yield from self._probe_one(probe, side, probe_schema,
+                                       build_schema, elapsed, _sync,
+                                       pre=(lo, counts, total))
+
     def _probe_one(self, probe: DeviceBatch, side: _BuildSide, probe_schema,
-                   build_schema, elapsed, _sync: bool = True):
+                   build_schema, elapsed, _sync: bool = True, pre=None):
         cap = probe.capacity
-        kern = _probe_count_kernel(self.probe_keys, probe_schema, cap,
-                                   side.capacity)
-        with timer(elapsed, sync=_sync) as t:
-            h, lo, counts, total = t.track(kern(probe, side.hashes))
+        if pre is None:
+            kern = _probe_count_kernel(self.probe_keys, probe_schema, cap,
+                                       side.capacity)
+            with timer(elapsed, sync=_sync) as t:
+                _h, lo, counts, total = t.track(kern(probe, side.hashes))
+        else:   # the fused probe program already ran the candidate search
+            lo, counts, total = pre
         total_i = int(total)
 
         ctx = EvalContext()

@@ -18,6 +18,8 @@ from auron_tpu.utils.shapes import bucket_rows
 
 class LimitOp(PhysicalOp):
     name = "limit"
+    fusable = True
+    owns_output = "inherit"   # yields the child's batches (truncated)
 
     def __init__(self, child: PhysicalOp, limit: int):
         self.child = child
@@ -29,6 +31,22 @@ class LimitOp(PhysicalOp):
 
     def schema(self) -> Schema:
         return self.child.schema()
+
+    def build_kernel_fragment(self):
+        """Limit-within-batch as a carry: the remaining-row budget lives
+        in the member's int64 carry slot, truncation is a num_rows
+        rewrite (no data movement), and the host polls the slot to stop
+        pulling the child — see FusedStageOp.execute."""
+        from auron_tpu.ops.fused import KernelFragment
+
+        def apply(batch, partition_id, carry):
+            n = jnp.asarray(batch.num_rows, jnp.int64)
+            take = jnp.minimum(n, jnp.maximum(carry, 0))
+            out = DeviceBatch(batch.columns, take.astype(jnp.int32))
+            return (out,), carry - take
+
+        return KernelFragment(key=("limit", self.limit), apply=apply,
+                              init_carry=self.limit, is_limit=True)
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         metrics = ctx.metrics_for(self.name)
@@ -59,6 +77,7 @@ class UnionOp(PhysicalOp):
     distinct partition set; single-stream chain is equivalent per-partition)."""
 
     name = "union"
+    owns_output = "inherit"
 
     def __init__(self, inputs: list[PhysicalOp]):
         self.inputs = inputs
@@ -86,6 +105,7 @@ class CoalesceBatchesOp(PhysicalOp):
     ExecutionContext also coalesces on output, execution_context.rs:146-233)."""
 
     name = "coalesce_batches"
+    owns_output = "inherit"   # big batches pass through unchanged
 
     def __init__(self, child: PhysicalOp, target_rows: int):
         self.child = child
@@ -154,6 +174,15 @@ class RenameColumnsOp(PhysicalOp):
     """Schema-only rename (reference: rename_columns_exec.rs)."""
 
     name = "rename_columns"
+    fusable = True
+    owns_output = "inherit"
+
+    def build_kernel_fragment(self):
+        """Identity fragment: fusion chains cross renames for free."""
+        from auron_tpu.ops.fused import KernelFragment
+        return KernelFragment(key=("rename",),
+                              apply=lambda batch, pid, carry:
+                              ((batch,), carry))
 
     def __init__(self, child: PhysicalOp, names: list[str]):
         self.child = child

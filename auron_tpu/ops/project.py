@@ -9,7 +9,6 @@ fuses everything into one HLO computation.
 
 from __future__ import annotations
 
-from functools import lru_cache
 from typing import Iterator
 
 import jax
@@ -21,6 +20,7 @@ from auron_tpu.exprs import ir
 from auron_tpu.exprs.eval import (EvalContext, evaluate, infer_dtype,
                                   infer_field)
 from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
+from auron_tpu.runtime.programs import program_cache
 
 
 def project_schema(exprs: tuple, names: tuple[str, ...], in_schema: Schema) -> Schema:
@@ -30,7 +30,7 @@ def project_schema(exprs: tuple, names: tuple[str, ...], in_schema: Schema) -> S
                         for e, n in zip(exprs, names)))
 
 
-@lru_cache(maxsize=512)
+@program_cache("ops.project.project", maxsize=512)
 def _project_kernel(exprs: tuple, in_schema: Schema, capacity: int):
     """One compiled kernel per (expression tuple, schema, capacity)."""
 
@@ -44,7 +44,7 @@ def _project_kernel(exprs: tuple, in_schema: Schema, capacity: int):
     return kernel
 
 
-@lru_cache(maxsize=512)
+@program_cache("ops.project.filter", maxsize=512)
 def _filter_kernel(predicates: tuple, in_schema: Schema, capacity: int):
     @jax.jit
     def kernel(batch: DeviceBatch, partition_id, row_num_offset):
@@ -59,7 +59,7 @@ def _filter_kernel(predicates: tuple, in_schema: Schema, capacity: int):
     return kernel
 
 
-@lru_cache(maxsize=512)
+@program_cache("ops.project.filter_project", maxsize=512)
 def _filter_project_kernel(predicates: tuple, exprs: tuple, in_schema: Schema,
                            capacity: int):
     @jax.jit
@@ -79,6 +79,8 @@ def _filter_project_kernel(predicates: tuple, exprs: tuple, in_schema: Schema,
 
 class ProjectOp(PhysicalOp):
     name = "project"
+    fusable = True
+    fragment_computes = True
 
     def __init__(self, child: PhysicalOp, exprs: list[ir.Expr], names: list[str]):
         self.child = child
@@ -92,6 +94,21 @@ class ProjectOp(PhysicalOp):
 
     def schema(self) -> Schema:
         return self._schema
+
+    def build_kernel_fragment(self):
+        from auron_tpu.ops.fused import KernelFragment
+        exprs, in_schema = self.exprs, self.child.schema()
+
+        def apply(batch, partition_id, carry):
+            ctx = EvalContext(partition_id=partition_id,
+                              row_num_offset=carry, memo={})
+            cols = tuple(evaluate(e, batch, in_schema, ctx).col
+                         for e in exprs)
+            out = DeviceBatch(cols, batch.num_rows)
+            return (out,), carry + jnp.asarray(batch.num_rows, jnp.int64)
+
+        return KernelFragment(key=("project", exprs, in_schema),
+                              apply=apply)
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         metrics = ctx.metrics_for(self.name)
@@ -117,6 +134,8 @@ class ProjectOp(PhysicalOp):
 
 class FilterOp(PhysicalOp):
     name = "filter"
+    fusable = True
+    fragment_computes = True
 
     def __init__(self, child: PhysicalOp, predicates: list[ir.Expr]):
         self.child = child
@@ -128,6 +147,23 @@ class FilterOp(PhysicalOp):
 
     def schema(self) -> Schema:
         return self.child.schema()
+
+    def build_kernel_fragment(self):
+        from auron_tpu.ops.fused import KernelFragment
+        predicates, in_schema = self.predicates, self.child.schema()
+
+        def apply(batch, partition_id, carry):
+            ctx = EvalContext(partition_id=partition_id,
+                              row_num_offset=carry, memo={})
+            keep = batch.row_mask()
+            for p in predicates:
+                v = evaluate(p, batch, in_schema, ctx)
+                keep = keep & v.data.astype(bool) & v.validity
+            out = compact(batch, keep)
+            return (out,), carry + jnp.asarray(batch.num_rows, jnp.int64)
+
+        return KernelFragment(key=("filter", predicates, in_schema),
+                              apply=apply)
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         metrics = ctx.metrics_for(self.name)
@@ -155,6 +191,8 @@ class FilterProjectOp(PhysicalOp):
     """Fused filter+project — one kernel launch, full XLA fusion."""
 
     name = "filter_project"
+    fusable = True
+    fragment_computes = True
 
     def __init__(self, child: PhysicalOp, predicates: list[ir.Expr],
                  exprs: list[ir.Expr], names: list[str]):
@@ -170,6 +208,31 @@ class FilterProjectOp(PhysicalOp):
 
     def schema(self) -> Schema:
         return self._schema
+
+    def build_kernel_fragment(self):
+        from auron_tpu.ops.fused import KernelFragment
+        predicates, exprs = self.predicates, self.exprs
+        in_schema = self.child.schema()
+
+        def apply(batch, partition_id, carry):
+            # ONE shared EvalContext, like _filter_project_kernel: the
+            # memo keys on (batch, expr) so predicate/projection CSE
+            # still only shares within the same intermediate batch
+            ctx = EvalContext(partition_id=partition_id,
+                              row_num_offset=carry, memo={})
+            keep = batch.row_mask()
+            for p in predicates:
+                v = evaluate(p, batch, in_schema, ctx)
+                keep = keep & v.data.astype(bool) & v.validity
+            filtered = compact(batch, keep)
+            cols = tuple(evaluate(e, filtered, in_schema, ctx).col
+                         for e in exprs)
+            out = DeviceBatch(cols, filtered.num_rows)
+            return (out,), carry + jnp.asarray(batch.num_rows, jnp.int64)
+
+        return KernelFragment(
+            key=("filter_project", predicates, exprs, in_schema),
+            apply=apply)
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         metrics = ctx.metrics_for(self.name)

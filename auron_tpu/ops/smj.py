@@ -34,7 +34,6 @@ offloads its device arrays to host DRAM (re-uploaded lazily at next probe)
 
 from __future__ import annotations
 
-from functools import lru_cache
 from typing import Iterator, Optional
 
 import numpy as np
@@ -52,6 +51,7 @@ from auron_tpu.exprs.eval import EvalContext, evaluate
 from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
 from auron_tpu.ops.sort import _concat_all, sort_key_words
 from auron_tpu.utils.shapes import bucket_rows
+from auron_tpu.runtime.programs import program_cache
 
 __all__ = ["SortMergeJoinOp"]
 
@@ -60,7 +60,7 @@ __all__ = ["SortMergeJoinOp"]
 # key words
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=256)
+@program_cache("ops.smj.key_words", maxsize=256)
 def _key_words_kernel(key_exprs: tuple, in_schema: Schema, capacity: int):
     """Per-key order-word matrices [capacity, nw_k] (null word included, so
     word order == the child's (asc, nulls_first) sort order) + a per-row
@@ -117,7 +117,7 @@ def _host_lex_le(a: tuple[np.ndarray, ...], b: tuple[np.ndarray, ...]) -> bool:
 # device kernels
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=256)
+@program_cache("ops.smj.probe", maxsize=256)
 def _probe_kernel(n_words: int, win_cap: int, cap: int, left_outer: bool):
     """Vectorized lexicographic binary search of every left row's key into
     the window's sorted word matrix. Returns per-left-row lower bound,
@@ -167,7 +167,7 @@ def _probe_kernel(n_words: int, win_cap: int, cap: int, left_outer: bool):
     return kernel
 
 
-@lru_cache(maxsize=256)
+@program_cache("ops.smj.expand", maxsize=256)
 def _expand_kernel(out_cap: int, cap: int):
     """Expand per-left-row emit ranges into slot-ordered
     (left_idx, window_idx, is_real_match) triples. Slot order = ascending
@@ -370,7 +370,7 @@ class _MergeWindow:
             self.mem.unregister_consumer(self)
 
 
-@lru_cache(maxsize=256)
+@program_cache("ops.smj.mark", maxsize=256)
 def _mark_kernel(win_cap: int):
     """Matched window rows = union of the per-left-row match intervals
     [lo, lo+count): one +1/-1 scatter and a prefix sum — O(win_cap), no

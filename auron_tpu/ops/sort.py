@@ -20,7 +20,6 @@ manager (sorted-run spill + host merge), added with the memmgr subsystem.
 
 from __future__ import annotations
 
-from functools import lru_cache
 from typing import Iterator, Optional
 
 import jax
@@ -34,8 +33,22 @@ from auron_tpu.columnar.schema import DataType, Schema
 from auron_tpu.exprs import ir
 from auron_tpu.exprs.eval import EvalContext, evaluate
 from auron_tpu.memmgr.consumer import BufferedSpillConsumer
-from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
+from auron_tpu.ops.base import (ExecContext, PhysicalOp, count_output,
+                                timer, yields_owned_batches)
+from auron_tpu.runtime import programs
+from auron_tpu.runtime.programs import program_cache
 from auron_tpu.utils.shapes import bucket_rows
+
+
+def _sort_donate(batches: list[DeviceBatch], child: PhysicalOp) -> bool:
+    """Donate the sort input when it is dead after the kernel: a multi-
+    batch merge is always a fresh local concat; a single batch is safe
+    only when the child constructs fresh outputs (donating a replayed
+    broadcast/device-scan batch would poison later readers). CPU treats
+    donation as advisory, so skip it there (programs.jit also guards)."""
+    if jax.default_backend() == "cpu":
+        return False
+    return len(batches) > 1 or yields_owned_batches(child)
 
 
 def string_be_words(chars: "jax.Array") -> "jax.Array":
@@ -148,9 +161,9 @@ def sort_permutation(batch: DeviceBatch, key_cols, orders) -> jax.Array:
     return perm
 
 
-@lru_cache(maxsize=256)
-def _sort_kernel(sort_exprs: tuple, in_schema: Schema, capacity: int):
-    @jax.jit
+@program_cache("ops.sort.sort", maxsize=256)
+def _sort_kernel(sort_exprs: tuple, in_schema: Schema, capacity: int,
+                 donate: bool):
     def kernel(batch: DeviceBatch):
         ctx = EvalContext()
         key_cols = [evaluate(s.expr, batch, in_schema, ctx).col
@@ -159,7 +172,9 @@ def _sort_kernel(sort_exprs: tuple, in_schema: Schema, capacity: int):
         perm = sort_permutation(batch, key_cols, orders)
         return gather_batch(batch, perm, batch.num_rows)
 
-    return kernel
+    # the un-sorted input is dead after the gather — donating it halves
+    # peak HBM for the sort step (callers gate on ownership + platform)
+    return programs.jit(kernel, donate_argnums=(0,) if donate else ())
 
 
 def key_word_layout(sort_exprs: tuple, in_schema: Schema,
@@ -185,14 +200,13 @@ def key_word_layout(sort_exprs: tuple, in_schema: Schema,
     return layout
 
 
-@lru_cache(maxsize=256)
+@program_cache("ops.sort.sort_with_words", maxsize=256)
 def _sort_with_words_kernel(sort_exprs: tuple, in_schema: Schema,
-                            capacity: int):
+                            capacity: int, donate: bool):
     """Sorted batch + its order-word matrix [capacity, W] — the words ride
     into the spill so the host k-way merge (memmgr.merge) compares exactly
     what the device sorted."""
 
-    @jax.jit
     def kernel(batch: DeviceBatch):
         ctx = EvalContext()
         key_cols = [evaluate(s.expr, batch, in_schema, ctx).col
@@ -202,7 +216,7 @@ def _sort_with_words_kernel(sort_exprs: tuple, in_schema: Schema,
         words = jnp.stack(sort_key_words(key_cols, orders), axis=1)
         return gather_batch(batch, perm, batch.num_rows), words[perm]
 
-    return kernel
+    return programs.jit(kernel, donate_argnums=(0,) if donate else ())
 
 
 def _concat_all(batches: list[DeviceBatch]) -> DeviceBatch:
@@ -254,7 +268,8 @@ class _SortSpillConsumer(BufferedSpillConsumer):
             key_word_layout(self.op.sort_exprs, self.in_schema, merged),
             dtype=np.uint64)
         kern = _sort_with_words_kernel(self.op.sort_exprs, self.in_schema,
-                                       merged.capacity)
+                                       merged.capacity,
+                                       _sort_donate(batches, self.op.child))
         run, words = kern(merged)
         n = int(run.num_rows)
         host = batch_to_host(run, n)
@@ -311,9 +326,11 @@ class SortOp(PhysicalOp):
         def in_mem_stream(batches):
             if not batches:
                 return
+            donate = _sort_donate(batches, self.child)
             with timer(elapsed, sync=_sync) as t:
                 merged = _concat_all(batches) if len(batches) > 1 else batches[0]
-                kern = _sort_kernel(self.sort_exprs, in_schema, merged.capacity)
+                kern = _sort_kernel(self.sort_exprs, in_schema,
+                                    merged.capacity, donate)
                 out = t.track(kern(merged))
             yield out
 
@@ -341,9 +358,20 @@ class SortOp(PhysicalOp):
                 for batch in self.child.execute(partition, ctx):
                     ctx.check_cancelled()
                     consumer.add(batch)
+                # claim the buffer FIRST (take_buffered) so a concurrent
+                # victim spill can't serialize batches the in-mem sort
+                # may have donated to XLA; wait out any in-flight spill
+                # so the (buffer, spills) view below is consistent — an
+                # unpublished run would otherwise vanish silently
+                taken = consumer.take_buffered()
+                consumer.wait_spills_published()
                 if not consumer.spills:
-                    yield from self._limit(in_mem_stream(consumer.buffered))
+                    yield from self._limit(in_mem_stream(taken))
                 else:
+                    # a victim spill raced in: hand the claimed batches
+                    # back so external_stream's final spill includes them
+                    for b in taken:
+                        consumer.add(b)
                     yield from self._limit(external_stream(consumer))
             finally:
                 consumer.close()

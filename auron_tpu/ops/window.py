@@ -24,7 +24,6 @@ group's end); without ORDER BY, the whole partition.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import lru_cache
 from typing import Iterator, Optional
 
 import jax
@@ -38,6 +37,7 @@ from auron_tpu.exprs.eval import (EvalContext, TypedValue, evaluate,
                                   infer_dtype)
 from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
 from auron_tpu.ops.sort import _concat_all, sort_permutation
+from auron_tpu.runtime.programs import program_cache
 
 RANK_LIKE = ("row_number", "rank", "dense_rank", "percent_rank",
              "cume_dist", "ntile")
@@ -184,7 +184,7 @@ def _decimal_avg_type(p: int, s: int) -> tuple[int, int]:
     return np_, min(s + 4, np_)
 
 
-@lru_cache(maxsize=128)
+@program_cache("ops.window.window", maxsize=128)
 def _window_kernel(partition_exprs: tuple, order_by: tuple, fn_specs: tuple,
                    in_schema: Schema, capacity: int, group_limit):
     n_funcs = len(fn_specs)

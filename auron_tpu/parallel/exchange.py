@@ -29,7 +29,6 @@ same sorted-compaction rides `lax.all_to_all`
 from __future__ import annotations
 
 import threading
-from functools import lru_cache
 from typing import Iterator, Optional
 
 import numpy as np
@@ -39,11 +38,16 @@ import jax.numpy as jnp
 
 from auron_tpu.columnar.batch import DeviceBatch, gather_batch
 from auron_tpu.columnar.schema import Schema
-from auron_tpu.ops.base import ExecContext, PhysicalOp, count_output, timer
+from auron_tpu.exprs.eval import EvalContext, evaluate
+from auron_tpu.ops import hashing
+from auron_tpu.ops.base import (ExecContext, PhysicalOp, count_output,
+                                timer, yields_owned_batches)
 from auron_tpu.parallel.partitioning import (HashPartitioning,
                                              RangePartitioning,
                                              RoundRobinPartitioning,
                                              SinglePartitioning)
+from auron_tpu.runtime import programs
+from auron_tpu.runtime.programs import program_cache
 from auron_tpu.utils.shapes import bucket_rows
 
 #: rows sampled for range bounds (reference samples client-side too,
@@ -51,24 +55,96 @@ from auron_tpu.utils.shapes import bucket_rows
 _RANGE_SAMPLE_ROWS = 10_000
 
 
-@lru_cache(maxsize=256)
-def _sort_by_pid_kernel(num_partitions: int, capacity: int):
-    """ONE compaction for all partitions: stable sort rows by target
-    partition id (dead rows to the end) + per-partition counts
-    (reference: shuffle/buffered_data.rs:88-160)."""
+def _split_body(batch: DeviceBatch, pids, num_partitions: int):
+    """Traced split body: stable sort rows by target partition id (dead
+    rows to the end) + per-partition counts (reference:
+    shuffle/buffered_data.rs:88-160)."""
+    live = batch.row_mask()
+    key = jnp.where(live, pids, num_partitions)
+    perm = jnp.argsort(key, stable=True)
+    sorted_batch = gather_batch(batch, perm, batch.num_rows)
+    counts = jax.ops.segment_sum(
+        live.astype(jnp.int32), jnp.clip(key, 0, num_partitions),
+        num_segments=num_partitions + 1)[:num_partitions]
+    return sorted_batch, counts
 
-    @jax.jit
+
+@program_cache("parallel.exchange.sort_by_pid", maxsize=256)
+def _sort_by_pid_kernel(num_partitions: int, capacity: int, donate: bool):
+    """ONE compaction for all partitions. ``donate`` hands the input
+    batch's buffers to XLA (the un-sorted input is dead after the call —
+    halves peak HBM for the split); callers pass it only for owned
+    input streams on non-CPU backends (see yields_owned_batches)."""
+
     def kernel(batch: DeviceBatch, pids):
-        live = batch.row_mask()
-        key = jnp.where(live, pids, num_partitions)
-        perm = jnp.argsort(key, stable=True)
-        sorted_batch = gather_batch(batch, perm, batch.num_rows)
-        counts = jax.ops.segment_sum(
-            live.astype(jnp.int32), jnp.clip(key, 0, num_partitions),
-            num_segments=num_partitions + 1)[:num_partitions]
-        return sorted_batch, counts
+        return _split_body(batch, pids, num_partitions)
 
-    return kernel
+    return programs.jit(kernel, donate_argnums=(0,) if donate else ())
+
+
+#: fused split programs: the upstream fused-stage chain (when present),
+#: the partition-id computation and the sort-by-pid compaction in ONE
+#: XLA program — the whole-stage-fusion prologue of the exchange
+_SPLIT_PROGRAMS = programs.register(
+    programs.ProgramCache("parallel.exchange.fused_split", maxsize=256))
+
+
+def _fused_split_program(frag_keys: tuple, part_sig: tuple,
+                         in_schema: Schema, out_schema: Schema,
+                         n_out: int, capacity: int, donate: bool,
+                         fragments, part_exprs):
+    """One program per (chain signature, partitioning, schema, capacity):
+    runs the member fragments, computes partition ids on the chain
+    output, and splits — intermediates never touch HBM. The carry vector
+    is the members' carries plus one trailing slot counting rows seen at
+    the split (the round-robin start offset, kept on device)."""
+
+    def build():
+        from auron_tpu.ops.fused import thread_fragments
+        n_frags = len(fragments)
+        kind = part_sig[0]
+
+        def kernel(batch: DeviceBatch, partition_id, carries):
+            outs, new_carries = thread_fragments(fragments, batch,
+                                                 partition_id, carries)
+            (b,) = outs   # fan-out chains never take this path
+            if kind == "hash":
+                ctx = EvalContext()
+                cols = [evaluate(e, b, out_schema, ctx).col
+                        for e in part_exprs]
+                h = hashing.murmur3_columns(cols, b.capacity,
+                                            hashing.SPARK_SHUFFLE_SEED)
+                nn = jnp.int32(n_out)
+                pids = ((h % nn) + nn) % nn
+            elif kind == "round_robin":
+                start = carries[n_frags].astype(jnp.int32)
+                pids = (jnp.arange(b.capacity, dtype=jnp.int32) + start) \
+                    % jnp.int32(n_out)
+            else:   # single
+                pids = jnp.zeros(b.capacity, jnp.int32)
+            sorted_batch, counts = _split_body(b, pids, n_out)
+            new_carries.append(carries[n_frags]
+                               + jnp.asarray(b.num_rows, jnp.int64))
+            return sorted_batch, counts, jnp.stack(new_carries)
+
+        return programs.jit(kernel,
+                            donate_argnums=(0,) if donate else ())
+
+    return _SPLIT_PROGRAMS.get_or_build(
+        (frag_keys, part_sig, in_schema, n_out, capacity, donate), build)
+
+
+def _split_signature(partitioning) -> Optional[tuple]:
+    """Hashable partitioning signature for the fused split program, or
+    None when the partitioning cannot fuse (range bounds are sampled
+    host-side mid-stream)."""
+    if isinstance(partitioning, HashPartitioning):
+        return ("hash", partitioning.exprs)
+    if isinstance(partitioning, RoundRobinPartitioning):
+        return ("round_robin",)
+    if isinstance(partitioning, SinglePartitioning):
+        return ("single",)
+    return None
 
 
 class _ExchangeBuffer:
@@ -241,12 +317,19 @@ class ShuffleExchangeOp(PhysicalOp):
 
     def _materialize(self, ctx: ExecContext) -> _ExchangeBuffer:
         """Run all map tasks; ONE sort-by-pid compaction per batch."""
+        from auron_tpu import config as cfg
         metrics = ctx.metrics_for(self.name)
         write_time = metrics.counter("shuffle_write_total_time")
         n_out = self.num_partitions
         schema = self.child.schema()
         _sync = ctx.device_sync
         buffer = _ExchangeBuffer(self, ctx.mem_manager, metrics, ctx.conf)
+
+        part_sig = _split_signature(self.partitioning)
+        if part_sig is not None and ctx.conf.get(cfg.FUSION_ENABLED) \
+                and self._split_fragments() is not None:
+            self._materialize_fused(ctx, buffer, write_time, part_sig)
+            return buffer
 
         batches = self._input_batches(ctx)
         partitioning = self.partitioning
@@ -271,22 +354,90 @@ class ShuffleExchangeOp(PhysicalOp):
             self.partitioning = partitioning
 
         row_offset = 0
+        donate = yields_owned_batches(self.child) \
+            and jax.default_backend() != "cpu"
         import itertools
         for batch in itertools.chain(pending, batches):
+            # donation hands the batch's buffers to XLA — read the row
+            # count BEFORE the call (afterwards the donated leaves are
+            # poisoned)
+            n_in = int(batch.num_rows) if donate else None
             with timer(write_time, sync=_sync) as t:
                 if isinstance(partitioning, RoundRobinPartitioning):
                     part = RoundRobinPartitioning(n_out, row_offset)
                     pids = part.partition_ids(batch, schema)
                 else:
                     pids = partitioning.partition_ids(batch, schema)
-                kern = _sort_by_pid_kernel(n_out, batch.capacity)
+                kern = _sort_by_pid_kernel(n_out, batch.capacity, donate)
                 sorted_batch, counts = t.track(kern(batch, pids))
-            row_offset += int(batch.num_rows)
+            row_offset += n_in if donate else int(batch.num_rows)
             counts_h = np.asarray(counts)
             offsets = np.concatenate(
                 [np.zeros(1, np.int64), np.cumsum(counts_h)])
             buffer.add(sorted_batch, offsets)
         return buffer
+
+    def _split_fragments(self):
+        """The child chain's fragments when they can fold into the split
+        program, else None (no chain / fused limit / fan-out members) —
+        None keeps the classic path, whose pid+sort kernel is keyed only
+        on (n_out, capacity) and therefore SHARES across queries; a
+        fragment-less per-schema split program would trade that sharing
+        away for nothing."""
+        from auron_tpu.ops.fused import FusedStageOp
+        if not isinstance(self.child, FusedStageOp) \
+                or self.child.has_limit():
+            return None
+        fragments, frag_keys = self.child.fragment_pipeline()
+        if not fragments or any(f.fanout != 1 for f in fragments):
+            return None
+        return fragments, frag_keys
+
+    def _materialize_fused(self, ctx: ExecContext, buffer: _ExchangeBuffer,
+                           write_time, part_sig: tuple) -> None:
+        """Whole-stage split: the child chain's member fragments join the
+        exchange's partition-id + sort-by-pid program, so a
+        filter→project chain feeding a hash shuffle is ONE XLA launch
+        per batch with the intermediates living only in registers/VMEM."""
+        n_out = self.num_partitions
+        out_schema = self.child.schema()
+        _sync = ctx.device_sync
+        kmetrics = ctx.metrics_for("kernels")
+        built_c = kmetrics.counter("fused_split_programs_built")
+        hit_c = kmetrics.counter("fused_split_program_hits")
+
+        fragments, frag_keys = self._split_fragments()
+        input_op = self.child.input
+        in_schema = input_op.schema()
+        part_exprs = self.partitioning.exprs \
+            if isinstance(self.partitioning, HashPartitioning) else ()
+        donate = yields_owned_batches(input_op) \
+            and jax.default_backend() != "cpu"
+        init = [f.init_carry for f in fragments]
+
+        # the trailing carry slot (rows seen at the split — the
+        # round-robin start) persists across input partitions; member
+        # carries reset per input partition like a fresh execute() would
+        split_seen = jnp.zeros((1,), jnp.int64)
+        for in_p in range(self.input_partitions):
+            map_ctx = ctx.child(partition_id=in_p,
+                                num_partitions=self.input_partitions)
+            carries = jnp.concatenate(
+                [jnp.asarray(init, jnp.int64), split_seen])
+            for batch in input_op.execute(in_p, map_ctx):
+                map_ctx.check_cancelled()
+                kern, built = _fused_split_program(
+                    frag_keys, part_sig, in_schema, out_schema, n_out,
+                    batch.capacity, donate, fragments, part_exprs)
+                (built_c if built else hit_c).add(1)
+                with timer(write_time, sync=_sync) as t:
+                    sorted_batch, counts, carries = t.track(
+                        kern(batch, jnp.int32(in_p), carries))
+                counts_h = np.asarray(counts)
+                offsets = np.concatenate(
+                    [np.zeros(1, np.int64), np.cumsum(counts_h)])
+                buffer.add(sorted_batch, offsets)
+            split_seen = carries[-1:]
 
     # -- reduce side --------------------------------------------------------
 
@@ -385,18 +536,22 @@ class RssShuffleExchangeOp(PhysicalOp):
             writer = self.service.partition_writer(self.shuffle_id, in_p,
                                                    n_out)
             row_offset = 0
+            donate = yields_owned_batches(self.child) \
+                and jax.default_backend() != "cpu"
             import itertools
             try:
                 for batch in itertools.chain(pending, batches):
+                    n_in = int(batch.num_rows) if donate else None
                     with timer(write_time, sync=_sync) as t:
                         if isinstance(partitioning, RoundRobinPartitioning):
                             part = RoundRobinPartitioning(n_out, row_offset)
                             pids = part.partition_ids(batch, schema)
                         else:
                             pids = partitioning.partition_ids(batch, schema)
-                        kern = _sort_by_pid_kernel(n_out, batch.capacity)
+                        kern = _sort_by_pid_kernel(n_out, batch.capacity,
+                                                   donate)
                         sorted_batch, counts = t.track(kern(batch, pids))
-                    row_offset += int(batch.num_rows)
+                    row_offset += n_in if donate else int(batch.num_rows)
                     counts_h = np.asarray(counts)
                     offsets = np.concatenate(
                         [np.zeros(1, np.int64), np.cumsum(counts_h)])
@@ -588,6 +743,8 @@ class BroadcastExchangeOp(PhysicalOp):
     larger than the budget spills to host tiers and replays from there."""
 
     name = "broadcast_exchange"
+    #: every consumer partition replays the same collected batches
+    owns_output = False
 
     def __init__(self, child: PhysicalOp, input_partitions: int = 1):
         self.child = child

@@ -20,7 +20,7 @@ fabric).
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import partial
 
 import numpy as np
 
@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from auron_tpu.runtime.programs import program_cache
 
 try:
     from jax import shard_map
@@ -41,7 +43,7 @@ def make_mesh(num_devices: int | None = None, axis: str = "data") -> Mesh:
     return Mesh(np.array(devs[:n]), (axis,))
 
 
-@lru_cache(maxsize=64)
+@program_cache("parallel.mesh_exchange.exchange", maxsize=64)
 def _exchange_fn(mesh: Mesh, n_cols: int, quota: int, axis: str):
     """Builds the jitted SPMD exchange for a given column arity and quota.
 

@@ -57,6 +57,13 @@ class ExecutionRuntime:
             self._compile_start = compile_stats.snapshot()
         except Exception:
             self._compile_start = None
+        # per-task program-cache attribution (central registry,
+        # runtime/programs.py): builds vs hits across every compile site
+        try:
+            from auron_tpu.runtime import programs
+            self._programs_start = programs.totals()
+        except Exception:
+            self._programs_start = None
 
     def batches(self) -> Iterator[DeviceBatch]:
         """Device-batch stream (stays on device; used for stage chaining).
@@ -133,6 +140,11 @@ class ExecutionRuntime:
             d = compile_stats.delta(self._compile_start)
             snap["xla_compiles"] = d.count
             snap["xla_compile_seconds"] = round(d.seconds, 4)
+        if self._programs_start is not None:
+            from auron_tpu.runtime import programs
+            pd = programs.delta(self._programs_start)
+            snap["program_builds"] = pd.builds
+            snap["program_hits"] = pd.hits
         if getattr(self, "profile_dir", None):
             op_times = {
                 op: vals["elapsed_compute"] * 1e-9   # counters are ns

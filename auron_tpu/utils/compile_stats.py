@@ -89,8 +89,14 @@ def clears() -> int:
 
 def maybe_clear(limit: int | None = None) -> bool:
     """Clear jax's compilation caches when more than ``limit`` programs
-    were built since the last clear. Returns True when a clear happened.
-    Call between tasks / test modules — never mid-kernel."""
+    were built since the last clear, OR when the central program-cache
+    registry (runtime/programs.py) holds that many live builder entries —
+    raw backend compiles miss programs restored from the persistent XLA
+    cache, and the registry's python-side memos would otherwise pin
+    kernel closures past the ceiling. Both halves clear together so the
+    documented ``auron.max_live_programs`` semantics hold at every
+    compile site. Returns True when a clear happened. Call between
+    tasks / test modules — never mid-kernel."""
     install()   # counting must be live for the ceiling to mean anything
     if limit is None:
         # single binding through the typed config layer (session override
@@ -100,14 +106,20 @@ def maybe_clear(limit: int | None = None) -> bool:
         limit = cfg.get_config().get(cfg.MAX_LIVE_PROGRAMS)
     if limit <= 0:
         return False
+    from auron_tpu.runtime import programs
     with _LOCK:
         due = _SINCE_CLEAR["count"] >= limit
         if due:
+            _SINCE_CLEAR["count"] = 0
+    if not due and programs.total_live() >= limit:
+        due = True
+        with _LOCK:
             _SINCE_CLEAR["count"] = 0
     if not due:
         return False
     import jax
     jax.clear_caches()
+    programs.clear_all()
     with _LOCK:
         _CLEARS["count"] += 1
     return True

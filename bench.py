@@ -269,6 +269,39 @@ def _child_main() -> None:
 # parent: backend health probe + dispatch
 # ---------------------------------------------------------------------------
 
+def _condense_error(text: str) -> str:
+    """Reduce a (possibly truncated, multi-line) child stderr — a python
+    traceback or a faulthandler watchdog stack dump — to ONE grep-able
+    line: the terminal exception plus the innermost frame location. The
+    recorded ``accel_error`` JSON field stays a single canonical line
+    instead of an embedded multi-line traceback."""
+    import re
+    lines = [ln.strip() for ln in (text or "").strip().splitlines()
+             if ln.strip()]
+    if not lines:
+        return ""
+    exc = next((ln for ln in reversed(lines)
+                if re.match(r"[A-Za-z_][\w.]*(Error|Exception|Interrupt"
+                            r"|Exit)\b", ln)
+                or ln.startswith("Fatal Python error")), None)
+    frames = [ln for ln in lines if ln.startswith('File "')]
+    loc = ""
+    if frames:
+        # faulthandler dumps are most-recent-call-FIRST, tracebacks
+        # most-recent-call-LAST; the truncated tail keeps the frame
+        # nearest the fault in both cases at opposite ends — prefer the
+        # last frame (traceback order), which r05-style dumps also end on
+        m = re.match(r'File "([^"]+)", line (\d+)(?:,? in (.+))?',
+                     frames[-1])
+        if m:
+            loc = f"{os.path.basename(m.group(1))}:{m.group(2)}"
+            if m.group(3):
+                loc += f" in {m.group(3).strip()}"
+    if exc is None:
+        exc = lines[-1] if not loc else "backend init failed (stack dump)"
+    return (f"{exc} [at {loc}]" if loc else exc)[:300]
+
+
 def _probe_accelerator() -> tuple[bool, str]:
     """Initialize jax in a throwaway subprocess under the AMBIENT env.
     Returns (ok, platform-or-error). A wedged accelerator client hangs at
@@ -290,7 +323,7 @@ def _probe_accelerator() -> tuple[bool, str]:
     for line in proc.stdout.splitlines():
         if line.startswith("PLATFORM="):
             return True, line.split("=", 1)[1]
-    return False, (proc.stderr.strip() or "backend init failed")[-500:]
+    return False, _condense_error(proc.stderr) or "backend init failed"
 
 
 def _run_bench_child(env: dict) -> subprocess.CompletedProcess:
@@ -326,8 +359,8 @@ def main() -> None:
             return None, f"bench child exceeded {_BENCH_TIMEOUT_S}s"
         if proc.returncode == 0 and proc.stdout.strip():
             return proc, ""
-        return None, (proc.stderr.strip() or
-                      f"bench child rc={proc.returncode}")[-500:]
+        return None, (_condense_error(proc.stderr)
+                      or f"bench child rc={proc.returncode}")
 
     proc = None
     if accel_error:

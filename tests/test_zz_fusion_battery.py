@@ -1,0 +1,49 @@
+"""Fused-vs-unfused TPC-DS differential battery (ISSUE 2 satellite).
+
+Runs a representative TPC-DS subset (>= 10 queries spanning plain aggs,
+multi-joins, OR-predicate blocks, subquery-as-join, windows, pivots and
+count-only shapes) with ``auron.fusion.enabled`` on vs off and asserts
+BIT-IDENTICAL results — fusion must only change how many XLA programs
+exist, never a value. Named test_zz_* so the time-boxed tier-1 window
+runs the fast fusion unit tests (test_fusion.py) first; full-suite runs
+execute this battery.
+"""
+
+import tempfile
+
+import pytest
+
+from auron_tpu import config as cfg
+from auron_tpu.frontend.session import Session
+from auron_tpu.it.tpcds import generate
+from auron_tpu.it.tpcds_queries import QUERIES
+
+_SCALE = 0.02
+_NAMES = ["q3", "q19", "q48", "q1", "q68", "q89",
+          "q43", "q73", "q96", "q62"]
+
+
+@pytest.fixture(scope="module")
+def tables():
+    with tempfile.TemporaryDirectory(prefix="fusion_battery_") as d:
+        yield generate(d, scale=_SCALE)
+
+
+def _q(name):
+    return next(q for q in QUERIES if q.name == name)
+
+
+@pytest.mark.parametrize("qname", _NAMES)
+def test_query_bit_identical_fused_vs_unfused(qname, tables):
+    conf = cfg.get_config()
+    q = _q(qname)
+    try:
+        conf.set("auron.fusion.enabled", False)
+        unfused = q.run(Session(), tables)
+        conf.set("auron.fusion.enabled", True)
+        fused = q.run(Session(), tables)
+    finally:
+        conf.unset("auron.fusion.enabled")
+    assert fused.num_rows == unfused.num_rows
+    assert fused.equals(unfused), \
+        f"{qname}: fused result differs from unfused (values or order)"
