@@ -221,6 +221,52 @@ KERNELS_MAX_KEY_DOMAIN = _opt(
     "kernels; plans with a larger (or unknown) bound fall back to the "
     "sort path. Hard-capped at 2^16 by the kernels' (hi, lo) byte grid "
     "decomposition.")
+# device-resident hash table (auron_tpu/hashtable)
+HASHTABLE_ENABLED = _opt(
+    "auron.hashtable.enabled", bool, True,
+    "Allow the device-resident open-addressing hash table "
+    "(auron_tpu/hashtable) on the general (unbounded-key) aggregation "
+    "path, distinct dedup, and the hash-join candidate search; off "
+    "forces the sort-based formulations everywhere "
+    "(kernels/dispatch.select_hash_agg).")
+HASHTABLE_BACKEND = _opt(
+    "auron.hashtable.backend", str, "auto",
+    "General-agg grouping backend: 'auto' routes aggregations whose "
+    "accumulators are reassociation-exact (integer/decimal sums, "
+    "min/max, first, count) through the hash table and keeps float "
+    "sums on the sort path so results stay bit-identical either way; "
+    "'hash' forces the hash table wherever its kinds are structurally "
+    "supported (float scatter-adds may differ from the sort path in "
+    "the last ulp); 'sort' disables the hash path entirely.")
+HASHTABLE_LOAD_FACTOR = _opt(
+    "auron.hashtable.load_factor", float, 0.5,
+    "Maximum occupancy of the device hash table before a power-of-two "
+    "growth re-bucket (the auron.agg.initial_capacity growth "
+    "discipline). Lower values buy shorter probe chains with more "
+    "device memory.")
+HASHTABLE_MAX_PROBE_ROUNDS = _opt(
+    "auron.hashtable.max_probe_rounds", int, 64,
+    "Probe rounds (double-hashed open addressing) the vectorized "
+    "insert/probe loop runs before declaring overflow; an overflowing "
+    "insert grows the table and retries, and pathological repeat "
+    "overflow falls back to the sort path for the rest of the stream.")
+
+# map semantics
+MAP_KEY_DEDUP_POLICY = _opt(
+    "auron.map.key_dedup_policy", str, "LAST_WIN",
+    "Duplicate-key policy of the map constructors (map, create_map, "
+    "map_from_arrays, map_from_entries, map_concat): 'LAST_WIN' keeps "
+    "the last entry per key (Spark's legacy policy — this engine's "
+    "default, because a jit-compiled kernel cannot raise data-dependent "
+    "errors); 'EXCEPTION' (Spark's default) raises a deterministic "
+    "ValueError when the construction is evaluated eagerly, and inside "
+    "a jit-fused stage — where raising is impossible — nulls the "
+    "offending rows instead. TRACE-SEMANTIC knob: it changes what a "
+    "compiled kernel computes, so it is resolved from the PROCESS-GLOBAL "
+    "config (AuronConfig.set on get_config(), or the env var) and rides "
+    "every program-cache key (runtime/programs.py trace salt); "
+    "per-ExecContext session overrides are not honored for it.")
+
 KERNELS_BACKEND = _opt(
     "auron.kernels.backend", str, "auto",
     "Dense grouped-agg backend: 'auto' compiles the Pallas VMEM kernel "
@@ -280,6 +326,19 @@ class AuronConfig:
 #: process-wide default config; ExecContext carries a per-execution one
 #: that defaults to this (the "session" layer)
 _GLOBAL = AuronConfig()
+
+#: options whose value is read DURING kernel tracing and changes what
+#: the compiled program computes (not just how the plan is shaped).
+#: Their current values ride every program-cache key as the trace salt
+#: (runtime/programs.py), so flipping one can never serve a stale trace.
+TRACE_SEMANTIC_KEYS = (MAP_KEY_DEDUP_POLICY,)
+
+
+def trace_salt() -> tuple:
+    """Current values of the trace-semantic options, resolved from the
+    process-global config (these knobs are global by contract — see
+    their docs)."""
+    return tuple(_GLOBAL.get(k) for k in TRACE_SEMANTIC_KEYS)
 
 
 def get_config() -> AuronConfig:

@@ -16,6 +16,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from auron_tpu.columnar.batch import ListColumn, PrimitiveColumn, StringColumn
@@ -389,22 +390,55 @@ def _map_result(expr, schema):
     return DataType.MAP, 0, 0
 
 
-def _dedupe_last_wins(keys, values, vev, lens):
-    """Drop entry i when a later in-range entry has the same key and
-    compact survivors left — Spark's LAST_WIN map-key dedup policy.
-    Maps are small, so the per-row M^2 compare stays tiny."""
+def _key_dedup_policy() -> str:
+    from auron_tpu import config as cfg
+    policy = cfg.get_config().get(cfg.MAP_KEY_DEDUP_POLICY)
+    if policy not in ("LAST_WIN", "EXCEPTION"):
+        raise ValueError(
+            f"auron.map.key_dedup_policy: unknown policy {policy!r} "
+            "(LAST_WIN|EXCEPTION)")
+    return policy
+
+
+def _dedupe_last_wins(keys, values, vev, lens, row_valid=None):
+    """Resolve duplicate map keys per ``auron.map.key_dedup_policy``:
+
+    - LAST_WIN (this engine's default): drop entry i when a later
+      in-range entry has the same key and compact survivors left —
+      Spark's legacy policy;
+    - EXCEPTION (Spark's default): raise a deterministic ValueError when
+      any valid row constructs a map with duplicate keys. Inside a
+      jit-fused stage the check value is a tracer — a kernel cannot
+      raise data-dependent errors — so offending ROWS null out instead
+      (returned via the row-validity mask), the same degradation the
+      null-map-key rule uses.
+
+    Maps are small, so the per-row M^2 compare stays tiny. Returns
+    (keys, values, value_valid, lens, row_valid)."""
     M = keys.shape[1]
     jj = jnp.arange(M)
     in_rng = jj[None, :] < lens[:, None]
     same = keys[:, :, None] == keys[:, None, :]
     later = jj[None, None, :] > jj[None, :, None]
     dup = jnp.any(same & later & in_rng[:, None, :], axis=2)
+    if row_valid is None:
+        row_valid = jnp.ones(keys.shape[0], bool)
+    if _key_dedup_policy() == "EXCEPTION":
+        dup_row = jnp.any(dup & in_rng, axis=1) & row_valid
+        has_dup = jnp.any(dup_row)
+        if not isinstance(has_dup, jax.core.Tracer):
+            if bool(has_dup):
+                raise ValueError(
+                    "duplicate map key (auron.map.key_dedup_policy="
+                    "EXCEPTION; set LAST_WIN to keep the last entry)")
+        row_valid = row_valid & ~dup_row
     keep = in_rng & ~dup
     order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
     keys = jnp.take_along_axis(keys, order, axis=1)
     values = jnp.take_along_axis(values, order, axis=1)
     vev = jnp.take_along_axis(vev & keep, order, axis=1)
-    return keys, values, vev, jnp.sum(keep, axis=1).astype(jnp.int32)
+    lens = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return keys, values, vev, jnp.where(row_valid, lens, 0), row_valid
 
 
 def _reject_unsupported_map_args(name, args, expr, schema):
@@ -445,9 +479,9 @@ def _map(args, expr, batch, schema, ctx):
         ok = (karr.validity & varr.validity
               & (kcol.lens == vcol.lens)
               & ~jnp.any(k_in & ~kcol.elem_valid, axis=1))
-        kv, vv, vev, lens = _dedupe_last_wins(
+        kv, vv, vev, lens, ok = _dedupe_last_wins(
             kcol.values, vcol.values, vcol.elem_valid,
-            jnp.where(ok, kcol.lens, 0))
+            jnp.where(ok, kcol.lens, 0), row_valid=ok)
         return TypedValue(MapColumn(kv, vv, vev, lens, ok), DataType.MAP)
     assert len(args) % 2 == 0 and args, "map() needs key/value pairs"
     _reject_unsupported_map_args("map", args, expr, schema)
@@ -465,8 +499,9 @@ def _map(args, expr, batch, schema, ctx):
     vv = jnp.stack([x.data for x in vals], axis=1)
     vev = jnp.stack([x.validity for x in vals], axis=1)
     ok = ~jnp.any(jnp.stack([~x.validity for x in keys], axis=1), axis=1)
-    kv, vv, vev, lens = _dedupe_last_wins(
-        kv, vv, vev, jnp.where(ok, k, 0).astype(jnp.int32))
+    kv, vv, vev, lens, ok = _dedupe_last_wins(
+        kv, vv, vev, jnp.where(ok, k, 0).astype(jnp.int32),
+        row_valid=ok)
     return TypedValue(MapColumn(kv, vv, vev, lens, ok), DataType.MAP)
 
 
@@ -562,11 +597,11 @@ def _map_from_entries(args, expr, batch, schema, ctx):
         raise NotImplementedError(
             "map_from_entries needs an array<struct<key,value>> entry "
             "list")
-    kv, vv, vev, lens = _dedupe_last_wins(
+    kv, vv, vev, lens, ok = _dedupe_last_wins(
         m.keys, m.values, m.val_valid,
-        jnp.where(args[0].validity, m.lens, 0))
-    return TypedValue(MapColumn(kv, vv, vev, lens, args[0].validity),
-                      DataType.MAP)
+        jnp.where(args[0].validity, m.lens, 0),
+        row_valid=args[0].validity)
+    return TypedValue(MapColumn(kv, vv, vev, lens, ok), DataType.MAP)
 
 
 @register("map_contains_key", DataType.BOOL)
@@ -618,8 +653,9 @@ def _map_concat(args, expr, batch, schema, ctx):
         values = splice(a.values, b.values)
         vev = splice(a.val_valid, b.val_valid, fill=False)
         ok = out.validity & nxt.validity
-        keys, values, vev, lens = _dedupe_last_wins(
-            keys, values, vev, jnp.where(ok, a.lens + b.lens, 0))
+        keys, values, vev, lens, ok = _dedupe_last_wins(
+            keys, values, vev, jnp.where(ok, a.lens + b.lens, 0),
+            row_valid=ok)
         out = TypedValue(MapColumn(keys, values, vev, lens, ok),
                          DataType.MAP)
     return out

@@ -115,6 +115,87 @@ def record_rows(decision: KernelDecision, rows: int, metrics=None) -> None:
     _count(metrics, "bytes_moved_est", est)
 
 
+@dataclass(frozen=True)
+class HashAggDecision:
+    """The general-path grouping decision: hashtable vs sort."""
+    backend: str             # hashtable | sort
+    reason: str
+    load_factor: float = 0.5
+    max_probe_rounds: int = 64
+
+    @property
+    def is_hash(self) -> bool:
+        return self.backend == "hashtable"
+
+
+#: key column dtypes with a hashtable word encoding; nested types
+#: (STRUCT/LIST/MAP) stay on the sort path
+HASH_KEY_DTYPES = frozenset(
+    {DataType.BOOL, DataType.INT8, DataType.INT16, DataType.INT32,
+     DataType.INT64, DataType.FLOAT32, DataType.FLOAT64,
+     DataType.DATE32, DataType.TIMESTAMP_US, DataType.STRING,
+     DataType.DECIMAL})
+
+
+def record_operator_choice(metrics, backend: str) -> None:
+    """Mirror the chosen grouping backend into the OPERATOR's metrics
+    (not just the shared ``kernels`` set), so the finalize snapshot
+    shows which backend each operator actually ran."""
+    _count(metrics, f"dispatch_{backend}")
+
+
+def select_hash_agg(*, key_dtypes, acc_kinds, has_float_sum: bool,
+                    conf=None, metrics=None,
+                    record: bool = True) -> HashAggDecision:
+    """The general (unbounded-key) grouping decision: the device hash
+    table (auron_tpu/hashtable) or the sort + segment-reduce path.
+
+    key_dtypes: DataType per group key (nested types fall back).
+    acc_kinds: flat device reduce kinds (ops/agg._device_kinds).
+    has_float_sum: any float-dtype 'sum' accumulator — reassociation
+    changes last-ulp results, so 'auto' keeps those on the sort path and
+    only auron.hashtable.backend=hash forces them through the table.
+    """
+    from auron_tpu import config as cfg
+    from auron_tpu.hashtable import SUPPORTED_KINDS
+    conf = conf or cfg.get_config()
+
+    def decide(backend: str, reason: str) -> HashAggDecision:
+        if record:
+            event = "selected" if backend == "hashtable" else "fallback"
+            registry.stats("hashtable").add(event)
+            _count(metrics, f"hashtable_{event}")
+        return HashAggDecision(
+            backend, reason,
+            load_factor=conf.get(cfg.HASHTABLE_LOAD_FACTOR),
+            max_probe_rounds=max(1, conf.get(
+                cfg.HASHTABLE_MAX_PROBE_ROUNDS)))
+
+    if not conf.get(cfg.HASHTABLE_ENABLED):
+        return decide("sort", "disabled")
+    choice = conf.get(cfg.HASHTABLE_BACKEND)
+    if choice == "sort":
+        return decide("sort", "backend_config")
+    if choice not in ("auto", "hash"):
+        raise ValueError(
+            f"auron.hashtable.backend: unknown backend {choice!r} "
+            "(auto|hash|sort)")
+    kds = tuple(key_dtypes)
+    if not kds:
+        return decide("sort", "no_keys")
+    bad = [d for d in kds if d not in HASH_KEY_DTYPES]
+    if bad:
+        return decide("sort", f"key_dtype:{bad[0].value}")
+    for kind in acc_kinds:
+        if kind not in SUPPORTED_KINDS:
+            return decide("sort", f"acc_kind:{kind}")
+    if has_float_sum and choice != "hash":
+        # scatter-add reassociates float sums; 'auto' keeps results
+        # bit-identical to the sort path by falling back
+        return decide("sort", "float_sum_inexact")
+    return decide("hashtable", "eligible")
+
+
 def select_grouped_agg(*, key_domain: Optional[int], key_dtypes,
                        agg_fns, value_dtypes, conf=None, metrics=None,
                        platform: Optional[str] = None,

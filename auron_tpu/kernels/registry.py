@@ -132,6 +132,22 @@ DENSE_MATMUL = register(KernelInfo(
     platforms=("*",),
 ))
 
+HASHTABLE = register(KernelInfo(
+    name="hashtable",
+    description=(
+        "Device-resident open-addressing hash table "
+        "(auron_tpu/hashtable): claim-owner probe rounds (one "
+        "scatter-min + gathers per round, compacted tail) build the "
+        "group table in one fused program per batch; accumulators "
+        "scatter into their slots. Unbounded key domains, "
+        "primitive/string/decimal128 keys, reassociation-exact reduce "
+        "kinds (sum/min/max/or/first); the general-agg replacement for "
+        "sort + segment-reduce."),
+    reductions=("sum", "count", "min", "max", "or", "first"),
+    max_key_domain=0,            # unbounded
+    platforms=("*",),
+))
+
 SORT_GENERAL = register(KernelInfo(
     name="sort",
     description=(

@@ -649,12 +649,19 @@ def _table_nbytes(tbl) -> int:
                   else a.nbytes for a in accs))
 
 
+def _lvl_nbytes(lvl) -> int:
+    from auron_tpu.hashtable import HashAggState
+    if isinstance(lvl, HashAggState):
+        return lvl.nbytes()
+    return _table_nbytes(lvl)
+
+
 def _state_nbytes(state) -> int:
-    """Device bytes of a (main, hot) accumulator state, from array
-    metadata only."""
+    """Device bytes of a (main, hot) accumulator state — or a
+    hash-table-backed state level — from array metadata only."""
     if state is None:
         return 0
-    return sum(_table_nbytes(lvl) for lvl in state if lvl is not None)
+    return sum(_lvl_nbytes(lvl) for lvl in state if lvl is not None)
 
 
 #: single shared NaN object so NaN group keys rendezvous in host dicts
@@ -1122,6 +1129,20 @@ class _AggSpillConsumer:
         self.spills = []
 
 
+class _HashPathCtl:
+    """Per-execution hash-path control: the dispatch decision's knobs
+    plus the mid-stream fallback latch (pathological probe overflow
+    disables the hash path for the rest of the stream)."""
+
+    __slots__ = ("load_factor", "max_probe_rounds", "metrics", "disabled")
+
+    def __init__(self, decision, metrics):
+        self.load_factor = decision.load_factor
+        self.max_probe_rounds = decision.max_probe_rounds
+        self.metrics = metrics
+        self.disabled = False
+
+
 class AggOp(PhysicalOp):
     """mode: 'partial' emits (keys..., state...); 'final' consumes state
     columns; 'complete' does full agg in one op (reference: AggMode,
@@ -1471,7 +1492,70 @@ class AggOp(PhysicalOp):
     #: O(S / _HOT_FACTOR) per batch (LSM-style two-level state)
     _HOT_FACTOR = 8
 
-    def _merge(self, state, keys, accs, live, elapsed):
+    def _hash_dispatch(self, ctx: ExecContext):
+        """Consult the general-path grouping policy (hashtable vs sort,
+        kernels/dispatch.select_hash_agg)."""
+        from auron_tpu.exprs.eval import infer_field
+        from auron_tpu.kernels import dispatch as kdispatch
+        in_schema = self.child.schema()
+        key_dts = tuple(infer_field(e, in_schema, "k").dtype
+                        for e in self.group_exprs)
+        has_float_sum = any(
+            kind == "sum" and fdt in (DataType.FLOAT32, DataType.FLOAT64)
+            for spec in self.specs
+            for (_f, fdt, kind) in _device_fields(spec))
+        return kdispatch.select_hash_agg(
+            key_dtypes=key_dts, acc_kinds=tuple(self._device_kinds()),
+            has_float_sum=has_float_sum, conf=ctx.conf,
+            metrics=ctx.metrics_for("kernels"))
+
+    def _merge_hash(self, state, keys, accs, live, elapsed, ht):
+        """Hash-table update: the batch folds into the device table in
+        one fused program (no per-batch state sort/merge). A sorted
+        (tbl, None) state — the partial-skip decision's compaction, or a
+        drained spill fold — re-enters the table as group-partial
+        contributions (the same associativity the sorted merge relies
+        on). Pathological probe overflow latches the sort path for the
+        rest of the stream, salvaging the table as a sorted state."""
+        from auron_tpu.hashtable import HashAggState, HashTableOverflow
+        if state is not None and isinstance(state[0], HashAggState):
+            hs = state[0]
+            pending = [(keys, accs, live)]
+        else:
+            hs = HashAggState(
+                self._device_kinds(),
+                initial_capacity=self.initial_capacity,
+                load_factor=ht.load_factor,
+                max_probe_rounds=ht.max_probe_rounds)
+            pending = [self._state_contributions(self._state_batch(lvl))
+                       for lvl in (state or ()) if lvl is not None]
+            pending.append((keys, accs, live))
+        for i, (k2, a2, l2) in enumerate(pending):
+            try:
+                with timer(elapsed):    # update syncs via its readback
+                    hs.update(k2, a2, l2)
+            except HashTableOverflow:
+                # fall back mid-stream: export whatever the table holds
+                # (updates are transactional — the failed batch is NOT
+                # in it) and push it plus the unconsumed contributions
+                # through the sort path
+                ht.disabled = True
+                ht.metrics.counter("hashtable_overflow_fallback").add(1)
+                tbl = hs.to_sorted_table()
+                sorted_state = None if tbl is None else \
+                    (self._shrink_table(tbl, hs.count), None)
+                for (k3, a3, l3) in pending[i:]:
+                    sorted_state = self._merge_sorted(
+                        sorted_state, k3, a3, l3, elapsed)
+                return sorted_state
+        return (hs,)
+
+    def _merge(self, state, keys, accs, live, elapsed, ht=None):
+        if ht is not None and not ht.disabled:
+            return self._merge_hash(state, keys, accs, live, elapsed, ht)
+        return self._merge_sorted(state, keys, accs, live, elapsed)
+
+    def _merge_sorted(self, state, keys, accs, live, elapsed):
         """state: None | (main, hot), each None | (keys, accs, num_groups,
         capacity, hashes). Two-level update: every batch merges into the
         small hot table (O(B log B + hot)); the hot table folds into main
@@ -1497,9 +1581,17 @@ class AggOp(PhysicalOp):
 
     def _compact(self, state, elapsed):
         """Collapse (main, hot) into one table for emit / spill / the skip
-        decision. Returns a 5-tuple or None."""
+        decision. Returns a 5-tuple or None. A hash-table-backed state
+        exports through its hash-sorted conversion."""
         if state is None:
             return None
+        from auron_tpu.hashtable import HashAggState
+        if isinstance(state[0], HashAggState):
+            hs = state[0]
+            with timer(elapsed):
+                tbl = hs.to_sorted_table()
+            return None if tbl is None else \
+                self._shrink_table(tbl, hs.count)
         main, hot = state
         if main is None:
             return hot
@@ -1691,6 +1783,12 @@ class AggOp(PhysicalOp):
                 for (_f, _d, kind) in _device_fields(spec)]
 
     def _state_batch(self, state) -> DeviceBatch:
+        from auron_tpu.hashtable import HashAggState
+        if isinstance(state, HashAggState):
+            # spill / fold handoff: export restores the hash-sorted run
+            # invariant the bucket spills rely on
+            state = self._shrink_table(state.to_sorted_table(),
+                                       state.count)
         keys, accs, num_groups, cap, _hashes = state
         valid = jnp.arange(cap, dtype=jnp.int32) < num_groups
         cols = list(keys)
@@ -1950,12 +2048,21 @@ class AggOp(PhysicalOp):
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from auron_tpu import config as cfg
+        from auron_tpu.kernels import dispatch as kdispatch
         metrics = ctx.metrics_for(self.name)
         decision = self._dense_dispatch(ctx)
         if decision is not None:
+            # the chosen backend lands in THIS operator's finalize
+            # metrics, so gate logs show which path each agg actually ran
+            kdispatch.record_operator_choice(metrics, decision.kernel)
             return count_output(
                 self._dense_domain_stream(partition, ctx, decision,
                                           metrics), metrics)
+        ht_decision = self._hash_dispatch(ctx)
+        ht_ctl = _HashPathCtl(ht_decision, metrics) \
+            if ht_decision.is_hash else None
+        kdispatch.record_operator_choice(
+            metrics, "hashtable" if ht_ctl is not None else "sort")
         elapsed = metrics.counter("elapsed_compute")
         in_schema = self.child.schema()
         ectx = EvalContext(partition_id=partition)
@@ -1999,7 +2106,8 @@ class AggOp(PhysicalOp):
                         # state lives in the consumer between merges so an
                         # external victim spill can take it atomically
                         state = consumer.take_state()
-                    state = self._merge(state, keys, accs, live, elapsed)
+                    state = self._merge(state, keys, accs, live, elapsed,
+                                        ht_ctl)
                     if consumer is not None:
                         state = consumer.observe(state)
                     if not skip_pending:
@@ -2033,7 +2141,7 @@ class AggOp(PhysicalOp):
                                 k2, a2, l2 = self._state_contributions(
                                     spilled)
                                 state = self._merge(state, k2, a2, l2,
-                                                    elapsed)
+                                                    elapsed, ht_ctl)
                         yield self._emit(self._compact(state, elapsed),
                                          in_schema, host)
                         state = None
@@ -2053,7 +2161,7 @@ class AggOp(PhysicalOp):
                     for spilled in consumer.read_spilled_states():
                         keys, accs, live = self._state_contributions(spilled)
                         state = self._merge(state, keys, accs, live,
-                                            elapsed)
+                                            elapsed, ht_ctl)
                 final_tbl = self._compact(state, elapsed)
                 if final_tbl is None:
                     if not self.group_exprs and self.mode in ("final", "complete"):

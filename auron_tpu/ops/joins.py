@@ -57,26 +57,46 @@ def _take_cols(cols, idx, valid):
     return tuple(gather_column(c, idx, valid) for c in cols)
 
 
-def _probe_count_body(probe: DeviceBatch, build_hashes, key_exprs: tuple,
+def _candidate_lookup(h, index_kind: str, index_args: tuple, rounds: int):
+    """(lo, counts) of each probe hash's candidate run in the sorted
+    build table — via two binary searches ('sorted'), or one hash-table
+    probe of the run index ('ht', auron_tpu/hashtable). Both return the
+    EXACT same (lo, counts) for present hashes and counts == 0 for
+    absent ones, so downstream expand + exact-key verification make the
+    two candidate searches bit-identical end to end."""
+    if index_kind == "ht":
+        from auron_tpu.hashtable.core import EMPTY, probe_hash_index
+        idx_h, idx_lo, idx_cnt = index_args
+        live = h != EMPTY       # null/dead probe rows match nothing
+        slot, found = probe_hash_index(idx_h, h, live, rounds)
+        lo = jnp.where(found, idx_lo[slot], 0).astype(jnp.int32)
+        counts = jnp.where(found, idx_cnt[slot], 0).astype(jnp.int32)
+        return lo, counts
+    (build_hashes,) = index_args
+    lo = jnp.searchsorted(build_hashes, h, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(build_hashes, h, side="right").astype(jnp.int32)
+    return lo, hi - lo
+
+
+def _probe_count_body(probe: DeviceBatch, index_kind: str,
+                      index_args: tuple, rounds: int, key_exprs: tuple,
                       in_schema: Schema):
-    """Traced probe-side candidate search: key hashes binary-searched
-    into the sorted build table."""
+    """Traced probe-side candidate search over the build-side index."""
     ctx = EvalContext()
     keys = tuple(evaluate(e, probe, in_schema, ctx).col for e in key_exprs)
     h = _key_hashes(keys, probe.capacity, probe.row_mask(), _NULL_PROBE)
-    lo = jnp.searchsorted(build_hashes, h, side="left").astype(jnp.int32)
-    hi = jnp.searchsorted(build_hashes, h, side="right").astype(jnp.int32)
-    counts = hi - lo
+    lo, counts = _candidate_lookup(h, index_kind, index_args, rounds)
     total = jnp.sum(counts)
     return h, lo, counts, total
 
 
 @program_cache("ops.joins.probe_count", maxsize=256)
 def _probe_count_kernel(key_exprs: tuple, in_schema: Schema, capacity: int,
-                        build_cap: int):
+                        build_cap: int, index_kind: str, rounds: int):
     @jax.jit
-    def kernel(probe: DeviceBatch, build_hashes):
-        return _probe_count_body(probe, build_hashes, key_exprs, in_schema)
+    def kernel(probe: DeviceBatch, *index_args):
+        return _probe_count_body(probe, index_kind, index_args, rounds,
+                                 key_exprs, in_schema)
 
     return kernel
 
@@ -91,30 +111,34 @@ _PROBE_PROGRAMS = programs.register(
 
 def _fused_probe_program(frag_keys: tuple, key_exprs: tuple,
                          in_schema: Schema, out_schema: Schema,
-                         capacity: int, build_cap: int, fragments):
-    """One program per (probe chain, join keys, schema, capacities):
-    member fragments thread the batch, then the probe-count body runs on
-    the chain output. Returns the transformed batch too — the join's
-    match/gather phase consumes it, and the downstream eager key
-    evaluation (_keys_match) sees exactly the batch the standalone chain
-    would have produced, keeping fused results bit-identical."""
+                         capacity: int, build_cap: int, fragments,
+                         index_kind: str, rounds: int):
+    """One program per (probe chain, join keys, schema, capacities,
+    candidate-search backend): member fragments thread the batch, then
+    the probe-count body runs on the chain output. Returns the
+    transformed batch too — the join's match/gather phase consumes it,
+    and the downstream eager key evaluation (_keys_match) sees exactly
+    the batch the standalone chain would have produced, keeping fused
+    results bit-identical."""
 
     def build():
         from auron_tpu.ops.fused import thread_fragments
 
         @jax.jit
-        def kernel(batch: DeviceBatch, partition_id, carries, build_hashes):
+        def kernel(batch: DeviceBatch, partition_id, carries,
+                   *index_args):
             outs, new_carries = thread_fragments(fragments, batch,
                                                  partition_id, carries)
             (b,) = outs   # fan-out chains never take this path
             h, lo, counts, total = _probe_count_body(
-                b, build_hashes, key_exprs, out_schema)
+                b, index_kind, index_args, rounds, key_exprs, out_schema)
             return b, lo, counts, total, jnp.stack(new_carries)
 
         return kernel
 
     return _PROBE_PROGRAMS.get_or_build(
-        (frag_keys, key_exprs, in_schema, capacity, build_cap), build)
+        (frag_keys, key_exprs, in_schema, capacity, build_cap,
+         index_kind, rounds), build)
 
 
 @program_cache("ops.joins.expand", maxsize=256)
@@ -138,10 +162,11 @@ def _expand_kernel(out_cap: int, capacity: int):
 
 
 class _BuildSide:
-    """Sorted-by-hash build table."""
+    """Sorted-by-hash build table, plus (when enabled and the build side
+    fits) the hash-table candidate index over its hash runs."""
 
     def __init__(self, batch: DeviceBatch, schema: Schema, key_exprs,
-                 metrics):
+                 metrics, conf=None):
         self.schema = schema
         cap = batch.capacity
         ctx = EvalContext()
@@ -156,6 +181,33 @@ class _BuildSide:
         self.capacity = cap
         # matched mask for right/full joins, or-accumulated across batches
         self.matched = jnp.zeros(cap, bool)
+        # hash-run candidate index (auron_tpu/hashtable): probe hash →
+        # (run lo, run length) in O(probe rounds) gathers instead of two
+        # O(log B) searchsorted passes; None keeps the searchsorted path
+        # (disabled, too large, or sentinel-colliding hashes)
+        self.index = None
+        self.rounds = 64
+        if conf is not None:
+            from auron_tpu import config as cfg
+            if conf.get(cfg.HASHTABLE_ENABLED) \
+                    and conf.get(cfg.HASHTABLE_BACKEND) != "sort":
+                from auron_tpu.hashtable import build_join_index
+                self.rounds = max(1, conf.get(
+                    cfg.HASHTABLE_MAX_PROBE_ROUNDS))
+                self.index = build_join_index(self.hashes, self.rounds)
+        if metrics is not None:
+            metrics.counter(
+                "dispatch_ht_index" if self.index is not None
+                else "dispatch_searchsorted").add(1)
+
+    @property
+    def index_kind(self) -> str:
+        return "ht" if self.index is not None else "sorted"
+
+    def index_args(self) -> tuple:
+        if self.index is not None:
+            return (self.index.th, self.index.lo, self.index.cnt)
+        return (self.hashes,)
 
 
 def _keys_match(probe_keys, probe_idx, build_keys, build_idx) -> jax.Array:
@@ -247,7 +299,7 @@ class HashJoinOp(PhysicalOp):
                                                         probe_schema)
                     return
                 side = _BuildSide(merged, build_schema, self.build_keys,
-                                  metrics)
+                                  metrics, conf=ctx.conf)
 
                 fold = self._probe_fold(ctx)
                 if fold is not None:
@@ -320,11 +372,13 @@ class HashJoinOp(PhysicalOp):
             ctx.check_cancelled()
             kern, built = _fused_probe_program(
                 frag_keys, self.probe_keys, in_schema, probe_schema,
-                raw.capacity, side.capacity, fragments)
+                raw.capacity, side.capacity, fragments,
+                side.index_kind, side.rounds)
             (built_c if built else hit_c).add(1)
             with timer(elapsed, sync=_sync) as t:
                 probe, lo, counts, total, carries = t.track(
-                    kern(raw, jnp.int32(partition), carries, side.hashes))
+                    kern(raw, jnp.int32(partition), carries,
+                         *side.index_args()))
             yield from self._probe_one(probe, side, probe_schema,
                                        build_schema, elapsed, _sync,
                                        pre=(lo, counts, total))
@@ -334,9 +388,11 @@ class HashJoinOp(PhysicalOp):
         cap = probe.capacity
         if pre is None:
             kern = _probe_count_kernel(self.probe_keys, probe_schema, cap,
-                                       side.capacity)
+                                       side.capacity, side.index_kind,
+                                       side.rounds)
             with timer(elapsed, sync=_sync) as t:
-                _h, lo, counts, total = t.track(kern(probe, side.hashes))
+                _h, lo, counts, total = t.track(
+                    kern(probe, *side.index_args()))
         else:   # the fused probe program already ran the candidate search
             lo, counts, total = pre
         total_i = int(total)

@@ -68,6 +68,12 @@ class ProgramCache:
         self._hits_at_clear = 0
 
     def get_or_build(self, key, builder: Callable):
+        # trace-semantic config values partition every cache key: a
+        # builder's trace may read them (e.g. the map-key dedup policy),
+        # so a changed value must build a FRESH function object — jax's
+        # jit cache keys on function identity, making the re-trace real
+        from auron_tpu import config as _cfg
+        key = (key, _cfg.trace_salt())
         with self._lock:
             if key in self._memo:
                 self._memo.move_to_end(key)

@@ -133,6 +133,17 @@ def bench_device_general(batch) -> float:
                          batch)
 
 
+def bench_device_hash(batch) -> float:
+    """The general path re-based on the device hash table
+    (auron_tpu/hashtable): __graft_entry__._q01_kernel_hash — claim-owner
+    probe rounds + slot-indexed accumulator scatters, no sort. Measured
+    additively next to the sort-based general number; the ISSUE 3 gate is
+    hash >= 1.5x sort on the CPU snapshot."""
+    import __graft_entry__ as graft
+    return _bench_kernel(graft._q01_kernel_hash, max(1, ITERS // 4),
+                         batch)
+
+
 def bench_cpu_reference(threads: int = 1) -> float:
     """Same query via pyarrow's vectorized C++ kernels.
 
@@ -240,6 +251,15 @@ def _child_main() -> None:
             _snapshot_partial(result)   # upgrade the snapshot in place
     except Exception as e:   # additive metric: never lose the dense one
         result["general_agg_error"] = str(e)[:300]
+    try:
+        # hash-table general path (auron_tpu/hashtable), additive next
+        # to the sort-based general number — same snapshot protocol
+        result["hash_agg_rows_per_sec"] = round(
+            bench_device_hash(batch), 1)
+        if platform != "cpu":
+            _snapshot_partial(result)
+    except Exception as e:   # additive: never lose the earlier data
+        result["hash_agg_error"] = str(e)[:300]
     if platform == "tpu":
         # the kernel dispatch would pick on-chip (kernels/dispatch.py):
         # measured additively so the next healthy window reports its

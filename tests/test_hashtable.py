@@ -1,0 +1,455 @@
+"""Differential battery for the device hash table (auron_tpu/hashtable).
+
+The hash path must be BIT-IDENTICAL to the sort path through the real
+AggOp — strict ``pa.Table.equals``, values AND group order — across null
+keys, NaN/-0.0 float keys, string and decimal128 keys, duplicate-heavy
+and all-distinct distributions, multi-batch streams, and inputs that
+force repeated capacity growths. Also covered: the dispatch policy's
+fallback matrix, the per-operator dispatch metrics, the mid-stream
+overflow fallback, the join candidate index equivalence, and the
+hash-agg compile budget (program-count regressions fail here).
+
+The heavier TPC-DS subset battery lives in test_zz_hashtable_battery.py
+(the same fast-tests-first split as the fusion battery).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from auron_tpu import config as cfg
+from auron_tpu.columnar.arrow_bridge import schema_from_arrow, to_arrow
+from auron_tpu.columnar.batch import PrimitiveColumn, StringColumn
+from auron_tpu.columnar.schema import DataType
+from auron_tpu.exprs import ir
+from auron_tpu.io.parquet import MemoryScanOp
+from auron_tpu.kernels import dispatch
+from auron_tpu.ops.agg import AggOp
+from auron_tpu.ops.base import ExecContext
+
+C = ir.ColumnRef
+
+AGGS = [ir.AggFunction("sum", C(1)), ir.AggFunction("count", C(1)),
+        ir.AggFunction("avg", C(1)), ir.AggFunction("min", C(1)),
+        ir.AggFunction("max", C(1)), ir.AggFunction("first", C(1)),
+        ir.AggFunction("count_star", None)]
+NAMES = ["s", "c", "a", "mn", "mx", "f", "cs"]
+
+
+def _mem_scan(rbs, capacity=64):
+    if not isinstance(rbs, list):
+        rbs = [rbs]
+    return MemoryScanOp([rbs], schema_from_arrow(rbs[0].schema),
+                        capacity=capacity)
+
+
+def _collect(op, ctx=None) -> pa.Table:
+    ctx = ctx or ExecContext()
+    batches = [to_arrow(b, op.schema()) for b in op.execute(0, ctx)
+               if int(b.num_rows)]
+    if not batches:
+        from auron_tpu.columnar.arrow_bridge import schema_to_arrow
+        return schema_to_arrow(op.schema()).empty_table()
+    return pa.concat_tables(
+        pa.Table.from_batches([b]) for b in batches).combine_chunks()
+
+
+def _rbs(keys: pa.Array, vals: pa.Array, rows_per_batch=64):
+    out = []
+    for i in range(0, len(keys), rows_per_batch):
+        out.append(pa.record_batch(
+            {"k": keys[i:i + rows_per_batch],
+             "v": vals[i:i + rows_per_batch]}))
+    return out
+
+
+def _assert_identical(h: pa.Table, s: pa.Table) -> None:
+    """Bit-identical: same schema, same row ORDER, float cells compared
+    by their IEEE bits (pa.Table.equals would call NaN != NaN and hide
+    a -0.0/0.0 swap; this is the stricter claim the battery makes)."""
+    import struct
+    assert h.schema.equals(s.schema)
+    assert h.num_rows == s.num_rows
+
+    def canon(t):
+        return [tuple(struct.pack("<d", v) if isinstance(v, float) else v
+                      for v in r.values()) for r in t.to_pylist()]
+
+    assert canon(h) == canon(s)
+
+
+def _both(rbs, aggs=None, names=None, initial_capacity=64, capacity=64):
+    """(hash table, sort table) for the same AggOp plan — the hash run
+    asserts the hash backend actually engaged."""
+    aggs = AGGS if aggs is None else aggs
+    names = NAMES if names is None else names
+    results = {}
+    for backend in ("hash", "sort"):
+        conf = cfg.AuronConfig({cfg.HASHTABLE_BACKEND: backend})
+        op = AggOp(_mem_scan(rbs, capacity=capacity), [C(0)], aggs,
+                   mode="complete", group_names=["k"], agg_names=names,
+                   initial_capacity=initial_capacity)
+        ctx = ExecContext(config=conf)
+        results[backend] = _collect(op, ctx)
+        snap = ctx.metrics["agg"].snapshot()
+        assert snap.get(f"dispatch_{'hashtable' if backend == 'hash' else 'sort'}", 0) == 1, snap
+    return results["hash"], results["sort"]
+
+
+def _vals_int(rng, n):
+    v = rng.integers(-1000, 1000, n)
+    return pa.array(v, pa.int64(), mask=rng.random(n) < 0.2)
+
+
+class TestDifferentialBattery:
+    """hash == sort, strict Table.equals (values AND group order)."""
+
+    def test_int64_keys_with_nulls_duplicate_heavy(self):
+        rng = np.random.default_rng(0)
+        n = 1500
+        k = pa.array(rng.integers(0, 40, n), pa.int64(),
+                     mask=rng.random(n) < 0.1)   # null keys group too
+        h, s = _both(_rbs(k, _vals_int(rng, n)))
+        assert h.num_rows == s.num_rows > 0
+        assert h.equals(s)
+
+    def test_all_distinct_keys(self):
+        rng = np.random.default_rng(1)
+        n = 400
+        k = pa.array(np.arange(n), pa.int64())
+        # pre-sized table: growth is covered by its own test below
+        h, s = _both(_rbs(k, _vals_int(rng, n)), initial_capacity=1024)
+        assert h.num_rows == n
+        assert h.equals(s)
+
+    def test_float_keys_nan_and_negzero(self):
+        rng = np.random.default_rng(2)
+        n = 800
+        pool = np.array([0.0, -0.0, np.nan, 1.5, -1.5, 2.25])
+        k = pa.array(pool[rng.integers(0, len(pool), n)], pa.float64(),
+                     mask=rng.random(n) < 0.15)
+        h, s = _both(_rbs(k, _vals_int(rng, n)))
+        # NaN == NaN and -0.0 == 0.0 under Spark key semantics: the
+        # distinct groups are {0.0, NaN, 1.5, -1.5, 2.25, NULL}
+        assert h.num_rows == 6
+        _assert_identical(h, s)
+
+    def test_string_keys(self):
+        rng = np.random.default_rng(3)
+        n = 900
+        pool = ["", "a", "aa", "widget", "widget-2", "a long string key",
+                None, "ünicøde"]
+        k = pa.array([pool[i] for i in rng.integers(0, len(pool), n)],
+                     pa.string())
+        h, s = _both(_rbs(k, _vals_int(rng, n)))
+        assert h.num_rows == len(pool)
+        assert h.equals(s)
+
+    def test_decimal128_keys(self):
+        from decimal import Decimal
+        rng = np.random.default_rng(4)
+        n = 600
+        pool = [Decimal("12345678901234567890.12"),
+                Decimal("-999999999999999999999.99"),
+                Decimal("0.01"), Decimal("0.00"), None]
+        k = pa.array([pool[i] for i in rng.integers(0, len(pool), n)],
+                     pa.decimal128(23, 2))
+        h, s = _both(_rbs(k, _vals_int(rng, n)))
+        assert h.num_rows == len(pool)
+        assert h.equals(s)
+
+    def test_forced_capacity_growths(self):
+        """2000 distinct keys against a 16-slot initial table: at least
+        two power-of-two re-buckets must run (visible at the central
+        registry's hashtable.agg_grow site), and results stay exact."""
+        from auron_tpu.runtime import programs
+        rng = np.random.default_rng(5)
+        n = 500
+        k = pa.array(rng.permutation(n), pa.int64())
+        grow = programs.site("hashtable.agg_grow")
+        before = grow.builds + grow.hits if grow else 0
+        h, s = _both(_rbs(k, _vals_int(rng, n)), initial_capacity=64)
+        grow = programs.site("hashtable.agg_grow")
+        assert grow is not None
+        assert (grow.builds + grow.hits) - before >= 2
+        assert h.num_rows == n
+        assert h.equals(s)
+
+    def test_multi_batch_first_semantics(self):
+        """'first' must pick the globally first row per group across
+        batches in both paths."""
+        n = 512
+        k = pa.array([i % 7 for i in range(n)], pa.int64())
+        v = pa.array(list(range(n)), pa.int64())
+        h, s = _both(_rbs(k, v, rows_per_batch=32),
+                     aggs=[ir.AggFunction("first", C(1))], names=["f"])
+        assert h.num_rows == 7
+        assert h.equals(s)
+        got = {r["k"]: r["f"] for r in h.to_pylist()}
+        assert got == {i: i for i in range(7)}   # first occurrence
+
+    def test_distinct_no_aggs(self):
+        """SELECT DISTINCT lowers to a keyed AggOp with no aggregates —
+        pure hash-table dedup."""
+        rng = np.random.default_rng(6)
+        n = 400
+        k = pa.array(rng.integers(0, 64, n), pa.int64(),
+                     mask=rng.random(n) < 0.1)
+        h, s = _both(_rbs(k, _vals_int(rng, n)), aggs=[], names=[])
+        assert h.num_rows == 65
+        assert h.equals(s)
+
+    def test_default_auto_matches_sort_exactly(self):
+        """The DEFAULT config (auto) must already be bit-identical —
+        integer accumulators route through the table, so this is the
+        production-path differential."""
+        rng = np.random.default_rng(7)
+        n = 1200
+        k = pa.array(rng.integers(0, 100, n), pa.int64())
+        rbs = _rbs(k, _vals_int(rng, n))
+        auto = _collect(AggOp(_mem_scan(rbs), [C(0)], AGGS,
+                              mode="complete", group_names=["k"],
+                              agg_names=NAMES))
+        conf = cfg.AuronConfig({cfg.HASHTABLE_BACKEND: "sort"})
+        sort = _collect(AggOp(_mem_scan(rbs), [C(0)], AGGS,
+                              mode="complete", group_names=["k"],
+                              agg_names=NAMES),
+                        ExecContext(config=conf))
+        assert auto.equals(sort)
+
+
+class TestDispatchPolicy:
+    INT = (DataType.INT64,)
+
+    def _select(self, conf=None, **kw):
+        args = dict(key_dtypes=self.INT, acc_kinds=("sum", "or"),
+                    has_float_sum=False, conf=conf or cfg.AuronConfig())
+        args.update(kw)
+        return dispatch.select_hash_agg(**args)
+
+    def test_eligible(self):
+        d = self._select()
+        assert (d.backend, d.reason) == ("hashtable", "eligible")
+        assert d.is_hash
+
+    def test_disabled_falls_back(self):
+        conf = cfg.AuronConfig({cfg.HASHTABLE_ENABLED: False})
+        assert self._select(conf=conf).reason == "disabled"
+
+    def test_backend_sort_falls_back(self):
+        conf = cfg.AuronConfig({cfg.HASHTABLE_BACKEND: "sort"})
+        assert self._select(conf=conf).reason == "backend_config"
+
+    def test_no_keys_falls_back(self):
+        assert self._select(key_dtypes=()).reason == "no_keys"
+
+    def test_nested_keys_fall_back(self):
+        d = self._select(key_dtypes=(DataType.STRUCT,))
+        assert d.reason == "key_dtype:struct"
+
+    def test_collect_kind_falls_back(self):
+        d = self._select(acc_kinds=("collect_set",))
+        assert d.reason == "acc_kind:collect_set"
+
+    def test_float_sum_auto_falls_back_hash_forces(self):
+        d = self._select(has_float_sum=True)
+        assert d.reason == "float_sum_inexact"
+        conf = cfg.AuronConfig({cfg.HASHTABLE_BACKEND: "hash"})
+        d = self._select(conf=conf, has_float_sum=True)
+        assert d.is_hash
+
+    def test_knobs_ride_the_decision(self):
+        conf = cfg.AuronConfig({cfg.HASHTABLE_LOAD_FACTOR: 0.25,
+                                cfg.HASHTABLE_MAX_PROBE_ROUNDS: 17})
+        d = self._select(conf=conf)
+        assert (d.load_factor, d.max_probe_rounds) == (0.25, 17)
+
+
+class TestOverflowFallback:
+    def test_mid_stream_fallback_is_exact(self, monkeypatch):
+        """When growth hits the capacity wall, the operator must salvage
+        the table as sorted state and finish on the sort path with
+        exact results."""
+        from auron_tpu.hashtable import agg as htagg
+        monkeypatch.setattr(htagg, "_MAX_CAPACITY", 64)
+        rng = np.random.default_rng(8)
+        n = 400
+        k = pa.array(rng.permutation(n), pa.int64())   # 400 distinct
+        rbs = _rbs(k, _vals_int(rng, n))
+        conf = cfg.AuronConfig({cfg.HASHTABLE_BACKEND: "hash"})
+        ctx = ExecContext(config=conf)
+        got = _collect(AggOp(_mem_scan(rbs), [C(0)], AGGS,
+                             mode="complete", group_names=["k"],
+                             agg_names=NAMES, initial_capacity=16), ctx)
+        assert ctx.metrics["agg"].snapshot().get(
+            "hashtable_overflow_fallback", 0) >= 1
+        want = _collect(AggOp(_mem_scan(rbs), [C(0)], AGGS,
+                              mode="complete", group_names=["k"],
+                              agg_names=NAMES),
+                        ExecContext(config=cfg.AuronConfig(
+                            {cfg.HASHTABLE_BACKEND: "sort"})))
+        assert got.num_rows == n
+        # fallback re-orders state relative to a pure-sort run (the
+        # salvaged table becomes the first merge input), so compare as
+        # key-indexed rows rather than positionally
+        gk = {r["k"]: tuple(r[c] for c in NAMES) for r in got.to_pylist()}
+        wk = {r["k"]: tuple(r[c] for c in NAMES)
+              for r in want.to_pylist()}
+        assert gk == wk
+
+
+class TestJoinIndex:
+    def test_join_matches_searchsorted_exactly(self):
+        from auron_tpu.ops.joins import HashJoinOp
+        rng = np.random.default_rng(9)
+        n = 600
+        probe = pa.record_batch({
+            "k": pa.array(rng.integers(0, 60, n), pa.int64(),
+                          mask=rng.random(n) < 0.1),
+            "p": pa.array(rng.integers(0, 100, n), pa.int64())})
+        build = pa.record_batch({
+            "bk": pa.array(rng.integers(0, 50, 120), pa.int64(),
+                           mask=rng.random(120) < 0.1),
+            "b": pa.array(rng.integers(0, 100, 120), pa.int64())})
+
+        def run(jt, enabled):
+            conf = cfg.AuronConfig({cfg.HASHTABLE_ENABLED: enabled})
+            op = HashJoinOp(_mem_scan(probe, 1024),
+                            _mem_scan(build, 128), [C(0)], [C(0)], jt)
+            ctx = ExecContext(config=conf)
+            t = _collect(op, ctx)
+            snap = ctx.metrics["hash_join"].snapshot()
+            key = "dispatch_ht_index" if enabled \
+                else "dispatch_searchsorted"
+            assert snap.get(key, 0) == 1, snap
+            return t
+
+        for jt in ("inner", "left", "semi", "anti", "full"):
+            with_idx = run(jt, True)
+            without = run(jt, False)
+            assert with_idx.equals(without), jt
+
+    def test_degenerate_probe_round_budget_stays_exact(self):
+        """max_probe_rounds=1: inserts must never place keys deeper than
+        lookups may walk (or the index must disable itself) — join
+        results stay identical to searchsorted either way."""
+        from auron_tpu.ops.joins import HashJoinOp
+        rng = np.random.default_rng(12)
+        n = 256
+        probe = pa.record_batch({
+            "k": pa.array(rng.integers(0, 40, n), pa.int64()),
+            "p": pa.array(rng.integers(0, 100, n), pa.int64())})
+        build = pa.record_batch({
+            "bk": pa.array(rng.integers(0, 40, 96), pa.int64()),
+            "b": pa.array(rng.integers(0, 100, 96), pa.int64())})
+
+        def run(enabled, rounds=1):
+            conf = cfg.AuronConfig(
+                {cfg.HASHTABLE_ENABLED: enabled,
+                 cfg.HASHTABLE_MAX_PROBE_ROUNDS: rounds})
+            op = HashJoinOp(_mem_scan(probe, 256),
+                            _mem_scan(build, 128), [C(0)], [C(0)],
+                            "inner")
+            return _collect(op, ExecContext(config=conf))
+
+        assert run(True).equals(run(False))
+
+
+class TestCompileBudget:
+    def test_hash_agg_program_budget(self):
+        """The hash path's per-query compile budget: a steady-shape agg
+        builds at most 3 hashtable programs (step, export, and at most
+        one growth), and a second identical run builds ZERO (all
+        registry hits). A regression here fails tier-1."""
+        from auron_tpu.runtime import programs
+
+        def ht_builds():
+            return sum(c["builds"] for site, c in programs.snapshot().items()
+                       if site.startswith("hashtable."))
+
+        rng = np.random.default_rng(10)
+        n = 1024
+        k = pa.array(rng.integers(0, 50, n), pa.int64())
+        rbs = _rbs(k, _vals_int(rng, n))
+
+        def run():
+            conf = cfg.AuronConfig({cfg.HASHTABLE_BACKEND: "hash"})
+            op = AggOp(_mem_scan(rbs), [C(0)],
+                       [ir.AggFunction("sum", C(1)),
+                        ir.AggFunction("count", C(1))],
+                       mode="complete", group_names=["k"],
+                       agg_names=["s", "c"], initial_capacity=256)
+            return _collect(op, ExecContext(config=conf))
+
+        run()                       # warm (may build)
+        before = ht_builds()
+        run()                       # steady state: every program cached
+        assert ht_builds() - before == 0
+
+    def test_sites_registered_centrally(self):
+        """Every hashtable compile site lives in runtime/programs.py —
+        the acceptance criterion that makes auron.max_live_programs and
+        tools/compile_report.py see the subsystem."""
+        from auron_tpu.runtime import programs
+        import auron_tpu.hashtable.agg      # noqa: F401 — sites register
+        import auron_tpu.hashtable.table    # noqa: F401
+        for site in ("hashtable.agg_step", "hashtable.agg_grow",
+                     "hashtable.agg_export", "hashtable.build",
+                     "hashtable.probe", "hashtable.grow",
+                     "hashtable.join_index"):
+            assert programs.site(site) is not None, site
+
+
+class TestCoreProperties:
+    def test_probe_finds_every_inserted_key_and_misses_absent(self):
+        from auron_tpu.hashtable import DeviceHashTable
+        rng = np.random.default_rng(11)
+        n = 1024
+        k = jnp.asarray(rng.integers(0, 500, n).astype(np.int64))
+        col = PrimitiveColumn(k, jnp.ones(n, bool))
+        t = DeviceHashTable(initial_capacity=1024)
+        slot, _new = t.insert((col,), jnp.ones(n, bool))
+        assert t.count == len(np.unique(np.asarray(k)))
+        s2, found = t.probe((col,), jnp.ones(n, bool))
+        assert bool(jnp.all(found))
+        np.testing.assert_array_equal(np.asarray(s2), np.asarray(slot))
+        absent = PrimitiveColumn(
+            jnp.asarray(np.arange(1000, 1032, dtype=np.int64)),
+            jnp.ones(32, bool))
+        _s, found = t.probe((absent,), jnp.ones(32, bool))
+        assert not bool(jnp.any(found))
+
+    def test_string_width_drift_across_batches(self):
+        """Batches land in different string width buckets; the store
+        widens in place without disturbing existing keys."""
+        from auron_tpu.hashtable import DeviceHashTable
+
+        def scol(values, width):
+            n = len(values)
+            chars = np.zeros((n, width), np.uint8)
+            lens = np.zeros(n, np.int32)
+            for i, sv in enumerate(values):
+                b = sv.encode()
+                chars[i, :len(b)] = np.frombuffer(b, np.uint8)
+                lens[i] = len(b)
+            return StringColumn(jnp.asarray(chars), jnp.asarray(lens),
+                                jnp.ones(n, bool))
+
+        t = DeviceHashTable(initial_capacity=16)
+        t.insert((scol(["a", "bb"], 8),), jnp.ones(2, bool))
+        t.insert((scol(["a", "a much longer string key"], 32),),
+                 jnp.ones(2, bool))
+        assert t.count == 3
+        _s, found = t.probe((scol(["a", "bb"], 8),), jnp.ones(2, bool))
+        assert bool(jnp.all(found))
+
+    def test_hash_sentinel_remap(self):
+        from auron_tpu.hashtable import core
+        h = jnp.asarray(np.array([0, 5, 0xFFFFFFFFFFFFFFFF],
+                                 np.uint64))
+        out = np.asarray(core.remap_hashes(h))
+        assert out[2] == np.uint64(0xFFFFFFFFFFFFFFFE)
+        assert out[0] == 0 and out[1] == 5

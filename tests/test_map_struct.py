@@ -515,3 +515,66 @@ class TestEntryLists:
                           pa.map_(pa.string(), pa.string()))})
         with pytest.raises(NotImplementedError, match="string"):
             _project([fn("map_entries", ir.ColumnRef(0))], ["e"], rb)
+
+
+class TestKeyDedupPolicy:
+    """auron.map.key_dedup_policy (ISSUE 3 satellite): LAST_WIN default,
+    EXCEPTION raising eagerly, rows-null degradation inside jit, and —
+    crucially — the trace salt: flipping the policy must re-trace cached
+    kernels, never serve the previous policy's compiled behavior."""
+
+    def _dup_map_op(self):
+        rb = pa.record_batch({"a": pa.array([1, 1, 2], pa.int64()),
+                              "b": pa.array([10, 20, 30], pa.int64())})
+        # map(a, b, a, b): duplicate keys on EVERY row
+        e = ir.ScalarFunction("map", (C(0), C(1), C(0), C(1)))
+        return ProjectOp(_scan(rb), [e, C(0)], ["m", "a"])
+
+    def test_last_win_default(self):
+        out = collect(self._dup_map_op())
+        assert out.column("m").to_pylist() == [[(1, 10)], [(1, 20)],
+                                               [(2, 30)]]
+
+    def test_exception_policy_eager_raise(self):
+        from auron_tpu import config as cfg
+        from auron_tpu.columnar.batch import DeviceBatch, PrimitiveColumn
+        from auron_tpu.columnar.schema import Field, Schema
+        from auron_tpu.exprs.eval import EvalContext, evaluate
+        import jax.numpy as jnp
+        batch = DeviceBatch(
+            (PrimitiveColumn(jnp.asarray([1, 1], jnp.int64),
+                             jnp.ones(2, bool)),
+             PrimitiveColumn(jnp.asarray([5, 6], jnp.int64),
+                             jnp.ones(2, bool))),
+            jnp.asarray(2, jnp.int32))
+        schema = Schema((Field("a", DataType.INT64),
+                         Field("b", DataType.INT64)))
+        e = ir.ScalarFunction("map", (C(0), C(1), C(0), C(1)))
+        conf = cfg.get_config()
+        conf.set(cfg.MAP_KEY_DEDUP_POLICY, "EXCEPTION")
+        try:
+            with pytest.raises(ValueError, match="duplicate map key"):
+                evaluate(e, batch, schema, EvalContext())
+        finally:
+            conf.unset(cfg.MAP_KEY_DEDUP_POLICY)
+
+    def test_policy_flip_retraces_cached_kernels(self):
+        """The project kernel for this (exprs, schema, capacity) is
+        compiled and cached under LAST_WIN; flipping the policy must
+        key a FRESH trace (config.trace_salt rides every program-cache
+        key), under which the jitted kernel nulls duplicate-key rows."""
+        from auron_tpu import config as cfg
+        conf = cfg.get_config()
+        out = collect(self._dup_map_op())          # warm the caches
+        assert out.column("m").to_pylist() == [[(1, 10)], [(1, 20)],
+                                               [(2, 30)]]
+        conf.set(cfg.MAP_KEY_DEDUP_POLICY, "EXCEPTION")
+        try:
+            out = collect(self._dup_map_op())
+            # jit cannot raise data-dependently: offending rows null out
+            assert out.column("m").to_pylist() == [None, None, None]
+        finally:
+            conf.unset(cfg.MAP_KEY_DEDUP_POLICY)
+        out = collect(self._dup_map_op())
+        assert out.column("m").to_pylist() == [[(1, 10)], [(1, 20)],
+                                               [(2, 30)]]

@@ -89,20 +89,34 @@ def run_report(suite: str, scale: float, names, data_dir=None) -> dict:
     td = compile_stats.delta(t_start)
     pdt = programs.delta(p_start)
     from auron_tpu import config as cfg
+    sites = {k: v for k, v in programs.snapshot().items() if v["builds"]}
+    # hash-table subsystem attribution: every hashtable.* compile site
+    # (agg_step/agg_grow/agg_export/build/probe/grow/join_index) rides
+    # the central registry like any other builder — break its share out
+    # so hash-path compile costs are visible at a glance
+    ht_sites = {k: v for k, v in sites.items()
+                if k.startswith("hashtable.")}
     summary = {
         "suite": suite, "scale": scale,
         "queries": len(rows),
         "fusion": cfg.get_config().get(cfg.FUSION_ENABLED),
+        "hashtable": cfg.get_config().get(cfg.HASHTABLE_ENABLED),
         "program_builds": pdt.builds,
         "program_hits": pdt.hits,
+        "hashtable_builds": sum(v["builds"] for v in ht_sites.values()),
         "backend_compiles": td.count,
         "compile_seconds": round(td.seconds, 2),
-        "sites": {k: v for k, v in programs.snapshot().items()
-                  if v["builds"]},
+        "sites": sites,
+        "hashtable_sites": ht_sites,
         "per_query": rows,
     }
     print(f"total: {pdt.builds} program builds, {pdt.hits} hits, "
           f"{td.count} backend compiles, {td.seconds:.1f}s compiling")
+    if ht_sites:
+        per = ", ".join(f"{k.split('.', 1)[1]}={v['builds']}"
+                        for k, v in sorted(ht_sites.items()))
+        print(f"hashtable sites: {summary['hashtable_builds']} builds "
+              f"({per})")
     return summary
 
 
