@@ -1,0 +1,191 @@
+"""Structured error taxonomy for the recovery plane.
+
+The retry driver (runtime/executor.run_task_with_retries) used to decide
+transient-vs-deterministic by matching substrings of RuntimeError
+messages — fragile against XLA version drift and impossible to extend
+from the durable tiers. This module replaces that with a typed
+hierarchy: every recovery-relevant boundary (RSS write/fetch, spill
+write/read, device compute, program build, backend init) raises an
+``AuronError`` subclass whose ``transient`` attribute IS the retry
+decision, and the retry driver routes purely on the taxonomy
+(``is_transient``) — no message inspection anywhere on the retry path.
+
+The one place pattern knowledge survives is ``classify_runtime``: the
+*device-compute boundary* (ExecutionRuntime._batches_inner) calls it to
+split XLA's ambiguous bare RuntimeError into its deterministic
+(lowering/shape defect → ``KernelLoweringError``) and transient
+(resource/backend blip → ``DeviceExecutionError``) halves at the moment
+the error crosses out of the engine. That is classification at the
+boundary that owns the ambiguity, not string matching in the scheduler —
+the shape Spark's task scheduler + shuffle-integrity layer give the
+reference (SURVEY §5.3).
+
+Subclasses double-inherit the legacy builtin class they replace
+(``KernelLoweringError`` is-a RuntimeError, ``StorageIOError`` is-a
+OSError) so existing ``except`` sites and tests keep working while new
+code routes on the taxonomy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class AuronError(Exception):
+    """Base of the engine's classified errors.
+
+    ``transient`` is the retry contract: True means a clean re-execution
+    of the failed unit (task attempt, map recompute) can succeed — the
+    failure lives outside the plan (IO blip, backend hiccup, corrupted
+    durable frame that a recompute rewrites). False means recomputing
+    the same unit is guaranteed to fail again (plan/schema/engine
+    defect) or must be recovered at a DIFFERENT granularity than a blind
+    retry (e.g. ShuffleCorruption needs a map recompute, not a reducer
+    rerun), so the retry driver surfaces it immediately.
+    """
+
+    #: class-level default; instances may override via __init__
+    transient: bool = False
+    #: optional fault-plane site name this error was raised at
+    site: Optional[str] = None
+
+    def __init__(self, *args, site: Optional[str] = None):
+        super().__init__(*args)
+        if site is not None:
+            self.site = site
+
+
+# ---------------------------------------------------------------------------
+# deterministic classes — retrying cannot succeed
+# ---------------------------------------------------------------------------
+
+class PlanError(AuronError):
+    """Deterministic plan/schema/engine defect (the no-retry class)."""
+    transient = False
+
+
+class KernelLoweringError(PlanError, RuntimeError):
+    """XLA lowering / shape / Mosaic defect: the compiled-program
+    analogue of a syntax error. RuntimeError subclass so legacy
+    ``except RuntimeError`` sites (and tests matching on the message)
+    keep working."""
+
+
+class InjectedFatalError(PlanError):
+    """A fault plan's ``fatal`` kind: a deliberately deterministic
+    injected failure (chaos tests assert it is never retried)."""
+
+
+class BackendInitError(AuronError):
+    """Device/backend init or first-compile exceeded the watchdog
+    deadline and the CPU fallback also failed (or was disallowed).
+    Not transient: an in-process retry re-enters the same wedged
+    client (the axon-init failure mode, VERDICT r5)."""
+    transient = False
+
+
+class ShuffleCorruption(AuronError):
+    """A committed RSS map-output frame failed its checksum (or carries
+    an unknown format version). NOT transient: the bytes on storage are
+    stable, so a blind reducer retry re-reads the same corrupt frame —
+    recovery is map-output invalidation + map-task recompute, which
+    RssShuffleExchangeOp performs itself (it owns the map subtree);
+    a foreign-host RssShuffleReadOp surfaces this classified error to
+    whoever can reschedule the map."""
+    transient = False
+
+    def __init__(self, message: str, *, shuffle_id: Optional[int] = None,
+                 map_id: Optional[int] = None, path: Optional[str] = None,
+                 site: Optional[str] = None):
+        super().__init__(message, site=site)
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.path = path
+
+
+# ---------------------------------------------------------------------------
+# transient classes — a clean re-execution can succeed
+# ---------------------------------------------------------------------------
+
+class TransientError(AuronError):
+    """Base of the retryable classes."""
+    transient = True
+
+
+class DeviceExecutionError(TransientError, RuntimeError):
+    """A device/backend execution failure that is not a deterministic
+    lowering defect (resource exhaustion, tunnel hiccup, injected
+    device fault): an exact partition recompute can succeed."""
+
+
+class StorageIOError(TransientError, OSError):
+    """IO failure against a durable tier (shared-storage RSS root,
+    spill directory): the storage substrate heals between attempts.
+    OSError subclass so legacy ``except OSError`` sites keep working."""
+
+
+class RssUnavailableError(StorageIOError):
+    """The RSS service root failed a write/flush/commit/fetch."""
+
+
+class SpillIOError(StorageIOError):
+    """A spill-file write/read failed."""
+
+
+class SpillCorruption(TransientError):
+    """A spill frame failed its checksum. Transient at TASK granularity:
+    spill files are per-attempt artifacts, so a fresh attempt of the
+    same partition rewrites them from source — the retry driver's
+    normal recompute is the recovery."""
+
+
+# ---------------------------------------------------------------------------
+# boundary classification
+# ---------------------------------------------------------------------------
+
+#: RuntimeError message signatures of XLA's deterministic defect class.
+#: Used ONLY by classify_runtime at the device-compute boundary — the
+#: retry driver never sees these (formerly executor._NO_RETRY_RUNTIME_
+#: PATTERNS, matched inside the retry loop itself).
+_XLA_DETERMINISTIC_PATTERNS = (
+    "lowering", "invalid argument", "invalid_argument", "mosaic",
+    "incompatible shapes", "rank mismatch", "unimplemented",
+)
+
+
+def classify_runtime(e: RuntimeError) -> AuronError:
+    """Classify a bare RuntimeError crossing the device-compute boundary
+    into the taxonomy. Deterministic lowering/shape signatures become
+    KernelLoweringError (no retry); everything else — XLA wraps
+    resource and external-service failures in plain RuntimeError — is
+    DeviceExecutionError (retry)."""
+    msg = str(e)
+    low = msg.lower()
+    if any(p in low for p in _XLA_DETERMINISTIC_PATTERNS):
+        return KernelLoweringError(msg)
+    return DeviceExecutionError(msg)
+
+
+#: exception classes that are deterministic plan/schema/engine defects
+#: by TYPE: recomputing the partition cannot succeed (ValueError joined
+#: in round 6 — shape mismatches, invalid kernel bounds and parse
+#: failures are ValueErrors, and retrying them paid retries+1 full
+#: computes with misleading "retrying" logs)
+NO_RETRY_TYPES = (NotImplementedError, TypeError, AssertionError,
+                  KeyError, IndexError, AttributeError, ValueError)
+
+
+def is_transient(e: BaseException) -> bool:
+    """The retry driver's routing function: True when a clean task-level
+    recompute may succeed. Routes purely on types — classified errors
+    carry their own ``transient`` verdict; bare builtins keep the
+    legacy type-based split (NO_RETRY_TYPES fail fast, IO and unknown
+    failures retry). No message inspection."""
+    if isinstance(e, AuronError):
+        return e.transient
+    if isinstance(e, NO_RETRY_TYPES):
+        return False
+    # bare OSError/RuntimeError/Exception: the legacy default — retry
+    # (boundaries classify their own errors before they get here; this
+    # is the conservative fallback for third-party raises)
+    return True

@@ -29,6 +29,13 @@ class Session:
         from auron_tpu.config import get_config
         self.config = config or get_config()
         self._bind_xla_cache()
+        # backend watchdog (runtime/watchdog.py): bounded device init +
+        # first compile with CPU fallback. Both probes default OFF
+        # (deadline 0) so Session construction stays lazy unless the
+        # auron.watchdog.* knobs arm them.
+        from auron_tpu.runtime import watchdog
+        watchdog.ensure_backend(self.config)
+        watchdog.first_compile_probe(self.config)
         self.ctx = PlannerContext(batch_capacity=batch_capacity,
                                   config=self.config)
         self.mem_manager = mem_manager

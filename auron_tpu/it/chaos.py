@@ -1,0 +1,222 @@
+"""Chaos harness: seeded fault plans driven over recovery scenarios.
+
+The executable contract of the robustness plane (runtime/faults.py):
+for ANY fault plan, a scenario run either produces results bit-identical
+to its fault-free baseline (recovery worked) or raises a classified
+``AuronError`` (failure surfaced with a verdict) — never silently wrong
+rows, never an unclassified crash, and never leaked ``.part``/spill
+files after teardown. ``tests/test_zz_chaos_battery.py`` asserts it over
+seeds; ``tools/chaos_report.py`` sweeps it and prints the site-by-site
+outcome table.
+
+Scenarios are self-contained op pipelines chosen so every injection
+site has traffic: ``rss_pipeline`` (RSS write/flush/commit/fetch),
+``spill_sort`` (spill write/read through the external-sort path),
+``agg_pipeline`` (device compute + program build through a
+Session-planned two-phase aggregation). Each ``run()`` constructs a
+FRESH operator tree — exchange materialization and spill state are
+per-run, exactly like a fresh task attempt.
+"""
+
+from __future__ import annotations
+
+import gc
+import glob
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from auron_tpu import config as cfg
+from auron_tpu import errors
+from auron_tpu.runtime import faults
+
+
+@dataclass
+class ChaosOutcome:
+    scenario: str
+    fault_plan: str
+    seed: int
+    #: identical | classified | mismatch | unclassified
+    status: str
+    error_type: Optional[str] = None
+    error: Optional[str] = None
+    #: {site: {kind: count}} actually injected during the run
+    injected: dict = field(default_factory=dict)
+    #: leftover .part / spill files after teardown (must be empty)
+    leaks: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.status in ("identical", "classified") and not self.leaks
+
+
+class Scenario:
+    """One recovery scenario: a fresh-run factory + leak audit paths."""
+
+    def __init__(self, name: str, run: Callable[[], pa.Table],
+                 leak_globs: list[str]):
+        self.name = name
+        self._run = run
+        self.leak_globs = leak_globs
+        self._baseline: Optional[pa.Table] = None
+
+    def run(self) -> pa.Table:
+        return self._run()
+
+    def baseline(self) -> pa.Table:
+        """Fault-free reference output (computed once, faults disarmed)."""
+        if self._baseline is None:
+            conf = cfg.get_config()
+            conf.unset(cfg.FAULTS_PLAN)
+            faults.reset()
+            self._baseline = self.run()
+        return self._baseline
+
+    def leaks(self) -> list[str]:
+        gc.collect()   # drop spill refs held by collected generators
+        found = []
+        for pattern in self.leak_globs:
+            found.extend(glob.glob(pattern, recursive=True))
+        return found
+
+
+def _rows(n: int, seed: int = 11) -> pa.RecordBatch:
+    rng = np.random.default_rng(seed)
+    return pa.record_batch({
+        "k": pa.array(rng.integers(0, 64, n), pa.int64()),
+        "v": pa.array(rng.normal(size=n)),
+        "c": pa.array(rng.integers(0, 1000, n), pa.int32()),
+    })
+
+
+def _canonical(table: pa.Table) -> pa.Table:
+    """Row-order-canonical view for cross-run equality (shuffle reads
+    are deterministic per run, but canonicalizing keeps the contract
+    about VALUES, which is what integrity protects)."""
+    return table.sort_by([(c, "ascending") for c in table.column_names])
+
+
+def rss_pipeline(workdir: str) -> Scenario:
+    """Scan → hash-partitioned RSS shuffle → collect: traffic on every
+    rss.* site, map recompute on fetch corruption."""
+    from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+    from auron_tpu.exprs import ir
+    from auron_tpu.io.parquet import MemoryScanOp
+    from auron_tpu.parallel.exchange import RssShuffleExchangeOp
+    from auron_tpu.parallel.partitioning import HashPartitioning
+    from auron_tpu.parallel.shuffle_service import FileShuffleService
+    from auron_tpu.runtime.executor import collect
+
+    rb = _rows(4096)
+    rss_root = os.path.join(workdir, "rss")
+    counter = [0]
+
+    def run() -> pa.Table:
+        counter[0] += 1
+        root = os.path.join(rss_root, f"run_{counter[0]}")
+        per = rb.num_rows // 2
+        parts = [[rb.slice(i * per, per).slice(o, 512)
+                  for o in range(0, per, 512)] for i in range(2)]
+        scan = MemoryScanOp(parts, schema_from_arrow(rb.schema),
+                            capacity=512)
+        op = RssShuffleExchangeOp(
+            scan, HashPartitioning([ir.ColumnRef(0)], 4),
+            FileShuffleService(root), shuffle_id=1, input_partitions=2)
+        return _canonical(collect(op, num_partitions=4))
+
+    return Scenario("rss_pipeline", run,
+                    [os.path.join(rss_root, "**", "*.part")])
+
+
+def spill_sort(workdir: str) -> Scenario:
+    """External sort with a 1-byte device budget and a 1-byte host spill
+    budget: every run spills every batch to DISK frames — traffic on
+    spill.write/spill.read, task-level recompute on spill corruption."""
+    from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+    from auron_tpu.exprs import ir
+    from auron_tpu.io.parquet import MemoryScanOp
+    from auron_tpu.memmgr.manager import MemManager
+    from auron_tpu.memmgr.spill import SpillManager
+    from auron_tpu.ops.sort import SortOp
+    from auron_tpu.runtime.executor import collect
+
+    rb = _rows(3000, seed=5)
+    spill_dir = os.path.join(workdir, "spill")
+
+    def run() -> pa.Table:
+        rbs = [rb.slice(o, 500) for o in range(0, rb.num_rows, 500)]
+        scan = MemoryScanOp([rbs], schema_from_arrow(rb.schema),
+                            capacity=512)
+        orders = [ir.SortOrder(ir.ColumnRef(0), ascending=True),
+                  ir.SortOrder(ir.ColumnRef(2), ascending=False)]
+        mm = MemManager(total_bytes=1, min_trigger=0,
+                        spill_manager=SpillManager(
+                            host_budget_bytes=1,
+                            spill_dir=spill_dir))
+        return collect(SortOp(scan, orders), num_partitions=1,
+                       mem_manager=mm)
+
+    return Scenario("spill_sort", run,
+                    [os.path.join(spill_dir, "auron-spill-*")])
+
+
+def agg_pipeline(workdir: str) -> Scenario:
+    """Session-planned two-phase aggregation (the q01 shape): traffic on
+    device.compute and program.build through the full planner path."""
+    from auron_tpu.frontend.dataframe import col, functions as F
+    from auron_tpu.frontend.session import Session
+
+    table = pa.Table.from_batches([_rows(4096, seed=23)])
+
+    def run() -> pa.Table:
+        s = Session()
+        df = (s.from_arrow(table)
+              .filter(col("c") > 50)
+              .group_by("k")
+              .agg(F.sum(col("v")).alias("sv"),
+                   F.count(col("c")).alias("n")))
+        return _canonical(s.execute(df))
+
+    return Scenario("agg_pipeline", run, [])
+
+
+SCENARIOS: dict[str, Callable[[str], Scenario]] = {
+    "rss_pipeline": rss_pipeline,
+    "spill_sort": spill_sort,
+    "agg_pipeline": agg_pipeline,
+}
+
+
+def run_chaos(scenario: Scenario, fault_plan: str,
+              seed: int) -> ChaosOutcome:
+    """One chaos run: arm the plan at ``seed``, execute a fresh pipeline,
+    classify the outcome against the fault-free baseline, audit leaks.
+    The global fault config is restored (and the plane reset) whatever
+    happens."""
+    baseline = scenario.baseline()
+    conf = cfg.get_config()
+    conf.set(cfg.FAULTS_PLAN, fault_plan)
+    conf.set(cfg.FAULTS_SEED, seed)
+    faults.reset()
+    injected: dict = {}
+    try:
+        try:
+            out = scenario.run()
+        finally:
+            injected = faults.snapshot()
+        status = "identical" if out.equals(baseline) else "mismatch"
+        err_t = err = None
+    except errors.AuronError as e:
+        status, err_t, err = "classified", type(e).__name__, str(e)
+    except Exception as e:   # noqa: BLE001 — the contract's failure bucket
+        status, err_t, err = "unclassified", type(e).__name__, str(e)
+    finally:
+        conf.unset(cfg.FAULTS_PLAN)
+        conf.unset(cfg.FAULTS_SEED)
+        faults.reset()
+    return ChaosOutcome(scenario.name, fault_plan, seed, status,
+                        error_type=err_t, error=err, injected=injected,
+                        leaks=scenario.leaks())

@@ -80,7 +80,14 @@ class BufferedSpillConsumer:
             self._inflight_spills += 1
         try:
             spill = self.mem.spill_manager.new_spill()
-            self._write_run(spill, buffered)
+            try:
+                self._write_run(spill, buffered)
+            except BaseException:
+                # a failed run write (IO error mid-frame) must not leak
+                # the half-written spill file: the run was claimed but
+                # never published, so nobody else will ever release it
+                spill.release()
+                raise
             with self._lock:
                 self.spills.append(spill.finish())
         finally:

@@ -8,6 +8,20 @@ hop is the expensive part, compression is cheap), tier 2 is an append-only
 temp file of length-prefixed frames. A Spill written while DRAM budget
 lasts can later overflow: frames are flushed to disk in order and the spill
 keeps a single frame sequence either way.
+
+Disk format v2 (header magic ``ASP2`` + a checksum-algorithm byte,
+utils/checksum.py): each frame record is ``<u32 len><u32 crc>`` + bytes,
+and every disk read verifies the CRC before the frame reaches the serde
+— a flipped byte surfaces as ``errors.SpillCorruption``, which is
+TRANSIENT at task granularity (spill files are per-attempt artifacts;
+the retry driver's recompute rewrites them from source), never silently
+wrong merge output. Headerless v1 files are rejected, not misread.
+DRAM-tier frames carry no CRC (host memory is trusted; the durable tier
+is the disk file).
+
+Fault-injection sites (runtime/faults.py): ``spill.write`` (write
+failure + on-disk corruption after the CRC), ``spill.read`` (read
+failure + in-flight corruption).
 """
 
 from __future__ import annotations
@@ -17,6 +31,15 @@ import struct
 import tempfile
 import threading
 from typing import Iterator, Optional
+
+from auron_tpu import errors
+from auron_tpu.utils import checksum as cks
+
+#: v2 file header: magic + <B algo>
+_SPILL_MAGIC = b"ASP2"
+_HEADER_SIZE = len(_SPILL_MAGIC) + 1
+#: per-frame record header (shared with the RSS tier, utils/checksum.py)
+_FRAME_HDR = cks.FRAME_HDR
 
 
 class Spill:
@@ -34,6 +57,7 @@ class Spill:
         self._file: Optional[object] = None
         self._path: Optional[str] = None
         self._finished = False
+        self._algo = cks.write_algo()
         self.mem_bytes = 0
         self.disk_bytes = 0
         self._frame_sizes: list[int] = []
@@ -46,23 +70,32 @@ class Spill:
         if self._file is None and not self._mgr.try_reserve_host(len(frame)):
             self._spill_to_disk()
         if self._file is not None:
-            self._file.write(struct.pack("<I", len(frame)))
-            self._file.write(frame)
-            self.disk_bytes += len(frame) + 4
+            self._write_disk_frame(frame)
         else:
             self._mem_frames.append(frame)
             self.mem_bytes += len(frame)
         self._frame_sizes.append(len(frame))
+
+    def _write_disk_frame(self, frame: bytes) -> None:
+        from auron_tpu.runtime import faults
+        faults.maybe_fail("spill.write", errors.SpillIOError)
+        crc = cks.compute(frame, self._algo)
+        # corruption injects AFTER the CRC over the clean bytes: durable
+        # bit rot is the integrity layer's problem, not the writer's
+        payload = faults.maybe_corrupt("spill.write", frame)
+        self._file.write(_FRAME_HDR.pack(len(frame), crc))
+        self._file.write(payload)
+        self.disk_bytes += len(frame) + _FRAME_HDR.size
 
     def _spill_to_disk(self) -> None:
         fd, self._path = tempfile.mkstemp(
             prefix=f"auron-spill-{self.spill_id}-", suffix=".atb",
             dir=self._mgr.spill_dir)
         self._file = os.fdopen(fd, "wb")
+        self._file.write(_SPILL_MAGIC + struct.pack("<B", self._algo))
+        self.disk_bytes += _HEADER_SIZE
         for frame in self._mem_frames:
-            self._file.write(struct.pack("<I", len(frame)))
-            self._file.write(frame)
-            self.disk_bytes += len(frame) + 4
+            self._write_disk_frame(frame)
         self._mem_frames.clear()
         self._mgr.release_host(self.mem_bytes)
         self.mem_bytes = 0
@@ -74,26 +107,62 @@ class Spill:
             self._file.close()
             self._file = None
         # byte-offset index for frame_at (the reference's partition-offset
-        # array alongside the data file, sort_repartitioner.rs:151+)
-        offs, o = [], 0
+        # array alongside the data file, sort_repartitioner.rs:151+);
+        # disk offsets account the file header + per-frame record headers
+        offs, o = [], _HEADER_SIZE
         for s in self._frame_sizes:
             offs.append(o)
-            o += 4 + s
+            o += _FRAME_HDR.size + s
         self._offsets = offs
         return self
 
     # -- read ---------------------------------------------------------------
 
+    def _corrupt(self, msg: str) -> errors.SpillCorruption:
+        return errors.SpillCorruption(
+            f"{msg} (spill {self.spill_id}: {self._path})",
+            site="spill.read")
+
+    def _open_verified(self):
+        """Open the disk file and verify the v2 header; returns
+        (file, algo)."""
+        from auron_tpu.runtime import faults
+        faults.maybe_fail("spill.read", errors.SpillIOError)
+        f = open(self._path, "rb")
+        hdr = f.read(_HEADER_SIZE)
+        if hdr[:4] != _SPILL_MAGIC or len(hdr) != _HEADER_SIZE:
+            f.close()
+            raise self._corrupt("bad spill-file header (v1 or foreign "
+                                "file rejected)")
+        return f, hdr[4]
+
+    def _read_frame(self, f, algo: int) -> Optional[bytes]:
+        """One verified frame record at the current offset; None at EOF."""
+        from auron_tpu.runtime import faults
+        hdr = f.read(_FRAME_HDR.size)
+        if not hdr:
+            return None
+        if len(hdr) != _FRAME_HDR.size:
+            raise self._corrupt("spill frame header truncated")
+        ln, crc = _FRAME_HDR.unpack(hdr)
+        frame = f.read(ln)
+        if len(frame) != ln:
+            raise self._corrupt("spill frame body truncated")
+        frame = faults.maybe_corrupt("spill.read", frame)
+        cks.verify_or_raise(frame, crc, algo, self._corrupt,
+                            what="spill frame")
+        return frame
+
     def frames(self) -> Iterator[bytes]:
         assert self._finished
         if self._path is not None:
-            with open(self._path, "rb") as f:
+            f, algo = self._open_verified()
+            with f:
                 while True:
-                    hdr = f.read(4)
-                    if not hdr:
+                    frame = self._read_frame(f, algo)
+                    if frame is None:
                         break
-                    (ln,) = struct.unpack("<I", hdr)
-                    yield f.read(ln)
+                    yield frame
         else:
             yield from self._mem_frames
 
@@ -106,15 +175,25 @@ class Spill:
             return self._mem_frames[index]
         if index >= len(self._offsets):
             raise IndexError(index)
-        with open(self._path, "rb") as f:
+        f, algo = self._open_verified()
+        with f:
             f.seek(self._offsets[index])
-            hdr = f.read(4)
-            (ln,) = struct.unpack("<I", hdr)
-            return f.read(ln)
+            frame = self._read_frame(f, algo)
+            if frame is None:
+                raise self._corrupt("spill frame offset past EOF")
+            return frame
 
     # -- lifecycle ----------------------------------------------------------
 
     def release(self) -> None:
+        # mid-write abort support: a failed run write releases before
+        # finish(), so the file may still be open
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
         self._mgr.release_host(self.mem_bytes)
         self._mem_frames.clear()
         self.mem_bytes = 0

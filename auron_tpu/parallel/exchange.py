@@ -28,6 +28,7 @@ same sorted-compaction rides `lax.all_to_all`
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Iterator, Optional
 
@@ -53,6 +54,8 @@ from auron_tpu.utils.shapes import bucket_rows
 #: rows sampled for range bounds (reference samples client-side too,
 #: NativeShuffleExchangeBase.scala:313+)
 _RANGE_SAMPLE_ROWS = 10_000
+
+logger = logging.getLogger("auron_tpu")
 
 
 def _split_body(batch: DeviceBatch, pids, num_partitions: int):
@@ -492,17 +495,8 @@ class RssShuffleExchangeOp(PhysicalOp):
         return self.partitioning.num_partitions
 
     def _materialize(self, ctx: ExecContext) -> None:
-        from auron_tpu import config as cfg
-        from auron_tpu.columnar.serde import (batch_to_host,
-                                              serialize_host_batch,
-                                              slice_host_batch)
-        metrics = ctx.metrics_for(self.name)
-        write_time = metrics.counter("shuffle_write_total_time")
-        _sync = ctx.device_sync
-        n_out = self.num_partitions
-        schema = self.child.schema()
-        codec_level = ctx.conf.get(cfg.SPILL_CODEC_LEVEL)
         partitioning = self.partitioning
+        schema = self.child.schema()
         # invalidate any previous attempt's manifest so readers can't mix
         # stale map outputs into this attempt
         self.service.begin_shuffle(self.shuffle_id)
@@ -532,43 +526,104 @@ class RssShuffleExchangeOp(PhysicalOp):
                     partitioning.sort_orders, partitioning.num_partitions,
                     bounds)
                 self.partitioning = partitioning
-
-            writer = self.service.partition_writer(self.shuffle_id, in_p,
-                                                   n_out)
-            row_offset = 0
-            donate = yields_owned_batches(self.child) \
-                and jax.default_backend() != "cpu"
-            import itertools
-            try:
-                for batch in itertools.chain(pending, batches):
-                    n_in = int(batch.num_rows) if donate else None
-                    with timer(write_time, sync=_sync) as t:
-                        if isinstance(partitioning, RoundRobinPartitioning):
-                            part = RoundRobinPartitioning(n_out, row_offset)
-                            pids = part.partition_ids(batch, schema)
-                        else:
-                            pids = partitioning.partition_ids(batch, schema)
-                        kern = _sort_by_pid_kernel(n_out, batch.capacity,
-                                                   donate)
-                        sorted_batch, counts = t.track(kern(batch, pids))
-                    row_offset += n_in if donate else int(batch.num_rows)
-                    counts_h = np.asarray(counts)
-                    offsets = np.concatenate(
-                        [np.zeros(1, np.int64), np.cumsum(counts_h)])
-                    n = int(sorted_batch.num_rows)
-                    with timer(write_time):
-                        host = batch_to_host(sorted_batch, n)
-                        for p in range(n_out):
-                            lo, hi = int(offsets[p]), int(offsets[p + 1])
-                            if hi > lo:
-                                writer.write(p, serialize_host_batch(
-                                    slice_host_batch(host, lo, hi),
-                                    codec_level=codec_level))
-                writer.commit()
-            except BaseException:
-                writer.abort()
-                raise
+            self._write_map(in_p, ctx, partitioning, pending, batches)
         self.service.commit_shuffle(self.shuffle_id, self.input_partitions)
+
+    def _write_map(self, in_p: int, ctx: ExecContext, partitioning,
+                   pending=(), batches=None) -> None:
+        """Write ONE map task's output. Also the corruption-recovery
+        entry point: a checksum failure on fetch recomputes exactly this
+        map (``batches=None`` re-executes the child partition — the
+        engine is functional, so the recompute is exact). The writer's
+        context manager guarantees no exception path leaves a ``.part``
+        file behind."""
+        import itertools
+
+        from auron_tpu import config as cfg
+        from auron_tpu.columnar.serde import (batch_to_host,
+                                              serialize_host_batch,
+                                              slice_host_batch)
+        metrics = ctx.metrics_for(self.name)
+        write_time = metrics.counter("shuffle_write_total_time")
+        _sync = ctx.device_sync
+        n_out = self.num_partitions
+        schema = self.child.schema()
+        codec_level = ctx.conf.get(cfg.SPILL_CODEC_LEVEL)
+        if batches is None:
+            map_ctx = ctx.child(partition_id=in_p,
+                                num_partitions=self.input_partitions)
+            batches = self.child.execute(in_p, map_ctx)
+        row_offset = 0
+        donate = yields_owned_batches(self.child) \
+            and jax.default_backend() != "cpu"
+        with self.service.partition_writer(self.shuffle_id, in_p,
+                                           n_out) as writer:
+            for batch in itertools.chain(pending, batches):
+                n_in = int(batch.num_rows) if donate else None
+                with timer(write_time, sync=_sync) as t:
+                    if isinstance(partitioning, RoundRobinPartitioning):
+                        part = RoundRobinPartitioning(n_out, row_offset)
+                        pids = part.partition_ids(batch, schema)
+                    else:
+                        pids = partitioning.partition_ids(batch, schema)
+                    kern = _sort_by_pid_kernel(n_out, batch.capacity,
+                                               donate)
+                    sorted_batch, counts = t.track(kern(batch, pids))
+                row_offset += n_in if donate else int(batch.num_rows)
+                counts_h = np.asarray(counts)
+                offsets = np.concatenate(
+                    [np.zeros(1, np.int64), np.cumsum(counts_h)])
+                n = int(sorted_batch.num_rows)
+                with timer(write_time):
+                    host = batch_to_host(sorted_batch, n)
+                    for p in range(n_out):
+                        lo, hi = int(offsets[p]), int(offsets[p + 1])
+                        if hi > lo:
+                            writer.write(p, serialize_host_batch(
+                                slice_host_batch(host, lo, hi),
+                                codec_level=codec_level))
+            writer.commit()
+
+    #: per-map corruption-recovery bound: recompute + refetch this many
+    #: times before surfacing the classified error (a fault plan that
+    #: corrupts EVERY write would otherwise loop forever)
+    _CORRUPTION_RECOVERY_ATTEMPTS = 3
+
+    def _fetch_map(self, map_id: int, partition: int,
+                   ctx: ExecContext) -> list[bytes]:
+        """Verified frames of one map output, with corruption recovery:
+        a checksum mismatch invalidates that map output and RECOMPUTES
+        the map task (the lineage-recompute contract the reference
+        inherits from Spark's shuffle-integrity layer) instead of
+        blindly retrying the reducer over the same corrupt bytes."""
+        from auron_tpu import errors as aerr
+        attempt = 0
+        while True:
+            try:
+                return self.service.map_partition_frames(
+                    self.shuffle_id, map_id, partition)
+            except aerr.ShuffleCorruption:
+                if attempt >= self._CORRUPTION_RECOVERY_ATTEMPTS:
+                    raise
+                attempt += 1
+                logger.warning(
+                    "shuffle %d map %d corrupt on fetch (partition %d); "
+                    "invalidating and recomputing the map task "
+                    "(recovery attempt %d/%d)", self.shuffle_id, map_id,
+                    partition, attempt, self._CORRUPTION_RECOVERY_ATTEMPTS)
+                with self._lock:   # one recovery of a map at a time
+                    try:
+                        # another reducer may have repaired the map while
+                        # we waited for the lock — re-verify before
+                        # invalidating, or we would delete its clean file
+                        return self.service.map_partition_frames(
+                            self.shuffle_id, map_id, partition)
+                    except aerr.ShuffleCorruption:
+                        ctx.metrics_for("recovery").counter(
+                            "corruption_recomputes").add(1)
+                        self.service.invalidate_map(self.shuffle_id,
+                                                    map_id)
+                        self._write_map(map_id, ctx, self.partitioning)
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         with self._lock:
@@ -581,12 +636,17 @@ class RssShuffleExchangeOp(PhysicalOp):
         def stream():
             from auron_tpu.columnar.serde import (deserialize_host_batch,
                                                   host_to_batch)
-            for frame in self.service.partition_frames(self.shuffle_id,
-                                                       partition):
-                with timer(read_time):
-                    host, _ = deserialize_host_batch(frame)
-                    if host.num_rows:
-                        yield host_to_batch(host, bucket_rows(host.num_rows))
+            # map-by-map fetch: each map's frames are fully verified
+            # before any is yielded, so corruption recovery never
+            # re-yields data a downstream operator already consumed
+            maps = self.service.committed_maps(self.shuffle_id)
+            for map_id in range(len(maps)):
+                for frame in self._fetch_map(map_id, partition, ctx):
+                    with timer(read_time):
+                        host, _ = deserialize_host_batch(frame)
+                        if host.num_rows:
+                            yield host_to_batch(host,
+                                                bucket_rows(host.num_rows))
 
         return count_output(stream(), metrics)
 

@@ -17,24 +17,49 @@ Layout (one directory per shuffle):
     root/shuffle_{id}/map_{m}.data        committed map output
     root/shuffle_{id}/manifest           shuffle-level commit marker
 
-A map output file is a sequence of length-prefixed frames grouped by
-partition, followed by a trailer [per partition: run count + (offset,
-length) runs] — the reference's one-data-file + partition-offset index
-(sort_repartitioner.rs:151+). Commits are atomic renames at two levels:
-per map output, and the shuffle-level ``manifest`` naming the exact map
-count, so readers never observe partial attempts OR stale map outputs
-from a previous attempt with different parallelism. Map retries overwrite
-by map id (idempotent, the engine's partition-granular recovery contract,
-SURVEY.md §5.3).
+A map output file (format v2, magic ``AUR2``) is a sequence of frame
+records grouped by partition — each record ``<u32 len><u32 crc>`` +
+frame bytes — followed by a trailer [per partition: run count +
+(offset, length) runs] and a footer naming the trailer offset, the
+partition count, the trailer's own CRC and the checksum algorithm id
+(utils/checksum.py). Every fetch verifies the frame CRC before
+deserializing: a flipped byte on storage surfaces as
+``errors.ShuffleCorruption`` carrying the map id, which the RSS exchange
+recovers by invalidating that map output and recomputing the map task —
+never a blind reducer retry over the same corrupt bytes, never silently
+wrong rows. v1 files (magic ``AURS``, no CRCs) are *rejected* with the
+same corruption error, not misread.
+
+Commits are atomic renames at two levels: per map output, and the
+shuffle-level ``manifest`` naming the exact map count, so readers never
+observe partial attempts OR stale map outputs from a previous attempt
+with different parallelism. Map retries overwrite by map id (idempotent,
+the engine's partition-granular recovery contract, SURVEY.md §5.3).
+``commit_shuffle`` also sweeps orphaned ``.part`` files — an aborted map
+attempt must not leak storage.
+
+Fault-injection sites (runtime/faults.py): ``rss.write`` (buffered push
++ on-disk corruption after the CRC — durable bit rot), ``rss.flush``,
+``rss.commit``, ``rss.fetch`` (fetch failure + in-flight corruption).
 """
 
 from __future__ import annotations
 
+import io
 import os
 import struct
 from typing import Iterator
 
-_TRAILER_MAGIC = b"AURS"
+from auron_tpu import errors
+from auron_tpu.utils import checksum as cks
+
+#: v2 footer magic; v1 (``AURS``) files are rejected as corrupt
+_TRAILER_MAGIC = b"AUR2"
+_V1_MAGIC = b"AURS"
+#: footer: <Q trailer_start><I num_partitions><I trailer_crc><B algo>
+_FOOTER = struct.Struct("<QIIB")
+#: per-frame record header (shared with the spill tier, utils/checksum.py)
+_FRAME_HDR = cks.FRAME_HDR
 
 
 class RssPartitionWriter:
@@ -43,14 +68,22 @@ class RssPartitionWriter:
     Frames are buffered per partition and flushed to the map file grouped
     by partition id; `commit()` writes the offset trailer and atomically
     renames. The buffer bound makes host memory independent of map-output
-    size (the push-based contract of the reference's RSS writers)."""
+    size (the push-based contract of the reference's RSS writers).
+
+    Context-manager support guarantees no exception path leaves a
+    ``.part`` file behind: exiting the ``with`` block without having
+    committed — exception or not — aborts (abort after commit is a
+    no-op)."""
 
     def __init__(self, service: "FileShuffleService", shuffle_id: int,
                  map_id: int, num_partitions: int,
                  buffer_bytes: int = 8 << 20):
         self.service = service
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
         self.num_partitions = num_partitions
         self.buffer_bytes = buffer_bytes
+        self._algo = cks.write_algo()
         self._dir = service._shuffle_dir(shuffle_id)
         os.makedirs(self._dir, exist_ok=True)
         self._tmp = os.path.join(self._dir, f"map_{map_id}.part")
@@ -64,37 +97,64 @@ class RssPartitionWriter:
         self._pos = 0
         self._committed = False
 
+    def __enter__(self) -> "RssPartitionWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        # uncommitted on exit — exception unwind OR a caller that never
+        # reached commit() — is an abandoned attempt: abort
+        self.abort()
+        return False
+
     def write(self, partition: int, frame: bytes) -> None:
         assert not self._committed
-        self._buffers.setdefault(partition, []).append(frame)
+        from auron_tpu.runtime import faults
+        faults.maybe_fail("rss.write", errors.RssUnavailableError)
+        # CRC here, not at flush time: the producer just serialized the
+        # frame, so the bytes are cache-hot — the hardware CRC runs at
+        # its warm rate instead of re-streaming a cold flush buffer
+        crc = cks.compute(frame, self._algo)
+        self._buffers.setdefault(partition, []).append((frame, crc))
         self._buffered += len(frame)
         if self._buffered >= self.buffer_bytes:
             self._flush()
 
     def _flush(self) -> None:
+        from auron_tpu.runtime import faults
+        faults.maybe_fail("rss.flush", errors.RssUnavailableError)
         for p in sorted(self._buffers):
             frames = self._buffers[p]
             start = self._pos
-            for fr in frames:
-                self._file.write(struct.pack("<I", len(fr)))
-                self._file.write(fr)
-                self._pos += 4 + len(fr)
+            for fr, crc in frames:
+                # corruption injects AFTER the CRC over the clean bytes:
+                # durable bit rot is the integrity layer's problem
+                payload = faults.maybe_corrupt("rss.write", fr)
+                self._file.write(_FRAME_HDR.pack(len(fr), crc))
+                self._file.write(payload)
+                self._pos += _FRAME_HDR.size + len(fr)
             self._runs.setdefault(p, []).append((start, self._pos - start))
         self._buffers = {}
         self._buffered = 0
 
     def commit(self) -> None:
         """Flush, append the partition-run trailer, atomically publish."""
+        from auron_tpu.runtime import faults
+        faults.maybe_fail("rss.commit", errors.RssUnavailableError)
         self._flush()
         trailer_start = self._pos
-        # trailer: per partition, run count then (offset, length) pairs
+        # trailer: per partition, run count then (offset, length) pairs —
+        # assembled in memory so its own CRC rides the footer
+        trailer = io.BytesIO()
         for p in range(self.num_partitions):
             runs = self._runs.get(p, [])
-            self._file.write(struct.pack("<I", len(runs)))
+            trailer.write(struct.pack("<I", len(runs)))
             for off, ln in runs:
-                self._file.write(struct.pack("<QQ", off, ln))
-        self._file.write(struct.pack("<QI", trailer_start,
-                                     self.num_partitions))
+                trailer.write(struct.pack("<QQ", off, ln))
+        tbytes = trailer.getvalue()
+        self._file.write(tbytes)
+        self._file.write(_FOOTER.pack(trailer_start, self.num_partitions,
+                                      cks.compute(tbytes, self._algo),
+                                      self._algo))
         self._file.write(_TRAILER_MAGIC)
         self._file.close()
         os.replace(self._tmp, self._final)   # atomic commit
@@ -145,6 +205,35 @@ class FileShuffleService:
         with open(tmp, "w") as f:
             f.write(str(num_maps))
         os.replace(tmp, os.path.join(d, "manifest"))
+        # committed shuffles carry no in-progress files: sweep orphans
+        # from aborted/crashed map attempts (the .part leak audit)
+        self.sweep_parts(shuffle_id)
+
+    def sweep_parts(self, shuffle_id: int) -> int:
+        """Remove orphaned ``.part`` files (crashed map attempts that
+        never reached abort()); returns how many were removed."""
+        d = self._shuffle_dir(shuffle_id)
+        removed = 0
+        if not os.path.isdir(d):
+            return removed
+        for f in os.listdir(d):
+            if f.endswith(".part"):
+                try:
+                    os.unlink(os.path.join(d, f))
+                    removed += 1
+                except OSError:
+                    pass
+        return removed
+
+    def invalidate_map(self, shuffle_id: int, map_id: int) -> None:
+        """Drop ONE committed map output (corruption recovery: the map
+        task recomputes and re-commits under the same id; the manifest —
+        which names only the map COUNT — stays valid throughout)."""
+        try:
+            os.unlink(os.path.join(self._shuffle_dir(shuffle_id),
+                                   f"map_{map_id}.data"))
+        except OSError:
+            pass
 
     def map_outputs(self, shuffle_id: int) -> list[str]:
         """Committed map output files present on storage (diagnostics;
@@ -173,23 +262,65 @@ class FileShuffleService:
         """All committed map outputs' frames for one partition, reading
         only that partition's byte runs (offset-indexed fetch). One read
         for the whole trailer + one per run — no per-entry round trips
-        (matters on NFS/FUSE substrates)."""
-        for path in self.committed_maps(shuffle_id):
-            with open(path, "rb") as f:
-                # fixed footer: <QI trailer_start num_partitions> + magic
-                foot = 12 + len(_TRAILER_MAGIC)
-                f.seek(0, os.SEEK_END)
-                size = f.tell()
-                f.seek(size - foot)
-                tail = f.read(foot)
-                assert tail[-4:] == _TRAILER_MAGIC, f"corrupt map output {path}"
-                trailer_start, num_parts = struct.unpack("<QI", tail[:12])
-                if partition >= num_parts:
-                    continue
-                f.seek(trailer_start)
-                trailer = f.read(size - foot - trailer_start)
-                pos = 0
-                runs = []
+        (matters on NFS/FUSE substrates). Every frame is CRC-verified;
+        a mismatch raises ShuffleCorruption naming the map."""
+        for map_id, path in enumerate(self.committed_maps(shuffle_id)):
+            yield from self.map_partition_frames(shuffle_id, map_id,
+                                                 partition)
+
+    def map_partition_frames(self, shuffle_id: int, map_id: int,
+                             partition: int) -> list[bytes]:
+        """Verified frames of ONE committed map output for one partition
+        — the recovery granularity: the RSS exchange fetches map by map
+        so a ShuffleCorruption can recompute exactly the corrupt map
+        without re-yielding earlier maps' data."""
+        from auron_tpu.runtime import faults
+        path = os.path.join(self._shuffle_dir(shuffle_id),
+                            f"map_{map_id}.data")
+        faults.maybe_fail("rss.fetch", errors.RssUnavailableError)
+
+        def corrupt(msg):
+            return errors.ShuffleCorruption(
+                f"{msg} (shuffle {shuffle_id} map {map_id}: {path})",
+                shuffle_id=shuffle_id, map_id=map_id, path=path,
+                site="rss.fetch")
+
+        frames: list[bytes] = []
+        try:
+            f = open(path, "rb")
+        except FileNotFoundError as e:
+            # a committed map output that is GONE (invalidated by a
+            # corruption recovery that died before re-committing, or
+            # external deletion) is recovered exactly like a corrupt
+            # one: map recompute rewrites it — never an unclassified
+            # OSError out of the fetch path
+            raise corrupt("map output missing from storage") from e
+        with f:
+            foot = _FOOTER.size + len(_TRAILER_MAGIC)
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if size < foot:
+                raise corrupt("map output truncated below footer size")
+            f.seek(size - foot)
+            tail = f.read(foot)
+            if tail[-4:] != _TRAILER_MAGIC:
+                if tail[-4:] == _V1_MAGIC:
+                    raise corrupt("unchecksummed v1 map output rejected "
+                                  "(recompute rewrites it at v2)")
+                raise corrupt("bad map-output trailer magic")
+            trailer_start, num_parts, trailer_crc, algo = \
+                _FOOTER.unpack(tail[:_FOOTER.size])
+            if partition >= num_parts:
+                return frames
+            if trailer_start > size - foot:
+                raise corrupt("map-output trailer offset out of range")
+            f.seek(trailer_start)
+            trailer = f.read(size - foot - trailer_start)
+            cks.verify_or_raise(trailer, trailer_crc, algo, corrupt,
+                                what="map-output trailer")
+            pos = 0
+            runs = []
+            try:
                 for p in range(num_parts):
                     (nruns,) = struct.unpack_from("<I", trailer, pos)
                     pos += 4
@@ -199,15 +330,26 @@ class FileShuffleService:
                                 for r in range(nruns)]
                         break
                     pos += 16 * nruns
-                for off, ln in runs:
-                    f.seek(off)
-                    blob = f.read(ln)
-                    bpos = 0
-                    while bpos < ln:
-                        (flen,) = struct.unpack_from("<I", blob, bpos)
-                        bpos += 4
-                        yield blob[bpos:bpos + flen]
-                        bpos += flen
+            except struct.error as e:
+                raise corrupt("map-output trailer truncated") from e
+            for off, ln in runs:
+                f.seek(off)
+                blob = f.read(ln)
+                bpos = 0
+                while bpos < ln:
+                    try:
+                        flen, crc = _FRAME_HDR.unpack_from(blob, bpos)
+                    except struct.error as e:
+                        raise corrupt("frame header truncated") from e
+                    bpos += _FRAME_HDR.size
+                    frame = blob[bpos:bpos + flen]
+                    if len(frame) != flen:
+                        raise corrupt("frame body truncated")
+                    frame = faults.maybe_corrupt("rss.fetch", frame)
+                    cks.verify_or_raise(frame, crc, algo, corrupt)
+                    frames.append(frame)
+                    bpos += flen
+        return frames
 
     def delete_shuffle(self, shuffle_id: int) -> None:
         import shutil

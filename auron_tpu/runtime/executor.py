@@ -35,12 +35,19 @@ class TaskDefinition:
 
 
 class ExecutionRuntime:
-    """Runs one (plan, partition) pair to completion."""
+    """Runs one (plan, partition) pair to completion.
+
+    ``attempt``/``retry_stats`` carry the retry driver's recovery
+    counters into the finalize snapshot: the runtime that finally
+    succeeds reports how many attempts the task took."""
 
     def __init__(self, plan: PhysicalOp, task: TaskDefinition,
-                 mem_manager=None, config=None):
+                 mem_manager=None, config=None, attempt: int = 0,
+                 retry_stats: Optional[dict] = None):
         self.plan = plan
         self.task = task
+        self.attempt = attempt
+        self.retry_stats = retry_stats if retry_stats is not None else {}
         self.ctx = ExecContext(
             stage_id=task.stage_id,
             partition_id=task.partition_id,
@@ -64,6 +71,9 @@ class ExecutionRuntime:
             self._programs_start = programs.totals()
         except Exception:
             self._programs_start = None
+        # per-task fault attribution (runtime/faults)
+        from auron_tpu.runtime import faults as _faults
+        self._faults_start = _faults.totals()
 
     def batches(self) -> Iterator[DeviceBatch]:
         """Device-batch stream (stays on device; used for stage chaining).
@@ -93,11 +103,15 @@ class ExecutionRuntime:
         self.ctx.cancel()
 
     def _batches_inner(self) -> Iterator[DeviceBatch]:
+        from auron_tpu import errors
         from auron_tpu.ops.base import TaskCancelled
+        from auron_tpu.runtime import faults
         try:
             for batch in self.plan.execute(self.task.partition_id,
                                            self.ctx):
                 self.ctx.check_cancelled()
+                faults.maybe_fail("device.compute",
+                                  errors.DeviceExecutionError)
                 yield batch
         except TaskCancelled:
             # reference behavior: task-kill is teardown, not failure
@@ -107,6 +121,27 @@ class ExecutionRuntime:
                 self.task.stage_id, self.task.partition_id,
                 self.task.task_id)
             raise
+        except NotImplementedError:
+            # the one NO_RETRY_TYPES member that IS a RuntimeError
+            # subclass: shield it from classify_runtime below (callers
+            # catch it to reject unsupported plans); the other
+            # deterministic builtins reach the generic handler unchanged
+            logger.exception(
+                "task failed: stage=%d partition=%d task=%d",
+                self.task.stage_id, self.task.partition_id, self.task.task_id)
+            raise
+        except RuntimeError as e:
+            # the device-compute boundary: XLA wraps BOTH transient
+            # resource failures and deterministic lowering defects in
+            # bare RuntimeError — classify here, at the boundary that
+            # owns the ambiguity, so the retry driver routes purely on
+            # the AuronError taxonomy (classified errors pass through)
+            logger.exception(
+                "task failed: stage=%d partition=%d task=%d",
+                self.task.stage_id, self.task.partition_id, self.task.task_id)
+            if isinstance(e, errors.AuronError):
+                raise
+            raise errors.classify_runtime(e) from e
         except Exception:
             # real failures surface with task identity attached
             logger.exception(
@@ -115,11 +150,30 @@ class ExecutionRuntime:
             raise
 
     def arrow_batches(self) -> Iterator[pa.RecordBatch]:
-        """Host materialization (the FFI export boundary of the reference)."""
+        """Host materialization (the FFI export boundary of the reference).
+
+        The device→host export runs jitted gather/concat programs, so
+        XLA's ambiguous RuntimeErrors surface here exactly as they do in
+        the compute loop — classify them at this boundary too, or a
+        deterministic lowering defect in the export path would retry as
+        if transient."""
+        from auron_tpu import errors
         schema = self.plan.schema()
         for batch in self.batches():
             if int(batch.num_rows) > 0:
-                yield to_arrow(batch, schema)
+                try:
+                    rb = to_arrow(batch, schema)
+                except NotImplementedError:
+                    raise
+                except RuntimeError as e:
+                    if isinstance(e, errors.AuronError):
+                        raise
+                    logger.exception(
+                        "host materialization failed: stage=%d "
+                        "partition=%d task=%d", self.task.stage_id,
+                        self.task.partition_id, self.task.task_id)
+                    raise errors.classify_runtime(e) from e
+                yield rb
 
     def collect(self) -> pa.Table:
         from auron_tpu.columnar.arrow_bridge import schema_to_arrow
@@ -145,6 +199,22 @@ class ExecutionRuntime:
             pd = programs.delta(self._programs_start)
             snap["program_builds"] = pd.builds
             snap["program_hits"] = pd.hits
+        # recovery counters (robustness plane): attempts/retries from the
+        # retry driver, corruption recomputes from the RSS exchange's
+        # ctx counters (already under the "recovery" metrics key),
+        # fault/watchdog deltas from their monotonic totals
+        from auron_tpu.runtime import faults as _faults
+        from auron_tpu.runtime import watchdog as _watchdog
+        rec = snap.setdefault("recovery", {})
+        rec.setdefault("corruption_recomputes", 0)
+        rec["attempts"] = self.attempt + 1
+        rec["transient_retries"] = self.retry_stats.get(
+            "transient_retries", self.attempt)
+        # process-level, not a per-task delta: watchdog probes run at
+        # Session init (before any task exists), so the meaningful
+        # number is how many fallbacks this process has taken in total
+        rec["watchdog_fallbacks"] = _watchdog.totals()
+        rec["faults_injected"] = _faults.totals() - self._faults_start
         if getattr(self, "profile_dir", None):
             op_times = {
                 op: vals["elapsed_compute"] * 1e-9   # counters are ns
@@ -160,31 +230,15 @@ class ExecutionRuntime:
         return snap
 
 
-#: exception classes that are deterministic plan/schema/engine defects:
-#: recomputing the partition cannot succeed, so they surface immediately
-#: (ValueError joined the tuple in round 6 — shape mismatches, invalid
-#: kernel bounds and parse failures are ValueErrors, and retrying them
-#: paid retries+1 full computes with misleading "retrying" logs)
-_NO_RETRY_TYPES = (NotImplementedError, TypeError, AssertionError,
-                   KeyError, IndexError, AttributeError, ValueError)
-
-#: RuntimeError is ambiguous — XLA wraps both transient resource
-#: failures and deterministic lowering/shape defects in it. Message
-#: patterns that identify the deterministic classes (case-insensitive):
-_NO_RETRY_RUNTIME_PATTERNS = (
-    "lowering", "invalid argument", "invalid_argument", "mosaic",
-    "incompatible shapes", "rank mismatch", "unimplemented",
-)
-
-
-def _is_deterministic_failure(e: BaseException) -> bool:
-    """True when re-running the partition is guaranteed to fail again."""
-    if isinstance(e, _NO_RETRY_TYPES):
-        return True
-    if isinstance(e, RuntimeError):
-        msg = str(e).lower()
-        return any(p in msg for p in _NO_RETRY_RUNTIME_PATTERNS)
-    return False
+def _retry_backoff_s(attempt: int, base: float, cap: float) -> float:
+    """Exponential backoff with FULL jitter (attempt k draws uniform
+    from [0, min(cap, base * 2^k)]): concurrently failed partitions
+    spread their retries instead of hammering the healing external
+    system in lockstep."""
+    import random
+    if base <= 0:
+        return 0.0
+    return random.uniform(0.0, min(cap, base * (2.0 ** attempt)))
 
 
 def run_task_with_retries(plan: PhysicalOp, partition: int,
@@ -198,15 +252,23 @@ def run_task_with_retries(plan: PhysicalOp, partition: int,
     retry-idempotent and RSS attempts invalidate, making re-execution
     safe end to end. Each attempt gets a fresh ExecutionRuntime and a
     distinct task_id (attempt number in the low bits, like Spark TIDs).
-    Cancellation is surfaced immediately, never retried."""
+
+    Routing is purely the error taxonomy (auron_tpu/errors.py):
+    classified errors carry their own ``transient`` verdict — the
+    device-compute boundary classifies XLA's ambiguous RuntimeErrors
+    before they get here, so NO message-pattern matching happens on the
+    retry path. Cancellation is surfaced immediately, never retried."""
     import time as _time
 
     from auron_tpu import config as cfg
+    from auron_tpu import errors
     from auron_tpu.ops.base import TaskCancelled
 
     conf = config if config is not None else cfg.get_config()
     retries = max(0, int(conf.get(cfg.TASK_MAX_RETRIES)))
     backoff = float(conf.get(cfg.TASK_RETRY_BACKOFF_S))
+    backoff_cap = float(conf.get(cfg.TASK_RETRY_BACKOFF_MAX_S))
+    retry_stats = {"transient_retries": 0}
     last_err = None
     for attempt in range(retries + 1):
         rt = ExecutionRuntime(
@@ -214,27 +276,30 @@ def run_task_with_retries(plan: PhysicalOp, partition: int,
             TaskDefinition(partition_id=partition,
                            num_partitions=num_partitions,
                            task_id=partition * 1000 + attempt),
-            mem_manager=mem_manager, config=config)
+            mem_manager=mem_manager, config=config,
+            attempt=attempt, retry_stats=retry_stats)
         try:
             return rt.collect()
         except TaskCancelled:
             raise
         except Exception as e:         # noqa: BLE001 — retry boundary
-            # deterministic plan/schema/engine defects (including
-            # shape/lowering RuntimeErrors) surface immediately instead
-            # of paying retries+1 full computes and misleading
-            # "retrying" logs; transient classes — IO, resource,
-            # external-service RuntimeErrors — retry
-            if _is_deterministic_failure(e):
+            # non-transient classes — plan/schema/engine defects,
+            # classified corruption needing a DIFFERENT recovery
+            # granularity (ShuffleCorruption → map recompute, not a
+            # blind reducer rerun) — surface immediately instead of
+            # paying retries+1 full computes; transient classes retry
+            if not errors.is_transient(e):
                 raise
             last_err = e
             if attempt >= retries:
                 break
+            retry_stats["transient_retries"] += 1
             logger.warning(
                 "task attempt %d/%d failed for partition %d (%s); "
                 "retrying", attempt + 1, retries + 1, partition, e)
-            if backoff > 0:
-                _time.sleep(backoff * (attempt + 1))
+            delay = _retry_backoff_s(attempt, backoff, backoff_cap)
+            if delay > 0:
+                _time.sleep(delay)
     raise last_err
 
 

@@ -1,0 +1,270 @@
+"""Deterministic, seeded fault-injection plane.
+
+Every recovery-relevant boundary in the engine carries a *named
+injection site*; a fault plan — one config string — arms sites with
+failure kinds and probabilities, and every decision is a pure function
+of ``(seed, site, kind, per-rule event index)``, so a failing chaos run
+replays EXACTLY by re-running with the same seed. No fault plan armed
+(the default) costs one config-epoch compare per site check (the
+armed/disarmed verdict is cached until a config mutation).
+
+Plan grammar (``auron.faults.plan``)::
+
+    site:kind@prob[;site:kind@prob...]
+    rss.fetch:corrupt@0.05;spill.read:io_error@0.1;device.compute:io_error
+
+``@prob`` defaults to 1.0. Kinds:
+
+- ``io_error``  — raise the site's transient error class (the call site
+  passes it; e.g. ``RssUnavailableError`` at rss.*, ``SpillIOError`` at
+  spill.*, ``DeviceExecutionError`` at device.compute/program.build).
+- ``fatal``     — raise ``errors.InjectedFatalError`` (deterministic:
+  chaos tests assert it is never retried).
+- ``corrupt``   — at byte boundaries (``maybe_corrupt``), flip one byte
+  of the payload AFTER its checksum was computed, simulating storage
+  bit rot the integrity layer must catch. Ignored at fail-only sites.
+- ``hang``      — sleep ``auron.faults.hang_s`` seconds (simulates the
+  wedged axon backend init; pair with the watchdog deadline).
+
+Named sites threaded through the engine:
+
+    rss.write | rss.flush | rss.commit | rss.fetch      (shuffle tier)
+    spill.write | spill.read                            (spill tier)
+    device.compute                                      (per batch)
+    program.build                                       (compile sites)
+    backend.init                                        (watchdog probe)
+
+The plane is resolved from the PROCESS-GLOBAL config (the sites live in
+code paths with no ExecContext at hand — file services, spill files),
+and injection counters are exposed via ``snapshot``/``totals`` so the
+per-task metrics snapshot can attribute injected faults.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Optional
+
+from auron_tpu import errors
+
+#: the engine's named injection sites (documentation + plan validation)
+SITES = (
+    "rss.write", "rss.flush", "rss.commit", "rss.fetch",
+    "spill.write", "spill.read",
+    "device.compute", "program.build", "backend.init",
+)
+
+KINDS = ("io_error", "fatal", "corrupt", "hang")
+
+
+@dataclass(frozen=True)
+class Rule:
+    site: str
+    kind: str
+    prob: float
+
+
+def parse_plan(plan: str) -> list[Rule]:
+    """Parse the ``site:kind@prob;...`` grammar; raises ValueError on an
+    unknown site/kind or malformed probability (a typo'd chaos plan must
+    fail loudly, not silently inject nothing)."""
+    rules = []
+    for part in filter(None, (p.strip() for p in plan.split(";"))):
+        try:
+            site, rest = part.split(":", 1)
+            kind, _, prob_s = rest.partition("@")
+            prob = float(prob_s) if prob_s else 1.0
+        except ValueError as e:
+            raise ValueError(f"malformed fault rule {part!r} "
+                             f"(want site:kind@prob)") from e
+        site, kind = site.strip(), kind.strip()
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r}; known: {SITES}")
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; known: {KINDS}")
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError(f"fault probability out of range: {part!r}")
+        rules.append(Rule(site, kind, prob))
+    return rules
+
+
+class FaultPlane:
+    """One parsed plan + its deterministic decision state."""
+
+    def __init__(self, plan: str, seed: int, hang_s: float = 2.0):
+        self.plan = plan
+        self.seed = seed
+        self.hang_s = hang_s
+        self._rules: dict[str, list[Rule]] = {}
+        for r in parse_plan(plan):
+            self._rules.setdefault(r.site, []).append(r)
+        self._lock = threading.Lock()
+        #: per-rule event index — each rule sees its own deterministic
+        #: Bernoulli sequence, independent of other rules' traffic
+        self._events: dict[tuple[str, str], int] = {}
+        self.injected: dict[tuple[str, str], int] = {}
+
+    def _decide(self, rule: Rule) -> Optional[int]:
+        """Advance the rule's event counter; return the event index when
+        this event injects, else None. hash(seed|site|kind|n) → [0,1)."""
+        with self._lock:
+            n = self._events.get((rule.site, rule.kind), 0)
+            self._events[(rule.site, rule.kind)] = n + 1
+            h = zlib.crc32(
+                f"{self.seed}|{rule.site}|{rule.kind}|{n}".encode())
+            if (h & 0xFFFFFFFF) / 2**32 >= rule.prob:
+                return None
+            self.injected[(rule.site, rule.kind)] = \
+                self.injected.get((rule.site, rule.kind), 0) + 1
+            return n
+
+    def fire(self, site: str, kinds: tuple[str, ...]) -> Optional[Rule]:
+        """First armed rule of ``site`` among ``kinds`` that injects on
+        this event, advancing every matching rule's counter."""
+        hit = None
+        for rule in self._rules.get(site, ()):
+            if rule.kind in kinds and self._decide(rule) is not None \
+                    and hit is None:
+                hit = rule
+        return hit
+
+    def snapshot(self) -> dict[str, dict[str, int]]:
+        with self._lock:
+            out: dict[str, dict[str, int]] = {}
+            for (site, kind), n in self.injected.items():
+                out.setdefault(site, {})[kind] = n
+            return out
+
+    def totals(self) -> int:
+        with self._lock:
+            return sum(self.injected.values())
+
+
+# ---------------------------------------------------------------------------
+# process-global plane, resolved from config
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_PLANE: Optional[FaultPlane] = None
+_PLANE_KEY: Optional[tuple] = None
+#: (config epoch, plane) verdict cache: the unarmed hot path — every
+#: batch, frame and program build calls a site check — must cost one
+#: tuple read + int compare, not a config lock + os.environ lookup.
+#: A single-assignment tuple so readers never see a torn update.
+_CACHED: tuple[int, Optional[FaultPlane]] = (-1, None)
+#: monotonic injected-fault count across plane rebuilds (per-task metric
+#: attribution survives a reconfigure mid-process)
+_TOTAL_BASE = 0
+
+
+def _active() -> Optional[FaultPlane]:
+    """The plane for the current config; None when no plan is armed.
+    The verdict is cached against the config-mutation epoch, so the
+    common unarmed check is one int compare — plan changes go through
+    ``AuronConfig.set/unset`` (or :func:`reset`), which bump the epoch."""
+    from auron_tpu import config as cfg
+    epoch, plane = _CACHED
+    if epoch == cfg.config_epoch():
+        return plane
+    return _resolve()
+
+
+def _resolve() -> Optional[FaultPlane]:
+    global _PLANE, _PLANE_KEY, _TOTAL_BASE, _CACHED
+    from auron_tpu import config as cfg
+    # read the epoch BEFORE the config values: a concurrent set() bumps
+    # it after we read, so the stale cache entry misses on the next call
+    epoch = cfg.config_epoch()
+    conf = cfg.get_config()
+    plan = conf.get(cfg.FAULTS_PLAN)
+    if not plan:
+        if _PLANE is not None:
+            with _LOCK:
+                if _PLANE is not None:
+                    _TOTAL_BASE += _PLANE.totals()
+                    _PLANE, _PLANE_KEY = None, None
+        _CACHED = (epoch, None)
+        return None
+    key = (plan, conf.get(cfg.FAULTS_SEED), conf.get(cfg.FAULTS_HANG_S))
+    plane = _PLANE
+    if plane is None or _PLANE_KEY != key:
+        with _LOCK:
+            if _PLANE is None or _PLANE_KEY != key:
+                if _PLANE is not None:
+                    _TOTAL_BASE += _PLANE.totals()
+                _PLANE = FaultPlane(*key)
+                _PLANE_KEY = key
+            plane = _PLANE
+    _CACHED = (epoch, plane)
+    return plane
+
+
+def reset() -> None:
+    """Drop the active plane's decision state so the NEXT site check
+    replays event 0 (chaos harness: one reset per run = exact replay).
+    Also invalidates the verdict cache — the one hook that notices a
+    direct os.environ change."""
+    global _PLANE, _PLANE_KEY, _TOTAL_BASE, _CACHED
+    with _LOCK:
+        if _PLANE is not None:
+            _TOTAL_BASE += _PLANE.totals()
+        _PLANE, _PLANE_KEY = None, None
+        _CACHED = (-1, None)
+
+
+def maybe_fail(site: str, exc_cls=errors.TransientError) -> None:
+    """Injection hook for failure sites: raises the plan's armed fault
+    (``exc_cls`` for io_error — the call site's transient error class —
+    InjectedFatalError for fatal), or sleeps for hang. No-op when the
+    site is unarmed."""
+    plane = _active()
+    if plane is None:
+        return
+    rule = plane.fire(site, ("io_error", "fatal", "hang"))
+    if rule is None:
+        return
+    if rule.kind == "hang":
+        time.sleep(plane.hang_s)
+        return
+    if rule.kind == "fatal":
+        raise errors.InjectedFatalError(
+            f"injected deterministic fault at {site} "
+            f"(seed={plane.seed})", site=site)
+    raise exc_cls(f"injected {rule.kind} at {site} (seed={plane.seed})",
+                  site=site)
+
+
+def maybe_corrupt(site: str, data: bytes) -> bytes:
+    """Injection hook for byte boundaries: flips one deterministic byte
+    of ``data`` when the site's ``corrupt`` rule injects. Call AFTER the
+    checksum over the clean bytes is computed — the corruption must be
+    the integrity layer's problem, not the writer's."""
+    plane = _active()
+    if plane is None or not data:
+        return data
+    rule = plane.fire(site, ("corrupt",))
+    if rule is None:
+        return data
+    pos = zlib.crc32(f"{plane.seed}|{site}|pos|{len(data)}".encode()) \
+        % len(data)
+    corrupted = bytearray(data)
+    corrupted[pos] ^= 0xFF
+    return bytes(corrupted)
+
+
+def snapshot() -> dict[str, dict[str, int]]:
+    """{site: {kind: injected count}} of the active plane ({} unarmed)."""
+    plane = _PLANE
+    return plane.snapshot() if plane is not None else {}
+
+
+def totals() -> int:
+    """Monotonic injected-fault count (survives plane rebuilds) — the
+    per-task metrics delta source."""
+    with _LOCK:
+        base = _TOTAL_BASE
+        plane = _PLANE
+    return base + (plane.totals() if plane is not None else 0)

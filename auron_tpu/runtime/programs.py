@@ -79,6 +79,9 @@ class ProgramCache:
                 self._memo.move_to_end(key)
                 self.hits += 1
                 return self._memo[key], False
+        from auron_tpu import errors as _errors
+        from auron_tpu.runtime import faults as _faults
+        _faults.maybe_fail("program.build", _errors.DeviceExecutionError)
         value = builder()   # build outside the lock: builders may recurse
         with self._lock:
             if key in self._memo:   # raced with another thread: keep first

@@ -1,0 +1,286 @@
+"""Backend watchdog: bounded device init + first compile, CPU fallback.
+
+Four rounds of bench windows died to the same failure mode: the
+tunneled accelerator client wedges INSIDE backend init (``jax.devices``
+never returns — VERDICT r5), so nothing downstream ever runs and no
+exception ever surfaces to classify. The watchdog turns that silent
+wedge into a bounded, observable decision:
+
+- ``ensure_backend``: probe REAL backend init in a sacrificial child
+  process with a deadline (``auron.watchdog.init_timeout_s``). The
+  wedge happens inside jax's ``backends()`` while it holds the global
+  ``_backend_lock`` — an in-process probe thread abandoned mid-init
+  would keep that lock forever and deadlock the CPU fallback's own
+  ``jax.devices("cpu")``. Confining the first touch of the plugin to a
+  child means the parent never enters the lock until a probe has
+  already proven init completes; on timeout the child is killed, the
+  parent flips to the CPU platform (config + ``JAX_PLATFORMS`` env so
+  subprocesses inherit the flip) and verifies CPU init inside the same
+  deadline. Only when the fallback ALSO fails does a classified
+  ``BackendInitError`` (non-transient — re-entering a wedged client
+  cannot help) surface.
+- ``first_compile_probe``: same contract for the first jit compile
+  (``auron.watchdog.compile_timeout_s``) — a backend that initializes
+  but cannot compile is equally wedged. This wedge is post-init (the
+  lock is free), so the probe runs in an abandoned-on-timeout daemon
+  thread, and the fallback drops jax's cached backend dict before the
+  platform flip — ``backends()`` caches its result, so flipping
+  ``jax_platforms`` alone would leave every later compile on the wedged
+  platform.
+
+Both default OFF (deadline 0) so nothing eagerly initializes a backend
+that lazy paths would not have touched; Session arms them from config.
+Injected faults (see below) are simulated in a bounded daemon thread —
+never inside jax — so a chaos ``hang`` exercises the timeout path
+without wedging the real backend lock. Fallbacks are counted
+(``stats``/``totals``) and the process-level total surfaces as
+``watchdog_fallbacks`` in every finalize metrics snapshot.
+
+Injection site: ``backend.init`` (kind ``hang`` + ``auron.faults.hang_s``
+simulates the wedge; ``io_error`` a failing init).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from auron_tpu import errors
+
+logger = logging.getLogger("auron_tpu")
+
+_LOCK = threading.Lock()
+_STATS = {"probes": 0, "timeouts": 0, "fallbacks": 0}
+
+
+def stats() -> dict:
+    with _LOCK:
+        return dict(_STATS)
+
+
+def totals() -> int:
+    """Monotonic process-level fallback count (surfaced in finalize)."""
+    with _LOCK:
+        return _STATS["fallbacks"]
+
+
+def _count(key: str) -> None:
+    with _LOCK:
+        _STATS[key] += 1
+
+
+def _run_bounded(fn: Callable, deadline_s: float, what: str
+                 ) -> tuple[bool, Optional[BaseException], object]:
+    """Run ``fn`` in a daemon thread; (completed, error, value) within
+    the deadline. A timeout leaves the thread running — wedged native
+    init cannot be interrupted, only abandoned."""
+    result: dict = {}
+
+    def worker():
+        try:
+            result["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — classified by caller
+            result["error"] = e
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name=f"auron-watchdog-{what}")
+    t.start()
+    t.join(deadline_s)
+    if t.is_alive():
+        return False, None, None
+    return True, result.get("error"), result.get("value")
+
+
+def _fault_probe():
+    """Injected faults only — bounded in-process, BEFORE jax is ever
+    touched, so an injected hang simulates the wedge without holding
+    jax's real backend lock."""
+    from auron_tpu.runtime import faults
+    faults.maybe_fail("backend.init", errors.BackendInitError)
+
+
+def _initialized_platform() -> Optional[str]:
+    """Lock-free peek: the platform name when jax backends are ALREADY
+    initialized in this process, else None. Never triggers init and
+    never enters jax's ``_backend_lock`` (which a wedged init would
+    hold)."""
+    import sys
+    if sys.modules.get("jax") is None:
+        return None
+    try:
+        from jax._src import xla_bridge as xb
+        if not getattr(xb, "_backends", None):
+            return None
+        default = getattr(xb, "_default_backend", None)
+        if default is not None:
+            return default.platform
+        return next(iter(xb._backends))
+    except Exception:   # pragma: no cover - jax internals drift
+        return None
+
+
+_CHILD_PROBE = ("import jax, sys; jax.devices(); "
+                "sys.stdout.write(jax.default_backend())")
+
+
+def _subprocess_init_probe(deadline_s: float) -> tuple[bool, str]:
+    """Probe REAL backend init in a sacrificial child process: a wedged
+    plugin client wedges (and is killed with) the child, and the parent
+    never enters jax's ``_backend_lock``, so the later CPU fallback
+    cannot deadlock on a lock held by an abandoned in-process thread.
+    Returns (ok, detail) — detail is the platform on success, 'timeout'
+    or an error tail otherwise."""
+    import os
+    import subprocess
+    import sys
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _CHILD_PROBE],
+            capture_output=True, text=True, timeout=deadline_s,
+            env=dict(os.environ))
+    except subprocess.TimeoutExpired:
+        return False, "timeout"
+    except Exception as e:   # pragma: no cover - spawn failure
+        return False, f"probe spawn failed: {e}"
+    if proc.returncode != 0:
+        tail = " | ".join((proc.stderr or "").strip().splitlines()[-3:])
+        return False, tail or f"probe exited {proc.returncode}"
+    return True, (proc.stdout or "").strip()
+
+
+def _drop_noncpu_backends() -> None:
+    """Post-init fallback (first-compile wedge): ``backends()`` caches
+    its dict, so flipping ``jax_platforms`` alone leaves every later
+    compile on the wedged platform — drop the cache so the next
+    ``backends()`` re-initializes CPU-only. No-op when nothing is
+    initialized yet or CPU is already the default. Safe here: init
+    completed, so the backend lock is free."""
+    try:
+        from jax._src import xla_bridge as xb
+        if not getattr(xb, "_backends", None):
+            return
+        default = getattr(xb, "_default_backend", None)
+        if default is not None and default.platform == "cpu":
+            return
+        from jax.extend import backend as jex_backend
+        jex_backend.clear_backends()
+    except Exception as e:   # pragma: no cover - jax internals drift
+        logger.warning(
+            "backend watchdog: could not drop cached non-CPU backends "
+            "after the platform flip (%s) — already-compiled programs "
+            "may stay pinned to the wedged platform", e)
+
+
+def _fallback_to_cpu(deadline_s: float, why: str) -> None:
+    """Flip jax to the CPU platform and verify it initializes; raise
+    BackendInitError when even that fails."""
+    import os
+    import jax
+    logger.error(
+        "backend watchdog: %s — falling back to the CPU platform "
+        "(rerun with JAX_PLATFORMS=cpu to skip the probe entirely)", why)
+    _count("fallbacks")
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        os.environ["JAX_PLATFORMS"] = "cpu"   # subprocesses inherit the flip
+    except Exception as e:   # pragma: no cover - jax-version dependent
+        raise errors.BackendInitError(
+            f"watchdog could not select the CPU platform after: {why} "
+            f"({e})") from e
+    _drop_noncpu_backends()
+    done, err, _ = _run_bounded(lambda: __import__("jax").devices("cpu"),
+                                max(deadline_s, 5.0), "cpu-fallback")
+    if not done or err is not None:
+        raise errors.BackendInitError(
+            f"watchdog CPU fallback failed after: {why} "
+            f"({err if err is not None else 'cpu init timed out'})")
+
+
+def ensure_backend(config=None) -> Optional[str]:
+    """Bound backend init by ``auron.watchdog.init_timeout_s``; returns
+    the live platform name, or None when the watchdog is disabled
+    (deadline 0 — no eager backend init happens at all)."""
+    from auron_tpu import config as cfg
+    conf = config if config is not None else cfg.get_config()
+    deadline = float(conf.get(cfg.WATCHDOG_INIT_TIMEOUT_S))
+    if deadline <= 0:
+        return None
+    _count("probes")
+    # injected faults first, bounded in-process (a chaos `hang` must
+    # exercise the timeout path without wedging jax's backend lock)
+    done, err, _ = _run_bounded(_fault_probe, deadline, "init")
+    if not done or err is not None:
+        if not done:
+            _count("timeouts")
+        why = (f"backend init exceeded the {deadline:.1f}s deadline"
+               if not done else f"backend init failed: {err}")
+        _fallback_to_cpu(deadline, why)
+        import jax
+        return jax.default_backend()
+    # already initialized in this process: init completed once, there is
+    # nothing left to bound (and re-probing in a child would be waste)
+    live = _initialized_platform()
+    if live is not None:
+        return live
+    ok, detail = _subprocess_init_probe(deadline)
+    if not ok:
+        if detail == "timeout":
+            _count("timeouts")
+            why = (f"backend init exceeded the {deadline:.1f}s deadline "
+                   f"(probe child killed)")
+        else:
+            why = f"backend init failed: {detail}"
+        _fallback_to_cpu(deadline, why)
+    import jax
+    return jax.default_backend()
+
+
+def first_compile_probe(config=None) -> Optional[float]:
+    """Bound the first jit compile by ``auron.watchdog.compile_timeout_s``
+    (0 = skip); returns compile seconds, or None when skipped. A timeout
+    or failure falls back to CPU like ensure_backend."""
+    import time
+
+    from auron_tpu import config as cfg
+    conf = config if config is not None else cfg.get_config()
+    deadline = float(conf.get(cfg.WATCHDOG_COMPILE_TIMEOUT_S))
+    if deadline <= 0:
+        return None
+    _count("probes")
+    if _initialized_platform() is None:
+        # the jit probe would otherwise be the FIRST thing to enter
+        # backend init — inside jax's backend lock, in a thread we may
+        # abandon. Prove init completes in a sacrificial child first so
+        # a timeout here stays recoverable (same contract as
+        # ensure_backend).
+        ok, detail = _subprocess_init_probe(deadline)
+        if not ok:
+            if detail == "timeout":
+                _count("timeouts")
+                why = (f"backend init (first-compile probe) exceeded the "
+                       f"{deadline:.1f}s deadline (probe child killed)")
+            else:
+                why = f"backend init (first-compile probe) failed: {detail}"
+            _fallback_to_cpu(deadline, why)
+            return None
+
+    def probe():
+        import jax
+        import jax.numpy as jnp
+        t0 = time.perf_counter()
+        # unique constant per call: never served from a stale jit cache
+        salt = int(t0 * 1e6) % (1 << 20)
+        jax.jit(lambda x: x + salt)(jnp.ones((8,), jnp.int32)
+                                    ).block_until_ready()
+        return time.perf_counter() - t0
+
+    done, err, dt = _run_bounded(probe, deadline, "first-compile")
+    if done and err is None:
+        return dt
+    why = (f"first compile exceeded the {deadline:.1f}s deadline"
+           if not done else f"first compile failed: {err}")
+    if not done:
+        _count("timeouts")
+    _fallback_to_cpu(deadline, why)
+    return None

@@ -1,0 +1,110 @@
+"""Frame checksums for the durable tiers (RSS map outputs, spill files).
+
+The reference inherits shuffle integrity from Spark's shuffle layer
+(frame CRCs on the block store path); here the durable tiers carry their
+own: every frame written to shared storage or a spill file is followed
+by a 32-bit checksum, and every fetch verifies before deserializing —
+a flipped byte surfaces as a classified corruption error (lineage
+recompute), never as silently wrong rows.
+
+Algorithm: CRC32C (Castagnoli — hardware-accelerated on every modern
+ISA) when a native ``crc32c`` module is present in the image; otherwise
+zlib's CRC-32 (also C-speed, always available). The algorithm id is
+recorded in each file's header/trailer, so readers verify with the
+writer's algorithm and *reject* frames whose algorithm they cannot
+compute instead of misreading them. No dependency is installed for
+this: the module gates on what the image provides.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+#: per-frame record header shared by both durable tiers (RSS map
+#: outputs, spill files): <I frame_len><I frame_crc>
+FRAME_HDR = struct.Struct("<II")
+
+#: algorithm ids recorded on disk (one byte)
+ALGO_NONE = 0     # checksumming disabled (auron.durability.checksum=false)
+ALGO_CRC32C = 1   # Castagnoli, native module
+ALGO_CRC32 = 2    # zlib crc32 fallback
+
+#: hardware CRC32C, whichever provider the image bakes in (both compute
+#: the same Castagnoli polynomial, so files interoperate): the
+#: standalone ``crc32c`` module, or google's ``google_crc32c`` (the C
+#: implementation runs the SSE4.2/ARMv8 CRC instructions — measured
+#: ~15 GiB/s on cache-warm 256 KiB frames vs ~0.4 GiB/s for this
+#: image's un-SIMD'd zlib).
+_crc32c_fn = None
+try:
+    import crc32c as _crc32c_mod
+    _crc32c_fn = _crc32c_mod.crc32c
+except ImportError:
+    try:
+        import google_crc32c as _gcrc32c_mod
+        _crc32c_fn = _gcrc32c_mod.value
+    except ImportError:
+        pass
+
+
+def preferred_algo() -> int:
+    """The algorithm new files are written with (checksumming on)."""
+    return ALGO_CRC32C if _crc32c_fn is not None else ALGO_CRC32
+
+
+def write_algo() -> int:
+    """Checksum algorithm for new durable-tier files: the preferred
+    algorithm, or ALGO_NONE when the ``auron.durability.checksum`` knob
+    is off (same on-disk format, no verification). The single
+    knob-to-algorithm mapping for BOTH tiers — shuffle and spill must
+    not diverge."""
+    from auron_tpu import config as cfg
+    if cfg.get_config().get(cfg.DURABILITY_CHECKSUM):
+        return preferred_algo()
+    return ALGO_NONE
+
+
+def compute(data: bytes, algo: int) -> int:
+    """Checksum ``data`` under ``algo``; 0 for ALGO_NONE."""
+    if algo == ALGO_NONE:
+        return 0
+    if algo == ALGO_CRC32C:
+        if _crc32c_fn is None:
+            raise UnsupportedChecksum(
+                "frame was written with CRC32C but no crc32c module is "
+                "available in this environment")
+        return _crc32c_fn(data) & 0xFFFFFFFF
+    if algo == ALGO_CRC32:
+        return zlib.crc32(data) & 0xFFFFFFFF
+    raise UnsupportedChecksum(f"unknown checksum algorithm id {algo}")
+
+
+def verify(data: bytes, expected: int, algo: int) -> bool:
+    """True when ``data`` matches ``expected`` under ``algo`` (always
+    True for ALGO_NONE — verification disabled)."""
+    if algo == ALGO_NONE:
+        return True
+    return compute(data, algo) == expected
+
+
+class UnsupportedChecksum(Exception):
+    """Reader cannot compute the writer's algorithm (or the algo byte is
+    unknown) — callers convert this into their tier's corruption error
+    so the frame is rejected, not misread."""
+
+
+def verify_or_raise(data: bytes, expected: int, algo: int, make_err,
+                    what: str = "frame") -> None:
+    """Verify ``data`` or raise the tier's corruption error.
+
+    ``make_err(msg)`` builds the tier-specific corruption exception
+    (ShuffleCorruption / SpillCorruption); an unsupported algorithm is
+    converted through it too, so unverifiable frames are rejected with
+    the same classified error as mismatching ones."""
+    try:
+        ok = verify(data, expected, algo)
+    except UnsupportedChecksum as e:
+        raise make_err(str(e)) from e
+    if not ok:
+        raise make_err(f"{what} checksum mismatch")

@@ -32,6 +32,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: full-sweep batteries excluded from the tier-1 window "
+        "(tier-1 runs -m 'not slow')")
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bound_live_programs():
     """Bound accumulated XLA programs across the suite: the CPU backend's

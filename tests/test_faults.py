@@ -1,0 +1,232 @@
+"""Unit tests for the robustness plane's building blocks: the seeded
+fault-injection plane (runtime/faults.py), the durable-tier checksum
+module (utils/checksum.py) and the backend watchdog
+(runtime/watchdog.py). The end-to-end contract — bit-identical or
+classified, never leaks — lives in test_zz_chaos_battery.py; these pin
+the deterministic mechanics the battery relies on."""
+
+import pytest
+
+from auron_tpu import config as cfg
+from auron_tpu import errors
+from auron_tpu.runtime import faults, watchdog
+from auron_tpu.utils import checksum as cks
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test starts and ends with no fault plan armed."""
+    conf = cfg.get_config()
+    conf.unset(cfg.FAULTS_PLAN)
+    conf.unset(cfg.FAULTS_SEED)
+    faults.reset()
+    yield
+    conf.unset(cfg.FAULTS_PLAN)
+    conf.unset(cfg.FAULTS_SEED)
+    faults.reset()
+
+
+# -- plan grammar -----------------------------------------------------------
+
+def test_parse_plan_grammar():
+    rules = faults.parse_plan(
+        "rss.fetch:corrupt@0.05; spill.read:io_error@0.1 ;device.compute:fatal")
+    assert [(r.site, r.kind, r.prob) for r in rules] == [
+        ("rss.fetch", "corrupt", 0.05),
+        ("spill.read", "io_error", 0.1),
+        ("device.compute", "fatal", 1.0),   # @prob defaults to 1.0
+    ]
+    assert faults.parse_plan("") == []
+
+
+@pytest.mark.parametrize("bad", [
+    "nosuch.site:io_error",          # unknown site
+    "rss.fetch:meteor",              # unknown kind
+    "rss.fetch:corrupt@1.5",         # probability out of range
+    "rss.fetch",                     # malformed (no kind)
+])
+def test_parse_plan_rejects_typos_loudly(bad):
+    with pytest.raises(ValueError):
+        faults.parse_plan(bad)
+
+
+# -- deterministic injection ------------------------------------------------
+
+def _sequence(plan, seed, site, n=64, exc=errors.TransientError):
+    """The injected/clean outcome sequence of ``n`` site checks."""
+    conf = cfg.get_config()
+    conf.set(cfg.FAULTS_PLAN, plan)
+    conf.set(cfg.FAULTS_SEED, seed)
+    faults.reset()
+    out = []
+    for _ in range(n):
+        try:
+            faults.maybe_fail(site, exc)
+            out.append(False)
+        except errors.AuronError:
+            out.append(True)
+    conf.unset(cfg.FAULTS_PLAN)
+    faults.reset()
+    return out
+
+
+def test_same_seed_replays_exactly():
+    a = _sequence("rss.fetch:io_error@0.3", seed=7, site="rss.fetch")
+    b = _sequence("rss.fetch:io_error@0.3", seed=7, site="rss.fetch")
+    assert a == b
+    assert any(a) and not all(a)      # prob 0.3 over 64 events: mixed
+
+
+def test_different_seed_differs():
+    a = _sequence("rss.fetch:io_error@0.3", seed=7, site="rss.fetch")
+    b = _sequence("rss.fetch:io_error@0.3", seed=8, site="rss.fetch")
+    assert a != b
+
+
+def test_unarmed_site_never_fires():
+    assert not any(_sequence("rss.fetch:io_error@1.0", seed=1,
+                             site="spill.read"))
+
+
+def test_io_error_raises_call_sites_class():
+    conf = cfg.get_config()
+    conf.set(cfg.FAULTS_PLAN, "spill.write:io_error@1.0")
+    faults.reset()
+    with pytest.raises(errors.SpillIOError) as ei:
+        faults.maybe_fail("spill.write", errors.SpillIOError)
+    assert ei.value.transient
+    assert ei.value.site == "spill.write"
+
+
+def test_fatal_is_deterministic_class():
+    conf = cfg.get_config()
+    conf.set(cfg.FAULTS_PLAN, "device.compute:fatal@1.0")
+    faults.reset()
+    with pytest.raises(errors.InjectedFatalError) as ei:
+        faults.maybe_fail("device.compute", errors.DeviceExecutionError)
+    assert not ei.value.transient
+
+
+def test_maybe_corrupt_flips_exactly_one_byte_deterministically():
+    conf = cfg.get_config()
+    conf.set(cfg.FAULTS_PLAN, "rss.write:corrupt@1.0")
+    conf.set(cfg.FAULTS_SEED, 3)
+    faults.reset()
+    data = bytes(range(256))
+    a = faults.maybe_corrupt("rss.write", data)
+    faults.reset()
+    b = faults.maybe_corrupt("rss.write", data)
+    assert a == b != data
+    assert sum(x != y for x, y in zip(a, data)) == 1
+    # unarmed: payload passes through untouched, same object
+    conf.unset(cfg.FAULTS_PLAN)
+    faults.reset()
+    assert faults.maybe_corrupt("rss.write", data) is data
+
+
+def test_snapshot_counts_injections():
+    conf = cfg.get_config()
+    conf.set(cfg.FAULTS_PLAN, "rss.fetch:io_error@1.0")
+    faults.reset()
+    base = faults.totals()
+    for _ in range(3):
+        with pytest.raises(errors.AuronError):
+            faults.maybe_fail("rss.fetch", errors.RssUnavailableError)
+    assert faults.snapshot() == {"rss.fetch": {"io_error": 3}}
+    assert faults.totals() - base == 3
+    # totals are monotonic across plane resets (per-task delta source)
+    faults.reset()
+    assert faults.totals() - base == 3
+
+
+# -- checksum module --------------------------------------------------------
+
+def test_checksum_roundtrip_and_detection():
+    algo = cks.preferred_algo()
+    data = b"the quick brown fox" * 100
+    crc = cks.compute(data, algo)
+    assert cks.verify(data, crc, algo)
+    flipped = bytearray(data)
+    flipped[7] ^= 0x01
+    assert not cks.verify(bytes(flipped), crc, algo)
+
+
+def test_checksum_algo_none_disables_verification():
+    assert cks.compute(b"anything", cks.ALGO_NONE) == 0
+    assert cks.verify(b"anything", 0xDEAD, cks.ALGO_NONE)
+
+
+def test_unknown_algo_rejected_not_misread():
+    with pytest.raises(cks.UnsupportedChecksum):
+        cks.compute(b"x", 42)
+
+
+# -- backend watchdog -------------------------------------------------------
+
+def test_watchdog_disabled_by_default():
+    assert watchdog.ensure_backend() is None
+    assert watchdog.first_compile_probe() is None
+
+
+def test_watchdog_init_within_deadline():
+    conf = cfg.AuronConfig().set(cfg.WATCHDOG_INIT_TIMEOUT_S, 30.0)
+    assert watchdog.ensure_backend(conf) == "cpu"
+
+
+def test_watchdog_hang_falls_back_to_cpu():
+    """The wedged-init failure mode (VERDICT r5): an injected hang past
+    the deadline must end in a counted CPU fallback, not a wedged
+    process."""
+    conf = cfg.get_config()
+    conf.set(cfg.FAULTS_PLAN, "backend.init:hang@1.0")
+    conf.set(cfg.FAULTS_HANG_S, 2.0)
+    conf.set(cfg.WATCHDOG_INIT_TIMEOUT_S, 0.2)
+    faults.reset()
+    before = watchdog.totals()
+    try:
+        assert watchdog.ensure_backend(conf) == "cpu"
+        assert watchdog.totals() == before + 1
+    finally:
+        conf.unset(cfg.FAULTS_PLAN)
+        conf.unset(cfg.FAULTS_HANG_S)
+        conf.unset(cfg.WATCHDOG_INIT_TIMEOUT_S)
+        faults.reset()
+
+
+def test_watchdog_real_wedge_confined_to_child():
+    """The targeted VERDICT-r5 mode with a REAL wedge (not an injected
+    fault): backend init that never returns must be confined to the
+    sacrificial probe child — the parent, which never entered jax's
+    backend lock, completes the CPU fallback and still computes."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+    code = "\n".join([
+        "from auron_tpu import config as cfg",
+        "from auron_tpu.runtime import watchdog",
+        "from jax._src import xla_bridge as xb",
+        "assert not xb._backends, 'backends initialized before the probe'",
+        "watchdog._CHILD_PROBE = 'import time; time.sleep(3600)'",
+        "conf = cfg.AuronConfig().set(cfg.WATCHDOG_INIT_TIMEOUT_S, 2.0)",
+        "assert watchdog.ensure_backend(conf) == 'cpu'",
+        "s = watchdog.stats()",
+        "assert s['fallbacks'] == 1 and s['timeouts'] == 1, s",
+        "assert os.environ['JAX_PLATFORMS'] == 'cpu'" .replace(
+            "os.", "__import__('os')."),
+        "import jax, jax.numpy as jnp",
+        "assert float(jax.jit(lambda x: x.sum())(jnp.ones(8))) == 8.0",
+    ])
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=str(Path(__file__).resolve().parents[1]))
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120,
+                          env=env)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_watchdog_compile_probe_returns_seconds():
+    conf = cfg.AuronConfig().set(cfg.WATCHDOG_COMPILE_TIMEOUT_S, 60.0)
+    dt = watchdog.first_compile_probe(conf)
+    assert dt is not None and dt >= 0.0

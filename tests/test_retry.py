@@ -7,10 +7,13 @@ import pyarrow as pa
 import pytest
 
 from auron_tpu import config as cfg
+from auron_tpu import errors
 from auron_tpu.columnar.arrow_bridge import schema_from_arrow
 from auron_tpu.io.parquet import MemoryScanOp
 from auron_tpu.ops.base import PhysicalOp, TaskCancelled
-from auron_tpu.runtime.executor import collect, run_task_with_retries
+from auron_tpu.runtime import executor
+from auron_tpu.runtime.executor import (ExecutionRuntime, TaskDefinition,
+                                        collect, run_task_with_retries)
 
 
 class FlakyOp(PhysicalOp):
@@ -111,6 +114,91 @@ def test_cancellation_not_retried():
     with pytest.raises(TaskCancelled):
         run_task_with_retries(op, 0, 1, config=conf)
     assert op.attempts == 1
+
+
+def test_no_message_pattern_matching_left_on_retry_path():
+    """The retry driver routes purely on the error taxonomy: the
+    _NO_RETRY_RUNTIME_PATTERNS table and its matcher are gone from the
+    executor (classification of XLA's ambiguous RuntimeErrors happens
+    once, at the device-compute boundary, via errors.classify_runtime)."""
+    assert not hasattr(executor, "_NO_RETRY_RUNTIME_PATTERNS")
+    assert not hasattr(executor, "_is_deterministic_failure")
+
+
+@pytest.mark.parametrize("exc_cls", [
+    errors.DeviceExecutionError,   # transient device/backend blip
+    errors.RssUnavailableError,    # RSS service IO failure
+    errors.SpillIOError,           # spill-file IO failure
+    errors.SpillCorruption,        # per-attempt artifact: recompute rewrites
+    errors.StorageIOError,
+])
+def test_transient_taxonomy_classes_retried(exc_cls):
+    assert errors.is_transient(exc_cls("injected"))
+    op = FlakyOp(_scan(), failures=1, exc=exc_cls)
+    conf = cfg.AuronConfig().set(cfg.TASK_MAX_RETRIES, 2)
+    out = run_task_with_retries(op, 0, 1, config=conf)
+    assert out.column("x").to_pylist() == [1, 2, 3, 4]
+    assert op.attempts == 2
+
+
+@pytest.mark.parametrize("exc_cls", [
+    errors.KernelLoweringError,    # deterministic lowering/shape defect
+    errors.InjectedFatalError,     # chaos plans' deterministic kind
+    errors.BackendInitError,       # re-entering a wedged client can't help
+    errors.ShuffleCorruption,      # needs map recompute, not reducer rerun
+    errors.PlanError,
+])
+def test_deterministic_taxonomy_classes_fail_fast(exc_cls):
+    assert not errors.is_transient(exc_cls("injected"))
+    op = FlakyOp(_scan(), failures=10, exc=exc_cls)
+    conf = cfg.AuronConfig().set(cfg.TASK_MAX_RETRIES, 3)
+    with pytest.raises(exc_cls):
+        run_task_with_retries(op, 0, 1, config=conf)
+    assert op.attempts == 1
+
+
+def test_classify_runtime_splits_xla_ambiguity():
+    """The device-compute boundary's classifier: lowering/shape
+    signatures become the deterministic class, anything else the
+    transient class — and both land in the legacy RuntimeError family
+    so existing except sites keep working."""
+    det = errors.classify_runtime(RuntimeError("Mosaic lowering failed"))
+    assert isinstance(det, errors.KernelLoweringError)
+    assert isinstance(det, RuntimeError) and not det.transient
+    trans = errors.classify_runtime(RuntimeError("connection reset"))
+    assert isinstance(trans, errors.DeviceExecutionError)
+    assert isinstance(trans, RuntimeError) and trans.transient
+
+
+def test_exponential_backoff_full_jitter_bounds():
+    from auron_tpu.runtime.executor import _retry_backoff_s
+    assert _retry_backoff_s(5, base=0.0, cap=30.0) == 0.0
+    for attempt in range(6):
+        bound = min(4.0, 0.25 * 2 ** attempt)
+        draws = [_retry_backoff_s(attempt, base=0.25, cap=4.0)
+                 for _ in range(200)]
+        assert all(0.0 <= d <= bound for d in draws)
+        # full jitter: draws spread over the window, not a fixed point
+        assert max(draws) - min(draws) > bound * 0.1
+
+
+def test_finalize_snapshot_carries_recovery_counters():
+    from auron_tpu.runtime import watchdog
+
+    rb = pa.record_batch({"x": pa.array([1, 2], pa.int64())})
+    scan = MemoryScanOp([[rb]], schema_from_arrow(rb.schema), capacity=8)
+    rt = ExecutionRuntime(
+        scan, TaskDefinition(partition_id=0, num_partitions=1, task_id=2),
+        attempt=2, retry_stats={"transient_retries": 2})
+    rt.collect()
+    rec = rt.finalize()["recovery"]
+    assert rec["attempts"] == 3
+    assert rec["transient_retries"] == 2
+    assert rec["corruption_recomputes"] == 0
+    # process-level total (watchdog probes run at Session init, before
+    # any task exists — a per-task delta could never be nonzero)
+    assert rec["watchdog_fallbacks"] == watchdog.totals()
+    assert rec["faults_injected"] == 0
 
 
 def test_multi_partition_retries_only_failed_partition():

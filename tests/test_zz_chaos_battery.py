@@ -1,0 +1,276 @@
+"""Seeded chaos battery: the executable contract of the robustness
+plane (ISSUE 4).
+
+For EVERY seeded fault plan, a run either produces output bit-identical
+to its fault-free baseline (recovery worked) or raises a classified
+``AuronError`` (failure surfaced with a transient/deterministic
+verdict) — never silently wrong rows, never an unclassified crash, and
+never leaked ``.part``/spill files after teardown. The scenarios
+(auron_tpu/it/chaos.py) give every injection site traffic: the RSS
+durable tier, the spill durable tier, and the device-compute/
+program-build path through a Session-planned aggregation.
+
+Tier-1 runs the fast seeds; the full sweep (more seeds — what
+tools/chaos_report.py prints a table for) is marked ``slow``. Named
+test_zz_* so the time-boxed tier-1 window runs unit batteries first.
+
+The two ``test_flipped_byte_*`` cases are the acceptance criterion's
+direct proof: ONE byte flipped on committed durable state (out-of-band,
+no fault plane) is detected by the frame checksum and recovered by
+recompute — map-granular for the RSS tier, task-granular for spills.
+"""
+
+import os
+import struct
+import tempfile
+
+import pyarrow as pa
+import pytest
+
+from auron_tpu import config as cfg
+from auron_tpu import errors
+from auron_tpu.it import chaos
+from auron_tpu.runtime import faults
+
+#: (scenario name, fault plan) pairs giving every site traffic
+_PLANS = [
+    ("rss_pipeline", "rss.write:io_error@0.2"),
+    ("rss_pipeline", "rss.write:corrupt@0.3"),
+    ("rss_pipeline", "rss.flush:io_error@0.4"),
+    ("rss_pipeline", "rss.commit:fatal@0.5"),
+    ("rss_pipeline", "rss.fetch:corrupt@0.1"),
+    ("rss_pipeline", "rss.fetch:io_error@0.3"),
+    ("spill_sort", "spill.write:io_error@0.3"),
+    ("spill_sort", "spill.write:corrupt@0.4"),
+    ("spill_sort", "spill.read:io_error@0.4"),
+    ("spill_sort", "spill.read:corrupt@0.15"),
+    ("agg_pipeline", "device.compute:io_error@0.3"),
+    ("agg_pipeline", "device.compute:fatal@0.5"),
+    ("agg_pipeline", "program.build:io_error@0.2"),
+    ("agg_pipeline",
+     "device.compute:io_error@0.2;rss.fetch:corrupt@0.1"),
+]
+
+_FAST_SEEDS = (1, 2)
+_SWEEP_SEEDS = tuple(range(3, 11))
+
+
+@pytest.fixture(scope="module")
+def scenarios():
+    with tempfile.TemporaryDirectory(prefix="chaos_battery_") as d:
+        built = {name: factory(os.path.join(d, name))
+                 for name, factory in chaos.SCENARIOS.items()}
+        yield built
+
+
+def _assert_contract(outcome):
+    assert outcome.status in ("identical", "classified"), (
+        f"chaos contract violated: {outcome.scenario} under "
+        f"{outcome.fault_plan!r} seed={outcome.seed} -> {outcome.status} "
+        f"({outcome.error_type}: {outcome.error})")
+    assert not outcome.leaks, (
+        f"leaked temp files after {outcome.scenario} under "
+        f"{outcome.fault_plan!r} seed={outcome.seed}: {outcome.leaks}")
+
+
+@pytest.mark.parametrize("scenario,plan", _PLANS)
+@pytest.mark.parametrize("seed", _FAST_SEEDS)
+def test_chaos_fast(scenario, plan, seed, scenarios):
+    _assert_contract(chaos.run_chaos(scenarios[scenario], plan, seed))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario,plan", _PLANS)
+@pytest.mark.parametrize("seed", _SWEEP_SEEDS)
+def test_chaos_full_sweep(scenario, plan, seed, scenarios):
+    _assert_contract(chaos.run_chaos(scenarios[scenario], plan, seed))
+
+
+# -- TPC-DS subset under injected faults ------------------------------------
+
+_TPCDS_NAMES = ["q3", "q96"]
+_TPCDS_PLANS = ["device.compute:io_error@0.1",
+                "device.compute:fatal@0.05",
+                "program.build:io_error@0.1"]
+
+
+@pytest.fixture(scope="module")
+def tpcds_tables():
+    from auron_tpu.it.tpcds import generate
+    with tempfile.TemporaryDirectory(prefix="chaos_tpcds_") as d:
+        yield generate(d, scale=0.01)
+
+
+@pytest.mark.parametrize("qname", _TPCDS_NAMES)
+@pytest.mark.parametrize("plan", _TPCDS_PLANS)
+def test_tpcds_under_faults_identical_or_classified(qname, plan,
+                                                    tpcds_tables):
+    from auron_tpu.frontend.session import Session
+    from auron_tpu.it.tpcds_queries import QUERIES
+    q = next(x for x in QUERIES if x.name == qname)
+    conf = cfg.get_config()
+    conf.unset(cfg.FAULTS_PLAN)
+    faults.reset()
+    baseline = q.run(Session(), tpcds_tables)
+    conf.set(cfg.FAULTS_PLAN, plan)
+    conf.set(cfg.FAULTS_SEED, 5)
+    faults.reset()
+    try:
+        out = q.run(Session(), tpcds_tables)
+    except errors.AuronError:
+        return   # classified: contract satisfied
+    finally:
+        conf.unset(cfg.FAULTS_PLAN)
+        conf.unset(cfg.FAULTS_SEED)
+        faults.reset()
+    assert out.equals(baseline), \
+        f"{qname} under {plan!r}: silent divergence from fault-free run"
+
+
+# -- flipped-byte proofs (acceptance criterion) ------------------------------
+
+def _rows(n):
+    import numpy as np
+    rng = np.random.default_rng(3)
+    return pa.record_batch({
+        "k": pa.array(rng.integers(0, 32, n), pa.int64()),
+        "v": pa.array(rng.normal(size=n)),
+    })
+
+
+def test_flipped_byte_in_rss_map_output_recovered_by_recompute(tmp_path):
+    """Flip one byte of a COMMITTED map-output frame on disk: the next
+    fetch detects the checksum mismatch, invalidates exactly that map
+    output, recomputes the map task from its child, and the reducer's
+    result is bit-identical to the clean run — never silently wrong."""
+    from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+    from auron_tpu.exprs import ir
+    from auron_tpu.io.parquet import MemoryScanOp
+    from auron_tpu.parallel.exchange import RssShuffleExchangeOp
+    from auron_tpu.parallel.partitioning import HashPartitioning
+    from auron_tpu.parallel.shuffle_service import FileShuffleService
+    from auron_tpu.runtime.executor import collect
+
+    rb = _rows(2048)
+    service = FileShuffleService(str(tmp_path))
+
+    def exchange():
+        scan = MemoryScanOp(
+            [[rb.slice(o, 512) for o in range(0, rb.num_rows, 512)]],
+            schema_from_arrow(rb.schema), capacity=512)
+        return RssShuffleExchangeOp(
+            scan, HashPartitioning([ir.ColumnRef(0)], 3), service,
+            shuffle_id=7, input_partitions=1)
+
+    def canon(t):
+        return t.sort_by([(c, "ascending") for c in t.column_names])
+
+    baseline = canon(collect(exchange(), num_partitions=3))
+    data_file = os.path.join(str(tmp_path), "shuffle_7", "map_0.data")
+    assert os.path.exists(data_file)
+    # flip one byte INSIDE the first frame's body (past its 8-byte
+    # <len><crc> record header)
+    with open(data_file, "r+b") as f:
+        f.seek(8 + 16)
+        b = f.read(1)
+        f.seek(8 + 16)
+        f.write(bytes([b[0] ^ 0xFF]))
+    # a fresh reducer pass over the SAME committed shuffle: the fetch
+    # must detect, recompute map 0, and produce identical output
+    op = exchange()
+    op._written = True   # committed state is on storage; readers only
+    out = canon(collect(op, num_partitions=3))
+    assert out.equals(baseline)
+    # the recomputed map output is clean again on storage
+    assert canon(collect(exchange(), num_partitions=3)).equals(baseline)
+
+
+def test_flipped_byte_in_spill_file_detected():
+    """Flip one byte of a finished spill frame on disk: the read path
+    raises SpillCorruption (a TRANSIENT error — spill files are
+    per-attempt artifacts, so the retry driver's task recompute rewrites
+    them; routing proven in test_retry.py)."""
+    from auron_tpu.memmgr.spill import SpillManager
+
+    with tempfile.TemporaryDirectory() as d:
+        mgr = SpillManager(host_budget_bytes=0, spill_dir=d)
+        spill = mgr.new_spill()
+        frames = [bytes([i]) * 2000 for i in range(4)]
+        for fr in frames:
+            spill.write_frame(fr)
+        spill.finish()
+        assert list(spill.frames()) == frames      # clean roundtrip
+        with open(spill._path, "r+b") as f:
+            f.seek(5 + 8 + 100)   # file header + record header + 100
+            b = f.read(1)
+            f.seek(5 + 8 + 100)
+            f.write(bytes([b[0] ^ 0x10]))
+        with pytest.raises(errors.SpillCorruption) as ei:
+            list(spill.frames())
+        assert errors.is_transient(ei.value)
+        spill.release()
+
+
+def test_spill_corruption_recovered_by_task_recompute():
+    """End to end: a spill file corrupted on disk after its first-attempt
+    write is detected on read, the attempt fails with the TRANSIENT
+    SpillCorruption, and the retry driver's recompute (which rewrites
+    spills from source) produces the exact sorted output."""
+    from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+    from auron_tpu.exprs import ir
+    from auron_tpu.io.parquet import MemoryScanOp
+    from auron_tpu.memmgr.manager import MemManager
+    from auron_tpu.memmgr.spill import SpillManager
+    from auron_tpu.ops.sort import SortOp
+    from auron_tpu.runtime.executor import collect
+
+    class CorruptFirstSpillManager(SpillManager):
+        """Flips a byte of the FIRST finished spill file — simulated
+        storage bit rot between write and read of one attempt."""
+
+        def __init__(self, spill_dir):
+            super().__init__(host_budget_bytes=1, spill_dir=spill_dir)
+            self.rotted = False
+
+        def new_spill(self):
+            spill = super().new_spill()
+            orig_finish = spill.finish
+
+            def finish():
+                out = orig_finish()
+                if not self.rotted and spill._path is not None:
+                    with open(spill._path, "r+b") as f:
+                        f.seek(5 + 8 + 50)
+                        b = f.read(1)
+                        f.seek(5 + 8 + 50)
+                        f.write(bytes([b[0] ^ 0xFF]))
+                    self.rotted = True
+                return out
+
+            spill.finish = finish
+            return spill
+
+    rb = _rows(2000)
+    with tempfile.TemporaryDirectory() as d:
+        def run(spill_mgr):
+            scan = MemoryScanOp(
+                [[rb.slice(o, 500) for o in range(0, rb.num_rows, 500)]],
+                schema_from_arrow(rb.schema), capacity=512)
+            op = SortOp(scan, [ir.SortOrder(ir.ColumnRef(0),
+                                            ascending=True)])
+            mm = MemManager(total_bytes=1, min_trigger=0,
+                            spill_manager=spill_mgr)
+            conf = cfg.AuronConfig().set(cfg.TASK_MAX_RETRIES, 2)
+            return collect(op, num_partitions=1, mem_manager=mm,
+                           config=conf)
+
+        baseline = run(SpillManager(host_budget_bytes=1, spill_dir=d))
+        mgr = CorruptFirstSpillManager(d)
+        out = run(mgr)
+        assert mgr.rotted                       # the corruption happened
+        assert out.equals(baseline)             # ...and recompute healed it
+        # per-attempt artifacts: nothing left behind after teardown
+        import gc
+        gc.collect()
+        assert not [f for f in os.listdir(d)
+                    if f.startswith("auron-spill-")]

@@ -1,0 +1,137 @@
+"""Chaos-battery sweep report: injected faults vs recovery outcome.
+
+Runs the chaos harness (auron_tpu/it/chaos.py) across N seeds for every
+(scenario, fault plan) pair of the battery and prints a site-by-site
+table: how many faults each plan injected, how many runs recovered to
+bit-identical output, how many surfaced a classified ``AuronError`` —
+and, the failure buckets, how many diverged silently (``mismatch``) or
+crashed unclassified. A non-zero exit means the robustness contract
+broke somewhere in the sweep; the failing (plan, seed) pairs replay
+exactly via ``auron.faults.plan`` / ``auron.faults.seed``.
+
+    python tools/chaos_report.py                   # default 8 seeds
+    python tools/chaos_report.py --seeds 32
+    python tools/chaos_report.py --scenario spill_sort
+
+The last stdout line is one JSON record (same driver contract as
+bench.py / compile_report.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+# CPU mesh before jax init: chaos verifies recovery logic, not device
+# perf — it must run on a wedged-accelerator host
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_xf = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xf:
+    os.environ["XLA_FLAGS"] = (
+        _xf + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: the battery's (scenario, plan) pairs — one per site/kind with traffic
+PLANS = [
+    ("rss_pipeline", "rss.write:io_error@0.2"),
+    ("rss_pipeline", "rss.write:corrupt@0.3"),
+    ("rss_pipeline", "rss.flush:io_error@0.4"),
+    ("rss_pipeline", "rss.commit:fatal@0.5"),
+    ("rss_pipeline", "rss.fetch:corrupt@0.1"),
+    ("rss_pipeline", "rss.fetch:io_error@0.3"),
+    ("spill_sort", "spill.write:io_error@0.3"),
+    ("spill_sort", "spill.write:corrupt@0.4"),
+    ("spill_sort", "spill.read:io_error@0.4"),
+    ("spill_sort", "spill.read:corrupt@0.15"),
+    ("agg_pipeline", "device.compute:io_error@0.3"),
+    ("agg_pipeline", "device.compute:fatal@0.5"),
+    ("agg_pipeline", "program.build:io_error@0.2"),
+    ("agg_pipeline", "device.compute:io_error@0.2;rss.fetch:corrupt@0.1"),
+]
+
+
+def run_sweep(seeds: int, scenario_filter: str | None) -> dict:
+    from auron_tpu.it import chaos
+
+    rows = []
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="chaos_report_") as d:
+        scenarios = {name: factory(os.path.join(d, name))
+                     for name, factory in chaos.SCENARIOS.items()}
+        for scen_name, plan in PLANS:
+            if scenario_filter and scen_name != scenario_filter:
+                continue
+            agg = {"identical": 0, "classified": 0, "mismatch": 0,
+                   "unclassified": 0}
+            injected = 0
+            leaked = 0
+            for seed in range(1, seeds + 1):
+                o = chaos.run_chaos(scenarios[scen_name], plan, seed)
+                agg[o.status] += 1
+                injected += sum(sum(v.values())
+                                for v in o.injected.values())
+                leaked += len(o.leaks)
+                if not o.ok:
+                    failures.append({
+                        "scenario": scen_name, "plan": plan, "seed": seed,
+                        "status": o.status, "error_type": o.error_type,
+                        "error": o.error, "leaks": o.leaks})
+            rows.append({"scenario": scen_name, "plan": plan,
+                         "injected": injected, "leaked": leaked, **agg})
+    return {"seeds": seeds, "rows": rows, "failures": failures}
+
+
+def print_table(report: dict) -> None:
+    w_plan = max(len(r["plan"]) for r in report["rows"])
+    hdr = (f"{'scenario':13s} {'fault plan':{w_plan}s} {'inj':>5s} "
+           f"{'ident':>5s} {'class':>5s} {'mism':>4s} {'uncls':>5s} "
+           f"{'leak':>4s}")
+    print(hdr)
+    print("-" * len(hdr))
+    for r in report["rows"]:
+        print(f"{r['scenario']:13s} {r['plan']:{w_plan}s} "
+              f"{r['injected']:>5d} {r['identical']:>5d} "
+              f"{r['classified']:>5d} {r['mismatch']:>4d} "
+              f"{r['unclassified']:>5d} {r['leaked']:>4d}")
+    total = {k: sum(r[k] for r in report["rows"])
+             for k in ("injected", "identical", "classified", "mismatch",
+                       "unclassified", "leaked")}
+    print("-" * len(hdr))
+    print(f"{'TOTAL':13s} {'':{w_plan}s} {total['injected']:>5d} "
+          f"{total['identical']:>5d} {total['classified']:>5d} "
+          f"{total['mismatch']:>4d} {total['unclassified']:>5d} "
+          f"{total['leaked']:>4d}")
+    for f in report["failures"]:
+        print(f"CONTRACT BROKEN: {f['scenario']} plan={f['plan']!r} "
+              f"seed={f['seed']} -> {f['status']} "
+              f"({f['error_type']}: {f['error']}) leaks={f['leaks']}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", type=int, default=8,
+                    help="seeds per (scenario, plan) pair")
+    ap.add_argument("--scenario", choices=["rss_pipeline", "spill_sort",
+                                           "agg_pipeline"], default=None)
+    args = ap.parse_args(argv)
+
+    report = run_sweep(args.seeds, args.scenario)
+    print_table(report)
+    ok = not report["failures"]
+    print(json.dumps({"chaos_seeds": report["seeds"],
+                      "chaos_runs": sum(
+                          sum(r[k] for k in ("identical", "classified",
+                                             "mismatch", "unclassified"))
+                          for r in report["rows"]),
+                      "chaos_injected": sum(r["injected"]
+                                            for r in report["rows"]),
+                      "chaos_contract_ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
