@@ -211,6 +211,46 @@ PROFILE_DIR = _opt(
     "under the system temp dir. The trace is viewable with "
     "tensorboard/xprof.")
 
+# tracing plane (auron_tpu/obs/trace.py)
+TRACE_ENABLED = _opt(
+    "auron.trace.enabled", bool, False,
+    "Record the query→stage→task→operator→event span timeline "
+    "(auron_tpu/obs/trace.py): task attempts and retry backoffs, "
+    "program builds per compile site, shuffle write/flush/commit/fetch, "
+    "spill tier decisions, injected faults (site/kind attributes) and "
+    "watchdog probes. Spans are buffered lock-free per thread; the "
+    "disabled hot path costs one cached epoch compare. Export with "
+    "auron.trace.dir (per-query Chrome-trace JSON + JSONL) or the "
+    "trace API (tools/trace_report.py summarizes a trace dir).")
+TRACE_DIR = _opt(
+    "auron.trace.dir", str, "",
+    "Directory the tracer exports each top-level query's spans into "
+    "(trace_<id>.json Chrome/Perfetto trace + trace_<id>.jsonl event "
+    "log), written when the outermost Session.execute finishes. Empty "
+    "(the default) keeps spans in memory for the trace API only.")
+TRACE_EVENTS = _opt(
+    "auron.trace.events", str, "",
+    "Comma-separated span-category allowlist (query, task, program, "
+    "shuffle, spill, fault, watchdog); empty records every category. "
+    "Narrowing the list bounds tracing overhead on hot paths — e.g. "
+    "'task,shuffle,fault' drops the per-hit program events.")
+TRACE_MAX_SPANS = _opt(
+    "auron.trace.max_spans", int, 200_000,
+    "Ceiling on buffered spans per process; past it new spans are "
+    "dropped (counted — the Chrome export records dropped_spans) so an "
+    "unbounded query can never turn the tracer into a memory leak. "
+    "The cap is approximate: enforcement is lock-free like recording.")
+
+# process metrics registry (auron_tpu/obs/registry.py)
+METRICS_REGISTRY = _opt(
+    "auron.metrics.registry", bool, True,
+    "Aggregate per-task observations (task seconds histogram, retries, "
+    "recovery/spill/program counters) into the process-wide metrics "
+    "registry (auron_tpu/obs/registry.py), whose Prometheus text "
+    "exposition (render_prometheus) is the scrape surface — the role "
+    "of the reference's pprof HTTP endpoints. Off skips the per-task "
+    "observation entirely.")
+
 # metrics / sinks
 METRICS_DEVICE_SYNC = _opt(
     "auron.metrics.device_sync", bool, True,

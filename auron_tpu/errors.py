@@ -153,12 +153,25 @@ _XLA_DETERMINISTIC_PATTERNS = (
 )
 
 
-def classify_runtime(e: RuntimeError) -> AuronError:
+def classify_runtime(e: RuntimeError) -> BaseException:
     """Classify a bare RuntimeError crossing the device-compute boundary
     into the taxonomy. Deterministic lowering/shape signatures become
     KernelLoweringError (no retry); everything else — XLA wraps
     resource and external-service failures in plain RuntimeError — is
-    DeviceExecutionError (retry)."""
+    DeviceExecutionError (retry).
+
+    Taxonomy trap guarded FIRST: ``NotImplementedError`` IS-A
+    RuntimeError (and jax raises TypeError-adjacent errors for trace/
+    lowering defects), so the deterministic builtin types must be
+    checked before the message split — otherwise the engine's
+    deliberate unsupported-plan rejections would be re-wrapped as a
+    *transient* DeviceExecutionError and retried ``retries+1`` times.
+    They return UNCHANGED (``raise classify_runtime(e) from e`` keeps
+    the original type) because callers catch them by type to reject
+    unsupported plans; ``is_transient`` already routes them
+    non-transient by NO_RETRY_TYPES membership."""
+    if isinstance(e, NO_RETRY_TYPES):
+        return e
     msg = str(e)
     low = msg.lower()
     if any(p in low for p in _XLA_DETERMINISTIC_PATTERNS):

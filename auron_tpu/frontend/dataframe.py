@@ -864,6 +864,12 @@ class DataFrame:
     def to_pandas(self):
         return self.collect().to_pandas()
 
-    def explain(self) -> str:
+    def explain(self, analyze: bool = False) -> str:
+        """The plan tree; ``analyze=True`` EXECUTES the plan and
+        annotates every node with its mirrored metrics
+        (elapsed_compute, output_rows, spill/shuffle counters — the
+        EXPLAIN ANALYZE of obs/metric_tree.py)."""
+        if analyze:
+            return self.session.explain_analyze(self)
         op = self.session.plan_physical(self)
         return op.tree_string()

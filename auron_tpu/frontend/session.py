@@ -153,6 +153,26 @@ class Session:
         return plan_from_bytes(df.task_bytes(), self.ctx)
 
     def execute(self, df: DataFrame) -> pa.Table:
-        op = self.plan_physical(df)
-        return _collect(op, num_partitions=df.num_partitions,
-                        mem_manager=self.mem_manager, config=self.config)
+        from auron_tpu.obs import trace
+        # one trace per TOP-LEVEL query: nested executes (host-fn
+        # children, scalar subqueries) join the enclosing trace, and the
+        # outermost scope exports into auron.trace.dir when set
+        with trace.query_scope(label=f"p{df.num_partitions}"):
+            op = self.plan_physical(df)
+            return _collect(op, num_partitions=df.num_partitions,
+                            mem_manager=self.mem_manager,
+                            config=self.config)
+
+    def explain_analyze(self, df: DataFrame) -> str:
+        """EXPLAIN ANALYZE: run the plan with a positional metric tree
+        mirrored at every task finalize (obs/metric_tree — the
+        update_metric_node walk of the reference, rt.rs:302-308) and
+        render the annotated plan."""
+        from auron_tpu.obs import metric_tree as mt
+        from auron_tpu.obs import trace
+        with trace.query_scope(label="explain_analyze"):
+            op = self.plan_physical(df)
+            tree, _table = mt.explain_analyze(
+                op, num_partitions=df.num_partitions,
+                mem_manager=self.mem_manager, config=self.config)
+        return mt.render(tree)

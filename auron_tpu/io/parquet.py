@@ -98,7 +98,7 @@ class ParquetScanOp(PhysicalOp):
                 if i % num_partitions == partition]
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         io_time = metrics.counter("io_time")
         files = self._partition_files(partition, max(ctx.num_partitions, 1))
 
@@ -144,7 +144,7 @@ class ParquetScanOp(PhysicalOp):
                     with timer(io_time):
                         yield pending.result()
 
-        return count_output(stream(), metrics)
+        return count_output(stream(), metrics, timed=True)
 
     def _widths_for(self, rb: pa.RecordBatch) -> dict[str, int]:
         """Stable width buckets per string column, learned once per scan from
@@ -190,7 +190,7 @@ class MemoryScanOp(PhysicalOp):
         return self._schema
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
 
         def stream():
             for rb in self.partitions[partition]:
@@ -198,7 +198,7 @@ class MemoryScanOp(PhysicalOp):
                     yield to_device(rb, capacity=self.capacity,
                                     string_widths=self.string_widths)[0]
 
-        return count_output(stream(), metrics)
+        return count_output(stream(), metrics, timed=True)
 
 
 class DeviceBatchScanOp(PhysicalOp):
@@ -223,5 +223,5 @@ class DeviceBatchScanOp(PhysicalOp):
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         parts = self.partitions(partition) if callable(self.partitions) \
             else self.partitions[partition]
-        metrics = ctx.metrics_for(self.name)
-        return count_output(iter(parts), metrics)
+        metrics = ctx.metrics_for(self)
+        return count_output(iter(parts), metrics, timed=True)

@@ -58,7 +58,7 @@ class _FileSinkOp(PhysicalOp):
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from auron_tpu import config as cfg
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         io_time = metrics.counter("io_time")
         child_schema = self.child.schema()
         buffer_rows = ctx.conf.get(cfg.SINK_BUFFER_ROWS)
@@ -114,7 +114,7 @@ class _FileSinkOp(PhysicalOp):
             result = pa.record_batch({"num_rows": pa.array([n], pa.int64())})
             yield to_device(result, capacity=16)[0]
 
-        return count_output(stream(), metrics)
+        return count_output(stream(), metrics, timed=True)
 
     def _write_chunk(self, writer, chunk: pa.Table, partition: int,
                      wstate: dict):

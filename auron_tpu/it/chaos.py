@@ -23,6 +23,7 @@ from __future__ import annotations
 import gc
 import glob
 import os
+import sys
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -47,10 +48,70 @@ class ChaosOutcome:
     injected: dict = field(default_factory=dict)
     #: leftover .part / spill files after teardown (must be empty)
     leaks: list = field(default_factory=list)
+    #: trace id of the run's span timeline (obs/trace; 0 = none)
+    trace_id: int = 0
+    #: site → {injected, fault_spans, recovery: {span name: count}} —
+    #: the fault-injection events linked to the recovery spans they
+    #: triggered (tools/chaos_report prints the aggregate table)
+    correlation: dict = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
         return self.status in ("identical", "classified") and not self.leaks
+
+
+#: span names that ARE recovery actions on the timeline: task-level
+#: retries, corrupt-map recomputes, watchdog CPU fallbacks
+RECOVERY_SPAN_NAMES = ("task.retry", "shuffle.corruption_recompute",
+                       "watchdog.fallback")
+
+
+#: which injection KINDS can cause each recovery span — the corrupt
+#: kind has a DEFERRED effect (injected at write, detected at fetch),
+#: so a corruption recompute must skip over interleaved io_error/hang
+#: injections when walking back for its cause
+_RECOVERY_CAUSE_KINDS = {
+    "shuffle.corruption_recompute": ("corrupt",),
+    # any injected backend.init kind (hang, io_error, fatal) can force
+    # the CPU fallback, so the watchdog entry lists them all
+    "watchdog.fallback": ("hang", "io_error", "fatal"),
+}
+
+
+def correlate_spans(spans) -> dict:
+    """Link fault-injection events to the recovery spans they triggered:
+    each recovery span is attributed to the NEAREST PRECEDING injection
+    of a kind that can cause it (the causality proxy — the run is
+    single-pipeline, so the recovery that follows an injection was
+    triggered by it). Nearest-preceding, not first-injection-onward: a
+    multi-site plan must not double-count one task.retry under every
+    armed site; kind-aware, because a corrupt fault injected at WRITE
+    time recovers only at fetch time, past unrelated injections."""
+    inj = sorted((s for s in spans
+                  if s.cat == "fault" and s.name == "fault.injected"),
+                 key=lambda s: (s.ts_ns, s.span_id))
+    rec = [s for s in spans if s.name in RECOVERY_SPAN_NAMES]
+    out: dict = {}
+    for s in inj:
+        site = s.attrs.get("site")
+        entry = out.setdefault(site, {"injected": 0, "fault_spans": [],
+                                      "recovery": {}})
+        entry["injected"] += 1
+        if len(entry["fault_spans"]) < 16:
+            entry["fault_spans"].append(s.span_id)
+    for r in rec:
+        kinds = _RECOVERY_CAUSE_KINDS.get(r.name)
+        prev = None
+        for s in inj:
+            if s.ts_ns > r.ts_ns:
+                break
+            if kinds is None or s.attrs.get("kind") in kinds:
+                prev = s
+        if prev is None:
+            continue
+        counts = out[prev.attrs.get("site")]["recovery"]
+        counts[r.name] = counts.get(r.name, 0) + 1
+    return out
 
 
 class Scenario:
@@ -190,22 +251,55 @@ SCENARIOS: dict[str, Callable[[str], Scenario]] = {
 }
 
 
-def run_chaos(scenario: Scenario, fault_plan: str,
-              seed: int) -> ChaosOutcome:
+def run_chaos(scenario: Scenario, fault_plan: str, seed: int,
+              with_trace: bool = True) -> ChaosOutcome:
     """One chaos run: arm the plan at ``seed``, execute a fresh pipeline,
     classify the outcome against the fault-free baseline, audit leaks.
     The global fault config is restored (and the plane reset) whatever
-    happens."""
+    happens.
+
+    ``with_trace`` (default) records the run under its own trace id
+    (obs/trace) and attaches the site→recovery-span correlation, so a
+    chaos report links every injected fault to the recovery it
+    triggered."""
+    from auron_tpu.obs import trace
     baseline = scenario.baseline()
     conf = cfg.get_config()
     conf.set(cfg.FAULTS_PLAN, fault_plan)
     conf.set(cfg.FAULTS_SEED, seed)
+    _missing = object()
+    saved_trace = {}
+    if with_trace:
+        # save-and-restore, not unset: a caller's own session override
+        # (debugging with tracing armed) must survive the chaos run
+        for key in (cfg.TRACE_ENABLED, cfg.TRACE_DIR, cfg.TRACE_EVENTS):
+            saved_trace[key] = conf._overrides.get(key, _missing)
+        conf.set(cfg.TRACE_ENABLED, True)
+        # keep spans in memory and every category recording: an ambient
+        # auron.trace.dir (CI env var) would make the query scope
+        # export-and-DROP the trace before correlate_spans below ever
+        # sees it, and an ambient auron.trace.events allowlist would
+        # filter out the fault/recovery events the correlation reads
+        conf.set(cfg.TRACE_DIR, "")
+        conf.set(cfg.TRACE_EVENTS, "")
     faults.reset()
     injected: dict = {}
+    trace_id = 0
+    correlation: dict = {}
     try:
+        scope = trace.query_scope(label=f"chaos:{scenario.name}") \
+            if with_trace else None
         try:
+            if scope is not None:
+                scope.__enter__()
+                trace_id = scope.trace_id
             out = scenario.run()
         finally:
+            if scope is not None:
+                # real exc_info, not Nones: the root span's error
+                # attribute is what makes a failed chaos trace
+                # self-explaining in trace_report
+                scope.__exit__(*sys.exc_info())
             injected = faults.snapshot()
         status = "identical" if out.equals(baseline) else "mismatch"
         err_t = err = None
@@ -214,9 +308,23 @@ def run_chaos(scenario: Scenario, fault_plan: str,
     except Exception as e:   # noqa: BLE001 — the contract's failure bucket
         status, err_t, err = "unclassified", type(e).__name__, str(e)
     finally:
+        if with_trace:
+            correlation = correlate_spans(
+                trace.tracer().spans(trace_id or None))
+            for key, prev in saved_trace.items():
+                if prev is _missing:
+                    conf.unset(key)
+                else:
+                    conf.set(key, prev)
+            # drop only THIS run's spans: a caller's own in-progress
+            # trace (the debugging scenario the save/restore above
+            # protects) must survive — a global reset would wipe it
+            if trace_id:
+                trace.tracer().drop(trace_id)
         conf.unset(cfg.FAULTS_PLAN)
         conf.unset(cfg.FAULTS_SEED)
         faults.reset()
     return ChaosOutcome(scenario.name, fault_plan, seed, status,
                         error_type=err_t, error=err, injected=injected,
-                        leaks=scenario.leaks())
+                        leaks=scenario.leaks(), trace_id=trace_id,
+                        correlation=correlation)

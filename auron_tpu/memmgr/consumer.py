@@ -72,6 +72,7 @@ class BufferedSpillConsumer:
     # -- MemConsumer --------------------------------------------------------
 
     def spill(self) -> int:
+        from auron_tpu.obs import trace
         with self._lock:
             if not self.buffered:
                 return 0
@@ -79,17 +80,24 @@ class BufferedSpillConsumer:
             freed, self.bytes = self.bytes, 0
             self._inflight_spills += 1
         try:
-            spill = self.mem.spill_manager.new_spill()
-            try:
-                self._write_run(spill, buffered)
-            except BaseException:
-                # a failed run write (IO error mid-frame) must not leak
-                # the half-written spill file: the run was claimed but
-                # never published, so nobody else will ever release it
-                spill.release()
-                raise
-            with self._lock:
-                self.spills.append(spill.finish())
+            with trace.span("spill", "spill.run_write",
+                            consumer=self.consumer_name,
+                            batches=len(buffered), bytes=freed) as sp:
+                spill = self.mem.spill_manager.new_spill()
+                try:
+                    self._write_run(spill, buffered)
+                except BaseException:
+                    # a failed run write (IO error mid-frame) must not
+                    # leak the half-written spill file: the run was
+                    # claimed but never published, so nobody else will
+                    # ever release it
+                    spill.release()
+                    raise
+                # tier decision: DRAM while the host budget lasted,
+                # disk once it overflowed (spill.overflow_to_disk)
+                sp.set(tier="disk" if spill.disk_bytes else "dram")
+                with self._lock:
+                    self.spills.append(spill.finish())
         finally:
             with self._quiesced:
                 self._inflight_spills -= 1

@@ -88,17 +88,24 @@ class Spill:
         self.disk_bytes += len(frame) + _FRAME_HDR.size
 
     def _spill_to_disk(self) -> None:
-        fd, self._path = tempfile.mkstemp(
-            prefix=f"auron-spill-{self.spill_id}-", suffix=".atb",
-            dir=self._mgr.spill_dir)
-        self._file = os.fdopen(fd, "wb")
-        self._file.write(_SPILL_MAGIC + struct.pack("<B", self._algo))
-        self.disk_bytes += _HEADER_SIZE
-        for frame in self._mem_frames:
-            self._write_disk_frame(frame)
-        self._mem_frames.clear()
-        self._mgr.release_host(self.mem_bytes)
-        self.mem_bytes = 0
+        # tier decision: the DRAM budget ran out, this spill moves to
+        # the disk tier — a timeline-visible event
+        from auron_tpu.obs import trace
+        with trace.span("spill", "spill.overflow_to_disk",
+                        spill=self.spill_id,
+                        frames=len(self._mem_frames),
+                        bytes=self.mem_bytes):
+            fd, self._path = tempfile.mkstemp(
+                prefix=f"auron-spill-{self.spill_id}-", suffix=".atb",
+                dir=self._mgr.spill_dir)
+            self._file = os.fdopen(fd, "wb")
+            self._file.write(_SPILL_MAGIC + struct.pack("<B", self._algo))
+            self.disk_bytes += _HEADER_SIZE
+            for frame in self._mem_frames:
+                self._write_disk_frame(frame)
+            self._mem_frames.clear()
+            self._mgr.release_host(self.mem_bytes)
+            self.mem_bytes = 0
 
     def finish(self) -> "Spill":
         self._finished = True
@@ -156,13 +163,23 @@ class Spill:
     def frames(self) -> Iterator[bytes]:
         assert self._finished
         if self._path is not None:
-            f, algo = self._open_verified()
-            with f:
-                while True:
-                    frame = self._read_frame(f, algo)
-                    if frame is None:
-                        break
-                    yield frame
+            # production-segment timing only, zero per-frame overhead
+            # when the 'spill' category is off (obs/trace.stream_spanned
+            # explains the span-across-yield hazard)
+            from auron_tpu.obs import trace
+
+            def read_frames():
+                f, algo = self._open_verified()
+                with f:
+                    while True:
+                        frame = self._read_frame(f, algo)
+                        if frame is None:
+                            return
+                        yield frame
+
+            yield from trace.stream_spanned(
+                "spill", "spill.read", read_frames(),
+                spill=self.spill_id, tier="disk")
         else:
             yield from self._mem_frames
 

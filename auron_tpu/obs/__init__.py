@@ -1,0 +1,19 @@
+"""Observability plane: span tracing, mirrored metric tree, process
+metrics registry.
+
+The reference's observability layer is load-bearing (SURVEY.md L9):
+every native operator registers timers/counters in an
+ExecutionPlanMetricsSet, task end mirrors them into Spark's SQLMetrics
+tree by position (auron/src/metrics.rs, rt.rs:302-308), and pprof HTTP
+endpoints expose process profiles. This package is that layer for the
+TPU engine, split the same three ways:
+
+- :mod:`auron_tpu.obs.trace` — Dapper-style query→stage→task→operator→
+  event span timeline, recorded lock-free per thread and exported as
+  Chrome-trace JSON (Perfetto-loadable) or a JSONL event log;
+- :mod:`auron_tpu.obs.metric_tree` — the positional metric tree each
+  PhysicalOp node mirrors into at finalize (EXPLAIN ANALYZE);
+- :mod:`auron_tpu.obs.registry` — process-wide counters/gauges/
+  histograms with a Prometheus text exposition (the pprof-endpoint
+  analogue for scrapers).
+"""

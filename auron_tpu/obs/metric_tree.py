@@ -1,0 +1,129 @@
+"""Mirrored metric tree: per-plan-node metrics, EXPLAIN ANALYZE.
+
+The reference walks the native plan tree on task end and copies each
+operator's metric values onto the matching Spark SQLMetrics node *by
+position* (``update_metric_node``, auron/src/rt.rs:302-308) — the plan
+the user sees in the UI is annotated with what actually happened. Here
+the host plan IS the PhysicalOp tree, so the mirror is: build a
+``MetricNode`` tree positionally congruent with the plan
+(:func:`build_tree`), then after each finished task fold that task's
+per-op metric sets into the nodes (:func:`mirror` — ExecContext records
+a *per-instance* MetricsSet for every op that reported metrics, see
+ops/base.ExecContext.metrics_for). Values accumulate across tasks/
+partitions, exactly like SQLMetrics sum over Spark tasks.
+
+Canonical metric names follow the reference (NativeHelper.scala:170-238):
+``elapsed_compute`` (ns), ``output_rows``, ``output_batches``,
+``mem_spill_count``/``mem_spill_size``, ``shuffle_write_total_time``/
+``shuffle_read_total_time``, plus this engine's dispatch-decision
+counters (``dispatch_hashtable``, ``dispatch_sort``, ...).
+
+``render`` produces the EXPLAIN ANALYZE text
+(DataFrame.explain(analyze=True), tools/explain_report.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+#: display order: the canonical trio first, then everything sorted
+_CANONICAL = ("output_rows", "output_batches", "elapsed_compute")
+#: nanosecond counters rendered as milliseconds
+_NS_METRICS = ("elapsed_compute", "shuffle_write_total_time",
+               "shuffle_read_total_time")
+
+
+@dataclass
+class MetricNode:
+    """One plan node's mirrored metrics (positionally congruent with the
+    PhysicalOp tree it was built from)."""
+
+    name: str
+    op_repr: str
+    metrics: dict = field(default_factory=dict)
+    children: list = field(default_factory=list)
+
+    def add(self, values: dict) -> None:
+        for k, v in values.items():
+            self.metrics[k] = self.metrics.get(k, 0) + v
+
+    def walk(self):
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+
+def build_tree(op) -> MetricNode:
+    """A MetricNode tree positionally mirroring ``op``'s plan tree."""
+    return MetricNode(op.name, repr(op),
+                      children=[build_tree(c) for c in op.children])
+
+
+def mirror(node: MetricNode, op, ctx) -> None:
+    """Fold one finished task's per-op metric sets into the tree — the
+    positional walk of the reference's update_metric_node. ``node`` must
+    have been built from this exact ``op`` tree (same positions)."""
+    for ms in ctx.op_metric_sets(op):
+        node.add(ms.snapshot())
+    for child_node, child_op in zip(node.children, op.children):
+        mirror(child_node, child_op, ctx)
+
+
+def _fmt_value(name: str, v) -> str:
+    if name in _NS_METRICS:
+        return f"{v / 1e6:.1f}ms"
+    if name.endswith("_size") or name.endswith("_bytes"):
+        if v >= 1 << 20:
+            return f"{v / (1 << 20):.1f}MiB"
+        if v >= 1 << 10:
+            return f"{v / (1 << 10):.1f}KiB"
+    return str(v)
+
+
+def _annotation(metrics: dict) -> str:
+    if not metrics:
+        return ""
+    names = [n for n in _CANONICAL if n in metrics]
+    names += sorted(n for n in metrics if n not in _CANONICAL)
+    parts = [f"{n}={_fmt_value(n, metrics[n])}" for n in names]
+    return "  [" + ", ".join(parts) + "]"
+
+
+def render(node: MetricNode, indent: int = 0) -> str:
+    """EXPLAIN ANALYZE text: the plan tree annotated per node."""
+    s = "  " * indent + node.op_repr + _annotation(node.metrics) + "\n"
+    for c in node.children:
+        s += render(c, indent + 1)
+    return s
+
+
+def totals(node: MetricNode) -> dict:
+    """Aggregate view over the whole tree (report footers): summed
+    elapsed_compute/output_rows plus node count.
+
+    ``elapsed_compute_ms`` is a sum of PER-NODE values, and pass-through
+    nodes (limits, exchange reads, scans feeding a pipeline) time their
+    producer's ``next()`` INCLUSIVELY (ops/base.count_output
+    ``timed=True``) — so the sum exceeds wall time whenever such nodes
+    stack; treat it as attribution weight, not a wall-clock figure."""
+    elapsed = rows = nodes = 0
+    for n in node.walk():
+        nodes += 1
+        elapsed += n.metrics.get("elapsed_compute", 0)
+        rows += n.metrics.get("output_rows", 0)
+    return {"nodes": nodes, "elapsed_compute_ms": round(elapsed / 1e6, 3),
+            "output_rows": rows}
+
+
+def explain_analyze(plan, num_partitions: int = 1, mem_manager=None,
+                    config=None) -> tuple[MetricNode, "object"]:
+    """Run every partition of ``plan`` with a mirrored metric tree and
+    return (tree, collected pyarrow table) — the engine of
+    DataFrame.explain(analyze=True) and tools/explain_report.py."""
+    from auron_tpu.runtime.executor import collect
+    tree = build_tree(plan)
+    table = collect(plan, num_partitions=num_partitions,
+                    mem_manager=mem_manager, config=config,
+                    metric_tree=tree)
+    return tree, table

@@ -1,0 +1,317 @@
+"""Process-wide metrics registry: counters, gauges, fixed-bucket
+histograms, Prometheus text exposition.
+
+Per-task metrics (ops/base.MetricsSet) die with their ExecContext; this
+registry is the process-lifetime aggregate the scrape surface reads —
+the role the reference's pprof/metrics HTTP endpoints play
+(auron/src/http/mod.rs:25-108). The executor feeds it one observation
+per finished task (gated by ``auron.metrics.registry``): task seconds,
+retries, recovery counters, spill volume. ``render_prometheus`` emits
+the standard text format and additionally collects live totals from the
+runtime singletons (program-cache builds/hits per site, backend
+compiles, injected faults, watchdog fallbacks) so a scrape needs no
+separate wiring per subsystem.
+
+Histograms are fixed-bucket (Prometheus-shaped: cumulative ``le``
+buckets + ``_sum``/``_count``) with p50/p95/p99 estimation by linear
+interpolation inside the bucket — exact enough for dashboards, O(1)
+memory, no reservoir.
+
+The exposition is trace_salt-aware: ``auron_info`` carries the current
+``config.trace_salt()`` so a scraper can correlate metric shifts with
+trace-semantic config flips (the same salt that partitions every
+program-cache key, runtime/programs.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+#: default latency buckets (seconds): 1ms .. 2min, roughly log-spaced
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotonic counter."""
+
+    def __init__(self, name: str, labels: tuple):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self.value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self.value += v
+
+    def expose(self) -> list[str]:
+        return [f"{self.name}{_fmt_labels(self.labels)} {self.value:g}"]
+
+
+class Gauge:
+    """Last-write-wins value."""
+
+    def __init__(self, name: str, labels: tuple):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self.value += v
+
+    def expose(self) -> list[str]:
+        return [f"{self.name}{_fmt_labels(self.labels)} {self.value:g}"]
+
+
+class Histogram:
+    """Fixed cumulative-bucket histogram with percentile estimation."""
+
+    def __init__(self, name: str, labels: tuple,
+                 buckets: Optional[tuple] = None):
+        self.name = name
+        self.labels = labels
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self._lock = threading.Lock()
+        #: per-bucket NON-cumulative counts; [-1] is the +Inf overflow
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.sum += v
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    def percentile(self, p: float) -> float:
+        """Estimate the p-quantile (p in [0, 1]) by linear interpolation
+        inside the bucket holding the target rank; the overflow bucket
+        answers with the largest finite bound (a floor, honestly)."""
+        with self._lock:
+            total = self.count
+            if total == 0:
+                return 0.0
+            rank = p * total
+            cum = 0.0
+            lo = 0.0
+            for i, b in enumerate(self.buckets):
+                c = self.counts[i]
+                if cum + c >= rank and c > 0:
+                    frac = (rank - cum) / c
+                    return lo + (b - lo) * min(max(frac, 0.0), 1.0)
+                cum += c
+                lo = b
+            return self.buckets[-1]
+
+    def expose(self) -> list[str]:
+        base = dict(self.labels)
+        out = []
+        cum = 0
+        with self._lock:
+            for i, b in enumerate(self.buckets):
+                cum += self.counts[i]
+                lab = _label_key(dict(base, le=f"{b:g}"))
+                out.append(f"{self.name}_bucket{_fmt_labels(lab)} {cum}")
+            cum += self.counts[-1]
+            lab = _label_key(dict(base, le="+Inf"))
+            out.append(f"{self.name}_bucket{_fmt_labels(lab)} {cum}")
+            out.append(f"{self.name}_sum{_fmt_labels(self.labels)} "
+                       f"{self.sum:g}")
+            out.append(f"{self.name}_count{_fmt_labels(self.labels)} "
+                       f"{self.count}")
+        return out
+
+
+class MetricsRegistry:
+    """Name+labels → instrument store; one per process (get_registry)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: dict[tuple, object] = {}
+        self._types: dict[str, str] = {}
+
+    def _get(self, cls, typ: str, name: str, labels: dict, **kw):
+        key = (name, _label_key(labels))
+        with self._lock:
+            prev = self._types.setdefault(name, typ)
+            if prev != typ:
+                raise TypeError(
+                    f"metric {name!r} already registered as {prev}")
+            inst = self._instruments.get(key)
+            if inst is None:
+                inst = cls(name, _label_key(labels), **kw)
+                self._instruments[key] = inst
+            return inst
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, "counter", name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, "gauge", name, labels)
+
+    def histogram(self, name: str, buckets: Optional[tuple] = None,
+                  **labels) -> Histogram:
+        return self._get(Histogram, "histogram", name, labels,
+                         buckets=buckets)
+
+    def snapshot(self) -> dict:
+        """{name{labels}: value | {sum, count, p50, p95, p99}}."""
+        with self._lock:
+            items = list(self._instruments.items())
+        out = {}
+        for (name, labels), inst in items:
+            key = f"{name}{_fmt_labels(labels)}"
+            if isinstance(inst, Histogram):
+                out[key] = {"sum": inst.sum, "count": inst.count,
+                            "p50": inst.percentile(0.50),
+                            "p95": inst.percentile(0.95),
+                            "p99": inst.percentile(0.99)}
+            else:
+                out[key] = inst.value
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition: registered instruments plus live
+        totals collected from the runtime singletons."""
+        with self._lock:
+            items = sorted(self._instruments.items(),
+                           key=lambda kv: kv[0])
+            types = dict(self._types)
+        lines = []
+        seen_type = set()
+        for (name, _labels), inst in items:
+            if name not in seen_type:
+                lines.append(f"# TYPE {name} {types[name]}")
+                seen_type.add(name)
+            lines.extend(inst.expose())
+        lines.extend(_collect_runtime())
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+            self._types.clear()
+
+
+def _collect_runtime() -> list[str]:
+    """Live totals from the runtime singletons — collected at scrape
+    time so subsystems need no push wiring. Best-effort: a missing
+    module never fails the exposition."""
+    lines = []
+    try:
+        from auron_tpu import config as cfg
+        salt = ",".join(str(v) for v in cfg.trace_salt())
+        lines.append("# TYPE auron_info gauge")
+        lines.append(f'auron_info{{trace_salt="{salt}"}} 1')
+    except Exception:
+        pass
+    try:
+        from auron_tpu.runtime import programs
+        lines.append("# TYPE auron_program_builds_total counter")
+        lines.append("# TYPE auron_program_hits_total counter")
+        lines.append("# TYPE auron_program_live gauge")
+        for site, st in sorted(programs.snapshot().items()):
+            lab = f'{{site="{site}"}}'
+            lines.append(f"auron_program_builds_total{lab} {st['builds']}")
+            lines.append(f"auron_program_hits_total{lab} {st['hits']}")
+            lines.append(f"auron_program_live{lab} {st['live']}")
+    except Exception:
+        pass
+    try:
+        from auron_tpu.utils import compile_stats
+        snap = compile_stats.snapshot()
+        lines.append("# TYPE auron_backend_compiles_total counter")
+        lines.append(f"auron_backend_compiles_total {snap.count}")
+        lines.append("# TYPE auron_backend_compile_seconds_total counter")
+        lines.append(f"auron_backend_compile_seconds_total "
+                     f"{snap.seconds:g}")
+    except Exception:
+        pass
+    try:
+        from auron_tpu.runtime import faults
+        lines.append("# TYPE auron_faults_injected_total counter")
+        lines.append(f"auron_faults_injected_total {faults.totals()}")
+    except Exception:
+        pass
+    try:
+        from auron_tpu.runtime import watchdog
+        lines.append("# TYPE auron_watchdog_fallbacks_total counter")
+        lines.append(f"auron_watchdog_fallbacks_total {watchdog.totals()}")
+    except Exception:
+        pass
+    try:
+        from auron_tpu.obs import trace
+        lines.append("# TYPE auron_trace_dropped_spans counter")
+        lines.append(f"auron_trace_dropped_spans {trace.tracer().dropped}")
+    except Exception:
+        pass
+    return lines
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+#: (config epoch, enabled) verdict cache — per-task feeding checks this
+_CACHED: tuple[int, Optional[bool]] = (-1, None)
+
+
+def enabled() -> bool:
+    global _CACHED
+    from auron_tpu import config as cfg
+    epoch, val = _CACHED
+    if epoch == cfg.config_epoch() and val is not None:
+        return val
+    epoch = cfg.config_epoch()
+    val = cfg.get_config().get(cfg.METRICS_REGISTRY)
+    _CACHED = (epoch, val)
+    return val
+
+
+def observe_task(wall_s: float, snap: dict, output_rows: int = 0) -> None:
+    """One finished task's observation: called by the retry driver with
+    the task's metrics snapshot (gated by auron.metrics.registry)."""
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.counter("auron_tasks_total").inc()
+    r.histogram("auron_task_seconds").observe(wall_s)
+    rec = snap.get("recovery") or {}
+    r.counter("auron_task_retries_total").inc(
+        rec.get("transient_retries", 0))
+    r.counter("auron_corruption_recomputes_total").inc(
+        rec.get("corruption_recomputes", 0))
+    spill_count = spill_bytes = 0
+    for vals in snap.values():
+        if isinstance(vals, dict):
+            spill_count += vals.get("mem_spill_count", 0)
+            spill_bytes += vals.get("mem_spill_size", 0)
+    r.counter("auron_spill_runs_total").inc(spill_count)
+    r.counter("auron_spill_bytes_total").inc(spill_bytes)
+    r.counter("auron_output_rows_total").inc(output_rows)

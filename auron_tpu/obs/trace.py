@@ -1,0 +1,516 @@
+"""Span plane: the engine's query→stage→task→operator→event timeline.
+
+Dapper-shaped tracing for the runtime (PAPERS.md distributed-tracing
+line): every recovery- or latency-relevant boundary opens a *span*
+(named, categorized, attributed, nested via a per-thread stack) or drops
+a zero-duration *event*. What the reference gets from pprof HTTP
+endpoints plus log archaeology — "what happened when" across retries,
+shuffle fetches, spills, compiles and watchdog decisions — is here one
+timeline, exportable two ways:
+
+- Chrome-trace JSON (``export_chrome``): the ``{"traceEvents": [...]}``
+  format Perfetto / chrome://tracing load directly;
+- JSONL (``export_jsonl``): one span per line for programmatic
+  consumption (``tools/trace_report.py``).
+
+Recording contract (the <2% overhead budget, PERF.md):
+
+- **disabled hot path**: one cached config-epoch compare (the
+  fault-plane pattern, runtime/faults.py) — no lock, no dict lookup;
+- **enabled recording is lock-free**: each thread appends to its own
+  buffer (registered once under the tracer lock); merge happens only at
+  export/snapshot time. The ``auron.trace.max_spans`` cap is enforced
+  with the same lock-freedom, so it is approximate by design.
+
+Span identity is stable and deterministic per process: monotonic
+counters assign trace ids (one per top-level query scope) and span ids
+(global), never wall-clock or randomness, so two runs of the same
+single-threaded pipeline number their spans identically.
+
+Config surface: ``auron.trace.{enabled,dir,events,max_spans}``
+(config.py). The knobs are deliberately NOT trace-semantic in the
+program-cache sense (config.TRACE_SEMANTIC_KEYS): flipping tracing must
+never retrace a kernel.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import NamedTuple, Optional
+
+#: span categories (the auron.trace.events allowlist vocabulary)
+CATEGORIES = ("query", "task", "program", "shuffle", "spill", "fault",
+              "watchdog")
+
+_SPAN_IDS = itertools.count(1)     # next() is GIL-atomic
+_TRACE_IDS = itertools.count(1)
+
+
+class _Settings(NamedTuple):
+    enabled: bool
+    dir: str
+    events: Optional[frozenset]    # None = every category
+    max_spans: int
+
+
+#: (config epoch, settings) — the disabled check must cost one int
+#: compare (same verdict-cache shape as runtime/faults._CACHED)
+_CACHED: tuple[int, Optional[_Settings]] = (-1, None)
+
+
+def _settings() -> _Settings:
+    global _CACHED
+    from auron_tpu import config as cfg
+    epoch, st = _CACHED
+    if epoch == cfg.config_epoch() and st is not None:
+        return st
+    # read the epoch BEFORE the values: a concurrent set() bumps it
+    # after we read, so a stale cache entry misses on the next call
+    epoch = cfg.config_epoch()
+    conf = cfg.get_config()
+    ev = conf.get(cfg.TRACE_EVENTS)
+    cats = frozenset(c.strip() for c in ev.split(",") if c.strip())
+    st = _Settings(
+        enabled=conf.get(cfg.TRACE_ENABLED),
+        dir=conf.get(cfg.TRACE_DIR),
+        events=cats or None,
+        max_spans=conf.get(cfg.TRACE_MAX_SPANS),
+    )
+    _CACHED = (epoch, st)
+    return st
+
+
+class Span:
+    """One finished span (events are zero-duration spans)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "cat", "name",
+                 "ts_ns", "dur_ns", "tid", "attrs")
+
+    def __init__(self, trace_id, span_id, parent_id, cat, name, ts_ns,
+                 dur_ns, tid, attrs):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.cat = cat
+        self.name = name
+        self.ts_ns = ts_ns
+        self.dur_ns = dur_ns
+        self.tid = tid
+        self.attrs = attrs
+
+    def to_dict(self) -> dict:
+        return {"trace": self.trace_id, "span": self.span_id,
+                "parent": self.parent_id, "cat": self.cat,
+                "name": self.name, "ts_us": self.ts_ns / 1000.0,
+                "dur_us": self.dur_ns / 1000.0, "tid": self.tid,
+                "attrs": self.attrs}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Span":
+        return cls(d["trace"], d["span"], d["parent"], d["cat"],
+                   d["name"], round(d["ts_us"] * 1000.0),
+                   round(d["dur_us"] * 1000.0), d["tid"],
+                   d.get("attrs") or {})
+
+
+class Tracer:
+    """Process tracer: per-thread lock-free buffers, merged on demand."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buffers: list[list[Span]] = []
+        self._tls = threading.local()
+        #: approximate buffered-span count (lock-free increments)
+        self._count = 0
+        self.dropped = 0
+        #: wall-clock epoch of the monotonic ts origin (JSONL metadata)
+        self.epoch_wall = time.time()
+        self._t0 = time.perf_counter_ns()
+
+    # -- recording (per-thread, lock-free) ----------------------------------
+
+    def _buf(self) -> list:
+        buf = getattr(self._tls, "buf", None)
+        if buf is None:
+            buf = []
+            with self._lock:
+                self._buffers.append(buf)
+            self._tls.buf = buf
+        return buf
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+    @property
+    def current_trace(self) -> int:
+        return getattr(self._tls, "trace", 0)
+
+    def set_trace(self, trace_id: int) -> None:
+        self._tls.trace = trace_id
+
+    def now_ns(self) -> int:
+        return time.perf_counter_ns() - self._t0
+
+    def record(self, span: Span, max_spans: int) -> None:
+        if self._count >= max_spans:
+            self.dropped += 1
+            return
+        self._buf().append(span)
+        self._count += 1
+
+    # -- merge / export ------------------------------------------------------
+
+    def spans(self, trace_id: Optional[int] = None) -> list[Span]:
+        """Merged snapshot of every thread's buffer, timeline-ordered."""
+        with self._lock:
+            buffers = list(self._buffers)
+        out: list[Span] = []
+        for buf in buffers:
+            out.extend(buf[:len(buf)])   # len() pins a consistent prefix
+        if trace_id is not None:
+            out = [s for s in out if s.trace_id == trace_id]
+        out.sort(key=lambda s: (s.ts_ns, s.span_id))
+        return out
+
+    def drop(self, trace_id: int) -> None:
+        """Forget one trace's spans (post-export memory bound)."""
+        with self._lock:
+            buffers = list(self._buffers)
+        for buf in buffers:
+            n = len(buf)   # pin: the owning thread may append concurrently
+            kept = [s for s in buf[:n] if s.trace_id != trace_id]
+            if len(kept) != n:
+                buf[:n] = kept
+                self._count -= n - len(kept)
+
+    def reset(self) -> None:
+        with self._lock:
+            for buf in self._buffers:
+                del buf[:]
+            self._count = 0
+            self.dropped = 0
+
+
+_TRACER = Tracer()
+
+
+def tracer() -> Tracer:
+    return _TRACER
+
+
+def enabled() -> bool:
+    return _settings().enabled
+
+
+def category_enabled(cat: str) -> bool:
+    """True when spans of ``cat`` would actually record — tracing on
+    AND the category not excluded by auron.trace.events. Hot paths that
+    pay per-item clock reads purely to feed a span should gate on this,
+    not on :func:`enabled` alone."""
+    st = _settings()
+    return st.enabled and (st.events is None or cat in st.events)
+
+
+def reset() -> None:
+    """Drop every buffered span (tests, chaos-run isolation)."""
+    _TRACER.reset()
+
+
+# ---------------------------------------------------------------------------
+# recording API
+# ---------------------------------------------------------------------------
+
+class _Noop:
+    """Disabled-path span: a shared, attribute-tolerant no-op."""
+
+    __slots__ = ()
+    span_id = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NOOP = _Noop()
+
+
+class _SpanCM:
+    __slots__ = ("cat", "name", "attrs", "span_id", "_parent", "_t0",
+                 "_max")
+
+    def __init__(self, cat, name, attrs, max_spans):
+        self.cat = cat
+        self.name = name
+        self.attrs = attrs
+        self._max = max_spans
+
+    def set(self, **attrs):
+        """Attach attributes discovered mid-span (bytes read, rows...)."""
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        tr = _TRACER
+        stack = tr._stack()
+        self._parent = stack[-1] if stack else 0
+        self.span_id = next(_SPAN_IDS)
+        stack.append(self.span_id)
+        self._t0 = tr.now_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        tr = _TRACER
+        stack = tr._stack()
+        # pop by identity, not position: spans held open across
+        # generator yields (shuffle.fetch, spill.read wrap streams) can
+        # exit out of LIFO order when a consumer interleaves two
+        # streams — a positional pop would strand the dead id on the
+        # stack forever, misparenting every later span on the thread
+        if stack and stack[-1] == self.span_id:
+            stack.pop()
+        else:
+            try:
+                stack.remove(self.span_id)
+            except ValueError:
+                pass
+        if exc_type is not None:
+            self.attrs.setdefault("error", exc_type.__name__)
+        t0 = self._t0
+        tr.record(Span(tr.current_trace, self.span_id, self._parent,
+                       self.cat, self.name, t0, tr.now_ns() - t0,
+                       threading.get_ident(), self.attrs), self._max)
+        return False
+
+
+def span(cat: str, name: str, **attrs):
+    """Open a span (context manager). Disabled / filtered categories
+    return a shared no-op whose cost is the settings check."""
+    st = _settings()
+    if not st.enabled or (st.events is not None and cat not in st.events):
+        return _NOOP
+    return _SpanCM(cat, name, attrs, st.max_spans)
+
+
+def event(cat: str, name: str, **attrs) -> None:
+    """Record a zero-duration span at the current stack position."""
+    st = _settings()
+    if not st.enabled or (st.events is not None and cat not in st.events):
+        return
+    tr = _TRACER
+    stack = tr._stack()
+    tr.record(Span(tr.current_trace, next(_SPAN_IDS),
+                   stack[-1] if stack else 0, cat, name, tr.now_ns(), 0,
+                   threading.get_ident(), attrs), st.max_spans)
+
+
+def complete_span(cat: str, name: str, start_ns: int, dur_ns: int,
+                  **attrs) -> None:
+    """Record an already-finished span with explicit timing — for work
+    accumulated across a GENERATOR's production segments (shuffle reads,
+    spill reads). Holding a ``span()`` context open across yields would
+    (a) time the consumer's compute while the generator is suspended and
+    (b) keep the span on the per-thread stack so every consumer-side
+    span misparents under it; measuring each ``next()`` segment and
+    recording once at exhaustion reports only the producer's own cost.
+    Parent is the CURRENT stack top (the consumer driving the
+    generator), never the span itself."""
+    st = _settings()
+    if not st.enabled or (st.events is not None and cat not in st.events):
+        return
+    tr = _TRACER
+    stack = tr._stack()
+    tr.record(Span(tr.current_trace, next(_SPAN_IDS),
+                   stack[-1] if stack else 0, cat, name, start_ns,
+                   dur_ns, threading.get_ident(), attrs), st.max_spans)
+
+
+def stream_spanned(cat: str, name: str, it, time_counter=None, **attrs):
+    """Yield ``it``'s items, timing ONLY the production segments (each
+    ``next()``), and record ONE completed span at exhaustion or
+    abandonment (:func:`complete_span` explains why a span must never
+    stay open across yields). ``time_counter`` — an ops.base Metric —
+    additionally accrues the produced nanoseconds even when tracing is
+    off, for host metrics (``shuffle_read_total_time``) that ride the
+    same clock. With the category off/filtered and no counter, this
+    degrades to plain iteration: zero per-item overhead."""
+    record = category_enabled(cat)
+    if not record and time_counter is None:
+        yield from it
+        return
+    tr = _TRACER
+    it = iter(it)
+    start = tr.now_ns()
+    produced_ns = 0
+    n = 0
+    try:
+        while True:
+            t0 = tr.now_ns()
+            try:
+                item = next(it)
+            except StopIteration:
+                produced_ns += tr.now_ns() - t0
+                break
+            produced_ns += tr.now_ns() - t0
+            n += 1
+            yield item
+    finally:
+        if time_counter is not None:
+            time_counter.add(produced_ns)
+        if record:
+            complete_span(cat, name, start, produced_ns, items=n,
+                          **attrs)
+
+
+class _QueryScope:
+    """Top-level query scope: assigns the trace id, opens the root
+    ``query.execute`` span, and exports/drops the trace when the
+    OUTERMOST scope exits (nested Session.execute calls — host-fn
+    children, scalar subqueries — join the enclosing trace)."""
+
+    __slots__ = ("trace_id", "_span", "_outermost", "_entered",
+                 "_label")
+
+    def __init__(self, label: str):
+        self._label = label
+        self.trace_id = 0
+        self._span = _NOOP
+        self._outermost = False
+        self._entered = False
+
+    def __enter__(self):
+        st = _settings()
+        if not st.enabled:
+            return self
+        self._entered = True
+        tr = _TRACER
+        depth = getattr(tr._tls, "query_depth", 0)
+        tr._tls.query_depth = depth + 1
+        if depth == 0:
+            self.trace_id = next(_TRACE_IDS)
+            tr.set_trace(self.trace_id)
+            self._outermost = True
+        else:
+            self.trace_id = tr.current_trace
+        # the span itself may be a no-op (the 'query' category can be
+        # filtered by auron.trace.events) — scope bookkeeping must not
+        # depend on it, or depth would leak and the trace never export
+        self._span = span("query", "query.execute", label=self._label)
+        self._span.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._span.__exit__(exc_type, exc, tb)
+        if not self._entered:
+            return False
+        tr = _TRACER
+        tr._tls.query_depth = max(getattr(tr._tls, "query_depth", 1) - 1,
+                                  0)
+        if self._outermost:
+            # leave no stale trace id on the thread: spans recorded
+            # BETWEEN queries (session init, watchdog probes) must not
+            # tag themselves onto an already-exported trace
+            tr.set_trace(0)
+            st = _settings()
+            if st.dir:
+                # best-effort like every observability sink: an
+                # unwritable trace dir must never discard the query
+                # result computed inside the scope (or shadow the
+                # query's own exception)
+                try:
+                    export_trace_dir(st.dir, self.trace_id)
+                except Exception:
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "trace export to %r failed", st.dir)
+                finally:
+                    tr.drop(self.trace_id)
+        return False
+
+
+def query_scope(label: str = "") -> _QueryScope:
+    return _QueryScope(label)
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def chrome_trace(spans: list[Span]) -> dict:
+    """Chrome-trace JSON object (Perfetto / chrome://tracing loadable):
+    complete ('ph': 'X') events with microsecond ts/dur."""
+    pid = os.getpid()
+    events = []
+    for s in spans:
+        events.append({
+            "name": s.name, "cat": s.cat, "ph": "X",
+            "ts": s.ts_ns / 1000.0, "dur": s.dur_ns / 1000.0,
+            "pid": pid, "tid": s.tid,
+            "args": dict(s.attrs, trace=s.trace_id, span=s.span_id,
+                         parent=s.parent_id),
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"dropped_spans": _TRACER.dropped,
+                          "epoch_wall": _TRACER.epoch_wall}}
+
+
+def export_chrome(path: str, trace_id: Optional[int] = None,
+                  spans: Optional[list] = None) -> int:
+    """Write a Chrome-trace JSON file; returns the span count.
+    ``spans`` skips the merge for callers that already snapshotted."""
+    if spans is None:
+        spans = _TRACER.spans(trace_id)
+    tmp = path + ".part"
+    with open(tmp, "w") as f:
+        json.dump(chrome_trace(spans), f)
+    os.replace(tmp, path)
+    return len(spans)
+
+
+def export_jsonl(path: str, trace_id: Optional[int] = None,
+                 spans: Optional[list] = None) -> int:
+    """Write the JSONL event log (one span per line, timeline order);
+    returns the span count. ``spans`` as in :func:`export_chrome`."""
+    if spans is None:
+        spans = _TRACER.spans(trace_id)
+    tmp = path + ".part"
+    with open(tmp, "w") as f:
+        for s in spans:
+            f.write(json.dumps(s.to_dict()) + "\n")
+    os.replace(tmp, path)
+    return len(spans)
+
+
+def read_jsonl(path: str) -> list[Span]:
+    """Load a JSONL event log back into Span records (trace_report)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(Span.from_dict(json.loads(line)))
+    return out
+
+
+def export_trace_dir(trace_dir: str, trace_id: int) -> tuple[str, str]:
+    """Per-query export into ``auron.trace.dir``: Chrome trace + JSONL,
+    named by trace id. Returns the two paths."""
+    os.makedirs(trace_dir, exist_ok=True)
+    chrome = os.path.join(trace_dir, f"trace_{trace_id:08d}.json")
+    jsonl = os.path.join(trace_dir, f"trace_{trace_id:08d}.jsonl")
+    spans = _TRACER.spans(trace_id)   # one merge+sort for both files
+    export_chrome(chrome, spans=spans)
+    export_jsonl(jsonl, spans=spans)
+    return chrome, jsonl

@@ -2049,7 +2049,7 @@ class AggOp(PhysicalOp):
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         from auron_tpu import config as cfg
         from auron_tpu.kernels import dispatch as kdispatch
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         decision = self._dense_dispatch(ctx)
         if decision is not None:
             # the chosen backend lands in THIS operator's finalize

@@ -22,27 +22,42 @@ from auron_tpu.columnar.schema import Schema
 
 
 class Metric:
-    __slots__ = ("value",)
+    __slots__ = ("value", "_mirror")
 
-    def __init__(self):
+    def __init__(self, mirror: "Optional[Metric]" = None):
         self.value = 0
+        self._mirror = mirror
 
     def add(self, v):
         self.value += v
+        m = self._mirror
+        if m is not None:
+            m.value += v
 
 
 class MetricsSet:
     """Per-operator metrics, mirrored into the host tree on finalize —
     canonical names follow the reference (NativeHelper.scala:170-238):
-    output_rows, output_batches, elapsed_compute, mem_spill_count, ..."""
+    output_rows, output_batches, elapsed_compute, mem_spill_count, ...
 
-    def __init__(self):
+    A set may carry a ``mirror``: every counter then chains its adds
+    into the same-named counter of the mirror set. That is how per-op
+    POSITIONAL sets (ExecContext.metrics_for(op) — the metric-tree /
+    EXPLAIN ANALYZE source, obs/metric_tree.py) stay consistent with
+    the legacy name-keyed aggregate (``ctx.metrics[op.name]``) without
+    double bookkeeping at call sites."""
+
+    def __init__(self, mirror: "Optional[MetricsSet]" = None):
         self._metrics: dict[str, Metric] = {}
+        self._mirror = mirror
 
     def counter(self, name: str) -> Metric:
-        if name not in self._metrics:
-            self._metrics[name] = Metric()
-        return self._metrics[name]
+        m = self._metrics.get(name)
+        if m is None:
+            chained = (self._mirror.counter(name)
+                       if self._mirror is not None else None)
+            m = self._metrics[name] = Metric(chained)
+        return m
 
     def snapshot(self) -> dict[str, int]:
         return {k: m.value for k, m in self._metrics.items()}
@@ -131,6 +146,11 @@ class ExecContext:
     cancel_event: object = field(default_factory=lambda: _new_event())
     # typed config (auron_tpu.config); None = process-wide defaults
     config: Optional[object] = None
+    #: per-op-INSTANCE metric sets keyed (id(op), suffix) — the
+    #: positional source the metric tree mirrors from
+    #: (obs/metric_tree.mirror); shared with child contexts like
+    #: ``metrics`` so map-side work attributes to the same plan nodes
+    op_metrics: dict = field(default_factory=dict)
 
     def child(self, **overrides) -> "ExecContext":
         """Derived context for a sub-execution (the map side of an
@@ -142,7 +162,8 @@ class ExecContext:
             stage_id=self.stage_id, partition_id=self.partition_id,
             task_id=self.task_id, num_partitions=self.num_partitions,
             metrics=self.metrics, mem_manager=self.mem_manager,
-            cancel_event=self.cancel_event, config=self.config)
+            cancel_event=self.cancel_event, config=self.config,
+            op_metrics=self.op_metrics)
         base.update(overrides)
         return ExecContext(**base)
 
@@ -182,10 +203,39 @@ class ExecContext:
             self._device_sync = cached
         return cached
 
-    def metrics_for(self, op_name: str) -> MetricsSet:
-        if op_name not in self.metrics:
-            self.metrics[op_name] = MetricsSet()
-        return self.metrics[op_name]
+    def metrics_for(self, op, suffix: str = "") -> MetricsSet:
+        """The metric set for ``op``.
+
+        Passing a *string* returns the legacy name-keyed set (shared by
+        every same-named op — plan-wide categories like "kernels" and
+        "recovery" live here). Passing the *PhysicalOp instance* returns
+        a per-instance set whose counters chain into the name-keyed one,
+        giving the metric tree positional attribution
+        (obs/metric_tree.py) while every existing name-keyed consumer
+        keeps seeing the aggregate."""
+        if isinstance(op, str):
+            name = op + suffix
+            if name not in self.metrics:
+                self.metrics[name] = MetricsSet()
+            return self.metrics[name]
+        key = (id(op), suffix)
+        entry = self.op_metrics.get(key)
+        if entry is None:
+            # the cache value PINS the op: id() keys are only unique
+            # while the object lives, and a gc'd subquery plan's id can
+            # be recycled by a later op in the same task
+            entry = (op, MetricsSet(
+                mirror=self.metrics_for(op.name + suffix)))
+            self.op_metrics[key] = entry
+        return entry[1]
+
+    def op_metric_sets(self, op) -> list[MetricsSet]:
+        """Every per-instance metric set ``op`` recorded under this
+        context (all suffixes — an exchange records both its write side
+        and its "_read" side)."""
+        oid = id(op)
+        return [entry[1] for (i, _s), entry in self.op_metrics.items()
+                if i == oid]
 
     def metrics_snapshot(self) -> dict[str, dict[str, int]]:
         return {k: v.snapshot() for k, v in self.metrics.items()}
@@ -274,11 +324,33 @@ def yields_owned_batches(op: PhysicalOp) -> bool:
     return bool(owned)
 
 
-def count_output(stream, metrics: MetricsSet):
-    """Wrap a batch stream with output_rows/output_batches counting."""
+def count_output(stream, metrics: MetricsSet, timed: bool = False):
+    """Wrap a batch stream with output_rows/output_batches counting.
+
+    ``timed=True`` additionally accrues the time spent INSIDE the
+    producer's ``next()`` into ``elapsed_compute`` — the inclusive
+    host-side elapsed for operators that run no device kernels of their
+    own (scans, limits, exchange reads) so EXPLAIN ANALYZE shows a
+    nonzero elapsed on every plan node. Operators that time their
+    kernels explicitly must NOT pass it (they would double-count)."""
     rows = metrics.counter("output_rows")
     batches = metrics.counter("output_batches")
-    for b in stream:
+    if not timed:
+        for b in stream:
+            rows.add(int(b.num_rows))
+            batches.add(1)
+            yield b
+        return
+    elapsed = metrics.counter("elapsed_compute")
+    it = iter(stream)
+    while True:
+        t0 = time.perf_counter_ns()
+        try:
+            b = next(it)
+        except StopIteration:
+            elapsed.add(time.perf_counter_ns() - t0)
+            return
+        elapsed.add(time.perf_counter_ns() - t0)
         rows.add(int(b.num_rows))
         batches.add(1)
         yield b

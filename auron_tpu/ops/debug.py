@@ -30,7 +30,7 @@ class DebugOp(PhysicalOp):
         return self.child.schema()
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         schema = self.child.schema()
 
         def stream():
@@ -48,7 +48,7 @@ class DebugOp(PhysicalOp):
                                 partition, i, n, batch.capacity, preview)
                 yield batch
 
-        return count_output(stream(), metrics)
+        return count_output(stream(), metrics, timed=True)
 
     def __repr__(self):
         return f"DebugOp[{self.label}]"

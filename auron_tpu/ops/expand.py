@@ -73,7 +73,7 @@ class ExpandOp(PhysicalOp):
                               apply=apply, fanout=len(projections))
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         elapsed = metrics.counter("elapsed_compute")
         in_schema = self.child.schema()
 

@@ -165,7 +165,7 @@ class FusedStageOp(PhysicalOp):
         return any(isinstance(m, LimitOp) for m in self.members)
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         elapsed = metrics.counter("elapsed_compute")
         kmetrics = ctx.metrics_for("kernels")
         built_c = kmetrics.counter("fused_stage_programs_built")

@@ -188,7 +188,7 @@ class GenerateOp(PhysicalOp):
     # -- execute ------------------------------------------------------------
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         elapsed = metrics.counter("elapsed_compute")
         in_schema = self.child.schema()
 

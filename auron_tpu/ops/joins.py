@@ -259,7 +259,7 @@ class HashJoinOp(PhysicalOp):
         return self._schema
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         elapsed = metrics.counter("elapsed_compute")
         build_time = metrics.counter("build_hash_map_time")
         probe_schema = self.probe.schema()
@@ -365,6 +365,16 @@ class HashJoinOp(PhysicalOp):
         kmetrics = ctx.metrics_for("kernels")
         built_c = kmetrics.counter("fused_probe_programs_built")
         hit_c = kmetrics.counter("fused_probe_program_hits")
+        # the folded chain still OWNS its plan node: the probe program
+        # runs the member fragments and returns the transformed batch,
+        # so the FusedStageOp node gets its real output rows and the
+        # program's time (the whole-stage attribution — without this,
+        # EXPLAIN ANALYZE would show the elided node as dead)
+        fmetrics = ctx.metrics_for(self.probe)
+        f_elapsed = fmetrics.counter("elapsed_compute")
+        f_rows = fmetrics.counter("output_rows")
+        f_batches = fmetrics.counter("output_batches")
+        fmetrics.counter("probe_search_folded").add(1)
         in_schema = input_op.schema()
         _sync = ctx.device_sync
         carries = jnp.asarray([f.init_carry for f in fragments], jnp.int64)
@@ -375,10 +385,12 @@ class HashJoinOp(PhysicalOp):
                 raw.capacity, side.capacity, fragments,
                 side.index_kind, side.rounds)
             (built_c if built else hit_c).add(1)
-            with timer(elapsed, sync=_sync) as t:
+            with timer(f_elapsed, sync=_sync) as t:
                 probe, lo, counts, total, carries = t.track(
                     kern(raw, jnp.int32(partition), carries,
                          *side.index_args()))
+            f_rows.add(int(probe.num_rows))
+            f_batches.add(1)
             yield from self._probe_one(probe, side, probe_schema,
                                        build_schema, elapsed, _sync,
                                        pre=(lo, counts, total))

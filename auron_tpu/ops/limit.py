@@ -49,7 +49,7 @@ class LimitOp(PhysicalOp):
                               init_carry=self.limit, is_limit=True)
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
 
         def stream():
             remaining = self.limit
@@ -66,7 +66,7 @@ class LimitOp(PhysicalOp):
                     remaining = 0
                     break
 
-        return count_output(stream(), metrics)
+        return count_output(stream(), metrics, timed=True)
 
     def __repr__(self):
         return f"LimitOp[{self.limit}]"
@@ -90,13 +90,13 @@ class UnionOp(PhysicalOp):
         return self.inputs[0].schema()
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
 
         def stream():
             for child in self.inputs:
                 yield from child.execute(partition, ctx)
 
-        return count_output(stream(), metrics)
+        return count_output(stream(), metrics, timed=True)
 
 
 class CoalesceBatchesOp(PhysicalOp):
@@ -119,7 +119,7 @@ class CoalesceBatchesOp(PhysicalOp):
         return self.child.schema()
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         target_cap = bucket_rows(self.target_rows)
 
         def stream():
@@ -147,7 +147,7 @@ class CoalesceBatchesOp(PhysicalOp):
             if acc is not None and acc_rows > 0:
                 yield acc
 
-        return count_output(stream(), metrics)
+        return count_output(stream(), metrics, timed=True)
 
 
 class EmptyPartitionsOp(PhysicalOp):

@@ -111,7 +111,7 @@ class ProjectOp(PhysicalOp):
                               apply=apply)
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         elapsed = metrics.counter("elapsed_compute")
         in_schema = self.child.schema()
         _sync = ctx.device_sync
@@ -166,7 +166,7 @@ class FilterOp(PhysicalOp):
                               apply=apply)
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         elapsed = metrics.counter("elapsed_compute")
         in_schema = self.child.schema()
         _sync = ctx.device_sync
@@ -235,7 +235,7 @@ class FilterProjectOp(PhysicalOp):
             apply=apply)
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         elapsed = metrics.counter("elapsed_compute")
         in_schema = self.child.schema()
         _sync = ctx.device_sync

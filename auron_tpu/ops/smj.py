@@ -429,7 +429,7 @@ class SortMergeJoinOp(PhysicalOp):
 
     # -- execution ----------------------------------------------------------
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         elapsed = metrics.counter("elapsed_compute")
         left_schema = self.probe.schema()
         right_schema = self.build.schema()

@@ -316,7 +316,7 @@ class SortOp(PhysicalOp):
                 return
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         elapsed = metrics.counter("elapsed_compute")
         in_schema = self.child.schema()
         _sync = ctx.device_sync

@@ -320,8 +320,15 @@ class ShuffleExchangeOp(PhysicalOp):
 
     def _materialize(self, ctx: ExecContext) -> _ExchangeBuffer:
         """Run all map tasks; ONE sort-by-pid compaction per batch."""
+        from auron_tpu.obs import trace
+        with trace.span("shuffle", "shuffle.materialize",
+                        maps=self.input_partitions,
+                        partitions=self.num_partitions):
+            return self._materialize_inner(ctx)
+
+    def _materialize_inner(self, ctx: ExecContext) -> _ExchangeBuffer:
         from auron_tpu import config as cfg
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         write_time = metrics.counter("shuffle_write_total_time")
         n_out = self.num_partitions
         schema = self.child.schema()
@@ -408,6 +415,15 @@ class ShuffleExchangeOp(PhysicalOp):
         kmetrics = ctx.metrics_for("kernels")
         built_c = kmetrics.counter("fused_split_programs_built")
         hit_c = kmetrics.counter("fused_split_program_hits")
+        # the folded chain still OWNS its plan node (see the hash-join
+        # probe fold): the split is row-preserving over live rows, so
+        # the sorted batch's count IS the chain's output count, and the
+        # one-launch program's time lands on the whole-stage node
+        fmetrics = ctx.metrics_for(self.child)
+        f_elapsed = fmetrics.counter("elapsed_compute")
+        f_rows = fmetrics.counter("output_rows")
+        f_batches = fmetrics.counter("output_batches")
+        fmetrics.counter("split_folded").add(1)
 
         fragments, frag_keys = self._split_fragments()
         input_op = self.child.input
@@ -433,9 +449,15 @@ class ShuffleExchangeOp(PhysicalOp):
                     frag_keys, part_sig, in_schema, out_schema, n_out,
                     batch.capacity, donate, fragments, part_exprs)
                 (built_c if built else hit_c).add(1)
-                with timer(write_time, sync=_sync) as t:
+                t0v = f_elapsed.value
+                with timer(f_elapsed, sync=_sync) as t:
                     sorted_batch, counts, carries = t.track(
                         kern(batch, jnp.int32(in_p), carries))
+                # the shuffle node keeps its canonical write-time view
+                # of the same launch (chain + split are one program)
+                write_time.add(f_elapsed.value - t0v)
+                f_rows.add(int(sorted_batch.num_rows))
+                f_batches.add(1)
                 counts_h = np.asarray(counts)
                 offsets = np.concatenate(
                     [np.zeros(1, np.int64), np.cumsum(counts_h)])
@@ -448,15 +470,18 @@ class ShuffleExchangeOp(PhysicalOp):
         with self._lock:
             if self._buffer is None:
                 self._buffer = self._materialize(ctx)
-        metrics = ctx.metrics_for(self.name + "_read")
+        metrics = ctx.metrics_for(self, "_read")
         read_time = metrics.counter("shuffle_read_total_time")
 
-        def stream():
-            for batch in self._buffer.partition_batches(partition):
-                with timer(read_time):
-                    yield batch
-
-        return count_output(stream(), metrics)
+        # production-segment timing only (obs/trace.stream_spanned): the
+        # read timer must not bill the consumer's compute, and the span
+        # must not stay open across yields
+        from auron_tpu.obs import trace
+        stream = trace.stream_spanned(
+            "shuffle", "shuffle.fetch",
+            self._buffer.partition_batches(partition),
+            time_counter=read_time, partition=partition)
+        return count_output(stream, metrics, timed=True)
 
     def __repr__(self):
         return (f"ShuffleExchangeOp[{type(self.partitioning).__name__} "
@@ -543,7 +568,8 @@ class RssShuffleExchangeOp(PhysicalOp):
         from auron_tpu.columnar.serde import (batch_to_host,
                                               serialize_host_batch,
                                               slice_host_batch)
-        metrics = ctx.metrics_for(self.name)
+        from auron_tpu.obs import trace
+        metrics = ctx.metrics_for(self)
         write_time = metrics.counter("shuffle_write_total_time")
         _sync = ctx.device_sync
         n_out = self.num_partitions
@@ -556,8 +582,10 @@ class RssShuffleExchangeOp(PhysicalOp):
         row_offset = 0
         donate = yields_owned_batches(self.child) \
             and jax.default_backend() != "cpu"
-        with self.service.partition_writer(self.shuffle_id, in_p,
-                                           n_out) as writer:
+        with trace.span("shuffle", "rss.map_write",
+                        shuffle=self.shuffle_id, map=in_p), \
+                self.service.partition_writer(self.shuffle_id, in_p,
+                                              n_out) as writer:
             for batch in itertools.chain(pending, batches):
                 n_in = int(batch.num_rows) if donate else None
                 with timer(write_time, sync=_sync) as t:
@@ -619,8 +647,13 @@ class RssShuffleExchangeOp(PhysicalOp):
                         return self.service.map_partition_frames(
                             self.shuffle_id, map_id, partition)
                     except aerr.ShuffleCorruption:
+                        from auron_tpu.obs import trace
                         ctx.metrics_for("recovery").counter(
                             "corruption_recomputes").add(1)
+                        trace.event(
+                            "shuffle", "shuffle.corruption_recompute",
+                            shuffle=self.shuffle_id, map=map_id,
+                            partition=partition, attempt=attempt)
                         self.service.invalidate_map(self.shuffle_id,
                                                     map_id)
                         self._write_map(map_id, ctx, self.partitioning)
@@ -630,7 +663,7 @@ class RssShuffleExchangeOp(PhysicalOp):
             if not self._written:
                 self._materialize(ctx)
                 self._written = True
-        metrics = ctx.metrics_for(self.name + "_read")
+        metrics = ctx.metrics_for(self, "_read")
         read_time = metrics.counter("shuffle_read_total_time")
 
         def stream():
@@ -642,13 +675,18 @@ class RssShuffleExchangeOp(PhysicalOp):
             maps = self.service.committed_maps(self.shuffle_id)
             for map_id in range(len(maps)):
                 for frame in self._fetch_map(map_id, partition, ctx):
+                    # deserialize INSIDE the timer, yield OUTSIDE it: a
+                    # yield under the timer would bill the consumer's
+                    # compute to shuffle_read_total_time
                     with timer(read_time):
                         host, _ = deserialize_host_batch(frame)
-                        if host.num_rows:
-                            yield host_to_batch(host,
-                                                bucket_rows(host.num_rows))
+                        batch = (host_to_batch(host,
+                                               bucket_rows(host.num_rows))
+                                 if host.num_rows else None)
+                    if batch is not None:
+                        yield batch
 
-        return count_output(stream(), metrics)
+        return count_output(stream(), metrics, timed=True)
 
     def __repr__(self):
         return (f"RssShuffleExchangeOp[{type(self.partitioning).__name__} "
@@ -675,7 +713,7 @@ class RssShuffleReadOp(PhysicalOp):
         return self._schema
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         read_time = metrics.counter("shuffle_read_total_time")
 
         def stream():
@@ -683,12 +721,16 @@ class RssShuffleReadOp(PhysicalOp):
                                                   host_to_batch)
             for frame in self.service.partition_frames(self.shuffle_id,
                                                        partition):
+                # yield outside the timer (see RssShuffleExchangeOp)
                 with timer(read_time):
                     host, _ = deserialize_host_batch(frame)
-                    if host.num_rows:
-                        yield host_to_batch(host, bucket_rows(host.num_rows))
+                    batch = (host_to_batch(host,
+                                           bucket_rows(host.num_rows))
+                             if host.num_rows else None)
+                if batch is not None:
+                    yield batch
 
-        return count_output(stream(), metrics)
+        return count_output(stream(), metrics, timed=True)
 
     def __repr__(self):
         return f"RssShuffleReadOp[shuffle={self.shuffle_id}]"
@@ -820,17 +862,20 @@ class BroadcastExchangeOp(PhysicalOp):
         return self.child.schema()
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         with self._lock:
             if self._buffer is None:
-                buf = _BroadcastBuffer(self, ctx.mem_manager, metrics,
-                                       conf=ctx.config)
-                for in_p in range(self.input_partitions):
-                    map_ctx = ctx.child(
-                        partition_id=in_p,
-                        num_partitions=self.input_partitions)
-                    for b in self.child.execute(in_p, map_ctx):
-                        map_ctx.check_cancelled()
-                        buf.add(b)
-                self._buffer = buf
-        return count_output(self._buffer.replay(), metrics)
+                from auron_tpu.obs import trace
+                with trace.span("shuffle", "broadcast.collect",
+                                maps=self.input_partitions):
+                    buf = _BroadcastBuffer(self, ctx.mem_manager, metrics,
+                                           conf=ctx.config)
+                    for in_p in range(self.input_partitions):
+                        map_ctx = ctx.child(
+                            partition_id=in_p,
+                            num_partitions=self.input_partitions)
+                        for b in self.child.execute(in_p, map_ctx):
+                            map_ctx.check_cancelled()
+                            buf.add(b)
+                    self._buffer = buf
+        return count_output(self._buffer.replay(), metrics, timed=True)

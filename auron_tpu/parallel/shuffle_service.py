@@ -120,8 +120,15 @@ class RssPartitionWriter:
             self._flush()
 
     def _flush(self) -> None:
+        from auron_tpu.obs import trace
         from auron_tpu.runtime import faults
         faults.maybe_fail("rss.flush", errors.RssUnavailableError)
+        with trace.span("shuffle", "rss.flush", shuffle=self.shuffle_id,
+                        map=self.map_id, bytes=self._buffered):
+            self._flush_inner()
+
+    def _flush_inner(self) -> None:
+        from auron_tpu.runtime import faults
         for p in sorted(self._buffers):
             frames = self._buffers[p]
             start = self._pos
@@ -138,8 +145,14 @@ class RssPartitionWriter:
 
     def commit(self) -> None:
         """Flush, append the partition-run trailer, atomically publish."""
+        from auron_tpu.obs import trace
         from auron_tpu.runtime import faults
         faults.maybe_fail("rss.commit", errors.RssUnavailableError)
+        with trace.span("shuffle", "rss.commit", shuffle=self.shuffle_id,
+                        map=self.map_id, bytes=self._pos):
+            self._commit_inner()
+
+    def _commit_inner(self) -> None:
         self._flush()
         trailer_start = self._pos
         # trailer: per partition, run count then (offset, length) pairs —
@@ -274,9 +287,21 @@ class FileShuffleService:
         — the recovery granularity: the RSS exchange fetches map by map
         so a ShuffleCorruption can recompute exactly the corrupt map
         without re-yielding earlier maps' data."""
+        from auron_tpu.obs import trace
         from auron_tpu.runtime import faults
         path = os.path.join(self._shuffle_dir(shuffle_id),
                             f"map_{map_id}.data")
+        with trace.span("shuffle", "rss.fetch", shuffle=shuffle_id,
+                        map=map_id, partition=partition) as sp:
+            frames = self._map_partition_frames(shuffle_id, map_id,
+                                                partition, path)
+            sp.set(frames=len(frames),
+                   bytes=sum(len(f) for f in frames))
+            return frames
+
+    def _map_partition_frames(self, shuffle_id: int, map_id: int,
+                              partition: int, path: str) -> list[bytes]:
+        from auron_tpu.runtime import faults
         faults.maybe_fail("rss.fetch", errors.RssUnavailableError)
 
         def corrupt(msg):

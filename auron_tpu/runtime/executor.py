@@ -104,15 +104,21 @@ class ExecutionRuntime:
 
     def _batches_inner(self) -> Iterator[DeviceBatch]:
         from auron_tpu import errors
+        from auron_tpu.obs import trace
         from auron_tpu.ops.base import TaskCancelled
         from auron_tpu.runtime import faults
         try:
-            for batch in self.plan.execute(self.task.partition_id,
-                                           self.ctx):
-                self.ctx.check_cancelled()
-                faults.maybe_fail("device.compute",
-                                  errors.DeviceExecutionError)
-                yield batch
+            with trace.span("task", "task.attempt",
+                            stage=self.task.stage_id,
+                            partition=self.task.partition_id,
+                            task=self.task.task_id,
+                            attempt=self.attempt):
+                for batch in self.plan.execute(self.task.partition_id,
+                                               self.ctx):
+                    self.ctx.check_cancelled()
+                    faults.maybe_fail("device.compute",
+                                      errors.DeviceExecutionError)
+                    yield batch
         except TaskCancelled:
             # reference behavior: task-kill is teardown, not failure
             # (is_task_running checks, rt.rs:208-238)
@@ -241,9 +247,32 @@ def _retry_backoff_s(attempt: int, base: float, cap: float) -> float:
     return random.uniform(0.0, min(cap, base * (2.0 ** attempt)))
 
 
+def _observe_task(rt: "ExecutionRuntime", table: pa.Table,
+                  metric_tree=None) -> None:
+    """Post-success observability for one task: mirror the per-op metric
+    sets onto the positional metric tree (obs/metric_tree — the
+    update_metric_node walk) and feed the process registry. Both halves
+    are cheap and gated; failures here must never fail a finished
+    task."""
+    try:
+        from auron_tpu.obs import metric_tree as mt
+        from auron_tpu.obs import registry as obs_registry
+        if metric_tree is not None:
+            mt.mirror(metric_tree, rt.plan, rt.ctx)
+        if obs_registry.enabled():
+            # finalize(), not the raw ctx snapshot: only finalize
+            # injects the recovery counters (transient_retries from the
+            # retry driver) the registry exists to expose
+            obs_registry.observe_task(
+                time.time() - rt._started, rt.finalize(),
+                output_rows=table.num_rows)
+    except Exception:   # pragma: no cover - observability is best-effort
+        logger.exception("task observability update failed")
+
+
 def run_task_with_retries(plan: PhysicalOp, partition: int,
                           num_partitions: int, mem_manager=None,
-                          config=None) -> pa.Table:
+                          config=None, metric_tree=None) -> pa.Table:
     """Run one (plan, partition) task, retrying transient failures at
     partition granularity — the retry driver the reference delegates to
     Spark's task scheduler (SURVEY §5.3; rt.rs's is_task_running checks
@@ -279,7 +308,9 @@ def run_task_with_retries(plan: PhysicalOp, partition: int,
             mem_manager=mem_manager, config=config,
             attempt=attempt, retry_stats=retry_stats)
         try:
-            return rt.collect()
+            table = rt.collect()
+            _observe_task(rt, table, metric_tree)
+            return table
         except TaskCancelled:
             raise
         except Exception as e:         # noqa: BLE001 — retry boundary
@@ -298,18 +329,24 @@ def run_task_with_retries(plan: PhysicalOp, partition: int,
                 "task attempt %d/%d failed for partition %d (%s); "
                 "retrying", attempt + 1, retries + 1, partition, e)
             delay = _retry_backoff_s(attempt, backoff, backoff_cap)
+            from auron_tpu.obs import trace
+            trace.event("task", "task.retry", partition=partition,
+                        attempt=attempt, backoff_s=round(delay, 4),
+                        error=type(e).__name__)
             if delay > 0:
                 _time.sleep(delay)
     raise last_err
 
 
 def collect(plan: PhysicalOp, num_partitions: int = 1,
-            mem_manager=None, config=None) -> pa.Table:
+            mem_manager=None, config=None, metric_tree=None) -> pa.Table:
     """Run every partition of a plan and concatenate (driver-side
-    collect), with per-partition transient-failure retries."""
+    collect), with per-partition transient-failure retries.
+    ``metric_tree`` (obs/metric_tree.build_tree(plan)) accumulates every
+    task's per-op metrics positionally — the EXPLAIN ANALYZE source."""
     tables = []
     for p in range(num_partitions):
         tables.append(run_task_with_retries(
             plan, p, num_partitions, mem_manager=mem_manager,
-            config=config))
+            config=config, metric_tree=metric_tree))
     return pa.concat_tables(tables)

@@ -226,6 +226,11 @@ def maybe_fail(site: str, exc_cls=errors.TransientError) -> None:
     rule = plane.fire(site, ("io_error", "fatal", "hang"))
     if rule is None:
         return
+    # injected faults carry site/kind on the timeline so chaos runs are
+    # self-explaining (obs/trace.py; correlated by tools/chaos_report)
+    from auron_tpu.obs import trace
+    trace.event("fault", "fault.injected", site=site, kind=rule.kind,
+                seed=plane.seed)
     if rule.kind == "hang":
         time.sleep(plane.hang_s)
         return
@@ -248,6 +253,9 @@ def maybe_corrupt(site: str, data: bytes) -> bytes:
     rule = plane.fire(site, ("corrupt",))
     if rule is None:
         return data
+    from auron_tpu.obs import trace
+    trace.event("fault", "fault.injected", site=site, kind="corrupt",
+                seed=plane.seed, bytes=len(data))
     pos = zlib.crc32(f"{plane.seed}|{site}|pos|{len(data)}".encode()) \
         % len(data)
     corrupted = bytearray(data)

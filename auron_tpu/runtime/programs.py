@@ -74,15 +74,25 @@ class ProgramCache:
         # jit cache keys on function identity, making the re-trace real
         from auron_tpu import config as _cfg
         key = (key, _cfg.trace_salt())
+        value = None
+        hit = False
         with self._lock:
             if key in self._memo:
                 self._memo.move_to_end(key)
                 self.hits += 1
-                return self._memo[key], False
+                value = self._memo[key]
+                hit = True
+        from auron_tpu.obs import trace as _trace
+        if hit:
+            # per-site hit events make the compile economics visible on
+            # the timeline; narrow auron.trace.events to drop them
+            _trace.event("program", "program.hit", site=self.site)
+            return value, False
         from auron_tpu import errors as _errors
         from auron_tpu.runtime import faults as _faults
         _faults.maybe_fail("program.build", _errors.DeviceExecutionError)
-        value = builder()   # build outside the lock: builders may recurse
+        with _trace.span("program", "program.build", site=self.site):
+            value = builder()   # build outside the lock: builders recurse
         with self._lock:
             if key in self._memo:   # raced with another thread: keep first
                 self.hits += 1

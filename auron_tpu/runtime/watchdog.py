@@ -181,6 +181,8 @@ def _fallback_to_cpu(deadline_s: float, why: str) -> None:
         "backend watchdog: %s — falling back to the CPU platform "
         "(rerun with JAX_PLATFORMS=cpu to skip the probe entirely)", why)
     _count("fallbacks")
+    from auron_tpu.obs import trace
+    trace.event("watchdog", "watchdog.fallback", why=why[:200])
     try:
         jax.config.update("jax_platforms", "cpu")
         os.environ["JAX_PLATFORMS"] = "cpu"   # subprocesses inherit the flip
@@ -206,6 +208,13 @@ def ensure_backend(config=None) -> Optional[str]:
     deadline = float(conf.get(cfg.WATCHDOG_INIT_TIMEOUT_S))
     if deadline <= 0:
         return None
+    from auron_tpu.obs import trace
+    with trace.span("watchdog", "watchdog.init_probe",
+                    deadline_s=deadline):
+        return _ensure_backend_probed(deadline)
+
+
+def _ensure_backend_probed(deadline: float) -> Optional[str]:
     _count("probes")
     # injected faults first, bounded in-process (a chaos `hang` must
     # exercise the timeout path without wedging jax's backend lock)
@@ -247,6 +256,14 @@ def first_compile_probe(config=None) -> Optional[float]:
     deadline = float(conf.get(cfg.WATCHDOG_COMPILE_TIMEOUT_S))
     if deadline <= 0:
         return None
+    from auron_tpu.obs import trace
+    with trace.span("watchdog", "watchdog.compile_probe",
+                    deadline_s=deadline):
+        return _first_compile_probed(deadline)
+
+
+def _first_compile_probed(deadline: float) -> Optional[float]:
+    import time
     _count("probes")
     if _initialized_platform() is None:
         # the jit probe would otherwise be the FIRST thing to enter

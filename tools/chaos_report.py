@@ -59,6 +59,7 @@ def run_sweep(seeds: int, scenario_filter: str | None) -> dict:
 
     rows = []
     failures = []
+    sites: dict = {}
     with tempfile.TemporaryDirectory(prefix="chaos_report_") as d:
         scenarios = {name: factory(os.path.join(d, name))
                      for name, factory in chaos.SCENARIOS.items()}
@@ -75,14 +76,28 @@ def run_sweep(seeds: int, scenario_filter: str | None) -> dict:
                 injected += sum(sum(v.values())
                                 for v in o.injected.values())
                 leaked += len(o.leaks)
+                # site→span correlation, aggregated across the sweep:
+                # each injected site accumulates the recovery spans its
+                # faults triggered (trace ids make single runs
+                # replayable/inspectable)
+                for site, c in o.correlation.items():
+                    s = sites.setdefault(
+                        site, {"injected": 0, "recovery": {}, "runs": 0})
+                    s["injected"] += c["injected"]
+                    s["runs"] += 1
+                    for name, n in c["recovery"].items():
+                        s["recovery"][name] = \
+                            s["recovery"].get(name, 0) + n
                 if not o.ok:
                     failures.append({
                         "scenario": scen_name, "plan": plan, "seed": seed,
                         "status": o.status, "error_type": o.error_type,
-                        "error": o.error, "leaks": o.leaks})
+                        "error": o.error, "leaks": o.leaks,
+                        "trace_id": o.trace_id})
             rows.append({"scenario": scen_name, "plan": plan,
                          "injected": injected, "leaked": leaked, **agg})
-    return {"seeds": seeds, "rows": rows, "failures": failures}
+    return {"seeds": seeds, "rows": rows, "failures": failures,
+            "sites": sites}
 
 
 def print_table(report: dict) -> None:
@@ -105,10 +120,24 @@ def print_table(report: dict) -> None:
           f"{total['identical']:>5d} {total['classified']:>5d} "
           f"{total['mismatch']:>4d} {total['unclassified']:>5d} "
           f"{total['leaked']:>4d}")
+    sites = report.get("sites") or {}
+    if sites:
+        print()
+        print("site -> recovery-span correlation "
+              "(fault events linked to the recovery they triggered)")
+        w_site = max(len(s) for s in sites)
+        for site in sorted(sites):
+            s = sites[site]
+            rec = ", ".join(f"{k}x{v}"
+                            for k, v in sorted(s["recovery"].items())) \
+                or "-"
+            print(f"  {site:{w_site}s}  injected={s['injected']:<5d} "
+                  f"runs={s['runs']:<4d} recovery: {rec}")
     for f in report["failures"]:
         print(f"CONTRACT BROKEN: {f['scenario']} plan={f['plan']!r} "
-              f"seed={f['seed']} -> {f['status']} "
-              f"({f['error_type']}: {f['error']}) leaks={f['leaks']}")
+              f"seed={f['seed']} trace={f.get('trace_id', 0)} -> "
+              f"{f['status']} ({f['error_type']}: {f['error']}) "
+              f"leaks={f['leaks']}")
 
 
 def main(argv=None) -> int:
@@ -129,6 +158,7 @@ def main(argv=None) -> int:
                           for r in report["rows"]),
                       "chaos_injected": sum(r["injected"]
                                             for r in report["rows"]),
+                      "chaos_sites": report.get("sites") or {},
                       "chaos_contract_ok": ok}))
     return 0 if ok else 1
 

@@ -1,0 +1,172 @@
+"""Trace-directory report: what the engine spent its time on.
+
+Summarizes the JSONL event logs a traced run exported into
+``auron.trace.dir`` (obs/trace.py, one ``trace_*.jsonl`` per top-level
+query): per-category span counts and total/max duration, the top-N
+slowest spans per category, the retry/recompute timeline (task.retry,
+shuffle.corruption_recompute, fault.injected, watchdog.fallback events
+in order), and compile-time attribution (program.build spans grouped by
+compile site). ``--compare`` diffs two trace dirs (A/B runs: fused vs
+unfused, checksums on vs off, ...) by per-category totals.
+
+    python tools/trace_report.py /tmp/trace_dir
+    python tools/trace_report.py /tmp/trace_dir --top 5
+    python tools/trace_report.py --compare /tmp/base /tmp/candidate
+
+The last stdout line is one JSON record (same driver contract as
+bench.py / compile_report.py / chaos_report.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: event names that form the retry/recompute timeline
+_TIMELINE_NAMES = ("fault.injected", "task.retry",
+                   "shuffle.corruption_recompute", "watchdog.fallback")
+
+
+def load_dir(trace_dir: str) -> list:
+    from auron_tpu.obs.trace import read_jsonl
+    spans = []
+    files = sorted(glob.glob(os.path.join(trace_dir, "trace_*.jsonl")))
+    if not files:
+        raise SystemExit(f"no trace_*.jsonl files under {trace_dir!r} "
+                         "(run with auron.trace.enabled + auron.trace.dir)")
+    for f in files:
+        spans.extend(read_jsonl(f))
+    spans.sort(key=lambda s: (s.ts_ns, s.span_id))
+    return spans
+
+
+def summarize(spans: list, top: int = 10) -> dict:
+    by_cat: dict = {}
+    for s in spans:
+        c = by_cat.setdefault(s.cat, {"count": 0, "total_ms": 0.0,
+                                      "max_ms": 0.0})
+        c["count"] += 1
+        ms = s.dur_ns / 1e6
+        c["total_ms"] += ms
+        c["max_ms"] = max(c["max_ms"], ms)
+    slowest = {}
+    for cat in by_cat:
+        worst = sorted((s for s in spans if s.cat == cat and s.dur_ns),
+                       key=lambda s: -s.dur_ns)[:top]
+        slowest[cat] = [
+            {"name": s.name, "ms": round(s.dur_ns / 1e6, 3),
+             "trace": s.trace_id, "span": s.span_id, "attrs": s.attrs}
+            for s in worst]
+    timeline = [
+        {"ts_ms": round(s.ts_ns / 1e6, 3), "name": s.name,
+         "attrs": s.attrs}
+        for s in spans if s.name in _TIMELINE_NAMES]
+    compile_sites: dict = {}
+    for s in spans:
+        if s.name == "program.build":
+            site = s.attrs.get("site", "?")
+            c = compile_sites.setdefault(site, {"builds": 0,
+                                                "total_ms": 0.0})
+            c["builds"] += 1
+            c["total_ms"] += s.dur_ns / 1e6
+    hits: dict = {}
+    for s in spans:
+        if s.name == "program.hit":
+            site = s.attrs.get("site", "?")
+            hits[site] = hits.get(site, 0) + 1
+    for site, n in hits.items():
+        compile_sites.setdefault(site, {"builds": 0, "total_ms": 0.0})
+        compile_sites[site]["hits"] = n
+    for c in compile_sites.values():
+        c["total_ms"] = round(c["total_ms"], 3)
+        c.setdefault("hits", 0)
+    for c in by_cat.values():
+        c["total_ms"] = round(c["total_ms"], 3)
+        c["max_ms"] = round(c["max_ms"], 3)
+    return {"spans": len(spans),
+            "traces": len({s.trace_id for s in spans}),
+            "by_category": by_cat, "slowest": slowest,
+            "timeline": timeline, "compile_sites": compile_sites}
+
+
+def print_summary(rep: dict, top: int) -> None:
+    print(f"{rep['spans']} spans across {rep['traces']} trace(s)")
+    print(f"{'category':10s} {'count':>7s} {'total_ms':>10s} "
+          f"{'max_ms':>9s}")
+    for cat, c in sorted(rep["by_category"].items()):
+        print(f"{cat:10s} {c['count']:>7d} {c['total_ms']:>10.1f} "
+              f"{c['max_ms']:>9.1f}")
+    print(f"\ntop-{top} slowest spans per category:")
+    for cat, worst in sorted(rep["slowest"].items()):
+        if not worst:
+            continue
+        print(f"  [{cat}]")
+        for w in worst:
+            attrs = {k: v for k, v in w["attrs"].items()
+                     if k not in ("error",)}
+            print(f"    {w['ms']:>10.2f}ms  {w['name']}  {attrs}")
+    if rep["compile_sites"]:
+        print("\ncompile-time attribution (program.build per site):")
+        for site, c in sorted(rep["compile_sites"].items(),
+                              key=lambda kv: -kv[1]["total_ms"]):
+            print(f"  {site:40s} builds={c['builds']:<4d} "
+                  f"hits={c['hits']:<6d} {c['total_ms']:>9.1f}ms")
+    if rep["timeline"]:
+        print("\nretry/recompute timeline:")
+        for t in rep["timeline"]:
+            print(f"  {t['ts_ms']:>12.2f}ms  {t['name']}  {t['attrs']}")
+
+
+def _compare(base_dir: str, cand_dir: str, top: int) -> int:
+    base = summarize(load_dir(base_dir), top)
+    cand = summarize(load_dir(cand_dir), top)
+    print(f"{'category':10s} {'base_ms':>10s} {'cand_ms':>10s} "
+          f"{'delta':>8s}")
+    deltas = {}
+    for cat in sorted(set(base["by_category"]) | set(cand["by_category"])):
+        b = base["by_category"].get(cat, {}).get("total_ms", 0.0)
+        c = cand["by_category"].get(cat, {}).get("total_ms", 0.0)
+        # None, not inf, for a category absent from base: json.dumps
+        # would emit the non-RFC 'Infinity' token and break the
+        # last-line JSON driver contract
+        pct = round((c - b) / b * 100.0, 2) if b else (None if c else 0.0)
+        deltas[cat] = {"base_ms": b, "cand_ms": c, "delta_pct": pct}
+        shown = "new" if pct is None else f"{pct:.1f}%"
+        print(f"{cat:10s} {b:>10.1f} {c:>10.1f} {shown:>8s}")
+    print(json.dumps({"base_spans": base["spans"],
+                      "cand_spans": cand["spans"],
+                      "categories": deltas}))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace_dir", nargs="?", default=None,
+                    help="directory of trace_*.jsonl files")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest spans listed per category")
+    ap.add_argument("--compare", nargs=2, metavar=("BASE", "CANDIDATE"),
+                    default=None,
+                    help="diff two trace dirs by per-category totals")
+    args = ap.parse_args(argv)
+    if args.compare:
+        return _compare(args.compare[0], args.compare[1], args.top)
+    if not args.trace_dir:
+        ap.error("trace_dir (or --compare) is required")
+    rep = summarize(load_dir(args.trace_dir), args.top)
+    print_summary(rep, args.top)
+    print(json.dumps({"trace_spans": rep["spans"],
+                      "trace_traces": rep["traces"],
+                      "by_category": rep["by_category"],
+                      "compile_sites": rep["compile_sites"],
+                      "timeline_events": len(rep["timeline"])}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
