@@ -210,6 +210,31 @@ PROFILE_DIR = _opt(
     "Directory for profiler trace output; empty = a per-task directory "
     "under the system temp dir. The trace is viewable with "
     "tensorboard/xprof.")
+PROFILE_ENABLED = _opt(
+    "auron.profile.enabled", bool, True,
+    "Host/device time attribution (auron_tpu/obs/profile.py): every "
+    "jitted-program invocation through the central registry "
+    "(runtime/programs.py) is timed as dispatch (host python glue until "
+    "the async call returns) + device (block_until_ready wait), and "
+    "per-operator timers classify the remaining wall into named host "
+    "buckets (elapsed_host_{dispatch,convert,serde,iter,other}) "
+    "alongside elapsed_device in the metric tree / EXPLAIN ANALYZE. "
+    "Feeds the per-batch dispatch-overhead registry histograms and the "
+    "per-query profile_*.jsonl export into auron.trace.dir that "
+    "tools/hotspot_report.py ranks. Measured overhead < 2% (bench A/B, "
+    "PERF.md 'Performance forensics'); off reduces the hot-path cost to "
+    "one cached epoch compare per timer. Attribution requires the "
+    "per-call sync point, so auron.metrics.device_sync=false (the "
+    "maximum-throughput knob) disables the profiler too — profiling "
+    "never silently serializes a run that asked for async overlap.")
+PERF_GATE_TOLERANCE_PCT = _opt(
+    "auron.perf_gate.tolerance_pct", float, 50.0,
+    "Allowed q01 rows/s shortfall vs the checked-in per-platform "
+    "baseline (tools/perf_baseline.json) before tools/perf_gate.py "
+    "fails the run. Sized to this container's measured wall-clock "
+    "variance (single-rep swings of +/-10-50%): the BENCH_r03->r05 "
+    "regression (276k -> 108k rows/s, a 61% drop) fails the default "
+    "while honest noise passes. CLI --tolerance-pct overrides.")
 
 # tracing plane (auron_tpu/obs/trace.py)
 TRACE_ENABLED = _opt(

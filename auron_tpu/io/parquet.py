@@ -137,11 +137,11 @@ class ParquetScanOp(PhysicalOp):
                 for rb in it:
                     nxt = pool.submit(convert, rb)
                     if pending is not None:
-                        with timer(io_time):
+                        with timer(io_time, bucket="convert"):
                             yield pending.result()
                     pending = nxt
                 if pending is not None:
-                    with timer(io_time):
+                    with timer(io_time, bucket="convert"):
                         yield pending.result()
 
         return count_output(stream(), metrics, timed=True)

@@ -86,19 +86,19 @@ class _FileSinkOp(PhysicalOp):
                     if pending_rows >= buffer_rows:
                         chunk = pa.concat_tables(pending).combine_chunks()
                         pending, pending_rows = [], 0
-                        with timer(io_time):
+                        with timer(io_time, bucket="serde"):
                             writer = self._write_chunk(writer, chunk,
                                                        partition, wstate)
                 if pending:
                     chunk = pa.concat_tables(pending).combine_chunks()
-                    with timer(io_time):
+                    with timer(io_time, bucket="serde"):
                         writer = self._write_chunk(writer, chunk, partition,
                                                    wstate)
                 ok = True
             finally:
                 if writer is not None:
                     try:
-                        with timer(io_time):
+                        with timer(io_time, bucket="serde"):
                             writer.close()
                             for st in wstate.get("streams", ()):
                                 if not st.closed:

@@ -46,14 +46,21 @@ def _dim(session, tables, name):
 # q01: scan → filter → two-phase agg → sort  (the flagship q01 shape)
 # --------------------------------------------------------------------------
 
-def _q01_run(s, t):
+def q01_dataframe(s, t):
+    """The q01 DataFrame WITHOUT collecting — shared by the e2e query
+    below and the bench's profiled explain-analyze section
+    (bench.bench_profile_q01), so the profiled plan can never drift from
+    the differential-tested one."""
     return (_sales(s, t)
             .filter(col("ss_quantity") > 5)
             .group_by("ss_store_sk")
             .agg(F.sum(col("ss_sales_price")).alias("total"),
                  F.count(col("ss_net_paid")).alias("paid_cnt"),
-                 F.avg(col("ss_net_profit")).alias("avg_profit"))
-            .collect())
+                 F.avg(col("ss_net_profit")).alias("avg_profit")))
+
+
+def _q01_run(s, t):
+    return q01_dataframe(s, t).collect()
 
 
 def _q01_oracle(p):
@@ -181,12 +188,20 @@ def _q06_run(s, t):
 
 
 def _q06_oracle(p):
+    import pandas as pd
     j = p["store_sales"].merge(p["customer"], left_on="ss_customer_sk",
                                right_on="c_customer_sk")
-    return j.groupby("c_state").agg(
-        first_email=("c_email", "min"),
-        last_email=("c_email", "max"),
-        n=("c_email", "count")).reset_index()
+    # pandas >= 2 groupby.agg(min/max) raises TypeError on object
+    # columns containing None (its cython path compares str against the
+    # NaN float). SQL min/max skip nulls, so dropna-then-reduce states
+    # the intended oracle semantics AND sidesteps the pandas limitation
+    # (all-null groups would yield NaN, matching the engine's NULL).
+    g = j.groupby("c_state")["c_email"]
+    return pd.DataFrame({
+        "first_email": g.apply(lambda s: s.dropna().min()),
+        "last_email": g.apply(lambda s: s.dropna().max()),
+        "n": g.count(),
+    }).reset_index()
 
 
 # --------------------------------------------------------------------------

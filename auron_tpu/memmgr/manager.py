@@ -98,12 +98,33 @@ class MemManager:
 
     def update_mem_used(self, c: MemConsumer, used: int) -> str:
         """Record ``c``'s usage; returns 'nothing' or 'spilled'. May invoke
-        c.spill() (or the largest consumer's) synchronously."""
+        c.spill() (or the largest consumer's) synchronously.
+
+        Every accounting decision is observable on the same planes as
+        compute (the PR 6 forensics contract): the post-decision status
+        mirrors onto registry gauges (obs/registry.observe_memmgr), an
+        under-budget grant drops a ``memory`` trace event, each spill
+        opens a ``memmgr.spill`` span around the victim's spill, and an
+        over-budget exit with no spillable candidate left records a
+        ``memmgr.deny`` — so memory pressure lines up with the span
+        timeline instead of hiding in log archaeology."""
+        from auron_tpu.obs import trace
+        observe = self._registry_enabled()
         with self._lock:
             self._used[c] = used
             total_used = sum(self._used.values())
+            # grant-path telemetry snapshot under the SAME lock the
+            # accounting already holds — no second acquisition, and the
+            # consumer copy only happens when the registry will see it
+            status = self._status_locked() if observe else None
 
         if total_used <= self.total:
+            trace.event("memory", "memmgr.grant",
+                        consumer=getattr(c, "consumer_name", "?"),
+                        used=used, total_used=total_used,
+                        budget=self.total)
+            if status is not None:
+                self._observe(status)
             return "nothing"
 
         # Spill until under budget or out of candidates (the reference loops
@@ -126,11 +147,20 @@ class MemManager:
                     candidates = [(u, v) for v, u in self._used.items()
                                   if u >= self.min_trigger and v not in tried]
                 if not candidates:
+                    trace.event("memory", "memmgr.deny",
+                                consumer=getattr(c, "consumer_name", "?"),
+                                total_used=total_used, budget=self.total,
+                                tried=len(tried))
                     break
                 _, victim = max(candidates, key=lambda t: t[0])
             tried.add(victim)
 
-            freed = victim.spill()
+            with trace.span("memory", "memmgr.spill",
+                            victim=getattr(victim, "consumer_name", "?"),
+                            total_used=total_used,
+                            budget=self.total) as sp:
+                freed = victim.spill()
+                sp.set(freed=freed)
             with self._lock:
                 self._used[victim] = max(self._used.get(victim, 0) - freed, 0)
                 if freed:
@@ -141,19 +171,44 @@ class MemManager:
                 logger.info("memmgr: spilled %s (%d bytes freed, %d/%d used)",
                             victim.consumer_name, freed,
                             max(total_used - freed, 0), self.total)
+        if self._registry_enabled():
+            self._observe(self.status())
         return "spilled" if spilled_any else "nothing"
+
+    @staticmethod
+    def _registry_enabled() -> bool:
+        try:
+            from auron_tpu.obs import registry as obs_registry
+            return obs_registry.enabled()
+        except Exception:   # pragma: no cover
+            return False
+
+    def _observe(self, status: dict) -> None:
+        """Mirror a status snapshot onto the process registry gauges
+        (best-effort: telemetry must never fail an accounting update)."""
+        try:
+            from auron_tpu.obs import registry as obs_registry
+            obs_registry.observe_memmgr(status)
+        except Exception:   # pragma: no cover - observability best-effort
+            logger.exception("memmgr gauge update failed")
 
     # -- status (reference dumps the consumer table on exit,
     #    auron-memmgr/src/lib.rs:143-163) ----------------------------------
 
     def status(self) -> dict:
         with self._lock:
-            return {
-                "total": self.total,
-                "used": sum(self._used.values()),
-                "num_consumers": len(self._used),
-                "num_spills": self.num_spills,
-                "spilled_bytes": self.spilled_bytes,
-                "consumers": {getattr(c, "consumer_name", "?"): u
-                              for c, u in self._used.items()},
-            }
+            return self._status_locked()
+
+    def _status_locked(self) -> dict:
+        """Status snapshot; caller holds ``self._lock``."""
+        n = max(len(self._used), 1)
+        return {
+            "total": self.total,
+            "used": sum(self._used.values()),
+            "num_consumers": len(self._used),
+            "fair_share": self.total // n,
+            "num_spills": self.num_spills,
+            "spilled_bytes": self.spilled_bytes,
+            "consumers": {getattr(c, "consumer_name", "?"): u
+                          for c, u in self._used.items()},
+        }

@@ -29,9 +29,6 @@ from typing import Optional
 
 #: display order: the canonical trio first, then everything sorted
 _CANONICAL = ("output_rows", "output_batches", "elapsed_compute")
-#: nanosecond counters rendered as milliseconds
-_NS_METRICS = ("elapsed_compute", "shuffle_write_total_time",
-               "shuffle_read_total_time")
 
 
 @dataclass
@@ -71,7 +68,11 @@ def mirror(node: MetricNode, op, ctx) -> None:
 
 
 def _fmt_value(name: str, v) -> str:
-    if name in _NS_METRICS:
+    # the engine's naming contract: every ``elapsed_*`` counter
+    # (elapsed_compute, the profiler's elapsed_device / elapsed_host_*)
+    # and every ``*_time`` counter (io_time, shuffle_*_total_time) is a
+    # nanosecond wall, rendered as milliseconds
+    if name.startswith("elapsed_") or name.endswith("_time"):
         return f"{v / 1e6:.1f}ms"
     if name.endswith("_size") or name.endswith("_bytes"):
         if v >= 1 << 20:

@@ -1,0 +1,344 @@
+"""Performance forensics: host/device time attribution.
+
+The [speed] ROADMAP item is a *measurement* problem before it is an
+optimization problem: q01 CPU throughput decayed 276k → 108k rows/s
+across three bench rounds with nothing pointing at WHERE the time went.
+``elapsed_compute`` (ops/base.timer) honestly measures each operator's
+wall — but Flare (PAPERS.md, 1703.08219) attributes exactly this class
+of loss to host-side glue *around* the engine, and a single wall number
+cannot separate the XLA execution from the python that feeds it.
+
+This module splits every operator's wall into:
+
+- ``elapsed_device`` — time spent waiting on the accelerator. The
+  central program registry (runtime/programs.py) wraps every jitted
+  program it hands out; each invocation times the async dispatch
+  (call → return) and then ``block_until_ready`` on the outputs
+  (return → results materialized). Kernels that bypass the registry
+  (the dense grouped-agg module jits) still get a split through the
+  ``timer.track`` fallback: the tracked-value registration marks the
+  dispatch/device boundary and the timer's exit sync bounds the wait.
+- ``elapsed_host_*`` — named host buckets for the remainder:
+  ``dispatch`` (python glue until the async call returns: arg prep,
+  cache lookups, jax dispatch), ``convert`` (arrow↔device transfers:
+  scan decode waits, the executor's to_arrow materialization),
+  ``serde`` (shuffle/spill frame pack/unpack + host slicing),
+  ``iter`` (executor drive-loop bookkeeping between batches), and
+  ``other`` (the unclassified residue, so per-timer attribution sums
+  to the measured wall by construction).
+
+Recording contract (same shape as obs/trace.py):
+
+- disabled path: one cached config-epoch compare per timer / per
+  program call — no frame allocation, no clock reads beyond what
+  ``elapsed_compute`` already pays;
+- enabled recording is thread-local (a frame STACK per thread, pushed
+  by ops/base.timer) — kernel calls credit the innermost open frame,
+  so nested/inclusive timers keep today's inclusive semantics and the
+  residue lands in the inner operator's ``other``.
+
+Beyond the per-op counters, each wrapped call feeds two process
+histograms (``auron_dispatch_overhead_seconds`` /
+``auron_device_call_seconds`` — the per-batch dispatch-overhead
+p50/p95/p99 of the registry scrape) and, when the ``program`` trace
+category records, a ``program.call`` span carrying the split so
+tools/trace_report.py can print host/device columns. ``export_task``
+appends one JSONL record per operator instance into ``auron.trace.dir``
+(``profile_<trace>.jsonl``) — the input ``tools/hotspot_report.py``
+ranks into its category×operator table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+#: host-bucket vocabulary (counter names are "elapsed_host_" + bucket)
+HOST_BUCKETS = ("dispatch", "convert", "serde", "iter", "other")
+
+#: finer-than-default histogram buckets (seconds): python dispatch glue
+#: and single-batch device calls live in the 10µs–100ms range the
+#: registry's 1ms-floor latency buckets cannot resolve
+CALL_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3,
+                5e-3, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0, 5.0)
+
+#: (config epoch, enabled) verdict cache — the disabled hot path is one
+#: int compare (the trace/faults pattern)
+_CACHED: tuple[int, Optional[bool]] = (-1, None)
+
+_TLS = threading.local()
+
+
+def enabled() -> bool:
+    global _CACHED
+    from auron_tpu import config as cfg
+    epoch, val = _CACHED
+    if epoch == cfg.config_epoch() and val is not None:
+        return val
+    epoch = cfg.config_epoch()
+    conf = cfg.get_config()
+    # attribution NEEDS the per-call sync point (block_until_ready is
+    # what separates device wait from host glue), so it must never
+    # override auron.metrics.device_sync=False — the documented
+    # maximum-throughput knob that trades metrics honesty for
+    # async-dispatch overlap. device_sync off ⇒ profiler off.
+    val = (conf.get(cfg.PROFILE_ENABLED)
+           and conf.get(cfg.METRICS_DEVICE_SYNC))
+    _CACHED = (epoch, val)
+    return val
+
+
+# ---------------------------------------------------------------------------
+# frames: per-timer attribution scopes (thread-local stack)
+# ---------------------------------------------------------------------------
+
+class Frame:
+    """One open timer scope's accumulators (nanoseconds)."""
+
+    __slots__ = ("device", "dispatch", "convert", "serde", "iter",
+                 "calls")
+
+    def __init__(self):
+        self.device = 0
+        self.dispatch = 0
+        self.convert = 0
+        self.serde = 0
+        self.iter = 0
+        self.calls = 0
+
+
+def _stack() -> list:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = []
+        _TLS.stack = st
+    return st
+
+
+def push_frame() -> Optional[Frame]:
+    """Open an attribution frame for a timer scope; None when profiling
+    is off (the caller skips the pop entirely)."""
+    if not enabled():
+        return None
+    f = Frame()
+    _stack().append(f)
+    return f
+
+
+def pop_frame(frame: Frame, sink, wall_ns: int,
+              track_offset_ns: Optional[int] = None,
+              bucket: Optional[str] = None) -> None:
+    """Close ``frame`` and flush its attribution into ``sink`` (the
+    owning ops.base.MetricsSet).
+
+    - wrapped program calls recorded their own dispatch/device split;
+    - with NO wrapped call but a ``timer.track`` registration,
+      ``track_offset_ns`` marks the dispatch→device boundary (the dense
+      grouped-agg path, whose module-level jits bypass the registry);
+    - with neither, a ``bucket`` hint classifies the whole wall (host
+      sections: scan decode waits → convert, shuffle serde → serde);
+    - the residue is ``other`` so the buckets sum to the wall.
+
+    Only nonzero buckets materialize counters (metric snapshots stay
+    small; EXPLAIN ANALYZE shows what actually happened, not the whole
+    vocabulary)."""
+    st = _stack()
+    if st and st[-1] is frame:
+        st.pop()
+    else:   # pragma: no cover - unwound out of order (exception paths)
+        try:
+            st.remove(frame)
+        except ValueError:
+            pass
+    device = frame.device
+    dispatch = frame.dispatch
+    convert = frame.convert
+    serde = frame.serde
+    iter_ns = frame.iter
+    if frame.calls == 0:
+        if track_offset_ns is not None:
+            dispatch += max(track_offset_ns, 0)
+            device += max(wall_ns - max(track_offset_ns, 0), 0)
+        elif bucket is not None:
+            if bucket == "convert":
+                convert += wall_ns
+            elif bucket == "serde":
+                serde += wall_ns
+            elif bucket == "iter":
+                iter_ns += wall_ns
+            else:
+                dispatch += wall_ns
+    other = wall_ns - (device + dispatch + convert + serde + iter_ns)
+    if device:
+        sink.counter("elapsed_device").add(device)
+    if dispatch:
+        sink.counter("elapsed_host_dispatch").add(dispatch)
+    if convert:
+        sink.counter("elapsed_host_convert").add(convert)
+    if serde:
+        sink.counter("elapsed_host_serde").add(serde)
+    if iter_ns:
+        sink.counter("elapsed_host_iter").add(iter_ns)
+    if other > 0:
+        sink.counter("elapsed_host_other").add(other)
+
+
+def add_host(bucket: str, ns: int) -> None:
+    """Credit ``ns`` host nanoseconds of ``bucket`` to the innermost
+    open frame (no-op without one) — for host sections nested inside a
+    compute timer."""
+    st = getattr(_TLS, "stack", None)
+    if not st:
+        return
+    f = st[-1]
+    if bucket == "convert":
+        f.convert += ns
+    elif bucket == "serde":
+        f.serde += ns
+    elif bucket == "iter":
+        f.iter += ns
+    else:
+        f.dispatch += ns
+
+
+# ---------------------------------------------------------------------------
+# program-call instrumentation (runtime/programs.py wraps through here)
+# ---------------------------------------------------------------------------
+
+def _block(out) -> None:
+    """Wait for every array leaf of a program result. Per-leaf
+    block_until_ready, tolerant of plugins where it raises (ops/base.
+    _device_sync documents the tunneled-accelerator caveat)."""
+    import jax
+    for leaf in jax.tree_util.tree_leaves(out):
+        block = getattr(leaf, "block_until_ready", None)
+        if block is None:
+            continue
+        try:
+            block()
+        except Exception:   # pragma: no cover - plugin-dependent
+            return
+
+
+def on_call(dispatch_ns: int, device_ns: int, site: str) -> None:
+    """One wrapped program invocation's split: credit the innermost
+    frame, feed the registry histograms, and drop a ``program.call``
+    span when that trace category records."""
+    st = getattr(_TLS, "stack", None)
+    if st:
+        f = st[-1]
+        f.dispatch += dispatch_ns
+        f.device += device_ns
+        f.calls += 1
+    from auron_tpu.obs import registry as _registry
+    if _registry.enabled():
+        r = _registry.get_registry()
+        r.histogram("auron_dispatch_overhead_seconds",
+                    buckets=CALL_BUCKETS).observe(dispatch_ns * 1e-9)
+        r.histogram("auron_device_call_seconds",
+                    buckets=CALL_BUCKETS).observe(device_ns * 1e-9)
+    from auron_tpu.obs import trace as _trace
+    if _trace.category_enabled("program"):
+        total = dispatch_ns + device_ns
+        # start reconstructed from the durations: no clock reads beyond
+        # the two the wrapper already took
+        _trace.complete_span(
+            "program", "program.call",
+            _trace.tracer().now_ns() - total, total, site=site,
+            dispatch_ms=round(dispatch_ns / 1e6, 4),
+            device_ms=round(device_ns / 1e6, 4))
+
+
+class ProfiledProgram:
+    """Transparent callable proxy timing dispatch + device wait per
+    invocation. Attribute access (``cache_info``-style introspection)
+    passes through to the wrapped program."""
+
+    __slots__ = ("_fn", "_site")
+
+    def __init__(self, fn, site: str):
+        object.__setattr__(self, "_fn", fn)
+        object.__setattr__(self, "_site", site)
+
+    def __call__(self, *args, **kwargs):
+        import time
+        t0 = time.perf_counter_ns()
+        out = self._fn(*args, **kwargs)
+        t1 = time.perf_counter_ns()
+        _block(out)
+        on_call(t1 - t0, time.perf_counter_ns() - t1, self._site)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_fn"), name)
+
+
+def wrap_program(value, site: str):
+    """The registry's return hook: wrap a callable program in the
+    per-invocation timer when profiling is on; everything else (and the
+    disabled path) passes through untouched."""
+    if not callable(value) or not enabled():
+        return value
+    return ProfiledProgram(value, site)
+
+
+# ---------------------------------------------------------------------------
+# per-task export + aggregate views
+# ---------------------------------------------------------------------------
+
+def export_task(ctx, plan) -> None:
+    """Append one JSONL record per operator instance of a finished task
+    into ``auron.trace.dir`` (``profile_<trace>.jsonl``) — the
+    tools/hotspot_report.py input. Best-effort like every observability
+    sink; no-op unless profiling is on and a trace dir is configured."""
+    if not enabled():
+        return
+    from auron_tpu import config as cfg
+    trace_dir = cfg.get_config().get(cfg.TRACE_DIR)
+    if not trace_dir:
+        return
+    from auron_tpu.obs import trace as _trace
+    trace_id = _trace.tracer().current_trace
+    path = os.path.join(trace_dir, f"profile_{trace_id:08d}.jsonl")
+    try:
+        os.makedirs(trace_dir, exist_ok=True)
+        lines = []
+        for (oid, suffix), (op, ms) in list(ctx.op_metrics.items()):
+            snap = ms.snapshot()
+            if not snap:
+                continue
+            lines.append(json.dumps({
+                "task": ctx.task_id, "stage": ctx.stage_id,
+                "partition": ctx.partition_id,
+                "op": op.name + suffix, "repr": repr(op),
+                "metrics": snap}))
+        if lines:
+            with open(path, "a") as f:
+                f.write("\n".join(lines) + "\n")
+    except Exception:   # pragma: no cover - observability is best-effort
+        import logging
+        logging.getLogger(__name__).exception(
+            "profile export to %r failed", trace_dir)
+
+
+def summarize_tree(node) -> dict:
+    """Host/device rollup over a metric tree (obs/metric_tree.MetricNode)
+    — the machine-readable profile section bench.py records and the
+    EXPLAIN ANALYZE footer's source. Millisecond floats."""
+    device = 0
+    buckets = {b: 0 for b in HOST_BUCKETS}
+    compute = 0
+    for n in node.walk():
+        device += n.metrics.get("elapsed_device", 0)
+        compute += n.metrics.get("elapsed_compute", 0)
+        for b in HOST_BUCKETS:
+            buckets[b] += n.metrics.get("elapsed_host_" + b, 0)
+    host = {b: round(v / 1e6, 3) for b, v in buckets.items() if v}
+    return {
+        "device_ms": round(device / 1e6, 3),
+        "host_ms": round(sum(buckets.values()) / 1e6, 3),
+        "host_buckets_ms": host,
+        "elapsed_compute_ms": round(compute / 1e6, 3),
+    }

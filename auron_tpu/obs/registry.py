@@ -294,6 +294,38 @@ def enabled() -> bool:
     return val
 
 
+def observe_memmgr(status: dict) -> None:
+    """Mirror one MemManager.status() snapshot onto registry gauges —
+    called by the manager on every ``update_mem_used`` / spill decision
+    (gated by auron.metrics.registry), so the HBM/DRAM tier pressure the
+    paper's memory manager arbitrates is scrapeable live:
+
+    - ``auron_memmgr_budget_bytes`` / ``auron_memmgr_used_bytes`` /
+      ``auron_memmgr_consumers`` / ``auron_memmgr_fair_share_bytes``
+    - ``auron_memmgr_spills_total`` / ``auron_memmgr_spilled_bytes_total``
+      (monotonic manager totals, exposed last-write-wins so a scrape
+      between managers never double-counts)
+    - ``auron_memmgr_consumer_bytes{consumer=...}`` per registered
+      consumer. A consumer absent from a later snapshot keeps its last
+      value (gauges are last-write-wins, not reaped); cardinality is
+      bounded by the set of consumer NAMES, which are stable per
+      operator class, not per instance.
+    """
+    if not enabled():
+        return
+    r = _REGISTRY
+    r.gauge("auron_memmgr_budget_bytes").set(status["total"])
+    r.gauge("auron_memmgr_used_bytes").set(status["used"])
+    r.gauge("auron_memmgr_consumers").set(status["num_consumers"])
+    r.gauge("auron_memmgr_fair_share_bytes").set(
+        status.get("fair_share", 0))
+    r.gauge("auron_memmgr_spills_total").set(status["num_spills"])
+    r.gauge("auron_memmgr_spilled_bytes_total").set(
+        status["spilled_bytes"])
+    for name, used in status.get("consumers", {}).items():
+        r.gauge("auron_memmgr_consumer_bytes", consumer=name).set(used)
+
+
 def observe_task(wall_s: float, snap: dict, output_rows: int = 0) -> None:
     """One finished task's observation: called by the retry driver with
     the task's metrics snapshot (gated by auron.metrics.registry)."""

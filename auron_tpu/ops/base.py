@@ -22,11 +22,17 @@ from auron_tpu.columnar.schema import Schema
 
 
 class Metric:
-    __slots__ = ("value", "_mirror")
+    __slots__ = ("value", "_mirror", "_owner")
 
-    def __init__(self, mirror: "Optional[Metric]" = None):
+    def __init__(self, mirror: "Optional[Metric]" = None,
+                 owner: "Optional[MetricsSet]" = None):
         self.value = 0
         self._mirror = mirror
+        #: the MetricsSet this counter was created by — lets a timer
+        #: wrapping one counter flush its host/device attribution
+        #: (obs/profile) into sibling counters of the same operator
+        #: without threading the set through every helper signature
+        self._owner = owner
 
     def add(self, v):
         self.value += v
@@ -56,7 +62,7 @@ class MetricsSet:
         if m is None:
             chained = (self._mirror.counter(name)
                        if self._mirror is not None else None)
-            m = self._metrics[name] = Metric(chained)
+            m = self._metrics[name] = Metric(chained, owner=self)
         return m
 
     def snapshot(self) -> dict[str, int]:
@@ -99,21 +105,41 @@ class timer:
     outputs to block on before the clock stops, so elapsed_compute means
     device compute rather than async dispatch (round-3 honest metrics;
     gate: auron.metrics.device_sync, resolved once per ExecContext and
-    passed as ``sync``)."""
+    passed as ``sync``).
 
-    __slots__ = ("metric", "t0", "_tracked", "sync")
+    When the profiler is on (``auron.profile.enabled``, obs/profile.py)
+    and the metric belongs to a MetricsSet, the scope additionally opens
+    an attribution frame: wrapped program calls record their
+    dispatch/device split into it, ``track`` marks the dispatch→device
+    boundary for kernels that bypass the program registry, and
+    ``bucket`` classifies kernel-free host sections (scan decode waits
+    → "convert", shuffle serde → "serde"). The flush lands
+    ``elapsed_device`` / ``elapsed_host_*`` counters next to this
+    metric in the same set — EXPLAIN ANALYZE's host/device columns."""
 
-    def __init__(self, metric: Metric, sync: bool = True):
+    __slots__ = ("metric", "t0", "_tracked", "sync", "_frame",
+                 "_bucket", "_t_track")
+
+    def __init__(self, metric: Metric, sync: bool = True,
+                 bucket: "Optional[str]" = None):
         self.metric = metric
         self.sync = sync
         self._tracked = None
+        self._bucket = bucket
+        self._frame = None
+        self._t_track = 0
 
     def track(self, value):
         """Register a kernel result to sync on at exit; returns it."""
         self._tracked = value
+        if self._frame is not None:
+            self._t_track = time.perf_counter_ns()
         return value
 
     def __enter__(self):
+        if self.metric._owner is not None:
+            from auron_tpu.obs import profile as _profile
+            self._frame = _profile.push_frame()
         self.t0 = time.perf_counter_ns()
         return self
 
@@ -121,7 +147,16 @@ class timer:
         if self._tracked is not None and exc[0] is None and self.sync:
             _device_sync(self._tracked)
             self._tracked = None
-        self.metric.add(time.perf_counter_ns() - self.t0)
+        wall = time.perf_counter_ns() - self.t0
+        self.metric.add(wall)
+        if self._frame is not None:
+            from auron_tpu.obs import profile as _profile
+            _profile.pop_frame(
+                self._frame, self.metric._owner, wall,
+                (self._t_track - self.t0) if self._t_track else None,
+                self._bucket)
+            self._frame = None
+            self._t_track = 0
         return False
 
 

@@ -602,7 +602,7 @@ class RssShuffleExchangeOp(PhysicalOp):
                 offsets = np.concatenate(
                     [np.zeros(1, np.int64), np.cumsum(counts_h)])
                 n = int(sorted_batch.num_rows)
-                with timer(write_time):
+                with timer(write_time, bucket="serde"):
                     host = batch_to_host(sorted_batch, n)
                     for p in range(n_out):
                         lo, hi = int(offsets[p]), int(offsets[p + 1])
@@ -678,7 +678,7 @@ class RssShuffleExchangeOp(PhysicalOp):
                     # deserialize INSIDE the timer, yield OUTSIDE it: a
                     # yield under the timer would bill the consumer's
                     # compute to shuffle_read_total_time
-                    with timer(read_time):
+                    with timer(read_time, bucket="serde"):
                         host, _ = deserialize_host_batch(frame)
                         batch = (host_to_batch(host,
                                                bucket_rows(host.num_rows))
@@ -722,7 +722,7 @@ class RssShuffleReadOp(PhysicalOp):
             for frame in self.service.partition_frames(self.shuffle_id,
                                                        partition):
                 # yield outside the timer (see RssShuffleExchangeOp)
-                with timer(read_time):
+                with timer(read_time, bucket="serde"):
                     host, _ = deserialize_host_batch(frame)
                     batch = (host_to_batch(host,
                                            bucket_rows(host.num_rows))

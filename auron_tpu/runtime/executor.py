@@ -104,9 +104,17 @@ class ExecutionRuntime:
 
     def _batches_inner(self) -> Iterator[DeviceBatch]:
         from auron_tpu import errors
+        from auron_tpu.obs import profile as _profile
         from auron_tpu.obs import trace
         from auron_tpu.ops.base import TaskCancelled
         from auron_tpu.runtime import faults
+        # drive-loop glue (cancel polls, fault checks, generator
+        # bookkeeping between batches) attributed to the ROOT plan node
+        # as the "iter" host bucket — the per-batch host tax the fused
+        # pipelines pay even when every kernel is warm
+        iter_c = (self.ctx.metrics_for(self.plan)
+                  .counter("elapsed_host_iter")
+                  if _profile.enabled() else None)
         try:
             with trace.span("task", "task.attempt",
                             stage=self.task.stage_id,
@@ -115,9 +123,13 @@ class ExecutionRuntime:
                             attempt=self.attempt):
                 for batch in self.plan.execute(self.task.partition_id,
                                                self.ctx):
+                    t0 = (time.perf_counter_ns() if iter_c is not None
+                          else 0)
                     self.ctx.check_cancelled()
                     faults.maybe_fail("device.compute",
                                       errors.DeviceExecutionError)
+                    if iter_c is not None:
+                        iter_c.add(time.perf_counter_ns() - t0)
                     yield batch
         except TaskCancelled:
             # reference behavior: task-kill is teardown, not failure
@@ -164,9 +176,17 @@ class ExecutionRuntime:
         deterministic lowering defect in the export path would retry as
         if transient."""
         from auron_tpu import errors
+        from auron_tpu.obs import profile as _profile
         schema = self.plan.schema()
+        # the device→host materialization is pure arrow↔jax conversion:
+        # attributed to the root plan node's "convert" host bucket
+        convert_c = (self.ctx.metrics_for(self.plan)
+                     .counter("elapsed_host_convert")
+                     if _profile.enabled() else None)
         for batch in self.batches():
             if int(batch.num_rows) > 0:
+                t0 = (time.perf_counter_ns() if convert_c is not None
+                      else 0)
                 try:
                     rb = to_arrow(batch, schema)
                 except NotImplementedError:
@@ -179,6 +199,8 @@ class ExecutionRuntime:
                         "partition=%d task=%d", self.task.stage_id,
                         self.task.partition_id, self.task.task_id)
                     raise errors.classify_runtime(e) from e
+                if convert_c is not None:
+                    convert_c.add(time.perf_counter_ns() - t0)
                 yield rb
 
     def collect(self) -> pa.Table:
@@ -256,9 +278,13 @@ def _observe_task(rt: "ExecutionRuntime", table: pa.Table,
     task."""
     try:
         from auron_tpu.obs import metric_tree as mt
+        from auron_tpu.obs import profile as obs_profile
         from auron_tpu.obs import registry as obs_registry
         if metric_tree is not None:
             mt.mirror(metric_tree, rt.plan, rt.ctx)
+        # per-op host/device attribution record into auron.trace.dir
+        # (profile_<trace>.jsonl — the tools/hotspot_report.py input)
+        obs_profile.export_task(rt.ctx, rt.plan)
         if obs_registry.enabled():
             # finalize(), not the raw ctx snapshot: only finalize
             # injects the recovery counters (transient_retries from the

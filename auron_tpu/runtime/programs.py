@@ -73,6 +73,7 @@ class ProgramCache:
         # so a changed value must build a FRESH function object — jax's
         # jit cache keys on function identity, making the re-trace real
         from auron_tpu import config as _cfg
+        from auron_tpu.obs import profile as _profile
         key = (key, _cfg.trace_salt())
         value = None
         hit = False
@@ -87,7 +88,11 @@ class ProgramCache:
             # per-site hit events make the compile economics visible on
             # the timeline; narrow auron.trace.events to drop them
             _trace.event("program", "program.hit", site=self.site)
-            return value, False
+            # the memo holds the RAW program (stable identity for the
+            # cache); the per-invocation host/device timing proxy wraps
+            # only what leaves the registry (obs/profile.wrap_program —
+            # a pass-through when profiling is off)
+            return _profile.wrap_program(value, self.site), False
         from auron_tpu import errors as _errors
         from auron_tpu.runtime import faults as _faults
         _faults.maybe_fail("program.build", _errors.DeviceExecutionError)
@@ -96,13 +101,14 @@ class ProgramCache:
         with self._lock:
             if key in self._memo:   # raced with another thread: keep first
                 self.hits += 1
-                return self._memo[key], False
+                return _profile.wrap_program(self._memo[key],
+                                             self.site), False
             self._memo[key] = value
             self.builds += 1
             while len(self._memo) > self.maxsize:
                 self._memo.popitem(last=False)
                 self.evictions += 1
-        return value, True
+        return _profile.wrap_program(value, self.site), True
 
     def live(self) -> int:
         with self._lock:

@@ -42,8 +42,10 @@ simulates the wedge; ``io_error`` a failing init).
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
+from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from auron_tpu import errors
@@ -52,6 +54,77 @@ logger = logging.getLogger("auron_tpu")
 
 _LOCK = threading.Lock()
 _STATS = {"probes": 0, "timeouts": 0, "fallbacks": 0}
+
+#: bump when ProbeReport.to_dict() keys change (consumers: bench.py's
+#: ``probe_report`` field, probe_report.json next to traces, and the
+#: schema-stability test in tests/test_perf_gate.py)
+PROBE_SCHEMA_VERSION = 1
+
+#: probe ladder step names, in execution order
+PROBE_STEPS = ("env", "plugin", "devices", "first_compile")
+
+
+@dataclass
+class ProbeStep:
+    """One rung of the backend probe ladder: what ran, whether it
+    passed, and — unlike the clipped ``accel_error`` blobs of
+    BENCH_r02–r05 — the FULL exception type and message when it did
+    not."""
+
+    name: str
+    ok: bool
+    detail: str = ""
+    error_type: str = ""
+    error_message: str = ""
+    elapsed_s: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "ok": self.ok, "detail": self.detail,
+                "error_type": self.error_type,
+                "error_message": self.error_message,
+                "elapsed_s": round(self.elapsed_s, 3)}
+
+
+@dataclass
+class ProbeReport:
+    """Structured outcome of the backend probe ladder
+    (env vars → plugin registration → jax.devices() → first-compile
+    smoke). ``ok`` means the ambient accelerator platform is usable end
+    to end; a failed report pinpoints WHICH rung broke and carries the
+    classified exception, so 'nothing has run on the accelerator since
+    r01' becomes an actionable diagnosis instead of a truncated
+    traceback."""
+
+    ok: bool
+    platform: str = ""
+    steps: list = field(default_factory=list)
+    schema_version: int = PROBE_SCHEMA_VERSION
+
+    def to_dict(self) -> dict:
+        return {"schema_version": self.schema_version, "ok": self.ok,
+                "platform": self.platform,
+                "steps": [s.to_dict() for s in self.steps]}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    def failed_step(self) -> Optional[ProbeStep]:
+        return next((s for s in self.steps if not s.ok), None)
+
+    def summary(self) -> str:
+        """One grep-able line: the first failing rung's
+        ``step: Type: message``, or the live platform on success."""
+        if self.ok:
+            return f"platform={self.platform}"
+        s = self.failed_step()
+        if s is None:   # pragma: no cover - ok=False implies a failure
+            return "probe failed"
+        head = f"{s.name}: "
+        if s.error_type:
+            head += f"{s.error_type}: {s.error_message}"
+        else:
+            head += s.detail or "failed"
+        return head[:300]
 
 
 def stats() -> dict:
@@ -197,6 +270,232 @@ def _fallback_to_cpu(deadline_s: float, why: str) -> None:
         raise errors.BackendInitError(
             f"watchdog CPU fallback failed after: {why} "
             f"({err if err is not None else 'cpu init timed out'})")
+
+
+# ---------------------------------------------------------------------------
+# probe ladder: the structured accelerator diagnosis (ProbeReport)
+# ---------------------------------------------------------------------------
+
+#: env vars that decide (or witness) which PJRT backend init will pick
+_PLATFORM_ENV_VARS = ("JAX_PLATFORMS", "JAX_PLATFORM_NAME", "TPU_NAME",
+                      "TPU_WORKER_ID", "TPU_SKIP_MDS_QUERY",
+                      "PJRT_DEVICE", "TPU_LIBRARY_PATH")
+
+#: ladder child: devices + first-compile smoke, each step flushed as its
+#: own line the MOMENT it finishes — a killed (timed-out) child still
+#: leaves every completed step parseable in the captured stdout
+_LADDER_CHILD = r"""
+import json, sys, time
+
+def emit(step):
+    sys.stdout.write("PROBE_STEP=" + json.dumps(step) + "\n")
+    sys.stdout.flush()
+
+def run(name, fn):
+    t0 = time.perf_counter()
+    try:
+        detail = fn()
+        emit({"name": name, "ok": True, "detail": detail,
+              "error_type": "", "error_message": "",
+              "elapsed_s": round(time.perf_counter() - t0, 3)})
+        return True
+    except BaseException as e:
+        emit({"name": name, "ok": False, "detail": "",
+              "error_type": type(e).__name__,
+              "error_message": str(e)[:500],
+              "elapsed_s": round(time.perf_counter() - t0, 3)})
+        return False
+
+state = {}
+
+def devices():
+    import jax
+    d = jax.devices()
+    state["platform"] = d[0].platform
+    return "%d x %s" % (len(d), d[0].platform)
+
+def first_compile():
+    import jax
+    import jax.numpy as jnp
+    jax.jit(lambda x: x + 1)(jnp.ones((8,), jnp.int32)
+                             ).block_until_ready()
+    return "jit smoke ok"
+
+if run("devices", devices):
+    run("first_compile", first_compile)
+sys.stdout.write("PROBE_PLATFORM=" + state.get("platform", "") + "\n")
+"""
+
+
+def _env_step() -> ProbeStep:
+    """Rung 1: which platform the environment is steering init toward.
+    Informational — it cannot fail, but its detail is the first thing a
+    human needs when rung 3 wedges."""
+    import os
+    seen = {v: os.environ[v] for v in _PLATFORM_ENV_VARS
+            if v in os.environ}
+    detail = (", ".join(f"{k}={v}" for k, v in sorted(seen.items()))
+              or "no platform env vars set (jax auto-detects)")
+    return ProbeStep("env", True, detail=detail)
+
+
+def _requested_platforms() -> list[str]:
+    import os
+    raw = os.environ.get("JAX_PLATFORMS") \
+        or os.environ.get("JAX_PLATFORM_NAME") or ""
+    return [p.strip().lower() for p in raw.split(",") if p.strip()]
+
+
+def _plugin_step() -> ProbeStep:
+    """Rung 2: PJRT plugin registration WITHOUT initializing anything —
+    entry points in the ``jax_plugins`` group plus the namespace-package
+    modules. Fails only when the env explicitly requests a non-CPU
+    platform that no installed plugin can provide (the
+    'plugin never installed' failure mode, distinguishable from the
+    'plugin wedges at init' one rung 3 catches)."""
+    plugins = []
+    try:
+        from importlib import metadata
+        plugins.extend(ep.name for ep in
+                       metadata.entry_points(group="jax_plugins"))
+    except Exception:   # pragma: no cover - importlib API drift
+        pass
+    try:
+        import pkgutil
+
+        import jax_plugins   # namespace package
+        plugins.extend(
+            m.name for m in pkgutil.iter_modules(jax_plugins.__path__))
+    except Exception:
+        pass
+    plugins = sorted(set(plugins))
+    detail = ("registered PJRT plugins: " + ", ".join(plugins)
+              if plugins else "no PJRT plugin entry points registered")
+    requested = [p for p in _requested_platforms() if p != "cpu"]
+    if requested and not plugins:
+        return ProbeStep(
+            "plugin", False, detail=detail,
+            error_type="PluginNotRegistered",
+            error_message=(f"JAX_PLATFORMS requests {requested} but no "
+                           f"PJRT plugin is registered"))
+    return ProbeStep("plugin", True, detail=detail)
+
+
+def _parse_ladder_stdout(stdout: str) -> tuple[list[ProbeStep], str]:
+    steps, platform = [], ""
+    for line in (stdout or "").splitlines():
+        if line.startswith("PROBE_STEP="):
+            try:
+                d = json.loads(line[len("PROBE_STEP="):])
+                steps.append(ProbeStep(**d))
+            except Exception:   # pragma: no cover - malformed line
+                pass
+        elif line.startswith("PROBE_PLATFORM="):
+            platform = line[len("PROBE_PLATFORM="):].strip()
+    return steps, platform
+
+
+def run_probe_ladder(deadline_s: float = 60.0) -> ProbeReport:
+    """The full backend diagnosis: env vars → plugin registration →
+    ``jax.devices()`` → first-compile smoke. Rungs 3–4 run in ONE
+    sacrificial child under ``deadline_s`` (init wedges with — and is
+    killed with — the child; each completed step is flushed before the
+    next starts, so a timeout still reports how far init got). Never
+    raises; never touches jax in THIS process."""
+    import os
+    import subprocess
+    import sys
+    import time as _time
+
+    steps = [_env_step(), _plugin_step()]
+    t0 = _time.perf_counter()
+    timed_out = False
+    stdout = ""
+    stderr = ""
+    returncode = 0
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _LADDER_CHILD],
+            capture_output=True, text=True, timeout=deadline_s,
+            env=dict(os.environ))
+        stdout = proc.stdout or ""
+        stderr = proc.stderr or ""
+        returncode = proc.returncode
+    except subprocess.TimeoutExpired as e:
+        timed_out = True
+        out = e.stdout
+        stdout = (out.decode(errors="replace")
+                  if isinstance(out, bytes) else (out or ""))
+    except Exception as e:   # pragma: no cover - spawn failure
+        steps.append(ProbeStep(
+            "devices", False, error_type=type(e).__name__,
+            error_message=f"probe child spawn failed: {e}"[:500],
+            elapsed_s=_time.perf_counter() - t0))
+        return ProbeReport(ok=False, steps=steps)
+    child_steps, platform = _parse_ladder_stdout(stdout)
+    steps.extend(child_steps)
+    reported = {s.name for s in child_steps}
+    if timed_out:
+        # whichever rung never reported is the one that wedged
+        stuck = ("devices" if "devices" not in reported
+                 else "first_compile")
+        steps.append(ProbeStep(
+            stuck, False, error_type="TimeoutError",
+            error_message=(f"{stuck} probe exceeded the "
+                           f"{deadline_s:.0f}s deadline "
+                           f"(child killed — the wedged-init signature, "
+                           f"VERDICT r5)"),
+            elapsed_s=_time.perf_counter() - t0))
+    elif returncode != 0 or "first_compile" not in reported:
+        # a hard child crash (SIGSEGV/abort in native plugin code is not
+        # catchable by the harness' except) can land AFTER a rung already
+        # flushed ok — every unreported rung is then a failure, and the
+        # step output alone must never prove health without the child's
+        # clean exit (a rung that DID report a failure keeps its own
+        # richer record instead of a synthetic one)
+        missing = [name for name in ("devices", "first_compile")
+                   if name not in reported]
+        child_failed = any(not s.ok for s in child_steps)
+        if missing and not child_failed:
+            tail = " | ".join(stderr.strip().splitlines()[-3:])
+            sig = (f"probe child died rc={returncode} during the "
+                   f"{missing[0]} rung (native crash is the "
+                   f"wedged-plugin signature)")
+            steps.append(ProbeStep(
+                missing[0], False, error_type="ChildCrashed",
+                error_message=(f"{sig}: {tail}" if tail else sig)[:500],
+                elapsed_s=_time.perf_counter() - t0))
+    ok = all(s.ok for s in steps) and not timed_out \
+        and returncode == 0 and "first_compile" in reported
+    return ProbeReport(ok=ok, platform=platform, steps=steps)
+
+
+def write_report(report: ProbeReport,
+                 dir_path: Optional[str] = None) -> Optional[str]:
+    """Persist a ProbeReport as ``probe_report.json`` next to the traces
+    (``auron.trace.dir`` unless ``dir_path`` overrides); returns the
+    path, or None when no directory is configured. Best-effort — a
+    diagnosis must never become a failure of its own."""
+    import os
+    if dir_path is None:
+        try:
+            from auron_tpu import config as cfg
+            dir_path = cfg.get_config().get(cfg.TRACE_DIR)
+        except Exception:   # pragma: no cover
+            dir_path = ""
+    if not dir_path:
+        return None
+    try:
+        os.makedirs(dir_path, exist_ok=True)
+        path = os.path.join(dir_path, "probe_report.json")
+        tmp = path + ".part"
+        with open(tmp, "w") as f:
+            f.write(report.to_json() + "\n")
+        os.replace(tmp, path)
+        return path
+    except Exception:   # pragma: no cover - best-effort sink
+        logger.exception("probe report write to %r failed", dir_path)
+        return None
 
 
 def ensure_backend(config=None) -> Optional[str]:

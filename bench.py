@@ -145,20 +145,31 @@ def bench_device_hash(batch) -> float:
                          batch)
 
 
-#: trace-overhead A/B sizing defaults (the measured configuration);
-#: the env overrides are read at CALL time so tests can monkeypatch
-#: without reloading the module
+#: observability-overhead A/B sizing defaults (the measured
+#: configuration); the env overrides are read at CALL time so tests can
+#: monkeypatch without reloading the module. Reps dropped 8 → 6 when the
+#: third arm landed (PR 6): 3 arms × 6 reps costs what 2 × 8 + warmup
+#: did, and the per-query-min estimator converges by ~5 reps (the A/A
+#: methodology note in PERF.md).
 _TRACE_BENCH_SCALE = 0.01
-_TRACE_BENCH_REPS = 8
+_TRACE_BENCH_REPS = 6
 _TRACE_BENCH_QUERIES = "q3,q42,q52"
 
 
 def bench_trace_overhead() -> dict:
-    """Additive A/B: a TPC-DS subset with tracing OFF vs ON
-    (auron.trace.enabled), same process, compiles warmed first so the
-    delta is the tracing plane's recording cost, not compile noise.
-    The observability contract is measured, not assumed: the gate is
-    trace_overhead_pct < 2 (PERF.md 'Tracing & metric tree')."""
+    """Additive three-arm A/B on a TPC-DS subset, same process, compiles
+    warmed first so the deltas are recording cost, not compile noise:
+
+    - base — tracing OFF, profiler ON (the shipping defaults);
+    - trace — tracing ON, profiler ON: ``trace_overhead_pct`` is
+      (trace − base)/base (the PR 5 <2% gate, PERF.md);
+    - noprof — tracing OFF, profiler OFF:  ``profile_overhead_pct`` is
+      (base − noprof)/noprof — what the host/device attribution plane
+      (obs/profile.py) costs with everything else unchanged (the PR 6
+      <2% gate; the disabled path must be near-zero BY this same
+      measurement read the other way).
+
+    Both observability contracts are measured, not assumed."""
     import tempfile
 
     from auron_tpu import config as cfg
@@ -188,8 +199,8 @@ def bench_trace_overhead() -> dict:
 
     # warm every compile site AND the host caches: the suite keeps
     # speeding up for a couple of repetitions, so the arms must
-    # INTERLEAVE (off, on, off, on, ...) — back-to-back blocks would
-    # attribute the warm-up drift to whichever arm ran first. The
+    # INTERLEAVE (base, trace, noprof, base, ...) — back-to-back blocks
+    # would attribute the warm-up drift to whichever arm ran first. The
     # estimator is the sum of PER-QUERY minima per arm: container
     # timing noise is additive and positive (scheduler stalls inflate a
     # rep, nothing deflates one), so each query's min converges on its
@@ -197,16 +208,21 @@ def bench_trace_overhead() -> dict:
     # stall hits one query, not the whole suite, so a suite-level min
     # almost never runs every query clean at once (measured A/A bias:
     # suite-min 4.3%, per-query-min 0.1% on this container, whose
-    # single-rep deltas of ±10-50% dwarf the <2% gate).
-    off_min = {q.name: float("inf") for q in subset}
-    on_min = {q.name: float("inf") for q in subset}
+    # single-rep deltas of ±10-50% dwarf the <2% gates).
+    arms = {
+        "base": {cfg.TRACE_ENABLED: False, cfg.PROFILE_ENABLED: True},
+        "trace": {cfg.TRACE_ENABLED: True, cfg.PROFILE_ENABLED: True},
+        "noprof": {cfg.TRACE_ENABLED: False,
+                   cfg.PROFILE_ENABLED: False},
+    }
+    mins = {arm: {q.name: float("inf") for q in subset} for arm in arms}
 
-    def accrue(mins: dict) -> None:
+    def accrue(arm: str) -> None:
         for q in subset:
             t0 = time.perf_counter()
             q.run(Session(), tables)
-            mins[q.name] = min(mins[q.name],
-                               time.perf_counter() - t0)
+            mins[arm][q.name] = min(mins[arm][q.name],
+                                    time.perf_counter() - t0)
 
     try:
         # explicit pins, not unset: unset falls back to ambient
@@ -218,28 +234,74 @@ def bench_trace_overhead() -> dict:
         run_suite()
         run_suite()
         for _ in range(reps):
-            conf.set(cfg.TRACE_ENABLED, False)
-            accrue(off_min)
-            conf.set(cfg.TRACE_ENABLED, True)
-            accrue(on_min)
+            for arm, knobs in arms.items():
+                for key, val in knobs.items():
+                    conf.set(key, val)
+                accrue(arm)
         traced_spans = len(trace.tracer().spans())
     finally:
         conf.unset(cfg.TRACE_ENABLED)
+        conf.unset(cfg.PROFILE_ENABLED)
         conf.unset(cfg.TRACE_DIR)
         conf.unset(cfg.TRACE_EVENTS)
         trace.reset()
         shutil.rmtree(data, ignore_errors=True)
-    off_s, on_s = sum(off_min.values()), sum(on_min.values())
-    pct = (on_s - off_s) / off_s * 100.0
+    base_s = sum(mins["base"].values())
+    on_s = sum(mins["trace"].values())
+    noprof_s = sum(mins["noprof"].values())
     return {
-        "trace_overhead_pct": round(pct, 2),
+        "trace_overhead_pct": round((on_s - base_s) / base_s * 100.0, 2),
         "trace_overhead_gate_pct": 2.0,
+        "profile_overhead_pct": round(
+            (base_s - noprof_s) / noprof_s * 100.0, 2),
+        "profile_overhead_gate_pct": 2.0,
         "trace_ab_queries": names,
         "trace_ab_scale": scale,
-        "trace_ab_off_s": round(off_s, 3),
+        "trace_ab_off_s": round(base_s, 3),
         "trace_ab_on_s": round(on_s, 3),
+        "trace_ab_noprofile_s": round(noprof_s, 3),
         "trace_ab_spans": traced_spans,
     }
+
+
+def bench_profile_q01() -> dict:
+    """Machine-readable host/device profile of the q01 OPERATOR pipeline
+    (it/queries.py q01_filter_agg — the plan-shaped twin of the flagship
+    kernel the headline metric times): one profiled explain-analyze run,
+    rolled up by obs/profile.summarize_tree. This is the bench record's
+    attribution section — tools/perf_gate.py carries it through so a
+    rows/s regression arrives WITH the category split that explains it."""
+    import tempfile
+
+    from auron_tpu import config as cfg
+    from auron_tpu.frontend.session import Session
+    from auron_tpu.it.tpcds_data import generate as gen_data
+    from auron_tpu.obs import metric_tree as mt
+    from auron_tpu.obs import profile as obs_profile
+
+    scale = float(os.environ.get("AURON_BENCH_PROFILE_SCALE", "0.1"))
+    data = tempfile.mkdtemp(prefix="auron_profile_q01_")
+    conf = cfg.get_config()
+    try:
+        tables = gen_data(data, scale=scale)
+        conf.set(cfg.PROFILE_ENABLED, True)
+        from auron_tpu.it.queries import q01_dataframe
+        q01_dataframe(Session(), tables).collect()   # warm compiles
+        s = Session()
+        df = q01_dataframe(s, tables)
+        t0 = time.perf_counter()
+        op = s.plan_physical(df)
+        tree, _tbl = mt.explain_analyze(
+            op, num_partitions=df.num_partitions,
+            mem_manager=s.mem_manager, config=s.config)
+        wall_s = time.perf_counter() - t0
+        summary = obs_profile.summarize_tree(tree)
+        summary["wall_s"] = round(wall_s, 3)
+        summary["scale"] = scale
+        return summary
+    finally:
+        conf.unset(cfg.PROFILE_ENABLED)
+        shutil.rmtree(data, ignore_errors=True)
 
 
 def bench_cpu_reference(threads: int = 1) -> float:
@@ -374,13 +436,20 @@ def _child_main() -> None:
         except Exception as e:   # additive: never lose the dense datum
             result["pallas_agg_error"] = str(e)[:300]
     try:
-        # tracing-plane overhead A/B on the TPC-DS subset (additive —
-        # never lose the earlier data; the <2% gate lives in PERF.md)
+        # tracing + profiler overhead A/B on the TPC-DS subset (additive
+        # — never lose the earlier data; the <2% gates live in PERF.md)
         result.update(bench_trace_overhead())
         if platform != "cpu":
             _snapshot_partial(result)
     except Exception as e:   # additive: never lose the earlier data
         result["trace_overhead_error"] = str(e)[:300]
+    try:
+        # machine-readable host/device attribution of the q01 operator
+        # pipeline (tools/perf_gate.py records it next to the rows/s
+        # verdict so a regression arrives with its category split)
+        result["profile"] = bench_profile_q01()
+    except Exception as e:   # additive: never lose the earlier data
+        result["profile_error"] = str(e)[:300]
     # set when this child is the CPU fallback after an accelerator
     # failure (probe or bench): keeps environmental failures
     # distinguishable from perf regressions in the recorded line
@@ -395,61 +464,74 @@ def _child_main() -> None:
 # parent: backend health probe + dispatch
 # ---------------------------------------------------------------------------
 
-def _condense_error(text: str) -> str:
+#: frame locations kept by _condense_error (innermost last)
+_CONDENSE_FRAMES = 2
+
+
+def _condense_error(text: str, frames: int = _CONDENSE_FRAMES) -> str:
     """Reduce a (possibly truncated, multi-line) child stderr — a python
     traceback or a faulthandler watchdog stack dump — to ONE grep-able
-    line: the terminal exception plus the innermost frame location. The
-    recorded ``accel_error`` JSON field stays a single canonical line
-    instead of an embedded multi-line traceback."""
+    line that LEADS with the exception ``Type: message`` (continuation
+    lines of a multi-line message joined in) and then carries the last
+    ``frames`` frame locations. The r02–r05 regression this fixes: the
+    old condenser kept only a frame location, so every recorded
+    ``accel_error`` was a message-less ``[at rt.py:123]`` stub nobody
+    could act on."""
     import re
     lines = [ln.strip() for ln in (text or "").strip().splitlines()
              if ln.strip()]
     if not lines:
         return ""
-    exc = next((ln for ln in reversed(lines)
-                if re.match(r"[A-Za-z_][\w.]*(Error|Exception|Interrupt"
-                            r"|Exit)\b", ln)
-                or ln.startswith("Fatal Python error")), None)
-    frames = [ln for ln in lines if ln.startswith('File "')]
-    loc = ""
-    if frames:
-        # faulthandler dumps are most-recent-call-FIRST, tracebacks
-        # most-recent-call-LAST; the truncated tail keeps the frame
-        # nearest the fault in both cases at opposite ends — prefer the
-        # last frame (traceback order), which r05-style dumps also end on
-        m = re.match(r'File "([^"]+)", line (\d+)(?:,? in (.+))?',
-                     frames[-1])
+    exc_re = re.compile(
+        r"([A-Za-z_][\w.]*(?:Error|Exception|Interrupt|Exit))\b:?\s*(.*)")
+    exc = None
+    for i in range(len(lines) - 1, -1, -1):
+        if lines[i].startswith("Fatal Python error"):
+            exc = lines[i]
+            break
+        m = exc_re.match(lines[i])
+        if m:
+            # join message continuation lines (a wrapped/multi-line
+            # message follows the `Type: head` line until the next
+            # structural traceback line)
+            parts = [m.group(2).strip()]
+            for cont in lines[i + 1:i + 4]:
+                if cont.startswith(('File "', "Traceback",
+                                    "Current thread", "Thread ",
+                                    "The above exception")):
+                    break
+                parts.append(cont)
+            msg = " ".join(p for p in parts if p)
+            exc = f"{m.group(1)}: {msg}" if msg else m.group(1)
+            break
+    frame_lines = [ln for ln in lines if ln.startswith('File "')]
+    locs = []
+    # tracebacks are most-recent-call-LAST (r05-style faulthandler dumps
+    # also end on the faulting frame), so the tail frames are the ones
+    # nearest the fault; rendered innermost-first after "at"
+    for fl in frame_lines[-max(frames, 1):]:
+        m = re.match(r'File "([^"]+)", line (\d+)(?:,? in (.+))?', fl)
         if m:
             loc = f"{os.path.basename(m.group(1))}:{m.group(2)}"
             if m.group(3):
                 loc += f" in {m.group(3).strip()}"
+            locs.append(loc)
     if exc is None:
-        exc = lines[-1] if not loc else "backend init failed (stack dump)"
-    return (f"{exc} [at {loc}]" if loc else exc)[:300]
+        exc = ("backend init failed (stack dump)" if locs else lines[-1])
+    if locs:
+        exc += " [at " + " < ".join(reversed(locs)) + "]"
+    return exc[:300]
 
 
-def _probe_accelerator() -> tuple[bool, str]:
-    """Initialize jax in a throwaway subprocess under the AMBIENT env.
-    Returns (ok, platform-or-error). A wedged accelerator client hangs at
-    init, so the probe carries its own watchdog + hard timeout."""
-    from auron_tpu.utils.envsafe import watchdogged_child_code
-
-    code, _ = watchdogged_child_code(
-        "import jax\n"
-        "d = jax.devices()\n"
-        "print('PLATFORM=' + d[0].platform)",
-        _PROBE_TIMEOUT_S, margin_s=10)
-    try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, text=True,
-                              timeout=_PROBE_TIMEOUT_S,
-                              cwd=os.path.dirname(os.path.abspath(__file__)))
-    except subprocess.TimeoutExpired:
-        return False, f"backend init exceeded {_PROBE_TIMEOUT_S}s (hung client)"
-    for line in proc.stdout.splitlines():
-        if line.startswith("PLATFORM="):
-            return True, line.split("=", 1)[1]
-    return False, _condense_error(proc.stderr) or "backend init failed"
+def _probe_accelerator():
+    """Diagnose the ambient accelerator with the watchdog's structured
+    probe ladder (env vars → plugin registration → jax.devices() →
+    first-compile smoke), each rung in a sacrificial child with a hard
+    deadline — a wedged client hangs, and is killed, with the child.
+    Returns the ProbeReport; ``report.ok`` gates the accelerator bench
+    arm and ``report.summary()`` is the one-line ``accel_error``."""
+    from auron_tpu.runtime import watchdog
+    return watchdog.run_probe_ladder(_PROBE_TIMEOUT_S)
 
 
 def _run_bench_child(env: dict) -> subprocess.CompletedProcess:
@@ -470,13 +552,23 @@ def main() -> None:
 
     accel_error = ""
     accel_ok = False
+    probe_report = None
     for attempt in range(_PROBE_ATTEMPTS):
-        accel_ok, info = _probe_accelerator()
+        probe_report = _probe_accelerator()
+        accel_ok = probe_report.ok
         if accel_ok:
             break
-        accel_error = info
+        accel_error = probe_report.summary()
         if attempt + 1 < _PROBE_ATTEMPTS:
             time.sleep(_PROBE_BACKOFF_S)
+    if probe_report is not None:
+        # persist the structured diagnosis next to the traces (when
+        # auron.trace.dir is configured) — best-effort, never fatal
+        try:
+            from auron_tpu.runtime import watchdog
+            watchdog.write_report(probe_report)
+        except Exception:
+            pass
 
     def try_child(env):
         try:
@@ -510,11 +602,26 @@ def main() -> None:
 
     if proc is not None:
         sys.stderr.write(proc.stderr)
-        print(proc.stdout.strip().splitlines()[-1])
+        line = proc.stdout.strip().splitlines()[-1]
+        # attach the structured backend diagnosis to the child's record:
+        # the probe_report (exception TYPE + MESSAGE per ladder rung)
+        # replaces log archaeology over the truncated accel_error blobs
+        # of BENCH_r02–r05. Best-effort: a non-JSON line passes through.
+        if probe_report is not None:
+            try:
+                rec = json.loads(line)
+                rec["probe_report"] = probe_report.to_dict()
+                line = json.dumps(rec)
+            except Exception:
+                pass
+        print(line)
         return
 
     print(json.dumps({"metric": _METRIC, "error": failure,
-                      "accel_error": accel_error[:500] or None}))
+                      "accel_error": accel_error[:500] or None,
+                      "probe_report": (probe_report.to_dict()
+                                       if probe_report is not None
+                                       else None)}))
     sys.exit(1)
 
 

@@ -61,6 +61,59 @@ def test_bench_survives_hostile_sitecustomize(tmp_path):
     assert rec.get("accel_error"), "environmental failure must be recorded"
 
 
+class TestCondenseError:
+    """_condense_error must LEAD with the exception type + message (the
+    r02–r05 records kept only truncated frame lines) and then carry the
+    innermost frame locations."""
+
+    def _deep_traceback(self, depth=30, msg="deep boom"):
+        lines = ["Traceback (most recent call last):"]
+        for i in range(depth):
+            lines.append(f'  File "/app/m{i}.py", line {i}, in fn{i}')
+            lines.append("    call()")
+        lines.append(f"ValueError: {msg}")
+        return "\n".join(lines)
+
+    def test_leads_with_type_and_message(self):
+        import bench
+        out = bench._condense_error(self._deep_traceback())
+        assert out.startswith("ValueError: deep boom"), out
+        # last N frames, innermost first
+        assert "m29.py:29 in fn29" in out
+        assert "m28.py:28 in fn28" in out
+        assert len(out) <= 300
+
+    def test_multiline_message_joined(self):
+        import bench
+        tb = ('Traceback (most recent call last):\n'
+              '  File "/x/rt.py", line 9, in go\n'
+              '    boom()\n'
+              'RuntimeError: tunnel client wedged:\n'
+              'channel reset by peer (axon)\n')
+        out = bench._condense_error(tb)
+        assert out.startswith(
+            "RuntimeError: tunnel client wedged: channel reset by peer "
+            "(axon)"), out
+        assert "rt.py:9 in go" in out
+
+    def test_truncated_dump_keeps_frames(self):
+        """An r05-style clipped faulthandler dump with no terminal
+        exception line still reports the frames instead of nothing."""
+        import bench
+        trunc = ('  File "/venv/jax/_src/xla_bridge.py", line 824 in backends\n'
+                 '  File "/root/.axon_site/axon/register/__init__.py", '
+                 'line 619 in _axon_get_backend_uncached')
+        out = bench._condense_error(trunc)
+        assert "backend init failed" in out
+        assert "__init__.py:619" in out
+        assert "xla_bridge.py:824" in out
+
+    def test_empty_input(self):
+        import bench
+        assert bench._condense_error("") == ""
+        assert bench._condense_error("   \n  ") == ""
+
+
 def test_bench_error_record_is_parseable(tmp_path):
     """When even the CPU fallback cannot run (a dependency unimportable),
     the output must still be one JSON line with an ``error`` key.

@@ -173,6 +173,82 @@ class TestMemManager:
         mm.update_mem_used(c, 42)
         st = mm.status()
         assert st["used"] == 42 and st["consumers"] == {"x": 42}
+        assert st["fair_share"] == 100
+
+
+class TestMemmgrTelemetry:
+    """PR 6: every accounting decision mirrors onto registry gauges and
+    the span timeline (the memmgr tier-telemetry half of the forensics
+    plane)."""
+
+    def test_gauges_in_prometheus_exposition(self):
+        from auron_tpu.obs import registry as obs_registry
+        reg = obs_registry.get_registry()
+        mm = MemManager(total_bytes=1000, min_trigger=0)
+        a, b = _FakeConsumer("sort"), _FakeConsumer("agg")
+        mm.register_consumer(a)
+        mm.register_consumer(b)
+        a.used = 300
+        mm.update_mem_used(a, 300)
+        b.used = 900
+        mm.update_mem_used(b, 900)     # over budget → spill
+        text = reg.render_prometheus()
+        assert "# TYPE auron_memmgr_used_bytes gauge" in text
+        assert "auron_memmgr_budget_bytes 1000" in text
+        assert "auron_memmgr_fair_share_bytes 500" in text
+        assert "auron_memmgr_spills_total 1" in text
+        # per-consumer gauges carry the consumer label
+        assert 'auron_memmgr_consumer_bytes{consumer="sort"}' in text
+        assert 'auron_memmgr_consumer_bytes{consumer="agg"}' in text
+        # the snapshot view agrees with the spill accounting
+        snap = reg.snapshot()
+        assert snap["auron_memmgr_spilled_bytes_total"] > 0
+
+    def test_gauges_gated_by_registry_knob(self):
+        from auron_tpu import config as cfg
+        from auron_tpu.obs import registry as obs_registry
+        g = cfg.get_config()
+        g.set(cfg.METRICS_REGISTRY, False)
+        try:
+            before = obs_registry.get_registry().snapshot().get(
+                "auron_memmgr_used_bytes")
+            mm = MemManager(total_bytes=50, min_trigger=0)
+            c = _FakeConsumer("gated")
+            mm.register_consumer(c)
+            mm.update_mem_used(c, 7)
+            after = obs_registry.get_registry().snapshot().get(
+                "auron_memmgr_used_bytes")
+            assert after == before      # no update happened
+        finally:
+            g.unset(cfg.METRICS_REGISTRY)
+
+    def test_grant_deny_spill_on_timeline(self):
+        from auron_tpu import config as cfg
+        from auron_tpu.obs import trace
+        g = cfg.get_config()
+        g.set(cfg.TRACE_ENABLED, True)
+        g.set(cfg.TRACE_EVENTS, "memory")
+        try:
+            trace.reset()
+            mm = MemManager(total_bytes=1000, min_trigger=0)
+            c = _FakeConsumer("w")
+            mm.register_consumer(c)
+            mm.update_mem_used(c, 100)          # grant
+            mm.update_mem_used(c, 1500)         # spill
+            # deny: over budget but the only consumer refuses to free
+            refuser = _FakeConsumer("stuck")
+            refuser.spill = lambda: 0
+            mm2 = MemManager(total_bytes=10, min_trigger=0)
+            mm2.register_consumer(refuser)
+            mm2.update_mem_used(refuser, 50)
+            names = [s.name for s in trace.tracer().spans()]
+        finally:
+            g.unset(cfg.TRACE_ENABLED)
+            g.unset(cfg.TRACE_EVENTS)
+            trace.reset()
+        assert "memmgr.grant" in names
+        assert "memmgr.spill" in names
+        assert "memmgr.deny" in names
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,251 @@
+"""Perf-forensics plane contracts (PR 6):
+
+- tools/perf_gate.py pass/fail/unusable mechanics on synthetic bench
+  records + the checked-in baseline's shape;
+- ProbeReport JSON schema stability (bench records and
+  probe_report.json are parsed by the driver across rounds — key drift
+  is a silent consumer break);
+- tools/hotspot_report.py aggregation/ranking mechanics.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import perf_gate  # noqa: E402  (tools/ is not a package)
+
+
+def _baseline():
+    return perf_gate.load_baseline(
+        os.path.join(_REPO, "tools", "perf_baseline.json"))
+
+
+class TestPerfGate:
+    def test_baseline_shape(self):
+        base = _baseline()
+        assert base["metric"] == "q01_pipeline_rows_per_sec_per_chip"
+        assert "cpu" in base["platforms"]
+        assert "tpu" in base["platforms"]
+        assert base["platforms"]["cpu"]["rows_per_sec"] > 0
+        # the axon platform name must resolve to the tpu baseline
+        assert base["platform_aliases"]["axon"] == "tpu"
+
+    def test_pass_at_head_level(self):
+        base = _baseline()
+        rec = {"value": base["platforms"]["cpu"]["rows_per_sec"] * 1.2,
+               "platform": "cpu"}
+        v = perf_gate.evaluate(rec, base, tolerance_pct=50.0)
+        assert v["perf_gate"] == "pass"
+        assert v["floor_rows_per_sec"] < v["value_rows_per_sec"]
+
+    def test_fail_on_simulated_q01_regression(self):
+        """The r03→r05 trajectory (−61%) must fail the default
+        tolerance."""
+        base = _baseline()
+        cpu = base["platforms"]["cpu"]["rows_per_sec"]
+        rec = {"value": cpu * 0.39, "platform": "cpu"}
+        v = perf_gate.evaluate(rec, base,
+                               tolerance_pct=base["default_tolerance_pct"])
+        assert v["perf_gate"] == "fail"
+        assert v["delta_vs_baseline_pct"] < -50
+
+    def test_tolerance_boundary(self):
+        base = _baseline()
+        cpu = base["platforms"]["cpu"]["rows_per_sec"]
+        at_floor = {"value": cpu * 0.5, "platform": "cpu"}
+        just_below = {"value": cpu * 0.5 - 1, "platform": "cpu"}
+        assert perf_gate.evaluate(at_floor, base, 50.0)["perf_gate"] \
+            == "pass"
+        assert perf_gate.evaluate(just_below, base, 50.0)["perf_gate"] \
+            == "fail"
+
+    def test_unusable_records(self):
+        base = _baseline()
+        assert perf_gate.evaluate({"error": "boom"}, base, 50.0)[
+            "perf_gate"] == "unusable"
+        assert perf_gate.evaluate({"value": 1.0, "platform": "quantum"},
+                                  base, 50.0)["perf_gate"] == "unusable"
+
+    def test_alias_resolves_axon_to_tpu(self):
+        base = _baseline()
+        tpu = base["platforms"]["tpu"]["rows_per_sec"]
+        v = perf_gate.evaluate({"value": tpu, "platform": "axon"}, base,
+                               50.0)
+        assert v["perf_gate"] == "pass"
+
+    def test_probe_report_carried_into_verdict(self):
+        base = _baseline()
+        rec = {"value": 1.0, "platform": "cpu",
+               "probe_report": {"ok": False, "steps": [
+                   {"name": "devices", "ok": False,
+                    "error_type": "TimeoutError",
+                    "error_message": "init exceeded 90s"}]}}
+        v = perf_gate.evaluate(rec, base, 50.0)
+        assert v["probe_ok"] is False
+        assert v["probe_failed_step"] == "devices"
+        assert "TimeoutError" in v["probe_error"]
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        base = _baseline()
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(
+            {"value": base["platforms"]["cpu"]["rows_per_sec"],
+             "platform": "cpu"}))
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"value": 1.0, "platform": "cpu"}))
+        assert perf_gate.main(["--bench-json", str(good)]) == 0
+        assert perf_gate.main(["--bench-json", str(bad)]) == 1
+        err = tmp_path / "err.json"
+        err.write_text(json.dumps({"error": "no measurement"}))
+        assert perf_gate.main(["--bench-json", str(err)]) == 2
+        out = capsys.readouterr().out
+        # every run ends with a parseable JSON line (driver contract)
+        for block in out.strip().split("\n"):
+            pass
+        last = out.strip().splitlines()[-1]
+        assert json.loads(last)["perf_gate"] == "unusable"
+
+
+class TestProbeReportSchema:
+    """The JSON shape is a cross-round contract: bench records embed it
+    and probe_report.json sits next to traces."""
+
+    EXPECTED_TOP = {"schema_version", "ok", "platform", "steps"}
+    EXPECTED_STEP = {"name", "ok", "detail", "error_type",
+                     "error_message", "elapsed_s"}
+
+    def test_schema_keys_stable(self):
+        from auron_tpu.runtime import watchdog
+        rep = watchdog.ProbeReport(
+            ok=False, platform="",
+            steps=[watchdog.ProbeStep("devices", False,
+                                      error_type="RuntimeError",
+                                      error_message="boom")])
+        d = rep.to_dict()
+        assert set(d) == self.EXPECTED_TOP
+        assert d["schema_version"] == watchdog.PROBE_SCHEMA_VERSION == 1
+        assert set(d["steps"][0]) == self.EXPECTED_STEP
+        # round-trips through json
+        assert json.loads(rep.to_json()) == d
+
+    def test_summary_leads_with_type_and_message(self):
+        from auron_tpu.runtime import watchdog
+        rep = watchdog.ProbeReport(
+            ok=False,
+            steps=[watchdog.ProbeStep("env", True, detail="x"),
+                   watchdog.ProbeStep(
+                       "devices", False, error_type="TimeoutError",
+                       error_message="init exceeded 90s deadline")])
+        assert rep.summary() == \
+            "devices: TimeoutError: init exceeded 90s deadline"
+        ok = watchdog.ProbeReport(ok=True, platform="cpu", steps=[])
+        assert ok.summary() == "platform=cpu"
+
+    def test_ladder_on_cpu(self):
+        """Real ladder run on the ambient CPU platform: all four rungs
+        present, ordered, ok (tier-1 pins JAX_PLATFORMS=cpu)."""
+        from auron_tpu.runtime import watchdog
+        rep = watchdog.run_probe_ladder(deadline_s=120)
+        names = [s.name for s in rep.steps]
+        assert names == list(watchdog.PROBE_STEPS)
+        assert rep.ok, rep.to_json()
+        assert rep.platform == "cpu"
+
+    def test_child_crash_after_flushed_rung_is_not_ok(self, monkeypatch):
+        """A native crash (SIGSEGV in plugin code — uncatchable by the
+        child harness) can land AFTER the devices rung already flushed
+        ok. The report must not diagnose that backend as healthy."""
+        import subprocess as sp
+
+        from auron_tpu.runtime import watchdog
+
+        real_run = sp.run
+
+        def fake_run(args, **kw):
+            class P:
+                returncode = -11   # killed by SIGSEGV
+                stdout = ('PROBE_STEP={"name": "devices", "ok": true, '
+                          '"detail": "1 x tpu", "error_type": "", '
+                          '"error_message": "", "elapsed_s": 1.0}\n')
+                stderr = "Fatal Python error: Segmentation fault"
+            return P()
+
+        monkeypatch.setattr(sp, "run", fake_run)
+        try:
+            rep = watchdog.run_probe_ladder(deadline_s=5)
+        finally:
+            monkeypatch.setattr(sp, "run", real_run)
+        assert not rep.ok, rep.to_json()
+        crashed = rep.failed_step()
+        assert crashed.name == "first_compile"
+        assert crashed.error_type == "ChildCrashed"
+        assert "rc=-11" in crashed.error_message
+
+    def test_write_report(self, tmp_path):
+        from auron_tpu.runtime import watchdog
+        rep = watchdog.ProbeReport(ok=True, platform="cpu", steps=[])
+        path = watchdog.write_report(rep, str(tmp_path))
+        assert path and os.path.exists(path)
+        with open(path) as f:
+            assert json.loads(f.read())["ok"] is True
+        # no directory configured → no write, no failure
+        assert watchdog.write_report(rep, "") is None
+
+
+class TestHotspotReport:
+    _MS = 1_000_000   # ns per ms (records carry nanosecond counters)
+
+    def _records(self):
+        ms = self._MS
+        mk = lambda op, **m: {"task": 0, "stage": 0, "partition": 0,
+                              "op": op, "repr": op, "metrics": m}
+        return [
+            mk("agg", elapsed_compute=100 * ms, elapsed_device=10 * ms,
+               elapsed_host_dispatch=80 * ms,
+               elapsed_host_other=10 * ms),
+            mk("agg", elapsed_compute=50 * ms, elapsed_device=5 * ms,
+               elapsed_host_dispatch=40 * ms),
+            mk("parquet_scan", elapsed_compute=30 * ms,
+               elapsed_host_convert=200 * ms),
+            mk("shuffle_exchange", elapsed_host_serde=60 * ms,
+               elapsed_device=1 * ms),
+        ]
+
+    def test_aggregate_and_rank(self):
+        import hotspot_report as hr
+        ms = self._MS
+        agg = hr.aggregate(self._records())
+        assert agg["by_cat"]["dispatch"] == 120 * ms
+        assert agg["by_cat"]["convert"] == 200 * ms
+        assert agg["by_cat"]["device"] == 16 * ms
+        rep = hr.report(agg, top=3)
+        # host categories ranked: convert(200) > dispatch(120) > serde(60)
+        assert rep["top_host_categories"] == ["convert", "dispatch",
+                                              "serde"]
+        assert rep["top_sinks"][0]["op"] == "parquet_scan"
+        assert rep["top_sinks"][0]["category"] == "convert"
+        assert rep["device_ms"] == 16.0
+
+    def test_load_dir_and_cli(self, tmp_path, capsys):
+        import hotspot_report as hr
+        p = tmp_path / "profile_00000001.jsonl"
+        with open(p, "w") as f:
+            for r in self._records():
+                f.write(json.dumps(r) + "\n")
+        rc = hr.main([str(tmp_path), "--top", "2"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        last = json.loads(out.strip().splitlines()[-1])
+        assert last["profile_records"] == 4
+        assert last["top_host_categories"][0] == "convert"
+        assert len(last["top_sinks"]) == 2
+
+    def test_empty_dir_is_actionable(self, tmp_path):
+        import hotspot_report as hr
+        with pytest.raises(SystemExit, match="profile_"):
+            hr.load_dir(str(tmp_path))

@@ -441,7 +441,14 @@ class TestOverheadHarness:
         assert res["trace_ab_queries"] == ["q3"]
         assert res["trace_ab_off_s"] > 0
         assert res["trace_ab_on_s"] > 0
+        assert res["trace_ab_noprofile_s"] > 0
         assert np.isfinite(res["trace_overhead_pct"])
         assert res["trace_overhead_gate_pct"] == 2.0
+        # the third arm: profiler-attribution overhead (PR 6 <2% gate,
+        # measured at real scale by bench.py — finiteness only here)
+        assert np.isfinite(res["profile_overhead_pct"])
+        assert res["profile_overhead_gate_pct"] == 2.0
         assert res["trace_ab_spans"] > 0
         assert not trace.enabled()
+        from auron_tpu.obs import profile as obs_profile
+        assert obs_profile.enabled()   # default restored

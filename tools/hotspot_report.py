@@ -1,0 +1,176 @@
+"""Host-overhead hotspot report: where did the wall time actually go.
+
+Reads the per-task attribution records the profiler exports next to the
+traces (``profile_*.jsonl`` in ``auron.trace.dir`` — one line per
+operator instance per finished task, obs/profile.export_task) and ranks
+the host-overhead sinks: a category × operator table over the
+attribution buckets (``device``, ``dispatch``, ``convert``, ``serde``,
+``iter``, ``other``), per-category totals, and the top-N individual
+(category, operator) sinks. This is the tool that answers the ROADMAP
+[speed] question — "where did q01's 400× gap vs the pandas baseline
+go" — with numbers instead of a guess:
+
+    python tools/hotspot_report.py /tmp/trace_dir
+    python tools/hotspot_report.py /tmp/trace_dir --top 8
+    python tools/hotspot_report.py --compare /tmp/base /tmp/candidate
+
+``--compare`` diffs two trace dirs by per-category totals (A/B runs:
+profiler-guided fix vs baseline). The last stdout line is one JSON
+record (the bench.py / trace_report.py driver contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: attribution categories, display order (device first, then the host
+#: buckets by typical magnitude)
+CATEGORIES = ("device", "dispatch", "convert", "serde", "iter", "other")
+
+_METRIC_FOR = {"device": "elapsed_device"}
+_METRIC_FOR.update({b: "elapsed_host_" + b for b in CATEGORIES[1:]})
+
+
+def load_dir(trace_dir: str) -> list[dict]:
+    files = sorted(glob.glob(os.path.join(trace_dir, "profile_*.jsonl")))
+    if not files:
+        raise SystemExit(
+            f"no profile_*.jsonl files under {trace_dir!r} (run with "
+            "auron.profile.enabled + auron.trace.dir set)")
+    records = []
+    for path in files:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    records.append(json.loads(line))
+    return records
+
+
+def aggregate(records: list[dict]) -> dict:
+    """{(category, op): total_ns} plus per-category and per-op rollups."""
+    cells: dict = {}
+    compute_ns: dict = {}
+    for r in records:
+        op = r.get("op", "?")
+        metrics = r.get("metrics", {})
+        compute_ns[op] = compute_ns.get(op, 0) + \
+            metrics.get("elapsed_compute", 0)
+        for cat in CATEGORIES:
+            v = metrics.get(_METRIC_FOR[cat], 0)
+            if v:
+                cells[(cat, op)] = cells.get((cat, op), 0) + v
+    by_cat = {c: 0 for c in CATEGORIES}
+    by_op: dict = {}
+    for (cat, op), ns in cells.items():
+        by_cat[cat] += ns
+        by_op[op] = by_op.get(op, 0) + ns
+    return {"cells": cells, "by_cat": by_cat, "by_op": by_op,
+            "compute_ns": compute_ns}
+
+
+def _ms(ns: int) -> float:
+    return round(ns / 1e6, 2)
+
+
+def report(agg: dict, top: int = 10) -> dict:
+    host_cats = {c: _ms(v) for c, v in agg["by_cat"].items()
+                 if c != "device" and v}
+    # the headline: host-overhead categories ranked by total time
+    top_categories = sorted(host_cats.items(), key=lambda kv: -kv[1])
+    top_sinks = sorted(
+        ((cat, op, _ms(ns)) for (cat, op), ns in agg["cells"].items()
+         if cat != "device"),
+        key=lambda t: -t[2])[:top]
+    compute_ms = _ms(sum(agg["compute_ns"].values()))
+    attributed_ms = _ms(agg["by_cat"]["device"]) + \
+        round(sum(host_cats.values()), 2)
+    return {
+        "device_ms": _ms(agg["by_cat"]["device"]),
+        "host_ms": round(sum(host_cats.values()), 2),
+        "host_categories_ms": dict(top_categories),
+        "top_host_categories": [c for c, _v in top_categories[:3]],
+        "top_sinks": [{"category": c, "op": o, "ms": m}
+                      for c, o, m in top_sinks],
+        # attribution coverage: how much of the timers' measured wall
+        # the buckets explain (convert/serde/iter live OUTSIDE
+        # elapsed_compute, so >100% is normal on scan-heavy plans)
+        "compute_ms": compute_ms,
+        "attributed_pct": (round(attributed_ms / compute_ms * 100.0, 1)
+                           if compute_ms else None),
+    }
+
+
+def print_table(agg: dict, rep: dict, top: int) -> None:
+    ops = sorted(agg["by_op"], key=lambda o: -agg["by_op"][o])
+    print("category × operator attribution (ms):")
+    header = f"{'operator':24s}" + "".join(f"{c:>10s}" for c in CATEGORIES)
+    print(header)
+    for op in ops:
+        row = f"{op[:24]:24s}"
+        for cat in CATEGORIES:
+            row += f"{_ms(agg['cells'].get((cat, op), 0)):>10.1f}"
+        print(row)
+    total_row = f"{'TOTAL':24s}"
+    for cat in CATEGORIES:
+        total_row += f"{_ms(agg['by_cat'][cat]):>10.1f}"
+    print(total_row)
+    print(f"\ndevice total: {rep['device_ms']}ms   "
+          f"host total: {rep['host_ms']}ms   "
+          f"(timers' elapsed_compute: {rep['compute_ms']}ms)")
+    print("top host-overhead categories: "
+          + ", ".join(f"{c}={rep['host_categories_ms'][c]}ms"
+                      for c in rep["top_host_categories"]))
+    print(f"\ntop-{top} host-overhead sinks:")
+    for s in rep["top_sinks"]:
+        print(f"  {s['ms']:>10.1f}ms  {s['category']:9s} {s['op']}")
+
+
+def _compare(base_dir: str, cand_dir: str) -> int:
+    base = aggregate(load_dir(base_dir))
+    cand = aggregate(load_dir(cand_dir))
+    print(f"{'category':10s} {'base_ms':>10s} {'cand_ms':>10s} "
+          f"{'delta':>8s}")
+    deltas = {}
+    for cat in CATEGORIES:
+        b, c = _ms(base["by_cat"][cat]), _ms(cand["by_cat"][cat])
+        # None (not inf) for a category absent from base: json.dumps
+        # would emit the non-RFC 'Infinity' token otherwise
+        pct = round((c - b) / b * 100.0, 2) if b else (None if c else 0.0)
+        deltas[cat] = {"base_ms": b, "cand_ms": c, "delta_pct": pct}
+        shown = "new" if pct is None else f"{pct:.1f}%"
+        print(f"{cat:10s} {b:>10.1f} {c:>10.1f} {shown:>8s}")
+    print(json.dumps({"categories": deltas}))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace_dir", nargs="?", default=None,
+                    help="directory holding profile_*.jsonl files")
+    ap.add_argument("--top", type=int, default=10,
+                    help="individual (category, operator) sinks listed")
+    ap.add_argument("--compare", nargs=2, metavar=("BASE", "CANDIDATE"),
+                    default=None,
+                    help="diff two trace dirs by per-category totals")
+    args = ap.parse_args(argv)
+    if args.compare:
+        return _compare(args.compare[0], args.compare[1])
+    if not args.trace_dir:
+        ap.error("trace_dir (or --compare) is required")
+    records = load_dir(args.trace_dir)
+    agg = aggregate(records)
+    rep = report(agg, args.top)
+    print_table(agg, rep, args.top)
+    print(json.dumps(dict(rep, profile_records=len(records))))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,170 @@
+"""Perf regression gate: fresh q01 bench vs the checked-in baseline.
+
+The ROADMAP [speed] item's third front: q01 CPU throughput decayed
+276k → 108k rows/s across BENCH_r03→r05 and nobody noticed until the
+round-5 verdict read the history side by side. This gate makes that
+trajectory a failing exit code: it takes a fresh ``bench.py`` record
+(or one from a file/stdin), looks up the platform's floor in
+``tools/perf_baseline.json`` (distilled from BENCH_r01–r05 — the
+weakest HONEST measurement per platform), applies the tolerance
+(CLI > ``auron.perf_gate.tolerance_pct`` > baseline default, sized to
+this container's measured wall-clock variance), and exits nonzero on a
+regression past it.
+
+    python tools/perf_gate.py --run                # runs bench.py
+    python tools/perf_gate.py --bench-json rec.json
+    python bench.py | python tools/perf_gate.py --bench-json -
+
+Exit codes: 0 pass, 1 regression, 2 unusable record (bench errored or
+the platform has no baseline). The last stdout line is one JSON record
+(the bench.py / chaos_report.py driver contract) carrying the verdict
+AND the bench record's host/device ``profile`` section, so a failing
+gate arrives WITH the attribution that explains where the time went.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_DEFAULT_BASELINE = os.path.join(_HERE, "perf_baseline.json")
+
+
+def load_baseline(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def fresh_bench_record(timeout_s: int = 1200) -> dict:
+    """Run bench.py and parse its one-JSON-line contract."""
+    repo = os.path.dirname(_HERE)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, timeout=timeout_s, cwd=repo)
+    lines = [ln for ln in (proc.stdout or "").strip().splitlines()
+             if ln.strip()]
+    if not lines:
+        raise SystemExit(
+            f"bench.py produced no output (rc={proc.returncode}); "
+            f"stderr tail: {(proc.stderr or '')[-500:]}")
+    return json.loads(lines[-1])
+
+
+def resolve_tolerance(cli_pct, baseline: dict) -> float:
+    if cli_pct is not None:
+        return float(cli_pct)
+    try:
+        from auron_tpu import config as cfg
+        conf = cfg.get_config()
+        # honor an explicit override — a programmatic AuronConfig.set
+        # (the documented top of the resolution order) or the env
+        # binding; otherwise prefer the baseline file so the floor and
+        # its tolerance travel together in one reviewed artifact
+        opt = cfg._REGISTRY[cfg.PERF_GATE_TOLERANCE_PCT]
+        with conf._lock:
+            session_set = cfg.PERF_GATE_TOLERANCE_PCT in conf._overrides
+        if session_set or os.environ.get(opt.env_var) is not None:
+            return float(conf.get(cfg.PERF_GATE_TOLERANCE_PCT))
+    except Exception:
+        pass
+    return float(baseline.get("default_tolerance_pct", 50.0))
+
+
+def evaluate(record: dict, baseline: dict, tolerance_pct: float) -> dict:
+    """Pure gate verdict from a bench record + baseline (the unit the
+    mechanics tests drive with synthetic records)."""
+    if "error" in record and record.get("value") is None:
+        return {"perf_gate": "unusable",
+                "reason": f"bench errored: {record['error']}"}
+    platform = record.get("platform", "")
+    aliases = baseline.get("platform_aliases", {})
+    entry = baseline.get("platforms", {}).get(
+        aliases.get(platform, platform))
+    if entry is None:
+        return {"perf_gate": "unusable",
+                "reason": f"no baseline for platform {platform!r}"}
+    value = float(record.get("value", 0.0))
+    base = float(entry["rows_per_sec"])
+    floor = base * (1.0 - tolerance_pct / 100.0)
+    verdict = {
+        "perf_gate": "pass" if value >= floor else "fail",
+        "metric": baseline.get("metric"),
+        "platform": platform,
+        "value_rows_per_sec": round(value, 1),
+        "baseline_rows_per_sec": round(base, 1),
+        "floor_rows_per_sec": round(floor, 1),
+        "tolerance_pct": tolerance_pct,
+        "delta_vs_baseline_pct": round((value - base) / base * 100.0, 2),
+    }
+    # carry the forensics along: a failing gate should arrive WITH the
+    # host/device attribution and the structured backend diagnosis
+    if isinstance(record.get("profile"), dict):
+        verdict["profile"] = record["profile"]
+    pr = record.get("probe_report")
+    if isinstance(pr, dict):
+        verdict["probe_ok"] = pr.get("ok")
+        if not pr.get("ok"):
+            failed = next((s for s in pr.get("steps", [])
+                           if not s.get("ok")), {})
+            verdict["probe_failed_step"] = failed.get("name")
+            verdict["probe_error"] = (
+                f"{failed.get('error_type', '')}: "
+                f"{failed.get('error_message', '')}").strip(": ")
+    return verdict
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--baseline", default=_DEFAULT_BASELINE,
+                    help="baseline JSON (default tools/perf_baseline.json)")
+    ap.add_argument("--bench-json", default=None,
+                    help="bench record file ('-' reads stdin) instead of "
+                         "running bench.py")
+    ap.add_argument("--run", action="store_true",
+                    help="run bench.py for a fresh record (the default "
+                         "when --bench-json is absent)")
+    ap.add_argument("--tolerance-pct", type=float, default=None,
+                    help="allowed shortfall vs the baseline floor "
+                         "(default: auron.perf_gate.tolerance_pct env "
+                         "override, else the baseline file's)")
+    args = ap.parse_args(argv)
+
+    baseline = load_baseline(args.baseline)
+    if args.bench_json == "-":
+        record = json.loads(sys.stdin.read().strip().splitlines()[-1])
+    elif args.bench_json:
+        with open(args.bench_json) as f:
+            record = json.loads(f.read().strip().splitlines()[-1])
+    else:
+        record = fresh_bench_record()
+
+    tolerance = resolve_tolerance(args.tolerance_pct, baseline)
+    verdict = evaluate(record, baseline, tolerance)
+
+    if verdict["perf_gate"] == "unusable":
+        print(f"perf gate: UNUSABLE — {verdict['reason']}")
+        print(json.dumps(verdict))
+        return 2
+    print(f"perf gate [{verdict['platform']}]: "
+          f"{verdict['value_rows_per_sec']:,.0f} rows/s vs baseline "
+          f"{verdict['baseline_rows_per_sec']:,.0f} "
+          f"(floor {verdict['floor_rows_per_sec']:,.0f}, "
+          f"tolerance {tolerance:.0f}%) → "
+          f"{verdict['perf_gate'].upper()}")
+    if "profile" in verdict:
+        p = verdict["profile"]
+        print(f"  host/device split: device={p.get('device_ms')}ms "
+              f"host={p.get('host_ms')}ms "
+              f"buckets={p.get('host_buckets_ms')}")
+    print(json.dumps(verdict))
+    return 0 if verdict["perf_gate"] == "pass" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,17 +49,24 @@ def summarize(spans: list, top: int = 10) -> dict:
     by_cat: dict = {}
     for s in spans:
         c = by_cat.setdefault(s.cat, {"count": 0, "total_ms": 0.0,
-                                      "max_ms": 0.0})
+                                      "max_ms": 0.0, "device_ms": 0.0})
         c["count"] += 1
         ms = s.dur_ns / 1e6
         c["total_ms"] += ms
         c["max_ms"] = max(c["max_ms"], ms)
+        # host/device split: spans the profiler annotates (program.call
+        # carries its block_until_ready wait as device_ms) contribute
+        # device time; the category's host_ms is the remainder
+        dev = s.attrs.get("device_ms")
+        if isinstance(dev, (int, float)):
+            c["device_ms"] += dev
     slowest = {}
     for cat in by_cat:
         worst = sorted((s for s in spans if s.cat == cat and s.dur_ns),
                        key=lambda s: -s.dur_ns)[:top]
         slowest[cat] = [
             {"name": s.name, "ms": round(s.dur_ns / 1e6, 3),
+             "device_ms": s.attrs.get("device_ms", 0.0),
              "trace": s.trace_id, "span": s.span_id, "attrs": s.attrs}
             for s in worst]
     timeline = [
@@ -88,6 +95,8 @@ def summarize(spans: list, top: int = 10) -> dict:
     for c in by_cat.values():
         c["total_ms"] = round(c["total_ms"], 3)
         c["max_ms"] = round(c["max_ms"], 3)
+        c["device_ms"] = round(c["device_ms"], 3)
+        c["host_ms"] = round(max(c["total_ms"] - c["device_ms"], 0.0), 3)
     return {"spans": len(spans),
             "traces": len({s.trace_id for s in spans}),
             "by_category": by_cat, "slowest": slowest,
@@ -97,9 +106,11 @@ def summarize(spans: list, top: int = 10) -> dict:
 def print_summary(rep: dict, top: int) -> None:
     print(f"{rep['spans']} spans across {rep['traces']} trace(s)")
     print(f"{'category':10s} {'count':>7s} {'total_ms':>10s} "
-          f"{'max_ms':>9s}")
+          f"{'device_ms':>10s} {'host_ms':>9s} {'max_ms':>9s}")
     for cat, c in sorted(rep["by_category"].items()):
         print(f"{cat:10s} {c['count']:>7d} {c['total_ms']:>10.1f} "
+              f"{c.get('device_ms', 0.0):>10.1f} "
+              f"{c.get('host_ms', c['total_ms']):>9.1f} "
               f"{c['max_ms']:>9.1f}")
     print(f"\ntop-{top} slowest spans per category:")
     for cat, worst in sorted(rep["slowest"].items()):
@@ -108,8 +119,10 @@ def print_summary(rep: dict, top: int) -> None:
         print(f"  [{cat}]")
         for w in worst:
             attrs = {k: v for k, v in w["attrs"].items()
-                     if k not in ("error",)}
-            print(f"    {w['ms']:>10.2f}ms  {w['name']}  {attrs}")
+                     if k not in ("error", "device_ms", "dispatch_ms")}
+            dev = w.get("device_ms") or 0.0
+            split = f" dev={dev:.2f}ms" if dev else ""
+            print(f"    {w['ms']:>10.2f}ms{split}  {w['name']}  {attrs}")
     if rep["compile_sites"]:
         print("\ncompile-time attribution (program.build per site):")
         for site, c in sorted(rep["compile_sites"].items(),
