@@ -96,6 +96,29 @@ SPILL_CODEC_LEVEL = _opt(
     "auron.spill.codec_level", int, 1,
     "zstd compression level for spill/shuffle frames (the reference "
     "defaults its IPC compression to lz4/zstd level 1).")
+MEMMGR_PRESSURE_POLICY = _opt(
+    "auron.memmgr.pressure_policy", str, "degrade",
+    "What the memory manager does when the spill loop exits still over "
+    "budget (the old silent 'deny'): 'degrade' (default) walks the "
+    "degradation ladder — shrink (advise smaller scan batches + ask the "
+    "requester to shrink) -> force-spill (largest consumer, ignoring "
+    "min_trigger) -> deny (survivable, counted) — so pressure degrades "
+    "throughput before it fails anything; 'shed' ends the ladder by "
+    "failing THIS query with the classified errors.MemoryExhausted "
+    "(never the process) — the serving/admission-control posture; "
+    "'legacy' restores the pre-ladder deny event only. A per-query "
+    "quota breach (auron.memmgr.query_quota_bytes) sheds under every "
+    "policy except 'legacy'. Each rung taken is counted on "
+    "auron_memmgr_pressure_total{rung=...}.")
+MEMMGR_QUERY_QUOTA_BYTES = _opt(
+    "auron.memmgr.query_quota_bytes", int, 0,
+    "Device-memory quota on one MemManager's consumers: exceeded AFTER "
+    "the spill loop and the degradation ladder ran, the requesting "
+    "query is shed with errors.MemoryExhausted — never the process. "
+    "Today a Session executes one query at a time, so the cap IS "
+    "per-query; the concurrent scheduler (ROADMAP [serving]) must give "
+    "each query its own manager (or per-query ledger) to keep that "
+    "property. 0 (default) disables the quota.")
 
 # NOTE: options are declared only once a use-site exists — an option in
 # CONFIG.md that nothing reads is a lie to the user. SMJ-fallback,
@@ -154,8 +177,12 @@ FAULTS_PLAN = _opt(
     "auron.faults.plan", str, "",
     "Seeded fault-injection plan: 'site:kind@prob;...' over the named "
     "sites rss.{write,flush,commit,fetch}, spill.{write,read}, "
-    "device.compute, program.build, backend.init with kinds io_error | "
-    "fatal | corrupt | hang (prob defaults to 1.0). Every injection "
+    "device.compute, task.hang, cancel.race, program.build, "
+    "backend.init, memmgr.deny with kinds io_error | fatal | corrupt | "
+    "hang | cancel | deny (prob defaults to 1.0). Injected hangs poll "
+    "the task's cancel registry, 'cancel' fires the task's CancelToken "
+    "mid-drive (the cancel-race site), 'deny' forces the memory "
+    "manager's degradation ladder. Every injection "
     "decision is a pure function of (auron.faults.seed, site, kind, "
     "event index), so failing chaos runs replay exactly. Empty (the "
     "default) disarms every site at one cached epoch-compare of "
@@ -197,6 +224,28 @@ WATCHDOG_COMPILE_TIMEOUT_S = _opt(
     "program): a backend that initializes but cannot compile within "
     "the deadline triggers the same CPU fallback. 0 (default) skips "
     "the probe.")
+WATCHDOG_STALL_TIMEOUT_S = _opt(
+    "auron.watchdog.stall_timeout_s", float, 0.0,
+    "Task-level stall watchdog: executor, shuffle and spill loops beat "
+    "a per-attempt heartbeat (ExecContext.checkpoint); a monitor thread "
+    "flags any task silent past this timeout, writes a structured "
+    "StallReport (last heartbeat site, driving thread's stack) into "
+    "auron.trace.dir, and raises the classified errors.TaskStalled at "
+    "the task's next cooperative poll — which the retry driver retries "
+    "exactly ONCE before surfacing. Detection latency is bounded by "
+    "1.25x the timeout (the monitor polls at a quarter interval). "
+    "0 (default) disarms the plane (no heartbeat registration, no "
+    "monitor thread).")
+
+# query lifecycle (runtime/lifecycle.py)
+QUERY_DEADLINE_S = _opt(
+    "auron.query.deadline_s", float, 0.0,
+    "Default per-query deadline applied by Session.execute when the "
+    "caller passes no explicit df.collect(timeout_s=...): past it, the "
+    "query's CancelToken self-cancels with reason 'deadline' and every "
+    "cooperative poll site unwinds with errors.DeadlineExceeded — full "
+    "resource cleanup, task-level backoff sleeps clamped to the "
+    "remaining budget. 0 (default) = no deadline.")
 
 # profiling
 PROFILE = _opt(

@@ -104,6 +104,51 @@ class ShuffleCorruption(AuronError):
 
 
 # ---------------------------------------------------------------------------
+# lifecycle classes — the query lifecycle control plane (PR 8)
+# ---------------------------------------------------------------------------
+
+class QueryCancelled(AuronError):
+    """The query's CancelToken was flipped (host cancel, serving CANCEL
+    frame, or session.cancel(query_id)): the task unwinds cooperatively
+    with full resource cleanup. NOT transient — a cancelled query must
+    never be silently recomputed; the retry driver surfaces it
+    immediately (and the executor treats it as teardown, not failure,
+    exactly like the legacy TaskCancelled)."""
+    transient = False
+
+    def __init__(self, *args, query_id: Optional[str] = None,
+                 site: Optional[str] = None):
+        super().__init__(*args, site=site)
+        self.query_id = query_id
+
+
+class DeadlineExceeded(QueryCancelled):
+    """The query ran past its deadline (``df.collect(timeout_s=...)`` /
+    ``auron.query.deadline_s`` / a serving-frame timeout): same
+    cooperative unwind as QueryCancelled, but surfaced to the caller as
+    a budget failure rather than swallowed as teardown."""
+
+
+class TaskStalled(AuronError):
+    """The stall watchdog flagged this task silent past
+    ``auron.watchdog.stall_timeout_s`` (no heartbeat from the drive
+    loop, shuffle frames, or spill consumers). Transient ONCE: the retry
+    driver re-runs a stalled task a single time (a wedged external
+    dependency may have healed), then surfaces it — an infinite
+    stall-retry loop would hide a deterministic wedge forever."""
+    transient = True
+
+
+class MemoryExhausted(AuronError):
+    """The memory-pressure degradation ladder ran out of rungs (shrink →
+    force-spill → shed) or a per-query quota was breached: THIS query is
+    shed with a classified error — never the process. Not transient: an
+    immediate identical recompute meets the same pressure; admission
+    control / the caller decides when to resubmit."""
+    transient = False
+
+
+# ---------------------------------------------------------------------------
 # transient classes — a clean re-execution can succeed
 # ---------------------------------------------------------------------------
 

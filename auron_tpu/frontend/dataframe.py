@@ -858,8 +858,14 @@ class DataFrame:
             partition_id=partition_id, num_partitions=self.num_partitions,
             plan=self.plan).SerializeToString()
 
-    def collect(self) -> pa.Table:
-        return self.session.execute(self)
+    def collect(self, timeout_s: Optional[float] = None) -> pa.Table:
+        """Execute and materialize. ``timeout_s`` arms a per-query
+        deadline: past it, every cooperative poll site unwinds with the
+        classified ``errors.DeadlineExceeded`` and the query's resources
+        (spill files, shuffle buffers, memmgr consumers) are released —
+        the same token mechanism ``session.cancel(query_id)`` and the
+        serving CANCEL frame flip."""
+        return self.session.execute(self, timeout_s=timeout_s)
 
     def to_pandas(self):
         return self.collect().to_pandas()

@@ -42,6 +42,18 @@ class Session:
         self._ids = itertools.count()
         #: host-fallback registrations: rid -> (child DataFrame, fn)
         self._host_fns: dict[str, tuple[DataFrame, Callable]] = {}
+        #: live query lifecycles: query_id -> CancelToken (the
+        #: session.cancel(query_id) registry); guarded by _queries_lock
+        #: because serving/admin threads cancel while the driver runs
+        import threading
+        self._queries_lock = threading.Lock()
+        self._active_queries: dict[str, object] = {}
+        self._query_ids = itertools.count(1)
+        self._closed = False
+        #: thread-local current token: nested executes (host-fn
+        #: children, scalar subqueries) join the ENCLOSING query's
+        #: lifecycle — one cancel/deadline covers the whole tree
+        self._tls = threading.local()
 
     def _bind_xla_cache(self) -> None:
         """Bind jax's persistent compilation cache to
@@ -152,16 +164,108 @@ class Session:
         self._materialize_host_fns(df.plan)
         return plan_from_bytes(df.task_bytes(), self.ctx)
 
-    def execute(self, df: DataFrame) -> pa.Table:
+    # -- query lifecycle ----------------------------------------------------
+
+    def _begin_query(self, timeout_s: Optional[float]):
+        """Create + register one query's CancelToken. The deadline is
+        the explicit ``timeout_s`` when given, else the session default
+        ``auron.query.deadline_s`` (0 = none)."""
+        from auron_tpu import config as cfg
+        from auron_tpu.runtime.lifecycle import CancelToken
+        if timeout_s is None:
+            default = float(self.config.get(cfg.QUERY_DEADLINE_S))
+            timeout_s = default if default > 0 else None
+        qid = f"q{next(self._query_ids)}"
+        token = CancelToken(query_id=qid, deadline_s=timeout_s)
+        with self._queries_lock:
+            self._active_queries[qid] = token
+        return token
+
+    def _end_query(self, token) -> None:
+        with self._queries_lock:
+            self._active_queries.pop(token.query_id, None)
+
+    def cancel(self, query_id: str) -> bool:
+        """Cancel a running query by id (thread-safe; the API face of
+        the serving CANCEL frame). Returns True when a live query was
+        cancelled; False — the idempotent after-DONE no-op — when the
+        id is unknown or already finished."""
+        with self._queries_lock:
+            token = self._active_queries.get(query_id)
+        if token is None:
+            return False
+        token.cancel()
+        return True
+
+    def active_queries(self) -> dict:
+        """{query_id: CancelToken} of the queries currently executing."""
+        with self._queries_lock:
+            return dict(self._active_queries)
+
+    def close(self) -> None:
+        """End the session: cancel every live query and sweep the spill
+        tier's orphaned files (the commit-time ``.part`` sweep's
+        equivalent for per-attempt spill artifacts — a crashed or
+        cancelled attempt must not leak storage past the session)."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._queries_lock:
+            tokens = list(self._active_queries.values())
+        for t in tokens:
+            t.cancel()
+        # cancellation is COOPERATIVE: wait (bounded) for the driver
+        # threads to unwind and unregister before sweeping, or the
+        # sweep would unlink spill files a still-running task is about
+        # to read — turning the classified QueryCancelled into an
+        # unclassified FileNotFoundError
+        if tokens:
+            import time as _time
+            deadline = _time.monotonic() + 5.0
+            while _time.monotonic() < deadline:
+                with self._queries_lock:
+                    if not self._active_queries:
+                        break
+                _time.sleep(0.02)
+        spill_mgr = getattr(self.mem_manager, "spill_manager", None)
+        if spill_mgr is not None and hasattr(spill_mgr, "sweep_orphans"):
+            spill_mgr.sweep_orphans()
+
+    def __enter__(self) -> "Session":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    def execute(self, df: DataFrame,
+                timeout_s: Optional[float] = None) -> pa.Table:
         from auron_tpu.obs import trace
+        # nested execute (a host-fn child or scalar subquery driven from
+        # inside an enclosing query): join the enclosing lifecycle — the
+        # outer token's cancel/deadline covers the whole tree
+        enclosing = getattr(self._tls, "token", None)
+        if enclosing is not None:
+            with trace.query_scope(label=f"p{df.num_partitions}"):
+                op = self.plan_physical(df)
+                return _collect(op, num_partitions=df.num_partitions,
+                                mem_manager=self.mem_manager,
+                                config=self.config,
+                                cancel_token=enclosing)
+        token = self._begin_query(timeout_s)
+        self._tls.token = token
         # one trace per TOP-LEVEL query: nested executes (host-fn
         # children, scalar subqueries) join the enclosing trace, and the
         # outermost scope exports into auron.trace.dir when set
-        with trace.query_scope(label=f"p{df.num_partitions}"):
-            op = self.plan_physical(df)
-            return _collect(op, num_partitions=df.num_partitions,
-                            mem_manager=self.mem_manager,
-                            config=self.config)
+        try:
+            with trace.query_scope(label=f"p{df.num_partitions}"):
+                op = self.plan_physical(df)
+                return _collect(op, num_partitions=df.num_partitions,
+                                mem_manager=self.mem_manager,
+                                config=self.config, cancel_token=token)
+        finally:
+            self._tls.token = None
+            self._end_query(token)
 
     def explain_analyze(self, df: DataFrame) -> str:
         """EXPLAIN ANALYZE: run the plan with a positional metric tree

@@ -109,6 +109,11 @@ class ParquetScanOp(PhysicalOp):
             if f is not None:
                 arrow_filter = f if arrow_filter is None else (arrow_filter & f)
 
+        def advised_rows(base: int) -> int:
+            fn = getattr(ctx.mem_manager, "advised_batch_rows", None) \
+                if ctx.mem_manager is not None else None
+            return fn(base) if fn is not None else base
+
         def host_batches():
             if not files:
                 return
@@ -119,9 +124,15 @@ class ParquetScanOp(PhysicalOp):
             for rb in scanner.to_batches():
                 if rb.num_rows == 0:
                     continue
-                # split oversized batches (scanner batch_size is a hint)
-                for off in range(0, rb.num_rows, self.batch_rows):
-                    yield rb.slice(off, min(self.batch_rows, rb.num_rows - off))
+                # split oversized batches (scanner batch_size is a
+                # hint); under memory pressure the manager's shrink rung
+                # advises smaller slices (memmgr degradation ladder) so
+                # the scan stops ramming full-capacity batches into a
+                # budget that just denied
+                rows = advised_rows(self.batch_rows)
+                for off in range(0, rb.num_rows, rows):
+                    ctx.checkpoint("scan.decode")
+                    yield rb.slice(off, min(rows, rb.num_rows - off))
 
         def stream():
             # Double buffering: decode/transfer next batch while caller
@@ -130,7 +141,14 @@ class ParquetScanOp(PhysicalOp):
                 it = host_batches()
 
                 def convert(rb):
-                    return to_device(rb, capacity=self.batch_rows,
+                    # capacity stays pinned to batch_rows (ONE program
+                    # shape per scan) unless the pressure ladder shrank
+                    # the slices — smaller capacity is the point then
+                    from auron_tpu.utils.shapes import bucket_rows
+                    cap = self.batch_rows
+                    if rb.num_rows < cap and advised_rows(cap) < cap:
+                        cap = bucket_rows(rb.num_rows)
+                    return to_device(rb, capacity=cap,
                                      string_widths=self._widths_for(rb))[0]
 
                 pending = None

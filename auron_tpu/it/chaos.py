@@ -61,9 +61,12 @@ class ChaosOutcome:
 
 
 #: span names that ARE recovery actions on the timeline: task-level
-#: retries, corrupt-map recomputes, watchdog CPU fallbacks
+#: retries, corrupt-map recomputes, watchdog CPU fallbacks, stall
+#: verdicts and pressure-ladder sheds (the lifecycle plane's recovery
+#: actions)
 RECOVERY_SPAN_NAMES = ("task.retry", "shuffle.corruption_recompute",
-                       "watchdog.fallback")
+                       "watchdog.fallback", "watchdog.stall",
+                       "memmgr.shed")
 
 
 #: which injection KINDS can cause each recovery span — the corrupt
@@ -75,6 +78,10 @@ _RECOVERY_CAUSE_KINDS = {
     # any injected backend.init kind (hang, io_error, fatal) can force
     # the CPU fallback, so the watchdog entry lists them all
     "watchdog.fallback": ("hang", "io_error", "fatal"),
+    # only a hang goes silent long enough for the stall monitor
+    "watchdog.stall": ("hang",),
+    # the pressure ladder sheds on injected denies
+    "memmgr.shed": ("deny",),
 }
 
 
@@ -141,6 +148,12 @@ class Scenario:
         found = []
         for pattern in self.leak_globs:
             found.extend(glob.glob(pattern, recursive=True))
+        extra = getattr(self, "extra_audit", None)
+        if extra is not None:
+            # scenario-specific resource ledger (registered memmgr
+            # consumers, tracked spill files) — the zero-leaked-
+            # consumers half of the lifecycle contract
+            found.extend(extra())
         return found
 
 
@@ -244,10 +257,91 @@ def agg_pipeline(workdir: str) -> Scenario:
     return Scenario("agg_pipeline", run, [])
 
 
+def lifecycle_pipeline(workdir: str) -> Scenario:
+    """Chaos 2.0 lifecycle scenario: a Session-planned sort+agg under a
+    tiny memory budget so spills/memmgr traffic is guaranteed, run with
+    a short stall watchdog and the 'shed' pressure policy. Gives the
+    lifecycle sites deterministic traffic: ``cancel.race`` fires the
+    query's CancelToken mid-drive (→ QueryCancelled), ``task.hang``
+    goes silent past the stall timeout (→ TaskStalled, retried once),
+    ``memmgr.deny`` forces the degradation ladder to the shed rung
+    (→ MemoryExhausted). Every outcome must be identical-or-classified
+    with a clean resource ledger (no spill files, no registered
+    consumers) — audited per run via ``extra_audit``."""
+    from auron_tpu.frontend.dataframe import col, functions as F
+    from auron_tpu.frontend.session import Session
+    from auron_tpu.memmgr.manager import MemManager
+    from auron_tpu.memmgr.spill import SpillManager
+
+    spill_dir = os.path.join(workdir, "spill")
+    os.makedirs(spill_dir, exist_ok=True)
+    # several record batches: every one is a checkpoint event in the
+    # sort/drive loops, so the seeded cancel.race/task.hang Bernoulli
+    # sequences see real traffic
+    table = pa.Table.from_batches([_rows(512, seed=31 + i)
+                                   for i in range(8)])
+    last: dict = {}
+
+    # stall timeout sized ABOVE this mesh's worst single-program compile
+    # (the monitor credits completed compiles, but one compile longer
+    # than the timeout would still flag); hang_s above the timeout so an
+    # injected hang reliably trips the stall verdict
+    _KNOBS = {cfg.WATCHDOG_STALL_TIMEOUT_S: 1.5,
+              cfg.FAULTS_HANG_S: 4.0,
+              cfg.MEMMGR_PRESSURE_POLICY: "shed"}
+
+    def run() -> pa.Table:
+        conf = cfg.get_config()
+        _missing = object()
+        saved = {k: conf._overrides.get(k, _missing) for k in _KNOBS}
+        for k, v in _KNOBS.items():
+            conf.set(k, v)
+        mm = MemManager(
+            total_bytes=1 << 22, min_trigger=0,
+            spill_manager=SpillManager(host_budget_bytes=1,
+                                       spill_dir=spill_dir))
+        last["mm"] = mm
+        s = Session(mem_manager=mm)
+        try:
+            df = (s.from_arrow(table)
+                  .sort("k")
+                  .group_by("k")
+                  .agg(F.sum(col("v")).alias("sv"),
+                       F.count(col("c")).alias("n")))
+            return _canonical(s.execute(df))
+        finally:
+            s.close()
+            for k, prev in saved.items():
+                if prev is _missing:
+                    conf.unset(k)
+                else:
+                    conf.set(k, prev)
+
+    sc = Scenario("lifecycle_pipeline", run,
+                  [os.path.join(spill_dir, "auron-spill-*")])
+
+    def extra_audit() -> list[str]:
+        mm = last.get("mm")
+        if mm is None:
+            return []
+        gc.collect()
+        found = [f"memmgr-consumer:{name}"
+                 for name in mm.status()["consumers"]]
+        live = mm.spill_manager.live_disk_files() \
+            if mm.spill_manager is not None else 0
+        if live:
+            found.append(f"tracked-spill-files:{live}")
+        return found
+
+    sc.extra_audit = extra_audit
+    return sc
+
+
 SCENARIOS: dict[str, Callable[[str], Scenario]] = {
     "rss_pipeline": rss_pipeline,
     "spill_sort": spill_sort,
     "agg_pipeline": agg_pipeline,
+    "lifecycle_pipeline": lifecycle_pipeline,
 }
 
 

@@ -106,6 +106,47 @@ class BufferedSpillConsumer:
         self.metrics.counter("mem_spill_size").add(freed)
         return freed
 
+    def shrink(self) -> int:
+        """Degradation-ladder rung 1 (memmgr/manager._pressure_ladder):
+        shed the OLDEST half of the buffered batches as one spill run —
+        partial relief that keeps the newest (still hot) batches on
+        device. Returns bytes freed; declines (0) when fewer than two
+        batches are buffered (a full spill is then the right tool and
+        rung 2 will take it)."""
+        from auron_tpu.obs import trace
+        if getattr(self.mem, "spill_manager", None) is None:
+            return 0
+        with self._lock:
+            if len(self.buffered) < 2:
+                return 0
+            half = len(self.buffered) // 2
+            victims, self.buffered = (self.buffered[:half],
+                                      self.buffered[half:])
+            freed = sum(batch_nbytes(b) for b in victims)
+            self.bytes -= freed
+            self._inflight_spills += 1
+        try:
+            with trace.span("spill", "spill.run_write",
+                            consumer=self.consumer_name,
+                            batches=len(victims), bytes=freed,
+                            rung="shrink") as sp:
+                spill = self.mem.spill_manager.new_spill()
+                try:
+                    self._write_run(spill, victims)
+                except BaseException:
+                    spill.release()
+                    raise
+                sp.set(tier="disk" if spill.disk_bytes else "dram")
+                with self._lock:
+                    self.spills.append(spill.finish())
+        finally:
+            with self._quiesced:
+                self._inflight_spills -= 1
+                self._quiesced.notify_all()
+        self.metrics.counter("mem_spill_count").add(1)
+        self.metrics.counter("mem_spill_size").add(freed)
+        return freed
+
     def _write_run(self, spill, batches: list[DeviceBatch]) -> None:
         """Default run format: each batch's live rows as unsorted frames."""
         from auron_tpu.columnar.serde import (batch_to_host,

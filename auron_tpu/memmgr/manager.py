@@ -22,6 +22,27 @@ logger = logging.getLogger("auron_tpu.memmgr")
 #: 16MB, auron-memmgr/src/lib.rs:36)
 MIN_TRIGGER_SIZE = 16 << 20
 
+#: every live manager, weakly held — the process-wide consumer-leak
+#: probe the tier-1 leak-audit fixture and the chaos battery read
+import weakref as _weakref
+
+_MANAGERS: "_weakref.WeakSet" = _weakref.WeakSet()
+
+#: (config epoch, quota bytes) — see MemManager._query_quota
+_QUOTA_CACHE: tuple = (-1, 0)
+
+
+def live_consumer_count() -> int:
+    """Registered consumers across every live MemManager (after a gc, a
+    finished query must leave this at its pre-query value — consumers
+    are weakly held, so anything still counted is either genuinely live
+    or pinned by a leak)."""
+    total = 0
+    for m in list(_MANAGERS):
+        with m._lock:
+            total += len(m._used)
+    return total
+
 
 class MemConsumer:
     """Spillable participant. Operators subclass / duck-type this."""
@@ -35,6 +56,14 @@ class MemConsumer:
     def spill(self) -> int:
         """Release device memory; returns bytes freed."""
         raise NotImplementedError
+
+    def shrink(self) -> int:
+        """OPTIONAL degradation hook (pressure ladder rung 1): release
+        PART of the held memory — cheaper than a full spill — returning
+        bytes freed. The default declines (0); consumers that buffer
+        batch lists override (memmgr/consumer.BufferedSpillConsumer
+        sheds its oldest half)."""
+        return 0
 
 
 class MemManager:
@@ -56,6 +85,15 @@ class MemManager:
             weakref.WeakKeyDictionary()
         self.num_spills = 0
         self.spilled_bytes = 0
+        #: degradation-ladder state: shrink rungs taken (drives the
+        #: advised batch-rows hint scans consult) + per-rung counters
+        self._shrink_level = 0
+        #: consecutive comfortable grants (under half budget) since the
+        #: last pressure event — the shrink-level decay hysteresis
+        self._comfort_grants = 0
+        self.pressure_counts = {"shrink": 0, "force_spill": 0,
+                                "deny": 0, "shed": 0}
+        _MANAGERS.add(self)
 
     @staticmethod
     def default_budget() -> int:
@@ -109,6 +147,7 @@ class MemManager:
         ``memmgr.deny`` — so memory pressure lines up with the span
         timeline instead of hiding in log archaeology."""
         from auron_tpu.obs import trace
+        from auron_tpu.runtime import faults
         observe = self._registry_enabled()
         with self._lock:
             self._used[c] = used
@@ -118,7 +157,24 @@ class MemManager:
             # consumer copy only happens when the registry will see it
             status = self._status_locked() if observe else None
 
-        if total_used <= self.total:
+        # the memmgr.deny chaos site: pretend the budget is exhausted so
+        # the degradation ladder gets deterministic traffic
+        forced = faults.fires("memmgr.deny", "deny")
+        quota = self._query_quota()
+        budget = min(self.total, quota) if quota else self.total
+        if total_used <= budget and not forced:
+            if self._shrink_level:
+                # decay the shrink advice once pressure has demonstrably
+                # subsided (16 consecutive grants under HALF budget) —
+                # one pressure episode must not pin 8x-smaller scan
+                # batches for the manager's lifetime
+                if total_used <= budget // 2:
+                    self._comfort_grants += 1
+                    if self._comfort_grants >= 16:
+                        self._shrink_level -= 1
+                        self._comfort_grants = 0
+                else:
+                    self._comfort_grants = 0
             trace.event("memory", "memmgr.grant",
                         consumer=getattr(c, "consumer_name", "?"),
                         used=used, total_used=total_used,
@@ -131,13 +187,14 @@ class MemManager:
         # to its watermark the same way; one victim's spill may free less
         # than the overshoot — e.g. a consumer refusing mid-merge).
         spilled_any = False
+        exhausted = forced    # an injected deny skips straight to the ladder
         tried: set = set()
-        while True:
+        while not exhausted:
             with self._lock:
                 total_used = sum(self._used.values())
                 share = self.total // max(len(self._used), 1)
                 c_used = self._used.get(c, 0)
-            if total_used <= self.total:
+            if total_used <= budget:
                 break
             if (c not in tried and c_used >= max(share, 1)
                     and c_used >= self.min_trigger):
@@ -147,10 +204,7 @@ class MemManager:
                     candidates = [(u, v) for v, u in self._used.items()
                                   if u >= self.min_trigger and v not in tried]
                 if not candidates:
-                    trace.event("memory", "memmgr.deny",
-                                consumer=getattr(c, "consumer_name", "?"),
-                                total_used=total_used, budget=self.total,
-                                tried=len(tried))
+                    exhausted = True
                     break
                 _, victim = max(candidates, key=lambda t: t[0])
             tried.add(victim)
@@ -171,9 +225,164 @@ class MemManager:
                 logger.info("memmgr: spilled %s (%d bytes freed, %d/%d used)",
                             victim.consumer_name, freed,
                             max(total_used - freed, 0), self.total)
+        if exhausted:
+            # the spill loop ran dry still over budget — the old hard
+            # "deny": now a policy (auron.memmgr.pressure_policy)
+            if self._pressure_ladder(c, budget, forced=forced):
+                spilled_any = True
         if self._registry_enabled():
             self._observe(self.status())
         return "spilled" if spilled_any else "nothing"
+
+    # -- memory-pressure degradation ladder (PR 8) --------------------------
+
+    def _query_quota(self) -> int:
+        """auron.memmgr.query_quota_bytes resolved from the process
+        config (0 = no quota), cached against the config epoch —
+        update_mem_used runs per batch-add, so the common no-quota path
+        must cost one int compare. Scope honesty: the quota caps THIS
+        MANAGER's total — today a Session runs one query at a time, so
+        that is the query's footprint; the concurrent scheduler
+        (ROADMAP [serving]) must give each query its own manager (or a
+        per-query ledger) for the cap to stay per-query."""
+        global _QUOTA_CACHE
+        from auron_tpu import config as cfg
+        epoch, val = _QUOTA_CACHE
+        if epoch == cfg.config_epoch():
+            return val
+        try:
+            val = int(cfg.get_config().get(cfg.MEMMGR_QUERY_QUOTA_BYTES))
+        except Exception:   # pragma: no cover - config always resolvable
+            val = 0
+        _QUOTA_CACHE = (cfg.config_epoch(), val)
+        return val
+
+    def advised_batch_rows(self, base: int) -> int:
+        """Pressure-adapted scan granularity: every shrink rung taken
+        halves the advised batch rows (floor ``base/8``, never below
+        256), so the scans feeding a struggling query deliver smaller
+        device batches instead of ramming full-capacity ones into a
+        budget that just denied. Scans consult this per batch
+        (io/parquet.ParquetScanOp)."""
+        lvl = self._shrink_level
+        if lvl <= 0:
+            return base
+        return max(base >> min(lvl, 3), min(base, 256))
+
+    def _count_rung(self, rung: str) -> None:
+        self.pressure_counts[rung] = self.pressure_counts.get(rung, 0) + 1
+        if self._registry_enabled():
+            try:
+                from auron_tpu.obs import registry as obs_registry
+                obs_registry.get_registry().counter(
+                    "auron_memmgr_pressure_total", rung=rung).inc()
+            except Exception:   # pragma: no cover - telemetry best-effort
+                pass
+
+    def _pressure_ladder(self, c: MemConsumer, budget: int,
+                         forced: bool = False) -> bool:
+        """Walk the degradation rungs after the spill loop ran dry still
+        over budget: (1) **shrink** — bump the advised-batch-rows hint
+        and ask the REQUESTER to shrink (partial release, cheaper than a
+        full spill); (2) **force-spill** — spill the largest consumer
+        ignoring ``min_trigger`` (small consumers add up); (3) **shed**
+        — fail THIS query with the classified ``errors.MemoryExhausted``
+        (policy 'shed', or any per-query quota breach), never the
+        process — or, under the default 'degrade' policy, record a
+        survivable deny. Returns True when any rung freed bytes.
+        ``forced`` (the memmgr.deny chaos site) treats every rung as
+        over budget so the whole ladder gets traffic."""
+        from auron_tpu import config as cfg
+        from auron_tpu.obs import trace
+        policy = cfg.get_config().get(cfg.MEMMGR_PRESSURE_POLICY)
+        cname = getattr(c, "consumer_name", "?")
+
+        def over() -> tuple[bool, int]:
+            with self._lock:
+                total_used = sum(self._used.values())
+            return (forced or total_used > budget), total_used
+
+        if policy == "legacy":
+            _o, total_used = over()
+            self._count_rung("deny")
+            trace.event("memory", "memmgr.deny", consumer=cname,
+                        total_used=total_used, budget=self.total)
+            return False
+
+        freed_any = False
+        # rung 1: shrink — advise smaller scan batches from here on and
+        # ask the requester for a partial release
+        is_over, total_used = over()
+        if is_over:
+            self._shrink_level = min(self._shrink_level + 1, 3)
+            self._comfort_grants = 0
+            shrink_fn = getattr(c, "shrink", None)   # duck-typed consumers
+            try:
+                freed = int(shrink_fn() or 0) if shrink_fn else 0
+            except Exception:   # pragma: no cover - consumer bug guard
+                logger.exception("memmgr: %s.shrink() failed", cname)
+                freed = 0
+            if freed:
+                freed_any = True
+                with self._lock:
+                    self._used[c] = max(self._used.get(c, 0) - freed, 0)
+                    self.num_spills += 1
+                    self.spilled_bytes += freed
+            self._count_rung("shrink")
+            trace.event("memory", "memmgr.pressure", rung="shrink",
+                        consumer=cname, freed=freed,
+                        advised_shift=self._shrink_level)
+
+        # rung 2: force-spill the largest holder, min_trigger waived —
+        # under real pressure many small consumers add up to the budget
+        is_over, total_used = over()
+        if is_over:
+            with self._lock:
+                candidates = [(u, v) for v, u in self._used.items()
+                              if u > 0]
+            freed = 0
+            if candidates:
+                _, victim = max(candidates, key=lambda t: t[0])
+                with trace.span("memory", "memmgr.spill",
+                                victim=getattr(victim, "consumer_name",
+                                               "?"),
+                                total_used=total_used, budget=self.total,
+                                rung="force_spill") as sp:
+                    freed = victim.spill()
+                    sp.set(freed=freed)
+                with self._lock:
+                    self._used[victim] = max(
+                        self._used.get(victim, 0) - freed, 0)
+                    if freed:
+                        self.num_spills += 1
+                        self.spilled_bytes += freed
+                if freed:
+                    freed_any = True
+            self._count_rung("force_spill")
+            trace.event("memory", "memmgr.pressure", rung="force_spill",
+                        consumer=cname, freed=freed)
+
+        # rung 3: shed or survivable deny
+        is_over, total_used = over()
+        if is_over:
+            quota = self._query_quota()
+            if policy == "shed" or (quota and total_used > quota):
+                self._count_rung("shed")
+                trace.event("memory", "memmgr.shed", consumer=cname,
+                            total_used=total_used, budget=self.total,
+                            quota=quota)
+                from auron_tpu import errors
+                raise errors.MemoryExhausted(
+                    f"memory pressure unresolved after the degradation "
+                    f"ladder: {total_used} bytes used against budget "
+                    f"{self.total}" + (f" (query quota {quota})"
+                                       if quota else "")
+                    + f"; shedding the query (requester {cname})",
+                    site="memmgr.deny")
+            self._count_rung("deny")
+            trace.event("memory", "memmgr.deny", consumer=cname,
+                        total_used=total_used, budget=self.total)
+        return freed_any
 
     @staticmethod
     def _registry_enabled() -> bool:

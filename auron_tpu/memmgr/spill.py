@@ -98,6 +98,10 @@ class Spill:
             fd, self._path = tempfile.mkstemp(
                 prefix=f"auron-spill-{self.spill_id}-", suffix=".atb",
                 dir=self._mgr.spill_dir)
+            # registered with the manager so a crashed attempt's orphan
+            # is swept at Session close (sweep_orphans) — the spill-tier
+            # equivalent of the RSS commit-time .part sweep
+            self._mgr._track_path(self._path)
             self._file = os.fdopen(fd, "wb")
             self._file.write(_SPILL_MAGIC + struct.pack("<B", self._algo))
             self.disk_bytes += _HEADER_SIZE
@@ -214,8 +218,10 @@ class Spill:
         self._mgr.release_host(self.mem_bytes)
         self._mem_frames.clear()
         self.mem_bytes = 0
-        if self._path is not None and os.path.exists(self._path):
-            os.unlink(self._path)
+        if self._path is not None:
+            if os.path.exists(self._path):
+                os.unlink(self._path)
+            self._mgr._untrack_path(self._path)
         self._path = None
 
 
@@ -237,8 +243,50 @@ class SpillManager:
         self._lock = threading.RLock()
         self._host_used = 0
         self._next_id = 0
+        #: every disk-tier file this manager created and has not yet
+        #: seen released — the sweep ledger (scoped to THIS manager so a
+        #: sweep can never delete another process's spills in a shared
+        #: temp dir)
+        self._live_paths: set[str] = set()
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
+
+    def _track_path(self, path: str) -> None:
+        with self._lock:
+            self._live_paths.add(path)
+
+    def _untrack_path(self, path: str) -> None:
+        with self._lock:
+            self._live_paths.discard(path)
+
+    def sweep_orphans(self) -> int:
+        """Delete every disk spill file this manager created that was
+        never released — orphans of crashed/cancelled attempts (PR 4
+        added the commit-time ``.part`` sweep for the RSS tier; this is
+        the spill-tier equivalent, run at Session close). Returns how
+        many files were removed. Ledger-scoped: files of other managers
+        or processes in the same directory are never touched."""
+        with self._lock:
+            paths, self._live_paths = self._live_paths, set()
+        removed = 0
+        for p in paths:
+            try:
+                if os.path.exists(p):
+                    os.unlink(p)
+                    removed += 1
+            except OSError:   # pragma: no cover - fs race
+                pass
+        if removed:
+            import logging
+            logging.getLogger("auron_tpu.memmgr").warning(
+                "spill sweep removed %d orphaned spill file(s) at close",
+                removed)
+        return removed
+
+    def live_disk_files(self) -> int:
+        """Disk-tier files currently tracked (the leak-audit probe)."""
+        with self._lock:
+            return len(self._live_paths)
 
     @property
     def host_used(self) -> int:

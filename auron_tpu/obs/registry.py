@@ -260,6 +260,9 @@ def _collect_runtime() -> list[str]:
         from auron_tpu.runtime import watchdog
         lines.append("# TYPE auron_watchdog_fallbacks_total counter")
         lines.append(f"auron_watchdog_fallbacks_total {watchdog.totals()}")
+        lines.append("# TYPE auron_watchdog_stalls_total counter")
+        lines.append(f"auron_watchdog_stalls_total "
+                     f"{watchdog.stall_totals()}")
     except Exception:
         pass
     try:

@@ -179,6 +179,11 @@ class ExecContext:
     #: (serving handler, task-kill) flips it from another thread and
     #: operators poll between batches.
     cancel_event: object = field(default_factory=lambda: _new_event())
+    #: the task's stall-watchdog heartbeat (runtime/watchdog.TaskHeartbeat)
+    #: when auron.watchdog.stall_timeout_s arms the monitor; operators
+    #: beat it through ``checkpoint`` so the monitor can tell a slow
+    #: batch from a wedged one
+    heartbeat: Optional[object] = None
     # typed config (auron_tpu.config); None = process-wide defaults
     config: Optional[object] = None
     #: per-op-INSTANCE metric sets keyed (id(op), suffix) — the
@@ -197,8 +202,8 @@ class ExecContext:
             stage_id=self.stage_id, partition_id=self.partition_id,
             task_id=self.task_id, num_partitions=self.num_partitions,
             metrics=self.metrics, mem_manager=self.mem_manager,
-            cancel_event=self.cancel_event, config=self.config,
-            op_metrics=self.op_metrics)
+            cancel_event=self.cancel_event, heartbeat=self.heartbeat,
+            config=self.config, op_metrics=self.op_metrics)
         base.update(overrides)
         return ExecContext(**base)
 
@@ -212,13 +217,60 @@ class ExecContext:
         return ev is not None and ev.is_set()
 
     def check_cancelled(self) -> None:
-        """Raise TaskCancelled if the host tore this task down — called
-        by operators between child batches so a cancel lands within one
-        batch of compute."""
-        if self.cancelled:
+        """Raise the task's teardown error if the host tore it down —
+        called by operators between child batches so a cancel lands
+        within one batch of compute. Three teardown verdicts, most
+        specific first: a stall flag from the watchdog monitor raises
+        the classified ``errors.TaskStalled`` (retry driver: transient
+        once); a CancelToken registry raises its own classified error
+        (QueryCancelled / DeadlineExceeded by reason); a bare Event
+        registry keeps the legacy TaskCancelled."""
+        hb = self.heartbeat
+        if hb is not None and getattr(hb, "stalled", False):
+            from auron_tpu import errors
+            raise errors.TaskStalled(
+                f"task {self.task_id} (stage {self.stage_id}, partition "
+                f"{self.partition_id}) flagged stalled by the watchdog "
+                f"(last heartbeat at {hb.last_site or '?'})")
+        ev = self.cancel_event
+        if ev is not None and ev.is_set():
+            raise_for = getattr(ev, "raise_for_status", None)
+            if raise_for is not None:
+                raise_for()
             raise TaskCancelled(
                 f"task {self.task_id} (stage {self.stage_id}, partition "
                 f"{self.partition_id}) was cancelled")
+
+    def checkpoint(self, site: str = "") -> None:
+        """The cooperative-lifecycle poll for long-running loops (batch
+        drives, shuffle fetch/materialize, spill consumers): beat the
+        stall watchdog with ``site`` (the last-heartbeat attribution a
+        StallReport prints), give the lifecycle chaos sites traffic
+        (``cancel.race`` races a cancel against this very poll,
+        ``task.hang`` wedges mid-stream — both no-ops at one cached
+        epoch-compare each when unarmed), AND surface any pending
+        cancellation."""
+        hb = self.heartbeat
+        if hb is not None:
+            hb.beat(site)
+        from auron_tpu.runtime import faults
+        faults.lifecycle_poll(self)
+        if hb is not None and not hb.stalled:
+            # an injected hang may have slept here: re-beat so the
+            # SLEEP is not misread as the task's own silence (a stall
+            # flag set meanwhile survives — beats never clear it)
+            hb.beat(site)
+        self.check_cancelled()
+
+    @property
+    def should_stop(self) -> bool:
+        """True when this task must unwind (cancelled OR stall-flagged)
+        without raising — the poll the fault plane's interruptible hang
+        loop uses (runtime/faults.maybe_fail)."""
+        hb = self.heartbeat
+        if hb is not None and getattr(hb, "stalled", False):
+            return True
+        return self.cancelled
 
     @property
     def conf(self):

@@ -342,13 +342,16 @@ class SortOp(PhysicalOp):
                 consumer.spill()   # final in-mem run joins the merge
             for host in merge_sorted_runs(
                     [s.frames() for s in consumer.spills]):
+                # lifecycle poll per merged run batch: cancels land
+                # mid-merge and the stall watchdog sees spill progress
+                ctx.checkpoint("spill.merge")
                 yield host_to_batch(host, bucket_rows(host.num_rows))
 
         def stream():
             if not spillable:
                 collected = []
                 for b in self.child.execute(partition, ctx):
-                    ctx.check_cancelled()   # cancel lands mid-collect too
+                    ctx.checkpoint("sort.collect")   # cancel lands mid-collect too
                     collected.append(b)
                 yield from self._limit(in_mem_stream(collected))
                 return
@@ -356,7 +359,7 @@ class SortOp(PhysicalOp):
                                           conf=ctx.conf)
             try:
                 for batch in self.child.execute(partition, ctx):
-                    ctx.check_cancelled()
+                    ctx.checkpoint("sort.collect")
                     consumer.add(batch)
                 # claim the buffer FIRST (take_buffered) so a concurrent
                 # victim spill can't serialize batches the in-mem sort

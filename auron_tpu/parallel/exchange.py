@@ -315,7 +315,7 @@ class ShuffleExchangeOp(PhysicalOp):
             map_ctx = ctx.child(partition_id=in_p,
                                 num_partitions=self.input_partitions)
             for b in self.child.execute(in_p, map_ctx):
-                map_ctx.check_cancelled()
+                map_ctx.checkpoint("shuffle.map")
                 yield b
 
     def _materialize(self, ctx: ExecContext) -> _ExchangeBuffer:
@@ -327,13 +327,25 @@ class ShuffleExchangeOp(PhysicalOp):
             return self._materialize_inner(ctx)
 
     def _materialize_inner(self, ctx: ExecContext) -> _ExchangeBuffer:
-        from auron_tpu import config as cfg
         metrics = ctx.metrics_for(self)
         write_time = metrics.counter("shuffle_write_total_time")
-        n_out = self.num_partitions
-        schema = self.child.schema()
-        _sync = ctx.device_sync
         buffer = _ExchangeBuffer(self, ctx.mem_manager, metrics, ctx.conf)
+        try:
+            return self._fill_buffer(ctx, buffer, write_time)
+        except BaseException:
+            # a cancelled/failed materialization must not leave the
+            # half-filled buffer registered with the memory manager (or
+            # its spill files on disk) until gc finds it — the
+            # zero-leaked-consumers contract of the cancel battery
+            buffer.close()
+            raise
+
+    def _fill_buffer(self, ctx: ExecContext, buffer: "_ExchangeBuffer",
+                     write_time) -> "_ExchangeBuffer":
+        from auron_tpu import config as cfg
+        schema = self.child.schema()
+        n_out = self.num_partitions
+        _sync = ctx.device_sync
 
         part_sig = _split_signature(self.partitioning)
         if part_sig is not None and ctx.conf.get(cfg.FUSION_ENABLED) \
@@ -444,7 +456,7 @@ class ShuffleExchangeOp(PhysicalOp):
             carries = jnp.concatenate(
                 [jnp.asarray(init, jnp.int64), split_seen])
             for batch in input_op.execute(in_p, map_ctx):
-                map_ctx.check_cancelled()
+                map_ctx.checkpoint("shuffle.map")
                 kern, built = _fused_split_program(
                     frag_keys, part_sig, in_schema, out_schema, n_out,
                     batch.capacity, donate, fragments, part_exprs)
@@ -473,13 +485,20 @@ class ShuffleExchangeOp(PhysicalOp):
         metrics = ctx.metrics_for(self, "_read")
         read_time = metrics.counter("shuffle_read_total_time")
 
+        def polled(buf):
+            # lifecycle poll per fetched batch: a cancel mid-fetch lands
+            # within one batch, and the stall watchdog sees the reducer
+            # making progress
+            for b in buf.partition_batches(partition):
+                ctx.checkpoint("shuffle.fetch")
+                yield b
+
         # production-segment timing only (obs/trace.stream_spanned): the
         # read timer must not bill the consumer's compute, and the span
         # must not stay open across yields
         from auron_tpu.obs import trace
         stream = trace.stream_spanned(
-            "shuffle", "shuffle.fetch",
-            self._buffer.partition_batches(partition),
+            "shuffle", "shuffle.fetch", polled(self._buffer),
             time_counter=read_time, partition=partition)
         return count_output(stream, metrics, timed=True)
 
@@ -587,6 +606,10 @@ class RssShuffleExchangeOp(PhysicalOp):
                 self.service.partition_writer(self.shuffle_id, in_p,
                                               n_out) as writer:
             for batch in itertools.chain(pending, batches):
+                # lifecycle poll per map batch: a cancel mid-write
+                # aborts through the writer's context manager (no .part
+                # left behind) and the heartbeat shows write progress
+                ctx.checkpoint("rss.map_write")
                 n_in = int(batch.num_rows) if donate else None
                 with timer(write_time, sync=_sync) as t:
                     if isinstance(partitioning, RoundRobinPartitioning):
@@ -674,6 +697,7 @@ class RssShuffleExchangeOp(PhysicalOp):
             # re-yields data a downstream operator already consumed
             maps = self.service.committed_maps(self.shuffle_id)
             for map_id in range(len(maps)):
+                ctx.checkpoint("rss.fetch")
                 for frame in self._fetch_map(map_id, partition, ctx):
                     # deserialize INSIDE the timer, yield OUTSIDE it: a
                     # yield under the timer would bill the consumer's
@@ -870,12 +894,19 @@ class BroadcastExchangeOp(PhysicalOp):
                                 maps=self.input_partitions):
                     buf = _BroadcastBuffer(self, ctx.mem_manager, metrics,
                                            conf=ctx.config)
-                    for in_p in range(self.input_partitions):
-                        map_ctx = ctx.child(
-                            partition_id=in_p,
-                            num_partitions=self.input_partitions)
-                        for b in self.child.execute(in_p, map_ctx):
-                            map_ctx.check_cancelled()
-                            buf.add(b)
+                    try:
+                        for in_p in range(self.input_partitions):
+                            map_ctx = ctx.child(
+                                partition_id=in_p,
+                                num_partitions=self.input_partitions)
+                            for b in self.child.execute(in_p, map_ctx):
+                                map_ctx.checkpoint("broadcast.collect")
+                                buf.add(b)
+                    except BaseException:
+                        # cancelled/failed collect: release the
+                        # half-filled buffer (consumer + spills) now,
+                        # not at gc time
+                        buf.close()
+                        raise
                     self._buffer = buf
         return count_output(self._buffer.replay(), metrics, timed=True)

@@ -43,11 +43,12 @@ class ExecutionRuntime:
 
     def __init__(self, plan: PhysicalOp, task: TaskDefinition,
                  mem_manager=None, config=None, attempt: int = 0,
-                 retry_stats: Optional[dict] = None):
+                 retry_stats: Optional[dict] = None, cancel_token=None):
         self.plan = plan
         self.task = task
         self.attempt = attempt
         self.retry_stats = retry_stats if retry_stats is not None else {}
+        self.cancel_token = cancel_token
         self.ctx = ExecContext(
             stage_id=task.stage_id,
             partition_id=task.partition_id,
@@ -56,6 +57,11 @@ class ExecutionRuntime:
             mem_manager=mem_manager,
             config=config,
         )
+        if cancel_token is not None:
+            # the query's CancelToken IS the task's cancellation
+            # registry: socket-level CANCEL, session.cancel(query_id)
+            # and deadline expiry all land through one mechanism
+            self.ctx.cancel_event = cancel_token
         self._started = time.time()
         # per-task XLA compile attribution (round-5 directive 7): NEW
         # program builds during this task surface in the finalize metrics
@@ -107,7 +113,7 @@ class ExecutionRuntime:
         from auron_tpu.obs import profile as _profile
         from auron_tpu.obs import trace
         from auron_tpu.ops.base import TaskCancelled
-        from auron_tpu.runtime import faults
+        from auron_tpu.runtime import faults, watchdog
         # drive-loop glue (cancel polls, fault checks, generator
         # bookkeeping between batches) attributed to the ROOT plan node
         # as the "iter" host bucket — the per-batch host tax the fused
@@ -115,6 +121,13 @@ class ExecutionRuntime:
         iter_c = (self.ctx.metrics_for(self.plan)
                   .counter("elapsed_host_iter")
                   if _profile.enabled() else None)
+        # stall-watchdog heartbeat: per ATTEMPT (a retry self-clears the
+        # stall flag by registering a fresh heartbeat); None disarmed
+        hb = watchdog.register_heartbeat(
+            task_id=self.task.task_id, stage_id=self.task.stage_id,
+            partition_id=self.task.partition_id, attempt=self.attempt,
+            config=self.ctx.config)
+        self.ctx.heartbeat = hb
         try:
             with trace.span("task", "task.attempt",
                             stage=self.task.stage_id,
@@ -125,15 +138,19 @@ class ExecutionRuntime:
                                                self.ctx):
                     t0 = (time.perf_counter_ns() if iter_c is not None
                           else 0)
-                    self.ctx.check_cancelled()
+                    # checkpoint covers the lifecycle plane: heartbeat,
+                    # cancel.race / task.hang injection, cancel raise
+                    self.ctx.checkpoint("task.batch")
                     faults.maybe_fail("device.compute",
                                       errors.DeviceExecutionError)
                     if iter_c is not None:
                         iter_c.add(time.perf_counter_ns() - t0)
                     yield batch
-        except TaskCancelled:
+        except (TaskCancelled, errors.QueryCancelled):
             # reference behavior: task-kill is teardown, not failure
-            # (is_task_running checks, rt.rs:208-238)
+            # (is_task_running checks, rt.rs:208-238); the classified
+            # QueryCancelled/DeadlineExceeded unwind the same way but
+            # keep their verdict for the caller
             logger.info(
                 "task cancelled: stage=%d partition=%d task=%d",
                 self.task.stage_id, self.task.partition_id,
@@ -166,6 +183,8 @@ class ExecutionRuntime:
                 "task failed: stage=%d partition=%d task=%d",
                 self.task.stage_id, self.task.partition_id, self.task.task_id)
             raise
+        finally:
+            watchdog.unregister_heartbeat(hb)
 
     def arrow_batches(self) -> Iterator[pa.RecordBatch]:
         """Host materialization (the FFI export boundary of the reference).
@@ -298,7 +317,8 @@ def _observe_task(rt: "ExecutionRuntime", table: pa.Table,
 
 def run_task_with_retries(plan: PhysicalOp, partition: int,
                           num_partitions: int, mem_manager=None,
-                          config=None, metric_tree=None) -> pa.Table:
+                          config=None, metric_tree=None,
+                          cancel_token=None) -> pa.Table:
     """Run one (plan, partition) task, retrying transient failures at
     partition granularity — the retry driver the reference delegates to
     Spark's task scheduler (SURVEY §5.3; rt.rs's is_task_running checks
@@ -312,33 +332,70 @@ def run_task_with_retries(plan: PhysicalOp, partition: int,
     classified errors carry their own ``transient`` verdict — the
     device-compute boundary classifies XLA's ambiguous RuntimeErrors
     before they get here, so NO message-pattern matching happens on the
-    retry path. Cancellation is surfaced immediately, never retried."""
+    retry path. Cancellation is surfaced immediately, never retried
+    (and its cancel-to-unwind latency feeds the registry histogram);
+    a stall verdict (errors.TaskStalled) retries exactly ONCE.
+
+    ``cancel_token`` (runtime/lifecycle.CancelToken) is the query's
+    cancellation registry: checked before every attempt, installed as
+    every runtime's cancel_event, and it bounds the backoff sleeps —
+    clamped to the remaining deadline budget and woken by a cancel."""
     import time as _time
 
     from auron_tpu import config as cfg
     from auron_tpu import errors
     from auron_tpu.ops.base import TaskCancelled
+    from auron_tpu.runtime import lifecycle
 
     conf = config if config is not None else cfg.get_config()
     retries = max(0, int(conf.get(cfg.TASK_MAX_RETRIES)))
     backoff = float(conf.get(cfg.TASK_RETRY_BACKOFF_S))
     backoff_cap = float(conf.get(cfg.TASK_RETRY_BACKOFF_MAX_S))
-    retry_stats = {"transient_retries": 0}
+    retry_stats = {"transient_retries": 0, "stall_retries": 0}
     last_err = None
     for attempt in range(retries + 1):
+        if cancel_token is not None:
+            # a cancel that lands between attempts must not start one
+            cancel_token.raise_for_status()
         rt = ExecutionRuntime(
             plan,
             TaskDefinition(partition_id=partition,
                            num_partitions=num_partitions,
                            task_id=partition * 1000 + attempt),
             mem_manager=mem_manager, config=config,
-            attempt=attempt, retry_stats=retry_stats)
+            attempt=attempt, retry_stats=retry_stats,
+            cancel_token=cancel_token)
         try:
             table = rt.collect()
             _observe_task(rt, table, metric_tree)
             return table
         except TaskCancelled:
             raise
+        except errors.QueryCancelled:
+            # classified cancellation (cancel or deadline): surface
+            # immediately and record how long the unwind took from the
+            # moment the token flipped — the acceptance gate's number
+            if cancel_token is not None:
+                lifecycle.observe_unwind(
+                    cancel_token, kind=cancel_token.reason or "cancel")
+            raise
+        except errors.TaskStalled as e:
+            # the watchdog's verdict is transient ONCE: a wedged
+            # external dependency may have healed, but an infinite
+            # stall-retry loop would hide a deterministic wedge forever
+            lifecycle.observe_unwind(_stall_latency_s(rt), kind="stall")
+            if retry_stats["stall_retries"] >= 1 or attempt >= retries:
+                raise
+            retry_stats["stall_retries"] += 1
+            retry_stats["transient_retries"] += 1
+            last_err = e
+            logger.warning(
+                "task attempt %d/%d stalled for partition %d (%s); "
+                "retrying once", attempt + 1, retries + 1, partition, e)
+            from auron_tpu.obs import trace
+            trace.event("task", "task.retry", partition=partition,
+                        attempt=attempt, backoff_s=0.0,
+                        error=type(e).__name__)
         except Exception as e:         # noqa: BLE001 — retry boundary
             # non-transient classes — plan/schema/engine defects,
             # classified corruption needing a DIFFERENT recovery
@@ -355,24 +412,50 @@ def run_task_with_retries(plan: PhysicalOp, partition: int,
                 "task attempt %d/%d failed for partition %d (%s); "
                 "retrying", attempt + 1, retries + 1, partition, e)
             delay = _retry_backoff_s(attempt, backoff, backoff_cap)
+            if cancel_token is not None:
+                rem = cancel_token.remaining()
+                if rem is not None:
+                    # never sleep past the deadline budget: a backoff
+                    # that outlives the deadline just converts a retry
+                    # into a guaranteed DeadlineExceeded later
+                    delay = min(delay, rem)
             from auron_tpu.obs import trace
             trace.event("task", "task.retry", partition=partition,
                         attempt=attempt, backoff_s=round(delay, 4),
                         error=type(e).__name__)
             if delay > 0:
-                _time.sleep(delay)
+                if cancel_token is not None:
+                    # interruptible: wakes (and raises) on cancellation
+                    # instead of sleeping out the full jittered interval
+                    cancel_token.sleep(delay)
+                else:
+                    _time.sleep(delay)
     raise last_err
 
 
+def _stall_latency_s(rt: "ExecutionRuntime"):
+    """Stall-flag-to-unwind latency of one attempt (None when the
+    heartbeat carries no stall timestamp)."""
+    hb = getattr(rt.ctx, "heartbeat", None)
+    if hb is None or not getattr(hb, "stalled_at_ns", 0):
+        return None
+    import time as _time
+    return (_time.monotonic_ns() - hb.stalled_at_ns) * 1e-9
+
+
 def collect(plan: PhysicalOp, num_partitions: int = 1,
-            mem_manager=None, config=None, metric_tree=None) -> pa.Table:
+            mem_manager=None, config=None, metric_tree=None,
+            cancel_token=None) -> pa.Table:
     """Run every partition of a plan and concatenate (driver-side
     collect), with per-partition transient-failure retries.
     ``metric_tree`` (obs/metric_tree.build_tree(plan)) accumulates every
-    task's per-op metrics positionally — the EXPLAIN ANALYZE source."""
+    task's per-op metrics positionally — the EXPLAIN ANALYZE source.
+    ``cancel_token`` threads the query's cancellation registry through
+    every partition's retry driver."""
     tables = []
     for p in range(num_partitions):
         tables.append(run_task_with_retries(
             plan, p, num_partitions, mem_manager=mem_manager,
-            config=config, metric_tree=metric_tree))
+            config=config, metric_tree=metric_tree,
+            cancel_token=cancel_token))
     return pa.concat_tables(tables)

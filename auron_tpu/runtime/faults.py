@@ -24,15 +24,27 @@ Plan grammar (``auron.faults.plan``)::
   of the payload AFTER its checksum was computed, simulating storage
   bit rot the integrity layer must catch. Ignored at fail-only sites.
 - ``hang``      — sleep ``auron.faults.hang_s`` seconds (simulates the
-  wedged axon backend init; pair with the watchdog deadline).
+  wedged axon backend init; pair with the watchdog deadline). The sleep
+  POLLS the caller's cancel registry (``maybe_fail(..., cancel=ctx)``)
+  so a cooperative cancel — or a stall-watchdog flag — terminates an
+  injected hang promptly instead of blocking the full interval.
+- ``cancel``    — lifecycle chaos (``maybe_cancel``): fire the task's
+  cancel registry at a seeded event index, racing cancellation against
+  live batch traffic (the ``cancel.race`` site).
+- ``deny``      — memory-pressure chaos (``fires``): force the memory
+  manager's degradation ladder as if the budget were exhausted
+  (the ``memmgr.deny`` site).
 
 Named sites threaded through the engine:
 
     rss.write | rss.flush | rss.commit | rss.fetch      (shuffle tier)
     spill.write | spill.read                            (spill tier)
     device.compute                                      (per batch)
+    task.hang                                           (per batch, mid-drive)
+    cancel.race                                         (per batch, lifecycle)
     program.build                                       (compile sites)
     backend.init                                        (watchdog probe)
+    memmgr.deny                                         (pressure ladder)
 
 The plane is resolved from the PROCESS-GLOBAL config (the sites live in
 code paths with no ExecContext at hand — file services, spill files),
@@ -55,9 +67,10 @@ SITES = (
     "rss.write", "rss.flush", "rss.commit", "rss.fetch",
     "spill.write", "spill.read",
     "device.compute", "program.build", "backend.init",
+    "task.hang", "cancel.race", "memmgr.deny",
 )
 
-KINDS = ("io_error", "fatal", "corrupt", "hang")
+KINDS = ("io_error", "fatal", "corrupt", "hang", "cancel", "deny")
 
 
 @dataclass(frozen=True)
@@ -215,11 +228,36 @@ def reset() -> None:
         _CACHED = (-1, None)
 
 
-def maybe_fail(site: str, exc_cls=errors.TransientError) -> None:
+def _stop_requested(cancel) -> bool:
+    """Duck-typed poll of a cancel registry: ExecContext (``should_stop``
+    covers both the cancel event and the stall flag), CancelToken /
+    threading.Event (``is_set``)."""
+    if cancel is None:
+        return False
+    stop = getattr(cancel, "should_stop", None)
+    if stop is not None:
+        return bool(stop)
+    is_set = getattr(cancel, "is_set", None)
+    return bool(is_set()) if is_set is not None else False
+
+
+#: poll granularity of interruptible injected hangs (a cancel lands
+#: within one tick, far inside the watchdog's stall resolution)
+_HANG_POLL_S = 0.02
+
+
+def maybe_fail(site: str, exc_cls=errors.TransientError,
+               cancel=None) -> None:
     """Injection hook for failure sites: raises the plan's armed fault
     (``exc_cls`` for io_error — the call site's transient error class —
     InjectedFatalError for fatal), or sleeps for hang. No-op when the
-    site is unarmed."""
+    site is unarmed.
+
+    ``cancel`` (an ExecContext, CancelToken or Event) makes an injected
+    hang INTERRUPTIBLE: the sleep polls it and returns early on a
+    cooperative cancel or stall flag, so chaos cancel tests terminate
+    promptly — the caller's next checkpoint raises the classified
+    error."""
     plane = _active()
     if plane is None:
         return
@@ -232,7 +270,7 @@ def maybe_fail(site: str, exc_cls=errors.TransientError) -> None:
     trace.event("fault", "fault.injected", site=site, kind=rule.kind,
                 seed=plane.seed)
     if rule.kind == "hang":
-        time.sleep(plane.hang_s)
+        _interruptible_sleep(plane.hang_s, cancel)
         return
     if rule.kind == "fatal":
         raise errors.InjectedFatalError(
@@ -240,6 +278,91 @@ def maybe_fail(site: str, exc_cls=errors.TransientError) -> None:
             f"(seed={plane.seed})", site=site)
     raise exc_cls(f"injected {rule.kind} at {site} (seed={plane.seed})",
                   site=site)
+
+
+def _interruptible_sleep(seconds: float, cancel) -> None:
+    """The injected-hang sleep: returns early the moment the caller's
+    cancel registry (or stall flag) trips."""
+    end = time.monotonic() + seconds
+    while True:
+        left = end - time.monotonic()
+        if left <= 0 or _stop_requested(cancel):
+            return
+        wait = getattr(cancel, "wait", None)
+        if wait is not None:
+            # event/token wait wakes the instant a cancel lands
+            wait(min(_HANG_POLL_S, left))
+        else:
+            time.sleep(min(_HANG_POLL_S, left))
+
+
+def maybe_hang(site: str, cancel=None) -> bool:
+    """Hang-only injection hook for checkpoint sites (``task.hang``):
+    sleeps the armed hang interval — interruptibly, polling ``cancel``
+    — and reports whether a hang was injected. Never raises: checkpoint
+    callers surface whatever the hang provoked (stall flag, cancel)
+    through check_cancelled."""
+    plane = _active()
+    if plane is None:
+        return False
+    rule = plane.fire(site, ("hang",))
+    if rule is None:
+        return False
+    from auron_tpu.obs import trace
+    trace.event("fault", "fault.injected", site=site, kind="hang",
+                seed=plane.seed)
+    _interruptible_sleep(plane.hang_s, cancel)
+    return True
+
+
+def lifecycle_poll(ctx) -> None:
+    """The checkpoint-site fast path: ONE armed/disarmed verdict check
+    covering both lifecycle sites (``cancel.race`` + ``task.hang``).
+    ExecContext.checkpoint calls this per loop iteration, so the
+    unarmed cost must stay one function call + one epoch compare."""
+    if _active() is None:
+        return
+    maybe_cancel("cancel.race", ctx)
+    maybe_hang("task.hang", cancel=ctx)
+
+
+def maybe_cancel(site: str, target) -> bool:
+    """Lifecycle injection hook (site ``cancel.race``, kind ``cancel``):
+    fire the task's cancel registry at this seeded event index — racing
+    cancellation against live traffic so the chaos battery proves every
+    interleaving unwinds classified and leak-free. ``target`` is
+    anything with ``cancel()`` (ExecContext, CancelToken). Returns True
+    when the cancel fired."""
+    plane = _active()
+    if plane is None:
+        return False
+    rule = plane.fire(site, ("cancel",))
+    if rule is None:
+        return False
+    from auron_tpu.obs import trace
+    trace.event("fault", "fault.injected", site=site, kind="cancel",
+                seed=plane.seed)
+    cancel = getattr(target, "cancel", None)
+    if cancel is not None:
+        cancel()
+    return True
+
+
+def fires(site: str, kind: str) -> bool:
+    """Boolean injection hook for sites whose fault is a forced DECISION
+    rather than a raise (``memmgr.deny``: pretend the budget is
+    exhausted and walk the degradation ladder). Advances the rule's
+    deterministic event counter like every other site."""
+    plane = _active()
+    if plane is None:
+        return False
+    rule = plane.fire(site, (kind,))
+    if rule is None:
+        return False
+    from auron_tpu.obs import trace
+    trace.event("fault", "fault.injected", site=site, kind=kind,
+                seed=plane.seed)
+    return True
 
 
 def maybe_corrupt(site: str, data: bytes) -> bytes:

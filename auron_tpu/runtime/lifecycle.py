@@ -1,0 +1,182 @@
+"""Query lifecycle control plane: deadlines + cooperative cancellation.
+
+The reference brackets every task with hard teardown semantics
+(callNative / nextBatch / finalizeNative — a task can always be
+finalized mid-stream from the host side, rt.rs:76-300); this module is
+the host-side half of that contract for the TPU engine: a per-query
+``CancelToken`` that every layer polls cooperatively.
+
+One token per top-level query, created by ``Session.execute`` (or the
+serving handler) and threaded through the retry driver into every
+ExecContext as its ``cancel_event``. The token is a drop-in for the
+legacy ``threading.Event`` registry — it implements ``set``/``is_set``/
+``wait`` — but additionally carries:
+
+- an optional **deadline** (monotonic): the first ``is_set`` check past
+  it self-cancels with reason ``deadline``, so deadline enforcement
+  needs no timer thread — any poll site notices;
+- a **reason** (``cancelled`` | ``deadline``) that decides which
+  classified error unwinds the task (``errors.QueryCancelled`` vs
+  ``errors.DeadlineExceeded``);
+- the **cancel timestamp**, which the retry driver turns into the
+  ``auron_cancel_latency_seconds`` registry histogram — the measured
+  cancel-to-unwind latency the acceptance gate reads (PERF.md
+  "Lifecycle guarantees").
+
+Cancellation is FIRST-WINS and idempotent: a second ``cancel`` (the
+after-DONE no-op of the race battery) keeps the original reason and
+timestamp.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class CancelToken:
+    """Per-query cancellation registry with an optional deadline.
+
+    Event-compatible (``set``/``is_set``/``wait``) so it slots directly
+    into ``ExecContext.cancel_event`` and the serving handler's window
+    loop; richer callers use ``cancel(reason)`` / ``raise_for_status`` /
+    ``sleep`` (the interruptible, deadline-clamped backoff primitive).
+    """
+
+    __slots__ = ("query_id", "_event", "_lock", "_deadline", "reason",
+                 "cancelled_at_ns")
+
+    def __init__(self, query_id: str = "", deadline_s: Optional[float] = None):
+        self.query_id = query_id
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._deadline = (time.monotonic() + deadline_s
+                         if deadline_s is not None and deadline_s > 0
+                         else None)
+        #: first-wins cancel reason: "cancelled" | "deadline"
+        self.reason: Optional[str] = None
+        #: monotonic ns of the winning cancel (the latency-histogram t0)
+        self.cancelled_at_ns: Optional[int] = None
+
+    # -- deadline ------------------------------------------------------------
+
+    def arm_deadline(self, deadline_s: float) -> "CancelToken":
+        """(Re-)arm the deadline ``deadline_s`` seconds from now (the
+        serving handler arms it after the SUBMIT frame arrives)."""
+        if deadline_s and deadline_s > 0:
+            self._deadline = time.monotonic() + deadline_s
+        return self
+
+    def remaining(self) -> Optional[float]:
+        """Seconds of deadline budget left; None = no deadline. Already
+        clamped at 0 — callers use it to bound sleeps and IO waits."""
+        if self._deadline is None:
+            return None
+        return max(self._deadline - time.monotonic(), 0.0)
+
+    # -- cancel (Event-compatible surface) -----------------------------------
+
+    def cancel(self, reason: str = "cancelled") -> None:
+        """Flip the token (thread-safe, idempotent, first reason wins)."""
+        with self._lock:
+            if self.reason is None:
+                self.reason = reason
+                self.cancelled_at_ns = time.monotonic_ns()
+                try:
+                    from auron_tpu.obs import trace
+                    trace.event("task", "query.cancel", reason=reason,
+                                query=self.query_id)
+                except Exception:   # pragma: no cover - obs best-effort
+                    pass
+        self._event.set()
+
+    def set(self) -> None:
+        """threading.Event alias (the serving control reader calls it)."""
+        self.cancel()
+
+    def finish(self) -> None:
+        """Quiet completion: release every waiter (the serving
+        handler's finally must unblock its control-reader thread after
+        a SUCCESSFUL task) WITHOUT recording a cancel reason, timestamp
+        or trace event — a finished query is not a cancelled one, and
+        telemetry must not show a spurious cancel per success."""
+        self._event.set()
+
+    def is_set(self) -> bool:
+        if self._event.is_set():
+            return True
+        if self._deadline is not None \
+                and time.monotonic() >= self._deadline:
+            self.cancel("deadline")
+            return True
+        return False
+
+    @property
+    def cancelled(self) -> bool:
+        return self.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Event-compatible wait, clamped to the deadline budget."""
+        rem = self.remaining()
+        if rem is not None:
+            timeout = rem if timeout is None else min(timeout, rem)
+        got = self._event.wait(timeout)
+        return got or self.is_set()
+
+    # -- cooperative unwind --------------------------------------------------
+
+    def raise_for_status(self) -> None:
+        """Raise the classified lifecycle error when the token is set
+        (QueryCancelled / DeadlineExceeded by reason); no-op otherwise.
+        ExecContext.check_cancelled delegates here, so every operator
+        poll site unwinds with the right verdict for free."""
+        if not self.is_set():
+            return
+        from auron_tpu import errors
+        qid = self.query_id
+        if self.reason == "deadline":
+            raise errors.DeadlineExceeded(
+                f"query {qid or '?'} exceeded its deadline", query_id=qid)
+        raise errors.QueryCancelled(
+            f"query {qid or '?'} was cancelled", query_id=qid)
+
+    def sleep(self, seconds: float) -> None:
+        """Interruptible sleep: wakes the moment the token is cancelled
+        and never sleeps past the deadline (the retry driver's backoff
+        primitive — a jittered backoff must not outlive the budget it is
+        spending). Raises via raise_for_status when woken cancelled."""
+        if seconds > 0:
+            self.wait(seconds)
+        self.raise_for_status()
+
+    def unwind_latency_s(self) -> Optional[float]:
+        """Seconds between the winning cancel and NOW — observed by the
+        retry driver when the classified error finally unwinds (the
+        cancel-to-unwind latency of the acceptance criterion)."""
+        if self.cancelled_at_ns is None:
+            return None
+        return (time.monotonic_ns() - self.cancelled_at_ns) * 1e-9
+
+    def __repr__(self):
+        state = self.reason or ("set" if self._event.is_set() else "live")
+        return f"CancelToken({self.query_id!r}, {state})"
+
+
+def observe_unwind(token_or_latency, kind: str = "cancel") -> None:
+    """Feed one cancel-to-unwind latency into the process registry
+    (``auron_cancel_latency_seconds{kind=...}``); kind is ``cancel`` |
+    ``deadline`` | ``stall``. Best-effort — latency telemetry must never
+    fail an unwinding task."""
+    try:
+        lat = (token_or_latency if isinstance(token_or_latency, (int, float))
+               else token_or_latency.unwind_latency_s())
+        if lat is None:
+            return
+        from auron_tpu.obs import registry as obs_registry
+        if not obs_registry.enabled():
+            return
+        obs_registry.get_registry().histogram(
+            "auron_cancel_latency_seconds", kind=kind).observe(lat)
+    except Exception:   # pragma: no cover - telemetry best-effort
+        pass

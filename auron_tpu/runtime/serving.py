@@ -129,7 +129,12 @@ def _schema_from_b64(b64: str) -> pa.Schema:
 
 class _TaskHandler(socketserver.BaseRequestHandler):
     def setup(self):
-        self._cancel = threading.Event()
+        # the handler's cancel registry IS a query CancelToken: the
+        # CANCEL frame, a client disconnect, and a request deadline all
+        # flip the SAME token the execution runtime polls — socket-level
+        # and API-level cancel are one mechanism (runtime/lifecycle.py)
+        from auron_tpu.runtime.lifecycle import CancelToken
+        self._cancel = CancelToken(query_id="serving")
         self._window = threading.Semaphore(
             getattr(self.server, "window", DEFAULT_WINDOW))
         self._tables: queue.Queue = queue.Queue()
@@ -170,7 +175,10 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             except OSError:
                 pass
         finally:
-            self._cancel.set()   # unblocks the reader on close
+            # quiet completion, NOT a cancel: the token must release the
+            # control reader without recording a cancel reason/event on
+            # every successful request
+            self._cancel.finish()
             try:
                 # long-lived engine process: bound accumulated XLA
                 # programs — but ONLY while no other handler thread is
@@ -202,18 +210,32 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         except Exception:
             pass   # malformed frame / peer went away: stop computing
         finally:
-            # EVERY reader exit must cancel: a live handler with a dead
-            # reader would otherwise spin on the window semaphore forever
-            self._cancel.set()
+            # EVERY mid-task reader exit must cancel: a live handler
+            # with a dead reader would otherwise spin on the window
+            # semaphore forever. After the handler already finished
+            # (token released quietly) there is nothing to cancel — a
+            # post-DONE socket close must not record a spurious one.
+            if not self._cancel.is_set():
+                self._cancel.set()
 
     def _send_batch(self, rb: pa.RecordBatch) -> None:
         """Backpressured BATCH send; raises _Cancelled when the client
-        cancelled or disconnected instead of writing into the void."""
+        cancelled or disconnected instead of writing into the void. A
+        DEADLINE that expires while blocked on the window (slow or
+        stopped consumer) raises the classified DeadlineExceeded so the
+        client still gets the ERROR frame — the budget verdict must be
+        visible even when the task itself never got to poll."""
+
+        def stop():
+            if self._cancel.reason == "deadline":
+                self._cancel.raise_for_status()
+            raise _Cancelled()
+
         while not self._window.acquire(timeout=0.1):
             if self._cancel.is_set():
-                raise _Cancelled()
+                stop()
         if self._cancel.is_set():
-            raise _Cancelled()
+            stop()
         try:
             write_frame(self.request, KIND_BATCH, _ipc_bytes(rb))
             self.server.stats["batches_sent"] += 1
@@ -235,6 +257,11 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         from auron_tpu.ir.planner import PlannerContext
         req = json.loads(payload.decode())
         rewrites = req.get("path_rewrites") or {}
+        # request-scoped deadline: arrives on the SUBMIT_PLAN frame so
+        # the server enforces it even when the client vanishes
+        timeout_s = req.get("timeout_s")
+        if timeout_s:
+            self._cancel.arm_deadline(float(timeout_s))
 
         def rewrite(p):
             return rewrites.get(p) or rewrites.get(os.path.basename(p), p)
@@ -305,17 +332,26 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                                num_partitions=task.num_partitions or 1,
                                stage_id=task.stage_id,
                                task_id=task.task_id))
-        # share the handler's cancel event as the task's cancellation
+        # share the handler's cancel TOKEN as the task's cancellation
         # registry: operators polling between child batches unwind even
         # MID-operator, not just between output batches
         rt.ctx.cancel_event = self._cancel
+        from auron_tpu import errors
         from auron_tpu.ops.base import TaskCancelled
+        from auron_tpu.runtime import lifecycle
         try:
             for batch in rt.batches():
                 rb = to_arrow(batch, op.schema())
                 if rb.num_rows:
                     self._send_batch(rb)
-        except TaskCancelled:
+        except errors.DeadlineExceeded:
+            # a deadline is a CLIENT-VISIBLE verdict (ERROR frame with
+            # the classified type), unlike a cancel (silent teardown)
+            lifecycle.observe_unwind(self._cancel, kind="deadline")
+            raise
+        except (TaskCancelled, errors.QueryCancelled):
+            lifecycle.observe_unwind(
+                self._cancel, kind=self._cancel.reason or "cancel")
             raise _Cancelled()
         metrics = rt.finalize()
         done = {"metrics": metrics,
@@ -390,7 +426,8 @@ class AuronClient:
 
     def execute_plan(self, plan, path_rewrites=None, partition_id: int = 0,
                      num_partitions: int = 1, spark_version: str = "3.5.0",
-                     fallback_provider=None):
+                     fallback_provider=None,
+                     timeout_s: "Optional[float]" = None):
         """Live attach: submit a raw Spark ``plan.toJSON`` tree (parsed
         JSON list/dict). The engine converts it server-side; when the
         conversion hits unconvertible subtrees it asks back for their
@@ -399,10 +436,15 @@ class AuronClient:
         plays host-side in the reference).
 
         Returns (pa.Table, done dict) where done carries metrics plus the
-        conversion report (fallbacks + summary)."""
+        conversion report (fallbacks + summary). ``timeout_s`` rides the
+        frame as a SERVER-SIDE deadline: the engine's own CancelToken
+        enforces it (errors.DeadlineExceeded on the ERROR frame), so the
+        budget holds even if this client dies mid-stream."""
         req = {"plan": plan, "partition_id": partition_id,
                "num_partitions": num_partitions,
                "spark_version": spark_version}
+        if timeout_s:
+            req["timeout_s"] = float(timeout_s)
         if path_rewrites:
             req["path_rewrites"] = dict(path_rewrites)
         return self._drive(KIND_SUBMIT_PLAN, json.dumps(req).encode(),

@@ -53,7 +53,7 @@ from auron_tpu import errors
 logger = logging.getLogger("auron_tpu")
 
 _LOCK = threading.Lock()
-_STATS = {"probes": 0, "timeouts": 0, "fallbacks": 0}
+_STATS = {"probes": 0, "timeouts": 0, "fallbacks": 0, "stalls": 0}
 
 #: bump when ProbeReport.to_dict() keys change (consumers: bench.py's
 #: ``probe_report`` field, probe_report.json next to traces, and the
@@ -136,6 +136,13 @@ def totals() -> int:
     """Monotonic process-level fallback count (surfaced in finalize)."""
     with _LOCK:
         return _STATS["fallbacks"]
+
+
+def stall_totals() -> int:
+    """Monotonic process-level stall-detection count (registry +
+    chaos-report surface)."""
+    with _LOCK:
+        return _STATS["stalls"]
 
 
 def _count(key: str) -> None:
@@ -542,6 +549,269 @@ def _ensure_backend_probed(deadline: float) -> Optional[str]:
         _fallback_to_cpu(deadline, why)
     import jax
     return jax.default_backend()
+
+
+# ---------------------------------------------------------------------------
+# task-level stall watchdog: the heartbeat plane (PR 8)
+# ---------------------------------------------------------------------------
+#
+# The init/compile probes above bound the BACKEND's liveness; this plane
+# bounds every running TASK's. Executor and shuffle/spill loops beat a
+# per-attempt TaskHeartbeat through ExecContext.checkpoint(site); a
+# monitor thread flags any task silent past auron.watchdog.stall_timeout_s,
+# emits a structured StallReport (task identity, last heartbeat site,
+# driving thread's stack) into auron.trace.dir, and sets the heartbeat's
+# ``stalled`` flag — the next cooperative poll raises the classified
+# ``errors.TaskStalled``, which the retry driver treats as transient
+# ONCE. A truly wedged native call never polls again; the report is then
+# the diagnosis (the same observable-decision contract as the init
+# watchdog) and the query deadline remains the hard bound.
+
+#: bump when StallReport.to_dict() keys change
+STALL_SCHEMA_VERSION = 1
+
+
+@dataclass
+class TaskHeartbeat:
+    """One task attempt's liveness record. ``beat`` is the hot path —
+    two attribute stores, no lock (torn reads merely skew the stall
+    estimate by one beat)."""
+
+    task_id: int = 0
+    stage_id: int = 0
+    partition_id: int = 0
+    attempt: int = 0
+    #: stall timeout RESOLVED AT REGISTRATION from the registering
+    #: task's config (a session-scoped knob must arm detection for its
+    #: own tasks even when the process-global default is 0)
+    timeout_s: float = 0.0
+    last_site: str = ""
+    last_beat_ns: int = 0
+    started_ns: int = 0
+    #: set by the monitor; the task's next checkpoint raises TaskStalled
+    stalled: bool = False
+    stalled_at_ns: int = 0
+    thread_ident: Optional[int] = None
+
+    def beat(self, site: str = "") -> None:
+        self.last_beat_ns = _now_ns()
+        if site:
+            self.last_site = site
+
+    def silent_s(self) -> float:
+        return (_now_ns() - self.last_beat_ns) * 1e-9
+
+
+@dataclass
+class StallReport:
+    """Structured stall diagnosis written next to the traces
+    (``stall_report_<task>.json``): which task went silent, where its
+    last heartbeat came from, and what the driving thread was doing when
+    the monitor caught it."""
+
+    task_id: int
+    stage_id: int
+    partition_id: int
+    attempt: int
+    last_site: str
+    silent_s: float
+    stall_timeout_s: float
+    thread_stack: list = field(default_factory=list)
+    schema_version: int = STALL_SCHEMA_VERSION
+
+    def to_dict(self) -> dict:
+        return {"schema_version": self.schema_version,
+                "task_id": self.task_id, "stage_id": self.stage_id,
+                "partition_id": self.partition_id, "attempt": self.attempt,
+                "last_site": self.last_site,
+                "silent_s": round(self.silent_s, 3),
+                "stall_timeout_s": self.stall_timeout_s,
+                "thread_stack": self.thread_stack}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+
+def _now_ns() -> int:
+    import time
+    return time.monotonic_ns()
+
+
+_HB_LOCK = threading.Lock()
+_HEARTBEATS: dict[int, TaskHeartbeat] = {}
+_MONITOR: Optional[threading.Thread] = None
+
+
+def stall_timeout_s(config=None) -> float:
+    from auron_tpu import config as cfg
+    conf = config if config is not None else cfg.get_config()
+    return float(conf.get(cfg.WATCHDOG_STALL_TIMEOUT_S))
+
+
+def register_heartbeat(task_id: int = 0, stage_id: int = 0,
+                       partition_id: int = 0, attempt: int = 0,
+                       config=None) -> Optional[TaskHeartbeat]:
+    """Register one task attempt with the stall monitor; returns None
+    when the plane is disarmed (``auron.watchdog.stall_timeout_s`` <= 0)
+    so the disarmed path costs one config read per attempt. Starts the
+    monitor thread lazily on the first armed registration."""
+    timeout = stall_timeout_s(config)
+    if timeout <= 0:
+        return None
+    hb = TaskHeartbeat(task_id=task_id, stage_id=stage_id,
+                       partition_id=partition_id, attempt=attempt,
+                       timeout_s=timeout, started_ns=_now_ns(),
+                       thread_ident=threading.get_ident())
+    hb.beat("task.start")
+    with _HB_LOCK:
+        _HEARTBEATS[id(hb)] = hb
+        _ensure_monitor_locked()
+    return hb
+
+
+def unregister_heartbeat(hb: Optional[TaskHeartbeat]) -> None:
+    if hb is None:
+        return
+    with _HB_LOCK:
+        _HEARTBEATS.pop(id(hb), None)
+
+
+def live_heartbeats() -> int:
+    with _HB_LOCK:
+        return len(_HEARTBEATS)
+
+
+def _ensure_monitor_locked() -> None:
+    """Start the monitor thread if none is running (caller holds
+    _HB_LOCK). The thread exits when the registry drains, so an idle
+    process carries no watchdog thread."""
+    global _MONITOR
+    if _MONITOR is not None and _MONITOR.is_alive():
+        return
+    _MONITOR = threading.Thread(target=_monitor_loop, daemon=True,
+                                name="auron-stall-watchdog")
+    _MONITOR.start()
+
+
+def _monitor_loop() -> None:
+    import time
+    last_compiles = -1
+    poll = 0.25
+    while True:
+        time.sleep(poll)
+        with _HB_LOCK:
+            if not _HEARTBEATS:
+                return          # registry drained: thread retires
+            beats = list(_HEARTBEATS.values())
+        # each heartbeat carries ITS OWN timeout (resolved from the
+        # registering task's config — a session-scoped knob must work
+        # with the global default at 0); poll at a quarter of the
+        # tightest live timeout so detection latency stays bounded by
+        # timeout + poll <= 1.25 x timeout, inside the 2x gate
+        tightest = min(hb.timeout_s for hb in beats)
+        poll = max(min(tightest / 4.0, 1.0), 0.01)
+        # compile-aware: an XLA backend compile runs ON the driving
+        # thread with no chance to beat — when compiles completed since
+        # the last poll, credit every live task with a beat so a slow
+        # first-compile is never misread as a stall (a single compile
+        # LONGER than the timeout still flags: size the knob above the
+        # platform's worst single-program compile time)
+        try:
+            from auron_tpu.utils import compile_stats
+            n = compile_stats.snapshot().count
+        except Exception:   # pragma: no cover
+            n = last_compiles
+        if n != last_compiles:
+            if last_compiles >= 0:
+                for hb in beats:
+                    if not hb.stalled:
+                        hb.beat("xla.compile")
+            last_compiles = n
+            continue
+        for hb in beats:
+            if not hb.stalled and hb.silent_s() > hb.timeout_s:
+                _flag_stalled(hb, hb.timeout_s)
+
+
+def _flag_stalled(hb: TaskHeartbeat, timeout: float) -> None:
+    """One stall verdict: count it, put it on the timeline, persist the
+    StallReport, THEN set the flag (the report must exist before the
+    task can observe the flag and unwind past its trace scope)."""
+    report = StallReport(
+        task_id=hb.task_id, stage_id=hb.stage_id,
+        partition_id=hb.partition_id, attempt=hb.attempt,
+        last_site=hb.last_site, silent_s=hb.silent_s(),
+        stall_timeout_s=timeout,
+        thread_stack=_thread_stack(hb.thread_ident))
+    _count("stalls")
+    logger.error(
+        "stall watchdog: task %d (stage %d, partition %d, attempt %d) "
+        "silent %.2fs past the last heartbeat at %r — flagging TaskStalled",
+        hb.task_id, hb.stage_id, hb.partition_id, hb.attempt,
+        report.silent_s, hb.last_site)
+    try:
+        from auron_tpu.obs import trace
+        trace.event("watchdog", "watchdog.stall", task=hb.task_id,
+                    stage=hb.stage_id, partition=hb.partition_id,
+                    attempt=hb.attempt, last_site=hb.last_site,
+                    silent_s=round(report.silent_s, 3))
+    except Exception:   # pragma: no cover - obs best-effort
+        pass
+    try:
+        from auron_tpu.obs import registry as obs_registry
+        if obs_registry.enabled():
+            obs_registry.get_registry().counter(
+                "auron_stall_detections_total").inc()
+    except Exception:   # pragma: no cover
+        pass
+    write_stall_report(report)
+    hb.stalled_at_ns = _now_ns()
+    hb.stalled = True
+
+
+def _thread_stack(ident: Optional[int]) -> list:
+    """The driving thread's current stack (frames innermost-last), the
+    StallReport's 'what was it doing' payload. Best-effort."""
+    if ident is None:
+        return []
+    import sys
+    import traceback
+    try:
+        frame = sys._current_frames().get(ident)
+        if frame is None:
+            return []
+        return [f"{f.filename}:{f.lineno} {f.name}"
+                for f in traceback.extract_stack(frame)][-20:]
+    except Exception:   # pragma: no cover
+        return []
+
+
+def write_stall_report(report: StallReport,
+                       dir_path: Optional[str] = None) -> Optional[str]:
+    """Persist a StallReport as ``stall_report_<task>.json`` next to the
+    traces (``auron.trace.dir``); returns the path, or None when no
+    directory is configured. Best-effort, like write_report."""
+    import os
+    if dir_path is None:
+        try:
+            from auron_tpu import config as cfg
+            dir_path = cfg.get_config().get(cfg.TRACE_DIR)
+        except Exception:   # pragma: no cover
+            dir_path = ""
+    if not dir_path:
+        return None
+    try:
+        os.makedirs(dir_path, exist_ok=True)
+        path = os.path.join(dir_path,
+                            f"stall_report_{report.task_id}.json")
+        tmp = path + ".part"
+        with open(tmp, "w") as f:
+            f.write(report.to_json() + "\n")
+        os.replace(tmp, path)
+        return path
+    except Exception:   # pragma: no cover - best-effort sink
+        logger.exception("stall report write to %r failed", dir_path)
+        return None
 
 
 def first_compile_probe(config=None) -> Optional[float]:

@@ -39,6 +39,58 @@ def pytest_configure(config):
         "(tier-1 runs -m 'not slow')")
 
 
+@pytest.fixture(autouse=True)
+def _spill_file_leak_check():
+    """Tier-1 resource-leak audit, per-TEST half (PR 8): fail any test
+    that leaves spill files in the system temp dir behind. A glob costs
+    ~a millisecond; the gc pass (spill refs pinned by collected
+    generators) runs only when the cheap check trips."""
+    import glob as _glob
+    import tempfile
+
+    pattern = os.path.join(tempfile.gettempdir(), "auron-spill-*")
+    files_before = set(_glob.glob(pattern))
+    yield
+    leaked = set(_glob.glob(pattern)) - files_before
+    if leaked:
+        import gc
+        gc.collect()
+        leaked = set(_glob.glob(pattern)) - files_before
+    if leaked:
+        for p in leaked:   # clean up so ONE leak fails ONE test
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        pytest.fail("lifecycle leak audit: leaked spill files: "
+                    f"{sorted(leaked)}", pytrace=False)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _memmgr_consumer_leak_check():
+    """Per-MODULE half of the leak audit: no test module may grow the
+    set of live registered memmgr consumers. Module-scoped because the
+    verdict needs a full gc (consumers are weakly held — 'pinned leak'
+    vs 'not collected yet'), and a per-test gc would tax the whole
+    tier-1 window ~100 ms per test."""
+    try:
+        from auron_tpu.memmgr import manager as _mgr
+    except Exception:
+        yield
+        return
+    before = _mgr.live_consumer_count()
+    yield
+    consumers = _mgr.live_consumer_count()
+    if consumers > before:
+        import gc
+        gc.collect()
+        consumers = _mgr.live_consumer_count()
+    if consumers > before:
+        pytest.fail(
+            f"lifecycle leak audit: live memmgr consumers grew "
+            f"{before} -> {consumers} over this module", pytrace=False)
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bound_live_programs():
     """Bound accumulated XLA programs across the suite: the CPU backend's

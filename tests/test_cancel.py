@@ -79,3 +79,166 @@ def test_runtime_cancel_surfaces_as_task_cancelled():
     with pytest.raises(TaskCancelled):
         for _ in rt.batches():
             pass
+
+
+# ---------------------------------------------------------------------------
+# cancellation race battery (PR 8): cancel during program build, during
+# RSS fetch, during spill write, and after DONE — every race ends in the
+# classified error with a clean resource ledger (no leaked spill files,
+# no registered memmgr consumers)
+# ---------------------------------------------------------------------------
+
+def _scan_op(rb, capacity=512):
+    from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+    from auron_tpu.io.parquet import MemoryScanOp
+    slices = [rb.slice(o, capacity) for o in range(0, rb.num_rows,
+                                                   capacity)]
+    return MemoryScanOp([slices], schema_from_arrow(rb.schema),
+                        capacity=capacity)
+
+
+def _rows(n, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.record_batch({
+        "k": pa.array(rng.integers(0, 32, n), pa.int64()),
+        "v": pa.array(rng.normal(size=n)),
+    })
+
+
+def test_cancel_during_program_build_unwinds_classified():
+    """A cancel that lands WHILE a program is building (builds do not
+    poll) surfaces at the next checkpoint as the classified
+    QueryCancelled — within one batch of the build returning."""
+    from auron_tpu import config as cfg, errors
+    from auron_tpu.frontend.dataframe import col, functions as F
+    from auron_tpu.frontend.session import Session
+    from auron_tpu.runtime import faults
+
+    conf = cfg.get_config()
+    conf.set(cfg.FAULTS_PLAN, "program.build:hang@1.0")
+    conf.set(cfg.FAULTS_HANG_S, 0.4)
+    faults.reset()
+    try:
+        s = Session()
+        df = (s.from_arrow(pa.Table.from_batches([_rows(2048)]))
+              .group_by("k").agg(F.sum(col("v")).alias("sv")))
+
+        def cancel_soon():
+            time.sleep(0.1)
+            for qid in list(s.active_queries()):
+                s.cancel(qid)
+
+        threading.Thread(target=cancel_soon, daemon=True).start()
+        with pytest.raises(errors.QueryCancelled):
+            s.execute(df)
+    finally:
+        conf.unset(cfg.FAULTS_PLAN)
+        conf.unset(cfg.FAULTS_HANG_S)
+        faults.reset()
+
+
+def test_cancel_during_rss_fetch_no_part_leak(tmp_path):
+    from auron_tpu import errors
+    from auron_tpu.exprs import ir
+    from auron_tpu.parallel.exchange import RssShuffleExchangeOp
+    from auron_tpu.parallel.partitioning import HashPartitioning
+    from auron_tpu.parallel.shuffle_service import FileShuffleService
+    from auron_tpu.runtime.executor import collect
+    from auron_tpu.runtime.lifecycle import CancelToken
+
+    token = CancelToken("rss-race")
+
+    class CancellingService(FileShuffleService):
+        def map_partition_frames(self, shuffle_id, map_id, partition):
+            token.cancel()       # the race: cancel lands mid-fetch
+            return super().map_partition_frames(shuffle_id, map_id,
+                                                partition)
+
+    op = RssShuffleExchangeOp(
+        _scan_op(_rows(2048)), HashPartitioning([ir.ColumnRef(0)], 3),
+        CancellingService(str(tmp_path)), shuffle_id=11,
+        input_partitions=1)
+    with pytest.raises(errors.QueryCancelled):
+        collect(op, num_partitions=3, cancel_token=token)
+    import glob
+    assert not glob.glob(str(tmp_path / "**" / "*.part"))
+
+
+def test_cancel_during_spill_write_clean_ledger(tmp_path):
+    from auron_tpu import errors
+    from auron_tpu.exprs import ir
+    from auron_tpu.memmgr import manager as mgr_mod
+    from auron_tpu.memmgr.manager import MemManager
+    from auron_tpu.memmgr.spill import SpillManager
+    from auron_tpu.ops.sort import SortOp
+    from auron_tpu.runtime.executor import collect
+    from auron_tpu.runtime.lifecycle import CancelToken
+
+    token = CancelToken("spill-race")
+
+    class CancellingSpillManager(SpillManager):
+        def new_spill(self):
+            token.cancel()       # the race: cancel lands mid-spill
+            return super().new_spill()
+
+    sm = CancellingSpillManager(host_budget_bytes=1,
+                                spill_dir=str(tmp_path))
+    mm = MemManager(total_bytes=1, min_trigger=0, spill_manager=sm)
+    op = SortOp(_scan_op(_rows(3000), capacity=500),
+                [ir.SortOrder(ir.ColumnRef(0), ascending=True)])
+    with pytest.raises(errors.QueryCancelled):
+        collect(op, num_partitions=1, mem_manager=mm,
+                cancel_token=token)
+    import gc
+    import os as _os
+    gc.collect()
+    # per-attempt spill artifacts all released; nothing on disk,
+    # nothing tracked, no consumer left registered
+    assert not [f for f in _os.listdir(str(tmp_path))
+                if f.startswith("auron-spill-")]
+    assert sm.live_disk_files() == 0
+    assert mm.status()["num_consumers"] == 0
+
+
+def test_cancel_after_done_is_idempotent_noop():
+    from auron_tpu.frontend.dataframe import col, functions as F
+    from auron_tpu.frontend.session import Session
+
+    s = Session()
+    df = (s.from_arrow(pa.Table.from_batches([_rows(512)]))
+          .group_by("k").agg(F.count_star().alias("n")))
+    out = df.collect()
+    assert out.num_rows > 0
+    # the query is finished: its id is gone, cancel is a no-op...
+    assert s.cancel("q1") is False
+    assert s.active_queries() == {}
+    # ...and the session still executes new queries afterwards
+    assert df.collect().equals(out)
+
+
+def test_deadline_exceeded_is_classified_and_non_transient():
+    from auron_tpu import config as cfg, errors
+    from auron_tpu.frontend.dataframe import col, functions as F
+    from auron_tpu.frontend.session import Session
+    from auron_tpu.runtime import faults
+
+    conf = cfg.get_config()
+    conf.set(cfg.FAULTS_PLAN, "task.hang:hang@1.0")
+    conf.set(cfg.FAULTS_HANG_S, 5.0)
+    faults.reset()
+    try:
+        s = Session()
+        df = (s.from_arrow(pa.Table.from_batches([_rows(2048)]))
+              .group_by("k").agg(F.sum(col("v")).alias("sv")))
+        t0 = time.time()
+        with pytest.raises(errors.DeadlineExceeded) as ei:
+            df.collect(timeout_s=0.3)
+        # the injected hang polls the token: the deadline unwinds in
+        # ~deadline + one poll tick, nowhere near the full 5s hang
+        assert time.time() - t0 < 3.0
+        assert not errors.is_transient(ei.value)
+        assert isinstance(ei.value, errors.QueryCancelled)
+    finally:
+        conf.unset(cfg.FAULTS_PLAN)
+        conf.unset(cfg.FAULTS_HANG_S)
+        faults.reset()

@@ -1,0 +1,425 @@
+"""Query lifecycle control plane (PR 8): CancelToken semantics, the
+task-level stall watchdog, the memory-pressure degradation ladder, and
+the spill-tier orphan sweep.
+
+The e2e cancel/deadline races live in tests/test_cancel.py; the seeded
+chaos proofs in tests/test_zz_chaos_battery.py. This module pins the
+primitives: token state machine, heartbeat/monitor mechanics, TaskStalled
+transient-once routing, ladder rungs + policy/quota, sweep ledger."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from auron_tpu import config as cfg
+from auron_tpu import errors
+from auron_tpu.runtime.lifecycle import CancelToken
+
+
+# ---------------------------------------------------------------------------
+# CancelToken
+# ---------------------------------------------------------------------------
+
+class TestCancelToken:
+    def test_deadline_self_cancels_with_reason(self):
+        t = CancelToken("q", deadline_s=0.05)
+        assert not t.is_set() and t.remaining() > 0
+        time.sleep(0.06)
+        assert t.is_set() and t.reason == "deadline"
+        with pytest.raises(errors.DeadlineExceeded):
+            t.raise_for_status()
+
+    def test_cancel_first_wins_and_is_idempotent(self):
+        t = CancelToken("q")
+        t.cancel()
+        first_ts = t.cancelled_at_ns
+        t.cancel("deadline")      # loses: reason/timestamp unchanged
+        assert t.reason == "cancelled" and t.cancelled_at_ns == first_ts
+        with pytest.raises(errors.QueryCancelled):
+            t.raise_for_status()
+
+    def test_event_compat_set_alias(self):
+        t = CancelToken("q")
+        t.set()                   # the serving control reader's call
+        assert t.is_set() and t.reason == "cancelled"
+
+    def test_wait_clamps_to_deadline(self):
+        t = CancelToken("q", deadline_s=0.1)
+        t0 = time.time()
+        assert t.wait(5.0) is True          # woke at the deadline
+        assert time.time() - t0 < 2.0
+        assert t.reason == "deadline"
+
+    def test_sleep_interrupted_by_cancel_raises(self):
+        t = CancelToken("q")
+        threading.Timer(0.05, t.cancel).start()
+        t0 = time.time()
+        with pytest.raises(errors.QueryCancelled):
+            t.sleep(5.0)
+        assert time.time() - t0 < 2.0
+
+    def test_unwind_latency_measured_from_cancel(self):
+        t = CancelToken("q")
+        assert t.unwind_latency_s() is None
+        t.cancel()
+        time.sleep(0.02)
+        assert t.unwind_latency_s() >= 0.02
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+# ---------------------------------------------------------------------------
+
+class TestStallWatchdog:
+    def test_disarmed_registers_nothing(self):
+        from auron_tpu.runtime import watchdog
+        assert watchdog.register_heartbeat(task_id=1) is None
+
+    def test_silent_task_flagged_and_report_written(self, tmp_path):
+        from auron_tpu.runtime import watchdog
+        conf = cfg.get_config()
+        conf.set(cfg.WATCHDOG_STALL_TIMEOUT_S, 0.15)
+        conf.set(cfg.TRACE_DIR, str(tmp_path))
+        hb = None
+        try:
+            before = watchdog.stall_totals()
+            hb = watchdog.register_heartbeat(task_id=42, stage_id=1,
+                                             partition_id=2, attempt=0)
+            assert hb is not None
+            deadline = time.time() + 5.0
+            while not hb.stalled and time.time() < deadline:
+                time.sleep(0.02)
+            assert hb.stalled, "monitor never flagged the silent task"
+            assert watchdog.stall_totals() == before + 1
+            report = tmp_path / "stall_report_42.json"
+            assert report.exists()
+            import json
+            d = json.loads(report.read_text())
+            assert d["task_id"] == 42 and d["last_site"] == "task.start"
+            assert d["schema_version"] == watchdog.STALL_SCHEMA_VERSION
+            assert d["silent_s"] >= 0.15
+        finally:
+            watchdog.unregister_heartbeat(hb)
+            conf.unset(cfg.WATCHDOG_STALL_TIMEOUT_S)
+            conf.unset(cfg.TRACE_DIR)
+
+    def test_session_scoped_timeout_detected_with_global_default_zero(self):
+        """A session-scoped stall_timeout_s must arm detection even
+        while the process-global knob stays at its 0 default: the
+        timeout is resolved at registration and carried per heartbeat
+        (code-review regression)."""
+        from auron_tpu.runtime import watchdog
+        session_conf = cfg.AuronConfig(
+            {cfg.WATCHDOG_STALL_TIMEOUT_S: 0.15})
+        hb = None
+        try:
+            hb = watchdog.register_heartbeat(task_id=44,
+                                             config=session_conf)
+            assert hb is not None and hb.timeout_s == 0.15
+            deadline = time.time() + 5.0
+            while not hb.stalled and time.time() < deadline:
+                time.sleep(0.02)
+            assert hb.stalled
+        finally:
+            watchdog.unregister_heartbeat(hb)
+
+    def test_beating_task_never_flagged(self):
+        from auron_tpu.runtime import watchdog
+        conf = cfg.get_config()
+        conf.set(cfg.WATCHDOG_STALL_TIMEOUT_S, 0.15)
+        hb = None
+        try:
+            hb = watchdog.register_heartbeat(task_id=43)
+            for _ in range(10):
+                hb.beat("test.loop")
+                time.sleep(0.05)
+            assert not hb.stalled
+        finally:
+            watchdog.unregister_heartbeat(hb)
+            conf.unset(cfg.WATCHDOG_STALL_TIMEOUT_S)
+
+    def test_stalled_heartbeat_raises_task_stalled_at_checkpoint(self):
+        from auron_tpu.ops.base import ExecContext
+        from auron_tpu.runtime.watchdog import TaskHeartbeat
+        hb = TaskHeartbeat(task_id=7)
+        hb.stalled = True
+        ctx = ExecContext(task_id=7, heartbeat=hb)
+        with pytest.raises(errors.TaskStalled):
+            ctx.checkpoint("unit")
+
+    def test_task_stalled_is_retried_exactly_once(self):
+        """The retry driver's transient-once contract: a plan that
+        stalls every attempt runs exactly twice, then surfaces."""
+        from auron_tpu.columnar.schema import DataType, Field, Schema
+        from auron_tpu.ops.base import PhysicalOp
+        from auron_tpu.runtime.executor import run_task_with_retries
+
+        attempts = []
+
+        class AlwaysStalls(PhysicalOp):
+            def schema(self):
+                return Schema((Field("x", DataType.INT64, True),))
+
+            def execute(self, partition, ctx):
+                attempts.append(1)
+                raise errors.TaskStalled("wedged")
+                yield  # pragma: no cover
+
+        conf = cfg.AuronConfig().set(cfg.TASK_MAX_RETRIES, 5)
+        with pytest.raises(errors.TaskStalled):
+            run_task_with_retries(AlwaysStalls(), 0, 1, config=conf)
+        assert len(attempts) == 2   # first attempt + ONE stall retry
+
+
+# ---------------------------------------------------------------------------
+# memory-pressure degradation ladder
+# ---------------------------------------------------------------------------
+
+class _Consumer:
+    def __init__(self, name, used=0, spillable=True, shrinkable=0):
+        self.consumer_name = name
+        self.used = used
+        self.spill_calls = 0
+        self.shrink_calls = 0
+        self._spillable = spillable
+        self._shrinkable = shrinkable
+
+    def mem_used(self):
+        return self.used
+
+    def spill(self):
+        self.spill_calls += 1
+        if not self._spillable:
+            return 0
+        freed, self.used = self.used, 0
+        return freed
+
+    def shrink(self):
+        self.shrink_calls += 1
+        freed = min(self._shrinkable, self.used)
+        self.used -= freed
+        return freed
+
+
+class TestPressureLadder:
+    def _mm(self, total=100):
+        from auron_tpu.memmgr.manager import MemManager
+        return MemManager(total_bytes=total, min_trigger=0)
+
+    def test_shrink_rung_relieves_without_shed(self):
+        mm = self._mm(100)
+        c = _Consumer("a", used=150, spillable=False, shrinkable=100)
+        mm.register_consumer(c)
+        assert mm.update_mem_used(c, 150) == "spilled"
+        assert c.shrink_calls == 1
+        assert mm.pressure_counts["shrink"] == 1
+        assert mm.pressure_counts["shed"] == 0
+        # the shrink rung also shrinks the advised scan batch rows
+        assert mm.advised_batch_rows(1 << 16) == 1 << 15
+
+    def test_force_spill_rung_waives_min_trigger(self):
+        from auron_tpu.memmgr.manager import MemManager
+        # min_trigger ABOVE every consumer: the normal loop refuses,
+        # the force rung spills the largest anyway
+        mm = MemManager(total_bytes=100, min_trigger=1 << 30)
+        big = _Consumer("big", used=90)
+        mm.register_consumer(big)
+        small = _Consumer("small", used=60)
+        mm.register_consumer(small)
+        mm.update_mem_used(big, 90)
+        assert mm.update_mem_used(small, 60) == "spilled"
+        assert big.spill_calls == 1          # largest, despite trigger
+        assert mm.pressure_counts["force_spill"] == 1
+
+    def test_degrade_policy_denies_survivably(self):
+        mm = self._mm(10)
+        stuck = _Consumer("stuck", used=50, spillable=False)
+        mm.register_consumer(stuck)
+        assert mm.update_mem_used(stuck, 50) == "nothing"
+        assert mm.pressure_counts["deny"] == 1
+
+    def test_shed_policy_raises_memory_exhausted(self):
+        conf = cfg.get_config()
+        conf.set(cfg.MEMMGR_PRESSURE_POLICY, "shed")
+        try:
+            mm = self._mm(10)
+            stuck = _Consumer("stuck", used=50, spillable=False)
+            mm.register_consumer(stuck)
+            with pytest.raises(errors.MemoryExhausted) as ei:
+                mm.update_mem_used(stuck, 50)
+            assert not errors.is_transient(ei.value)
+            assert mm.pressure_counts["shed"] == 1
+        finally:
+            conf.unset(cfg.MEMMGR_PRESSURE_POLICY)
+
+    def test_query_quota_breach_sheds_under_degrade(self):
+        conf = cfg.get_config()
+        conf.set(cfg.MEMMGR_QUERY_QUOTA_BYTES, 30)
+        try:
+            mm = self._mm(1000)     # global budget is NOT the problem
+            stuck = _Consumer("hog", used=50, spillable=False)
+            mm.register_consumer(stuck)
+            with pytest.raises(errors.MemoryExhausted):
+                mm.update_mem_used(stuck, 50)
+        finally:
+            conf.unset(cfg.MEMMGR_QUERY_QUOTA_BYTES)
+
+    def test_legacy_policy_restores_deny_only(self):
+        conf = cfg.get_config()
+        conf.set(cfg.MEMMGR_PRESSURE_POLICY, "legacy")
+        try:
+            mm = self._mm(10)
+            stuck = _Consumer("stuck", used=50, spillable=False)
+            mm.register_consumer(stuck)
+            assert mm.update_mem_used(stuck, 50) == "nothing"
+            assert stuck.shrink_calls == 0
+            assert mm.pressure_counts["deny"] == 1
+            assert mm.pressure_counts["shrink"] == 0
+        finally:
+            conf.unset(cfg.MEMMGR_PRESSURE_POLICY)
+
+    def test_injected_deny_forces_ladder(self):
+        from auron_tpu.runtime import faults
+        conf = cfg.get_config()
+        conf.set(cfg.FAULTS_PLAN, "memmgr.deny:deny@1.0")
+        faults.reset()
+        try:
+            mm = self._mm(1000)
+            c = _Consumer("fine", used=5)
+            mm.register_consumer(c)
+            # well under budget, but the injected deny walks the ladder
+            mm.update_mem_used(c, 5)
+            assert mm.pressure_counts["deny"] == 1
+        finally:
+            conf.unset(cfg.FAULTS_PLAN)
+            faults.reset()
+
+    def test_buffered_consumer_shrink_sheds_oldest_half(self, tmp_path):
+        from auron_tpu.columnar.arrow_bridge import to_device
+        from auron_tpu.memmgr.consumer import BufferedSpillConsumer
+        from auron_tpu.memmgr.manager import MemManager
+        from auron_tpu.memmgr.spill import SpillManager
+        from auron_tpu.ops.base import MetricsSet
+        mm = MemManager(total_bytes=1 << 30, min_trigger=0,
+                        spill_manager=SpillManager(
+                            host_budget_bytes=1 << 20,
+                            spill_dir=str(tmp_path)))
+        consumer = BufferedSpillConsumer("t", mm, MetricsSet(),
+                                         cfg.get_config())
+        rb = pa.record_batch({"x": pa.array(np.arange(64), pa.int64())})
+        for _ in range(4):
+            consumer.add(to_device(rb, capacity=64)[0])
+        freed = consumer.shrink()
+        assert freed > 0
+        assert len(consumer.buffered) == 2      # newest half kept
+        assert len(consumer.spills) == 1        # oldest half is a run
+        consumer.close()
+
+
+# ---------------------------------------------------------------------------
+# spill-tier orphan sweep
+# ---------------------------------------------------------------------------
+
+class TestSpillSweep:
+    def test_sweep_removes_unreleased_disk_files(self, tmp_path):
+        from auron_tpu.memmgr.spill import SpillManager
+        mgr = SpillManager(host_budget_bytes=0, spill_dir=str(tmp_path))
+        s = mgr.new_spill()
+        s.write_frame(b"x" * 1000)
+        s.finish()
+        path = s._path
+        assert path is not None and os.path.exists(path)
+        assert mgr.live_disk_files() == 1
+        # the attempt "crashes": nobody calls release()
+        assert mgr.sweep_orphans() == 1
+        assert not os.path.exists(path)
+        assert mgr.live_disk_files() == 0
+
+    def test_released_spills_are_not_swept_twice(self, tmp_path):
+        from auron_tpu.memmgr.spill import SpillManager
+        mgr = SpillManager(host_budget_bytes=0, spill_dir=str(tmp_path))
+        s = mgr.new_spill()
+        s.write_frame(b"y" * 100)
+        s.finish()
+        s.release()
+        assert mgr.live_disk_files() == 0
+        assert mgr.sweep_orphans() == 0
+
+    def test_session_close_sweeps_spill_tier(self, tmp_path):
+        from auron_tpu.frontend.session import Session
+        from auron_tpu.memmgr.manager import MemManager
+        from auron_tpu.memmgr.spill import SpillManager
+        sm = SpillManager(host_budget_bytes=0, spill_dir=str(tmp_path))
+        orphan = sm.new_spill()
+        orphan.write_frame(b"z" * 500)
+        orphan.finish()        # never released — the crashed attempt
+        with Session(mem_manager=MemManager(total_bytes=1 << 20,
+                                            spill_manager=sm)):
+            pass
+        assert sm.live_disk_files() == 0
+        assert not [f for f in os.listdir(str(tmp_path))
+                    if f.startswith("auron-spill-")]
+
+
+# ---------------------------------------------------------------------------
+# session lifecycle + fault helpers
+# ---------------------------------------------------------------------------
+
+def test_session_close_cancels_active_queries():
+    from auron_tpu.frontend.session import Session
+    s = Session()
+    token = s._begin_query(timeout_s=None)
+    assert s.active_queries() == {token.query_id: token}
+    s.close()
+    assert token.is_set() and token.reason == "cancelled"
+
+
+def test_injected_hang_polls_cancel_registry():
+    from auron_tpu.runtime import faults
+    conf = cfg.get_config()
+    conf.set(cfg.FAULTS_PLAN, "task.hang:hang@1.0")
+    conf.set(cfg.FAULTS_HANG_S, 10.0)
+    faults.reset()
+    try:
+        token = CancelToken("hang")
+        threading.Timer(0.1, token.cancel).start()
+        t0 = time.time()
+        faults.maybe_fail("task.hang", errors.DeviceExecutionError,
+                          cancel=token)
+        # woke on the cancel, nowhere near the 10s interval
+        assert time.time() - t0 < 5.0
+    finally:
+        conf.unset(cfg.FAULTS_PLAN)
+        conf.unset(cfg.FAULTS_HANG_S)
+        faults.reset()
+
+
+def test_maybe_cancel_fires_target_deterministically():
+    from auron_tpu.runtime import faults
+    conf = cfg.get_config()
+    conf.set(cfg.FAULTS_PLAN, "cancel.race:cancel@1.0")
+    faults.reset()
+    try:
+        token = CancelToken("race")
+        assert faults.maybe_cancel("cancel.race", token) is True
+        assert token.is_set()
+        # seeded and replayable like every other site
+        assert faults.snapshot() == {"cancel.race": {"cancel": 1}}
+    finally:
+        conf.unset(cfg.FAULTS_PLAN)
+        faults.reset()
+
+
+def test_cancel_latency_histogram_is_fed():
+    from auron_tpu.obs import registry as obs_registry
+    from auron_tpu.runtime import lifecycle
+    token = CancelToken("lat")
+    token.cancel()
+    lifecycle.observe_unwind(token, kind="cancelled")
+    snap = obs_registry.get_registry().snapshot()
+    key = 'auron_cancel_latency_seconds{kind="cancelled"}'
+    assert key in snap and snap[key]["count"] >= 1

@@ -49,6 +49,14 @@ _PLANS = [
     ("agg_pipeline", "program.build:io_error@0.2"),
     ("agg_pipeline",
      "device.compute:io_error@0.2;rss.fetch:corrupt@0.1"),
+    # Chaos 2.0 lifecycle battery: cancel races, mid-batch hangs under
+    # the stall watchdog, forced memory-pressure sheds — every seed must
+    # end identical-or-classified with a clean resource ledger
+    ("lifecycle_pipeline", "cancel.race:cancel@0.3"),
+    ("lifecycle_pipeline", "task.hang:hang@0.15"),
+    ("lifecycle_pipeline", "memmgr.deny:deny@0.5"),
+    ("lifecycle_pipeline",
+     "cancel.race:cancel@0.2;task.hang:hang@0.1"),
 ]
 
 _FAST_SEEDS = (1, 2)

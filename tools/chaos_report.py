@@ -51,7 +51,45 @@ PLANS = [
     ("agg_pipeline", "device.compute:fatal@0.5"),
     ("agg_pipeline", "program.build:io_error@0.2"),
     ("agg_pipeline", "device.compute:io_error@0.2;rss.fetch:corrupt@0.1"),
+    # Chaos 2.0 lifecycle battery (PR 8): cancel races, stall-watchdog
+    # hangs, forced memory-pressure sheds
+    ("lifecycle_pipeline", "cancel.race:cancel@0.3"),
+    ("lifecycle_pipeline", "task.hang:hang@0.15"),
+    ("lifecycle_pipeline", "memmgr.deny:deny@0.5"),
+    ("lifecycle_pipeline", "cancel.race:cancel@0.2;task.hang:hang@0.1"),
 ]
+
+
+def lifecycle_summary() -> dict:
+    """Process-level lifecycle telemetry accumulated over the sweep:
+    cancel-to-unwind latency percentiles per kind (the registry
+    histogram the acceptance gate reads), stall detections, and
+    degradation-ladder rung counts."""
+    out = {"cancel_latency_s": {}, "stall_detections": 0,
+           "pressure_rungs": {}}
+    try:
+        from auron_tpu.obs import registry as obs_registry
+        snap = obs_registry.get_registry().snapshot()
+        for key, val in snap.items():
+            if key.startswith("auron_cancel_latency_seconds"):
+                kind = key.split('kind="')[1].rstrip('"}') \
+                    if 'kind="' in key else "all"
+                out["cancel_latency_s"][kind] = {
+                    "count": val["count"],
+                    "p50": round(val["p50"], 4),
+                    "p99": round(val["p99"], 4)}
+            elif key.startswith("auron_memmgr_pressure_total"):
+                rung = key.split('rung="')[1].rstrip('"}') \
+                    if 'rung="' in key else "?"
+                out["pressure_rungs"][rung] = int(val)
+    except Exception:
+        pass
+    try:
+        from auron_tpu.runtime import watchdog
+        out["stall_detections"] = watchdog.stall_totals()
+    except Exception:
+        pass
+    return out
 
 
 def run_sweep(seeds: int, scenario_filter: str | None) -> dict:
@@ -97,7 +135,7 @@ def run_sweep(seeds: int, scenario_filter: str | None) -> dict:
             rows.append({"scenario": scen_name, "plan": plan,
                          "injected": injected, "leaked": leaked, **agg})
     return {"seeds": seeds, "rows": rows, "failures": failures,
-            "sites": sites}
+            "sites": sites, "lifecycle": lifecycle_summary()}
 
 
 def print_table(report: dict) -> None:
@@ -133,6 +171,19 @@ def print_table(report: dict) -> None:
                 or "-"
             print(f"  {site:{w_site}s}  injected={s['injected']:<5d} "
                   f"runs={s['runs']:<4d} recovery: {rec}")
+    life = report.get("lifecycle") or {}
+    if life.get("cancel_latency_s") or life.get("stall_detections") \
+            or life.get("pressure_rungs"):
+        print()
+        print("lifecycle (cancel latency / stalls / pressure rungs)")
+        for kind, p in sorted(life.get("cancel_latency_s", {}).items()):
+            print(f"  cancel->unwind [{kind:9s}]  n={p['count']:<4d} "
+                  f"p50={p['p50']*1000:.1f}ms p99={p['p99']*1000:.1f}ms")
+        print(f"  stall detections: {life.get('stall_detections', 0)}")
+        rungs = ", ".join(f"{k}x{v}" for k, v in
+                          sorted(life.get("pressure_rungs", {}).items())) \
+            or "-"
+        print(f"  degradation rungs taken: {rungs}")
     for f in report["failures"]:
         print(f"CONTRACT BROKEN: {f['scenario']} plan={f['plan']!r} "
               f"seed={f['seed']} trace={f.get('trace_id', 0)} -> "
@@ -145,7 +196,9 @@ def main(argv=None) -> int:
     ap.add_argument("--seeds", type=int, default=8,
                     help="seeds per (scenario, plan) pair")
     ap.add_argument("--scenario", choices=["rss_pipeline", "spill_sort",
-                                           "agg_pipeline"], default=None)
+                                           "agg_pipeline",
+                                           "lifecycle_pipeline"],
+                    default=None)
     args = ap.parse_args(argv)
 
     report = run_sweep(args.seeds, args.scenario)
@@ -159,6 +212,7 @@ def main(argv=None) -> int:
                       "chaos_injected": sum(r["injected"]
                                             for r in report["rows"]),
                       "chaos_sites": report.get("sites") or {},
+                      "chaos_lifecycle": report.get("lifecycle") or {},
                       "chaos_contract_ok": ok}))
     return 0 if ok else 1
 
