@@ -74,7 +74,50 @@ BATCH_CAPACITY = _opt(
 PARQUET_BATCH_ROWS = _opt(
     "auron.io.parquet.batch_rows", int, 1 << 16,
     "Row-group read granularity for the parquet/ORC scans when the plan "
-    "does not pin batch_rows explicitly.")
+    "does not pin batch_rows explicitly and auron.scan.batch_rows is 0 "
+    "on a non-CPU platform (legacy knob; auron.scan.batch_rows wins "
+    "when set).")
+SCAN_BATCH_ROWS = _opt(
+    "auron.scan.batch_rows", int, 0,
+    "Rows per file-scan device batch (parquet/ORC). 0 (default) = auto: "
+    "2^17 on the CPU mesh — larger batches amortize the per-batch host "
+    "glue that dominates CPU throughput (PERF.md 'Pipelined "
+    "execution') — and auron.io.parquet.batch_rows (2^16) on "
+    "accelerators. The scan clamps the conversion capacity to the "
+    "partition's actual row count bucket, so small files never pad to "
+    "the full batch size. One flag for batch-size experiments; "
+    "tools/hotspot_report.py prints the achieved rows/batch per "
+    "operator next to the attribution table.")
+
+# pipelined async execution (runtime/pipeline.py)
+PIPELINE_ENABLED = _opt(
+    "auron.pipeline.enabled", bool, True,
+    "Pipelined asynchronous execution (the [speed] overlap plane): the "
+    "file scans decode row-group N+1 on a bounded background worker "
+    "while the device computes batch N (auron.scan.prefetch_batches "
+    "deep, decoded bytes registered with the memory manager so depth "
+    "degrades under pressure), per-batch device syncs inside operator "
+    "timers and the profiler's program wrapper are skipped — XLA's "
+    "async dispatch queues batch N+1 while N's arrays are in flight — "
+    "and execution synchronizes only at operator boundaries that "
+    "semantically require it (sort collect, shuffle materialize, "
+    "to_arrow), where the wait is attributed to elapsed_device. Off "
+    "restores fully serial decode → dispatch → block per batch "
+    "(the differential baseline: pipelined and serial results are "
+    "bit-identical by construction — overlap never reorders batches). "
+    "PROCESS-GLOBAL by contract (resolved from get_config(), the "
+    "map-key-dedup precedent): the mode moves sync points across "
+    "planes that cannot see a session config (the profiler's program "
+    "wrapper), so per-Session overrides are not honored for it.")
+SCAN_PREFETCH_BATCHES = _opt(
+    "auron.scan.prefetch_batches", int, 2,
+    "Decoded-batch lookahead of the prefetching file scan (bounded "
+    "queue depth between the background decode worker and the drive "
+    "loop) when auron.pipeline.enabled is on. The prefetcher registers "
+    "its buffered decoded bytes with the memory manager and degrades "
+    "to depth 1 while the pressure ladder's shrink rung is active. "
+    "<= 1 keeps the decode worker but no lookahead beyond the batch "
+    "in flight.")
 
 # memory / spill
 MEMORY_FRACTION = _opt(

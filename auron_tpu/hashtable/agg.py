@@ -245,7 +245,13 @@ class HashAggState:
             th, tw, store, accs, auxs, n_new, overflow = kern(
                 self.th, self.tw, self.store, self.accs, self.auxs,
                 keys, contribs, live, ord_base)
-            n_new_h, ovf = jax.device_get([n_new, overflow])
+            # this readback is the per-batch sync point (pipelined mode
+            # attributes the wait as device time). NOTE the donation
+            # sweep deliberately skips the step/grow kernels: the
+            # overflow-retry protocol re-runs them with the SAME state
+            # and batch inputs, which donation would have invalidated.
+            from auron_tpu.obs import profile as _profile
+            n_new_h, ovf = _profile.timed_get([n_new, overflow])
             if not bool(ovf):
                 self.th, self.tw, self.store = th, tw, store
                 self.accs, self.auxs = accs, auxs

@@ -5,13 +5,19 @@ parquet opener with row-group/page pruning (reference: datafusion-ext-plans/
 src/parquet_exec.rs:151-237, scan/internal_file_reader.rs). Here the host side
 is pyarrow (column pruning + row-group statistics pruning + dictionary-aware
 reads) feeding padded DeviceBatches to the TPU; the scan is the host→device
-on-ramp, deliberately kept off the device's critical path via double
-buffering: while the device crunches batch N, pyarrow decodes batch N+1.
+on-ramp, deliberately kept off the device's critical path by the prefetching
+worker (``ScanPrefetcher``): while the device crunches batch N, a bounded
+background thread decodes and transfers batch N+1 (and beyond, up to
+``auron.scan.prefetch_batches``), with the decoded bytes registered with the
+memory manager so lookahead degrades to 1 under pressure. With
+``auron.pipeline.enabled`` off the scan decodes inline on the query thread —
+the fully serial differential baseline.
 """
 
 from __future__ import annotations
 
-import concurrent.futures
+import threading
+from collections import deque
 from typing import Iterator, Optional
 
 import pyarrow as pa
@@ -53,6 +59,167 @@ def _expr_to_arrow_filter(e: ir.Expr, names: list[str]):
     except Exception:
         return None
     return None
+
+
+class ScanPrefetcher:
+    """Bounded background decode worker for the file scans.
+
+    One daemon thread drives the decode→transfer iterator and parks the
+    resulting DeviceBatches in a bounded buffer; the query thread drains
+    it in order, so row-group N+1 decodes while the device computes
+    batch N. Three contracts beyond the overlap:
+
+    - **memory**: the buffered decoded bytes are registered with the
+      memory manager (a duck-typed MemConsumer named ``scan_prefetch``),
+      and the effective lookahead degrades to 1 whenever the pressure
+      ladder's shrink rung is active (``advised_batch_rows`` < base) or
+      the ladder asked this consumer to ``shrink()`` — prefetch depth is
+      the first thing a struggling query gives back;
+    - **cancellation**: the consumer polls ``ExecContext.checkpoint``
+      while waiting, so a cancel/deadline unwinds within one poll
+      interval; ``close()`` stops the worker, drains the buffer, zeroes
+      the memmgr accounting and unregisters — a cancel mid-prefetch
+      leaks neither consumers nor buffered batches;
+    - **errors**: a worker-side exception (decode failure, classified
+      memmgr shed) is re-raised on the query thread with its type
+      intact.
+
+    Batches arrive in exactly source order — prefetching changes WHEN
+    decode happens, never what streams out.
+    """
+
+    consumer_name = "scan_prefetch"
+
+    #: consumer-side wait quantum (seconds): bounds cancel latency while
+    #: parked on an empty buffer
+    _POLL_S = 0.02
+
+    def __init__(self, source, ctx: ExecContext, depth: int):
+        self._source = source
+        self._ctx = ctx
+        self._depth = max(1, int(depth))
+        self._cond = threading.Condition()
+        self._buf: deque = deque()
+        self._bytes = 0
+        self._done = False
+        self._stop = False
+        self._err: Optional[BaseException] = None
+        self._degraded = False
+        self._mem = ctx.mem_manager
+        #: serializes the worker's accounting update against close()'s
+        #: unregister, so a slow in-flight update_mem_used (it may walk
+        #: the spill loop) can never re-insert an unregistered consumer
+        self._mem_lock = threading.Lock()
+        if self._mem is not None:
+            self._mem.register_consumer(self)
+        self._thread = threading.Thread(
+            target=self._run, name="auron-scan-prefetch", daemon=True)
+        self._thread.start()
+
+    # -- memmgr duck-type ---------------------------------------------------
+
+    def mem_used(self) -> int:
+        with self._cond:
+            return self._bytes
+
+    def spill(self) -> int:
+        """Prefetched batches cannot be released without losing data —
+        the prefetcher degrades by shrinking lookahead, not by
+        spilling."""
+        return 0
+
+    def shrink(self) -> int:
+        """Pressure-ladder rung 1: give back the lookahead for the rest
+        of this scan (the worker stops refilling past depth 1)."""
+        self._degraded = True
+        return 0
+
+    def target_depth(self) -> int:
+        """Effective lookahead right now: 1 while the memory manager's
+        shrink rung is active (or the ladder shrank this consumer),
+        else the configured depth."""
+        if self._degraded:
+            return 1
+        mem = self._mem
+        if mem is not None:
+            fn = getattr(mem, "advised_batch_rows", None)
+            if fn is not None and fn(1 << 20) < (1 << 20):
+                return 1
+        return self._depth
+
+    # -- worker -------------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            for item in self._source:
+                with self._cond:
+                    while (len(self._buf) >= self.target_depth()
+                           and not self._stop):
+                        self._cond.wait(self._POLL_S)
+                    if self._stop:
+                        return
+                    self._buf.append(item)
+                    self._bytes += item[1]
+                    self._cond.notify_all()
+                with self._mem_lock:
+                    if self._mem is not None and not self._stop:
+                        # outside the condition: accounting may spill /
+                        # walk the pressure ladder synchronously
+                        # (shrink() re-enters on this thread, a flag
+                        # set only)
+                        self._mem.update_mem_used(self, self.mem_used())
+                if self._stop or self._ctx.should_stop:
+                    return
+        except BaseException as e:   # noqa: BLE001 — forwarded verbatim
+            with self._cond:
+                self._err = e
+                self._cond.notify_all()
+        finally:
+            with self._cond:
+                self._done = True
+                self._cond.notify_all()
+
+    # -- consumer -----------------------------------------------------------
+
+    def batches(self, io_time) -> Iterator[DeviceBatch]:
+        """Drain in order. The dequeue wait is decode time the worker
+        could not hide — attributed to the ``convert`` host bucket like
+        the serial path's inline decode."""
+        while True:
+            with timer(io_time, bucket="convert"):
+                with self._cond:
+                    while (not self._buf and not self._done
+                           and self._err is None):
+                        self._cond.wait(self._POLL_S)
+                        # surface cancel/deadline/stall while parked
+                        self._ctx.checkpoint("scan.prefetch")
+                    if self._err is not None:
+                        raise self._err
+                    if self._buf:
+                        batch, nbytes = self._buf.popleft()
+                        self._bytes -= nbytes
+                        self._cond.notify_all()
+                    else:   # done and drained
+                        return
+            with self._mem_lock:
+                if self._mem is not None and not self._stop:
+                    self._mem.update_mem_used(self, self.mem_used())
+            self._ctx.checkpoint("scan.decode")
+            yield batch
+
+    def close(self) -> None:
+        """Stop the worker, drop buffered batches, zero the accounting
+        and unregister from the memory manager (idempotent)."""
+        with self._cond:
+            self._stop = True
+            self._buf.clear()
+            self._bytes = 0
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)
+        with self._mem_lock:
+            if self._mem is not None:
+                self._mem.unregister_consumer(self)
+                self._mem = None
 
 
 class ParquetScanOp(PhysicalOp):
@@ -97,12 +264,38 @@ class ParquetScanOp(PhysicalOp):
         return [f for i, f in enumerate(self.files)
                 if i % num_partitions == partition]
 
+    def _capacity_for(self, partition: int, files: list[str]) -> int:
+        """Conversion capacity for one partition's file set: pinned to
+        batch_rows (ONE program shape per scan) but clamped to the
+        partition's actual row-count bucket, so a small file never pads
+        its batches to the full configured batch size. Metadata-only
+        (parquet footers / ORC stripe stats), cached per partition so
+        retries don't re-parse footers; falls back to batch_rows when
+        the count is unavailable."""
+        cache = getattr(self, "_cap_cache", None)
+        if cache is None:
+            cache = self._cap_cache = {}
+        cap = cache.get(partition)
+        if cap is not None:
+            return cap
+        from auron_tpu.utils.shapes import bucket_rows
+        cap = self.batch_rows
+        try:
+            ds = pa_ds.dataset(files, format=self._format,
+                               filesystem=self._fs)
+            total = ds.count_rows()
+            if total:
+                cap = min(self.batch_rows, bucket_rows(int(total)))
+        except Exception:
+            pass
+        cache[partition] = cap
+        return cap
+
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         metrics = ctx.metrics_for(self)
         io_time = metrics.counter("io_time")
         files = self._partition_files(partition, max(ctx.num_partitions, 1))
 
-        names = self._arrow_schema.names
         arrow_filter = None
         for p in self.predicates:
             f = _expr_to_arrow_filter(p, self._schema.names)
@@ -113,6 +306,9 @@ class ParquetScanOp(PhysicalOp):
             fn = getattr(ctx.mem_manager, "advised_batch_rows", None) \
                 if ctx.mem_manager is not None else None
             return fn(base) if fn is not None else base
+
+        capacity = (self._capacity_for(partition, files)
+                    if files else self.batch_rows)
 
         def host_batches():
             if not files:
@@ -131,36 +327,51 @@ class ParquetScanOp(PhysicalOp):
                 # budget that just denied
                 rows = advised_rows(self.batch_rows)
                 for off in range(0, rb.num_rows, rows):
-                    ctx.checkpoint("scan.decode")
                     yield rb.slice(off, min(rows, rb.num_rows - off))
 
-        def stream():
-            # Double buffering: decode/transfer next batch while caller
-            # computes on the current one.
-            with concurrent.futures.ThreadPoolExecutor(1) as pool:
-                it = host_batches()
+        def convert(rb):
+            # capacity stays pinned per scan unless the pressure ladder
+            # shrank the slices — smaller capacity is the point then
+            from auron_tpu.utils.shapes import bucket_rows
+            cap = capacity
+            if rb.num_rows < cap and advised_rows(cap) < cap:
+                cap = bucket_rows(rb.num_rows)
+            return to_device(rb, capacity=cap,
+                             string_widths=self._widths_for(rb))[0]
 
-                def convert(rb):
-                    # capacity stays pinned to batch_rows (ONE program
-                    # shape per scan) unless the pressure ladder shrank
-                    # the slices — smaller capacity is the point then
-                    from auron_tpu.utils.shapes import bucket_rows
-                    cap = self.batch_rows
-                    if rb.num_rows < cap and advised_rows(cap) < cap:
-                        cap = bucket_rows(rb.num_rows)
-                    return to_device(rb, capacity=cap,
-                                     string_widths=self._widths_for(rb))[0]
-
-                pending = None
-                for rb in it:
-                    nxt = pool.submit(convert, rb)
-                    if pending is not None:
-                        with timer(io_time, bucket="convert"):
-                            yield pending.result()
-                    pending = nxt
-                if pending is not None:
+        from auron_tpu.runtime import pipeline
+        if not pipeline.enabled():
+            # serial baseline: decode → transfer inline on the query
+            # thread (the differential twin the pipelined==serial
+            # battery compares against)
+            def stream():
+                for rb in host_batches():
+                    ctx.checkpoint("scan.decode")
                     with timer(io_time, bucket="convert"):
-                        yield pending.result()
+                        yield convert(rb)
+
+            return count_output(stream(), metrics, timed=True)
+
+        from auron_tpu import config as cfg
+        depth = max(1, int(ctx.conf.get(cfg.SCAN_PREFETCH_BATCHES)))
+
+        def decoded():
+            from auron_tpu.columnar.batch import batch_nbytes
+            for rb in host_batches():
+                batch = convert(rb)
+                # account the DEVICE footprint of what sits in the
+                # buffer (padded to capacity), not the smaller Arrow
+                # slice it came from — under-reporting would hide the
+                # prefetch buffer from the pressure ladder
+                yield batch, batch_nbytes(batch)
+
+        def stream():
+            pf = ScanPrefetcher(decoded(), ctx, depth)
+            try:
+                for batch in pf.batches(io_time):
+                    yield batch
+            finally:
+                pf.close()
 
         return count_output(stream(), metrics, timed=True)
 

@@ -94,7 +94,24 @@ class PhysicalPlanner:
     # -- sources ------------------------------------------------------------
 
     def _file_scan_batch_rows(self) -> int:
+        """File-scan batch sizing: ``auron.scan.batch_rows`` when set;
+        0 (the default) resolves per platform — 2^17 on the CPU mesh,
+        where larger batches amortize the per-batch host glue that
+        dominates throughput (PERF.md 'Pipelined execution'), else the
+        legacy ``auron.io.parquet.batch_rows``. The scan clamps its
+        conversion capacity to the partition's actual row-count bucket,
+        so the larger default never inflates small files' padding."""
         from auron_tpu import config as cfg
+        explicit = int(self.ctx.config.get(cfg.SCAN_BATCH_ROWS))
+        if explicit > 0:
+            return explicit
+        try:
+            import jax
+            platform = jax.default_backend()
+        except Exception:   # backend init failure: stay conservative
+            platform = "cpu"
+        if platform == "cpu":
+            return 1 << 17
         return self.ctx.config.get(cfg.PARQUET_BATCH_ROWS)
 
     def _plan_parquet_scan(self, n: pb.ParquetScanNode) -> PhysicalOp:

@@ -79,13 +79,18 @@ def enabled() -> bool:
         return val
     epoch = cfg.config_epoch()
     conf = cfg.get_config()
-    # attribution NEEDS the per-call sync point (block_until_ready is
-    # what separates device wait from host glue), so it must never
-    # override auron.metrics.device_sync=False — the documented
-    # maximum-throughput knob that trades metrics honesty for
-    # async-dispatch overlap. device_sync off ⇒ profiler off.
-    val = (conf.get(cfg.PROFILE_ENABLED)
-           and conf.get(cfg.METRICS_DEVICE_SYNC))
+    # serial mode's attribution NEEDS the per-call sync point
+    # (block_until_ready is what separates device wait from host glue),
+    # so it must never override auron.metrics.device_sync=False — the
+    # legacy maximum-throughput knob that trades metrics honesty for
+    # async-dispatch overlap. Pipelined mode (auron.pipeline.enabled)
+    # times asynchronously instead — dispatch per call, device at the
+    # moved sync points (device_fence/timed_get) — so it keeps the
+    # profiler on WITHOUT serializing anything: there is no per-call
+    # block left to defeat the overlap.
+    val = bool(conf.get(cfg.PROFILE_ENABLED)
+               and (conf.get(cfg.METRICS_DEVICE_SYNC)
+                    or conf.get(cfg.PIPELINE_ENABLED)))
     _CACHED = (epoch, val)
     return val
 
@@ -267,8 +272,17 @@ class ProfiledProgram:
         t0 = time.perf_counter_ns()
         out = self._fn(*args, **kwargs)
         t1 = time.perf_counter_ns()
-        _block(out)
-        on_call(t1 - t0, time.perf_counter_ns() - t1, self._site)
+        from auron_tpu.runtime import pipeline
+        if pipeline.enabled():
+            # pipelined mode: the arrays stay in flight — batch N+1
+            # dispatches while N computes. The device wait is measured
+            # where execution actually synchronizes (device_fence /
+            # timed_get at the semantic boundaries), so attribution
+            # still sums to wall; per-call we record dispatch only.
+            on_call(t1 - t0, 0, self._site)
+        else:
+            _block(out)
+            on_call(t1 - t0, time.perf_counter_ns() - t1, self._site)
         return out
 
     def __getattr__(self, name):
@@ -282,6 +296,66 @@ def wrap_program(value, site: str):
     if not callable(value) or not enabled():
         return value
     return ProfiledProgram(value, site)
+
+
+# ---------------------------------------------------------------------------
+# moved sync points (pipelined mode — runtime/pipeline.py)
+# ---------------------------------------------------------------------------
+
+def add_device(ns: int) -> None:
+    """Credit ``ns`` device-wait nanoseconds to the innermost open
+    frame (no-op without one) — the async twin of ``on_call``'s device
+    half for waits measured at a moved sync point."""
+    st = getattr(_TLS, "stack", None)
+    if st:
+        st[-1].device += ns
+
+
+def device_fence(value, sink=None) -> int:
+    """Pipelined mode's materialization point: block until every array
+    leaf of ``value`` is ready and attribute the wait as device time —
+    to the innermost open frame when one is recording, else to ``sink``
+    (a MetricsSet) when given. Returns the wait in nanoseconds.
+
+    Call this ONLY where execution semantically requires materialized
+    results (the to_arrow export, sort collect, shuffle materialize):
+    the whole point of pipelining is that nothing else waits."""
+    import time
+    t0 = time.perf_counter_ns()
+    _block(value)
+    ns = time.perf_counter_ns() - t0
+    if not enabled():
+        return ns
+    st = getattr(_TLS, "stack", None)
+    if st:
+        st[-1].device += ns
+    elif sink is not None:
+        sink.counter("elapsed_device").add(ns)
+    from auron_tpu.obs import registry as _registry
+    if _registry.enabled():
+        _registry.get_registry().histogram(
+            "auron_device_call_seconds",
+            buckets=CALL_BUCKETS).observe(ns * 1e-9)
+    return ns
+
+
+def timed_get(values):
+    """``jax.device_get`` with the wait credited to the innermost open
+    frame's device bucket — for the per-batch control-scalar readbacks
+    (agg group counts, hashtable overflow flags, fused limit budgets)
+    that ARE real sync points: under pipelined execution they carry the
+    device wait the per-call block used to absorb, and attributing them
+    as device keeps the host buckets honest."""
+    import time
+
+    import jax
+    st = getattr(_TLS, "stack", None)
+    if st is None or not st:
+        return jax.device_get(values)
+    t0 = time.perf_counter_ns()
+    out = jax.device_get(values)
+    st[-1].device += time.perf_counter_ns() - t0
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -537,14 +537,19 @@ def _reduce_sorted(keys_s, accs_s, live_s, h_s, acc_meta, out_cap):
 
 
 @program_cache("ops.agg.batch_reduce", maxsize=256)
-def _batch_reduce_kernel(n_keys: int, acc_meta: tuple, cap: int):
+def _batch_reduce_kernel(n_keys: int, acc_meta: tuple, cap: int,
+                         donate: bool = False):
     """(keys, accs, live) of one batch → its own group table, hash-sorted.
     One O(B log B) sort of the BATCH only — the state is never re-sorted
     (it merges by binary search in _state_merge_kernel). acc_meta: tuple
     of (kind, out_elems) per state column. Returns (keys, accs, hashes,
-    num_groups, needed_elems)."""
+    num_groups, needed_elems). ``donate`` hands the batch's key/acc/live
+    buffers to XLA — they are dead after the reduce when the child owns
+    its batches and no collect kind can force the caller's growth retry
+    (callers gate on exactly that; programs.jit keeps donation off the
+    advisory CPU backend)."""
+    from auron_tpu.runtime import programs
 
-    @jax.jit
     def kernel(keys, accs, live):
         h = hashing.xxhash64_columns(list(keys), cap).view(jnp.uint64)
         h = jnp.where(live, h, _HASH_SENTINEL)  # dead rows to the end
@@ -555,7 +560,8 @@ def _batch_reduce_kernel(n_keys: int, acc_meta: tuple, cap: int):
         accs_s = tuple(_gather_acc(a, perm) for a in accs)
         return _reduce_sorted(keys_s, accs_s, live_s, h[perm], acc_meta, cap)
 
-    return kernel
+    return programs.jit(kernel,
+                        donate_argnums=(0, 1, 2) if donate else ())
 
 
 def _scatter_acc(a_s, a_b, pos_s, pos_b, m: int):
@@ -1431,23 +1437,36 @@ class AggOp(PhysicalOp):
                       else a[:new_cap] for a in accs)
         return (keys2, accs2, n, new_cap, h[:new_cap])
 
-    def _reduce_batch(self, keys, accs, live, elapsed):
-        """Step 1: one batch → its hash-sorted group table."""
+    def _reduce_batch(self, keys, accs, live, elapsed, donate=False):
+        """Step 1: one batch → its hash-sorted group table. ``donate``
+        (the owned-batch donation sweep) hands the contribution buffers
+        to XLA; callers may only pass it when the batch is owned, no
+        collect kind can grow elements (the retry below reuses the
+        inputs), and no two contribution leaves alias one buffer."""
         kinds = [kind for spec in self.specs
                  for (_n, _dt, kind) in _device_fields(spec)]
         cap_b = live.shape[0]
         out_elems = self._collect_elems(accs)
+        if donate:
+            # duplicate donated buffers are illegal: sum(x) + avg(x)
+            # evaluate to the SAME column object twice
+            leaves = jax.tree_util.tree_leaves((tuple(keys), tuple(accs),
+                                                live))
+            if len({id(x) for x in leaves}) != len(leaves):
+                donate = False
         while True:
             meta = tuple(zip(kinds, out_elems))
-            kern = _batch_reduce_kernel(len(keys), meta, cap_b)
+            kern = _batch_reduce_kernel(len(keys), meta, cap_b, donate)
             with timer(elapsed) as t:
                 bk, ba, bh, bn, needed = kern(tuple(keys), tuple(accs),
                                               live)
                 # one batched round trip for every control scalar — on
                 # tunneled accelerators each separate int() readback costs
                 # a full RTT, and the readback doubles as the device sync
-                import jax
-                ng, needed_h = jax.device_get([bn, needed])
+                # (under pipelining it IS the sync point: attributed as
+                # device wait, obs/profile.timed_get)
+                from auron_tpu.obs import profile as _profile
+                ng, needed_h = _profile.timed_get([bn, needed])
                 ng = int(ng)
             ok, _cap = self._grow_check(kinds, out_elems, ng, cap_b,
                                         needed_h)
@@ -1478,8 +1497,8 @@ class AggOp(PhysicalOp):
             with timer(elapsed) as t:
                 new_keys, new_accs, h_out, num_groups, needed = kern(
                     s_keys, s_accs, s_h, s_n, bk, ba, bh, bn)
-                import jax
-                ng, needed_h = jax.device_get([num_groups, needed])
+                from auron_tpu.obs import profile as _profile
+                ng, needed_h = _profile.timed_get([num_groups, needed])
                 ng = int(ng)
             ok, out_cap = self._grow_check(kinds, out_elems, ng, out_cap,
                                            needed_h)
@@ -1550,12 +1569,29 @@ class AggOp(PhysicalOp):
                 return sorted_state
         return (hs,)
 
-    def _merge(self, state, keys, accs, live, elapsed, ht=None):
+    def _merge(self, state, keys, accs, live, elapsed, ht=None,
+               donate=False):
         if ht is not None and not ht.disabled:
+            # the hash step's overflow-retry protocol reuses its inputs
+            # (PERF.md 'Pipelined execution'): no donation on this path
             return self._merge_hash(state, keys, accs, live, elapsed, ht)
-        return self._merge_sorted(state, keys, accs, live, elapsed)
+        return self._merge_sorted(state, keys, accs, live, elapsed,
+                                  donate=donate)
 
-    def _merge_sorted(self, state, keys, accs, live, elapsed):
+    def _donate_contributions(self, ctx: ExecContext) -> bool:
+        """Owned-batch donation gate for the per-batch reduce: the child
+        must own its batches (dead after the reduce) and no collect kind
+        may be present — collect-element growth retries the reduce with
+        the same inputs, which donation would have invalidated."""
+        from auron_tpu.ops.base import yields_owned_batches
+        if not yields_owned_batches(self.child):
+            return False
+        return not any(
+            k in ("collect_list", "collect_set") or k in _DCOLLECT
+            for k in self._device_kinds())
+
+    def _merge_sorted(self, state, keys, accs, live, elapsed,
+                      donate=False):
         """state: None | (main, hot), each None | (keys, accs, num_groups,
         capacity, hashes). Two-level update: every batch merges into the
         small hot table (O(B log B + hot)); the hot table folds into main
@@ -1563,7 +1599,8 @@ class AggOp(PhysicalOp):
         ~_HOT_FACTOR batches instead of per batch. The reference's
         open-addressing AggTable gets the same amortization from its
         in-memory table + sorted bucket spills (agg_table.rs:68-356)."""
-        batch_tbl = self._reduce_batch(keys, accs, live, elapsed)
+        batch_tbl = self._reduce_batch(keys, accs, live, elapsed,
+                                       donate=donate)
         cap_b = live.shape[0]
         main, hot = state if state is not None else (None, None)
         if hot is None:
@@ -2076,6 +2113,7 @@ class AggOp(PhysicalOp):
                         and conf.get(cfg.AGG_PARTIAL_SKIP_ENABLED))
         skip_ratio = conf.get(cfg.AGG_PARTIAL_SKIP_RATIO)
         skip_min_rows = conf.get(cfg.AGG_PARTIAL_SKIP_MIN_ROWS)
+        donate_contribs = self._donate_contributions(ctx)
 
         def stream():
             consumer = _AggSpillConsumer(self, mem, metrics, conf) \
@@ -2107,7 +2145,7 @@ class AggOp(PhysicalOp):
                         # external victim spill can take it atomically
                         state = consumer.take_state()
                     state = self._merge(state, keys, accs, live, elapsed,
-                                        ht_ctl)
+                                        ht_ctl, donate=donate_contribs)
                     if consumer is not None:
                         state = consumer.observe(state)
                     if not skip_pending:

@@ -281,13 +281,33 @@ class ExecContext:
 
     @property
     def device_sync(self) -> bool:
-        """auron.metrics.device_sync resolved once per context (timers are
-        on the hot path; see timer.track)."""
+        """Should per-operator timers block on kernel outputs? Resolved
+        once per context (timers are on the hot path; see timer.track):
+        auron.metrics.device_sync, overridden to False by pipelined
+        execution (auron.pipeline.enabled) — under pipelining the
+        per-batch sync points move to the semantic materialization
+        boundaries (runtime/pipeline.py), and a timer that blocked per
+        batch would serialize exactly the overlap the mode exists to
+        create."""
         cached = getattr(self, "_device_sync", None)
         if cached is None:
             from auron_tpu import config as cfg
-            cached = self.conf.get(cfg.METRICS_DEVICE_SYNC)
+            cached = (self.conf.get(cfg.METRICS_DEVICE_SYNC)
+                      and not self.pipelined)
             self._device_sync = cached
+        return cached
+
+    @property
+    def pipelined(self) -> bool:
+        """auron.pipeline.enabled resolved once per context — from the
+        PROCESS-GLOBAL config by the knob's contract (sync points must
+        move consistently across planes that cannot see a session
+        config; see runtime/pipeline.enabled)."""
+        cached = getattr(self, "_pipelined", None)
+        if cached is None:
+            from auron_tpu.runtime import pipeline
+            cached = pipeline.enabled()
+            self._pipelined = cached
         return cached
 
     def metrics_for(self, op, suffix: str = "") -> MetricsSet:

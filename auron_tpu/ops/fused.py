@@ -94,26 +94,30 @@ def thread_fragments(fragments, batch: DeviceBatch, partition_id, carries):
     return outs, new_carries
 
 
-def build_stage_kernel(fragments: list[KernelFragment]):
-    """Compose member fragments into one jitted program."""
+def build_stage_kernel(fragments: list[KernelFragment],
+                       donate: bool = False):
+    """Compose member fragments into one jitted program. ``donate``
+    hands the input batch's buffers to XLA — the chain gathers/projects
+    into fresh arrays, so an OWNED input batch is dead the moment the
+    program runs (programs.jit keeps donation off the advisory CPU
+    backend)."""
 
-    @jax.jit
     def kernel(batch: DeviceBatch, partition_id, carries):
         outs, new_carries = thread_fragments(fragments, batch,
                                              partition_id, carries)
         return outs, jnp.stack(new_carries)
 
-    return kernel
+    return programs.jit(kernel, donate_argnums=(0,) if donate else ())
 
 
 def stage_program(frag_keys: tuple, in_schema: Schema, capacity: int,
-                  fragments: list[KernelFragment]):
+                  fragments: list[KernelFragment], donate: bool = False):
     """Central-registry lookup of the stage program. Returns
     (kernel, built) — ``built`` feeds the per-stage counters in the
     ``kernels`` metrics snapshot."""
     return _STAGE_PROGRAMS.get_or_build(
-        (frag_keys, in_schema, capacity),
-        lambda: build_stage_kernel(fragments))
+        (frag_keys, in_schema, capacity, donate),
+        lambda: build_stage_kernel(fragments, donate))
 
 
 class FusedStageOp(PhysicalOp):
@@ -175,23 +179,37 @@ class FusedStageOp(PhysicalOp):
         limit_slots = [i for i, f in enumerate(fragments) if f.is_limit]
         init = [f.init_carry for f in fragments]
         _sync = ctx.device_sync
+        # donation sweep: an owned input batch is dead once the chain
+        # gathered/projected it into fresh arrays — donate it to XLA
+        # (no-op on CPU; pass-through chains alias their input in the
+        # output, which donation supports — the input buffer BECOMES
+        # the output buffer)
+        from auron_tpu.ops.base import yields_owned_batches
+        donate = (any(m.fragment_computes for m in self.members)
+                  and yields_owned_batches(self.input))
 
         def stream():
+            from auron_tpu.obs import profile as _profile
             carries = jnp.asarray(init, dtype=jnp.int64)
             for batch in self.input.execute(partition, ctx):
                 ctx.check_cancelled()
                 kern, built = stage_program(frag_keys, in_schema,
-                                            batch.capacity, fragments)
+                                            batch.capacity, fragments,
+                                            donate)
                 (built_c if built else hit_c).add(1)
                 with timer(elapsed, sync=_sync) as t:
                     outs, carries = t.track(
                         kern(batch, jnp.int32(partition), carries))
+                    if limit_slots:
+                        # a fused limit's budget readback is a real
+                        # per-batch sync point: time it as device wait
+                        budgets = _profile.timed_get(
+                            [carries[i] for i in limit_slots])
                 yield from outs
                 # a fused limit exhausts: stop pulling the child (the
                 # slot readback is the same per-batch sync the unfused
                 # LimitOp paid on int(batch.num_rows))
-                if limit_slots and any(int(carries[i]) <= 0
-                                       for i in limit_slots):
+                if limit_slots and any(int(b) <= 0 for b in budgets):
                     break
 
         return count_output(stream(), metrics)

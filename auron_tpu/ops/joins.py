@@ -112,19 +112,21 @@ _PROBE_PROGRAMS = programs.register(
 def _fused_probe_program(frag_keys: tuple, key_exprs: tuple,
                          in_schema: Schema, out_schema: Schema,
                          capacity: int, build_cap: int, fragments,
-                         index_kind: str, rounds: int):
+                         index_kind: str, rounds: int,
+                         donate: bool = False):
     """One program per (probe chain, join keys, schema, capacities,
     candidate-search backend): member fragments thread the batch, then
     the probe-count body runs on the chain output. Returns the
     transformed batch too — the join's match/gather phase consumes it,
     and the downstream eager key evaluation (_keys_match) sees exactly
     the batch the standalone chain would have produced, keeping fused
-    results bit-identical."""
+    results bit-identical. ``donate`` hands the raw input batch to XLA
+    when the probe child owns it (dead after the chain; no-op on CPU)."""
 
     def build():
         from auron_tpu.ops.fused import thread_fragments
+        from auron_tpu.runtime import programs as _programs
 
-        @jax.jit
         def kernel(batch: DeviceBatch, partition_id, carries,
                    *index_args):
             outs, new_carries = thread_fragments(fragments, batch,
@@ -134,11 +136,12 @@ def _fused_probe_program(frag_keys: tuple, key_exprs: tuple,
                 b, index_kind, index_args, rounds, key_exprs, out_schema)
             return b, lo, counts, total, jnp.stack(new_carries)
 
-        return kernel
+        return _programs.jit(kernel,
+                             donate_argnums=(0,) if donate else ())
 
     return _PROBE_PROGRAMS.get_or_build(
         (frag_keys, key_exprs, in_schema, capacity, build_cap,
-         index_kind, rounds), build)
+         index_kind, rounds, donate), build)
 
 
 @program_cache("ops.joins.expand", maxsize=256)
@@ -377,13 +380,19 @@ class HashJoinOp(PhysicalOp):
         fmetrics.counter("probe_search_folded").add(1)
         in_schema = input_op.schema()
         _sync = ctx.device_sync
+        # donation sweep: the raw probe batch is dead once the chain
+        # produced the transformed batch — donate it when owned
+        from auron_tpu.ops.base import yields_owned_batches
+        donate = (any(getattr(m, "fragment_computes", False)
+                      for m in self.probe.members)
+                  and yields_owned_batches(input_op))
         carries = jnp.asarray([f.init_carry for f in fragments], jnp.int64)
         for raw in input_op.execute(partition, ctx):
             ctx.check_cancelled()
             kern, built = _fused_probe_program(
                 frag_keys, self.probe_keys, in_schema, probe_schema,
                 raw.capacity, side.capacity, fragments,
-                side.index_kind, side.rounds)
+                side.index_kind, side.rounds, donate)
             (built_c if built else hit_c).add(1)
             with timer(f_elapsed, sync=_sync) as t:
                 probe, lo, counts, total, carries = t.track(

@@ -271,7 +271,11 @@ class _SortSpillConsumer(BufferedSpillConsumer):
                                        merged.capacity,
                                        _sort_donate(batches, self.op.child))
         run, words = kern(merged)
-        n = int(run.num_rows)
+        # the sort-collect spill's semantic sync point: under pipelined
+        # execution this readback carries the device wait (booked as
+        # device when a timer frame is open, obs/profile.timed_get)
+        from auron_tpu.obs import profile as _profile
+        n = int(_profile.timed_get(run.num_rows))
         host = batch_to_host(run, n)
         host_words = np.asarray(words[:n])
         for lo in range(0, max(n, 1), self.frame_rows):

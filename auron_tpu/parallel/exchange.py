@@ -392,8 +392,12 @@ class ShuffleExchangeOp(PhysicalOp):
                     pids = partitioning.partition_ids(batch, schema)
                 kern = _sort_by_pid_kernel(n_out, batch.capacity, donate)
                 sorted_batch, counts = t.track(kern(batch, pids))
+                # the counts readback is the shuffle materialize's
+                # semantic sync point: read it inside the timer frame so
+                # pipelined mode books the wait as device, not serde
+                from auron_tpu.obs import profile as _profile
+                counts_h = np.asarray(_profile.timed_get(counts))
             row_offset += n_in if donate else int(batch.num_rows)
-            counts_h = np.asarray(counts)
             offsets = np.concatenate(
                 [np.zeros(1, np.int64), np.cumsum(counts_h)])
             buffer.add(sorted_batch, offsets)
@@ -465,12 +469,15 @@ class ShuffleExchangeOp(PhysicalOp):
                 with timer(f_elapsed, sync=_sync) as t:
                     sorted_batch, counts, carries = t.track(
                         kern(batch, jnp.int32(in_p), carries))
+                    # semantic sync point (see _materialize): the wait
+                    # books as device inside this frame
+                    from auron_tpu.obs import profile as _profile
+                    counts_h = np.asarray(_profile.timed_get(counts))
                 # the shuffle node keeps its canonical write-time view
                 # of the same launch (chain + split are one program)
                 write_time.add(f_elapsed.value - t0v)
                 f_rows.add(int(sorted_batch.num_rows))
                 f_batches.add(1)
-                counts_h = np.asarray(counts)
                 offsets = np.concatenate(
                     [np.zeros(1, np.int64), np.cumsum(counts_h)])
                 buffer.add(sorted_batch, offsets)

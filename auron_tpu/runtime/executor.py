@@ -189,6 +189,16 @@ class ExecutionRuntime:
     def arrow_batches(self) -> Iterator[pa.RecordBatch]:
         """Host materialization (the FFI export boundary of the reference).
 
+        Under pipelined execution (auron.pipeline.enabled) the drive is
+        double-buffered: batch N+1 is pulled from the operator chain —
+        dispatching its kernels asynchronously and refilling the scan
+        prefetcher — BEFORE batch N materializes to Arrow, so the
+        device computes N+1 while the host converts N. to_arrow is the
+        semantic sync point; the wait for N's in-flight arrays is
+        fenced explicitly there and attributed to the root node's
+        ``elapsed_device`` (async-aware timing: the sync moved, the
+        attribution still sums to wall).
+
         The device→host export runs jitted gather/concat programs, so
         XLA's ambiguous RuntimeErrors surface here exactly as they do in
         the compute loop — classify them at this boundary too, or a
@@ -197,12 +207,26 @@ class ExecutionRuntime:
         from auron_tpu import errors
         from auron_tpu.obs import profile as _profile
         schema = self.plan.schema()
+        profiling = _profile.enabled()
         # the device→host materialization is pure arrow↔jax conversion:
         # attributed to the root plan node's "convert" host bucket
         convert_c = (self.ctx.metrics_for(self.plan)
                      .counter("elapsed_host_convert")
-                     if _profile.enabled() else None)
-        for batch in self.batches():
+                     if profiling else None)
+        source = self.batches()
+        pipelined = self.ctx.pipelined
+        if pipelined:
+            from auron_tpu.runtime import pipeline
+            source = pipeline.lookahead(source, depth=1)
+        fence_sink = (self.ctx.metrics_for(self.plan)
+                      if (pipelined and profiling) else None)
+        for batch in source:
+            if fence_sink is not None:
+                # materialization boundary: wait out batch N's in-flight
+                # kernels HERE (N+1 is already dispatched) and book the
+                # wait as device time — BEFORE the num_rows readback
+                # below silently absorbs it
+                _profile.device_fence(batch, fence_sink)
             if int(batch.num_rows) > 0:
                 t0 = (time.perf_counter_ns() if convert_c is not None
                       else 0)

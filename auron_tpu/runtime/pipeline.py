@@ -1,0 +1,97 @@
+"""Pipelined asynchronous execution.
+
+The PR 6 forensics plane measured where q01's wall time actually goes on
+the CPU mesh: the device accounts for ~9% of attributed time while
+``dispatch`` and ``convert`` (synchronous parquet decode) dominate —
+the engine serialized decode → dispatch → block_until_ready per batch,
+wasting exactly the overlap Zerrow-style zero-copy Arrow pipelines
+(PAPERS.md, 2504.06151) and inter-kernel pipelining (FlashFuser,
+2512.12949) exploit. This module is the small shared core of the fix;
+the three planes that consume it:
+
+- **prefetching scan** (io/parquet.Prefetcher): decode row-group N+1 on
+  a bounded background worker while the device computes batch N;
+- **double-buffered dispatch** (runtime/executor.arrow_batches +
+  obs/profile.ProfiledProgram): per-batch ``block_until_ready`` calls
+  disappear — XLA's async dispatch queues batch N+1 while N's arrays
+  are in flight, and execution synchronizes only at operator boundaries
+  that semantically require materialization (sort collect, shuffle
+  materialize, to_arrow), where the wait is attributed to
+  ``elapsed_device``;
+- **donation sweep** (ops/fused, ops/joins, ops/agg): owned-batch hot
+  loops donate their dead inputs to XLA behind the existing
+  ``yields_owned_batches`` gate (runtime/programs.jit keeps donation
+  off the CPU backend, where it is advisory and warns).
+
+The mode is one knob (``auron.pipeline.enabled``, default on) resolved
+through the cached epoch-compare pattern every hot-path plane uses
+(trace/faults/profile): the disabled path costs one int compare.
+Pipelined and serial execution are bit-identical by construction —
+overlap changes WHEN results materialize, never their values or order —
+and tests/test_pipeline.py holds that line over the TPC-DS battery.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+#: (config epoch, enabled) verdict cache for the PROCESS-GLOBAL config
+_CACHED: tuple[int, Optional[bool]] = (-1, None)
+
+_SENTINEL = object()
+
+
+def enabled(conf=None) -> bool:
+    """Is pipelined execution on? PROCESS-GLOBAL by contract (the
+    map-key-dedup precedent): the mode decides where SYNC POINTS live
+    across planes that cannot see a session config — the profiler's
+    program wrapper most of all — so honoring a session-scoped override
+    in some planes but not others would desynchronize operator timers
+    from the wrapper (serial timers blocking while the wrapper skips
+    its block, or vice versa). ``conf`` is accepted for call-site
+    symmetry but resolution is always the process-global config (set
+    via ``AuronConfig.set`` on ``get_config()``, or the env binding
+    read before first use); one cached epoch-compare on the hot path."""
+    from auron_tpu import config as cfg
+    global _CACHED
+    epoch, val = _CACHED
+    if epoch == cfg.config_epoch() and val is not None:
+        return val
+    epoch = cfg.config_epoch()
+    val = bool(cfg.get_config().get(cfg.PIPELINE_ENABLED))
+    _CACHED = (epoch, val)
+    return val
+
+
+def lookahead(it: Iterator, depth: int = 1) -> Iterator:
+    """Double-buffered drive: pull item N+1 from ``it`` BEFORE yielding
+    item N, so the producer's async work (kernel dispatch, prefetch
+    refill) for the next batch is already queued while the consumer
+    blocks on the current one (host materialization, sink writes).
+
+    Order is preserved exactly — this is a window, not a reorder. A
+    producer exception surfaces on the pull that raised it, which under
+    lookahead is up to ``depth`` items earlier than serial drive would
+    have surfaced it; all-or-nothing consumers (collect) can't tell the
+    difference. ``close()`` propagates to the inner iterator so
+    cancellation unwinds generators exactly as serial drive does."""
+    if depth <= 0:
+        yield from it
+        return
+    it = iter(it)
+    window: list = []
+    try:
+        for _ in range(depth):
+            item = next(it, _SENTINEL)
+            if item is _SENTINEL:
+                break
+            window.append(item)
+        while window:
+            nxt = next(it, _SENTINEL)
+            yield window.pop(0)
+            if nxt is not _SENTINEL:
+                window.append(nxt)
+    finally:
+        close = getattr(it, "close", None)
+        if close is not None:
+            close()

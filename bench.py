@@ -264,13 +264,23 @@ def bench_trace_overhead() -> dict:
     }
 
 
+def _table_rows(files) -> int:
+    """Row count of a parquet table (metadata only)."""
+    import pyarrow.parquet as pq
+    files = [files] if isinstance(files, str) else list(files)
+    return sum(pq.read_metadata(f).num_rows for f in files)
+
+
 def bench_profile_q01() -> dict:
     """Machine-readable host/device profile of the q01 OPERATOR pipeline
     (it/queries.py q01_filter_agg — the plan-shaped twin of the flagship
     kernel the headline metric times): one profiled explain-analyze run,
-    rolled up by obs/profile.summarize_tree. This is the bench record's
-    attribution section — tools/perf_gate.py carries it through so a
-    rows/s regression arrives WITH the category split that explains it."""
+    rolled up by obs/profile.summarize_tree, plus the end-to-end
+    OPERATOR-pipeline throughput ``pipeline_rows_per_sec`` (input rows /
+    wall — the number the pipelined-execution work moves and the CPU
+    floor tools/perf_gate.py gates). This is the bench record's
+    attribution section — the gate carries it through so a rows/s
+    regression arrives WITH the category split that explains it."""
     import tempfile
 
     from auron_tpu import config as cfg
@@ -279,7 +289,11 @@ def bench_profile_q01() -> dict:
     from auron_tpu.obs import metric_tree as mt
     from auron_tpu.obs import profile as obs_profile
 
-    scale = float(os.environ.get("AURON_BENCH_PROFILE_SCALE", "0.1"))
+    # scale 4 ≈ 480k fact rows: large enough that per-query fixed
+    # overhead (plan/trace/host-fn glue, ~100 ms) stops dominating the
+    # throughput figure the gate's CPU pipeline floor watches
+    scale = float(os.environ.get("AURON_BENCH_PROFILE_SCALE", "4"))
+    reps = max(1, int(os.environ.get("AURON_BENCH_PROFILE_REPS", "2")))
     data = tempfile.mkdtemp(prefix="auron_profile_q01_")
     conf = cfg.get_config()
     try:
@@ -287,17 +301,29 @@ def bench_profile_q01() -> dict:
         conf.set(cfg.PROFILE_ENABLED, True)
         from auron_tpu.it.queries import q01_dataframe
         q01_dataframe(Session(), tables).collect()   # warm compiles
-        s = Session()
-        df = q01_dataframe(s, tables)
-        t0 = time.perf_counter()
-        op = s.plan_physical(df)
-        tree, _tbl = mt.explain_analyze(
-            op, num_partitions=df.num_partitions,
-            mem_manager=s.mem_manager, config=s.config)
-        wall_s = time.perf_counter() - t0
+        # best-of-N (container timing noise is additive and positive —
+        # the per-query-min estimator argument, PERF.md)
+        wall_s, tree = float("inf"), None
+        for _ in range(reps):
+            s = Session()
+            df = q01_dataframe(s, tables)
+            t0 = time.perf_counter()
+            op = s.plan_physical(df)
+            rep_tree, _tbl = mt.explain_analyze(
+                op, num_partitions=df.num_partitions,
+                mem_manager=s.mem_manager, config=s.config)
+            rep_wall = time.perf_counter() - t0
+            if rep_wall < wall_s:
+                wall_s, tree = rep_wall, rep_tree
         summary = obs_profile.summarize_tree(tree)
         summary["wall_s"] = round(wall_s, 3)
         summary["scale"] = scale
+        try:
+            rows = _table_rows(tables["store_sales"])
+            summary["input_rows"] = rows
+            summary["pipeline_rows_per_sec"] = round(rows / wall_s, 1)
+        except Exception:
+            pass
         return summary
     finally:
         conf.unset(cfg.PROFILE_ENABLED)
@@ -372,9 +398,55 @@ def _snapshot_partial(result: dict) -> None:
         pass   # snapshotting must never fail the bench
 
 
+def _bind_xla_cache() -> dict:
+    """Bind jax's persistent compilation cache for the bench child
+    (``auron.xla_cache_dir``; default a stable per-container dir so
+    successive rounds share compiles): q01's multi-second first-call
+    tracing cost stops polluting per-round throughput comparisons.
+    Returns the cache record for the bench JSON — ``entries_before`` >
+    0 means this run started warm (cache hits), ``new_entries`` counts
+    the misses this run compiled and persisted."""
+    import tempfile
+
+    from auron_tpu import config as cfg
+    conf = cfg.get_config()
+    cache_dir = conf.get(cfg.XLA_CACHE_DIR) or os.path.join(
+        tempfile.gettempdir(), "auron_xla_cache")
+    record = {"dir": cache_dir, "entries_before": 0}
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        conf.set(cfg.XLA_CACHE_DIR, cache_dir)   # Sessions re-bind too
+        import jax
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # default min-compile-time gate (1s) would skip most CPU-mesh
+        # programs; persist everything so the warm-round diet is real
+        try:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception:
+            pass
+        record["entries_before"] = len(os.listdir(cache_dir))
+    except Exception as e:   # cache must never fail the bench
+        record["error"] = str(e)[:200]
+    return record
+
+
+def _finish_xla_cache(record: dict) -> dict:
+    try:
+        entries = len(os.listdir(record["dir"]))
+        record["entries_after"] = entries
+        record["new_entries"] = entries - record.get("entries_before", 0)
+        record["warm"] = record.get("entries_before", 0) > 0
+    except Exception:
+        pass
+    return record
+
+
 def _child_main() -> None:
     import faulthandler
     faulthandler.dump_traceback_later(_BENCH_TIMEOUT_S - 30, exit=True)
+
+    xla_cache = _bind_xla_cache()
 
     import jax
     platform = jax.devices()[0].platform
@@ -450,6 +522,9 @@ def _child_main() -> None:
         result["profile"] = bench_profile_q01()
     except Exception as e:   # additive: never lose the earlier data
         result["profile_error"] = str(e)[:300]
+    # persistent-compile-cache economics of this run (satellite of the
+    # pipelined-execution PR: warm rounds stop re-paying q01's tracing)
+    result["xla_cache"] = _finish_xla_cache(xla_cache)
     # set when this child is the CPU fallback after an accelerator
     # failure (probe or bench): keeps environmental failures
     # distinguishable from perf regressions in the recorded line

@@ -25,6 +25,15 @@ def _baseline():
         os.path.join(_REPO, "tools", "perf_baseline.json"))
 
 
+def _healthy_profile(base):
+    """A profile section at the cpu pipeline baseline — synthetic cpu
+    records need one now that a MISSING pipeline number fails the gate
+    loudly (the silent-skip fix)."""
+    pipe = base["platforms"]["cpu"]["pipeline"]
+    return {"scale": pipe["scale"],
+            "pipeline_rows_per_sec": pipe["rows_per_sec"]}
+
+
 class TestPerfGate:
     def test_baseline_shape(self):
         base = _baseline()
@@ -38,7 +47,7 @@ class TestPerfGate:
     def test_pass_at_head_level(self):
         base = _baseline()
         rec = {"value": base["platforms"]["cpu"]["rows_per_sec"] * 1.2,
-               "platform": "cpu"}
+               "platform": "cpu", "profile": _healthy_profile(base)}
         v = perf_gate.evaluate(rec, base, tolerance_pct=50.0)
         assert v["perf_gate"] == "pass"
         assert v["floor_rows_per_sec"] < v["value_rows_per_sec"]
@@ -57,12 +66,101 @@ class TestPerfGate:
     def test_tolerance_boundary(self):
         base = _baseline()
         cpu = base["platforms"]["cpu"]["rows_per_sec"]
-        at_floor = {"value": cpu * 0.5, "platform": "cpu"}
-        just_below = {"value": cpu * 0.5 - 1, "platform": "cpu"}
-        assert perf_gate.evaluate(at_floor, base, 50.0)["perf_gate"] \
+        at_floor = {"value": cpu * 0.5, "platform": "cpu",
+                    "profile": _healthy_profile(base)}
+        just_below = {"value": cpu * 0.5 - 1, "platform": "cpu",
+                      "profile": _healthy_profile(base)}
+        # pinned = the CLI path: the platform entry's tighter tolerance
+        # must NOT override an explicit --tolerance-pct
+        assert perf_gate.evaluate(at_floor, base, 50.0,
+                                  tolerance_pinned=True)["perf_gate"] \
             == "pass"
-        assert perf_gate.evaluate(just_below, base, 50.0)["perf_gate"] \
+        assert perf_gate.evaluate(just_below, base, 50.0,
+                                  tolerance_pinned=True)["perf_gate"] \
             == "fail"
+
+    def test_platform_entry_tolerance_overrides_default(self):
+        """The tightened CPU floor: the cpu entry's tolerance_pct (30)
+        beats the resolved default (50) unless the caller pinned one."""
+        base = _baseline()
+        cpu = base["platforms"]["cpu"]["rows_per_sec"]
+        entry_tol = base["platforms"]["cpu"]["tolerance_pct"]
+        assert entry_tol < 50.0
+        rec = {"value": cpu * (1 - (entry_tol + 5) / 100),
+               "platform": "cpu", "profile": _healthy_profile(base)}
+        v = perf_gate.evaluate(rec, base, tolerance_pct=50.0)
+        assert v["tolerance_pct"] == entry_tol
+        assert v["perf_gate"] == "fail"
+        # pinned CLI tolerance still wins
+        v = perf_gate.evaluate(rec, base, tolerance_pct=50.0,
+                               tolerance_pinned=True)
+        assert v["perf_gate"] == "pass"
+
+    def test_pipeline_floor_fails_seeded_minus_20pct(self):
+        """The PR 8 satellite's acceptance test: a synthetic −20%
+        regression of the q01 OPERATOR-pipeline throughput must fail
+        the gate (the pipeline entry's tolerance is 15%), even when the
+        kernel headline is healthy."""
+        base = _baseline()
+        cpu = base["platforms"]["cpu"]
+        pipe = cpu["pipeline"]
+        rec = {"value": cpu["rows_per_sec"] * 1.2, "platform": "cpu",
+               "profile": {"scale": pipe["scale"],
+                           "pipeline_rows_per_sec":
+                               pipe["rows_per_sec"] * 0.8}}
+        v = perf_gate.evaluate(rec, base, tolerance_pct=50.0)
+        assert v["pipeline"]["verdict"] == "fail"
+        assert v["perf_gate"] == "fail"
+        assert v["pipeline"]["delta_vs_baseline_pct"] == -20.0
+        # at-baseline pipeline passes
+        rec["profile"]["pipeline_rows_per_sec"] = pipe["rows_per_sec"]
+        v = perf_gate.evaluate(rec, base, tolerance_pct=50.0)
+        assert v["perf_gate"] == "pass"
+        assert v["pipeline"]["verdict"] == "pass"
+
+    def test_pipeline_floor_skipped_on_scale_mismatch(self):
+        """Batch-size / scale experiments (a different profile scale)
+        must not trip the pipeline floor — but the skip is RECORDED in
+        the verdict, never silent."""
+        base = _baseline()
+        cpu = base["platforms"]["cpu"]
+        pipe = cpu["pipeline"]
+        rec = {"value": cpu["rows_per_sec"], "platform": "cpu",
+               "profile": {"scale": pipe["scale"] * 8,
+                           "pipeline_rows_per_sec": 1.0}}
+        v = perf_gate.evaluate(rec, base, tolerance_pct=50.0)
+        assert v["pipeline"]["verdict"] == "skipped"
+        assert "scale" in v["pipeline"]["reason"]
+        assert v["perf_gate"] == "pass"
+
+    def test_pipeline_floor_missing_fails_loudly(self):
+        """A cpu record WITHOUT a usable pipeline number (bench profile
+        errored, or throughput collapsed to 0) must FAIL the gate —
+        exactly the silent-decay mode the floor exists to catch."""
+        base = _baseline()
+        cpu = base["platforms"]["cpu"]["rows_per_sec"]
+        for rec in (
+            {"value": cpu, "platform": "cpu"},
+            {"value": cpu, "platform": "cpu",
+             "profile_error": "boom at scale 4"},
+            {"value": cpu, "platform": "cpu",
+             "profile": {"scale": 4.0, "pipeline_rows_per_sec": 0}},
+        ):
+            v = perf_gate.evaluate(rec, base, tolerance_pct=50.0)
+            assert v["pipeline"]["verdict"] == "missing", rec
+            assert v["perf_gate"] == "fail", rec
+
+    def test_smoke_mode(self, capsys):
+        """tools/perf_gate.py --smoke from tier-1: the in-process q01
+        pipeline at tiny scale clears the generous smoke floor, and the
+        last stdout line is one JSON verdict (driver contract)."""
+        rc = perf_gate.main(["--smoke"])
+        out = capsys.readouterr().out
+        last = json.loads(out.strip().splitlines()[-1])
+        assert last["mode"] == "smoke"
+        assert rc == 0, out
+        assert last["perf_gate"] == "pass"
+        assert last["value_rows_per_sec"] > last["floor_rows_per_sec"]
 
     def test_unusable_records(self):
         base = _baseline()
@@ -95,7 +193,7 @@ class TestPerfGate:
         good = tmp_path / "good.json"
         good.write_text(json.dumps(
             {"value": base["platforms"]["cpu"]["rows_per_sec"],
-             "platform": "cpu"}))
+             "platform": "cpu", "profile": _healthy_profile(base)}))
         bad = tmp_path / "bad.json"
         bad.write_text(json.dumps({"value": 1.0, "platform": "cpu"}))
         assert perf_gate.main(["--bench-json", str(good)]) == 0

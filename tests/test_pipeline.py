@@ -1,0 +1,418 @@
+"""Pipelined async execution (runtime/pipeline.py + the prefetching
+scan + double-buffered dispatch + async-aware attribution).
+
+The contracts this file holds:
+
+- ``lookahead`` preserves order exactly and propagates close/errors;
+- the scan prefetcher streams batches in source order, registers its
+  decoded bytes with the memory manager, unregisters on close (the
+  tier-1 leak-audit fixtures watch the same ledger), re-raises worker
+  errors with their type intact, and shrinks its lookahead to 1 under
+  pressure-ladder rung 1;
+- a cancel mid-prefetch unwinds classified and leaks neither consumers
+  nor spill files;
+- pipelined-mode attribution still sums to wall (device measured at
+  the moved sync points, per-call dispatch kept);
+- bit-identity of pipelined vs serial on a real parquet query (the
+  full TPC-DS battery lives in tests/test_zz_pipeline_battery.py).
+"""
+
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from auron_tpu import config as cfg
+from auron_tpu.memmgr.manager import MemManager
+from auron_tpu.ops.base import ExecContext
+from auron_tpu.runtime import pipeline
+
+
+# ---------------------------------------------------------------------------
+# lookahead window
+# ---------------------------------------------------------------------------
+
+class TestLookahead:
+    def test_preserves_order_and_exhausts(self):
+        for depth in (0, 1, 2, 5, 100):
+            assert list(pipeline.lookahead(iter(range(7)), depth)) \
+                == list(range(7))
+        assert list(pipeline.lookahead(iter([]), 1)) == []
+
+    def test_pulls_ahead_of_yield(self):
+        pulled = []
+
+        def src():
+            for i in range(4):
+                pulled.append(i)
+                yield i
+
+        it = pipeline.lookahead(src(), depth=1)
+        assert next(it) == 0
+        # item 1 was pulled BEFORE item 0 was yielded (the overlap)
+        assert pulled == [0, 1]
+
+    def test_close_propagates(self):
+        closed = []
+
+        def src():
+            try:
+                for i in range(100):
+                    yield i
+            finally:
+                closed.append(True)
+
+        it = pipeline.lookahead(src(), depth=1)
+        assert next(it) == 0
+        it.close()
+        assert closed == [True]
+
+    def test_error_surfaces(self):
+        def src():
+            yield 1
+            raise ValueError("decode failed")
+
+        it = pipeline.lookahead(src(), depth=1)
+        with pytest.raises(ValueError, match="decode failed"):
+            list(it)
+
+
+# ---------------------------------------------------------------------------
+# knob resolution
+# ---------------------------------------------------------------------------
+
+def test_enabled_tracks_config_epoch():
+    conf = cfg.get_config()
+    assert pipeline.enabled()          # default on
+    conf.set(cfg.PIPELINE_ENABLED, False)
+    try:
+        assert not pipeline.enabled()
+    finally:
+        conf.unset(cfg.PIPELINE_ENABLED)
+    assert pipeline.enabled()
+
+
+def test_ctx_device_sync_off_under_pipelining():
+    ctx = ExecContext()
+    assert ctx.pipelined
+    assert not ctx.device_sync     # pipelining moves the sync points
+    # the knob is PROCESS-GLOBAL by contract: every plane (timers, the
+    # profiler's program wrapper, the executor's fence) must agree on
+    # where the sync points live, and the wrapper cannot see a session
+    # config — so only the global flips the mode
+    conf = cfg.get_config()
+    conf.set(cfg.PIPELINE_ENABLED, False)
+    try:
+        ctx2 = ExecContext()
+        assert not ctx2.pipelined
+        assert ctx2.device_sync
+        # a session-scoped override is deliberately NOT honored
+        ctx3 = ExecContext(config=cfg.AuronConfig(
+            {cfg.PIPELINE_ENABLED: True}))
+        assert not ctx3.pipelined
+    finally:
+        conf.unset(cfg.PIPELINE_ENABLED)
+
+
+# ---------------------------------------------------------------------------
+# scan prefetcher
+# ---------------------------------------------------------------------------
+
+def _write_parquet(tmp, rows=50_000, row_group=4096):
+    rng = np.random.default_rng(0)
+    path = os.path.join(tmp, "t.parquet")
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 100, rows), pa.int64()),
+        "v": pa.array(rng.normal(size=rows), pa.float64()),
+    }), path, row_group_size=row_group)
+    return path
+
+
+class TestScanPrefetcher:
+    def _prefetcher(self, source, ctx=None, depth=2):
+        from auron_tpu.io.parquet import ScanPrefetcher
+        return ScanPrefetcher(source, ctx or ExecContext(), depth)
+
+    def test_order_and_drain(self):
+        from auron_tpu.ops.base import MetricsSet
+        items = [(i, 10) for i in range(20)]
+        pf = self._prefetcher(iter(items))
+        try:
+            out = list(pf.batches(MetricsSet().counter("io_time")))
+        finally:
+            pf.close()
+        assert out == list(range(20))
+
+    def test_memmgr_accounting_and_unregister(self):
+        from auron_tpu.memmgr import manager as mgr
+        from auron_tpu.ops.base import MetricsSet
+        mem = MemManager(total_bytes=1 << 30)
+        before = mgr.live_consumer_count()
+        gate = threading.Event()
+
+        def src():
+            for i in range(6):
+                yield i, 1000
+            gate.wait(5)
+
+        ctx = ExecContext(mem_manager=mem)
+        pf = self._prefetcher(src(), ctx)
+        try:
+            it = pf.batches(MetricsSet().counter("io_time"))
+            next(it)
+            # worker holds up to depth buffered items; accounting is
+            # queued bytes (0..depth*1000), consistent with the ledger
+            deadline = time.monotonic() + 2
+            while pf.mem_used() == 0 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert 0 <= pf.mem_used() <= 2 * 1000
+            assert mgr.live_consumer_count() == before + 1
+        finally:
+            gate.set()
+            pf.close()
+        assert pf.mem_used() == 0
+        assert mgr.live_consumer_count() == before
+
+    def test_worker_error_reraised_with_type(self):
+        from auron_tpu.ops.base import MetricsSet
+
+        def src():
+            yield 0, 1
+            raise RuntimeError("corrupt row group")
+
+        pf = self._prefetcher(src())
+        try:
+            with pytest.raises(RuntimeError, match="corrupt row group"):
+                list(pf.batches(MetricsSet().counter("io_time")))
+        finally:
+            pf.close()
+
+    def test_depth_shrinks_under_pressure_rung1(self):
+        """Pressure-ladder rung 1 (the shrink rung: advised_batch_rows
+        < base) must degrade the prefetch lookahead to 1."""
+        mem = MemManager(total_bytes=1 << 30)
+        ctx = ExecContext(mem_manager=mem)
+        pf = self._prefetcher(iter([]), ctx, depth=4)
+        try:
+            assert pf.target_depth() == 4
+            mem._shrink_level = 1          # rung 1 taken
+            assert pf.target_depth() == 1
+            mem._shrink_level = 0
+            assert pf.target_depth() == 4
+            pf.shrink()                    # the ladder's direct ask
+            assert pf.target_depth() == 1
+        finally:
+            pf.close()
+
+    def test_cancel_mid_prefetch_no_leaks(self):
+        """Cancel while the worker is mid-stream: the consumer unwinds
+        with the classified error, the worker stops, and the memmgr
+        ledger returns to its pre-scan state (the tier-1 leak-audit
+        fixtures check the same globals after this test)."""
+        from auron_tpu.memmgr import manager as mgr
+        from auron_tpu.ops.base import MetricsSet
+        from auron_tpu.runtime.lifecycle import CancelToken
+
+        mem = MemManager(total_bytes=1 << 30)
+        before = mgr.live_consumer_count()
+        token = CancelToken(query_id="q_prefetch")
+        ctx = ExecContext(mem_manager=mem, cancel_event=token)
+
+        def src():
+            i = 0
+            while True:          # endless decode — only cancel stops it
+                yield i, 100
+                i += 1
+                time.sleep(0.001)
+
+        pf = self._prefetcher(src(), ctx)
+        try:
+            it = pf.batches(MetricsSet().counter("io_time"))
+            next(it)
+            threading.Thread(target=lambda: (time.sleep(0.05),
+                                             token.cancel()),
+                             daemon=True).start()
+            from auron_tpu import errors
+            with pytest.raises(errors.QueryCancelled):
+                for _ in it:
+                    pass
+        finally:
+            pf.close()
+        assert pf.mem_used() == 0
+        assert mgr.live_consumer_count() == before
+        # the worker thread exits promptly after close
+        pf._thread.join(timeout=2)
+        assert not pf._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: parquet scan, pipelined vs serial
+# ---------------------------------------------------------------------------
+
+class TestPipelinedScan:
+    @pytest.fixture(scope="class")
+    def data(self, tmp_path_factory):
+        tmp = str(tmp_path_factory.mktemp("pipe_scan"))
+        return _write_parquet(tmp)
+
+    def _q(self, path):
+        from auron_tpu.frontend.dataframe import col
+        from auron_tpu.frontend.session import Session
+        s = Session()
+        return (s.read_parquet([path])
+                .filter(col("k") < 50)
+                .group_by("k")
+                .agg(__import__(
+                    "auron_tpu.frontend.dataframe",
+                    fromlist=["functions"]).functions.sum(col("v"))
+                    .alias("sv"))
+                .collect())
+
+    def test_bit_identical_on_off(self, data):
+        conf = cfg.get_config()
+        pipelined = self._q(data)
+        conf.set(cfg.PIPELINE_ENABLED, False)
+        try:
+            serial = self._q(data)
+        finally:
+            conf.unset(cfg.PIPELINE_ENABLED)
+        assert pipelined.equals(serial)
+
+    def test_scan_cancel_through_session_is_clean(self, data):
+        """df.collect(timeout_s=tiny) during a parquet scan: classified
+        deadline, and the scan prefetcher's consumer is gone after (the
+        autouse leak fixtures re-check at module end)."""
+        from auron_tpu import errors
+        from auron_tpu.frontend.dataframe import col
+        from auron_tpu.frontend.session import Session
+        from auron_tpu.memmgr import manager as mgr
+        before = mgr.live_consumer_count()
+        s = Session(mem_manager=MemManager(total_bytes=1 << 30))
+        df = s.read_parquet([data]).filter(col("k") >= 0)
+        with pytest.raises(errors.QueryCancelled):
+            df.collect(timeout_s=0.000001)
+        import gc
+        gc.collect()
+        assert mgr.live_consumer_count() <= before
+
+    def test_pipelined_attribution_sums_and_fences_device(self, data):
+        """Async-aware timing: with profiling on and pipelining on, the
+        export still carries elapsed_device (fenced at the to_arrow
+        boundary / control readbacks), and per-op attribution never
+        exceeds wall by more than the documented tolerance."""
+        from auron_tpu.frontend.dataframe import col
+        from auron_tpu.frontend.session import Session
+        conf = cfg.get_config()
+        with tempfile.TemporaryDirectory() as td:
+            conf.set(cfg.TRACE_DIR, td)
+            try:
+                s = Session()
+                (s.read_parquet([data]).filter(col("k") < 10).collect())
+                profs = [f for f in os.listdir(td)
+                         if f.startswith("profile_")]
+                assert profs, os.listdir(td)
+                import json
+                records = []
+                for f in profs:
+                    with open(os.path.join(td, f)) as fh:
+                        records += [json.loads(l) for l in fh
+                                    if l.strip()]
+            finally:
+                conf.unset(cfg.TRACE_DIR)
+        assert records
+        total_device = sum(r["metrics"].get("elapsed_device", 0)
+                           for r in records)
+        assert total_device > 0, records
+        # per-record: buckets inside elapsed_compute stay bounded by it
+        for r in records:
+            m = r["metrics"]
+            wall = m.get("elapsed_compute", 0)
+            if not wall:
+                continue
+            inside = m.get("elapsed_host_dispatch", 0) \
+                + m.get("elapsed_host_other", 0)
+            assert inside <= wall * 1.10 + 500_000, r
+
+
+# ---------------------------------------------------------------------------
+# donation sweep plumbing
+# ---------------------------------------------------------------------------
+
+class TestDonationSweep:
+    def test_stage_program_keys_split_on_donate(self):
+        """The fused-stage program cache must key on the donate flag —
+        a donating and a non-donating caller can never share a
+        compiled program."""
+        from auron_tpu.ops import fused
+        site = fused._STAGE_PROGRAMS
+        stats0 = site.stats()["builds"]
+        from auron_tpu.columnar.schema import DataType, Field, Schema
+        import jax.numpy as jnp
+        from auron_tpu.ops.fused import KernelFragment
+
+        def apply(batch, pid, carry):
+            return (batch,), carry
+
+        frag = KernelFragment(key=("test_donate_plumb",), apply=apply)
+        schema = Schema((Field("x", DataType.INT64),))
+        k1, b1 = fused.stage_program(("a",), schema, 16, [frag], False)
+        k2, b2 = fused.stage_program(("a",), schema, 16, [frag], True)
+        k3, b3 = fused.stage_program(("a",), schema, 16, [frag], False)
+        assert b1 and b2 and not b3
+        assert site.stats()["builds"] == stats0 + 2
+
+    def test_agg_donation_gate(self):
+        """Owned child + no collect kinds → donate; collect kinds or
+        borrowed batches → never."""
+        from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+        from auron_tpu.exprs import ir
+        from auron_tpu.io.parquet import DeviceBatchScanOp, MemoryScanOp
+        from auron_tpu.ops.agg import AggOp
+        rb = pa.record_batch({"k": pa.array([1, 2], pa.int64()),
+                              "v": pa.array([0.5, 1.5], pa.float64())})
+        schema = schema_from_arrow(rb.schema)
+        owned = MemoryScanOp([[rb]], schema, capacity=16)
+        ctx = ExecContext()
+        agg = AggOp(owned, [ir.ColumnRef(0)],
+                    [ir.AggFunction("sum", ir.ColumnRef(1))],
+                    mode="complete")
+        assert agg._donate_contributions(ctx)
+        borrowed = DeviceBatchScanOp([[None]], schema)
+        agg_b = AggOp(borrowed, [ir.ColumnRef(0)],
+                      [ir.AggFunction("sum", ir.ColumnRef(1))],
+                      mode="complete")
+        assert not agg_b._donate_contributions(ctx)
+        agg_c = AggOp(owned, [ir.ColumnRef(0)],
+                      [ir.AggFunction("collect_list", ir.ColumnRef(1))],
+                      mode="complete")
+        assert not agg_c._donate_contributions(ctx)
+
+    def test_aliased_contributions_never_donate(self):
+        """sum(x) + avg(x) share the x column object — the reduce must
+        detect the aliasing and fall back to the non-donating program
+        (duplicate donated buffers are illegal on real backends), while
+        producing identical results."""
+        from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+        from auron_tpu.exprs import ir
+        from auron_tpu.io.parquet import MemoryScanOp
+        from auron_tpu.ops.agg import AggOp
+        from auron_tpu.runtime.executor import (ExecutionRuntime,
+                                                TaskDefinition)
+        rng = np.random.default_rng(1)
+        rb = pa.record_batch({
+            "k": pa.array(rng.integers(0, 5, 256), pa.int64()),
+            "v": pa.array(rng.normal(size=256), pa.float64())})
+        scan = MemoryScanOp([[rb]], schema_from_arrow(rb.schema),
+                            capacity=256)
+        op = AggOp(scan, [ir.ColumnRef(0)],
+                   [ir.AggFunction("sum", ir.ColumnRef(1)),
+                    ir.AggFunction("avg", ir.ColumnRef(1))],
+                   mode="complete")
+        rt = ExecutionRuntime(op, TaskDefinition(task_id=1))
+        tbl = rt.collect()
+        assert tbl.num_rows == 5

@@ -1,0 +1,49 @@
+"""Pipelined-vs-serial TPC-DS differential battery (ISSUE 8 tentpole
+safety net).
+
+Runs a representative TPC-DS subset with ``auron.pipeline.enabled`` on
+vs off and asserts BIT-IDENTICAL results: overlap (prefetching scan,
+double-buffered dispatch, donation, moved sync points) may only change
+WHEN work happens, never a value or an output order. Named test_zz_* so
+the time-boxed tier-1 window runs the fast pipeline unit tests
+(test_pipeline.py) first; the subset spans scans through exchanges,
+joins, windows and sorts so every moved sync point gets traffic.
+"""
+
+import tempfile
+
+import pytest
+
+from auron_tpu import config as cfg
+from auron_tpu.frontend.session import Session
+from auron_tpu.it.tpcds import generate
+from auron_tpu.it.tpcds_queries import QUERIES
+
+_SCALE = 0.02
+_NAMES = ["q3", "q19", "q48", "q68", "q43", "q96"]
+
+
+@pytest.fixture(scope="module")
+def tables():
+    with tempfile.TemporaryDirectory(prefix="pipeline_battery_") as d:
+        yield generate(d, scale=_SCALE)
+
+
+def _q(name):
+    return next(q for q in QUERIES if q.name == name)
+
+
+@pytest.mark.parametrize("qname", _NAMES)
+def test_query_bit_identical_pipelined_vs_serial(qname, tables):
+    conf = cfg.get_config()
+    q = _q(qname)
+    try:
+        conf.set(cfg.PIPELINE_ENABLED, False)
+        serial = q.run(Session(), tables)
+        conf.set(cfg.PIPELINE_ENABLED, True)
+        pipelined = q.run(Session(), tables)
+    finally:
+        conf.unset(cfg.PIPELINE_ENABLED)
+    assert pipelined.num_rows == serial.num_rows
+    assert pipelined.equals(serial), \
+        f"{qname}: pipelined result differs from serial (values or order)"

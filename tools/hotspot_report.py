@@ -54,14 +54,20 @@ def load_dir(trace_dir: str) -> list[dict]:
 
 
 def aggregate(records: list[dict]) -> dict:
-    """{(category, op): total_ns} plus per-category and per-op rollups."""
+    """{(category, op): total_ns} plus per-category and per-op rollups
+    (and per-op output rows/batches — the achieved batch size, so
+    batch-size experiments read straight off the table)."""
     cells: dict = {}
     compute_ns: dict = {}
+    rows: dict = {}
+    batches: dict = {}
     for r in records:
         op = r.get("op", "?")
         metrics = r.get("metrics", {})
         compute_ns[op] = compute_ns.get(op, 0) + \
             metrics.get("elapsed_compute", 0)
+        rows[op] = rows.get(op, 0) + metrics.get("output_rows", 0)
+        batches[op] = batches.get(op, 0) + metrics.get("output_batches", 0)
         for cat in CATEGORIES:
             v = metrics.get(_METRIC_FOR[cat], 0)
             if v:
@@ -72,7 +78,7 @@ def aggregate(records: list[dict]) -> dict:
         by_cat[cat] += ns
         by_op[op] = by_op.get(op, 0) + ns
     return {"cells": cells, "by_cat": by_cat, "by_op": by_op,
-            "compute_ns": compute_ns}
+            "compute_ns": compute_ns, "rows": rows, "batches": batches}
 
 
 def _ms(ns: int) -> float:
@@ -98,6 +104,12 @@ def report(agg: dict, top: int = 10) -> dict:
         "top_host_categories": [c for c, _v in top_categories[:3]],
         "top_sinks": [{"category": c, "op": o, "ms": m}
                       for c, o, m in top_sinks],
+        # achieved batch sizes (output rows / output batches per op) —
+        # the auron.scan.batch_rows experiment readout
+        "rows_per_batch": {
+            op: round(agg["rows"][op] / agg["batches"][op], 1)
+            for op in agg.get("batches", {})
+            if agg["batches"].get(op)},
         # attribution coverage: how much of the timers' measured wall
         # the buckets explain (convert/serde/iter live OUTSIDE
         # elapsed_compute, so >100% is normal on scan-heavy plans)
@@ -107,15 +119,23 @@ def report(agg: dict, top: int = 10) -> dict:
     }
 
 
+def _rows_per_batch(agg: dict, op: str):
+    b = agg.get("batches", {}).get(op, 0)
+    return (agg.get("rows", {}).get(op, 0) / b) if b else None
+
+
 def print_table(agg: dict, rep: dict, top: int) -> None:
     ops = sorted(agg["by_op"], key=lambda o: -agg["by_op"][o])
     print("category × operator attribution (ms):")
     header = f"{'operator':24s}" + "".join(f"{c:>10s}" for c in CATEGORIES)
+    header += f"{'rows/batch':>12s}"
     print(header)
     for op in ops:
         row = f"{op[:24]:24s}"
         for cat in CATEGORIES:
             row += f"{_ms(agg['cells'].get((cat, op), 0)):>10.1f}"
+        rpb = _rows_per_batch(agg, op)
+        row += f"{rpb:>12.0f}" if rpb is not None else f"{'-':>12s}"
         print(row)
     total_row = f"{'TOTAL':24s}"
     for cat in CATEGORIES:

@@ -76,9 +76,20 @@ def resolve_tolerance(cli_pct, baseline: dict) -> float:
     return float(baseline.get("default_tolerance_pct", 50.0))
 
 
-def evaluate(record: dict, baseline: dict, tolerance_pct: float) -> dict:
+def evaluate(record: dict, baseline: dict, tolerance_pct: float,
+             tolerance_pinned: bool = False) -> dict:
     """Pure gate verdict from a bench record + baseline (the unit the
-    mechanics tests drive with synthetic records)."""
+    mechanics tests drive with synthetic records).
+
+    Two floors per platform: the flagship KERNEL metric
+    (``record["value"]``, the historical rows/s headline) under the
+    platform's ``tolerance_pct`` (falling back to the resolved default),
+    and — when both the baseline entry and the record carry one — the
+    q01 OPERATOR-PIPELINE floor (``profile.pipeline_rows_per_sec``, the
+    end-to-end number the pipelined-execution work moves) under its own
+    tighter tolerance. Either floor failing fails the gate. The
+    pipeline floor only applies when the record's profile scale matches
+    the baseline's (batch-size/scale experiments must not trip it)."""
     if "error" in record and record.get("value") is None:
         return {"perf_gate": "unusable",
                 "reason": f"bench errored: {record['error']}"}
@@ -91,7 +102,13 @@ def evaluate(record: dict, baseline: dict, tolerance_pct: float) -> dict:
                 "reason": f"no baseline for platform {platform!r}"}
     value = float(record.get("value", 0.0))
     base = float(entry["rows_per_sec"])
-    floor = base * (1.0 - tolerance_pct / 100.0)
+    # per-platform tolerance override (the tightened CPU floor) unless
+    # the caller pinned one explicitly (CLI --tolerance-pct)
+    entry_tol = entry.get("tolerance_pct")
+    eff_tol = (float(entry_tol)
+               if entry_tol is not None and not tolerance_pinned
+               else tolerance_pct)
+    floor = base * (1.0 - eff_tol / 100.0)
     verdict = {
         "perf_gate": "pass" if value >= floor else "fail",
         "metric": baseline.get("metric"),
@@ -99,9 +116,53 @@ def evaluate(record: dict, baseline: dict, tolerance_pct: float) -> dict:
         "value_rows_per_sec": round(value, 1),
         "baseline_rows_per_sec": round(base, 1),
         "floor_rows_per_sec": round(floor, 1),
-        "tolerance_pct": tolerance_pct,
+        "tolerance_pct": eff_tol,
         "delta_vs_baseline_pct": round((value - base) / base * 100.0, 2),
     }
+    pentry = entry.get("pipeline")
+    if pentry:
+        prof = record.get("profile")
+        pscale = pentry.get("scale")
+        has_value = isinstance(prof, dict) \
+            and bool(prof.get("pipeline_rows_per_sec"))
+        if has_value and pscale is not None \
+                and float(prof.get("scale", -1)) != float(pscale):
+            # batch-size / scale experiments must not trip the floor,
+            # but the skip is RECORDED, never silent
+            verdict["pipeline"] = {
+                "verdict": "skipped",
+                "reason": f"profile scale {prof.get('scale')} != "
+                          f"baseline scale {pscale}",
+            }
+        elif not has_value:
+            # the baseline expects a pipeline number and the record
+            # can't produce one (bench profile errored, or throughput
+            # collapsed to 0) — exactly the silent-decay mode the
+            # floor exists to catch: fail loudly
+            verdict["pipeline"] = {
+                "verdict": "missing",
+                "reason": "record carries no usable "
+                          "profile.pipeline_rows_per_sec "
+                          + (f"(profile_error: {record['profile_error']})"
+                             if record.get("profile_error") else ""),
+            }
+            verdict["perf_gate"] = "fail"
+        else:
+            pval = float(prof["pipeline_rows_per_sec"])
+            pbase = float(pentry["rows_per_sec"])
+            ptol = float(pentry.get("tolerance_pct", eff_tol))
+            pfloor = pbase * (1.0 - ptol / 100.0)
+            verdict["pipeline"] = {
+                "verdict": "pass" if pval >= pfloor else "fail",
+                "value_rows_per_sec": round(pval, 1),
+                "baseline_rows_per_sec": round(pbase, 1),
+                "floor_rows_per_sec": round(pfloor, 1),
+                "tolerance_pct": ptol,
+                "delta_vs_baseline_pct": round(
+                    (pval - pbase) / pbase * 100.0, 2),
+            }
+            if pval < pfloor:
+                verdict["perf_gate"] = "fail"
     # carry the forensics along: a failing gate should arrive WITH the
     # host/device attribution and the structured backend diagnosis
     if isinstance(record.get("profile"), dict):
@@ -119,6 +180,46 @@ def evaluate(record: dict, baseline: dict, tolerance_pct: float) -> dict:
     return verdict
 
 
+def run_smoke(baseline: dict) -> dict:
+    """Tier-1-fast smoke arm: run the q01 operator pipeline in-process
+    at a tiny scale and compare against the generous smoke floor — an
+    order-of-magnitude tripwire (compile-cache regressions, accidental
+    per-row host loops) cheap enough for a test to invoke every run,
+    so throughput can't silently decay between bench rounds again."""
+    import tempfile
+    import time
+
+    scale = float(os.environ.get("AURON_PERF_SMOKE_SCALE", "0.5"))
+    from auron_tpu.frontend.session import Session
+    from auron_tpu.it.queries import q01_dataframe
+    from auron_tpu.it.tpcds_data import generate as gen_data
+    smoke = baseline.get("smoke", {})
+    floor = float(smoke.get("cpu_floor_rows_per_sec", 20000.0))
+    data = tempfile.mkdtemp(prefix="auron_perf_smoke_")
+    try:
+        tables = gen_data(data, scale=scale)
+        from bench import _table_rows
+        rows = _table_rows(tables["store_sales"])
+        q01_dataframe(Session(), tables).collect()   # warm compiles
+        wall = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            q01_dataframe(Session(), tables).collect()
+            wall = min(wall, time.perf_counter() - t0)
+        value = rows / wall
+        return {
+            "perf_gate": "pass" if value >= floor else "fail",
+            "mode": "smoke",
+            "scale": scale,
+            "input_rows": rows,
+            "value_rows_per_sec": round(value, 1),
+            "floor_rows_per_sec": round(floor, 1),
+        }
+    finally:
+        import shutil
+        shutil.rmtree(data, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--baseline", default=_DEFAULT_BASELINE,
@@ -129,13 +230,26 @@ def main(argv=None) -> int:
     ap.add_argument("--run", action="store_true",
                     help="run bench.py for a fresh record (the default "
                          "when --bench-json is absent)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1-fast mode: run the q01 operator "
+                         "pipeline in-process at a tiny scale against "
+                         "the generous smoke floor (no bench.py child)")
     ap.add_argument("--tolerance-pct", type=float, default=None,
                     help="allowed shortfall vs the baseline floor "
                          "(default: auron.perf_gate.tolerance_pct env "
-                         "override, else the baseline file's)")
+                         "override, else the baseline file's / the "
+                         "platform entry's)")
     args = ap.parse_args(argv)
 
     baseline = load_baseline(args.baseline)
+    if args.smoke:
+        verdict = run_smoke(baseline)
+        print(f"perf gate [smoke @ scale {verdict['scale']}]: "
+              f"{verdict['value_rows_per_sec']:,.0f} rows/s vs floor "
+              f"{verdict['floor_rows_per_sec']:,.0f} → "
+              f"{verdict['perf_gate'].upper()}")
+        print(json.dumps(verdict))
+        return 0 if verdict["perf_gate"] == "pass" else 1
     if args.bench_json == "-":
         record = json.loads(sys.stdin.read().strip().splitlines()[-1])
     elif args.bench_json:
@@ -145,7 +259,8 @@ def main(argv=None) -> int:
         record = fresh_bench_record()
 
     tolerance = resolve_tolerance(args.tolerance_pct, baseline)
-    verdict = evaluate(record, baseline, tolerance)
+    verdict = evaluate(record, baseline, tolerance,
+                       tolerance_pinned=args.tolerance_pct is not None)
 
     if verdict["perf_gate"] == "unusable":
         print(f"perf gate: UNUSABLE — {verdict['reason']}")
@@ -155,8 +270,19 @@ def main(argv=None) -> int:
           f"{verdict['value_rows_per_sec']:,.0f} rows/s vs baseline "
           f"{verdict['baseline_rows_per_sec']:,.0f} "
           f"(floor {verdict['floor_rows_per_sec']:,.0f}, "
-          f"tolerance {tolerance:.0f}%) → "
+          f"tolerance {verdict['tolerance_pct']:.0f}%) → "
           f"{verdict['perf_gate'].upper()}")
+    if "pipeline" in verdict:
+        p = verdict["pipeline"]
+        if p["verdict"] in ("skipped", "missing"):
+            print(f"  q01 pipeline: {p['verdict'].upper()} — "
+                  f"{p['reason']}")
+        else:
+            print(f"  q01 pipeline: {p['value_rows_per_sec']:,.0f} "
+                  f"rows/s vs baseline "
+                  f"{p['baseline_rows_per_sec']:,.0f} "
+                  f"(floor {p['floor_rows_per_sec']:,.0f}, tolerance "
+                  f"{p['tolerance_pct']:.0f}%) → {p['verdict'].upper()}")
     if "profile" in verdict:
         p = verdict["profile"]
         print(f"  host/device split: device={p.get('device_ms')}ms "
