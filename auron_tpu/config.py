@@ -119,6 +119,48 @@ SCAN_PREFETCH_BATCHES = _opt(
     "<= 1 keeps the decode worker but no lookahead beyond the batch "
     "in flight.")
 
+# concurrent query scheduler (runtime/scheduler.py)
+SCHED_MAX_CONCURRENT = _opt(
+    "auron.sched.max_concurrent", int, 4,
+    "Queries one scheduler (one Session / one AuronServer) runs "
+    "concurrently. Further admitted queries wait in the bounded run "
+    "queue (auron.sched.queue_depth); nested executes (host-fn "
+    "children, scalar subqueries) inherit the enclosing query's slot "
+    "and NEVER queue — queueing a child while its parent holds a slot "
+    "would deadlock the pair. Also the divisor of the automatic "
+    "per-query memory quota (auron.memmgr.query_quota_bytes = 0).")
+SCHED_QUEUE_DEPTH = _opt(
+    "auron.sched.queue_depth", int, 16,
+    "Bounded run-queue depth behind the concurrent slots: a query "
+    "arriving past max_concurrent running + queue_depth queued is "
+    "REJECTED fast with the classified errors.AdmissionRejected "
+    "(transient, retry_after_s hint) instead of waiting unboundedly — "
+    "the overload-shedding half of admission control. Queued queries "
+    "honor their deadline/cancel token WHILE queued (dequeued without "
+    "ever starting).")
+SCHED_ADMIT_QUEUE_WAIT_P99_S = _opt(
+    "auron.sched.admit.queue_wait_p99_s", float, 0.0,
+    "Admission threshold on the observed queue-wait p99 (the "
+    "auron_sched_queue_wait_seconds registry histogram): when queries "
+    "admitted in the last 30 s waited longer than this, new queries "
+    "are shed with AdmissionRejected(reason='queue_wait') even though "
+    "the queue still has room — latency-based backpressure ahead of "
+    "the hard depth bound. Age-windowed so one old burst cannot latch "
+    "the signal shut after the backlog drains. 0 (default) disables "
+    "the signal.")
+SCHED_ADMIT_MEM_RATIO = _opt(
+    "auron.sched.admit.mem_ratio", float, 0.0,
+    "Admission threshold on the memory manager's used/budget ratio: "
+    "past it new queries are shed with "
+    "AdmissionRejected(reason='memory') instead of being admitted into "
+    "a budget that is already spilling — rejecting at the door is "
+    "cheaper than shedding mid-flight with MemoryExhausted. Read from "
+    "the scheduler's attached MemManager at admission time — the "
+    "Session's mem_manager; a scheduler with NO manager attached "
+    "(Session() without one, the serving process) logs a one-time "
+    "warning and leaves the signal disarmed. 0 (default) disables "
+    "the signal.")
+
 # memory / spill
 MEMORY_FRACTION = _opt(
     "auron.memory.fraction", float, 0.6,
@@ -155,13 +197,16 @@ MEMMGR_PRESSURE_POLICY = _opt(
     "auron_memmgr_pressure_total{rung=...}.")
 MEMMGR_QUERY_QUOTA_BYTES = _opt(
     "auron.memmgr.query_quota_bytes", int, 0,
-    "Device-memory quota on one MemManager's consumers: exceeded AFTER "
-    "the spill loop and the degradation ladder ran, the requesting "
-    "query is shed with errors.MemoryExhausted — never the process. "
-    "Today a Session executes one query at a time, so the cap IS "
-    "per-query; the concurrent scheduler (ROADMAP [serving]) must give "
-    "each query its own manager (or per-query ledger) to keep that "
-    "property. 0 (default) disables the quota.")
+    "Device-memory quota on ONE query's registered consumers (the "
+    "manager keeps a per-query ledger — consumers are tagged with the "
+    "lifecycle plane's current query id at registration): exceeded "
+    "AFTER the spill loop and the degradation ladder ran, the "
+    "requesting query is shed with errors.MemoryExhausted — never the "
+    "process, never an innocent neighbor. 0 (default) = AUTO: "
+    "budget / auron.sched.max_concurrent while more than one query is "
+    "live on the manager (one query cannot starve the rest), no quota "
+    "while a single query runs (a solo query may use the whole "
+    "budget). Set negative to disable the quota entirely.")
 
 # NOTE: options are declared only once a use-site exists — an option in
 # CONFIG.md that nothing reads is a lie to the user. SMJ-fallback,
@@ -348,7 +393,8 @@ TRACE_DIR = _opt(
 TRACE_EVENTS = _opt(
     "auron.trace.events", str, "",
     "Comma-separated span-category allowlist (query, task, program, "
-    "shuffle, spill, fault, watchdog); empty records every category. "
+    "shuffle, spill, fault, watchdog, memory, sched); empty records "
+    "every category. "
     "Narrowing the list bounds tracing overhead on hot paths — e.g. "
     "'task,shuffle,fault' drops the per-hit program events.")
 TRACE_MAX_SPANS = _opt(

@@ -148,6 +148,29 @@ class MemoryExhausted(AuronError):
     transient = False
 
 
+class AdmissionRejected(AuronError):
+    """The query scheduler refused to ADMIT this query (run queue full,
+    queue-wait p99 past the admission threshold, memory used/budget
+    ratio past its threshold, or an injected ``sched.admit`` fault):
+    the query never started — no executor, no memmgr consumers, no
+    durable-tier artifacts exist for it. TRANSIENT by design: this is
+    load shedding, not failure — the same query resubmitted after
+    ``retry_after_s`` can succeed once the backlog drains. The retry
+    driver never sees it (admission happens before any task exists);
+    the hint is for the CALLER's backoff."""
+    transient = True
+
+    def __init__(self, *args, reason: Optional[str] = None,
+                 retry_after_s: Optional[float] = None,
+                 site: Optional[str] = None):
+        super().__init__(*args, site=site)
+        #: queue_full | queue_wait | memory | injected
+        self.reason = reason
+        #: caller backoff hint (seconds); estimated from the observed
+        #: queue-wait distribution when available
+        self.retry_after_s = retry_after_s
+
+
 # ---------------------------------------------------------------------------
 # transient classes — a clean re-execution can succeed
 # ---------------------------------------------------------------------------

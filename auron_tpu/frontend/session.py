@@ -9,6 +9,7 @@ transition, SURVEY.md §3.1)."""
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 from typing import Callable, Optional
 
@@ -21,6 +22,11 @@ from auron_tpu.frontend.dataframe import DataFrame
 from auron_tpu.ir import pb, plan_from_bytes
 from auron_tpu.ir.planner import PhysicalPlanner, PlannerContext
 from auron_tpu.runtime.executor import collect as _collect
+
+#: process-wide query-id sequence: ids key process-global ledgers (the
+#: program cache's per-query attribution, the memmgr query ledger), so
+#: two Sessions must never mint the same id
+_QUERY_SEQ = itertools.count(1)
 
 
 class Session:
@@ -39,6 +45,15 @@ class Session:
         self.ctx = PlannerContext(batch_capacity=batch_capacity,
                                   config=self.config)
         self.mem_manager = mem_manager
+        if mem_manager is not None \
+                and getattr(mem_manager, "config", None) is None:
+            # bind the session config as the manager's knob source so
+            # the auto per-query quota divisor and the scheduler's
+            # admission clamp read the SAME auron.sched.max_concurrent
+            # (first binding wins for a shared manager)
+            mem_manager.config = self.config
+            if hasattr(mem_manager, "_quota_cache"):
+                mem_manager._quota_cache = (-1, 0, 1)
         self._ids = itertools.count()
         #: host-fallback registrations: rid -> (child DataFrame, fn)
         self._host_fns: dict[str, tuple[DataFrame, Callable]] = {}
@@ -48,12 +63,21 @@ class Session:
         import threading
         self._queries_lock = threading.Lock()
         self._active_queries: dict[str, object] = {}
-        self._query_ids = itertools.count(1)
         self._closed = False
         #: thread-local current token: nested executes (host-fn
         #: children, scalar subqueries) join the ENCLOSING query's
         #: lifecycle — one cancel/deadline covers the whole tree
         self._tls = threading.local()
+        #: the concurrent-query control plane (runtime/scheduler.py):
+        #: every top-level execute is admitted through it — bounded run
+        #: queue, weighted-round-robin task fairness, overload shedding
+        #: with the classified errors.AdmissionRejected. Nested executes
+        #: inherit the enclosing query's slot and NEVER queue (queueing
+        #: a child behind its slot-holding parent would deadlock both).
+        from auron_tpu.runtime.scheduler import QueryScheduler
+        self._scheduler = QueryScheduler(name="session",
+                                         mem_manager=mem_manager,
+                                         config=self.config)
 
     def _bind_xla_cache(self) -> None:
         """Bind jax's persistent compilation cache to
@@ -175,7 +199,7 @@ class Session:
         if timeout_s is None:
             default = float(self.config.get(cfg.QUERY_DEADLINE_S))
             timeout_s = default if default > 0 else None
-        qid = f"q{next(self._query_ids)}"
+        qid = f"q{next(_QUERY_SEQ)}"
         token = CancelToken(query_id=qid, deadline_s=timeout_s)
         with self._queries_lock:
             self._active_queries[qid] = token
@@ -184,6 +208,47 @@ class Session:
     def _end_query(self, token) -> None:
         with self._queries_lock:
             self._active_queries.pop(token.query_id, None)
+        # drop the query's program-cache attribution ledger (bounded
+        # memory; explain_analyze reads it BEFORE ending the query)
+        from auron_tpu.runtime import programs
+        programs.pop_query(token.query_id)
+
+    @contextlib.contextmanager
+    def _admitted_query(self, timeout_s: Optional[float]):
+        """One top-level query's full admission choreography as a
+        context manager: begin (token + registry entry) → scheduler
+        acquire (admission control; the token's slot rides it) →
+        lifecycle/thread-local binding; unwound in exact reverse on
+        exit. execute() and explain_analyze() share this so the
+        teardown ordering can never desynchronize between them."""
+        from auron_tpu import errors
+        from auron_tpu.runtime import lifecycle
+        token = self._begin_query(timeout_s)
+        # admission BEFORE any planning/execution work: a shed query
+        # costs nothing (AdmissionRejected / the token's own classified
+        # error when cancelled while queued)
+        try:
+            slot = self._scheduler.acquire(token)
+        except errors.QueryCancelled:
+            # queue-phase cancels feed the same cancel-latency
+            # histogram as mid-execution ones (every cancel class
+            # counts toward the acceptance-gate metric)
+            lifecycle.observe_unwind(token, kind=token.reason or "cancel")
+            self._end_query(token)
+            raise
+        except BaseException:
+            self._end_query(token)
+            raise
+        token.slot = slot
+        self._tls.token = token
+        prev_bind = lifecycle.bind_token(token)
+        try:
+            yield token
+        finally:
+            self._tls.token = None
+            lifecycle.bind_token(prev_bind)
+            slot.release()
+            self._end_query(token)
 
     def cancel(self, query_id: str) -> bool:
         """Cancel a running query by id (thread-safe; the API face of
@@ -203,17 +268,25 @@ class Session:
             return dict(self._active_queries)
 
     def close(self) -> None:
-        """End the session: cancel every live query and sweep the spill
+        """End the session: drain the scheduler DETERMINISTICALLY —
+        queued queries are cancelled first (reason "session-closed";
+        their waiting acquires dequeue without ever starting, so no
+        executor or consumer/spill ledger entry is ever created for
+        them), then the running tokens — and finally sweep the spill
         tier's orphaned files (the commit-time ``.part`` sweep's
         equivalent for per-attempt spill artifacts — a crashed or
         cancelled attempt must not leak storage past the session)."""
         if self._closed:
             return
         self._closed = True
+        # queued-first through the scheduler's drain order...
+        self._scheduler.drain("session-closed")
+        # ...then any token the scheduler has not seen yet (admission
+        # raced close): cancel idempotently, first reason wins
         with self._queries_lock:
             tokens = list(self._active_queries.values())
         for t in tokens:
-            t.cancel()
+            t.cancel("session-closed")
         # cancellation is COOPERATIVE: wait (bounded) for the driver
         # threads to unwind and unregister before sweeping, or the
         # sweep would unlink spill files a still-running task is about
@@ -243,7 +316,9 @@ class Session:
         from auron_tpu.obs import trace
         # nested execute (a host-fn child or scalar subquery driven from
         # inside an enclosing query): join the enclosing lifecycle — the
-        # outer token's cancel/deadline covers the whole tree
+        # outer token's cancel/deadline covers the whole tree, and the
+        # enclosing query's scheduler SLOT travels with the token (a
+        # nested query must never queue behind its own parent)
         enclosing = getattr(self._tls, "token", None)
         if enclosing is not None:
             with trace.query_scope(label=f"p{df.num_partitions}"):
@@ -252,31 +327,48 @@ class Session:
                                 mem_manager=self.mem_manager,
                                 config=self.config,
                                 cancel_token=enclosing)
-        token = self._begin_query(timeout_s)
-        self._tls.token = token
         # one trace per TOP-LEVEL query: nested executes (host-fn
         # children, scalar subqueries) join the enclosing trace, and the
         # outermost scope exports into auron.trace.dir when set
-        try:
+        with self._admitted_query(timeout_s) as token:
             with trace.query_scope(label=f"p{df.num_partitions}"):
                 op = self.plan_physical(df)
                 return _collect(op, num_partitions=df.num_partitions,
                                 mem_manager=self.mem_manager,
                                 config=self.config, cancel_token=token)
-        finally:
-            self._tls.token = None
-            self._end_query(token)
 
     def explain_analyze(self, df: DataFrame) -> str:
         """EXPLAIN ANALYZE: run the plan with a positional metric tree
         mirrored at every task finalize (obs/metric_tree — the
         update_metric_node walk of the reference, rt.rs:302-308) and
-        render the annotated plan."""
+        render the annotated plan, followed by the query's program-cache
+        footer (per-QUERY builds/hits — under the concurrent scheduler
+        the central cache is shared across queries, so the hit rate a
+        query actually enjoyed is its ledger's, not the process's)."""
         from auron_tpu.obs import metric_tree as mt
         from auron_tpu.obs import trace
-        with trace.query_scope(label="explain_analyze"):
-            op = self.plan_physical(df)
-            tree, _table = mt.explain_analyze(
-                op, num_partitions=df.num_partitions,
-                mem_manager=self.mem_manager, config=self.config)
-        return mt.render(tree)
+        from auron_tpu.runtime import programs
+
+        def analyzed(token) -> str:
+            with trace.query_scope(label="explain_analyze"):
+                op = self.plan_physical(df)
+                tree, _table = mt.explain_analyze(
+                    op, num_partitions=df.num_partitions,
+                    mem_manager=self.mem_manager, config=self.config,
+                    cancel_token=token)
+            snap = programs.query_totals(token.query_id)
+            total = snap.builds + snap.hits
+            footer = (f"[program cache] builds={snap.builds} "
+                      f"hits={snap.hits} hit_rate="
+                      f"{(snap.hits / total * 100.0) if total else 0.0:.1f}%"
+                      f" (query {token.query_id})\n")
+            return mt.render(tree) + footer
+
+        # nested (a host fn analyzing mid-query): inherit the enclosing
+        # token and slot exactly like execute() — acquiring here would
+        # queue this analysis behind its own slot-holding parent
+        enclosing = getattr(self._tls, "token", None)
+        if enclosing is not None:
+            return analyzed(enclosing)
+        with self._admitted_query(None) as token:
+            return analyzed(token)

@@ -66,7 +66,7 @@ class ChaosOutcome:
 #: actions)
 RECOVERY_SPAN_NAMES = ("task.retry", "shuffle.corruption_recompute",
                        "watchdog.fallback", "watchdog.stall",
-                       "memmgr.shed")
+                       "memmgr.shed", "sched.reject")
 
 
 #: which injection KINDS can cause each recovery span — the corrupt
@@ -82,6 +82,8 @@ _RECOVERY_CAUSE_KINDS = {
     "watchdog.stall": ("hang",),
     # the pressure ladder sheds on injected denies
     "memmgr.shed": ("deny",),
+    # admission control sheds at the door on injected denies
+    "sched.reject": ("deny",),
 }
 
 
@@ -337,11 +339,126 @@ def lifecycle_pipeline(workdir: str) -> Scenario:
     return sc
 
 
+def overload(workdir: str) -> Scenario:
+    """Concurrency chaos: THREE identical Session-planned aggregations
+    race through ONE Session whose scheduler is clamped tight
+    (max_concurrent=1, queue_depth=1) over a small memory budget under
+    the 'shed' pressure policy — the 2x-overload posture. Gives the
+    admission/arbitration sites deterministic traffic: ``sched.admit``
+    denies shed queries at the door (→ AdmissionRejected, transient),
+    ``memmgr.deny`` forces the pressure ladder mid-flight (→
+    MemoryExhausted). The contract: every per-query outcome is a table
+    bit-identical to the fault-free result OR a classified AuronError —
+    never an unclassified crash, never divergent successful results,
+    never a leaked consumer/spill file. One query runs on the CALLING
+    thread so its admission/shed spans land inside the chaos trace and
+    correlate."""
+    import threading
+
+    from auron_tpu.frontend.dataframe import col, functions as F
+    from auron_tpu.frontend.session import Session
+    from auron_tpu.memmgr.manager import MemManager
+    from auron_tpu.memmgr.spill import SpillManager
+
+    spill_dir = os.path.join(workdir, "spill")
+    os.makedirs(spill_dir, exist_ok=True)
+    table = pa.Table.from_batches([_rows(768, seed=47 + i)
+                                   for i in range(4)])
+    last: dict = {}
+
+    _KNOBS = {cfg.SCHED_MAX_CONCURRENT: 1,
+              cfg.SCHED_QUEUE_DEPTH: 1,
+              cfg.MEMMGR_PRESSURE_POLICY: "shed"}
+
+    def run() -> pa.Table:
+        conf = cfg.get_config()
+        _missing = object()
+        saved = {k: conf._overrides.get(k, _missing) for k in _KNOBS}
+        for k, v in _KNOBS.items():
+            conf.set(k, v)
+        mm = MemManager(
+            total_bytes=1 << 22, min_trigger=0,
+            spill_manager=SpillManager(host_budget_bytes=1,
+                                       spill_dir=spill_dir))
+        last["mm"] = mm
+        s = Session(mem_manager=mm)
+
+        def query() -> pa.Table:
+            df = (s.from_arrow(table)
+                  .sort("k")
+                  .group_by("k")
+                  .agg(F.sum(col("v")).alias("sv"),
+                       F.count(col("c")).alias("n")))
+            return _canonical(s.execute(df))
+
+        outcomes: list = [None, None, None]
+
+        def worker(i: int) -> None:
+            try:
+                outcomes[i] = ("ok", query())
+            except BaseException as e:   # noqa: BLE001 — audited below
+                outcomes[i] = ("err", e)
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in (1, 2)]
+        try:
+            for t in threads:
+                t.start()
+            # slot 0 runs on the CALLING thread: its scheduler/memmgr
+            # events join the chaos trace scope for correlation
+            worker(0)
+            for t in threads:
+                t.join(timeout=60)
+                if t.is_alive():
+                    raise RuntimeError("overload worker wedged")
+        finally:
+            s.close()
+            for k, prev in saved.items():
+                if prev is _missing:
+                    conf.unset(k)
+                else:
+                    conf.set(k, prev)
+
+        tables = [o[1] for o in outcomes if o and o[0] == "ok"]
+        failures = [o[1] for o in outcomes if o and o[0] == "err"]
+        for e in failures:
+            if not isinstance(e, errors.AuronError):
+                raise e     # unclassified: the contract's failure bucket
+        for t in tables[1:]:
+            if not t.equals(tables[0]):
+                raise AssertionError(
+                    "concurrent overload queries diverged: identical "
+                    "queries produced different tables")
+        if not tables:
+            raise failures[0]   # everything shed: classified, auditable
+        return tables[0]
+
+    sc = Scenario("overload", run,
+                  [os.path.join(spill_dir, "auron-spill-*")])
+
+    def extra_audit() -> list[str]:
+        mm = last.get("mm")
+        if mm is None:
+            return []
+        gc.collect()
+        found = [f"memmgr-consumer:{name}"
+                 for name in mm.status()["consumers"]]
+        live = mm.spill_manager.live_disk_files() \
+            if mm.spill_manager is not None else 0
+        if live:
+            found.append(f"tracked-spill-files:{live}")
+        return found
+
+    sc.extra_audit = extra_audit
+    return sc
+
+
 SCENARIOS: dict[str, Callable[[str], Scenario]] = {
     "rss_pipeline": rss_pipeline,
     "spill_sort": spill_sort,
     "agg_pipeline": agg_pipeline,
     "lifecycle_pipeline": lifecycle_pipeline,
+    "overload": overload,
 }
 
 

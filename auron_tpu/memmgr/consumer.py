@@ -21,6 +21,11 @@ class BufferedSpillConsumer:
     Subclasses override ``_write_run`` to control the run format (e.g. the
     sort consumer sorts the buffer and attaches order words)."""
 
+    #: buffer claims happen under self._lock and runs serialize outside
+    #: it, so a FOREIGN thread (a neighbor query's pressure walk under
+    #: the concurrent scheduler) may safely invoke spill()/shrink()
+    spill_thread_safe = True
+
     def __init__(self, name: str, mem, metrics, conf,
                  frame_rows: Optional[int] = None):
         from auron_tpu import config as cfg

@@ -2,12 +2,20 @@
 
 Mirrors the reference's design (reference: auron-memmgr/src/lib.rs:303-423):
 one manager per process, consumers update their usage after each growth
-step, the manager answers Nothing or Spill based on the consumer's fair
-share ``total / num_spillable_consumers`` and a global watermark. The
-reference's Wait arm (condvar, 10 s) exists because many tasks share one
-pool concurrently; the host driver here executes partitions cooperatively,
-so over-budget resolves by spilling the requester (the biggest consumer is
-asked first when the requester is under fair share).
+step, the manager answers Nothing or Spill based on fair share and a
+global watermark. The reference's Wait arm (condvar, 10 s) exists because
+many tasks share one pool concurrently; over-budget here resolves by
+spilling the requester first when it holds at least its share (the
+biggest consumer otherwise).
+
+Concurrent-query fairness (the [serving] scheduler plane): every
+consumer is tagged at registration with the query that created it (the
+lifecycle plane's thread-local token), giving the manager a per-query
+ledger. ``fair_share()`` divides the budget over LIVE QUERIES, not
+consumers; the per-query quota (``auron.memmgr.query_quota_bytes``,
+auto = budget / auron.sched.max_concurrent under concurrency) is
+enforced against the requester's OWN ledger — and a quota breach spills
+or sheds that query, never an innocent neighbor.
 """
 
 from __future__ import annotations
@@ -28,9 +36,6 @@ import weakref as _weakref
 
 _MANAGERS: "_weakref.WeakSet" = _weakref.WeakSet()
 
-#: (config epoch, quota bytes) — see MemManager._query_quota
-_QUOTA_CACHE: tuple = (-1, 0)
-
 
 def live_consumer_count() -> int:
     """Registered consumers across every live MemManager (after a gc, a
@@ -49,6 +54,17 @@ class MemConsumer:
 
     #: display name for the status dump
     consumer_name: str = "consumer"
+
+    #: may ``spill()`` be invoked from a thread OTHER than the one that
+    #: registered (drives) this consumer? Under the concurrent runtime
+    #: pressure can originate on any thread — a neighbor query's
+    #: driver, this query's own prefetch worker — and pick any consumer
+    #: as victim, but only consumers with internal locking
+    #: (BufferedSpillConsumer's claim-under-lock protocol) survive a
+    #: foreign-thread spill; the rest are spilled only from their OWN
+    #: driving thread (victim pools filter on thread identity — the
+    #: cross-query safety audit's finding)
+    spill_thread_safe: bool = False
 
     def mem_used(self) -> int:
         raise NotImplementedError
@@ -69,12 +85,22 @@ class MemConsumer:
 class MemManager:
     def __init__(self, total_bytes: Optional[int] = None,
                  min_trigger: int = MIN_TRIGGER_SIZE,
-                 spill_manager: Optional["object"] = None):
+                 spill_manager: Optional["object"] = None,
+                 config=None):
         if total_bytes is None:
             total_bytes = self.default_budget()
         self.total = total_bytes
         self.min_trigger = min_trigger
         self.spill_manager = spill_manager
+        #: knob source for the auto per-query quota divisor
+        #: (auron.sched.max_concurrent): the owning Session binds its
+        #: own config here so the quota divisor and the scheduler's
+        #: admission clamp cannot desynchronize under per-Session
+        #: overrides; None = process config
+        self.config = config
+        #: (config epoch, quota knob, max_concurrent) memo — per
+        #: manager because the knob source is
+        self._quota_cache: tuple = (-1, 0, 1)
         self._lock = threading.Lock()
         # weak keys: a consumer whose operator was dropped without an
         # explicit unregister (e.g. a memoized exchange buffer released
@@ -82,6 +108,19 @@ class MemManager:
         # in the manager for the process lifetime
         import weakref
         self._used: "weakref.WeakKeyDictionary[MemConsumer, int]" = \
+            weakref.WeakKeyDictionary()
+        #: per-query ledger: consumer → owning query id (tagged at
+        #: registration from the lifecycle plane's thread-local token;
+        #: "" is the anonymous bucket of direct collect() calls). The
+        #: concurrent scheduler's fairness — per-query fair_share, the
+        #: quota breach check, the over-quota-first force-spill — reads
+        #: usage grouped by this tag.
+        self._query_of: "weakref.WeakKeyDictionary[MemConsumer, str]" = \
+            weakref.WeakKeyDictionary()
+        #: consumer → registering (driving) thread id: the safety key
+        #: for victim selection — spill() on a non-thread-safe consumer
+        #: is only sound from the thread that drives it
+        self._thread_of: "weakref.WeakKeyDictionary[MemConsumer, int]" = \
             weakref.WeakKeyDictionary()
         self.num_spills = 0
         self.spilled_bytes = 0
@@ -115,12 +154,28 @@ class MemManager:
     # -- registration -------------------------------------------------------
 
     def register_consumer(self, c: MemConsumer) -> None:
+        from auron_tpu.runtime import lifecycle
+        qid = lifecycle.current_query_id()
         with self._lock:
             self._used.setdefault(c, 0)
+            self._query_of[c] = qid
+            self._thread_of[c] = threading.get_ident()
 
     def unregister_consumer(self, c: MemConsumer) -> None:
         with self._lock:
             self._used.pop(c, None)
+            self._query_of.pop(c, None)
+            self._thread_of.pop(c, None)
+
+    def _spill_eligible_locked(self, v: MemConsumer) -> bool:
+        """May the CURRENT thread invoke ``v.spill()``? Yes when it is
+        v's own driving (registering) thread, or when v advertises an
+        internally-locked spill (``spill_thread_safe``). Query tags do
+        NOT make a victim safe — this query's prefetch worker racing
+        this query's agg consumer is just as unsynchronized as a
+        neighbor's driver. Caller holds ``self._lock``."""
+        return (self._thread_of.get(v) == threading.get_ident()
+                or getattr(v, "spill_thread_safe", False))
 
     # -- accounting ---------------------------------------------------------
 
@@ -129,10 +184,39 @@ class MemManager:
         with self._lock:
             return sum(self._used.values())
 
+    def _usage_by_query_locked(self) -> dict:
+        """{query tag: accounted bytes} over registered consumers — the
+        ONE definition every per-query view (live count, quota check,
+        force-spill pool, status) derives from; "" is the anonymous tag
+        of direct collect() calls. Caller holds ``self._lock``."""
+        out: dict[str, int] = {}
+        for c, u in self._used.items():
+            tag = self._query_of.get(c, "")
+            out[tag] = out.get(tag, 0) + u
+        return out
+
+    def _live_queries_locked(self) -> set:
+        """Distinct query tags across registered consumers; the
+        anonymous "" tag counts as one query (direct collect() calls).
+        Caller holds ``self._lock``."""
+        return set(self._usage_by_query_locked())
+
     def fair_share(self) -> int:
+        """Budget divided over LIVE QUERIES (not consumers): the
+        concurrent runtime's fairness unit — a query spawning many
+        consumers must not multiply its claim on the budget. With one
+        query live (the solo path) this is the whole budget."""
         with self._lock:
-            n = max(len(self._used), 1)
+            n = max(len(self._live_queries_locked()), 1)
         return self.total // n
+
+    def query_used(self, qid: str) -> int:
+        """Bytes accounted to ``qid``'s registered consumers."""
+        with self._lock:
+            return self._query_used_locked(qid)
+
+    def _query_used_locked(self, qid: str) -> int:
+        return self._usage_by_query_locked().get(qid, 0)
 
     def update_mem_used(self, c: MemConsumer, used: int) -> str:
         """Record ``c``'s usage; returns 'nothing' or 'spilled'. May invoke
@@ -151,24 +235,32 @@ class MemManager:
         observe = self._registry_enabled()
         with self._lock:
             self._used[c] = used
-            total_used = sum(self._used.values())
-            # grant-path telemetry snapshot under the SAME lock the
-            # accounting already holds — no second acquisition, and the
-            # consumer copy only happens when the registry will see it
+            qid = self._query_of.get(c, "")
+            # ONE per-query walk serves every grant-path read (total,
+            # the requester's query usage, live-query count) — the hot
+            # path stays a single O(consumers) pass under the lock the
+            # accounting already holds
+            by_query = self._usage_by_query_locked()
+            total_used = sum(by_query.values())
+            q_used = by_query.get(qid, 0)
+            n_live = len(by_query)
+            # grant-path telemetry snapshot under the SAME lock — no
+            # second acquisition, and the consumer copy only happens
+            # when the registry will see it
             status = self._status_locked() if observe else None
 
         # the memmgr.deny chaos site: pretend the budget is exhausted so
         # the degradation ladder gets deterministic traffic
         forced = faults.fires("memmgr.deny", "deny")
-        quota = self._query_quota()
-        budget = min(self.total, quota) if quota else self.total
-        if total_used <= budget and not forced:
+        quota = self._query_quota(live=n_live)
+        over_quota = bool(quota) and q_used > quota
+        if total_used <= self.total and not over_quota and not forced:
             if self._shrink_level:
                 # decay the shrink advice once pressure has demonstrably
                 # subsided (16 consecutive grants under HALF budget) —
                 # one pressure episode must not pin 8x-smaller scan
                 # batches for the manager's lifetime
-                if total_used <= budget // 2:
+                if total_used <= self.total // 2:
                     self._comfort_grants += 1
                     if self._comfort_grants >= 16:
                         self._shrink_level -= 1
@@ -191,18 +283,39 @@ class MemManager:
         tried: set = set()
         while not exhausted:
             with self._lock:
-                total_used = sum(self._used.values())
-                share = self.total // max(len(self._used), 1)
+                by_query = self._usage_by_query_locked()
+                total_used = sum(by_query.values())
+                q_used = by_query.get(qid, 0)
+                q_consumers = [v for v in self._used
+                               if self._query_of.get(v, "") == qid]
+                n_queries = max(len(by_query), 1)
                 c_used = self._used.get(c, 0)
-            if total_used <= budget:
+            over_budget = total_used > self.total
+            over_quota = bool(quota) and q_used > quota
+            if not over_budget and not over_quota:
                 break
+            # requester-first when it holds at least its slice of its
+            # query's fair share (per-query share split over the query's
+            # consumers — reduces to total // num_consumers when one
+            # query is live, the legacy heuristic)
+            share = self.total // n_queries // max(len(q_consumers), 1)
             if (c not in tried and c_used >= max(share, 1)
                     and c_used >= self.min_trigger):
                 victim = c
             else:
                 with self._lock:
-                    candidates = [(u, v) for v, u in self._used.items()
-                                  if u >= self.min_trigger and v not in tried]
+                    # a quota-only breach spills the OVER-QUOTA query's
+                    # own consumers — a neighbor must not pay for this
+                    # query's appetite; a global over-budget considers
+                    # every consumer. Either way the victim must be
+                    # spill-safe FROM THIS THREAD (its own driving
+                    # thread, or an internally locked spill)
+                    pool = (q_consumers if over_quota and not over_budget
+                            else list(self._used))
+                    candidates = [(self._used.get(v, 0), v) for v in pool
+                                  if self._spill_eligible_locked(v)
+                                  and self._used.get(v, 0)
+                                  >= self.min_trigger and v not in tried]
                 if not candidates:
                     exhausted = True
                     break
@@ -228,7 +341,7 @@ class MemManager:
         if exhausted:
             # the spill loop ran dry still over budget — the old hard
             # "deny": now a policy (auron.memmgr.pressure_policy)
-            if self._pressure_ladder(c, budget, forced=forced):
+            if self._pressure_ladder(c, qid, quota, forced=forced):
                 spilled_any = True
         if self._registry_enabled():
             self._observe(self.status())
@@ -236,26 +349,40 @@ class MemManager:
 
     # -- memory-pressure degradation ladder (PR 8) --------------------------
 
-    def _query_quota(self) -> int:
-        """auron.memmgr.query_quota_bytes resolved from the process
-        config (0 = no quota), cached against the config epoch —
-        update_mem_used runs per batch-add, so the common no-quota path
-        must cost one int compare. Scope honesty: the quota caps THIS
-        MANAGER's total — today a Session runs one query at a time, so
-        that is the query's footprint; the concurrent scheduler
-        (ROADMAP [serving]) must give each query its own manager (or a
-        per-query ledger) for the cap to stay per-query."""
-        global _QUOTA_CACHE
+    def _query_quota(self, live: Optional[int] = None) -> int:
+        """Effective per-query quota (0 = none). The knob values are
+        cached against the config epoch — update_mem_used runs per
+        batch-add, so the common path costs one int compare plus
+        arithmetic; the live-query count rides in from the accounting
+        lock the caller already held (``live``), so no second lock
+        acquisition happens on the hot path. An explicit positive
+        ``auron.memmgr.query_quota_bytes`` wins; the default 0 is AUTO
+        — budget / auron.sched.max_concurrent once MORE than one query
+        is live on this manager (the per-query ledger makes the cap
+        genuinely per-query), no quota while a single query runs;
+        negative disables entirely. Knobs resolve from ``self.config``
+        (the owning Session's — bound at Session init so the quota
+        divisor and the scheduler's admission clamp read the SAME
+        max_concurrent) falling back to the process config."""
         from auron_tpu import config as cfg
-        epoch, val = _QUOTA_CACHE
-        if epoch == cfg.config_epoch():
-            return val
-        try:
-            val = int(cfg.get_config().get(cfg.MEMMGR_QUERY_QUOTA_BYTES))
-        except Exception:   # pragma: no cover - config always resolvable
-            val = 0
-        _QUOTA_CACHE = (cfg.config_epoch(), val)
-        return val
+        epoch, knob, maxc = self._quota_cache
+        if epoch != cfg.config_epoch():
+            try:
+                conf = (self.config if self.config is not None
+                        else cfg.get_config())
+                knob = int(conf.get(cfg.MEMMGR_QUERY_QUOTA_BYTES))
+                maxc = max(int(conf.get(cfg.SCHED_MAX_CONCURRENT)), 1)
+            except Exception:   # pragma: no cover - config resolvable
+                knob, maxc = 0, 1
+            self._quota_cache = (cfg.config_epoch(), knob, maxc)
+        if knob > 0:
+            return knob
+        if knob < 0:
+            return 0
+        if live is None:
+            with self._lock:
+                live = len(self._live_queries_locked())
+        return self.total // maxc if live > 1 else 0
 
     def advised_batch_rows(self, base: int) -> int:
         """Pressure-adapted scan granularity: every shrink rung taken
@@ -279,19 +406,21 @@ class MemManager:
             except Exception:   # pragma: no cover - telemetry best-effort
                 pass
 
-    def _pressure_ladder(self, c: MemConsumer, budget: int,
+    def _pressure_ladder(self, c: MemConsumer, qid: str, quota: int,
                          forced: bool = False) -> bool:
         """Walk the degradation rungs after the spill loop ran dry still
         over budget: (1) **shrink** — bump the advised-batch-rows hint
         and ask the REQUESTER to shrink (partial release, cheaper than a
-        full spill); (2) **force-spill** — spill the largest consumer
-        ignoring ``min_trigger`` (small consumers add up); (3) **shed**
-        — fail THIS query with the classified ``errors.MemoryExhausted``
-        (policy 'shed', or any per-query quota breach), never the
-        process — or, under the default 'degrade' policy, record a
-        survivable deny. Returns True when any rung freed bytes.
-        ``forced`` (the memmgr.deny chaos site) treats every rung as
-        over budget so the whole ladder gets traffic."""
+        full spill); (2) **force-spill** — spill the largest consumer of
+        the OVER-QUOTA query first (the query over its ledger pays for
+        its own pressure before any neighbor), min_trigger waived; (3)
+        **shed** — fail THIS query with the classified
+        ``errors.MemoryExhausted`` (policy 'shed', or the requester's
+        per-query quota breached), never the process — or, under the
+        default 'degrade' policy, record a survivable deny. Returns True
+        when any rung freed bytes. ``forced`` (the memmgr.deny chaos
+        site) treats every rung as over budget so the whole ladder gets
+        traffic."""
         from auron_tpu import config as cfg
         from auron_tpu.obs import trace
         policy = cfg.get_config().get(cfg.MEMMGR_PRESSURE_POLICY)
@@ -300,7 +429,10 @@ class MemManager:
         def over() -> tuple[bool, int]:
             with self._lock:
                 total_used = sum(self._used.values())
-            return (forced or total_used > budget), total_used
+                q_used = self._query_used_locked(qid)
+            breach = total_used > self.total \
+                or (bool(quota) and q_used > quota)
+            return (forced or breach), total_used
 
         if policy == "legacy":
             _o, total_used = over()
@@ -334,12 +466,31 @@ class MemManager:
                         advised_shift=self._shrink_level)
 
         # rung 2: force-spill the largest holder, min_trigger waived —
-        # under real pressure many small consumers add up to the budget
+        # under real pressure many small consumers add up to the budget.
+        # Victim pool: consumers of OVER-QUOTA queries first (the query
+        # over its per-query ledger pays before any neighbor), every
+        # consumer when no query is over quota
         is_over, total_used = over()
         if is_over:
             with self._lock:
-                candidates = [(u, v) for v, u in self._used.items()
-                              if u > 0]
+                per_query = self._usage_by_query_locked()
+                over_q = {q for q, u in per_query.items()
+                          if quota and u > quota}
+                # over-quota queries' consumers first; fall back to ALL
+                # eligible consumers only when the GLOBAL budget is
+                # breached (or the chaos deny forces the rung) — on a
+                # quota-only breach spilling an innocent neighbor could
+                # not lower the offender's ledger anyway ('never an
+                # innocent neighbor'), so an empty offender pool lets
+                # rung 3 decide instead
+                pool = [(u, v) for v, u in self._used.items()
+                        if self._query_of.get(v, "") in over_q
+                        and self._spill_eligible_locked(v) and u > 0]
+                if not pool and (forced
+                                 or sum(per_query.values()) > self.total):
+                    pool = [(u, v) for v, u in self._used.items()
+                            if u > 0 and self._spill_eligible_locked(v)]
+                candidates = pool
             freed = 0
             if candidates:
                 _, victim = max(candidates, key=lambda t: t[0])
@@ -365,18 +516,21 @@ class MemManager:
         # rung 3: shed or survivable deny
         is_over, total_used = over()
         if is_over:
-            quota = self._query_quota()
-            if policy == "shed" or (quota and total_used > quota):
+            with self._lock:
+                q_used = self._query_used_locked(qid)
+            if policy == "shed" or (quota and q_used > quota):
                 self._count_rung("shed")
                 trace.event("memory", "memmgr.shed", consumer=cname,
+                            query=qid, query_used=q_used,
                             total_used=total_used, budget=self.total,
                             quota=quota)
                 from auron_tpu import errors
                 raise errors.MemoryExhausted(
                     f"memory pressure unresolved after the degradation "
                     f"ladder: {total_used} bytes used against budget "
-                    f"{self.total}" + (f" (query quota {quota})"
-                                       if quota else "")
+                    f"{self.total}"
+                    + (f" (query {qid or '<anon>'} used {q_used} against "
+                       f"quota {quota})" if quota else "")
                     + f"; shedding the query (requester {cname})",
                     site="memmgr.deny")
             self._count_rung("deny")
@@ -410,14 +564,19 @@ class MemManager:
 
     def _status_locked(self) -> dict:
         """Status snapshot; caller holds ``self._lock``."""
-        n = max(len(self._used), 1)
+        queries = {tag or "<anon>": u
+                   for tag, u in self._usage_by_query_locked().items()}
+        n = max(len(queries), 1)
         return {
             "total": self.total,
             "used": sum(self._used.values()),
             "num_consumers": len(self._used),
+            "num_queries": len(queries),
+            # per LIVE QUERY, the concurrent runtime's fairness unit
             "fair_share": self.total // n,
             "num_spills": self.num_spills,
             "spilled_bytes": self.spilled_bytes,
             "consumers": {getattr(c, "consumer_name", "?"): u
                           for c, u in self._used.items()},
+            "queries": queries,
         }

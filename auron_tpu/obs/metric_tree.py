@@ -118,13 +118,17 @@ def totals(node: MetricNode) -> dict:
 
 
 def explain_analyze(plan, num_partitions: int = 1, mem_manager=None,
-                    config=None) -> tuple[MetricNode, "object"]:
+                    config=None, cancel_token=None
+                    ) -> tuple[MetricNode, "object"]:
     """Run every partition of ``plan`` with a mirrored metric tree and
     return (tree, collected pyarrow table) — the engine of
-    DataFrame.explain(analyze=True) and tools/explain_report.py."""
+    DataFrame.explain(analyze=True) and tools/explain_report.py.
+    ``cancel_token`` threads the query's lifecycle/scheduler identity
+    through, so an analyzed run is admitted, cancellable and
+    attributed exactly like a normal one."""
     from auron_tpu.runtime.executor import collect
     tree = build_tree(plan)
     table = collect(plan, num_partitions=num_partitions,
                     mem_manager=mem_manager, config=config,
-                    metric_tree=tree)
+                    metric_tree=tree, cancel_token=cancel_token)
     return tree, table

@@ -362,6 +362,14 @@ def timed_get(values):
 # per-task export + aggregate views
 # ---------------------------------------------------------------------------
 
+def _lifecycle_query_id() -> str:
+    try:
+        from auron_tpu.runtime import lifecycle
+        return lifecycle.current_query_id()
+    except Exception:   # pragma: no cover - best-effort attribution
+        return ""
+
+
 def export_task(ctx, plan) -> None:
     """Append one JSONL record per operator instance of a finished task
     into ``auron.trace.dir`` (``profile_<trace>.jsonl``) — the
@@ -386,6 +394,10 @@ def export_task(ctx, plan) -> None:
             lines.append(json.dumps({
                 "task": ctx.task_id, "stage": ctx.stage_id,
                 "partition": ctx.partition_id,
+                # concurrent queries with tracing off share trace id 0
+                # (one jsonl file): the query id keeps their records
+                # attributable (cross-query safety audit)
+                "query": _lifecycle_query_id(),
                 "op": op.name + suffix, "repr": repr(op),
                 "metrics": snap}))
         if lines:

@@ -271,6 +271,22 @@ def _collect_runtime() -> list[str]:
         lines.append(f"auron_trace_dropped_spans {trace.tracer().dropped}")
     except Exception:
         pass
+    try:
+        # scheduler occupancy collected LIVE and summed by name across
+        # every scheduler in the process: several Sessions share the
+        # "session" name, and per-change gauge sets from each would
+        # overwrite one another last-writer-wins
+        from auron_tpu.runtime import scheduler
+        states = scheduler.aggregate_states()
+        if states:
+            lines.append("# TYPE auron_sched_running gauge")
+            lines.append("# TYPE auron_sched_queued gauge")
+            for name, st in sorted(states.items()):
+                lab = f'{{scheduler="{name}"}}'
+                lines.append(f"auron_sched_running{lab} {st['running']}")
+                lines.append(f"auron_sched_queued{lab} {st['queued']}")
+    except Exception:
+        pass
     return lines
 
 

@@ -71,11 +71,26 @@ class ExecutionRuntime:
         except Exception:
             self._compile_start = None
         # per-task program-cache attribution (central registry,
-        # runtime/programs.py): builds vs hits across every compile site
+        # runtime/programs.py): builds vs hits across every compile
+        # site. Under the concurrent scheduler a PROCESS-total delta
+        # would blame this task for neighbor queries' compiles, so when
+        # the task runs under a query token the delta is taken from the
+        # per-QUERY ledger instead (cross-query safety audit). The
+        # ledger is only WRITTEN while the lifecycle thread-local is
+        # bound to this query (Session/serving do that); a bare token
+        # handed straight to collect() would read permanent zeros from
+        # it, so such callers keep the legacy process delta.
         try:
-            from auron_tpu.runtime import programs
-            self._programs_start = programs.totals()
+            from auron_tpu.runtime import lifecycle, programs
+            qid = (getattr(cancel_token, "query_id", "")
+                   if cancel_token is not None else "")
+            self._programs_query = \
+                qid if qid and lifecycle.current_query_id() == qid else ""
+            self._programs_start = (
+                programs.query_totals(self._programs_query)
+                if self._programs_query else programs.totals())
         except Exception:
+            self._programs_query = ""
             self._programs_start = None
         # per-task fault attribution (runtime/faults)
         from auron_tpu.runtime import faults as _faults
@@ -267,9 +282,10 @@ class ExecutionRuntime:
             snap["xla_compile_seconds"] = round(d.seconds, 4)
         if self._programs_start is not None:
             from auron_tpu.runtime import programs
-            pd = programs.delta(self._programs_start)
-            snap["program_builds"] = pd.builds
-            snap["program_hits"] = pd.hits
+            now = (programs.query_totals(self._programs_query)
+                   if self._programs_query else programs.totals())
+            snap["program_builds"] = now.builds - self._programs_start.builds
+            snap["program_hits"] = now.hits - self._programs_start.hits
         # recovery counters (robustness plane): attempts/retries from the
         # retry driver, corruption recomputes from the RSS exchange's
         # ctx counters (already under the "recovery" metrics key),
@@ -476,8 +492,26 @@ def collect(plan: PhysicalOp, num_partitions: int = 1,
     task's per-op metrics positionally — the EXPLAIN ANALYZE source.
     ``cancel_token`` threads the query's cancellation registry through
     every partition's retry driver."""
+    from auron_tpu import errors as _errors
+    from auron_tpu.runtime import lifecycle as _lifecycle
+    from auron_tpu.runtime import scheduler as _scheduler
     tables = []
     for p in range(num_partitions):
+        # task-level fairness: a token admitted by the concurrent
+        # scheduler carries its slot — take the weighted-round-robin
+        # turn before each task so running queries interleave instead
+        # of one query monopolizing the driver (one getattr for bare
+        # tokens / direct collect calls)
+        try:
+            _scheduler.turn(cancel_token)
+        except _errors.QueryCancelled:
+            # a cancel landing during the fairness wait still counts
+            # on the cancel-latency histogram (run_task_with_retries
+            # observes mid-task cancels; this is the between-task site)
+            _lifecycle.observe_unwind(
+                cancel_token,
+                kind=getattr(cancel_token, "reason", None) or "cancel")
+            raise
         tables.append(run_task_with_retries(
             plan, p, num_partitions, mem_manager=mem_manager,
             config=config, metric_tree=metric_tree,

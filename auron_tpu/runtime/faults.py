@@ -31,9 +31,10 @@ Plan grammar (``auron.faults.plan``)::
 - ``cancel``    — lifecycle chaos (``maybe_cancel``): fire the task's
   cancel registry at a seeded event index, racing cancellation against
   live batch traffic (the ``cancel.race`` site).
-- ``deny``      — memory-pressure chaos (``fires``): force the memory
-  manager's degradation ladder as if the budget were exhausted
-  (the ``memmgr.deny`` site).
+- ``deny``      — forced-decision chaos (``fires``): make a survivable
+  refusal happen as if its threshold were breached — the memory
+  manager's degradation ladder at ``memmgr.deny``, an admission-control
+  rejection (``errors.AdmissionRejected``) at ``sched.admit``.
 
 Named sites threaded through the engine:
 
@@ -45,6 +46,7 @@ Named sites threaded through the engine:
     program.build                                       (compile sites)
     backend.init                                        (watchdog probe)
     memmgr.deny                                         (pressure ladder)
+    sched.admit                                         (admission control)
 
 The plane is resolved from the PROCESS-GLOBAL config (the sites live in
 code paths with no ExecContext at hand — file services, spill files),
@@ -67,7 +69,7 @@ SITES = (
     "rss.write", "rss.flush", "rss.commit", "rss.fetch",
     "spill.write", "spill.read",
     "device.compute", "program.build", "backend.init",
-    "task.hang", "cancel.race", "memmgr.deny",
+    "task.hang", "cancel.race", "memmgr.deny", "sched.admit",
 )
 
 KINDS = ("io_error", "fatal", "corrupt", "hang", "cancel", "deny")

@@ -45,12 +45,16 @@ class CancelToken:
     """
 
     __slots__ = ("query_id", "_event", "_lock", "_deadline", "reason",
-                 "cancelled_at_ns")
+                 "cancelled_at_ns", "slot")
 
     def __init__(self, query_id: str = "", deadline_s: Optional[float] = None):
         self.query_id = query_id
         self._event = threading.Event()
         self._lock = threading.Lock()
+        #: the query's scheduler seat (runtime/scheduler.Slot) once
+        #: admitted; nested executes ride the enclosing token, so the
+        #: slot travels with it (executor.collect's fairness hook)
+        self.slot = None
         self._deadline = (time.monotonic() + deadline_s
                          if deadline_s is not None and deadline_s > 0
                          else None)
@@ -161,6 +165,39 @@ class CancelToken:
     def __repr__(self):
         state = self.reason or ("set" if self._event.is_set() else "live")
         return f"CancelToken({self.query_id!r}, {state})"
+
+
+# ---------------------------------------------------------------------------
+# thread-local query binding (the concurrent runtime's attribution key)
+# ---------------------------------------------------------------------------
+
+#: the driving thread's current query token. Bound by Session.execute /
+#: the serving handler around execution so planes with no ExecContext at
+#: hand (memmgr consumer registration, the central program cache) can
+#: attribute work to the query that caused it — the per-query ledger the
+#: concurrent scheduler's fairness decisions read.
+_TLS = threading.local()
+
+
+def bind_token(token: Optional[CancelToken]):
+    """Bind ``token`` as this thread's current query; returns the
+    previous binding for the caller's finally-restore (nested executes
+    re-bind the same token, so restore keeps the enclosing query)."""
+    prev = getattr(_TLS, "token", None)
+    _TLS.token = token
+    return prev
+
+
+def current_token() -> Optional[CancelToken]:
+    return getattr(_TLS, "token", None)
+
+
+def current_query_id() -> str:
+    """Query id of the driving thread's bound token; "" when no query
+    is bound (direct executor.collect calls, tests) — the anonymous
+    ledger bucket."""
+    tok = getattr(_TLS, "token", None)
+    return getattr(tok, "query_id", "") if tok is not None else ""
 
 
 def observe_unwind(token_or_latency, kind: str = "cancel") -> None:

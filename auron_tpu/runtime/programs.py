@@ -37,6 +37,39 @@ from typing import Callable, NamedTuple, Optional
 _LOCK = threading.Lock()
 _SITES: "OrderedDict[str, ProgramCache]" = OrderedDict()
 
+#: per-QUERY build/hit attribution (query id from the lifecycle plane's
+#: thread-local token). The cache itself is shared ACROSS concurrent
+#: queries — a hit compiled by query A serves query B — so the process
+#: totals can no longer attribute per query by delta; this ledger can.
+#: Popped at query end (Session._end_query) so memory stays bounded;
+#: read by explain(analyze=True)'s program-cache footer.
+_QUERY_LOCK = threading.Lock()
+_QUERY_COUNTS: dict[str, list] = {}
+
+
+def _note_query(built: bool) -> None:
+    from auron_tpu.runtime import lifecycle
+    qid = lifecycle.current_query_id()
+    if not qid:
+        return
+    with _QUERY_LOCK:
+        ent = _QUERY_COUNTS.setdefault(qid, [0, 0])
+        ent[0 if built else 1] += 1
+
+
+def query_totals(qid: str) -> "ProgramSnapshot":
+    """(builds, hits) attributed to ``qid`` so far."""
+    with _QUERY_LOCK:
+        ent = _QUERY_COUNTS.get(qid, (0, 0))
+        return ProgramSnapshot(ent[0], ent[1])
+
+
+def pop_query(qid: str) -> "ProgramSnapshot":
+    """Remove and return ``qid``'s attribution (query teardown)."""
+    with _QUERY_LOCK:
+        ent = _QUERY_COUNTS.pop(qid, (0, 0))
+        return ProgramSnapshot(ent[0], ent[1])
+
 
 class ProgramSnapshot(NamedTuple):
     builds: int
@@ -88,6 +121,7 @@ class ProgramCache:
             # per-site hit events make the compile economics visible on
             # the timeline; narrow auron.trace.events to drop them
             _trace.event("program", "program.hit", site=self.site)
+            _note_query(built=False)
             # the memo holds the RAW program (stable identity for the
             # cache); the per-invocation host/device timing proxy wraps
             # only what leaves the registry (obs/profile.wrap_program —
@@ -101,6 +135,7 @@ class ProgramCache:
         with self._lock:
             if key in self._memo:   # raced with another thread: keep first
                 self.hits += 1
+                _note_query(built=False)
                 return _profile.wrap_program(self._memo[key],
                                              self.site), False
             self._memo[key] = value
@@ -108,6 +143,7 @@ class ProgramCache:
             while len(self._memo) > self.maxsize:
                 self._memo.popitem(last=False)
                 self.evictions += 1
+        _note_query(built=True)
         return _profile.wrap_program(value, self.site), True
 
     def live(self) -> int:

@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import base64
 import io
+import itertools
 import json
 import os
 import queue
@@ -62,6 +63,10 @@ import threading
 import traceback
 
 import pyarrow as pa
+
+#: process-unique serving query ids: they key the process-global
+#: per-query ledgers (program cache, memmgr), so handlers must not share
+_SERVING_QUERY_SEQ = itertools.count(1)
 
 KIND_SUBMIT = 1
 KIND_BATCH = 2
@@ -134,7 +139,8 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         # flip the SAME token the execution runtime polls — socket-level
         # and API-level cancel are one mechanism (runtime/lifecycle.py)
         from auron_tpu.runtime.lifecycle import CancelToken
-        self._cancel = CancelToken(query_id="serving")
+        self._cancel = CancelToken(
+            query_id=f"serving-{next(_SERVING_QUERY_SEQ)}")
         self._window = threading.Semaphore(
             getattr(self.server, "window", DEFAULT_WINDOW))
         self._tables: queue.Queue = queue.Queue()
@@ -161,6 +167,7 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         self._reader = threading.Thread(target=self._control_reader,
                                         daemon=True)
         self._reader.start()
+        from auron_tpu import errors as _errors
         try:
             if kind == KIND_SUBMIT:
                 self._run_task(payload)
@@ -168,6 +175,18 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                 self._run_plan_task(payload)
         except _Cancelled:
             self.server.stats["cancelled"] += 1
+        except _errors.AdmissionRejected as e:
+            # overload shed: a STRUCTURED first line (machine-parseable
+            # reason + retry-after hint) ahead of the message, so a
+            # client can back off without scraping a traceback
+            self.server.stats["rejected"] += 1
+            try:
+                write_frame(self.request, KIND_ERROR,
+                            (f"AdmissionRejected reason={e.reason} "
+                             f"retry_after_s={e.retry_after_s}\n{e}")
+                            .encode())
+            except OSError:
+                pass
         except Exception:
             try:
                 write_frame(self.request, KIND_ERROR,
@@ -322,38 +341,70 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                                                      to_arrow)
         from auron_tpu.ir import pb
         from auron_tpu.ir.planner import plan_from_bytes
-        from auron_tpu.runtime.executor import (ExecutionRuntime,
-                                                TaskDefinition)
-        task = pb.TaskDefinition()
-        task.ParseFromString(task_bytes)
-        op = plan_from_bytes(task_bytes, planner_ctx)
-        rt = ExecutionRuntime(
-            op, TaskDefinition(partition_id=task.partition_id,
-                               num_partitions=task.num_partitions or 1,
-                               stage_id=task.stage_id,
-                               task_id=task.task_id))
-        # share the handler's cancel TOKEN as the task's cancellation
-        # registry: operators polling between child batches unwind even
-        # MID-operator, not just between output batches
-        rt.ctx.cancel_event = self._cancel
         from auron_tpu import errors
         from auron_tpu.ops.base import TaskCancelled
         from auron_tpu.runtime import lifecycle
+        from auron_tpu.runtime.executor import (ExecutionRuntime,
+                                                TaskDefinition)
+        # admission control BEFORE any plan building: the server's
+        # scheduler bounds concurrent executing tasks; past the bounded
+        # queue (or a breached registry signal) this request is shed
+        # with AdmissionRejected — mapped to a structured ERROR frame by
+        # handle(). A CANCEL frame / client disconnect / deadline expiry
+        # WHILE QUEUED dequeues here and tears down silently: no
+        # runtime, no consumer or spill ledger entry ever exists.
         try:
-            for batch in rt.batches():
-                rb = to_arrow(batch, op.schema())
-                if rb.num_rows:
-                    self._send_batch(rb)
+            slot = self.server.scheduler.acquire(self._cancel)
         except errors.DeadlineExceeded:
-            # a deadline is a CLIENT-VISIBLE verdict (ERROR frame with
-            # the classified type), unlike a cancel (silent teardown)
+            # ordering matters: DeadlineExceeded IS-A QueryCancelled,
+            # and a deadline expiring WHILE QUEUED is just as much a
+            # client-visible budget verdict as one mid-stream — it must
+            # reach the ERROR frame, not vanish as a silent cancel
             lifecycle.observe_unwind(self._cancel, kind="deadline")
             raise
         except (TaskCancelled, errors.QueryCancelled):
+            # queue-phase cancels feed the same cancel-latency
+            # histogram as mid-execution ones — the acceptance gate
+            # reads it as covering every cancel class
             lifecycle.observe_unwind(
                 self._cancel, kind=self._cancel.reason or "cancel")
             raise _Cancelled()
-        metrics = rt.finalize()
+        self._cancel.slot = slot
+        prev_bind = lifecycle.bind_token(self._cancel)
+        try:
+            task = pb.TaskDefinition()
+            task.ParseFromString(task_bytes)
+            op = plan_from_bytes(task_bytes, planner_ctx)
+            rt = ExecutionRuntime(
+                op, TaskDefinition(partition_id=task.partition_id,
+                                   num_partitions=task.num_partitions or 1,
+                                   stage_id=task.stage_id,
+                                   task_id=task.task_id),
+                cancel_token=self._cancel)
+            # the handler's cancel TOKEN is the task's cancellation
+            # registry: operators polling between child batches unwind
+            # even MID-operator, not just between output batches
+            try:
+                for batch in rt.batches():
+                    rb = to_arrow(batch, op.schema())
+                    if rb.num_rows:
+                        self._send_batch(rb)
+            except errors.DeadlineExceeded:
+                # a deadline is a CLIENT-VISIBLE verdict (ERROR frame
+                # with the classified type), unlike a cancel (silent
+                # teardown)
+                lifecycle.observe_unwind(self._cancel, kind="deadline")
+                raise
+            except (TaskCancelled, errors.QueryCancelled):
+                lifecycle.observe_unwind(
+                    self._cancel, kind=self._cancel.reason or "cancel")
+                raise _Cancelled()
+            metrics = rt.finalize()
+        finally:
+            lifecycle.bind_token(prev_bind)
+            slot.release()
+            from auron_tpu.runtime import programs
+            programs.pop_query(self._cancel.query_id)
         done = {"metrics": metrics,
                 "schema_ipc": _schema_ipc_b64(schema_to_arrow(op.schema()))}
         if report is not None:
@@ -379,9 +430,15 @@ class AuronServer(socketserver.ThreadingTCPServer):
         super().__init__((host, port), _TaskHandler)
         self._shutdown_requested = False
         self.window = window
-        self.stats = {"batches_sent": 0, "cancelled": 0}
+        self.stats = {"batches_sent": 0, "cancelled": 0, "rejected": 0}
         self._active_lock = threading.Lock()
         self._active_tasks = 0
+        # the serving process's admission plane: handler threads are
+        # cheap, EXECUTIONS are not — at most auron.sched.max_concurrent
+        # tasks compute concurrently, auron.sched.queue_depth more wait,
+        # the rest shed with a structured AdmissionRejected ERROR frame
+        from auron_tpu.runtime.scheduler import QueryScheduler
+        self.scheduler = QueryScheduler(name="serving")
 
     def task_started(self) -> None:
         with self._active_lock:

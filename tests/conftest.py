@@ -101,3 +101,17 @@ def _bound_live_programs():
     yield
     from auron_tpu.utils import compile_stats
     compile_stats.maybe_clear()
+
+
+def spin_until(predicate, timeout_s=30.0, what="condition"):
+    """Poll ``predicate`` until true or fail after ``timeout_s``
+    (monotonic clock) — the shared wait helper of the concurrency
+    tests (test_scheduler / test_serving), one definition so clock
+    source and failure shape cannot drift between modules."""
+    import time as _time
+    end = _time.monotonic() + timeout_s
+    while _time.monotonic() < end:
+        if predicate():
+            return
+        _time.sleep(0.005)
+    pytest.fail(f"timed out waiting for {what}", pytrace=False)

@@ -1,0 +1,156 @@
+"""Tier-1 concurrency stress: N threads drive small TPC-DS queries
+through ONE Session (the [serving] scheduler plane's acceptance shape).
+
+Contract: per-query results BIT-IDENTICAL to the same queries run
+serially, interleaved task.attempt spans on the timeline (queries
+actually overlapped instead of convoying), a clean consumer/spill
+ledger after the storm, and aggregate concurrent wall in the same
+ballpark as serial (the hard ≥0.8x throughput gate runs in
+tools/load_report.py / PERF.md with repetitions; here a generous bound
+catches pathological convoying without adding CI flake)."""
+
+import tempfile
+import threading
+import time
+
+import pytest
+
+from auron_tpu import config as cfg
+
+_QUERY_NAMES = ["q3", "q96", "q42", "q52"]
+
+
+@pytest.fixture(scope="module")
+def tpcds_tables():
+    from auron_tpu.it.tpcds import generate
+    with tempfile.TemporaryDirectory(prefix="conc_tpcds_") as d:
+        yield generate(d, scale=0.01)
+
+
+@pytest.fixture(scope="module")
+def queries():
+    from auron_tpu.it.tpcds_queries import QUERIES
+    by_name = {q.name: q for q in QUERIES}
+    return [by_name[n] for n in _QUERY_NAMES]
+
+
+def test_four_threads_one_session_bit_identical(tpcds_tables, queries):
+    from auron_tpu.frontend.session import Session
+    from auron_tpu.memmgr.manager import MemManager
+    from auron_tpu.memmgr.spill import SpillManager
+    from auron_tpu.obs import trace
+
+    conf = cfg.get_config()
+    _missing = object()
+    saved = {k: conf._overrides.get(k, _missing)
+             for k in (cfg.TRACE_ENABLED, cfg.TRACE_DIR, cfg.TRACE_EVENTS)}
+    conf.set(cfg.TRACE_ENABLED, True)
+    conf.set(cfg.TRACE_DIR, "")
+    conf.set(cfg.TRACE_EVENTS, "")
+    trace_ids = []
+    with tempfile.TemporaryDirectory(prefix="conc_spill_") as spill_dir:
+        mm = MemManager(spill_manager=SpillManager(spill_dir=spill_dir))
+        s = Session(mem_manager=mm)
+        try:
+            # warmup (compiles) + serial baseline
+            for q in queries:
+                q.run(s, tpcds_tables)
+            t0 = time.perf_counter()
+            serial = [q.run(s, tpcds_tables) for q in queries]
+            serial_wall = time.perf_counter() - t0
+
+            results = [None] * len(queries)
+            failures = []
+
+            def worker(i):
+                try:
+                    with trace.query_scope(
+                            label=f"conc:{queries[i].name}") as scope:
+                        trace_ids.append(scope.trace_id)
+                        results[i] = queries[i].run(s, tpcds_tables)
+                except BaseException as e:   # noqa: BLE001
+                    failures.append((queries[i].name, e))
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        daemon=True)
+                       for i in range(len(queries))]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+                assert not t.is_alive(), "concurrent query wedged"
+            conc_wall = time.perf_counter() - t0
+            assert not failures, f"concurrent queries failed: {failures}"
+
+            # 1) bit-identical per-query results vs the serial run
+            for name, a, b in zip(_QUERY_NAMES, serial, results):
+                assert b.equals(a), \
+                    f"{name}: concurrent result diverged from serial"
+
+            # 2) interleaved task.attempt spans: at least one pair of
+            # attempts from DIFFERENT queries overlapped in wall time
+            spans = [sp for sp in trace.tracer().spans()
+                     if sp.name == "task.attempt"
+                     and sp.trace_id in trace_ids]
+            by_query = {}
+            for sp in spans:
+                by_query.setdefault(sp.trace_id, []).append(
+                    (sp.ts_ns, sp.ts_ns + sp.dur_ns))
+            assert len(by_query) == len(queries)
+            overlapped = any(
+                a0 < b1 and b0 < a1
+                for qa, ia in by_query.items()
+                for qb, ib in by_query.items() if qa < qb
+                for a0, a1 in ia for b0, b1 in ib)
+            assert overlapped, \
+                "no task.attempt spans from different queries overlap " \
+                "— the queries convoyed instead of interleaving"
+
+            # 3) all four admitted by the scheduler, none left seated
+            st = s._scheduler.stats()
+            assert st["admitted"] >= 2 * len(queries)   # serial + conc
+            assert st["running"] == 0 and st["queued"] == 0
+
+            # 4) generous anti-convoy wall bound (the measured ≥0.8x
+            # aggregate-throughput gate lives in PERF.md/load_report)
+            assert conc_wall < max(serial_wall * 1.5, serial_wall + 2.0), \
+                f"concurrent wall {conc_wall:.2f}s vs serial " \
+                f"{serial_wall:.2f}s — concurrency pathologically slow"
+        finally:
+            s.close()
+            for tid in trace_ids:
+                trace.tracer().drop(tid)
+            for k, prev in saved.items():
+                if prev is _missing:
+                    conf.unset(k)
+                else:
+                    conf.set(k, prev)
+        # 5) clean ledger: no registered consumers, no live spill files
+        import gc
+        gc.collect()
+        assert mm.status()["consumers"] == {}
+        assert mm.spill_manager.live_disk_files() == 0
+
+
+def _store_sales_column(tables):
+    import pyarrow.parquet as pq
+    files = tables["store_sales"]
+    path = files[0] if isinstance(files, (list, tuple)) else files
+    return pq.read_table(path, columns=["ss_store_sk"])
+
+
+def test_explain_analyze_reports_per_query_hit_rate(tpcds_tables,
+                                                    queries):
+    """The central program cache is SHARED across queries (a build by
+    one query serves its neighbors); explain(analyze=True) therefore
+    reports the per-QUERY ledger, not process totals."""
+    from auron_tpu.frontend.dataframe import functions as F
+    from auron_tpu.frontend.session import Session
+    s = Session()
+    queries[0].run(s, tpcds_tables)     # warm the shared cache
+    df = (s.from_arrow(_store_sales_column(tpcds_tables))
+          .group_by("ss_store_sk").agg(F.count_star().alias("n")))
+    text = df.explain(analyze=True)
+    assert "[program cache] builds=" in text
+    assert "hit_rate=" in text and "query q" in text

@@ -375,7 +375,10 @@ def test_session_close_cancels_active_queries():
     token = s._begin_query(timeout_s=None)
     assert s.active_queries() == {token.query_id: token}
     s.close()
-    assert token.is_set() and token.reason == "cancelled"
+    # the deterministic drain (queued first, then running) stamps its
+    # OWN reason so a close-time unwind is distinguishable from a user
+    # cancel in telemetry; still raises QueryCancelled at poll sites
+    assert token.is_set() and token.reason == "session-closed"
 
 
 def test_injected_hang_polls_cancel_registry():

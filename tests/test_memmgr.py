@@ -176,6 +176,188 @@ class TestMemManager:
         assert st["fair_share"] == 100
 
 
+class TestPerQueryFairness:
+    """Concurrent-runtime memory arbitration: consumers are tagged with
+    the registering thread's query, fair_share divides the budget over
+    LIVE QUERIES, the per-query quota (auto budget/max_concurrent under
+    concurrency) sheds the offender, and force-spill picks the
+    over-quota query's own largest consumer — never a neighbor's."""
+
+    def _register_as(self, mm, consumer, qid, own_thread=False):
+        import threading
+
+        from auron_tpu.runtime import lifecycle
+        from auron_tpu.runtime.lifecycle import CancelToken
+
+        def do():
+            prev = lifecycle.bind_token(CancelToken(qid))
+            try:
+                mm.register_consumer(consumer)
+            finally:
+                lifecycle.bind_token(prev)
+
+        if own_thread:
+            # register from a separate thread: that thread becomes the
+            # consumer's DRIVING thread for victim-eligibility purposes
+            t = threading.Thread(target=do)
+            t.start()
+            t.join(5)
+        else:
+            do()
+
+    def test_fair_share_divides_by_live_queries(self):
+        mm = MemManager(total_bytes=1200, min_trigger=0)
+        a1, a2 = _FakeConsumer("a1"), _FakeConsumer("a2")
+        b1 = _FakeConsumer("b1")
+        self._register_as(mm, a1, "qa")
+        self._register_as(mm, a2, "qa")
+        assert mm.fair_share() == 1200        # one query: whole budget
+        self._register_as(mm, b1, "qb")
+        # two queries, three consumers: per-QUERY share
+        assert mm.fair_share() == 600
+        st = mm.status()
+        assert st["num_queries"] == 2 and st["fair_share"] == 600
+        mm.update_mem_used(a1, 100)
+        mm.update_mem_used(a2, 50)
+        assert mm.query_used("qa") == 150 and mm.query_used("qb") == 0
+        assert st["queries"].keys() <= {"qa", "qb", "<anon>"}
+
+    def test_auto_quota_only_under_concurrency(self):
+        from auron_tpu import config as cfg
+        conf = cfg.get_config()
+        conf.set(cfg.SCHED_MAX_CONCURRENT, 4)
+        try:
+            mm = MemManager(total_bytes=1000, min_trigger=0)
+            a = _FakeConsumer("a")
+            self._register_as(mm, a, "qa")
+            # solo query: no auto quota — may use the whole budget
+            assert mm._query_quota() == 0
+            b = _FakeConsumer("b")
+            self._register_as(mm, b, "qb")
+            # two live queries: budget / max_concurrent
+            assert mm._query_quota() == 250
+            # explicit knob wins over auto...
+            conf.set(cfg.MEMMGR_QUERY_QUOTA_BYTES, 400)
+            assert mm._query_quota() == 400
+            # ...and negative disables entirely
+            conf.set(cfg.MEMMGR_QUERY_QUOTA_BYTES, -1)
+            assert mm._query_quota() == 0
+        finally:
+            conf.unset(cfg.SCHED_MAX_CONCURRENT)
+            conf.unset(cfg.MEMMGR_QUERY_QUOTA_BYTES)
+
+    def test_quota_breach_spills_own_query_not_neighbor(self):
+        """A query over ITS quota while the manager is under budget
+        spills that query's own consumers; the innocent neighbor's
+        buffers stay resident."""
+        from auron_tpu import config as cfg
+        conf = cfg.get_config()
+        conf.set(cfg.MEMMGR_QUERY_QUOTA_BYTES, 300)
+        try:
+            mm = MemManager(total_bytes=10_000, min_trigger=0)
+            hog_big = _FakeConsumer("hog_big")
+            hog_small = _FakeConsumer("hog_small")
+            neighbor = _FakeConsumer("neighbor")
+            self._register_as(mm, hog_big, "qhog")
+            self._register_as(mm, hog_small, "qhog")
+            self._register_as(mm, neighbor, "qn")
+            neighbor.used = 280
+            mm.update_mem_used(neighbor, 280)
+            hog_big.used = 250
+            mm.update_mem_used(hog_big, 250)
+            hog_small.used = 100
+            assert mm.update_mem_used(hog_small, 100) == "spilled"
+            # the hog's largest consumer paid; the neighbor did not
+            assert hog_big.spill_calls == 1
+            assert neighbor.spill_calls == 0
+        finally:
+            conf.unset(cfg.MEMMGR_QUERY_QUOTA_BYTES)
+
+    def test_quota_breach_exhausted_sheds_the_offender(self):
+        """Spill runs dry (unspillable hog) → ladder rung 3 sheds THIS
+        query with MemoryExhausted even though the manager is under
+        its global budget."""
+        from auron_tpu import config as cfg
+        from auron_tpu import errors
+        conf = cfg.get_config()
+        conf.set(cfg.MEMMGR_QUERY_QUOTA_BYTES, 100)
+        try:
+            mm = MemManager(total_bytes=10_000, min_trigger=0)
+
+            class _Stuck(_FakeConsumer):
+                def spill(self):
+                    self.spill_calls += 1
+                    return 0
+
+            hog = _Stuck("hog")
+            self._register_as(mm, hog, "qhog")
+            hog.used = 500
+            with pytest.raises(errors.MemoryExhausted) as ei:
+                mm.update_mem_used(hog, 500)
+            assert "qhog" in str(ei.value)
+            assert mm.pressure_counts["shed"] == 1
+        finally:
+            conf.unset(cfg.MEMMGR_QUERY_QUOTA_BYTES)
+
+    def test_quota_only_breach_never_force_spills_neighbor(self):
+        """Rung-2 force-spill on a QUOTA-only breach: when the offender
+        has no victim eligible from this thread, the rung must NOT fall
+        back to an innocent neighbor (spilling it cannot lower the
+        offender's ledger) — rung 3 sheds the offender instead."""
+        from auron_tpu import config as cfg
+        from auron_tpu import errors
+        conf = cfg.get_config()
+        conf.set(cfg.MEMMGR_QUERY_QUOTA_BYTES, 1000)
+        try:
+            mm = MemManager(total_bytes=100_000, min_trigger=0)
+
+            class _Stuck(_FakeConsumer):
+                def spill(self):
+                    self.spill_calls += 1
+                    return 0
+
+            hog = _Stuck("hog")
+            neighbor = _FakeConsumer("neighbor")
+            neighbor.spill_thread_safe = True   # globally spillable...
+            self._register_as(mm, hog, "qhog", own_thread=True)
+            self._register_as(mm, neighbor, "qn")
+            neighbor.used = 800                 # under ITS quota
+            mm.update_mem_used(neighbor, 800)
+            hog.used = 1500                     # over ITS quota
+            with pytest.raises(errors.MemoryExhausted):
+                mm.update_mem_used(hog, 1500)
+            # ...but NOT for a breach that is not its fault
+            assert neighbor.spill_calls == 0
+        finally:
+            conf.unset(cfg.MEMMGR_QUERY_QUOTA_BYTES)
+
+    def test_cross_thread_victim_requires_thread_safe_spill(self):
+        """Global over-budget: a consumer driven by ANOTHER thread is
+        only eligible as victim when it advertises spill_thread_safe
+        (the cross-query safety audit's guard — thread identity, not
+        query tag, is what makes a foreign spill() unsound); consumers
+        driven by the requesting thread are always eligible."""
+        mm = MemManager(total_bytes=100, min_trigger=0)
+        unsafe = _FakeConsumer("unsafe_foreign")      # default: not safe
+        safe = _FakeConsumer("safe_foreign")
+        safe.spill_thread_safe = True
+        mine = _FakeConsumer("mine")
+        self._register_as(mm, unsafe, "qa", own_thread=True)
+        self._register_as(mm, safe, "qb", own_thread=True)
+        self._register_as(mm, mine, "qc")
+        unsafe.used = 500
+        with mm._lock:
+            mm._used[unsafe] = 500
+        safe.used = 400
+        with mm._lock:
+            mm._used[safe] = 400
+        mine.used = 10
+        assert mm.update_mem_used(mine, 10) == "spilled"
+        # the biggest eligible foreign victim is the THREAD-SAFE one
+        assert safe.spill_calls >= 1
+        assert unsafe.spill_calls == 0
+
+
 class TestMemmgrTelemetry:
     """PR 6: every accounting decision mirrors onto registry gauges and
     the span timeline (the memmgr tier-telemetry half of the forensics
@@ -195,7 +377,10 @@ class TestMemmgrTelemetry:
         text = reg.render_prometheus()
         assert "# TYPE auron_memmgr_used_bytes gauge" in text
         assert "auron_memmgr_budget_bytes 1000" in text
-        assert "auron_memmgr_fair_share_bytes 500" in text
+        # fair share is per LIVE QUERY now (the concurrent scheduler's
+        # fairness unit): both consumers belong to one (anonymous)
+        # query, so its share is the whole budget
+        assert "auron_memmgr_fair_share_bytes 1000" in text
         assert "auron_memmgr_spills_total 1" in text
         # per-consumer gauges carry the consumer label
         assert 'auron_memmgr_consumer_bytes{consumer="sort"}' in text

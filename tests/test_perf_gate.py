@@ -152,8 +152,10 @@ class TestPerfGate:
 
     def test_smoke_mode(self, capsys):
         """tools/perf_gate.py --smoke from tier-1: the in-process q01
-        pipeline at tiny scale clears the generous smoke floor, and the
-        last stdout line is one JSON verdict (driver contract)."""
+        pipeline at tiny scale clears the generous smoke floor, the
+        scheduler's solo-query tax clears the <2% concurrency-tax gate,
+        and the last stdout line is one JSON verdict (driver
+        contract)."""
         rc = perf_gate.main(["--smoke"])
         out = capsys.readouterr().out
         last = json.loads(out.strip().splitlines()[-1])
@@ -161,6 +163,10 @@ class TestPerfGate:
         assert rc == 0, out
         assert last["perf_gate"] == "pass"
         assert last["value_rows_per_sec"] > last["floor_rows_per_sec"]
+        # the concurrency-tax gate: every query now passes through the
+        # scheduler; its bookkeeping must stay invisible on a solo run
+        assert last["sched_tax_limit_pct"] == 2.0
+        assert 0.0 <= last["sched_tax_pct"] < last["sched_tax_limit_pct"]
 
     def test_unusable_records(self):
         base = _baseline()

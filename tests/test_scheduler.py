@@ -1,0 +1,477 @@
+"""Concurrent query scheduler (runtime/scheduler.py): admission
+control, bounded run queue, queued-cancel dequeue, weighted-round-robin
+task fairness, session drain order, nested-execute slot inheritance."""
+
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from auron_tpu import config as cfg
+from auron_tpu import errors
+from auron_tpu.runtime.lifecycle import CancelToken
+from auron_tpu.runtime.scheduler import QueryScheduler
+
+
+@pytest.fixture
+def knobs():
+    """Save/restore the scheduler knobs a test clamps."""
+    conf = cfg.get_config()
+    keys = (cfg.SCHED_MAX_CONCURRENT, cfg.SCHED_QUEUE_DEPTH,
+            cfg.SCHED_ADMIT_QUEUE_WAIT_P99_S, cfg.SCHED_ADMIT_MEM_RATIO)
+    _missing = object()
+    saved = {k: conf._overrides.get(k, _missing) for k in keys}
+    yield conf
+    for k, prev in saved.items():
+        if prev is _missing:
+            conf.unset(k)
+        else:
+            conf.set(k, prev)
+
+
+from conftest import spin_until as _spin
+
+
+class TestAdmission:
+    def test_solo_fast_path_and_overhead_ledger(self):
+        sched = QueryScheduler(name="t")
+        tok = CancelToken("qa")
+        slot = sched.acquire(tok)
+        assert slot.granted and slot.queue_wait_s == 0.0
+        slot.task_turn()
+        slot.release()
+        assert sched.last_overhead_ns > 0
+        # bookkeeping, not policy: a solo query's tax is microseconds
+        assert sched.last_overhead_ns < 50_000_000
+        st = sched.stats()
+        assert st["admitted"] == 1 and st["rejected"] == 0
+
+    def test_queue_full_rejects_with_classified_hint(self, knobs):
+        knobs.set(cfg.SCHED_MAX_CONCURRENT, 1)
+        knobs.set(cfg.SCHED_QUEUE_DEPTH, 0)
+        sched = QueryScheduler(name="t")
+        a = sched.acquire(CancelToken("qa"))
+        with pytest.raises(errors.AdmissionRejected) as ei:
+            sched.acquire(CancelToken("qb"))
+        e = ei.value
+        # transient-by-design: load shedding, not failure — and the
+        # caller gets a backoff hint
+        assert errors.is_transient(e)
+        assert e.reason == "queue_full"
+        assert e.retry_after_s and e.retry_after_s > 0
+        assert e.site == "sched.admit"
+        a.release()
+        st = sched.stats()
+        assert st["rejected_by_reason"] == {"queue_full": 1}
+
+    def test_release_promotes_queued_fifo(self, knobs):
+        knobs.set(cfg.SCHED_MAX_CONCURRENT, 1)
+        knobs.set(cfg.SCHED_QUEUE_DEPTH, 4)
+        sched = QueryScheduler(name="t")
+        a = sched.acquire(CancelToken("qa"))
+        got = []
+
+        def waiter(name):
+            s = sched.acquire(CancelToken(name))
+            got.append(name)
+            s.release()
+
+        tb = threading.Thread(target=waiter, args=("qb",), daemon=True)
+        tb.start()
+        _spin(lambda: sched.queued_count() == 1, what="qb queued")
+        tc = threading.Thread(target=waiter, args=("qc",), daemon=True)
+        tc.start()
+        _spin(lambda: sched.queued_count() == 2, what="qc queued")
+        assert got == []                      # both parked, none started
+        a.release()
+        tb.join(5)
+        tc.join(5)
+        # FIFO: first queued runs first
+        assert got == ["qb", "qc"]
+        st = sched.stats()
+        assert st["admitted"] == 3
+        assert st["queue_wait_p99_s"] >= 0.0
+
+    def test_cancel_while_queued_dequeues_without_starting(self, knobs):
+        knobs.set(cfg.SCHED_MAX_CONCURRENT, 1)
+        knobs.set(cfg.SCHED_QUEUE_DEPTH, 4)
+        sched = QueryScheduler(name="t")
+        a = sched.acquire(CancelToken("qa"))
+        tok = CancelToken("qb")
+        res = {}
+
+        def waiter():
+            try:
+                sched.acquire(tok)
+                res["out"] = "granted"
+            except BaseException as e:   # noqa: BLE001
+                res["out"] = e
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        _spin(lambda: sched.queued_count() == 1, what="qb queued")
+        tok.cancel()
+        t.join(5)
+        assert isinstance(res["out"], errors.QueryCancelled)
+        assert sched.queued_count() == 0
+        st = sched.stats()
+        # never admitted, cleanly dequeued
+        assert st["admitted"] == 1
+        assert st["dequeued_by_reason"] == {"cancelled": 1}
+        a.release()
+
+    def test_deadline_while_queued_is_deadline_exceeded(self, knobs):
+        knobs.set(cfg.SCHED_MAX_CONCURRENT, 1)
+        knobs.set(cfg.SCHED_QUEUE_DEPTH, 4)
+        sched = QueryScheduler(name="t")
+        a = sched.acquire(CancelToken("qa"))
+        with pytest.raises(errors.DeadlineExceeded):
+            sched.acquire(CancelToken("qb", deadline_s=0.15))
+        assert sched.stats()["dequeued_by_reason"] == {"deadline": 1}
+        a.release()
+
+    def test_injected_sched_admit_deny(self, knobs):
+        from auron_tpu.runtime import faults
+        conf = cfg.get_config()
+        conf.set(cfg.FAULTS_PLAN, "sched.admit:deny@1.0")
+        faults.reset()
+        try:
+            sched = QueryScheduler(name="t")
+            with pytest.raises(errors.AdmissionRejected) as ei:
+                sched.acquire(CancelToken("qa"))
+            assert ei.value.reason == "injected"
+        finally:
+            conf.unset(cfg.FAULTS_PLAN)
+            faults.reset()
+
+    def test_memory_signal_rejects(self, knobs):
+        from auron_tpu.memmgr.manager import MemManager
+
+        class _C:
+            consumer_name = "hog"
+
+        mm = MemManager(total_bytes=100, min_trigger=0)
+        hog = _C()
+        mm.register_consumer(hog)
+        with mm._lock:
+            mm._used[hog] = 90
+        knobs.set(cfg.SCHED_ADMIT_MEM_RATIO, 0.8)
+        sched = QueryScheduler(name="t", mem_manager=mm)
+        with pytest.raises(errors.AdmissionRejected) as ei:
+            sched.acquire(CancelToken("qa"))
+        assert ei.value.reason == "memory"
+        # pressure released → admission opens again
+        with mm._lock:
+            mm._used[hog] = 10
+        sched.acquire(CancelToken("qb")).release()
+
+    def test_queue_wait_signal_rejects(self, knobs):
+        knobs.set(cfg.SCHED_MAX_CONCURRENT, 1)
+        knobs.set(cfg.SCHED_QUEUE_DEPTH, 8)
+        knobs.set(cfg.SCHED_ADMIT_QUEUE_WAIT_P99_S, 0.5)
+        sched = QueryScheduler(name="t")
+        now = time.monotonic()
+        sched._waits.extend([(now, 2.0)] * 10)   # recent: p99 = 2s
+        a = sched.acquire(CancelToken("qa"))  # free slot: not queueing
+        with pytest.raises(errors.AdmissionRejected) as ei:
+            sched.acquire(CancelToken("qb"))  # would queue → latency shed
+        assert ei.value.reason == "queue_wait"
+        a.release()
+
+    def test_queue_wait_signal_decays_with_sample_age(self, knobs):
+        """The latency signal must describe the RECENT queue: a burst
+        outside the age window cannot latch admission shut forever."""
+        from auron_tpu.runtime import scheduler as sched_mod
+        knobs.set(cfg.SCHED_MAX_CONCURRENT, 1)
+        knobs.set(cfg.SCHED_QUEUE_DEPTH, 8)
+        knobs.set(cfg.SCHED_ADMIT_QUEUE_WAIT_P99_S, 0.5)
+        sched = QueryScheduler(name="t")
+        stale = time.monotonic() - sched_mod._WAIT_SIGNAL_WINDOW_S - 1.0
+        sched._waits.extend([(stale, 2.0)] * 10)   # old burst only
+        a = sched.acquire(CancelToken("qa"))
+        done = {}
+
+        def waiter():
+            s = sched.acquire(CancelToken("qb"))   # queues, NOT shed
+            done["granted"] = True
+            s.release()
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        _spin(lambda: sched.queued_count() == 1, what="qb queued")
+        a.release()
+        t.join(5)
+        assert done.get("granted")
+        assert sched.stats()["rejected"] == 0
+
+
+class TestFairness:
+    def _two(self, knobs, weight_a=1.0):
+        knobs.set(cfg.SCHED_MAX_CONCURRENT, 2)
+        sched = QueryScheduler(name="t")
+        a = sched.acquire(CancelToken("qa"), weight=weight_a)
+        b = sched.acquire(CancelToken("qb"))
+        return sched, a, b
+
+    def test_round_robin_gates_the_leader(self, knobs):
+        sched, a, b = self._two(knobs)
+        done = []
+
+        def runner():
+            for i in range(3):
+                a.task_turn()
+                done.append(i)
+
+        t = threading.Thread(target=runner, daemon=True)
+        t.start()
+        # A may run exactly ONE task ahead of the idle B, then parks
+        time.sleep(0.3)
+        assert done == [0]
+        b.task_turn()                      # the laggard advances...
+        _spin(lambda: len(done) == 2, what="A's second turn")
+        time.sleep(0.2)
+        assert len(done) == 2              # ...and A is gated again
+        b.release()                        # B finishes: A runs free
+        t.join(5)
+        assert len(done) == 3
+        a.release()
+
+    def test_weighted_leader_gets_proportional_turns(self, knobs):
+        sched, a, b = self._two(knobs, weight_a=2.0)
+        done = []
+
+        def runner():
+            for i in range(4):
+                a.task_turn()
+                done.append(i)
+
+        t = threading.Thread(target=runner, daemon=True)
+        t.start()
+        # weight 2 → TWO tasks per round against an idle weight-1 peer
+        _spin(lambda: len(done) == 2, what="A's weighted turns")
+        time.sleep(0.2)
+        assert len(done) == 2
+        b.task_turn()
+        _spin(lambda: len(done) == 4, what="A's next round")
+        a.release()
+        b.release()
+
+    def test_new_admission_joins_round_in_progress(self, knobs):
+        """Start-time fair queueing: a newcomer's virtual clock begins
+        at the running round's minimum — an established query must NOT
+        stall while the newcomer replays its whole task history."""
+        knobs.set(cfg.SCHED_MAX_CONCURRENT, 2)
+        sched = QueryScheduler(name="t")
+        a = sched.acquire(CancelToken("qa"))
+        for _ in range(5):
+            a.task_turn()          # solo: unconstrained, vtime 5
+        b = sched.acquire(CancelToken("qb"))
+        assert b.vtime == a.vtime  # joined at the round, not at zero
+        t0 = time.monotonic()
+        a.task_turn()              # must proceed immediately, no stall
+        assert time.monotonic() - t0 < 0.5
+        a.release()
+        b.release()
+
+    def test_release_never_promotes_cancelled_head(self, knobs):
+        """A queued query whose token flipped must be DEQUEUED even
+        when capacity frees before its own poll notices — promotion
+        skips dead heads, so no executor ever spins up for it."""
+        knobs.set(cfg.SCHED_MAX_CONCURRENT, 1)
+        knobs.set(cfg.SCHED_QUEUE_DEPTH, 4)
+        sched = QueryScheduler(name="t")
+        a = sched.acquire(CancelToken("qa"))
+        tok = CancelToken("qb")
+        res = {}
+
+        def waiter():
+            try:
+                sched.acquire(tok)
+                res["out"] = "granted"
+            except BaseException as e:   # noqa: BLE001
+                res["out"] = e
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        _spin(lambda: sched.queued_count() == 1, what="qb queued")
+        # flip the token and IMMEDIATELY free capacity: the promotion
+        # path races qb's 50ms poll and must skip the dead head
+        tok.cancel()
+        a.release()
+        t.join(5)
+        assert isinstance(res["out"], errors.QueryCancelled)
+        st = sched.stats()
+        assert st["admitted"] == 1 and st["running"] == 0
+        assert st["dequeued_by_reason"] == {"cancelled": 1}
+
+    def test_queue_wait_signal_sees_inflight_waits(self, knobs):
+        """Under sustained saturation nothing is ever granted, so the
+        signal must read the ages of the queries queued RIGHT NOW —
+        completed samples alone would go blind exactly at overload."""
+        knobs.set(cfg.SCHED_MAX_CONCURRENT, 1)
+        knobs.set(cfg.SCHED_QUEUE_DEPTH, 8)
+        knobs.set(cfg.SCHED_ADMIT_QUEUE_WAIT_P99_S, 0.2)
+        sched = QueryScheduler(name="t")
+        a = sched.acquire(CancelToken("qa"))
+        tok_b = CancelToken("qb")
+        tb = threading.Thread(target=lambda: self._swallow(sched, tok_b),
+                              daemon=True)
+        tb.start()
+        _spin(lambda: sched.queued_count() == 1, what="qb queued")
+        time.sleep(0.4)            # qb's in-flight wait now > limit
+        with pytest.raises(errors.AdmissionRejected) as ei:
+            sched.acquire(CancelToken("qc"))
+        assert ei.value.reason == "queue_wait"
+        tok_b.cancel()
+        tb.join(5)
+        a.release()
+
+    @staticmethod
+    def _swallow(sched, tok):
+        try:
+            sched.acquire(tok).release()
+        except BaseException:   # noqa: BLE001 — cancelled on purpose
+            pass
+
+    def test_cancel_unblocks_fairness_wait(self, knobs):
+        sched, a, b = self._two(knobs)
+        a.task_turn()                      # A is now one unit ahead
+        res = {}
+
+        def runner():
+            try:
+                a.task_turn()
+                res["out"] = "ran"
+            except BaseException as e:   # noqa: BLE001
+                res["out"] = e
+
+        t = threading.Thread(target=runner, daemon=True)
+        t.start()
+        time.sleep(0.15)
+        a.token.cancel()
+        t.join(5)
+        assert isinstance(res["out"], errors.QueryCancelled)
+        a.release()
+        b.release()
+
+
+class TestSessionIntegration:
+    def _table(self, n=2048):
+        import numpy as np
+        rng = np.random.default_rng(5)
+        return pa.table({
+            "k": pa.array(rng.integers(0, 16, n), pa.int64()),
+            "v": pa.array(rng.normal(size=n)),
+        })
+
+    def test_execute_admits_and_clears_slot(self):
+        from auron_tpu.frontend.dataframe import col, functions as F
+        from auron_tpu.frontend.session import Session
+        s = Session()
+        df = (s.from_arrow(self._table()).group_by("k")
+              .agg(F.sum(col("v")).alias("sv")))
+        out = s.execute(df)
+        assert out.num_rows == 16
+        st = s._scheduler.stats()
+        assert st["admitted"] == 1 and st["running"] == 0
+
+    def test_nested_host_fn_inherits_slot_single_admission(self):
+        from auron_tpu.frontend.dataframe import col, functions as F
+        from auron_tpu.frontend.session import Session
+        s = Session()
+        seen = {}
+
+        def double(rb):
+            # the nested execute runs while the PARENT holds the only
+            # slot; a queued child would deadlock here
+            seen["running_during_child"] = s._scheduler.running_count()
+            return rb
+
+        conf = cfg.get_config()
+        conf.set(cfg.SCHED_MAX_CONCURRENT, 1)
+        conf.set(cfg.SCHED_QUEUE_DEPTH, 0)
+        try:
+            df = (s.from_arrow(self._table()).map_batches(double)
+                  .group_by("k").agg(F.count_star().alias("n")))
+            out = s.execute(df)
+        finally:
+            conf.unset(cfg.SCHED_MAX_CONCURRENT)
+            conf.unset(cfg.SCHED_QUEUE_DEPTH)
+        assert out.num_rows == 16
+        # ONE admission for the whole tree — the nested execute rode
+        # the enclosing token's slot instead of queueing behind it
+        assert s._scheduler.stats()["admitted"] == 1
+        assert seen["running_during_child"] == 1
+
+    def test_session_config_overrides_sched_knobs(self):
+        """auron.sched.* is a SESSION-honored knob family (scheduler
+        state is per-Session): a Session built with its own config gets
+        that config's clamps, not the process defaults."""
+        from auron_tpu.config import AuronConfig
+        from auron_tpu.frontend.session import Session
+        conf = (AuronConfig().set(cfg.SCHED_MAX_CONCURRENT, 1)
+                .set(cfg.SCHED_QUEUE_DEPTH, 0))
+        s = Session(config=conf)
+        a = s._scheduler.acquire(CancelToken("qa"))
+        with pytest.raises(errors.AdmissionRejected) as ei:
+            s._scheduler.acquire(CancelToken("qb"))
+        assert ei.value.reason == "queue_full"
+        a.release()
+
+    def test_close_mid_queue_drains_deterministically(self, knobs):
+        """Satellite regression: Session.close() with queued + running
+        queries cancels the QUEUED entry first (reason session-closed,
+        dequeued without ever starting — no admission, no executor),
+        then the running token, then sweeps."""
+        from auron_tpu.frontend.dataframe import col, functions as F
+        from auron_tpu.frontend.session import Session
+        from auron_tpu.runtime import faults
+        knobs.set(cfg.SCHED_MAX_CONCURRENT, 1)
+        knobs.set(cfg.SCHED_QUEUE_DEPTH, 4)
+        conf = cfg.get_config()
+        # the running query crawls: every checkpoint sleeps 0.3s (the
+        # injected hang polls the cancel registry, so close() unwinds
+        # it promptly)
+        conf.set(cfg.FAULTS_PLAN, "task.hang:hang@1.0")
+        conf.set(cfg.FAULTS_HANG_S, 0.3)
+        faults.reset()
+        s = Session()
+        table = self._table(8192)
+        results = {}
+
+        def run(name):
+            df = (s.from_arrow(table).sort("k").group_by("k")
+                  .agg(F.sum(col("v")).alias("sv")))
+            try:
+                results[name] = s.execute(df)
+            except BaseException as e:   # noqa: BLE001
+                results[name] = e
+
+        try:
+            ta = threading.Thread(target=run, args=("a",), daemon=True)
+            ta.start()
+            _spin(lambda: s._scheduler.running_count() == 1,
+                  what="query a running")
+            tb = threading.Thread(target=run, args=("b",), daemon=True)
+            tb.start()
+            _spin(lambda: s._scheduler.queued_count() == 1,
+                  what="query b queued")
+            s.close()
+            ta.join(10)
+            tb.join(10)
+        finally:
+            conf.unset(cfg.FAULTS_PLAN)
+            conf.unset(cfg.FAULTS_HANG_S)
+            faults.reset()
+        # the queued query was dequeued with the close reason, never
+        # admitted, never started
+        assert isinstance(results["b"], errors.QueryCancelled)
+        st = s._scheduler.stats()
+        assert st["dequeued_by_reason"].get("session-closed") == 1
+        assert st["admitted"] == 1
+        # the running query unwound classified too (or, if it raced
+        # completion, returned a real table)
+        assert isinstance(results["a"],
+                          (errors.QueryCancelled, pa.Table))
+        assert s.active_queries() == {}

@@ -161,6 +161,126 @@ def test_client_disconnect_cancels_task(tmp_path):
         srv.shutdown()
 
 
+def _blocked_task(path):
+    """A many-batch task (512-row scan batches) the producer cannot
+    finish while the client withholds ACKs — a deterministic way to
+    keep one serving slot occupied without faults or sleeps."""
+    col = lambda i: pb.ExprNode(column=pb.ColumnRefE(index=i))
+    plan = pb.PlanNode(project=pb.ProjectNode(
+        child=pb.PlanNode(parquet_scan=pb.ParquetScanNode(
+            files=[path], batch_rows=512)),
+        exprs=[col(0), col(1)], names=["k", "v"]))
+    return pb.TaskDefinition(plan=plan, task_id=3).SerializeToString()
+
+
+def _sched_knobs(max_concurrent, queue_depth):
+    from auron_tpu import config as cfg
+    conf = cfg.get_config()
+    conf.set(cfg.SCHED_MAX_CONCURRENT, max_concurrent)
+    conf.set(cfg.SCHED_QUEUE_DEPTH, queue_depth)
+
+    def restore():
+        conf.unset(cfg.SCHED_MAX_CONCURRENT)
+        conf.unset(cfg.SCHED_QUEUE_DEPTH)
+    return restore
+
+
+from conftest import spin_until as _spin
+
+
+def test_cancel_while_queued_dequeues_without_starting(tmp_path):
+    """Satellite regression (PR 7 mapping): a serving client that sends
+    CANCEL — or disconnects — while its query is still QUEUED behind a
+    full scheduler is dequeued cleanly: silent teardown, no executor
+    spin-up, no consumer/spill ledger entry, no admission counted."""
+    import socket as socketmod
+
+    from auron_tpu.runtime.serving import (KIND_BATCH, KIND_CANCEL,
+                                           KIND_SUBMIT, read_frame,
+                                           write_frame)
+    path, _tbl = _dataset(str(tmp_path))
+    restore = _sched_knobs(1, 2)
+    srv = AuronServer(window=2)
+    srv.serve_background()
+    try:
+        # A occupies the ONLY slot: unACKed window blocks its producer
+        sa = socketmod.create_connection(srv.address, timeout=60)
+        write_frame(sa, KIND_SUBMIT, _blocked_task(path))
+        kind, _ = read_frame(sa)
+        assert kind == KIND_BATCH
+        _spin(lambda: srv.scheduler.running_count() == 1,
+              what="A running")
+        # B queues, then CANCELs while queued
+        sb = socketmod.create_connection(srv.address, timeout=60)
+        write_frame(sb, KIND_SUBMIT, _blocked_task(path))
+        _spin(lambda: srv.scheduler.queued_count() == 1, what="B queued")
+        write_frame(sb, KIND_CANCEL, b"")
+        _spin(lambda: srv.scheduler.stats()["dequeued"] == 1,
+              what="B dequeued")
+        # C queues, then DISCONNECTS while queued (same mechanism)
+        sc = socketmod.create_connection(srv.address, timeout=60)
+        write_frame(sc, KIND_SUBMIT, _blocked_task(path))
+        _spin(lambda: srv.scheduler.queued_count() == 1, what="C queued")
+        sc.close()
+        _spin(lambda: srv.scheduler.stats()["dequeued"] == 2,
+              what="C dequeued")
+        st = srv.scheduler.stats()
+        # only A was ever ADMITTED; B and C never started an executor
+        assert st["admitted"] == 1
+        assert st["dequeued_by_reason"].get("cancelled") == 2
+        # teardown is the silent-cancel mapping, no ERROR frames owed
+        write_frame(sa, KIND_CANCEL, b"")
+        sa.close()
+        sb.close()
+        _spin(lambda: srv.scheduler.running_count() == 0,
+              what="A released")
+        assert srv.stats["cancelled"] >= 3
+    finally:
+        restore()
+        srv.shutdown()
+
+
+def test_overload_sheds_with_structured_admission_error(tmp_path):
+    """Past the bounded queue the server rejects FAST with a structured
+    AdmissionRejected ERROR frame (reason + retry_after_s on the first
+    line) instead of stalling the client or crashing."""
+    import socket as socketmod
+
+    from auron_tpu.runtime.serving import (KIND_BATCH, KIND_CANCEL,
+                                           KIND_ERROR, KIND_SUBMIT,
+                                           read_frame, write_frame)
+    path, _tbl = _dataset(str(tmp_path))
+    restore = _sched_knobs(1, 0)          # no queue at all
+    srv = AuronServer(window=2)
+    srv.serve_background()
+    try:
+        sa = socketmod.create_connection(srv.address, timeout=60)
+        write_frame(sa, KIND_SUBMIT, _blocked_task(path))
+        kind, _ = read_frame(sa)
+        assert kind == KIND_BATCH
+        _spin(lambda: srv.scheduler.running_count() == 1,
+              what="A running")
+        sb = socketmod.create_connection(srv.address, timeout=60)
+        write_frame(sb, KIND_SUBMIT, _blocked_task(path))
+        kind, payload = read_frame(sb)
+        assert kind == KIND_ERROR
+        first = payload.decode().splitlines()[0]
+        assert first.startswith("AdmissionRejected ")
+        assert "reason=queue_full" in first
+        assert "retry_after_s=" in first
+        assert srv.stats["rejected"] == 1
+        assert srv.scheduler.stats()["rejected_by_reason"] == \
+            {"queue_full": 1}
+        sb.close()
+        write_frame(sa, KIND_CANCEL, b"")
+        sa.close()
+        _spin(lambda: srv.scheduler.running_count() == 0,
+              what="A released")
+    finally:
+        restore()
+        srv.shutdown()
+
+
 @pytest.fixture(scope="module")
 def spark_fixture_env(tmp_path_factory):
     """Small TPC-DS dataset + fixture plans + path rewrites, shared by the

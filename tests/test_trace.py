@@ -297,7 +297,11 @@ class TestMetricTree:
         # the DSL face renders the same tree
         text = df.explain(analyze=True)
         assert "output_rows=" in text and "elapsed_compute=" in text
-        assert text.count("\n") == len(nodes)
+        # one line per node + the per-query program-cache footer (the
+        # shared central cache means a query's hit rate is its OWN
+        # ledger's, surfaced here)
+        assert text.count("\n") == len(nodes) + 1
+        assert "[program cache] builds=" in text and "hit_rate=" in text
 
     def test_render_formats_and_totals(self):
         node = mt.MetricNode("sort", "SortOp", {"elapsed_compute": 2_500_000,

@@ -57,6 +57,13 @@ _PLANS = [
     ("lifecycle_pipeline", "memmgr.deny:deny@0.5"),
     ("lifecycle_pipeline",
      "cancel.race:cancel@0.2;task.hang:hang@0.1"),
+    # concurrency battery (the [serving] scheduler plane): three
+    # queries race one clamped Session under admission denies and
+    # forced memory pressure — shed-not-crash, identical-or-classified,
+    # clean ledger per run
+    ("overload", "sched.admit:deny@0.5"),
+    ("overload", "memmgr.deny:deny@0.4"),
+    ("overload", "sched.admit:deny@0.3;memmgr.deny:deny@0.3"),
 ]
 
 _FAST_SEEDS = (1, 2)

@@ -57,6 +57,11 @@ PLANS = [
     ("lifecycle_pipeline", "task.hang:hang@0.15"),
     ("lifecycle_pipeline", "memmgr.deny:deny@0.5"),
     ("lifecycle_pipeline", "cancel.race:cancel@0.2;task.hang:hang@0.1"),
+    # concurrency battery (the [serving] scheduler plane): admission
+    # denies + forced memory pressure against racing queries
+    ("overload", "sched.admit:deny@0.5"),
+    ("overload", "memmgr.deny:deny@0.4"),
+    ("overload", "sched.admit:deny@0.3;memmgr.deny:deny@0.3"),
 ]
 
 
@@ -66,7 +71,7 @@ def lifecycle_summary() -> dict:
     histogram the acceptance gate reads), stall detections, and
     degradation-ladder rung counts."""
     out = {"cancel_latency_s": {}, "stall_detections": 0,
-           "pressure_rungs": {}}
+           "pressure_rungs": {}, "admission_sheds": {}}
     try:
         from auron_tpu.obs import registry as obs_registry
         snap = obs_registry.get_registry().snapshot()
@@ -82,6 +87,10 @@ def lifecycle_summary() -> dict:
                 rung = key.split('rung="')[1].rstrip('"}') \
                     if 'rung="' in key else "?"
                 out["pressure_rungs"][rung] = int(val)
+            elif key.startswith("auron_sched_rejected_total"):
+                reason = key.split('reason="')[1].rstrip('"}') \
+                    if 'reason="' in key else "?"
+                out["admission_sheds"][reason] = int(val)
     except Exception:
         pass
     try:
@@ -173,9 +182,10 @@ def print_table(report: dict) -> None:
                   f"runs={s['runs']:<4d} recovery: {rec}")
     life = report.get("lifecycle") or {}
     if life.get("cancel_latency_s") or life.get("stall_detections") \
-            or life.get("pressure_rungs"):
+            or life.get("pressure_rungs") or life.get("admission_sheds"):
         print()
-        print("lifecycle (cancel latency / stalls / pressure rungs)")
+        print("lifecycle (cancel latency / stalls / pressure rungs / "
+              "admission sheds)")
         for kind, p in sorted(life.get("cancel_latency_s", {}).items()):
             print(f"  cancel->unwind [{kind:9s}]  n={p['count']:<4d} "
                   f"p50={p['p50']*1000:.1f}ms p99={p['p99']*1000:.1f}ms")
@@ -184,6 +194,10 @@ def print_table(report: dict) -> None:
                           sorted(life.get("pressure_rungs", {}).items())) \
             or "-"
         print(f"  degradation rungs taken: {rungs}")
+        sheds = ", ".join(f"{k}x{v}" for k, v in
+                          sorted(life.get("admission_sheds", {}).items())) \
+            or "-"
+        print(f"  admission sheds: {sheds}")
     for f in report["failures"]:
         print(f"CONTRACT BROKEN: {f['scenario']} plan={f['plan']!r} "
               f"seed={f['seed']} trace={f.get('trace_id', 0)} -> "
@@ -197,7 +211,8 @@ def main(argv=None) -> int:
                     help="seeds per (scenario, plan) pair")
     ap.add_argument("--scenario", choices=["rss_pipeline", "spill_sort",
                                            "agg_pipeline",
-                                           "lifecycle_pipeline"],
+                                           "lifecycle_pipeline",
+                                           "overload"],
                     default=None)
     args = ap.parse_args(argv)
 

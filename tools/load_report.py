@@ -1,0 +1,234 @@
+"""Concurrent-serving load report: drive M clients against AuronServer.
+
+The measurement half of the [serving] scheduler plane: spin an
+in-process ``AuronServer`` (admission control armed via the
+``auron.sched.*`` knobs), hammer it with M concurrent clients x R
+requests each, and print the admission/shed/latency table the PERF.md
+"Concurrent serving" section quotes:
+
+- serial baseline wall vs concurrent wall → the aggregate-vs-serial
+  throughput ratio (the ROADMAP gate asks >= ~0.8x of serial);
+- admission outcomes: ok / rejected-by-reason / cancelled, straight
+  from the server scheduler's registry-independent counters;
+- latency p50/p99 of successful requests and the scheduler's observed
+  queue-wait p50/p99;
+- an overload arm: clients sized at 2x the concurrency + queue budget
+  MUST produce rejections (shed-not-crash) — the report fails loudly
+  when overload produced zero sheds, because that means the admission
+  door was not actually exercised.
+
+    python tools/load_report.py                      # defaults
+    python tools/load_report.py --clients 8 --requests 4 \
+        --max-concurrent 2 --queue-depth 2
+
+The last stdout line is one JSON record (the bench.py/chaos_report.py
+driver contract)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _dataset(root: str, rows: int):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(7)
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, 64, rows), pa.int64()),
+        "v": pa.array(rng.normal(size=rows), pa.float64())})
+    path = os.path.join(root, "load.parquet")
+    pq.write_table(tbl, path)
+    return path
+
+
+def _task_bytes(path: str):
+    from auron_tpu.ir import pb
+    col = lambda i: pb.ExprNode(column=pb.ColumnRefE(index=i))
+    plan = pb.PlanNode(agg=pb.AggNode(
+        child=pb.PlanNode(parquet_scan=pb.ParquetScanNode(files=[path])),
+        mode="complete", group_exprs=[col(0)],
+        aggs=[pb.AggFunctionP(fn="sum", arg=col(1)),
+              pb.AggFunctionP(fn="count", arg=col(1))]))
+    return pb.TaskDefinition(plan=plan, task_id=1).SerializeToString()
+
+
+def _pct(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(int(p * len(sorted_vals)),
+                           len(sorted_vals) - 1)]
+
+
+def _drive(addr, task, n_requests, outcomes, lock):
+    from auron_tpu.runtime.serving import AuronClient
+    client = AuronClient(*addr, timeout_s=120)
+    for _ in range(n_requests):
+        t0 = time.perf_counter()
+        try:
+            client.execute(task)
+            kind = "ok"
+        except RuntimeError as e:
+            kind = ("rejected" if "AdmissionRejected" in str(e)
+                    else "error")
+        except Exception:   # noqa: BLE001 — tally, don't crash the driver
+            kind = "error"
+        with lock:
+            outcomes.append((kind, time.perf_counter() - t0))
+
+
+def run_load(clients: int, requests: int, max_concurrent: int,
+             queue_depth: int, rows: int) -> dict:
+    from auron_tpu import config as cfg
+    from auron_tpu.runtime.serving import AuronServer
+    conf = cfg.get_config()
+    conf.set(cfg.SCHED_MAX_CONCURRENT, max_concurrent)
+    conf.set(cfg.SCHED_QUEUE_DEPTH, queue_depth)
+    root = tempfile.mkdtemp(prefix="auron_load_")
+    try:
+        path = _dataset(root, rows)
+        task = _task_bytes(path)
+        srv = AuronServer()
+        srv.serve_background()
+        try:
+            lock = threading.Lock()
+            # warm compiles so the serial/concurrent comparison is fair
+            warm: list = []
+            _drive(srv.address, task, 1, warm, lock)
+            if warm[0][0] != "ok":
+                raise SystemExit("load_report: warmup request failed")
+
+            # serial baseline: the same total request count, one at a
+            # time through one client
+            serial: list = []
+            t0 = time.perf_counter()
+            _drive(srv.address, task, clients * requests, serial, lock)
+            serial_wall = time.perf_counter() - t0
+            serial_ok = sum(1 for k, _ in serial if k == "ok")
+
+            # concurrent storm
+            before = srv.scheduler.stats()
+            outcomes: list = []
+            threads = [threading.Thread(
+                target=_drive,
+                args=(srv.address, task, requests, outcomes, lock),
+                daemon=True) for _ in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            wedged = 0
+            for t in threads:
+                t.join(300)
+                if t.is_alive():
+                    wedged += 1
+            conc_wall = time.perf_counter() - t0
+            st = srv.scheduler.stats()
+
+            oks = sorted(lat for k, lat in outcomes if k == "ok")
+            n_ok = len(oks)
+            n_rej = sum(1 for k, _ in outcomes if k == "rejected")
+            # tally against the EXPECTED request count: a wedged
+            # client's missing outcomes register as errors — a dropped
+            # thread must fail the report, not flatter its table
+            n_err = clients * requests - n_ok - n_rej
+            # aggregate throughput ratio: completed requests per second,
+            # concurrent vs serial (rejected requests completed NOTHING
+            # — shedding must not flatter the ratio)
+            serial_rps = serial_ok / serial_wall if serial_wall else 0.0
+            conc_rps = n_ok / conc_wall if conc_wall else 0.0
+            return {
+                "clients": clients,
+                "requests_per_client": requests,
+                "max_concurrent": max_concurrent,
+                "queue_depth": queue_depth,
+                "input_rows": rows,
+                "serial": {"ok": serial_ok,
+                           "wall_s": round(serial_wall, 3),
+                           "req_per_sec": round(serial_rps, 2)},
+                "concurrent": {
+                    "ok": n_ok, "rejected": n_rej, "error": n_err,
+                    "wall_s": round(conc_wall, 3),
+                    "req_per_sec": round(conc_rps, 2),
+                    "latency_p50_s": round(_pct(oks, 0.50), 4),
+                    "latency_p99_s": round(_pct(oks, 0.99), 4),
+                },
+                "throughput_ratio_vs_serial": round(
+                    conc_rps / serial_rps, 3) if serial_rps else 0.0,
+                "sched": {
+                    "rejected_by_reason": {
+                        k: v - before["rejected_by_reason"].get(k, 0)
+                        for k, v in st["rejected_by_reason"].items()},
+                    "dequeued_by_reason": st["dequeued_by_reason"],
+                    "queue_wait_p50_s": st["queue_wait_p50_s"],
+                    "queue_wait_p99_s": st["queue_wait_p99_s"],
+                },
+                "wedged_clients": wedged,
+                "server_stats": dict(srv.stats),
+            }
+        finally:
+            srv.shutdown()
+    finally:
+        conf.unset(cfg.SCHED_MAX_CONCURRENT)
+        conf.unset(cfg.SCHED_QUEUE_DEPTH)
+        import shutil
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", type=int, default=8,
+                    help="concurrent client threads (default 8)")
+    ap.add_argument("--requests", type=int, default=3,
+                    help="requests per client (default 3)")
+    ap.add_argument("--max-concurrent", type=int, default=2,
+                    help="auron.sched.max_concurrent for the run")
+    ap.add_argument("--queue-depth", type=int, default=2,
+                    help="auron.sched.queue_depth for the run")
+    ap.add_argument("--rows", type=int, default=200_000,
+                    help="rows in the driven aggregation (default 200k)")
+    ap.add_argument("--expect-shed", action="store_true",
+                    help="fail (exit 1) when the overload produced ZERO "
+                         "rejections — the admission door went untested")
+    args = ap.parse_args(argv)
+
+    rep = run_load(args.clients, args.requests, args.max_concurrent,
+                   args.queue_depth, args.rows)
+    c, s = rep["concurrent"], rep["serial"]
+    print(f"load report: {args.clients} clients x {args.requests} req, "
+          f"max_concurrent={args.max_concurrent} "
+          f"queue_depth={args.queue_depth}")
+    print(f"  serial    : {s['ok']} ok in {s['wall_s']}s "
+          f"({s['req_per_sec']} req/s)")
+    print(f"  concurrent: {c['ok']} ok / {c['rejected']} rejected / "
+          f"{c['error']} error in {c['wall_s']}s "
+          f"({c['req_per_sec']} req/s)")
+    print(f"  throughput ratio vs serial: "
+          f"{rep['throughput_ratio_vs_serial']}x")
+    print(f"  latency p50/p99: {c['latency_p50_s']}s / "
+          f"{c['latency_p99_s']}s ; queue wait p50/p99: "
+          f"{rep['sched']['queue_wait_p50_s']}s / "
+          f"{rep['sched']['queue_wait_p99_s']}s")
+    print(f"  sheds by reason: {rep['sched']['rejected_by_reason']}")
+    rc = 0
+    if args.expect_shed and c["rejected"] == 0:
+        print("  FAIL: overload produced no rejections — admission "
+              "control untested at this load")
+        rc = 1
+    if c["error"]:
+        print(f"  FAIL: {c['error']} requests died UNCLASSIFIED "
+              "(neither DONE nor AdmissionRejected)")
+        rc = 1
+    print(json.dumps(rep))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -185,7 +185,14 @@ def run_smoke(baseline: dict) -> dict:
     at a tiny scale and compare against the generous smoke floor — an
     order-of-magnitude tripwire (compile-cache regressions, accidental
     per-row host loops) cheap enough for a test to invoke every run,
-    so throughput can't silently decay between bench rounds again."""
+    so throughput can't silently decay between bench rounds again.
+
+    Doubles as the CONCURRENCY-TAX gate: every query now enters the
+    scheduler (admission + fairness bookkeeping), and this mode asserts
+    the solo-query path pays < 2% of wall for it. Measured from the
+    slot's own overhead ledger (time INSIDE acquire/turn/release, not
+    policy waits) against the best run's wall — a deterministic ratio,
+    immune to the container's wall-clock noise that plagues A/B runs."""
     import tempfile
     import time
 
@@ -195,26 +202,39 @@ def run_smoke(baseline: dict) -> dict:
     from auron_tpu.it.tpcds_data import generate as gen_data
     smoke = baseline.get("smoke", {})
     floor = float(smoke.get("cpu_floor_rows_per_sec", 20000.0))
+    tax_limit = float(smoke.get("sched_tax_limit_pct", 2.0))
     data = tempfile.mkdtemp(prefix="auron_perf_smoke_")
     try:
         tables = gen_data(data, scale=scale)
         from bench import _table_rows
         rows = _table_rows(tables["store_sales"])
         q01_dataframe(Session(), tables).collect()   # warm compiles
-        wall = float("inf")
+        wall, tax_ns = float("inf"), 0
         for _ in range(2):
+            s = Session()
             t0 = time.perf_counter()
-            q01_dataframe(Session(), tables).collect()
-            wall = min(wall, time.perf_counter() - t0)
+            q01_dataframe(s, tables).collect()
+            w = time.perf_counter() - t0
+            if w < wall:
+                wall, tax_ns = w, s._scheduler.last_overhead_ns
         value = rows / wall
-        return {
+        tax_pct = tax_ns / (wall * 1e9) * 100.0
+        verdict = {
             "perf_gate": "pass" if value >= floor else "fail",
             "mode": "smoke",
             "scale": scale,
             "input_rows": rows,
             "value_rows_per_sec": round(value, 1),
             "floor_rows_per_sec": round(floor, 1),
+            "sched_tax_pct": round(tax_pct, 4),
+            "sched_tax_limit_pct": tax_limit,
         }
+        if tax_pct >= tax_limit:
+            verdict["perf_gate"] = "fail"
+            verdict["reason"] = (
+                f"scheduler tax {tax_pct:.3f}% >= {tax_limit}% of the "
+                f"solo-query wall (concurrency-tax gate)")
+        return verdict
     finally:
         import shutil
         shutil.rmtree(data, ignore_errors=True)
@@ -246,7 +266,9 @@ def main(argv=None) -> int:
         verdict = run_smoke(baseline)
         print(f"perf gate [smoke @ scale {verdict['scale']}]: "
               f"{verdict['value_rows_per_sec']:,.0f} rows/s vs floor "
-              f"{verdict['floor_rows_per_sec']:,.0f} → "
+              f"{verdict['floor_rows_per_sec']:,.0f}, sched tax "
+              f"{verdict['sched_tax_pct']:.3f}% (limit "
+              f"{verdict['sched_tax_limit_pct']:.0f}%) → "
               f"{verdict['perf_gate'].upper()}")
         print(json.dumps(verdict))
         return 0 if verdict["perf_gate"] == "pass" else 1
