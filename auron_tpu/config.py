@@ -119,6 +119,39 @@ SCAN_PREFETCH_BATCHES = _opt(
     "<= 1 keeps the decode worker but no lookahead beyond the batch "
     "in flight.")
 
+# SPMD mesh execution plane (parallel/mesh.py)
+MESH_ENABLED = _opt(
+    "auron.mesh.enabled", bool, False,
+    "SPMD execution plane (parallel/mesh.py): Session init builds a "
+    "jax Mesh/NamedSharding layout over the visible devices and eligible "
+    "hash-repartition exchanges lower to the on-device "
+    "lax.all_to_all stage program (parallel/mesh_exchange.py) — the "
+    "fused stage chain, the partition-id compute, the sort-by-pid split "
+    "and the collective run as ONE shard_map program partition-parallel "
+    "across all mesh devices, fencing once at the output boundary. "
+    "Ineligible exchanges (range/round-robin/single partitioning, fan-in "
+    "wider than the mesh) keep the host-orchestrated device-buffer path; "
+    "RSS stays the durable/multihost tier. The route taken is recorded "
+    "per exchange in the metric tree (exchange_route_* counters) and the "
+    "trace ('mesh' category exchange.route events — "
+    "tools/mesh_report.py). PROCESS-GLOBAL by contract (the device set "
+    "is process state, like auron.pipeline.enabled): resolved from "
+    "get_config(), per-Session overrides are not honored. Default off; "
+    "tests/bench force a virtual CPU mesh via "
+    "--xla_force_host_platform_device_count.")
+MESH_DEVICES = _opt(
+    "auron.mesh.devices", int, 0,
+    "Devices in the SPMD mesh; 0 (default) = every device jax exposes. "
+    "An exchange with num_partitions <= this width runs on the leading "
+    "submesh of exactly num_partitions devices (one output partition "
+    "per device — the all-to-all's square contract); wider exchanges "
+    "fall back to the host device-buffer route, recorded per exchange.")
+MESH_AXIS = _opt(
+    "auron.mesh.axis", str, "data",
+    "Name of the mesh's single batch-sharding axis (the PartitionSpec "
+    "axis scan batches shard over; broadcast relations and hash-table "
+    "build sides replicate — parallel/mesh.buffer_spec).")
+
 # concurrent query scheduler (runtime/scheduler.py)
 SCHED_MAX_CONCURRENT = _opt(
     "auron.sched.max_concurrent", int, 4,
@@ -393,8 +426,8 @@ TRACE_DIR = _opt(
 TRACE_EVENTS = _opt(
     "auron.trace.events", str, "",
     "Comma-separated span-category allowlist (query, task, program, "
-    "shuffle, spill, fault, watchdog, memory, sched); empty records "
-    "every category. "
+    "shuffle, spill, fault, watchdog, memory, sched, mesh); empty "
+    "records every category. "
     "Narrowing the list bounds tracing overhead on hot paths — e.g. "
     "'task,shuffle,fault' drops the per-hit program events.")
 TRACE_MAX_SPANS = _opt(

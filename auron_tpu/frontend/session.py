@@ -42,6 +42,14 @@ class Session:
         from auron_tpu.runtime import watchdog
         watchdog.ensure_backend(self.config)
         watchdog.first_compile_probe(self.config)
+        # SPMD mesh plane (parallel/mesh.py): resolved EAGERLY at Session
+        # init so the device layout exists before the first plan. The
+        # plane is process-global by the knob's contract — consumers
+        # (annotate_mesh, ExecContext.mesh_plane, exchange routing) all
+        # resolve mesh.current_plane() themselves, so nothing is stored
+        # per Session.
+        from auron_tpu.parallel import mesh as _mesh
+        _mesh.current_plane()
         self.ctx = PlannerContext(batch_capacity=batch_capacity,
                                   config=self.config)
         self.mem_manager = mem_manager

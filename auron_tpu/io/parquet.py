@@ -226,6 +226,9 @@ class ParquetScanOp(PhysicalOp):
     name = "parquet_scan"
     #: pyarrow.dataset format — OrcScanOp subclasses with "orc"
     _format = "parquet"
+    #: SPMD layout: scan output shards on the batch dim (one map
+    #: partition per mesh device — parallel/mesh.buffer_spec)
+    mesh_buffer_kind = "scan_batch"
 
     def __init__(self, files: list[str], schema: Optional[Schema] = None,
                  columns: Optional[list[str]] = None,
@@ -402,6 +405,7 @@ class MemoryScanOp(PhysicalOp):
     """In-memory source (tests and broadcast-side plumbing)."""
 
     name = "memory_scan"
+    mesh_buffer_kind = "scan_batch"   # SPMD layout: shard on batch dim
 
     def __init__(self, partitions: list[list[pa.RecordBatch]], schema: Schema,
                  capacity: int = DEFAULT_BATCH_CAPACITY,
@@ -437,6 +441,9 @@ class DeviceBatchScanOp(PhysicalOp):
     #: replays stored batches (broadcast builds, resource maps) that
     #: later readers share — consumers must never donate them
     owns_output = False
+    #: SPMD layout: replayed shared batches behave like broadcast
+    #: relations — every shard reads them whole
+    mesh_buffer_kind = "broadcast"
 
     def __init__(self, partitions, schema: Schema):
         self.partitions = partitions  # list[list[DeviceBatch]] or callable

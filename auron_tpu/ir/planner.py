@@ -78,9 +78,12 @@ class PhysicalPlanner:
         return self.finalize_plan(self.create_plan(task.plan))
 
     def finalize_plan(self, op: PhysicalOp) -> PhysicalOp:
-        """Post-planning passes over the materialized operator tree —
-        currently whole-stage fusion (fuse_stages)."""
-        return fuse_stages(op, self.ctx.config)
+        """Post-planning passes over the materialized operator tree:
+        whole-stage fusion (fuse_stages), then the SPMD mesh annotation
+        (annotate_mesh — a no-op while auron.mesh.enabled is off)."""
+        from auron_tpu.parallel import mesh as mesh_mod
+        return annotate_mesh(fuse_stages(op, self.ctx.config),
+                             mesh_mod.current_plane())
 
     def create_plan(self, node: pb.PlanNode) -> PhysicalOp:
         kind = node.WhichOneof("node")
@@ -687,6 +690,57 @@ def _push_agg_projection(op: PhysicalOp) -> PhysicalOp:
         # must never reach execution
         return op
     return rewritten
+
+
+# ---------------------------------------------------------------------------
+# SPMD mesh annotation pass
+# ---------------------------------------------------------------------------
+
+def annotate_mesh(op: PhysicalOp, plane) -> PhysicalOp:
+    """Stamp each node's resolved SPMD spec (``op.mesh_spec``) when the
+    mesh plane is active:
+
+    - eligible hash exchanges become ``"gang"`` — their materialization
+      occupies the whole mesh (parallel/exchange._materialize_mesh);
+    - nodes declaring a buffer kind (``mesh_buffer_kind``) resolve
+      through the replicate-vs-shard table (parallel/mesh.buffer_spec):
+      broadcast relations and hash-join build sides ``"replicate"``
+      (every shard reads them whole), scan batches / shuffle entries /
+      partial-agg rows ``"shard"`` on the batch dim;
+    - everything else shards (the default — throughput scales with
+      devices; replication is the exception).
+
+    The annotation is the static half of the routing contract — the
+    runtime decision (exchange_route at materialize time) re-derives it
+    from the same pure function, so the plan a user inspects and the
+    route the engine takes can never disagree."""
+    if plane is None:
+        return op
+    _annotate_mesh(op, plane)
+    return op
+
+
+def _annotate_mesh(op: PhysicalOp, plane) -> None:
+    from auron_tpu.ops.joins import HashJoinOp
+    from auron_tpu.parallel import mesh as mesh_mod
+    from auron_tpu.parallel.exchange import ShuffleExchangeOp
+    if isinstance(op, ShuffleExchangeOp):
+        route, _reason = mesh_mod.exchange_route(
+            op.partitioning, op.num_partitions, op.input_partitions,
+            plane)
+        op.mesh_spec = "gang" if route == "all_to_all" else "shard"
+    else:
+        op.mesh_spec = mesh_mod.buffer_spec(op.mesh_buffer_kind)
+    for c in op.children:
+        _annotate_mesh(c, plane)
+    if isinstance(op, HashJoinOp) and op.build.mesh_spec != "gang":
+        # the build side replicates: every probe shard reads the full
+        # build relation (the join declares the kind — mesh_build_kind
+        # — so the decision stays in the replicate-vs-shard table). A
+        # gang-annotated build exchange keeps its stamp: the exchange
+        # itself is mesh-routed; it is the COLLECTED hash table that
+        # replicates.
+        op.build.mesh_spec = mesh_mod.buffer_spec(op.mesh_build_kind)
 
 
 def _collect_subqueries(msg) -> list:

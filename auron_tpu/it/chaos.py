@@ -259,6 +259,50 @@ def agg_pipeline(workdir: str) -> Scenario:
     return Scenario("agg_pipeline", run, [])
 
 
+def mesh_pipeline(workdir: str) -> Scenario:
+    """SPMD chaos scenario: the agg_pipeline shape (Session-planned
+    two-phase aggregation) with ``auron.mesh.enabled`` on, so the hash
+    exchange rides the on-device all-to-all stage program — the
+    ``device.compute`` site fires both per output batch in the drive
+    loop AND per all-to-all round inside the sharded-stage
+    materialization. A fault mid-exchange must classify cleanly (the
+    gang releases, the mesh buffer unregisters, the task retries or
+    surfaces the verdict); RSS stays untouched as the durable fallback
+    tier, which is exactly what this scenario proves out."""
+    from auron_tpu.frontend.dataframe import col, functions as F
+    from auron_tpu.frontend.session import Session
+    from auron_tpu.parallel import mesh as mesh_mod
+
+    table = pa.Table.from_batches([_rows(1024, seed=41 + i)
+                                   for i in range(4)])
+
+    def run() -> pa.Table:
+        conf = cfg.get_config()
+        _missing = object()
+        saved = conf._overrides.get(cfg.MESH_ENABLED, _missing)
+        conf.set(cfg.MESH_ENABLED, True)
+        try:
+            # <2 devices is still a valid run — the exchange routes
+            # device_buffer and records why; the battery contract
+            # (identical-or-classified) holds on either route
+            _ = mesh_mod.current_plane()
+            s = Session()
+            df = (s.from_arrow(table)
+                  .repartition(4, "k")
+                  .filter(col("c") > 50)
+                  .group_by("k")
+                  .agg(F.sum(col("v")).alias("sv"),
+                       F.count(col("c")).alias("n")))
+            return _canonical(s.execute(df))
+        finally:
+            if saved is _missing:
+                conf.unset(cfg.MESH_ENABLED)
+            else:
+                conf.set(cfg.MESH_ENABLED, saved)
+
+    return Scenario("mesh_pipeline", run, [])
+
+
 def lifecycle_pipeline(workdir: str) -> Scenario:
     """Chaos 2.0 lifecycle scenario: a Session-planned sort+agg under a
     tiny memory budget so spills/memmgr traffic is guaranteed, run with
@@ -457,6 +501,7 @@ SCENARIOS: dict[str, Callable[[str], Scenario]] = {
     "rss_pipeline": rss_pipeline,
     "spill_sort": spill_sort,
     "agg_pipeline": agg_pipeline,
+    "mesh_pipeline": mesh_pipeline,
     "lifecycle_pipeline": lifecycle_pipeline,
     "overload": overload,
 }

@@ -46,12 +46,13 @@ def _dim(session, tables, name):
 # q01: scan → filter → two-phase agg → sort  (the flagship q01 shape)
 # --------------------------------------------------------------------------
 
-def q01_dataframe(s, t):
+def q01_dataframe(s, t, partitions=4):
     """The q01 DataFrame WITHOUT collecting — shared by the e2e query
-    below and the bench's profiled explain-analyze section
-    (bench.bench_profile_q01), so the profiled plan can never drift from
-    the differential-tested one."""
-    return (_sales(s, t)
+    below, the bench's profiled explain-analyze section
+    (bench.bench_profile_q01) and the mesh scaling bench
+    (bench._mesh_child_main, which sweeps ``partitions`` across device
+    counts), so every profiled plan is the differential-tested one."""
+    return (_sales(s, t, partitions=partitions)
             .filter(col("ss_quantity") > 5)
             .group_by("ss_store_sk")
             .agg(F.sum(col("ss_sales_price")).alias("total"),

@@ -44,7 +44,7 @@ from typing import NamedTuple, Optional
 
 #: span categories (the auron.trace.events allowlist vocabulary)
 CATEGORIES = ("query", "task", "program", "shuffle", "spill", "fault",
-              "watchdog", "memory", "sched")
+              "watchdog", "memory", "sched", "mesh")
 
 _SPAN_IDS = itertools.count(1)     # next() is GIL-atomic
 _TRACE_IDS = itertools.count(1)

@@ -1174,6 +1174,11 @@ class AggOp(PhysicalOp):
         #: plan-time promise, verified at runtime: out-of-range or NULL
         #: keys fail the task with a deterministic ValueError.
         self.key_domain = key_domain
+        #: SPMD layout (parallel/mesh.buffer_spec): a partial agg's
+        #: state rows shard on the batch dim — they are exactly what a
+        #: mesh-routed exchange moves through the all-to-all (the
+        #: map-side-combine-before-exchange shape)
+        self.mesh_buffer_kind = "agg_partial" if mode == "partial" else None
         in_schema = child.schema()
 
         if mode == "final":

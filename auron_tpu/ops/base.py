@@ -298,6 +298,20 @@ class ExecContext:
         return cached
 
     @property
+    def mesh_plane(self):
+        """The process's SPMD mesh plane (parallel/mesh.current_plane),
+        resolved once per context like ``pipelined`` — None when
+        ``auron.mesh.enabled`` is off or fewer than 2 devices exist.
+        PROCESS-GLOBAL by the knob's contract (the device set is
+        process state)."""
+        cached = getattr(self, "_mesh_plane", None)
+        if cached is None:
+            from auron_tpu.parallel import mesh
+            cached = (mesh.current_plane(),)
+            self._mesh_plane = cached
+        return cached[0]
+
+    @property
     def pipelined(self) -> bool:
         """auron.pipeline.enabled resolved once per context — from the
         PROCESS-GLOBAL config by the knob's contract (sync points must
@@ -383,6 +397,19 @@ class PhysicalOp:
     #: unfused operators do host-side for free, so the fusion pass only
     #: creates stages containing at least one computing member.
     fragment_computes: bool = False
+
+    #: SPMD layout declaration (parallel/mesh.buffer_spec): what KIND of
+    #: buffer this op's output is, for the replicate-vs-shard decision —
+    #: "broadcast"/"hash_build" replicate across the mesh, "scan_batch"/
+    #: "shuffle_entry"/"agg_partial" shard on the batch dim. None = no
+    #: declared kind (shards by default). The planner's annotate_mesh
+    #: pass resolves this into ``mesh_spec`` on each node.
+    mesh_buffer_kind: Optional[str] = None
+
+    #: resolved sharding spec ("replicate" | "shard" | "gang"), stamped
+    #: by ir/planner.annotate_mesh when the mesh plane is active; "gang"
+    #: marks an exchange whose materialization occupies the whole mesh
+    mesh_spec: Optional[str] = None
 
     #: may a consumer destroy (donate to XLA) the batches execute() yields?
     #: True for ops that construct fresh device arrays per output batch;

@@ -94,6 +94,31 @@ def thread_fragments(fragments, batch: DeviceBatch, partition_id, carries):
     return outs, new_carries
 
 
+def sharded_fragment_chain(fragments: list[KernelFragment]):
+    """The SPMD form of a fused stage body (parallel/mesh_exchange):
+    a traced function running the member chain on ONE mesh shard's
+    local batch, with the member carries threaded as an
+    ``int64[n_members]`` vector (each shard owns its map partition's
+    carries — exactly the per-partition streaming state the unfused
+    host loop keeps per ``execute(partition)`` call).
+
+    ``apply(batch, partition_id, carry_vec) -> (out_batch, carry_vec')``
+
+    Only straight chains qualify (fan-out members and fused limits are
+    rejected by the exchange's eligibility check before tracing):
+    a sharded stage yields exactly one output batch per shard."""
+
+    def apply(batch: DeviceBatch, partition_id, carry_vec):
+        outs, new_carries = thread_fragments(
+            fragments, batch, partition_id,
+            [carry_vec[i] for i in range(len(fragments))])
+        (b,) = outs   # eligibility rejected fan-out chains
+        return b, (jnp.stack(new_carries) if new_carries
+                   else jnp.zeros((0,), jnp.int64))
+
+    return apply
+
+
 def build_stage_kernel(fragments: list[KernelFragment],
                        donate: bool = False):
     """Compose member fragments into one jitted program. ``donate``

@@ -235,6 +235,12 @@ class HashJoinOp(PhysicalOp):
 
     name = "hash_join"
 
+    #: SPMD layout contract (ir/planner.annotate_mesh → parallel/mesh
+    #: buffer_spec): the build side REPLICATES across the mesh — every
+    #: probe shard reads the full relation, so a sharded probe stage
+    #: never exchanges build rows; probe batches shard on the batch dim.
+    mesh_build_kind = "hash_build"
+
     def __init__(self, probe: PhysicalOp, build: PhysicalOp,
                  probe_keys: list[ir.Expr], build_keys: list[ir.Expr],
                  join_type: str = "inner"):

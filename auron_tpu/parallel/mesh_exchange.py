@@ -43,6 +43,136 @@ def make_mesh(num_devices: int | None = None, axis: str = "data") -> Mesh:
     return Mesh(np.array(devs[:n]), (axis,))
 
 
+# ---------------------------------------------------------------------------
+# sharded stage-exchange program (the SPMD execution plane's workhorse)
+# ---------------------------------------------------------------------------
+
+#: central compile site for the sharded stage programs: the fused member
+#: chain (when the exchange folded one), the partition-id compute, the
+#: sort-by-pid split and the all-to-all collective in ONE shard_map
+#: program — the whole map side of a shuffle runs partition-parallel
+#: across the mesh with no host round-trip between its steps
+from auron_tpu.runtime import programs as _programs
+
+_STAGE_EXCHANGE_PROGRAMS = _programs.register(
+    _programs.ProgramCache("parallel.mesh_exchange.stage", maxsize=128))
+
+
+def stage_exchange_program(mesh: Mesh, axis: str, n_dev: int,
+                           frag_keys: tuple, part_key: tuple,
+                           in_schema, out_schema, capacity: int,
+                           quota: int, fragments, part_exprs):
+    """Central-registry lookup of the sharded stage-exchange program for
+    one (chain signature, hash keys, schema, capacity, quota) class.
+    Returns ``(kernel, built)``.
+
+    The program NEVER donates its inputs: a bucket overflowing the row
+    quota triggers the one-shot host-side re-run at the exact needed
+    pow2 quota (the ``exchange_device_batches`` contract), and a donated
+    input would be poisoned for that re-run — the donate sweep from the
+    pipelined-execution work must not reach across the exchange
+    (``yields_owned_batches`` notwithstanding).
+
+    Kernel signature (all global, batch-dim sharded on ``axis`` unless
+    noted)::
+
+        kernel(columns, num_rows, carries) ->
+            (out_columns, recv_counts, out_num_rows, global_max, carries')
+
+    - ``columns``: the stacked input batch's column pytree, every leaf
+      ``[n_dev * capacity, ...]`` (shard i = map partition i's rows);
+    - ``num_rows``: ``int32[n_dev]`` live rows per shard;
+    - ``carries``: ``int64[n_dev, n_frags]`` per-shard member carries;
+    - ``out_columns``: received rows, shard p = reducer partition p; row
+      layout per shard is ``[src * quota + r]`` (source-major, original
+      row order within a source — NOT compacted, so the reducer can
+      slice per source and preserve the host path's map-major order);
+    - ``recv_counts``: ``int32[n_dev * n_dev]``, shard p's row = rows
+      received from each source;
+    - ``global_max``: REPLICATED int32 — the global largest bucket, the
+      host's one output-boundary readback: rows were dropped iff it
+      exceeds ``quota``, and its value is the exact quota the single
+      re-run needs.
+    """
+    key = (frag_keys, part_key, in_schema, out_schema, n_dev, capacity,
+           quota, axis)
+
+    def build():
+        from auron_tpu.columnar.batch import DeviceBatch, gather_batch
+        from auron_tpu.exprs.eval import EvalContext, evaluate
+        from auron_tpu.ops import hashing
+        from auron_tpu.ops.fused import sharded_fragment_chain
+        chain = sharded_fragment_chain(fragments) if fragments else None
+        n_frags = len(fragments)
+
+        def local_fn(columns, num_rows, carries):
+            nr = num_rows[0]
+            batch = DeviceBatch(columns, nr)
+            # this device IS its map partition (maps assigned in order)
+            pid_dev = lax.axis_index(axis).astype(jnp.int32)
+            if chain is not None:
+                b, new_carry = chain(batch, pid_dev, carries[0])
+            else:
+                b, new_carry = batch, jnp.zeros((n_frags,), jnp.int64)
+            # partition ids on the chain output (Spark-exact pmod
+            # murmur3 — the HashPartitioning contract)
+            ctx = EvalContext()
+            cols = [evaluate(e, b, out_schema, ctx).col
+                    for e in part_exprs]
+            h = hashing.murmur3_columns(cols, b.capacity,
+                                        hashing.SPARK_SHUFFLE_SEED)
+            nn = jnp.int32(n_dev)
+            pids = ((h % nn) + nn) % nn
+            # stable sort-by-pid split (the buffered_data.rs compaction,
+            # exactly _split_body's shape — inlined because the bucket
+            # scatter below needs the sorted pid column too)
+            live = b.row_mask()
+            pid_key = jnp.where(live, pids, nn)
+            perm = jnp.argsort(pid_key, stable=True)
+            sorted_b = gather_batch(b, perm, b.num_rows)
+            sorted_pid = pid_key[perm]
+            counts = jax.ops.segment_sum(
+                live.astype(jnp.int32), jnp.clip(pid_key, 0, n_dev),
+                num_segments=n_dev + 1)[:n_dev]
+            offsets = jnp.cumsum(counts) - counts   # exclusive
+            max_count = jnp.max(counts).astype(jnp.int32)
+            cap_b = sorted_b.capacity
+            pos = jnp.arange(cap_b, dtype=jnp.int32)
+            tgt = jnp.clip(sorted_pid, 0, n_dev - 1)
+            slot = pos - offsets[tgt]
+            in_quota = (sorted_pid < nn) & (slot < quota)
+            flat_slot = jnp.where(in_quota, tgt * quota + slot,
+                                  n_dev * quota)
+            send_counts = jnp.minimum(counts, quota)
+
+            def send_recv(leaf):
+                buf = jnp.zeros((n_dev * quota,) + leaf.shape[1:],
+                                leaf.dtype)
+                buf = buf.at[flat_slot].set(leaf, mode="drop")
+                buf = buf.reshape((n_dev, quota) + leaf.shape[1:])
+                recv = lax.all_to_all(buf, axis, split_axis=0,
+                                      concat_axis=0, tiled=False)
+                return recv.reshape((n_dev * quota,) + leaf.shape[1:])
+
+            out_cols = jax.tree_util.tree_map(send_recv, sorted_b.columns)
+            recv_counts = lax.all_to_all(send_counts, axis, split_axis=0,
+                                         concat_axis=0, tiled=True)
+            out_nr = jnp.sum(recv_counts).astype(jnp.int32)
+            gmax = lax.pmax(max_count, axis)
+            return (out_cols, recv_counts, out_nr[None], gmax,
+                    new_carry[None, :])
+
+        in_specs = (P(axis), P(axis), P(axis, None))
+        out_specs = (P(axis), P(axis), P(axis), P(), P(axis, None))
+        # donation deliberately OFF (see docstring): programs.jit with
+        # no donate_argnums, on every backend
+        return _programs.jit(shard_map(local_fn, mesh=mesh,
+                                       in_specs=in_specs,
+                                       out_specs=out_specs))
+
+    return _STAGE_EXCHANGE_PROGRAMS.get_or_build(key, build)
+
+
 @program_cache("parallel.mesh_exchange.exchange", maxsize=64)
 def _exchange_fn(mesh: Mesh, n_cols: int, quota: int, axis: str):
     """Builds the jitted SPMD exchange for a given column arity and quota.

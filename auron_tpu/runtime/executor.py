@@ -302,6 +302,15 @@ class ExecutionRuntime:
         # number is how many fallbacks this process has taken in total
         rec["watchdog_fallbacks"] = _watchdog.totals()
         rec["faults_injected"] = _faults.totals() - self._faults_start
+        # SPMD plane occupancy (process-level like the watchdog number:
+        # the gang ledger spans queries by design — one slot = the mesh)
+        try:
+            from auron_tpu.parallel import mesh as _mesh
+            plane = _mesh.current_plane()
+            if plane is not None:
+                snap["mesh"] = plane.stats()
+        except Exception:   # pragma: no cover - observability only
+            pass
         if getattr(self, "profile_dir", None):
             op_times = {
                 op: vals["elapsed_compute"] * 1e-9   # counters are ns

@@ -480,7 +480,7 @@ class QueryScheduler:
     def stats(self) -> dict:
         """Registry-independent counter snapshot (load_report's table)."""
         with self._cond:
-            return {
+            out = {
                 "admitted": self._counts["admitted"],
                 "rejected": self._counts["rejected"],
                 "rejected_by_reason": dict(self._reject_reasons),
@@ -491,6 +491,19 @@ class QueryScheduler:
                 "queue_wait_p50_s": round(self._queue_wait_p(0.50), 4),
                 "queue_wait_p99_s": round(self._queue_wait_p(0.99), 4),
             }
+        # gang-slot accounting: a sharded stage occupies the WHOLE mesh
+        # (one slot = the mesh — parallel/mesh.MeshPlane.gang takes this
+        # scheduler's WRR turn on entry, so fairness operates BETWEEN
+        # sharded stages); surfaced here so load/mesh reports show the
+        # mesh occupancy next to the query-slot numbers
+        try:
+            from auron_tpu.parallel import mesh as _mesh
+            plane = _mesh.current_plane()
+            if plane is not None:
+                out["mesh_gang"] = plane.stats()
+        except Exception:   # pragma: no cover - stats are best-effort
+            pass
+        return out
 
     def running_count(self) -> int:
         with self._cond:

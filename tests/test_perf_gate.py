@@ -150,6 +150,87 @@ class TestPerfGate:
             assert v["pipeline"]["verdict"] == "missing", rec
             assert v["perf_gate"] == "fail", rec
 
+    def _healthy_mesh(self, base):
+        m = base["platforms"]["mesh"]
+        return {"mesh_rows_per_sec": m["rows_per_sec"],
+                "devices": m["devices"], "scale": m["scale"],
+                "scaling_factor": 0.9,
+                "route_all_to_all_by_devices": {"8": 1}}
+
+    def test_mesh_baseline_shape(self):
+        """The ISSUE 11 satellite: a 'mesh' platform entry (virtual
+        8-device CPU mesh q01 floor) exists and is well-formed."""
+        base = _baseline()
+        m = base["platforms"]["mesh"]
+        assert m["rows_per_sec"] > 0
+        assert m["devices"] == 8
+        assert m["tolerance_pct"] > 0
+
+    def test_mesh_floor_fails_seeded_regression(self):
+        """A seeded mesh-path throughput decay past the tolerance must
+        fail the gate even when every other floor is healthy — the
+        acceptance criterion's 'mesh perf_gate entry that fails on a
+        seeded regression'."""
+        base = _baseline()
+        cpu = base["platforms"]["cpu"]
+        m = base["platforms"]["mesh"]
+        mesh_rec = self._healthy_mesh(base)
+        mesh_rec["mesh_rows_per_sec"] = m["rows_per_sec"] \
+            * (1 - (m["tolerance_pct"] + 10) / 100)
+        rec = {"value": cpu["rows_per_sec"] * 1.2, "platform": "cpu",
+               "profile": _healthy_profile(base), "mesh": mesh_rec}
+        v = perf_gate.evaluate(rec, base, tolerance_pct=50.0)
+        assert v["mesh"]["verdict"] == "fail"
+        assert v["perf_gate"] == "fail"
+        # at-baseline mesh passes
+        rec["mesh"] = self._healthy_mesh(base)
+        v = perf_gate.evaluate(rec, base, tolerance_pct=50.0)
+        assert v["mesh"]["verdict"] == "pass"
+        assert v["perf_gate"] == "pass"
+
+    def test_mesh_errored_bench_fails_loudly(self):
+        """A bench that TRIED the mesh measurement and failed records
+        mesh_error — the gate fails (the silent-decay hole stays
+        closed); records predating the mesh bench skip, recorded."""
+        base = _baseline()
+        cpu = base["platforms"]["cpu"]["rows_per_sec"]
+        errored = {"value": cpu * 1.2, "platform": "cpu",
+                   "profile": _healthy_profile(base),
+                   "mesh_error": "no all_to_all route recorded"}
+        v = perf_gate.evaluate(errored, base, tolerance_pct=50.0)
+        assert v["mesh"]["verdict"] == "missing"
+        assert v["perf_gate"] == "fail"
+        legacy = {"value": cpu * 1.2, "platform": "cpu",
+                  "profile": _healthy_profile(base)}
+        v = perf_gate.evaluate(legacy, base, tolerance_pct=50.0)
+        assert v["mesh"]["verdict"] == "skipped"
+        assert v["perf_gate"] == "pass"
+        # a mesh section WITHOUT a usable value (interrupted child,
+        # renamed key) is the silent-decay mode — fail, not skip
+        hollow = {"value": cpu * 1.2, "platform": "cpu",
+                  "profile": _healthy_profile(base),
+                  "mesh": {"devices": 8, "scale": 2.0}}
+        v = perf_gate.evaluate(hollow, base, tolerance_pct=50.0)
+        assert v["mesh"]["verdict"] == "missing"
+        assert v["perf_gate"] == "fail"
+
+    def test_mesh_scale_or_devices_mismatch_skips_recorded(self):
+        base = _baseline()
+        cpu = base["platforms"]["cpu"]["rows_per_sec"]
+        mesh_rec = self._healthy_mesh(base)
+        mesh_rec["scale"] = mesh_rec["scale"] * 4
+        rec = {"value": cpu * 1.2, "platform": "cpu",
+               "profile": _healthy_profile(base), "mesh": mesh_rec}
+        v = perf_gate.evaluate(rec, base, tolerance_pct=50.0)
+        assert v["mesh"]["verdict"] == "skipped"
+        assert "scale" in v["mesh"]["reason"]
+        mesh_rec = self._healthy_mesh(base)
+        mesh_rec["devices"] = 4
+        rec["mesh"] = mesh_rec
+        v = perf_gate.evaluate(rec, base, tolerance_pct=50.0)
+        assert v["mesh"]["verdict"] == "skipped"
+        assert "devices" in v["mesh"]["reason"]
+
     def test_smoke_mode(self, capsys):
         """tools/perf_gate.py --smoke from tier-1: the in-process q01
         pipeline at tiny scale clears the generous smoke floor, the

@@ -475,3 +475,170 @@ class TestSessionIntegration:
         assert isinstance(results["a"],
                           (errors.QueryCancelled, pa.Table))
         assert s.active_queries() == {}
+
+
+# ---------------------------------------------------------------------------
+# mesh gang scheduling (ISSUE 11): one slot = the mesh
+# ---------------------------------------------------------------------------
+
+class TestMeshGang:
+    """A sharded stage occupies the WHOLE mesh (parallel/mesh.MeshPlane
+    .gang): mutual exclusion between queries' sharded stages, FIFO
+    ordering, cancel-aware waits, per-thread re-entrancy (exchange
+    above exchange), and the slot-accounting counters the scheduler's
+    stats() surfaces."""
+
+    def _plane(self):
+        from auron_tpu.parallel.mesh import MeshPlane
+        # the gang door is pure host scheduling — device objects are
+        # irrelevant to it, so a fake device list keeps the tests fast
+        return MeshPlane([object(), object()], axis="data")
+
+    def test_gang_mutual_exclusion_and_fifo(self):
+        plane = self._plane()
+        active = []
+        max_active = [0]
+        order = []
+        start = threading.Barrier(4)
+
+        def worker(i):
+            start.wait()
+            with plane.gang(CancelToken(f"g{i}")):
+                active.append(i)
+                max_active[0] = max(max_active[0], len(active))
+                order.append(i)
+                time.sleep(0.02)
+                active.remove(i)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert max_active[0] == 1, \
+            "two sharded stages interleaved inside the mesh"
+        assert sorted(order) == [0, 1, 2, 3]
+        st = plane.stats()
+        assert st["gang_acquired"] == 4
+        assert st["gang_contended"] >= 1
+        assert st["gang_holder"] is None and st["gang_queued"] == 0
+
+    def test_gang_cancel_while_queued_dequeues(self):
+        plane = self._plane()
+        tok = CancelToken("gq")
+        entered = threading.Event()
+        release = threading.Event()
+        result = {}
+
+        def holder():
+            with plane.gang(CancelToken("gh")):
+                entered.set()
+                release.wait(10)
+
+        def waiter():
+            try:
+                with plane.gang(tok):
+                    result["r"] = "acquired"
+            except errors.QueryCancelled as e:
+                result["r"] = e
+
+        th = threading.Thread(target=holder)
+        tw = threading.Thread(target=waiter)
+        th.start()
+        entered.wait(10)
+        tw.start()
+        _spin(lambda: plane.stats()["gang_queued"] == 1,
+              what="waiter queued on the gang")
+        tok.cancel()
+        tw.join(10)
+        release.set()
+        th.join(10)
+        # dequeued with the classified verdict, never granted
+        assert isinstance(result["r"], errors.QueryCancelled)
+        assert plane.stats()["gang_acquired"] == 1
+        assert plane.stats()["gang_queued"] == 0
+
+    def test_gang_reentrant_on_same_thread(self):
+        # exchange above exchange: the nested sharded stage belongs to
+        # the same gang occupation — a second acquisition on the
+        # holding thread must not deadlock
+        plane = self._plane()
+        tok = CancelToken("gr")
+        with plane.gang(tok):
+            with plane.gang(tok):
+                assert plane.gang_holder() is not None
+        assert plane.gang_holder() is None
+        # the nested entry is not a second slot
+        assert plane.stats()["gang_acquired"] == 1
+
+    def test_gang_wait_beats_heartbeat(self):
+        # parking behind another query's sharded stage is legitimate
+        # liveness: the wait loop must beat the stall-watchdog heartbeat
+        # (an armed watchdog would otherwise flag the parked task)
+        plane = self._plane()
+
+        class Beats:
+            sites = []
+            def beat(self, site):
+                self.sites.append(site)
+
+        hb = Beats()
+        entered = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with plane.gang(CancelToken("hh")):
+                entered.set()
+                release.wait(10)
+
+        th = threading.Thread(target=holder)
+        th.start()
+        entered.wait(10)
+
+        def waiter():
+            with plane.gang(CancelToken("hw"), heartbeat=hb):
+                pass
+
+        tw = threading.Thread(target=waiter)
+        tw.start()
+        _spin(lambda: len(hb.sites) >= 2,
+              what="heartbeat beats while parked on the gang")
+        release.set()
+        th.join(10)
+        tw.join(10)
+        assert set(hb.sites) == {"mesh.gang"}
+
+    def test_gang_takes_scheduler_turn(self, knobs):
+        # WRR fairness operates BETWEEN sharded stages: gang entry
+        # takes the token's task turn, so a slot-carrying token pays
+        # one fairness gate per sharded stage
+        knobs.set(cfg.SCHED_MAX_CONCURRENT, 2)
+        sched = QueryScheduler(name="t")
+        tok = CancelToken("gt")
+        slot = sched.acquire(tok)
+        tok.slot = slot
+        plane = self._plane()
+        before = slot.tasks_run
+        with plane.gang(tok):
+            pass
+        assert slot.tasks_run == before + 1
+        slot.release()
+
+    def test_scheduler_stats_surface_gang_accounting(self, knobs):
+        from auron_tpu import config as _cfg
+        from auron_tpu.parallel import mesh as mesh_mod
+        conf = _cfg.get_config()
+        conf.set(_cfg.MESH_ENABLED, True)
+        try:
+            plane = mesh_mod.current_plane()
+            if plane is None:
+                pytest.skip("needs >= 2 devices")
+            sched = QueryScheduler(name="t")
+            with plane.gang(CancelToken("gs")):
+                st = sched.stats()
+                assert st["mesh_gang"]["gang_holder"] == "gs"
+            st = sched.stats()
+            assert st["mesh_gang"]["gang_acquired"] >= 1
+        finally:
+            conf.unset(_cfg.MESH_ENABLED)

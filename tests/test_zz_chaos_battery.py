@@ -57,6 +57,14 @@ _PLANS = [
     ("lifecycle_pipeline", "memmgr.deny:deny@0.5"),
     ("lifecycle_pipeline",
      "cancel.race:cancel@0.2;task.hang:hang@0.1"),
+    # SPMD battery (the [scale-out] mesh plane): device faults landing
+    # INSIDE the sharded-stage all-to-all materialization (the
+    # mesh_pipeline scenario injects per round as well as per batch)
+    # must classify cleanly — gang released, mesh buffer unregistered,
+    # retry or surfaced verdict, never wrong rows
+    ("mesh_pipeline", "device.compute:io_error@0.3"),
+    ("mesh_pipeline", "device.compute:fatal@0.5"),
+    ("mesh_pipeline", "program.build:io_error@0.2"),
     # concurrency battery (the [serving] scheduler plane): three
     # queries race one clamped Session under admission denies and
     # forced memory pressure — shed-not-crash, identical-or-classified,

@@ -1,0 +1,408 @@
+"""SPMD mesh differential battery (ISSUE 11).
+
+TPC-DS subset with ``auron.mesh.enabled`` on vs off, asserting
+BIT-IDENTICAL results (group order included — the fusion/pipeline
+battery contract): mesh routing must only change WHERE the shuffle's
+bytes move (on-device all-to-all vs host buffers), never a value or an
+order. The flagship case additionally proves — from the RECORDED route
+counters in the metric tree, not inference — that the hash exchange of
+an 8-partition q01 actually rode the on-device all-to-all on the full
+virtual 8-device mesh.
+
+Plus the unit halves of the plane: replicate-vs-shard spec selection
+(planner annotate_mesh over a real planned query), the pure routing
+decision (parallel/mesh.exchange_route), and the one-shot quota
+escalation with a donation-eligible child (the double-donate
+regression: inputs entering the all-to-all are never donated, so the
+re-run path always has them).
+"""
+
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax
+
+from auron_tpu import config as cfg
+from auron_tpu.frontend.session import Session
+from auron_tpu.it.tpcds import generate
+from auron_tpu.it.tpcds_queries import QUERIES
+from auron_tpu.parallel import mesh
+
+_SCALE = 0.02
+#: spans plain aggs, joins, subquery-as-join, OR-blocks, count-only —
+#: every one with at least one hash exchange at 4 partitions (a
+#: 4-device submesh of the virtual 8)
+_NAMES = ["q3", "q19", "q48", "q1", "q43", "q96", "q62"]
+
+needs_mesh = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8 virtual devices")
+
+
+@pytest.fixture(scope="module")
+def tables():
+    with tempfile.TemporaryDirectory(prefix="mesh_battery_") as d:
+        yield generate(d, scale=_SCALE)
+
+
+@pytest.fixture()
+def mesh_on():
+    conf = cfg.get_config()
+    conf.set(cfg.MESH_ENABLED, True)
+    try:
+        yield mesh.current_plane()
+    finally:
+        conf.unset(cfg.MESH_ENABLED)
+
+
+def _q(name):
+    return next(q for q in QUERIES if q.name == name)
+
+
+@needs_mesh
+@pytest.mark.parametrize("qname", _NAMES)
+def test_query_bit_identical_mesh_vs_single(qname, tables):
+    conf = cfg.get_config()
+    q = _q(qname)
+    single = q.run(Session(), tables)
+    conf.set(cfg.MESH_ENABLED, True)
+    try:
+        sharded = q.run(Session(), tables)
+    finally:
+        conf.unset(cfg.MESH_ENABLED)
+    assert sharded.num_rows == single.num_rows
+    assert sharded.equals(single), \
+        f"{qname}: sharded result differs from single-device " \
+        f"(values or order)"
+
+
+@needs_mesh
+def test_q01_8way_routes_through_all_to_all(tables, mesh_on):
+    """The acceptance criterion's direct proof: an 8-partition q01 on
+    the full virtual 8-device mesh is bit-identical to single-device
+    AND its hash exchange is RECORDED as routed through the on-device
+    all-to-all (metric-tree route counters — never inferred)."""
+    from auron_tpu.it.queries import q01_dataframe
+    from auron_tpu.obs import metric_tree as mt
+
+    conf = cfg.get_config()
+    conf.unset(cfg.MESH_ENABLED)
+    single = q01_dataframe(Session(), tables, partitions=8).collect()
+    conf.set(cfg.MESH_ENABLED, True)
+
+    s = Session()
+    df = q01_dataframe(s, tables, partitions=8)
+    op = s.plan_physical(df)
+    tree, sharded = mt.explain_analyze(
+        op, num_partitions=df.num_partitions, config=s.config)
+    assert sharded.equals(single), \
+        "8-way sharded q01 differs from single-device"
+    routes = {}
+    for node in tree.walk():
+        for k, v in node.metrics.items():
+            if k.startswith("exchange_route_"):
+                routes[k] = routes.get(k, 0) + v
+    assert routes.get("exchange_route_all_to_all", 0) >= 1, \
+        f"no all_to_all route recorded (routes: {routes})"
+    # and the exchange actually moved bytes on-device
+    moved = sum(n.metrics.get("mesh_bytes_moved", 0)
+                for n in tree.walk())
+    assert moved > 0
+
+
+@needs_mesh
+def test_route_events_in_trace(tables, mesh_on):
+    """The trace half of the route record (tools/mesh_report.py's
+    input): exchange.route events with route/bytes/skew attributes."""
+    from auron_tpu.it.queries import q01_dataframe
+    from auron_tpu.obs import trace
+
+    conf = cfg.get_config()
+    conf.set(cfg.TRACE_ENABLED, True)
+    conf.set(cfg.TRACE_DIR, "")
+    try:
+        q01_dataframe(Session(), tables, partitions=8).collect()
+        evs = [s for s in trace.tracer().spans()
+               if s.name == "exchange.route"]
+    finally:
+        conf.unset(cfg.TRACE_ENABLED)
+        conf.unset(cfg.TRACE_DIR)
+        trace.reset()
+    assert any(e.attrs.get("route") == "all_to_all" for e in evs), evs
+    ev = next(e for e in evs if e.attrs.get("route") == "all_to_all")
+    for key in ("rounds", "bytes", "skew", "escalations", "devices"):
+        assert key in ev.attrs
+
+
+# ---------------------------------------------------------------------------
+# replicate-vs-shard spec selection
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_replicate_layout(mesh_on):
+    """mesh.replicate produces the fully-replicated NamedSharding the
+    "replicate" spec names (every device holds the whole array) — the
+    device_put half future sharded stage bodies consume."""
+    import jax
+    import jax.numpy as jnp
+
+    plane = mesh.current_plane()
+    m = plane.mesh_for(plane.num_devices)
+    arrs = {"a": jnp.arange(16), "b": jnp.ones((4, 4))}
+    rep = mesh.replicate(arrs, m)
+    for leaf in jax.tree_util.tree_leaves(rep):
+        assert leaf.sharding.is_fully_replicated
+        assert len(leaf.sharding.device_set) == plane.num_devices
+
+
+def test_buffer_spec_table():
+    assert mesh.buffer_spec("broadcast") == "replicate"
+    assert mesh.buffer_spec("hash_build") == "replicate"
+    assert mesh.buffer_spec("scan_batch") == "shard"
+    assert mesh.buffer_spec("shuffle_entry") == "shard"
+    assert mesh.buffer_spec("agg_partial") == "shard"
+    assert mesh.buffer_spec(None) == "shard"     # sharding is the rule
+    assert mesh.buffer_spec("unknown_kind") == "shard"
+
+
+@needs_mesh
+def test_annotate_mesh_specs_on_planned_query(tables, mesh_on):
+    """annotate_mesh over a real planned join query: scans shard,
+    broadcast/build sides replicate, eligible hash exchanges gang."""
+    from auron_tpu.io.parquet import DeviceBatchScanOp, ParquetScanOp
+    from auron_tpu.ops.joins import HashJoinOp
+    from auron_tpu.parallel.exchange import (BroadcastExchangeOp,
+                                             ShuffleExchangeOp)
+
+    s = Session()
+    # the q3 shape (co-partitioned fact ⋈ dim), planned without collect
+    from auron_tpu.frontend.dataframe import col, functions as F
+    sales = s.read_parquet(tables["store_sales"], partitions=4) \
+        .repartition(4, "ss_item_sk")
+    dim = (s.read_parquet(tables["item"])
+           .select(col("i_item_sk").alias("ss_item_sk"),
+                   col("i_category"))
+           .repartition(4, "ss_item_sk"))
+    df = (sales.join(dim, on="ss_item_sk")
+          .group_by("i_category")
+          .agg(F.count_star().alias("n")))
+    op = s.plan_physical(df)
+
+    specs = {}
+    def walk(node):
+        specs.setdefault(type(node).__name__, set()).add(node.mesh_spec)
+        for c in node.children:
+            walk(c)
+    walk(op)
+    # scan batches shard on the batch dim
+    assert specs.get("ParquetScanOp", {"shard"}) == {"shard"}
+    found_gang = any("gang" in v for v in specs.values())
+    assert found_gang, f"no gang-annotated exchange in {specs}"
+
+    # build-side stamp: replicate for materialized relations, gang kept
+    # when the build side IS a mesh-routed exchange
+    def find_join(node):
+        if isinstance(node, HashJoinOp):
+            return node
+        for c in node.children:
+            j = find_join(c)
+            if j is not None:
+                return j
+        return None
+    join = find_join(op)
+    assert join is not None
+    assert join.build.mesh_spec in ("replicate", "gang")
+    assert join.mesh_build_kind == "hash_build"
+    # declared kinds resolved through the one table
+    assert BroadcastExchangeOp.mesh_buffer_kind == "broadcast"
+    assert DeviceBatchScanOp.mesh_buffer_kind == "broadcast"
+    assert ParquetScanOp.mesh_buffer_kind == "scan_batch"
+
+
+# ---------------------------------------------------------------------------
+# routing decision (pure)
+# ---------------------------------------------------------------------------
+
+def test_exchange_route_decisions():
+    from auron_tpu.exprs import ir
+    from auron_tpu.parallel.partitioning import (HashPartitioning,
+                                                 RangePartitioning,
+                                                 RoundRobinPartitioning,
+                                                 SinglePartitioning)
+
+    class FakePlane:
+        num_devices = 8
+    plane = FakePlane()
+    hp4 = HashPartitioning((ir.ColumnRef(0),), 4)
+
+    assert mesh.exchange_route(hp4, 4, 4, None) == \
+        ("device_buffer", "mesh_disabled")
+    assert mesh.exchange_route(hp4, 4, 4, plane)[0] == "all_to_all"
+    assert mesh.exchange_route(hp4, 4, 2, plane)[0] == "all_to_all"
+    # fan-in wider than the output mesh: host path (order contract)
+    assert mesh.exchange_route(hp4, 4, 6, plane)[0] == "device_buffer"
+    # wider than the mesh: host path
+    hp16 = HashPartitioning((ir.ColumnRef(0),), 16)
+    assert mesh.exchange_route(hp16, 16, 4, plane)[0] == "device_buffer"
+    # non-hash partitionings never mesh-route
+    for part in (RoundRobinPartitioning(4),
+                 SinglePartitioning(),
+                 RangePartitioning((), 4, ())):
+        n = part.num_partitions
+        assert mesh.exchange_route(part, n, 1, plane)[0] == \
+            "device_buffer"
+
+
+# ---------------------------------------------------------------------------
+# quota escalation + donation regression (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_quota_escalation_with_donation_eligible_child(mesh_on):
+    """The double-donate regression: a fully skewed exchange (every row
+    to one partition) forces the one-shot quota escalation, whose
+    re-run reuses the SAME stacked inputs — with a child that yields
+    owned batches (the donate sweep's precondition), the mesh program
+    must still never donate them. Verified by content equality after a
+    guaranteed escalation."""
+    from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+    from auron_tpu.exprs import ir
+    from auron_tpu.io.parquet import MemoryScanOp
+    from auron_tpu.ops.base import ExecContext, yields_owned_batches
+    from auron_tpu.parallel.exchange import ShuffleExchangeOp
+    from auron_tpu.parallel.partitioning import HashPartitioning
+    from auron_tpu.runtime.executor import collect
+
+    n = 2048
+    # ONE key: every row hashes to the same partition — the worst-case
+    # skew that must overflow the initial per-(src,dst) quota
+    rb = pa.record_batch({
+        "k": pa.array([7] * n, pa.int64()),
+        "v": pa.array(list(range(n)), pa.int64()),
+    })
+    rbs = [rb.slice(o, 512) for o in range(0, n, 512)]
+    scan = MemoryScanOp([rbs[:2], rbs[2:]],
+                        schema_from_arrow(rb.schema), capacity=512)
+    assert yields_owned_batches(scan), \
+        "regression precondition: the child must be donation-eligible"
+    ex = ShuffleExchangeOp(scan, HashPartitioning((ir.ColumnRef(0),), 4),
+                           input_partitions=2)
+    ctx = ExecContext()
+    got = []
+    for p in range(4):
+        for b in ex.execute(p, ctx):
+            nn = int(b.num_rows)
+            got.extend(np.asarray(b.columns[1].data[:nn]).tolist())
+    # every row survived the escalation re-run (a donated input would
+    # have poisoned it — wrong rows or a runtime error here)
+    assert sorted(got) == list(range(n))
+    esc = ctx.metrics["shuffle_exchange"].counter(
+        "mesh_quota_escalations").value
+    assert esc >= 1, "fully skewed exchange must escalate the quota"
+    routes = ctx.metrics["shuffle_exchange"].counter(
+        "exchange_route_all_to_all").value
+    assert routes == 1
+    # cross-check through the driver path too
+    ex2 = ShuffleExchangeOp(scan, HashPartitioning((ir.ColumnRef(0),), 4),
+                            input_partitions=2)
+    out = collect(ex2, num_partitions=4)
+    assert out.num_rows == n
+    assert sorted(out.column("v").to_pylist()) == list(range(n))
+
+
+@needs_mesh
+def test_mesh_exchange_multi_round_order_matches_classic(mesh_on):
+    """Maps with SEVERAL batches each: the mesh read path must yield
+    source-major (map-major) order — exactly the classic entry order —
+    or downstream group order diverges. Driven at the operator level
+    with ragged per-map batch counts (2 vs 3 batches, odd sizes)."""
+    from auron_tpu.columnar.arrow_bridge import schema_from_arrow
+    from auron_tpu.exprs import ir
+    from auron_tpu.io.parquet import MemoryScanOp
+    from auron_tpu.parallel.exchange import ShuffleExchangeOp
+    from auron_tpu.parallel.partitioning import HashPartitioning
+    from auron_tpu.runtime.executor import collect
+
+    rng = np.random.default_rng(17)
+    n = 1700
+    rb = pa.record_batch({
+        "k": pa.array(rng.integers(0, 37, n), pa.int64()),
+        "v": pa.array(list(range(n)), pa.int64()),
+    })
+    # ragged: map0 gets 2 batches (300+400), map1 gets 3 (400+300+300)
+    parts = [[rb.slice(0, 300), rb.slice(300, 400)],
+             [rb.slice(700, 400), rb.slice(1100, 300),
+              rb.slice(1400, 300)]]
+
+    def build():
+        scan = MemoryScanOp(parts, schema_from_arrow(rb.schema),
+                            capacity=512)
+        return ShuffleExchangeOp(scan,
+                                 HashPartitioning((ir.ColumnRef(0),), 4),
+                                 input_partitions=2)
+
+    conf = cfg.get_config()
+    conf.unset(cfg.MESH_ENABLED)
+    classic = collect(build(), num_partitions=4)
+    conf.set(cfg.MESH_ENABLED, True)
+    sharded = collect(build(), num_partitions=4)
+    assert sharded.equals(classic), \
+        "mesh read order differs from the classic device-buffer path"
+
+
+# ---------------------------------------------------------------------------
+# gang scheduling under PR 9 concurrency (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_two_concurrent_sharded_queries_bit_identical(mesh_on):
+    """Two queries with sharded stages through ONE Session stay
+    bit-identical to serial: the gang lock keeps their sharded stages
+    from interleaving inside the mesh (mutual exclusion is structural),
+    WRR orders them, and the conftest leak audits assert the clean
+    consumer/spill ledger."""
+    import threading
+
+    from auron_tpu.frontend.dataframe import col, functions as F
+
+    rng = np.random.default_rng(9)
+    t1 = pa.table({"k": rng.integers(0, 50, 4000),
+                   "v": rng.normal(size=4000)})
+    t2 = pa.table({"k": rng.integers(0, 20, 4000),
+                   "v": rng.normal(size=4000)})
+
+    def make(s, t):
+        return (s.from_arrow(t).repartition(4, "k")
+                .group_by("k").agg(F.sum(col("v")).alias("sv"),
+                                   F.count_star().alias("n")))
+
+    s0 = Session()
+    serial = [s0.execute(make(s0, t)) for t in (t1, t2)]
+
+    plane = mesh.current_plane()
+    acq0 = plane.gang_acquired
+    s = Session()
+    results = [None, None]
+    errs = []
+
+    def run(i, t):
+        try:
+            results[i] = s.execute(make(s, t))
+        except Exception as e:   # surfaced below with identity
+            errs.append((i, e))
+
+    threads = [threading.Thread(target=run, args=(i, t))
+               for i, t in enumerate((t1, t2))]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(120)
+    assert not errs, errs
+    for got, want in zip(results, serial):
+        assert got is not None and got.equals(want), \
+            "concurrent sharded query diverged from serial"
+    # both queries' sharded stages went through the gang door
+    assert plane.gang_acquired >= acq0 + 2
+    assert plane.gang_holder() is None

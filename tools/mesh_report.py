@@ -1,0 +1,158 @@
+"""Per-exchange SPMD routing report from a trace directory.
+
+Every exchange records its routing decision as an ``exchange.route``
+event in the ``mesh`` trace category (parallel/exchange._record_route):
+``all_to_all`` (the mesh-routed on-device shuffle, with rounds / quota
+escalations / bytes moved / per-device skew attributes), ``device_buffer``
+(the host-orchestrated classic path, with the fallback reason) or
+``rss`` (the durable/multihost tier). This tool prints the table those
+events make — which shuffles actually rode the mesh, how much data moved
+on-device vs through host tiers, and where the quota contract escalated
+— plus the ``mesh.gang`` occupancy events (gang waits are the
+cross-query serialization cost of "one slot = the mesh").
+
+    AURON_CONF_TRACE_ENABLED=1 AURON_CONF_TRACE_DIR=/tmp/tr <run>
+    python tools/mesh_report.py /tmp/tr
+    python tools/mesh_report.py --compare /tmp/base /tmp/candidate
+
+``--compare`` diffs two trace dirs (e.g. mesh off vs on): per-route
+exchange counts and bytes side by side.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_events(trace_dir: str) -> list[dict]:
+    """All spans of every trace_*.jsonl in ``trace_dir`` (dict form)."""
+    out = []
+    paths = sorted(glob.glob(os.path.join(trace_dir, "trace_*.jsonl")))
+    if not paths:
+        raise SystemExit(
+            f"no trace_*.jsonl in {trace_dir!r} — run with "
+            "AURON_CONF_TRACE_ENABLED=1 and AURON_CONF_TRACE_DIR set")
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+    return out
+
+
+def route_events(events: list[dict]) -> list[dict]:
+    return [e for e in events if e.get("name") == "exchange.route"]
+
+
+def gang_events(events: list[dict]) -> list[dict]:
+    return [e for e in events if e.get("name") == "mesh.gang"]
+
+
+def summarize(events: list[dict]) -> dict:
+    """Aggregate per-route totals for one trace dir (the --compare
+    unit): exchange counts, bytes, rounds, escalations."""
+    routes = route_events(events)
+    agg: dict = {}
+    for e in routes:
+        a = e.get("attrs", {})
+        r = a.get("route", "?")
+        ent = agg.setdefault(r, {"exchanges": 0, "bytes": 0, "rows": 0,
+                                 "rounds": 0, "escalations": 0})
+        ent["exchanges"] += 1
+        ent["bytes"] += int(a.get("bytes", 0))
+        ent["rows"] += int(a.get("rows", 0))
+        ent["rounds"] += int(a.get("rounds", 0))
+        ent["escalations"] += int(a.get("escalations", 0))
+    gangs = gang_events(events)
+    return {
+        "by_route": agg,
+        "gang": {
+            "acquisitions": len(gangs),
+            "contended": sum(1 for g in gangs
+                             if g.get("attrs", {}).get("contended")),
+            "wait_ms": round(sum(float(g.get("attrs", {})
+                                       .get("wait_ms", 0.0))
+                                 for g in gangs), 3),
+        },
+    }
+
+
+def print_table(events: list[dict]) -> None:
+    routes = route_events(events)
+    if not routes:
+        print("no exchange.route events recorded "
+              "(is auron.mesh category traced?)")
+    else:
+        hdr = (f"{'route':<14} {'reason':<28} {'parts':>5} {'maps':>5} "
+               f"{'rounds':>6} {'esc':>4} {'rows':>10} {'bytes':>12} "
+               f"{'skew':>6}")
+        print(hdr)
+        print("-" * len(hdr))
+        for e in routes:
+            a = e.get("attrs", {})
+            print(f"{a.get('route', '?'):<14} "
+                  f"{str(a.get('reason', ''))[:28]:<28} "
+                  f"{a.get('partitions', ''):>5} {a.get('maps', ''):>5} "
+                  f"{a.get('rounds', ''):>6} {a.get('escalations', ''):>4} "
+                  f"{a.get('rows', ''):>10} {a.get('bytes', ''):>12} "
+                  f"{a.get('skew', ''):>6}")
+    s = summarize(events)
+    print()
+    for r, ent in sorted(s["by_route"].items()):
+        print(f"{r}: {ent['exchanges']} exchange(s), "
+              f"{ent['bytes']:,} bytes, {ent['rows']:,} rows, "
+              f"{ent['rounds']} round(s), "
+              f"{ent['escalations']} quota escalation(s)")
+    g = s["gang"]
+    if g["acquisitions"]:
+        print(f"mesh gang: {g['acquisitions']} acquisition(s), "
+              f"{g['contended']} contended, "
+              f"total wait {g['wait_ms']}ms")
+
+
+def print_compare(base_dir: str, cand_dir: str) -> None:
+    base = summarize(load_events(base_dir))
+    cand = summarize(load_events(cand_dir))
+    routes = sorted(set(base["by_route"]) | set(cand["by_route"]))
+    print(f"{'route':<14} {'base ex':>8} {'cand ex':>8} "
+          f"{'base bytes':>14} {'cand bytes':>14}")
+    for r in routes:
+        b = base["by_route"].get(r, {})
+        c = cand["by_route"].get(r, {})
+        print(f"{r:<14} {b.get('exchanges', 0):>8} "
+              f"{c.get('exchanges', 0):>8} "
+              f"{b.get('bytes', 0):>14,} {c.get('bytes', 0):>14,}")
+    print(f"gang waits: base {base['gang']['wait_ms']}ms "
+          f"({base['gang']['acquisitions']} acq) -> cand "
+          f"{cand['gang']['wait_ms']}ms "
+          f"({cand['gang']['acquisitions']} acq)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace_dir", nargs="?",
+                    help="directory of trace_*.jsonl files")
+    ap.add_argument("--compare", nargs=2, metavar=("BASE", "CANDIDATE"),
+                    help="diff two trace dirs instead")
+    ap.add_argument("--json", action="store_true",
+                    help="print the aggregate as one JSON line too")
+    args = ap.parse_args(argv)
+    if args.compare:
+        print_compare(*args.compare)
+        return 0
+    if not args.trace_dir:
+        ap.error("trace_dir (or --compare) is required")
+    events = load_events(args.trace_dir)
+    print_table(events)
+    if args.json:
+        print(json.dumps(summarize(events)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

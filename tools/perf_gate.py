@@ -41,7 +41,9 @@ def load_baseline(path: str) -> dict:
         return json.load(f)
 
 
-def fresh_bench_record(timeout_s: int = 1200) -> dict:
+def fresh_bench_record(timeout_s: int = 1800) -> dict:
+    # sized for the bench child (900s budget) PLUS the mesh scaling
+    # child the parent runs afterwards (~540s budget)
     """Run bench.py and parse its one-JSON-line contract."""
     repo = os.path.dirname(_HERE)
     proc = subprocess.run(
@@ -163,6 +165,71 @@ def evaluate(record: dict, baseline: dict, tolerance_pct: float,
             }
             if pval < pfloor:
                 verdict["perf_gate"] = "fail"
+    # SPMD mesh floor: the virtual 8-device CPU mesh q01 scaling figure
+    # (bench's mesh child). Gated whenever the record carries a mesh
+    # section; a bench that TRIED and failed records mesh_error and
+    # FAILS (the silent-decay hole stays closed for every fresh bench);
+    # records predating the mesh bench skip with the skip recorded.
+    mentry = baseline.get("platforms", {}).get("mesh")
+    if mentry:
+        mrec = record.get("mesh")
+        if isinstance(mrec, dict) and mrec.get("mesh_rows_per_sec"):
+            mscale = mentry.get("scale")
+            mdev = int(mentry.get("devices", 8))
+            if mscale is not None \
+                    and float(mrec.get("scale", -1)) != float(mscale):
+                verdict["mesh"] = {
+                    "verdict": "skipped",
+                    "reason": f"mesh scale {mrec.get('scale')} != "
+                              f"baseline scale {mscale}",
+                }
+            elif int(mrec.get("devices", 0)) != mdev:
+                verdict["mesh"] = {
+                    "verdict": "skipped",
+                    "reason": f"mesh devices {mrec.get('devices')} != "
+                              f"baseline devices {mdev}",
+                }
+            else:
+                mval = float(mrec["mesh_rows_per_sec"])
+                mbase = float(mentry["rows_per_sec"])
+                mtol = float(mentry.get("tolerance_pct", eff_tol))
+                mfloor = mbase * (1.0 - mtol / 100.0)
+                verdict["mesh"] = {
+                    "verdict": "pass" if mval >= mfloor else "fail",
+                    "value_rows_per_sec": round(mval, 1),
+                    "baseline_rows_per_sec": round(mbase, 1),
+                    "floor_rows_per_sec": round(mfloor, 1),
+                    "tolerance_pct": mtol,
+                    "delta_vs_baseline_pct": round(
+                        (mval - mbase) / mbase * 100.0, 2),
+                    "scaling_factor": mrec.get("scaling_factor"),
+                    "route_all_to_all": mrec.get(
+                        "route_all_to_all_by_devices"),
+                }
+                if mval < mfloor:
+                    verdict["perf_gate"] = "fail"
+        elif record.get("mesh_error"):
+            verdict["mesh"] = {
+                "verdict": "missing",
+                "reason": f"mesh bench errored: {record['mesh_error']}",
+            }
+            verdict["perf_gate"] = "fail"
+        elif "mesh" in record:
+            # a mesh section WITHOUT a usable value (interrupted child,
+            # renamed key) is the silent-decay mode, not a pre-mesh
+            # record — fail loudly like the pipeline floor's zero case
+            verdict["mesh"] = {
+                "verdict": "missing",
+                "reason": "mesh section carries no usable "
+                          "mesh_rows_per_sec",
+            }
+            verdict["perf_gate"] = "fail"
+        else:
+            verdict["mesh"] = {
+                "verdict": "skipped",
+                "reason": "record carries no mesh section "
+                          "(predates the mesh bench)",
+            }
     # carry the forensics along: a failing gate should arrive WITH the
     # host/device attribution and the structured backend diagnosis
     if isinstance(record.get("profile"), dict):
@@ -305,6 +372,18 @@ def main(argv=None) -> int:
                   f"{p['baseline_rows_per_sec']:,.0f} "
                   f"(floor {p['floor_rows_per_sec']:,.0f}, tolerance "
                   f"{p['tolerance_pct']:.0f}%) → {p['verdict'].upper()}")
+    if "mesh" in verdict:
+        m = verdict["mesh"]
+        if m["verdict"] in ("skipped", "missing"):
+            print(f"  mesh (8-dev virtual): {m['verdict'].upper()} — "
+                  f"{m['reason']}")
+        else:
+            print(f"  mesh (8-dev virtual): "
+                  f"{m['value_rows_per_sec']:,.0f} rows/s vs baseline "
+                  f"{m['baseline_rows_per_sec']:,.0f} "
+                  f"(floor {m['floor_rows_per_sec']:,.0f}, tolerance "
+                  f"{m['tolerance_pct']:.0f}%, scaling "
+                  f"{m.get('scaling_factor')}) → {m['verdict'].upper()}")
     if "profile" in verdict:
         p = verdict["profile"]
         print(f"  host/device split: device={p.get('device_ms')}ms "
