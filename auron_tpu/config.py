@@ -134,8 +134,11 @@ MESH_ENABLED = _opt(
     "RSS stays the durable/multihost tier. The route taken is recorded "
     "per exchange in the metric tree (exchange_route_* counters) and the "
     "trace ('mesh' category exchange.route events — "
-    "tools/mesh_report.py). PROCESS-GLOBAL by contract (the device set "
-    "is process state, like auron.pipeline.enabled): resolved from "
+    "tools/mesh_report.py). A device lost mid-exchange "
+    "(errors.MeshUnavailable) demotes the remaining rounds to the host "
+    "path and quarantines the chip (auron.mesh.quarantine) — the plane "
+    "degrades, never the query. PROCESS-GLOBAL by contract (the device "
+    "set is process state, like auron.pipeline.enabled): resolved from "
     "get_config(), per-Session overrides are not honored. Default off; "
     "tests/bench force a virtual CPU mesh via "
     "--xla_force_host_platform_device_count.")
@@ -151,6 +154,40 @@ MESH_AXIS = _opt(
     "Name of the mesh's single batch-sharding axis (the PartitionSpec "
     "axis scan batches shard over; broadcast relations and hash-table "
     "build sides replicate — parallel/mesh.buffer_spec).")
+MESH_STRAGGLER_FACTOR = _opt(
+    "auron.mesh.straggler_factor", float, 4.0,
+    "Straggler defense of the SPMD plane: an all-to-all round slower "
+    "than this factor times the rolling per-round p50 (the plane's "
+    "MeshRoundStats window, armed after a few observed rounds) emits a "
+    "mesh.straggler trace event and counts on "
+    "auron_mesh_stragglers_total — one slow chip becomes an observable "
+    "signal instead of an invisible latency spike on every query in the "
+    "gang queue. With auron.mesh.demote_on_straggler it also triggers "
+    "the same mid-exchange route demotion a device loss does. "
+    "<= 0 disables the detector.")
+MESH_DEMOTE_ON_STRAGGLER = _opt(
+    "auron.mesh.demote_on_straggler", bool, False,
+    "Escalate a detected straggler round (auron.mesh.straggler_factor) "
+    "from an observable event to the demotion path: the exchange's "
+    "REMAINING rounds re-route through the host device-buffer tier — "
+    "the completed slow round's received rows stay valid on the mesh — "
+    "so one slow chip degrades throughput instead of latency-spiking "
+    "the whole gang queue. Default off: stragglers are reported, not "
+    "acted on (a transient OS hiccup would otherwise demote a healthy "
+    "mesh).")
+MESH_QUARANTINE = _opt(
+    "auron.mesh.quarantine", bool, True,
+    "On a device loss (errors.MeshUnavailable mid-exchange), record the "
+    "failed device in the MeshPlane's quarantine set: subsequent "
+    "exchanges rebuild a smaller submesh from the remaining healthy "
+    "devices when the square contract (num_partitions == submesh "
+    "width) still holds, and route host-side otherwise — the rest of "
+    "the query keeps running without ever re-entering the dead chip. "
+    "When XLA's error carries no device identity, the tail device of "
+    "the failed submesh is retired (deterministic; a wrongly blamed "
+    "healthy chip costs one device of capacity, never correctness). "
+    "Off demotes the failing exchange but leaves the plane's device "
+    "set intact (the next exchange will try the full mesh again).")
 
 # concurrent query scheduler (runtime/scheduler.py)
 SCHED_MAX_CONCURRENT = _opt(
@@ -299,7 +336,11 @@ FAULTS_PLAN = _opt(
     "Seeded fault-injection plan: 'site:kind@prob;...' over the named "
     "sites rss.{write,flush,commit,fetch}, spill.{write,read}, "
     "device.compute, task.hang, cancel.race, program.build, "
-    "backend.init, memmgr.deny with kinds io_error | fatal | corrupt | "
+    "backend.init, memmgr.deny, sched.admit, mesh.all_to_all (per "
+    "sharded-exchange round: io_error/fatal simulate a device loss the "
+    "demotion path must route around, hang a straggling chip) and "
+    "mesh.gang (kind cancel: a cancel racing the gang door) with kinds "
+    "io_error | fatal | corrupt | "
     "hang | cancel | deny (prob defaults to 1.0). Injected hangs poll "
     "the task's cancel registry, 'cancel' fires the task's CancelToken "
     "mid-drive (the cancel-race site), 'deny' forces the memory "

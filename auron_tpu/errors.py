@@ -186,6 +186,30 @@ class DeviceExecutionError(TransientError, RuntimeError):
     device fault): an exact partition recompute can succeed."""
 
 
+class MeshUnavailable(DeviceExecutionError):
+    """A device of the SPMD mesh is lost (or the collective fabric
+    failed) mid-exchange: the gang-scheduled ``all_to_all`` round holds
+    every chip's in-flight shard in volatile HBM, so the round cannot
+    complete on the mesh. Recovery is ROUTE DEMOTION, not a blind rerun
+    of the same collective: the exchange re-routes its remaining rounds
+    down the existing ladder (``all_to_all`` → host ``device_buffer`` →
+    RSS) re-using the lost round's still-live map inputs (inputs are
+    never donated into the exchange program by contract), and the plane
+    quarantines the device so SUBSEQUENT exchanges rebuild a smaller
+    submesh or route host-side (``parallel/exchange.py`` /
+    ``parallel/mesh.py``). Transient by type — if it escapes the
+    in-place demotion (e.g. the prior rounds' mesh-resident shards are
+    unreadable too), a task-level recompute re-routes against the
+    already-quarantined plane and succeeds host-side."""
+
+    def __init__(self, *args, device: Optional[int] = None,
+                 site: Optional[str] = None):
+        super().__init__(*args, site=site)
+        #: mesh device index the failure was attributed to (None when
+        #: XLA's error carries no device identity)
+        self.device = device
+
+
 class StorageIOError(TransientError, OSError):
     """IO failure against a durable tier (shared-storage RSS root,
     spill directory): the storage substrate heals between attempts.
@@ -220,6 +244,18 @@ _XLA_DETERMINISTIC_PATTERNS = (
     "incompatible shapes", "rank mismatch", "unimplemented",
 )
 
+#: RuntimeError signatures of DEVICE LOSS — the failure class where the
+#: chip (or the collective fabric between chips) died under a running
+#: program, as opposed to the program being wrong. Checked BEFORE the
+#: deterministic split: these become ``MeshUnavailable`` so the SPMD
+#: exchange's demotion handler (and the plane's quarantine) can route
+#: around the dead device instead of retrying into it.
+_DEVICE_LOSS_PATTERNS = (
+    "device lost", "device unavailable", "device failure",
+    "device halted", "device is in an invalid state", "slice health",
+    "interconnect", "data transfer failure", "chip unreachable",
+)
+
 
 def classify_runtime(e: RuntimeError) -> BaseException:
     """Classify a bare RuntimeError crossing the device-compute boundary
@@ -242,6 +278,11 @@ def classify_runtime(e: RuntimeError) -> BaseException:
         return e
     msg = str(e)
     low = msg.lower()
+    # device loss outranks the deterministic split: "device lost during
+    # lowering cleanup"-style messages are a dead chip, not a plan
+    # defect, and must reach the mesh demotion/quarantine path
+    if any(p in low for p in _DEVICE_LOSS_PATTERNS):
+        return MeshUnavailable(msg)
     if any(p in low for p in _XLA_DETERMINISTIC_PATTERNS):
         return KernelLoweringError(msg)
     return DeviceExecutionError(msg)

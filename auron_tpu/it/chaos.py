@@ -66,7 +66,8 @@ class ChaosOutcome:
 #: actions)
 RECOVERY_SPAN_NAMES = ("task.retry", "shuffle.corruption_recompute",
                        "watchdog.fallback", "watchdog.stall",
-                       "memmgr.shed", "sched.reject")
+                       "memmgr.shed", "sched.reject",
+                       "exchange.demote", "mesh.quarantine")
 
 
 #: which injection KINDS can cause each recovery span — the corrupt
@@ -84,6 +85,11 @@ _RECOVERY_CAUSE_KINDS = {
     "memmgr.shed": ("deny",),
     # admission control sheds at the door on injected denies
     "sched.reject": ("deny",),
+    # the mesh fault domain demotes on device loss (io_error/fatal at
+    # mesh.all_to_all) and — under demote_on_straggler — on an injected
+    # hang's straggling round
+    "exchange.demote": ("io_error", "fatal", "hang"),
+    "mesh.quarantine": ("io_error", "fatal"),
 }
 
 
@@ -265,10 +271,15 @@ def mesh_pipeline(workdir: str) -> Scenario:
     exchange rides the on-device all-to-all stage program — the
     ``device.compute`` site fires both per output batch in the drive
     loop AND per all-to-all round inside the sharded-stage
-    materialization. A fault mid-exchange must classify cleanly (the
-    gang releases, the mesh buffer unregisters, the task retries or
-    surfaces the verdict); RSS stays untouched as the durable fallback
-    tier, which is exactly what this scenario proves out."""
+    materialization, and the mesh fault domain's own sites get traffic
+    too: ``mesh.all_to_all`` (per round — io_error/fatal simulate a
+    device loss the DEMOTION path must recover bit-identically, hang a
+    straggling chip) and ``mesh.gang`` (a cancel racing the gang door
+    must dequeue without starting a round). A fault mid-exchange must
+    classify cleanly (the gang releases, the mesh buffer unregisters,
+    the exchange demotes or the task surfaces the verdict); RSS stays
+    untouched as the durable fallback tier, which is exactly what this
+    scenario proves out."""
     from auron_tpu.frontend.dataframe import col, functions as F
     from auron_tpu.frontend.session import Session
     from auron_tpu.parallel import mesh as mesh_mod
@@ -580,6 +591,12 @@ def run_chaos(scenario: Scenario, fault_plan: str, seed: int,
         conf.unset(cfg.FAULTS_PLAN)
         conf.unset(cfg.FAULTS_SEED)
         faults.reset()
+        # a device quarantined by THIS run's injected loss must not
+        # silently reroute the next run's exchanges (each chaos run is
+        # a fresh pipeline by contract; the quarantine ledger still
+        # counted it for the report)
+        from auron_tpu.parallel import mesh as _mesh
+        _mesh.clear_quarantine()
     return ChaosOutcome(scenario.name, fault_plan, seed, status,
                         error_type=err_t, error=err, injected=injected,
                         leaks=scenario.leaks(), trace_id=trace_id,

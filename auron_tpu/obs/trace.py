@@ -42,7 +42,14 @@ import threading
 import time
 from typing import NamedTuple, Optional
 
-#: span categories (the auron.trace.events allowlist vocabulary)
+#: span categories (the auron.trace.events allowlist vocabulary).
+#: The ``mesh`` category carries the SPMD plane's routing AND fault
+#: domain: ``exchange.route`` (per-exchange routing decision),
+#: ``mesh.gang`` (gang-door occupancy), ``exchange.demote`` (mid-query
+#: route demotion with reason/recompute cost), ``mesh.straggler``
+#: (round slower than straggler_factor × rolling p50) and
+#: ``mesh.quarantine`` (device retired from future submeshes) —
+#: tools/mesh_report.py prints all of them.
 CATEGORIES = ("query", "task", "program", "shuffle", "spill", "fault",
               "watchdog", "memory", "sched", "mesh")
 

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Iterator, Optional
 
 import numpy as np
@@ -248,30 +249,46 @@ class _ExchangeBuffer:
 
     # -- read side ----------------------------------------------------------
 
-    def partition_batches(self, p: int) -> Iterator[DeviceBatch]:
+    def _entry_partition(self, e, p: int) -> Optional[DeviceBatch]:
+        """Partition ``p``'s rows of ONE entry (device slice or restored
+        host frame); None when the entry holds no rows for ``p``."""
         from auron_tpu.columnar.serde import (deserialize_host_batch,
                                               host_to_batch)
+        offsets = e[2]
+        lo, hi = int(offsets[p]), int(offsets[p + 1])
+        n_p = hi - lo
+        if n_p <= 0:
+            return None
+        if e[0].startswith("dev"):
+            # "dev" or "dev-spilling": the device batch in this
+            # snapshot's entry list stays valid even if a concurrent
+            # spill swaps the entry afterwards
+            batch = e[1]
+            cap = bucket_rows(n_p)
+            idx = jnp.minimum(lo + jnp.arange(cap, dtype=jnp.int32),
+                              batch.capacity - 1)
+            return gather_batch(batch, idx, jnp.asarray(n_p, jnp.int32))
+        host, _extras = deserialize_host_batch(e[1].frame_at(p))
+        return host_to_batch(host, bucket_rows(n_p))
+
+    def partition_batches(self, p: int) -> Iterator[DeviceBatch]:
         with self._lock:
             entries = list(self.entries)
         for e in entries:
-            offsets = e[2]
-            lo, hi = int(offsets[p]), int(offsets[p + 1])
-            n_p = hi - lo
-            if n_p <= 0:
-                continue
-            if e[0].startswith("dev"):
-                # "dev" or "dev-spilling": the device batch in this
-                # snapshot's entry list stays valid even if a concurrent
-                # spill swaps the entry afterwards
-                batch = e[1]
-                cap = bucket_rows(n_p)
-                idx = jnp.minimum(lo + jnp.arange(cap, dtype=jnp.int32),
-                                  batch.capacity - 1)
-                yield gather_batch(batch, idx,
-                                   jnp.asarray(n_p, jnp.int32))
-            else:
-                host, _extras = deserialize_host_batch(e[1].frame_at(p))
-                yield host_to_batch(host, bucket_rows(n_p))
+            out = self._entry_partition(e, p)
+            if out is not None:
+                yield out
+
+    def entry_batches(self, p: int, indices) -> Iterator[DeviceBatch]:
+        """Partition ``p``'s rows of the entries at ``indices`` only —
+        the demoted read path's per-source slice (a spill swaps entries
+        IN PLACE, so indices stay stable across pressure)."""
+        with self._lock:
+            picked = [self.entries[i] for i in indices]
+        for e in picked:
+            out = self._entry_partition(e, p)
+            if out is not None:
+                yield out
 
     def close(self) -> None:
         if self.mem is not None:
@@ -371,34 +388,51 @@ class _MeshExchangeBuffer:
     def spill(self) -> int:
         return 0   # device-resident by design (see class docstring)
 
-    def partition_batches(self, p: int) -> Iterator[DeviceBatch]:
-        from auron_tpu.columnar.batch import DeviceBatch as _DB
+    def partition_shards(self, p: int) -> list:
+        """Device ``p``'s zero-copy shard tree of every round — hoisted
+        ONCE per partition by both read paths (recomputing per source
+        would tree_map n_out× per reducer)."""
         from auron_tpu.parallel import mesh as mesh_mod
         with self._lock:
             entries = list(self.entries)
-        # device p's shard of every round, materialized zero-copy once
-        shards = [jax.tree_util.tree_map(
+        return [jax.tree_util.tree_map(
             lambda a: mesh_mod.local_shard(a, p, self.mesh), cols)
             for cols, _counts, _quota in entries]
+
+    def source_batches(self, p: int, source: int,
+                       _shards=None) -> Iterator[DeviceBatch]:
+        """Partition ``p``'s rows received from ONE source map, rounds
+        in order — the per-source slice the demoted read path
+        interleaves with host entries."""
+        from auron_tpu.columnar.batch import DeviceBatch as _DB
+        with self._lock:
+            entries = list(self.entries)
+        if _shards is None:
+            _shards = self.partition_shards(p)
         home = self.mesh.devices.flat[0]
+        for (cols, counts, quota), shard_cols in zip(entries, _shards):
+            n_s = int(counts[p, source])
+            if n_s <= 0:
+                continue
+            cap = bucket_rows(n_s)
+            base = _DB(shard_cols, jnp.asarray(n_s, jnp.int32))
+            idx = jnp.minimum(
+                source * quota + jnp.arange(cap, dtype=jnp.int32),
+                base.capacity - 1)
+            out = gather_batch(base, idx, jnp.asarray(n_s, jnp.int32))
+            # rebase onto the engine's home device: downstream
+            # operators mix these rows with build sides / agg state
+            # committed there (one ICI hop on a real slice; the
+            # HBM-tier item keeps them resident per-device later)
+            yield jax.device_put(out, home)
+
+    def partition_batches(self, p: int) -> Iterator[DeviceBatch]:
+        # device p's shard of every round, materialized zero-copy once
+        shards = self.partition_shards(p)
         # SOURCE-major, rounds-minor: map s's round-r rows appear where
         # the host path's entry (map s, batch r) would
         for s in range(self.n_out):
-            for (cols, counts, quota), shard_cols in zip(entries, shards):
-                n_s = int(counts[p, s])
-                if n_s <= 0:
-                    continue
-                cap = bucket_rows(n_s)
-                base = _DB(shard_cols, jnp.asarray(n_s, jnp.int32))
-                idx = jnp.minimum(
-                    s * quota + jnp.arange(cap, dtype=jnp.int32),
-                    base.capacity - 1)
-                out = gather_batch(base, idx, jnp.asarray(n_s, jnp.int32))
-                # rebase onto the engine's home device: downstream
-                # operators mix these rows with build sides / agg state
-                # committed there (one ICI hop on a real slice; the
-                # HBM-tier item keeps them resident per-device later)
-                yield jax.device_put(out, home)
+            yield from self.source_batches(p, s, _shards=shards)
 
     def close(self) -> None:
         if self.mem is not None:
@@ -406,6 +440,49 @@ class _MeshExchangeBuffer:
         with self._lock:
             self.entries = []
             self._dev_bytes = 0
+
+
+class _DemotedExchangeBuffer:
+    """Read path of a MID-QUERY demoted exchange: the rounds that
+    completed on the mesh plus the host-routed remainder.
+
+    A demotion splits one exchange's entries across two tiers — rounds
+    0..k-1 live in the mesh buffer (shard-resident received rows), the
+    lost round's re-routed inputs and every later batch in a classic
+    host ``_ExchangeBuffer`` (``host_sources[i]`` = the map partition
+    host entry ``i`` came from). The read path interleaves them
+    SOURCE-major: for each map, first its mesh rounds (rounds-minor),
+    then its host entries in append order — exactly the map-major batch
+    sequence both the pure-mesh and pure-host paths yield, so the
+    bit-identity contract (group order included) survives the
+    demotion. Both sub-buffers stay registered with the memory manager
+    (the host half spills under pressure like any classic exchange)."""
+
+    def __init__(self, mesh_buffer: "_MeshExchangeBuffer",
+                 host_buffer: "_ExchangeBuffer", host_sources: list,
+                 n_out: int):
+        self.mesh_buffer = mesh_buffer
+        self.host_buffer = host_buffer
+        self.host_sources = list(host_sources)
+        self.n_out = n_out
+
+    def partition_batches(self, p: int) -> Iterator[DeviceBatch]:
+        by_source: dict[int, list[int]] = {}
+        for i, s in enumerate(self.host_sources):
+            by_source.setdefault(s, []).append(i)
+        # hoist the per-round shard trees ONCE per partition (the pure-
+        # mesh read path's discipline) instead of once per source
+        shards = self.mesh_buffer.partition_shards(p)
+        for s in range(self.n_out):
+            yield from self.mesh_buffer.source_batches(p, s,
+                                                       _shards=shards)
+            idxs = by_source.get(s)
+            if idxs:
+                yield from self.host_buffer.entry_batches(p, idxs)
+
+    def close(self) -> None:
+        self.mesh_buffer.close()
+        self.host_buffer.close()
 
 
 class ShuffleExchangeOp(PhysicalOp):
@@ -534,12 +611,21 @@ class ShuffleExchangeOp(PhysicalOp):
         built_c = kmetrics.counter("mesh_stage_programs_built")
         hit_c = kmetrics.counter("mesh_stage_program_hits")
 
+        from auron_tpu.parallel import mesh_exchange as mex
+        from auron_tpu.runtime import watchdog
+
         buffer = _MeshExchangeBuffer(self, mesh, axis, n_out,
                                      ctx.mem_manager, metrics)
-        rounds = escalations = 0
+        rounds = escalations = 0   # rounds = COMPLETED mesh rounds
         bytes_moved = 0   # LIVE bytes through the all-to-all (unpadded)
         quota: Optional[int] = None   # sticky: escalated once, reused
         dest_rows = np.zeros(n_out, np.int64)
+        straggler_factor = float(ctx.conf.get(cfg.MESH_STRAGGLER_FACTOR))
+        demote_on_straggler = ctx.conf.get(cfg.MESH_DEMOTE_ON_STRAGGLER)
+        demote_reason: Optional[str] = None
+        pending: list = []         # (map, still-live batch) of a lost round
+        carries_h = None           # host carry snapshot for the demoted path
+        t_demote = 0.0
 
         def polled(in_p: int):
             map_ctx = ctx.child(partition_id=in_p,
@@ -562,8 +648,9 @@ class ShuffleExchangeOp(PhysicalOp):
                     ref = next((b for b in batches if b is not None), None)
                     if ref is None:
                         break
-                    rounds += 1
-                    n_live = sum(1 for b in batches if b is not None)
+                    live = [(p, b) for p, b in enumerate(batches)
+                            if b is not None]
+                    n_live = len(live)
                     # zero-copy empties for exhausted maps: a live
                     # batch's arrays with num_rows=0 (rows past
                     # num_rows are dead by the batch contract)
@@ -575,34 +662,87 @@ class ShuffleExchangeOp(PhysicalOp):
                     # device fault mid-exchange must classify cleanly
                     faults.maybe_fail("device.compute",
                                       errors.DeviceExecutionError)
-                    with timer(write_time, sync=False):
-                        cols, num_rows, cap = mesh_mod.stack_global_batch(
-                            batches, mesh, axis)
-                        if quota is None:
-                            quota = bucket_rows(max((2 * cap) // n_out, 1))
-                        while True:
-                            kern, built = stage_exchange_program(
-                                mesh, axis, n_out, frag_keys, part_key,
-                                in_schema, out_schema, cap, quota,
-                                fragments, part_exprs)
-                            (built_c if built else hit_c).add(1)
-                            out_cols, rc, _nr, gmax, new_carries = kern(
-                                cols, num_rows, carries)
-                            # ONE fence at the sharded stage's output
-                            # boundary: the round's only readback,
-                            # booked as device wait (PR 8 discipline —
-                            # never per shard, never per program step)
-                            gmax_h, rc_h = _profile.timed_get((gmax, rc))
-                            needed = int(np.asarray(gmax_h))
-                            if needed <= quota:
-                                break
-                            # one-shot escalation at the exact pow2
-                            # quota (the exchange_device_batches
-                            # contract); the un-donated inputs are
-                            # still live for this re-run
-                            escalations += 1
-                            quota = bucket_rows(needed)
-                        carries = new_carries
+                    # gang-aware round guard: flags downgraded to "slow"
+                    # when the round completes; a raise below is the
+                    # dead-device verdict (watchdog.MeshRoundGuard)
+                    guard = watchdog.MeshRoundGuard(ctx.heartbeat)
+                    round_built = False   # compile time is not latency
+                    try:
+                        with guard:
+                            # the mesh fault domain's per-round site
+                            mex.round_fault_check(ctx)
+                            with timer(write_time, sync=False):
+                                cols, num_rows, cap = \
+                                    mesh_mod.stack_global_batch(
+                                        batches, mesh, axis)
+                                if quota is None:
+                                    quota = bucket_rows(
+                                        max((2 * cap) // n_out, 1))
+                                while True:
+                                    kern, built = stage_exchange_program(
+                                        mesh, axis, n_out, frag_keys,
+                                        part_key, in_schema, out_schema,
+                                        cap, quota, fragments, part_exprs)
+                                    round_built |= built
+                                    (built_c if built else hit_c).add(1)
+                                    (out_cols, rc, _nr, gmax,
+                                     new_carries) = kern(
+                                        cols, num_rows, carries)
+                                    # ONE fence at the sharded stage's
+                                    # output boundary: the round's only
+                                    # readback, booked as device wait
+                                    # (PR 8 discipline — never per
+                                    # shard, never per program step)
+                                    gmax_h, rc_h = _profile.timed_get(
+                                        (gmax, rc))
+                                    needed = int(np.asarray(gmax_h))
+                                    if needed <= quota:
+                                        break
+                                    # one-shot escalation at the exact
+                                    # pow2 quota (the
+                                    # exchange_device_batches contract);
+                                    # the un-donated inputs are still
+                                    # live for this re-run
+                                    escalations += 1
+                                    quota = bucket_rows(needed)
+                    except BaseException as e:
+                        err = mex.classify_collective(e)
+                        if not mex.is_mesh_loss(err):
+                            if err is e:
+                                raise
+                            raise err from e
+                        # DEVICE LOSS mid-round: quarantine first (even
+                        # if in-place demotion fails below, the next
+                        # task attempt routes against the shrunken
+                        # plane), then capture the still-live inputs of
+                        # the lost round (donation-off contract) for
+                        # the host re-route
+                        t_demote = time.perf_counter()
+                        # a stall the monitor flagged while the dying
+                        # round blocked must not abort the recovery at
+                        # the host continuation's first checkpoint
+                        guard.forgive_stall()
+                        if ctx.conf.get(cfg.MESH_QUARANTINE):
+                            plane.quarantine(
+                                getattr(err, "device", None),
+                                f"{type(err).__name__} at round "
+                                f"{rounds}")
+                        try:
+                            carries_h = np.asarray(jax.device_get(carries))
+                        except Exception:
+                            # the carry shards are unreadable too: the
+                            # loss reaches past this round — surface
+                            # the classified verdict; the task-level
+                            # retry (MeshUnavailable is transient)
+                            # re-materializes host-side against the
+                            # quarantined plane
+                            raise err from e
+                        pending = live
+                        demote_reason = "device_loss"
+                        self._emit_demote(metrics, err, rounds, plane)
+                        break
+                    carries = new_carries
+                    rounds += 1
                     counts = np.asarray(rc_h).reshape(n_out, n_out)
                     dest_rows += counts.sum(axis=1)
                     bytes_moved += buffer.add_round(out_cols, counts,
@@ -614,19 +754,198 @@ class ShuffleExchangeOp(PhysicalOp):
                         fmetrics.counter("output_rows").add(
                             int(counts.sum()))
                         fmetrics.counter("output_batches").add(n_live)
-            total = int(dest_rows.sum())
-            skew = (float(dest_rows.max() / max(dest_rows.mean(), 1e-9))
-                    if total else 1.0)
-            metrics.counter("mesh_rounds").add(rounds)
-            metrics.counter("mesh_quota_escalations").add(escalations)
-            _record_route(self, metrics, "all_to_all", reason,
-                          rounds=rounds, escalations=escalations,
-                          bytes=bytes_moved, rows=total,
-                          devices=n_out, skew=round(skew, 3))
-            return buffer
+                    # straggler defense: judge THIS round against the
+                    # rolling p50 BEFORE it joins the window; a stall
+                    # flag the guard forgave is a straggler by
+                    # construction (the round outlived the watchdog
+                    # timeout and still completed). Rounds that BUILT a
+                    # program (first shape class, quota escalation) are
+                    # excluded from verdict AND window — compile time is
+                    # not chip latency, and billing it would demote a
+                    # healthy mesh / inflate the baseline
+                    if round_built:
+                        slow = False
+                    else:
+                        slow = guard.forgiven or plane.round_stats \
+                            .is_straggler(guard.elapsed_s,
+                                          straggler_factor)
+                        plane.round_stats.observe(guard.elapsed_s)
+                    if slow:
+                        plane.record_straggler()
+                        metrics.counter("mesh_stragglers").add(1)
+                        from auron_tpu.obs import trace
+                        trace.event(
+                            "mesh", "mesh.straggler", op=repr(self),
+                            round=rounds - 1,
+                            elapsed_ms=round(guard.elapsed_s * 1e3, 3),
+                            p50_ms=round(
+                                (plane.round_stats.p50() or 0.0) * 1e3,
+                                3),
+                            forgiven_stall=guard.forgiven,
+                            demoting=bool(demote_on_straggler))
+                        if demote_on_straggler:
+                            # the slow round COMPLETED — its received
+                            # rows stay valid on the mesh; only the
+                            # remaining rounds re-route
+                            t_demote = time.perf_counter()
+                            carries_h = np.asarray(
+                                jax.device_get(carries))
+                            demote_reason = "straggler"
+                            self._emit_demote(metrics, None, rounds,
+                                              plane)
+                            break
+            # gang released HERE on every path (the with-block's exit):
+            # the demoted host continuation below must never hold the
+            # mesh, and neighbor queries are never wedged behind a dead
+            # one
+            if demote_reason is None:
+                total = int(dest_rows.sum())
+                skew = (float(dest_rows.max()
+                              / max(dest_rows.mean(), 1e-9))
+                        if total else 1.0)
+                metrics.counter("mesh_rounds").add(rounds)
+                metrics.counter("mesh_quota_escalations").add(escalations)
+                _record_route(self, metrics, "all_to_all", reason,
+                              rounds=rounds, escalations=escalations,
+                              bytes=bytes_moved, rows=total,
+                              devices=n_out, skew=round(skew, 3))
+                return buffer
         except BaseException:
             buffer.close()
             raise
+        plane.record_demotion(demote_reason)
+        return self._demote_to_host(
+            ctx, metrics, write_time, buffer, iters, pending, carries_h,
+            demote_reason, rounds, escalations, bytes_moved, fragments,
+            frag_keys, fmetrics, t_demote)
+
+    def _emit_demote(self, metrics, err, rounds_done: int, plane) -> None:
+        """Put the demotion DECISION on the timeline the moment it is
+        taken (the chaos correlation links the injected fault to this
+        event); the completed continuation's totals follow on the
+        ``exchange.route`` record."""
+        from auron_tpu.obs import trace
+        metrics.counter("mesh_demotions").add(1)
+        trace.event("mesh", "exchange.demote", op=repr(self),
+                    reason="device_loss" if err is not None
+                    else "straggler",
+                    error=type(err).__name__ if err is not None else "",
+                    rounds_completed=rounds_done,
+                    quarantined=plane.quarantined(),
+                    usable=plane.usable_width)
+
+    def _demote_to_host(self, ctx: ExecContext, metrics, write_time,
+                        mesh_buffer: "_MeshExchangeBuffer", iters,
+                        pending, carries_h, demote_reason: str,
+                        rounds_done: int, escalations: int,
+                        bytes_moved: int, fragments, frag_keys,
+                        fmetrics, t_demote: float):
+        """Host continuation of a demoted exchange: the REMAINING rounds
+        re-route down the existing ladder (``all_to_all`` → host
+        ``device_buffer``; RSS stays the durable tier below it), run
+        OUTSIDE the gang — a demoted exchange never holds the mesh.
+
+        Only the lost round's map inputs are recomputed (``pending`` —
+        still live because inputs are never donated into the exchange
+        program), and only rounds the mesh never completed are routed
+        here: already-consumed rounds stay in the mesh buffer and are
+        never re-yielded, the map-by-map streaming contract of the RSS
+        recovery path applied to the SPMD tier. When the mesh program
+        had a fused chain folded in, the same chain folds into the host
+        split program with each map's member carries seeded from the
+        last completed round's carry snapshot — the demoted path keeps
+        computing the SAME rows."""
+        n_out = self.num_partitions
+        out_schema = self.child.schema()
+        part_exprs = self.partitioning.exprs
+        use_frags = bool(fragments)
+        in_schema = (self.child.input if use_frags
+                     else self.child).schema()
+        host = _ExchangeBuffer(self, ctx.mem_manager, metrics, ctx.conf)
+        sources: list[int] = []
+        recompute_rows = 0
+        recompute_bytes = 0
+        host_rows = 0
+        pending_by_map = dict(pending)
+        _sync = ctx.device_sync
+        from auron_tpu.obs import profile as _profile
+
+        def route_batch(in_p: int, batch: DeviceBatch, carries):
+            nonlocal host_rows
+            # the demoted path never donates: a classic one-launch
+            # split per batch (chain folded when the mesh program had
+            # one), entry tagged with its source map so the combined
+            # read path can interleave map-major
+            with timer(write_time, sync=_sync) as t:
+                if use_frags:
+                    kern, _built = _fused_split_program(
+                        frag_keys, ("hash", part_exprs), in_schema,
+                        out_schema, n_out, batch.capacity, False,
+                        fragments, part_exprs)
+                    sorted_batch, counts, carries = t.track(
+                        kern(batch, jnp.int32(in_p), carries))
+                else:
+                    pids = self.partitioning.partition_ids(batch,
+                                                           out_schema)
+                    kern = _sort_by_pid_kernel(n_out, batch.capacity,
+                                               False)
+                    sorted_batch, counts = t.track(kern(batch, pids))
+                counts_h = np.asarray(_profile.timed_get(counts))
+            offsets = np.concatenate(
+                [np.zeros(1, np.int64), np.cumsum(counts_h)])
+            host.add(sorted_batch, offsets)
+            sources.append(in_p)
+            n = int(sorted_batch.num_rows)
+            host_rows += n
+            if fmetrics is not None:
+                fmetrics.counter("output_rows").add(n)
+                fmetrics.counter("output_batches").add(1)
+            return carries, n
+
+        try:
+            for in_p in range(self.input_partitions):
+                if use_frags:
+                    # member carries from the last completed mesh round
+                    # + the trailing split-seen slot (round-robin only —
+                    # mesh routing is hash-only, the slot is inert)
+                    carries = jnp.concatenate([
+                        jnp.asarray(carries_h[in_p], jnp.int64),
+                        jnp.zeros((1,), jnp.int64)])
+                else:
+                    carries = None
+                pend = pending_by_map.pop(in_p, None)
+                if pend is not None:
+                    # the lost round's re-route: its rows are the
+                    # demotion's recompute cost
+                    ctx.checkpoint("exchange.demote")
+                    from auron_tpu.columnar.batch import batch_nbytes
+                    recompute_bytes += batch_nbytes(pend)
+                    carries, n = route_batch(in_p, pend, carries)
+                    recompute_rows += n
+                for batch in iters[in_p]:
+                    # polled() checkpoints per child batch already
+                    carries, _n = route_batch(in_p, batch, carries)
+        except BaseException:
+            # every unwind path releases BOTH halves' consumers (and
+            # the host half's spill files) — the zero-leak contract
+            host.close()
+            mesh_buffer.close()
+            raise
+        latency_ms = round((time.perf_counter() - t_demote) * 1e3, 3)
+        metrics.counter("mesh_rounds").add(rounds_done)
+        metrics.counter("mesh_quota_escalations").add(escalations)
+        _record_route(self, metrics, "demoted", demote_reason,
+                      rounds=rounds_done, escalations=escalations,
+                      bytes=bytes_moved, rows=host_rows,
+                      recompute_rows=recompute_rows,
+                      recompute_bytes=recompute_bytes,
+                      latency_ms=latency_ms, devices=n_out)
+        logger.warning(
+            "mesh exchange demoted to host (%s): %d mesh round(s) kept, "
+            "%d host rows routed, %d rows recomputed from the lost "
+            "round, %.1fms demote-to-reroute latency", demote_reason,
+            rounds_done, host_rows, recompute_rows, latency_ms)
+        return _DemotedExchangeBuffer(mesh_buffer, host, sources, n_out)
 
     def _fill_buffer(self, ctx: ExecContext, buffer: "_ExchangeBuffer",
                      write_time) -> "_ExchangeBuffer":

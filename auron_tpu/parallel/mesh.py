@@ -34,6 +34,7 @@ and a real TPU slice.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
@@ -41,6 +42,8 @@ from contextlib import contextmanager
 from typing import Optional
 
 import numpy as np
+
+logger = logging.getLogger("auron_tpu")
 
 #: buffer-kind → layout decision (the replicate-vs-shard table). Kinds
 #: are declared by operators (``mesh_buffer_kind``); anything undeclared
@@ -58,6 +61,19 @@ _BUFFER_SPECS = {
 def buffer_spec(kind: Optional[str]) -> str:
     """'replicate' | 'shard' for a declared buffer kind (default shard)."""
     return _BUFFER_SPECS.get(kind or "", "shard")
+
+
+def _token_raise(token) -> None:
+    """Raise the token's classified error when it is set (QueryCancelled
+    / DeadlineExceeded by reason; legacy TaskCancelled for bare Events)
+    — the gang door's dequeue-without-starting check."""
+    if token is None or not token.is_set():
+        return
+    raise_for = getattr(token, "raise_for_status", None)
+    if raise_for is not None:
+        raise_for()
+    from auron_tpu.ops.base import TaskCancelled
+    raise TaskCancelled("cancelled while queued for the mesh gang")
 
 
 class MeshPlane:
@@ -79,22 +95,134 @@ class MeshPlane:
         self.gang_acquired = 0
         self.gang_contended = 0
         self.gang_wait_ns = 0
+        # -- fault domain --------------------------------------------------
+        #: quarantined device indices (into self.devices): chips a
+        #: MeshUnavailable was attributed to. Submeshes rebuild from the
+        #: remaining healthy devices; exchanges wider than the healthy
+        #: set route host-side (exchange_route).
+        self._quarantined: set = set()
+        self._quarantine_epoch = 0
+        #: demotion/straggler ledger (stats() + executor finalize "mesh")
+        self.demotions: dict = {}
+        self.stragglers = 0
+        self.device_losses = 0
+        #: rolling per-round duration window (straggler defense baseline)
+        from auron_tpu.runtime.watchdog import MeshRoundStats
+        self.round_stats = MeshRoundStats()
 
     @property
     def num_devices(self) -> int:
         return len(self.devices)
 
+    # -- fault domain --------------------------------------------------------
+
+    def healthy_devices(self) -> list:
+        with self._cond:
+            if not self._quarantined:
+                return list(self.devices)
+            return [d for i, d in enumerate(self.devices)
+                    if i not in self._quarantined]
+
+    @property
+    def usable_width(self) -> int:
+        """Devices still eligible for a submesh (total minus quarantine):
+        the width exchange_route checks the square contract against."""
+        with self._cond:
+            return len(self.devices) - len(self._quarantined)
+
+    def quarantine(self, device_index: Optional[int], reason: str) -> int:
+        """Retire one device from every future submesh. ``device_index``
+        None (XLA carried no device identity) retires the tail device of
+        the current healthy set — deterministic, and shrinking the mesh
+        by one either way (a wrongly blamed healthy chip costs capacity,
+        never correctness). Returns the retired index."""
+        with self._cond:
+            if device_index is not None \
+                    and device_index in self._quarantined:
+                # a stale submesh (built pre-quarantine, e.g. a query
+                # parked at the gang door) re-reporting the SAME dead
+                # chip: already retired — blaming the tail here would
+                # compound one real loss into one lost chip per
+                # concurrent query
+                return device_index
+            healthy = [i for i in range(len(self.devices))
+                       if i not in self._quarantined]
+            if device_index is None or device_index not in healthy:
+                device_index = healthy[-1] if healthy else 0
+            self._quarantined.add(device_index)
+            self._quarantine_epoch += 1
+            self.device_losses += 1
+            # submesh cache entries may include the dead device: drop
+            # them all; mesh_for rebuilds from the healthy set
+            self._meshes.clear()
+        from auron_tpu.obs import trace
+        trace.event("mesh", "mesh.quarantine", device=device_index,
+                    reason=reason, usable=self.usable_width)
+        logger.warning(
+            "mesh fault domain: quarantined device %d (%s); %d/%d "
+            "devices remain usable", device_index, reason,
+            self.usable_width, self.num_devices)
+        try:
+            from auron_tpu.obs import registry as obs_registry
+            if obs_registry.enabled():
+                obs_registry.get_registry().counter(
+                    "auron_mesh_quarantines_total").inc()
+        except Exception:   # pragma: no cover - obs best-effort
+            pass
+        return device_index
+
+    def quarantined(self) -> list:
+        with self._cond:
+            return sorted(self._quarantined)
+
+    def clear_quarantine(self) -> None:
+        """Re-admit every quarantined device (tests / operator reset
+        after the hardware was actually serviced)."""
+        with self._cond:
+            if self._quarantined:
+                self._quarantined.clear()
+                self._quarantine_epoch += 1
+                self._meshes.clear()
+
+    def record_demotion(self, reason: str) -> None:
+        with self._cond:
+            self.demotions[reason] = self.demotions.get(reason, 0) + 1
+        try:
+            from auron_tpu.obs import registry as obs_registry
+            if obs_registry.enabled():
+                obs_registry.get_registry().counter(
+                    "auron_mesh_demotions_total", reason=reason).inc()
+        except Exception:   # pragma: no cover - obs best-effort
+            pass
+
+    def record_straggler(self) -> None:
+        with self._cond:
+            self.stragglers += 1
+        try:
+            from auron_tpu.obs import registry as obs_registry
+            if obs_registry.enabled():
+                obs_registry.get_registry().counter(
+                    "auron_mesh_stragglers_total").inc()
+        except Exception:   # pragma: no cover - obs best-effort
+            pass
+
     def mesh_for(self, n: int):
-        """The leading-n-device submesh (cached): an exchange with n
-        output partitions runs on exactly n devices — the all-to-all's
-        square contract (one output partition per device)."""
+        """The leading-n-HEALTHY-device submesh (cached per quarantine
+        epoch): an exchange with n output partitions runs on exactly n
+        devices — the all-to-all's square contract (one output
+        partition per device). Quarantined devices never join a
+        submesh."""
         from jax.sharding import Mesh
-        m = self._meshes.get(n)
+        with self._cond:
+            epoch = self._quarantine_epoch
+        key = (n, epoch)
+        m = self._meshes.get(key)
         if m is None:
-            assert 1 <= n <= self.num_devices, \
-                f"submesh width {n} exceeds mesh ({self.num_devices})"
-            m = Mesh(np.array(self.devices[:n]), (self.axis,))
-            self._meshes[n] = m
+            healthy = self.healthy_devices()
+            assert 1 <= n <= len(healthy), \
+                f"submesh width {n} exceeds usable mesh ({len(healthy)})"
+            m = Mesh(np.array(healthy[:n]), (self.axis,))
+            self._meshes[key] = m
         return m
 
     # -- gang scheduling -----------------------------------------------------
@@ -127,8 +255,14 @@ class MeshPlane:
         if reentrant:
             yield self
             return
+        from auron_tpu.runtime import faults as _faults
         from auron_tpu.runtime import scheduler as _scheduler
         _scheduler.turn(token)
+        # the gang-door chaos site (mesh.gang:cancel): a cancel racing
+        # the door itself — fired before AND while parked, so both the
+        # uncontended fast path and a parked ticket prove the dequeue-
+        # without-starting contract
+        _faults.maybe_cancel("mesh.gang", token)
         ticket = object()
         qid = (getattr(token, "query_id", "") or "") if token is not None \
             else ""
@@ -137,20 +271,17 @@ class MeshPlane:
         with self._cond:
             self._queue.append(ticket)
             try:
+                # a cancel that landed BEFORE the door (or the injected
+                # one above) dequeues here — the round never starts
+                _token_raise(token)
                 while self._holder is not None \
                         or self._queue[0] is not ticket:
                     contended = True
                     if heartbeat is not None:
                         heartbeat.beat("mesh.gang")
                     self._cond.wait(0.05)
-                    if token is not None and token.is_set():
-                        raise_for = getattr(token, "raise_for_status",
-                                            None)
-                        if raise_for is not None:
-                            raise_for()
-                        from auron_tpu.ops.base import TaskCancelled
-                        raise TaskCancelled(
-                            "cancelled while queued for the mesh gang")
+                    _faults.maybe_cancel("mesh.gang", token)
+                    _token_raise(token)
             except BaseException:
                 self._queue.remove(ticket)
                 self._cond.notify_all()
@@ -185,7 +316,13 @@ class MeshPlane:
                     "gang_contended": self.gang_contended,
                     "gang_wait_ms": round(self.gang_wait_ns / 1e6, 3),
                     "gang_holder": self._holder,
-                    "gang_queued": len(self._queue)}
+                    "gang_queued": len(self._queue),
+                    "quarantined": sorted(self._quarantined),
+                    "usable": (len(self.devices)
+                               - len(self._quarantined)),
+                    "demotions": dict(self.demotions),
+                    "stragglers": self.stragglers,
+                    "device_losses": self.device_losses}
 
 
 #: (params, plane) — the plane persists across UNRELATED config flips
@@ -247,6 +384,16 @@ def reset_plane() -> None:
         _EPOCH = -1
 
 
+def clear_quarantine() -> None:
+    """Re-admit quarantined devices on the cached plane regardless of
+    the current ``auron.mesh.enabled`` value (test/chaos hygiene: a
+    quarantine injected by one run must not silently reroute the
+    next)."""
+    plane = _PLANE[1]
+    if plane is not None:
+        plane.clear_quarantine()
+
+
 # ---------------------------------------------------------------------------
 # routing decision (the exchange's eligibility check, unit-testable pure)
 # ---------------------------------------------------------------------------
@@ -266,9 +413,21 @@ def exchange_route(partitioning, num_partitions: int,
                 f"partitioning_{type(partitioning).__name__}")
     if num_partitions < 2:
         return "device_buffer", "single_output"
-    if num_partitions > plane.num_devices:
+    # the square contract is checked against the HEALTHY width: after a
+    # quarantine the plane rebuilds a smaller submesh while
+    # 2 <= num_partitions <= usable still holds, and routes host-side
+    # (with the reason telling you WHY) once it does not
+    usable = getattr(plane, "usable_width", plane.num_devices)
+    if num_partitions > usable:
+        # blame the quarantine only when it is what actually broke the
+        # square contract — an exchange wider than the FULL mesh never
+        # had a mesh route to lose
+        if usable < plane.num_devices \
+                and num_partitions <= plane.num_devices:
+            return ("device_buffer",
+                    f"mesh_quarantined_{usable}<{num_partitions}")
         return ("device_buffer",
-                f"mesh_too_narrow_{plane.num_devices}<{num_partitions}")
+                f"mesh_too_narrow_{usable}<{num_partitions}")
     if input_partitions > num_partitions:
         return ("device_buffer",
                 f"fan_in_exceeds_mesh_{input_partitions}>{num_partitions}")

@@ -44,6 +44,55 @@ def make_mesh(num_devices: int | None = None, axis: str = "data") -> Mesh:
 
 
 # ---------------------------------------------------------------------------
+# collective boundary: fault site + device-loss classification
+# ---------------------------------------------------------------------------
+
+def round_fault_check(cancel=None) -> None:
+    """The per-round injection site of the mesh fault domain
+    (``mesh.all_to_all``): fired once before every all-to-all round.
+    ``io_error`` raises the classified :class:`errors.MeshUnavailable`
+    (simulated device loss — the exchange's demotion handler routes the
+    remaining rounds host-side), ``fatal`` an InjectedFatalError
+    carrying this site (same demotion: a deterministically failing mesh
+    is recovered by routing AROUND it), ``hang`` a straggling chip (the
+    sleep lands inside the round guard's timer, so the straggler
+    defense sees it)."""
+    from auron_tpu import errors
+    from auron_tpu.runtime import faults
+    faults.maybe_fail("mesh.all_to_all", errors.MeshUnavailable,
+                      cancel=cancel)
+
+
+def classify_collective(e: BaseException) -> BaseException:
+    """Classification at the collective boundary: a bare RuntimeError
+    crossing out of a shard_map program routes through
+    ``errors.classify_runtime``, whose device-loss signatures become
+    :class:`errors.MeshUnavailable` — the verdict the demotion ladder
+    keys on. Already-classified errors pass through unchanged."""
+    from auron_tpu import errors
+    if isinstance(e, errors.AuronError) or not isinstance(e, RuntimeError):
+        return e
+    return errors.classify_runtime(e)
+
+
+def is_mesh_loss(e: BaseException) -> bool:
+    """True when ``e`` is the mesh fault domain's DEMOTABLE class: a
+    classified device loss (MeshUnavailable, injected or real) or any
+    classified error raised AT a mesh fault site (an injected ``fatal``
+    at ``mesh.all_to_all`` carries the site — a deterministic failure
+    of the mesh plane is recovered by demotion, not by retrying the
+    same collective). Errors from the map-side CHILD operators (e.g.
+    ``device.compute`` faults inside the drive loop) are NOT mesh
+    losses: they keep their own recovery semantics (task retry /
+    surfaced verdict)."""
+    from auron_tpu import errors
+    if isinstance(e, errors.MeshUnavailable):
+        return True
+    return (isinstance(e, errors.AuronError)
+            and (getattr(e, "site", None) or "").startswith("mesh."))
+
+
+# ---------------------------------------------------------------------------
 # sharded stage-exchange program (the SPMD execution plane's workhorse)
 # ---------------------------------------------------------------------------
 

@@ -457,9 +457,29 @@ def run_task_with_retries(plan: PhysicalOp, partition: int,
             if attempt >= retries:
                 break
             retry_stats["transient_retries"] += 1
-            logger.warning(
-                "task attempt %d/%d failed for partition %d (%s); "
-                "retrying", attempt + 1, retries + 1, partition, e)
+            if isinstance(e, errors.MeshUnavailable):
+                # a device loss that ESCAPED the exchange's in-place
+                # demotion (e.g. prior rounds' mesh-resident shards were
+                # unreadable too): the retry re-routes against the
+                # already-quarantined plane, so name that in the log —
+                # this recompute will run host-side, not re-enter the
+                # dead chip
+                try:
+                    from auron_tpu.parallel import mesh as _mesh
+                    plane = _mesh.current_plane()
+                    quarantined = (plane.quarantined()
+                                   if plane is not None else [])
+                except Exception:   # pragma: no cover - log best-effort
+                    quarantined = []
+                logger.warning(
+                    "task attempt %d/%d lost a mesh device for "
+                    "partition %d (%s); retrying against the "
+                    "quarantined plane (quarantined=%s)",
+                    attempt + 1, retries + 1, partition, e, quarantined)
+            else:
+                logger.warning(
+                    "task attempt %d/%d failed for partition %d (%s); "
+                    "retrying", attempt + 1, retries + 1, partition, e)
             delay = _retry_backoff_s(attempt, backoff, backoff_cap)
             if cancel_token is not None:
                 rem = cancel_token.remaining()

@@ -47,6 +47,19 @@ Named sites threaded through the engine:
     backend.init                                        (watchdog probe)
     memmgr.deny                                         (pressure ladder)
     sched.admit                                         (admission control)
+    mesh.all_to_all                                     (per sharded round)
+    mesh.gang                                           (gang door, cancel)
+
+``mesh.all_to_all`` fires once per all-to-all round of a mesh-routed
+exchange: ``io_error`` raises the classified ``errors.MeshUnavailable``
+(a lost device — the demotion ladder must route the exchange's
+remaining rounds host-side), ``fatal`` an InjectedFatalError carrying
+the mesh site (same demotion path: a deterministic mesh failure is
+recovered by routing AROUND the mesh, not by retrying into it), and
+``hang`` a straggling chip (the straggler defense's signal).
+``mesh.gang`` (kind ``cancel``) fires the task's cancel registry while
+it queues at the gang door — the parked ticket must dequeue without
+ever starting a round.
 
 The plane is resolved from the PROCESS-GLOBAL config (the sites live in
 code paths with no ExecContext at hand — file services, spill files),
@@ -70,6 +83,7 @@ SITES = (
     "spill.write", "spill.read",
     "device.compute", "program.build", "backend.init",
     "task.hang", "cancel.race", "memmgr.deny", "sched.admit",
+    "mesh.all_to_all", "mesh.gang",
 )
 
 KINDS = ("io_error", "fatal", "corrupt", "hang", "cancel", "deny")

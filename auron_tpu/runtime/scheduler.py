@@ -495,7 +495,11 @@ class QueryScheduler:
         # (one slot = the mesh — parallel/mesh.MeshPlane.gang takes this
         # scheduler's WRR turn on entry, so fairness operates BETWEEN
         # sharded stages); surfaced here so load/mesh reports show the
-        # mesh occupancy next to the query-slot numbers
+        # mesh occupancy next to the query-slot numbers. The plane's
+        # stats also carry its FAULT DOMAIN ledger (quarantined devices,
+        # usable width, demotions by reason, straggler/device-loss
+        # counts) — an operator reading the scheduler surface sees a
+        # degraded mesh, not just a slow one
         try:
             from auron_tpu.parallel import mesh as _mesh
             plane = _mesh.current_plane()

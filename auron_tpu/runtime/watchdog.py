@@ -45,6 +45,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -53,7 +54,8 @@ from auron_tpu import errors
 logger = logging.getLogger("auron_tpu")
 
 _LOCK = threading.Lock()
-_STATS = {"probes": 0, "timeouts": 0, "fallbacks": 0, "stalls": 0}
+_STATS = {"probes": 0, "timeouts": 0, "fallbacks": 0, "stalls": 0,
+          "mesh_rounds_forgiven": 0}
 
 #: bump when ProbeReport.to_dict() keys change (consumers: bench.py's
 #: ``probe_report`` field, probe_report.json next to traces, and the
@@ -812,6 +814,140 @@ def write_stall_report(report: StallReport,
     except Exception:   # pragma: no cover - best-effort sink
         logger.exception("stall report write to %r failed", dir_path)
         return None
+
+
+# ---------------------------------------------------------------------------
+# mesh fault domain: per-round gang-aware liveness + straggler defense
+# ---------------------------------------------------------------------------
+#
+# A gang-scheduled all-to-all round blocks the driving thread inside an
+# uninterruptible collective, so the stall monitor above will flag the
+# task silent — but a flagged ROUND is not automatically a dead one. The
+# guard below is the arbiter at the round boundary:
+#
+# - a round that COMPLETES after being flagged was merely SLOW (a
+#   straggling chip): the guard forgives the stall (clears the flag and
+#   re-beats, exactly like the compile-credit precedent — waiting out a
+#   slow collective is liveness, not a wedge) and hands the duration to
+#   the straggler defense;
+# - a round that RAISES is DEAD: the error classifies at the collective
+#   boundary (errors.classify_runtime → MeshUnavailable) and the
+#   exchange's demotion handler routes the remaining rounds host-side;
+# - a round that NEVER RETURNS is beyond cooperative recovery — the
+#   StallReport is the diagnosis and the query deadline the hard bound,
+#   same contract as the init watchdog.
+
+
+class MeshRoundStats:
+    """Rolling per-round duration window: the straggler defense's
+    baseline. ``observe`` feeds a bounded deque (and the registry
+    histogram ``auron_mesh_round_seconds``); ``is_straggler`` compares
+    one round against ``factor`` × the rolling p50, arming only after
+    ``min_rounds`` observations so the first cold-compile rounds never
+    self-report. Pure host arithmetic — unit-testable without a mesh."""
+
+    def __init__(self, window: int = 64, min_rounds: int = 4):
+        self.min_rounds = min_rounds
+        self._durations: deque = deque(maxlen=window)
+        self._lock = threading.Lock()
+
+    def p50(self) -> Optional[float]:
+        with self._lock:
+            if len(self._durations) < self.min_rounds:
+                return None
+            ordered = sorted(self._durations)
+            return ordered[len(ordered) // 2]
+
+    def is_straggler(self, seconds: float, factor: float) -> bool:
+        """Verdict BEFORE ``seconds`` joins the window (a straggler must
+        not drag the baseline it is judged against)."""
+        if factor <= 0:
+            return False
+        p50 = self.p50()
+        return p50 is not None and p50 > 0 and seconds > factor * p50
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._durations.append(seconds)
+        try:
+            from auron_tpu.obs import registry as obs_registry
+            if obs_registry.enabled():
+                obs_registry.get_registry().histogram(
+                    "auron_mesh_round_seconds").observe(seconds)
+        except Exception:   # pragma: no cover - obs best-effort
+            pass
+
+
+class MeshRoundGuard:
+    """Context manager around ONE all-to-all round (dispatch + the
+    output-boundary readback): beats the task heartbeat on entry with
+    the ``mesh.round`` site, measures the round, and — when the stall
+    monitor flagged the task MID-round but the round then completed —
+    forgives the stall (slow, not dead; see the module section comment).
+    After exit, ``elapsed_s`` carries the round duration for the
+    straggler defense and ``forgiven`` whether a stall verdict was
+    downgraded."""
+
+    def __init__(self, heartbeat: Optional[TaskHeartbeat]):
+        self.heartbeat = heartbeat
+        self.elapsed_s = 0.0
+        self.forgiven = False
+        self._t0 = 0
+        self._stalled_on_entry = False
+
+    def __enter__(self) -> "MeshRoundGuard":
+        hb = self.heartbeat
+        if hb is not None:
+            self._stalled_on_entry = hb.stalled
+            if not hb.stalled:
+                hb.beat("mesh.round")
+        self._t0 = _now_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.elapsed_s = (_now_ns() - self._t0) * 1e-9
+        hb = self.heartbeat
+        if hb is None:
+            return
+        if exc_type is None and hb.stalled and not self._stalled_on_entry:
+            # flagged DURING a round that completed: slow, not dead —
+            # forgive (a pre-existing flag is someone else's verdict and
+            # survives; the exchange's straggler defense takes it from
+            # here)
+            self.forgive_stall()
+        elif exc_type is None and not hb.stalled:
+            hb.beat("mesh.round")
+
+    def forgive_stall(self) -> None:
+        """Downgrade a stall flagged MID-round to a slow round. Called
+        by ``__exit__`` for completed rounds, and by the exchange's
+        DEMOTION handler for failed ones — the loss is being recovered
+        in place, and a pending TaskStalled would abort (at the next
+        checkpoint) exactly the recovery it was supposed to enable. A
+        flag that predates the round is someone else's verdict and is
+        never cleared here."""
+        hb = self.heartbeat
+        if hb is None or not hb.stalled or self._stalled_on_entry:
+            return
+        hb.stalled = False
+        hb.stalled_at_ns = 0
+        hb.beat("mesh.round")
+        self.forgiven = True
+        _count("mesh_rounds_forgiven")
+        try:
+            from auron_tpu.obs import trace
+            trace.event("watchdog", "watchdog.round_slow",
+                        task=hb.task_id,
+                        elapsed_s=round(self.elapsed_s, 3),
+                        stall_timeout_s=hb.timeout_s)
+        except Exception:   # pragma: no cover - obs best-effort
+            pass
+
+
+def mesh_rounds_forgiven() -> int:
+    """Monotonic count of stall verdicts downgraded to slow rounds."""
+    with _LOCK:
+        return _STATS["mesh_rounds_forgiven"]
 
 
 def first_compile_probe(config=None) -> Optional[float]:

@@ -491,6 +491,8 @@ def _mesh_child_main() -> None:
         conf.set(cfg.TRACE_DIR, "")
         per_count = {}
         routes = {}
+        route_mix = {}
+        demoted = {}
         bytes_moved = {}
         for n in counts:
             # devices == partitions: the exchange's square contract; at
@@ -504,28 +506,51 @@ def _mesh_child_main() -> None:
                 t0 = time.perf_counter()
                 q01_dataframe(Session(), tables, partitions=n).collect()
                 best = min(best, time.perf_counter() - t0)
-            evs = [s for s in trace.tracer().spans()
-                   if s.name == "exchange.route"
+            spans = trace.tracer().spans()
+            evs = [s for s in spans if s.name == "exchange.route"
                    and s.attrs.get("route") == "all_to_all"]
+            # the FULL route mix per exchange, demotions included: a
+            # run whose rounds fell back to host mid-exchange
+            # (exchange.demote) measures the recovery path, not the
+            # mesh — perf_gate must see that and skip the floor
+            mix: dict = {}
+            for s in spans:
+                if s.name == "exchange.route":
+                    r = s.attrs.get("route", "?")
+                    mix[r] = mix.get(r, 0) + 1
             per_count[str(n)] = round(rows / best, 1)
             routes[str(n)] = len(evs)
+            route_mix[str(n)] = mix
+            demoted[str(n)] = sum(1 for s in spans
+                                  if s.name == "exchange.demote")
             bytes_moved[str(n)] = sum(int(s.attrs.get("bytes", 0))
                                       for s in evs)
             trace.reset()
         record["rows_per_sec_by_devices"] = per_count
         record["route_all_to_all_by_devices"] = routes
+        record["route_mix_by_devices"] = route_mix
+        record["route_demoted_by_devices"] = demoted
         record["mesh_bytes_moved_by_devices"] = bytes_moved
         top = str(max(counts))
         # any multi-device top count MUST have ridden the all-to-all —
         # keyed on the top count itself, not the sweep width, so a
         # single-count AURON_BENCH_MESH_COUNTS=8 run is still verified
-        if int(top) > 1 and routes.get(top, 0) < 1:
-            # the mesh path never engaged — the figure would be a lie
+        if int(top) > 1 and routes.get(top, 0) < 1 \
+                and demoted.get(top, 0) < 1:
+            # the mesh path never engaged — the figure would be a lie.
+            # (A demotion at the top count is NOT this case: the mesh
+            # engaged and recovered — fall through so the run carries
+            # the mesh_demoted skip flag instead of failing the gate.)
             record["error"] = (f"no all_to_all route recorded at "
                                f"{top} devices")
         else:
             record["mesh_rows_per_sec"] = per_count[top]
             record["devices"] = int(top)
+            # demoted rounds at the gated count: the figure is a
+            # recovery-path measurement — recorded for the report,
+            # flagged so perf_gate neither fails nor passes the mesh
+            # floor on it
+            record["mesh_demoted"] = demoted.get(top, 0) > 0
             base = per_count.get(str(counts[0]), 0.0)
             if base:
                 record["scaling_factor"] = round(

@@ -188,6 +188,38 @@ class TestPerfGate:
         assert v["mesh"]["verdict"] == "pass"
         assert v["perf_gate"] == "pass"
 
+    def test_mesh_demoted_run_never_miscounted(self):
+        """ISSUE 12 satellite: a bench run whose mesh rounds demoted to
+        host measured the RECOVERY path — it must neither fail the mesh
+        floor (even at host-tier throughput far below it) nor pass it
+        (even at or above baseline); the demotion is recorded in the
+        verdict instead."""
+        base = _baseline()
+        cpu = base["platforms"]["cpu"]["rows_per_sec"]
+        m = base["platforms"]["mesh"]
+        # far below the floor, but demoted: skipped, not failed
+        demoted = self._healthy_mesh(base)
+        demoted["mesh_rows_per_sec"] = m["rows_per_sec"] * 0.1
+        demoted["mesh_demoted"] = True
+        demoted["route_demoted_by_devices"] = {"8": 1}
+        rec = {"value": cpu * 1.2, "platform": "cpu",
+               "profile": _healthy_profile(base), "mesh": demoted}
+        v = perf_gate.evaluate(rec, base, tolerance_pct=50.0)
+        assert v["mesh"]["verdict"] == "skipped"
+        assert "demoted" in v["mesh"]["reason"]
+        assert v["perf_gate"] == "pass"
+        # at-baseline but demoted: still skipped (never counts TOWARD)
+        healthy_but_demoted = self._healthy_mesh(base)
+        healthy_but_demoted["route_demoted_by_devices"] = {"8": 2}
+        rec["mesh"] = healthy_but_demoted
+        v = perf_gate.evaluate(rec, base, tolerance_pct=50.0)
+        assert v["mesh"]["verdict"] == "skipped"
+        # an un-demoted run still gates normally
+        rec["mesh"] = self._healthy_mesh(base)
+        rec["mesh"]["route_demoted_by_devices"] = {"8": 0}
+        v = perf_gate.evaluate(rec, base, tolerance_pct=50.0)
+        assert v["mesh"]["verdict"] == "pass"
+
     def test_mesh_errored_bench_fails_loudly(self):
         """A bench that TRIED the mesh measurement and failed records
         mesh_error — the gate fails (the silent-decay hole stays

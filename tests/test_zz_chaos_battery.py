@@ -65,6 +65,18 @@ _PLANS = [
     ("mesh_pipeline", "device.compute:io_error@0.3"),
     ("mesh_pipeline", "device.compute:fatal@0.5"),
     ("mesh_pipeline", "program.build:io_error@0.2"),
+    # mesh fault domain (ISSUE 12): device loss per all-to-all round —
+    # io_error (MeshUnavailable) and fatal both recover by ROUTE
+    # DEMOTION (bit-identical, so these runs end "identical", not just
+    # classified), hang exercises the straggler defense's slow-round
+    # path, and mesh.gang:cancel proves the gang door dequeues a
+    # cancelled ticket without starting a round
+    ("mesh_pipeline", "mesh.all_to_all:io_error@0.3"),
+    ("mesh_pipeline", "mesh.all_to_all:fatal@0.5"),
+    ("mesh_pipeline", "mesh.all_to_all:hang@0.15"),
+    ("mesh_pipeline", "mesh.gang:cancel@0.5"),
+    ("mesh_pipeline",
+     "mesh.all_to_all:io_error@0.2;device.compute:io_error@0.1"),
     # concurrency battery (the [serving] scheduler plane): three
     # queries race one clamped Session under admission denies and
     # forced memory pressure — shed-not-crash, identical-or-classified,

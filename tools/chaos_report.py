@@ -62,6 +62,15 @@ PLANS = [
     ("overload", "sched.admit:deny@0.5"),
     ("overload", "memmgr.deny:deny@0.4"),
     ("overload", "sched.admit:deny@0.3;memmgr.deny:deny@0.3"),
+    # mesh fault domain (ISSUE 12): per-round device losses recover by
+    # route demotion (identical, not merely classified), hangs drive
+    # the straggler defense, gang-door cancels dequeue cleanly
+    ("mesh_pipeline", "mesh.all_to_all:io_error@0.3"),
+    ("mesh_pipeline", "mesh.all_to_all:fatal@0.5"),
+    ("mesh_pipeline", "mesh.all_to_all:hang@0.15"),
+    ("mesh_pipeline", "mesh.gang:cancel@0.5"),
+    ("mesh_pipeline",
+     "mesh.all_to_all:io_error@0.2;device.compute:io_error@0.1"),
 ]
 
 
@@ -96,6 +105,43 @@ def lifecycle_summary() -> dict:
     try:
         from auron_tpu.runtime import watchdog
         out["stall_detections"] = watchdog.stall_totals()
+    except Exception:
+        pass
+    return out
+
+
+def mesh_summary() -> dict:
+    """Mesh-recovery telemetry accumulated over the sweep: route
+    demotions by reason (device_loss vs straggler), device-loss
+    quarantines, straggler detections and stall verdicts the round
+    guard downgraded to slow rounds — the fault domain's ledger
+    alongside the per-(plan, seed) contract table."""
+    out = {"demotions": {}, "quarantines": 0, "stragglers": 0,
+           "rounds_forgiven": 0, "device_losses": 0}
+    try:
+        from auron_tpu.obs import registry as obs_registry
+        snap = obs_registry.get_registry().snapshot()
+        for key, val in snap.items():
+            if key.startswith("auron_mesh_demotions_total"):
+                reason = key.split('reason="')[1].rstrip('"}') \
+                    if 'reason="' in key else "?"
+                out["demotions"][reason] = int(val)
+            elif key.startswith("auron_mesh_quarantines_total"):
+                out["quarantines"] = int(val)
+            elif key.startswith("auron_mesh_stragglers_total"):
+                out["stragglers"] = int(val)
+    except Exception:
+        pass
+    try:
+        from auron_tpu.runtime import watchdog
+        out["rounds_forgiven"] = watchdog.mesh_rounds_forgiven()
+    except Exception:
+        pass
+    try:
+        from auron_tpu.parallel import mesh as mesh_mod
+        plane = mesh_mod._PLANE[1]
+        if plane is not None:
+            out["device_losses"] = plane.device_losses
     except Exception:
         pass
     return out
@@ -144,7 +190,8 @@ def run_sweep(seeds: int, scenario_filter: str | None) -> dict:
             rows.append({"scenario": scen_name, "plan": plan,
                          "injected": injected, "leaked": leaked, **agg})
     return {"seeds": seeds, "rows": rows, "failures": failures,
-            "sites": sites, "lifecycle": lifecycle_summary()}
+            "sites": sites, "lifecycle": lifecycle_summary(),
+            "mesh": mesh_summary()}
 
 
 def print_table(report: dict) -> None:
@@ -198,6 +245,20 @@ def print_table(report: dict) -> None:
                           sorted(life.get("admission_sheds", {}).items())) \
             or "-"
         print(f"  admission sheds: {sheds}")
+    m = report.get("mesh") or {}
+    if m.get("demotions") or m.get("quarantines") or m.get("stragglers") \
+            or m.get("rounds_forgiven"):
+        print()
+        print("mesh recovery (route demotions / quarantines / "
+              "straggler defense)")
+        dem = ", ".join(f"{k}x{v}" for k, v in
+                        sorted(m.get("demotions", {}).items())) or "-"
+        print(f"  route demotions by reason: {dem}")
+        print(f"  device-loss quarantines: {m.get('quarantines', 0)} "
+              f"(losses recorded: {m.get('device_losses', 0)})")
+        print(f"  straggler rounds: {m.get('stragglers', 0)} "
+              f"(stall verdicts forgiven as slow rounds: "
+              f"{m.get('rounds_forgiven', 0)})")
     for f in report["failures"]:
         print(f"CONTRACT BROKEN: {f['scenario']} plan={f['plan']!r} "
               f"seed={f['seed']} trace={f.get('trace_id', 0)} -> "
@@ -211,6 +272,7 @@ def main(argv=None) -> int:
                     help="seeds per (scenario, plan) pair")
     ap.add_argument("--scenario", choices=["rss_pipeline", "spill_sort",
                                            "agg_pipeline",
+                                           "mesh_pipeline",
                                            "lifecycle_pipeline",
                                            "overload"],
                     default=None)
@@ -228,6 +290,7 @@ def main(argv=None) -> int:
                                             for r in report["rows"]),
                       "chaos_sites": report.get("sites") or {},
                       "chaos_lifecycle": report.get("lifecycle") or {},
+                      "chaos_mesh": report.get("mesh") or {},
                       "chaos_contract_ok": ok}))
     return 0 if ok else 1
 

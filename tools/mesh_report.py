@@ -16,7 +16,11 @@ cross-query serialization cost of "one slot = the mesh").
     python tools/mesh_report.py --compare /tmp/base /tmp/candidate
 
 ``--compare`` diffs two trace dirs (e.g. mesh off vs on): per-route
-exchange counts and bytes side by side.
+exchange counts and bytes side by side, PLUS the fault domain's
+recovery ledger (``exchange.demote`` / ``mesh.straggler`` /
+``mesh.quarantine`` events) — a candidate round that silently started
+demoting rounds to host or breeding stragglers is a recovery-path
+regression this diff makes visible, not a throughput mystery.
 """
 
 from __future__ import annotations
@@ -53,6 +57,18 @@ def gang_events(events: list[dict]) -> list[dict]:
     return [e for e in events if e.get("name") == "mesh.gang"]
 
 
+def demote_events(events: list[dict]) -> list[dict]:
+    return [e for e in events if e.get("name") == "exchange.demote"]
+
+
+def straggler_events(events: list[dict]) -> list[dict]:
+    return [e for e in events if e.get("name") == "mesh.straggler"]
+
+
+def quarantine_events(events: list[dict]) -> list[dict]:
+    return [e for e in events if e.get("name") == "mesh.quarantine"]
+
+
 def summarize(events: list[dict]) -> dict:
     """Aggregate per-route totals for one trace dir (the --compare
     unit): exchange counts, bytes, rounds, escalations."""
@@ -69,6 +85,11 @@ def summarize(events: list[dict]) -> dict:
         ent["rounds"] += int(a.get("rounds", 0))
         ent["escalations"] += int(a.get("escalations", 0))
     gangs = gang_events(events)
+    demotes = demote_events(events)
+    dem_by_reason: dict = {}
+    for e in demotes:
+        r = e.get("attrs", {}).get("reason", "?")
+        dem_by_reason[r] = dem_by_reason.get(r, 0) + 1
     return {
         "by_route": agg,
         "gang": {
@@ -79,6 +100,12 @@ def summarize(events: list[dict]) -> dict:
                                        .get("wait_ms", 0.0))
                                  for g in gangs), 3),
         },
+        # the fault domain's recovery ledger: a recovery-path
+        # regression (new demotions, new stragglers) must be visible
+        # between rounds via --compare
+        "demotions": dem_by_reason,
+        "stragglers": len(straggler_events(events)),
+        "quarantines": len(quarantine_events(events)),
     }
 
 
@@ -113,6 +140,18 @@ def print_table(events: list[dict]) -> None:
         print(f"mesh gang: {g['acquisitions']} acquisition(s), "
               f"{g['contended']} contended, "
               f"total wait {g['wait_ms']}ms")
+    if s["demotions"] or s["stragglers"] or s["quarantines"]:
+        dem = ", ".join(f"{k}x{v}"
+                        for k, v in sorted(s["demotions"].items())) or "-"
+        print(f"mesh recovery: demotions {dem}; "
+              f"{s['stragglers']} straggler round(s); "
+              f"{s['quarantines']} quarantine(s)")
+        for e in demote_events(events):
+            a = e.get("attrs", {})
+            print(f"  demote [{a.get('reason', '?')}] "
+                  f"after {a.get('rounds_completed', '?')} mesh "
+                  f"round(s), usable={a.get('usable', '?')} "
+                  f"quarantined={a.get('quarantined', [])}")
 
 
 def print_compare(base_dir: str, cand_dir: str) -> None:
@@ -131,6 +170,20 @@ def print_compare(base_dir: str, cand_dir: str) -> None:
           f"({base['gang']['acquisitions']} acq) -> cand "
           f"{cand['gang']['wait_ms']}ms "
           f"({cand['gang']['acquisitions']} acq)")
+    # recovery-path delta: demotions/stragglers appearing only on the
+    # candidate side are the regression --compare exists to catch
+    bd = sum(base["demotions"].values())
+    cd = sum(cand["demotions"].values())
+    print(f"{'demotions':<14} {bd:>8} {cd:>8}   "
+          f"base {base['demotions'] or '-'} -> cand "
+          f"{cand['demotions'] or '-'}")
+    print(f"{'stragglers':<14} {base['stragglers']:>8} "
+          f"{cand['stragglers']:>8}")
+    print(f"{'quarantines':<14} {base['quarantines']:>8} "
+          f"{cand['quarantines']:>8}")
+    if cd > bd or cand["stragglers"] > base["stragglers"]:
+        print("WARNING: candidate run demoted/straggled more than base "
+              "— a mesh recovery-path regression, not a perf win")
 
 
 def main(argv=None) -> int:

@@ -189,6 +189,24 @@ def evaluate(record: dict, baseline: dict, tolerance_pct: float,
                     "reason": f"mesh devices {mrec.get('devices')} != "
                               f"baseline devices {mdev}",
                 }
+            elif mrec.get("mesh_demoted") or (mrec.get(
+                    "route_demoted_by_devices") or {}).get(
+                    str(mrec.get("devices", 0)), 0):
+                # a run whose rounds demoted to host mid-exchange
+                # measured the RECOVERY path, not the mesh: it must
+                # neither fail the floor (the demotion worked as
+                # designed) nor pass it (host throughput is not a mesh
+                # figure) — recorded and reported, never miscounted
+                verdict["mesh"] = {
+                    "verdict": "skipped",
+                    "reason": "mesh rounds demoted to host mid-run "
+                              "(recovery path measured, not the mesh)",
+                    "value_rows_per_sec": round(
+                        float(mrec["mesh_rows_per_sec"]), 1),
+                    "route_demoted": mrec.get(
+                        "route_demoted_by_devices"),
+                    "route_mix": mrec.get("route_mix_by_devices"),
+                }
             else:
                 mval = float(mrec["mesh_rows_per_sec"])
                 mbase = float(mentry["rows_per_sec"])
@@ -205,6 +223,7 @@ def evaluate(record: dict, baseline: dict, tolerance_pct: float,
                     "scaling_factor": mrec.get("scaling_factor"),
                     "route_all_to_all": mrec.get(
                         "route_all_to_all_by_devices"),
+                    "route_mix": mrec.get("route_mix_by_devices"),
                 }
                 if mval < mfloor:
                     verdict["perf_gate"] = "fail"
