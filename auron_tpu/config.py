@@ -330,6 +330,63 @@ TASK_RETRY_BACKOFF_MAX_S = _opt(
     "bound): attempt k draws its sleep from [0, min(cap, "
     "retry_backoff_s * 2^k)].")
 
+# crash-safe query journal (runtime/journal.py)
+JOURNAL_DIR = _opt(
+    "auron.journal.dir", str, "",
+    "Directory of the crash-safe query journal. When set, every "
+    "top-level query writes a per-query journal (plan fingerprint, "
+    "source-snapshot fingerprints, the exchange DAG, and an "
+    "append-only log of committed RSS map outputs recorded at the "
+    "durable tier's existing commit() boundary), and the planner "
+    "routes the query's shuffle exchanges through the durable RSS tier "
+    "under <dir>/rss/<journal stem> so shuffle stages survive the "
+    "process. After a crash, Session.resume(query_id) — or "
+    "re-submission of the identical plan with auron.journal.reuse on — "
+    "re-plans, validates the fingerprints, skips the map side of every "
+    "fully-committed exchange (reducers fetch straight from the "
+    "journaled RSS files), skips individual committed map outputs of "
+    "partially-committed hash/round-robin/single exchanges, and "
+    "recomputes only what the durable tier never received — resumed "
+    "results are bit-identical to a fresh run, group order included. "
+    "Journals are deleted at query completion (and by Session.close); "
+    "a startup sweep garbage-collects journals/RSS run directories "
+    "whose owning process is dead and whose state is not resumable "
+    "(utils/liveness.py pid+epoch check). Empty (default) disables "
+    "journaling entirely: shuffles stay on the in-memory device-buffer "
+    "tier and a crash loses in-flight queries (the pre-journal "
+    "posture).")
+JOURNAL_REUSE = _opt(
+    "auron.journal.reuse", bool, True,
+    "Allow Session.execute to ADOPT an existing resumable journal "
+    "whose plan fingerprint AND source-snapshot fingerprints match the "
+    "submitted query (the crashed-and-resubmitted dashboard case): the "
+    "adopted journal's committed exchanges are skipped exactly like "
+    "Session.resume. Only journals not currently open in a live "
+    "process are adoptable; fingerprint mismatch or a corrupt journal "
+    "falls back to a fresh run (classified handling, never a wrong "
+    "answer). Off mints a fresh journal per submission.")
+JOURNAL_RETENTION_S = _opt(
+    "auron.journal.retention_s", float, 7 * 24 * 3600.0,
+    "Age cap on the resume inventory: the startup sweep garbage-"
+    "collects a DEAD process's resumable journal — and with it the "
+    "journal's RSS run directory holding real shuffle bytes — once "
+    "the journal file has not been touched for this many seconds. "
+    "Without a cap, a long-lived deployment with a steady trickle of "
+    "failed-and-never-resumed queries (suspended serving tasks, "
+    "crashed dashboards nobody re-opens) accumulates journals and "
+    "multi-MB RSS dirs until the disk fills. <= 0 keeps the inventory "
+    "indefinitely.")
+JOURNAL_FSYNC = _opt(
+    "auron.journal.fsync", bool, True,
+    "fsync the journal at its durability boundaries only: the header "
+    "write and each shuffle-level commit record (map-output records "
+    "ride the async appender and are made durable by the next commit "
+    "fsync — the journal never claims more than the RSS tier holds, "
+    "because records are appended AFTER the durable tier's atomic "
+    "rename). Off skips the fsync (journal durability then depends on "
+    "the OS page cache surviving the crash — fine for tests, not for "
+    "production).")
+
 # fault injection (runtime/faults.py) — the deterministic chaos plane
 FAULTS_PLAN = _opt(
     "auron.faults.plan", str, "",
@@ -338,8 +395,12 @@ FAULTS_PLAN = _opt(
     "device.compute, task.hang, cancel.race, program.build, "
     "backend.init, memmgr.deny, sched.admit, mesh.all_to_all (per "
     "sharded-exchange round: io_error/fatal simulate a device loss the "
-    "demotion path must route around, hang a straggling chip) and "
-    "mesh.gang (kind cancel: a cancel racing the gang door) with kinds "
+    "demotion path must route around, hang a straggling chip), "
+    "mesh.gang (kind cancel: a cancel racing the gang door) and "
+    "journal.{write,commit,load} (the crash-safe query journal: write/"
+    "commit faults degrade journaling to off for that query — the run "
+    "completes identical, resumability is lost; load faults surface "
+    "the classified JournalCorrupt / fresh-run fallback) with kinds "
     "io_error | fatal | corrupt | "
     "hang | cancel | deny (prob defaults to 1.0). Injected hangs poll "
     "the task's cancel registry, 'cancel' fires the task's CancelToken "
@@ -467,8 +528,8 @@ TRACE_DIR = _opt(
 TRACE_EVENTS = _opt(
     "auron.trace.events", str, "",
     "Comma-separated span-category allowlist (query, task, program, "
-    "shuffle, spill, fault, watchdog, memory, sched, mesh); empty "
-    "records every category. "
+    "shuffle, spill, fault, watchdog, memory, sched, mesh, journal); "
+    "empty records every category. "
     "Narrowing the list bounds tracing overhead on hot paths — e.g. "
     "'task,shuffle,fault' drops the per-hit program events.")
 TRACE_MAX_SPANS = _opt(

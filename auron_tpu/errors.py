@@ -172,6 +172,63 @@ class AdmissionRejected(AuronError):
 
 
 # ---------------------------------------------------------------------------
+# journal classes — the crash-safe query journal (runtime/journal.py)
+# ---------------------------------------------------------------------------
+
+class JournalError(AuronError):
+    """Base of the query-journal verdicts. NOT transient: a journal
+    problem is never recovered by blindly re-running the resume — the
+    recovery is always explicit (fall back to a fresh run, or surface
+    the structured reason to the caller)."""
+    transient = False
+
+    def __init__(self, *args, query_id: Optional[str] = None,
+                 reason: Optional[str] = None,
+                 site: Optional[str] = None):
+        super().__init__(*args, site=site)
+        self.query_id = query_id
+        #: machine-readable verdict (no_journal | corrupt | ambiguous |
+        #: fingerprint_mismatch | journaling_disabled | missing_source)
+        self.reason = reason
+
+
+class JournalCorrupt(JournalError):
+    """A journal file failed its per-record CRC, carries an unknown
+    format version, or cannot be parsed. The committed RSS data it
+    described may be fine, but its inventory is not trustworthy — the
+    ONLY safe recovery is a fresh run (which the reuse path performs
+    automatically); resume() surfaces this classified verdict so the
+    caller decides. Never a wrong answer: a corrupt journal is
+    discarded, not believed."""
+
+
+class JournalInvalidated(JournalError):
+    """The journal's plan or source-snapshot fingerprints no longer
+    match the live plan/sources (a source file was rewritten, a catalog
+    table changed): the journaled shuffle outputs were computed from
+    DIFFERENT data, so reusing them would return stale rows. The
+    classified invalidation: journal + its RSS run directory are
+    garbage-collected and the query must run fresh."""
+
+
+class ResumeUnavailable(JournalError):
+    """``Session.resume`` (or the serving RESUME frame) named a query
+    id with no resumable journal behind it: unknown id, already
+    completed (journals are deleted at completion), journaling
+    disabled, or a plan whose sources this process cannot re-bind.
+    Carries the machine-readable ``reason`` the serving tier puts on
+    the structured ERROR frame's first line."""
+
+
+class UnknownQuery(JournalError):
+    """A by-id control operation (the serving CANCEL-by-id frame)
+    named a query id that is not live on this server: unknown, or
+    already finished (cancel-after-DONE is a no-op by contract, but a
+    FIRST-frame CANCEL for an id the server never saw deserves a
+    structured verdict, not a generic traceback)."""
+
+
+# ---------------------------------------------------------------------------
 # transient classes — a clean re-execution can succeed
 # ---------------------------------------------------------------------------
 
@@ -222,6 +279,13 @@ class RssUnavailableError(StorageIOError):
 
 class SpillIOError(StorageIOError):
     """A spill-file write/read failed."""
+
+
+class JournalIOError(StorageIOError):
+    """A query-journal append/fsync/load failed at the IO layer. The
+    journal plane SWALLOWS this on the write path (journaling degrades
+    to off for that query — losing resumability, never the query); the
+    load path converts it to the deterministic JournalCorrupt verdict."""
 
 
 class SpillCorruption(TransientError):

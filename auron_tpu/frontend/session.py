@@ -86,6 +86,18 @@ class Session:
         self._scheduler = QueryScheduler(name="session",
                                          mem_manager=mem_manager,
                                          config=self.config)
+        #: crash-safe query journals this Session opened (runtime/
+        #: journal.py): completed queries delete their own; close()
+        #: deletes the rest — in-process, a journal never outlives its
+        #: Session (cross-process survival is exactly the crash case)
+        self._journals: list = []
+        from auron_tpu.runtime import journal as _jrn
+        if _jrn.enabled(self.config):
+            # startup orphan sweep: reclaim dead predecessors' torn
+            # journals and unreferenced RSS run dirs (resumable
+            # journals of dead processes are KEPT — they are the
+            # resume inventory)
+            _jrn.sweep_orphans(_jrn.journal_dir(self.config))
 
     def _bind_xla_cache(self) -> None:
         """Bind jax's persistent compilation cache to
@@ -311,6 +323,16 @@ class Session:
         spill_mgr = getattr(self.mem_manager, "spill_manager", None)
         if spill_mgr is not None and hasattr(spill_mgr, "sweep_orphans"):
             spill_mgr.sweep_orphans()
+        # a journal's in-process lifetime is bounded by its Session:
+        # completed queries already deleted theirs; failed/cancelled
+        # ones are reclaimed here (a journal that outlives its process
+        # is exactly — and only — the crash-recovery inventory)
+        for jr in self._journals:
+            try:
+                jr.complete()
+            except Exception:   # pragma: no cover - cleanup best-effort
+                pass
+        self._journals = []
 
     def __enter__(self) -> "Session":
         return self
@@ -340,10 +362,105 @@ class Session:
         # outermost scope exports into auron.trace.dir when set
         with self._admitted_query(timeout_s) as token:
             with trace.query_scope(label=f"p{df.num_partitions}"):
-                op = self.plan_physical(df)
-                return _collect(op, num_partitions=df.num_partitions,
-                                mem_manager=self.mem_manager,
-                                config=self.config, cancel_token=token)
+                jr = self._journal_begin(df, token)
+                try:
+                    op = self.plan_physical(df)
+                    table = _collect(op, num_partitions=df.num_partitions,
+                                     mem_manager=self.mem_manager,
+                                     config=self.config,
+                                     cancel_token=token)
+                except BaseException:
+                    if jr is not None:
+                        # the query failed IN-PROCESS: flush and keep
+                        # the journal — an identical re-submission
+                        # under auron.journal.reuse (or a resume) can
+                        # pick the committed stages up; close() deletes
+                        # whatever is never reused
+                        jr.suspend()
+                    raise
+                if jr is not None:
+                    jr.complete(write_report=True)
+                    self._journal_discard(jr)
+                return table
+
+    def _journal_discard(self, jr) -> None:
+        """Drop a COMPLETED journal from the Session ledger (its disk
+        state is already gone) — only suspended journals stay tracked,
+        for close() to reclaim.  Without this a long-lived Session
+        retains one QueryJournal (plan bytes included) per executed
+        query forever."""
+        try:
+            self._journals.remove(jr)
+        except ValueError:
+            pass
+
+    def _journal_begin(self, df: DataFrame, token):
+        """Open (adopt or mint) the crash-safe journal for one
+        top-level query; None when journaling is disarmed or this plan
+        opted out (runtime/journal.begin)."""
+        from auron_tpu.runtime import journal as jrn
+        if not jrn.enabled(self.config):
+            return None
+        jr = jrn.begin(token, df.task_bytes(), df.num_partitions,
+                       self.ctx.catalog, self.config)
+        if jr is not None:
+            self._journals.append(jr)
+        return jr
+
+    def resume(self, query_id: str,
+               timeout_s: Optional[float] = None) -> pa.Table:
+        """Resume a crashed process's journaled query: load + validate
+        its journal (classified ResumeUnavailable / JournalCorrupt /
+        JournalInvalidated on every not-resumable shape — never a wrong
+        answer), re-plan from the journaled plan bytes, and execute
+        with the journal bound so every fully-committed exchange is
+        satisfied (map side skipped, reducers fetch the journaled RSS
+        files) and partially-committed hash/round-robin/single
+        exchanges skip exactly their committed maps. The resumed
+        result is bit-identical to a fresh run, group order included;
+        the journal (and its RSS run directory) is deleted at
+        completion, leaving a resume report for
+        tools/journal_report.py."""
+        from auron_tpu.obs import trace
+        from auron_tpu.runtime import journal as jrn
+        jr = jrn.load_for_resume(jrn.journal_dir(self.config), query_id,
+                                 self.ctx.catalog, self.config)
+        try:
+            with self._admitted_query(timeout_s) as token:
+                with trace.query_scope(label=f"resume:{query_id}"):
+                    jrn.attach_resumed(token, jr)
+                    self._journals.append(jr)
+                    op = plan_from_bytes(jr.plan_bytes, self.ctx)
+                    if jr.scope == "task":
+                        # serving-journaled Spark task: the host engine
+                        # owns the partition fan-out — replay exactly
+                        # the journaled task's own partition, not the
+                        # whole range (which would over-produce)
+                        from auron_tpu.runtime.executor import \
+                            run_task_with_retries
+                        task = pb.TaskDefinition.FromString(
+                            jr.plan_bytes)
+                        table = run_task_with_retries(
+                            op, task.partition_id, jr.num_partitions,
+                            mem_manager=self.mem_manager,
+                            config=self.config, cancel_token=token)
+                    else:
+                        table = _collect(op,
+                                         num_partitions=jr.num_partitions,
+                                         mem_manager=self.mem_manager,
+                                         config=self.config,
+                                         cancel_token=token)
+        except BaseException:
+            # covers admission shedding / cancel-while-queued too: the
+            # load claimed the journal's open stem, so EVERY unwind
+            # must release it or the query becomes unresumable with
+            # reason='open' until process restart (suspend is
+            # idempotent — a no-op when the run already completed)
+            jr.suspend()
+            raise
+        jr.complete(write_report=True)
+        self._journal_discard(jr)
+        return table
 
     def explain_analyze(self, df: DataFrame) -> str:
         """EXPLAIN ANALYZE: run the plan with a positional metric tree

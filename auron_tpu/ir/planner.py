@@ -429,7 +429,38 @@ class PhysicalPlanner:
         raise NotImplementedError(f"partitioning {p.kind!r}")
 
     def _plan_shuffle_writer(self, n: pb.ShuffleWriterNode) -> PhysicalOp:
-        if n.rss_root:
+        rss_root, shuffle_id, orphan_sweep = n.rss_root, n.shuffle_id, True
+        journal = None
+        if rss_root:
+            # explicit RSS root with a journal active (a journaled —
+            # or RESUMING — serving task): restrict the service's
+            # startup sweep to .part files. The full sweep rmtree's a
+            # dead predecessor's UNCOMMITTED shuffle dirs, which is
+            # exactly where the individually-committed map outputs a
+            # task-scope journal recorded live until resume reuses
+            # them (eager GC of such dirs falls to non-journaled
+            # constructions of the same root).
+            from auron_tpu.runtime import journal as jrn
+            if jrn.active_journal() is not None:
+                orphan_sweep = "parts"
+        if not rss_root:
+            # crash-safe journal routing (runtime/journal.py): while a
+            # journal is active for the driving thread's query, its
+            # shuffles lower through the DURABLE RSS tier under the
+            # journal's run directory, with shuffle ids assigned in
+            # plan-walk order — deterministic, so a fresh process
+            # re-planning the identical bytes reproduces them and
+            # resume can match committed stages to plan nodes. The
+            # journal's own sweep governs whole-dir lifecycle there
+            # (a dead predecessor's partial maps are what resume
+            # reuses), so the service sweeps .part files only.
+            from auron_tpu.runtime import journal as jrn
+            journal = jrn.active_journal()
+            if journal is not None:
+                rss_root = journal.rss_root
+                shuffle_id = journal.next_shuffle_id()
+                orphan_sweep = "parts"
+        if rss_root:
             # RSS tier: push partition frames to the host shuffle service
             # so other hosts can read them (exchange.RssShuffleExchangeOp)
             from auron_tpu.parallel.exchange import RssShuffleExchangeOp
@@ -437,8 +468,14 @@ class PhysicalPlanner:
             op = RssShuffleExchangeOp(
                 self.create_plan(n.child),
                 self._parse_partitioning(n.partitioning),
-                FileShuffleService(n.rss_root), n.shuffle_id,
+                FileShuffleService(rss_root, orphan_sweep=orphan_sweep),
+                shuffle_id,
                 input_partitions=n.input_partitions or 1)
+            if journal is not None:
+                journal.record_exchange(
+                    shuffle_id, n.input_partitions or 1,
+                    n.partitioning.num_partitions,
+                    n.partitioning.kind or "single")
         else:
             from auron_tpu.parallel.exchange import ShuffleExchangeOp
             op = ShuffleExchangeOp(self.create_plan(n.child),
@@ -451,9 +488,18 @@ class PhysicalPlanner:
     def _plan_rss_shuffle_read(self, n: pb.RssShuffleReadNode) -> PhysicalOp:
         from auron_tpu.parallel.exchange import RssShuffleReadOp
         from auron_tpu.parallel.shuffle_service import FileShuffleService
-        return RssShuffleReadOp(FileShuffleService(n.rss_root), n.shuffle_id,
-                                serde.parse_schema(n.schema),
-                                n.num_partitions or 1)
+        from auron_tpu.runtime import journal as jrn
+        # same sweep restriction as _plan_shuffle_writer, and for the
+        # same reason: read nodes plan BEFORE writer nodes, so a full
+        # sweep here would rmtree the dead predecessor's uncommitted
+        # dirs (and memoize the root) before the writer's 'parts'
+        # guard ever ran — destroying the committed maps a task-scope
+        # journal recorded for resume
+        sweep = "parts" if jrn.active_journal() is not None else True
+        return RssShuffleReadOp(
+            FileShuffleService(n.rss_root, orphan_sweep=sweep),
+            n.shuffle_id, serde.parse_schema(n.schema),
+            n.num_partitions or 1)
 
     def _plan_broadcast_exchange(self, n: pb.BroadcastExchangeNode) -> PhysicalOp:
         from auron_tpu.parallel.exchange import BroadcastExchangeOp

@@ -508,6 +508,55 @@ def overload(workdir: str) -> Scenario:
     return sc
 
 
+def journal_pipeline(workdir: str) -> Scenario:
+    """Crash-safe-journal chaos scenario: the agg_pipeline shape run
+    with ``auron.journal.dir`` armed, so the ``journal.write`` /
+    ``journal.commit`` sites see real traffic on the append/fsync path.
+    The journal's contract under faults is DEGRADE, NEVER FAIL: an
+    injected io_error/fatal on either site disables journaling for that
+    query (a ``journal.disable`` event on the timeline) and the query
+    itself completes bit-identical — resumability is lost, rows are
+    not. The leak audit covers the journal dir: whatever the fault did,
+    a completed query leaves no ``*.journal`` file behind."""
+    from auron_tpu.frontend.dataframe import col, functions as F
+    from auron_tpu.frontend.session import Session
+
+    jdir = os.path.join(workdir, "journal")
+    table = pa.Table.from_batches([_rows(2048, seed=61 + i)
+                                   for i in range(2)])
+
+    def run() -> pa.Table:
+        conf = cfg.get_config()
+        _missing = object()
+        saved = conf._overrides.get(cfg.JOURNAL_DIR, _missing)
+        conf.set(cfg.JOURNAL_DIR, jdir)
+        s = None
+        try:
+            s = Session()
+            df = (s.from_arrow(table)
+                  .repartition(2, "k")
+                  .filter(col("c") > 50)
+                  .group_by("k")
+                  .agg(F.sum(col("v")).alias("sv"),
+                       F.count(col("c")).alias("n")))
+            return _canonical(s.execute(df))
+        finally:
+            # close on EVERY path: a classified failure suspends its
+            # journal, and in-process a journal never outlives its
+            # Session (cross-process survival is the crash case — this
+            # scenario's audit treats a leftover as a leak)
+            if s is not None:
+                s.close()
+            if saved is _missing:
+                conf.unset(cfg.JOURNAL_DIR)
+            else:
+                conf.set(cfg.JOURNAL_DIR, saved)
+
+    return Scenario("journal_pipeline", run,
+                    [os.path.join(jdir, "*.journal"),
+                     os.path.join(jdir, "**", "*.part")])
+
+
 SCENARIOS: dict[str, Callable[[str], Scenario]] = {
     "rss_pipeline": rss_pipeline,
     "spill_sort": spill_sort,
@@ -515,6 +564,7 @@ SCENARIOS: dict[str, Callable[[str], Scenario]] = {
     "mesh_pipeline": mesh_pipeline,
     "lifecycle_pipeline": lifecycle_pipeline,
     "overload": overload,
+    "journal_pipeline": journal_pipeline,
 }
 
 
@@ -601,3 +651,337 @@ def run_chaos(scenario: Scenario, fault_plan: str, seed: int,
                         error_type=err_t, error=err, injected=injected,
                         leaks=scenario.leaks(), trace_id=trace_id,
                         correlation=correlation)
+
+
+# ---------------------------------------------------------------------------
+# crash scenario: subprocess SIGKILL at every journal stage boundary
+# ---------------------------------------------------------------------------
+#
+# The one failure mode no in-process chaos run can exercise: the Python
+# process DIES (SIGKILL — no unwind, no finally, no atexit). A child
+# process runs a two-exchange query with the crash-safe journal armed
+# (runtime/journal.py) and kills itself at the k-th journal event (map
+# commit record / shuffle commit record — the stage boundaries); the
+# parent then resumes from the journal and audits the full contract:
+#
+#   - resumed result BIT-IDENTICAL to a fresh run (group order included)
+#   - the child's uncommitted ``.part`` files, orphaned spill files and
+#     journal artifacts are reclaimed by the startup sweeps
+#   - nothing unclassified anywhere
+#
+# ``run_crash_sweep`` sweeps EVERY kill point (1..events+1 — the +1 run
+# outlives all boundaries and completes in the child, proving the
+# no-kill control path); ``tests/test_zz_crash_battery.py`` asserts a
+# fast subset tier-1 and the full sweep under ``slow``.
+
+CRASH_SCALE = 0.25          # ~30k fact rows: multi-batch, fast children
+
+
+@dataclass
+class CrashOutcome:
+    """One (kill point → resume) cycle's audited outcome."""
+    kill_point: int
+    #: child exit: -9 = SIGKILLed at the boundary, 0 = ran past every
+    #: boundary and completed (the control run)
+    child_rc: int
+    #: identical | classified | completed | mismatch | unclassified
+    status: str
+    error_type: Optional[str] = None
+    error: Optional[str] = None
+    maps_skipped: int = 0
+    maps_recomputed: int = 0
+    bytes_reused: int = 0
+    resume_wall_s: float = 0.0
+    #: leftover .part / spill / journal artifacts after the sweeps +
+    #: resume (must be empty)
+    leaks: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return (self.status in ("identical", "classified", "completed")
+                and not self.leaks)
+
+
+def crash_query(session, files: list):
+    """The sweep's TWO-EXCHANGE TPC-DS-shaped query (hash repartition →
+    two-phase agg), shared verbatim by the crashing child and the
+    parent's fresh baseline so the bit-identity comparison is about the
+    JOURNAL, not the plan."""
+    from auron_tpu.frontend.dataframe import col, functions as F
+    return (session.read_parquet(files, partitions=3)
+            .repartition(3, "ss_store_sk")
+            .filter(col("ss_quantity") > 5)
+            .group_by("ss_store_sk")
+            .agg(F.sum(col("ss_sales_price")).alias("total"),
+                 F.count(col("ss_net_paid")).alias("paid_cnt")))
+
+
+def _crash_workdir_init(workdir: str) -> list:
+    """Generate the sweep's dataset once under ``workdir`` and persist
+    the file manifest the child re-reads. Returns the fact files."""
+    import json as _json
+    from auron_tpu.it.tpcds_data import generate as gen_data
+    manifest = os.path.join(workdir, "manifest.json")
+    if not os.path.exists(manifest):
+        tables = gen_data(os.path.join(workdir, "data"),
+                          scale=CRASH_SCALE)
+        with open(manifest, "w") as f:
+            _json.dump({"store_sales": tables["store_sales"]}, f)
+    import json as _json2
+    with open(manifest) as f:
+        return _json2.load(f)["store_sales"]
+
+
+def _crash_child_main(workdir: str, kill_at: int) -> int:
+    """Child half of the crash harness: run ``crash_query`` with the
+    journal armed and SIGKILL OURSELVES the moment the ``kill_at``-th
+    journal boundary event (map record / shuffle commit) returns — no
+    unwind, no cleanup, exactly an OOM-kill. ``kill_at <= 0`` disables
+    the kill (the event-count probe / completion control): the child
+    then writes its result table to ``result.arrow`` and prints one
+    JSON line ``{"completed": true, "events": N}``."""
+    import json as _json
+    import signal
+
+    from auron_tpu import config as _cfg
+    from auron_tpu.frontend.session import Session
+    from auron_tpu.memmgr import spill as spill_mod
+    from auron_tpu.runtime import journal as jrn
+
+    conf = _cfg.get_config()
+    conf.set(_cfg.JOURNAL_DIR, os.path.join(workdir, "journal"))
+    # a real crashed engine leaves spill files too: drop one carrying
+    # THIS process's pid.epoch owner token so the parent can prove the
+    # spill startup sweep reclaims a dead writer's artifact
+    spill_dir = os.path.join(workdir, "spill")
+    os.makedirs(spill_dir, exist_ok=True)
+    if kill_at > 0:
+        with open(os.path.join(
+                spill_dir,
+                f"auron-spill-{spill_mod._owner_token()}-0-crash.atb"),
+                "wb") as f:
+            f.write(b"orphan")
+
+    counter = [0]
+    orig_map = jrn.QueryJournal.record_map
+    orig_commit = jrn.QueryJournal.record_shuffle_commit
+
+    def _boundary() -> None:
+        counter[0] += 1
+        if counter[0] == kill_at:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def record_map(self, *a, **kw):
+        orig_map(self, *a, **kw)
+        _boundary()
+
+    def record_shuffle_commit(self, *a, **kw):
+        orig_commit(self, *a, **kw)
+        _boundary()
+
+    jrn.QueryJournal.record_map = record_map
+    jrn.QueryJournal.record_shuffle_commit = record_shuffle_commit
+
+    files = _crash_workdir_init(workdir)
+    s = Session()
+    table = s.execute(crash_query(s, files))
+    s.close()
+    import pyarrow.feather as feather
+    feather.write_feather(table, os.path.join(workdir, "result.arrow"),
+                          compression="uncompressed")
+    print(_json.dumps({"completed": True, "events": counter[0],
+                       "rows": table.num_rows}))
+    return 0
+
+
+def _spawn_crash_child(workdir: str, kill_at: int,
+                       timeout_s: float = 240.0):
+    """Run one crash child; returns (rc, stdout)."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # children share a persistent XLA cache so only the first pays the
+    # compile bill — the sweep measures crash recovery, not tracing
+    env["AURON_CONF_XLA_CACHE_DIR"] = os.path.join(workdir, "xla_cache")
+    proc = subprocess.run(
+        [sys.executable, "-m", "auron_tpu.it.chaos", "--crash-child",
+         workdir, str(kill_at)],
+        capture_output=True, text=True, timeout=timeout_s, cwd=repo,
+        env=env)
+    return proc.returncode, proc.stdout
+
+
+def crash_probe(workdir: str) -> int:
+    """Count the query's journal boundary events (one no-kill child
+    run): the sweep's kill points are 1..events."""
+    import json as _json
+    _crash_workdir_init(workdir)
+    rc, out = _spawn_crash_child(workdir, 0)
+    if rc != 0:
+        raise RuntimeError(f"crash probe child failed rc={rc}: "
+                           f"{out[-500:]}")
+    return int(_json.loads(out.strip().splitlines()[-1])["events"])
+
+
+def crash_baseline(workdir: str) -> pa.Table:
+    """The parent's fresh, journal-free reference result."""
+    from auron_tpu.frontend.session import Session
+    files = _crash_workdir_init(workdir)
+    s = Session()
+    try:
+        return s.execute(crash_query(s, files))
+    finally:
+        s.close()
+
+
+def run_crash_point(workdir: str, kill_point: int,
+                    baseline: Optional[pa.Table] = None) -> CrashOutcome:
+    """One full crash cycle: fresh journal/spill dirs for this kill
+    point, child SIGKILLed at the boundary, parent startup sweeps
+    asserted (spill + RSS tiers), ``Session.resume`` of the journaled
+    query, bit-identity vs the fresh baseline, orphan audit."""
+    import shutil
+    import time
+
+    from auron_tpu.frontend.session import Session
+    from auron_tpu.memmgr.spill import SpillManager
+    from auron_tpu.runtime import journal as jrn
+
+    if baseline is None:
+        baseline = crash_baseline(workdir)
+    point_dir = os.path.join(workdir, f"k{kill_point}")
+    # each kill point gets fresh journal/spill dirs under the shared
+    # data/workdir (the once-per-process sweep memos key on the dir)
+    shutil.rmtree(point_dir, ignore_errors=True)
+    os.makedirs(point_dir, exist_ok=True)
+    for sub in ("journal", "spill"):
+        os.makedirs(os.path.join(point_dir, sub), exist_ok=True)
+    # the child resolves journal/spill under ITS workdir: symlink the
+    # shared data/manifest/xla_cache into the per-point dir
+    for shared in ("data", "manifest.json", "xla_cache"):
+        src = os.path.join(workdir, shared)
+        if os.path.exists(src):
+            os.symlink(src, os.path.join(point_dir, shared))
+
+    rc, out = _spawn_crash_child(point_dir, kill_point)
+    jdir = os.path.join(point_dir, "journal")
+    spill_dir = os.path.join(point_dir, "spill")
+
+    # -- startup sweeps (the satellite assertions) ------------------------
+    # spill tier: constructing a SpillManager over the dead child's dir
+    # IS the startup sweep; the child's crash marker (its own pid.epoch
+    # in the filename, its process now provably dead) must be gone
+    SpillManager(host_budget_bytes=1, spill_dir=spill_dir)
+    leftover_spill = [p for p in glob.glob(
+        os.path.join(spill_dir, "auron-spill-*"))]
+    if leftover_spill:
+        return CrashOutcome(
+            kill_point, rc, "unclassified",
+            error_type="SpillSweepFailed",
+            error=f"spill startup sweep left {leftover_spill}",
+            leaks=leftover_spill)
+
+    if rc == 0:
+        # the kill point lies past the last boundary: the child ran to
+        # completion — its journal must be gone and its result must
+        # match the baseline (read back from result.arrow)
+        import pyarrow.feather as feather
+        table = feather.read_table(
+            os.path.join(point_dir, "result.arrow"))
+        status = ("completed" if table.equals(baseline) else "mismatch")
+        return CrashOutcome(kill_point, rc, status,
+                            leaks=_crash_leaks(jdir, spill_dir))
+
+    outcome = CrashOutcome(kill_point, rc, "unclassified")
+
+    # -- resume -----------------------------------------------------------
+    stems = [os.path.splitext(os.path.basename(p))[0]
+             for p in glob.glob(os.path.join(jdir, "*.journal"))]
+    if len(stems) != 1:
+        outcome.error_type = "JournalInventory"
+        outcome.error = (f"expected exactly one journal after the "
+                         f"crash, found {stems}")
+        return outcome
+    conf = cfg.get_config()
+    _missing = object()
+    saved = conf._overrides.get(cfg.JOURNAL_DIR, _missing)
+    conf.set(cfg.JOURNAL_DIR, jdir)
+    try:
+        s = Session()
+        t0 = time.perf_counter()
+        try:
+            table = s.resume(stems[0])
+            outcome.resume_wall_s = time.perf_counter() - t0
+            stats = jrn.last_stats()
+            outcome.maps_skipped = stats.get("maps_skipped", 0)
+            outcome.maps_recomputed = stats.get("maps_recomputed", 0)
+            outcome.bytes_reused = stats.get("bytes_reused", 0)
+            outcome.status = ("identical" if table.equals(baseline)
+                              else "mismatch")
+        except errors.AuronError as e:
+            outcome.status = "classified"
+            outcome.error_type = type(e).__name__
+            outcome.error = str(e)
+        except Exception as e:   # noqa: BLE001 — the failure bucket
+            outcome.error_type = type(e).__name__
+            outcome.error = str(e)
+        finally:
+            s.close()
+    finally:
+        if saved is _missing:
+            conf.unset(cfg.JOURNAL_DIR)
+        else:
+            conf.set(cfg.JOURNAL_DIR, saved)
+    outcome.leaks = _crash_leaks(jdir, spill_dir)
+    return outcome
+
+
+def _crash_leaks(jdir: str, spill_dir: str) -> list:
+    """Orphan audit after one crash cycle: no ``.part`` anywhere under
+    the journal root, no journal files, no RSS run dirs, no spill
+    files. ``report_*.json`` is a deliberate artifact (the
+    tools/journal_report.py input), not a leak."""
+    gc.collect()
+    found = glob.glob(os.path.join(jdir, "**", "*.part"), recursive=True)
+    found += glob.glob(os.path.join(jdir, "*.journal"))
+    found += glob.glob(os.path.join(jdir, "*.claim"))
+    found += [d for d in glob.glob(os.path.join(jdir, "rss", "*"))
+              if os.path.isdir(d)]
+    found += glob.glob(os.path.join(spill_dir, "auron-spill-*"))
+    return found
+
+
+def run_crash_sweep(workdir: Optional[str] = None,
+                    kill_points: Optional[list] = None) -> list:
+    """Sweep every journal boundary of the two-exchange crash query:
+    kill points 1..events (each child dies AT that boundary) plus
+    events+1 (the child outlives every boundary and completes). Returns
+    the list of ``CrashOutcome``; the contract is ``all(o.ok)``."""
+    import tempfile
+
+    own = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="auron_crash_")
+    try:
+        events = crash_probe(workdir)
+        baseline = crash_baseline(workdir)
+        points = kill_points or list(range(1, events + 2))
+        return [run_crash_point(workdir, k, baseline) for k in points]
+    finally:
+        if own:
+            import shutil
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _main(argv) -> int:
+    if len(argv) >= 3 and argv[0] == "--crash-child":
+        return _crash_child_main(argv[1], int(argv[2]))
+    raise SystemExit(
+        "usage: python -m auron_tpu.it.chaos --crash-child "
+        "<workdir> <kill_at>")
+
+
+if __name__ == "__main__":
+    sys.exit(_main(sys.argv[1:]))

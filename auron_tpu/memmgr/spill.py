@@ -42,6 +42,58 @@ _HEADER_SIZE = len(_SPILL_MAGIC) + 1
 _FRAME_HDR = cks.FRAME_HDR
 
 
+def _host_hex() -> str:
+    """8-hex-char hostname digest for the spill owner token (raw
+    hostnames can contain the '-'/'.' the filename grammar uses)."""
+    import hashlib
+    import socket
+    return hashlib.sha1(
+        socket.gethostname().encode()).hexdigest()[:8]
+
+
+_HOST_HEX = _host_hex()
+
+
+_OWN_TOKEN = (None, "")
+
+
+def _owner_token() -> str:
+    """``p<pid>.<epoch>.<hosthex>`` filename token of this process
+    (dots inside so the '-'-separated name parses unambiguously; the
+    host digest keeps the sweep HOST-SCOPED like the RSS/journal owner
+    tags — on a shared spill mount another host's pid numbers mean
+    nothing here).  Memoized per pid: it is stamped on every spill
+    file and the epoch is immutable for the process's lifetime."""
+    global _OWN_TOKEN
+    pid = os.getpid()
+    if _OWN_TOKEN[0] != pid:
+        from auron_tpu.utils import liveness
+        _OWN_TOKEN = (
+            pid, f"p{pid}.{liveness.process_epoch(pid)}.{_HOST_HEX}")
+    return _OWN_TOKEN[1]
+
+
+def _parse_owner_token(name: str):
+    """(pid, epoch, host_hex) from a spill filename, or None for the
+    pre-sweep name format (never swept — provenance unknowable)."""
+    if not name.startswith("auron-spill-p"):
+        return None
+    token = name[len("auron-spill-p"):].split("-", 1)[0]
+    try:
+        pid_s, epoch_s, host = token.split(".", 2)
+        return int(pid_s), int(epoch_s), host
+    except ValueError:
+        return None
+
+
+#: spill dirs already startup-swept by this process (the system temp
+#: dir is shared and large — sweep it once; explicitly configured dirs
+#: are swept on every manager construction, they are small and the
+#: crash harness re-enters them)
+_SWEPT_DIRS: set = set()
+_SWEPT_LOCK = threading.Lock()
+
+
 class Spill:
     """One spill: an ordered sequence of opaque frames (serialized batches).
 
@@ -95,9 +147,15 @@ class Spill:
                         spill=self.spill_id,
                         frames=len(self._mem_frames),
                         bytes=self.mem_bytes):
+            # the filename carries the owner's pid.epoch (utils/
+            # liveness) so a successor process's startup sweep can
+            # prove a crashed writer dead and reclaim the file — the
+            # per-manager ledger (sweep_orphans at Session close) only
+            # covers crashes the process SURVIVES
             fd, self._path = tempfile.mkstemp(
-                prefix=f"auron-spill-{self.spill_id}-", suffix=".atb",
-                dir=self._mgr.spill_dir)
+                prefix=f"auron-spill-{_owner_token()}-"
+                       f"{self.spill_id}-",
+                suffix=".atb", dir=self._mgr.spill_dir)
             # registered with the manager so a crashed attempt's orphan
             # is swept at Session close (sweep_orphans) — the spill-tier
             # equivalent of the RSS commit-time .part sweep
@@ -250,6 +308,53 @@ class SpillManager:
         self._live_paths: set[str] = set()
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
+        # startup half of the orphan sweep: a SIGKILLed process never
+        # ran Session.close(), so its ledger died with it — reclaim by
+        # pid+epoch liveness from the filename instead. Explicit dirs
+        # sweep every construction; the shared system temp dir once
+        # per process.
+        sweep_dir = spill_dir or tempfile.gettempdir()
+        if spill_dir is None:
+            with _SWEPT_LOCK:
+                if sweep_dir in _SWEPT_DIRS:
+                    sweep_dir = None
+                else:
+                    _SWEPT_DIRS.add(sweep_dir)
+        if sweep_dir:
+            self.sweep_dead_owners(sweep_dir)
+
+    @staticmethod
+    def sweep_dead_owners(directory: str) -> int:
+        """Remove spill files whose owning process (pid.epoch in the
+        filename) is provably dead — the startup complement of the
+        Session-close ledger sweep; counted on
+        ``auron_spill_orphans_swept_total``. Files in the pre-sweep
+        name format (no owner token) are never touched."""
+        from auron_tpu.utils import liveness
+        removed = 0
+        try:
+            names = os.listdir(directory)
+        except OSError:
+            return 0
+        for name in names:
+            if not name.startswith("auron-spill-"):
+                continue
+            parsed = _parse_owner_token(name)
+            if parsed is None:
+                continue
+            pid, epoch, host = parsed
+            if host != _HOST_HEX:
+                continue   # another host's writer: their sweep, not ours
+            if not liveness.owner_dead(pid, epoch):
+                continue
+            try:
+                os.unlink(os.path.join(directory, name))
+                removed += 1
+            except OSError:   # pragma: no cover - fs race
+                pass
+        liveness.note_swept("auron_spill_orphans_swept_total", removed,
+                            directory, "spill")
+        return removed
 
     def _track_path(self, path: str) -> None:
         with self._lock:

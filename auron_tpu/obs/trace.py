@@ -51,7 +51,7 @@ from typing import NamedTuple, Optional
 #: ``mesh.quarantine`` (device retired from future submeshes) —
 #: tools/mesh_report.py prints all of them.
 CATEGORIES = ("query", "task", "program", "shuffle", "spill", "fault",
-              "watchdog", "memory", "sched", "mesh")
+              "watchdog", "memory", "sched", "mesh", "journal")
 
 _SPAN_IDS = itertools.count(1)     # next() is GIL-atomic
 _TRACE_IDS = itertools.count(1)

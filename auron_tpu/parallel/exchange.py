@@ -1152,6 +1152,12 @@ class RssShuffleExchangeOp(PhysicalOp):
     def num_partitions(self) -> int:
         return self.partitioning.num_partitions
 
+    def _journal(self, ctx: ExecContext):
+        """The driving query's crash-safe journal (runtime/journal),
+        resolved through the cancel token; None when journaling is off
+        for this query."""
+        return getattr(ctx.cancel_event, "journal", None)
+
     def _materialize(self, ctx: ExecContext) -> None:
         partitioning = self.partitioning
         schema = self.child.schema()
@@ -1163,8 +1169,30 @@ class RssShuffleExchangeOp(PhysicalOp):
         # invalidate any previous attempt's manifest so readers can't mix
         # stale map outputs into this attempt
         self.service.begin_shuffle(self.shuffle_id)
+        journal = self._journal(ctx)
+        # map-level resume: a resumed query skips exactly the map
+        # outputs the journal proves committed AND intact on storage
+        # (size + trailer CRC), recomputing only what the durable tier
+        # never received. Range partitioning is excluded — its bounds
+        # are sampled from map 0's live batches, so a skipped map 0
+        # would leave later maps unboundable; a range exchange resumes
+        # only at full-satisfied granularity (see execute()).
+        map_skips_ok = (journal is not None and journal.resumed
+                        and not isinstance(partitioning,
+                                           RangePartitioning))
+        jmetrics = ctx.metrics_for(self)
 
         for in_p in range(self.input_partitions):
+            if map_skips_ok:
+                size = journal.reusable_map(self.shuffle_id, in_p,
+                                            self.service)
+                if size is not None:
+                    journal.note_map_skipped(self.shuffle_id, size)
+                    jmetrics.counter("journal_maps_skipped").add(1)
+                    jmetrics.counter("journal_bytes_reused").add(size)
+                    continue
+                journal.note_map_recomputed(self.shuffle_id)
+                jmetrics.counter("journal_maps_recomputed").add(1)
             map_ctx = ctx.child(partition_id=in_p,
                                 num_partitions=self.input_partitions)
             batches = self.child.execute(in_p, map_ctx)
@@ -1191,6 +1219,11 @@ class RssShuffleExchangeOp(PhysicalOp):
                 self.partitioning = partitioning
             self._write_map(in_p, ctx, partitioning, pending, batches)
         self.service.commit_shuffle(self.shuffle_id, self.input_partitions)
+        if journal is not None:
+            # the journal's shuffle-level commit record rides the SAME
+            # boundary as the durable tier's manifest (fsync here only)
+            journal.record_shuffle_commit(self.shuffle_id,
+                                          self.input_partitions)
 
     def _write_map(self, in_p: int, ctx: ExecContext, partitioning,
                    pending=(), batches=None) -> None:
@@ -1253,6 +1286,14 @@ class RssShuffleExchangeOp(PhysicalOp):
                                 slice_host_batch(host, lo, hi),
                                 codec_level=codec_level))
             writer.commit()
+            journal = self._journal(ctx)
+            if journal is not None:
+                # recorded AFTER the atomic rename: the journal never
+                # claims more than the durable tier holds (async
+                # append; made durable by the shuffle-commit fsync)
+                journal.record_map(self.shuffle_id, in_p,
+                                   writer.committed_size,
+                                   writer.trailer_crc)
 
     #: per-map corruption-recovery bound: recompute + refetch this many
     #: times before surfacing the classified error (a fault plan that
@@ -1303,7 +1344,26 @@ class RssShuffleExchangeOp(PhysicalOp):
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         with self._lock:
             if not self._written:
-                self._materialize(ctx)
+                journal = self._journal(ctx)
+                if journal is not None and journal.satisfied(
+                        self.shuffle_id, self.input_partitions,
+                        self.service):
+                    # SATISFIED exchange (crash-safe journal): every
+                    # map output is committed and intact on storage —
+                    # the whole map side is skipped and reducers fetch
+                    # straight from the journaled RSS files. Recorded
+                    # like every other routing decision.
+                    metrics = ctx.metrics_for(self)
+                    metrics.counter("journal_maps_skipped").add(
+                        self.input_partitions)
+                    _record_route(self, metrics, "rss",
+                                  "journal_satisfied")
+                    from auron_tpu.obs import trace
+                    trace.event("journal", "journal.satisfied",
+                                shuffle=self.shuffle_id,
+                                maps=self.input_partitions)
+                else:
+                    self._materialize(ctx)
                 self._written = True
         metrics = ctx.metrics_for(self, "_read")
         read_time = metrics.counter("shuffle_read_total_time")

@@ -48,7 +48,8 @@ from __future__ import annotations
 import io
 import os
 import struct
-from typing import Iterator
+import threading
+from typing import Iterator, Optional
 
 from auron_tpu import errors
 from auron_tpu.utils import checksum as cks
@@ -88,6 +89,7 @@ class RssPartitionWriter:
         os.makedirs(self._dir, exist_ok=True)
         self._tmp = os.path.join(self._dir, f"map_{map_id}.part")
         self._final = os.path.join(self._dir, f"map_{map_id}.data")
+        service._write_owner(self._dir)
         self._file = open(self._tmp, "wb")
         #: per-partition buffered frames awaiting a flush
         self._buffers: dict[int, list[bytes]] = {}
@@ -96,6 +98,11 @@ class RssPartitionWriter:
         self._runs: dict[int, list[tuple[int, int]]] = {}
         self._pos = 0
         self._committed = False
+        #: commit artifacts the query journal records (runtime/journal):
+        #: total committed file size and the trailer's CRC — the cheap
+        #: resume-time validity check that needs only the footer
+        self.committed_size = 0
+        self.trailer_crc = 0
 
     def __enter__(self) -> "RssPartitionWriter":
         return self
@@ -165,13 +172,16 @@ class RssPartitionWriter:
                 trailer.write(struct.pack("<QQ", off, ln))
         tbytes = trailer.getvalue()
         self._file.write(tbytes)
+        tcrc = cks.compute(tbytes, self._algo)
         self._file.write(_FOOTER.pack(trailer_start, self.num_partitions,
-                                      cks.compute(tbytes, self._algo),
-                                      self._algo))
+                                      tcrc, self._algo))
         self._file.write(_TRAILER_MAGIC)
         self._file.close()
         os.replace(self._tmp, self._final)   # atomic commit
         self._committed = True
+        self.committed_size = (trailer_start + len(tbytes)
+                               + _FOOTER.size + len(_TRAILER_MAGIC))
+        self.trailer_crc = tcrc
 
     def abort(self) -> None:
         if not self._committed:
@@ -182,17 +192,118 @@ class RssPartitionWriter:
                 pass
 
 
+#: roots already startup-swept by THIS process (one sweep per root per
+#: process: the sweep targets a crashed PREDECESSOR's leftovers, and a
+#: root is typically re-opened many times per query)
+_SWEPT_ROOTS: set = set()
+_SWEPT_LOCK = threading.Lock()
+
+
 class FileShuffleService:
     """Shared-storage shuffle service. Each host creates its own instance
     over the same root; no coordination beyond the filesystem's atomic
-    renames is needed."""
+    renames is needed.
 
-    def __init__(self, root: str):
+    Every shuffle directory carries a ``.owner`` tag
+    (``utils/liveness``: host:pid:epoch of the writing process), and
+    service construction runs a STARTUP SWEEP over the root: a crashed
+    predecessor's ``.part`` files are removed, and — in the default
+    ``orphan_sweep=True`` mode — its whole UNCOMMITTED shuffle
+    directories too (no manifest = no reader can ever observe them).
+    ``orphan_sweep="parts"`` restricts the sweep to ``.part`` files
+    (journal-managed roots: the journal's own sweep owns whole-dir
+    lifecycle there, because a dead process's partially-committed maps
+    are exactly what resume reuses). Liveness is pid+epoch checked and
+    host-scoped, so a live writer — this process included — is never
+    swept; unowned directories (pre-sweep format) are left alone."""
+
+    def __init__(self, root: str, orphan_sweep=True):
         self.root = root
         os.makedirs(root, exist_ok=True)
+        #: shuffle dirs this service already owner-stamped (one .owner
+        #: read + liveness probe per dir, not per map writer)
+        self._stamped: set = set()
+        self._stamped_lock = threading.Lock()
+        if orphan_sweep:
+            # full-mode roots are memoized process-wide (a root is
+            # re-opened many times per query and the sweep targets a
+            # crashed PREDECESSOR); parts-mode roots are per-query
+            # journal run dirs — unique per query, so memoizing them
+            # would grow the set forever, and the liveness-gated .part
+            # sweep is repeat-safe and near-free on a fresh dir
+            first = True
+            if orphan_sweep is True:
+                with _SWEPT_LOCK:
+                    first = root not in _SWEPT_ROOTS
+                    _SWEPT_ROOTS.add(root)
+            if first:
+                self.sweep_dead_owners(
+                    remove_uncommitted=(orphan_sweep is True))
 
     def _shuffle_dir(self, shuffle_id: int) -> str:
         return os.path.join(self.root, f"shuffle_{shuffle_id}")
+
+    def _write_owner(self, shuffle_dir: str) -> None:
+        """Stamp (or adopt) the directory's owner tag: written when
+        absent or when the recorded owner is provably dead (a resumed
+        query adopting a crashed predecessor's partial shuffle).  Memo
+        per (service, dir): a wide exchange opens one writer per map —
+        one .owner read + liveness probe per DIR, not per map."""
+        from auron_tpu.utils import liveness
+        with self._stamped_lock:
+            if shuffle_dir in self._stamped:
+                return
+            self._stamped.add(shuffle_dir)
+        path = os.path.join(shuffle_dir, ".owner")
+        try:
+            with open(path) as f:
+                if liveness.is_live(f.read().strip()):
+                    return
+        except OSError:
+            pass
+        try:
+            with open(path, "w") as f:
+                f.write(liveness.own_tag())
+        except OSError:   # pragma: no cover - best-effort tag
+            pass
+
+    def sweep_dead_owners(self, remove_uncommitted: bool = True) -> int:
+        """The startup sweep (see class docstring); returns artifacts
+        removed, counted on ``auron_rss_orphans_swept_total``."""
+        import shutil
+
+        from auron_tpu.utils import liveness
+        removed = 0
+        try:
+            entries = sorted(os.listdir(self.root))
+        except OSError:
+            return 0
+        for name in entries:
+            d = os.path.join(self.root, name)
+            if not (name.startswith("shuffle_") and os.path.isdir(d)):
+                continue
+            try:
+                with open(os.path.join(d, ".owner")) as f:
+                    owner = f.read().strip()
+            except OSError:
+                continue   # unowned (pre-sweep format): conservative
+            if liveness.is_live(owner):
+                continue
+            committed = os.path.exists(os.path.join(d, "manifest"))
+            if remove_uncommitted and not committed:
+                shutil.rmtree(d, ignore_errors=True)
+                removed += 1
+                continue
+            for f in os.listdir(d):
+                if f.endswith(".part"):
+                    try:
+                        os.unlink(os.path.join(d, f))
+                        removed += 1
+                    except OSError:
+                        pass
+        liveness.note_swept("auron_rss_orphans_swept_total", removed,
+                            self.root, "RSS")
+        return removed
 
     def partition_writer(self, shuffle_id: int, map_id: int,
                          num_partitions: int,
@@ -214,6 +325,7 @@ class FileShuffleService:
     def commit_shuffle(self, shuffle_id: int, num_maps: int) -> None:
         d = self._shuffle_dir(shuffle_id)
         os.makedirs(d, exist_ok=True)
+        self._write_owner(d)
         tmp = os.path.join(d, "manifest.part")
         with open(tmp, "w") as f:
             f.write(str(num_maps))
@@ -261,12 +373,44 @@ class FileShuffleService:
         """Paths of EXACTLY the map outputs the manifest names; [] when the
         shuffle is not (yet) committed."""
         d = self._shuffle_dir(shuffle_id)
-        try:
-            with open(os.path.join(d, "manifest")) as f:
-                num_maps = int(f.read().strip())
-        except (OSError, ValueError):
-            return []
+        num_maps = self.manifest_maps(shuffle_id)
         return [os.path.join(d, f"map_{m}.data") for m in range(num_maps)]
+
+    def manifest_maps(self, shuffle_id: int) -> int:
+        """Map count the shuffle-level manifest names; 0 when the
+        shuffle is not (yet) committed."""
+        try:
+            with open(os.path.join(self._shuffle_dir(shuffle_id),
+                                   "manifest")) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return 0
+
+    def map_output_stat(self, shuffle_id: int,
+                        map_id: int) -> Optional[tuple[int, int]]:
+        """(size, trailer_crc) of one committed map output — the query
+        journal's cheap resume-time validity probe (reads only the
+        footer, never the frames; frame CRCs still verify on every
+        fetch).  None when the file is missing or its footer is not a
+        valid v2 trailer."""
+        path = os.path.join(self._shuffle_dir(shuffle_id),
+                            f"map_{map_id}.data")
+        foot = _FOOTER.size + len(_TRAILER_MAGIC)
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if size < foot:
+                    return None
+                f.seek(size - foot)
+                tail = f.read(foot)
+        except OSError:
+            return None
+        if tail[-4:] != _TRAILER_MAGIC:
+            return None
+        _start, _nparts, trailer_crc, _algo = \
+            _FOOTER.unpack(tail[:_FOOTER.size])
+        return size, trailer_crc
 
     # -- read side ------------------------------------------------------------
 

@@ -49,6 +49,17 @@ Named sites threaded through the engine:
     sched.admit                                         (admission control)
     mesh.all_to_all                                     (per sharded round)
     mesh.gang                                           (gang door, cancel)
+    journal.write | journal.commit | journal.load       (query journal)
+
+``journal.write``/``journal.commit`` fire on the crash-safe query
+journal's append/fsync path (runtime/journal.py): ``io_error``/``fatal``
+are SWALLOWED by the journal — journaling degrades to off for that
+query (a ``journal.disable`` event on the timeline), the query itself
+completes identically; ``corrupt`` flips a byte of the appended record
+AFTER its CRC, surfacing as ``JournalCorrupt`` only when a later resume
+loads the file. ``journal.load`` fires on resume/reuse loads: the
+classified ``JournalCorrupt`` (resume) or a logged fresh-run fallback
+(reuse).
 
 ``mesh.all_to_all`` fires once per all-to-all round of a mesh-routed
 exchange: ``io_error`` raises the classified ``errors.MeshUnavailable``
@@ -84,6 +95,7 @@ SITES = (
     "device.compute", "program.build", "backend.init",
     "task.hang", "cancel.race", "memmgr.deny", "sched.admit",
     "mesh.all_to_all", "mesh.gang",
+    "journal.write", "journal.commit", "journal.load",
 )
 
 KINDS = ("io_error", "fatal", "corrupt", "hang", "cancel", "deny")

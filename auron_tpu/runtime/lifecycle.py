@@ -45,7 +45,7 @@ class CancelToken:
     """
 
     __slots__ = ("query_id", "_event", "_lock", "_deadline", "reason",
-                 "cancelled_at_ns", "slot")
+                 "cancelled_at_ns", "slot", "journal")
 
     def __init__(self, query_id: str = "", deadline_s: Optional[float] = None):
         self.query_id = query_id
@@ -55,6 +55,11 @@ class CancelToken:
         #: admitted; nested executes ride the enclosing token, so the
         #: slot travels with it (executor.collect's fairness hook)
         self.slot = None
+        #: the query's crash-safe journal (runtime/journal.QueryJournal)
+        #: when auron.journal.dir arms the plane: the planner's shuffle
+        #: routing oracle and the RSS exchange's commit-record sink /
+        #: resume oracle both resolve it through this token
+        self.journal = None
         self._deadline = (time.monotonic() + deadline_s
                          if deadline_s is not None and deadline_s > 0
                          else None)

@@ -36,6 +36,25 @@ Wire format (all integers little-endian):
                                           boundary, AuronConvertStrategy)
             10 TABLE       client→server  u32 name_len | name | Arrow IPC
                                           stream with the subtree's rows
+            11 RESUME      client→server  JSON {query_id} — continue a
+                                          journaled query after a server
+                                          restart (runtime/journal.py);
+                                          streams BATCH/DONE like SUBMIT
+                                          or answers a structured ERROR
+                                          (first line "ResumeUnavailable
+                                          reason=...").  Replays the
+                                          journaled DRIVING SCOPE: a
+                                          Session-journaled ("collect")
+                                          query streams every partition
+                                          0..N-1 — the dead driver's
+                                          fan-out — while a serving-
+                                          journaled ("task") one replays
+                                          exactly its own partition_id
+
+CANCEL doubles as a FIRST frame carrying JSON {query_id}: cancel a live
+query by id over a fresh connection (DONE {cancelled} on success, a
+structured ERROR "UnknownQuery reason=unknown_query_id ..." when the id
+is unknown or already finished).
 
 Flow control mirrors rt.rs's bound-1 sync channel, generalized to a
 window: the server keeps at most ``window`` un-ACKed BATCH frames in
@@ -78,6 +97,13 @@ KIND_ACK = 7
 KIND_CANCEL = 8
 KIND_NEED_TABLES = 9
 KIND_TABLE = 10
+#: first-frame RESUME: payload JSON {"query_id": ...} (or a bare utf-8
+#: query id) — continue a journaled query after a server restart
+#: (runtime/journal.py). The server streams the resumed result exactly
+#: like a SUBMIT, or answers a STRUCTURED first-line ERROR naming why
+#: not (ResumeUnavailable reason=no_journal|corrupt|
+#: fingerprint_mismatch|journaling_disabled|ambiguous|missing_source).
+KIND_RESUME = 11
 
 #: max un-ACKed BATCH frames in flight (rt.rs uses a bound-1 channel; a
 #: small window amortizes the network round trip without losing the
@@ -89,6 +115,16 @@ _HDR = struct.Struct("<BI")
 
 def write_frame(sock, kind: int, payload: bytes) -> None:
     sock.sendall(_HDR.pack(kind, len(payload)) + payload)
+
+
+def _journal_error_frame(e) -> bytes:
+    """ERROR payload for a JournalError verdict: ONE machine-parseable
+    first line (``<Type> reason=<reason> query_id=<id>``) ahead of the
+    human message — the single formatter every by-id control path uses
+    (RESUME refusals, CANCEL-by-id unknowns), so the wire contract
+    cannot drift between them."""
+    return (f"{type(e).__name__} reason={e.reason or 'error'} "
+            f"query_id={e.query_id or ''}\n{e}").encode()
 
 
 def read_frame(sock) -> tuple[int, bytes]:
@@ -156,7 +192,14 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             threading.Thread(target=self.server.shutdown,
                              daemon=True).start()
             return
-        if kind not in (KIND_SUBMIT, KIND_SUBMIT_PLAN):
+        if kind == KIND_CANCEL:
+            # first-frame CANCEL-BY-ID (a reconnecting/admin client
+            # cancelling a query it no longer holds the socket for):
+            # a live id cancels and DONEs; an unknown/expired id gets
+            # the STRUCTURED verdict, never a generic traceback
+            self._cancel_by_id(payload)
+            return
+        if kind not in (KIND_SUBMIT, KIND_SUBMIT_PLAN, KIND_RESUME):
             write_frame(self.request, KIND_ERROR,
                         f"expected SUBMIT, got kind={kind}".encode())
             return
@@ -168,13 +211,27 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                                         daemon=True)
         self._reader.start()
         from auron_tpu import errors as _errors
+        self.server.register_query(self._cancel)
         try:
             if kind == KIND_SUBMIT:
                 self._run_task(payload)
+            elif kind == KIND_RESUME:
+                self._run_resume(payload)
             else:
                 self._run_plan_task(payload)
         except _Cancelled:
             self.server.stats["cancelled"] += 1
+        except _errors.JournalError as e:
+            # resume verdicts carry a machine-readable reason on the
+            # STRUCTURED first line (the AdmissionRejected precedent):
+            # a reconnecting client learns WHY its query cannot be
+            # continued without scraping a traceback
+            self.server.stats["resume_refused"] += 1
+            try:
+                write_frame(self.request, KIND_ERROR,
+                            _journal_error_frame(e))
+            except OSError:
+                pass
         except _errors.AdmissionRejected as e:
             # overload shed: a STRUCTURED first line (machine-parseable
             # reason + retry-after hint) ahead of the message, so a
@@ -194,6 +251,7 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             except OSError:
                 pass
         finally:
+            self.server.unregister_query(self._cancel)
             # quiet completion, NOT a cancel: the token must release the
             # control reader without recording a cancel reason/event on
             # every successful request
@@ -261,11 +319,91 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         except OSError:
             raise _Cancelled()
 
+    @staticmethod
+    def _parse_query_id(payload: bytes) -> str:
+        """Query id from a by-id control frame: JSON ``{"query_id"}``
+        or a bare utf-8 id.  ONE definition for both CANCEL-by-id and
+        RESUME so the wire contract cannot drift between them."""
+        try:
+            req = json.loads(payload.decode() or "{}")
+            return req.get("query_id", "") if isinstance(req, dict) \
+                else str(req)
+        except (ValueError, UnicodeDecodeError):
+            return payload.decode("utf-8", "replace").strip()
+
+    def _cancel_by_id(self, payload: bytes) -> None:
+        """First-frame CANCEL with a query-id payload: cancel another
+        connection's live query on this server, or answer the
+        structured ``UnknownQuery`` verdict."""
+        qid = self._parse_query_id(payload)
+        token = self.server.find_query(qid)
+        if token is None:
+            from auron_tpu import errors as _errors
+            verdict = _errors.UnknownQuery(
+                f"query {qid!r} is not live on this server (unknown "
+                "id, or it already finished — cancel-after-DONE is a "
+                "no-op)", query_id=qid, reason="unknown_query_id")
+            try:
+                write_frame(self.request, KIND_ERROR,
+                            _journal_error_frame(verdict))
+            except OSError:
+                pass
+            return
+        token.cancel()
+        try:
+            write_frame(self.request, KIND_DONE,
+                        json.dumps({"cancelled": qid}).encode())
+        except OSError:
+            pass
+
     # -- task execution ----------------------------------------------------
 
     def _run_task(self, task_bytes: bytes) -> None:
         from auron_tpu.ir.planner import PlannerContext
         self._execute(task_bytes, PlannerContext(), report=None)
+
+    def _run_resume(self, payload: bytes) -> None:
+        """RESUME: continue a journaled query after a server restart.
+        The journal is loaded + validated (classified JournalError
+        verdicts reach handle()'s structured ERROR frame), bound to
+        this handler's token, and the journaled TaskDefinition replays
+        through the normal execute path — satisfied exchanges skip
+        their map sides, reducers fetch the journaled RSS files, and
+        the client receives the continued stream exactly as a fresh
+        SUBMIT would have delivered it."""
+        from auron_tpu import config as cfg
+        from auron_tpu import errors
+        from auron_tpu.ir.planner import PlannerContext
+        from auron_tpu.runtime import journal as jrn
+        qid = self._parse_query_id(payload)
+        conf = cfg.get_config()
+        if not jrn.enabled(conf):
+            raise errors.ResumeUnavailable(
+                "journaling is disabled on this server "
+                "(auron.journal.dir is empty)", query_id=qid,
+                reason="journaling_disabled")
+        jr = jrn.load_for_resume(jrn.journal_dir(conf), qid, {}, conf)
+        # replay the journaled DRIVING SCOPE: a Session-journaled query
+        # ("collect") streams every partition 0..N-1 — the driver that
+        # owned the fan-out is dead, so the server takes its place; a
+        # serving-journaled task ("task") replays exactly its own
+        # partition_id (the host engine still owns the other tasks)
+        parts = (list(range(jr.num_partitions))
+                 if jr.scope == "collect" else None)
+        try:
+            # attach INSIDE the guard: a failed reopen (ENOSPC, the
+            # file raced away) must release the open-stem/.claim too,
+            # or the query is unresumable until this server restarts
+            jrn.attach_resumed(self._cancel, jr)
+            self._execute(jr.plan_bytes, PlannerContext(), report=None,
+                          journal=jr, partitions=parts)
+        except BaseException:
+            # _execute suspends the journal only once INSIDE its slot;
+            # an AdmissionRejected from the acquire (or any pre-slot
+            # unwind) would otherwise leave the stem claimed 'open'
+            # forever — suspend here too, idempotently
+            jr.suspend()
+            raise
 
     def _run_plan_task(self, payload: bytes) -> None:
         """SUBMIT_PLAN: convert a raw host plan server-side through the
@@ -334,7 +472,8 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                                   report.never_converted],
                               "summary": report.summary()})
 
-    def _execute(self, task_bytes: bytes, planner_ctx, report) -> None:
+    def _execute(self, task_bytes: bytes, planner_ctx, report,
+                 journal=None, partitions=None) -> None:
         # imported lazily so the server process controls jax platform
         # selection before anything initializes a backend
         from auron_tpu.columnar.arrow_bridge import (schema_to_arrow,
@@ -371,24 +510,46 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             raise _Cancelled()
         self._cancel.slot = slot
         prev_bind = lifecycle.bind_token(self._cancel)
+        jr = journal
         try:
             task = pb.TaskDefinition()
             task.ParseFromString(task_bytes)
+            if jr is None:
+                # journal this served task (when auron.journal.dir is
+                # armed) so a server restart can RESUME it — the
+                # reconnect contract; a None return degrades to the
+                # pre-journal posture
+                from auron_tpu.runtime import journal as jrn
+                jr = jrn.begin(self._cancel, task_bytes,
+                               task.num_partitions or 1,
+                               planner_ctx.catalog, scope="task")
             op = plan_from_bytes(task_bytes, planner_ctx)
-            rt = ExecutionRuntime(
-                op, TaskDefinition(partition_id=task.partition_id,
-                                   num_partitions=task.num_partitions or 1,
-                                   stage_id=task.stage_id,
-                                   task_id=task.task_id),
-                cancel_token=self._cancel)
+            # SUBMIT serves the host engine's one-task-per-partition
+            # model (one runtime at task.partition_id); RESUME of a
+            # collect-scoped journal passes the full partition list —
+            # the dead driver's fan-out — streamed in partition order
+            # so the reassembled stream is bit-identical to what the
+            # driver would have collected
+            parts = (partitions if partitions is not None
+                     else [task.partition_id])
+            snaps = []
             # the handler's cancel TOKEN is the task's cancellation
             # registry: operators polling between child batches unwind
             # even MID-operator, not just between output batches
             try:
-                for batch in rt.batches():
-                    rb = to_arrow(batch, op.schema())
-                    if rb.num_rows:
-                        self._send_batch(rb)
+                for p in parts:
+                    rt = ExecutionRuntime(
+                        op, TaskDefinition(
+                            partition_id=p,
+                            num_partitions=task.num_partitions or 1,
+                            stage_id=task.stage_id,
+                            task_id=task.task_id),
+                        cancel_token=self._cancel)
+                    for batch in rt.batches():
+                        rb = to_arrow(batch, op.schema())
+                        if rb.num_rows:
+                            self._send_batch(rb)
+                    snaps.append(rt.finalize())
             except errors.DeadlineExceeded:
                 # a deadline is a CLIENT-VISIBLE verdict (ERROR frame
                 # with the classified type), unlike a cancel (silent
@@ -399,12 +560,22 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                 lifecycle.observe_unwind(
                     self._cancel, kind=self._cancel.reason or "cancel")
                 raise _Cancelled()
-            metrics = rt.finalize()
+            metrics = (snaps[0] if len(snaps) == 1
+                       else {"num_partitions": len(snaps),
+                             "per_partition": snaps})
+        except BaseException:
+            if jr is not None:
+                # a failed/cancelled/died-mid-stream serving task keeps
+                # its journal: the RESUME frame's inventory
+                jr.suspend()
+            raise
         finally:
             lifecycle.bind_token(prev_bind)
             slot.release()
             from auron_tpu.runtime import programs
             programs.pop_query(self._cancel.query_id)
+        if jr is not None:
+            jr.complete(write_report=True)
         done = {"metrics": metrics,
                 "schema_ipc": _schema_ipc_b64(schema_to_arrow(op.schema()))}
         if report is not None:
@@ -430,15 +601,40 @@ class AuronServer(socketserver.ThreadingTCPServer):
         super().__init__((host, port), _TaskHandler)
         self._shutdown_requested = False
         self.window = window
-        self.stats = {"batches_sent": 0, "cancelled": 0, "rejected": 0}
+        self.stats = {"batches_sent": 0, "cancelled": 0, "rejected": 0,
+                      "resume_refused": 0}
         self._active_lock = threading.Lock()
         self._active_tasks = 0
+        #: live query tokens by id — the CANCEL-by-id frame's registry
+        self._queries_lock = threading.Lock()
+        self._live_queries: dict = {}
+        # journal startup sweep: a restarted server reclaims its dead
+        # predecessor's torn journals/unreferenced RSS run dirs while
+        # KEEPING resumable ones — the RESUME frame's inventory
+        from auron_tpu.runtime import journal as _jrn
+        if _jrn.enabled():
+            _jrn.sweep_orphans(_jrn.journal_dir())
         # the serving process's admission plane: handler threads are
         # cheap, EXECUTIONS are not — at most auron.sched.max_concurrent
         # tasks compute concurrently, auron.sched.queue_depth more wait,
         # the rest shed with a structured AdmissionRejected ERROR frame
         from auron_tpu.runtime.scheduler import QueryScheduler
         self.scheduler = QueryScheduler(name="serving")
+
+    def register_query(self, token) -> None:
+        with self._queries_lock:
+            self._live_queries[token.query_id] = token
+
+    def unregister_query(self, token) -> None:
+        with self._queries_lock:
+            self._live_queries.pop(token.query_id, None)
+
+    def find_query(self, query_id: str):
+        """Live CancelToken behind ``query_id``, or None (the
+        CANCEL-by-id lookup; expired ids return None by construction —
+        tokens unregister when their handler finishes)."""
+        with self._queries_lock:
+            return self._live_queries.get(query_id)
 
     def task_started(self) -> None:
         with self._active_lock:
@@ -547,6 +743,32 @@ class AuronClient:
         else:
             tbl = None
         return tbl, done
+
+    def resume(self, query_id: str):
+        """Continue a journaled query after a server restart (RESUME
+        frame): returns (pa.Table, metrics) like ``execute``. A
+        non-resumable id raises RuntimeError whose message LEADS with
+        the server's structured verdict line
+        (``ResumeUnavailable reason=...`` etc.)."""
+        tbl, done = self._drive(
+            KIND_RESUME, json.dumps({"query_id": query_id}).encode(),
+            None)
+        return tbl, done.get("metrics", done)
+
+    def cancel_query(self, query_id: str) -> bool:
+        """Cancel a live query BY ID over a fresh connection (the
+        reconnect/admin path — no need to hold the original socket).
+        True when a live query was cancelled; raises RuntimeError with
+        the structured ``UnknownQuery reason=unknown_query_id`` first
+        line when the id is unknown or already finished."""
+        with socket.create_connection(self.addr,
+                                      timeout=self.timeout_s) as s:
+            write_frame(s, KIND_CANCEL,
+                        json.dumps({"query_id": query_id}).encode())
+            kind, payload = read_frame(s)
+        if kind == KIND_ERROR:
+            raise RuntimeError("engine error:\n" + payload.decode())
+        return bool(json.loads(payload.decode()).get("cancelled"))
 
     def stream(self, task_bytes: bytes):
         """Yield (kind, payload) frames for one task submission, ACKing

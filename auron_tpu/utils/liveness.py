@@ -1,0 +1,138 @@
+"""Process-liveness tags for the durable tiers' orphan sweeps.
+
+A crashed (SIGKILLed, OOM-killed, preempted) engine process leaves
+artifacts on shared storage — uncommitted RSS map attempts, disk spill
+files, in-flight query journals — that no in-process cleanup path can
+ever reclaim: the cleanup code died with the process.  The startup
+sweeps (``FileShuffleService``, ``SpillManager``, ``runtime/journal``)
+reclaim them instead, and this module is their ownership oracle.
+
+An owner tag is ``host:pid:epoch``.  ``epoch`` is the owning process's
+start time in kernel clock ticks (``/proc/<pid>/stat`` field 22), which
+makes the verdict robust against pid recycling: a new process that
+happens to reuse a dead writer's pid has a different start time, so the
+dead writer's artifacts still sweep.  Where ``/proc`` is unavailable
+the epoch is 0 and the check degrades to pid-existence (the
+conservative direction: a recycled pid reads as live and the artifact
+is merely kept one sweep longer).
+
+Sweeps are HOST-SCOPED by the tag's host field: on a shared-storage RSS
+root another host's live writer must never read as dead just because
+its pid means nothing here.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional, Tuple
+
+_HOST = socket.gethostname()
+
+
+def process_epoch(pid: int) -> int:
+    """Start time of ``pid`` in kernel ticks; 0 when unknowable (no
+    /proc, or the process is gone)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read().decode("ascii", "replace")
+        # comm (field 2) may contain spaces/parens: parse after the
+        # LAST ')'; starttime is field 22 overall = index 19 of the
+        # post-paren fields (state is field 3)
+        rest = stat.rsplit(")", 1)[1].split()
+        return int(rest[19])
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+#: (pid, tag) memo — the process's own epoch is immutable, and own_tag
+#: sits on per-submission screens and per-artifact stamps; keyed by pid
+#: so a fork() child re-derives its own
+_OWN_TAG: Tuple[Optional[int], str] = (None, "")
+
+
+def own_tag() -> str:
+    """This process's owner tag (``host:pid:epoch``)."""
+    global _OWN_TAG
+    pid = os.getpid()
+    if _OWN_TAG[0] != pid:
+        _OWN_TAG = (pid, f"{_HOST}:{pid}:{process_epoch(pid)}")
+    return _OWN_TAG[1]
+
+
+def parse_tag(tag: str) -> Optional[Tuple[str, int, int]]:
+    """``(host, pid, epoch)`` of a tag, or None when malformed."""
+    try:
+        host, pid_s, epoch_s = tag.strip().rsplit(":", 2)
+        return host, int(pid_s), int(epoch_s)
+    except ValueError:
+        return None
+
+
+def pid_alive(pid: int) -> bool:
+    """Does a process with this pid exist on THIS host right now?"""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:   # exists, owned by someone else
+        return True
+    except OSError:
+        return True           # unknowable: conservative = alive
+    return True
+
+
+def note_swept(counter: str, removed: int, directory: str,
+               what: str) -> None:
+    """Shared emission half of the startup orphan sweeps (spill / RSS /
+    journal tiers): one warning line + the tier's
+    ``auron_*_orphans_swept_total`` registry counter. One definition so
+    the three sweeps' observability cannot drift."""
+    if not removed:
+        return
+    import logging
+    logging.getLogger("auron_tpu").warning(
+        "%s startup sweep removed %d orphaned artifact(s) of dead "
+        "writers under %s", what, removed, directory)
+    try:
+        from auron_tpu.obs import registry as obs_registry
+        if obs_registry.enabled():
+            obs_registry.get_registry().counter(counter).add(removed)
+    except Exception:   # pragma: no cover - telemetry best-effort
+        pass
+
+
+def owner_dead(pid: int, epoch: int) -> bool:
+    """Provably-dead verdict for a SAME-HOST ``(pid, epoch)`` owner —
+    the one shared core of the spill/RSS/journal sweeps (host scoping
+    is the caller's: tag-host vs hash-digest formats differ per tier).
+    False for this very process and for a live pid whose epoch matches
+    or cannot be compared; True only when the pid is gone or its start
+    time proves the pid was recycled."""
+    if pid == os.getpid():
+        return False
+    if not pid_alive(pid):
+        return True
+    if epoch:
+        live_epoch = process_epoch(pid)
+        if live_epoch and live_epoch != epoch:
+            return True   # recycled pid: the recorded owner is dead
+    return False
+
+
+def is_live(tag: str) -> bool:
+    """Is the tag's owning process still running?
+
+    Returns True (= do NOT sweep) for: this very process, a live pid
+    whose epoch matches (or whose epoch cannot be compared), another
+    host's tag (their sweep, not ours), and malformed tags.  Returns
+    False only for a provably dead same-host owner."""
+    parsed = parse_tag(tag)
+    if parsed is None:
+        return True
+    host, pid, epoch = parsed
+    if host != _HOST:
+        return True
+    return not owner_dead(pid, epoch)

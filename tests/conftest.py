@@ -67,6 +67,48 @@ def _spill_file_leak_check():
 
 
 @pytest.fixture(autouse=True, scope="module")
+def _journal_leak_check():
+    """Tier-1 leak audit, journal half (ISSUE 13): no test module may
+    grow the set of ``*.journal`` files across the journal dirs this
+    process touched (runtime/journal tracks them), nor leave a journal
+    registered OPEN. Tests that crash/suspend journals mid-module must
+    consume them (resume/reuse/GC) before the module ends — a journal
+    surviving its test module is the in-process equivalent of a leaked
+    spill file."""
+    try:
+        from auron_tpu.runtime import journal as _jrn
+    except Exception:
+        yield
+        return
+
+    def _journal_files():
+        import glob as _glob
+        found = []
+        for d in _jrn.seen_dirs():
+            found.extend(_glob.glob(os.path.join(d, "*.journal")))
+        return set(found)
+
+    before = _journal_files()
+    open_before = _jrn.open_journal_count()
+    yield
+    leaked = _journal_files() - before
+    still_open = _jrn.open_journal_count()
+    if leaked:
+        for p in leaked:   # clean up so ONE leak fails ONE module
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        pytest.fail("lifecycle leak audit: leaked query journals: "
+                    f"{sorted(leaked)}", pytrace=False)
+    if still_open > open_before:
+        pytest.fail(
+            f"lifecycle leak audit: open journal count grew "
+            f"{open_before} -> {still_open} over this module",
+            pytrace=False)
+
+
+@pytest.fixture(autouse=True, scope="module")
 def _memmgr_consumer_leak_check():
     """Per-MODULE half of the leak audit: no test module may grow the
     set of live registered memmgr consumers. Module-scoped because the

@@ -280,6 +280,32 @@ class TestPerfGate:
         # scheduler; its bookkeeping must stay invisible on a solo run
         assert last["sched_tax_limit_pct"] == 2.0
         assert 0.0 <= last["sched_tax_pct"] < last["sched_tax_limit_pct"]
+        # the journal-overhead gate (crash-safe query journal): the
+        # journaled q01 run ENGAGED (records > 0 — an idle journal
+        # would be a vacuous measurement and fails the gate) and its
+        # hot-path ledger stays under the 2% limit
+        assert last["journal_overhead_limit_pct"] == 2.0
+        assert last["journal_records"] > 0
+        assert last["journal_commits"] >= 1
+        assert 0.0 <= last["journal_overhead_pct"] \
+            < last["journal_overhead_limit_pct"]
+
+    def test_smoke_journal_overhead_regression_fails(
+            self, monkeypatch, capsys):
+        """A journal hot-path cost regression FAILS the smoke gate
+        instead of hiding: seed a synthetic ledger an order of
+        magnitude past the limit."""
+        monkeypatch.setenv("AURON_PERF_SMOKE_SCALE", "0.2")
+        from auron_tpu.runtime import journal as jrn
+        monkeypatch.setattr(
+            jrn, "last_stats",
+            lambda: {"hot_ns": int(1e12), "records": 6, "commits": 1})
+        rc = perf_gate.main(["--smoke"])
+        out = capsys.readouterr().out
+        last = json.loads(out.strip().splitlines()[-1])
+        assert rc == 1
+        assert last["perf_gate"] == "fail"
+        assert "journal hot-path overhead" in last["reason"]
 
     def test_unusable_records(self):
         base = _baseline()

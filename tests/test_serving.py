@@ -379,3 +379,213 @@ def test_two_process_live_attach_all_fixtures(spark_fixture_env):
     finally:
         proc.terminate()
         proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# crash-safe journal serving surface (ISSUE 13): RESUME frame,
+# CANCEL-by-id, structured unknown-query verdicts
+# ---------------------------------------------------------------------------
+
+def _arm_journal(d):
+    from auron_tpu import config as cfg
+    conf = cfg.get_config()
+    conf.set(cfg.JOURNAL_DIR, d)
+
+    def restore():
+        conf.unset(cfg.JOURNAL_DIR)
+    return restore
+
+
+def test_cancel_by_id_unknown_is_structured():
+    """A first-frame CANCEL naming an id the server never saw gets the
+    STRUCTURED verdict (UnknownQuery reason=unknown_query_id ...) on
+    the ERROR frame's first line — not a generic traceback."""
+    srv = AuronServer()
+    srv.serve_background()
+    try:
+        client = AuronClient(*srv.address)
+        with pytest.raises(RuntimeError) as ei:
+            client.cancel_query("serving-999999")
+        first = str(ei.value).splitlines()[1]   # after "engine error:"
+        assert first.startswith("UnknownQuery reason=unknown_query_id")
+        assert "serving-999999" in first
+    finally:
+        srv.shutdown()
+
+
+def test_cancel_by_id_cancels_live_query(tmp_path):
+    """CANCEL over a FRESH connection (reconnect/admin path) stops a
+    query another socket is driving."""
+    import socket as socketmod
+
+    from auron_tpu.runtime.serving import KIND_BATCH, KIND_SUBMIT, \
+        read_frame, write_frame
+    path, _tbl = _dataset(str(tmp_path))
+    srv = AuronServer(window=2)
+    srv.serve_background()
+    try:
+        s = socketmod.create_connection(srv.address, timeout=60)
+        write_frame(s, KIND_SUBMIT, _blocked_task(path))
+        kind, _ = read_frame(s)
+        assert kind == KIND_BATCH          # producer now parked un-ACKed
+        _spin(lambda: srv._live_queries, what="query registration")
+        qid = next(iter(srv._live_queries))
+        client = AuronClient(*srv.address)
+        assert client.cancel_query(qid) is True
+        _spin(lambda: srv.stats["cancelled"] == 1,
+              what="cancel teardown")
+        s.close()
+    finally:
+        srv.shutdown()
+
+
+def test_resume_unknown_query_is_structured(tmp_path):
+    """RESUME for an id with no journal behind it: the structured
+    ResumeUnavailable verdict names WHY (journaling_disabled with the
+    plane disarmed, no_journal with it armed)."""
+    srv = AuronServer()
+    srv.serve_background()
+    try:
+        client = AuronClient(*srv.address)
+        with pytest.raises(RuntimeError) as ei:
+            client.resume("serving-31337")
+        assert "ResumeUnavailable reason=journaling_disabled" \
+            in str(ei.value)
+        restore = _arm_journal(str(tmp_path / "journal"))
+        try:
+            with pytest.raises(RuntimeError) as ei:
+                client.resume("serving-31337")
+            assert "ResumeUnavailable reason=no_journal" in str(ei.value)
+        finally:
+            restore()
+        assert srv.stats["resume_refused"] == 2
+    finally:
+        srv.shutdown()
+
+
+def test_reconnect_after_server_restart_resumes(tmp_path):
+    """The RESUME regression gate: a journaled task dies mid-run on
+    server A (injected non-transient fault — the in-process stand-in
+    for the server process being killed), server A goes away, and a
+    client reconnecting to a FRESH server B continues the query by id:
+    same rows a clean SUBMIT would have produced."""
+    import glob as globmod
+
+    from auron_tpu import config as cfg
+    from auron_tpu.runtime import faults
+    from auron_tpu.runtime import journal as jrn
+
+    path, tbl = _dataset(str(tmp_path))
+    jdir = str(tmp_path / "journal")
+    restore = _arm_journal(jdir)
+    conf = cfg.get_config()
+    try:
+        srv_a = AuronServer()
+        srv_a.serve_background()
+        try:
+            client = AuronClient(*srv_a.address)
+            conf.set(cfg.FAULTS_PLAN, "device.compute:fatal@1.0")
+            conf.set(cfg.FAULTS_SEED, 2)
+            faults.reset()
+            try:
+                with pytest.raises(RuntimeError, match="engine error"):
+                    client.execute(_task(path))
+            finally:
+                conf.unset(cfg.FAULTS_PLAN)
+                conf.unset(cfg.FAULTS_SEED)
+                faults.reset()
+        finally:
+            srv_a.shutdown()
+        # the failed task's journal survived the server: the RESUME
+        # inventory (simulate full process death for the stem ledger)
+        journals = globmod.glob(os.path.join(jdir, "*.journal"))
+        assert len(journals) == 1
+        stem = os.path.splitext(os.path.basename(journals[0]))[0]
+        jrn._forget_open_stems()
+
+        srv_b = AuronServer()
+        srv_b.serve_background()
+        try:
+            client = AuronClient(*srv_b.address)
+            table, metrics = client.resume(stem)
+            _check(table, metrics, tbl)
+            # the resumed journal completed: inventory consumed
+            assert not globmod.glob(os.path.join(jdir, "*.journal"))
+            # and a second RESUME of the same id is now the structured
+            # unknown verdict (journals are deleted at completion)
+            with pytest.raises(RuntimeError) as ei:
+                client.resume(stem)
+            assert "ResumeUnavailable reason=no_journal" in str(ei.value)
+        finally:
+            srv_b.shutdown()
+    finally:
+        restore()
+
+
+def test_wire_resume_collect_scope_streams_every_partition(tmp_path):
+    """Regression (caught by the e2e crash drive): a SESSION-journaled
+    query is "collect"-scoped — the dead driver owned the fan-out over
+    num_partitions partitions — so the RESUME frame must stream ALL of
+    them, not just partition 0 of the journaled TaskDefinition.  The
+    reassembled stream is bit-identical (order included) to the fresh
+    Session run; a serving-journaled task stays at task scope (the
+    host engine still owns the other partitions)."""
+    import glob as globmod
+
+    from auron_tpu import errors
+    from auron_tpu.frontend.dataframe import col, functions as F
+    from auron_tpu.frontend.session import Session
+    from auron_tpu.runtime import journal as jrn
+
+    path, _tbl = _dataset(str(tmp_path))
+
+    def _df(s):
+        return (s.read_parquet([path], partitions=2)
+                .repartition(2, "k")
+                .group_by("k")
+                .agg(F.sum(col("v")).alias("sv"),
+                     F.count(col("v")).alias("n")))
+
+    s0 = Session()
+    fresh = s0.execute(_df(s0))
+
+    jdir = str(tmp_path / "journal")
+    restore = _arm_journal(jdir)
+    try:
+        s1 = Session()
+        orig = jrn.QueryJournal.record_shuffle_commit
+
+        def hook(self, *a, **kw):
+            orig(self, *a, **kw)
+            raise errors.InjectedFatalError(
+                "simulated crash after first shuffle commit",
+                site="test.crash")
+
+        jrn.QueryJournal.record_shuffle_commit = hook
+        try:
+            with pytest.raises(errors.AuronError):
+                s1.execute(_df(s1))
+        finally:
+            jrn.QueryJournal.record_shuffle_commit = orig
+        journals = globmod.glob(os.path.join(jdir, "*.journal"))
+        assert len(journals) == 1
+        stem = os.path.splitext(os.path.basename(journals[0]))[0]
+        # simulate the driver process dying (SIGKILL loses the open-
+        # stem ledger with the process)
+        s1._journals = []
+        jrn._forget_open_stems()
+
+        srv = AuronServer()
+        srv.serve_background()
+        try:
+            client = AuronClient(*srv.address)
+            table, metrics = client.resume(stem)
+            # every driver partition streamed, bit-identical order
+            # included — NOT just partition 0's prefix
+            assert table.equals(fresh)
+            assert metrics.get("num_partitions") == 2
+            assert not globmod.glob(os.path.join(jdir, "*.journal"))
+        finally:
+            srv.shutdown()
+    finally:
+        restore()

@@ -84,6 +84,15 @@ _PLANS = [
     ("overload", "sched.admit:deny@0.5"),
     ("overload", "memmgr.deny:deny@0.4"),
     ("overload", "sched.admit:deny@0.3;memmgr.deny:deny@0.3"),
+    # crash-safe query journal (ISSUE 13): append/fsync faults DEGRADE
+    # journaling for the run (journal.disable on the timeline) — the
+    # query itself must end IDENTICAL with no journal file left behind
+    # (the classified load paths live in tests/test_zz_crash_battery)
+    ("journal_pipeline", "journal.write:io_error@0.3"),
+    ("journal_pipeline", "journal.write:fatal@0.5"),
+    ("journal_pipeline", "journal.commit:io_error@0.5"),
+    ("journal_pipeline",
+     "journal.write:io_error@0.2;rss.write:io_error@0.2"),
 ]
 
 _FAST_SEEDS = (1, 2)

@@ -1,0 +1,805 @@
+"""Crash-safety battery: the crash-safe query journal (ISSUE 13).
+
+The contract under test (runtime/journal.py): a SIGKILLed process's
+journaled query resumes in a fresh process BIT-IDENTICAL to a fresh run
+(group order included), reusing exactly the shuffle map outputs the
+durable RSS tier committed before the crash; every not-resumable shape
+is a CLASSIFIED verdict (JournalCorrupt / JournalInvalidated /
+ResumeUnavailable) and never a wrong answer; and the startup sweeps
+(journal + RSS + spill tiers) reclaim every artifact of a dead process
+while keeping the resumable inventory.
+
+Fast subset tier-1; the kill-at-EVERY-boundary subprocess sweep runs
+under ``slow`` (tools/chaos_report.py --crash prints the same table).
+"""
+
+import glob
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+
+import pyarrow as pa
+import pytest
+
+from auron_tpu import config as cfg
+from auron_tpu import errors
+from auron_tpu.frontend.dataframe import col, functions as F
+from auron_tpu.frontend.session import Session
+from auron_tpu.it import chaos
+from auron_tpu.runtime import journal as jrn
+
+
+@pytest.fixture
+def jdir(tmp_path):
+    """One test's journal dir, armed on the process config."""
+    d = str(tmp_path / "journal")
+    conf = cfg.get_config()
+    _missing = object()
+    saved = conf._overrides.get(cfg.JOURNAL_DIR, _missing)
+    conf.set(cfg.JOURNAL_DIR, d)
+    yield d
+    if saved is _missing:
+        conf.unset(cfg.JOURNAL_DIR)
+    else:
+        conf.set(cfg.JOURNAL_DIR, saved)
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def _table(seed=7, n=6000):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 40, n), pa.int64()),
+        "v": pa.array(rng.normal(size=n)),
+        "c": pa.array(rng.integers(0, 1000, n), pa.int32()),
+    })
+
+
+def _two_exchange_df(s, name="crash_t", threshold=50):
+    """Hash repartition + two-phase agg = two journaled exchanges."""
+    return (s.table(name)
+            .repartition(3, "k")
+            .filter(col("c") > threshold)
+            .group_by("k")
+            .agg(F.sum(col("v")).alias("sv"),
+                 F.count(col("c")).alias("n")))
+
+
+def _fault_abort(s, df, plan="rss.commit:fatal@1.0"):
+    """Fail a journaled query mid-run with an injected non-transient
+    fault (the in-process stand-in for a crash: the journal is
+    SUSPENDED — kept on disk with everything the durable tier holds)."""
+    from auron_tpu.runtime import faults
+    conf = cfg.get_config()
+    conf.set(cfg.FAULTS_PLAN, plan)
+    conf.set(cfg.FAULTS_SEED, 1)
+    faults.reset()
+    try:
+        with pytest.raises(errors.AuronError):
+            s.execute(df)
+    finally:
+        conf.unset(cfg.FAULTS_PLAN)
+        conf.unset(cfg.FAULTS_SEED)
+        faults.reset()
+
+
+def _abort_after_commit(s, df, commits=1):
+    """Fail a journaled query right AFTER its ``commits``-th
+    shuffle-level commit: the durable tier AND the journal both hold
+    the committed exchange, then the 'crash' lands — deterministic
+    committed state for the resume/reuse assertions (a probabilistic
+    fault plan cannot pin WHICH commit it interrupts)."""
+    orig = jrn.QueryJournal.record_shuffle_commit
+    seen = []
+
+    def hook(self, *a, **kw):
+        orig(self, *a, **kw)
+        seen.append(1)
+        if len(seen) == commits:
+            raise errors.InjectedFatalError(
+                f"simulated crash after shuffle commit #{commits}",
+                site="test.crash")
+
+    jrn.QueryJournal.record_shuffle_commit = hook
+    try:
+        with pytest.raises(errors.AuronError):
+            s.execute(df)
+    finally:
+        jrn.QueryJournal.record_shuffle_commit = orig
+
+
+def _journal_stems(jdir):
+    return sorted(os.path.splitext(os.path.basename(p))[0]
+                  for p in glob.glob(os.path.join(jdir, "*.journal")))
+
+
+# ---------------------------------------------------------------------------
+# subprocess crash sweep (the tentpole's harness)
+# ---------------------------------------------------------------------------
+
+class TestCrashSweep:
+    @pytest.fixture(scope="class")
+    def workdir(self):
+        d = tempfile.mkdtemp(prefix="auron_crash_battery_")
+        yield d
+        shutil.rmtree(d, ignore_errors=True)
+
+    @pytest.fixture(scope="class")
+    def baseline(self, workdir):
+        return chaos.crash_baseline(workdir)
+
+    def test_kill_mid_first_exchange_resumes_identical(
+            self, workdir, baseline):
+        """SIGKILL after the 2nd map commit of exchange 0: resume must
+        skip the durable map(s), recompute the rest, and produce the
+        fresh result bit-identical — with both startup sweeps (spill +
+        RSS .part) asserted by the harness's audit."""
+        o = chaos.run_crash_point(workdir, 2, baseline)
+        assert o.child_rc == -9
+        assert o.status == "identical", (o.error_type, o.error)
+        assert not o.leaks
+        assert o.maps_recomputed >= 1
+
+    def test_kill_after_first_commit_satisfies_exchange(
+            self, workdir, baseline):
+        """SIGKILL right after exchange 0's shuffle commit (event 4:
+        3 maps + the fsynced commit record): the whole exchange is
+        SATISFIED on resume — its 3 maps skip, reducers fetch the
+        journaled RSS files."""
+        o = chaos.run_crash_point(workdir, 4, baseline)
+        assert o.child_rc == -9
+        assert o.status == "identical", (o.error_type, o.error)
+        assert not o.leaks
+        assert o.maps_skipped >= 3
+        assert o.bytes_reused > 0
+
+    @pytest.mark.slow
+    def test_kill_every_stage_boundary(self):
+        """The acceptance sweep: a child SIGKILLed at EVERY journal
+        boundary of the two-exchange query, the parent resuming each —
+        identical-or-classified everywhere, zero orphans, and the
+        control point past the last boundary completes in the child."""
+        outs = chaos.run_crash_sweep()
+        assert all(o.ok for o in outs), [
+            (o.kill_point, o.status, o.error_type, o.leaks)
+            for o in outs if not o.ok]
+        assert sum(1 for o in outs if o.status == "identical") \
+            == len(outs) - 1
+        assert outs[-1].status == "completed"
+        # reuse must actually engage across the sweep (not recompute
+        # everything everywhere)
+        assert any(o.maps_skipped for o in outs)
+
+
+# ---------------------------------------------------------------------------
+# journal load paths: corrupt / torn / version skew / fingerprints
+# ---------------------------------------------------------------------------
+
+class TestJournalLoadPaths:
+    @pytest.fixture
+    def setup(self, jdir):
+        s = Session()
+        s.register("crash_t", _table())
+        df = _two_exchange_df(s)
+        return s, df, jdir
+
+    def _suspended_journal(self, s, df, jdir):
+        _fault_abort(s, df)
+        stems = _journal_stems(jdir)
+        assert len(stems) == 1
+        return stems[0], os.path.join(jdir, stems[0] + ".journal")
+
+    def test_corrupt_interior_record_is_classified(self, setup):
+        s, df, jdir = setup
+        stem, path = self._suspended_journal(s, df, jdir)
+        with open(path, "rb") as f:
+            lines = f.read().splitlines(keepends=True)
+        assert len(lines) >= 3
+        # flip a byte INSIDE a middle record's payload (not the tail)
+        mid = bytearray(lines[1])
+        mid[-5] ^= 0xFF
+        lines[1] = bytes(mid)
+        with open(path, "wb") as f:
+            f.writelines(lines)
+        with pytest.raises(errors.JournalCorrupt):
+            jrn.load_for_resume(jdir, stem, s.ctx.catalog)
+        s.close()
+
+    def test_torn_tail_is_dropped_not_fatal(self, setup):
+        """A crash mid-append leaves a torn FINAL line: load drops it
+        silently and resumes from the records before it."""
+        s, df, jdir = setup
+        stem, path = self._suspended_journal(s, df, jdir)
+        with open(path, "rb") as f:
+            data = f.read()
+        with open(path, "wb") as f:
+            f.write(data[:-7])   # tear the last record mid-line
+        jr = jrn.load_for_resume(jdir, stem, s.ctx.catalog)
+        assert jr.resumed
+        jr.suspend()
+        s.close()
+
+    def test_version_skew_rejected_not_misread(self, setup):
+        s, df, jdir = setup
+        stem, path = self._suspended_journal(s, df, jdir)
+        header, records, _vl = jrn._read_records(path)
+        header["v"] = jrn.VERSION + 41
+        with open(path, "wb") as f:
+            f.write(jrn._encode(header))
+            for rec in records:
+                f.write(jrn._encode(rec))
+        with pytest.raises(errors.JournalCorrupt, match="version skew"):
+            jrn.load_for_resume(jdir, stem, s.ctx.catalog)
+        s.close()
+
+    def test_truncated_to_nothing_is_classified(self, setup):
+        s, df, jdir = setup
+        stem, path = self._suspended_journal(s, df, jdir)
+        with open(path, "wb") as f:
+            f.write(b"")
+        with pytest.raises(errors.JournalCorrupt):
+            jrn.load_for_resume(jdir, stem, s.ctx.catalog)
+        s.close()
+
+    def test_fingerprint_mismatch_invalidates_and_gcs(self, setup):
+        """The source table changed since the journal was written: the
+        classified invalidation — journal AND its RSS run dir are
+        garbage-collected, a fresh run is the only path to rows."""
+        s, df, jdir = setup
+        stem, path = self._suspended_journal(s, df, jdir)
+        s.register("crash_t", _table(seed=99))   # different snapshot
+        with pytest.raises(errors.JournalInvalidated,
+                           match="fingerprint"):
+            jrn.load_for_resume(jdir, stem, s.ctx.catalog)
+        assert not os.path.exists(path)
+        assert not os.path.isdir(os.path.join(jdir, "rss", stem))
+        s.close()
+
+    def test_unknown_query_id_is_resume_unavailable(self, jdir):
+        with pytest.raises(errors.ResumeUnavailable) as ei:
+            jrn.load_for_resume(jdir, "q_never_existed", {})
+        assert ei.value.reason == "no_journal"
+
+    def test_open_journal_refuses_resume(self, setup):
+        """A journal OPEN in this process (the query is running) is not
+        adoptable — resume names it 'open', never double-drives it."""
+        s, df, jdir = setup
+        stem, path = self._suspended_journal(s, df, jdir)
+        jr = jrn._load(path)   # registers the stem open, like a run
+        try:
+            with pytest.raises(errors.ResumeUnavailable) as ei:
+                jrn.load_for_resume(jdir, stem, s.ctx.catalog)
+            assert ei.value.reason == "open"
+        finally:
+            jr.suspend()
+        s.close()
+
+    def test_missing_source_is_classified(self, setup):
+        """A fresh process that has not re-registered the catalog table
+        gets the structured 'register your sources' verdict, not a
+        KeyError mid-replan."""
+        s, df, jdir = setup
+        stem, _path = self._suspended_journal(s, df, jdir)
+        with pytest.raises(errors.ResumeUnavailable) as ei:
+            jrn.load_for_resume(jdir, stem, {})   # empty catalog
+        assert ei.value.reason == "missing_source"
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# in-process resume / reuse (the crash simulated by fault-abort)
+# ---------------------------------------------------------------------------
+
+class TestResumeAndReuse:
+    def _baseline(self, tbl):
+        s = Session()
+        s.register("crash_t", tbl)
+        try:
+            return s.execute(_two_exchange_df(s))
+        finally:
+            s.close()
+
+    def test_fault_abort_then_resume_bit_identical(self, jdir):
+        tbl = _table()
+        conf = cfg.get_config()
+        conf.unset(cfg.JOURNAL_DIR)
+        baseline = self._baseline(tbl)
+        conf.set(cfg.JOURNAL_DIR, jdir)
+        s = Session()
+        s.register("crash_t", tbl)
+        _abort_after_commit(s, _two_exchange_df(s))
+        stems = _journal_stems(jdir)
+        assert len(stems) == 1
+        # simulate the process dying WITHOUT Session.close (a close
+        # would reclaim the suspended journal — an in-process failure
+        # needs no cross-process resume; SIGKILL is the case journals
+        # exist for)
+        s._journals = []
+        jrn._forget_open_stems()
+        s2 = Session()
+        s2.register("crash_t", tbl)
+        resumed = s2.resume(stems[0])
+        assert resumed.equals(baseline)
+        stats = jrn.last_stats()
+        # the 'crash' landed after the repartition exchange's commit:
+        # that exchange is satisfied on resume (its single map — the
+        # memory scan is one partition — skips, reducers fetch the
+        # journaled RSS file) and only the agg exchange recomputes
+        assert stats["maps_skipped"] >= 1
+        assert stats["bytes_reused"] > 0
+        assert not _journal_stems(jdir)
+        # the resume left its report behind — tools/journal_report.py
+        # renders the per-exchange stage map from it
+        reports = glob.glob(os.path.join(jdir, "report_*.json"))
+        assert len(reports) == 1
+        import importlib
+        jr_tool = importlib.import_module("tools.journal_report")
+        assert jr_tool.main([jdir]) == 0
+        s2.close()
+        s.close()
+
+    def test_reuse_adopts_suspended_journal(self, jdir):
+        """The crashed-and-resubmitted dashboard case: an IDENTICAL
+        plan re-submitted with auron.journal.reuse on adopts the
+        suspended journal and skips its committed maps."""
+        tbl = _table(seed=13)
+        conf = cfg.get_config()
+        conf.unset(cfg.JOURNAL_DIR)
+        baseline = self._baseline(tbl)
+        conf.set(cfg.JOURNAL_DIR, jdir)
+        s = Session()
+        s.register("crash_t", tbl)
+        _abort_after_commit(s, _two_exchange_df(s))
+        assert len(_journal_stems(jdir)) == 1
+        # simulate the process dying WITHOUT Session.close (SIGKILL):
+        # the open-stem ledger of "this process" empties
+        s._journals = []
+        jrn._forget_open_stems()
+        s2 = Session()
+        s2.register("crash_t", tbl)
+        out = s2.execute(_two_exchange_df(s2))
+        assert out.equals(baseline)
+        stats = jrn.last_stats()
+        assert stats["maps_skipped"] >= 1
+        assert stats["bytes_reused"] > 0
+        assert not _journal_stems(jdir)
+        s2.close()
+        s.close()
+
+    def test_reuse_ignores_different_plan(self, jdir):
+        """A DIFFERENT plan must never adopt another query's journal —
+        plan fingerprints gate adoption."""
+        tbl = _table(seed=17)
+        s = Session()
+        s.register("crash_t", tbl)
+        _fault_abort(s, _two_exchange_df(s))
+        assert len(_journal_stems(jdir)) == 1
+        s._journals = []
+        jrn._forget_open_stems()
+        s2 = Session()
+        s2.register("crash_t", tbl)
+        # different threshold = different plan bytes = no adoption
+        out = s2.execute(_two_exchange_df(s2, threshold=500))
+        conf = cfg.get_config()
+        conf.unset(cfg.JOURNAL_DIR)
+        s3 = Session()
+        s3.register("crash_t", tbl)
+        expect = s3.execute(_two_exchange_df(s3, threshold=500))
+        conf.set(cfg.JOURNAL_DIR, jdir)
+        assert out.equals(expect)
+        # the foreign suspended journal is still there (it was never
+        # adopted); the two sessions' own journals completed+deleted
+        assert len(_journal_stems(jdir)) == 1
+        s3.close()
+        s2.close()
+        s.close()
+
+    def test_resume_disambiguates_recycled_query_id(self, jdir):
+        """Query ids recycle across process restarts (serving's
+        per-process counter: crashed server A's 'serving-1' and live
+        server B's 'serving-1' coexist as different stems) — a
+        candidate owned by ANOTHER LIVE process would be refused with
+        reason='open' anyway, so it must not make the id ambiguous:
+        resume picks the one genuinely resumable journal."""
+        from auron_tpu.utils import liveness
+        tbl = _table(seed=31)
+        conf = cfg.get_config()
+        conf.unset(cfg.JOURNAL_DIR)
+        baseline = self._baseline(tbl)
+        conf.set(cfg.JOURNAL_DIR, jdir)
+        s = Session()
+        s.register("crash_t", tbl)
+        _abort_after_commit(s, _two_exchange_df(s))
+        stems = _journal_stems(jdir)
+        assert len(stems) == 1
+        qid = stems[0].rsplit("_", 1)[0]
+        s._journals = []
+        jrn._forget_open_stems()
+        # a LIVE foreign process's journal under the SAME query id
+        # (pid 1 = init, alive on any linux box, with its live epoch)
+        src = os.path.join(jdir, stems[0] + ".journal")
+        header, records, _vl = jrn._read_records(src)
+        header["owner"] = f"{liveness._HOST}:1:{liveness.process_epoch(1)}"
+        twin = os.path.join(jdir, f"{qid}_1.journal")
+        with open(twin, "wb") as f:
+            f.write(jrn._encode(header))
+            for r in records:
+                f.write(jrn._encode(r))
+        resumed = s.resume(qid)
+        assert resumed.equals(baseline)
+        os.unlink(twin)
+        s.close()
+
+    def test_foreign_live_owner_refuses_resume_and_adoption(self, jdir):
+        """On a SHARED journal dir the in-process open-stem ledger
+        cannot see another process's claim — the header's owner tag is
+        the cross-process half of the guard: a journal owned by a
+        DIFFERENT live process refuses resume (reason='open') and is
+        never adopted (two appenders in one file, and the winner's
+        complete() would rmtree the shared rss_root under the loser)."""
+        from auron_tpu.utils import liveness
+        tbl = _table(seed=29)
+        s = Session()
+        s.register("crash_t", tbl)
+        _abort_after_commit(s, _two_exchange_df(s), commits=1)
+        stems = _journal_stems(jdir)
+        assert len(stems) == 1
+        s._journals = []
+        jrn._forget_open_stems()
+        # re-head the journal as owned by a FOREIGN live process:
+        # pid 1 (init — alive on any linux box) with its live epoch
+        path = os.path.join(jdir, stems[0] + ".journal")
+        header, records, _vl = jrn._read_records(path)
+        header["owner"] = f"{liveness._HOST}:1:{liveness.process_epoch(1)}"
+        with open(path, "wb") as f:
+            f.write(jrn._encode(header))
+            for r in records:
+                f.write(jrn._encode(r))
+        with pytest.raises(errors.ResumeUnavailable) as ei:
+            s.resume(stems[0])
+        assert ei.value.reason == "open"
+        # identical re-submission does NOT adopt it either: the run
+        # mints (and completes) its own journal, the foreign one stays
+        out = s.execute(_two_exchange_df(s))
+        assert _journal_stems(jdir) == stems
+        conf = cfg.get_config()
+        conf.unset(cfg.JOURNAL_DIR)
+        s2 = Session()
+        s2.register("crash_t", tbl)
+        assert out.equals(s2.execute(_two_exchange_df(s2)))
+        conf.set(cfg.JOURNAL_DIR, jdir)
+        os.unlink(path)
+        shutil.rmtree(os.path.join(jdir, "rss"), ignore_errors=True)
+        s2.close()
+        s.close()
+
+    def test_reuse_ignores_scope_mismatch_and_task_scope_resumes(
+            self, jdir):
+        """Scope is part of the adoption identity: a TASK-scoped
+        journal (serving SUBMIT — the host engine owns the partition
+        fan-out) must never be adopted by a Session submission of the
+        identical plan bytes, and Session.resume of one replays
+        exactly the journaled task's own partition, never the whole
+        range (which would over-produce rows the host engine computes
+        elsewhere)."""
+        tbl = _table(seed=23)
+        s = Session()
+        s.register("crash_t", tbl)
+        df = _two_exchange_df(s)
+        baseline = s.execute(df)
+        # a suspended TASK-scoped journal carrying the very plan bytes
+        # a Session submission fingerprints
+        jr = jrn.QueryJournal.create(jdir, "qtask", df.task_bytes(),
+                                     df.num_partitions, s.ctx.catalog,
+                                     scope="task")
+        assert jr is not None
+        jr.suspend()
+        jrn._forget_open_stems()
+        out = s.execute(_two_exchange_df(s))
+        assert out.equals(baseline)
+        # NOT adopted: the task-scoped journal still sits suspended
+        # (the session's own journal completed and deleted itself)
+        stems = _journal_stems(jdir)
+        assert len(stems) == 1 and stems[0].startswith("qtask")
+        # task-scope resume: exactly the journaled partition_id's rows
+        # (partition 0 = the baseline's leading chunk, engine order
+        # being deterministic), not all num_partitions of them
+        resumed = s.resume("qtask")
+        assert resumed.num_rows < baseline.num_rows
+        assert resumed.equals(baseline.slice(0, resumed.num_rows))
+        assert not _journal_stems(jdir)
+        s.close()
+
+    def test_concurrent_resume_two_queries_one_session(self, jdir):
+        """Two crashed journaled queries resume CONCURRENTLY through
+        one Session: both bit-identical, clean journal/spill ledger."""
+        tbl_a, tbl_b = _table(seed=21), _table(seed=23)
+        conf = cfg.get_config()
+        conf.unset(cfg.JOURNAL_DIR)
+        s0 = Session()
+        s0.register("crash_a", tbl_a)
+        s0.register("crash_b", tbl_b)
+        base_a = s0.execute(_two_exchange_df(s0, "crash_a"))
+        base_b = s0.execute(_two_exchange_df(s0, "crash_b",
+                                             threshold=200))
+        s0.close()
+        conf.set(cfg.JOURNAL_DIR, jdir)
+        s1 = Session()
+        s1.register("crash_a", tbl_a)
+        s1.register("crash_b", tbl_b)
+        _fault_abort(s1, _two_exchange_df(s1, "crash_a"))
+        _fault_abort(s1, _two_exchange_df(s1, "crash_b", threshold=200))
+        stems = _journal_stems(jdir)
+        assert len(stems) == 2
+        s1._journals = []
+        jrn._forget_open_stems()
+
+        s2 = Session()
+        s2.register("crash_a", tbl_a)
+        s2.register("crash_b", tbl_b)
+        results: dict = {}
+
+        def resume(stem):
+            try:
+                results[stem] = s2.resume(stem)
+            except BaseException as e:   # noqa: BLE001 — asserted below
+                results[stem] = e
+
+        threads = [threading.Thread(target=resume, args=(st,))
+                   for st in stems]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for st in stems:
+            assert isinstance(results[st], pa.Table), results[st]
+        # match each resumed table to its baseline by equality
+        assert any(results[st].equals(base_a) for st in stems)
+        assert any(results[st].equals(base_b) for st in stems)
+        assert not _journal_stems(jdir)
+        assert jrn.open_journal_count() == 0
+        s2.close()
+        s1.close()
+
+
+# ---------------------------------------------------------------------------
+# startup sweeps (satellites: spill + RSS + journal orphan GC)
+# ---------------------------------------------------------------------------
+
+def _dead_tag():
+    """A liveness tag of a genuinely dead process (spawned + exited)."""
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys, os;"
+         "sys.path.insert(0, os.getcwd());"
+         "from auron_tpu.utils import liveness;"
+         "print(liveness.own_tag())"],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    return out.stdout.strip()
+
+
+def _dead_spill_token(tag):
+    """pid.epoch.hosthex filename token from a liveness tag (the dead
+    child ran on THIS host, so the digest is ours)."""
+    from auron_tpu.memmgr import spill as spill_mod
+    _host, pid, epoch = tag.rsplit(":", 2)
+    return f"p{pid}.{epoch}.{spill_mod._HOST_HEX}"
+
+
+class TestStartupSweeps:
+    def test_spill_sweep_reclaims_dead_owner_only(self, tmp_path):
+        from auron_tpu.memmgr.spill import SpillManager, _owner_token
+        d = str(tmp_path / "spill")
+        os.makedirs(d)
+        dead = os.path.join(
+            d, f"auron-spill-{_dead_spill_token(_dead_tag())}-1-x.atb")
+        live = os.path.join(d, f"auron-spill-{_owner_token()}-2-y.atb")
+        legacy = os.path.join(d, "auron-spill-3-z.atb")   # pre-sweep name
+        # a FOREIGN host's token (shared spill mount): its pids mean
+        # nothing here — never swept, whatever our pid table says
+        foreign = os.path.join(
+            d, "auron-spill-p1.0.deadbeef-4-w.atb")
+        for p in (dead, live, legacy, foreign):
+            with open(p, "wb") as f:
+                f.write(b"spill")
+        SpillManager(host_budget_bytes=1, spill_dir=d)
+        assert not os.path.exists(dead)
+        assert os.path.exists(live)
+        assert os.path.exists(legacy)
+        assert os.path.exists(foreign)
+        for p in (live, legacy, foreign):
+            os.unlink(p)
+
+    def test_rss_sweep_uncommitted_dirs_and_parts(self, tmp_path):
+        from auron_tpu.parallel.shuffle_service import FileShuffleService
+        from auron_tpu.utils import liveness
+        root = str(tmp_path / "rss")
+        dead_tag = _dead_tag()
+        # dead owner, UNCOMMITTED (no manifest): whole dir sweeps
+        d1 = os.path.join(root, "shuffle_1")
+        os.makedirs(d1)
+        with open(os.path.join(d1, ".owner"), "w") as f:
+            f.write(dead_tag)
+        with open(os.path.join(d1, "map_0.part"), "wb") as f:
+            f.write(b"x")
+        # dead owner, COMMITTED: data stays, .part sweeps
+        d2 = os.path.join(root, "shuffle_2")
+        os.makedirs(d2)
+        with open(os.path.join(d2, ".owner"), "w") as f:
+            f.write(dead_tag)
+        with open(os.path.join(d2, "manifest"), "w") as f:
+            f.write("1")
+        with open(os.path.join(d2, "map_0.data"), "wb") as f:
+            f.write(b"data")
+        with open(os.path.join(d2, "map_1.part"), "wb") as f:
+            f.write(b"torn")
+        # LIVE owner (this process): untouched
+        d3 = os.path.join(root, "shuffle_3")
+        os.makedirs(d3)
+        with open(os.path.join(d3, ".owner"), "w") as f:
+            f.write(liveness.own_tag())
+        with open(os.path.join(d3, "map_0.part"), "wb") as f:
+            f.write(b"inflight")
+        FileShuffleService(root)
+        assert not os.path.isdir(d1)
+        assert os.path.exists(os.path.join(d2, "map_0.data"))
+        assert not os.path.exists(os.path.join(d2, "map_1.part"))
+        assert os.path.exists(os.path.join(d3, "map_0.part"))
+
+    def test_journal_sweep_keeps_resumable_dead_inventory(
+            self, tmp_path):
+        """The journal sweep's crucial asymmetry: a DEAD process's
+        RESUMABLE journal is the recovery inventory (kept); its torn
+        husks and unreferenced RSS run dirs are garbage (swept)."""
+        d = str(tmp_path / "journal")
+        os.makedirs(d)
+        dead_tag = _dead_tag()
+        # resumable journal of a dead owner (valid header): KEPT
+        keep = os.path.join(d, "q9_111.journal")
+        header = {"k": "h", "v": jrn.VERSION, "query_id": "q9",
+                  "owner": dead_tag, "plan_fp": "x", "sources": {},
+                  "num_partitions": 1, "plan_b64": "", "created": 0}
+        with open(keep, "wb") as f:
+            f.write(jrn._encode(header))
+        # torn-header husk of a dead owner: swept (epoch-0 tag parses
+        # as unknowable-pid -> also swept when the pid is dead)
+        husk = os.path.join(d, "q8_222.journal")
+        with open(husk, "wb") as f:
+            f.write(b"not a journal at all")
+        # .part tempfile: swept
+        part = os.path.join(d, "q7_333.journal.part")
+        with open(part, "wb") as f:
+            f.write(b"x")
+        # RSS run dir with NO journal and a dead .owner: swept
+        rss_orphan = os.path.join(d, "rss", "q6_444")
+        os.makedirs(rss_orphan)
+        with open(os.path.join(rss_orphan, ".owner"), "w") as f:
+            f.write(dead_tag)
+        # RSS run dir BEHIND the kept journal: kept
+        rss_keep = os.path.join(d, "rss", "q9_111")
+        os.makedirs(rss_keep)
+        with open(os.path.join(rss_keep, ".owner"), "w") as f:
+            f.write(dead_tag)
+        removed = jrn.sweep_orphans(d, force=True)
+        assert removed >= 3
+        assert os.path.exists(keep)
+        assert not os.path.exists(husk)
+        assert not os.path.exists(part)
+        assert not os.path.isdir(rss_orphan)
+        assert os.path.isdir(rss_keep)
+        os.unlink(keep)
+        shutil.rmtree(os.path.join(d, "rss"), ignore_errors=True)
+
+    def test_inventory_retention_cap(self, tmp_path):
+        """A dead owner's RESUMABLE journal is inventory — but only
+        for auron.journal.retention_s: aged inventory nobody resumes
+        GCs along with its RSS run dir, fresh inventory stays."""
+        d = str(tmp_path / "journal")
+        os.makedirs(d)
+        dead_tag = _dead_tag()
+
+        def mk(stem, age_s):
+            p = os.path.join(d, f"{stem}.journal")
+            header = {"k": "h", "v": jrn.VERSION, "query_id": stem,
+                      "owner": dead_tag, "plan_fp": "x", "sources": {},
+                      "num_partitions": 1, "plan_b64": "", "created": 0}
+            with open(p, "wb") as f:
+                f.write(jrn._encode(header))
+            t = __import__("time").time() - age_s
+            os.utime(p, (t, t))
+            rss = os.path.join(d, "rss", stem)
+            os.makedirs(rss)
+            with open(os.path.join(rss, ".owner"), "w") as f:
+                f.write(dead_tag)
+            return p, rss
+
+        conf = cfg.get_config()
+        conf.set(cfg.JOURNAL_RETENTION_S, 3600.0)
+        try:
+            aged, aged_rss = mk("old1", 7200)
+            fresh, fresh_rss = mk("new1", 60)
+            jrn.sweep_orphans(d, force=True)
+        finally:
+            conf.unset(cfg.JOURNAL_RETENTION_S)
+        assert not os.path.exists(aged) and not os.path.isdir(aged_rss)
+        assert os.path.exists(fresh) and os.path.isdir(fresh_rss)
+        os.unlink(fresh)
+        shutil.rmtree(os.path.join(d, "rss"), ignore_errors=True)
+
+    def test_report_retention_cap(self, tmp_path):
+        """Resume reports are telemetry, not inventory: the sweep keeps
+        only the newest REPORT_RETENTION of them (a long-lived
+        deployment must not grow one file per resumed query forever)."""
+        d = str(tmp_path / "journal")
+        os.makedirs(d)
+        n = jrn.REPORT_RETENTION + 5
+        for i in range(n):
+            p = os.path.join(d, f"report_q{i}.json")
+            with open(p, "w") as f:
+                f.write("{}")
+            os.utime(p, (1000 + i, 1000 + i))
+        removed = jrn.sweep_orphans(d, force=True)
+        assert removed == 5
+        left = sorted(os.listdir(d))
+        assert len(left) == jrn.REPORT_RETENTION
+        # the OLDEST five went, the newest stayed
+        assert f"report_q{n - 1}.json" in left
+        assert "report_q0.json" not in left
+
+
+# ---------------------------------------------------------------------------
+# journal fault sites: degrade, never fail
+# ---------------------------------------------------------------------------
+
+class TestJournalFaults:
+    @pytest.mark.parametrize("plan", [
+        "journal.write:io_error@1.0",
+        "journal.commit:io_error@1.0",
+        "journal.write:fatal@0.5",
+    ])
+    def test_write_faults_degrade_never_fail(self, tmp_path, plan):
+        """An injected journal write/commit fault DISABLES journaling
+        for the query (resumability lost) — the query itself completes
+        bit-identical to the unfaulted run."""
+        sc = chaos.journal_pipeline(str(tmp_path))
+        o = chaos.run_chaos(sc, plan, seed=3)
+        assert o.status == "identical", (o.status, o.error_type, o.error)
+        assert not o.leaks
+
+    def test_load_fault_is_classified(self, jdir):
+        """A journal.load io_error surfaces as the classified
+        JournalCorrupt on resume — never an OSError traceback."""
+        from auron_tpu.runtime import faults
+        tbl = _table(seed=29)
+        s = Session()
+        s.register("crash_t", tbl)
+        _fault_abort(s, _two_exchange_df(s))
+        stem = _journal_stems(jdir)[0]
+        s._journals = []
+        jrn._forget_open_stems()
+        conf = cfg.get_config()
+        conf.set(cfg.FAULTS_PLAN, "journal.load:io_error@1.0")
+        conf.set(cfg.FAULTS_SEED, 5)
+        faults.reset()
+        try:
+            s2 = Session()
+            s2.register("crash_t", tbl)
+            with pytest.raises(errors.JournalCorrupt):
+                s2.resume(stem)
+        finally:
+            conf.unset(cfg.FAULTS_PLAN)
+            conf.unset(cfg.FAULTS_SEED)
+            faults.reset()
+            s2.close()
+            s.close()
+        # the journal survives the failed load attempt (retryable by
+        # an operator once the IO issue clears)
+        leftovers = _journal_stems(jdir)
+        assert leftovers == [stem]
+        os.unlink(os.path.join(jdir, stem + ".journal"))
+        shutil.rmtree(os.path.join(jdir, "rss"), ignore_errors=True)

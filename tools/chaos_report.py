@@ -71,6 +71,14 @@ PLANS = [
     ("mesh_pipeline", "mesh.gang:cancel@0.5"),
     ("mesh_pipeline",
      "mesh.all_to_all:io_error@0.2;device.compute:io_error@0.1"),
+    # crash-safe query journal (ISSUE 13): write/commit faults must
+    # DEGRADE journaling (journal.disable), never the query — every run
+    # identical, no journal file left behind
+    ("journal_pipeline", "journal.write:io_error@0.3"),
+    ("journal_pipeline", "journal.write:fatal@0.5"),
+    ("journal_pipeline", "journal.commit:io_error@0.5"),
+    ("journal_pipeline",
+     "journal.write:io_error@0.2;rss.write:io_error@0.2"),
 ]
 
 
@@ -266,6 +274,44 @@ def print_table(report: dict) -> None:
               f"leaks={f['leaks']}")
 
 
+def run_crash(kill_points=None) -> dict:
+    """The subprocess crash sweep (auron_tpu/it/chaos.run_crash_sweep):
+    a child Session SIGKILLed at every journal stage boundary of the
+    two-exchange crash query, the parent resuming each time. Reported
+    like the seeded battery: identical-or-classified, zero leaks."""
+    from auron_tpu.it import chaos
+    outs = chaos.run_crash_sweep(kill_points=kill_points)
+    rows = [{"kill_point": o.kill_point, "child_rc": o.child_rc,
+             "status": o.status, "error_type": o.error_type,
+             "maps_skipped": o.maps_skipped,
+             "maps_recomputed": o.maps_recomputed,
+             "bytes_reused": o.bytes_reused,
+             "resume_wall_s": round(o.resume_wall_s, 3),
+             "leaks": o.leaks} for o in outs]
+    return {"rows": rows, "ok": all(o.ok for o in outs)}
+
+
+def print_crash(report: dict) -> None:
+    hdr = (f"{'kill@':>5s} {'rc':>4s} {'status':>10s} {'skip':>5s} "
+           f"{'recomp':>6s} {'bytes reused':>13s} {'resume s':>8s} "
+           f"{'leaks':>5s}")
+    print("crash sweep (child SIGKILLed at every journal boundary, "
+          "parent resumes)")
+    print(hdr)
+    print("-" * len(hdr))
+    for r in report["rows"]:
+        print(f"{r['kill_point']:>5d} {r['child_rc']:>4d} "
+              f"{r['status']:>10s} {r['maps_skipped']:>5d} "
+              f"{r['maps_recomputed']:>6d} {r['bytes_reused']:>13,d} "
+              f"{r['resume_wall_s']:>8.3f} {len(r['leaks']):>5d}")
+    for r in report["rows"]:
+        if r["status"] not in ("identical", "classified", "completed") \
+                or r["leaks"]:
+            print(f"CONTRACT BROKEN: kill@{r['kill_point']} -> "
+                  f"{r['status']} ({r.get('error_type')}) "
+                  f"leaks={r['leaks']}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seeds", type=int, default=8,
@@ -274,9 +320,22 @@ def main(argv=None) -> int:
                                            "agg_pipeline",
                                            "mesh_pipeline",
                                            "lifecycle_pipeline",
-                                           "overload"],
+                                           "overload",
+                                           "journal_pipeline"],
                     default=None)
+    ap.add_argument("--crash", action="store_true",
+                    help="run the subprocess crash sweep (SIGKILL at "
+                         "every journal stage boundary + resume) "
+                         "instead of the seeded fault battery")
     args = ap.parse_args(argv)
+
+    if args.crash:
+        report = run_crash()
+        print_crash(report)
+        print(json.dumps({"crash_points": len(report["rows"]),
+                          "crash_rows": report["rows"],
+                          "crash_contract_ok": report["ok"]}))
+        return 0 if report["ok"] else 1
 
     report = run_sweep(args.seeds, args.scenario)
     print_table(report)

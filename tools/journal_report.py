@@ -1,0 +1,195 @@
+"""Crash-recovery journal report: per-query stage map of what a resume
+reused vs recomputed.
+
+Reads a journal directory (``auron.journal.dir``):
+
+- ``report_*.json`` — resume reports persisted by completed journaled
+  queries (runtime/journal.QueryJournal.complete): per-exchange
+  satisfied / maps skipped / maps recomputed / bytes reused, plus the
+  hot-path cost ledger the perf gate reads.
+- ``*.journal`` — the PENDING resume inventory: journals of queries
+  that have not completed (in-flight, crashed, or awaiting adoption),
+  printed with their owner's liveness verdict (utils/liveness) so an
+  operator can tell "running right now" from "resumable after a crash"
+  at a glance.
+
+    python tools/journal_report.py /path/to/journal/dir
+    python tools/journal_report.py dirA --compare dirB
+
+``--compare`` diffs the two directories' aggregate reuse (maps skipped,
+bytes reused, hot-path ns) and WARNS when the newer side reuses less —
+the regression surface for resume coverage. The last stdout line is one
+JSON record (the bench.py / chaos_report.py driver contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_reports(dir_: str) -> list:
+    out = []
+    for path in sorted(glob.glob(os.path.join(dir_, "report_*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            rec["_path"] = path
+            out.append(rec)
+        except (OSError, ValueError) as e:
+            print(f"  ! unreadable report {path}: {e}")
+    return out
+
+
+def load_inventory(dir_: str) -> list:
+    """Pending (not-yet-completed) journals with owner liveness."""
+    from auron_tpu import errors
+    from auron_tpu.runtime import journal as jrn
+    from auron_tpu.utils import liveness
+    out = []
+    for path in sorted(glob.glob(os.path.join(dir_, "*.journal"))):
+        stem = os.path.splitext(os.path.basename(path))[0]
+        try:
+            header, records, _vl = jrn._read_records(path)
+        except errors.JournalError as e:
+            out.append({"stem": stem, "state": "corrupt",
+                        "error": str(e)})
+            continue
+        owner = header.get("owner", "")
+        commits = sum(1 for r in records if r.get("k") == "c")
+        maps = sum(1 for r in records if r.get("k") == "m")
+        exchanges = sum(1 for r in records if r.get("k") == "x")
+        out.append({
+            "stem": stem,
+            "query_id": header.get("query_id", ""),
+            "plan_fp": header.get("plan_fp", ""),
+            "owner": owner,
+            "owner_live": liveness.is_live(owner) if owner else None,
+            "state": ("in-flight" if owner and liveness.is_live(owner)
+                      else "resumable"),
+            "exchanges": exchanges,
+            "maps_committed": maps,
+            "shuffles_committed": commits,
+        })
+    return out
+
+
+def summarize(reports: list) -> dict:
+    agg = {"queries": len(reports), "maps_skipped": 0,
+           "maps_recomputed": 0, "bytes_reused": 0, "hot_ns": 0,
+           "satisfied_exchanges": 0, "recomputed_exchanges": 0}
+    for rec in reports:
+        st = rec.get("stats", {})
+        agg["maps_skipped"] += st.get("maps_skipped", 0)
+        agg["maps_recomputed"] += st.get("maps_recomputed", 0)
+        agg["bytes_reused"] += st.get("bytes_reused", 0)
+        agg["hot_ns"] += st.get("hot_ns", 0)
+        for entry in st.get("resume_log", {}).values():
+            if entry.get("satisfied"):
+                agg["satisfied_exchanges"] += 1
+            elif entry.get("maps_recomputed"):
+                agg["recomputed_exchanges"] += 1
+    return agg
+
+
+def print_report(rec: dict) -> None:
+    st = rec.get("stats", {})
+    print(f"\nquery {rec.get('query_id', '?')}  "
+          f"(journal {rec.get('stem', '?')}, "
+          f"plan {rec.get('plan_fp', '?')[:12]})")
+    print(f"  hot-path cost: {st.get('hot_ns', 0) / 1e6:.2f} ms over "
+          f"{st.get('records', 0)} records / "
+          f"{st.get('commits', 0)} commits")
+    exchanges = rec.get("exchanges", {})
+    resume_log = st.get("resume_log", {})
+    if not exchanges:
+        print("  (no exchanges journaled)")
+        return
+    print(f"  {'shuffle':>8} {'kind':>12} {'maps':>5} {'parts':>6} "
+          f"{'verdict':>10} {'skipped':>8} {'recomp':>7} "
+          f"{'bytes reused':>13}")
+    for sid in sorted(exchanges, key=lambda x: int(x)):
+        ex = exchanges[sid]
+        log = resume_log.get(str(sid), {})
+        if log.get("satisfied"):
+            verdict = "satisfied"
+        elif log.get("maps_skipped") or log.get("maps_recomputed"):
+            verdict = "partial"
+        else:
+            verdict = "fresh"
+        print(f"  {sid:>8} {ex.get('kind', '?'):>12} "
+              f"{ex.get('maps', 0):>5} {ex.get('partitions', 0):>6} "
+              f"{verdict:>10} {log.get('maps_skipped', 0):>8} "
+              f"{log.get('maps_recomputed', 0):>7} "
+              f"{log.get('bytes_reused', 0):>13,}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("journal_dir", help="auron.journal.dir to report on")
+    ap.add_argument("--compare", default=None, metavar="OTHER_DIR",
+                    help="second journal dir: diff aggregate reuse "
+                         "(positional dir is the NEW side)")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.journal_dir):
+        print(f"journal dir not found: {args.journal_dir}")
+        print(json.dumps({"error": "no_journal_dir",
+                          "dir": args.journal_dir}))
+        return 2
+
+    reports = load_reports(args.journal_dir)
+    inventory = load_inventory(args.journal_dir)
+    agg = summarize(reports)
+
+    print(f"journal dir: {args.journal_dir}")
+    print(f"completed resume reports: {len(reports)}   "
+          f"pending journals: {len(inventory)}")
+    for rec in reports:
+        print_report(rec)
+    if inventory:
+        print("\npending resume inventory:")
+        for inv in inventory:
+            if inv.get("state") == "corrupt":
+                print(f"  {inv['stem']:>24}  CORRUPT  {inv['error']}")
+            else:
+                print(f"  {inv['stem']:>24}  {inv['state']:>9}  "
+                      f"exchanges={inv['exchanges']} "
+                      f"maps={inv['maps_committed']} "
+                      f"commits={inv['shuffles_committed']} "
+                      f"owner={'live' if inv['owner_live'] else 'dead'}")
+    print(f"\naggregate: {agg['maps_skipped']} maps skipped / "
+          f"{agg['maps_recomputed']} recomputed, "
+          f"{agg['bytes_reused']:,} bytes reused, "
+          f"{agg['satisfied_exchanges']} exchanges satisfied, "
+          f"hot-path {agg['hot_ns'] / 1e6:.2f} ms")
+
+    record = {"dir": args.journal_dir, "aggregate": agg,
+              "pending": len(inventory),
+              "corrupt": sum(1 for i in inventory
+                             if i.get("state") == "corrupt")}
+    rc = 0
+    if args.compare:
+        other = summarize(load_reports(args.compare))
+        record["compare"] = {"dir": args.compare, "aggregate": other}
+        print(f"\ncompare vs {args.compare}:")
+        for key in ("maps_skipped", "bytes_reused",
+                    "satisfied_exchanges", "hot_ns"):
+            print(f"  {key:>20}: {other[key]:,} -> {agg[key]:,}")
+        if other["queries"] and agg["queries"] \
+                and agg["maps_skipped"] < other["maps_skipped"]:
+            print("  WARNING: resume reuse REGRESSED — the new side "
+                  "skipped fewer committed maps than the old")
+            record["regressed"] = True
+            rc = 1
+    print(json.dumps(record))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -278,7 +278,17 @@ def run_smoke(baseline: dict) -> dict:
     the solo-query path pays < 2% of wall for it. Measured from the
     slot's own overhead ledger (time INSIDE acquire/turn/release, not
     policy waits) against the best run's wall — a deterministic ratio,
-    immune to the container's wall-clock noise that plagues A/B runs."""
+    immune to the container's wall-clock noise that plagues A/B runs.
+
+    And as the JOURNAL-OVERHEAD gate (the crash-safe query journal,
+    runtime/journal.py): one extra q01 run with ``auron.journal.dir``
+    armed, asserting the journal's HOT-PATH cost (its own ``hot_ns``
+    ledger: record enqueues + the commit-boundary drain/fsync waits —
+    everything the driving thread ever blocks on) stays under
+    ``smoke.journal_overhead_limit_pct`` of that run's wall. Same
+    deterministic-ledger discipline as the scheduler tax: a regression
+    in the hot-path cost fails the gate instead of hiding in container
+    noise."""
     import tempfile
     import time
 
@@ -289,6 +299,7 @@ def run_smoke(baseline: dict) -> dict:
     smoke = baseline.get("smoke", {})
     floor = float(smoke.get("cpu_floor_rows_per_sec", 20000.0))
     tax_limit = float(smoke.get("sched_tax_limit_pct", 2.0))
+    journal_limit = float(smoke.get("journal_overhead_limit_pct", 2.0))
     data = tempfile.mkdtemp(prefix="auron_perf_smoke_")
     try:
         tables = gen_data(data, scale=scale)
@@ -305,6 +316,28 @@ def run_smoke(baseline: dict) -> dict:
                 wall, tax_ns = w, s._scheduler.last_overhead_ns
         value = rows / wall
         tax_pct = tax_ns / (wall * 1e9) * 100.0
+        # journal arm: same query, journaling armed, hot-path ledger
+        from auron_tpu import config as cfg
+        from auron_tpu.runtime import journal as jrn
+        conf = cfg.get_config()
+        jdir = os.path.join(data, "journal")
+        conf.set(cfg.JOURNAL_DIR, jdir)
+        try:
+            # best-of-2 like the main loop: one cold fsync outlier on
+            # this container must not fail a healthy hot path
+            journal_pct, jstats = float("inf"), {}
+            for _ in range(2):
+                s = Session()
+                t0 = time.perf_counter()
+                q01_dataframe(s, tables).collect()
+                jwall = time.perf_counter() - t0
+                s.close()
+                st = jrn.last_stats()
+                pct = st.get("hot_ns", 0) / (jwall * 1e9) * 100.0
+                if pct < journal_pct:
+                    journal_pct, jstats = pct, st
+        finally:
+            conf.unset(cfg.JOURNAL_DIR)
         verdict = {
             "perf_gate": "pass" if value >= floor else "fail",
             "mode": "smoke",
@@ -314,12 +347,30 @@ def run_smoke(baseline: dict) -> dict:
             "floor_rows_per_sec": round(floor, 1),
             "sched_tax_pct": round(tax_pct, 4),
             "sched_tax_limit_pct": tax_limit,
+            "journal_overhead_pct": round(journal_pct, 4),
+            "journal_overhead_limit_pct": journal_limit,
+            "journal_records": jstats.get("records", 0),
+            "journal_commits": jstats.get("commits", 0),
         }
         if tax_pct >= tax_limit:
             verdict["perf_gate"] = "fail"
             verdict["reason"] = (
                 f"scheduler tax {tax_pct:.3f}% >= {tax_limit}% of the "
                 f"solo-query wall (concurrency-tax gate)")
+        if not jstats.get("records"):
+            # the journaled run recorded NOTHING: the plane silently
+            # disarmed itself (or degraded) — the gate must not pass
+            # on a measurement of an idle journal
+            verdict["perf_gate"] = "fail"
+            verdict["reason"] = (
+                "journal-overhead gate measured an idle journal "
+                "(0 records) — journaling did not engage")
+        elif journal_pct >= journal_limit:
+            verdict["perf_gate"] = "fail"
+            verdict["reason"] = (
+                f"journal hot-path overhead {journal_pct:.3f}% >= "
+                f"{journal_limit}% of the journaled q01 wall "
+                f"(crash-safe journal gate)")
         return verdict
     finally:
         import shutil
@@ -354,7 +405,9 @@ def main(argv=None) -> int:
               f"{verdict['value_rows_per_sec']:,.0f} rows/s vs floor "
               f"{verdict['floor_rows_per_sec']:,.0f}, sched tax "
               f"{verdict['sched_tax_pct']:.3f}% (limit "
-              f"{verdict['sched_tax_limit_pct']:.0f}%) → "
+              f"{verdict['sched_tax_limit_pct']:.0f}%), journal "
+              f"overhead {verdict['journal_overhead_pct']:.3f}% (limit "
+              f"{verdict['journal_overhead_limit_pct']:.0f}%) → "
               f"{verdict['perf_gate'].upper()}")
         print(json.dumps(verdict))
         return 0 if verdict["perf_gate"] == "pass" else 1
