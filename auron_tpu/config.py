@@ -539,6 +539,69 @@ TRACE_MAX_SPANS = _opt(
     "unbounded query can never turn the tracer into a memory leak. "
     "The cap is approximate: enforcement is lock-free like recording.")
 
+# ops plane: live telemetry endpoint (auron_tpu/obs/ops_server.py)
+OPS_ENABLED = _opt(
+    "auron.ops.enabled", bool, False,
+    "Run the in-process ops HTTP endpoint (auron_tpu/obs/ops_server.py, "
+    "stdlib ThreadingHTTPServer — the role of the reference's runtime "
+    "HTTP service, auron/src/http/mod.rs:25-108): /metrics serves the "
+    "process registry's Prometheus exposition, /healthz the ok-vs-"
+    "degraded probe/scheduler/memmgr/mesh verdict, /queries the live "
+    "query table (state, wall, tasks done/total, per-query memory vs "
+    "quota, program-cache hits), /flight the flight recorder's recent-"
+    "event ring as JSONL. One server per process (refcounted across "
+    "Sessions/AuronServers; the last close stops it). Default off.")
+OPS_PORT = _opt(
+    "auron.ops.port", int, 0,
+    "TCP port of the ops HTTP endpoint; 0 (default) binds an ephemeral "
+    "port, logged at startup and surfaced as Session.ops_address / the "
+    "AuronServer stats 'ops_port' entry (and on the serving STATS "
+    "frame), so a supervisor can discover it without parsing logs.")
+
+# always-on flight recorder (auron_tpu/obs/flight_recorder.py)
+FLIGHT_ENABLED = _opt(
+    "auron.flight.enabled", bool, True,
+    "Arm the always-on flight recorder: a bounded per-thread ring of "
+    "the most recent structured events across ALL trace categories "
+    "that records even while auron.trace.enabled is off (the trace "
+    "plane tees into it at emit time), so the last seconds before any "
+    "failure are reconstructable from /flight or a post-mortem bundle "
+    "without having had tracing on. Overhead is measured by the bench "
+    "three-arm A/B's 'norec' arm (flight_overhead_pct, gate <2% — "
+    "PERF.md 'Ops plane'); off restores the bare cached-epoch-compare "
+    "disabled path.")
+FLIGHT_RING_EVENTS = _opt(
+    "auron.flight.ring_events", int, 4096,
+    "Events retained per THREAD by the flight recorder's ring (a "
+    "collections.deque maxlen — O(1) memory, oldest evicted first). "
+    "Sized so several seconds of control-plane history (retries, "
+    "sheds, fault injections, admission decisions) survive on every "
+    "thread without the ring ever becoming a leak.")
+
+# post-mortem failure bundles (auron_tpu/obs/bundle.py)
+BUNDLE_ENABLED = _opt(
+    "auron.bundle.enabled", bool, False,
+    "Write a self-contained post-mortem bundle directory "
+    "(bundle_<query_id>/ under auron.bundle.dir) when a query ends in "
+    "a CLASSIFIED failure — MemoryExhausted shed, DeadlineExceeded, "
+    "TaskStalled exhaustion, unrecovered MeshUnavailable, "
+    "JournalCorrupt/JournalInvalidated: flight-recorder dump, explain "
+    "tree with metrics, scheduler/memmgr/mesh stats, probe + stall "
+    "reports, journal state and a config snapshot with the trace "
+    "salt. Plain cancels and admission sheds (no resources ever "
+    "existed) write nothing. tools/ops_report.py renders a bundle "
+    "into a human post-mortem. Default off.")
+BUNDLE_DIR = _opt(
+    "auron.bundle.dir", str, "",
+    "Directory for post-mortem bundles; empty (default) places them "
+    "under '<system temp>/auron-bundles'.")
+BUNDLE_MAX_BUNDLES = _opt(
+    "auron.bundle.max_bundles", int, 16,
+    "Retention cap on bundle directories under auron.bundle.dir: past "
+    "it the OLDEST bundles are evicted after each write, so a crash "
+    "loop can never fill the disk with post-mortems. <= 0 keeps "
+    "everything (tests only).")
+
 # process metrics registry (auron_tpu/obs/registry.py)
 METRICS_REGISTRY = _opt(
     "auron.metrics.registry", bool, True,

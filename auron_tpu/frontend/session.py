@@ -98,6 +98,18 @@ class Session:
             # journals of dead processes are KEPT — they are the
             # resume inventory)
             _jrn.sweep_orphans(_jrn.journal_dir(self.config))
+        #: ops plane (obs/ops_server.py): acquire the process's live
+        #: telemetry endpoint when auron.ops.enabled — refcounted, so
+        #: several Sessions share one server and the LAST close stops
+        #: it. ops_address is the bound (host, port) — the ephemeral-
+        #: port (auron.ops.port=0) discovery surface. Acquired LAST:
+        #: nothing after this can raise, so a failed __init__ (whose
+        #: close() never runs) can never strand the refcount above
+        #: zero and keep the port bound for the process lifetime.
+        from auron_tpu.obs import ops_server as _ops
+        self._ops = _ops.ensure_started(self.config)
+        self.ops_address = (self._ops.address
+                            if self._ops is not None else None)
 
     def _bind_xla_cache(self) -> None:
         """Bind jax's persistent compilation cache to
@@ -240,23 +252,45 @@ class Session:
         acquire (admission control; the token's slot rides it) →
         lifecycle/thread-local binding; unwound in exact reverse on
         exit. execute() and explain_analyze() share this so the
-        teardown ordering can never desynchronize between them."""
+        teardown ordering can never desynchronize between them.
+
+        Doubles as the query's end-to-end OBSERVATION point (the ops
+        plane): every exit — success, shed, cancel, failure — lands on
+        the ``auron_query_duration_seconds{outcome}`` registry
+        histogram, and a CLASSIFIED failure writes its post-mortem
+        bundle here (obs/bundle.maybe_write — the unwind that still
+        sees the scheduler, memmgr and the token's plan tree)."""
+        import time as _time
+
         from auron_tpu import errors
+        from auron_tpu.obs import bundle as _bundle
+        from auron_tpu.obs import registry as _registry
         from auron_tpu.runtime import lifecycle
+        t0 = _time.monotonic()
+
+        def observe(exc) -> None:
+            try:
+                _registry.observe_query(_time.monotonic() - t0,
+                                        _registry.classify_outcome(exc))
+            except Exception:   # pragma: no cover - telemetry only
+                pass
+
         token = self._begin_query(timeout_s)
         # admission BEFORE any planning/execution work: a shed query
         # costs nothing (AdmissionRejected / the token's own classified
         # error when cancelled while queued)
         try:
             slot = self._scheduler.acquire(token)
-        except errors.QueryCancelled:
+        except errors.QueryCancelled as e:
             # queue-phase cancels feed the same cancel-latency
             # histogram as mid-execution ones (every cancel class
             # counts toward the acceptance-gate metric)
             lifecycle.observe_unwind(token, kind=token.reason or "cancel")
+            observe(e)
             self._end_query(token)
             raise
-        except BaseException:
+        except BaseException as e:
+            observe(e)
             self._end_query(token)
             raise
         token.slot = slot
@@ -264,6 +298,18 @@ class Session:
         prev_bind = lifecycle.bind_token(token)
         try:
             yield token
+        except BaseException as e:
+            # classified-failure post-mortem (shed/deadline/stall/mesh/
+            # journal — obs/bundle.classify decides; plain cancels and
+            # unclassified crashes write nothing). maybe_write never
+            # raises: the query's own verdict always wins the unwind.
+            _bundle.maybe_write(e, token=token, config=self.config,
+                                scheduler=self._scheduler,
+                                mem_manager=self.mem_manager)
+            observe(e)
+            raise
+        else:
+            observe(None)
         finally:
             self._tls.token = None
             lifecycle.bind_token(prev_bind)
@@ -333,6 +379,12 @@ class Session:
             except Exception:   # pragma: no cover - cleanup best-effort
                 pass
         self._journals = []
+        # ops endpoint: drop this Session's acquisition — the LAST
+        # release stops the server (clean shutdown, no dangling port)
+        if self._ops is not None:
+            from auron_tpu.obs import ops_server as _ops
+            _ops.release()
+            self._ops = None
 
     def __enter__(self) -> "Session":
         return self
@@ -365,9 +417,20 @@ class Session:
                 jr = self._journal_begin(df, token)
                 try:
                     op = self.plan_physical(df)
+                    # with bundles armed, mirror task metrics onto a
+                    # positional tree as the query runs: a failure
+                    # bundle then carries the explain-with-metrics of
+                    # every task that DID finish (obs/bundle.py)
+                    mtree = None
+                    from auron_tpu.obs import bundle as _bundle
+                    if _bundle.armed(self.config):
+                        from auron_tpu.obs import metric_tree as mt
+                        mtree = mt.build_tree(op)
+                        token.plan_tree = mtree
                     table = _collect(op, num_partitions=df.num_partitions,
                                      mem_manager=self.mem_manager,
                                      config=self.config,
+                                     metric_tree=mtree,
                                      cancel_token=token)
                 except BaseException:
                     if jr is not None:

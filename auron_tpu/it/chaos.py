@@ -54,6 +54,10 @@ class ChaosOutcome:
     #: the fault-injection events linked to the recovery spans they
     #: triggered (tools/chaos_report prints the aggregate table)
     correlation: dict = field(default_factory=dict)
+    #: post-mortem bundle directories THIS run created (auron.bundle.*
+    #: armed); the bundle audit's findings land in ``leaks`` so a
+    #: missing/extra/fault-less bundle fails the run like a leaked file
+    bundles: list = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -579,9 +583,17 @@ def run_chaos(scenario: Scenario, fault_plan: str, seed: int,
     (obs/trace) and attaches the site→recovery-span correlation, so a
     chaos report links every injected fault to the recovery it
     triggered."""
+    from auron_tpu.obs import bundle as _bundle
     from auron_tpu.obs import trace
     baseline = scenario.baseline()
     conf = cfg.get_config()
+    # post-mortem correlation (auron.bundle.enabled armed by the
+    # caller): snapshot the bundle inventory so this run's new bundles
+    # — and ONLY this run's — are audited against its injections
+    bundle_root = (_bundle.bundle_dir(conf)
+                   if conf.get(cfg.BUNDLE_ENABLED) else None)
+    bundles_before = (set(_bundle.list_bundles(bundle_root))
+                      if bundle_root else set())
     conf.set(cfg.FAULTS_PLAN, fault_plan)
     conf.set(cfg.FAULTS_SEED, seed)
     _missing = object()
@@ -620,10 +632,13 @@ def run_chaos(scenario: Scenario, fault_plan: str, seed: int,
             injected = faults.snapshot()
         status = "identical" if out.equals(baseline) else "mismatch"
         err_t = err = None
+        bundle_tag = None
     except errors.AuronError as e:
         status, err_t, err = "classified", type(e).__name__, str(e)
+        bundle_tag = _bundle.classify(e)
     except Exception as e:   # noqa: BLE001 — the contract's failure bucket
         status, err_t, err = "unclassified", type(e).__name__, str(e)
+        bundle_tag = None
     finally:
         if with_trace:
             correlation = correlate_spans(
@@ -647,10 +662,69 @@ def run_chaos(scenario: Scenario, fault_plan: str, seed: int,
         # counted it for the report)
         from auron_tpu.parallel import mesh as _mesh
         _mesh.clear_quarantine()
+    new_bundles = ([p for p in _bundle.list_bundles(bundle_root)
+                    if p not in bundles_before] if bundle_root else [])
+    bundle_leaks = (_audit_bundles(bundle_root, new_bundles, bundle_tag,
+                                   err_t, injected, seed, conf)
+                    if bundle_root else [])
     return ChaosOutcome(scenario.name, fault_plan, seed, status,
                         error_type=err_t, error=err, injected=injected,
-                        leaks=scenario.leaks(), trace_id=trace_id,
-                        correlation=correlation)
+                        leaks=scenario.leaks() + bundle_leaks,
+                        trace_id=trace_id,
+                        correlation=correlation, bundles=new_bundles)
+
+
+def _audit_bundles(root: str, new_bundles: list, bundle_tag,
+                   err_t, injected: dict, seed: int, conf) -> list[str]:
+    """Bundle half of the chaos leak audit (ISSUE 14): a run whose
+    terminal error is bundle-eligible must have produced EXACTLY ONE
+    bundle for it, that bundle's flight dump must contain the injected
+    fault's ``fault.injected`` event (site + seed match — the
+    post-mortem provably shows the cause), and the retention cap
+    (auron.bundle.max_bundles, oldest-first) must hold so bundles can
+    never become the leak they exist to explain. Findings are leak
+    strings — they fail the run through ``ChaosOutcome.ok``."""
+    from auron_tpu.obs import bundle as _bundle
+    from auron_tpu.obs import flight_recorder as _flight
+    probs: list[str] = []
+    if bundle_tag is not None:
+        matching = []
+        for p in new_bundles:
+            try:
+                mf = _bundle.read_manifest(p)
+            except Exception as e:   # noqa: BLE001 — audit verdict
+                probs.append(f"bundle-unreadable:{p}:{e}")
+                continue
+            if mf.get("error_type") == err_t:
+                matching.append(p)
+        if len(matching) != 1:
+            probs.append(
+                f"bundle-count:{len(matching)} for {err_t} "
+                f"(expected exactly 1; new={new_bundles})")
+        for p in matching:
+            if not injected:
+                continue   # classified by knobs, not by an injection
+            try:
+                events = _flight.read_jsonl(
+                    os.path.join(p, "flight.jsonl"))
+            except Exception as e:   # noqa: BLE001 — audit verdict
+                probs.append(f"bundle-flight-unreadable:{p}:{e}")
+                continue
+            hit = any(
+                ev.get("name") == "fault.injected"
+                and ev.get("attrs", {}).get("site") in injected
+                and ev.get("attrs", {}).get("seed") == seed
+                for ev in events)
+            if not hit:
+                probs.append(
+                    f"bundle-flight-missing-fault:{p} "
+                    f"(sites={sorted(injected)}, seed={seed})")
+    keep = int(conf.get(cfg.BUNDLE_MAX_BUNDLES))
+    total = len(_bundle.list_bundles(root))
+    if keep > 0 and total > keep:
+        probs.append(f"bundle-retention:{total} bundles > "
+                     f"max_bundles={keep}")
+    return probs
 
 
 # ---------------------------------------------------------------------------

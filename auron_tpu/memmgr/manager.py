@@ -49,6 +49,25 @@ def live_consumer_count() -> int:
     return total
 
 
+def aggregate_status() -> list[dict]:
+    """Status snapshots of every live manager in the process — the ops
+    plane's ``/healthz`` memmgr section and the bundle's memmgr.json
+    (a scrape has no Session handle, so the weak registry is the
+    discovery surface). Empty-ledger managers (no consumers, no spill
+    history) are skipped: long-lived processes accumulate idle managers
+    from finished tests/sessions and the operator surface should show
+    pressure, not archaeology."""
+    out = []
+    for m in list(_MANAGERS):
+        try:
+            st = m.status()
+        except Exception:   # pragma: no cover - status best-effort
+            continue
+        if st["num_consumers"] or st["num_spills"] or st["used"]:
+            out.append(st)
+    return out
+
+
 class MemConsumer:
     """Spillable participant. Operators subclass / duck-type this."""
 
@@ -214,6 +233,11 @@ class MemManager:
         """Bytes accounted to ``qid``'s registered consumers."""
         with self._lock:
             return self._query_used_locked(qid)
+
+    def query_quota(self) -> int:
+        """Public face of the effective per-query quota (0 = none) —
+        the ops plane's /queries table prints usage against it."""
+        return self._query_quota()
 
     def _query_used_locked(self, qid: str) -> int:
         return self._usage_by_query_locked().get(qid, 0)

@@ -1,0 +1,243 @@
+"""Always-on flight recorder: the last N structured events, every
+thread, every category — armed even when tracing is off.
+
+The trace plane (obs/trace.py) is opt-in and export-oriented: spans are
+recorded only while ``auron.trace.enabled`` is on, and a query's
+timeline leaves the process as a per-query file. Production failures do
+not wait for tracing to be enabled — when a query is shed, stalled, or
+crash-resumed, the seconds BEFORE the failure are exactly the data
+nobody recorded. This module is the black box that closes that gap:
+
+- **Tee at emit time.** The trace plane's emit functions
+  (``trace.event`` / ``trace.complete_span`` / span exit) call
+  :func:`tee` before their own enabled check, so every structured event
+  the runtime ever emits — fault injections, retries, admission
+  decisions, pressure rungs, demotions, stall verdicts — lands in the
+  ring regardless of the tracing knobs. With tracing off the ring holds
+  the control-plane events (spans are never timed on the disabled
+  path); with tracing on it additionally holds the completed spans.
+
+- **Bounded per-thread rings.** Each thread appends to its own
+  ``collections.deque(maxlen=auron.flight.ring_events)`` — lock-free
+  recording (the tracer's buffer pattern), O(1) memory, oldest events
+  evicted first. The merged, time-ordered snapshot happens only at dump
+  time (``/flight``, a post-mortem bundle).
+
+- **Query attribution.** Every record carries the lifecycle plane's
+  current query id, so a bundle can present the failing query's
+  timeline with its neighbors' events interleaved — which is what a
+  shed/stall post-mortem actually needs (the neighbor that caused the
+  pressure is on the same timeline).
+
+Overhead contract: the disarmed path costs one cached config-epoch
+compare (the fault-plane pattern); the armed path is one thread-local
+read plus a deque append, measured <2% by the bench three-arm A/B's
+``norec`` arm (PERF.md "Ops plane"). ``auron.flight.{enabled,
+ring_events}`` are deliberately NOT trace-semantic: flipping the
+recorder never retraces a kernel.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import weakref
+from collections import deque
+from typing import NamedTuple, Optional
+
+
+class _Settings(NamedTuple):
+    enabled: bool
+    ring: int
+
+
+#: (config epoch, settings) — the disarmed check must cost one int
+#: compare (same verdict-cache shape as obs/trace._CACHED)
+_CACHED: tuple[int, Optional[_Settings]] = (-1, None)
+
+
+def _settings() -> _Settings:
+    global _CACHED
+    from auron_tpu import config as cfg
+    epoch, st = _CACHED
+    if epoch == cfg.config_epoch() and st is not None:
+        return st
+    epoch = cfg.config_epoch()
+    conf = cfg.get_config()
+    st = _Settings(
+        enabled=conf.get(cfg.FLIGHT_ENABLED),
+        ring=max(int(conf.get(cfg.FLIGHT_RING_EVENTS)), 16),
+    )
+    _CACHED = (epoch, st)
+    return st
+
+
+def armed() -> bool:
+    return _settings().enabled
+
+
+class FlightRecorder:
+    """Process flight recorder: per-thread bounded rings, merged on
+    demand. Records are tuples ``(ts_ns, cat, name, query_id, dur_ns,
+    tid, attrs)`` — the span vocabulary, flattened.
+
+    Rings are held as ``(weakref-to-owning-thread, deque)`` pairs: a
+    thread-per-connection serving process mints one ring per handler
+    thread, so dead threads' rings are PRUNED when a new ring registers
+    — their events fold into one shared bounded ``graveyard`` ring
+    (a task thread that died moments before a failure holds exactly
+    the evidence a post-mortem needs, so pruning preserves the recent
+    tail instead of dropping it), and recorder memory stays bounded by
+    the LIVE thread count plus one ring."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rings: list[tuple] = []   # (thread weakref, deque)
+        #: merged tail of dead threads' rings (bounded like any ring)
+        self._graveyard: deque = deque(maxlen=4096)
+        self._tls = threading.local()
+        #: wall-clock epoch of the monotonic ts origin (dump metadata —
+        #: lets a post-mortem reader print absolute timestamps)
+        self.epoch_wall = time.time()
+        self._t0 = time.perf_counter_ns()
+
+    # -- recording (per-thread, lock-free) ----------------------------------
+
+    def _prune_locked(self, maxlen: int) -> None:
+        """Fold dead threads' rings into the graveyard (caller holds
+        the lock). Runs only when a NEW ring registers, so the cost is
+        bounded by thread creation, not by recording."""
+        if self._graveyard.maxlen != maxlen:
+            self._graveyard = deque(self._graveyard, maxlen=maxlen)
+        alive = []
+        for tref, ring in self._rings:
+            t = tref()
+            if t is not None and t.is_alive():
+                alive.append((tref, ring))
+            else:
+                self._graveyard.extend(ring)
+        self._rings = alive
+
+    def _ring(self, maxlen: int) -> deque:
+        ring = getattr(self._tls, "ring", None)
+        if ring is None or ring.maxlen != maxlen:
+            fresh: deque = deque(maxlen=maxlen)
+            me = weakref.ref(threading.current_thread())
+            with self._lock:
+                if ring is not None:
+                    # ring_events changed mid-flight: replace this
+                    # thread's ring (keeping what fits) so the old one
+                    # is neither leaked nor double-dumped
+                    self._rings = [(r, d) for r, d in self._rings
+                                   if d is not ring]
+                    fresh.extend(list(ring)[-maxlen:])
+                self._prune_locked(maxlen)
+                self._rings.append((me, fresh))
+            self._tls.ring = fresh
+            ring = fresh
+        return ring
+
+    def now_ns(self) -> int:
+        return time.perf_counter_ns() - self._t0
+
+    def record(self, cat: str, name: str, attrs: dict, query_id: str,
+               dur_ns: int = 0, ts_ns: Optional[int] = None) -> None:
+        self._ring(_settings().ring).append(
+            (ts_ns if ts_ns is not None else self.now_ns(), cat, name,
+             query_id, dur_ns, threading.get_ident(), attrs))
+
+    # -- merge / dump --------------------------------------------------------
+
+    def snapshot(self, query_id: Optional[str] = None,
+                 last: Optional[int] = None) -> list[dict]:
+        """Merged, time-ordered view of every thread's ring. ``query_id``
+        keeps only that query's records; ``last`` keeps the newest N
+        after merging. Rings are appended lock-free by their owning
+        threads, so the copy retries around a concurrent mutation."""
+        with self._lock:
+            rings = [d for _t, d in self._rings] + [self._graveyard]
+        raw: list[tuple] = []
+        for ring in rings:
+            for _ in range(8):
+                try:
+                    raw.extend(list(ring))
+                    break
+                except RuntimeError:   # mutated during iteration: retry
+                    continue
+        if query_id is not None:
+            raw = [r for r in raw if r[3] == query_id]
+        raw.sort(key=lambda r: r[0])
+        if last is not None and last > 0:
+            raw = raw[-last:]
+        wall0 = self.epoch_wall
+        return [{"ts_us": r[0] / 1000.0,
+                 "wall": round(wall0 + r[0] * 1e-9, 6),
+                 "cat": r[1], "name": r[2], "query": r[3],
+                 "dur_us": r[4] / 1000.0, "tid": r[5],
+                 "attrs": r[6]} for r in raw]
+
+    def dump_jsonl(self, query_id: Optional[str] = None,
+                   last: Optional[int] = None) -> str:
+        """The ring as JSONL text (one event per line, timeline order)
+        — the ``/flight`` endpoint's and the bundle's wire format."""
+        out = []
+        for rec in self.snapshot(query_id=query_id, last=last):
+            try:
+                out.append(json.dumps(rec, default=str))
+            except (TypeError, ValueError):   # pragma: no cover
+                out.append(json.dumps({**rec, "attrs": str(rec["attrs"])}))
+        return "\n".join(out) + ("\n" if out else "")
+
+    def reset(self) -> None:
+        """Drop every buffered event (tests, chaos-run isolation)."""
+        with self._lock:
+            for _t, ring in self._rings:
+                ring.clear()
+            self._graveyard.clear()
+
+
+_RECORDER = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def reset() -> None:
+    _RECORDER.reset()
+
+
+#: memoized lifecycle accessor — tee runs on hot emit paths (per
+#: program-cache hit), so the import lookup happens once per process
+_CURRENT_QID = None
+
+
+def tee(cat: str, name: str, attrs: dict, dur_ns: int = 0,
+        ts_ns: Optional[int] = None) -> None:
+    """The trace plane's emit-time tee (called BEFORE the tracing
+    enabled check): record one structured event with the current
+    query's id attached. Disarmed cost: one cached epoch compare."""
+    if not _settings().enabled:
+        return
+    global _CURRENT_QID
+    if _CURRENT_QID is None:
+        try:
+            from auron_tpu.runtime.lifecycle import current_query_id
+            _CURRENT_QID = current_query_id
+        except Exception:   # pragma: no cover - import cycle guard
+            _CURRENT_QID = lambda: ""   # noqa: E731
+    _RECORDER.record(cat, name, attrs, _CURRENT_QID(), dur_ns=dur_ns,
+                     ts_ns=ts_ns)
+
+
+def read_jsonl(path: str) -> list[dict]:
+    """Load a flight dump back into records (tools/ops_report.py, the
+    chaos bundle audit)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

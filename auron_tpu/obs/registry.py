@@ -25,6 +25,7 @@ program-cache key, runtime/programs.py).
 
 from __future__ import annotations
 
+import re
 import threading
 from typing import Optional
 
@@ -37,10 +38,18 @@ def _label_key(labels: dict) -> tuple:
     return tuple(sorted(labels.items()))
 
 
+def escape_label(v) -> str:
+    """Prometheus text-format label-value escaping (exposition format
+    spec): backslash, double-quote and newline — in THAT order, or the
+    escapes themselves get re-escaped."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: tuple) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{escape_label(v)}"' for k, v in labels)
     return "{" + inner + "}"
 
 
@@ -195,19 +204,30 @@ class MetricsRegistry:
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition: registered instruments plus live
-        totals collected from the runtime singletons."""
+        totals collected from the runtime singletons. Conformance
+        contract (pinned by tests/test_metrics_registry.py): exactly one
+        ``# HELP`` and one ``# TYPE`` line per metric family, emitted
+        before the family's first sample; label values escaped; a
+        histogram's ``+Inf`` bucket equals its ``_count``."""
         with self._lock:
             items = sorted(self._instruments.items(),
                            key=lambda kv: kv[0])
             types = dict(self._types)
         lines = []
-        seen_type = set()
+        seen = set()
         for (name, _labels), inst in items:
-            if name not in seen_type:
+            if name not in seen:
+                lines.append(f"# HELP {name} {_help_text(name)}")
                 lines.append(f"# TYPE {name} {types[name]}")
-                seen_type.add(name)
+                seen.add(name)
             lines.extend(inst.expose())
-        lines.extend(_collect_runtime())
+        for name, typ, samples in _collect_runtime():
+            if name in seen:   # registered instruments own the family
+                continue
+            seen.add(name)
+            lines.append(f"# HELP {name} {_help_text(name)}")
+            lines.append(f"# TYPE {name} {typ}")
+            lines.extend(samples)
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
@@ -216,59 +236,107 @@ class MetricsRegistry:
             self._types.clear()
 
 
-def _collect_runtime() -> list[str]:
+#: HELP text per metric family — the exposition's one-HELP-per-family
+#: conformance line; unknown families fall back to a generic string so
+#: a new metric can never break a scrape by missing an entry here.
+_HELP = {
+    "auron_info": "Build/config identity (trace_salt label).",
+    "auron_program_builds_total": "Program-cache builds per compile site.",
+    "auron_program_hits_total": "Program-cache hits per compile site.",
+    "auron_program_live": "Live compiled programs per compile site.",
+    "auron_backend_compiles_total": "Raw XLA backend compiles.",
+    "auron_backend_compile_seconds_total": "Seconds spent in XLA compiles.",
+    "auron_faults_injected_total": "Chaos-plane fault injections.",
+    "auron_watchdog_fallbacks_total": "Watchdog CPU fallbacks taken.",
+    "auron_watchdog_stalls_total": "Task stalls flagged by the watchdog.",
+    "auron_trace_dropped_spans": "Spans dropped past auron.trace.max_spans.",
+    "auron_sched_running": "Queries running, per scheduler.",
+    "auron_sched_queued": "Queries queued, per scheduler.",
+    "auron_tasks_total": "Finished tasks observed by the registry.",
+    "auron_task_seconds": "Per-task wall seconds.",
+    "auron_task_retries_total": "Transient task retries.",
+    "auron_corruption_recomputes_total":
+        "Map recomputes after checksum mismatches.",
+    "auron_spill_runs_total": "Spill runs written.",
+    "auron_spill_bytes_total": "Bytes spilled.",
+    "auron_output_rows_total": "Rows produced by finished tasks.",
+    "auron_query_duration_seconds":
+        "End-to-end per-query latency by outcome "
+        "(ok|shed|cancelled|failed) — the SLO-burn source.",
+    "auron_bundles_written_total": "Post-mortem bundles written.",
+    "auron_flight_events": "Events currently buffered by the recorder.",
+    "auron_ops_scrapes_total": "Ops-endpoint requests served, per path.",
+}
+
+
+def _help_text(name: str) -> str:
+    return _HELP.get(name, "auron runtime metric.")
+
+
+def _collect_runtime() -> list[tuple]:
     """Live totals from the runtime singletons — collected at scrape
     time so subsystems need no push wiring. Best-effort: a missing
-    module never fails the exposition."""
-    lines = []
+    module never fails the exposition. Returns ``(family name, type,
+    [sample lines])`` so the renderer can keep the one-HELP/TYPE-per-
+    family conformance contract."""
+    fams: list[tuple] = []
+
+    def lab(**labels) -> str:
+        return _fmt_labels(_label_key(labels))
+
     try:
         from auron_tpu import config as cfg
         salt = ",".join(str(v) for v in cfg.trace_salt())
-        lines.append("# TYPE auron_info gauge")
-        lines.append(f'auron_info{{trace_salt="{salt}"}} 1')
+        fams.append(("auron_info", "gauge",
+                     [f"auron_info{lab(trace_salt=salt)} 1"]))
     except Exception:
         pass
     try:
         from auron_tpu.runtime import programs
-        lines.append("# TYPE auron_program_builds_total counter")
-        lines.append("# TYPE auron_program_hits_total counter")
-        lines.append("# TYPE auron_program_live gauge")
+        builds, hits, live = [], [], []
         for site, st in sorted(programs.snapshot().items()):
-            lab = f'{{site="{site}"}}'
-            lines.append(f"auron_program_builds_total{lab} {st['builds']}")
-            lines.append(f"auron_program_hits_total{lab} {st['hits']}")
-            lines.append(f"auron_program_live{lab} {st['live']}")
+            builds.append(f"auron_program_builds_total{lab(site=site)} "
+                          f"{st['builds']}")
+            hits.append(f"auron_program_hits_total{lab(site=site)} "
+                        f"{st['hits']}")
+            live.append(f"auron_program_live{lab(site=site)} "
+                        f"{st['live']}")
+        fams.append(("auron_program_builds_total", "counter", builds))
+        fams.append(("auron_program_hits_total", "counter", hits))
+        fams.append(("auron_program_live", "gauge", live))
     except Exception:
         pass
     try:
         from auron_tpu.utils import compile_stats
         snap = compile_stats.snapshot()
-        lines.append("# TYPE auron_backend_compiles_total counter")
-        lines.append(f"auron_backend_compiles_total {snap.count}")
-        lines.append("# TYPE auron_backend_compile_seconds_total counter")
-        lines.append(f"auron_backend_compile_seconds_total "
-                     f"{snap.seconds:g}")
+        fams.append(("auron_backend_compiles_total", "counter",
+                     [f"auron_backend_compiles_total {snap.count}"]))
+        fams.append(("auron_backend_compile_seconds_total", "counter",
+                     [f"auron_backend_compile_seconds_total "
+                      f"{snap.seconds:g}"]))
     except Exception:
         pass
     try:
         from auron_tpu.runtime import faults
-        lines.append("# TYPE auron_faults_injected_total counter")
-        lines.append(f"auron_faults_injected_total {faults.totals()}")
+        fams.append(("auron_faults_injected_total", "counter",
+                     [f"auron_faults_injected_total {faults.totals()}"]))
     except Exception:
         pass
     try:
         from auron_tpu.runtime import watchdog
-        lines.append("# TYPE auron_watchdog_fallbacks_total counter")
-        lines.append(f"auron_watchdog_fallbacks_total {watchdog.totals()}")
-        lines.append("# TYPE auron_watchdog_stalls_total counter")
-        lines.append(f"auron_watchdog_stalls_total "
-                     f"{watchdog.stall_totals()}")
+        fams.append(("auron_watchdog_fallbacks_total", "counter",
+                     [f"auron_watchdog_fallbacks_total "
+                      f"{watchdog.totals()}"]))
+        fams.append(("auron_watchdog_stalls_total", "counter",
+                     [f"auron_watchdog_stalls_total "
+                      f"{watchdog.stall_totals()}"]))
     except Exception:
         pass
     try:
         from auron_tpu.obs import trace
-        lines.append("# TYPE auron_trace_dropped_spans counter")
-        lines.append(f"auron_trace_dropped_spans {trace.tracer().dropped}")
+        fams.append(("auron_trace_dropped_spans", "counter",
+                     [f"auron_trace_dropped_spans "
+                      f"{trace.tracer().dropped}"]))
     except Exception:
         pass
     try:
@@ -279,15 +347,17 @@ def _collect_runtime() -> list[str]:
         from auron_tpu.runtime import scheduler
         states = scheduler.aggregate_states()
         if states:
-            lines.append("# TYPE auron_sched_running gauge")
-            lines.append("# TYPE auron_sched_queued gauge")
+            running, queued = [], []
             for name, st in sorted(states.items()):
-                lab = f'{{scheduler="{name}"}}'
-                lines.append(f"auron_sched_running{lab} {st['running']}")
-                lines.append(f"auron_sched_queued{lab} {st['queued']}")
+                running.append(f"auron_sched_running"
+                               f"{lab(scheduler=name)} {st['running']}")
+                queued.append(f"auron_sched_queued"
+                              f"{lab(scheduler=name)} {st['queued']}")
+            fams.append(("auron_sched_running", "gauge", running))
+            fams.append(("auron_sched_queued", "gauge", queued))
     except Exception:
         pass
-    return lines
+    return fams
 
 
 _REGISTRY = MetricsRegistry()
@@ -366,3 +436,224 @@ def observe_task(wall_s: float, snap: dict, output_rows: int = 0) -> None:
     r.counter("auron_spill_runs_total").inc(spill_count)
     r.counter("auron_spill_bytes_total").inc(spill_bytes)
     r.counter("auron_output_rows_total").inc(output_rows)
+
+
+# ---------------------------------------------------------------------------
+# per-query SLO surface (the ops plane's /metrics acceptance metric)
+# ---------------------------------------------------------------------------
+
+def classify_outcome(exc) -> str:
+    """Map a query's terminal exception onto the
+    ``auron_query_duration_seconds`` outcome vocabulary:
+
+    - ``ok`` — no exception;
+    - ``shed`` — the runtime refused/evicted the query to protect the
+      process (MemoryExhausted, AdmissionRejected);
+    - ``cancelled`` — the caller's verdict (QueryCancelled, including
+      DeadlineExceeded: the budget was the caller's) or a serving
+      task-kill (TaskCancelled);
+    - ``failed`` — everything else.
+    """
+    if exc is None:
+        return "ok"
+    from auron_tpu import errors
+    if isinstance(exc, (errors.MemoryExhausted, errors.AdmissionRejected)):
+        return "shed"
+    if isinstance(exc, errors.QueryCancelled):
+        return "cancelled"
+    if type(exc).__name__ in ("TaskCancelled", "_Cancelled"):
+        return "cancelled"
+    return "failed"
+
+
+def observe_query(duration_s: float, outcome: str) -> None:
+    """One top-level query's end-to-end latency observation, labelled by
+    outcome — fed by Session's admission scope and the serving handler,
+    so SLO burn is computable from ``/metrics`` alone (gated by
+    auron.metrics.registry)."""
+    if not enabled():
+        return
+    _REGISTRY.histogram("auron_query_duration_seconds",
+                        outcome=outcome).observe(duration_s)
+
+
+# ---------------------------------------------------------------------------
+# strict text-format parser (conformance audit + ops-plane gates)
+# ---------------------------------------------------------------------------
+
+_NAME_RE = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME_RE})(\{{.*\}})? "
+    r"(-?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|Inf|NaN)|\+Inf)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_VALID_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def _unescape_label(v: str) -> str:
+    """Single left-to-right scan: sequential str.replace would corrupt
+    values where an escaped backslash precedes an 'n' (``\\\\n`` must
+    read as backslash+n, not newline)."""
+    out = []
+    i = 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            n = v[i + 1]
+            if n == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if n in ('"', "\\"):
+                out.append(n)
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _parse_labels(body: str) -> dict:
+    """Strict ``{k="v",...}`` parse: every byte must be consumed by
+    well-formed pairs (a malformed pair silently dropped is exactly the
+    torn-table bug the audit exists to catch)."""
+    inner = body[1:-1].rstrip(",")
+    if not inner:
+        return {}
+    out = {}
+    pos = 0
+    while pos < len(inner):
+        m = _LABEL_RE.match(inner, pos)
+        if m is None:
+            raise ValueError(f"malformed label pair at {inner[pos:]!r}")
+        out[m.group(1)] = _unescape_label(m.group(2))
+        pos = m.end()
+        if pos < len(inner):
+            if inner[pos] != ",":
+                raise ValueError(f"expected ',' at {inner[pos:]!r}")
+            pos += 1
+    return out
+
+
+def parse_prometheus(text: str) -> dict:
+    """STRICT Prometheus text-format parser — the conformance oracle the
+    regression tests and the perf-gate ops arm scrape through. Raises
+    ``ValueError`` on any violation of the contract render_prometheus
+    promises:
+
+    - every non-comment line is a well-formed sample (name, optional
+      escaped label set, float value);
+    - exactly one ``# HELP`` and one ``# TYPE`` per family, before the
+      family's first sample;
+    - every sample belongs to a declared family (histogram samples via
+      their ``_bucket``/``_sum``/``_count`` suffixes);
+    - per histogram series: the ``+Inf`` bucket exists, equals
+      ``_count``, and bucket counts are monotone in ``le``.
+
+    Returns ``{family: {"type", "help", "samples": [(name, labels,
+    value)]}}``.
+    """
+    fams: dict[str, dict] = {}
+
+    def family_of(name: str) -> Optional[str]:
+        if name in fams:
+            return name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = name[: -len(suffix)]
+                if base in fams and fams[base]["type"] == "histogram":
+                    return base
+        return None
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(" ", 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                raise ValueError(f"line {lineno}: malformed comment "
+                                 f"{line!r}")
+            kind, name = parts[1], parts[2]
+            if not re.fullmatch(_NAME_RE, name):
+                raise ValueError(f"line {lineno}: bad metric name "
+                                 f"{name!r}")
+            ent = fams.setdefault(
+                name, {"type": None, "help": None, "samples": []})
+            if kind == "HELP":
+                if ent["help"] is not None:
+                    raise ValueError(
+                        f"line {lineno}: duplicate HELP for {name}")
+                if ent["samples"]:
+                    raise ValueError(
+                        f"line {lineno}: HELP for {name} after samples")
+                ent["help"] = parts[3] if len(parts) > 3 else ""
+            else:
+                if ent["type"] is not None:
+                    raise ValueError(
+                        f"line {lineno}: duplicate TYPE for {name}")
+                if ent["samples"]:
+                    raise ValueError(
+                        f"line {lineno}: TYPE for {name} after samples")
+                typ = parts[3].strip() if len(parts) > 3 else ""
+                if typ not in _VALID_TYPES:
+                    raise ValueError(
+                        f"line {lineno}: invalid type {typ!r} for {name}")
+                ent["type"] = typ
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: malformed sample {line!r}")
+        name, labels_body, raw = m.group(1), m.group(2), m.group(3)
+        fam = family_of(name)
+        if fam is None:
+            raise ValueError(
+                f"line {lineno}: sample {name!r} has no declared family")
+        if fams[fam]["type"] is None:
+            raise ValueError(
+                f"line {lineno}: sample {name!r} before its TYPE")
+        labels = _parse_labels(labels_body) if labels_body else {}
+        value = float(raw.replace("+Inf", "inf").replace("Inf", "inf")
+                      .replace("NaN", "nan"))
+        fams[fam]["samples"].append((name, labels, value))
+    for name, ent in fams.items():
+        if ent["type"] is None:
+            raise ValueError(f"family {name}: HELP without TYPE")
+        if ent["help"] is None:
+            raise ValueError(f"family {name}: TYPE without HELP")
+        if ent["type"] == "histogram":
+            _check_histogram(name, ent["samples"])
+    return fams
+
+
+def _check_histogram(fam: str, samples: list) -> None:
+    """Per-series histogram invariants: +Inf bucket present and equal to
+    _count; cumulative bucket counts monotone in le."""
+    series: dict[tuple, dict] = {}
+    for name, labels, value in samples:
+        key = _label_key({k: v for k, v in labels.items() if k != "le"})
+        ent = series.setdefault(key, {"buckets": [], "count": None})
+        if name == fam + "_bucket":
+            if "le" not in labels:
+                raise ValueError(f"{fam}: bucket sample without le")
+            ent["buckets"].append((float(labels["le"]
+                                         .replace("+Inf", "inf")), value))
+        elif name == fam + "_count":
+            ent["count"] = value
+    for key, ent in series.items():
+        if ent["count"] is None and not ent["buckets"]:
+            continue
+        buckets = sorted(ent["buckets"])
+        if not buckets or buckets[-1][0] != float("inf"):
+            raise ValueError(f"{fam}{dict(key)}: no +Inf bucket")
+        if ent["count"] is None:
+            raise ValueError(f"{fam}{dict(key)}: buckets without _count")
+        if buckets[-1][1] != ent["count"]:
+            raise ValueError(
+                f"{fam}{dict(key)}: +Inf bucket {buckets[-1][1]} != "
+                f"_count {ent['count']}")
+        prev = 0.0
+        for le, v in buckets:
+            if v < prev:
+                raise ValueError(
+                    f"{fam}{dict(key)}: bucket le={le} count {v} < "
+                    f"previous {prev} (not cumulative)")
+            prev = v

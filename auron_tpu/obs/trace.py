@@ -42,6 +42,8 @@ import threading
 import time
 from typing import NamedTuple, Optional
 
+from auron_tpu.obs import flight_recorder as _flight
+
 #: span categories (the auron.trace.events allowlist vocabulary).
 #: The ``mesh`` category carries the SPMD plane's routing AND fault
 #: domain: ``exchange.route`` (per-exchange routing decision),
@@ -296,8 +298,12 @@ class _SpanCM:
         if exc_type is not None:
             self.attrs.setdefault("error", exc_type.__name__)
         t0 = self._t0
+        dur = tr.now_ns() - t0
+        # flight-recorder tee (obs/flight_recorder): completed spans
+        # join the always-on ring — attrs are final here (error set)
+        _flight.tee(self.cat, self.name, self.attrs, dur_ns=dur)
         tr.record(Span(tr.current_trace, self.span_id, self._parent,
-                       self.cat, self.name, t0, tr.now_ns() - t0,
+                       self.cat, self.name, t0, dur,
                        threading.get_ident(), self.attrs), self._max)
         return False
 
@@ -312,7 +318,13 @@ def span(cat: str, name: str, **attrs):
 
 
 def event(cat: str, name: str, **attrs) -> None:
-    """Record a zero-duration span at the current stack position."""
+    """Record a zero-duration span at the current stack position.
+
+    Tees into the always-on flight recorder BEFORE the enabled check:
+    structured events (fault injections, retries, sheds, admission
+    decisions) stay reconstructable even with tracing off — the
+    black-box contract (obs/flight_recorder.py)."""
+    _flight.tee(cat, name, attrs)
     st = _settings()
     if not st.enabled or (st.events is not None and cat not in st.events):
         return
@@ -334,6 +346,7 @@ def complete_span(cat: str, name: str, start_ns: int, dur_ns: int,
     recording once at exhaustion reports only the producer's own cost.
     Parent is the CURRENT stack top (the consumer driving the
     generator), never the span itself."""
+    _flight.tee(cat, name, attrs, dur_ns=dur_ns)
     st = _settings()
     if not st.enabled or (st.events is not None and cat not in st.events):
         return

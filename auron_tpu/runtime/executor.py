@@ -524,6 +524,17 @@ def collect(plan: PhysicalOp, num_partitions: int = 1,
     from auron_tpu import errors as _errors
     from auron_tpu.runtime import lifecycle as _lifecycle
     from auron_tpu.runtime import scheduler as _scheduler
+    # driver progress for the ops plane's /queries table: total stamped
+    # up front, done bumped per finished partition (CancelToken carries
+    # the counters; a bare Event / None costs nothing). Only the
+    # OUTERMOST collect on a token tracks — a nested execute (host-fn
+    # child, scalar subquery) rides the ENCLOSING token and must not
+    # clobber the parent's progress
+    track = (cancel_token is not None
+             and getattr(cancel_token, "tasks_total", None) == 0)
+    if track:
+        cancel_token.tasks_total = num_partitions
+        cancel_token.tasks_done = 0
     tables = []
     for p in range(num_partitions):
         # task-level fairness: a token admitted by the concurrent
@@ -545,4 +556,6 @@ def collect(plan: PhysicalOp, num_partitions: int = 1,
             plan, p, num_partitions, mem_manager=mem_manager,
             config=config, metric_tree=metric_tree,
             cancel_token=cancel_token))
+        if track:
+            cancel_token.tasks_done += 1
     return pa.concat_tables(tables)

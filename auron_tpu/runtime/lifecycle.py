@@ -45,12 +45,22 @@ class CancelToken:
     """
 
     __slots__ = ("query_id", "_event", "_lock", "_deadline", "reason",
-                 "cancelled_at_ns", "slot", "journal")
+                 "cancelled_at_ns", "slot", "journal", "tasks_total",
+                 "tasks_done", "plan_tree")
 
     def __init__(self, query_id: str = "", deadline_s: Optional[float] = None):
         self.query_id = query_id
         self._event = threading.Event()
         self._lock = threading.Lock()
+        #: driver progress surfaced on the ops plane's /queries table
+        #: (runtime/executor.collect stamps total and bumps done per
+        #: finished partition; 0/0 until the drive loop starts)
+        self.tasks_total = 0
+        self.tasks_done = 0
+        #: the query's positional metric tree (obs/metric_tree) when the
+        #: bundle plane armed one — a failure bundle renders it as the
+        #: explain-with-metrics snapshot (obs/bundle.py)
+        self.plan_tree = None
         #: the query's scheduler seat (runtime/scheduler.Slot) once
         #: admitted; nested executes ride the enclosing token, so the
         #: slot travels with it (executor.collect's fairness hook)

@@ -77,6 +77,16 @@ def aggregate_states() -> dict:
     return out
 
 
+def aggregate_query_table() -> list[dict]:
+    """Live query table across every scheduler in the process — the ops
+    plane's ``/queries`` body and the serving STATS frame's table."""
+    rows: list[dict] = []
+    for s in list(_SCHEDULERS):
+        rows.extend(s.query_table())
+    rows.sort(key=lambda r: (r["scheduler"], r["query"]))
+    return rows
+
+
 class Slot:
     """One admitted query's seat in the scheduler.
 
@@ -89,7 +99,7 @@ class Slot:
 
     __slots__ = ("scheduler", "token", "query_id", "weight", "tasks_run",
                  "vbase", "queue_wait_s", "overhead_ns", "granted",
-                 "released")
+                 "released", "granted_at")
 
     def __init__(self, scheduler: "QueryScheduler", token, weight: float):
         self.scheduler = scheduler
@@ -107,6 +117,9 @@ class Slot:
         self.overhead_ns = 0
         self.granted = False
         self.released = False
+        #: monotonic stamp of the grant — the /queries table's
+        #: wall-so-far origin (0.0 until seated)
+        self.granted_at = 0.0
 
     @property
     def vtime(self) -> float:
@@ -345,6 +358,7 @@ class QueryScheduler:
         slot.vbase = (min(s.vtime for s in self._running)
                       if self._running else 0.0)
         slot.granted = True
+        slot.granted_at = time.monotonic()
         self._running.append(slot)
 
     def _admit_wait_limit(self) -> float:
@@ -508,6 +522,51 @@ class QueryScheduler:
         except Exception:   # pragma: no cover - stats are best-effort
             pass
         return out
+
+    def query_table(self) -> list[dict]:
+        """The live query table (the ops plane's ``/queries`` rows):
+        one row per running/queued slot — query id, state, wall so far,
+        driver task progress (the token's collect-loop counters),
+        per-query memory usage vs quota (the attached manager's
+        ledger), and the query's program-cache builds/hits. Reads are
+        lock-bounded snapshots; a row is internally consistent but the
+        table is not a transaction across queries (scrape semantics)."""
+        now = time.monotonic()
+        with self._cond:
+            seats = ([("running", s) for s in self._running]
+                     + [("queued", s) for s in self._queued])
+            rows = []
+            for state, s in seats:
+                tok = s.token
+                wall = (now - s.granted_at if state == "running"
+                        # queue_wait_s holds the ENQUEUE stamp until
+                        # the slot is granted (acquire's contract)
+                        else now - s.queue_wait_s)
+                rows.append({
+                    "query": s.query_id,
+                    "scheduler": self.name,
+                    "state": state,
+                    "wall_s": round(max(wall, 0.0), 3),
+                    "tasks_run": s.tasks_run,
+                    "tasks_done": getattr(tok, "tasks_done", 0),
+                    "tasks_total": getattr(tok, "tasks_total", 0),
+                })
+        mm = self.mem_manager
+        for row in rows:
+            if mm is not None:
+                try:
+                    row["mem_used_bytes"] = mm.query_used(row["query"])
+                    row["mem_quota_bytes"] = mm.query_quota()
+                except Exception:   # pragma: no cover - duck-typed mm
+                    pass
+            try:
+                from auron_tpu.runtime import programs
+                snap = programs.query_totals(row["query"])
+                row["program_builds"] = snap.builds
+                row["program_hits"] = snap.hits
+            except Exception:   # pragma: no cover - stats best-effort
+                pass
+        return rows
 
     def running_count(self) -> int:
         with self._cond:

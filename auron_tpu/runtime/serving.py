@@ -104,6 +104,11 @@ KIND_TABLE = 10
 #: not (ResumeUnavailable reason=no_journal|corrupt|
 #: fingerprint_mismatch|journaling_disabled|ambiguous|missing_source).
 KIND_RESUME = 11
+#: first-frame STATS: answers one DONE frame with the ops plane's live
+#: query table + admission counters + server stats as JSON — the
+#: /queries endpoint over the EXISTING wire protocol, for clients
+#: behind firewalls that cannot reach the HTTP port (AuronClient.stats)
+KIND_STATS = 12
 
 #: max un-ACKed BATCH frames in flight (rt.rs uses a bound-1 channel; a
 #: small window amortizes the network round trip without losing the
@@ -198,6 +203,9 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             # a live id cancels and DONEs; an unknown/expired id gets
             # the STRUCTURED verdict, never a generic traceback
             self._cancel_by_id(payload)
+            return
+        if kind == KIND_STATS:
+            self._send_stats()
             return
         if kind not in (KIND_SUBMIT, KIND_SUBMIT_PLAN, KIND_RESUME):
             write_frame(self.request, KIND_ERROR,
@@ -330,6 +338,29 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                 else str(req)
         except (ValueError, UnicodeDecodeError):
             return payload.decode("utf-8", "replace").strip()
+
+    def _send_stats(self) -> None:
+        """First-frame STATS: one DONE frame carrying the live query
+        table (every scheduler in the process — the ops plane's
+        /queries body), this server's admission stats and wire
+        counters, and the ops endpoint's port when it is running — so
+        a client that can reach the serving socket needs no second
+        port to observe the process."""
+        from auron_tpu.obs import ops_server as _ops
+        from auron_tpu.runtime import scheduler as sched_mod
+        body = {
+            "queries": sched_mod.aggregate_query_table(),
+            "admission": self.server.scheduler.stats(),
+            "server": dict(self.server.stats),
+        }
+        ops = _ops.current()
+        if ops is not None:
+            body["ops_port"] = ops.port
+        try:
+            write_frame(self.request, KIND_DONE,
+                        json.dumps(body, default=str).encode())
+        except OSError:   # pragma: no cover - client went away
+            pass
 
     def _cancel_by_id(self, payload: bytes) -> None:
         """First-frame CANCEL with a query-id payload: cancel another
@@ -474,6 +505,38 @@ class _TaskHandler(socketserver.BaseRequestHandler):
 
     def _execute(self, task_bytes: bytes, planner_ctx, report,
                  journal=None, partitions=None) -> None:
+        """End-to-end observation wrapper around the execution body:
+        every exit — DONE, shed, cancel, deadline, failure — lands on
+        the ``auron_query_duration_seconds{outcome}`` histogram, and a
+        classified failure writes its post-mortem bundle from THIS
+        unwind (the serving half of the Session contract)."""
+        import time as _time
+
+        from auron_tpu.obs import bundle as _bundle
+        from auron_tpu.obs import registry as _obs_registry
+
+        def observe(exc) -> None:
+            try:
+                _obs_registry.observe_query(
+                    _time.monotonic() - t0,
+                    _obs_registry.classify_outcome(exc))
+            except Exception:   # pragma: no cover - telemetry only
+                pass
+
+        t0 = _time.monotonic()
+        try:
+            self._execute_inner(task_bytes, planner_ctx, report,
+                                journal=journal, partitions=partitions)
+        except BaseException as e:
+            _bundle.maybe_write(e, token=self._cancel,
+                                scheduler=self.server.scheduler)
+            observe(e)
+            raise
+        else:
+            observe(None)
+
+    def _execute_inner(self, task_bytes: bytes, planner_ctx, report,
+                       journal=None, partitions=None) -> None:
         # imported lazily so the server process controls jax platform
         # selection before anything initializes a backend
         from auron_tpu.columnar.arrow_bridge import (schema_to_arrow,
@@ -532,6 +595,11 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             # driver would have collected
             parts = (partitions if partitions is not None
                      else [task.partition_id])
+            # /queries task progress (the token is this handler's
+            # CancelToken — one query per connection, so no nested
+            # ownership question like the Session collect path)
+            self._cancel.tasks_total = len(parts)
+            self._cancel.tasks_done = 0
             snaps = []
             # the handler's cancel TOKEN is the task's cancellation
             # registry: operators polling between child batches unwind
@@ -550,6 +618,7 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                         if rb.num_rows:
                             self._send_batch(rb)
                     snaps.append(rt.finalize())
+                    self._cancel.tasks_done += 1
             except errors.DeadlineExceeded:
                 # a deadline is a CLIENT-VISIBLE verdict (ERROR frame
                 # with the classified type), unlike a cancel (silent
@@ -620,6 +689,14 @@ class AuronServer(socketserver.ThreadingTCPServer):
         # the rest shed with a structured AdmissionRejected ERROR frame
         from auron_tpu.runtime.scheduler import QueryScheduler
         self.scheduler = QueryScheduler(name="serving")
+        # ops plane (obs/ops_server.py): the serving process exposes
+        # the same live telemetry endpoint Sessions do — refcounted, so
+        # a Session in the same process shares it; the bound port rides
+        # the stats dict (and the STATS frame) for discovery
+        from auron_tpu.obs import ops_server as _ops_srv
+        self._ops = _ops_srv.ensure_started()
+        if self._ops is not None:
+            self.stats["ops_port"] = self._ops.port
 
     def register_query(self, token) -> None:
         with self._queries_lock:
@@ -651,6 +728,14 @@ class AuronServer(socketserver.ThreadingTCPServer):
         if quiescent:
             from auron_tpu.utils import compile_stats
             compile_stats.maybe_clear()
+
+    def server_close(self) -> None:
+        super().server_close()
+        # drop the ops-endpoint acquisition (last release stops it)
+        if getattr(self, "_ops", None) is not None:
+            from auron_tpu.obs import ops_server as _ops_srv
+            _ops_srv.release()
+            self._ops = None
 
     @property
     def address(self) -> tuple[str, int]:
@@ -754,6 +839,20 @@ class AuronClient:
             KIND_RESUME, json.dumps({"query_id": query_id}).encode(),
             None)
         return tbl, done.get("metrics", done)
+
+    def stats(self) -> dict:
+        """The server's live observability over the wire (STATS frame):
+        the /queries table + admission counters + server stats as one
+        dict — for clients behind firewalls that cannot reach the ops
+        HTTP port. The dict carries ``ops_port`` when the HTTP endpoint
+        is also running."""
+        with socket.create_connection(self.addr,
+                                      timeout=self.timeout_s) as s:
+            write_frame(s, KIND_STATS, b"")
+            kind, payload = read_frame(s)
+        if kind == KIND_ERROR:
+            raise RuntimeError("engine error:\n" + payload.decode())
+        return json.loads(payload.decode())
 
     def cancel_query(self, query_id: str) -> bool:
         """Cancel a live query BY ID over a fresh connection (the

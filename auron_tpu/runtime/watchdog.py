@@ -129,6 +129,17 @@ class ProbeReport:
         return head[:300]
 
 
+#: most recent ProbeReport this process produced (run_probe_ladder) —
+#: the ops plane's /healthz reports it without re-running the ladder
+#: (the ladder spawns a sacrificial child; a health scrape must be
+#: cheap and side-effect-free)
+_LAST_PROBE: Optional["ProbeReport"] = None
+
+
+def last_probe_report() -> Optional["ProbeReport"]:
+    return _LAST_PROBE
+
+
 def stats() -> dict:
     with _LOCK:
         return dict(_STATS)
@@ -476,7 +487,10 @@ def run_probe_ladder(deadline_s: float = 60.0) -> ProbeReport:
                 elapsed_s=_time.perf_counter() - t0))
     ok = all(s.ok for s in steps) and not timed_out \
         and returncode == 0 and "first_compile" in reported
-    return ProbeReport(ok=ok, platform=platform, steps=steps)
+    report = ProbeReport(ok=ok, platform=platform, steps=steps)
+    global _LAST_PROBE
+    _LAST_PROBE = report
+    return report
 
 
 def write_report(report: ProbeReport,

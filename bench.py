@@ -167,9 +167,14 @@ def bench_trace_overhead() -> dict:
       (base − noprof)/noprof — what the host/device attribution plane
       (obs/profile.py) costs with everything else unchanged (the PR 6
       <2% gate; the disabled path must be near-zero BY this same
-      measurement read the other way).
+      measurement read the other way);
+    - norec — tracing OFF, profiler ON, flight recorder OFF:
+      ``flight_overhead_pct`` is (base − norec)/norec — what the
+      always-on flight recorder (obs/flight_recorder.py) costs with
+      the recorder armed and trace export off, exactly the shipping
+      posture (the ops-plane <2% gate, PERF.md 'Ops plane').
 
-    Both observability contracts are measured, not assumed."""
+    All three observability contracts are measured, not assumed."""
     import tempfile
 
     from auron_tpu import config as cfg
@@ -210,10 +215,15 @@ def bench_trace_overhead() -> dict:
     # suite-min 4.3%, per-query-min 0.1% on this container, whose
     # single-rep deltas of ±10-50% dwarf the <2% gates).
     arms = {
-        "base": {cfg.TRACE_ENABLED: False, cfg.PROFILE_ENABLED: True},
-        "trace": {cfg.TRACE_ENABLED: True, cfg.PROFILE_ENABLED: True},
+        "base": {cfg.TRACE_ENABLED: False, cfg.PROFILE_ENABLED: True,
+                 cfg.FLIGHT_ENABLED: True},
+        "trace": {cfg.TRACE_ENABLED: True, cfg.PROFILE_ENABLED: True,
+                  cfg.FLIGHT_ENABLED: True},
         "noprof": {cfg.TRACE_ENABLED: False,
-                   cfg.PROFILE_ENABLED: False},
+                   cfg.PROFILE_ENABLED: False,
+                   cfg.FLIGHT_ENABLED: True},
+        "norec": {cfg.TRACE_ENABLED: False, cfg.PROFILE_ENABLED: True,
+                  cfg.FLIGHT_ENABLED: False},
     }
     mins = {arm: {q.name: float("inf") for q in subset} for arm in arms}
 
@@ -242,24 +252,32 @@ def bench_trace_overhead() -> dict:
     finally:
         conf.unset(cfg.TRACE_ENABLED)
         conf.unset(cfg.PROFILE_ENABLED)
+        conf.unset(cfg.FLIGHT_ENABLED)
         conf.unset(cfg.TRACE_DIR)
         conf.unset(cfg.TRACE_EVENTS)
         trace.reset()
+        from auron_tpu.obs import flight_recorder as _flight
+        _flight.reset()
         shutil.rmtree(data, ignore_errors=True)
     base_s = sum(mins["base"].values())
     on_s = sum(mins["trace"].values())
     noprof_s = sum(mins["noprof"].values())
+    norec_s = sum(mins["norec"].values())
     return {
         "trace_overhead_pct": round((on_s - base_s) / base_s * 100.0, 2),
         "trace_overhead_gate_pct": 2.0,
         "profile_overhead_pct": round(
             (base_s - noprof_s) / noprof_s * 100.0, 2),
         "profile_overhead_gate_pct": 2.0,
+        "flight_overhead_pct": round(
+            (base_s - norec_s) / norec_s * 100.0, 2),
+        "flight_overhead_gate_pct": 2.0,
         "trace_ab_queries": names,
         "trace_ab_scale": scale,
         "trace_ab_off_s": round(base_s, 3),
         "trace_ab_on_s": round(on_s, 3),
         "trace_ab_noprofile_s": round(noprof_s, 3),
+        "trace_ab_norecorder_s": round(norec_s, 3),
         "trace_ab_spans": traced_spans,
     }
 

@@ -1,0 +1,224 @@
+"""Prometheus exposition conformance audit (ISSUE 14 satellite).
+
+The ops plane's /metrics is only as good as its text format: a scraper
+that chokes on an unescaped label or a duplicate TYPE line silently
+drops the whole target. These tests pin the conformance contract with
+the STRICT parser (obs/registry.parse_prometheus) that the perf-gate
+ops arm and the concurrency scrape test also use — the parser itself is
+regression-tested here so the contract cannot rot from either side:
+
+- label values escaped (backslash / newline / double-quote);
+- exactly one ``# HELP`` and one ``# TYPE`` per metric family, before
+  the family's first sample;
+- histogram ``+Inf`` bucket == ``_count`` per series, buckets
+  cumulative;
+- the full process exposition (registered instruments + runtime-
+  collected families) parses strictly.
+"""
+
+import pytest
+
+from auron_tpu.obs import registry as reg
+
+
+@pytest.fixture()
+def fresh():
+    r = reg.MetricsRegistry()
+    yield r
+
+
+# ---------------------------------------------------------------------------
+# escaping
+# ---------------------------------------------------------------------------
+
+class TestLabelEscaping:
+    def test_escape_label(self):
+        assert reg.escape_label('a"b') == 'a\\"b'
+        assert reg.escape_label("a\\b") == "a\\\\b"
+        assert reg.escape_label("a\nb") == "a\\nb"
+        # order matters: the backslash introduced by the quote escape
+        # must not be re-escaped
+        assert reg.escape_label('\\"') == '\\\\\\"'
+
+    def test_round_trip_through_parser(self, fresh):
+        evil = 'we"ird\\name\nwith everything'
+        fresh.gauge("auron_test_escape", consumer=evil).set(3)
+        fams = reg.parse_prometheus(fresh.render_prometheus())
+        (name, labels, value), = [
+            s for s in fams["auron_test_escape"]["samples"]]
+        assert labels["consumer"] == evil
+        assert value == 3.0
+
+    def test_runtime_collected_labels_escaped(self):
+        # auron_info carries the trace salt — a str-valued config knob
+        # could in principle hold a quote; the exposition must stay
+        # parseable regardless (parse of the LIVE exposition covers
+        # every runtime-collected family's label formatting)
+        text = reg.get_registry().render_prometheus()
+        fams = reg.parse_prometheus(text)
+        assert "auron_info" in fams
+
+
+# ---------------------------------------------------------------------------
+# one HELP/TYPE per family
+# ---------------------------------------------------------------------------
+
+class TestFamilyMetadata:
+    def test_one_help_one_type_per_family(self, fresh):
+        fresh.counter("auron_test_total", reason="a").inc()
+        fresh.counter("auron_test_total", reason="b").inc(2)
+        fresh.histogram("auron_test_seconds").observe(0.1)
+        text = fresh.render_prometheus()
+        for fam in ("auron_test_total", "auron_test_seconds"):
+            assert text.count(f"# TYPE {fam} ") == 1
+            assert text.count(f"# HELP {fam} ") == 1
+        # metadata precedes the first sample (parser enforces; pin the
+        # raw layout too)
+        lines = text.splitlines()
+        type_at = lines.index("# TYPE auron_test_total counter")
+        first_sample = next(i for i, ln in enumerate(lines)
+                            if ln.startswith("auron_test_total{"))
+        assert type_at < first_sample
+
+    def test_full_process_exposition_parses_strictly(self):
+        r = reg.get_registry()
+        r.counter("auron_tasks_total").inc()
+        r.histogram("auron_query_duration_seconds",
+                    outcome="ok").observe(0.05)
+        fams = reg.parse_prometheus(r.render_prometheus())
+        # registered + runtime-collected families all declared
+        assert fams["auron_tasks_total"]["type"] == "counter"
+        assert fams["auron_query_duration_seconds"]["type"] == "histogram"
+        assert "auron_info" in fams
+        for name, ent in fams.items():
+            assert ent["help"] is not None, f"{name} missing HELP"
+            assert ent["type"] is not None, f"{name} missing TYPE"
+
+
+# ---------------------------------------------------------------------------
+# histogram invariants
+# ---------------------------------------------------------------------------
+
+class TestHistogramInvariants:
+    def test_inf_bucket_equals_count(self, fresh):
+        h = fresh.histogram("auron_test_seconds", outcome="ok")
+        for v in (0.0005, 0.3, 7.0, 1e9):   # incl. overflow past 120s
+            h.observe(v)
+        fams = reg.parse_prometheus(fresh.render_prometheus())
+        samples = fams["auron_test_seconds"]["samples"]
+        inf = [v for n, l, v in samples
+               if n.endswith("_bucket") and l.get("le") == "+Inf"]
+        count = [v for n, _l, v in samples if n.endswith("_count")]
+        assert inf == [4.0] and count == [4.0]
+
+    def test_parser_rejects_inf_count_mismatch(self):
+        bad = ("# HELP h x\n# TYPE h histogram\n"
+               'h_bucket{le="1"} 1\nh_bucket{le="+Inf"} 2\n'
+               "h_sum 1.5\nh_count 3\n")
+        with pytest.raises(ValueError, match=r"\+Inf bucket"):
+            reg.parse_prometheus(bad)
+
+    def test_parser_rejects_non_cumulative_buckets(self):
+        bad = ("# HELP h x\n# TYPE h histogram\n"
+               'h_bucket{le="1"} 5\nh_bucket{le="2"} 3\n'
+               'h_bucket{le="+Inf"} 5\nh_sum 1\nh_count 5\n')
+        with pytest.raises(ValueError, match="not cumulative"):
+            reg.parse_prometheus(bad)
+
+    def test_parser_requires_inf_bucket(self):
+        bad = ("# HELP h x\n# TYPE h histogram\n"
+               'h_bucket{le="1"} 1\nh_sum 1\nh_count 1\n')
+        with pytest.raises(ValueError, match="no \\+Inf"):
+            reg.parse_prometheus(bad)
+
+
+# ---------------------------------------------------------------------------
+# strict-parser regressions (the conformance oracle itself)
+# ---------------------------------------------------------------------------
+
+class TestStrictParser:
+    def test_duplicate_type_rejected(self):
+        bad = ("# HELP m x\n# TYPE m counter\n# TYPE m counter\nm 1\n")
+        with pytest.raises(ValueError, match="duplicate TYPE"):
+            reg.parse_prometheus(bad)
+
+    def test_duplicate_help_rejected(self):
+        bad = ("# HELP m x\n# HELP m y\n# TYPE m counter\nm 1\n")
+        with pytest.raises(ValueError, match="duplicate HELP"):
+            reg.parse_prometheus(bad)
+
+    def test_metadata_after_samples_rejected(self):
+        bad = ("# HELP m x\n# TYPE m counter\nm 1\n# TYPE m counter\n")
+        with pytest.raises(ValueError, match="duplicate TYPE"):
+            reg.parse_prometheus(bad)
+        bad2 = ("# TYPE m counter\nm 1\n# HELP m x\n")
+        with pytest.raises(ValueError, match="after samples"):
+            reg.parse_prometheus(bad2)
+
+    def test_undeclared_family_rejected(self):
+        with pytest.raises(ValueError, match="no declared family"):
+            reg.parse_prometheus("orphan_metric 1\n")
+
+    def test_malformed_sample_rejected(self):
+        bad = "# HELP m x\n# TYPE m counter\nm one\n"
+        with pytest.raises(ValueError, match="malformed sample"):
+            reg.parse_prometheus(bad)
+
+    def test_malformed_label_rejected(self):
+        bad = ('# HELP m x\n# TYPE m counter\n'
+               'm{k="unterminated} 1\n')
+        with pytest.raises(ValueError):
+            reg.parse_prometheus(bad)
+
+    def test_help_without_type_rejected(self):
+        with pytest.raises(ValueError, match="HELP without TYPE"):
+            reg.parse_prometheus("# HELP m x\n")
+
+    def test_invalid_type_rejected(self):
+        with pytest.raises(ValueError, match="invalid type"):
+            reg.parse_prometheus("# HELP m x\n# TYPE m countr\nm 1\n")
+
+
+# ---------------------------------------------------------------------------
+# the per-query SLO surface
+# ---------------------------------------------------------------------------
+
+class TestQueryDuration:
+    def test_classify_outcome_vocabulary(self):
+        from auron_tpu import errors
+        assert reg.classify_outcome(None) == "ok"
+        assert reg.classify_outcome(
+            errors.MemoryExhausted("x")) == "shed"
+        assert reg.classify_outcome(
+            errors.AdmissionRejected("x", reason="queue_full")) == "shed"
+        assert reg.classify_outcome(
+            errors.QueryCancelled("x")) == "cancelled"
+        # DeadlineExceeded IS-A QueryCancelled: the budget was the
+        # caller's verdict, not an engine failure
+        assert reg.classify_outcome(
+            errors.DeadlineExceeded("x")) == "cancelled"
+        assert reg.classify_outcome(RuntimeError("x")) == "failed"
+        assert reg.classify_outcome(
+            errors.TaskStalled("x")) == "failed"
+
+    def test_observe_query_lands_on_histogram(self):
+        r = reg.get_registry()
+        before = r.histogram("auron_query_duration_seconds",
+                             outcome="shed").count
+        reg.observe_query(0.25, "shed")
+        h = r.histogram("auron_query_duration_seconds", outcome="shed")
+        assert h.count == before + 1
+
+    def test_observe_query_gated_by_registry_knob(self):
+        from auron_tpu import config as cfg
+        conf = cfg.get_config()
+        r = reg.get_registry()
+        before = r.histogram("auron_query_duration_seconds",
+                             outcome="failed").count
+        conf.set(cfg.METRICS_REGISTRY, False)
+        try:
+            reg.observe_query(0.1, "failed")
+        finally:
+            conf.unset(cfg.METRICS_REGISTRY)
+        assert r.histogram("auron_query_duration_seconds",
+                           outcome="failed").count == before

@@ -289,6 +289,50 @@ class TestPerfGate:
         assert last["journal_commits"] >= 1
         assert 0.0 <= last["journal_overhead_pct"] \
             < last["journal_overhead_limit_pct"]
+        # the ops-plane gate (ISSUE 14): the live endpoint answered
+        # parseable /metrics scrapes mid-q01, SLO family present
+        assert last["ops_gate"] == "pass"
+        assert last["ops_scrapes"] >= 1
+
+    def test_ops_gate_scrape_rejects_seeded_regressions(
+            self, monkeypatch):
+        """Seeded regressions for the smoke ops arm: a live endpoint
+        whose exposition is unparseable (duplicate TYPE — the torn-
+        exposition shape) or whose ``auron_query_duration_seconds``
+        family vanished must fail the scrape LOUDLY, not pass a
+        vacuous gate."""
+        from auron_tpu import config as cfg
+        from auron_tpu.obs import ops_server
+        from auron_tpu.obs import registry as obs_registry
+        conf = cfg.get_config()
+        conf.set(cfg.OPS_ENABLED, True)
+        conf.set(cfg.OPS_PORT, 0)
+        try:
+            srv = ops_server.ensure_started()
+            assert srv is not None
+            port = srv.port
+            # healthy exposition passes (the family exists process-wide
+            # once any query was observed)
+            obs_registry.observe_query(0.01, "ok")
+            fams = perf_gate.scrape_ops_metrics(port)
+            assert "auron_query_duration_seconds" in fams
+            real = obs_registry.MetricsRegistry.render_prometheus
+            monkeypatch.setattr(
+                obs_registry.MetricsRegistry, "render_prometheus",
+                lambda self: real(self)
+                + "# TYPE auron_info gauge\nauron_info 1\n")
+            with pytest.raises(ValueError, match="duplicate TYPE"):
+                perf_gate.scrape_ops_metrics(port)
+            monkeypatch.setattr(
+                obs_registry.MetricsRegistry, "render_prometheus",
+                lambda self: "# HELP up x\n# TYPE up gauge\nup 1\n")
+            with pytest.raises(ValueError,
+                               match="auron_query_duration_seconds"):
+                perf_gate.scrape_ops_metrics(port)
+        finally:
+            ops_server.release()
+            conf.unset(cfg.OPS_ENABLED)
+            conf.unset(cfg.OPS_PORT)
 
     def test_smoke_journal_overhead_regression_fails(
             self, monkeypatch, capsys):

@@ -75,6 +75,73 @@ def test_error_propagates_with_traceback(tmp_path):
         srv.shutdown()
 
 
+def test_stats_frame_returns_live_table(tmp_path):
+    """ISSUE 14 satellite: a first-frame STATS request answers the
+    /queries live table + admission counters as JSON over the EXISTING
+    wire protocol (no HTTP port needed), via AuronClient.stats()."""
+    import json as _json
+    import threading
+
+    from conftest import spin_until
+
+    path, tbl = _dataset(str(tmp_path))
+    srv = AuronServer()
+    srv.serve_background()
+    try:
+        client = AuronClient(*srv.address)
+        # idle shape first
+        st = client.stats()
+        assert st["queries"] == []
+        assert st["admission"]["admitted"] == 0
+        assert "batches_sent" in st["server"]
+        # now sample it WHILE a task executes: the live table must show
+        # the serving query with its progress columns
+        seen: list = []
+        done = threading.Event()
+
+        def run_task():
+            try:
+                client.execute(_task(path))
+            finally:
+                done.set()
+
+        t = threading.Thread(target=run_task, daemon=True)
+        t.start()
+
+        def saw_live_row():
+            if done.is_set():
+                return True   # too fast — the post-run checks still run
+            rows = [r for r in client.stats()["queries"]
+                    if r["query"].startswith("serving-")]
+            if rows:
+                seen.extend(rows)
+            return bool(rows)
+
+        spin_until(saw_live_row, what="a live serving row on STATS")
+        done.wait(60)
+        t.join(10)
+        if seen:   # raced-to-done is legal; a seen row must be sane
+            row = seen[0]
+            assert row["state"] in ("running", "queued")
+            assert row["scheduler"] == "serving"
+            assert row["tasks_total"] in (0, 1)
+        st = client.stats()
+        assert st["admission"]["admitted"] >= 1
+        assert st["queries"] == []   # nothing left seated
+        # the frame is plain JSON on the wire (firewalled clients can
+        # speak it without this helper)
+        from auron_tpu.runtime.serving import (KIND_DONE, KIND_STATS,
+                                               read_frame, write_frame)
+        import socket
+        with socket.create_connection(srv.address, timeout=10) as s:
+            write_frame(s, KIND_STATS, b"")
+            kind, payload = read_frame(s)
+        assert kind == KIND_DONE
+        assert _json.loads(payload.decode())["admission"]["admitted"] >= 1
+    finally:
+        srv.shutdown()
+
+
 def test_two_process_serving(tmp_path):
     """The VERDICT gate: a fixture client in THIS process drives an
     engine server in a SEPARATE python process over TCP."""

@@ -452,7 +452,14 @@ class TestOverheadHarness:
         # measured at real scale by bench.py — finiteness only here)
         assert np.isfinite(res["profile_overhead_pct"])
         assert res["profile_overhead_gate_pct"] == 2.0
+        # the fourth arm: always-on flight recorder (ISSUE 14 — armed
+        # recorder, trace export off, the shipping posture)
+        assert res["trace_ab_norecorder_s"] > 0
+        assert np.isfinite(res["flight_overhead_pct"])
+        assert res["flight_overhead_gate_pct"] == 2.0
         assert res["trace_ab_spans"] > 0
         assert not trace.enabled()
         from auron_tpu.obs import profile as obs_profile
         assert obs_profile.enabled()   # default restored
+        from auron_tpu.obs import flight_recorder as _flight
+        assert _flight.armed()         # default restored

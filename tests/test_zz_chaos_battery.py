@@ -130,6 +130,49 @@ def test_chaos_full_sweep(scenario, plan, seed, scenarios):
     _assert_contract(chaos.run_chaos(scenarios[scenario], plan, seed))
 
 
+# -- post-mortem bundle correlation (ISSUE 14 satellite) --------------------
+
+def test_chaos_classified_failures_produce_correlated_bundles(scenarios):
+    """With ``auron.bundle.enabled`` armed, every classified-failure
+    chaos run must produce EXACTLY ONE post-mortem bundle whose flight
+    dump contains the injected fault's ``fault.injected`` event (site +
+    seed match), and the bundle inventory must honor max_bundles with
+    no growth past it — ``run_chaos`` folds both audits into the leak
+    verdict, so ``_assert_contract`` is the whole assertion. memmgr.deny
+    at prob 1.0 sheds deterministically (MemoryExhausted under the
+    lifecycle scenario's 'shed' policy), so every seed exercises the
+    bundle path — and the retention cap (2) is exceeded by run count
+    (4), proving oldest-first eviction under the audit."""
+    conf = cfg.get_config()
+    _missing = object()
+    keys = (cfg.BUNDLE_ENABLED, cfg.BUNDLE_DIR, cfg.BUNDLE_MAX_BUNDLES)
+    saved = {k: conf._overrides.get(k, _missing) for k in keys}
+    with tempfile.TemporaryDirectory(prefix="chaos_bundles_") as bdir:
+        conf.set(cfg.BUNDLE_ENABLED, True)
+        conf.set(cfg.BUNDLE_DIR, bdir)
+        conf.set(cfg.BUNDLE_MAX_BUNDLES, 2)
+        try:
+            shed = 0
+            for seed in (1, 2, 3, 4):
+                outcome = chaos.run_chaos(
+                    scenarios["lifecycle_pipeline"],
+                    "memmgr.deny:deny@1.0", seed)
+                _assert_contract(outcome)
+                if outcome.error_type == "MemoryExhausted":
+                    shed += 1
+                    assert len(outcome.bundles) == 1, outcome.bundles
+            assert shed >= 2, "the battery never exercised the shed path"
+            # no growth: retention held across every run
+            from auron_tpu.obs import bundle as bundle_mod
+            assert len(bundle_mod.list_bundles(bdir)) <= 2
+        finally:
+            for k, prev in saved.items():
+                if prev is _missing:
+                    conf.unset(k)
+                else:
+                    conf.set(k, prev)
+
+
 # -- TPC-DS subset under injected faults ------------------------------------
 
 _TPCDS_NAMES = ["q3", "q96"]

@@ -266,6 +266,89 @@ def evaluate(record: dict, baseline: dict, tolerance_pct: float,
     return verdict
 
 
+def scrape_ops_metrics(port: int, host: str = "127.0.0.1") -> dict:
+    """One STRICT ops-endpoint scrape (the ops-plane gate's unit):
+    fetch ``/metrics``, run it through the conformance parser
+    (obs/registry.parse_prometheus — ValueError on any text-format
+    violation), and verify the SLO family
+    ``auron_query_duration_seconds`` is being exposed. Returns the
+    parsed families."""
+    import urllib.request
+
+    from auron_tpu.obs import registry as obs_registry
+    with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10) as r:
+        text = r.read().decode()
+    fams = obs_registry.parse_prometheus(text)
+    if "auron_query_duration_seconds" not in fams:
+        raise ValueError(
+            "auron_query_duration_seconds absent from /metrics — the "
+            "per-query SLO surface is gone")
+    return fams
+
+
+def run_ops_gate(tables) -> dict:
+    """Ops-plane smoke gate: boot a Session with the telemetry endpoint
+    on (ephemeral port), scrape ``/metrics`` in a loop WHILE q01 runs,
+    and fail loudly when any scrape is unparseable, the SLO histogram
+    is missing, or the endpoint never answered. Returns
+    ``{"ops_gate": "pass"|"fail", "ops_scrapes": n, "ops_error": ...}``."""
+    import threading
+
+    from auron_tpu import config as cfg
+    from auron_tpu.frontend.session import Session
+    from auron_tpu.it.queries import q01_dataframe
+    conf = cfg.get_config()
+    conf.set(cfg.OPS_ENABLED, True)
+    conf.set(cfg.OPS_PORT, 0)
+    errors: list = []
+    scrapes = [0]
+    try:
+        s = Session()
+        try:
+            if s.ops_address is None:
+                return {"ops_gate": "fail", "ops_scrapes": 0,
+                        "ops_error": "ops endpoint did not start "
+                                     "(auron.ops.enabled was on)"}
+            port = s.ops_address[1]
+            stop = threading.Event()
+
+            def scraper():
+                while not stop.is_set():
+                    try:
+                        scrape_ops_metrics(port)
+                        scrapes[0] += 1
+                    except Exception as e:   # noqa: BLE001 — verdict
+                        errors.append(f"{type(e).__name__}: {e}")
+                        return
+                    stop.wait(0.002)
+
+            th = threading.Thread(target=scraper, daemon=True)
+            th.start()
+            q01_dataframe(s, tables).collect()   # scraped mid-flight
+            stop.set()
+            th.join(10)
+            try:
+                # final post-run scrape: the family must be present
+                # and parseable AFTER the query observed its outcome
+                scrape_ops_metrics(port)
+                scrapes[0] += 1
+            except Exception as e:   # noqa: BLE001 — verdict
+                errors.append(f"{type(e).__name__}: {e}")
+        finally:
+            s.close()
+    finally:
+        conf.unset(cfg.OPS_ENABLED)
+        conf.unset(cfg.OPS_PORT)
+    out = {"ops_gate": "pass" if not errors and scrapes[0] else "fail",
+           "ops_scrapes": scrapes[0]}
+    if errors:
+        out["ops_error"] = errors[0]
+    elif not scrapes[0]:
+        out["ops_error"] = "ops endpoint answered no scrape"
+    return out
+
+
 def run_smoke(baseline: dict) -> dict:
     """Tier-1-fast smoke arm: run the q01 operator pipeline in-process
     at a tiny scale and compare against the generous smoke floor — an
@@ -371,6 +454,16 @@ def run_smoke(baseline: dict) -> dict:
                 f"journal hot-path overhead {journal_pct:.3f}% >= "
                 f"{journal_limit}% of the journaled q01 wall "
                 f"(crash-safe journal gate)")
+        # ops-plane arm: the live telemetry endpoint must expose a
+        # parseable /metrics carrying the SLO histogram, scraped WHILE
+        # q01 runs (unparseable exposition or a vanished
+        # auron_query_duration_seconds fails the gate loudly)
+        verdict.update(run_ops_gate(tables))
+        if verdict["ops_gate"] != "pass" \
+                and verdict["perf_gate"] == "pass":
+            verdict["perf_gate"] = "fail"
+            verdict["reason"] = (
+                f"ops-plane gate: {verdict.get('ops_error', 'failed')}")
         return verdict
     finally:
         import shutil
