@@ -1,0 +1,41 @@
+"""graftlint: AST contract checker for the runtime's cross-cutting
+invariants.
+
+Fourteen PRs accreted contracts no type checker sees: device syncs must
+route through ``profile.timed_get``/``device_fence`` frames, donation is
+forbidden where a retry reuses inputs, trace-semantic knobs must ride
+``config.trace_salt()``, runtime raises must be classified
+``AuronError``s, fault-site / trace-category strings must match the
+documented vocabularies, operator batch loops must poll
+``ctx.checkpoint``, and lock acquisition must stay cycle-free. Each was
+guarded only by chaos sweeps and regression tests that catch violations
+AFTER they ship a wrong answer or a silent stall. This package enforces
+them at CI time, the way the SystemML fusion-plan work (PAPERS.md,
+1801.00829) and Flare (1703.08219) argue a native-execution engine must
+enforce its structural invariants to evolve safely.
+
+Entry points:
+
+- ``python -m auron_tpu.analysis --baseline tools/lint_baseline.json``
+  (the CI gate; ``--update-baseline`` freezes today's grandfathered
+  violations, ``--json`` emits the machine-readable report)
+- :func:`analyze` / :func:`run` for programmatic use
+  (tests/test_zz_lint_gate.py, tools/perf_gate.py's lint arm)
+
+The rule contracts, the suppression grammar
+(``# graft: disable=<rule-id> -- <reason>``, reason mandatory) and the
+baseline workflow are documented in ANALYSIS.md.
+"""
+
+from auron_tpu.analysis.core import (       # noqa: F401
+    AnalysisResult,
+    Violation,
+    all_rules,
+    analyze,
+    apply_baseline,
+    default_targets,
+    load_baseline,
+    repo_root,
+    run,
+    save_baseline,
+)

@@ -103,6 +103,25 @@ class ShuffleCorruption(AuronError):
         self.path = path
 
 
+class ScalarSubqueryError(PlanError, RuntimeError):
+    """A scalar subquery used as an expression returned more than one
+    row: a deterministic plan/data defect — recomputing the partition
+    re-reads the same rows. RuntimeError subclass so legacy ``except
+    RuntimeError`` sites (and tests matching on the message) keep
+    working."""
+
+
+class RemoteEngineError(AuronError, RuntimeError):
+    """The serving tier's client half received a structured ERROR frame
+    the server did not classify further: the failure already happened
+    (and was classified, retried, or shed) SERVER-side, so a blind
+    client-side retry of the same submission is not the recovery — the
+    caller decides. RuntimeError subclass so existing ``except
+    RuntimeError``/``pytest.raises(RuntimeError, match='engine error')``
+    consumers keep working."""
+    transient = False
+
+
 # ---------------------------------------------------------------------------
 # lifecycle classes — the query lifecycle control plane (PR 8)
 # ---------------------------------------------------------------------------

@@ -77,6 +77,9 @@ class _FileSinkOp(PhysicalOp):
             ok = False
             try:
                 for batch in self.child.execute(partition, ctx):
+                    # durable-tier drive loop: poll like the shuffle/
+                    # spill writers so cancels land between chunks
+                    ctx.checkpoint("sink.write")
                     rb = to_arrow(batch, child_schema)
                     if not rb.num_rows:
                         continue

@@ -560,6 +560,8 @@ def _batch_reduce_kernel(n_keys: int, acc_meta: tuple, cap: int,
         accs_s = tuple(_gather_acc(a, perm) for a in accs)
         return _reduce_sorted(keys_s, accs_s, live_s, h[perm], acc_meta, cap)
 
+    # graft: donation-ok -- per-batch contribution temporaries;
+    # collect kinds/aliased leaves force donate=False upstream
     return programs.jit(kernel,
                         donate_argnums=(0, 1, 2) if donate else ())
 
@@ -933,7 +935,7 @@ class _HostAggState:
                 try:
                     self._buf_size_sample = max(
                         self._buf_size_sample, len(pickle.dumps(buf)))
-                except Exception:
+                except Exception:   # graft: disable=GL004 -- size sampling is advisory; an unpicklable UDAF buffer must not fail the query
                     pass
                 break
 
@@ -1580,6 +1582,8 @@ class AggOp(PhysicalOp):
             # the hash step's overflow-retry protocol reuses its inputs
             # (PERF.md 'Pipelined execution'): no donation on this path
             return self._merge_hash(state, keys, accs, live, elapsed, ht)
+        # graft: donation-ok -- sorted path only: the hash branch
+        # above latched off (its overflow retry reuses inputs)
         return self._merge_sorted(state, keys, accs, live, elapsed,
                                   donate=donate)
 
@@ -1604,6 +1608,8 @@ class AggOp(PhysicalOp):
         ~_HOT_FACTOR batches instead of per batch. The reference's
         open-addressing AggTable gets the same amortization from its
         in-memory table + sorted bucket spills (agg_table.rs:68-356)."""
+        # graft: donation-ok -- _donate_contributions gate (owned
+        # child, no collect-kind growth retry, no aliased leaves)
         batch_tbl = self._reduce_batch(keys, accs, live, elapsed,
                                        donate=donate)
         cap_b = live.shape[0]
@@ -2045,10 +2051,12 @@ class AggOp(PhysicalOp):
         touched = rows > 0
         ng_dev = jnp.sum(touched.astype(jnp.int32))
         order = jnp.argsort(~touched, stable=True)   # touched keys first
-        import jax
+        from auron_tpu.obs import profile as _profile
         # ONE batched readback for every control scalar (each separate
-        # int() costs a full RTT on tunneled accelerators)
-        ng, mx, mn, nulls, nrows = jax.device_get(
+        # int() costs a full RTT on tunneled accelerators); routed
+        # through the profiler so the wait books as device time at this
+        # moved sync point, like the grow/overflow readbacks above
+        ng, mx, mn, nulls, nrows = _profile.timed_get(
             [ng_dev, max_k, min_k, saw_null, total_rows])
         ng = int(ng)
         kdispatch.record_rows(decision, int(nrows), kmetrics)
@@ -2149,6 +2157,8 @@ class AggOp(PhysicalOp):
                         # state lives in the consumer between merges so an
                         # external victim spill can take it atomically
                         state = consumer.take_state()
+                    # graft: donation-ok -- donate_contribs is the
+                    # _donate_contributions gate resolved above
                     state = self._merge(state, keys, accs, live, elapsed,
                                         ht_ctl, donate=donate_contribs)
                     if consumer is not None:

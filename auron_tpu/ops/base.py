@@ -90,12 +90,13 @@ def _device_sync(value) -> None:
     try:
         import numpy as _np
         picks = leaves[-2:]
+        # graft: disable=GL001 -- this IS the serial-mode sanctioned sync helper (timer.track attributes it)
         _np.asarray(jax.device_get([p.ravel()[:1] for p in picks]))
     except Exception:
         for leaf in leaves[-2:]:
             try:
-                leaf.block_until_ready()
-            except Exception:
+                leaf.block_until_ready()   # graft: disable=GL001 -- plugin fallback of the sanctioned sync helper
+            except Exception:   # graft: disable=GL004 -- plugin-dependent sync fallback; the wait is best-effort by contract
                 pass
 
 

@@ -132,6 +132,8 @@ def build_stage_kernel(fragments: list[KernelFragment],
                                              partition_id, carries)
         return outs, jnp.stack(new_carries)
 
+    # graft: donation-ok -- donate gated on yields_owned_batches by
+    # the caller; fused stages never retry on the same inputs
     return programs.jit(kernel, donate_argnums=(0,) if donate else ())
 
 

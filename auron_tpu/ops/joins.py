@@ -136,6 +136,8 @@ def _fused_probe_program(frag_keys: tuple, key_exprs: tuple,
                 b, index_kind, index_args, rounds, key_exprs, out_schema)
             return b, lo, counts, total, jnp.stack(new_carries)
 
+        # graft: donation-ok -- probe chain owns the raw batch
+        # (fragment_computes gate); probe programs never re-run
         return _programs.jit(kernel,
                              donate_argnums=(0,) if donate else ())
 
@@ -284,6 +286,10 @@ class HashJoinOp(PhysicalOp):
                 build_batches = []
                 with timer(build_time):
                     for b in self.build.execute(partition, ctx):
+                        # the build side materializes fully before any
+                        # probe batch streams: without a poll here a
+                        # cancel/deadline waits out the whole build
+                        ctx.checkpoint("join.build")
                         if consumer is not None:
                             consumer.add(b)
                         else:

@@ -174,6 +174,7 @@ def _sort_kernel(sort_exprs: tuple, in_schema: Schema, capacity: int,
 
     # the un-sorted input is dead after the gather — donating it halves
     # peak HBM for the sort step (callers gate on ownership + platform)
+    # graft: donation-ok -- _sort_donate gate (owned batches only)
     return programs.jit(kernel, donate_argnums=(0,) if donate else ())
 
 
@@ -216,6 +217,8 @@ def _sort_with_words_kernel(sort_exprs: tuple, in_schema: Schema,
         words = jnp.stack(sort_key_words(key_cols, orders), axis=1)
         return gather_batch(batch, perm, batch.num_rows), words[perm]
 
+    # graft: donation-ok -- _sort_donate gate (owned batches only);
+    # the k-way merge consumes each gathered run exactly once
     return programs.jit(kernel, donate_argnums=(0,) if donate else ())
 
 

@@ -66,8 +66,6 @@ class ScalarSubqueryBinderOp(PhysicalOp):
 
     def _resolve_one(self, q: "pb.ScalarSubqueryE", ctx: ExecContext):
         """Run one subquery plan to completion, single partition."""
-        import numpy as np
-
         from auron_tpu.ir.planner import PhysicalPlanner
         # plan_task, not create_plan: the subquery's own plan may contain
         # further scalar subqueries (nested binder resolves them)
@@ -79,14 +77,17 @@ class ScalarSubqueryBinderOp(PhysicalOp):
         rows = 0
         value = None
         from auron_tpu.columnar.arrow_bridge import to_arrow
+        from auron_tpu.obs import profile as _profile
         for batch in op.execute(0, sub_ctx):
-            n = int(np.asarray(batch.num_rows))
+            sub_ctx.checkpoint("subquery.collect")
+            n = int(_profile.timed_get(batch.num_rows))
             if n == 0:
                 continue
             rb = to_arrow(batch, op.schema())
             rows += rb.num_rows
             if rows > 1:
-                raise RuntimeError(
+                from auron_tpu import errors
+                raise errors.ScalarSubqueryError(
                     "more than one row returned by a subquery used as "
                     "an expression")
             value = rb.column(0)[0].as_py()

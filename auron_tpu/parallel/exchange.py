@@ -83,6 +83,8 @@ def _sort_by_pid_kernel(num_partitions: int, capacity: int, donate: bool):
     def kernel(batch: DeviceBatch, pids):
         return _split_body(batch, pids, num_partitions)
 
+    # graft: donation-ok -- callers gate on owned input streams;
+    # a task retry re-splits from source, never the donated array
     return programs.jit(kernel, donate_argnums=(0,) if donate else ())
 
 
@@ -131,6 +133,8 @@ def _fused_split_program(frag_keys: tuple, part_sig: tuple,
                                + jnp.asarray(b.num_rows, jnp.int64))
             return sorted_batch, counts, jnp.stack(new_carries)
 
+        # graft: donation-ok -- host split path (the mesh exchange
+        # keeps donation OFF by contract for its escalation re-run)
         return programs.jit(kernel,
                             donate_argnums=(0,) if donate else ())
 
@@ -728,7 +732,10 @@ class ShuffleExchangeOp(PhysicalOp):
                                 f"{type(err).__name__} at round "
                                 f"{rounds}")
                         try:
-                            carries_h = np.asarray(jax.device_get(carries))
+                            # the carry readback IS the demotion's sync
+                            # point: timed_get books the wait as device
+                            carries_h = np.asarray(
+                                _profile.timed_get(carries))
                         except Exception:
                             # the carry shards are unreadable too: the
                             # loss reaches past this round — surface
@@ -789,7 +796,7 @@ class ShuffleExchangeOp(PhysicalOp):
                             # remaining rounds re-route
                             t_demote = time.perf_counter()
                             carries_h = np.asarray(
-                                jax.device_get(carries))
+                                _profile.timed_get(carries))
                             demote_reason = "straggler"
                             self._emit_demote(metrics, None, rounds,
                                               plane)

@@ -501,6 +501,7 @@ def local_shard(arr, d: int, mesh):
     zero-copy per-device view the reducer read path slices (single-host;
     multihost reducers go through the RSS tier by construction)."""
     dev = mesh.devices.flat[d]
+    # graft: disable=GL001 -- documented single-host reducer read path; multihost routes RSS by construction (ROADMAP scale-out)
     for s in arr.addressable_shards:
         if s.device == dev:
             return s.data

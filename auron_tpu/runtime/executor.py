@@ -309,7 +309,7 @@ class ExecutionRuntime:
             plane = _mesh.current_plane()
             if plane is not None:
                 snap["mesh"] = plane.stats()
-        except Exception:   # pragma: no cover - observability only
+        except Exception:   # pragma: no cover - observability only  # graft: disable=GL004 -- observability export is best-effort by contract
             pass
         if getattr(self, "profile_dir", None):
             op_times = {

@@ -546,7 +546,7 @@ class QueryJournal:
             from auron_tpu.obs import trace
             trace.event("journal", "journal.disable", stem=self.stem,
                         error=type(exc).__name__)
-        except Exception:
+        except Exception:  # graft: disable=GL004 -- degrade-event tee is best-effort; the degrade itself already logged
             pass
 
     @property
@@ -647,7 +647,7 @@ class QueryJournal:
             try:
                 self._file.flush()
                 self._file.close()
-            except Exception:
+            except Exception:  # graft: disable=GL004 -- closing a possibly-degraded journal; the degrade path logged the cause
                 pass
             self._file = None
 
@@ -727,7 +727,7 @@ class QueryJournal:
                         maps_skipped=self.maps_skipped,
                         maps_recomputed=self.maps_recomputed,
                         bytes_reused=self.bytes_reused)
-        except Exception:
+        except Exception:  # graft: disable=GL004 -- completion-event tee is best-effort
             pass
 
 
@@ -1036,7 +1036,7 @@ def load_for_resume(dir_: str, query_id: str, catalog: dict,
         trace.event("journal", "journal.resume", stem=stem,
                     shuffles_committed=len(jr.shuffle_commits),
                     maps_committed=len(jr.committed))
-    except Exception:
+    except Exception:  # graft: disable=GL004 -- resume-event tee is best-effort
         pass
     return jr
 
@@ -1125,7 +1125,7 @@ def find_reusable(dir_: str, plan_bytes: bytes, catalog: dict,
             from auron_tpu.obs import trace
             trace.event("journal", "journal.reuse", stem=stem,
                         shuffles_committed=len(jr.shuffle_commits))
-        except Exception:
+        except Exception:  # graft: disable=GL004 -- reuse-event tee is best-effort
             pass
         return jr
     return None

@@ -274,6 +274,8 @@ def jit(fun=None, *, donate_argnums=(), **kwargs):
             except Exception:   # backend init failure: stay conservative
                 platform = "cpu"
             if platform != "cpu":
+                # graft: donation-ok -- the donation-aware wrapper
+                # itself; every caller annotates its own site
                 return jax.jit(f, donate_argnums=donate_argnums, **kwargs)
         return jax.jit(f, **kwargs)
 

@@ -83,6 +83,8 @@ import traceback
 
 import pyarrow as pa
 
+from auron_tpu import errors
+
 #: process-unique serving query ids: they key the process-global
 #: per-query ledgers (program cache, memmgr), so handlers must not share
 _SERVING_QUERY_SEQ = itertools.count(1)
@@ -270,7 +272,7 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                 # mid-task (clear_caches during a concurrent trace would
                 # race the very caches it prunes)
                 self.server.task_done_maybe_trim()
-            except Exception:
+            except Exception:   # graft: disable=GL004 -- post-request cache trim is opportunistic; the reply already shipped
                 pass
 
     # -- control plane -----------------------------------------------------
@@ -292,7 +294,7 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                     self._tables.put((name, _ipc_table(payload[4 + nlen:])))
                 else:
                     return   # protocol violation: treat as disconnect
-        except Exception:
+        except Exception:   # graft: disable=GL004 -- reader teardown: dead peer/malformed frame ends the loop; the finally cancels the task
             pass   # malformed frame / peer went away: stop computing
         finally:
             # EVERY mid-task reader exit must cancel: a live handler
@@ -520,7 +522,7 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                 _obs_registry.observe_query(
                     _time.monotonic() - t0,
                     _obs_registry.classify_outcome(exc))
-            except Exception:   # pragma: no cover - telemetry only
+            except Exception:   # pragma: no cover  # graft: disable=GL004 -- per-query outcome telemetry is best-effort
                 pass
 
         t0 = _time.monotonic()
@@ -796,15 +798,15 @@ class AuronClient:
             while True:
                 fkind, fpayload = read_frame(s)
                 if fkind == KIND_ERROR:
-                    raise RuntimeError("engine error:\n"
-                                       + fpayload.decode())
+                    raise errors.RemoteEngineError(
+                        "engine error:\n" + fpayload.decode())
                 if fkind == KIND_BATCH:
                     batches.append(_ipc_batch(fpayload))
                     write_frame(s, KIND_ACK, b"")
                 elif fkind == KIND_NEED_TABLES:
                     need = json.loads(fpayload.decode())
                     if fallback_provider is None:
-                        raise RuntimeError(
+                        raise errors.RemoteEngineError(
                             "engine requested fallback tables "
                             f"{[n['table'] for n in need]} but no "
                             "fallback_provider was given")
@@ -851,7 +853,8 @@ class AuronClient:
             write_frame(s, KIND_STATS, b"")
             kind, payload = read_frame(s)
         if kind == KIND_ERROR:
-            raise RuntimeError("engine error:\n" + payload.decode())
+            raise errors.RemoteEngineError(
+                "engine error:\n" + payload.decode())
         return json.loads(payload.decode())
 
     def cancel_query(self, query_id: str) -> bool:
@@ -866,7 +869,8 @@ class AuronClient:
                         json.dumps({"query_id": query_id}).encode())
             kind, payload = read_frame(s)
         if kind == KIND_ERROR:
-            raise RuntimeError("engine error:\n" + payload.decode())
+            raise errors.RemoteEngineError(
+                "engine error:\n" + payload.decode())
         return bool(json.loads(payload.decode()).get("cancelled"))
 
     def stream(self, task_bytes: bytes):
@@ -878,8 +882,8 @@ class AuronClient:
             while True:
                 kind, payload = read_frame(s)
                 if kind == KIND_ERROR:
-                    raise RuntimeError("engine error:\n"
-                                       + payload.decode())
+                    raise errors.RemoteEngineError(
+                        "engine error:\n" + payload.decode())
                 if kind == KIND_BATCH:
                     write_frame(s, KIND_ACK, b"")
                 yield kind, payload

@@ -1,0 +1,696 @@
+"""graftlint fixture battery: at least one true-positive and one
+true-negative snippet per rule (GL001–GL008), the suppression grammar
+(mandatory reasons, unknown ids, file-wide disables), annotations, and
+the baseline round-trip (freeze → clean → new violation fails →
+count semantics → stale reporting). ANALYSIS.md documents the
+contracts these snippets encode."""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from auron_tpu.analysis import core
+from auron_tpu.analysis import __main__ as cli
+
+
+# ---------------------------------------------------------------------------
+# harness: a fake repo tree under tmp_path
+# ---------------------------------------------------------------------------
+
+class Tree:
+    def __init__(self, root):
+        self.root = str(root)
+
+    def write(self, rel: str, source: str) -> str:
+        path = os.path.join(self.root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(textwrap.dedent(source))
+        return path
+
+    def config_md(self) -> None:
+        """A CONFIG.md in exact sync, so GL005 tests see only their
+        seeded drift."""
+        from auron_tpu import config
+        with open(os.path.join(self.root, "CONFIG.md"), "w") as f:
+            f.write(config.generate_docs())
+
+    def analyze(self, rule_ids=None) -> core.AnalysisResult:
+        return core.analyze([os.path.join(self.root, "auron_tpu")],
+                            root=self.root, rule_ids=rule_ids)
+
+
+@pytest.fixture
+def tree(tmp_path):
+    t = Tree(tmp_path)
+    # a synced CONFIG.md by default so GL005's doc checks see only
+    # deliberately seeded drift
+    t.config_md()
+    return t
+
+
+def rules_of(result) -> list:
+    return [v.rule for v in result.violations]
+
+
+# ---------------------------------------------------------------------------
+# GL001 — sync discipline
+# ---------------------------------------------------------------------------
+
+def test_gl001_true_positives(tree):
+    tree.write("auron_tpu/ops/x.py", """\
+        import jax
+        import numpy as np
+
+        def f(b, arrs):
+            n = int(b.num_rows)               # candidate sync
+            w = float(b.total)                # candidate sync
+            a = np.asarray(b.col)             # candidate transfer
+            arrs.block_until_ready()          # raw sync
+            jax.device_get(arrs)              # raw readback
+            for s in arrs.addressable_shards: # host shard slicing
+                pass
+            return n, w, a
+        """)
+    result = tree.analyze(rule_ids=["GL001"])
+    assert rules_of(result) == ["GL001"] * 6
+
+
+def test_gl001_true_negatives(tree):
+    tree.write("auron_tpu/ops/x.py", """\
+        from auron_tpu.obs import profile as _profile
+
+        def f(b, xs):
+            n = int(_profile.timed_get(b.num_rows))   # sanctioned
+            k = int(len(xs))                          # host builtin
+            z = float("1.5")                          # literal
+            i = int("ff", 16)                         # base conversion
+            _profile.device_fence(b)                  # sanctioned fence
+            return n, k, z, i
+        """)
+    result = tree.analyze(rule_ids=["GL001"])
+    assert result.violations == []
+
+
+def test_gl001_scoped_to_runtime_packages(tree):
+    # exprs/ is outside ops//runtime//parallel/: no GL001 there
+    tree.write("auron_tpu/exprs/x.py", """\
+        def f(b):
+            return int(b.num_rows)
+        """)
+    result = tree.analyze(rule_ids=["GL001"])
+    assert result.violations == []
+
+
+# ---------------------------------------------------------------------------
+# GL002 — donation safety
+# ---------------------------------------------------------------------------
+
+def test_gl002_true_positive(tree):
+    tree.write("auron_tpu/ops/x.py", """\
+        def build(kernel, programs, donate):
+            return programs.jit(kernel, donate_argnums=(0,))
+        """)
+    result = tree.analyze(rule_ids=["GL002"])
+    assert rules_of(result) == ["GL002"]
+
+
+def test_gl002_annotated_and_empty_are_clean(tree):
+    tree.write("auron_tpu/ops/x.py", """\
+        def build(kernel, programs, donate):
+            # graft: donation-ok -- inputs are per-batch temporaries;
+            # no retry path reuses them
+            a = programs.jit(kernel, donate_argnums=(0,) if donate else ())
+            b = programs.jit(kernel, donate_argnums=())   # explicit off
+            c = programs.jit(kernel, donate=False)        # explicit off
+            return a, b, c
+        """)
+    result = tree.analyze(rule_ids=["GL002"])
+    assert result.violations == []
+
+
+# ---------------------------------------------------------------------------
+# GL003 — trace-semantic knobs
+# ---------------------------------------------------------------------------
+
+def test_gl003_true_positive_in_kernel_builder(tree):
+    tree.write("auron_tpu/ops/x.py", """\
+        def build_sum_kernel(conf, cfg):
+            return conf.get(cfg.BATCH_CAPACITY)
+        """)
+    result = tree.analyze(rule_ids=["GL003"])
+    assert rules_of(result) == ["GL003"]
+    assert "auron.batch.capacity" in result.violations[0].message
+
+
+def test_gl003_true_negatives(tree):
+    tree.write("auron_tpu/ops/x.py", """\
+        def plan_stage(conf, cfg):
+            # plan shaping, not kernel building: fine anywhere
+            return conf.get(cfg.BATCH_CAPACITY)
+
+        def build_map_kernel(conf, cfg):
+            # trace-semantic keys ride the program-cache salt already
+            return conf.get(cfg.MAP_KEY_DEDUP_POLICY)
+
+        def build_salt_kernel(conf, cfg):
+            # graft: inert-knob -- only sizes the host-side staging
+            # buffer; the traced program never sees it
+            return conf.get(cfg.SINK_BUFFER_ROWS)
+        """)
+    result = tree.analyze(rule_ids=["GL003"])
+    assert result.violations == []
+
+
+# ---------------------------------------------------------------------------
+# GL004 — error taxonomy
+# ---------------------------------------------------------------------------
+
+def test_gl004_true_positives(tree):
+    tree.write("auron_tpu/runtime/x.py", """\
+        def f(cond):
+            if cond:
+                raise RuntimeError("boom")
+            try:
+                g()
+            except Exception:
+                pass
+        """)
+    result = tree.analyze(rule_ids=["GL004"])
+    assert rules_of(result) == ["GL004", "GL004"]
+
+
+def test_gl004_true_negatives(tree):
+    tree.write("auron_tpu/runtime/x.py", """\
+        import logging
+        from auron_tpu import errors
+
+        def f(cond):
+            if cond:
+                raise errors.MemoryExhausted("classified")
+            try:
+                g()
+            except Exception:
+                logging.getLogger(__name__).exception("ctx")
+            except ValueError:
+                pass   # narrow catch: not GL004's business
+        """)
+    # a bare raise OUTSIDE runtime//ops/ is also not GL004's business
+    tree.write("auron_tpu/obs/x.py", """\
+        def f():
+            raise RuntimeError("observability helper")
+        """)
+    result = tree.analyze(rule_ids=["GL004"])
+    assert result.violations == []
+
+
+# ---------------------------------------------------------------------------
+# GL005 — knob-registry drift
+# ---------------------------------------------------------------------------
+
+def test_gl005_unknown_literal_key(tree):
+    tree.config_md()
+    tree.write("auron_tpu/runtime/x.py", """\
+        def f(conf):
+            return conf.get("auron.totally.unknown")
+        """)
+    result = tree.analyze(rule_ids=["GL005"])
+    assert rules_of(result) == ["GL005"]
+    assert "auron.totally.unknown" in result.violations[0].message
+
+
+def test_gl005_known_literal_key_clean(tree):
+    tree.config_md()
+    tree.write("auron_tpu/runtime/x.py", """\
+        def f(conf):
+            return conf.get("auron.batch.capacity")
+        """)
+    result = tree.analyze(rule_ids=["GL005"])
+    assert result.violations == []
+
+
+def test_gl005_config_md_drift(tree):
+    from auron_tpu import config
+    # hand-edited doc: one documented knob the registry never declared
+    with open(os.path.join(tree.root, "CONFIG.md"), "w") as f:
+        f.write(config.generate_docs()
+                + "| `auron.ghost.knob` | bool | False | `X` | gone |\n")
+    tree.write("auron_tpu/runtime/x.py", "def f():\n    pass\n")
+    result = tree.analyze(rule_ids=["GL005"])
+    assert [v.rule for v in result.violations] == ["GL005"]
+    assert "auron.ghost.knob" in result.violations[0].message
+    assert result.violations[0].file == "CONFIG.md"
+
+
+def test_gl005_dead_knob_detection(tree):
+    """Copy the real config.py in; reference every declared const but
+    one from a use-site file — exactly that knob reads as dead."""
+    from auron_tpu import config
+    real = os.path.join(core.repo_root(), "auron_tpu", "config.py")
+    with open(real) as f:
+        tree.write("auron_tpu/config.py", f.read())
+    tree.config_md()
+    keys = {o.key for o in config.options()}
+    consts = sorted(
+        n for n in dir(config)
+        if n.isupper() and isinstance(getattr(config, n), str)
+        and getattr(config, n) in keys)
+    victim = "BATCH_CAPACITY"
+    body = "def f(cfg):\n" + "".join(
+        f"    cfg.{n}\n" for n in consts if n != victim)
+    tree.write("auron_tpu/runtime/uses.py", body)
+    result = tree.analyze(rule_ids=["GL005"])
+    assert [v.rule for v in result.violations] == ["GL005"]
+    assert "auron.batch.capacity" in result.violations[0].message
+    assert result.violations[0].file == "auron_tpu/config.py"
+
+
+# ---------------------------------------------------------------------------
+# GL006 — vocabulary drift
+# ---------------------------------------------------------------------------
+
+def test_gl006_true_positives(tree):
+    tree.write("auron_tpu/ops/x.py", """\
+        from auron_tpu.obs import trace
+        from auron_tpu.runtime import faults
+
+        def f():
+            trace.event("nonsense", "x.y")
+            faults.maybe_fail("bogus.site")
+            faults.fires("memmgr.deny", "bogus_kind")
+        """)
+    result = tree.analyze(rule_ids=["GL006"])
+    assert rules_of(result) == ["GL006"] * 3
+
+
+def test_gl006_true_negatives(tree):
+    tree.write("auron_tpu/ops/x.py", """\
+        from auron_tpu.obs import trace
+        from auron_tpu.runtime import faults
+
+        def f(cat):
+            trace.event("shuffle", "rss.flush")
+            trace.event(cat, "dynamic category is not judged")
+            faults.maybe_fail("rss.write")
+            faults.fires("memmgr.deny", "deny")
+        """)
+    result = tree.analyze(rule_ids=["GL006"])
+    assert result.violations == []
+
+
+# ---------------------------------------------------------------------------
+# GL007 — checkpoint coverage
+# ---------------------------------------------------------------------------
+
+def test_gl007_true_positive(tree):
+    tree.write("auron_tpu/ops/x.py", """\
+        def execute(self, partition, ctx):
+            out = []
+            for batch in self.child.execute(partition, ctx):
+                out.append(batch)
+            return out
+        """)
+    result = tree.analyze(rule_ids=["GL007"])
+    assert rules_of(result) == ["GL007"]
+
+
+def test_gl007_true_negatives(tree):
+    tree.write("auron_tpu/ops/x.py", """\
+        def execute(self, partition, ctx):
+            for batch in self.child.execute(partition, ctx):
+                ctx.checkpoint("x.drive")
+                yield batch
+
+        def other(self, items, ctx):
+            for i in items:       # not a child-stream drive loop
+                yield i
+        """)
+    result = tree.analyze(rule_ids=["GL007"])
+    assert result.violations == []
+
+
+# ---------------------------------------------------------------------------
+# GL008 — lock order
+# ---------------------------------------------------------------------------
+
+def test_gl008_cycle_detected(tree):
+    tree.write("auron_tpu/runtime/x.py", """\
+        import threading
+        _a_lock = threading.Lock()
+        _b_lock = threading.Lock()
+
+        def f1():
+            with _a_lock:
+                with _b_lock:
+                    pass
+
+        def f2():
+            with _b_lock:
+                with _a_lock:
+                    pass
+        """)
+    result = tree.analyze(rule_ids=["GL008"])
+    assert rules_of(result) == ["GL008"]
+    assert "_a_lock" in result.violations[0].message
+    assert "_b_lock" in result.violations[0].message
+
+
+def test_gl008_consistent_order_clean(tree):
+    tree.write("auron_tpu/runtime/x.py", """\
+        import threading
+        _a_lock = threading.Lock()
+        _b_lock = threading.Lock()
+
+        def f1():
+            with _a_lock:
+                with _b_lock:
+                    pass
+
+        def f2():
+            with _a_lock, _b_lock:
+                pass
+        """)
+    result = tree.analyze(rule_ids=["GL008"])
+    assert result.violations == []
+
+
+def test_gl008_same_attr_different_classes_distinct(tree):
+    # A._lock > B._lock in one method, B._lock > A._lock would cycle —
+    # but self._lock on two CLASSES are different nodes, so nesting
+    # self._lock inside another class's method is clean
+    tree.write("auron_tpu/runtime/x.py", """\
+        class A:
+            def f(self, b):
+                with self._lock:
+                    with b._other_lock:
+                        pass
+
+        class B:
+            def g(self, a):
+                with self._lock:
+                    pass
+        """)
+    result = tree.analyze(rule_ids=["GL008"])
+    assert result.violations == []
+
+
+def test_gl008_function_boundary_resets_held_set(tree):
+    # a def nested inside a with-block runs LATER: its body must not
+    # inherit the lexically-enclosing held set
+    tree.write("auron_tpu/runtime/x.py", """\
+        import threading
+        _a_lock = threading.Lock()
+        _b_lock = threading.Lock()
+
+        def outer():
+            with _a_lock:
+                def cb():
+                    with _b_lock:
+                        pass
+                return cb
+
+        def elsewhere():
+            with _b_lock:
+                with _a_lock:
+                    pass
+        """)
+    result = tree.analyze(rule_ids=["GL008"])
+    assert result.violations == []
+
+
+# ---------------------------------------------------------------------------
+# suppression grammar + annotations (GL000)
+# ---------------------------------------------------------------------------
+
+def test_suppression_with_reason_absorbs(tree):
+    tree.write("auron_tpu/runtime/x.py", """\
+        def f():
+            raise RuntimeError("x")   # graft: disable=GL004 -- legacy wire shim
+        """)
+    result = tree.analyze(rule_ids=["GL004"])
+    assert result.violations == []
+    assert result.suppressed == 1
+
+
+def test_suppression_without_reason_is_gl000(tree):
+    tree.write("auron_tpu/runtime/x.py", """\
+        def f():
+            raise RuntimeError("x")   # graft: disable=GL004
+        """)
+    result = tree.analyze(rule_ids=["GL004"])
+    rules = rules_of(result)
+    # the disable is VOID (GL000) and the violation still fires
+    assert sorted(rules) == ["GL000", "GL004"]
+
+
+def test_suppression_unknown_rule_is_gl000(tree):
+    tree.write("auron_tpu/runtime/x.py", """\
+        def f():
+            pass   # graft: disable=GL999 -- no such rule
+        """)
+    result = tree.analyze()
+    assert rules_of(result) == ["GL000"]
+
+
+def test_file_wide_suppression(tree):
+    tree.write("auron_tpu/runtime/x.py", """\
+        # graft: disable-file=GL004 -- generated protocol shim, raises mirror the wire
+        def f():
+            raise RuntimeError("a")
+
+        def g():
+            raise RuntimeError("b")
+        """)
+    result = tree.analyze(rule_ids=["GL004"])
+    assert result.violations == []
+    assert result.suppressed == 2
+
+
+def test_graft_in_string_literal_is_not_a_directive(tree):
+    tree.write("auron_tpu/runtime/x.py", '''\
+        DOC = "the grammar is '# graft: disable=GL001 -- reason'"
+
+        def f():
+            """Explains ``# graft: disable-file=GL004`` in prose."""
+            return DOC
+        ''')
+    result = tree.analyze()
+    assert result.violations == []
+    assert result.suppressed == 0
+
+
+def test_suppression_on_comment_line_above(tree):
+    """A directive on a standalone comment line directly above the
+    offending statement suppresses it — the same placement contract as
+    the positive annotations (long lines can't fit an inline tail)."""
+    tree.write("auron_tpu/runtime/x.py", """\
+        def f():
+            # graft: disable=GL004 -- wire shim raises mirror the peer's
+            # verdict verbatim (wrapped reason keeps the block contiguous)
+            raise RuntimeError("x")
+        """)
+    result = tree.analyze(rule_ids=["GL004"])
+    assert result.violations == []
+    assert result.suppressed == 1
+
+
+def test_suppression_inventory_and_used_counts(tree):
+    tree.write("auron_tpu/runtime/x.py", """\
+        def f():
+            raise RuntimeError("x")   # graft: disable=GL004 -- shim
+            return None   # graft: disable=GL001 -- nothing here fires
+        """)
+    result = tree.analyze(rule_ids=["GL001", "GL004"])
+    inv = {(d["rules"][0]): d["used"]
+           for d in result.suppression_inventory}
+    assert inv == {"GL004": 1, "GL001": 0}   # unused directive visible
+
+
+def test_gl000_not_suppressible(tree):
+    tree.write("auron_tpu/runtime/x.py", """\
+        def f():
+            pass   # graft: disable=GL000 -- trying to silence the meta rule
+        """)
+    result = tree.analyze()
+    assert [v.rule for v in result.violations] == ["GL000"]
+
+
+def test_annotation_without_reason_is_gl000(tree):
+    tree.write("auron_tpu/ops/x.py", """\
+        def build(kernel, programs):
+            # graft: donation-ok
+            return programs.jit(kernel, donate_argnums=(0,))
+        """)
+    result = tree.analyze(rule_ids=["GL002"])
+    # reasonless annotation is void: GL000 AND the GL002 still fires
+    assert sorted(rules_of(result)) == ["GL000", "GL002"]
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+def _two_violation_tree(tree):
+    tree.write("auron_tpu/runtime/x.py", """\
+        def f():
+            raise RuntimeError("a")
+
+        def g():
+            raise RuntimeError("b")
+        """)
+
+
+def test_baseline_round_trip(tree, tmp_path):
+    _two_violation_tree(tree)
+    result = tree.analyze(rule_ids=["GL004"])
+    assert len(result.violations) == 2
+    bl_path = str(tmp_path / "baseline.json")
+    core.save_baseline(bl_path, result.violations)
+    baseline = core.load_baseline(bl_path)
+    new, old, stale = core.apply_baseline(result.violations, baseline)
+    assert new == [] and len(old) == 2 and stale == []
+
+
+def test_baseline_new_violation_fails(tree, tmp_path):
+    _two_violation_tree(tree)
+    bl_path = str(tmp_path / "baseline.json")
+    core.save_baseline(bl_path,
+                       tree.analyze(rule_ids=["GL004"]).violations)
+    # grow the file by one more identical-context violation: the
+    # per-key count budget must NOT absorb it
+    with open(os.path.join(tree.root, "auron_tpu/runtime/x.py"),
+              "a") as f:
+        f.write('\n\ndef h():\n    raise RuntimeError("a")\n')
+    result = tree.analyze(rule_ids=["GL004"])
+    baseline = core.load_baseline(bl_path)
+    new, old, stale = core.apply_baseline(result.violations, baseline)
+    assert len(old) == 2 and len(new) == 1
+
+
+def test_baseline_survives_line_drift(tree, tmp_path):
+    _two_violation_tree(tree)
+    bl_path = str(tmp_path / "baseline.json")
+    core.save_baseline(bl_path,
+                       tree.analyze(rule_ids=["GL004"]).violations)
+    # prepend 5 lines: every lineno shifts, keys (context) do not
+    p = os.path.join(tree.root, "auron_tpu/runtime/x.py")
+    with open(p) as f:
+        src = f.read()
+    with open(p, "w") as f:
+        f.write("# pad\n" * 5 + src)
+    new, old, stale = core.apply_baseline(
+        tree.analyze(rule_ids=["GL004"]).violations,
+        core.load_baseline(bl_path))
+    assert new == [] and len(old) == 2
+
+
+def test_baseline_stale_entries_reported(tree, tmp_path):
+    _two_violation_tree(tree)
+    bl_path = str(tmp_path / "baseline.json")
+    core.save_baseline(bl_path,
+                       tree.analyze(rule_ids=["GL004"]).violations)
+    # fix one violation: its frozen entry goes stale
+    p = os.path.join(tree.root, "auron_tpu/runtime/x.py")
+    with open(p) as f:
+        src = f.read()
+    with open(p, "w") as f:
+        f.write(src.replace('raise RuntimeError("b")', "return 2"))
+    new, old, stale = core.apply_baseline(
+        tree.analyze(rule_ids=["GL004"]).violations,
+        core.load_baseline(bl_path))
+    assert new == [] and len(old) == 1
+    assert len(stale) == 1 and 'b' in stale[0]["context"]
+
+
+def test_baseline_partial_consumption_is_stale(tree, tmp_path):
+    """A key frozen at count N with some sites fixed must report its
+    LEFTOVER budget as stale — otherwise the residue silently
+    grandfathers future identical violations forever."""
+    tree.write("auron_tpu/runtime/x.py", """\
+        def f():
+            raise RuntimeError("a")
+
+        def g():
+            raise RuntimeError("a")
+        """)
+    bl_path = str(tmp_path / "baseline.json")
+    core.save_baseline(bl_path,
+                       tree.analyze(rule_ids=["GL004"]).violations)
+    # one identical-context key, count 2; fix ONE of the two sites
+    assert core.load_baseline(bl_path)["entries"][0]["count"] == 2
+    p = os.path.join(tree.root, "auron_tpu/runtime/x.py")
+    with open(p) as f:
+        src = f.read()
+    with open(p, "w") as f:
+        f.write(src.replace(
+            'def g():\n    raise RuntimeError("a")', "def g():\n    return 2"))
+    new, old, stale = core.apply_baseline(
+        tree.analyze(rule_ids=["GL004"]).violations,
+        core.load_baseline(bl_path))
+    assert new == [] and len(old) == 1
+    assert len(stale) == 1 and stale[0]["unmatched"] == 1
+
+
+def test_cli_update_baseline_refuses_rule_subset(tree, capsys):
+    _two_violation_tree(tree)
+    rc = cli.main([os.path.join(tree.root, "auron_tpu"),
+                   "--root", tree.root, "--rules", "GL007",
+                   "--update-baseline"])
+    assert rc == 2
+    assert "refusing" in capsys.readouterr().err
+
+
+def test_baseline_garbage_fails_loudly(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text('{"version": 99, "entries": "nope"}')
+    with pytest.raises(ValueError, match="not a graftlint baseline"):
+        core.load_baseline(str(p))
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_codes_and_json(tree, tmp_path, capsys):
+    _two_violation_tree(tree)
+    target = os.path.join(tree.root, "auron_tpu")
+    # violations, no baseline -> 1
+    assert cli.main([target, "--root", tree.root]) == 1
+    capsys.readouterr()
+    # freeze, then clean -> 0, and --json parses
+    bl = str(tmp_path / "bl.json")
+    assert cli.main([target, "--root", tree.root,
+                     "--update-baseline", "--baseline", bl]) == 0
+    capsys.readouterr()
+    assert cli.main([target, "--root", tree.root,
+                     "--baseline", bl, "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"] is True and report["grandfathered"] == 2
+    # garbage baseline -> 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{")
+    assert cli.main([target, "--root", tree.root,
+                     "--baseline", str(bad)]) == 2
+
+
+def test_one_parse_per_file_multiplexing(tree, monkeypatch):
+    """The framework parses each file once regardless of rule count."""
+    import ast as ast_mod
+    calls = []
+    real_parse = ast_mod.parse
+
+    def counting_parse(src, **kw):
+        if kw.get("filename", "").endswith(".py"):
+            calls.append(kw.get("filename"))
+        return real_parse(src, **kw)
+
+    monkeypatch.setattr(core.ast, "parse", counting_parse)
+    tree.write("auron_tpu/ops/x.py", "def f():\n    pass\n")
+    tree.write("auron_tpu/ops/y.py", "def g():\n    pass\n")
+    tree.analyze()   # all rules active
+    named = [c for c in calls if c and c.endswith((".py",))]
+    assert sorted(named) == ["auron_tpu/ops/x.py", "auron_tpu/ops/y.py"]

@@ -1,0 +1,205 @@
+"""Tier-1 lint gate: graftlint over the WHOLE tree must hold on HEAD.
+
+This is the test that makes every future PR pass under the contract
+checker: any new violation of GL001–GL008 that is not frozen in
+tools/lint_baseline.json fails here, with the rule's fix hint in the
+assertion message. Also proves the whole-tree run fits the wall-clock
+budget (< 30 s asserted — the analyzer parses each file once), that a
+seeded violation of EACH rule makes the CLI exit nonzero, and that the
+perf-gate smoke's lint arm fails loudly on a missing/stale baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+import time
+
+import pytest
+
+from auron_tpu.analysis import core
+from auron_tpu.analysis import __main__ as cli
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BASELINE = os.path.join(_REPO, "tools", "lint_baseline.json")
+
+#: whole-tree wall budget (seconds). Measured ~3 s on this container;
+#: 30 s is the documented ceiling the ISSUE pins.
+_BUDGET_S = 30.0
+
+
+def test_tree_clean_under_baseline_within_budget():
+    t0 = time.perf_counter()
+    report = core.run(baseline_path=_BASELINE)
+    wall = time.perf_counter() - t0
+    new = report["violations"]
+    assert not report["parse_errors"], report["parse_errors"]
+    assert not new, (
+        f"{len(new)} NEW contract violations (not in the baseline):\n"
+        + "\n".join(
+            f"  {v['file']}:{v['line']}: {v['rule']}: {v['message']}\n"
+            f"      fix: {v['hint']}" for v in new[:10]))
+    assert report["ok"] is True
+    # the analyzer really covered the tree (not a vacuous pass)
+    assert report["files_scanned"] > 100
+    assert wall < _BUDGET_S, (
+        f"whole-tree lint took {wall:.1f}s >= {_BUDGET_S}s budget")
+
+
+def test_cli_exits_zero_on_head():
+    assert cli.main(["--baseline", _BASELINE]) == 0
+
+
+#: one seed snippet per rule, each violating exactly that contract
+_SEEDS = {
+    "GL001": ("auron_tpu/ops/seed.py", """\
+        def f(batch):
+            return int(batch.num_rows)
+        """),
+    "GL002": ("auron_tpu/ops/seed.py", """\
+        def build(kernel, programs):
+            return programs.jit(kernel, donate_argnums=(0,))
+        """),
+    "GL003": ("auron_tpu/ops/seed.py", """\
+        def build_seed_kernel(conf, cfg):
+            return conf.get(cfg.BATCH_CAPACITY)
+        """),
+    "GL004": ("auron_tpu/runtime/seed.py", """\
+        def f():
+            raise RuntimeError("unclassified")
+        """),
+    "GL005": ("auron_tpu/runtime/seed.py", """\
+        def f(conf):
+            return conf.get("auron.seeded.unknown.knob")
+        """),
+    "GL006": ("auron_tpu/ops/seed.py", """\
+        from auron_tpu.obs import trace
+
+        def f():
+            trace.event("not.a.category", "x")
+        """),
+    "GL007": ("auron_tpu/ops/seed.py", """\
+        def execute(self, partition, ctx):
+            out = []
+            for b in self.child.execute(partition, ctx):
+                out.append(b)
+            return out
+        """),
+    "GL008": ("auron_tpu/runtime/seed.py", """\
+        import threading
+        _a_lock = threading.Lock()
+        _b_lock = threading.Lock()
+
+        def f1():
+            with _a_lock:
+                with _b_lock:
+                    pass
+
+        def f2():
+            with _b_lock:
+                with _a_lock:
+                    pass
+        """),
+}
+
+
+@pytest.mark.parametrize("rule_id", sorted(_SEEDS))
+def test_seeded_violation_fails_cli(rule_id, tmp_path, capsys):
+    """Acceptance: the CLI exits nonzero on a seeded violation of each
+    of the 8 rules, and names the rule."""
+    rel, src = _SEEDS[rule_id]
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    # a synced CONFIG.md so GL005 sees only the seeded drift
+    from auron_tpu import config
+    (tmp_path / "CONFIG.md").write_text(config.generate_docs())
+    rc = cli.main([str(tmp_path / "auron_tpu"),
+                   "--root", str(tmp_path), "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert rule_id in {v["rule"] for v in report["violations"]}, report
+
+
+# ---------------------------------------------------------------------------
+# perf-gate lint arm (tools/perf_gate.py --smoke)
+# ---------------------------------------------------------------------------
+
+def _perf_gate():
+    import importlib
+    import sys
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    return importlib.import_module("perf_gate")
+
+
+@pytest.fixture(scope="module")
+def lint_arm_head():
+    """One run_lint_gate() over HEAD shared by the arm tests (each
+    whole-tree analysis costs ~3 s; the failure modes below never reach
+    the analysis, so only this one pays it)."""
+    return _perf_gate().run_lint_gate()
+
+
+def test_perf_gate_lint_arm_passes_on_head(lint_arm_head):
+    out = lint_arm_head
+    assert out["lint_gate"] == "pass", out
+    assert out["lint_new"] == 0
+    assert out["lint_files"] > 100
+
+
+def test_perf_gate_lint_arm_fails_on_missing_baseline(monkeypatch,
+                                                      tmp_path):
+    pg = _perf_gate()
+    monkeypatch.setattr(core, "default_baseline_path",
+                        lambda root=None: str(tmp_path / "absent.json"))
+    out = pg.run_lint_gate()
+    assert out["lint_gate"] == "fail"
+    assert "missing" in out["lint_error"]
+
+
+def test_perf_gate_lint_arm_fails_on_garbage_baseline(monkeypatch,
+                                                      tmp_path):
+    pg = _perf_gate()
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"version": 1, "entries": [{"nope": true}]}')
+    monkeypatch.setattr(core, "default_baseline_path",
+                        lambda root=None: str(bad))
+    out = pg.run_lint_gate()
+    assert out["lint_gate"] == "fail"
+    assert "unreadable" in out["lint_error"]
+
+
+def test_perf_gate_lint_arm_fails_on_stale_baseline(monkeypatch,
+                                                    tmp_path):
+    """A baseline describing another world (over half its entries match
+    nothing) must fail, not pass vacuously."""
+    pg = _perf_gate()
+    ghost = {"version": 1, "entries": [
+        {"file": f"auron_tpu/ghost/g{i}.py", "rule": "GL001",
+         "context": f"int(ghost_{i})", "count": 1}
+        for i in range(8)]}
+    stale = tmp_path / "stale.json"
+    stale.write_text(json.dumps(ghost))
+    monkeypatch.setattr(core, "default_baseline_path",
+                        lambda root=None: str(stale))
+    # a canned clean analysis: the stale verdict is about the BASELINE
+    # not matching, and must not need (or pay for) a real tree run
+    monkeypatch.setattr(
+        core, "analyze",
+        lambda *a, **k: core.AnalysisResult([], 0, 139, []))
+    out = pg.run_lint_gate()
+    assert out["lint_gate"] == "fail"
+    assert "stale" in out["lint_error"]
+
+
+def test_baseline_checked_in_and_loadable():
+    """The frozen baseline ships with the tree and parses (the CI
+    gate's input; perf_gate fails loudly without it)."""
+    data = core.load_baseline(_BASELINE)
+    assert data["entries"], "baseline unexpectedly empty"
+    # every frozen entry names a file that still exists
+    missing = sorted({e["file"] for e in data["entries"]
+                      if not os.path.exists(
+                          os.path.join(_REPO, e["file"]))})
+    assert not missing, f"baseline references deleted files: {missing}"

@@ -1,0 +1,176 @@
+"""graftlint report: per-rule / per-package violation table, baseline
+health, and cross-run comparison — the house-report face of
+``python -m auron_tpu.analysis`` (ANALYSIS.md documents the rules).
+
+    python tools/lint_report.py                      # analyze HEAD
+    python tools/lint_report.py --json out.json      # + machine record
+    python tools/lint_report.py --compare old.json new.json
+
+A single run prints the rule×package table (baselined / suppressed /
+NEW columns), the suppression inventory (every '# graft: disable'
+carries its reason — this is where they are audited), and the stale-
+baseline list (fixed code whose frozen entries should be pruned).
+``--compare`` diffs two ``--json`` records: new rules firing, packages
+whose counts grew, and baseline shrinkage — the numbers a PR review
+quotes. The last stdout line of a single run is one JSON record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _package(rel: str) -> str:
+    parts = rel.split("/")
+    if parts[0] == "auron_tpu" and len(parts) > 2:
+        return "/".join(parts[:2])
+    return parts[0]
+
+
+def build_record(baseline_path=None) -> dict:
+    from auron_tpu.analysis import core
+    result = core.analyze()
+    record = result.to_json()
+    baseline_path = baseline_path or core.default_baseline_path()
+    new = result.violations
+    grandfathered: list = []
+    stale: list = []
+    if os.path.exists(baseline_path):
+        baseline = core.load_baseline(baseline_path)
+        new, grandfathered, stale = core.apply_baseline(
+            result.violations, baseline)
+    record["new"] = [v.to_json() for v in new]
+    record["grandfathered"] = [v.to_json() for v in grandfathered]
+    record["stale_baseline_entries"] = stale
+    record["baseline"] = baseline_path if os.path.exists(baseline_path) \
+        else None
+    # rule × package rollup
+    table: dict = {}
+    for kind, vs in (("baselined", record["grandfathered"]),
+                     ("new", record["new"])):
+        for v in vs:
+            ent = table.setdefault(
+                (v["rule"], _package(v["file"])),
+                {"baselined": 0, "new": 0})
+            ent[kind] += 1
+    record["table"] = [
+        {"rule": r, "package": p, **ent}
+        for (r, p), ent in sorted(table.items())]
+    return record
+
+
+def print_report(record: dict) -> None:
+    print("graftlint report")
+    print(f"  files scanned : {record['files_scanned']}")
+    print(f"  violations    : "
+          f"{len(record['grandfathered']) + len(record['new'])} "
+          f"({len(record['new'])} NEW, "
+          f"{len(record['grandfathered'])} baselined, "
+          f"{record['suppressed']} suppressed)")
+    if record["table"]:
+        print(f"\n  {'rule':<7} {'package':<22} {'baselined':>9} "
+              f"{'new':>5}")
+        for row in record["table"]:
+            print(f"  {row['rule']:<7} {row['package']:<22} "
+                  f"{row['baselined']:>9} {row['new']:>5}")
+    for v in record["new"]:
+        print(f"\n  NEW {v['file']}:{v['line']}: {v['rule']}: "
+              f"{v['message']}")
+    inventory = record.get("suppression_inventory", [])
+    if inventory:
+        print(f"\n  suppression inventory ({len(inventory)} directives "
+              f"— every disable carries its reason; used=0 suppresses "
+              f"nothing and deserves a look):")
+        for d in inventory:
+            mark = "" if d["used"] else "  <-- UNUSED"
+            print(f"    {d['file']}:{d['line']} "
+                  f"[{','.join(d['rules'])}] used={d['used']} — "
+                  f"{d['reason'][:60]}{mark}")
+    stale = record["stale_baseline_entries"]
+    if stale:
+        print(f"\n  stale baseline entries ({len(stale)} — fixed code; "
+              f"prune with --update-baseline):")
+        for e in stale[:20]:
+            print(f"    {e['file']} [{e['rule']}] "
+                  f"unmatched={e.get('unmatched', '?')} "
+                  f"{e['context'][:60]}")
+        if len(stale) > 20:
+            print(f"    ... and {len(stale) - 20} more")
+    if record.get("parse_errors"):
+        for rel, msg in record["parse_errors"]:
+            print(f"  PARSE ERROR {rel}: {msg}")
+
+
+def compare(old: dict, new: dict) -> int:
+    """Diff two --json records; nonzero when the candidate regressed
+    (new violations appeared, or a package's baselined count grew)."""
+    def totals(rec):
+        out: dict = {}
+        for row in rec.get("table", ()):
+            key = (row["rule"], row["package"])
+            out[key] = row["baselined"] + row["new"]
+        return out
+
+    o, n = totals(old), totals(new)
+    regressed = False
+    print(f"{'rule':<7} {'package':<22} {'old':>6} {'new':>6} {'Δ':>6}")
+    for key in sorted(set(o) | set(n)):
+        ov, nv = o.get(key, 0), n.get(key, 0)
+        if ov == nv == 0:
+            continue
+        mark = ""
+        if nv > ov:
+            mark = "  <-- GREW"
+            regressed = True
+        print(f"{key[0]:<7} {key[1]:<22} {ov:>6} {nv:>6} "
+              f"{nv - ov:>+6}{mark}")
+    new_count = len(new.get("new", ()))
+    if new_count:
+        print(f"\ncandidate has {new_count} NEW (unbaselined) violations")
+        regressed = True
+    shrunk = len(old.get("grandfathered", ())) \
+        - len(new.get("grandfathered", ()))
+    if shrunk > 0:
+        print(f"\nbaseline debt shrank by {shrunk} (good)")
+    return 1 if regressed else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default tools/lint_baseline.json)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the machine record to this path")
+    ap.add_argument("--compare", nargs=2, metavar=("OLD", "NEW"),
+                    help="diff two --json records instead of analyzing")
+    args = ap.parse_args(argv)
+
+    if args.compare:
+        with open(args.compare[0]) as f:
+            old = json.load(f)
+        with open(args.compare[1]) as f:
+            new = json.load(f)
+        return compare(old, new)
+
+    record = build_record(args.baseline)
+    print_report(record)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(record, f, indent=1)
+    print(json.dumps({
+        "files_scanned": record["files_scanned"],
+        "new": len(record["new"]),
+        "baselined": len(record["grandfathered"]),
+        "suppressed": record["suppressed"],
+        "stale": len(record["stale_baseline_entries"]),
+    }))
+    return 0 if not record["new"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

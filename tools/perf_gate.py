@@ -349,6 +349,55 @@ def run_ops_gate(tables) -> dict:
     return out
 
 
+def run_lint_gate() -> dict:
+    """graftlint arm of the smoke gate: the contract checker
+    (auron_tpu/analysis, ANALYSIS.md) must hold on HEAD. Fails LOUDLY
+    when the baseline file is missing or unparseable (a deleted/garbage
+    baseline would otherwise let every frozen violation pass as 'new
+    code clean'), when baseline entries have gone stale en masse (the
+    file no longer describes this tree), or when unbaselined
+    violations/parse errors exist. Returns
+    ``{"lint_gate": "pass"|"fail", "lint_new": n, ...}``."""
+    from auron_tpu.analysis import core
+    path = core.default_baseline_path()
+    if not os.path.exists(path):
+        return {"lint_gate": "fail", "lint_new": -1,
+                "lint_error": f"lint baseline missing: {path} — run "
+                              f"python -m auron_tpu.analysis "
+                              f"--update-baseline"}
+    try:
+        baseline = core.load_baseline(path)
+    except (ValueError, json.JSONDecodeError, OSError) as e:
+        return {"lint_gate": "fail", "lint_new": -1,
+                "lint_error": f"lint baseline unreadable: {e}"}
+    result = core.analyze()
+    new, old, stale = core.apply_baseline(result.violations, baseline)
+    out = {"lint_gate": "pass", "lint_new": len(new),
+           "lint_baselined": len(old), "lint_stale": len(stale),
+           "lint_suppressed": result.suppressed,
+           "lint_files": result.files_scanned}
+    entries = len(baseline.get("entries", ()))
+    if result.parse_errors:
+        out["lint_gate"] = "fail"
+        out["lint_error"] = (f"{len(result.parse_errors)} files failed "
+                             f"to parse: {result.parse_errors[0]}")
+    elif new:
+        out["lint_gate"] = "fail"
+        v = new[0]
+        out["lint_error"] = (f"{len(new)} unbaselined violations, "
+                             f"first: {v.file}:{v.line} {v.rule} "
+                             f"{v.message}")
+    elif entries and len(stale) * 2 > entries:
+        # over half the frozen entries match nothing in this tree: the
+        # baseline is from another world (mass rename/refactor) and
+        # 'pass' would be vacuous — regenerate it deliberately
+        out["lint_gate"] = "fail"
+        out["lint_error"] = (f"lint baseline is stale: {len(stale)} of "
+                             f"{entries} entries match nothing — "
+                             f"regenerate with --update-baseline")
+    return out
+
+
 def run_smoke(baseline: dict) -> dict:
     """Tier-1-fast smoke arm: run the q01 operator pipeline in-process
     at a tiny scale and compare against the generous smoke floor — an
@@ -464,6 +513,15 @@ def run_smoke(baseline: dict) -> dict:
             verdict["perf_gate"] = "fail"
             verdict["reason"] = (
                 f"ops-plane gate: {verdict.get('ops_error', 'failed')}")
+        # lint arm: the AST contract checker must hold on HEAD (a
+        # missing/stale tools/lint_baseline.json fails loudly — decay
+        # of the invariant surface can't hide between rounds either)
+        verdict.update(run_lint_gate())
+        if verdict["lint_gate"] != "pass" \
+                and verdict["perf_gate"] == "pass":
+            verdict["perf_gate"] = "fail"
+            verdict["reason"] = (
+                f"lint gate: {verdict.get('lint_error', 'failed')}")
         return verdict
     finally:
         import shutil
@@ -500,7 +558,8 @@ def main(argv=None) -> int:
               f"{verdict['sched_tax_pct']:.3f}% (limit "
               f"{verdict['sched_tax_limit_pct']:.0f}%), journal "
               f"overhead {verdict['journal_overhead_pct']:.3f}% (limit "
-              f"{verdict['journal_overhead_limit_pct']:.0f}%) → "
+              f"{verdict['journal_overhead_limit_pct']:.0f}%), lint "
+              f"{verdict.get('lint_new', '?')} new → "
               f"{verdict['perf_gate'].upper()}")
         print(json.dumps(verdict))
         return 0 if verdict["perf_gate"] == "pass" else 1
