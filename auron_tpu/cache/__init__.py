@@ -1,0 +1,27 @@
+"""Warm-path serving plane: plan-fingerprint result/subplan cache + AOT.
+
+Two planes, both keyed on the crash-tested journal identity machinery
+(``runtime/journal.py``: ``plan_fingerprint`` / ``source_fingerprints``):
+
+- ``result_cache``: a process-wide LRU of materialized Arrow results
+  (and broadcast-subplan relations) keyed on
+  ``(plan_fingerprint, source_fingerprints, trace_salt)``. Exact
+  re-submissions are answered from host memory instead of silicon;
+  source mutation or a semantics-knob flip changes the key, so stale
+  data can never be served. Entries are memmgr-registered sheddable
+  consumers — the pressure ladder evicts them (rung ``cache_evict``)
+  before any working state is force-spilled.
+- ``aot``: the ahead-of-time program plane. ``Session`` records plan
+  signatures next to the persistent XLA cache (``auron.xla_cache_dir``)
+  and, at startup, warms the top-N signatures through the normal
+  planner/executor path so compiles land in the central program
+  registry and the persistent XLA cache before the first user query.
+
+``identity`` holds the ONE implementation of "is this recorded state
+the same query over the same data" — shared by journal adoption
+(``find_reusable``) and cache lookup, so the two can never drift.
+
+Knobs: ``auron.cache.{enabled,max_bytes,subplan,aot_top_n}``.
+"""
+
+from auron_tpu.cache.result_cache import get_cache  # noqa: F401

@@ -1,0 +1,118 @@
+"""Shared query-identity screening: ONE implementation of "is this
+recorded state the same query over the same data".
+
+Both consumers of the journal's fingerprint machinery go through here:
+
+- journal adoption (``runtime/journal.py find_reusable``): screen a
+  candidate journal's header, then the loaded journal, then its
+  recorded source fingerprints against the live catalog;
+- the warm-path result cache (``cache/result_cache.py``): build a
+  lookup key whose components are exactly the things that must match
+  for a cached result to be byte-correct — the plan fingerprint, the
+  live source fingerprints, and the process trace salt (the semantics
+  knobs that change query OUTPUT, ``config.trace_salt()``).
+
+Keeping both on one module means a screening bug (or a new component
+of query identity) is fixed in one place for journal reuse AND the
+cache — they can never drift apart and disagree about staleness.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from auron_tpu.runtime.journal import (
+    _owner_is_other_live_process,
+    plan_fingerprint,
+    plan_has_host_fns,
+    source_fingerprints,
+)
+
+#: sentinel value source_fingerprints records for an unreadable source;
+#: any key containing it is uncacheable (identity can't be established)
+MISSING = "missing:"
+
+
+def screen_header(header: Optional[dict], plan_fp: str,
+                  scope: str = "collect") -> bool:
+    """Cheap pre-load screen of a journal header dict (``_peek_header``):
+    True when the candidate MAY be the same query — same plan
+    fingerprint, same scope, and not owned by another live process."""
+    if header is None:
+        return False
+    if header.get("plan_fp") != plan_fp:
+        return False
+    if header.get("scope", "collect") != scope:
+        return False
+    if _owner_is_other_live_process(header.get("owner", "")):
+        return False
+    return True
+
+
+def screen_loaded(jr: Any, plan_fp: str, scope: str = "collect") -> bool:
+    """Authoritative post-load re-screen of a loaded journal object
+    (the header screen raced against concurrent writers; this one reads
+    the parsed journal)."""
+    if jr.plan_fp != plan_fp:
+        return False
+    if jr.scope != scope:
+        return False
+    if _owner_is_other_live_process(getattr(jr, "owner", "")):
+        return False
+    return True
+
+
+class SourceProbe:
+    """Lazily-computed live source fingerprints for one plan.
+
+    ``fingerprints()`` walks the plan's sources (file stat / table
+    digest) ONCE and memoizes — ``find_reusable`` probes many journal
+    candidates against one submission, and the cache key needs the same
+    map, so the walk must not repeat per candidate."""
+
+    def __init__(self, plan_bytes: bytes, catalog: Optional[dict]):
+        self._plan_bytes = plan_bytes
+        self._catalog = catalog
+        self._fps: Optional[dict] = None
+
+    def fingerprints(self) -> dict:
+        if self._fps is None:
+            self._fps = source_fingerprints(self._plan_bytes, self._catalog)
+        return self._fps
+
+    def matches(self, recorded: dict) -> bool:
+        """True when ``recorded`` (a journal's ``sources`` map) is
+        byte-for-byte the live state of every source."""
+        return recorded == self.fingerprints()
+
+    def any_missing(self) -> bool:
+        return any(v == MISSING for v in self.fingerprints().values())
+
+
+def cacheable(plan_bytes: bytes) -> bool:
+    """A plan is cacheable when its identity is fully capturable: no
+    host-fn sources (their output is process-local and re-registered
+    per execution, so no durable fingerprint exists)."""
+    return not plan_has_host_fns(plan_bytes)
+
+
+def result_key(plan_bytes: bytes, catalog: Optional[dict],
+               scope: str = "collect", partition: int = -1):
+    """The full cache key for one materialized result, or None when the
+    plan's identity cannot be established (host fns, unreadable
+    sources).
+
+    Components mirror the journal's reuse screen exactly:
+    ``(plan_fp, source_fps, trace_salt, scope, partition)`` — source
+    fingerprints IN the key make invalidation automatic (a mutated
+    source produces a different key, so the stale entry is simply
+    never hit again and ages out of the LRU)."""
+    if not cacheable(plan_bytes):
+        return None
+    probe = SourceProbe(plan_bytes, catalog)
+    if probe.any_missing():
+        return None
+    from auron_tpu import config as cfg
+    return (plan_fingerprint(plan_bytes),
+            frozenset(probe.fingerprints().items()),
+            cfg.trace_salt(), scope, int(partition))

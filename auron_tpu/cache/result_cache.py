@@ -1,0 +1,317 @@
+"""Process-wide warm-path result/subplan cache.
+
+One LRU holds two planes of entries, both keyed by
+``cache/identity.py`` (plan fingerprint + source fingerprints +
+trace salt, so staleness is structurally impossible — a mutated source
+or flipped semantics knob produces a DIFFERENT key):
+
+- ``result`` plane: materialized ``pyarrow.Table`` query results, hit
+  on exact re-submission (Session collect scope, serving task scope);
+- ``subplan`` plane: materialized broadcast relations — the host-side
+  entry list a ``BroadcastExchangeOp`` replays — shared across queries
+  whose plans differ but whose broadcast subtree is identical.
+
+Memory discipline: the cache is a memmgr-registered consumer
+(``pressure_evictable = True``). Under pressure the ladder's
+``cache_evict`` rung (memmgr/manager.py) calls ``spill()`` — cached
+results are pure derived state, re-creatable at the cost of one query,
+so they are ALWAYS evicted before any working state is force-spilled.
+Capacity (``auron.cache.max_bytes``) evicts LRU-first on insert.
+
+Lock order (GL008): ``_lock`` guards the OrderedDict + counters and is
+leaf-level — no memmgr call is ever made while holding it; manager
+accounting (``update_mem_used``) happens strictly after release.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, NamedTuple, Optional
+
+
+class _Entry(NamedTuple):
+    value: Any
+    nbytes: int
+    plane: str          # "result" | "subplan"
+
+
+class _Settings(NamedTuple):
+    enabled: bool
+    max_bytes: int
+    subplan: bool
+
+
+def _table_nbytes(tbl) -> int:
+    try:
+        return int(tbl.nbytes)
+    except Exception:   # older pyarrow: no Table.nbytes
+        return int(tbl.get_total_buffer_size())
+
+
+class QueryResultCache:
+    """The process-wide cache instance (use the ``get_cache()``
+    singleton — per-Session instances would defeat cross-session
+    sharing and double-register with the memmgr)."""
+
+    # memmgr consumer protocol
+    consumer_name = "result_cache"
+    spill_thread_safe = True    # evictable from any thread's pressure walk
+    #: ladder marker: the cache_evict rung (memmgr/manager.py
+    #: _pressure_ladder) targets consumers holding re-creatable derived
+    #: state — evict these BEFORE force-spilling working state
+    pressure_evictable = True
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mgr_lock = threading.Lock()
+        self._entries: "OrderedDict[Any, _Entry]" = OrderedDict()
+        self._bytes = 0
+        self._managers: dict = {}   # MemManager -> attach refcount
+        # monotonic counters (under _lock)
+        self._hits = 0
+        self._misses = 0
+        self._inserts = 0
+        self._evictions = 0
+        self._pressure_evictions = 0
+        self._subplan_hits = 0
+        self._subplan_misses = 0
+        # config-epoch-cached settings
+        self._settings_epoch = -1
+        self._settings = _Settings(False, 0, True)
+
+    # -- settings -----------------------------------------------------------
+
+    def _resolve_settings(self) -> _Settings:
+        from auron_tpu import config as cfg
+        epoch = cfg.config_epoch()
+        if epoch != self._settings_epoch:
+            conf = cfg.get_config()
+            self._settings = _Settings(
+                bool(conf.get(cfg.CACHE_ENABLED)),
+                int(conf.get(cfg.CACHE_MAX_BYTES)),
+                bool(conf.get(cfg.CACHE_SUBPLAN)))
+            self._settings_epoch = epoch
+        return self._settings
+
+    def enabled(self) -> bool:
+        return self._resolve_settings().enabled
+
+    def subplan_enabled(self) -> bool:
+        s = self._resolve_settings()
+        return s.enabled and s.subplan
+
+    # -- key construction (identity lives in cache/identity.py) -------------
+
+    def result_key(self, plan_bytes: bytes, catalog: Optional[dict],
+                   scope: str = "collect", partition: int = -1):
+        """Lookup key for a full result, or None when caching is off or
+        the plan's identity cannot be established."""
+        if not self.enabled():
+            return None
+        from auron_tpu.cache import identity
+        return identity.result_key(plan_bytes, catalog, scope, partition)
+
+    def subplan_cache_key(self, subtree_bytes: bytes,
+                          catalog: Optional[dict],
+                          input_partitions: int = 1):
+        """Key for a materialized subplan output. ``input_partitions``
+        is folded in: the collected entry LIST depends on the input
+        fan-out, and replay order must be bit-stable."""
+        if not self.subplan_enabled():
+            return None
+        from auron_tpu.cache import identity
+        return identity.result_key(subtree_bytes, catalog,
+                                   scope="subplan",
+                                   partition=input_partitions)
+
+    # -- lookups / inserts --------------------------------------------------
+
+    def get_result(self, key):
+        """Cached ``pyarrow.Table`` for ``key``, or None (miss)."""
+        return self._get(key, "result")
+
+    def put_result(self, key, table) -> bool:
+        return self._put(key, table, _table_nbytes(table), "result")
+
+    def get_subplan(self, key):
+        """Cached broadcast entry list for ``key``, or None."""
+        return self._get(key, "subplan")
+
+    def put_subplan(self, key, entries, nbytes: int) -> bool:
+        return self._put(key, entries, int(nbytes), "subplan")
+
+    def _get(self, key, plane: str):
+        from auron_tpu.obs import trace
+        if key is None:
+            return None
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None and ent.plane == plane:
+                self._entries.move_to_end(key)
+                if plane == "subplan":
+                    self._subplan_hits += 1
+                else:
+                    self._hits += 1
+                value = ent.value
+            else:
+                if plane == "subplan":
+                    self._subplan_misses += 1
+                else:
+                    self._misses += 1
+                value = None
+        trace.event("cache", "cache.hit" if value is not None
+                    else "cache.miss", plane=plane, plan_fp=key[0])
+        return value
+
+    def _put(self, key, value, nbytes: int, plane: str) -> bool:
+        from auron_tpu.obs import trace
+        if key is None:
+            return False
+        s = self._resolve_settings()
+        if not s.enabled or nbytes > s.max_bytes:
+            return False
+        evicted = 0
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            while self._entries and self._bytes + nbytes > s.max_bytes:
+                _, dropped = self._entries.popitem(last=False)
+                self._bytes -= dropped.nbytes
+                evicted += 1
+            self._entries[key] = _Entry(value, nbytes, plane)
+            self._bytes += nbytes
+            self._inserts += 1
+            self._evictions += evicted
+        trace.event("cache", "cache.store", plane=plane, plan_fp=key[0],
+                    nbytes=nbytes, evicted=evicted)
+        self._update_managers()
+        return True
+
+    # -- memmgr consumer protocol -------------------------------------------
+
+    def mem_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def spill(self) -> int:
+        """Pressure eviction: drop EVERYTHING (LRU order is moot — the
+        whole cache is derived state and the ladder only calls this when
+        working state would otherwise be force-spilled). Returns bytes
+        freed. Does NOT call back into manager accounting: the invoking
+        ladder adjusts its own ledger from the return value, and a
+        re-entrant ``update_mem_used`` here could recurse into another
+        pressure walk mid-eviction."""
+        from auron_tpu.obs import trace
+        with self._lock:
+            freed, dropped = self._bytes, len(self._entries)
+            self._entries.clear()
+            self._bytes = 0
+            self._evictions += dropped
+            self._pressure_evictions += dropped
+        if dropped:
+            trace.event("cache", "cache.evict", reason="pressure",
+                        entries=dropped, freed=freed)
+        return freed
+
+    def shrink(self) -> int:
+        """Advisory trim (ladder rung 1): drop the LRU half."""
+        from auron_tpu.obs import trace
+        freed = dropped = 0
+        with self._lock:
+            for _ in range(len(self._entries) // 2):
+                _, ent = self._entries.popitem(last=False)
+                self._bytes -= ent.nbytes
+                freed += ent.nbytes
+                dropped += 1
+            self._evictions += dropped
+            self._pressure_evictions += dropped
+        if dropped:
+            trace.event("cache", "cache.evict", reason="shrink",
+                        entries=dropped, freed=freed)
+        return freed
+
+    # -- manager attachment (Session init/close, refcounted) ----------------
+
+    def attach(self, mem_manager) -> bool:
+        """Register with ``mem_manager`` (first attach per manager).
+        No-op (False) when caching is disabled or there is no manager —
+        the consumer ledger must stay untouched for cache-off runs."""
+        if mem_manager is None or not self.enabled():
+            return False
+        with self._mgr_lock:
+            n = self._managers.get(mem_manager, 0)
+            self._managers[mem_manager] = n + 1
+            first = n == 0
+        if first:
+            mem_manager.register_consumer(self)
+            self._account(mem_manager)
+        return True
+
+    def detach(self, mem_manager) -> None:
+        if mem_manager is None:
+            return
+        with self._mgr_lock:
+            n = self._managers.get(mem_manager)
+            if n is None:
+                return
+            if n <= 1:
+                del self._managers[mem_manager]
+                last = True
+            else:
+                self._managers[mem_manager] = n - 1
+                last = False
+        if last:
+            mem_manager.unregister_consumer(self)
+
+    def _account(self, manager) -> None:
+        try:
+            manager.update_mem_used(self, self.mem_used())
+        except Exception:
+            # an over-budget manager may deny the grant (MemoryExhausted
+            # under the shed policy): a cache insert must never kill the
+            # query that performed it — drop the cache instead
+            self.spill()
+
+    def _update_managers(self) -> None:
+        with self._mgr_lock:
+            managers = list(self._managers)
+        for m in managers:
+            self._account(m)
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        enabled = self.enabled()
+        with self._lock:
+            return {
+                "enabled": enabled,
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "hits": self._hits,
+                "misses": self._misses,
+                "inserts": self._inserts,
+                "evictions": self._evictions,
+                "pressure_evictions": self._pressure_evictions,
+                "subplan_hits": self._subplan_hits,
+                "subplan_misses": self._subplan_misses,
+            }
+
+    def clear(self, reset_counters: bool = False) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            if reset_counters:
+                self._hits = self._misses = self._inserts = 0
+                self._evictions = self._pressure_evictions = 0
+                self._subplan_hits = self._subplan_misses = 0
+        self._update_managers()
+
+
+_CACHE = QueryResultCache()
+
+
+def get_cache() -> QueryResultCache:
+    """The process-wide cache singleton."""
+    return _CACHE

@@ -308,6 +308,42 @@ XLA_CACHE_DIR = _opt(
     "cache across processes is the first step of the compile-budget "
     "diet; empty (the default) leaves the cache off.")
 
+# warm-path serving plane (auron_tpu/cache): result/subplan cache + AOT
+CACHE_ENABLED = _opt(
+    "auron.cache.enabled", bool, False,
+    "Master switch for the warm-path result/subplan cache "
+    "(cache/result_cache.py). When on, exact re-submissions — same "
+    "plan fingerprint, same source fingerprints, same trace salt "
+    "(cache/identity.py, the journal's crash-tested identity) — are "
+    "answered from a process-wide LRU of materialized Arrow results "
+    "instead of re-executing; serving marks such answers with "
+    "cache_hit and served_from=cache. Off by default: caching trades "
+    "memory for latency and dashboards must opt in.")
+CACHE_MAX_BYTES = _opt(
+    "auron.cache.max_bytes", int, 256 << 20,
+    "Capacity of the warm-path cache in bytes (LRU eviction on "
+    "insert). Independent of auron.memmgr.*: the cache additionally "
+    "registers as a sheddable memmgr consumer, so global pressure "
+    "evicts it (ladder rung cache_evict) before any working state is "
+    "force-spilled, whatever this cap says.")
+CACHE_SUBPLAN = _opt(
+    "auron.cache.subplan", bool, True,
+    "Cache materialized SUBPLAN outputs (broadcast relations keyed by "
+    "per-node fingerprints computed at planning time) in addition to "
+    "full results, so queries that differ in their outer plan but "
+    "share a broadcast subtree reuse the built relation. Only "
+    "meaningful while auron.cache.enabled is on.")
+CACHE_AOT_TOP_N = _opt(
+    "auron.cache.aot_top_n", int, 0,
+    "Ahead-of-time warming at Session init (cache/aot.py): execute the "
+    "top-N plan signatures by submission count from the aot_plans "
+    "inventory (recorded next to auron.xla_cache_dir) and resumable "
+    "journals, driving their compiles through the central program "
+    "registry and the persistent XLA cache before the first user "
+    "query. 0 (the default) disables; the warmer never raises — "
+    "failures surface in cache/aot.last_stats() and fail the "
+    "perf_gate cache arm.")
+
 # failure recovery
 TASK_MAX_RETRIES = _opt(
     "auron.task.max_retries", int, 2,
@@ -528,8 +564,8 @@ TRACE_DIR = _opt(
 TRACE_EVENTS = _opt(
     "auron.trace.events", str, "",
     "Comma-separated span-category allowlist (query, task, program, "
-    "shuffle, spill, fault, watchdog, memory, sched, mesh, journal); "
-    "empty records every category. "
+    "shuffle, spill, fault, watchdog, memory, sched, mesh, journal, "
+    "cache); empty records every category. "
     "Narrowing the list bounds tracing overhead on hot paths — e.g. "
     "'task,shuffle,fault' drops the per-hit program events.")
 TRACE_MAX_SPANS = _opt(

@@ -98,6 +98,17 @@ class Session:
             # journals of dead processes are KEPT — they are the
             # resume inventory)
             _jrn.sweep_orphans(_jrn.journal_dir(self.config))
+        #: warm-path serving plane (auron_tpu/cache): register the
+        #: process-wide result cache as a sheddable consumer on this
+        #: Session's manager (refcounted — detached in close(), so the
+        #: consumer ledger stays balanced), then run the AOT warmer
+        #: (auron.cache.aot_top_n; a no-op at the default 0, NEVER
+        #: raises — a corrupt inventory must not fail construction)
+        from auron_tpu.cache import aot as _aot
+        from auron_tpu.cache import result_cache as _rcache
+        self._result_cache = _rcache.get_cache()
+        self._cache_attached = self._result_cache.attach(mem_manager)
+        _aot.warm(self)
         #: ops plane (obs/ops_server.py): acquire the process's live
         #: telemetry endpoint when auron.ops.enabled — refcounted, so
         #: several Sessions share one server and the LAST close stops
@@ -267,15 +278,17 @@ class Session:
         from auron_tpu.obs import registry as _registry
         from auron_tpu.runtime import lifecycle
         t0 = _time.monotonic()
+        token = self._begin_query(timeout_s)
 
         def observe(exc) -> None:
             try:
-                _registry.observe_query(_time.monotonic() - t0,
-                                        _registry.classify_outcome(exc))
+                _registry.observe_query(
+                    _time.monotonic() - t0,
+                    _registry.classify_outcome(exc),
+                    served_from=getattr(token, "served_from", None))
             except Exception:   # pragma: no cover - telemetry only
                 pass
 
-        token = self._begin_query(timeout_s)
         # admission BEFORE any planning/execution work: a shed query
         # costs nothing (AdmissionRejected / the token's own classified
         # error when cancelled while queued)
@@ -379,6 +392,12 @@ class Session:
             except Exception:   # pragma: no cover - cleanup best-effort
                 pass
         self._journals = []
+        # balance the warm-path cache's consumer registration (the
+        # cache itself is process-wide and keeps its entries; only this
+        # Session's memmgr attachment ends)
+        if self._cache_attached:
+            self._result_cache.detach(self.mem_manager)
+            self._cache_attached = False
         # ops endpoint: drop this Session's acquisition — the LAST
         # release stops the server (clean shutdown, no dangling port)
         if self._ops is not None:
@@ -414,7 +433,20 @@ class Session:
         # outermost scope exports into auron.trace.dir when set
         with self._admitted_query(timeout_s) as token:
             with trace.query_scope(label=f"p{df.num_partitions}"):
-                jr = self._journal_begin(df, token)
+                # warm-path lookup BEFORE journal/plan work: an exact
+                # re-submission (same plan fp + source fps + trace
+                # salt — cache/identity.py) is answered from the
+                # process cache; the key embeds the live source
+                # fingerprints, so a mutated source simply misses
+                pb_bytes = df.task_bytes()
+                cache_key = self._result_cache.result_key(
+                    pb_bytes, self.ctx.catalog)
+                if cache_key is not None:
+                    cached = self._result_cache.get_result(cache_key)
+                    if cached is not None:
+                        token.served_from = "cache"
+                        return cached
+                jr = self._journal_begin(df, token, plan_bytes=pb_bytes)
                 try:
                     op = self.plan_physical(df)
                     # with bundles armed, mirror task metrics onto a
@@ -444,6 +476,11 @@ class Session:
                 if jr is not None:
                     jr.complete(write_report=True)
                     self._journal_discard(jr)
+                if cache_key is not None:
+                    self._result_cache.put_result(cache_key, table)
+                from auron_tpu.cache import aot as _aot
+                _aot.record_plan(pb_bytes, self.ctx.catalog,
+                                 df.num_partitions, self.config)
                 return table
 
     def _journal_discard(self, jr) -> None:
@@ -457,14 +494,18 @@ class Session:
         except ValueError:
             pass
 
-    def _journal_begin(self, df: DataFrame, token):
+    def _journal_begin(self, df: DataFrame, token, plan_bytes=None):
         """Open (adopt or mint) the crash-safe journal for one
         top-level query; None when journaling is disarmed or this plan
-        opted out (runtime/journal.begin)."""
+        opted out (runtime/journal.begin). ``plan_bytes`` lets the
+        caller reuse an already-serialized plan (execute() serializes
+        once for the cache key and the journal)."""
         from auron_tpu.runtime import journal as jrn
         if not jrn.enabled(self.config):
             return None
-        jr = jrn.begin(token, df.task_bytes(), df.num_partitions,
+        if plan_bytes is None:
+            plan_bytes = df.task_bytes()
+        jr = jrn.begin(token, plan_bytes, df.num_partitions,
                        self.ctx.catalog, self.config)
         if jr is not None:
             self._journals.append(jr)
@@ -550,6 +591,15 @@ class Session:
                       f"hits={snap.hits} hit_rate="
                       f"{(snap.hits / total * 100.0) if total else 0.0:.1f}%"
                       f" (query {token.query_id})\n")
+            # warm-path result cache: PROCESS totals (the cache is
+            # shared across sessions/queries by design — explain runs
+            # fresh for the metric tree, so its own lookup is not in
+            # these numbers)
+            rc = self._result_cache.stats()
+            footer += (f"[result cache] enabled={rc['enabled']} "
+                       f"hits={rc['hits']} misses={rc['misses']} "
+                       f"evictions={rc['evictions']} "
+                       f"entries={rc['entries']} bytes={rc['bytes']}\n")
             return mt.render(tree) + footer
 
         # nested (a host fn analyzing mid-query): inherit the enclosing

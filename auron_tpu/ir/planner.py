@@ -503,8 +503,25 @@ class PhysicalPlanner:
 
     def _plan_broadcast_exchange(self, n: pb.BroadcastExchangeNode) -> PhysicalOp:
         from auron_tpu.parallel.exchange import BroadcastExchangeOp
+        # warm-path subplan identity (auron.cache.subplan): fingerprint
+        # the broadcast SUBTREE as its own plan — same identity
+        # components as a full result (cache/identity.py), with the
+        # input fan-out folded in — so successive/concurrent queries
+        # whose outer plans differ still share the built relation
+        subplan_key = None
+        try:
+            from auron_tpu.cache import result_cache as _rcache
+            cache = _rcache.get_cache()
+            if cache.subplan_enabled():
+                subplan_key = cache.subplan_cache_key(
+                    pb.TaskDefinition(plan=n.child).SerializeToString(),
+                    self.ctx.catalog,
+                    input_partitions=n.input_partitions or 1)
+        except Exception:   # planning must survive a cache-plane bug
+            subplan_key = None
         op = BroadcastExchangeOp(self.create_plan(n.child),
-                                 input_partitions=n.input_partitions or 1)
+                                 input_partitions=n.input_partitions or 1,
+                                 subplan_key=subplan_key)
         if n.output_resource_id:
             self.ctx.put_resource(n.output_resource_id, op)
         return op

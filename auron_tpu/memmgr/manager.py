@@ -149,8 +149,8 @@ class MemManager:
         #: consecutive comfortable grants (under half budget) since the
         #: last pressure event — the shrink-level decay hysteresis
         self._comfort_grants = 0
-        self.pressure_counts = {"shrink": 0, "force_spill": 0,
-                                "deny": 0, "shed": 0}
+        self.pressure_counts = {"shrink": 0, "cache_evict": 0,
+                                "force_spill": 0, "deny": 0, "shed": 0}
         _MANAGERS.add(self)
 
     @staticmethod
@@ -488,6 +488,42 @@ class MemManager:
             trace.event("memory", "memmgr.pressure", rung="shrink",
                         consumer=cname, freed=freed,
                         advised_shift=self._shrink_level)
+
+        # rung 1.5: cache_evict — drop warm-path cache entries (any
+        # consumer marked pressure_evictable, i.e. pure DERIVED state
+        # re-creatable at the cost of one query) before force-spilling
+        # WORKING state. min_trigger is irrelevant here: small caches
+        # that the main spill loop skipped still free real bytes
+        is_over, total_used = over()
+        if is_over:
+            with self._lock:
+                victims = [v for v, u in self._used.items()
+                           if getattr(v, "pressure_evictable", False)
+                           and u > 0 and self._spill_eligible_locked(v)]
+            if victims:
+                freed = 0
+                for victim in victims:
+                    with trace.span("memory", "memmgr.spill",
+                                    victim=getattr(victim,
+                                                   "consumer_name", "?"),
+                                    total_used=total_used,
+                                    budget=self.total,
+                                    rung="cache_evict") as sp:
+                        v_freed = victim.spill()
+                        sp.set(freed=v_freed)
+                    with self._lock:
+                        self._used[victim] = max(
+                            self._used.get(victim, 0) - v_freed, 0)
+                        if v_freed:
+                            self.num_spills += 1
+                            self.spilled_bytes += v_freed
+                    freed += v_freed
+                if freed:
+                    freed_any = True
+                self._count_rung("cache_evict")
+                trace.event("memory", "memmgr.pressure",
+                            rung="cache_evict", consumer=cname,
+                            freed=freed, victims=len(victims))
 
         # rung 2: force-spill the largest holder, min_trigger waived —
         # under real pressure many small consumers add up to the budget.

@@ -109,7 +109,15 @@ def queries() -> dict:
             s.name, {"admitted": 0, "rejected": 0, "dequeued": 0})
         for k in ent:
             ent[k] += st[k]
-    return {"queries": table, "admission": admission}
+    out = {"queries": table, "admission": admission}
+    try:
+        from auron_tpu.cache import aot as _aot
+        from auron_tpu.cache import result_cache as _rcache
+        out["cache"] = _rcache.get_cache().stats()
+        out["aot"] = _aot.last_stats()
+    except Exception:   # pragma: no cover - cache plane optional
+        pass
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -266,6 +266,15 @@ _HELP = {
     "auron_bundles_written_total": "Post-mortem bundles written.",
     "auron_flight_events": "Events currently buffered by the recorder.",
     "auron_ops_scrapes_total": "Ops-endpoint requests served, per path.",
+    "auron_cache_hits_total": "Warm-path cache hits, per plane.",
+    "auron_cache_misses_total": "Warm-path cache misses, per plane.",
+    "auron_cache_evictions_total":
+        "Warm-path cache evictions (capacity LRU + memmgr pressure).",
+    "auron_cache_inserts_total": "Warm-path cache inserts.",
+    "auron_cache_bytes": "Bytes held by the warm-path cache.",
+    "auron_cache_entries": "Entries held by the warm-path cache.",
+    "auron_aot_warmed": "Plans warmed by the last AOT startup pass.",
+    "auron_aot_errors": "Errors in the last AOT startup pass.",
 }
 
 
@@ -355,6 +364,37 @@ def _collect_runtime() -> list[tuple]:
                               f"{lab(scheduler=name)} {st['queued']}")
             fams.append(("auron_sched_running", "gauge", running))
             fams.append(("auron_sched_queued", "gauge", queued))
+    except Exception:
+        pass
+    try:
+        from auron_tpu.cache import result_cache as _rcache
+        rc = _rcache.get_cache().stats()
+        fams.append(("auron_cache_hits_total", "counter", [
+            f"auron_cache_hits_total{lab(plane='result')} {rc['hits']}",
+            f"auron_cache_hits_total{lab(plane='subplan')} "
+            f"{rc['subplan_hits']}"]))
+        fams.append(("auron_cache_misses_total", "counter", [
+            f"auron_cache_misses_total{lab(plane='result')} "
+            f"{rc['misses']}",
+            f"auron_cache_misses_total{lab(plane='subplan')} "
+            f"{rc['subplan_misses']}"]))
+        fams.append(("auron_cache_evictions_total", "counter",
+                     [f"auron_cache_evictions_total {rc['evictions']}"]))
+        fams.append(("auron_cache_inserts_total", "counter",
+                     [f"auron_cache_inserts_total {rc['inserts']}"]))
+        fams.append(("auron_cache_bytes", "gauge",
+                     [f"auron_cache_bytes {rc['bytes']}"]))
+        fams.append(("auron_cache_entries", "gauge",
+                     [f"auron_cache_entries {rc['entries']}"]))
+    except Exception:
+        pass
+    try:
+        from auron_tpu.cache import aot as _aot
+        a = _aot.last_stats()
+        fams.append(("auron_aot_warmed", "gauge",
+                     [f"auron_aot_warmed {a['warmed']}"]))
+        fams.append(("auron_aot_errors", "gauge",
+                     [f"auron_aot_errors {len(a['errors'])}"]))
     except Exception:
         pass
     return fams
@@ -466,15 +506,21 @@ def classify_outcome(exc) -> str:
     return "failed"
 
 
-def observe_query(duration_s: float, outcome: str) -> None:
+def observe_query(duration_s: float, outcome: str,
+                  served_from: Optional[str] = None) -> None:
     """One top-level query's end-to-end latency observation, labelled by
     outcome — fed by Session's admission scope and the serving handler,
     so SLO burn is computable from ``/metrics`` alone (gated by
-    auron.metrics.registry)."""
+    auron.metrics.registry). ``served_from="cache"`` distinguishes
+    warm-path answers (auron_tpu/cache) from executed ones, so cached
+    hits can't silently flatter the executed-latency SLO."""
     if not enabled():
         return
+    labels = {"outcome": outcome}
+    if served_from:
+        labels["served_from"] = served_from
     _REGISTRY.histogram("auron_query_duration_seconds",
-                        outcome=outcome).observe(duration_s)
+                        **labels).observe(duration_s)
 
 
 # ---------------------------------------------------------------------------

@@ -52,8 +52,12 @@ from auron_tpu.obs import flight_recorder as _flight
 #: (round slower than straggler_factor × rolling p50) and
 #: ``mesh.quarantine`` (device retired from future submeshes) —
 #: tools/mesh_report.py prints all of them.
+#: The ``cache`` category is the warm-path serving plane
+#: (auron_tpu/cache): ``cache.hit`` / ``cache.miss`` / ``cache.store``
+#: / ``cache.evict`` on the result/subplan cache and ``aot.warm``
+#: spans around each ahead-of-time plan warming at Session init.
 CATEGORIES = ("query", "task", "program", "shuffle", "spill", "fault",
-              "watchdog", "memory", "sched", "mesh", "journal")
+              "watchdog", "memory", "sched", "mesh", "journal", "cache")
 
 _SPAN_IDS = itertools.count(1)     # next() is GIL-atomic
 _TRACE_IDS = itertools.count(1)

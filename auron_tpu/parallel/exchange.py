@@ -1562,11 +1562,18 @@ class BroadcastExchangeOp(PhysicalOp):
     #: reads the same broadcast relation
     mesh_buffer_kind = "broadcast"
 
-    def __init__(self, child: PhysicalOp, input_partitions: int = 1):
+    def __init__(self, child: PhysicalOp, input_partitions: int = 1,
+                 subplan_key=None):
         self.child = child
         self.input_partitions = input_partitions
         self._lock = threading.Lock()
         self._buffer: Optional[_BroadcastBuffer] = None
+        #: warm-path subplan identity (ir/planner computes it from the
+        #: subtree's plan + source fingerprints; None = caching off or
+        #: identity not capturable): a hit replays the cached host-side
+        #: relation instead of collecting the child at all
+        self._subplan_key = subplan_key
+        self._cached_entries = None
 
     @property
     def children(self):
@@ -1578,7 +1585,12 @@ class BroadcastExchangeOp(PhysicalOp):
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         metrics = ctx.metrics_for(self)
         with self._lock:
-            if self._buffer is None:
+            if self._buffer is None and self._cached_entries is None \
+                    and self._subplan_key is not None:
+                from auron_tpu.cache import result_cache as _rcache
+                self._cached_entries = _rcache.get_cache().get_subplan(
+                    self._subplan_key)
+            if self._buffer is None and self._cached_entries is None:
                 from auron_tpu.obs import trace
                 with trace.span("shuffle", "broadcast.collect",
                                 maps=self.input_partitions):
@@ -1599,4 +1611,39 @@ class BroadcastExchangeOp(PhysicalOp):
                         buf.close()
                         raise
                     self._buffer = buf
+                self._store_subplan(buf)
+        if self._cached_entries is not None:
+            return count_output(self._replay_cached(), metrics,
+                                timed=True)
         return count_output(self._buffer.replay(), metrics, timed=True)
+
+    def _store_subplan(self, buf: "_BroadcastBuffer") -> None:
+        """Publish the freshly-collected relation to the warm-path
+        subplan cache as HOST entries (device buffers must not outlive
+        this query's memmgr ledger). Skipped when any entry already
+        spilled — the process is under pressure, exactly when adding a
+        cache copy would be wrong."""
+        if self._subplan_key is None:
+            return
+        from auron_tpu.columnar.batch import batch_nbytes
+        from auron_tpu.columnar.serde import batch_to_host
+        from auron_tpu.obs import profile as _profile
+        with buf._lock:
+            entries = list(buf.entries)
+        if any(e[0] != "dev" for e in entries):
+            return
+        host_entries, nbytes = [], 0
+        for e in entries:
+            # sanctioned readback (GL001): the row-count scalar lives on
+            # device; timed_get books the wait at this sync point
+            n = int(_profile.timed_get(e[1].num_rows))
+            host_entries.append((batch_to_host(e[1], n), n))
+            nbytes += batch_nbytes(e[1])
+        from auron_tpu.cache import result_cache as _rcache
+        _rcache.get_cache().put_subplan(self._subplan_key, host_entries,
+                                        nbytes)
+
+    def _replay_cached(self) -> Iterator[DeviceBatch]:
+        from auron_tpu.columnar.serde import host_to_batch
+        for host, n in self._cached_entries:
+            yield host_to_batch(host, bucket_rows(n))

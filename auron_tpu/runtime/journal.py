@@ -1047,13 +1047,19 @@ def find_reusable(dir_: str, plan_bytes: bytes, catalog: dict,
     whose plan AND source fingerprints — and driving ``scope`` — match
     ``plan_bytes``, adopted by an identical re-submission.  Every
     failure mode (corrupt, open, mismatch) falls back to None = fresh
-    run; never a wrong answer."""
+    run; never a wrong answer.
+
+    The screening itself lives in ``cache/identity.py`` — ONE
+    implementation of "same plan over the same data" shared with the
+    warm-path result cache, so journal adoption and cache lookup can
+    never drift apart about staleness."""
+    from auron_tpu.cache import identity
     fp = plan_fingerprint(plan_bytes)
     try:
         names = sorted(os.listdir(dir_))
     except OSError:
         return None
-    live_fps = None
+    probe = identity.SourceProbe(plan_bytes, catalog)
     for n in names:
         if not n.endswith(".journal"):
             continue
@@ -1065,11 +1071,7 @@ def find_reusable(dir_: str, plan_bytes: bytes, catalog: dict,
         # the one-line header already names plan_fp/scope/owner, which
         # rejects nearly every candidate for pennies (mismatches are
         # re-checked authoritatively after the load)
-        header = _peek_header(path)
-        if header is None or header.get("plan_fp") != fp \
-                or header.get("scope", "collect") != scope \
-                or _owner_is_other_live_process(
-                    header.get("owner", "")):
+        if not identity.screen_header(_peek_header(path), fp, scope):
             continue
         # check-and-CLAIM atomically (the load_for_resume discipline):
         # two identical concurrent re-submissions must never both
@@ -1092,9 +1094,7 @@ def find_reusable(dir_: str, plan_bytes: bytes, catalog: dict,
             _unregister_open(stem)
             continue
         jr._claimed = True
-        if jr.plan_fp != fp or jr.scope != scope \
-                or _owner_is_other_live_process(
-                    getattr(jr, "owner", "")):
+        if not identity.screen_loaded(jr, fp, scope):
             # a scope mismatch (a serving task adopting a Session
             # collect journal or vice versa) would re-head the file
             # with the WRONG replay contract for a later crash-resume;
@@ -1103,9 +1103,7 @@ def find_reusable(dir_: str, plan_bytes: bytes, catalog: dict,
             # and race its complete()'s rss_root rmtree
             jr.suspend()
             continue
-        if live_fps is None:
-            live_fps = source_fingerprints(plan_bytes, catalog)
-        if jr.sources != live_fps:
+        if not probe.matches(jr.sources):
             logger.warning(
                 "journal reuse skipped %s: source fingerprints "
                 "changed — stale journal invalidated", n)

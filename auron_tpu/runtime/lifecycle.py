@@ -46,7 +46,7 @@ class CancelToken:
 
     __slots__ = ("query_id", "_event", "_lock", "_deadline", "reason",
                  "cancelled_at_ns", "slot", "journal", "tasks_total",
-                 "tasks_done", "plan_tree")
+                 "tasks_done", "plan_tree", "served_from")
 
     def __init__(self, query_id: str = "", deadline_s: Optional[float] = None):
         self.query_id = query_id
@@ -73,6 +73,10 @@ class CancelToken:
         self._deadline = (time.monotonic() + deadline_s
                          if deadline_s is not None and deadline_s > 0
                          else None)
+        #: "cache" when the query was answered from the warm-path
+        #: result cache (auron_tpu/cache) instead of executing — the
+        #: served_from label on auron_query_duration_seconds
+        self.served_from: Optional[str] = None
         #: first-wins cancel reason: "cancelled" | "deadline"
         self.reason: Optional[str] = None
         #: monotonic ns of the winning cancel (the latency-histogram t0)

@@ -355,6 +355,13 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             "admission": self.server.scheduler.stats(),
             "server": dict(self.server.stats),
         }
+        try:
+            from auron_tpu.cache import aot as _aot
+            from auron_tpu.cache import result_cache as _rcache
+            body["cache"] = _rcache.get_cache().stats()
+            body["aot"] = _aot.last_stats()
+        except Exception:   # graft: disable=GL004 -- stats tee is best-effort
+            pass
         ops = _ops.current()
         if ops is not None:
             body["ops_port"] = ops.port
@@ -521,7 +528,9 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             try:
                 _obs_registry.observe_query(
                     _time.monotonic() - t0,
-                    _obs_registry.classify_outcome(exc))
+                    _obs_registry.classify_outcome(exc),
+                    served_from=getattr(self._cancel, "served_from",
+                                        None))
             except Exception:   # pragma: no cover  # graft: disable=GL004 -- per-query outcome telemetry is best-effort
                 pass
 
@@ -576,9 +585,41 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         self._cancel.slot = slot
         prev_bind = lifecycle.bind_token(self._cancel)
         jr = journal
+        cache_key = None
         try:
             task = pb.TaskDefinition()
             task.ParseFromString(task_bytes)
+            # warm-path lookup (auron_tpu/cache) BEFORE journal/plan
+            # work — plain SUBMITs only (a RESUME or pre-adopted
+            # journal means committed partial state exists and must be
+            # driven to completion, not shadowed by a cached answer)
+            from auron_tpu.cache import result_cache as _rcache
+            cache = _rcache.get_cache()
+            if journal is None and partitions is None:
+                cache_key = cache.result_key(
+                    task_bytes, planner_ctx.catalog, scope="task",
+                    partition=task.partition_id)
+            if cache_key is not None:
+                hit = cache.get_result(cache_key)
+                if hit is not None:
+                    self._cancel.served_from = "cache"
+                    self._cancel.tasks_total = 1
+                    for rb in hit.to_batches():
+                        if rb.num_rows:
+                            self._send_batch(rb)
+                    self._cancel.tasks_done = 1
+                    # the flag rides the first RESPONSE frame the
+                    # protocol can carry it in: BATCH frames are raw
+                    # Arrow IPC, so that is DONE (and for an empty
+                    # result DONE literally IS the first frame)
+                    done = {"metrics": {"cache_hit": True},
+                            "cache_hit": True,
+                            "schema_ipc": _schema_ipc_b64(hit.schema)}
+                    if report is not None:
+                        done["report"] = report
+                    write_frame(self.request, KIND_DONE,
+                                json.dumps(done, default=str).encode())
+                    return
             if jr is None:
                 # journal this served task (when auron.journal.dir is
                 # armed) so a server restart can RESUME it — the
@@ -603,6 +644,7 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             self._cancel.tasks_total = len(parts)
             self._cancel.tasks_done = 0
             snaps = []
+            cached_batches = [] if cache_key is not None else None
             # the handler's cancel TOKEN is the task's cancellation
             # registry: operators polling between child batches unwind
             # even MID-operator, not just between output batches
@@ -619,6 +661,8 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                         rb = to_arrow(batch, op.schema())
                         if rb.num_rows:
                             self._send_batch(rb)
+                            if cached_batches is not None:
+                                cached_batches.append(rb)
                     snaps.append(rt.finalize())
                     self._cancel.tasks_done += 1
             except errors.DeadlineExceeded:
@@ -647,6 +691,15 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             programs.pop_query(self._cancel.query_id)
         if jr is not None:
             jr.complete(write_report=True)
+        if cache_key is not None:
+            import pyarrow as _pa
+            arrow_schema = schema_to_arrow(op.schema())
+            cache.put_result(cache_key, _pa.Table.from_batches(
+                cached_batches, schema=arrow_schema) if cached_batches
+                else arrow_schema.empty_table())
+        from auron_tpu.cache import aot as _aot
+        _aot.record_plan(task_bytes, planner_ctx.catalog,
+                         task.num_partitions or 1)
         done = {"metrics": metrics,
                 "schema_ipc": _schema_ipc_b64(schema_to_arrow(op.schema()))}
         if report is not None:

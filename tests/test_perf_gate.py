@@ -289,6 +289,14 @@ class TestPerfGate:
         assert last["journal_commits"] >= 1
         assert 0.0 <= last["journal_overhead_pct"] \
             < last["journal_overhead_limit_pct"]
+        # the warm-path cache gate (PR 16): the repeated q01 was served
+        # from the result cache bit-identically, past the speedup
+        # floor, and the AOT warmer replayed the recorded plan cleanly
+        assert last["cache_gate"] == "pass"
+        assert last["cache_hits"] >= 1
+        assert last["cache_speedup_x"] >= last["cache_speedup_floor_x"]
+        assert last["aot_warmed"] >= 1
+        assert last["aot_errors"] == 0
         # the ops-plane gate (ISSUE 14): the live endpoint answered
         # parseable /metrics scrapes mid-q01, SLO family present
         assert last["ops_gate"] == "pass"
@@ -338,18 +346,59 @@ class TestPerfGate:
             self, monkeypatch, capsys):
         """A journal hot-path cost regression FAILS the smoke gate
         instead of hiding: seed a synthetic ledger an order of
-        magnitude past the limit."""
+        magnitude past the limit. The cache/ops/lint arms are stubbed
+        to passing verdicts — each has its own seeded regression test,
+        and this one must stay cheap enough for the bounded tier-1
+        window."""
         monkeypatch.setenv("AURON_PERF_SMOKE_SCALE", "0.2")
         from auron_tpu.runtime import journal as jrn
         monkeypatch.setattr(
             jrn, "last_stats",
             lambda: {"hot_ns": int(1e12), "records": 6, "commits": 1})
+        monkeypatch.setattr(perf_gate, "run_cache_gate",
+                            lambda tables, smoke: {
+                                "cache_gate": "pass",
+                                "cache_speedup_x": 99.0,
+                                "cache_speedup_floor_x": 5.0,
+                                "aot_warmed": 1})
+        monkeypatch.setattr(perf_gate, "run_ops_gate",
+                            lambda tables: {"ops_gate": "pass",
+                                            "ops_scrapes": 1})
+        monkeypatch.setattr(perf_gate, "run_lint_gate",
+                            lambda: {"lint_gate": "pass", "lint_new": 0})
         rc = perf_gate.main(["--smoke"])
         out = capsys.readouterr().out
         last = json.loads(out.strip().splitlines()[-1])
         assert rc == 1
         assert last["perf_gate"] == "fail"
         assert "journal hot-path overhead" in last["reason"]
+
+    def test_smoke_cache_gate_fails_on_silent_aot_errors(
+            self, monkeypatch, capsys):
+        """The cache arm's reason to exist: an AOT warmer that
+        collected errors (it never raises by contract) must FAIL the
+        smoke gate instead of passing vacuously. The ops/lint arms are
+        stubbed to passing verdicts — each has its own seeded
+        regression test, and this one must stay cheap enough for the
+        bounded tier-1 window."""
+        monkeypatch.setenv("AURON_PERF_SMOKE_SCALE", "0.2")
+        from auron_tpu.cache import aot as _aot
+        monkeypatch.setattr(
+            _aot, "last_stats",
+            lambda: {"warmed": 0, "skipped": 0,
+                     "errors": ["deadbeef: ValueError: boom"]})
+        monkeypatch.setattr(perf_gate, "run_ops_gate",
+                            lambda tables: {"ops_gate": "pass",
+                                            "ops_scrapes": 1})
+        monkeypatch.setattr(perf_gate, "run_lint_gate",
+                            lambda: {"lint_gate": "pass", "lint_new": 0})
+        rc = perf_gate.main(["--smoke"])
+        out = capsys.readouterr().out
+        last = json.loads(out.strip().splitlines()[-1])
+        assert rc == 1
+        assert last["perf_gate"] == "fail"
+        assert last["cache_gate"] == "fail"
+        assert "AOT warmer errored" in last["reason"]
 
     def test_unusable_records(self):
         base = _baseline()

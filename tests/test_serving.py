@@ -142,6 +142,40 @@ def test_stats_frame_returns_live_table(tmp_path):
         srv.shutdown()
 
 
+def test_cache_hit_flag_and_stats(tmp_path):
+    """PR 16 satellite: a repeated identical task is served from the
+    warm-path result cache — the DONE frame carries ``cache_hit``, the
+    streamed result is bit-identical to the fresh run, and
+    ``AuronClient.stats()`` reports the cache totals."""
+    from auron_tpu import config as cfg
+    from auron_tpu.cache.result_cache import get_cache
+
+    path, tbl = _dataset(str(tmp_path))
+    conf = cfg.get_config()
+    conf.set(cfg.CACHE_ENABLED, True)
+    cache = get_cache()
+    cache.clear(reset_counters=True)
+    srv = AuronServer()
+    srv.serve_background()
+    try:
+        client = AuronClient(*srv.address)
+        fresh, m1 = client.execute(_task(path))
+        _check(fresh, m1, tbl)
+        assert not m1.get("cache_hit")
+        cached, m2 = client.execute(_task(path))
+        assert m2.get("cache_hit") is True
+        assert cached.equals(fresh)          # bit-identical replay
+        st = client.stats()
+        assert st["cache"]["enabled"]
+        assert st["cache"]["hits"] >= 1
+        assert st["cache"]["entries"] >= 1
+        assert "aot" in st
+    finally:
+        srv.shutdown()
+        conf.unset(cfg.CACHE_ENABLED)
+        cache.clear(reset_counters=True)
+
+
 def test_two_process_serving(tmp_path):
     """The VERDICT gate: a fixture client in THIS process drives an
     engine server in a SEPARATE python process over TCP."""

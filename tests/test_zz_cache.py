@@ -1,0 +1,489 @@
+"""Warm-path serving plane battery (PR 16, cache/).
+
+The contract under test: an EXACT re-submission — same plan bytes, same
+source fingerprints, same trace salt — is served from the process-wide
+result cache BIT-IDENTICAL to a fresh run; any identity change (mutated
+source file, flipped trace-semantic knob) makes the key different, so a
+stale answer is structurally impossible rather than merely invalidated;
+cached state is a memmgr-registered sheddable consumer evicted by the
+``cache_evict`` pressure rung with a clean ledger; and the AOT plane's
+crash-surviving inventory can never replay stale bytes because warming
+EXECUTES the recorded plan against the live sources.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from auron_tpu import config as cfg
+from auron_tpu.cache import aot as _aot
+from auron_tpu.cache import identity
+from auron_tpu.cache.result_cache import get_cache
+from auron_tpu.frontend.dataframe import col, functions as F
+from auron_tpu.frontend.session import Session
+
+
+@pytest.fixture
+def cache_on():
+    """Arm the result cache for one test, starting and ending empty."""
+    conf = cfg.get_config()
+    conf.set(cfg.CACHE_ENABLED, True)
+    cache = get_cache()
+    cache.clear(reset_counters=True)
+    yield cache
+    conf.unset(cfg.CACHE_ENABLED)
+    cache.clear(reset_counters=True)
+
+
+def _write_parquet(path, seed=11, n=4000, lo=0, hi=30):
+    rng = np.random.default_rng(seed)
+    tbl = pa.table({
+        "k": pa.array(rng.integers(lo, hi, n), pa.int64()),
+        "v": pa.array(rng.normal(size=n), pa.float64())})
+    pq.write_table(tbl, path)
+    return tbl
+
+
+def _agg_df(s, path):
+    return (s.read_parquet(str(path))
+            .group_by("k")
+            .agg(F.sum(col("v")).alias("sv"),
+                 F.count(col("v")).alias("n")))
+
+
+# ---------------------------------------------------------------------------
+# result plane: hit semantics + invalidation-by-key
+# ---------------------------------------------------------------------------
+
+class TestResultPlane:
+    def test_cached_result_bit_identical(self, tmp_path, cache_on):
+        path = tmp_path / "t.parquet"
+        _write_parquet(path)
+        s = Session()
+        try:
+            fresh = _agg_df(s, path).collect()
+            again = _agg_df(s, path).collect()
+        finally:
+            s.close()
+        assert again.equals(fresh)   # bit-identical, group order included
+        st = cache_on.stats()
+        assert st["hits"] == 1 and st["misses"] == 1
+        assert st["inserts"] == 1 and st["entries"] == 1
+
+    def test_source_mutation_is_a_different_key(self, tmp_path, cache_on):
+        """Invalidation is structural: the mutated file's size/mtime
+        fingerprint lands IN the key, so the stale entry simply can't
+        be addressed — the re-run recomputes against the new bytes."""
+        path = tmp_path / "t.parquet"
+        _write_parquet(path, seed=1)
+        s = Session()
+        try:
+            before = _agg_df(s, path).collect()
+            tbl2 = _write_parquet(path, seed=2, n=5000)
+            after = _agg_df(s, path).collect()
+        finally:
+            s.close()
+        assert not after.equals(before)
+        exp = tbl2.to_pandas().groupby("k")["v"].sum()
+        got = after.to_pandas().set_index("k")["sv"].sort_index()
+        assert np.allclose(got.values, exp.values)
+        st = cache_on.stats()
+        assert st["hits"] == 0 and st["misses"] == 2
+
+    def test_trace_salt_flip_is_a_different_key(self, tmp_path, cache_on):
+        """A trace-semantic knob changes what compiled kernels compute,
+        so it rides the cache key exactly like the program-cache salt."""
+        path = tmp_path / "t.parquet"
+        _write_parquet(path)
+        conf = cfg.get_config()
+        s = Session()
+        try:
+            _agg_df(s, path).collect()
+            conf.set(cfg.MAP_KEY_DEDUP_POLICY, "EXCEPTION")
+            try:
+                _agg_df(s, path).collect()
+            finally:
+                conf.unset(cfg.MAP_KEY_DEDUP_POLICY)
+        finally:
+            s.close()
+        st = cache_on.stats()
+        assert st["hits"] == 0 and st["misses"] == 2
+
+    def test_disabled_is_inert(self, tmp_path):
+        """Cache off (the default): no keys, no consumer registration,
+        no counters — tier-1 seed behavior is untouched."""
+        cache = get_cache()
+        cache.clear(reset_counters=True)
+        path = tmp_path / "t.parquet"
+        _write_parquet(path)
+        s = Session()
+        try:
+            df = _agg_df(s, path)
+            assert cache.result_key(df.task_bytes(),
+                                    s.ctx.catalog) is None
+            df.collect()
+        finally:
+            s.close()
+        st = cache.stats()
+        assert not st["enabled"]
+        assert st["entries"] == 0 and st["inserts"] == 0
+
+    def test_result_key_components(self, tmp_path, cache_on):
+        """Identity unit: the key is deterministic for identical state
+        and differs on every identity axis (source bytes, trace salt,
+        scope, partition) — the invalidation story in one assert set."""
+        path = tmp_path / "t.parquet"
+        _write_parquet(path, seed=1)
+        s = Session()
+        try:
+            pb_bytes = _agg_df(s, path).task_bytes()
+            catalog = s.ctx.catalog
+            k1 = identity.result_key(pb_bytes, catalog)
+            assert k1 == identity.result_key(pb_bytes, catalog)
+            assert identity.result_key(
+                pb_bytes, catalog, scope="task", partition=0) != k1
+            conf = cfg.get_config()
+            conf.set(cfg.MAP_KEY_DEDUP_POLICY, "EXCEPTION")
+            try:
+                assert identity.result_key(pb_bytes, catalog) != k1
+            finally:
+                conf.unset(cfg.MAP_KEY_DEDUP_POLICY)
+            _write_parquet(path, seed=2)
+            assert identity.result_key(pb_bytes, catalog) != k1
+            os.unlink(path)
+            assert identity.result_key(pb_bytes, catalog) is None
+        finally:
+            s.close()
+
+    def test_explain_analyze_surfaces_cache_line(self, tmp_path, cache_on):
+        path = tmp_path / "t.parquet"
+        _write_parquet(path)
+        s = Session()
+        try:
+            text = _agg_df(s, path).explain(analyze=True)
+        finally:
+            s.close()
+        assert "[result cache]" in text
+        assert "hits=" in text and "evictions=" in text
+
+
+# ---------------------------------------------------------------------------
+# memory discipline: LRU capacity + pressure rung + ledger hygiene
+# ---------------------------------------------------------------------------
+
+class TestMemoryDiscipline:
+    def test_capacity_evicts_lru_first(self, cache_on):
+        conf = cfg.get_config()
+        tbl = pa.table({"x": pa.array(np.arange(4000), pa.int64())})
+        nbytes = tbl.nbytes
+        conf.set(cfg.CACHE_MAX_BYTES, int(nbytes * 2.5))
+        try:
+            cache = cache_on
+            keys = [(f"fp{i}", frozenset(), (), "collect", -1)
+                    for i in range(3)]
+            for k in keys:
+                assert cache.put_result(k, tbl)
+            st = cache.stats()
+            assert st["entries"] == 2 and st["evictions"] == 1
+            assert cache.get_result(keys[0]) is None      # LRU victim
+            assert cache.get_result(keys[2]) is not None
+        finally:
+            conf.unset(cfg.CACHE_MAX_BYTES)
+
+    def test_oversized_entry_is_refused(self, cache_on):
+        conf = cfg.get_config()
+        conf.set(cfg.CACHE_MAX_BYTES, 64)
+        try:
+            tbl = pa.table({"x": pa.array(np.arange(4000), pa.int64())})
+            assert not cache_on.put_result(
+                ("fp", frozenset(), (), "collect", -1), tbl)
+            assert cache_on.stats()["entries"] == 0
+        finally:
+            conf.unset(cfg.CACHE_MAX_BYTES)
+
+    def test_pressure_rung_evicts_with_clean_ledger(self, cache_on):
+        """The cache_evict rung: derived state goes FIRST under
+        pressure, the manager's ledger for the cache returns to zero,
+        and detach leaves no registered consumer behind."""
+        from auron_tpu.memmgr import manager as mgr_mod
+        from auron_tpu.memmgr.manager import MemManager
+        before_live = mgr_mod.live_consumer_count()
+        # default min_trigger: the small cache is SKIPPED by the main
+        # spill walk, so the eviction below must come from the ladder's
+        # cache_evict rung (which waives min_trigger by design)
+        mm = MemManager(total_bytes=1 << 20)
+        cache = cache_on
+        assert cache.attach(mm)
+        try:
+            tbl = pa.table({"x": pa.array(np.arange(1000), pa.int64())})
+            key = ("fp", frozenset(), (), "collect", -1)
+            assert cache.put_result(key, tbl)
+            assert mm._used[cache] == cache.mem_used() > 0
+
+            class Hog:
+                consumer_name = "hog"
+                spill_thread_safe = True
+
+                def mem_used(self):
+                    return 0
+
+                def spill(self):
+                    return 0
+
+            hog = Hog()
+            mm.register_consumer(hog)
+            try:
+                # budget breach with no spillable working state: the
+                # ladder walks shrink → cache_evict (the degrade policy
+                # grants after shedding; it only raises under 'strict')
+                try:
+                    mm.update_mem_used(hog, 2 << 20)
+                except Exception:   # noqa: BLE001 — either outcome is fine
+                    pass
+                mm.update_mem_used(hog, 0)
+            finally:
+                mm.unregister_consumer(hog)
+            st = cache.stats()
+            assert st["entries"] == 0
+            assert st["pressure_evictions"] >= 1
+            assert mm.pressure_counts["cache_evict"] >= 1
+            assert mm._used[cache] == 0                # ledger is clean
+        finally:
+            cache.detach(mm)
+        assert mgr_mod.live_consumer_count() >= before_live   # gc'd later
+
+    def test_attach_detach_refcounts(self, cache_on):
+        from auron_tpu.memmgr.manager import MemManager
+        mm = MemManager(total_bytes=1 << 20, min_trigger=0)
+        cache = cache_on
+        assert cache.attach(mm) and cache.attach(mm)
+        cache.detach(mm)
+        # still registered: one attach outstanding
+        assert cache in mm._used
+        cache.detach(mm)
+        assert cache not in mm._used
+
+
+# ---------------------------------------------------------------------------
+# concurrency: racing identical submissions through one Session
+# ---------------------------------------------------------------------------
+
+class TestConcurrency:
+    def test_racing_identical_queries_one_session(self, tmp_path,
+                                                  cache_on):
+        import threading
+        path = tmp_path / "t.parquet"
+        _write_parquet(path)
+        s = Session()
+        results, errors = [], []
+        lock = threading.Lock()
+
+        def run():
+            try:
+                t = _agg_df(s, path).collect()
+                with lock:
+                    results.append(t)
+            except BaseException as e:   # noqa: BLE001 — asserted below
+                with lock:
+                    errors.append(e)
+
+        try:
+            threads = [threading.Thread(target=run) for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+        finally:
+            s.close()
+        assert not errors, errors
+        assert len(results) == 6
+        for t in results[1:]:
+            assert t.equals(results[0])
+        st = cache_on.stats()
+        assert st["hits"] + st["misses"] == 6
+        assert st["hits"] >= 1     # at least the stragglers hit
+
+
+# ---------------------------------------------------------------------------
+# subplan plane: broadcast relations shared across plannings
+# ---------------------------------------------------------------------------
+
+class TestSubplanPlane:
+    def test_broadcast_subplan_reused_across_queries(self, tmp_path,
+                                                     cache_on):
+        path = tmp_path / "dim.parquet"
+        _write_parquet(path, seed=5, n=200, lo=0, hi=20)
+        fact_path = tmp_path / "fact.parquet"
+        _write_parquet(fact_path, seed=6, n=6000, lo=0, hi=20)
+
+        def run(agg):
+            """Two DIFFERENT top-level queries (different fact-side
+            aggregate → different result keys, no top-level hit) over
+            the SAME broadcast dim subtree — only the subplan plane can
+            share work between them."""
+            s = Session()
+            try:
+                # repartitioned probe vs 1-partition build: not
+                # co-partitioned, so the planner broadcasts the build
+                # side (the subplan the cache shares across queries)
+                fact = s.read_parquet(str(fact_path)).repartition(2, "k")
+                dim = s.read_parquet(str(path)) \
+                    .group_by("k").agg(F.sum(col("v")).alias("dv"))
+                return (fact.join(dim, on="k")
+                        .group_by("k")
+                        .agg(agg(col("v")).alias("a"))
+                        .collect())
+            finally:
+                s.close()
+
+        run(F.sum)
+        st1 = cache_on.stats()
+        run(F.count)
+        st2 = cache_on.stats()
+        assert st1["subplan_misses"] >= 1
+        assert st2["subplan_hits"] >= st1["subplan_hits"] + 1
+        # and an exact re-submission of query 1 hits at TOP level
+        # without touching the subplan plane again
+        run(F.sum)
+        st3 = cache_on.stats()
+        assert st3["hits"] >= st2["hits"] + 1
+        assert st3["subplan_misses"] == st2["subplan_misses"]
+
+
+# ---------------------------------------------------------------------------
+# AOT plane: record → warm → serve; SIGKILL never-stale proof
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def xla_binding_restored():
+    """Session binds jax's persistent compilation cache dir process-wide
+    and never unbinds; these tests point it at a tmp_path that pytest
+    deletes afterwards. Restore the binding or every later >1s compile
+    in the suite pays serialization + failed writes against a vanished
+    directory."""
+    import jax
+    prior = jax.config.jax_compilation_cache_dir
+    yield
+    jax.config.update("jax_compilation_cache_dir", prior)
+
+
+class TestAotPlane:
+    pytestmark = pytest.mark.usefixtures("xla_binding_restored")
+    def test_record_then_warm_serves_first_query(self, tmp_path,
+                                                 cache_on):
+        conf = cfg.get_config()
+        conf.set(cfg.XLA_CACHE_DIR, str(tmp_path / "xla"))
+        try:
+            path = tmp_path / "t.parquet"
+            _write_parquet(path)
+            s = Session()
+            try:
+                expected = _agg_df(s, path).collect()
+            finally:
+                s.close()
+            inv = os.listdir(_aot.aot_dir(conf))
+            assert any(n.endswith(".plan") for n in inv)
+            # fresh "process": empty cache, warmer armed
+            cache_on.clear(reset_counters=True)
+            conf.set(cfg.CACHE_AOT_TOP_N, 2)
+            try:
+                s2 = Session()
+                try:
+                    assert _aot.last_stats() == {
+                        "warmed": 1, "skipped": 0, "errors": []}
+                    got = _agg_df(s2, path).collect()
+                finally:
+                    s2.close()
+            finally:
+                conf.unset(cfg.CACHE_AOT_TOP_N)
+            assert got.equals(expected)
+            assert cache_on.stats()["hits"] >= 1   # warm left it ready
+        finally:
+            conf.unset(cfg.XLA_CACHE_DIR)
+
+    def test_warm_skips_vanished_sources(self, tmp_path, cache_on):
+        conf = cfg.get_config()
+        conf.set(cfg.XLA_CACHE_DIR, str(tmp_path / "xla"))
+        try:
+            path = tmp_path / "gone.parquet"
+            _write_parquet(path)
+            s = Session()
+            try:
+                _agg_df(s, path).collect()
+            finally:
+                s.close()
+            os.unlink(path)
+            conf.set(cfg.CACHE_AOT_TOP_N, 2)
+            try:
+                Session().close()
+            finally:
+                conf.unset(cfg.CACHE_AOT_TOP_N)
+            st = _aot.last_stats()
+            assert st["warmed"] == 0 and st["errors"] == []
+            assert st["skipped"] == 1   # not an error: datasets expire
+        finally:
+            conf.unset(cfg.XLA_CACHE_DIR)
+
+    def test_sigkill_then_mutate_never_serves_stale(self, tmp_path,
+                                                    cache_on):
+        """The crash-sweep never-stale proof: a SIGKILLed process's AOT
+        inventory survives; the next process warms it by EXECUTING the
+        plan against the LIVE (mutated) source, so neither the warmed
+        entry nor a user submission can ever observe pre-crash bytes."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        data = str(tmp_path / "t.parquet")
+        xla = str(tmp_path / "xla")
+        child = textwrap.dedent(f"""
+            import os, signal, sys
+            sys.path.insert(0, {repo!r})
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            from auron_tpu import config as cfg
+            from auron_tpu.frontend.dataframe import col, functions as F
+            from auron_tpu.frontend.session import Session
+            conf = cfg.get_config()
+            conf.set(cfg.CACHE_ENABLED, True)
+            conf.set(cfg.XLA_CACHE_DIR, {xla!r})
+            s = Session()
+            df = (s.read_parquet({data!r}).group_by("k")
+                  .agg(F.sum(col("v")).alias("sv"),
+                       F.count(col("v")).alias("n")))
+            df.collect()                 # completes → inventory recorded
+            print("RECORDED", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        """)
+        _write_parquet(data, seed=1)
+        out = subprocess.run([sys.executable, "-c", child],
+                             capture_output=True, text=True, timeout=300)
+        assert "RECORDED" in out.stdout, out.stderr[-2000:]
+        assert out.returncode == -signal.SIGKILL
+        # the source mutates AFTER the crash; then a fresh process warms
+        mutated = _write_parquet(data, seed=2, n=5000)
+        conf = cfg.get_config()
+        conf.set(cfg.XLA_CACHE_DIR, xla)
+        conf.set(cfg.CACHE_AOT_TOP_N, 2)
+        try:
+            s = Session()
+            try:
+                st = _aot.last_stats()
+                assert st["errors"] == []
+                assert st["warmed"] == 1
+                got = (s.read_parquet(data).group_by("k")
+                       .agg(F.sum(col("v")).alias("sv"),
+                            F.count(col("v")).alias("n"))
+                       .collect())
+            finally:
+                s.close()
+        finally:
+            conf.unset(cfg.CACHE_AOT_TOP_N)
+            conf.unset(cfg.XLA_CACHE_DIR)
+        exp = mutated.to_pandas().groupby("k")["v"].agg(["sum", "count"])
+        gp = got.to_pandas().set_index("k").sort_index()
+        assert np.allclose(gp["sv"].values, exp["sum"].values)
+        assert np.array_equal(gp["n"].values, exp["count"].values)

@@ -21,6 +21,16 @@ requests each, and print the admission/shed/latency table the PERF.md
     python tools/load_report.py --clients 8 --requests 4 \
         --max-concurrent 2 --queue-depth 2
 
+``--repeat N`` switches to the WARM-PATH measurement (the PR 16 cache
+acceptance figure): the same task driven N times cold (cache disabled,
+every run executes fully) and N times warm (cache enabled, first run
+populates, the rest hit), reporting cold/warm latency p50s, their
+ratio, a bit-identical check of cached-vs-fresh results, and the
+server's cache counters from ``AuronClient.stats()``.
+``--expect-speedup X`` makes a warm-p50 speedup under X exit nonzero:
+
+    python tools/load_report.py --repeat 10 --expect-speedup 10
+
 The last stdout line is one JSON record (the bench.py/chaos_report.py
 driver contract)."""
 
@@ -182,6 +192,78 @@ def run_load(clients: int, requests: int, max_concurrent: int,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_repeat(repeats: int, rows: int) -> dict:
+    """Warm-path A/B: the same task N times with the result cache OFF
+    (cold — every run executes the full pipeline) then N times with it
+    ON (warm — the first run populates, the rest are served from
+    cache). Cold/warm p50s and their ratio are the PERF.md "Warm-path
+    serving" figures; the bit-identical check and the server's cache
+    counters prove the warm runs actually came from the cache rather
+    than a faster execution."""
+    from auron_tpu import config as cfg
+    from auron_tpu.cache.result_cache import get_cache
+    from auron_tpu.runtime.serving import AuronClient, AuronServer
+    conf = cfg.get_config()
+    cache = get_cache()
+    root = tempfile.mkdtemp(prefix="auron_repeat_")
+    try:
+        path = _dataset(root, rows)
+        task = _task_bytes(path)
+        srv = AuronServer()
+        srv.serve_background()
+        try:
+            client = AuronClient(*srv.address, timeout_s=120)
+            # cold phase: cache off; one unmeasured warmup first so the
+            # cold p50 measures execution, not first-compile
+            conf.set(cfg.CACHE_ENABLED, False)
+            client.execute(task)
+            cold_lat: list = []
+            cold_tbl = None
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                cold_tbl, _ = client.execute(task)
+                cold_lat.append(time.perf_counter() - t0)
+            # warm phase: cache on, starting empty; the first request
+            # misses and populates, the measured N all hit
+            conf.set(cfg.CACHE_ENABLED, True)
+            cache.clear(reset_counters=True)
+            fresh_tbl, _ = client.execute(task)
+            warm_lat: list = []
+            warm_tbl, hit_flags = None, []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                warm_tbl, metrics = client.execute(task)
+                warm_lat.append(time.perf_counter() - t0)
+                hit_flags.append(bool(metrics.get("cache_hit")))
+            identical = (warm_tbl.equals(fresh_tbl)
+                         and warm_tbl.equals(cold_tbl))
+            stats = client.stats()
+            cold_sorted, warm_sorted = sorted(cold_lat), sorted(warm_lat)
+            cold_p50 = _pct(cold_sorted, 0.50)
+            warm_p50 = _pct(warm_sorted, 0.50)
+            return {
+                "mode": "repeat",
+                "repeats": repeats,
+                "input_rows": rows,
+                "cold": {"p50_s": round(cold_p50, 4),
+                         "p99_s": round(_pct(cold_sorted, 0.99), 4)},
+                "warm": {"p50_s": round(warm_p50, 4),
+                         "p99_s": round(_pct(warm_sorted, 0.99), 4),
+                         "cache_hits": sum(hit_flags)},
+                "speedup_x": round(cold_p50 / warm_p50, 1)
+                if warm_p50 > 0 else 0.0,
+                "bit_identical": identical,
+                "cache": stats.get("cache", {}),
+            }
+        finally:
+            srv.shutdown()
+    finally:
+        conf.unset(cfg.CACHE_ENABLED)
+        cache.clear(reset_counters=True)
+        import shutil
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", type=int, default=8,
@@ -197,7 +279,45 @@ def main(argv=None) -> int:
     ap.add_argument("--expect-shed", action="store_true",
                     help="fail (exit 1) when the overload produced ZERO "
                          "rejections — the admission door went untested")
+    ap.add_argument("--repeat", type=int, default=0, metavar="N",
+                    help="warm-path mode: drive the same task N times "
+                         "cold (cache off) and N times warm (cache on) "
+                         "and report the p50 speedup instead of the "
+                         "concurrency table")
+    ap.add_argument("--expect-speedup", type=float, default=None,
+                    metavar="X",
+                    help="with --repeat: fail (exit 1) when the warm "
+                         "p50 speedup is under X or the cached results "
+                         "are not bit-identical")
     args = ap.parse_args(argv)
+
+    if args.repeat > 0:
+        rep = run_repeat(args.repeat, args.rows)
+        c, w = rep["cold"], rep["warm"]
+        print(f"repeat report: {args.repeat} runs cold vs warm "
+              f"({args.rows} rows)")
+        print(f"  cold p50/p99: {c['p50_s']}s / {c['p99_s']}s "
+              f"(cache disabled)")
+        print(f"  warm p50/p99: {w['p50_s']}s / {w['p99_s']}s "
+              f"({w['cache_hits']}/{args.repeat} served from cache)")
+        print(f"  speedup: {rep['speedup_x']}x ; bit-identical: "
+              f"{rep['bit_identical']}")
+        print(f"  server cache stats: {rep['cache']}")
+        rc = 0
+        if not rep["bit_identical"]:
+            print("  FAIL: cached result differs from the fresh run")
+            rc = 1
+        if w["cache_hits"] < args.repeat:
+            print(f"  FAIL: only {w['cache_hits']}/{args.repeat} warm "
+                  "runs hit the cache — the warm path did not engage")
+            rc = 1
+        if args.expect_speedup is not None \
+                and rep["speedup_x"] < args.expect_speedup:
+            print(f"  FAIL: speedup {rep['speedup_x']}x < expected "
+                  f"{args.expect_speedup}x")
+            rc = 1
+        print(json.dumps(rep))
+        return rc
 
     rep = run_load(args.clients, args.requests, args.max_concurrent,
                    args.queue_depth, args.rows)

@@ -398,6 +398,113 @@ def run_lint_gate() -> dict:
     return out
 
 
+def run_cache_gate(tables, smoke: dict) -> dict:
+    """Warm-path cache arm (the serving-plane cache, cache/result_cache):
+    with ``auron.cache.*`` armed, the SAME q01 re-submitted through one
+    Session must come back from the result cache — bit-identical and at
+    least ``smoke.cache_speedup_floor_x`` times faster than the fresh
+    run — and a fresh Session's AOT warmer (``auron.cache.aot_top_n``)
+    must replay the recorded plan with zero silent errors. A repeat
+    submission that never hits, a non-identical cached result, a
+    speedup under the floor, an erroring warmer, or a warmer that
+    warmed NOTHING all fail loudly. Returns
+    ``{"cache_gate": "pass"|"fail", "cache_speedup_x": ..., ...}``."""
+    import shutil
+    import tempfile
+    import time
+
+    from auron_tpu import config as cfg
+    from auron_tpu.cache import aot as _aot
+    from auron_tpu.cache.result_cache import get_cache
+    from auron_tpu.frontend.session import Session
+    from auron_tpu.it.queries import q01_dataframe
+
+    floor_x = float(smoke.get("cache_speedup_floor_x", 5.0))
+    conf = cfg.get_config()
+    cache = get_cache()
+    # the AOT inventory rides next to the persistent XLA cache; Session
+    # binds jax_compilation_cache_dir to it, so remember the binding and
+    # restore it after — the gate's temp dir must not outlive the gate
+    aot_root = tempfile.mkdtemp(prefix="auron_cache_gate_")
+    try:
+        import jax
+        prev_xla_dir = jax.config.jax_compilation_cache_dir
+    except Exception:   # noqa: BLE001 — jax-version dependent attr
+        jax, prev_xla_dir = None, None
+    conf.set(cfg.CACHE_ENABLED, True)
+    conf.set(cfg.XLA_CACHE_DIR, aot_root)
+    try:
+        cache.clear(reset_counters=True)
+        s = Session()
+        try:
+            t0 = time.perf_counter()
+            fresh = q01_dataframe(s, tables).collect()
+            fresh_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            cached = q01_dataframe(s, tables).collect()
+            cached_s = time.perf_counter() - t0
+        finally:
+            s.close()
+        st = cache.stats()
+        speedup = fresh_s / cached_s if cached_s > 0 else float("inf")
+        out = {
+            "cache_gate": "pass",
+            "cache_speedup_x": round(speedup, 1),
+            "cache_speedup_floor_x": floor_x,
+            "cache_fresh_s": round(fresh_s, 4),
+            "cache_hit_s": round(cached_s, 4),
+            "cache_hits": st["hits"],
+        }
+        if not st["hits"]:
+            out["cache_gate"] = "fail"
+            out["cache_error"] = (
+                "repeat submission never hit the result cache (0 hits "
+                "recorded) — the warm path did not engage")
+        elif not cached.equals(fresh):
+            out["cache_gate"] = "fail"
+            out["cache_error"] = ("cached q01 result is not bit-identical "
+                                  "to the fresh run")
+        elif speedup < floor_x:
+            out["cache_gate"] = "fail"
+            out["cache_error"] = (
+                f"repeat-query speedup {speedup:.1f}x < floor "
+                f"{floor_x:.0f}x (warm-path serving gate)")
+        # AOT arm: the fresh run above recorded its plan in the
+        # inventory; a NEW Session with the warmer armed must replay it
+        # cleanly (errors are collected, never raised — exactly the
+        # silent-failure mode this arm exists to catch)
+        conf.set(cfg.CACHE_AOT_TOP_N, 2)
+        try:
+            cache.clear(reset_counters=True)
+            Session().close()
+        finally:
+            conf.unset(cfg.CACHE_AOT_TOP_N)
+        aot = _aot.last_stats()
+        out["aot_warmed"] = aot["warmed"]
+        out["aot_errors"] = len(aot["errors"])
+        if aot["errors"]:
+            out["cache_gate"] = "fail"
+            out["cache_error"] = (
+                f"AOT warmer errored silently: {aot['errors'][0]}")
+        elif not aot["warmed"]:
+            out["cache_gate"] = "fail"
+            out["cache_error"] = (
+                "AOT warmer warmed nothing — the recorded q01 plan "
+                "never reached the inventory")
+        return out
+    finally:
+        conf.unset(cfg.CACHE_ENABLED)
+        conf.unset(cfg.XLA_CACHE_DIR)
+        cache.clear(reset_counters=True)
+        if jax is not None:
+            try:
+                jax.config.update(
+                    "jax_compilation_cache_dir", prev_xla_dir)
+            except Exception:   # noqa: BLE001 — best-effort restore
+                pass
+        shutil.rmtree(aot_root, ignore_errors=True)
+
+
 def run_smoke(baseline: dict) -> dict:
     """Tier-1-fast smoke arm: run the q01 operator pipeline in-process
     at a tiny scale and compare against the generous smoke floor — an
@@ -420,7 +527,13 @@ def run_smoke(baseline: dict) -> dict:
     ``smoke.journal_overhead_limit_pct`` of that run's wall. Same
     deterministic-ledger discipline as the scheduler tax: a regression
     in the hot-path cost fails the gate instead of hiding in container
-    noise."""
+    noise.
+
+    And as the WARM-PATH CACHE gate (``run_cache_gate``): with
+    ``auron.cache.*`` armed, a repeated identical q01 must be served
+    from the result cache bit-identically and at least
+    ``smoke.cache_speedup_floor_x`` times faster than fresh, and the
+    AOT warmer must replay the recorded plan with zero errors."""
     import tempfile
     import time
 
@@ -503,6 +616,15 @@ def run_smoke(baseline: dict) -> dict:
                 f"journal hot-path overhead {journal_pct:.3f}% >= "
                 f"{journal_limit}% of the journaled q01 wall "
                 f"(crash-safe journal gate)")
+        # warm-path cache arm: repeated identical q01 must be served
+        # from the result cache (bit-identical, >= the floor's speedup)
+        # and the AOT warmer must replay the recorded plan cleanly
+        verdict.update(run_cache_gate(tables, smoke))
+        if verdict["cache_gate"] != "pass" \
+                and verdict["perf_gate"] == "pass":
+            verdict["perf_gate"] = "fail"
+            verdict["reason"] = (
+                f"cache gate: {verdict.get('cache_error', 'failed')}")
         # ops-plane arm: the live telemetry endpoint must expose a
         # parseable /metrics carrying the SLO histogram, scraped WHILE
         # q01 runs (unparseable exposition or a vanished
@@ -558,7 +680,10 @@ def main(argv=None) -> int:
               f"{verdict['sched_tax_pct']:.3f}% (limit "
               f"{verdict['sched_tax_limit_pct']:.0f}%), journal "
               f"overhead {verdict['journal_overhead_pct']:.3f}% (limit "
-              f"{verdict['journal_overhead_limit_pct']:.0f}%), lint "
+              f"{verdict['journal_overhead_limit_pct']:.0f}%), cache "
+              f"{verdict.get('cache_speedup_x', '?')}x (floor "
+              f"{verdict.get('cache_speedup_floor_x', '?')}x, aot "
+              f"{verdict.get('aot_warmed', '?')} warmed), lint "
               f"{verdict.get('lint_new', '?')} new → "
               f"{verdict['perf_gate'].upper()}")
         print(json.dumps(verdict))
