@@ -16,6 +16,14 @@ into jax at Session init) into an end-to-end cold-start story:
   cache is enabled — leaves the warmed results ready to serve, so the
   process's first user query pays neither compile nor execution.
 
+``warm`` runs OFF the construction path: Session init only spawns a
+daemon thread, so warming overlaps the first user query's planning
+instead of serializing ahead of it — ``last_stats()['overlapped_ms']``
+is the wall the warmer ran concurrently. ``wait()`` joins the in-flight
+warm (Session.close does, bounding the thread's lifetime to the session
+that started it), and readers that need the FINAL summary call it
+before ``last_stats``.
+
 ``warm`` NEVER raises (Session init must survive a corrupt inventory);
 failures are collected in ``last_stats()['errors']`` and the perf_gate
 cache arm fails loudly when the warmer errored silently.
@@ -33,7 +41,11 @@ from typing import Optional
 logger = logging.getLogger("auron.cache.aot")
 
 _LOCK = threading.Lock()
-_LAST: dict = {"warmed": 0, "skipped": 0, "errors": []}
+_LAST: dict = {"warmed": 0, "skipped": 0, "errors": [],
+               "overlapped_ms": 0.0}
+#: the in-flight background warm, if any (one at a time: ``warm`` joins
+#: the previous session's thread before starting its own)
+_THREAD: Optional[threading.Thread] = None
 
 
 def aot_dir(conf=None) -> str:
@@ -135,24 +147,69 @@ def _inventory(conf) -> dict:
 
 
 def warm(session) -> dict:
-    """Execute the top-N inventory plans through ``session``'s normal
-    plan/execute path. Returns (and records for ``last_stats``) a
-    ``{"warmed", "skipped", "errors"}`` summary. Never raises."""
-    global _LAST
-    stats: dict = {"warmed": 0, "skipped": 0, "errors": []}
+    """Start warming the top-N inventory plans through ``session``'s
+    normal plan/execute path on a BACKGROUND daemon thread and return
+    immediately — Session construction no longer blocks on the warm,
+    which instead overlaps the first user query's planning. The final
+    ``{"warmed", "skipped", "errors", "overlapped_ms"}`` summary lands
+    in ``last_stats`` when the thread completes; ``wait()`` joins it.
+    Never raises (a broken warmer must not fail construction)."""
+    global _LAST, _THREAD
+    import time
+    # one warm at a time: a second Session arming the warmer while the
+    # first is still warming would race the shared inventory/stats
+    wait()
+    stats: dict = {"warmed": 0, "skipped": 0, "errors": [],
+                   "overlapped_ms": 0.0}
+    top_n = 0
     try:
         from auron_tpu import config as cfg
         conf = session.config
         top_n = int(conf.get(cfg.CACHE_AOT_TOP_N))
-        if top_n > 0:
-            stats = _warm_inner(session, conf, top_n)
     except Exception as e:   # Session init must survive a broken warmer
         stats["errors"].append(f"warm: {type(e).__name__}: {e}")
         logger.warning("aot: warm failed", exc_info=True)
+    if top_n <= 0:
+        with _LOCK:
+            _LAST = dict(stats, errors=list(stats["errors"]))
+        return stats
+
+    def _run() -> None:
+        global _LAST
+        t0 = time.perf_counter()
+        out: dict = {"warmed": 0, "skipped": 0, "errors": []}
+        try:
+            out = _warm_inner(session, conf, top_n)
+        except Exception as e:   # same contract as the sync era
+            out["errors"].append(f"warm: {type(e).__name__}: {e}")
+            logger.warning("aot: warm failed", exc_info=True)
+        out["overlapped_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        with _LOCK:
+            _LAST = out
+
+    th = threading.Thread(target=_run, name="auron-aot-warm", daemon=True)
     with _LOCK:
-        _LAST = {"warmed": stats["warmed"], "skipped": stats["skipped"],
-                 "errors": list(stats["errors"])}
+        _THREAD = th
+    th.start()
     return stats
+
+
+def wait(timeout: Optional[float] = None) -> bool:
+    """Join the in-flight background warm; no-op when none is running.
+    Returns True when no warm is left in flight (so ``last_stats`` is
+    the FINAL summary), False on a timeout expiring first."""
+    global _THREAD
+    with _LOCK:
+        th = _THREAD
+    if th is None:
+        return True
+    th.join(timeout)
+    if th.is_alive():
+        return False
+    with _LOCK:
+        if _THREAD is th:
+            _THREAD = None
+    return True
 
 
 def _warm_inner(session, conf, top_n: int) -> dict:
@@ -195,8 +252,11 @@ def _warm_inner(session, conf, top_n: int) -> dict:
 
 
 def last_stats() -> dict:
-    """The most recent ``warm`` summary (perf_gate's silent-failure
-    check and the ops endpoints read this)."""
+    """The most recent COMPLETED ``warm`` summary (perf_gate's
+    silent-failure check and the ops endpoints read this). With a warm
+    still in flight this is the previous summary — call ``wait()``
+    first when the final figures are needed."""
     with _LOCK:
         return {"warmed": _LAST["warmed"], "skipped": _LAST["skipped"],
-                "errors": list(_LAST["errors"])}
+                "errors": list(_LAST["errors"]),
+                "overlapped_ms": _LAST.get("overlapped_ms", 0.0)}

@@ -101,9 +101,11 @@ class Session:
         #: warm-path serving plane (auron_tpu/cache): register the
         #: process-wide result cache as a sheddable consumer on this
         #: Session's manager (refcounted — detached in close(), so the
-        #: consumer ledger stays balanced), then run the AOT warmer
+        #: consumer ledger stays balanced), then START the AOT warmer
         #: (auron.cache.aot_top_n; a no-op at the default 0, NEVER
-        #: raises — a corrupt inventory must not fail construction)
+        #: raises — a corrupt inventory must not fail construction).
+        #: The warm runs on a background daemon thread overlapping the
+        #: first user query's planning; close() joins it (aot.wait)
         from auron_tpu.cache import aot as _aot
         from auron_tpu.cache import result_cache as _rcache
         self._result_cache = _rcache.get_cache()
@@ -358,6 +360,11 @@ class Session:
         if self._closed:
             return
         self._closed = True
+        # the AOT warmer overlaps this session's first queries on a
+        # background thread; join it FIRST (bounded) so the spill and
+        # journal sweeps below never race a still-warming plan
+        from auron_tpu.cache import aot as _aot
+        _aot.wait(timeout=60.0)
         # queued-first through the scheduler's drain order...
         self._scheduler.drain("session-closed")
         # ...then any token the scheduler has not seen yet (admission

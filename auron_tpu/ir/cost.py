@@ -1,0 +1,188 @@
+"""Fusion plan cost model (Fusion 2.0).
+
+The planner's greedy-maximal chaining (fuse everything fusable, combine
+everywhere eligible) is the right static prior, but SystemML's fusion-plan
+work (arXiv:1801.00829) and FusionStitching (arXiv:2009.10924) both show
+*selected* plans beating maximal chains once real statistics exist. This
+module is the selection half: a per-site history of observed exchange
+statistics keyed by (plan fingerprint, site) — the PR 16 identity plumbing
+— and a small analytic cost model that scores the candidate decisions the
+planner enumerates:
+
+  * at each foldable hash exchange: fold with per-batch COMBINE vs fold
+    with PASSTHROUGH (state-layout rows cross uncombined). Combining pays
+    one O(B log B) stable sort per batch to ship ratio·rows rows instead
+    of all of them; on high-cardinality sites (ratio → 1) the sort buys
+    nothing and passthrough wins.
+  * at each hash join: probe-into-consumer fold vs unfused consumer chain
+    (the fold saves a host round-trip per batch but builds one more
+    specialized program; it stops paying when observed probe output rows
+    per batch are tiny).
+
+History is per-process and advisory: no entry → the static prior decides.
+Everything here is plan-SHAPE selection — the chosen plan changes which
+programs are built, never what a given program computes, so bit-identity
+is the fold's own contract (ops/agg.AggOp.combine_fold_reason), not this
+module's.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+# -- cost constants (relative units: 1.0 = one row through a fused
+# row-local fragment). WIRE is a row crossing the exchange: serde/buffer
+# (or all_to_all slot) + the reduce side re-reducing it. SORT_LOG is the
+# per-row-per-log2(B) price of the combine's stable hash-sort. The
+# implied break-even combine ratio is 1 - SORT_LOG*log2(B)/WIRE — 0.84
+# at the default 64Ki batch, 0.90 at 1Ki — above it, combining ships so
+# few fewer rows that the sort is pure loss.
+WIRE_COST_PER_ROW = 4.0
+SORT_COST_PER_ROW_LOG = 0.04
+#: static prior for the combine ratio when a site has no history: assume
+#: combining halves the rows (safe: prior-scored combine wins, matching
+#: the greedy default, until a real observation says otherwise)
+PRIOR_COMBINE_RATIO = 0.5
+#: probe fold stops paying below this observed consumer rows/batch (one
+#: extra specialized program build amortized over almost no rows)
+PROBE_FOLD_MIN_ROWS_PER_BATCH = 256.0
+
+_MAX_SITES = 4096
+
+
+@dataclass
+class SiteStats:
+    """Accumulated observations for one (plan_fp, site)."""
+    rows_in: int = 0
+    rows_out: int = 0
+    batches: int = 0
+    runs: int = 0
+
+    @property
+    def combine_ratio(self) -> float:
+        return (self.rows_out / self.rows_in) if self.rows_in else 1.0
+
+    @property
+    def rows_per_batch(self) -> float:
+        return (self.rows_in / self.batches) if self.batches else 0.0
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One scored fusion decision at a site."""
+    mode: str
+    cost: float
+    detail: str
+
+
+_LOCK = threading.Lock()
+_HISTORY: dict = {}
+#: plan-time decisions, site → (kind, mode) — what the planner actually
+#: chose at each cost site, for tools/compile_report's plan-diff view
+#: (greedy vs cost-model runs) and the fusion battery's assertions
+_DECISIONS: dict = {}
+
+
+def observe(site: Optional[tuple], rows_in: int, rows_out: int,
+            batches: int) -> None:
+    """Record one run's observed exchange statistics. site is the
+    (plan_fp, site_label) stamp the planner left on the op; None (no
+    fingerprint — e.g. ad-hoc plans outside plan_from_bytes) is a no-op."""
+    if site is None:
+        return
+    with _LOCK:
+        st = _HISTORY.get(site)
+        if st is None:
+            if len(_HISTORY) >= _MAX_SITES:   # advisory cache: drop, don't grow
+                _HISTORY.clear()
+            st = _HISTORY[site] = SiteStats()
+        st.rows_in += int(rows_in)
+        st.rows_out += int(rows_out)
+        st.batches += int(batches)
+        st.runs += 1
+
+
+def stats_for(site: Optional[tuple]) -> Optional[SiteStats]:
+    if site is None:
+        return None
+    with _LOCK:
+        return _HISTORY.get(site)
+
+
+def snapshot() -> dict:
+    with _LOCK:
+        return dict(_HISTORY)
+
+
+def record_decision(site: Optional[tuple], kind: str, mode: str) -> None:
+    """Note the planner's choice at a cost site ('exchange' →
+    combine/passthrough, 'probe_fold' → fold/unfused). Advisory, like
+    the history; None sites (no plan fingerprint) are not recorded."""
+    if site is None:
+        return
+    with _LOCK:
+        if len(_DECISIONS) >= _MAX_SITES:
+            _DECISIONS.clear()
+        _DECISIONS[site] = (kind, mode)
+
+
+def decisions_snapshot() -> dict:
+    with _LOCK:
+        return dict(_DECISIONS)
+
+
+def clear() -> None:
+    with _LOCK:
+        _HISTORY.clear()
+        _DECISIONS.clear()
+
+
+# -- candidate scoring -------------------------------------------------------
+
+def exchange_candidates(ratio: float, rows_per_batch: float) -> list:
+    """Score the two fold modes of one exchange for a (possibly prior)
+    combine ratio and batch size. Costs are per input row."""
+    import math
+    b = max(rows_per_batch, 2.0)
+    sort = SORT_COST_PER_ROW_LOG * math.log2(b)
+    return sorted([
+        Candidate("combine", sort + ratio * WIRE_COST_PER_ROW,
+                  f"ratio={ratio:.3f} sort={sort:.3f}"),
+        Candidate("passthrough", WIRE_COST_PER_ROW,
+                  f"ratio={ratio:.3f}"),
+    ], key=lambda c: c.cost)
+
+
+def choose_exchange_mode(conf, site: Optional[tuple],
+                         batch_capacity: int) -> tuple:
+    """('combine'|'passthrough', why) for one foldable exchange.
+
+    cost_model off → greedy-maximal: always combine (unless the combine
+    knob itself is off, which the caller resolves first). With the model
+    on, observed per-site history feeds the candidate scores; no history
+    falls back to the static prior (which scores combine ahead)."""
+    from auron_tpu import config as cfg
+    if not conf.get(cfg.FUSION_COST_MODEL):
+        return "combine", "greedy"
+    st = stats_for(site)
+    if st is None or st.rows_in == 0:
+        ratio, rpb, src = PRIOR_COMBINE_RATIO, float(batch_capacity), "prior"
+    else:
+        ratio, rpb, src = st.combine_ratio, st.rows_per_batch, "observed"
+    best = exchange_candidates(ratio, rpb)[0]
+    return best.mode, f"{src}:{best.detail}"
+
+
+def choose_probe_fold(conf, site: Optional[tuple]) -> bool:
+    """Whether the hash-join probe should fold into its consumer chain.
+    Greedy (cost_model off) and the no-history prior both fold; history
+    showing near-empty probe outputs per batch declines the fold."""
+    from auron_tpu import config as cfg
+    if not conf.get(cfg.FUSION_COST_MODEL):
+        return True
+    st = stats_for(site)
+    if st is None or st.batches == 0:
+        return True
+    return (st.rows_out / st.batches) >= PROBE_FOLD_MIN_ROWS_PER_BATCH

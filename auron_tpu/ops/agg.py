@@ -738,6 +738,163 @@ def _host_string_column(values: list, cap: int) -> StringColumn:
                         jnp.asarray(val))
 
 
+def _contribution_columns(group_exprs, mode: str, aggs, specs,
+                          batch: DeviceBatch, in_schema: Schema,
+                          ctx: EvalContext):
+    """Evaluate group keys and per-row initial accumulator columns.
+
+    Module-level (plan data in, columns out) so traced closures can use
+    it without capturing the AggOp — the combine fold's stage closure
+    (``build_combine_stage``) lands in the process-wide split-program
+    cache, where a captured op would pin its whole subtree (including
+    any broadcast build buffers below it) for the cache's lifetime."""
+    keys = tuple(evaluate(e, batch, in_schema, ctx).col
+                 for e in group_exprs)
+    accs = []
+    live = batch.row_mask()
+    if mode == "final":
+        # state columns come in as-is
+        idx = len(group_exprs)
+        for spec in specs:
+            for k, (fname, fdt, kind) in enumerate(spec.state_fields):
+                col = batch.columns[idx]
+                if kind in HOST_KINDS:
+                    idx += 1      # merged host-side (_HostAggState)
+                    continue
+                if kind in ("collect_list", "collect_set"):
+                    accs.append((col.values,
+                                 jnp.where(col.validity, col.lens, 0)))
+                    idx += 1
+                    continue
+                if kind in _DCOLLECT:
+                    accs.append((col.keys, col.values,
+                                 jnp.where(col.validity, col.lens, 0)))
+                    idx += 1
+                    continue
+                if kind in _STR_KINDS:
+                    accs.append((col.chars, col.lens, col.validity))
+                    idx += 1
+                    continue
+                if kind in _DEC_KINDS:
+                    # limb pair; invalid state rows already hold the
+                    # reduce-neutral (partial emit / passthrough
+                    # neutralized them), so no re-masking needed
+                    accs.append((col.hi, col.lo))
+                    idx += 1
+                    continue
+                data = col.data
+                if fname == "has":
+                    data = data.astype(jnp.bool_) & col.validity
+                elif kind in ("min", "max") or kind == "first":
+                    data = data  # validity handled via 'has'
+                accs.append(data)
+                idx += 1
+        return keys, accs, live
+
+    for agg, spec in zip(aggs, specs):
+        if spec.state_fields and spec.state_fields[0][2] in HOST_KINDS:
+            continue              # accumulated host-side
+        if spec.state_fields[0][2] in ("collect_list", "collect_set"):
+            # collect_* and the DISTINCT aggs share the padded-list
+            # accumulator (one-element list per valid row; len 0
+            # where null: Spark collect_*/distinct skip nulls)
+            v = evaluate(agg.arg, batch, in_schema, ctx)
+            if not isinstance(v.col, PrimitiveColumn):
+                raise NotImplementedError(f"{agg.fn} over non-primitives")
+            valid = v.validity & live
+            accs.append((v.col.data[:, None], valid.astype(jnp.int32)))
+            continue
+        if spec.state_fields[0][2] in _DCOLLECT:
+            from auron_tpu.columnar.decimal128 import Decimal128Column
+            v = evaluate(agg.arg, batch, in_schema, ctx)
+            if not isinstance(v.col, Decimal128Column):
+                raise NotImplementedError(
+                    f"{agg.fn}: expected two-limb decimal input")
+            valid = v.validity & live
+            accs.append((v.col.hi[:, None], v.col.lo[:, None],
+                         valid.astype(jnp.int32)))
+            continue
+        if agg.fn in ("count", "count_star"):
+            if agg.arg is None:
+                c = live.astype(jnp.int64)
+            else:
+                v = evaluate(agg.arg, batch, in_schema, ctx)
+                c = (v.validity & live).astype(jnp.int64)
+            accs.append(c)
+            continue
+        v = evaluate(agg.arg, batch, in_schema, ctx)
+        valid = v.validity & live
+        if isinstance(v.col, StringColumn):
+            if spec.state_fields[0][2] in _STR_KINDS:
+                accs.append((v.col.chars, v.col.lens, valid))
+                continue
+            raise NotImplementedError(f"{agg.fn} over strings")
+        from auron_tpu.columnar.decimal128 import Decimal128Column
+        needs_limbs = any(k in _DEC_KINDS
+                          for _f, _d, k in spec.state_fields)
+        if isinstance(v.col, Decimal128Column) or needs_limbs:
+            if isinstance(v.col, Decimal128Column):
+                hi, lo = v.col.hi, v.col.lo
+            else:
+                # narrow decimal input promoted to two limbs: avg
+                # with p+4>18 accumulates/returns wide (Spark
+                # DecimalType.bounded promotion past 18 digits)
+                from auron_tpu.columnar import decimal128 as d128
+                hi, lo = d128.from_int64(v.col.data.astype(jnp.int64))
+            for fname, fdt, kind in spec.state_fields:
+                if fname == "has":
+                    accs.append(valid)
+                elif fname == "count":
+                    accs.append(valid.astype(jnp.int64))
+                elif kind == "dsum":
+                    accs.append((jnp.where(valid, hi, 0),
+                                 jnp.where(valid, lo, 0)))
+                elif kind in ("dmin", "dmax"):
+                    nh, nl = _DEC_NEUTRAL[kind]
+                    accs.append((jnp.where(valid, hi, nh),
+                                 jnp.where(valid, lo, nl)))
+                elif kind == "dfirst":
+                    accs.append((hi, lo))
+                else:
+                    raise ValueError(kind)
+            continue
+        for fname, fdt, kind in spec.state_fields:
+            if fname == "has":
+                accs.append(valid)
+            elif fname == "count":
+                accs.append(valid.astype(jnp.int64))
+            elif kind == "sum":
+                jdt = _JNPT[fdt]
+                accs.append(jnp.where(valid, v.data, 0).astype(jdt))
+            elif kind in ("min", "max"):
+                neutral = _neutral(kind, v.data.dtype)
+                accs.append(jnp.where(valid, v.data, neutral))
+            elif kind == "first":
+                accs.append(v.data)
+            else:
+                raise ValueError(kind)
+    return keys, accs, live
+
+
+def _passthrough_state_batch(keys, accs, live, num_rows) -> DeviceBatch:
+    """One input batch re-expressed in partial-state layout without
+    merging — each row is its own group (adaptive partial-agg
+    skipping, reference: agg/agg_ctx.rs:63-196). Module-level for the
+    same no-captured-op rule as ``_contribution_columns``."""
+    cols = list(keys)
+    for a in accs:
+        if isinstance(a, tuple) and len(a) == 3:
+            cols.append(StringColumn(a[0], a[1], a[2]))
+        elif isinstance(a, tuple) and a[0].ndim == 1:
+            from auron_tpu.columnar.decimal128 import Decimal128Column
+            cols.append(Decimal128Column(a[0], a[1], live))
+        elif isinstance(a, tuple):
+            cols.append(_list_column_from_acc(a, live))
+        else:
+            cols.append(PrimitiveColumn(a, live))
+    return DeviceBatch(tuple(cols), num_rows)
+
+
 class _HostAggState:
     """Host-side accumulation for bloom_filter and host-UDAF aggregates.
 
@@ -1246,132 +1403,8 @@ class AggOp(PhysicalOp):
     def _contributions(self, batch: DeviceBatch, in_schema: Schema,
                        ctx: EvalContext):
         """Evaluate group keys and per-row initial accumulator columns."""
-        keys = tuple(evaluate(e, batch, in_schema, ctx).col
-                     for e in self.group_exprs)
-        accs = []
-        live = batch.row_mask()
-        if self.mode == "final":
-            # state columns come in as-is
-            idx = len(self.group_exprs)
-            for spec in self.specs:
-                for k, (fname, fdt, kind) in enumerate(spec.state_fields):
-                    col = batch.columns[idx]
-                    if kind in HOST_KINDS:
-                        idx += 1      # merged host-side (_HostAggState)
-                        continue
-                    if kind in ("collect_list", "collect_set"):
-                        accs.append((col.values,
-                                     jnp.where(col.validity, col.lens, 0)))
-                        idx += 1
-                        continue
-                    if kind in _DCOLLECT:
-                        accs.append((col.keys, col.values,
-                                     jnp.where(col.validity, col.lens, 0)))
-                        idx += 1
-                        continue
-                    if kind in _STR_KINDS:
-                        accs.append((col.chars, col.lens, col.validity))
-                        idx += 1
-                        continue
-                    if kind in _DEC_KINDS:
-                        # limb pair; invalid state rows already hold the
-                        # reduce-neutral (partial emit / passthrough
-                        # neutralized them), so no re-masking needed
-                        accs.append((col.hi, col.lo))
-                        idx += 1
-                        continue
-                    data = col.data
-                    if fname == "has":
-                        data = data.astype(jnp.bool_) & col.validity
-                    elif kind in ("min", "max") or kind == "first":
-                        data = data  # validity handled via 'has'
-                    accs.append(data)
-                    idx += 1
-            return keys, accs, live
-
-        for agg, spec in zip(self.aggs, self.specs):
-            if spec.state_fields and spec.state_fields[0][2] in HOST_KINDS:
-                continue              # accumulated host-side
-            if spec.state_fields[0][2] in ("collect_list", "collect_set"):
-                # collect_* and the DISTINCT aggs share the padded-list
-                # accumulator (one-element list per valid row; len 0
-                # where null: Spark collect_*/distinct skip nulls)
-                v = evaluate(agg.arg, batch, in_schema, ctx)
-                if not isinstance(v.col, PrimitiveColumn):
-                    raise NotImplementedError(f"{agg.fn} over non-primitives")
-                valid = v.validity & live
-                accs.append((v.col.data[:, None], valid.astype(jnp.int32)))
-                continue
-            if spec.state_fields[0][2] in _DCOLLECT:
-                from auron_tpu.columnar.decimal128 import Decimal128Column
-                v = evaluate(agg.arg, batch, in_schema, ctx)
-                if not isinstance(v.col, Decimal128Column):
-                    raise NotImplementedError(
-                        f"{agg.fn}: expected two-limb decimal input")
-                valid = v.validity & live
-                accs.append((v.col.hi[:, None], v.col.lo[:, None],
-                             valid.astype(jnp.int32)))
-                continue
-            if agg.fn in ("count", "count_star"):
-                if agg.arg is None:
-                    c = live.astype(jnp.int64)
-                else:
-                    v = evaluate(agg.arg, batch, in_schema, ctx)
-                    c = (v.validity & live).astype(jnp.int64)
-                accs.append(c)
-                continue
-            v = evaluate(agg.arg, batch, in_schema, ctx)
-            valid = v.validity & live
-            if isinstance(v.col, StringColumn):
-                if spec.state_fields[0][2] in _STR_KINDS:
-                    accs.append((v.col.chars, v.col.lens, valid))
-                    continue
-                raise NotImplementedError(f"{agg.fn} over strings")
-            from auron_tpu.columnar.decimal128 import Decimal128Column
-            needs_limbs = any(k in _DEC_KINDS
-                              for _f, _d, k in spec.state_fields)
-            if isinstance(v.col, Decimal128Column) or needs_limbs:
-                if isinstance(v.col, Decimal128Column):
-                    hi, lo = v.col.hi, v.col.lo
-                else:
-                    # narrow decimal input promoted to two limbs: avg
-                    # with p+4>18 accumulates/returns wide (Spark
-                    # DecimalType.bounded promotion past 18 digits)
-                    from auron_tpu.columnar import decimal128 as d128
-                    hi, lo = d128.from_int64(v.col.data.astype(jnp.int64))
-                for fname, fdt, kind in spec.state_fields:
-                    if fname == "has":
-                        accs.append(valid)
-                    elif fname == "count":
-                        accs.append(valid.astype(jnp.int64))
-                    elif kind == "dsum":
-                        accs.append((jnp.where(valid, hi, 0),
-                                     jnp.where(valid, lo, 0)))
-                    elif kind in ("dmin", "dmax"):
-                        nh, nl = _DEC_NEUTRAL[kind]
-                        accs.append((jnp.where(valid, hi, nh),
-                                     jnp.where(valid, lo, nl)))
-                    elif kind == "dfirst":
-                        accs.append((hi, lo))
-                    else:
-                        raise ValueError(kind)
-                continue
-            for fname, fdt, kind in spec.state_fields:
-                if fname == "has":
-                    accs.append(valid)
-                elif fname == "count":
-                    accs.append(valid.astype(jnp.int64))
-                elif kind == "sum":
-                    jdt = _JNPT[fdt]
-                    accs.append(jnp.where(valid, v.data, 0).astype(jdt))
-                elif kind in ("min", "max"):
-                    neutral = _neutral(kind, v.data.dtype)
-                    accs.append(jnp.where(valid, v.data, neutral))
-                elif kind == "first":
-                    accs.append(v.data)
-                else:
-                    raise ValueError(kind)
-        return keys, accs, live
+        return _contribution_columns(self.group_exprs, self.mode, self.aggs,
+                                     self.specs, batch, in_schema, ctx)
 
     # -- merge driver -------------------------------------------------------
     #
@@ -1892,18 +1925,107 @@ class AggOp(PhysicalOp):
         """One input batch re-expressed in partial-state layout without
         merging — each row is its own group (adaptive partial-agg
         skipping, reference: agg/agg_ctx.rs:63-196)."""
-        cols = list(keys)
-        for a in accs:
-            if isinstance(a, tuple) and len(a) == 3:
-                cols.append(StringColumn(a[0], a[1], a[2]))
-            elif isinstance(a, tuple) and a[0].ndim == 1:
-                from auron_tpu.columnar.decimal128 import Decimal128Column
-                cols.append(Decimal128Column(a[0], a[1], live))
-            elif isinstance(a, tuple):
-                cols.append(_list_column_from_acc(a, live))
-            else:
-                cols.append(PrimitiveColumn(a, live))
-        return DeviceBatch(tuple(cols), num_rows)
+        return _passthrough_state_batch(keys, accs, live, num_rows)
+
+    # -- map-side combine fold (parallel/exchange + mesh_exchange) ----------
+    #
+    # A hash exchange whose child is an eligible partial agg elides the
+    # partial-agg OPERATOR and folds a per-batch (stateless) combine into
+    # the shuffle-split program: contributions → one hash-sort →
+    # _reduce_sorted → partial-layout batch, all inside the already-fused
+    # split kernel. Groups combine per map batch (host route) or per
+    # shard round (all_to_all route) BEFORE rows cross the exchange.
+    # Bit-identity: per-batch reduce is exactly today's _batch_reduce
+    # step, and the cross-batch merge that the elided partial ladder used
+    # to do is the SAME associative merge the final agg performs — so for
+    # reassociation-exact kinds the result is unchanged. Float sums are
+    # NOT reassociation-exact (the elided hot/main ladder and the final
+    # agg's ladder add in different orders) and stay unfolded — the same
+    # exactness rule the hashtable dispatch applies
+    # (kernels/dispatch.select_hash_agg's float_sum_inexact fallback).
+
+    def combine_fold_reason(self) -> Optional[str]:
+        """None when this agg can fold into a shuffle-split program as a
+        map-side combine, else why not (explain/telemetry vocabulary)."""
+        if self.mode != "partial":
+            return "not_partial"
+        if not self.group_exprs:
+            return "no_group_keys"
+        if self.key_domain is not None:
+            return "dense_domain"   # keep the dense-kernel dispatch
+        kinds = [kind for spec in self.specs
+                 for (_f, _d, kind) in spec.state_fields]
+        if any(k in HOST_KINDS for k in kinds):
+            return "host_state"
+        if any(k in ("collect_list", "collect_set") or k in _DCOLLECT
+               for k in kinds):
+            # element buffers grow by host-side retry; a fixed split
+            # program cannot re-enter the growth loop
+            return "collect_state"
+        exact = {"sum", "min", "max", "or", "first"}
+        exact.update(_STR_KINDS)
+        exact.update(_DEC_KINDS)
+        if any(k not in exact for k in kinds):
+            return "unsupported_kind"
+        if any(kind == "sum" and fdt in (DataType.FLOAT32, DataType.FLOAT64)
+               for spec in self.specs
+               for (_f, fdt, kind) in _device_fields(spec)):
+            return "float_sum_inexact"
+        return None
+
+    def combine_signature(self, mode: str) -> tuple:
+        """Hashable trace signature of the folded combine stage — rides
+        the split-program cache key (schema/capacity ride separately)."""
+        return ("combine_v1", mode, self.group_exprs, self.aggs)
+
+    def build_combine_stage(self, mode: str):
+        """Traced (DeviceBatch → (partial-layout DeviceBatch, rows_in))
+        stage folded into a shuffle-split program. mode 'combine' merges
+        the batch's groups (one stable hash-sort + segment reduce, the
+        _batch_reduce_kernel body inlined — no carries, no growth retry:
+        eligibility excluded collect kinds); mode 'passthrough' emits
+        state-layout rows uncombined (the partial-skip shape — what the
+        cost model picks on high-cardinality sites, and the combine=off
+        A/B arm). rows_in is the pre-combine live-row count, read by the
+        caller in its existing readback fence (combine telemetry)."""
+        in_schema = self.child.schema()
+        kinds = self._device_kinds()
+        # plan DATA only below — this closure is stored in the process-wide
+        # split-program cache, so capturing self would pin the whole op
+        # subtree (broadcast build buffers included) past query teardown
+        group_exprs, aggs, specs = self.group_exprs, self.aggs, self.specs
+
+        def apply(batch: DeviceBatch):
+            ectx = EvalContext()
+            keys, accs, live = _contribution_columns(
+                group_exprs, "partial", aggs, specs, batch, in_schema, ectx)
+            rows_in = jnp.sum(live.astype(jnp.int32))
+            if mode != "combine":
+                return (_passthrough_state_batch(keys, accs, live,
+                                                 batch.num_rows), rows_in)
+            cap = int(live.shape[0])   # graft: disable=GL001 -- .shape[0] is a static python int, never device data
+            h = hashing.xxhash64_columns(list(keys), cap).view(jnp.uint64)
+            h = jnp.where(live, h, _HASH_SENTINEL)
+            perm = jnp.argsort(h, stable=True)
+            keys_s = tuple(gather_column(c, perm, jnp.ones(cap, bool))
+                           for c in keys)
+            accs_s = tuple(_gather_acc(a, perm) for a in accs)
+            meta = tuple((k, 0) for k in kinds)
+            new_keys, new_accs, _h, num_groups, _needed = _reduce_sorted(
+                keys_s, accs_s, live[perm], h[perm], meta, cap)
+            valid = jnp.arange(cap, dtype=jnp.int32) < num_groups
+            cols = list(new_keys)
+            for kind, a in zip(kinds, new_accs):
+                if kind in _STR_KINDS:
+                    cols.append(StringColumn(a[0], a[1], a[2] & valid))
+                elif kind in _DEC_KINDS:
+                    from auron_tpu.columnar.decimal128 import Decimal128Column
+                    cols.append(Decimal128Column(a[0], a[1], valid))
+                else:
+                    cols.append(PrimitiveColumn(a, valid))
+            return DeviceBatch(tuple(cols), num_groups), rows_in
+
+        return apply
 
     # -- dense-domain fast path (auron_tpu/kernels) -------------------------
     #

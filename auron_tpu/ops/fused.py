@@ -195,8 +195,67 @@ class FusedStageOp(PhysicalOp):
         from auron_tpu.ops.limit import LimitOp
         return any(isinstance(m, LimitOp) for m in self.members)
 
+    def _consumer_fold(self, ctx: ExecContext):
+        """(fragments, frag_keys) when this stage's input is an inner
+        hash join whose matched output can run through the join's
+        gather+chain program (ops/joins._gather_consumer_program) — the
+        probe-into-consumer fold. The planner's cost pass gates it per
+        site via ``probe_fold_consumer`` (ir/cost.choose_probe_fold);
+        fan-out members and fused limits keep the stage on its own
+        program (the gather program yields exactly one batch and never
+        polls a budget)."""
+        from auron_tpu.ops.joins import HashJoinOp
+        j = self.input
+        if not isinstance(j, HashJoinOp) or j.join_type != "inner":
+            return None
+        if not getattr(j, "probe_fold_consumer", True):
+            return None
+        if self.has_limit():
+            return None
+        fragments, frag_keys = self.fragment_pipeline()
+        if not fragments or any(f.fanout != 1 for f in fragments):
+            return None
+        return fragments, frag_keys
+
+    def run_chain(self, source, partition: int,
+                  ctx: ExecContext) -> Iterator[DeviceBatch]:
+        """Run the member chain over an externally produced batch stream
+        — the consumer fold's degraded path (the join fell back to SMJ
+        or saw an empty build side): those batches flow through the
+        ordinary stage program here, so every batch the join yields is
+        chained exactly once on every route."""
+        kmetrics = ctx.metrics_for("kernels")
+        built_c = kmetrics.counter("fused_stage_programs_built")
+        hit_c = kmetrics.counter("fused_stage_program_hits")
+        fragments, frag_keys = self.fragment_pipeline()
+        in_schema = self.input.schema()
+        carries = jnp.asarray([f.init_carry for f in fragments],
+                              dtype=jnp.int64)
+        for batch in source:
+            ctx.check_cancelled()
+            kern, built = stage_program(frag_keys, in_schema,
+                                        batch.capacity, fragments)
+            (built_c if built else hit_c).add(1)
+            outs, carries = kern(batch, jnp.int32(partition), carries)
+            yield from outs
+
     def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
         metrics = ctx.metrics_for(self)
+        from auron_tpu import config as cfg
+        if ctx.conf.get(cfg.FUSION_ENABLED):
+            fold = self._consumer_fold(ctx)
+            if fold is not None:
+                # probe-into-consumer: the join runs this stage's
+                # fragments inside its gather program and yields
+                # already-chained batches — count them as this stage's
+                # output (whole-stage attribution, as with the probe
+                # prologue fold)
+                fragments, frag_keys = fold
+                return count_output(
+                    self.input.execute(partition, ctx,
+                                       _consumer=(self, fragments,
+                                                  frag_keys)),
+                    metrics)
         elapsed = metrics.counter("elapsed_compute")
         kmetrics = ctx.metrics_for("kernels")
         built_c = kmetrics.counter("fused_stage_programs_built")

@@ -108,6 +108,71 @@ def _probe_count_kernel(key_exprs: tuple, in_schema: Schema, capacity: int,
 _PROBE_PROGRAMS = programs.register(
     programs.ProgramCache("ops.joins.fused_probe", maxsize=256))
 
+#: probe-epilogue programs (Fusion 2.0): candidate expansion + exact-key
+#: verification + pair gather + compaction + the CONSUMER stage's fragment
+#: chain in ONE XLA program — the inner join's matched output feeds the
+#: downstream fused chain without materializing the joined batch between
+#: two program launches (the dual of the probe prologue above)
+_GATHER_PROGRAMS = programs.register(
+    programs.ProgramCache("ops.joins.gather_consumer", maxsize=256))
+
+
+def _gather_consumer_program(frag_keys: tuple, key_exprs: tuple,
+                             probe_schema: Schema, build_schema: Schema,
+                             out_cap: int, capacity: int, build_cap: int,
+                             fragments):
+    """One program per (consumer chain, join keys, schemas, capacities):
+    the inner join's match/gather phase — expand, verify, gather both
+    sides, compact — runs fused with the consumer FusedStageOp's member
+    fragments. The compacted joined batch the chain sees is exactly the
+    batch ``_probe_one`` would have yielded standalone (same expand, same
+    ``_keys_match``, same stable compaction), and the fragments are the
+    same traced bodies the consumer's own stage program would run, so the
+    fold is bit-identical — it only removes one program boundary."""
+
+    def build():
+        from auron_tpu.ops.fused import thread_fragments
+        from auron_tpu.runtime import programs as _programs
+
+        def kernel(probe: DeviceBatch, build_batch: DeviceBatch,
+                   build_keys: tuple, lo, counts, partition_id, carries):
+            ctx = EvalContext()
+            probe_key_cols = tuple(
+                evaluate(e, probe, probe_schema, ctx).col for e in key_exprs)
+            # candidate expansion (same body as _expand_kernel)
+            starts = jnp.cumsum(counts) - counts
+            total = jnp.sum(counts)
+            slots = jnp.arange(out_cap, dtype=jnp.int32)
+            probe_idx = jnp.searchsorted(
+                starts, slots, side="right").astype(jnp.int32) - 1
+            probe_idx = jnp.clip(probe_idx, 0, capacity - 1)
+            offset = slots - starts[probe_idx]
+            build_idx = lo[probe_idx] + offset
+            in_range = slots < total
+            build_idx = jnp.where(in_range, build_idx, 0)
+            ok = _keys_match(probe_key_cols, probe_idx, build_keys,
+                             build_idx) & in_range
+            out_probe = _take_cols(probe.columns, probe_idx,
+                                   jnp.ones_like(probe_idx, bool))
+            out_build = _take_cols(build_batch.columns, build_idx,
+                                   jnp.ones_like(build_idx, bool))
+            pair = DeviceBatch(tuple(out_probe) + tuple(out_build),
+                               jnp.asarray(out_cap, jnp.int32))
+            matched = compact(pair, ok)
+            outs, new_carries = thread_fragments(fragments, matched,
+                                                 partition_id, carries)
+            (b,) = outs   # fan-out chains rejected by eligibility
+            return b, jnp.stack(new_carries)
+
+        # donation stays off: the probe batch may still feed a
+        # left/full unmatched pass upstream in future variants; the
+        # gather allocates fresh output arrays regardless
+        return _programs.jit(kernel)
+
+    return _GATHER_PROGRAMS.get_or_build(
+        (frag_keys, key_exprs, probe_schema, build_schema, out_cap,
+         capacity, build_cap), build)
+
 
 def _fused_probe_program(frag_keys: tuple, key_exprs: tuple,
                          in_schema: Schema, out_schema: Schema,
@@ -243,6 +308,15 @@ class HashJoinOp(PhysicalOp):
     #: never exchanges build rows; probe batches shard on the batch dim.
     mesh_build_kind = "hash_build"
 
+    #: Fusion 2.0 plan facts, stamped per-instance by the planner's
+    #: _fold_combine pass; class defaults keep hand-built op trees (and
+    #: plans produced with the fusion pass disabled) on sane behavior.
+    #: cost_site is the (plan_fp, site) key for the ir/cost history;
+    #: probe_fold_consumer gates the probe-into-consumer fold the
+    #: downstream FusedStageOp asks for (ir/cost.choose_probe_fold).
+    cost_site = None
+    probe_fold_consumer = True
+
     def __init__(self, probe: PhysicalOp, build: PhysicalOp,
                  probe_keys: list[ir.Expr], build_keys: list[ir.Expr],
                  join_type: str = "inner"):
@@ -269,7 +343,16 @@ class HashJoinOp(PhysicalOp):
     def schema(self) -> Schema:
         return self._schema
 
-    def execute(self, partition: int, ctx: ExecContext) -> Iterator[DeviceBatch]:
+    def execute(self, partition: int, ctx: ExecContext,
+                _consumer=None) -> Iterator[DeviceBatch]:
+        """``_consumer`` is the probe-into-consumer fold handshake
+        (ops/fused.FusedStageOp.execute): ``(consumer_op, fragments,
+        frag_keys)`` of the downstream fused chain. The inner join's
+        matched output then runs through ``_gather_consumer_program`` —
+        match phase + consumer chain in one launch — and every batch this
+        generator yields is ALREADY chained; degraded paths (SMJ
+        fallback, empty build) chain via the consumer's ordinary stage
+        program instead so the contract holds on every route."""
         metrics = ctx.metrics_for(self)
         elapsed = metrics.counter("elapsed_compute")
         build_time = metrics.counter("build_hash_map_time")
@@ -278,10 +361,30 @@ class HashJoinOp(PhysicalOp):
         mem = ctx.mem_manager
         spillable = mem is not None and \
             getattr(mem, "spill_manager", None) is not None
+        fold_state = None
+        if _consumer is not None:
+            consumer_op, cfrags, cfrag_keys = _consumer
+            fold_state = {
+                "op": consumer_op, "fragments": cfrags,
+                "frag_keys": cfrag_keys, "partition": partition,
+                "carries": jnp.asarray([f.init_carry for f in cfrags],
+                                       jnp.int64),
+            }
+            ctx.metrics_for(consumer_op).counter(
+                "probe_consumer_folded").add(1)
+            km = ctx.metrics_for("kernels")
+            fold_state["built_c"] = km.counter(
+                "gather_consumer_programs_built")
+            fold_state["hit_c"] = km.counter("gather_consumer_program_hits")
 
         def stream():
             consumer = _JoinBuildConsumer(self, mem, metrics, ctx.conf) \
                 if spillable else None
+            # per-run probe statistics for the ir/cost history: matched
+            # candidate totals are already host-synced (int(total) gates
+            # the output capacity), so observing them adds no sync
+            probe_rows_out = 0
+            probe_batches = 0
             try:
                 build_batches = []
                 with timer(build_time):
@@ -300,7 +403,10 @@ class HashJoinOp(PhysicalOp):
                     # reference's smj-fallback knob, conf.rs:53-55, in the
                     # memory-safe direction).
                     metrics.counter("fallback_smj_count").add(1)
-                    yield from self._smj_fallback(consumer, partition, ctx)
+                    out = self._smj_fallback(consumer, partition, ctx)
+                    if fold_state is not None:
+                        out = fold_state["op"].run_chain(out, partition, ctx)
+                    yield from out
                     return
                 if consumer is not None:
                     build_batches = consumer.take_buffered()
@@ -310,22 +416,29 @@ class HashJoinOp(PhysicalOp):
                         merged = _concat_all(build_batches) \
                             if len(build_batches) > 1 else build_batches[0]
                 if merged is None:
-                    yield from self._empty_build_stream(partition, ctx,
-                                                        probe_schema)
+                    out = self._empty_build_stream(partition, ctx,
+                                                   probe_schema)
+                    if fold_state is not None:
+                        out = fold_state["op"].run_chain(out, partition, ctx)
+                    yield from out
                     return
                 side = _BuildSide(merged, build_schema, self.build_keys,
                                   metrics, conf=ctx.conf)
 
+                stats = [0, 0]
                 fold = self._probe_fold(ctx)
                 if fold is not None:
                     yield from self._probe_fused(fold, side, partition, ctx,
                                                  probe_schema, build_schema,
-                                                 elapsed)
+                                                 elapsed, fold_state, stats)
                 else:
                     for probe in self.probe.execute(partition, ctx):
                         yield from self._probe_one(probe, side, probe_schema,
                                                    build_schema, elapsed,
-                                                   ctx.device_sync)
+                                                   ctx.device_sync,
+                                                   fold_state=fold_state,
+                                                   stats=stats)
+                probe_rows_out, probe_batches = stats
 
                 if self.join_type in ("right", "full"):
                     yield self._unmatched_build(side, probe_schema,
@@ -333,6 +446,10 @@ class HashJoinOp(PhysicalOp):
             finally:
                 if consumer is not None:
                     consumer.close()
+                if probe_batches:
+                    from auron_tpu.ir import cost as cost_mod
+                    cost_mod.observe(self.cost_site, probe_rows_out,
+                                     probe_rows_out, probe_batches)
 
         return count_output(stream(), metrics)
 
@@ -372,7 +489,8 @@ class HashJoinOp(PhysicalOp):
         return fragments, frag_keys, self.probe.input
 
     def _probe_fused(self, fold, side: _BuildSide, partition: int,
-                     ctx: ExecContext, probe_schema, build_schema, elapsed):
+                     ctx: ExecContext, probe_schema, build_schema, elapsed,
+                     fold_state=None, stats=None):
         """Probe loop with the chain folded into the probe program: one
         XLA launch runs the member fragments AND the candidate search;
         the transformed batch comes back for the match/gather phase."""
@@ -414,10 +532,12 @@ class HashJoinOp(PhysicalOp):
             f_batches.add(1)
             yield from self._probe_one(probe, side, probe_schema,
                                        build_schema, elapsed, _sync,
-                                       pre=(lo, counts, total))
+                                       pre=(lo, counts, total),
+                                       fold_state=fold_state, stats=stats)
 
     def _probe_one(self, probe: DeviceBatch, side: _BuildSide, probe_schema,
-                   build_schema, elapsed, _sync: bool = True, pre=None):
+                   build_schema, elapsed, _sync: bool = True, pre=None,
+                   fold_state=None, stats=None):
         cap = probe.capacity
         if pre is None:
             kern = _probe_count_kernel(self.probe_keys, probe_schema, cap,
@@ -429,6 +549,33 @@ class HashJoinOp(PhysicalOp):
         else:   # the fused probe program already ran the candidate search
             lo, counts, total = pre
         total_i = int(total)
+        if stats is not None:
+            stats[0] += total_i
+            stats[1] += 1
+
+        if fold_state is not None:
+            # probe-into-consumer fold (inner joins only — eligibility is
+            # the consumer's _consumer_fold): expand + verify + gather +
+            # compact + consumer chain, one launch; the consumer carries
+            # advance across matched batches exactly as its own stage
+            # program would have advanced them
+            if total_i == 0:
+                # no candidates → the unfused join yields no batch here,
+                # so the consumer chain (and its carries) never see one
+                return
+            out_cap = bucket_rows(total_i)
+            kern, built = _gather_consumer_program(
+                fold_state["frag_keys"], self.probe_keys, probe_schema,
+                build_schema, out_cap, cap, side.capacity,
+                fold_state["fragments"])
+            (fold_state["built_c"] if built else fold_state["hit_c"]).add(1)
+            with timer(elapsed, sync=_sync) as t:
+                out, fold_state["carries"] = t.track(kern(
+                    probe, side.batch, side.keys, lo, counts,
+                    jnp.int32(fold_state["partition"]),
+                    fold_state["carries"]))
+            yield out
+            return
 
         ctx = EvalContext()
         probe_key_cols = tuple(evaluate(e, probe, probe_schema, ctx).col

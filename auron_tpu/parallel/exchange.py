@@ -98,12 +98,22 @@ _SPLIT_PROGRAMS = programs.register(
 def _fused_split_program(frag_keys: tuple, part_sig: tuple,
                          in_schema: Schema, out_schema: Schema,
                          n_out: int, capacity: int, donate: bool,
-                         fragments, part_exprs):
+                         fragments, part_exprs,
+                         combine=None, combine_sig=None):
     """One program per (chain signature, partitioning, schema, capacity):
     runs the member fragments, computes partition ids on the chain
     output, and splits — intermediates never touch HBM. The carry vector
     is the members' carries plus one trailing slot counting rows seen at
-    the split (the round-robin start offset, kept on device)."""
+    the split (the round-robin start offset, kept on device).
+
+    ``combine`` (ops/agg.AggOp.build_combine_stage) is the map-side
+    combine fold: the elided partial agg's per-batch combine (or
+    state-layout passthrough) runs between the chain and the partition-id
+    computation, so ``out_schema``/``part_exprs`` see the partial state
+    layout and groups merge BEFORE the split. Stateless — no carries —
+    and the program grows one extra output: the pre-combine live-row
+    count, read by the caller in its existing counts fence (combine
+    telemetry never adds a sync point). ``combine_sig`` keys the trace."""
 
     def build():
         from auron_tpu.ops.fused import thread_fragments
@@ -114,6 +124,9 @@ def _fused_split_program(frag_keys: tuple, part_sig: tuple,
             outs, new_carries = thread_fragments(fragments, batch,
                                                  partition_id, carries)
             (b,) = outs   # fan-out chains never take this path
+            comb_in = None
+            if combine is not None:
+                b, comb_in = combine(b)
             if kind == "hash":
                 ctx = EvalContext()
                 cols = [evaluate(e, b, out_schema, ctx).col
@@ -131,6 +144,8 @@ def _fused_split_program(frag_keys: tuple, part_sig: tuple,
             sorted_batch, counts = _split_body(b, pids, n_out)
             new_carries.append(carries[n_frags]
                                + jnp.asarray(b.num_rows, jnp.int64))
+            if combine is not None:
+                return sorted_batch, counts, jnp.stack(new_carries), comb_in
             return sorted_batch, counts, jnp.stack(new_carries)
 
         # graft: donation-ok -- host split path (the mesh exchange
@@ -139,7 +154,8 @@ def _fused_split_program(frag_keys: tuple, part_sig: tuple,
                             donate_argnums=(0,) if donate else ())
 
     return _SPLIT_PROGRAMS.get_or_build(
-        (frag_keys, part_sig, in_schema, n_out, capacity, donate), build)
+        (frag_keys, part_sig, in_schema, n_out, capacity, donate,
+         combine_sig), build)
 
 
 def _split_signature(partitioning) -> Optional[tuple]:
@@ -502,6 +518,22 @@ class ShuffleExchangeOp(PhysicalOp):
         self.input_partitions = input_partitions
         self._lock = threading.Lock()
         self._buffer: Optional[_ExchangeBuffer] = None
+        #: map-side combine fold (ir/planner._fold_combine): when the
+        #: child is an eligible partial AggOp, the planner stamps the
+        #: fold mode here and the agg's combine stage joins the split
+        #: program — 'combine' merges groups per batch/round BEFORE the
+        #: rows cross, 'passthrough' ships state-layout rows uncombined
+        #: (cost-model choice for high-cardinality sites / the
+        #: auron.fusion.combine=off arm). None = no fold (ineligible
+        #: child or fusion off); the agg then executes as its own op.
+        self.combine_mode: Optional[str] = None
+        self.combine_why: str = ""
+        #: (plan fingerprint, preorder site label) — the ir/cost.py
+        #: history key; None for ad-hoc plans without a fingerprint
+        self.cost_site: Optional[tuple] = None
+        #: (live rows in, live rows out) of the last materialization's
+        #: combine stage, for the route record (set under _lock)
+        self._combine_stats: Optional[tuple] = None
 
     @property
     def children(self):
@@ -547,10 +579,10 @@ class ShuffleExchangeOp(PhysicalOp):
             ctx.mesh_plane)
         if route == "all_to_all":
             return self._materialize_mesh(ctx, metrics, write_time, reason)
-        _record_route(self, metrics, route, reason)
         buffer = _ExchangeBuffer(self, ctx.mem_manager, metrics, ctx.conf)
+        self._combine_stats = None
         try:
-            return self._fill_buffer(ctx, buffer, write_time)
+            filled = self._fill_buffer(ctx, buffer, write_time)
         except BaseException:
             # a cancelled/failed materialization must not leave the
             # half-filled buffer registered with the memory manager (or
@@ -558,6 +590,38 @@ class ShuffleExchangeOp(PhysicalOp):
             # zero-leaked-consumers contract of the cancel battery
             buffer.close()
             raise
+        # recorded AFTER the fill (the mesh route's convention) so the
+        # event carries the observed combine figures
+        _record_route(self, metrics, route, reason,
+                      **self._combine_attrs())
+        return filled
+
+    def _combine_attrs(self) -> dict:
+        """exchange.route attributes of the fold's observed effect —
+        empty when no combine stage ran (tools/mesh_report.py columns)."""
+        if self._combine_stats is None:
+            return {}
+        rows_in, rows_out = self._combine_stats   # host ints (_note_combine)
+        return dict(combine_mode=self.combine_mode,
+                    combine_rows_in=rows_in,
+                    combine_rows_out=rows_out,
+                    combine_ratio=round(rows_out / rows_in, 4)
+                    if rows_in else 1.0)
+
+    def _note_combine(self, metrics, rows_in: int, rows_out: int,
+                      batches: int) -> None:
+        """Book one materialization's combine figures: metric counters,
+        the route-event stash, and the ir/cost.py per-site history (only
+        COMBINE-mode runs feed history — a passthrough run ships every
+        row and would record a fake ratio of 1.0 over the honest one)."""
+        rows_in = int(rows_in)     # graft: disable=GL001 -- summed on host from the fold's fenced counts readback
+        rows_out = int(rows_out)   # graft: disable=GL001 -- host int like rows_in
+        self._combine_stats = (rows_in, rows_out)
+        metrics.counter("combine_rows_in").add(rows_in)
+        metrics.counter("combine_rows_out").add(rows_out)
+        if self.combine_mode == "combine":
+            from auron_tpu.ir import cost as cost_mod
+            cost_mod.observe(self.cost_site, rows_in, rows_out, batches)
 
     def _materialize_mesh(self, ctx: ExecContext, metrics, write_time,
                           reason: str) -> "_MeshExchangeBuffer":
@@ -595,17 +659,21 @@ class ShuffleExchangeOp(PhysicalOp):
         axis = plane.axis
         out_schema = self.child.schema()
 
-        frag_info = self._split_fragments() \
+        fold = self._fold_spec() \
             if ctx.conf.get(cfg.FUSION_ENABLED) else None
-        if frag_info is not None:
-            fragments, frag_keys = frag_info
-            input_op = self.child.input
+        if fold is not None:
+            fragments, frag_keys, input_op, combine, combine_sig = fold
             fmetrics = ctx.metrics_for(self.child)
             fmetrics.counter("split_folded").add(1)
         else:
             fragments, frag_keys = [], ()
             input_op = self.child
+            combine = combine_sig = None
             fmetrics = None
+        self._combine_stats = None
+        comb_in_total = 0
+        comb_out_total = 0
+        comb_batches = 0
         in_schema = input_op.schema()
         part_exprs = self.partitioning.exprs
         part_key = ("hash", part_exprs)
@@ -686,19 +754,34 @@ class ShuffleExchangeOp(PhysicalOp):
                                     kern, built = stage_exchange_program(
                                         mesh, axis, n_out, frag_keys,
                                         part_key, in_schema, out_schema,
-                                        cap, quota, fragments, part_exprs)
+                                        cap, quota, fragments, part_exprs,
+                                        combine, combine_sig)
                                     round_built |= built
                                     (built_c if built else hit_c).add(1)
-                                    (out_cols, rc, _nr, gmax,
-                                     new_carries) = kern(
-                                        cols, num_rows, carries)
+                                    if combine is not None:
+                                        (out_cols, rc, _nr, gmax,
+                                         new_carries, comb_in) = kern(
+                                            cols, num_rows, carries)
+                                    else:
+                                        (out_cols, rc, _nr, gmax,
+                                         new_carries) = kern(
+                                            cols, num_rows, carries)
+                                        comb_in = None
                                     # ONE fence at the sharded stage's
                                     # output boundary: the round's only
                                     # readback, booked as device wait
                                     # (PR 8 discipline — never per
-                                    # shard, never per program step)
-                                    gmax_h, rc_h = _profile.timed_get(
-                                        (gmax, rc))
+                                    # shard, never per program step);
+                                    # the pre-combine row count rides
+                                    # the same fence
+                                    if comb_in is not None:
+                                        gmax_h, rc_h, comb_h = \
+                                            _profile.timed_get(
+                                                (gmax, rc, comb_in))
+                                    else:
+                                        gmax_h, rc_h = _profile.timed_get(
+                                            (gmax, rc))
+                                        comb_h = None
                                     needed = int(np.asarray(gmax_h))
                                     if needed <= quota:
                                         break
@@ -754,6 +837,12 @@ class ShuffleExchangeOp(PhysicalOp):
                     dest_rows += counts.sum(axis=1)
                     bytes_moved += buffer.add_round(out_cols, counts,
                                                     quota)
+                    if comb_h is not None:
+                        # per-shard pre-combine rows of the COMPLETED
+                        # round (escalation re-runs were discarded)
+                        comb_in_total += int(np.asarray(comb_h).sum())   # graft: disable=GL001 -- comb_h rode the round's host counts readback
+                        comb_out_total += int(counts.sum())
+                        comb_batches += n_live
                     if fmetrics is not None:
                         # the folded chain still owns its plan node:
                         # post-chain live rows are what the exchange
@@ -812,10 +901,14 @@ class ShuffleExchangeOp(PhysicalOp):
                         if total else 1.0)
                 metrics.counter("mesh_rounds").add(rounds)
                 metrics.counter("mesh_quota_escalations").add(escalations)
+                if combine is not None:
+                    self._note_combine(metrics, comb_in_total,
+                                       comb_out_total, comb_batches)
                 _record_route(self, metrics, "all_to_all", reason,
                               rounds=rounds, escalations=escalations,
                               bytes=bytes_moved, rows=total,
-                              devices=n_out, skew=round(skew, 3))
+                              devices=n_out, skew=round(skew, 3),
+                              **self._combine_attrs())
                 return buffer
         except BaseException:
             buffer.close()
@@ -824,7 +917,8 @@ class ShuffleExchangeOp(PhysicalOp):
         return self._demote_to_host(
             ctx, metrics, write_time, buffer, iters, pending, carries_h,
             demote_reason, rounds, escalations, bytes_moved, fragments,
-            frag_keys, fmetrics, t_demote)
+            frag_keys, fmetrics, t_demote, input_op, combine, combine_sig,
+            (comb_in_total, comb_out_total, comb_batches))
 
     def _emit_demote(self, metrics, err, rounds_done: int, plane) -> None:
         """Put the demotion DECISION on the timeline the moment it is
@@ -846,7 +940,9 @@ class ShuffleExchangeOp(PhysicalOp):
                         pending, carries_h, demote_reason: str,
                         rounds_done: int, escalations: int,
                         bytes_moved: int, fragments, frag_keys,
-                        fmetrics, t_demote: float):
+                        fmetrics, t_demote: float, input_op=None,
+                        combine=None, combine_sig=None,
+                        comb_totals=(0, 0, 0)):
         """Host continuation of a demoted exchange: the REMAINING rounds
         re-route down the existing ladder (``all_to_all`` → host
         ``device_buffer``; RSS stays the durable tier below it), run
@@ -865,44 +961,64 @@ class ShuffleExchangeOp(PhysicalOp):
         n_out = self.num_partitions
         out_schema = self.child.schema()
         part_exprs = self.partitioning.exprs
-        use_frags = bool(fragments)
-        in_schema = (self.child.input if use_frags
-                     else self.child).schema()
+        use_fused = bool(fragments) or combine is not None
+        if input_op is None:
+            input_op = self.child.input if fragments else self.child
+        in_schema = input_op.schema()
         host = _ExchangeBuffer(self, ctx.mem_manager, metrics, ctx.conf)
         sources: list[int] = []
         recompute_rows = 0
         recompute_bytes = 0
         host_rows = 0
+        comb_in_total, comb_out_total, comb_batches = comb_totals
         pending_by_map = dict(pending)
         _sync = ctx.device_sync
         from auron_tpu.obs import profile as _profile
 
         def route_batch(in_p: int, batch: DeviceBatch, carries):
-            nonlocal host_rows
+            nonlocal host_rows, comb_in_total, comb_out_total, \
+                comb_batches
             # the demoted path never donates: a classic one-launch
-            # split per batch (chain folded when the mesh program had
-            # one), entry tagged with its source map so the combined
-            # read path can interleave map-major
+            # split per batch (chain — and the map-side combine, when
+            # the mesh program had one folded — rides along), entry
+            # tagged with its source map so the combined read path can
+            # interleave map-major
             with timer(write_time, sync=_sync) as t:
-                if use_frags:
+                if use_fused:
                     kern, _built = _fused_split_program(
                         frag_keys, ("hash", part_exprs), in_schema,
                         out_schema, n_out, batch.capacity, False,
-                        fragments, part_exprs)
-                    sorted_batch, counts, carries = t.track(
-                        kern(batch, jnp.int32(in_p), carries))
+                        fragments, part_exprs, combine, combine_sig)
+                    if combine is not None:
+                        sorted_batch, counts, carries, comb_in = \
+                            t.track(kern(batch, jnp.int32(in_p),
+                                         carries))
+                        counts_h, comb_in_h = _profile.timed_get(
+                            (counts, comb_in))
+                        counts_h = np.asarray(counts_h)   # graft: disable=GL001 -- already host: read via timed_get above
+                        comb_in_total += int(comb_in_h)   # graft: disable=GL001 -- same fenced readback
+                    else:
+                        sorted_batch, counts, carries = t.track(
+                            kern(batch, jnp.int32(in_p), carries))
+                        counts_h = np.asarray(
+                            _profile.timed_get(counts))
                 else:
                     pids = self.partitioning.partition_ids(batch,
                                                            out_schema)
                     kern = _sort_by_pid_kernel(n_out, batch.capacity,
                                                False)
                     sorted_batch, counts = t.track(kern(batch, pids))
-                counts_h = np.asarray(_profile.timed_get(counts))
+                    counts_h = np.asarray(_profile.timed_get(counts))
+            n = int(counts_h.sum())
+            if combine is not None:
+                # pin the concrete group count (see _materialize_fused)
+                sorted_batch = DeviceBatch(sorted_batch.columns, n)
+                comb_out_total += n
+                comb_batches += 1
             offsets = np.concatenate(
                 [np.zeros(1, np.int64), np.cumsum(counts_h)])
             host.add(sorted_batch, offsets)
             sources.append(in_p)
-            n = int(sorted_batch.num_rows)
             host_rows += n
             if fmetrics is not None:
                 fmetrics.counter("output_rows").add(n)
@@ -911,7 +1027,7 @@ class ShuffleExchangeOp(PhysicalOp):
 
         try:
             for in_p in range(self.input_partitions):
-                if use_frags:
+                if use_fused:
                     # member carries from the last completed mesh round
                     # + the trailing split-seen slot (round-robin only —
                     # mesh routing is hash-only, the slot is inert)
@@ -941,12 +1057,16 @@ class ShuffleExchangeOp(PhysicalOp):
         latency_ms = round((time.perf_counter() - t_demote) * 1e3, 3)
         metrics.counter("mesh_rounds").add(rounds_done)
         metrics.counter("mesh_quota_escalations").add(escalations)
+        if combine is not None:
+            self._note_combine(metrics, comb_in_total, comb_out_total,
+                               comb_batches)
         _record_route(self, metrics, "demoted", demote_reason,
                       rounds=rounds_done, escalations=escalations,
                       bytes=bytes_moved, rows=host_rows,
                       recompute_rows=recompute_rows,
                       recompute_bytes=recompute_bytes,
-                      latency_ms=latency_ms, devices=n_out)
+                      latency_ms=latency_ms, devices=n_out,
+                      **self._combine_attrs())
         logger.warning(
             "mesh exchange demoted to host (%s): %d mesh round(s) kept, "
             "%d host rows routed, %d rows recomputed from the lost "
@@ -962,9 +1082,12 @@ class ShuffleExchangeOp(PhysicalOp):
         _sync = ctx.device_sync
 
         part_sig = _split_signature(self.partitioning)
-        if part_sig is not None and ctx.conf.get(cfg.FUSION_ENABLED) \
-                and self._split_fragments() is not None:
-            self._materialize_fused(ctx, buffer, write_time, part_sig)
+        fold = self._fold_spec() \
+            if part_sig is not None and ctx.conf.get(cfg.FUSION_ENABLED) \
+            else None
+        if fold is not None:
+            self._materialize_fused(ctx, buffer, write_time, part_sig,
+                                    fold)
             return buffer
 
         batches = self._input_batches(ctx)
@@ -1012,6 +1135,11 @@ class ShuffleExchangeOp(PhysicalOp):
                 from auron_tpu.obs import profile as _profile
                 counts_h = np.asarray(_profile.timed_get(counts))
             row_offset += n_in if donate else int(batch.num_rows)
+            from auron_tpu.columnar.batch import batch_nbytes
+            live_rows = int(counts_h.sum())   # graft: disable=GL001 -- counts_h is a host ndarray (timed_get above)
+            cap = max(int(sorted_batch.capacity), 1)   # graft: disable=GL001 -- capacity is a python int by construction
+            ctx.metrics_for(self).counter("shuffle_bytes_live").add(
+                batch_nbytes(sorted_batch) * live_rows // cap)
             offsets = np.concatenate(
                 [np.zeros(1, np.int64), np.cumsum(counts_h)])
             buffer.add(sorted_batch, offsets)
@@ -1033,36 +1161,73 @@ class ShuffleExchangeOp(PhysicalOp):
             return None
         return fragments, frag_keys
 
+    def _fold_spec(self):
+        """Fold-aware map side: (fragments, frag_keys, input_op,
+        combine, combine_sig) or None for the classic per-op path.
+
+        With a planner-stamped ``combine_mode`` the child IS the partial
+        AggOp being elided: the exchange executes the agg's OWN child
+        (chain fragments when one fused below it) and folds the agg's
+        combine/passthrough stage into the split program. Without one,
+        this is exactly the PR 2 chain fold (_split_fragments)."""
+        from auron_tpu.ops.fused import FusedStageOp
+        if self.combine_mode is not None:
+            agg = self.child          # planner guaranteed: eligible AggOp
+            inner = agg.child
+            fragments, frag_keys, input_op = [], (), inner
+            if isinstance(inner, FusedStageOp) and not inner.has_limit():
+                frags, keys = inner.fragment_pipeline()
+                if frags and all(f.fanout == 1 for f in frags):
+                    fragments, frag_keys, input_op = \
+                        frags, keys, inner.input
+            return (fragments, frag_keys, input_op,
+                    agg.build_combine_stage(self.combine_mode),
+                    agg.combine_signature(self.combine_mode))
+        frag_info = self._split_fragments()
+        if frag_info is None:
+            return None
+        fragments, frag_keys = frag_info
+        return fragments, frag_keys, self.child.input, None, None
+
     def _materialize_fused(self, ctx: ExecContext, buffer: _ExchangeBuffer,
-                           write_time, part_sig: tuple) -> None:
+                           write_time, part_sig: tuple,
+                           fold: tuple) -> None:
         """Whole-stage split: the child chain's member fragments join the
         exchange's partition-id + sort-by-pid program, so a
         filter→project chain feeding a hash shuffle is ONE XLA launch
-        per batch with the intermediates living only in registers/VMEM."""
+        per batch with the intermediates living only in registers/VMEM.
+        With a map-side combine folded (``fold`` carries the elided
+        partial agg's combine stage) the same launch also merges the
+        batch's groups before the split — the bytes entering the buffer
+        (and its RSS spill frames) are per-batch GROUPS, not rows."""
         n_out = self.num_partitions
         out_schema = self.child.schema()
         _sync = ctx.device_sync
         kmetrics = ctx.metrics_for("kernels")
         built_c = kmetrics.counter("fused_split_programs_built")
         hit_c = kmetrics.counter("fused_split_program_hits")
-        # the folded chain still OWNS its plan node (see the hash-join
-        # probe fold): the split is row-preserving over live rows, so
-        # the sorted batch's count IS the chain's output count, and the
-        # one-launch program's time lands on the whole-stage node
+        # the folded chain/agg still OWNS its plan node (see the
+        # hash-join probe fold): the sorted batch's live count IS the
+        # folded work's output count, and the one-launch program's time
+        # lands on the whole-stage node
         fmetrics = ctx.metrics_for(self.child)
         f_elapsed = fmetrics.counter("elapsed_compute")
         f_rows = fmetrics.counter("output_rows")
         f_batches = fmetrics.counter("output_batches")
         fmetrics.counter("split_folded").add(1)
+        metrics = ctx.metrics_for(self)
 
-        fragments, frag_keys = self._split_fragments()
-        input_op = self.child.input
+        fragments, frag_keys, input_op, combine, combine_sig = fold
         in_schema = input_op.schema()
         part_exprs = self.partitioning.exprs \
             if isinstance(self.partitioning, HashPartitioning) else ()
         donate = yields_owned_batches(input_op) \
             and jax.default_backend() != "cpu"
         init = [f.init_carry for f in fragments]
+        comb_in_total = 0
+        comb_out_total = 0
+        n_batches = 0
+        from auron_tpu.columnar.batch import batch_nbytes
 
         # the trailing carry slot (rows seen at the split — the
         # round-robin start) persists across input partitions; member
@@ -1077,25 +1242,55 @@ class ShuffleExchangeOp(PhysicalOp):
                 map_ctx.checkpoint("shuffle.map")
                 kern, built = _fused_split_program(
                     frag_keys, part_sig, in_schema, out_schema, n_out,
-                    batch.capacity, donate, fragments, part_exprs)
+                    batch.capacity, donate, fragments, part_exprs,
+                    combine, combine_sig)
                 (built_c if built else hit_c).add(1)
                 t0v = f_elapsed.value
                 with timer(f_elapsed, sync=_sync) as t:
-                    sorted_batch, counts, carries = t.track(
-                        kern(batch, jnp.int32(in_p), carries))
-                    # semantic sync point (see _materialize): the wait
-                    # books as device inside this frame
                     from auron_tpu.obs import profile as _profile
-                    counts_h = np.asarray(_profile.timed_get(counts))
+                    if combine is not None:
+                        sorted_batch, counts, carries, comb_in = t.track(
+                            kern(batch, jnp.int32(in_p), carries))
+                        # pre-combine live rows ride the SAME readback
+                        # fence as the counts (no extra sync point)
+                        counts_h, comb_in_h = _profile.timed_get(
+                            (counts, comb_in))
+                        counts_h = np.asarray(counts_h)   # graft: disable=GL001 -- already host: read via timed_get above
+                        comb_in_total += int(comb_in_h)   # graft: disable=GL001 -- same fenced readback
+                    else:
+                        sorted_batch, counts, carries = t.track(
+                            kern(batch, jnp.int32(in_p), carries))
+                        # semantic sync point (see _materialize): the
+                        # wait books as device inside this frame
+                        counts_h = np.asarray(_profile.timed_get(counts))
                 # the shuffle node keeps its canonical write-time view
                 # of the same launch (chain + split are one program)
                 write_time.add(f_elapsed.value - t0v)
-                f_rows.add(int(sorted_batch.num_rows))
+                live = int(counts_h.sum())
+                if combine is not None:
+                    # a combined batch's row count is traced (the group
+                    # count) — pin the concrete live total so buffer
+                    # bookkeeping and spill slicing never sync on it
+                    sorted_batch = DeviceBatch(sorted_batch.columns,
+                                               live)
+                    comb_out_total += live
+                    n_batches += 1
+                f_rows.add(live)
                 f_batches.add(1)
+                # honest data-movement figure for the host route: live
+                # rows × per-row width (the mesh buffer's add_round
+                # convention; the allocated batch is capacity-padded)
+                nbytes = batch_nbytes(sorted_batch)
+                cap = max(int(sorted_batch.capacity), 1)   # graft: disable=GL001 -- capacity is a python int by construction
+                metrics.counter("shuffle_bytes_live").add(
+                    nbytes * live // cap)
                 offsets = np.concatenate(
                     [np.zeros(1, np.int64), np.cumsum(counts_h)])
                 buffer.add(sorted_batch, offsets)
             split_seen = carries[-1:]
+        if combine is not None:
+            self._note_combine(metrics, comb_in_total, comb_out_total,
+                               n_batches)
 
     # -- reduce side --------------------------------------------------------
 

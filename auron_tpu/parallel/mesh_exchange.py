@@ -110,7 +110,8 @@ _STAGE_EXCHANGE_PROGRAMS = _programs.register(
 def stage_exchange_program(mesh: Mesh, axis: str, n_dev: int,
                            frag_keys: tuple, part_key: tuple,
                            in_schema, out_schema, capacity: int,
-                           quota: int, fragments, part_exprs):
+                           quota: int, fragments, part_exprs,
+                           combine=None, combine_sig=None):
     """Central-registry lookup of the sharded stage-exchange program for
     one (chain signature, hash keys, schema, capacity, quota) class.
     Returns ``(kernel, built)``.
@@ -122,11 +123,20 @@ def stage_exchange_program(mesh: Mesh, axis: str, n_dev: int,
     pipelined-execution work must not reach across the exchange
     (``yields_owned_batches`` notwithstanding).
 
+    ``combine`` (ops/agg.AggOp.build_combine_stage, keyed by
+    ``combine_sig``) is the map-side combine fold: each shard merges its
+    round's groups (or re-lays rows out in partial-state form) BETWEEN
+    the chain and the partition-id compute, so what crosses
+    ``lax.all_to_all`` is per-shard GROUPS — fewer live rows through the
+    collective, the cheapest scale-out win available. Stateless, so the
+    escalation re-run and the demoted host path replay it exactly.
+
     Kernel signature (all global, batch-dim sharded on ``axis`` unless
     noted)::
 
         kernel(columns, num_rows, carries) ->
-            (out_columns, recv_counts, out_num_rows, global_max, carries')
+            (out_columns, recv_counts, out_num_rows, global_max, carries'
+             [, combine_rows_in])
 
     - ``columns``: the stacked input batch's column pytree, every leaf
       ``[n_dev * capacity, ...]`` (shard i = map partition i's rows);
@@ -141,10 +151,13 @@ def stage_exchange_program(mesh: Mesh, axis: str, n_dev: int,
     - ``global_max``: REPLICATED int32 — the global largest bucket, the
       host's one output-boundary readback: rows were dropped iff it
       exceeds ``quota``, and its value is the exact quota the single
-      re-run needs.
+      re-run needs;
+    - ``combine_rows_in``: ``int32[n_dev]`` pre-combine live rows per
+      shard, present only when a combine stage is folded — read in the
+      same output-boundary fence (telemetry adds no sync point).
     """
     key = (frag_keys, part_key, in_schema, out_schema, n_dev, capacity,
-           quota, axis)
+           quota, axis, combine_sig)
 
     def build():
         from auron_tpu.columnar.batch import DeviceBatch, gather_batch
@@ -163,6 +176,11 @@ def stage_exchange_program(mesh: Mesh, axis: str, n_dev: int,
                 b, new_carry = chain(batch, pid_dev, carries[0])
             else:
                 b, new_carry = batch, jnp.zeros((n_frags,), jnp.int64)
+            comb_in = None
+            if combine is not None:
+                # map-side combine: this shard's round collapses to its
+                # groups before any row is offered to the collective
+                b, comb_in = combine(b)
             # partition ids on the chain output (Spark-exact pmod
             # murmur3 — the HashPartitioning contract)
             ctx = EvalContext()
@@ -208,11 +226,16 @@ def stage_exchange_program(mesh: Mesh, axis: str, n_dev: int,
                                          concat_axis=0, tiled=True)
             out_nr = jnp.sum(recv_counts).astype(jnp.int32)
             gmax = lax.pmax(max_count, axis)
+            if comb_in is not None:
+                return (out_cols, recv_counts, out_nr[None], gmax,
+                        new_carry[None, :], comb_in[None])
             return (out_cols, recv_counts, out_nr[None], gmax,
                     new_carry[None, :])
 
         in_specs = (P(axis), P(axis), P(axis, None))
         out_specs = (P(axis), P(axis), P(axis), P(), P(axis, None))
+        if combine is not None:
+            out_specs = out_specs + (P(axis),)
         # donation deliberately OFF (see docstring): programs.jit with
         # no donate_argnums, on every backend
         return _programs.jit(shard_map(local_fn, mesh=mesh,

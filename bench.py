@@ -348,6 +348,65 @@ def bench_profile_q01() -> dict:
         shutil.rmtree(data, ignore_errors=True)
 
 
+def bench_fusion2() -> dict:
+    """Map-side combine A/B (Fusion 2.0): the dup-heavy grouped-agg
+    shape — a q01-style multi-partition sum/count group-by whose key
+    domain is tiny relative to the row count — executed with
+    ``auron.fusion.combine`` on and off. Records the live shuffle bytes
+    both ways (``shuffle_bytes_live`` counts exactly what crosses the
+    exchange: batch bytes scaled by live rows), the reduction, and the
+    combined run's end-to-end rows/s. Additive like every satellite
+    metric: tools/perf_gate.py --smoke gates the reduction floor."""
+    import numpy as np
+    import pyarrow as pa
+
+    from auron_tpu import config as cfg
+    from auron_tpu.frontend import Session, col
+    from auron_tpu.frontend import functions as F
+    from auron_tpu.ops.base import ExecContext
+
+    rng = np.random.default_rng(0)
+    n = int(os.environ.get("AURON_BENCH_FUSION2_ROWS", "200000"))
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, 200, n), pa.int64()),
+        "v": pa.array(rng.integers(0, 1000, n), pa.int64()),
+    })
+    conf = cfg.get_config()
+
+    def run(combine: bool):
+        if not combine:
+            conf.set("auron.fusion.combine", "false")
+        try:
+            s = Session()
+            s.register("fusion2_bench", tbl)
+            df = (s.table("fusion2_bench").repartition(4).group_by("k")
+                  .agg(F.sum(col("v")).alias("sv"),
+                       F.count(col("v")).alias("c")))
+            op = s.plan_physical(df)
+            ctx = ExecContext()
+            t0 = time.perf_counter()
+            for p in range(df.num_partitions):
+                for _ in op.execute(p, ctx):
+                    pass
+            wall = time.perf_counter() - t0
+            m = ctx.metrics["shuffle_exchange"]
+            return m.counter("shuffle_bytes_live").value, wall
+        finally:
+            if not combine:
+                conf.unset("auron.fusion.combine")
+
+    run(True)   # warm programs so the timed runs measure execution
+    run(False)
+    b_on, w_on = run(True)
+    b_off, _w_off = run(False)
+    return {
+        "combine_shuffle_bytes_on": int(b_on),
+        "combine_shuffle_bytes_off": int(b_off),
+        "combine_byte_reduction": round(1.0 - b_on / max(1, b_off), 4),
+        "fusion2_rows_per_sec": round(n / w_on, 1),
+    }
+
+
 def bench_cpu_reference(threads: int = 1) -> float:
     """Same query via pyarrow's vectorized C++ kernels.
 
@@ -512,6 +571,7 @@ def _mesh_child_main() -> None:
         route_mix = {}
         demoted = {}
         bytes_moved = {}
+        combine_mix = {}
         for n in counts:
             # devices == partitions: the exchange's square contract; at
             # n=1 the plan has no exchange at all — the single-device
@@ -532,10 +592,22 @@ def _mesh_child_main() -> None:
             # (exchange.demote) measures the recovery path, not the
             # mesh — perf_gate must see that and skip the floor
             mix: dict = {}
+            comb = {"folds": 0, "rows_in": 0, "rows_out": 0}
             for s in spans:
                 if s.name == "exchange.route":
                     r = s.attrs.get("route", "?")
                     mix[r] = mix.get(r, 0) + 1
+                    # combine-fold attrs ride the route event on every
+                    # route (all_to_all, device_buffer, demoted): their
+                    # presence on a demoted run is how perf_gate tells
+                    # "mesh recovered mid-combine" from "combine off"
+                    if s.attrs.get("combine_mode"):
+                        comb["folds"] += 1
+                        comb["rows_in"] += int(
+                            s.attrs.get("combine_rows_in", 0))
+                        comb["rows_out"] += int(
+                            s.attrs.get("combine_rows_out", 0))
+            combine_mix[str(n)] = comb
             per_count[str(n)] = round(rows / best, 1)
             routes[str(n)] = len(evs)
             route_mix[str(n)] = mix
@@ -549,6 +621,7 @@ def _mesh_child_main() -> None:
         record["route_mix_by_devices"] = route_mix
         record["route_demoted_by_devices"] = demoted
         record["mesh_bytes_moved_by_devices"] = bytes_moved
+        record["combine_by_devices"] = combine_mix
         top = str(max(counts))
         # any multi-device top count MUST have ridden the all-to-all —
         # keyed on the top count itself, not the sweep width, so a
@@ -691,6 +764,12 @@ def _child_main() -> None:
         result["profile"] = bench_profile_q01()
     except Exception as e:   # additive: never lose the earlier data
         result["profile_error"] = str(e)[:300]
+    try:
+        # Fusion 2.0 map-side combine A/B (shuffle-byte reduction +
+        # combined-run throughput — the perf_gate --smoke fusion floor)
+        result.update(bench_fusion2())
+    except Exception as e:   # additive: never lose the earlier data
+        result["fusion2_error"] = str(e)[:300]
     # persistent-compile-cache economics of this run (satellite of the
     # pipelined-execution PR: warm rounds stop re-paying q01's tracing)
     result["xla_cache"] = _finish_xla_cache(xla_cache)

@@ -276,3 +276,238 @@ def test_q01_pipeline_compile_budget(fusion_on):
     # at a second bucket, sort); headroom for capacity re-bucketing only
     assert d.builds <= 6, \
         f"fused q01 pipeline built {d.builds} programs (budget 6)"
+
+
+# ---------------------------------------------------------------------------
+# Fusion 2.0: map-side combine + cost-based plan selection
+# ---------------------------------------------------------------------------
+
+def _grouped_session(n=20000, keys=50, seed=0):
+    """Dup-heavy grouped-agg shape: tiny key domain vs row count, the
+    case map-side combine exists for."""
+    rng = np.random.default_rng(seed)
+    s = Session()
+    s.register("g", pa.table({
+        "k": pa.array(rng.integers(0, keys, n), pa.int64()),
+        "v": pa.array(rng.integers(0, 1000, n), pa.int64()),
+        "f": pa.array(rng.normal(size=n), pa.float64()),
+    }))
+    return s
+
+
+def test_combine_eligibility_vocabulary(fusion_on):
+    """combine_fold_reason: exact kinds (int sum/count) fold; a float
+    sum refuses — segment-reducing in a different order than the
+    reducer would reassociate float adds, and the fold's contract is
+    bit-identity, not approximate equality."""
+    from auron_tpu.ops.agg import AggOp
+    s = _grouped_session()
+    df = (s.table("g").repartition(4)
+          .group_by("k").agg(F.sum(col("v")).alias("sv"),
+                             F.count(col("v")).alias("n")))
+    partials = [o for o in _walk(s.plan_physical(df))
+                if isinstance(o, AggOp) and o.mode == "partial"]
+    assert partials and partials[0].combine_fold_reason() is None
+    df_f = (s.table("g").repartition(4)
+            .group_by("k").agg(F.sum(col("f")).alias("sf")))
+    partials = [o for o in _walk(s.plan_physical(df_f))
+                if isinstance(o, AggOp) and o.mode == "partial"]
+    assert partials
+    assert partials[0].combine_fold_reason() == "float_sum_inexact"
+
+
+def test_planner_stamps_combine_mode_and_knob(fusion_on):
+    """The selection walk stamps the exchange: combine by default on an
+    eligible site, passthrough (state rows cross uncombined) when the
+    combine knob is off, and no fold at all — with the explain reason —
+    on an ineligible float sum."""
+    from auron_tpu.parallel.exchange import ShuffleExchangeOp
+    conf = cfg.get_config()
+    s = _grouped_session()
+    df = (s.table("g").repartition(4)
+          .group_by("k").agg(F.sum(col("v")).alias("sv")))
+
+    def exchange_of(frame):
+        ex = [o for o in _walk(s.plan_physical(frame))
+              if isinstance(o, ShuffleExchangeOp)]
+        assert ex
+        return ex[0]
+
+    assert exchange_of(df).combine_mode == "combine"
+    conf.set(cfg.FUSION_COMBINE, False)
+    try:
+        ex = exchange_of(df)
+        assert ex.combine_mode == "passthrough"
+        assert ex.combine_why == "combine_off"
+    finally:
+        conf.unset(cfg.FUSION_COMBINE)
+    df_f = (s.table("g").repartition(4)
+            .group_by("k").agg(F.sum(col("f")).alias("sf")))
+    ex = exchange_of(df_f)
+    assert ex.combine_mode is None
+    assert ex.combine_why == "float_sum_inexact"
+
+
+def test_combine_bit_identical_and_fewer_shuffle_bytes(fusion_on):
+    """The fold's whole contract in one run: combine on vs off return
+    byte-identical tables (values AND order) while the combined run
+    ships strictly fewer live bytes across the exchange and books its
+    rows-in/rows-out counters honestly."""
+    from auron_tpu.ops.base import ExecContext
+    conf = cfg.get_config()
+
+    def run(combine: bool):
+        if not combine:
+            conf.set(cfg.FUSION_COMBINE, False)
+        try:
+            s = _grouped_session(seed=3)
+            df = (s.table("g").repartition(4)
+                  .group_by("k").agg(F.sum(col("v")).alias("sv"),
+                                     F.count(col("v")).alias("n")))
+            op = s.plan_physical(df)
+            ctx = ExecContext()
+            rows = []
+            for p in range(df.num_partitions):
+                for b in op.execute(p, ctx):
+                    n = int(b.num_rows)
+                    rows.extend(zip(
+                        np.asarray(b.columns[0].data[:n]).tolist(),
+                        np.asarray(b.columns[1].data[:n]).tolist(),
+                        np.asarray(b.columns[2].data[:n]).tolist()))
+            m = ctx.metrics["shuffle_exchange"]
+            return (rows, m.counter("shuffle_bytes_live").value,
+                    m.counter("combine_rows_in").value,
+                    m.counter("combine_rows_out").value)
+        finally:
+            conf.unset(cfg.FUSION_COMBINE)
+
+    rows_on, bytes_on, in_on, out_on = run(True)
+    rows_off, bytes_off, in_off, out_off = run(False)
+    assert rows_on == rows_off          # bit-identical, order included
+    assert 0 < bytes_on < bytes_off
+    assert in_on > out_on > 0           # the fold merged groups...
+    assert in_off == out_off            # ...passthrough ships them all
+
+
+def test_cost_model_selects_against_history():
+    """ir/cost.choose_exchange_mode: greedy when the model is off; the
+    static prior combines on a fresh site; an observed ratio of ~1.0
+    (high-cardinality keys — the sort buys nothing) flips the SAME site
+    to passthrough while a dup-heavy site keeps combining."""
+    from auron_tpu.ir import cost
+    conf = cfg.get_config()
+    cost.clear()
+    site, site2 = ("fp-unit", "x0"), ("fp-unit", "x1")
+    try:
+        conf.set(cfg.FUSION_COST_MODEL, False)
+        try:
+            assert cost.choose_exchange_mode(conf, site, 65536) \
+                == ("combine", "greedy")
+        finally:
+            conf.unset(cfg.FUSION_COST_MODEL)
+        mode, why = cost.choose_exchange_mode(conf, site, 65536)
+        assert mode == "combine" and why.startswith("prior")
+        cost.observe(site, 100_000, 100_000, 2)
+        mode, why = cost.choose_exchange_mode(conf, site, 65536)
+        assert mode == "passthrough" and why.startswith("observed")
+        cost.observe(site2, 100_000, 500, 2)
+        assert cost.choose_exchange_mode(conf, site2, 65536)[0] \
+            == "combine"
+    finally:
+        cost.clear()
+
+
+def test_probe_fold_declined_on_starved_history():
+    """choose_probe_fold: fold by default (greedy and the no-history
+    prior), declined once observed probe output rows per batch fall
+    under the amortization floor."""
+    from auron_tpu.ir import cost
+    conf = cfg.get_config()
+    cost.clear()
+    site = ("fp-unit", "j0")
+    try:
+        assert cost.choose_probe_fold(conf, site)
+        cost.observe(site, 10, 10, 100)   # 0.1 rows/batch: starved
+        assert not cost.choose_probe_fold(conf, site)
+        site2 = ("fp-unit", "j1")
+        cost.observe(site2, 100_000, 100_000, 10)
+        assert cost.choose_probe_fold(conf, site2)
+    finally:
+        cost.clear()
+
+
+def test_probe_into_consumer_fold_counted_and_bit_identical(
+        fusion_on, monkeypatch):
+    """An inner join under a fused consumer chain runs gather + chain
+    as ONE program (probe_consumer_folded counts it) and returns the
+    same table as the unfused plan, which a monkeypatched selector
+    forces for the B side."""
+    from auron_tpu.ops.base import ExecContext
+    from auron_tpu.ops.fused import FusedStageOp
+    from auron_tpu.ops.joins import HashJoinOp
+    rng = np.random.default_rng(9)
+    n = 8000
+    left = pa.table({
+        "k": pa.array(rng.integers(0, 500, n), pa.int64()),
+        "v": pa.array(rng.integers(0, 1000, n), pa.int64()),
+    })
+    right = pa.table({
+        "k": pa.array(rng.integers(0, 500, 600), pa.int64()),
+        "w": pa.array(rng.integers(0, 9, 600), pa.int64()),
+    })
+
+    def run():
+        s = Session()
+        s.register("l", left)
+        s.register("r", right)
+        df = (s.table("l").join(s.table("r"), on="k")
+              .filter(col("v") > 100)
+              .with_column("z", col("v") + col("w")))
+        op = s.plan_physical(df)
+        stages = [o for o in _walk(op) if isinstance(o, FusedStageOp)
+                  and isinstance(o.input, HashJoinOp)]
+        assert stages, "consumer chain did not fuse over the join"
+        ctx = ExecContext()
+        rows = []
+        for p in range(df.num_partitions):
+            for b in op.execute(p, ctx):
+                m = int(b.num_rows)
+                rows.extend(zip(*(np.asarray(c.data[:m]).tolist()
+                                  for c in b.columns)))
+        folded = ctx.metrics["fused_stage"].counter(
+            "probe_consumer_folded").value
+        return sorted(rows), folded
+
+    rows_folded, n_folded = run()
+    assert n_folded >= 1
+    from auron_tpu.ir import cost
+    monkeypatch.setattr(cost, "choose_probe_fold",
+                        lambda conf, site: False)
+    rows_unfused, n_unfused = run()
+    assert n_unfused == 0
+    assert rows_folded == rows_unfused
+
+
+def test_combined_exchange_program_reused_across_runs(fusion_on):
+    """Compile budget for the fold: the SAME dup-heavy grouped agg run
+    twice builds its combined split program once — the combine stage
+    rides the split-program cache key, it must not defeat it."""
+    from auron_tpu.ops.base import ExecContext
+    s = _grouped_session(seed=17)
+    df = (s.table("g").repartition(4)
+          .group_by("k").agg(F.sum(col("v")).alias("sv")))
+
+    def run():
+        op = s.plan_physical(df)
+        ctx = ExecContext()
+        for p in range(df.num_partitions):
+            for _ in op.execute(p, ctx):
+                pass
+
+    run()
+    p0 = programs.totals()
+    run()
+    d = programs.delta(p0)
+    assert d.builds == 0, \
+        f"second identical combined run rebuilt {d.builds} program(s)"
+    assert d.hits >= 1

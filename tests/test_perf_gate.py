@@ -301,6 +301,14 @@ class TestPerfGate:
         # parseable /metrics scrapes mid-q01, SLO family present
         assert last["ops_gate"] == "pass"
         assert last["ops_scrapes"] >= 1
+        # the Fusion 2.0 gate (PR 17): map-side combine engaged (the
+        # combined run shipped strictly fewer live shuffle bytes) and
+        # the reduction clears the baseline floor
+        assert last["fusion_gate"] == "pass"
+        assert 0 < last["combine_shuffle_bytes_on"] \
+            < last["combine_shuffle_bytes_off"]
+        assert last["combine_byte_reduction"] \
+            >= last["combine_byte_reduction_floor"]
 
     def test_ops_gate_scrape_rejects_seeded_regressions(
             self, monkeypatch):
@@ -366,6 +374,8 @@ class TestPerfGate:
                                             "ops_scrapes": 1})
         monkeypatch.setattr(perf_gate, "run_lint_gate",
                             lambda: {"lint_gate": "pass", "lint_new": 0})
+        monkeypatch.setattr(perf_gate, "run_fusion_gate",
+                            lambda smoke: {"fusion_gate": "pass"})
         rc = perf_gate.main(["--smoke"])
         out = capsys.readouterr().out
         last = json.loads(out.strip().splitlines()[-1])
@@ -392,6 +402,8 @@ class TestPerfGate:
                                             "ops_scrapes": 1})
         monkeypatch.setattr(perf_gate, "run_lint_gate",
                             lambda: {"lint_gate": "pass", "lint_new": 0})
+        monkeypatch.setattr(perf_gate, "run_fusion_gate",
+                            lambda smoke: {"fusion_gate": "pass"})
         rc = perf_gate.main(["--smoke"])
         out = capsys.readouterr().out
         last = json.loads(out.strip().splitlines()[-1])
@@ -399,6 +411,45 @@ class TestPerfGate:
         assert last["perf_gate"] == "fail"
         assert last["cache_gate"] == "fail"
         assert "AOT warmer errored" in last["reason"]
+
+    def test_fusion_gate_fails_on_disengaged_combine(self, monkeypatch):
+        """The fusion arm's seeded regression: a map-side combine that
+        SILENTLY disengaged (the A/B ships identical live shuffle
+        bytes both ways — exactly what a broken eligibility check or a
+        dead fold would measure) must fail the arm loudly, not pass on
+        a vacuous 0% reduction, and a dark byte ledger (zero counters)
+        must fail rather than divide its way to a pass. Runs the arm
+        directly on stubbed bench numbers — the engagement checks are
+        pure verdict logic."""
+        import bench
+        monkeypatch.setattr(bench, "bench_fusion2", lambda: {
+            "combine_shuffle_bytes_on": 9_400_000,
+            "combine_shuffle_bytes_off": 9_400_000,
+            "combine_byte_reduction": 0.0,
+            "fusion2_rows_per_sec": 1.0})
+        out = perf_gate.run_fusion_gate({})
+        assert out["fusion_gate"] == "fail"
+        assert "silently disengaged" in out["fusion_error"]
+        monkeypatch.setattr(bench, "bench_fusion2", lambda: {
+            "combine_shuffle_bytes_on": 0,
+            "combine_shuffle_bytes_off": 0,
+            "combine_byte_reduction": 0.0,
+            "fusion2_rows_per_sec": 1.0})
+        out = perf_gate.run_fusion_gate({})
+        assert out["fusion_gate"] == "fail"
+        assert "ledger went dark" in out["fusion_error"]
+        # a half-broken fold (reduction below the floor but nonzero)
+        # fails on the floor, with the measured number in the verdict
+        monkeypatch.setattr(bench, "bench_fusion2", lambda: {
+            "combine_shuffle_bytes_on": 8_000_000,
+            "combine_shuffle_bytes_off": 9_400_000,
+            "combine_byte_reduction": 0.149,
+            "fusion2_rows_per_sec": 1.0})
+        out = perf_gate.run_fusion_gate(
+            {"combine_byte_reduction_floor": 0.40})
+        assert out["fusion_gate"] == "fail"
+        assert "floor" in out["fusion_error"]
+        assert out["combine_byte_reduction_floor"] == 0.40
 
     def test_unusable_records(self):
         base = _baseline()

@@ -396,8 +396,12 @@ class TestAotPlane:
             try:
                 s2 = Session()
                 try:
-                    assert _aot.last_stats() == {
-                        "warmed": 1, "skipped": 0, "errors": []}
+                    # the warm rides a background thread now: join it
+                    # before reading the final summary
+                    assert _aot.wait(timeout=120.0)
+                    st = _aot.last_stats()
+                    assert (st["warmed"], st["skipped"],
+                            st["errors"]) == (1, 0, [])
                     got = _agg_df(s2, path).collect()
                 finally:
                     s2.close()
@@ -405,6 +409,61 @@ class TestAotPlane:
                 conf.unset(cfg.CACHE_AOT_TOP_N)
             assert got.equals(expected)
             assert cache_on.stats()["hits"] >= 1   # warm left it ready
+        finally:
+            conf.unset(cfg.XLA_CACHE_DIR)
+
+    def test_warm_overlaps_init_instead_of_blocking(self, tmp_path,
+                                                    cache_on,
+                                                    monkeypatch):
+        """Session construction no longer serializes behind the warmer:
+        with a deliberately stalled ``_warm_inner``, Session() returns
+        while the warm is still in flight (``wait(0)`` is False), the
+        stall releases, ``wait()`` joins, and ``last_stats`` reports
+        both the completed warm and the wall it ran OFF the init path
+        (``overlapped_ms`` > 0)."""
+        import threading
+        import time
+
+        conf = cfg.get_config()
+        conf.set(cfg.XLA_CACHE_DIR, str(tmp_path / "xla"))
+        try:
+            path = tmp_path / "t.parquet"
+            _write_parquet(path)
+            s = Session()
+            try:
+                _agg_df(s, path).collect()   # record the inventory
+            finally:
+                s.close()
+            started, release = threading.Event(), threading.Event()
+            real = _aot._warm_inner
+
+            def stalled(session, conf_, top_n):
+                started.set()
+                release.wait(30)
+                return real(session, conf_, top_n)
+
+            monkeypatch.setattr(_aot, "_warm_inner", stalled)
+            conf.set(cfg.CACHE_AOT_TOP_N, 2)
+            try:
+                t0 = time.perf_counter()
+                s2 = Session()
+                init_s = time.perf_counter() - t0
+                try:
+                    assert started.wait(30)          # warm IS running
+                    assert not _aot.wait(timeout=0)  # ...still in flight
+                    release.set()
+                    assert _aot.wait(timeout=120.0)
+                    st = _aot.last_stats()
+                    assert st["warmed"] == 1 and st["errors"] == []
+                    assert st["overlapped_ms"] > 0
+                finally:
+                    s2.close()
+            finally:
+                conf.unset(cfg.CACHE_AOT_TOP_N)
+            # construction returned while the stalled warm held the
+            # thread — the synchronous era would have sat out the full
+            # 30s stall here
+            assert init_s < 10.0
         finally:
             conf.unset(cfg.XLA_CACHE_DIR)
 
@@ -471,6 +530,7 @@ class TestAotPlane:
         try:
             s = Session()
             try:
+                assert _aot.wait(timeout=120.0)
                 st = _aot.last_stats()
                 assert st["errors"] == []
                 assert st["warmed"] == 1

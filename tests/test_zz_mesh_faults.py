@@ -592,3 +592,75 @@ def test_session_query_demotes_bit_identical(mesh_on, armed):
     assert got.equals(base), \
         "sharded query demotion diverged from single-device (values " \
         "or group order)"
+
+
+@needs_mesh
+@pytest.mark.parametrize("round_idx", [0, 2])
+def test_fatal_mid_combined_exchange_demotes_bit_identical(
+        round_idx, mesh_on, armed):
+    """Fusion 2.0 chaos case: a fatal all_to_all fault mid-COMBINED
+    exchange (the map-side combine stage folded into the staged mesh
+    program) demotes to the host route with the combine threading
+    intact — bit-identical rows AND order vs the fault-free
+    single-device run, the demotion recorded, and the demoted run still
+    booking honest combine counters (rows_in > rows_out > 0: the host
+    continuation combines too, it does not silently passthrough)."""
+    from auron_tpu.frontend import Session, col, functions as F
+    from auron_tpu.ops.base import ExecContext
+    from auron_tpu.parallel.exchange import ShuffleExchangeOp
+
+    rng = np.random.default_rng(29)
+    n = 8000
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, 40, n), pa.int64()),
+        "v": pa.array(rng.integers(0, 1000, n), pa.int64()),
+    })
+
+    def plan():
+        # capacity 512 -> 4 batches per map -> 4 all_to_all rounds, so
+        # both parametrized fault indices land MID-exchange
+        s = Session(batch_capacity=512)
+        s.register("cx", tbl)
+        df = (s.table("cx").repartition(4)
+              .group_by("k").agg(F.sum(col("v")).alias("sv"),
+                                 F.count(col("v")).alias("n")))
+        return df, s.plan_physical(df)
+
+    def walk(o):
+        yield o
+        for c in o.children:
+            yield from walk(c)
+
+    def run(op, parts):
+        ctx = ExecContext()
+        rows = []
+        for p in range(parts):
+            for b in op.execute(p, ctx):
+                m = int(b.num_rows)
+                rows.extend(zip(*(np.asarray(c.data[:m]).tolist()
+                                  for c in b.columns)))
+        return rows, ctx
+
+    conf = cfg.get_config()
+    conf.unset(cfg.MESH_ENABLED)
+    df, op = plan()
+    classic, _ = run(op, df.num_partitions)
+    conf.set(cfg.MESH_ENABLED, True)
+
+    armed(f"mesh.all_to_all:fatal@{_PROB}",
+          _seed_for_round(round_idx, "fatal", _PROB))
+    df, op = plan()
+    ex = [o for o in walk(op) if isinstance(o, ShuffleExchangeOp)]
+    # the exchange really is combined — this must not silently decay
+    # into a plain-exchange demotion test
+    assert ex and ex[0].combine_mode == "combine", \
+        f"exchange not combined: {ex and ex[0].combine_why}"
+    got, ctx = run(op, df.num_partitions)
+    assert got == classic, \
+        f"demotion at round {round_idx} mid-combined-exchange " \
+        f"diverged from the single-device run (values or order)"
+    m = ctx.metrics["shuffle_exchange"]
+    assert m.counter("exchange_route_demoted").value == 1
+    rows_in = m.counter("combine_rows_in").value
+    rows_out = m.counter("combine_rows_out").value
+    assert rows_in > rows_out > 0, (rows_in, rows_out)

@@ -78,12 +78,22 @@ def summarize(events: list[dict]) -> dict:
         a = e.get("attrs", {})
         r = a.get("route", "?")
         ent = agg.setdefault(r, {"exchanges": 0, "bytes": 0, "rows": 0,
-                                 "rounds": 0, "escalations": 0})
+                                 "rounds": 0, "escalations": 0,
+                                 "combined": 0, "combine_rows_in": 0,
+                                 "combine_rows_out": 0})
         ent["exchanges"] += 1
         ent["bytes"] += int(a.get("bytes", 0))
         ent["rows"] += int(a.get("rows", 0))
         ent["rounds"] += int(a.get("rounds", 0))
         ent["escalations"] += int(a.get("escalations", 0))
+        # Fusion 2.0 map-side combine telemetry (attrs present only on
+        # folded exchanges): how many rows the fold saw vs shipped — the
+        # route mix distinguishes a demoted combined run (combine attrs
+        # on a 'demoted' route) from a combine-off run (no attrs at all)
+        if a.get("combine_mode"):
+            ent["combined"] += 1
+            ent["combine_rows_in"] += int(a.get("combine_rows_in", 0))
+            ent["combine_rows_out"] += int(a.get("combine_rows_out", 0))
     gangs = gang_events(events)
     demotes = demote_events(events)
     dem_by_reason: dict = {}
@@ -117,24 +127,36 @@ def print_table(events: list[dict]) -> None:
     else:
         hdr = (f"{'route':<14} {'reason':<28} {'parts':>5} {'maps':>5} "
                f"{'rounds':>6} {'esc':>4} {'rows':>10} {'bytes':>12} "
-               f"{'skew':>6}")
+               f"{'skew':>6} {'combine':>12}")
         print(hdr)
         print("-" * len(hdr))
         for e in routes:
             a = e.get("attrs", {})
+            comb = ""
+            if a.get("combine_mode"):
+                comb = f"{a['combine_mode'][:7]}:" \
+                       f"{a.get('combine_ratio', '')}"
             print(f"{a.get('route', '?'):<14} "
                   f"{str(a.get('reason', ''))[:28]:<28} "
                   f"{a.get('partitions', ''):>5} {a.get('maps', ''):>5} "
                   f"{a.get('rounds', ''):>6} {a.get('escalations', ''):>4} "
                   f"{a.get('rows', ''):>10} {a.get('bytes', ''):>12} "
-                  f"{a.get('skew', ''):>6}")
+                  f"{a.get('skew', ''):>6} {comb:>12}")
     s = summarize(events)
     print()
     for r, ent in sorted(s["by_route"].items()):
-        print(f"{r}: {ent['exchanges']} exchange(s), "
-              f"{ent['bytes']:,} bytes, {ent['rows']:,} rows, "
-              f"{ent['rounds']} round(s), "
-              f"{ent['escalations']} quota escalation(s)")
+        line = (f"{r}: {ent['exchanges']} exchange(s), "
+                f"{ent['bytes']:,} bytes, {ent['rows']:,} rows, "
+                f"{ent['rounds']} round(s), "
+                f"{ent['escalations']} quota escalation(s)")
+        if ent["combined"]:
+            ratio = (ent["combine_rows_out"]
+                     / max(1, ent["combine_rows_in"]))
+            line += (f", {ent['combined']} combined fold(s) "
+                     f"({ent['combine_rows_in']:,} -> "
+                     f"{ent['combine_rows_out']:,} rows, "
+                     f"ratio {ratio:.3f})")
+        print(line)
     g = s["gang"]
     if g["acquisitions"]:
         print(f"mesh gang: {g['acquisitions']} acquisition(s), "
@@ -159,13 +181,31 @@ def print_compare(base_dir: str, cand_dir: str) -> None:
     cand = summarize(load_events(cand_dir))
     routes = sorted(set(base["by_route"]) | set(cand["by_route"]))
     print(f"{'route':<14} {'base ex':>8} {'cand ex':>8} "
-          f"{'base bytes':>14} {'cand bytes':>14}")
+          f"{'base bytes':>14} {'cand bytes':>14} "
+          f"{'base comb':>10} {'cand comb':>10}")
     for r in routes:
         b = base["by_route"].get(r, {})
         c = cand["by_route"].get(r, {})
         print(f"{r:<14} {b.get('exchanges', 0):>8} "
               f"{c.get('exchanges', 0):>8} "
-              f"{b.get('bytes', 0):>14,} {c.get('bytes', 0):>14,}")
+              f"{b.get('bytes', 0):>14,} {c.get('bytes', 0):>14,} "
+              f"{b.get('combined', 0):>10} {c.get('combined', 0):>10}")
+    # combine-fold delta: shipped-row reduction side by side — a
+    # candidate whose folds vanished (combine silently off) shows up as
+    # combined exchanges dropping to zero, not as a bytes mystery
+    bci, bco = (sum(e.get("combine_rows_in", 0)
+                    for e in base["by_route"].values()),
+                sum(e.get("combine_rows_out", 0)
+                    for e in base["by_route"].values()))
+    cci, cco = (sum(e.get("combine_rows_in", 0)
+                    for e in cand["by_route"].values()),
+                sum(e.get("combine_rows_out", 0)
+                    for e in cand["by_route"].values()))
+    if bci or cci:
+        print(f"{'combine rows':<14} base {bci:,} -> {bco:,} "
+              f"(ratio {bco / max(1, bci):.3f}); "
+              f"cand {cci:,} -> {cco:,} "
+              f"(ratio {cco / max(1, cci):.3f})")
     print(f"gang waits: base {base['gang']['wait_ms']}ms "
           f"({base['gang']['acquisitions']} acq) -> cand "
           f"{cand['gang']['wait_ms']}ms "

@@ -479,9 +479,14 @@ def run_cache_gate(tables, smoke: dict) -> dict:
             Session().close()
         finally:
             conf.unset(cfg.CACHE_AOT_TOP_N)
+        # the warmer runs on a background thread since Fusion 2.0's
+        # overlap work; close() joins it, but join explicitly anyway —
+        # this arm must gate the FINAL summary, not an in-flight one
+        _aot.wait(timeout=120.0)
         aot = _aot.last_stats()
         out["aot_warmed"] = aot["warmed"]
         out["aot_errors"] = len(aot["errors"])
+        out["aot_overlapped_ms"] = aot.get("overlapped_ms", 0.0)
         if aot["errors"]:
             out["cache_gate"] = "fail"
             out["cache_error"] = (
@@ -503,6 +508,54 @@ def run_cache_gate(tables, smoke: dict) -> dict:
             except Exception:   # noqa: BLE001 — best-effort restore
                 pass
         shutil.rmtree(aot_root, ignore_errors=True)
+
+
+def run_fusion_gate(smoke: dict) -> dict:
+    """Fusion 2.0 map-side-combine arm: the dup-heavy grouped-agg A/B
+    (bench.bench_fusion2 — ``auron.fusion.combine`` on vs off over a
+    tiny-key-domain multi-partition group-by) must cut the LIVE shuffle
+    bytes by at least ``smoke.combine_byte_reduction_floor``. A run
+    whose byte counters read zero (the exchange's live-bytes ledger
+    went dark), or whose combined run shipped no fewer bytes than
+    combine-off (the fold silently disengaged — the seeded-regression
+    mode this arm exists to catch), fails loudly rather than gating a
+    vacuous measurement. Returns
+    ``{"fusion_gate": "pass"|"fail", "combine_byte_reduction": ...}``."""
+    from bench import bench_fusion2
+    floor = float(smoke.get("combine_byte_reduction_floor", 0.40))
+    try:
+        r = bench_fusion2()
+    except Exception as e:   # noqa: BLE001 — verdict, not a crash
+        return {"fusion_gate": "fail",
+                "fusion_error": f"{type(e).__name__}: {e}"}
+    on = int(r.get("combine_shuffle_bytes_on", 0))
+    off = int(r.get("combine_shuffle_bytes_off", 0))
+    out = {
+        "fusion_gate": "pass",
+        "combine_byte_reduction": r.get("combine_byte_reduction", 0.0),
+        "combine_byte_reduction_floor": floor,
+        "combine_shuffle_bytes_on": on,
+        "combine_shuffle_bytes_off": off,
+        "fusion2_rows_per_sec": r.get("fusion2_rows_per_sec", 0.0),
+    }
+    if not on or not off:
+        out["fusion_gate"] = "fail"
+        out["fusion_error"] = (
+            "shuffle byte counters read zero — the exchange's "
+            "live-bytes ledger went dark, nothing to gate")
+    elif on >= off:
+        out["fusion_gate"] = "fail"
+        out["fusion_error"] = (
+            f"combined run shipped no fewer shuffle bytes than "
+            f"combine-off ({on:,} vs {off:,}) — map-side combine "
+            f"silently disengaged")
+    elif out["combine_byte_reduction"] < floor:
+        out["fusion_gate"] = "fail"
+        out["fusion_error"] = (
+            f"shuffle-byte reduction "
+            f"{out['combine_byte_reduction']:.1%} < floor {floor:.0%} "
+            f"(map-side-combine gate)")
+    return out
 
 
 def run_smoke(baseline: dict) -> dict:
@@ -533,7 +586,12 @@ def run_smoke(baseline: dict) -> dict:
     ``auron.cache.*`` armed, a repeated identical q01 must be served
     from the result cache bit-identically and at least
     ``smoke.cache_speedup_floor_x`` times faster than fresh, and the
-    AOT warmer must replay the recorded plan with zero errors."""
+    AOT warmer must replay the recorded plan with zero errors.
+
+    And as the FUSION 2.0 gate (``run_fusion_gate``): map-side combine
+    must cut the dup-heavy grouped-agg A/B's live shuffle bytes by at
+    least ``smoke.combine_byte_reduction_floor`` — a fold that silently
+    disengaged ships exactly the combine-off bytes and fails here."""
     import tempfile
     import time
 
@@ -625,6 +683,15 @@ def run_smoke(baseline: dict) -> dict:
             verdict["perf_gate"] = "fail"
             verdict["reason"] = (
                 f"cache gate: {verdict.get('cache_error', 'failed')}")
+        # Fusion 2.0 arm: map-side combine must still cut the live
+        # shuffle bytes of the dup-heavy grouped-agg A/B by the floor
+        # (a silently disengaged fold fails loudly, not as a bytes tie)
+        verdict.update(run_fusion_gate(smoke))
+        if verdict["fusion_gate"] != "pass" \
+                and verdict["perf_gate"] == "pass":
+            verdict["perf_gate"] = "fail"
+            verdict["reason"] = (
+                f"fusion gate: {verdict.get('fusion_error', 'failed')}")
         # ops-plane arm: the live telemetry endpoint must expose a
         # parseable /metrics carrying the SLO histogram, scraped WHILE
         # q01 runs (unparseable exposition or a vanished
@@ -683,8 +750,11 @@ def main(argv=None) -> int:
               f"{verdict['journal_overhead_limit_pct']:.0f}%), cache "
               f"{verdict.get('cache_speedup_x', '?')}x (floor "
               f"{verdict.get('cache_speedup_floor_x', '?')}x, aot "
-              f"{verdict.get('aot_warmed', '?')} warmed), lint "
-              f"{verdict.get('lint_new', '?')} new → "
+              f"{verdict.get('aot_warmed', '?')} warmed), combine "
+              f"-{verdict.get('combine_byte_reduction', 0) * 100:.0f}% "
+              f"shuffle bytes (floor "
+              f"-{verdict.get('combine_byte_reduction_floor', 0) * 100:.0f}%), "
+              f"lint {verdict.get('lint_new', '?')} new → "
               f"{verdict['perf_gate'].upper()}")
         print(json.dumps(verdict))
         return 0 if verdict["perf_gate"] == "pass" else 1
