@@ -17,7 +17,8 @@ from auron_tpu.analysis.core import FileContext, Project, Rule, rule
 # directory scopes (repo-relative prefixes)
 _RUNTIME_DIRS = ("auron_tpu/ops/", "auron_tpu/runtime/",
                  "auron_tpu/parallel/")
-_TAXONOMY_DIRS = ("auron_tpu/runtime/", "auron_tpu/ops/")
+_TAXONOMY_DIRS = ("auron_tpu/runtime/", "auron_tpu/ops/",
+                  "auron_tpu/fleet/")
 _OPERATOR_DIRS = ("auron_tpu/ops/", "auron_tpu/parallel/",
                   "auron_tpu/io/", "auron_tpu/runtime/")
 

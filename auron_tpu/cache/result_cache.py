@@ -134,6 +134,15 @@ class QueryResultCache:
     def put_result(self, key, table) -> bool:
         return self._put(key, table, _table_nbytes(table), "result")
 
+    def warm_plan_fps(self) -> list:
+        """Sorted plan fingerprints of every live entry (result AND
+        subplan planes) — the process's warm inventory, scraped by the
+        fleet router's affinity routing so a re-submission lands where
+        its 173x warm path already lives. Fingerprints only: no keys,
+        no values, nothing an ops scrape could leak."""
+        with self._lock:
+            return sorted({key[0] for key in self._entries})
+
     def get_subplan(self, key):
         """Cached broadcast entry list for ``key``, or None."""
         return self._get(key, "subplan")

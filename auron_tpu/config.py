@@ -436,7 +436,11 @@ FAULTS_PLAN = _opt(
     "journal.{write,commit,load} (the crash-safe query journal: write/"
     "commit faults degrade journaling to off for that query — the run "
     "completes identical, resumability is lost; load faults surface "
-    "the classified JournalCorrupt / fresh-run fallback) with kinds "
+    "the classified JournalCorrupt / fresh-run fallback) and "
+    "fleet.{route,forward} (the fleet router: route faults fail the "
+    "routing decision before any replica is contacted, forward faults "
+    "break/hang the router→replica conversation mid-stream — both "
+    "exercise the spill-over and failover recovery paths) with kinds "
     "io_error | fatal | corrupt | "
     "hang | cancel | deny (prob defaults to 1.0). Injected hangs poll "
     "the task's cancel registry, 'cancel' fires the task's CancelToken "
@@ -593,6 +597,45 @@ OPS_PORT = _opt(
     "port, logged at startup and surfaced as Session.ops_address / the "
     "AuronServer stats 'ops_port' entry (and on the serving STATS "
     "frame), so a supervisor can discover it without parsing logs.")
+
+# serving fleet (auron_tpu/fleet/: router in front of N AuronServers)
+FLEET_REPLICAS = _opt(
+    "auron.fleet.replicas", int, 2,
+    "Replica count booted by the fleet tooling (tools/load_report.py "
+    "--fleet, the perf-gate fleet arm, chaos fleet_failover). The "
+    "router itself takes an explicit replica list and ignores this "
+    "knob — it sizes HARNESSES, not the router.")
+FLEET_POLL_S = _opt(
+    "auron.fleet.poll_s", float, 0.5,
+    "Bounded-staleness interval of the router's health poll loop: each "
+    "tick scrapes every replica's /healthz + /queries (occupancy, "
+    "memmgr pressure, watchdog state, warm plan fingerprints) into an "
+    "immutable snapshot the pure routing functions decide over. A "
+    "snapshot older than 4 poll intervals is treated as unreachable — "
+    "routing never blocks on a scrape.")
+FLEET_AFFINITY = _opt(
+    "auron.fleet.affinity", bool, True,
+    "Warm-affinity routing: a submission whose plan fingerprint (the "
+    "cache/identity.py result-key fp) matches a replica's warm result-"
+    "cache inventory — or that this router recently routed — lands on "
+    "that replica so the plan-fingerprint cache's warm path survives "
+    "going multi-process. Off routes purely by load.")
+FLEET_FAILOVER = _opt(
+    "auron.fleet.failover", bool, True,
+    "Journal-backed failover: on replica death mid-query (connection "
+    "loss confirmed by the liveness plane's pid+epoch verdict) the "
+    "router RESUMEs the journaled query on a survivor from its "
+    "committed shuffle stages (bit-identical), and re-executes non-"
+    "journaled in-flight queries from scratch under a result-key "
+    "idempotency guard. Off surfaces replica death to the client as a "
+    "classified ReplicaUnavailable.")
+CLIENT_TIMEOUT_S = _opt(
+    "auron.client.timeout_s", float, 30.0,
+    "AuronClient socket budget: connect timeout per attempt and read "
+    "timeout on every subsequent frame (a dead peer surfaces as a "
+    "classified RemoteEngineError instead of hanging the client "
+    "forever). Connection attempts retry with jittered backoff inside "
+    "this same budget; <=0 disables (legacy block-forever behavior).")
 
 # always-on flight recorder (auron_tpu/obs/flight_recorder.py)
 FLIGHT_ENABLED = _opt(

@@ -122,6 +122,27 @@ class RemoteEngineError(AuronError, RuntimeError):
     transient = False
 
 
+class ReplicaUnavailable(AuronError):
+    """A fleet replica could not serve this submission: connect refused,
+    the connection dropped mid-conversation, or the liveness plane's
+    pid+epoch verdict says the engine process is dead. TRANSIENT by
+    design — the replica's death says nothing about the query, and the
+    router's recovery (spill-over to a survivor, or journal-backed
+    RESUME) is exactly a retry elsewhere. Only the router raises this;
+    a client talking straight to one server keeps seeing
+    ``RemoteEngineError``."""
+    transient = True
+
+    def __init__(self, *args, replica: Optional[str] = None,
+                 reason: Optional[str] = None,
+                 site: Optional[str] = None):
+        super().__init__(*args, site=site)
+        #: "host:port" of the replica that failed
+        self.replica = replica
+        #: connect | io | dead | hello
+        self.reason = reason
+
+
 # ---------------------------------------------------------------------------
 # lifecycle classes — the query lifecycle control plane (PR 8)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,901 @@
+"""The fleet router: one process in front of N AuronServer replicas.
+
+Speaks the ``runtime/serving.py`` wire protocol on BOTH sides — to a
+client it looks exactly like an AuronServer (the wire protocol is
+unchanged; ``AuronClient`` connects to the router with no code
+changes), to each replica it looks like a driving client.  Three
+behaviors compose the availability story:
+
+- **Routed admission**: a poll thread scrapes every replica's /healthz
+  + /queries into immutable snapshots (``fleet/snapshot.py``) and each
+  submission is routed by the pure preference order in
+  ``fleet/routing.py`` — least-loaded first, warm-affinity (result-
+  cache plan fingerprints + the router's own sticky memory) ahead of
+  cold.
+- **Spill-over retry**: an ``AdmissionRejected`` shed at one replica is
+  retried at the next candidate after a jittered, deadline-clamped
+  sleep honoring the shed's ``retry_after_s`` hint; only a fleet-wide
+  shed reaches the client, as a structured verdict the same parser
+  understands.
+- **Journal-backed failover**: the router buffers a replica's BATCH
+  frames and forwards them only after DONE (store-and-forward), so a
+  replica death mid-query never leaves a client stream half-written.
+  On death (connection loss, confirmed by the liveness plane's
+  pid+epoch verdict) a query whose id the router learned through the
+  ``router_tag`` early-ACK echo RESUMEs on a survivor under its
+  journal stem ``<query_id>_<pid>`` — bit-identical from committed
+  shuffle stages — and a non-journaled one re-executes from scratch
+  under a single-flight idempotency guard keyed on its plan
+  fingerprint.
+
+Fault sites ``fleet.route`` (the routing decision) and
+``fleet.forward`` (the router→replica conversation) extend the
+deterministic fault plane to this tier.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import socketserver
+import threading
+import time
+
+from auron_tpu import errors
+from auron_tpu.runtime import serving
+
+
+class _Flight:
+    """Single-flight slot of the re-execution idempotency guard."""
+
+    __slots__ = ("event", "result")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result = None
+
+
+class _Replica:
+    """Mutable per-replica runtime state (snapshot + identity)."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self.name = f"{host}:{port}"
+        self.hello: dict = {}
+        self.dead = False
+        from auron_tpu.fleet import snapshot as snap_mod
+        self.snapshot = snap_mod.unreachable(self.name, host, port, 0.0)
+
+    @property
+    def pid(self):
+        return self.hello.get("pid")
+
+    @property
+    def tag(self):
+        return self.hello.get("tag", "")
+
+    @property
+    def ops_port(self):
+        return self.hello.get("ops_port")
+
+    @property
+    def journal_dir(self) -> str:
+        return self.hello.get("journal_dir") or ""
+
+
+class _RouterHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        self.server.router._handle_conn(self.request)
+
+
+class _RouterServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class FleetRouter:
+    """Router/coordinator over ``replicas`` = [(host, port), ...]."""
+
+    def __init__(self, replicas, host: str = "127.0.0.1", port: int = 0,
+                 config=None):
+        from auron_tpu import config as cfg
+        conf = config or cfg.get_config()
+        self.poll_s = float(conf.get(cfg.FLEET_POLL_S))
+        #: a snapshot older than 4 poll intervals is unroutable
+        self.staleness_s = max(self.poll_s * 4, 0.5)
+        self.affinity = bool(conf.get(cfg.FLEET_AFFINITY))
+        self.failover = bool(conf.get(cfg.FLEET_FAILOVER))
+        io_t = conf.get(cfg.CLIENT_TIMEOUT_S)
+        #: per-operation socket budget for replica conversations
+        self.io_timeout_s = io_t if io_t and io_t > 0 else None
+        self._replicas = [_Replica(h, p) for h, p in replicas]
+        if not self._replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self._lock = threading.Lock()
+        self._sticky: dict = {}     # affinity fp -> replica name
+        self._inflight: dict = {}   # idempotency guard: fp -> _Flight
+        self.stats = {"routed": 0, "spillovers": 0, "fleet_sheds": 0,
+                      "failovers_resume": 0, "failovers_reexecute": 0,
+                      "replica_deaths": 0, "guard_shared": 0,
+                      "errors_forwarded": 0}
+        #: detect→recovered failover latencies (seconds) — the perf
+        #: gate and PERF.md read p50/p99 from here via stats()
+        self._failover_lat: list = []
+        self._srv = _RouterServer((host, port), _RouterHandler)
+        self._srv.router = self
+        self._poll_stop = threading.Event()
+        self._poll_thread = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def address(self) -> tuple:
+        return self._srv.server_address
+
+    def start(self) -> "FleetRouter":
+        """HELLO every replica (identity + ops port + journal dir),
+        take one synchronous scrape so the first submission routes on
+        real data, then start the poll loop and the listener."""
+        for rep in self._replicas:
+            self._hello(rep)
+        if all(rep.dead for rep in self._replicas):
+            raise errors.ReplicaUnavailable(
+                "no replica answered HELLO at fleet startup",
+                reason="hello")
+        self._poll_once()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, daemon=True)
+        self._poll_thread.start()
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def close(self) -> None:
+        self._poll_stop.set()
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def stats_dict(self) -> dict:
+        """Router counters + per-replica snapshots + failover latency
+        samples (the STATS frame body and the tooling's gate input)."""
+        with self._lock:
+            lat = sorted(self._failover_lat)
+            body = {"router": dict(self.stats),
+                    "failover_latency_s": lat,
+                    "replicas": {}}
+        for rep in self._replicas:
+            s = rep.snapshot
+            body["replicas"][rep.name] = {
+                "status": s.status, "ok": s.ok, "dead": rep.dead,
+                "running": s.running, "queued": s.queued,
+                "admitted": s.admitted, "rejected": s.rejected,
+                "mem_frac": round(s.mem_frac, 4),
+                "warm_fps": len(s.warm_fps),
+                "resume_stems": list(s.resume_stems),
+                "pid": rep.pid, "ops_port": rep.ops_port}
+        return body
+
+    # -- replica registration + polling --------------------------------------
+
+    def _hello(self, rep: _Replica) -> None:
+        try:
+            client = serving.AuronClient(rep.host, rep.port,
+                                         timeout_s=self.io_timeout_s
+                                         or 10.0)
+            rep.hello = client.hello()
+            rep.dead = False
+        except (OSError, errors.RemoteEngineError):
+            rep.dead = True
+
+    def _poll_loop(self) -> None:
+        while not self._poll_stop.wait(self.poll_s):
+            try:
+                self._poll_once()
+            except Exception:   # graft: disable=GL004 -- the poll loop must survive any scrape surprise; stale snapshots already classify as unreachable
+                pass
+
+    def _poll_once(self) -> None:
+        from auron_tpu.fleet import snapshot as snap_mod
+        now = time.monotonic()
+        for rep in self._replicas:
+            if not rep.hello:
+                self._hello(rep)
+            snap = None
+            if rep.ops_port:
+                try:
+                    health, queries = snap_mod.scrape_replica(
+                        rep.host, rep.ops_port,
+                        timeout_s=max(self.poll_s, 0.5))
+                    snap = snap_mod.snapshot_from_bodies(
+                        rep.name, rep.host, rep.port, health, queries,
+                        now)
+                    rep.dead = False
+                except (OSError, ValueError):
+                    snap = None
+            if snap is None:
+                snap = snap_mod.unreachable(rep.name, rep.host,
+                                            rep.port, now)
+            rep.snapshot = snap
+
+    def _snapshots(self) -> list:
+        return [rep.snapshot for rep in self._replicas if not rep.dead]
+
+    def _by_name(self, name: str):
+        for rep in self._replicas:
+            if rep.name == name:
+                return rep
+        return None
+
+    def _mark_dead(self, rep: _Replica) -> bool:
+        """Record a replica death after the connection-loss signal,
+        CONFIRMED by the liveness plane where possible: a same-host
+        pid+epoch that is provably alive keeps the replica routable
+        (the conversation broke, not the process) — journal claim
+        arbitration protects the resume path either way."""
+        from auron_tpu.fleet import snapshot as snap_mod
+        from auron_tpu.utils import liveness
+        if rep.dead:
+            return True   # another conversation already confirmed it
+        confirmed = True
+        parsed = liveness.parse_tag(rep.tag) if rep.tag else None
+        if parsed is not None:
+            host, pid, epoch = parsed
+            if host == socket.gethostname():
+                confirmed = liveness.owner_dead(pid, epoch)
+                if not confirmed:
+                    # a SIGKILLed child lingers as a zombie until its
+                    # parent reaps it — one beat closes that window
+                    time.sleep(0.05)
+                    confirmed = liveness.owner_dead(pid, epoch)
+        if confirmed:
+            with self._lock:
+                if not rep.dead:   # N broken conversations, ONE death
+                    rep.dead = True
+                    rep.snapshot = snap_mod.unreachable(
+                        rep.name, rep.host, rep.port, time.monotonic())
+                    self.stats["replica_deaths"] += 1
+        return confirmed
+
+    # -- metrics -------------------------------------------------------------
+
+    def _count(self, name: str, **labels) -> None:
+        try:
+            from auron_tpu.obs import registry as _reg
+            if _reg.enabled():
+                _reg.get_registry().counter(name, **labels).inc()
+        except Exception:   # graft: disable=GL004 -- metric emission is best-effort by contract
+            pass
+
+    def _observe_failover(self, seconds: float, replica: str,
+                          action: str) -> None:
+        with self._lock:
+            self._failover_lat.append(seconds)
+            self.stats["failovers_resume" if action == "resume"
+                       else "failovers_reexecute"] += 1
+        self._count("auron_fleet_failover_total", replica=replica,
+                    action=action)
+        try:
+            from auron_tpu.obs import registry as _reg
+            if _reg.enabled():
+                _reg.get_registry().histogram(
+                    "auron_fleet_failover_seconds").observe(seconds)
+        except Exception:   # graft: disable=GL004 -- metric emission is best-effort by contract
+            pass
+
+    # -- connection dispatch -------------------------------------------------
+
+    def _handle_conn(self, sock) -> None:
+        try:
+            kind, payload = serving.read_frame(sock)
+        except (OSError, ConnectionError):
+            return
+        try:
+            if kind == serving.KIND_SHUTDOWN:
+                self._shutdown_fleet()
+                return
+            if kind == serving.KIND_HELLO:
+                self._send_router_hello(sock)
+                return
+            if kind == serving.KIND_STATS:
+                serving.write_frame(
+                    sock, serving.KIND_DONE,
+                    json.dumps(self.stats_dict(), default=str).encode())
+                return
+            if kind == serving.KIND_CANCEL:
+                self._broadcast_cancel(sock, payload)
+                return
+            if kind == serving.KIND_RESUME:
+                self._serve_resume(sock, payload)
+                return
+            if kind in (serving.KIND_SUBMIT, serving.KIND_SUBMIT_PLAN):
+                self._serve_submit(sock, kind, payload)
+                return
+            serving.write_frame(sock, serving.KIND_ERROR,
+                                f"expected SUBMIT, got kind={kind}"
+                                .encode())
+        except errors.AuronError as e:
+            # classified router-tier verdict (injected fleet.route
+            # faults, exhausted fleets): structured first line, the
+            # serving ERROR convention
+            try:
+                serving.write_frame(
+                    sock, serving.KIND_ERROR,
+                    (f"{type(e).__name__} "
+                     f"reason={getattr(e, 'reason', None) or 'error'}"
+                     f"\n{e}").encode())
+            except OSError:
+                pass
+        except (OSError, ConnectionError):
+            pass   # client went away mid-reply: nothing to tell it
+
+    def _send_router_hello(self, sock) -> None:
+        import os
+        from auron_tpu.utils import liveness
+        body = {"pid": os.getpid(), "tag": liveness.own_tag(),
+                "role": "router",
+                "host": self.address[0], "port": self.address[1],
+                "replicas": [rep.name for rep in self._replicas]}
+        serving.write_frame(sock, serving.KIND_DONE,
+                            json.dumps(body).encode())
+
+    def _shutdown_fleet(self) -> None:
+        for rep in self._replicas:
+            if rep.dead:
+                continue
+            try:
+                serving.AuronClient(rep.host, rep.port,
+                                    timeout_s=5.0).shutdown()
+            except (OSError, errors.RemoteEngineError):
+                pass
+        threading.Thread(target=self.close, daemon=True).start()
+
+    def _broadcast_cancel(self, sock, payload: bytes) -> None:
+        """First-frame CANCEL-by-id: the router does not know which
+        replica owns the id (ids are per-replica), so ask each live one
+        in turn; the first success wins, otherwise the last structured
+        verdict is forwarded."""
+        last_error = b"UnknownQuery reason=unknown_query_id \nno replica"
+        for rep in self._replicas:
+            if rep.dead:
+                continue
+            try:
+                with socket.create_connection(
+                        (rep.host, rep.port),
+                        timeout=self.io_timeout_s) as rsock:
+                    serving.write_frame(rsock, serving.KIND_CANCEL,
+                                        payload)
+                    fkind, fpayload = serving.read_frame(rsock)
+            except (OSError, ConnectionError):
+                continue
+            if fkind == serving.KIND_DONE:
+                serving.write_frame(sock, serving.KIND_DONE, fpayload)
+                return
+            last_error = fpayload
+        serving.write_frame(sock, serving.KIND_ERROR, last_error)
+
+    # -- submission path -----------------------------------------------------
+
+    def _affinity_fp(self, kind: int, payload: bytes):
+        """The submission's affinity fingerprint. A SUBMIT payload IS
+        the TaskDefinition bytes the replica's cache identity
+        fingerprints, so the router computes the SAME fp and can match
+        a replica's warm inventory exactly; a SUBMIT_PLAN's task bytes
+        only exist after server-side conversion, so its fp is a local
+        digest that rides the router's sticky memory instead."""
+        from auron_tpu.runtime.journal import plan_fingerprint
+        if kind == serving.KIND_SUBMIT:
+            return plan_fingerprint(payload)
+        import hashlib
+        return "plan:" + hashlib.sha256(payload).hexdigest()[:32]
+
+    def _deadline_of(self, kind: int, payload: bytes):
+        if kind != serving.KIND_SUBMIT_PLAN:
+            return None
+        try:
+            t = json.loads(payload.decode()).get("timeout_s")
+            return time.monotonic() + float(t) if t else None
+        except (ValueError, UnicodeDecodeError):
+            return None
+
+    def _tagged_payload(self, kind: int, payload: bytes) -> bytes:
+        """Inject ``router_tag`` into a SUBMIT_PLAN request so the
+        replica echoes its query id + pid (the journal stem) on an
+        early ACK. SUBMIT payloads are raw protobuf — no tag channel —
+        so their failover is re-execution, never resume."""
+        if kind != serving.KIND_SUBMIT_PLAN:
+            return payload
+        try:
+            req = json.loads(payload.decode())
+            req["router_tag"] = True
+            return json.dumps(req).encode()
+        except (ValueError, UnicodeDecodeError):
+            return payload
+
+    def _serve_submit(self, client, kind: int, payload: bytes) -> None:
+        from auron_tpu.fleet import routing
+        from auron_tpu.runtime import faults
+        fp = self._affinity_fp(kind, payload)
+        deadline = self._deadline_of(kind, payload)
+        fwd = self._tagged_payload(kind, payload)
+        tried: set = set()
+        sheds: list = []
+        attempt = 0
+        max_attempts = 2 * len(self._replicas) + 2
+        while attempt < max_attempts:
+            attempt += 1
+            faults.maybe_fail("fleet.route", errors.ReplicaUnavailable)
+            faults.maybe_hang("fleet.route")
+            with self._lock:
+                sticky = self._sticky.get(fp)
+            order = routing.route_order(
+                self._snapshots(), plan_fp=fp, sticky=sticky,
+                affinity=self.affinity, now=time.monotonic(),
+                staleness_s=self.staleness_s)
+            cands = [s for s in order if s.name not in tried]
+            if not cands:
+                break
+            target = self._by_name(cands[0].name)
+            if target is None or target.dead:
+                tried.add(cands[0].name)
+                continue
+            reason = ("warm" if self.affinity
+                      and (fp in cands[0].warm_fps
+                           or cands[0].name == sticky) else "load")
+            res = self._drive_replica(target, kind, fwd, client)
+            rkind = res["kind"]
+            if rkind == "done":
+                with self._lock:
+                    self.stats["routed"] += 1
+                    if self.affinity:
+                        self._sticky[fp] = target.name
+                self._count("auron_fleet_routed_total",
+                            replica=target.name, reason=reason)
+                self._replay(client, res["batches"], res["done"])
+                return
+            if rkind == "client_gone":
+                return
+            if rkind == "error":
+                with self._lock:
+                    self.stats["errors_forwarded"] += 1
+                serving.write_frame(client, serving.KIND_ERROR,
+                                    res["payload"])
+                return
+            if rkind == "shed":
+                tried.add(target.name)
+                sheds.append((res["reason"], res["retry_after_s"]))
+                with self._lock:
+                    self.stats["spillovers"] += 1
+                self._count("auron_fleet_spillover_total",
+                            replica=target.name)
+                remaining = (deadline - time.monotonic()
+                             if deadline is not None else None)
+                delay = routing.spillover_delay(
+                    res["retry_after_s"], len(sheds) - 1,
+                    random.random(), remaining)
+                if delay:
+                    time.sleep(delay)
+                continue
+            # rkind == "died": replica conversation broke mid-query
+            tried.add(target.name)
+            self._mark_dead(target)
+            t_detect = time.monotonic()
+            if self._failover(client, kind, payload, fp, target,
+                              res.get("query_id"), res.get("pid"),
+                              t_detect):
+                return
+            # failover exhausted its own candidates: fall out to the
+            # fleet-wide verdict below
+            break
+        if sheds:
+            with self._lock:
+                self.stats["fleet_sheds"] += 1
+            self._count("auron_fleet_shed_total")
+            from auron_tpu.fleet.routing import shed_verdict
+            reason, hint = shed_verdict(sheds)
+            serving.write_frame(
+                client, serving.KIND_ERROR,
+                (f"AdmissionRejected reason={reason} "
+                 f"retry_after_s={hint}\nevery replica shed this "
+                 f"submission ({len(sheds)} sheds); resubmit after "
+                 "the hint").encode())
+            return
+        serving.write_frame(
+            client, serving.KIND_ERROR,
+            (b"ReplicaUnavailable reason=no_replicas\nno usable "
+             b"replica in the fleet (all dead or unreachable)"))
+
+    # -- failover ------------------------------------------------------------
+
+    def _failover(self, client, kind: int, payload: bytes, fp: str,
+                  dead_rep: _Replica, query_id, pid,
+                  t_detect: float) -> bool:
+        """Recover a query that was mid-flight on a dead replica.
+        True when the client received a full reply (success or a
+        classified error); False to let the caller surface the
+        fleet-wide verdict."""
+        from auron_tpu.fleet import routing
+        # exclusion is DEATH-only: a replica that merely shed this
+        # submission earlier was full at that instant, not unusable —
+        # the patient re-execution below must be allowed back there
+        excluded = {dead_rep.name}
+        survivors = [rep for rep in self._replicas
+                     if not rep.dead and rep.name != dead_rep.name]
+        action = routing.failover_action(
+            query_id=query_id, pid=pid,
+            journal_shared=bool(dead_rep.journal_dir),
+            failover_enabled=self.failover,
+            survivors=len(survivors))
+        if action == "error":
+            if not self.failover:
+                serving.write_frame(
+                    client, serving.KIND_ERROR,
+                    (f"ReplicaUnavailable reason=dead "
+                     f"replica={dead_rep.name}\nreplica died "
+                     "mid-query and auron.fleet.failover is off"
+                     ).encode())
+                return True
+            return False
+        stem = f"{query_id}_{pid}" if action == "resume" else None
+        if stem is None:
+            # a raw SUBMIT has no router_tag channel, but its affinity
+            # fp IS the journal's plan fingerprint (both hash the
+            # TaskDefinition bytes): find the dead owner's stem in the
+            # shared journal dir so the query RESUMEs (completing a
+            # resume deletes the journal — re-execution would leave it
+            # as a permanent orphan the sweep deliberately keeps)
+            stem = self._orphan_stem(dead_rep, fp)
+        if stem is not None:
+            # RESUME rides the survivor's admission door like any
+            # query, so a momentarily full survivor SHEDS it — and a
+            # failed-over query already earned its slot once, so shed
+            # means wait-and-retry (hint-paced, bounded), never an
+            # instant downgrade to re-execution (which would strand
+            # the dead owner's journal as a permanent orphan)
+            resume_payload = json.dumps({"query_id": stem}).encode()
+            deadline = time.monotonic() + 20.0
+            round_ = 0
+            while stem is not None:
+                hint = None
+                sheds_only = False
+                for rep in self._replicas:
+                    if rep.dead or rep.name in excluded:
+                        continue
+                    res = self._drive_replica(
+                        rep, serving.KIND_RESUME, resume_payload,
+                        client)
+                    if res["kind"] == "done":
+                        self._observe_failover(
+                            time.monotonic() - t_detect, rep.name,
+                            "resume")
+                        self._replay(client, res["batches"],
+                                     res["done"])
+                        return True
+                    if res["kind"] == "client_gone":
+                        return True
+                    if res["kind"] == "died":
+                        excluded.add(rep.name)
+                        self._mark_dead(rep)
+                        continue
+                    if res["kind"] == "shed":
+                        sheds_only = True
+                        if res["retry_after_s"]:
+                            hint = max(hint or 0.0,
+                                       res["retry_after_s"])
+                        continue
+                    # a structured resume refusal (no journal for
+                    # the stem, claim raced, corrupt): re-execution
+                    # is the classified fallback
+                    stem = None
+                    break
+                if stem is None or not sheds_only:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                time.sleep(routing.spillover_delay(
+                    hint, round_, random.random(), remaining))
+                round_ += 1
+        status = self._reexecute_guarded(client, kind, payload, fp,
+                                         excluded)
+        if status == "served":
+            self._observe_failover(time.monotonic() - t_detect,
+                                   "fleet", "reexecute")
+            return True
+        if status == "gone":
+            return True
+        if status == "failed_shed":
+            # the survivors are FULL, not gone: the classified verdict
+            # is a fleet-wide shed, same as the spill-over path's
+            with self._lock:
+                self.stats["fleet_sheds"] += 1
+            self._count("auron_fleet_shed_total")
+            serving.write_frame(
+                client, serving.KIND_ERROR,
+                (b"AdmissionRejected reason=fleet_saturated "
+                 b"retry_after_s=1.0\nreplica died mid-query and "
+                 b"every survivor shed the re-execution"))
+            return True
+        return False
+
+    def _orphan_stem(self, dead_rep: _Replica, fp):
+        """The dead replica's resumable journal stem for this
+        submission, found by plan-fingerprint match in the SHARED
+        journal dir (same-host deployments; a remote dir is simply not
+        visible and failover re-executes)."""
+        import os
+        jdir = dead_rep.journal_dir
+        if not fp or not jdir or not os.path.isdir(jdir):
+            return None
+        try:
+            from auron_tpu.runtime import journal as jrn
+            for ent in jrn.resume_inventory(jdir):
+                if ent.get("owner_alive") or ent.get("claimed"):
+                    continue
+                if ent.get("plan_fp") == fp:
+                    return ent.get("stem")
+        except Exception:   # graft: disable=GL004 -- inventory probing is an optimization; re-execution stays correct without it
+            pass
+        return None
+
+    def _reexecute_guarded(self, client, kind: int, payload: bytes,
+                           fp: str, excluded: set,
+                           budget_s: float = 20.0) -> str:
+        """Re-execute a non-resumable in-flight query on a survivor
+        under the single-flight idempotency guard: two concurrent
+        failovers of the SAME submission (same result-key fingerprint)
+        must produce exactly one replica execution — the second waits
+        and replays the first's buffered frames.
+
+        A failed-over query was ALREADY admitted once, so a shed here
+        means a momentarily full survivor, not a rejection verdict:
+        keep coming around (hint-paced) until ``budget_s`` runs out.
+        Returns ``served`` / ``gone`` (client vanished) /
+        ``failed_shed`` (survivors kept shedding all budget long) /
+        ``failed_dead`` (no survivor left at all)."""
+        owner = False
+        with self._lock:
+            fl = self._inflight.get(fp)
+            if fl is None:
+                fl = _Flight()
+                self._inflight[fp] = fl
+                owner = True
+        if not owner:
+            fl.event.wait(timeout=(self.io_timeout_s or 30.0) * 2)
+            if fl.result is not None:
+                with self._lock:
+                    self.stats["guard_shared"] += 1
+                self._replay(client, fl.result["batches"],
+                             fl.result["done"])
+                return "served"
+            # the owner failed; this waiter recovers on its own
+        from auron_tpu.fleet import routing
+        deadline = time.monotonic() + budget_s
+        shed_seen = False
+        try:
+            round_ = 0
+            while True:
+                hint = None
+                progressed = False
+                for rep in self._replicas:
+                    if rep.dead or rep.name in excluded:
+                        continue
+                    res = self._drive_replica(
+                        rep, kind,
+                        self._tagged_payload(kind, payload), client)
+                    if res["kind"] == "done":
+                        if owner:
+                            fl.result = res
+                        self._replay(client, res["batches"],
+                                     res["done"])
+                        return "served"
+                    if res["kind"] == "client_gone":
+                        return "gone"
+                    if res["kind"] == "error":
+                        serving.write_frame(client,
+                                            serving.KIND_ERROR,
+                                            res["payload"])
+                        return "served"
+                    if res["kind"] == "died":
+                        excluded.add(rep.name)
+                        self._mark_dead(rep)
+                        continue
+                    # shed: the survivor is merely FULL, not gone
+                    progressed = True
+                    shed_seen = True
+                    if res["retry_after_s"]:
+                        hint = max(hint or 0.0, res["retry_after_s"])
+                if not progressed:
+                    return ("failed_shed" if shed_seen
+                            else "failed_dead")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return "failed_shed"
+                time.sleep(routing.spillover_delay(
+                    hint, round_, random.random(), remaining))
+                round_ += 1
+        finally:
+            if owner:
+                with self._lock:
+                    self._inflight.pop(fp, None)
+                fl.event.set()
+
+    # -- resume path (client-driven) -----------------------------------------
+
+    def _serve_resume(self, client, payload: bytes) -> None:
+        """First-frame RESUME from a client: route to the survivor
+        whose scraped resume inventory holds the stem (shared journal
+        dir), else least-loaded, and forward verbatim."""
+        from auron_tpu.fleet import routing
+        qid = serving._TaskHandler._parse_query_id(payload)
+        last_error = (b"ResumeUnavailable reason=no_replicas "
+                      b"query_id=\nno usable replica")
+        tried: set = set()
+        while True:
+            snap = routing.resume_target(
+                [s for s in self._snapshots()
+                 if s.name not in tried], qid,
+                now=time.monotonic(), staleness_s=self.staleness_s)
+            if snap is None:
+                serving.write_frame(client, serving.KIND_ERROR,
+                                    last_error)
+                return
+            rep = self._by_name(snap.name)
+            if rep is None or rep.dead:
+                tried.add(snap.name)
+                continue
+            res = self._drive_replica(rep, serving.KIND_RESUME,
+                                      payload, client)
+            if res["kind"] == "done":
+                with self._lock:
+                    self.stats["routed"] += 1
+                self._replay(client, res["batches"], res["done"])
+                return
+            if res["kind"] == "client_gone":
+                return
+            if res["kind"] in ("error", "shed"):
+                serving.write_frame(client, serving.KIND_ERROR,
+                                    res["payload"])
+                return
+            tried.add(rep.name)
+            self._mark_dead(rep)
+            last_error = (f"ReplicaUnavailable reason=dead "
+                          f"replica={rep.name}\nreplica died during "
+                          "RESUME").encode()
+
+    # -- the store-and-forward pump ------------------------------------------
+
+    def _drive_replica(self, rep: _Replica, kind: int, payload: bytes,
+                       client) -> dict:
+        """Drive one conversation with one replica, buffering BATCH
+        frames (forwarded to the client only after DONE — a death
+        mid-stream must leave the client stream untouched so failover
+        can restart it cleanly).  NEED_TABLES/TABLE exchanges relay
+        through live, they are client-owned state.
+
+        Returns a dict with ``kind`` one of: ``done`` (with buffered
+        ``batches`` + ``done`` payload + echoed ``query_id``/``pid``),
+        ``shed`` (structured AdmissionRejected, parsed), ``error``
+        (any other ERROR payload, forwarded verbatim), ``died``
+        (connection broke — with whatever identity the early ACK
+        echoed), ``client_gone`` (the CLIENT side broke mid-relay)."""
+        from auron_tpu.runtime import faults
+        batches: list = []
+        query_id = pid = None
+        try:
+            rsock = socket.create_connection(
+                (rep.host, rep.port), timeout=self.io_timeout_s)
+        except OSError:
+            return {"kind": "died", "query_id": None, "pid": None}
+        with rsock:
+            try:
+                faults.maybe_fail("fleet.forward",
+                                  errors.ReplicaUnavailable)
+                serving.write_frame(rsock, kind, payload)
+                while True:
+                    faults.maybe_hang("fleet.forward")
+                    fkind, fpayload = serving.read_frame(rsock)
+                    if fkind == serving.KIND_ACK:
+                        # the router_tag echo: query id + pid = the
+                        # journal stem failover resumes under
+                        try:
+                            meta = json.loads(fpayload.decode())
+                            query_id = meta.get("query_id")
+                            pid = meta.get("pid")
+                        except (ValueError, UnicodeDecodeError):
+                            pass
+                        continue
+                    if fkind == serving.KIND_BATCH:
+                        batches.append(fpayload)
+                        serving.write_frame(rsock, serving.KIND_ACK,
+                                            b"")
+                    elif fkind == serving.KIND_NEED_TABLES:
+                        if not self._relay_tables(rsock, client,
+                                                  fpayload):
+                            return {"kind": "client_gone"}
+                    elif fkind == serving.KIND_ERROR:
+                        text = fpayload.decode("utf-8", "replace")
+                        shed = serving.parse_shed(text)
+                        if shed is not None:
+                            return {"kind": "shed",
+                                    "reason": shed[0],
+                                    "retry_after_s": shed[1],
+                                    "payload": fpayload}
+                        return {"kind": "error", "payload": fpayload}
+                    elif fkind == serving.KIND_DONE:
+                        return {"kind": "done", "batches": batches,
+                                "done": fpayload,
+                                "query_id": query_id, "pid": pid}
+                    else:
+                        return {"kind": "died", "query_id": query_id,
+                                "pid": pid}
+            except (errors.ReplicaUnavailable, OSError,
+                    ConnectionError, TimeoutError):
+                return {"kind": "died", "query_id": query_id,
+                        "pid": pid}
+
+    def _relay_tables(self, rsock, client, need_payload: bytes) -> bool:
+        """Relay a NEED_TABLES round: forward the request to the
+        client, stream its TABLE frames back to the replica. False
+        when the client broke the protocol or vanished."""
+        try:
+            serving.write_frame(client, serving.KIND_NEED_TABLES,
+                                need_payload)
+            need = json.loads(need_payload.decode())
+            for _ in range(len(need)):
+                ck, cp = serving.read_frame(client)
+                if ck != serving.KIND_TABLE:
+                    return False
+                serving.write_frame(rsock, serving.KIND_TABLE, cp)
+            return True
+        except (OSError, ConnectionError, ValueError):
+            return False
+
+    def _replay(self, client, batches: list, done_payload: bytes) -> bool:
+        """Forward the buffered result to the client under its ACK
+        flow control (one un-ACKed frame in flight — the router is the
+        server now)."""
+        try:
+            for b in batches:
+                serving.write_frame(client, serving.KIND_BATCH, b)
+                ck, _ = serving.read_frame(client)
+                if ck != serving.KIND_ACK:
+                    return False
+            serving.write_frame(client, serving.KIND_DONE,
+                                done_payload)
+            return True
+        except (OSError, ConnectionError):
+            return False
+
+
+def main(argv=None) -> int:
+    """``python -m auron_tpu.fleet.router --replica host:port ...`` —
+    run a router process (prints the bound address for the parent)."""
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replica", action="append", required=True,
+                    help="host:port of an AuronServer replica "
+                         "(repeatable)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+    replicas = []
+    for spec in args.replica:
+        host, _, port = spec.rpartition(":")
+        replicas.append((host or "127.0.0.1", int(port)))
+    router = FleetRouter(replicas, host=args.host, port=args.port)
+    router.start()
+    print(f"AURON_FLEET {router.address[0]}:{router.address[1]}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        router.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

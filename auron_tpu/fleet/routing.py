@@ -1,0 +1,130 @@
+"""Pure routing decisions over replica snapshots.
+
+Every function here is a pure function of its arguments — no clocks, no
+sockets, no config reads, no randomness that is not injected — so the
+router's entire decision surface (admission routing, warm affinity,
+spill-over ordering, backoff clamping, failover action) is exhaustively
+unit-testable from literal snapshots (tests/test_fleet.py).  The
+``FleetRouter`` in ``fleet/router.py`` owns all the I/O and calls down
+into these; it never second-guesses them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from auron_tpu.fleet.snapshot import ReplicaSnapshot
+
+
+def load_score(snap: ReplicaSnapshot) -> tuple:
+    """Deterministic least-loaded ordering key: occupancy first (live +
+    queued queries — the admission plane's real queue), then memory
+    pressure, then degraded-after-ok, then name for a total order (two
+    idle replicas must sort the same way on every router)."""
+    return (snap.occupancy, round(snap.mem_frac, 3),
+            0 if snap.status == "ok" else 1, snap.name)
+
+
+def usable(snapshots, now: float, staleness_s: float) -> list:
+    """The routable subset: scraped ok and fresh. Degraded replicas
+    stay usable (degraded means serving with a caveat — shedding them
+    entirely would turn one bad probe into an outage); unreachable and
+    stale ones do not."""
+    return [s for s in snapshots if s.fresh(now, staleness_s)]
+
+
+def route_order(snapshots, *, plan_fp: Optional[str] = None,
+                sticky: Optional[str] = None, affinity: bool = True,
+                now: float = 0.0,
+                staleness_s: float = 2.0) -> list:
+    """Full preference order for one submission: every usable replica,
+    best first — the head is the admission target, the tail is the
+    spill-over sequence.
+
+    Affinity first: replicas whose warm result-cache inventory holds
+    ``plan_fp`` — or the ``sticky`` replica this router last routed the
+    same fingerprint to (the router's own memory covers SUBMIT_PLAN
+    payloads whose server-side task identity it cannot compute) — rank
+    ahead of cold ones, each group least-loaded first.  The warm path's
+    173x is worth far more than perfect load spreading; ties inside a
+    group still spread by load."""
+    cands = usable(snapshots, now, staleness_s)
+    if not (affinity and (plan_fp or sticky)):
+        return sorted(cands, key=load_score)
+    warm, cold = [], []
+    for s in cands:
+        if (plan_fp is not None and plan_fp in s.warm_fps) \
+                or (sticky is not None and s.name == sticky):
+            warm.append(s)
+        else:
+            cold.append(s)
+    return sorted(warm, key=load_score) + sorted(cold, key=load_score)
+
+
+def resume_target(snapshots, stem: str, *, now: float,
+                  staleness_s: float) -> Optional[ReplicaSnapshot]:
+    """Where to RESUME a dead replica's journaled query: prefer a
+    survivor that already sees the stem in its resume inventory (same
+    shared journal dir, inventory confirmed by its own scrape), else
+    the least-loaded usable survivor (the shared dir means any of them
+    can claim it — inventory lag must not block failover).  None when
+    the fleet has no usable survivor."""
+    order = route_order(snapshots, affinity=False, now=now,
+                        staleness_s=staleness_s)
+    for s in order:
+        if stem in s.resume_stems:
+            return s
+    return order[0] if order else None
+
+
+def spillover_delay(retry_after_s: Optional[float], attempt: int,
+                    rand: float, remaining_s: Optional[float],
+                    *, floor_s: float = 0.02,
+                    cap_s: float = 2.0) -> float:
+    """Jittered, deadline-clamped backoff before retrying a shed at the
+    next replica (the PR 7 token discipline, fleet edition).
+
+    ``rand`` is an injected uniform [0,1) sample — determinism belongs
+    to the caller. The server's ``retry_after_s`` hint anchors the
+    delay; without a hint, exponential from ``floor_s`` by attempt.
+    Clamped to ``remaining_s`` (the submission's deadline budget) so a
+    backoff never outlives the query it serves; never negative."""
+    base = retry_after_s if retry_after_s and retry_after_s > 0 \
+        else floor_s * (2 ** attempt)
+    delay = min(base, cap_s) * (0.5 + rand / 2)   # full jitter, >=50%
+    if remaining_s is not None:
+        delay = min(delay, max(0.0, remaining_s))
+    return max(0.0, delay)
+
+
+def failover_action(*, query_id: Optional[str], pid: Optional[int],
+                    journal_shared: bool, failover_enabled: bool,
+                    survivors: int) -> str:
+    """The failover state machine's single decision: what to do about a
+    query that was mid-flight on a replica that died.
+
+    - ``resume``     — the router knows the server-assigned query id
+                       and pid (the early ACK echo), the fleet shares a
+                       journal dir, and a survivor exists: RESUME the
+                       journal stem ``<query_id>_<pid>`` there.
+    - ``reexecute``  — survivors exist but the query has no reachable
+                       journal identity: run it again from scratch
+                       (under the idempotency guard).
+    - ``error``      — failover is off, or nobody is left: surface the
+                       classified ReplicaUnavailable verdict.
+    """
+    if not failover_enabled or survivors <= 0:
+        return "error"
+    if query_id and pid and journal_shared:
+        return "resume"
+    return "reexecute"
+
+
+def shed_verdict(sheds: list) -> tuple[str, Optional[float]]:
+    """Collapse per-replica sheds into the fleet-wide verdict the
+    client sees: reason ``fleet_saturated`` and the LARGEST retry hint
+    (the fleet is ready when its slowest-draining member is).
+    ``sheds`` holds (reason, retry_after_s) tuples from
+    ``serving.parse_shed``."""
+    hints = [r for _, r in sheds if r is not None]
+    return "fleet_saturated", (max(hints) if hints else None)

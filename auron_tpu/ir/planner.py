@@ -465,9 +465,31 @@ class PhysicalPlanner:
             from auron_tpu.runtime import journal as jrn
             journal = jrn.active_journal()
             if journal is not None:
-                rss_root = journal.rss_root
                 shuffle_id = journal.next_shuffle_id()
-                orphan_sweep = "parts"
+                # mesh-aware journal routing: consume the plan-walk
+                # shuffle id UNCONDITIONALLY (resume re-plans the same
+                # bytes and must reproduce every id, whichever tier
+                # each exchange lands on), then route by the CURRENT
+                # exchange_route verdict. An exchange the mesh can
+                # carry stays on the all_to_all fast path — journaling
+                # a query must not silently forfeit 8-wide exchanges to
+                # the durable tier — at the price of that one stage's
+                # resumability. The exception is an exchange the
+                # journal already holds durable state for (a RESUME
+                # onto a possibly NARROWER mesh): its committed maps
+                # live on the RSS tier, so it re-plans there
+                # regardless of what the current plane could carry.
+                from auron_tpu.parallel import mesh as mesh_mod
+                route, _ = mesh_mod.exchange_route(
+                    self._parse_partitioning(n.partitioning),
+                    n.partitioning.num_partitions,
+                    n.input_partitions or 1, mesh_mod.current_plane())
+                if route == "all_to_all" \
+                        and not journal.has_shuffle_state(shuffle_id):
+                    journal = None   # non-durable mesh fast path
+                else:
+                    rss_root = journal.rss_root
+                    orphan_sweep = "parts"
         if rss_root:
             # RSS tier: push partition frames to the host shuffle service
             # so other hosts can read them (exchange.RssShuffleExchangeOp)

@@ -24,6 +24,7 @@ import gc
 import glob
 import os
 import sys
+import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -561,6 +562,125 @@ def journal_pipeline(workdir: str) -> Scenario:
                      os.path.join(jdir, "**", "*.part")])
 
 
+def fleet_failover(workdir: str) -> Scenario:
+    """Serving-fleet chaos scenario (ISSUE 19): TWO real AuronServer
+    subprocesses behind an in-process ``FleetRouter``, one SIGKILLed
+    mid-query on EVERY run — the router must fail the in-flight query
+    over to the survivor (journal RESUME when committed shuffle state
+    exists, guarded re-execution otherwise) and hand the client a table
+    bit-identical to the fault-free answer. The seeded plans put faults
+    on the router's OWN sites: ``fleet.route`` (the admission/routing
+    step) and ``fleet.forward`` (the replica leg of a forwarded query),
+    which must surface as spill-over retries, a failover, or a
+    classified verdict — never an unclassified crash, never wrong rows.
+    ``extra_audit`` force-sweeps every run's shared journal dir after
+    teardown: no journal / ``.part`` / ``.claim`` / RSS artifact may
+    survive a completed run (a resumed query deletes its journal; a
+    torn dead-owner journal is reclaimed by the sweep)."""
+    import pyarrow.parquet as pq
+
+    journal_root = os.path.join(workdir, "journal")
+    data_path = os.path.join(workdir, "fleet.parquet")
+    counter = [0]
+    task_box: dict = {}
+
+    def _task() -> bytes:
+        if "task" not in task_box:
+            from auron_tpu.ir import pb
+            rng = np.random.default_rng(19)
+            n = 600_000   # ~0.7s of drive time: wide kill window
+            os.makedirs(workdir, exist_ok=True)
+            pq.write_table(pa.table({
+                "k": pa.array(rng.integers(0, 64, n), pa.int64()),
+                "v": pa.array(rng.normal(size=n), pa.float64())}),
+                data_path)
+            col = lambda i: pb.ExprNode(column=pb.ColumnRefE(index=i))
+            plan = pb.PlanNode(agg=pb.AggNode(
+                child=pb.PlanNode(parquet_scan=pb.ParquetScanNode(
+                    files=[data_path])),
+                mode="complete", group_exprs=[col(0)],
+                aggs=[pb.AggFunctionP(fn="sum", arg=col(1)),
+                      pb.AggFunctionP(fn="count", arg=col(1))]))
+            task_box["task"] = pb.TaskDefinition(
+                plan=plan, task_id=1).SerializeToString()
+        return task_box["task"]
+
+    def run() -> pa.Table:
+        import threading
+
+        from auron_tpu.fleet.replica import FleetHarness
+
+        task = _task()
+        counter[0] += 1
+        jdir = os.path.join(journal_root, f"run_{counter[0]}")
+        os.makedirs(jdir, exist_ok=True)
+        with FleetHarness(2, journal_dir=jdir) as h:
+            # warm pass: pays the one-off compile so the measured kill
+            # below lands mid-DATA, not mid-compile (an injected
+            # fleet.* fault here already classifies the run — fine)
+            warm, _ = h.client(timeout_s=120).execute(task)
+            box: dict = {}
+
+            def drive() -> None:
+                try:
+                    tbl, _ = h.client(timeout_s=120).execute(task)
+                    box["table"] = tbl
+                except BaseException as e:   # noqa: BLE001 — audited below
+                    box["err"] = e
+
+            t = threading.Thread(target=drive, daemon=True)
+            t.start()
+            # SIGKILL whichever replica picks the query up, mid-flight
+            victim = None
+            deadline = _time.monotonic() + 10.0
+            while victim is None and t.is_alive() \
+                    and _time.monotonic() < deadline:
+                h.router._poll_once()
+                for i in range(len(h.replicas)):
+                    snap = h.router._replicas[i].snapshot
+                    if snap is not None and snap.occupancy > 0:
+                        victim = i
+                        break
+                if victim is None:
+                    _time.sleep(0.05)
+            if victim is not None and h.replicas[victim].alive():
+                h.kill_replica(victim)
+            t.join(timeout=120)
+            if t.is_alive():
+                raise RuntimeError("fleet_failover run wedged: the "
+                                   "killed query never completed or "
+                                   "classified")
+            if "err" in box:
+                raise box["err"]
+            out = box["table"]
+            if not out.equals(warm):
+                raise AssertionError(
+                    "fleet failover diverged: the failed-over query's "
+                    "table differs from the same fleet's warm pass")
+        return _canonical(out)
+
+    sc = Scenario("fleet_failover", run, [])
+
+    def extra_audit() -> list[str]:
+        from auron_tpu.runtime import journal as jrn
+        found: list[str] = []
+        for d in sorted(glob.glob(os.path.join(journal_root, "run_*"))):
+            try:
+                jrn.sweep_orphans(d, force=True)
+            except OSError:
+                pass   # audit still reports the raw globs below
+            found += glob.glob(os.path.join(d, "*.journal"))
+            found += glob.glob(os.path.join(d, "*.claim"))
+            found += glob.glob(os.path.join(d, "**", "*.part"),
+                               recursive=True)
+            found += [p for p in glob.glob(os.path.join(d, "rss", "*"))
+                      if os.path.isdir(p)]
+        return found
+
+    sc.extra_audit = extra_audit
+    return sc
+
+
 SCENARIOS: dict[str, Callable[[str], Scenario]] = {
     "rss_pipeline": rss_pipeline,
     "spill_sort": spill_sort,
@@ -569,6 +689,7 @@ SCENARIOS: dict[str, Callable[[str], Scenario]] = {
     "lifecycle_pipeline": lifecycle_pipeline,
     "overload": overload,
     "journal_pipeline": journal_pipeline,
+    "fleet_failover": fleet_failover,
 }
 
 

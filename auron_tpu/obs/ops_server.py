@@ -115,7 +115,21 @@ def queries() -> dict:
         from auron_tpu.cache import result_cache as _rcache
         out["cache"] = _rcache.get_cache().stats()
         out["aot"] = _aot.last_stats()
+        # warm inventory for the fleet router's affinity routing: the
+        # plan fingerprints this process can serve from its result
+        # cache without executing anything
+        out["warm_plan_fps"] = _rcache.get_cache().warm_plan_fps()
     except Exception:   # pragma: no cover - cache plane optional
+        pass
+    try:
+        from auron_tpu import config as _cfg
+        from auron_tpu.runtime import journal as _jrn
+        jdir = _cfg.get_config().get(_cfg.JOURNAL_DIR)
+        if jdir:
+            # failover inventory: which journaled queries under the
+            # (fleet-shared) journal dir could a survivor RESUME
+            out["resume_inventory"] = _jrn.resume_inventory(jdir)
+    except Exception:   # pragma: no cover - journal plane optional
         pass
     return out
 

@@ -275,6 +275,16 @@ _HELP = {
     "auron_cache_entries": "Entries held by the warm-path cache.",
     "auron_aot_warmed": "Plans warmed by the last AOT startup pass.",
     "auron_aot_errors": "Errors in the last AOT startup pass.",
+    "auron_fleet_routed_total":
+        "Fleet router submissions routed, per replica and pick reason.",
+    "auron_fleet_spillover_total":
+        "Fleet router spill-over retries after a replica shed.",
+    "auron_fleet_shed_total":
+        "Fleet-wide sheds surfaced to the client (every replica shed).",
+    "auron_fleet_failover_total":
+        "Fleet failovers per replica and action (resume|reexecute).",
+    "auron_fleet_failover_seconds":
+        "Fleet failover latency: replica-death detect to recovery done.",
 }
 
 

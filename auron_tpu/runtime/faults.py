@@ -96,6 +96,7 @@ SITES = (
     "task.hang", "cancel.race", "memmgr.deny", "sched.admit",
     "mesh.all_to_all", "mesh.gang",
     "journal.write", "journal.commit", "journal.load",
+    "fleet.route", "fleet.forward",
 )
 
 KINDS = ("io_error", "fatal", "corrupt", "hang", "cancel", "deny")

@@ -600,6 +600,16 @@ class QueryJournal:
             return None
         return self._validate_map(service, shuffle_id, map_id)
 
+    def has_shuffle_state(self, shuffle_id: int) -> bool:
+        """Does the journal carry ANY durable state for this exchange —
+        a full shuffle commit or at least one committed map output?
+        The planner's route oracle: a resumed exchange with journaled
+        state must re-plan onto the RSS tier (where that state lives);
+        one with none is free to take the current mesh fast path."""
+        if shuffle_id in self.shuffle_commits:
+            return True
+        return any(sid == shuffle_id for sid, _ in self.committed)
+
     # -- resume ledger -------------------------------------------------------
 
     def _log_entry(self, shuffle_id: int) -> dict:
@@ -1039,6 +1049,43 @@ def load_for_resume(dir_: str, query_id: str, catalog: dict,
     except Exception:  # graft: disable=GL004 -- resume-event tee is best-effort
         pass
     return jr
+
+
+def resume_inventory(dir_: str) -> list:
+    """The router's failover inventory: every journal under ``dir_``
+    summarized from its header line alone (``_peek_header`` — no full
+    read/CRC/base64), with a liveness verdict per owner.  A fleet
+    router scrapes this to answer "which crashed queries can a survivor
+    RESUME, and under which stem?" without importing any engine state.
+    Entries whose owner is still alive are included (flagged) so the
+    caller can distinguish in-flight from resumable; torn headers are
+    skipped — an unreadable journal is not inventory."""
+    from auron_tpu.utils import liveness
+    out = []
+    try:
+        names = sorted(os.listdir(dir_))
+    except OSError:
+        return out
+    for n in names:
+        if not n.endswith(".journal"):
+            continue
+        stem = n[:-len(".journal")]
+        header = _peek_header(os.path.join(dir_, n))
+        if header is None:
+            continue
+        owner = header.get("owner", "")
+        out.append({
+            "stem": stem,
+            "query_id": header.get("query_id", stem),
+            "owner": owner,
+            "owner_alive": bool(owner) and liveness.is_live(owner),
+            "claimed": os.path.exists(
+                os.path.join(dir_, f"{stem}.claim")),
+            "plan_fp": header.get("plan_fp", ""),
+            "num_partitions": int(header.get("num_partitions", 1)),   # graft: disable=GL001 -- JSON header field, host data
+            "scope": header.get("scope", "collect"),
+        })
+    return out
 
 
 def find_reusable(dir_: str, plan_bytes: bytes, catalog: dict,

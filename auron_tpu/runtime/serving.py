@@ -51,10 +51,30 @@ Wire format (all integers little-endian):
                                           journaled ("task") one replays
                                           exactly its own partition_id
 
+            13 HELLO       client→server  empty payload — replica
+                                          registration handshake: one
+                                          DONE frame with JSON {pid,
+                                          tag, host, port, ops_port,
+                                          window, journal_dir} so a
+                                          fleet router learns a
+                                          replica's liveness identity
+                                          (utils/liveness pid+epoch
+                                          tag), its ops scrape port,
+                                          and its journal dir without
+                                          any side channel
+
 CANCEL doubles as a FIRST frame carrying JSON {query_id}: cancel a live
 query by id over a fresh connection (DONE {cancelled} on success, a
 structured ERROR "UnknownQuery reason=unknown_query_id ..." when the id
 is unknown or already finished).
+
+A SUBMIT_PLAN whose JSON carries ``"router_tag": true`` (the fleet
+router sets it; extra keys are ignored by older servers, so the client
+wire contract is unchanged) receives one EARLY server→client ACK frame
+with JSON {query_id, pid} before any BATCH: the router learns the
+server-assigned query id (hence the journal stem ``<query_id>_<pid>``)
+so it can CANCEL-by-id or RESUME the query on a survivor after this
+replica dies mid-stream.
 
 Flow control mirrors rt.rs's bound-1 sync channel, generalized to a
 window: the server keeps at most ``window`` un-ACKed BATCH frames in
@@ -111,6 +131,10 @@ KIND_RESUME = 11
 #: /queries endpoint over the EXISTING wire protocol, for clients
 #: behind firewalls that cannot reach the HTTP port (AuronClient.stats)
 KIND_STATS = 12
+#: first-frame HELLO: the fleet router's registration handshake —
+#: answers one DONE frame with this process's identity (pid + liveness
+#: tag), serving address, ops port, and journal dir
+KIND_HELLO = 13
 
 #: max un-ACKed BATCH frames in flight (rt.rs uses a bound-1 channel; a
 #: small window amortizes the network round trip without losing the
@@ -132,6 +156,30 @@ def _journal_error_frame(e) -> bytes:
     cannot drift between them."""
     return (f"{type(e).__name__} reason={e.reason or 'error'} "
             f"query_id={e.query_id or ''}\n{e}").encode()
+
+
+def parse_shed(text: str):
+    """``(reason, retry_after_s)`` parsed from a structured
+    ``AdmissionRejected`` ERROR payload's first line, or None when the
+    text is not a shed.  ONE parser for every consumer of the shed
+    contract — the client's ``retry_sheds`` fallback and the fleet
+    router's spill-over — so the wire format cannot drift between
+    them.  ``retry_after_s`` is None when the server had no estimate
+    (the literal ``None`` the f-string emits)."""
+    first = text.splitlines()[0] if text else ""
+    if not first.startswith("AdmissionRejected"):
+        return None
+    reason, retry = "unknown", None
+    for tok in first.split()[1:]:
+        key, _, val = tok.partition("=")
+        if key == "reason":
+            reason = val
+        elif key == "retry_after_s":
+            try:
+                retry = float(val)   # graft: disable=GL001 -- parsing a wire-protocol token, host data
+            except ValueError:
+                retry = None
+    return reason, retry
 
 
 def read_frame(sock) -> tuple[int, bytes]:
@@ -208,6 +256,9 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             return
         if kind == KIND_STATS:
             self._send_stats()
+            return
+        if kind == KIND_HELLO:
+            self._send_hello()
             return
         if kind not in (KIND_SUBMIT, KIND_SUBMIT_PLAN, KIND_RESUME):
             write_frame(self.request, KIND_ERROR,
@@ -371,6 +422,31 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         except OSError:   # pragma: no cover - client went away
             pass
 
+    def _send_hello(self) -> None:
+        """First-frame HELLO: the fleet router's registration
+        handshake. One DONE frame carrying this process's pid AND its
+        liveness tag (host:pid:epoch — the router's provably-dead
+        verdict needs the epoch, a recycled pid must not mask a death),
+        the serving address, the ops scrape port, and the journal dir
+        (empty when journaling is off) so the router knows whether
+        failover can RESUME here or must re-execute."""
+        from auron_tpu.runtime import journal as _jrn
+        from auron_tpu.utils import liveness
+        body = {
+            "pid": os.getpid(),
+            "tag": liveness.own_tag(),
+            "host": self.server.address[0],
+            "port": self.server.address[1],
+            "window": getattr(self.server, "window", DEFAULT_WINDOW),
+            "journal_dir": _jrn.journal_dir() or "",
+            "ops_port": self.server.stats.get("ops_port"),
+        }
+        try:
+            write_frame(self.request, KIND_DONE,
+                        json.dumps(body).encode())
+        except OSError:   # pragma: no cover - router went away
+            pass
+
     def _cancel_by_id(self, payload: bytes) -> None:
         """First-frame CANCEL with a query-id payload: cancel another
         connection's live query on this server, or answer the
@@ -459,6 +535,20 @@ class _TaskHandler(socketserver.BaseRequestHandler):
         timeout_s = req.get("timeout_s")
         if timeout_s:
             self._cancel.arm_deadline(float(timeout_s))
+        if req.get("router_tag"):
+            # fleet-router registration: echo the server-assigned query
+            # id (and pid — together the journal stem) EARLY, before
+            # any admission/planning work, so the router can CANCEL or
+            # journal-RESUME this query even if the replica dies before
+            # its first BATCH. Plain clients never set the key and the
+            # server never volunteers the frame — the wire protocol is
+            # unchanged for them.
+            try:
+                write_frame(self.request, KIND_ACK,
+                            json.dumps({"query_id": self._cancel.query_id,
+                                        "pid": os.getpid()}).encode())
+            except OSError:
+                raise _Cancelled()
 
         def rewrite(p):
             return rewrites.get(p) or rewrites.get(os.path.basename(p), p)
@@ -804,11 +894,64 @@ class AuronServer(socketserver.ThreadingTCPServer):
 
 class AuronClient:
     """The host-engine side of the protocol: callNative is ``execute``'s
-    SUBMIT, nextBatch is the BATCH stream, finalizeNative is DONE."""
+    SUBMIT, nextBatch is the BATCH stream, finalizeNative is DONE.
 
-    def __init__(self, host: str, port: int, timeout_s: float = 300.0):
+    Every socket operation is budgeted: connect attempts retry with
+    jittered backoff inside ``timeout_s`` (default: the
+    ``auron.client.timeout_s`` knob), and each frame read carries the
+    same per-operation timeout — a dead or wedged server surfaces as a
+    classified ``RemoteEngineError`` instead of hanging the caller
+    forever. ``timeout_s<=0`` restores the legacy block-forever
+    behavior."""
+
+    def __init__(self, host: str, port: int,
+                 timeout_s: "Optional[float]" = None,
+                 connect_retries: int = 3):
         self.addr = (host, port)
-        self.timeout_s = timeout_s
+        if timeout_s is None:
+            from auron_tpu import config as cfg
+            timeout_s = cfg.get_config().get(cfg.CLIENT_TIMEOUT_S)
+        self.timeout_s = timeout_s if timeout_s and timeout_s > 0 else None
+        self.connect_retries = max(0, int(connect_retries))   # graft: disable=GL001 -- constructor argument, host data
+
+    def _connect(self):
+        """Deadline-bounded connect with jittered reconnect: up to
+        ``connect_retries`` extra attempts inside the ``timeout_s``
+        budget (a replica restarting under a supervisor comes back
+        within a beat — one refused SYN must not fail the query), then
+        the classified ``RemoteEngineError``. The returned socket
+        carries the same timeout for every subsequent read/write."""
+        if self.timeout_s is None:
+            return socket.create_connection(self.addr)
+        import random
+        import time as _time
+        deadline = _time.monotonic() + self.timeout_s
+        last = None
+        for attempt in range(self.connect_retries + 1):
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                return socket.create_connection(
+                    self.addr, timeout=min(self.timeout_s, remaining))
+            except OSError as e:
+                last = e
+                delay = min(0.05 * (2 ** attempt), 1.0)
+                delay *= 0.5 + random.random() / 2   # full jitter
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                _time.sleep(min(delay, remaining))
+        raise errors.RemoteEngineError(
+            f"cannot connect to engine at {self.addr[0]}:{self.addr[1]} "
+            f"after {self.connect_retries + 1} attempts within the "
+            f"{self.timeout_s}s budget (auron.client.timeout_s): {last}")
+
+    def _timeout_error(self) -> errors.RemoteEngineError:
+        return errors.RemoteEngineError(
+            f"engine at {self.addr[0]}:{self.addr[1]} timed out "
+            f"({self.timeout_s}s per-operation budget, "
+            "auron.client.timeout_s) — server dead or wedged")
 
     def execute(self, task_bytes: bytes):
         """Submit one TaskDefinition; returns (pa.Table, metrics dict).
@@ -820,7 +963,8 @@ class AuronClient:
     def execute_plan(self, plan, path_rewrites=None, partition_id: int = 0,
                      num_partitions: int = 1, spark_version: str = "3.5.0",
                      fallback_provider=None,
-                     timeout_s: "Optional[float]" = None):
+                     timeout_s: "Optional[float]" = None,
+                     retry_sheds: bool = False):
         """Live attach: submit a raw Spark ``plan.toJSON`` tree (parsed
         JSON list/dict). The engine converts it server-side; when the
         conversion hits unconvertible subtrees it asks back for their
@@ -832,7 +976,15 @@ class AuronClient:
         conversion report (fallbacks + summary). ``timeout_s`` rides the
         frame as a SERVER-SIDE deadline: the engine's own CancelToken
         enforces it (errors.DeadlineExceeded on the ERROR frame), so the
-        budget holds even if this client dies mid-stream."""
+        budget holds even if this client dies mid-stream.
+
+        ``retry_sheds=True`` opts into honoring the server's
+        ``AdmissionRejected retry_after_s=`` hint client-side: sleep
+        the hinted interval (jittered, clamped to the remaining
+        ``timeout_s``/client budget) and retry ONCE — the single-
+        replica fallback of the fleet router's spill-over. Default off:
+        a shed stays a structured error for callers that do their own
+        backoff."""
         req = {"plan": plan, "partition_id": partition_id,
                "num_partitions": num_partitions,
                "spark_version": spark_version}
@@ -840,42 +992,68 @@ class AuronClient:
             req["timeout_s"] = float(timeout_s)
         if path_rewrites:
             req["path_rewrites"] = dict(path_rewrites)
-        return self._drive(KIND_SUBMIT_PLAN, json.dumps(req).encode(),
-                           fallback_provider)
+        payload = json.dumps(req).encode()
+        if not retry_sheds:
+            return self._drive(KIND_SUBMIT_PLAN, payload,
+                               fallback_provider)
+        import random
+        import time as _time
+        budget = timeout_s or self.timeout_s
+        deadline = (_time.monotonic() + budget) if budget else None
+        try:
+            return self._drive(KIND_SUBMIT_PLAN, payload,
+                               fallback_provider)
+        except errors.RemoteEngineError as e:
+            shed = parse_shed(str(e).partition("engine error:\n")[2])
+            if shed is None:
+                raise
+            hint = shed[1] if shed[1] is not None else 0.05
+            delay = hint * (0.75 + random.random() / 2)   # jitter
+            if deadline is not None:
+                delay = min(delay, max(0.0,
+                                       deadline - _time.monotonic()))
+            _time.sleep(delay)
+            return self._drive(KIND_SUBMIT_PLAN, payload,
+                               fallback_provider)
 
     def _drive(self, kind: int, payload: bytes, fallback_provider):
         batches, done = [], None
-        with socket.create_connection(self.addr,
-                                      timeout=self.timeout_s) as s:
-            write_frame(s, kind, payload)
-            while True:
-                fkind, fpayload = read_frame(s)
-                if fkind == KIND_ERROR:
-                    raise errors.RemoteEngineError(
-                        "engine error:\n" + fpayload.decode())
-                if fkind == KIND_BATCH:
-                    batches.append(_ipc_batch(fpayload))
-                    write_frame(s, KIND_ACK, b"")
-                elif fkind == KIND_NEED_TABLES:
-                    need = json.loads(fpayload.decode())
-                    if fallback_provider is None:
+        try:
+            with self._connect() as s:
+                write_frame(s, kind, payload)
+                while True:
+                    fkind, fpayload = read_frame(s)
+                    if fkind == KIND_ERROR:
                         raise errors.RemoteEngineError(
-                            "engine requested fallback tables "
-                            f"{[n['table'] for n in need]} but no "
-                            "fallback_provider was given")
-                    for ent in need:
-                        tbl = fallback_provider(ent["table"], ent["exec"],
-                                                ent["columns"])
-                        name = ent["table"].encode()
-                        sink = io.BytesIO()
-                        with pa.ipc.new_stream(sink, tbl.schema) as w:
-                            w.write_table(tbl)
-                        write_frame(s, KIND_TABLE,
-                                    struct.pack("<I", len(name)) + name
-                                    + sink.getvalue())
-                elif fkind == KIND_DONE:
-                    done = json.loads(fpayload.decode())
-                    break
+                            "engine error:\n" + fpayload.decode())
+                    if fkind == KIND_BATCH:
+                        batches.append(_ipc_batch(fpayload))
+                        write_frame(s, KIND_ACK, b"")
+                    elif fkind == KIND_NEED_TABLES:
+                        need = json.loads(fpayload.decode())
+                        if fallback_provider is None:
+                            raise errors.RemoteEngineError(
+                                "engine requested fallback tables "
+                                f"{[n['table'] for n in need]} but no "
+                                "fallback_provider was given")
+                        for ent in need:
+                            tbl = fallback_provider(ent["table"],
+                                                    ent["exec"],
+                                                    ent["columns"])
+                            name = ent["table"].encode()
+                            sink = io.BytesIO()
+                            with pa.ipc.new_stream(sink, tbl.schema) as w:
+                                w.write_table(tbl)
+                            write_frame(s, KIND_TABLE,
+                                        struct.pack("<I", len(name)) + name
+                                        + sink.getvalue())
+                    elif fkind == KIND_DONE:
+                        done = json.loads(fpayload.decode())
+                        break
+        except TimeoutError as e:
+            # socket timeout mid-conversation: the per-operation budget
+            # expired with no frame — classify, never hang/raw-OSError
+            raise self._timeout_error() from e
         if batches:
             tbl = pa.Table.from_batches(batches)
         elif done and done.get("schema_ipc"):
@@ -895,16 +1073,34 @@ class AuronClient:
             None)
         return tbl, done.get("metrics", done)
 
+    def hello(self) -> dict:
+        """Replica registration handshake (HELLO frame): the server's
+        identity — {pid, tag, host, port, ops_port, window,
+        journal_dir} — consumed by the fleet router at registration
+        time (and usable by any supervisor for discovery)."""
+        try:
+            with self._connect() as s:
+                write_frame(s, KIND_HELLO, b"")
+                kind, payload = read_frame(s)
+        except TimeoutError as e:
+            raise self._timeout_error() from e
+        if kind == KIND_ERROR:
+            raise errors.RemoteEngineError(
+                "engine error:\n" + payload.decode())
+        return json.loads(payload.decode())
+
     def stats(self) -> dict:
         """The server's live observability over the wire (STATS frame):
         the /queries table + admission counters + server stats as one
         dict — for clients behind firewalls that cannot reach the ops
         HTTP port. The dict carries ``ops_port`` when the HTTP endpoint
         is also running."""
-        with socket.create_connection(self.addr,
-                                      timeout=self.timeout_s) as s:
-            write_frame(s, KIND_STATS, b"")
-            kind, payload = read_frame(s)
+        try:
+            with self._connect() as s:
+                write_frame(s, KIND_STATS, b"")
+                kind, payload = read_frame(s)
+        except TimeoutError as e:
+            raise self._timeout_error() from e
         if kind == KIND_ERROR:
             raise errors.RemoteEngineError(
                 "engine error:\n" + payload.decode())
@@ -916,11 +1112,13 @@ class AuronClient:
         True when a live query was cancelled; raises RuntimeError with
         the structured ``UnknownQuery reason=unknown_query_id`` first
         line when the id is unknown or already finished."""
-        with socket.create_connection(self.addr,
-                                      timeout=self.timeout_s) as s:
-            write_frame(s, KIND_CANCEL,
-                        json.dumps({"query_id": query_id}).encode())
-            kind, payload = read_frame(s)
+        try:
+            with self._connect() as s:
+                write_frame(s, KIND_CANCEL,
+                            json.dumps({"query_id": query_id}).encode())
+                kind, payload = read_frame(s)
+        except TimeoutError as e:
+            raise self._timeout_error() from e
         if kind == KIND_ERROR:
             raise errors.RemoteEngineError(
                 "engine error:\n" + payload.decode())
@@ -929,8 +1127,7 @@ class AuronClient:
     def stream(self, task_bytes: bytes):
         """Yield (kind, payload) frames for one task submission, ACKing
         each BATCH (legacy-shaped helper used by tests)."""
-        with socket.create_connection(self.addr,
-                                      timeout=self.timeout_s) as s:
+        with self._connect() as s:
             write_frame(s, KIND_SUBMIT, task_bytes)
             while True:
                 kind, payload = read_frame(s)

@@ -93,6 +93,14 @@ _PLANS = [
     ("journal_pipeline", "journal.commit:io_error@0.5"),
     ("journal_pipeline",
      "journal.write:io_error@0.2;rss.write:io_error@0.2"),
+    # serving fleet (ISSUE 19): every fleet_failover run SIGKILLs one
+    # of its two replica subprocesses mid-query (the scenario's own
+    # drill) while the seeded plan faults the router's own sites —
+    # routing errors and forward-leg breaks must end in a spill-over,
+    # a failover, or a classified verdict, never wrong rows, and the
+    # shared journal dir must audit clean after teardown
+    ("fleet_failover", "fleet.route:io_error@0.25"),
+    ("fleet_failover", "fleet.forward:io_error@0.25"),
 ]
 
 _FAST_SEEDS = (1, 2)

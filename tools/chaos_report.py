@@ -79,6 +79,15 @@ PLANS = [
     ("journal_pipeline", "journal.commit:io_error@0.5"),
     ("journal_pipeline",
      "journal.write:io_error@0.2;rss.write:io_error@0.2"),
+    # serving fleet (ISSUE 19): a replica SIGKILLed mid-query every run
+    # (the scenario's own drill) PLUS seeded faults on the router's own
+    # sites — routing errors and forward-leg breaks must end in a
+    # spill-over, a failover, or a classified verdict, with the shared
+    # journal dir clean after teardown
+    ("fleet_failover", "fleet.route:io_error@0.25"),
+    ("fleet_failover", "fleet.forward:io_error@0.25"),
+    ("fleet_failover",
+     "fleet.route:io_error@0.15;fleet.forward:io_error@0.15"),
 ]
 
 
@@ -321,7 +330,8 @@ def main(argv=None) -> int:
                                            "mesh_pipeline",
                                            "lifecycle_pipeline",
                                            "overload",
-                                           "journal_pipeline"],
+                                           "journal_pipeline",
+                                           "fleet_failover"],
                     default=None)
     ap.add_argument("--crash", action="store_true",
                     help="run the subprocess crash sweep (SIGKILL at "

@@ -31,6 +31,25 @@ server's cache counters from ``AuronClient.stats()``.
 
     python tools/load_report.py --repeat 10 --expect-speedup 10
 
+``--fleet N`` switches to the FLEET measurement (the serving-fleet
+acceptance figure): N subprocess replicas behind an in-process
+``FleetRouter``, each replica throttled to one concurrent query +
+one queue slot so admission capacity — the thing replication buys —
+is the measured resource.  The same concurrent burst is driven twice
+(once at fleet size 1, once at N, with one replica SIGKILLed
+mid-burst) and the report gates on:
+
+- zero UNCLASSIFIED client errors (every request ends in a result or
+  a structured AdmissionRejected — replica death included);
+- every successful result bit-identical to the baseline table
+  (journal-backed failover must not change bytes);
+- aggregate admitted throughput >= ``--expect-scale`` x the
+  single-replica run (default 2.5);
+- a clean shared journal dir after the dead-owner sweep (a resumable
+  journal nobody failed over = a dropped query).
+
+    python tools/load_report.py --fleet 3
+
 The last stdout line is one JSON record (the bench.py/chaos_report.py
 driver contract)."""
 
@@ -264,18 +283,190 @@ def run_repeat(repeats: int, rows: int) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _fleet_burst(harness, task, clients: int, requests: int,
+                 kill_index=None, kill_after_s: float = 0.0):
+    """Drive ``clients`` x ``requests`` through the harness's router,
+    optionally SIGKILLing one replica mid-burst.  Returns (outcomes,
+    wall_s, tables) where outcomes are ("ok"|"rejected"|"error", lat)
+    tuples — "rejected" strictly means a structured AdmissionRejected
+    verdict, anything else non-ok is an UNCLASSIFIED error."""
+    lock = threading.Lock()
+    outcomes: list = []
+    tables: list = []
+    error_samples: list = []
+    # all clients pass the gate together: admission capacity is the
+    # measured resource, so the burst must actually be simultaneous
+    # (thread start stagger on a small host would smuggle refill
+    # capacity into the "one replica" baseline)
+    barrier = threading.Barrier(clients)
+
+    def drive():
+        client = harness.client(timeout_s=120)
+        barrier.wait(timeout=60)
+        for _ in range(requests):
+            t0 = time.perf_counter()
+            try:
+                tbl, _ = client.execute(task)
+                kind = "ok"
+                with lock:
+                    tables.append(tbl)
+            except Exception as e:   # noqa: BLE001 — tally, don't crash
+                kind = ("rejected" if "AdmissionRejected" in str(e)
+                        else "error")
+                if kind == "error":
+                    with lock:
+                        if len(error_samples) < 3:
+                            error_samples.append(
+                                str(e).replace("\n", " | ")[:300])
+            with lock:
+                outcomes.append((kind, time.perf_counter() - t0))
+
+    threads = [threading.Thread(target=drive, daemon=True)
+               for _ in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    if kill_index is not None:
+        time.sleep(kill_after_s)
+        # prefer a replica that is actually busy so the kill lands on
+        # an in-flight conversation (the failover surface under test)
+        harness.router._poll_once()
+        busy = kill_index
+        for i, rep in enumerate(harness.router._replicas):
+            if rep.snapshot.running or rep.snapshot.queued:
+                busy = i
+                break
+        harness.kill_replica(busy)
+    wedged = 0
+    for t in threads:
+        t.join(300)
+        if t.is_alive():
+            wedged += 1
+    wall = time.perf_counter() - t0
+    return outcomes, wall, tables, wedged, error_samples
+
+
+def _journal_orphans(journal_dir: str) -> list:
+    """Artifacts left in the shared journal dir after the dead-owner
+    sweep: every one is a query failover dropped on the floor."""
+    import glob as globmod
+
+    from auron_tpu.runtime import journal as jrn
+    jrn.sweep_orphans(journal_dir, force=True)
+    leftovers = []
+    for pat in ("*.journal", "*.part", "*.claim"):
+        leftovers.extend(os.path.basename(p) for p in globmod.glob(
+            os.path.join(journal_dir, pat)))
+    rss = os.path.join(journal_dir, "rss")
+    if os.path.isdir(rss):
+        leftovers.extend("rss/" + n for n in os.listdir(rss))
+    return sorted(leftovers)
+
+
+def run_fleet(n: int, clients: int, requests: int, rows: int) -> dict:
+    from auron_tpu.fleet import FleetHarness
+    root = tempfile.mkdtemp(prefix="auron_fleet_load_")
+    # throttle each replica to 1 running + 1 queued query: on a small
+    # host the fleet's win is ADMISSION capacity (more replicas admit
+    # more of the same burst), and this makes that the measured axis
+    env_extra = {"AURON_CONF_SCHED_MAX_CONCURRENT": "1",
+                 "AURON_CONF_SCHED_QUEUE_DEPTH": "1"}
+    try:
+        path = _dataset(root, rows)
+        task = _task_bytes(path)
+        jdir_one = os.path.join(root, "journal_one")
+        jdir_n = os.path.join(root, "journal_n")
+        os.makedirs(jdir_one)
+        os.makedirs(jdir_n)
+
+        with FleetHarness(1, journal_dir=jdir_one,
+                          env_extra=env_extra) as h1:
+            warm: list = []
+            lock = threading.Lock()
+            _drive(h1.address, task, 1, warm, lock)
+            if warm[0][0] != "ok":
+                raise SystemExit("fleet report: warmup failed")
+            base_tbl, _ = h1.client(timeout_s=120).execute(task)
+            out1, wall1, _tbls1, wedged1, errs1 = _fleet_burst(
+                h1, task, clients, requests)
+            stats1 = h1.router.stats_dict()
+
+        with FleetHarness(n, journal_dir=jdir_n,
+                          env_extra=env_extra) as hn:
+            _drive(hn.address, task, 1, [], lock)   # warm compiles
+            outn, walln, tblsn, wedgedn, errsn = _fleet_burst(
+                hn, task, clients, requests, kill_index=0,
+                kill_after_s=1.0)
+            statsn = hn.router.stats_dict()
+
+        orphans = (_journal_orphans(jdir_one)
+                   + _journal_orphans(jdir_n))
+
+        def tally(outcomes, total):
+            ok = sum(1 for k, _ in outcomes if k == "ok")
+            rej = sum(1 for k, _ in outcomes if k == "rejected")
+            return ok, rej, total - ok - rej
+
+        total = clients * requests
+        ok1, rej1, err1 = tally(out1, total)
+        okn, rejn, errn = tally(outn, total)
+        rps1 = ok1 / wall1 if wall1 else 0.0
+        rpsn = okn / walln if walln else 0.0
+        identical = all(t.equals(base_tbl) for t in tblsn)
+        lat = statsn.get("failover_latency_s") or []
+        return {
+            "mode": "fleet",
+            "replicas": n,
+            "clients": clients,
+            "requests_per_client": requests,
+            "input_rows": rows,
+            "one": {"ok": ok1, "rejected": rej1, "error": err1,
+                    "wall_s": round(wall1, 3),
+                    "req_per_sec": round(rps1, 2),
+                    "wedged": wedged1},
+            "fleet": {"ok": okn, "rejected": rejn, "error": errn,
+                      "wall_s": round(walln, 3),
+                      "req_per_sec": round(rpsn, 2),
+                      "wedged": wedgedn},
+            "admitted_scale_x": round(okn / ok1, 2) if ok1 else 0.0,
+            "throughput_scale_x": round(rpsn / rps1, 2) if rps1
+            else 0.0,
+            "bit_identical": identical,
+            "failover": {
+                "deaths": statsn["router"]["replica_deaths"],
+                "resumes": statsn["router"]["failovers_resume"],
+                "reexecutes": statsn["router"]["failovers_reexecute"],
+                "latency_p50_s": round(_pct(lat, 0.50), 4),
+                "latency_p99_s": round(_pct(lat, 0.99), 4),
+            },
+            "router": statsn["router"],
+            "journal_orphans": orphans,
+            "error_samples": errs1 + errsn,
+        }
+    finally:
+        import shutil
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--clients", type=int, default=8,
-                    help="concurrent client threads (default 8)")
-    ap.add_argument("--requests", type=int, default=3,
-                    help="requests per client (default 3)")
+    ap.add_argument("--clients", type=int, default=None,
+                    help="concurrent client threads (default 8; "
+                         "fleet mode: 4 x N)")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="requests per client (default 3; fleet "
+                         "mode: 1 — a single simultaneous round "
+                         "measures admission capacity, not refill "
+                         "dynamics)")
     ap.add_argument("--max-concurrent", type=int, default=2,
                     help="auron.sched.max_concurrent for the run")
     ap.add_argument("--queue-depth", type=int, default=2,
                     help="auron.sched.queue_depth for the run")
-    ap.add_argument("--rows", type=int, default=200_000,
-                    help="rows in the driven aggregation (default 200k)")
+    ap.add_argument("--rows", type=int, default=None,
+                    help="rows in the driven aggregation (default "
+                         "200k; fleet mode: 3M — query time must "
+                         "dwarf burst stagger so admission capacity, "
+                         "not thread scheduling, decides outcomes)")
     ap.add_argument("--expect-shed", action="store_true",
                     help="fail (exit 1) when the overload produced ZERO "
                          "rejections — the admission door went untested")
@@ -289,13 +480,70 @@ def main(argv=None) -> int:
                     help="with --repeat: fail (exit 1) when the warm "
                          "p50 speedup is under X or the cached results "
                          "are not bit-identical")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="fleet mode: N subprocess replicas behind a "
+                         "router, one SIGKILLed mid-burst; reports "
+                         "admitted-throughput scale vs one replica, "
+                         "failover latency, and journal cleanliness")
+    ap.add_argument("--expect-scale", type=float, default=2.5,
+                    metavar="X",
+                    help="with --fleet: fail (exit 1) when aggregate "
+                         "admitted throughput is under X x the one-"
+                         "replica run (default 2.5)")
     args = ap.parse_args(argv)
 
+    if args.fleet > 0:
+        rep = run_fleet(args.fleet,
+                        args.clients or 4 * args.fleet,
+                        args.requests or 1,
+                        args.rows or 3_000_000)
+        o, f, fo = rep["one"], rep["fleet"], rep["failover"]
+        print(f"fleet report: {args.fleet} replicas, "
+              f"{rep['clients']} clients x "
+              f"{rep['requests_per_client']} req, one replica "
+              "SIGKILLed mid-burst")
+        print(f"  one replica : {o['ok']} ok / {o['rejected']} "
+              f"rejected / {o['error']} error in {o['wall_s']}s "
+              f"({o['req_per_sec']} req/s)")
+        print(f"  fleet       : {f['ok']} ok / {f['rejected']} "
+              f"rejected / {f['error']} error in {f['wall_s']}s "
+              f"({f['req_per_sec']} req/s)")
+        print(f"  admitted scale: {rep['admitted_scale_x']}x ; "
+              f"throughput scale: {rep['throughput_scale_x']}x")
+        print(f"  failover: {fo['deaths']} death(s), {fo['resumes']} "
+              f"resumed / {fo['reexecutes']} re-executed, "
+              f"p50/p99 {fo['latency_p50_s']}s / {fo['latency_p99_s']}s")
+        print(f"  bit-identical results: {rep['bit_identical']} ; "
+              f"journal orphans: {len(rep['journal_orphans'])}")
+        rc = 0
+        if f["error"] or f["wedged"] or o["error"] or o["wedged"]:
+            print(f"  FAIL: {f['error'] + o['error']} request(s) died "
+                  f"UNCLASSIFIED / {f['wedged'] + o['wedged']} "
+                  "wedged — replica death leaked to a client")
+            rc = 1
+        if not rep["bit_identical"]:
+            print("  FAIL: a failed-over result differs from the "
+                  "baseline table")
+            rc = 1
+        if rep["journal_orphans"]:
+            print(f"  FAIL: journal orphans left behind: "
+                  f"{rep['journal_orphans']}")
+            rc = 1
+        if rep["throughput_scale_x"] < args.expect_scale \
+                and rep["admitted_scale_x"] < args.expect_scale:
+            print(f"  FAIL: admitted throughput scaled "
+                  f"{rep['throughput_scale_x']}x (admitted "
+                  f"{rep['admitted_scale_x']}x) < expected "
+                  f"{args.expect_scale}x")
+            rc = 1
+        print(json.dumps(rep))
+        return rc
+
     if args.repeat > 0:
-        rep = run_repeat(args.repeat, args.rows)
+        rep = run_repeat(args.repeat, args.rows or 200_000)
         c, w = rep["cold"], rep["warm"]
         print(f"repeat report: {args.repeat} runs cold vs warm "
-              f"({args.rows} rows)")
+              f"({rep['input_rows']} rows)")
         print(f"  cold p50/p99: {c['p50_s']}s / {c['p99_s']}s "
               f"(cache disabled)")
         print(f"  warm p50/p99: {w['p50_s']}s / {w['p99_s']}s "
@@ -319,8 +567,9 @@ def main(argv=None) -> int:
         print(json.dumps(rep))
         return rc
 
-    rep = run_load(args.clients, args.requests, args.max_concurrent,
-                   args.queue_depth, args.rows)
+    rep = run_load(args.clients or 8, args.requests or 3,
+                   args.max_concurrent, args.queue_depth,
+                   args.rows or 200_000)
     c, s = rep["concurrent"], rep["serial"]
     print(f"load report: {args.clients} clients x {args.requests} req, "
           f"max_concurrent={args.max_concurrent} "

@@ -558,6 +558,134 @@ def run_fusion_gate(smoke: dict) -> dict:
     return out
 
 
+def run_fleet_gate(smoke: dict) -> dict:
+    """Serving-fleet arm (the replicated-AuronServer plane): TWO real
+    replica subprocesses behind an in-process ``FleetRouter``; a query
+    is driven through the router and the replica that picked it up is
+    SIGKILLed mid-flight. The gate holds when the client still receives
+    the bit-identical table (journal RESUME on the survivor, or guarded
+    re-execution — either is a legitimate failover), exactly one
+    replica death is recorded, and the detect-to-done failover latency
+    stays under ``smoke.fleet_failover_ceiling_s`` — an idle survivor
+    has free capacity, so a slow failover here is router overhead, not
+    admission queueing. Returns ``{"fleet_gate": "pass"|"fail",
+    "fleet_failover_s": ..., ...}``."""
+    import tempfile
+    import threading
+    import time
+
+    ceiling = float(smoke.get("fleet_failover_ceiling_s", 10.0))
+    out: dict = {"fleet_gate": "pass",
+                 "fleet_failover_ceiling_s": ceiling}
+    root = None
+    try:
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from auron_tpu.fleet.replica import FleetHarness
+        from auron_tpu.ir import pb
+
+        root = tempfile.mkdtemp(prefix="auron_fleet_gate_")
+        rng = np.random.default_rng(19)
+        n = 600_000
+        path = os.path.join(root, "fleet.parquet")
+        pq.write_table(pa.table({
+            "k": pa.array(rng.integers(0, 64, n), pa.int64()),
+            "v": pa.array(rng.normal(size=n), pa.float64())}), path)
+        col = lambda i: pb.ExprNode(column=pb.ColumnRefE(index=i))
+        plan = pb.PlanNode(agg=pb.AggNode(
+            child=pb.PlanNode(parquet_scan=pb.ParquetScanNode(
+                files=[path])),
+            mode="complete", group_exprs=[col(0)],
+            aggs=[pb.AggFunctionP(fn="sum", arg=col(1)),
+                  pb.AggFunctionP(fn="count", arg=col(1))]))
+        task = pb.TaskDefinition(plan=plan,
+                                 task_id=1).SerializeToString()
+
+        with FleetHarness(2) as h:
+            warm, _ = h.client(timeout_s=120).execute(task)
+            box: dict = {}
+
+            def drive() -> None:
+                try:
+                    tbl, _ = h.client(timeout_s=120).execute(task)
+                    box["table"] = tbl
+                except BaseException as e:   # noqa: BLE001 — verdict below
+                    box["err"] = e
+
+            t = threading.Thread(target=drive, daemon=True)
+            t.start()
+            victim = None
+            deadline = time.monotonic() + 10.0
+            while victim is None and t.is_alive() \
+                    and time.monotonic() < deadline:
+                h.router._poll_once()
+                for i in range(len(h.replicas)):
+                    snap = h.router._replicas[i].snapshot
+                    if snap is not None and snap.occupancy > 0:
+                        victim = i
+                        break
+                if victim is None:
+                    time.sleep(0.05)
+            if victim is not None and h.replicas[victim].alive():
+                h.kill_replica(victim)
+            t.join(timeout=120)
+            stats = h.router.stats_dict()
+            r = stats["router"]
+            out["fleet_deaths"] = r["replica_deaths"]
+            out["fleet_failover_kind"] = (
+                "resume" if r["failovers_resume"]
+                else "reexecute" if r["failovers_reexecute"] else "none")
+            lats = stats.get("failover_latency_s") or []
+            out["fleet_failover_s"] = round(lats[0], 3) if lats else None
+            if t.is_alive():
+                out["fleet_gate"] = "fail"
+                out["fleet_error"] = ("the killed query never "
+                                      "completed or classified (wedged)")
+            elif victim is None:
+                out["fleet_gate"] = "fail"
+                out["fleet_error"] = ("no replica ever showed the query "
+                                      "running — nothing was killed, "
+                                      "nothing gated")
+            elif "err" in box:
+                out["fleet_gate"] = "fail"
+                out["fleet_error"] = (f"failover surfaced an error to "
+                                      f"the client: "
+                                      f"{type(box['err']).__name__}: "
+                                      f"{str(box['err'])[:200]}")
+            elif not box["table"].equals(warm):
+                out["fleet_gate"] = "fail"
+                out["fleet_error"] = ("failed-over query's table is "
+                                      "not bit-identical to the warm "
+                                      "pass")
+            elif r["replica_deaths"] != 1:
+                out["fleet_gate"] = "fail"
+                out["fleet_error"] = (f"expected exactly one recorded "
+                                      f"replica death, saw "
+                                      f"{r['replica_deaths']}")
+            elif out["fleet_failover_kind"] == "none":
+                out["fleet_gate"] = "fail"
+                out["fleet_error"] = ("no failover recorded — the "
+                                      "query survived without one "
+                                      "(kill landed too late?)")
+            elif lats and lats[0] >= ceiling:
+                out["fleet_gate"] = "fail"
+                out["fleet_error"] = (
+                    f"failover took {lats[0]:.2f}s >= ceiling "
+                    f"{ceiling:.0f}s against an IDLE survivor — "
+                    f"router overhead, not admission queueing")
+    except Exception as e:   # noqa: BLE001 — verdict, not a crash
+        return {"fleet_gate": "fail",
+                "fleet_failover_ceiling_s": ceiling,
+                "fleet_error": f"{type(e).__name__}: {e}"}
+    finally:
+        if root is not None:
+            import shutil
+            shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def run_smoke(baseline: dict) -> dict:
     """Tier-1-fast smoke arm: run the q01 operator pipeline in-process
     at a tiny scale and compare against the generous smoke floor — an
@@ -591,7 +719,12 @@ def run_smoke(baseline: dict) -> dict:
     And as the FUSION 2.0 gate (``run_fusion_gate``): map-side combine
     must cut the dup-heavy grouped-agg A/B's live shuffle bytes by at
     least ``smoke.combine_byte_reduction_floor`` — a fold that silently
-    disengaged ships exactly the combine-off bytes and fails here."""
+    disengaged ships exactly the combine-off bytes and fails here.
+
+    And as the SERVING-FLEET gate (``run_fleet_gate``): a two-replica
+    fleet with one replica SIGKILLed mid-query must hand the client the
+    bit-identical table via failover within
+    ``smoke.fleet_failover_ceiling_s`` of detection."""
     import tempfile
     import time
 
@@ -702,6 +835,15 @@ def run_smoke(baseline: dict) -> dict:
             verdict["perf_gate"] = "fail"
             verdict["reason"] = (
                 f"ops-plane gate: {verdict.get('ops_error', 'failed')}")
+        # serving-fleet arm: a 2-replica fleet must survive a SIGKILL
+        # mid-query — bit-identical answer to the client via failover
+        # (resume or guarded re-execution), within the latency ceiling
+        verdict.update(run_fleet_gate(smoke))
+        if verdict["fleet_gate"] != "pass" \
+                and verdict["perf_gate"] == "pass":
+            verdict["perf_gate"] = "fail"
+            verdict["reason"] = (
+                f"fleet gate: {verdict.get('fleet_error', 'failed')}")
         # lint arm: the AST contract checker must hold on HEAD (a
         # missing/stale tools/lint_baseline.json fails loudly — decay
         # of the invariant surface can't hide between rounds either)
@@ -754,6 +896,10 @@ def main(argv=None) -> int:
               f"-{verdict.get('combine_byte_reduction', 0) * 100:.0f}% "
               f"shuffle bytes (floor "
               f"-{verdict.get('combine_byte_reduction_floor', 0) * 100:.0f}%), "
+              f"fleet failover "
+              f"{verdict.get('fleet_failover_kind', '?')} in "
+              f"{verdict.get('fleet_failover_s', '?')}s (ceiling "
+              f"{verdict.get('fleet_failover_ceiling_s', '?'):.0f}s), "
               f"lint {verdict.get('lint_new', '?')} new → "
               f"{verdict['perf_gate'].upper()}")
         print(json.dumps(verdict))
