@@ -569,7 +569,7 @@ TRACE_EVENTS = _opt(
     "auron.trace.events", str, "",
     "Comma-separated span-category allowlist (query, task, program, "
     "shuffle, spill, fault, watchdog, memory, sched, mesh, journal, "
-    "cache); empty records every category. "
+    "cache, fleet); empty records every category. "
     "Narrowing the list bounds tracing overhead on hot paths — e.g. "
     "'task,shuffle,fault' drops the per-hit program events.")
 TRACE_MAX_SPANS = _opt(
@@ -578,6 +578,19 @@ TRACE_MAX_SPANS = _opt(
     "dropped (counted — the Chrome export records dropped_spans) so an "
     "unbounded query can never turn the tracer into a memory leak. "
     "The cap is approximate: enforcement is lock-free like recording.")
+TRACE_PROPAGATE = _opt(
+    "auron.trace.propagate", bool, True,
+    "Cross-process trace-context propagation over the serving wire "
+    "protocol: when tracing is enabled and a trace is active, "
+    "AuronClient prefixes SUBMIT/SUBMIT_PLAN/RESUME with a TRACE frame "
+    "(trace id + parent span id), the fleet router adds a fleet.forward "
+    "hop span and forwards the context, and the replica adopts the "
+    "inbound id as its query-span parent — so exports from client, "
+    "router, and every replica share ONE trace id and "
+    "tools/trace_report.py --stitch renders a single cross-process "
+    "timeline. With tracing off (or no active trace) nothing extra is "
+    "sent on the wire; overhead with tracing on is gated < 2% by the "
+    "perf-gate obs-fleet arm.")
 
 # ops plane: live telemetry endpoint (auron_tpu/obs/ops_server.py)
 OPS_ENABLED = _opt(
@@ -629,6 +642,14 @@ FLEET_FAILOVER = _opt(
     "journaled in-flight queries from scratch under a result-key "
     "idempotency guard. Off surfaces replica death to the client as a "
     "classified ReplicaUnavailable.")
+FLEET_OPS_PORT = _opt(
+    "auron.fleet.ops_port", int, -1,
+    "TCP port of the ROUTER's own ops HTTP endpoint (federated "
+    "/metrics merging every replica's scraped exposition re-labeled "
+    "replica=\"rN\", /fleet/queries merging the live query tables, "
+    "/healthz with per-replica up/down rows). 0 binds an ephemeral "
+    "port (surfaced as FleetRouter.ops_address and on the router STATS "
+    "frame); a negative value (default) disables the router endpoint.")
 CLIENT_TIMEOUT_S = _opt(
     "auron.client.timeout_s", float, 30.0,
     "AuronClient socket budget: connect timeout per attempt and read "
@@ -690,6 +711,19 @@ METRICS_REGISTRY = _opt(
     "exposition (render_prometheus) is the scrape surface — the role "
     "of the reference's pprof HTTP endpoints. Off skips the per-task "
     "observation entirely.")
+
+# per-query cost ledger (auron_tpu/obs/ledger.py)
+LEDGER_ENABLED = _opt(
+    "auron.ledger.enabled", bool, True,
+    "Assemble a compact per-query cost ledger at query finalize "
+    "(auron_tpu/obs/ledger.py): device seconds vs host-bucket splits, "
+    "shuffle/spill/combine bytes and rows, cache hits, retries and "
+    "recovery counts, replica hops. The record rides the serving DONE "
+    "frame, lands in failure bundles (ledger.json), and surfaces in "
+    "AuronClient.stats() and tools/load_report.py — the accounting "
+    "unit for admission and capacity decisions at fleet scale. "
+    "Overhead is gated < 2% by the perf-gate obs-fleet arm; off skips "
+    "assembly entirely (no ledger on DONE, none retained).")
 
 # metrics / sinks
 METRICS_DEVICE_SYNC = _opt(

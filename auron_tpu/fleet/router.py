@@ -31,11 +31,25 @@ behaviors compose the availability story:
 Fault sites ``fleet.route`` (the routing decision) and
 ``fleet.forward`` (the router→replica conversation) extend the
 deterministic fault plane to this tier.
+
+Observability rides the same paths: an inbound TRACE prefix frame is
+adopted (``trace.wire_scope`` with role "router") so routing decisions
+(``fleet.route`` events), forwarding conversations (``fleet.forward``
+spans) and failovers land in the CLIENT's trace, and a fresh context is
+forwarded to the replica so all three processes share one trace id.
+``auron.fleet.ops_port`` ≥ 0 additionally opens the router's own ops
+endpoint: /metrics federates every replica's last-scraped exposition
+re-labeled ``replica="rN"`` alongside the router's registry, and
+/fleet/queries merges the live query tables (dead replicas labeled
+``down``). A liveness-confirmed death writes a fleet failure bundle
+(routing timeline + the dead replica's last scraped state), and the
+DONE-frame cost ledger is augmented with fleet facts before replay.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import random
 import socket
 import socketserver
@@ -43,7 +57,11 @@ import threading
 import time
 
 from auron_tpu import errors
+from auron_tpu.obs import ops_server as _ops
+from auron_tpu.obs import trace as _trace
 from auron_tpu.runtime import serving
+
+logger = logging.getLogger("auron_tpu.fleet")
 
 
 class _Flight:
@@ -65,6 +83,14 @@ class _Replica:
         self.name = f"{host}:{port}"
         self.hello: dict = {}
         self.dead = False
+        #: last successfully scraped bodies, stashed by the POLL loop —
+        #: the router ops endpoint serves ONLY these (a handler never
+        #: scrapes inline, so a wedged replica cannot wedge a scrape of
+        #: the router, and a dead replica's last state survives for the
+        #: fleet failure bundle)
+        self.last_health: dict = {}
+        self.last_queries: dict = {}
+        self.last_metrics: str = ""
         from auron_tpu.fleet import snapshot as snap_mod
         self.snapshot = snap_mod.unreachable(self.name, host, port, 0.0)
 
@@ -95,6 +121,35 @@ class _RouterServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
+class _FleetOpsHandler(_ops._OpsHandler):
+    """The router's ops endpoint: fleet-scope views assembled from
+    the poll loop's stashed scrapes — a handler NEVER touches a
+    replica's network, so a wedged or dead replica cannot wedge a
+    scrape of the router."""
+
+    _KNOWN_PATHS = frozenset(
+        ("/metrics", "/healthz", "/fleet/queries", "/"))
+
+    def _route(self, path: str, q: dict) -> None:
+        self._count(path)
+        router = self.server.context
+        if path == "/metrics":
+            self._reply(200, router.federated_metrics().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            self._reply_json(router.fleet_health())
+        elif path == "/fleet/queries":
+            self._reply_json(router.fleet_queries())
+        elif path == "/":
+            self._reply_json({
+                "service": "auron fleet ops endpoint",
+                "endpoints": ["/metrics", "/healthz",
+                              "/fleet/queries"]})
+        else:
+            self._reply(404, f"no such endpoint {path!r}\n".encode(),
+                        "text/plain; charset=utf-8")
+
+
 class FleetRouter:
     """Router/coordinator over ``replicas`` = [(host, port), ...]."""
 
@@ -110,6 +165,8 @@ class FleetRouter:
         io_t = conf.get(cfg.CLIENT_TIMEOUT_S)
         #: per-operation socket budget for replica conversations
         self.io_timeout_s = io_t if io_t and io_t > 0 else None
+        #: -1 = no router ops endpoint; 0 = ephemeral; >0 = fixed
+        self.ops_port_conf = int(conf.get(cfg.FLEET_OPS_PORT))
         self._replicas = [_Replica(h, p) for h, p in replicas]
         if not self._replicas:
             raise ValueError("a fleet needs at least one replica")
@@ -127,6 +184,10 @@ class FleetRouter:
         self._srv.router = self
         self._poll_stop = threading.Event()
         self._poll_thread = None
+        self._ops_srv = None
+        #: most recent fleet death bundle path — _observe_failover
+        #: appends the survivor's recovery record (failover.json) there
+        self._last_death_bundle = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -150,10 +211,33 @@ class FleetRouter:
         self._poll_thread.start()
         threading.Thread(target=self._srv.serve_forever,
                          daemon=True).start()
+        if self.ops_port_conf >= 0:
+            self._start_ops()
         return self
+
+    def _start_ops(self) -> None:
+        """Bind the router's own ops endpoint (auron.fleet.ops_port ≥
+        0). Observability, never availability: a taken port logs and
+        the fleet serves on."""
+        from auron_tpu.obs import ops_server as _ops
+        try:
+            self._ops_srv = _ops.OpsServer(
+                port=self.ops_port_conf,
+                handler_cls=_FleetOpsHandler, context=self).start()
+        except OSError:
+            logger.exception("could not bind the fleet ops endpoint")
+            self._ops_srv = None
+
+    @property
+    def ops_address(self):
+        """(host, port) of the router ops endpoint, or None."""
+        return self._ops_srv.address if self._ops_srv else None
 
     def close(self) -> None:
         self._poll_stop.set()
+        if self._ops_srv is not None:
+            self._ops_srv.stop()
+            self._ops_srv = None
         self._srv.shutdown()
         self._srv.server_close()
 
@@ -165,6 +249,8 @@ class FleetRouter:
             body = {"router": dict(self.stats),
                     "failover_latency_s": lat,
                     "replicas": {}}
+        ops = self.ops_address
+        body["ops_port"] = ops[1] if ops else None
         for rep in self._replicas:
             s = rep.snapshot
             body["replicas"][rep.name] = {
@@ -176,6 +262,66 @@ class FleetRouter:
                 "resume_stems": list(s.resume_stems),
                 "pid": rep.pid, "ops_port": rep.ops_port}
         return body
+
+    # -- fleet ops views (served by _FleetOpsHandler) -------------------------
+
+    def federated_metrics(self) -> str:
+        """The router /metrics body: this process's registry exposition
+        merged with every live replica's last-scraped exposition,
+        replica samples re-labeled ``replica="rN"`` (strict round-trip
+        through ``registry.parse_prometheus`` on both ends). Dead
+        replicas' stale expositions are dropped — their reachability
+        survives as the ``auron_fleet_replica_up`` gauge."""
+        from auron_tpu.obs import registry as _reg
+        local = _reg.get_registry().render_prometheus()
+        texts = [(f"r{i}", rep.last_metrics)
+                 for i, rep in enumerate(self._replicas)
+                 if not rep.dead and rep.last_metrics]
+        return _reg.render_federated(local, texts)
+
+    def fleet_queries(self) -> dict:
+        """The /fleet/queries body: every replica's live query table
+        merged, each row tagged with its replica label; dead or
+        unreachable replicas stay in the replica table labeled
+        ``down`` (the scrape-under-failover contract)."""
+        merged: list = []
+        replicas: dict = {}
+        for i, rep in enumerate(self._replicas):
+            label = f"r{i}"
+            s = rep.snapshot
+            replicas[label] = {
+                "name": rep.name,
+                "status": ("down" if (rep.dead or not s.ok)
+                           else s.status),
+                "dead": rep.dead,
+                "running": s.running, "queued": s.queued,
+                "pid": rep.pid, "ops_port": rep.ops_port}
+            if rep.dead:
+                continue
+            for row in (rep.last_queries or {}).get("queries") or []:
+                if isinstance(row, dict):
+                    merged.append(dict(row, replica=label,
+                                       replica_name=rep.name))
+        return {"role": "router", "replicas": replicas,
+                "queries": merged}
+
+    def fleet_health(self) -> dict:
+        """The router /healthz body: a fleet-level verdict (``ok``
+        while at least one replica is routable) plus the router's own
+        counters and per-replica reachability."""
+        with self._lock:
+            stats = dict(self.stats)
+        live = sum(1 for rep in self._replicas if not rep.dead)
+        return {
+            "status": "ok" if live else "degraded",
+            "role": "router",
+            "replicas_total": len(self._replicas),
+            "replicas_live": live,
+            "router": stats,
+            "replicas": {
+                rep.name: ("down" if (rep.dead or not rep.snapshot.ok)
+                           else rep.snapshot.status)
+                for rep in self._replicas}}
 
     # -- replica registration + polling --------------------------------------
 
@@ -212,12 +358,22 @@ class FleetRouter:
                         rep.name, rep.host, rep.port, health, queries,
                         now)
                     rep.dead = False
+                    rep.last_health, rep.last_queries = health, queries
+                    try:
+                        rep.last_metrics = snap_mod.scrape_text(
+                            rep.host, rep.ops_port, "/metrics",
+                            timeout_s=max(self.poll_s, 0.5))
+                    except OSError:
+                        rep.last_metrics = ""
                 except (OSError, ValueError):
                     snap = None
             if snap is None:
                 snap = snap_mod.unreachable(rep.name, rep.host,
                                             rep.port, now)
             rep.snapshot = snap
+            self._gauge("auron_fleet_replica_up",
+                        0.0 if (rep.dead or not snap.ok) else 1.0,
+                        replica=rep.name)
 
     def _snapshots(self) -> list:
         return [rep.snapshot for rep in self._replicas if not rep.dead]
@@ -250,13 +406,42 @@ class FleetRouter:
                     time.sleep(0.05)
                     confirmed = liveness.owner_dead(pid, epoch)
         if confirmed:
+            first = False
             with self._lock:
                 if not rep.dead:   # N broken conversations, ONE death
                     rep.dead = True
                     rep.snapshot = snap_mod.unreachable(
                         rep.name, rep.host, rep.port, time.monotonic())
                     self.stats["replica_deaths"] += 1
+                    first = True
+            if first:
+                _trace.event("fleet", "fleet.death", replica=rep.name,
+                             pid=rep.pid or 0)
+                self._count("auron_fleet_replica_deaths_total",
+                            replica=rep.name)
+                self._gauge("auron_fleet_replica_up", 0.0,
+                            replica=rep.name)
+                self._write_death_bundle(rep)
         return confirmed
+
+    def _write_death_bundle(self, rep: _Replica) -> None:
+        """Fleet failure bundle on the FIRST confirmation of a death:
+        the router's routing/failover timeline (its flight ring), the
+        dead replica's LAST scraped health + query table, and the
+        router counters. The survivor's recovery record
+        (``failover.json``) is appended by ``_observe_failover`` once
+        recovery lands."""
+        try:
+            from auron_tpu.obs import bundle as _bundle
+            from auron_tpu.obs import flight_recorder as _flight
+            path = _bundle.write_fleet_death(
+                rep.name, rep.last_health, rep.last_queries,
+                self.stats_dict(), _flight.recorder().dump_jsonl())
+            if path:
+                with self._lock:
+                    self._last_death_bundle = path
+        except Exception:   # graft: disable=GL004 -- diagnostics must never block failover
+            logger.exception("fleet death bundle failed")
 
     # -- metrics -------------------------------------------------------------
 
@@ -265,6 +450,14 @@ class FleetRouter:
             from auron_tpu.obs import registry as _reg
             if _reg.enabled():
                 _reg.get_registry().counter(name, **labels).inc()
+        except Exception:   # graft: disable=GL004 -- metric emission is best-effort by contract
+            pass
+
+    def _gauge(self, name: str, value: float, **labels) -> None:
+        try:
+            from auron_tpu.obs import registry as _reg
+            if _reg.enabled():
+                _reg.get_registry().gauge(name, **labels).set(value)
         except Exception:   # graft: disable=GL004 -- metric emission is best-effort by contract
             pass
 
@@ -283,6 +476,19 @@ class FleetRouter:
                     "auron_fleet_failover_seconds").observe(seconds)
         except Exception:   # graft: disable=GL004 -- metric emission is best-effort by contract
             pass
+        _trace.event("fleet", "fleet.failover", survivor=replica,
+                     action=action, latency_s=round(seconds, 6))
+        with self._lock:
+            bundle_path = self._last_death_bundle
+        if bundle_path:
+            from auron_tpu.obs import bundle as _bundle
+            _bundle.add_artifact(bundle_path, "failover.json",
+                                 json.dumps({"survivor": replica,
+                                             "action": action,
+                                             "latency_s":
+                                                 round(seconds, 6),
+                                             "wall": time.time()},
+                                            indent=2))
 
     # -- connection dispatch -------------------------------------------------
 
@@ -291,6 +497,25 @@ class FleetRouter:
             kind, payload = serving.read_frame(sock)
         except (OSError, ConnectionError):
             return
+        wire_ctx = None
+        if kind == serving.KIND_TRACE:
+            # optional trace-context prefix frame: adopt the client's
+            # trace id so every routing decision, forward and failover
+            # this conversation makes lands in the client's trace
+            try:
+                ctx = json.loads(payload.decode() or "{}")
+                if isinstance(ctx, dict):
+                    wire_ctx = ctx
+            except (ValueError, UnicodeDecodeError):
+                pass
+            try:
+                kind, payload = serving.read_frame(sock)
+            except (OSError, ConnectionError):
+                return
+        with _trace.wire_scope(wire_ctx, role="router"):
+            self._dispatch(sock, kind, payload)
+
+    def _dispatch(self, sock, kind: int, payload: bytes) -> None:
         try:
             if kind == serving.KIND_SHUTDOWN:
                 self._shutdown_fleet()
@@ -333,9 +558,11 @@ class FleetRouter:
     def _send_router_hello(self, sock) -> None:
         import os
         from auron_tpu.utils import liveness
+        ops = self.ops_address
         body = {"pid": os.getpid(), "tag": liveness.own_tag(),
                 "role": "router",
                 "host": self.address[0], "port": self.address[1],
+                "ops_port": ops[1] if ops else None,
                 "replicas": [rep.name for rep in self._replicas]}
         serving.write_frame(sock, serving.KIND_DONE,
                             json.dumps(body).encode())
@@ -443,6 +670,8 @@ class FleetRouter:
             reason = ("warm" if self.affinity
                       and (fp in cands[0].warm_fps
                            or cands[0].name == sticky) else "load")
+            _trace.event("fleet", "fleet.route", replica=target.name,
+                         reason=reason, attempt=attempt)
             res = self._drive_replica(target, kind, fwd, client)
             rkind = res["kind"]
             if rkind == "done":
@@ -452,13 +681,19 @@ class FleetRouter:
                         self._sticky[fp] = target.name
                 self._count("auron_fleet_routed_total",
                             replica=target.name, reason=reason)
-                self._replay(client, res["batches"], res["done"])
+                self._replay(client, res["batches"],
+                             self._augment_done(
+                                 res["done"], hops=attempt,
+                                 spillovers=len(sheds),
+                                 replica=target.name))
                 return
             if rkind == "client_gone":
                 return
             if rkind == "error":
                 with self._lock:
                     self.stats["errors_forwarded"] += 1
+                self._count("auron_fleet_errors_forwarded_total",
+                            replica=target.name)
                 serving.write_frame(client, serving.KIND_ERROR,
                                     res["payload"])
                 return
@@ -570,7 +805,11 @@ class FleetRouter:
                             time.monotonic() - t_detect, rep.name,
                             "resume")
                         self._replay(client, res["batches"],
-                                     res["done"])
+                                     self._augment_done(
+                                         res["done"],
+                                         hops=len(excluded) + 1,
+                                         failover="resume",
+                                         replica=rep.name))
                         return True
                     if res["kind"] == "client_gone":
                         return True
@@ -666,6 +905,7 @@ class FleetRouter:
             if fl.result is not None:
                 with self._lock:
                     self.stats["guard_shared"] += 1
+                self._count("auron_fleet_guard_shared_total")
                 self._replay(client, fl.result["batches"],
                              fl.result["done"])
                 return "served"
@@ -688,7 +928,11 @@ class FleetRouter:
                         if owner:
                             fl.result = res
                         self._replay(client, res["batches"],
-                                     res["done"])
+                                     self._augment_done(
+                                         res["done"],
+                                         hops=len(excluded) + 1,
+                                         failover="reexecute",
+                                         replica=rep.name))
                         return "served"
                     if res["kind"] == "client_gone":
                         return "gone"
@@ -750,7 +994,9 @@ class FleetRouter:
             if res["kind"] == "done":
                 with self._lock:
                     self.stats["routed"] += 1
-                self._replay(client, res["batches"], res["done"])
+                self._replay(client, res["batches"],
+                             self._augment_done(res["done"], hops=1,
+                                                replica=rep.name))
                 return
             if res["kind"] == "client_gone":
                 return
@@ -788,10 +1034,19 @@ class FleetRouter:
                 (rep.host, rep.port), timeout=self.io_timeout_s)
         except OSError:
             return {"kind": "died", "query_id": None, "pid": None}
-        with rsock:
+        with rsock, _trace.span("fleet", "fleet.forward",
+                                replica=rep.name, kind=kind):
             try:
                 faults.maybe_fail("fleet.forward",
                                   errors.ReplicaUnavailable)
+                # forward the adopted trace context so the replica's
+                # spans join the same trace (parent = this forward
+                # span); None when tracing/propagation is off — the
+                # replica-side wire is then byte-identical to before
+                fctx = _trace.wire_context()
+                if fctx is not None:
+                    serving.write_frame(rsock, serving.KIND_TRACE,
+                                        json.dumps(fctx).encode())
                 serving.write_frame(rsock, kind, payload)
                 while True:
                     faults.maybe_hang("fleet.forward")
@@ -852,6 +1107,25 @@ class FleetRouter:
         except (OSError, ConnectionError, ValueError):
             return False
 
+    def _augment_done(self, done_payload: bytes, **fleet) -> bytes:
+        """Stamp fleet facts (hops, spillovers, failover action, the
+        serving replica) into the DONE frame's cost ledger before the
+        replay to the client — tolerant of a ledger-less or non-JSON
+        payload (ledger disabled, an older replica): the payload then
+        passes through untouched."""
+        try:
+            done = json.loads(done_payload.decode())
+        except (ValueError, UnicodeDecodeError):
+            return done_payload
+        if not isinstance(done, dict) or "cost_ledger" not in done:
+            return done_payload
+        from auron_tpu.obs import ledger as _ledger
+        _ledger.augment_fleet(done["cost_ledger"], **fleet)
+        try:
+            return json.dumps(done, default=str).encode()
+        except (TypeError, ValueError):   # pragma: no cover
+            return done_payload
+
     def _replay(self, client, batches: list, done_payload: bytes) -> bool:
         """Forward the buffered result to the client under its ACK
         flow control (one un-ACKed frame in flight — the router is the
@@ -880,6 +1154,8 @@ def main(argv=None) -> int:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     args = ap.parse_args(argv)
+    from auron_tpu.obs import flight_recorder as _flight
+    _flight.set_role("router")
     replicas = []
     for spec in args.replica:
         host, _, port = spec.rpartition(":")

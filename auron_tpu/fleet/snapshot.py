@@ -108,6 +108,19 @@ def snapshot_from_bodies(name: str, host: str, port: int,
         scraped_at=scraped_at)
 
 
+def scrape_text(host: str, ops_port: int, path: str = "/metrics",
+                timeout_s: float = 2.0) -> str:
+    """Fetch one ops endpoint body as raw text — the router federates
+    each replica's /metrics exposition verbatim (re-labeling happens at
+    render time, ``registry.render_federated``). Raises OSError on an
+    unreachable endpoint; the poll loop treats that as a missed scrape,
+    not a death."""
+    with urllib.request.urlopen(
+            f"http://{host}:{ops_port}{path}",
+            timeout=timeout_s) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
 def scrape_replica(host: str, ops_port: int,
                    timeout_s: float = 2.0) -> tuple[dict, dict]:
     """Fetch (/healthz body, /queries body) from a replica's ops

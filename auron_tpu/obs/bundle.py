@@ -167,6 +167,7 @@ def _write(exc, outcome: str, token=None, config=None, scheduler=None,
         "pid": os.getpid(),
     }, indent=2, default=str))
     art("flight.jsonl", _flight_dump)
+    art("ledger.json", lambda: _ledger_json(token))
     art("explain.txt", lambda: _explain_text(token))
     art("metrics.prom", _metrics_text)
     art("scheduler.json", lambda: _scheduler_json(scheduler))
@@ -190,7 +191,113 @@ def _write(exc, outcome: str, token=None, config=None, scheduler=None,
     return path
 
 
+def write_fleet_death(dead_name: str, dead_health, dead_queries,
+                      router_stats, timeline: str,
+                      config=None) -> Optional[str]:
+    """Fleet failure bundle: one directory per liveness-confirmed
+    replica death, written by the ROUTER (the only process that saw
+    the whole story):
+
+    ``bundle_fleet_death_<replica>/``
+        ``bundle.json``            manifest (kind=fleet_death)
+        ``routing_timeline.jsonl`` the router's flight ring — route /
+                                   forward / death / failover events
+        ``replica_health.json``    the dead replica's LAST scraped
+                                   /healthz body (its final state)
+        ``replica_queries.json``   its last /queries table
+        ``router_stats.json``      router counters + fleet snapshot
+
+    The survivor's recovery record (``failover.json``) is appended via
+    :func:`add_artifact` once failover lands — recovery happens AFTER
+    the death, so the bundle is sealed first. NEVER raises; returns
+    the bundle path or None (disarmed / write failure)."""
+    try:
+        if not armed(config):
+            return None
+        root = bundle_dir(config)
+        os.makedirs(root, exist_ok=True)
+        safe = str(dead_name).replace(":", "_").replace("/", "_")
+        name = f"bundle_fleet_death_{safe}"
+        path = os.path.join(root, name)
+        n = 2
+        while os.path.exists(path):   # the same replica can die twice
+            path = os.path.join(root, f"{name}_{n}")
+            n += 1
+        tmp = os.path.join(root, f".{os.path.basename(path)}.part")
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+
+        def art(filename: str, producer) -> None:
+            try:
+                body = producer()
+                if body is None:
+                    return
+                with open(os.path.join(tmp, filename), "w") as f:
+                    f.write(body)
+            except Exception:   # noqa: BLE001
+                logger.exception("bundle artifact %s failed", filename)
+
+        art("bundle.json", lambda: json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "kind": "fleet_death",
+            "replica": dead_name,
+            "outcome": "replica_death",
+            "created_wall": time.time(),
+            "pid": os.getpid(),
+        }, indent=2, default=str))
+        art("routing_timeline.jsonl", lambda: timeline or None)
+        art("replica_health.json",
+            lambda: (json.dumps(dead_health, indent=2, default=str)
+                     if dead_health else None))
+        art("replica_queries.json",
+            lambda: (json.dumps(dead_queries, indent=2, default=str)
+                     if dead_queries else None))
+        art("router_stats.json",
+            lambda: json.dumps(router_stats, indent=2, default=str))
+        os.replace(tmp, path)
+        _evict(root, config)
+        try:
+            from auron_tpu.obs import registry
+            if registry.enabled():
+                registry.get_registry().counter(
+                    "auron_bundles_written_total",
+                    outcome="replica_death").inc()
+        except Exception:   # pragma: no cover - telemetry best-effort
+            pass
+        logger.warning("fleet death bundle written: %s (replica %s)",
+                       path, dead_name)
+        return path
+    except Exception:   # noqa: BLE001 — diagnostics must not shadow
+        logger.exception("fleet death bundle write failed")
+        return None
+
+
+def add_artifact(path: str, filename: str, body: str) -> bool:
+    """Append one artifact to an ALREADY-sealed bundle (the router's
+    ``failover.json``: the survivor's recovery record lands after the
+    death bundle was written). Best-effort, never raises."""
+    try:
+        if not path or not os.path.isdir(path):
+            return False
+        with open(os.path.join(path, filename), "w") as f:
+            f.write(body)
+        return True
+    except Exception:   # noqa: BLE001
+        logger.exception("bundle add_artifact %s failed", filename)
+        return False
+
+
 # -- artifact producers (each individually guarded by art()) ----------------
+
+def _ledger_json(token) -> Optional[str]:
+    """The failing query's cost ledger (serving stashes it on the
+    cancel token at finalize — ``outcome=failed`` partial costs are
+    exactly what a post-mortem wants)."""
+    led = getattr(token, "cost_ledger", None)
+    if not isinstance(led, dict):
+        return None
+    return json.dumps(led, indent=2, default=str)
+
 
 def _flight_dump() -> str:
     from auron_tpu.obs import flight_recorder

@@ -40,11 +40,28 @@ recorder never retraces a kernel.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import weakref
 from collections import deque
 from typing import NamedTuple, Optional
+
+#: which fleet role this process plays ("client" default; the serving
+#: CLI sets "replica", the router thread adopts "router") — stamped on
+#: flight snapshots and trace exports so records merged across process
+#: boundaries stay attributable. Lives here (not obs/trace.py) because
+#: the trace plane imports this module at its top, never the reverse.
+_ROLE = "client"
+
+
+def set_role(role: str) -> None:
+    global _ROLE
+    _ROLE = str(role)
+
+
+def get_role() -> str:
+    return _ROLE
 
 
 class _Settings(NamedTuple):
@@ -171,8 +188,10 @@ class FlightRecorder:
         if last is not None and last > 0:
             raw = raw[-last:]
         wall0 = self.epoch_wall
+        role, pid = get_role(), os.getpid()
         return [{"ts_us": r[0] / 1000.0,
                  "wall": round(wall0 + r[0] * 1e-9, 6),
+                 "role": role, "pid": pid,
                  "cat": r[1], "name": r[2], "query": r[3],
                  "dur_us": r[4] / 1000.0, "tid": r[5],
                  "attrs": r[6]} for r in raw]

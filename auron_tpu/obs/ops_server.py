@@ -222,11 +222,18 @@ class _OpsHandler(BaseHTTPRequestHandler):
 class OpsServer:
     """One process's ops endpoint (ThreadingHTTPServer on a daemon
     thread). ``address`` is the BOUND (host, port) — the ephemeral-port
-    discovery surface."""
+    discovery surface.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self._httpd = ThreadingHTTPServer((host, port), _OpsHandler)
+    ``handler_cls`` swaps the route table (the fleet router serves its
+    federated views through the same plumbing); ``context`` is exposed
+    to handlers as ``self.server.context``."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 handler_cls=None, context=None):
+        self._httpd = ThreadingHTTPServer((host, port),
+                                          handler_cls or _OpsHandler)
         self._httpd.daemon_threads = True
+        self._httpd.context = context
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -285,6 +285,14 @@ _HELP = {
         "Fleet failovers per replica and action (resume|reexecute).",
     "auron_fleet_failover_seconds":
         "Fleet failover latency: replica-death detect to recovery done.",
+    "auron_fleet_replica_deaths_total":
+        "Liveness-confirmed replica deaths recorded by the router.",
+    "auron_fleet_guard_shared_total":
+        "Failover re-executions answered from the single-flight guard.",
+    "auron_fleet_errors_forwarded_total":
+        "Replica ERROR frames the router forwarded to clients.",
+    "auron_fleet_replica_up":
+        "Replica reachability as seen by the router (1 up, 0 down).",
 }
 
 
@@ -588,6 +596,56 @@ def _parse_labels(body: str) -> dict:
                 raise ValueError(f"expected ',' at {inner[pos:]!r}")
             pos += 1
     return out
+
+
+def render_federated(local_text: str, replica_texts: list) -> str:
+    """Fleet-scope /metrics: merge this process's exposition with each
+    replica's scraped exposition, every replica sample re-labeled
+    ``replica="rN"`` — the router's one-scrape-path contract.
+
+    Both inputs and the output go through :func:`parse_prometheus`
+    strictness: the local text is parsed STRICTLY (we rendered it — a
+    violation is a bug), while an unparseable replica text (a replica
+    dying mid-scrape, a version skew) drops THAT replica's samples
+    rather than failing the whole federation. ``replica_texts`` is
+    ``[(label, exposition_text), ...]``.
+
+    The merged text is conformant by construction: one HELP/TYPE per
+    family before its first sample, and every histogram series is
+    distinguished by the ``replica`` label, so each keeps its own
+    +Inf==_count invariant.
+    """
+    fams: dict[str, dict] = {}
+
+    def fold(parsed: dict, label) -> None:
+        for fam, info in parsed.items():
+            ent = fams.get(fam)
+            if ent is None:
+                ent = fams[fam] = {"type": info["type"],
+                                   "help": info["help"] or "",
+                                   "samples": []}
+            elif ent["type"] != info["type"]:
+                continue   # version-skewed family: first writer owns it
+            for name, labels, value in info["samples"]:
+                if label is not None:
+                    labels = dict(labels, replica=label)
+                ent["samples"].append((name, labels, value))
+
+    fold(parse_prometheus(local_text), None)
+    for label, text in replica_texts:
+        try:
+            fold(parse_prometheus(text), label)
+        except ValueError:
+            continue
+    lines = []
+    for fam in sorted(fams):
+        ent = fams[fam]
+        lines.append(f"# HELP {fam} {ent['help'] or _help_text(fam)}")
+        lines.append(f"# TYPE {fam} {ent['type']}")
+        for name, labels, value in ent["samples"]:
+            lines.append(
+                f"{name}{_fmt_labels(_label_key(labels))} {value:g}")
+    return "\n".join(lines) + "\n"
 
 
 def parse_prometheus(text: str) -> dict:

@@ -43,6 +43,7 @@ import time
 from typing import NamedTuple, Optional
 
 from auron_tpu.obs import flight_recorder as _flight
+from auron_tpu.obs.flight_recorder import get_role, set_role  # noqa: F401
 
 #: span categories (the auron.trace.events allowlist vocabulary).
 #: The ``mesh`` category carries the SPMD plane's routing AND fault
@@ -56,8 +57,16 @@ from auron_tpu.obs import flight_recorder as _flight
 #: (auron_tpu/cache): ``cache.hit`` / ``cache.miss`` / ``cache.store``
 #: / ``cache.evict`` on the result/subplan cache and ``aot.warm``
 #: spans around each ahead-of-time plan warming at Session init.
+#: The ``fleet`` category is the cross-process serving plane:
+#: ``fleet.submit`` (client-side conversation span), ``fleet.adopt``
+#: (a process adopting an inbound wire trace context — carries
+#: remote_parent/remote_role/remote_pid, the stitch tool's cross-
+#: process link), ``fleet.route`` (router routing decision) and
+#: ``fleet.forward`` (router hop span around one replica
+#: conversation; failover shows as a second hop to the survivor).
 CATEGORIES = ("query", "task", "program", "shuffle", "spill", "fault",
-              "watchdog", "memory", "sched", "mesh", "journal", "cache")
+              "watchdog", "memory", "sched", "mesh", "journal", "cache",
+              "fleet")
 
 _SPAN_IDS = itertools.count(1)     # next() is GIL-atomic
 _TRACE_IDS = itertools.count(1)
@@ -68,6 +77,7 @@ class _Settings(NamedTuple):
     dir: str
     events: Optional[frozenset]    # None = every category
     max_spans: int
+    propagate: bool
 
 
 #: (config epoch, settings) — the disabled check must cost one int
@@ -92,6 +102,7 @@ def _settings() -> _Settings:
         dir=conf.get(cfg.TRACE_DIR),
         events=cats or None,
         max_spans=conf.get(cfg.TRACE_MAX_SPANS),
+        propagate=conf.get(cfg.TRACE_PROPAGATE),
     )
     _CACHED = (epoch, st)
     return st
@@ -173,6 +184,16 @@ class Tracer:
         return time.perf_counter_ns() - self._t0
 
     def record(self, span: Span, max_spans: int) -> None:
+        sink = getattr(self._tls, "sink", None)
+        if sink is not None:
+            # adopted wire trace (wire_scope): stream the span straight
+            # to its per-role file instead of buffering — the dead
+            # replica's partial spans survive a SIGKILL, replica memory
+            # stays flat without drop(), and a router thread sharing
+            # the client's process never double-exports into the
+            # client's buffered trace
+            sink.write(span)
+            return
         if self._count >= max_spans:
             self.dropped += 1
             return
@@ -469,6 +490,157 @@ def query_scope(label: str = "") -> _QueryScope:
 
 
 # ---------------------------------------------------------------------------
+# cross-process propagation (the serving wire protocol's TRACE frame)
+# ---------------------------------------------------------------------------
+
+def _span_line(s: Span, role: Optional[str] = None,
+               pid: Optional[int] = None) -> dict:
+    """One exported JSONL record: the span dict plus the cross-process
+    alignment keys (role, pid, epoch wall-clock) the stitch tool needs
+    — monotonic-only timestamps cannot be ordered across processes."""
+    d = s.to_dict()
+    d["role"] = role if role is not None else get_role()
+    d["pid"] = pid if pid is not None else os.getpid()
+    d["wall"] = round(_TRACER.epoch_wall + s.ts_ns * 1e-9, 6)
+    return d
+
+
+class _SpanSink:
+    """Streaming per-role JSONL sink for one adopted wire trace
+    (thread-local, installed by :class:`_WireScope`): every span the
+    thread records is appended and flushed immediately, best-effort —
+    a SIGKILLed replica leaves its partial spans on disk."""
+
+    __slots__ = ("role", "pid", "_f")
+
+    def __init__(self, path: str, role: str):
+        self.role = role
+        self.pid = os.getpid()
+        self._f = open(path, "a")
+
+    def write(self, s: Span) -> None:
+        try:
+            self._f.write(
+                json.dumps(_span_line(s, self.role, self.pid),
+                           default=str) + "\n")
+            self._f.flush()
+        except Exception:   # pragma: no cover - best-effort sink
+            pass
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:   # pragma: no cover
+            pass
+
+
+def wire_context() -> Optional[dict]:
+    """The current thread's trace context for the wire (the TRACE
+    frame payload): trace id, parent span id (current stack top) and
+    the sender's role/pid. ``None`` when propagation or tracing is off
+    or no trace is active — callers send no frame in that case, so the
+    disabled wire is byte-identical to before."""
+    st = _settings()
+    if not st.enabled or not st.propagate:
+        return None
+    tr = _TRACER
+    t = tr.current_trace
+    if not t:
+        return None
+    stack = tr._stack()
+    # inside an adopted wire scope the thread speaks AS that role (an
+    # in-process router forwarding from a client process must stamp
+    # role=router, or the stitcher resolves the parent span against
+    # the wrong process group)
+    role = getattr(tr._tls, "wire_role", None) or get_role()
+    return {"trace": t, "parent": stack[-1] if stack else 0,
+            "role": role, "pid": os.getpid()}
+
+
+class _WireScope:
+    """Adopt an inbound wire trace context on this thread: take the
+    remote trace id, pretend an outer query scope is open (so a nested
+    ``query_scope`` JOINS the trace instead of minting a new id and
+    exporting it), open a ``fleet.adopt`` span carrying the remote
+    parent/role/pid (span ids are per-process counters, so the
+    cross-process parent link must travel as attributes — the stitch
+    tool resolves it), and, when ``auron.trace.dir`` is set, stream
+    this thread's spans straight to ``trace_<id>_<role><pid>.jsonl``."""
+
+    __slots__ = ("trace_id", "_ctx", "_role", "_span", "_saved",
+                 "_sink", "_entered")
+
+    def __init__(self, ctx: Optional[dict], role: Optional[str]):
+        self._ctx = ctx if isinstance(ctx, dict) else None
+        self._role = role
+        self.trace_id = 0
+        self._span = _NOOP
+        self._sink = None
+        self._entered = False
+
+    def __enter__(self):
+        st = _settings()
+        try:
+            trace_id = int((self._ctx or {}).get("trace") or 0)
+        except (TypeError, ValueError):
+            trace_id = 0
+        if not st.enabled or not st.propagate or trace_id <= 0:
+            return self
+        tr = _TRACER
+        tls = tr._tls
+        self._entered = True
+        self.trace_id = trace_id
+        self._saved = (tr.current_trace,
+                       getattr(tls, "query_depth", 0),
+                       getattr(tls, "sink", None),
+                       getattr(tls, "wire_role", None))
+        tr.set_trace(trace_id)
+        tls.query_depth = self._saved[1] + 1
+        role = self._role or get_role()
+        tls.wire_role = role
+        if st.dir:
+            try:
+                os.makedirs(st.dir, exist_ok=True)
+                path = os.path.join(
+                    st.dir,
+                    f"trace_{trace_id:08d}_{role}{os.getpid()}.jsonl")
+                tls.sink = _SpanSink(path, role)
+                self._sink = tls.sink
+            except Exception:   # unwritable dir: record to the buffer
+                tls.sink = self._saved[2]
+        ctx = self._ctx or {}
+        self._span = span("fleet", "fleet.adopt", role=role,
+                          remote_parent=ctx.get("parent") or 0,
+                          remote_role=ctx.get("role") or "",
+                          remote_pid=ctx.get("pid") or 0)
+        self._span.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if not self._entered:
+            return False
+        # close the adopt span BEFORE restoring the sink: its record
+        # must land in the adopted trace's file, not the local buffer
+        self._span.__exit__(exc_type, exc, tb)
+        tr = _TRACER
+        tls = tr._tls
+        tr.set_trace(self._saved[0])
+        tls.query_depth = self._saved[1]
+        tls.sink = self._saved[2]
+        tls.wire_role = self._saved[3]
+        if self._sink is not None:
+            self._sink.close()
+        return False
+
+
+def wire_scope(ctx: Optional[dict], role: Optional[str] = None) -> _WireScope:
+    """Adopt ``ctx`` (a :func:`wire_context` dict off the wire) for the
+    duration of the scope. A ``None``/invalid context, tracing off, or
+    propagation off all degrade to a no-op scope."""
+    return _WireScope(ctx, role)
+
+
+# ---------------------------------------------------------------------------
 # exporters
 # ---------------------------------------------------------------------------
 
@@ -512,19 +684,35 @@ def export_jsonl(path: str, trace_id: Optional[int] = None,
     tmp = path + ".part"
     with open(tmp, "w") as f:
         for s in spans:
-            f.write(json.dumps(s.to_dict()) + "\n")
+            f.write(json.dumps(_span_line(s), default=str) + "\n")
     os.replace(tmp, path)
     return len(spans)
 
 
 def read_jsonl(path: str) -> list[Span]:
-    """Load a JSONL event log back into Span records (trace_report)."""
+    """Load a JSONL event log back into Span records (trace_report).
+    Malformed lines are skipped — a SIGKILLed process's streamed sink
+    file may end mid-write, and the intact prefix is the evidence."""
+    return [Span.from_dict(d) for d in read_jsonl_raw(path)]
+
+
+def read_jsonl_raw(path: str) -> list[dict]:
+    """The JSONL event log as raw dicts, keeping the cross-process keys
+    (role/pid/wall) that :class:`Span` does not model — the stitch
+    renderer's loader. Skips malformed/truncated lines like
+    :func:`read_jsonl`."""
     out = []
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
-                out.append(Span.from_dict(json.loads(line)))
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(d, dict) and "span" in d:
+                out.append(d)
     return out
 
 

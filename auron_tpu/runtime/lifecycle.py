@@ -46,7 +46,7 @@ class CancelToken:
 
     __slots__ = ("query_id", "_event", "_lock", "_deadline", "reason",
                  "cancelled_at_ns", "slot", "journal", "tasks_total",
-                 "tasks_done", "plan_tree", "served_from")
+                 "tasks_done", "plan_tree", "served_from", "cost_ledger")
 
     def __init__(self, query_id: str = "", deadline_s: Optional[float] = None):
         self.query_id = query_id
@@ -77,6 +77,9 @@ class CancelToken:
         #: result cache (auron_tpu/cache) instead of executing — the
         #: served_from label on auron_query_duration_seconds
         self.served_from: Optional[str] = None
+        #: the query's per-query cost ledger (obs/ledger.build) stamped
+        #: at finalize — rides the DONE frame and the failure bundle
+        self.cost_ledger: Optional[dict] = None
         #: first-wins cancel reason: "cancelled" | "deadline"
         self.reason: Optional[str] = None
         #: monotonic ns of the winning cancel (the latency-histogram t0)

@@ -51,6 +51,21 @@ Wire format (all integers little-endian):
                                           journaled ("task") one replays
                                           exactly its own partition_id
 
+            14 TRACE       client→server  JSON {trace, parent, role,
+                                          pid} — OPTIONAL prefix frame
+                                          ahead of SUBMIT/SUBMIT_PLAN/
+                                          RESUME carrying the sender's
+                                          trace context
+                                          (obs/trace.wire_context); the
+                                          receiver adopts it so spans
+                                          on both sides share one
+                                          trace id. Sent only when
+                                          auron.trace.{enabled,
+                                          propagate} are on AND a trace
+                                          is active — the wire is
+                                          byte-identical otherwise, and
+                                          a receiver with tracing off
+                                          just skips the frame
             13 HELLO       client→server  empty payload — replica
                                           registration handshake: one
                                           DONE frame with JSON {pid,
@@ -135,6 +150,14 @@ KIND_STATS = 12
 #: answers one DONE frame with this process's identity (pid + liveness
 #: tag), serving address, ops port, and journal dir
 KIND_HELLO = 13
+#: OPTIONAL trace-context prefix frame ahead of SUBMIT/SUBMIT_PLAN/
+#: RESUME (fleet-scope observability): JSON {trace, parent, role, pid}
+#: from obs/trace.wire_context — the receiver adopts the trace id as
+#: its query-span parent (obs/trace.wire_scope), so client, router and
+#: replica exports stitch into ONE timeline. Never sent unless
+#: auron.trace.enabled + auron.trace.propagate are on and a trace is
+#: active.
+KIND_TRACE = 14
 
 #: max un-ACKed BATCH frames in flight (rt.rs uses a bound-1 channel; a
 #: small window amortizes the network round trip without losing the
@@ -240,6 +263,19 @@ class _TaskHandler(socketserver.BaseRequestHandler):
     def handle(self):
         try:
             kind, payload = read_frame(self.request)
+            self._wire_ctx = None
+            if kind == KIND_TRACE:
+                # optional trace-context prefix (fleet observability):
+                # adopt it around the REAL first frame that follows; a
+                # malformed payload degrades to no adoption, never an
+                # error — telemetry must not fail a query
+                try:
+                    ctx = json.loads(payload.decode() or "{}")
+                    if isinstance(ctx, dict):
+                        self._wire_ctx = ctx
+                except (ValueError, UnicodeDecodeError):
+                    pass
+                kind, payload = read_frame(self.request)
         except ConnectionError:
             return
         if kind == KIND_SHUTDOWN:
@@ -272,14 +308,19 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                                         daemon=True)
         self._reader.start()
         from auron_tpu import errors as _errors
+        from auron_tpu.obs import trace as _trace
         self.server.register_query(self._cancel)
         try:
-            if kind == KIND_SUBMIT:
-                self._run_task(payload)
-            elif kind == KIND_RESUME:
-                self._run_resume(payload)
-            else:
-                self._run_plan_task(payload)
+            # adopt the inbound wire trace context (no-op without one):
+            # every span this handler thread records — the query scope,
+            # task/operator spans — joins the SENDER's trace id
+            with _trace.wire_scope(self._wire_ctx):
+                if kind == KIND_SUBMIT:
+                    self._run_task(payload)
+                elif kind == KIND_RESUME:
+                    self._run_resume(payload)
+                else:
+                    self._run_plan_task(payload)
         except _Cancelled:
             self.server.stats["cancelled"] += 1
         except _errors.JournalError as e:
@@ -411,6 +452,11 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             from auron_tpu.cache import result_cache as _rcache
             body["cache"] = _rcache.get_cache().stats()
             body["aot"] = _aot.last_stats()
+        except Exception:   # graft: disable=GL004 -- stats tee is best-effort
+            pass
+        try:
+            from auron_tpu.obs import ledger as _ledger
+            body["cost_ledgers"] = _ledger.recent(16)
         except Exception:   # graft: disable=GL004 -- stats tee is best-effort
             pass
         ops = _ops.current()
@@ -674,8 +720,33 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             raise _Cancelled()
         self._cancel.slot = slot
         prev_bind = lifecycle.bind_token(self._cancel)
+        import time as _time
+
+        from auron_tpu.obs import ledger as _ledger
+        ledger_on = _ledger.enabled()
+        t_led = _time.monotonic()
+        snaps: list = []
+        rows_sent = batches_sent = 0
         jr = journal
         cache_key = None
+
+        def _finish_ledger(outcome: str) -> dict:
+            # the per-query accounting record (obs/ledger.py): stashed
+            # on the token (the bundle writer reads it), retained in
+            # the process ring (STATS frame / AuronClient.stats), and
+            # — on success — ridden on the DONE frame
+            led = _ledger.build(
+                snaps, query_id=self._cancel.query_id, rows=rows_sent,
+                batches=batches_sent, partitions=len(snaps),
+                wall_s=_time.monotonic() - t_led,
+                cache_hit=getattr(self._cancel, "served_from",
+                                  None) == "cache",
+                served_from=getattr(self._cancel, "served_from",
+                                    None) or "",
+                outcome=outcome)
+            self._cancel.cost_ledger = led
+            _ledger.record(led)
+            return led
         try:
             task = pb.TaskDefinition()
             task.ParseFromString(task_bytes)
@@ -697,6 +768,8 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                     for rb in hit.to_batches():
                         if rb.num_rows:
                             self._send_batch(rb)
+                            rows_sent += rb.num_rows
+                            batches_sent += 1
                     self._cancel.tasks_done = 1
                     # the flag rides the first RESPONSE frame the
                     # protocol can carry it in: BATCH frames are raw
@@ -707,6 +780,8 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                             "schema_ipc": _schema_ipc_b64(hit.schema)}
                     if report is not None:
                         done["report"] = report
+                    if ledger_on:
+                        done["cost_ledger"] = _finish_ledger("ok")
                     write_frame(self.request, KIND_DONE,
                                 json.dumps(done, default=str).encode())
                     return
@@ -733,7 +808,6 @@ class _TaskHandler(socketserver.BaseRequestHandler):
             # ownership question like the Session collect path)
             self._cancel.tasks_total = len(parts)
             self._cancel.tasks_done = 0
-            snaps = []
             cached_batches = [] if cache_key is not None else None
             # the handler's cancel TOKEN is the task's cancellation
             # registry: operators polling between child batches unwind
@@ -751,6 +825,8 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                         rb = to_arrow(batch, op.schema())
                         if rb.num_rows:
                             self._send_batch(rb)
+                            rows_sent += rb.num_rows
+                            batches_sent += 1
                             if cached_batches is not None:
                                 cached_batches.append(rb)
                     snaps.append(rt.finalize())
@@ -769,6 +845,13 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                        else {"num_partitions": len(snaps),
                              "per_partition": snaps})
         except BaseException:
+            if ledger_on:
+                try:
+                    # partial ledger: whatever the finished partitions
+                    # cost rides the token into the failure bundle
+                    _finish_ledger("failed")
+                except Exception:   # graft: disable=GL004 -- ledger assembly must never shadow the real failure
+                    pass
             if jr is not None:
                 # a failed/cancelled/died-mid-stream serving task keeps
                 # its journal: the RESUME frame's inventory
@@ -794,6 +877,8 @@ class _TaskHandler(socketserver.BaseRequestHandler):
                 "schema_ipc": _schema_ipc_b64(schema_to_arrow(op.schema()))}
         if report is not None:
             done["report"] = report
+        if ledger_on:
+            done["cost_ledger"] = _finish_ledger("ok")
         write_frame(self.request, KIND_DONE,
                     json.dumps(done, default=str).encode())
 
@@ -958,7 +1043,19 @@ class AuronClient:
         Empty results return a typed empty table (schema rides DONE).
         Raises RuntimeError with the remote traceback on engine errors."""
         tbl, done = self._drive(KIND_SUBMIT, task_bytes, None)
-        return tbl, done.get("metrics", done)
+        return tbl, self._metrics_from_done(done)
+
+    @staticmethod
+    def _metrics_from_done(done: dict) -> dict:
+        """The metrics view of a DONE body. The per-query cost ledger
+        rides DONE at top level (next to metrics — the router augments
+        it there without touching engine metrics); surface it in the
+        returned dict so callers see one flat observability record."""
+        metrics = done.get("metrics", done)
+        if "cost_ledger" in done and isinstance(metrics, dict) \
+                and metrics is not done:
+            metrics = dict(metrics, cost_ledger=done["cost_ledger"])
+        return metrics
 
     def execute_plan(self, plan, path_rewrites=None, partition_id: int = 0,
                      num_partitions: int = 1, spark_version: str = "3.5.0",
@@ -1017,9 +1114,36 @@ class AuronClient:
                                fallback_provider)
 
     def _drive(self, kind: int, payload: bytes, fallback_provider):
+        import contextlib
+
+        from auron_tpu.obs import trace as _trace
+        scopes = contextlib.ExitStack()
+        wire_ctx = None
+        if (kind in (KIND_SUBMIT, KIND_SUBMIT_PLAN, KIND_RESUME)
+                and _trace.enabled()):
+            # standalone client use (no enclosing Session scope): the
+            # conversation becomes its own exported trace; inside a
+            # scope it joins the active trace. The fleet.submit span is
+            # the parent the remote side's spans hang under.
+            if _trace.tracer().current_trace == 0:
+                scopes.enter_context(_trace.query_scope("client.drive"))
+            scopes.enter_context(_trace.span(
+                "fleet", "fleet.submit", kind=kind,
+                server=f"{self.addr[0]}:{self.addr[1]}"))
+            wire_ctx = _trace.wire_context()
         batches, done = [], None
+        with scopes:
+            return self._drive_framed(kind, payload, fallback_provider,
+                                      wire_ctx, batches)
+
+    def _drive_framed(self, kind, payload, fallback_provider, wire_ctx,
+                      batches):
+        done = None
         try:
             with self._connect() as s:
+                if wire_ctx is not None:
+                    write_frame(s, KIND_TRACE,
+                                json.dumps(wire_ctx).encode())
                 write_frame(s, kind, payload)
                 while True:
                     fkind, fpayload = read_frame(s)
@@ -1071,7 +1195,7 @@ class AuronClient:
         tbl, done = self._drive(
             KIND_RESUME, json.dumps({"query_id": query_id}).encode(),
             None)
-        return tbl, done.get("metrics", done)
+        return tbl, self._metrics_from_done(done)
 
     def hello(self) -> dict:
         """Replica registration handshake (HELLO frame): the server's
@@ -1154,6 +1278,10 @@ def serve_main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--window", type=int, default=DEFAULT_WINDOW)
     args = ap.parse_args(argv)
+    # this process IS a replica: stamp every flight/trace export it
+    # writes so stitched fleet telemetry stays attributable
+    from auron_tpu.obs import flight_recorder as _flight
+    _flight.set_role("replica")
     srv = AuronServer(args.host, args.port, window=args.window)
     print(f"AURON_SERVING {srv.address[0]}:{srv.address[1]}", flush=True)
     try:

@@ -1,0 +1,389 @@
+"""Fleet-scope observability units (ISSUE 20):
+
+- obs/ledger: per-query cost-ledger assembly from finalize snapshots,
+  router fleet augmentation, fleet-scale folding, the bounded ring;
+- obs/trace wire propagation: wire_context gating, wire_scope adoption
+  (role override, nesting, invalid-context no-op);
+- obs/registry.render_federated: replica re-labeling, strict-local /
+  tolerant-replica parsing, type-conflict handling, round-trip through
+  parse_prometheus;
+- tools/trace_report stitching: cross-process grouping, adopt-link
+  resolution, trace selection, tolerant JSONL reading;
+- fleet/router._augment_done: DONE-payload ledger stamping without a
+  live fleet;
+- obs/bundle fleet-death bundles: artifact set, post-seal add_artifact,
+  unarmed no-op.
+
+Everything here is in-process and socket-free; the cross-process
+acceptance (3 replicas, SIGKILL mid-burst, ONE stitched trace) lives in
+tests/test_zz_fleet_obs.py.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from auron_tpu import config as cfg
+from auron_tpu.obs import bundle
+from auron_tpu.obs import ledger
+from auron_tpu.obs import registry as obs_registry
+from auron_tpu.obs import trace
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import trace_report  # noqa: E402  (tools/ is not a package)
+
+
+class TestCostLedger:
+    def _snaps(self):
+        ns = 1_000_000_000
+        return [
+            {"xla_compiles": 2, "xla_compile_seconds": 0.5,
+             "program_builds": 3, "program_hits": 7,
+             "recovery": {"attempts": 4, "transient_retries": 1},
+             "agg": {"elapsed_compute": 2 * ns,
+                     "elapsed_host_dispatch": 1 * ns,
+                     "elapsed_host_serde": ns // 2,
+                     "shuffle_bytes_live": 1024,
+                     "mem_spill_size": 333, "mem_spill_count": 1},
+             "shuffle_exchange": {"shuffle_write_total_time": ns,
+                                  "shuffle_read_total_time": ns // 4,
+                                  "combine_rows_in": 1000,
+                                  "combine_rows_out": 10}},
+            {"parquet_scan": {"elapsed_compute": ns,
+                              "elapsed_host_convert": 3 * ns,
+                              "journal_bytes_reused": 77}},
+        ]
+
+    def test_build_folds_snapshots(self):
+        led = ledger.build(self._snaps(), query_id="q-1", rows=500,
+                           batches=2, partitions=2, wall_s=1.25,
+                           outcome="ok")
+        assert led["version"] == ledger.LEDGER_VERSION
+        assert led["query_id"] == "q-1" and led["outcome"] == "ok"
+        assert led["device_s"] == 3.0
+        assert led["host_s"]["dispatch"] == 1.0
+        assert led["host_s"]["convert"] == 3.0
+        assert led["host_s"]["serde"] == 0.5
+        assert led["host_total_s"] == 4.5
+        assert led["shuffle"]["bytes"] == 1024
+        assert led["shuffle"]["write_s"] == 1.0
+        assert led["shuffle"]["combine_rows_in"] == 1000
+        assert led["spill"] == {"count": 1, "bytes": 333}
+        assert led["journal_bytes_reused"] == 77
+        assert led["compile"]["xla_compiles"] == 2
+        assert led["compile"]["program_hits"] == 7
+        assert led["retries"]["attempts"] == 4
+        assert led["rows"] == 500 and led["partitions"] == 2
+        # the router's slots exist zeroed before augmentation
+        assert led["fleet"] == {"hops": 0, "spillovers": 0,
+                                "failover": "", "replica": ""}
+        # the ledger is DONE-frame JSON by contract
+        assert json.loads(json.dumps(led)) == led
+
+    def test_build_tolerates_garbage(self):
+        """Snapshots are observability output — a missing counter, a
+        non-dict snapshot, or no snapshots at all must still produce a
+        valid zeroed ledger, never raise."""
+        for snaps in (None, [], [None, 42, "x"],
+                      [{"agg": {"elapsed_compute": "NaNsense"}}]):
+            led = ledger.build(snaps, query_id="q")
+            assert led["device_s"] == 0.0
+            assert led["host_total_s"] == 0.0
+
+    def test_augment_fleet(self):
+        led = ledger.build([], query_id="q")
+        out = ledger.augment_fleet(led, hops=2, spillovers=1,
+                                   failover="resume", replica="r:1")
+        assert out["fleet"] == {"hops": 2, "spillovers": 1,
+                                "failover": "resume", "replica": "r:1"}
+        # partial augmentation leaves the other slots alone
+        ledger.augment_fleet(out, replica="r:2")
+        assert out["fleet"]["hops"] == 2
+        assert out["fleet"]["replica"] == "r:2"
+        # non-dict / foreign payloads pass through unchanged
+        assert ledger.augment_fleet(None, hops=1) is None
+        foreign = {"fleet": "not-a-dict"}
+        assert ledger.augment_fleet(foreign, hops=1) is foreign
+
+    def test_fold(self):
+        a = ledger.build(self._snaps(), rows=100, cache_hit=True)
+        b = ledger.build(self._snaps(), rows=50)
+        ledger.augment_fleet(b, hops=2, failover="reexecute")
+        tot = ledger.fold([a, b, None, "junk"])
+        assert tot["queries"] == 2
+        assert tot["rows"] == 150
+        assert tot["device_s"] == 6.0
+        assert tot["host_s"]["convert"] == 6.0
+        assert tot["shuffle_bytes"] == 2048
+        assert tot["cache_hits"] == 1
+        assert tot["retries"] == 2
+        assert tot["failovers"] == 1
+        assert tot["replica_hops"] == 2
+        # empty fold is all-zero, not an error
+        assert ledger.fold(())["queries"] == 0
+
+    def test_ring_retention(self):
+        ledger.reset()
+        try:
+            for i in range(70):
+                ledger.record({"query_id": f"q-{i}"})
+            items = ledger.recent()
+            assert len(items) == 64   # bounded ring
+            assert items[-1]["query_id"] == "q-69"
+            assert [d["query_id"] for d in ledger.recent(2)] \
+                == ["q-68", "q-69"]
+            ledger.record("not-a-dict")   # ignored
+            assert len(ledger.recent()) == 64
+        finally:
+            ledger.reset()
+
+    def test_enabled_knob(self):
+        conf = cfg.get_config()
+        assert ledger.enabled() is True   # on by default
+        conf.set(cfg.LEDGER_ENABLED, False)
+        try:
+            assert ledger.enabled() is False
+        finally:
+            conf.unset(cfg.LEDGER_ENABLED)
+
+
+class TestWirePropagation:
+    @pytest.fixture()
+    def traced(self):
+        conf = cfg.get_config()
+        conf.set(cfg.TRACE_ENABLED, True)
+        try:
+            yield conf
+        finally:
+            conf.unset(cfg.TRACE_ENABLED)
+            conf.unset(cfg.TRACE_PROPAGATE)
+
+    def test_wire_context_gating(self, traced):
+        # no active trace → nothing to propagate
+        assert trace.wire_context() is None
+        with trace.query_scope("gate-test"):
+            ctx = trace.wire_context()
+            assert ctx is not None
+            assert ctx["trace"] > 0 and ctx["parent"] > 0
+            assert ctx["role"] == trace.get_role()
+            assert ctx["pid"] == os.getpid()
+            # propagation off → None even with a live trace (the wire
+            # stays byte-identical)
+            traced.set(cfg.TRACE_PROPAGATE, False)
+            assert trace.wire_context() is None
+            traced.set(cfg.TRACE_PROPAGATE, True)
+        assert trace.wire_context() is None   # scope closed
+
+    def test_wire_context_none_when_tracing_off(self):
+        assert trace.wire_context() is None
+
+    def test_wire_scope_adopts_and_overrides_role(self, traced):
+        with trace.query_scope("origin"):
+            ctx = trace.wire_context()
+        with trace.wire_scope(ctx, role="router"):
+            inner = trace.wire_context()
+            assert inner["trace"] == ctx["trace"]
+            # the forwarded context speaks AS the adopted role — the
+            # stitcher resolves the parent span against the router
+            # group, not the process-global role's group
+            assert inner["role"] == "router"
+        # scope restored: no trace leaks onto the thread
+        assert trace.wire_context() is None
+
+    def test_wire_scope_noop_on_invalid_ctx(self, traced):
+        for ctx in (None, {}, {"trace": 0}, {"trace": "garbage"}, 7):
+            with trace.wire_scope(ctx):
+                assert trace.wire_context() is None
+
+    def test_wire_scope_noop_when_disabled(self):
+        with trace.wire_scope({"trace": 5, "parent": 1}):
+            assert trace.wire_context() is None
+
+
+class TestFederatedMetrics:
+    LOCAL = ("# HELP auron_fleet_routed_total r\n"
+             "# TYPE auron_fleet_routed_total counter\n"
+             "auron_fleet_routed_total 3\n")
+    REPLICA = ("# HELP auron_queries_total q\n"
+               "# TYPE auron_queries_total counter\n"
+               'auron_queries_total{outcome="ok"} 5\n')
+
+    def test_relabels_and_round_trips(self):
+        text = obs_registry.render_federated(
+            self.LOCAL, [("r0", self.REPLICA), ("r1", self.REPLICA)])
+        fams = obs_registry.parse_prometheus(text)   # STRICT round-trip
+        assert "auron_fleet_routed_total" in fams
+        samples = fams["auron_queries_total"]["samples"]
+        labels = sorted(s[1]["replica"] for s in samples)
+        assert labels == ["r0", "r1"]
+        assert all(s[1]["outcome"] == "ok" for s in samples)
+        # router-local samples carry NO replica label
+        for s in fams["auron_fleet_routed_total"]["samples"]:
+            assert "replica" not in s[1]
+
+    def test_unparseable_replica_dropped_local_strict(self):
+        text = obs_registry.render_federated(
+            self.LOCAL, [("r0", "!! not prometheus !!"),
+                         ("r1", self.REPLICA)])
+        fams = obs_registry.parse_prometheus(text)
+        samples = fams["auron_queries_total"]["samples"]
+        assert [s[1]["replica"] for s in samples] == ["r1"]
+        # a corrupt LOCAL exposition is a router bug: strict, raises
+        with pytest.raises(ValueError):
+            obs_registry.render_federated("garbage 1 2 3 4\n", [])
+
+    def test_type_conflict_skips_replica_family(self):
+        conflicting = ("# HELP auron_fleet_routed_total r\n"
+                       "# TYPE auron_fleet_routed_total gauge\n"
+                       "auron_fleet_routed_total 9\n")
+        text = obs_registry.render_federated(
+            self.LOCAL, [("r0", conflicting)])
+        fams = obs_registry.parse_prometheus(text)
+        fam = fams["auron_fleet_routed_total"]
+        assert fam["type"] == "counter"   # first writer (local) wins
+        assert len(fam["samples"]) == 1   # conflicting sample dropped
+
+    def test_live_registry_federates(self):
+        """The real process registry's exposition federates with itself
+        — the shape the router serves from /metrics."""
+        local = obs_registry.get_registry().render_prometheus()
+        text = obs_registry.render_federated(local, [("r0", local)])
+        obs_registry.parse_prometheus(text)   # must not raise
+
+
+class TestStitch:
+    def _fleet_records(self):
+        """A synthetic 3-process fleet trace with a failover hop."""
+        def rec(role, pid, span, parent, name, wall, **attrs):
+            return {"trace": 9, "span": span, "parent": parent,
+                    "cat": "fleet", "name": name, "ts_us": 0,
+                    "dur_us": 1000, "tid": 1, "attrs": attrs,
+                    "role": role, "pid": pid, "wall": wall}
+        return [
+            rec("client", 10, 1, 0, "query.execute", 100.0),
+            rec("client", 10, 2, 1, "fleet.submit", 100.001),
+            rec("router", 10, 1, 0, "fleet.adopt", 100.002,
+                remote_parent=2, remote_role="client", remote_pid=10),
+            rec("router", 10, 2, 1, "fleet.forward", 100.003),
+            rec("replica", 20, 1, 0, "fleet.adopt", 100.004,
+                remote_parent=2, remote_role="router", remote_pid=10),
+            rec("replica", 20, 2, 1, "task.attempt", 100.005),
+            # failover: second forward to the survivor
+            rec("router", 10, 3, 1, "fleet.forward", 100.5),
+            rec("replica", 30, 1, 0, "fleet.adopt", 100.501,
+                remote_parent=3, remote_role="router", remote_pid=10),
+        ]
+
+    def test_stitch_groups_and_links(self):
+        st = trace_report.stitch(self._fleet_records())
+        assert st["trace"] == 9
+        assert st["processes"] == 4
+        assert st["spans"] == 8
+        roles = sorted({g["role"] for g in st["groups"]})
+        assert roles == ["client", "replica", "router"]
+        # every adopt resolved: router←client, both replicas←router
+        parents = sorted((ln["parent_group"], ln["child_group"])
+                         for ln in st["links"])
+        assert parents == [(("client", 10), ("router", 10)),
+                           (("router", 10), ("replica", 20)),
+                           (("router", 10), ("replica", 30))]
+        assert st["wall_span_s"] == pytest.approx(0.502, abs=0.01)
+
+    def test_stitch_picks_widest_trace(self):
+        """With no --trace given, the stitcher picks the trace touching
+        the MOST processes (the fleet trace), not the busiest one."""
+        records = self._fleet_records()
+        for i in range(20):   # a single-process trace with more spans
+            records.append({"trace": 2, "span": i + 1, "parent": 0,
+                            "cat": "task", "name": "task.attempt",
+                            "ts_us": 0, "dur_us": 1, "tid": 1,
+                            "attrs": {}, "role": "client", "pid": 10,
+                            "wall": 50.0})
+        st = trace_report.stitch(records)
+        assert st["trace"] == 9
+        st2 = trace_report.stitch(records, trace_id=2)
+        assert st2["trace"] == 2 and st2["processes"] == 1
+
+    def test_read_jsonl_raw_tolerant(self, tmp_path):
+        p = tmp_path / "trace_00000009_replica20.jsonl"
+        good = {"trace": 9, "span": 1, "parent": 0, "cat": "t",
+                "name": "n", "ts_us": 0, "dur_us": 1, "tid": 1,
+                "attrs": {}, "role": "replica", "pid": 20, "wall": 1.0}
+        p.write_text(json.dumps(good) + "\n"
+                     + "\n"                       # blank
+                     + "{truncated by SIGKILL\n"  # torn final line
+                     + json.dumps({"no": "span key"}) + "\n")
+        recs = trace.read_jsonl_raw(str(p))
+        assert recs == [good]
+
+    def test_empty_dir_is_actionable(self, tmp_path):
+        with pytest.raises(SystemExit, match="trace_"):
+            trace_report.load_dir_raw(str(tmp_path))
+
+
+class TestAugmentDone:
+    def _router(self):
+        from auron_tpu.fleet.router import FleetRouter
+        return FleetRouter([("127.0.0.1", 1)])   # never started
+
+    def test_stamps_fleet_facts(self):
+        r = self._router()
+        led = ledger.build([], query_id="q")
+        payload = json.dumps({"metrics": {}, "cost_ledger": led}).encode()
+        out = json.loads(r._augment_done(
+            payload, hops=2, failover="resume", replica="r:9"))
+        assert out["cost_ledger"]["fleet"]["hops"] == 2
+        assert out["cost_ledger"]["fleet"]["failover"] == "resume"
+        assert out["cost_ledger"]["fleet"]["replica"] == "r:9"
+
+    def test_passthrough_without_ledger(self):
+        r = self._router()
+        for payload in (b"not json", b"[1, 2]",
+                        json.dumps({"metrics": {}}).encode()):
+            assert r._augment_done(payload, hops=1) == payload
+
+
+class TestFleetDeathBundle:
+    @pytest.fixture()
+    def armed(self, tmp_path):
+        conf = cfg.get_config()
+        conf.set(cfg.BUNDLE_ENABLED, True)
+        conf.set(cfg.BUNDLE_DIR, str(tmp_path))
+        try:
+            yield str(tmp_path)
+        finally:
+            conf.unset(cfg.BUNDLE_ENABLED)
+            conf.unset(cfg.BUNDLE_DIR)
+
+    def test_write_fleet_death(self, armed):
+        path = bundle.write_fleet_death(
+            "127.0.0.1:9999", {"status": "degraded"},
+            {"queries": [{"id": "q-1", "state": "running"}]},
+            {"router": {"replica_deaths": 1}},
+            '{"name": "fleet.route", "wall": 1.0}\n')
+        assert path and os.path.isdir(path)
+        assert os.path.basename(path).startswith("bundle_fleet_death_")
+        names = sorted(os.listdir(path))
+        assert names == ["bundle.json", "replica_health.json",
+                         "replica_queries.json", "router_stats.json",
+                         "routing_timeline.jsonl"]
+        with open(os.path.join(path, "bundle.json")) as f:
+            mf = json.load(f)
+        assert mf["kind"] == "fleet_death"
+        assert mf["replica"] == "127.0.0.1:9999"
+        assert mf["outcome"] == "replica_death"
+        # failover.json lands AFTER sealing (the survivor finishes the
+        # query later) via add_artifact
+        assert bundle.add_artifact(path, "failover.json",
+                                   '{"survivor": "127.0.0.1:1"}')
+        assert os.path.exists(os.path.join(path, "failover.json"))
+        # a vanished bundle is a no-op False, never a raise
+        assert not bundle.add_artifact(
+            os.path.join(armed, "gone"), "x.json", "{}")
+
+    def test_unarmed_is_noop(self):
+        assert bundle.write_fleet_death("r", {}, {}, {}, "") is None

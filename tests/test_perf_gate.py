@@ -309,6 +309,13 @@ class TestPerfGate:
             < last["combine_shuffle_bytes_off"]
         assert last["combine_byte_reduction"] \
             >= last["combine_byte_reduction_floor"]
+        # the fleet-observability gate (ISSUE 20): trace propagation +
+        # the cost ledger engaged on every on-arm query, disengaged
+        # off-arm, and cost under the overhead limit
+        assert last["obs_fleet_gate"] == "pass"
+        assert last["obs_fleet_ledgers"] == last["obs_fleet_queries"]
+        assert last["obs_fleet_overhead_pct"] \
+            < last["obs_fleet_overhead_pct_max"] == 2.0
 
     def test_ops_gate_scrape_rejects_seeded_regressions(
             self, monkeypatch):
@@ -376,6 +383,11 @@ class TestPerfGate:
                             lambda: {"lint_gate": "pass", "lint_new": 0})
         monkeypatch.setattr(perf_gate, "run_fusion_gate",
                             lambda smoke: {"fusion_gate": "pass"})
+        monkeypatch.setattr(perf_gate, "run_obs_fleet_gate",
+                            lambda smoke: {"obs_fleet_gate": "pass",
+                                           "obs_fleet_overhead_pct": 0.1,
+                                           "obs_fleet_overhead_pct_max":
+                                               2.0})
         rc = perf_gate.main(["--smoke"])
         out = capsys.readouterr().out
         last = json.loads(out.strip().splitlines()[-1])
@@ -404,6 +416,11 @@ class TestPerfGate:
                             lambda: {"lint_gate": "pass", "lint_new": 0})
         monkeypatch.setattr(perf_gate, "run_fusion_gate",
                             lambda smoke: {"fusion_gate": "pass"})
+        monkeypatch.setattr(perf_gate, "run_obs_fleet_gate",
+                            lambda smoke: {"obs_fleet_gate": "pass",
+                                           "obs_fleet_overhead_pct": 0.1,
+                                           "obs_fleet_overhead_pct_max":
+                                               2.0})
         rc = perf_gate.main(["--smoke"])
         out = capsys.readouterr().out
         last = json.loads(out.strip().splitlines()[-1])
@@ -450,6 +467,40 @@ class TestPerfGate:
         assert out["fusion_gate"] == "fail"
         assert "floor" in out["fusion_error"]
         assert out["combine_byte_reduction_floor"] == 0.40
+
+    def test_obs_fleet_gate_rejects_seeded_regressions(self):
+        """The ISSUE 20 satellite: a seeded +10% trace-propagation /
+        cost-ledger overhead must fail the obs-fleet arm, and a vacuous
+        A/B — an on-arm whose ledger never engaged, or an off-arm that
+        still produced ledgers (the knob no longer disengages) — must
+        fail regardless of the measured overhead. Pure verdict
+        mechanics on synthetic walls (obs_fleet_verdict)."""
+        smoke = {"obs_fleet_overhead_pct_max": 2.0}
+        honest = dict(ledgers_on=4, ledgers_off=0, queries=4)
+        v = perf_gate.obs_fleet_verdict(1.0, 1.10, smoke, **honest)
+        assert v["obs_fleet_gate"] == "fail"
+        assert v["obs_fleet_overhead_pct"] == 10.0
+        assert "fleet-observability gate" in v["obs_fleet_error"]
+        # within-noise overhead passes
+        v = perf_gate.obs_fleet_verdict(1.0, 1.01, smoke, **honest)
+        assert v["obs_fleet_gate"] == "pass"
+        assert v["obs_fleet_overhead_pct"] < 2.0
+        # an idle on-arm ledger is a vacuous measurement — fail even
+        # though the walls are identical
+        v = perf_gate.obs_fleet_verdict(1.0, 1.0, smoke, ledgers_on=0,
+                                        ledgers_off=0, queries=4)
+        assert v["obs_fleet_gate"] == "fail"
+        assert "idle ledger" in v["obs_fleet_error"]
+        # an off-arm that still ledgers measured the feature against
+        # itself — fail even at 0% overhead
+        v = perf_gate.obs_fleet_verdict(1.0, 1.0, smoke, ledgers_on=4,
+                                        ledgers_off=3, queries=4)
+        assert v["obs_fleet_gate"] == "fail"
+        assert "no longer disengages" in v["obs_fleet_error"]
+        # a dark wall (measurement never ran) can't gate anything
+        v = perf_gate.obs_fleet_verdict(0.0, 1.0, smoke, **honest)
+        assert v["obs_fleet_gate"] == "fail"
+        assert "went dark" in v["obs_fleet_error"]
 
     def test_unusable_records(self):
         base = _baseline()

@@ -1,0 +1,269 @@
+"""Fleet-scope observability acceptance (ISSUE 20): real subprocess
+replicas behind the in-process router, observed end to end.
+
+The tentpole contract: with tracing + propagation on, a 3-replica fleet
+serving a query whose replica is SIGKILLed mid-flight yields ONE trace
+id across client, router, and replicas — ``tools/trace_report.py
+--stitch`` renders a single timeline in which the failover is visible
+as a second forward hop to the survivor — while the router's own ops
+endpoint keeps serving strictly-parseable federated /metrics and a
+merged /fleet/queries table that shows the dead replica as ``down``,
+the death lands as a ``bundle_fleet_death_*`` directory (failover
+record attached once the survivor finishes), and the client's DONE
+metrics carry a cost ledger stamped with the fleet facts.
+
+Fast, socket-free units live in tests/test_fleet_obs.py.
+"""
+
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from auron_tpu import config as cfg
+from auron_tpu.fleet import FleetHarness
+from auron_tpu.obs import registry as obs_registry
+
+import tools.load_report as lr
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import trace_report  # noqa: E402  (tools/ is not a package)
+
+
+@pytest.fixture(scope="module")
+def workdir():
+    d = tempfile.mkdtemp(prefix="auron_fleet_obs_")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def _kill_busy_replica(h, driver, deadline_s=15.0):
+    """Poll the router's snapshots until a replica shows the in-flight
+    query, then SIGKILL it. Returns the victim index or None."""
+    deadline = time.monotonic() + deadline_s
+    while driver.is_alive() and time.monotonic() < deadline:
+        h.router._poll_once()
+        for i in range(len(h.replicas)):
+            snap = h.router._replicas[i].snapshot
+            if snap is not None and snap.occupancy > 0:
+                if h.replicas[i].alive():
+                    h.kill_replica(i)
+                return i
+        time.sleep(0.05)
+    return None
+
+
+class TestStitchedFailoverTrace:
+    def test_one_trace_across_failover(self, workdir):
+        """The acceptance criterion: mid-query SIGKILL, ONE stitched
+        client→router→replica timeline with the hop to the survivor,
+        fleet facts on the client's cost ledger, and a fleet-death
+        bundle carrying the failover record."""
+        tdir = os.path.join(workdir, "traces")
+        bdir = os.path.join(workdir, "bundles")
+        data = os.path.join(workdir, "data_stitch")
+        os.makedirs(data, exist_ok=True)
+        task = lr._task_bytes(lr._dataset(data, 600_000))
+        conf = cfg.get_config()
+        conf.set(cfg.TRACE_ENABLED, True)
+        conf.set(cfg.TRACE_DIR, tdir)
+        conf.set(cfg.BUNDLE_ENABLED, True)
+        conf.set(cfg.BUNDLE_DIR, bdir)
+        env = {"AURON_CONF_TRACE_ENABLED": "1",
+               "AURON_CONF_TRACE_DIR": tdir}
+        try:
+            with FleetHarness(3, env_extra=env) as h:
+                warm, wm = h.client(timeout_s=120).execute(task)
+                # the ledger rides DONE even without a failover, fleet
+                # facts stamped by the router
+                wled = wm.get("cost_ledger")
+                assert isinstance(wled, dict), wm.keys()
+                assert wled["fleet"]["hops"] >= 1
+                assert wled["fleet"]["replica"]
+                assert wled["rows"] > 0
+
+                box: dict = {}
+
+                def drive() -> None:
+                    try:
+                        tbl, m = h.client(timeout_s=120).execute(task)
+                        box["table"], box["metrics"] = tbl, m
+                    except BaseException as e:   # noqa: BLE001
+                        box["err"] = e
+
+                t = threading.Thread(target=drive, daemon=True)
+                t.start()
+                victim = _kill_busy_replica(h, t)
+                t.join(timeout=120)
+                assert not t.is_alive(), "failed-over query wedged"
+                assert victim is not None, \
+                    "no replica ever showed the query running"
+                assert "err" not in box, box.get("err")
+                assert box["table"].equals(warm)
+                led = box["metrics"].get("cost_ledger")
+                assert isinstance(led, dict)
+                assert led["fleet"]["failover"] in ("resume",
+                                                    "reexecute")
+                assert led["fleet"]["hops"] >= 2
+                r = h.router.stats_dict()["router"]
+                assert r["replica_deaths"] == 1
+        finally:
+            conf.unset(cfg.TRACE_ENABLED)
+            conf.unset(cfg.TRACE_DIR)
+            conf.unset(cfg.BUNDLE_ENABLED)
+            conf.unset(cfg.BUNDLE_DIR)
+
+        # --- ONE stitched timeline over everything the fleet exported
+        st = trace_report.stitch(trace_report.load_dir_raw(tdir))
+        roles = {g["role"] for g in st["groups"]}
+        assert roles == {"client", "router", "replica"}
+        # failover visible: the victim AND the survivor both appear in
+        # the same trace (two distinct replica processes)
+        replica_pids = {g["pid"] for g in st["groups"]
+                        if g["role"] == "replica"}
+        assert len(replica_pids) >= 2, st["groups"]
+        assert st["processes"] >= 4
+        # every replica group was adopted FROM the router
+        child_roles = {ln["child_group"][0]: ln["parent_group"][0]
+                       for ln in st["links"]}
+        assert child_roles.get("replica") == "router"
+        assert child_roles.get("router") == "client"
+        # the CLI renders it (rc 0, driver-contract JSON last line)
+        assert trace_report.main([tdir, "--stitch"]) == 0
+
+        # --- the death landed as a fleet bundle with the failover
+        # record attached after the survivor finished
+        bundles = glob.glob(os.path.join(bdir, "bundle_fleet_death_*"))
+        assert len(bundles) == 1, bundles
+        names = set(os.listdir(bundles[0]))
+        assert {"bundle.json", "routing_timeline.jsonl",
+                "replica_health.json", "replica_queries.json",
+                "router_stats.json", "failover.json"} <= names
+        with open(os.path.join(bundles[0], "bundle.json")) as f:
+            mf = json.load(f)
+        assert mf["kind"] == "fleet_death"
+        with open(os.path.join(bundles[0], "failover.json")) as f:
+            fo = json.load(f)
+        assert fo["action"] in ("resume", "reexecute")
+        # ops_report renders a fleet-death bundle without raising,
+        # leading with the dead replica and the recovery line
+        import ops_report
+        text = ops_report.render_bundle(bundles[0])
+        assert "fleet death" in text or "replica" in text
+        assert fo["survivor"] in text
+
+
+class TestScrapeUnderFailover:
+    def _get(self, url, path):
+        with urllib.request.urlopen(url + path, timeout=10) as r:
+            return r.read().decode()
+
+    def test_scrapes_strict_parse_through_kill(self, workdir):
+        """The scrape-under-failover satellite: poll the router's
+        /metrics and /fleet/queries WHILE a replica is SIGKILLed
+        mid-burst — every /metrics poll must strict-parse, the router
+        must never wedge, and once the death is confirmed the dead
+        replica shows as a labeled ``down`` row (its gauge drops to 0)
+        while the survivors' series stay present."""
+        data = os.path.join(workdir, "data_scrape")
+        os.makedirs(data, exist_ok=True)
+        task = lr._task_bytes(lr._dataset(data, 600_000))
+        conf = cfg.get_config()
+        conf.set(cfg.FLEET_OPS_PORT, 0)
+        try:
+            with FleetHarness(3) as h:
+                ops = h.router.ops_address
+                assert ops is not None, \
+                    "router ops endpoint did not start"
+                url = f"http://{ops[0]}:{ops[1]}"
+                # warm pass: federation up, every replica labeled
+                fams = obs_registry.parse_prometheus(
+                    self._get(url, "/metrics"))
+                polls = [1]
+
+                def poll_once():
+                    obs_registry.parse_prometheus(
+                        self._get(url, "/metrics"))
+                    fq = json.loads(self._get(url, "/fleet/queries"))
+                    assert fq["role"] == "router"
+                    polls[0] += 1
+                    return fq
+
+                box: dict = {}
+
+                def drive() -> None:
+                    try:
+                        tbl, m = h.client(timeout_s=120).execute(task)
+                        box["table"], box["metrics"] = tbl, m
+                    except BaseException as e:   # noqa: BLE001
+                        box["err"] = e
+
+                t = threading.Thread(target=drive, daemon=True)
+                t.start()
+                victim = _kill_busy_replica(h, t)
+                while t.is_alive():
+                    poll_once()       # scraped THROUGH the failover
+                    time.sleep(0.05)
+                t.join(timeout=120)
+                assert victim is not None
+                assert "err" not in box, box.get("err")
+                dead = h.replicas[victim].name
+
+                # the dead replica converges to a labeled down row
+                deadline = time.monotonic() + 30.0
+                fq = poll_once()
+                while time.monotonic() < deadline:
+                    row = fq["replicas"].get(
+                        f"r{victim}") or {}
+                    if row.get("status") == "down":
+                        break
+                    time.sleep(0.2)
+                    fq = poll_once()
+                row = fq["replicas"][f"r{victim}"]
+                assert row["status"] == "down", fq["replicas"]
+                assert row["name"] == dead
+                live = [k for k, v in fq["replicas"].items()
+                        if v["status"] != "down"]
+                assert len(live) == 2
+
+                # the reachability gauge records the death with the
+                # replica label; survivors stay at 1
+                fams = obs_registry.parse_prometheus(
+                    self._get(url, "/metrics"))
+                # the gauge is process-global: filter to THIS fleet's
+                # replica names (an earlier fleet in the same process
+                # legitimately left its own labeled series behind)
+                mine = {r.name for r in h.replicas}
+                up = {s[1]["replica"]: s[2] for s in
+                      fams["auron_fleet_replica_up"]["samples"]
+                      if s[1].get("replica") in mine}
+                assert up[dead] == 0.0
+                assert sorted(up.values()) == [0.0, 1.0, 1.0]
+                # federated families from the survivors still present,
+                # re-labeled replica="rN"
+                relabeled = {s[1]["replica"]
+                             for fam in fams.values()
+                             for s in fam["samples"]
+                             if "replica" in s[1]
+                             and s[1]["replica"].startswith("r")}
+                assert relabeled & {f"r{i}" for i in range(3)}, \
+                    sorted(fams)
+
+                # health degrades but answers; the router still serves
+                health = json.loads(self._get(url, "/healthz"))
+                assert health["role"] == "router"
+                assert health["replicas_live"] == 2
+                tbl2, _ = h.client(timeout_s=120).execute(task)
+                assert tbl2.equals(box["table"])
+                assert polls[0] >= 3
+        finally:
+            conf.unset(cfg.FLEET_OPS_PORT)

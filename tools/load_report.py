@@ -97,14 +97,18 @@ def _pct(sorted_vals, p):
                            len(sorted_vals) - 1)]
 
 
-def _drive(addr, task, n_requests, outcomes, lock):
+def _drive(addr, task, n_requests, outcomes, lock, ledgers=None):
     from auron_tpu.runtime.serving import AuronClient
     client = AuronClient(*addr, timeout_s=120)
     for _ in range(n_requests):
         t0 = time.perf_counter()
         try:
-            client.execute(task)
+            _tbl, metrics = client.execute(task)
             kind = "ok"
+            if ledgers is not None and isinstance(metrics, dict) \
+                    and isinstance(metrics.get("cost_ledger"), dict):
+                with lock:
+                    ledgers.append(metrics["cost_ledger"])
         except RuntimeError as e:
             kind = ("rejected" if "AdmissionRejected" in str(e)
                     else "error")
@@ -146,9 +150,11 @@ def run_load(clients: int, requests: int, max_concurrent: int,
             # concurrent storm
             before = srv.scheduler.stats()
             outcomes: list = []
+            ledgers: list = []
             threads = [threading.Thread(
                 target=_drive,
-                args=(srv.address, task, requests, outcomes, lock),
+                args=(srv.address, task, requests, outcomes, lock,
+                      ledgers),
                 daemon=True) for _ in range(clients)]
             t0 = time.perf_counter()
             for t in threads:
@@ -201,6 +207,9 @@ def run_load(clients: int, requests: int, max_concurrent: int,
                 },
                 "wedged_clients": wedged,
                 "server_stats": dict(srv.stats),
+                # per-query cost ledgers off the DONE frames, folded
+                # into fleet-scale totals (obs/ledger.fold)
+                "cost": _fold_ledgers(ledgers),
             }
         finally:
             srv.shutdown()
@@ -283,6 +292,11 @@ def run_repeat(repeats: int, rows: int) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _fold_ledgers(ledgers: list) -> dict:
+    from auron_tpu.obs import ledger as ledger_mod
+    return ledger_mod.fold(ledgers)
+
+
 def _fleet_burst(harness, task, clients: int, requests: int,
                  kill_index=None, kill_after_s: float = 0.0):
     """Drive ``clients`` x ``requests`` through the harness's router,
@@ -300,16 +314,21 @@ def _fleet_burst(harness, task, clients: int, requests: int,
     # capacity into the "one replica" baseline)
     barrier = threading.Barrier(clients)
 
+    ledgers: list = []
+
     def drive():
         client = harness.client(timeout_s=120)
         barrier.wait(timeout=60)
         for _ in range(requests):
             t0 = time.perf_counter()
             try:
-                tbl, _ = client.execute(task)
+                tbl, metrics = client.execute(task)
                 kind = "ok"
                 with lock:
                     tables.append(tbl)
+                    if isinstance(metrics, dict) and isinstance(
+                            metrics.get("cost_ledger"), dict):
+                        ledgers.append(metrics["cost_ledger"])
             except Exception as e:   # noqa: BLE001 — tally, don't crash
                 kind = ("rejected" if "AdmissionRejected" in str(e)
                         else "error")
@@ -343,7 +362,7 @@ def _fleet_burst(harness, task, clients: int, requests: int,
         if t.is_alive():
             wedged += 1
     wall = time.perf_counter() - t0
-    return outcomes, wall, tables, wedged, error_samples
+    return outcomes, wall, tables, wedged, error_samples, ledgers
 
 
 def _journal_orphans(journal_dir: str) -> list:
@@ -387,14 +406,14 @@ def run_fleet(n: int, clients: int, requests: int, rows: int) -> dict:
             if warm[0][0] != "ok":
                 raise SystemExit("fleet report: warmup failed")
             base_tbl, _ = h1.client(timeout_s=120).execute(task)
-            out1, wall1, _tbls1, wedged1, errs1 = _fleet_burst(
+            out1, wall1, _tbls1, wedged1, errs1, _led1 = _fleet_burst(
                 h1, task, clients, requests)
             stats1 = h1.router.stats_dict()
 
         with FleetHarness(n, journal_dir=jdir_n,
                           env_extra=env_extra) as hn:
             _drive(hn.address, task, 1, [], lock)   # warm compiles
-            outn, walln, tblsn, wedgedn, errsn = _fleet_burst(
+            outn, walln, tblsn, wedgedn, errsn, ledn = _fleet_burst(
                 hn, task, clients, requests, kill_index=0,
                 kill_after_s=1.0)
             statsn = hn.router.stats_dict()
@@ -442,6 +461,9 @@ def run_fleet(n: int, clients: int, requests: int, rows: int) -> dict:
             "router": statsn["router"],
             "journal_orphans": orphans,
             "error_samples": errs1 + errsn,
+            # folded per-query cost ledgers from the fleet burst's DONE
+            # frames — fleet.hops/failover facts stamped by the router
+            "cost": _fold_ledgers(ledn),
         }
     finally:
         import shutil
@@ -515,6 +537,15 @@ def main(argv=None) -> int:
               f"p50/p99 {fo['latency_p50_s']}s / {fo['latency_p99_s']}s")
         print(f"  bit-identical results: {rep['bit_identical']} ; "
               f"journal orphans: {len(rep['journal_orphans'])}")
+        cost = rep.get("cost") or {}
+        if cost.get("queries"):
+            print(f"  cost ledgers: {cost['queries']} queries, "
+                  f"device {cost['device_s']}s / host "
+                  f"{cost['host_total_s']}s, "
+                  f"{cost['rows']} rows, "
+                  f"{cost['replica_hops']} replica hop(s), "
+                  f"{cost['failovers']} failed-over, "
+                  f"{cost['cache_hits']} cache hit(s)")
         rc = 0
         if f["error"] or f["wedged"] or o["error"] or o["wedged"]:
             print(f"  FAIL: {f['error'] + o['error']} request(s) died "
@@ -586,6 +617,12 @@ def main(argv=None) -> int:
           f"{rep['sched']['queue_wait_p50_s']}s / "
           f"{rep['sched']['queue_wait_p99_s']}s")
     print(f"  sheds by reason: {rep['sched']['rejected_by_reason']}")
+    cost = rep.get("cost") or {}
+    if cost.get("queries"):
+        print(f"  cost ledgers: {cost['queries']} queries, "
+              f"device {cost['device_s']}s / host "
+              f"{cost['host_total_s']}s, shuffle "
+              f"{cost['shuffle_bytes']}B, spill {cost['spill_bytes']}B")
     rc = 0
     if args.expect_shed and c["rejected"] == 0:
         print("  FAIL: overload produced no rejections — admission "

@@ -11,6 +11,15 @@ failing query's events flagged, neighbors interleaved), the scheduler /
 memmgr / mesh state at failure time, and the explain-with-metrics tree
 when the bundle carries one. The live mode polls /healthz, /queries and
 /metrics and prints the same shape for a process that is still up.
+
+``--url`` understands BOTH endpoint flavors: a replica's ops endpoint
+(PR 14) and the fleet router's (``auron.fleet.ops_port``) — the
+/healthz body's ``role`` key picks the renderer. Against a router it
+prints the merged fleet query table (each row tagged with its replica)
+and the per-replica health/occupancy table, dead replicas labeled
+``down``. Fleet death bundles (``bundle_fleet_death_*``) render their
+routing timeline, the dead replica's last scraped state, and the
+survivor's failover record.
 """
 
 from __future__ import annotations
@@ -56,10 +65,61 @@ def _load_json(path: str):
         return None
 
 
+def render_fleet_death(path: str, mf: dict) -> str:
+    """A fleet death bundle: the router's routing/failover timeline,
+    the dead replica's last scraped state, and (when recovery landed)
+    the survivor's failover record."""
+    from auron_tpu.obs import flight_recorder as flight
+    out = [
+        f"fleet death bundle: {path}",
+        f"  replica   : {mf.get('replica')}",
+        f"  outcome   : {mf.get('outcome')}",
+        f"  router pid: {mf.get('pid')}   created: "
+        f"{mf.get('created_wall')}",
+    ]
+    fo = _load_json(os.path.join(path, "failover.json"))
+    if fo:
+        out.append(f"  recovery  : {fo.get('action')} on "
+                   f"{fo.get('survivor')} after "
+                   f"{fo.get('latency_s')}s")
+    else:
+        out.append("  recovery  : (no failover record — nothing was "
+                   "in flight, or recovery failed)")
+    health = _load_json(os.path.join(path, "replica_health.json"))
+    if health:
+        out.append("")
+        out.append(f"dead replica's last scraped health: "
+                   f"status={health.get('status')}"
+                   + (f" reasons={health.get('reasons')}"
+                      if health.get("reasons") else ""))
+    queries = _load_json(os.path.join(path, "replica_queries.json"))
+    if queries:
+        rows = queries.get("queries") or []
+        out.append(f"dead replica's last query table "
+                   f"({len(rows)} rows):")
+        for row in rows:
+            out.append(f"  {row.get('query'):<12} "
+                       f"{row.get('state'):<8} "
+                       f"wall={row.get('wall_s')}s")
+    tl = os.path.join(path, "routing_timeline.jsonl")
+    if os.path.exists(tl):
+        events = flight.read_jsonl(tl)
+        out.append("")
+        out.append(f"routing timeline ({len(events)} router events):")
+        out.extend(render_timeline(events))
+    stats = _load_json(os.path.join(path, "router_stats.json"))
+    if stats:
+        out.append("")
+        out.append(f"router counters: {stats.get('router')}")
+    return "\n".join(out) + "\n"
+
+
 def render_bundle(path: str) -> str:
     from auron_tpu.obs import bundle as bundle_mod
     from auron_tpu.obs import flight_recorder as flight
     mf = bundle_mod.read_manifest(path)
+    if mf.get("kind") == "fleet_death":
+        return render_fleet_death(path, mf)
     qid = mf.get("query_id", "?")
     out = [
         f"post-mortem bundle: {path}",
@@ -72,6 +132,15 @@ def render_bundle(path: str) -> str:
         f"  pid       : {mf.get('pid')}   created: "
         f"{mf.get('created_wall')}",
     ]
+    led = _load_json(os.path.join(path, "ledger.json"))
+    if led:
+        out.append(f"  cost      : device={led.get('device_s')}s "
+                   f"host={led.get('host_total_s')}s "
+                   f"wall={led.get('wall_s')}s "
+                   f"rows={led.get('rows')} "
+                   f"spill={_g(led, 'spill', 'bytes')}B "
+                   f"shuffle={_g(led, 'shuffle', 'bytes')}B "
+                   f"retries={_g(led, 'retries', 'transient_retries')}")
     flight_path = os.path.join(path, "flight.jsonl")
     if os.path.exists(flight_path):
         events = flight.read_jsonl(flight_path)
@@ -128,6 +197,70 @@ def render_bundle(path: str) -> str:
     return "\n".join(out) + "\n"
 
 
+def _g(d: dict, *keys, default="-"):
+    """Nested dict get for report rows (missing keys render '-')."""
+    for k in keys:
+        if not isinstance(d, dict) or k not in d:
+            return default
+        d = d[k]
+    return d
+
+
+def render_fleet_live(url: str, get, health: dict) -> str:
+    """The router flavor of the live poll: per-replica health /
+    occupancy (dead replicas labeled ``down``), the merged fleet query
+    table, router counters, and the federated metrics' outcome view."""
+    fleet = json.loads(get("/fleet/queries"))
+    out = [f"live fleet poll: {url}",
+           f"  status : {health.get('status')}  replicas "
+           f"{health.get('replicas_live')}/"
+           f"{health.get('replicas_total')} live"]
+    rt = health.get("router") or {}
+    out.append(f"  router : routed={rt.get('routed')} "
+               f"spillovers={rt.get('spillovers')} "
+               f"deaths={rt.get('replica_deaths')} "
+               f"failovers={rt.get('failovers_resume')}+"
+               f"{rt.get('failovers_reexecute')}")
+    out.append("")
+    out.append("replicas:")
+    for label, rep in sorted((fleet.get("replicas") or {}).items()):
+        out.append(f"  {label:<4} {rep.get('name'):<22} "
+                   f"{rep.get('status'):<12} "
+                   f"running={rep.get('running')} "
+                   f"queued={rep.get('queued')} "
+                   f"pid={rep.get('pid')}")
+    out.append("")
+    out.append("fleet queries (merged):")
+    rows = fleet.get("queries") or []
+    if not rows:
+        out.append("  (idle)")
+    for row in rows:
+        out.append(f"  {row.get('replica'):<4} "
+                   f"{row.get('query'):<12} {row.get('state'):<8} "
+                   f"wall={row.get('wall_s')}s "
+                   f"tasks={row.get('tasks_done')}/"
+                   f"{row.get('tasks_total')}")
+    from auron_tpu.obs import registry as obs_registry
+    fams = obs_registry.parse_prometheus(get("/metrics").decode())
+    up = fams.get("auron_fleet_replica_up")
+    if up:
+        out.append("")
+        out.append("federated reachability (auron_fleet_replica_up):")
+        for name, labels, value in up["samples"]:
+            out.append(f"  {labels.get('replica'):<22} "
+                       f"{'up' if value else 'DOWN'}")
+    dur = fams.get("auron_query_duration_seconds")
+    if dur:
+        out.append("")
+        out.append("fleet query outcomes (per replica):")
+        for name, labels, value in dur["samples"]:
+            if name.endswith("_count"):
+                out.append(f"  replica={labels.get('replica', '-'):<4} "
+                           f"outcome={labels.get('outcome'):<10} "
+                           f"count={value:g}")
+    return "\n".join(out) + "\n"
+
+
 def render_live(url: str) -> str:
     import urllib.request
 
@@ -137,6 +270,8 @@ def render_live(url: str) -> str:
             return r.read()
 
     health = json.loads(get("/healthz"))
+    if health.get("role") == "router":
+        return render_fleet_live(url, get, health)
     queries = json.loads(get("/queries"))
     out = [f"live ops poll: {url}",
            f"  status : {health.get('status')}"

@@ -686,6 +686,144 @@ def run_fleet_gate(smoke: dict) -> dict:
     return out
 
 
+def obs_fleet_verdict(base_s: float, obs_s: float, smoke: dict, *,
+                      ledgers_on: int, ledgers_off: int,
+                      queries: int) -> dict:
+    """Pure verdict for the fleet-observability overhead arm (the unit
+    the seeded-regression test drives with synthetic walls): ``base_s``
+    is the best observed wall with trace propagation + the cost ledger
+    OFF, ``obs_s`` with both ON, over the same ``queries``-query batch.
+    The A/B must be HONEST to gate anything: the on-arm must have
+    produced a cost ledger on every query (an idle ledger would measure
+    nothing) and the off-arm must have produced none (a knob that no
+    longer disengages would measure the feature against itself)."""
+    limit = float(smoke.get("obs_fleet_overhead_pct_max", 2.0))
+    out: dict = {"obs_fleet_gate": "pass",
+                 "obs_fleet_overhead_pct_max": limit,
+                 "obs_fleet_queries": queries,
+                 "obs_fleet_base_s": round(base_s, 4),
+                 "obs_fleet_obs_s": round(obs_s, 4),
+                 "obs_fleet_ledgers": ledgers_on}
+    if not (base_s > 0.0) or not (obs_s > 0.0):
+        out["obs_fleet_gate"] = "fail"
+        out["obs_fleet_error"] = (
+            "overhead measurement went dark (non-positive wall) — "
+            "nothing to gate")
+        return out
+    overhead = (obs_s - base_s) / base_s * 100.0
+    out["obs_fleet_overhead_pct"] = round(overhead, 3)
+    if ledgers_on < queries:
+        out["obs_fleet_gate"] = "fail"
+        out["obs_fleet_error"] = (
+            f"cost ledger engaged on only {ledgers_on}/{queries} "
+            f"on-arm queries — the overhead measured an idle ledger")
+    elif ledgers_off:
+        out["obs_fleet_gate"] = "fail"
+        out["obs_fleet_error"] = (
+            f"off-arm still produced {ledgers_off} cost ledger(s) — "
+            f"auron.ledger.enabled no longer disengages, the A/B "
+            f"measured the feature against itself")
+    elif overhead >= limit:
+        out["obs_fleet_gate"] = "fail"
+        out["obs_fleet_error"] = (
+            f"trace-propagation + cost-ledger overhead "
+            f"{overhead:.2f}% >= {limit:.0f}% of the serving wall "
+            f"(fleet-observability gate)")
+    return out
+
+
+def run_obs_fleet_gate(smoke: dict) -> dict:
+    """Fleet-observability overhead arm (ISSUE 20): the cross-process
+    trace plumbing (KIND_TRACE prefix frame + wire_scope adoption) and
+    the per-query cost ledger both sit on the serving hot path, so this
+    arm runs the SAME grouped-agg through one in-process AuronServer
+    with tracing on in BOTH arms and only ``auron.trace.propagate`` +
+    ``auron.ledger.enabled`` toggled between them. Best-of-3
+    interleaved passes per arm (min wall over a 4-query batch) against
+    ``smoke.obs_fleet_overhead_pct_max``; verdict mechanics live in
+    ``obs_fleet_verdict``."""
+    import tempfile
+    import time
+
+    try:
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from auron_tpu import config as cfg
+        from auron_tpu.ir import pb
+        from auron_tpu.runtime.serving import AuronClient, AuronServer
+
+        root = tempfile.mkdtemp(prefix="auron_obs_gate_")
+        try:
+            rng = np.random.default_rng(20)
+            n = 120_000
+            path = os.path.join(root, "obs.parquet")
+            pq.write_table(pa.table({
+                "k": pa.array(rng.integers(0, 32, n), pa.int64()),
+                "v": pa.array(rng.normal(size=n), pa.float64())}), path)
+            col = lambda i: pb.ExprNode(column=pb.ColumnRefE(index=i))
+            plan = pb.PlanNode(agg=pb.AggNode(
+                child=pb.PlanNode(parquet_scan=pb.ParquetScanNode(
+                    files=[path])),
+                mode="complete", group_exprs=[col(0)],
+                aggs=[pb.AggFunctionP(fn="sum", arg=col(1)),
+                      pb.AggFunctionP(fn="count", arg=col(1))]))
+            task = pb.TaskDefinition(plan=plan,
+                                     task_id=1).SerializeToString()
+
+            conf = cfg.get_config()
+            conf.set(cfg.TRACE_ENABLED, True)
+            srv = AuronServer()
+            srv.serve_background()
+            try:
+                client = AuronClient(*srv.address, timeout_s=120)
+                passes, batch = 3, 4
+
+                def arm(obs_on: bool) -> "tuple[float, int]":
+                    conf.set(cfg.TRACE_PROPAGATE, obs_on)
+                    conf.set(cfg.LEDGER_ENABLED, obs_on)
+                    led = 0
+                    t0 = time.perf_counter()
+                    for _ in range(batch):
+                        _tbl, metrics = client.execute(task)
+                        if isinstance(metrics.get("cost_ledger"), dict):
+                            led += 1
+                    return time.perf_counter() - t0, led
+
+                arm(True)   # warm compiles + first-span setup costs
+                base_s = obs_s = float("inf")
+                ledgers_on = ledgers_off = 0
+                # interleaved passes so container drift hits both arms
+                for _ in range(passes):
+                    w, led = arm(False)
+                    base_s = min(base_s, w)
+                    ledgers_off += led
+                    w, led = arm(True)
+                    obs_s = min(obs_s, w)
+                    ledgers_on += led
+            finally:
+                srv.shutdown()
+                conf.unset(cfg.TRACE_ENABLED)
+                conf.unset(cfg.TRACE_PROPAGATE)
+                conf.unset(cfg.LEDGER_ENABLED)
+            # the on-arm must have engaged on EVERY query of every pass
+            # and the off-arm on none — obs_fleet_verdict normalizes to
+            # one pass's batch for the engagement contract
+            return obs_fleet_verdict(
+                base_s, obs_s, smoke,
+                ledgers_on=ledgers_on // passes,
+                ledgers_off=ledgers_off, queries=batch)
+        finally:
+            import shutil
+            shutil.rmtree(root, ignore_errors=True)
+    except Exception as e:   # noqa: BLE001 — verdict, not a crash
+        return {"obs_fleet_gate": "fail",
+                "obs_fleet_overhead_pct_max": float(
+                    smoke.get("obs_fleet_overhead_pct_max", 2.0)),
+                "obs_fleet_error": f"{type(e).__name__}: {e}"}
+
+
 def run_smoke(baseline: dict) -> dict:
     """Tier-1-fast smoke arm: run the q01 operator pipeline in-process
     at a tiny scale and compare against the generous smoke floor — an
@@ -844,6 +982,17 @@ def run_smoke(baseline: dict) -> dict:
             verdict["perf_gate"] = "fail"
             verdict["reason"] = (
                 f"fleet gate: {verdict.get('fleet_error', 'failed')}")
+        # fleet-observability arm: the trace-propagation + cost-ledger
+        # plumbing on the serving hot path must stay under the
+        # obs_fleet_overhead_pct_max share of the A/B wall, with the
+        # ledger engaging on-arm and disengaging off-arm
+        verdict.update(run_obs_fleet_gate(smoke))
+        if verdict["obs_fleet_gate"] != "pass" \
+                and verdict["perf_gate"] == "pass":
+            verdict["perf_gate"] = "fail"
+            verdict["reason"] = (
+                f"obs-fleet gate: "
+                f"{verdict.get('obs_fleet_error', 'failed')}")
         # lint arm: the AST contract checker must hold on HEAD (a
         # missing/stale tools/lint_baseline.json fails loudly — decay
         # of the invariant surface can't hide between rounds either)
@@ -900,6 +1049,9 @@ def main(argv=None) -> int:
               f"{verdict.get('fleet_failover_kind', '?')} in "
               f"{verdict.get('fleet_failover_s', '?')}s (ceiling "
               f"{verdict.get('fleet_failover_ceiling_s', '?'):.0f}s), "
+              f"obs overhead "
+              f"{verdict.get('obs_fleet_overhead_pct', '?')}% (limit "
+              f"{verdict.get('obs_fleet_overhead_pct_max', '?'):.0f}%), "
               f"lint {verdict.get('lint_new', '?')} new → "
               f"{verdict['perf_gate'].upper()}")
         print(json.dumps(verdict))

@@ -12,6 +12,21 @@ unfused, checksums on vs off, ...) by per-category totals.
     python tools/trace_report.py /tmp/trace_dir
     python tools/trace_report.py /tmp/trace_dir --top 5
     python tools/trace_report.py --compare /tmp/base /tmp/candidate
+    python tools/trace_report.py /tmp/trace_dir --stitch
+    python tools/trace_report.py /tmp/trace_dir --stitch --trace 7
+
+``--stitch`` renders one CROSS-PROCESS trace as a single timeline:
+the wire protocol's TRACE frame propagates a trace id client → router
+→ replica, each process streams its spans to its own
+``trace_<id>_<role><pid>.jsonl`` (role/pid/epoch-wall stamped on every
+record), and the ``fleet.adopt`` span's remote_parent/remote_role/
+remote_pid attributes carry the cross-process parent link (span ids
+are per-process counters, so the link cannot be an id match). The
+stitcher groups records by (role, pid), builds each process's local
+span tree, grafts adopted groups under their remote parent, and orders
+everything on the epoch wall clock — a mid-query failover shows as the
+dead replica's truncated group followed by the adoption hop to the
+survivor.
 
 The last stdout line is one JSON record (same driver contract as
 bench.py / compile_report.py / chaos_report.py).
@@ -43,6 +58,152 @@ def load_dir(trace_dir: str) -> list:
         spans.extend(read_jsonl(f))
     spans.sort(key=lambda s: (s.ts_ns, s.span_id))
     return spans
+
+
+def load_dir_raw(trace_dir: str) -> list:
+    """Every exported span record in ``trace_dir`` as raw dicts (the
+    stitch path needs the role/pid/wall keys the Span class does not
+    carry). Tolerant of partial files — a SIGKILLed replica leaves a
+    torn last line, which ``read_jsonl_raw`` skips."""
+    from auron_tpu.obs.trace import read_jsonl_raw
+    recs: list = []
+    files = sorted(glob.glob(os.path.join(trace_dir, "trace_*.jsonl")))
+    if not files:
+        raise SystemExit(f"no trace_*.jsonl files under {trace_dir!r} "
+                         "(run with auron.trace.enabled + auron.trace.dir)")
+    for f in files:
+        recs.extend(read_jsonl_raw(f))
+    return recs
+
+
+def stitch(records: list, trace_id=None) -> dict:
+    """Assemble one cross-process trace from raw exported records.
+
+    Returns ``{"trace", "groups": [group...], "links": [...],
+    "spans", "processes", "wall_span_s"}`` where each group is one
+    (role, pid) process view — records wall-ordered, local parent tree
+    resolved — and each link is a (parent group, parent span id, child
+    group) graft derived from a ``fleet.adopt`` span's remote_* attrs.
+    """
+    recs = [r for r in records if isinstance(r.get("span"), int)]
+    if trace_id is None:
+        # the most interesting trace: most distinct processes, then
+        # most records (a fleet query beats a local warm-up trace)
+        counts: dict = {}
+        for r in recs:
+            t = r.get("trace")
+            ent = counts.setdefault(t, [set(), 0])
+            ent[0].add((r.get("role"), r.get("pid")))
+            ent[1] += 1
+        if not counts:
+            raise SystemExit("no span records to stitch")
+        trace_id = max(counts,
+                       key=lambda t: (len(counts[t][0]), counts[t][1]))
+    recs = [r for r in recs if r.get("trace") == trace_id]
+    groups: dict = {}
+    for r in recs:
+        key = (str(r.get("role") or "?"), int(r.get("pid") or 0))
+        groups.setdefault(key, []).append(r)
+    out_groups = []
+    links = []
+    for key in sorted(groups, key=lambda k: min(
+            r.get("wall") or 0.0 for r in groups[k])):
+        rows = sorted(groups[key], key=lambda r: (r.get("wall") or 0.0,
+                                                  r["span"]))
+        by_id = {r["span"]: r for r in rows}
+        roots = [r for r in rows
+                 if not r.get("parent") or r["parent"] not in by_id]
+        out_groups.append({"role": key[0], "pid": key[1],
+                           "records": rows, "by_id": by_id,
+                           "roots": roots})
+        for r in rows:
+            if r.get("name") != "fleet.adopt":
+                continue
+            attrs = r.get("attrs") or {}
+            links.append({
+                "parent_group": (str(attrs.get("remote_role") or "?"),
+                                 int(attrs.get("remote_pid") or 0)),
+                "parent_span": int(attrs.get("remote_parent") or 0),
+                "child_group": key, "adopt_span": r["span"]})
+    walls = [r.get("wall") or 0.0 for r in recs]
+    return {"trace": trace_id, "groups": out_groups, "links": links,
+            "spans": len(recs), "processes": len(out_groups),
+            "wall_span_s": round(max(walls) - min(walls), 6)
+            if walls else 0.0}
+
+
+def print_stitched(st: dict) -> None:
+    """One timeline, all processes: each span at its wall offset from
+    the trace start, adopted process groups nested under the span that
+    forwarded the context to them."""
+    t0 = min((r.get("wall") or 0.0 for g in st["groups"]
+              for r in g["records"]), default=0.0)
+    by_key = {(g["role"], g["pid"]): g for g in st["groups"]}
+    grafts: dict = {}     # (parent group key, parent span) -> [links]
+    orphan_links = []
+    for ln in st["links"]:
+        pg = by_key.get(ln["parent_group"])
+        if pg is not None and ln["parent_span"] in pg["by_id"]:
+            grafts.setdefault((ln["parent_group"], ln["parent_span"]),
+                              []).append(ln)
+        else:
+            orphan_links.append(ln)
+    print(f"stitched trace {st['trace']}: {st['processes']} "
+          f"process(es), {st['spans']} spans, "
+          f"{st['wall_span_s'] * 1e3:.1f}ms wall")
+    rendered: set = set()
+
+    def line(rec, depth):
+        rel = ((rec.get("wall") or 0.0) - t0) * 1e3
+        dur = (rec.get("dur_us") or 0.0) / 1e3
+        attrs = rec.get("attrs") or {}
+        shown = {k: v for k, v in attrs.items()
+                 if k not in ("remote_parent", "remote_role",
+                              "remote_pid") and v not in ("", 0, None)}
+        pad = "  " * depth
+        print(f"  +{rel:9.2f}ms {dur:9.2f}ms  {pad}"
+              f"{rec.get('name')}  {shown}" if shown else
+              f"  +{rel:9.2f}ms {dur:9.2f}ms  {pad}{rec.get('name')}")
+
+    def render_span(gkey, rec, depth):
+        line(rec, depth)
+        g = by_key[gkey]
+        kids = sorted((r for r in g["records"]
+                       if r.get("parent") == rec["span"]
+                       and r is not rec),
+                      key=lambda r: (r.get("wall") or 0.0, r["span"]))
+        for kid in kids:
+            render_span(gkey, kid, depth + 1)
+        for ln in grafts.get((gkey, rec["span"]), ()):
+            render_group(ln["child_group"], depth + 1)
+
+    def render_group(gkey, depth):
+        if gkey in rendered:
+            return
+        rendered.add(gkey)
+        g = by_key[gkey]
+        pad = "  " * depth
+        print(f"  {'':22s}  {pad}-> {g['role']} pid {g['pid']} "
+              f"({len(g['records'])} spans)")
+        for root in g["roots"]:
+            render_span(gkey, root, depth + 1)
+
+    # roots: groups nobody adopted (normally just the client)
+    child_keys = {ln["child_group"] for ln in st["links"]}
+    for g in st["groups"]:
+        key = (g["role"], g["pid"])
+        if key not in child_keys:
+            render_group(key, 0)
+    # orphan links (the remote parent span never hit disk — a killed
+    # process) and any group still unrendered: surface, never drop
+    for ln in orphan_links:
+        if ln["child_group"] not in rendered:
+            pr, pp = ln["parent_group"]
+            print(f"  (adopted from {pr} pid {pp}, parent span "
+                  f"{ln['parent_span']} not on disk)")
+            render_group(ln["child_group"], 0)
+    for g in st["groups"]:
+        render_group((g["role"], g["pid"]), 0)
 
 
 def summarize(spans: list, top: int = 10) -> dict:
@@ -166,11 +327,27 @@ def main(argv=None) -> int:
     ap.add_argument("--compare", nargs=2, metavar=("BASE", "CANDIDATE"),
                     default=None,
                     help="diff two trace dirs by per-category totals")
+    ap.add_argument("--stitch", action="store_true",
+                    help="render one cross-process trace as a single "
+                         "client→router→replica timeline")
+    ap.add_argument("--trace", type=int, default=None,
+                    help="trace id to stitch (default: the one "
+                         "spanning the most processes)")
     args = ap.parse_args(argv)
     if args.compare:
         return _compare(args.compare[0], args.compare[1], args.top)
     if not args.trace_dir:
         ap.error("trace_dir (or --compare) is required")
+    if args.stitch:
+        st = stitch(load_dir_raw(args.trace_dir), args.trace)
+        print_stitched(st)
+        print(json.dumps({
+            "trace": st["trace"], "spans": st["spans"],
+            "processes": st["processes"],
+            "roles": sorted({g["role"] for g in st["groups"]}),
+            "hops": len(st["links"]),
+            "wall_span_s": st["wall_span_s"]}))
+        return 0
     rep = summarize(load_dir(args.trace_dir), args.top)
     print_summary(rep, args.top)
     print(json.dumps({"trace_spans": rep["spans"],
